@@ -1,0 +1,32 @@
+"""mxnet_tpu — a TPU-native deep-learning framework with MXNet's capabilities.
+
+Conventional alias: ``import mxnet_tpu as mx``. See SURVEY.md for the layer
+map of the reference this framework re-implements TPU-first.
+"""
+from .base import MXNetError, __version__
+from . import base
+from . import context
+from .context import Context, cpu, cpu_pinned, current_context, gpu, num_gpus, num_tpus, tpu
+from . import engine
+from . import random
+from . import autograd
+from . import ops
+from . import ndarray
+from . import ndarray as nd
+from .ndarray import NDArray
+
+waitall = engine.waitall
+
+
+def __getattr__(name):
+    # lazy subpackages to keep import light
+    import importlib
+    if name in ("gluon", "optimizer", "metric", "initializer", "lr_scheduler",
+                "symbol", "sym", "io", "image", "kvstore", "profiler", "module",
+                "callback", "monitor", "parallel", "test_utils", "visualization",
+                "executor", "runtime", "model", "recordio", "contrib", "amp"):
+        target = {"sym": "symbol"}.get(name, name)
+        mod = importlib.import_module(f".{target}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'mxnet_tpu' has no attribute {name!r}")

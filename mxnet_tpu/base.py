@@ -1,0 +1,96 @@
+"""Core shared definitions: dtypes, errors, registry helpers.
+
+TPU-native re-design of the roles played by dmlc-core in the reference
+(``include/mxnet/base.h``, dmlc ``LOG/CHECK`` and ``dmlc::Parameter``): here
+Python + JAX provide typing/logging, and op parameters are plain keyword
+attributes validated per-op.
+"""
+from __future__ import annotations
+
+import logging
+import numpy as np
+
+__version__ = "0.1.0"
+
+logger = logging.getLogger("mxnet_tpu")
+
+
+class MXNetError(RuntimeError):
+    """Error raised by the runtime (parity: MXNetError in python/mxnet/base.py)."""
+
+
+# dtype handling -------------------------------------------------------------
+# The reference maps int codes <-> numpy dtypes (mshadow type codes). We keep
+# the same code assignment for checkpoint compatibility (NDArray binary format
+# stores these codes; see reference src/ndarray/ndarray.cc Save/Load).
+_DTYPE_NP_TO_MX = {
+    np.dtype(np.float32): 0,
+    np.dtype(np.float64): 1,
+    np.dtype(np.float16): 2,
+    np.dtype(np.uint8): 3,
+    np.dtype(np.int32): 4,
+    np.dtype(np.int8): 5,
+    np.dtype(np.int64): 6,
+    # TPU-era addition (not in the v1.5 reference wire format):
+    np.dtype("bfloat16") if hasattr(np, "bfloat16") else "bfloat16": 7,
+    np.dtype(np.bool_): 8,
+}
+_DTYPE_MX_TO_NP = {}
+for _k, _v in list(_DTYPE_NP_TO_MX.items()):
+    _DTYPE_MX_TO_NP[_v] = _k
+
+try:  # ml_dtypes ships with jax; gives us a real bfloat16 numpy dtype
+    import ml_dtypes as _ml_dtypes
+
+    bfloat16 = np.dtype(_ml_dtypes.bfloat16)
+    _DTYPE_NP_TO_MX[bfloat16] = 7
+    _DTYPE_MX_TO_NP[7] = bfloat16
+except ImportError:  # pragma: no cover
+    bfloat16 = None
+
+
+def np_dtype(dtype):
+    """Normalise a user-provided dtype spec to a numpy dtype."""
+    if dtype is None:
+        return np.dtype(np.float32)
+    if isinstance(dtype, str) and dtype == "bfloat16" and bfloat16 is not None:
+        return bfloat16
+    return np.dtype(dtype)
+
+
+def dtype_code(dtype):
+    d = np_dtype(dtype)
+    if d not in _DTYPE_NP_TO_MX:
+        raise MXNetError(f"unsupported dtype {d}")
+    return _DTYPE_NP_TO_MX[d]
+
+
+def dtype_from_code(code):
+    if code not in _DTYPE_MX_TO_NP:
+        raise MXNetError(f"unknown dtype code {code}")
+    return _DTYPE_MX_TO_NP[code]
+
+
+# string constants mirroring GradReq (include/mxnet/op_attr_types.h OpReqType)
+GRAD_REQ_MAP = {"null": 0, "write": 1, "add": 3}
+
+
+def check_call(ret):  # parity shim: no C ABI here, everything is in-process
+    return ret
+
+
+class _NameManager:
+    """Automatic unique naming (parity: python/mxnet/name.py NameManager)."""
+
+    def __init__(self):
+        self._counter = {}
+
+    def get(self, name, hint):
+        if name is not None:
+            return name
+        n = self._counter.get(hint, 0)
+        self._counter[hint] = n + 1
+        return f"{hint}{n}"
+
+
+name_manager = _NameManager()

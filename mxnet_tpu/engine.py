@@ -1,0 +1,98 @@
+"""Thin dependency/sync layer.
+
+The reference earns async parallelism with a hand-built dependency engine
+(src/engine/threaded_engine.{h,cc}: versioned vars, per-var reader/writer
+queues, worker pools). On TPU, XLA/PJRT *is* the async engine: every op
+dispatch is asynchronous, ordering is by data dependence on immutable buffers,
+and transfers overlap compute. What survives here is the *semantic contract*:
+
+- every NDArray has an engine var with a version counter bumped on write
+  (parity: engine::Var, include/mxnet/engine.h:44-61) — used by autograd to
+  detect stale reads and by CachedOp caching;
+- ``wait_for_all`` / per-array ``wait_to_read`` sync points where async errors
+  surface (parity: ThreadedEngine::WaitForAll, threaded_engine.cc:416);
+- a NaiveEngine-style serial mode (MXNET_ENGINE_TYPE=NaiveEngine) that blocks
+  after every op for debugging (parity: src/engine/naive_engine.cc).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import weakref
+
+import jax
+
+
+class Var:
+    """Version-counted variable attached to each NDArray chunk."""
+
+    __slots__ = ("version", "__weakref__")
+
+    def __init__(self):
+        self.version = 0
+
+    def bump(self):
+        self.version += 1
+        return self.version
+
+
+class Engine:
+    """Tracks outstanding arrays so wait_for_all() has something to wait on."""
+
+    def __init__(self):
+        # id -> weakref to the producing NDArray (jax.Arrays themselves are
+        # neither hashable nor weakref-able, so we track the handles)
+        self._outstanding = {}
+        self._lock = threading.Lock()
+        self._exceptions = []
+        etype = os.environ.get("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice")
+        self.naive = etype == "NaiveEngine"
+        # bulking knobs kept for API parity; XLA fuses regardless
+        self.bulk_size = int(os.environ.get("MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN", 15))
+
+    def on_compute(self, ndarrays):
+        """Called after an op dispatch with the freshly produced NDArrays."""
+        with self._lock:
+            for a in ndarrays:
+                self._outstanding[id(a)] = weakref.ref(a)
+        if self.naive:
+            for a in ndarrays:
+                a._data.block_until_ready()
+
+    def throw(self, exc):
+        with self._lock:
+            self._exceptions.append(exc)
+
+    def wait_for_all(self):
+        with self._lock:
+            pending = list(self._outstanding.values())
+            self._outstanding = {}
+            excs, self._exceptions = self._exceptions, []
+        for ref in pending:
+            a = ref()
+            if a is not None:
+                try:
+                    a._data.block_until_ready()
+                except Exception as e:  # surface async failure at the sync point
+                    excs.append(e)
+        if excs:
+            raise excs[0]
+
+    def set_bulk_size(self, size):
+        old, self.bulk_size = self.bulk_size, size
+        return old
+
+
+_engine = Engine()
+
+
+def get():
+    return _engine
+
+
+def wait_for_all():
+    _engine.wait_for_all()
+
+
+def waitall():
+    _engine.wait_for_all()

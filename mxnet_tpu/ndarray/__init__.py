@@ -1,0 +1,45 @@
+"""mx.nd — the imperative namespace.
+
+Reference generates Python functions for each registered op at import time
+(python/mxnet/ndarray/register.py:31 codegen over the C op registry). Here the
+module exposes every registered op via module-level ``__getattr__``: NDArray
+positional args become inputs, keyword args become attrs, ``out=`` is honored.
+"""
+from . import random  # noqa: F401
+from . import sparse  # noqa: F401
+from .ndarray import (NDArray, add_n, arange, array, concat, dot, empty, eye,
+                      full, invoke, linspace, moveaxis, ones, ones_like, stack,
+                      transpose, waitall, zeros, zeros_like)
+from .utils import load, save
+from ..ops import registry as _registry
+
+ElementWiseSum = add_n
+
+
+def _make_op_func(op):
+    def fn(*args, out=None, name=None, **kwargs):
+        inputs = [a for a in args if isinstance(a, NDArray)]
+        scalars = [a for a in args
+                   if not isinstance(a, NDArray) and isinstance(a, (int, float))]
+        for attr_name, val in zip(op.scalar_args, scalars):
+            kwargs.setdefault(attr_name, val)
+        return invoke(op, inputs, kwargs, out=out)
+
+    fn.__name__ = op.name
+    fn.__doc__ = f"Imperative wrapper for operator `{op.name}`."
+    return fn
+
+
+_OP_FUNC_CACHE = {}
+
+
+def __getattr__(name):
+    if _registry.exists(name):
+        if name not in _OP_FUNC_CACHE:
+            _OP_FUNC_CACHE[name] = _make_op_func(_registry.get(name))
+        return _OP_FUNC_CACHE[name]
+    raise AttributeError(f"module 'mxnet_tpu.ndarray' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_registry.list_ops()))

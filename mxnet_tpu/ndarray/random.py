@@ -1,0 +1,66 @@
+"""mx.nd.random — sampling front-end (reference: python/mxnet/ndarray/random.py)."""
+from __future__ import annotations
+
+from ..base import np_dtype
+from .ndarray import NDArray, invoke
+
+
+def _sample(opname, shape, ctx, dtype, extra_inputs=(), **attrs):
+    if shape is None:
+        shape = (1,)
+    if isinstance(shape, int):
+        shape = (shape,)
+    attrs["shape"] = tuple(shape)
+    if dtype is not None:
+        attrs["dtype"] = np_dtype(dtype).name
+    out = invoke(opname, list(extra_inputs), attrs)
+    if ctx is not None:
+        out = out.as_in_context(ctx)
+    return out
+
+
+def uniform(low=0, high=1, shape=None, dtype=None, ctx=None, out=None):
+    return _sample("_random_uniform", shape, ctx, dtype, low=low, high=high)
+
+
+def normal(loc=0, scale=1, shape=None, dtype=None, ctx=None, out=None):
+    return _sample("_random_normal", shape, ctx, dtype, loc=loc, scale=scale)
+
+
+def randn(*shape, loc=0, scale=1, dtype=None, ctx=None):
+    return normal(loc, scale, shape or (1,), dtype, ctx)
+
+
+def gamma(alpha=1, beta=1, shape=None, dtype=None, ctx=None):
+    return _sample("_random_gamma", shape, ctx, dtype, alpha=alpha, beta=beta)
+
+
+def exponential(scale=1.0, shape=None, dtype=None, ctx=None):
+    return _sample("_random_exponential", shape, ctx, dtype, lam=1.0 / scale)
+
+
+def poisson(lam=1.0, shape=None, dtype=None, ctx=None):
+    return _sample("_random_poisson", shape, ctx, dtype, lam=lam)
+
+
+def negative_binomial(k=1, p=1, shape=None, dtype=None, ctx=None):
+    return _sample("_random_negative_binomial", shape, ctx, dtype, k=k, p=p)
+
+
+def randint(low, high, shape=None, dtype="int32", ctx=None):
+    return _sample("_random_randint", shape, ctx, dtype, low=low, high=high)
+
+
+def multinomial(data, shape=None, get_prob=False, dtype="int32"):
+    attrs = {"dtype": np_dtype(dtype).name}
+    if shape:
+        attrs["shape"] = (shape,) if isinstance(shape, int) else tuple(shape)
+    return invoke("_sample_multinomial", [data], attrs)
+
+
+def shuffle(data):
+    return invoke("_shuffle", [data], {})
+
+
+def bernoulli(p=0.5, shape=None, dtype=None, ctx=None):
+    return _sample("_random_bernoulli", shape, ctx, dtype, p=p)

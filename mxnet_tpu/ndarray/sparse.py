@@ -1,0 +1,273 @@
+"""Sparse NDArrays: row_sparse and CSR.
+
+Reference: include/mxnet/ndarray.h storage types kRowSparseStorage/kCSRStorage
+with C++/CUDA kernels (src/operator/tensor/cast_storage-inl.h, dot-inl.h).
+TPU redesign: XLA has no native sparse, so these are struct-of-dense-arrays
+(indices + values) with gather/scatter/segment_sum emissions behind the same
+``stype`` API (SURVEY.md §7 hard part 3). This keeps the *capability*
+(memory-proportional-to-nnz storage, sparse push/pull, sparse optimizer
+updates on only the touched rows) with static-shape-friendly kernels.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError, np_dtype
+from ..context import current_context
+from .ndarray import NDArray, array as _dense_array
+
+
+class BaseSparseNDArray(NDArray):
+    __slots__ = ()
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """data: (nnz_rows, *row_shape); indices: (nnz_rows,) sorted unique."""
+
+    __slots__ = ("_indices", "_full_shape")
+
+    def __init__(self, data, indices, shape, ctx=None):
+        super().__init__(data._data if isinstance(data, NDArray) else data,
+                         ctx or current_context())
+        self._indices = indices._data if isinstance(indices, NDArray) else indices
+        self._full_shape = tuple(shape)
+
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    @property
+    def shape(self):
+        return self._full_shape
+
+    @property
+    def data(self):
+        return NDArray(self._data, self._ctx)
+
+    @property
+    def indices(self):
+        return NDArray(self._indices, self._ctx)
+
+    def tostype(self, stype):
+        if stype == "row_sparse":
+            return self
+        if stype == "default":
+            return self.todense()
+        raise MXNetError(f"cast_storage row_sparse->{stype}")
+
+    def todense(self):
+        out = jnp.zeros(self._full_shape, dtype=self._data.dtype)
+        out = out.at[self._indices.astype(jnp.int32)].set(self._data)
+        return NDArray(out, self._ctx)
+
+    def asnumpy(self):
+        return np.asarray(self.todense()._data)
+
+    def copy(self):
+        return RowSparseNDArray(jnp.array(self._data), jnp.array(self._indices),
+                                self._full_shape, self._ctx)
+
+    def __repr__(self):
+        return (f"\n<RowSparseNDArray {'x'.join(map(str, self._full_shape))} "
+                f"nnz_rows={self._indices.shape[0]} @{self._ctx}>")
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed sparse row: data (nnz,), indices (nnz,), indptr (rows+1,)."""
+
+    __slots__ = ("_indices", "_indptr", "_full_shape")
+
+    def __init__(self, data, indices, indptr, shape, ctx=None):
+        super().__init__(data._data if isinstance(data, NDArray) else data,
+                         ctx or current_context())
+        self._indices = indices._data if isinstance(indices, NDArray) else indices
+        self._indptr = indptr._data if isinstance(indptr, NDArray) else indptr
+        self._full_shape = tuple(shape)
+
+    @property
+    def stype(self):
+        return "csr"
+
+    @property
+    def shape(self):
+        return self._full_shape
+
+    @property
+    def data(self):
+        return NDArray(self._data, self._ctx)
+
+    @property
+    def indices(self):
+        return NDArray(self._indices, self._ctx)
+
+    @property
+    def indptr(self):
+        return NDArray(self._indptr, self._ctx)
+
+    def _row_ids(self):
+        nnz = self._data.shape[0]
+        # row id per nnz element from indptr (searchsorted: static shapes)
+        return jnp.searchsorted(self._indptr[1:], jnp.arange(nnz), side="right")
+
+    def todense(self):
+        rows = self._row_ids()
+        out = jnp.zeros(self._full_shape, dtype=self._data.dtype)
+        out = out.at[rows, self._indices.astype(jnp.int32)].add(self._data)
+        return NDArray(out, self._ctx)
+
+    def tostype(self, stype):
+        if stype == "csr":
+            return self
+        if stype == "default":
+            return self.todense()
+        raise MXNetError(f"cast_storage csr->{stype}")
+
+    def asnumpy(self):
+        return np.asarray(self.todense()._data)
+
+    def __getitem__(self, key):
+        if isinstance(key, slice):
+            start = key.start or 0
+            stop = key.stop if key.stop is not None else self._full_shape[0]
+            d = self.todense()._data[start:stop]
+            return array(np.asarray(d), stype="csr", ctx=self._ctx)
+        raise MXNetError("CSR supports row-slice indexing only")
+
+    def __repr__(self):
+        return (f"\n<CSRNDArray {'x'.join(map(str, self._full_shape))} "
+                f"nnz={self._data.shape[0]} @{self._ctx}>")
+
+
+# -- creation ---------------------------------------------------------------
+def row_sparse_array(arg, shape=None, ctx=None, dtype=None):
+    if isinstance(arg, (tuple, list)) and len(arg) == 2:
+        data, indices = arg
+        data = jnp.asarray(np.asarray(data, dtype=np_dtype(dtype)))
+        indices = jnp.asarray(np.asarray(indices, dtype=np.int64))
+        if shape is None:
+            raise MXNetError("shape required")
+        return RowSparseNDArray(data, indices, shape, ctx)
+    dense = np.asarray(arg, dtype=np_dtype(dtype))
+    nz = np.where(np.any(dense.reshape(dense.shape[0], -1) != 0, axis=1))[0]
+    return RowSparseNDArray(jnp.asarray(dense[nz]), jnp.asarray(nz.astype(np.int64)),
+                            dense.shape, ctx)
+
+
+def csr_matrix(arg, shape=None, ctx=None, dtype=None):
+    if isinstance(arg, (tuple, list)) and len(arg) == 3:
+        data, indices, indptr = arg
+        data = jnp.asarray(np.asarray(data, dtype=np_dtype(dtype)))
+        indices = jnp.asarray(np.asarray(indices, dtype=np.int64))
+        indptr = jnp.asarray(np.asarray(indptr, dtype=np.int64))
+        if shape is None:
+            raise MXNetError("shape required")
+        return CSRNDArray(data, indices, indptr, shape, ctx)
+    dense = np.asarray(arg, dtype=np_dtype(dtype))
+    import scipy.sparse  # available transitively; fallback below if not
+    sp = scipy.sparse.csr_matrix(dense)
+    return CSRNDArray(jnp.asarray(sp.data.astype(dense.dtype)),
+                      jnp.asarray(sp.indices.astype(np.int64)),
+                      jnp.asarray(sp.indptr.astype(np.int64)),
+                      dense.shape, ctx)
+
+
+def array(source, stype="default", ctx=None, dtype=None):
+    if stype == "row_sparse":
+        return row_sparse_array(source, ctx=ctx, dtype=dtype)
+    if stype == "csr":
+        if isinstance(source, np.ndarray) or isinstance(source, (list, tuple)):
+            dense = np.asarray(source, dtype=np_dtype(dtype))
+            indptr = [0]
+            indices, data = [], []
+            for row in dense:
+                nz = np.nonzero(row)[0]
+                indices.extend(nz.tolist())
+                data.extend(row[nz].tolist())
+                indptr.append(len(indices))
+            return CSRNDArray(jnp.asarray(np.asarray(data, dtype=dense.dtype)),
+                              jnp.asarray(np.asarray(indices, dtype=np.int64)),
+                              jnp.asarray(np.asarray(indptr, dtype=np.int64)),
+                              dense.shape, ctx)
+    return _dense_array(source, ctx=ctx, dtype=dtype)
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    dtype = np_dtype(dtype)
+    if stype == "row_sparse":
+        row_shape = tuple(shape[1:])
+        return RowSparseNDArray(jnp.zeros((0,) + row_shape, dtype=dtype),
+                                jnp.zeros((0,), dtype=jnp.int64), shape, ctx)
+    if stype == "csr":
+        return CSRNDArray(jnp.zeros((0,), dtype=dtype),
+                          jnp.zeros((0,), dtype=jnp.int64),
+                          jnp.zeros((shape[0] + 1,), dtype=jnp.int64), shape, ctx)
+    from .ndarray import zeros as _z
+    return _z(shape, ctx=ctx, dtype=dtype)
+
+
+def cast_storage(arr, stype):
+    if stype == "default":
+        return arr.tostype("default") if isinstance(arr, BaseSparseNDArray) else arr
+    if stype == "row_sparse":
+        if isinstance(arr, RowSparseNDArray):
+            return arr
+        dense = arr.asnumpy()
+        return row_sparse_array(dense, ctx=arr.ctx, dtype=dense.dtype)
+    if stype == "csr":
+        if isinstance(arr, CSRNDArray):
+            return arr
+        return array(arr.asnumpy(), stype="csr", ctx=arr.ctx, dtype=arr.dtype)
+    raise MXNetError(f"unknown stype {stype}")
+
+
+def sparse_retain(arr, indices):
+    """Keep only the given rows of a RowSparseNDArray (reference:
+    src/operator/tensor/sparse_retain.cc)."""
+    if not isinstance(arr, RowSparseNDArray):
+        raise MXNetError("sparse_retain expects row_sparse input")
+    want = indices._data.astype(jnp.int64) if isinstance(indices, NDArray) else jnp.asarray(indices, jnp.int64)
+    # membership of stored rows in wanted set; keeps static shape = nnz in
+    mask = jnp.isin(arr._indices, want)
+    data = jnp.where(mask.reshape((-1,) + (1,) * (arr._data.ndim - 1)),
+                     arr._data, jnp.zeros_like(arr._data))
+    return RowSparseNDArray(data, arr._indices, arr.shape, arr._ctx)
+
+
+def _sparse_dot(a, b, transpose_a=False, transpose_b=False):
+    """dot for sparse operands (reference: src/operator/tensor/dot-inl.h).
+
+    csr·dense and csrᵀ·dense are the capability-critical paths (linear model
+    training on Criteo): emitted as segment-sum gathers so nnz work only.
+    """
+    if isinstance(a, CSRNDArray) and isinstance(b, NDArray) and not isinstance(b, BaseSparseNDArray):
+        rows = a._row_ids()
+        cols = a._indices.astype(jnp.int32)
+        if not transpose_a:
+            # out[r, :] += data * b[col, :]
+            contrib = a._data[:, None] * b._data[cols]
+            out = jax.ops.segment_sum(contrib, rows, num_segments=a.shape[0])
+            return NDArray(out, a._ctx)
+        # a^T b: out[col, :] += data * b[row, :]
+        contrib = a._data[:, None] * b._data[rows]
+        out = jnp.zeros((a.shape[1], b.shape[1]), dtype=b.dtype)
+        out = out.at[cols].add(contrib)
+        return NDArray(out, a._ctx)
+    if isinstance(a, RowSparseNDArray):
+        return NDArray(jnp.tensordot(a.todense()._data, b._data, axes=1), a._ctx)
+    if isinstance(b, BaseSparseNDArray):
+        return NDArray(jnp.tensordot(a._data, b.todense()._data, axes=1), a._ctx)
+    raise MXNetError("unsupported sparse dot combination")
+
+
+def elemwise_add(a, b):
+    if isinstance(a, RowSparseNDArray) and isinstance(b, RowSparseNDArray):
+        idx = jnp.union1d(a._indices, b._indices)
+        da = jnp.zeros((idx.shape[0],) + a._data.shape[1:], a._data.dtype)
+        pa = jnp.searchsorted(idx, a._indices)
+        pb = jnp.searchsorted(idx, b._indices)
+        da = da.at[pa].add(a._data).at[pb].add(b._data)
+        return RowSparseNDArray(da, idx, a.shape, a._ctx)
+    return a.todense() + b.todense() if isinstance(a, BaseSparseNDArray) else a + b

@@ -1,0 +1,110 @@
+"""Random sampling ops.
+
+Reference: src/operator/random/sample_op.{h,cc,cu} with per-context mshadow
+PRNG resources (kRandom/kParallelRandom). TPU redesign: counter-based
+jax.random with explicit keys — the imperative layer threads a key from the
+global mx.random state (mxnet_tpu/random.py) into ops flagged is_random, so
+seeded runs are reproducible across devices by construction.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+from ..base import np_dtype
+
+
+def _shape_dtype(attrs):
+    shape = tuple(attrs.get("shape", ()) or ())
+    dtype = np_dtype(attrs.get("dtype", "float32"))
+    return shape, dtype
+
+
+@register("_random_uniform", is_random=True, alias=("uniform",))
+def _uniform(attrs, key):
+    shape, dtype = _shape_dtype(attrs)
+    return jax.random.uniform(key, shape, dtype=dtype,
+                              minval=float(attrs.get("low", 0.0)),
+                              maxval=float(attrs.get("high", 1.0)))
+
+
+@register("_random_normal", is_random=True, alias=("normal",))
+def _normal(attrs, key):
+    shape, dtype = _shape_dtype(attrs)
+    return (jax.random.normal(key, shape, dtype=dtype)
+            * float(attrs.get("scale", 1.0)) + float(attrs.get("loc", 0.0)))
+
+
+@register("_random_gamma", is_random=True)
+def _gamma(attrs, key):
+    shape, dtype = _shape_dtype(attrs)
+    return (jax.random.gamma(key, float(attrs.get("alpha", 1.0)), shape, dtype=dtype)
+            * float(attrs.get("beta", 1.0)))
+
+
+@register("_random_exponential", is_random=True)
+def _exponential(attrs, key):
+    shape, dtype = _shape_dtype(attrs)
+    return jax.random.exponential(key, shape, dtype=dtype) / float(attrs.get("lam", 1.0))
+
+
+@register("_random_poisson", is_random=True)
+def _poisson(attrs, key):
+    shape, dtype = _shape_dtype(attrs)
+    return jax.random.poisson(key, float(attrs.get("lam", 1.0)), shape).astype(dtype)
+
+
+@register("_random_negative_binomial", is_random=True)
+def _neg_binomial(attrs, key):
+    shape, dtype = _shape_dtype(attrs)
+    k = float(attrs.get("k", 1.0))
+    p = float(attrs.get("p", 1.0))
+    lam = jax.random.gamma(key, k, shape) * (1 - p) / p
+    return jax.random.poisson(jax.random.fold_in(key, 1), lam, shape).astype(dtype)
+
+
+@register("_random_randint", is_random=True)
+def _randint(attrs, key):
+    shape = tuple(attrs.get("shape", ()) or ())
+    dtype = np_dtype(attrs.get("dtype", "int32"))
+    return jax.random.randint(key, shape, int(attrs["low"]), int(attrs["high"]),
+                              dtype=dtype)
+
+
+@register("_sample_multinomial", is_random=True, alias=("multinomial",))
+def _multinomial(attrs, key, data):
+    shape = attrs.get("shape", ())
+    n = 1
+    if shape:
+        n = int(shape[0]) if isinstance(shape, (tuple, list)) else int(shape)
+    logits = jnp.log(jnp.maximum(data, 1e-30))
+    if data.ndim == 1:
+        out = jax.random.categorical(key, logits, shape=(n,))
+        return out.astype(np_dtype(attrs.get("dtype", "int32")))
+    out = jax.random.categorical(key, logits[:, None, :], axis=-1,
+                                 shape=(data.shape[0], n))
+    if not shape:
+        out = out[:, 0]
+    return out.astype(np_dtype(attrs.get("dtype", "int32")))
+
+
+@register("_shuffle", is_random=True, alias=("shuffle",))
+def _shuffle(attrs, key, data):
+    return jax.random.permutation(key, data, axis=0)
+
+
+@register("_sample_unique_zipfian", is_random=True)
+def _sample_unique_zipfian(attrs, key):
+    n = int(attrs["range_max"])
+    shape = tuple(attrs.get("shape", (1,)))
+    u = jax.random.uniform(key, shape)
+    out = (jnp.exp(u * jnp.log(n + 1.0)) - 1.0).astype(jnp.int64)
+    return jnp.clip(out, 0, n - 1)
+
+
+# GPU-free bernoulli helper used by gluon (not in reference op set by this name)
+@register("_random_bernoulli", is_random=True)
+def _bernoulli(attrs, key):
+    shape, dtype = _shape_dtype(attrs)
+    return jax.random.bernoulli(key, float(attrs.get("p", 0.5)), shape).astype(dtype)

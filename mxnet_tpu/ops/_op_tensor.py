@@ -1,0 +1,574 @@
+"""Tensor ops: elementwise, scalar, broadcast, reduce, matrix manipulation,
+indexing, init.
+
+Covers the capability of reference src/operator/tensor/* (~55k LoC of
+C++/CUDA: elemwise_unary_op*, elemwise_binary_op*, broadcast_reduce_op,
+matrix_op, indexing_op, init_op, ordering_op, dot) as JAX emissions — XLA
+supplies kernels, fusion and dtype dispatch that the reference hand-writes
+via mshadow expression templates and Kernel<OP,xpu>::Launch.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, register_simple
+
+
+# --- unary zoo (reference: elemwise_unary_op_basic/_trig/_pow .cc/.cu) ------
+_UNARY = {
+    "abs": jnp.abs, "sign": jnp.sign, "rint": jnp.rint, "round": jnp.round,
+    "ceil": jnp.ceil, "floor": jnp.floor, "trunc": jnp.trunc,
+    "fix": jnp.trunc, "square": jnp.square, "sqrt": jnp.sqrt,
+    "rsqrt": lambda x: lax.rsqrt(x), "cbrt": jnp.cbrt,
+    "rcbrt": lambda x: 1.0 / jnp.cbrt(x),
+    "exp": jnp.exp, "log": jnp.log, "log10": jnp.log10, "log2": jnp.log2,
+    "log1p": jnp.log1p, "expm1": jnp.expm1,
+    "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
+    "arcsin": jnp.arcsin, "arccos": jnp.arccos, "arctan": jnp.arctan,
+    "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
+    "arcsinh": jnp.arcsinh, "arccosh": jnp.arccosh, "arctanh": jnp.arctanh,
+    "sigmoid": jax.nn.sigmoid, "softsign": jax.nn.soft_sign,
+    "relu": jax.nn.relu, "erf": jax.scipy.special.erf,
+    "erfinv": jax.scipy.special.erfinv,
+    "gamma": lambda x: jnp.exp(jax.scipy.special.gammaln(x)),
+    "gammaln": jax.scipy.special.gammaln,
+    "negative": jnp.negative, "reciprocal": lambda x: 1.0 / x,
+    "degrees": jnp.degrees, "radians": jnp.radians,
+    "logical_not": lambda x: (x == 0).astype(x.dtype),
+    "identity": lambda x: x,
+}
+for _name, _fn in _UNARY.items():
+    register_simple(_name, _fn)
+
+register("_copy")(lambda attrs, x: x)
+register("stop_gradient", alias=("BlockGrad",))(lambda attrs, x: lax.stop_gradient(x))
+register("make_loss", alias=("MakeLoss",))(lambda attrs, x: x)
+
+
+@register("clip", scalar_args=("a_min", "a_max"))
+def _clip(attrs, x):
+    return jnp.clip(x, attrs["a_min"], attrs["a_max"])
+
+
+@register("cast", alias=("Cast",))
+def _cast(attrs, x):
+    from ..base import np_dtype
+    return x.astype(np_dtype(attrs["dtype"]))
+
+
+# --- binary (elementwise, same-shape) and broadcast variants ----------------
+_BINARY = {
+    "add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+    "div": jnp.divide, "mod": jnp.mod, "power": jnp.power,
+    "maximum": jnp.maximum, "minimum": jnp.minimum, "hypot": jnp.hypot,
+}
+_BINARY_LOGIC = {
+    "equal": jnp.equal, "not_equal": jnp.not_equal,
+    "greater": jnp.greater, "greater_equal": jnp.greater_equal,
+    "lesser": jnp.less, "lesser_equal": jnp.less_equal,
+    "logical_and": jnp.logical_and, "logical_or": jnp.logical_or,
+    "logical_xor": jnp.logical_xor,
+}
+
+
+def _as_out_dtype(fn):
+    def wrapped(a, b):
+        return fn(a, b).astype(a.dtype)
+    return wrapped
+
+
+for _name, _fn in _BINARY.items():
+    register_simple(f"elemwise_{_name}", _fn)
+    register_simple(f"broadcast_{_name}", _fn)
+for _name, _fn in _BINARY_LOGIC.items():
+    register_simple(f"broadcast_{_name}", _as_out_dtype(_fn))
+    register_simple(f"_{_name}", _as_out_dtype(_fn))
+
+register_simple("_grad_add", jnp.add)
+register_simple("dot_product", lambda a, b: jnp.vdot(a, b))
+
+
+def _scalar_op(name, fn, reverse_fn=None):
+    @register(f"_{name}_scalar")
+    def _f(attrs, x, _fn=fn):
+        return _fn(x, jnp.asarray(attrs["scalar"], dtype=x.dtype))
+    if reverse_fn is not None:
+        @register(f"_r{name}_scalar")
+        def _rf(attrs, x, _fn=reverse_fn):
+            return _fn(x, jnp.asarray(attrs["scalar"], dtype=x.dtype))
+
+
+_scalar_op("plus", jnp.add)
+_scalar_op("minus", jnp.subtract, lambda x, s: s - x)
+_scalar_op("mul", jnp.multiply)
+_scalar_op("div", jnp.divide, lambda x, s: s / x)
+_scalar_op("mod", jnp.mod, lambda x, s: jnp.mod(s, x))
+_scalar_op("power", jnp.power, lambda x, s: jnp.power(s, x))
+_scalar_op("maximum", jnp.maximum)
+_scalar_op("minimum", jnp.minimum)
+_scalar_op("hypot", jnp.hypot)
+for _name, _fn in _BINARY_LOGIC.items():
+    _scalar_op(_name, _as_out_dtype(_fn))
+
+
+# --- reductions (reference: broadcast_reduce_op.h) --------------------------
+def _norm_axis(attrs):
+    axis = attrs.get("axis", None)
+    if axis is None or axis == ():
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(axis)
+    return int(axis)
+
+
+def _reduce(fn):
+    def compute(attrs, x):
+        axis = _norm_axis(attrs)
+        keepdims = bool(attrs.get("keepdims", False))
+        out = fn(x, axis=axis, keepdims=keepdims)
+        if bool(attrs.get("exclude", False)):
+            raise NotImplementedError("exclude=True")
+        return out
+    return compute
+
+
+for _name, _fn in {
+    "sum": jnp.sum, "mean": jnp.mean, "prod": jnp.prod,
+    "nansum": jnp.nansum, "nanprod": jnp.nanprod,
+    "max": jnp.max, "min": jnp.min,
+}.items():
+    register(_name)(_reduce(_fn))
+
+
+@register("norm")
+def _norm(attrs, x):
+    ord_ = attrs.get("ord", 2)
+    axis = _norm_axis(attrs)
+    keepdims = bool(attrs.get("keepdims", False))
+    if ord_ == 1:
+        return jnp.sum(jnp.abs(x), axis=axis, keepdims=keepdims)
+    return jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdims))
+
+
+def _arg_reduce(fn):
+    def compute(attrs, x):
+        axis = attrs.get("axis", None)
+        out = fn(x, axis=None if axis is None else int(axis))
+        return out.astype(jnp.float32)  # MXNet returns float indices
+    return compute
+
+
+register("argmax")(_arg_reduce(jnp.argmax))
+register("argmin")(_arg_reduce(jnp.argmin))
+register("argmax_channel")(lambda attrs, x: jnp.argmax(x, axis=1).astype(jnp.float32))
+
+
+# --- dot / linalg front door (reference: dot-inl.h, la_op) ------------------
+@register("dot")
+def _dot(attrs, a, b):
+    if attrs.get("transpose_a", False):
+        a = a.T if a.ndim == 2 else jnp.moveaxis(a, 0, -1)
+    if attrs.get("transpose_b", False):
+        b = b.T if b.ndim == 2 else jnp.moveaxis(b, -1, 0)
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.vdot(a, b)
+    # MXNet dot contracts last axis of a with first axis of b
+    return jnp.tensordot(a, b, axes=([a.ndim - 1], [0]))
+
+
+@register("batch_dot")
+def _batch_dot(attrs, a, b):
+    if attrs.get("transpose_a", False):
+        a = jnp.swapaxes(a, -1, -2)
+    if attrs.get("transpose_b", False):
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b)
+
+
+# --- shape manipulation (reference: matrix_op.cc) ---------------------------
+@register("reshape", alias=("Reshape",))
+def _reshape(attrs, x):
+    shape = attrs.get("shape")
+    if bool(attrs.get("reverse", False)):
+        raise NotImplementedError("reshape(reverse=True)")
+    # MXNet special codes: 0 copy dim, -1 infer, -2 copy rest, -3 merge two,
+    # -4 split (consumes following dims)
+    out, src = [], list(x.shape)
+    i = 0
+    it = iter(range(len(shape)))
+    si = 0
+    shape = list(shape)
+    j = 0
+    while j < len(shape):
+        s = shape[j]
+        if s == 0:
+            out.append(src[si]); si += 1
+        elif s == -1:
+            out.append(-1); si += 1
+        elif s == -2:
+            out.extend(src[si:]); si = len(src)
+        elif s == -3:
+            out.append(src[si] * src[si + 1]); si += 2
+        elif s == -4:
+            d1, d2 = shape[j + 1], shape[j + 2]
+            if d1 == -1:
+                d1 = src[si] // d2
+            if d2 == -1:
+                d2 = src[si] // d1
+            out.extend([d1, d2]); si += 1; j += 2
+        else:
+            out.append(s)
+            if si < len(src):
+                si += 1
+        j += 1
+    return jnp.reshape(x, tuple(out))
+
+
+@register("flatten", alias=("Flatten",))
+def _flatten(attrs, x):
+    return jnp.reshape(x, (x.shape[0], -1))
+
+
+@register("transpose")
+def _transpose(attrs, x):
+    axes = attrs.get("axes", None)
+    if axes is None or axes == ():
+        axes = tuple(reversed(range(x.ndim)))
+    return jnp.transpose(x, axes)
+
+
+register("expand_dims", scalar_args=("axis",))(
+    lambda attrs, x: jnp.expand_dims(x, int(attrs["axis"])))
+
+
+@register("squeeze")
+def _squeeze(attrs, x):
+    axis = attrs.get("axis", None)
+    return jnp.squeeze(x, axis if axis is None else tuple(
+        [axis] if isinstance(axis, int) else axis))
+
+
+@register("swapaxes", alias=("SwapAxis",), scalar_args=("dim1", "dim2"))
+def _swapaxes(attrs, x):
+    return jnp.swapaxes(x, int(attrs.get("dim1", 0)), int(attrs.get("dim2", 0)))
+
+
+@register("concat", alias=("Concat",))
+def _concat(attrs, *xs):
+    return jnp.concatenate(xs, axis=int(attrs.get("dim", 1)))
+
+
+@register("stack")
+def _stack(attrs, *xs):
+    return jnp.stack(xs, axis=int(attrs.get("axis", 0)))
+
+
+@register("split", alias=("SliceChannel",), num_outputs="num_outputs")
+def _split(attrs, x):
+    axis = int(attrs.get("axis", 1))
+    num = int(attrs["num_outputs"])
+    squeeze = bool(attrs.get("squeeze_axis", False))
+    parts = jnp.split(x, num, axis=axis)
+    if squeeze:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
+@register("slice", alias=("crop",))
+def _slice(attrs, x):
+    begin, end = attrs["begin"], attrs["end"]
+    step = attrs.get("step", None) or (1,) * len(begin)
+    idx = tuple(slice(b, e, s) for b, e, s in
+                zip(begin, end, step))
+    return x[idx]
+
+
+@register("slice_axis", scalar_args=("axis", "begin", "end"))
+def _slice_axis(attrs, x):
+    axis = int(attrs["axis"])
+    begin = int(attrs["begin"])
+    end = attrs.get("end")  # absent/None means to-the-end (invoke strips None)
+    idx = [slice(None)] * x.ndim
+    idx[axis] = slice(begin, None if end is None else int(end))
+    return x[tuple(idx)]
+
+
+@register("slice_like")
+def _slice_like(attrs, x, like):
+    axes = attrs.get("axes", None) or tuple(range(x.ndim))
+    idx = [slice(None)] * x.ndim
+    for ax in axes:
+        idx[ax] = slice(0, like.shape[ax])
+    return x[tuple(idx)]
+
+
+@register("tile")
+def _tile(attrs, x):
+    return jnp.tile(x, attrs["reps"])
+
+
+@register("repeat", scalar_args=("repeats", "axis"))
+def _repeat(attrs, x):
+    return jnp.repeat(x, int(attrs["repeats"]), axis=attrs.get("axis", None))
+
+
+@register("flip", alias=("reverse",))
+def _flip(attrs, x):
+    axis = attrs["axis"]
+    return jnp.flip(x, axis if isinstance(axis, int) else tuple(axis))
+
+
+@register("pad", alias=("Pad",))
+def _pad(attrs, x):
+    pw = attrs["pad_width"]
+    pairs = [(int(pw[2 * i]), int(pw[2 * i + 1])) for i in range(len(pw) // 2)]
+    mode = attrs.get("mode", "constant")
+    if mode == "constant":
+        return jnp.pad(x, pairs, constant_values=attrs.get("constant_value", 0))
+    return jnp.pad(x, pairs, mode={"edge": "edge", "reflect": "reflect"}[mode])
+
+
+@register("depth_to_space")
+def _d2s(attrs, x):
+    b = int(attrs["block_size"])
+    n, c, h, w = x.shape
+    x = x.reshape(n, b, b, c // (b * b), h, w)
+    x = x.transpose(0, 3, 4, 1, 5, 2)
+    return x.reshape(n, c // (b * b), h * b, w * b)
+
+
+@register("space_to_depth")
+def _s2d(attrs, x):
+    b = int(attrs["block_size"])
+    n, c, h, w = x.shape
+    x = x.reshape(n, c, h // b, b, w // b, b)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return x.reshape(n, c * b * b, h // b, w // b)
+
+
+@register("broadcast_to")
+def _broadcast_to(attrs, x):
+    shape = tuple(s if s != 0 else x.shape[i] for i, s in enumerate(attrs["shape"]))
+    return jnp.broadcast_to(x, shape)
+
+
+register("broadcast_like")(lambda attrs, x, like: jnp.broadcast_to(x, like.shape))
+register("broadcast_axis", alias=("broadcast_axes",))(
+    lambda attrs, x: jnp.broadcast_to(x, tuple(
+        int(s) if i in ((attrs["axis"],) if isinstance(attrs["axis"], int)
+                        else tuple(attrs["axis"])) else x.shape[i]
+        for i, s in enumerate(
+            [dict(zip((attrs["axis"],) if isinstance(attrs["axis"], int)
+                      else tuple(attrs["axis"]),
+                      (attrs["size"],) if isinstance(attrs["size"], int)
+                      else tuple(attrs["size"]))).get(i, x.shape[i])
+             for i in range(x.ndim)]))))
+
+
+# --- indexing (reference: indexing_op.h) ------------------------------------
+@register("take")
+def _take(attrs, a, indices):
+    axis = int(attrs.get("axis", 0))
+    mode = attrs.get("mode", "clip")
+    idx = indices.astype(jnp.int32)
+    if mode == "clip":
+        idx = jnp.clip(idx, 0, a.shape[axis] - 1)
+    elif mode == "wrap":
+        idx = jnp.mod(idx, a.shape[axis])
+    return jnp.take(a, idx, axis=axis)
+
+
+@register("Embedding")
+def _embedding(attrs, data, weight):
+    idx = data.astype(jnp.int32)
+    out = jnp.take(weight, jnp.clip(idx, 0, weight.shape[0] - 1), axis=0)
+    return out
+
+
+@register("pick")
+def _pick(attrs, x, index):
+    axis = int(attrs.get("axis", -1))
+    idx = index.astype(jnp.int32)
+    idx = jnp.clip(idx, 0, x.shape[axis] - 1)
+    out = jnp.take_along_axis(x, jnp.expand_dims(idx, axis), axis=axis)
+    if not bool(attrs.get("keepdims", False)):
+        out = jnp.squeeze(out, axis=axis)
+    return out
+
+
+@register("gather_nd")
+def _gather_nd(attrs, data, indices):
+    idx = tuple(indices.astype(jnp.int32))
+    return data[idx]
+
+
+@register("scatter_nd")
+def _scatter_nd(attrs, data, indices):
+    shape = tuple(attrs["shape"])
+    out = jnp.zeros(shape, dtype=data.dtype)
+    idx = tuple(indices.astype(jnp.int32))
+    return out.at[idx].set(data)
+
+
+@register("one_hot", scalar_args=("depth",))
+def _one_hot(attrs, indices):
+    depth = int(attrs["depth"])
+    on = attrs.get("on_value", 1.0)
+    off = attrs.get("off_value", 0.0)
+    from ..base import np_dtype
+    dtype = np_dtype(attrs.get("dtype", "float32"))
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), depth)
+    return (oh * (on - off) + off).astype(dtype)
+
+
+@register("where")
+def _where(attrs, cond, x, y):
+    return jnp.where(cond.astype(bool), x, y)
+
+
+@register("boolean_mask_fill")
+def _boolean_mask_fill(attrs, data, mask):
+    """Static-shape-friendly boolean_mask: keeps shape, fills masked-out
+    entries with `value` (TPU redesign of contrib.boolean_mask whose output
+    shape is data-dependent; see SURVEY.md §7 hard part 8)."""
+    value = attrs.get("value", 0.0)
+    m = mask.astype(bool)
+    m = m.reshape(m.shape + (1,) * (data.ndim - m.ndim))
+    return jnp.where(m, data, jnp.asarray(value, dtype=data.dtype))
+
+
+# --- ordering (reference: ordering_op.cc) -----------------------------------
+@register("sort")
+def _sort(attrs, x):
+    axis = attrs.get("axis", -1)
+    out = jnp.sort(x, axis=None if axis is None else int(axis))
+    if bool(attrs.get("is_ascend", True)):
+        return out
+    return jnp.flip(out, axis=-1 if axis is None else int(axis))
+
+
+@register("argsort")
+def _argsort(attrs, x):
+    axis = attrs.get("axis", -1)
+    idx = jnp.argsort(x, axis=None if axis is None else int(axis))
+    if not bool(attrs.get("is_ascend", True)):
+        idx = jnp.flip(idx, axis=-1 if axis is None else int(axis))
+    from ..base import np_dtype
+    return idx.astype(np_dtype(attrs.get("dtype", "float32")))
+
+
+@register("topk", num_outputs="_dynamic")
+def _topk(attrs, x):
+    axis = int(attrs.get("axis", -1))
+    k = int(attrs.get("k", 1))
+    ret_typ = attrs.get("ret_typ", "indices")
+    largest = bool(attrs.get("is_ascend", False)) is False
+    xm = x if largest else -x
+    xm = jnp.moveaxis(xm, axis, -1)
+    vals, idxs = lax.top_k(xm, k)
+    if not largest:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, axis)
+    idxs = jnp.moveaxis(idxs, -1, axis)
+    from ..base import np_dtype
+    idxs = idxs.astype(np_dtype(attrs.get("dtype", "float32")))
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "indices":
+        return idxs
+    if ret_typ == "both":
+        return vals, idxs
+    if ret_typ == "mask":
+        oh = jax.nn.one_hot(jnp.moveaxis(idxs.astype(jnp.int32), axis, -1),
+                            x.shape[axis]).sum(-2)
+        return jnp.moveaxis(oh, -1, axis).astype(x.dtype)
+    raise ValueError(ret_typ)
+
+
+# --- init ops (reference: init_op.cc) ---------------------------------------
+def _init_attrs(attrs):
+    from ..base import np_dtype
+    return tuple(attrs["shape"]), np_dtype(attrs.get("dtype", "float32"))
+
+
+@register("_zeros")
+def _zeros(attrs):
+    shape, dtype = _init_attrs(attrs)
+    return jnp.zeros(shape, dtype)
+
+
+@register("_ones")
+def _ones(attrs):
+    shape, dtype = _init_attrs(attrs)
+    return jnp.ones(shape, dtype)
+
+
+@register("_full")
+def _full(attrs):
+    shape, dtype = _init_attrs(attrs)
+    return jnp.full(shape, attrs["value"], dtype)
+
+
+@register("_eye")
+def _eye(attrs):
+    from ..base import np_dtype
+    return jnp.eye(int(attrs["N"]), int(attrs.get("M", 0)) or None,
+                   k=int(attrs.get("k", 0)),
+                   dtype=np_dtype(attrs.get("dtype", "float32")))
+
+
+@register("_arange")
+def _arange(attrs):
+    from ..base import np_dtype
+    out = jnp.arange(attrs.get("start", 0), attrs.get("stop", None),
+                     attrs.get("step", 1.0),
+                     dtype=np_dtype(attrs.get("dtype", "float32")))
+    repeat = int(attrs.get("repeat", 1))
+    if repeat > 1:
+        out = jnp.repeat(out, repeat)
+    return out
+
+
+@register("_linspace")
+def _linspace(attrs):
+    from ..base import np_dtype
+    return jnp.linspace(attrs["start"], attrs["stop"], int(attrs["num"]),
+                        endpoint=bool(attrs.get("endpoint", True)),
+                        dtype=np_dtype(attrs.get("dtype", "float32")))
+
+
+register("zeros_like")(lambda attrs, x: jnp.zeros_like(x))
+register("ones_like")(lambda attrs, x: jnp.ones_like(x))
+register("shape_array")(lambda attrs, x: jnp.asarray(x.shape, dtype=jnp.int64))
+register("size_array")(lambda attrs, x: jnp.asarray([x.size], dtype=jnp.int64))
+
+
+@register("diag")
+def _diag(attrs, x):
+    k = int(attrs.get("k", 0))
+    if x.ndim == 1:
+        return jnp.diag(x, k)
+    return jnp.diagonal(x, offset=k, axis1=int(attrs.get("axis1", 0)),
+                        axis2=int(attrs.get("axis2", 1)))
+
+
+@register("smooth_l1")
+def _smooth_l1(attrs, x):
+    sigma = float(attrs.get("scalar", 1.0))
+    s2 = sigma * sigma
+    return jnp.where(jnp.abs(x) < 1.0 / s2,
+                     0.5 * s2 * jnp.square(x),
+                     jnp.abs(x) - 0.5 / s2)
+
+
+@register("reshape_like")
+def _reshape_like(attrs, x, like):
+    return jnp.reshape(x, like.shape)
+
+
+@register("histogram", num_outputs=2)
+def _histogram(attrs, x, bins):
+    cnt, edges = jnp.histogram(x, bins=bins)
+    return cnt.astype(jnp.int64), edges

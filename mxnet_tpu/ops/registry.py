@@ -1,0 +1,139 @@
+"""Operator registry.
+
+TPU-native re-design of the NNVM op registry (reference:
+include/mxnet/op_attr_types.h:124-294, src/operator/* NNVM_REGISTER_OP). In the
+reference each op carries FInferShape/FInferType/FCompute<cpu|gpu>/FGradient
+attributes; kernels are hand-written CUDA/mshadow. Here an op's ``fcompute`` is
+a JAX emission (jax.numpy / lax / pallas):
+
+- shape+dtype inference = ``jax.eval_shape`` over fcompute (always consistent
+  with the kernel, unlike hand-written FInferShape);
+- gradient = ``jax.vjp`` over fcompute (an op can override with a custom
+  fgradient for numerically-better or cheaper rules);
+- CPU/GPU/TPU dispatch = XLA backends — one registration covers all devices
+  (the reference needs .cc + .cu per op);
+- per-op kernel fusion/scheduling = XLA; the imperative path jit-caches each
+  (op, attrs) pair so steady-state dispatch is a cache hit.
+
+Op attrs are plain keyword arguments, normalised to a hashable canonical tuple
+(the role dmlc::Parameter plays in the reference).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import numpy as np
+
+from ..base import MXNetError
+
+_OPS = {}
+
+
+def _canon_attr(v):
+    """Make an attr value hashable + jit-stable."""
+    if isinstance(v, (list, tuple)):
+        return tuple(_canon_attr(x) for x in v)
+    if isinstance(v, np.ndarray):
+        return tuple(v.ravel().tolist()) + ("__shape__",) + v.shape
+    if isinstance(v, np.dtype):
+        return v.name
+    if isinstance(v, type) and issubclass(v, np.generic):
+        return np.dtype(v).name
+    return v
+
+
+class Operator:
+    """A registered operator.
+
+    fcompute(attrs: dict, *inputs: jax.Array) -> jax.Array | tuple[jax.Array]
+    """
+
+    def __init__(self, name, fcompute, num_outputs=1, is_random=False,
+                 mutate_aux=(), fgradient=None, alias=(), scalar_args=("scalar",)):
+        self.name = name
+        self.fcompute = fcompute
+        self.num_outputs = num_outputs
+        self.is_random = is_random
+        self.mutate_aux = mutate_aux  # indices of inputs that receive updated state
+        self.fgradient = fgradient
+        self.alias = alias
+        # names assigned, in order, to positional non-array args in the
+        # generated imperative wrapper (e.g. nd.clip(x, 0, 1))
+        self.scalar_args = scalar_args
+        self._jit_cache = {}
+
+    # -- compiled execution ------------------------------------------------
+    def jitted(self, attrs_key, attrs):
+        fn = self._jit_cache.get(attrs_key)
+        if fn is None:
+            fcompute = self.fcompute
+
+            def call(*arrays):
+                out = fcompute(dict(attrs), *arrays)
+                return out
+
+            fn = jax.jit(call)
+            self._jit_cache[attrs_key] = fn
+        return fn
+
+    def bind(self, **attrs):
+        """Return (jitted_fn, attrs_key) for the given attrs."""
+        key = tuple(sorted((k, _canon_attr(v)) for k, v in attrs.items()))
+        return self.jitted(key, attrs), key
+
+    def raw(self, attrs):
+        """Unjitted closure — used under jax.vjp (jax 0.9 cannot linearize
+        some primitives, e.g. reduce_window, through an inner jit)."""
+        fcompute = self.fcompute
+
+        def call(*arrays):
+            return fcompute(dict(attrs), *arrays)
+
+        return call
+
+    def infer(self, attrs, *avals):
+        """Shape/dtype inference via abstract evaluation."""
+        fn, _ = self.bind(**attrs)
+        return jax.eval_shape(fn, *avals)
+
+    def __repr__(self):
+        return f"Operator({self.name})"
+
+
+def register(name, num_outputs=1, is_random=False, mutate_aux=(),
+             fgradient=None, alias=(), scalar_args=("scalar",)):
+    """Decorator: register fcompute under ``name`` (+ aliases)."""
+
+    def deco(fcompute):
+        op = Operator(name, fcompute, num_outputs=num_outputs,
+                      is_random=is_random, mutate_aux=mutate_aux,
+                      fgradient=fgradient, alias=alias, scalar_args=scalar_args)
+        if name in _OPS:
+            raise MXNetError(f"op {name} already registered")
+        _OPS[name] = op
+        for a in alias:
+            _OPS[a] = op
+        return fcompute
+
+    return deco
+
+
+def register_simple(name, fn, **kw):
+    """Register an op whose fcompute ignores attrs: fn(*inputs)."""
+    register(name, **kw)(lambda attrs, *ins: fn(*ins))
+
+
+def get(name):
+    op = _OPS.get(name)
+    if op is None:
+        raise MXNetError(f"operator {name} is not registered")
+    return op
+
+
+def exists(name):
+    return name in _OPS
+
+
+def list_ops():
+    return sorted(_OPS)

@@ -1,0 +1,39 @@
+"""Global random state.
+
+Reference keeps per-context mshadow PRNGs seeded via MXRandomSeed
+(src/resource.cc kRandom). TPU redesign: a single counter-based root key;
+every random op folds in a fresh counter, so seeding is reproducible and
+device-count independent.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+_state = threading.local()
+
+
+def _get():
+    if not hasattr(_state, "key"):
+        _state.key = jax.random.PRNGKey(0)
+        _state.counter = 0
+    return _state
+
+
+def seed(seed_state, ctx=None):
+    """mx.random.seed(n) parity (ctx arg accepted and ignored: keys are
+    device-independent)."""
+    s = _get()
+    s.key = jax.random.PRNGKey(int(seed_state))
+    s.counter = 0
+
+
+def next_key():
+    s = _get()
+    s.counter += 1
+    return jax.random.fold_in(s.key, s.counter)
+
+
+def current_key():
+    return _get().key

@@ -1,0 +1,101 @@
+"""Testing utilities.
+
+Parity with reference python/mxnet/test_utils.py: numpy-as-oracle forward
+checks, central numeric-gradient checker for backward, tolerance helper, and
+a check_consistency-style cross-dtype harness (SURVEY.md §4 key takeaway).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import autograd
+from . import ndarray as nd
+from .context import cpu, current_context
+
+
+def default_context():
+    return current_context()
+
+
+def assert_almost_equal(a, b, rtol=1e-5, atol=1e-7, names=("a", "b")):
+    a = a.asnumpy() if isinstance(a, nd.NDArray) else np.asarray(a)
+    b = b.asnumpy() if isinstance(b, nd.NDArray) else np.asarray(b)
+    np.testing.assert_allclose(a, b, rtol=rtol, atol=atol,
+                               err_msg=f"{names[0]} vs {names[1]}")
+
+
+def same(a, b):
+    return np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def rand_ndarray(shape, stype="default", density=None, dtype=None, ctx=None):
+    dtype = dtype or np.float32
+    dense = np.random.uniform(-1, 1, size=shape).astype(dtype)
+    if stype == "default":
+        return nd.array(dense, ctx=ctx)
+    if density is not None:
+        mask = np.random.uniform(0, 1, size=shape) < density
+        dense = dense * mask
+    from .ndarray import sparse
+    return sparse.array(dense, stype=stype, ctx=ctx, dtype=dtype)
+
+
+def numeric_grad(f, inputs, eps=1e-4):
+    """Central-difference numeric gradient of scalar-valued f(list[np]) -> float."""
+    grads = []
+    for i, x in enumerate(inputs):
+        g = np.zeros_like(x, dtype=np.float64)
+        flat = x.reshape(-1)
+        gf = g.reshape(-1)
+        for j in range(flat.size):
+            orig = flat[j]
+            flat[j] = orig + eps
+            fp = f(inputs)
+            flat[j] = orig - eps
+            fm = f(inputs)
+            flat[j] = orig
+            gf[j] = (fp - fm) / (2 * eps)
+        grads.append(g)
+    return grads
+
+
+def check_numeric_gradient(op_fn, input_arrays, rtol=1e-2, atol=1e-3, eps=1e-3):
+    """Compare autograd backward of sum(op_fn(*inputs)) against numeric grads.
+
+    Parity: check_numeric_gradient (reference test_utils.py:860), but the
+    oracle loop runs the same jitted op on float64-upcast host values.
+    """
+    np_inputs = [np.asarray(a, dtype=np.float64) for a in input_arrays]
+
+    def scalar_f(nps):
+        args = [nd.array(x.astype(np.float32)) for x in nps]
+        out = op_fn(*args)
+        return float(out.sum().asscalar())
+
+    expected = numeric_grad(scalar_f, [x.copy() for x in np_inputs], eps=eps)
+
+    args = [nd.array(x.astype(np.float32)) for x in np_inputs]
+    for a in args:
+        a.attach_grad()
+    with autograd.record():
+        out = op_fn(*args)
+        s = out.sum()
+    s.backward()
+    for a, e in zip(args, expected):
+        assert_almost_equal(a.grad, e.astype(np.float32), rtol=rtol, atol=atol)
+
+
+def check_consistency(op_fn, input_shapes, dtypes=(np.float32, np.float16),
+                      rtol=None, atol=None):
+    """Run the same op across dtypes and cross-check (parity:
+    check_consistency test_utils.py:1283, which ran cpu/gpu × fp16/32/64)."""
+    base_inputs = [np.random.uniform(-1, 1, size=s) for s in input_shapes]
+    outs = []
+    for dt in dtypes:
+        args = [nd.array(x.astype(dt)) for x in base_inputs]
+        outs.append(op_fn(*args).asnumpy().astype(np.float64))
+    ref = outs[0]
+    tol = {np.dtype(np.float16): 1e-2, np.dtype(np.float32): 1e-5}
+    for o, dt in zip(outs[1:], dtypes[1:]):
+        t = tol.get(np.dtype(dt), 1e-2)
+        np.testing.assert_allclose(ref, o, rtol=rtol or t, atol=atol or t)

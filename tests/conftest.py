@@ -1,0 +1,25 @@
+"""Test harness configuration.
+
+Forces an 8-device virtual CPU mesh (parity with the reference's strategy of
+running the whole unit suite per backend, SURVEY.md §4): sharding/collective
+tests exercise real multi-device code paths without TPU hardware.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seeded():
+    """Reproducible-but-varied RNG per test (parity: with_seed() decorator in
+    reference tests/python/unittest/common.py)."""
+    import mxnet_tpu as mx
+    np.random.seed(0)
+    mx.random.seed(0)
+    yield

@@ -1,0 +1,297 @@
+"""Operator forward-vs-numpy and backward-vs-numeric-gradient checks
+(parity target: reference tests/python/unittest/test_operator.py strategy)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
+
+
+def test_unary_forward():
+    x = np.random.uniform(0.1, 2.0, size=(3, 4)).astype(np.float32)
+    a = nd.array(x)
+    for name, ref in [("sqrt", np.sqrt), ("exp", np.exp), ("log", np.log),
+                      ("square", np.square), ("abs", np.abs),
+                      ("tanh", np.tanh), ("sin", np.sin), ("floor", np.floor)]:
+        out = getattr(nd, name)(a)
+        assert_almost_equal(out, ref(x), rtol=1e-5, atol=1e-6)
+    sg = nd.sigmoid(a)
+    assert_almost_equal(sg, 1 / (1 + np.exp(-x)), rtol=1e-5, atol=1e-6)
+    r = nd.relu(nd.array(x - 1))
+    assert_almost_equal(r, np.maximum(x - 1, 0))
+
+
+def test_binary_broadcast():
+    a = np.random.randn(2, 3, 1).astype(np.float32)
+    b = np.random.randn(1, 3, 4).astype(np.float32)
+    assert_almost_equal(nd.broadcast_add(nd.array(a), nd.array(b)), a + b)
+    assert_almost_equal(nd.broadcast_mul(nd.array(a), nd.array(b)), a * b)
+    assert_almost_equal(nd.broadcast_maximum(nd.array(a), nd.array(b)),
+                        np.maximum(a, b))
+
+
+def test_dot():
+    a = np.random.randn(3, 4).astype(np.float32)
+    b = np.random.randn(4, 5).astype(np.float32)
+    assert_almost_equal(nd.dot(nd.array(a), nd.array(b)), a @ b, rtol=1e-5)
+    assert_almost_equal(nd.dot(nd.array(a), nd.array(b.T), transpose_b=True),
+                        a @ b, rtol=1e-5)
+    assert_almost_equal(nd.dot(nd.array(a.T), nd.array(b), transpose_a=True),
+                        a @ b, rtol=1e-5)
+    x = np.random.randn(2, 3, 4).astype(np.float32)
+    y = np.random.randn(2, 4, 5).astype(np.float32)
+    assert_almost_equal(nd.batch_dot(nd.array(x), nd.array(y)), x @ y, rtol=1e-5)
+
+
+def test_fully_connected():
+    x = np.random.randn(4, 10).astype(np.float32)
+    w = np.random.randn(3, 10).astype(np.float32)
+    b = np.random.randn(3).astype(np.float32)
+    out = nd.FullyConnected(nd.array(x), nd.array(w), nd.array(b), num_hidden=3)
+    assert_almost_equal(out, x @ w.T + b, rtol=1e-5)
+    out2 = nd.FullyConnected(nd.array(x), nd.array(w), num_hidden=3, no_bias=True)
+    assert_almost_equal(out2, x @ w.T, rtol=1e-5)
+
+
+def _np_conv2d(x, w, stride, pad):
+    from numpy.lib.stride_tricks import sliding_window_view
+    xp = np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    windows = sliding_window_view(xp, w.shape[2:], axis=(2, 3))
+    windows = windows[:, :, ::stride, ::stride]
+    return np.einsum("nchwkl,ockl->nohw", windows, w)
+
+
+@pytest.mark.parametrize("stride,pad", [(1, 0), (1, 1), (2, 1)])
+def test_convolution(stride, pad):
+    x = np.random.randn(2, 3, 7, 7).astype(np.float32)
+    w = np.random.randn(5, 3, 3, 3).astype(np.float32)
+    out = nd.Convolution(nd.array(x), nd.array(w), kernel=(3, 3), num_filter=5,
+                         stride=(stride, stride), pad=(pad, pad), no_bias=True)
+    ref = _np_conv2d(x, w, stride, pad)
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_convolution_grouped_1d_3d():
+    x1 = np.random.randn(2, 4, 9).astype(np.float32)
+    w1 = np.random.randn(6, 2, 3).astype(np.float32)
+    out = nd.Convolution(nd.array(x1), nd.array(w1), kernel=(3,), num_filter=6,
+                         num_group=2, no_bias=True)
+    assert out.shape == (2, 6, 7)
+    x3 = np.random.randn(1, 2, 5, 5, 5).astype(np.float32)
+    w3 = np.random.randn(3, 2, 2, 2, 2).astype(np.float32)
+    out3 = nd.Convolution(nd.array(x3), nd.array(w3), kernel=(2, 2, 2),
+                          num_filter=3, no_bias=True)
+    assert out3.shape == (1, 3, 4, 4, 4)
+
+
+def test_deconvolution():
+    x = np.random.randn(2, 3, 5, 5).astype(np.float32)
+    w = np.random.randn(3, 4, 3, 3).astype(np.float32)
+    out = nd.Deconvolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                           num_filter=4, no_bias=True)
+    assert out.shape == (2, 4, 7, 7)
+    # adjoint identity: deconv is conv's transpose, so
+    # <deconv_w(x), y> == <x, conv_w(y)> with the SAME weight
+    y = np.random.randn(*out.shape).astype(np.float32)
+    conv_y = nd.Convolution(nd.array(y), nd.array(w),
+                            kernel=(3, 3), num_filter=3, no_bias=True)
+    lhs = float((out * nd.array(y)).sum().asscalar())
+    rhs = float((nd.array(x) * conv_y).sum().asscalar())
+    assert np.isclose(lhs, rhs, rtol=1e-3)
+
+
+def test_pooling():
+    x = np.random.randn(2, 3, 6, 6).astype(np.float32)
+    mp = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2), pool_type="max")
+    ref = x.reshape(2, 3, 3, 2, 3, 2).max(axis=(3, 5))
+    assert_almost_equal(mp, ref)
+    ap = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2), pool_type="avg")
+    refa = x.reshape(2, 3, 3, 2, 3, 2).mean(axis=(3, 5))
+    assert_almost_equal(ap, refa, rtol=1e-5)
+    gp = nd.Pooling(nd.array(x), pool_type="max", global_pool=True)
+    assert gp.shape == (2, 3, 1, 1)
+
+
+def test_batchnorm_train_and_eval():
+    x = np.random.randn(8, 4, 5, 5).astype(np.float32)
+    gamma, beta = np.ones(4, np.float32), np.zeros(4, np.float32)
+    mm, mv = np.zeros(4, np.float32), np.ones(4, np.float32)
+    a_mm, a_mv = nd.array(mm), nd.array(mv)
+    with mx.autograd.train_mode():
+        out = nd.BatchNorm(nd.array(x), nd.array(gamma), nd.array(beta),
+                           a_mm, a_mv, fix_gamma=False, momentum=0.9, eps=1e-5)
+    mean = x.mean(axis=(0, 2, 3))
+    var = x.var(axis=(0, 2, 3))
+    ref = (x - mean[None, :, None, None]) / np.sqrt(var + 1e-5)[None, :, None, None]
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-4)
+    # moving stats were updated in place (aux mutation contract)
+    assert_almost_equal(a_mm, 0.1 * mean, rtol=1e-4, atol=1e-5)
+    # eval mode uses moving stats
+    out_eval = nd.BatchNorm(nd.array(x), nd.array(gamma), nd.array(beta),
+                            a_mm, a_mv, fix_gamma=False, eps=1e-5)
+    refe = (x - a_mm.asnumpy()[None, :, None, None]) / \
+        np.sqrt(a_mv.asnumpy() + 1e-5)[None, :, None, None]
+    assert_almost_equal(out_eval, refe, rtol=1e-4, atol=1e-4)
+
+
+def test_layernorm():
+    x = np.random.randn(4, 10).astype(np.float32)
+    g = np.random.randn(10).astype(np.float32)
+    b = np.random.randn(10).astype(np.float32)
+    out = nd.LayerNorm(nd.array(x), nd.array(g), nd.array(b))
+    mu = x.mean(-1, keepdims=True)
+    sd = np.sqrt(x.var(-1, keepdims=True) + 1e-5)
+    assert_almost_equal(out, (x - mu) / sd * g + b, rtol=1e-4, atol=1e-4)
+
+
+def test_softmax_family():
+    x = np.random.randn(3, 5).astype(np.float32)
+    sm = nd.softmax(nd.array(x))
+    e = np.exp(x - x.max(-1, keepdims=True))
+    assert_almost_equal(sm, e / e.sum(-1, keepdims=True), rtol=1e-5, atol=1e-6)
+    ls = nd.log_softmax(nd.array(x))
+    assert_almost_equal(ls, np.log(e / e.sum(-1, keepdims=True)), rtol=1e-4, atol=1e-5)
+
+
+def test_softmax_output_gradient():
+    x = np.random.randn(4, 3).astype(np.float32)
+    label = np.array([0, 2, 1, 1], dtype=np.float32)
+    a = nd.array(x)
+    a.attach_grad()
+    with mx.autograd.record():
+        out = nd.SoftmaxOutput(a, nd.array(label))
+    out.backward()
+    e = np.exp(x - x.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    expected = p - np.eye(3)[label.astype(int)]
+    assert_almost_equal(a.grad, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_activation_variants():
+    x = np.random.randn(3, 4).astype(np.float32)
+    a = nd.array(x)
+    assert_almost_equal(nd.Activation(a, act_type="relu"), np.maximum(x, 0))
+    assert_almost_equal(nd.LeakyReLU(a, act_type="leaky", slope=0.1),
+                        np.where(x > 0, x, 0.1 * x), rtol=1e-6, atol=1e-6)
+    elu = nd.LeakyReLU(a, act_type="elu", slope=1.0)
+    assert_almost_equal(elu, np.where(x > 0, x, np.expm1(x)), rtol=1e-5, atol=1e-6)
+
+
+def test_embedding():
+    w = np.random.randn(10, 4).astype(np.float32)
+    idx = np.array([[1, 2], [3, 9]], dtype=np.float32)
+    out = nd.Embedding(nd.array(idx), nd.array(w), input_dim=10, output_dim=4)
+    assert_almost_equal(out, w[idx.astype(int)])
+
+
+def test_dropout_modes():
+    x = nd.ones((100, 100))
+    with mx.autograd.predict_mode():
+        out = nd.Dropout(x, p=0.5)
+    assert np.allclose(out.asnumpy(), 1.0)
+    with mx.autograd.train_mode():
+        out_t = nd.Dropout(x, p=0.5)
+    kept = (out_t.asnumpy() != 0).mean()
+    assert 0.4 < kept < 0.6
+    assert np.allclose(out_t.asnumpy()[out_t.asnumpy() != 0], 2.0)
+
+
+def test_numeric_gradient_core_ops():
+    check_numeric_gradient(lambda a, b: nd.dot(a, b),
+                           [np.random.randn(3, 4), np.random.randn(4, 2)])
+    check_numeric_gradient(lambda a: nd.sigmoid(a), [np.random.randn(3, 3)])
+    check_numeric_gradient(
+        lambda x, w: nd.Convolution(x, w, kernel=(3, 3), num_filter=2,
+                                    pad=(1, 1), no_bias=True),
+        [np.random.randn(1, 2, 5, 5), np.random.randn(2, 2, 3, 3)])
+    check_numeric_gradient(lambda a: nd.Pooling(a, kernel=(2, 2), stride=(2, 2),
+                                                pool_type="avg"),
+                           [np.random.randn(1, 1, 4, 4)])
+
+
+def test_sequence_ops():
+    x = np.random.randn(4, 2, 3).astype(np.float32)
+    slen = nd.array([2, 4], dtype=np.float32)
+    masked = nd.SequenceMask(nd.array(x), slen, use_sequence_length=True, value=-1)
+    m = masked.asnumpy()
+    assert np.allclose(m[2:, 0], -1)
+    assert np.allclose(m[:, 1], x[:, 1])
+    last = nd.SequenceLast(nd.array(x), slen, use_sequence_length=True)
+    assert np.allclose(last.asnumpy()[0], x[1, 0])
+    assert np.allclose(last.asnumpy()[1], x[3, 1])
+    rev = nd.SequenceReverse(nd.array(x), slen, use_sequence_length=True)
+    assert np.allclose(rev.asnumpy()[0, 0], x[1, 0])
+    assert np.allclose(rev.asnumpy()[0, 1], x[3, 1])
+
+
+def test_rnn_fused_lstm():
+    from mxnet_tpu.ops._op_nn import rnn_param_size
+    T, N, I, H, L = 5, 2, 3, 4, 2
+    psize = rnn_param_size("lstm", L, I, H, False)
+    params = nd.array(np.random.uniform(-0.1, 0.1, psize).astype(np.float32))
+    x = nd.array(np.random.randn(T, N, I).astype(np.float32))
+    h0 = nd.zeros((L, N, H))
+    c0 = nd.zeros((L, N, H))
+    out, hN, cN = nd.RNN(x, params, h0, c0, mode="lstm", state_size=H,
+                         num_layers=L, state_outputs=True)
+    assert out.shape == (T, N, H)
+    assert hN.shape == (L, N, H) and cN.shape == (L, N, H)
+    # bidirectional
+    psize_b = rnn_param_size("gru", 1, I, H, True)
+    params_b = nd.array(np.random.uniform(-0.1, 0.1, psize_b).astype(np.float32))
+    h0b = nd.zeros((2, N, H))
+    out_b = nd.RNN(x, params_b, h0b, mode="gru", state_size=H, num_layers=1,
+                   bidirectional=True)
+    assert out_b.shape == (T, N, 2 * H)
+
+
+def test_optimizer_ops_inplace():
+    w = nd.array(np.ones((3,), np.float32))
+    g = nd.array(np.full((3,), 0.5, np.float32))
+    out = nd.sgd_update(w, g, lr=0.1, wd=0.0, out=w)
+    assert np.allclose(w.asnumpy(), 0.95)
+    mom = nd.zeros((3,))
+    nd.sgd_mom_update(w, g, mom, lr=0.1, momentum=0.9, out=w)
+    assert np.allclose(mom.asnumpy(), -0.05)
+    assert np.allclose(w.asnumpy(), 0.90)
+    mean, var = nd.zeros((3,)), nd.zeros((3,))
+    w2 = nd.array(np.ones((3,), np.float32))
+    nd.adam_update(w2, g, mean, var, lr=0.1, out=w2)
+    assert not np.allclose(w2.asnumpy(), 1.0)
+    assert np.allclose(mean.asnumpy(), 0.05)
+
+
+def test_where_clip_gather():
+    c = nd.array([1, 0, 1], dtype=np.int32)
+    x, y = nd.array([1.0, 2, 3]), nd.array([10.0, 20, 30])
+    assert np.allclose(nd.where(c, x, y).asnumpy(), [1, 20, 3])
+    assert np.allclose(nd.clip(nd.array([-2.0, 0.5, 9]), 0, 1).asnumpy(),
+                       [0, 0.5, 1])
+    data = nd.array(np.arange(9).reshape(3, 3).astype(np.float32))
+    ind = nd.array(np.array([[0, 2], [1, 1]]), dtype=np.int32)
+    out = nd.gather_nd(data, ind)
+    assert np.allclose(out.asnumpy(), [1, 7])
+
+
+def test_ctc_loss_simple():
+    # single example, uniform logits: loss should be positive finite
+    T, N, C, L = 6, 2, 5, 2
+    data = nd.array(np.random.randn(T, N, C).astype(np.float32))
+    label = nd.array(np.array([[1, 2], [3, 4]], np.float32))
+    loss = nd.CTCLoss(data, label)
+    l = loss.asnumpy()
+    assert l.shape == (N,) and np.all(np.isfinite(l)) and np.all(l > 0)
+
+
+def test_random_ops_determinism():
+    mx.random.seed(42)
+    a = nd.random.normal(shape=(4, 4)).asnumpy()
+    mx.random.seed(42)
+    b = nd.random.normal(shape=(4, 4)).asnumpy()
+    assert np.allclose(a, b)
+    c = nd.random.uniform(low=2, high=3, shape=(1000,)).asnumpy()
+    assert c.min() >= 2 and c.max() < 3 and 2.4 < c.mean() < 2.6
+    r = nd.random.randint(0, 10, shape=(100,))
+    assert r.dtype == np.int32
+    assert r.asnumpy().min() >= 0 and r.asnumpy().max() < 10
