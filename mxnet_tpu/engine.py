@@ -57,7 +57,8 @@ class Engine:
                 self._outstanding[id(a)] = weakref.ref(a)
         if self.naive:
             for a in ndarrays:
-                a._data.block_until_ready()
+                if not isinstance(a._data, jax.core.Tracer):
+                    a._data.block_until_ready()
 
     def throw(self, exc):
         with self._lock:

@@ -1,0 +1,2 @@
+"""mx.executor — Executor re-export (parity: python/mxnet/executor.py)."""
+from .symbol.executor import Executor  # noqa: F401

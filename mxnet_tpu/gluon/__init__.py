@@ -1,0 +1,20 @@
+"""Gluon: the imperative/hybrid neural-network API
+(parity: python/mxnet/gluon/)."""
+from . import block
+from .block import Block, HybridBlock, SymbolBlock
+from .parameter import Constant, Parameter, ParameterDict, \
+    DeferredInitializationError
+from . import nn
+from . import loss
+from . import utils
+from .trainer import Trainer
+from .utils import split_and_load, split_data, clip_global_norm
+
+
+def __getattr__(name):
+    import importlib
+    if name in ("rnn", "data", "model_zoo", "contrib"):
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'mxnet_tpu.gluon' has no attribute {name!r}")

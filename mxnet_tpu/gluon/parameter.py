@@ -1,0 +1,574 @@
+"""gluon.Parameter / ParameterDict / Constant.
+
+Re-design of reference python/mxnet/gluon/parameter.py (parameter.py:44
+Parameter, :681 ParameterDict). Semantics preserved: deferred init on unknown
+shapes, per-context replicas, grad_req, lr/wd multipliers, save/load. TPU
+difference: a parameter replicated across a device mesh is ONE sharded
+jax.Array under pjit rather than N copies — the per-ctx replica list here
+serves the explicit multi-device imperative path (split_and_load style DP),
+while `mxnet_tpu.parallel` shards parameters with NamedSharding for SPMD.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import autograd, initializer as init_mod, ndarray as nd
+from ..base import MXNetError, np_dtype
+from ..context import Context, cpu, current_context
+from ..ndarray import NDArray
+
+
+class DeferredInitializationError(MXNetError):
+    """Error for unfinished deferred initialization."""
+
+
+class Parameter:
+    """A Container holding parameters (weights) of Blocks
+    (parity: gluon/parameter.py:44)."""
+
+    def __init__(self, name, grad_req="write", shape=None, dtype=np.float32,
+                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
+                 differentiable=True, stype="default", grad_stype="default"):
+        self._var = None
+        self._data = None   # list of per-ctx NDArrays
+        self._grad = None
+        self._ctx_list = None
+        self._ctx_map = None
+        self._trainer = None
+        self._deferred_init = ()
+        self._differentiable = differentiable
+        self._allow_deferred_init = allow_deferred_init
+        self._grad_req = None
+        if isinstance(shape, int):
+            shape = (shape,)
+        self._shape = tuple(shape) if shape is not None else None
+        self.name = name
+        self._dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.grad_req = grad_req
+        self.init = init
+        for t in (stype, grad_stype):
+            if t not in ("default", "row_sparse", "csr"):
+                raise ValueError(f"invalid stype {t!r}")
+        self._stype = stype
+        self._grad_stype = grad_stype
+
+    def __repr__(self):
+        return f"Parameter {self.name} (shape={self.shape}, dtype={self.dtype})"
+
+    # -- properties --------------------------------------------------------
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        if req not in ("write", "add", "null"):
+            raise ValueError(f"grad_req must be write/add/null, got {req}")
+        if not self._differentiable:
+            req = "null"
+        if self._grad_req == req:
+            return
+        self._grad_req = req
+        if req == "null" and self._grad is not None:
+            self._grad = None
+            if self._data is not None:
+                for d in self._data:
+                    d._mark_variable(None, "null")
+        elif self._data is not None:
+            self._init_grad()
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @dtype.setter
+    def dtype(self, dtype):
+        self.cast(dtype)
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @shape.setter
+    def shape(self, new_shape):
+        if self._shape is None:
+            self._shape = tuple(new_shape) if new_shape is not None else None
+            return
+        if new_shape is None:
+            return
+        unknown_ok = all(s1 in (0, s2) for s1, s2 in zip(self._shape, new_shape)) \
+            and len(self._shape) == len(new_shape)
+        if not unknown_ok:
+            raise AssertionError(
+                f"Expected shape {new_shape} is incompatible with given "
+                f"shape {self._shape} for Parameter {self.name}")
+        self._shape = tuple(new_shape)
+
+    @property
+    def stype(self):
+        return self._stype
+
+    @property
+    def grad_stype(self):
+        return self._grad_stype
+
+    # -- init --------------------------------------------------------------
+    def _check_and_get(self, arr_list, ctx):
+        if arr_list is not None:
+            if ctx is list:
+                return arr_list
+            if ctx is None:
+                if len(arr_list) == 1:
+                    return arr_list[0]
+                ctx = current_context()
+            ctx_list = self._ctx_map[ctx.device_typeid & 1]
+            if ctx.device_id < len(ctx_list):
+                idx = ctx_list[ctx.device_id]
+                if idx is not None:
+                    return arr_list[idx]
+            raise RuntimeError(
+                f"Parameter '{self.name}' was not initialized on context {ctx}. "
+                f"It was only initialized on {self._ctx_list}.")
+        if self._deferred_init:
+            raise DeferredInitializationError(
+                f"Parameter '{self.name}' has not been initialized yet because "
+                "initialization was deferred. Actual initialization happens "
+                "during the first forward pass. Please pass one batch of data "
+                "through the network before accessing Parameters.")
+        raise RuntimeError(
+            f"Parameter '{self.name}' has not been initialized. You should "
+            "initialize parameters and create a Trainer first, then use "
+            "net.forward() and trainer.step() to start training.")
+
+    def _load_init(self, data, ctx, cast_dtype=False, dtype_source="current"):
+        if self.shape:
+            unknown = any(s == 0 for s in self.shape)
+            if not unknown and tuple(self.shape) != tuple(data.shape):
+                raise AssertionError(
+                    f"Failed loading Parameter '{self.name}' from saved params: "
+                    f"shape incompatible expected {self.shape} vs saved {data.shape}")
+            self.shape = tuple(data.shape)
+        if cast_dtype and np_dtype(self.dtype) != data.dtype:
+            if dtype_source == "current":
+                data = data.astype(self.dtype)
+            else:
+                self._dtype = data.dtype
+        elif np_dtype(self.dtype) != data.dtype:
+            raise AssertionError(
+                f"Failed loading Parameter '{self.name}' from saved params: "
+                f"dtype incompatible expected {np_dtype(self.dtype)} vs saved "
+                f"{data.dtype}. Set cast_dtype=True to cast")
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if self._data is None:
+            if self._deferred_init:
+                ctx = self._deferred_init[1]
+            elif ctx is None:
+                ctx = [cpu()]
+            self._init_impl(data, ctx)
+        else:
+            if ctx is None:
+                ctx = self._ctx_list
+            self.set_data(data)
+        self._deferred_init = ()
+
+    def _finish_deferred_init(self):
+        if not self._deferred_init:
+            return
+        initializer, ctx, default_init, data = self._deferred_init
+        self._deferred_init = ()
+        assert self.shape is not None and int(np.prod(self.shape)) > 0, \
+            (f"Cannot initialize Parameter '{self.name}' because it has "
+             f"invalid shape: {self.shape}.")
+        with autograd.pause():
+            if data is None:
+                data = nd.zeros(self.shape, dtype=self.dtype, ctx=cpu())
+                init_mod.create(default_init)(
+                    init_mod.InitDesc(self.name,
+                                      {"__init__": initializer.dumps()
+                                       if isinstance(initializer, init_mod.Initializer)
+                                       else initializer or ""}),
+                    data)
+            self._init_impl(data, ctx)
+
+    def _init_impl(self, data, ctx_list):
+        """Set data and grad on each ctx (parity: parameter.py:336)."""
+        self._ctx_list = list(ctx_list)
+        self._ctx_map = [[], []]
+        for i, ctx in enumerate(self._ctx_list):
+            dev_list = self._ctx_map[ctx.device_typeid & 1]
+            while len(dev_list) <= ctx.device_id:
+                dev_list.append(None)
+            dev_list[ctx.device_id] = i
+        data = data if isinstance(data, NDArray) else nd.array(
+            data, dtype=self.dtype)
+        self._data = [data.copyto(nd.empty(data.shape, ctx=ctx,
+                                           dtype=self.dtype))
+                      for ctx in self._ctx_list]
+        self._init_grad()
+
+    def _init_grad(self):
+        if self.grad_req == "null":
+            self._grad = None
+            return
+        self._grad = [nd.zeros(d.shape, ctx=d.ctx, dtype=d.dtype)
+                      for d in self._data]
+        for d, g in zip(self._data, self._grad):
+            autograd.mark_variables([d], [g], self.grad_req)
+
+    def _reduce(self):
+        """Average gradients/data from all contexts (parity: parameter.py:361)."""
+        ctx = cpu()
+        if self._stype == "default":
+            block = self.list_data()
+            if len(block) == 1:
+                return block[0].as_in_context(ctx)
+            data = nd.add_n(*[w.as_in_context(ctx) for w in block]) / len(block)
+            return data
+        raise NotImplementedError("sparse parameter reduce")
+
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        """Initialize parameter and gradient arrays
+        (parity: parameter.py initialize)."""
+        if default_init is None:
+            default_init = init_mod.Uniform()
+        if self._data is not None and not force_reinit:
+            import logging
+            logging.getLogger(__name__).warning(
+                "Parameter '%s' is already initialized, ignoring. "
+                "Set force_reinit=True to re-initialize.", self.name)
+            return
+        self._data = self._grad = None
+        if ctx is None:
+            ctx = [current_context()]
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if init is None:
+            init = default_init if self.init is None else self.init
+        if self.shape is None or any(s == 0 for s in self.shape):
+            if self._allow_deferred_init:
+                self._deferred_init = (init, ctx, default_init, None)
+                return
+            raise ValueError(
+                f"Cannot initialize Parameter '{self.name}' because it has "
+                f"invalid shape: {self.shape}.")
+        self._deferred_init = (init, ctx, default_init, None)
+        self._finish_deferred_init()
+
+    def reset_ctx(self, ctx):
+        """Re-assign Parameter to other contexts."""
+        if ctx is None:
+            ctx = [current_context()]
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if self._data:
+            data = self._reduce()
+            with autograd.pause():
+                self._init_impl(data, ctx)
+        elif self._deferred_init:
+            initializer, _, default_init, data = self._deferred_init
+            self._deferred_init = (initializer, ctx, default_init, data)
+        else:
+            raise ValueError(
+                f"Cannot reset context for Parameter '{self.name}' because it "
+                "has not been initialized.")
+
+    def set_data(self, data):
+        """Set this parameter's value on all contexts."""
+        self.shape = data.shape
+        if self._data is None:
+            assert self._deferred_init, \
+                f"Parameter '{self.name}' has not been initialized"
+            self._deferred_init = self._deferred_init[:3] + (
+                data if isinstance(data, NDArray) else nd.array(data),)
+            return
+        # keep trainer's kvstore in sync when present
+        if self._trainer is not None and getattr(self._trainer, "_kv_initialized", False):
+            self._trainer._reset_kvstore()
+        for arr in self._check_and_get(self._data, list):
+            arr[:] = data
+
+    def row_sparse_data(self, row_id):
+        raise NotImplementedError(
+            "row_sparse parameters are not yet supported on the TPU runtime")
+
+    def list_row_sparse_data(self, row_id):
+        raise NotImplementedError(
+            "row_sparse parameters are not yet supported on the TPU runtime")
+
+    def data(self, ctx=None):
+        """Return a copy of this parameter on one context."""
+        if self._stype != "default":
+            raise RuntimeError(
+                f"Cannot return a copy of Parameter '{self.name}' via data() "
+                f"because its storage type is {self._stype}.")
+        return self._check_and_get(self._data, ctx)
+
+    def list_data(self):
+        return self._check_and_get(self._data, list)
+
+    def grad(self, ctx=None):
+        if self._data is not None and self._grad is None:
+            raise RuntimeError(
+                f"Cannot get gradient array for Parameter '{self.name}' "
+                "because grad_req='null'")
+        return self._check_and_get(self._grad, ctx)
+
+    def list_grad(self):
+        if self._data is not None and self._grad is None:
+            raise RuntimeError(
+                f"Cannot get gradient array for Parameter '{self.name}' "
+                "because grad_req='null'")
+        return self._check_and_get(self._grad, list)
+
+    def list_ctx(self):
+        if self._data is None:
+            if self._deferred_init:
+                return self._deferred_init[1]
+            raise RuntimeError(
+                f"Parameter '{self.name}' has not been initialized")
+        return self._ctx_list
+
+    def zero_grad(self):
+        """Set gradient buffer on all contexts to 0."""
+        if self._grad is None:
+            return
+        for g in self._grad:
+            g[:] = 0.0
+
+    def var(self):
+        """Symbol representing this parameter (symbolic API bridge)."""
+        if self._var is None:
+            from .. import symbol
+            self._var = symbol.var(self.name, shape=self.shape,
+                                   dtype=self.dtype, lr_mult=self.lr_mult,
+                                   wd_mult=self.wd_mult, init=self.init)
+            if not self._differentiable:
+                # non-differentiable params (BatchNorm moving stats) are aux
+                # states in the symbolic graph (parity: aux_states in
+                # GraphExecutor)
+                self._var._outputs[0][0].attrs["__is_aux__"] = True
+        return self._var
+
+    def cast(self, dtype):
+        """Cast data and gradient of this Parameter to a new dtype."""
+        self._dtype = dtype
+        if self._data is None:
+            return
+        with autograd.pause():
+            self._data = [i.astype(dtype) for i in self._data]
+            if self._grad is not None:
+                self._grad = [i.astype(dtype) for i in self._grad]
+                for d, g in zip(self._data, self._grad):
+                    autograd.mark_variables([d], [g], self.grad_req)
+
+
+class Constant(Parameter):
+    """A constant parameter (grad_req='null'), for fixed tensors
+    (parity: gluon/parameter.py Constant)."""
+
+    def __init__(self, name, value):
+        if not isinstance(value, NDArray):
+            value = nd.array(value)
+        self.value = value
+
+        class Init(init_mod.Initializer):
+            def _init_weight(self2, _, arr):
+                value.copyto(arr)
+
+        init_name = f"Constant_{name}_{id(self)}"
+        init_mod._INITIALIZER_REGISTRY[init_name.lower()] = Init
+        super().__init__(name, grad_req="null", shape=value.shape,
+                         dtype=value.dtype, init=init_name)
+
+    def __repr__(self):
+        return f"Constant {self.name} (shape={self.shape}, dtype={self.dtype})"
+
+    @property
+    def grad_req(self):
+        return "null"
+
+    @grad_req.setter
+    def grad_req(self, req):
+        if req != "null":
+            import logging
+            logging.getLogger(__name__).warning(
+                "Constant parameter %s does not support grad_req other than "
+                "'null', and new value %s is ignored.", self.name, req)
+
+
+class ParameterDict:
+    """A dictionary managing a set of parameters
+    (parity: gluon/parameter.py:681)."""
+
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params = {}  # insertion ordered
+        self._shared = shared
+
+    def __getitem__(self, key):
+        return self._params[key]
+
+    def __repr__(self):
+        name = self._prefix + " " if self._prefix else ""
+        return f"{name}(\n" + "\n".join(
+            f"  {v}" for v in self.values()) + "\n)"
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def _get_impl(self, name):
+        if name in self._params:
+            return self._params[name]
+        if self._shared is not None and name in self._shared._params:
+            self._params[name] = self._shared._params[name]
+            return self._shared._params[name]
+        return None
+
+    def get(self, name, **kwargs):
+        """Retrieve or create a Parameter named prefix+name."""
+        name = self.prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            param = Parameter(name, **kwargs)
+            self._params[name] = param
+        else:
+            for k, v in kwargs.items():
+                if hasattr(param, k) and getattr(param, k) is not None:
+                    existing = getattr(param, k)
+                    if k == "shape" and v is not None and len(v) == len(existing):
+                        inferred_shape = []
+                        matched = True
+                        for dim1, dim2 in zip(v, existing):
+                            if dim1 != dim2 and dim1 * dim2 != 0:
+                                matched = False
+                                break
+                            inferred_shape.append(max(dim1, dim2))
+                        if matched:
+                            param._shape = tuple(inferred_shape)
+                            continue
+                    elif k == "dtype" and np_dtype(v) == np_dtype(existing):
+                        continue
+                    assert v is None or v == existing, \
+                        (f"Cannot retrieve Parameter '{name}' because desired "
+                         f"attribute does not match with stored for attribute "
+                         f"'{k}': desired '{v}' vs stored '{existing}'.")
+                else:
+                    setattr(param, k, v)
+        return param
+
+    def get_constant(self, name, value=None):
+        name = self.prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            if value is None:
+                raise KeyError(
+                    f"No constant named '{name}'. Please specify value "
+                    "if you want to create a new constant.")
+            param = Constant(name, value)
+            self._params[name] = param
+        elif value is not None:
+            assert isinstance(param, Constant), \
+                f"Parameter '{name}' already exists but it is not a constant."
+            if isinstance(value, NDArray):
+                value = value.asnumpy()
+            assert param.shape == value.shape and \
+                (param.value.asnumpy() == value).all(), \
+                f"Constant '{name}' already exists but its value doesn't match."
+        return param
+
+    def update(self, other):
+        """Copy all Parameters in ``other`` to self."""
+        for k, v in other.items():
+            if k in self._params:
+                assert self._params[k] is v, \
+                    f"Cannot update self with other because they have different " \
+                    f"Parameters with the same name '{k}'"
+            else:
+                self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        if init is None:
+            init = init_mod.Uniform()
+        if verbose and hasattr(init, "set_verbosity"):
+            init.set_verbosity(verbose=verbose)
+        for _, v in self.items():
+            v.initialize(None, ctx, init, force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for i in self.values():
+            i.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for i in self.values():
+            i.reset_ctx(ctx)
+
+    def list_ctx(self):
+        s = set()
+        for i in self.values():
+            s.update(i.list_ctx())
+        return list(s)
+
+    def setattr(self, name, value):
+        """Set an attribute on all Parameters (e.g. lr_mult, grad_req)."""
+        for i in self.values():
+            setattr(i, name, value)
+
+    def save(self, filename, strip_prefix=""):
+        arg_dict = {}
+        for param in self.values():
+            weight = param._reduce()
+            if not param.name.startswith(strip_prefix):
+                raise ValueError(
+                    f"Prefix '{strip_prefix}' is to be striped before saving, "
+                    f"but Parameter's name '{param.name}' does not start "
+                    "with it")
+            arg_dict[param.name[len(strip_prefix):]] = weight
+        nd.save(filename, arg_dict)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix="", cast_dtype=False,
+             dtype_source="current"):
+        if restore_prefix:
+            for name in self.keys():
+                assert name.startswith(restore_prefix), \
+                    (f"restore_prefix is '{restore_prefix}' but Parameter name "
+                     f"'{name}' does not start with it")
+        lprefix = len(restore_prefix)
+        loaded = nd.load(filename)
+        arg_dict = {(k[4:] if k.startswith(("arg:", "aux:")) else k): v
+                    for k, v in loaded.items()}
+        arg_dict = {restore_prefix + k: v for k, v in arg_dict.items()}
+        if not allow_missing:
+            for name in self.keys():
+                assert name in arg_dict, \
+                    (f"Parameter '{name[lprefix:]}' is missing in file "
+                     f"'{filename}'. Set allow_missing=True to ignore missing "
+                     "parameters.")
+        for name in arg_dict:
+            if name not in self._params:
+                assert ignore_extra, \
+                    (f"Parameter '{name[lprefix:]}' loaded from file "
+                     f"'{filename}' is not present in this ParameterDict. "
+                     "Set ignore_extra=True to ignore.")
+                continue
+            self[name]._load_init(arg_dict[name], ctx,
+                                  cast_dtype=cast_dtype,
+                                  dtype_source=dtype_source)

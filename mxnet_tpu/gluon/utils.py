@@ -1,0 +1,125 @@
+"""gluon utilities (parity: python/mxnet/gluon/utils.py).
+
+split_data/split_and_load slice a batch across a device list — the explicit
+imperative DP path. (Under pjit SPMD, `mxnet_tpu.parallel` shards the batch
+with one NamedSharding instead; this API remains for source compatibility.)
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from .. import ndarray as nd
+from ..base import MXNetError
+from ..context import Context, cpu
+from ..ndarray import NDArray
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Split an NDArray into num_slice slices along batch_axis
+    (parity: gluon/utils.py split_data)."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            f"data with shape {data.shape} cannot be evenly split into "
+            f"{num_slice} slices along axis {batch_axis}. Use a batch size "
+            f"that's a multiple of {num_slice} or set even_split=False to "
+            "allow uneven partitioning of data.")
+    if num_slice == 1:
+        return [data]
+    step = size // num_slice
+    if even_split:
+        slices = [data.slice_axis(batch_axis, i * step, (i + 1) * step)
+                  for i in range(num_slice)]
+    else:
+        slices = [data.slice_axis(batch_axis, i * step,
+                                  (i + 1) * step if i < num_slice - 1 else size)
+                  for i in range(num_slice)]
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split and load each slice to one context (parity: gluon/utils.py)."""
+    if not isinstance(data, NDArray):
+        data = nd.array(data, ctx=ctx_list[0])
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [i.as_in_context(ctx) for i, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescale arrays so that the sum of their 2-norm is smaller than max_norm
+    (parity: gluon/utils.py clip_global_norm)."""
+
+    def _norm(array):
+        if array.stype == "default":
+            x = array.reshape((-1,))
+            return nd.dot(x, x)
+        return array.norm().square()
+
+    assert len(arrays) > 0
+    ctx = arrays[0].ctx
+    total_norm = nd.add_n(*[_norm(arr).as_in_context(ctx) for arr in arrays])
+    total_norm = float(total_norm.sqrt().asscalar())
+    if check_isfinite and not np.isfinite(total_norm):
+        import warnings
+        warnings.warn(UserWarning(
+            "nan or inf is detected. Clipping results will be undefined."),
+            stacklevel=2)
+    scale = max_norm / (total_norm + 1e-8)
+    if scale < 1.0:
+        for arr in arrays:
+            arr *= scale
+    return total_norm
+
+
+def _indent(s_, num_spaces):
+    """Indent a multi-line string."""
+    lines = s_.split("\n")
+    if len(lines) == 1:
+        return s_
+    first = lines.pop(0)
+    return first + "\n" + "\n".join(" " * num_spaces + line for line in lines)
+
+
+def check_sha1(filename, sha1_hash):
+    """Check whether a file's sha1 hash matches."""
+    import hashlib
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
+             verify_ssl=True):
+    """Download a file (parity: gluon/utils.py download). This environment has
+    no egress; raises unless the file is already present locally."""
+    if path is None:
+        fname = url.split("/")[-1]
+    elif os.path.isdir(path):
+        fname = os.path.join(path, url.split("/")[-1])
+    else:
+        fname = path
+    if os.path.exists(fname) and not overwrite and (
+            not sha1_hash or check_sha1(fname, sha1_hash)):
+        return fname
+    raise MXNetError(
+        f"Cannot download {url}: the runtime has no network egress. Place the "
+        f"file at {fname} manually.")
+
+
+def shape_is_known(shape):
+    """Check whether a shape is completely known with or without np semantics."""
+    if shape is None:
+        return False
+    unknown_dim_size = 0
+    if len(shape) == 0:
+        return True
+    return all(dim_size > unknown_dim_size for dim_size in shape)

@@ -1,0 +1,326 @@
+"""Weight initializers.
+
+Re-design of reference python/mxnet/initializer.py (758 LoC): registry of
+named initializers applied by parameter-name pattern. Initialization here is
+pure — each initializer produces a jax array via the framework RNG, so a
+seeded init is reproducible across hosts (important for SPMD: every host
+computes identical initial weights without a broadcast).
+"""
+from __future__ import annotations
+
+import json
+import math
+import re
+
+import numpy as np
+
+from . import ndarray as nd
+from .base import MXNetError
+from .registry import get_register_func, get_alias_func, get_create_func
+
+_INITIALIZER_REGISTRY = {}
+
+
+class InitDesc(str):
+    """Parameter name + attrs hint passed to initializers
+    (parity: initializer.py InitDesc)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        ret = super().__new__(cls, name)
+        ret.attrs = attrs or {}
+        ret.global_init = global_init
+        return ret
+
+
+class Initializer:
+    """Base class. Callable on (InitDesc|str, NDArray)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+        self._verbose = False
+        self._print_func = None
+
+    def set_verbosity(self, verbose=False, print_func=None):
+        self._verbose = verbose
+        self._print_func = print_func or (lambda x: None)
+        return self
+
+    def dumps(self):
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, desc, arr):
+        if not isinstance(desc, str):
+            raise TypeError("desc must be str or InitDesc")
+        if isinstance(desc, InitDesc) and desc.global_init is None:
+            desc.global_init = self
+        init = getattr(desc, "attrs", {}).get("__init__", "")
+        if init:
+            create(init)._init_weight(desc, arr)
+        else:
+            name = str(desc)
+            if name.endswith("weight"):
+                self._init_weight(name, arr)
+            elif name.endswith("bias"):
+                self._init_bias(name, arr)
+            elif name.endswith("gamma"):
+                self._init_gamma(name, arr)
+            elif name.endswith("beta"):
+                self._init_beta(name, arr)
+            elif name.endswith("running_mean") or name.endswith("moving_mean"):
+                self._init_zero(name, arr)
+            elif name.endswith("running_var") or name.endswith("moving_var"):
+                self._init_one(name, arr)
+            elif name.endswith("moving_inv_var") or name.endswith("moving_avg"):
+                self._init_zero(name, arr)
+            elif name.endswith("min") or name.endswith("max"):
+                self._init_zero(name, arr)
+            else:
+                self._init_default(name, arr)
+        if self._verbose and self._print_func:
+            self._print_func(f"Initialized {desc}")
+
+    def _init_bias(self, _, arr):
+        arr[:] = 0.0
+
+    def _init_gamma(self, _, arr):
+        arr[:] = 1.0
+
+    def _init_beta(self, _, arr):
+        arr[:] = 0.0
+
+    def _init_zero(self, _, arr):
+        arr[:] = 0.0
+
+    def _init_one(self, _, arr):
+        arr[:] = 1.0
+
+    def _init_weight(self, name, arr):
+        raise NotImplementedError()
+
+    def _init_default(self, name, arr):
+        raise MXNetError(
+            f"Unknown policy for parameter {name!r}: MXNet-convention names "
+            "(*_weight/_bias/_gamma/_beta/...) get default policies; others "
+            "need an explicit init")
+
+    def __repr__(self):
+        return f"{self.__class__.__name__}({self._kwargs})"
+
+
+register = get_register_func(Initializer, "initializer", _INITIALIZER_REGISTRY)
+alias = get_alias_func(Initializer, "initializer", _INITIALIZER_REGISTRY)
+create = get_create_func(Initializer, "initializer", _INITIALIZER_REGISTRY)
+
+
+@register
+@alias("zeros")
+class Zero(Initializer):
+    def _init_weight(self, _, arr):
+        arr[:] = 0.0
+
+
+@register
+@alias("ones")
+class One(Initializer):
+    def _init_weight(self, _, arr):
+        arr[:] = 1.0
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, _, arr):
+        arr[:] = self.value
+
+
+@register
+class Uniform(Initializer):
+    """U(-scale, scale) (parity: initializer.py Uniform)."""
+
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, _, arr):
+        arr[:] = nd.random.uniform(-self.scale, self.scale, arr.shape,
+                                   dtype=arr.dtype, ctx=arr.ctx)
+
+
+@register
+class Normal(Initializer):
+    """N(0, sigma^2)."""
+
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, _, arr):
+        arr[:] = nd.random.normal(0, self.sigma, arr.shape,
+                                  dtype=arr.dtype, ctx=arr.ctx)
+
+
+@register
+class Orthogonal(Initializer):
+    """Orthogonal matrix init (parity: initializer.py Orthogonal; Saxe et al. 2013)."""
+
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, _, arr):
+        nout = arr.shape[0]
+        nin = int(np.prod(arr.shape[1:])) if len(arr.shape) > 1 else 1
+        if self.rand_type == "uniform":
+            tmp = nd.random.uniform(-1.0, 1.0, (nout, nin)).asnumpy()
+        else:
+            tmp = nd.random.normal(0.0, 1.0, (nout, nin)).asnumpy()
+        u, _, v = np.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == tmp.shape else v
+        arr[:] = self.scale * q.reshape(arr.shape)
+
+
+@register
+class Xavier(Initializer):
+    """Glorot init, uniform/gaussian, avg/in/out fan (parity: initializer.py Xavier)."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, arr):
+        shape = arr.shape
+        if len(shape) < 2:
+            raise MXNetError(f"Xavier requires ndim>=2, got {shape} for {name}")
+        hw_scale = float(np.prod(shape[2:])) if len(shape) > 2 else 1.0
+        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        elif self.factor_type == "out":
+            factor = fan_out
+        else:
+            raise MXNetError("Incorrect factor type")
+        scale = math.sqrt(self.magnitude / factor)
+        if self.rnd_type == "uniform":
+            arr[:] = nd.random.uniform(-scale, scale, shape, dtype=arr.dtype,
+                                       ctx=arr.ctx)
+        elif self.rnd_type == "gaussian":
+            arr[:] = nd.random.normal(0, scale, shape, dtype=arr.dtype,
+                                      ctx=arr.ctx)
+        else:
+            raise MXNetError("Unknown random type")
+
+
+@register
+class MSRAPrelu(Xavier):
+    """He init for PReLU nets (parity: initializer.py MSRAPrelu)."""
+
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel (for Deconvolution upsampling)."""
+
+    def _init_weight(self, _, arr):
+        weight = np.zeros(arr.shape, dtype="float32")
+        shape = arr.shape
+        f = np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(np.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight.ravel()[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr[:] = weight
+
+
+@register
+class LSTMBias(Initializer):
+    """Forget-gate bias = forget_bias, others 0 (parity: initializer.py LSTMBias)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, arr):
+        arr[:] = 0.0
+        num_hidden = int(arr.shape[0] / 4)
+        arr[num_hidden:2 * num_hidden] = self.forget_bias
+
+
+@register
+class FusedRNN(Initializer):
+    """Initialize fused RNN parameter blobs by unpacking per-gate inits."""
+
+    def __init__(self, init, num_hidden, num_layers, mode, bidirectional=False,
+                 forget_bias=1.0):
+        if isinstance(init, str):
+            klass, kwargs = json.loads(init)
+            init = _INITIALIZER_REGISTRY[klass.lower()](**kwargs)
+        super().__init__(init=init.dumps() if init else None,
+                         num_hidden=num_hidden, num_layers=num_layers,
+                         mode=mode, bidirectional=bidirectional,
+                         forget_bias=forget_bias)
+        self._init = init
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+        self._forget_bias = forget_bias
+
+    def _init_weight(self, desc, arr):  # simple policy: treat as one blob
+        if self._init is not None:
+            self._init._init_weight(desc, arr)
+        else:
+            Uniform()._init_weight(desc, arr)
+
+
+class Load:
+    """Initialize by copying from a dict of arrays (parity: initializer.py Load)."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        self.param = {k[4:] if k.startswith(("arg:", "aux:")) else k: v
+                      for k, v in param.items()}
+        self.default_init = default_init
+        self.verbose = verbose
+
+    def __call__(self, name, arr):
+        if name in self.param:
+            src = self.param[name]
+            if src.shape != arr.shape:
+                raise MXNetError(f"Parameter {name} shape mismatch: "
+                                 f"{src.shape} vs {arr.shape}")
+            arr[:] = src
+        else:
+            if self.default_init is None:
+                raise MXNetError(f"Cannot init parameter {name}: not found "
+                                 "in loaded params and no default_init")
+            self.default_init(name, arr)
+
+
+class Mixed:
+    """Patterns → initializers; first match wins (parity: initializer.py Mixed)."""
+
+    def __init__(self, patterns, initializers):
+        if len(patterns) != len(initializers):
+            raise MXNetError("patterns and initializers must have same length")
+        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+
+    def __call__(self, name, arr):
+        for prog, init in self.map:
+            if prog.match(name):
+                init(name, arr)
+                return
+        raise MXNetError(f"Parameter {name} did not match any pattern; "
+                         'add a ".*" catch-all')

@@ -1,0 +1,57 @@
+"""Automatic naming support (parity: python/mxnet/name.py NameManager/Prefix)."""
+from __future__ import annotations
+
+import threading
+
+
+class NameManager:
+    """Name manager to do automatic naming."""
+
+    _current = threading.local()
+
+    def __init__(self, prefix=None):
+        self._counter = {}
+        self._old_manager = None
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        if name:
+            # scope prefix applies to explicit names too (parity: name.py
+            # Prefix.get used by gluon _BlockScope)
+            return self._prefix + name if self._prefix else name
+        if hint not in self._counter:
+            self._counter[hint] = 0
+        name = f"{hint}{self._counter[hint]}"
+        self._counter[hint] += 1
+        if self._prefix:
+            name = self._prefix + name
+        return name
+
+    def __enter__(self):
+        if not hasattr(NameManager._current, "value"):
+            NameManager._current.value = NameManager()
+        self._old_manager = NameManager._current.value
+        NameManager._current.value = self
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        assert self._old_manager
+        NameManager._current.value = self._old_manager
+
+    @staticmethod
+    def _current_value():
+        if not hasattr(NameManager._current, "value"):
+            NameManager._current.value = NameManager()
+        return NameManager._current.value
+
+
+class Prefix(NameManager):
+    """Always prepend a prefix to all names."""
+
+    def __init__(self, prefix):
+        super().__init__()
+        self._name_prefix = prefix
+
+    def get(self, name, hint):
+        name = super().get(name, hint)
+        return self._name_prefix + name

@@ -445,7 +445,14 @@ def invoke(op, inputs, attrs, out=None):
     if op.is_random:
         arrays = [_random.next_key()] + arrays
 
-    fn, _ = op.bind(**attrs)
+    # inside an outer trace (CachedOp jit / vjp / shard_map): emit raw ops so
+    # the outer transform sees the primitives directly (jax 0.9 cannot
+    # linearize e.g. reduce_window through an inner jit) and trace time stays
+    # flat
+    if any(isinstance(a, jax.core.Tracer) for a in arrays):
+        fn = op.raw(attrs)
+    else:
+        fn, _ = op.bind(**attrs)
     recording = autograd.is_recording()
     try:
         if recording and op.fgradient is not None:
@@ -640,6 +647,26 @@ def moveaxis(a, source, destination):
     axes = list(range(a.ndim))
     axes.insert(destination % a.ndim, axes.pop(source % a.ndim))
     return invoke("transpose", [a], {"axes": tuple(axes)})
+
+
+def maximum(lhs, rhs):
+    if isinstance(lhs, NDArray) and isinstance(rhs, NDArray):
+        return invoke("broadcast_maximum", [lhs, rhs], {})
+    if isinstance(lhs, NDArray):
+        return invoke("_maximum_scalar", [lhs], {"scalar": float(rhs)})
+    if isinstance(rhs, NDArray):
+        return invoke("_maximum_scalar", [rhs], {"scalar": float(lhs)})
+    return max(lhs, rhs)  # both python scalars (parity: _ufunc_helper)
+
+
+def minimum(lhs, rhs):
+    if isinstance(lhs, NDArray) and isinstance(rhs, NDArray):
+        return invoke("broadcast_minimum", [lhs, rhs], {})
+    if isinstance(lhs, NDArray):
+        return invoke("_minimum_scalar", [lhs], {"scalar": float(rhs)})
+    if isinstance(rhs, NDArray):
+        return invoke("_minimum_scalar", [rhs], {"scalar": float(lhs)})
+    return min(lhs, rhs)
 
 
 def add_n(*args):

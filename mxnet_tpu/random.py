@@ -32,7 +32,29 @@ def seed(seed_state, ctx=None):
 def next_key():
     s = _get()
     s.counter += 1
+    trace_key = getattr(_state, "trace_key", None)
+    if trace_key is not None:
+        # under CachedOp/jit tracing: derive from the traced per-call key so
+        # every compiled invocation gets fresh randomness (a concrete key
+        # would bake one dropout mask into the executable)
+        return jax.random.fold_in(trace_key, s.counter)
     return jax.random.fold_in(s.key, s.counter)
+
+
+class trace_key_scope:
+    """Context manager installing a traced base key for random ops."""
+
+    def __init__(self, key):
+        self._key = key
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = getattr(_state, "trace_key", None)
+        _state.trace_key = self._key
+        return self
+
+    def __exit__(self, *a):
+        _state.trace_key = self._prev
 
 
 def current_key():

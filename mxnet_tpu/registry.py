@@ -1,0 +1,77 @@
+"""Generic class registry helpers.
+
+Parity: python/mxnet/registry.py — register/alias/create factories used by
+Initializer, Optimizer, EvalMetric, LRScheduler registries. create() accepts
+a name string, a (name, kwargs) json string, or an instance.
+"""
+from __future__ import annotations
+
+import json
+
+from .base import MXNetError
+
+_REGISTRIES = {}
+
+
+def get_register_func(base_class, nickname, registry=None):
+    if registry is None:
+        registry = _REGISTRIES.setdefault(nickname, {})
+    _REGISTRIES[nickname] = registry
+
+    def register(klass, name=None):
+        assert issubclass(klass, base_class), \
+            f"Can only register subclass of {base_class.__name__}"
+        key = (name or klass.__name__).lower()
+        if key in registry and registry[key] is not klass:
+            import logging
+            logging.getLogger(__name__).warning(
+                "New %s %s.%s registered with name %s is overriding existing %s",
+                nickname, klass.__module__, klass.__name__, key,
+                registry[key].__name__)
+        registry[key] = klass
+        return klass
+
+    register.__doc__ = f"Register a {nickname} to the {nickname} registry"
+    return register
+
+
+def get_alias_func(base_class, nickname, registry=None):
+    register = get_register_func(base_class, nickname, registry)
+
+    def alias(*aliases):
+        def reg(klass):
+            for a in aliases:
+                register(klass, a)
+            return klass
+        return reg
+
+    return alias
+
+
+def get_create_func(base_class, nickname, registry=None):
+    if registry is None:
+        registry = _REGISTRIES.setdefault(nickname, {})
+
+    def create(*args, **kwargs):
+        if len(args) == 0:
+            raise MXNetError(f"{nickname} name required")
+        name = args[0]
+        args = args[1:]
+        if isinstance(name, base_class):
+            if args or kwargs:
+                raise MXNetError(
+                    f"{nickname} is already an instance; no extra args allowed")
+            return name
+        if not isinstance(name, str):
+            raise MXNetError(f"{nickname} must be str or {base_class.__name__}")
+        if name.startswith("["):
+            if args or kwargs:
+                raise MXNetError("no positional/kwargs with json spec")
+            name, kwargs = json.loads(name)
+        key = name.lower()
+        if key not in registry:
+            raise MXNetError(f"Cannot find {nickname} {name} in registry "
+                             f"({sorted(registry)})")
+        return registry[key](*args, **kwargs)
+
+    return create

@@ -1,0 +1,33 @@
+"""mx.sym — the symbolic namespace (parity: python/mxnet/symbol/)."""
+from .symbol import (Symbol, Group, Variable, var, load, load_json, zeros,
+                     ones, arange)
+from ..ops import registry as _registry
+
+
+def _make_sym_func(op):
+    def fn(*args, name=None, attr=None, **kwargs):
+        inputs = [a for a in args if isinstance(a, Symbol)]
+        scalars = [a for a in args
+                   if not isinstance(a, Symbol) and isinstance(a, (int, float))]
+        for attr_name, val in zip(op.scalar_args, scalars):
+            kwargs.setdefault(attr_name, val)
+        return Symbol._create(op.name, inputs, kwargs, name=name)
+
+    fn.__name__ = op.name
+    fn.__doc__ = f"Symbolic wrapper for operator `{op.name}`."
+    return fn
+
+
+_SYM_FUNC_CACHE = {}
+
+
+def __getattr__(name):
+    if _registry.exists(name):
+        if name not in _SYM_FUNC_CACHE:
+            _SYM_FUNC_CACHE[name] = _make_sym_func(_registry.get(name))
+        return _SYM_FUNC_CACHE[name]
+    raise AttributeError(f"module 'mxnet_tpu.symbol' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_registry.list_ops()))

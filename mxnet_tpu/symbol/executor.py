@@ -1,0 +1,282 @@
+"""Executor: run a bound symbolic graph.
+
+Re-design of reference src/executor/graph_executor.cc (Executor::Bind:1906,
+SimpleBind:1874, Forward:66, Backward:79). The reference builds the full
+fwd+bwd graph, plans memory (plan_memory.cc), attaches one engine op per node
+and bulks segments. Here the entire graph is traced once into a single jitted
+XLA computation per input signature (forward) and a jitted vjp pair
+(backward) — XLA does memory planning/fusion/scheduling. Aux states
+(BatchNorm moving stats) are extra traced outputs written back after each
+forward, matching the reference's in-place aux mutation semantics.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import random as _random
+from ..base import MXNetError
+from ..context import current_context
+from ..ndarray import NDArray
+from ..ops import registry as _registry
+
+_BWD_EXEC = jax.jit(lambda vjp_fn, cts: vjp_fn(cts))
+
+
+class Executor:
+    """Executor for a Symbol (parity: python/mxnet/executor.py Executor)."""
+
+    def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write",
+                 aux_states=None):
+        self._symbol = symbol
+        self._ctx = ctx or current_context()
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+
+        if isinstance(args, dict):
+            self.arg_dict = dict(args)
+        else:
+            if len(args) != len(arg_names):
+                raise MXNetError(
+                    f"bind: expected {len(arg_names)} args "
+                    f"({arg_names}), got {len(args)}")
+            self.arg_dict = dict(zip(arg_names, args))
+        self.arg_arrays = [self.arg_dict.get(n) for n in arg_names]
+
+        if isinstance(aux_states, dict):
+            self.aux_dict = dict(aux_states)
+        elif aux_states is None:
+            self.aux_dict = {}
+        else:
+            self.aux_dict = dict(zip(aux_names, aux_states))
+        self.aux_arrays = [self.aux_dict.get(n) for n in aux_names]
+
+        if isinstance(args_grad, dict):
+            self.grad_dict = dict(args_grad)
+        elif args_grad is None:
+            self.grad_dict = {}
+        else:
+            self.grad_dict = dict(zip(arg_names, args_grad))
+
+        if isinstance(grad_req, str):
+            self.grad_req = {n: grad_req for n in arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            self.grad_req = dict(zip(arg_names, grad_req))
+        else:
+            self.grad_req = dict(grad_req)
+
+        self._arg_names = arg_names
+        self._aux_names = aux_names
+        self.outputs = []
+        self._monitor_callback = None
+        self._fn_cache = {}
+        self._vjp_holder = None
+        self._last_is_train = False
+
+    # -- graph compilation -------------------------------------------------
+    def _build_fn(self, is_train):
+        """Trace the graph into fn(key, arg_arrays, aux_arrays) ->
+        (outputs, new_aux_arrays)."""
+        sym = self._symbol
+        topo = sym._topo()
+        arg_names = self._arg_names
+        aux_names = self._aux_names
+
+        def fn(key, arg_arrays, aux_arrays):
+            env = {}
+            arg_map = dict(zip(arg_names, arg_arrays))
+            aux_map = dict(zip(aux_names, aux_arrays))
+            new_aux = dict(aux_map)
+            counter = 0
+            for node in topo:
+                if node.is_variable():
+                    if node.name in arg_map:
+                        env[(node, 0)] = arg_map[node.name]
+                    elif node.name in aux_map:
+                        env[(node, 0)] = aux_map[node.name]
+                    else:
+                        raise MXNetError(
+                            f"executor: variable {node.name} was not bound")
+                    continue
+                op = _registry.get(node.op)
+                ins = [env[e] for e in node.inputs]
+                attrs = {k: v for k, v in node.attrs.items()
+                         if not k.startswith("__")}
+                if node.op in ("Dropout", "BatchNorm"):
+                    attrs["_training"] = is_train
+                if op.is_random:
+                    counter += 1
+                    ins = [jax.random.fold_in(key, counter)] + ins
+                out = op.fcompute(attrs, *ins)
+                outs = out if isinstance(out, (tuple, list)) else (out,)
+                n_user = len(outs) - len(op.mutate_aux)
+                for i, o in enumerate(outs[:n_user]):
+                    env[(node, i)] = o
+                # route mutated aux outputs back to their aux variables
+                for j, in_idx in enumerate(op.mutate_aux):
+                    src_node, _ = node.inputs[in_idx]
+                    if src_node.is_variable() and src_node.name in new_aux:
+                        new_aux[src_node.name] = outs[n_user + j]
+            outputs = tuple(env[e] for e in sym._outputs)
+            return outputs, tuple(new_aux[n] for n in aux_names)
+
+        return fn
+
+    def _get_jitted(self, is_train):
+        key = (is_train,
+               tuple((a.shape, str(a.dtype)) if a is not None else None
+                     for a in self.arg_arrays),
+               tuple((a.shape, str(a.dtype)) if a is not None else None
+                     for a in self.aux_arrays))
+        entry = self._fn_cache.get(key)
+        if entry is None:
+            fn = self._build_fn(is_train)
+            jitted = jax.jit(fn)
+            grad_args = [i for i, n in enumerate(self._arg_names)
+                         if self.grad_req.get(n, "null") != "null"]
+
+            def fwd_vjp(key_arr, arg_arrays, aux_arrays):
+                ga = [arg_arrays[i] for i in grad_args]
+
+                def f(*diff):
+                    full = list(arg_arrays)
+                    for i, d in zip(grad_args, diff):
+                        full[i] = d
+                    outs, new_aux = fn(key_arr, tuple(full), aux_arrays)
+                    return outs, new_aux
+
+                return jax.vjp(f, *ga)
+
+            fwd_vjp_jit = jax.jit(fwd_vjp)
+            entry = (jitted, fwd_vjp_jit, grad_args)
+            self._fn_cache[key] = entry
+        return entry
+
+    # -- execution ---------------------------------------------------------
+    def forward(self, is_train=False, **kwargs):
+        """Run forward (parity: executor.py forward → GraphExecutor::Forward)."""
+        from .. import ndarray as nd
+        if kwargs:
+            for name, val in kwargs.items():
+                if name not in self.arg_dict:
+                    raise MXNetError(f"unknown argument {name}")
+                if isinstance(val, NDArray):
+                    self.arg_dict[name][:] = val
+                else:
+                    self.arg_dict[name][:] = nd.array(val)
+        jitted, fwd_vjp_jit, grad_args = self._get_jitted(bool(is_train))
+        key_arr = _random.next_key()
+        arg_arrays = tuple(a._data for a in self.arg_arrays)
+        aux_arrays = tuple(a._data for a in self.aux_arrays)
+        if is_train and grad_args:
+            (outs, new_aux), vjp_fn = fwd_vjp_jit(key_arr, arg_arrays,
+                                                  aux_arrays)
+            self._vjp_holder = (vjp_fn, grad_args,
+                                [jnp.zeros_like(a) for a in new_aux])
+        else:
+            outs, new_aux = jitted(key_arr, arg_arrays, aux_arrays)
+            self._vjp_holder = None
+        self._last_is_train = bool(is_train)
+        for arr, new in zip(self.aux_arrays, new_aux):
+            arr._set_data(new)
+        self.outputs = [NDArray(o, self._ctx) for o in outs]
+        if self._monitor_callback is not None:
+            names = self._symbol.list_outputs()
+            for n, o in zip(names, self.outputs):
+                self._monitor_callback(n, o)
+        return self.outputs
+
+    def backward(self, out_grads=None, is_train=True):
+        """Run backward and accumulate into args_grad per grad_req
+        (parity: executor.py backward → GraphExecutor::Backward)."""
+        if self._vjp_holder is None:
+            raise MXNetError(
+                "backward requires forward(is_train=True) first (parity: "
+                "reference requires bind with args_grad + train forward)")
+        vjp_fn, grad_args, zero_aux = self._vjp_holder
+        if out_grads is None:
+            cts = tuple(jnp.ones_like(o._data) for o in self.outputs)
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            cts = tuple(g._data for g in out_grads)
+        grads = _BWD_EXEC(vjp_fn, (cts, tuple(zero_aux)))
+        for i, g in zip(grad_args, grads):
+            name = self._arg_names[i]
+            req = self.grad_req.get(name, "null")
+            tgt = self.grad_dict.get(name)
+            if tgt is None or req == "null":
+                continue
+            if req == "add":
+                tgt._set_data(tgt._data + g)
+            else:
+                tgt._set_data(g.astype(tgt.dtype))
+
+    # -- utility -----------------------------------------------------------
+    @property
+    def output_dict(self):
+        return dict(zip(self._symbol.list_outputs(), self.outputs))
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        """Copy parameter values (parity: executor.py copy_params_from)."""
+        for name, array in arg_params.items():
+            if name in self.arg_dict:
+                self.arg_dict[name][:] = array
+            elif not allow_extra_params:
+                raise MXNetError(f"Found name \"{name}\" that is not in the "
+                                 "arguments")
+        if aux_params:
+            for name, array in aux_params.items():
+                if name in self.aux_dict:
+                    self.aux_dict[name][:] = array
+                elif not allow_extra_params:
+                    raise MXNetError(f"Found name \"{name}\" that is not in "
+                                     "the auxiliary states")
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Return a new executor with new input shapes (parity:
+        executor.py reshape; cheap here — recompile happens lazily)."""
+        from .. import ndarray as nd
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**kwargs)
+        new_args = {}
+        for name, shape in zip(self._arg_names, arg_shapes):
+            old = self.arg_dict.get(name)
+            if old is not None and tuple(old.shape) == tuple(shape):
+                new_args[name] = old
+            else:
+                new_args[name] = nd.zeros(shape, ctx=self._ctx,
+                                          dtype=old.dtype if old is not None
+                                          else np.float32)
+        new_grads = None
+        if self.grad_dict:
+            new_grads = {}
+            for name, arr in self.grad_dict.items():
+                shape = new_args[name].shape
+                new_grads[name] = nd.zeros(shape, ctx=self._ctx,
+                                           dtype=arr.dtype)
+        new_aux = {}
+        for name, shape in zip(self._aux_names, aux_shapes):
+            old = self.aux_dict.get(name)
+            if old is not None and tuple(old.shape) == tuple(shape):
+                new_aux[name] = old
+            else:
+                new_aux[name] = nd.zeros(shape, ctx=self._ctx)
+        return Executor(self._symbol, self._ctx, new_args, new_grads,
+                        self.grad_req, new_aux)
+
+    def set_monitor_callback(self, callback, monitor_all=False):
+        """Install per-output callback (parity: graph_executor.cc:1403
+        monitor_callback_)."""
+        self._monitor_callback = callback
+
+    def debug_str(self):
+        lines = ["Symbol Outputs:"]
+        for n in self._symbol.list_outputs():
+            lines.append(f"\toutput[{n}]")
+        for node in self._symbol._topo():
+            if not node.is_variable():
+                lines.append(f"Op:{node.op}, Name={node.name}")
+        return "\n".join(lines)

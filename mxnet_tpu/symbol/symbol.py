@@ -1,0 +1,659 @@
+"""Symbol: the declarative graph IR.
+
+Re-design of reference nnvm Symbol/Graph (python/mxnet/symbol/symbol.py:55 +
+the vendored nnvm C++ graph). A Symbol is a DAG of _SymNode (op + attrs +
+input entries) with a list of output entries. JSON serde keeps the MXNet
+format (nodes / arg_nodes / heads) so reference model-zoo JSON files load.
+
+Executor story (reference: src/executor/graph_executor.cc): bind() returns an
+Executor that traces the whole graph into ONE jitted XLA computation —
+memory planning, op fusion, and scheduling (PlanMemory / bulking in the
+reference) all delegated to XLA.
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from ..base import MXNetError, np_dtype
+from ..ops import registry as _registry
+
+
+class _SymNode:
+    """One graph node (op instance or variable)."""
+
+    __slots__ = ("op", "name", "attrs", "inputs")
+
+    def __init__(self, op, name, attrs=None, inputs=None):
+        self.op = op              # str op name, or None for variables
+        self.name = name
+        self.attrs = dict(attrs or {})
+        self.inputs = list(inputs or [])  # list[(node, out_index)]
+
+    def is_variable(self):
+        return self.op is None
+
+
+def _auto_name(hint):
+    from ..name import NameManager
+    return NameManager._current_value().get(None, hint)
+
+
+class Symbol:
+    """Symbol is symbolic graph handle (parity: symbol/symbol.py:55)."""
+
+    def __init__(self, outputs):
+        # outputs: list[(node, out_index)]
+        self._outputs = list(outputs)
+
+    # -- construction ------------------------------------------------------
+    @staticmethod
+    def _create(op_name, input_syms, attrs, name=None):
+        op = _registry.get(op_name)
+        attrs = {k: v for k, v in attrs.items() if v is not None}
+        from ..name import NameManager
+        name = NameManager._current_value().get(name, op_name.lower().strip("_"))
+        entries = []
+        for s in input_syms:
+            if len(s._outputs) != 1:
+                raise MXNetError(
+                    "cannot compose with a multi-output symbol as one input; "
+                    "select an output first")
+            entries.append(s._outputs[0])
+        node = _SymNode(op_name, name, attrs, entries)
+        n_out = op.num_outputs
+        if isinstance(n_out, str):  # dynamic: resolved at bind time
+            n_out = int(attrs.get("num_outputs", 1)) if n_out == "num_outputs" else 1
+        # aux-mutating ops (BatchNorm moving stats): user-facing outputs only;
+        # the executor routes the trailing outputs back into the aux inputs
+        n_out -= len(op.mutate_aux)
+        if n_out == 1:
+            return Symbol([(node, 0)])
+        return Symbol([(node, i) for i in range(n_out)])
+
+    # -- basic properties --------------------------------------------------
+    @property
+    def name(self):
+        if len(self._outputs) == 1:
+            return self._outputs[0][0].name
+        return None
+
+    def __repr__(self):
+        name = self.name
+        if name is None:
+            name = ", ".join(n.name for n, _ in self._outputs)
+            return f"<Symbol group [{name}]>"
+        return f"<Symbol {name}>"
+
+    def __iter__(self):
+        return (self[i] for i in range(len(self._outputs)))
+
+    def __len__(self):
+        return len(self._outputs)
+
+    def __getitem__(self, index):
+        if isinstance(index, str):
+            names = self.list_outputs()
+            idx = names.index(index)
+            return Symbol([self._outputs[idx]])
+        if isinstance(index, slice):
+            return Group([Symbol([o]) for o in self._outputs[index]])
+        return Symbol([self._outputs[index]])
+
+    def __copy__(self):
+        return Symbol(list(self._outputs))
+
+    def __deepcopy__(self, memo):
+        # graph nodes are immutable-by-convention; shallow copy is enough
+        return Symbol(list(self._outputs))
+
+    # -- graph walks -------------------------------------------------------
+    def _topo(self):
+        """Topological order of all nodes reachable from outputs."""
+        seen = set()
+        order = []
+
+        def visit(node):
+            if id(node) in seen:
+                return
+            seen.add(id(node))
+            for (src, _) in node.inputs:
+                visit(src)
+            order.append(node)
+
+        for (n, _) in self._outputs:
+            visit(n)
+        return order
+
+    def list_arguments(self):
+        """Names of all variable (argument) nodes in topo order."""
+        return [n.name for n in self._topo()
+                if n.is_variable() and not n.attrs.get("__is_aux__")]
+
+    def list_auxiliary_states(self):
+        """Aux states: variables marked auxiliary (BatchNorm moving stats)."""
+        return [n.name for n in self._topo()
+                if n.is_variable() and n.attrs.get("__is_aux__")]
+
+    def list_inputs(self):
+        return [n.name for n in self._topo() if n.is_variable()]
+
+    def list_outputs(self):
+        outs = []
+        for (n, i) in self._outputs:
+            if n.is_variable():
+                outs.append(n.name)
+                continue
+            op = _registry.get(n.op)
+            n_out = op.num_outputs
+            if (isinstance(n_out, int) and n_out > 1) or not isinstance(n_out, int):
+                outs.append(f"{n.name}_output{i}")
+            else:
+                outs.append(f"{n.name}_output")
+        return outs
+
+    def get_internals(self):
+        """Symbol grouping every internal output (parity: get_internals)."""
+        entries = []
+        for n in self._topo():
+            if n.is_variable():
+                entries.append((n, 0))
+            else:
+                op = _registry.get(n.op)
+                n_out = op.num_outputs if isinstance(op.num_outputs, int) else 1
+                for i in range(n_out):
+                    entries.append((n, i))
+        return Symbol(entries)
+
+    def get_children(self):
+        if len(self._outputs) != 1:
+            raise MXNetError("get_children on multi-output symbol")
+        node = self._outputs[0][0]
+        if not node.inputs:
+            return None
+        return Symbol(list(node.inputs))
+
+    @property
+    def attr_dict(self):
+        ret = {}
+        for n in self._topo():
+            if n.attrs:
+                ret[n.name] = {k: str(v) for k, v in n.attrs.items()}
+        return ret
+
+    def attr(self, key):
+        if len(self._outputs) == 1:
+            v = self._outputs[0][0].attrs.get(key)
+            return None if v is None else str(v)
+        return None
+
+    # -- composition sugar -------------------------------------------------
+    def _binary(self, other, op, scalar_op, reverse=False):
+        if isinstance(other, Symbol):
+            a, b = (other, self) if reverse else (self, other)
+            return Symbol._create(op, [a, b], {})
+        return Symbol._create(scalar_op, [self], {"scalar": float(other)})
+
+    def __add__(self, o):
+        return self._binary(o, "broadcast_add", "_plus_scalar")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binary(o, "broadcast_sub", "_minus_scalar")
+
+    def __rsub__(self, o):
+        if isinstance(o, Symbol):
+            return o.__sub__(self)
+        return Symbol._create("_rminus_scalar", [self], {"scalar": float(o)})
+
+    def __mul__(self, o):
+        return self._binary(o, "broadcast_mul", "_mul_scalar")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binary(o, "broadcast_div", "_div_scalar")
+
+    def __rtruediv__(self, o):
+        if isinstance(o, Symbol):
+            return o.__truediv__(self)
+        return Symbol._create("_rdiv_scalar", [self], {"scalar": float(o)})
+
+    def __pow__(self, o):
+        return self._binary(o, "broadcast_power", "_power_scalar")
+
+    def __neg__(self):
+        return Symbol._create("negative", [self], {})
+
+    def __eq__(self, o):
+        return self._binary(o, "broadcast_equal", "_equal_scalar")
+
+    def __ne__(self, o):
+        return self._binary(o, "broadcast_not_equal", "_not_equal_scalar")
+
+    def __gt__(self, o):
+        return self._binary(o, "broadcast_greater", "_greater_scalar")
+
+    def __ge__(self, o):
+        return self._binary(o, "broadcast_greater_equal", "_greater_equal_scalar")
+
+    def __lt__(self, o):
+        return self._binary(o, "broadcast_lesser", "_lesser_scalar")
+
+    def __le__(self, o):
+        return self._binary(o, "broadcast_lesser_equal", "_lesser_equal_scalar")
+
+    def __hash__(self):
+        return id(self)
+
+    def __getattr__(self, name):
+        # method-style op application: sym.reshape(...), sym.sum(...)
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if _registry.exists(name):
+            def method(*args, **kwargs):
+                return Symbol._create(name, [self] + [a for a in args
+                                                      if isinstance(a, Symbol)],
+                                      {k: v for k, v in kwargs.items()})
+            return method
+        raise AttributeError(f"Symbol has no attribute {name}")
+
+    # -- shape/type inference ----------------------------------------------
+    def infer_shape(self, *args, **kwargs):
+        """Infer shapes of arguments/outputs/aux given some known shapes
+        (parity: symbol.py infer_shape). Returns (arg_shapes, out_shapes,
+        aux_shapes)."""
+        try:
+            return self._infer_shape_impl(False, *args, **kwargs)
+        except MXNetError:
+            raise
+
+    def infer_shape_partial(self, *args, **kwargs):
+        return self._infer_shape_impl(True, *args, **kwargs)
+
+    def _infer_shape_impl(self, partial, *args, **kwargs):
+        import jax
+        import jax.numpy as jnp
+
+        known = {}
+        if args:
+            for name, shape in zip(self.list_arguments(), args):
+                if shape is not None:
+                    known[name] = tuple(shape)
+        known.update({k: tuple(v) for k, v in kwargs.items() if v is not None})
+
+        shapes = {}   # (node,idx) -> shape or None
+        dtypes = {}
+        arg_names = self.list_arguments()
+        aux_names = self.list_auxiliary_states()
+        topo = self._topo()
+
+        for n in topo:
+            if n.is_variable():
+                sh = known.get(n.name)
+                if sh is None:
+                    sh_attr = n.attrs.get("__shape__")
+                    sh = tuple(sh_attr) if sh_attr else None
+                shapes[(n, 0)] = sh
+                dtypes[(n, 0)] = np_dtype(n.attrs.get("__dtype__", "float32"))
+            else:
+                op = _registry.get(n.op)
+                in_shapes = [shapes.get((src, i)) for (src, i) in n.inputs]
+                if any(s is None for s in in_shapes):
+                    # backward inference: ops with parameter inputs declare
+                    # how weight shapes follow from data shapes (role of
+                    # bidirectional FInferShape in the reference,
+                    # infer_graph_attr_pass.cc:94)
+                    rule = _PARAM_SHAPE_RULES.get(n.op)
+                    if rule is not None:
+                        filled = rule(dict(n.attrs), in_shapes)
+                        for k, s in enumerate(filled):
+                            if in_shapes[k] is None and s is not None:
+                                in_shapes[k] = tuple(s)
+                                src, i = n.inputs[k]
+                                shapes[(src, i)] = tuple(s)
+                                if (src, i) not in dtypes:
+                                    dtypes[(src, i)] = np.dtype(np.float32)
+                if any(s is None for s in in_shapes):
+                    if partial:
+                        continue
+                    missing = [src.name for (src, i) in n.inputs
+                               if shapes.get((src, i)) is None]
+                    raise MXNetError(
+                        f"cannot infer shape for node {n.name}: unknown input "
+                        f"shapes for {missing}")
+                avals = [jax.ShapeDtypeStruct(s, dtypes.get((src, i),
+                                                            np.float32))
+                         for s, (src, i) in zip(in_shapes, n.inputs)]
+                attrs = dict(n.attrs)
+                if op.is_random:
+                    import jax.random as jrandom
+                    avals = [jax.ShapeDtypeStruct((2,), np.uint32)] + avals
+                try:
+                    out = op.infer(attrs, *avals)
+                except Exception as e:
+                    if partial:
+                        continue
+                    raise MXNetError(
+                        f"shape inference failed at node {n.name} ({n.op}): {e}"
+                    ) from e
+                out_t = out if isinstance(out, (tuple, list)) else (out,)
+                for i, o in enumerate(out_t):
+                    shapes[(n, i)] = tuple(o.shape)
+                    dtypes[(n, i)] = np.dtype(o.dtype)
+
+        def var_shape(name):
+            for n in topo:
+                if n.is_variable() and n.name == name:
+                    return shapes.get((n, 0))
+            return None
+
+        arg_shapes = [var_shape(a) for a in arg_names]
+        aux_shapes = [var_shape(a) for a in aux_names]
+        out_shapes = [shapes.get(o) for o in self._outputs]
+        if not partial and any(s is None for s in arg_shapes):
+            raise MXNetError("incomplete shape information for arguments")
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_type(self, *args, **kwargs):
+        """Returns (arg_types, out_types, aux_types)."""
+        arg_names = self.list_arguments()
+        known = {}
+        if args:
+            for name, t in zip(arg_names, args):
+                if t is not None:
+                    known[name] = np_dtype(t)
+        known.update({k: np_dtype(v) for k, v in kwargs.items()
+                      if v is not None})
+        arg_types = [known.get(a, np.dtype(np.float32)) for a in arg_names]
+        out_types = [np.dtype(np.float32)] * len(self._outputs)
+        aux_types = [np.dtype(np.float32)] * len(self.list_auxiliary_states())
+        return arg_types, out_types, aux_types
+
+    # -- serde (MXNet JSON format) ------------------------------------------
+    def tojson(self):
+        """Serialize in the MXNet graph JSON format (parity: sym.tojson;
+        reference format produced by nnvm::Graph JSON pass)."""
+        topo = self._topo()
+        node_index = {id(n): i for i, n in enumerate(topo)}
+        nodes = []
+        for n in topo:
+            entry = {
+                "op": "null" if n.is_variable() else n.op,
+                "name": n.name,
+                "inputs": [[node_index[id(src)], i, 0] for (src, i) in n.inputs],
+            }
+            attrs = {k: str(v) for k, v in n.attrs.items()
+                     if not k.startswith("__")}
+            if attrs:
+                entry["attrs"] = attrs
+            nodes.append(entry)
+        arg_nodes = [i for i, n in enumerate(topo) if n.is_variable()]
+        heads = [[node_index[id(n)], i, 0] for (n, i) in self._outputs]
+        return json.dumps({
+            "nodes": nodes,
+            "arg_nodes": arg_nodes,
+            "node_row_ptr": list(range(len(nodes) + 1)),
+            "heads": heads,
+            "attrs": {"mxnet_version": ["int", 10500]},
+        }, indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # -- evaluation --------------------------------------------------------
+    def bind(self, ctx, args, args_grad=None, grad_req="write", aux_states=None,
+             group2ctx=None, shared_exec=None):
+        from .executor import Executor
+        return Executor(self, ctx, args, args_grad, grad_req, aux_states)
+
+    def simple_bind(self, ctx, grad_req="write", type_dict=None,
+                    stype_dict=None, group2ctx=None, shared_arg_names=None,
+                    shared_exec=None, shared_buffer=None, **kwargs):
+        """Allocate arguments automatically and bind
+        (parity: symbol.py simple_bind → GraphExecutor::Init)."""
+        from .. import ndarray as nd
+        from .executor import Executor
+        arg_shapes, _, aux_shapes = self.infer_shape(**kwargs)
+        arg_names = self.list_arguments()
+        aux_names = self.list_auxiliary_states()
+        type_dict = type_dict or {}
+        args = {}
+        args_grad = {}
+        for name, shape in zip(arg_names, arg_shapes):
+            dtype = type_dict.get(name, np.float32)
+            args[name] = nd.zeros(shape, ctx=ctx, dtype=dtype)
+            if grad_req != "null":
+                args_grad[name] = nd.zeros(shape, ctx=ctx, dtype=dtype)
+        aux_states = {name: nd.zeros(shape, ctx=ctx)
+                      for name, shape in zip(aux_names, aux_shapes)}
+        return Executor(self, ctx, args, args_grad or None, grad_req,
+                        aux_states)
+
+    def bind_dict(self, ctx, arg_dict, grad_req="null"):
+        """Convenience: bind with a name->NDArray dict covering all inputs."""
+        from .executor import Executor
+        return Executor(self, ctx, arg_dict, None, grad_req, None)
+
+    def eval(self, ctx=None, **kwargs):
+        from ..context import current_context
+        ctx = ctx or current_context()
+        ex = self.bind_dict(ctx, kwargs)
+        return ex.forward()
+
+    def tostype(self, stype):
+        if stype == "default":
+            return self
+        raise NotImplementedError("sparse symbol storage conversion")
+
+
+def _fc_shapes(attrs, in_shapes):
+    data = in_shapes[0]
+    if data is None:
+        return in_shapes
+    nh = int(attrs["num_hidden"])
+    flatten = bool(attrs.get("flatten", True))
+    in_units = int(np.prod(data[1:])) if flatten else data[-1]
+    out = [data, (nh, in_units)]
+    if len(in_shapes) > 2:
+        out.append((nh,))
+    return out
+
+
+def _conv_shapes(attrs, in_shapes):
+    data = in_shapes[0]
+    if data is None:
+        return in_shapes
+    nf = int(attrs["num_filter"])
+    groups = int(attrs.get("num_group", 1))
+    kernel = tuple(attrs["kernel"])
+    layout = attrs.get("layout") or ("NCW", "NCHW", "NCDHW")[len(kernel) - 1]
+    c = data[layout.find("C")]
+    out = [data, (nf, c // groups) + kernel]
+    if len(in_shapes) > 2:
+        out.append((nf,))
+    return out
+
+
+def _deconv_shapes(attrs, in_shapes):
+    data = in_shapes[0]
+    if data is None:
+        return in_shapes
+    nf = int(attrs["num_filter"])
+    groups = int(attrs.get("num_group", 1))
+    kernel = tuple(attrs["kernel"])
+    layout = attrs.get("layout") or ("NCW", "NCHW", "NCDHW")[len(kernel) - 1]
+    c = data[layout.find("C")]
+    out = [data, (c, nf // groups) + kernel]
+    if len(in_shapes) > 2:
+        out.append((nf,))
+    return out
+
+
+def _norm_shapes(attrs, in_shapes):
+    data = in_shapes[0]
+    if data is None:
+        return in_shapes
+    axis = int(attrs.get("axis", 1))
+    c = data[axis % len(data)]
+    return [data] + [(c,)] * (len(in_shapes) - 1)
+
+
+def _layernorm_shapes(attrs, in_shapes):
+    data = in_shapes[0]
+    if data is None:
+        return in_shapes
+    axis = int(attrs.get("axis", -1))
+    c = data[axis % len(data)]
+    return [data] + [(c,)] * (len(in_shapes) - 1)
+
+
+def _embedding_shapes(attrs, in_shapes):
+    return [in_shapes[0],
+            (int(attrs["input_dim"]), int(attrs["output_dim"]))]
+
+
+def _rnn_shapes(attrs, in_shapes):
+    data = in_shapes[0]
+    if data is None:
+        return in_shapes
+    from ..ops._op_nn import rnn_param_size
+    mode = attrs["mode"]
+    hidden = int(attrs["state_size"])
+    layers = int(attrs["num_layers"])
+    bidir = bool(attrs.get("bidirectional", False))
+    dirs = 2 if bidir else 1
+    T, N, I = data
+    psize = rnn_param_size(mode, layers, I, hidden, bidir)
+    out = [data, (psize,), (layers * dirs, N, hidden)]
+    if len(in_shapes) > 3:
+        out.append((layers * dirs, N, hidden))
+    return out
+
+
+def _prelu_shapes(attrs, in_shapes):
+    data = in_shapes[0]
+    if data is None or len(in_shapes) < 2:
+        return in_shapes
+    return [data, (data[1] if len(data) > 1 else 1,)]
+
+
+_PARAM_SHAPE_RULES = {
+    "FullyConnected": _fc_shapes,
+    "Convolution": _conv_shapes,
+    "Deconvolution": _deconv_shapes,
+    "BatchNorm": _norm_shapes,
+    "InstanceNorm": _norm_shapes,
+    "GroupNorm": lambda attrs, s: _norm_shapes({**attrs, "axis": 1}, s),
+    "LayerNorm": _layernorm_shapes,
+    "Embedding": _embedding_shapes,
+    "RNN": _rnn_shapes,
+    "LeakyReLU": _prelu_shapes,
+}
+
+
+def var(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None,
+        init=None, stype=None, **kwargs):
+    """Create a variable symbol (parity: symbol.py var/Variable)."""
+    if not isinstance(name, str):
+        raise TypeError("Expect a string for variable `name`")
+    attrs = dict(attr or {})
+    if shape is not None:
+        attrs["__shape__"] = tuple(shape)
+    if dtype is not None:
+        attrs["__dtype__"] = np_dtype(dtype).name
+    if lr_mult is not None:
+        attrs["__lr_mult__"] = lr_mult
+    if wd_mult is not None:
+        attrs["__wd_mult__"] = wd_mult
+    if init is not None:
+        if not isinstance(init, str):
+            init = init.dumps()
+        attrs["__init__"] = init
+    attrs.update(kwargs)
+    node = _SymNode(None, name, attrs)
+    return Symbol([(node, 0)])
+
+
+Variable = var
+
+
+def Group(symbols):
+    """Create a symbol grouping outputs of `symbols` (parity: sym.Group)."""
+    entries = []
+    for s in symbols:
+        entries.extend(s._outputs)
+    return Symbol(entries)
+
+
+def load_json(json_str):
+    """Load symbol from MXNet graph JSON (parity: sym.load_json; also reads
+    reference-produced files — format from nnvm JSON pass)."""
+    data = json.loads(json_str)
+    raw_nodes = data["nodes"]
+    nodes = []
+    for entry in raw_nodes:
+        op = entry["op"]
+        attrs = dict(entry.get("attrs", entry.get("attr", {}) or {}))
+        parsed_attrs = {k: _parse_attr_value(v) for k, v in attrs.items()}
+        node = _SymNode(None if op == "null" else op, entry["name"],
+                        parsed_attrs)
+        node.inputs = [(nodes[src], out_i)
+                       for src, out_i, *_ in entry.get("inputs", [])]
+        nodes.append(node)
+    heads = [(nodes[i], out_i) for i, out_i, *_ in data["heads"]]
+    return Symbol(heads)
+
+
+def _parse_attr_value(v):
+    """Parse MXNet string attr values: '(3, 3)' → tuple, 'True' → bool, …"""
+    if not isinstance(v, str):
+        return v
+    s = v.strip()
+    low = s.lower()
+    if low in ("true", "false"):
+        return low == "true"
+    if low in ("none", "null"):
+        return None
+    try:
+        return int(s)
+    except ValueError:
+        pass
+    try:
+        return float(s)
+    except ValueError:
+        pass
+    if s.startswith("(") and s.endswith(")") or \
+            s.startswith("[") and s.endswith("]"):
+        inner = s[1:-1].strip()
+        if not inner:
+            return ()
+        try:
+            return tuple(_parse_attr_value(x) for x in inner.split(","))
+        except Exception:
+            return s
+    return s
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+def zeros(shape, dtype=None, **kwargs):
+    return Symbol._create("_zeros", [], {"shape": tuple(shape),
+                                         "dtype": np_dtype(dtype or "float32").name})
+
+
+def ones(shape, dtype=None, **kwargs):
+    return Symbol._create("_ones", [], {"shape": tuple(shape),
+                                        "dtype": np_dtype(dtype or "float32").name})
+
+
+def arange(start, stop=None, step=1.0, repeat=1, name=None, dtype=None):
+    return Symbol._create("_arange", [], {
+        "start": start, "stop": stop, "step": step, "repeat": repeat,
+        "dtype": np_dtype(dtype or "float32").name}, name=name)

@@ -3,13 +3,28 @@
 Forces an 8-device virtual CPU mesh (parity with the reference's strategy of
 running the whole unit suite per backend, SURVEY.md §4): sharding/collective
 tests exercise real multi-device code paths without TPU hardware.
+
+The axon TPU plugin (registered at interpreter startup via sitecustomize)
+is unregistered here: unit tests are CPU-only by design, and initializing
+the axon client adds a network roundtrip per backend init (and hangs the
+suite outright if the TPU tunnel is down).
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+try:  # drop the axon TPU plugin before any backend initializes
+    import jax
+    import jax._src.xla_bridge as _xb
+    _xb._backend_factories.pop("axon", None)
+    # sitecustomize imported jax before this file ran, so the env var was
+    # captured already — update the live config too
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,368 @@
+"""Gluon Block/Parameter/Trainer/layers tests.
+
+Parity with reference tests/python/unittest/test_gluon.py (2805 LoC): layer
+forward shapes vs expectation, parameter management, save/load round-trips,
+hybridize consistency, trainer updates.
+"""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+
+def test_parameter():
+    p = gluon.Parameter("weight", shape=(10, 10))
+    p.initialize(init="xavier", ctx=[mx.cpu(0)])
+    assert len(p.list_data()) == 1
+    assert len(p.list_grad()) == 1
+    assert p.data(mx.cpu(0)).ctx == mx.cpu(0)
+    assert p.data().shape == (10, 10)
+    p.reset_ctx(ctx=[mx.cpu(0)])
+    assert p.list_ctx() == [mx.cpu(0)]
+
+
+def test_paramdict():
+    params = gluon.ParameterDict("net_")
+    params.get("weight", shape=(10, 10))
+    assert list(params.keys()) == ["net_weight"]
+    params.initialize(ctx=mx.cpu())
+    with tempfile.TemporaryDirectory() as d:
+        fname = os.path.join(d, "test.params")
+        params.save(fname)
+        params.load(fname, mx.cpu())
+
+
+def test_constant():
+    class Test(gluon.HybridBlock):
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            self.value = np.asarray([[1, 2], [3, 4]], dtype="float32")
+            self.const = self.params.get_constant("const", self.value)
+
+        def hybrid_forward(self, F, x, const):
+            return x + const
+
+    test = Test()
+    test.initialize()
+    trainer = gluon.Trainer(test.collect_params(), "sgd",
+                            {"learning_rate": 1.0, "momentum": 0.5})
+    with autograd.record():
+        x = mx.nd.ones((2, 2))
+        x.attach_grad()
+        y = test(x)
+        y.backward()
+    trainer.step(1)
+    assert (test.const.data().asnumpy() == test.value).all()
+    assert (x.grad.asnumpy() == 1).all()
+
+
+def test_basic():
+    model = nn.Sequential()
+    model.add(nn.Dense(128, activation="tanh", in_units=10, flatten=False))
+    model.add(nn.Dropout(0.5))
+    model.add(nn.Dense(64, activation="tanh", in_units=256))
+    model.add(nn.Dense(32, in_units=64))
+    model.add(nn.Activation("relu"))
+
+    # ndarray
+    model.initialize(mx.initializer.Xavier(magnitude=2.24))
+    x = mx.nd.zeros((32, 2, 10))
+    out = model(x)
+    assert out.shape == (32, 32)
+
+    model.collect_params().setattr("grad_req", "null")
+    assert list(model.collect_params().values())[0]._grad is None
+    model.collect_params().setattr("grad_req", "write")
+    assert list(model.collect_params().values())[0]._grad is not None
+
+
+def test_dense():
+    model = nn.Dense(128, activation="tanh", in_units=10, flatten=False,
+                     prefix="test_")
+    inputs = mx.nd.zeros((2, 3, 10))
+    model.initialize()
+    outputs = model(inputs)
+    assert {p.name for p in model.collect_params().values()} == \
+        {"test_weight", "test_bias"}
+    assert outputs.shape == (2, 3, 128)
+
+    model = nn.Dense(128, activation="relu", in_units=30, flatten=True,
+                     prefix="test2_")
+    inputs = mx.nd.zeros((17, 2, 5, 3))
+    model.initialize()
+    outputs = model(inputs)
+    assert outputs.shape == (17, 128)
+
+
+def test_dense_deferred_shape():
+    model = nn.Dense(16)
+    model.initialize()
+    x = mx.nd.ones((4, 7))
+    out = model(x)
+    assert out.shape == (4, 16)
+    assert model.weight.shape == (16, 7)
+
+
+@pytest.mark.parametrize("layer,shape,expected", [
+    (lambda: nn.Conv2D(16, (3, 3), in_channels=4), (2, 4, 10, 10), (2, 16, 8, 8)),
+    (lambda: nn.Conv2D(16, (3, 3), padding=(1, 1), in_channels=4),
+     (2, 4, 10, 10), (2, 16, 10, 10)),
+    (lambda: nn.Conv2D(16, (3, 3), strides=2, in_channels=4),
+     (2, 4, 10, 10), (2, 16, 4, 4)),
+    (lambda: nn.Conv2D(16, (3, 3), groups=2, in_channels=4),
+     (2, 4, 10, 10), (2, 16, 8, 8)),
+    (lambda: nn.Conv1D(16, 3, in_channels=4), (2, 4, 10), (2, 16, 8)),
+    (lambda: nn.Conv3D(16, (3, 3, 3), in_channels=4), (2, 4, 8, 8, 8),
+     (2, 16, 6, 6, 6)),
+    (lambda: nn.MaxPool2D(2), (2, 4, 10, 10), (2, 4, 5, 5)),
+    (lambda: nn.AvgPool2D(2), (2, 4, 10, 10), (2, 4, 5, 5)),
+    (lambda: nn.GlobalAvgPool2D(), (2, 4, 10, 10), (2, 4, 1, 1)),
+    (lambda: nn.GlobalMaxPool2D(), (2, 4, 10, 10), (2, 4, 1, 1)),
+    (lambda: nn.Conv2DTranspose(16, (3, 3), in_channels=4), (2, 4, 10, 10),
+     (2, 16, 12, 12)),
+    (lambda: nn.Conv2DTranspose(16, (3, 3), strides=2, output_padding=1,
+                                in_channels=4), (2, 4, 10, 10),
+     (2, 16, 22, 22)),
+])
+def test_layer_shapes(layer, shape, expected):
+    l = layer()
+    l.initialize()
+    x = mx.nd.random.uniform(shape=shape)
+    out = l(x)
+    assert out.shape == expected, (out.shape, expected)
+
+
+def test_conv_vs_numpy():
+    """Conv2D forward against explicit numpy convolution."""
+    l = nn.Conv2D(2, (3, 3), in_channels=3, use_bias=False)
+    l.initialize(mx.initializer.Xavier())
+    x = mx.nd.random.uniform(shape=(1, 3, 5, 5))
+    out = l(x).asnumpy()
+    w = l.weight.data().asnumpy()
+    xn = x.asnumpy()
+    ref = np.zeros((1, 2, 3, 3), dtype=np.float32)
+    for o in range(2):
+        for i in range(3):
+            for hh in range(3):
+                for ww in range(3):
+                    ref[0, o, hh, ww] += np.sum(
+                        xn[0, i, hh:hh + 3, ww:ww + 3] * w[o, i])
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_batchnorm_running_stats():
+    bn = nn.BatchNorm(in_channels=4)
+    bn.initialize()
+    x = mx.nd.random.normal(1.5, 2.0, shape=(8, 4, 3, 3))
+    with autograd.record():
+        bn(x)
+    rm = bn.running_mean.data().asnumpy()
+    assert not np.allclose(rm, np.zeros(4)), "running mean should update"
+    # inference mode: uses running stats, output not normalized to 0 mean
+    out = bn(x)
+    assert out.shape == x.shape
+
+
+def test_layernorm_values():
+    ln = nn.LayerNorm(in_channels=5)
+    ln.initialize()
+    x = mx.nd.random.uniform(shape=(3, 5))
+    out = ln(x).asnumpy()
+    xn = x.asnumpy()
+    ref = (xn - xn.mean(-1, keepdims=True)) / np.sqrt(
+        xn.var(-1, keepdims=True) + 1e-5)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_embedding():
+    layer = nn.Embedding(10, 100)
+    layer.initialize()
+    x = mx.nd.array([3, 4, 2, 0])
+    y = layer(x)
+    assert y.shape == (4, 100)
+    with autograd.record():
+        y = layer(x)
+        loss = y.sum()
+    loss.backward()
+    grad = layer.weight.grad().asnumpy()
+    assert np.allclose(grad[[3, 4, 2, 0]], np.ones((4, 100)))
+    assert np.allclose(grad[[1, 5, 6, 7, 8, 9]], 0)
+
+
+def test_hybrid_consistency():
+    """Hybridized and imperative outputs must match (inference mode)."""
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(8, 3, padding=1), nn.BatchNorm(),
+                nn.Activation("relu"), nn.MaxPool2D(2), nn.Flatten(),
+                nn.Dense(10))
+    net.initialize()
+    x = mx.nd.random.uniform(shape=(2, 3, 8, 8))
+    out_imp = net(x).asnumpy()
+    net.hybridize()
+    out_hyb = net(x).asnumpy()
+    np.testing.assert_allclose(out_imp, out_hyb, rtol=1e-5, atol=1e-5)
+
+
+def test_hybrid_grad_consistency():
+    """Gradients through the CachedOp (hybridized) match imperative ones."""
+    def build():
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+        return net
+
+    x = mx.nd.random.uniform(shape=(3, 8))
+    net1 = build()
+    net1.initialize(mx.initializer.Constant(0.05))
+    with autograd.record():
+        l1 = (net1(x) ** 2).sum()
+    l1.backward()
+    g1 = {k: v.grad().asnumpy() for k, v in net1.collect_params().items()}
+
+    net2 = build()
+    net2.initialize(mx.initializer.Constant(0.05))
+    net2.hybridize()
+    with autograd.record():
+        l2 = (net2(x) ** 2).sum()
+    l2.backward()
+    g2 = {k: v.grad().asnumpy() for k, v in net2.collect_params().items()}
+    for (k1, a), (k2, b) in zip(sorted(g1.items()), sorted(g2.items())):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+def test_trainer_updates():
+    net = nn.Dense(1, in_units=2)
+    net.initialize(mx.initializer.Constant(0.5))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 1.0})
+    x = mx.nd.array([[1.0, 2.0]])
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    w_before = net.weight.data().asnumpy().copy()
+    trainer.step(1)
+    w_after = net.weight.data().asnumpy()
+    np.testing.assert_allclose(w_before - np.array([[1.0, 2.0]]), w_after,
+                               rtol=1e-5)
+
+
+def test_trainer_lr_scheduler():
+    from mxnet_tpu.lr_scheduler import FactorScheduler
+    net = nn.Dense(1, in_units=2)
+    net.initialize()
+    sched = FactorScheduler(step=1, factor=0.5, base_lr=1.0)
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 1.0, "lr_scheduler": sched})
+    x = mx.nd.ones((1, 2))
+    for _ in range(3):
+        with autograd.record():
+            loss = net(x).sum()
+        loss.backward()
+        trainer.step(1)
+    assert trainer.learning_rate < 1.0
+
+
+def test_save_load_parameters():
+    net = nn.HybridSequential(prefix="model_")
+    with net.name_scope():
+        net.add(nn.Dense(8, in_units=4), nn.Dense(2, in_units=8))
+    net.initialize(mx.initializer.Xavier())
+    x = mx.nd.random.uniform(shape=(2, 4))
+    out1 = net(x).asnumpy()
+    with tempfile.TemporaryDirectory() as d:
+        fname = os.path.join(d, "net.params")
+        net.save_parameters(fname)
+        net2 = nn.HybridSequential(prefix="model_")
+        with net2.name_scope():
+            net2.add(nn.Dense(8, in_units=4), nn.Dense(2, in_units=8))
+        net2.load_parameters(fname)
+        out2 = net2(x).asnumpy()
+    np.testing.assert_allclose(out1, out2, rtol=1e-6)
+
+
+def test_losses():
+    pred = mx.nd.random.uniform(shape=(5, 4))
+    label_cls = mx.nd.array([0, 1, 2, 3, 0])
+    label_reg = mx.nd.random.uniform(shape=(5, 4))
+
+    l = gluon.loss.SoftmaxCrossEntropyLoss()(pred, label_cls)
+    assert l.shape == (5,)
+    ref = -np.log(
+        np.exp(pred.asnumpy()) /
+        np.exp(pred.asnumpy()).sum(-1, keepdims=True))[
+            np.arange(5), label_cls.asnumpy().astype(int)]
+    np.testing.assert_allclose(l.asnumpy(), ref, rtol=1e-4, atol=1e-5)
+
+    l2 = gluon.loss.L2Loss()(pred, label_reg)
+    ref2 = 0.5 * ((pred.asnumpy() - label_reg.asnumpy()) ** 2).mean(-1)
+    np.testing.assert_allclose(l2.asnumpy(), ref2, rtol=1e-4, atol=1e-6)
+
+    l1 = gluon.loss.L1Loss()(pred, label_reg)
+    ref1 = np.abs(pred.asnumpy() - label_reg.asnumpy()).mean(-1)
+    np.testing.assert_allclose(l1.asnumpy(), ref1, rtol=1e-4, atol=1e-6)
+
+    bce = gluon.loss.SigmoidBinaryCrossEntropyLoss()
+    lbce = bce(pred, (label_reg > 0.5).astype("float32"))
+    assert lbce.shape == (5,)
+
+    hl = gluon.loss.HuberLoss()(pred, label_reg)
+    assert hl.shape == (5,)
+
+    hinge = gluon.loss.HingeLoss()(pred, (label_reg > 0.5) * 2 - 1)
+    assert hinge.shape == (5,)
+
+    kl = gluon.loss.KLDivLoss(from_logits=False)(
+        pred, mx.nd.softmax(label_reg))
+    assert kl.shape == (5,)
+
+
+def test_sequential_slicing():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4), nn.Dense(3), nn.Dense(2))
+    assert len(net) == 3
+    assert isinstance(net[1], nn.Dense)
+    sub = net[0:2]
+    assert len(sub) == 2
+
+
+def test_block_attr_registration():
+    class Model(gluon.Block):
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            with self.name_scope():
+                self.dense0 = nn.Dense(5, in_units=5)
+                self.dense1 = nn.Dense(5, in_units=5)
+
+        def forward(self, x):
+            return self.dense1(self.dense0(x))
+
+    model = Model()
+    assert len(model._children) == 2
+    names = set(model.collect_params().keys())
+    assert len(names) == 4
+    model.initialize()
+    out = model(mx.nd.zeros((2, 5)))
+    assert out.shape == (2, 5)
+
+
+def test_global_norm_clip():
+    x1 = mx.nd.ones((3, 3))
+    x2 = mx.nd.ones((4, 4))
+    norm = gluon.utils.clip_global_norm([x1, x2], 1.0)
+    assert norm == pytest.approx(5.0, rel=1e-4)
+    assert x1.asnumpy().max() < 0.3
+
+
+def test_split_and_load():
+    data = mx.nd.arange(16).reshape((8, 2))
+    splits = gluon.utils.split_and_load(data, [mx.cpu(0)])
+    assert len(splits) == 1
+    splits = gluon.utils.split_data(data, 4)
+    assert len(splits) == 4
+    assert splits[0].shape == (2, 2)

@@ -1,0 +1,170 @@
+"""Symbol / Executor tests (parity: reference tests/python/unittest/
+test_symbol.py + test_executor.py strategy: compose, infer, bind, JSON serde,
+forward vs ndarray results, backward vs autograd)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+import mxnet_tpu.symbol as sym
+
+
+def test_compose_and_list():
+    data = sym.var("data")
+    net = sym.FullyConnected(data, sym.var("fc1_weight"), sym.var("fc1_bias"),
+                             num_hidden=10, name="fc1")
+    net = sym.relu(net, name="relu0")
+    net = sym.FullyConnected(net, sym.var("fc2_weight"), sym.var("fc2_bias"),
+                             num_hidden=4, name="fc2")
+    assert net.list_arguments() == [
+        "data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias"]
+    assert net.list_outputs() == ["fc2_output"]
+    assert net.name == "fc2"
+
+
+def test_infer_shape():
+    data = sym.var("data")
+    out = sym.FullyConnected(data, sym.var("w"), sym.var("b"), num_hidden=7)
+    arg_shapes, out_shapes, _ = out.infer_shape(data=(5, 3))
+    assert arg_shapes == [(5, 3), (7, 3), (7,)]
+    assert out_shapes == [(5, 7)]
+
+
+def test_infer_shape_conv():
+    data = sym.var("data")
+    out = sym.Convolution(data, sym.var("w"), sym.var("b"), kernel=(3, 3),
+                          num_filter=8, pad=(1, 1))
+    arg_shapes, out_shapes, _ = out.infer_shape(data=(2, 3, 10, 10))
+    assert arg_shapes[1] == (8, 3, 3, 3)
+    assert out_shapes == [(2, 8, 10, 10)]
+
+
+def test_executor_forward_backward():
+    data = sym.var("data")
+    w = sym.var("w")
+    out = sym.FullyConnected(data, w, sym.var("b"), num_hidden=4)
+    out = (out ** 2).sum()
+    ex = out.simple_bind(mx.cpu(), data=(2, 3))
+    xv = np.random.randn(2, 3).astype(np.float32)
+    wv = np.random.randn(4, 3).astype(np.float32)
+    ex.arg_dict["data"][:] = xv
+    ex.arg_dict["w"][:] = wv
+    res = ex.forward(is_train=True)[0]
+    ref = ((xv @ wv.T) ** 2).sum()
+    np.testing.assert_allclose(res.asscalar(), ref, rtol=1e-4)
+    ex.backward()
+    # numeric gradient check on w
+    eps = 1e-3
+    gw = ex.grad_dict["w"].asnumpy()
+    for i in range(2):
+        wp = wv.copy(); wp[0, i] += eps
+        wm = wv.copy(); wm[0, i] -= eps
+        num = (((xv @ wp.T) ** 2).sum() - ((xv @ wm.T) ** 2).sum()) / (2 * eps)
+        np.testing.assert_allclose(gw[0, i], num, rtol=1e-2, atol=1e-2)
+
+
+def test_grad_req_add_and_null():
+    data = sym.var("data")
+    out = (data * 2.0).sum()
+    import mxnet_tpu.ndarray as nd
+    args = {"data": nd.ones((3,))}
+    grads = {"data": nd.zeros((3,))}
+    ex = out.bind(mx.cpu(), args, args_grad=grads, grad_req="add")
+    ex.forward(is_train=True)
+    ex.backward()
+    ex.forward(is_train=True)
+    ex.backward()
+    np.testing.assert_allclose(grads["data"].asnumpy(), np.full(3, 4.0))
+
+
+def test_json_roundtrip():
+    data = sym.var("data")
+    out = sym.Activation(
+        sym.FullyConnected(data, sym.var("w"), sym.var("b"), num_hidden=4,
+                           name="fc"), act_type="relu", name="act")
+    js = out.tojson()
+    out2 = sym.load_json(js)
+    assert out2.list_arguments() == out.list_arguments()
+    assert out2.list_outputs() == out.list_outputs()
+    ex = out2.simple_bind(mx.cpu(), data=(1, 6))
+    assert ex.forward()[0].shape == (1, 4)
+
+
+def test_symbol_save_load(tmp_path):
+    out = sym.softmax(sym.FullyConnected(
+        sym.var("data"), sym.var("w"), sym.var("b"), num_hidden=3))
+    fname = str(tmp_path / "sym.json")
+    out.save(fname)
+    loaded = sym.load(fname)
+    assert loaded.list_arguments() == out.list_arguments()
+
+
+def test_get_internals():
+    data = sym.var("data")
+    fc1 = sym.FullyConnected(data, sym.var("w1"), sym.var("b1"), num_hidden=5,
+                             name="fc1")
+    fc2 = sym.FullyConnected(fc1, sym.var("w2"), sym.var("b2"), num_hidden=2,
+                             name="fc2")
+    internals = fc2.get_internals()
+    assert "fc1_output" in internals.list_outputs()
+    fc1_out = internals["fc1_output"]
+    assert fc1_out.list_arguments() == ["data", "w1", "b1"]
+
+
+def test_group():
+    a = sym.var("a")
+    b = sym.var("b")
+    g = sym.Group([a + b, a * b])
+    assert len(g.list_outputs()) == 2
+    ex = g.bind_dict(mx.cpu(), {
+        "a": mx.nd.array([2.0]), "b": mx.nd.array([3.0])})
+    outs = ex.forward()
+    assert outs[0].asscalar() == 5.0
+    assert outs[1].asscalar() == 6.0
+
+
+def test_symbolic_batchnorm_aux():
+    """BatchNorm under the executor updates aux states on train forward."""
+    data = sym.var("data")
+    g = sym.var("gamma")
+    be = sym.var("beta")
+    mm = sym.var("mean"); mm._outputs[0][0].attrs["__is_aux__"] = True
+    mv = sym.var("var"); mv._outputs[0][0].attrs["__is_aux__"] = True
+    out = sym.BatchNorm(data, g, be, mm, mv, fix_gamma=False)
+    assert out.list_auxiliary_states() == ["mean", "var"]
+    ex = out.simple_bind(mx.cpu(), data=(4, 3))
+    ex.arg_dict["data"][:] = np.random.randn(4, 3) * 3 + 1
+    ex.arg_dict["gamma"][:] = 1
+    ex.aux_dict["var"][:] = 1
+    ex.forward(is_train=True)
+    assert not np.allclose(ex.aux_dict["mean"].asnumpy(), 0)
+
+
+def test_gluon_symbolic_trace_and_export(tmp_path):
+    net = nn.HybridSequential(prefix="m_")
+    with net.name_scope():
+        net.add(nn.Conv2D(4, 3, padding=1), nn.BatchNorm(),
+                nn.Activation("relu"), nn.Flatten(), nn.Dense(3))
+    net.initialize()
+    x = mx.nd.random.uniform(shape=(2, 3, 6, 6))
+    ref = net(x).asnumpy()
+    path = str(tmp_path / "model")
+    net.export(path, epoch=3)
+    assert os.path.exists(path + "-symbol.json")
+    assert os.path.exists(path + "-0003.params")
+    net2 = gluon.SymbolBlock.imports(path + "-symbol.json", ["data"],
+                                     path + "-0003.params")
+    got = net2(x).asnumpy()
+    np.testing.assert_allclose(ref, got, rtol=1e-4, atol=1e-5)
+
+
+def test_executor_reshape():
+    out = sym.FullyConnected(sym.var("data"), sym.var("w"), sym.var("b"),
+                             num_hidden=4)
+    ex = out.simple_bind(mx.cpu(), data=(2, 6))
+    ex2 = ex.reshape(data=(5, 6))
+    assert ex2.forward()[0].shape == (5, 4)
