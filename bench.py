@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Headline benchmark: ResNet-50 ImageNet-shape training throughput.
+
+Baseline (BASELINE.md / docs/faq/perf.md:231-243 of the reference):
+ResNet-50 train @ bs32 fp32 on 1x V100 = 298.51 img/s.
+
+This bench runs the SAME model/batch on one TPU chip with the TPU-idiomatic
+recipe: whole train step (fwd+bwd+SGD-momentum update) compiled to one XLA
+program, bf16 compute with fp32 master weights & BatchNorm statistics.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import os
+import sys
+import time
+
+BASELINE_IMG_S = 298.51
+BATCH = 32
+
+
+def main():
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.parallel.spmd import functionalize
+    from mxnet_tpu.ops import registry as _registry
+    from mxnet_tpu import random as _random
+
+    dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
+    n_warm = int(os.environ.get("BENCH_WARMUP", 3))
+    n_iter = int(os.environ.get("BENCH_ITERS", 20))
+
+    net = vision.resnet50_v1()
+    net.initialize(mx.initializer.Xavier())
+
+    x_ex = mx.nd.zeros((BATCH, 3, 224, 224))
+    y_np = np.random.randint(0, 1000, (BATCH,)).astype(np.float32)
+
+    apply_fn, param_arrays, names = functionalize(net, x_ex)
+    # fp32 master weights; bf16 compute for conv/matmul params (
+    # BatchNorm/bias vectors stay fp32 — standard TPU mixed precision)
+    compute_dtype = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+
+    momentum = 0.9
+    lr = 0.1
+    sgd_attrs = {"lr": lr, "wd": 1e-4, "momentum": momentum,
+                 "rescale_grad": 1.0}
+    sgd_mom = _registry.get("sgd_mom_update").fcompute
+
+    def cast_params(params):
+        return tuple(
+            p.astype(compute_dtype) if p.ndim > 1 else p for p in params)
+
+    def step(key, params, moms, x, y):
+        def loss_fn(ps):
+            outs, mutated = apply_fn(key, cast_params(ps), (x,))
+            logits = outs[0].astype(jnp.float32)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            oh = jax.nn.one_hot(y.astype(jnp.int32), 1000)
+            return -(oh * logp).sum(axis=-1).mean(), mutated
+
+        (loss, mutated), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params)
+        new_params, new_moms = [], []
+        for w, g, m in zip(params, grads, moms):
+            nw, nm = sgd_mom(sgd_attrs, w, g.astype(w.dtype), m)
+            new_params.append(nw)
+            new_moms.append(nm)
+        return tuple(new_params), tuple(new_moms), loss
+
+    step_jit = jax.jit(step, donate_argnums=(1, 2))
+
+    params = tuple(jnp.asarray(a) for a in param_arrays)
+    moms = tuple(jnp.zeros_like(p) for p in params)
+    x = jnp.asarray(np.random.randn(BATCH, 3, 224, 224).astype(np.float32)
+                    ).astype(compute_dtype)
+    y = jnp.asarray(y_np)
+
+    key = _random.next_key()
+    for _ in range(n_warm):
+        params, moms, loss = step_jit(key, params, moms, x, y)
+    loss.block_until_ready()
+
+    t0 = time.perf_counter()
+    for _ in range(n_iter):
+        params, moms, loss = step_jit(key, params, moms, x, y)
+    loss.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    img_s = BATCH * n_iter / dt
+    print(json.dumps({
+        "metric": "resnet50_train_img_per_sec_bs32",
+        "value": round(img_s, 2),
+        "unit": "img/s",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

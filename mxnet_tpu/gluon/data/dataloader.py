@@ -1,0 +1,160 @@
+"""DataLoader (parity: python/mxnet/gluon/data/dataloader.py).
+
+Reference architecture: fork workers + cpu_shared-storage NDArray rebuild via
+a custom ForkingPickler (dataloader.py:55-120). TPU redesign: workers run in
+a multiprocessing.Pool producing numpy batches (picklable, zero-copy via OS
+pipes is unnecessary since batches transfer host→HBM anyway), with an
+in-flight prefetch window so host decode overlaps device compute. Batchify
+returns NDArrays on cpu; the training loop (or TrainStep) moves them to the
+device mesh.
+"""
+from __future__ import annotations
+
+import multiprocessing
+import sys
+
+import numpy as np
+
+from ... import ndarray as nd
+from ...ndarray import NDArray
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (parity: dataloader.py default_batchify_fn)."""
+    if isinstance(data[0], NDArray):
+        return nd.stack(*data)
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(i) for i in data]
+    data = np.asarray(data)
+    return nd.array(data, dtype=data.dtype)
+
+
+def default_mp_batchify_fn(data):
+    """Worker-side batchify: stays in numpy (crosses the process boundary
+    as plain buffers; the reference rebuilds into cpu_shared NDArrays)."""
+    if isinstance(data[0], NDArray):
+        return np.stack([d.asnumpy() for d in data])
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_mp_batchify_fn(i) for i in data]
+    return np.asarray(data)
+
+
+_worker_dataset = None
+
+
+def _worker_initializer(dataset):
+    global _worker_dataset
+    _worker_dataset = dataset
+
+
+def _worker_fn(samples, batchify_fn, dataset=None):
+    """Worker target: fetch samples, batchify to numpy."""
+    global _worker_dataset
+    ds = dataset if dataset is not None else _worker_dataset
+    batch = batchify_fn([ds[i] for i in samples])
+    return batch
+
+
+def _as_nd(batch):
+    if isinstance(batch, (list, tuple)):
+        return [_as_nd(b) for b in batch]
+    if isinstance(batch, NDArray):
+        return batch
+    return nd.array(batch)
+
+
+class DataLoader:
+    """Loads data from a Dataset, returns mini-batches
+    (parity: dataloader.py DataLoader)."""
+
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, pin_device_id=0,
+                 prefetch=None, thread_pool=False):
+        self._dataset = dataset
+        self._pin_memory = pin_memory  # staging is XLA-managed; accepted
+
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError(
+                    "batch_size must be specified unless batch_sampler is "
+                    "specified")
+            if sampler is None:
+                if shuffle:
+                    sampler = RandomSampler(len(dataset))
+                else:
+                    sampler = SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError(
+                    "shuffle must not be specified if sampler is specified")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch if last_batch else "keep")
+        elif batch_size is not None or shuffle or sampler is not None or \
+                last_batch is not None:
+            raise ValueError(
+                "batch_size, shuffle, sampler and last_batch must not be "
+                "specified if batch_sampler is specified.")
+
+        self._batch_sampler = batch_sampler
+        self._num_workers = num_workers if num_workers >= 0 else 0
+        self._prefetch = max(0, int(prefetch) if prefetch is not None
+                             else 2 * self._num_workers)
+        if batchify_fn is None:
+            if num_workers > 0:
+                self._batchify_fn = default_mp_batchify_fn
+            else:
+                self._batchify_fn = default_batchify_fn
+        else:
+            self._batchify_fn = batchify_fn
+        self._thread_pool = thread_pool
+        self._pool = None
+        if self._num_workers > 0:
+            if thread_pool:
+                from multiprocessing.pool import ThreadPool
+                self._pool = ThreadPool(self._num_workers)
+                _worker_initializer(dataset)
+            else:
+                ctx = multiprocessing.get_context("fork")
+                self._pool = ctx.Pool(self._num_workers,
+                                      initializer=_worker_initializer,
+                                      initargs=(dataset,))
+
+    def __iter__(self):
+        if self._pool is None:
+            for batch in self._batch_sampler:
+                yield _as_nd(self._batchify_fn(
+                    [self._dataset[i] for i in batch]))
+            return
+
+        # async prefetch window over the worker pool
+        import collections
+        pending = collections.deque()
+        it = iter(self._batch_sampler)
+
+        def submit():
+            try:
+                samples = next(it)
+            except StopIteration:
+                return False
+            pending.append(self._pool.apply_async(
+                _worker_fn, (samples, self._batchify_fn)))
+            return True
+
+        for _ in range(self._prefetch or 1):
+            if not submit():
+                break
+        while pending:
+            result = pending.popleft()
+            batch = result.get()
+            submit()
+            yield _as_nd(batch)
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def __del__(self):
+        if self._pool is not None:
+            self._pool.terminate()

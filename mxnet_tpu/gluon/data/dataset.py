@@ -1,0 +1,171 @@
+"""Datasets (parity: python/mxnet/gluon/data/dataset.py)."""
+from __future__ import annotations
+
+import os
+
+from ... import ndarray as nd
+from ...base import MXNetError
+
+
+class Dataset:
+    """Abstract dataset: __getitem__ + __len__ (parity: dataset.py Dataset)."""
+
+    def __getitem__(self, idx):
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+    def filter(self, fn):
+        return _FilteredDataset(self, fn)
+
+    def take(self, count):
+        if count is None or count > len(self):
+            count = len(self)
+        return _TakenDataset(self, count)
+
+    def sample(self, sampler):
+        if not isinstance(sampler, (list, tuple)) and not hasattr(
+                sampler, "__iter__"):
+            raise TypeError(
+                f"Invalid sampler type: {type(sampler)}. Expected an iterable")
+        return _SampledDataset(self, list(iter(sampler)))
+
+    def shard(self, num_shards, index):
+        """Shard into num_shards parts, return part `index` (the distributed
+        data split — parity: dataset.py shard; reference ImageRecordIter
+        part_index/num_parts)."""
+        assert 0 <= index < num_shards
+        length = len(self)
+        shard_len = length // num_shards
+        rest = length % num_shards
+        start = shard_len * index + min(index, rest)
+        end = start + shard_len + (index < rest)
+        return _SampledDataset(self, list(range(start, end)))
+
+    def transform(self, fn, lazy=True):
+        trans = _LazyTransformDataset(self, fn)
+        if lazy:
+            return trans
+        return SimpleDataset([trans[i] for i in range(len(trans))])
+
+    def transform_first(self, fn, lazy=True):
+        return self.transform(_TransformFirstClosure(fn), lazy)
+
+
+class SimpleDataset(Dataset):
+    """Wrap a list-like (parity: dataset.py SimpleDataset)."""
+
+    def __init__(self, data):
+        self._data = data
+
+    def __len__(self):
+        return len(self._data)
+
+    def __getitem__(self, idx):
+        return self._data[idx]
+
+
+class _LazyTransformDataset(Dataset):
+    def __init__(self, data, fn):
+        self._data = data
+        self._fn = fn
+
+    def __len__(self):
+        return len(self._data)
+
+    def __getitem__(self, idx):
+        item = self._data[idx]
+        if isinstance(item, tuple):
+            return self._fn(*item)
+        return self._fn(item)
+
+
+class _TransformFirstClosure:
+    def __init__(self, fn):
+        self._fn = fn
+
+    def __call__(self, x, *args):
+        if args:
+            return (self._fn(x),) + args
+        return self._fn(x)
+
+
+class _FilteredDataset(Dataset):
+    def __init__(self, dataset, fn):
+        self._indices = [i for i in range(len(dataset)) if fn(dataset[i])]
+        self._dataset = dataset
+
+    def __len__(self):
+        return len(self._indices)
+
+    def __getitem__(self, idx):
+        return self._dataset[self._indices[idx]]
+
+
+class _TakenDataset(Dataset):
+    def __init__(self, dataset, count):
+        self._dataset = dataset
+        self._count = count
+
+    def __len__(self):
+        return self._count
+
+    def __getitem__(self, idx):
+        if idx >= self._count:
+            raise IndexError("Invalid index")
+        return self._dataset[idx]
+
+
+class _SampledDataset(Dataset):
+    def __init__(self, dataset, indices):
+        self._dataset = dataset
+        self._indices = indices
+
+    def __len__(self):
+        return len(self._indices)
+
+    def __getitem__(self, idx):
+        return self._dataset[self._indices[idx]]
+
+
+class ArrayDataset(Dataset):
+    """Zip of array-likes (parity: dataset.py ArrayDataset)."""
+
+    def __init__(self, *args):
+        assert len(args) > 0
+        self._length = len(args[0])
+        self._data = []
+        for i, data in enumerate(args):
+            assert len(data) == self._length, \
+                f"All arrays must have the same length; 0-th has {self._length} " \
+                f"while {i}-th has {len(data)}."
+            if isinstance(data, nd.NDArray) and data.ndim == 1:
+                data = data.asnumpy()
+            self._data.append(data)
+
+    def __getitem__(self, idx):
+        if len(self._data) == 1:
+            return self._data[0][idx]
+        return tuple(data[idx] for data in self._data)
+
+    def __len__(self):
+        return self._length
+
+
+class RecordFileDataset(Dataset):
+    """Dataset over an indexed RecordIO file
+    (parity: dataset.py RecordFileDataset)."""
+
+    def __init__(self, filename):
+        from ... import recordio
+        self.idx_file = os.path.splitext(filename)[0] + ".idx"
+        self.filename = filename
+        self._record = recordio.MXIndexedRecordIO(self.idx_file,
+                                                 self.filename, "r")
+
+    def __getitem__(self, idx):
+        return self._record.read_idx(self._record.keys[idx])
+
+    def __len__(self):
+        return len(self._record.keys)

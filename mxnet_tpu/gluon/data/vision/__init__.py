@@ -1,0 +1,4 @@
+"""Vision datasets + transforms (parity: python/mxnet/gluon/data/vision/)."""
+from . import transforms
+from .datasets import (CIFAR10, CIFAR100, MNIST, FashionMNIST,
+                       ImageFolderDataset, ImageRecordDataset)

@@ -1,0 +1,223 @@
+"""Vision datasets (parity: python/mxnet/gluon/data/vision/datasets.py):
+MNIST, FashionMNIST, CIFAR10/100, ImageRecordDataset, ImageFolderDataset.
+No-egress runtime: files must exist locally (standard idx/bin formats)."""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+import tarfile
+
+import numpy as np
+
+from .... import ndarray as nd
+from ....base import MXNetError
+from ..dataset import ArrayDataset, Dataset, RecordFileDataset
+
+
+class _DownloadedDataset(Dataset):
+    def __init__(self, root, transform):
+        self._transform = transform
+        self._data = None
+        self._label = None
+        root = os.path.expanduser(root)
+        self._root = root
+        if not os.path.isdir(root):
+            os.makedirs(root, exist_ok=True)
+        self._get_data()
+
+    def __getitem__(self, idx):
+        if self._transform is not None:
+            return self._transform(self._data[idx], self._label[idx])
+        return self._data[idx], self._label[idx]
+
+    def __len__(self):
+        return len(self._label)
+
+    def _get_data(self):
+        raise NotImplementedError
+
+
+class MNIST(_DownloadedDataset):
+    """MNIST from local idx files (parity: datasets.py MNIST)."""
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "mnist"),
+                 train=True, transform=None):
+        self._train = train
+        self._train_data = ("train-images-idx3-ubyte.gz",)
+        self._train_label = ("train-labels-idx1-ubyte.gz",)
+        self._test_data = ("t10k-images-idx3-ubyte.gz",)
+        self._test_label = ("t10k-labels-idx1-ubyte.gz",)
+        self._namespace = "mnist"
+        super().__init__(root, transform)
+
+    def _get_data(self):
+        if self._train:
+            data_file, label_file = self._train_data[0], self._train_label[0]
+        else:
+            data_file, label_file = self._test_data[0], self._test_label[0]
+        data_path = os.path.join(self._root, data_file)
+        label_path = os.path.join(self._root, label_file)
+        for p in (data_path, label_path):
+            alt = p[:-3]  # allow uncompressed
+            if not os.path.exists(p) and not os.path.exists(alt):
+                raise MXNetError(
+                    f"{self._namespace} file {p} not found; place the "
+                    "standard idx files there (no network egress).")
+
+        def _open(p):
+            if os.path.exists(p):
+                return gzip.open(p, "rb")
+            return open(p[:-3], "rb")
+
+        with _open(label_path) as fin:
+            struct.unpack(">II", fin.read(8))
+            label = np.frombuffer(fin.read(), dtype=np.uint8).astype(np.int32)
+        with _open(data_path) as fin:
+            _, num, rows, cols = struct.unpack(">IIII", fin.read(16))
+            data = np.frombuffer(fin.read(), dtype=np.uint8)
+            data = data.reshape(num, rows, cols, 1)
+        self._label = label
+        self._data = nd.array(data, dtype=np.uint8)
+
+
+class FashionMNIST(MNIST):
+    """FashionMNIST (parity: datasets.py FashionMNIST)."""
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+                                         "fashion-mnist"),
+                 train=True, transform=None):
+        self._namespace = "fashion-mnist"
+        self._train = train
+        self._train_data = ("train-images-idx3-ubyte.gz",)
+        self._train_label = ("train-labels-idx1-ubyte.gz",)
+        self._test_data = ("t10k-images-idx3-ubyte.gz",)
+        self._test_label = ("t10k-labels-idx1-ubyte.gz",)
+        _DownloadedDataset.__init__(self, root, transform)
+
+
+class CIFAR10(_DownloadedDataset):
+    """CIFAR10 from local binary batches (parity: datasets.py CIFAR10)."""
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "cifar10"),
+                 train=True, transform=None):
+        self._train = train
+        self._archive_file = "cifar-10-binary.tar.gz"
+        self._train_data = [f"data_batch_{i}.bin" for i in range(1, 6)]
+        self._test_data = ["test_batch.bin"]
+        self._namespace = "cifar10"
+        super().__init__(root, transform)
+
+    def _read_batch(self, filename):
+        with open(filename, "rb") as fin:
+            data = np.frombuffer(fin.read(), dtype=np.uint8).reshape(
+                -1, 3072 + 1)
+        return data[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1), \
+            data[:, 0].astype(np.int32)
+
+    def _get_data(self):
+        files = self._train_data if self._train else self._test_data
+        paths = []
+        for f in files:
+            p = os.path.join(self._root, f)
+            if not os.path.exists(p):
+                sub = os.path.join(self._root, "cifar-10-batches-bin", f)
+                if os.path.exists(sub):
+                    p = sub
+                else:
+                    arch = os.path.join(self._root, self._archive_file)
+                    if os.path.exists(arch):
+                        with tarfile.open(arch) as tar:
+                            tar.extractall(self._root)
+                        p = os.path.join(self._root, "cifar-10-batches-bin", f)
+                    if not os.path.exists(p):
+                        raise MXNetError(
+                            f"cifar10 file {f} not found under {self._root} "
+                            "(no network egress; place binary batches there).")
+            paths.append(p)
+        data, label = zip(*[self._read_batch(p) for p in paths])
+        data = np.concatenate(data)
+        label = np.concatenate(label)
+        self._data = nd.array(data, dtype=np.uint8)
+        self._label = label
+
+
+class CIFAR100(CIFAR10):
+    """CIFAR100 (parity: datasets.py CIFAR100)."""
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+                                         "cifar100"),
+                 fine_label=False, train=True, transform=None):
+        self._train = train
+        self._archive_file = "cifar-100-binary.tar.gz"
+        self._train_data = ["train.bin"]
+        self._test_data = ["test.bin"]
+        self._fine_label = fine_label
+        self._namespace = "cifar100"
+        _DownloadedDataset.__init__(self, root, transform)
+
+    def _read_batch(self, filename):
+        with open(filename, "rb") as fin:
+            data = np.frombuffer(fin.read(), dtype=np.uint8).reshape(
+                -1, 3072 + 2)
+        return data[:, 2:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1), \
+            data[:, 0 + self._fine_label].astype(np.int32)
+
+
+class ImageRecordDataset(RecordFileDataset):
+    """Images packed in a RecordIO file (parity: datasets.py
+    ImageRecordDataset; files from the reference's tools/im2rec load
+    directly)."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        super().__init__(filename)
+        self._flag = flag
+        self._transform = transform
+
+    def __getitem__(self, idx):
+        from .... import recordio
+        record = super().__getitem__(idx)
+        header, img = recordio.unpack_img(record, self._flag)
+        img_nd = nd.array(img, dtype=np.uint8)
+        label = header.label
+        if self._transform is not None:
+            return self._transform(img_nd, label)
+        return img_nd, label
+
+
+class ImageFolderDataset(Dataset):
+    """root/<label>/xxx.jpg layout (parity: datasets.py ImageFolderDataset)."""
+
+    def __init__(self, root, flag=1, transform=None):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self._exts = [".jpg", ".jpeg", ".png"]
+        self._list_images(self._root)
+
+    def _list_images(self, root):
+        self.synsets = []
+        self.items = []
+        for folder in sorted(os.listdir(root)):
+            path = os.path.join(root, folder)
+            if not os.path.isdir(path):
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for filename in sorted(os.listdir(path)):
+                filename = os.path.join(path, filename)
+                ext = os.path.splitext(filename)[1]
+                if ext.lower() not in self._exts:
+                    continue
+                self.items.append((filename, label))
+
+    def __getitem__(self, idx):
+        from ....image import imread
+        img = imread(self.items[idx][0], self._flag)
+        label = self.items[idx][1]
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+    def __len__(self):
+        return len(self.items)

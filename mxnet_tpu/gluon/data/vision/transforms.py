@@ -1,0 +1,271 @@
+"""Vision transforms (parity: python/mxnet/gluon/data/vision/transforms.py):
+Compose/Cast/ToTensor/Normalize/Resize/CenterCrop/RandomResizedCrop/
+RandomFlip/RandomColorJitter/RandomLighting."""
+from __future__ import annotations
+
+import random
+
+import numpy as np
+
+from .... import ndarray as nd
+from ....ndarray import NDArray
+from ...block import Block, HybridBlock
+from ...nn import HybridSequential, Sequential
+
+
+class Compose(Sequential):
+    """Sequentially compose transforms (parity: transforms.py Compose)."""
+
+    def __init__(self, transforms):
+        super().__init__()
+        transforms.append(None)
+        hybrid = []
+        for i in transforms:
+            if isinstance(i, HybridBlock):
+                hybrid.append(i)
+                continue
+            if len(hybrid) == 1:
+                self.add(hybrid[0])
+            elif len(hybrid) > 1:
+                hblock = HybridSequential()
+                for j in hybrid:
+                    hblock.add(j)
+                self.add(hblock)
+            hybrid = []
+            if i is not None:
+                self.add(i)
+
+
+class Cast(HybridBlock):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def hybrid_forward(self, F, x):
+        return F.cast(x, dtype=self._dtype)
+
+
+class ToTensor(HybridBlock):
+    """HWC uint8 [0,255] -> CHW float32 [0,1) (parity: transforms.py)."""
+
+    def hybrid_forward(self, F, x):
+        x = F.cast(x, dtype="float32") / 255.0
+        if x.ndim == 3:
+            return x.transpose((2, 0, 1))
+        return x.transpose((0, 3, 1, 2))
+
+
+class Normalize(HybridBlock):
+    """(x - mean) / std per channel on CHW input."""
+
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = np.asarray(mean, dtype=np.float32).reshape(-1, 1, 1)
+        self._std = np.asarray(std, dtype=np.float32).reshape(-1, 1, 1)
+
+    def hybrid_forward(self, F, x):
+        mean = nd.array(self._mean) if not isinstance(x, NDArray) else \
+            nd.array(self._mean)
+        std = nd.array(self._std)
+        return (x - mean) / std
+
+
+def _resize_image(arr, size, interp="bilinear"):
+    """Resize an HWC image NDArray via jax.image.resize."""
+    import jax
+    h, w = (size, size) if isinstance(size, int) else (size[1], size[0])
+    data = arr._data.astype("float32")
+    out = jax.image.resize(data, (h, w, data.shape[2]), method=interp)
+    return NDArray(out.astype(arr._data.dtype), arr.ctx)
+
+
+class Resize(Block):
+    """Resize to given size (parity: transforms.py Resize)."""
+
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = size
+        self._keep = keep_ratio
+
+    def forward(self, x):
+        if isinstance(self._size, int) and self._keep:
+            h, w = x.shape[:2]
+            if h < w:
+                size = (int(w * self._size / h), self._size)
+            else:
+                size = (self._size, int(h * self._size / w))
+        else:
+            size = self._size
+        return _resize_image(x, size)
+
+
+class CenterCrop(Block):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def forward(self, x):
+        th, tw = self._size[1], self._size[0]
+        h, w = x.shape[:2]
+        if h < th or w < tw:
+            x = _resize_image(x, (max(tw, w), max(th, h)))
+            h, w = x.shape[:2]
+        y0 = (h - th) // 2
+        x0 = (w - tw) // 2
+        return x[y0:y0 + th, x0:x0 + tw]
+
+
+class RandomResizedCrop(Block):
+    """Random crop w/ area+ratio jitter then resize (parity: transforms.py)."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3.0 / 4.0, 4.0 / 3.0),
+                 interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._scale = scale
+        self._ratio = ratio
+
+    def forward(self, x):
+        h, w = x.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target_area = random.uniform(*self._scale) * area
+            log_ratio = (np.log(self._ratio[0]), np.log(self._ratio[1]))
+            aspect = np.exp(random.uniform(*log_ratio))
+            cw = int(round(np.sqrt(target_area * aspect)))
+            ch = int(round(np.sqrt(target_area / aspect)))
+            if cw <= w and ch <= h:
+                x0 = random.randint(0, w - cw)
+                y0 = random.randint(0, h - ch)
+                crop = x[y0:y0 + ch, x0:x0 + cw]
+                return _resize_image(crop, self._size)
+        return CenterCrop(self._size).forward(x)
+
+
+class RandomCrop(Block):
+    def __init__(self, size, pad=None, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._pad = pad
+
+    def forward(self, x):
+        if self._pad:
+            p = self._pad
+            arr = np.pad(x.asnumpy(), ((p, p), (p, p), (0, 0)))
+            x = nd.array(arr, dtype=x.dtype)
+        th, tw = self._size[1], self._size[0]
+        h, w = x.shape[:2]
+        y0 = random.randint(0, max(0, h - th))
+        x0 = random.randint(0, max(0, w - tw))
+        return x[y0:y0 + th, x0:x0 + tw]
+
+
+class RandomFlipLeftRight(Block):
+    def forward(self, x):
+        if random.random() < 0.5:
+            return nd.flip(x, axis=1)
+        return x
+
+
+class RandomFlipTopBottom(Block):
+    def forward(self, x):
+        if random.random() < 0.5:
+            return nd.flip(x, axis=0)
+        return x
+
+
+class RandomBrightness(Block):
+    def __init__(self, brightness):
+        super().__init__()
+        self._b = brightness
+
+    def forward(self, x):
+        alpha = 1.0 + random.uniform(-self._b, self._b)
+        return (x.astype("float32") * alpha).clip(0, 255).astype(x.dtype)
+
+
+class RandomContrast(Block):
+    def __init__(self, contrast):
+        super().__init__()
+        self._c = contrast
+
+    def forward(self, x):
+        alpha = 1.0 + random.uniform(-self._c, self._c)
+        xf = x.astype("float32")
+        gray = xf.mean()
+        return ((xf - gray) * alpha + gray).clip(0, 255).astype(x.dtype)
+
+
+class RandomSaturation(Block):
+    def __init__(self, saturation):
+        super().__init__()
+        self._s = saturation
+
+    def forward(self, x):
+        alpha = 1.0 + random.uniform(-self._s, self._s)
+        xf = x.astype("float32")
+        coef = nd.array(np.array([0.299, 0.587, 0.114], np.float32))
+        gray = (xf * coef.reshape(1, 1, 3)).sum(axis=2, keepdims=True)
+        return (xf * alpha + gray * (1 - alpha)).clip(0, 255).astype(x.dtype)
+
+
+class RandomHue(Block):
+    def __init__(self, hue):
+        super().__init__()
+        self._h = hue
+
+    def forward(self, x):
+        # approximate hue jitter via yiq rotation (parity with reference
+        # image_random-inl.h RandomHue math)
+        alpha = random.uniform(-self._h, self._h)
+        u = np.cos(alpha * np.pi)
+        w_ = np.sin(alpha * np.pi)
+        bt = np.array([[1.0, 0.0, 0.0], [0.0, u, -w_], [0.0, w_, u]],
+                      np.float32)
+        t_yiq = np.array([[0.299, 0.587, 0.114], [0.596, -0.274, -0.321],
+                          [0.211, -0.523, 0.311]], np.float32)
+        t_rgb = np.linalg.inv(t_yiq)
+        m = t_rgb @ bt @ t_yiq
+        xf = x.astype("float32")
+        out = nd.dot(xf.reshape((-1, 3)), nd.array(m.T))
+        return out.reshape(x.shape).clip(0, 255).astype(x.dtype)
+
+
+class RandomColorJitter(Block):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super().__init__()
+        self._transforms = []
+        if brightness:
+            self._transforms.append(RandomBrightness(brightness))
+        if contrast:
+            self._transforms.append(RandomContrast(contrast))
+        if saturation:
+            self._transforms.append(RandomSaturation(saturation))
+        if hue:
+            self._transforms.append(RandomHue(hue))
+
+    def forward(self, x):
+        ts = list(self._transforms)
+        random.shuffle(ts)
+        for t in ts:
+            x = t.forward(x)
+        return x
+
+
+class RandomLighting(Block):
+    """AlexNet-style PCA noise (parity: transforms.py RandomLighting)."""
+
+    _eigval = np.array([55.46, 4.794, 1.148], np.float32)
+    _eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                        [-0.5808, -0.0045, -0.8140],
+                        [-0.5836, -0.6948, 0.4203]], np.float32)
+
+    def __init__(self, alpha):
+        super().__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        alpha = np.random.normal(0, self._alpha, size=(3,)).astype(np.float32)
+        rgb = (self._eigvec * alpha * self._eigval).sum(axis=1)
+        return (x.astype("float32") +
+                nd.array(rgb.reshape(1, 1, 3))).clip(0, 255).astype(x.dtype)

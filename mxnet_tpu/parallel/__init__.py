@@ -1,0 +1,13 @@
+"""mxnet_tpu.parallel — SPMD parallelism over device meshes.
+
+This is the TPU-native replacement for the reference's entire distributed
+stack (SURVEY.md §2.4: kvstore comm trees, NCCL, ps-lite). One mesh +
+sharding annotations + pjit replace CommDevice/CommDeviceTree/KVStoreDist:
+XLA inserts the psum/all-gather/reduce-scatter collectives over ICI.
+
+New capabilities relative to the reference (SURVEY.md §2.4 checklist —
+TP/SP/ring attention absent there) are first-class here.
+"""
+from .mesh import DeviceMesh, make_mesh, current_mesh
+from .spmd import (TrainStep, functionalize, shard_batch, replicate,
+                   data_parallel_shardings)

@@ -1,0 +1,251 @@
+"""SPMD training: functionalized gluon blocks + pjit over a DeviceMesh.
+
+The reference's data-parallel train loop (SURVEY.md §3.4/3.5) moves gradients
+through kvstore comm trees / ps-lite. Here the WHOLE train step — forward,
+backward, gradient reduction, optimizer update — is one pjit'd XLA program:
+batch sharded over 'dp', parameters replicated (or sharded over 'fsdp'),
+gradient psum inserted by XLA over ICI. BatchNorm under a sharded batch
+reduces globally (collectives), i.e. sync-BN semantics for free (the
+reference needs a dedicated sync_batch_norm op, contrib/sync_batch_norm).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .. import random as _random
+from ..base import MXNetError
+from ..ndarray import NDArray
+from ..ops import registry as _registry
+from .mesh import DeviceMesh
+
+
+def functionalize(block, *example_args):
+    """Turn an initialized HybridBlock into a pure function.
+
+    Returns (apply_fn, param_arrays, param_names) with
+    apply_fn(key, params_tuple, inputs_tuple) -> (outputs_tuple, mutated_tuple)
+    — the functional core the reference's CachedOp wraps statefully.
+    """
+    from ..gluon.block import _flatten
+    from .. import autograd
+
+    # one imperative dry-run to finish deferred init
+    needs = any(p._data is None for p in block.collect_params().values())
+    if needs:
+        with autograd.pause():
+            block(*example_args)
+    params = [p for p in block.collect_params().values()
+              if p._data is not None]
+    flat, fmt, _ = block._trace_signature(example_args)
+    entry = block._build_jit(flat, fmt, params)
+    raw = entry.raw
+    names = [p.name for p in params]
+    arrays = tuple(p.data()._data for p in params)
+    return raw, arrays, names
+
+
+def data_parallel_shardings(mesh, params, batch_axis="dp",
+                            param_axis=None):
+    """(param_sharding, batch_sharding) for plain DP or fsdp-style DP."""
+    if param_axis is None:
+        param_sh = mesh.replicated()
+        param_shardings = tuple(param_sh for _ in params)
+    else:
+        # shard the largest axis of each parameter over param_axis when
+        # divisible (zero/fsdp-style); small/indivisible params replicate
+        n = mesh.size(param_axis)
+        shardings = []
+        for p in params:
+            shape = p.shape
+            best = None
+            for i, s in enumerate(shape):
+                if s % n == 0 and (best is None or s > shape[best]):
+                    best = i
+            if best is None:
+                shardings.append(mesh.replicated())
+            else:
+                spec = [None] * len(shape)
+                spec[best] = param_axis
+                shardings.append(mesh.sharding(*spec))
+        param_shardings = tuple(shardings)
+    batch_sharding = mesh.sharding(batch_axis)
+    return param_shardings, batch_sharding
+
+
+def shard_batch(mesh, array, axis="dp"):
+    """Place a host batch onto the mesh, sharded along its leading dim."""
+    data = array._data if isinstance(array, NDArray) else jnp.asarray(array)
+    return jax.device_put(data, mesh.sharding(axis))
+
+
+def replicate(mesh, array):
+    data = array._data if isinstance(array, NDArray) else jnp.asarray(array)
+    return jax.device_put(data, mesh.replicated())
+
+
+# -- functional optimizers ---------------------------------------------------
+def _opt_sgd(attrs):
+    mom = float(attrs.get("momentum", 0.0))
+    if mom == 0.0:
+        fc = _registry.get("sgd_update").fcompute
+
+        def init(w):
+            return ()
+
+        def update(attrs_, w, g, state):
+            return fc(attrs_, w, g), ()
+    else:
+        fc = _registry.get("sgd_mom_update").fcompute
+
+        def init(w):
+            return (jnp.zeros_like(w),)
+
+        def update(attrs_, w, g, state):
+            new_w, new_m = fc(attrs_, w, g, state[0])
+            return new_w, (new_m,)
+    return init, update
+
+
+def _opt_adam(attrs):
+    fc = _registry.get("adam_update").fcompute
+
+    def init(w):
+        return (jnp.zeros_like(w), jnp.zeros_like(w))
+
+    def update(attrs_, w, g, state):
+        new_w, m, v = fc(attrs_, w, g, state[0], state[1])
+        return new_w, (m, v)
+    return init, update
+
+
+def _opt_adamw(attrs):
+    fc = _registry.get("adamw_update").fcompute
+
+    def init(w):
+        return (jnp.zeros_like(w), jnp.zeros_like(w))
+
+    def update(attrs_, w, g, state):
+        new_w, m, v = fc(attrs_, w, g, state[0], state[1])
+        return new_w, (m, v)
+    return init, update
+
+
+_FUNCTIONAL_OPTS = {"sgd": _opt_sgd, "adam": _opt_adam, "adamw": _opt_adamw}
+
+
+class TrainStep:
+    """One compiled SPMD train step for a gluon block.
+
+    Usage:
+        mesh = make_mesh(dp=8)
+        step = TrainStep(net, loss_fn, "sgd",
+                         {"learning_rate": 0.1, "momentum": 0.9},
+                         mesh, example_batch=(x, y))
+        for x, y in data:
+            loss = step(x, y)        # params/opt state live sharded on device
+
+    The whole step is ONE pjit'd XLA program; gradient reduction over 'dp'
+    and (with param_axis='fsdp') parameter all-gathers are XLA collectives.
+    """
+
+    def __init__(self, block, loss_fn, optimizer, optimizer_params, mesh,
+                 example_batch, batch_axis="dp", param_axis=None,
+                 dtype=None):
+        if not isinstance(mesh, DeviceMesh):
+            raise MXNetError("mesh must be a parallel.DeviceMesh")
+        self.mesh = mesh
+        self.block = block
+        x_ex, y_ex = example_batch
+        apply_fn, param_arrays, names = functionalize(block, x_ex)
+        if dtype is not None:
+            param_arrays = tuple(a.astype(dtype) if
+                                 jnp.issubdtype(a.dtype, jnp.floating) else a
+                                 for a in param_arrays)
+        self._apply = apply_fn
+        self.param_names = names
+        lr = float(optimizer_params.get("learning_rate", 0.01))
+        self._opt_attrs = {"lr": lr,
+                           "wd": float(optimizer_params.get("wd", 0.0)),
+                           "rescale_grad": 1.0}
+        for k in ("momentum", "beta1", "beta2", "epsilon", "clip_gradient"):
+            if k in optimizer_params:
+                self._opt_attrs[k] = optimizer_params[k]
+        if optimizer not in _FUNCTIONAL_OPTS:
+            raise MXNetError(
+                f"functional optimizer {optimizer!r} not available "
+                f"(options: {sorted(_FUNCTIONAL_OPTS)}); use gluon.Trainer "
+                "for the imperative path")
+        opt_init, opt_update = _FUNCTIONAL_OPTS[optimizer](self._opt_attrs)
+        self._opt_update = opt_update
+
+        # shardings
+        param_sh, batch_sh = data_parallel_shardings(
+            mesh, [type("S", (), {"shape": a.shape})() for a in param_arrays],
+            batch_axis, param_axis)
+        self._param_sh = param_sh
+        self._batch_sh = batch_sh
+
+        # place params + opt state on the mesh
+        self.params = tuple(
+            jax.device_put(a, s) for a, s in zip(param_arrays, param_sh))
+        self.opt_state = tuple(
+            tuple(jax.device_put(s, sh) for s in opt_init(a))
+            for a, sh in zip(self.params, param_sh))
+
+        ctx_holder = self
+
+        loss_is_block = hasattr(loss_fn, "hybrid_forward") or callable(loss_fn)
+
+        def loss_raw(pred, label):
+            if hasattr(loss_fn, "hybrid_forward"):
+                from ..context import current_context
+                l = loss_fn(NDArray(pred, current_context()),
+                            NDArray(label, current_context()))
+                return l._data.mean()
+            return loss_fn(pred, label)
+
+        opt_attrs = dict(self._opt_attrs)
+
+        def step(key, params, opt_state, x, y):
+            def compute_loss(ps):
+                outs, mutated = apply_fn(key, ps, (x,))
+                return loss_raw(outs[0], y), mutated
+
+            (loss, mutated), grads = jax.value_and_grad(
+                compute_loss, has_aux=True)(params)
+            new_params = []
+            new_state = []
+            for w, g, st in zip(params, grads, opt_state):
+                nw, ns = opt_update(opt_attrs, w, g, st)
+                new_params.append(nw)
+                new_state.append(ns)
+            return tuple(new_params), tuple(new_state), loss, mutated
+
+        self._mutated_param_idx = None
+        in_sh = (None, param_sh,
+                 tuple(tuple(s for _ in range(9)) for s in param_sh),
+                 batch_sh, batch_sh)
+        # jit with shardings: params/opt state keep their placement, batch
+        # arrives sharded; XLA inserts the dp psum for grads
+        self._step = jax.jit(step)
+
+    def __call__(self, x, y):
+        """Run one step; returns scalar loss (host float on .item())."""
+        key = _random.next_key()
+        xs = shard_batch(self.mesh, x) if not isinstance(x, jax.Array) else x
+        ys = shard_batch(self.mesh, y) if not isinstance(y, jax.Array) else y
+        with self.mesh.jax_mesh:
+            self.params, self.opt_state, loss, mutated = self._step(
+                key, self.params, self.opt_state, xs, ys)
+        return loss
+
+    def sync_to_block(self):
+        """Write the trained parameters back into the gluon block."""
+        for name, arr in zip(self.param_names, self.params):
+            p = self.block.collect_params()[name]
+            d = p.data()
+            d._set_data(jnp.asarray(arr, dtype=d.dtype))

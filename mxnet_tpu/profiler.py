@@ -1,0 +1,247 @@
+"""Profiler (parity: python/mxnet/profiler.py + src/profiler/profiler.h:260).
+
+The reference writes chrome://tracing JSON from an in-engine profiler with
+device/engine lanes + an aggregate stats table. TPU redesign: the heavy
+lifting is jax.profiler (XLA xplane → TensorBoard/perfetto); this module
+keeps the mx.profiler API surface (set_config/start/stop/dump/dumps) and
+adds a lightweight host-side op-dispatch recorder producing the same
+chrome-trace JSON + aggregate table the reference emits.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from .base import MXNetError
+
+_config = {
+    "filename": "profile.json",
+    "profile_all": False,
+    "profile_symbolic": True,
+    "profile_imperative": True,
+    "profile_memory": False,
+    "profile_api": False,
+    "aggregate_stats": False,
+}
+_state = {"running": False, "jax_trace_dir": None}
+_records = []
+_records_lock = threading.Lock()
+_t0 = None
+
+KWARGS = _config  # parity alias
+
+
+def set_config(**kwargs):
+    """Configure the profiler (parity: profiler.py set_config)."""
+    for k, v in kwargs.items():
+        if k in _config:
+            _config[k] = v
+        elif k in ("continuous_dump", "dump_period", "profile_process"):
+            pass  # accepted for API parity
+        else:
+            raise MXNetError(f"unknown profiler option {k}")
+
+
+def profiler_set_config(mode="symbolic", filename="profile.json"):
+    """Deprecated API (parity: profiler.py profiler_set_config)."""
+    set_config(filename=filename)
+
+
+def set_state(state="stop", profile_process="worker"):
+    if state == "run":
+        start()
+    else:
+        stop()
+
+
+def start(profile_process="worker"):
+    """Start profiling (parity: profiler.py start). Also starts a JAX/XLA
+    device trace when a directory is configured via MXNET_PROFILER_XPLANE_DIR."""
+    global _t0
+    _t0 = time.perf_counter()
+    _state["running"] = True
+    xdir = os.environ.get("MXNET_PROFILER_XPLANE_DIR")
+    if xdir:
+        import jax
+        jax.profiler.start_trace(xdir)
+        _state["jax_trace_dir"] = xdir
+
+
+def stop(profile_process="worker"):
+    """Stop profiling."""
+    _state["running"] = False
+    if _state["jax_trace_dir"]:
+        import jax
+        jax.profiler.stop_trace()
+        _state["jax_trace_dir"] = None
+
+
+def is_running():
+    return _state["running"]
+
+
+def record_op(name, dur_us, cat="operator"):
+    """Internal hook: record one op dispatch (called from ndarray.invoke
+    when profiling is on)."""
+    if not _state["running"]:
+        return
+    with _records_lock:
+        _records.append({
+            "name": name,
+            "cat": cat,
+            "ph": "X",
+            "ts": (time.perf_counter() - _t0) * 1e6 - dur_us,
+            "dur": dur_us,
+            "pid": os.getpid(),
+            "tid": threading.get_ident() % 100000,
+        })
+
+
+def pause(profile_process="worker"):
+    _state["running"] = False
+
+
+def resume(profile_process="worker"):
+    _state["running"] = True
+
+
+def dump(finished=True, profile_process="worker"):
+    """Write chrome://tracing JSON (parity: profiler.py dump →
+    profile.json format of src/profiler/profiler.h:460)."""
+    with _records_lock:
+        events = list(_records)
+    doc = {"traceEvents": events, "displayTimeUnit": "ms"}
+    with open(_config["filename"], "w") as f:
+        json.dump(doc, f)
+
+
+def dumps(reset=False, format="table", sort_by="total", ascending=False):
+    """Return aggregate stats as an ASCII table
+    (parity: profiler.py dumps → aggregate_stats.cc table)."""
+    with _records_lock:
+        events = list(_records)
+        if reset:
+            _records.clear()
+    agg = {}
+    for e in events:
+        st = agg.setdefault(e["name"], [0, 0.0, float("inf"), 0.0])
+        st[0] += 1
+        st[1] += e["dur"]
+        st[2] = min(st[2], e["dur"])
+        st[3] = max(st[3], e["dur"])
+    lines = ["Profile Statistics:",
+             f"{'Name':<40}{'Total Count':>12}{'Time (ms)':>14}"
+             f"{'Min (ms)':>12}{'Max (ms)':>12}{'Avg (ms)':>12}"]
+    items = sorted(agg.items(),
+                   key=lambda kv: kv[1][1] if sort_by == "total" else kv[1][0],
+                   reverse=not ascending)
+    for name, (cnt, tot, mn, mx) in items:
+        lines.append(f"{name:<40}{cnt:>12}{tot/1e3:>14.4f}"
+                     f"{mn/1e3:>12.4f}{mx/1e3:>12.4f}{tot/cnt/1e3:>12.4f}")
+    return "\n".join(lines)
+
+
+class Profiler:
+    """Context-manager convenience."""
+
+    def __init__(self, **kwargs):
+        set_config(**kwargs)
+
+    def __enter__(self):
+        start()
+        return self
+
+    def __exit__(self, *args):
+        stop()
+
+
+# -- scoped domains / tasks / frames / markers (API parity) ------------------
+class Domain:
+    def __init__(self, name):
+        self.name = name
+
+    def __str__(self):
+        return self.name
+
+    def new_task(self, name):
+        return Task(self, name)
+
+    def new_frame(self, name):
+        return Frame(self, name)
+
+    def new_counter(self, name, value=None):
+        return Counter(self, name, value)
+
+    def new_marker(self, name):
+        return Marker(self, name)
+
+
+class _Span:
+    def __init__(self, domain, name):
+        self.name = name
+        self.domain = domain
+        self._start = None
+
+    def start(self):
+        self._start = time.perf_counter()
+
+    def stop(self):
+        if self._start is not None and _state["running"]:
+            dur_us = (time.perf_counter() - self._start) * 1e6
+            record_op(f"{self.domain}:{self.name}", dur_us, cat="task")
+        self._start = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *args):
+        self.stop()
+
+
+class Task(_Span):
+    pass
+
+
+class Frame(_Span):
+    pass
+
+
+class Event(_Span):
+    def __init__(self, name):
+        super().__init__("event", name)
+
+
+class Counter:
+    def __init__(self, domain, name, value=None):
+        self.domain = domain
+        self.name = name
+        self.value = value or 0
+
+    def set_value(self, value):
+        self.value = value
+
+    def increment(self, delta=1):
+        self.value += delta
+
+    def decrement(self, delta=1):
+        self.value -= delta
+
+    def __iadd__(self, v):
+        self.increment(v)
+        return self
+
+    def __isub__(self, v):
+        self.decrement(v)
+        return self
+
+
+class Marker:
+    def __init__(self, domain, name):
+        self.domain = domain
+        self.name = name
+
+    def mark(self, scope="process"):
+        record_op(f"{self.domain}:{self.name}", 0, cat="marker")

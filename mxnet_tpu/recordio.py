@@ -1,0 +1,269 @@
+"""RecordIO: record-packed binary files (parity: python/mxnet/recordio.py
+MXRecordIO/MXIndexedRecordIO/IRHeader; format = dmlc-core recordio framing).
+
+Byte-format compatible with the reference so datasets packed by the
+reference's tools/im2rec.py load directly: each record is
+[magic u32][cflag:3bits|length:29bits u32][data][pad to 4B]. Long records are
+split into multi-part frames with continuation flags (1=start, 2=middle,
+3=end). Pure-Python implementation backed by buffered file IO — record
+parsing is memcpy-bound, not a TPU concern; the C++ data plane
+(src_native/recordio) accelerates bulk sharded reads for the training input
+pipeline.
+"""
+from __future__ import annotations
+
+import numbers
+import os
+import struct
+
+import numpy as np
+
+from .base import MXNetError
+
+_MAGIC = 0xced7230a
+_LEN_MASK = (1 << 29) - 1
+
+
+def _encode_lrec(cflag, length):
+    return (cflag << 29) | length
+
+
+def _decode_lrec(lrec):
+    return lrec >> 29, lrec & _LEN_MASK
+
+
+class MXRecordIO:
+    """Sequential reader/writer (parity: recordio.py MXRecordIO)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.pid = None
+        self.fid = None
+        self.writable = None
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.fid = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.fid = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise ValueError("Invalid flag %s" % self.flag)
+        self.pid = os.getpid()
+
+    def __del__(self):
+        self.close()
+
+    def __getstate__(self):
+        is_open = self.fid is not None and not self.fid.closed
+        d = dict(self.__dict__)
+        d["fid"] = None
+        d["is_open"] = is_open
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__ = d
+        is_open = d.pop("is_open", False)
+        self.pid = None
+        self.fid = None
+        if is_open:
+            self.open()
+
+    def _check_pid(self, allow_reset=False):
+        # after fork (DataLoader workers) reopen the file handle
+        if self.pid != os.getpid():
+            if allow_reset:
+                self.reset()
+            else:
+                raise MXNetError("Forbidden operation in a forked process")
+
+    def close(self):
+        if self.fid is not None and not self.fid.closed:
+            self.fid.close()
+        self.pid = None
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def write(self, buf):
+        """Insert a string buffer as a record."""
+        assert self.writable
+        self._check_pid(allow_reset=False)
+        total = len(buf)
+        pos = 0
+        # single frame if it fits in 29 bits, else multi-part
+        if total <= _LEN_MASK:
+            self._write_frame(0, buf)
+        else:
+            first = True
+            while pos < total:
+                chunk = buf[pos:pos + _LEN_MASK]
+                pos += len(chunk)
+                if first:
+                    cflag = 1
+                    first = False
+                elif pos >= total:
+                    cflag = 3
+                else:
+                    cflag = 2
+                self._write_frame(cflag, chunk)
+
+    def _write_frame(self, cflag, data):
+        self.fid.write(struct.pack("<II", _MAGIC,
+                                   _encode_lrec(cflag, len(data))))
+        self.fid.write(data)
+        pad = (4 - (len(data) % 4)) % 4
+        if pad:
+            self.fid.write(b"\x00" * pad)
+
+    def read(self):
+        """Read a record; None at EOF."""
+        assert not self.writable
+        self._check_pid(allow_reset=True)
+        parts = []
+        while True:
+            header = self.fid.read(8)
+            if len(header) < 8:
+                return b"".join(parts) if parts else None
+            magic, lrec = struct.unpack("<II", header)
+            if magic != _MAGIC:
+                raise MXNetError(f"invalid record magic {magic:#x} in {self.uri}")
+            cflag, length = _decode_lrec(lrec)
+            data = self.fid.read(length)
+            pad = (4 - (length % 4)) % 4
+            if pad:
+                self.fid.read(pad)
+            parts.append(data)
+            if cflag in (0, 3):
+                return b"".join(parts)
+
+    def tell(self):
+        assert self.fid is not None
+        return self.fid.tell()
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Random-access reader/writer with .idx file
+    (parity: recordio.py MXIndexedRecordIO)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if not self.writable and os.path.isfile(self.idx_path):
+            with open(self.idx_path) as fin:
+                for line in fin.readlines():
+                    line = line.strip().split("\t")
+                    key = self.key_type(line[0])
+                    self.idx[key] = int(line[1])
+                    self.keys.append(key)
+
+    def close(self):
+        if self.writable and self.fid is not None and not self.fid.closed:
+            with open(self.idx_path, "w") as fout:
+                for k in self.keys:
+                    fout.write(f"{k}\t{self.idx[k]}\n")
+        super().close()
+
+    def __getstate__(self):
+        d = super().__getstate__()
+        d["idx"] = dict(self.idx)
+        d["keys"] = list(self.keys)
+        return d
+
+    def seek(self, idx):
+        assert not self.writable
+        self._check_pid(allow_reset=True)
+        self.fid.seek(self.idx[idx])
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        assert self.writable
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+IRHeader = __import__("collections").namedtuple(
+    "HEADER", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header, s):
+    """Pack an IRHeader + byte payload (parity: recordio.py pack)."""
+    header = IRHeader(*header)
+    if isinstance(header.label, numbers.Number):
+        header = header._replace(flag=0)
+    else:
+        label = np.asarray(header.label, dtype=np.float32)
+        header = header._replace(flag=label.size, label=0)
+        s = label.tobytes() + s
+    s = struct.pack(_IR_FORMAT, *header) + s
+    return s
+
+
+def unpack(s):
+    """Unpack to (IRHeader, payload bytes) (parity: recordio.py unpack)."""
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        header = header._replace(
+            label=np.frombuffer(s[:header.flag * 4], np.float32).copy())
+        s = s[header.flag * 4:]
+    return header, s
+
+
+def unpack_img(s, iscolor=1):
+    """Unpack a packed image record to (header, image array)."""
+    header, s = unpack(s)
+    img = _imdecode_bytes(s, iscolor)
+    return header, img
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Pack an image + header (requires an encoder; PNG/JPEG via PIL if
+    present, else raises)."""
+    try:
+        import io as _io
+
+        from PIL import Image
+    except ImportError as e:
+        raise MXNetError("pack_img requires PIL") from e
+    buf = _io.BytesIO()
+    arr = np.asarray(img).astype(np.uint8)
+    Image.fromarray(arr).save(buf, format="JPEG" if "jpg" in img_fmt.lower()
+                              or "jpeg" in img_fmt.lower() else "PNG",
+                              quality=quality)
+    return pack(header, buf.getvalue())
+
+
+def _imdecode_bytes(s, iscolor=1):
+    try:
+        import io as _io
+
+        from PIL import Image
+    except ImportError as e:
+        raise MXNetError("image decode requires PIL") from e
+    img = Image.open(_io.BytesIO(s))
+    if iscolor == 1:
+        img = img.convert("RGB")
+    elif iscolor == 0:
+        img = img.convert("L")
+    return np.asarray(img)

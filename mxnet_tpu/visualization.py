@@ -1,0 +1,158 @@
+"""Network visualization (parity: python/mxnet/visualization.py):
+print_summary ASCII table + plot_network graphviz export."""
+from __future__ import annotations
+
+import json
+
+from .base import MXNetError
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=(.44, .64,
+                                                                  .74, 1.)):
+    """Print a symbol's layer summary table
+    (parity: visualization.py print_summary)."""
+    if shape is not None:
+        interals = symbol.get_internals()
+        _, out_shapes, _ = interals.infer_shape(**shape)
+        if out_shapes is None:
+            raise ValueError("Input shape is incomplete")
+        shape_dict = dict(zip(interals.list_outputs(), out_shapes))
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    if positions[-1] <= 1:
+        positions = [int(line_length * p) for p in positions]
+    to_display = ["Layer (type)", "Output Shape", "Param #",
+                  "Previous Layer"]
+
+    def print_row(fields, positions):
+        line = ""
+        for i, field in enumerate(fields):
+            line += str(field)
+            line = line[:positions[i]]
+            line += " " * (positions[i] - len(line))
+        print(line)
+
+    print("_" * line_length)
+    print_row(to_display, positions)
+    print("=" * line_length)
+    total_params = 0
+
+    def print_layer_summary(node, out_shape):
+        op = node["op"]
+        pre_node = []
+        pre_filter = 0
+        if op != "null":
+            inputs = node["inputs"]
+            for item in inputs:
+                input_node = nodes[item[0]]
+                input_name = input_node["name"]
+                if input_node["op"] != "null" or item[0] in heads:
+                    pre_node.append(input_name)
+                    if input_node["op"] != "null":
+                        key = input_name + "_output"
+                        if key in shape_dict:
+                            pre_filter = pre_filter + int(shape_dict[key][1])
+        cur_param = 0
+        attrs = node.get("attrs", {})
+        if op == "Convolution":
+            num_group = int(attrs.get("num_group", "1"))
+            k = _parse_tuple(attrs["kernel"])
+            cur_param = pre_filter * int(attrs["num_filter"]) // num_group
+            for kk in k:
+                cur_param *= kk
+            if attrs.get("no_bias", "False") not in ("True", "true", "1"):
+                cur_param += int(attrs["num_filter"])
+        elif op == "FullyConnected":
+            if attrs.get("no_bias", "False") in ("True", "true", "1"):
+                cur_param = pre_filter * int(attrs["num_hidden"])
+            else:
+                cur_param = (pre_filter + 1) * int(attrs["num_hidden"])
+        elif op == "BatchNorm":
+            key = node["name"] + "_output"
+            if shape is not None and key in shape_dict:
+                num_filter = shape_dict[key][1]
+                cur_param = int(num_filter) * 2
+        elif op == "Embedding":
+            cur_param = int(attrs["input_dim"]) * int(attrs["output_dim"])
+        first_connection = not pre_node
+        fields = [node["name"] + "(" + op + ")",
+                  "x".join(str(x) for x in out_shape),
+                  cur_param,
+                  pre_node[0] if pre_node else ""]
+        print_row(fields, positions)
+        for i in range(1, len(pre_node)):
+            fields = ["", "", "", pre_node[i]]
+            print_row(fields, positions)
+        return cur_param
+
+    heads = set(conf["arg_nodes"])
+    for i, node in enumerate(nodes):
+        out_shape = []
+        op = node["op"]
+        if op == "null" and i > 0:
+            continue
+        if op != "null" or i in heads:
+            if shape is not None:
+                key = node["name"] + "_output"
+                if key in shape_dict:
+                    out_shape = shape_dict[key][1:]
+        total_params += print_layer_summary(node, out_shape)
+        if i == len(nodes) - 1:
+            print("=" * line_length)
+        else:
+            print("_" * line_length)
+    print(f"Total params: {total_params}")
+    print("_" * line_length)
+
+
+def _parse_tuple(s):
+    if isinstance(s, (tuple, list)):
+        return tuple(int(x) for x in s)
+    return tuple(int(x) for x in s.strip("()[] ").split(",") if x.strip())
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 dtype=None, node_attrs=None, hide_weights=True):
+    """Build a graphviz Digraph of the symbol
+    (parity: visualization.py plot_network). Requires the graphviz package;
+    raises a clear error if absent (no egress to install it)."""
+    try:
+        from graphviz import Digraph
+    except ImportError as e:
+        raise MXNetError(
+            "plot_network requires the graphviz python package") from e
+    node_attrs = node_attrs or {}
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    node_attr = {"shape": "box", "fixedsize": "true", "width": "1.3",
+                 "height": "0.8034", "style": "filled"}
+    node_attr.update(node_attrs)
+    dot = Digraph(name=title, format=save_format)
+    hidden_nodes = set()
+    for i, node in enumerate(nodes):
+        op = node["op"]
+        name = node["name"]
+        if op == "null":
+            if name.endswith("_weight") or name.endswith("_bias") or \
+                    name.endswith("_gamma") or name.endswith("_beta") or \
+                    name.endswith("_moving_var") or \
+                    name.endswith("_moving_mean") or \
+                    name.endswith("_running_var") or \
+                    name.endswith("_running_mean"):
+                if hide_weights:
+                    hidden_nodes.add(i)
+                continue
+            dot.node(name=name, label=name,
+                     **dict(node_attr, fillcolor="#8dd3c7"))
+        else:
+            dot.node(name=name, label=f"{name}\n({op})",
+                     **dict(node_attr, fillcolor="#fb8072"))
+    for i, node in enumerate(nodes):
+        if node["op"] == "null":
+            continue
+        for item in node["inputs"]:
+            src = item[0]
+            if src in hidden_nodes:
+                continue
+            dot.edge(tail_name=nodes[src]["name"], head_name=node["name"])
+    return dot

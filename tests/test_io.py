@@ -1,0 +1,222 @@
+"""io / recordio / gluon.data tests (parity: reference test_io.py,
+test_recordio.py, test_gluon_data.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, io, recordio
+from mxnet_tpu.gluon import data as gdata
+
+
+def test_ndarrayiter():
+    data = np.ones([1000, 2, 2])
+    label = np.ones([1000, 1])
+    data_iter = io.NDArrayIter(data, label, 128, shuffle=True,
+                               last_batch_handle="pad")
+    batch_count = 0
+    labelcount = 0
+    for batch in data_iter:
+        label = batch.label[0].asnumpy().flatten()
+        assert (batch.data[0].asnumpy()[:, 0, 0] == label).all()
+        labelcount += (label == 1).sum()
+        batch_count += 1
+    assert batch_count == 8
+    assert labelcount == 1024  # padded
+
+
+def test_ndarrayiter_discard():
+    data = np.arange(100).reshape(100, 1)
+    it = io.NDArrayIter(data, None, 32, last_batch_handle="discard")
+    n = sum(1 for _ in it)
+    assert n == 3
+
+
+def test_ndarrayiter_reset():
+    data = np.arange(10).reshape(10, 1)
+    it = io.NDArrayIter(data, None, 5)
+    a = [b.data[0].asnumpy() for b in it]
+    it.reset()
+    b = [b.data[0].asnumpy() for b in it]
+    np.testing.assert_array_equal(np.concatenate(a), np.concatenate(b))
+
+
+def test_resize_iter():
+    it = io.NDArrayIter(np.zeros((12, 2)), None, 4)
+    rit = io.ResizeIter(it, 5)
+    assert sum(1 for _ in rit) == 5
+
+
+def test_prefetching_iter():
+    it = io.NDArrayIter(np.arange(64).reshape(64, 1), None, 16)
+    pit = io.PrefetchingIter(it)
+    got = [b.data[0].asnumpy() for b in pit]
+    assert len(got) == 4
+    np.testing.assert_array_equal(np.concatenate(got).ravel(), np.arange(64))
+
+
+def test_recordio(tmp_path):
+    frec = str(tmp_path / "test.rec")
+    N = 255
+    writer = recordio.MXRecordIO(frec, "w")
+    for i in range(N):
+        writer.write(bytes(str(chr(i)), "utf-8"))
+    del writer
+    reader = recordio.MXRecordIO(frec, "r")
+    for i in range(N):
+        res = reader.read()
+        assert res == bytes(str(chr(i)), "utf-8")
+    assert reader.read() is None
+
+
+def test_indexed_recordio(tmp_path):
+    fidx = str(tmp_path / "test.idx")
+    frec = str(tmp_path / "test.rec")
+    N = 255
+    writer = recordio.MXIndexedRecordIO(fidx, frec, "w")
+    for i in range(N):
+        writer.write_idx(i, bytes(str(chr(i)), "utf-8"))
+    writer.close()
+    reader = recordio.MXIndexedRecordIO(fidx, frec, "r")
+    keys = list(reader.keys)
+    np.random.shuffle(keys)
+    for i in keys:
+        res = reader.read_idx(i)
+        assert res == bytes(str(chr(i)), "utf-8")
+
+
+def test_recordio_large_record(tmp_path):
+    frec = str(tmp_path / "big.rec")
+    writer = recordio.MXRecordIO(frec, "w")
+    payloads = [b"x" * 10, b"y" * 100000, b"z" * 3]
+    for p in payloads:
+        writer.write(p)
+    del writer
+    reader = recordio.MXRecordIO(frec, "r")
+    for p in payloads:
+        assert reader.read() == p
+
+
+def test_irheader_pack_unpack():
+    header = recordio.IRHeader(0, 1.5, 7, 0)
+    s = recordio.pack(header, b"payload")
+    h2, payload = recordio.unpack(s)
+    assert payload == b"payload"
+    assert h2.label == 1.5
+    assert h2.id == 7
+    # array label
+    header = recordio.IRHeader(0, np.array([1.0, 2.0, 3.0], np.float32), 9, 0)
+    s = recordio.pack(header, b"data")
+    h2, payload = recordio.unpack(s)
+    np.testing.assert_array_equal(h2.label, [1.0, 2.0, 3.0])
+    assert payload == b"data"
+
+
+def test_dataset_basics():
+    ds = gdata.ArrayDataset(np.arange(10), np.arange(10) * 2)
+    assert len(ds) == 10
+    x, y = ds[3]
+    assert x == 3 and y == 6
+    sub = ds.take(5)
+    assert len(sub) == 5
+    filt = gdata.SimpleDataset(list(range(10))).filter(lambda x: x % 2 == 0)
+    assert len(filt) == 5
+    sh = gdata.SimpleDataset(list(range(10))).shard(3, 0)
+    assert len(sh) == 4  # 10 = 4+3+3
+    t = gdata.SimpleDataset(list(range(5))).transform(lambda x: x * 10)
+    assert t[2] == 20
+
+
+def test_samplers():
+    seq = list(gdata.SequentialSampler(7))
+    assert seq == list(range(7))
+    rnd = list(gdata.RandomSampler(7))
+    assert sorted(rnd) == list(range(7))
+    bs = gdata.BatchSampler(gdata.SequentialSampler(7), 3, "keep")
+    assert [len(b) for b in bs] == [3, 3, 1]
+    bs = gdata.BatchSampler(gdata.SequentialSampler(7), 3, "discard")
+    assert [len(b) for b in bs] == [3, 3]
+    bs = gdata.BatchSampler(gdata.SequentialSampler(7), 3, "rollover")
+    assert [len(b) for b in bs] == [3, 3]
+    assert [len(b) for b in bs] == [3, 3]  # 1 rolled + 7 = 8 -> 2 batches + 2 left
+
+
+def test_dataloader_serial():
+    ds = gdata.ArrayDataset(np.random.rand(24, 3).astype(np.float32),
+                            np.arange(24).astype(np.float32))
+    loader = gdata.DataLoader(ds, batch_size=8)
+    batches = list(loader)
+    assert len(batches) == 3
+    x, y = batches[0]
+    assert x.shape == (8, 3)
+    assert y.shape == (8,)
+
+
+def test_dataloader_workers():
+    ds = gdata.ArrayDataset(np.random.rand(32, 2).astype(np.float32),
+                            np.arange(32).astype(np.float32))
+    loader = gdata.DataLoader(ds, batch_size=8, num_workers=2,
+                              thread_pool=True)
+    seen = []
+    for x, y in loader:
+        assert x.shape == (8, 2)
+        seen.extend(y.asnumpy().tolist())
+    assert sorted(seen) == list(range(32))
+
+
+def test_image_record_roundtrip(tmp_path):
+    """Pack images with pack_img, read back via ImageRecordDataset."""
+    pytest.importorskip("PIL")
+    fidx = str(tmp_path / "img.idx")
+    frec = str(tmp_path / "img.rec")
+    writer = recordio.MXIndexedRecordIO(fidx, frec, "w")
+    imgs = []
+    for i in range(4):
+        img = (np.random.rand(8, 8, 3) * 255).astype(np.uint8)
+        imgs.append(img)
+        packed = recordio.pack_img(recordio.IRHeader(0, float(i), i, 0), img,
+                                   img_fmt=".png")
+        writer.write_idx(i, packed)
+    writer.close()
+    ds = gdata.vision.ImageRecordDataset(frec)
+    assert len(ds) == 4
+    img, label = ds[2]
+    assert img.shape == (8, 8, 3)
+    np.testing.assert_array_equal(img.asnumpy(), imgs[2])  # png lossless
+    assert label == 2.0
+
+
+def test_transforms():
+    from mxnet_tpu.gluon.data.vision import transforms
+    img = mx.nd.array((np.random.rand(16, 20, 3) * 255).astype(np.uint8))
+    t = transforms.ToTensor()(img)
+    assert t.shape == (3, 16, 20)
+    assert float(t.max().asscalar()) <= 1.0
+    n = transforms.Normalize([0.5, 0.5, 0.5], [0.25, 0.25, 0.25])(t)
+    assert n.shape == (3, 16, 20)
+    r = transforms.Resize(8)(img)
+    assert r.shape == (8, 8, 3)
+    c = transforms.CenterCrop(10)(img)
+    assert c.shape == (10, 10, 3)
+    rc = transforms.RandomResizedCrop(12)(img)
+    assert rc.shape == (12, 12, 3)
+    comp = transforms.Compose([transforms.Resize(8), transforms.ToTensor()])
+    assert comp(img).shape == (3, 8, 8)
+
+
+def test_image_iter_from_list(tmp_path):
+    pytest.importorskip("PIL")
+    from PIL import Image
+    from mxnet_tpu import image as mximage
+    files = []
+    for i in range(6):
+        arr = (np.random.rand(10, 10, 3) * 255).astype(np.uint8)
+        p = str(tmp_path / f"img{i}.png")
+        Image.fromarray(arr).save(p)
+        files.append((float(i % 2), f"img{i}.png"))
+    it = mximage.ImageIter(batch_size=3, data_shape=(3, 8, 8),
+                           path_root=str(tmp_path), imglist=files)
+    batch = it.next()
+    assert batch.data[0].shape == (3, 3, 8, 8)
+    assert batch.label[0].shape == (3,)
