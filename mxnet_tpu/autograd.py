@@ -26,15 +26,20 @@ def _st():
 
 
 class TapeNode:
-    __slots__ = ("op_name", "inputs", "out_refs", "vjp_fn", "n_outputs", "attrs")
+    __slots__ = ("op_name", "inputs", "out_refs", "vjp_fn", "n_outputs",
+                 "attrs", "out_avals")
 
-    def __init__(self, op_name, inputs, out_refs, vjp_fn, n_outputs, attrs=None):
+    def __init__(self, op_name, inputs, out_refs, vjp_fn, n_outputs,
+                 attrs=None, out_avals=None):
         self.op_name = op_name
         self.inputs = inputs          # list of input NDArrays
         self.out_refs = out_refs      # weakrefs to output NDArrays
         self.vjp_fn = vjp_fn
         self.n_outputs = n_outputs
         self.attrs = attrs
+        # (shape, dtype) per output — lets backward build zero cotangents
+        # for outputs the user dropped (their weakrefs are dead by then)
+        self.out_avals = out_avals
 
 
 class Tape:
@@ -175,8 +180,11 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
                 cots.append(None)
         if not touched:
             continue
-        cots = [jnp.zeros_like(o._data) if (c is None and o is not None) else c
-                for c, o in zip(cots, outs)]
+        avals = node.out_avals or [None] * len(outs)
+        cots = [c if c is not None else
+                (jnp.zeros_like(o._data) if o is not None else
+                 jnp.zeros(av[0], av[1]))
+                for c, o, av in zip(cots, outs, avals)]
         if node.n_outputs == 1:
             in_cots = node.vjp_fn(cots[0])
         else:

@@ -663,7 +663,8 @@ class HybridBlock(Block):
             node = autograd.TapeNode(
                 f"CachedOp_{self.name}", nd_inputs,
                 [weakref.ref(r) for r in results],
-                vjp_user, len(results), None)
+                vjp_user, len(results), None,
+                out_avals=[(r.shape, r.dtype) for r in results])
             for r in results:
                 r._autograd_node = node
             tape = autograd.get_tape()

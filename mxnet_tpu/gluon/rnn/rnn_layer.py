@@ -1,0 +1,181 @@
+"""Fused multi-layer RNN layers (parity: python/mxnet/gluon/rnn/rnn_layer.py
+RNN/LSTM/GRU over the fused RNN op).
+
+The reference dispatches to cuDNN RNN descriptors (rnn-inl.h:395); here the
+fused `RNN` op is one lax.scan per layer/direction — the whole multi-layer
+recurrence compiles to a single XLA while-loop with gate matmuls on the MXU.
+Parameters use the cuDNN-canonical flat layout (ops/_op_nn.py
+rnn_unpack_params) so checkpoints map 1:1.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ... import ndarray as nd
+from ...base import MXNetError
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size, i2h_weight_initializer,
+                 h2h_weight_initializer, i2h_bias_initializer,
+                 h2h_bias_initializer, mode, **kwargs):
+        self._mode = mode  # before super(): _alias() is used for the prefix
+        super().__init__(**kwargs)
+        assert layout in ("TNC", "NTC"), \
+            f"Invalid layout {layout}; must be one of ['TNC' or 'NTC']"
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._i2h_weight_initializer = i2h_weight_initializer
+        self._h2h_weight_initializer = h2h_weight_initializer
+        self._i2h_bias_initializer = i2h_bias_initializer
+        self._h2h_bias_initializer = h2h_bias_initializer
+        self._gates = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+        from ...ops._op_nn import rnn_param_size
+        psize = rnn_param_size(mode, num_layers, input_size, hidden_size,
+                               bidirectional) if input_size else 0
+        with self.name_scope():
+            self.rnn_param = self.params.get(
+                "rnn_param", shape=(psize if psize else 0,),
+                init=i2h_weight_initializer, allow_deferred_init=True)
+
+    def _shape_hint(self, x, *states):
+        from ...ops._op_nn import rnn_param_size
+        in_sz = x.shape[-1]
+        self._input_size = in_sz
+        self.rnn_param.shape = (rnn_param_size(
+            self._mode, self._num_layers, in_sz, self._hidden_size,
+            self._dir == 2),)
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def _alias(self):
+        return self._mode
+
+    def __repr__(self):
+        s = "{name}({mapping}, {_layout}"
+        if self._num_layers != 1:
+            s += ", num_layers={_num_layers}"
+        if self._dropout != 0:
+            s += ", dropout={_dropout}"
+        if self._dir == 2:
+            s += ", bidirectional"
+        s += ")"
+        mapping = f"{self._input_size or None} -> {self._hidden_size}"
+        return s.format(name=self.__class__.__name__, mapping=mapping,
+                        **self.__dict__)
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        """Initial recurrent state (parity: rnn_layer.py begin_state)."""
+        if func is None:
+            func = nd.zeros
+        states = []
+        for i, info in enumerate(self.state_info(batch_size)):
+            if info is not None:
+                info.update(kwargs)
+            else:
+                info = kwargs
+            states.append(func(**{k: v for k, v in info.items()
+                                  if k != "__layout__"}))
+        return states
+
+    def hybrid_forward(self, F, inputs, states=None, **params):
+        if isinstance(states, nd.NDArray):
+            states = [states]
+        batch_axis = self._layout.find("N")
+        batch_size = inputs.shape[batch_axis]
+        skip_states = states is None
+        if skip_states:
+            states = self.begin_state(batch_size, ctx=inputs.ctx,
+                                      dtype=inputs.dtype)
+        if self._layout == "NTC":
+            inputs = inputs.swapaxes(0, 1)
+        rnn_args = [params["rnn_param"]] + states
+        outs = F.RNN(inputs, *rnn_args, state_size=self._hidden_size,
+                     num_layers=self._num_layers, mode=self._mode,
+                     bidirectional=self._dir == 2, state_outputs=True,
+                     p=self._dropout)
+        if self._mode == "lstm":
+            outputs, h, c = outs
+            out_states = [h, c]
+        else:
+            outputs, h = outs
+            out_states = [h]
+        if self._layout == "NTC":
+            outputs = outputs.swapaxes(0, 1)
+        if skip_states:
+            return outputs
+        return outputs, out_states
+
+    def forward(self, inputs, states=None):
+        """Entry that tolerates optional states (unlike generic HybridBlock)."""
+        try:
+            p = self.rnn_param.data(inputs.ctx)
+        except Exception:
+            self._shape_hint(inputs)
+            self.rnn_param._finish_deferred_init()
+            p = self.rnn_param.data(inputs.ctx)
+        return self.hybrid_forward(nd, inputs, states, rnn_param=p)
+
+
+class RNN(_RNNLayer):
+    """Multi-layer Elman RNN (parity: rnn_layer.py RNN)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "rnn_" + activation, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+
+class LSTM(_RNNLayer):
+    """Multi-layer LSTM (parity: rnn_layer.py LSTM)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "lstm", **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"},
+                {"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+
+class GRU(_RNNLayer):
+    """Multi-layer GRU (parity: rnn_layer.py GRU)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "gru", **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]

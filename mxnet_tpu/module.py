@@ -1,0 +1,662 @@
+"""Module API: symbolic training interface.
+
+Re-design of reference python/mxnet/module/ (BaseModule.fit:409, Module:364
+over DataParallelExecutorGroup, BucketingModule). Each Module owns one
+Executor per context; forward/backward run the whole compiled graph (the
+per-node engine pushes + bulking of graph_executor.cc collapse into one XLA
+program per signature). Batches bigger than one context are split along the
+batch axis (DataParallelExecutorGroup._load_data semantics).
+"""
+from __future__ import annotations
+
+import logging
+import time
+
+import numpy as np
+
+from . import io as mx_io
+from . import metric as metric_mod
+from . import ndarray as nd
+from . import optimizer as opt_mod
+from .base import MXNetError
+from .context import cpu
+from .initializer import Uniform
+from .model import BatchEndParam, load_checkpoint, save_checkpoint
+from .ndarray import NDArray
+
+
+class BaseModule:
+    """Base class defining the Module API (parity: module/base_module.py)."""
+
+    def __init__(self, logger=logging):
+        self.logger = logger
+        self.binded = False
+        self.for_training = False
+        self.params_initialized = False
+        self.optimizer_initialized = False
+        self.inputs_need_grad = False
+        self._symbol = None
+
+    # -- high-level train/eval loops ---------------------------------------
+    def fit(self, train_data, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None,
+            kvstore="local", optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.01),),
+            eval_end_callback=None, eval_batch_end_callback=None,
+            initializer=None, arg_params=None, aux_params=None,
+            allow_missing=False, force_rebind=False, force_init=False,
+            begin_epoch=0, num_epoch=None, validation_metric=None,
+            monitor=None, sparse_row_id_fn=None):
+        """Train the module (parity: base_module.py:409 fit)."""
+        assert num_epoch is not None, "please specify number of epochs"
+        if initializer is None:
+            initializer = Uniform(0.01)
+        self.bind(data_shapes=train_data.provide_data,
+                  label_shapes=train_data.provide_label,
+                  for_training=True, force_rebind=force_rebind)
+        if monitor is not None:
+            self.install_monitor(monitor)
+        self.init_params(initializer=initializer, arg_params=arg_params,
+                         aux_params=aux_params, allow_missing=allow_missing,
+                         force_init=force_init)
+        self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                            optimizer_params=optimizer_params)
+        if validation_metric is None:
+            validation_metric = eval_metric
+        if not isinstance(eval_metric, metric_mod.EvalMetric):
+            eval_metric = metric_mod.create(eval_metric)
+
+        for epoch in range(begin_epoch, num_epoch):
+            tic = time.time()
+            eval_metric.reset()
+            nbatch = 0
+            data_iter = iter(train_data)
+            end_of_batch = False
+            next_data_batch = next(data_iter)
+            while not end_of_batch:
+                data_batch = next_data_batch
+                if monitor is not None:
+                    monitor.tic()
+                self.forward_backward(data_batch)
+                self.update()
+                self.update_metric(eval_metric, data_batch.label)
+                try:
+                    next_data_batch = next(data_iter)
+                except StopIteration:
+                    end_of_batch = True
+                if monitor is not None:
+                    monitor.toc_print()
+                if batch_end_callback is not None:
+                    batch_end_params = BatchEndParam(
+                        epoch=epoch, nbatch=nbatch, eval_metric=eval_metric,
+                        locals=locals())
+                    for callback in _as_list(batch_end_callback):
+                        callback(batch_end_params)
+                nbatch += 1
+            for name, val in eval_metric.get_name_value():
+                self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
+            toc = time.time()
+            self.logger.info("Epoch[%d] Time cost=%.3f", epoch, (toc - tic))
+            arg_params, aux_params = self.get_params()
+            self.set_params(arg_params, aux_params)
+            if epoch_end_callback is not None:
+                for callback in _as_list(epoch_end_callback):
+                    callback(epoch, self.symbol, arg_params, aux_params)
+            if eval_data is not None:
+                res = self.score(eval_data, validation_metric,
+                                 score_end_callback=eval_end_callback,
+                                 batch_end_callback=eval_batch_end_callback,
+                                 epoch=epoch)
+                for name, val in res:
+                    self.logger.info("Epoch[%d] Validation-%s=%f", epoch,
+                                     name, val)
+            train_data.reset()
+
+    def score(self, eval_data, eval_metric, num_batch=None,
+              batch_end_callback=None, score_end_callback=None, reset=True,
+              epoch=0, sparse_row_id_fn=None):
+        """Evaluate (parity: base_module.py score)."""
+        assert self.binded and self.params_initialized
+        if reset:
+            eval_data.reset()
+        if not isinstance(eval_metric, metric_mod.EvalMetric):
+            eval_metric = metric_mod.create(eval_metric)
+        eval_metric.reset()
+        actual_num_batch = 0
+        for nbatch, eval_batch in enumerate(eval_data):
+            if num_batch is not None and nbatch == num_batch:
+                break
+            self.forward(eval_batch, is_train=False)
+            self.update_metric(eval_metric, eval_batch.label)
+            if batch_end_callback is not None:
+                batch_end_params = BatchEndParam(
+                    epoch=epoch, nbatch=nbatch, eval_metric=eval_metric,
+                    locals=locals())
+                for callback in _as_list(batch_end_callback):
+                    callback(batch_end_params)
+            actual_num_batch += 1
+        if score_end_callback:
+            params = BatchEndParam(epoch=epoch, nbatch=actual_num_batch,
+                                   eval_metric=eval_metric, locals=locals())
+            for callback in _as_list(score_end_callback):
+                callback(params)
+        return eval_metric.get_name_value()
+
+    def predict(self, eval_data, num_batch=None, merge_batches=True,
+                reset=True, always_output_list=False,
+                sparse_row_id_fn=None):
+        """Run prediction, collect outputs (parity: base_module.py predict)."""
+        assert self.binded and self.params_initialized
+        if reset:
+            eval_data.reset()
+        output_list = []
+        for nbatch, eval_batch in enumerate(eval_data):
+            if num_batch is not None and nbatch == num_batch:
+                break
+            self.forward(eval_batch, is_train=False)
+            pad = eval_batch.pad
+            outputs = [out.slice_axis(0, 0, out.shape[0] - (pad or 0))
+                       for out in self.get_outputs()]
+            output_list.append(outputs)
+        if len(output_list) == 0:
+            return output_list
+        if merge_batches:
+            num_outputs = len(output_list[0])
+            for out in output_list:
+                assert len(out) == num_outputs
+            output_list2 = [nd.concat(*[out[i] for out in output_list], dim=0)
+                            for i in range(num_outputs)]
+            if num_outputs == 1 and not always_output_list:
+                return output_list2[0]
+            return output_list2
+        return output_list
+
+    def forward_backward(self, data_batch):
+        self.forward(data_batch, is_train=True)
+        self.backward()
+
+    # -- interface subclasses implement ------------------------------------
+    @property
+    def symbol(self):
+        return self._symbol
+
+    def bind(self, *args, **kwargs):
+        raise NotImplementedError()
+
+    def init_params(self, *args, **kwargs):
+        raise NotImplementedError()
+
+    def forward(self, data_batch, is_train=None):
+        raise NotImplementedError()
+
+    def backward(self, out_grads=None):
+        raise NotImplementedError()
+
+    def update(self):
+        raise NotImplementedError()
+
+    def get_outputs(self, merge_multi_context=True):
+        raise NotImplementedError()
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        raise NotImplementedError()
+
+    def install_monitor(self, mon):
+        raise NotImplementedError()
+
+
+def _as_list(obj):
+    if isinstance(obj, (list, tuple)):
+        return obj
+    return [obj]
+
+
+class Module(BaseModule):
+    """Module over (symbol, data_names, label_names)
+    (parity: module/module.py:364)."""
+
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), logger=logging,
+                 context=None, work_load_list=None, fixed_param_names=None,
+                 state_names=None, group2ctxs=None,
+                 compression_params=None):
+        super().__init__(logger=logger)
+        if context is None:
+            context = cpu()
+        if isinstance(context, (list, tuple)):
+            context = context[0]  # one XLA program covers the device set
+        self._context = context
+        self._symbol = symbol
+        self._data_names = list(data_names) if data_names else []
+        self._label_names = list(label_names) if label_names else []
+        self._fixed_param_names = list(fixed_param_names or [])
+        arg_names = symbol.list_arguments()
+        input_names = self._data_names + self._label_names
+        self._param_names = [n for n in arg_names if n not in input_names]
+        self._aux_names = symbol.list_auxiliary_states()
+        self._arg_params = None
+        self._aux_params = None
+        self._optimizer = None
+        self._kvstore = None
+        self._updater = None
+        self._exec = None
+        self._data_shapes = None
+        self._label_shapes = None
+        self._monitor = None
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        """Create a Module from a checkpoint (parity: module.py load)."""
+        sym, args, auxs = load_checkpoint(prefix, epoch)
+        mod = Module(symbol=sym, **kwargs)
+        mod._arg_params = args
+        mod._aux_params = auxs
+        mod.params_initialized = True
+        if load_optimizer_states:
+            mod._preload_opt_states = f"{prefix}-{epoch:04d}.states"
+        return mod
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        """Save symbol + params (+ optimizer states)
+        (parity: module.py save_checkpoint)."""
+        self._sync_params_from_exec()
+        save_checkpoint(prefix, epoch, self.symbol, self._arg_params,
+                        self._aux_params)
+        if save_optimizer_states and self._updater is not None:
+            with open(f"{prefix}-{epoch:04d}.states", "wb") as f:
+                f.write(self._updater.get_states())
+
+    # -- bind / params -----------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        """Allocate executors (parity: module.py bind → GraphExecutor)."""
+        if self.binded and not force_rebind:
+            self.logger.warning("Already bound, ignoring bind()")
+            return
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.binded = True
+
+        self._data_shapes = [_as_data_desc(x) for x in data_shapes]
+        self._label_shapes = [_as_data_desc(x) for x in label_shapes] \
+            if label_shapes else []
+        shape_kwargs = {d.name: d.shape for d in self._data_shapes}
+        for l in self._label_shapes:
+            shape_kwargs[l.name] = l.shape
+        grad_req_dict = {}
+        for name in self.symbol.list_arguments():
+            if name in self._data_names:
+                grad_req_dict[name] = "write" if inputs_need_grad else "null"
+            elif name in self._label_names or name in self._fixed_param_names \
+                    or not for_training:
+                grad_req_dict[name] = "null"
+            else:
+                grad_req_dict[name] = grad_req
+        self._exec = self.symbol.simple_bind(self._context,
+                                             grad_req=grad_req_dict,
+                                             **shape_kwargs)
+        if self._arg_params is not None:
+            self._exec.copy_params_from(self._arg_params, self._aux_params,
+                                        allow_extra_params=True)
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False,
+                    allow_extra=False):
+        """Initialize parameters (parity: module.py init_params)."""
+        if self.params_initialized and not force_init:
+            return
+        assert self.binded, "call bind before initializing the parameters"
+        if initializer is None:
+            initializer = Uniform(0.01)
+        from .initializer import InitDesc
+        for name in self._param_names:
+            arr = self._exec.arg_dict[name]
+            if arg_params is not None and name in arg_params:
+                arr[:] = arg_params[name]
+            elif self._arg_params is not None and name in self._arg_params \
+                    and not force_init:
+                arr[:] = self._arg_params[name]
+            else:
+                if initializer is None and not allow_missing:
+                    raise MXNetError(f"no initializer for {name}")
+                initializer(InitDesc(name), arr)
+        for name in self._aux_names:
+            arr = self._exec.aux_dict[name]
+            if aux_params is not None and name in aux_params:
+                arr[:] = aux_params[name]
+            elif self._aux_params is not None and name in self._aux_params \
+                    and not force_init:
+                arr[:] = self._aux_params[name]
+            else:
+                initializer(InitDesc(name), arr)
+        self._sync_params_from_exec()
+        self.params_initialized = True
+
+    def get_params(self):
+        """(arg_params, aux_params) on cpu (parity: module.py get_params)."""
+        assert self.binded and self.params_initialized
+        self._sync_params_from_exec()
+        return self._arg_params, self._aux_params
+
+    def set_params(self, arg_params, aux_params, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        self.init_params(initializer=None, arg_params=arg_params,
+                         aux_params=aux_params, allow_missing=allow_missing,
+                         force_init=force_init, allow_extra=allow_extra)
+
+    def _sync_params_from_exec(self):
+        if self._exec is None:
+            return
+        self._arg_params = {n: self._exec.arg_dict[n].copy()
+                            for n in self._param_names}
+        self._aux_params = {n: self._exec.aux_dict[n].copy()
+                            for n in self._aux_names}
+
+    # -- optimizer ---------------------------------------------------------
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        """Install optimizer (parity: module.py init_optimizer)."""
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            return
+        if isinstance(optimizer, str):
+            idx2name = {i: n for i, n in enumerate(self._param_names)}
+            optimizer = opt_mod.create(
+                optimizer, param_idx2name=idx2name,
+                **dict(optimizer_params or ()))
+        self._optimizer = optimizer
+        self._updater = opt_mod.get_updater(optimizer)
+        self.optimizer_initialized = True
+        if hasattr(self, "_preload_opt_states"):
+            with open(self._preload_opt_states, "rb") as f:
+                self._updater.set_states(f.read())
+            del self._preload_opt_states
+
+    # -- compute -----------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        """Forward (parity: module.py forward; batch feeds the executor)."""
+        assert self.binded and self.params_initialized
+        if is_train is None:
+            is_train = self.for_training
+        feed = {}
+        for desc, arr in zip(self._data_shapes, data_batch.data):
+            feed[desc.name] = arr
+        if self._label_shapes and data_batch.label:
+            for desc, arr in zip(self._label_shapes, data_batch.label):
+                feed[desc.name] = arr
+        for name, arr in feed.items():
+            tgt = self._exec.arg_dict[name]
+            if tuple(arr.shape) != tuple(tgt.shape):
+                # shape change (last partial batch / bucketing): reshape
+                self._exec = self._exec.reshape(
+                    **{n: a.shape for n, a in feed.items()})
+            break
+        for name, arr in feed.items():
+            self._exec.arg_dict[name][:] = arr
+        self._exec.forward(is_train=is_train)
+
+    def backward(self, out_grads=None):
+        """Backward (parity: module.py backward)."""
+        assert self.binded and self.params_initialized
+        self._exec.backward(out_grads=out_grads)
+
+    def update(self):
+        """Apply optimizer to gradients (parity: module.py update →
+        _update_params locally; dist kvstore path via push/pull)."""
+        assert self.binded and self.params_initialized and \
+            self.optimizer_initialized
+        for i, name in enumerate(self._param_names):
+            grad = self._exec.grad_dict.get(name)
+            if grad is None:
+                continue
+            weight = self._exec.arg_dict[name]
+            self._updater(i, grad, weight)
+            grad[:] = 0.0  # write-mode semantics for the next backward
+
+    def get_outputs(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized
+        return self._exec.outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized and \
+            self.inputs_need_grad
+        return [self._exec.grad_dict[n] for n in self._data_names]
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        eval_metric.update_dict(
+            {name: l for name, l in zip([d.name for d in self._label_shapes],
+                                        labels)},
+            dict(zip(self.output_names, self._exec.outputs)))
+
+    @property
+    def output_names(self):
+        return self.symbol.list_outputs()
+
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_shapes(self):
+        return self._label_shapes
+
+    @property
+    def data_shapes(self):
+        return self._data_shapes
+
+    @property
+    def output_shapes(self):
+        return [(n, o.shape) for n, o in zip(self.output_names,
+                                             self._exec.outputs)]
+
+    def install_monitor(self, mon):
+        assert self.binded
+        mon.install(self._exec)
+
+
+class BucketingModule(BaseModule):
+    """Bucketing over variable-length inputs (parity:
+    module/bucketing_module.py). One Module per bucket key; parameters are
+    shared by name; each bucket compiles its own XLA program (one-compile-
+    per-bucket is the TPU analogue of shared-memory executors per bucket)."""
+
+    def __init__(self, sym_gen, default_bucket_key=None, logger=logging,
+                 context=None, work_load_list=None, fixed_param_names=None,
+                 state_names=None, group2ctxs=None, compression_params=None):
+        super().__init__(logger=logger)
+        assert default_bucket_key is not None
+        self._default_bucket_key = default_bucket_key
+        self._sym_gen = sym_gen
+        self._context = context
+        self._fixed_param_names = fixed_param_names
+        self._buckets = {}
+        self._curr_module = None
+        self._curr_bucket_key = None
+        self._initializer = None
+
+    @property
+    def default_bucket_key(self):
+        return self._default_bucket_key
+
+    @property
+    def symbol(self):
+        return self._curr_module.symbol
+
+    def _gen_module(self, bucket_key):
+        if bucket_key in self._buckets:
+            return self._buckets[bucket_key]
+        sym, data_names, label_names = self._sym_gen(bucket_key)
+        mod = Module(sym, data_names, label_names, logger=self.logger,
+                     context=self._context,
+                     fixed_param_names=self._fixed_param_names)
+        self._buckets[bucket_key] = mod
+        return mod
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        assert shared_module is None
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        mod = self._gen_module(self._default_bucket_key)
+        mod.bind(data_shapes, label_shapes, for_training, inputs_need_grad,
+                 force_rebind, None, grad_req)
+        self._curr_module = mod
+        self._curr_bucket_key = self._default_bucket_key
+        self.binded = True
+
+    def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
+        """Switch to a bucket (parity: bucketing_module.py switch_bucket)."""
+        assert self.binded
+        if bucket_key == self._curr_bucket_key:
+            return
+        arg_params, aux_params = self._curr_module.get_params() \
+            if self._curr_module.params_initialized else (None, None)
+        mod = self._gen_module(bucket_key)
+        if not mod.binded:
+            mod.bind(data_shapes, label_shapes, self.for_training,
+                     self.inputs_need_grad)
+        if arg_params is not None and not mod.params_initialized:
+            mod.init_params(self._initializer, arg_params=arg_params,
+                            aux_params=aux_params, allow_missing=False)
+        elif arg_params is not None:
+            mod.set_params(arg_params, aux_params)
+        if self.optimizer_initialized and not mod.optimizer_initialized:
+            mod._optimizer = self._curr_module._optimizer
+            mod._updater = self._curr_module._updater
+            mod.optimizer_initialized = True
+        self._curr_module = mod
+        self._curr_bucket_key = bucket_key
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        if initializer is None:
+            initializer = Uniform(0.01)
+        self._initializer = initializer
+        self._curr_module.init_params(initializer, arg_params, aux_params,
+                                      allow_missing, force_init, allow_extra)
+        self.params_initialized = True
+
+    def get_params(self):
+        return self._curr_module.get_params()
+
+    def set_params(self, arg_params, aux_params, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        self._curr_module.set_params(arg_params, aux_params, allow_missing,
+                                     force_init, allow_extra)
+        self.params_initialized = True
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        self._curr_module.init_optimizer(kvstore, optimizer, optimizer_params,
+                                         force_init)
+        self.optimizer_initialized = True
+
+    def forward(self, data_batch, is_train=None):
+        assert self.binded
+        bucket_key = getattr(data_batch, "bucket_key",
+                             self._default_bucket_key)
+        if bucket_key is None:
+            bucket_key = self._default_bucket_key
+        self.switch_bucket(bucket_key, data_batch.provide_data,
+                           data_batch.provide_label)
+        self._curr_module.forward(data_batch, is_train)
+
+    def backward(self, out_grads=None):
+        self._curr_module.backward(out_grads)
+
+    def update(self):
+        self._curr_module.update()
+        # propagate updated params so other buckets see them on switch
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._curr_module.get_outputs(merge_multi_context)
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        self._curr_module.update_metric(eval_metric, labels, pre_sliced)
+
+    def install_monitor(self, mon):
+        for mod in self._buckets.values():
+            mod.install_monitor(mon)
+
+
+class SequentialModule(BaseModule):
+    """Chain of modules (parity: module/sequential_module.py). Minimal
+    implementation: forward feeds each module's outputs to the next."""
+
+    META_TAKE_LABELS = "take_labels"
+    META_AUTO_WIRING = "auto_wiring"
+
+    def __init__(self, logger=logging):
+        super().__init__(logger=logger)
+        self._modules = []
+        self._metas = []
+
+    def add(self, module, **kwargs):
+        self._modules.append(module)
+        self._metas.append(kwargs)
+        return self
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        my_data_shapes = data_shapes
+        for i, module in enumerate(self._modules):
+            meta = self._metas[i]
+            my_label_shapes = label_shapes if meta.get(
+                self.META_TAKE_LABELS) else None
+            module.bind(my_data_shapes, my_label_shapes, for_training,
+                        inputs_need_grad if i == 0 else True,
+                        force_rebind, None, grad_req)
+            my_data_shapes = [mx_io.DataDesc(name, shape) for name, shape
+                              in module.output_shapes]
+        self.binded = True
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        for module in self._modules:
+            module.init_params(initializer, arg_params, aux_params,
+                               allow_missing=True, force_init=force_init)
+        self.params_initialized = True
+
+    def init_optimizer(self, **kwargs):
+        for module in self._modules:
+            module.init_optimizer(**kwargs)
+        self.optimizer_initialized = True
+
+    def forward(self, data_batch, is_train=None):
+        batch = data_batch
+        for i, module in enumerate(self._modules):
+            module.forward(batch, is_train)
+            outs = module.get_outputs()
+            batch = mx_io.DataBatch(data=outs, label=data_batch.label,
+                                    pad=data_batch.pad)
+        self._last_batch = batch
+
+    def backward(self, out_grads=None):
+        for i, module in reversed(list(enumerate(self._modules))):
+            module.backward(out_grads)
+            if i > 0:
+                out_grads = module.get_input_grads()
+
+    def update(self):
+        for module in self._modules:
+            module.update()
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._modules[-1].get_outputs(merge_multi_context)
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        for meta, module in zip(self._metas, self._modules):
+            if meta.get(self.META_TAKE_LABELS):
+                module.update_metric(eval_metric, labels, pre_sliced)
+
+
+def _as_data_desc(x):
+    if isinstance(x, mx_io.DataDesc):
+        return x
+    name, shape = x[0], x[1]
+    return mx_io.DataDesc(name, tuple(shape))

@@ -537,7 +537,8 @@ def invoke(op, inputs, attrs, out=None):
         node = autograd.TapeNode(
             op.name, nd_inputs,
             [weakref.ref(r) for r in results],
-            vjp_use, n_user, attrs)
+            vjp_use, n_user, attrs,
+            out_avals=[(r.shape, r.dtype) for r in results])
         for r in results:
             r._autograd_node = node
         tape = autograd.get_tape()

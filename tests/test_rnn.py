@@ -1,0 +1,134 @@
+"""RNN cell/layer tests (parity: reference tests/python/unittest/
+test_gluon_rnn.py strategy: shapes, unroll vs fused consistency, grads)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.gluon import rnn
+
+
+def test_rnn_cells_shapes():
+    for cell_cls, hidden in [(rnn.RNNCell, 10), (rnn.LSTMCell, 10),
+                             (rnn.GRUCell, 10)]:
+        cell = cell_cls(hidden, input_size=6)
+        cell.initialize()
+        x = mx.nd.random.uniform(shape=(4, 6))
+        states = cell.begin_state(4)
+        out, new_states = cell(x, states)
+        assert out.shape == (4, hidden)
+        assert len(new_states) == len(states)
+
+
+def test_unroll_merge():
+    cell = rnn.LSTMCell(8, input_size=5)
+    cell.initialize()
+    x = mx.nd.random.uniform(shape=(2, 6, 5))
+    outs, states = cell.unroll(6, x, layout="NTC", merge_outputs=True)
+    assert outs.shape == (2, 6, 8)
+    outs_l, _ = cell.unroll(6, x, layout="NTC", merge_outputs=False)
+    assert len(outs_l) == 6
+    np.testing.assert_allclose(outs.asnumpy()[:, 0],
+                               outs_l[0].asnumpy(), rtol=1e-5)
+
+
+def test_sequential_and_modifiers():
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.LSTMCell(8))
+    stack.add(rnn.DropoutCell(0.0))
+    stack.add(rnn.ResidualCell(rnn.LSTMCell(8)))
+    stack.initialize()
+    x = mx.nd.random.uniform(shape=(3, 5, 8))
+    out, states = stack.unroll(5, x, layout="NTC", merge_outputs=True)
+    assert out.shape == (3, 5, 8)
+
+
+def test_bidirectional():
+    bi = rnn.BidirectionalCell(rnn.GRUCell(7), rnn.GRUCell(7))
+    bi.initialize()
+    x = mx.nd.random.uniform(shape=(2, 4, 3))
+    out, states = bi.unroll(4, x, layout="NTC", merge_outputs=True)
+    assert out.shape == (2, 4, 14)
+
+
+def test_fused_layers_shapes():
+    for layer_cls, mult in [(rnn.RNN, 1), (rnn.LSTM, 1), (rnn.GRU, 1)]:
+        layer = layer_cls(12, num_layers=2, layout="NTC")
+        layer.initialize()
+        out = layer(mx.nd.random.uniform(shape=(3, 9, 4)))
+        assert out.shape == (3, 9, 12)
+    layer = rnn.LSTM(12, num_layers=2, layout="NTC", bidirectional=True)
+    layer.initialize()
+    out, states = layer(mx.nd.random.uniform(shape=(3, 9, 4)),
+                        layer.begin_state(3))
+    assert out.shape == (3, 9, 24)
+    assert states[0].shape == (4, 3, 12)
+    assert states[1].shape == (4, 3, 12)
+
+
+def test_fused_matches_cell():
+    """Fused lax.scan LSTM == explicit cell unroll with identical weights
+    (the de-facto cuDNN-vs-CPU consistency check of the reference)."""
+    from mxnet_tpu.ops._op_nn import rnn_unpack_params
+    layer = rnn.LSTM(6, num_layers=1, layout="NTC")
+    layer.initialize()
+    x = mx.nd.random.uniform(shape=(2, 4, 3))
+    want = layer(x).asnumpy()
+    ws, bs = rnn_unpack_params(layer.rnn_param.data()._data, "lstm", 1, 3, 6,
+                               False)
+    cell = rnn.LSTMCell(6, input_size=3)
+    cell.initialize()
+    cell.i2h_weight.set_data(mx.nd.array(np.asarray(ws[0][0])))
+    cell.h2h_weight.set_data(mx.nd.array(np.asarray(ws[0][1])))
+    cell.i2h_bias.set_data(mx.nd.array(np.asarray(bs[0][0])))
+    cell.h2h_bias.set_data(mx.nd.array(np.asarray(bs[0][1])))
+    got, _ = cell.unroll(4, x, layout="NTC", merge_outputs=True)
+    np.testing.assert_allclose(want, got.asnumpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_rnn_layer_backward():
+    layer = rnn.GRU(8, num_layers=2, layout="NTC")
+    layer.initialize()
+    x = mx.nd.random.uniform(shape=(2, 5, 3))
+    x.attach_grad()
+    with autograd.record():
+        out = layer(x)
+        loss = (out ** 2).sum()
+    loss.backward()
+    assert float(layer.rnn_param.grad().norm().asscalar()) > 0
+    assert float(x.grad.norm().asscalar()) > 0
+
+
+def test_cell_backward():
+    cell = rnn.LSTMCell(4, input_size=3)
+    cell.initialize()
+    x = mx.nd.random.uniform(shape=(2, 5, 3))
+    with autograd.record():
+        outs, _ = cell.unroll(5, x, layout="NTC", merge_outputs=True)
+        loss = (outs ** 2).sum()
+    loss.backward()
+    assert float(cell.i2h_weight.grad().norm().asscalar()) > 0
+
+
+def test_zoneout():
+    cell = rnn.ZoneoutCell(rnn.LSTMCell(4, input_size=3),
+                           zoneout_outputs=0.5, zoneout_states=0.5)
+    cell.initialize()
+    x = mx.nd.random.uniform(shape=(2, 5, 3))
+    outs, states = cell.unroll(5, x, layout="NTC", merge_outputs=True)
+    assert outs.shape == (2, 5, 4)
+
+
+def test_variable_length_unroll():
+    cell = rnn.LSTMCell(4, input_size=3)
+    cell.initialize()
+    x = mx.nd.random.uniform(shape=(3, 6, 3))
+    vl = mx.nd.array([2, 4, 6])
+    outs, states = cell.unroll(6, x, layout="NTC", merge_outputs=True,
+                               valid_length=vl)
+    o = outs.asnumpy()
+    assert o.shape == (3, 6, 4)
+    # steps past valid_length are masked to zero
+    assert np.allclose(o[0, 2:], 0)
+    assert np.allclose(o[1, 4:], 0)
+    assert not np.allclose(o[2, 5], 0)
