@@ -95,7 +95,11 @@ class Trainer:
         if kvstore and not isinstance(kvstore, str):
             self._kvstore = kvstore
             self._distributed = "dist" in kvstore.type
-        elif kvstore and len(self._contexts) > 1:
+        elif kvstore and ("dist" in kvstore or len(self._contexts) > 1):
+            # dist stores must be created even on a single-device worker —
+            # otherwise multi-worker training silently never synchronizes
+            # (parity: model.py _create_kvstore creates dist stores
+            # regardless of device count)
             from .. import kvstore as kvs_mod
             self._kvstore = kvs_mod.create(kvstore)
             self._distributed = "dist" in self._kvstore.type
@@ -160,7 +164,14 @@ class Trainer:
             for i, param in enumerate(self._params):
                 if param.grad_req != "null":
                     self._kvstore.push(i, param.list_grad(), priority=-i)
-                    if not self._update_on_kvstore:
+                    if self._update_on_kvstore:
+                        # optimizer ran in-store (server side for dist):
+                        # pull the updated weights back unconditionally
+                        # here — not in _update, where the stale-grad
+                        # `continue` would skip it and workers would drift
+                        # from the server (parity: trainer.py:418-423)
+                        self._kvstore.pull(i, param.list_data(), priority=-i)
+                    else:
                         self._kvstore.pull(i, param.list_grad(), priority=-i,
                                            ignore_sparse=self._distributed)
             return
@@ -210,7 +221,7 @@ class Trainer:
                     continue
                 self._last_grad_version[i] = versions
             if self._kvstore and self._update_on_kvstore:
-                continue
+                continue  # weights already pulled in _allreduce_grads
             for upd, arr, grad in zip(self._updaters, param.list_data(),
                                       param.list_grad()):
                 upd(i, grad, arr)

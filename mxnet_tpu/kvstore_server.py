@@ -8,12 +8,15 @@ DMLC_PS_ROOT_PORT, DMLC_NUM_WORKER). sync mode aggregates until all workers
 pushed then applies the updater (kvstore_dist_server.h:346 ApplyUpdates);
 async applies per push.
 
-Wire format: pickle frames with a u32 length prefix — simple and sufficient
-for localhost tests; multi-host TPU deployments use the SPMD path (XLA
-collectives over ICI/DCN), not this server.
+Wire format: pickle frames, u32 length prefix + HMAC-SHA256 of the body
+(keyed by MXNET_KVSTORE_AUTH_TOKEN, verified before deserializing).
+Localhost-only by default; multi-host TPU deployments use the SPMD path
+(XLA collectives over ICI/DCN), not this server.
 """
 from __future__ import annotations
 
+import hmac
+import hashlib
 import os
 import pickle
 import socket
@@ -22,20 +25,48 @@ import threading
 
 import numpy as np
 
+# pickle frames execute code on load: every frame carries an HMAC-SHA256 of
+# the body keyed by MXNET_KVSTORE_AUTH_TOKEN, VERIFIED BEFORE deserializing.
+# With no token configured the MAC is all-zeros and the server must only
+# listen on localhost (the default bind).
+_MAC_LEN = 32
+_MAX_FRAME = int(os.environ.get("MXNET_KVSTORE_MAX_FRAME", 1 << 30))
 
-def _send_msg(sock, obj):
-    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    sock.sendall(struct.pack("<I", len(payload)) + payload)
+
+def _token():
+    return os.environ.get("MXNET_KVSTORE_AUTH_TOKEN", "")
 
 
-def _recv_msg(sock):
+def _mac(body, token):
+    if not token:
+        return b"\x00" * _MAC_LEN
+    return hmac.new(token.encode(), body, hashlib.sha256).digest()
+
+
+def _send_msg(sock, obj, token=None):
+    token = _token() if token is None else token
+    body = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(struct.pack("<I", len(body)) + _mac(body, token) + body)
+
+
+def _recv_msg(sock, token=None):
+    token = _token() if token is None else token
     header = _recv_exact(sock, 4)
     if header is None:
         return None
     (length,) = struct.unpack("<I", header)
+    if length > _MAX_FRAME:
+        raise RuntimeError(f"kvstore frame too large: {length}")
+    mac = _recv_exact(sock, _MAC_LEN)
+    if mac is None:
+        return None
     payload = _recv_exact(sock, length)
     if payload is None:
         return None
+    if not hmac.compare_digest(mac, _mac(payload, token)):
+        # authenticate BEFORE pickle.loads — never deserialize an
+        # unauthenticated frame
+        raise RuntimeError("kvstore frame failed authentication")
     return pickle.loads(payload)
 
 
@@ -52,22 +83,44 @@ def _recv_exact(sock, n):
 class KVServer:
     """The server process main loop (parity: KVStoreDistServer)."""
 
-    def __init__(self, port=9091, num_workers=1):
+    def __init__(self, port=9091, num_workers=1, bind_addr=None,
+                 auth_token=None):
         self.port = port
+        # localhost-only by default: frames are pickle (code execution if a
+        # hostile peer can reach the port).  Cross-host deployments must set
+        # DMLC_PS_BIND_ADDR explicitly AND share MXNET_KVSTORE_AUTH_TOKEN.
+        self.bind_addr = bind_addr if bind_addr is not None else \
+            os.environ.get("DMLC_PS_BIND_ADDR", "127.0.0.1")
+        self.auth_token = auth_token if auth_token is not None else \
+            os.environ.get("MXNET_KVSTORE_AUTH_TOKEN", "")
+        if (self.bind_addr not in ("127.0.0.1", "localhost", "::1")
+                and not self.auth_token
+                and os.environ.get("MXNET_KVSTORE_ALLOW_INSECURE") != "1"):
+            raise RuntimeError(
+                "KVServer: refusing to bind a non-loopback address "
+                f"({self.bind_addr}) without MXNET_KVSTORE_AUTH_TOKEN — "
+                "unauthenticated pickle frames are remote code execution. "
+                "Set a token, or MXNET_KVSTORE_ALLOW_INSECURE=1 on a "
+                "trusted private network.")
         self.num_workers = num_workers
         self.store = {}           # key -> np.ndarray
         self.updater = None
         self.optimizer = None
         self._agg = {}            # key -> (sum, count) for sync mode
+        self._version = {}        # key -> completed sync rounds
         self._barrier_count = 0
         self._barrier_cv = threading.Condition()
         self._lock = threading.Lock()
+        # signaled whenever a sync aggregation round completes, so pulls can
+        # wait out an in-flight round (parity: the reference server buffers
+        # pull responses until ApplyUpdates runs, kvstore_dist_server.h:346)
+        self._store_cv = threading.Condition(self._lock)
         self._stop = threading.Event()
 
     def run(self):
         srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        srv.bind(("0.0.0.0", self.port))
+        srv.bind((self.bind_addr, self.port))
         srv.listen(self.num_workers * 2)
         threads = []
         try:
@@ -96,7 +149,10 @@ class KVServer:
 
     def _handle(self, conn):
         while not self._stop.is_set():
-            msg = _recv_msg(conn)
+            try:
+                msg = _recv_msg(conn, self.auth_token)
+            except RuntimeError:
+                break  # unauthenticated or oversized frame: drop connection
             if msg is None:
                 break
             op = msg["op"]
@@ -104,7 +160,7 @@ class KVServer:
                 with self._lock:
                     if msg["key"] not in self.store:
                         self.store[msg["key"]] = np.array(msg["value"])
-                _send_msg(conn, {"ok": True})
+                _send_msg(conn, {"ok": True}, self.auth_token)
             elif op == "push":
                 key = msg["key"]
                 grad = np.asarray(msg["value"])
@@ -116,15 +172,39 @@ class KVServer:
                         if c == self.num_workers:
                             self._apply_update(key, s)
                             self._agg[key] = (None, 0)
+                            self._version[key] = \
+                                self._version.get(key, 0) + 1
+                            self._store_cv.notify_all()
                         else:
                             self._agg[key] = (s, c)
                     else:
                         self._apply_update(key, grad)
-                _send_msg(conn, {"ok": True})
+                _send_msg(conn, {"ok": True}, self.auth_token)
             elif op == "pull":
-                with self._lock:
-                    val = self.store.get(msg["key"])
-                _send_msg(conn, {"ok": True, "value": val})
+                key = msg["key"]
+                # versioned pull: the client states how many sync rounds it
+                # has contributed to for this key; answering before the
+                # server has applied that round would hand back PRE-update
+                # weights (workers diverge).  A plain "no round in flight"
+                # predicate would deadlock when a fast worker opens round
+                # N+1 while a slow one still waits on round N.
+                min_version = int(msg.get("min_version", 0))
+                with self._store_cv:
+                    # must be shorter than the client's 120s socket timeout
+                    # so the error reply reaches the client instead of a
+                    # socket.timeout that desynchronizes the connection
+                    done = self._store_cv.wait_for(
+                        lambda: self._version.get(key, 0) >= min_version,
+                        timeout=100)
+                    val = self.store.get(key)
+                if not done:
+                    _send_msg(conn, {"ok": False,
+                                     "error": f"pull timeout waiting for "
+                                              f"round {min_version} of key "
+                                              f"{key}"}, self.auth_token)
+                else:
+                    _send_msg(conn, {"ok": True, "value": val},
+                              self.auth_token)
             elif op == "barrier":
                 with self._barrier_cv:
                     self._barrier_count += 1
@@ -136,7 +216,7 @@ class KVServer:
                         self._barrier_cv.wait_for(
                             lambda: self._barrier_count >=
                             target * self.num_workers, timeout=120)
-                _send_msg(conn, {"ok": True})
+                _send_msg(conn, {"ok": True}, self.auth_token)
             elif op == "command":
                 head, body = msg["head"], msg["body"]
                 if head == "set_optimizer":
@@ -153,9 +233,9 @@ class KVServer:
                     self.updater = np_updater
                 elif head == "stop":
                     self._stop.set()
-                _send_msg(conn, {"ok": True})
+                _send_msg(conn, {"ok": True}, self.auth_token)
             else:
-                _send_msg(conn, {"ok": False, "error": f"bad op {op}"})
+                _send_msg(conn, {"ok": False, "error": f"bad op {op}"}, self.auth_token)
         conn.close()
 
 
@@ -165,6 +245,7 @@ class KVClient:
     def __init__(self, host, port, rank, num_workers, timeout=120):
         self.rank = rank
         self.num_workers = num_workers
+        self._push_counts = {}    # key -> sync pushes sent (pull versioning)
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self.sock.settimeout(timeout)
         import time
@@ -193,9 +274,13 @@ class KVClient:
     def push(self, key, value, sync=True):
         self._rpc({"op": "push", "key": key, "value": np.asarray(value),
                    "sync": sync})
+        if sync:
+            self._push_counts[key] = self._push_counts.get(key, 0) + 1
 
     def pull(self, key):
-        return self._rpc({"op": "pull", "key": key})["value"]
+        return self._rpc({"op": "pull", "key": key,
+                          "min_version": self._push_counts.get(key, 0)}
+                         )["value"]
 
     def barrier(self):
         self._rpc({"op": "barrier"})

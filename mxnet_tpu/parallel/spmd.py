@@ -23,20 +23,100 @@ from ..ops import registry as _registry
 from .mesh import DeviceMesh
 
 
+def host_cpu_scope():
+    """Context manager pinning computation to the host CPU backend, or a
+    no-op when the cpu platform is unavailable (e.g. JAX_PLATFORMS=tpu)."""
+    import contextlib
+    try:
+        cpu = jax.devices("cpu")[0]
+    except RuntimeError:
+        return contextlib.nullcontext()
+    return jax.default_device(cpu)
+
+
+class FunctionalizedBlock:
+    """Pure-function view of an initialized HybridBlock.
+
+    Unpacks as (apply_fn, param_arrays, param_names) for backward compat;
+    also exposes ``mutated_idx()`` — the indices of params the forward
+    mutates in place (BatchNorm running stats), available after the first
+    (abstract or concrete) trace of ``apply_fn``.
+    """
+
+    def __init__(self, apply_fn, param_arrays, names, mutated_idx_box):
+        self.apply_fn = apply_fn
+        self.param_arrays = param_arrays
+        self.names = names
+        self._mutated_idx_box = mutated_idx_box
+
+    def __iter__(self):
+        return iter((self.apply_fn, self.param_arrays, self.names))
+
+    def mutated_idx(self, example_inputs=None):
+        """Indices into params of in-place-mutated (aux) arrays.
+
+        Known only after a trace of apply_fn; pass ``example_inputs``
+        (tuple of arrays/ShapeDtypeStructs) to (re)derive them with one
+        abstract trace (jax.eval_shape — no compile, no device work) under
+        the CURRENT train/predict mode.  Without example_inputs, returns
+        whatever the last trace observed (mode-dependent: an inference
+        trace legitimately mutates nothing).
+        """
+        if example_inputs is not None:
+            # re-trace rather than trusting whichever mode traced first —
+            # a prior inference trace would have latched [] and BN stats
+            # would silently be fed through the optimizer
+            del self._mutated_idx_box[:]
+            key = jax.random.PRNGKey(0)
+            jax.eval_shape(self.apply_fn, key, self.param_arrays,
+                           tuple(example_inputs))
+        return list(self._mutated_idx_box[0]) if self._mutated_idx_box else []
+
+    def split_train_aux(self, example_inputs):
+        """(train_idx, aux_idx): params the optimizer owns vs aux arrays the
+        forward updates itself (BN running stats).  Derived with one
+        train-mode abstract trace."""
+        from .. import autograd as _ag
+        with _ag.train_mode():
+            aux = sorted(self.mutated_idx(example_inputs))
+        aux_set = set(aux)
+        train = [i for i in range(len(self.param_arrays))
+                 if i not in aux_set]
+        return train, aux
+
+
+def merge_params(train_idx, aux_idx, train_params, aux_params):
+    """Reassemble the full functionalize-order param tuple from the
+    trainable/aux split (inverse of split_train_aux)."""
+    full = [None] * (len(train_idx) + len(aux_idx))
+    for i, w in zip(train_idx, train_params):
+        full[i] = w
+    for i, a in zip(aux_idx, aux_params):
+        full[i] = a
+    return tuple(full)
+
+
 def functionalize(block, *example_args):
     """Turn an initialized HybridBlock into a pure function.
 
-    Returns (apply_fn, param_arrays, param_names) with
+    Returns a FunctionalizedBlock unpacking as
+    (apply_fn, param_arrays, param_names) with
     apply_fn(key, params_tuple, inputs_tuple) -> (outputs_tuple, mutated_tuple)
     — the functional core the reference's CachedOp wraps statefully.
+
+    The deferred-init dry-run executes op-by-op; to avoid one device
+    compile per op (fatal over a remote-compile TPU link) it runs on the
+    host CPU backend with jit disabled — values are thrown away, only
+    shapes matter.
     """
     from ..gluon.block import _flatten
     from .. import autograd
 
-    # one imperative dry-run to finish deferred init
+    # one imperative dry-run to finish deferred init — on the host CPU
+    # backend when available, uncompiled either way
     needs = any(p._data is None for p in block.collect_params().values())
     if needs:
-        with autograd.pause():
+        with autograd.pause(), host_cpu_scope(), jax.disable_jit():
             block(*example_args)
     params = [p for p in block.collect_params().values()
               if p._data is not None]
@@ -45,7 +125,7 @@ def functionalize(block, *example_args):
     raw = entry.raw
     names = [p.name for p in params]
     arrays = tuple(p.data()._data for p in params)
-    return raw, arrays, names
+    return FunctionalizedBlock(raw, arrays, names, entry.mutated_idx_box)
 
 
 def data_parallel_shardings(mesh, params, batch_axis="dp",
@@ -155,18 +235,27 @@ class TrainStep:
     def __init__(self, block, loss_fn, optimizer, optimizer_params, mesh,
                  example_batch, batch_axis="dp", param_axis=None,
                  dtype=None):
+        from .. import autograd as _ag
+
         if not isinstance(mesh, DeviceMesh):
             raise MXNetError("mesh must be a parallel.DeviceMesh")
         self.mesh = mesh
         self.block = block
         x_ex, y_ex = example_batch
-        apply_fn, param_arrays, names = functionalize(block, x_ex)
+        fb = functionalize(block, x_ex)
+        apply_fn, param_arrays, names = fb
         if dtype is not None:
             param_arrays = tuple(a.astype(dtype) if
                                  jnp.issubdtype(a.dtype, jnp.floating) else a
                                  for a in param_arrays)
         self._apply = apply_fn
         self.param_names = names
+
+        # discover aux params (BatchNorm running stats — mutated in-place by
+        # the forward) with ONE abstract trace in train mode: no compile.
+        x_sds = jax.ShapeDtypeStruct(tuple(x_ex.shape), np.dtype(x_ex.dtype))
+        self._train_idx, self._aux_idx = fb.split_train_aux((x_sds,))
+
         lr = float(optimizer_params.get("learning_rate", 0.01))
         self._opt_attrs = {"lr": lr,
                            "wd": float(optimizer_params.get("wd", 0.0)),
@@ -182,23 +271,26 @@ class TrainStep:
         opt_init, opt_update = _FUNCTIONAL_OPTS[optimizer](self._opt_attrs)
         self._opt_update = opt_update
 
-        # shardings
+        # shardings (param_axis='fsdp' shards the largest divisible dim)
         param_sh, batch_sh = data_parallel_shardings(
             mesh, [type("S", (), {"shape": a.shape})() for a in param_arrays],
             batch_axis, param_axis)
         self._param_sh = param_sh
         self._batch_sh = batch_sh
+        train_sh = tuple(param_sh[i] for i in self._train_idx)
+        aux_sh = tuple(param_sh[i] for i in self._aux_idx)
 
-        # place params + opt state on the mesh
-        self.params = tuple(
-            jax.device_put(a, s) for a, s in zip(param_arrays, param_sh))
+        # place params + opt state on the mesh (opt state only for
+        # trainable params — the round-1 bug fed BN stats through SGD)
+        self._train_params = tuple(
+            jax.device_put(param_arrays[i], param_sh[i])
+            for i in self._train_idx)
+        self._aux_params = tuple(
+            jax.device_put(param_arrays[i], param_sh[i])
+            for i in self._aux_idx)
         self.opt_state = tuple(
             tuple(jax.device_put(s, sh) for s in opt_init(a))
-            for a, sh in zip(self.params, param_sh))
-
-        ctx_holder = self
-
-        loss_is_block = hasattr(loss_fn, "hybrid_forward") or callable(loss_fn)
+            for a, sh in zip(self._train_params, train_sh))
 
         def loss_raw(pred, label):
             if hasattr(loss_fn, "hybrid_forward"):
@@ -209,29 +301,47 @@ class TrainStep:
             return loss_fn(pred, label)
 
         opt_attrs = dict(self._opt_attrs)
+        train_idx = list(self._train_idx)
+        aux_idx = list(self._aux_idx)
 
-        def step(key, params, opt_state, x, y):
-            def compute_loss(ps):
-                outs, mutated = apply_fn(key, ps, (x,))
+        def step(key, train_params, aux_params, opt_state, x, y):
+            def compute_loss(tps):
+                ps = merge_params(train_idx, aux_idx, tps, aux_params)
+                with _ag.train_mode():
+                    outs, mutated = apply_fn(key, ps, (x,))
                 return loss_raw(outs[0], y), mutated
 
             (loss, mutated), grads = jax.value_and_grad(
-                compute_loss, has_aux=True)(params)
+                compute_loss, has_aux=True)(train_params)
             new_params = []
             new_state = []
-            for w, g, st in zip(params, grads, opt_state):
+            for w, g, st in zip(train_params, grads, opt_state):
                 nw, ns = opt_update(opt_attrs, w, g, st)
                 new_params.append(nw)
                 new_state.append(ns)
-            return tuple(new_params), tuple(new_state), loss, mutated
+            # mutated comes back in ascending-param-index order == aux order;
+            # write the new running stats into the aux slot (round-1 dropped
+            # them: inference-mode BN saw frozen stats forever)
+            new_aux = tuple(m.astype(a.dtype) for m, a in
+                            zip(mutated, aux_params)) if mutated else aux_params
+            return tuple(new_params), new_aux, tuple(new_state), loss
 
-        self._mutated_param_idx = None
-        in_sh = (None, param_sh,
-                 tuple(tuple(s for _ in range(9)) for s in param_sh),
-                 batch_sh, batch_sh)
-        # jit with shardings: params/opt state keep their placement, batch
-        # arrives sharded; XLA inserts the dp psum for grads
-        self._step = jax.jit(step)
+        state_sh = tuple(tuple(sh for _ in st)
+                         for st, sh in zip(self.opt_state, train_sh))
+        # one pjit'd program: params/opt state pinned to their shardings and
+        # DONATED (no 2x HBM), batch arrives dp-sharded; XLA inserts the dp
+        # psum for grads and fsdp all-gathers
+        self._step = jax.jit(
+            step,
+            in_shardings=(None, train_sh, aux_sh, state_sh,
+                          batch_sh, batch_sh),
+            donate_argnums=(1, 2, 3))
+
+    @property
+    def params(self):
+        """Full parameter tuple (trainable + aux) in functionalize order."""
+        return merge_params(self._train_idx, self._aux_idx,
+                            self._train_params, self._aux_params)
 
     def __call__(self, x, y):
         """Run one step; returns scalar loss (host float on .item())."""
@@ -239,12 +349,13 @@ class TrainStep:
         xs = shard_batch(self.mesh, x) if not isinstance(x, jax.Array) else x
         ys = shard_batch(self.mesh, y) if not isinstance(y, jax.Array) else y
         with self.mesh.jax_mesh:
-            self.params, self.opt_state, loss, mutated = self._step(
-                key, self.params, self.opt_state, xs, ys)
+            (self._train_params, self._aux_params, self.opt_state,
+             loss) = self._step(key, self._train_params, self._aux_params,
+                                self.opt_state, xs, ys)
         return loss
 
     def sync_to_block(self):
-        """Write the trained parameters back into the gluon block."""
+        """Write the trained parameters (and BN stats) back into the block."""
         for name, arr in zip(self.param_names, self.params):
             p = self.block.collect_params()[name]
             d = p.data()

@@ -104,6 +104,49 @@ def test_fsdp_param_sharding():
     assert any("fsdp" in str(s) for s in specs), specs
 
 
+def test_batchnorm_aux_updates_and_not_optimized():
+    """BN running stats must advance each step (round-1 regression: TrainStep
+    dropped `mutated`), and must NOT be fed through the optimizer."""
+    _need_devices(8)
+    mesh = make_mesh(dp=8)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16), nn.BatchNorm(), nn.Dense(10))
+    net.initialize(mx.initializer.Xavier())
+    x = mx.nd.random.uniform(shape=(16, 8))
+    y = mx.nd.array(np.arange(16) % 10)
+    step = TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+                     {"learning_rate": 0.1, "wd": 1e-2}, mesh,
+                     example_batch=(x, y))
+    assert len(step._aux_idx) == 2, step._aux_idx  # running_mean + running_var
+    aux_names = [step.param_names[i] for i in step._aux_idx]
+    assert all("running" in n for n in aux_names), aux_names
+    before = [np.asarray(a).copy() for a in step._aux_params]
+    for _ in range(3):
+        step(x, y)
+    after = [np.asarray(a) for a in step._aux_params]
+    assert any(not np.allclose(b, a) for b, a in zip(before, after)), \
+        "running stats frozen"
+    # optimizer state exists only for trainable params
+    assert len(step.opt_state) == len(step._train_params)
+
+
+def test_params_donated_no_double_buffer():
+    """donate_argnums must be wired: the old param buffers are invalidated
+    after a step (no 2x HBM residency)."""
+    _need_devices(8)
+    mesh = make_mesh(dp=8)
+    net = _make_net()
+    x = mx.nd.random.uniform(shape=(16, 16))
+    y = mx.nd.array(np.arange(16) % 10)
+    step = TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+                     {"learning_rate": 0.1}, mesh, example_batch=(x, y))
+    old = step._train_params
+    step(x, y)
+    assert any(getattr(p, "is_deleted", lambda: False)() for p in old), \
+        "input param buffers were not donated"
+
+
 def test_shard_batch_placement():
     _need_devices(8)
     mesh = make_mesh(dp=8)
