@@ -213,8 +213,16 @@ class Parameter:
         if self.grad_req == "null":
             self._grad = None
             return
-        self._grad = [nd.zeros(d.shape, ctx=d.ctx, dtype=d.dtype)
-                      for d in self._data]
+        if self._grad_stype == "row_sparse":
+            # sparse gradient buffers: backward writes RowSparseNDArrays
+            # holding only the touched rows (parity: Parameter grad_stype,
+            # reference parameter.py:44 row_sparse support)
+            from ..ndarray import sparse as _sp
+            self._grad = [_sp.zeros("row_sparse", d.shape, ctx=d.ctx,
+                                    dtype=d.dtype) for d in self._data]
+        else:
+            self._grad = [nd.zeros(d.shape, ctx=d.ctx, dtype=d.dtype)
+                          for d in self._data]
         for d, g in zip(self._data, self._grad):
             autograd.mark_variables([d], [g], self.grad_req)
 

@@ -10,6 +10,7 @@ in mxnet_tpu.parallel and plugs in through the same KVStore facade.
 from __future__ import annotations
 
 from .. import optimizer as opt
+from ..base import MXNetError
 from ..ndarray import NDArray
 from .parameter import Parameter
 
@@ -137,8 +138,17 @@ class Trainer:
         self._optimizer.set_learning_rate(lr)
 
     def _row_sparse_pull(self, parameter, out, row_id, full_idx=False):
-        raise NotImplementedError(
-            "row_sparse parameters are not yet supported on the TPU runtime")
+        """Pull only the rows named by row_id for a sparse parameter
+        (parity: trainer.py _row_sparse_pull → kvstore.row_sparse_pull)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._kvstore is None:
+            raise MXNetError(
+                "row_sparse parameters require a kvstore; create the "
+                "Trainer with kvstore='local' (or a dist store)")
+        i = self._param2idx[parameter.name]
+        self._kvstore.row_sparse_pull(i, out=out, row_ids=row_id,
+                                      priority=-i)
 
     def step(self, batch_size, ignore_stale_grad=False):
         """Make one parameter update step: rescale, allreduce, update

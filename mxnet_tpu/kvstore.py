@@ -69,12 +69,27 @@ class KVStore:
 
     def push(self, key, value, priority=0):
         """Sum values across devices, optionally run the in-store updater
-        (parity: KVStoreLocal::Push → Comm*::Reduce)."""
+        (parity: KVStoreLocal::Push → Comm*::Reduce; row_sparse values
+        reduce sparsely and reach the updater as row_sparse so lazy
+        optimizer updates touch only the pushed rows)."""
+        from .ndarray import sparse as _sp
         keys, values = _key_grouped(key, value)
         for k, vlist in zip(keys, values):
             if k not in self._store:
                 raise MXNetError(f"key {k} was not init()ed")
             stored = self._store[k]
+            if any(isinstance(v, _sp.BaseSparseNDArray) for v in vlist):
+                merged = vlist[0]
+                for v in vlist[1:]:
+                    merged = _sp.elemwise_add(merged, v)
+                if self._updater is not None:
+                    self._updater(_updater_key(k), merged, stored)
+                elif isinstance(merged, _sp.BaseSparseNDArray) and \
+                        not isinstance(stored, _sp.BaseSparseNDArray):
+                    stored._set_data(merged.todense()._data)
+                else:
+                    self._store[k] = merged.copy()
+                continue
             merged = vlist[0].copyto(stored.ctx) if len(vlist) == 1 else \
                 nd.add_n(*[v.as_in_context(stored.ctx) for v in vlist])
             if self._updater is not None:
@@ -83,12 +98,23 @@ class KVStore:
                 stored._set_data(merged._data)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
-        """Broadcast stored value to out arrays (parity: pull → Broadcast)."""
+        """Broadcast stored value to out arrays (parity: pull → Broadcast).
+
+        Sparse outs are skipped when ignore_sparse (reference behavior) and
+        rejected otherwise — a dense broadcast into a RowSparseNDArray would
+        desync its indices; use row_sparse_pull."""
+        from .ndarray.sparse import BaseSparseNDArray
         assert out is not None
         keys, outs = _key_grouped(key, out)
         for k, olist in zip(keys, outs):
             stored = self._store[k]
             for o in olist:
+                if isinstance(o, BaseSparseNDArray):
+                    if ignore_sparse:
+                        continue
+                    raise MXNetError(
+                        "pull into a sparse NDArray is not defined; use "
+                        "row_sparse_pull(key, out, row_ids=...)")
                 o._set_data(stored.as_in_context(o.ctx)._data)
 
     def pushpull(self, key, value, out=None, priority=0):
@@ -101,8 +127,36 @@ class KVStore:
         self.pull(key, out, priority)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        raise NotImplementedError(
-            "row_sparse keys are not yet supported by the TPU kvstore")
+        """Pull ONLY the requested rows as RowSparseNDArray(s) (parity:
+        KVStore::PullRowSparse, kvstore_dist.h:243 — the bandwidth win for
+        embedding-style parameters)."""
+        import numpy as np
+        import jax.numpy as jnp
+        from .ndarray.sparse import RowSparseNDArray
+        assert out is not None and row_ids is not None
+        keys, outs = _key_grouped(key, out)
+        ids_list = row_ids if isinstance(row_ids, (list, tuple)) else \
+            [row_ids] * len(keys)
+        for k, olist, rid in zip(keys, outs, ids_list):
+            stored = self._store[k]
+            rows = np.unique(np.asarray(
+                rid.asnumpy() if isinstance(rid, NDArray) else rid
+            ).astype(np.int64).ravel())
+            vals = self._fetch_rows(k, stored, rows)
+            for o in olist:
+                if not isinstance(o, RowSparseNDArray):
+                    raise MXNetError(
+                        "row_sparse_pull requires row_sparse out arrays "
+                        "(a dense scatter would zero the un-pulled rows)")
+                o._indices = jnp.asarray(rows)
+                o._set_data(jnp.asarray(vals))
+
+    def _fetch_rows(self, k, stored, rows):
+        import jax.numpy as jnp
+        data = stored.todense()._data \
+            if getattr(stored, "stype", "default") != "default" \
+            else stored._data
+        return data[jnp.asarray(rows)]
 
     # -- updater / optimizer ----------------------------------------------
     def set_optimizer(self, optimizer):
@@ -185,12 +239,29 @@ class KVStoreDist(KVStore):
     def push(self, key, value, priority=0):
         if self._client is None:
             return super().push(key, value, priority)
+        from .ndarray import sparse as _sp
         keys, values = _key_grouped(key, value)
+        sync = self._type in ("dist_sync", "dist_device_sync")
         for k, vlist in zip(keys, values):
+            if any(isinstance(v, _sp.BaseSparseNDArray) for v in vlist):
+                merged = vlist[0]
+                for v in vlist[1:]:
+                    merged = _sp.elemwise_add(merged, v)
+                import numpy as np
+                self._client.push_rs(
+                    k, np.asarray(merged._indices),
+                    np.asarray(merged._data), tuple(merged.shape), sync=sync)
+                continue
             merged = vlist[0] if len(vlist) == 1 else nd.add_n(
                 *[v.as_in_context(vlist[0].ctx) for v in vlist])
-            sync = self._type in ("dist_sync", "dist_device_sync")
             self._client.push(k, merged.asnumpy(), sync=sync)
+
+    def _fetch_rows(self, k, stored, rows):
+        # only the requested rows cross the wire (kvstore_dist.h:243)
+        if self._client is None:
+            return super()._fetch_rows(k, stored, rows)
+        import jax.numpy as jnp
+        return jnp.asarray(self._client.pull_rows(k, rows))
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         if self._client is None:

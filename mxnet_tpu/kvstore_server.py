@@ -163,7 +163,17 @@ class KVServer:
                 _send_msg(conn, {"ok": True}, self.auth_token)
             elif op == "push":
                 key = msg["key"]
-                grad = np.asarray(msg["value"])
+                value = msg["value"]
+                if isinstance(value, dict) and "indices" in value:
+                    # row_sparse push: only (indices, values) crossed the
+                    # wire (parity: kvstore_dist.h row_sparse push); expand
+                    # to a dense contribution for aggregation
+                    grad = np.zeros(value["shape"],
+                                    dtype=value["values"].dtype)
+                    np.add.at(grad, value["indices"].astype(np.int64),
+                              value["values"])
+                else:
+                    grad = np.asarray(value)
                 with self._lock:
                     if msg.get("sync", True):
                         s, c = self._agg.get(key, (None, 0))
@@ -203,6 +213,10 @@ class KVServer:
                                               f"round {min_version} of key "
                                               f"{key}"}, self.auth_token)
                 else:
+                    rows = msg.get("rows")
+                    if rows is not None and val is not None:
+                        # row_sparse pull: ship only the requested rows
+                        val = val[np.asarray(rows).astype(np.int64)]
                     _send_msg(conn, {"ok": True, "value": val},
                               self.auth_token)
             elif op == "barrier":
@@ -277,8 +291,25 @@ class KVClient:
         if sync:
             self._push_counts[key] = self._push_counts.get(key, 0) + 1
 
+    def push_rs(self, key, indices, values, shape, sync=True):
+        """Push a row_sparse value: only (indices, values) cross the wire."""
+        self._rpc({"op": "push", "key": key,
+                   "value": {"indices": np.asarray(indices),
+                             "values": np.asarray(values),
+                             "shape": tuple(shape)},
+                   "sync": sync})
+        if sync:
+            self._push_counts[key] = self._push_counts.get(key, 0) + 1
+
     def pull(self, key):
         return self._rpc({"op": "pull", "key": key,
+                          "min_version": self._push_counts.get(key, 0)}
+                         )["value"]
+
+    def pull_rows(self, key, rows):
+        """Pull only the requested rows (row_sparse pull)."""
+        return self._rpc({"op": "pull", "key": key,
+                          "rows": np.asarray(rows),
                           "min_version": self._push_counts.get(key, 0)}
                          )["value"]
 

@@ -7,6 +7,7 @@ positional args become inputs, keyword args become attrs, ``out=`` is honored.
 """
 from . import random  # noqa: F401
 from . import sparse  # noqa: F401
+from . import contrib  # noqa: F401
 from .ndarray import (NDArray, add_n, arange, array, concat, dot, empty, eye,
                       full, invoke, linspace, maximum, minimum, moveaxis, ones,
                       ones_like, stack, transpose, waitall, zeros, zeros_like)
@@ -34,6 +35,9 @@ _OP_FUNC_CACHE = {}
 
 
 def __getattr__(name):
+    if name == "Custom":
+        from ..operator import custom
+        return custom
     if _registry.exists(name):
         if name not in _OP_FUNC_CACHE:
             _OP_FUNC_CACHE[name] = _make_op_func(_registry.get(name))

@@ -1,0 +1,222 @@
+"""Control-flow operators: foreach / while_loop / cond.
+
+Reference: src/operator/control_flow.cc (_foreach:1089, _while_loop:1150,
+_cond:1211) — stateful subgraph-executing ops, exposed through
+python/mxnet/ndarray/contrib.py.  TPU redesign: the loop body is traced ONCE
+and lowered to ``lax.scan`` / ``lax.while_loop`` / ``lax.cond`` — one XLA
+While/Conditional HLO instead of an O(T) unrolled graph, differentiable end
+to end (the scan transpose rule replaces the reference's subgraph gradient
+machinery).  The tape sees a single node per control-flow call.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import autograd
+from ..base import MXNetError
+from .ndarray import NDArray
+
+
+def _as_list(x):
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _wrap(arrs, ctx):
+    return [NDArray(a, ctx) for a in arrs]
+
+
+def _unwrap(nds):
+    return [d._data if isinstance(d, NDArray) else jnp.asarray(d)
+            for d in nds]
+
+
+def _run_traced(fn, arg_nds, out_template=None, op_name="control_flow"):
+    """Execute a pure jax function of the flattened NDArray inputs,
+    recording ONE tape node whose vjp is the jax.vjp of the whole program.
+
+    The body may close over grad-requiring NDArrays (RNN weights etc.).
+    A discovery trace collects them (invoke's capture hook), then they are
+    lifted to explicit vjp inputs via the subst hook — the same free-
+    variable lifting the reference's subgraph cut does (control_flow.cc).
+    """
+    from .ndarray import _trace_hooks
+    ctx = arg_nds[0]._ctx if arg_nds else None
+    arrays = _unwrap(arg_nds)
+    if not autograd.is_recording():
+        outs = fn(*arrays)
+        return _wrap(outs, ctx)
+
+    # pass 1: discover free variables that need gradients (abstract, cheap)
+    captured = {}
+    prev_cap = _trace_hooks.capture
+    _trace_hooks.capture = captured
+    try:
+        jax.eval_shape(fn, *arrays)
+    finally:
+        _trace_hooks.capture = prev_cap
+    arg_ids = {id(a) for a in arg_nds}
+    cap_nds = [v for k, v in captured.items() if k not in arg_ids]
+    cap_ids = [id(v) for v in cap_nds]
+    all_nds = list(arg_nds) + cap_nds
+    n_args = len(arg_nds)
+
+    def fn_lifted(*all_arrays):
+        subst = dict(zip(cap_ids, all_arrays[n_args:]))
+        prev = _trace_hooks.subst
+        _trace_hooks.subst = {**(prev or {}), **subst}
+        try:
+            return fn(*all_arrays[:n_args])
+        finally:
+            _trace_hooks.subst = prev
+
+    outs, vjp_fn = jax.vjp(fn_lifted, *[d._data for d in all_nds])
+    out_nds = _wrap(outs, ctx)
+
+    def tape_vjp(cts, _v=vjp_fn):
+        return _v(tuple(cts if isinstance(cts, tuple) else (cts,)))
+
+    autograd.record_custom(op_name, all_nds, out_nds, tape_vjp)
+    return out_nds
+
+
+def foreach(body, data, init_states, name="foreach"):
+    """Scan ``body`` over dim 0 of ``data`` (parity:
+    python/mxnet/ndarray/contrib.py:136 / control_flow.cc:1089).
+
+    body(data_slice, states) -> (out, new_states); outputs are stacked along
+    a new axis 0; the final states are returned second.  Lowered to ONE
+    ``lax.scan`` — compile time and graph size are O(1) in sequence length.
+    """
+    data_l = _as_list(data)
+    states_l = _as_list(init_states)
+    n_data = len(data_l)
+    n_states = len(states_l)
+    train = autograd.is_training()
+    ctx = (data_l + states_l)[0]._ctx
+    out_struct = {}
+
+    def scan_fn(*arrays):
+        data_arrs = arrays[:n_data]
+        state_arrs = arrays[n_data:]
+
+        def step(carry, xs):
+            with autograd.pause(train_mode=train):
+                d_nds = _wrap(list(xs), ctx)
+                s_nds = _wrap(list(carry), ctx)
+                out, new_states = body(
+                    d_nds[0] if not isinstance(data, (list, tuple))
+                    else d_nds,
+                    s_nds[0] if not isinstance(init_states, (list, tuple))
+                    and n_states == 1 else s_nds)
+                out_l = _as_list(out)
+                ns_l = _as_list(new_states)
+                out_struct["single_out"] = not isinstance(out, (list, tuple))
+                return (tuple(_unwrap(ns_l)), tuple(_unwrap(out_l)))
+
+        final_states, stacked = jax.lax.scan(step, tuple(state_arrs),
+                                             tuple(data_arrs))
+        return tuple(stacked) + tuple(final_states)
+
+    out_nds = _run_traced(scan_fn, data_l + states_l, op_name="_foreach")
+    n_outs = len(out_nds) - n_states
+    outs = out_nds[:n_outs]
+    states = out_nds[n_outs:]
+    outs_r = outs[0] if out_struct.get("single_out", n_outs == 1) else outs
+    states_r = states if isinstance(init_states, (list, tuple)) else states[0]
+    return outs_r, states_r
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None,
+               name="while_loop"):
+    """Bounded while loop (parity: ndarray/contrib.py:232 /
+    control_flow.cc:1150).
+
+    Lowered to ``lax.scan`` over ``max_iterations`` with an active-flag
+    carry and ``lax.cond`` per step — a single XLA While, differentiable.
+    As in the reference ndarray implementation, stacked outputs have
+    axis 0 == max_iterations (steps after termination are zero).
+    Returns (stacked_step_outputs, final_loop_vars).
+    """
+    if max_iterations is None:
+        raise MXNetError("while_loop requires max_iterations "
+                         "(static bound for the compiled loop)")
+    vars_l = _as_list(loop_vars)
+    n_vars = len(vars_l)
+    train = autograd.is_training()
+    ctx = vars_l[0]._ctx
+    meta = {}
+
+    def scan_prog(*state_arrs):
+        def step(carry, _):
+            active, vs = carry
+
+            def run_body(vs_):
+                with autograd.pause(train_mode=train):
+                    out, new_vars = func(*_wrap(list(vs_), ctx))
+                    out_l = _unwrap(_as_list(out))
+                    meta["single_out"] = not isinstance(out, (list, tuple))
+                    nv = _unwrap(_as_list(new_vars))
+                return tuple(nv), tuple(out_l)
+
+            def run_cond(vs_):
+                with autograd.pause(train_mode=train):
+                    c = cond(*_wrap(list(vs_), ctx))
+                return (c._data if isinstance(c, NDArray) else c
+                        ).astype(jnp.bool_).reshape(())
+
+            # trace the body once to learn output shapes for the skip branch
+            out_sds = jax.eval_shape(lambda v: run_body(v)[1], vs)
+            zeros = tuple(jnp.zeros(s.shape, s.dtype) for s in out_sds)
+            do = jnp.logical_and(active, run_cond(vs))
+
+            new_vs, outs = jax.lax.cond(
+                do, lambda v: run_body(v),
+                lambda v: (tuple(v), zeros), vs)
+            return (do, new_vs), outs
+
+        (final_active, final_vs), stacked = jax.lax.scan(
+            step, (jnp.asarray(True), tuple(state_arrs)), None,
+            length=int(max_iterations))
+        return tuple(stacked) + tuple(final_vs)
+
+    out_nds = _run_traced(scan_prog, vars_l, op_name="_while_loop")
+    n_outs = len(out_nds) - n_vars
+    outs = out_nds[:n_outs]
+    final_vars = out_nds[n_outs:]
+    outs_r = outs[0] if meta.get("single_out", n_outs == 1) else outs
+    return outs_r, final_vars
+
+
+def cond(pred, then_func, else_func, name="cond"):
+    """If-then-else (parity: ndarray/contrib.py:400 / control_flow.cc:1211).
+
+    Imperative semantics match the reference: the predicate is evaluated on
+    host and ONLY the chosen branch executes (its ops record on the tape
+    normally, so gradients flow).  Under an outer trace (hybridize) the
+    predicate is a tracer — then both branches are traced into one
+    ``lax.cond``.
+    """
+    p = pred._data if isinstance(pred, NDArray) else pred
+    if isinstance(p, jax.core.Tracer):
+        then_outs = {}
+
+        def t_branch(_):
+            with autograd.pause(train_mode=autograd.is_training()):
+                out = then_func()
+            then_outs["single"] = not isinstance(out, (list, tuple))
+            return tuple(_unwrap(_as_list(out)))
+
+        def e_branch(_):
+            with autograd.pause(train_mode=autograd.is_training()):
+                out = else_func()
+            return tuple(_unwrap(_as_list(out)))
+
+        outs = jax.lax.cond(p.astype(jnp.bool_).reshape(()),
+                            t_branch, e_branch, 0)
+        from ..context import current_context
+        nds = _wrap(list(outs), current_context())
+        return nds[0] if then_outs.get("single", len(nds) == 1) else nds
+    take_then = bool(jnp.any(p != 0)) if hasattr(p, "shape") else bool(p)
+    out = then_func() if take_then else else_func()
+    return out

@@ -26,6 +26,26 @@ from ..ops import registry as _registry
 _TRAINING_ATTR_OPS = {"Dropout", "BatchNorm"}
 
 
+class _TraceHooks(__import__("threading").local):
+    """Closure-capture hooks for control-flow tracing (ndarray/contrib.py).
+
+    capture: dict filled with grad-requiring NDArrays whose concrete
+             buffers an op touches during a discovery trace — these are the
+             loop body's free variables that must be lifted to explicit
+             differentiation inputs (the reference lifts subgraph free vars
+             as extra op inputs, control_flow.cc).
+    subst:   id(NDArray) -> tracer, consulted at op dispatch so a retrace
+             sees those free variables as function inputs.
+    """
+
+    def __init__(self):
+        self.capture = None
+        self.subst = None
+
+
+_trace_hooks = _TraceHooks()
+
+
 class NDArray:
     __array_priority__ = 1000.0
 
@@ -99,8 +119,17 @@ class NDArray:
         self._grad_req = req
 
     def attach_grad(self, grad_req="write", stype=None):
-        """Parity: ndarray.py attach_grad — allocate grad buffer + mark."""
-        g = zeros(self.shape, ctx=self._ctx, dtype=self.dtype)
+        """Parity: ndarray.py attach_grad — allocate grad buffer + mark.
+
+        stype='row_sparse' keeps the gradient row-sparse end-to-end
+        (Embedding sparse_grad / sparse linear models): backward writes a
+        RowSparseNDArray holding only the touched rows."""
+        if stype == "row_sparse":
+            from . import sparse as _sp
+            g = _sp.zeros("row_sparse", self.shape, ctx=self._ctx,
+                          dtype=self.dtype)
+        else:
+            g = zeros(self.shape, ctx=self._ctx, dtype=self.dtype)
         autograd.mark_variables([self], [g], grad_req)
 
     def detach(self):
@@ -452,7 +481,22 @@ def invoke(op, inputs, attrs, out=None):
         _prof_t0 = _time.perf_counter()
 
     nd_inputs = [i for i in inputs if isinstance(i, NDArray)]
-    arrays = [i._data for i in inputs]
+    hooks = _trace_hooks
+    if hooks.subst is None and hooks.capture is None:
+        arrays = [i._data for i in inputs]
+    else:
+        arrays = []
+        for i in inputs:
+            a = i._data if isinstance(i, NDArray) else i
+            if isinstance(i, NDArray):
+                if hooks.subst is not None:
+                    a = hooks.subst.get(id(i), a)
+                if hooks.capture is not None and \
+                        not isinstance(a, jax.core.Tracer) and \
+                        (i._grad is not None or
+                         i._autograd_node is not None):
+                    hooks.capture[id(i)] = i
+            arrays.append(a)
     if op.is_random:
         arrays = [_random.next_key()] + arrays
 

@@ -199,23 +199,31 @@ def zeros(stype, shape, ctx=None, dtype=None):
     if stype == "row_sparse":
         row_shape = tuple(shape[1:])
         return RowSparseNDArray(jnp.zeros((0,) + row_shape, dtype=dtype),
-                                jnp.zeros((0,), dtype=jnp.int64), shape, ctx)
+                                jnp.zeros((0,), dtype=jnp.int32), shape, ctx)
     if stype == "csr":
         return CSRNDArray(jnp.zeros((0,), dtype=dtype),
-                          jnp.zeros((0,), dtype=jnp.int64),
-                          jnp.zeros((shape[0] + 1,), dtype=jnp.int64), shape, ctx)
+                          jnp.zeros((0,), dtype=jnp.int32),
+                          jnp.zeros((shape[0] + 1,), dtype=jnp.int32), shape, ctx)
     from .ndarray import zeros as _z
     return _z(shape, ctx=ctx, dtype=dtype)
 
 
 def cast_storage(arr, stype):
+    """dense<->sparse conversion (reference: cast_storage-inl.h).
+
+    dense->row_sparse: the nonzero-row mask is computed ON DEVICE; only the
+    (rows,) bool mask syncs to host to fix the nnz shape, then values are
+    gathered on device — no full-tensor host roundtrip."""
     if stype == "default":
         return arr.tostype("default") if isinstance(arr, BaseSparseNDArray) else arr
     if stype == "row_sparse":
         if isinstance(arr, RowSparseNDArray):
             return arr
-        dense = arr.asnumpy()
-        return row_sparse_array(dense, ctx=arr.ctx, dtype=dense.dtype)
+        mask = jnp.any(arr._data.reshape(arr.shape[0], -1) != 0, axis=1)
+        nz = np.nonzero(np.asarray(mask))[0]
+        vals = arr._data[jnp.asarray(nz)]
+        return RowSparseNDArray(vals, jnp.asarray(nz.astype(np.int64)),
+                                arr.shape, arr.ctx)
     if stype == "csr":
         if isinstance(arr, CSRNDArray):
             return arr
@@ -224,16 +232,24 @@ def cast_storage(arr, stype):
 
 
 def sparse_retain(arr, indices):
-    """Keep only the given rows of a RowSparseNDArray (reference:
-    src/operator/tensor/sparse_retain.cc)."""
+    """Keep only the requested rows of a RowSparseNDArray (reference:
+    src/operator/tensor/sparse_retain.cc).  Output nnz == len(indices)
+    (static shape); rows absent from the input come back zero."""
     if not isinstance(arr, RowSparseNDArray):
         raise MXNetError("sparse_retain expects row_sparse input")
-    want = indices._data.astype(jnp.int64) if isinstance(indices, NDArray) else jnp.asarray(indices, jnp.int64)
-    # membership of stored rows in wanted set; keeps static shape = nnz in
-    mask = jnp.isin(arr._indices, want)
-    data = jnp.where(mask.reshape((-1,) + (1,) * (arr._data.ndim - 1)),
-                     arr._data, jnp.zeros_like(arr._data))
-    return RowSparseNDArray(data, arr._indices, arr.shape, arr._ctx)
+    want = indices._data if isinstance(indices, NDArray) else \
+        jnp.asarray(indices)
+    want = jnp.sort(want.astype(arr._indices.dtype))
+    if arr._indices.shape[0] == 0:
+        data = jnp.zeros((want.shape[0],) + arr._data.shape[1:],
+                         arr._data.dtype)
+        return RowSparseNDArray(data, want, arr.shape, arr._ctx)
+    pos = jnp.clip(jnp.searchsorted(arr._indices, want), 0,
+                   arr._indices.shape[0] - 1)
+    found = arr._indices[pos] == want
+    data = jnp.where(found.reshape((-1,) + (1,) * (arr._data.ndim - 1)),
+                     arr._data[pos], 0).astype(arr._data.dtype)
+    return RowSparseNDArray(data, want, arr.shape, arr._ctx)
 
 
 def _sparse_dot(a, b, transpose_a=False, transpose_b=False):
@@ -241,25 +257,137 @@ def _sparse_dot(a, b, transpose_a=False, transpose_b=False):
 
     csr·dense and csrᵀ·dense are the capability-critical paths (linear model
     training on Criteo): emitted as segment-sum gathers so nnz work only.
+    Differentiable w.r.t. the DENSE operand: the cotangent is produced as a
+    row-sparse SparseCot (only rows referenced by the csr matrix), matching
+    the reference's sparse gradient storage inference.
     """
-    if isinstance(a, CSRNDArray) and isinstance(b, NDArray) and not isinstance(b, BaseSparseNDArray):
+    from .. import autograd as _ag
+
+    if isinstance(a, CSRNDArray) and isinstance(b, NDArray) and \
+            not isinstance(b, BaseSparseNDArray):
+        if transpose_b:
+            raise MXNetError("dot(csr, dense, transpose_b=True) unsupported")
+        nnz = a._data.shape[0]
         rows = a._row_ids()
         cols = a._indices.astype(jnp.int32)
+        data = a._data
         if not transpose_a:
-            # out[r, :] += data * b[col, :]
-            contrib = a._data[:, None] * b._data[cols]
+            # out[r, :] = Σ_k data[k]·b[col_k, :]
+            contrib = data[:, None] * b._data[cols]
             out = jax.ops.segment_sum(contrib, rows, num_segments=a.shape[0])
-            return NDArray(out, a._ctx)
-        # a^T b: out[col, :] += data * b[row, :]
-        contrib = a._data[:, None] * b._data[rows]
-        out = jnp.zeros((a.shape[1], b.shape[1]), dtype=b.dtype)
-        out = out.at[cols].add(contrib)
-        return NDArray(out, a._ctx)
+            result = NDArray(out, a._ctx)
+
+            def vjp(ct, _data=data, _rows=rows, _cols=cols,
+                    _shape=b.shape):
+                # db[j, :] = Σ_{k: col_k=j} data[k]·ct[row_k, :]
+                vals = _data[:, None] * ct[_rows]
+                return (_ag.SparseCot(_cols, vals, _shape),)
+        else:
+            # out[j, :] = Σ_{k: col_k=j} data[k]·b[row_k, :]
+            contrib = data[:, None] * b._data[rows]
+            out = jnp.zeros((a.shape[1], b.shape[1]), dtype=b.dtype)
+            out = out.at[cols].add(contrib)
+            result = NDArray(out, a._ctx)
+
+            def vjp(ct, _data=data, _rows=rows, _cols=cols,
+                    _shape=b.shape):
+                # db[r, :] = Σ_{k: row_k=r} data[k]·ct[col_k, :]
+                vals = _data[:, None] * ct[_cols]
+                return (_ag.SparseCot(_rows, vals, _shape),)
+
+        _ag.record_custom("dot_csr_dense", [b], [result], vjp,
+                          {"transpose_a": transpose_a})
+        return result
     if isinstance(a, RowSparseNDArray):
-        return NDArray(jnp.tensordot(a.todense()._data, b._data, axes=1), a._ctx)
+        return NDArray(jnp.tensordot(a.todense()._data, b._data, axes=1),
+                       a._ctx)
     if isinstance(b, BaseSparseNDArray):
-        return NDArray(jnp.tensordot(a._data, b.todense()._data, axes=1), a._ctx)
+        return NDArray(jnp.tensordot(a._data, b.todense()._data, axes=1),
+                       a._ctx)
     raise MXNetError("unsupported sparse dot combination")
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """mx.nd.sparse.dot (reference python/mxnet/ndarray/sparse.py dot)."""
+    if isinstance(lhs, BaseSparseNDArray) or isinstance(rhs, BaseSparseNDArray):
+        return _sparse_dot(lhs, rhs, transpose_a, transpose_b)
+    from .ndarray import dot as _dense_dot
+    return _dense_dot(lhs, rhs, transpose_a=transpose_a,
+                      transpose_b=transpose_b)
+
+
+def square_sum(arr, axis=None, keepdims=False):
+    """Σ data² over only the stored rows of a RowSparseNDArray (reference:
+    src/operator/tensor/square_sum-inl.h — the group-lasso building block)."""
+    if not isinstance(arr, RowSparseNDArray):
+        raise MXNetError("square_sum expects row_sparse input")
+    sq = arr._data * arr._data
+    if axis is None:
+        out = sq.sum()
+        if keepdims:
+            out = out.reshape((1,) * len(arr.shape))
+        return NDArray(out, arr._ctx)
+    if axis in (1, -1) and arr._data.ndim == 2:
+        # per-stored-row sums -> row_sparse result (parity with reference
+        # FInferStorageType: row_sparse in, row_sparse out for axis=1)
+        vals = sq.sum(axis=1, keepdims=keepdims)
+        if keepdims:
+            return RowSparseNDArray(vals, arr._indices,
+                                    (arr.shape[0], 1), arr._ctx)
+        return RowSparseNDArray(vals, arr._indices, (arr.shape[0],),
+                                arr._ctx)
+    if axis == 0:
+        out = jnp.zeros(arr.shape[1:], sq.dtype)
+        out = out + sq.sum(axis=0)
+        if keepdims:
+            out = out[None]
+        return NDArray(out, arr._ctx)
+    raise MXNetError(f"square_sum: unsupported axis {axis}")
+
+
+# -- lazy (row-sparse-gradient) optimizer kernels ---------------------------
+# Parity: reference optimizer_op.cc sparse sgd/adam FComputeEx with
+# lazy_update=True (python/mxnet/optimizer/optimizer.py:511): only rows
+# present in the gradient are touched — weight decay, momentum decay and
+# adam moment decay all apply to JUST those rows.
+
+def _prep_grad(grad_rs, rescale, clip):
+    g = grad_rs._data * rescale
+    if clip is not None:
+        g = jnp.clip(g, -clip, clip)
+    return grad_rs._indices.astype(jnp.int32), g
+
+
+def sgd_lazy_update(weight, grad_rs, mom, lr, wd, momentum=0.0,
+                    rescale_grad=1.0, clip_gradient=None):
+    """In-place lazy SGD(+momentum) on only the gradient's rows."""
+    idx, g = _prep_grad(grad_rs, rescale_grad, clip_gradient)
+    w_rows = weight._data[idx]
+    g = g.astype(w_rows.dtype) + wd * w_rows
+    if mom is not None and momentum != 0.0:
+        m_rows = mom._data[idx]
+        m_new = momentum * m_rows - lr * g
+        mom._set_data(mom._data.at[idx].set(m_new))
+        w_new = w_rows + m_new
+    else:
+        w_new = w_rows - lr * g
+    weight._set_data(weight._data.at[idx].set(w_new))
+
+
+def adam_lazy_update(weight, grad_rs, mean, var, lr, wd, beta1=0.9,
+                     beta2=0.999, epsilon=1e-8, t=1,
+                     rescale_grad=1.0, clip_gradient=None):
+    """In-place lazy Adam on only the gradient's rows."""
+    idx, g = _prep_grad(grad_rs, rescale_grad, clip_gradient)
+    w_rows = weight._data[idx]
+    g = g.astype(w_rows.dtype) + wd * w_rows
+    m_rows = beta1 * mean._data[idx] + (1 - beta1) * g
+    v_rows = beta2 * var._data[idx] + (1 - beta2) * g * g
+    mean._set_data(mean._data.at[idx].set(m_rows))
+    var._set_data(var._data.at[idx].set(v_rows))
+    lr_t = lr * np.sqrt(1 - beta2 ** t) / (1 - beta1 ** t)
+    w_new = w_rows - lr_t * m_rows / (jnp.sqrt(v_rows) + epsilon)
+    weight._set_data(weight._data.at[idx].set(w_new))
 
 
 def elemwise_add(a, b):
@@ -270,4 +398,7 @@ def elemwise_add(a, b):
         pb = jnp.searchsorted(idx, b._indices)
         da = da.at[pa].add(a._data).at[pb].add(b._data)
         return RowSparseNDArray(da, idx, a.shape, a._ctx)
-    return a.todense() + b.todense() if isinstance(a, BaseSparseNDArray) else a + b
+    # mixed sparse/dense: densify the sparse side (full-shape result)
+    da = a.tostype("default") if isinstance(a, BaseSparseNDArray) else a
+    db = b.tostype("default") if isinstance(b, BaseSparseNDArray) else b
+    return da + db

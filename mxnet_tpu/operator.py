@@ -1,0 +1,228 @@
+"""Custom operators in Python (parity: python/mxnet/operator.py).
+
+Reference architecture: CustomOp/CustomOpProp registered by name; the C++
+host (src/operator/custom/custom-inl.h:52) runs Python callbacks on a
+DEDICATED worker thread pool pushing async engine ops so the engine never
+blocks on Python.  TPU redesign:
+
+- imperative path: the op runs directly (host Python is already off the
+  device's critical path — XLA dispatch is async);
+- traced path (hybridize / jit): the op body is staged as a
+  ``jax.pure_callback`` with a ``jax.custom_vjp`` whose backward is a second
+  pure_callback — the XLA program calls back into Python at the exact
+  graph position, the TPU-era equivalent of the reference's callback host.
+
+Usage (same surface as the reference):
+
+    @mx.operator.register("softsign")
+    class SoftsignProp(mx.operator.CustomOpProp):
+        def list_arguments(self): return ['data']
+        def list_outputs(self): return ['output']
+        def infer_shape(self, in_shape): return in_shape, [in_shape[0]]
+        def create_operator(self, ctx, shapes, dtypes): return Softsign()
+
+    y = mx.nd.Custom(x, op_type="softsign")
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import autograd
+from .base import MXNetError
+from .context import current_context
+from .ndarray import NDArray
+
+_REGISTRY = {}
+
+
+class CustomOp:
+    """Base class for custom ops (parity: operator.py:428)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError()
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError()
+
+    def assign(self, dst, req, src):
+        """Write src into dst honoring the write/add/null req."""
+        if req in ("null", None):
+            return
+        if req == "add":
+            dst[:] = dst + src
+        else:
+            dst[:] = src
+
+
+class CustomOpProp:
+    """Op metadata provider (parity: operator.py:474)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+        self.kwargs = {}
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        raise NotImplementedError()
+
+
+def register(reg_name):
+    """Decorator registering a CustomOpProp subclass under reg_name
+    (parity: operator.py:694)."""
+
+    def deco(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError("can only register subclasses of CustomOpProp")
+        _REGISTRY[reg_name] = prop_cls
+        return prop_cls
+
+    return deco
+
+
+def _normalize_shapes(result, n_in):
+    """infer_shape may return (in, out) or (in, out, aux)."""
+    if len(result) == 2:
+        in_s, out_s = result
+        aux_s = []
+    else:
+        in_s, out_s, aux_s = result
+    return list(in_s), list(out_s), list(aux_s)
+
+
+def _make_prop(op_type, kwargs):
+    prop_cls = _REGISTRY.get(op_type)
+    if prop_cls is None:
+        raise MXNetError(f"custom op type {op_type!r} is not registered")
+    prop = prop_cls(**{k: str(v) for k, v in kwargs.items()}) \
+        if _prop_wants_kwargs(prop_cls) else prop_cls()
+    prop.kwargs = kwargs
+    return prop
+
+
+def _prop_wants_kwargs(prop_cls):
+    import inspect
+    sig = inspect.signature(prop_cls.__init__)
+    params = [p for n, p in sig.parameters.items() if n != "self"]
+    return any(p.kind in (p.VAR_KEYWORD, p.POSITIONAL_OR_KEYWORD)
+               for p in params) and len(params) > 0
+
+
+def custom(*inputs, op_type=None, **kwargs):
+    """nd.Custom(...): run a registered custom op imperatively or staged
+    (parity: the generated Custom op over custom-inl.h)."""
+    if op_type is None:
+        raise MXNetError("Custom requires op_type=")
+    nd_inputs = [i for i in inputs if isinstance(i, NDArray)]
+    ctx = nd_inputs[0]._ctx if nd_inputs else current_context()
+    prop = _make_prop(op_type, kwargs)
+
+    in_shapes = [tuple(i.shape) for i in nd_inputs]
+    in_types = [i.dtype for i in nd_inputs]
+    in_s, out_s, _aux_s = _normalize_shapes(prop.infer_shape(in_shapes),
+                                            len(nd_inputs))
+    t_res = prop.infer_type(in_types)
+    out_t = list(t_res[1]) if isinstance(t_res, tuple) else \
+        [in_types[0]] * len(out_s)
+    op = prop.create_operator(ctx, in_s, in_types)
+
+    traced = any(isinstance(i._data, jax.core.Tracer) for i in nd_inputs)
+    if traced:
+        return _custom_traced(op, prop, nd_inputs, out_s, out_t, ctx)
+    return _custom_imperative(op, prop, nd_inputs, out_s, out_t, ctx)
+
+
+def _custom_imperative(op, prop, nd_inputs, out_shapes, out_types, ctx):
+    from . import ndarray as ndmod
+    out_data = [ndmod.zeros(s, ctx=ctx, dtype=t)
+                for s, t in zip(out_shapes, out_types)]
+    with autograd.pause(train_mode=autograd.is_training()):
+        op.forward(is_train=autograd.is_training(),
+                   req=["write"] * len(out_data),
+                   in_data=list(nd_inputs), out_data=out_data, aux=[])
+    if autograd.is_recording():
+        def vjp(cts, _op=op, _ins=nd_inputs, _outs=out_data, _ctx=ctx):
+            cts_t = cts if isinstance(cts, tuple) else (cts,)
+            ograds = [NDArray(c, _ctx) for c in cts_t]
+            igrads = [ndmod.zeros(i.shape, ctx=_ctx, dtype=i.dtype)
+                      for i in _ins]
+            with autograd.pause():
+                _op.backward(req=["write"] * len(igrads), out_grad=ograds,
+                             in_data=list(_ins), out_data=list(_outs),
+                             in_grad=igrads, aux=[])
+            return tuple(g._data for g in igrads)
+
+        autograd.record_custom(f"Custom:{type(op).__name__}", nd_inputs,
+                               out_data, vjp)
+    return out_data[0] if len(out_data) == 1 else out_data
+
+
+def _custom_traced(op, prop, nd_inputs, out_shapes, out_types, ctx):
+    """Stage the custom op into the surrounding XLA program as a host
+    callback with a custom VJP (the pure_callback equivalent of the
+    reference's custom-op worker threads)."""
+    from . import ndarray as ndmod
+    from .base import np_dtype
+    n_in = len(nd_inputs)
+    out_sds = tuple(jax.ShapeDtypeStruct(tuple(s), np_dtype(t))
+                    for s, t in zip(out_shapes, out_types))
+    in_sds = tuple(jax.ShapeDtypeStruct(tuple(i.shape), np_dtype(i.dtype))
+                   for i in nd_inputs)
+    train = autograd.is_training()
+
+    def host_fwd(*arrs):
+        ins = [ndmod.array(np.asarray(a)) for a in arrs]
+        outs = [ndmod.zeros(s.shape, dtype=s.dtype) for s in out_sds]
+        with autograd.pause(train_mode=train):
+            op.forward(is_train=train, req=["write"] * len(outs),
+                       in_data=ins, out_data=outs, aux=[])
+        return tuple(np.asarray(o.asnumpy(), dtype=s.dtype)
+                     for o, s in zip(outs, out_sds))
+
+    def host_bwd(*arrs):
+        ins = [ndmod.array(np.asarray(a)) for a in arrs[:n_in]]
+        cts = [ndmod.array(np.asarray(a)) for a in arrs[n_in:]]
+        outs = [ndmod.zeros(s.shape, dtype=s.dtype) for s in out_sds]
+        igrads = [ndmod.zeros(s.shape, dtype=s.dtype) for s in in_sds]
+        with autograd.pause():
+            op.forward(is_train=True, req=["write"] * len(outs),
+                       in_data=ins, out_data=outs, aux=[])
+            op.backward(req=["write"] * len(igrads), out_grad=cts,
+                        in_data=ins, out_data=outs, in_grad=igrads, aux=[])
+        return tuple(np.asarray(g.asnumpy(), dtype=s.dtype)
+                     for g, s in zip(igrads, in_sds))
+
+    @jax.custom_vjp
+    def staged(*arrs):
+        return jax.pure_callback(host_fwd, out_sds, *arrs, vmap_method=None)
+
+    def staged_fwd(*arrs):
+        return staged(*arrs), arrs
+
+    def staged_bwd(res, cts):
+        cts_t = cts if isinstance(cts, tuple) else (cts,)
+        return jax.pure_callback(host_bwd, in_sds, *(res + tuple(cts_t)),
+                                 vmap_method=None)
+
+    staged.defvjp(staged_fwd, staged_bwd)
+    outs = staged(*[i._data for i in nd_inputs])
+    out_nds = [NDArray(o, ctx) for o in outs]
+    return out_nds[0] if len(out_nds) == 1 else out_nds

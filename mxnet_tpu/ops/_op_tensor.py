@@ -380,7 +380,22 @@ def _take(attrs, a, indices):
     return jnp.take(a, idx, axis=axis)
 
 
-@register("Embedding")
+def _embedding_grad(attrs, prims, cts):
+    """Custom FGradient: with sparse_grad=True the weight cotangent is a
+    row-sparse SparseCot over just the looked-up rows (parity: reference
+    Embedding backward emits a row_sparse grad, indexing_op.h)."""
+    data, weight = prims
+    ct = cts[0]
+    idx = jnp.clip(data.astype(jnp.int32), 0, weight.shape[0] - 1).reshape(-1)
+    vals = ct.reshape(-1, weight.shape[1])
+    if attrs.get("sparse_grad"):
+        from ..autograd import SparseCot
+        return (None, SparseCot(idx, vals, weight.shape))
+    dense = jnp.zeros_like(weight).at[idx].add(vals.astype(weight.dtype))
+    return (None, dense)
+
+
+@register("Embedding", fgradient=_embedding_grad)
 def _embedding(attrs, data, weight):
     idx = data.astype(jnp.int32)
     out = jnp.take(weight, jnp.clip(idx, 0, weight.shape[0] - 1), axis=0)

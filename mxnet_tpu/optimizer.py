@@ -200,6 +200,17 @@ class SGD(Optimizer):
         return self.create_state(index, weight)
 
     def update(self, index, weight, grad, state):
+        from .ndarray.sparse import RowSparseNDArray, sgd_lazy_update
+        if isinstance(grad, RowSparseNDArray) and self.lazy_update:
+            # lazy sparse path: touch only the gradient's rows (parity:
+            # reference sgd FComputeEx lazy_update, optimizer.py:511)
+            self._update_count(index)
+            sgd_lazy_update(weight, grad, state, self._get_lr(index),
+                            self._get_wd(index), self.momentum,
+                            self.rescale_grad, self.clip_gradient)
+            return
+        if isinstance(grad, RowSparseNDArray):
+            grad = grad.todense()
         self._update_count(index)
         attrs = self._common_attrs(self._get_lr(index), self._get_wd(index))
         if state is not None:
@@ -289,6 +300,17 @@ class Adam(Optimizer):
                 nd.zeros(weight.shape, weight.ctx, dtype=weight.dtype))
 
     def update(self, index, weight, grad, state):
+        from .ndarray.sparse import RowSparseNDArray, adam_lazy_update
+        if isinstance(grad, RowSparseNDArray) and self.lazy_update:
+            self._update_count(index)
+            mean, var = state
+            adam_lazy_update(weight, grad, mean, var, self._get_lr(index),
+                             self._get_wd(index), self.beta1, self.beta2,
+                             self.epsilon, self._index_update_count[index],
+                             self.rescale_grad, self.clip_gradient)
+            return
+        if isinstance(grad, RowSparseNDArray):
+            grad = grad.todense()
         self._update_count(index)
         t = self._index_update_count[index]
         lr = self._get_lr(index)

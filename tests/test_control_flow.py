@@ -1,0 +1,217 @@
+"""Control-flow op tests (parity intent: reference
+tests/python/unittest/test_contrib_control_flow.py): foreach == unrolled
+loop, while_loop semantics, cond branches, gradients through all three."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ndarray import contrib
+
+
+def test_foreach_matches_unrolled_rnn():
+    """foreach-RNN equals the hand-unrolled loop, forward and backward
+    (the reference's canonical foreach test)."""
+    T, B, H = 5, 2, 4
+    x_np = np.random.randn(T, B, H).astype(np.float32)
+    w_np = np.random.randn(H, H).astype(np.float32) * 0.3
+
+    def run_foreach():
+        x = nd.array(x_np)
+        w = nd.array(w_np)
+        w.attach_grad()
+        h0 = nd.zeros((B, H))
+        with mx.autograd.record():
+            def body(xt, states):
+                h = states[0]
+                new_h = nd.tanh(nd.dot(xt, w) + h)
+                return new_h, [new_h]
+            outs, final = contrib.foreach(body, x, [h0])
+            loss = (outs * outs).sum()
+        loss.backward()
+        return outs.asnumpy(), final[0].asnumpy(), w.grad.asnumpy()
+
+    def run_unrolled():
+        x = nd.array(x_np)
+        w = nd.array(w_np)
+        w.attach_grad()
+        h = nd.zeros((B, H))
+        with mx.autograd.record():
+            outs = []
+            for t in range(T):
+                h = nd.tanh(nd.dot(x[t], w) + h)
+                outs.append(h)
+            stacked = nd.stack(*outs, axis=0)
+            loss = (stacked * stacked).sum()
+        loss.backward()
+        return stacked.asnumpy(), h.asnumpy(), w.grad.asnumpy()
+
+    o1, f1, g1 = run_foreach()
+    o2, f2, g2 = run_unrolled()
+    np.testing.assert_allclose(o1, o2, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(f1, f2, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(g1, g2, rtol=1e-4, atol=1e-5)
+
+
+def test_foreach_single_arrays():
+    x = nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    s0 = nd.zeros((3,))
+
+    def body(xt, state):
+        acc = state[0] + xt
+        return acc * 2, [acc]
+
+    outs, final = contrib.foreach(body, x, [s0])
+    want_states = np.cumsum(x.asnumpy(), axis=0)
+    np.testing.assert_allclose(final[0].asnumpy(), want_states[-1],
+                               rtol=1e-6)
+    np.testing.assert_allclose(outs.asnumpy(), want_states * 2, rtol=1e-6)
+
+
+def test_while_loop():
+    """sum integers until total >= 10; outputs padded to max_iterations."""
+    i0 = nd.array([1.0])
+    tot0 = nd.array([0.0])
+
+    outs, finals = contrib.while_loop(
+        cond=lambda i, tot: (tot < 10).reshape(()),
+        func=lambda i, tot: (i * 10, [i + 1, tot + i]),
+        loop_vars=[i0, tot0], max_iterations=8)
+    # runs i=1,2,3,4 (tot 1,3,6,10) then stops
+    np.testing.assert_allclose(finals[0].asnumpy(), [5.0])
+    np.testing.assert_allclose(finals[1].asnumpy(), [10.0])
+    got = outs.asnumpy()
+    assert got.shape == (8, 1)
+    np.testing.assert_allclose(got[:4, 0], [10, 20, 30, 40])
+    np.testing.assert_allclose(got[4:], 0)
+
+
+def test_while_loop_gradient():
+    w = nd.array([2.0])
+    w.attach_grad()
+    with mx.autograd.record():
+        outs, finals = contrib.while_loop(
+            cond=lambda x: (x < 100).reshape(()),
+            func=lambda x: (x, [x * w]),
+            loop_vars=[nd.array([1.0]) * w], max_iterations=10)
+        loss = finals[0].sum()
+    loss.backward()
+    # x_final = w^k for first k with w^k >= 100: w=2 -> 128 = w^7
+    np.testing.assert_allclose(finals[0].asnumpy(), [128.0])
+    np.testing.assert_allclose(w.grad.asnumpy(), [7 * 2.0 ** 6], rtol=1e-5)
+
+
+def test_cond_imperative():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with mx.autograd.record():
+        out = contrib.cond(nd.array([1.0]),
+                           lambda: x * 2,
+                           lambda: x * 10)
+        out.backward()
+    np.testing.assert_allclose(out.asnumpy(), [6.0])
+    np.testing.assert_allclose(x.grad.asnumpy(), [2.0])
+    out2 = contrib.cond(nd.array([0.0]), lambda: x * 2, lambda: x * 10)
+    np.testing.assert_allclose(out2.asnumpy(), [30.0])
+
+
+def test_autograd_function():
+    """A python Function with custom backward trains correctly
+    (reference autograd.py:365 sigmoid example)."""
+
+    class sigmoid(mx.autograd.Function):
+        def forward(self, x):
+            y = 1 / (1 + nd.exp(-x))
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            y, = self.saved_tensors
+            return dy * y * (1 - y)
+
+    x = nd.array(np.random.randn(10).astype(np.float32))
+    x.attach_grad()
+    func = sigmoid()
+    with mx.autograd.record():
+        m = func(x)
+        m.backward()
+    y = 1 / (1 + np.exp(-x.asnumpy()))
+    np.testing.assert_allclose(x.grad.asnumpy(), y * (1 - y), rtol=1e-5)
+
+
+def test_custom_op_imperative_and_hybridized():
+    """CustomOp (numpy body) runs imperatively AND inside a hybridized
+    block via pure_callback (reference custom-inl.h:52 host)."""
+
+    class Softsign(mx.operator.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            x = in_data[0].asnumpy()
+            self.assign(out_data[0], req[0],
+                        nd.array(x / (1 + np.abs(x))))
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            x = in_data[0].asnumpy()
+            dy = out_grad[0].asnumpy()
+            self.assign(in_grad[0], req[0],
+                        nd.array(dy / (1 + np.abs(x)) ** 2))
+
+    @mx.operator.register("softsign_test")
+    class SoftsignProp(mx.operator.CustomOpProp):
+        def __init__(self):
+            super().__init__(need_top_grad=True)
+
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]]
+
+        def create_operator(self, ctx, shapes, dtypes):
+            return Softsign()
+
+    x_np = np.random.randn(6).astype(np.float32)
+    x = nd.array(x_np)
+    x.attach_grad()
+    with mx.autograd.record():
+        y = nd.Custom(x, op_type="softsign_test")
+        loss = (y * y).sum()
+    loss.backward()
+    want_y = x_np / (1 + np.abs(x_np))
+    want_g = 2 * want_y / (1 + np.abs(x_np)) ** 2
+    np.testing.assert_allclose(y.asnumpy(), want_y, rtol=1e-5)
+    np.testing.assert_allclose(x.grad.asnumpy(), want_g, rtol=1e-5)
+
+    # inside a hybridized block: staged as pure_callback
+    from mxnet_tpu.gluon import nn
+
+    class Net(nn.HybridBlock):
+        def hybrid_forward(self, F, x):
+            return F.Custom(x, op_type="softsign_test") * 2
+
+    net = Net()
+    net.hybridize()
+    out = net(nd.array(x_np))
+    np.testing.assert_allclose(out.asnumpy(), want_y * 2, rtol=1e-5)
+
+
+def test_higher_order_grad():
+    """grad(create_graph=True) supports second derivatives (reference
+    tests/python/unittest/test_higher_order_grad.py)."""
+    x = nd.array([0.3, -0.7, 1.1])
+    x.attach_grad()
+    with mx.autograd.record():
+        y = nd.sin(x)
+        dydx = mx.autograd.grad(y, x, create_graph=True)
+        d2 = dydx.sum()
+    d2.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), -np.sin(x.asnumpy()),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_higher_order_grad_chain():
+    """d2/dx2 of x^3 = 6x through a composite expression."""
+    x = nd.array([1.0, 2.0, -3.0])
+    x.attach_grad()
+    with mx.autograd.record():
+        y = x * x * x
+        dy = mx.autograd.grad(y, x, create_graph=True)
+        z = (dy * dy).sum()       # z = Σ (3x²)² = 9x⁴ ; dz/dx = 36x³
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(),
+                               36 * x.asnumpy() ** 3, rtol=1e-4)

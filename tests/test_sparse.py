@@ -1,0 +1,242 @@
+"""Sparse storage tests (parity intent: reference
+tests/python/unittest/test_sparse_operator.py / test_sparse_ndarray.py and
+the Criteo linear-model config in BASELINE.json: device-resident row_sparse/
+CSR kernels, sparse gradients, lazy optimizer updates, kvstore
+row_sparse_pull)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ndarray import sparse as sp
+
+
+def _rand_csr(rows, cols, nnz_per_row, rng):
+    dense = np.zeros((rows, cols), np.float32)
+    for r in range(rows):
+        idx = rng.choice(cols, size=nnz_per_row, replace=False)
+        dense[r, idx] = rng.standard_normal(nnz_per_row).astype(np.float32)
+    return dense
+
+
+def test_cast_storage_roundtrip():
+    dense = np.zeros((6, 4), np.float32)
+    dense[1] = [1, 0, 2, 0]
+    dense[4] = [0, 3, 0, 4]
+    d = nd.array(dense)
+    rs = sp.cast_storage(d, "row_sparse")
+    assert rs.stype == "row_sparse"
+    assert rs.indices.asnumpy().tolist() == [1, 4]
+    np.testing.assert_array_equal(rs.asnumpy(), dense)
+    back = sp.cast_storage(rs, "default")
+    np.testing.assert_array_equal(back.asnumpy(), dense)
+    csr = sp.cast_storage(d, "csr")
+    assert csr.stype == "csr"
+    np.testing.assert_array_equal(csr.asnumpy(), dense)
+
+
+def test_sparse_retain():
+    dense = np.zeros((8, 3), np.float32)
+    dense[[1, 3, 6]] = np.arange(9).reshape(3, 3) + 1
+    rs = sp.row_sparse_array(dense)
+    out = sp.sparse_retain(rs, np.array([0, 3, 6]))
+    assert out.indices.asnumpy().tolist() == [0, 3, 6]
+    want = np.zeros_like(dense)
+    want[[3, 6]] = dense[[3, 6]]
+    np.testing.assert_array_equal(out.asnumpy(), want)
+
+
+def test_square_sum():
+    dense = np.zeros((10, 4), np.float32)
+    dense[[2, 5]] = np.random.randn(2, 4).astype(np.float32)
+    rs = sp.row_sparse_array(dense)
+    total = sp.square_sum(rs).asnumpy()
+    np.testing.assert_allclose(total, (dense ** 2).sum(), rtol=1e-6)
+    per_row = sp.square_sum(rs, axis=1)
+    assert per_row.stype == "row_sparse"
+    np.testing.assert_allclose(per_row.asnumpy(),
+                               (dense ** 2).sum(axis=1), rtol=1e-6)
+
+
+def test_csr_dot_dense_forward():
+    rng = np.random.default_rng(0)
+    dense = _rand_csr(8, 30, 4, rng)
+    w = rng.standard_normal((30, 5)).astype(np.float32)
+    csr = sp.array(dense, stype="csr")
+    out = sp.dot(csr, nd.array(w))
+    np.testing.assert_allclose(out.asnumpy(), dense @ w, rtol=1e-5,
+                               atol=1e-6)
+    # transpose_a
+    out_t = sp.dot(csr, nd.array(rng.standard_normal((8, 5)).astype(
+        np.float32) * 0 + 1.0), transpose_a=True)
+    np.testing.assert_allclose(out_t.asnumpy(),
+                               dense.T @ np.ones((8, 5), np.float32),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_csr_dot_sparse_grad():
+    """Gradient w.r.t. the dense operand arrives row-sparse with exactly the
+    touched rows; values match the dense computation."""
+    rng = np.random.default_rng(1)
+    dense = _rand_csr(6, 20, 3, rng)
+    w_np = rng.standard_normal((20, 4)).astype(np.float32)
+    csr = sp.array(dense, stype="csr")
+    w = nd.array(w_np)
+    w.attach_grad(stype="row_sparse")
+    with mx.autograd.record():
+        out = sp.dot(csr, w)
+        loss = (out * out).sum()
+    loss.backward()
+    g = w.grad
+    assert g.stype == "row_sparse"
+    want_full = 2 * dense.T @ (dense @ w_np)
+    touched = sorted(set(np.nonzero(dense)[1].tolist()))
+    assert g.indices.asnumpy().tolist() == touched
+    np.testing.assert_allclose(g.asnumpy(), want_full, rtol=1e-4, atol=1e-5)
+
+
+def test_embedding_sparse_grad():
+    vocab, dim = 50, 8
+    w_np = np.random.randn(vocab, dim).astype(np.float32)
+    ids = np.array([[3, 7, 3], [44, 7, 0]], np.float32)
+
+    def run(sparse_grad):
+        w = nd.array(w_np)
+        w.attach_grad(stype="row_sparse" if sparse_grad else "write")
+        x = nd.array(ids)
+        with mx.autograd.record():
+            out = nd.Embedding(x, w, input_dim=vocab, output_dim=dim,
+                               sparse_grad=sparse_grad)
+            loss = (out * out).sum()
+        loss.backward()
+        return w.grad
+
+    g_sparse = run(True)
+    g_dense = run(False)
+    assert g_sparse.stype == "row_sparse"
+    assert g_sparse.indices.asnumpy().tolist() == [0, 3, 7, 44]
+    np.testing.assert_allclose(g_sparse.asnumpy(), g_dense.asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_sparse_cot_through_interior_node_densifies():
+    """Embedding(sparse_grad=True) on a COMPUTED weight: the SparseCot
+    reaching the interior mul node must densify instead of crashing."""
+    vocab, dim = 12, 3
+    w = nd.array(np.random.randn(vocab, dim).astype(np.float32))
+    w.attach_grad()
+    ids = nd.array(np.array([1.0, 4.0]))
+    with mx.autograd.record():
+        w2 = w * 2.0
+        out = nd.Embedding(ids, w2, input_dim=vocab, output_dim=dim,
+                           sparse_grad=True)
+        loss = out.sum()
+    loss.backward()
+    want = np.zeros((vocab, dim), np.float32)
+    want[[1, 4]] = 2.0
+    np.testing.assert_allclose(w.grad.asnumpy(), want, rtol=1e-6)
+
+
+def test_lazy_sgd_touches_only_grad_rows():
+    rows, dim = 10, 4
+    w_np = np.random.randn(rows, dim).astype(np.float32)
+    g_rows = [2, 7]
+    g_vals = np.random.randn(2, dim).astype(np.float32)
+    grad = sp.row_sparse_array((g_vals, np.array(g_rows)), shape=(rows, dim))
+
+    opt = mx.optimizer.SGD(learning_rate=0.5, momentum=0.9, wd=0.1)
+    w = nd.array(w_np)
+    state = opt.create_state(0, w)
+    mom_before = state.asnumpy().copy()
+    opt.update(0, w, grad, state)
+    w_after = w.asnumpy()
+    mom_after = state.asnumpy()
+    untouched = [r for r in range(rows) if r not in g_rows]
+    np.testing.assert_array_equal(w_after[untouched], w_np[untouched])
+    np.testing.assert_array_equal(mom_after[untouched],
+                                  mom_before[untouched])
+    # touched rows follow the dense sgd_mom formula
+    for i, r in enumerate(g_rows):
+        g = g_vals[i] + 0.1 * w_np[r]
+        m = 0.9 * 0.0 - 0.5 * g
+        np.testing.assert_allclose(w_after[r], w_np[r] + m, rtol=1e-5)
+
+
+def test_lazy_adam_touches_only_grad_rows():
+    rows, dim = 8, 3
+    w_np = np.random.randn(rows, dim).astype(np.float32)
+    grad = sp.row_sparse_array(
+        (np.random.randn(2, dim).astype(np.float32), np.array([1, 5])),
+        shape=(rows, dim))
+    opt = mx.optimizer.Adam(learning_rate=0.1)
+    w = nd.array(w_np)
+    state = opt.create_state(0, w)
+    opt.update(0, w, grad, state)
+    w_after = w.asnumpy()
+    untouched = [0, 2, 3, 4, 6, 7]
+    np.testing.assert_array_equal(w_after[untouched], w_np[untouched])
+    assert not np.allclose(w_after[[1, 5]], w_np[[1, 5]])
+
+
+def test_kvstore_row_sparse_pull_local():
+    kv = mx.kvstore.create("local")
+    w = np.random.randn(20, 6).astype(np.float32)
+    kv.init(3, nd.array(w))
+    out = sp.zeros("row_sparse", (20, 6))
+    kv.row_sparse_pull(3, out=out, row_ids=nd.array([4, 9, 4]))
+    assert out.indices.asnumpy().tolist() == [4, 9]
+    np.testing.assert_allclose(out.data.asnumpy(), w[[4, 9]], rtol=1e-6)
+
+
+def test_kvstore_sparse_push_with_updater():
+    kv = mx.kvstore.create("local")
+    w = np.zeros((10, 2), np.float32)
+    kv.init(0, nd.array(w))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=1.0))
+    g = sp.row_sparse_array((np.ones((2, 2), np.float32),
+                             np.array([3, 8])), shape=(10, 2))
+    kv.push(0, g)
+    out = nd.zeros((10, 2))
+    kv.pull(0, out=out)
+    got = out.asnumpy()
+    want = np.zeros((10, 2), np.float32)
+    want[[3, 8]] = -1.0
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_criteo_shaped_linear_model_converges():
+    """Sparse logistic regression like the reference's Criteo linear
+    classifier config (BASELINE.json): csr features, row-sparse gradients,
+    lazy SGD — loss must drop and accuracy beat chance comfortably."""
+    rng = np.random.default_rng(42)
+    n, d, nnz = 256, 500, 20
+    true_w = (rng.standard_normal(d) * (rng.random(d) < 0.1)).astype(
+        np.float32)
+    dense_x = np.zeros((n, d), np.float32)
+    for r in range(n):
+        idx = rng.choice(d, size=nnz, replace=False)
+        dense_x[r, idx] = rng.standard_normal(nnz).astype(np.float32)
+    logits = dense_x @ true_w
+    y_np = (logits > 0).astype(np.float32)
+
+    w = nd.zeros((d, 1))
+    w.attach_grad(stype="row_sparse")
+    opt = mx.optimizer.SGD(learning_rate=2.0)
+    losses = []
+    bs = 64
+    for epoch in range(30):
+        for s in range(0, n, bs):
+            xb = sp.array(dense_x[s:s + bs], stype="csr")
+            yb = nd.array(y_np[s:s + bs].reshape(-1, 1))
+            with mx.autograd.record():
+                z = sp.dot(xb, w)
+                # logistic loss
+                loss = (nd.log(1 + nd.exp(-nd.abs(z))) +
+                        nd.maximum(z, 0) - z * yb).mean()
+            loss.backward()
+            opt.update(0, w, w.grad, None)
+            losses.append(float(loss.asscalar()))
+    pred = (dense_x @ w.asnumpy() > 0).astype(np.float32).ravel()
+    acc = (pred == y_np).mean()
+    assert losses[-1] < losses[0] * 0.4, (losses[0], losses[-1])
+    assert acc > 0.9, acc
