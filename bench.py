@@ -92,9 +92,16 @@ def main():
         from mxnet_tpu.ops import registry as _registry
         from mxnet_tpu import random as _random
         from mxnet_tpu import autograd as _ag
+        from mxnet_tpu import amp
 
         dev = jax.devices()[0]
         log(f"device: {dev.platform}/{getattr(dev, 'device_kind', '?')}")
+
+        if dtype == "bfloat16":
+            # framework AMP: MXU ops compute in bf16, fp32 master weights
+            # and norm statistics — the recipe lives in mxnet_tpu.amp, not
+            # hand-rolled here
+            amp.init(target_dtype="bfloat16")
 
         log("building ResNet-50 on host CPU (no device compiles)")
         from mxnet_tpu.parallel.spmd import host_cpu_scope
@@ -117,14 +124,9 @@ def main():
                      "rescale_grad": 1.0}
         sgd_mom = _registry.get("sgd_mom_update").fcompute
 
-        def cast(p):
-            # bf16 compute for matrix/conv params; vectors (BN, bias) fp32
-            return p.astype(compute_dtype) if p.ndim > 1 else p
-
         def step(key, tparams, aparams, moms, x, y):
             def loss_fn(tps):
-                ps = tuple(cast(p) for p in
-                           merge_params(train_idx, aux_list, tps, aparams))
+                ps = merge_params(train_idx, aux_list, tps, aparams)
                 with _ag.train_mode():
                     outs, mutated = apply_fn(key, ps, (x,))
                 logits = outs[0].astype(jnp.float32)

@@ -1,0 +1,167 @@
+"""Automatic mixed precision (parity: python/mxnet/contrib/amp/amp.py:250).
+
+``amp.init()`` turns on dispatch-level precision routing: allow-listed ops
+(the MXU matmul/conv family) cast fp32 float inputs down to the target
+dtype, deny-listed ops cast low-precision inputs up to fp32, and widest-
+type ops promote mixed inputs — the role of the reference's
+low_precision_pass.cc graph rewrite, applied at op dispatch so it covers
+the imperative path AND everything traced through it (hybridize,
+functionalize, TrainStep).  The casts live INSIDE each op's differentiated
+function, so backward transposes them: low-precision compute, fp32
+gradient accumulation, fp32 master weights.
+
+Default target is bfloat16 — the TPU-native low precision (fp32 exponent
+range: no loss scaling needed).  fp16 + dynamic LossScaler is supported
+for parity.
+"""
+from __future__ import annotations
+
+import contextlib
+
+from ..base import MXNetError
+from . import lists
+from .loss_scaler import LossScaler
+
+_STATE = {
+    "active": False,
+    "target_dtype": None,
+    "low_ops": frozenset(),
+    "fp32_ops": frozenset(),
+    "widest_ops": frozenset(),
+}
+
+
+def init(target_dtype="bfloat16", target_precision_ops=None,
+         conditional_fp32_ops=None, fp32_ops=None):
+    """Enable AMP (parity: amp.py:250 — patches the op namespaces; here it
+    arms the dispatch hook in ndarray.invoke via op attrs)."""
+    if target_dtype not in ("bfloat16", "float16"):
+        raise MXNetError("target_dtype must be bfloat16 or float16")
+    low = set(lists.LOW_PRECISION_OPS)
+    if target_precision_ops is not None:
+        low |= set(target_precision_ops)
+    f32 = set(lists.FP32_OPS)
+    if fp32_ops is not None:
+        f32 |= set(fp32_ops)
+    if conditional_fp32_ops is not None:
+        f32 |= {name for (name, _attr, _vals) in conditional_fp32_ops}
+    _STATE.update(active=True, target_dtype=target_dtype,
+                  low_ops=frozenset(low - f32), fp32_ops=frozenset(f32),
+                  widest_ops=frozenset(lists.WIDEST_TYPE_CASTS))
+
+
+def deinit():
+    """Disable AMP (test helper; the reference has no public off-switch)."""
+    _STATE.update(active=False, target_dtype=None, low_ops=frozenset(),
+                  fp32_ops=frozenset(), widest_ops=frozenset())
+
+
+def is_active():
+    return _STATE["active"]
+
+
+def amp_mode_for(op_name):
+    """The '_amp' attr value for an op under the current AMP state, or
+    None.  Consulted by ndarray.invoke at dispatch."""
+    if not _STATE["active"]:
+        return None
+    if op_name in _STATE["low_ops"]:
+        return "low:" + _STATE["target_dtype"]
+    if op_name in _STATE["fp32_ops"]:
+        return "f32:" + _STATE["target_dtype"]
+    if op_name in _STATE["widest_ops"]:
+        return "widest:" + _STATE["target_dtype"]
+    return None
+
+
+# -- loss scaling ------------------------------------------------------------
+def init_trainer(optimizer_or_trainer):
+    """Attach a dynamic loss scaler to a Trainer (parity: amp.py:287)."""
+    from ..gluon.trainer import Trainer
+    if isinstance(optimizer_or_trainer, Trainer):
+        optimizer_or_trainer._amp_loss_scaler = LossScaler()
+        optimizer_or_trainer._amp_original_scale = \
+            optimizer_or_trainer._scale
+    else:
+        raise MXNetError("init_trainer expects a gluon.Trainer")
+
+
+@contextlib.contextmanager
+def scale_loss(loss, optimizer_or_trainer):
+    """``with amp.scale_loss(loss, trainer) as scaled: scaled.backward()``
+    (parity: amp.py:240).  Scales the loss up; trainer.step unscales the
+    gradients and skips the update on overflow."""
+    scaler = getattr(optimizer_or_trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        yield loss
+        return
+    optimizer_or_trainer._scale = (
+        optimizer_or_trainer._amp_original_scale / scaler.loss_scale)
+    if isinstance(loss, (list, tuple)):
+        yield [l * scaler.loss_scale for l in loss]
+    else:
+        yield loss * scaler.loss_scale
+
+
+def unscale(optimizer_or_trainer):
+    """Explicitly unscale gradients (parity: amp.py:330) — for use with
+    trainer.allreduce_grads()/update() split steps.  Restores the
+    trainer's rescale factor so update() does not divide by the loss
+    scale a second time; the scaler's dynamic state is untouched."""
+    scaler = getattr(optimizer_or_trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        return
+    inv = 1.0 / scaler.loss_scale
+    for param in optimizer_or_trainer._params:
+        if param.grad_req != "null" and param._grad is not None:
+            for g in param.list_grad():
+                g._set_data(g._data * inv)
+    optimizer_or_trainer._scale = \
+        optimizer_or_trainer._amp_original_scale
+
+
+# -- model conversion --------------------------------------------------------
+def convert_model(sym, arg_params, aux_params, target_dtype="bfloat16",
+                  target_dtype_ops=None, fp32_ops=None,
+                  conditional_fp32_ops=None, excluded_sym_names=None,
+                  cast_optional_params=False):
+    """Cast a symbolic model's parameters for low-precision inference
+    (parity: amp.py:508).  Norm/aux statistics stay fp32;
+    excluded_sym_names keeps named params in fp32.  Op-level precision
+    lists are applied at dispatch by amp.init(), not by this parameter
+    cast — passing them here warns."""
+    import numpy as np
+    import warnings
+    if target_dtype_ops or fp32_ops or conditional_fp32_ops:
+        warnings.warn(
+            "convert_model casts parameters only; op-level precision "
+            "lists are applied at dispatch — pass them to amp.init()")
+    excluded = set(excluded_sym_names or [])
+    new_args = {}
+    for k, v in arg_params.items():
+        if k not in excluded and v.dtype == np.float32 and v.ndim > 1:
+            new_args[k] = v.astype(target_dtype)
+        else:
+            new_args[k] = v
+    return sym, new_args, dict(aux_params)
+
+
+def convert_hybrid_block(block, target_dtype="bfloat16"):
+    """Cast a gluon block's matrix/conv parameters to the target dtype
+    for inference (vector params — norms, biases — stay fp32)."""
+    for p in block.collect_params().values():
+        if p._data is not None:
+            d = p.data()
+            if len(d.shape) > 1 and str(d.dtype) == "float32":
+                p.cast(target_dtype)  # set_data would coerce back to p.dtype
+    return block
+
+
+def all_finite(*arrays):
+    """True iff every array is free of inf/nan (reference all_finite op)."""
+    import jax.numpy as jnp
+    ok = True
+    for a in arrays:
+        data = a._data if hasattr(a, "_data") else a
+        ok = ok and bool(jnp.isfinite(data).all())
+    return ok

@@ -1,0 +1,34 @@
+"""AMP op lists (role parity: python/mxnet/contrib/amp/lists/symbol.py).
+
+Reference semantics: FP16_FUNCS run in low precision, FP32_FUNCS are forced
+to full precision, WIDEST_TYPE_CASTS promote mixed inputs.  TPU defaults
+target bfloat16 — same exponent range as fp32, so the deny list is shorter
+than the reference's fp16 one (no loss-scaling-critical softmax/exp cases),
+but reductions, norms and losses still accumulate in fp32.
+"""
+
+# the MXU ops — where low precision pays
+LOW_PRECISION_OPS = [
+    "Convolution", "Deconvolution", "FullyConnected", "RNN",
+    "dot", "batch_dot",
+]
+
+# numerically sensitive: force fp32 inputs
+FP32_OPS = [
+    "softmax", "log_softmax", "SoftmaxActivation", "SoftmaxOutput",
+    "BatchNorm", "LayerNorm", "GroupNorm", "InstanceNorm", "LRN",
+    "L2Normalization", "norm",
+    "exp", "log", "log2", "log10", "log1p", "expm1",
+    "mean", "sum", "nansum", "prod", "nanprod", "cumsum",
+    "CTCLoss", "MakeLoss", "LinearRegressionOutput",
+    "LogisticRegressionOutput", "MAERegressionOutput",
+    "smooth_l1", "SVMOutput",
+]
+
+# elementwise combiners where mixed inputs should promote
+WIDEST_TYPE_CASTS = [
+    "broadcast_add", "broadcast_sub", "broadcast_mul", "broadcast_div",
+    "elemwise_add", "elemwise_sub", "elemwise_mul", "elemwise_div",
+    "add_n", "where", "broadcast_maximum", "broadcast_minimum",
+    "broadcast_power", "maximum", "minimum",
+]

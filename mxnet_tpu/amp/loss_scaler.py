@@ -1,0 +1,41 @@
+"""Dynamic loss scaler (parity: python/mxnet/contrib/amp/loss_scaler.py).
+
+With bfloat16 (TPU default) scaling is rarely needed — bf16 shares fp32's
+exponent range — but the capability is kept for fp16 workflows and API
+parity: multiply the loss up, check gradients for inf/nan, halve the scale
+on overflow, double it after a streak of clean steps.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class LossScaler:
+    def __init__(self, init_scale=2. ** 16, scale_factor=2.,
+                 scale_window=2000, tolerance=0.05):
+        self.loss_scale = init_scale
+        self._scale_factor = scale_factor
+        self._scale_window = scale_window
+        self._unskipped = 0
+
+    def has_overflow(self, grads):
+        """True if any gradient array contains inf/nan.  All per-array
+        checks reduce into ONE scalar before the single host sync
+        (reference: fused multi_all_finite op)."""
+        import jax.numpy as jnp
+        checks = [jnp.isfinite(g._data if hasattr(g, "_data") else g).all()
+                  for g in grads if g is not None]
+        if not checks:
+            return False
+        return not bool(jnp.stack(checks).all())
+
+    def update_scale(self, overflow):
+        if overflow:
+            self.loss_scale = max(self.loss_scale / self._scale_factor, 1)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+        if self._unskipped == self._scale_window:
+            self.loss_scale = min(self.loss_scale * self._scale_factor,
+                                  2. ** 24)
+            self._unskipped = 0

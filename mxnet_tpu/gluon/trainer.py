@@ -152,10 +152,24 @@ class Trainer:
 
     def step(self, batch_size, ignore_stale_grad=False):
         """Make one parameter update step: rescale, allreduce, update
-        (parity: trainer.py:305)."""
+        (parity: trainer.py:305).  With amp.init_trainer attached, the
+        gradient rescale folds in the loss scale and the update is skipped
+        (scale halved) on inf/nan gradients — reference amp step contract."""
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
+        scaler = getattr(self, "_amp_loss_scaler", None)
+        if scaler is not None:
+            # check BEFORE allreduce: with update_on_kvstore the push
+            # itself applies the update server-side — inf/nan must never
+            # reach the store
+            grads = [g for p in self._params
+                     if p.grad_req != "null" and p._grad is not None
+                     for g in p.list_grad()]
+            overflow = scaler.has_overflow(grads)
+            scaler.update_scale(overflow)
+            if overflow:
+                return  # skip push + update entirely (reference semantics)
         self._allreduce_grads()
         self._update(ignore_stale_grad)
 

@@ -8,6 +8,7 @@ positional args become inputs, keyword args become attrs, ``out=`` is honored.
 from . import random  # noqa: F401
 from . import sparse  # noqa: F401
 from . import contrib  # noqa: F401
+from . import linalg  # noqa: F401
 from .ndarray import (NDArray, add_n, arange, array, concat, dot, empty, eye,
                       full, invoke, linspace, maximum, minimum, moveaxis, ones,
                       ones_like, stack, transpose, waitall, zeros, zeros_like)

@@ -1,0 +1,31 @@
+"""mx.nd.linalg — linear-algebra namespace (parity:
+python/mxnet/ndarray/linalg.py generated over the la_op family,
+src/operator/tensor/la_op.cc)."""
+from __future__ import annotations
+
+from ..ops import registry as _registry
+from .ndarray import NDArray, invoke
+
+_PREFIX = "_linalg_"
+
+_NAMES = ["gemm", "gemm2", "potrf", "potri", "trmm", "trsm", "sumlogdiag",
+          "extractdiag", "makediag", "extracttrian", "maketrian", "syrk",
+          "gelqf", "syevd", "inverse", "det", "slogdet"]
+
+
+def _make(name):
+    op = _registry.get(_PREFIX + name)
+
+    def fn(*args, out=None, **kwargs):
+        inputs = [a for a in args if isinstance(a, NDArray)]
+        return invoke(op, inputs, kwargs, out=out)
+
+    fn.__name__ = name
+    fn.__doc__ = f"linalg.{name} (reference la_op _linalg_{name})."
+    return fn
+
+
+for _n in _NAMES:
+    globals()[_n] = _make(_n)
+
+del _n

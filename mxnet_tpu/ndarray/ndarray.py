@@ -45,6 +45,17 @@ class _TraceHooks(__import__("threading").local):
 
 _trace_hooks = _TraceHooks()
 
+_amp_mod = None
+
+
+def _amp_mode_for(op_name):
+    """Dispatch-time AMP routing (lazy import; no-op until amp.init())."""
+    global _amp_mod
+    if _amp_mod is None:
+        from .. import amp as _a
+        _amp_mod = _a
+    return _amp_mod.amp_mode_for(op_name)
+
 
 class NDArray:
     __array_priority__ = 1000.0
@@ -474,6 +485,9 @@ def invoke(op, inputs, attrs, out=None):
     attrs = {k: v for k, v in attrs.items() if v is not None}
     if op.name in _TRAINING_ATTR_OPS:
         attrs["_training"] = autograd.is_training()
+    amp_mode = _amp_mode_for(op.name)
+    if amp_mode is not None:
+        attrs["_amp"] = amp_mode
 
     _prof_t0 = None
     if _profiler_running():

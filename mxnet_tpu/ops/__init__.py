@@ -12,3 +12,4 @@ from . import _op_tensor  # noqa: F401
 from . import _op_nn  # noqa: F401
 from . import _op_random  # noqa: F401
 from . import _op_optimizer  # noqa: F401
+from . import _op_linalg  # noqa: F401

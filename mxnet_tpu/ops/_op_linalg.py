@@ -1,0 +1,190 @@
+"""Linear-algebra operator family (reference: src/operator/tensor/la_op.cc
+— _linalg_gemm:40 … _linalg_inverse:892, BLAS/LAPACK dispatch via
+linalg_impl.h).  TPU redesign: thin emissions over jax.lax.linalg /
+jnp.linalg — XLA lowers to MXU-tiled kernels on TPU and LAPACK on CPU; all
+ops are batched over leading dims for free (the reference hand-loops
+batched GEMM).  Registered under the reference's public aliases
+(``linalg_gemm`` etc., exposed as mx.nd.linalg.* in the frontends).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _tri_lower(x, lower=True):
+    return jnp.tril(x) if lower else jnp.triu(x)
+
+
+@register("_linalg_gemm", alias=("linalg_gemm",),
+          scalar_args=("alpha", "beta"))
+def _linalg_gemm(attrs, a, b, c):
+    ta = bool(attrs.get("transpose_a", False))
+    tb = bool(attrs.get("transpose_b", False))
+    alpha = float(attrs.get("alpha", 1.0))
+    beta = float(attrs.get("beta", 1.0))
+    aa = jnp.swapaxes(a, -1, -2) if ta else a
+    bb = jnp.swapaxes(b, -1, -2) if tb else b
+    return alpha * jnp.matmul(aa, bb) + beta * c
+
+
+@register("_linalg_gemm2", alias=("linalg_gemm2",), scalar_args=("alpha",))
+def _linalg_gemm2(attrs, a, b):
+    ta = bool(attrs.get("transpose_a", False))
+    tb = bool(attrs.get("transpose_b", False))
+    alpha = float(attrs.get("alpha", 1.0))
+    aa = jnp.swapaxes(a, -1, -2) if ta else a
+    bb = jnp.swapaxes(b, -1, -2) if tb else b
+    return alpha * jnp.matmul(aa, bb)
+
+
+@register("_linalg_potrf", alias=("linalg_potrf",))
+def _linalg_potrf(attrs, a):
+    l = jnp.linalg.cholesky(a)
+    if not bool(attrs.get("lower", True)):
+        return jnp.swapaxes(l, -1, -2)
+    return l
+
+
+@register("_linalg_potri", alias=("linalg_potri",))
+def _linalg_potri(attrs, a):
+    # inverse of the matrix whose cholesky factor is a:
+    # A = L Lᵀ  =>  A⁻¹ = L⁻ᵀ L⁻¹
+    lower = bool(attrs.get("lower", True))
+    l = a if lower else jnp.swapaxes(a, -1, -2)
+    eye = jnp.broadcast_to(jnp.eye(l.shape[-1], dtype=l.dtype), l.shape)
+    linv = jax.lax.linalg.triangular_solve(
+        l, eye, left_side=True, lower=True)
+    return jnp.matmul(jnp.swapaxes(linv, -1, -2), linv)
+
+
+@register("_linalg_trmm", alias=("linalg_trmm",), scalar_args=("alpha",))
+def _linalg_trmm(attrs, a, b):
+    alpha = float(attrs.get("alpha", 1.0))
+    lower = bool(attrs.get("lower", True))
+    transpose = bool(attrs.get("transpose", False))
+    rightside = bool(attrs.get("rightside", False))
+    t = _tri_lower(a, lower)
+    if transpose:
+        t = jnp.swapaxes(t, -1, -2)
+    return alpha * (jnp.matmul(b, t) if rightside else jnp.matmul(t, b))
+
+
+@register("_linalg_trsm", alias=("linalg_trsm",), scalar_args=("alpha",))
+def _linalg_trsm(attrs, a, b):
+    alpha = float(attrs.get("alpha", 1.0))
+    lower = bool(attrs.get("lower", True))
+    transpose = bool(attrs.get("transpose", False))
+    rightside = bool(attrs.get("rightside", False))
+    out = jax.lax.linalg.triangular_solve(
+        a, alpha * b, left_side=not rightside, lower=lower,
+        transpose_a=transpose)
+    return out
+
+
+@register("_linalg_sumlogdiag", alias=("linalg_sumlogdiag",))
+def _linalg_sumlogdiag(attrs, a):
+    diag = jnp.diagonal(a, axis1=-2, axis2=-1)
+    return jnp.sum(jnp.log(diag), axis=-1)
+
+
+@register("_linalg_extractdiag", alias=("linalg_extractdiag",))
+def _linalg_extractdiag(attrs, a):
+    offset = int(attrs.get("offset", 0))
+    return jnp.diagonal(a, offset=offset, axis1=-2, axis2=-1)
+
+
+@register("_linalg_makediag", alias=("linalg_makediag",))
+def _linalg_makediag(attrs, a):
+    offset = int(attrs.get("offset", 0))
+    n = a.shape[-1] + abs(offset)
+    out = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+    idx = jnp.arange(a.shape[-1])
+    r = idx + max(-offset, 0)
+    c = idx + max(offset, 0)
+    return out.at[..., r, c].set(a)
+
+
+def _trian_indices(n, offset, lower):
+    """Reference la_op semantics: a nonzero offset picks the triangle by
+    its sign (offset>0 upper, offset<0 lower); `lower` applies only at
+    offset 0.  The selected band excludes |offset|-1 diagonals."""
+    if offset > 0:
+        return jnp.triu_indices(n, k=offset)
+    if offset < 0:
+        return jnp.tril_indices(n, k=offset)
+    return jnp.tril_indices(n) if lower else jnp.triu_indices(n)
+
+
+@register("_linalg_extracttrian", alias=("linalg_extracttrian",))
+def _linalg_extracttrian(attrs, a):
+    offset = int(attrs.get("offset", 0))
+    lower = bool(attrs.get("lower", True))
+    rows, cols = _trian_indices(a.shape[-1], offset, lower)
+    return a[..., rows, cols]
+
+
+@register("_linalg_maketrian", alias=("linalg_maketrian",))
+def _linalg_maketrian(attrs, a):
+    offset = int(attrs.get("offset", 0))
+    lower = bool(attrs.get("lower", True))
+    m = a.shape[-1]
+    # triangle at |offset| of an n×n has (n-k)(n-k+1)/2 entries; invert
+    import math
+    k = abs(offset)
+    n = int((math.isqrt(8 * m + 1) - 1) // 2) + k
+    rows, cols = _trian_indices(n, offset, lower)
+    out = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+    return out.at[..., rows, cols].set(a)
+
+
+@register("_linalg_syrk", alias=("linalg_syrk",), scalar_args=("alpha",))
+def _linalg_syrk(attrs, a):
+    alpha = float(attrs.get("alpha", 1.0))
+    transpose = bool(attrs.get("transpose", False))
+    at = jnp.swapaxes(a, -1, -2)
+    return alpha * (jnp.matmul(at, a) if transpose else jnp.matmul(a, at))
+
+
+@register("_linalg_gelqf", alias=("linalg_gelqf",), num_outputs=2)
+def _linalg_gelqf(attrs, a):
+    # LQ factorization: A = L·Q with Q orthonormal rows (reference
+    # la_op.cc:752); computed via QR of Aᵀ
+    q, r = jnp.linalg.qr(jnp.swapaxes(a, -1, -2), mode="reduced")
+    return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+
+
+@register("_linalg_syevd", alias=("linalg_syevd",), num_outputs=2)
+def _linalg_syevd(attrs, a):
+    w, u = jnp.linalg.eigh(a)
+    # reference returns (U, L) with rows of U the eigenvectors: A = Uᵀ·L·U
+    return jnp.swapaxes(u, -1, -2), w
+
+
+@register("_linalg_inverse", alias=("linalg_inverse", "inverse"))
+def _linalg_inverse(attrs, a):
+    return jnp.linalg.inv(a)
+
+
+@register("_linalg_det", alias=("linalg_det", "det"))
+def _linalg_det(attrs, a):
+    return jnp.linalg.det(a)
+
+
+@register("_linalg_slogdet", alias=("linalg_slogdet", "slogdet"),
+          num_outputs=2)
+def _linalg_slogdet(attrs, a):
+    sign, logabs = jnp.linalg.slogdet(a)
+    return sign, logabs
+
+
+@register("moments", num_outputs=2)
+def _moments(attrs, x):
+    axes = attrs.get("axes")
+    keepdims = bool(attrs.get("keepdims", False))
+    axes = tuple(axes) if axes is not None else None
+    mean = jnp.mean(x, axis=axes, keepdims=keepdims)
+    var = jnp.var(x, axis=axes, keepdims=keepdims)
+    return mean, var

@@ -30,6 +30,42 @@ from ..base import MXNetError
 _OPS = {}
 
 
+def _amp_cast(arrays, mode):
+    """Input casting for mixed precision, applied INSIDE the op's traced
+    function so jax.vjp transposes the casts (low-precision compute, full-
+    precision gradient accumulation).  The '_amp' attr rides the jit-cache
+    key, so amp-on and amp-off programs never collide.
+
+    Role parity: src/nnvm/low_precision_pass.cc inserts amp_cast/
+    amp_multicast nodes by allow/deny list; here the cast is attached at
+    dispatch by mxnet_tpu.amp.
+    """
+    import jax.numpy as jnp
+    low = jnp.bfloat16 if mode.endswith("bfloat16") else jnp.float16
+    out = []
+    for a in arrays:
+        dt = getattr(a, "dtype", None)
+        if dt is None or not jnp.issubdtype(a.dtype, jnp.floating):
+            out.append(a)
+        elif mode.startswith("low"):
+            out.append(a.astype(low) if a.dtype == jnp.float32 else a)
+        elif mode.startswith("f32"):
+            out.append(a.astype(jnp.float32)
+                       if a.dtype in (jnp.bfloat16, jnp.float16) else a)
+        else:  # widest
+            out.append(a)
+    if mode.startswith("widest"):
+        f = [a for a in out if getattr(a, "dtype", None) is not None and
+             jnp.issubdtype(a.dtype, jnp.floating)]
+        if f:
+            widest = jnp.result_type(*[a.dtype for a in f])
+            out = [a.astype(widest)
+                   if getattr(a, "dtype", None) is not None and
+                   jnp.issubdtype(a.dtype, jnp.floating) else a
+                   for a in out]
+    return tuple(out)
+
+
 def _canon_attr(v):
     """Make an attr value hashable + jit-stable."""
     if isinstance(v, (list, tuple)):
@@ -68,9 +104,14 @@ class Operator:
         fn = self._jit_cache.get(attrs_key)
         if fn is None:
             fcompute = self.fcompute
+            amp_mode = attrs.get("_amp")
 
             def call(*arrays):
-                out = fcompute(dict(attrs), *arrays)
+                if amp_mode:
+                    arrays = _amp_cast(arrays, amp_mode)
+                out = fcompute(
+                    {k: v for k, v in attrs.items() if k != "_amp"},
+                    *arrays)
                 return out
 
             fn = jax.jit(call)
@@ -86,9 +127,13 @@ class Operator:
         """Unjitted closure — used under jax.vjp (jax 0.9 cannot linearize
         some primitives, e.g. reduce_window, through an inner jit)."""
         fcompute = self.fcompute
+        amp_mode = attrs.get("_amp")
 
         def call(*arrays):
-            return fcompute(dict(attrs), *arrays)
+            if amp_mode:
+                arrays = _amp_cast(arrays, amp_mode)
+            return fcompute(
+                {k: v for k, v in attrs.items() if k != "_amp"}, *arrays)
 
         return call
 

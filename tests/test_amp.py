@@ -1,0 +1,112 @@
+"""AMP tests (parity intent: reference tests/python/gpu/test_contrib_amp.py
+— init() routes precision by op lists, loss scaler skips bad steps,
+training under amp matches fp32 closely)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import amp, gluon, nd
+from mxnet_tpu.gluon import nn
+
+
+@pytest.fixture(autouse=True)
+def _amp_off():
+    yield
+    amp.deinit()
+
+
+def test_amp_routes_matmul_to_bf16():
+    amp.init(target_dtype="bfloat16")
+    x = nd.array(np.random.randn(4, 8).astype(np.float32))
+    w = nd.array(np.random.randn(8, 8).astype(np.float32))
+    out = nd.dot(x, w)
+    assert str(out.dtype) == "bfloat16"
+    # deny-listed op gets fp32 back
+    s = nd.softmax(out)
+    assert str(s.dtype) == "float32"
+
+
+def test_amp_off_is_fp32():
+    x = nd.array(np.random.randn(4, 8).astype(np.float32))
+    w = nd.array(np.random.randn(8, 8).astype(np.float32))
+    assert str(nd.dot(x, w).dtype) == "float32"
+
+
+def test_amp_mlp_converges_close_to_fp32():
+    """bf16 AMP training tracks fp32 training (the MFU recipe is safe)."""
+    np.random.seed(0)
+    x_np = np.random.randn(64, 16).astype(np.float32)
+    y_np = (np.arange(64) % 10).astype(np.float32)
+
+    def run(use_amp):
+        if use_amp:
+            amp.init(target_dtype="bfloat16")
+        else:
+            amp.deinit()
+        mx.random.seed(3)
+        np.random.seed(3)
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(32, activation="relu"), nn.Dense(10))
+        net.initialize(mx.initializer.Xavier())
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.2})
+        lossfn = gluon.loss.SoftmaxCrossEntropyLoss()
+        x, y = nd.array(x_np), nd.array(y_np)
+        losses = []
+        for _ in range(60):
+            with mx.autograd.record():
+                l = lossfn(net(x), y).mean()
+            l.backward()
+            tr.step(1)
+            losses.append(float(l.asscalar()))
+        amp.deinit()
+        return losses
+
+    fp32 = run(False)
+    bf16 = run(True)
+    assert bf16[-1] < bf16[0] * 0.5, bf16
+    # same ballpark as fp32 (bf16 rounding means not bit-identical)
+    assert abs(bf16[-1] - fp32[-1]) < 0.3, (fp32[-1], bf16[-1])
+
+
+def test_loss_scaler_skips_overflow_and_halves_scale():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(4))
+    net.initialize(mx.initializer.Xavier())
+    x = nd.array(np.random.randn(2, 3).astype(np.float32))
+    y = nd.array(np.random.randn(2, 4).astype(np.float32))
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    amp.init_trainer(tr)
+    scaler = tr._amp_loss_scaler
+    lossfn = gluon.loss.L2Loss()
+    with mx.autograd.record():
+        l = lossfn(net(x), y).mean()
+        with amp.scale_loss(l, tr) as scaled:
+            scaled.backward()
+    before = [p.data().asnumpy().copy()
+              for p in net.collect_params().values()]
+    # poison one gradient with inf -> step must skip and halve the scale
+    p0 = list(net.collect_params().values())[0]
+    g = p0.list_grad()[0]
+    g[:] = nd.array(np.full(g.shape, np.inf, np.float32))
+    s0 = scaler.loss_scale
+    tr.step(1)
+    after = [p.data().asnumpy() for p in net.collect_params().values()]
+    for b, a in zip(before, after):
+        np.testing.assert_array_equal(b, a)
+    assert scaler.loss_scale == s0 / 2
+
+
+def test_convert_hybrid_block():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(8), nn.Dense(4))
+    net.initialize(mx.initializer.Xavier())
+    net(nd.array(np.random.randn(2, 6).astype(np.float32)))
+    amp.convert_hybrid_block(net, "bfloat16")
+    dts = {p.name: str(p.data().dtype)
+           for p in net.collect_params().values()}
+    assert all(d == "bfloat16" for n, d in dts.items() if "weight" in n)
+    assert all(d == "float32" for n, d in dts.items() if "bias" in n)

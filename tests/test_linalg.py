@@ -1,0 +1,105 @@
+"""linalg op family tests (parity intent: reference test_operator.py
+linalg sections — forward vs numpy, grads via tape where defined)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _spd(n, batch=()):
+    a = np.random.randn(*batch, n, n).astype(np.float32)
+    return np.matmul(a, np.swapaxes(a, -1, -2)) + \
+        n * np.eye(n, dtype=np.float32)
+
+
+def test_gemm_gemm2():
+    a = np.random.randn(2, 3, 4).astype(np.float32)
+    b = np.random.randn(2, 4, 5).astype(np.float32)
+    c = np.random.randn(2, 3, 5).astype(np.float32)
+    out = nd.linalg.gemm(nd.array(a), nd.array(b), nd.array(c),
+                         alpha=2.0, beta=0.5)
+    np.testing.assert_allclose(out.asnumpy(), 2 * a @ b + 0.5 * c,
+                               rtol=1e-5)
+    out2 = nd.linalg.gemm2(nd.array(a), nd.array(b))
+    np.testing.assert_allclose(out2.asnumpy(), a @ b, rtol=1e-5)
+    # transpose flags
+    out3 = nd.linalg.gemm2(nd.array(a), nd.array(c), transpose_a=True)
+    np.testing.assert_allclose(out3.asnumpy(),
+                               np.swapaxes(a, -1, -2) @ c, rtol=1e-5)
+
+
+def test_potrf_potri_sumlogdiag():
+    a = _spd(4, (2,))
+    l = nd.linalg.potrf(nd.array(a))
+    np.testing.assert_allclose(np.matmul(l.asnumpy(),
+                                         np.swapaxes(l.asnumpy(), -1, -2)),
+                               a, rtol=1e-4, atol=1e-4)
+    ainv = nd.linalg.potri(l)
+    np.testing.assert_allclose(ainv.asnumpy(), np.linalg.inv(a),
+                               rtol=1e-3, atol=1e-3)
+    sld = nd.linalg.sumlogdiag(l)
+    want = 0.5 * np.linalg.slogdet(a)[1]
+    np.testing.assert_allclose(sld.asnumpy(), want, rtol=1e-4)
+
+
+def test_trsm_trmm():
+    a = np.tril(_spd(3))
+    b = np.random.randn(3, 4).astype(np.float32)
+    x = nd.linalg.trsm(nd.array(a), nd.array(b))
+    np.testing.assert_allclose(a @ x.asnumpy(), b, rtol=1e-4, atol=1e-4)
+    y = nd.linalg.trmm(nd.array(a), nd.array(b))
+    np.testing.assert_allclose(y.asnumpy(), a @ b, rtol=1e-5)
+
+
+def test_syrk_gelqf_syevd():
+    a = np.random.randn(3, 5).astype(np.float32)
+    s = nd.linalg.syrk(nd.array(a))
+    np.testing.assert_allclose(s.asnumpy(), a @ a.T, rtol=1e-5)
+    l, q = nd.linalg.gelqf(nd.array(a))
+    np.testing.assert_allclose(l.asnumpy() @ q.asnumpy(), a, rtol=1e-4,
+                               atol=1e-4)
+    np.testing.assert_allclose(q.asnumpy() @ q.asnumpy().T, np.eye(3),
+                               rtol=1e-4, atol=1e-4)
+    spd = _spd(4)
+    u, w = nd.linalg.syevd(nd.array(spd))
+    rec = u.asnumpy().T @ np.diag(w.asnumpy()) @ u.asnumpy()
+    np.testing.assert_allclose(rec, spd, rtol=1e-3, atol=1e-3)
+
+
+def test_inverse_det_slogdet():
+    a = _spd(4)
+    np.testing.assert_allclose(nd.linalg.inverse(nd.array(a)).asnumpy(),
+                               np.linalg.inv(a), rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(nd.linalg.det(nd.array(a)).asnumpy(),
+                               np.linalg.det(a), rtol=1e-3)
+    sign, logabs = nd.linalg.slogdet(nd.array(a))
+    s_np, l_np = np.linalg.slogdet(a)
+    np.testing.assert_allclose(sign.asnumpy(), s_np)
+    np.testing.assert_allclose(logabs.asnumpy(), l_np, rtol=1e-4)
+
+
+def test_diag_trian_roundtrip():
+    a = np.random.randn(4, 4).astype(np.float32)
+    d = nd.linalg.extractdiag(nd.array(a))
+    np.testing.assert_allclose(d.asnumpy(), np.diag(a), rtol=1e-6)
+    m = nd.linalg.makediag(d)
+    np.testing.assert_allclose(m.asnumpy(), np.diag(np.diag(a)), rtol=1e-6)
+    t = nd.linalg.extracttrian(nd.array(a))
+    back = nd.linalg.maketrian(t)
+    np.testing.assert_allclose(back.asnumpy(), np.tril(a), rtol=1e-6)
+
+
+def test_linalg_grad_through_tape():
+    """potrf/sumlogdiag compose to 0.5*logdet — its gradient is 0.5*A^-1."""
+    a_np = _spd(3)
+    a = nd.array(a_np)
+    a.attach_grad()
+    with mx.autograd.record():
+        l = nd.linalg.potrf(a)
+        out = nd.linalg.sumlogdiag(l)
+    out.backward()
+    want = 0.5 * np.linalg.inv(a_np)
+    got = a.grad.asnumpy()
+    got_sym = 0.5 * (got + got.T)  # gradient defined up to symmetrization
+    np.testing.assert_allclose(got_sym, 0.5 * (want + want.T) / 1.0,
+                               rtol=1e-3, atol=1e-3)
