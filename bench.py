@@ -8,8 +8,21 @@ TPU recipe: the whole train step (fwd+bwd+SGD-momentum update) is ONE
 compiled XLA program; bf16 compute with fp32 master weights & BatchNorm
 statistics (mxnet_tpu.amp recipe).  Model build / functionalization happens
 on the host CPU backend with jit disabled so NOTHING compiles for the
-device except that single program — round 1 died doing one remote compile
-per imperative op over the axon link.
+device except the few programs we time.
+
+MFU methodology (round-3 hardening):
+  * model FLOPs are ANALYTIC (ResNet-50 fwd ~3.86 GFLOP/img at 224x224,
+    train = 3x fwd) — the standard MFU convention; XLA's
+    compiled.cost_analysis() is reported alongside for diagnosis (r02
+    showed it ~2x the analytic count).
+  * peak FLOP/s is the max of (a) the public table number for the
+    reported device_kind and (b) an EMPIRICAL calibration: chained large
+    bf16 matmuls timed on the same device.  If the relay under-reports
+    its device kind, (b) catches it.
+  * if the resulting MFU is still > 1.0 the number is NOT printed as
+    "mfu"; the raw measurements go into an "anomaly" field instead.
+  * a fully-synchronous per-step timing cross-checks the chunked async
+    loop (catches relay-side timing artifacts).
 
 Prints ONE JSON line:
   {"metric", "value", "unit", "vs_baseline", "mfu", ...}
@@ -17,14 +30,18 @@ Always prints the line — on failure or budget exhaustion with whatever was
 measured (value 0.0 and an "error" field if nothing was).
 
 Env knobs: BENCH_DTYPE, BENCH_WARMUP, BENCH_ITERS, BENCH_TIME_BUDGET (s),
-BENCH_BATCH.
+BENCH_BATCH, BENCH_BATCH2 (second MFU point, 0 disables), BENCH_CALIB_N.
 """
+import functools
 import json
 import os
 import sys
 import time
 
 BASELINE_IMG_S = 298.51
+# ResNet-50 v1, 224x224, fwd pass: ~3.86e9 FLOPs/img (2*MACs over
+# conv+fc; the usual published figure).  Training step ~= 3x forward.
+ANALYTIC_FWD_FLOPS_PER_IMG = 3.86e9
 T_START = time.perf_counter()
 
 
@@ -53,17 +70,56 @@ def peak_flops_for(device_kind: str):
     return 197e12, f"unknown({device_kind})->assumed v5e"
 
 
+def calibrate_peak(dev, n=None, reps=50):
+    """Empirical peak bf16 FLOP/s: chained NxN matmuls on-device.
+
+    Data is generated on the device (no host transfer over the relay);
+    the chain b = a@b serialises the executions so total time is the sum
+    of the individual matmuls.  Returns (flops_per_sec, details dict).
+    """
+    import jax
+    import jax.numpy as jnp
+    n = n or int(os.environ.get("BENCH_CALIB_N", 4096))
+    key = jax.random.PRNGKey(0)
+
+    @functools.partial(jax.jit, device=dev)
+    def init(k):
+        ka, kb = jax.random.split(k)
+        a = jax.random.normal(ka, (n, n), jnp.bfloat16)
+        b = jax.random.normal(kb, (n, n), jnp.bfloat16)
+        return a, b
+
+    @functools.partial(jax.jit, device=dev)
+    def mm(a, b):
+        return a @ b
+
+    a, b = init(key)
+    a.block_until_ready()
+    c = mm(a, b)
+    c.block_until_ready()  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        b = mm(a, b)
+    b.block_until_ready()
+    dt = time.perf_counter() - t0
+    flops = 2.0 * n * n * n * reps
+    return flops / dt, {"n": n, "reps": reps, "seconds": round(dt, 4)}
+
+
 def main():
     budget = float(os.environ.get("BENCH_TIME_BUDGET", 1200))
     batch = int(os.environ.get("BENCH_BATCH", 32))
+    batch2 = int(os.environ.get("BENCH_BATCH2", 128))
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
     n_warm = int(os.environ.get("BENCH_WARMUP", 2))
     n_iter = int(os.environ.get("BENCH_ITERS", 20))
 
     result = {
-        "metric": "resnet50_train_img_per_sec_bs32",
+        "metric": f"resnet50_train_img_per_sec_bs{batch}",
         "value": 0.0,
         "unit": "img/s",
+        # baseline is bs32 fp32 on 1x V100; only a like-for-like batch is
+        # a meaningful ratio
         "vs_baseline": 0.0,
     }
 
@@ -94,8 +150,12 @@ def main():
         from mxnet_tpu import autograd as _ag
         from mxnet_tpu import amp
 
-        dev = jax.devices()[0]
-        log(f"device: {dev.platform}/{getattr(dev, 'device_kind', '?')}")
+        devs = jax.devices()
+        dev = devs[0]
+        kind = getattr(dev, "device_kind", "?")
+        log(f"devices: {len(devs)}x {dev.platform}/{kind}")
+        result["n_devices"] = len(devs)
+        result["device_kind"] = str(kind)
 
         if dtype == "bfloat16":
             # framework AMP: MXU ops compute in bf16, fp32 master weights
@@ -145,81 +205,164 @@ def main():
                             for mu, a in zip(mutated, aparams))
             return tuple(new_p), new_aux, tuple(new_m), loss
 
-        log("placing params on device")
-        tparams = tuple(jax.device_put(param_arrays[i], dev)
-                        for i in train_idx)
-        aparams = tuple(jax.device_put(param_arrays[i], dev)
-                        for i in aux_list)
-        moms = tuple(jnp.zeros_like(p) for p in tparams)
-        x = jax.device_put(
-            np.random.randn(batch, 3, 224, 224).astype(np.float32), dev
-        ).astype(compute_dtype)
-        y = jax.device_put(
-            np.random.randint(0, 1000, (batch,)).astype(np.float32), dev)
-        key = _random.next_key()
+        base_tparams = tuple(jax.device_put(param_arrays[i], dev)
+                             for i in train_idx)
+        base_aparams = tuple(jax.device_put(param_arrays[i], dev)
+                             for i in aux_list)
 
-        log("lowering + compiling ONE train-step program")
-        t0 = time.perf_counter()
-        step_jit = jax.jit(step, donate_argnums=(1, 2, 3))
-        lowered = step_jit.lower(key, tparams, aparams, moms, x, y)
-        compiled = lowered.compile()
-        compile_s = time.perf_counter() - t0
-        log(f"compiled in {compile_s:.1f}s")
-        result["compile_seconds"] = round(compile_s, 1)
+        def measure(bs, iters):
+            """Compile + time the train step at batch size bs.
 
-        flops_per_step = None
-        try:
-            ca = compiled.cost_analysis()
-            if isinstance(ca, (list, tuple)):
-                ca = ca[0]
-            flops_per_step = float(ca.get("flops", 0.0)) or None
-        except Exception:
-            pass
-        if not flops_per_step:
-            # analytic fallback: ~3.86 GFLOP fwd/img * 3 (fwd+bwd)
-            flops_per_step = 3.86e9 * 3 * batch
+            Returns dict with img/s, per-step times and flops diagnostics.
+            """
+            tparams = tuple(jnp.array(p) for p in base_tparams)
+            aparams = tuple(jnp.array(p) for p in base_aparams)
+            moms = tuple(jnp.zeros_like(p) for p in tparams)
+            x = jax.device_put(
+                np.random.randn(bs, 3, 224, 224).astype(np.float32), dev
+            ).astype(compute_dtype)
+            y = jax.device_put(
+                np.random.randint(0, 1000, (bs,)).astype(np.float32), dev)
+            key = _random.next_key()
 
-        log(f"warmup x{n_warm}")
-        loss = None
-        for _ in range(n_warm):
-            tparams, aparams, moms, loss = compiled(
-                key, tparams, aparams, moms, x, y)
-        if loss is not None:
-            loss.block_until_ready()
+            log(f"[bs{bs}] lowering + compiling train-step program")
+            t0 = time.perf_counter()
+            step_jit = jax.jit(step, donate_argnums=(1, 2, 3))
+            compiled = step_jit.lower(
+                key, tparams, aparams, moms, x, y).compile()
+            compile_s = time.perf_counter() - t0
+            log(f"[bs{bs}] compiled in {compile_s:.1f}s")
 
-        # timed loop, chunked so a budget overrun still reports
-        log(f"timing (target {n_iter} iters, budget {budget:.0f}s)")
-        done = 0
-        t0 = time.perf_counter()
-        while done < n_iter:
-            chunk = min(5, n_iter - done)
-            for _ in range(chunk):
+            ca_flops = None
+            try:
+                ca = compiled.cost_analysis()
+                if isinstance(ca, (list, tuple)):
+                    ca = ca[0]
+                ca_flops = float(ca.get("flops", 0.0)) or None
+            except Exception:
+                pass
+
+            loss = None
+            for _ in range(n_warm):
                 tparams, aparams, moms, loss = compiled(
                     key, tparams, aparams, moms, x, y)
-            loss.block_until_ready()
-            done += chunk
-            if time.perf_counter() - T_START > budget * 0.9:
-                log(f"time budget; stopping at {done} iters")
-                break
-        dt = time.perf_counter() - t0
-        img_s = batch * done / dt
+            if loss is not None:
+                loss.block_until_ready()
 
-        peak, kind = peak_flops_for(getattr(dev, "device_kind", ""))
-        mfu = (flops_per_step * done / dt) / peak
-        log(f"{img_s:.1f} img/s, mfu {mfu:.3f} "
-            f"(flops/step {flops_per_step / 1e9:.1f}G, peak {kind})")
+            # cross-check: fully synchronous steps (block every iter)
+            sync_times = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                tparams, aparams, moms, loss = compiled(
+                    key, tparams, aparams, moms, x, y)
+                loss.block_until_ready()
+                sync_times.append(time.perf_counter() - t0)
+            sync_step_ms = min(sync_times) * 1e3
+
+            # timed loop, chunked so a budget overrun still reports
+            log(f"[bs{bs}] timing (target {iters} iters)")
+            done = 0
+            t0 = time.perf_counter()
+            while done < iters:
+                chunk = min(5, iters - done)
+                for _ in range(chunk):
+                    tparams, aparams, moms, loss = compiled(
+                        key, tparams, aparams, moms, x, y)
+                loss.block_until_ready()
+                done += chunk
+                if time.perf_counter() - T_START > budget * 0.85:
+                    log(f"[bs{bs}] time budget; stopping at {done} iters")
+                    break
+            dt = time.perf_counter() - t0
+            if done == 0:
+                raise RuntimeError("no timed iterations completed")
+            return {
+                "batch": bs,
+                "img_s": bs * done / dt,
+                "iters": done,
+                "step_ms": dt / done * 1e3,
+                "sync_step_ms": sync_step_ms,
+                "compile_seconds": round(compile_s, 1),
+                "flops_analytic": ANALYTIC_FWD_FLOPS_PER_IMG * 3 * bs,
+                "flops_cost_analysis": ca_flops,
+                "final_loss": float(loss),
+            }
+
+        m1 = measure(batch, n_iter)
+        log(f"[bs{batch}] {m1['img_s']:.1f} img/s, "
+            f"step {m1['step_ms']:.2f}ms (sync {m1['sync_step_ms']:.2f}ms)")
+
+        # --- peak calibration -------------------------------------------
+        table_peak, table_kind = peak_flops_for(str(kind))
+        calibrated_peak, calib_info = None, None
+        try:
+            log("calibrating peak FLOP/s (chained bf16 matmuls)")
+            calibrated_peak, calib_info = calibrate_peak(dev)
+            log(f"calibrated peak: {calibrated_peak / 1e12:.1f} TFLOP/s "
+                f"(table {table_kind}: {table_peak / 1e12:.0f})")
+        except Exception as e:
+            log(f"calibration failed: {type(e).__name__}: {e}")
+
+        # Denominator: trust whichever evidence says the chip is FASTER —
+        # a mis-reported device_kind is exactly what calibration catches.
+        peak_used = max([p for p in (table_peak, calibrated_peak) if p])
+
+        def attach_mfu(m, res):
+            achieved = m["flops_analytic"] * 1e3 / m["step_ms"]
+            mfu = achieved / peak_used
+            res["step_ms"] = round(m["step_ms"], 3)
+            res["sync_step_ms"] = round(m["sync_step_ms"], 3)
+            # the sync cross-check gates trust in the async timing: if a
+            # fully-blocking step is much slower than the chunked-loop
+            # step, the async numbers are a relay/timing artifact
+            timing_ok = m["sync_step_ms"] <= m["step_ms"] * 1.5
+            if 0 < mfu <= 1.0 and timing_ok:
+                res["mfu"] = round(mfu, 4)
+            else:
+                res["anomaly"] = {
+                    "reason": ("computed MFU > 1.0 — physically impossible"
+                               if mfu > 1.0 else
+                               "sync step time diverges from async timing"),
+                    "mfu_raw": round(mfu, 4),
+                    "achieved_flops_per_sec": achieved,
+                    "peak_used": peak_used,
+                }
+            return mfu
 
         result.update({
-            "value": round(img_s, 2),
-            "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
-            "mfu": round(mfu, 4),
-            "mfu_peak_flops_assumed": f"{kind}:{peak:.3g}",
-            "flops_per_step": round(flops_per_step, 0),
-            "iters": done,
+            "value": round(m1["img_s"], 2),
+            "vs_baseline": round(m1["img_s"] / BASELINE_IMG_S, 3),
+            "compile_seconds": m1["compile_seconds"],
+            "iters": m1["iters"],
             "batch": batch,
             "dtype": dtype,
-            "final_loss": float(loss),
+            "final_loss": m1["final_loss"],
+            "flops_per_step_analytic": m1["flops_analytic"],
+            "flops_per_step_cost_analysis": m1["flops_cost_analysis"],
+            "peak_flops_table": f"{table_kind}:{table_peak:.3g}",
+            "peak_flops_calibrated": (
+                round(calibrated_peak, 0) if calibrated_peak else None),
+            "calibration": calib_info,
         })
+        attach_mfu(m1, result)
+
+        # --- second MFU point (bs128-256 per round-3 verdict) ------------
+        remaining = budget - (time.perf_counter() - T_START)
+        if batch2 and batch2 != batch and remaining > 240:
+            try:
+                m2 = measure(batch2, n_iter)
+                log(f"[bs{batch2}] {m2['img_s']:.1f} img/s, "
+                    f"step {m2['step_ms']:.2f}ms")
+                sub = {"img_s": round(m2["img_s"], 2), "iters": m2["iters"],
+                       "compile_seconds": m2["compile_seconds"],
+                       "final_loss": m2["final_loss"]}
+                attach_mfu(m2, sub)
+                result[f"bs{batch2}"] = sub
+            except Exception as e:
+                log(f"bs{batch2} phase failed: {type(e).__name__}: {e}")
+                result[f"bs{batch2}"] = {"error": str(e)}
+        elif batch2 and batch2 != batch:
+            log(f"skipping bs{batch2}: only {remaining:.0f}s left")
     except Exception as e:  # always emit the JSON line
         import traceback
         traceback.print_exc(file=sys.stderr)
