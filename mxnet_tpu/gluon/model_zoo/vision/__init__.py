@@ -20,6 +20,7 @@ from .resnet import (get_resnet, resnet18_v1, resnet18_v2, resnet34_v1,
 from .squeezenet import squeezenet1_0, squeezenet1_1
 from .vgg import (get_vgg, vgg11, vgg11_bn, vgg13, vgg13_bn, vgg16, vgg16_bn,
                   vgg19, vgg19_bn)
+from .ssd import SSD, SSDTrainLoss, ssd_300_vgg16, ssd_vgg16_test
 
 _models = {
     "resnet18_v1": resnet18_v1, "resnet34_v1": resnet34_v1,
@@ -31,6 +32,7 @@ _models = {
     "vgg11_bn": vgg11_bn, "vgg13_bn": vgg13_bn, "vgg16_bn": vgg16_bn,
     "vgg19_bn": vgg19_bn,
     "alexnet": alexnet,
+    "ssd_300_vgg16": ssd_300_vgg16,
     "densenet121": densenet121, "densenet161": densenet161,
     "densenet169": densenet169, "densenet201": densenet201,
     "squeezenet1.0": squeezenet1_0, "squeezenet1.1": squeezenet1_1,

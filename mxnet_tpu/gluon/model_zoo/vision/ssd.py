@@ -1,0 +1,169 @@
+"""SSD object detection (parity: example/ssd — symbol/symbol_vgg16_reduced
++ symbol/common.py multibox heads; BASELINE.json configs[3]).
+
+TPU redesign: the whole detector is one HybridBlock — base conv features,
+multi-scale heads, and MultiBoxPrior anchors all trace into a single XLA
+program under hybridize; training targets (MultiBoxTarget) and decode/NMS
+(MultiBoxDetection) are the bounded-shape ops in ops/_op_contrib.py.
+"""
+from __future__ import annotations
+
+from ...block import HybridBlock
+from ... import nn
+from ....context import cpu
+from .... import initializer as init
+
+__all__ = ["SSD", "ssd_300_vgg16", "ssd_vgg16_test", "SSDTrainLoss"]
+
+
+def _conv_block(out, num, channels, stride=1):
+    for _ in range(num):
+        out.add(nn.Conv2D(channels, kernel_size=3, padding=1,
+                          weight_initializer=init.Xavier(),
+                          bias_initializer="zeros"))
+        out.add(nn.Activation("relu"))
+    if stride == 2:
+        out.add(nn.MaxPool2D(strides=2))
+    return out
+
+
+class SSD(HybridBlock):
+    """Multi-scale single-shot detector.
+
+    Outputs (training mode): anchors (1, A, 4), cls_preds (B, C+1, A),
+    loc_preds (B, A*4) — exactly the inputs MultiBoxTarget /
+    MultiBoxDetection expect (example/ssd/symbol/common.py:multibox_layer).
+    """
+
+    def __init__(self, num_classes, base_filters=(64, 128, 256, 512, 512),
+                 base_layers=(2, 2, 3, 3, 3),
+                 sizes=((.1, .141), (.2, .272), (.37, .447), (.54, .619),
+                        (.71, .79), (.88, .961)),
+                 ratios=((1, 2, .5),) * 6, **kwargs):
+        super().__init__(**kwargs)
+        assert len(sizes) == len(ratios)
+        self.num_classes = num_classes
+        self.sizes = sizes
+        self.ratios = ratios
+        n_scales = len(sizes)
+        with self.name_scope():
+            # VGG base up to conv4_3 (first prediction scale)
+            self.base = nn.HybridSequential(prefix="base_")
+            with self.base.name_scope():
+                for i in range(4):
+                    _conv_block(self.base, base_layers[i], base_filters[i],
+                                stride=2 if i < 3 else 1)
+            # conv5 block + fc6/fc7-as-conv (the "reduced" VGG tail)
+            self.tail = nn.HybridSequential(prefix="tail_")
+            with self.tail.name_scope():
+                self.tail.add(nn.MaxPool2D(strides=2))
+                _conv_block(self.tail, base_layers[4], base_filters[4])
+                self.tail.add(nn.Conv2D(1024, kernel_size=3, padding=1,
+                                        weight_initializer=init.Xavier(),
+                                        bias_initializer="zeros"))
+                self.tail.add(nn.Activation("relu"))
+                self.tail.add(nn.Conv2D(1024, kernel_size=1,
+                                        weight_initializer=init.Xavier(),
+                                        bias_initializer="zeros"))
+                self.tail.add(nn.Activation("relu"))
+            # extra downsampling scales
+            self.extras = []
+            for i in range(n_scales - 2):
+                blk = nn.HybridSequential(prefix=f"extra{i}_")
+                with blk.name_scope():
+                    blk.add(nn.Conv2D(256, kernel_size=1,
+                                      weight_initializer=init.Xavier(),
+                                      bias_initializer="zeros"))
+                    blk.add(nn.Activation("relu"))
+                    blk.add(nn.Conv2D(512, kernel_size=3, strides=2,
+                                      padding=1,
+                                      weight_initializer=init.Xavier(),
+                                      bias_initializer="zeros"))
+                    blk.add(nn.Activation("relu"))
+                setattr(self, f"extra{i}", blk)
+                self.extras.append(blk)
+            # per-scale heads
+            self.cls_heads = []
+            self.loc_heads = []
+            for i in range(n_scales):
+                k = len(sizes[i]) + len(ratios[i]) - 1
+                ch = nn.Conv2D(k * (num_classes + 1), kernel_size=3,
+                               padding=1, prefix=f"cls{i}_",
+                               weight_initializer=init.Xavier(),
+                               bias_initializer="zeros")
+                lh = nn.Conv2D(k * 4, kernel_size=3, padding=1,
+                               prefix=f"loc{i}_",
+                               weight_initializer=init.Xavier(),
+                               bias_initializer="zeros")
+                setattr(self, f"cls_head{i}", ch)
+                setattr(self, f"loc_head{i}", lh)
+                self.cls_heads.append(ch)
+                self.loc_heads.append(lh)
+
+    def hybrid_forward(self, F, x):
+        feats = []
+        x = self.base(x)
+        feats.append(x)
+        x = self.tail(x)
+        feats.append(x)
+        for blk in self.extras:
+            x = blk(x)
+            feats.append(x)
+
+        anchors, cls_preds, loc_preds = [], [], []
+        for i, feat in enumerate(feats):
+            anchors.append(F.contrib.MultiBoxPrior(
+                feat, sizes=self.sizes[i], ratios=self.ratios[i]))
+            cp = self.cls_heads[i](feat)          # (B, K*(C+1), H, W)
+            # -> (B, A_i, C+1) flattened per-anchor class rows
+            cp = F.transpose(cp, axes=(0, 2, 3, 1))
+            cls_preds.append(F.reshape(cp, shape=(0, -1, self.num_classes + 1)))
+            lp = F.transpose(self.loc_heads[i](feat), axes=(0, 2, 3, 1))
+            loc_preds.append(F.reshape(lp, shape=(0, -1)))
+        anchors = F.concat(*anchors, dim=1)               # (1, A, 4)
+        cls_preds = F.concat(*cls_preds, dim=1)           # (B, A, C+1)
+        cls_preds = F.transpose(cls_preds, axes=(0, 2, 1))  # (B, C+1, A)
+        loc_preds = F.concat(*loc_preds, dim=1)           # (B, A*4)
+        return anchors, cls_preds, loc_preds
+
+
+class SSDTrainLoss(HybridBlock):
+    """MultiBoxTarget + softmax CE (classes) + smooth-L1 (boxes)
+    (example/ssd/symbol/common.py training head)."""
+
+    def __init__(self, negative_mining_ratio=3.0, **kwargs):
+        super().__init__(**kwargs)
+        self._ratio = negative_mining_ratio
+
+    def hybrid_forward(self, F, anchors, cls_preds, loc_preds, labels):
+        loc_t, loc_m, cls_t = F.contrib.MultiBoxTarget(
+            anchors, labels, cls_preds,
+            negative_mining_ratio=self._ratio,
+            negative_mining_thresh=0.5)
+        # masked CE over logits (B, C+1, A); ignore_label (-1) rows
+        # contribute zero
+        valid = cls_t >= 0
+        logp = F.log_softmax(cls_preds, axis=1)
+        n_valid = F.broadcast_maximum(F.sum(valid), F.ones_like(F.sum(valid)))
+        cls_loss = F.sum(-F.pick(logp, F.relu(cls_t), axis=1) * valid) \
+            / n_valid
+        n_loc = F.broadcast_maximum(F.sum(loc_m),
+                                    F.ones_like(F.sum(loc_m)))
+        loc_loss = F.sum(F.smooth_l1((loc_preds - loc_t) * loc_m,
+                                     scalar=1.0)) / n_loc
+        return cls_loss + loc_loss
+
+
+def ssd_300_vgg16(classes=20, pretrained=False, ctx=cpu(), **kwargs):
+    """SSD-300 with the full VGG16 base (BASELINE.json configs[3])."""
+    net = SSD(num_classes=classes, **kwargs)
+    if pretrained:
+        raise NotImplementedError("no pretrained SSD weights in-tree")
+    return net
+
+
+def ssd_vgg16_test(classes=3, **kwargs):
+    """Small-input SSD (VGG16 topology, 4 scales) for unit tests."""
+    return SSD(num_classes=classes,
+               sizes=((.2, .272), (.37, .447), (.54, .619), (.71, .79)),
+               ratios=((1, 2, .5),) * 4, **kwargs)

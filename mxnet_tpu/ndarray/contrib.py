@@ -220,3 +220,18 @@ def cond(pred, then_func, else_func, name="cond"):
     take_then = bool(jnp.any(p != 0)) if hasattr(p, "shape") else bool(p)
     out = then_func() if take_then else else_func()
     return out
+
+
+# ---------------------------------------------------------------------------
+# registry-backed contrib ops: nd.contrib.box_nms resolves _contrib_box_nms
+# (parity: python/mxnet/ndarray/contrib.py is codegen over _contrib_* ops)
+# ---------------------------------------------------------------------------
+def __getattr__(name):
+    from ..ops import registry as _registry
+    from . import _make_op_func
+    if _registry.exists(f"_contrib_{name}"):
+        fn = _make_op_func(_registry.get(f"_contrib_{name}"))
+        globals()[name] = fn  # cache: next access skips __getattr__
+        return fn
+    raise AttributeError(
+        f"module 'mxnet_tpu.ndarray.contrib' has no attribute {name!r}")

@@ -603,9 +603,10 @@ def invoke(op, inputs, attrs, out=None):
         if tape is not None:
             tape.append(node)
 
-    if len(results) == 1:
-        return results[0]
-    return results
+    visible = results if op.num_visible is None else results[:op.num_visible]
+    if len(visible) == 1:
+        return visible[0]
+    return visible
 
 
 # -- creation --------------------------------------------------------------

@@ -1,0 +1,589 @@
+"""Detection / contrib operator family.
+
+Reference: src/operator/contrib/bounding_box.cc (_contrib_box_nms:36,
+_contrib_box_iou:117, _contrib_bipartite_matching:158),
+multibox_prior.cc, multibox_target.cc:71 (MultiBoxTargetForward),
+multibox_detection.cc:83 (MultiBoxDetectionForward), roi_align.cc and
+src/operator/roi_pooling.cc.
+
+TPU redesign (SURVEY.md §7 hard part 8 — dynamic-shape ops under XLA
+static shapes): every op here is a *bounded-shape + masking* program.
+Where the reference compacts variable-length results with CopyIf /
+std::sort on the host, these emit fixed-shape sort + prefix-sum-scatter
+programs: invalid slots carry -1 sentinels exactly like the reference's
+output contract, so downstream consumers see the same API.  Sequential
+dependencies (greedy NMS, bipartite matching) lower to one
+``lax.fori_loop``/``lax.scan`` — a single XLA While op — instead of host
+loops; everything is vmapped over the batch and differentiable where the
+reference defines gradients (NMS backward = scatter of the kept rows,
+ROIAlign backward = bilinear scatter-add, both produced by JAX AD from
+the gather-based forwards).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+def _floats(v, default):
+    if v is None:
+        return tuple(float(x) for x in default)
+    if isinstance(v, (int, float)):
+        return (float(v),)
+    return tuple(float(x) for x in v)
+
+
+def _center_to_corner(b):
+    x, y, w, h = b[..., 0], b[..., 1], b[..., 2], b[..., 3]
+    return jnp.stack([x - w / 2, y - h / 2, x + w / 2, y + h / 2], axis=-1)
+
+
+def _corner_to_center(b):
+    l, t, r, bo = b[..., 0], b[..., 1], b[..., 2], b[..., 3]
+    return jnp.stack([(l + r) / 2, (t + bo) / 2, r - l, bo - t], axis=-1)
+
+
+def _box_area(b, fmt="corner"):
+    if fmt == "corner":
+        w = b[..., 2] - b[..., 0]
+        h = b[..., 3] - b[..., 1]
+    else:
+        w = b[..., 2]
+        h = b[..., 3]
+    return jnp.where((w < 0) | (h < 0), 0.0, w * h)
+
+
+def _pairwise_iou(a, b, fmt="corner"):
+    """IoU of (N,4) x (M,4) -> (N,M), matching CalculateOverlap
+    (multibox_detection.cc:73): union<=0 -> 0."""
+    ac = a if fmt == "corner" else _center_to_corner(a)
+    bc = b if fmt == "corner" else _center_to_corner(b)
+    tl = jnp.maximum(ac[:, None, :2], bc[None, :, :2])
+    br = jnp.minimum(ac[:, None, 2:], bc[None, :, 2:])
+    wh = jnp.maximum(br - tl, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = (_box_area(ac, "corner")[:, None]
+             + _box_area(bc, "corner")[None, :] - inter)
+    return jnp.where(union <= 0, 0.0, inter / union)
+
+
+# ---------------------------------------------------------------------------
+# box_iou
+# ---------------------------------------------------------------------------
+@register("_contrib_box_iou", alias=("box_iou",))
+def _contrib_box_iou(attrs, lhs, rhs):
+    fmt = attrs.get("format", "corner")
+    lsh, rsh = lhs.shape[:-1], rhs.shape[:-1]
+    out = _pairwise_iou(lhs.reshape(-1, 4), rhs.reshape(-1, 4), fmt)
+    return out.reshape(lsh + rsh)
+
+
+# ---------------------------------------------------------------------------
+# box_nms
+# ---------------------------------------------------------------------------
+def _nms_one(data, *, overlap_thresh, valid_thresh, topk, coord_start,
+             score_index, id_index, background_id, force_suppress,
+             in_format, out_format):
+    """Greedy NMS on one batch element (N, W) -> (out (N, W), record (N,)).
+
+    Kept boxes are compacted to the front in descending-score order;
+    dropped slots are -1 (bounding_box-inl.h nms_assign).
+    """
+    n, w = data.shape
+    scores = data[:, score_index]
+    valid = scores > valid_thresh
+    if id_index >= 0:
+        valid &= data[:, id_index] != background_id
+
+    # stable desc sort of valid scores; invalid slots sink to the end
+    order = jnp.argsort(jnp.where(valid, -scores, jnp.inf), stable=True)
+    sdata = data[order]
+    svalid = valid[order]
+    topk_eff = n if topk < 0 else min(n, topk)
+    cand = svalid & (jnp.arange(n) < topk_eff)
+
+    boxes = sdata[:, coord_start:coord_start + 4]
+    iou = _pairwise_iou(boxes, boxes, in_format)
+    if id_index >= 0 and not force_suppress:
+        cls = sdata[:, id_index]
+        suppress_ok = cls[:, None] == cls[None, :]
+        sup_mat = (iou > overlap_thresh) & suppress_ok
+    else:
+        sup_mat = iou > overlap_thresh
+
+    later = jnp.arange(n)[None, :] > jnp.arange(n)[:, None]
+
+    def body(i, keep):
+        sup = sup_mat[i] & later[i] & keep
+        return jnp.where(keep[i], keep & ~sup, keep)
+
+    keep = lax.fori_loop(0, topk_eff, body, cand)
+
+    if in_format != out_format:
+        conv = (_center_to_corner if out_format == "corner"
+                else _corner_to_center)
+        sdata = sdata.at[:, coord_start:coord_start + 4].set(conv(boxes))
+
+    # prefix-sum scatter: kept rows compact to the front, others dropped
+    pos = jnp.cumsum(keep) - 1
+    idx = jnp.where(keep, pos, n)
+    out = jnp.full((n, w), -1.0, data.dtype).at[idx].set(sdata, mode="drop")
+    rec = jnp.full((n,), -1.0, data.dtype).at[idx].set(
+        order.astype(data.dtype), mode="drop")
+    return out, rec
+
+
+@register("_contrib_box_nms", alias=("box_nms",), num_outputs=2,
+          num_visible=1)
+def _contrib_box_nms(attrs, data):
+    kw = dict(
+        overlap_thresh=float(attrs.get("overlap_thresh", 0.5)),
+        valid_thresh=float(attrs.get("valid_thresh", 0.0)),
+        topk=int(attrs.get("topk", -1)),
+        coord_start=int(attrs.get("coord_start", 2)),
+        score_index=int(attrs.get("score_index", 1)),
+        id_index=int(attrs.get("id_index", -1)),
+        background_id=int(attrs.get("background_id", -1)),
+        force_suppress=bool(attrs.get("force_suppress", False)),
+        in_format=attrs.get("in_format", "corner"),
+        out_format=attrs.get("out_format", "corner"),
+    )
+    shape = data.shape
+    n, w = shape[-2], shape[-1]
+    flat = data.reshape(-1, n, w)
+    out, rec = jax.vmap(lambda d: _nms_one(d, **kw))(flat)
+    # record holds the ORIGINAL index flattened over (batch, num_elem)
+    # (bounding_box-inl.h nms_assign: record[i*num+count] = location)
+    offs = jnp.arange(flat.shape[0], dtype=data.dtype) * n
+    rec = jnp.where(rec >= 0, rec + offs[:, None], -1.0)
+    return out.reshape(shape), rec.reshape(shape[:-1] + (1,))
+
+
+# ---------------------------------------------------------------------------
+# bipartite_matching
+# ---------------------------------------------------------------------------
+def _bipartite_one(score, *, is_ascend, threshold, topk):
+    n, m = score.shape
+    k = min(n, m) if topk < 0 else min(topk, min(n, m))
+    big = jnp.inf
+    sgn = 1.0 if is_ascend else -1.0  # minimise sgn*score
+
+    def body(carry, _):
+        row_free, col_free, row_match, col_match = carry
+        masked = jnp.where(row_free[:, None] & col_free[None, :],
+                           sgn * score, big)
+        flat = jnp.argmin(masked)
+        ri, ci = flat // m, flat % m
+        val = score[ri, ci]
+        ok = jnp.where(is_ascend, val <= threshold, val >= threshold)
+        ok &= masked[ri, ci] < big
+        r_sel = (jnp.arange(n) == ri) & ok
+        c_sel = (jnp.arange(m) == ci) & ok
+        row_free = row_free & ~r_sel
+        col_free = col_free & ~c_sel
+        row_match = jnp.where(r_sel, ci, row_match)
+        col_match = jnp.where(c_sel, ri, col_match)
+        return (row_free, col_free, row_match, col_match), 0
+
+    init = (jnp.ones(n, bool), jnp.ones(m, bool),
+            jnp.full(n, -1.0, score.dtype), jnp.full(m, -1.0, score.dtype))
+    (rf, cf, rm, cm), _ = lax.scan(body, init, None, length=k)
+    return rm, cm
+
+
+@register("_contrib_bipartite_matching", alias=("bipartite_matching",),
+          num_outputs=2)
+def _contrib_bipartite_matching(attrs, data):
+    kw = dict(is_ascend=bool(attrs.get("is_ascend", False)),
+              threshold=float(attrs.get("threshold", 0.0)),
+              topk=int(attrs.get("topk", -1)))
+    shape = data.shape
+    n, m = shape[-2], shape[-1]
+    flat = data.reshape(-1, n, m)
+    rm, cm = jax.vmap(lambda s: _bipartite_one(s, **kw))(flat)
+    return rm.reshape(shape[:-1]), cm.reshape(shape[:-2] + (m,))
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxPrior
+# ---------------------------------------------------------------------------
+@register("_contrib_MultiBoxPrior", alias=("MultiBoxPrior",))
+def _contrib_multibox_prior(attrs, data):
+    """Anchor generation (multibox_prior.cc:31 MultiBoxPriorForward).
+
+    Output (1, H*W*(num_sizes+num_ratios-1), 4) corner boxes; per
+    location the order is [each size with ratio0, then each extra ratio
+    with size0] in row-major (y, x) scan — byte-for-byte the reference's
+    layout.
+    """
+    sizes = _floats(attrs.get("sizes"), (1.0,))
+    ratios = _floats(attrs.get("ratios"), (1.0,))
+    steps = _floats(attrs.get("steps"), (-1.0, -1.0))
+    offsets = _floats(attrs.get("offsets"), (0.5, 0.5))
+    clip = bool(attrs.get("clip", False))
+    h, w = data.shape[-2], data.shape[-1]
+    step_y = steps[0] if steps[0] > 0 else 1.0 / h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / w
+    dt = data.dtype if jnp.issubdtype(data.dtype, jnp.floating) \
+        else jnp.float32
+
+    cy = (jnp.arange(h, dtype=dt) + offsets[0]) * step_y
+    cx = (jnp.arange(w, dtype=dt) + offsets[1]) * step_x
+    # per-location anchor half-sizes, reference order
+    half = []
+    r0 = jnp.sqrt(jnp.asarray(ratios[0], dt))
+    for s in sizes:
+        half.append((s * h / w * r0 / 2, s / r0 / 2))
+    for r in ratios[1:]:
+        rs = jnp.sqrt(jnp.asarray(r, dt))
+        half.append((sizes[0] * h / w * rs / 2, sizes[0] / rs / 2))
+    hw = jnp.stack([jnp.asarray(a, dt) for a, _ in half])  # (K,) half-width
+    hh = jnp.stack([jnp.asarray(b, dt) for _, b in half])  # (K,) half-height
+
+    cyg = cy[:, None, None]      # (H,1,1)
+    cxg = cx[None, :, None]      # (1,W,1)
+    boxes = jnp.stack([
+        jnp.broadcast_to(cxg - hw, (h, w, hw.shape[0])),
+        jnp.broadcast_to(cyg - hh, (h, w, hw.shape[0])),
+        jnp.broadcast_to(cxg + hw, (h, w, hw.shape[0])),
+        jnp.broadcast_to(cyg + hh, (h, w, hw.shape[0])),
+    ], axis=-1)                  # (H, W, K, 4)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    return boxes.reshape(1, -1, 4)
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxTarget
+# ---------------------------------------------------------------------------
+def _encode_loc(anchor, gt, variances):
+    """(gx-ax)/aw/vx ... log(gw/aw)/vw (multibox_target.cc:32
+    AssignLocTargets)."""
+    vx, vy, vw, vh = variances
+    aw = anchor[..., 2] - anchor[..., 0]
+    ah = anchor[..., 3] - anchor[..., 1]
+    ax = (anchor[..., 0] + anchor[..., 2]) * 0.5
+    ay = (anchor[..., 1] + anchor[..., 3]) * 0.5
+    gw = gt[..., 2] - gt[..., 0]
+    gh = gt[..., 3] - gt[..., 1]
+    gx = (gt[..., 0] + gt[..., 2]) * 0.5
+    gy = (gt[..., 1] + gt[..., 3]) * 0.5
+    eps = jnp.finfo(anchor.dtype).tiny
+    return jnp.stack([
+        (gx - ax) / aw / vx,
+        (gy - ay) / ah / vy,
+        jnp.log(jnp.maximum(gw / aw, eps)) / vw,
+        jnp.log(jnp.maximum(gh / ah, eps)) / vh,
+    ], axis=-1)
+
+
+def _mbox_target_one(anchors, label, cls_pred, *, overlap_threshold,
+                     ignore_label, negative_mining_ratio,
+                     negative_mining_thresh, variances):
+    """One batch element of MultiBoxTargetForward (multibox_target.cc:71).
+
+    anchors (A,4) corner, label (L,>=5) [cls,x1,y1,x2,y2,...] with -1
+    padding rows, cls_pred (C,A).  Returns loc_target (A*4), loc_mask
+    (A*4), cls_target (A).
+    """
+    a, l = anchors.shape[0], label.shape[0]
+    dt = anchors.dtype
+    # reference stops scanning labels at the first -1 class row
+    valid_gt = jnp.cumprod(label[:, 0] != -1.0).astype(bool)
+    n_valid = valid_gt.sum()
+
+    iou = _pairwise_iou(anchors, label[:, 1:5], "corner")   # (A, L)
+    iou = jnp.where(valid_gt[None, :], iou, -1.0)
+
+    # stage 1: greedy bipartite matching, one gt per iteration
+    def body(carry, _):
+        a_free, g_free, match_gt, match_iou = carry
+        masked = jnp.where(a_free[:, None] & g_free[None, :], iou, -1e9)
+        flat = jnp.argmax(masked)
+        ai, gi = flat // l, flat % l
+        val = masked.reshape(-1)[flat]
+        ok = val > 1e-6
+        a_sel = (jnp.arange(a) == ai) & ok
+        g_sel = (jnp.arange(l) == gi) & ok
+        return (a_free & ~a_sel, g_free & ~g_sel,
+                jnp.where(a_sel, gi, match_gt),
+                jnp.where(a_sel, val, match_iou)), 0
+
+    init = (jnp.ones(a, bool), jnp.ones(l, bool),
+            jnp.zeros(a, jnp.int32), jnp.full(a, -1.0, dt))
+    (a_free, _, match_gt, match_iou), _ = lax.scan(body, init, None,
+                                                   length=l)
+
+    # stage 2: threshold matching for still-free anchors
+    best_gt = jnp.argmax(iou, axis=1).astype(jnp.int32)
+    best_iou = jnp.max(iou, axis=1)
+    stage2 = a_free & (best_iou > overlap_threshold) & (n_valid > 0)
+    match_gt = jnp.where(stage2, best_gt, match_gt)
+    pos = (~a_free) | stage2
+    # per-anchor best overlap regardless of matching (negative mining key)
+    any_iou = jnp.where(a_free, best_iou, match_iou)
+
+    if negative_mining_ratio > 0:
+        num_pos = pos.sum()
+        num_neg = jnp.minimum(
+            (num_pos * negative_mining_ratio).astype(jnp.int32),
+            a - num_pos)
+        cand = (~pos) & (any_iou < negative_mining_thresh)
+        # hardest negatives = lowest background (class 0) probability
+        logits = cls_pred.astype(jnp.float32)
+        prob_bg = jax.nn.softmax(logits, axis=0)[0]
+        key = jnp.where(cand, -prob_bg, -jnp.inf)
+        desc = jnp.argsort(-key, stable=True)
+        rank = jnp.argsort(desc, stable=True)
+        neg = cand & (rank < num_neg)
+    else:
+        neg = ~pos
+
+    gt_cls = label[match_gt, 0]
+    gt_box = label[match_gt, 1:5]
+    cls_target = jnp.where(
+        pos, gt_cls + 1.0,
+        jnp.where(neg, 0.0, float(ignore_label))).astype(dt)
+    loc = _encode_loc(anchors, gt_box, variances)
+    loc_target = jnp.where(pos[:, None], loc, 0.0).astype(dt)
+    loc_mask = jnp.where(pos[:, None],
+                         jnp.ones((a, 4), dt), jnp.zeros((a, 4), dt))
+    # no valid gt: reference leaves everything at init
+    # (loc 0 / mask 0 / cls ignore_label)
+    has_gt = n_valid > 0
+    cls_target = jnp.where(has_gt, cls_target, float(ignore_label))
+    loc_target = jnp.where(has_gt, loc_target, 0.0)
+    loc_mask = jnp.where(has_gt, loc_mask, 0.0)
+    return loc_target.reshape(-1), loc_mask.reshape(-1), cls_target
+
+
+@register("_contrib_MultiBoxTarget", alias=("MultiBoxTarget",),
+          num_outputs=3)
+def _contrib_multibox_target(attrs, anchor, label, cls_pred):
+    kw = dict(
+        overlap_threshold=float(attrs.get("overlap_threshold", 0.5)),
+        ignore_label=float(attrs.get("ignore_label", -1.0)),
+        negative_mining_ratio=float(attrs.get("negative_mining_ratio",
+                                              -1.0)),
+        negative_mining_thresh=float(attrs.get("negative_mining_thresh",
+                                               0.5)),
+        variances=_floats(attrs.get("variances"), (0.1, 0.1, 0.2, 0.2)),
+    )
+    anchors = anchor.reshape(-1, 4)
+    lt, lm, ct = jax.vmap(
+        lambda lb, cp: _mbox_target_one(anchors, lb, cp, **kw))(
+            label, cls_pred)
+    return lt, lm, ct
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxDetection
+# ---------------------------------------------------------------------------
+def _decode_loc(anchors, loc_pred, variances, clip):
+    """TransformLocations (multibox_detection.cc:46)."""
+    vx, vy, vw, vh = variances
+    al, at, ar, ab = (anchors[:, 0], anchors[:, 1],
+                      anchors[:, 2], anchors[:, 3])
+    aw, ah = ar - al, ab - at
+    ax, ay = (al + ar) / 2, (at + ab) / 2
+    p = loc_pred.reshape(-1, 4)
+    ox = p[:, 0] * vx * aw + ax
+    oy = p[:, 1] * vy * ah + ay
+    ow = jnp.exp(p[:, 2] * vw) * aw / 2
+    oh = jnp.exp(p[:, 3] * vh) * ah / 2
+    out = jnp.stack([ox - ow, oy - oh, ox + ow, oy + oh], axis=-1)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out
+
+
+def _mbox_detection_one(cls_prob, loc_pred, anchors, *, clip, threshold,
+                        nms_threshold, force_suppress, variances, nms_topk):
+    c, a = cls_prob.shape
+    dt = cls_prob.dtype
+    # class 0 is background (multibox_detection.cc:112 scans classes
+    # from 1; the reference kernel likewise ignores its background_id
+    # param — the python wrapper below rejects non-zero values instead
+    # of silently mis-classifying)
+    fg = cls_prob[1:, :]
+    score = jnp.max(fg, axis=0)
+    cid = jnp.argmax(fg, axis=0).astype(dt)           # 0-based fg class
+    cid = jnp.where(score < threshold, -1.0, cid)
+    boxes = _decode_loc(anchors, loc_pred, variances, clip)
+    det = jnp.concatenate([cid[:, None], score[:, None], boxes], axis=1)
+
+    valid = cid >= 0
+    order = jnp.argsort(jnp.where(valid, -score, jnp.inf), stable=True)
+    sdet = det[order]
+    svalid = valid[order]
+    nkeep = a if nms_topk < 0 else min(nms_topk, a)
+    # beyond-topk detections are discarded (id -> -1), rows remain
+    sdet = sdet.at[:, 0].set(
+        jnp.where(svalid & (jnp.arange(a) >= nkeep), -1.0, sdet[:, 0]))
+    # blank out invalid rows entirely (reference preinitialises out to -1)
+    sdet = jnp.where(svalid[:, None], sdet, -1.0)
+
+    iou = _pairwise_iou(sdet[:, 2:6], sdet[:, 2:6], "corner")
+    if force_suppress:
+        same = jnp.ones((a, a), bool)
+    else:
+        same = sdet[:, 0][:, None] == sdet[:, 0][None, :]
+    sup_mat = (iou >= nms_threshold) & same
+    later = jnp.arange(a)[None, :] > jnp.arange(a)[:, None]
+
+    def body(i, ids):
+        alive_i = ids[i] >= 0
+        sup = sup_mat[i] & later[i] & (ids >= 0)
+        return jnp.where(alive_i, jnp.where(sup, -1.0, ids), ids)
+
+    ids = lax.fori_loop(0, nkeep, body, sdet[:, 0])
+    return sdet.at[:, 0].set(ids)
+
+
+@register("_contrib_MultiBoxDetection", alias=("MultiBoxDetection",))
+def _contrib_multibox_detection(attrs, cls_prob, loc_pred, anchor):
+    if int(attrs.get("background_id", 0)) != 0:
+        raise NotImplementedError(
+            "MultiBoxDetection: only background_id=0 is supported (the "
+            "reference CPU/GPU kernels also hardcode class 0 as background)")
+    kw = dict(
+        clip=bool(attrs.get("clip", True)),
+        threshold=float(attrs.get("threshold", 0.01)),
+        nms_threshold=float(attrs.get("nms_threshold", 0.5)),
+        force_suppress=bool(attrs.get("force_suppress", False)),
+        variances=_floats(attrs.get("variances"), (0.1, 0.1, 0.2, 0.2)),
+        nms_topk=int(attrs.get("nms_topk", -1)),
+    )
+    anchors = anchor.reshape(-1, 4)
+    return jax.vmap(
+        lambda cp, lp: _mbox_detection_one(cp, lp, anchors, **kw))(
+            cls_prob, loc_pred)
+
+
+# ---------------------------------------------------------------------------
+# ROIAlign
+# ---------------------------------------------------------------------------
+def _roi_align_one(data, roi, *, pooled_h, pooled_w, spatial_scale,
+                   sample_ratio, position_sensitive):
+    """One ROI of ROIAlignForward (roi_align.cc:150): average of bilinear
+    samples per bin; batch index in roi[0].
+
+    Deviation (documented): sample_ratio <= 0 means an adaptive
+    per-roi grid in the reference (ceil(roi_size/pooled)); XLA needs a
+    static grid, so <=0 falls back to 2 samples per bin axis.
+    """
+    b, c, h, w = data.shape
+    sg = sample_ratio if sample_ratio > 0 else 2
+    feat = jnp.take(data, roi[0].astype(jnp.int32), axis=0,
+                    mode="clip")                       # (C, H, W)
+    start_w = roi[1] * spatial_scale
+    start_h = roi[2] * spatial_scale
+    end_w = roi[3] * spatial_scale
+    end_h = roi[4] * spatial_scale
+    roi_w = jnp.maximum(end_w - start_w, 1.0)
+    roi_h = jnp.maximum(end_h - start_h, 1.0)
+    bin_w = roi_w / pooled_w
+    bin_h = roi_h / pooled_h
+
+    def axis_coords(start, bin_sz, pooled):
+        # sample centres: start + p*bin + (i+.5)*bin/sg
+        p = jnp.arange(pooled, dtype=data.dtype)[:, None]
+        i = jnp.arange(sg, dtype=data.dtype)[None, :]
+        return (start + p * bin_sz + (i + 0.5) * bin_sz / sg).reshape(-1)
+
+    ys = axis_coords(start_h, bin_h, pooled_h)          # (Ph*sg,)
+    xs = axis_coords(start_w, bin_w, pooled_w)          # (Pw*sg,)
+
+    def bilinear(coords, size):
+        # outside [-1, size] contributes zero; clamp<0 to 0 (roi_align.cc
+        # bilinear_interpolate edge handling)
+        inside = (coords >= -1.0) & (coords <= size)
+        cc = jnp.clip(coords, 0.0, size - 1)
+        lo = jnp.floor(cc)
+        hi = jnp.minimum(lo + 1, size - 1)
+        frac = cc - lo
+        return (lo.astype(jnp.int32), hi.astype(jnp.int32), frac,
+                inside.astype(data.dtype))
+
+    y0, y1, fy, my = bilinear(ys, h)
+    x0, x1, fx, mx = bilinear(xs, w)
+
+    def gather(yi, xi):
+        return feat[:, yi[:, None], xi[None, :]]        # (C, Ny, Nx)
+
+    val = ((1 - fy)[None, :, None] * (1 - fx)[None, None, :] * gather(y0, x0)
+           + (1 - fy)[None, :, None] * fx[None, None, :] * gather(y0, x1)
+           + fy[None, :, None] * (1 - fx)[None, None, :] * gather(y1, x0)
+           + fy[None, :, None] * fx[None, None, :] * gather(y1, x1))
+    val = val * my[None, :, None] * mx[None, None, :]
+    val = val.reshape(-1, pooled_h, sg, pooled_w, sg).mean(axis=(2, 4))
+
+    if position_sensitive:
+        c_out = c // (pooled_h * pooled_w)
+        ph = jnp.arange(pooled_h)[:, None]
+        pw = jnp.arange(pooled_w)[None, :]
+        chan = (jnp.arange(c_out)[:, None, None] * pooled_h * pooled_w
+                + ph[None] * pooled_w + pw[None])       # (Co,Ph,Pw)
+        val = jnp.take_along_axis(
+            val[None].repeat(c_out, 0).reshape(c_out, c, pooled_h,
+                                               pooled_w),
+            chan[:, None], axis=1).squeeze(1)
+    return val
+
+
+@register("_contrib_ROIAlign", alias=("ROIAlign",))
+def _contrib_roi_align(attrs, data, rois):
+    pooled = attrs["pooled_size"]
+    ph, pw = int(pooled[0]), int(pooled[1])
+    kw = dict(pooled_h=ph, pooled_w=pw,
+              spatial_scale=float(attrs.get("spatial_scale", 1.0)),
+              sample_ratio=int(attrs.get("sample_ratio", -1)),
+              position_sensitive=bool(attrs.get("position_sensitive",
+                                                False)))
+    return jax.vmap(lambda r: _roi_align_one(data, r, **kw))(rois)
+
+
+# ---------------------------------------------------------------------------
+# ROIPooling (legacy top-level op, src/operator/roi_pooling.cc)
+# ---------------------------------------------------------------------------
+def _roi_pool_one(data, roi, *, pooled_h, pooled_w, spatial_scale):
+    b, c, h, w = data.shape
+    dt = data.dtype
+    feat = jnp.take(data, roi[0].astype(jnp.int32), axis=0, mode="clip")
+    start_w = jnp.round(roi[1] * spatial_scale)
+    start_h = jnp.round(roi[2] * spatial_scale)
+    end_w = jnp.round(roi[3] * spatial_scale)
+    end_h = jnp.round(roi[4] * spatial_scale)
+    roi_h = jnp.maximum(end_h - start_h + 1, 1.0)
+    roi_w = jnp.maximum(end_w - start_w + 1, 1.0)
+
+    def bin_bounds(p, roi_sz, start, pooled, size):
+        lo = jnp.floor(p * roi_sz / pooled) + start
+        hi = jnp.ceil((p + 1) * roi_sz / pooled) + start
+        return (jnp.clip(lo, 0, size), jnp.clip(hi, 0, size))
+
+    prange_h = jnp.arange(pooled_h, dtype=dt)
+    prange_w = jnp.arange(pooled_w, dtype=dt)
+    h0, h1 = bin_bounds(prange_h, roi_h, start_h, pooled_h, h)  # (Ph,)
+    w0, w1 = bin_bounds(prange_w, roi_w, start_w, pooled_w, w)
+    hi = jnp.arange(h, dtype=dt)
+    wi = jnp.arange(w, dtype=dt)
+    mask_h = (hi[None, :] >= h0[:, None]) & (hi[None, :] < h1[:, None])
+    mask_w = (wi[None, :] >= w0[:, None]) & (wi[None, :] < w1[:, None])
+    m = mask_h[:, None, :, None] & mask_w[None, :, None, :]  # (Ph,Pw,H,W)
+    neg = jnp.asarray(-jnp.inf, dt)
+    vals = jnp.where(m[None], feat[:, None, None], neg)      # (C,Ph,Pw,H,W)
+    out = vals.max(axis=(3, 4))
+    empty = ~m.any(axis=(2, 3))
+    return jnp.where(empty[None], jnp.zeros((), dt), out)
+
+
+@register("ROIPooling")
+def _roi_pooling(attrs, data, rois):
+    pooled = attrs["pooled_size"]
+    kw = dict(pooled_h=int(pooled[0]), pooled_w=int(pooled[1]),
+              spatial_scale=float(attrs.get("spatial_scale", 1.0)))
+    return jax.vmap(lambda r: _roi_pool_one(data, r, **kw))(rois)

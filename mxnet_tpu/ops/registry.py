@@ -86,10 +86,14 @@ class Operator:
     """
 
     def __init__(self, name, fcompute, num_outputs=1, is_random=False,
-                 mutate_aux=(), fgradient=None, alias=(), scalar_args=("scalar",)):
+                 mutate_aux=(), fgradient=None, alias=(), scalar_args=("scalar",),
+                 num_visible=None):
         self.name = name
         self.fcompute = fcompute
         self.num_outputs = num_outputs
+        # outputs beyond num_visible are internal (parity: the reference's
+        # FNumVisibleOutputs, e.g. box_nms hides its index record)
+        self.num_visible = num_visible
         self.is_random = is_random
         self.mutate_aux = mutate_aux  # indices of inputs that receive updated state
         self.fgradient = fgradient
@@ -147,13 +151,15 @@ class Operator:
 
 
 def register(name, num_outputs=1, is_random=False, mutate_aux=(),
-             fgradient=None, alias=(), scalar_args=("scalar",)):
+             fgradient=None, alias=(), scalar_args=("scalar",),
+             num_visible=None):
     """Decorator: register fcompute under ``name`` (+ aliases)."""
 
     def deco(fcompute):
         op = Operator(name, fcompute, num_outputs=num_outputs,
                       is_random=is_random, mutate_aux=mutate_aux,
-                      fgradient=fgradient, alias=alias, scalar_args=scalar_args)
+                      fgradient=fgradient, alias=alias, scalar_args=scalar_args,
+                      num_visible=num_visible)
         if name in _OPS:
             raise MXNetError(f"op {name} already registered")
         _OPS[name] = op

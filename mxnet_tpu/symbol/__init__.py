@@ -1,6 +1,7 @@
 """mx.sym — the symbolic namespace (parity: python/mxnet/symbol/)."""
 from .symbol import (Symbol, Group, Variable, var, load, load_json, zeros,
                      ones, arange)
+from . import contrib  # noqa: F401
 from ..ops import registry as _registry
 
 

@@ -1,0 +1,13 @@
+"""mx.sym.contrib — symbolic contrib namespace (parity:
+python/mxnet/symbol/contrib.py codegen over _contrib_* registrations)."""
+
+
+def __getattr__(name):
+    from ..ops import registry as _registry
+    from . import _make_sym_func
+    if _registry.exists(f"_contrib_{name}"):
+        fn = _make_sym_func(_registry.get(f"_contrib_{name}"))
+        globals()[name] = fn  # cache: next access skips __getattr__
+        return fn
+    raise AttributeError(
+        f"module 'mxnet_tpu.symbol.contrib' has no attribute {name!r}")

@@ -68,6 +68,10 @@ class Symbol:
         # aux-mutating ops (BatchNorm moving stats): user-facing outputs only;
         # the executor routes the trailing outputs back into the aux inputs
         n_out -= len(op.mutate_aux)
+        # hidden outputs (FNumVisibleOutputs parity, e.g. box_nms's index
+        # record) are not part of the composable surface
+        if op.num_visible is not None:
+            n_out = min(n_out, op.num_visible)
         if n_out == 1:
             return Symbol([(node, 0)])
         return Symbol([(node, i) for i in range(n_out)])

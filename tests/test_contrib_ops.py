@@ -1,0 +1,332 @@
+"""Detection/contrib op tests (reference: tests/python/unittest/
+test_operator.py box_nms/multibox/ROI cases — forward vs a NumPy oracle,
+backward through the gather/scatter paths)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _np_iou(a, b):
+    tlx = max(a[0], b[0]); tly = max(a[1], b[1])
+    brx = min(a[2], b[2]); bry = min(a[3], b[3])
+    i = max(0.0, brx - tlx) * max(0.0, bry - tly)
+    u = ((a[2] - a[0]) * (a[3] - a[1])
+         + (b[2] - b[0]) * (b[3] - b[1]) - i)
+    return 0.0 if u <= 0 else i / u
+
+
+def test_box_iou_vs_numpy():
+    rng = np.random.RandomState(0)
+    pts = rng.uniform(0, 1, (5, 2, 2))
+    lhs = np.concatenate([pts.min(1), pts.max(1)], axis=1).astype(np.float32)
+    pts = rng.uniform(0, 1, (3, 2, 2))
+    rhs = np.concatenate([pts.min(1), pts.max(1)], axis=1).astype(np.float32)
+    got = nd.contrib.box_iou(nd.array(lhs), nd.array(rhs)).asnumpy()
+    want = np.array([[_np_iou(l, r) for r in rhs] for l in lhs])
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def _np_box_nms(data, overlap_thresh=0.5, valid_thresh=0, topk=-1,
+                coord_start=2, score_index=1, id_index=-1,
+                background_id=-1, force_suppress=False):
+    """NumPy oracle for one batch (N, W): compact kept rows, -1 fill."""
+    n, w = data.shape
+    scores = data[:, score_index]
+    valid = scores > valid_thresh
+    if id_index >= 0:
+        valid &= data[:, id_index] != background_id
+    order = sorted(range(n), key=lambda i: (-scores[i], i))
+    order = [i for i in order if valid[i]]
+    k = len(order) if topk < 0 else min(topk, len(order))
+    order = order[:k]
+    kept = []
+    for i in order:
+        ok = True
+        for j in kept:
+            if (force_suppress or id_index < 0
+                    or data[i, id_index] == data[j, id_index]):
+                if _np_iou(data[i, coord_start:coord_start + 4],
+                           data[j, coord_start:coord_start + 4]) \
+                        > overlap_thresh:
+                    ok = False
+                    break
+        if ok:
+            kept.append(i)
+    out = np.full((n, w), -1.0, np.float32)
+    for slot, i in enumerate(kept):
+        out[slot] = data[i]
+    return out
+
+
+@pytest.mark.parametrize("force", [False, True])
+def test_box_nms_vs_numpy(force):
+    rng = np.random.RandomState(42)
+    n = 32
+    pts = rng.uniform(0, 1, (n, 2, 2)).astype(np.float32)
+    boxes = np.concatenate([pts.min(1), pts.max(1)], axis=1)
+    cls = rng.randint(0, 3, (n, 1)).astype(np.float32)
+    score = rng.uniform(0, 1, (n, 1)).astype(np.float32)
+    data = np.concatenate([cls, score, boxes], axis=1)[None]  # (1,N,6)
+    got = nd.contrib.box_nms(
+        nd.array(data), overlap_thresh=0.5, valid_thresh=0.1,
+        id_index=0, force_suppress=force).asnumpy()
+    want = _np_box_nms(data[0], overlap_thresh=0.5, valid_thresh=0.1,
+                       id_index=0, force_suppress=force)[None]
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_box_nms_topk_and_batch():
+    rng = np.random.RandomState(3)
+    data = rng.uniform(0, 1, (2, 3, 10, 6)).astype(np.float32)
+    got = nd.contrib.box_nms(nd.array(data), overlap_thresh=0.7,
+                             valid_thresh=0.3, topk=4).asnumpy()
+    for b in range(2):
+        for c in range(3):
+            want = _np_box_nms(data[b, c], overlap_thresh=0.7,
+                               valid_thresh=0.3, topk=4)
+            np.testing.assert_allclose(got[b, c], want, rtol=1e-5,
+                                       atol=1e-6)
+
+
+def test_box_nms_backward_scatters_to_kept():
+    data = np.array([[[0.9, 0, 0, 1, 1],
+                      [0.8, 0, 0, .9, .9],
+                      [0.7, 2, 2, 3, 3]]], np.float32)
+    x = nd.array(data)
+    x.attach_grad()
+    with mx.autograd.record():
+        out = nd.contrib.box_nms(x, overlap_thresh=0.5, coord_start=1,
+                                 score_index=0)
+        loss = (out * out).sum()
+    loss.backward()
+    g = x.grad.asnumpy()
+    # kept rows (0 and 2) receive 2*x, the suppressed row receives 0
+    np.testing.assert_allclose(g[0, 0], 2 * data[0, 0], rtol=1e-5)
+    np.testing.assert_allclose(g[0, 2], 2 * data[0, 2], rtol=1e-5)
+    np.testing.assert_allclose(g[0, 1], np.zeros(5), atol=1e-6)
+
+
+def test_multibox_prior_matches_reference_layout():
+    h, w = 3, 4
+    sizes, ratios = (0.4, 0.8), (1.0, 2.0, 0.5)
+    x = nd.zeros((1, 2, h, w))
+    got = nd.contrib.MultiBoxPrior(
+        x, sizes=sizes, ratios=ratios).asnumpy()[0]
+    # oracle: direct port of the loop in multibox_prior.cc:43-73
+    want = []
+    for r in range(h):
+        cy = (r + 0.5) / h
+        for c in range(w):
+            cx = (c + 0.5) / w
+            rat = np.sqrt(ratios[0])
+            for s in sizes:
+                bw = s * h / w * rat / 2
+                bh = s / rat / 2
+                want.append([cx - bw, cy - bh, cx + bw, cy + bh])
+            for rr in ratios[1:]:
+                rat2 = np.sqrt(rr)
+                bw = sizes[0] * h / w * rat2 / 2
+                bh = sizes[0] / rat2 / 2
+                want.append([cx - bw, cy - bh, cx + bw, cy + bh])
+    np.testing.assert_allclose(got, np.array(want), rtol=1e-5, atol=1e-6)
+    assert got.shape == (h * w * (len(sizes) + len(ratios) - 1), 4)
+
+
+def test_multibox_target_basic_matching():
+    # 4 hand-placed anchors, 1 gt that clearly overlaps anchor 0
+    anchors = np.array([[0.0, 0.0, 0.5, 0.5],
+                        [0.5, 0.5, 1.0, 1.0],
+                        [0.0, 0.5, 0.5, 1.0],
+                        [0.4, 0.0, 0.9, 0.5]], np.float32)[None]
+    label = np.array([[[2, 0.05, 0.05, 0.45, 0.45],
+                       [-1, -1, -1, -1, -1]]], np.float32)
+    cls_pred = np.zeros((1, 4, 4), np.float32)
+    lt, lm, ct = nd.contrib.MultiBoxTarget(
+        nd.array(anchors), nd.array(label), nd.array(cls_pred))
+    ct = ct.asnumpy()[0]
+    # anchor 0 positive with class 2 -> target 3 (0 = background)
+    assert ct[0] == 3.0
+    # everything else negative (no mining)
+    np.testing.assert_allclose(ct[1:], 0.0)
+    lm = lm.asnumpy()[0].reshape(4, 4)
+    np.testing.assert_allclose(lm[0], 1.0)
+    np.testing.assert_allclose(lm[1:], 0.0)
+    # loc target encodes (gt - anchor) / variance
+    ltv = lt.asnumpy()[0].reshape(4, 4)
+    aw = ah = 0.5
+    gx, gy, gw, gh = 0.25, 0.25, 0.4, 0.4
+    want = [(gx - 0.25) / aw / 0.1, (gy - 0.25) / ah / 0.1,
+            np.log(gw / aw) / 0.2, np.log(gh / ah) / 0.2]
+    np.testing.assert_allclose(ltv[0], want, rtol=1e-4, atol=1e-5)
+
+
+def test_multibox_target_negative_mining():
+    rng = np.random.RandomState(0)
+    a = 16
+    cy = cx = (np.arange(4) + 0.5) / 4
+    grid = np.stack(np.meshgrid(cx, cy), -1).reshape(-1, 2)
+    anchors = np.concatenate([grid - 0.12, grid + 0.12],
+                             axis=1).astype(np.float32)[None]
+    label = np.array([[[0, 0.05, 0.05, 0.3, 0.3]]], np.float32)
+    cls_pred = rng.randn(1, 3, a).astype(np.float32)
+    lt, lm, ct = nd.contrib.MultiBoxTarget(
+        nd.array(anchors), nd.array(label), nd.array(cls_pred),
+        negative_mining_ratio=2.0, negative_mining_thresh=0.5)
+    ct = ct.asnumpy()[0]
+    n_pos = int((ct > 0).sum())
+    n_neg = int((ct == 0).sum())
+    n_ign = int((ct == -1).sum())
+    assert n_pos >= 1
+    assert n_neg == min(2 * n_pos, a - n_pos)
+    assert n_pos + n_neg + n_ign == a
+
+
+def test_multibox_target_no_gt():
+    anchors = np.array([[[0, 0, .5, .5], [.5, .5, 1, 1]]], np.float32)
+    label = -np.ones((1, 2, 5), np.float32)
+    cls_pred = np.zeros((1, 3, 2), np.float32)
+    lt, lm, ct = nd.contrib.MultiBoxTarget(
+        nd.array(anchors), nd.array(label), nd.array(cls_pred))
+    np.testing.assert_allclose(ct.asnumpy(), -1.0)
+    np.testing.assert_allclose(lt.asnumpy(), 0.0)
+    np.testing.assert_allclose(lm.asnumpy(), 0.0)
+
+
+def test_multibox_detection_decode_and_nms():
+    # 3 anchors; anchor 0/1 same spot (class 1 wins both), anchor 2 far
+    anchors = np.array([[[0.1, 0.1, 0.4, 0.4],
+                         [0.12, 0.12, 0.42, 0.42],
+                         [0.6, 0.6, 0.9, 0.9]]], np.float32)
+    cls_prob = np.array([[[0.1, 0.2, 0.05],
+                          [0.8, 0.7, 0.05],
+                          [0.1, 0.1, 0.9]]], np.float32)  # (1, C=3, A=3)
+    loc_pred = np.zeros((1, 12), np.float32)
+    out = nd.contrib.MultiBoxDetection(
+        nd.array(cls_prob), nd.array(loc_pred), nd.array(anchors),
+        nms_threshold=0.5).asnumpy()[0]
+    # rows sorted by score desc: .9 (anchor2, class 1), .8 (anchor0,
+    # class 0), .7 (anchor1, class 0 — suppressed by anchor0, id -> -1);
+    # decode with zero loc_pred reproduces the anchor box exactly
+    np.testing.assert_allclose(out[0], [1, 0.9, 0.6, 0.6, 0.9, 0.9],
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(out[1], [0, 0.8, 0.1, 0.1, 0.4, 0.4],
+                               rtol=1e-5, atol=1e-6)
+    assert out[2, 0] == -1.0
+    assert out[2, 1] == pytest.approx(0.7)
+
+
+def test_multibox_detection_threshold():
+    anchors = np.array([[[0.1, 0.1, 0.4, 0.4]]], np.float32)
+    cls_prob = np.array([[[0.99], [0.005], [0.005]]], np.float32)
+    loc_pred = np.zeros((1, 4), np.float32)
+    out = nd.contrib.MultiBoxDetection(
+        nd.array(cls_prob), nd.array(loc_pred), nd.array(anchors),
+        threshold=0.01).asnumpy()[0]
+    assert out[0, 0] == -1.0  # best fg score below threshold -> invalid
+
+
+def _np_roi_align(feat, roi, ph, pw, scale, sg):
+    c, h, w = feat.shape
+    sw, sh, ew, eh = roi[1] * scale, roi[2] * scale, roi[3] * scale, \
+        roi[4] * scale
+    rw = max(ew - sw, 1.0); rh = max(eh - sh, 1.0)
+    bw, bh = rw / pw, rh / ph
+    out = np.zeros((c, ph, pw), np.float32)
+    for py in range(ph):
+        for px in range(pw):
+            acc = np.zeros(c, np.float32)
+            for iy in range(sg):
+                y = sh + py * bh + (iy + 0.5) * bh / sg
+                for ix in range(sg):
+                    x = sw + px * bw + (ix + 0.5) * bw / sg
+                    if y < -1.0 or y > h or x < -1.0 or x > w:
+                        continue
+                    yy, xx = max(y, 0.0), max(x, 0.0)
+                    y0, x0 = int(min(np.floor(yy), h - 1)), \
+                        int(min(np.floor(xx), w - 1))
+                    y1, x1 = min(y0 + 1, h - 1), min(x0 + 1, w - 1)
+                    fy, fx = yy - y0, xx - x0
+                    acc += ((1 - fy) * (1 - fx) * feat[:, y0, x0]
+                            + (1 - fy) * fx * feat[:, y0, x1]
+                            + fy * (1 - fx) * feat[:, y1, x0]
+                            + fy * fx * feat[:, y1, x1])
+            out[:, py, px] = acc / (sg * sg)
+    return out
+
+
+def test_roi_align_vs_numpy():
+    rng = np.random.RandomState(1)
+    feat = rng.randn(2, 3, 8, 8).astype(np.float32)
+    rois = np.array([[0, 1.0, 1.0, 6.0, 6.0],
+                     [1, 0.0, 2.0, 7.5, 7.5],
+                     [0, 3.0, 3.0, 4.0, 4.0]], np.float32)
+    got = nd.contrib.ROIAlign(nd.array(feat), nd.array(rois),
+                              pooled_size=(3, 3), spatial_scale=0.5,
+                              sample_ratio=2).asnumpy()
+    for i, roi in enumerate(rois):
+        want = _np_roi_align(feat[int(roi[0])], roi, 3, 3, 0.5, 2)
+        np.testing.assert_allclose(got[i], want, rtol=1e-4, atol=1e-5)
+
+
+def test_roi_align_backward_numeric():
+    rng = np.random.RandomState(2)
+    feat = rng.randn(1, 2, 6, 6).astype(np.float32)
+    rois = np.array([[0, 0.0, 0.0, 5.0, 5.0]], np.float32)
+    x = nd.array(feat)
+    x.attach_grad()
+    cot = rng.randn(1, 2, 2, 2).astype(np.float32)
+    with mx.autograd.record():
+        out = nd.contrib.ROIAlign(x, nd.array(rois), pooled_size=(2, 2),
+                                  spatial_scale=1.0, sample_ratio=2)
+        loss = (out * nd.array(cot)).sum()
+    loss.backward()
+    g = x.grad.asnumpy()
+    # numeric gradient on a few random entries
+    eps = 1e-2
+    for _ in range(5):
+        ci, yi, xi = (rng.randint(2), rng.randint(6), rng.randint(6))
+        fp = feat.copy(); fp[0, ci, yi, xi] += eps
+        fm = feat.copy(); fm[0, ci, yi, xi] -= eps
+        op = nd.contrib.ROIAlign(nd.array(fp), nd.array(rois),
+                                 pooled_size=(2, 2), spatial_scale=1.0,
+                                 sample_ratio=2).asnumpy()
+        om = nd.contrib.ROIAlign(nd.array(fm), nd.array(rois),
+                                 pooled_size=(2, 2), spatial_scale=1.0,
+                                 sample_ratio=2).asnumpy()
+        num = ((op - om) / (2 * eps) * cot).sum()
+        np.testing.assert_allclose(g[0, ci, yi, xi], num, rtol=1e-2,
+                                   atol=1e-3)
+
+
+def test_roi_pooling_max_semantics():
+    feat = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    rois = np.array([[0, 0, 0, 3, 3]], np.float32)
+    out = nd.ROIPooling(nd.array(feat), nd.array(rois),
+                        pooled_size=(2, 2), spatial_scale=1.0).asnumpy()
+    np.testing.assert_allclose(out[0, 0], [[5, 7], [13, 15]])
+
+
+def test_bipartite_matching_greedy():
+    score = np.array([[[0.5, 0.6], [0.9, 0.2], [0.3, 0.1]]], np.float32)
+    rm, cm = nd.contrib.bipartite_matching(nd.array(score), threshold=0.1)
+    np.testing.assert_allclose(rm.asnumpy(), [[1, 0, -1]])
+    np.testing.assert_allclose(cm.asnumpy(), [[1, 0]])
+    # threshold excludes weak pairs
+    rm, cm = nd.contrib.bipartite_matching(nd.array(score), threshold=0.7)
+    np.testing.assert_allclose(rm.asnumpy(), [[-1, 0, -1]])
+    np.testing.assert_allclose(cm.asnumpy(), [[1, -1]])
+
+
+def test_box_nms_symbolic():
+    import mxnet_tpu.symbol as sym
+    data = sym.Variable("data")
+    out = sym.contrib.box_nms(data, overlap_thresh=0.5, coord_start=1,
+                              score_index=0)
+    arr = np.array([[[0.9, 0, 0, 1, 1],
+                     [0.8, 0, 0, .9, .9]]], np.float32)
+    ex = out.bind(mx.cpu(), {"data": nd.array(arr)})
+    res = ex.forward()[0].asnumpy()
+    assert res[0, 0, 0] == pytest.approx(0.9)
+    assert res[0, 1, 0] == -1.0

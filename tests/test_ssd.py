@@ -1,0 +1,109 @@
+"""SSD-VGG16 end-to-end (BASELINE.json configs[3]; reference example/ssd):
+forward + target assignment + backward on synthetic data, then decode/NMS
+inference. Uses the small-input variant so the suite stays fast; topology
+(VGG16 conv base, multi-scale heads) matches ssd_300_vgg16."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.gluon import Trainer
+from mxnet_tpu.gluon.model_zoo.vision import SSDTrainLoss, ssd_vgg16_test
+
+
+def _synthetic_batch(rng, b=2, hw=64, n_obj=2):
+    x = rng.uniform(-1, 1, (b, 3, hw, hw)).astype(np.float32)
+    labels = -np.ones((b, 4, 5), np.float32)
+    for i in range(b):
+        for j in range(n_obj):
+            cx, cy = rng.uniform(0.25, 0.75, 2)
+            w, h = rng.uniform(0.2, 0.4, 2)
+            labels[i, j] = [rng.randint(0, 3), cx - w / 2, cy - h / 2,
+                            cx + w / 2, cy + h / 2]
+    return nd.array(x), nd.array(labels)
+
+
+def test_ssd_forward_shapes():
+    net = ssd_vgg16_test(classes=3)
+    net.initialize()
+    x = nd.zeros((2, 3, 64, 64))
+    anchors, cls_preds, loc_preds = net(x)
+    a = anchors.shape[1]
+    assert anchors.shape == (1, a, 4)
+    assert cls_preds.shape == (2, 4, a)      # 3 classes + background
+    assert loc_preds.shape == (2, a * 4)
+    # scales: 8x8, 4x4, 2x2, 1x1 maps, 4 anchors each
+    assert a == (64 + 16 + 4 + 1) * 4
+
+
+def test_ssd_train_step_decreases_loss():
+    rng = np.random.RandomState(0)
+    net = ssd_vgg16_test(classes=3)
+    net.initialize(mx.initializer.Xavier())
+    head = SSDTrainLoss()
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 0.05, "momentum": 0.9})
+    x, labels = _synthetic_batch(rng)
+    losses = []
+    for _ in range(5):
+        with mx.autograd.record():
+            anchors, cls_preds, loc_preds = net(x)
+            loss = head(anchors, cls_preds, loc_preds, labels)
+        loss.backward()
+        trainer.step(x.shape[0])
+        losses.append(float(loss.asscalar()))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+def test_ssd_gradients_reach_base():
+    rng = np.random.RandomState(1)
+    net = ssd_vgg16_test(classes=3)
+    net.initialize(mx.initializer.Xavier())
+    head = SSDTrainLoss()
+    x, labels = _synthetic_batch(rng, b=1)
+    with mx.autograd.record():
+        anchors, cls_preds, loc_preds = net(x)
+        loss = head(anchors, cls_preds, loc_preds, labels)
+    loss.backward()
+    # the first conv of the VGG base must receive nonzero gradient
+    params = net.collect_params()
+    first_conv = min((k for k in params if "conv" in k and "weight" in k),
+                     key=lambda k: k)
+    g = params[first_conv].grad().asnumpy()
+    assert np.abs(g).max() > 0
+
+
+def test_ssd_inference_detection():
+    rng = np.random.RandomState(2)
+    net = ssd_vgg16_test(classes=3)
+    net.initialize(mx.initializer.Xavier())
+    x, _ = _synthetic_batch(rng, b=1)
+    anchors, cls_preds, loc_preds = net(x)
+    probs = nd.softmax(cls_preds, axis=1)
+    det = nd.contrib.MultiBoxDetection(probs, loc_preds, anchors,
+                                       nms_threshold=0.5, threshold=0.0,
+                                       nms_topk=10)
+    d = det.asnumpy()
+    assert d.shape == (1, anchors.shape[1], 6)
+    ids = d[0, :, 0]
+    # at least one detection survives and scores are within [0, 1]
+    kept = d[0][ids >= 0]
+    assert kept.shape[0] >= 1
+    assert ((kept[:, 1] >= 0) & (kept[:, 1] <= 1)).all()
+
+
+def test_ssd_hybridize_matches_imperative():
+    rng = np.random.RandomState(3)
+    net = ssd_vgg16_test(classes=3)
+    net.initialize(mx.initializer.Xavier())
+    x, _ = _synthetic_batch(rng, b=1, hw=32)
+    a1, c1, l1 = net(x)
+    net.hybridize()
+    a2, c2, l2 = net(x)
+    np.testing.assert_allclose(a1.asnumpy(), a2.asnumpy(), rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_allclose(c1.asnumpy(), c2.asnumpy(), rtol=1e-4,
+                               atol=1e-4)
+    np.testing.assert_allclose(l1.asnumpy(), l2.asnumpy(), rtol=1e-4,
+                               atol=1e-4)
