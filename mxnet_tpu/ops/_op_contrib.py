@@ -587,3 +587,11 @@ def _roi_pooling(attrs, data, rois):
     kw = dict(pooled_h=int(pooled[0]), pooled_w=int(pooled[1]),
               spatial_scale=float(attrs.get("spatial_scale", 1.0)))
     return jax.vmap(lambda r: _roi_pool_one(data, r, **kw))(rois)
+
+
+# ---------------------------------------------------------------------------
+# transformer helpers (src/operator/contrib/transformer.cc)
+# ---------------------------------------------------------------------------
+@register("_contrib_div_sqrt_dim", alias=("div_sqrt_dim",))
+def _contrib_div_sqrt_dim(attrs, data):
+    return data / jnp.sqrt(jnp.asarray(data.shape[-1], data.dtype))

@@ -1,0 +1,226 @@
+"""Blockwise (flash) attention — the framework's first Pallas TPU kernel.
+
+Reference capability anchor: src/operator/contrib/transformer-inl.h ships
+interleaved-matmul self-attention ops that materialise the (S, S) score
+matrix in HBM; SURVEY.md §7 step 8 calls for the TPU-native replacement.
+This kernel computes softmax(q·kᵀ)·v with the online-softmax recurrence:
+scores never leave VMEM, HBM traffic is O(S·D) instead of O(S²), and the
+MXU sees (BLOCK_Q × D) @ (D × BLOCK_K) tiles.
+
+Design (canonical TPU flash pattern):
+  grid = (batch·heads, S/BLOCK_Q, S/BLOCK_K); the innermost grid axis is
+  sequential on TPU, so f32 scratch (acc, running max m, running sum l)
+  persists across the K sweep — initialised at k==0, finalised (acc/l)
+  at the last k block.  Causal masking compares global q/k indices from
+  broadcasted_iota; fully-masked k blocks are skipped with @pl.when.
+
+Backward: custom_vjp that recomputes attention row-blocks in plain XLA
+(rematerialisation trades FLOPs for HBM, same recipe as jax.checkpoint);
+a dedicated Pallas backward kernel is a later optimisation.
+
+On non-TPU backends the same kernel runs under the Pallas interpreter so
+unit tests exercise the identical code path.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu imports fail on CPU-only builds of jaxlib
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PLTPU = True
+except Exception:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+from .registry import register
+
+_NEG_INF = -1e30
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, m_out_ref, l_out_ref,
+                 acc_ref, m_ref, l_ref, *,
+                 block_q, block_k, s_actual, sm_scale, causal):
+    """One (q-block, k-block) grid step of online-softmax attention."""
+    kb = pl.program_id(2)
+    n_kb = pl.num_programs(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q_start = pl.program_id(1) * block_q
+    k_start = kb * block_k
+
+    # causal: a k block strictly above the diagonal contributes nothing
+    run = True
+    if causal:
+        run = k_start <= q_start + block_q - 1
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)            # (BQ, D)
+        k = k_ref[0].astype(jnp.float32)            # (BK, D)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # (BQ, BK)
+
+        q_ids = q_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_ids = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = k_ids < s_actual                      # padded keys
+        if causal:
+            mask &= k_ids <= q_ids
+        s = jnp.where(mask, s, _NEG_INF)
+
+        m_prev = m_ref[:, :1]                        # (BQ, 1)
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                       # (BQ, BK)
+        correction = jnp.exp(m_prev - m_new)         # (BQ, 1)
+        l_new = l_ref[:, :1] * correction + jnp.sum(p, axis=1,
+                                                    keepdims=True)
+        acc_ref[:] = acc_ref[:] * correction + jax.lax.dot_general(
+            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(kb == n_kb - 1)
+    def _finalize():
+        # padded q rows have l == 0; emit 0 there rather than NaN
+        l = l_ref[:, :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+        m_out_ref[0] = m_ref[:]
+        l_out_ref[0] = l_ref[:]
+
+
+def _round_up(x, m):
+    return (x + m - 1) // m * m
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "sm_scale",
+                                             "block_q", "block_k",
+                                             "interpret"))
+def _flash_fwd(q, k, v, *, causal, sm_scale, block_q, block_k, interpret):
+    import math
+    b, h, s, d = q.shape
+    bq = min(block_q, _round_up(s, 128))
+    bk = min(block_k, _round_up(s, 128))
+    # pad to a common multiple of BOTH block sizes — a floor-divided grid
+    # would silently drop tail key blocks
+    s_pad = _round_up(s, math.lcm(bq, bk))
+    if s_pad != s:
+        pad = [(0, 0), (0, 0), (0, s_pad - s), (0, 0)]
+        q = jnp.pad(q, pad)
+        k = jnp.pad(k, pad)
+        v = jnp.pad(v, pad)
+    bh = b * h
+    qf = q.reshape(bh, s_pad, d)
+    kf = k.reshape(bh, s_pad, d)
+    vf = v.reshape(bh, s_pad, d)
+
+    kernel = functools.partial(
+        _attn_kernel, block_q=bq, block_k=bk, s_actual=s,
+        sm_scale=sm_scale, causal=causal)
+    grid = (bh, s_pad // bq, s_pad // bk)
+    scratch_shapes = [
+        pltpu.VMEM((bq, d), jnp.float32),       # acc
+        pltpu.VMEM((bq, 128), jnp.float32),     # running max (lane-bcast)
+        pltpu.VMEM((bq, 128), jnp.float32),     # running sum (lane-bcast)
+    ]
+
+    q_spec = pl.BlockSpec((1, bq, d), lambda bh_, qi, ki: (bh_, qi, 0))
+    stat_spec = pl.BlockSpec((1, bq, 128), lambda bh_, qi, ki: (bh_, qi, 0))
+    out, m_out, l_out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            q_spec,
+            pl.BlockSpec((1, bk, d), lambda bh_, qi, ki: (bh_, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh_, qi, ki: (bh_, ki, 0)),
+        ],
+        out_specs=(q_spec, stat_spec, stat_spec),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, s_pad, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, s_pad, 128), jnp.float32),
+            jax.ShapeDtypeStruct((bh, s_pad, 128), jnp.float32),
+        ),
+        scratch_shapes=scratch_shapes,
+        interpret=interpret,
+    )(qf, kf, vf)
+    out = out.reshape(b, h, s_pad, d)[:, :, :s, :]
+    m_out = m_out[:, :, 0].reshape(b, h, s_pad)[:, :, :s]
+    l_out = l_out[:, :, 0].reshape(b, h, s_pad)[:, :, :s]
+    return out, m_out, l_out
+
+
+def _reference_attention(q, k, v, causal, sm_scale):
+    """Plain XLA attention (used by the recompute backward)."""
+    s = q.shape[2]
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * sm_scale
+    if causal:
+        qi = jnp.arange(s)[:, None]
+        ki = jnp.arange(s)[None, :]
+        logits = jnp.where(ki <= qi, logits, _NEG_INF)
+    p = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+def _use_interpret():
+    return jax.default_backend() != "tpu"
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal=False, sm_scale=None, block_q=128,
+                    block_k=128):
+    """softmax(q kᵀ / √d) v with O(S·D) memory.
+
+    q, k, v: (batch, heads, seq, head_dim).  sm_scale defaults to
+    1/sqrt(head_dim).
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    out, _, _ = _flash_fwd(q, k, v, causal=causal, sm_scale=float(sm_scale),
+                           block_q=block_q, block_k=block_k,
+                           interpret=_use_interpret())
+    return out
+
+
+def _flash_fwd_rule(q, k, v, causal, sm_scale, block_q, block_k):
+    out = flash_attention(q, k, v, causal, sm_scale, block_q, block_k)
+    return out, (q, k, v)
+
+
+def _flash_bwd_rule(causal, sm_scale, block_q, block_k, res, g):
+    q, k, v = res
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+
+    def ref(q_, k_, v_):
+        return _reference_attention(q_, k_, v_, causal, float(sm_scale))
+
+    _, vjp = jax.vjp(ref, q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+@register("_contrib_flash_attention", alias=("flash_attention",))
+def _contrib_flash_attention(attrs, q, k, v):
+    causal = bool(attrs.get("causal", False))
+    sm_scale = attrs.get("sm_scale")
+    sm_scale = float(sm_scale) if sm_scale is not None else None
+    return flash_attention(q, k, v, causal, sm_scale,
+                           int(attrs.get("block_q", 128)),
+                           int(attrs.get("block_k", 128)))

@@ -11,3 +11,7 @@ TP/SP/ring attention absent there) are first-class here.
 from .mesh import DeviceMesh, make_mesh, current_mesh
 from .spmd import (TrainStep, functionalize, shard_batch, replicate,
                    data_parallel_shardings)
+from .tp import (column_parallel_dense, row_parallel_dense,
+                 init_transformer_params, shard_transformer_params,
+                 transformer_block_ref, transformer_block_tp)
+from .ring import ring_attention_local, ring_self_attention
