@@ -14,69 +14,63 @@ from ..block import Block, HybridBlock
 from ..parameter import Parameter
 
 
-def _cells_state_info(cells, batch_size):
-    return sum([c.state_info(batch_size) for c in cells], [])
+# ---------------------------------------------------------------------------
+# Sequence canonicalisation — TPU-idiomatic: a sequence travels as ONE merged
+# time-major (T, N, ...) tensor, the layout lax.scan and the sequence ops
+# (SequenceMask/Last/Reverse, all time-axis-0) consume directly.  Per-step
+# lists exist only at the python cell-stepping boundary and at the public
+# API edge (merge_outputs=False).
+# ---------------------------------------------------------------------------
+
+def _tn_perm(layout, ndim):
+    """Axis permutation taking a ``layout`` tensor to (T, N, rest...)."""
+    t_ax, n_ax = layout.find("T"), layout.find("N")
+    if t_ax < 0 or n_ax < 0:
+        raise MXNetError(f"layout {layout!r} must contain 'T' and 'N'")
+    rest = [i for i in range(ndim) if i not in (t_ax, n_ax)]
+    return [t_ax, n_ax] + rest
 
 
-def _cells_begin_state(cells, **kwargs):
-    return sum([c.begin_state(**kwargs) for c in cells], [])
+def _to_time_major(inputs, layout, length=None):
+    """Canonicalise ``inputs`` — a merged tensor in ``layout`` or a
+    per-step list of (N, ...) arrays — to one (T, N, ...) tensor.
 
-
-def _get_begin_state(cell, F, begin_state, inputs, batch_size):
-    if begin_state is None:
-        ctx = inputs.ctx if hasattr(inputs, "ctx") else None
-        with ctx if hasattr(ctx, "__enter__") else _nullcontext():
-            begin_state = cell.begin_state(batch_size=batch_size,
-                                           func=nd.zeros)
-    return begin_state
-
-
-class _nullcontext:
-    def __enter__(self):
-        return None
-
-    def __exit__(self, *a):
-        return False
-
-
-def _format_sequence(length, inputs, layout, merge, in_layout=None):
-    assert inputs is not None
-    axis = layout.find("T")
-    batch_axis = layout.find("N")
-    batch_size = 0
-    in_axis = in_layout.find("T") if in_layout is not None else axis
+    Returns (seq, batch_size)."""
     from ...ndarray import NDArray
     if isinstance(inputs, NDArray):
-        batch_size = inputs.shape[batch_axis]
-        if merge is False:
-            assert length is None or length == inputs.shape[in_axis]
-            inputs = [inputs.slice_axis(in_axis, i, i + 1).squeeze(in_axis)
-                      for i in range(inputs.shape[in_axis])]
+        t_ax = layout.find("T")
+        if length is not None and inputs.shape[t_ax] != length:
+            raise MXNetError(
+                f"sequence length {inputs.shape[t_ax]} != unroll "
+                f"length {length}")
+        perm = _tn_perm(layout, len(inputs.shape))
+        seq = nd.transpose(inputs, axes=perm) if perm != list(
+            range(len(inputs.shape))) else inputs
     else:
-        assert length is None or len(inputs) == length
-        batch_size = inputs[0].shape[batch_axis]
-        if merge is True:
-            inputs = nd.stack(*inputs, axis=axis)
-            in_axis = axis
-    if isinstance(inputs, NDArray) and axis != in_axis:
-        inputs = inputs.swapaxes(axis, in_axis)
-    return inputs, axis, batch_size
+        if length is not None and len(inputs) != length:
+            raise MXNetError(
+                f"got {len(inputs)} step inputs, expected {length}")
+        seq = nd.stack(*inputs, axis=0)
+    return seq, seq.shape[1]
 
 
-def _mask_sequence_variable_length(F, data, length, valid_length, time_axis,
-                                   merge):
-    assert valid_length is not None
-    if not isinstance(data, list):
-        outputs = nd.SequenceMask(data, valid_length,
-                                  use_sequence_length=True, axis=time_axis)
-    else:
-        outputs = nd.SequenceMask(nd.stack(*data, axis=time_axis),
-                                  valid_length, use_sequence_length=True,
-                                  axis=time_axis)
-        if not merge:
-            outputs = [outputs.slice_axis(time_axis, i, i + 1)
-                       .squeeze(time_axis) for i in range(len(data))]
-    return outputs
+def _batch_size_of(inputs, layout):
+    """Batch size without materialising the merged tensor."""
+    from ...ndarray import NDArray
+    if isinstance(inputs, NDArray):
+        return inputs.shape[layout.find("N")]
+    return inputs[0].shape[0]
+
+
+def _emit_sequence(seq, layout, merge):
+    """Present a time-major (T, N, ...) tensor in the caller-requested
+    form: merged tensor in ``layout`` (merge truthy) or per-step list."""
+    if merge:
+        perm = _tn_perm(layout, len(seq.shape))
+        inv = [perm.index(i) for i in range(len(perm))]
+        return nd.transpose(seq, axes=inv) if inv != list(
+            range(len(seq.shape))) else seq
+    return [seq[i] for i in range(seq.shape[0])]
 
 
 class RecurrentCell(Block):
@@ -102,6 +96,15 @@ class RecurrentCell(Block):
     def _gate_names(self):
         return ()
 
+    def _ensure_begin_state(self, begin_state, batch_size, ctx=None):
+        """begin_state, or fresh zeros states sized for batch_size (on
+        ``ctx`` — the input's device — when given)."""
+        if begin_state is not None:
+            return begin_state
+        kwargs = {"ctx": ctx} if ctx is not None else {}
+        return self.begin_state(batch_size=batch_size, func=nd.zeros,
+                                **kwargs)
+
     def begin_state(self, batch_size=0, func=None, **kwargs):
         """Initial states for this cell (parity: rnn_cell.py begin_state)."""
         assert not self._modified, \
@@ -123,38 +126,36 @@ class RecurrentCell(Block):
 
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None, valid_length=None):
-        """Unroll the cell for `length` steps (parity: rnn_cell.py unroll)."""
+        """Unroll the cell for `length` steps (parity: rnn_cell.py unroll).
+
+        The sequence is held as one time-major tensor end to end; the
+        python step loop traces away under hybridize/jit (the fused
+        rnn_layer path lowers the same recurrence to one lax.scan)."""
         self.reset()
-        F = nd
-        inputs, axis, batch_size = _format_sequence(length, inputs, layout,
-                                                    False)
-        begin_state = _get_begin_state(self, F, begin_state, inputs,
-                                       batch_size)
-        states = begin_state
-        outputs = []
-        all_states = []
-        for i in range(length):
-            output, states = self(inputs[i], states)
-            outputs.append(output)
+        seq, batch_size = _to_time_major(inputs, layout, length)
+        if length is None:
+            length = seq.shape[0]
+        states = self._ensure_begin_state(begin_state, batch_size, seq.ctx)
+        step_outs = []
+        step_states = []
+        for t in range(length):
+            out, states = self(seq[t], states)
+            step_outs.append(out)
             if valid_length is not None:
-                all_states.append(states)
+                step_states.append(states)
+        out_seq = nd.stack(*step_outs, axis=0)            # (T, N, C)
         if valid_length is not None:
-            states = []
-            import jax.numpy as jnp
-            for layer_id in range(len(begin_state)):
-                stacked = nd.stack(*[ele[layer_id] for ele in all_states],
-                                   axis=0)
-                states.append(nd.SequenceLast(stacked, valid_length,
-                                              use_sequence_length=True,
-                                              axis=0))
-            outputs = _mask_sequence_variable_length(F, outputs, length,
-                                                     valid_length, axis, True)
+            # final state = state at each row's true last step; outputs
+            # beyond valid_length are zeroed
+            states = [
+                nd.SequenceLast(
+                    nd.stack(*[s[i] for s in step_states], axis=0),
+                    valid_length, use_sequence_length=True, axis=0)
+                for i in range(len(states))]
+            out_seq = nd.SequenceMask(out_seq, valid_length,
+                                      use_sequence_length=True, axis=0)
             merge_outputs = True
-        if merge_outputs is None:
-            merge_outputs = False
-        if merge_outputs and isinstance(outputs, list):
-            outputs = nd.stack(*outputs, axis=axis)
-        return outputs, states
+        return _emit_sequence(out_seq, layout, bool(merge_outputs)), states
 
     def forward(self, inputs, states):
         self._counter += 1
@@ -378,11 +379,13 @@ class SequentialRNNCell(RecurrentCell):
         self.register_child(cell)
 
     def state_info(self, batch_size=0):
-        return _cells_state_info(self._children.values(), batch_size)
+        return [info for c in self._children.values()
+                for info in c.state_info(batch_size)]
 
     def begin_state(self, **kwargs):
         assert not self._modified
-        return _cells_begin_state(self._children.values(), **kwargs)
+        return [s for c in self._children.values()
+                for s in c.begin_state(**kwargs)]
 
     def __call__(self, inputs, states):
         self._counter += 1
@@ -407,9 +410,8 @@ class SequentialRNNCell(RecurrentCell):
                merge_outputs=None, valid_length=None):
         self.reset()
         num_cells = len(self._children)
-        _, _, batch_size = _format_sequence(length, inputs, layout, None)
-        begin_state = _get_begin_state(self, nd, begin_state, inputs,
-                                       batch_size)
+        begin_state = self._ensure_begin_state(
+            begin_state, _batch_size_of(inputs, layout))
         p = 0
         next_states = []
         for i, cell in enumerate(self._children.values()):
@@ -540,10 +542,12 @@ class ResidualCell(ModifierCell):
         self.base_cell._modified = True
         merge_outputs = isinstance(outputs, nd.NDArray) if \
             merge_outputs is None else merge_outputs
-        inputs, axis, _ = _format_sequence(length, inputs, layout,
-                                           merge_outputs)
         if merge_outputs:
-            outputs = outputs + inputs
+            in_seq, _ = _to_time_major(inputs, layout, length)
+            outputs = outputs + _emit_sequence(in_seq, layout, True)
+        elif isinstance(inputs, nd.NDArray):
+            in_seq, _ = _to_time_major(inputs, layout, length)
+            outputs = [o + in_seq[i] for i, o in enumerate(outputs)]
         else:
             outputs = [o + i for o, i in zip(outputs, inputs)]
         return outputs, states
@@ -564,49 +568,40 @@ class BidirectionalCell(RecurrentCell):
             "Bidirectional cannot be stepped. Please use unroll")
 
     def state_info(self, batch_size=0):
-        return _cells_state_info(self._children.values(), batch_size)
+        return [info for c in self._children.values()
+                for info in c.state_info(batch_size)]
 
     def begin_state(self, **kwargs):
         assert not self._modified
-        return _cells_begin_state(self._children.values(), **kwargs)
+        return [s for c in self._children.values()
+                for s in c.begin_state(**kwargs)]
 
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None, valid_length=None):
+        """Both directions run over the SAME merged time-major tensor:
+        the reverse pass consumes SequenceReverse(seq) (one gather, not a
+        python list reversal), and the two output tensors concat on the
+        feature axis."""
         self.reset()
-        inputs, axis, batch_size = _format_sequence(length, inputs, layout,
-                                                    False)
-        begin_state = _get_begin_state(self, nd, begin_state, inputs,
-                                       batch_size)
-        states = begin_state
+        seq, batch_size = _to_time_major(inputs, layout, length)
+        if length is None:
+            length = seq.shape[0]
+        states = self._ensure_begin_state(begin_state, batch_size, seq.ctx)
         l_cell, r_cell = self._children.values()
-        l_outputs, l_states = l_cell.unroll(
-            length, inputs=inputs,
-            begin_state=states[:len(l_cell.state_info())],
-            layout=layout, merge_outputs=False, valid_length=valid_length)
-        if valid_length is None:
-            reversed_inputs = list(reversed(inputs))
-        else:
-            seq = nd.stack(*inputs, axis=0)
-            reversed_inputs = nd.SequenceReverse(seq, valid_length,
-                                                 use_sequence_length=True)
-            reversed_inputs = [reversed_inputs[i]
-                               for i in range(length)]
-        r_outputs, r_states = r_cell.unroll(
-            length, inputs=reversed_inputs,
-            begin_state=states[len(l_cell.state_info()):],
-            layout=layout, merge_outputs=False, valid_length=valid_length)
-        if valid_length is None:
-            reversed_r_outputs = list(reversed(r_outputs))
-        else:
-            seq = nd.stack(*r_outputs, axis=0)
-            rev = nd.SequenceReverse(seq, valid_length,
-                                     use_sequence_length=True)
-            reversed_r_outputs = [rev[i] for i in range(length)]
-        outputs = [nd.concat(l_o, r_o, dim=1)
-                   for l_o, r_o in zip(l_outputs, reversed_r_outputs)]
-        if merge_outputs:
-            outputs = nd.stack(*outputs, axis=axis)
-        if valid_length is not None and not merge_outputs:
-            pass
-        states = l_states + r_states
-        return outputs, states
+        n_l = len(l_cell.state_info())
+
+        def reverse(s):
+            if valid_length is None:
+                return nd.SequenceReverse(s)
+            return nd.SequenceReverse(s, valid_length,
+                                      use_sequence_length=True)
+
+        l_out, l_states = l_cell.unroll(
+            length, seq, begin_state=states[:n_l], layout="TNC",
+            merge_outputs=True, valid_length=valid_length)
+        r_out, r_states = r_cell.unroll(
+            length, reverse(seq), begin_state=states[n_l:], layout="TNC",
+            merge_outputs=True, valid_length=valid_length)
+        out_seq = nd.concat(l_out, reverse(r_out), dim=2)   # (T, N, 2C)
+        return (_emit_sequence(out_seq, layout, bool(merge_outputs)),
+                l_states + r_states)
