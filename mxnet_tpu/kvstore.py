@@ -45,6 +45,7 @@ class KVStore:
         self._optimizer = None
         self._str_key_dict = {}
         self._compression_params = None
+        self._compression = None
 
     # -- identity ----------------------------------------------------------
     @property
@@ -90,6 +91,9 @@ class KVStore:
                 else:
                     self._store[k] = merged.copy()
                 continue
+            if getattr(self, "_compression", None) is not None:
+                vlist = [self._compress_cycle(k, i, v)
+                         for i, v in enumerate(vlist)]
             merged = vlist[0].copyto(stored.ctx) if len(vlist) == 1 else \
                 nd.add_n(*[v.as_in_context(stored.ctx) for v in vlist])
             if self._updater is not None:
@@ -183,7 +187,29 @@ class KVStore:
 
     # -- compression / barrier --------------------------------------------
     def set_gradient_compression(self, compression_params):
+        """Arm 2-bit gradient compression (parity: kvstore.py
+        set_gradient_compression — device/dist stores only; the reference
+        raises for plain local too)."""
+        if not ("device" in self._type or "dist" in self._type):
+            raise MXNetError(
+                "gradient compression is only supported for 'device' and "
+                "'dist*' kvstores")
+        from . import gradient_compression as gc
         self._compression_params = dict(compression_params)
+        self._compression = gc.create(compression_params)
+
+    def _compress_cycle(self, k, i, value):
+        """Local stores quantize+dequantize each pushed value (with
+        per-(key, device) residual) so compressed training semantics are
+        identical whether the grads cross a wire or not (parity: the
+        reference's CommDevice compressed reduce path)."""
+        import numpy as np
+        gc = getattr(self, "_compression", None)
+        if gc is None:
+            return value
+        deq = gc.dequantize(gc.quantize((k, i), value.asnumpy()),
+                            tuple(value.shape), np.float32)
+        return nd.array(deq, ctx=value.ctx, dtype=value.dtype)
 
     def barrier(self):
         nd.waitall()
@@ -244,6 +270,10 @@ class KVStoreDist(KVStore):
         sync = self._type in ("dist_sync", "dist_device_sync")
         for k, vlist in zip(keys, values):
             if any(isinstance(v, _sp.BaseSparseNDArray) for v in vlist):
+                if getattr(self, "_compression", None) is not None:
+                    raise MXNetError(
+                        "gradient compression does not support row_sparse "
+                        "pushes (reference kvstore_dist parity)")
                 merged = vlist[0]
                 for v in vlist[1:]:
                     merged = _sp.elemwise_add(merged, v)
@@ -254,7 +284,14 @@ class KVStoreDist(KVStore):
                 continue
             merged = vlist[0] if len(vlist) == 1 else nd.add_n(
                 *[v.as_in_context(vlist[0].ctx) for v in vlist])
-            self._client.push(k, merged.asnumpy(), sync=sync)
+            gc = getattr(self, "_compression", None)
+            if gc is not None:
+                # 2-bit codes + error-feedback residual on this worker
+                # (parity: KVStoreDist::PushCompressed)
+                self._client.push_compressed(
+                    k, gc.encode_push(k, merged.asnumpy()), sync=sync)
+            else:
+                self._client.push(k, merged.asnumpy(), sync=sync)
 
     def _fetch_rows(self, k, stored, rows):
         # only the requested rows cross the wire (kvstore_dist.h:243)

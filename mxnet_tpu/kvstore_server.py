@@ -164,7 +164,13 @@ class KVServer:
             elif op == "push":
                 key = msg["key"]
                 value = msg["value"]
-                if isinstance(value, dict) and "indices" in value:
+                if isinstance(value, dict) and "q2bit" in value:
+                    # 2-bit compressed push: unpack ±threshold/0 before
+                    # aggregation (parity: kvstore_dist_server.h
+                    # DataHandleCompressed)
+                    from .gradient_compression import GradientCompression
+                    grad = GradientCompression.decode_push(value)
+                elif isinstance(value, dict) and "indices" in value:
                     # row_sparse push: only (indices, values) crossed the
                     # wire (parity: kvstore_dist.h row_sparse push); expand
                     # to a dense contribution for aggregation
@@ -287,6 +293,14 @@ class KVClient:
 
     def push(self, key, value, sync=True):
         self._rpc({"op": "push", "key": key, "value": np.asarray(value),
+                   "sync": sync})
+        if sync:
+            self._push_counts[key] = self._push_counts.get(key, 0) + 1
+
+    def push_compressed(self, key, encoded, sync=True):
+        """Push a 2-bit-compressed gradient (dict from
+        GradientCompression.encode_push)."""
+        self._rpc({"op": "push", "key": key, "value": encoded,
                    "sync": sync})
         if sync:
             self._push_counts[key] = self._push_counts.get(key, 0) + 1
