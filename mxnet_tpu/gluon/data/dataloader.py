@@ -1,12 +1,19 @@
 """DataLoader (parity: python/mxnet/gluon/data/dataloader.py).
 
-Reference architecture: fork workers + cpu_shared-storage NDArray rebuild via
-a custom ForkingPickler (dataloader.py:55-120). TPU redesign: workers run in
-a multiprocessing.Pool producing numpy batches (picklable, zero-copy via OS
-pipes is unnecessary since batches transfer host→HBM anyway), with an
-in-flight prefetch window so host decode overlaps device compute. Batchify
-returns NDArrays on cpu; the training loop (or TrainStep) moves them to the
-device mesh.
+Reference architecture: fork workers + cpu_shared-storage NDArray rebuild
+via a custom ForkingPickler (dataloader.py:55-120, POSIX shm under
+src/storage/cpu_shared_storage_manager.h).  TPU redesign, same roles:
+
+- fork workers batchify to numpy; large arrays cross the process
+  boundary through multiprocessing.shared_memory blocks (one memcpy into
+  shm, zero-copy attach in the parent) instead of being pickled through
+  a pipe — the cpu_shared equivalent;
+- an in-flight prefetch window keeps the pool busy ahead of the
+  consumer (dmlc ThreadedIter's double buffering);
+- optional ``device_prefetch``: batches are handed to jax.device_put as
+  soon as the worker result lands, so the host→HBM copy of batch N+1
+  overlaps the consumer's compute on batch N (the reference's
+  iter_prefetcher.h pinned-memory stage).
 """
 from __future__ import annotations
 
@@ -18,6 +25,9 @@ import numpy as np
 from ... import ndarray as nd
 from ...ndarray import NDArray
 from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+# arrays below this many bytes just pickle (shm setup costs more)
+_SHM_MIN_BYTES = 1 << 16
 
 
 def default_batchify_fn(data):
@@ -33,13 +43,64 @@ def default_batchify_fn(data):
 
 def default_mp_batchify_fn(data):
     """Worker-side batchify: stays in numpy (crosses the process boundary
-    as plain buffers; the reference rebuilds into cpu_shared NDArrays)."""
+    via shared memory; the reference rebuilds into cpu_shared NDArrays)."""
     if isinstance(data[0], NDArray):
         return np.stack([d.asnumpy() for d in data])
     if isinstance(data[0], tuple):
         data = zip(*data)
         return [default_mp_batchify_fn(i) for i in data]
     return np.asarray(data)
+
+
+class _ShmBatch:
+    """Descriptor for a numpy array parked in a SharedMemory block."""
+
+    __slots__ = ("name", "shape", "dtype")
+
+    def __init__(self, name, shape, dtype):
+        self.name = name
+        self.shape = shape
+        self.dtype = dtype
+
+
+def _to_shm(obj):
+    """Recursively move large numpy arrays into shared memory blocks."""
+    from multiprocessing import shared_memory
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_shm(o) for o in obj)
+    if isinstance(obj, np.ndarray) and obj.nbytes >= _SHM_MIN_BYTES:
+        shm = shared_memory.SharedMemory(create=True, size=obj.nbytes)
+        view = np.ndarray(obj.shape, obj.dtype, buffer=shm.buf)
+        view[...] = obj
+        desc = _ShmBatch(shm.name, obj.shape, obj.dtype)
+        # ownership transfers to the parent (which unlinks after attach);
+        # drop the creating process's resource-tracker registration so it
+        # doesn't warn about the block it no longer owns
+        try:
+            from multiprocessing import resource_tracker
+            resource_tracker.unregister(shm._name, "shared_memory")
+        except Exception:
+            pass
+        shm.close()
+        return desc
+    return obj
+
+
+def _from_shm(obj):
+    """Attach descriptors, copy out (device_put consumes the copy), unlink."""
+    from multiprocessing import shared_memory
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_from_shm(o) for o in obj)
+    if isinstance(obj, _ShmBatch):
+        shm = shared_memory.SharedMemory(name=obj.name)
+        try:
+            arr = np.ndarray(obj.shape, obj.dtype,
+                             buffer=shm.buf).copy()
+        finally:
+            shm.close()
+            shm.unlink()
+        return arr
+    return obj
 
 
 _worker_dataset = None
@@ -50,32 +111,53 @@ def _worker_initializer(dataset):
     _worker_dataset = dataset
 
 
-def _worker_fn(samples, batchify_fn, dataset=None):
-    """Worker target: fetch samples, batchify to numpy."""
+def _worker_fn(samples, batchify_fn, use_shm, dataset=None):
+    """Worker target: fetch samples, batchify to numpy, park in shm."""
     global _worker_dataset
     ds = dataset if dataset is not None else _worker_dataset
     batch = batchify_fn([ds[i] for i in samples])
-    return batch
+    return _to_shm(batch) if use_shm else batch
 
 
-def _as_nd(batch):
+def _ctx_for_device(device):
+    from ...context import Context
+    plat = getattr(device, "platform", "cpu")
+    dev_type = plat if plat in ("cpu", "gpu", "tpu") else "tpu"
+    return Context(dev_type, getattr(device, "id", 0))
+
+
+def _as_nd(batch, device=None):
     if isinstance(batch, (list, tuple)):
-        return [_as_nd(b) for b in batch]
+        return [_as_nd(b, device) for b in batch]
     if isinstance(batch, NDArray):
         return batch
+    if device is not None:
+        import jax
+        arr = jax.device_put(np.asarray(batch), device)
+        return NDArray(arr, _ctx_for_device(device))
     return nd.array(batch)
 
 
 class DataLoader:
     """Loads data from a Dataset, returns mini-batches
-    (parity: dataloader.py DataLoader)."""
+    (parity: dataloader.py DataLoader).
+
+    num_workers > 0 forks a pool; batches come back through shared
+    memory.  device_prefetch=True (or a jax device) starts the host→HBM
+    transfer as soon as a batch is ready instead of when the consumer
+    touches it."""
 
     def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
                  last_batch=None, batch_sampler=None, batchify_fn=None,
                  num_workers=0, pin_memory=False, pin_device_id=0,
-                 prefetch=None, thread_pool=False):
+                 prefetch=None, thread_pool=False, device_prefetch=False):
         self._dataset = dataset
         self._pin_memory = pin_memory  # staging is XLA-managed; accepted
+        self._device = None
+        if device_prefetch:
+            import jax
+            self._device = (device_prefetch if not isinstance(
+                device_prefetch, bool) else jax.devices()[0])
 
         if batch_sampler is None:
             if batch_size is None:
@@ -111,6 +193,7 @@ class DataLoader:
             self._batchify_fn = batchify_fn
         self._thread_pool = thread_pool
         self._pool = None
+        self._use_shm = False
         if self._num_workers > 0:
             if thread_pool:
                 from multiprocessing.pool import ThreadPool
@@ -121,18 +204,25 @@ class DataLoader:
                 self._pool = ctx.Pool(self._num_workers,
                                       initializer=_worker_initializer,
                                       initargs=(dataset,))
+                self._use_shm = True
 
     def __iter__(self):
         if self._pool is None:
             for batch in self._batch_sampler:
                 yield _as_nd(self._batchify_fn(
-                    [self._dataset[i] for i in batch]))
+                    [self._dataset[i] for i in batch]), self._device)
             return
 
-        # async prefetch window over the worker pool
+        # async prefetch window over the worker pool; completed batches
+        # move straight to the device (double buffering: transfer of the
+        # next batch overlaps compute on the current one).  The window
+        # bounds TOTAL in-flight batches (pending + ready) so a slow
+        # consumer cannot accumulate unbounded host/HBM memory.
         import collections
         pending = collections.deque()
+        ready = collections.deque()
         it = iter(self._batch_sampler)
+        window = max(1, self._prefetch)
 
         def submit():
             try:
@@ -140,17 +230,37 @@ class DataLoader:
             except StopIteration:
                 return False
             pending.append(self._pool.apply_async(
-                _worker_fn, (samples, self._batchify_fn)))
+                _worker_fn, (samples, self._batchify_fn, self._use_shm)))
             return True
 
-        for _ in range(self._prefetch or 1):
-            if not submit():
-                break
-        while pending:
-            result = pending.popleft()
-            batch = result.get()
-            submit()
-            yield _as_nd(batch)
+        def drain_ready():
+            # move completed worker results into the device queue
+            while pending and (pending[0].ready() or not ready):
+                result = pending.popleft()
+                batch = result.get()
+                if self._use_shm:
+                    batch = _from_shm(batch)
+                ready.append(_as_nd(batch, self._device))
+            while len(pending) + len(ready) < window:
+                if not submit():
+                    break
+
+        try:
+            for _ in range(window):
+                if not submit():
+                    break
+            while pending or ready:
+                drain_ready()
+                yield ready.popleft()
+        finally:
+            # consumer stopped early (or a worker raised): attach+unlink
+            # any in-flight shm blocks so /dev/shm does not leak
+            if self._use_shm:
+                for result in pending:
+                    try:
+                        _from_shm(result.get(timeout=30))
+                    except Exception:
+                        pass
 
     def __len__(self):
         return len(self._batch_sampler)
