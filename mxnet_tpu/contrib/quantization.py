@@ -1,0 +1,288 @@
+"""Post-training int8 quantization with calibration.
+
+Reference: python/mxnet/contrib/quantization.py — quantize_model:423 with
+calib_mode 'naive' (min/max, _collect_layer_output_min_max:262) and
+'entropy' (KL-optimal thresholds, _get_optimal_threshold:262 /
+_smooth_distribution:241); the C++ graph pass quantize_graph_pass.cc
+inserts quantize/dequantize around supported ops.
+
+TPU redesign: the "graph pass" operates on gluon blocks — supported
+layers (Conv2D, Dense) are swapped for quantized wrappers whose forward
+is quantize → int8 MXU op (ops/_op_quantization.py) → dequantize; ranges
+come from a calibration sweep using forward-pre hooks.  Weights quantize
+once at conversion.  XLA fuses the (de)quantize elementwise stages into
+the int8 conv/GEMM, so the compiled program matches the reference's
+fused quantized operators without a kernel zoo.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["quantize_net", "_get_optimal_threshold"]
+
+_NUM_BINS = 8001  # reference quantization.py:262 default
+_NUM_QUANTIZED_BINS = 255
+
+
+def _smooth_distribution(p, eps=1e-4):
+    """Spread eps mass to zero bins (reference quantization.py:241)."""
+    is_zeros = (p == 0).astype(np.float32)
+    n_zeros = is_zeros.sum()
+    n_nonzeros = p.size - n_zeros
+    if n_nonzeros == 0:
+        return None
+    eps1 = eps * n_zeros / n_nonzeros
+    hist = p.astype(np.float32)
+    hist += eps * is_zeros - eps1 * (1 - is_zeros)
+    return hist
+
+
+def _get_optimal_threshold(arr, num_bins=_NUM_BINS,
+                           num_quantized_bins=_NUM_QUANTIZED_BINS):
+    """KL-divergence-optimal |threshold| for int8 (reference
+    quantization.py:262, simplified to the symmetric |x| histogram)."""
+    from scipy import stats as _stats  # scipy ships with the image
+    arr = np.abs(np.asarray(arr).ravel())
+    th = float(arr.max())
+    if th == 0.0:
+        return 1e-10
+    hist, edges = np.histogram(arr, bins=num_bins, range=(0, th))
+    best_kl, best_th = None, th
+    for i in range(num_quantized_bins, num_bins + 1,
+                   max(1, (num_bins - num_quantized_bins) // 128)):
+        p = hist[:i].astype(np.float32).copy()
+        p[-1] += hist[i:].sum()          # clip outliers into the last bin
+        # quantize the first i bins down to num_quantized_bins
+        factor = i / num_quantized_bins
+        idx = (np.arange(i) / factor).astype(np.int64)
+        q_small = np.bincount(idx, weights=hist[:i],
+                              minlength=num_quantized_bins)
+        # expand back, distributing each quantized bin over its sources
+        counts = np.bincount(idx, minlength=num_quantized_bins)
+        q = np.where(counts[idx] > 0, q_small[idx] / counts[idx], 0.0)
+        p_s = _smooth_distribution(p)
+        q_s = _smooth_distribution(q.astype(np.float32))
+        if p_s is None or q_s is None:
+            continue
+        kl = float(_stats.entropy(p_s, q_s))
+        if best_kl is None or kl < best_kl:
+            # hist[:i] spans up to the RIGHT edge of bin i-1 == edges[i]
+            best_kl, best_th = kl, float(edges[i])
+    return max(best_th, 1e-10)
+
+
+class _Calibrator:
+    """Forward-pre-hook collector of per-layer input ranges."""
+
+    def __init__(self, mode):
+        self.mode = mode
+        self.minmax = {}         # id(block) -> [min, max]
+        self.samples = {}        # id(block) -> list of |x| samples
+
+    def hook(self, block, args):
+        x = args[0]
+        arr = x.asnumpy()
+        key = id(block)
+        mn, mx = float(arr.min()), float(arr.max())
+        if key in self.minmax:
+            self.minmax[key][0] = min(self.minmax[key][0], mn)
+            self.minmax[key][1] = max(self.minmax[key][1], mx)
+        else:
+            self.minmax[key] = [mn, mx]
+        if self.mode == "entropy":
+            flat = np.abs(arr.ravel())
+            if flat.size > 8192:
+                flat = np.random.default_rng(0).choice(flat, 8192,
+                                                       replace=False)
+            self.samples.setdefault(key, []).append(flat)
+
+    def range_of(self, block):
+        key = id(block)
+        if key not in self.minmax:
+            raise MXNetError(
+                "calibration never reached a quantized layer — did "
+                "calib_data cover the forward path?")
+        if self.mode == "entropy":
+            th = _get_optimal_threshold(np.concatenate(self.samples[key]))
+            return -th, th
+        mn, mx = self.minmax[key]
+        amax = max(abs(mn), abs(mx), 1e-10)
+        return -amax, amax
+
+
+class _QuantizedConv2D:
+    """Forward replacement for a calibrated Conv2D: int8 conv + f32 bias.
+
+    Built as a plain callable (not a Block) that swaps into the parent's
+    child slot — it owns no parameters of its own; the original block's
+    weight/bias stay the source of truth (so save/load still works)."""
+
+    def __init__(self, conv, amax_in):
+        from .. import nd
+        self._conv = conv
+        self._amax_in = float(amax_in)
+        w = conv.weight.data()
+        w_np = w.asnumpy()
+        self._amax_w = float(np.abs(w_np).max()) or 1e-10
+        scale_w = 127.0 / self._amax_w
+        self._qweight = nd.array(
+            np.clip(np.rint(w_np * scale_w), -127, 127).astype(np.int8))
+        self._wmin = nd.array(np.float32(-self._amax_w))
+        self._wmax = nd.array(np.float32(self._amax_w))
+
+    def __call__(self, x):
+        from .. import nd
+        conv = self._conv
+        qx, mn_d, mx_d = nd.contrib.quantize_v2(
+            x, min_calib_range=-self._amax_in,
+            max_calib_range=self._amax_in)
+        kw = dict(conv._kwargs)
+        kw.pop("no_bias", None)
+        out, mn_o, mx_o = nd.contrib.quantized_conv(
+            qx, self._qweight, mn_d, mx_d, self._wmin, self._wmax, **kw)
+        out = nd.contrib.dequantize(out, mn_o, mx_o)
+        if conv.bias is not None:
+            b = conv.bias.data()
+            out = out + b.reshape((1, -1) + (1,) * (len(out.shape) - 2))
+        if conv.act is not None:
+            out = conv.act(out)
+        return out
+
+    # Block-protocol surface used by parents: recursive Block APIs
+    # (hybridize/cast/apply/collect_params) delegate to the wrapped
+    # block; _children is empty so tree walks terminate here
+    _children = {}
+
+    def collect_params(self, select=None):
+        return self._conv.collect_params(select)
+
+    def __getattr__(self, name):
+        return getattr(object.__getattribute__(self, "_conv"), name)
+
+    def __repr__(self):
+        return f"Quantized({self._conv!r})"
+
+
+class _QuantizedDense:
+    def __init__(self, dense, amax_in):
+        from .. import nd
+        self._dense = dense
+        self._amax_in = float(amax_in)
+        w_np = dense.weight.data().asnumpy()
+        self._amax_w = float(np.abs(w_np).max()) or 1e-10
+        self._qweight = nd.array(
+            np.clip(np.rint(w_np * (127.0 / self._amax_w)),
+                    -127, 127).astype(np.int8))
+        self._wmin = nd.array(np.float32(-self._amax_w))
+        self._wmax = nd.array(np.float32(self._amax_w))
+
+    def __call__(self, x):
+        from .. import nd
+        dense = self._dense
+        qx, mn_d, mx_d = nd.contrib.quantize_v2(
+            x, min_calib_range=-self._amax_in,
+            max_calib_range=self._amax_in)
+        out, mn_o, mx_o = nd.contrib.quantized_fully_connected(
+            qx, self._qweight, mn_d, mx_d, self._wmin, self._wmax,
+            flatten=dense._flatten)
+        out = nd.contrib.dequantize(out, mn_o, mx_o)
+        if dense.bias is not None:
+            out = out + dense.bias.data()
+        if dense.act is not None:
+            out = dense.act(out)
+        return out
+
+    _children = {}
+
+    def collect_params(self, select=None):
+        return self._dense.collect_params(select)
+
+    def __getattr__(self, name):
+        return getattr(object.__getattribute__(self, "_dense"), name)
+
+    def __repr__(self):
+        return f"Quantized({self._dense!r})"
+
+
+def _walk_quantizable(block, exclude):
+    """Yield (parent, child_name, child) for every Conv2D/Dense.
+    ``exclude`` entries may be block instances or name strings (the
+    reference's exclude_layers takes names)."""
+    from ..gluon import nn
+    exclude = exclude or ()
+    for name, child in list(block._children.items()):
+        excluded = any(
+            (isinstance(e, str) and e in (name, getattr(child, "name", "")))
+            or e is child for e in exclude)
+        if isinstance(child, (nn.Conv2D, nn.Dense)) and not excluded:
+            yield block, name, child
+        elif getattr(child, "_children", None):
+            yield from _walk_quantizable(child, exclude)
+
+
+def quantize_net(net, calib_data=None, calib_mode="naive",
+                 quantized_dtype="int8", exclude_layers=None,
+                 logger=None):
+    """Convert a gluon net to int8 inference (parity:
+    contrib/quantization.py quantize_model:423 / quantize_net).
+
+    calib_data: iterable of input batches (NDArray) driven through the
+    net to collect activation ranges.  calib_mode: 'naive' (min/max) or
+    'entropy' (KL thresholds).  Returns the SAME net instance with
+    Conv2D/Dense children swapped for int8 wrappers.
+    """
+    if quantized_dtype != "int8":
+        raise MXNetError("only int8 quantization is supported on TPU "
+                         "(uint8 has no MXU advantage)")
+    if calib_mode not in ("naive", "entropy"):
+        raise MXNetError(f"unknown calib_mode {calib_mode!r}")
+    if calib_data is None:
+        raise MXNetError("calib_data is required (post-training "
+                         "quantization needs activation ranges)")
+    targets = list(_walk_quantizable(net, exclude_layers))
+    if not targets:
+        raise MXNetError("no quantizable (Conv2D/Dense) layers found")
+
+    # calibration must step through the children imperatively (the hooks
+    # read concrete values), and stale compiled float graphs must never
+    # shadow the swapped-in quantized children — drop every jit cache
+    # and deactivate hybrid execution for the calibration pass
+    def _clear_jit(blk):
+        if hasattr(blk, "_jit_cache"):
+            blk._jit_cache.clear()
+        for c in blk._children.values():
+            if hasattr(c, "_children"):
+                _clear_jit(c)
+
+    _clear_jit(net)
+    was_active = getattr(net, "_active", False)
+    if hasattr(net, "hybridize"):
+        net.hybridize(False)
+
+    calib = _Calibrator(calib_mode)
+    handles = [child.register_forward_pre_hook(calib.hook)
+               for _, _, child in targets]
+    from .. import autograd
+    with autograd.pause():
+        for batch in calib_data:
+            net(batch)
+    for h in handles:
+        h.detach()
+
+    for parent, name, child in targets:
+        lo, hi = calib.range_of(child)
+        wrapper_cls = _QuantizedDense if child.__class__.__name__ == \
+            "Dense" else _QuantizedConv2D
+        wrapped = wrapper_cls(child, max(abs(lo), abs(hi)))
+        parent._children[name] = wrapped
+        # attribute access (e.g. net.conv1) should see the wrapper too
+        for attr, val in list(vars(parent).items()):
+            if val is child:
+                object.__setattr__(parent, attr, wrapped)
+    if was_active:
+        # re-arm hybrid execution: the next forward traces the QUANTIZED
+        # graph into a fresh jit cache
+        net.hybridize(True)
+    return net

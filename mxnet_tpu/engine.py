@@ -22,6 +22,8 @@ import weakref
 
 import jax
 
+from .base import MXNetError
+
 
 class Var:
     """Version-counted variable attached to each NDArray chunk."""
@@ -77,7 +79,14 @@ class Engine:
                 except Exception as e:  # surface async failure at the sync point
                     excs.append(e)
         if excs:
-            raise excs[0]
+            # MXNetError at the MXNet-defined sync point (parity:
+            # ThreadedEngine::WaitForAll rethrow, threaded_engine.cc:416)
+            first = excs[0]
+            if isinstance(first, MXNetError):
+                raise first
+            raise MXNetError(
+                f"async operator execution failed (surfaced at waitall): "
+                f"{first}") from first
 
     def set_bulk_size(self, size):
         old, self.bulk_size = self.bulk_size, size

@@ -151,12 +151,29 @@ class NDArray:
         autograd.backward([self], [out_grad] if out_grad is not None else None,
                           retain_graph=retain_graph, train_mode=train_mode)
 
-    # -- sync points (parity: WaitToRead / asnumpy) ------------------------
+    # -- sync points (parity: WaitToRead / asnumpy).  Async device
+    # failures surface HERE as MXNetError — the reference's contract
+    # (threaded_engine.cc:422-451 rethrows captured opr exceptions at
+    # WaitToRead/WaitForAll), not a raw XLA error at a random later op.
     def wait_to_read(self):
-        self._data.block_until_ready()
+        try:
+            self._data.block_until_ready()
+        except MXNetError:
+            raise
+        except Exception as e:
+            raise MXNetError(
+                f"async operator execution failed (surfaced at "
+                f"wait_to_read): {e}") from e
 
     def asnumpy(self):
-        return np.asarray(self._data)
+        try:
+            return np.asarray(self._data)
+        except MXNetError:
+            raise
+        except Exception as e:
+            raise MXNetError(
+                f"async operator execution failed (surfaced at "
+                f"asnumpy): {e}") from e
 
     def asscalar(self):
         if self.size != 1:
@@ -548,6 +565,16 @@ def invoke(op, inputs, attrs, out=None):
     if _prof_t0 is not None:
         import time as _time
         from .. import profiler as _prof
+        # block so the recorded duration covers DEVICE execution, not
+        # just async dispatch (the round-2 profiler only saw dispatch);
+        # serialisation under profiling matches the reference's
+        # per-opr ProfileOperator wrapping (threaded_engine.cc:288)
+        if _prof.device_sync_enabled():
+            try:
+                jax.block_until_ready(
+                    [o for o in outs if not isinstance(o, jax.core.Tracer)])
+            except Exception:
+                pass  # the error re-surfaces at the user's sync point
         _prof.record_op(op.name, (_time.perf_counter() - _prof_t0) * 1e6)
 
     ctx = nd_inputs[0]._ctx if nd_inputs else current_context()

@@ -154,10 +154,19 @@ def _custom_imperative(op, prop, nd_inputs, out_shapes, out_types, ctx):
     from . import ndarray as ndmod
     out_data = [ndmod.zeros(s, ctx=ctx, dtype=t)
                 for s, t in zip(out_shapes, out_types)]
-    with autograd.pause(train_mode=autograd.is_training()):
-        op.forward(is_train=autograd.is_training(),
-                   req=["write"] * len(out_data),
-                   in_data=list(nd_inputs), out_data=out_data, aux=[])
+    try:
+        with autograd.pause(train_mode=autograd.is_training()):
+            op.forward(is_train=autograd.is_training(),
+                       req=["write"] * len(out_data),
+                       in_data=list(nd_inputs), out_data=out_data, aux=[])
+    except MXNetError:
+        raise
+    except Exception as e:
+        # custom-op failures are framework errors (async-exception
+        # contract parity: custom-inl.h pushes failures to the engine,
+        # rethrown as MXNetError at the sync point)
+        raise MXNetError(
+            f"custom op '{type(op).__name__}' failed: {e}") from e
     if autograd.is_recording():
         def vjp(cts, _op=op, _ins=nd_inputs, _outs=out_data, _ctx=ctx):
             cts_t = cts if isinstance(cts, tuple) else (cts,)
@@ -223,6 +232,15 @@ def _custom_traced(op, prop, nd_inputs, out_shapes, out_types, ctx):
                                  vmap_method=None)
 
     staged.defvjp(staged_fwd, staged_bwd)
-    outs = staged(*[i._data for i in nd_inputs])
+    try:
+        outs = staged(*[i._data for i in nd_inputs])
+    except MXNetError:
+        raise
+    except Exception as e:
+        # host callback failures are framework errors, not raw XLA noise
+        # (async-exception contract; under jit the same failure surfaces
+        # as MXNetError at the consumer's sync point instead)
+        raise MXNetError(f"custom op '{type(op).__name__}' failed: "
+                         f"{e}") from e
     out_nds = [NDArray(o, ctx) for o in outs]
     return out_nds[0] if len(out_nds) == 1 else out_nds

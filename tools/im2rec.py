@@ -1,0 +1,210 @@
+#!/usr/bin/env python
+"""im2rec: pack an image folder into RecordIO (.rec/.idx).
+
+Role parity with /root/reference/tools/im2rec.py: list generation
+(prefix.lst: "index\tlabel[\tlabel...]\trelpath"), then a multiprocess
+pack of encoded JPEG/PNG records in MXIndexedRecordIO format — the
+.rec files interoperate with the reference's readers (recordio.py is
+format-compatible).
+
+Usage:
+  python tools/im2rec.py --list prefix root          # make prefix.lst
+  python tools/im2rec.py prefix root                 # pack prefix.rec/.idx
+"""
+import argparse
+import multiprocessing
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def list_images(root, recursive, exts):
+    """Yield (index, relpath, label) — label = sorted-subdir index
+    (reference list_image)."""
+    i = 0
+    if recursive:
+        cat = {}
+        for path, dirs, files in sorted(os.walk(root, followlinks=True)):
+            dirs.sort()
+            files.sort()
+            for fname in files:
+                fpath = os.path.join(path, fname)
+                suffix = os.path.splitext(fname)[1].lower()
+                if os.path.isfile(fpath) and (suffix in exts):
+                    if path not in cat:
+                        cat[path] = len(cat)
+                    yield (i, os.path.relpath(fpath, root), cat[path])
+                    i += 1
+    else:
+        for fname in sorted(os.listdir(root)):
+            fpath = os.path.join(root, fname)
+            suffix = os.path.splitext(fname)[1].lower()
+            if os.path.isfile(fpath) and (suffix in exts):
+                yield (i, os.path.relpath(fpath, root), 0)
+                i += 1
+
+
+def write_list(path_out, image_list):
+    with open(path_out, "w") as fout:
+        for i, item in enumerate(image_list):
+            line = "%d\t" % item[0]
+            for j in item[2:]:
+                line += "%f\t" % j
+            line += "%s\n" % item[1]
+            fout.write(line)
+
+
+def read_list(path_in):
+    with open(path_in) as fin:
+        for line in fin:
+            line = [i.strip() for i in line.strip().split("\t")]
+            if len(line) < 3:
+                continue
+            yield (int(line[0]), line[-1],
+                   [float(i) for i in line[1:-1]])
+
+
+def _encode_image(args, item):
+    """Load + (optionally) resize/crop + encode one image to bytes."""
+    fullpath = os.path.join(args.root, item[1])
+    if args.pass_through:
+        with open(fullpath, "rb") as f:
+            return f.read()
+    import numpy as np
+    try:
+        import cv2
+        img = cv2.imread(fullpath, args.color)
+        if img is None:
+            return None
+        if args.center_crop and img.shape[0] != img.shape[1]:
+            m = min(img.shape[:2])
+            y0 = (img.shape[0] - m) // 2
+            x0 = (img.shape[1] - m) // 2
+            img = img[y0:y0 + m, x0:x0 + m]
+        if args.resize:
+            h, w = img.shape[:2]
+            scale = args.resize / min(h, w)
+            img = cv2.resize(img, (int(w * scale), int(h * scale)))
+        ok, buf = cv2.imencode(args.encoding, img,
+                               [cv2.IMWRITE_JPEG_QUALITY, args.quality])
+        return buf.tobytes() if ok else None
+    except ImportError:
+        from PIL import Image
+        import io
+        img = Image.open(fullpath).convert("RGB")
+        if args.center_crop and img.size[0] != img.size[1]:
+            m = min(img.size)
+            x0 = (img.size[0] - m) // 2
+            y0 = (img.size[1] - m) // 2
+            img = img.crop((x0, y0, x0 + m, y0 + m))
+        if args.resize:
+            scale = args.resize / min(img.size)
+            img = img.resize((int(img.size[0] * scale),
+                              int(img.size[1] * scale)))
+        out = io.BytesIO()
+        img.save(out, format="JPEG" if args.encoding == ".jpg" else "PNG",
+                 quality=args.quality)
+        return out.getvalue()
+
+
+def _pack_worker(args, item):
+    from mxnet_tpu import recordio
+    data = _encode_image(args, item)
+    if data is None:
+        return item[0], None
+    if len(item[2]) > 1 or args.pack_label:
+        header = recordio.IRHeader(0, item[2], item[0], 0)
+    else:
+        header = recordio.IRHeader(0, item[2][0], item[0], 0)
+    return item[0], recordio.pack(header, data)
+
+
+def make_rec(args, image_list):
+    """Multiprocess encode, single-writer pack (reference im2rec.py
+    read_worker/write_worker pipeline)."""
+    from functools import partial
+    from mxnet_tpu import recordio
+    record = recordio.MXIndexedRecordIO(args.prefix + ".idx",
+                                        args.prefix + ".rec", "w")
+    t0 = time.time()
+    count = 0
+    worker = partial(_pack_worker, args)
+    if args.num_thread > 1:
+        with multiprocessing.Pool(args.num_thread) as pool:
+            for idx, payload in pool.imap(worker, image_list,
+                                          chunksize=16):
+                if payload is None:
+                    print(f"imread failed for index {idx}",
+                          file=sys.stderr)
+                    continue
+                record.write_idx(idx, payload)
+                count += 1
+                if count % 1000 == 0:
+                    print(f"packed {count} images "
+                          f"({count / (time.time() - t0):.1f}/s)")
+    else:
+        for item in image_list:
+            idx, payload = worker(item)
+            if payload is None:
+                continue
+            record.write_idx(idx, payload)
+            count += 1
+    record.close()
+    print(f"wrote {count} records to {args.prefix}.rec "
+          f"in {time.time() - t0:.1f}s")
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="Create an image list / RecordIO database "
+                    "(parity: reference tools/im2rec.py)")
+    parser.add_argument("prefix", help="prefix of .lst/.rec/.idx files")
+    parser.add_argument("root", help="folder containing the images")
+    parser.add_argument("--list", action="store_true",
+                        help="create an image list, not a database")
+    parser.add_argument("--exts", nargs="+",
+                        default=[".jpeg", ".jpg", ".png"])
+    parser.add_argument("--recursive", action="store_true",
+                        help="label = sorted-subdir index")
+    parser.add_argument("--no-shuffle", dest="shuffle",
+                        action="store_false")
+    parser.add_argument("--pass-through", action="store_true",
+                        help="skip transcoding, pack raw bytes")
+    parser.add_argument("--resize", type=int, default=0)
+    parser.add_argument("--center-crop", action="store_true")
+    parser.add_argument("--quality", type=int, default=95)
+    parser.add_argument("--num-thread", type=int, default=1)
+    parser.add_argument("--color", type=int, default=1,
+                        choices=[-1, 0, 1])
+    parser.add_argument("--encoding", type=str, default=".jpg",
+                        choices=[".jpg", ".png"])
+    parser.add_argument("--pack-label", action="store_true")
+    args = parser.parse_args()
+
+    if args.list:
+        images = list(list_images(args.root, args.recursive, args.exts))
+        image_list = [(i, rel, lab) for i, rel, lab in images]
+        if args.shuffle:
+            random.seed(100)
+            random.shuffle(image_list)
+            image_list = [(n, rel, lab) for n, (_, rel, lab)
+                          in enumerate(image_list)]
+        write_list(args.prefix + ".lst",
+                   [(i, rel, lab) for i, rel, lab in image_list])
+        print(f"wrote {len(image_list)} entries to {args.prefix}.lst")
+        return
+
+    lst = args.prefix + ".lst"
+    if os.path.exists(lst):
+        image_list = list(read_list(lst))
+    else:
+        image_list = [(i, rel, [float(lab)]) for i, rel, lab in
+                      list_images(args.root, args.recursive, args.exts)]
+    make_rec(args, image_list)
+
+
+if __name__ == "__main__":
+    main()
