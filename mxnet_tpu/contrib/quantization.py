@@ -120,10 +120,16 @@ class _QuantizedConv2D:
     weight/bias stay the source of truth (so save/load still works)."""
 
     def __init__(self, conv, amax_in):
-        from .. import nd
         self._conv = conv
         self._amax_in = float(amax_in)
-        w = conv.weight.data()
+        self._w_version = None
+        self._refresh_weight()
+
+    def _refresh_weight(self):
+        from .. import nd
+        w = self._conv.weight.data()
+        if w.version == self._w_version:
+            return
         w_np = w.asnumpy()
         self._amax_w = float(np.abs(w_np).max()) or 1e-10
         scale_w = 127.0 / self._amax_w
@@ -131,10 +137,14 @@ class _QuantizedConv2D:
             np.clip(np.rint(w_np * scale_w), -127, 127).astype(np.int8))
         self._wmin = nd.array(np.float32(-self._amax_w))
         self._wmax = nd.array(np.float32(self._amax_w))
+        self._w_version = w.version
 
     def __call__(self, x):
         from .. import nd
         conv = self._conv
+        # load_parameters after quantize_net bumps the weight's engine
+        # version: requantize so the checkpoint actually takes effect
+        self._refresh_weight()
         qx, mn_d, mx_d = nd.contrib.quantize_v2(
             x, min_calib_range=-self._amax_in,
             max_calib_range=self._amax_in)
@@ -167,20 +177,29 @@ class _QuantizedConv2D:
 
 class _QuantizedDense:
     def __init__(self, dense, amax_in):
-        from .. import nd
         self._dense = dense
         self._amax_in = float(amax_in)
-        w_np = dense.weight.data().asnumpy()
+        self._w_version = None
+        self._refresh_weight()
+
+    def _refresh_weight(self):
+        from .. import nd
+        w = self._dense.weight.data()
+        if w.version == self._w_version:
+            return
+        w_np = w.asnumpy()
         self._amax_w = float(np.abs(w_np).max()) or 1e-10
         self._qweight = nd.array(
             np.clip(np.rint(w_np * (127.0 / self._amax_w)),
                     -127, 127).astype(np.int8))
         self._wmin = nd.array(np.float32(-self._amax_w))
         self._wmax = nd.array(np.float32(self._amax_w))
+        self._w_version = w.version
 
     def __call__(self, x):
         from .. import nd
         dense = self._dense
+        self._refresh_weight()
         qx, mn_d, mx_d = nd.contrib.quantize_v2(
             x, min_calib_range=-self._amax_in,
             max_calib_range=self._amax_in)
@@ -257,7 +276,16 @@ def quantize_net(net, calib_data=None, calib_mode="naive",
                 _clear_jit(c)
 
     _clear_jit(net)
-    was_active = getattr(net, "_active", False)
+
+    def _collect_active(blk, out):
+        if getattr(blk, "_active", False):
+            out.append(blk)
+        for c in blk._children.values():
+            if hasattr(c, "_children"):
+                _collect_active(c, out)
+        return out
+
+    active_blocks = _collect_active(net, [])
     if hasattr(net, "hybridize"):
         net.hybridize(False)
 
@@ -273,16 +301,18 @@ def quantize_net(net, calib_data=None, calib_mode="naive",
 
     for parent, name, child in targets:
         lo, hi = calib.range_of(child)
-        wrapper_cls = _QuantizedDense if child.__class__.__name__ == \
-            "Dense" else _QuantizedConv2D
+        from ..gluon import nn
+        wrapper_cls = _QuantizedDense if isinstance(child, nn.Dense) \
+            else _QuantizedConv2D
         wrapped = wrapper_cls(child, max(abs(lo), abs(hi)))
         parent._children[name] = wrapped
         # attribute access (e.g. net.conv1) should see the wrapper too
         for attr, val in list(vars(parent).items()):
             if val is child:
                 object.__setattr__(parent, attr, wrapped)
-    if was_active:
-        # re-arm hybrid execution: the next forward traces the QUANTIZED
-        # graph into a fresh jit cache
-        net.hybridize(True)
+    for blk in active_blocks:
+        # re-arm exactly the blocks that were hybridized (flag set
+        # directly so hybridize kwargs the user configured survive);
+        # the next forward traces the QUANTIZED graph into a fresh cache
+        blk._active = True
     return net

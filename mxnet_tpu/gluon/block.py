@@ -680,17 +680,10 @@ class HybridBlock(Block):
             if _prof_t0 is not None:
                 # profile the jit path too (the round-2 profiler missed
                 # it): one record per compiled-forward invocation,
-                # blocking so the duration is device time; errors
-                # re-surface at the user's sync point as MXNetError
-                import time as _time
+                # blocking so the duration is device time
                 from .. import profiler as _prof
-                if _prof.device_sync_enabled():
-                    try:
-                        jax.block_until_ready(outs)
-                    except Exception:
-                        pass
-                _prof.record_op(f"CachedOp_{self.name}",
-                                (_time.perf_counter() - _prof_t0) * 1e6)
+                _prof.record_synced(f"CachedOp_{self.name}", _prof_t0,
+                                    outs)
             results = [NDArray(o, ctx) for o in outs]
             self._apply_mutation(mutated_idx_box, param_list, mutated, ctx)
 

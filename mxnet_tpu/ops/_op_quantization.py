@@ -37,7 +37,13 @@ def _quantize_v2(attrs, data):
     """f32 -> (int8, min, max); calibrated range from attrs or data."""
     mn = attrs.get("min_calib_range")
     mx = attrs.get("max_calib_range")
-    if mn is None or mx is None:
+    if (mn is None) != (mx is None):
+        from ..base import MXNetError
+        raise MXNetError(
+            "quantize_v2: min_calib_range and max_calib_range must be "
+            "given together (one-sided ranges would silently fall back "
+            "to per-batch dynamic scales)")
+    if mn is None:
         mn = jnp.min(data).astype(jnp.float32)
         mx = jnp.max(data).astype(jnp.float32)
     else:

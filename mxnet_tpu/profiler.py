@@ -89,6 +89,22 @@ def device_sync_enabled():
     return _config["profile_device_sync"]
 
 
+def record_synced(name, t0, arrays):
+    """Block on ``arrays`` (when device-sync profiling is on) and record
+    the op with duration measured from ``t0``.  Errors re-surface at the
+    user's sync point as MXNetError, not here."""
+    import time as _time
+    if _config["profile_device_sync"]:
+        try:
+            import jax
+            jax.block_until_ready(
+                [a for a in arrays
+                 if not isinstance(a, jax.core.Tracer)])
+        except Exception:
+            pass
+    record_op(name, (_time.perf_counter() - t0) * 1e6)
+
+
 def record_op(name, dur_us, cat="operator"):
     """Internal hook: record one op dispatch (called from ndarray.invoke
     when profiling is on)."""

@@ -85,17 +85,57 @@ def check_numeric_gradient(op_fn, input_arrays, rtol=1e-2, atol=1e-3, eps=1e-3):
         assert_almost_equal(a.grad, e.astype(np.float32), rtol=rtol, atol=atol)
 
 
+def consistency_devices():
+    """The jax devices check_consistency crosses: the host CPU always,
+    plus the TPU chip when its backend is initialized and reachable
+    (skipped cleanly otherwise — the reference pattern is
+    tests/python/gpu/test_operator_gpu.py rerunning the CPU suite on
+    GPU; here one harness crosses backends in-process)."""
+    import jax
+    devs = []
+    try:
+        devs.append(jax.devices("cpu")[0])
+    except RuntimeError:
+        pass
+    for plat in ("tpu",):
+        try:
+            devs.append(jax.devices(plat)[0])
+        except Exception:
+            pass  # backend absent/unreachable: cpu-only run
+    return devs
+
+
 def check_consistency(op_fn, input_shapes, dtypes=(np.float32, np.float16),
-                      rtol=None, atol=None):
-    """Run the same op across dtypes and cross-check (parity:
-    check_consistency test_utils.py:1283, which ran cpu/gpu × fp16/32/64)."""
+                      rtol=None, atol=None, devices=None):
+    """Run the same op across devices × dtypes and cross-check every leg
+    against the (cpu, dtypes[0]) reference (parity: check_consistency
+    test_utils.py:1283, which ran [cpu, gpu] × [fp16, fp32, fp64])."""
+    import jax
+    devices = devices if devices is not None else consistency_devices()
     base_inputs = [np.random.uniform(-1, 1, size=s) for s in input_shapes]
-    outs = []
-    for dt in dtypes:
-        args = [nd.array(x.astype(dt)) for x in base_inputs]
-        outs.append(op_fn(*args).asnumpy().astype(np.float64))
-    ref = outs[0]
     tol = {np.dtype(np.float16): 1e-2, np.dtype(np.float32): 1e-5}
-    for o, dt in zip(outs[1:], dtypes[1:]):
-        t = tol.get(np.dtype(dt), 1e-2)
-        np.testing.assert_allclose(ref, o, rtol=rtol or t, atol=atol or t)
+    try:
+        import ml_dtypes
+        tol[np.dtype(ml_dtypes.bfloat16)] = 2e-2
+    except ImportError:
+        pass
+    ref = None
+    for dev in devices:
+        for dt in dtypes:
+            args = []
+            for x in base_inputs:
+                arr = jax.device_put(x.astype(dt), dev)
+                args.append(nd.NDArray(arr, current_context()))
+            out = op_fn(*args).asnumpy().astype(np.float64)
+            if ref is None:
+                ref = out    # (devices[0], dtypes[0]) is the oracle leg
+                continue
+            t = tol.get(np.dtype(dt), 1e-2)
+            if dev is not devices[0]:
+                # cross-DEVICE legs compare at accelerator matmul
+                # precision (TPU f32 dots default to bf16-ish internals)
+                t = max(t, 2e-3)
+            np.testing.assert_allclose(
+                ref, out, rtol=rtol or t, atol=atol or t,
+                err_msg=f"inconsistent on {dev.platform}/{dt}")
+    return ref
