@@ -24,7 +24,7 @@ def __getattr__(name):
     if name in ("gluon", "optimizer", "metric", "initializer", "lr_scheduler",
                 "symbol", "sym", "io", "image", "kvstore", "profiler", "module",
                 "callback", "monitor", "parallel", "test_utils", "visualization",
-                "executor", "runtime", "model", "recordio", "contrib", "amp",
+                "executor", "runtime", "model", "recordio", "contrib", "amp", "config",
                 "operator"):
         target = {"sym": "symbol"}.get(name, name)
         mod = importlib.import_module(f".{target}", __name__)

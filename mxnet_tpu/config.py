@@ -1,0 +1,121 @@
+"""Environment-variable configuration tier.
+
+Parity: the reference reads ~79 documented MXNET_* variables via
+dmlc::GetEnv at use sites (docs/faq/env_var.md; SURVEY.md §5 config
+tiers).  This module is the single typed registry for every variable
+the TPU framework consumes: each entry declares type, default, and doc,
+``get()`` parses with validation, and ``describe()`` renders the
+env_var.md-style table so the surface is discoverable
+(mx.config.describe()).
+
+Variables the reference defines but XLA/PJRT makes moot (memory-pool
+knobs, engine thread counts, cudnn autotune) are intentionally absent —
+XLA owns those decisions; see SURVEY.md §7 architecture stance.
+"""
+from __future__ import annotations
+
+import os
+
+from .base import MXNetError
+
+_REGISTRY = {}
+
+
+class _Var:
+    __slots__ = ("name", "vtype", "default", "doc")
+
+    def __init__(self, name, vtype, default, doc):
+        self.name = name
+        self.vtype = vtype
+        self.default = default
+        self.doc = doc
+
+
+def _register(name, vtype, default, doc):
+    _REGISTRY[name] = _Var(name, vtype, default, doc)
+
+
+def get(name):
+    """Typed value of a registered env var (default when unset)."""
+    var = _REGISTRY.get(name)
+    if var is None:
+        raise MXNetError(f"unknown config variable {name!r}; see "
+                         "mxnet_tpu.config.describe()")
+    raw = os.environ.get(name)
+    if raw is None:
+        return var.default
+    try:
+        if var.vtype is bool:
+            low = raw.strip().lower()
+            if low in ("1", "true", "yes", "on"):
+                return True
+            if low in ("0", "false", "no", "off", ""):
+                return False
+            raise ValueError(raw)
+        return var.vtype(raw)
+    except (TypeError, ValueError) as e:
+        raise MXNetError(
+            f"config variable {name}={raw!r} is not a valid "
+            f"{var.vtype.__name__}") from e
+
+
+def list_vars():
+    return sorted(_REGISTRY)
+
+
+def describe():
+    """env_var.md-style table of every registered variable."""
+    lines = [f"{'Variable':<40}{'Type':<8}{'Default':<18}Description"]
+    for name in list_vars():
+        v = _REGISTRY[name]
+        lines.append(f"{name:<40}{v.vtype.__name__:<8}"
+                     f"{str(v.default):<18}{v.doc}")
+    return "\n".join(lines)
+
+
+# -- engine / execution ------------------------------------------------------
+_register("MXNET_ENGINE_TYPE", str, "ThreadedEnginePerDevice",
+          "NaiveEngine blocks after every op (serial debugging, parity: "
+          "src/engine/naive_engine.cc)")
+_register("MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN", int, 15,
+          "bulking hint kept for API parity; XLA fuses regardless")
+# -- kvstore / distributed ---------------------------------------------------
+_register("MXNET_KVSTORE_AUTH_TOKEN", str, "",
+          "HMAC key for dist kvstore frames (REQUIRED for non-loopback "
+          "binds)")
+_register("MXNET_KVSTORE_ALLOW_INSECURE", bool, False,
+          "allow non-loopback kvstore bind without auth token (trusted "
+          "networks only)")
+_register("MXNET_KVSTORE_MAX_FRAME", int, 1 << 30,
+          "maximum kvstore wire frame size in bytes")
+_register("MXNET_KVSTORE_HEARTBEAT_INTERVAL", float, 5.0,
+          "worker heartbeat period in seconds (0 disables); feeds "
+          "get_num_dead_node")
+_register("DMLC_ROLE", str, "worker",
+          "process role: worker | server (ps-lite contract)")
+_register("DMLC_RANK", int, 0, "worker rank")
+_register("DMLC_WORKER_ID", int, 0, "alias of DMLC_RANK")
+_register("DMLC_NUM_WORKER", int, 1, "number of workers")
+_register("DMLC_NUM_SERVER", int, 1, "number of servers (always 1 here)")
+_register("DMLC_PS_ROOT_URI", str, "",
+          "kvstore server host; empty = single-process degradation")
+_register("DMLC_PS_ROOT_PORT", int, 9091, "kvstore server port")
+_register("DMLC_PS_BIND_ADDR", str, "127.0.0.1",
+          "kvstore server bind address (loopback by default — frames "
+          "are pickle)")
+# -- profiler ---------------------------------------------------------------
+_register("MXNET_PROFILER_XPLANE_DIR", str, "",
+          "directory for jax.profiler xplane traces (TensorBoard/"
+          "perfetto); empty disables the device trace")
+# -- driver / bench ---------------------------------------------------------
+_register("MX_DRYRUN_TIMEOUT", float, 900.0,
+          "subprocess timeout for __graft_entry__.dryrun_multichip")
+_register("BENCH_TIME_BUDGET", float, 1200.0, "bench.py wall budget (s)")
+_register("BENCH_BATCH", int, 32, "bench.py primary batch size")
+_register("BENCH_BATCH2", int, 128,
+          "bench.py second MFU point (0 disables)")
+_register("BENCH_ITERS", int, 20, "bench.py timed iterations")
+_register("BENCH_WARMUP", int, 2, "bench.py warmup iterations")
+_register("BENCH_DTYPE", str, "bfloat16", "bench.py compute dtype")
+_register("BENCH_CALIB_N", int, 4096,
+          "bench.py peak-calibration matmul dimension")

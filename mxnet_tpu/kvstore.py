@@ -317,6 +317,13 @@ class KVStoreDist(KVStore):
                                       pickle.dumps(optimizer))
         self._client.barrier()
 
+    def get_num_dead_node(self, node_id=0, timeout=60):
+        """Number of workers whose heartbeats stopped (parity:
+        KVStore::get_num_dead_node, include/mxnet/kvstore.h:353)."""
+        if self._client is None:
+            return 0
+        return self._client.num_dead_node(timeout)
+
     def barrier(self):
         if self._client is not None:
             self._client.barrier()

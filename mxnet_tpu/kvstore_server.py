@@ -22,6 +22,7 @@ import pickle
 import socket
 import struct
 import threading
+import time
 
 import numpy as np
 
@@ -93,9 +94,10 @@ class KVServer:
             os.environ.get("DMLC_PS_BIND_ADDR", "127.0.0.1")
         self.auth_token = auth_token if auth_token is not None else \
             os.environ.get("MXNET_KVSTORE_AUTH_TOKEN", "")
+        from .config import get as _cfg
         if (self.bind_addr not in ("127.0.0.1", "localhost", "::1")
                 and not self.auth_token
-                and os.environ.get("MXNET_KVSTORE_ALLOW_INSECURE") != "1"):
+                and not _cfg("MXNET_KVSTORE_ALLOW_INSECURE")):
             raise RuntimeError(
                 "KVServer: refusing to bind a non-loopback address "
                 f"({self.bind_addr}) without MXNET_KVSTORE_AUTH_TOKEN — "
@@ -106,6 +108,10 @@ class KVServer:
         self.store = {}           # key -> np.ndarray
         self.updater = None
         self.optimizer = None
+        # failure detection (parity: ps-lite heartbeats surfaced as
+        # KVStore::get_num_dead_node, include/mxnet/kvstore.h:353)
+        self._heartbeats = {}     # rank -> last heartbeat monotonic time
+        self._start_time = time.monotonic()
         self._agg = {}            # key -> (sum, count) for sync mode
         self._version = {}        # key -> completed sync rounds
         self._barrier_count = 0
@@ -225,6 +231,26 @@ class KVServer:
                         val = val[np.asarray(rows).astype(np.int64)]
                     _send_msg(conn, {"ok": True, "value": val},
                               self.auth_token)
+            elif op == "heartbeat":
+                with self._lock:
+                    self._heartbeats[int(msg["rank"])] = time.monotonic()
+                _send_msg(conn, {"ok": True}, self.auth_token)
+            elif op == "num_dead_node":
+                timeout = float(msg.get("timeout", 60))
+                now = time.monotonic()
+                with self._lock:
+                    dead = 0
+                    for rank in range(self.num_workers):
+                        last = self._heartbeats.get(rank)
+                        if last is None:
+                            # never announced: dead once the grace
+                            # period from server start elapses
+                            if now - self._start_time > timeout:
+                                dead += 1
+                        elif now - last > timeout:
+                            dead += 1
+                _send_msg(conn, {"ok": True, "value": dead},
+                          self.auth_token)
             elif op == "barrier":
                 with self._barrier_cv:
                     self._barrier_count += 1
@@ -262,23 +288,68 @@ class KVServer:
 class KVClient:
     """Worker-side connection (parity: ps::KVWorker)."""
 
-    def __init__(self, host, port, rank, num_workers, timeout=120):
+    def __init__(self, host, port, rank, num_workers, timeout=120,
+                 heartbeat_interval=None):
         self.rank = rank
         self.num_workers = num_workers
         self._push_counts = {}    # key -> sync pushes sent (pull versioning)
-        self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self.sock.settimeout(timeout)
-        import time
+        self._host, self._port = host, port
+        self._timeout = timeout
+        self.sock = self._connect(timeout)
+        self._lock = threading.Lock()
+        # heartbeat loop announcing liveness (ps-lite van heartbeats) on
+        # its OWN connection — a barrier or versioned pull can block the
+        # main RPC socket for up to 100s and must not stall liveness.
+        # interval 0 disables (some tests drive heartbeats manually)
+        if heartbeat_interval is not None:
+            self._hb_interval = float(heartbeat_interval)
+        else:
+            from .config import get as _cfg
+            self._hb_interval = _cfg("MXNET_KVSTORE_HEARTBEAT_INTERVAL")
+        self._hb_stop = threading.Event()
+        self._hb_sock = None
+        self._hb_lock = threading.Lock()
+        if self._hb_interval > 0:
+            self.heartbeat()
+            t = threading.Thread(target=self._heartbeat_loop, daemon=True)
+            t.start()
+
+    def _connect(self, timeout):
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.settimeout(timeout)
         deadline = time.time() + timeout
         while True:
             try:
-                self.sock.connect((host, port))
-                break
+                sock.connect((self._host, self._port))
+                return sock
             except (ConnectionRefusedError, socket.timeout):
                 if time.time() > deadline:
                     raise
                 time.sleep(0.1)
-        self._lock = threading.Lock()
+
+    def _heartbeat_loop(self):
+        while not self._hb_stop.wait(self._hb_interval):
+            try:
+                self.heartbeat()
+            except Exception:
+                return  # connection gone; the owner will notice
+
+    def heartbeat(self):
+        with self._hb_lock:
+            if self._hb_sock is None:
+                self._hb_sock = self._connect(self._timeout)
+            _send_msg(self._hb_sock, {"op": "heartbeat",
+                                      "rank": self.rank})
+            resp = _recv_msg(self._hb_sock)
+        if resp is None or not resp.get("ok"):
+            raise RuntimeError("heartbeat rpc failed")
+
+    def num_dead_node(self, timeout=60):
+        return int(self._rpc({"op": "num_dead_node",
+                              "timeout": timeout})["value"])
+
+    def close(self):
+        self._hb_stop.set()
 
     def _rpc(self, msg):
         with self._lock:

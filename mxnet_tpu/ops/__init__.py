@@ -15,4 +15,5 @@ from . import _op_optimizer  # noqa: F401
 from . import _op_linalg  # noqa: F401
 from . import _op_contrib  # noqa: F401
 from . import _op_quantization  # noqa: F401
+from . import _op_spatial  # noqa: F401
 from . import pallas_attention  # noqa: F401

@@ -595,3 +595,144 @@ def _roi_pooling(attrs, data, rois):
 @register("_contrib_div_sqrt_dim", alias=("div_sqrt_dim",))
 def _contrib_div_sqrt_dim(attrs, data):
     return data / jnp.sqrt(jnp.asarray(data.shape[-1], data.dtype))
+
+
+# ---------------------------------------------------------------------------
+# Proposal / MultiProposal (RPN)
+# ---------------------------------------------------------------------------
+def _generate_base_anchors(stride, scales, ratios):
+    """py_faster_rcnn anchor generation (proposal.cc GenerateAnchors):
+    base box [0,0,stride-1,stride-1], ratio sweep then scale sweep."""
+    base = stride
+    x_ctr = (base - 1) * 0.5
+    size = base * base
+    anchors = []
+    for r in ratios:
+        size_r = size / r
+        ws = round(size_r ** 0.5)
+        hs = round(ws * r)
+        for s in scales:
+            w, h = ws * s, hs * s
+            anchors.append([x_ctr - 0.5 * (w - 1), x_ctr - 0.5 * (h - 1),
+                            x_ctr + 0.5 * (w - 1), x_ctr + 0.5 * (h - 1)])
+    import numpy as np
+    return np.asarray(anchors, np.float32)        # (A, 4)
+
+
+def _proposal_one(scores, bbox_deltas, im_info, anchors, *, stride,
+                  pre_nms, post_nms, nms_thresh, min_size):
+    """One image of ProposalForward (proposal.cc:316-414).
+
+    scores (A,H,W) foreground scores, bbox_deltas (4A,H,W), im_info
+    (3,) = [height, width, scale]; anchors (A,4) base anchors.
+    Returns rois (post_nms, 4) and scores (post_nms,)."""
+    a, h, w = scores.shape
+    sx = jnp.arange(w, dtype=jnp.float32) * stride
+    sy = jnp.arange(h, dtype=jnp.float32) * stride
+    shift = jnp.stack(
+        [jnp.tile(sx[None, :], (h, 1)), jnp.tile(sy[:, None], (1, w)),
+         jnp.tile(sx[None, :], (h, 1)), jnp.tile(sy[:, None], (1, w))],
+        axis=-1)                                     # (H,W,4)
+    all_anchors = (anchors[None, None] + shift[:, :, None]) \
+        .reshape(-1, 4)                              # (H*W*A, 4)
+
+    deltas = bbox_deltas.reshape(a, 4, h, w).transpose(2, 3, 0, 1) \
+        .reshape(-1, 4)                              # (H*W*A, 4)
+    score = scores.transpose(1, 2, 0).reshape(-1)    # (H*W*A,)
+
+    # decode (pixel convention with the +1 widths, proposal.cc
+    # BBoxTransformInv)
+    ws = all_anchors[:, 2] - all_anchors[:, 0] + 1.0
+    hs = all_anchors[:, 3] - all_anchors[:, 1] + 1.0
+    cx = all_anchors[:, 0] + 0.5 * (ws - 1.0)
+    cy = all_anchors[:, 1] + 0.5 * (hs - 1.0)
+    pcx = deltas[:, 0] * ws + cx
+    pcy = deltas[:, 1] * hs + cy
+    pw = jnp.exp(deltas[:, 2]) * ws
+    ph = jnp.exp(deltas[:, 3]) * hs
+    x1 = pcx - 0.5 * (pw - 1.0)
+    y1 = pcy - 0.5 * (ph - 1.0)
+    x2 = pcx + 0.5 * (pw - 1.0)
+    y2 = pcy + 0.5 * (ph - 1.0)
+    # clip to image
+    x1 = jnp.clip(x1, 0, im_info[1] - 1.0)
+    y1 = jnp.clip(y1, 0, im_info[0] - 1.0)
+    x2 = jnp.clip(x2, 0, im_info[1] - 1.0)
+    y2 = jnp.clip(y2, 0, im_info[0] - 1.0)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1)
+
+    # min-size filter (scaled by im_info[2])
+    msize = min_size * im_info[2]
+    valid = ((x2 - x1 + 1.0) >= msize) & ((y2 - y1 + 1.0) >= msize)
+    score = jnp.where(valid, score, -jnp.inf)
+
+    n = boxes.shape[0]
+    k_pre = min(pre_nms, n) if pre_nms > 0 else n
+    order = jnp.argsort(-score)[:k_pre]
+    sboxes = boxes[order]
+    sscore = score[order]
+    svalid = jnp.isfinite(sscore)
+
+    # pixel-convention IoU (+1 widths) matching proposal.cc NMS, not the
+    # normalised-corner IoU the rest of the contrib family uses
+    tl = jnp.maximum(sboxes[:, None, :2], sboxes[None, :, :2])
+    br = jnp.minimum(sboxes[:, None, 2:], sboxes[None, :, 2:])
+    wh = jnp.maximum(br - tl + 1.0, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area = ((sboxes[:, 2] - sboxes[:, 0] + 1.0)
+            * (sboxes[:, 3] - sboxes[:, 1] + 1.0))
+    union = area[:, None] + area[None, :] - inter
+    iou = jnp.where(union <= 0, 0.0, inter / union)
+    later = jnp.arange(k_pre)[None, :] > jnp.arange(k_pre)[:, None]
+    sup = (iou > nms_thresh) & later
+
+    def body(i, keep):
+        return jnp.where(keep[i], keep & ~sup[i], keep)
+
+    keep = lax.fori_loop(0, k_pre, body, svalid)
+    # compact kept indices to the front; pad by cycling (proposal.cc:414
+    # keep[i % out_size])
+    pos = jnp.cumsum(keep) - 1
+    kept_idx = jnp.zeros(k_pre, jnp.int32).at[
+        jnp.where(keep, pos, k_pre)].set(jnp.arange(k_pre),
+                                         mode="drop")
+    out_size = jnp.maximum(keep.sum(), 1)
+    sel = kept_idx[jnp.mod(jnp.arange(post_nms), out_size)]
+    return sboxes[sel], sscore[sel]
+
+
+@register("_contrib_Proposal", alias=("Proposal", "_contrib_MultiProposal",
+                                      "MultiProposal"),
+          num_outputs="_dynamic")
+def _contrib_proposal(attrs, cls_prob, bbox_pred, im_info):
+    """RPN proposals (proposal.cc / multi_proposal.cc): cls_prob
+    (B,2A,H,W) with foreground scores in the second half, bbox_pred
+    (B,4A,H,W), im_info (B,3).  Returns rois (B*post_nms, 5) with batch
+    index; + scores when output_score."""
+    import numpy as np
+    stride = int(attrs.get("feature_stride", 16))
+    scales = tuple(float(s) for s in attrs.get("scales", (4, 8, 16, 32)))
+    ratios = tuple(float(r) for r in attrs.get("ratios", (0.5, 1, 2)))
+    pre_nms = int(attrs.get("rpn_pre_nms_top_n", 6000))
+    post_nms = int(attrs.get("rpn_post_nms_top_n", 300))
+    nms_thresh = float(attrs.get("threshold", 0.7))
+    min_size = float(attrs.get("rpn_min_size", 16))
+    if bool(attrs.get("iou_loss", False)):
+        raise NotImplementedError("Proposal: iou_loss decoding is not "
+                                  "supported")
+    anchors = jnp.asarray(_generate_base_anchors(stride, scales, ratios))
+    a = anchors.shape[0]
+    fg = cls_prob[:, a:, :, :]                       # (B,A,H,W)
+
+    rois, scores = jax.vmap(
+        lambda s, d, ii: _proposal_one(
+            s, d, ii, anchors, stride=stride, pre_nms=pre_nms,
+            post_nms=post_nms, nms_thresh=nms_thresh,
+            min_size=min_size))(fg, bbox_pred, im_info)
+    b = rois.shape[0]
+    batch_idx = jnp.repeat(jnp.arange(b, dtype=rois.dtype), post_nms)
+    rois_out = jnp.concatenate(
+        [batch_idx[:, None], rois.reshape(-1, 4)], axis=1)
+    if bool(attrs.get("output_score", False)):
+        return rois_out, scores.reshape(-1, 1)
+    return rois_out

@@ -1,0 +1,168 @@
+"""Spatial-transformer op family + FFT.
+
+Reference: src/operator/bilinear_sampler.cc (BilinearSampler),
+grid_generator-inl.h (GridGenerator affine/warp),
+spatial_transformer-inl.h (SpatialTransformer: affine grid + bilinear
+sampling, target grid -1..1 inclusive i.e. align-corners),
+correlation-inl.h (FlowNet correlation volume), contrib/fft-inl.h +
+ifft-inl.h (cuFFT C2C; ifft is UNNORMALIZED — the reference's
+`out /= dim_` is commented out).
+
+TPU redesign: sampling is gather-based bilinear interpolation (JAX AD
+produces the scatter-add backward the reference hand-writes in
+bilinear_sampler.cu); the correlation volume is a displacement loop of
+fused multiply + box-filter convs (D² is small); FFT lowers to XLA's
+native fft HLO instead of a cuFFT plan pool.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+def _bilinear_sample_zero_pad(data, x_src, y_src):
+    """Sample data (B,C,H,W) at real-valued pixel coords x_src/y_src
+    (B,Ho,Wo); out-of-bounds corners contribute zero (reference
+    BilinearSamplerForward corner-validity checks)."""
+    b, c, h, w = data.shape
+    x0 = jnp.floor(x_src)
+    y0 = jnp.floor(y_src)
+    outs = 0.0
+    for dy in (0, 1):
+        for dx in (0, 1):
+            xi = x0 + dx
+            yi = y0 + dy
+            wgt = ((1 - jnp.abs(x_src - xi)) *
+                   (1 - jnp.abs(y_src - yi)))          # bilinear weight
+            valid = (xi >= 0) & (xi <= w - 1) & (yi >= 0) & (yi <= h - 1)
+            xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+            yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+            # gather per batch: (B,C,Ho,Wo)
+            vals = jax.vmap(
+                lambda d, yy, xx: d[:, yy, xx])(data, yc, xc)
+            outs = outs + vals * (wgt * valid)[:, None]
+    return outs
+
+
+@register("BilinearSampler")
+def _bilinear_sampler(attrs, data, grid):
+    """data (B,C,H,W), grid (B,2,Ho,Wo) with x=grid[:,0], y=grid[:,1] in
+    [-1,1] (align-corners normalisation, bilinear_sampler-inl.h)."""
+    b, c, h, w = data.shape
+    x_src = (grid[:, 0] + 1) * (w - 1) / 2
+    y_src = (grid[:, 1] + 1) * (h - 1) / 2
+    return _bilinear_sample_zero_pad(data, x_src, y_src)
+
+
+def _affine_grid(theta, target_shape, dtype):
+    """(B,6) affine params -> (B,2,H,W) source coords in [-1,1]
+    (spatial_transformer-inl.h:99 target grid, align-corners)."""
+    ho, wo = target_shape
+    ys = jnp.linspace(-1.0, 1.0, ho, dtype=dtype)
+    xs = jnp.linspace(-1.0, 1.0, wo, dtype=dtype)
+    gx, gy = jnp.meshgrid(xs, ys)                      # (H,W)
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=0).reshape(3, -1)  # (3, H*W)
+    t = theta.reshape(-1, 2, 3)
+    src = jnp.einsum("bij,jk->bik", t, base)           # (B,2,H*W)
+    return src.reshape(-1, 2, ho, wo)
+
+
+@register("GridGenerator")
+def _grid_generator(attrs, data):
+    ttype = attrs.get("transform_type", "affine")
+    dtype = data.dtype
+    if ttype == "affine":
+        ho, wo = (int(s) for s in attrs["target_shape"])
+        return _affine_grid(data, (ho, wo), dtype)
+    if ttype == "warp":
+        # data = flow (B,2,H,W) in pixels; normalised absolute coords out
+        b, _, h, w = data.shape
+        gx = jnp.arange(w, dtype=dtype)[None, None, :]
+        gy = jnp.arange(h, dtype=dtype)[None, :, None]
+        x = (data[:, 0] + gx) * 2 / max(w - 1, 1) - 1
+        y = (data[:, 1] + gy) * 2 / max(h - 1, 1) - 1
+        return jnp.stack([x, y], axis=1)
+    raise ValueError(f"unknown transform_type {ttype}")
+
+
+@register("SpatialTransformer")
+def _spatial_transformer(attrs, data, loc):
+    """Affine spatial transformer (data (B,C,H,W), loc (B,6))."""
+    ho, wo = (int(s) for s in attrs["target_shape"])
+    grid = _affine_grid(loc, (ho, wo), data.dtype)
+    return _bilinear_sampler({}, data, grid)
+
+
+@register("Correlation", num_outputs=3, num_visible=1)
+def _correlation(attrs, data1, data2):
+    """FlowNet correlation volume (correlation-inl.h).  Output channels
+    enumerate the (2*max_displacement/stride2+1)^2 displacement grid;
+    each is the kernel-window mean of data1·shift(data2) (is_multiply)
+    or -|data1-shift(data2)|.  Hidden outputs tmp1/tmp2 mirror the
+    reference's rearranged-patch workspaces (ListOutputs
+    correlation-inl.h:175, NumVisibleOutputs 1)."""
+    k = int(attrs.get("kernel_size", 1))
+    max_d = int(attrs.get("max_displacement", 1))
+    s1 = int(attrs.get("stride1", 1))
+    s2 = int(attrs.get("stride2", 1))
+    pad = int(attrs.get("pad_size", 0))
+    multiply = bool(attrs.get("is_multiply", True))
+    b, c, h, w = data1.shape
+    kr = k // 2                                  # kernel radius
+    border = max_d + kr
+    p1 = jnp.pad(data1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    p2 = jnp.pad(data2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    ph, pw = h + 2 * pad, w + 2 * pad
+    # output spatial positions x1 = border + i*s1 (correlation-inl.h
+    # top_height = ceil((paddedheight - border*2) / stride1))
+    ho = -(-(ph - 2 * border) // s1)
+    wo = -(-(pw - 2 * border) // s1)
+    sumelems = k * k * c
+    box = jnp.ones((1, 1, k, k), data1.dtype) / sumelems
+
+    if max_d % s1:
+        raise ValueError("Correlation: max_displacement must be a "
+                         "multiple of stride1")
+    maps = []
+    for dy in range(-max_d, max_d + 1, s2):
+        for dx in range(-max_d, max_d + 1, s2):
+            shifted = jnp.roll(p2, (-dy, -dx), axis=(2, 3))
+            prod = p1 * shifted if multiply else \
+                -jnp.abs(p1 - shifted)
+            prod = prod.sum(axis=1, keepdims=True)   # (B,1,ph,pw)
+            # kernel-window mean at the output stride; conv output t has
+            # window centre t*s1 + kr, we need centres border + i*s1
+            m = lax.conv_general_dilated(
+                prod, box, window_strides=(s1, s1),
+                padding=[(0, 0), (0, 0)],
+                dimension_numbers=lax.conv_dimension_numbers(
+                    prod.shape, box.shape, ("NCHW", "OIHW", "NCHW")))
+            start = max_d // s1
+            maps.append(m[:, :, start:start + ho, start:start + wo])
+    out = jnp.concatenate(maps, axis=1)
+    return out, p1, p2
+
+
+@register("_contrib_fft", alias=("fft",))
+def _contrib_fft(attrs, data):
+    """1D FFT over the last axis; complex output interleaved as
+    [..., re0, im0, re1, im1, ...] (contrib/fft-inl.h layout)."""
+    f = jnp.fft.fft(data.astype(jnp.float32), axis=-1)
+    out = jnp.stack([f.real, f.imag], axis=-1)
+    return out.reshape(data.shape[:-1] + (2 * data.shape[-1],)) \
+        .astype(jnp.float32)
+
+
+@register("_contrib_ifft", alias=("ifft",))
+def _contrib_ifft(attrs, data):
+    """Inverse of _contrib_fft, UNNORMALIZED like the reference's cuFFT
+    C2C inverse (ifft-inl.h:136 has the normalisation commented out):
+    ifft(fft(x)) == d * x."""
+    d = data.shape[-1] // 2
+    c = data.reshape(data.shape[:-1] + (d, 2))
+    z = c[..., 0] + 1j * c[..., 1]
+    return (jnp.fft.ifft(z, axis=-1) * d).real.astype(jnp.float32)

@@ -330,3 +330,44 @@ def test_box_nms_symbolic():
     res = ex.forward()[0].asnumpy()
     assert res[0, 0, 0] == pytest.approx(0.9)
     assert res[0, 1, 0] == -1.0
+
+
+def test_proposal_basic():
+    """RPN Proposal: decoded/clipped boxes, NMS, cyclic padding
+    (ref proposal.cc:316-414)."""
+    rng = np.random.RandomState(9)
+    a = 9  # 3 scales x 3 ratios
+    h = w = 4
+    cls_prob = rng.uniform(0, 1, (1, 2 * a, h, w)).astype(np.float32)
+    bbox_pred = (rng.randn(1, 4 * a, h, w) * 0.1).astype(np.float32)
+    im_info = np.array([[64.0, 64.0, 1.0]], np.float32)
+    rois = nd.contrib.Proposal(
+        nd.array(cls_prob), nd.array(bbox_pred), nd.array(im_info),
+        feature_stride=16, scales=(4, 8, 16), ratios=(0.5, 1, 2),
+        rpn_pre_nms_top_n=50, rpn_post_nms_top_n=12, threshold=0.7,
+        rpn_min_size=4).asnumpy()
+    assert rois.shape == (12, 5)
+    assert (rois[:, 0] == 0).all()                # batch index
+    x1, y1, x2, y2 = rois[:, 1], rois[:, 2], rois[:, 3], rois[:, 4]
+    assert (x1 >= 0).all() and (x2 <= 63).all()   # clipped to image
+    assert (y1 >= 0).all() and (y2 <= 63).all()
+    assert ((x2 - x1 + 1) >= 4).all()             # min-size filter
+
+
+def test_proposal_output_score_and_batch():
+    rng = np.random.RandomState(10)
+    a = 3  # 3 ratios x 1 scale
+    cls_prob = rng.uniform(0, 1, (2, 2 * a, 3, 3)).astype(np.float32)
+    bbox_pred = np.zeros((2, 4 * a, 3, 3), np.float32)
+    im_info = np.tile(np.array([48.0, 48.0, 1.0], np.float32), (2, 1))
+    rois, scores = nd.contrib.MultiProposal(
+        nd.array(cls_prob), nd.array(bbox_pred), nd.array(im_info),
+        feature_stride=16, scales=(8,), ratios=(0.5, 1, 2),
+        rpn_pre_nms_top_n=20, rpn_post_nms_top_n=5, output_score=True)
+    rois = rois.asnumpy()
+    scores = scores.asnumpy()
+    assert rois.shape == (10, 5) and scores.shape == (10, 1)
+    np.testing.assert_array_equal(rois[:5, 0], 0)
+    np.testing.assert_array_equal(rois[5:, 0], 1)
+    # scores sorted desc within each image (pre-NMS order preserved)
+    assert scores[0, 0] >= scores[1, 0]

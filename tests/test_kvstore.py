@@ -132,3 +132,33 @@ def test_dist_sync_localhost(tmp_path):
         np.testing.assert_allclose(r, 3.0)
     # bit-exact across workers (parity: dist_sync_kvstore.py assertion)
     np.testing.assert_array_equal(results[0], results[1])
+
+
+def test_heartbeat_dead_node_detection():
+    """PS failure detection: a worker that stops heartbeating is
+    reported by get_num_dead_node (parity: ps-lite heartbeats,
+    include/mxnet/kvstore.h:353)."""
+    from mxnet_tpu.kvstore_server import KVClient, KVServer
+    port = 19557
+    server = KVServer(port=port, num_workers=2)
+    t = threading.Thread(target=server.run, daemon=True)
+    t.start()
+    time.sleep(0.2)
+    try:
+        # manual heartbeats so the test controls time precisely
+        c0 = KVClient("127.0.0.1", port, rank=0, num_workers=2,
+                      heartbeat_interval=0)
+        c1 = KVClient("127.0.0.1", port, rank=1, num_workers=2,
+                      heartbeat_interval=0)
+        c0.heartbeat()
+        c1.heartbeat()
+        assert c0.num_dead_node(timeout=5) == 0
+        # rank 1 goes silent; rank 0 keeps beating
+        time.sleep(1.2)
+        c0.heartbeat()
+        assert c0.num_dead_node(timeout=1.0) == 1
+        # rank 1 recovers
+        c1.heartbeat()
+        assert c0.num_dead_node(timeout=1.0) == 0
+    finally:
+        server._stop.set()

@@ -1,0 +1,188 @@
+"""Spatial-transformer family + FFT (reference:
+src/operator/bilinear_sampler.cc, grid_generator-inl.h,
+spatial_transformer-inl.h, correlation-inl.h, contrib/fft-inl.h)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _np_bilinear_sample(data, x_src, y_src):
+    b, c, h, w = data.shape
+    _, ho, wo = x_src.shape
+    out = np.zeros((b, c, ho, wo), np.float32)
+    for bi in range(b):
+        for i in range(ho):
+            for j in range(wo):
+                x, y = x_src[bi, i, j], y_src[bi, i, j]
+                x0, y0 = int(np.floor(x)), int(np.floor(y))
+                for dy in (0, 1):
+                    for dx in (0, 1):
+                        xi, yi = x0 + dx, y0 + dy
+                        if 0 <= xi <= w - 1 and 0 <= yi <= h - 1:
+                            wgt = (1 - abs(x - xi)) * (1 - abs(y - yi))
+                            out[bi, :, i, j] += wgt * data[bi, :, yi, xi]
+    return out
+
+
+def test_bilinear_sampler_vs_numpy():
+    rng = np.random.RandomState(0)
+    data = rng.randn(2, 3, 5, 6).astype(np.float32)
+    grid = rng.uniform(-1.2, 1.2, (2, 2, 4, 4)).astype(np.float32)
+    got = nd.BilinearSampler(nd.array(data), nd.array(grid)).asnumpy()
+    x_src = (grid[:, 0] + 1) * (6 - 1) / 2
+    y_src = (grid[:, 1] + 1) * (5 - 1) / 2
+    want = _np_bilinear_sample(data, x_src, y_src)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_bilinear_sampler_identity_grid():
+    rng = np.random.RandomState(1)
+    data = rng.randn(1, 2, 4, 4).astype(np.float32)
+    ys, xs = np.meshgrid(np.linspace(-1, 1, 4), np.linspace(-1, 1, 4),
+                         indexing="ij")
+    grid = np.stack([xs, ys])[None].astype(np.float32)
+    out = nd.BilinearSampler(nd.array(data), nd.array(grid)).asnumpy()
+    np.testing.assert_allclose(out, data, rtol=1e-5, atol=1e-5)
+
+
+def test_bilinear_sampler_grads():
+    rng = np.random.RandomState(2)
+    data = nd.array(rng.randn(1, 1, 4, 4).astype(np.float32))
+    grid = nd.array(rng.uniform(-0.8, 0.8, (1, 2, 3, 3))
+                    .astype(np.float32))
+    data.attach_grad()
+    grid.attach_grad()
+    with mx.autograd.record():
+        out = nd.BilinearSampler(data, grid)
+        loss = (out * out).sum()
+    loss.backward()
+    assert np.abs(data.grad.asnumpy()).max() > 0
+    assert np.abs(grid.grad.asnumpy()).max() > 0
+
+
+def test_grid_generator_affine_identity():
+    theta = nd.array(np.array([[1, 0, 0, 0, 1, 0]], np.float32))
+    grid = nd.GridGenerator(theta, transform_type="affine",
+                            target_shape=(3, 4)).asnumpy()
+    assert grid.shape == (1, 2, 3, 4)
+    np.testing.assert_allclose(grid[0, 0, 0], np.linspace(-1, 1, 4),
+                               atol=1e-6)
+    np.testing.assert_allclose(grid[0, 1, :, 0], np.linspace(-1, 1, 3),
+                               atol=1e-6)
+
+
+def test_grid_generator_warp_zero_flow_is_identity():
+    flow = nd.zeros((1, 2, 3, 5))
+    grid = nd.GridGenerator(flow, transform_type="warp").asnumpy()
+    np.testing.assert_allclose(grid[0, 0, 0], np.linspace(-1, 1, 5),
+                               atol=1e-6)
+
+
+def test_spatial_transformer_identity():
+    rng = np.random.RandomState(3)
+    data = rng.randn(2, 3, 6, 6).astype(np.float32)
+    loc = np.tile(np.array([1, 0, 0, 0, 1, 0], np.float32), (2, 1))
+    out = nd.SpatialTransformer(
+        nd.array(data), nd.array(loc), target_shape=(6, 6),
+        transform_type="affine", sampler_type="bilinear").asnumpy()
+    np.testing.assert_allclose(out, data, rtol=1e-4, atol=1e-4)
+
+
+def test_spatial_transformer_zoom():
+    # zoom-in by 2x around the centre: sampled coords span [-.5, .5]
+    rng = np.random.RandomState(4)
+    data = rng.randn(1, 1, 8, 8).astype(np.float32)
+    loc = np.array([[0.5, 0, 0, 0, 0.5, 0]], np.float32)
+    out = nd.SpatialTransformer(
+        nd.array(data), nd.array(loc), target_shape=(8, 8),
+        transform_type="affine", sampler_type="bilinear").asnumpy()
+    # target pixel (4,4) sits at normalised 2*4/7-1; the 0.5x affine
+    # halves it, mapping back to source pixel (norm+1)*3.5
+    src = ((0.5 * (2 * 4 / 7 - 1)) + 1) * 3.5
+    want = _np_bilinear_sample(data, np.array([[[src]]]),
+                               np.array([[[src]]]))[0, 0, 0, 0]
+    np.testing.assert_allclose(out[0, 0, 4, 4], want, rtol=1e-4)
+
+
+def _np_correlation(d1, d2, max_d, k, s1, s2, pad, multiply=True):
+    b, c, h, w = d1.shape
+    kr = k // 2
+    border = max_d + kr
+    p1 = np.pad(d1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    p2 = np.pad(d2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    ph, pw = h + 2 * pad, w + 2 * pad
+    ho = -(-(ph - 2 * border) // s1)
+    wo = -(-(pw - 2 * border) // s1)
+    disps = [(dy, dx) for dy in range(-max_d, max_d + 1, s2)
+             for dx in range(-max_d, max_d + 1, s2)]
+    out = np.zeros((b, len(disps), ho, wo), np.float32)
+    for bi in range(b):
+        for di, (dy, dx) in enumerate(disps):
+            for i in range(ho):
+                for j in range(wo):
+                    y1 = border + i * s1
+                    x1 = border + j * s1
+                    acc = 0.0
+                    for ky in range(-kr, kr + 1):
+                        for kx in range(-kr, kr + 1):
+                            a = p1[bi, :, y1 + ky, x1 + kx]
+                            bb = p2[bi, :, y1 + dy + ky, x1 + dx + kx]
+                            acc += (a * bb).sum() if multiply else \
+                                -np.abs(a - bb).sum()
+                    out[bi, di, i, j] = acc / (k * k * c)
+    return out
+
+
+@pytest.mark.parametrize("multiply", [True, False])
+def test_correlation_vs_numpy(multiply):
+    rng = np.random.RandomState(5)
+    d1 = rng.randn(1, 2, 6, 6).astype(np.float32)
+    d2 = rng.randn(1, 2, 6, 6).astype(np.float32)
+    got = nd.Correlation(nd.array(d1), nd.array(d2), kernel_size=3,
+                         max_displacement=1, stride1=1, stride2=1,
+                         pad_size=2, is_multiply=multiply).asnumpy()
+    want = _np_correlation(d1, d2, 1, 3, 1, 1, 2, multiply)
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_correlation_self_peak_at_zero_displacement():
+    # correlating a tensor with itself peaks at zero displacement
+    rng = np.random.RandomState(6)
+    d = rng.randn(1, 4, 8, 8).astype(np.float32)
+    out = nd.Correlation(nd.array(d), nd.array(d), kernel_size=1,
+                         max_displacement=1, stride1=1, stride2=1,
+                         pad_size=1).asnumpy()
+    # in aggregate, the zero-displacement channel (index 4 of the 3x3
+    # grid) carries the most correlation energy
+    energies = out.sum(axis=(0, 2, 3))
+    assert energies.argmax() == 4
+
+
+def test_fft_ifft_roundtrip_and_oracle():
+    rng = np.random.RandomState(7)
+    x = rng.randn(3, 8).astype(np.float32)
+    f = nd.contrib.fft(nd.array(x)).asnumpy()
+    ref = np.fft.fft(x, axis=-1)
+    np.testing.assert_allclose(f[:, 0::2], ref.real, rtol=1e-4,
+                               atol=1e-4)
+    np.testing.assert_allclose(f[:, 1::2], ref.imag, rtol=1e-4,
+                               atol=1e-4)
+    # unnormalized inverse: ifft(fft(x)) == d * x (reference cuFFT C2C)
+    back = nd.contrib.ifft(nd.array(f)).asnumpy()
+    np.testing.assert_allclose(back, 8 * x, rtol=1e-4, atol=1e-3)
+
+
+def test_fft_gradient_flows():
+    rng = np.random.RandomState(8)
+    x = nd.array(rng.randn(2, 8).astype(np.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        loss = (nd.contrib.fft(x) ** 2).sum()
+    loss.backward()
+    # Parseval: d/dx sum(|F x|^2) = 2*d*x
+    np.testing.assert_allclose(x.grad.asnumpy(),
+                               2 * 8 * x.asnumpy(), rtol=1e-3,
+                               atol=1e-3)

@@ -119,3 +119,20 @@ def test_pyproject_metadata():
         meta = tomllib.load(f)
     assert meta["project"]["name"] == "mxnet-tpu"
     assert "jax>=0.6" in meta["project"]["dependencies"]
+
+
+def test_config_registry():
+    import mxnet_tpu as mx
+    cfg = mx.config
+    assert cfg.get("DMLC_PS_ROOT_PORT") == 9091
+    os.environ["MXNET_KVSTORE_HEARTBEAT_INTERVAL"] = "2.5"
+    try:
+        assert cfg.get("MXNET_KVSTORE_HEARTBEAT_INTERVAL") == 2.5
+    finally:
+        del os.environ["MXNET_KVSTORE_HEARTBEAT_INTERVAL"]
+    table = cfg.describe()
+    assert "MXNET_ENGINE_TYPE" in table
+    assert len(cfg.list_vars()) >= 20
+    from mxnet_tpu.base import MXNetError
+    with pytest.raises(MXNetError):
+        cfg.get("MXNET_NO_SUCH_VAR")
