@@ -1,3 +1,4 @@
 """mx.contrib — experimental subsystems (parity: python/mxnet/contrib/)."""
 from . import quantization  # noqa: F401
+from . import onnx  # noqa: F401
 from .. import amp  # noqa: F401  (reference exposes contrib.amp)

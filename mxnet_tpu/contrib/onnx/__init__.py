@@ -1,0 +1,9 @@
+"""ONNX interop (parity: reference python/mxnet/contrib/onnx/__init__.py).
+
+Self-contained: carries its own protobuf wire codec (_proto.py) so neither
+the `onnx` nor `protobuf` packages are required. Files written here are
+standard ONNX protobufs (opset 13) readable by onnxruntime/netron.
+"""
+from .mx2onnx import export_model, graph_to_onnx
+from .onnx2mx import (import_model, get_model_metadata, graph_from_onnx,
+                      import_to_gluon)

@@ -1,0 +1,518 @@
+"""Minimal ONNX protobuf wire-format codec (no onnx/protobuf dependency).
+
+The reference ships ONNX interop in python/mxnet/contrib/onnx/ on top of the
+`onnx` pip package. This environment has no `onnx`, so the TPU framework
+carries its own self-contained encoder/decoder for the (small, stable) subset
+of onnx.proto that model serialization needs: ModelProto / GraphProto /
+NodeProto / AttributeProto / TensorProto / ValueInfoProto. The files produced
+here are byte-level valid ONNX protobufs readable by onnxruntime/netron, and
+the decoder reads files produced by torch.onnx / tf2onnx / onnx itself
+(unknown fields are skipped, as protobuf semantics require).
+
+Field numbers follow onnx.proto3 (ONNX IR; unchanged since IR version 3).
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+# --- TensorProto.DataType enum (onnx.proto3) --------------------------------
+UNDEFINED = 0
+FLOAT = 1
+UINT8 = 2
+INT8 = 3
+UINT16 = 4
+INT16 = 5
+INT32 = 6
+INT64 = 7
+STRING = 8
+BOOL = 9
+FLOAT16 = 10
+DOUBLE = 11
+UINT32 = 12
+UINT64 = 13
+BFLOAT16 = 16
+
+_NP_TO_ONNX = {
+    np.dtype(np.float32): FLOAT,
+    np.dtype(np.float64): DOUBLE,
+    np.dtype(np.float16): FLOAT16,
+    np.dtype(np.uint8): UINT8,
+    np.dtype(np.int8): INT8,
+    np.dtype(np.uint16): UINT16,
+    np.dtype(np.int16): INT16,
+    np.dtype(np.int32): INT32,
+    np.dtype(np.int64): INT64,
+    np.dtype(np.uint32): UINT32,
+    np.dtype(np.uint64): UINT64,
+    np.dtype(bool): BOOL,
+}
+_ONNX_TO_NP = {v: k for k, v in _NP_TO_ONNX.items()}
+
+try:  # ml_dtypes ships with jax; bfloat16 round-trips if present
+    import ml_dtypes
+
+    _NP_TO_ONNX[np.dtype(ml_dtypes.bfloat16)] = BFLOAT16
+    _ONNX_TO_NP[BFLOAT16] = np.dtype(ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover
+    pass
+
+
+def np_to_onnx_dtype(dtype):
+    return _NP_TO_ONNX[np.dtype(dtype)]
+
+
+def onnx_to_np_dtype(code):
+    return _ONNX_TO_NP[code]
+
+
+# --- wire primitives --------------------------------------------------------
+def _varint(value):
+    """Encode an unsigned varint."""
+    out = bytearray()
+    while True:
+        b = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _svarint(value):
+    """int64 fields encode negatives as 10-byte two's complement varints."""
+    if value < 0:
+        value += 1 << 64
+    return _varint(value)
+
+
+def _read_varint(buf, pos):
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _to_int64(value):
+    """Interpret a decoded varint as a signed int64."""
+    if value >= 1 << 63:
+        value -= 1 << 64
+    return value
+
+
+def _tag(field, wire):
+    return _varint((field << 3) | wire)
+
+
+def emit_int(field, value):
+    return _tag(field, 0) + _svarint(int(value))
+
+
+def emit_bytes(field, data):
+    return _tag(field, 2) + _varint(len(data)) + bytes(data)
+
+
+def emit_str(field, s):
+    return emit_bytes(field, s.encode("utf-8"))
+
+
+def emit_float(field, value):
+    return _tag(field, 5) + struct.pack("<f", float(value))
+
+
+def emit_packed_ints(field, values):
+    body = b"".join(_svarint(int(v)) for v in values)
+    return emit_bytes(field, body)
+
+
+def emit_packed_floats(field, values):
+    return emit_bytes(field, struct.pack(f"<{len(values)}f", *values))
+
+
+def parse_fields(buf):
+    """Yield (field_number, wire_type, value) for every field in `buf`.
+
+    value is: int for varint (wire 0), bytes for length-delimited (wire 2),
+    4/8 raw bytes for fixed32/64 (wires 5/1). Groups (3/4) are unsupported
+    (ONNX never uses them).
+    """
+    pos, end = 0, len(buf)
+    while pos < end:
+        key, pos = _read_varint(buf, pos)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            value, pos = _read_varint(buf, pos)
+        elif wire == 2:
+            length, pos = _read_varint(buf, pos)
+            value = buf[pos:pos + length]
+            pos += length
+        elif wire == 5:
+            value = buf[pos:pos + 4]
+            pos += 4
+        elif wire == 1:
+            value = buf[pos:pos + 8]
+            pos += 8
+        else:
+            raise ValueError(f"unsupported protobuf wire type {wire}")
+        yield field, wire, value
+
+
+def _unpack_ints(raw):
+    out = []
+    pos = 0
+    while pos < len(raw):
+        v, pos = _read_varint(raw, pos)
+        out.append(_to_int64(v))
+    return out
+
+
+# --- message classes --------------------------------------------------------
+class TensorProto:
+    def __init__(self, name="", dims=(), data_type=FLOAT, raw_data=b""):
+        self.name = name
+        self.dims = list(dims)
+        self.data_type = data_type
+        self.raw_data = raw_data
+
+    @classmethod
+    def from_array(cls, arr, name=""):
+        arr = np.ascontiguousarray(arr)
+        return cls(name=name, dims=arr.shape,
+                   data_type=np_to_onnx_dtype(arr.dtype),
+                   raw_data=arr.tobytes())
+
+    def to_array(self):
+        dtype = onnx_to_np_dtype(self.data_type)
+        arr = np.frombuffer(self.raw_data, dtype=dtype)
+        return arr.reshape(self.dims).copy()
+
+    def encode(self):
+        out = bytearray()
+        for d in self.dims:
+            out += emit_int(1, d)
+        out += emit_int(2, self.data_type)
+        if self.name:
+            out += emit_str(8, self.name)
+        out += emit_bytes(9, self.raw_data)
+        return bytes(out)
+
+    @classmethod
+    def decode(cls, buf):
+        t = cls()
+        float_data, int32_data, int64_data, double_data = [], [], [], []
+        for field, wire, value in parse_fields(buf):
+            if field == 1 and wire == 0:
+                t.dims.append(_to_int64(value))
+            elif field == 1 and wire == 2:  # packed dims
+                t.dims.extend(_unpack_ints(value))
+            elif field == 2:
+                t.data_type = value
+            elif field == 8:
+                t.name = value.decode("utf-8")
+            elif field == 9:
+                t.raw_data = bytes(value)
+            elif field == 4:  # float_data (packed or not)
+                if wire == 2:
+                    float_data.extend(
+                        struct.unpack(f"<{len(value) // 4}f", value))
+                else:
+                    float_data.append(struct.unpack("<f", value)[0])
+            elif field == 5:
+                if wire == 2:
+                    int32_data.extend(_unpack_ints(value))
+                else:
+                    int32_data.append(_to_int64(value))
+            elif field == 7:
+                if wire == 2:
+                    int64_data.extend(_unpack_ints(value))
+                else:
+                    int64_data.append(_to_int64(value))
+            elif field == 10:
+                if wire == 2:
+                    double_data.extend(
+                        struct.unpack(f"<{len(value) // 8}d", value))
+                else:
+                    double_data.append(struct.unpack("<d", value)[0])
+        if not t.raw_data:  # reconstruct from typed repeated fields
+            if float_data:
+                t.raw_data = np.asarray(float_data, np.float32).tobytes()
+            elif int64_data:
+                t.raw_data = np.asarray(int64_data, np.int64).tobytes()
+            elif double_data:
+                t.raw_data = np.asarray(double_data, np.float64).tobytes()
+            elif int32_data:
+                if t.data_type in (FLOAT16, BFLOAT16):
+                    # onnx.proto stores fp16/bf16 as raw 16-bit patterns in
+                    # int32_data — reinterpret bits, don't convert values
+                    t.raw_data = np.asarray(
+                        int32_data, np.uint16).tobytes()
+                else:
+                    np_dt = _ONNX_TO_NP.get(t.data_type, np.dtype(np.int32))
+                    t.raw_data = np.asarray(int32_data).astype(np_dt).tobytes()
+        return t
+
+
+class AttributeProto:
+    # AttributeType enum values
+    A_FLOAT, A_INT, A_STRING, A_TENSOR = 1, 2, 3, 4
+    A_FLOATS, A_INTS, A_STRINGS = 6, 7, 8
+
+    def __init__(self, name, value):
+        self.name = name
+        self.value = value
+
+    def encode(self):
+        out = bytearray(emit_str(1, self.name))
+        v = self.value
+        if isinstance(v, TensorProto):
+            out += emit_bytes(5, v.encode())
+            out += emit_int(20, self.A_TENSOR)
+        elif isinstance(v, bool):
+            out += emit_int(3, int(v))
+            out += emit_int(20, self.A_INT)
+        elif isinstance(v, int):
+            out += emit_int(3, v)
+            out += emit_int(20, self.A_INT)
+        elif isinstance(v, float):
+            out += emit_float(2, v)
+            out += emit_int(20, self.A_FLOAT)
+        elif isinstance(v, str):
+            out += emit_str(4, v)
+            out += emit_int(20, self.A_STRING)
+        elif isinstance(v, (list, tuple)):
+            if v and isinstance(v[0], float):
+                for x in v:
+                    out += emit_float(7, x)
+                out += emit_int(20, self.A_FLOATS)
+            elif v and isinstance(v[0], str):
+                for x in v:
+                    out += emit_str(9, x)
+                out += emit_int(20, self.A_STRINGS)
+            else:
+                for x in v:
+                    out += emit_int(8, int(x))
+                out += emit_int(20, self.A_INTS)
+        else:
+            raise TypeError(f"unsupported attribute {self.name}={v!r}")
+        return bytes(out)
+
+    @classmethod
+    def decode(cls, buf):
+        name, atype = "", None
+        f_val = i_val = s_val = t_val = None
+        floats, ints, strings = [], [], []
+        for field, wire, value in parse_fields(buf):
+            if field == 1:
+                name = value.decode("utf-8")
+            elif field == 2:
+                f_val = struct.unpack("<f", value)[0]
+            elif field == 3:
+                i_val = _to_int64(value)
+            elif field == 4:
+                s_val = value.decode("utf-8", errors="replace")
+            elif field == 5:
+                t_val = TensorProto.decode(value)
+            elif field == 7:
+                if wire == 2:
+                    floats.extend(struct.unpack(f"<{len(value) // 4}f", value))
+                else:
+                    floats.append(struct.unpack("<f", value)[0])
+            elif field == 8:
+                if wire == 2:
+                    ints.extend(_unpack_ints(value))
+                else:
+                    ints.append(_to_int64(value))
+            elif field == 9:
+                strings.append(value.decode("utf-8", errors="replace"))
+            elif field == 20:
+                atype = value
+        if atype == cls.A_FLOAT:
+            v = f_val
+        elif atype == cls.A_INT:
+            v = i_val
+        elif atype == cls.A_STRING:
+            v = s_val
+        elif atype == cls.A_TENSOR:
+            v = t_val
+        elif atype == cls.A_FLOATS:
+            v = list(floats)
+        elif atype == cls.A_INTS:
+            v = list(ints)
+        elif atype == cls.A_STRINGS:
+            v = list(strings)
+        else:  # producers may omit `type`; pick whichever field was set
+            for cand in (t_val, s_val, f_val, i_val):
+                if cand is not None:
+                    v = cand
+                    break
+            else:
+                v = ints or floats or strings
+        return cls(name, v)
+
+
+class ValueInfoProto:
+    def __init__(self, name, elem_type=FLOAT, shape=()):
+        self.name = name
+        self.elem_type = elem_type
+        self.shape = list(shape)  # ints, or strs for symbolic dims
+
+    def encode(self):
+        dims = bytearray()
+        for d in self.shape:
+            if isinstance(d, str):
+                dim = emit_str(2, d)
+            else:
+                dim = emit_int(1, int(d))
+            dims += emit_bytes(1, dim)
+        shape_proto = bytes(dims)
+        tensor_type = emit_int(1, self.elem_type) + emit_bytes(2, shape_proto)
+        type_proto = emit_bytes(1, tensor_type)
+        return emit_str(1, self.name) + emit_bytes(2, type_proto)
+
+    @classmethod
+    def decode(cls, buf):
+        vi = cls("")
+        for field, _, value in parse_fields(buf):
+            if field == 1:
+                vi.name = value.decode("utf-8")
+            elif field == 2:  # TypeProto
+                for f2, _, v2 in parse_fields(value):
+                    if f2 != 1:  # tensor_type only
+                        continue
+                    for f3, _, v3 in parse_fields(v2):
+                        if f3 == 1:
+                            vi.elem_type = v3
+                        elif f3 == 2:  # TensorShapeProto
+                            for f4, _, v4 in parse_fields(v3):
+                                if f4 != 1:
+                                    continue
+                                dim = None
+                                for f5, _, v5 in parse_fields(v4):
+                                    if f5 == 1:
+                                        dim = _to_int64(v5)
+                                    elif f5 == 2 and dim is None:
+                                        dim = v5.decode("utf-8")
+                                vi.shape.append(0 if dim is None else dim)
+        return vi
+
+
+class NodeProto:
+    def __init__(self, op_type, inputs=(), outputs=(), name="", attrs=None):
+        self.op_type = op_type
+        self.inputs = list(inputs)
+        self.outputs = list(outputs)
+        self.name = name
+        self.attrs = dict(attrs or {})
+
+    def encode(self):
+        out = bytearray()
+        for i in self.inputs:
+            out += emit_str(1, i)
+        for o in self.outputs:
+            out += emit_str(2, o)
+        if self.name:
+            out += emit_str(3, self.name)
+        out += emit_str(4, self.op_type)
+        for k in sorted(self.attrs):
+            out += emit_bytes(5, AttributeProto(k, self.attrs[k]).encode())
+        return bytes(out)
+
+    @classmethod
+    def decode(cls, buf):
+        n = cls("")
+        for field, _, value in parse_fields(buf):
+            if field == 1:
+                n.inputs.append(value.decode("utf-8"))
+            elif field == 2:
+                n.outputs.append(value.decode("utf-8"))
+            elif field == 3:
+                n.name = value.decode("utf-8")
+            elif field == 4:
+                n.op_type = value.decode("utf-8")
+            elif field == 5:
+                a = AttributeProto.decode(value)
+                n.attrs[a.name] = a.value
+        return n
+
+
+class GraphProto:
+    def __init__(self, name="graph"):
+        self.name = name
+        self.nodes = []
+        self.initializers = []   # TensorProto
+        self.inputs = []         # ValueInfoProto
+        self.outputs = []        # ValueInfoProto
+
+    def encode(self):
+        out = bytearray()
+        for n in self.nodes:
+            out += emit_bytes(1, n.encode())
+        out += emit_str(2, self.name)
+        for t in self.initializers:
+            out += emit_bytes(5, t.encode())
+        for vi in self.inputs:
+            out += emit_bytes(11, vi.encode())
+        for vi in self.outputs:
+            out += emit_bytes(12, vi.encode())
+        return bytes(out)
+
+    @classmethod
+    def decode(cls, buf):
+        g = cls()
+        for field, _, value in parse_fields(buf):
+            if field == 1:
+                g.nodes.append(NodeProto.decode(value))
+            elif field == 2:
+                g.name = value.decode("utf-8")
+            elif field == 5:
+                g.initializers.append(TensorProto.decode(value))
+            elif field == 11:
+                g.inputs.append(ValueInfoProto.decode(value))
+            elif field == 12:
+                g.outputs.append(ValueInfoProto.decode(value))
+        return g
+
+
+class ModelProto:
+    def __init__(self, graph=None, ir_version=7, opset=13,
+                 producer_name="mxnet_tpu", producer_version="1.0"):
+        self.graph = graph or GraphProto()
+        self.ir_version = ir_version
+        self.opset = opset
+        self.producer_name = producer_name
+        self.producer_version = producer_version
+
+    def encode(self):
+        out = bytearray()
+        out += emit_int(1, self.ir_version)
+        out += emit_str(2, self.producer_name)
+        out += emit_str(3, self.producer_version)
+        out += emit_bytes(7, self.graph.encode())
+        opset = emit_str(1, "") + emit_int(2, self.opset)
+        out += emit_bytes(8, opset)
+        return bytes(out)
+
+    @classmethod
+    def decode(cls, buf):
+        m = cls(graph=None)
+        for field, _, value in parse_fields(buf):
+            if field == 1:
+                m.ir_version = _to_int64(value)
+            elif field == 2:
+                m.producer_name = value.decode("utf-8")
+            elif field == 3:
+                m.producer_version = value.decode("utf-8")
+            elif field == 7:
+                m.graph = GraphProto.decode(value)
+            elif field == 8:
+                for f2, _, v2 in parse_fields(value):
+                    if f2 == 2:
+                        m.opset = _to_int64(v2)
+        return m

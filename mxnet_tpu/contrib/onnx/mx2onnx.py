@@ -1,0 +1,538 @@
+"""Symbol-graph → ONNX exporter.
+
+Parity with reference python/mxnet/contrib/onnx/mx2onnx/export_onnx.py
+(MXNetGraph.create_onnx_graph_proto) + _op_translations.py, re-designed over
+this framework's Symbol IR: we walk the _SymNode DAG directly (no JSON
+detour) and emit opset-13 nodes through the self-contained codec in
+_proto.py. Each translator returns a list of NodeProto plus any extra
+initializers it manufactures (reshape targets, scalar operands, …).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...base import MXNetError, np_dtype
+from ...ops import registry as _registry
+from . import _proto as P
+
+_TRANSLATORS = {}
+
+
+def _canon(node):
+    """Canonical op name (resolves registry aliases: Reshape→reshape, …)."""
+    if _registry.exists(node.op):
+        return _registry.get(node.op).name
+    return node.op
+
+
+def _translator(*op_names):
+    def deco(fn):
+        for n in op_names:
+            _TRANSLATORS[n] = fn
+        return fn
+    return deco
+
+
+def _tup(v, n, default=1):
+    if v is None or v == ():
+        return (default,) * n
+    if isinstance(v, (int, float)):
+        return (int(v),) * n
+    return tuple(int(x) for x in v)
+
+
+class _Ctx:
+    """Per-export state handed to translators."""
+
+    def __init__(self, shapes):
+        self.shapes = shapes          # tensor name -> shape (may be None)
+        self.nodes = []
+        self.initializers = []
+        self._uid = 0
+
+    def uniq(self, hint):
+        self._uid += 1
+        return f"{hint}__{self._uid}"
+
+    def add_const(self, arr, hint):
+        name = self.uniq(hint)
+        self.initializers.append(P.TensorProto.from_array(np.asarray(arr), name))
+        return name
+
+    def emit(self, op_type, inputs, outputs, name=None, **attrs):
+        self.nodes.append(P.NodeProto(op_type, inputs, outputs,
+                                      name=name or outputs[0], attrs=attrs))
+
+
+# --- translators ------------------------------------------------------------
+@_translator("Convolution")
+def _conv(ctx, n, ins, out):
+    kernel = tuple(n.attrs["kernel"])
+    nd = len(kernel)
+    attrs = dict(
+        kernel_shape=list(kernel),
+        strides=list(_tup(n.attrs.get("stride"), nd)),
+        dilations=list(_tup(n.attrs.get("dilate"), nd)),
+        pads=list(_tup(n.attrs.get("pad"), nd, 0)) * 2,
+        group=int(n.attrs.get("num_group", 1)),
+    )
+    inputs = ins[:2] if n.attrs.get("no_bias") else ins[:3]
+    ctx.emit("Conv", inputs, [out], **attrs)
+
+
+@_translator("Deconvolution")
+def _deconv(ctx, n, ins, out):
+    kernel = tuple(n.attrs["kernel"])
+    nd = len(kernel)
+    attrs = dict(
+        kernel_shape=list(kernel),
+        strides=list(_tup(n.attrs.get("stride"), nd)),
+        dilations=list(_tup(n.attrs.get("dilate"), nd)),
+        pads=list(_tup(n.attrs.get("pad"), nd, 0)) * 2,
+        group=int(n.attrs.get("num_group", 1)),
+    )
+    inputs = ins[:2] if n.attrs.get("no_bias") else ins[:3]
+    ctx.emit("ConvTranspose", inputs, [out], **attrs)
+
+
+@_translator("FullyConnected")
+def _fc(ctx, n, ins, out):
+    data = ins[0]
+    shape = ctx.shapes.get(data)
+    flatten = bool(n.attrs.get("flatten", True))
+    if shape is not None and len(shape) > 2:
+        if flatten:
+            flat = ctx.uniq(out + "_flat")
+            ctx.emit("Flatten", [data], [flat], axis=1)
+            data = flat
+        else:
+            # per-last-axis projection: MatMul with W^T (+ bias)
+            wt = ctx.uniq(out + "_wT")
+            ctx.emit("Transpose", [ins[1]], [wt], perm=[1, 0])
+            mm_out = out if n.attrs.get("no_bias") else ctx.uniq(out + "_mm")
+            ctx.emit("MatMul", [data, wt], [mm_out])
+            if not n.attrs.get("no_bias"):
+                ctx.emit("Add", [mm_out, ins[2]], [out])
+            return
+    inputs = [data, ins[1]] + ([] if n.attrs.get("no_bias") else [ins[2]])
+    ctx.emit("Gemm", inputs, [out], alpha=1.0, beta=1.0, transA=0, transB=1)
+
+
+@_translator("BatchNorm")
+def _bn(ctx, n, ins, out):
+    # inputs: data, gamma, beta, moving_mean, moving_var
+    ctx.emit("BatchNormalization", ins[:5], [out],
+             epsilon=float(n.attrs.get("eps", 1e-3)),
+             momentum=float(n.attrs.get("momentum", 0.9)))
+
+
+@_translator("Pooling")
+def _pool(ctx, n, ins, out):
+    pool_type = n.attrs.get("pool_type", "max")
+    if n.attrs.get("global_pool"):
+        op = {"max": "GlobalMaxPool", "avg": "GlobalAveragePool"}[pool_type]
+        ctx.emit(op, [ins[0]], [out])
+        return
+    kernel = tuple(n.attrs["kernel"])
+    nd = len(kernel)
+    attrs = dict(
+        kernel_shape=list(kernel),
+        strides=list(_tup(n.attrs.get("stride"), nd)),
+        pads=list(_tup(n.attrs.get("pad"), nd, 0)) * 2,
+        ceil_mode=int(n.attrs.get("pooling_convention", "valid") == "full"),
+    )
+    if pool_type == "max":
+        ctx.emit("MaxPool", [ins[0]], [out], **attrs)
+    elif pool_type == "avg":
+        attrs["count_include_pad"] = int(bool(
+            n.attrs.get("count_include_pad", True)))
+        ctx.emit("AveragePool", [ins[0]], [out], **attrs)
+    else:
+        raise MXNetError(f"ONNX export: unsupported pool_type {pool_type}")
+
+
+_ACT_MAP = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+            "softrelu": "Softplus", "softsign": "Softsign"}
+
+
+@_translator("Activation")
+def _act(ctx, n, ins, out):
+    act = n.attrs.get("act_type", "relu")
+    if act not in _ACT_MAP:
+        raise MXNetError(f"ONNX export: unsupported act_type {act}")
+    ctx.emit(_ACT_MAP[act], [ins[0]], [out])
+
+
+@_translator("LeakyReLU")
+def _leaky(ctx, n, ins, out):
+    act = n.attrs.get("act_type", "leaky")
+    if act == "leaky":
+        ctx.emit("LeakyRelu", [ins[0]], [out],
+                 alpha=float(n.attrs.get("slope", 0.25)))
+    elif act == "elu":
+        ctx.emit("Elu", [ins[0]], [out],
+                 alpha=float(n.attrs.get("slope", 0.25)))
+    elif act == "prelu":
+        ctx.emit("PRelu", ins[:2], [out])
+    elif act == "selu":
+        ctx.emit("Selu", [ins[0]], [out])
+    elif act == "gelu":
+        # erf-form gelu decomposition (Gelu is opset-20; stay at 13)
+        half = ctx.add_const(np.float32(0.5), "gelu_half")
+        one = ctx.add_const(np.float32(1.0), "gelu_one")
+        isqrt2 = ctx.add_const(np.float32(1.0 / np.sqrt(2.0)), "gelu_isqrt2")
+        scaled = ctx.uniq(out + "_s")
+        ctx.emit("Mul", [ins[0], isqrt2], [scaled])
+        erf = ctx.uniq(out + "_erf")
+        ctx.emit("Erf", [scaled], [erf])
+        erf1 = ctx.uniq(out + "_erf1")
+        ctx.emit("Add", [erf, one], [erf1])
+        xh = ctx.uniq(out + "_xh")
+        ctx.emit("Mul", [ins[0], half], [xh])
+        ctx.emit("Mul", [xh, erf1], [out])
+    else:
+        raise MXNetError(f"ONNX export: unsupported LeakyReLU {act}")
+
+
+@_translator("softmax")
+def _softmax(ctx, n, ins, out):
+    ctx.emit("Softmax", [ins[0]], [out], axis=int(n.attrs.get("axis", -1)))
+
+
+@_translator("log_softmax")
+def _log_softmax(ctx, n, ins, out):
+    ctx.emit("LogSoftmax", [ins[0]], [out], axis=int(n.attrs.get("axis", -1)))
+
+
+@_translator("SoftmaxOutput", "SoftmaxActivation")
+def _softmax_output(ctx, n, ins, out):
+    # inference semantics only (reference mx2onnx does the same)
+    ctx.emit("Softmax", [ins[0]], [out], axis=1)
+
+
+@_translator("flatten")
+def _flatten(ctx, n, ins, out):
+    ctx.emit("Flatten", [ins[0]], [out], axis=1)
+
+
+@_translator("reshape")
+def _reshape(ctx, n, ins, out):
+    shape = [int(s) for s in n.attrs.get("shape", ())]
+    if any(s in (-2, -3, -4) for s in shape):
+        raise MXNetError("ONNX export: reshape special codes -2/-3/-4 "
+                         "unsupported")
+    shp = ctx.add_const(np.asarray(shape, np.int64), out + "_shape")
+    ctx.emit("Reshape", [ins[0], shp], [out])
+
+
+@_translator("transpose")
+def _transpose(ctx, n, ins, out):
+    axes = n.attrs.get("axes", ())
+    attrs = {"perm": [int(a) for a in axes]} if axes else {}
+    ctx.emit("Transpose", [ins[0]], [out], **attrs)
+
+
+@_translator("concat")
+def _concat(ctx, n, ins, out):
+    ctx.emit("Concat", ins, [out], axis=int(n.attrs.get("dim", 1)))
+
+
+@_translator("Dropout")
+def _dropout(ctx, n, ins, out):
+    ratio = ctx.add_const(np.float32(n.attrs.get("p", 0.5)), out + "_ratio")
+    ctx.emit("Dropout", [ins[0], ratio], [out])
+
+
+_BINARY = {"elemwise_add": "Add", "broadcast_add": "Add",
+           "elemwise_sub": "Sub", "broadcast_sub": "Sub",
+           "elemwise_mul": "Mul", "broadcast_mul": "Mul",
+           "elemwise_div": "Div", "broadcast_div": "Div",
+           "broadcast_power": "Pow", "broadcast_maximum": "Max",
+           "broadcast_minimum": "Min"}
+
+
+@_translator(*_BINARY)
+def _binary(ctx, n, ins, out):
+    ctx.emit(_BINARY[_canon(n)], ins[:2], [out])
+
+
+_SCALAR = {"_plus_scalar": ("Add", False), "_minus_scalar": ("Sub", False),
+           "_rminus_scalar": ("Sub", True), "_mul_scalar": ("Mul", False),
+           "_div_scalar": ("Div", False), "_rdiv_scalar": ("Div", True),
+           "_power_scalar": ("Pow", False)}
+
+
+@_translator(*_SCALAR)
+def _scalar(ctx, n, ins, out):
+    op, reverse = _SCALAR[_canon(n)]
+    c = ctx.add_const(np.float32(n.attrs.get("scalar", 0.0)), out + "_c")
+    inputs = [c, ins[0]] if reverse else [ins[0], c]
+    ctx.emit(op, inputs, [out])
+
+
+_UNARY = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+          "exp": "Exp", "log": "Log", "sqrt": "Sqrt", "abs": "Abs",
+          "negative": "Neg", "floor": "Floor", "ceil": "Ceil",
+          "round": "Round", "sign": "Sign", "erf": "Erf",
+          "_copy": "Identity", "stop_gradient": "Identity",
+          "make_loss": "Identity", "identity": "Identity",
+          "softsign": "Softsign", "reciprocal": "Reciprocal",
+          "cos": "Cos", "sin": "Sin", "tan": "Tan", "arccos": "Acos",
+          "arcsin": "Asin", "arctan": "Atan"}
+
+
+@_translator(*_UNARY)
+def _unary(ctx, n, ins, out):
+    ctx.emit(_UNARY[_canon(n)], [ins[0]], [out])
+
+
+@_translator("add_n")
+def _add_n(ctx, n, ins, out):
+    ctx.emit("Sum", ins, [out])
+
+
+_REDUCE = {"mean": "ReduceMean", "sum": "ReduceSum", "max": "ReduceMax",
+           "min": "ReduceMin", "prod": "ReduceProd"}
+
+
+@_translator(*_REDUCE)
+def _reduce(ctx, n, ins, out):
+    axis = n.attrs.get("axis")
+    attrs = {"keepdims": int(bool(n.attrs.get("keepdims", False)))}
+    if axis is not None and axis != ():
+        axes = [int(axis)] if isinstance(axis, (int, float)) else \
+            [int(a) for a in axis]
+        attrs["axes"] = axes
+    if _canon(n) == "sum":  # opset 13: ReduceSum axes moved to an input
+        inputs = [ins[0]]
+        if "axes" in attrs:
+            inputs.append(ctx.add_const(
+                np.asarray(attrs.pop("axes"), np.int64), out + "_axes"))
+        ctx.emit("ReduceSum", inputs, [out], **attrs)
+        return
+    ctx.emit(_REDUCE[_canon(n)], [ins[0]], [out], **attrs)
+
+
+@_translator("clip")
+def _clip(ctx, n, ins, out):
+    lo = ctx.add_const(np.float32(n.attrs.get("a_min", 0.0)), out + "_min")
+    hi = ctx.add_const(np.float32(n.attrs.get("a_max", 0.0)), out + "_max")
+    ctx.emit("Clip", [ins[0], lo, hi], [out])
+
+
+@_translator("LRN")
+def _lrn(ctx, n, ins, out):
+    ctx.emit("LRN", [ins[0]], [out],
+             alpha=float(n.attrs.get("alpha", 1e-4)),
+             beta=float(n.attrs.get("beta", 0.75)),
+             bias=float(n.attrs.get("knorm", 2.0)),
+             size=int(n.attrs["nsize"]))
+
+
+@_translator("pad")
+def _pad(ctx, n, ins, out):
+    mode = n.attrs.get("mode", "constant")
+    pw = [int(p) for p in n.attrs["pad_width"]]
+    # MXNet interleaves (lo, hi) per axis; ONNX wants all-lo then all-hi
+    los, his = pw[0::2], pw[1::2]
+    pads = ctx.add_const(np.asarray(los + his, np.int64), out + "_pads")
+    inputs = [ins[0], pads]
+    if mode == "constant":
+        inputs.append(ctx.add_const(
+            np.float32(n.attrs.get("constant_value", 0.0)), out + "_cval"))
+    ctx.emit("Pad", inputs, [out],
+             mode={"constant": "constant", "edge": "edge",
+                   "reflect": "reflect"}[mode])
+
+
+@_translator("Embedding")
+def _embedding(ctx, n, ins, out):
+    idx = ctx.uniq(out + "_idx")
+    ctx.emit("Cast", [ins[0]], [idx], to=P.INT64)
+    ctx.emit("Gather", [ins[1], idx], [out])
+
+
+@_translator("take")
+def _take(ctx, n, ins, out):
+    idx = ctx.uniq(out + "_idx")
+    ctx.emit("Cast", [ins[1]], [idx], to=P.INT64)
+    ctx.emit("Gather", [ins[0], idx], [out],
+             axis=int(n.attrs.get("axis", 0)))
+
+
+@_translator("dot")
+def _dot(ctx, n, ins, out):
+    a, b = ins[0], ins[1]
+    if n.attrs.get("transpose_a"):
+        t = ctx.uniq(out + "_aT")
+        ctx.emit("Transpose", [a], [t], perm=[1, 0])
+        a = t
+    if n.attrs.get("transpose_b"):
+        t = ctx.uniq(out + "_bT")
+        ctx.emit("Transpose", [b], [t], perm=[1, 0])
+        b = t
+    ctx.emit("MatMul", [a, b], [out])
+
+
+@_translator("batch_dot")
+def _batch_dot(ctx, n, ins, out):
+    a, b = ins[0], ins[1]
+    if n.attrs.get("transpose_a"):
+        t = ctx.uniq(out + "_aT")
+        ctx.emit("Transpose", [a], [t], perm=[0, 2, 1])
+        a = t
+    if n.attrs.get("transpose_b"):
+        t = ctx.uniq(out + "_bT")
+        ctx.emit("Transpose", [b], [t], perm=[0, 2, 1])
+        b = t
+    ctx.emit("MatMul", [a, b], [out])
+
+
+@_translator("cast")
+def _cast(ctx, n, ins, out):
+    dt = np_dtype(n.attrs["dtype"])
+    ctx.emit("Cast", [ins[0]], [out], to=P.np_to_onnx_dtype(dt))
+
+
+@_translator("expand_dims")
+def _expand_dims(ctx, n, ins, out):
+    axes = ctx.add_const(np.asarray([int(n.attrs["axis"])], np.int64),
+                         out + "_axes")
+    ctx.emit("Unsqueeze", [ins[0], axes], [out])
+
+
+@_translator("squeeze")
+def _squeeze(ctx, n, ins, out):
+    axis = n.attrs.get("axis")
+    inputs = [ins[0]]
+    if axis is not None:
+        axes = [int(axis)] if isinstance(axis, (int, float)) else \
+            [int(a) for a in axis]
+        inputs.append(ctx.add_const(np.asarray(axes, np.int64), out + "_axes"))
+    ctx.emit("Squeeze", inputs, [out])
+
+
+@_translator("slice_axis")
+def _slice_axis(ctx, n, ins, out):
+    axis = int(n.attrs["axis"])
+    begin = int(n.attrs.get("begin", 0))
+    end = n.attrs.get("end")
+    end = np.iinfo(np.int64).max if end in (None, "None") else int(end)
+    starts = ctx.add_const(np.asarray([begin], np.int64), out + "_starts")
+    ends = ctx.add_const(np.asarray([end], np.int64), out + "_ends")
+    axes = ctx.add_const(np.asarray([axis], np.int64), out + "_axes")
+    ctx.emit("Slice", [ins[0], starts, ends, axes], [out])
+
+
+# --- driver -----------------------------------------------------------------
+def export_model(sym, params, input_shapes, input_dtype=np.float32,
+                 onnx_file_path="model.onnx", verbose=False):
+    """Export a Symbol + params to an ONNX file.
+
+    Parity: reference mx2onnx.export_model (export_model.py). `params` maps
+    arg/aux names to NDArray or numpy arrays; non-param variables become
+    graph inputs bound to `input_shapes` positionally.
+    """
+    model = graph_to_onnx(sym, params, input_shapes, input_dtype)
+    with open(onnx_file_path, "wb") as f:
+        f.write(model.encode())
+    return onnx_file_path
+
+
+def graph_to_onnx(sym, params, input_shapes, input_dtype=np.float32):
+    np_params = {}
+    for k, v in (params or {}).items():
+        k = k.split(":", 1)[-1]  # tolerate "arg:name"/"aux:name" prefixes
+        np_params[k] = v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v)
+
+    topo = sym._topo()
+    data_names = [n.name for n in topo
+                  if n.is_variable() and n.name not in np_params]
+    if len(data_names) != len(input_shapes):
+        raise MXNetError(
+            f"ONNX export: {len(data_names)} graph inputs {data_names} but "
+            f"{len(input_shapes)} input shapes")
+
+    # infer every internal shape so translators can rank-dispatch
+    shapes = {}
+    try:
+        internals = sym.get_internals()
+        shape_args = dict(zip(data_names, input_shapes))
+        in_shapes, out_shapes, _ = internals.infer_shape(**shape_args)
+        for name, shp in zip(internals.list_outputs(), out_shapes):
+            shapes[name] = tuple(shp)
+        for name, shp in zip(internals.list_inputs(), in_shapes):
+            shapes[name] = tuple(shp)
+    except Exception as e:
+        # rank-dispatching translators (FullyConnected) degrade without
+        # shapes — surface the problem instead of silently mis-exporting
+        import warnings
+        warnings.warn(f"ONNX export: shape inference failed ({e}); "
+                      "rank-dependent ops may export incorrectly")
+
+    graph = P.GraphProto(name=(sym.name or "mxnet_tpu_model"))
+    ctx = _Ctx(shapes)
+
+    # entry name assignment follows list_outputs() naming, but node names
+    # are uniquified first: traced gluon graphs can carry duplicate node
+    # names (e.g. several blocks named "fwd"), which is fine for the
+    # object-identity Symbol IR but illegal in ONNX's name-keyed graph
+    entry_name = {}
+    used_names = {n.name for n in topo if n.is_variable()}
+    for n in topo:
+        if n.is_variable():
+            entry_name[(id(n), 0)] = n.name
+            shapes.setdefault(n.name, None)
+            continue
+        base = n.name
+        if base in used_names:
+            k = 1
+            while f"{base}_{k}" in used_names:
+                k += 1
+            base = f"{base}_{k}"
+        used_names.add(base)
+        op = _registry.get(n.op)
+        n_out = op.num_outputs if isinstance(op.num_outputs, int) else 1
+        if n_out > 1:
+            for i in range(n_out):
+                entry_name[(id(n), i)] = f"{base}_output{i}"
+        else:
+            entry_name[(id(n), 0)] = f"{base}_output"
+        # shape table is keyed by the *original* executor-facing names;
+        # alias the uniquified names onto it
+        for i in range(n_out):
+            orig = f"{n.name}_output{i}" if n_out > 1 else f"{n.name}_output"
+            shapes.setdefault(entry_name[(id(n), i)], shapes.get(orig))
+
+    for n in topo:
+        if n.is_variable():
+            continue
+        cname = _canon(n)
+        if cname not in _TRANSLATORS:
+            raise MXNetError(f"ONNX export: no translator for op '{n.op}'")
+        ins = [entry_name[(id(src), i)] for (src, i) in n.inputs]
+        out = entry_name[(id(n), 0)]
+        # fix_gamma: ONNX BatchNormalization has no such switch — bake
+        # gamma=1 into the exported scale initializer
+        if cname == "BatchNorm" and bool(n.attrs.get("fix_gamma", True)):
+            gname = n.inputs[1][0].name
+            if gname in np_params:
+                np_params[gname] = np.ones_like(np_params[gname])
+        _TRANSLATORS[cname](ctx, n, ins, out)
+
+    graph.nodes = ctx.nodes
+    graph.initializers = ctx.initializers
+    for name, arr in np_params.items():
+        graph.initializers.append(P.TensorProto.from_array(arr, name))
+
+    elem = P.np_to_onnx_dtype(input_dtype)
+    for name, shp in zip(data_names, input_shapes):
+        graph.inputs.append(P.ValueInfoProto(name, elem, shp))
+    # output names must come from the uniquified entry table, not
+    # list_outputs(): with duplicate node names the latter would wire the
+    # model output to the FIRST same-named node's tensor
+    for (n, i), orig in zip(sym._outputs, sym.list_outputs()):
+        out_name = entry_name[(id(n), i)]
+        graph.outputs.append(P.ValueInfoProto(
+            out_name, elem, shapes.get(orig) or ()))
+    return P.ModelProto(graph=graph)

@@ -31,11 +31,16 @@ import numpy as np
 # With no token configured the MAC is all-zeros and the server must only
 # listen on localhost (the default bind).
 _MAC_LEN = 32
-_MAX_FRAME = int(os.environ.get("MXNET_KVSTORE_MAX_FRAME", 1 << 30))
+
+
+def _max_frame():
+    from .config import get as _cfg
+    return int(_cfg("MXNET_KVSTORE_MAX_FRAME"))
 
 
 def _token():
-    return os.environ.get("MXNET_KVSTORE_AUTH_TOKEN", "")
+    from .config import get as _cfg
+    return _cfg("MXNET_KVSTORE_AUTH_TOKEN")
 
 
 def _mac(body, token):
@@ -56,7 +61,7 @@ def _recv_msg(sock, token=None):
     if header is None:
         return None
     (length,) = struct.unpack("<I", header)
-    if length > _MAX_FRAME:
+    if length > _max_frame():
         raise RuntimeError(f"kvstore frame too large: {length}")
     mac = _recv_exact(sock, _MAC_LEN)
     if mac is None:
@@ -238,14 +243,19 @@ class KVServer:
             elif op == "num_dead_node":
                 timeout = float(msg.get("timeout", 60))
                 now = time.monotonic()
+                from .config import get as _cfg
+                hb_enabled = _cfg("MXNET_KVSTORE_HEARTBEAT_INTERVAL") > 0
                 with self._lock:
                     dead = 0
                     for rank in range(self.num_workers):
                         last = self._heartbeats.get(rank)
                         if last is None:
-                            # never announced: dead once the grace
-                            # period from server start elapses
-                            if now - self._start_time > timeout:
+                            # never announced: dead once the grace period
+                            # from server start elapses — but only when
+                            # heartbeating is enabled at all, else every
+                            # healthy worker would read as dead
+                            if hb_enabled and \
+                                    now - self._start_time > timeout:
                                 dead += 1
                         elif now - last > timeout:
                             dead += 1
@@ -350,6 +360,21 @@ class KVClient:
 
     def close(self):
         self._hb_stop.set()
+        # close sockets so the server-side handler threads unblock; a
+        # close() racing the heartbeat loop is fine — the loop treats a
+        # dead socket as a stop signal
+        with self._hb_lock:
+            if self._hb_sock is not None:
+                try:
+                    self._hb_sock.close()
+                except OSError:
+                    pass
+                self._hb_sock = None
+        with self._lock:
+            try:
+                self.sock.close()
+            except OSError:
+                pass
 
     def _rpc(self, msg):
         with self._lock:

@@ -1,0 +1,282 @@
+"""ONNX interop tests (parity target: reference onnx import/export,
+python/mxnet/contrib/onnx/ — exercised here end-to-end through the
+self-contained wire codec, exporter, and importer)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.contrib import onnx as mxonnx
+from mxnet_tpu.contrib.onnx import _proto as P
+
+
+# --- wire format ------------------------------------------------------------
+def test_tensorproto_roundtrip():
+    for arr in [np.arange(12, dtype=np.float32).reshape(3, 4),
+                np.asarray([-5, 0, 7], np.int64),
+                np.random.rand(2, 3, 1).astype(np.float16),
+                np.asarray(3.5, np.float64)]:
+        t = P.TensorProto.from_array(arr, "x")
+        back = P.TensorProto.decode(t.encode())
+        assert back.name == "x"
+        np.testing.assert_array_equal(back.to_array(), arr)
+
+
+def test_varint_negative_int64():
+    # negative int64 attrs encode as 10-byte varints (protobuf contract)
+    a = P.AttributeProto("axis", -1)
+    back = P.AttributeProto.decode(a.encode())
+    assert back.name == "axis" and back.value == -1
+
+
+def test_attribute_kinds_roundtrip():
+    cases = {"f": 2.5, "i": 7, "s": "same", "ints": [1, -2, 3],
+             "floats": [0.5, 1.5]}
+    for name, val in cases.items():
+        back = P.AttributeProto.decode(P.AttributeProto(name, val).encode())
+        if isinstance(val, list) and isinstance(val[0], float):
+            assert back.value == pytest.approx(val)
+        elif isinstance(val, float):
+            assert back.value == pytest.approx(val)
+        else:
+            assert back.value == val
+
+
+def test_modelproto_roundtrip():
+    g = P.GraphProto("g")
+    g.nodes.append(P.NodeProto("Relu", ["x"], ["y"], attrs={}))
+    g.inputs.append(P.ValueInfoProto("x", P.FLOAT, (1, 3)))
+    g.outputs.append(P.ValueInfoProto("y", P.FLOAT, (1, 3)))
+    m = P.ModelProto(graph=g, opset=13)
+    back = P.ModelProto.decode(m.encode())
+    assert back.opset == 13
+    assert back.graph.nodes[0].op_type == "Relu"
+    assert back.graph.inputs[0].shape == [1, 3]
+
+
+def test_unknown_fields_skipped():
+    # decoder must skip fields it doesn't know (forward compat): append a
+    # length-delimited field 99 to an encoded node
+    n = P.NodeProto("Relu", ["x"], ["y"])
+    raw = n.encode() + P.emit_bytes(99, b"future-stuff")
+    back = P.NodeProto.decode(raw)
+    assert back.op_type == "Relu" and back.inputs == ["x"]
+
+
+# --- roundtrips -------------------------------------------------------------
+def _forward(symbol, params, data, aux=None):
+    aux_names = set(symbol.list_auxiliary_states())
+    args = {k: (v if isinstance(v, mx.nd.NDArray) else mx.nd.array(v))
+            for k, v in params.items() if k not in aux_names}
+    args["data"] = mx.nd.array(data)
+    aux_d = {k: (v if isinstance(v, mx.nd.NDArray) else mx.nd.array(v))
+             for k, v in (aux or {}).items()}
+    ex = symbol.bind(mx.cpu(), args, aux_states=aux_d, grad_req="null")
+    return ex.forward()[0].asnumpy()
+
+
+def test_mlp_roundtrip(tmp_path):
+    data = sym.var("data")
+    w1, b1 = sym.var("w1"), sym.var("b1")
+    w2, b2 = sym.var("w2"), sym.var("b2")
+    h = sym.Symbol._create("FullyConnected", [data, w1, b1],
+                           {"num_hidden": 16})
+    h = sym.Symbol._create("Activation", [h], {"act_type": "relu"})
+    h = h * 2.0 + 1.0
+    out = sym.Symbol._create("FullyConnected", [h, w2, b2],
+                             {"num_hidden": 4})
+    out = sym.Symbol._create("softmax", [out], {"axis": -1})
+
+    rng = np.random.RandomState(3)
+    params = {"w1": rng.randn(16, 8).astype(np.float32),
+              "b1": rng.randn(16).astype(np.float32),
+              "w2": rng.randn(4, 16).astype(np.float32),
+              "b2": rng.randn(4).astype(np.float32)}
+    x = rng.randn(5, 8).astype(np.float32)
+    ref = _forward(out, params, x)
+
+    path = str(tmp_path / "mlp.onnx")
+    mxonnx.export_model(out, params, [(5, 8)], onnx_file_path=path)
+    s2, arg_p, aux_p = mxonnx.import_model(path)
+    got = _forward(s2, arg_p, x, aux_p)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_convnet_roundtrip(tmp_path):
+    data = sym.var("data")
+    w = sym.var("cw")
+    g, be = sym.var("g"), sym.var("be")
+    mm = sym.var("mm", __is_aux__=True)
+    mv = sym.var("mv", __is_aux__=True)
+    x = sym.Symbol._create("Convolution", [data, w],
+                           {"kernel": (3, 3), "num_filter": 6,
+                            "pad": (1, 1), "no_bias": True})
+    x = sym.Symbol._create("BatchNorm", [x, g, be, mm, mv],
+                           {"fix_gamma": False, "eps": 1e-5})
+    x = sym.Symbol._create("Activation", [x], {"act_type": "relu"})
+    p1 = sym.Symbol._create("Pooling", [x], {"kernel": (2, 2),
+                                             "stride": (2, 2),
+                                             "pool_type": "max"})
+    p2 = sym.Symbol._create("Pooling", [x], {"kernel": (2, 2),
+                                             "stride": (2, 2),
+                                             "pool_type": "avg"})
+    x = sym.Symbol._create("concat", [p1, p2], {"dim": 1, "num_args": 2})
+    x = sym.Symbol._create("Pooling", [x], {"kernel": (1, 1),
+                                            "pool_type": "avg",
+                                            "global_pool": True})
+    x = sym.Symbol._create("flatten", [x], {})
+
+    rng = np.random.RandomState(7)
+    params = {"cw": rng.randn(6, 3, 3, 3).astype(np.float32) * 0.2,
+              "g": (rng.rand(6) + 0.5).astype(np.float32),
+              "be": rng.randn(6).astype(np.float32) * 0.1}
+    aux = {"mm": rng.randn(6).astype(np.float32) * 0.01,
+           "mv": (rng.rand(6) + 0.5).astype(np.float32)}
+    xin = rng.randn(2, 3, 8, 8).astype(np.float32)
+    ref = _forward(x, params, xin, aux)
+
+    path = str(tmp_path / "conv.onnx")
+    mxonnx.export_model(x, {**params, **aux}, [(2, 3, 8, 8)],
+                        onnx_file_path=path)
+    s2, arg_p, aux_p = mxonnx.import_model(path)
+    assert sorted(aux_p) == ["mm", "mv"]
+    got = _forward(s2, arg_p, xin, aux_p)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_ops_roundtrip(tmp_path):
+    """Reduce / transpose / clip / reshape / slice / embedding family."""
+    data = sym.var("data")
+    emb = sym.var("emb")
+    idx = sym.Symbol._create("clip", [data], {"a_min": 0.0, "a_max": 9.0})
+    e = sym.Symbol._create("Embedding", [idx, emb],
+                           {"input_dim": 10, "output_dim": 4})
+    t = sym.Symbol._create("transpose", [e], {"axes": (1, 0, 2)})
+    r = sym.Symbol._create("mean", [t], {"axis": (2,), "keepdims": False})
+    out = sym.Symbol._create("reshape", [r], {"shape": (-1,)})
+
+    rng = np.random.RandomState(11)
+    params = {"emb": rng.randn(10, 4).astype(np.float32)}
+    xin = rng.randint(0, 10, size=(3, 5)).astype(np.float32)
+    ref = _forward(out, params, xin)
+
+    path = str(tmp_path / "ops.onnx")
+    mxonnx.export_model(out, params, [(3, 5)], onnx_file_path=path)
+    s2, arg_p, aux_p = mxonnx.import_model(path)
+    got = _forward(s2, arg_p, xin, aux_p)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_model_metadata(tmp_path):
+    data = sym.var("data")
+    w = sym.var("w")
+    out = sym.Symbol._create("FullyConnected", [data, w],
+                             {"num_hidden": 3, "no_bias": True})
+    params = {"w": np.zeros((3, 4), np.float32)}
+    path = str(tmp_path / "meta.onnx")
+    mxonnx.export_model(out, params, [(2, 4)], onnx_file_path=path)
+    meta = mxonnx.get_model_metadata(path)
+    assert meta["input_tensor_data"] == [("data", (2, 4))]
+    assert len(meta["output_tensor_data"]) == 1
+
+
+def test_import_attribute_form_clip_dropout():
+    """Older opsets carry Clip bounds / Dropout ratio as attributes."""
+    g = P.GraphProto("old")
+    g.inputs.append(P.ValueInfoProto("data", P.FLOAT, (2, 3)))
+    g.nodes.append(P.NodeProto("Clip", ["data"], ["c"],
+                               attrs={"min": -1.0, "max": 1.0}))
+    g.nodes.append(P.NodeProto("Dropout", ["c"], ["d"],
+                               attrs={"ratio": 0.25}))
+    g.outputs.append(P.ValueInfoProto("d", P.FLOAT, (2, 3)))
+    s, arg_p, aux_p = mxonnx.graph_from_onnx(g)
+    x = np.asarray([[-3, 0.5, 3], [2, -2, 0]], np.float32)
+    got = _forward(s, arg_p, x, aux_p)
+    np.testing.assert_allclose(got, np.clip(x, -1, 1))
+
+
+def test_import_strided_slice():
+    g = P.GraphProto("s")
+    g.inputs.append(P.ValueInfoProto("data", P.FLOAT, (4, 6)))
+    g.initializers.append(P.TensorProto.from_array(
+        np.asarray([0], np.int64), "starts"))
+    g.initializers.append(P.TensorProto.from_array(
+        np.asarray([6], np.int64), "ends"))
+    g.initializers.append(P.TensorProto.from_array(
+        np.asarray([1], np.int64), "axes"))
+    g.initializers.append(P.TensorProto.from_array(
+        np.asarray([2], np.int64), "steps"))
+    g.nodes.append(P.NodeProto("Slice",
+                               ["data", "starts", "ends", "axes", "steps"],
+                               ["y"]))
+    g.outputs.append(P.ValueInfoProto("y", P.FLOAT, (4, 3)))
+    s, arg_p, aux_p = mxonnx.graph_from_onnx(g)
+    x = np.arange(24, dtype=np.float32).reshape(4, 6)
+    got = _forward(s, arg_p, x, aux_p)
+    np.testing.assert_array_equal(got, x[:, 0:6:2])
+
+
+def test_export_duplicate_output_node_name(tmp_path):
+    """Model output must come from the uniquified tensor, not the first
+    node that happened to share the name."""
+    data = sym.var("data")
+    a = sym.Symbol._create("relu", [data], {}, name=None)
+    b = sym.Symbol._create("relu", [a], {}, name=None)
+    # force both nodes to the same name (traced gluon graphs do this)
+    a._outputs[0][0].name = "fwd"
+    b._outputs[0][0].name = "fwd"
+    out = b * 2.0
+    out._outputs[0][0].name = "fwd"
+    x = np.asarray([[-1.0, 2.0]], np.float32)
+    ref = _forward(out, {}, x)
+    path = str(tmp_path / "dup.onnx")
+    mxonnx.export_model(out, {}, [(1, 2)], onnx_file_path=path)
+    s2, arg_p, aux_p = mxonnx.import_model(path)
+    got = _forward(s2, arg_p, x, aux_p)
+    np.testing.assert_allclose(got, ref)
+
+
+def test_fp16_int32data_bit_reinterpretation():
+    # fp16 1.0 has bit pattern 15360; stored via int32_data per onnx.proto
+    raw = P.emit_int(1, 2) + P.emit_int(2, P.FLOAT16) + \
+        P.emit_bytes(5, P._varint(15360) + P._varint(0))
+    t = P.TensorProto.decode(raw)
+    arr = t.to_array()
+    assert arr.dtype == np.float16
+    np.testing.assert_array_equal(arr, np.asarray([1.0, 0.0], np.float16))
+
+
+@pytest.mark.slow
+def test_resnet18_roundtrip(tmp_path):
+    from mxnet_tpu.gluon.model_zoo.vision import get_model
+    net = get_model("resnet18_v1")
+    net.initialize()
+    xin = np.random.RandomState(0).randn(1, 3, 64, 64).astype(np.float32)
+    ref = net(mx.nd.array(xin)).asnumpy()
+    _, s = net._build_sym_graph()
+    params = {k: v._reduce() for k, v in net.collect_params().items()}
+    path = str(tmp_path / "resnet18.onnx")
+    mxonnx.export_model(s, params, [(1, 3, 64, 64)], onnx_file_path=path)
+    s2, arg_p, aux_p = mxonnx.import_model(path)
+    args2 = dict(arg_p)
+    args2["data"] = mx.nd.array(xin)
+    ex2 = s2.bind(mx.cpu(), args2, aux_states=aux_p, grad_req="null")
+    got = ex2.forward()[0].asnumpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_import_to_gluon(tmp_path):
+    data = sym.var("data")
+    w, b = sym.var("w"), sym.var("b")
+    out = sym.Symbol._create("FullyConnected", [data, w, b],
+                             {"num_hidden": 3})
+    rng = np.random.RandomState(5)
+    params = {"w": rng.randn(3, 4).astype(np.float32),
+              "b": rng.randn(3).astype(np.float32)}
+    x = rng.randn(2, 4).astype(np.float32)
+    ref = _forward(out, params, x)
+    path = str(tmp_path / "g.onnx")
+    mxonnx.export_model(out, params, [(2, 4)], onnx_file_path=path)
+    net = mxonnx.import_to_gluon(path)
+    got = net(mx.nd.array(x)).asnumpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
