@@ -14,6 +14,7 @@ from . import ops
 from . import ndarray
 from . import ndarray as nd
 from .ndarray import NDArray
+from .attribute import AttrScope
 
 waitall = engine.waitall
 

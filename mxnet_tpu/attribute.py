@@ -1,0 +1,53 @@
+"""Attribute scoping (parity: python/mxnet/attribute.py AttrScope).
+
+``with mx.AttrScope(ctx_group='dev1'):`` stamps every symbol created in
+the block with the given attributes — the reference uses this to annotate
+context groups for model parallelism (docs/faq/model_parallel_lstm.md);
+bind(group2ctx={...}) then places each group on its device.
+"""
+from __future__ import annotations
+
+import threading
+
+
+class AttrScope:
+    """Attach user attrs to every symbol created inside the scope."""
+
+    _current = threading.local()
+
+    def __init__(self, **kwargs):
+        for v in kwargs.values():
+            if not isinstance(v, str):
+                raise ValueError("AttrScope values must be strings")
+        self._attr = kwargs
+        self._old_scope = None
+
+    def get(self, attr):
+        """Merge scope attrs under explicit ``attr`` (explicit wins)."""
+        if not self._attr:
+            return attr or {}
+        ret = dict(self._attr)
+        if attr:
+            ret.update(attr)
+        return ret
+
+    def __enter__(self):
+        if not hasattr(AttrScope._current, "value"):
+            AttrScope._current.value = AttrScope()
+        self._old_scope = AttrScope._current.value
+        # nested scopes inherit the outer attrs
+        merged = dict(self._old_scope._attr)
+        merged.update(self._attr)
+        self._attr = merged
+        AttrScope._current.value = self
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        assert self._old_scope is not None
+        AttrScope._current.value = self._old_scope
+
+    @staticmethod
+    def _current_value():
+        if not hasattr(AttrScope._current, "value"):
+            AttrScope._current.value = AttrScope()
+        return AttrScope._current.value

@@ -127,15 +127,6 @@ def emit_float(field, value):
     return _tag(field, 5) + struct.pack("<f", float(value))
 
 
-def emit_packed_ints(field, values):
-    body = b"".join(_svarint(int(v)) for v in values)
-    return emit_bytes(field, body)
-
-
-def emit_packed_floats(field, values):
-    return emit_bytes(field, struct.pack(f"<{len(values)}f", *values))
-
-
 def parse_fields(buf):
     """Yield (field_number, wire_type, value) for every field in `buf`.
 
@@ -183,7 +174,9 @@ class TensorProto:
 
     @classmethod
     def from_array(cls, arr, name=""):
-        arr = np.ascontiguousarray(arr)
+        # NOT ascontiguousarray: it promotes 0-d scalars to shape (1,),
+        # and ORT requires e.g. Clip bounds to be true rank-0 tensors
+        arr = np.asarray(arr, order="C")
         return cls(name=name, dims=arr.shape,
                    data_type=np_to_onnx_dtype(arr.dtype),
                    raw_data=arr.tobytes())

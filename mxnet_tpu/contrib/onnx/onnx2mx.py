@@ -37,6 +37,16 @@ class _ImportCtx:
             raise MXNetError(f"ONNX import: expected constant input {name}")
         return self.consts[name]
 
+    def scalar(self, name):
+        """A constant as a python float (tolerates rank-0 and shape-(1,)
+        forms — both appear in the wild)."""
+        arr = np.asarray(self.const(name)).ravel()
+        if arr.size != 1:
+            raise MXNetError(
+                f"ONNX import: expected scalar constant {name}, "
+                f"got shape {arr.shape}")
+        return float(arr[0])
+
 
 def _attr_pads(attrs, nd):
     pads = attrs.get("pads")
@@ -221,7 +231,7 @@ def _concat(ctx, node, ins):
 def _dropout(ctx, node, ins):
     ratio = 0.5
     if len(node.inputs) > 1 and node.inputs[1]:
-        ratio = float(ctx.const(node.inputs[1]))
+        ratio = ctx.scalar(node.inputs[1])
     elif "ratio" in node.attrs:  # opset <12 attribute form
         ratio = float(node.attrs["ratio"])
     return _sym.Symbol._create("Dropout", [ins[0]], {"p": ratio})
@@ -281,8 +291,8 @@ def _reduce(ctx, node, ins):
 @_importer("Clip")
 def _clip(ctx, node, ins):
     if len(node.inputs) > 1:
-        lo = float(ctx.const(node.inputs[1])) if node.inputs[1] else -np.inf
-        hi = float(ctx.const(node.inputs[2])) \
+        lo = ctx.scalar(node.inputs[1]) if node.inputs[1] else -np.inf
+        hi = ctx.scalar(node.inputs[2]) \
             if len(node.inputs) > 2 and node.inputs[2] else np.inf
     else:  # opset <11 attribute form
         lo = float(node.attrs.get("min", -np.inf))
@@ -304,7 +314,7 @@ def _lrn(ctx, node, ins):
 def _pad(ctx, node, ins):
     if len(node.inputs) > 1:
         pads = [int(p) for p in ctx.const(node.inputs[1])]
-        cval = float(ctx.const(node.inputs[2])) \
+        cval = ctx.scalar(node.inputs[2]) \
             if len(node.inputs) > 2 and node.inputs[2] else 0.0
     else:
         pads = [int(p) for p in node.attrs.get("pads", ())]

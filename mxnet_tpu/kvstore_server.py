@@ -360,21 +360,27 @@ class KVClient:
 
     def close(self):
         self._hb_stop.set()
-        # close sockets so the server-side handler threads unblock; a
-        # close() racing the heartbeat loop is fine — the loop treats a
-        # dead socket as a stop signal
+        # close sockets so the server-side handler threads unblock. The
+        # heartbeat socket stays SET (not None) so a racing heartbeat()
+        # fails on the dead fd instead of transparently reconnecting
+        # post-close; the loop treats that failure as its stop signal.
         with self._hb_lock:
             if self._hb_sock is not None:
                 try:
                     self._hb_sock.close()
                 except OSError:
                     pass
-                self._hb_sock = None
-        with self._lock:
-            try:
-                self.sock.close()
-            except OSError:
-                pass
+        # shutdown OUTSIDE self._lock: an in-flight RPC (e.g. a barrier
+        # blocked in recv for up to 120s) holds the lock — shutdown aborts
+        # that recv immediately instead of waiting it out
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
 
     def _rpc(self, msg):
         with self._lock:

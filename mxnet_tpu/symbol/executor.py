@@ -29,9 +29,18 @@ class Executor:
     """Executor for a Symbol (parity: python/mxnet/executor.py Executor)."""
 
     def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write",
-                 aux_states=None):
+                 aux_states=None, group2ctx=None):
         self._symbol = symbol
         self._ctx = ctx or current_context()
+        # group2ctx model parallelism: only engage the multi-device path
+        # when the graph actually carries ctx_group annotations
+        self._grouped = None
+        if group2ctx:
+            has_groups = any(n.attrs.get("ctx_group")
+                             for n in symbol._topo())
+            if has_groups:
+                from .grouped import GroupedRunner
+                self._grouped = GroupedRunner(symbol, group2ctx, self._ctx)
         arg_names = symbol.list_arguments()
         aux_names = symbol.list_auxiliary_states()
 
@@ -166,6 +175,8 @@ class Executor:
                     self.arg_dict[name][:] = val
                 else:
                     self.arg_dict[name][:] = nd.array(val)
+        if self._grouped is not None:
+            return self._forward_grouped(bool(is_train))
         jitted, fwd_vjp_jit, grad_args = self._get_jitted(bool(is_train))
         key_arr = _random.next_key()
         arg_arrays = tuple(a._data for a in self.arg_arrays)
@@ -188,9 +199,64 @@ class Executor:
                 self._monitor_callback(n, o)
         return self.outputs
 
+    def _forward_grouped(self, is_train):
+        """Multi-device forward via GroupedRunner (group2ctx path)."""
+        key_arr = _random.next_key()
+        want_tape = is_train and any(
+            self.grad_req.get(n, "null") != "null" for n in self._arg_names)
+        arg_map = {n: a._data for n, a in zip(self._arg_names,
+                                              self.arg_arrays)
+                   if a is not None}
+        aux_map = {n: a._data for n, a in zip(self._aux_names,
+                                              self.aux_arrays)
+                   if a is not None}
+        outs, new_aux, tape = self._grouped.run(
+            key_arr, arg_map, aux_map, is_train, want_tape)
+        self._grouped_tape = tape
+        self._vjp_holder = None
+        self._last_is_train = is_train
+        for name, arr in zip(self._aux_names, self.aux_arrays):
+            if arr is not None:
+                arr._set_data(new_aux[name])
+        self.outputs = [NDArray(o, self._ctx) for o in outs]
+        if self._monitor_callback is not None:
+            names = self._symbol.list_outputs()
+            for n, o in zip(names, self.outputs):
+                self._monitor_callback(n, o)
+        return self.outputs
+
+    def _backward_grouped(self, out_grads):
+        if getattr(self, "_grouped_tape", None) is None:
+            raise MXNetError(
+                "backward requires forward(is_train=True) first")
+        if out_grads is None:
+            cts = [jnp.ones_like(o._data) for o in self.outputs]
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            cts = [g._data for g in out_grads]
+        grads_by_entry = {}
+        for entry, g in zip(self._symbol._outputs, cts):
+            grads_by_entry[entry] = g
+        var_grads = self._grouped.backward(self._grouped_tape,
+                                           grads_by_entry)
+        for name, g in var_grads.items():
+            req = self.grad_req.get(name, "null")
+            tgt = self.grad_dict.get(name)
+            if tgt is None or req == "null":
+                continue
+            if req == "add":
+                tgt._set_data(tgt._data + jax.device_put(
+                    g, next(iter(tgt._data.devices()))))
+            else:
+                tgt._set_data(jax.device_put(
+                    g, next(iter(tgt._data.devices()))).astype(tgt.dtype))
+
     def backward(self, out_grads=None, is_train=True):
         """Run backward and accumulate into args_grad per grad_req
         (parity: executor.py backward → GraphExecutor::Backward)."""
+        if self._grouped is not None:
+            return self._backward_grouped(out_grads)
         if self._vjp_holder is None:
             raise MXNetError(
                 "backward requires forward(is_train=True) first (parity: "

@@ -1,0 +1,144 @@
+"""Context-group model parallelism (parity: group2ctx).
+
+Reference: Executor::SimpleBind's group2ctx map + AssignContext pass
+(src/executor/graph_executor.cc:985,1876) place annotated subgraphs on
+different devices and the engine inserts cross-device copies
+(src/operator/cross_device_copy.cc). The TPU re-design: nodes annotated
+``ctx_group`` (via AttrScope or var attr) are executed
+computation-follows-data — each op's inputs are device_put onto the
+group's device and the op runs there; JAX's async dispatch overlaps the
+per-device streams exactly like the reference engine's per-device worker
+queues.
+
+Backward is a per-node vjp tape recorded during forward (the whole-graph
+single-jit path in executor.py cannot express multi-device placement:
+XLA pins one device per computation). Aux-state updates (BatchNorm
+moving stats) are primal side-outputs, excluded from differentiation —
+same contract as the single-jit path.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..ops import registry as _registry
+
+
+def _as_tuple(x):
+    return tuple(x) if isinstance(x, (tuple, list)) else (x,)
+
+
+class GroupedRunner:
+    """Execute a Symbol graph with per-group device placement."""
+
+    def __init__(self, symbol, group2ctx, default_ctx):
+        self._symbol = symbol
+        self._default_dev = default_ctx.jax_device
+        self._group_dev = {}
+        for group, ctx in (group2ctx or {}).items():
+            self._group_dev[group] = ctx.jax_device
+
+    def _node_device(self, node):
+        group = node.attrs.get("ctx_group")
+        # reference semantics: unmapped groups fall back to the default ctx
+        return self._group_dev.get(group, self._default_dev)
+
+    def run(self, key, arg_map, aux_map, is_train, want_tape):
+        """Forward pass. Returns (outputs, new_aux, tape).
+
+        arg_map/aux_map: name -> jax array. When ``want_tape`` each op is
+        run under jax.vjp and the tape records
+        (node, input_entries, vjp_fn, out_avals, is_random).
+        """
+        sym = self._symbol
+        env = {}
+        new_aux = dict(aux_map)
+        tape = [] if want_tape else None
+        counter = 0
+        for node in sym._topo():
+            if node.is_variable():
+                dev = self._node_device(node)
+                if node.name in arg_map:
+                    val = arg_map[node.name]
+                elif node.name in aux_map:
+                    val = aux_map[node.name]
+                else:
+                    raise MXNetError(
+                        f"executor: variable {node.name} was not bound")
+                env[(node, 0)] = jax.device_put(val, dev)
+                continue
+            op = _registry.get(node.op)
+            dev = self._node_device(node)
+            ins = [jax.device_put(env[e], dev) for e in node.inputs]
+            attrs = {k: v for k, v in node.attrs.items()
+                     if not k.startswith("__") and k != "ctx_group"}
+            if node.op in ("Dropout", "BatchNorm"):
+                attrs["_training"] = is_train
+            if op.is_random:
+                counter += 1
+                ins = [jax.device_put(jax.random.fold_in(key, counter),
+                                      dev)] + ins
+            raw = op.raw(attrs)
+            if want_tape:
+                outs, vjp_fn = jax.vjp(lambda *a: _as_tuple(raw(*a)), *ins)
+                tape.append((node, list(node.inputs), vjp_fn,
+                             [(o.shape, o.dtype) for o in outs],
+                             op.is_random, dev))
+            else:
+                outs = _as_tuple(raw(*ins))
+            n_user = len(outs) - len(op.mutate_aux)
+            for i, o in enumerate(outs[:n_user]):
+                env[(node, i)] = o
+            for j, in_idx in enumerate(op.mutate_aux):
+                src_node, _ = node.inputs[in_idx]
+                if src_node.is_variable() and src_node.name in new_aux:
+                    new_aux[src_node.name] = outs[n_user + j]
+        outputs = tuple(env[e] for e in sym._outputs)
+        return outputs, new_aux, tape
+
+    def backward(self, tape, out_grads):
+        """Walk the tape in reverse, accumulating per-variable cotangents.
+
+        out_grads: {(node, out_idx): cotangent} for the symbol outputs.
+        Returns {var_name: cotangent}.
+        """
+        sym = self._symbol
+        cts = {}
+        for entry, g in out_grads.items():
+            _accum(cts, entry, g)
+        for node, in_entries, vjp_fn, out_avals, is_random, dev \
+                in reversed(tape):
+            op = _registry.get(node.op)
+            n_user = len(out_avals) - len(op.mutate_aux)
+            have_any = any(cts.get((node, i)) is not None
+                           for i in range(n_user))
+            if not have_any:
+                continue  # nothing downstream consumed this node
+            out_ct = []
+            for i, (shape, dtype) in enumerate(out_avals):
+                g = cts.get((node, i)) if i < n_user else None
+                # aux updates carry zero cotangent (not differentiated);
+                # cotangents flow in from downstream devices — hop them
+                # onto this node's device (the reverse cross-device copy
+                # the reference engine would insert)
+                out_ct.append(jax.device_put(
+                    g if g is not None else jnp.zeros(shape, dtype), dev))
+            in_cts = vjp_fn(tuple(out_ct))
+            offset = 1 if is_random else 0  # skip RNG-key cotangent
+            for e, g in zip(in_entries, in_cts[offset:]):
+                _accum(cts, e, g)
+        var_grads = {}
+        for node in sym._topo():
+            if node.is_variable() and (node, 0) in cts:
+                var_grads[node.name] = cts[(node, 0)]
+        return var_grads
+
+
+def _accum(cts, entry, g):
+    cur = cts.get(entry)
+    if cur is None:
+        cts[entry] = g
+    else:
+        # cross-device consumers: accumulate on the first consumer's device
+        cts[entry] = cur + jax.device_put(g, next(iter(cur.devices())))

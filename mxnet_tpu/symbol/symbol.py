@@ -52,6 +52,8 @@ class Symbol:
     def _create(op_name, input_syms, attrs, name=None):
         op = _registry.get(op_name)
         attrs = {k: v for k, v in attrs.items() if v is not None}
+        from ..attribute import AttrScope
+        attrs = AttrScope._current_value().get(attrs)
         from ..name import NameManager
         name = NameManager._current_value().get(name, op_name.lower().strip("_"))
         entries = []
@@ -412,7 +414,8 @@ class Symbol:
     def bind(self, ctx, args, args_grad=None, grad_req="write", aux_states=None,
              group2ctx=None, shared_exec=None):
         from .executor import Executor
-        return Executor(self, ctx, args, args_grad, grad_req, aux_states)
+        return Executor(self, ctx, args, args_grad, grad_req, aux_states,
+                        group2ctx=group2ctx)
 
     def simple_bind(self, ctx, grad_req="write", type_dict=None,
                     stype_dict=None, group2ctx=None, shared_arg_names=None,
@@ -435,7 +438,7 @@ class Symbol:
         aux_states = {name: nd.zeros(shape, ctx=ctx)
                       for name, shape in zip(aux_names, aux_shapes)}
         return Executor(self, ctx, args, args_grad or None, grad_req,
-                        aux_states)
+                        aux_states, group2ctx=group2ctx)
 
     def bind_dict(self, ctx, arg_dict, grad_req="null"):
         """Convenience: bind with a name->NDArray dict covering all inputs."""
@@ -564,7 +567,8 @@ def var(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None,
     """Create a variable symbol (parity: symbol.py var/Variable)."""
     if not isinstance(name, str):
         raise TypeError("Expect a string for variable `name`")
-    attrs = dict(attr or {})
+    from ..attribute import AttrScope
+    attrs = AttrScope._current_value().get(dict(attr or {}))
     if shape is not None:
         attrs["__shape__"] = tuple(shape)
     if dtype is not None:
