@@ -463,10 +463,14 @@ class ImageIter(DataIter):
             if i == 0:
                 raise
         pad = batch_size - i
-        # NCHW for the device
-        batch_data = batch_data.transpose(0, 3, 1, 2)
+        # NCHW for the device: fused native pack (one OpenMP pass, no
+        # numpy stride-view materialization) when the library is present
+        from . import _native
+        packed = _native.batch_transform(batch_data)
+        if packed is None:
+            packed = np.ascontiguousarray(batch_data.transpose(0, 3, 1, 2))
         label_out = batch_label if self.label_width > 1 else batch_label[:, 0]
-        return DataBatch([nd.array(batch_data)], [nd.array(label_out)],
+        return DataBatch([nd.array(packed)], [nd.array(label_out)],
                          pad=pad)
 
     def augmentation_transform(self, data):

@@ -346,6 +346,10 @@ class KVClient:
 
     def heartbeat(self):
         with self._hb_lock:
+            if self._hb_stop.is_set():
+                # closed client must not transparently reconnect (it would
+                # report itself alive and leak the socket)
+                raise RuntimeError("heartbeat after close()")
             if self._hb_sock is None:
                 self._hb_sock = self._connect(self._timeout)
             _send_msg(self._hb_sock, {"op": "heartbeat",

@@ -200,6 +200,38 @@ class MXIndexedRecordIO(MXRecordIO):
         self.keys.append(key)
 
 
+def rec2idx(rec_path, idx_path=None, key_type=int):
+    """Rebuild the .idx file for a .rec (parity: tools/rec2idx.py).
+
+    Uses the native frame scanner (src/io_native.cc) when available —
+    one sequential pass, no payload reads — else a Python read loop.
+    Keys are sequential record ordinals (the im2rec convention).
+    """
+    idx_path = idx_path or os.path.splitext(rec_path)[0] + ".idx"
+    positions = []
+    from . import _native
+    scan = _native.scan_records(rec_path) if _native.available() else None
+    if scan is not None:
+        offsets, _lengths, cflags = scan
+        # record start = frame header start (offset - 8); multi-part
+        # records contribute only their FIRST frame (cflag 0 or 1)
+        for off, cf in zip(offsets, cflags):
+            if cf in (0, 1):
+                positions.append(int(off) - 8)
+    else:
+        reader = MXRecordIO(rec_path, "r")
+        while True:
+            pos = reader.tell()
+            if reader.read() is None:
+                break
+            positions.append(pos)
+        reader.close()
+    with open(idx_path, "w") as fout:
+        for i, pos in enumerate(positions):
+            fout.write(f"{key_type(i)}\t{pos}\n")
+    return len(positions)
+
+
 IRHeader = __import__("collections").namedtuple(
     "HEADER", ["flag", "label", "id", "id2"])
 _IR_FORMAT = "IfQQ"

@@ -35,6 +35,7 @@ class Executor:
         # group2ctx model parallelism: only engage the multi-device path
         # when the graph actually carries ctx_group annotations
         self._grouped = None
+        self._group2ctx = group2ctx
         if group2ctx:
             has_groups = any(n.attrs.get("ctx_group")
                              for n in symbol._topo())
@@ -237,7 +238,10 @@ class Executor:
             cts = [g._data for g in out_grads]
         grads_by_entry = {}
         for entry, g in zip(self._symbol._outputs, cts):
-            grads_by_entry[entry] = g
+            prev = grads_by_entry.get(entry)
+            # duplicate output entries (Group([y, y])) sum their cotangents,
+            # matching the single-jit vjp path
+            grads_by_entry[entry] = g if prev is None else prev + g
         var_grads = self._grouped.backward(self._grouped_tape,
                                            grads_by_entry)
         for name, g in var_grads.items():
@@ -331,7 +335,8 @@ class Executor:
             else:
                 new_aux[name] = nd.zeros(shape, ctx=self._ctx)
         return Executor(self._symbol, self._ctx, new_args, new_grads,
-                        self.grad_req, new_aux)
+                        self.grad_req, new_aux,
+                        group2ctx=self._group2ctx)
 
     def set_monitor_callback(self, callback, monitor_all=False):
         """Install per-output callback (parity: graph_executor.cc:1403

@@ -1,0 +1,94 @@
+"""Native data-plane tests (src/io_native.cc via mxnet_tpu._native).
+
+Every native kernel is checked against its pure-Python/numpy fallback;
+tests skip cleanly when no C++ toolchain is available (the framework's
+contract: native absence degrades speed, never capability)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import _native, recordio
+
+needs_native = pytest.mark.skipif(not _native.available(),
+                                  reason="native io library not built")
+
+
+@needs_native
+def test_batch_transform_uint8_matches_numpy():
+    rng = np.random.RandomState(0)
+    imgs = rng.randint(0, 256, (6, 11, 13, 3), dtype=np.uint8)
+    mirror = (rng.rand(6) > 0.5).astype(np.uint8)
+    mean = np.array([123.68, 116.28, 103.53], np.float32)
+    std = np.array([58.395, 57.12, 57.375], np.float32)
+    got = _native.batch_transform(imgs, mirror, mean, std)
+    ref = imgs.astype(np.float32)
+    m = mirror.astype(bool)
+    ref[m] = ref[m][:, :, ::-1, :]
+    ref = ((ref - mean) / std).transpose(0, 3, 1, 2)
+    np.testing.assert_allclose(got, ref, atol=1e-4)
+
+
+@needs_native
+def test_batch_transform_f32_plain_pack():
+    rng = np.random.RandomState(1)
+    imgs = rng.rand(4, 8, 8, 3).astype(np.float32)
+    got = _native.batch_transform(imgs)
+    np.testing.assert_allclose(got, imgs.transpose(0, 3, 1, 2), atol=1e-6)
+
+
+@needs_native
+def test_scan_and_gather_roundtrip(tmp_path):
+    rng = np.random.RandomState(2)
+    p = str(tmp_path / "t.rec")
+    w = recordio.MXRecordIO(p, "w")
+    recs = [bytes(rng.randint(0, 256, rng.randint(1, 300),
+                              dtype=np.uint8)) for _ in range(40)]
+    for r in recs:
+        w.write(r)
+    w.close()
+    offsets, lengths, cflags = _native.scan_records(p)
+    assert len(offsets) == 40 and (cflags == 0).all()
+    buf, oo = _native.gather(p, offsets, lengths)
+    for i, r in enumerate(recs):
+        assert buf[oo[i]:oo[i] + lengths[i]].tobytes() == r
+
+
+def test_rec2idx_matches_writer_index(tmp_path):
+    rng = np.random.RandomState(3)
+    rec = str(tmp_path / "d.rec")
+    idx = str(tmp_path / "d.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(25):
+        w.write_idx(i, bytes(rng.randint(0, 256, rng.randint(1, 100),
+                                         dtype=np.uint8)))
+    w.close()
+    with open(idx) as f:
+        original = f.read()
+    os.remove(idx)
+    n = recordio.rec2idx(rec, idx)
+    assert n == 25
+    with open(idx) as f:
+        rebuilt = f.read()
+    assert rebuilt == original
+    # and the rebuilt index serves random access
+    r = recordio.MXIndexedRecordIO(idx, rec, "r")
+    assert r.read_idx(7) is not None
+    r.close()
+
+
+def test_rec2idx_python_fallback(tmp_path, monkeypatch):
+    monkeypatch.setattr(_native, "available", lambda: False)
+    rng = np.random.RandomState(4)
+    rec = str(tmp_path / "f.rec")
+    w = recordio.MXRecordIO(rec, "w")
+    for _ in range(10):
+        w.write(bytes(rng.randint(0, 256, 50, dtype=np.uint8)))
+    w.close()
+    assert recordio.rec2idx(rec) == 10
+
+
+def test_batch_transform_none_when_disabled(monkeypatch):
+    monkeypatch.setattr(_native, "get_lib", lambda: None)
+    assert _native.batch_transform(np.zeros((1, 2, 2, 3), np.uint8)) is None

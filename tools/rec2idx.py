@@ -1,0 +1,27 @@
+#!/usr/bin/env python
+"""Rebuild the .idx file for a RecordIO .rec file.
+
+Parity: reference tools/rec2idx.py. Uses the native frame scanner
+(src/io_native.cc) when built — a single sequential header pass — and
+falls back to a Python read loop otherwise.
+
+Usage: python tools/rec2idx.py data.rec [data.idx]
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    if len(sys.argv) < 2:
+        sys.exit(__doc__)
+    from mxnet_tpu import recordio
+    rec = sys.argv[1]
+    idx = sys.argv[2] if len(sys.argv) > 2 else None
+    n = recordio.rec2idx(rec, idx)
+    print(f"wrote {n} index entries")
+
+
+if __name__ == "__main__":
+    main()
