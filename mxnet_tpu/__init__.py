@@ -26,7 +26,7 @@ def __getattr__(name):
                 "symbol", "sym", "io", "image", "kvstore", "profiler", "module",
                 "callback", "monitor", "parallel", "test_utils", "visualization",
                 "executor", "runtime", "model", "recordio", "contrib", "amp", "config",
-                "operator"):
+                "operator", "subgraph", "attribute"):
         target = {"sym": "symbol"}.get(name, name)
         mod = importlib.import_module(f".{target}", __name__)
         globals()[name] = mod

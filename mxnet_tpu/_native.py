@@ -68,7 +68,8 @@ def get_lib():
         if _TRIED:
             return _LIB
         _TRIED = True
-        if os.environ.get("MXNET_NATIVE_IO", "1") == "0":
+        from .config import get as _cfg
+        if not _cfg("MXNET_NATIVE_IO"):
             return None
         if not os.path.exists(_LIB_PATH) and not _build():
             return None

@@ -79,6 +79,12 @@ _register("MXNET_ENGINE_TYPE", str, "ThreadedEnginePerDevice",
           "src/engine/naive_engine.cc)")
 _register("MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN", int, 15,
           "bulking hint kept for API parity; XLA fuses regardless")
+_register("MXNET_SUBGRAPH_BACKEND", str, "",
+          "graph-rewrite backend applied at bind time (parity: "
+          "src/operator/subgraph/; e.g. 'dense_act'); empty disables")
+_register("MXNET_NATIVE_IO", bool, True,
+          "load the native data-plane library (src/io_native.cc); "
+          "0 forces the pure-Python paths")
 # -- kvstore / distributed ---------------------------------------------------
 _register("MXNET_KVSTORE_AUTH_TOKEN", str, "",
           "HMAC key for dist kvstore frames (REQUIRED for non-loopback "
@@ -91,6 +97,9 @@ _register("MXNET_KVSTORE_MAX_FRAME", int, 1 << 30,
 _register("MXNET_KVSTORE_HEARTBEAT_INTERVAL", float, 5.0,
           "worker heartbeat period in seconds (0 disables); feeds "
           "get_num_dead_node")
+_register("MXNET_KVSTORE_BIGARRAY_BOUND", int, 1000000,
+          "arrays larger than this many elements are pushed/pulled in "
+          "row chunks (parity: kvstore_dist.h:243 key sharding)")
 _register("DMLC_ROLE", str, "worker",
           "process role: worker | server (ps-lite contract)")
 _register("DMLC_RANK", int, 0, "worker rank")

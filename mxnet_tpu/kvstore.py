@@ -216,14 +216,107 @@ class KVStore:
 
 
 class KVStoreICI(KVStore):
-    """SPMD facade: gradients synchronize inside the pjit'd step (XLA psum
-    over ICI), so push/pull become local bookkeeping. Exists so
-    gluon.Trainer / Module.fit code written against kvstore keeps working
-    when the model runs under mxnet_tpu.parallel (SURVEY.md §5 'KVStore(ici)'
-    north star)."""
+    """XLA-collective store (SURVEY.md §5 'KVStore(ici)' north star).
+
+    Gradient allreduce runs as ONE jitted XLA computation over the devices
+    holding the pushed copies: per-device arrays are assembled into a
+    sharded jax.Array over a throwaway 1-axis mesh and summed with
+    replicated out_shardings — XLA lowers that to an all-reduce riding the
+    ICI torus (CommDevice/NCCL equivalent, zero host round-trips). pull
+    hands back each device's replicated shard without any transfer.
+    gluon.Trainer / Module.fit select it with kvstore='ici'."""
 
     def __init__(self):
         super().__init__("ici")
+        self._fn_cache = {}
+        self._replicated = {}  # key -> replicated jax.Array after push
+
+    def _allreduce(self, vlist):
+        import jax
+        import numpy as _np
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        devs = tuple(next(iter(v._data.devices())) for v in vlist)
+        if len(set(devs)) != len(devs):
+            # duplicate devices (e.g. tests faking multi-device on one
+            # chip): plain add is both correct and optimal
+            total = vlist[0]._data
+            for v in vlist[1:]:
+                total = total + v._data
+            return None, total
+        shape = tuple(vlist[0].shape)
+        ckey = (devs, shape, str(vlist[0].dtype))
+        entry = self._fn_cache.get(ckey)
+        if entry is None:
+            mesh = Mesh(_np.array(devs), ("dp",))
+            fn = jax.jit(lambda x: x.sum(0),
+                         out_shardings=NamedSharding(mesh, P()))
+            entry = (mesh, fn)
+            self._fn_cache[ckey] = entry
+        mesh, fn = entry
+        shards = [v._data[None] for v in vlist]  # (1,)+shape, on-device
+        stacked = jax.make_array_from_single_device_arrays(
+            (len(vlist),) + shape, NamedSharding(mesh, P("dp")), shards)
+        return fn(stacked), None
+
+    def push(self, key, value, priority=0):
+        from .ndarray import sparse as _sp
+        keys, values = _key_grouped(key, value)
+        for k, vlist in zip(keys, values):
+            if k not in self._store:
+                raise MXNetError(f"key {k} was not init()ed")
+            if any(isinstance(v, _sp.BaseSparseNDArray) for v in vlist) or \
+                    len(vlist) == 1:
+                # sparse or single-device: the local reduction is optimal
+                self._replicated.pop(k, None)
+                super().push(k, vlist, priority)
+                continue
+            replicated, plain = self._allreduce(vlist)
+            stored = self._store[k]
+            if replicated is None:
+                merged_dev0 = plain
+            else:
+                # the shard on the stored array's device (no transfer)
+                sdev = next(iter(stored._data.devices()))
+                merged_dev0 = None
+                for shard in replicated.addressable_shards:
+                    if shard.device == sdev:
+                        merged_dev0 = shard.data
+                        break
+                if merged_dev0 is None:
+                    merged_dev0 = replicated.addressable_shards[0].data
+            merged = NDArray(merged_dev0, stored.ctx)
+            if self._updater is not None:
+                self._replicated.pop(k, None)  # weights changed: rebroadcast
+                self._updater(_updater_key(k), merged, stored)
+            else:
+                stored._set_data(merged._data)
+                if replicated is not None:
+                    self._replicated[k] = replicated
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        from .ndarray.sparse import BaseSparseNDArray
+        assert out is not None
+        keys, outs = _key_grouped(key, out)
+        for k, olist in zip(keys, outs):
+            replicated = self._replicated.get(k)
+            stored = self._store[k]
+            for o in olist:
+                if isinstance(o, BaseSparseNDArray):
+                    if ignore_sparse:
+                        continue
+                    raise MXNetError("pull into sparse: use row_sparse_pull")
+                odev = next(iter(o._data.devices()))
+                shard_data = None
+                if replicated is not None:
+                    for shard in replicated.addressable_shards:
+                        if shard.device == odev:
+                            shard_data = shard.data
+                            break
+                if shard_data is not None:
+                    o._set_data(shard_data)
+                else:
+                    import jax
+                    o._set_data(jax.device_put(stored._data, odev))
 
 
 class KVStoreDist(KVStore):
@@ -237,6 +330,7 @@ class KVStoreDist(KVStore):
                                         os.environ.get("DMLC_WORKER_ID", 0)))
         self._num_workers = int(os.environ.get("DMLC_NUM_WORKER", 1))
         self._client = None
+        self._chunked = {}  # key -> chunk layout (None = unchunked)
         root_uri = os.environ.get("DMLC_PS_ROOT_URI")
         if self._num_workers > 1 and root_uri:
             from .kvstore_server import KVClient
@@ -252,14 +346,45 @@ class KVStoreDist(KVStore):
     def num_workers(self):
         return self._num_workers
 
+    @staticmethod
+    def _chunk_layout(k, shape):
+        """Row-chunk plan for a big dense array under derived keys
+        (parity: kvstore_dist.h big-array key sharding over servers,
+        MXNET_KVSTORE_BIGARRAY_BOUND). Bounds the wire frame size and
+        lets chunk pushes pipeline through the server. Returns
+        [(key, row_start, row_stop)] — a single entry means unchunked."""
+        from .config import get as _cfg
+        import numpy as np
+        bound = _cfg("MXNET_KVSTORE_BIGARRAY_BOUND")
+        size = int(np.prod(shape)) if shape else 1
+        if size <= bound or not shape or shape[0] < 2:
+            return [(k, 0, shape[0] if shape else 0)]
+        rows_per = max(int(bound // max(size // shape[0], 1)), 1)
+        return [(f"{k}#chunk{i}", start, min(start + rows_per, shape[0]))
+                for i, start in enumerate(range(0, shape[0], rows_per))]
+
     def init(self, key, value):
         if self._client is None:
             return super().init(key, value)
         keys, values = _key_value(key, value)
         for k, v in zip(keys, values):
             self._store[k] = v.copy()
+            # the chunk decision is made ONCE here and remembered: every
+            # later access (push/pull/row_sparse/compressed) must agree on
+            # the server key namespace. Compression writes whole keys, so
+            # a compressed store never chunks.
+            if self._compression is None:
+                layout = self._chunk_layout(k, tuple(v.shape))
+            else:
+                layout = [(k, 0, v.shape[0] if v.shape else 0)]
+            self._chunked[k] = layout if len(layout) > 1 else None
             if self._rank == 0:
-                self._client.init(k, v.asnumpy())
+                if self._chunked[k] is None:
+                    self._client.init(k, v.asnumpy())
+                else:
+                    arr = v.asnumpy()
+                    self._client.init_many(
+                        [(ck, arr[b:e]) for ck, b, e in layout])
         self._client.barrier()
 
     def push(self, key, value, priority=0):
@@ -274,6 +399,7 @@ class KVStoreDist(KVStore):
                     raise MXNetError(
                         "gradient compression does not support row_sparse "
                         "pushes (reference kvstore_dist parity)")
+                self._check_not_chunked(k, "row_sparse push")
                 merged = vlist[0]
                 for v in vlist[1:]:
                     merged = _sp.elemwise_add(merged, v)
@@ -288,24 +414,46 @@ class KVStoreDist(KVStore):
             if gc is not None:
                 # 2-bit codes + error-feedback residual on this worker
                 # (parity: KVStoreDist::PushCompressed)
+                self._check_not_chunked(k, "compressed push")
                 self._client.push_compressed(
                     k, gc.encode_push(k, merged.asnumpy()), sync=sync)
             else:
-                self._client.push(k, merged.asnumpy(), sync=sync)
+                layout = self._chunked.get(k)
+                if layout is None:
+                    self._client.push(k, merged.asnumpy(), sync=sync)
+                else:  # pipelined chunk pushes: one in-flight window
+                    arr = merged.asnumpy()
+                    self._client.push_many(
+                        [(ck, arr[b:e]) for ck, b, e in layout], sync=sync)
+
+    def _check_not_chunked(self, k, what):
+        if self._chunked.get(k) is not None:
+            raise MXNetError(
+                f"{what} on key {k!r} is incompatible with big-array "
+                "chunking (array exceeds MXNET_KVSTORE_BIGARRAY_BOUND "
+                "elements); raise the bound for this key's workflow, or "
+                "enable compression/sparse before init")
 
     def _fetch_rows(self, k, stored, rows):
         # only the requested rows cross the wire (kvstore_dist.h:243)
         if self._client is None:
             return super()._fetch_rows(k, stored, rows)
+        self._check_not_chunked(k, "row_sparse pull")
         import jax.numpy as jnp
         return jnp.asarray(self._client.pull_rows(k, rows))
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         if self._client is None:
             return super().pull(key, out, priority, ignore_sparse)
+        import numpy as np
         keys, outs = _key_grouped(key, out)
         for k, olist in zip(keys, outs):
-            arr = self._client.pull(k)
+            layout = self._chunked.get(k)
+            if layout is None:
+                arr = self._client.pull(k)
+            else:  # big array: pipelined chunk pulls, reassembled
+                parts = self._client.pull_many([ck for ck, _b, _e in layout])
+                arr = np.concatenate(parts, axis=0)
             for o in olist:
                 o[:] = arr
 

@@ -394,6 +394,19 @@ class KVClient:
             raise RuntimeError(f"kvstore server rpc failed: {resp}")
         return resp
 
+    def _rpc_many(self, msgs):
+        """Pipelined round-trips: send every request, then drain the
+        replies — one lock hold, one in-flight window (used by big-array
+        chunk push/pull so chunking doesn't serialize latency)."""
+        with self._lock:
+            for m in msgs:
+                _send_msg(self.sock, m)
+            resps = [_recv_msg(self.sock) for _ in msgs]
+        for resp in resps:
+            if resp is None or not resp.get("ok"):
+                raise RuntimeError(f"kvstore server rpc failed: {resp}")
+        return resps
+
     def init(self, key, value):
         self._rpc({"op": "init", "key": key, "value": np.asarray(value)})
 
@@ -425,6 +438,23 @@ class KVClient:
         return self._rpc({"op": "pull", "key": key,
                           "min_version": self._push_counts.get(key, 0)}
                          )["value"]
+
+    def init_many(self, kv_pairs):
+        self._rpc_many([{"op": "init", "key": k, "value": np.asarray(v)}
+                        for k, v in kv_pairs])
+
+    def push_many(self, kv_pairs, sync=True):
+        self._rpc_many([{"op": "push", "key": k, "value": np.asarray(v),
+                         "sync": sync} for k, v in kv_pairs])
+        if sync:
+            for k, _v in kv_pairs:
+                self._push_counts[k] = self._push_counts.get(k, 0) + 1
+
+    def pull_many(self, keys):
+        resps = self._rpc_many(
+            [{"op": "pull", "key": k,
+              "min_version": self._push_counts.get(k, 0)} for k in keys])
+        return [r["value"] for r in resps]
 
     def pull_rows(self, key, rows):
         """Pull only the requested rows (row_sparse pull)."""

@@ -175,6 +175,19 @@ class NDArray:
                 f"async operator execution failed (surfaced at "
                 f"asnumpy): {e}") from e
 
+    def __array__(self, dtype=None, copy=None):
+        # without this, np.asarray(ndarray) walks __getitem__ element by
+        # element — one jax dispatch per scalar
+        if copy is False:
+            # numpy-2 contract: a zero-copy view of device memory is
+            # impossible; raising lets np.asarray(..., copy=False) fail
+            # loudly instead of handing back a throwaway buffer
+            raise ValueError(
+                "NDArray device data cannot be aliased as a numpy array "
+                "without a copy")
+        arr = self.asnumpy()
+        return arr.astype(dtype) if dtype is not None else arr
+
     def asscalar(self):
         if self.size != 1:
             raise MXNetError("The current array is not a scalar")

@@ -298,11 +298,82 @@ def _sparse_dot(a, b, transpose_a=False, transpose_b=False):
         _ag.record_custom("dot_csr_dense", [b], [result], vjp,
                           {"transpose_a": transpose_a})
         return result
-    if isinstance(a, RowSparseNDArray):
+    if isinstance(a, RowSparseNDArray) and isinstance(b, NDArray) and \
+            not isinstance(b, BaseSparseNDArray) and b._data.ndim >= 2:
+        # rsp·dense / rspᵀ·dense without densifying: only the stored rows
+        # contribute (reference dot-inl.h DotDnsRsp paths)
+        idx = a._indices.astype(jnp.int32)
+        vals = a._data  # [nnz_rows, D]
+        if not transpose_a:
+            # out[r,:] = vals_r @ b  for stored r, zero elsewhere
+            out = jnp.zeros((a.shape[0], b.shape[1]), dtype=b.dtype)
+            out = out.at[idx].set(vals @ b._data)
+            result = NDArray(out, a._ctx)
+
+            def vjp(ct, _vals=vals, _idx=idx):
+                # db = rspᵀ·ct = valsᵀ @ ct[idx]  (dense cotangent)
+                return (_vals.T @ ct[_idx],)
+        else:
+            # out[d,k] = Σ_stored vals[i,d]·b[idx_i,k]
+            out = vals.T @ b._data[idx]
+            result = NDArray(out, a._ctx)
+
+            def vjp(ct, _vals=vals, _idx=idx, _shape=b.shape):
+                # db[idx_i,:] = vals_i @ ct — row-sparse cotangent
+                return (_ag.SparseCot(_idx, _vals @ ct, _shape),)
+
+        _ag.record_custom("dot_rsp_dense", [b], [result], vjp,
+                          {"transpose_a": transpose_a})
+        return result
+    if isinstance(b, CSRNDArray) and isinstance(a, NDArray) and \
+            not isinstance(a, BaseSparseNDArray) and a._data.ndim >= 2:
+        # dense·csr / dense·csrᵀ without densifying (reference
+        # dot-inl.h DotDnsCsr paths): nnz-work scatter/gather
+        rows = b._row_ids()
+        cols = b._indices.astype(jnp.int32)
+        data = b._data
+        if transpose_a:
+            raise MXNetError("dot(dense, csr, transpose_a=True) unsupported")
+        if not transpose_b:
+            # out[:,c] += a[:,row_k]·data_k  for each nnz k
+            contrib = a._data[:, rows] * data[None, :]
+            out = jnp.zeros((a.shape[0], b.shape[1]), dtype=a.dtype)
+            out = out.at[:, cols].add(contrib)
+            result = NDArray(out, a._ctx)
+
+            def vjp(ct, _rows=rows, _cols=cols, _data=data,
+                    _shape=a.shape):
+                # da[:,row_k] += ct[:,col_k]·data_k
+                vals = ct[:, _cols] * _data[None, :]
+                return (jnp.zeros(_shape, ct.dtype)
+                        .at[:, _rows].add(vals),)
+        else:
+            # out[:,r] += a[:,col_k]·data_k (b transposed)
+            contrib = a._data[:, cols] * data[None, :]
+            out = jnp.zeros((a.shape[0], b.shape[0]), dtype=a.dtype)
+            out = out.at[:, rows].add(contrib)
+            result = NDArray(out, a._ctx)
+
+            def vjp(ct, _rows=rows, _cols=cols, _data=data,
+                    _shape=a.shape):
+                # da[:,col_k] += ct[:,row_k]·data_k
+                vals = ct[:, _rows] * _data[None, :]
+                return (jnp.zeros(_shape, ct.dtype)
+                        .at[:, _cols].add(vals),)
+
+        _ag.record_custom("dot_dense_csr", [a], [result], vjp,
+                          {"transpose_b": transpose_b})
+        return result
+    # remaining combinations (incl. 1-D operands): densify — correct,
+    # full-shape work (reference falls back likewise for odd stypes)
+    if isinstance(a, BaseSparseNDArray) and not isinstance(
+            b, BaseSparseNDArray):
         return NDArray(jnp.tensordot(a.todense()._data, b._data, axes=1),
                        a._ctx)
     if isinstance(b, BaseSparseNDArray):
-        return NDArray(jnp.tensordot(a._data, b.todense()._data, axes=1),
+        a_data = a.todense()._data if isinstance(a, BaseSparseNDArray) \
+            else a._data
+        return NDArray(jnp.tensordot(a_data, b.todense()._data, axes=1),
                        a._ctx)
     raise MXNetError("unsupported sparse dot combination")
 

@@ -1,0 +1,296 @@
+"""Subgraph partition / graph-rewrite framework.
+
+Parity: reference src/operator/subgraph/ — SubgraphProperty +
+SubgraphSelector walk the graph, claim regions, and replace each with a
+single subgraph op (build_subgraph.cc); backends select via
+MXNET_SUBGRAPH_BACKEND. That machinery is the basis of the reference's
+MKLDNN fusion, TensorRT offload and quantized-graph passes.
+
+TPU re-design: XLA already fuses elementwise chains into matmuls, so the
+framework's value here is *semantic* rewriting — swapping a matched
+region for a different implementation (a Pallas kernel, a quantized op,
+a precision-cast region) rather than micro-fusion. A claimed region is
+replaced by one `_subgraph` node whose attrs carry the inner graph as
+MXNet JSON; its fcompute re-traces the inner graph, so under jit the
+whole region still compiles into the enclosing XLA computation.
+
+Region contract (v1): single external output — the selector grows
+producer-into-consumer from a seed, and a producer joins only if every
+consumer lies inside the region. This makes cycles impossible by
+construction (no internal node is visible outside except the seed).
+Random / aux-mutating ops (Dropout, BatchNorm) never join a region.
+"""
+from __future__ import annotations
+
+import json
+
+from .base import MXNetError
+from .ops import registry as _registry
+
+_PROPERTIES = {}
+
+
+def register_subgraph_property(name, prop_cls=None):
+    """Register a SubgraphProperty under ``name`` (decorator or direct)."""
+    def deco(cls):
+        _PROPERTIES[name] = cls
+        return cls
+    if prop_cls is not None:
+        return deco(prop_cls)
+    return deco
+
+
+def list_backends():
+    return sorted(_PROPERTIES)
+
+
+def get_property(name):
+    if name not in _PROPERTIES:
+        raise MXNetError(f"unknown subgraph backend '{name}' "
+                         f"(registered: {list_backends()})")
+    return _PROPERTIES[name]()
+
+
+class SubgraphSelector:
+    """Per-region growth policy (parity: subgraph_property.h
+    SubgraphSelector). The partitioner seeds a region at a node where
+    ``select`` is true, then repeatedly offers producers via
+    ``select_input``."""
+
+    def select(self, node):
+        return False
+
+    def select_input(self, node, input_node):
+        return False
+
+
+class SubgraphProperty:
+    """A rewrite backend (parity: SubgraphProperty)."""
+
+    #: smallest region worth rewriting; 1 enables single-node op
+    #: substitution (e.g. swapping a matched op for a Pallas kernel)
+    min_subgraph_size = 2
+
+    def create_selector(self):
+        raise NotImplementedError
+
+    def create_subgraph_node(self, subgraph_sym, input_syms, subgraph_id):
+        """Default replacement: a `_subgraph` op carrying the inner JSON."""
+        from .symbol.symbol import Symbol
+        return Symbol._create(
+            "_subgraph", input_syms,
+            {"subgraph_json": subgraph_sym.tojson(),
+             "subgraph_backend": type(self).__name__,
+             "subgraph_id": subgraph_id})
+
+
+# --- the generic subgraph op -----------------------------------------------
+_SUBGRAPH_CACHE = {}
+
+
+def _inner_symbol(json_str):
+    sym = _SUBGRAPH_CACHE.get(json_str)
+    if sym is None:
+        from .symbol.symbol import load_json
+        sym = load_json(json_str)
+        _SUBGRAPH_CACHE[json_str] = sym
+    return sym
+
+
+def _exec_inner(sym, inputs):
+    """Trace the inner graph on jax values (inputs in list_inputs order)."""
+    env = {}
+    in_map = dict(zip(sym.list_inputs(), inputs))
+    for node in sym._topo():
+        if node.is_variable():
+            env[(node, 0)] = in_map[node.name]
+            continue
+        op = _registry.get(node.op)
+        attrs = {k: v for k, v in node.attrs.items()
+                 if not k.startswith("__")}
+        ins = [env[e] for e in node.inputs]
+        out = op.fcompute(attrs, *ins)
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        for i, o in enumerate(outs):
+            env[(node, i)] = o
+    return env[sym._outputs[0]]
+
+
+@_registry.register("_subgraph")
+def _subgraph_fcompute(attrs, *inputs):
+    sym = _inner_symbol(attrs["subgraph_json"])
+    return _exec_inner(sym, inputs)
+
+
+# --- partitioner ------------------------------------------------------------
+def partition(sym, prop):
+    """Apply ``prop`` to ``sym``: claim regions, replace each with one
+    subgraph node. Returns the rewritten Symbol (parity:
+    build_subgraph.cc BuildSubgraph)."""
+    from .symbol.symbol import Symbol, _SymNode
+
+    if isinstance(prop, str):
+        prop = get_property(prop)
+
+    topo = sym._topo()
+    consumers = {}  # node -> set of consumer nodes
+    for n in topo:
+        for (src, _i) in n.inputs:
+            consumers.setdefault(id(src), set()).add(id(n))
+    output_nodes = {id(n) for (n, _i) in sym._outputs}
+
+    claimed = set()
+    regions = []  # (seed_node, set_of_member_ids, members_topo_list)
+    for seed in reversed(topo):  # consumers first: largest fusions win
+        if id(seed) in claimed or seed.is_variable():
+            continue
+        selector = prop.create_selector()
+        if not selector.select(seed):
+            continue
+        if _is_stateful(seed):
+            continue
+        region = {id(seed)}
+        members = [seed]
+        frontier = [seed]
+        while frontier:
+            node = frontier.pop()
+            for (src, _i) in node.inputs:
+                if src.is_variable() or id(src) in region \
+                        or id(src) in claimed or _is_stateful(src):
+                    continue
+                # single-output contract: every consumer of the producer
+                # must already be inside the region, and it must not be a
+                # graph output itself
+                if id(src) in output_nodes:
+                    continue
+                if not consumers.get(id(src), set()) <= region:
+                    continue
+                if selector.select_input(node, src):
+                    region.add(id(src))
+                    members.append(src)
+                    frontier.append(src)
+        if len(region) >= prop.min_subgraph_size:
+            claimed |= region
+            regions.append((seed, region))
+
+    if not regions:
+        return sym
+
+    # rebuild the graph bottom-up, swapping claimed regions
+    region_of = {}
+    for seed, region in regions:
+        for nid in region:
+            region_of[nid] = id(seed)
+    seed_by_id = {id(seed): (seed, region) for seed, region in regions}
+
+    new_nodes = {}       # id(old_node) -> new _SymNode
+    subgraph_out = {}    # id(seed) -> replacement Symbol
+
+    def map_entry(entry):
+        src, i = entry
+        rid = region_of.get(id(src))
+        if rid is not None:
+            rep = subgraph_out[rid]
+            return rep._outputs[0]
+        return (new_nodes[id(src)], i)
+
+    sub_count = 0
+    for n in topo:
+        rid = region_of.get(id(n))
+        if rid is not None and rid != id(n):
+            continue  # interior region node: swallowed by its seed
+        if rid == id(n):
+            seed, region = seed_by_id[rid]
+            inner_sym, ext_inputs = _extract(sym, seed, region)
+            input_syms = [Symbol([map_entry(e)]) for e in ext_inputs]
+            rep = prop.create_subgraph_node(inner_sym, input_syms, sub_count)
+            sub_count += 1
+            subgraph_out[rid] = rep
+            continue
+        node = _SymNode(n.op, n.name, dict(n.attrs))
+        new_nodes[id(n)] = node
+        node.inputs = [map_entry(e) for e in n.inputs]
+
+    outs = []
+    for (n, i) in sym._outputs:
+        rid = region_of.get(id(n))
+        if rid is not None:
+            outs.append(subgraph_out[rid]._outputs[0])
+        else:
+            outs.append((new_nodes[id(n)], i))
+    return Symbol(outs)
+
+
+def _is_stateful(node):
+    if node.is_variable():
+        return False
+    op = _registry.get(node.op)
+    return op.is_random or bool(op.mutate_aux) or \
+        (isinstance(op.num_outputs, int) and op.num_outputs > 1)
+
+
+def _extract(sym, seed, region):
+    """Inner symbol of a region: external entries become fresh variables
+    named _in0.. in first-use order. Returns (inner_sym, ext_entries)."""
+    from .symbol.symbol import Symbol, _SymNode
+
+    ext_entries = []
+    ext_map = {}
+    clones = {}
+
+    def clone(node):
+        c = clones.get(id(node))
+        if c is not None:
+            return c
+        c = _SymNode(node.op, node.name, dict(node.attrs))
+        clones[id(node)] = c
+        ins = []
+        for (src, i) in node.inputs:
+            if id(src) in region:
+                ins.append((clone(src), i))
+            else:
+                key = (id(src), i)
+                if key not in ext_map:
+                    v = _SymNode(None, f"_in{len(ext_entries)}", {})
+                    ext_map[key] = v
+                    ext_entries.append((src, i))
+                ins.append((ext_map[key], 0))
+        c.inputs = ins
+        return c
+
+    inner = Symbol([(clone(seed), 0)])
+    return inner, ext_entries
+
+
+# --- built-in properties ----------------------------------------------------
+class _DenseActSelector(SubgraphSelector):
+    _ELEMWISE = {"Activation", "relu", "sigmoid", "tanh", "LeakyReLU",
+                 "clip", "_plus_scalar", "_mul_scalar"}
+
+    def select(self, node):
+        return node.op in self._ELEMWISE
+
+    def select_input(self, node, input_node):
+        return input_node.op == "FullyConnected" or \
+            input_node.op in self._ELEMWISE
+
+
+@register_subgraph_property("dense_act")
+class DenseActivationFusion(SubgraphProperty):
+    """Fuse FullyConnected + trailing elementwise chain into one subgraph
+    op (the reference's MKLDNN fc+act fusion analogue; under XLA this is
+    a semantic grouping that guarantees one fused kernel)."""
+
+    def create_selector(self):
+        return _DenseActSelector()
+
+
+def apply_backend(sym, backend=None):
+    """Apply the env-selected backend (MXNET_SUBGRAPH_BACKEND) to a
+    Symbol; identity when unset/unknown-empty."""
+    if backend is None:
+        from .config import get as _cfg
+        backend = _cfg("MXNET_SUBGRAPH_BACKEND")
+    if not backend:
+        return sym
+    return partition(sym, backend)

@@ -414,8 +414,9 @@ class Symbol:
     def bind(self, ctx, args, args_grad=None, grad_req="write", aux_states=None,
              group2ctx=None, shared_exec=None):
         from .executor import Executor
-        return Executor(self, ctx, args, args_grad, grad_req, aux_states,
-                        group2ctx=group2ctx)
+        from ..subgraph import apply_backend
+        return Executor(apply_backend(self), ctx, args, args_grad, grad_req,
+                        aux_states, group2ctx=group2ctx)
 
     def simple_bind(self, ctx, grad_req="write", type_dict=None,
                     stype_dict=None, group2ctx=None, shared_arg_names=None,
@@ -437,8 +438,9 @@ class Symbol:
                 args_grad[name] = nd.zeros(shape, ctx=ctx, dtype=dtype)
         aux_states = {name: nd.zeros(shape, ctx=ctx)
                       for name, shape in zip(aux_names, aux_shapes)}
-        return Executor(self, ctx, args, args_grad or None, grad_req,
-                        aux_states, group2ctx=group2ctx)
+        from ..subgraph import apply_backend
+        return Executor(apply_backend(self), ctx, args, args_grad or None,
+                        grad_req, aux_states, group2ctx=group2ctx)
 
     def bind_dict(self, ctx, arg_dict, grad_req="null"):
         """Convenience: bind with a name->NDArray dict covering all inputs."""

@@ -1,0 +1,156 @@
+"""Subgraph partition / graph-rewrite framework tests.
+
+Parity target: reference src/operator/subgraph/ (SubgraphProperty,
+build_subgraph.cc BuildSubgraph, MXNET_SUBGRAPH_BACKEND)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import subgraph as sg
+from mxnet_tpu import symbol as sym
+
+
+def _mlp():
+    data = sym.var("data")
+    w1, b1 = sym.var("w1"), sym.var("b1")
+    w2 = sym.var("w2")
+    h = sym.Symbol._create("FullyConnected", [data, w1, b1],
+                           {"num_hidden": 8})
+    h = sym.Symbol._create("Activation", [h], {"act_type": "relu"})
+    h = sym.Symbol._create("_mul_scalar", [h], {"scalar": 2.0})
+    out = sym.Symbol._create("FullyConnected", [h, w2],
+                             {"num_hidden": 3, "no_bias": True})
+    return out
+
+
+def _params(rng):
+    return {"data": rng.randn(4, 5).astype(np.float32),
+            "w1": rng.randn(8, 5).astype(np.float32),
+            "b1": rng.randn(8).astype(np.float32),
+            "w2": rng.randn(3, 8).astype(np.float32)}
+
+
+def _forward(s, vals, grad=False):
+    args = {k: mx.nd.array(v) for k, v in vals.items()}
+    grads = {k: mx.nd.zeros(v.shape) for k, v in vals.items()} if grad \
+        else None
+    ex = s.bind(mx.cpu(), args, args_grad=grads,
+                grad_req="write" if grad else "null")
+    y = ex.forward(is_train=grad)[0].asnumpy()
+    if not grad:
+        return y, None
+    ex.backward()
+    return y, {k: g.asnumpy() for k, g in grads.items()}
+
+
+def test_partition_fuses_and_matches():
+    out = _mlp()
+    fused = sg.partition(out, "dense_act")
+    ops = [n.op for n in fused._topo() if n.op]
+    assert "_subgraph" in ops, f"no fusion happened: {ops}"
+    # FC+relu+scale fused; the second FC stays (it is a seed-only region)
+    assert ops.count("FullyConnected") == 1
+    rng = np.random.RandomState(0)
+    vals = _params(rng)
+    y_ref, g_ref = _forward(out, vals, grad=True)
+    y_fused, g_fused = _forward(fused, vals, grad=True)
+    np.testing.assert_allclose(y_fused, y_ref, rtol=1e-5, atol=1e-6)
+    for k in vals:
+        np.testing.assert_allclose(g_fused[k], g_ref[k],
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_partition_respects_external_consumers():
+    # relu output consumed by two branches -> the producer FC may join
+    # only the region that owns BOTH consumers; with two separate seeds
+    # it must stay outside (single-output contract)
+    data = sym.var("data")
+    w = sym.var("w")
+    h = sym.Symbol._create("FullyConnected", [data, w],
+                          {"num_hidden": 4, "no_bias": True})
+    r = sym.Symbol._create("Activation", [h], {"act_type": "relu"})
+    a = sym.Symbol._create("_mul_scalar", [r], {"scalar": 2.0})
+    b = sym.Symbol._create("_mul_scalar", [r], {"scalar": 3.0})
+    out = sym.Symbol._create("broadcast_add", [a, b], {})
+    fused = sg.partition(out, "dense_act")
+    rng = np.random.RandomState(1)
+    vals = {"data": rng.randn(2, 3).astype(np.float32),
+            "w": rng.randn(4, 3).astype(np.float32)}
+    y_ref, _ = _forward(out, vals)
+    y_fused, _ = _forward(fused, vals)
+    np.testing.assert_allclose(y_fused, y_ref, rtol=1e-5, atol=1e-6)
+
+
+def test_graph_output_nodes_not_swallowed():
+    # the relu feeding a second (graph) output must not disappear into
+    # another region
+    data = sym.var("data")
+    w = sym.var("w")
+    h = sym.Symbol._create("FullyConnected", [data, w],
+                          {"num_hidden": 4, "no_bias": True})
+    r = sym.Symbol._create("Activation", [h], {"act_type": "relu"})
+    s2 = sym.Symbol._create("_mul_scalar", [r], {"scalar": 2.0})
+    grouped = sym.Group([s2, r])
+    fused = sg.partition(grouped, "dense_act")
+    rng = np.random.RandomState(2)
+    vals = {"data": rng.randn(2, 3).astype(np.float32),
+            "w": rng.randn(4, 3).astype(np.float32)}
+    args = {k: mx.nd.array(v) for k, v in vals.items()}
+    y0, y1 = fused.bind(mx.cpu(), args, grad_req="null").forward()
+    r0, r1 = grouped.bind(mx.cpu(), args, grad_req="null").forward()
+    np.testing.assert_allclose(y0.asnumpy(), r0.asnumpy(), rtol=1e-5)
+    np.testing.assert_allclose(y1.asnumpy(), r1.asnumpy(), rtol=1e-5)
+
+
+def test_env_backend_applied_at_bind(monkeypatch):
+    monkeypatch.setenv("MXNET_SUBGRAPH_BACKEND", "dense_act")
+    out = _mlp()
+    rng = np.random.RandomState(3)
+    vals = _params(rng)
+    y, _ = _forward(out, vals)  # bind applies the env backend
+    monkeypatch.delenv("MXNET_SUBGRAPH_BACKEND")
+    y_ref, _ = _forward(out, vals)
+    np.testing.assert_allclose(y, y_ref, rtol=1e-5, atol=1e-6)
+
+
+def test_stateful_ops_never_fused():
+    data = sym.var("data")
+    d = sym.Symbol._create("Dropout", [data], {"p": 0.5})
+    r = sym.Symbol._create("Activation", [d], {"act_type": "relu"})
+    fused = sg.partition(r, "dense_act")
+    ops = [n.op for n in fused._topo() if n.op]
+    assert "Dropout" in ops  # random op stays a top-level node
+
+
+def test_unknown_backend_raises():
+    with pytest.raises(Exception):
+        sg.partition(_mlp(), "nope")
+
+
+def test_custom_property_replacement():
+    """A property may swap the region for arbitrary structure — here a
+    matched `x*2` chain is replaced by a plain symbol expression."""
+    class Sel(sg.SubgraphSelector):
+        def select(self, node):
+            return node.op == "_mul_scalar" and \
+                float(node.attrs.get("scalar", 0)) == 2.0
+
+    class Doubler(sg.SubgraphProperty):
+        min_subgraph_size = 1  # single-node op substitution
+
+        def create_selector(self):
+            return Sel()
+
+        def create_subgraph_node(self, inner, input_syms, sid):
+            return input_syms[0] + input_syms[0]  # x*2 -> x+x
+
+    data = sym.var("data")
+    r = sym.Symbol._create("Activation", [data], {"act_type": "relu"})
+    m = sym.Symbol._create("_mul_scalar", [r], {"scalar": 2.0})
+    m2 = sym.Symbol._create("_mul_scalar", [m], {"scalar": 2.0})
+    fused = sg.partition(m2, Doubler())
+    ops = [n.op for n in fused._topo() if n.op]
+    assert "_mul_scalar" not in ops
+    x = np.random.RandomState(4).randn(3, 3).astype(np.float32)
+    y, _ = _forward(fused, {"data": x})
+    np.testing.assert_allclose(y, np.maximum(x, 0) * 4, rtol=1e-6)
