@@ -15,6 +15,9 @@ from . import ndarray
 from . import ndarray as nd
 from .ndarray import NDArray
 from .attribute import AttrScope
+from .initialize import install_fork_handlers as _install_fork_handlers
+
+_install_fork_handlers()
 
 waitall = engine.waitall
 

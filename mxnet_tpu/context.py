@@ -146,5 +146,36 @@ def num_tpus():
         return 0
 
 
+def device_memory_info(ctx=None):
+    """Memory stats of a context's device as a dict (bytes_in_use,
+    bytes_limit, peak_bytes_in_use, …) from the PJRT allocator.
+
+    Parity: the reference's Context.gpu_memory_info / storage-pool env
+    introspection (include/mxnet/base.h, src/storage/); here the HBM
+    pool is owned by PJRT, whose live stats are surfaced directly.
+    """
+    ctx = ctx or current_context()
+    dev = ctx.jax_device
+    stats = None
+    try:
+        stats = dev.memory_stats()
+    except Exception:
+        stats = None
+    if not stats:
+        raise MXNetError(
+            f"device {dev} does not expose memory stats "
+            "(host CPU backends have no PJRT allocator pool)")
+    return dict(stats)
+
+
+def gpu_memory_info(device_id=0):
+    """(free, total) bytes for an accelerator device (parity:
+    mx.context.gpu_memory_info)."""
+    stats = device_memory_info(Context("gpu", device_id))
+    total = int(stats.get("bytes_limit", 0))
+    used = int(stats.get("bytes_in_use", 0))
+    return max(total - used, 0), total
+
+
 def current_context():
     return Context.default_ctx()

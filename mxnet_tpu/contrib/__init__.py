@@ -1,4 +1,7 @@
 """mx.contrib — experimental subsystems (parity: python/mxnet/contrib/)."""
 from . import quantization  # noqa: F401
 from . import onnx  # noqa: F401
+from . import svrg  # noqa: F401
+from . import text  # noqa: F401
+from . import tensorboard  # noqa: F401
 from .. import amp  # noqa: F401  (reference exposes contrib.amp)

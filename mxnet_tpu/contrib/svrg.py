@@ -1,0 +1,166 @@
+"""SVRG optimization (parity: reference contrib/svrg_optimization/).
+
+Reference design (svrg_module.py / svrg_optimizer.py): SVRGModule keeps a
+snapshot of the weights taken every ``update_freq`` epochs plus the full
+dataset gradient at that snapshot, and each step applies the
+variance-reduced gradient  g(w, b) - g(w_s, b) + mu  where mu is the full
+gradient mean; the reference routes this through a wrapper optimizer and
+special kvstore keys.
+
+TPU re-design: the corrected gradient is computed explicitly on device
+(three executor gradients are plain arrays here) and then ANY base
+optimizer applies unchanged — no wrapper-optimizer/kvstore-key machinery
+needed. Same math, same schedule, ordinary update path.
+"""
+from __future__ import annotations
+
+import logging
+
+from .. import ndarray as nd
+from .. import optimizer as opt_mod
+from ..base import MXNetError
+from ..module import Module
+
+
+class SVRGModule(Module):
+    """Module with Stochastic Variance Reduced Gradient updates
+    (parity: svrg_module.py:30 SVRGModule).
+
+    update_freq: take a full-gradient snapshot every N epochs (the
+    reference's update_freq contract in fit())."""
+
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), update_freq=2,
+                 logger=logging, **kwargs):
+        super().__init__(symbol, data_names=data_names,
+                         label_names=label_names, logger=logger, **kwargs)
+        if int(update_freq) < 1:
+            raise MXNetError("update_freq must be >= 1")
+        self.update_freq = int(update_freq)
+        # snapshot state: weights w_s and full-gradient mean mu
+        self._mod_aux = Module(symbol, data_names=data_names,
+                               label_names=label_names, **kwargs)
+        self._full_grads = None
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        super().bind(data_shapes, label_shapes, for_training,
+                     inputs_need_grad, force_rebind, shared_module, grad_req)
+        self._mod_aux.bind(data_shapes, label_shapes, for_training,
+                           inputs_need_grad, force_rebind, None, grad_req)
+
+    def init_params(self, *args, **kwargs):
+        super().init_params(*args, **kwargs)
+        arg_p, aux_p = self.get_params()
+        self._mod_aux.init_params(arg_params=arg_p, aux_params=aux_p,
+                                  allow_missing=False, force_init=True)
+
+    def update_full_grads(self, train_data):
+        """Snapshot w_s := w and mu := (1/N) Σ_batches g(w_s, batch)
+        (parity: svrg_module.py:292)."""
+        arg_p, aux_p = self.get_params()
+        self._mod_aux.set_params(arg_p, aux_p, allow_missing=False,
+                                 allow_extra=True)
+        accum = {name: nd.zeros(self._mod_aux._exec.arg_dict[name].shape)
+                 for name in self._param_names
+                 if self._mod_aux._exec.grad_dict.get(name) is not None}
+        n_batches = 0
+        train_data.reset()
+        for batch in train_data:
+            self._mod_aux.forward(batch, is_train=True)
+            self._mod_aux.backward()
+            for name in accum:
+                accum[name] += self._mod_aux._exec.grad_dict[name]
+                self._mod_aux._exec.grad_dict[name][:] = 0.0
+            n_batches += 1
+        if n_batches == 0:
+            raise MXNetError("update_full_grads: empty data iterator")
+        self._full_grads = {k: v / n_batches for k, v in accum.items()}
+        train_data.reset()
+
+    def forward(self, data_batch, is_train=None):
+        super().forward(data_batch, is_train)
+        if (is_train is None and self.for_training) or is_train:
+            # g(w_s, batch) for the same minibatch (parity: forward on
+            # _mod_aux, svrg_module.py:232)
+            self._mod_aux.forward(data_batch, is_train=True)
+
+    def backward(self, out_grads=None):
+        super().backward(out_grads)
+        self._mod_aux.backward(out_grads)
+
+    def update(self):
+        """Apply the variance-reduced gradient through the base optimizer
+        (parity: _svrg_grads_update_rule, svrg_module.py:360)."""
+        assert self.binded and self.params_initialized and \
+            self.optimizer_initialized
+        if self._full_grads is None:
+            raise MXNetError(
+                "call update_full_grads(train_data) before update() "
+                "(the SVRG schedule requires a snapshot)")
+        for i, name in enumerate(self._param_names):
+            grad = self._exec.grad_dict.get(name)
+            if grad is None:
+                continue
+            weight = self._exec.arg_dict[name]
+            g_aux = self._mod_aux._exec.grad_dict[name]
+            corrected = grad - g_aux + self._full_grads[name].as_in_context(
+                grad.ctx)
+            self._updater(i, corrected, weight)
+            grad[:] = 0.0
+            g_aux[:] = 0.0
+
+    def fit(self, train_data, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None,
+            kvstore="local", optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.01),),
+            initializer=None, arg_params=None, aux_params=None,
+            allow_missing=False, force_rebind=False, force_init=False,
+            begin_epoch=0, num_epoch=None, validation_metric=None,
+            monitor=None):
+        """Training loop with the SVRG snapshot schedule
+        (parity: svrg_module.py:395 fit)."""
+        from .. import metric as metric_mod
+        from ..initializer import Uniform
+        assert num_epoch is not None, "num_epoch required"
+        self.bind(data_shapes=train_data.provide_data,
+                  label_shapes=train_data.provide_label,
+                  for_training=True, force_rebind=force_rebind)
+        self.init_params(initializer=initializer or Uniform(0.01),
+                         arg_params=arg_params, aux_params=aux_params,
+                         allow_missing=allow_missing, force_init=force_init)
+        self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                            optimizer_params=optimizer_params)
+        if not isinstance(eval_metric, metric_mod.EvalMetric):
+            eval_metric = metric_mod.create(eval_metric)
+        for epoch in range(begin_epoch, num_epoch):
+            if (epoch - begin_epoch) % self.update_freq == 0:
+                self.update_full_grads(train_data)
+            eval_metric.reset()
+            train_data.reset()
+            for nbatch, batch in enumerate(train_data):
+                self.forward(batch, is_train=True)
+                self.backward()
+                self.update()
+                self.update_metric(eval_metric, batch.label)
+                if batch_end_callback is not None:
+                    for cb in (batch_end_callback
+                               if isinstance(batch_end_callback, list)
+                               else [batch_end_callback]):
+                        cb(type("BatchEndParam", (), {
+                            "epoch": epoch, "nbatch": nbatch,
+                            "eval_metric": eval_metric, "locals": None})())
+            self.logger.info("Epoch[%d] Train-%s=%f", epoch,
+                             *eval_metric.get())
+            if epoch_end_callback is not None:
+                self._sync_params_from_exec()
+                for cb in (epoch_end_callback
+                           if isinstance(epoch_end_callback, list)
+                           else [epoch_end_callback]):
+                    cb(epoch, self.symbol, *self.get_params())
+            if eval_data is not None:
+                res = self.score(eval_data, validation_metric or eval_metric)
+                for name, val in res:
+                    self.logger.info("Epoch[%d] Validation-%s=%f",
+                                     epoch, name, val)

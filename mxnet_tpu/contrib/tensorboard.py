@@ -1,0 +1,123 @@
+"""TensorBoard logging (parity: reference python/mxnet/contrib/tensorboard.py
+LogMetricsCallback, which delegates to the external `tensorboard` package).
+
+Zero-dependency redesign: a minimal event-file writer producing standard
+TensorBoard scalar summaries — protobuf Event records in the TFRecord
+framing (length + masked crc32c), written under
+``<logdir>/events.out.tfevents.*``. Readable by stock TensorBoard; no
+external packages required.
+"""
+from __future__ import annotations
+
+import os
+import struct
+import time
+
+# --- crc32c (Castagnoli), table-driven — required by the TFRecord frame ----
+_CRC_TABLE = []
+
+
+def _crc_table():
+    if not _CRC_TABLE:
+        poly = 0x82F63B78
+        for n in range(256):
+            c = n
+            for _ in range(8):
+                c = (c >> 1) ^ poly if c & 1 else c >> 1
+            _CRC_TABLE.append(c)
+    return _CRC_TABLE
+
+
+def _crc32c(data):
+    table = _crc_table()
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data):
+    crc = _crc32c(data)
+    return ((crc >> 15) | (crc << 17)) + 0xA282EAD8 & 0xFFFFFFFF
+
+
+# --- Event protobuf (field numbers from tensorflow/core/util/event.proto) ---
+def _emit_double(field, value):
+    from .onnx._proto import _tag
+    return _tag(field, 1) + struct.pack("<d", float(value))
+
+
+def _event_bytes(wall_time, step=None, file_version=None, summary=None):
+    from .onnx._proto import emit_bytes, emit_int, emit_str
+    out = bytearray(_emit_double(1, wall_time))
+    if step is not None:
+        out += emit_int(2, int(step))
+    if file_version is not None:
+        out += emit_str(3, file_version)
+    if summary is not None:
+        out += emit_bytes(5, summary)
+    return bytes(out)
+
+
+def _scalar_summary(tag, value):
+    from .onnx._proto import emit_bytes, emit_float, emit_str
+    val = emit_str(1, tag) + emit_float(2, value)
+    return emit_bytes(1, val)
+
+
+class SummaryWriter:
+    """Minimal scalar-only event writer (mxboard.SummaryWriter surface
+    subset: add_scalar / flush / close)."""
+
+    def __init__(self, logdir):
+        os.makedirs(logdir, exist_ok=True)
+        fname = f"events.out.tfevents.{int(time.time())}.mxnet_tpu"
+        self._path = os.path.join(logdir, fname)
+        self._f = open(self._path, "ab")
+        self._write_event(_event_bytes(time.time(),
+                                       file_version="brain.Event:2"))
+
+    def _write_event(self, payload):
+        header = struct.pack("<Q", len(payload))
+        self._f.write(header)
+        self._f.write(struct.pack("<I", _masked_crc(header)))
+        self._f.write(payload)
+        self._f.write(struct.pack("<I", _masked_crc(payload)))
+
+    def add_scalar(self, tag, value, global_step=0):
+        self._write_event(_event_bytes(
+            time.time(), step=global_step,
+            summary=_scalar_summary(tag, float(value))))
+
+    def flush(self):
+        self._f.flush()
+
+    def close(self):
+        self._f.close()
+
+
+class LogMetricsCallback:
+    """Batch-end callback streaming eval metrics to TensorBoard
+    (parity: reference contrib/tensorboard.py LogMetricsCallback)."""
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        self._writer = SummaryWriter(logging_dir)
+        self._step = 0
+
+    def __call__(self, param):
+        if param.eval_metric is None:
+            return
+        self._step += 1
+        for name, value in zip(*_metric_get(param.eval_metric)):
+            if self.prefix is not None:
+                name = f"{self.prefix}-{name}"
+            self._writer.add_scalar(name, value, self._step)
+        self._writer.flush()
+
+
+def _metric_get(metric):
+    names, values = metric.get()
+    if not isinstance(names, (list, tuple)):
+        names, values = [names], [values]
+    return names, values

@@ -1,0 +1,204 @@
+"""Text utilities: vocabulary + token embeddings (parity: reference
+python/mxnet/contrib/text/ — vocab.py Vocabulary, embedding.py
+CustomEmbedding/CompositeEmbedding, utils.py count_tokens_from_str).
+
+Zero-egress adaptation: the reference downloads GloVe/fastText archives;
+here pretrained vectors load from LOCAL files in the same text format
+(one token per line: ``token v1 v2 ...``). The class surface matches so
+user code only changes the source path.
+"""
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+from .. import ndarray as nd
+from ..base import MXNetError
+
+C_UNKNOWN_TOKEN = "<unk>"
+
+
+def count_tokens_from_str(source_str, token_delim=" ", seq_delim="\n",
+                          to_lower=False, counter_to_update=None):
+    """Token counter from a string (parity: text/utils.py)."""
+    import collections
+    source_str = re.sub(f"({token_delim})|({seq_delim})", " ", source_str)
+    if to_lower:
+        source_str = source_str.lower()
+    counter = counter_to_update if counter_to_update is not None \
+        else collections.Counter()
+    counter.update(source_str.split())
+    return counter
+
+
+class Vocabulary:
+    """Indexed vocabulary (parity: text/vocab.py Vocabulary).
+
+    Index 0 is the unknown token; reserved tokens follow; then counted
+    tokens by frequency (ties broken alphabetically, reference order).
+    """
+
+    def __init__(self, counter=None, most_freq_count=None, min_freq=1,
+                 unknown_token=C_UNKNOWN_TOKEN, reserved_tokens=None):
+        if min_freq < 1:
+            raise MXNetError("min_freq must be >= 1")
+        self._unknown_token = unknown_token
+        reserved_tokens = list(reserved_tokens or [])
+        if unknown_token in reserved_tokens or \
+                len(set(reserved_tokens)) != len(reserved_tokens):
+            raise MXNetError("reserved tokens must be unique and must not "
+                             "contain the unknown token")
+        self._idx_to_token = [unknown_token] + reserved_tokens
+        self._reserved_tokens = reserved_tokens
+        self._token_to_idx = {t: i for i, t in enumerate(self._idx_to_token)}
+        if counter is not None:
+            pairs = sorted(counter.items(), key=lambda kv: (-kv[1], kv[0]))
+            if most_freq_count is not None:
+                pairs = pairs[:most_freq_count]
+            for tok, freq in pairs:
+                if freq < min_freq or tok in self._token_to_idx:
+                    continue
+                self._token_to_idx[tok] = len(self._idx_to_token)
+                self._idx_to_token.append(tok)
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    @property
+    def reserved_tokens(self):
+        return self._reserved_tokens
+
+    def to_indices(self, tokens):
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        idx = [self._token_to_idx.get(t, 0) for t in toks]
+        return idx[0] if single else idx
+
+    def to_tokens(self, indices):
+        single = isinstance(indices, int)
+        idxs = [indices] if single else indices
+        for i in idxs:
+            if not 0 <= i < len(self._idx_to_token):
+                raise MXNetError(f"token index {i} out of range")
+        toks = [self._idx_to_token[i] for i in idxs]
+        return toks[0] if single else toks
+
+
+class _TokenEmbedding(Vocabulary):
+    """Base of embedding classes (parity: embedding.py _TokenEmbedding):
+    a vocabulary plus an idx_to_vec matrix; unknown tokens map to
+    init_unknown_vec (zeros by default)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._vec_len = 0
+        self._idx_to_vec = None
+
+    @property
+    def vec_len(self):
+        return self._vec_len
+
+    @property
+    def idx_to_vec(self):
+        return self._idx_to_vec
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        if lower_case_backup:
+            toks = [t if t in self._token_to_idx else t.lower()
+                    for t in toks]
+        indices = [self._token_to_idx.get(t, 0) for t in toks]
+        vecs = self._idx_to_vec.asnumpy()[np.asarray(indices)]
+        out = nd.array(vecs)
+        return out[0] if single else out
+
+    def update_token_vectors(self, tokens, new_vectors):
+        toks = [tokens] if isinstance(tokens, str) else tokens
+        new_vectors = new_vectors.asnumpy() \
+            if isinstance(new_vectors, nd.NDArray) else np.asarray(new_vectors)
+        if new_vectors.ndim == 1:
+            new_vectors = new_vectors[None]
+        mat = np.array(self._idx_to_vec.asnumpy())  # asnumpy is read-only
+        for t, v in zip(toks, new_vectors):
+            if t not in self._token_to_idx:
+                raise MXNetError(f"token {t!r} is not in the vocabulary")
+            mat[self._token_to_idx[t]] = v
+        self._idx_to_vec = nd.array(mat)
+
+
+class CustomEmbedding(_TokenEmbedding):
+    """Embedding loaded from a local text file of ``token v1 v2 ...``
+    lines (parity: embedding.py CustomEmbedding; also the zero-egress
+    replacement for GloVe/FastText loaders — point it at a local copy)."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ", encoding="utf8",
+                 vocabulary=None, init_unknown_vec=None, **kwargs):
+        super().__init__(**kwargs)
+        tokens, vecs = [], []
+        with open(pretrained_file_path, encoding=encoding) as f:
+            for line_num, line in enumerate(f):
+                parts = line.rstrip().split(elem_delim)
+                if len(parts) <= 2:
+                    continue  # header / malformed line (reference skips)
+                tok, elems = parts[0], parts[1:]
+                try:
+                    vec = np.asarray([float(x) for x in elems], np.float32)
+                except ValueError:
+                    continue
+                if self._vec_len and len(vec) != self._vec_len:
+                    continue  # inconsistent width: skip (reference warns)
+                if not self._vec_len:
+                    self._vec_len = len(vec)
+                if tok in self._token_to_idx:
+                    continue
+                if vocabulary is not None and \
+                        tok not in vocabulary.token_to_idx:
+                    continue
+                self._token_to_idx[tok] = len(self._idx_to_token)
+                self._idx_to_token.append(tok)
+                tokens.append(tok)
+                vecs.append(vec)
+        if not vecs:
+            raise MXNetError(
+                f"no embedding vectors loaded from {pretrained_file_path}")
+        unk = np.zeros((self._vec_len,), np.float32) \
+            if init_unknown_vec is None else \
+            np.asarray(init_unknown_vec, np.float32)
+        n_special = len(self._idx_to_token) - len(tokens)
+        mat = np.concatenate(
+            [np.tile(unk, (n_special, 1)), np.stack(vecs)], axis=0)
+        self._idx_to_vec = nd.array(mat)
+
+
+class CompositeEmbedding(_TokenEmbedding):
+    """Concatenate several embeddings over one vocabulary
+    (parity: embedding.py CompositeEmbedding)."""
+
+    def __init__(self, vocabulary, token_embeddings):
+        self._unknown_token = vocabulary.unknown_token
+        self._reserved_tokens = vocabulary.reserved_tokens
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        if not isinstance(token_embeddings, (list, tuple)):
+            token_embeddings = [token_embeddings]
+        mats = []
+        for emb in token_embeddings:
+            vecs = emb.get_vecs_by_tokens(self._idx_to_token)
+            mats.append(vecs.asnumpy())
+        mat = np.concatenate(mats, axis=1)
+        self._vec_len = mat.shape[1]
+        self._idx_to_vec = nd.array(mat)

@@ -1,0 +1,49 @@
+"""Library initialization: fork safety (parity: src/initialize.cc
+LibraryInitializer — pthread_atfork engine Stop()/Start() around fork so
+DataLoader fork workers are safe).
+
+TPU adaptation: XLA owns the execution threads, so there is no engine to
+stop; the hazards in a forked child are (a) an inherited accelerator
+backend whose device handles are invalid in the child and (b) the RNG
+stream being byte-identical to the parent's (every DataLoader worker
+would draw the same augmentations). The after-fork handler folds the
+child PID into the RNG key and resets profiler state; CPU-backend JAX
+tolerates fork for the compute we do host-side.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+
+_installed = False
+
+
+def _after_fork_child():
+    # new RNG stream per child: fold the pid into the root key so fork
+    # workers never replay the parent's randomness
+    try:
+        import jax
+        from . import random as _random
+        s = _random._get()
+        s.key = jax.random.fold_in(s.key, os.getpid() & 0x7FFFFFFF)
+        s.counter = 0
+    except Exception:
+        pass
+    # profiler state is per-process; a child must not append to the
+    # parent's trace buffers
+    try:
+        from . import profiler
+        if hasattr(profiler, "_reset_after_fork"):
+            profiler._reset_after_fork()
+    except Exception:
+        pass
+
+
+def install_fork_handlers():
+    """Idempotently install the at-fork handlers (called at import)."""
+    global _installed
+    if _installed:
+        return
+    _installed = True
+    os.register_at_fork(after_in_child=_after_fork_child)

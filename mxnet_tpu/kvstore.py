@@ -238,10 +238,11 @@ class KVStoreICI(KVStore):
         devs = tuple(next(iter(v._data.devices())) for v in vlist)
         if len(set(devs)) != len(devs):
             # duplicate devices (e.g. tests faking multi-device on one
-            # chip): plain add is both correct and optimal
+            # chip): reduce on the first copy's device — mixed partial
+            # duplication would otherwise feed jit incompatible devices
             total = vlist[0]._data
             for v in vlist[1:]:
-                total = total + v._data
+                total = total + jax.device_put(v._data, devs[0])
             return None, total
         shape = tuple(vlist[0].shape)
         ckey = (devs, shape, str(vlist[0].dtype))
@@ -399,14 +400,25 @@ class KVStoreDist(KVStore):
                     raise MXNetError(
                         "gradient compression does not support row_sparse "
                         "pushes (reference kvstore_dist parity)")
-                self._check_not_chunked(k, "row_sparse push")
                 merged = vlist[0]
                 for v in vlist[1:]:
                     merged = _sp.elemwise_add(merged, v)
                 import numpy as np
-                self._client.push_rs(
-                    k, np.asarray(merged._indices),
-                    np.asarray(merged._data), tuple(merged.shape), sync=sync)
+                idx = np.asarray(merged._indices).astype(np.int64)
+                vals = np.asarray(merged._data)
+                layout = self._chunked.get(k)
+                if layout is None:
+                    self._client.push_rs(k, idx, vals,
+                                         tuple(merged.shape), sync=sync)
+                else:
+                    # chunked key: split rows by chunk range; EVERY chunk
+                    # gets a (possibly empty) push so sync aggregation
+                    # counts line up across workers
+                    for ck, b, e in layout:
+                        m = (idx >= b) & (idx < e)
+                        self._client.push_rs(
+                            ck, idx[m] - b, vals[m],
+                            (e - b,) + tuple(merged.shape[1:]), sync=sync)
                 continue
             merged = vlist[0] if len(vlist) == 1 else nd.add_n(
                 *[v.as_in_context(vlist[0].ctx) for v in vlist])
@@ -432,15 +444,27 @@ class KVStoreDist(KVStore):
                 f"{what} on key {k!r} is incompatible with big-array "
                 "chunking (array exceeds MXNET_KVSTORE_BIGARRAY_BOUND "
                 "elements); raise the bound for this key's workflow, or "
-                "enable compression/sparse before init")
+                "enable compression before init")
 
     def _fetch_rows(self, k, stored, rows):
-        # only the requested rows cross the wire (kvstore_dist.h:243)
+        # only the requested rows cross the wire (kvstore_dist.h:243);
+        # on a chunked key each chunk serves its own row range
         if self._client is None:
             return super()._fetch_rows(k, stored, rows)
-        self._check_not_chunked(k, "row_sparse pull")
+        import numpy as np
         import jax.numpy as jnp
-        return jnp.asarray(self._client.pull_rows(k, rows))
+        rows_np = np.asarray(rows).astype(np.int64)
+        layout = self._chunked.get(k)
+        if layout is None:
+            return jnp.asarray(self._client.pull_rows(k, rows_np))
+        out = np.empty((len(rows_np),) + tuple(stored.shape[1:]),
+                       np.dtype(str(stored.dtype)))
+        for ck, b, e in layout:
+            m = (rows_np >= b) & (rows_np < e)
+            if not m.any():
+                continue
+            out[m] = self._client.pull_rows(ck, rows_np[m] - b)
+        return jnp.asarray(out)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         if self._client is None:

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .. import autograd
 from ..base import MXNetError
@@ -139,8 +140,37 @@ def while_loop(cond, func, loop_vars, max_iterations=None,
     Returns (stacked_step_outputs, final_loop_vars).
     """
     if max_iterations is None:
-        raise MXNetError("while_loop requires max_iterations "
-                         "(static bound for the compiled loop)")
+        # reference ndarray while_loop runs unbounded imperatively
+        # (python/mxnet/ndarray/contrib.py:232). XLA needs a static
+        # bound, so: eager non-recording calls fall back to a host loop
+        # (cond evaluated on host each trip); recorded/traced execution
+        # still requires the bound.
+        if autograd.is_recording():
+            raise MXNetError(
+                "while_loop requires max_iterations under autograd "
+                "recording / hybridize (static bound for the compiled "
+                "loop)")
+        vs = list(_as_list(loop_vars))
+        step_outs = []
+        single = None
+        while bool(np.asarray(
+                (lambda c: c._data if isinstance(c, NDArray) else c)(
+                    cond(*vs)))):
+            out, vs = func(*vs)
+            single = not isinstance(out, (list, tuple))
+            step_outs.append(_as_list(out))
+            vs = list(_as_list(vs))
+        if step_outs:
+            from .ndarray import stack as _stack
+            stacked = [_stack(*[row[i] for row in step_outs], axis=0)
+                       for i in range(len(step_outs[0]))]
+        else:
+            stacked = []
+        # unwrap ONLY when func returned a bare (non-list) output — the
+        # compiled path's meta['single_out'] contract, so adding or
+        # removing max_iterations never changes the return shape
+        outs_r = stacked[0] if single and stacked else stacked
+        return outs_r, vs
     vars_l = _as_list(loop_vars)
     n_vars = len(vars_l)
     train = autograd.is_training()

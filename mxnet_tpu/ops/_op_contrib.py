@@ -674,20 +674,25 @@ def _proposal_one(scores, bbox_deltas, im_info, anchors, *, stride,
     svalid = jnp.isfinite(sscore)
 
     # pixel-convention IoU (+1 widths) matching proposal.cc NMS, not the
-    # normalised-corner IoU the rest of the contrib family uses
-    tl = jnp.maximum(sboxes[:, None, :2], sboxes[None, :, :2])
-    br = jnp.minimum(sboxes[:, None, 2:], sboxes[None, :, 2:])
-    wh = jnp.maximum(br - tl + 1.0, 0.0)
-    inter = wh[..., 0] * wh[..., 1]
+    # normalised-corner IoU the rest of the contrib family uses.
+    # The IoU ROW is computed inside the loop body — O(n) live memory
+    # instead of materializing the k_pre x k_pre matrix (at the default
+    # pre_nms=6000 that matrix is ~144MB per image under vmap; the
+    # reference uses an O(n^2/64) bitmask workspace, nms.cu)
     area = ((sboxes[:, 2] - sboxes[:, 0] + 1.0)
             * (sboxes[:, 3] - sboxes[:, 1] + 1.0))
-    union = area[:, None] + area[None, :] - inter
-    iou = jnp.where(union <= 0, 0.0, inter / union)
-    later = jnp.arange(k_pre)[None, :] > jnp.arange(k_pre)[:, None]
-    sup = (iou > nms_thresh) & later
+    idxs = jnp.arange(k_pre)
 
     def body(i, keep):
-        return jnp.where(keep[i], keep & ~sup[i], keep)
+        bi = sboxes[i]
+        tl = jnp.maximum(bi[:2], sboxes[:, :2])
+        br = jnp.minimum(bi[2:], sboxes[:, 2:])
+        wh = jnp.maximum(br - tl + 1.0, 0.0)
+        inter = wh[:, 0] * wh[:, 1]
+        union = area + area[i] - inter
+        iou_row = jnp.where(union <= 0, 0.0, inter / union)
+        sup_row = (iou_row > nms_thresh) & (idxs > i)
+        return jnp.where(keep[i], keep & ~sup_row, keep)
 
     keep = lax.fori_loop(0, k_pre, body, svalid)
     # compact kept indices to the front; pad by cycling (proposal.cc:414
@@ -736,3 +741,351 @@ def _contrib_proposal(attrs, cls_prob, bbox_pred, im_info):
     if bool(attrs.get("output_score", False)):
         return rois_out, scores.reshape(-1, 1)
     return rois_out
+
+
+# --- resize / pooling family ------------------------------------------------
+@register("_contrib_AdaptiveAvgPooling2D")
+def _adaptive_avg_pool2d(attrs, x):
+    """Adaptive average pool to a target (H,W)
+    (reference: contrib/adaptive_avg_pooling.cc). Emitted as a pair of
+    interval-overlap matmuls — fully dense, MXU-friendly, differentiable."""
+    out_hw = attrs.get("output_size", ())
+    if isinstance(out_hw, (int, float)):
+        out_hw = (int(out_hw), int(out_hw))
+    if not out_hw:
+        out_hw = (1, 1)
+    oh, ow = (int(out_hw[0]), int(out_hw[-1]))
+    n, c, h, w = x.shape
+
+    def weights(in_size, out_size):
+        # row r covers input interval [r*in/out, (r+1)*in/out); fractional
+        # overlap with each input cell gives the averaging weight
+        starts = jnp.arange(out_size) * in_size / out_size
+        ends = (jnp.arange(out_size) + 1) * in_size / out_size
+        cells = jnp.arange(in_size)
+        overlap = jnp.clip(
+            jnp.minimum(ends[:, None], cells[None, :] + 1.0)
+            - jnp.maximum(starts[:, None], cells[None, :]), 0.0, 1.0)
+        return (overlap / (in_size / out_size)).astype(x.dtype)
+
+    wh = weights(h, oh)            # (oh, h)
+    ww = weights(w, ow)            # (ow, w)
+    out = jnp.einsum("nchw,oh->ncow", x, wh)
+    return jnp.einsum("ncow,pw->ncop", out, ww)
+
+
+@register("_contrib_BilinearResize2D")
+def _bilinear_resize2d(attrs, x, *maybe_like):
+    """Bilinear upsample/downsample (reference: bilinear_resize.cc)."""
+    if maybe_like:
+        oh, ow = maybe_like[0].shape[2], maybe_like[0].shape[3]
+    else:
+        oh = int(attrs.get("height", 0))
+        ow = int(attrs.get("width", 0))
+        sh = float(attrs.get("scale_height", 0) or 0)
+        sw = float(attrs.get("scale_width", 0) or 0)
+        if oh <= 0 and sh > 0:
+            oh = int(x.shape[2] * sh)
+        if ow <= 0 and sw > 0:
+            ow = int(x.shape[3] * sw)
+    return jax.image.resize(x, (x.shape[0], x.shape[1], oh, ow),
+                            method="linear")
+
+
+# --- deformable family ------------------------------------------------------
+def _bilinear_gather(img, ys, xs):
+    """Sample img (C,H,W) at fractional (ys, xs) [any shape] with zero
+    padding outside — the deformable-conv sampling kernel
+    (deformable_im2col.h DmcnIm2colBilinear)."""
+    c, h, w = img.shape
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    wy1 = ys - y0
+    wx1 = xs - x0
+    out = 0.0
+    for dy, wy in ((0, 1.0 - wy1), (1, wy1)):
+        for dx, wx in ((0, 1.0 - wx1), (1, wx1)):
+            yy = (y0 + dy).astype(jnp.int32)
+            xx = (x0 + dx).astype(jnp.int32)
+            valid = (yy >= 0) & (yy < h) & (xx >= 0) & (xx < w)
+            yc = jnp.clip(yy, 0, h - 1)
+            xc = jnp.clip(xx, 0, w - 1)
+            val = img[:, yc, xc]                    # (C, *idx_shape)
+            out = out + val * (wy * wx * valid)[None]
+    return out
+
+
+@register("_contrib_DeformableConvolution", alias=("DeformableConvolution",))
+def _deformable_convolution(attrs, x, offset, weight, *maybe_bias):
+    """Deformable convolution v1 (reference:
+    contrib/deformable_convolution.cc + deformable_im2col.h): each kernel
+    tap samples the input at its grid position plus a learned offset,
+    bilinearly. Lowered to one fused gather + tensordot per image."""
+    kernel = tuple(int(k) for k in attrs["kernel"])
+    kh, kw = kernel
+    stride = attrs.get("stride") or (1, 1)
+    pad = attrs.get("pad") or (0, 0)
+    dilate = attrs.get("dilate") or (1, 1)
+    sh, sw = (int(s) for s in stride)
+    ph, pw = (int(p) for p in pad)
+    dh, dw = (int(d) for d in dilate)
+    groups = int(attrs.get("num_group", 1))
+    defg = int(attrs.get("num_deformable_group", 1))
+    if groups != 1 or defg != 1:
+        raise NotImplementedError(
+            "DeformableConvolution: groups > 1 not supported")
+    n, c, h, w = x.shape
+    oh = (h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    ow = (w + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+
+    base_y = (jnp.arange(oh) * sh - ph)[:, None, None]      # (oh,1,1)
+    base_x = (jnp.arange(ow) * sw - pw)[None, :, None]      # (1,ow,1)
+    ky = (jnp.arange(kh) * dh)[None, None, :, None]          # (1,1,kh,1)
+    kx = (jnp.arange(kw) * dw)[None, None, None, :]          # (1,1,1,kw)
+    grid_y = base_y[..., None] + ky                          # (oh,ow,kh,1)
+    grid_x = base_x[..., None] + kx                          # (oh,ow,1,kw)
+    grid_y = jnp.broadcast_to(grid_y, (oh, ow, kh, kw)).astype(x.dtype)
+    grid_x = jnp.broadcast_to(grid_x, (oh, ow, kh, kw)).astype(x.dtype)
+
+    def one(img, off):
+        # off: (2*kh*kw, oh, ow) ordered (y0,x0,y1,x1,...) per tap
+        off = off.reshape(kh * kw, 2, oh, ow)
+        oy = off[:, 0].transpose(1, 2, 0).reshape(oh, ow, kh, kw)
+        ox = off[:, 1].transpose(1, 2, 0).reshape(oh, ow, kh, kw)
+        ys = grid_y + oy
+        xs = grid_x + ox
+        col = _bilinear_gather(img, ys, xs)       # (C,oh,ow,kh,kw)
+        return jnp.tensordot(weight, col, axes=[[1, 2, 3], [0, 3, 4]])
+
+    out = jax.vmap(one)(x, offset)                # (N,Cout,oh,ow)
+    if maybe_bias and not bool(attrs.get("no_bias", False)):
+        out = out + maybe_bias[0].reshape(1, -1, 1, 1)
+    return out
+
+
+@register("_contrib_PSROIPooling", alias=("PSROIPooling",))
+def _psroi_pooling(attrs, data, rois):
+    """Position-sensitive ROI pooling (reference: contrib/psroi_pooling.cc):
+    output channel c at bin (i,j) pools input channel c*P*P + i*P + j over
+    that bin (R-FCN)."""
+    spatial_scale = float(attrs["spatial_scale"])
+    out_dim = int(attrs["output_dim"])
+    group = int(attrs.get("group_size", attrs.get("pooled_size")))
+    pooled = int(attrs.get("pooled_size", group))
+    n, c, h, w = data.shape
+
+    def one_roi(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1, y1, x2, y2 = roi[1] * spatial_scale, roi[2] * spatial_scale, \
+            roi[3] * spatial_scale, roi[4] * spatial_scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_h = rh / pooled
+        bin_w = rw / pooled
+        img = data[bidx]
+        ys = jnp.arange(h, dtype=data.dtype)
+        xs = jnp.arange(w, dtype=data.dtype)
+
+        def bin_val(ci, bi, bj):
+            gy1 = y1 + bi * bin_h
+            gx1 = x1 + bj * bin_w
+            my = (ys[None, :] >= jnp.floor(gy1)) & \
+                 (ys[None, :] < jnp.ceil(gy1 + bin_h))
+            mx = (xs[None, :] >= jnp.floor(gx1)) & \
+                 (xs[None, :] < jnp.ceil(gx1 + bin_w))
+            mask = (my.reshape(-1, 1) & mx.reshape(1, -1)).astype(data.dtype)
+            # bin -> position-sensitive group cell (psroi_pooling.cc:
+            # gh = floor(ph * group / pooled)); differs from the bin
+            # index whenever group_size != pooled_size
+            gh = (bi * group) // pooled
+            gw = (bj * group) // pooled
+            chan = ci * group * group + gh * group + gw
+            s = (img[chan] * mask).sum()
+            cnt = jnp.maximum(mask.sum(), 1.0)
+            return s / cnt
+
+        ci, bi, bj = jnp.meshgrid(jnp.arange(out_dim), jnp.arange(pooled),
+                                  jnp.arange(pooled), indexing="ij")
+        return jax.vmap(lambda a, b, c_: bin_val(a, b, c_))(
+            ci.ravel(), bi.ravel(), bj.ravel()).reshape(
+                out_dim, pooled, pooled)
+
+    return jax.vmap(one_roi)(rois)
+
+
+# --- sync batch norm --------------------------------------------------------
+@register("_contrib_SyncBatchNorm", num_outputs=3, mutate_aux=(3, 4),
+          alias=("SyncBatchNorm",))
+def _sync_batch_norm(attrs, x, gamma, beta, moving_mean, moving_var):
+    """Cross-device BatchNorm (reference: contrib/sync_batch_norm-inl.h —
+    allreduce of batch statistics across GPUs).
+
+    TPU redesign: inside shard_map/pmap the ``axis_name`` attr names the
+    mesh axis to psum statistics over; in single-program execution (the
+    usual pjit data-parallel case) XLA already sees the GLOBAL batch, so
+    plain BN statistics are exactly the synchronized ones and no attr is
+    needed."""
+    eps = float(attrs.get("eps", 1e-3))
+    momentum = float(attrs.get("momentum", 0.9))
+    training = bool(attrs.get("_training", False)) and not bool(
+        attrs.get("use_global_stats", False))
+    fix_gamma = bool(attrs.get("fix_gamma", True))
+    axis_name = attrs.get("axis_name", None)
+    if fix_gamma:
+        gamma = jnp.ones_like(gamma)
+    red = tuple(i for i in range(x.ndim) if i != 1)
+    bshape = (1, -1) + (1,) * (x.ndim - 2)
+    if training:
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=red)
+        sq = jnp.mean(xf * xf, axis=red)
+        if axis_name:
+            mean = lax.pmean(mean, axis_name)
+            sq = lax.pmean(sq, axis_name)
+        var = sq - mean * mean
+        new_mm = moving_mean * momentum + mean.astype(moving_mean.dtype) \
+            * (1 - momentum)
+        new_mv = moving_var * momentum + var.astype(moving_var.dtype) \
+            * (1 - momentum)
+    else:
+        mean, var = moving_mean, moving_var
+        new_mm, new_mv = moving_mean, moving_var
+    inv = lax.rsqrt(var.astype(jnp.float32) + eps)
+    out = (x.astype(jnp.float32) - mean.reshape(bshape)) * inv.reshape(bshape)
+    out = out * gamma.reshape(bshape) + beta.reshape(bshape)
+    return out.astype(x.dtype), new_mm, new_mv
+
+
+# --- small contrib ops ------------------------------------------------------
+@register("_contrib_quadratic")
+def _quadratic(attrs, x):
+    """a*x^2 + b*x + c (reference: contrib/quadratic_op.cc — the tutorial
+    example op)."""
+    a = float(attrs.get("a", 0.0))
+    b = float(attrs.get("b", 0.0))
+    c = float(attrs.get("c", 0.0))
+    return a * x * x + b * x + c
+
+
+@register("_contrib_index_array")
+def _index_array(attrs, x):
+    """Coordinates of every element (reference: contrib/index_array.cc);
+    optional ``axes`` selects coordinate dims."""
+    axes = attrs.get("axes", None)
+    shape = x.shape
+    # int32 by design: TPU integer width (the reference emits int64;
+    # int64 narrows to int32 throughout this framework)
+    coords = jnp.stack(
+        jnp.meshgrid(*[jnp.arange(s) for s in shape], indexing="ij"),
+        axis=-1).astype(jnp.int32)
+    if axes is not None:
+        axes = [int(a) for a in (axes if isinstance(axes, (tuple, list))
+                                 else (axes,))]
+        coords = coords[..., axes]
+    return coords
+
+
+@register("_contrib_index_copy")
+def _index_copy(attrs, old, index, new):
+    """Copy rows of ``new`` into ``old`` at ``index``
+    (reference: contrib/index_copy.cc)."""
+    return old.at[index.astype(jnp.int32)].set(new)
+
+
+@register("_contrib_count_sketch")
+def _count_sketch(attrs, data, h, s):
+    """Count sketch projection (reference: contrib/count_sketch.cc):
+    out[:, h[i]] += s[i] * data[:, i], out_dim columns."""
+    out_dim = int(attrs["out_dim"])
+    n = data.shape[0]
+    hh = h.reshape(-1).astype(jnp.int32)
+    ss = s.reshape(-1).astype(data.dtype)
+    contrib = data * ss[None, :]
+    out = jnp.zeros((n, out_dim), data.dtype)
+    return out.at[:, hh].add(contrib)
+
+
+@register("_contrib_getnnz")
+def _getnnz(attrs, data):
+    """Number of stored values (reference: contrib/nnz.cc for CSR; dense
+    inputs count non-zeros)."""
+    axis = attrs.get("axis", None)
+    nz = (data != 0)
+    if axis is None:
+        return nz.sum().astype(jnp.int32)
+    return nz.sum(axis=int(axis)).astype(jnp.int32)
+
+
+@register("khatri_rao")
+def _khatri_rao(attrs, *mats):
+    """Column-wise Khatri-Rao product (reference: contrib/krprod.cc)."""
+    out = mats[0]
+    for m in mats[1:]:
+        out = (out[:, None, :] * m[None, :, :]).reshape(
+            out.shape[0] * m.shape[0], out.shape[1])
+    return out
+
+
+@register("_contrib_hawkesll", num_outputs=2)
+def _hawkesll(attrs, lda, alpha, beta, state, lags, marks, valid_length,
+              max_time):
+    """Univariate Hawkes process log likelihood over ragged sequences
+    (reference: contrib/hawkes_ll-inl.h hawkesll_forward +
+    hawkesll_forward_compensator, exact per-mark last-event-time
+    recurrence). Returns (ll per sample (N,), end-of-window state (N,K));
+    the event recurrence is one lax.scan per sample."""
+    k = alpha.shape[-1]
+    n, t = lags.shape
+    marks_i = marks.astype(jnp.int32)
+
+    def sample_ll(mu_i, state_i, lags_i, marks_row, vl, mt):
+        def step(carry, inp):
+            state_c, last_c, t_c, ll_c = carry
+            lag, mark, idx = inp
+            valid = idx < vl
+            t2 = t_c + lag
+            d = t2 - last_c[mark]
+            ed = jnp.exp(-beta[mark] * d)
+            lam = mu_i[mark] + alpha[mark] * beta[mark] * state_c[mark] * ed
+            comp = mu_i[mark] * d + alpha[mark] * state_c[mark] * (1 - ed)
+            ll2 = ll_c + jnp.where(
+                valid, jnp.log(jnp.maximum(lam, 1e-30)) - comp, 0.0)
+            state2 = state_c.at[mark].set(1.0 + state_c[mark] * ed)
+            last2 = last_c.at[mark].set(t2)
+            return (jnp.where(valid, state2, state_c),
+                    jnp.where(valid, last2, last_c),
+                    jnp.where(valid, t2, t_c), ll2), None
+
+        (state_f, last_f, _tf, ll), _ = lax.scan(
+            step,
+            (state_i.astype(jnp.float32), jnp.zeros(k, jnp.float32),
+             jnp.float32(0.0), jnp.float32(0.0)),
+            (lags_i.astype(jnp.float32), marks_row, jnp.arange(t)))
+        # remaining compensators over (t_last_k, T] + state decay to T
+        d = mt - last_f
+        ed = jnp.exp(-beta * d)
+        rem = mu_i * d + alpha * state_f * (1.0 - ed)
+        return ll - rem.sum(), state_f * ed
+
+    ll, new_state = jax.vmap(sample_ll)(
+        jnp.broadcast_to(lda, (n, k)).astype(jnp.float32), state, lags,
+        marks_i, valid_length.astype(jnp.int32),
+        max_time.astype(jnp.float32).reshape(-1))
+    return ll.astype(lda.dtype), new_state.astype(state.dtype)
+
+
+@register("_contrib_group_adagrad_update", num_outputs=2, mutate_aux=(2,))
+def _group_adagrad_update(attrs, weight, grad, history):
+    """Group AdaGrad (reference: contrib/optimizer_op.cc — per-row
+    accumulated squared norm). The history accumulator is a mutated
+    state input (same contract as sgd_mom_update's momentum)."""
+    lr = float(attrs["lr"])
+    eps = float(attrs.get("epsilon", 1e-5))
+    rescale = float(attrs.get("rescale_grad", 1.0))
+    clip = float(attrs.get("clip_gradient", -1.0))
+    g = grad * rescale
+    if clip > 0:
+        g = jnp.clip(g, -clip, clip)
+    red = tuple(range(1, g.ndim))
+    hist_new = history + jnp.mean(g * g, axis=red, keepdims=True)
+    return weight - lr * g / (jnp.sqrt(hist_new) + eps), hist_new

@@ -413,6 +413,39 @@ def _softmax_output(attrs, data, label):
     return jax.nn.softmax(data, axis=-1)
 
 
+# --- regression outputs (reference: src/operator/regression_output.cc) ------
+def _regression_grad(link, err_fn):
+    def grad(attrs, primals, cotangents):
+        data, label = primals
+        grad_scale = float(attrs.get("grad_scale", 1.0))
+        pred = link(data)
+        g = err_fn(pred, label.reshape(pred.shape)) * grad_scale
+        # reference normalizes by batch size (regression_output-inl.h)
+        g = g / data.shape[0]
+        ct = cotangents[0]
+        return (g * (ct.sum() if ct.ndim == 0 else 1.0), None)
+    return grad
+
+
+@register("LinearRegressionOutput",
+          fgradient=_regression_grad(lambda x: x, lambda p, l: p - l))
+def _linear_regression_output(attrs, data, label):
+    return data
+
+
+@register("MAERegressionOutput",
+          fgradient=_regression_grad(lambda x: x,
+                                     lambda p, l: jnp.sign(p - l)))
+def _mae_regression_output(attrs, data, label):
+    return data
+
+
+@register("LogisticRegressionOutput",
+          fgradient=_regression_grad(jax.nn.sigmoid, lambda p, l: p - l))
+def _logistic_regression_output(attrs, data, label):
+    return jax.nn.sigmoid(data)
+
+
 @register("softmax_cross_entropy")
 def _softmax_cross_entropy(attrs, data, label):
     logp = jax.nn.log_softmax(data, axis=-1)

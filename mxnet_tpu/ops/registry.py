@@ -141,6 +141,49 @@ class Operator:
 
         return call
 
+    def grad_aware(self, attrs):
+        """Compute closure that honors a registered custom ``fgradient``
+        under jax transforms (jax.custom_vjp wrapper).
+
+        The imperative tape applies fgradient itself (ndarray.py); every
+        TRACED path — symbol executor, group2ctx runner, fused subgraph
+        bodies — must use this so whole-graph jax.vjp picks up the custom
+        rule instead of differentiating fcompute literally (e.g.
+        SoftmaxOutput's forward is plain softmax; its training gradient
+        is softmax - one_hot(label), reference softmax_output-inl.h).
+        Wrappers are cached per canonical attrs key (this sits on the
+        per-node hot loop of every executor forward)."""
+        if self.fgradient is None:
+            return self.raw(attrs)
+        cache = getattr(self, "_grad_aware_cache", None)
+        if cache is None:
+            cache = self._grad_aware_cache = {}
+        key = tuple(sorted((k, _canon_attr(v)) for k, v in attrs.items()))
+        f = cache.get(key)
+        if f is not None:
+            return f
+        base = self.raw(attrs)
+        fg = self.fgradient
+        clean = {k: v for k, v in attrs.items() if k != "_amp"}
+
+        @jax.custom_vjp
+        def f(*arrays):
+            return base(*arrays)
+
+        def fwd(*arrays):
+            return f(*arrays), arrays
+
+        def bwd(primals, cts):
+            cts_t = tuple(cts) if isinstance(cts, (tuple, list)) else (cts,)
+            gs = fg(clean, primals, cts_t)
+            import jax.numpy as jnp
+            return tuple(jnp.zeros_like(p) if g is None else g
+                         for g, p in zip(gs, primals))
+
+        f.defvjp(fwd, bwd)
+        cache[key] = f
+        return f
+
     def infer(self, attrs, *avals):
         """Shape/dtype inference via abstract evaluation."""
         fn, _ = self.bind(**attrs)

@@ -85,6 +85,16 @@ def is_running():
     return _state["running"]
 
 
+def _reset_after_fork():
+    """Clear per-process profiling state in a forked child (called by
+    initialize.py's at-fork handler): the child must not append to the
+    parent's trace buffers or try to stop the parent's jax trace."""
+    _state["running"] = False
+    _state["jax_trace_dir"] = None
+    with _records_lock:
+        _records.clear()
+
+
 def device_sync_enabled():
     return _config["profile_device_sync"]
 

@@ -109,7 +109,7 @@ def _exec_inner(sym, inputs):
         attrs = {k: v for k, v in node.attrs.items()
                  if not k.startswith("__")}
         ins = [env[e] for e in node.inputs]
-        out = op.fcompute(attrs, *ins)
+        out = op.grad_aware(attrs)(*ins)
         outs = out if isinstance(out, (tuple, list)) else (out,)
         for i, o in enumerate(outs):
             env[(node, i)] = o

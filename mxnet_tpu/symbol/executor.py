@@ -119,7 +119,7 @@ class Executor:
                 if op.is_random:
                     counter += 1
                     ins = [jax.random.fold_in(key, counter)] + ins
-                out = op.fcompute(attrs, *ins)
+                out = op.grad_aware(attrs)(*ins)
                 outs = out if isinstance(out, (tuple, list)) else (out,)
                 n_user = len(outs) - len(op.mutate_aux)
                 for i, o in enumerate(outs[:n_user]):

@@ -79,7 +79,7 @@ class GroupedRunner:
                 counter += 1
                 ins = [jax.device_put(jax.random.fold_in(key, counter),
                                       dev)] + ins
-            raw = op.raw(attrs)
+            raw = op.grad_aware(attrs)
             if want_tape:
                 outs, vjp_fn = jax.vjp(lambda *a: _as_tuple(raw(*a)), *ins)
                 tape.append((node, list(node.inputs), vjp_fn,

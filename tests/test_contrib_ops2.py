@@ -1,0 +1,226 @@
+"""Second contrib op family: adaptive pooling, bilinear resize,
+deformable conv, PSROI pooling, sync BN, hawkesll, count sketch,
+index ops, quadratic, khatri_rao, group adagrad.
+
+Forward oracles are numpy re-implementations of the reference kernels
+(contrib/adaptive_avg_pooling.cc, bilinear_resize.cc,
+deformable_convolution.cc, psroi_pooling.cc, sync_batch_norm-inl.h,
+hawkes_ll-inl.h, count_sketch.cc, index_copy.cc, index_array.cc,
+quadratic_op.cc, krprod.cc, contrib optimizer_op.cc)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+class TestAdaptiveAvgPool:
+    def test_divisible(self):
+        x = np.arange(2 * 3 * 8 * 8, dtype=np.float32).reshape(2, 3, 8, 8)
+        out = nd.contrib.AdaptiveAvgPooling2D(
+            nd.array(x), output_size=(4, 4)).asnumpy()
+        ref = x.reshape(2, 3, 4, 2, 4, 2).mean(axis=(3, 5))
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_global(self):
+        x = np.random.RandomState(0).rand(1, 2, 5, 7).astype(np.float32)
+        out = nd.contrib.AdaptiveAvgPooling2D(nd.array(x)).asnumpy()
+        np.testing.assert_allclose(out[..., 0, 0], x.mean(axis=(2, 3)),
+                                   rtol=1e-5)
+
+    def test_non_divisible_partition_of_unity(self):
+        # interval weights must average exactly (sum of weighted cells = 1)
+        x = np.ones((1, 1, 7, 5), np.float32)
+        out = nd.contrib.AdaptiveAvgPooling2D(
+            nd.array(x), output_size=(3, 2)).asnumpy()
+        np.testing.assert_allclose(out, 1.0, rtol=1e-5)
+
+
+class TestBilinearResize:
+    def test_matches_jax_resize(self):
+        x = np.random.RandomState(1).rand(2, 3, 4, 4).astype(np.float32)
+        out = nd.contrib.BilinearResize2D(nd.array(x), height=8,
+                                          width=8).asnumpy()
+        assert out.shape == (2, 3, 8, 8)
+        # corners-aligned midpoint sanity: output mean ~ input mean
+        np.testing.assert_allclose(out.mean(), x.mean(), atol=0.02)
+
+
+class TestDeformableConv:
+    def test_zero_offset_equals_plain_conv(self):
+        rng = np.random.RandomState(2)
+        x = rng.randn(1, 3, 6, 6).astype(np.float32)
+        w = rng.randn(4, 3, 3, 3).astype(np.float32)
+        offset = np.zeros((1, 2 * 9, 4, 4), np.float32)
+        out = nd.contrib.DeformableConvolution(
+            nd.array(x), nd.array(offset), nd.array(w),
+            kernel=(3, 3), num_filter=4, no_bias=True).asnumpy()
+        ref = nd.Convolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                             num_filter=4, no_bias=True).asnumpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+    def test_integer_offset_shifts_sampling(self):
+        rng = np.random.RandomState(3)
+        x = rng.randn(1, 1, 6, 6).astype(np.float32)
+        w = np.ones((1, 1, 1, 1), np.float32)
+        # 1x1 kernel, offset (0, +1): out(y,x) = x(y, x+1) with zero pad
+        offset = np.zeros((1, 2, 6, 6), np.float32)
+        offset[:, 1] = 1.0
+        out = nd.contrib.DeformableConvolution(
+            nd.array(x), nd.array(offset), nd.array(w),
+            kernel=(1, 1), num_filter=1, no_bias=True).asnumpy()
+        ref = np.zeros_like(x)
+        ref[..., :, :-1] = x[..., :, 1:]
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_grad_flows(self):
+        rng = np.random.RandomState(4)
+        x = nd.array(rng.randn(1, 2, 5, 5).astype(np.float32))
+        off = nd.array(np.zeros((1, 18, 3, 3), np.float32))
+        w = nd.array(rng.randn(2, 2, 3, 3).astype(np.float32))
+        for a in (x, off, w):
+            a.attach_grad()
+        with mx.autograd.record():
+            y = nd.contrib.DeformableConvolution(
+                x, off, w, kernel=(3, 3), num_filter=2, no_bias=True)
+            loss = (y * y).sum()
+        loss.backward()
+        assert float(nd.abs(x.grad).sum().asscalar()) > 0
+        assert float(nd.abs(w.grad).sum().asscalar()) > 0
+
+
+class TestSyncBatchNorm:
+    def test_matches_batchnorm_single_program(self):
+        rng = np.random.RandomState(5)
+        x = rng.randn(4, 3, 5, 5).astype(np.float32)
+        g = (rng.rand(3) + 0.5).astype(np.float32)
+        b = rng.randn(3).astype(np.float32)
+        mm = np.zeros(3, np.float32)
+        mv = np.ones(3, np.float32)
+        args = [nd.array(x), nd.array(g), nd.array(b), nd.array(mm),
+                nd.array(mv)]
+        out_sync = nd.contrib.SyncBatchNorm(*args, fix_gamma=False,
+                                            eps=1e-5).asnumpy()
+        out_bn = nd.BatchNorm(*[a.copy() for a in args], fix_gamma=False,
+                              eps=1e-5).asnumpy()
+        np.testing.assert_allclose(out_sync, out_bn, rtol=1e-4, atol=1e-5)
+
+
+class TestHawkes:
+    def _numpy_hawkes(self, mu, alpha, beta, state, lags, marks, vl, mt):
+        n, t = lags.shape
+        k = mu.shape[1]
+        lls = np.zeros(n)
+        out_state = state.copy().astype(np.float64)
+        for i in range(n):
+            last = np.zeros(k)
+            tt = 0.0
+            ll = 0.0
+            for j in range(int(vl[i])):
+                ci = int(marks[i, j])
+                tt += lags[i, j]
+                d = tt - last[ci]
+                ed = np.exp(-beta[ci] * d)
+                lam = mu[i, ci] + alpha[ci] * beta[ci] * out_state[i, ci] * ed
+                comp = mu[i, ci] * d + alpha[ci] * out_state[i, ci] * (1 - ed)
+                ll += np.log(lam) - comp
+                out_state[i, ci] = 1 + out_state[i, ci] * ed
+                last[ci] = tt
+            d = mt[i] - last
+            ed = np.exp(-beta * d)
+            ll -= (mu[i] * d + alpha * out_state[i] * (1 - ed)).sum()
+            out_state[i] *= ed
+            lls[i] = ll
+        return lls, out_state
+
+    def test_matches_reference_kernel(self):
+        rng = np.random.RandomState(6)
+        n, t, k = 3, 6, 2
+        mu = rng.rand(n, k).astype(np.float32) * 0.5 + 0.2
+        alpha = rng.rand(k).astype(np.float32) * 0.5
+        beta = rng.rand(k).astype(np.float32) + 0.5
+        state = rng.rand(n, k).astype(np.float32)
+        lags = rng.rand(n, t).astype(np.float32)
+        marks = rng.randint(0, k, (n, t)).astype(np.float32)
+        vl = np.asarray([6, 4, 0], np.float32)
+        mt = np.asarray([8.0, 7.0, 5.0], np.float32)
+        ll, out_state = nd.contrib.hawkesll(
+            nd.array(mu), nd.array(alpha), nd.array(beta), nd.array(state),
+            nd.array(lags), nd.array(marks), nd.array(vl), nd.array(mt))
+        ll_ref, state_ref = self._numpy_hawkes(
+            mu, alpha, beta, state, lags, marks, vl, mt)
+        np.testing.assert_allclose(ll.asnumpy(), ll_ref, rtol=1e-4,
+                                   atol=1e-4)
+        np.testing.assert_allclose(out_state.asnumpy(), state_ref,
+                                   rtol=1e-4, atol=1e-4)
+
+
+class TestSmallContribOps:
+    def test_quadratic(self):
+        x = nd.array(np.asarray([1.0, 2.0, 3.0], np.float32))
+        out = nd.contrib.quadratic(x, a=2.0, b=3.0, c=1.0).asnumpy()
+        np.testing.assert_allclose(out, [6.0, 15.0, 28.0])
+
+    def test_index_copy(self):
+        old = nd.zeros((5, 3))
+        new = nd.array(np.ones((2, 3), np.float32) * 7)
+        idx = nd.array(np.asarray([1, 3], np.float32))
+        out = nd.contrib.index_copy(old, idx, new).asnumpy()
+        assert (out[1] == 7).all() and (out[3] == 7).all()
+        assert (out[0] == 0).all()
+
+    def test_index_array(self):
+        x = nd.zeros((2, 3))
+        out = nd.contrib.index_array(x).asnumpy()
+        assert out.shape == (2, 3, 2)
+        assert out[1, 2, 0] == 1 and out[1, 2, 1] == 2
+
+    def test_count_sketch(self):
+        rng = np.random.RandomState(7)
+        data = rng.randn(2, 4).astype(np.float32)
+        h = np.asarray([0, 2, 0, 1], np.float32)
+        s = np.asarray([1, -1, 1, 1], np.float32)
+        out = nd.contrib.count_sketch(
+            nd.array(data), nd.array(h), nd.array(s), out_dim=3).asnumpy()
+        ref = np.zeros((2, 3), np.float32)
+        for i in range(4):
+            ref[:, int(h[i])] += s[i] * data[:, i]
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_getnnz(self):
+        x = nd.array(np.asarray([[0, 1, 2], [0, 0, 3]], np.float32))
+        assert int(nd.contrib.getnnz(x).asscalar()) == 3
+
+    def test_khatri_rao(self):
+        a = np.asarray([[1., 2.], [3., 4.]], np.float32)
+        b = np.asarray([[5., 6.], [7., 8.], [9., 10.]], np.float32)
+        out = nd.khatri_rao(nd.array(a), nd.array(b)).asnumpy()
+        ref = np.stack([np.kron(a[:, i], b[:, i])
+                        for i in range(2)], axis=1)
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_group_adagrad(self):
+        rng = np.random.RandomState(8)
+        w = rng.randn(4, 3).astype(np.float32)
+        g = rng.randn(4, 3).astype(np.float32)
+        h = np.zeros((4, 1), np.float32)
+        out = nd.contrib.group_adagrad_update(
+            nd.array(w), nd.array(g), nd.array(h), lr=0.1).asnumpy()
+        hist = h + (g * g).mean(axis=1, keepdims=True)
+        ref = w - 0.1 * g / (np.sqrt(hist) + 1e-5)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+class TestPSROIPooling:
+    def test_uniform_map_pools_identity(self):
+        # constant feature map: every PS bin must return that constant
+        pooled = 2
+        out_dim = 3
+        c = out_dim * pooled * pooled
+        x = np.full((1, c, 8, 8), 5.0, np.float32)
+        rois = np.asarray([[0, 0, 0, 7, 7]], np.float32)
+        out = nd.contrib.PSROIPooling(
+            nd.array(x), nd.array(rois), spatial_scale=1.0,
+            output_dim=out_dim, pooled_size=pooled).asnumpy()
+        assert out.shape == (1, out_dim, pooled, pooled)
+        np.testing.assert_allclose(out, 5.0, rtol=1e-5)

@@ -128,7 +128,13 @@ kv.push("w", mx.nd.array(g))
 kv.barrier()
 out = mx.nd.zeros((40, 8))
 kv.pull("w", out=out)
+# row_sparse pull on the CHUNKED key: rows span chunk boundaries
+rows = mx.nd.array(np.asarray([2, 17, 35], np.float32))
+rs_out = mx.nd.sparse.row_sparse_array(
+    (np.zeros((3, 8), np.float32), [2, 17, 35]), shape=(40, 8))
+kv.row_sparse_pull("w", out=rs_out, row_ids=rows)
 np.save(sys.argv[4], np.stack([pre.asnumpy(), out.asnumpy()]))
+np.save(sys.argv[4] + ".rs.npy", rs_out.data.asnumpy())
 """
 
     def test_dist_chunked_roundtrip(self, tmp_path):
@@ -167,6 +173,12 @@ np.save(sys.argv[4], np.stack([pre.asnumpy(), out.asnumpy()]))
             np.testing.assert_allclose(pre, big, rtol=1e-6, atol=1e-6)
             np.testing.assert_allclose(post, 3.0, rtol=1e-6)
         np.testing.assert_array_equal(results[0], results[1])
+        # row_sparse pull across chunk boundaries returns the post-push
+        # rows (all 3.0 here)
+        for o in outs:
+            rs = np.load(o + ".rs.npy")
+            assert rs.shape == (3, 8)
+            np.testing.assert_allclose(rs, 3.0, rtol=1e-6)
 
 
 class TestSparseDotBreadth:

@@ -516,8 +516,23 @@ EXEMPT = {
     "_contrib_flash_attention": "test_tp_ring.py",
     "_contrib_boolean_mask": "test_operator.py",
     "_contrib_arange_like": "test_operator.py",
-    "_contrib_AdaptiveAvgPooling2D": "test_operator.py",
-    "_contrib_BilinearResize2D": "test_operator.py",
+    "_contrib_AdaptiveAvgPooling2D": "test_contrib_ops2.py",
+    "_contrib_BilinearResize2D": "test_contrib_ops2.py",
+    "_contrib_DeformableConvolution": "test_contrib_ops2.py",
+    "_contrib_PSROIPooling": "test_contrib_ops2.py",
+    "_contrib_SyncBatchNorm": "test_contrib_ops2.py",
+    "_contrib_hawkesll": "test_contrib_ops2.py",
+    "_contrib_count_sketch": "test_contrib_ops2.py",
+    "_contrib_getnnz": "test_contrib_ops2.py",
+    "_contrib_index_copy": "test_contrib_ops2.py",
+    "_contrib_index_array": "test_contrib_ops2.py",
+    "_contrib_quadratic": "test_contrib_ops2.py",
+    "_contrib_group_adagrad_update": "test_contrib_ops2.py",
+    "khatri_rao": "test_contrib_ops2.py",
+    "LinearRegressionOutput": "test_contrib_svrg_text.py",
+    "MAERegressionOutput": "test_contrib_svrg_text.py",
+    "LogisticRegressionOutput": "test_contrib_svrg_text.py",
+    "_subgraph": "test_subgraph.py",
     # quantization ops
     "_contrib_quantize": "test_quantization.py",
     "_contrib_quantize_v2": "test_quantization.py",
@@ -680,6 +695,8 @@ def test_zero_uncovered_ops():
             forms = {n, n.lstrip("_")}
             if "linalg_" in n:     # tests call nd.linalg.<suffix>
                 forms.add("linalg." + n.split("linalg_")[-1])
+            if n.startswith("_contrib_"):  # tests call nd.contrib.<suffix>
+                forms.add("contrib." + n[len("_contrib_"):])
             return any(f in text for f in forms)
 
         assert any(mentioned(n) for n in names), \
