@@ -1,0 +1,87 @@
+"""Python side of the C predict API (driven by src/c_predict_api.cc).
+
+Parity: the reference's standalone predict ABI (c_predict_api.cc) binds a
+symbol + params for inference only; here the Predictor wraps a bound
+Executor with grad_req='null'. Params arrive as the raw bytes of a
+.params file (nd.save format), inputs/outputs as raw float32 buffers.
+"""
+from __future__ import annotations
+
+import io as _io
+import os
+import tempfile
+
+import numpy as np
+
+from . import ndarray as nd
+from .base import MXNetError
+from .context import cpu
+from .symbol import load_json
+
+
+def _load_params_bytes(raw):
+    """Deserialize nd.save bytes → dict (tolerates arg:/aux: prefixes)."""
+    # nd.load reads from a path; spool the bytes through a temp file
+    with tempfile.NamedTemporaryFile(suffix=".params", delete=False) as f:
+        f.write(raw)
+        path = f.name
+    try:
+        loaded = nd.load(path)
+    finally:
+        os.unlink(path)
+    if not isinstance(loaded, dict):
+        raise MXNetError(
+            "predictor params must be a NAMED dict save "
+            "(nd.save(path, {'arg:name': ...}) / HybridBlock.export); "
+            "got an unnamed list save")
+    out = {}
+    for k, v in loaded.items():
+        out[k.split(":", 1)[-1]] = v
+    return out
+
+
+class Predictor:
+    def __init__(self, symbol_json, param_bytes, input_shapes):
+        self._sym = load_json(symbol_json)
+        params = _load_params_bytes(param_bytes)
+        arg_names = self._sym.list_arguments()
+        aux_names = set(self._sym.list_auxiliary_states())
+        self._input_shapes = {k: tuple(int(d) for d in v)
+                              for k, v in input_shapes.items()}
+        args = {}
+        for name in arg_names:
+            if name in self._input_shapes:
+                args[name] = nd.zeros(self._input_shapes[name])
+            elif name in params:
+                args[name] = params[name]
+            else:
+                raise MXNetError(
+                    f"predictor: argument {name!r} has neither a bound "
+                    "input shape nor a loaded parameter")
+        aux = {name: params[name] for name in aux_names if name in params}
+        self._exec = self._sym.bind(cpu(), args, grad_req="null",
+                                    aux_states=aux)
+        self._outputs = None
+
+    def set_input(self, key, raw):
+        if key not in self._input_shapes:
+            raise MXNetError(f"predictor: unknown input {key!r}")
+        shape = self._input_shapes[key]
+        arr = np.frombuffer(raw, np.float32).reshape(shape)
+        self._exec.arg_dict[key][:] = arr
+        return True
+
+    def forward(self):
+        self._outputs = self._exec.forward(is_train=False)
+        return True
+
+    def output_shape(self, index):
+        if self._outputs is None:
+            self.forward()
+        return tuple(int(d) for d in self._outputs[int(index)].shape)
+
+    def output_bytes(self, index):
+        if self._outputs is None:
+            raise MXNetError("forward() has not run")
+        out = self._outputs[int(index)].asnumpy().astype(np.float32)
+        return np.ascontiguousarray(out).tobytes()

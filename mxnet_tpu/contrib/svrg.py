@@ -22,6 +22,13 @@ from ..base import MXNetError
 from ..module import Module
 
 
+def _name_values(metric):
+    names, values = metric.get()
+    if not isinstance(names, (list, tuple)):
+        names, values = [names], [values]
+    return names, values
+
+
 class SVRGModule(Module):
     """Module with Stochastic Variance Reduced Gradient updates
     (parity: svrg_module.py:30 SVRGModule).
@@ -151,8 +158,9 @@ class SVRGModule(Module):
                         cb(type("BatchEndParam", (), {
                             "epoch": epoch, "nbatch": nbatch,
                             "eval_metric": eval_metric, "locals": None})())
-            self.logger.info("Epoch[%d] Train-%s=%f", epoch,
-                             *eval_metric.get())
+            for mname, mval in zip(*_name_values(eval_metric)):
+                self.logger.info("Epoch[%d] Train-%s=%f", epoch, mname,
+                                 mval)
             if epoch_end_callback is not None:
                 self._sync_params_from_exec()
                 for cb in (epoch_end_callback

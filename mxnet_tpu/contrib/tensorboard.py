@@ -65,13 +65,23 @@ def _scalar_summary(tag, value):
     return emit_bytes(1, val)
 
 
+_FILE_COUNTER = 0
+
+
 class SummaryWriter:
     """Minimal scalar-only event writer (mxboard.SummaryWriter surface
     subset: add_scalar / flush / close)."""
 
     def __init__(self, logdir):
         os.makedirs(logdir, exist_ok=True)
-        fname = f"events.out.tfevents.{int(time.time())}.mxnet_tpu"
+        # hostname+pid+counter keep concurrent writers (train/val
+        # callbacks created in the same second) in separate files
+        import socket
+        global _FILE_COUNTER
+        _FILE_COUNTER += 1
+        fname = (f"events.out.tfevents.{int(time.time())}."
+                 f"{socket.gethostname()}.{os.getpid()}.{_FILE_COUNTER}"
+                 ".mxnet_tpu")
         self._path = os.path.join(logdir, fname)
         self._f = open(self._path, "ab")
         self._write_event(_event_bytes(time.time(),

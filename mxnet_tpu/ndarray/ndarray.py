@@ -23,7 +23,7 @@ from ..context import Context, cpu, current_context
 from ..ops import registry as _registry
 
 # ops whose compute depends on autograd train/predict mode
-_TRAINING_ATTR_OPS = {"Dropout", "BatchNorm"}
+_TRAINING_ATTR_OPS = {"Dropout", "BatchNorm", "_contrib_SyncBatchNorm"}
 
 
 class _TraceHooks(__import__("threading").local):

@@ -776,7 +776,10 @@ def _adaptive_avg_pool2d(attrs, x):
 
 @register("_contrib_BilinearResize2D")
 def _bilinear_resize2d(attrs, x, *maybe_like):
-    """Bilinear upsample/downsample (reference: bilinear_resize.cc)."""
+    """Bilinear upsample/downsample (reference: bilinear_resize.cc).
+
+    Align-corners sampling (src = i*(in-1)/(out-1)), matching the
+    reference kernel — NOT jax.image.resize's half-pixel convention."""
     if maybe_like:
         oh, ow = maybe_like[0].shape[2], maybe_like[0].shape[3]
     else:
@@ -788,8 +791,26 @@ def _bilinear_resize2d(attrs, x, *maybe_like):
             oh = int(x.shape[2] * sh)
         if ow <= 0 and sw > 0:
             ow = int(x.shape[3] * sw)
-    return jax.image.resize(x, (x.shape[0], x.shape[1], oh, ow),
-                            method="linear")
+    h, w = x.shape[2], x.shape[3]
+
+    def axis_weights(in_size, out_size):
+        if out_size == 1:
+            src = jnp.zeros((1,), x.dtype)
+        else:
+            src = jnp.arange(out_size, dtype=x.dtype) * \
+                ((in_size - 1) / (out_size - 1))
+        lo = jnp.clip(jnp.floor(src).astype(jnp.int32), 0, in_size - 1)
+        hi = jnp.clip(lo + 1, 0, in_size - 1)
+        frac = src - lo.astype(x.dtype)
+        return lo, hi, frac
+
+    ylo, yhi, fy = axis_weights(h, oh)
+    xlo, xhi, fx = axis_weights(w, ow)
+    top = x[:, :, ylo, :] * (1 - fy)[None, None, :, None] + \
+        x[:, :, yhi, :] * fy[None, None, :, None]
+    out = top[:, :, :, xlo] * (1 - fx)[None, None, None, :] + \
+        top[:, :, :, xhi] * fx[None, None, None, :]
+    return out
 
 
 # --- deformable family ------------------------------------------------------
@@ -1088,4 +1109,5 @@ def _group_adagrad_update(attrs, weight, grad, history):
         g = jnp.clip(g, -clip, clip)
     red = tuple(range(1, g.ndim))
     hist_new = history + jnp.mean(g * g, axis=red, keepdims=True)
-    return weight - lr * g / (jnp.sqrt(hist_new) + eps), hist_new
+    # eps INSIDE the sqrt (reference GroupAdagradDnsRspDnsImpl)
+    return weight - lr * g / jnp.sqrt(hist_new + eps), hist_new

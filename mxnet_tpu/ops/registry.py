@@ -177,8 +177,19 @@ class Operator:
             cts_t = tuple(cts) if isinstance(cts, (tuple, list)) else (cts,)
             gs = fg(clean, primals, cts_t)
             import jax.numpy as jnp
-            return tuple(jnp.zeros_like(p) if g is None else g
-                         for g, p in zip(gs, primals))
+            out = []
+            for g, p in zip(gs, primals):
+                if g is None:
+                    g = jnp.zeros_like(p)
+                elif hasattr(g, "full_shape") and hasattr(g, "indices"):
+                    # SparseCot (row-sparse tape gradient, e.g. Embedding
+                    # sparse_grad): custom_vjp needs dense jax cotangents
+                    # — densify here; the traced-graph path has no sparse
+                    # gradient storage anyway
+                    g = jnp.zeros(g.full_shape, g.values.dtype).at[
+                        g.indices.astype(jnp.int32)].add(g.values)
+                out.append(g)
+            return tuple(out)
 
         f.defvjp(fwd, bwd)
         cache[key] = f

@@ -73,7 +73,8 @@ class GroupedRunner:
             ins = [jax.device_put(env[e], dev) for e in node.inputs]
             attrs = {k: v for k, v in node.attrs.items()
                      if not k.startswith("__") and k != "ctx_group"}
-            if node.op in ("Dropout", "BatchNorm"):
+            from ..ndarray.ndarray import _TRAINING_ATTR_OPS
+            if op.name in _TRAINING_ATTR_OPS:
                 attrs["_training"] = is_train
             if op.is_random:
                 counter += 1

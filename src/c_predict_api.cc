@@ -1,0 +1,206 @@
+// C predict API for mxnet_tpu (parity: include/mxnet/c_predict_api.h —
+// the reference's standalone inference ABI that every language binding
+// wraps: MXPredCreate/SetInput/Forward/GetOutput/Free + MXGetLastError).
+//
+// Architecture: the reference's C API fronts a C++ core; this framework's
+// core is Python-over-JAX, so the ABI embeds CPython (or joins an already
+// initialized interpreter when loaded INTO a Python process) and drives
+// the helper module mxnet_tpu.c_predict under the GIL. Any C-capable
+// language links this exactly like the reference's libmxnet_predict.
+//
+// Build: make -C src predict   (links libpython3; see src/Makefile)
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+typedef void* PredictorHandle;
+typedef uint32_t mx_uint;
+
+namespace {
+
+std::mutex g_mutex;
+thread_local std::string g_last_error;
+bool g_we_initialized = false;
+
+struct Predictor {
+  PyObject* py_pred = nullptr;          // mxnet_tpu.c_predict.Predictor
+  std::vector<std::vector<mx_uint>> out_shapes;
+};
+
+class Gil {
+ public:
+  Gil() : state_(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+void ensure_python() {
+  std::lock_guard<std::mutex> lk(g_mutex);
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    g_we_initialized = true;
+    // release the GIL acquired by Py_Initialize so Gil{} works uniformly
+    PyEval_SaveThread();
+  }
+}
+
+int fail_from_python() {
+  PyObject *type, *value, *tb;
+  PyErr_Fetch(&type, &value, &tb);
+  PyObject* s = value ? PyObject_Str(value) : nullptr;
+  g_last_error = s ? PyUnicode_AsUTF8(s) : "unknown python error";
+  Py_XDECREF(s);
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  return -1;
+}
+
+int fail(const std::string& msg) {
+  g_last_error = msg;
+  return -1;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* MXGetLastError() { return g_last_error.c_str(); }
+
+// Create a predictor from symbol JSON + serialized params (the bytes of a
+// .params file), binding input shapes (CSR layout via indptr, as in the
+// reference signature c_predict_api.h:87).
+int MXPredCreate(const char* symbol_json_str, const void* param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 mx_uint num_input_nodes, const char** input_keys,
+                 const mx_uint* input_shape_indptr,
+                 const mx_uint* input_shape_data, PredictorHandle* out) {
+  (void)dev_type;
+  (void)dev_id;
+  ensure_python();
+  Gil gil;
+  PyObject* mod = PyImport_ImportModule("mxnet_tpu.c_predict");
+  if (!mod) return fail_from_python();
+  PyObject* cls = PyObject_GetAttrString(mod, "Predictor");
+  Py_DECREF(mod);
+  if (!cls) return fail_from_python();
+
+  PyObject* shapes = PyDict_New();
+  for (mx_uint i = 0; i < num_input_nodes; ++i) {
+    PyObject* shp = PyTuple_New(input_shape_indptr[i + 1]
+                                - input_shape_indptr[i]);
+    for (mx_uint j = input_shape_indptr[i], k = 0;
+         j < input_shape_indptr[i + 1]; ++j, ++k) {
+      PyTuple_SET_ITEM(shp, k, PyLong_FromUnsignedLong(
+          input_shape_data[j]));
+    }
+    PyDict_SetItemString(shapes, input_keys[i], shp);
+    Py_DECREF(shp);
+  }
+  PyObject* args = Py_BuildValue(
+      "(s y# O)", symbol_json_str,
+      static_cast<const char*>(param_bytes),
+      static_cast<Py_ssize_t>(param_size), shapes);
+  Py_DECREF(shapes);
+  PyObject* pred = args ? PyObject_CallObject(cls, args) : nullptr;
+  Py_XDECREF(args);
+  Py_DECREF(cls);
+  if (!pred) return fail_from_python();
+
+  Predictor* p = new Predictor;
+  p->py_pred = pred;
+  *out = p;
+  return 0;
+}
+
+int MXPredSetInput(PredictorHandle handle, const char* key,
+                   const float* data, mx_uint size) {
+  Predictor* p = static_cast<Predictor*>(handle);
+  if (!p) return fail("null handle");
+  Gil gil;
+  PyObject* buf = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char*>(data),
+      static_cast<Py_ssize_t>(size) * sizeof(float));
+  if (!buf) return fail_from_python();
+  PyObject* r = PyObject_CallMethod(p->py_pred, "set_input", "sO", key, buf);
+  Py_DECREF(buf);
+  if (!r) return fail_from_python();
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXPredForward(PredictorHandle handle) {
+  Predictor* p = static_cast<Predictor*>(handle);
+  if (!p) return fail("null handle");
+  Gil gil;
+  PyObject* r = PyObject_CallMethod(p->py_pred, "forward", nullptr);
+  if (!r) return fail_from_python();
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                         mx_uint** shape_data, mx_uint* shape_ndim) {
+  Predictor* p = static_cast<Predictor*>(handle);
+  if (!p) return fail("null handle");
+  Gil gil;
+  PyObject* shp = PyObject_CallMethod(p->py_pred, "output_shape", "I",
+                                      index);
+  if (!shp) return fail_from_python();
+  Py_ssize_t n = PyTuple_Size(shp);
+  if (p->out_shapes.size() <= index) p->out_shapes.resize(index + 1);
+  auto& vec = p->out_shapes[index];
+  vec.resize(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    vec[i] = static_cast<mx_uint>(
+        PyLong_AsUnsignedLong(PyTuple_GET_ITEM(shp, i)));
+  }
+  Py_DECREF(shp);
+  *shape_data = vec.data();
+  *shape_ndim = static_cast<mx_uint>(n);
+  return 0;
+}
+
+int MXPredGetOutput(PredictorHandle handle, mx_uint index, float* data,
+                    mx_uint size) {
+  Predictor* p = static_cast<Predictor*>(handle);
+  if (!p) return fail("null handle");
+  Gil gil;
+  PyObject* buf = PyObject_CallMethod(p->py_pred, "output_bytes", "I",
+                                      index);
+  if (!buf) return fail_from_python();
+  char* src = nullptr;
+  Py_ssize_t nbytes = 0;
+  if (PyBytes_AsStringAndSize(buf, &src, &nbytes) != 0) {
+    Py_DECREF(buf);
+    return fail_from_python();
+  }
+  if (static_cast<size_t>(nbytes) != size * sizeof(float)) {
+    Py_DECREF(buf);
+    return fail("MXPredGetOutput: size mismatch");
+  }
+  std::memcpy(data, src, nbytes);
+  Py_DECREF(buf);
+  return 0;
+}
+
+int MXPredFree(PredictorHandle handle) {
+  Predictor* p = static_cast<Predictor*>(handle);
+  if (!p) return 0;
+  {
+    Gil gil;
+    Py_XDECREF(p->py_pred);
+  }
+  delete p;
+  return 0;
+}
+
+}  // extern "C"

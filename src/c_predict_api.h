@@ -1,0 +1,54 @@
+/*
+ * C predict API for mxnet_tpu (parity: include/mxnet/c_predict_api.h).
+ *
+ * Standalone inference ABI: link libmxnet_tpu_predict.so (build with
+ * `make -C src predict`) from any C-capable language. The library embeds
+ * CPython when loaded into a non-Python host, or joins the running
+ * interpreter when loaded into a Python process.
+ *
+ * All functions return 0 on success, -1 on error; MXGetLastError()
+ * returns the thread-local message for the last failure.
+ */
+#ifndef MXNET_TPU_C_PREDICT_API_H_
+#define MXNET_TPU_C_PREDICT_API_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void* PredictorHandle;
+typedef unsigned int mx_uint;
+
+const char* MXGetLastError(void);
+
+/* Create a predictor from symbol JSON + the bytes of a .params file.
+ * Input shapes use CSR layout: input_shape_indptr has num_input_nodes+1
+ * entries delimiting each input's dims in input_shape_data.
+ * dev_type/dev_id are accepted for signature parity (the runtime places
+ * computation via its own context rules). */
+int MXPredCreate(const char* symbol_json_str, const void* param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 mx_uint num_input_nodes, const char** input_keys,
+                 const mx_uint* input_shape_indptr,
+                 const mx_uint* input_shape_data, PredictorHandle* out);
+
+int MXPredSetInput(PredictorHandle handle, const char* key,
+                   const float* data, mx_uint size);
+
+int MXPredForward(PredictorHandle handle);
+
+/* shape_data points into predictor-owned storage; valid until the next
+ * MXPredGetOutputShape call for the same index or MXPredFree. */
+int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                         mx_uint** shape_data, mx_uint* shape_ndim);
+
+int MXPredGetOutput(PredictorHandle handle, mx_uint index, float* data,
+                    mx_uint size);
+
+int MXPredFree(PredictorHandle handle);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  /* MXNET_TPU_C_PREDICT_API_H_ */

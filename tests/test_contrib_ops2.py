@@ -37,13 +37,41 @@ class TestAdaptiveAvgPool:
 
 
 class TestBilinearResize:
-    def test_matches_jax_resize(self):
-        x = np.random.RandomState(1).rand(2, 3, 4, 4).astype(np.float32)
-        out = nd.contrib.BilinearResize2D(nd.array(x), height=8,
-                                          width=8).asnumpy()
-        assert out.shape == (2, 3, 8, 8)
-        # corners-aligned midpoint sanity: output mean ~ input mean
-        np.testing.assert_allclose(out.mean(), x.mean(), atol=0.02)
+    def test_align_corners_exact(self):
+        # reference bilinear_resize.cc convention: src = i*(in-1)/(out-1)
+        x = np.asarray([[[[0.0, 1.0]]]], np.float32)
+        out = nd.contrib.BilinearResize2D(nd.array(x), height=1,
+                                          width=4).asnumpy()
+        np.testing.assert_allclose(out.ravel(), [0, 1 / 3, 2 / 3, 1],
+                                   rtol=1e-5)
+
+    def test_full_oracle(self):
+        rng = np.random.RandomState(1)
+        x = rng.rand(2, 3, 4, 5).astype(np.float32)
+        oh, ow = 7, 3
+        out = nd.contrib.BilinearResize2D(nd.array(x), height=oh,
+                                          width=ow).asnumpy()
+
+        def ref_resize(img):
+            res = np.zeros((oh, ow), np.float32)
+            for i in range(oh):
+                for j in range(ow):
+                    sy = i * (img.shape[0] - 1) / (oh - 1)
+                    sx = j * (img.shape[1] - 1) / (ow - 1)
+                    y0, x0 = int(np.floor(sy)), int(np.floor(sx))
+                    y1 = min(y0 + 1, img.shape[0] - 1)
+                    x1 = min(x0 + 1, img.shape[1] - 1)
+                    fy, fx = sy - y0, sx - x0
+                    res[i, j] = (img[y0, x0] * (1 - fy) * (1 - fx)
+                                 + img[y1, x0] * fy * (1 - fx)
+                                 + img[y0, x1] * (1 - fy) * fx
+                                 + img[y1, x1] * fy * fx)
+            return res
+
+        for n in range(2):
+            for c in range(3):
+                np.testing.assert_allclose(out[n, c], ref_resize(x[n, c]),
+                                           rtol=1e-4, atol=1e-5)
 
 
 class TestDeformableConv:
@@ -90,6 +118,23 @@ class TestDeformableConv:
 
 
 class TestSyncBatchNorm:
+    def test_training_mode_normalizes_and_updates_stats(self):
+        """SyncBatchNorm must receive the _training attr like BatchNorm
+        (regression: it silently ran in inference mode)."""
+        rng = np.random.RandomState(11)
+        x = nd.array((rng.randn(8, 3, 4, 4) * 3 + 7).astype(np.float32))
+        g = nd.array(np.ones(3, np.float32))
+        b = nd.array(np.zeros(3, np.float32))
+        mm = nd.zeros((3,))
+        mv = nd.ones((3,))
+        with mx.autograd.record(train_mode=True):
+            out = nd.contrib.SyncBatchNorm(x, g, b, mm, mv,
+                                           fix_gamma=False, eps=1e-5)
+        o = out.asnumpy()
+        assert abs(o.mean()) < 0.1, "not normalized: training attr missing"
+        assert abs(o.std() - 1.0) < 0.1
+        assert abs(float(mm.asnumpy()[0])) > 1e-6, "moving stats frozen"
+
     def test_matches_batchnorm_single_program(self):
         rng = np.random.RandomState(5)
         x = rng.randn(4, 3, 5, 5).astype(np.float32)
@@ -205,9 +250,11 @@ class TestSmallContribOps:
         g = rng.randn(4, 3).astype(np.float32)
         h = np.zeros((4, 1), np.float32)
         out = nd.contrib.group_adagrad_update(
-            nd.array(w), nd.array(g), nd.array(h), lr=0.1).asnumpy()
+            nd.array(w), nd.array(g), nd.array(h), lr=0.1)
+        out = (out[0] if isinstance(out, (list, tuple)) else out).asnumpy()
         hist = h + (g * g).mean(axis=1, keepdims=True)
-        ref = w - 0.1 * g / (np.sqrt(hist) + 1e-5)
+        # reference GroupAdaGrad: eps inside the sqrt
+        ref = w - 0.1 * g / np.sqrt(hist + 1e-5)
         np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
 
 

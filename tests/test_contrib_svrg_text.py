@@ -118,6 +118,30 @@ class TestCustomGradInExecutor:
         np.testing.assert_allclose(grads["data"].asnumpy(), prob - onehot,
                                    rtol=1e-5, atol=1e-6)
 
+    def test_sparse_grad_embedding_in_executor(self):
+        """Embedding(sparse_grad=True) must work through the traced
+        executor (regression: SparseCot leaked into custom_vjp)."""
+        data = sym.var("data")
+        w = sym.var("emb_weight")
+        e = sym.Symbol._create("Embedding", [data, w],
+                               {"input_dim": 10, "output_dim": 4,
+                                "sparse_grad": True})
+        out = sym.Symbol._create("sum", [e], {})
+        rng = np.random.RandomState(4)
+        wv = rng.randn(10, 4).astype(np.float32)
+        args = {"data": mx.nd.array(np.asarray([1, 3, 3], np.float32)),
+                "emb_weight": mx.nd.array(wv)}
+        grads = {"emb_weight": mx.nd.zeros((10, 4))}
+        ex = out.bind(mx.cpu(), args, args_grad=grads,
+                      grad_req={"data": "null", "emb_weight": "write"})
+        ex.forward(is_train=True)
+        ex.backward()
+        gw = grads["emb_weight"].asnumpy()
+        expect = np.zeros((10, 4), np.float32)
+        expect[1] += 1
+        expect[3] += 2
+        np.testing.assert_allclose(gw, expect, rtol=1e-6)
+
     def test_regression_output_grads(self):
         """MAERegressionOutput / LogisticRegressionOutput custom grads
         (reference regression_output.cc: sign(p-l) and p-l, batch-normed)."""
