@@ -1,0 +1,98 @@
+// Header-only C++ API over the mxnet_tpu C predict ABI
+// (parity: reference cpp-package/ — a fluent C++ layer generated over the
+// C API; here hand-written RAII over src/c_predict_api.h).
+//
+// Usage:
+//   #include <mxnet_tpu/predictor.hpp>
+//   mxnet_tpu::Predictor pred(symbol_json, param_bytes,
+//                             {{"data", {1, 3, 224, 224}}});
+//   pred.SetInput("data", img.data(), img.size());
+//   pred.Forward();
+//   std::vector<float> out = pred.GetOutput(0);
+//
+// Link: -lmxnet_tpu_predict (build with `make -C src predict`).
+
+#ifndef MXNET_TPU_CPP_PREDICTOR_HPP_
+#define MXNET_TPU_CPP_PREDICTOR_HPP_
+
+#include <cstdint>
+#include <map>
+#include <numeric>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "../../../src/c_predict_api.h"
+
+namespace mxnet_tpu {
+
+class Error : public std::runtime_error {
+ public:
+  explicit Error(const std::string& what) : std::runtime_error(what) {}
+};
+
+inline void Check(int rc) {
+  if (rc != 0) throw Error(MXGetLastError());
+}
+
+class Predictor {
+ public:
+  Predictor(const std::string& symbol_json, const std::string& param_bytes,
+            const std::map<std::string, std::vector<mx_uint>>& input_shapes)
+      : handle_(nullptr) {
+    std::vector<const char*> keys;
+    std::vector<mx_uint> indptr{0};
+    std::vector<mx_uint> shape_data;
+    for (const auto& kv : input_shapes) {
+      keys.push_back(kv.first.c_str());
+      for (mx_uint d : kv.second) shape_data.push_back(d);
+      indptr.push_back(static_cast<mx_uint>(shape_data.size()));
+    }
+    Check(MXPredCreate(symbol_json.c_str(), param_bytes.data(),
+                       static_cast<int>(param_bytes.size()),
+                       /*dev_type=*/1, /*dev_id=*/0,
+                       static_cast<mx_uint>(keys.size()), keys.data(),
+                       indptr.data(), shape_data.data(), &handle_));
+  }
+
+  Predictor(const Predictor&) = delete;
+  Predictor& operator=(const Predictor&) = delete;
+  Predictor(Predictor&& o) noexcept : handle_(o.handle_) {
+    o.handle_ = nullptr;
+  }
+
+  ~Predictor() {
+    if (handle_) MXPredFree(handle_);
+  }
+
+  void SetInput(const std::string& key, const float* data, size_t size) {
+    Check(MXPredSetInput(handle_, key.c_str(), data,
+                         static_cast<mx_uint>(size)));
+  }
+
+  void Forward() { Check(MXPredForward(handle_)); }
+
+  std::vector<mx_uint> GetOutputShape(mx_uint index = 0) {
+    mx_uint* data = nullptr;
+    mx_uint ndim = 0;
+    Check(MXPredGetOutputShape(handle_, index, &data, &ndim));
+    return std::vector<mx_uint>(data, data + ndim);
+  }
+
+  std::vector<float> GetOutput(mx_uint index = 0) {
+    auto shape = GetOutputShape(index);
+    size_t size = std::accumulate(shape.begin(), shape.end(),
+                                  size_t{1}, std::multiplies<size_t>());
+    std::vector<float> out(size);
+    Check(MXPredGetOutput(handle_, index, out.data(),
+                          static_cast<mx_uint>(size)));
+    return out;
+  }
+
+ private:
+  PredictorHandle handle_;
+};
+
+}  // namespace mxnet_tpu
+
+#endif  // MXNET_TPU_CPP_PREDICTOR_HPP_

@@ -7,7 +7,6 @@ Executor with grad_req='null'. Params arrive as the raw bytes of a
 """
 from __future__ import annotations
 
-import io as _io
 import os
 import tempfile
 
@@ -76,9 +75,12 @@ class Predictor:
         return True
 
     def output_shape(self, index):
-        if self._outputs is None:
-            self.forward()
-        return tuple(int(d) for d in self._outputs[int(index)].shape)
+        # answer from shape inference — never run the model for a shape
+        # query (and never cache zero-input outputs as if they were real)
+        if self._outputs is not None:
+            return tuple(int(d) for d in self._outputs[int(index)].shape)
+        _, out_shapes, _ = self._sym.infer_shape(**self._input_shapes)
+        return tuple(int(d) for d in out_shapes[int(index)])
 
     def output_bytes(self, index):
         if self._outputs is None:

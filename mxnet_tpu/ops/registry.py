@@ -181,13 +181,11 @@ class Operator:
             for g, p in zip(gs, primals):
                 if g is None:
                     g = jnp.zeros_like(p)
-                elif hasattr(g, "full_shape") and hasattr(g, "indices"):
+                elif hasattr(g, "dense"):
                     # SparseCot (row-sparse tape gradient, e.g. Embedding
-                    # sparse_grad): custom_vjp needs dense jax cotangents
-                    # — densify here; the traced-graph path has no sparse
-                    # gradient storage anyway
-                    g = jnp.zeros(g.full_shape, g.values.dtype).at[
-                        g.indices.astype(jnp.int32)].add(g.values)
+                    # sparse_grad): custom_vjp needs dense jax cotangents;
+                    # the traced-graph path has no sparse gradient storage
+                    g = g.dense()
                 out.append(g)
             return tuple(out)
 

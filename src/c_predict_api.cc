@@ -56,7 +56,8 @@ int fail_from_python() {
   PyObject *type, *value, *tb;
   PyErr_Fetch(&type, &value, &tb);
   PyObject* s = value ? PyObject_Str(value) : nullptr;
-  g_last_error = s ? PyUnicode_AsUTF8(s) : "unknown python error";
+  const char* c = s ? PyUnicode_AsUTF8(s) : nullptr;
+  g_last_error = c ? c : "unknown python error";
   Py_XDECREF(s);
   Py_XDECREF(type);
   Py_XDECREF(value);

@@ -198,3 +198,61 @@ def test_standalone_c_program(tmp_path):
     p.forward()
     ref = np.frombuffer(p.output_bytes(0), np.float32).reshape(2, 3)
     np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+_CPP_MAIN = r"""
+#include <mxnet_tpu/predictor.hpp>
+#include <cstdio>
+#include <fstream>
+#include <sstream>
+
+static std::string slurp(const char* p) {
+  std::ifstream f(p, std::ios::binary);
+  std::ostringstream ss;
+  ss << f.rdbuf();
+  return ss.str();
+}
+
+int main(int argc, char** argv) {
+  (void)argc;
+  mxnet_tpu::Predictor pred(slurp(argv[1]), slurp(argv[2]),
+                            {{"data", {2, 4}}});
+  std::vector<float> in(8);
+  for (int i = 0; i < 8; ++i) in[i] = i * 0.25f - 1.0f;
+  pred.SetInput("data", in.data(), in.size());
+  pred.Forward();
+  auto shape = pred.GetOutputShape(0);
+  if (shape.size() != 2 || shape[0] != 2 || shape[1] != 3) return 7;
+  for (float v : pred.GetOutput(0)) std::printf("%.6f\n", v);
+  return 0;
+}
+"""
+
+
+@needs_lib
+def test_cpp_package_wrapper(tmp_path):
+    """Header-only C++ fluent API (cpp-package/) over the C ABI."""
+    prefix, _xin, _ref = _export_mlp(tmp_path)
+    cpp = tmp_path / "main.cc"
+    cpp.write_text(_CPP_MAIN)
+    exe = str(tmp_path / "cpp_demo")
+    inc = os.path.join(_REPO, "cpp-package", "include")
+    try:
+        subprocess.run(
+            ["g++", "-std=c++17", str(cpp), "-o", exe, f"-I{inc}",
+             f"-L{os.path.dirname(_LIB)}", "-lmxnet_tpu_predict",
+             f"-Wl,-rpath,{os.path.dirname(_LIB)}"],
+            check=True, capture_output=True, timeout=120)
+    except subprocess.CalledProcessError as e:
+        pytest.fail(f"cpp compile failed: {e.stderr.decode()[-2000:]}")
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    site = [p for p in sys.path if "site-packages" in p]
+    env["PYTHONPATH"] = os.pathsep.join([_REPO] + site)
+    proc = subprocess.run(
+        [exe, prefix + "-symbol.json", prefix + "-0000.params"],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert proc.returncode == 0, proc.stderr
+    got = np.asarray([float(x) for x in proc.stdout.split()], np.float32)
+    assert got.shape == (6,) and np.isfinite(got).all()
