@@ -30,7 +30,8 @@ Always prints the line — on failure or budget exhaustion with whatever was
 measured (value 0.0 and an "error" field if nothing was).
 
 Env knobs: BENCH_DTYPE, BENCH_WARMUP, BENCH_ITERS, BENCH_TIME_BUDGET (s),
-BENCH_BATCH, BENCH_BATCH2 (second MFU point, 0 disables), BENCH_CALIB_N.
+BENCH_BATCH, BENCH_BATCH2 (second MFU point, 0 disables), BENCH_CALIB_N,
+BENCH_REMAT_FROM_BS (rematerialize at batch >= this; 0 disables).
 """
 import functools
 import json
@@ -184,26 +185,44 @@ def main():
                      "rescale_grad": 1.0}
         sgd_mom = _registry.get("sgd_mom_update").fcompute
 
-        def step(key, tparams, aparams, moms, x, y):
-            def loss_fn(tps):
-                ps = merge_params(train_idx, aux_list, tps, aparams)
-                with _ag.train_mode():
-                    outs, mutated = apply_fn(key, ps, (x,))
-                logits = outs[0].astype(jnp.float32)
-                logp = jax.nn.log_softmax(logits, axis=-1)
-                oh = jax.nn.one_hot(y.astype(jnp.int32), 1000)
-                return -(oh * logp).sum(axis=-1).mean(), mutated
+        # rematerialization for the large-batch point (parity:
+        # MXNET_BACKWARD_DO_MIRROR; r03 showed bs128 falling off a cliff
+        # — activation spill — while bs32 hit 0.55 MFU). The policy keeps
+        # conv+matmul outputs and recomputes elementwise chains
+        # (parallel/spmd.py remat_wrap, shared with TrainStep).
+        from mxnet_tpu.parallel.spmd import remat_wrap
+        remat_from = int(os.environ.get("BENCH_REMAT_FROM_BS", 64))
 
-            (loss, mutated), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(tparams)
-            new_p, new_m = [], []
-            for w, g, m in zip(tparams, grads, moms):
-                nw, nm = sgd_mom(sgd_attrs, w, g.astype(w.dtype), m)
-                new_p.append(nw)
-                new_m.append(nm)
-            new_aux = tuple(mu.astype(a.dtype)
-                            for mu, a in zip(mutated, aparams))
-            return tuple(new_p), new_aux, tuple(new_m), loss
+        def make_step(use_remat):
+            def step(key, tparams, aparams, moms, x, y):
+                def fwd(tps, x_):
+                    ps = merge_params(train_idx, aux_list, tps, aparams)
+                    with _ag.train_mode():
+                        outs, mutated = apply_fn(key, ps, (x_,))
+                    return outs[0], mutated
+
+                if use_remat:
+                    fwd = remat_wrap(fwd)
+
+                def loss_fn(tps):
+                    logits, mutated = fwd(tps, x)
+                    logits = logits.astype(jnp.float32)
+                    logp = jax.nn.log_softmax(logits, axis=-1)
+                    oh = jax.nn.one_hot(y.astype(jnp.int32), 1000)
+                    return -(oh * logp).sum(axis=-1).mean(), mutated
+
+                (loss, mutated), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(tparams)
+                new_p, new_m = [], []
+                for w, g, m in zip(tparams, grads, moms):
+                    nw, nm = sgd_mom(sgd_attrs, w, g.astype(w.dtype), m)
+                    new_p.append(nw)
+                    new_m.append(nm)
+                new_aux = tuple(mu.astype(a.dtype)
+                                for mu, a in zip(mutated, aparams))
+                return tuple(new_p), new_aux, tuple(new_m), loss
+
+            return step
 
         base_tparams = tuple(jax.device_put(param_arrays[i], dev)
                              for i in train_idx)
@@ -225,9 +244,12 @@ def main():
                 np.random.randint(0, 1000, (bs,)).astype(np.float32), dev)
             key = _random.next_key()
 
-            log(f"[bs{bs}] lowering + compiling train-step program")
+            use_remat = bs >= remat_from > 0
+            log(f"[bs{bs}] lowering + compiling train-step program"
+                f"{' (remat)' if use_remat else ''}")
             t0 = time.perf_counter()
-            step_jit = jax.jit(step, donate_argnums=(1, 2, 3))
+            step_jit = jax.jit(make_step(use_remat),
+                               donate_argnums=(1, 2, 3))
             compiled = step_jit.lower(
                 key, tparams, aparams, moms, x, y).compile()
             compile_s = time.perf_counter() - t0

@@ -79,6 +79,9 @@ _register("MXNET_ENGINE_TYPE", str, "ThreadedEnginePerDevice",
           "src/engine/naive_engine.cc)")
 _register("MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN", int, 15,
           "bulking hint kept for API parity; XLA fuses regardless")
+_register("MXNET_BACKWARD_DO_MIRROR", bool, False,
+          "rematerialize forward activations during backward (memory for "
+          "FLOPs; parity: gradient.cc mirror fn) — TrainStep jax.checkpoint")
 _register("MXNET_SUBGRAPH_BACKEND", str, "",
           "graph-rewrite backend applied at bind time (parity: "
           "src/operator/subgraph/; e.g. 'dense_act'); empty disables")
@@ -126,5 +129,8 @@ _register("BENCH_BATCH2", int, 128,
 _register("BENCH_ITERS", int, 20, "bench.py timed iterations")
 _register("BENCH_WARMUP", int, 2, "bench.py warmup iterations")
 _register("BENCH_DTYPE", str, "bfloat16", "bench.py compute dtype")
+_register("BENCH_REMAT_FROM_BS", int, 64,
+          "bench.py: rematerialize the train step at batch >= this "
+          "(0 disables); see MXNET_BACKWARD_DO_MIRROR")
 _register("BENCH_CALIB_N", int, 4096,
           "bench.py peak-calibration matmul dimension")

@@ -217,6 +217,23 @@ def _opt_adamw(attrs):
 _FUNCTIONAL_OPTS = {"sgd": _opt_sgd, "adam": _opt_adam, "adamw": _opt_adamw}
 
 
+def _matmul_conv_saveable(prim, *_args, **_params):
+    """Checkpoint policy: save matmul AND convolution outputs, recompute
+    everything else (elementwise/norm chains) in backward. The built-in
+    dots_with_no_batch_dims_saveable covers only dot_general — useless
+    for conv nets, which would recompute the entire forward."""
+    return getattr(prim, "name", "") in ("dot_general",
+                                         "conv_general_dilated")
+
+
+def remat_wrap(fwd):
+    """Wrap a forward fn with rematerialization (parity:
+    MXNET_BACKWARD_DO_MIRROR, src/nnvm/gradient.cc mirror fn): activation
+    memory shrinks to the matmul/conv outputs; elementwise intermediates
+    are recomputed during backward."""
+    return jax.checkpoint(fwd, policy=_matmul_conv_saveable)
+
+
 class TrainStep:
     """One compiled SPMD train step for a gluon block.
 
@@ -234,8 +251,19 @@ class TrainStep:
 
     def __init__(self, block, loss_fn, optimizer, optimizer_params, mesh,
                  example_batch, batch_axis="dp", param_axis=None,
-                 dtype=None):
+                 dtype=None, remat=None):
+        """remat: rematerialize the forward during backward, trading
+        FLOPs for activation memory (parity: MXNET_BACKWARD_DO_MIRROR,
+        src/nnvm/gradient.cc mirror fn). None reads the env var; True
+        wraps the forward in jax.checkpoint with a policy keeping matmul
+        AND conv outputs (elementwise recomputed) — the standard recipe
+        for large-batch training that would otherwise spill HBM."""
         from .. import autograd as _ag
+
+        if remat is None:
+            from ..config import get as _cfg
+            remat = bool(_cfg("MXNET_BACKWARD_DO_MIRROR"))
+        self.remat = bool(remat)
 
         if not isinstance(mesh, DeviceMesh):
             raise MXNetError("mesh must be a parallel.DeviceMesh")
@@ -304,12 +332,21 @@ class TrainStep:
         train_idx = list(self._train_idx)
         aux_idx = list(self._aux_idx)
 
+        use_remat = self.remat
+
         def step(key, train_params, aux_params, opt_state, x, y):
-            def compute_loss(tps):
+            def fwd(tps, x_):
                 ps = merge_params(train_idx, aux_idx, tps, aux_params)
                 with _ag.train_mode():
-                    outs, mutated = apply_fn(key, ps, (x,))
-                return loss_raw(outs[0], y), mutated
+                    outs, mutated = apply_fn(key, ps, (x_,))
+                return outs[0], mutated
+
+            if use_remat:
+                fwd = remat_wrap(fwd)
+
+            def compute_loss(tps):
+                pred, mutated = fwd(tps, x)
+                return loss_raw(pred, y), mutated
 
             (loss, mutated), grads = jax.value_and_grad(
                 compute_loss, has_aux=True)(train_params)

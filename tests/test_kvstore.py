@@ -162,3 +162,69 @@ def test_heartbeat_dead_node_detection():
         assert c0.num_dead_node(timeout=1.0) == 0
     finally:
         server._stop.set()
+
+
+_ASYNC_WORKER = """
+import os, sys
+import numpy as np
+rank = int(sys.argv[1]); num_workers = int(sys.argv[2]); port = int(sys.argv[3])
+os.environ["DMLC_RANK"] = str(rank)
+os.environ["DMLC_NUM_WORKER"] = str(num_workers)
+os.environ["DMLC_PS_ROOT_URI"] = "127.0.0.1"
+os.environ["DMLC_PS_ROOT_PORT"] = str(port)
+import mxnet_tpu as mx
+from mxnet_tpu import kvstore as kvs
+from mxnet_tpu import optimizer as opt
+kv = kvs.create("dist_async")
+assert kv.type == "dist_async"
+kv.init("w", mx.nd.ones((4,)))
+kv.set_optimizer(opt.SGD(learning_rate=0.1))
+# async: every push applies the update server-side immediately
+kv.push("w", mx.nd.ones((4,)))
+kv.push("w", mx.nd.ones((4,)))
+kv.barrier()
+out = mx.nd.zeros((4,))
+kv.pull("w", out=out)
+np.save(sys.argv[4], out.asnumpy())
+"""
+
+
+def test_dist_async_localhost(tmp_path):
+    """dist_async: per-push server-side updates, no sync barrier between
+    pushes (parity: kvstore_dist_server.h async DataHandle;
+    tests/nightly/dist_async_kvstore.py)."""
+    import subprocess
+    import sys
+
+    from mxnet_tpu.kvstore_server import KVServer
+    num_workers = 2
+    port = 19231
+    server = KVServer(port=port, num_workers=num_workers)
+    t = threading.Thread(target=server.run, daemon=True)
+    t.start()
+    time.sleep(0.2)
+    script = str(tmp_path / "aworker.py")
+    with open(script, "w") as f:
+        f.write(_ASYNC_WORKER)
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    outs = [str(tmp_path / f"aout{r}.npy") for r in range(num_workers)]
+    procs = [subprocess.Popen(
+        [sys.executable, script, str(r), str(num_workers), str(port),
+         outs[r]], env=env) for r in range(num_workers)]
+    try:
+        for p in procs:
+            assert p.wait(timeout=120) == 0
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        server._stop.set()
+    # 4 pushes total (2 per worker), each applying w -= 0.1 * 1
+    results = [np.load(o) for o in outs]
+    for r in results:
+        np.testing.assert_allclose(r, 1.0 - 0.4, rtol=1e-5)
+    np.testing.assert_array_equal(results[0], results[1])

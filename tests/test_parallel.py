@@ -169,3 +169,31 @@ def test_sync_to_block():
     step.sync_to_block()
     after = net.collect_params()[pname].data().asnumpy()
     assert not np.allclose(before, after)
+
+
+def test_remat_matches_plain():
+    """remat=True (MXNET_BACKWARD_DO_MIRROR parity: recompute activations
+    in backward) must be numerically identical to the plain step."""
+    _need_devices(8)
+    x = mx.nd.random.uniform(shape=(16, 16))
+    y = mx.nd.array(np.arange(16) % 10)
+
+    def run(remat):
+        mx.random.seed(7)
+        np.random.seed(7)
+        net = _make_net()
+        net(x)
+        for p in net.collect_params().values():
+            p.data()[:] = mx.nd.random.uniform(-0.1, 0.1, p.shape)
+        step = TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+                         {"learning_rate": 0.1, "momentum": 0.9},
+                         make_mesh(dp=8), example_batch=(x, y),
+                         remat=remat)
+        ls = [float(step(x, y)) for _ in range(5)]
+        return ls, [np.asarray(p) for p in step.params]
+
+    l_plain, p_plain = run(False)
+    l_remat, p_remat = run(True)
+    np.testing.assert_allclose(l_remat, l_plain, rtol=1e-5)
+    for a, b in zip(p_remat, p_plain):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
