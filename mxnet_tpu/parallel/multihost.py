@@ -1,0 +1,77 @@
+"""Multi-host initialization (parity: ps-lite rendezvous — DMLC_ROLE /
+DMLC_PS_ROOT_URI env contract, SURVEY §2.4; and the reference's
+dist_device_sync scaling path).
+
+TPU redesign: multi-host data/model parallelism is ONE jax.distributed
+job — every host runs the same SPMD program over the global mesh and XLA
+routes collectives over ICI within a slice and DCN across slices. This
+module adapts the reference's env-variable rendezvous contract onto
+jax.distributed.initialize so launcher scripts keep working:
+
+    DMLC_PS_ROOT_URI / DMLC_PS_ROOT_PORT -> coordinator address
+    DMLC_NUM_WORKER                      -> num_processes
+    DMLC_RANK / DMLC_WORKER_ID           -> process_id
+
+On Cloud TPU pods, call init_multihost() with no args — jax.distributed
+autodetects the coordinator from the TPU metadata. After initialization,
+`jax.devices()` spans the whole pod and every DeviceMesh built from it is
+a global mesh.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+from ..base import MXNetError
+
+_initialized = False
+
+
+def init_multihost(coordinator_address=None, num_processes=None,
+                   process_id=None):
+    """Initialize the multi-host runtime (idempotent).
+
+    With no arguments, resolves from the DMLC_* env contract when set,
+    else defers to jax.distributed autodetection (TPU pod metadata).
+    Single-process setups (num_processes == 1) are a no-op.
+    """
+    global _initialized
+    if _initialized:
+        return
+    if coordinator_address is None:
+        root = os.environ.get("DMLC_PS_ROOT_URI")
+        if root:
+            port = os.environ.get("DMLC_PS_ROOT_PORT", "8476")
+            coordinator_address = f"{root}:{port}"
+    if num_processes is None and os.environ.get("DMLC_NUM_WORKER"):
+        num_processes = int(os.environ["DMLC_NUM_WORKER"])
+    if process_id is None:
+        rank = os.environ.get("DMLC_RANK",
+                              os.environ.get("DMLC_WORKER_ID"))
+        if rank is not None:
+            process_id = int(rank)
+    if num_processes is not None and num_processes <= 1:
+        _initialized = True
+        return  # single host: nothing to rendezvous
+    if coordinator_address is not None and (
+            num_processes is None or process_id is None):
+        raise MXNetError(
+            "init_multihost: coordinator_address requires num_processes "
+            "and process_id (or the DMLC_NUM_WORKER / DMLC_RANK env vars)")
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes, process_id=process_id)
+    _initialized = True
+
+
+def process_index():
+    return jax.process_index()
+
+
+def process_count():
+    return jax.process_count()
+
+
+def is_coordinator():
+    return jax.process_index() == 0

@@ -3,6 +3,8 @@ xla_force_host_platform_device_count=8). Parity intent: the reference tests
 multi-device semantics via dist_sync_kvstore/multi_lenet; here the train
 step's gradient psum and parameter sharding are exercised directly."""
 import numpy as np
+import os
+
 import pytest
 
 import jax
@@ -197,3 +199,31 @@ def test_remat_matches_plain():
     np.testing.assert_allclose(l_remat, l_plain, rtol=1e-5)
     for a, b in zip(p_remat, p_plain):
         np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+def test_multihost_env_contract():
+    """init_multihost resolves the DMLC_* rendezvous contract; a
+    single-worker setup is a clean no-op (parity: ps-lite env vars)."""
+    import mxnet_tpu.parallel.multihost as mh
+    mh._initialized = False
+    old = {k: os.environ.get(k) for k in
+           ("DMLC_PS_ROOT_URI", "DMLC_NUM_WORKER", "DMLC_RANK")}
+    try:
+        os.environ["DMLC_NUM_WORKER"] = "1"
+        mh.init_multihost()          # no-op, must not try to rendezvous
+        assert mh._initialized
+        mh._initialized = False
+        os.environ["DMLC_PS_ROOT_URI"] = "10.0.0.1"
+        os.environ["DMLC_NUM_WORKER"] = "4"
+        os.environ.pop("DMLC_RANK", None)
+        os.environ.pop("DMLC_WORKER_ID", None)
+        with pytest.raises(mx.MXNetError):
+            mh.init_multihost()      # coordinator without rank: reject
+    finally:
+        mh._initialized = False
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    assert mh.process_count() >= 1
