@@ -54,14 +54,27 @@ def init_multihost(coordinator_address=None, num_processes=None,
     if num_processes is not None and num_processes <= 1:
         _initialized = True
         return  # single host: nothing to rendezvous
-    if coordinator_address is not None and (
-            num_processes is None or process_id is None):
+    provided = (coordinator_address, num_processes, process_id)
+    if any(v is not None for v in provided) and \
+            any(v is None for v in provided):
         raise MXNetError(
-            "init_multihost: coordinator_address requires num_processes "
-            "and process_id (or the DMLC_NUM_WORKER / DMLC_RANK env vars)")
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes, process_id=process_id)
+            "init_multihost: coordinator_address, num_processes and "
+            "process_id must be given together (DMLC_PS_ROOT_URI[:PORT] "
+            "+ DMLC_NUM_WORKER + DMLC_RANK) — or none of them on a TPU "
+            "pod, where jax.distributed autodetects")
+    already = getattr(jax.distributed, "is_initialized", None)
+    if already is not None and already():
+        _initialized = True
+        return  # someone else initialized the runtime: honor idempotence
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id)
+    except RuntimeError as e:
+        if "already initialized" in str(e).lower():
+            _initialized = True
+            return
+        raise
     _initialized = True
 
 

@@ -207,7 +207,8 @@ def test_multihost_env_contract():
     import mxnet_tpu.parallel.multihost as mh
     mh._initialized = False
     old = {k: os.environ.get(k) for k in
-           ("DMLC_PS_ROOT_URI", "DMLC_NUM_WORKER", "DMLC_RANK")}
+           ("DMLC_PS_ROOT_URI", "DMLC_NUM_WORKER", "DMLC_RANK",
+            "DMLC_WORKER_ID")}
     try:
         os.environ["DMLC_NUM_WORKER"] = "1"
         mh.init_multihost()          # no-op, must not try to rendezvous
