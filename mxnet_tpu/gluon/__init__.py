@@ -5,6 +5,7 @@ from .block import Block, HybridBlock, SymbolBlock
 from .parameter import Constant, Parameter, ParameterDict, \
     DeferredInitializationError
 from . import nn
+from . import contrib
 from . import loss
 from . import utils
 from .trainer import Trainer

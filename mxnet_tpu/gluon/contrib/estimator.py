@@ -1,0 +1,172 @@
+"""Gluon Estimator (parity: python/mxnet/gluon/contrib/estimator/ —
+Estimator.fit with train/val metrics and event handlers).
+
+Compact redesign keeping the reference's surface: Estimator(net, loss,
+metrics, trainer) + fit(train_data, val_data, epochs) firing
+train_begin/epoch_begin/batch_begin/batch_end/epoch_end/train_end events
+on registered handlers."""
+from __future__ import annotations
+
+import logging
+import time
+
+from ... import autograd
+from ... import metric as metric_mod
+from ...base import MXNetError
+from .. import loss as gloss
+from ..trainer import Trainer
+
+
+class EventHandler:
+    """Base event handler (parity: estimator/event_handler.py)."""
+
+    def train_begin(self, estimator):
+        pass
+
+    def train_end(self, estimator):
+        pass
+
+    def epoch_begin(self, estimator):
+        pass
+
+    def epoch_end(self, estimator):
+        pass
+
+    def batch_begin(self, estimator):
+        pass
+
+    def batch_end(self, estimator):
+        pass
+
+
+class LoggingHandler(EventHandler):
+    """Logs per-epoch metrics, and per-batch every ``log_interval``
+    batches when set (parity: event_handler.py LoggingHandler)."""
+
+    def __init__(self, log_interval=None, logger=None):
+        self.log_interval = log_interval
+        self.logger = logger or logging.getLogger("estimator")
+        self._batch = 0
+
+    def epoch_begin(self, estimator):
+        self._batch = 0
+
+    def batch_end(self, estimator):
+        self._batch += 1
+        if self.log_interval and self._batch % self.log_interval == 0:
+            parts = [f"{name}={val:.6f}"
+                     for name, val in estimator.metric_values().items()]
+            self.logger.info("Epoch[%d] Batch[%d] %s",
+                             estimator.current_epoch, self._batch,
+                             " ".join(parts))
+
+    def epoch_end(self, estimator):
+        parts = [f"{name}={val:.6f}"
+                 for name, val in estimator.metric_values().items()]
+        self.logger.info("Epoch[%d] %s (%.1fs)", estimator.current_epoch,
+                         " ".join(parts),
+                         time.time() - estimator._epoch_t0)
+
+
+class Estimator:
+    """Train-loop harness (parity: estimator/estimator.py Estimator)."""
+
+    def __init__(self, net, loss=None, metrics=None, trainer=None,
+                 context=None):
+        # context accepted for reference-signature parity; placement is
+        # the runtime's (data's context / SPMD mesh), not the Estimator's
+        self.net = net
+        self.loss = loss or gloss.SoftmaxCrossEntropyLoss()
+        if metrics is None:
+            metrics = [metric_mod.create("acc")]
+        elif not isinstance(metrics, (list, tuple)):
+            metrics = [metrics]
+        self.train_metrics = list(metrics)
+        self.trainer = trainer
+        self.context = context
+        self.current_epoch = 0
+        self._epoch_t0 = 0.0
+        self._loss_metric = metric_mod.Loss(name="loss")
+
+    @staticmethod
+    def _collect(metrics):
+        out = {}
+        for m in metrics:
+            names, vals = m.get()
+            if not isinstance(names, (list, tuple)):
+                names, vals = [names], [vals]
+            out.update(dict(zip(names, vals)))
+        return out
+
+    def metric_values(self):
+        return self._collect(self.train_metrics + [self._loss_metric])
+
+    def _reset_metrics(self):
+        self._loss_metric = metric_mod.Loss(name="loss")
+        for m in self.train_metrics:
+            m.reset()
+
+    @staticmethod
+    def _split_batch(batch):
+        if hasattr(batch, "data"):               # DataBatch
+            return batch.data[0], batch.label[0]
+        return batch[0], batch[1]                # DataLoader tuple
+
+    def evaluate(self, val_data):
+        """Run validation; returns {metric_name: value}. Uses FRESH metric
+        instances so the training metrics' state is untouched."""
+        import copy
+        metrics = [copy.deepcopy(m) for m in self.train_metrics]
+        for m in metrics:
+            m.reset()
+        if hasattr(val_data, "reset"):
+            val_data.reset()
+        for batch in val_data:
+            x, y = self._split_batch(batch)
+            pred = self.net(x)
+            for m in metrics:
+                m.update([y], [pred])
+        return self._collect(metrics)
+
+    def fit(self, train_data, val_data=None, epochs=1,
+            event_handlers=None, batch_size=None):
+        if self.trainer is None:
+            self.trainer = Trainer(self.net.collect_params(), "adam")
+        handlers = list(event_handlers or [])
+        if not any(isinstance(h, LoggingHandler) for h in handlers):
+            handlers.append(LoggingHandler())
+        for h in handlers:
+            h.train_begin(self)
+        for epoch in range(epochs):
+            self.current_epoch = epoch
+            self._epoch_t0 = time.time()
+            self._reset_metrics()
+            for h in handlers:
+                h.epoch_begin(self)
+            if hasattr(train_data, "reset"):
+                train_data.reset()
+            for batch in train_data:
+                x, y = self._split_batch(batch)
+                for h in handlers:
+                    h.batch_begin(self)
+                bs = batch_size or x.shape[0]
+                with autograd.record():
+                    pred = self.net(x)
+                    loss = self.loss(pred, y)
+                loss.backward()
+                self.trainer.step(bs)
+                self._loss_metric.update(None, [loss])
+                for m in self.train_metrics:
+                    m.update([y], [pred])
+                for h in handlers:
+                    h.batch_end(self)
+            for h in handlers:
+                h.epoch_end(self)
+            if val_data is not None:
+                vals = self.evaluate(val_data)
+                logging.getLogger("estimator").info(
+                    "Epoch[%d] validation: %s", epoch,
+                    " ".join(f"{k}={v:.6f}" for k, v in vals.items()))
+        for h in handlers:
+            h.train_end(self)
+        return self

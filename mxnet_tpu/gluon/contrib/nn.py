@@ -1,0 +1,136 @@
+"""gluon.contrib.nn layers (parity: python/mxnet/gluon/contrib/nn/
+basic_layers.py — Concurrent, HybridConcurrent, Identity, PixelShuffle1D/
+2D/3D, SyncBatchNorm)."""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ..block import HybridBlock
+from ..nn.basic_layers import BatchNorm, Sequential
+
+
+class Concurrent(Sequential):
+    """Run children on the same input, concat outputs along ``axis``
+    (parity: contrib/nn Concurrent)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def forward(self, x):
+        from ... import ndarray as nd
+        outs = [block(x) for block in self._children.values()]
+        return nd.concat(*outs, dim=self.axis)
+
+
+class HybridConcurrent(HybridBlock):
+    """Hybridizable Concurrent (parity: contrib/nn HybridConcurrent)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def add(self, *blocks):
+        for block in blocks:
+            self.register_child(block)
+
+    def hybrid_forward(self, F, x):
+        outs = [block(x) for block in self._children.values()]
+        return F.concat(*outs, dim=self.axis)
+
+
+class Identity(HybridBlock):
+    """Identity mapping — useful in Concurrent for residual branches
+    (parity: contrib/nn Identity)."""
+
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class _PixelShuffle(HybridBlock):
+    def __init__(self, factor, ndim, **kwargs):
+        super().__init__(**kwargs)
+        if isinstance(factor, int):
+            factor = (factor,) * ndim
+        self._factors = tuple(int(f) for f in factor)
+        if len(self._factors) != ndim:
+            raise MXNetError(f"PixelShuffle{ndim}D needs {ndim} factors")
+
+    def __repr__(self):
+        return f"{self.__class__.__name__}({self._factors})"
+
+
+class PixelShuffle1D(_PixelShuffle):
+    """(N, C*f, W) -> (N, C, W*f) sub-pixel upsample
+    (parity: contrib/nn PixelShuffle1D)."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(factor, 1, **kwargs)
+
+    def hybrid_forward(self, F, x):
+        # shape-free via reshape special codes (symbol-safe, parity with
+        # the reference's implementation): -4 split, 0 copy, -3 merge
+        (f,) = self._factors
+        x = F.reshape(x, shape=(0, -4, -1, f, 0))     # (N, C, f, W)
+        x = F.transpose(x, axes=(0, 1, 3, 2))          # (N, C, W, f)
+        return F.reshape(x, shape=(0, 0, -3))          # (N, C, W*f)
+
+
+class PixelShuffle2D(_PixelShuffle):
+    """(N, C*fh*fw, H, W) -> (N, C, H*fh, W*fw)
+    (parity: contrib/nn PixelShuffle2D)."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(factor, 2, **kwargs)
+
+    def hybrid_forward(self, F, x):
+        fh, fw = self._factors
+        x = F.reshape(x, shape=(0, -4, -1, fh * fw, 0, 0))
+        x = F.reshape(x, shape=(0, 0, -4, fh, fw, 0, 0))
+        x = F.transpose(x, axes=(0, 1, 4, 2, 5, 3))    # (N,C,H,fh,W,fw)
+        return F.reshape(x, shape=(0, 0, -3, -3))
+
+
+class PixelShuffle3D(_PixelShuffle):
+    """(N, C*fd*fh*fw, D, H, W) -> (N, C, D*fd, H*fh, W*fw)
+    (parity: contrib/nn PixelShuffle3D)."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(factor, 3, **kwargs)
+
+    def hybrid_forward(self, F, x):
+        fd, fh, fw = self._factors
+        x = F.reshape(x, shape=(0, -4, -1, fd * fh * fw, 0, 0, 0))
+        x = F.reshape(x, shape=(0, 0, -4, fd, fh * fw, 0, 0, 0))
+        x = F.reshape(x, shape=(0, 0, 0, -4, fh, fw, 0, 0, 0))
+        x = F.transpose(x, axes=(0, 1, 5, 2, 6, 3, 7, 4))
+        return F.reshape(x, shape=(0, 0, -3, -3, -3))
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device BatchNorm (parity: contrib/nn SyncBatchNorm over
+    sync_batch_norm-inl.h).
+
+    Under this framework's SPMD execution (pjit over a mesh) plain BN
+    statistics already see the GLOBAL batch, so the layer routes to the
+    `_contrib_SyncBatchNorm` op which additionally psums stats over an
+    `axis_name` when run inside shard_map/pmap."""
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, center=True, scale=True,
+                 use_global_stats=False, axis_name=None, **kwargs):
+        # num_devices accepted for reference-signature parity only: under
+        # single-program SPMD the statistics already cover the global
+        # batch, so there is no device count to configure (use axis_name
+        # for explicit shard_map/pmap sync instead)
+        super().__init__(axis=1, momentum=momentum, epsilon=epsilon,
+                         center=center, scale=scale,
+                         use_global_stats=use_global_stats,
+                         in_channels=in_channels, **kwargs)
+        if axis_name is not None:
+            self._kwargs["axis_name"] = axis_name
+        self._kwargs.pop("axis", None)
+
+    def hybrid_forward(self, F, x, gamma, beta, running_mean, running_var):
+        return F.contrib.SyncBatchNorm(x, gamma, beta, running_mean,
+                                       running_var, name="fwd",
+                                       **self._kwargs)

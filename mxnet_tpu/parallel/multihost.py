@@ -71,7 +71,10 @@ def init_multihost(coordinator_address=None, num_processes=None,
             coordinator_address=coordinator_address,
             num_processes=num_processes, process_id=process_id)
     except RuntimeError as e:
-        if "already initialized" in str(e).lower():
+        msg = str(e).lower()
+        # jax wordings across versions: "...already initialized" /
+        # "distributed.initialize should only be called once."
+        if "already initialized" in msg or "only be called once" in msg:
             _initialized = True
             return
         raise

@@ -366,3 +366,97 @@ def test_split_and_load():
     splits = gluon.utils.split_data(data, 4)
     assert len(splits) == 4
     assert splits[0].shape == (2, 2)
+
+
+class TestGluonContrib:
+    def test_concurrent_and_identity(self):
+        from mxnet_tpu.gluon.contrib import nn as cnn
+        from mxnet_tpu.gluon import nn as gnn
+        net = cnn.HybridConcurrent(axis=-1)
+        net.add(gnn.Dense(3), gnn.Dense(2), cnn.Identity())
+        net.initialize()
+        x = mx.nd.array(np.random.RandomState(0).randn(4, 5)
+                        .astype(np.float32))
+        out = net(x)
+        assert out.shape == (4, 3 + 2 + 5)
+        # identity branch is byte-exact
+        np.testing.assert_allclose(out.asnumpy()[:, 5:], x.asnumpy(),
+                                   rtol=1e-6)
+        net.hybridize()
+        out2 = net(x)
+        np.testing.assert_allclose(out2.asnumpy(), out.asnumpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_pixel_shuffle_2d(self):
+        from mxnet_tpu.gluon.contrib import nn as cnn
+        ps = cnn.PixelShuffle2D(2)
+        x = np.arange(1 * 4 * 2 * 2, dtype=np.float32).reshape(1, 4, 2, 2)
+        out = ps(mx.nd.array(x)).asnumpy()
+        assert out.shape == (1, 1, 4, 4)
+        # sub-pixel layout: out[0,0,0,0]=x[0,0,0,0], out[0,0,0,1]=x[0,1,0,0]
+        assert out[0, 0, 0, 0] == x[0, 0, 0, 0]
+        assert out[0, 0, 0, 1] == x[0, 1, 0, 0]
+        assert out[0, 0, 1, 0] == x[0, 2, 0, 0]
+
+    def test_pixel_shuffle_1d_3d_shapes(self):
+        from mxnet_tpu.gluon.contrib import nn as cnn
+        x1 = mx.nd.zeros((2, 6, 5))
+        assert cnn.PixelShuffle1D(3)(x1).shape == (2, 2, 15)
+        x3 = mx.nd.zeros((1, 8, 2, 3, 4))
+        assert cnn.PixelShuffle3D(2)(x3).shape == (1, 1, 4, 6, 8)
+
+    def test_sync_batchnorm_layer(self):
+        from mxnet_tpu.gluon.contrib import nn as cnn
+        sbn = cnn.SyncBatchNorm(in_channels=3)
+        sbn.initialize()
+        x = mx.nd.array(np.random.RandomState(1)
+                        .randn(4, 3, 5, 5).astype(np.float32) * 2 + 1)
+        with mx.autograd.record():
+            out = sbn(x)
+        o = out.asnumpy()
+        assert abs(o.mean()) < 0.15 and abs(o.std() - 1) < 0.15
+
+    def test_estimator_fit(self):
+        from mxnet_tpu.gluon.contrib.estimator import Estimator
+        from mxnet_tpu.gluon import nn as gnn
+        from mxnet_tpu import gluon, io as mxio
+        rng = np.random.RandomState(2)
+        x = rng.randn(32, 8).astype(np.float32)
+        y = (rng.rand(32) * 3).astype(np.float32) // 1
+        it = mxio.NDArrayIter(mx.nd.array(x), mx.nd.array(y), batch_size=8)
+        net = gnn.Dense(3)
+        net.initialize()
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.1})
+        est = Estimator(net, metrics=mx.metric.create("acc"), trainer=tr)
+        est.fit(it, epochs=2)
+        vals = est.metric_values()
+        assert "loss" in vals and "accuracy" in vals
+        assert np.isfinite(vals["loss"])
+
+    def test_pixel_shuffle_symbolic_path(self):
+        """PixelShuffle must trace through the Symbol path (shape-free
+        reshape special codes, like the reference)."""
+        from mxnet_tpu.gluon.contrib import nn as cnn
+        from mxnet_tpu import symbol as sym
+        ps = cnn.PixelShuffle2D(2)
+        out = ps(sym.var("x"))
+        assert isinstance(out, sym.Symbol)
+
+    def test_estimator_val_does_not_clobber_train_metrics(self):
+        from mxnet_tpu.gluon.contrib.estimator import Estimator
+        from mxnet_tpu.gluon import nn as gnn
+        from mxnet_tpu import gluon, io as mxio
+        rng = np.random.RandomState(3)
+        x = rng.randn(16, 4).astype(np.float32)
+        y = (rng.rand(16) * 2).astype(np.float32) // 1
+        it = mxio.NDArrayIter(mx.nd.array(x), mx.nd.array(y), batch_size=8)
+        val = mxio.NDArrayIter(mx.nd.array(x), mx.nd.array(y), batch_size=8)
+        net = gnn.Dense(2)
+        net.initialize()
+        est = Estimator(net, metrics=mx.metric.create("acc"),
+                        trainer=gluon.Trainer(net.collect_params(), "sgd"))
+        est.metric_values()  # callable before fit (no crash)
+        est.fit(it, val_data=val, epochs=1)
+        train_n = est.train_metrics[0].num_inst
+        assert train_n == 16, "validation clobbered train metric state"
