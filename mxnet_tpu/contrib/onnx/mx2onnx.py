@@ -48,6 +48,7 @@ class _Ctx:
         self.shapes = shapes          # tensor name -> shape (may be None)
         self.nodes = []
         self.initializers = []
+        self.current_outs = ()        # output names of the node in flight
         self._uid = 0
 
     def uniq(self, hint):
@@ -62,6 +63,9 @@ class _Ctx:
     def emit(self, op_type, inputs, outputs, name=None, **attrs):
         self.nodes.append(P.NodeProto(op_type, inputs, outputs,
                                       name=name or outputs[0], attrs=attrs))
+
+    def emit_node(self, node):
+        self.nodes.append(node)
 
 
 # --- translators ------------------------------------------------------------
@@ -412,6 +416,42 @@ def _squeeze(ctx, n, ins, out):
     ctx.emit("Squeeze", inputs, [out])
 
 
+@_translator("split")
+def _split(ctx, n, ins, out):
+    # multi-output: all output tensor names come via ctx.current_outs
+    final = list(ctx.current_outs)
+    if bool(n.attrs.get("squeeze_axis", False)):
+        # mxnet squeezes the split axis from every output; ONNX Split
+        # keeps it — append a Squeeze per output
+        axis = int(n.attrs.get("axis", 1))
+        raw = [ctx.uniq(o + "_unsq") for o in final]
+        ctx.emit_node(P.NodeProto(
+            "Split", [ins[0]], raw, name=out,
+            attrs={"axis": axis}))
+        axes = ctx.add_const(np.asarray([axis], np.int64), out + "_sqax")
+        for r, o in zip(raw, final):
+            ctx.emit("Squeeze", [r, axes], [o])
+        return
+    ctx.emit_node(P.NodeProto(
+        "Split", [ins[0]], final, name=out,
+        attrs={"axis": int(n.attrs.get("axis", 1))}))
+
+
+@_translator("UpSampling")
+def _upsampling(ctx, n, ins, out):
+    mode = n.attrs.get("sample_type", "nearest")
+    if mode != "nearest":
+        raise MXNetError("ONNX export: UpSampling supports "
+                         "sample_type='nearest' only")
+    scale = float(n.attrs.get("scale", 2))
+    roi = ctx.add_const(np.zeros((0,), np.float32), out + "_roi")
+    scales = ctx.add_const(
+        np.asarray([1.0, 1.0, scale, scale], np.float32), out + "_scales")
+    ctx.emit("Resize", [ins[0], roi, scales], [out],
+             mode="nearest", nearest_mode="floor",
+             coordinate_transformation_mode="asymmetric")
+
+
 @_translator("slice_axis")
 def _slice_axis(ctx, n, ins, out):
     axis = int(n.attrs["axis"])
@@ -478,6 +518,7 @@ def graph_to_onnx(sym, params, input_shapes, input_dtype=np.float32):
     # names (e.g. several blocks named "fwd"), which is fine for the
     # object-identity Symbol IR but illegal in ONNX's name-keyed graph
     entry_name = {}
+    outs_by_node = {}  # id(node) -> full ordered output-name list
     used_names = {n.name for n in topo if n.is_variable()}
     for n in topo:
         if n.is_variable():
@@ -492,12 +533,16 @@ def graph_to_onnx(sym, params, input_shapes, input_dtype=np.float32):
             base = f"{base}_{k}"
         used_names.add(base)
         op = _registry.get(n.op)
-        n_out = op.num_outputs if isinstance(op.num_outputs, int) else 1
+        n_out = op.num_outputs
+        if not isinstance(n_out, int):  # dynamic (split): from attrs
+            n_out = int(n.attrs.get("num_outputs", 1))
         if n_out > 1:
             for i in range(n_out):
                 entry_name[(id(n), i)] = f"{base}_output{i}"
         else:
             entry_name[(id(n), 0)] = f"{base}_output"
+        outs_by_node[id(n)] = [entry_name[(id(n), i)]
+                               for i in range(n_out)]
         # shape table is keyed by the *original* executor-facing names;
         # alias the uniquified names onto it
         for i in range(n_out):
@@ -512,6 +557,8 @@ def graph_to_onnx(sym, params, input_shapes, input_dtype=np.float32):
             raise MXNetError(f"ONNX export: no translator for op '{n.op}'")
         ins = [entry_name[(id(src), i)] for (src, i) in n.inputs]
         out = entry_name[(id(n), 0)]
+        # multi-output ops (split) read the full output-name list here
+        ctx.current_outs = outs_by_node[id(n)]
         # fix_gamma: ONNX BatchNormalization has no such switch — bake
         # gamma=1 into the exported scale initializer
         if cname == "BatchNorm" and bool(n.attrs.get("fix_gamma", True)):

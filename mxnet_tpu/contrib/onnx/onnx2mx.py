@@ -328,6 +328,49 @@ def _pad(ctx, node, ins):
         "pad_width": tuple(pad_width), "constant_value": cval})
 
 
+@_importer("Split")
+def _split_imp(ctx, node, ins):
+    sizes = None
+    if len(node.inputs) > 1 and node.inputs[1]:
+        sizes = [int(s) for s in ctx.const(node.inputs[1])]
+    elif node.attrs.get("split"):         # opset <13 attribute form
+        sizes = [int(s) for s in node.attrs["split"]]
+    if sizes is not None and len(set(sizes)) != 1:
+        raise MXNetError(
+            "ONNX import: unequal Split sizes unsupported "
+            f"(got {sizes}); only equal splits map to mxnet split")
+    return _sym.Symbol._create(
+        "split", [ins[0]],
+        {"axis": int(node.attrs.get("axis", 0)),
+         "num_outputs": len(node.outputs)})
+
+
+@_importer("Resize")
+def _resize(ctx, node, ins):
+    mode = node.attrs.get("mode", "nearest")
+    scales = ctx.const(node.inputs[2]) \
+        if len(node.inputs) > 2 and node.inputs[2] else None
+    sizes = ctx.const(node.inputs[3]) \
+        if len(node.inputs) > 3 and node.inputs[3] else None
+    if mode == "nearest" and scales is not None and len(scales) == 4:
+        s = [float(v) for v in scales]
+        if s[0] != 1.0 or s[1] != 1.0 or s[2] != s[3] or \
+                s[2] != int(s[2]) or s[2] < 1:
+            raise MXNetError(
+                "ONNX import: nearest Resize supports integral, "
+                f"spatial-only, isotropic scales; got {s}")
+        return _sym.Symbol._create(
+            "UpSampling", [ins[0]],
+            {"scale": int(s[2]), "sample_type": "nearest"})
+    if mode == "linear" and sizes is not None and len(sizes) == 4:
+        return _sym.Symbol._create(
+            "_contrib_BilinearResize2D", [ins[0]],
+            {"height": int(sizes[2]), "width": int(sizes[3])})
+    raise MXNetError(
+        f"ONNX import: Resize mode={mode} with "
+        f"{'scales' if scales is not None else 'sizes'} form unsupported")
+
+
 @_importer("Gather")
 def _gather(ctx, node, ins):
     return _sym.Symbol._create(
@@ -535,4 +578,6 @@ _ATTR_INPUTS = {
     "Unsqueeze": (1,),
     "Squeeze": (1,),
     "ReduceSum": (1,),
+    "Resize": (1, 2, 3),
+    "Split": (1,),
 }

@@ -280,3 +280,65 @@ def test_import_to_gluon(tmp_path):
     net = mxonnx.import_to_gluon(path)
     got = net(mx.nd.array(x)).asnumpy()
     np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_split_roundtrip(tmp_path):
+    """Multi-output Split (SliceChannel) export + import."""
+    data = sym.var("data")
+    parts = sym.Symbol._create("split", [data],
+                               {"axis": 1, "num_outputs": 3})
+    # consume all three outputs so the graph is multi-path
+    a = parts[0] * 1.0
+    b = parts[1] * 2.0
+    c = parts[2] * 3.0
+    out = sym.Symbol._create("concat", [a, b, c],
+                             {"dim": 1, "num_args": 3})
+    x = np.arange(2 * 6, dtype=np.float32).reshape(2, 6)
+    ref = _forward(out, {}, x)
+    path = str(tmp_path / "split.onnx")
+    mxonnx.export_model(out, {}, [(2, 6)], onnx_file_path=path)
+    s2, arg_p, aux_p = mxonnx.import_model(path)
+    got = _forward(s2, arg_p, x, aux_p)
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+def test_upsampling_roundtrip(tmp_path):
+    data = sym.var("data")
+    out = sym.Symbol._create("UpSampling", [data],
+                             {"scale": 2, "sample_type": "nearest"})
+    x = np.arange(1 * 1 * 2 * 2, dtype=np.float32).reshape(1, 1, 2, 2)
+    ref = _forward(out, {}, x)
+    path = str(tmp_path / "up.onnx")
+    mxonnx.export_model(out, {}, [(1, 1, 2, 2)], onnx_file_path=path)
+    s2, arg_p, aux_p = mxonnx.import_model(path)
+    got = _forward(s2, arg_p, x, aux_p)
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+def test_split_squeeze_axis_roundtrip(tmp_path):
+    data = sym.var("data")
+    parts = sym.Symbol._create("split", [data],
+                               {"axis": 1, "num_outputs": 3,
+                                "squeeze_axis": True})
+    out = sym.Symbol._create("broadcast_add", [parts[0], parts[2]], {})
+    x = np.arange(2 * 3, dtype=np.float32).reshape(2, 3)
+    ref = _forward(out, {}, x)
+    assert ref.shape == (2,)  # squeezed
+    path = str(tmp_path / "sq.onnx")
+    mxonnx.export_model(out, {}, [(2, 3)], onnx_file_path=path)
+    s2, arg_p, aux_p = mxonnx.import_model(path)
+    got = _forward(s2, arg_p, x, aux_p)
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+def test_import_unequal_split_raises():
+    g = P.GraphProto("s")
+    g.inputs.append(P.ValueInfoProto("data", P.FLOAT, (2, 6)))
+    g.initializers.append(P.TensorProto.from_array(
+        np.asarray([2, 4], np.int64), "sizes"))
+    g.nodes.append(P.NodeProto("Split", ["data", "sizes"], ["a", "b"],
+                               attrs={"axis": 1}))
+    g.outputs.append(P.ValueInfoProto("a", P.FLOAT, (2, 2)))
+    g.outputs.append(P.ValueInfoProto("b", P.FLOAT, (2, 4)))
+    with pytest.raises(Exception):
+        mxonnx.graph_from_onnx(g)
