@@ -104,7 +104,7 @@ _register("MXNET_KVSTORE_BIGARRAY_BOUND", int, 1000000,
           "arrays larger than this many elements are pushed/pulled in "
           "row chunks (parity: kvstore_dist.h:243 key sharding)")
 _register("DMLC_ROLE", str, "worker",
-          "process role: worker | server (ps-lite contract)")
+          "process role: worker/server (ps-lite contract)")
 _register("DMLC_RANK", int, 0, "worker rank")
 _register("DMLC_WORKER_ID", int, 0, "alias of DMLC_RANK")
 _register("DMLC_NUM_WORKER", int, 1, "number of workers")

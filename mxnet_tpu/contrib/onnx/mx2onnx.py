@@ -64,9 +64,6 @@ class _Ctx:
         self.nodes.append(P.NodeProto(op_type, inputs, outputs,
                                       name=name or outputs[0], attrs=attrs))
 
-    def emit_node(self, node):
-        self.nodes.append(node)
-
 
 # --- translators ------------------------------------------------------------
 @_translator("Convolution")
@@ -420,21 +417,19 @@ def _squeeze(ctx, n, ins, out):
 def _split(ctx, n, ins, out):
     # multi-output: all output tensor names come via ctx.current_outs
     final = list(ctx.current_outs)
+    axis = int(n.attrs.get("axis", 1))
     if bool(n.attrs.get("squeeze_axis", False)):
         # mxnet squeezes the split axis from every output; ONNX Split
-        # keeps it — append a Squeeze per output
-        axis = int(n.attrs.get("axis", 1))
+        # keeps it — append a Squeeze per output. Node names must stay
+        # unique, so the Split gets its own derived name.
         raw = [ctx.uniq(o + "_unsq") for o in final]
-        ctx.emit_node(P.NodeProto(
-            "Split", [ins[0]], raw, name=out,
-            attrs={"axis": axis}))
+        ctx.emit("Split", [ins[0]], raw,
+                 name=ctx.uniq(out + "_split"), axis=axis)
         axes = ctx.add_const(np.asarray([axis], np.int64), out + "_sqax")
         for r, o in zip(raw, final):
             ctx.emit("Squeeze", [r, axes], [o])
         return
-    ctx.emit_node(P.NodeProto(
-        "Split", [ins[0]], final, name=out,
-        attrs={"axis": int(n.attrs.get("axis", 1))}))
+    ctx.emit("Split", [ins[0]], final, axis=axis)
 
 
 @_translator("UpSampling")

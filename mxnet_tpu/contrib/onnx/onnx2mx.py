@@ -359,6 +359,20 @@ def _resize(ctx, node, ins):
             raise MXNetError(
                 "ONNX import: nearest Resize supports integral, "
                 f"spatial-only, isotropic scales; got {s}")
+        # UpSampling == repeat == asymmetric+floor; for INTEGRAL scales
+        # the ONNX defaults (half_pixel + round_prefer_floor) coincide
+        # with it — other mode combinations do not and must not import
+        # silently wrong
+        ctm = node.attrs.get("coordinate_transformation_mode",
+                             "half_pixel")
+        nm = node.attrs.get("nearest_mode", "round_prefer_floor")
+        ok = (ctm == "asymmetric" and nm == "floor") or \
+            (ctm == "half_pixel" and nm == "round_prefer_floor")
+        if not ok:
+            raise MXNetError(
+                f"ONNX import: nearest Resize with ctm={ctm}, "
+                f"nearest_mode={nm} does not match UpSampling (repeat) "
+                "semantics")
         return _sym.Symbol._create(
             "UpSampling", [ins[0]],
             {"scale": int(s[2]), "sample_type": "nearest"})

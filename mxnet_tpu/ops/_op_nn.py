@@ -268,6 +268,11 @@ def _batch_norm(attrs, x, gamma, beta, moving_mean, moving_var):
 def _layer_norm(attrs, x, gamma, beta):
     axis = int(attrs.get("axis", -1))
     eps = float(attrs.get("eps", 1e-5))
+    # trailing-axis LN takes the fused Pallas kernel (one HBM read+write
+    # per element; pallas_norm.py) — the hot transformer configuration
+    if axis in (-1, x.ndim - 1) and gamma.ndim == 1:
+        from .pallas_norm import fused_layer_norm
+        return fused_layer_norm(x, gamma, beta, eps=eps)
     mean = jnp.mean(x, axis=axis, keepdims=True)
     var = jnp.var(x, axis=axis, keepdims=True)
     out = (x - mean) * lax.rsqrt(var + eps)

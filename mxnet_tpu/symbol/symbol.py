@@ -167,7 +167,10 @@ class Symbol:
                 entries.append((n, 0))
             else:
                 op = _registry.get(n.op)
-                n_out = op.num_outputs if isinstance(op.num_outputs, int) else 1
+                n_out = op.num_outputs
+                if not isinstance(n_out, int):
+                    # dynamic-output ops (split): count from attrs
+                    n_out = int(n.attrs.get("num_outputs", 1))
                 for i in range(n_out):
                     entries.append((n, i))
         return Symbol(entries)
