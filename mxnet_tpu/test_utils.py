@@ -97,9 +97,10 @@ def consistency_devices():
         devs.append(jax.devices("cpu")[0])
     except RuntimeError:
         pass
-    for plat in ("tpu",):
+    for plat in ("tpu", "axon"):  # axon = the TPU relay platform name
         try:
             devs.append(jax.devices(plat)[0])
+            break
         except Exception:
             pass  # backend absent/unreachable: cpu-only run
     return devs

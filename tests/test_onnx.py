@@ -342,3 +342,29 @@ def test_import_unequal_split_raises():
     g.outputs.append(P.ValueInfoProto("b", P.FLOAT, (2, 4)))
     with pytest.raises(Exception):
         mxonnx.graph_from_onnx(g)
+
+
+def test_split_output_into_fc_ranks_correctly(tmp_path):
+    """Shape table must cover ALL split outputs so the FC translator
+    rank-dispatches (regression: get_internals truncated dynamic-output
+    ops and FC exported a 3-D Gemm)."""
+    data = sym.var("data")
+    parts = sym.Symbol._create("split", [data],
+                               {"axis": 1, "num_outputs": 2})
+    w = sym.var("w")
+    out = sym.Symbol._create("FullyConnected", [parts[1], w],
+                             {"num_hidden": 4, "no_bias": True})
+    rng = np.random.RandomState(6)
+    params = {"w": rng.randn(4, 3 * 5).astype(np.float32)}
+    x = rng.randn(2, 6, 5).astype(np.float32)
+    ref = _forward(out, params, x)
+    path = str(tmp_path / "splitfc.onnx")
+    mxonnx.export_model(out, params, [(2, 6, 5)], onnx_file_path=path)
+    # the exported graph must Flatten before Gemm (3-D input)
+    with open(path, "rb") as f:
+        m = P.ModelProto.decode(f.read())
+    ops = [n.op_type for n in m.graph.nodes]
+    assert "Flatten" in ops, f"no Flatten before Gemm: {ops}"
+    s2, arg_p, aux_p = mxonnx.import_model(path)
+    got = _forward(s2, arg_p, x, aux_p)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
