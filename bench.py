@@ -31,7 +31,8 @@ measured (value 0.0 and an "error" field if nothing was).
 
 Env knobs: BENCH_DTYPE, BENCH_WARMUP, BENCH_ITERS, BENCH_TIME_BUDGET (s),
 BENCH_BATCH, BENCH_BATCH2 (second MFU point, 0 disables), BENCH_CALIB_N,
-BENCH_REMAT_FROM_BS (rematerialize at batch >= this; 0 disables).
+BENCH_REMAT_FROM_BS (rematerialize at batch >= this; 0 disables),
+BENCH_INIT_TIMEOUT (s; fail fast if device init hangs; 0 disables).
 """
 import functools
 import json
@@ -132,6 +133,25 @@ def main():
                          ".jax_cache"))
         os.makedirs(cache_dir, exist_ok=True)
 
+        # watchdog: a dead TPU relay can hang device init in a sleep-retry
+        # loop for hours (observed r03). If the device list hasn't
+        # resolved within BENCH_INIT_TIMEOUT, emit the JSON error line and
+        # hard-exit — an immediate structured failure beats the driver's
+        # rc=124 after its full timeout.
+        import threading
+        init_done = threading.Event()
+        init_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT", 300))
+
+        def _watchdog():
+            if not init_done.wait(init_timeout):
+                emit({**result,
+                      "error": f"device init exceeded {init_timeout:.0f}s "
+                               "(TPU relay unreachable)"})
+                os._exit(3)
+
+        if init_timeout > 0:  # 0 disables, matching the other BENCH_* knobs
+            threading.Thread(target=_watchdog, daemon=True).start()
+
         log("importing jax")
         import numpy as np
         import jax
@@ -152,6 +172,7 @@ def main():
         from mxnet_tpu import amp
 
         devs = jax.devices()
+        init_done.set()  # relay answered: disarm the watchdog
         dev = devs[0]
         kind = getattr(dev, "device_kind", "?")
         log(f"devices: {len(devs)}x {dev.platform}/{kind}")
@@ -390,6 +411,12 @@ def main():
         import traceback
         traceback.print_exc(file=sys.stderr)
         result["error"] = f"{type(e).__name__}: {e}"
+    # disarm the init watchdog on every exit path: a failure surfacing
+    # near the deadline must not race this emit into two JSON lines
+    try:
+        init_done.set()
+    except NameError:
+        pass
     emit(result)
 
 
