@@ -84,13 +84,20 @@ def _initialize_kvstore(kvstore, param_arrays, arg_params, param_names,
 
 def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore,
                               param_names):
-    """Push grads, pull updated weights (parity: model.py:150)."""
+    """Push grads, pull updated weights (parity: model.py:150).
+
+    All pushes are issued BEFORE any pull: a dist pull blocks until every
+    worker's push for that key arrived, so interleaving push/pull per key
+    would serialize the sync round key by key across the cluster."""
+    live = []
     for index, pair in enumerate(zip(param_arrays, grad_arrays)):
         arg_list, grad_list = pair
         if grad_list[0] is None:
             continue
         name = param_names[index]
         kvstore.push(name, grad_list, priority=-index)
+        live.append((index, name, arg_list))
+    for index, name, arg_list in live:
         kvstore.pull(name, arg_list, priority=-index)
 
 

@@ -21,7 +21,9 @@ from . import optimizer as opt_mod
 from .base import MXNetError
 from .context import cpu
 from .initializer import Uniform
-from .model import BatchEndParam, load_checkpoint, save_checkpoint
+from .model import (BatchEndParam, load_checkpoint, save_checkpoint,
+                    _create_kvstore, _initialize_kvstore,
+                    _update_params_on_kvstore)
 from .ndarray import NDArray
 
 
@@ -262,9 +264,16 @@ class Module(BaseModule):
         self._sync_params_from_exec()
         save_checkpoint(prefix, epoch, self.symbol, self._arg_params,
                         self._aux_params)
-        if save_optimizer_states and self._updater is not None:
-            with open(f"{prefix}-{epoch:04d}.states", "wb") as f:
-                f.write(self._updater.get_states())
+        if save_optimizer_states:
+            fname = f"{prefix}-{epoch:04d}.states"
+            if getattr(self, "_update_on_kvstore", False) and \
+                    self._kvstore is not None:
+                # the real optimizer state lives IN the store (server
+                # side for dist) — the local updater never ran
+                self._kvstore.save_optimizer_states(fname)
+            elif self._updater is not None:
+                with open(fname, "wb") as f:
+                    f.write(self._updater.get_states())
 
     # -- bind / params -----------------------------------------------------
     def bind(self, data_shapes, label_shapes=None, for_training=True,
@@ -357,7 +366,10 @@ class Module(BaseModule):
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),
                        force_init=False):
-        """Install optimizer (parity: module.py init_optimizer)."""
+        """Install optimizer (parity: module.py init_optimizer →
+        model.py _create_kvstore/_initialize_kvstore). A dist kvstore
+        synchronizes gradients across workers in update(); the optimizer
+        then runs server-side (update_on_kvstore)."""
         assert self.binded and self.params_initialized
         if self.optimizer_initialized and not force_init:
             return
@@ -368,10 +380,23 @@ class Module(BaseModule):
                 **dict(optimizer_params or ()))
         self._optimizer = optimizer
         self._updater = opt_mod.get_updater(optimizer)
+        arg_params = {n: self._exec.arg_dict[n] for n in self._param_names}
+        kv, update_on_kvstore = _create_kvstore(kvstore, 1, arg_params)
+        self._kvstore = kv
+        self._update_on_kvstore = bool(kv is not None and update_on_kvstore)
+        if kv is not None:
+            _initialize_kvstore(
+                kv, [[arg_params[n]] for n in self._param_names],
+                arg_params, self._param_names, self._update_on_kvstore)
+            if self._update_on_kvstore:
+                kv.set_optimizer(self._optimizer)
         self.optimizer_initialized = True
         if hasattr(self, "_preload_opt_states"):
-            with open(self._preload_opt_states, "rb") as f:
-                self._updater.set_states(f.read())
+            if self._update_on_kvstore and kv is not None:
+                kv.load_optimizer_states(self._preload_opt_states)
+            else:
+                with open(self._preload_opt_states, "rb") as f:
+                    self._updater.set_states(f.read())
             del self._preload_opt_states
 
     # -- compute -----------------------------------------------------------
@@ -404,9 +429,21 @@ class Module(BaseModule):
 
     def update(self):
         """Apply optimizer to gradients (parity: module.py update →
-        _update_params locally; dist kvstore path via push/pull)."""
+        model.py _update_params_on_kvstore / local updater)."""
         assert self.binded and self.params_initialized and \
             self.optimizer_initialized
+        kv = getattr(self, "_kvstore", None)
+        if kv is not None and self._update_on_kvstore:
+            # optimizer runs IN the store (server-side for dist)
+            _update_params_on_kvstore(
+                [[self._exec.arg_dict[n]] for n in self._param_names],
+                [[self._exec.grad_dict.get(n)] for n in self._param_names],
+                kv, self._param_names)
+            for name in self._param_names:
+                g = self._exec.grad_dict.get(name)
+                if g is not None:
+                    g[:] = 0.0
+            return
         for i, name in enumerate(self._param_names):
             grad = self._exec.grad_dict.get(name)
             if grad is None:
@@ -526,6 +563,11 @@ class BucketingModule(BaseModule):
         if self.optimizer_initialized and not mod.optimizer_initialized:
             mod._optimizer = self._curr_module._optimizer
             mod._updater = self._curr_module._updater
+            # the kvstore wiring must follow the optimizer — otherwise a
+            # bucket switch silently drops dist synchronization
+            mod._kvstore = getattr(self._curr_module, "_kvstore", None)
+            mod._update_on_kvstore = getattr(
+                self._curr_module, "_update_on_kvstore", False)
             mod.optimizer_initialized = True
         self._curr_module = mod
         self._curr_bucket_key = bucket_key
