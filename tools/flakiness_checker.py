@@ -1,0 +1,47 @@
+#!/usr/bin/env python
+"""Run a test repeatedly to expose flakiness
+(parity: reference tools/flakiness_checker.py).
+
+Usage:
+    python tools/flakiness_checker.py tests/test_operator.py::test_foo -n 20
+Runs the named test N times with different PYTHONHASHSEED/MXNET seeds and
+reports the failure count.
+"""
+import argparse
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("test", help="pytest node id")
+    ap.add_argument("-n", "--trials", type=int, default=10)
+    ap.add_argument("--stop-on-fail", action="store_true")
+    args = ap.parse_args()
+
+    failures = 0
+    for trial in range(args.trials):
+        env = dict(os.environ)
+        env["PYTHONHASHSEED"] = str(trial)
+        env["MXNET_TEST_SEED"] = str(trial * 1000 + 7)
+        proc = subprocess.run(
+            [sys.executable, "-m", "pytest", args.test, "-q",
+             "--no-header"],
+            cwd=_REPO, env=env, capture_output=True, text=True)
+        status = "PASS" if proc.returncode == 0 else "FAIL"
+        print(f"trial {trial + 1}/{args.trials}: {status}")
+        if proc.returncode != 0:
+            failures += 1
+            tail = proc.stdout.strip().splitlines()[-5:]
+            print("\n".join("    " + ln for ln in tail))
+            if args.stop_on_fail:
+                break
+    print(f"\n{failures}/{args.trials} trials failed")
+    sys.exit(1 if failures else 0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,53 @@
+#!/usr/bin/env python
+"""Parse training logs into a table (parity: reference tools/parse_log.py).
+
+Understands the Module/Estimator log format:
+    Epoch[3] Train-accuracy=0.914
+    Epoch[3] Time cost=12.3
+    Epoch[3] Validation-accuracy=0.897
+
+Usage: python tools/parse_log.py train.log [--format markdown|csv]
+"""
+import argparse
+import re
+import sys
+
+
+def parse(lines):
+    rows = {}
+    for line in lines:
+        m = re.search(r"Epoch\[(\d+)\]\s+([^=]+?)=([0-9.eE+-]+)", line)
+        if not m:
+            continue
+        epoch, key, val = int(m.group(1)), m.group(2), float(m.group(3))
+        rows.setdefault(epoch, {})[key] = val
+    return rows
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("logfile")
+    ap.add_argument("--format", choices=("markdown", "csv"),
+                    default="markdown")
+    args = ap.parse_args()
+    with open(args.logfile) as f:
+        rows = parse(f)
+    if not rows:
+        sys.exit("no Epoch[...] lines found")
+    cols = sorted({k for r in rows.values() for k in r})
+    if args.format == "csv":
+        print(",".join(["epoch"] + cols))
+        for e in sorted(rows):
+            print(",".join([str(e)] + [str(rows[e].get(c, ""))
+                                       for c in cols]))
+    else:
+        print("| epoch | " + " | ".join(cols) + " |")
+        print("|" + "---|" * (len(cols) + 1))
+        for e in sorted(rows):
+            print("| " + " | ".join(
+                [str(e)] + [f"{rows[e][c]:g}" if c in rows[e] else ""
+                            for c in cols]) + " |")
+
+
+if __name__ == "__main__":
+    main()
