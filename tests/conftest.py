@@ -32,9 +32,11 @@ import pytest  # noqa: E402
 
 @pytest.fixture(autouse=True)
 def _seeded():
-    """Reproducible-but-varied RNG per test (parity: with_seed() decorator in
-    reference tests/python/unittest/common.py)."""
+    """Reproducible-but-varied RNG per test (parity: with_seed() decorator
+    in reference tests/python/unittest/common.py). MXNET_TEST_SEED varies
+    the base seed — tools/flakiness_checker.py sets it per trial."""
     import mxnet_tpu as mx
-    np.random.seed(0)
-    mx.random.seed(0)
+    seed = int(os.environ.get("MXNET_TEST_SEED", 0))
+    np.random.seed(seed)
+    mx.random.seed(seed)
     yield

@@ -23,6 +23,7 @@ def main():
     args = ap.parse_args()
 
     failures = 0
+    ran = 0
     for trial in range(args.trials):
         env = dict(os.environ)
         env["PYTHONHASHSEED"] = str(trial)
@@ -31,15 +32,18 @@ def main():
             [sys.executable, "-m", "pytest", args.test, "-q",
              "--no-header"],
             cwd=_REPO, env=env, capture_output=True, text=True)
+        ran += 1
         status = "PASS" if proc.returncode == 0 else "FAIL"
         print(f"trial {trial + 1}/{args.trials}: {status}")
         if proc.returncode != 0:
             failures += 1
-            tail = proc.stdout.strip().splitlines()[-5:]
-            print("\n".join("    " + ln for ln in tail))
+            # usage/collection errors report on stderr
+            tail = (proc.stdout.strip().splitlines()[-5:]
+                    + proc.stderr.strip().splitlines()[-3:])
+            print("\n".join("    " + ln for ln in tail if ln))
             if args.stop_on_fail:
                 break
-    print(f"\n{failures}/{args.trials} trials failed")
+    print(f"\n{failures}/{ran} trials failed")
     sys.exit(1 if failures else 0)
 
 

@@ -16,11 +16,19 @@ import sys
 def parse(lines):
     rows = {}
     for line in lines:
-        m = re.search(r"Epoch\[(\d+)\]\s+([^=]+?)=([0-9.eE+-]+)", line)
+        m = re.search(r"Epoch\[(\d+)\]\s+(.*)", line)
         if not m:
             continue
-        epoch, key, val = int(m.group(1)), m.group(2), float(m.group(3))
-        rows.setdefault(epoch, {})[key] = val
+        epoch, rest = int(m.group(1)), m.group(2)
+        prefix = ""
+        if rest.lower().startswith("validation:"):
+            # Estimator validation lines carry several k=v pairs after a
+            # "validation:" marker
+            prefix = "Validation-"
+            rest = rest.split(":", 1)[1]
+        for key, val in re.findall(
+                r"([A-Za-z][\w .-]*?)=([0-9.eE+-]+)", rest):
+            rows.setdefault(epoch, {})[prefix + key.strip()] = float(val)
     return rows
 
 
