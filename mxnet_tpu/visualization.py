@@ -46,12 +46,20 @@ def print_summary(symbol, shape=None, line_length=120, positions=(.44, .64,
             for item in inputs:
                 input_node = nodes[item[0]]
                 input_name = input_node["name"]
+                is_data = input_node["op"] == "null" and \
+                    shape is not None and input_name in shape
                 if input_node["op"] != "null" or item[0] in heads:
                     pre_node.append(input_name)
                     if input_node["op"] != "null":
                         key = input_name + "_output"
                         if key in shape_dict:
                             pre_filter = pre_filter + int(shape_dict[key][1])
+                    elif is_data and input_name in shape_dict and \
+                            len(shape_dict[input_name]) > 1:
+                        # data inputs (user-bound shapes) contribute their
+                        # feature dim; weight/bias variables do not
+                        pre_filter = pre_filter + \
+                            int(shape_dict[input_name][1])
         cur_param = 0
         attrs = node.get("attrs", {})
         if op == "Convolution":
