@@ -1,3 +1,10 @@
+# Licensed to the Apache Software Foundation (ASF) under one or more
+# contributor license agreements; this file contains portions derived from
+# Apache MXNet (incubating), licensed under the Apache License, Version 2.0
+# (http://www.apache.org/licenses/LICENSE-2.0). The network topologies /
+# formulas herein follow the original implementation to preserve checkpoint
+# and API compatibility; see the docstring for the source file reference.
+# Modifications for the TPU-native (JAX/XLA) backend are by this project.
 """DenseNet 121/161/169/201 (parity: model_zoo/vision/densenet.py; Huang et
 al. 2016)."""
 from __future__ import annotations
