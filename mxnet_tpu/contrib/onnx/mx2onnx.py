@@ -25,10 +25,15 @@ def _canon(node):
     return node.op
 
 
-def _translator(*op_names):
+_SHAPE_DEPENDENT = set()  # ops whose translator rank-dispatches on ctx.shapes
+
+
+def _translator(*op_names, shape_dependent=False):
     def deco(fn):
         for n in op_names:
             _TRANSLATORS[n] = fn
+            if shape_dependent:
+                _SHAPE_DEPENDENT.add(n)
         return fn
     return deco
 
@@ -96,7 +101,7 @@ def _deconv(ctx, n, ins, out):
     ctx.emit("ConvTranspose", inputs, [out], **attrs)
 
 
-@_translator("FullyConnected")
+@_translator("FullyConnected", shape_dependent=True)
 def _fc(ctx, n, ins, out):
     data = ins[0]
     shape = ctx.shapes.get(data)
@@ -499,11 +504,20 @@ def graph_to_onnx(sym, params, input_shapes, input_dtype=np.float32):
         for name, shp in zip(internals.list_inputs(), in_shapes):
             shapes[name] = tuple(shp)
     except Exception as e:
-        # rank-dispatching translators (FullyConnected) degrade without
-        # shapes — surface the problem instead of silently mis-exporting
+        # rank-dispatching translators would silently export wrong
+        # semantics without shapes — hard error for graphs containing
+        # them; graphs of rank-independent ops still export with a warning
+        offending = sorted({n.op for n in topo
+                            if n.op in _SHAPE_DEPENDENT})
+        if offending:
+            raise MXNetError(
+                f"ONNX export: shape inference failed ({e}) and the graph "
+                f"contains rank-dispatching ops {offending} that would "
+                "export incorrectly without shapes. Fix the symbol/input "
+                "shapes or pass concrete input_shapes.") from e
         import warnings
         warnings.warn(f"ONNX export: shape inference failed ({e}); "
-                      "rank-dependent ops may export incorrectly")
+                      "continuing — no rank-dependent ops in the graph")
 
     graph = P.GraphProto(name=(sym.name or "mxnet_tpu_model"))
     ctx = _Ctx(shapes)

@@ -348,7 +348,18 @@ class KVStoreDist(KVStore):
         return self._num_workers
 
     @staticmethod
-    def _chunk_layout(k, shape):
+    def _layout_from_rows_per(k, shape, rows_per):
+        """Materialize the chunk-key plan for a given rows-per-chunk.
+        The single authority for the ``k#chunkN`` namespace — used both by
+        the local bound computation and by workers adopting rank 0's
+        recorded layout, so the namespaces cannot diverge."""
+        if rows_per <= 0:
+            return [(k, 0, shape[0] if shape else 0)]
+        return [(f"{k}#chunk{i}", start, min(start + rows_per, shape[0]))
+                for i, start in enumerate(range(0, shape[0], rows_per))]
+
+    @classmethod
+    def _chunk_layout(cls, k, shape):
         """Row-chunk plan for a big dense array under derived keys
         (parity: kvstore_dist.h big-array key sharding over servers,
         MXNET_KVSTORE_BIGARRAY_BOUND). Bounds the wire frame size and
@@ -359,15 +370,16 @@ class KVStoreDist(KVStore):
         bound = _cfg("MXNET_KVSTORE_BIGARRAY_BOUND")
         size = int(np.prod(shape)) if shape else 1
         if size <= bound or not shape or shape[0] < 2:
-            return [(k, 0, shape[0] if shape else 0)]
+            return cls._layout_from_rows_per(k, shape, 0)
         rows_per = max(int(bound // max(size // shape[0], 1)), 1)
-        return [(f"{k}#chunk{i}", start, min(start + rows_per, shape[0]))
-                for i, start in enumerate(range(0, shape[0], rows_per))]
+        return cls._layout_from_rows_per(k, shape, rows_per)
 
     def init(self, key, value):
         if self._client is None:
             return super().init(key, value)
+        import numpy as np
         keys, values = _key_value(key, value)
+        batch = []  # one init_many RPC for all keys + layout records
         for k, v in zip(keys, values):
             self._store[k] = v.copy()
             # the chunk decision is made ONCE here and remembered: every
@@ -380,13 +392,30 @@ class KVStoreDist(KVStore):
                 layout = [(k, 0, v.shape[0] if v.shape else 0)]
             self._chunked[k] = layout if len(layout) > 1 else None
             if self._rank == 0:
+                # record the chosen layout server-side: workers launched
+                # with a different MXNET_KVSTORE_BIGARRAY_BOUND would
+                # otherwise address a divergent k vs k#chunkN namespace and
+                # deadlock dist_sync push aggregation with no diagnostic.
+                rows_per = (layout[0][2] - layout[0][1]
+                            if self._chunked[k] is not None else 0)
+                batch.append((f"__layout__{k}",
+                              np.array([rows_per], dtype=np.int64)))
                 if self._chunked[k] is None:
-                    self._client.init(k, v.asnumpy())
+                    batch.append((k, v.asnumpy()))
                 else:
                     arr = v.asnumpy()
-                    self._client.init_many(
-                        [(ck, arr[b:e]) for ck, b, e in layout])
+                    batch.extend((ck, arr[b:e]) for ck, b, e in layout)
+        if batch:
+            self._client.init_many(batch)
         self._client.barrier()
+        if self._rank != 0 and keys:
+            # adopt rank 0's layout so every worker agrees on the namespace
+            recs = self._client.pull_many(
+                [f"__layout__{k}" for k in keys])
+            for k, rec in zip(keys, recs):
+                layout = self._layout_from_rows_per(
+                    k, tuple(self._store[k].shape), int(rec[0]))
+                self._chunked[k] = layout if len(layout) > 1 else None
 
     def push(self, key, value, priority=0):
         if self._client is None:

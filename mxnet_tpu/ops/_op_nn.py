@@ -264,13 +264,49 @@ def _batch_norm(attrs, x, gamma, beta, moving_mean, moving_var):
     return out.astype(x.dtype), new_mm, new_mv
 
 
+_LN_PROBED = {}
+
+
+def _fused_ln_ok(n_rows, d, x_dtype, g_dtype, b_dtype):
+    """Decide once per tile configuration whether the Pallas LN kernel is
+    safe.  The probe compiles the SAME (block_rows, d) tile and the same
+    input dtypes a real call would use, so a Mosaic rejection (VMEM
+    overflow, unsupported width) is caught here and the op falls back to
+    plain XLA.  MXNET_FUSED_LAYERNORM=0/1 forces the choice; default
+    'auto' probes.
+    """
+    import os
+    flag = os.environ.get("MXNET_FUSED_LAYERNORM", "auto").lower()
+    if flag in ("0", "false", "off"):
+        return False
+    if flag in ("1", "true", "on"):
+        return True
+    from .pallas_norm import _pick_block_rows, fused_layer_norm
+    block_rows = _pick_block_rows(int(n_rows))
+    key = (block_rows, int(d), jnp.dtype(x_dtype).name,
+           jnp.dtype(g_dtype).name, jnp.dtype(b_dtype).name)
+    if key not in _LN_PROBED:
+        try:
+            import numpy as _np
+            probe = fused_layer_norm(jnp.ones((block_rows, d), x_dtype),
+                                     jnp.ones((d,), g_dtype),
+                                     jnp.zeros((d,), b_dtype))
+            _np.asarray(probe)
+            _LN_PROBED[key] = True
+        except Exception:
+            _LN_PROBED[key] = False
+    return _LN_PROBED[key]
+
+
 @register("LayerNorm")
 def _layer_norm(attrs, x, gamma, beta):
     axis = int(attrs.get("axis", -1))
     eps = float(attrs.get("eps", 1e-5))
     # trailing-axis LN takes the fused Pallas kernel (one HBM read+write
     # per element; pallas_norm.py) — the hot transformer configuration
-    if axis in (-1, x.ndim - 1) and gamma.ndim == 1:
+    if (axis in (-1, x.ndim - 1) and gamma.ndim == 1
+            and _fused_ln_ok(int(np.prod(x.shape[:-1])),
+                             x.shape[-1], x.dtype, gamma.dtype, beta.dtype)):
         from .pallas_norm import fused_layer_norm
         return fused_layer_norm(x, gamma, beta, eps=eps)
     mean = jnp.mean(x, axis=axis, keepdims=True)
@@ -424,9 +460,14 @@ def _regression_grad(link, err_fn):
         data, label = primals
         grad_scale = float(attrs.get("grad_scale", 1.0))
         pred = link(data)
-        g = err_fn(pred, label.reshape(pred.shape)) * grad_scale
-        # reference normalizes by batch size (regression_output-inl.h)
-        g = g / data.shape[0]
+        g = err_fn(pred, label.reshape(pred.shape))
+        # reference scales by grad_scale / num_output, where num_output is the
+        # per-sample output width label.Size()/label.shape_[0]
+        # (regression_output-inl.h:200-206) — NOT by batch size.
+        num_output = 1
+        for d in label.shape[1:]:
+            num_output *= d
+        g = g * (grad_scale / max(num_output, 1))
         ct = cotangents[0]
         return (g * (ct.sum() if ct.ndim == 0 else 1.0), None)
     return grad

@@ -40,20 +40,41 @@ def init_multihost(coordinator_address=None, num_processes=None,
     if _initialized:
         return
     if coordinator_address is None:
-        root = os.environ.get("DMLC_PS_ROOT_URI")
+        root = os.environ.get("MXNET_COORDINATOR_URI")
         if root:
-            port = os.environ.get("DMLC_PS_ROOT_PORT", "8476")
+            port = os.environ.get("MXNET_COORDINATOR_PORT", "8476")
             coordinator_address = f"{root}:{port}"
-    if num_processes is None and os.environ.get("DMLC_NUM_WORKER"):
-        num_processes = int(os.environ["DMLC_NUM_WORKER"])
-    if process_id is None:
-        rank = os.environ.get("DMLC_RANK",
-                              os.environ.get("DMLC_WORKER_ID"))
-        if rank is not None:
-            process_id = int(rank)
+        elif "DMLC_ROLE" not in os.environ:
+            # DMLC_PS_ROOT_URI:PORT addresses the TCP parameter server in a
+            # PS launch (DMLC_ROLE set); rendezvousing jax.distributed
+            # against that socket would hang.  Only borrow it when no PS
+            # deployment is indicated.
+            root = os.environ.get("DMLC_PS_ROOT_URI")
+            if root:
+                port = os.environ.get("DMLC_PS_ROOT_PORT", "8476")
+                coordinator_address = f"{root}:{port}"
+    if coordinator_address is not None or "DMLC_ROLE" not in os.environ:
+        # in a PS deployment (DMLC_ROLE set) borrow worker count/rank only
+        # once a coordinator address is actually in play — otherwise all
+        # three stay None and the PS no-op below applies instead of the
+        # all-or-none check misfiring on a half-borrowed DMLC contract
+        if num_processes is None and os.environ.get("DMLC_NUM_WORKER"):
+            num_processes = int(os.environ["DMLC_NUM_WORKER"])
+        if process_id is None:
+            rank = os.environ.get("DMLC_RANK",
+                                  os.environ.get("DMLC_WORKER_ID"))
+            if rank is not None:
+                process_id = int(rank)
     if num_processes is not None and num_processes <= 1:
         _initialized = True
         return  # single host: nothing to rendezvous
+    if (coordinator_address is None and num_processes is None
+            and process_id is None and "DMLC_ROLE" in os.environ):
+        # PS deployment with no explicit multihost config: the parameter
+        # server owns cross-process coordination; a jax.distributed
+        # rendezvous here would target the PS socket and hang
+        _initialized = True
+        return
     provided = (coordinator_address, num_processes, process_id)
     if any(v is not None for v in provided) and \
             any(v is None for v in provided):

@@ -63,7 +63,7 @@ class TestSVRG:
         mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
         mod.init_params(mx.initializer.Constant(0.0))
         mod.init_optimizer(optimizer="sgd",
-                           optimizer_params=(("learning_rate", 0.05),))
+                           optimizer_params=(("learning_rate", 0.003),))
         for epoch in range(10):
             if epoch % mod.update_freq == 0:
                 mod.update_full_grads(it)
@@ -143,29 +143,50 @@ class TestCustomGradInExecutor:
         np.testing.assert_allclose(gw, expect, rtol=1e-6)
 
     def test_regression_output_grads(self):
-        """MAERegressionOutput / LogisticRegressionOutput custom grads
-        (reference regression_output.cc: sign(p-l) and p-l, batch-normed)."""
+        """MAERegressionOutput / LogisticRegressionOutput custom grads.
+
+        Reference regression_output-inl.h:200-206 scales by
+        grad_scale / num_output where num_output = label.Size()/label.shape_[0]
+        (per-sample output width) — NOT by batch size. (6,3) vs (3,6) shapes
+        distinguish the two normalizations.
+        """
         rng = np.random.RandomState(9)
-        x = rng.randn(6, 3).astype(np.float32)
-        l = rng.randn(6, 3).astype(np.float32)
-        for op_name, fwd, gfn in [
-            ("MAERegressionOutput", lambda z: z,
-             lambda p, t: np.sign(p - t)),
-            ("LogisticRegressionOutput",
-             lambda z: 1 / (1 + np.exp(-z)),
-             lambda p, t: p - t),
-        ]:
-            a = mx.nd.array(x)
-            a.attach_grad()
-            with mx.autograd.record():
-                out = getattr(mx.nd, op_name)(a, mx.nd.array(l))
-                s = out.sum()
-            s.backward()
-            np.testing.assert_allclose(out.asnumpy(), fwd(x), rtol=1e-5,
-                                       atol=1e-6)
-            np.testing.assert_allclose(
-                a.grad.asnumpy(), gfn(fwd(x), l) / x.shape[0],
-                rtol=1e-4, atol=1e-5)
+        for shape in [(6, 3), (3, 6), (5, 1)]:
+            x = rng.randn(*shape).astype(np.float32)
+            l = rng.randn(*shape).astype(np.float32)
+            num_output = shape[1]
+            for op_name, fwd, gfn in [
+                ("MAERegressionOutput", lambda z: z,
+                 lambda p, t: np.sign(p - t)),
+                ("LogisticRegressionOutput",
+                 lambda z: 1 / (1 + np.exp(-z)),
+                 lambda p, t: p - t),
+            ]:
+                a = mx.nd.array(x)
+                a.attach_grad()
+                with mx.autograd.record():
+                    out = getattr(mx.nd, op_name)(a, mx.nd.array(l))
+                    s = out.sum()
+                s.backward()
+                np.testing.assert_allclose(out.asnumpy(), fwd(x), rtol=1e-5,
+                                           atol=1e-6)
+                np.testing.assert_allclose(
+                    a.grad.asnumpy(), gfn(fwd(x), l) / num_output,
+                    rtol=1e-4, atol=1e-5)
+
+    def test_regression_output_grad_scale(self):
+        """grad_scale attribute multiplies the per-output-normalized grad."""
+        x = np.array([[1.0], [2.0], [3.0], [4.0]], np.float32)
+        l = np.zeros((4, 1), np.float32)
+        a = mx.nd.array(x)
+        a.attach_grad()
+        with mx.autograd.record():
+            out = mx.nd.LinearRegressionOutput(a, mx.nd.array(l),
+                                               grad_scale=0.5)
+            out.sum().backward()
+        # D=1 → num_output=1: grad = (p - l) * 0.5, NOT divided by bs=4
+        np.testing.assert_allclose(a.grad.asnumpy(), (x - l) * 0.5,
+                                   rtol=1e-6)
 
     def test_module_training_converges_with_output_op(self):
         from mxnet_tpu.module import Module
@@ -184,7 +205,7 @@ class TestCustomGradInExecutor:
         mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
         mod.init_params(mx.initializer.Constant(0.0))
         mod.init_optimizer(optimizer="sgd",
-                           optimizer_params=(("learning_rate", 0.3),))
+                           optimizer_params=(("learning_rate", 0.02),))
         losses = []
         for _ in range(10):
             it.reset()

@@ -35,7 +35,7 @@ mod = Module(out, data_names=("data",), label_names=("lin_label",))
 mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
 mod.init_params(mx.initializer.Constant(0.0))
 mod.init_optimizer(kvstore="dist_sync", optimizer="sgd",
-                   optimizer_params=(("learning_rate", 0.1),))
+                   optimizer_params=(("learning_rate", 0.006),))
 assert mod._kvstore is not None and mod._update_on_kvstore
 for epoch in range(3):
     it.reset()
