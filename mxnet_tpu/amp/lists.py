@@ -19,7 +19,7 @@ FP32_OPS = [
     "BatchNorm", "LayerNorm", "GroupNorm", "InstanceNorm", "LRN",
     "L2Normalization", "norm",
     "exp", "log", "log2", "log10", "log1p", "expm1",
-    "mean", "sum", "nansum", "prod", "nanprod", "cumsum",
+    "mean", "sum", "nansum", "prod", "nanprod",
     "CTCLoss", "MakeLoss", "LinearRegressionOutput",
     "LogisticRegressionOutput", "MAERegressionOutput",
     "smooth_l1", "SVMOutput",

@@ -1,0 +1,235 @@
+"""Python backend for the general C API (src/c_api.cc).
+
+Role parity: the reference's src/c_api/c_api.cc + c_api_ndarray.cc +
+c_api_symbolic.cc + c_api_executor.cc fronts (include/mxnet/c_api.h,
+220 functions; the training-critical subset here: MXNDArray*,
+MXImperativeInvokeEx:1063, MXAutogradBackwardEx:1152, MXSymbol*,
+MXExecutorBindEX:1993, MXKVStore*).  Architecture: the C shim embeds
+CPython and calls these helpers under the GIL; every handle the C side
+holds is a PyObject* produced here.  Data crosses the boundary as raw
+bytes (C-order), so any C-capable language can bind without numpy.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+# MXNet dtype codes (reference include/mxnet/base.h TypeFlag)
+_DTYPE_BY_CODE = {0: np.float32, 1: np.float64, 2: np.float16,
+                  3: np.uint8, 4: np.int32, 5: np.int8, 6: np.int64}
+_CODE_BY_DTYPE = {np.dtype(v).name: k for k, v in _DTYPE_BY_CODE.items()}
+_CODE_BY_DTYPE["bfloat16"] = 12  # mshadow kBfloat16
+
+
+def _ctx(dev_type, dev_id):
+    from . import context
+    return {1: context.cpu, 2: context.gpu, 3: context.cpu,
+            7: context.tpu}.get(dev_type, context.cpu)(dev_id)
+
+
+# --- NDArray ----------------------------------------------------------------
+def ndarray_create(shape, dev_type, dev_id, dtype_code):
+    from . import nd
+    dtype = _DTYPE_BY_CODE.get(dtype_code, np.float32)
+    return nd.zeros(tuple(int(s) for s in shape), _ctx(dev_type, dev_id),
+                    dtype=dtype)
+
+
+def ndarray_set_bytes(arr, data):
+    np_arr = np.frombuffer(data, dtype=arr.dtype).reshape(arr.shape)
+    arr[:] = np_arr
+    return True
+
+
+def ndarray_get_bytes(arr):
+    return arr.asnumpy().tobytes()
+
+
+def ndarray_shape(arr):
+    return tuple(int(s) for s in arr.shape)
+
+
+def ndarray_dtype_code(arr):
+    return _CODE_BY_DTYPE.get(np.dtype(arr.dtype).name, 0)
+
+
+def ndarray_wait_all():
+    from .ndarray import waitall
+    waitall()
+    return True
+
+
+def ndarray_save(fname, arrays, names):
+    from . import nd
+    if names:
+        nd.save(fname, dict(zip(names, arrays)))
+    else:
+        nd.save(fname, list(arrays))
+    return True
+
+
+def ndarray_load(fname):
+    from . import nd
+    loaded = nd.load(fname)
+    if isinstance(loaded, dict):
+        names = list(loaded.keys())
+        return [loaded[n] for n in names], names
+    return list(loaded), []
+
+
+# --- imperative invoke ------------------------------------------------------
+def imperative_invoke(op_name, inputs, keys, vals, outputs=None):
+    """MXImperativeInvokeEx parity: run a registered op on NDArrays.
+    attrs arrive as parallel string lists; outputs (optional) receive
+    results in place."""
+    from .ndarray import invoke
+    from .symbol.symbol import _parse_attr_value
+    attrs = {k: _parse_attr_value(v) for k, v in zip(keys, vals)}
+    out = invoke(op_name, list(inputs), attrs,
+                 out=list(outputs) if outputs else None)
+    if not isinstance(out, (list, tuple)):
+        out = [out]
+    return list(out)
+
+
+# --- autograd ---------------------------------------------------------------
+def autograd_set_recording(flag):
+    from . import autograd
+    prev = autograd.is_recording()
+    autograd.set_recording(bool(flag))
+    return prev
+
+
+def autograd_set_training(flag):
+    from . import autograd
+    prev = autograd.is_training()
+    autograd.set_training(bool(flag))
+    return prev
+
+
+def autograd_mark_variables(variables, gradients):
+    for v, g in zip(variables, gradients):
+        v.attach_grad()
+        if g is not None:
+            v._grad = g
+    return True
+
+
+def autograd_backward(outputs, head_grads, retain_graph):
+    from . import autograd
+    hg = list(head_grads) if head_grads else None
+    autograd.backward(list(outputs), head_grads=hg,
+                      retain_graph=bool(retain_graph))
+    return True
+
+
+def ndarray_get_grad(arr):
+    return arr.grad
+
+
+# --- symbol -----------------------------------------------------------------
+def symbol_create_variable(name):
+    from . import symbol as sym
+    return sym.var(name)
+
+
+def symbol_create(op_name, input_symbols, keys, vals, name):
+    from . import symbol as sym
+    from .symbol.symbol import _parse_attr_value
+    attrs = {k: _parse_attr_value(v) for k, v in zip(keys, vals)}
+    return sym.Symbol._create(op_name, list(input_symbols), attrs,
+                              name=name or None)
+
+
+def symbol_from_json(json_str):
+    from .symbol import load_json
+    return load_json(json_str)
+
+
+def symbol_to_json(s):
+    return s.tojson()
+
+
+def symbol_list_arguments(s):
+    return list(s.list_arguments())
+
+
+def symbol_list_outputs(s):
+    return list(s.list_outputs())
+
+
+def symbol_list_aux(s):
+    return list(s.list_auxiliary_states())
+
+
+# --- executor ---------------------------------------------------------------
+def executor_bind(s, dev_type, dev_id, arg_names, arg_arrays,
+                  grad_reqs, aux_names, aux_arrays):
+    """MXExecutorBindEX parity over symbol/executor.py bind."""
+    ctx = _ctx(dev_type, dev_id)
+    args = dict(zip(arg_names, arg_arrays))
+    from . import nd
+    reqs = {}
+    grads = {}
+    for n, r in zip(arg_names, grad_reqs):
+        reqs[n] = r or "null"
+        if r and r != "null":
+            grads[n] = nd.zeros(args[n].shape, ctx, dtype=args[n].dtype)
+    aux = dict(zip(aux_names, aux_arrays)) if aux_names else {}
+    ex = s.bind(ctx, args, args_grad=grads or None,
+                grad_req=reqs, aux_states=aux or None)
+    return ex
+
+
+def executor_forward(ex, is_train):
+    ex.forward(is_train=bool(is_train))
+    return True
+
+
+def executor_backward(ex, head_grads):
+    ex.backward(list(head_grads) if head_grads else None)
+    return True
+
+
+def executor_outputs(ex):
+    return list(ex.outputs)
+
+
+def executor_arg_grad(ex, name):
+    return ex.grad_dict.get(name)
+
+
+# --- kvstore ----------------------------------------------------------------
+def kvstore_create(kv_type):
+    from . import kvstore
+    return kvstore.create(kv_type)
+
+
+def kvstore_init(kv, keys, values):
+    kv.init(list(keys), list(values))
+    return True
+
+
+def kvstore_push(kv, keys, values, priority):
+    kv.push(list(keys), list(values), priority=priority)
+    return True
+
+
+def kvstore_pull(kv, keys, outs, priority):
+    kv.pull(list(keys), out=list(outs), priority=priority)
+    return True
+
+
+def kvstore_rank_size(kv):
+    return kv.rank, kv.num_workers
+
+
+# --- misc -------------------------------------------------------------------
+def list_all_op_names():
+    from .ops import registry
+    return list(registry.list_ops())
+
+
+def version():
+    from . import __version__
+    return int("".join(f"{int(x):02d}" for x in
+                       __version__.split(".")[:3]))

@@ -100,6 +100,9 @@ _register("MXNET_KVSTORE_MAX_FRAME", int, 1 << 30,
 _register("MXNET_KVSTORE_HEARTBEAT_INTERVAL", float, 5.0,
           "worker heartbeat period in seconds (0 disables); feeds "
           "get_num_dead_node")
+_register("MXNET_OPTIMIZER_AGGREGATION_SIZE", int, 4,
+          "weights per aggregated multi_sgd_* dispatch in the SGD "
+          "optimizer (0 disables; parity: reference sgd.py)")
 _register("MXNET_KVSTORE_BIGARRAY_BOUND", int, 1000000,
           "arrays larger than this many elements are pushed/pulled in "
           "row chunks (parity: kvstore_dist.h:243 key sharding)")

@@ -542,9 +542,7 @@ def graph_to_onnx(sym, params, input_shapes, input_dtype=np.float32):
             base = f"{base}_{k}"
         used_names.add(base)
         op = _registry.get(n.op)
-        n_out = op.num_outputs
-        if not isinstance(n_out, int):  # dynamic (split): from attrs
-            n_out = int(n.attrs.get("num_outputs", 1))
+        n_out = op.resolve_num_outputs(n.attrs)
         if n_out > 1:
             for i in range(n_out):
                 entry_name[(id(n), i)] = f"{base}_output{i}"

@@ -225,6 +225,8 @@ class Trainer:
     def _update(self, ignore_stale_grad=False):
         """Run the optimizer on every (param, ctx) pair
         (parity: trainer.py:399)."""
+        import collections
+        pending = collections.defaultdict(list)
         for i, param in enumerate(self._params):
             if param.grad_req == "null" or param._data is None:
                 continue
@@ -246,9 +248,23 @@ class Trainer:
                 self._last_grad_version[i] = versions
             if self._kvstore and self._update_on_kvstore:
                 continue  # weights already pulled in _allreduce_grads
-            for upd, arr, grad in zip(self._updaters, param.list_data(),
-                                      param.list_grad()):
-                upd(i, grad, arr)
+            for j, (upd, arr, grad) in enumerate(
+                    zip(self._updaters, param.list_data(),
+                        param.list_grad())):
+                pending[j].append((i, grad, arr))
+        agg = getattr(self._optimizer, "aggregate_num", 0)
+        for j, triples in pending.items():
+            upd = self._updaters[j]
+            if agg and len(triples) > 1:
+                # multi-tensor dispatch: agg weights per updater call
+                # (reference trainer.py batches when aggregate_num > 0)
+                for k in range(0, len(triples), agg):
+                    chunk = triples[k:k + agg]
+                    upd([t[0] for t in chunk], [t[1] for t in chunk],
+                        [t[2] for t in chunk])
+            else:
+                for i, grad, arr in triples:
+                    upd(i, grad, arr)
 
     def save_states(self, fname):
         """Save optimizer/updater states (parity: trainer.py save_states)."""

@@ -591,12 +591,13 @@ def invoke(op, inputs, attrs, out=None):
         _prof.record_op(op.name, (_time.perf_counter() - _prof_t0) * 1e6)
 
     ctx = nd_inputs[0]._ctx if nd_inputs else current_context()
-    n_aux = len(op.mutate_aux)
+    mutate_aux = op.resolve_mutate_aux(attrs)
+    n_aux = len(mutate_aux)
     n_user = len(outs) - n_aux
 
     # write mutated aux state back into the input NDArrays (e.g. BatchNorm
     # moving stats, optimizer momenta) — reference does this in-place
-    for j, in_idx in enumerate(op.mutate_aux):
+    for j, in_idx in enumerate(mutate_aux):
         tgt = inputs[in_idx]
         if isinstance(tgt, NDArray):
             tgt._set_data(outs[n_user + j])

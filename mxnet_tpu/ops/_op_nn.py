@@ -731,3 +731,40 @@ def _rnn(attrs, data, params, state, *maybe_state_cell):
     if is_lstm:
         return x, hN, jnp.stack(out_c, axis=0)
     return x, hN
+
+
+# --- SVMOutput (reference: src/operator/svm_output.cc) ----------------------
+def _svm_output_grad(attrs, primals, cotangents):
+    data, label = primals
+    margin = float(attrs.get("margin", 1.0))
+    reg = float(attrs.get("regularization_coefficient", 1.0))
+    use_linear = bool(attrs.get("use_linear", False))
+    out = data  # forward is identity
+    k = jax.nn.one_hot(label.reshape(-1).astype(jnp.int32),
+                       data.shape[-1], dtype=jnp.bool_)
+    if use_linear:
+        # L1-SVM (svm_output.cc L1_SVM): hinge subgradient
+        g_true = -(margin > out).astype(data.dtype) * reg
+        g_other = (margin > -out).astype(data.dtype) * reg
+    else:
+        # L2-SVM (svm_output.cc L2_SVM): squared hinge
+        g_true = jnp.where(margin > out, -2 * reg * (margin - out), 0.0)
+        g_other = jnp.where(margin > -out, 2 * reg * (margin + out), 0.0)
+    g = jnp.where(k, g_true, g_other).astype(data.dtype)
+    ct = cotangents[0]
+    return (g * (ct.sum() if ct.ndim == 0 else 1.0), None)
+
+
+@register("SVMOutput", fgradient=_svm_output_grad)
+def _svm_output(attrs, data, label):
+    return data
+
+
+# --- SoftmaxActivation (reference: src/operator/softmax_activation.cc) ------
+@register("SoftmaxActivation")
+def _softmax_activation(attrs, x):
+    mode = attrs.get("mode", "instance")
+    if mode == "channel":
+        return jax.nn.softmax(x, axis=1)
+    flat = x.reshape(x.shape[0], -1)
+    return jax.nn.softmax(flat, axis=-1).reshape(x.shape)

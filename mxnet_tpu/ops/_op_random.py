@@ -108,3 +108,236 @@ def _sample_unique_zipfian(attrs, key):
 def _bernoulli(attrs, key):
     shape, dtype = _shape_dtype(attrs)
     return jax.random.bernoulli(key, float(attrs.get("p", 0.5)), shape).astype(dtype)
+
+
+# --- scalar generalized negative binomial (reference sample_op.cc:166) ------
+@register("_random_generalized_negative_binomial", is_random=True)
+def _gen_neg_binomial(attrs, key):
+    shape, dtype = _shape_dtype(attrs)
+    mu = float(attrs.get("mu", 1.0))
+    alpha = float(attrs.get("alpha", 1.0))
+    # NB(limit=1/alpha, prob=1/(mu*alpha+1)) via the gamma-Poisson mixture:
+    # lambda ~ Gamma(shape=1/alpha, scale=mu*alpha); x ~ Poisson(lambda)
+    lam = jax.random.gamma(key, 1.0 / alpha, shape) * (mu * alpha)
+    return jax.random.poisson(jax.random.fold_in(key, 1), lam, shape).astype(dtype)
+
+
+# --- per-element ("multisample") family -------------------------------------
+# Reference: src/operator/random/multisample_op.{h,cc} — each element of the
+# distribution-parameter tensors parameterizes its own block of samples; the
+# output shape is params.shape + attrs['shape'].  TPU redesign: one shaped
+# draw with the parameter tensors broadcast over the trailing sample dims —
+# a single fused XLA kernel, no per-distribution loop.
+
+def _msample_prep(attrs, *params):
+    sshape = attrs.get("shape", ()) or ()
+    if isinstance(sshape, int):
+        sshape = (sshape,)
+    sshape = tuple(int(s) for s in sshape)
+    oshape = params[0].shape + sshape
+    bcast = tuple(p.reshape(p.shape + (1,) * len(sshape)) for p in params)
+    dt = attrs.get("dtype")
+    dtype = np_dtype(dt) if dt not in (None, "None", -1) else params[0].dtype
+    return oshape, bcast, dtype
+
+
+@register("_sample_uniform", is_random=True, alias=("sample_uniform",))
+def _sample_uniform_op(attrs, key, low, high):
+    oshape, (lb, hb), dtype = _msample_prep(attrs, low, high)
+    u = jax.random.uniform(key, oshape, dtype=jnp.float32)
+    return (lb + u * (hb - lb)).astype(dtype)
+
+
+@register("_sample_normal", is_random=True, alias=("sample_normal",))
+def _sample_normal_op(attrs, key, mu, sigma):
+    oshape, (mb, sb), dtype = _msample_prep(attrs, mu, sigma)
+    return (mb + sb * jax.random.normal(key, oshape, jnp.float32)).astype(dtype)
+
+
+@register("_sample_gamma", is_random=True, alias=("sample_gamma",))
+def _sample_gamma_op(attrs, key, alpha, beta):
+    # beta is the SCALE (matches the scalar _random_gamma convention)
+    oshape, (ab, bb), dtype = _msample_prep(attrs, alpha, beta)
+    return (jax.random.gamma(key, ab, oshape) * bb).astype(dtype)
+
+
+@register("_sample_exponential", is_random=True,
+          alias=("sample_exponential",))
+def _sample_exponential_op(attrs, key, lam):
+    oshape, (lb,), dtype = _msample_prep(attrs, lam)
+    return (jax.random.exponential(key, oshape, jnp.float32) / lb).astype(dtype)
+
+
+@register("_sample_poisson", is_random=True, alias=("sample_poisson",))
+def _sample_poisson_op(attrs, key, lam):
+    oshape, (lb,), dtype = _msample_prep(attrs, lam)
+    return jax.random.poisson(key, lb, oshape).astype(dtype)
+
+
+@register("_sample_negative_binomial", is_random=True,
+          alias=("sample_negative_binomial",))
+def _sample_neg_binomial_op(attrs, key, k, p):
+    # gamma-Poisson mixture; p is the SUCCESS probability of the stopping
+    # criterion: mean = k(1-p)/p (matches scalar _random_negative_binomial)
+    oshape, (kb, pb), dtype = _msample_prep(attrs, k, p)
+    lam = jax.random.gamma(key, kb, oshape) * (1 - pb) / pb
+    return jax.random.poisson(jax.random.fold_in(key, 1), lam,
+                              oshape).astype(dtype)
+
+
+@register("_sample_generalized_negative_binomial", is_random=True,
+          alias=("sample_generalized_negative_binomial",))
+def _sample_gen_neg_binomial_op(attrs, key, mu, alpha):
+    oshape, (mb, ab), dtype = _msample_prep(attrs, mu, alpha)
+    lam = jax.random.gamma(key, 1.0 / ab, oshape) * (mb * ab)
+    return jax.random.poisson(jax.random.fold_in(key, 1), lam,
+                              oshape).astype(dtype)
+
+
+# --- *_like family (reference sample_op.cc:197-262) -------------------------
+@register("_random_uniform_like", is_random=True)
+def _uniform_like(attrs, key, data):
+    return jax.random.uniform(key, data.shape, dtype=jnp.float32,
+                              minval=float(attrs.get("low", 0.0)),
+                              maxval=float(attrs.get("high", 1.0))
+                              ).astype(data.dtype)
+
+
+@register("_random_normal_like", is_random=True)
+def _normal_like(attrs, key, data):
+    return (jax.random.normal(key, data.shape, jnp.float32)
+            * float(attrs.get("scale", 1.0))
+            + float(attrs.get("loc", 0.0))).astype(data.dtype)
+
+
+@register("_random_gamma_like", is_random=True)
+def _gamma_like(attrs, key, data):
+    return (jax.random.gamma(key, float(attrs.get("alpha", 1.0)), data.shape)
+            * float(attrs.get("beta", 1.0))).astype(data.dtype)
+
+
+@register("_random_exponential_like", is_random=True)
+def _exponential_like(attrs, key, data):
+    return (jax.random.exponential(key, data.shape, jnp.float32)
+            / float(attrs.get("lam", 1.0))).astype(data.dtype)
+
+
+@register("_random_poisson_like", is_random=True)
+def _poisson_like(attrs, key, data):
+    return jax.random.poisson(key, float(attrs.get("lam", 1.0)),
+                              data.shape).astype(data.dtype)
+
+
+@register("_random_negative_binomial_like", is_random=True)
+def _neg_binomial_like(attrs, key, data):
+    k = float(attrs.get("k", 1.0))
+    p = float(attrs.get("p", 1.0))
+    lam = jax.random.gamma(key, k, data.shape) * (1 - p) / p
+    return jax.random.poisson(jax.random.fold_in(key, 1), lam,
+                              data.shape).astype(data.dtype)
+
+
+@register("_random_generalized_negative_binomial_like", is_random=True)
+def _gen_neg_binomial_like(attrs, key, data):
+    mu = float(attrs.get("mu", 1.0))
+    alpha = float(attrs.get("alpha", 1.0))
+    lam = jax.random.gamma(key, 1.0 / alpha, data.shape) * (mu * alpha)
+    return jax.random.poisson(jax.random.fold_in(key, 1), lam,
+                              data.shape).astype(data.dtype)
+
+
+# --- pdf ops (reference random/pdf_op.{h,cc}) -------------------------------
+# random_pdf_<distr>(sample, *params, is_log): the parameter tensors describe
+# a batch of distributions (shape P); sample has shape P + T and each sample
+# element is evaluated under its row's distribution.  Deterministic jnp
+# formulas — gradients come from JAX autodiff of the closed forms (the
+# reference hand-writes *_Grad kernels; pdf_op.h).  Formula conventions
+# follow the reference exactly: gamma's beta is a RATE here (pdf_op.h
+# PDF_Gamma), negative_binomial's p is the failure probability.
+
+def _pdf_bcast(sample, params, vector=False):
+    """Reshape each param from P (or P+(k,)) to broadcast against sample."""
+    tail = 1 if vector else 0
+    extra = sample.ndim - params[0].ndim
+    outs = []
+    for p in params:
+        core = p.shape[:p.ndim - tail]
+        vec = p.shape[p.ndim - tail:]
+        outs.append(p.reshape(core + (1,) * extra + vec))
+    return outs
+
+
+def _pdf_out(lpdf, attrs):
+    return lpdf if bool(attrs.get("is_log", False)) else jnp.exp(lpdf)
+
+
+@register("_random_pdf_uniform", alias=("random_pdf_uniform",))
+def _pdf_uniform(attrs, sample, low, high):
+    lb, hb = _pdf_bcast(sample, (low, high))
+    # no support check — parity with reference PDF_Uniform
+    lpdf = jnp.broadcast_to(-jnp.log(hb - lb), sample.shape)
+    return _pdf_out(lpdf, attrs)
+
+
+@register("_random_pdf_normal", alias=("random_pdf_normal",))
+def _pdf_normal(attrs, sample, mu, sigma):
+    mb, sb = _pdf_bcast(sample, (mu, sigma))
+    lpdf = (-0.5 * jnp.square(sample - mb) / jnp.square(sb)
+            - jnp.log(sb * jnp.sqrt(2 * jnp.pi)))
+    return _pdf_out(lpdf, attrs)
+
+
+@register("_random_pdf_gamma", alias=("random_pdf_gamma",))
+def _pdf_gamma(attrs, sample, alpha, beta):
+    from jax.scipy.special import gammaln
+    ab, bb = _pdf_bcast(sample, (alpha, beta))
+    lpdf = (ab * jnp.log(bb) + (ab - 1) * jnp.log(sample) - bb * sample
+            - gammaln(ab))
+    return _pdf_out(lpdf, attrs)
+
+
+@register("_random_pdf_exponential", alias=("random_pdf_exponential",))
+def _pdf_exponential(attrs, sample, lam):
+    (lb,) = _pdf_bcast(sample, (lam,))
+    return _pdf_out(jnp.log(lb) - lb * sample, attrs)
+
+
+@register("_random_pdf_poisson", alias=("random_pdf_poisson",))
+def _pdf_poisson(attrs, sample, lam):
+    from jax.scipy.special import gammaln
+    (lb,) = _pdf_bcast(sample, (lam,))
+    lpdf = sample * jnp.log(lb) - gammaln(sample + 1) - lb
+    return _pdf_out(lpdf, attrs)
+
+
+def _nb_lpdf(limit, prob, x):
+    """log NB pmf with prob = FAILURE probability (reference pdf_op.h)."""
+    from jax.scipy.special import gammaln
+    return (gammaln(x + limit) - gammaln(x + 1) - gammaln(limit)
+            + limit * jnp.log(prob) + x * jnp.log1p(-prob))
+
+
+@register("_random_pdf_negative_binomial",
+          alias=("random_pdf_negative_binomial",))
+def _pdf_neg_binomial(attrs, sample, k, p):
+    kb, pb = _pdf_bcast(sample, (k, p))
+    return _pdf_out(_nb_lpdf(kb, pb, sample), attrs)
+
+
+@register("_random_pdf_generalized_negative_binomial",
+          alias=("random_pdf_generalized_negative_binomial",))
+def _pdf_gen_neg_binomial(attrs, sample, mu, alpha):
+    mb, ab = _pdf_bcast(sample, (mu, alpha))
+    limit = 1.0 / ab
+    prob = 1.0 / (mb * ab + 1.0)
+    return _pdf_out(_nb_lpdf(limit, prob, sample), attrs)
+
+
+@register("_random_pdf_dirichlet", alias=("random_pdf_dirichlet",))
+def _pdf_dirichlet(attrs, sample, alpha):
+    from jax.scipy.special import gammaln
+    (ab,) = _pdf_bcast(sample, (alpha,), vector=True)
+    lpdf = (jnp.sum((ab - 1) * jnp.log(sample), axis=-1)
+            + gammaln(jnp.sum(ab, axis=-1))
+            - jnp.sum(gammaln(ab), axis=-1))
+    return _pdf_out(lpdf, attrs)

@@ -14,6 +14,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .registry import register, register_simple
+from ..base import np_dtype
 
 
 # --- unary zoo (reference: elemwise_unary_op_basic/_trig/_pow .cc/.cu) ------
@@ -587,3 +588,78 @@ def _reshape_like(attrs, x, like):
 def _histogram(attrs, x, bins):
     cnt, edges = jnp.histogram(x, bins=bins)
     return cnt.astype(jnp.int64), edges
+
+
+# --- ravel / unravel (reference: src/operator/tensor/ravel.cc) --------------
+@register("_ravel_multi_index", alias=("ravel_multi_index",))
+def _ravel_multi_index_op(attrs, data):
+    shape = tuple(int(s) for s in attrs["shape"])
+    # data: (ndim, N) coordinate rows -> (N,) flat indices (row-major)
+    strides = []
+    acc = 1
+    for s in reversed(shape):
+        strides.append(acc)
+        acc *= s
+    strides = jnp.asarray(list(reversed(strides)), data.dtype)
+    return jnp.tensordot(strides, data, axes=([0], [0]))
+
+
+@register("_unravel_index", alias=("unravel_index",))
+def _unravel_index_op(attrs, data):
+    shape = tuple(int(s) for s in attrs["shape"])
+    # data: (N,) flat indices -> (ndim, N) coordinates (row-major)
+    coords = []
+    rem = data.astype(jnp.int32)
+    for s in reversed(shape):
+        coords.append(rem % s)
+        rem = rem // s
+    return jnp.stack(list(reversed(coords))).astype(data.dtype)
+
+
+# --- AMP cast ops (reference: src/operator/tensor/amp_cast.cc) --------------
+def _amp_cast_grad(attrs, primals, cotangents):
+    # gradient is the identity cast back to the input dtype (amp_cast.cc
+    # registers the backward as another amp_cast)
+    return (cotangents[0].astype(primals[0].dtype),)
+
+
+@register("amp_cast", fgradient=_amp_cast_grad)
+def _amp_cast(attrs, data):
+    return data.astype(np_dtype(attrs["dtype"]))
+
+
+def _amp_multicast_grad(attrs, primals, cotangents):
+    return tuple(ct.astype(p.dtype) for ct, p in zip(cotangents, primals))
+
+
+@register("amp_multicast", num_outputs="num_outputs",
+          fgradient=_amp_multicast_grad)
+def _amp_multicast(attrs, *data):
+    # cast every input to the widest floating dtype among them
+    # (amp_cast.cc AMPMultiCastType: common widest type)
+    widest = jnp.result_type(*[d.dtype for d in data])
+    if bool(attrs.get("cast_narrow", False)):
+        narrow = min((d.dtype for d in data),
+                     key=lambda t: jnp.dtype(t).itemsize)
+        widest = narrow
+    return tuple(d.astype(widest) for d in data)
+
+
+# --- add_n / ElementWiseSum (reference: tensor/elemwise_sum.cc:137) ---------
+@register("add_n", alias=("ElementWiseSum", "elemwise_sum"))
+def _add_n(attrs, *args):
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out
+
+
+# --- elemwise max/min (reference: tensor/elemwise_binary_op_extended.cc) ----
+@register("_maximum", alias=("maximum",))
+def _maximum_op(attrs, lhs, rhs):
+    return jnp.maximum(lhs, rhs)
+
+
+@register("_minimum", alias=("minimum",))
+def _minimum_op(attrs, lhs, rhs):
+    return jnp.minimum(lhs, rhs)

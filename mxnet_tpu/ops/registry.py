@@ -103,6 +103,24 @@ class Operator:
         self.scalar_args = scalar_args
         self._jit_cache = {}
 
+    # -- dynamic arity (multi-tensor ops: num_weights-driven) --------------
+    def resolve_num_outputs(self, attrs):
+        """Output count for given attrs. num_outputs may be an int, the
+        name of an attr holding the count (e.g. split's "num_outputs"), or
+        a callable(attrs) -> int (multi_sgd_*: 2*num_weights)."""
+        n = self.num_outputs
+        if isinstance(n, str):
+            return int(attrs.get(n, 1))
+        if callable(n):
+            return int(n(attrs))
+        return int(n)
+
+    def resolve_mutate_aux(self, attrs):
+        """Mutated-state input indices for given attrs; tuple or
+        callable(attrs) -> tuple (multi_sgd_mom: one momentum per weight)."""
+        ma = self.mutate_aux
+        return tuple(ma(attrs)) if callable(ma) else tuple(ma)
+
     # -- compiled execution ------------------------------------------------
     def jitted(self, attrs_key, attrs):
         fn = self._jit_cache.get(attrs_key)

@@ -187,6 +187,11 @@ class SGD(Optimizer):
         super().__init__(**kwargs)
         self.momentum = momentum
         self.lazy_update = lazy_update
+        # aggregated multi-tensor updates (reference sgd.py reads
+        # MXNET_OPTIMIZER_AGGREGATION_SIZE, default 4): N weights per
+        # multi_sgd_* dispatch — one fused XLA kernel pass instead of N
+        from .config import get as _cfg
+        self.aggregate_num = _cfg("MXNET_OPTIMIZER_AGGREGATION_SIZE")
 
     def create_state(self, index, weight):
         if self.momentum != 0.0:
@@ -220,6 +225,8 @@ class SGD(Optimizer):
             _invoke("sgd_update", [weight, grad], attrs, weight)
 
     def update_multi_precision(self, index, weight, grad, state):
+        if isinstance(index, (list, tuple)):
+            return self._aggregated_update(index, weight, grad, state)
         use_mp = self.multi_precision and isinstance(state, tuple) and \
             len(state) == 2 and hasattr(state[1], "shape") and \
             state[1].shape == weight.shape
@@ -233,6 +240,43 @@ class SGD(Optimizer):
             _invoke("mp_sgd_mom_update", [weight, grad, mom, w32], attrs, weight)
         else:
             _invoke("mp_sgd_update", [weight, grad, w32], attrs, weight)
+
+    def _aggregated_update(self, indices, weights, grads, states):
+        """One multi_sgd_* dispatch for N weights (optimizer_op.cc:320;
+        list-typed update_multi_precision mirrors the reference SGD)."""
+        from .ndarray.sparse import BaseSparseNDArray
+        mp = [self.multi_precision and isinstance(s, tuple) and len(s) == 2
+              and hasattr(s[1], "shape") for s in states]
+        aggregatable = (not any(isinstance(g, BaseSparseNDArray)
+                                for g in grads)) and \
+            (all(mp) or not any(mp))
+        if not aggregatable:
+            for i, w, g, s in zip(indices, weights, grads, states):
+                self.update_multi_precision(i, w, g, s)
+            return
+        for i in indices:
+            self._update_count(i)
+        lrs = tuple(self._get_lr(i) for i in indices)
+        wds = tuple(self._get_wd(i) for i in indices)
+        attrs = {"lrs": lrs, "wds": wds,
+                 "rescale_grad": self.rescale_grad,
+                 "num_weights": len(indices)}
+        if self.clip_gradient is not None:
+            attrs["clip_gradient"] = self.clip_gradient
+        use_mom = self.momentum != 0.0
+        if use_mom:
+            attrs["momentum"] = self.momentum
+        ins = []
+        if all(mp):
+            for w, g, s in zip(weights, grads, states):
+                mom, w32 = s
+                ins.extend([w, g] + ([mom] if use_mom else []) + [w32])
+            op = "multi_mp_sgd_mom_update" if use_mom else "multi_mp_sgd_update"
+        else:
+            for w, g, s in zip(weights, grads, states):
+                ins.extend([w, g] + ([s] if use_mom else []))
+            op = "multi_sgd_mom_update" if use_mom else "multi_sgd_update"
+        _invoke(op, ins, attrs, list(weights))
 
 
 @register
@@ -616,6 +660,9 @@ class LBSGD(SGD):
         kwargs.pop("multi_precision", None)
         super().__init__(momentum=momentum, **kwargs)
         self.eta = eta
+        # LARS scales lr per layer; the inherited multi_sgd_* aggregation
+        # would bypass that scaling — keep per-parameter updates
+        self.aggregate_num = 0
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -704,6 +751,26 @@ class Updater:
         self.aggregate_updates = optimizer.aggregate_num > 0
 
     def __call__(self, index, grad, weight):
+        if isinstance(index, (list, tuple)):
+            # aggregated call (reference optimizer.py Updater: list-typed
+            # index batches into one multi-tensor update)
+            for i, w in zip(index, weight):
+                self._ensure_state(i, w)
+            if hasattr(self.optimizer, "_aggregated_update"):
+                self.optimizer.update_multi_precision(
+                    list(index), list(weight), list(grad),
+                    [self.states[i] for i in index])
+            else:
+                # optimizer without multi-tensor support: unroll
+                for i, w, g in zip(index, weight, grad):
+                    self.optimizer.update_multi_precision(
+                        i, w, g, self.states[i])
+            return
+        self._ensure_state(index, weight)
+        self.optimizer.update_multi_precision(index, weight, grad,
+                                              self.states[index])
+
+    def _ensure_state(self, index, weight):
         if index not in self.states:
             self.states[index] = self.optimizer.create_state_multi_precision(
                 index, weight)
@@ -712,8 +779,6 @@ class Updater:
             self.states[index] = self.sync_state_context(
                 self.states[index], weight.ctx)
             self.states_synced[index] = True
-        self.optimizer.update_multi_precision(index, weight, grad,
-                                              self.states[index])
 
     def sync_state_context(self, state, context):
         from .ndarray import NDArray

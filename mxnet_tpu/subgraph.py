@@ -225,8 +225,8 @@ def _is_stateful(node):
     if node.is_variable():
         return False
     op = _registry.get(node.op)
-    return op.is_random or bool(op.mutate_aux) or \
-        (isinstance(op.num_outputs, int) and op.num_outputs > 1)
+    return op.is_random or bool(op.resolve_mutate_aux(node.attrs)) or \
+        op.resolve_num_outputs(node.attrs) > 1
 
 
 def _extract(sym, seed, region):

@@ -122,11 +122,12 @@ class Executor:
                     ins = [jax.random.fold_in(key, counter)] + ins
                 out = op.grad_aware(attrs)(*ins)
                 outs = out if isinstance(out, (tuple, list)) else (out,)
-                n_user = len(outs) - len(op.mutate_aux)
+                mutate_aux = op.resolve_mutate_aux(attrs)
+                n_user = len(outs) - len(mutate_aux)
                 for i, o in enumerate(outs[:n_user]):
                     env[(node, i)] = o
                 # route mutated aux outputs back to their aux variables
-                for j, in_idx in enumerate(op.mutate_aux):
+                for j, in_idx in enumerate(mutate_aux):
                     src_node, _ = node.inputs[in_idx]
                     if src_node.is_variable() and src_node.name in new_aux:
                         new_aux[src_node.name] = outs[n_user + j]

@@ -88,10 +88,11 @@ class GroupedRunner:
                              op.is_random, dev))
             else:
                 outs = _as_tuple(raw(*ins))
-            n_user = len(outs) - len(op.mutate_aux)
+            mutate_aux = op.resolve_mutate_aux(node.attrs)
+            n_user = len(outs) - len(mutate_aux)
             for i, o in enumerate(outs[:n_user]):
                 env[(node, i)] = o
-            for j, in_idx in enumerate(op.mutate_aux):
+            for j, in_idx in enumerate(mutate_aux):
                 src_node, _ = node.inputs[in_idx]
                 if src_node.is_variable() and src_node.name in new_aux:
                     new_aux[src_node.name] = outs[n_user + j]
@@ -111,7 +112,7 @@ class GroupedRunner:
         for node, in_entries, vjp_fn, out_avals, is_random, dev \
                 in reversed(tape):
             op = _registry.get(node.op)
-            n_user = len(out_avals) - len(op.mutate_aux)
+            n_user = len(out_avals) - len(op.resolve_mutate_aux(node.attrs))
             have_any = any(cts.get((node, i)) is not None
                            for i in range(n_user))
             if not have_any:

@@ -64,12 +64,10 @@ class Symbol:
                     "select an output first")
             entries.append(s._outputs[0])
         node = _SymNode(op_name, name, attrs, entries)
-        n_out = op.num_outputs
-        if isinstance(n_out, str):  # dynamic: resolved at bind time
-            n_out = int(attrs.get("num_outputs", 1)) if n_out == "num_outputs" else 1
+        n_out = op.resolve_num_outputs(attrs)
         # aux-mutating ops (BatchNorm moving stats): user-facing outputs only;
         # the executor routes the trailing outputs back into the aux inputs
-        n_out -= len(op.mutate_aux)
+        n_out -= len(op.resolve_mutate_aux(attrs))
         # hidden outputs (FNumVisibleOutputs parity, e.g. box_nms's index
         # record) are not part of the composable surface
         if op.num_visible is not None:
@@ -167,10 +165,7 @@ class Symbol:
                 entries.append((n, 0))
             else:
                 op = _registry.get(n.op)
-                n_out = op.num_outputs
-                if not isinstance(n_out, int):
-                    # dynamic-output ops (split): count from attrs
-                    n_out = int(n.attrs.get("num_outputs", 1))
+                n_out = op.resolve_num_outputs(n.attrs)
                 for i in range(n_out):
                     entries.append((n, i))
         return Symbol(entries)

@@ -1,0 +1,69 @@
+// Shared CPython-embedding plumbing for the C ABIs (c_predict_api.cc and
+// c_api.cc).  Role parity: the reference's src/c_api/c_api_error.cc
+// (MXGetLastError TLS) + engine init; here the "engine" is an embedded (or
+// joined) CPython interpreter driving mxnet_tpu under the GIL.
+#ifndef MXNET_TPU_C_EMBED_H_
+#define MXNET_TPU_C_EMBED_H_
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <mutex>
+#include <string>
+
+namespace mxtpu {
+
+inline std::mutex& init_mutex() {
+  static std::mutex m;
+  return m;
+}
+
+inline std::string& last_error() {
+  thread_local std::string err;
+  return err;
+}
+
+class Gil {
+ public:
+  Gil() : state_(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+inline void ensure_python() {
+  std::lock_guard<std::mutex> lk(init_mutex());
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    // release the GIL acquired by Py_Initialize so Gil{} works uniformly
+    PyEval_SaveThread();
+  }
+}
+
+inline int fail(const std::string& msg) {
+  last_error() = msg;
+  return -1;
+}
+
+inline int fail_from_python() {
+  PyObject *type, *value, *tb;
+  PyErr_Fetch(&type, &value, &tb);
+  PyObject* s = value ? PyObject_Str(value) : nullptr;
+  const char* c = s ? PyUnicode_AsUTF8(s) : nullptr;
+  last_error() = c ? c : "unknown python error";
+  Py_XDECREF(s);
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  return -1;
+}
+
+// import mxnet_tpu.<submodule>; returns new reference or nullptr
+inline PyObject* import_helper(const char* mod_name) {
+  return PyImport_ImportModule(mod_name);
+}
+
+}  // namespace mxtpu
+
+#endif  // MXNET_TPU_C_EMBED_H_

@@ -1,0 +1,472 @@
+"""General C API tests (src/c_api.cc; parity: include/mxnet/c_api.h
+training-critical subset — MXNDArray*, MXImperativeInvokeEx:1063,
+MXAutogradBackwardEx:1152, MXSymbol*, MXExecutorBind (c_api.h:1993),
+MXKVStore*).
+
+Two modes, mirroring test_c_predict.py: (1) ctypes joins the running
+interpreter; (2) a standalone C program embeds a fresh CPython and trains
+LeNet ONE STEP end-to-end — symbol compose, bind, forward, backward, SGD
+update — proving training (not just predict) is reachable from C.
+"""
+import ctypes
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_LIB = os.path.join(_REPO, "src", "build", "libmxnet_tpu_c.so")
+
+
+def _build_lib():
+    if os.path.exists(_LIB):
+        return True
+    try:
+        subprocess.run(["make", "-C", os.path.join(_REPO, "src"), "capi"],
+                       check=True, capture_output=True, timeout=180)
+        return os.path.exists(_LIB)
+    except Exception:
+        return False
+
+
+needs_lib = pytest.mark.skipif(not _build_lib(),
+                               reason="c api library not buildable")
+
+u32 = ctypes.c_uint32
+vp = ctypes.c_void_p
+
+
+def _lib():
+    lib = ctypes.CDLL(_LIB)
+    lib.MXGetLastError.restype = ctypes.c_char_p
+    # argtypes matter: a bare int handle would be truncated to c_int
+    cp, cpp, u32p = ctypes.c_char_p, ctypes.POINTER(ctypes.c_char_p), \
+        ctypes.POINTER(u32)
+    vpp = ctypes.POINTER(vp)
+    intp = ctypes.POINTER(ctypes.c_int)
+    lib.MXNDArrayCreateEx.argtypes = [u32p, u32, ctypes.c_int,
+                                      ctypes.c_int, ctypes.c_int,
+                                      ctypes.c_int, vpp]
+    lib.MXNDArraySyncCopyFromCPU.argtypes = [vp, vp, ctypes.c_size_t]
+    lib.MXNDArraySyncCopyToCPU.argtypes = [vp, vp, ctypes.c_size_t]
+    lib.MXNDArrayGetShape.argtypes = [vp, u32p, ctypes.POINTER(u32p)]
+    lib.MXNDArrayGetDType.argtypes = [vp, intp]
+    lib.MXNDArraySave.argtypes = [cp, u32, vpp, cpp]
+    lib.MXNDArrayLoad.argtypes = [cp, u32p, ctypes.POINTER(vpp), u32p,
+                                  ctypes.POINTER(cpp)]
+    lib.MXNDArrayFree.argtypes = [vp]
+    lib.MXNDArrayGetGrad.argtypes = [vp, vpp]
+    lib.MXImperativeInvokeEx.argtypes = [cp, ctypes.c_int, vpp, intp,
+                                         ctypes.POINTER(vpp),
+                                         ctypes.c_int, cpp, cpp]
+    lib.MXAutogradSetIsRecording.argtypes = [ctypes.c_int, intp]
+    lib.MXAutogradSetIsTraining.argtypes = [ctypes.c_int, intp]
+    lib.MXAutogradMarkVariables.argtypes = [u32, vpp, u32p, vpp]
+    lib.MXAutogradBackward.argtypes = [u32, vpp, vpp, ctypes.c_int]
+    lib.MXSymbolCreateVariable.argtypes = [cp, vpp]
+    lib.MXSymbolCreateOp.argtypes = [cp, u32, cpp, cpp, u32, vpp, cp, vpp]
+    lib.MXSymbolCreateFromJSON.argtypes = [cp, vpp]
+    lib.MXSymbolSaveToJSON.argtypes = [vp, cpp]
+    lib.MXSymbolListArguments.argtypes = [vp, u32p, ctypes.POINTER(cpp)]
+    lib.MXSymbolListOutputs.argtypes = [vp, u32p, ctypes.POINTER(cpp)]
+    lib.MXSymbolFree.argtypes = [vp]
+    lib.MXExecutorBind.argtypes = [vp, ctypes.c_int, ctypes.c_int, u32,
+                                   cpp, vpp, cpp, u32, cpp, vpp, vpp]
+    lib.MXExecutorForward.argtypes = [vp, ctypes.c_int]
+    lib.MXExecutorBackward.argtypes = [vp, u32, vpp]
+    lib.MXExecutorOutputs.argtypes = [vp, u32p, ctypes.POINTER(vpp)]
+    lib.MXExecutorArgGrad.argtypes = [vp, cp, vpp]
+    lib.MXExecutorFree.argtypes = [vp]
+    lib.MXKVStoreCreate.argtypes = [cp, vpp]
+    lib.MXKVStoreInit.argtypes = [vp, u32, intp, vpp]
+    lib.MXKVStorePush.argtypes = [vp, u32, intp, vpp, ctypes.c_int]
+    lib.MXKVStorePull.argtypes = [vp, u32, intp, vpp, ctypes.c_int]
+    lib.MXKVStoreGetRank.argtypes = [vp, intp]
+    lib.MXKVStoreGetGroupSize.argtypes = [vp, intp]
+    lib.MXKVStoreFree.argtypes = [vp]
+    lib.MXListAllOpNames.argtypes = [u32p, ctypes.POINTER(cpp)]
+    lib.MXGetVersion.argtypes = [intp]
+    return lib
+
+
+def _err(lib):
+    return lib.MXGetLastError().decode()
+
+
+def _mk_ndarray(lib, arr):
+    arr = np.ascontiguousarray(arr, np.float32)
+    shape = (u32 * arr.ndim)(*arr.shape)
+    h = vp()
+    rc = lib.MXNDArrayCreateEx(shape, arr.ndim, 1, 0, 0, 0,
+                               ctypes.byref(h))
+    assert rc == 0, _err(lib)
+    rc = lib.MXNDArraySyncCopyFromCPU(h, arr.ctypes.data_as(vp),
+                                      ctypes.c_size_t(arr.nbytes))
+    assert rc == 0, _err(lib)
+    return h
+
+
+def _to_numpy(lib, h):
+    ndim = u32()
+    pdata = ctypes.POINTER(u32)()
+    assert lib.MXNDArrayGetShape(h, ctypes.byref(ndim),
+                                 ctypes.byref(pdata)) == 0, _err(lib)
+    shape = tuple(pdata[i] for i in range(ndim.value))
+    out = np.zeros(shape, np.float32)
+    rc = lib.MXNDArraySyncCopyToCPU(h, out.ctypes.data_as(vp),
+                                    ctypes.c_size_t(out.nbytes))
+    assert rc == 0, _err(lib)
+    return out
+
+
+@needs_lib
+class TestCtypes:
+    def test_ndarray_roundtrip_and_save_load(self, tmp_path):
+        lib = _lib()
+        x = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+        h = _mk_ndarray(lib, x)
+        np.testing.assert_allclose(_to_numpy(lib, h), x)
+        dt = ctypes.c_int()
+        assert lib.MXNDArrayGetDType(h, ctypes.byref(dt)) == 0
+        assert dt.value == 0  # float32
+        fname = str(tmp_path / "arr.params").encode()
+        keys = (ctypes.c_char_p * 1)(b"weight")
+        handles = (vp * 1)(h)
+        assert lib.MXNDArraySave(fname, 1, handles, keys) == 0, _err(lib)
+        out_size = u32()
+        out_arrs = ctypes.POINTER(vp)()
+        name_size = u32()
+        names = ctypes.POINTER(ctypes.c_char_p)()
+        assert lib.MXNDArrayLoad(fname, ctypes.byref(out_size),
+                                 ctypes.byref(out_arrs),
+                                 ctypes.byref(name_size),
+                                 ctypes.byref(names)) == 0, _err(lib)
+        assert out_size.value == 1 and names[0] == b"weight"
+        np.testing.assert_allclose(_to_numpy(lib, out_arrs[0]), x)
+        lib.MXNDArrayFree(h)
+
+    def test_imperative_invoke(self):
+        lib = _lib()
+        a = _mk_ndarray(lib, np.full((2, 2), 3.0))
+        num_out = ctypes.c_int(0)
+        outs = ctypes.POINTER(vp)()
+        rc = lib.MXImperativeInvokeEx(b"square", 1, (vp * 1)(a),
+                                      ctypes.byref(num_out),
+                                      ctypes.byref(outs), 0, None, None)
+        assert rc == 0, _err(lib)
+        assert num_out.value == 1
+        np.testing.assert_allclose(_to_numpy(lib, outs[0]), 9.0)
+
+    def test_autograd(self):
+        lib = _lib()
+        x = _mk_ndarray(lib, np.ones((2, 3)))
+        g = _mk_ndarray(lib, np.zeros((2, 3)))
+        assert lib.MXAutogradMarkVariables(
+            1, (vp * 1)(x), (u32 * 1)(1), (vp * 1)(g)) == 0, _err(lib)
+        prev = ctypes.c_int()
+        assert lib.MXAutogradSetIsRecording(1, ctypes.byref(prev)) == 0
+        num_out = ctypes.c_int(0)
+        outs = ctypes.POINTER(vp)()
+        assert lib.MXImperativeInvokeEx(b"square", 1, (vp * 1)(x),
+                                        ctypes.byref(num_out),
+                                        ctypes.byref(outs), 0, None,
+                                        None) == 0
+        y = outs[0]
+        num_out = ctypes.c_int(0)          # reset: fresh outputs wanted
+        outs = ctypes.POINTER(vp)()
+        assert lib.MXImperativeInvokeEx(b"sum", 1, (vp * 1)(y),
+                                        ctypes.byref(num_out),
+                                        ctypes.byref(outs), 0, None,
+                                        None) == 0
+        s = outs[0]
+        assert lib.MXAutogradSetIsRecording(0, ctypes.byref(prev)) == 0
+        assert lib.MXAutogradBackward(1, (vp * 1)(s), None, 0) == 0, \
+            _err(lib)
+        gh = vp()
+        assert lib.MXNDArrayGetGrad(x, ctypes.byref(gh)) == 0
+        np.testing.assert_allclose(_to_numpy(lib, gh), 2.0)
+
+    def test_kvstore(self):
+        lib = _lib()
+        kv = vp()
+        assert lib.MXKVStoreCreate(b"local", ctypes.byref(kv)) == 0, \
+            _err(lib)
+        v = _mk_ndarray(lib, np.array([1.0, 2.0], np.float32))
+        keys = (ctypes.c_int * 1)(3)
+        assert lib.MXKVStoreInit(kv, 1, keys, (vp * 1)(v)) == 0, _err(lib)
+        assert lib.MXKVStorePush(kv, 1, keys, (vp * 1)(v), 0) == 0
+        out = _mk_ndarray(lib, np.zeros(2, np.float32))
+        assert lib.MXKVStorePull(kv, 1, keys, (vp * 1)(out), 0) == 0
+        np.testing.assert_allclose(_to_numpy(lib, out), [1.0, 2.0])
+        rank = ctypes.c_int()
+        size = ctypes.c_int()
+        assert lib.MXKVStoreGetRank(kv, ctypes.byref(rank)) == 0
+        assert lib.MXKVStoreGetGroupSize(kv, ctypes.byref(size)) == 0
+        assert (rank.value, size.value) == (0, 1)
+        lib.MXKVStoreFree(kv)
+
+    def test_symbol_and_executor_train_step(self):
+        """Full symbolic train step through the C ABI from ctypes."""
+        lib = _lib()
+        data = vp()
+        assert lib.MXSymbolCreateVariable(b"data", ctypes.byref(data)) == 0
+        w = vp()
+        assert lib.MXSymbolCreateVariable(b"w", ctypes.byref(w)) == 0
+        label = vp()
+        assert lib.MXSymbolCreateVariable(b"label",
+                                          ctypes.byref(label)) == 0
+        fc = vp()
+        keys = (ctypes.c_char_p * 2)(b"num_hidden", b"no_bias")
+        vals = (ctypes.c_char_p * 2)(b"3", b"True")
+        assert lib.MXSymbolCreateOp(b"FullyConnected", 2, keys, vals, 2,
+                                    (vp * 2)(data, w), b"fc",
+                                    ctypes.byref(fc)) == 0, _err(lib)
+        out = vp()
+        assert lib.MXSymbolCreateOp(b"SoftmaxOutput", 0, None, None, 2,
+                                    (vp * 2)(fc, label), b"sm",
+                                    ctypes.byref(out)) == 0, _err(lib)
+        # serde roundtrip
+        js = ctypes.c_char_p()
+        assert lib.MXSymbolSaveToJSON(out, ctypes.byref(js)) == 0
+        out2 = vp()
+        assert lib.MXSymbolCreateFromJSON(js, ctypes.byref(out2)) == 0, \
+            _err(lib)
+        n = u32()
+        strs = ctypes.POINTER(ctypes.c_char_p)()
+        assert lib.MXSymbolListArguments(out2, ctypes.byref(n),
+                                         ctypes.byref(strs)) == 0
+        args = [strs[i].decode() for i in range(n.value)]
+        assert args == ["data", "w", "label"]
+
+        rs = np.random.RandomState(2)
+        xs = {"data": rs.randn(4, 5).astype(np.float32),
+              "w": rs.randn(3, 5).astype(np.float32) * 0.1,
+              "label": np.array([0, 1, 2, 0], np.float32)}
+        handles = [_mk_ndarray(lib, xs[a]) for a in args]
+        reqs = (ctypes.c_char_p * 3)(b"null", b"write", b"null")
+        names = (ctypes.c_char_p * 3)(*[a.encode() for a in args])
+        ex = vp()
+        assert lib.MXExecutorBind(out2, 1, 0, 3, names,
+                                  (vp * 3)(*handles), reqs, 0, None, None,
+                                  ctypes.byref(ex)) == 0, _err(lib)
+        assert lib.MXExecutorForward(ex, 1) == 0, _err(lib)
+        on = u32()
+        oh = ctypes.POINTER(vp)()
+        assert lib.MXExecutorOutputs(ex, ctypes.byref(on),
+                                     ctypes.byref(oh)) == 0
+        probs = _to_numpy(lib, oh[0])
+        np.testing.assert_allclose(probs.sum(-1), 1.0, rtol=1e-5)
+        assert lib.MXExecutorBackward(ex, 0, None) == 0, _err(lib)
+        gw = vp()
+        assert lib.MXExecutorArgGrad(ex, b"w", ctypes.byref(gw)) == 0
+        grad = _to_numpy(lib, gw)
+        assert np.isfinite(grad).all() and np.abs(grad).sum() > 0
+        # SGD update through the imperative ABI
+        wh = handles[1]
+        before = _to_numpy(lib, wh)
+        num_out = ctypes.c_int(1)
+        outp = (vp * 1)(wh)
+        outs_pp = ctypes.cast(outp, ctypes.POINTER(vp))
+        k = (ctypes.c_char_p * 1)(b"lr")
+        v = (ctypes.c_char_p * 1)(b"0.1")
+        assert lib.MXImperativeInvokeEx(b"sgd_update", 2, (vp * 2)(wh, gw),
+                                        ctypes.byref(num_out),
+                                        ctypes.byref(outs_pp), 1, k,
+                                        v) == 0, _err(lib)
+        after = _to_numpy(lib, wh)
+        assert not np.allclose(before, after)
+        lib.MXExecutorFree(ex)
+
+    def test_misc(self):
+        lib = _lib()
+        ver = ctypes.c_int()
+        assert lib.MXGetVersion(ctypes.byref(ver)) == 0
+        assert ver.value > 0
+        n = u32()
+        strs = ctypes.POINTER(ctypes.c_char_p)()
+        assert lib.MXListAllOpNames(ctypes.byref(n),
+                                    ctypes.byref(strs)) == 0
+        names = {strs[i].decode() for i in range(n.value)}
+        assert "FullyConnected" in names and len(names) > 300
+
+
+_C_MAIN = r"""
+// Standalone C program: train LeNet ONE STEP end-to-end via the ABI.
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <math.h>
+
+typedef void* H;
+typedef unsigned int mx_uint;
+extern const char* MXGetLastError();
+extern int MXNDArrayCreateEx(const mx_uint*, mx_uint, int, int, int, int,
+                             H*);
+extern int MXNDArraySyncCopyFromCPU(H, const void*, size_t);
+extern int MXNDArraySyncCopyToCPU(H, void*, size_t);
+extern int MXSymbolCreateVariable(const char*, H*);
+extern int MXSymbolCreateOp(const char*, mx_uint, const char**,
+                            const char**, mx_uint, H*, const char*, H*);
+extern int MXSymbolListArguments(H, mx_uint*, const char***);
+extern int MXExecutorBind(H, int, int, mx_uint, const char**, H*,
+                          const char**, mx_uint, const char**, H*, H*);
+extern int MXExecutorForward(H, int);
+extern int MXExecutorBackward(H, mx_uint, H*);
+extern int MXExecutorOutputs(H, mx_uint*, H**);
+extern int MXExecutorArgGrad(H, const char*, H*);
+extern int MXImperativeInvokeEx(const char*, int, H*, int*, H**, int,
+                                const char**, const char**);
+
+#define CHECK(x) if ((x) != 0) { \
+  fprintf(stderr, "FAIL %s: %s\n", #x, MXGetLastError()); return 1; }
+
+static H nd(const mx_uint* shape, mx_uint ndim, const float* data,
+            size_t n) {
+  H h = NULL;
+  if (MXNDArrayCreateEx(shape, ndim, 1, 0, 0, 0, &h) != 0) return NULL;
+  if (data && MXNDArraySyncCopyFromCPU(h, data, n * 4) != 0) return NULL;
+  return h;
+}
+
+int main(void) {
+  // LeNet-ish: conv(8@5x5) -> tanh -> maxpool2 -> fc10 -> softmax
+  H data, c1w, c1b, fcw, fcb, label;
+  CHECK(MXSymbolCreateVariable("data", &data));
+  CHECK(MXSymbolCreateVariable("c1w", &c1w));
+  CHECK(MXSymbolCreateVariable("c1b", &c1b));
+  CHECK(MXSymbolCreateVariable("fcw", &fcw));
+  CHECK(MXSymbolCreateVariable("fcb", &fcb));
+  CHECK(MXSymbolCreateVariable("label", &label));
+
+  const char* ck[2] = {"kernel", "num_filter"};
+  const char* cv[2] = {"(5, 5)", "8"};
+  H conv, act, pool, fc, net;
+  H cin[3] = {data, c1w, c1b};
+  CHECK(MXSymbolCreateOp("Convolution", 2, ck, cv, 3, cin, "c1", &conv));
+  const char* ak[1] = {"act_type"};
+  const char* av[1] = {"tanh"};
+  CHECK(MXSymbolCreateOp("Activation", 1, ak, av, 1, &conv, "a1", &act));
+  const char* pk[3] = {"pool_type", "kernel", "stride"};
+  const char* pv[3] = {"max", "(2, 2)", "(2, 2)"};
+  CHECK(MXSymbolCreateOp("Pooling", 3, pk, pv, 1, &act, "p1", &pool));
+  const char* fk[1] = {"num_hidden"};
+  const char* fv[1] = {"10"};
+  H fin[3] = {pool, fcw, fcb};
+  CHECK(MXSymbolCreateOp("FullyConnected", 1, fk, fv, 3, fin, "fc", &fc));
+  H sin[2] = {fc, label};
+  CHECK(MXSymbolCreateOp("SoftmaxOutput", 0, NULL, NULL, 2, sin, "sm",
+                         &net));
+
+  mx_uint nargs = 0;
+  const char** argnames = NULL;
+  CHECK(MXSymbolListArguments(net, &nargs, &argnames));
+  if (nargs != 6) { fprintf(stderr, "args %u\n", nargs); return 1; }
+
+  // shapes: data(4,1,28,28) c1w(8,1,5,5) c1b(8) fcw(10,1152) fcb(10)
+  mx_uint sh_data[4] = {4, 1, 28, 28};
+  mx_uint sh_c1w[4] = {8, 1, 5, 5};
+  mx_uint sh_c1b[1] = {8};
+  mx_uint sh_fcw[2] = {10, 8 * 12 * 12};
+  mx_uint sh_fcb[1] = {10};
+  mx_uint sh_lab[1] = {4};
+  float xbuf[4 * 28 * 28], wbuf[10 * 1152], lbuf[4] = {0, 1, 2, 3};
+  unsigned seed = 42;
+  for (size_t i = 0; i < sizeof(xbuf) / 4; ++i) {
+    seed = seed * 1664525u + 1013904223u;
+    xbuf[i] = ((float)(seed >> 8) / 16777216.0f - 0.5f);
+  }
+  for (size_t i = 0; i < sizeof(wbuf) / 4; ++i) {
+    seed = seed * 1664525u + 1013904223u;
+    wbuf[i] = ((float)(seed >> 8) / 16777216.0f - 0.5f) * 0.1f;
+  }
+  float cwbuf[8 * 25];
+  for (size_t i = 0; i < 200; ++i) cwbuf[i] = wbuf[i] * 0.5f;
+  float zeros[1152] = {0};
+
+  H h_data = nd(sh_data, 4, xbuf, 4 * 28 * 28);
+  H h_c1w = nd(sh_c1w, 4, cwbuf, 200);
+  H h_c1b = nd(sh_c1b, 1, zeros, 8);
+  H h_fcw = nd(sh_fcw, 2, wbuf, 10 * 1152);
+  H h_fcb = nd(sh_fcb, 1, zeros, 10);
+  H h_lab = nd(sh_lab, 1, lbuf, 4);
+  if (!h_data || !h_c1w || !h_c1b || !h_fcw || !h_fcb || !h_lab) {
+    fprintf(stderr, "nd: %s\n", MXGetLastError());
+    return 1;
+  }
+
+  const char* names[6] = {"data", "c1w", "c1b", "fcw", "fcb", "label"};
+  H arrs[6] = {h_data, h_c1w, h_c1b, h_fcw, h_fcb, h_lab};
+  const char* reqs[6] = {"null", "write", "write", "write", "write",
+                         "null"};
+  H ex = NULL;
+  CHECK(MXExecutorBind(net, 1, 0, 6, names, arrs, reqs, 0, NULL, NULL,
+                       &ex));
+  CHECK(MXExecutorForward(ex, 1));
+  mx_uint nout = 0;
+  H* outs = NULL;
+  CHECK(MXExecutorOutputs(ex, &nout, &outs));
+  float probs[40];
+  CHECK(MXNDArraySyncCopyToCPU(outs[0], probs, sizeof(probs)));
+  float loss0 = 0;
+  for (int r = 0; r < 4; ++r) loss0 -= logf(probs[r * 10 + (int)lbuf[r]]);
+  CHECK(MXExecutorBackward(ex, 0, NULL));
+
+  // SGD step on every weight through the imperative ABI
+  const char* wnames[4] = {"c1w", "c1b", "fcw", "fcb"};
+  H warrs[4] = {h_c1w, h_c1b, h_fcw, h_fcb};
+  for (int i = 0; i < 4; ++i) {
+    H g = NULL;
+    CHECK(MXExecutorArgGrad(ex, wnames[i], &g));
+    if (!g) { fprintf(stderr, "no grad %s\n", wnames[i]); return 1; }
+    H ins[2] = {warrs[i], g};
+    int no = 1;
+    H outbuf[1] = {warrs[i]};
+    H* op = outbuf;
+    const char* k[1] = {"lr"};
+    const char* v[1] = {"0.5"};
+    CHECK(MXImperativeInvokeEx("sgd_update", 2, ins, &no, &op, 1, k, v));
+  }
+
+  // loss after one step must decrease on the same batch
+  CHECK(MXExecutorForward(ex, 1));
+  CHECK(MXExecutorOutputs(ex, &nout, &outs));
+  CHECK(MXNDArraySyncCopyToCPU(outs[0], probs, sizeof(probs)));
+  float loss1 = 0;
+  for (int r = 0; r < 4; ++r) loss1 -= logf(probs[r * 10 + (int)lbuf[r]]);
+  printf("loss %.6f -> %.6f\n", loss0, loss1);
+  if (!(loss1 < loss0)) { fprintf(stderr, "no improvement\n"); return 1; }
+  printf("C-TRAIN-OK\n");
+  return 0;
+}
+"""
+
+
+@needs_lib
+def test_standalone_c_training(tmp_path):
+    """A fresh C process (embedding its own interpreter) composes LeNet,
+    binds, runs fwd/bwd, applies SGD, and sees the loss decrease."""
+    csrc = tmp_path / "train.c"
+    csrc.write_text(_C_MAIN)
+    exe = tmp_path / "train"
+    cfg = subprocess.run(
+        [sys.executable, "-c",
+         "import sysconfig;v=sysconfig.get_config_vars();"
+         "print(v.get('LIBDIR',''));print(v['LDVERSION'])"],
+        capture_output=True, text=True, check=True).stdout.split()
+    libdir, ldver = cfg[0], cfg[1]
+    subprocess.run(
+        ["gcc", str(csrc), "-o", str(exe), "-L",
+         os.path.dirname(_LIB), "-lmxnet_tpu_c",
+         f"-L{libdir}", f"-lpython{ldver}", "-lm",
+         f"-Wl,-rpath,{os.path.dirname(_LIB)}", f"-Wl,-rpath,{libdir}"],
+        check=True, capture_output=True)
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run([str(exe)], capture_output=True, text=True,
+                      timeout=300, env=env)
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "C-TRAIN-OK" in r.stdout

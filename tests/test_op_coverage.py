@@ -466,6 +466,113 @@ CASES = {
 }
 
 
+def _np_gammaln(x):
+    from scipy.special import gammaln
+    return gammaln(x)
+
+
+_PDF_S34 = _p(3, 4)          # positive samples, rows = distributions
+_PDF_P3A = _p(3, lo=0.5)     # per-row params
+_PDF_P3B = _p(3, lo=0.5)
+
+
+def _np_pdf_gamma(x, a, b):
+    a, b = a[:, None], b[:, None]
+    return np.exp(a * np.log(b) + (a - 1) * np.log(x) - b * x - _np_gammaln(a))
+
+
+def _np_nb_lpdf(l, p, x):
+    return (_np_gammaln(x + l) - _np_gammaln(x + 1) - _np_gammaln(l)
+            + l * np.log(p) + x * np.log1p(-p))
+
+
+CASES.update({
+    # ---- pdf family (reference random/pdf_op.h formulas) ----------------
+    "random_pdf_uniform": C(
+        [_p(3, 4, lo=0.0, hi=0.4), np.zeros(3, np.float32),
+         np.full(3, 2.0, np.float32)],
+        lambda x, lo, hi: np.broadcast_to(1.0 / (hi - lo)[:, None], x.shape),
+        grad=False),
+    "random_pdf_normal": C(
+        [_u(3, 4), _u(3), _p(3, lo=0.5)],
+        lambda x, m, s: np.exp(-0.5 * (x - m[:, None]) ** 2 / s[:, None] ** 2)
+        / (s[:, None] * np.sqrt(2 * np.pi)), grad=True),
+    "random_pdf_gamma": C([_PDF_S34, _PDF_P3A, _PDF_P3B], _np_pdf_gamma,
+                          grad=True, grad_eps=1e-4),
+    "random_pdf_exponential": C(
+        [_PDF_S34, _PDF_P3A],
+        lambda x, l: l[:, None] * np.exp(-l[:, None] * x), grad=True),
+    "random_pdf_poisson": C(
+        [np.arange(12, dtype=np.float32).reshape(3, 4), _p(3, lo=1.0, hi=5.0)],
+        lambda x, l: np.exp(x * np.log(l[:, None]) - _np_gammaln(x + 1)
+                            - l[:, None]), grad=False),
+    "random_pdf_negative_binomial": C(
+        [np.arange(12, dtype=np.float32).reshape(3, 4),
+         _p(3, lo=1.0, hi=4.0), _p(3, lo=0.2, hi=0.8)],
+        lambda x, k, p: np.exp(_np_nb_lpdf(k[:, None], p[:, None], x)),
+        grad=False),
+    "random_pdf_generalized_negative_binomial": C(
+        [np.arange(12, dtype=np.float32).reshape(3, 4),
+         _p(3, lo=1.0, hi=4.0), _p(3, lo=0.3, hi=1.5)],
+        lambda x, mu, a: np.exp(_np_nb_lpdf(
+            1.0 / a[:, None], 1.0 / (mu[:, None] * a[:, None] + 1.0), x)),
+        grad=False),
+    "random_pdf_dirichlet": C(
+        [(lambda r: (r / r.sum(-1, keepdims=True)))(_p(3, 4)),
+         _p(3, 4, lo=0.5)],
+        lambda x, a: np.exp(np.sum((a - 1) * np.log(x), -1)
+                            + _np_gammaln(a.sum(-1))
+                            - _np_gammaln(a).sum(-1)), grad=False),
+    # ---- SVMOutput: forward is identity (custom grad pinned in
+    # test_sample_pdf_ops.py against the svm_output.cc kernels) -----------
+    "SVMOutput": C([A34, np.array([0, 2, 1], np.float32)],
+                   lambda d, l: d, grad=False),
+    # ---- ravel / unravel ------------------------------------------------
+    "ravel_multi_index": C(
+        [np.array([[0, 1, 2, 2], [0, 3, 1, 4]], np.float32)],
+        lambda d: np.ravel_multi_index(d.astype(np.int64), (3, 5)).astype(
+            np.float32), attrs={"shape": (3, 5)}, grad=False),
+    "unravel_index": C(
+        [np.array([0, 8, 6, 14], np.float32)],
+        lambda d: np.array(np.unravel_index(d.astype(np.int64), (3, 5)),
+                           np.float32), attrs={"shape": (3, 5)}, grad=False),
+    # ---- amp casts ------------------------------------------------------
+    "amp_cast": C([A34], lambda a: a.astype(np.float16),
+                  attrs={"dtype": "float16"}, grad=False, rtol=1e-2,
+                  atol=1e-2),
+    "amp_multicast": C([A34, B34], lambda a, b: (a, b),
+                       attrs={"num_outputs": 2}, grad=False),
+    # ---- add_n / elemwise extremes / SoftmaxActivation ------------------
+    "add_n": C([A34, B34, P34], lambda a, b, c: a + b + c, grad=True),
+    "_maximum": C([A34, B34], np.maximum, grad=True),
+    "_minimum": C([A34, B34], np.minimum, grad=True),
+    "SoftmaxActivation": C([A34], _np_softmax, grad=True),
+    # ---- aggregated multi-tensor optimizer updates ----------------------
+    "multi_sgd_update": C(
+        [A34, B34, _u(5), _u(5)],
+        lambda w1, g1, w2, g2: (_np_sgd(w1, g1, lr=0.1, wd=0.01),
+                                _np_sgd(w2, g2, lr=0.2, wd=0.0)),
+        attrs={"lrs": (0.1, 0.2), "wds": (0.01, 0.0), "num_weights": 2},
+        grad=False),
+    "multi_sgd_mom_update": C(
+        [A34, B34, np.zeros((3, 4), np.float32)],
+        # visible output = updated weight; momentum goes back via aux
+        lambda w, g, m: _np_sgd(w, g, lr=0.1, wd=0.01),
+        attrs={"lrs": (0.1,), "wds": (0.01,), "momentum": 0.0,
+               "num_weights": 1}, grad=False),
+    "multi_mp_sgd_update": C(
+        [A34, B34, A34.copy()],
+        lambda w, g, w32: _np_sgd(w32, g, lr=0.1, wd=0.01),
+        attrs={"lrs": (0.1,), "wds": (0.01,), "num_weights": 1},
+        grad=False),
+    "multi_mp_sgd_mom_update": C(
+        [A34, B34, np.zeros((3, 4), np.float32), A34.copy()],
+        lambda w, g, m, w32: _np_sgd(w32, g, lr=0.1, wd=0.01),
+        attrs={"lrs": (0.1,), "wds": (0.01,), "momentum": 0.0,
+               "num_weights": 1}, grad=False),
+})
+
+
 def _np_scatter_nd(d, idx, shape):
     out = np.zeros(shape, np.float32)
     out[tuple(idx.astype(np.int64))] = d
@@ -565,6 +672,22 @@ EXEMPT = {
     "_foreach": "test_control_flow.py",
     "_while_loop": "test_control_flow.py",
     "_cond": "test_control_flow.py",
+    # per-element samplers + *_like family: distribution moment tests
+    "_sample_uniform": "test_sample_pdf_ops.py",
+    "_sample_normal": "test_sample_pdf_ops.py",
+    "_sample_gamma": "test_sample_pdf_ops.py",
+    "_sample_exponential": "test_sample_pdf_ops.py",
+    "_sample_poisson": "test_sample_pdf_ops.py",
+    "_sample_negative_binomial": "test_sample_pdf_ops.py",
+    "_sample_generalized_negative_binomial": "test_sample_pdf_ops.py",
+    "_random_generalized_negative_binomial": "test_sample_pdf_ops.py",
+    "_random_uniform_like": "test_sample_pdf_ops.py",
+    "_random_normal_like": "test_sample_pdf_ops.py",
+    "_random_gamma_like": "test_sample_pdf_ops.py",
+    "_random_exponential_like": "test_sample_pdf_ops.py",
+    "_random_poisson_like": "test_sample_pdf_ops.py",
+    "_random_negative_binomial_like": "test_sample_pdf_ops.py",
+    "_random_generalized_negative_binomial_like": "test_sample_pdf_ops.py",
 }
 
 
