@@ -26,11 +26,11 @@ def __getattr__(name):
     # lazy subpackages to keep import light
     import importlib
     if name in ("gluon", "optimizer", "metric", "initializer", "lr_scheduler",
-                "symbol", "sym", "io", "image", "kvstore", "profiler", "module",
+                "symbol", "sym", "io", "image", "kvstore", "profiler", "module", "mod",
                 "callback", "monitor", "parallel", "test_utils", "visualization",
                 "executor", "runtime", "model", "recordio", "contrib", "amp", "config",
                 "operator", "subgraph", "attribute"):
-        target = {"sym": "symbol"}.get(name, name)
+        target = {"sym": "symbol", "mod": "module"}.get(name, name)
         mod = importlib.import_module(f".{target}", __name__)
         globals()[name] = mod
         return mod

@@ -375,9 +375,22 @@ class Module(BaseModule):
             return
         if isinstance(optimizer, str):
             idx2name = {i: n for i, n in enumerate(self._param_names)}
+            opt_kw = dict(optimizer_params or ())
+            # loss-layer ops (SoftmaxOutput, *RegressionOutput) emit
+            # batch-SUMMED gradients; the optimizer normalizes
+            # (parity: module.py:506 rescale_grad = 1.0/batch_size)
+            if "rescale_grad" not in opt_kw and self._data_shapes:
+                batch = self._data_shapes[0][1][0]
+                if batch:
+                    opt_kw["rescale_grad"] = 1.0 / batch
             optimizer = opt_mod.create(
-                optimizer, param_idx2name=idx2name,
-                **dict(optimizer_params or ()))
+                optimizer, param_idx2name=idx2name, **opt_kw)
+        elif getattr(optimizer, "rescale_grad", 1.0) == 1.0 and \
+                self._data_shapes and self._data_shapes[0][1][0] > 1:
+            self.logger.warning(
+                "Optimizer created manually outside Module but rescale_grad "
+                "= 1.0. Is this intended? (gradients from loss layers are "
+                "batch-summed; consider rescale_grad=1/batch_size)")
         self._optimizer = optimizer
         self._updater = opt_mod.get_updater(optimizer)
         arg_params = {n: self._exec.arg_dict[n] for n in self._param_names}

@@ -18,8 +18,24 @@ from jax import lax
 from .registry import register
 
 
+def _attr_bool(v):
+    """Robust bool attr: accepts reference-style string attrs
+    ("True"/"False"/"1"/"0") as well as Python bools."""
+    if isinstance(v, str):
+        return v.strip().lower() in ("1", "true", "yes")
+    return bool(v)
+
+
+def _wb_names(attrs):
+    """data/weight/bias input roles, honoring no_bias (FListInputNames
+    parity: reference nn/fully_connected.cc ListArguments)."""
+    if _attr_bool(attrs.get("no_bias", False)):
+        return ("data", "weight")
+    return ("data", "weight", "bias")
+
+
 # --- FullyConnected (reference: nn/fully_connected.cc) ----------------------
-@register("FullyConnected")
+@register("FullyConnected", input_names=_wb_names)
 def _fully_connected(attrs, x, weight, *maybe_bias):
     x = x.astype(weight.dtype)  # AMP contract: weight dtype is authoritative
     if not bool(attrs.get("flatten", True)):
@@ -55,7 +71,7 @@ def _tupleize(v, n):
     return t if t else (1,) * n
 
 
-@register("Convolution")
+@register("Convolution", input_names=_wb_names)
 def _convolution(attrs, x, weight, *maybe_bias):
     kernel = tuple(attrs["kernel"])
     nd = len(kernel)
@@ -83,7 +99,7 @@ def _convolution(attrs, x, weight, *maybe_bias):
     return out
 
 
-@register("Deconvolution")
+@register("Deconvolution", input_names=_wb_names)
 def _deconvolution(attrs, x, weight, *maybe_bias):
     kernel = tuple(attrs["kernel"])
     nd = len(kernel)
@@ -228,7 +244,8 @@ def _upsampling(attrs, x, *weights):
 
 
 # --- normalisation ----------------------------------------------------------
-@register("BatchNorm", num_outputs=3, mutate_aux=(3, 4))
+@register("BatchNorm", num_outputs=3, mutate_aux=(3, 4),
+          input_names=("data", "gamma", "beta", "moving_mean", "moving_var"))
 def _batch_norm(attrs, x, gamma, beta, moving_mean, moving_var):
     """Returns (out, new_moving_mean, new_moving_var).
 
@@ -298,7 +315,7 @@ def _fused_ln_ok(n_rows, d, x_dtype, g_dtype, b_dtype):
     return _LN_PROBED[key]
 
 
-@register("LayerNorm")
+@register("LayerNorm", input_names=("data", "gamma", "beta"))
 def _layer_norm(attrs, x, gamma, beta):
     axis = int(attrs.get("axis", -1))
     eps = float(attrs.get("eps", 1e-5))
@@ -316,7 +333,7 @@ def _layer_norm(attrs, x, gamma, beta):
     return out * gamma.reshape(bshape) + beta.reshape(bshape)
 
 
-@register("GroupNorm")
+@register("GroupNorm", input_names=("data", "gamma", "beta"))
 def _group_norm(attrs, x, gamma, beta):
     ng = int(attrs.get("num_groups", 1))
     eps = float(attrs.get("eps", 1e-5))
@@ -330,7 +347,7 @@ def _group_norm(attrs, x, gamma, beta):
     return out * gamma.reshape(bshape) + beta.reshape(bshape)
 
 
-@register("InstanceNorm")
+@register("InstanceNorm", input_names=("data", "gamma", "beta"))
 def _instance_norm(attrs, x, gamma, beta):
     eps = float(attrs.get("eps", 1e-3))
     axes = tuple(range(2, x.ndim))
@@ -449,7 +466,8 @@ def _softmax_output_grad(attrs, primals, cotangents):
     return (g * cotangents[0].sum() if cotangents[0].ndim == 0 else g, None)
 
 
-@register("SoftmaxOutput", fgradient=_softmax_output_grad, alias=("Softmax",))
+@register("SoftmaxOutput", fgradient=_softmax_output_grad, alias=("Softmax",),
+          input_names=("data", "label"))
 def _softmax_output(attrs, data, label):
     return jax.nn.softmax(data, axis=-1)
 
@@ -473,20 +491,20 @@ def _regression_grad(link, err_fn):
     return grad
 
 
-@register("LinearRegressionOutput",
+@register("LinearRegressionOutput", input_names=("data", "label"),
           fgradient=_regression_grad(lambda x: x, lambda p, l: p - l))
 def _linear_regression_output(attrs, data, label):
     return data
 
 
-@register("MAERegressionOutput",
+@register("MAERegressionOutput", input_names=("data", "label"),
           fgradient=_regression_grad(lambda x: x,
                                      lambda p, l: jnp.sign(p - l)))
 def _mae_regression_output(attrs, data, label):
     return data
 
 
-@register("LogisticRegressionOutput",
+@register("LogisticRegressionOutput", input_names=("data", "label"),
           fgradient=_regression_grad(jax.nn.sigmoid, lambda p, l: p - l))
 def _logistic_regression_output(attrs, data, label):
     return jax.nn.sigmoid(data)

@@ -396,7 +396,8 @@ def _embedding_grad(attrs, prims, cts):
     return (None, dense)
 
 
-@register("Embedding", fgradient=_embedding_grad)
+@register("Embedding", fgradient=_embedding_grad,
+          input_names=("data", "weight"))
 def _embedding(attrs, data, weight):
     idx = data.astype(jnp.int32)
     out = jnp.take(weight, jnp.clip(idx, 0, weight.shape[0] - 1), axis=0)

@@ -87,7 +87,7 @@ class Operator:
 
     def __init__(self, name, fcompute, num_outputs=1, is_random=False,
                  mutate_aux=(), fgradient=None, alias=(), scalar_args=("scalar",),
-                 num_visible=None):
+                 num_visible=None, input_names=None):
         self.name = name
         self.fcompute = fcompute
         self.num_outputs = num_outputs
@@ -101,7 +101,19 @@ class Operator:
         # names assigned, in order, to positional non-array args in the
         # generated imperative wrapper (e.g. nd.clip(x, 0, 1))
         self.scalar_args = scalar_args
+        # declared input roles (FListInputNames parity). The symbol layer
+        # auto-creates `{instance}_{suffix}` variables for trailing inputs
+        # the user did not supply — reference behavior, e.g.
+        # sym.FullyConnected(data, num_hidden=k) synthesizes fc_weight/
+        # fc_bias. Tuple, or callable(attrs) -> tuple (no_bias handling).
+        self.input_names = input_names
         self._jit_cache = {}
+
+    def resolve_input_names(self, attrs):
+        n = self.input_names
+        if n is None:
+            return None
+        return tuple(n(attrs)) if callable(n) else tuple(n)
 
     # -- dynamic arity (multi-tensor ops: num_weights-driven) --------------
     def resolve_num_outputs(self, attrs):
@@ -222,14 +234,14 @@ class Operator:
 
 def register(name, num_outputs=1, is_random=False, mutate_aux=(),
              fgradient=None, alias=(), scalar_args=("scalar",),
-             num_visible=None):
+             num_visible=None, input_names=None):
     """Decorator: register fcompute under ``name`` (+ aliases)."""
 
     def deco(fcompute):
         op = Operator(name, fcompute, num_outputs=num_outputs,
                       is_random=is_random, mutate_aux=mutate_aux,
                       fgradient=fgradient, alias=alias, scalar_args=scalar_args,
-                      num_visible=num_visible)
+                      num_visible=num_visible, input_names=input_names)
         if name in _OPS:
             raise MXNetError(f"op {name} already registered")
         _OPS[name] = op

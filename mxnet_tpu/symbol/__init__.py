@@ -12,7 +12,15 @@ def _make_sym_func(op):
                    if not isinstance(a, Symbol) and isinstance(a, (int, float))]
         for attr_name, val in zip(op.scalar_args, scalars):
             kwargs.setdefault(attr_name, val)
-        return Symbol._create(op.name, inputs, kwargs, name=name)
+        # Symbol-valued kwargs are INPUTS named by role (reference generated
+        # wrappers accept e.g. weight=shared_w for weight tying); they must
+        # not fall into attrs or the auto-create path would silently shadow
+        # them with fresh variables.
+        sym_kw = {k: v for k, v in kwargs.items() if isinstance(v, Symbol)}
+        for k in sym_kw:
+            del kwargs[k]
+        return Symbol._create(op.name, inputs, kwargs, name=name,
+                              named_inputs=sym_kw)
 
     fn.__name__ = op.name
     fn.__doc__ = f"Symbolic wrapper for operator `{op.name}`."

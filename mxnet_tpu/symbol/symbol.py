@@ -49,20 +49,55 @@ class Symbol:
 
     # -- construction ------------------------------------------------------
     @staticmethod
-    def _create(op_name, input_syms, attrs, name=None):
+    def _create(op_name, input_syms, attrs, name=None, named_inputs=None):
         op = _registry.get(op_name)
         attrs = {k: v for k, v in attrs.items() if v is not None}
         from ..attribute import AttrScope
         attrs = AttrScope._current_value().get(attrs)
         from ..name import NameManager
         name = NameManager._current_value().get(name, op_name.lower().strip("_"))
-        entries = []
-        for s in input_syms:
+
+        def one_output(s):
             if len(s._outputs) != 1:
                 raise MXNetError(
                     "cannot compose with a multi-output symbol as one input; "
                     "select an output first")
-            entries.append(s._outputs[0])
+            return s._outputs[0]
+
+        entries = [one_output(s) for s in input_syms]
+        expected = op.resolve_input_names(attrs)
+        named_inputs = dict(named_inputs or {})
+        if named_inputs:
+            # role-named Symbol inputs (weight=shared_w — the reference
+            # weight-tying idiom); only ops declaring input_names take them
+            if expected is None:
+                raise MXNetError(
+                    f"operator {op_name} does not declare named inputs; "
+                    f"pass {sorted(named_inputs)} positionally")
+            unknown = set(named_inputs) - set(expected)
+            if unknown:
+                raise MXNetError(
+                    f"unknown input name(s) {sorted(unknown)} for operator "
+                    f"{op_name}; declared inputs are {list(expected)}")
+            clash = set(expected[:len(entries)]) & set(named_inputs)
+            if clash:
+                raise MXNetError(
+                    f"input(s) {sorted(clash)} of {op_name} given both "
+                    "positionally and by name")
+        # auto-create parameter variables the caller omitted (reference
+        # generated-wrapper behavior: sym.FullyConnected(data, num_hidden=k)
+        # synthesizes fc_weight/fc_bias vars; BatchNorm's moving stats land
+        # in list_auxiliary_states via mutate_aux)
+        if expected is not None and len(entries) < len(expected):
+            aux_idx = set(op.resolve_mutate_aux(attrs))
+            for i in range(len(entries), len(expected)):
+                role = expected[i]
+                if role in named_inputs:
+                    entries.append(one_output(named_inputs.pop(role)))
+                    continue
+                var_attrs = {"__is_aux__": True} if i in aux_idx else None
+                entries.append(
+                    (_SymNode(None, f"{name}_{role}", var_attrs), 0))
         node = _SymNode(op_name, name, attrs, entries)
         n_out = op.resolve_num_outputs(attrs)
         # aux-mutating ops (BatchNorm moving stats): user-facing outputs only;
@@ -548,6 +583,24 @@ def _prelu_shapes(attrs, in_shapes):
     return [data, (data[1] if len(data) > 1 else 1,)]
 
 
+def _softmax_output_shapes(attrs, in_shapes):
+    # label defaults to data minus the class axis (reference
+    # softmax_output.cc SoftmaxOutputShape) — lets inference-only binds
+    # proceed without label_shapes
+    data = in_shapes[0]
+    if data is None or len(in_shapes) < 2:
+        return in_shapes
+    return [data, tuple(data[:-1]) if len(data) > 1 else (1,)]
+
+
+def _regression_output_shapes(attrs, in_shapes):
+    # label shape == data shape (reference regression_output-inl.h)
+    data = in_shapes[0]
+    if data is None or len(in_shapes) < 2:
+        return in_shapes
+    return [data, data]
+
+
 _PARAM_SHAPE_RULES = {
     "FullyConnected": _fc_shapes,
     "Convolution": _conv_shapes,
@@ -559,6 +612,11 @@ _PARAM_SHAPE_RULES = {
     "Embedding": _embedding_shapes,
     "RNN": _rnn_shapes,
     "LeakyReLU": _prelu_shapes,
+    "SoftmaxOutput": _softmax_output_shapes,
+    "Softmax": _softmax_output_shapes,
+    "LinearRegressionOutput": _regression_output_shapes,
+    "MAERegressionOutput": _regression_output_shapes,
+    "LogisticRegressionOutput": _regression_output_shapes,
 }
 
 

@@ -106,6 +106,42 @@ def consistency_devices():
     return devs
 
 
+def get_mnist_like(num_train=3000, num_val=500, translate=False, seed=7):
+    """Synthetic MNIST-shaped classification data for convergence gates.
+
+    Zero-egress stand-in for test_utils.get_mnist() (reference
+    test_utils.py:1565, which downloads the real files). Two flavors:
+
+    * ``translate=False``: each class is a fixed random 28x28 prototype
+      plus gaussian noise — linearly separable, the MLP gate.
+    * ``translate=True``: each class is a fixed 10x10 patch stamped at a
+      random position on an empty 28x28 canvas plus noise — translation
+      invariance is required, so convolution+pooling genuinely matters
+      (a same-budget MLP plateaus well below the conv gate's threshold).
+
+    Returns dict(train_data, train_label, test_data, test_label) with
+    data shaped (N, 1, 28, 28) float32 in [0, 1], matching get_mnist().
+    """
+    rng = np.random.RandomState(seed)
+    n = num_train + num_val
+    y = rng.randint(0, 10, n)
+    if not translate:
+        protos = rng.rand(10, 1, 28, 28).astype(np.float32)
+        x = protos[y] + rng.randn(n, 1, 28, 28).astype(np.float32) * 0.35
+    else:
+        patches = (rng.rand(10, 10, 10) > 0.5).astype(np.float32)
+        x = rng.rand(n, 1, 28, 28).astype(np.float32) * 0.15
+        rows = rng.randint(0, 28 - 10, n)
+        cols = rng.randint(0, 28 - 10, n)
+        for i in range(n):
+            x[i, 0, rows[i]:rows[i] + 10, cols[i]:cols[i] + 10] += \
+                patches[y[i]] * 0.85
+    x = np.clip(x, 0.0, 1.0)
+    y = y.astype(np.float32)
+    return {"train_data": x[:num_train], "train_label": y[:num_train],
+            "test_data": x[num_train:], "test_label": y[num_train:]}
+
+
 def check_consistency(op_fn, input_shapes, dtypes=(np.float32, np.float16),
                       rtol=None, atol=None, devices=None):
     """Run the same op across devices × dtypes and cross-check every leg

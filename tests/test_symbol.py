@@ -168,3 +168,47 @@ def test_executor_reshape():
     ex = out.simple_bind(mx.cpu(), data=(2, 6))
     ex2 = ex.reshape(data=(5, 6))
     assert ex2.forward()[0].shape == (5, 4)
+
+
+def test_auto_created_param_vars():
+    """Omitted parameter inputs become auto-created variables (reference
+    generated-wrapper behavior: symbol/register.py)."""
+    d = sym.var("data")
+    fc = sym.FullyConnected(d, num_hidden=8, name="fc1")
+    assert fc.list_arguments() == ["data", "fc1_weight", "fc1_bias"]
+    nb = sym.FullyConnected(d, num_hidden=8, no_bias=True, name="nb")
+    assert nb.list_arguments() == ["data", "nb_weight"]
+    # string attrs (reference convention) parse, not truthiness-of-str
+    nbs = sym.FullyConnected(d, num_hidden=8, no_bias="False", name="s1")
+    assert nbs.list_arguments() == ["data", "s1_weight", "s1_bias"]
+    bn = sym.BatchNorm(d, name="bn")
+    assert bn.list_arguments() == ["data", "bn_gamma", "bn_beta"]
+    assert bn.list_auxiliary_states() == ["bn_moving_mean", "bn_moving_var"]
+
+
+def test_named_symbol_inputs_weight_tying():
+    """weight=shared_var must tie, not silently auto-create a fresh var."""
+    d = sym.var("data")
+    w = sym.var("shared_w")
+    f1 = sym.FullyConnected(d, weight=w, num_hidden=4, name="f1")
+    f2 = sym.FullyConnected(f1, weight=w, num_hidden=4, name="f2")
+    assert f2.list_arguments() == ["data", "shared_w", "f1_bias", "f2_bias"]
+    with pytest.raises(mx.MXNetError):
+        sym.FullyConnected(d, wieght=w, num_hidden=4)  # typo'd input name
+
+
+def test_inference_only_bind_auto_label():
+    """SoftmaxOutput's auto-created label must not block label-less binds
+    (label shape inferred from data, reference SoftmaxOutputShape)."""
+    d = sym.var("data")
+    out = sym.SoftmaxOutput(sym.FullyConnected(d, num_hidden=10, name="fc"),
+                            name="softmax")
+    mod = mx.mod.Module(out, data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.bind(data_shapes=[("data", (2, 3))], label_shapes=None,
+             for_training=False)
+    mod.init_params(initializer=mx.initializer.Xavier())
+    from mxnet_tpu.io import DataBatch
+    mod.forward(DataBatch(data=[mx.nd.array(np.zeros((2, 3), np.float32))],
+                          label=None), is_train=False)
+    assert mod.get_outputs()[0].shape == (2, 10)

