@@ -14,7 +14,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .registry import register, register_simple
-from ..base import np_dtype
+from ..base import MXNetError, np_dtype
 
 
 # --- unary zoo (reference: elemwise_unary_op_basic/_trig/_pow .cc/.cu) ------
@@ -224,6 +224,21 @@ def _reshape(attrs, x):
             if si < len(src):
                 si += 1
         j += 1
+    if -1 in out:
+        # resolve -1 here: jnp's inference divides by the product of the
+        # other dims, which raises ZeroDivisionError for 0-size arrays
+        known = 1
+        for d in out:
+            if d != -1:
+                known *= d
+        if known:
+            out[out.index(-1)] = x.size // known
+        elif x.size == 0:
+            out[out.index(-1)] = 0
+        else:
+            raise MXNetError(
+                f"cannot infer -1 in reshape {attrs.get('shape')} for "
+                f"input shape {x.shape}")
     return jnp.reshape(x, tuple(out))
 
 
@@ -442,6 +457,10 @@ def _one_hot(attrs, indices):
 
 @register("where")
 def _where(attrs, cond, x, y):
+    # 1-D condition over an N-D x selects ROWS (reference
+    # control_flow.cc WhereOpShape: csr/1-D condition broadcast on axis 0)
+    if cond.ndim == 1 and x.ndim > 1 and cond.shape[0] == x.shape[0]:
+        cond = cond.reshape((cond.shape[0],) + (1,) * (x.ndim - 1))
     return jnp.where(cond.astype(bool), x, y)
 
 

@@ -62,8 +62,10 @@ class TestSVRG:
         losses = []
         mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
         mod.init_params(mx.initializer.Constant(0.0))
+        # Module defaults rescale_grad = 1/batch (reference parity, r4);
+        # lr is x16 the old value to keep the same effective step
         mod.init_optimizer(optimizer="sgd",
-                           optimizer_params=(("learning_rate", 0.003),))
+                           optimizer_params=(("learning_rate", 0.048),))
         for epoch in range(10):
             if epoch % mod.update_freq == 0:
                 mod.update_full_grads(it)
@@ -204,8 +206,9 @@ class TestCustomGradInExecutor:
         mod = Module(out, data_names=("data",), label_names=("lin_label",))
         mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
         mod.init_params(mx.initializer.Constant(0.0))
+        # lr x16 vs r3: Module now applies rescale_grad=1/batch (parity)
         mod.init_optimizer(optimizer="sgd",
-                           optimizer_params=(("learning_rate", 0.02),))
+                           optimizer_params=(("learning_rate", 0.32),))
         losses = []
         for _ in range(10):
             it.reset()

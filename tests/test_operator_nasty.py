@@ -1,0 +1,376 @@
+"""The nastiest operator cases, ported from the reference suite.
+
+Each test names its reference source (tests/python/unittest/
+test_operator.py unless noted). These are the cases that historically
+caught real bugs: special reshape codes, take's out-of-range modes,
+dot transpose flags, log_softmax overflow, BatchNorm moving-stat
+updates, ceil-mode pooling shapes, pick/where indexing, negative-step
+slices, tie-heavy ordering ops.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+rng = np.random.RandomState(11)
+
+
+def _a(*shape, lo=-2.0, hi=2.0):
+    return rng.uniform(lo, hi, shape).astype(np.float32)
+
+
+def test_reshape_special_codes():
+    """Reference test_operator.py test_reshape: the 0/-1/-2/-3/-4 code
+    matrix (matrix_op-inl.h ReshapeShape)."""
+    cases = [
+        ((2, 3, 4), (0, -1), (2, 12)),
+        ((2, 3, 4), (-1, 4), (6, 4)),
+        ((2, 3, 4), (0, 0, 4), (2, 3, 4)),
+        ((2, 3, 4), (-2,), (2, 3, 4)),
+        ((2, 3, 4), (2, -2), (2, 3, 4)),
+        ((2, 3, 4), (-3, 4), (6, 4)),
+        ((2, 3, 4), (0, -3), (2, 12)),
+        ((2, 3, 4), (-4, 1, 2, 0, 4), (1, 2, 3, 4)),
+        ((2, 3, 4), (-4, -1, 2, 12), (1, 2, 12)),
+        ((24,), (-4, 2, -1), (2, 12)),
+    ]
+    for src, code, want in cases:
+        x = _a(*src)
+        got = nd.Reshape(nd.array(x), shape=code)
+        assert got.shape == want, f"{src} -> {code}: {got.shape} != {want}"
+        np.testing.assert_allclose(got.asnumpy().ravel(), x.ravel())
+
+
+def test_take_out_of_range_modes():
+    """take's mode=clip/wrap (tensor/indexing_op.h TakeParam::mode)."""
+    x = _a(5, 3)
+    idx = np.array([-2, 0, 4, 7], np.float32)
+    got_clip = nd.take(nd.array(x), nd.array(idx), mode="clip").asnumpy()
+    want_clip = x[np.clip(idx.astype(int), 0, 4)]
+    np.testing.assert_allclose(got_clip, want_clip)
+    got_wrap = nd.take(nd.array(x), nd.array(idx), mode="wrap").asnumpy()
+    want_wrap = x[idx.astype(int) % 5]
+    np.testing.assert_allclose(got_wrap, want_wrap)
+
+
+def test_take_axis_nonzero():
+    x = _a(3, 5, 2)
+    idx = np.array([4, 0, 2], np.float32)
+    got = nd.take(nd.array(x), nd.array(idx), axis=1).asnumpy()
+    np.testing.assert_allclose(got, np.take(x, idx.astype(int), axis=1))
+
+
+def test_dot_transpose_flags():
+    """dot(a, b, transpose_a, transpose_b) all four combinations
+    (test_operator.py test_dot)."""
+    a = _a(4, 6)
+    b = _a(4, 6)
+    combos = [
+        (False, True, a @ b.T),
+        (True, False, a.T @ b),
+        (False, False, a @ b.T.T.reshape(6, 4).T) if False else None,
+    ]
+    np.testing.assert_allclose(
+        nd.dot(nd.array(a), nd.array(b), transpose_b=True).asnumpy(),
+        a @ b.T, rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(
+        nd.dot(nd.array(a), nd.array(b), transpose_a=True).asnumpy(),
+        a.T @ b, rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(
+        nd.dot(nd.array(a), nd.array(b.T)).asnumpy(),
+        a @ b.T, rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(
+        nd.dot(nd.array(a.T), nd.array(b), transpose_a=True,
+               transpose_b=True).asnumpy(),
+        a @ b.T, rtol=2e-5, atol=2e-5)
+
+
+def test_log_softmax_large_values():
+    """Numerical stability at |x| ~ 1e4 — naive exp overflows
+    (test_operator.py test_log_softmax + softmax with temperature)."""
+    x = np.array([[1e4, 1e4 - 1, 0.0], [-1e4, 0.0, 1e4]], np.float32)
+    got = nd.log_softmax(nd.array(x), axis=-1).asnumpy()
+    assert np.isfinite(got).all()
+    m = x.max(-1, keepdims=True)
+    want = (x - m) - np.log(np.exp(x - m).sum(-1, keepdims=True))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_softmax_temperature():
+    x = _a(3, 7)
+    t = 2.5
+    got = nd.softmax(nd.array(x), temperature=t).asnumpy()
+    e = np.exp((x - x.max(-1, keepdims=True)) / t)
+    np.testing.assert_allclose(got, e / e.sum(-1, keepdims=True),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_batchnorm_running_stats_update():
+    """Moving mean/var update with momentum over two train steps
+    (test_operator.py test_batchnorm_training / batch_norm.cc)."""
+    mom, eps = 0.9, 1e-3
+    x1, x2 = _a(8, 3, 4, 4), _a(8, 3, 4, 4)
+    gamma, beta = np.ones(3, np.float32), np.zeros(3, np.float32)
+    mm = np.zeros(3, np.float32)
+    mv = np.ones(3, np.float32)
+    from mxnet_tpu.ndarray import invoke
+    nd_mm, nd_mv = nd.array(mm), nd.array(mv)
+    for x in (x1, x2):
+        # imperative path mutates the aux NDArrays IN PLACE
+        # (reference batch_norm.cc writes moving stats through kAddTo-less
+        # aux refs); the visible output is just the normalized tensor
+        with mx.autograd.train_mode():
+            invoke("BatchNorm",
+                   [nd.array(x), nd.array(gamma), nd.array(beta),
+                    nd_mm, nd_mv],
+                   {"momentum": mom, "eps": eps, "fix_gamma": False})
+        bm = x.mean(axis=(0, 2, 3))
+        bv = x.var(axis=(0, 2, 3))
+        mm = mm * mom + bm * (1 - mom)
+        mv = mv * mom + bv * (1 - mom)
+        np.testing.assert_allclose(nd_mm.asnumpy(), mm, rtol=1e-4,
+                                   atol=1e-5)
+        np.testing.assert_allclose(nd_mv.asnumpy(), mv, rtol=1e-3,
+                                   atol=1e-4)
+
+
+def test_pooling_full_convention_shape():
+    """pooling_convention='full' ceil-mode output shapes
+    (test_operator.py test_pooling_full_conv / pooling-inl.h)."""
+    x = _a(1, 1, 7, 7)
+    # valid: floor((7-3)/2)+1 = 3 ; full: ceil((7-3)/2)+1 = 3... use
+    # asymmetric case: size 8, kernel 3, stride 3
+    x8 = _a(1, 1, 8, 8)
+    v = nd.Pooling(nd.array(x8), kernel=(3, 3), stride=(3, 3),
+                   pool_type="max", pooling_convention="valid")
+    f = nd.Pooling(nd.array(x8), kernel=(3, 3), stride=(3, 3),
+                   pool_type="max", pooling_convention="full")
+    assert v.shape == (1, 1, 2, 2)
+    assert f.shape == (1, 1, 3, 3)
+    # full-convention values: padded windows ignore the pad (max of real)
+    got = f.asnumpy()[0, 0]
+    want_corner = x8[0, 0, 6:8, 6:8].max()
+    np.testing.assert_allclose(got[2, 2], want_corner)
+
+
+def test_avg_pool_count_exclude_pad():
+    x = np.ones((1, 1, 4, 4), np.float32)
+    inc = nd.Pooling(nd.array(x), kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                     pool_type="avg", count_include_pad=True).asnumpy()
+    exc = nd.Pooling(nd.array(x), kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                     pool_type="avg", count_include_pad=False).asnumpy()
+    # corner window has 4 real cells of 9
+    np.testing.assert_allclose(inc[0, 0, 0, 0], 4.0 / 9.0, rtol=1e-6)
+    np.testing.assert_allclose(exc[0, 0, 0, 0], 1.0, rtol=1e-6)
+
+
+def test_pick_modes():
+    """pick with axis and keepdims (test_operator.py test_pick)."""
+    x = _a(4, 5)
+    idx = np.array([0, 4, 2, 1], np.float32)
+    got = nd.pick(nd.array(x), nd.array(idx), axis=1).asnumpy()
+    np.testing.assert_allclose(got, x[np.arange(4), idx.astype(int)])
+    got_k = nd.pick(nd.array(x), nd.array(idx), axis=1,
+                    keepdims=True).asnumpy()
+    assert got_k.shape == (4, 1)
+    # axis=0
+    idx0 = np.array([3, 0, 1, 2, 3], np.float32)
+    got0 = nd.pick(nd.array(x), nd.array(idx0), axis=0).asnumpy()
+    np.testing.assert_allclose(got0, x[idx0.astype(int), np.arange(5)])
+
+
+def test_where_broadcast_condition():
+    """where with 1-D condition selecting rows (test_operator.py
+    test_where: condition.ndim == 1 selects along axis 0)."""
+    cond = np.array([1, 0, 1], np.float32)
+    a, b = _a(3, 4), _a(3, 4)
+    got = nd.where(nd.array(cond), nd.array(a), nd.array(b)).asnumpy()
+    want = np.where(cond[:, None] != 0, a, b)
+    np.testing.assert_allclose(got, want)
+
+
+def test_slice_negative_step():
+    """slice with step=-1 reverses (matrix_op-inl.h SliceParam)."""
+    x = _a(6, 5)
+    got = nd.slice(nd.array(x), begin=(4, None), end=(0, None),
+                   step=(-2, 1)).asnumpy()
+    np.testing.assert_allclose(got, x[4:0:-2, :])
+    got2 = nd.slice(nd.array(x), begin=(None,), end=(None,),
+                    step=(-1,)).asnumpy()
+    np.testing.assert_allclose(got2, x[::-1])
+
+
+def test_clip_gradient_at_bounds():
+    """clip's gradient is 0 outside [a_min, a_max], 1 inside
+    (test_operator.py test_clip)."""
+    x = np.array([-3.0, -1.0, 0.0, 1.0, 3.0], np.float32)
+    a = nd.array(x)
+    a.attach_grad()
+    with mx.autograd.record():
+        y = nd.clip(a, -1.5, 1.5)
+        s = y.sum()
+    s.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(),
+                               np.array([0, 1, 1, 1, 0], np.float32))
+
+
+def test_topk_and_argsort_ties():
+    """Ordering ops on tie-heavy input: values must be correct and
+    indices valid (test_operator.py test_order)."""
+    x = np.array([[1.0, 1.0, 0.0, 2.0, 2.0],
+                  [5.0, 5.0, 5.0, 5.0, 5.0]], np.float32)
+    vals = nd.topk(nd.array(x), k=3, ret_typ="value").asnumpy()
+    np.testing.assert_allclose(vals, -np.sort(-x, axis=-1)[:, :3])
+    idx = nd.topk(nd.array(x), k=3, ret_typ="indices").asnumpy().astype(int)
+    for r in range(2):
+        np.testing.assert_allclose(
+            np.sort(x[r][idx[r]]), np.sort(vals[r]))
+    order = nd.argsort(nd.array(x), axis=-1).asnumpy().astype(int)
+    for r in range(2):
+        assert sorted(order[r].tolist()) == list(range(5))
+        np.testing.assert_allclose(x[r][order[r]], np.sort(x[r]))
+
+
+def test_norm_axes():
+    """norm over ord 1/2 x axis combinations (test_operator.py
+    test_norm)."""
+    x = _a(3, 4, 5)
+    np.testing.assert_allclose(
+        nd.norm(nd.array(x), ord=2, axis=1).asnumpy(),
+        np.sqrt((x ** 2).sum(axis=1)), rtol=1e-5)
+    np.testing.assert_allclose(
+        nd.norm(nd.array(x), ord=1, axis=(1, 2)).asnumpy(),
+        np.abs(x).sum(axis=(1, 2)), rtol=1e-5)
+    np.testing.assert_allclose(
+        float(nd.norm(nd.array(x)).asscalar()),
+        np.sqrt((x.astype(np.float64) ** 2).sum()), rtol=1e-5)
+
+
+def test_repeat_tile_axes():
+    x = _a(2, 3)
+    np.testing.assert_allclose(
+        nd.repeat(nd.array(x), repeats=3, axis=1).asnumpy(),
+        np.repeat(x, 3, axis=1))
+    np.testing.assert_allclose(
+        nd.repeat(nd.array(x), repeats=2).asnumpy(),
+        np.repeat(x.ravel(), 2))
+    np.testing.assert_allclose(
+        nd.tile(nd.array(x), reps=(2, 3)).asnumpy(), np.tile(x, (2, 3)))
+    np.testing.assert_allclose(
+        nd.tile(nd.array(x), reps=(2, 1, 3)).asnumpy(),
+        np.tile(x, (2, 1, 3)))
+
+
+def test_stack_swapaxes_depthspace():
+    x, y = _a(3, 4), _a(3, 4)
+    for axis in (0, 1, 2, -1):
+        np.testing.assert_allclose(
+            nd.stack(nd.array(x), nd.array(y), axis=axis).asnumpy(),
+            np.stack([x, y], axis=axis))
+    z = _a(2, 3, 4, 5)
+    np.testing.assert_allclose(
+        nd.swapaxes(nd.array(z), dim1=1, dim2=3).asnumpy(),
+        np.swapaxes(z, 1, 3))
+    # depth_to_space/space_to_depth round trip (matrix_op.cc)
+    d = _a(1, 12, 2, 3)
+    d2s = nd.depth_to_space(nd.array(d), block_size=2)
+    assert d2s.shape == (1, 3, 4, 6)
+    back = nd.space_to_depth(d2s, block_size=2)
+    np.testing.assert_allclose(back.asnumpy(), d)
+
+
+def test_one_hot_shapes_and_values():
+    idx = np.array([[0, 2], [1, 3]], np.float32)
+    got = nd.one_hot(nd.array(idx), depth=4, on_value=5.0,
+                     off_value=-1.0).asnumpy()
+    assert got.shape == (2, 2, 4)
+    want = np.full((2, 2, 4), -1.0, np.float32)
+    for i in range(2):
+        for j in range(2):
+            want[i, j, int(idx[i, j])] = 5.0
+    np.testing.assert_allclose(got, want)
+
+
+def test_reverse_and_flip():
+    x = _a(3, 4, 5)
+    np.testing.assert_allclose(
+        nd.reverse(nd.array(x), axis=1).asnumpy(), x[:, ::-1, :])
+    np.testing.assert_allclose(
+        nd.reverse(nd.array(x), axis=(0, 2)).asnumpy(), x[::-1, :, ::-1])
+
+
+def test_slice_channel_uneven_squeeze():
+    """SliceChannel with squeeze_axis (slice_channel.cc)."""
+    x = _a(2, 3, 4)
+    outs = nd.SliceChannel(nd.array(x), num_outputs=3, axis=1,
+                           squeeze_axis=True)
+    assert len(outs) == 3 and outs[0].shape == (2, 4)
+    for i, o in enumerate(outs):
+        np.testing.assert_allclose(o.asnumpy(), x[:, i, :])
+
+
+def test_broadcast_like_and_pad():
+    x = _a(1, 4, 1)
+    like = _a(3, 4, 5)
+    got = nd.broadcast_like(nd.array(x), nd.array(like))
+    assert got.shape == (3, 4, 5)
+    np.testing.assert_allclose(got.asnumpy(),
+                               np.broadcast_to(x, (3, 4, 5)))
+    # pad op: edge + constant modes (pad.cc)
+    z = _a(1, 1, 3, 3)
+    pc = nd.pad(nd.array(z), mode="constant",
+                pad_width=(0, 0, 0, 0, 1, 1, 2, 2),
+                constant_value=7.0).asnumpy()
+    assert pc.shape == (1, 1, 5, 7)
+    assert (pc[0, 0, 0] == 7.0).all()
+    pe = nd.pad(nd.array(z), mode="edge",
+                pad_width=(0, 0, 0, 0, 1, 1, 1, 1)).asnumpy()
+    np.testing.assert_allclose(pe[0, 0, 0, 1:-1], z[0, 0, 0])
+
+
+def test_expand_squeeze_roundtrip():
+    x = _a(3, 4)
+    e = nd.expand_dims(nd.array(x), axis=1)
+    assert e.shape == (3, 1, 4)
+    s = nd.squeeze(e, axis=1)
+    assert s.shape == (3, 4)
+    np.testing.assert_allclose(s.asnumpy(), x)
+    # squeeze with no axis removes all size-1 dims
+    y = nd.array(_a(1, 3, 1, 4))
+    assert nd.squeeze(y).shape == (3, 4)
+
+
+def test_elemwise_grad_chain_second_order():
+    """Higher-order: d2/dx2 of x^3 via two grad passes
+    (test_higher_order_grad.py analog)."""
+    from mxnet_tpu import autograd
+    x = nd.array(np.array([1.0, 2.0, 3.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+        dy = autograd.grad(y.sum(), [x], create_graph=True)[0]
+        s = dy.sum()
+    s.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(),
+                               6.0 * np.array([1.0, 2.0, 3.0]), rtol=1e-5)
+
+
+def test_batchnorm_large_mean_precision():
+    """BN must normalize correctly for large-mean/small-variance channels
+    — the regime where one-pass E[x^2]-E[x]^2 variance catastrophically
+    cancels (caught in r4 review; pins the two-pass f32 implementation)."""
+    from mxnet_tpu.ndarray import invoke
+    x = (1000.0 + rng.randn(64, 4, 8, 8) * 0.01).astype(np.float32)
+    gamma = np.ones(4, np.float32)
+    beta = np.zeros(4, np.float32)
+    with mx.autograd.train_mode():
+        out = invoke("BatchNorm",
+                     [nd.array(x), nd.array(gamma), nd.array(beta),
+                      nd.array(np.zeros(4, np.float32)),
+                      nd.array(np.ones(4, np.float32))],
+                     {"eps": 1e-5, "fix_gamma": False})
+    o = (out[0] if isinstance(out, list) else out).asnumpy()
+    # normalized output: per-channel mean ~0, std ~1
+    assert abs(o.mean()) < 1e-2, o.mean()
+    assert abs(o.std() - 1.0) < 0.05, o.std()
