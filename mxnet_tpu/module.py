@@ -10,6 +10,7 @@ batch axis (DataParallelExecutorGroup._load_data semantics).
 from __future__ import annotations
 
 import logging
+import os
 import time
 
 import numpy as np
@@ -232,8 +233,9 @@ class Module(BaseModule):
         self._data_names = list(data_names) if data_names else []
         self._label_names = list(label_names) if label_names else []
         self._fixed_param_names = list(fixed_param_names or [])
+        self._state_names = list(state_names or [])
         arg_names = symbol.list_arguments()
-        input_names = self._data_names + self._label_names
+        input_names = self._data_names + self._label_names + self._state_names
         self._param_names = [n for n in arg_names if n not in input_names]
         self._aux_names = symbol.list_auxiliary_states()
         self._arg_params = None
@@ -298,7 +300,7 @@ class Module(BaseModule):
             if name in self._data_names:
                 grad_req_dict[name] = "write" if inputs_need_grad else "null"
             elif name in self._label_names or name in self._fixed_param_names \
-                    or not for_training:
+                    or name in self._state_names or not for_training:
                 grad_req_dict[name] = "null"
             else:
                 grad_req_dict[name] = grad_req
@@ -339,8 +341,34 @@ class Module(BaseModule):
                 arr[:] = self._aux_params[name]
             else:
                 initializer(InitDesc(name), arr)
+        for name in self._state_names:
+            # initial states (RNN hidden/cell): zeros until set_states
+            self._exec.arg_dict[name][:] = 0
         self._sync_params_from_exec()
         self.params_initialized = True
+
+    def set_states(self, states=None, value=None):
+        """Set value of states (parity: module.py set_states). ``states``
+        is a list of NDArrays ordered like state_names, or ``value`` is a
+        scalar broadcast to every state. Exactly one must be given."""
+        assert self.binded and self._state_names
+        if (states is None) == (value is None):
+            raise MXNetError(
+                "set_states takes exactly one of states= or value=")
+        if states is not None:
+            if len(states) != len(self._state_names):
+                raise MXNetError(
+                    f"set_states got {len(states)} arrays for "
+                    f"{len(self._state_names)} states {self._state_names}")
+            for name, arr in zip(self._state_names, states):
+                self._exec.arg_dict[name][:] = arr
+        else:
+            for name in self._state_names:
+                self._exec.arg_dict[name][:] = value
+
+    def get_states(self, merge_multi_context=True):
+        assert self.binded and self._state_names
+        return [self._exec.arg_dict[n].copy() for n in self._state_names]
 
     def get_params(self):
         """(arg_params, aux_params) on cpu (parity: module.py get_params)."""
@@ -378,9 +406,17 @@ class Module(BaseModule):
             opt_kw = dict(optimizer_params or ())
             # loss-layer ops (SoftmaxOutput, *RegressionOutput) emit
             # batch-SUMMED gradients; the optimizer normalizes
-            # (parity: module.py:506 rescale_grad = 1.0/batch_size)
+            # (parity: module.py:503-506 — and a dist_sync server SUMS
+            # worker pushes before updating, so the divisor is the
+            # GLOBAL batch)
             if "rescale_grad" not in opt_kw and self._data_shapes:
                 batch = self._data_shapes[0][1][0]
+                kv_type = kvstore if isinstance(kvstore, str) else \
+                    getattr(kvstore, "type", "")
+                if "dist" in (kv_type or "") and "_async" not in kv_type:
+                    nw = kvstore.num_workers if not isinstance(kvstore, str) \
+                        else int(os.environ.get("DMLC_NUM_WORKER", 1))
+                    batch *= nw
                 if batch:
                     opt_kw["rescale_grad"] = 1.0 / batch
             optimizer = opt_mod.create(
@@ -521,6 +557,7 @@ class BucketingModule(BaseModule):
         self._sym_gen = sym_gen
         self._context = context
         self._fixed_param_names = fixed_param_names
+        self._state_names = list(state_names or [])
         self._buckets = {}
         self._curr_module = None
         self._curr_bucket_key = None
@@ -540,7 +577,8 @@ class BucketingModule(BaseModule):
         sym, data_names, label_names = self._sym_gen(bucket_key)
         mod = Module(sym, data_names, label_names, logger=self.logger,
                      context=self._context,
-                     fixed_param_names=self._fixed_param_names)
+                     fixed_param_names=self._fixed_param_names,
+                     state_names=self._state_names)
         self._buckets[bucket_key] = mod
         return mod
 
