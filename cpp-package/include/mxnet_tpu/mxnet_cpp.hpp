@@ -1,0 +1,12 @@
+// Umbrella header (parity: reference cpp-package/include/mxnet-cpp/
+// MxNetCpp.h).  Fluent C++ API over the training-capable C ABI
+// (src/c_api.h): value-semantic NDArray, Operator builder, generated
+// wrappers for every registered op, autograd scope.
+#ifndef MXNET_TPU_CPP_MXNET_CPP_HPP_
+#define MXNET_TPU_CPP_MXNET_CPP_HPP_
+
+#include "ndarray.hpp"
+#include "operator.hpp"
+#include "op.hpp"
+
+#endif  // MXNET_TPU_CPP_MXNET_CPP_HPP_

@@ -1,0 +1,128 @@
+// C++ NDArray over the general C API (parity: reference
+// cpp-package/include/mxnet-cpp/ndarray.h, re-based on src/c_api.h —
+// the training-capable ABI, not just predict).
+//
+// Handles are shared_ptr-managed (MXNDArrayFree deleter), so NDArray is
+// cheap to copy and value-semantic like the reference class.
+#ifndef MXNET_TPU_CPP_NDARRAY_HPP_
+#define MXNET_TPU_CPP_NDARRAY_HPP_
+
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "../../../src/c_api.h"
+
+namespace mxnet_tpu {
+namespace cpp {
+
+inline void Check(int rc) {
+  if (rc != 0) throw std::runtime_error(MXGetLastError());
+}
+
+struct Context {
+  int dev_type;
+  int dev_id;
+  static Context cpu(int id = 0) { return {1, id}; }
+  static Context gpu(int id = 0) { return {2, id}; }
+  static Context tpu(int id = 0) { return {6, id}; }
+};
+
+class NDArray {
+ public:
+  NDArray() = default;
+
+  explicit NDArray(NDArrayHandle h) { reset(h); }
+
+  NDArray(const std::vector<mx_uint>& shape, Context ctx = Context::cpu(),
+          int dtype = 0) {
+    NDArrayHandle h = nullptr;
+    Check(MXNDArrayCreateEx(shape.data(),
+                            static_cast<mx_uint>(shape.size()),
+                            ctx.dev_type, ctx.dev_id, 0, dtype, &h));
+    reset(h);
+  }
+
+  NDArray(const float* data, const std::vector<mx_uint>& shape,
+          Context ctx = Context::cpu())
+      : NDArray(shape, ctx) {
+    SyncCopyFromCPU(data, Size());
+  }
+
+  NDArray(const std::vector<float>& data, const std::vector<mx_uint>& shape,
+          Context ctx = Context::cpu())
+      : NDArray(data.data(), shape, ctx) {}
+
+  bool IsNull() const { return !blob_; }
+  NDArrayHandle GetHandle() const { return blob_.get(); }
+
+  void SyncCopyFromCPU(const float* data, size_t n) {
+    Check(MXNDArraySyncCopyFromCPU(GetHandle(), data, n * sizeof(float)));
+  }
+
+  void SyncCopyToCPU(float* data, size_t n) const {
+    Check(MXNDArraySyncCopyToCPU(GetHandle(), data, n * sizeof(float)));
+  }
+
+  std::vector<float> CopyToVector() const {
+    std::vector<float> out(Size());
+    SyncCopyToCPU(out.data(), out.size());
+    return out;
+  }
+
+  std::vector<mx_uint> GetShape() const {
+    mx_uint ndim = 0;
+    const mx_uint* pdata = nullptr;
+    Check(MXNDArrayGetShape(GetHandle(), &ndim, &pdata));
+    return std::vector<mx_uint>(pdata, pdata + ndim);
+  }
+
+  size_t Size() const {
+    size_t n = 1;
+    for (mx_uint d : GetShape()) n *= d;
+    return n;
+  }
+
+  int GetDType() const {
+    int dt = 0;
+    Check(MXNDArrayGetDType(GetHandle(), &dt));
+    return dt;
+  }
+
+  // autograd: allocate a grad buffer and mark this array trainable
+  // (reference exposes this via python; the C ABI is
+  // MXAutogradMarkVariables — req 1 = write)
+  void AttachGrad() {
+    NDArray g(GetShape());
+    std::vector<float> zeros(g.Size(), 0.0f);
+    g.SyncCopyFromCPU(zeros.data(), zeros.size());
+    NDArrayHandle vh = GetHandle(), gh = g.GetHandle();
+    mx_uint req = 1;
+    Check(MXAutogradMarkVariables(1, &vh, &req, &gh));
+    grad_keepalive_ = g.blob_;
+  }
+
+  NDArray Grad() const {
+    NDArrayHandle out = nullptr;
+    Check(MXNDArrayGetGrad(GetHandle(), &out));
+    return NDArray(out);
+  }
+
+  static void WaitAll() { Check(MXNDArrayWaitAll()); }
+
+ private:
+  void reset(NDArrayHandle h) {
+    blob_ = std::shared_ptr<void>(h, [](void* p) {
+      if (p) MXNDArrayFree(p);
+    });
+  }
+  std::shared_ptr<void> blob_;
+  std::shared_ptr<void> grad_keepalive_;
+};
+
+}  // namespace cpp
+}  // namespace mxnet_tpu
+
+#endif  // MXNET_TPU_CPP_NDARRAY_HPP_

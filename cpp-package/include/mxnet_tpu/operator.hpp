@@ -1,0 +1,136 @@
+// Fluent Operator builder (parity: reference cpp-package/include/
+// mxnet-cpp/operator.h — SetParam/SetInput/Invoke over
+// MXImperativeInvokeEx).  The generated wrappers in op.hpp are sugar
+// over this class, exactly the reference's layering.
+#ifndef MXNET_TPU_CPP_OPERATOR_HPP_
+#define MXNET_TPU_CPP_OPERATOR_HPP_
+
+#include <map>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "ndarray.hpp"
+
+namespace mxnet_tpu {
+namespace cpp {
+
+class Operator {
+ public:
+  explicit Operator(const std::string& op_name) : op_name_(op_name) {}
+
+  template <typename T>
+  Operator& SetParam(const std::string& name, const T& value) {
+    std::ostringstream os;
+    os << value;
+    params_[name] = os.str();
+    return *this;
+  }
+
+  Operator& SetParam(const std::string& name, bool value) {
+    params_[name] = value ? "True" : "False";
+    return *this;
+  }
+
+  Operator& SetInput(const NDArray& arr) {
+    inputs_.push_back(arr);
+    return *this;
+  }
+
+  Operator& PushInput(const NDArray& arr) { return SetInput(arr); }
+
+  Operator& operator()(const NDArray& arr) { return SetInput(arr); }
+
+  // run the op; returns all visible outputs
+  std::vector<NDArray> InvokeMulti(NDArray* out = nullptr) {
+    std::vector<const char*> keys, vals;
+    keys.reserve(params_.size());
+    for (auto& kv : params_) {
+      keys.push_back(kv.first.c_str());
+      vals.push_back(kv.second.c_str());
+    }
+    std::vector<NDArrayHandle> in_handles;
+    in_handles.reserve(inputs_.size());
+    for (auto& a : inputs_) in_handles.push_back(a.GetHandle());
+
+    int num_outputs = 0;
+    NDArrayHandle* outputs = nullptr;
+    NDArrayHandle preallocated[1];
+    NDArrayHandle* outputs_p = nullptr;
+    if (out != nullptr && !out->IsNull()) {
+      num_outputs = 1;
+      preallocated[0] = out->GetHandle();
+      outputs_p = preallocated;
+    }
+    Check(MXImperativeInvokeEx(
+        op_name_.c_str(), static_cast<int>(in_handles.size()),
+        in_handles.data(), &num_outputs,
+        outputs_p ? &outputs_p : &outputs,
+        static_cast<int>(keys.size()), keys.data(), vals.data()));
+    std::vector<NDArray> result;
+    if (out != nullptr && !out->IsNull()) {
+      result.push_back(*out);
+    } else {
+      for (int i = 0; i < num_outputs; ++i)
+        result.emplace_back(outputs[i]);
+    }
+    return result;
+  }
+
+  NDArray Invoke(NDArray* out = nullptr) { return InvokeMulti(out)[0]; }
+
+ private:
+  std::string op_name_;
+  std::map<std::string, std::string> params_;
+  std::vector<NDArray> inputs_;
+};
+
+// arithmetic sugar on NDArray (reference ndarray.h operators route
+// through the same imperative ABI)
+inline NDArray operator+(const NDArray& a, const NDArray& b) {
+  return Operator("elemwise_add").SetInput(a).SetInput(b).Invoke();
+}
+inline NDArray operator-(const NDArray& a, const NDArray& b) {
+  return Operator("elemwise_sub").SetInput(a).SetInput(b).Invoke();
+}
+inline NDArray operator*(const NDArray& a, const NDArray& b) {
+  return Operator("elemwise_mul").SetInput(a).SetInput(b).Invoke();
+}
+inline NDArray operator/(const NDArray& a, const NDArray& b) {
+  return Operator("elemwise_div").SetInput(a).SetInput(b).Invoke();
+}
+inline NDArray operator+(const NDArray& a, float s) {
+  return Operator("_plus_scalar").SetParam("scalar", s).SetInput(a).Invoke();
+}
+inline NDArray operator*(const NDArray& a, float s) {
+  return Operator("_mul_scalar").SetParam("scalar", s).SetInput(a).Invoke();
+}
+
+// autograd scope (reference python autograd.record(); C ABI
+// MXAutogradSetIsRecording/SetIsTraining)
+class AutogradRecord {
+ public:
+  explicit AutogradRecord(bool train_mode = true) {
+    Check(MXAutogradSetIsRecording(1, &prev_rec_));
+    Check(MXAutogradSetIsTraining(train_mode ? 1 : 0, &prev_train_));
+  }
+  ~AutogradRecord() {
+    int dummy;
+    MXAutogradSetIsRecording(prev_rec_, &dummy);
+    MXAutogradSetIsTraining(prev_train_, &dummy);
+  }
+
+ private:
+  int prev_rec_ = 0;
+  int prev_train_ = 0;
+};
+
+inline void Backward(const NDArray& head) {
+  NDArrayHandle h = head.GetHandle();
+  Check(MXAutogradBackward(1, &h, nullptr, 0));
+}
+
+}  // namespace cpp
+}  // namespace mxnet_tpu
+
+#endif  // MXNET_TPU_CPP_OPERATOR_HPP_

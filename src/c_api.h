@@ -1,0 +1,126 @@
+/*
+ * General C API for mxnet_tpu — the training-capable ABI.
+ *
+ * Parity: reference include/mxnet/c_api.h (training-critical subset:
+ * MXNDArray* c_api.h:560+, MXImperativeInvokeEx:1063,
+ * MXAutograd*:1152, MXSymbol*, MXExecutorBind:1993, MXKVStore*).
+ * Implemented by src/c_api.cc over an embedded CPython (see that file).
+ *
+ * Every function returns 0 on success, -1 on error (then
+ * MXGetLastError() describes it) — the reference ABI convention.
+ */
+#ifndef MXNET_TPU_C_API_H_
+#define MXNET_TPU_C_API_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void* NDArrayHandle;
+typedef void* SymbolHandle;
+typedef void* ExecutorHandle;
+typedef void* KVStoreHandle;
+typedef uint32_t mx_uint;
+
+/* ---- misc --------------------------------------------------------------- */
+const char* MXGetLastError(void);
+int MXGetVersion(int* out);
+int MXListAllOpNames(mx_uint* out_size, const char*** out_array);
+
+/* ---- NDArray ------------------------------------------------------------ */
+/* dev_type: 1 cpu, 2 gpu, 6 tpu (context.py codes); delay_alloc ignored.
+ * dtype: 0 f32, 1 f64, 2 f16, 3 u8, 4 i32, 5 i8, 6 i64 (mshadow codes). */
+int MXNDArrayCreateEx(const mx_uint* shape, mx_uint ndim, int dev_type,
+                      int dev_id, int delay_alloc, int dtype,
+                      NDArrayHandle* out);
+int MXNDArrayCreate(const mx_uint* shape, mx_uint ndim, int dev_type,
+                    int dev_id, int delay_alloc, NDArrayHandle* out);
+int MXNDArrayFree(NDArrayHandle handle);
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void* data,
+                             size_t size);
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void* data, size_t size);
+int MXNDArrayGetShape(NDArrayHandle handle, mx_uint* out_dim,
+                      const mx_uint** out_pdata);
+int MXNDArrayGetDType(NDArrayHandle handle, int* out);
+int MXNDArrayWaitAll(void);
+int MXNDArraySave(const char* fname, mx_uint num_args,
+                  NDArrayHandle* args, const char** keys);
+int MXNDArrayLoad(const char* fname, mx_uint* out_size,
+                  NDArrayHandle** out_arr, mx_uint* out_name_size,
+                  const char*** out_names);
+int MXNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle* out);
+
+/* ---- imperative invoke -------------------------------------------------- */
+/* num_outputs/outputs: *num_outputs > 0 with pre-created handles writes
+ * in place; else *outputs receives fresh handles and *num_outputs the
+ * count (reference MXImperativeInvokeEx contract). */
+int MXImperativeInvokeEx(const char* op_name, int num_inputs,
+                         NDArrayHandle* inputs, int* num_outputs,
+                         NDArrayHandle** outputs, int num_params,
+                         const char** param_keys, const char** param_vals);
+
+/* ---- autograd ----------------------------------------------------------- */
+int MXAutogradSetIsRecording(int is_recording, int* prev);
+int MXAutogradSetIsTraining(int train_mode, int* prev);
+int MXAutogradMarkVariables(mx_uint num_var, NDArrayHandle* var_handles,
+                            mx_uint* reqs_array,
+                            NDArrayHandle* grad_handles);
+int MXAutogradBackwardEx(mx_uint num_output, NDArrayHandle* output_handles,
+                         NDArrayHandle* ograd_handles, mx_uint num_variables,
+                         NDArrayHandle* var_handles, int retain_graph,
+                         int create_graph, int is_train,
+                         NDArrayHandle** grad_handles, int** grad_stypes);
+int MXAutogradBackward(mx_uint num_output, NDArrayHandle* output_handles,
+                       NDArrayHandle* ograd_handles, int retain_graph);
+
+/* ---- symbol ------------------------------------------------------------- */
+int MXSymbolCreateVariable(const char* name, SymbolHandle* out);
+int MXSymbolCreateOp(const char* op_name, mx_uint num_param,
+                     const char** keys, const char** vals,
+                     mx_uint num_inputs, SymbolHandle* inputs,
+                     const char* name, SymbolHandle* out);
+int MXSymbolCreateFromJSON(const char* json, SymbolHandle* out);
+int MXSymbolSaveToJSON(SymbolHandle sym, const char** out_json);
+int MXSymbolListArguments(SymbolHandle sym, mx_uint* out_size,
+                          const char*** out_array);
+int MXSymbolListOutputs(SymbolHandle sym, mx_uint* out_size,
+                        const char*** out_array);
+int MXSymbolListAuxiliaryStates(SymbolHandle sym, mx_uint* out_size,
+                                const char*** out_array);
+int MXSymbolFree(SymbolHandle sym);
+
+/* ---- executor ----------------------------------------------------------- */
+int MXExecutorBind(SymbolHandle sym, int dev_type, int dev_id,
+                   mx_uint num_args, const char** arg_names,
+                   NDArrayHandle* arg_arrays, const char** grad_reqs,
+                   mx_uint num_aux, const char** aux_names,
+                   NDArrayHandle* aux_arrays, ExecutorHandle* out);
+int MXExecutorForward(ExecutorHandle handle, int is_train);
+int MXExecutorBackward(ExecutorHandle handle, mx_uint num_grads,
+                       NDArrayHandle* head_grads);
+int MXExecutorOutputs(ExecutorHandle handle, mx_uint* out_size,
+                      NDArrayHandle** out);
+int MXExecutorArgGrad(ExecutorHandle handle, const char* arg_name,
+                      NDArrayHandle* out);
+int MXExecutorFree(ExecutorHandle handle);
+
+/* ---- kvstore ------------------------------------------------------------ */
+int MXKVStoreCreate(const char* type, KVStoreHandle* out);
+int MXKVStoreInit(KVStoreHandle handle, mx_uint num, const int* keys,
+                  NDArrayHandle* vals);
+int MXKVStorePush(KVStoreHandle handle, mx_uint num, const int* keys,
+                  NDArrayHandle* vals, int priority);
+int MXKVStorePull(KVStoreHandle handle, mx_uint num, const int* keys,
+                  NDArrayHandle* vals, int priority);
+int MXKVStoreGetRank(KVStoreHandle handle, int* rank);
+int MXKVStoreGetGroupSize(KVStoreHandle handle, int* size);
+int MXKVStoreFree(KVStoreHandle handle);
+
+#ifdef __cplusplus
+}  /* extern "C" */
+#endif
+
+#endif  /* MXNET_TPU_C_API_H_ */
