@@ -4,7 +4,7 @@
 //
 // Build + run (the test does this automatically):
 //   make -C src capi
-//   g++ -std=c++17 cpp-package/examples/mlp.cpp src/build/c_embed_boot.o \
+//   g++ -std=c++17 cpp-package/examples/mlp.cpp \
 //       -Lsrc/build -lmxnet_tpu_c -Wl,-rpath,src/build $(python3-config \
 //       --embed --ldflags) -o /tmp/mlp && /tmp/mlp
 //

@@ -95,7 +95,7 @@ class NDArray {
   // (reference exposes this via python; the C ABI is
   // MXAutogradMarkVariables — req 1 = write)
   void AttachGrad() {
-    NDArray g(GetShape());
+    NDArray g(GetShape(), Context::cpu(), GetDType());
     std::vector<float> zeros(g.Size(), 0.0f);
     g.SyncCopyFromCPU(zeros.data(), zeros.size());
     NDArrayHandle vh = GetHandle(), gh = g.GetHandle();

@@ -142,8 +142,13 @@ class DataLoader:
     """Loads data from a Dataset, returns mini-batches
     (parity: dataloader.py DataLoader).
 
-    num_workers > 0 forks a pool; batches come back through shared
-    memory.  device_prefetch=True (or a jax device) starts the host→HBM
+    num_workers > 0 runs a worker pool (forkserver start method: fork
+    after jax's XLA threads are live deadlocks — see __init__; like
+    torch DataLoader on spawn platforms, user SCRIPTS therefore need
+    the standard ``if __name__ == "__main__"`` guard; set
+    MXNET_MP_START_METHOD=fork to restore the old behavior for
+    non-picklable datasets).  Batches come back through shared memory.
+    device_prefetch=True (or a jax device) starts the host→HBM
     transfer as soon as a batch is ready instead of when the consumer
     touches it."""
 
@@ -200,7 +205,19 @@ class DataLoader:
                 self._pool = ThreadPool(self._num_workers)
                 _worker_initializer(dataset)
             else:
-                ctx = multiprocessing.get_context("fork")
+                # forkserver, NOT fork: by DataLoader-construction time
+                # jax's XLA thread pools are usually live, and a fork
+                # child inherits their held locks — measured hard
+                # deadlock with the 8-device CPU backend initialized.
+                # The forkserver process is spawned clean (fork+exec) and
+                # children fork from IT; the dataset crosses once by
+                # pickle. MXNET_MP_START_METHOD overrides (fork keeps
+                # the old zero-pickle behavior for non-picklable
+                # datasets created before any jax use).
+                import os as _os
+                method = _os.environ.get("MXNET_MP_START_METHOD",
+                                         "forkserver")
+                ctx = multiprocessing.get_context(method)
                 self._pool = ctx.Pool(self._num_workers,
                                       initializer=_worker_initializer,
                                       initargs=(dataset,))

@@ -1,0 +1,224 @@
+/* XS glue for AI::MXNetTPU — binds the training-capable C ABI
+ * (src/c_api.h) into Perl.  Parity: reference perl-package/AI-MXNet
+ * wraps the same handles; here handles cross as IVs and the .pm layer
+ * wraps them in objects with DESTROY.
+ */
+#define PERL_NO_GET_CONTEXT
+#include "EXTERN.h"
+#include "perl.h"
+#include "XSUB.h"
+
+#include <dlfcn.h>
+
+#include "c_api.h"
+
+static void croak_mx(pTHX) { croak("%s", MXGetLastError()); }
+
+MODULE = AI::MXNetTPU  PACKAGE = AI::MXNetTPU  PREFIX = mxtpu_
+
+PROTOTYPES: DISABLE
+
+BOOT:
+{
+  /* perl dlopens this extension RTLD_LOCAL, which keeps libpython's
+   * symbols private — numpy's own C extensions then fail to resolve
+   * them and the embedded interpreter cannot import numpy.  Promote
+   * libpython to RTLD_GLOBAL before the first C-API call initializes
+   * Python (the same dance every libpython-embedding plugin does). */
+  const char* candidates[] = {
+    "libpython3.12.so.1.0", "libpython3.12.so",
+    "libpython3.11.so.1.0", "libpython3.13.so.1.0", NULL};
+  int i;
+  for (i = 0; candidates[i]; ++i)
+    if (dlopen(candidates[i], RTLD_NOW | RTLD_GLOBAL | RTLD_NOLOAD) ||
+        dlopen(candidates[i], RTLD_NOW | RTLD_GLOBAL))
+      break;
+}
+
+int
+mxtpu__version()
+  CODE:
+    int v = 0;
+    if (MXGetVersion(&v) != 0) croak_mx(aTHX);
+    RETVAL = v;
+  OUTPUT: RETVAL
+
+IV
+mxtpu__nd_create(shape_ref)
+    SV* shape_ref
+  CODE:
+    AV* av = (AV*)SvRV(shape_ref);
+    mx_uint ndim = (mx_uint)(av_len(av) + 1);
+    mx_uint shape[32];
+    for (mx_uint i = 0; i < ndim && i < 32; ++i)
+      shape[i] = (mx_uint)SvUV(*av_fetch(av, i, 0));
+    NDArrayHandle h = NULL;
+    if (MXNDArrayCreateEx(shape, ndim, 1, 0, 0, 0, &h) != 0)
+      croak_mx(aTHX);
+    RETVAL = PTR2IV(h);
+  OUTPUT: RETVAL
+
+void
+mxtpu__nd_free(h)
+    IV h
+  CODE:
+    MXNDArrayFree(INT2PTR(NDArrayHandle, h));
+
+void
+mxtpu__nd_copy_from(h, data_ref)
+    IV h
+    SV* data_ref
+  CODE:
+    AV* av = (AV*)SvRV(data_ref);
+    size_t n = (size_t)(av_len(av) + 1);
+    float* buf = (float*)malloc(n * sizeof(float));
+    for (size_t i = 0; i < n; ++i)
+      buf[i] = (float)SvNV(*av_fetch(av, (SSize_t)i, 0));
+    int rc = MXNDArraySyncCopyFromCPU(INT2PTR(NDArrayHandle, h), buf,
+                                      n * sizeof(float));
+    free(buf);
+    if (rc != 0) croak_mx(aTHX);
+
+SV*
+mxtpu__nd_to_list(h)
+    IV h
+  CODE:
+    NDArrayHandle nh = INT2PTR(NDArrayHandle, h);
+    mx_uint ndim = 0;
+    const mx_uint* shape = NULL;
+    if (MXNDArrayGetShape(nh, &ndim, &shape) != 0) croak_mx(aTHX);
+    size_t n = 1;
+    for (mx_uint i = 0; i < ndim; ++i) n *= shape[i];
+    float* buf = (float*)malloc(n * sizeof(float));
+    if (MXNDArraySyncCopyToCPU(nh, buf, n * sizeof(float)) != 0) {
+      free(buf);
+      croak_mx(aTHX);
+    }
+    AV* out = newAV();
+    for (size_t i = 0; i < n; ++i) av_push(out, newSVnv(buf[i]));
+    free(buf);
+    RETVAL = newRV_noinc((SV*)out);
+  OUTPUT: RETVAL
+
+SV*
+mxtpu__nd_shape(h)
+    IV h
+  CODE:
+    mx_uint ndim = 0;
+    const mx_uint* shape = NULL;
+    if (MXNDArrayGetShape(INT2PTR(NDArrayHandle, h), &ndim, &shape) != 0)
+      croak_mx(aTHX);
+    AV* out = newAV();
+    for (mx_uint i = 0; i < ndim; ++i) av_push(out, newSVuv(shape[i]));
+    RETVAL = newRV_noinc((SV*)out);
+  OUTPUT: RETVAL
+
+SV*
+mxtpu__invoke(op_name, inputs_ref, attrs_ref)
+    const char* op_name
+    SV* inputs_ref
+    SV* attrs_ref
+  CODE:
+    AV* in_av = (AV*)SvRV(inputs_ref);
+    int n_in = (int)(av_len(in_av) + 1);
+    NDArrayHandle inputs[64];
+    for (int i = 0; i < n_in && i < 64; ++i)
+      inputs[i] = INT2PTR(NDArrayHandle, SvIV(*av_fetch(in_av, i, 0)));
+    HV* attrs = (HV*)SvRV(attrs_ref);
+    const char* keys[64];
+    const char* vals[64];
+    int n_attr = 0;
+    hv_iterinit(attrs);
+    HE* he;
+    while ((he = hv_iternext(attrs)) != NULL && n_attr < 64) {
+      STRLEN klen;
+      keys[n_attr] = HePV(he, klen);
+      vals[n_attr] = SvPV_nolen(HeVAL(he));
+      ++n_attr;
+    }
+    int n_out = 0;
+    NDArrayHandle* outputs = NULL;
+    if (MXImperativeInvokeEx(op_name, n_in, inputs, &n_out, &outputs,
+                             n_attr, keys, vals) != 0)
+      croak_mx(aTHX);
+    AV* out = newAV();
+    for (int i = 0; i < n_out; ++i) av_push(out, newSViv(PTR2IV(outputs[i])));
+    RETVAL = newRV_noinc((SV*)out);
+  OUTPUT: RETVAL
+
+void
+mxtpu__invoke_inplace(op_name, inputs_ref, attrs_ref, out_h)
+    const char* op_name
+    SV* inputs_ref
+    SV* attrs_ref
+    IV out_h
+  CODE:
+    AV* in_av = (AV*)SvRV(inputs_ref);
+    int n_in = (int)(av_len(in_av) + 1);
+    NDArrayHandle inputs[64];
+    for (int i = 0; i < n_in && i < 64; ++i)
+      inputs[i] = INT2PTR(NDArrayHandle, SvIV(*av_fetch(in_av, i, 0)));
+    HV* attrs = (HV*)SvRV(attrs_ref);
+    const char* keys[64];
+    const char* vals[64];
+    int n_attr = 0;
+    hv_iterinit(attrs);
+    HE* he;
+    while ((he = hv_iternext(attrs)) != NULL && n_attr < 64) {
+      STRLEN klen;
+      keys[n_attr] = HePV(he, klen);
+      vals[n_attr] = SvPV_nolen(HeVAL(he));
+      ++n_attr;
+    }
+    int n_out = 1;
+    NDArrayHandle pre[1] = {INT2PTR(NDArrayHandle, out_h)};
+    NDArrayHandle* outputs = pre;
+    if (MXImperativeInvokeEx(op_name, n_in, inputs, &n_out, &outputs,
+                             n_attr, keys, vals) != 0)
+      croak_mx(aTHX);
+
+void
+mxtpu__set_recording(flag)
+    int flag
+  CODE:
+    int prev = 0;
+    if (MXAutogradSetIsRecording(flag, &prev) != 0) croak_mx(aTHX);
+    if (MXAutogradSetIsTraining(flag, &prev) != 0) croak_mx(aTHX);
+
+void
+mxtpu__mark_variable(var_h, grad_h)
+    IV var_h
+    IV grad_h
+  CODE:
+    NDArrayHandle v = INT2PTR(NDArrayHandle, var_h);
+    NDArrayHandle g = INT2PTR(NDArrayHandle, grad_h);
+    mx_uint req = 1;
+    if (MXAutogradMarkVariables(1, &v, &req, &g) != 0) croak_mx(aTHX);
+
+void
+mxtpu__backward(h)
+    IV h
+  CODE:
+    NDArrayHandle nh = INT2PTR(NDArrayHandle, h);
+    if (MXAutogradBackward(1, &nh, NULL, 0) != 0) croak_mx(aTHX);
+
+IV
+mxtpu__grad(h)
+    IV h
+  CODE:
+    NDArrayHandle out = NULL;
+    if (MXNDArrayGetGrad(INT2PTR(NDArrayHandle, h), &out) != 0)
+      croak_mx(aTHX);
+    RETVAL = PTR2IV(out);
+  OUTPUT: RETVAL
+
+SV*
+mxtpu__list_ops()
+  CODE:
+    mx_uint n = 0;
+    const char** names = NULL;
+    if (MXListAllOpNames(&n, &names) != 0) croak_mx(aTHX);
+    AV* out = newAV();
+    for (mx_uint i = 0; i < n; ++i) av_push(out, newSVpv(names[i], 0));
+    RETVAL = newRV_noinc((SV*)out);
+  OUTPUT: RETVAL

@@ -1,0 +1,55 @@
+#!/usr/bin/env perl
+# Linear regression trained from Perl end-to-end: imperative ops +
+# autograd + sgd_update through AI::MXNetTPU (parity: the reference
+# perl-package AI-MXNet examples).  Prints PASS only on convergence.
+use strict;
+use warnings;
+use FindBin;
+use lib "$FindBin::Bin/../blib/lib", "$FindBin::Bin/../blib/arch";
+use AI::MXNetTPU;
+
+my ($n, $d) = (64, 4);
+my @true_w = (1.5, -2.0, 0.5, 3.0);
+srand(7);
+my (@xs, @ys);
+for my $i (0 .. $n - 1) {
+    my $y = 0.0;
+    for my $j (0 .. $d - 1) {
+        my $v = rand(2.0) - 1.0;
+        push @xs, $v;
+        $y += $v * $true_w[$j];
+    }
+    push @ys, $y;
+}
+
+my $x = AI::MXNetTPU::NDArray->new([$n, $d], \@xs);
+my $y = AI::MXNetTPU::NDArray->new([$n, 1], \@ys);
+my $w = AI::MXNetTPU::NDArray->new([1, $d], [(0.0) x $d]);
+$w->attach_grad;
+
+my ($first, $last);
+for my $epoch (0 .. 59) {
+    my $loss = AI::MXNetTPU::record(sub {
+        my ($pred) = AI::MXNetTPU::invoke(
+            'FullyConnected', [$x, $w], {num_hidden => 1, no_bias => 'True'});
+        my ($diff) = AI::MXNetTPU::invoke('elemwise_sub', [$pred, $y]);
+        my ($sq)   = AI::MXNetTPU::invoke('square', [$diff]);
+        my ($m)    = AI::MXNetTPU::invoke('mean', [$sq]);
+        return $m;
+    });
+    $loss->backward;
+    $w->update_inplace('sgd_update', [$w, $w->grad], {lr => 0.5});
+    my ($v) = $loss->to_list;
+    $first = $v if $epoch == 0;
+    $last = $v;
+    printf "epoch %d loss %.6f\n", $epoch, $v if $epoch % 10 == 0;
+}
+printf "first %.6f last %.6f\n", $first, $last;
+my @learned = $w->to_list;
+printf "learned w: %s\n", join(',', map { sprintf '%.3f', $_ } @learned);
+if ($last < 0.01 * $first) {
+    print "PASS\n";
+    exit 0;
+}
+print "FAIL\n";
+exit 1;

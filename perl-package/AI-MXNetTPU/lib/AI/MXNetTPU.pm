@@ -1,0 +1,103 @@
+package AI::MXNetTPU;
+# Perl binding for mxnet_tpu (parity: reference perl-package/AI-MXNet,
+# minimal surface) — NDArray + imperative operator invoke + autograd
+# over the training C ABI (src/c_api.h), via the XS glue in MXNetTPU.xs.
+use strict;
+use warnings;
+use XSLoader;
+
+our $VERSION = '0.01';
+XSLoader::load('AI::MXNetTPU', $VERSION);
+
+sub version { return _version() }
+sub list_ops { return @{ _list_ops() } }
+
+# autograd scope: AI::MXNetTPU::record(sub { ... })
+sub record {
+    my ($code) = @_;
+    _set_recording(1);
+    my @r = eval { $code->() };
+    _set_recording(0);
+    die $@ if $@;
+    return wantarray ? @r : $r[0];
+}
+
+# invoke(op, \@ndarrays, \%attrs) -> list of NDArrays
+sub invoke {
+    my ($op, $inputs, $attrs) = @_;
+    $attrs ||= {};
+    my @handles = map { $_->{h} } @$inputs;
+    my $outs = _invoke($op, \@handles, $attrs);
+    return map { AI::MXNetTPU::NDArray->_wrap($_) } @$outs;
+}
+
+package AI::MXNetTPU::NDArray;
+use strict;
+use warnings;
+
+# ->new([2,3])  or  ->new([2,3], [1,2,3,4,5,6])
+sub new {
+    my ($class, $shape, $data) = @_;
+    my $h = AI::MXNetTPU::_nd_create($shape);
+    my $self = bless { h => $h, own => 1 }, $class;
+    $self->copy_from($data) if $data;
+    return $self;
+}
+
+sub _wrap {
+    my ($class, $h) = @_;
+    return bless { h => $h, own => 1 }, $class;
+}
+
+sub copy_from { my ($self, $data) = @_;
+                AI::MXNetTPU::_nd_copy_from($self->{h}, $data); $self }
+sub to_list   { my ($self) = @_;
+                return @{ AI::MXNetTPU::_nd_to_list($self->{h}) } }
+sub shape     { my ($self) = @_;
+                return @{ AI::MXNetTPU::_nd_shape($self->{h}) } }
+
+sub attach_grad {
+    my ($self) = @_;
+    my @shape = $self->shape;
+    my $size = 1; $size *= $_ for @shape;
+    my $g = AI::MXNetTPU::NDArray->new([@shape], [(0) x $size]);
+    AI::MXNetTPU::_mark_variable($self->{h}, $g->{h});
+    $self->{grad_keepalive} = $g;   # the tape holds the buffer; keep it
+    return $self;
+}
+
+sub backward { my ($self) = @_;
+               AI::MXNetTPU::_backward($self->{h}); $self }
+sub grad {
+    my ($self) = @_;
+    return AI::MXNetTPU::NDArray->_wrap(AI::MXNetTPU::_grad($self->{h}));
+}
+
+# in-place update: $w->update_inplace('sgd_update', [$w, $g], {lr=>0.1})
+sub update_inplace {
+    my ($self, $op, $inputs, $attrs) = @_;
+    my @handles = map { $_->{h} } @$inputs;
+    AI::MXNetTPU::_invoke_inplace($op, \@handles, $attrs || {}, $self->{h});
+    return $self;
+}
+
+sub DESTROY {
+    my ($self) = @_;
+    AI::MXNetTPU::_nd_free($self->{h}) if $self->{own} && $self->{h};
+}
+
+1;
+__END__
+
+=head1 NAME
+
+AI::MXNetTPU - Perl binding for the mxnet_tpu training C ABI
+
+=head1 SYNOPSIS
+
+  use AI::MXNetTPU;
+  my $x = AI::MXNetTPU::NDArray->new([2, 2], [1, 2, 3, 4]);
+  my ($y) = AI::MXNetTPU::invoke('elemwise_add', [$x, $x]);
+  print join(',', $y->to_list), "\n";   # 2,4,6,8
+
+=cut

@@ -110,3 +110,20 @@ def test_convert_hybrid_block():
            for p in net.collect_params().values()}
     assert all(d == "bfloat16" for n, d in dts.items() if "weight" in n)
     assert all(d == "float32" for n, d in dts.items() if "bias" in n)
+
+
+def test_amp_lists_name_real_ops():
+    """Every name in amp/lists.py is a registered op (r03 verdict: the
+    lists once named SVMOutput before it existed; this pins them to the
+    live registry so entries cannot rot)."""
+    from mxnet_tpu.ops import registry
+    from mxnet_tpu.amp import lists
+    all_names = set()
+    for attr in dir(lists):
+        val = getattr(lists, attr)
+        if isinstance(val, (list, tuple, set, frozenset)) and \
+                not attr.startswith("_"):
+            all_names |= set(val)
+    assert all_names, "amp lists unexpectedly empty"
+    missing = sorted(n for n in all_names if not registry.exists(n))
+    assert not missing, f"amp lists name unregistered ops: {missing}"
