@@ -1111,3 +1111,41 @@ def _group_adagrad_update(attrs, weight, grad, history):
     hist_new = history + jnp.mean(g * g, axis=red, keepdims=True)
     # eps INSIDE the sqrt (reference GroupAdagradDnsRspDnsImpl)
     return weight - lr * g / jnp.sqrt(hist_new + eps), hist_new
+
+
+def _gradientmultiplier_grad(attrs, primals, cotangents):
+    scalar = float(attrs.get("scalar", 1.0))
+    return (cotangents[0] * scalar,)
+
+
+@register("_contrib_gradientmultiplier",
+          fgradient=_gradientmultiplier_grad,
+          alias=("gradientmultiplier",))
+def _gradientmultiplier(attrs, x):
+    """Identity forward, gradient scaled by `scalar` on backward
+    (reference: contrib/gradient_multiplier_op.cc:73-92 — the
+    gradient-reversal trick for domain-adversarial training when
+    scalar < 0)."""
+    return x
+
+
+@register("_contrib_arange_like", alias=("arange_like",))
+def _arange_like(attrs, x):
+    """Evenly spaced values shaped by the input (reference:
+    tensor/init_op.cc:104 _contrib_arange_like, RangeLikeParam
+    init_op.h:177). axis=None fills the whole (flattened) shape;
+    otherwise the length follows that axis."""
+    start = float(attrs.get("start", 0.0))
+    step = float(attrs.get("step", 1.0))
+    repeat = int(attrs.get("repeat", 1))
+    axis = attrs.get("axis")
+    if axis is None:
+        n = 1
+        for d in x.shape:
+            n *= d
+        vals = start + step * (jnp.arange(n, dtype=jnp.float32) // repeat)
+        return vals.reshape(x.shape)
+    ax = int(axis) % x.ndim
+    n = x.shape[ax]
+    vals = start + step * (jnp.arange(n, dtype=jnp.float32) // repeat)
+    return vals

@@ -271,3 +271,32 @@ class TestPSROIPooling:
             output_dim=out_dim, pooled_size=pooled).asnumpy()
         assert out.shape == (1, out_dim, pooled, pooled)
         np.testing.assert_allclose(out, 5.0, rtol=1e-5)
+
+
+def test_gradientmultiplier():
+    """Identity forward; backward scales (and with scalar<0 REVERSES)
+    the gradient — reference contrib/gradient_multiplier_op.cc:73."""
+    x = nd.array(np.array([[1.0, -2.0], [3.0, 0.5]], np.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = nd.contrib.gradientmultiplier(x, scalar=-0.25)
+        s = (y * y).sum()
+    s.backward()
+    np.testing.assert_allclose(y.asnumpy(), x.asnumpy())
+    np.testing.assert_allclose(x.grad.asnumpy(), -0.25 * 2 * x.asnumpy(),
+                               rtol=1e-6)
+
+
+def test_arange_like():
+    """arange shaped by input (reference tensor/init_op.cc
+    _contrib_arange_like:104)."""
+    x = nd.zeros((2, 3))
+    np.testing.assert_allclose(
+        nd.contrib.arange_like(x).asnumpy(),
+        np.arange(6, dtype=np.float32).reshape(2, 3))
+    np.testing.assert_allclose(
+        nd.contrib.arange_like(x, axis=-1, start=2, step=3).asnumpy(),
+        np.array([2.0, 5.0, 8.0], np.float32))
+    np.testing.assert_allclose(
+        nd.contrib.arange_like(x, axis=0, repeat=1, step=0.5).asnumpy(),
+        np.array([0.0, 0.5], np.float32))

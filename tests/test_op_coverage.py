@@ -622,7 +622,8 @@ EXEMPT = {
     "ROIPooling": "test_contrib_ops.py",
     "_contrib_flash_attention": "test_tp_ring.py",
     "_contrib_boolean_mask": "test_operator.py",
-    "_contrib_arange_like": "test_operator.py",
+    "_contrib_arange_like": "test_contrib_ops2.py",
+    "_contrib_gradientmultiplier": "test_contrib_ops2.py",
     "_contrib_AdaptiveAvgPooling2D": "test_contrib_ops2.py",
     "_contrib_BilinearResize2D": "test_contrib_ops2.py",
     "_contrib_DeformableConvolution": "test_contrib_ops2.py",
