@@ -106,6 +106,105 @@ def consistency_devices():
     return devs
 
 
+def rand_shape_2d(dim0=10, dim1=10):
+    """Random 2-D shape (parity: test_utils.py rand_shape_2d)."""
+    return (np.random.randint(1, dim0 + 1), np.random.randint(1, dim1 + 1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return (np.random.randint(1, dim0 + 1), np.random.randint(1, dim1 + 1),
+            np.random.randint(1, dim2 + 1))
+
+
+def rand_shape_nd(num_dim, dim=10):
+    return tuple(np.random.randint(1, dim + 1, size=num_dim))
+
+
+def almost_equal(a, b, rtol=1e-5, atol=1e-7):
+    a = a.asnumpy() if isinstance(a, nd.NDArray) else np.asarray(a)
+    b = b.asnumpy() if isinstance(b, nd.NDArray) else np.asarray(b)
+    return np.allclose(a, b, rtol=rtol, atol=atol)
+
+
+def almost_equal_ignore_nan(a, b, rtol=1e-5, atol=1e-7):
+    """Equality where positions that are NaN in BOTH arrays match
+    (parity: test_utils.py almost_equal_ignore_nan)."""
+    a = a.asnumpy() if isinstance(a, nd.NDArray) else np.asarray(a)
+    b = b.asnumpy() if isinstance(b, nd.NDArray) else np.asarray(b)
+    nan_mask = np.isnan(a)
+    if not np.array_equal(nan_mask, np.isnan(b)):
+        return False
+    return np.allclose(a[~nan_mask], b[~nan_mask], rtol=rtol, atol=atol)
+
+
+def assert_exception(f, exception_type, *args, **kwargs):
+    """f(*args, **kwargs) must raise exception_type (parity:
+    test_utils.py assert_exception)."""
+    try:
+        f(*args, **kwargs)
+    except exception_type:
+        return
+    raise AssertionError(
+        f"{f} did not raise {exception_type.__name__}")
+
+
+def check_symbolic_forward(sym, inputs, expected, rtol=1e-4, atol=1e-5,
+                           aux_states=None, ctx=None):
+    """Bind a symbol with the given input arrays and compare every output
+    (parity: test_utils.py check_symbolic_forward — the workhorse of the
+    reference's test_operator.py)."""
+    from .context import cpu as _cpu
+    ctx = ctx or _cpu()
+    arg_names = sym.list_arguments()
+    args = {n: nd.array(np.asarray(x, np.float32))
+            for n, x in zip(arg_names, inputs)}
+    aux = None
+    if aux_states is not None:
+        aux = {n: nd.array(np.asarray(x, np.float32))
+               for n, x in zip(sym.list_auxiliary_states(), aux_states)}
+    ex = sym.bind(ctx, args, aux_states=aux)
+    outs = ex.forward()
+    expected = expected if isinstance(expected, (list, tuple)) else [expected]
+    for o, w in zip(outs, expected):
+        np.testing.assert_allclose(o.asnumpy().astype(np.float64),
+                                   np.asarray(w, np.float64),
+                                   rtol=rtol, atol=atol)
+    return [o.asnumpy() for o in outs]
+
+
+def check_symbolic_backward(sym, inputs, out_grads, expected_grads,
+                            rtol=1e-4, atol=1e-5, ctx=None):
+    """Bind, forward, backward with given head gradients, compare arg
+    grads in list_arguments order (parity: test_utils.py
+    check_symbolic_backward)."""
+    from .context import cpu as _cpu
+    ctx = ctx or _cpu()
+    arg_names = sym.list_arguments()
+    args = {n: nd.array(np.asarray(x, np.float32))
+            for n, x in zip(arg_names, inputs)}
+    grads = {n: nd.zeros(a.shape, dtype=a.dtype)
+             for n, a in args.items()}
+    ex = sym.bind(ctx, args, args_grad=grads, grad_req="write")
+    ex.forward(is_train=True)
+    ograds = [nd.array(np.asarray(g, np.float32))
+              for g in (out_grads if isinstance(out_grads, (list, tuple))
+                        else [out_grads])]
+    ex.backward(ograds if len(ograds) > 1 else ograds[0])
+    expected = expected_grads if isinstance(expected_grads, (list, tuple)) \
+        else [expected_grads]
+    got = []
+    for n, w in zip(arg_names, expected):
+        if w is None:
+            continue
+        g = ex.grad_dict[n]
+        np.testing.assert_allclose(g.asnumpy().astype(np.float64),
+                                   np.asarray(w, np.float64),
+                                   rtol=rtol, atol=atol,
+                                   err_msg=f"grad mismatch for {n}")
+        got.append(g.asnumpy())
+    return got
+
+
 def get_mnist_like(num_train=3000, num_val=500, translate=False, seed=7):
     """Synthetic MNIST-shaped classification data for convergence gates.
 

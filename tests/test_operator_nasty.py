@@ -374,3 +374,31 @@ def test_batchnorm_large_mean_precision():
     # normalized output: per-channel mean ~0, std ~1
     assert abs(o.mean()) < 1e-2, o.mean()
     assert abs(o.std() - 1.0) < 0.05, o.std()
+
+
+def test_check_symbolic_helpers():
+    """check_symbolic_forward/backward (reference test_utils.py:
+    the workhorse harness of test_operator.py) drive real symbols."""
+    from mxnet_tpu import test_utils as tu
+
+    d = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(d, num_hidden=2, no_bias=True, name="fc")
+    x = np.arange(6, dtype=np.float32).reshape(2, 3)
+    W = np.array([[1, 0, 0], [0, 1, 0]], np.float32)
+    tu.check_symbolic_forward(fc, [x, W], [x @ W.T])
+    og = rng.randn(2, 2).astype(np.float32)
+    tu.check_symbolic_backward(fc, [x, W], [og], [og @ W, og.T @ x])
+
+    # activation: analytic grad at positive/negative points
+    act = mx.sym.Activation(d, act_type="tanh")
+    xv = _a(3, 4)
+    tu.check_symbolic_forward(act, [xv], [np.tanh(xv)])
+    og = np.ones((3, 4), np.float32)
+    tu.check_symbolic_backward(act, [xv], [og], [1 - np.tanh(xv) ** 2])
+
+    # misc helpers
+    assert tu.almost_equal([1.0], [1.0 + 1e-9])
+    nan_a = np.array([1.0, np.nan], np.float32)
+    assert tu.almost_equal_ignore_nan(nan_a, nan_a.copy())
+    tu.assert_exception(lambda: nd.zeros((2,)).reshape((3,)), Exception)
+    assert len(tu.rand_shape_nd(4)) == 4
