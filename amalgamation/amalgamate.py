@@ -1,0 +1,56 @@
+#!/usr/bin/env python
+"""Amalgamation generator (parity: reference amalgamation/ — the script
+that concatenates the predict-only C API into ONE .cc so any project can
+vendor a single file).
+
+Produces mxnet_tpu_predict-all.cc from src/c_embed.h + src/c_predict_api.h
++ src/c_predict_api.cc with local includes inlined exactly once; `make`
+in this directory builds ../lib/libmxnet_tpu_predict.so from it.
+
+Unlike the reference (which amalgamates ~100k LoC of kernels), the
+predict runtime here is the embedded-interpreter shim — the compute
+engine is jax/XLA behind it — so the single file is small; the point is
+identical: one vendorable translation unit for the predict ABI.
+"""
+import os
+import re
+import sys
+
+SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "src")
+ORDER = ["c_predict_api.h", "c_embed.h", "c_predict_api.cc"]
+_LOCAL_INC = re.compile(r'^\s*#include\s+"([^"]+)"')
+
+
+def amalgamate():
+    seen = set()
+    out = ["// GENERATED single-file predict library "
+           "(amalgamation/amalgamate.py).\n"
+           "// Build: g++ -O2 -fPIC -shared mxnet_tpu_predict-all.cc "
+           "$(python3-config --embed --includes --ldflags) -o "
+           "libmxnet_tpu_predict.so\n"]
+    for name in ORDER:
+        path = os.path.join(SRC, name)
+        out.append(f"\n// ===== begin {name} =====\n")
+        for line in open(path):
+            m = _LOCAL_INC.match(line)
+            if m:
+                inc = os.path.basename(m.group(1))
+                if inc in seen or inc in ORDER:
+                    out.append(f"// [amalgamated] {line}")
+                    continue
+                seen.add(inc)
+            out.append(line)
+        out.append(f"// ===== end {name} =====\n")
+        seen.add(name)
+    return "".join(out)
+
+
+if __name__ == "__main__":
+    dst = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "mxnet_tpu_predict-all.cc")
+    text = amalgamate()
+    with open(dst, "w") as f:
+        f.write(text)
+    print(f"wrote {dst} ({len(text.splitlines())} lines)")

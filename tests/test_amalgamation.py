@@ -1,0 +1,83 @@
+"""Amalgamation build test (amalgamation/; parity: reference
+amalgamation/ — single-file predict-only library any project can
+vendor).  Generates mxnet_tpu_predict-all.cc, builds
+lib/libmxnet_tpu_predict.so from that ONE file, and runs a prediction
+through it via ctypes."""
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_LIB = os.path.join(_REPO, "lib", "libmxnet_tpu_predict.so")
+
+
+def _build():
+    try:
+        subprocess.run(["make", "-C", os.path.join(_REPO, "amalgamation")],
+                       check=True, capture_output=True, timeout=240)
+        return os.path.exists(_LIB)
+    except Exception:
+        return False
+
+
+needs_lib = pytest.mark.skipif(not _build(),
+                               reason="amalgamation not buildable")
+
+
+@needs_lib
+def test_amalgamated_predict(tmp_path):
+    import mxnet_tpu as mx
+
+    # a model saved the framework way
+    d = mx.sym.Variable("data")
+    out = mx.sym.FullyConnected(d, num_hidden=3, name="fc")
+    ex = out.simple_bind(mx.cpu(), data=(1, 4))
+    for name, arr in ex.arg_dict.items():
+        if name != "data":
+            arr[:] = mx.nd.array(
+                np.random.RandomState(0).randn(*arr.shape).astype(np.float32))
+    sym_path = tmp_path / "m-symbol.json"
+    sym_path.write_text(out.tojson())
+    params = {f"arg:{n}": a for n, a in ex.arg_dict.items() if n != "data"}
+    mx.nd.save(str(tmp_path / "m-0000.params"), params)
+
+    u32 = ctypes.c_uint32
+    lib = ctypes.CDLL(_LIB)
+    lib.MXGetLastError.restype = ctypes.c_char_p
+    lib.MXPredCreate.argtypes = [
+        ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, u32, ctypes.POINTER(ctypes.c_char_p),
+        ctypes.POINTER(u32), ctypes.POINTER(u32),
+        ctypes.POINTER(ctypes.c_void_p)]
+    lib.MXPredSetInput.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_void_p, u32]
+    lib.MXPredForward.argtypes = [ctypes.c_void_p]
+    lib.MXPredGetOutput.argtypes = [ctypes.c_void_p, u32,
+                                    ctypes.c_void_p, u32]
+    lib.MXPredFree.argtypes = [ctypes.c_void_p]
+    sym_json = sym_path.read_text().encode()
+    with open(tmp_path / "m-0000.params", "rb") as f:
+        blob = f.read()
+    keys = (ctypes.c_char_p * 1)(b"data")
+    shape_data = (u32 * 2)(1, 4)
+    shape_ind = (u32 * 2)(0, 2)
+    handle = ctypes.c_void_p()
+    rc = lib.MXPredCreate(sym_json, blob, len(blob), 1, 0, 1, keys,
+                          shape_ind, shape_data, ctypes.byref(handle))
+    assert rc == 0, lib.MXGetLastError()
+    x = np.random.RandomState(1).randn(1, 4).astype(np.float32)
+    assert lib.MXPredSetInput(handle, b"data",
+                              x.ctypes.data_as(ctypes.c_void_p),
+                              x.size) == 0, lib.MXGetLastError()
+    assert lib.MXPredForward(handle) == 0, lib.MXGetLastError()
+    got = np.zeros(3, np.float32)
+    assert lib.MXPredGetOutput(handle, 0,
+                               got.ctypes.data_as(ctypes.c_void_p),
+                               got.size) == 0, lib.MXGetLastError()
+    W = ex.arg_dict["fc_weight"].asnumpy()
+    b = ex.arg_dict["fc_bias"].asnumpy()
+    np.testing.assert_allclose(got, (x @ W.T + b)[0], rtol=1e-4, atol=1e-5)
+    lib.MXPredFree(handle)
