@@ -402,3 +402,44 @@ def test_check_symbolic_helpers():
     assert tu.almost_equal_ignore_nan(nan_a, nan_a.copy())
     tu.assert_exception(lambda: nd.zeros((2,)).reshape((3,)), Exception)
     assert len(tu.rand_shape_nd(4)) == 4
+
+
+def test_convolution_grouping():
+    """Grouped conv equals per-group convs stitched together (reference
+    test_operator.py test_convolution_grouping)."""
+    num_group, in_c, out_c = 2, 4, 6
+    x = _a(2, in_c, 7, 7)
+    w = _a(out_c, in_c // num_group, 3, 3)
+    b = _a(out_c)
+    got = nd.Convolution(nd.array(x), nd.array(w), nd.array(b),
+                         kernel=(3, 3), num_filter=out_c,
+                         num_group=num_group).asnumpy()
+    parts = []
+    for g in range(num_group):
+        xg = x[:, g * 2:(g + 1) * 2]
+        wg = w[g * 3:(g + 1) * 3]
+        bg = b[g * 3:(g + 1) * 3]
+        parts.append(nd.Convolution(nd.array(xg), nd.array(wg),
+                                    nd.array(bg), kernel=(3, 3),
+                                    num_filter=3).asnumpy())
+    want = np.concatenate(parts, axis=1)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_depthwise_convolution():
+    """num_group == channels (reference test_depthwise_convolution)."""
+    c = 4
+    x = _a(2, c, 6, 6)
+    w = _a(c, 1, 3, 3)
+    got = nd.Convolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                         num_filter=c, num_group=c, no_bias=True,
+                         pad=(1, 1)).asnumpy()
+    assert got.shape == (2, c, 6, 6)
+    # channel 0 output only depends on channel 0 input
+    x2 = x.copy()
+    x2[:, 1:] = 0.0
+    got2 = nd.Convolution(nd.array(x2), nd.array(w), kernel=(3, 3),
+                          num_filter=c, num_group=c, no_bias=True,
+                          pad=(1, 1)).asnumpy()
+    np.testing.assert_allclose(got[:, 0], got2[:, 0], rtol=1e-5)
+    assert not np.allclose(got[:, 1], got2[:, 1])
