@@ -56,6 +56,19 @@ def _bind(lib):
         ctypes.c_int64, u8p, f32p, f32p, f32p]
     lib.mxio_version.restype = ctypes.c_int32
     lib.mxio_version.argtypes = []
+    lib.mxio_pipe_create.restype = ctypes.c_void_p
+    lib.mxio_pipe_create.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_uint64, f32p, f32p, ctypes.c_int32, ctypes.c_int32]
+    lib.mxio_pipe_next.restype = ctypes.c_int64
+    lib.mxio_pipe_next.argtypes = [ctypes.c_void_p, f32p, f32p]
+    lib.mxio_pipe_reset.restype = None
+    lib.mxio_pipe_reset.argtypes = [ctypes.c_void_p]
+    lib.mxio_pipe_num_batches.restype = ctypes.c_int64
+    lib.mxio_pipe_num_batches.argtypes = [ctypes.c_void_p]
+    lib.mxio_pipe_destroy.restype = None
+    lib.mxio_pipe_destroy.argtypes = [ctypes.c_void_p]
     return lib
 
 
@@ -172,3 +185,69 @@ def batch_transform(images, mirror=None, mean=None, std=None):
             _u8ptr(mir) if mir is not None else None, meanp, stdp,
             _fptr(out))
     return out
+
+
+class RecordPipe:
+    """Native threaded record pipeline (reference: the
+    iter_image_recordio_2.cc parser threads + ready-batch ring).  Reads
+    RAW-pixel records (IRHeader + h*w*c uint8 body) and produces
+    normalized NCHW float32 batches assembled by C++ worker threads that
+    run ahead of the consumer.  Returns None from the constructor path
+    (via create()) when the native lib is unavailable."""
+
+    def __init__(self, handle, lib, batch, shape, label_width):
+        self._h = handle
+        self._lib = lib
+        self.batch = batch
+        self.shape = shape            # (c, h, w)
+        self.label_width = label_width
+
+    @classmethod
+    def create(cls, path, batch_size, data_shape, label_width=1,
+               shuffle=False, rand_mirror=False, seed=0, mean=None,
+               std=None, prefetch=4, num_threads=2):
+        lib = get_lib()
+        if lib is None:
+            return None
+        c, h, w = data_shape
+        mean_c = np.ascontiguousarray(mean, np.float32).ravel() \
+            if mean is not None else None
+        std_c = np.ascontiguousarray(std, np.float32).ravel() \
+            if std is not None else None
+        handle = lib.mxio_pipe_create(
+            str(path).encode(), batch_size, h, w, c, label_width,
+            1 if shuffle else 0, 1 if rand_mirror else 0, seed,
+            _fptr(mean_c) if mean_c is not None else None,
+            _fptr(std_c) if std_c is not None else None,
+            prefetch, num_threads)
+        if not handle:
+            return None
+        return cls(handle, lib, batch_size, data_shape, label_width)
+
+    @property
+    def num_batches(self):
+        return int(self._lib.mxio_pipe_num_batches(self._h))
+
+    def next_batch(self):
+        """(data NCHW float32, label) or None at epoch end."""
+        c, h, w = self.shape
+        data = np.empty((self.batch, c, h, w), np.float32)
+        label = np.empty((self.batch, self.label_width), np.float32)
+        rc = int(self._lib.mxio_pipe_next(self._h, _fptr(data),
+                                          _fptr(label)))
+        if rc == -1:
+            return None
+        if rc < -1:
+            raise RuntimeError(f"native record pipe IO error ({rc})")
+        return data, label
+
+    def reset(self):
+        self._lib.mxio_pipe_reset(self._h)
+
+    def __del__(self):
+        try:
+            if self._h:
+                self._lib.mxio_pipe_destroy(self._h)
+                self._h = None
+        except Exception:
+            pass

@@ -550,3 +550,121 @@ def ImageRecordIter(**kwargs):
     (reference registers ImageRecordIter via MXNET_REGISTER_IO_ITER)."""
     from .image import ImageRecordIter as _IRI
     return _IRI(**kwargs)
+
+
+class RawRecordIter(DataIter):
+    """Pipelined iterator over RAW-pixel RecordIO files: the whole hot
+    path — sharded read, IRHeader parse, mirror/normalize, HWC→NCHW
+    pack, batch assembly — runs in C++ worker threads ahead of the
+    consumer (reference: src/io/iter_image_recordio_2.cc
+    ImageRecordIOParser2). Records must hold IRHeader + h*w*c uint8
+    pixels (recordio.pack(header, arr.tobytes())); JPEG-compressed
+    records go through image.ImageRecordIter instead (decode needs a
+    codec library). Falls back to a Python reader when the native
+    library is unavailable.
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
+                 shuffle=False, rand_mirror=False, seed=0, mean=None,
+                 std=None, prefetch=4, preprocess_threads=2):
+        super().__init__(batch_size)
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self._path = str(path_imgrec)
+        from . import _native
+        self._pipe = _native.RecordPipe.create(
+            self._path, batch_size, self.data_shape, label_width,
+            shuffle=shuffle, rand_mirror=rand_mirror, seed=seed,
+            mean=mean, std=std, prefetch=prefetch,
+            num_threads=preprocess_threads)
+        if self._pipe is None:  # pure-Python fallback — STREAMS by
+            # offset table, never holds the dataset in memory
+            self._py_offsets = self._py_scan_offsets()
+            self._py_cursor = 0
+            self._py_rng = np.random.RandomState(seed)
+            self._py_shuffle = shuffle
+            self._py_mirror = rand_mirror
+            self._py_order = np.arange(len(self._py_offsets))
+            self._mean = (np.asarray(mean, np.float32)
+                          if mean is not None else None)
+            self._std = (np.asarray(std, np.float32)
+                         if std is not None else None)
+            if shuffle:
+                self._py_rng.shuffle(self._py_order)
+
+    def _py_scan_offsets(self):
+        """Frame table (offset, length) per whole record — dmlc recordio
+        framing, the Python twin of mxio_scan_records."""
+        import struct
+        out = []
+        with open(self._path, "rb") as f:
+            while True:
+                head = f.read(8)
+                if len(head) < 8:
+                    break
+                magic, lrec = struct.unpack("<II", head)
+                if magic != 0xced7230a:
+                    raise MXNetError(f"bad recordio magic in {self._path}")
+                cflag, ln = lrec >> 29, lrec & ((1 << 29) - 1)
+                if cflag == 0:
+                    out.append((f.tell(), ln))
+                f.seek(ln + ((4 - ln % 4) % 4), 1)
+        return out
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        return [DataDesc("softmax_label",
+                         (self.batch_size, self.label_width))]
+
+    def reset(self):
+        if self._pipe is not None:
+            self._pipe.reset()
+        else:
+            self._py_cursor = 0
+            if self._py_shuffle:
+                self._py_rng.shuffle(self._py_order)
+
+    def next(self):
+        if self._pipe is not None:
+            got = self._pipe.next_batch()
+            if got is None:
+                raise StopIteration
+            data, label = got
+        else:
+            data, label = self._py_next()
+        from . import ndarray as nd
+        return DataBatch(data=[nd.array(data)], label=[nd.array(label)],
+                         pad=0)
+
+    def _py_next(self):
+        from . import recordio
+        c, h, w = self.data_shape
+        n = self.batch_size
+        if self._py_cursor + n > len(self._py_order):
+            raise StopIteration
+        data = np.empty((n, c, h, w), np.float32)
+        label = np.zeros((n, self.label_width), np.float32)
+        with open(self._path, "rb") as f:
+            for i in range(n):
+                off, ln = self._py_offsets[
+                    self._py_order[self._py_cursor + i]]
+                f.seek(off)
+                header, body = recordio.unpack(f.read(ln))
+                lbl = np.asarray(header.label).ravel()
+                label[i, :min(len(lbl), self.label_width)] = \
+                    lbl[:self.label_width]
+                img = np.frombuffer(body, np.uint8).reshape(h, w, c)
+                if self._py_mirror and self._py_rng.rand() < 0.5:
+                    img = img[:, ::-1]
+                x = img.astype(np.float32)
+                if self._mean is not None:
+                    x = x - self._mean
+                if self._std is not None:
+                    x = x / self._std
+                data[i] = x.transpose(2, 0, 1)
+        self._py_cursor += n
+        return data, label

@@ -92,3 +92,126 @@ def test_rec2idx_python_fallback(tmp_path, monkeypatch):
 def test_batch_transform_none_when_disabled(monkeypatch):
     monkeypatch.setattr(_native, "get_lib", lambda: None)
     assert _native.batch_transform(np.zeros((1, 2, 2, 3), np.uint8)) is None
+
+
+class TestRecordPipe:
+    """Native threaded record pipeline (src/io_native.cc mxio_pipe_*;
+    reference iter_image_recordio_2.cc parser threads + ready ring)."""
+
+    def _make_rec(self, tmp_path, n=40, shape=(3, 8, 8), label_width=1):
+        from mxnet_tpu import recordio
+        c, h, w = shape
+        rng = np.random.RandomState(0)
+        imgs = rng.randint(0, 255, (n, h, w, c)).astype(np.uint8)
+        labels = np.arange(n, dtype=np.float32)
+        path = str(tmp_path / "raw.rec")
+        rec = recordio.MXRecordIO(path, "w")
+        for i in range(n):
+            hdr = recordio.IRHeader(0, float(labels[i]), i, 0)
+            rec.write(recordio.pack(hdr, imgs[i].tobytes()))
+        rec.close()
+        return path, imgs, labels
+
+    def test_matches_python_reader(self, tmp_path):
+        import mxnet_tpu._native as _native
+        if not _native.available():
+            pytest.skip("native lib unavailable")
+        from mxnet_tpu.io import RawRecordIter
+        path, imgs, labels = self._make_rec(tmp_path)
+        mean = np.array([1.0, 2.0, 3.0], np.float32)
+        std = np.array([2.0, 4.0, 8.0], np.float32)
+        it = RawRecordIter(path, (3, 8, 8), batch_size=8, mean=mean,
+                           std=std)
+        assert it._pipe is not None, "native pipe should be active"
+        seen = 0
+        for batch in it:
+            d = batch.data[0].asnumpy()
+            l = batch.label[0].asnumpy()
+            for j in range(8):
+                i = int(l[j, 0])
+                want = (imgs[i].astype(np.float32) - mean) / std
+                np.testing.assert_allclose(d[j], want.transpose(2, 0, 1),
+                                           rtol=1e-5, atol=1e-5)
+            seen += 8
+        assert seen == 40
+        # second epoch after reset
+        it.reset()
+        n2 = sum(b.data[0].shape[0] for b in it)
+        assert n2 == 40
+
+    def test_shuffle_covers_all_and_varies(self, tmp_path):
+        import mxnet_tpu._native as _native
+        if not _native.available():
+            pytest.skip("native lib unavailable")
+        from mxnet_tpu.io import RawRecordIter
+        path, imgs, labels = self._make_rec(tmp_path)
+        it = RawRecordIter(path, (3, 8, 8), batch_size=8, shuffle=True,
+                           seed=3)
+        e1 = np.concatenate([b.label[0].asnumpy().ravel() for b in it])
+        it.reset()
+        e2 = np.concatenate([b.label[0].asnumpy().ravel() for b in it])
+        assert sorted(e1.tolist()) == sorted(labels.tolist())
+        assert sorted(e2.tolist()) == sorted(labels.tolist())
+        assert not np.array_equal(e1, e2)  # reshuffled across epochs
+
+    def test_python_fallback_matches(self, tmp_path, monkeypatch):
+        from mxnet_tpu.io import RawRecordIter
+        path, imgs, labels = self._make_rec(tmp_path)
+        import mxnet_tpu._native as _native
+        monkeypatch.setattr(_native.RecordPipe, "create",
+                            classmethod(lambda cls, *a, **k: None))
+        it = RawRecordIter(path, (3, 8, 8), batch_size=8)
+        assert it._pipe is None
+        b = next(iter(it))
+        i = int(b.label[0].asnumpy()[0, 0])
+        np.testing.assert_allclose(
+            b.data[0].asnumpy()[0],
+            imgs[i].astype(np.float32).transpose(2, 0, 1))
+
+    def test_no_deadlock_small_ring(self, tmp_path):
+        """Regression: slot+batch claims are atomic. With the old
+        claim-batch-then-wait-for-slot order, prefetch=2/threads=2 could
+        fill every slot with ready LATER batches while the worker owning
+        the consumer's next sequential batch starved — permanent hang."""
+        import mxnet_tpu._native as _native
+        if not _native.available():
+            pytest.skip("native lib unavailable")
+        from mxnet_tpu.io import RawRecordIter
+        path, imgs, labels = self._make_rec(tmp_path, n=160)
+        it = RawRecordIter(path, (3, 8, 8), batch_size=8, shuffle=True,
+                           prefetch=2, preprocess_threads=2)
+        for _ in range(3):  # several epochs stress slot reuse
+            seen = sum(b.data[0].shape[0] for b in it)
+            assert seen == 160
+            it.reset()
+
+    def test_rand_mirror_flag(self, tmp_path):
+        import mxnet_tpu._native as _native
+        if not _native.available():
+            pytest.skip("native lib unavailable")
+        from mxnet_tpu.io import RawRecordIter
+        path, imgs, labels = self._make_rec(tmp_path, n=16)
+        # without rand_mirror: pixels match the source exactly
+        it = RawRecordIter(path, (3, 8, 8), batch_size=16, shuffle=True)
+        b = next(iter(it))
+        d, l = b.data[0].asnumpy(), b.label[0].asnumpy()
+        for j in range(16):
+            i = int(l[j, 0])
+            np.testing.assert_allclose(
+                d[j], imgs[i].astype(np.float32).transpose(2, 0, 1))
+        # with rand_mirror: some images flipped, none corrupted
+        it2 = RawRecordIter(path, (3, 8, 8), batch_size=16,
+                            rand_mirror=True, seed=5)
+        b2 = next(iter(it2))
+        d2, l2 = b2.data[0].asnumpy(), b2.label[0].asnumpy()
+        n_flip = 0
+        for j in range(16):
+            i = int(l2[j, 0])
+            straight = imgs[i].astype(np.float32).transpose(2, 0, 1)
+            flipped = straight[:, :, ::-1]
+            if np.allclose(d2[j], flipped) and not np.allclose(d2[j],
+                                                               straight):
+                n_flip += 1
+            else:
+                np.testing.assert_allclose(d2[j], straight)
+        assert 0 < n_flip < 16
