@@ -166,3 +166,25 @@ def _contrib_ifft(attrs, data):
     c = data.reshape(data.shape[:-1] + (d, 2))
     z = c[..., 0] + 1j * c[..., 1]
     return (jnp.fft.ifft(z, axis=-1) * d).real.astype(jnp.float32)
+
+
+@register("Crop", input_names=None)
+def _crop_layer(attrs, data, *maybe_like):
+    """Legacy spatial Crop layer (reference src/operator/crop.cc:43):
+    crops dims 2/3 of NCHW data to h_w, or to the spatial size of a
+    second crop_like input; offset=(y,x) or center_crop."""
+    h_w = attrs.get("h_w")
+    if maybe_like:
+        th, tw = maybe_like[0].shape[2], maybe_like[0].shape[3]
+    else:
+        if not h_w:
+            raise ValueError("Crop needs h_w when no crop_like input")
+        th, tw = int(h_w[0]), int(h_w[1])
+    H, W = data.shape[2], data.shape[3]
+    if bool(attrs.get("center_crop", False)):
+        y0 = (H - th) // 2
+        x0 = (W - tw) // 2
+    else:
+        off = attrs.get("offset", (0, 0))
+        y0, x0 = int(off[0]), int(off[1])
+    return data[:, :, y0:y0 + th, x0:x0 + tw]

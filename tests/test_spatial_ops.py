@@ -186,3 +186,16 @@ def test_fft_gradient_flows():
     np.testing.assert_allclose(x.grad.asnumpy(),
                                2 * 8 * x.asnumpy(), rtol=1e-3,
                                atol=1e-3)
+
+
+def test_crop_layer():
+    """Legacy Crop layer (reference crop.cc): h_w, offset, center_crop,
+    and crop_like forms."""
+    x = np.arange(2 * 3 * 6 * 6, dtype=np.float32).reshape(2, 3, 6, 6)
+    got = nd.Crop(nd.array(x), h_w=(4, 3), offset=(1, 2)).asnumpy()
+    np.testing.assert_allclose(got, x[:, :, 1:5, 2:5])
+    got = nd.Crop(nd.array(x), h_w=(4, 4), center_crop=True).asnumpy()
+    np.testing.assert_allclose(got, x[:, :, 1:5, 1:5])
+    like = nd.zeros((2, 1, 3, 2))
+    got = nd.Crop(nd.array(x), like).asnumpy()
+    np.testing.assert_allclose(got, x[:, :, 0:3, 0:2])
