@@ -97,10 +97,13 @@ def _inner_symbol(json_str):
     return sym
 
 
-def _exec_inner(sym, inputs):
-    """Trace the inner graph on jax values (inputs in list_inputs order)."""
+def exec_subgraph(sym, in_map, all_outputs=False):
+    """Trace an inner graph on jax values. ``in_map``: name -> value for
+    every variable. Returns the first output, or all outputs as a list.
+    Shared by the fusion backend (`_subgraph`) and the control-flow ops
+    (symbol/control_flow.py) — the cut-out graph executes as plain jax
+    inside whatever lax combinator the caller wraps it in."""
     env = {}
-    in_map = dict(zip(sym.list_inputs(), inputs))
     for node in sym._topo():
         if node.is_variable():
             env[(node, 0)] = in_map[node.name]
@@ -113,7 +116,14 @@ def _exec_inner(sym, inputs):
         outs = out if isinstance(out, (tuple, list)) else (out,)
         for i, o in enumerate(outs):
             env[(node, i)] = o
+    if all_outputs:
+        return [env[e] for e in sym._outputs]
     return env[sym._outputs[0]]
+
+
+def _exec_inner(sym, inputs):
+    """Trace the inner graph (inputs in list_inputs order)."""
+    return exec_subgraph(sym, dict(zip(sym.list_inputs(), inputs)))
 
 
 @_registry.register("_subgraph")

@@ -1,5 +1,7 @@
 """mx.sym.contrib — symbolic contrib namespace (parity:
-python/mxnet/symbol/contrib.py codegen over _contrib_* registrations)."""
+python/mxnet/symbol/contrib.py codegen over _contrib_* registrations +
+the control-flow builders foreach/while_loop/cond)."""
+from .control_flow import cond, foreach, while_loop  # noqa: F401
 
 
 def __getattr__(name):

@@ -1,0 +1,300 @@
+"""Symbolic control flow: foreach / while_loop / cond as GRAPH NODES.
+
+Parity: reference src/operator/control_flow.cc (`_foreach`:1089,
+`_while_loop`:1150, `_cond`:1211) + python/mxnet/symbol/contrib.py
+(foreach/while_loop/cond builders that cut the body into a subgraph).
+
+TPU redesign: the body symbols serialize into the node's attrs as JSON
+(the `_subgraph` pattern, subgraph.py); at execution the registered ops
+re-trace them with subgraph.exec_subgraph and wrap the trace in the
+matching lax combinator — `lax.scan` for foreach, scan+active-flag for
+while_loop (differentiable, bounded — identical to the imperative
+ndarray/contrib.py lowering), `lax.cond` for cond.  Sequence length
+never unrolls into the graph: compile time is O(1) in T.
+
+Body closures may reference outer VARIABLES (weights) freely — they
+become loop-invariant node inputs; outer COMPUTED symbols are inlined
+into the subgraph and hoisted by XLA's loop-invariant code motion.
+"""
+from __future__ import annotations
+
+import json
+
+
+from ..base import MXNetError
+from ..ops import registry as _registry
+from .symbol import Group, Symbol, _SymNode, var
+
+
+def _as_list(x):
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _cut(sub_sym, bound_names):
+    """Split the subgraph's variables into (bound, free) preserving
+    bound order; free vars keep their outer _SymNode objects so the
+    caller can wire them as node inputs."""
+    free_nodes = []
+    seen = set()
+    for node in sub_sym._topo():
+        if node.is_variable() and node.name not in bound_names and \
+                id(node) not in seen:
+            seen.add(id(node))
+            free_nodes.append(node)
+    return free_nodes
+
+
+_UID = [0]
+
+
+def _gensym(kind):
+    """Unique bound-variable prefix per builder call: fixed names would
+    let an INNER nested loop's _cut absorb an outer loop's bound
+    variable by name collision and silently rebind it (caught in
+    review; reference contrib.py gets uniqueness from the NameManager).
+    """
+    _UID[0] += 1
+    return f"__{kind}{_UID[0]}"
+
+
+def _flatten(syms):
+    """Flatten possibly multi-output symbols into single-output ones so
+    output COUNTS match the serialized subgraph's outputs (Group
+    flattens; reference contrib.py counts via list_outputs)."""
+    out = []
+    for s in syms:
+        out.extend(list(s))
+    return out
+
+
+def _mk_node(op_name, entries, attrs, name, n_out):
+    node = _SymNode(op_name, name, attrs, entries)
+    return Symbol([(node, i) for i in range(n_out)])
+
+
+def foreach(body, data, init_states, name="foreach"):
+    """Scan ``body`` over axis 0 of ``data`` symbolically (parity:
+    symbol/contrib.py foreach). body(data_slice, states) ->
+    (outs, new_states). Returns (stacked_outs, final_states)."""
+    data_l = _as_list(data)
+    states_l = _as_list(init_states)
+    uid = _gensym(name)
+    slice_vars = [var(f"{uid}_slice{i}__") for i in range(len(data_l))]
+    state_vars = [var(f"{uid}_state{i}__") for i in range(len(states_l))]
+    d_arg = slice_vars if isinstance(data, (list, tuple)) else slice_vars[0]
+    s_arg = state_vars if isinstance(init_states, (list, tuple)) \
+        else state_vars[0]
+    out, new_states = body(d_arg, s_arg)
+    outs_l = _flatten(_as_list(out))
+    ns_l = _as_list(new_states)
+    if len(ns_l) != len(states_l):
+        raise MXNetError(
+            f"foreach body returned {len(ns_l)} states for "
+            f"{len(states_l)} init_states")
+    if any(len(s_._outputs) != 1 for s_ in ns_l):
+        raise MXNetError("foreach states must be single-output symbols")
+    sub = Group([*outs_l, *ns_l])
+    bound = [v.name for v in slice_vars] + [v.name for v in state_vars]
+    free_nodes = _cut(sub, set(bound))
+    attrs = {
+        "subgraph_json": sub.tojson(),
+        "in_names": json.dumps(bound + [n.name for n in free_nodes]),
+        "num_data": len(data_l),
+        "num_states": len(states_l),
+        "num_out_data": len(outs_l),
+        "num_outputs": len(outs_l) + len(states_l),
+    }
+    entries = [s._outputs[0] for s in data_l] \
+        + [s._outputs[0] for s in states_l] \
+        + [(n, 0) for n in free_nodes]
+    res = _mk_node("_foreach", entries, attrs, name,
+                   len(outs_l) + len(states_l))
+    outs = [res[i] for i in range(len(outs_l))]
+    fin = [res[len(outs_l) + i] for i in range(len(states_l))]
+    if isinstance(out, (list, tuple)):
+        outs_r = outs
+    elif len(outs) == 1:
+        outs_r = outs[0]
+    else:  # single MULTI-OUTPUT body symbol: keep every output reachable
+        outs_r = Symbol([o._outputs[0] for o in outs])
+    fin_r = fin if isinstance(init_states, (list, tuple)) else fin[0]
+    return outs_r, fin_r
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None,
+               name="while_loop"):
+    """Bounded symbolic while loop (parity: symbol/contrib.py
+    while_loop). cond(loop_vars)->scalar, func(loop_vars)->
+    (step_outputs, new_loop_vars). Stacked outputs have axis 0 ==
+    max_iterations (steps after termination are zero), like the
+    imperative lowering."""
+    if max_iterations is None:
+        raise MXNetError("while_loop requires max_iterations (bounded "
+                         "loops are what compile to one XLA While)")
+    lv_l = _as_list(loop_vars)
+    uid = _gensym(name)
+    lv_vars = [var(f"{uid}_var{i}__") for i in range(len(lv_l))]
+    lv_arg = lv_vars if isinstance(loop_vars, (list, tuple)) else lv_vars[0]
+    pred = cond(lv_arg)
+    out, new_lv = func(lv_arg)
+    outs_l = _flatten(_as_list(out))
+    nlv_l = _as_list(new_lv)
+    if len(nlv_l) != len(lv_l):
+        raise MXNetError("while_loop func must return as many loop_vars "
+                         "as it received")
+    if any(len(s_._outputs) != 1 for s_ in nlv_l):
+        raise MXNetError("while_loop loop_vars must be single-output "
+                         "symbols")
+    sub = Group([pred, *outs_l, *nlv_l])
+    bound = [v.name for v in lv_vars]
+    free_nodes = _cut(sub, set(bound))
+    attrs = {
+        "subgraph_json": sub.tojson(),
+        "in_names": json.dumps(bound + [n.name for n in free_nodes]),
+        "num_vars": len(lv_l),
+        "num_out_data": len(outs_l),
+        "max_iterations": int(max_iterations),
+        "num_outputs": len(outs_l) + len(lv_l),
+    }
+    entries = [s._outputs[0] for s in lv_l] + [(n, 0) for n in free_nodes]
+    res = _mk_node("_while_loop", entries, attrs, name,
+                   len(outs_l) + len(lv_l))
+    outs = [res[i] for i in range(len(outs_l))]
+    fin = [res[len(outs_l) + i] for i in range(len(lv_l))]
+    if isinstance(out, (list, tuple)):
+        outs_r = outs
+    elif len(outs) == 1:
+        outs_r = outs[0]
+    else:
+        outs_r = Symbol([o._outputs[0] for o in outs])
+    fin_r = fin if isinstance(loop_vars, (list, tuple)) else fin[0]
+    return outs_r, fin_r
+
+
+def cond(pred, then_func, else_func, name="cond"):
+    """Symbolic if/else (parity: symbol/contrib.py cond). ``pred`` is a
+    scalar Symbol; then_func/else_func are nullary closures over outer
+    symbols returning outputs of matching structure."""
+    then_out = _flatten(_as_list(then_func()))
+    else_out = _flatten(_as_list(else_func()))
+    if len(then_out) != len(else_out):
+        raise MXNetError("cond branches must return the same number of "
+                         "outputs")
+    n_out = len(then_out)
+    then_sub = Group(then_out) if n_out > 1 else then_out[0]
+    else_sub = Group(else_out) if n_out > 1 else else_out[0]
+    then_free = _cut(then_sub, set())
+    else_free = _cut(else_sub, set())
+    # union of branch inputs, stable order
+    free_nodes, seen = [], set()
+    for node in then_free + else_free:
+        if id(node) not in seen:
+            seen.add(id(node))
+            free_nodes.append(node)
+    attrs = {
+        "then_json": then_sub.tojson(),
+        "else_json": else_sub.tojson(),
+        "in_names": json.dumps([n.name for n in free_nodes]),
+        "num_outputs": n_out,
+    }
+    entries = [pred._outputs[0]] + [(n, 0) for n in free_nodes]
+    res = _mk_node("_cond", entries, attrs, name, n_out)
+    return res if n_out > 1 else res[0]
+
+
+# --- registered ops ---------------------------------------------------------
+def _names(v):
+    """in_names is stored as a json string; the generic symbol-attr
+    parser may pre-split it into a sequence of still-quoted elements —
+    accept both forms."""
+    if isinstance(v, str):
+        return json.loads(v)
+    out = []
+    for x in v:
+        x = str(x).strip()
+        if len(x) >= 2 and x[0] in "\"'" and x[-1] == x[0]:
+            x = x[1:-1]
+        out.append(x)
+    return out
+
+
+def _inner(json_str):
+    from ..subgraph import _inner_symbol
+    return _inner_symbol(json_str)
+
+
+def _foreach_fcompute(attrs, *arrays):
+    import jax
+    from ..subgraph import exec_subgraph
+    sym = _inner(attrs["subgraph_json"])
+    in_names = _names(attrs["in_names"])
+    n_data = int(attrs["num_data"])
+    n_states = int(attrs["num_states"])
+    n_outs = int(attrs["num_out_data"])
+    data_arrs = arrays[:n_data]
+    states = arrays[n_data:n_data + n_states]
+    frees = arrays[n_data + n_states:]
+
+    def step(carry, xs):
+        vals = dict(zip(in_names, list(xs) + list(carry) + list(frees)))
+        outs = exec_subgraph(sym, vals, all_outputs=True)
+        return tuple(outs[n_outs:]), tuple(outs[:n_outs])
+
+    final, stacked = jax.lax.scan(step, tuple(states), tuple(data_arrs))
+    return tuple(stacked) + tuple(final)
+
+
+def _while_loop_fcompute(attrs, *arrays):
+    import jax
+    import jax.numpy as jnp
+    from ..subgraph import exec_subgraph
+    sym = _inner(attrs["subgraph_json"])
+    in_names = _names(attrs["in_names"])
+    n_vars = int(attrs["num_vars"])
+    n_outs = int(attrs["num_out_data"])
+    max_iter = int(attrs["max_iterations"])
+    lvs = arrays[:n_vars]
+    frees = arrays[n_vars:]
+
+    def run(vals):
+        outs = exec_subgraph(sym, vals, all_outputs=True)
+        return outs[0], outs[1:1 + n_outs], outs[1 + n_outs:]
+
+    # probe shapes once (abstractly traced by the caller's jit anyway)
+    def step(carry, _):
+        active, lv = carry
+        vals = dict(zip(in_names, list(lv) + list(frees)))
+        pred, step_outs, new_lv = run(vals)
+        take = jnp.logical_and(active, pred.astype(bool).reshape(()))
+        lv2 = tuple(jnp.where(take, n, o) for n, o in zip(new_lv, lv))
+        outs = tuple(jnp.where(take, o, jnp.zeros_like(o))
+                     for o in step_outs)
+        return (take, lv2), outs
+
+    (_, final_lv), stacked = jax.lax.scan(
+        step, (jnp.bool_(True), tuple(lvs)), None, length=max_iter)
+    return tuple(stacked) + tuple(final_lv)
+
+
+def _cond_fcompute(attrs, pred, *arrays):
+    import jax
+    from ..subgraph import exec_subgraph
+    then_sym = _inner(attrs["then_json"])
+    else_sym = _inner(attrs["else_json"])
+    in_names = _names(attrs["in_names"])
+    vals = dict(zip(in_names, arrays))
+
+    def then_f(vs):
+        return tuple(exec_subgraph(then_sym, vs, all_outputs=True))
+
+    def else_f(vs):
+        return tuple(exec_subgraph(else_sym, vs, all_outputs=True))
+
+    out = jax.lax.cond(pred.astype(bool).reshape(()), then_f, else_f, vals)
+    return out
+
+
+_registry.register("_foreach", num_outputs="num_outputs")(_foreach_fcompute)
+_registry.register("_while_loop",
+                   num_outputs="num_outputs")(_while_loop_fcompute)
+_registry.register("_cond", num_outputs="num_outputs")(_cond_fcompute)

@@ -6,6 +6,7 @@ import numpy as np
 import mxnet_tpu as mx
 from mxnet_tpu import nd
 from mxnet_tpu.ndarray import contrib
+from mxnet_tpu import sym
 
 
 def test_foreach_matches_unrolled_rnn():
@@ -215,3 +216,115 @@ def test_higher_order_grad_chain():
     z.backward()
     np.testing.assert_allclose(x.grad.asnumpy(),
                                36 * x.asnumpy() ** 3, rtol=1e-4)
+
+
+# --- symbolic control flow (symbol/control_flow.py; reference
+# control_flow.cc _foreach:1089/_while_loop:1150/_cond:1211) ---------------
+
+def test_sym_foreach_matches_loop_and_grads():
+    data = sym.var("data")
+    w = sym.var("w")
+
+    def body(x_t, h):
+        h2 = sym.tanh(sym.FullyConnected(x_t, w, num_hidden=4,
+                                         no_bias=True) + h)
+        return h2, h2
+
+    outs, final_h = sym.contrib.foreach(body, data, sym.var("h0"))
+    T, N, C, H = 5, 2, 3, 4
+    rng = np.random.RandomState(0)
+    x = rng.randn(T, N, C).astype(np.float32)
+    W = rng.randn(H, C).astype(np.float32) * 0.3
+    args = {"data": mx.nd.array(x), "w": mx.nd.array(W),
+            "h0": mx.nd.zeros((N, H))}
+    grads = {k: mx.nd.zeros(v.shape) for k, v in args.items()}
+    ex = outs.bind(mx.cpu(), args, args_grad=grads)
+    got = ex.forward(is_train=True)[0].asnumpy()
+    h = np.zeros((N, H), np.float32)
+    want = []
+    for t in range(T):
+        h = np.tanh(x[t] @ W.T + h)
+        want.append(h)
+    np.testing.assert_allclose(got, np.stack(want), rtol=1e-5, atol=1e-6)
+    # gradient flows through the scan into the loop-invariant weight
+    ex.backward(mx.nd.ones((T, N, H)))
+    gw = ex.grad_dict["w"].asnumpy()
+    assert np.abs(gw).sum() > 0
+
+    # JSON round-trip: the subgraph travels in the node attrs
+    reloaded = mx.sym.load_json(outs.tojson())
+    ex2 = reloaded.bind(mx.cpu(), {k: v.copy() for k, v in args.items()})
+    np.testing.assert_allclose(ex2.forward()[0].asnumpy(), got,
+                               rtol=1e-6)
+
+
+def test_sym_while_loop_bounded():
+    def w_cond(lv):
+        s, i = lv
+        return sym.sum(s) < 10.0
+
+    def w_func(lv):
+        s, i = lv
+        return [s], [s + i, i]
+
+    outs, fin = sym.contrib.while_loop(
+        w_cond, w_func, [sym.var("s0"), sym.var("i0")], max_iterations=8)
+    g = mx.sym.Group([outs[0], fin[0]])
+    ex = g.bind(mx.cpu(), {"s0": mx.nd.array(np.array([1.0], np.float32)),
+                           "i0": mx.nd.array(np.array([3.0], np.float32))})
+    o = ex.forward()
+    np.testing.assert_allclose(o[0].asnumpy().ravel(),
+                               [1, 4, 7, 0, 0, 0, 0, 0])
+    np.testing.assert_allclose(o[1].asnumpy(), [10.0])
+
+
+def test_sym_cond_branches():
+    a, b = sym.var("a"), sym.var("b")
+    c = sym.contrib.cond(sym.sum(a) > sym.sum(b),
+                         lambda: a * 2, lambda: b * 3)
+    ex = c.bind(mx.cpu(), {"a": mx.nd.array(np.array([3.0], np.float32)),
+                           "b": mx.nd.array(np.array([1.0], np.float32))})
+    np.testing.assert_allclose(ex.forward()[0].asnumpy(), [6.0])
+    ex = c.bind(mx.cpu(), {"a": mx.nd.array(np.array([0.0], np.float32)),
+                           "b": mx.nd.array(np.array([5.0], np.float32))})
+    np.testing.assert_allclose(ex.forward()[0].asnumpy(), [15.0])
+
+
+def test_sym_foreach_nested_and_multioutput():
+    """Regressions from review: (a) an inner nested loop capturing the
+    OUTER loop's slice must not rebind it by name collision (bound vars
+    are gensym-unique); (b) a body returning one MULTI-OUTPUT symbol
+    keeps every output reachable."""
+    data = sym.var("data")
+
+    def outer_body(x_outer, s):
+        def inner_body(x_inner, z):
+            return x_inner + sym.sum(x_outer), z
+        outs, _ = sym.contrib.foreach(inner_body, x_outer, sym.var("z0"))
+        return outs, s
+
+    outs, _ = sym.contrib.foreach(outer_body, data, sym.var("s0"))
+    To, Ti, N = 2, 3, 2
+    x = np.arange(To * Ti * N, dtype=np.float32).reshape(To, Ti, N)
+    ex = outs.bind(mx.cpu(), {"data": mx.nd.array(x),
+                              "s0": mx.nd.zeros((1,)),
+                              "z0": mx.nd.zeros((1,))})
+    got = ex.forward()[0].asnumpy()
+    want = np.stack([np.stack([x[o, i] + x[o].sum() for i in range(Ti)])
+                     for o in range(To)])
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    d2 = sym.var("d2")
+
+    def body2(xt, h):
+        return sym.SliceChannel(xt, num_outputs=2, axis=0), h
+
+    outs2, _ = sym.contrib.foreach(body2, d2, sym.var("h0"))
+    ex2 = mx.sym.Group(list(outs2)).bind(
+        mx.cpu(), {"d2": mx.nd.array(np.arange(12, dtype=np.float32)
+                                     .reshape(3, 4)),
+                   "h0": mx.nd.zeros((1,))})
+    o = ex2.forward()
+    x2 = np.arange(12, dtype=np.float32).reshape(3, 4)
+    np.testing.assert_allclose(o[0].asnumpy(), x2[:, :2])
+    np.testing.assert_allclose(o[1].asnumpy(), x2[:, 2:])
