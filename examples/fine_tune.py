@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Fine-tuning flow (parity: reference example/image-classification/
+fine-tune.py): load a trained checkpoint, keep the feature extractor,
+replace the classifier head, train only/mostly the new head on a new
+task, and score.
+
+Zero-egress variant: "pretraining" happens here on synthetic task A
+(4-way); the feature checkpoint is then loaded into a new net with a
+3-way head, the backbone FROZEN, and only the head trained on a small
+task-B set — the script gates on the fine-tuned model reaching a
+quality bar and prints a random-backbone control for context.
+
+Run (CPU, ~2 min):  JAX_PLATFORMS=cpu python examples/fine_tune.py
+"""
+import argparse
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def make_task(rng, protos, n, remap=None):
+    y = rng.randint(0, len(protos), n)
+    x = protos[y] + rng.randn(n, *protos.shape[1:]).astype(np.float32) * 0.25
+    if remap is not None:
+        y = remap[y]
+    return x.astype(np.float32), y.astype(np.float32)
+
+
+def build_net(classes):
+    """features nested as ONE sub-block: its save_parameters keys are
+    structural and head-free, so a checkpoint of the features loads into
+    any same-architecture feature extractor regardless of head size —
+    the gluon analog of the reference's symbol-level head slicing."""
+    from mxnet_tpu.gluon import nn
+    features = nn.HybridSequential()
+    features.add(nn.Conv2D(8, 3, activation="relu"),
+                 nn.MaxPool2D(2, 2),
+                 nn.Conv2D(16, 3, activation="relu"),
+                 nn.MaxPool2D(2, 2),
+                 nn.Flatten(),
+                 nn.Dense(32, activation="relu"))
+    head = nn.Dense(classes)
+    net = nn.HybridSequential()
+    net.add(features, head)
+    return net, features, head
+
+
+def train(net, x, y, epochs, lr, params=None):
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(params or net.collect_params(), "adam",
+                            {"learning_rate": lr})
+    bs = 64
+    for _ in range(epochs):
+        order = np.random.permutation(len(x))
+        for i in range(0, len(x) - bs + 1, bs):
+            idx = order[i:i + bs]
+            xb = mx.nd.array(x[idx])
+            yb = mx.nd.array(y[idx])
+            with mx.autograd.record():
+                l = loss_fn(net(xb), yb)
+            l.backward()
+            trainer.step(bs)
+    return net
+
+
+def accuracy(net, x, y):
+    import mxnet_tpu as mx
+    out = net(mx.nd.array(x)).asnumpy()
+    return float((out.argmax(1) == y).mean())
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pretrain-epochs", type=int, default=4)
+    ap.add_argument("--finetune-epochs", type=int, default=10)
+    ap.add_argument("--finetune-samples", type=int, default=192,
+                    help="small on purpose: transfer shines in low-data")
+    args = ap.parse_args()
+
+    import mxnet_tpu as mx
+
+    rng = np.random.RandomState(0)
+    protos = rng.rand(4, 1, 16, 16).astype(np.float32)
+
+    # ---- task A pretraining + checkpoint --------------------------------
+    xa, ya = make_task(rng, protos, 3000)
+    net, features, _ = build_net(4)
+    net.initialize(mx.initializer.Xavier())
+    net.hybridize()
+    train(net, xa, ya, args.pretrain_epochs, 2e-3)
+    print(f"task A accuracy: {accuracy(net, xa, ya):.3f}")
+    ckpt = os.path.join(tempfile.mkdtemp(), "pretrained.params")
+    features.save_parameters(ckpt)   # feature extractor only, no head
+
+    # ---- task B: remixed classes (transfer target) ----------------------
+    remap = np.array([0, 1, 2, 0])   # 3-way; class 3 folds into 0
+    xb_t, yb_t = make_task(rng, protos, args.finetune_samples, remap)
+    xb_v, yb_v = make_task(rng, protos, 400, remap)
+
+    # fine-tune: load the feature extractor, FREEZE it, train the head
+    # only (the reference fine-tune.py default: fixed_param_names for the
+    # backbone; here freezing = giving the Trainer only the head params)
+    ft, ft_features, head = build_net(3)
+    ft_features.load_parameters(ckpt)
+    head.initialize(mx.initializer.Xavier())
+    ft.hybridize()
+    train(ft, xb_t, yb_t, args.finetune_epochs, 1e-2,
+          params=head.collect_params())
+    acc_ft = accuracy(ft, xb_v, yb_v)
+
+    # control: identical head-only budget on RANDOM (unpretrained)
+    # frozen features — isolates what the checkpoint transferred
+    sc, _, sc_head = build_net(3)
+    sc.initialize(mx.initializer.Xavier())
+    sc.hybridize()
+    train(sc, xb_t, yb_t, args.finetune_epochs, 1e-2,
+          params=sc_head.collect_params())
+    acc_sc = accuracy(sc, xb_v, yb_v)
+
+    print(f"task B val acc, head-only — pretrained features: {acc_ft:.3f}"
+          f"  random features (control): {acc_sc:.3f}")
+    # gate on the MECHANISM: a frozen pretrained backbone + fresh head
+    # trained on a small target set reaches the quality bar (the control
+    # number contextualizes what the checkpoint contributed)
+    if acc_ft > 0.9:
+        print("PASS")
+        return 0
+    print("FAIL: fine-tuned head below bar")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
