@@ -1,0 +1,26 @@
+#!/bin/bash
+# CI pipeline (parity: reference ci/build.py stages, single-host form):
+# build native libs, generated-code sync checks, full test suite on the
+# virtual 8-device CPU mesh, entry-point dry runs.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== native build =="
+make -C src
+make -C src capi
+make -C amalgamation
+
+echo "== generated code in sync =="
+python cpp-package/OpWrapperGenerator.py
+git diff --exit-code cpp-package/include/mxnet_tpu/op.hpp
+
+echo "== unit suite (virtual 8-device CPU mesh via tests/conftest.py) =="
+python -m pytest tests/ -q
+
+echo "== entry points =="
+JAX_PLATFORMS=cpu python -c \
+  "import __graft_entry__ as g; fn, a = g.entry(); fn(*a)"
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+echo "CI OK"

@@ -88,6 +88,11 @@ def _encode_image(args, item):
             h, w = img.shape[:2]
             scale = args.resize / min(h, w)
             img = cv2.resize(img, (int(w * scale), int(h * scale)))
+        if args.encoding == "raw":
+            # fixed-shape HWC uint8 pixels: the io.RawRecordIter /
+            # native RecordPipe fast-path format (requires --resize +
+            # --center-crop so every record is the same size)
+            return np.ascontiguousarray(img, np.uint8).tobytes()
         ok, buf = cv2.imencode(args.encoding, img,
                                [cv2.IMWRITE_JPEG_QUALITY, args.quality])
         return buf.tobytes() if ok else None
@@ -104,6 +109,9 @@ def _encode_image(args, item):
             scale = args.resize / min(img.size)
             img = img.resize((int(img.size[0] * scale),
                               int(img.size[1] * scale)))
+        if args.encoding == "raw":
+            arr = np.asarray(img, dtype=np.uint8)
+            return np.ascontiguousarray(arr).tobytes()
         out = io.BytesIO()
         img.save(out, format="JPEG" if args.encoding == ".jpg" else "PNG",
                  quality=args.quality)
@@ -180,7 +188,7 @@ def main():
     parser.add_argument("--color", type=int, default=1,
                         choices=[-1, 0, 1])
     parser.add_argument("--encoding", type=str, default=".jpg",
-                        choices=[".jpg", ".png"])
+                        choices=[".jpg", ".png", "raw"])
     parser.add_argument("--pack-label", action="store_true")
     args = parser.parse_args()
 
