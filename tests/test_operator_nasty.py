@@ -443,3 +443,144 @@ def test_depthwise_convolution():
                           pad=(1, 1)).asnumpy()
     np.testing.assert_allclose(got[:, 0], got2[:, 0], rtol=1e-5)
     assert not np.allclose(got[:, 1], got2[:, 1])
+
+
+def _num_grad(f, x, eps=1e-3):
+    g = np.zeros_like(x, dtype=np.float64)
+    flat = x.reshape(-1)
+    gf = g.reshape(-1)
+    for j in range(flat.size):
+        o = flat[j]
+        flat[j] = o + eps
+        fp = f(x)
+        flat[j] = o - eps
+        fm = f(x)
+        flat[j] = o
+        gf[j] = (fp - fm) / (2 * eps)
+    return g
+
+
+def _autograd_grad(op, x, **attrs):
+    a = nd.array(x.astype(np.float32))
+    a.attach_grad()
+    with mx.autograd.record():
+        out = op(a, **attrs)
+        s = out.sum() if not isinstance(out, list) else sum(
+            o.sum() for o in out)
+    s.backward()
+    return a.grad.asnumpy().astype(np.float64)
+
+
+def test_broadcast_grad_reduces_over_broadcast_axes():
+    """Gradients of broadcast binary ops sum over broadcast axes
+    (test_operator.py test_binary_op backward family)."""
+    a = _a(3, 1, 5)
+    b = _a(1, 4, 1)
+    na, nb = nd.array(a), nd.array(b)
+    na.attach_grad(), nb.attach_grad()
+    with mx.autograd.record():
+        s = nd.broadcast_mul(na, nb).sum()
+    s.backward()
+    np.testing.assert_allclose(
+        na.grad.asnumpy(),
+        np.broadcast_to(b, (3, 4, 5)).sum(axis=1, keepdims=True),
+        rtol=1e-5)
+    np.testing.assert_allclose(
+        nb.grad.asnumpy(),
+        np.broadcast_to(a, (3, 4, 5)).sum(axis=(0, 2))[None, :, None],
+        rtol=1e-5)
+
+
+def test_slice_and_concat_grads():
+    x = _a(4, 6)
+    g = _autograd_grad(lambda a: nd.slice(a, begin=(1, 2), end=(3, 5)), x)
+    want = np.zeros_like(x)
+    want[1:3, 2:5] = 1.0
+    np.testing.assert_allclose(g, want)
+
+    a, b = _a(2, 3), _a(2, 4)
+    na, nb = nd.array(a), nd.array(b)
+    na.attach_grad(), nb.attach_grad()
+    with mx.autograd.record():
+        s = (nd.Concat(na, nb, dim=1) * 2.0).sum()
+    s.backward()
+    np.testing.assert_allclose(na.grad.asnumpy(), np.full_like(a, 2.0))
+    np.testing.assert_allclose(nb.grad.asnumpy(), np.full_like(b, 2.0))
+
+
+def test_take_grad_scatter_adds_duplicates():
+    """take's backward scatter-ADDS when an index repeats
+    (indexing_op.h TakeGrad)."""
+    x = _a(4, 3)
+    idx = nd.array(np.array([1, 1, 2], np.float32))
+    a = nd.array(x)
+    a.attach_grad()
+    with mx.autograd.record():
+        s = nd.take(a, idx).sum()
+    s.backward()
+    want = np.zeros_like(x)
+    want[1] = 2.0
+    want[2] = 1.0
+    np.testing.assert_allclose(a.grad.asnumpy(), want)
+
+
+def test_avg_pool_grad_with_padding():
+    x = _a(1, 1, 4, 4)
+    g = _autograd_grad(
+        lambda a: nd.Pooling(a, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                             pool_type="avg"), x)
+    num = _num_grad(
+        lambda z: float(nd.Pooling(nd.array(z.astype(np.float32)),
+                                   kernel=(3, 3), stride=(2, 2),
+                                   pad=(1, 1), pool_type="avg")
+                        .sum().asscalar()),
+        x.astype(np.float64))
+    np.testing.assert_allclose(g, num, rtol=1e-2, atol=1e-3)
+
+
+def test_batch_dot_grads():
+    a = _a(2, 3, 4)
+    b = _a(2, 4, 5)
+    na, nb = nd.array(a), nd.array(b)
+    na.attach_grad(), nb.attach_grad()
+    with mx.autograd.record():
+        s = nd.batch_dot(na, nb).sum()
+    s.backward()
+    ones = np.ones((2, 3, 5), np.float32)
+    np.testing.assert_allclose(na.grad.asnumpy(),
+                               np.matmul(ones, b.transpose(0, 2, 1)),
+                               rtol=1e-4)
+    np.testing.assert_allclose(nb.grad.asnumpy(),
+                               np.matmul(a.transpose(0, 2, 1), ones),
+                               rtol=1e-4)
+
+
+def test_softmax_axis_grads_sum_zero():
+    """softmax gradient rows sum to ~0 along the softmax axis for any
+    upstream gradient (property the reference softmax bwd kernel
+    preserves)."""
+    x = _a(3, 5, 4)
+    for axis in (0, 1, -1):
+        a = nd.array(x)
+        a.attach_grad()
+        w = nd.array(_a(3, 5, 4))
+        with mx.autograd.record():
+            s = (nd.softmax(a, axis=axis) * w).sum()
+        s.backward()
+        g = a.grad.asnumpy()
+        np.testing.assert_allclose(g.sum(axis=axis), 0.0, atol=1e-5)
+
+
+def test_embedding_grad_accumulates_rows():
+    table = _a(6, 3)
+    idx = nd.array(np.array([[0, 2], [2, 5]], np.float32))
+    w = nd.array(table)
+    w.attach_grad()
+    with mx.autograd.record():
+        s = nd.Embedding(idx, w, input_dim=6, output_dim=3).sum()
+    s.backward()
+    want = np.zeros_like(table)
+    want[0] = 1.0
+    want[2] = 2.0
+    want[5] = 1.0
+    np.testing.assert_allclose(w.grad.asnumpy(), want)
