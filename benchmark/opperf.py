@@ -280,20 +280,32 @@ def main():
             int_shapes = entry[4] if len(entry) > 4 else []
             do_bwd = not args.no_backward and label not in _FWD_ONLY
             t0 = time.perf_counter()
-            r = time_op(label, op_name, attrs, shapes, int_shapes, dev,
-                        dtype, args.reps, do_bwd)
+            try:
+                r = time_op(label, op_name, attrs, shapes, int_shapes, dev,
+                            dtype, args.reps, do_bwd)
+            except Exception as e:
+                # the shared TPU relay flaps for hours at a time; keep every
+                # point measured so far rather than losing the run
+                r = {"error": f"{type(e).__name__}: {e}"}
             r["suite"] = suite
             results["results"][label] = r
             msg = " ".join(f"{k}={v}" for k, v in r.items()
-                           if k.endswith("_ms"))
+                           if k.endswith("_ms")) or r.get("error", "")[:60]
             print(f"[{time.perf_counter() - t_all:6.1f}s] {label:22s} {msg}"
                   f"  ({time.perf_counter() - t0:.1f}s incl. compile)",
                   flush=True)
+            if args.output:  # incremental: survive a relay drop mid-run
+                tmp = args.output + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(results, f, indent=1)
+                os.replace(tmp, args.output)  # atomic: never truncate
 
     out = args.output
     if out:
-        with open(out, "w") as f:
+        tmp = out + ".tmp"  # atomic like the incremental writes
+        with open(tmp, "w") as f:
             json.dump(results, f, indent=1)
+        os.replace(tmp, out)
         print(f"wrote {out}")
     else:
         print(json.dumps(results))

@@ -9,6 +9,7 @@ from . import random  # noqa: F401
 from . import sparse  # noqa: F401
 from . import contrib  # noqa: F401
 from . import linalg  # noqa: F401
+from . import image  # noqa: F401
 from .ndarray import (NDArray, add_n, arange, array, concat, dot, empty, eye,
                       full, invoke, linspace, maximum, minimum, moveaxis, ones,
                       ones_like, stack, transpose, waitall, zeros, zeros_like)
@@ -22,7 +23,8 @@ def _make_op_func(op):
     def fn(*args, out=None, name=None, **kwargs):
         inputs = [a for a in args if isinstance(a, NDArray)]
         scalars = [a for a in args
-                   if not isinstance(a, NDArray) and isinstance(a, (int, float))]
+                   if not isinstance(a, NDArray)
+                   and isinstance(a, (int, float, bool, str, tuple, list))]
         for attr_name, val in zip(op.scalar_args, scalars):
             kwargs.setdefault(attr_name, val)
         return invoke(op, inputs, kwargs, out=out)

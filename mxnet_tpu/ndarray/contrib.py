@@ -252,6 +252,18 @@ def cond(pred, then_func, else_func, name="cond"):
     return out
 
 
+def edge_id(data, u, v, out=None):
+    """Edge-id lookup on a CSRNDArray adjacency (reference:
+    contrib/dgl_graph.cc _contrib_edge_id): out[i] = data value of edge
+    (u[i], v[i]), or -1 when absent. Unpacks the CSR container into the
+    functional op's explicit (indptr, indices, data) inputs."""
+    from .ndarray import invoke
+    from ..ops import registry as _registry
+    op = _registry.get("_contrib_edge_id")
+    return invoke(op, [data.indptr, data.indices, data.data, u, v], {},
+                  out=out)
+
+
 # ---------------------------------------------------------------------------
 # registry-backed contrib ops: nd.contrib.box_nms resolves _contrib_box_nms
 # (parity: python/mxnet/ndarray/contrib.py is codegen over _contrib_* ops)

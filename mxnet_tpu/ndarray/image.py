@@ -1,0 +1,43 @@
+"""mx.nd.image — imperative namespace over the `_image_*` operator family
+(reference: python/mxnet/ndarray/image.py, generated from
+src/operator/image/ registrations). Functions are built from the same
+wrapper factory as the main nd namespace so scalar/NDArray argument
+handling can never diverge."""
+from __future__ import annotations
+
+from ..ops import registry as _registry
+
+# public name -> registered op
+_IMAGE_OPS = {
+    "to_tensor": "_image_to_tensor",
+    "normalize": "_image_normalize",
+    "crop": "_image_crop",
+    "resize": "_image_resize",
+    "flip_left_right": "_image_flip_left_right",
+    "flip_top_bottom": "_image_flip_top_bottom",
+    "random_flip_left_right": "_image_random_flip_left_right",
+    "random_flip_top_bottom": "_image_random_flip_top_bottom",
+    "random_brightness": "_image_random_brightness",
+    "random_contrast": "_image_random_contrast",
+    "random_saturation": "_image_random_saturation",
+    "random_hue": "_image_random_hue",
+    "random_color_jitter": "_image_random_color_jitter",
+    "adjust_lighting": "_image_adjust_lighting",
+    "random_lighting": "_image_random_lighting",
+}
+
+
+def __getattr__(name):
+    op_name = _IMAGE_OPS.get(name)
+    if op_name is not None:
+        from . import _make_op_func
+        fn = _make_op_func(_registry.get(op_name))
+        fn.__name__ = name
+        globals()[name] = fn  # cache
+        return fn
+    raise AttributeError(
+        f"module 'mxnet_tpu.ndarray.image' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_IMAGE_OPS))

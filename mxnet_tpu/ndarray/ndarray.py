@@ -549,7 +549,14 @@ def invoke(op, inputs, attrs, out=None):
     # linearize e.g. reduce_window through an inner jit) and trace time stays
     # flat
     if any(isinstance(a, jax.core.Tracer) for a in arrays):
+        if op.eager_only:
+            raise MXNetError(
+                f"operator {op.name} has data-dependent output shapes and "
+                "cannot be traced/hybridized (reference analog: dynamic-"
+                "shape FComputeEx ops); call it imperatively")
         fn = op.raw(attrs)
+    elif op.eager_only:
+        fn = op.raw(attrs)  # dynamic output shapes: run un-jitted
     else:
         fn, _ = op.bind(**attrs)
     recording = autograd.is_recording()
@@ -562,6 +569,12 @@ def invoke(op, inputs, attrs, out=None):
             def vjp_fn(cts, _op=op, _attrs=dict(attrs), _prims=prims):
                 cts_t = cts if isinstance(cts, tuple) else (cts,)
                 return _op.fgradient(_attrs, _prims, cts_t)
+        elif recording and op.eager_only:
+            # jax.vjp would abstractly trace the dynamic-shape body;
+            # eager_only ops must declare an explicit fgradient to train
+            raise MXNetError(
+                f"operator {op.name} has data-dependent output shapes and "
+                "no gradient rule; it cannot be recorded for autograd")
         elif recording:
             outs, vjp_fn = jax.vjp(op.raw(attrs), *arrays)
         else:

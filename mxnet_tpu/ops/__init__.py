@@ -15,5 +15,6 @@ from . import _op_optimizer  # noqa: F401
 from . import _op_linalg  # noqa: F401
 from . import _op_contrib  # noqa: F401
 from . import _op_quantization  # noqa: F401
+from . import _op_image  # noqa: F401
 from . import _op_spatial  # noqa: F401
 from . import pallas_attention  # noqa: F401

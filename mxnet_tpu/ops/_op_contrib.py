@@ -1149,3 +1149,99 @@ def _arange_like(attrs, x):
     n = x.shape[ax]
     vals = start + step * (jnp.arange(n, dtype=jnp.float32) // repeat)
     return vals
+
+
+# --- round-4 named-op gap closers -------------------------------------------
+
+def _boolean_mask_grad(attrs, prims, cts):
+    """Backward: scatter the kept rows' cotangents to their source
+    positions (reference: boolean_mask-inl.h BooleanMaskBackward).
+    Runs eagerly at tape playback, so the dynamic keep-set is fine."""
+    data, index = prims
+    axis = int(attrs.get("axis", 0))
+    keep = jnp.nonzero(index.astype(bool))[0]
+    ct = jnp.moveaxis(cts[0], axis, 0)
+    g = jnp.zeros(jnp.moveaxis(data, axis, 0).shape, data.dtype)
+    g = g.at[keep].set(ct.astype(data.dtype))
+    return (jnp.moveaxis(g, 0, axis), None)
+
+
+@register("_contrib_boolean_mask", alias=("boolean_mask",), eager_only=True,
+          fgradient=_boolean_mask_grad)
+def _contrib_boolean_mask(attrs, data, index):
+    """Compact the rows of `data` where `index` is nonzero (reference:
+    contrib/boolean_mask.cc — a dynamic-output-shape FComputeEx op).
+    Output shape depends on the VALUES of index, so this op is
+    eager-only; traced graphs use the static-shape redesign
+    `boolean_mask_fill` instead (same file, TPU pattern)."""
+    axis = int(attrs.get("axis", 0))
+    keep = jnp.nonzero(index.astype(bool))[0]
+    return jnp.take(data, keep, axis=axis)
+
+
+@register("_contrib_edge_id")
+def _contrib_edge_id(attrs, indptr, indices, data, u, v):
+    """CSR edge-id lookup: out[i] = data[e] if edge (u[i], v[i]) exists in
+    the CSR adjacency, else -1 (reference: contrib/dgl_graph.cc
+    _contrib_edge_id). The CSR container is unpacked by the NDArray
+    frontend (ndarray/contrib.py edge_id); here the three aux arrays are
+    explicit inputs — FComputeEx-over-CSR re-expressed functionally."""
+    u = u.astype(jnp.int32)
+    v = v.astype(jnp.int32)
+    row_start = indptr[u]
+    row_end = indptr[u + 1]
+
+    def lookup(rs, re, vv):
+        # masked probe over the row's column span — fixed bound, XLA
+        # vectorizes; nnz is small for graph adjacency data
+        offs = jnp.arange(indices.shape[0], dtype=jnp.int32)
+        inrow = (offs >= rs) & (offs < re)
+        hit = inrow & (indices.astype(jnp.int32) == vv)
+        eid = jnp.argmax(hit)
+        return jnp.where(jnp.any(hit), data[eid].astype(jnp.float32), -1.0)
+
+    return jax.vmap(lookup)(row_start, row_end, v)
+
+
+def _sparse_embedding_grad(attrs, prims, cts):
+    from ._op_tensor import _embedding_grad
+    a = dict(attrs)
+    a["sparse_grad"] = True
+    return _embedding_grad(a, prims, cts)
+
+
+@register("_contrib_SparseEmbedding", fgradient=_sparse_embedding_grad,
+          input_names=("data", "weight"))
+def _contrib_sparse_embedding(attrs, data, weight):
+    """Embedding whose weight gradient is row_sparse (reference:
+    indexing_op.cc SparseEmbedding). Same forward as Embedding; the
+    gradient rule forces the row-sparse cotangent path."""
+    idx = data.astype(jnp.int32)
+    return jnp.take(weight, jnp.clip(idx, 0, weight.shape[0] - 1), axis=0)
+
+
+def _kl_sparse_reg_grad(attrs, prims, cts):
+    data, moving_avg = prims
+    momentum = float(attrs.get("momentum", 0.9))
+    target = float(attrs.get("sparseness_target", 0.1))
+    penalty = float(attrs.get("penalty", 0.001))
+    flat = data.reshape(data.shape[0], -1)
+    avg = momentum * moving_avg + (1 - momentum) * flat.mean(axis=0)
+    pen = penalty * (-target / avg + (1 - target) / (1 - avg))
+    return (cts[0] + pen.reshape((1,) + data.shape[1:]).astype(data.dtype),
+            None)
+
+
+@register("IdentityAttachKLSparseReg", num_outputs=2, num_visible=1,
+          mutate_aux=(1,), fgradient=_kl_sparse_reg_grad)
+def _identity_attach_kl_sparse_reg(attrs, data, moving_avg):
+    """Identity that attaches a KL sparseness penalty to the gradient
+    (reference: identity_attach_KL_sparse_reg-inl.h). The running mean
+    of activations updates on forward here (the reference updates it in
+    backward; forward-update matches how BatchNorm running stats are
+    handled on this runtime) and the backward adds
+    penalty * (-rho/rho_hat + (1-rho)/(1-rho_hat))."""
+    momentum = float(attrs.get("momentum", 0.9))
+    flat = data.reshape(data.shape[0], -1)
+    new_avg = momentum * moving_avg + (1 - momentum) * flat.mean(axis=0)
+    return data, new_avg

@@ -280,3 +280,74 @@ def _multi_mp_sgd_mom_update(attrs, *args):
         ms.append(nm)
         w32s.append(nw32)
     return tuple(ws) + tuple(ms) + tuple(w32s)
+
+
+# --- round-4 named-op gap closers -------------------------------------------
+
+@register("ftml_update", num_outputs=4, mutate_aux=(2, 3, 4))
+def _ftml_update(attrs, weight, grad, d, v, z):
+    """FTML (reference: optimizer_op-inl.h FTMLKernel:~1215). Note the
+    reference clips AFTER adding wd*weight (clip_grad applies to the
+    regularized gradient), unlike sgd's clip-then-decay."""
+    lr = float(attrs["lr"])
+    beta1 = float(attrs.get("beta1", 0.6))
+    beta2 = float(attrs.get("beta2", 0.999))
+    eps = float(attrs.get("epsilon", 1e-8))
+    t = float(attrs["t"])
+    wd = float(attrs.get("wd", 0.0))
+    rescale = float(attrs.get("rescale_grad", 1.0))
+    clip = attrs.get("clip_grad", None)
+    clip = None if clip in (None, -1, -1.0) else float(clip)
+    g = rescale * grad + wd * weight
+    if clip is not None:
+        g = jnp.clip(g, -clip, clip)
+    v2 = beta2 * v + (1 - beta2) * jnp.square(g)
+    d_t = (1 - beta1 ** t) / lr * (jnp.sqrt(v2 / (1 - beta2 ** t)) + eps)
+    z2 = beta1 * z + (1 - beta1) * g - (d_t - beta1 * d) * weight
+    return -z2 / d_t, d_t, v2, z2
+
+
+@register("mp_nag_mom_update", num_outputs=3, mutate_aux=(2, 3))
+def _mp_nag_mom_update(attrs, weight, grad, mom, weight32):
+    """Multi-precision NAG: math in the f32 master copy (reference:
+    optimizer_op.cc mp_nag_mom_update)."""
+    lr, wd, rescale, clip = _common(attrs)
+    momentum = float(attrs.get("momentum", 0.0))
+    g = _prep_grad(grad, rescale, clip, jnp.float32) + wd * weight32
+    new_mom = momentum * mom + g
+    w32 = weight32 - lr * (g + momentum * new_mom)
+    return w32.astype(weight.dtype), new_mom, w32
+
+
+@register("_mp_adamw_update", alias=("mp_adamw_update",),
+          num_outputs=4, mutate_aux=(2, 3, 4))
+def _mp_adamw_update(attrs, weight, grad, mean, var, weight32):
+    """Multi-precision AdamW (reference: contrib/adamw.cc
+    _mp_adamw_update): adamw math on the f32 master weights."""
+    lr, wd, rescale, clip = _common(attrs)
+    eta = float(attrs.get("eta", 1.0))
+    beta1 = float(attrs.get("beta1", 0.9))
+    beta2 = float(attrs.get("beta2", 0.999))
+    eps = float(attrs.get("epsilon", 1e-8))
+    g = _prep_grad(grad, rescale, clip, jnp.float32)
+    m = beta1 * mean + (1 - beta1) * g
+    v = beta2 * var + (1 - beta2) * jnp.square(g)
+    w32 = weight32 - eta * (lr * m / (jnp.sqrt(v) + eps) + wd * weight32)
+    return w32.astype(weight.dtype), m, v, w32
+
+
+@register("_sparse_adagrad_update", alias=("sparse_adagrad_update",),
+          num_outputs=2, mutate_aux=(2,))
+def _sparse_adagrad_update(attrs, weight, grad, history):
+    """AdaGrad with per-row lazy semantics (reference: optimizer_op.cc
+    _sparse_adagrad_update — there grad is row_sparse and only touched
+    rows update; densely a zero grad row leaves w/h unchanged, which this
+    reproduces exactly: h += 0, w -= lr*0/... = w)."""
+    lr = float(attrs["lr"])
+    eps = float(attrs.get("epsilon", 1e-7))
+    rescale = float(attrs.get("rescale_grad", 1.0))
+    clip = attrs.get("clip_gradient", None)
+    clip = None if clip in (None, -1, -1.0) else float(clip)
+    g = _prep_grad(grad, rescale, clip)
+    h2 = history + jnp.square(g)
+    return weight - lr * g / (jnp.sqrt(h2) + eps), h2

@@ -160,3 +160,53 @@ def _quantized_pooling(attrs, qdata, min_d, max_d):
 def _quantized_flatten(attrs, qdata, min_d, max_d):
     return (qdata.reshape(qdata.shape[0], -1),
             min_d.reshape(()), max_d.reshape(()))
+
+
+@register("_contrib_quantized_act", alias=("quantized_act",), num_outputs=3)
+def _quantized_act(attrs, qdata, min_d, max_d):
+    """int8 activation (reference: quantization/quantized_activation.cc —
+    relu only, as there). Ranges pass through; negative values are
+    clamped in the int8 domain directly."""
+    act = attrs.get("act_type", "relu")
+    if act != "relu":
+        from ..base import MXNetError
+        raise MXNetError(f"quantized_act supports relu only, got {act}")
+    return (jnp.maximum(qdata, 0).astype(qdata.dtype),
+            min_d.reshape(()), max_d.reshape(()))
+
+
+@register("_contrib_quantized_concat", alias=("quantized_concat",),
+          num_outputs=3)
+def _quantized_concat(attrs, *args):
+    """Concat int8 inputs quantized with different scales (reference:
+    quantization/mkldnn/mkldnn_quantized_concat.cc): pick the widest
+    range, rescale every input onto it, concat. Inputs are laid out as
+    [d0..dn-1, min0, max0, min1, max1, ...]."""
+    n = (len(args)) // 3
+    datas, ranges = args[:n], args[n:]
+    amaxes = [jnp.maximum(_amax(ranges[2 * i].reshape(()),
+                                ranges[2 * i + 1].reshape(())), 1e-10)
+              for i in range(n)]
+    out_amax = amaxes[0]
+    for a in amaxes[1:]:
+        out_amax = jnp.maximum(out_amax, a)
+    dim = int(attrs.get("dim", 1))
+    parts = [jnp.clip(jnp.rint(d.astype(jnp.float32) * (a / out_amax)),
+                      -127, 127).astype(jnp.int8)
+             for d, a in zip(datas, amaxes)]
+    return jnp.concatenate(parts, axis=dim), -out_amax, out_amax
+
+
+@register("_contrib_quantized_elemwise_add", alias=("quantized_elemwise_add",),
+          num_outputs=3)
+def _quantized_elemwise_add(attrs, a, b, min_a, max_a, min_b, max_b):
+    """int8 + int8 -> int32 (reference:
+    quantization/quantized_elemwise_add.cc): the exact sum is
+    representable at int32 with out_range = range_a + range_b."""
+    amax_a = jnp.maximum(_amax(min_a.reshape(()), max_a.reshape(())), 1e-10)
+    amax_b = jnp.maximum(_amax(min_b.reshape(()), max_b.reshape(())), 1e-10)
+    out_amax = amax_a + amax_b
+    va = a.astype(jnp.float32) * (amax_a / _INT8_RANGE)
+    vb = b.astype(jnp.float32) * (amax_b / _INT8_RANGE)
+    out = jnp.rint((va + vb) / out_amax * _INT32_RANGE).astype(jnp.int32)
+    return out, -out_amax, out_amax

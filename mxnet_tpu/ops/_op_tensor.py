@@ -683,3 +683,175 @@ def _maximum_op(attrs, lhs, rhs):
 @register("_minimum", alias=("minimum",))
 def _minimum_op(attrs, lhs, rhs):
     return jnp.minimum(lhs, rhs)
+
+
+# --- round-4 named-op gap closers -------------------------------------------
+# Forward-facing reference registrations that were still missing from the
+# registry (VERDICT r03 coverage audit). Each cites its reference source.
+
+@register("hypot", alias=("_hypot",))
+def _hypot_binary(attrs, x, y):
+    """sqrt(x^2 + y^2) elementwise (reference:
+    tensor/elemwise_binary_op_extended.cc _hypot)."""
+    return jnp.hypot(x, y)
+
+
+# Non-broadcast elemwise mod/power (reference registers _mod/_power as the
+# same-shape variants of broadcast_mod/broadcast_power,
+# tensor/elemwise_binary_op_extended.cc). MXNet mod is fmod-style
+# (truncated, sign follows the dividend).
+register("_mod")(lambda attrs, x, y: jnp.fmod(x, y))
+register("_power")(lambda attrs, x, y: jnp.power(x, y))
+
+
+@register("batch_take")
+def _batch_take(attrs, a, indices):
+    """out[i] = a[i, indices[i]] (reference: tensor/indexing_op.cc
+    batch_take — a is (N, K), indices (N,))."""
+    idx = jnp.clip(indices.astype(jnp.int32), 0, a.shape[1] - 1)
+    return jnp.take_along_axis(a, idx[:, None], axis=1).squeeze(1)
+
+
+def _split_v2_norm(attrs):
+    """Normalize indices_or_sections: an int in the indices slot (the
+    python-frontend calling convention) means equal sections. A leading 0
+    in the indices tuple is the reference backend convention (its python
+    frontend prepends it, ndarray.py split_v2) — strip it so both the
+    with-0 (serialized reference graphs) and without-0 (direct calls)
+    forms yield the same splits and output count."""
+    ind = attrs.get("indices", ())
+    sections = int(attrs.get("sections", 0))
+    if isinstance(ind, (int, float)) and sections == 0:
+        sections, ind = int(ind), ()
+    ind = tuple(int(i) for i in ind)
+    if ind and ind[0] == 0:
+        ind = ind[1:]
+    return ind, sections
+
+
+def _split_v2_outs(attrs):
+    ind, sections = _split_v2_norm(attrs)
+    return sections if sections > 0 else len(ind) + 1
+
+
+@register("_split_v2", alias=("split_v2",), num_outputs=_split_v2_outs,
+          scalar_args=("indices", "axis", "squeeze_axis", "sections"))
+def _split_v2(attrs, x):
+    """Split by equal sections OR at explicit indices (reference:
+    tensor/matrix_op.cc _split_v2; python frontend split_v2)."""
+    axis = int(attrs.get("axis", 0))
+    squeeze = bool(attrs.get("squeeze_axis", False))
+    ind, sections = _split_v2_norm(attrs)
+    if sections > 0:
+        parts = jnp.split(x, sections, axis=axis)
+    else:
+        parts = jnp.split(x, list(ind), axis=axis)
+    if squeeze:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
+def _slice_assign_idx(attrs, lhs):
+    begin, end = attrs["begin"], attrs["end"]
+    step = attrs.get("step", None) or (1,) * len(begin)
+    return tuple(slice(b, e, s) for b, e, s in zip(begin, end, step))
+
+
+@register("_slice_assign", alias=("_crop_assign",))
+def _slice_assign(attrs, lhs, rhs):
+    """Functional x[begin:end] = rhs — returns lhs with the cropped region
+    replaced (reference: tensor/matrix_op.cc _slice_assign:529, backing
+    NDArray.__setitem__'s non-trivial path)."""
+    return lhs.at[_slice_assign_idx(attrs, lhs)].set(rhs.astype(lhs.dtype))
+
+
+@register("_slice_assign_scalar", alias=("_crop_assign_scalar",))
+def _slice_assign_scalar(attrs, lhs):
+    return lhs.at[_slice_assign_idx(attrs, lhs)].set(
+        jnp.asarray(float(attrs.get("scalar", 0.0)), lhs.dtype))
+
+
+@register("_scatter_set_nd")
+def _scatter_set_nd_op(attrs, lhs, rhs, indices):
+    """scatter_nd that keeps non-indexed elements of lhs (reference:
+    tensor/indexing_op.cc _scatter_set_nd:1008, backing x[idx_nd] = v)."""
+    return lhs.at[tuple(indices.astype(jnp.int32))].set(rhs.astype(lhs.dtype))
+
+
+# Scatter-mode elemwise variants (reference: tensor/elemwise_scatter_op.cc).
+# There they exist to keep row_sparse storage on the result; dense numerics
+# are identical to the plain ops, and the NDArray sparse layer preserves
+# stype. Registered so frontends/serialized graphs that name them resolve.
+register("_scatter_elemwise_div")(lambda attrs, x, y: x / y)
+register("_scatter_plus_scalar")(
+    lambda attrs, x: x + jnp.asarray(float(attrs.get("scalar", 0.0)), x.dtype))
+register("_scatter_minus_scalar")(
+    lambda attrs, x: x - jnp.asarray(float(attrs.get("scalar", 0.0)), x.dtype))
+
+
+@register("_identity_with_attr_like_rhs")
+def _identity_with_attr_like_rhs(attrs, lhs, rhs):
+    """Identity on lhs whose output storage attrs follow rhs (reference:
+    tensor/elemwise_unary_op_basic.cc — used by the gradient pass for
+    stype-preserving zeros). Storage type is an NDArray-layer concern here;
+    the dense value is lhs unchanged."""
+    return lhs
+
+
+@register("_zeros_without_dtype")
+def _zeros_without_dtype(attrs):
+    """zeros() with inferred-later dtype (reference: tensor/init_op.cc
+    _zeros_without_dtype, dtype=-1 → default float32)."""
+    dt = attrs.get("dtype", None)
+    dtype = np_dtype(dt) if dt not in (None, -1, "-1") else jnp.float32
+    return jnp.zeros(tuple(attrs["shape"]), dtype)
+
+
+@register("_rnn_param_concat")
+def _rnn_param_concat(attrs, *xs):
+    """Concat specialized for RNN parameter packing (reference:
+    tensor/matrix_op.cc _rnn_param_concat — same kernel as concat, shape
+    inference tolerates unknown param dims; here shapes are always known)."""
+    return jnp.concatenate(xs, axis=int(attrs.get("dim", 0)))
+
+
+@register("hard_sigmoid")
+def _hard_sigmoid(attrs, x):
+    """clip(alpha*x + beta, 0, 1) (reference:
+    tensor/elemwise_unary_op_basic.cc hard_sigmoid)."""
+    alpha = float(attrs.get("alpha", 0.2))
+    beta = float(attrs.get("beta", 0.5))
+    return jnp.clip(alpha * x + beta, 0.0, 1.0)
+
+
+@register("square_sum", alias=("_square_sum",))
+def _square_sum(attrs, x):
+    """sum(x*x) over axis (reference: tensor/square_sum-inl.h — fused
+    square+sum written for row_sparse gradients; XLA fuses the dense form)."""
+    axis = attrs.get("axis", None)
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(int(a) for a in axis)
+    elif axis is not None:
+        axis = int(axis)
+    return jnp.sum(jnp.square(x), axis=axis,
+                   keepdims=bool(attrs.get("keepdims", False)))
+
+
+@register("sparse_retain", alias=("_sparse_retain",))
+def _sparse_retain_op(attrs, data, indices):
+    """Keep only the rows named by indices, zero the rest (reference:
+    tensor/sparse_retain-inl.h — there data is row_sparse; the dense
+    semantics are a row mask)."""
+    idx = jnp.clip(indices.astype(jnp.int32), 0, data.shape[0] - 1)
+    mask = jnp.zeros((data.shape[0],), jnp.bool_).at[idx].set(True)
+    return jnp.where(mask.reshape((-1,) + (1,) * (data.ndim - 1)), data,
+                     jnp.zeros((), data.dtype))
+
+
+@register("cast_storage")
+def _cast_storage_op(attrs, x):
+    """Dense compute of cast_storage (reference: tensor/cast_storage-inl.h).
+    The value is unchanged; actual dense<->row_sparse/csr container
+    conversion happens in ndarray.sparse.cast_storage, which the NDArray
+    frontend routes to for stype != 'default'."""
+    return x

@@ -87,10 +87,16 @@ class Operator:
 
     def __init__(self, name, fcompute, num_outputs=1, is_random=False,
                  mutate_aux=(), fgradient=None, alias=(), scalar_args=("scalar",),
-                 num_visible=None, input_names=None):
+                 num_visible=None, input_names=None, eager_only=False):
         self.name = name
         self.fcompute = fcompute
         self.num_outputs = num_outputs
+        # eager_only: op produces data-dependent (dynamic) shapes — legal in
+        # eager jax, illegal under jit/trace. The imperative path runs it
+        # unjitted; traced paths (CachedOp/executor) reject it with a clear
+        # error. Parity: the reference's dynamic-shape FComputeEx ops
+        # (contrib.boolean_mask, np_nonzero-class).
+        self.eager_only = eager_only
         # outputs beyond num_visible are internal (parity: the reference's
         # FNumVisibleOutputs, e.g. box_nms hides its index record)
         self.num_visible = num_visible
@@ -234,14 +240,15 @@ class Operator:
 
 def register(name, num_outputs=1, is_random=False, mutate_aux=(),
              fgradient=None, alias=(), scalar_args=("scalar",),
-             num_visible=None, input_names=None):
+             num_visible=None, input_names=None, eager_only=False):
     """Decorator: register fcompute under ``name`` (+ aliases)."""
 
     def deco(fcompute):
         op = Operator(name, fcompute, num_outputs=num_outputs,
                       is_random=is_random, mutate_aux=mutate_aux,
                       fgradient=fgradient, alias=alias, scalar_args=scalar_args,
-                      num_visible=num_visible, input_names=input_names)
+                      num_visible=num_visible, input_names=input_names,
+                      eager_only=eager_only)
         if name in _OPS:
             raise MXNetError(f"op {name} already registered")
         _OPS[name] = op

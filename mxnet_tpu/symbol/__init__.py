@@ -2,6 +2,7 @@
 from .symbol import (Symbol, Group, Variable, var, load, load_json, zeros,
                      ones, arange)
 from . import contrib  # noqa: F401
+from . import image  # noqa: F401
 from ..ops import registry as _registry
 
 
@@ -9,7 +10,8 @@ def _make_sym_func(op):
     def fn(*args, name=None, attr=None, **kwargs):
         inputs = [a for a in args if isinstance(a, Symbol)]
         scalars = [a for a in args
-                   if not isinstance(a, Symbol) and isinstance(a, (int, float))]
+                   if not isinstance(a, Symbol)
+                   and isinstance(a, (int, float, bool, str, tuple, list))]
         for attr_name, val in zip(op.scalar_args, scalars):
             kwargs.setdefault(attr_name, val)
         # Symbol-valued kwargs are INPUTS named by role (reference generated

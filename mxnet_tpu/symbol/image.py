@@ -1,0 +1,21 @@
+"""mx.sym.image — symbolic namespace over the `_image_*` operator family
+(reference: python/mxnet/symbol/image.py)."""
+from __future__ import annotations
+
+from ..ndarray.image import _IMAGE_OPS
+from ..ops import registry as _registry
+
+
+def __getattr__(name):
+    op_name = _IMAGE_OPS.get(name)
+    if op_name is not None:
+        from . import _make_sym_func
+        fn = _make_sym_func(_registry.get(op_name))
+        globals()[name] = fn
+        return fn
+    raise AttributeError(
+        f"module 'mxnet_tpu.symbol.image' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_IMAGE_OPS))

@@ -1,0 +1,212 @@
+"""PyTorch interop bridge (parity surface: python/mxnet/torch.py + the
+reference's plugin/torch — there a Lua-Torch TorchModule/criterion bridge
+compiled in with USE_TORCH=1 and exposed as `mx.th.*`).
+
+TPU-era redesign: the modern torch is PyTorch, and the bridge rides the
+framework's custom-op host-callback machinery (mxnet_tpu.operator — the
+same design the reference used for its Python custom-op host,
+src/operator/custom/custom-inl.h:52):
+
+- ``to_torch`` / ``from_torch``: NDArray <-> torch.Tensor conversion
+  (host-side copy; torch in this stack is a CPU library, the NDArray may
+  live on TPU).
+- ``function(fn)``: wrap any differentiable torch callable as an
+  mx-callable op. Imperative AND traced (hybridize/jit) paths work; the
+  backward runs torch.autograd under the hood, so mx.autograd sees a
+  proper gradient. Under jit the call stages as a ``jax.pure_callback``
+  at the exact graph position.
+- ``TorchBlock``: wrap a ``torch.nn.Module`` as a gluon Block whose
+  parameters ARE gluon Parameters (initialized from the module's state);
+  forward runs the module functionally (``torch.func.functional_call``)
+  so gluon.Trainer/optimizers train it like any native block.
+
+Everything degrades with a clear MXNetError when torch is absent.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+from .context import current_context
+from .ndarray import NDArray
+from . import ndarray as ndmod
+from .operator import CustomOp, _custom_imperative, _custom_traced
+
+
+def _torch():
+    try:
+        import torch
+        return torch
+    except ImportError as e:  # pragma: no cover - torch is in this image
+        raise MXNetError("the torch bridge requires pytorch") from e
+
+
+def to_torch(arr):
+    """NDArray -> torch.Tensor (host copy)."""
+    torch = _torch()
+    if isinstance(arr, NDArray):
+        arr = arr.asnumpy()
+    # copy: jax host buffers are read-only views, torch wants writable
+    return torch.from_numpy(np.array(arr, copy=True))
+
+
+def from_torch(tensor, ctx=None):
+    """torch.Tensor -> NDArray."""
+    return ndmod.array(tensor.detach().cpu().numpy(),
+                       ctx=ctx or current_context())
+
+
+class _TorchFnOp(CustomOp):
+    """CustomOp whose forward is a torch callable and whose backward is
+    torch.autograd over a recomputed forward (the op is stateless between
+    calls — same contract as the reference custom-op host)."""
+
+    def __init__(self, fn, num_outputs=1):
+        self.fn = fn
+        self.num_outputs = num_outputs
+
+    def _run(self, in_data, needs_grad):
+        torch = _torch()
+        tins = [to_torch(x).float().requires_grad_(needs_grad)
+                for x in in_data]
+        outs = self.fn(*tins)
+        if not isinstance(outs, (tuple, list)):
+            outs = (outs,)
+        return tins, tuple(outs)
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        _, touts = self._run(in_data, needs_grad=False)
+        if len(touts) != len(out_data):
+            raise MXNetError(
+                f"torch fn returned {len(touts)} outputs, expected "
+                f"{len(out_data)}")
+        for dst, t, r in zip(out_data, touts, req):
+            self.assign(dst, r, from_torch(t, ctx=dst._ctx))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        torch = _torch()
+        tins, touts = self._run(in_data, needs_grad=True)
+        gouts = [to_torch(g).float().reshape(t.shape)
+                 for g, t in zip(out_grad, touts)]
+        grads = torch.autograd.grad(touts, tins, grad_outputs=gouts,
+                                    allow_unused=True)
+        for dst, g, r in zip(in_grad, grads, req):
+            if g is None:
+                continue
+            self.assign(dst, r, from_torch(g, ctx=dst._ctx))
+
+
+class _Shim:
+    """Minimal prop stand-in (unused by the call paths, kept for symmetry
+    with operator.custom)."""
+
+
+def function(fn, num_outputs=1, infer_shape=None):
+    """Wrap a torch callable as an mx op.
+
+        gelu = mx.torch_bridge.function(torch.nn.functional.gelu)
+        y = gelu(x)                      # NDArray in, NDArray out
+        # differentiable: works under mx.autograd.record()
+
+    infer_shape(in_shapes) -> [out_shapes] overrides the default dry-run
+    inference (needed under hybridize when shapes cannot be probed)."""
+    shape_cache = {}
+
+    def call(*inputs):
+        nd_inputs = [i for i in inputs if isinstance(i, NDArray)]
+        if not nd_inputs:
+            raise MXNetError("torch function op needs NDArray inputs")
+        ctx = nd_inputs[0]._ctx
+        op = _TorchFnOp(fn, num_outputs)
+        in_shapes = [tuple(i.shape) for i in nd_inputs]
+        key = tuple(in_shapes)
+        out_shapes = shape_cache.get(key)
+        if out_shapes is None:
+            if infer_shape is not None:
+                out_shapes = list(infer_shape(in_shapes))
+            else:
+                # one host dry-run on zero tensors per input signature —
+                # cached, so steady-state calls pay no extra torch forward
+                torch = _torch()
+                with torch.no_grad():
+                    touts = fn(*[torch.zeros(s) for s in in_shapes])
+                if not isinstance(touts, (tuple, list)):
+                    touts = (touts,)
+                out_shapes = [tuple(t.shape) for t in touts]
+            shape_cache[key] = out_shapes
+        out_types = [nd_inputs[0].dtype] * len(out_shapes)
+        import jax
+        traced = any(isinstance(i._data, jax.core.Tracer)
+                     for i in nd_inputs)
+        if traced:
+            return _custom_traced(op, _Shim(), nd_inputs, out_shapes,
+                                  out_types, ctx)
+        return _custom_imperative(op, _Shim(), nd_inputs, out_shapes,
+                                  out_types, ctx)
+
+    call.__name__ = getattr(fn, "__name__", "torch_fn")
+    return call
+
+
+class TorchBlock:
+    """Gluon Block wrapping a torch.nn.Module; the module's parameters
+    become gluon Parameters so Trainer/optimizers/save_parameters all
+    work. Forward runs torch functionally with the CURRENT gluon
+    parameter values (torch.func.functional_call), so the bridge is
+    stateless and gradient updates take effect immediately.
+
+        net = TorchBlock(torch.nn.Linear(4, 2))
+        trainer = gluon.Trainer(net.collect_params(), "sgd", ...)
+    """
+
+    def __new__(cls, module):
+        torch = _torch()
+        from .gluon.block import Block
+        from .gluon.parameter import ParameterDict
+
+        class _Wrapped(Block):
+            def __init__(self, mod):
+                super().__init__(prefix="torch_")
+                self._mod = mod
+                self._pnames = []
+                for name, p in mod.named_parameters():
+                    safe = name.replace(".", "_")
+                    param = self.params.get(
+                        safe, shape=tuple(p.shape), dtype="float32")
+                    self._pnames.append((name, safe))
+                    param._torch_init = p.detach().cpu().numpy()
+
+            def initialize(self, *a, **kw):
+                super().initialize(*a, **kw)
+                # seed gluon params from the torch module's own init
+                for name, safe in self._pnames:
+                    p = self.params.get(safe)
+                    init = getattr(p, "_torch_init", None)
+                    if init is not None:
+                        p.set_data(ndmod.array(init))
+
+            def _wrapped_for(self, n_in):
+                # one wrapper per input arity; its shape cache then makes
+                # steady-state steps run ONE torch forward, not two
+                cache = self.__dict__.setdefault("_fn_cache", {})
+                wrapped = cache.get(n_in)
+                if wrapped is None:
+                    mod = self._mod
+                    names = [n for n, _ in self._pnames]
+
+                    def fn(*tensors):
+                        tin, tparams = tensors[:n_in], tensors[n_in:]
+                        pdict = dict(zip(names, tparams))
+                        return torch.func.functional_call(mod, pdict, tin)
+
+                    wrapped = function(fn)
+                    cache[n_in] = wrapped
+                return wrapped
+
+            def forward(self, *inputs):
+                wrapped = self._wrapped_for(len(inputs))
+                pvals = [self.params.get(safe).data()
+                         for _, safe in self._pnames]
+                return wrapped(*inputs, *pvals)
+
+        return _Wrapped(module)

@@ -37,10 +37,13 @@ def test_op_hpp_in_sync(tmp_path):
     # Regenerate in a FRESH interpreter: tests earlier in the suite register
     # ad-hoc ops into the live registry, which would leak into generate().
     out = tmp_path / "op.hpp"
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # don't dial the TPU relay
+    env["JAX_PLATFORMS"] = "cpu"
     subprocess.run(
         [sys.executable, os.path.join(_REPO, "cpp-package",
                                       "OpWrapperGenerator.py"), str(out)],
-        check=True, timeout=300, cwd=_REPO)
+        check=True, timeout=300, cwd=_REPO, env=env)
     want = out.read_text()
     path = os.path.join(_REPO, "cpp-package", "include", "mxnet_tpu",
                         "op.hpp")

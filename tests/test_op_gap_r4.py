@@ -1,0 +1,504 @@
+"""Round-4 named-op gap closers: every forward-facing op the reference
+registers that was missing from the registry (VERDICT r03 audit + the
+`MXNET_REGISTER_IMAGE_*` macro family the audit's regex missed).
+
+Forward values check against NumPy oracles (reference test strategy,
+SURVEY.md §4); update ops check against hand-computed reference formulas
+(reference: tests/python/unittest/test_optimizer.py pattern).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+
+
+def _np(x):
+    return x.asnumpy()
+
+
+# --- tensor ops -------------------------------------------------------------
+
+def test_hypot():
+    a, b = nd.array([3.0, 5.0]), nd.array([4.0, 12.0])
+    np.testing.assert_allclose(_np(nd.hypot(a, b)), [5.0, 13.0], rtol=1e-6)
+
+
+def test_mod_power_elemwise():
+    x = nd.array([5.0, -5.0, 7.5])
+    y = nd.array([3.0, 3.0, 2.0])
+    np.testing.assert_allclose(_np(nd._mod(x, y)), np.fmod([5, -5, 7.5],
+                                                           [3, 3, 2]))
+    np.testing.assert_allclose(_np(nd._power(x, y)), [125.0, -125.0, 56.25])
+
+
+def test_batch_take():
+    a = nd.array(np.arange(12.0).reshape(3, 4))
+    out = nd.batch_take(a, nd.array([0, 3, 1]))
+    np.testing.assert_allclose(_np(out), [0.0, 7.0, 9.0])
+
+
+def test_split_v2_sections_and_indices():
+    x = nd.array(np.arange(12.0).reshape(3, 4))
+    parts = nd.split_v2(x, 2, axis=1)
+    assert len(parts) == 2 and parts[0].shape == (3, 2)
+    parts = nd.split_v2(x, (1, 3), axis=1)
+    assert [p.shape for p in parts] == [(3, 1), (3, 2), (3, 1)]
+    np.testing.assert_allclose(_np(parts[1]), _np(x)[:, 1:3])
+    sq = nd.split_v2(x, 3, axis=0, squeeze_axis=True)
+    assert sq[0].shape == (4,)
+
+
+def test_slice_assign():
+    x = nd.zeros((3, 4))
+    v = nd.ones((2, 2))
+    out = nd._slice_assign(x, v, begin=(0, 1), end=(2, 3))
+    expect = np.zeros((3, 4))
+    expect[0:2, 1:3] = 1
+    np.testing.assert_allclose(_np(out), expect)
+    out = nd._slice_assign_scalar(x, begin=(1, 0), end=(3, 2), scalar=7.0)
+    expect = np.zeros((3, 4))
+    expect[1:3, 0:2] = 7
+    np.testing.assert_allclose(_np(out), expect)
+
+
+def test_slice_assign_backs_setitem():
+    # NDArray.__setitem__ with a strided slice should route through the
+    # functional assign and preserve other elements
+    x = nd.array(np.arange(16.0).reshape(4, 4))
+    x[1:3, 1:3] = nd.ones((2, 2)) * -1
+    e = np.arange(16.0).reshape(4, 4)
+    e[1:3, 1:3] = -1
+    np.testing.assert_allclose(_np(x), e)
+
+
+def test_scatter_set_nd():
+    lhs = nd.zeros((2, 2))
+    rhs = nd.array([2.0, 3.0, 0.0])
+    indices = nd.array(np.array([[1, 1, 0], [0, 1, 0]]))
+    # reference docstring example (indexing_op.cc:1008): points are read
+    # per-dimension-row -> (1,0)=2, (1,1)=3, (0,0)=0
+    out = nd._scatter_set_nd(lhs, rhs, indices)
+    np.testing.assert_allclose(_np(out), [[0.0, 0.0], [2.0, 3.0]])
+
+
+def test_scatter_elemwise_variants():
+    x = nd.array([4.0, 9.0])
+    y = nd.array([2.0, 3.0])
+    np.testing.assert_allclose(_np(nd._scatter_elemwise_div(x, y)), [2, 3])
+    np.testing.assert_allclose(_np(nd._scatter_plus_scalar(x, 1.0)), [5, 10])
+    np.testing.assert_allclose(_np(nd._scatter_minus_scalar(x, 1.0)), [3, 8])
+
+
+def test_identity_with_attr_like_rhs():
+    a = nd.array([1.0, 2.0])
+    b = nd.zeros((2,))
+    np.testing.assert_allclose(_np(nd._identity_with_attr_like_rhs(a, b)),
+                               [1.0, 2.0])
+
+
+def test_zeros_without_dtype():
+    z = nd._zeros_without_dtype(shape=(2, 3))
+    assert z.shape == (2, 3) and z.dtype == np.float32
+    assert float(_np(z).sum()) == 0.0
+
+
+def test_rnn_param_concat():
+    a, b = nd.ones((2, 3)), nd.zeros((1, 3))
+    out = nd._rnn_param_concat(a, b, dim=0)
+    assert out.shape == (3, 3)
+
+
+def test_hard_sigmoid():
+    x = nd.array([-10.0, 0.0, 10.0, 1.0])
+    np.testing.assert_allclose(_np(nd.hard_sigmoid(x)), [0.0, 0.5, 1.0, 0.7],
+                               rtol=1e-6)
+    # gradient: alpha inside the linear band, 0 outside
+    x = mx.nd.array([-10.0, 0.0, 10.0])
+    x.attach_grad()
+    with mx.autograd.record():
+        y = nd.hard_sigmoid(x)
+    y.backward(nd.ones((3,)))
+    np.testing.assert_allclose(_np(x.grad), [0.0, 0.2, 0.0], atol=1e-6)
+
+
+def test_square_sum():
+    x = nd.array(np.arange(6.0).reshape(2, 3))
+    np.testing.assert_allclose(_np(nd.square_sum(x, axis=1)),
+                               (np.arange(6.0).reshape(2, 3) ** 2).sum(1))
+    assert nd.square_sum(x, axis=1, keepdims=True).shape == (2, 1)
+
+
+def test_sparse_retain():
+    x = nd.array(np.arange(12.0).reshape(4, 3))
+    out = nd.sparse_retain(x, nd.array([0, 2]))
+    e = np.zeros((4, 3))
+    e[[0, 2]] = np.arange(12.0).reshape(4, 3)[[0, 2]]
+    np.testing.assert_allclose(_np(out), e)
+
+
+def test_cast_storage_op_dense():
+    x = nd.array([[1.0, 0.0], [0.0, 2.0]])
+    np.testing.assert_allclose(_np(nd.cast_storage(x)), _np(x))
+
+
+# --- optimizer updates ------------------------------------------------------
+
+def test_ftml_update_matches_reference_formula():
+    rng = np.random.RandomState(0)
+    w = rng.randn(5).astype(np.float32)
+    g = rng.randn(5).astype(np.float32)
+    d = np.zeros(5, np.float32)
+    v = np.zeros(5, np.float32)
+    z = np.zeros(5, np.float32)
+    lr, b1, b2, eps, t, wd = 0.1, 0.6, 0.999, 1e-8, 1, 0.01
+    out = nd.ftml_update(nd.array(w), nd.array(g), nd.array(d), nd.array(v),
+                         nd.array(z), lr=lr, beta1=b1, beta2=b2, epsilon=eps,
+                         t=t, wd=wd)
+    # reference FTMLKernel (optimizer_op-inl.h)
+    ge = g + wd * w
+    ve = b2 * v + (1 - b2) * ge ** 2
+    dt = (1 - b1 ** t) / lr * (np.sqrt(ve / (1 - b2 ** t)) + eps)
+    ze = b1 * z + (1 - b1) * ge - (dt - b1 * d) * w
+    np.testing.assert_allclose(_np(out), -ze / dt, rtol=1e-5)
+
+
+def test_mp_nag_and_mp_adamw_track_fp32_master():
+    w = nd.array(np.ones(4, np.float32)).astype("float16") \
+        if hasattr(nd.NDArray, "astype") else nd.ones((4,))
+    w16 = nd.ones((4,), dtype="float16")
+    g16 = nd.ones((4,), dtype="float16")
+    mom = nd.zeros((4,))
+    w32 = nd.ones((4,))
+    out = nd.mp_nag_mom_update(w16, g16, mom, w32, lr=0.1, momentum=0.9)
+    assert out.dtype == np.float16
+    # one NAG step from m=0: m=g, w -= lr*(g + mu*m)
+    np.testing.assert_allclose(_np(mom), np.ones(4), rtol=1e-6)
+    np.testing.assert_allclose(_np(w32), 1 - 0.1 * (1 + 0.9), rtol=1e-6)
+
+    mean, var = nd.zeros((4,)), nd.zeros((4,))
+    w32b = nd.ones((4,))
+    out = nd._mp_adamw_update(w16, g16, mean, var, w32b, lr=0.1, eta=1.0,
+                              wd=0.0)
+    m = 0.1  # (1-beta1)*g
+    v = 0.001  # (1-beta2)*g^2
+    np.testing.assert_allclose(_np(w32b), 1 - 0.1 * m / (np.sqrt(v) + 1e-8),
+                               rtol=1e-5)
+
+
+def test_sparse_adagrad_update_rows_untouched_by_zero_grad():
+    w = nd.ones((3, 2))
+    g = nd.zeros((3, 2))
+    gnp = np.zeros((3, 2), np.float32)
+    gnp[1] = 2.0
+    g = nd.array(gnp)
+    h = nd.zeros((3, 2))
+    out = nd._sparse_adagrad_update(w, g, h, lr=0.5)
+    o = _np(out)
+    np.testing.assert_allclose(o[0], [1.0, 1.0])  # untouched row
+    np.testing.assert_allclose(o[2], [1.0, 1.0])
+    assert (o[1] < 1.0).all()
+    np.testing.assert_allclose(_np(h)[1], [4.0, 4.0])
+
+
+# --- contrib ----------------------------------------------------------------
+
+def test_contrib_boolean_mask_eager_dynamic_shape():
+    data = nd.array(np.arange(12.0).reshape(4, 3))
+    index = nd.array([0, 1, 0, 1])
+    out = mx.nd.contrib.boolean_mask(data, index)
+    assert out.shape == (2, 3)
+    np.testing.assert_allclose(_np(out),
+                               np.arange(12.0).reshape(4, 3)[[1, 3]])
+
+
+def test_contrib_boolean_mask_gradient():
+    """Backward = scatter of kept-row cotangents (reference:
+    boolean_mask-inl.h BooleanMaskBackward); the dynamic-shape op must
+    still record on the imperative tape."""
+    data = nd.array(np.arange(12.0).reshape(4, 3))
+    data.attach_grad()
+    idx = nd.array([0, 1, 0, 1])
+    with mx.autograd.record():
+        out = mx.nd.contrib.boolean_mask(data, idx)
+    out.backward(nd.ones((2, 3)))
+    g = _np(data.grad)
+    np.testing.assert_allclose(g[[1, 3]], 1.0)
+    np.testing.assert_allclose(g[[0, 2]], 0.0)
+
+
+def test_split_v2_reference_leading_zero_indices():
+    """Reference-serialized graphs carry indices with the python
+    frontend's prepended 0 (ndarray.py split_v2); both forms must give
+    identical splits."""
+    x = nd.array(np.arange(12.0).reshape(3, 4))
+    a = nd.split_v2(x, (0, 1, 3), axis=1)
+    b = nd.split_v2(x, (1, 3), axis=1)
+    assert [p.shape for p in a] == [p.shape for p in b]
+    for pa, pb in zip(a, b):
+        np.testing.assert_allclose(_np(pa), _np(pb))
+
+
+def test_contrib_boolean_mask_rejects_tracing():
+    import mxnet_tpu.gluon as gluon
+
+    class Net(gluon.HybridBlock):
+        def hybrid_forward(self, F, x):
+            return F._contrib_boolean_mask(x, x) if hasattr(
+                F, "_contrib_boolean_mask") else x
+
+    data = nd.array([0.0, 1.0])
+    net = Net()
+    net.hybridize()
+    with pytest.raises(mx.MXNetError):
+        net(data).asnumpy()
+
+
+def test_contrib_edge_id():
+    from mxnet_tpu.ndarray import sparse
+    # adjacency: row0 -> cols {1: e=10, 2: e=11}; row1 -> {0: e=12}
+    csr = sparse.CSRNDArray(nd.array([10.0, 11.0, 12.0]),
+                            nd.array([1, 2, 0]),
+                            nd.array([0, 2, 3, 3]), shape=(3, 3))
+    out = mx.nd.contrib.edge_id(csr, nd.array([0, 0, 1, 2]),
+                                nd.array([2, 0, 0, 1]))
+    np.testing.assert_allclose(_np(out), [11.0, -1.0, 12.0, -1.0])
+
+
+def test_contrib_sparse_embedding_forward_and_sparse_grad():
+    from mxnet_tpu.autograd import SparseCot
+    data = nd.array([1, 0, 1])
+    weight = nd.array(np.arange(8.0).reshape(4, 2))
+    weight.attach_grad(stype="row_sparse")
+    with mx.autograd.record():
+        out = nd._contrib_SparseEmbedding(data, weight)
+    out.backward(nd.ones((3, 2)))
+    g = weight.grad
+    dense = g.asnumpy() if not hasattr(g, "todense") else _np(g.todense())
+    expect = np.zeros((4, 2))
+    expect[1] = 2.0  # looked up twice
+    expect[0] = 1.0
+    np.testing.assert_allclose(dense, expect)
+
+
+def test_identity_attach_kl_sparse_reg():
+    data = nd.array(np.full((2, 3), 0.5, np.float32))
+    moving = nd.zeros((3,))
+    out = nd.IdentityAttachKLSparseReg(data, moving, momentum=0.9)
+    np.testing.assert_allclose(_np(out), 0.5)
+    # moving average updated in place: 0.9*0 + 0.1*0.5
+    np.testing.assert_allclose(_np(moving), 0.05, rtol=1e-6)
+    # gradient = upstream + penalty*(-rho/avg + (1-rho)/(1-avg))
+    data = nd.array(np.full((2, 3), 0.5, np.float32))
+    data.attach_grad()
+    moving = nd.array(np.full((3,), 0.5, np.float32))
+    with mx.autograd.record():
+        out = nd.IdentityAttachKLSparseReg(data, moving, momentum=1.0,
+                                           sparseness_target=0.1,
+                                           penalty=0.001)
+    out.backward(nd.ones((2, 3)))
+    pen = 0.001 * (-0.1 / 0.5 + 0.9 / 0.5)
+    np.testing.assert_allclose(_np(data.grad), 1.0 + pen, rtol=1e-5)
+
+
+# --- quantized --------------------------------------------------------------
+
+def test_quantized_act_relu():
+    q = nd.array(np.array([-5, 0, 7], np.int8), dtype="int8")
+    out, mn, mx_ = nd._contrib_quantized_act(q, nd.array([-1.0]),
+                                             nd.array([1.0]))
+    np.testing.assert_array_equal(_np(out), [0, 0, 7])
+
+
+def test_quantized_concat_rescales_to_widest():
+    a = nd.array(np.array([127, -127], np.int8), dtype="int8")   # range 1.0
+    b = nd.array(np.array([127, 0], np.int8), dtype="int8")      # range 2.0
+    out, mn, mx_ = nd._contrib_quantized_concat(
+        a, b, nd.array([-1.0]), nd.array([1.0]),
+        nd.array([-2.0]), nd.array([2.0]), dim=0)
+    # a rescaled onto range 2: 127 -> 63.5 -> 64 (round-half-even 63.5 -> 64)
+    vals = _np(out)
+    assert abs(int(vals[0])) in (63, 64)
+    assert int(vals[2]) == 127
+    assert float(_np(mx_)) == pytest.approx(2.0)
+
+
+def test_quantized_elemwise_add_exact():
+    a = nd.array(np.array([127], np.int8), dtype="int8")  # = 1.0 at range 1
+    b = nd.array(np.array([-127], np.int8), dtype="int8")  # = -2.0 at range 2
+    out, mn, mx_ = nd._contrib_quantized_elemwise_add(
+        a, b, nd.array([-1.0]), nd.array([1.0]),
+        nd.array([-2.0]), nd.array([2.0]))
+    amax = 3.0
+    got = float(_np(out)[0]) / (2 ** 31 - 1) * amax
+    assert got == pytest.approx(-1.0, abs=1e-6)
+
+
+# --- image family -----------------------------------------------------------
+
+def test_image_to_tensor_and_normalize():
+    img = nd.array(np.arange(24, dtype=np.uint8).reshape(2, 4, 3),
+                   dtype="uint8")
+    t = mx.nd.image.to_tensor(img)
+    assert t.shape == (3, 2, 4)
+    np.testing.assert_allclose(
+        _np(t), np.arange(24, dtype=np.float32).reshape(2, 4, 3)
+        .transpose(2, 0, 1) / 255.0, rtol=1e-6)
+    norm = mx.nd.image.normalize(t, mean=(0.5, 0.5, 0.5), std=(2, 2, 2))
+    np.testing.assert_allclose(_np(norm), (_np(t) - 0.5) / 2.0, rtol=1e-5)
+
+
+def test_image_crop_resize_flip():
+    img = nd.array(np.arange(48.0).reshape(4, 4, 3))
+    c = mx.nd.image.crop(img, 1, 0, 2, 3)  # x=1 y=0 w=2 h=3
+    assert c.shape == (3, 2, 3)
+    np.testing.assert_allclose(_np(c), _np(img)[0:3, 1:3, :])
+    r = mx.nd.image.resize(img, (2, 2))
+    assert r.shape == (2, 2, 3)
+    f = mx.nd.image.flip_left_right(img)
+    np.testing.assert_allclose(_np(f), _np(img)[:, ::-1, :])
+    f = mx.nd.image.flip_top_bottom(img)
+    np.testing.assert_allclose(_np(f), _np(img)[::-1, :, :])
+    # batched NHWC
+    bat = nd.array(np.arange(96.0).reshape(2, 4, 4, 3))
+    assert mx.nd.image.resize(bat, (2, 2)).shape == (2, 2, 2, 3)
+
+
+def test_image_resize_keep_ratio():
+    img = nd.array(np.zeros((4, 8, 3), np.float32))
+    out = mx.nd.image.resize(img, 2, True)  # shorter side -> 2
+    assert out.shape == (2, 4, 3)
+
+
+def test_image_random_ops_shapes_and_determinism():
+    mx.random.seed(7)
+    img = nd.array(np.full((4, 4, 3), 128.0, np.float32))
+    for fn in (mx.nd.image.random_flip_left_right,
+               mx.nd.image.random_flip_top_bottom):
+        assert fn(img).shape == img.shape
+    out = mx.nd.image.random_brightness(img, 0.5, 1.5)
+    assert out.shape == img.shape
+    out = mx.nd.image.random_contrast(img, 0.5, 1.5)
+    assert out.shape == img.shape
+    out = mx.nd.image.random_saturation(img, 0.5, 1.5)
+    assert out.shape == img.shape
+    out = mx.nd.image.random_hue(img, 0.9, 1.1)
+    assert out.shape == img.shape
+    out = mx.nd.image.random_color_jitter(img, brightness=0.1, contrast=0.1,
+                                          saturation=0.1, hue=0.1)
+    assert out.shape == img.shape
+    # seeded reproducibility (op RNG rides mx.random)
+    mx.random.seed(3)
+    a = _np(mx.nd.image.random_brightness(img, 0.5, 1.5))
+    mx.random.seed(3)
+    b = _np(mx.nd.image.random_brightness(img, 0.5, 1.5))
+    np.testing.assert_allclose(a, b)
+
+
+def test_image_random_factors_actually_apply():
+    """Positional min/max factors must reach the op attrs (regression:
+    they were silently dropped into the default 'scalar' slot)."""
+    img = nd.array(np.full((2, 2, 3), 100.0, np.float32))
+    # degenerate U(2,2) -> exactly x2 brightness
+    out = _np(mx.nd.image.random_brightness(img, 2.0, 2.0))
+    np.testing.assert_allclose(out, 200.0, rtol=1e-6)
+    # degenerate saturation 0 -> grayscale of a colored pixel
+    col = nd.array(np.array([[[10.0, 200.0, 30.0]]], np.float32))
+    g = _np(mx.nd.image.random_saturation(col, 0.0, 0.0))
+    np.testing.assert_allclose(g[..., 0], g[..., 1], rtol=1e-5)
+    # hue factor 1.0 is the identity point
+    h = _np(mx.nd.image.random_hue(col, 1.0, 1.0))
+    np.testing.assert_allclose(h, _np(col), atol=1e-3)
+    # normalize with positional mean/std tuples
+    t = nd.array(np.full((3, 2, 2), 1.0, np.float32))
+    n = _np(mx.nd.image.normalize(t, (0.5, 0.5, 0.5), (0.25, 0.25, 0.25)))
+    np.testing.assert_allclose(n, 2.0, rtol=1e-6)
+
+
+def test_symbol_side_tuple_scalars():
+    """mx.sym wrappers must capture tuple positionals like nd does."""
+    import mxnet_tpu.symbol as sym
+    x = sym.Variable("x")
+    outs = sym.split_v2(x, (1, 3), axis=1)
+    ex = outs.bind(mx.cpu(), {"x": nd.array(np.arange(12.0).reshape(3, 4))})
+    res = ex.forward()
+    assert [r.shape for r in res] == [(3, 1), (3, 2), (3, 1)]
+
+
+def test_image_lighting():
+    img = nd.array(np.full((2, 2, 3), 100.0, np.float32))
+    out = mx.nd.image.adjust_lighting(img, alpha=(0.01, 0.01, 0.01))
+    assert out.shape == img.shape
+    assert not np.allclose(_np(out), 100.0)
+    out = mx.nd.image.random_lighting(img, 0.1)
+    assert out.shape == img.shape
+
+
+def test_gray_plumbing_saturation_zero_is_grayscale():
+    rng = np.random.RandomState(0)
+    img = nd.array(rng.uniform(0, 255, (2, 2, 3)).astype(np.float32))
+    from mxnet_tpu.ops.registry import get
+    import jax.numpy as jnp
+    op = get("_image_random_saturation")
+    # alpha == min == max == 0 -> pure gray
+    import jax
+    out = op.fcompute({"min_factor": 0.0, "max_factor": 0.0},
+                      jax.random.PRNGKey(0), jnp.asarray(_np(img)))
+    o = np.asarray(out)
+    np.testing.assert_allclose(o[..., 0], o[..., 1], rtol=1e-5)
+    np.testing.assert_allclose(o[..., 1], o[..., 2], rtol=1e-5)
+
+
+# --- registry-level invariants ---------------------------------------------
+
+def test_audit_no_missing_forward_ops():
+    """The audit that produced this round's list, pinned as a test: every
+    forward-facing reference registration must resolve in the registry
+    (modulo the documented exclusions)."""
+    import re
+    import pathlib
+    import mxnet_tpu.symbol.control_flow  # registers _foreach/_while_loop/_cond
+    from mxnet_tpu.ops import registry
+    ref = pathlib.Path("/root/reference/src/operator")
+    if not ref.exists():
+        pytest.skip("reference tree unavailable")
+    regs = set()
+    for f in ref.rglob("*.cc"):
+        t = f.read_text(errors="ignore")
+        regs |= {m.group(1) for m in re.finditer(
+            r"NNVM_REGISTER_OP\(([A-Za-z0-9_]+)\)", t)}
+        regs |= {m.group(1) for m in re.finditer(
+            r"MXNET_OPERATOR_REGISTER_[A-Z_0-9]+\(([A-Za-z0-9_]+)", t)}
+        regs |= {m.group(1) for m in re.finditer(
+            r"MXNET_REGISTER_IMAGE_(?:RND_)?AUG_OP\(([A-Za-z0-9_]+)\)", t)}
+    EXCLUDED = {
+        # legacy/vendor-specific: no TPU meaning, documented in STATUS.md
+        "BatchNorm_v1", "CuDNNBatchNorm", "_TensorRT",
+        "_sg_mkldnn_conv", "_sg_mkldnn_fully_connected",
+        # DGL sampling family: excluded per STATUS.md (graph-store ops);
+        # edge_id IS implemented
+        "_contrib_dgl_adjacency", "_contrib_dgl_csr_neighbor_non_uniform_sample",
+        "_contrib_dgl_csr_neighbor_uniform_sample", "_contrib_dgl_graph_compact",
+        "_contrib_dgl_subgraph",
+        # macro-capture false positives (PDF op suffixes, param names)
+        "exponential", "poisson", "negative_binomial",
+        "generalized_negative_binomial", "dirichlet", "distr", "name",
+        "__name",
+        # Custom: surfaced as mx.nd.Custom via mxnet_tpu.operator (its own
+        # host-callback machinery), not a registry emission
+        "Custom",
+    }
+    names = set(registry.list_ops())
+    missing = []
+    for r in sorted(regs):
+        if r.startswith("_backward") or "_backward_" in r or \
+                r.endswith("_backward"):
+            continue  # gradients are registry rules here, not ops
+        if r in EXCLUDED or r in names:
+            continue
+        cands = {r.lstrip("_"), r.replace("_contrib_", ""),
+                 r.replace("_image_", "image_"), "_" + r}
+        if cands & names:
+            continue
+        missing.append(r)
+    assert not missing, f"reference ops still missing: {missing}"

@@ -1,0 +1,97 @@
+"""PyTorch interop bridge (parity surface: python/mxnet/torch.py +
+plugin/torch — mx.th over Lua-Torch there; PyTorch-over-custom-op here)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+
+torch = pytest.importorskip("torch")
+
+from mxnet_tpu import torch_bridge  # noqa: E402
+
+
+def test_roundtrip_conversion():
+    x = nd.array(np.arange(6.0).reshape(2, 3))
+    t = torch_bridge.to_torch(x)
+    assert isinstance(t, torch.Tensor) and tuple(t.shape) == (2, 3)
+    back = torch_bridge.from_torch(t)
+    np.testing.assert_allclose(back.asnumpy(), x.asnumpy())
+
+
+def test_function_forward_matches_torch():
+    gelu = torch_bridge.function(torch.nn.functional.gelu)
+    x = nd.array(np.linspace(-2, 2, 8, dtype=np.float32))
+    got = gelu(x).asnumpy()
+    want = torch.nn.functional.gelu(torch.from_numpy(
+        np.linspace(-2, 2, 8, dtype=np.float32))).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_function_gradient_through_mx_autograd():
+    f = torch_bridge.function(lambda t: (t * t).sum())
+    x = nd.array(np.array([1.0, -2.0, 3.0], np.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = f(x)
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [2.0, -4.0, 6.0], rtol=1e-5)
+
+
+def test_function_under_hybridize_stages_as_callback():
+    import mxnet_tpu.gluon as gluon
+    softplus = torch_bridge.function(torch.nn.functional.softplus)
+
+    class Net(gluon.HybridBlock):
+        def hybrid_forward(self, F, x):
+            return softplus(x) if isinstance(x, nd.NDArray) else x
+
+    # staged path: call inside a CachedOp trace
+    net = Net()
+    net.hybridize()
+    x = nd.array(np.array([[-1.0, 0.0, 2.0]], np.float32))
+    got = net(x).asnumpy()
+    want = torch.nn.functional.softplus(
+        torch.tensor([[-1.0, 0.0, 2.0]])).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_torch_block_trains_with_gluon_trainer():
+    import mxnet_tpu.gluon as gluon
+    torch.manual_seed(0)
+    net = torch_bridge.TorchBlock(torch.nn.Linear(3, 1))
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    rng = np.random.RandomState(0)
+    X = rng.randn(16, 3).astype(np.float32)
+    w_true = np.array([[1.0], [-2.0], [0.5]], np.float32)
+    Y = X @ w_true
+    loss_fn = gluon.loss.L2Loss()
+    first = None
+    for _ in range(40):
+        x, y = nd.array(X), nd.array(Y)
+        with mx.autograd.record():
+            out = net(x)
+            loss = loss_fn(out, y)
+        loss.backward()
+        trainer.step(16)
+        cur = float(loss.mean().asnumpy())
+        if first is None:
+            first = cur
+    assert cur < 0.2 * first, (first, cur)
+
+
+def test_torch_block_params_initialized_from_module_state():
+    lin = torch.nn.Linear(2, 2)
+    with torch.no_grad():
+        lin.weight.fill_(3.0)
+        lin.bias.fill_(-1.0)
+    net = torch_bridge.TorchBlock(lin)
+    net.initialize()
+    params = net.collect_params()
+    vals = {k: v.data().asnumpy() for k, v in params.items()}
+    w = [v for k, v in vals.items() if "weight" in k][0]
+    b = [v for k, v in vals.items() if "bias" in k][0]
+    np.testing.assert_allclose(w, 3.0)
+    np.testing.assert_allclose(b, -1.0)

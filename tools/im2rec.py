@@ -141,7 +141,11 @@ def make_rec(args, image_list):
     count = 0
     worker = partial(_pack_worker, args)
     if args.num_thread > 1:
-        with multiprocessing.Pool(args.num_thread) as pool:
+        # forkserver: the parent has imported mxnet_tpu (and therefore
+        # jax, which is multithreaded) by the time workers start — a
+        # plain fork() deadlocks. Same fix as gluon.data.DataLoader.
+        ctx = multiprocessing.get_context("forkserver")
+        with ctx.Pool(args.num_thread) as pool:
             for idx, payload in pool.imap(worker, image_list,
                                           chunksize=16):
                 if payload is None:
