@@ -15,7 +15,7 @@ python cpp-package/OpWrapperGenerator.py
 git diff --exit-code cpp-package/include/mxnet_tpu/op.hpp
 
 echo "== unit suite (virtual 8-device CPU mesh via tests/conftest.py) =="
-python -m pytest tests/ -q
+MXNET_TEST_EXAMPLES=1 python -m pytest tests/ -q
 
 echo "== entry points =="
 JAX_PLATFORMS=cpu python -c \

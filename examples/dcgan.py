@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""DCGAN (parity: reference example/gan/dcgan.py, gluon flavor).
+
+Generator: latent -> Conv2DTranspose stack; Discriminator: Conv2D
+stack -> logit. Alternating hybridized updates — each of the three
+steps (D-real, D-fake, G) traces to one XLA program, so the whole GAN
+iteration is three device dispatches.
+
+Trains on a synthetic two-moons-in-pixel-space dataset (no downloads);
+success criterion is the standard GAN health check: D accuracy away
+from 100%, G fooling rate > 0, both losses bounded.
+
+Run (CPU, ~2 min): JAX_PLATFORMS=cpu python examples/dcgan.py
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def real_batches(n, size=16, seed=0):
+    """Blob images: bright gaussian bump at one of two corners."""
+    rng = np.random.RandomState(seed)
+    yy, xx = np.mgrid[0:size, 0:size].astype(np.float32)
+    imgs = []
+    for _ in range(n):
+        cx, cy = ((4, 4) if rng.rand() < 0.5 else (size - 5, size - 5))
+        img = np.exp(-((xx - cx) ** 2 + (yy - cy) ** 2) / 8.0)
+        imgs.append(img + rng.randn(size, size).astype(np.float32) * 0.05)
+    return np.stack(imgs)[:, None]  # (n, 1, H, W)
+
+
+def build_nets(ngf=16, ndf=16, nz=16):
+    from mxnet_tpu.gluon import nn
+
+    netG = nn.HybridSequential()
+    # 1x1 -> 4x4 -> 8x8 -> 16x16
+    netG.add(nn.Conv2DTranspose(ngf * 2, 4, strides=1, padding=0,
+                                use_bias=False),
+             nn.BatchNorm(), nn.Activation("relu"),
+             nn.Conv2DTranspose(ngf, 4, strides=2, padding=1,
+                                use_bias=False),
+             nn.BatchNorm(), nn.Activation("relu"),
+             nn.Conv2DTranspose(1, 4, strides=2, padding=1,
+                                use_bias=False),
+             nn.Activation("sigmoid"))
+
+    netD = nn.HybridSequential()
+    netD.add(nn.Conv2D(ndf, 4, strides=2, padding=1, use_bias=False),
+             nn.LeakyReLU(0.2),
+             nn.Conv2D(ndf * 2, 4, strides=2, padding=1, use_bias=False),
+             nn.BatchNorm(), nn.LeakyReLU(0.2),
+             nn.Conv2D(1, 4, strides=1, padding=0, use_bias=False),
+             nn.Flatten())
+    return netG, netD
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--nz", type=int, default=16)
+    ap.add_argument("--iters", type=int, default=120)
+    ap.add_argument("--lr", type=float, default=0.0005)
+    args = ap.parse_args()
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+
+    netG, netD = build_nets(nz=args.nz)
+    netG.initialize(mx.initializer.Normal(0.02))
+    netD.initialize(mx.initializer.Normal(0.02))
+    netG.hybridize()
+    netD.hybridize()
+
+    loss_fn = gluon.loss.SigmoidBinaryCrossEntropyLoss()
+    # G learns faster than D — on this easily-separable synthetic set the
+    # discriminator otherwise saturates before G produces anything
+    trainerG = gluon.Trainer(netG.collect_params(), "adam",
+                             {"learning_rate": args.lr * 4, "beta1": 0.5})
+    trainerD = gluon.Trainer(netD.collect_params(), "adam",
+                             {"learning_rate": args.lr, "beta1": 0.5})
+
+    data = real_batches(args.iters * args.batch_size)
+    bs = args.batch_size
+    d_accs, fool_rates = [], []
+    for it in range(args.iters):
+        real = mx.nd.array(data[it * bs:(it + 1) * bs])
+        noise = mx.nd.random.normal(shape=(bs, args.nz, 1, 1))
+        ones = mx.nd.ones((bs,))
+        zeros = mx.nd.zeros((bs,))
+
+        # --- D step: real->1, G(z)->0
+        fake = netG(noise).detach()
+        with autograd.record():
+            out_r = netD(real).reshape((-1,))
+            out_f = netD(fake).reshape((-1,))
+            errD = loss_fn(out_r, ones) + loss_fn(out_f, zeros)
+        errD.backward()
+        trainerD.step(bs)
+
+        # --- G step: make D say 1 on fakes
+        with autograd.record():
+            out = netD(netG(noise)).reshape((-1,))
+            errG = loss_fn(out, ones)
+        errG.backward()
+        trainerG.step(bs)
+
+        d_acc = float(((out_r.sigmoid() > 0.5).asnumpy().mean()
+                       + (out_f.sigmoid() < 0.5).asnumpy().mean()) / 2)
+        fool = float((out.sigmoid() > 0.5).asnumpy().mean())
+        d_accs.append(d_acc)
+        fool_rates.append(fool)
+        if it % 20 == 0:
+            print(f"iter {it}: errD {float(errD.mean().asscalar()):.3f} "
+                  f"errG {float(errG.mean().asscalar()):.3f} "
+                  f"D-acc {d_acc:.2f} fool {fool:.2f}")
+
+    peak_fool = float(np.max(fool_rates[10:]))
+    print(f"final: peak fool rate {peak_fool:.2f}, "
+          f"mean D-acc {float(np.mean(d_accs[-30:])):.2f}")
+    # health: past warmup G fools D meaningfully at some point, and the
+    # adversarial losses stayed finite (no collapse to NaN/inf)
+    assert peak_fool > 0.05, "generator never fools the discriminator"
+    assert np.isfinite(float(errG.mean().asscalar()))
+    print("DCGAN trained OK")
+
+
+if __name__ == "__main__":
+    main()

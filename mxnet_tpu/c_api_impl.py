@@ -22,8 +22,9 @@ _CODE_BY_DTYPE["bfloat16"] = 12  # mshadow kBfloat16
 
 def _ctx(dev_type, dev_id):
     from . import context
+    # context.py device codes: 1 cpu, 2 gpu, 3 cpu_pinned, 6 tpu
     return {1: context.cpu, 2: context.gpu, 3: context.cpu,
-            7: context.tpu}.get(dev_type, context.cpu)(dev_id)
+            6: context.tpu}.get(dev_type, context.cpu)(dev_id)
 
 
 # --- NDArray ----------------------------------------------------------------
@@ -221,6 +222,209 @@ def kvstore_pull(kv, keys, outs, priority):
 
 def kvstore_rank_size(kv):
     return kv.rank, kv.num_workers
+
+
+# --- NDArray views / misc ---------------------------------------------------
+def ndarray_reshape(arr, dims):
+    return arr.reshape(tuple(int(d) for d in dims))
+
+
+def ndarray_slice(arr, begin, end):
+    return arr[int(begin):int(end)]
+
+
+def ndarray_at(arr, idx):
+    return arr[int(idx)]
+
+
+def ndarray_context(arr):
+    ctx = arr.context
+    from .context import Context
+    return Context.devstr2type.get(ctx.device_type, 1), ctx.device_id
+
+
+def random_seed(seed):
+    from . import random as _random
+    _random.seed(int(seed))
+    return True
+
+
+# --- symbol shape inference --------------------------------------------------
+def symbol_infer_shape(s, names, shapes):
+    """MXSymbolInferShape parity: returns (arg_shapes, out_shapes,
+    aux_shapes, complete); unknown shapes come back as ()."""
+    known = {n: tuple(int(d) for d in shp)
+             for n, shp in zip(names, shapes) if shp}
+    args, outs, aux = s.infer_shape_partial(**known)
+
+    def clean(group):
+        return [tuple(v) if v else () for v in (group or [])]
+
+    complete = (args is not None and outs is not None
+                and all(v for v in list(args) + list(outs)
+                        + list(aux or [])))
+    return clean(args), clean(outs), clean(aux), bool(complete)
+
+
+# --- cached op ---------------------------------------------------------------
+class _CCachedOp:
+    """CachedOp over a Symbol for the C ABI (parity: reference
+    src/imperative/cached_op.cc fronted by MXCreateCachedOpEx,
+    c_api.h:1376): inputs arrive positionally in list_arguments order;
+    executors are cached per input signature, so repeat invocations with
+    the same shapes hit one jitted XLA program."""
+
+    def __init__(self, sym):
+        self.sym = sym
+        self.arg_names = sym.list_arguments()
+        self._cache = {}
+
+    def invoke(self, inputs):
+        if len(inputs) != len(self.arg_names):
+            raise ValueError(
+                f"CachedOp expects {len(self.arg_names)} inputs "
+                f"({self.arg_names}), got {len(inputs)}")
+        import numpy as _np
+        # context is part of the key (reference CachedOp caches per
+        # context): same-shape inputs on another device must not reuse
+        # an executor bound to the old one
+        key = (str(inputs[0].context),) + tuple(
+            (tuple(a.shape), _np.dtype(a.dtype).name) for a in inputs)
+        ex = self._cache.get(key)
+        args = dict(zip(self.arg_names, inputs))
+        if ex is None:
+            ex = self.sym.bind(inputs[0].context, args, grad_req="null")
+            self._cache[key] = ex
+        else:
+            ex.copy_params_from(args)
+        ex.forward(is_train=False)
+        return list(ex.outputs)
+
+
+def cached_op_create(sym):
+    return _CCachedOp(sym)
+
+
+def cached_op_invoke(op, inputs):
+    return op.invoke(list(inputs))
+
+
+# --- data iterators ----------------------------------------------------------
+class _CDataIter:
+    """Holds a Python DataIter plus its current batch for the C-style
+    cursor protocol (MXDataIterNext/GetData/GetLabel, reference
+    c_api.h:2237)."""
+
+    def __init__(self, it):
+        self.it = it
+        self.batch = None
+
+    def advance(self):
+        try:
+            self.batch = next(self.it)
+            return True
+        except StopIteration:
+            self.batch = None
+            return False
+
+
+def _iter_registry():
+    from . import io as _io
+    return {"CSVIter": _io.CSVIter, "LibSVMIter": _io.LibSVMIter,
+            "ImageRecordIter": _io.ImageRecordIter,
+            "RawRecordIter": _io.RawRecordIter}
+
+
+def list_data_iters():
+    return sorted(_iter_registry())
+
+
+def data_iter_create(name, keys, vals):
+    from .symbol.symbol import _parse_attr_value
+    cls = _iter_registry().get(name)
+    if cls is None:
+        raise ValueError(f"unknown data iter {name!r}; "
+                         f"have {sorted(_iter_registry())}")
+    kwargs = {k: _parse_attr_value(v) for k, v in zip(keys, vals)}
+    return _CDataIter(cls(**kwargs))
+
+
+def data_iter_reset(h):
+    h.it.reset()
+    h.batch = None
+    return True
+
+
+def data_iter_next(h):
+    return h.advance()
+
+
+def data_iter_data(h):
+    return h.batch.data[0] if h.batch is not None else None
+
+
+def data_iter_label(h):
+    if h.batch is None or not h.batch.label:
+        return None
+    return h.batch.label[0]
+
+
+def data_iter_pad(h):
+    return int(h.batch.pad or 0) if h.batch is not None else 0
+
+
+# --- RecordIO ----------------------------------------------------------------
+def recordio_writer_create(uri):
+    from .recordio import MXRecordIO
+    return MXRecordIO(uri, "w")
+
+
+def recordio_write(w, data):
+    w.write(data)
+    return True
+
+
+def recordio_reader_create(uri):
+    from .recordio import MXRecordIO
+    return MXRecordIO(uri, "r")
+
+
+def recordio_read(r):
+    return r.read()  # None at EOF
+
+
+def recordio_close(h):
+    h.close()
+    return True
+
+
+# --- profiler ----------------------------------------------------------------
+def profiler_config(keys, vals):
+    from . import profiler
+    from .symbol.symbol import _parse_attr_value
+    profiler.set_config(**{k: _parse_attr_value(v)
+                           for k, v in zip(keys, vals)})
+    return True
+
+
+def profiler_state(state):
+    from . import profiler
+    if state:
+        profiler.start()
+    else:
+        profiler.stop()
+    return True
+
+
+def profiler_dump(finished):
+    from . import profiler
+    profiler.dump(finished=bool(finished))
+    return True
+
+
+def profiler_stats(reset):
+    from . import profiler
+    return profiler.dumps(reset=bool(reset))
 
 
 # --- misc -------------------------------------------------------------------

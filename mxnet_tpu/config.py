@@ -122,6 +122,12 @@ _register("DMLC_PS_BIND_ADDR", str, "127.0.0.1",
 _register("MXNET_PROFILER_XPLANE_DIR", str, "",
           "directory for jax.profiler xplane traces (TensorBoard/"
           "perfetto); empty disables the device trace")
+_register("MXNET_PROFILER_AUTOSTART", bool, False,
+          "start the profiler at import (parity: reference "
+          "env_var.md MXNET_PROFILER_AUTOSTART)")
+_register("MXNET_PROFILER_MODE", str, "",
+          "with AUTOSTART: 'all'/'1' also enables profile_all + "
+          "profile_api (parity: reference MXNET_PROFILER_MODE)")
 # -- driver / bench ---------------------------------------------------------
 _register("MX_DRYRUN_TIMEOUT", float, 900.0,
           "subprocess timeout for __graft_entry__.dryrun_multichip")

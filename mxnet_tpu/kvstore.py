@@ -518,6 +518,13 @@ class KVStoreDist(KVStore):
                                       pickle.dumps(optimizer))
         self._client.barrier()
 
+    def _send_command_to_servers(self, head, body):
+        """Generic server command (parity: KVStore::SendCommandToServers,
+        include/mxnet/kvstore.h:377; carries e.g. the profiler commands —
+        see profiler.set_kvstore_handle)."""
+        if self._client is not None:
+            self._client.send_command(head, body)
+
     def get_num_dead_node(self, node_id=0, timeout=60):
         """Number of workers whose heartbeats stopped (parity:
         KVStore::get_num_dead_node, include/mxnet/kvstore.h:353)."""

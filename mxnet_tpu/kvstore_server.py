@@ -158,6 +158,38 @@ class KVServer:
         stored = self.store[key]
         self.updater(key, grad, stored)
 
+    @staticmethod
+    def _server_trace_filename(name):
+        """The server's trace path for a given base filename: insert
+        ``_server`` before the extension (idempotent), so a colocated
+        server can never clobber the worker's own trace."""
+        root, ext = os.path.splitext(name)
+        if root.endswith("_server"):
+            return name
+        return f"{root}_server{ext}"
+
+    def _profiler_command(self, head, payload):
+        """Server-side profiler commands (parity: reference
+        KVStoreServerProfilerCommand kSetConfig/kState/kDumpProfile,
+        include/mxnet/kvstore.h:49)."""
+        from . import profiler
+        if head == "profiler_set_config":
+            cfg = dict(payload)
+            if "filename" in cfg:
+                cfg["filename"] = self._server_trace_filename(
+                    cfg["filename"])
+            profiler.set_config(**cfg)
+        elif head == "profiler_set_state":
+            profiler.set_state(payload)
+        elif head == "profiler_dump":
+            # enforce the _server suffix even when the worker never sent
+            # a filename (default config would collide on a shared CWD)
+            profiler.set_config(filename=self._server_trace_filename(
+                profiler.KWARGS["filename"]))
+            profiler.dump(finished=payload)
+        else:
+            raise ValueError(f"unknown profiler command {head!r}")
+
     def _handle(self, conn):
         while not self._stop.is_set():
             try:
@@ -289,6 +321,20 @@ class KVServer:
                     self.updater = np_updater
                 elif head == "stop":
                     self._stop.set()
+                elif head.startswith("profiler_"):
+                    # server-side profiling (parity: reference
+                    # KVStoreServerProfilerCommand, include/mxnet/
+                    # kvstore.h:49). Guarded: a profiler failure must
+                    # not kill the PS connection — push/pull traffic
+                    # outranks tracing.
+                    err = None
+                    try:
+                        self._profiler_command(head, pickle.loads(body))
+                    except Exception as e:  # reply, don't die
+                        err = str(e)
+                    _send_msg(conn, {"ok": err is None, "error": err},
+                              self.auth_token)
+                    continue
                 _send_msg(conn, {"ok": True}, self.auth_token)
             else:
                 _send_msg(conn, {"ok": False, "error": f"bad op {op}"}, self.auth_token)

@@ -166,14 +166,25 @@ class NDArray:
                 f"wait_to_read): {e}") from e
 
     def asnumpy(self):
+        t0 = None
+        from .. import profiler as _prof
+        if _prof.is_running() and (_prof.KWARGS["profile_api"]
+                                   or _prof.KWARGS["profile_all"]):
+            import time as _time
+            t0 = _time.perf_counter()
         try:
-            return np.asarray(self._data)
+            out = np.asarray(self._data)
         except MXNetError:
             raise
         except Exception as e:
             raise MXNetError(
                 f"async operator execution failed (surfaced at "
                 f"asnumpy): {e}") from e
+        if t0 is not None:
+            import time as _time
+            _prof.record_api("MXNDArraySyncCopyToCPU",
+                             (_time.perf_counter() - t0) * 1e6)
+        return out
 
     def __array__(self, dtype=None, copy=None):
         # without this, np.asarray(ndarray) walks __getitem__ element by
@@ -770,7 +781,15 @@ def transpose(a, axes=None):
 
 
 def waitall():
-    engine.wait_for_all()
+    from .. import profiler as _prof
+    if _prof.is_running():
+        import time as _time
+        t0 = _time.perf_counter()
+        engine.wait_for_all()
+        _prof.record_api("MXNDArrayWaitAll",
+                         (_time.perf_counter() - t0) * 1e6)
+    else:
+        engine.wait_for_all()
 
 
 def moveaxis(a, source, destination):

@@ -27,8 +27,11 @@ _config = {
     # block after each profiled op so durations include device execution
     # (reference per-opr profiling also serialises the engine)
     "profile_device_sync": True,
+    "continuous_dump": False,
+    "dump_period": 1.0,
 }
-_state = {"running": False, "jax_trace_dir": None}
+_state = {"running": False, "jax_trace_dir": None, "dump_timer": None,
+          "kvstore": None, "last_mem_sample": 0.0}
 _records = []
 _records_lock = threading.Lock()
 _t0 = None
@@ -37,14 +40,36 @@ KWARGS = _config  # parity alias
 
 
 def set_config(**kwargs):
-    """Configure the profiler (parity: profiler.py set_config)."""
+    """Configure the profiler (parity: profiler.py set_config). Forwards
+    to the kvstore servers too once ``set_kvstore_handle`` was called
+    (reference KVStoreServerProfilerCommand::kSetConfig)."""
     for k, v in kwargs.items():
         if k in _config:
             _config[k] = v
-        elif k in ("continuous_dump", "dump_period", "profile_process"):
+        elif k in ("profile_process",):
             pass  # accepted for API parity
         else:
             raise MXNetError(f"unknown profiler option {k}")
+    _forward_to_server("profiler_set_config", kwargs)
+
+
+def set_kvstore_handle(kv):
+    """Route subsequent profiler set_config/set_state/dump calls to the
+    dist kvstore servers as well (parity: reference profiler.py
+    set_kvstore_handle + KVStoreServerProfilerCommand,
+    include/mxnet/kvstore.h:49)."""
+    _state["kvstore"] = kv
+
+
+def _forward_to_server(head, payload):
+    kv = _state["kvstore"]
+    if kv is None:
+        return
+    try:
+        import pickle
+        kv._send_command_to_servers(head, pickle.dumps(payload))
+    except Exception:
+        pass  # server-side profiling is best-effort
 
 
 def profiler_set_config(mode="symbolic", filename="profile.json"):
@@ -70,15 +95,43 @@ def start(profile_process="worker"):
         import jax
         jax.profiler.start_trace(xdir)
         _state["jax_trace_dir"] = xdir
+    if _config["continuous_dump"]:
+        _schedule_dump()
+    _forward_to_server("profiler_set_state", "run")
+
+
+def _schedule_dump():
+    """Background periodic dump (reference continuous_dump/dump_period)."""
+    t = _state.get("dump_timer")
+    if t is not None:
+        t.cancel()
+
+    def tick():
+        if _state["running"]:
+            try:
+                dump(finished=False)
+            except Exception:
+                pass
+            _schedule_dump()
+
+    t = threading.Timer(float(_config["dump_period"]), tick)
+    t.daemon = True
+    t.start()
+    _state["dump_timer"] = t
 
 
 def stop(profile_process="worker"):
     """Stop profiling."""
     _state["running"] = False
+    t = _state.get("dump_timer")
+    if t is not None:
+        t.cancel()
+        _state["dump_timer"] = None
     if _state["jax_trace_dir"]:
         import jax
         jax.profiler.stop_trace()
         _state["jax_trace_dir"] = None
+    _forward_to_server("profiler_set_state", "stop")
 
 
 def is_running():
@@ -130,14 +183,59 @@ def record_op(name, dur_us, cat="operator"):
             "pid": os.getpid(),
             "tid": threading.get_ident() % 100000,
         })
+    if _config["profile_memory"]:
+        _sample_device_memory()
+
+
+def record_api(name, dur_us=0.0):
+    """Record a frontend/API event (waitall, asnumpy, bind, …) when
+    profile_api is on (parity: the reference's MXAPIThreadLocal API-call
+    profiling under profile_api, src/c_api/c_api_profile.cc)."""
+    if _config["profile_api"] or _config["profile_all"]:
+        record_op(name, dur_us, cat="api")
+
+
+_MEM_SAMPLE_PERIOD_S = 0.01  # at most 100 samples/s — PJRT stats aren't free
+
+
+def _sample_device_memory():
+    """Append a chrome-trace counter sample of device bytes in use
+    (parity: the reference memory profiler, src/profiler/storage_profiler.h,
+    rendered as a counter lane). Throttled; silently skipped when the
+    backend exposes no allocator stats."""
+    now = time.perf_counter()
+    if now - _state["last_mem_sample"] < _MEM_SAMPLE_PERIOD_S:
+        return
+    _state["last_mem_sample"] = now
+    try:
+        from .context import device_memory_info
+        info = device_memory_info()
+        used = int(info.get("bytes_in_use", 0))
+    except Exception:
+        return
+    with _records_lock:
+        _records.append({
+            "name": "device_memory",
+            "cat": "memory",
+            "ph": "C",
+            "ts": (now - _t0) * 1e6,
+            "pid": os.getpid(),
+            "args": {"bytes_in_use": used},
+        })
 
 
 def pause(profile_process="worker"):
     _state["running"] = False
+    t = _state.get("dump_timer")
+    if t is not None:
+        t.cancel()
+        _state["dump_timer"] = None
 
 
 def resume(profile_process="worker"):
     _state["running"] = True
+    if _config["continuous_dump"]:
+        _schedule_dump()
 
 
 def dump(finished=True, profile_process="worker"):
@@ -148,22 +246,30 @@ def dump(finished=True, profile_process="worker"):
     doc = {"traceEvents": events, "displayTimeUnit": "ms"}
     with open(_config["filename"], "w") as f:
         json.dump(doc, f)
+    _forward_to_server("profiler_dump", bool(finished))
 
 
 def dumps(reset=False, format="table", sort_by="total", ascending=False):
-    """Return aggregate stats as an ASCII table
-    (parity: profiler.py dumps → aggregate_stats.cc table)."""
+    """Return aggregate stats as an ASCII table, or a dict when
+    format="json" (parity: profiler.py dumps → aggregate_stats.cc table
+    and json dump modes)."""
     with _records_lock:
         events = list(_records)
         if reset:
             _records.clear()
     agg = {}
     for e in events:
+        if e.get("ph") != "X":
+            continue  # counter/memory samples have no duration
         st = agg.setdefault(e["name"], [0, 0.0, float("inf"), 0.0])
         st[0] += 1
         st[1] += e["dur"]
         st[2] = min(st[2], e["dur"])
         st[3] = max(st[3], e["dur"])
+    if format == "json":
+        return {name: {"count": c, "total_ms": t / 1e3, "min_ms": mn / 1e3,
+                       "max_ms": mx / 1e3, "avg_ms": t / c / 1e3}
+                for name, (c, t, mn, mx) in agg.items()}
     lines = ["Profile Statistics:",
              f"{'Name':<40}{'Total Count':>12}{'Time (ms)':>14}"
              f"{'Min (ms)':>12}{'Max (ms)':>12}{'Avg (ms)':>12}"]
@@ -253,14 +359,29 @@ class Counter:
         self.name = name
         self.value = value or 0
 
+    def _emit(self):
+        # counters render as a chrome-trace counter lane ("C" events),
+        # like the reference's profiler counters
+        if not _state["running"]:
+            return
+        with _records_lock:
+            _records.append({
+                "name": f"{self.domain}:{self.name}", "cat": "counter",
+                "ph": "C", "ts": (time.perf_counter() - _t0) * 1e6,
+                "pid": os.getpid(), "args": {"value": self.value},
+            })
+
     def set_value(self, value):
         self.value = value
+        self._emit()
 
     def increment(self, delta=1):
         self.value += delta
+        self._emit()
 
     def decrement(self, delta=1):
         self.value -= delta
+        self._emit()
 
     def __iadd__(self, v):
         self.increment(v)
@@ -278,3 +399,15 @@ class Marker:
 
     def mark(self, scope="process"):
         record_op(f"{self.domain}:{self.name}", 0, cat="marker")
+
+
+# -- env autostart (parity: MXNET_PROFILER_AUTOSTART / MXNET_PROFILER_MODE,
+#    reference docs/faq/env_var.md:193-197). Parsed through the config
+#    registry so every documented bool spelling (1/true/yes/on) works.
+from .config import get as _cfg_get  # noqa: E402
+
+if _cfg_get("MXNET_PROFILER_AUTOSTART"):
+    if _cfg_get("MXNET_PROFILER_MODE") in ("all", "1"):
+        _config["profile_all"] = True
+        _config["profile_api"] = True
+    start()

@@ -698,7 +698,9 @@ def _parse_attr_value(v):
         if not inner:
             return ()
         try:
-            return tuple(_parse_attr_value(x) for x in inner.split(","))
+            # "(4,)" splits to ["4", ""] — drop the trailing empty segment
+            return tuple(_parse_attr_value(x) for x in inner.split(",")
+                         if x.strip())
         except Exception:
             return s
     return s

@@ -38,6 +38,9 @@ typedef void* NDArrayHandle;
 typedef void* SymbolHandle;
 typedef void* ExecutorHandle;
 typedef void* KVStoreHandle;
+typedef void* CachedOpHandle;
+typedef void* DataIterHandle;
+typedef void* RecordIOHandle;
 typedef uint32_t mx_uint;
 
 namespace {
@@ -49,6 +52,34 @@ thread_local std::vector<const char*> g_ret_cstrs;
 thread_local std::vector<mx_uint> g_ret_shape;
 thread_local std::vector<NDArrayHandle> g_ret_handles;
 thread_local std::string g_ret_json;
+thread_local std::string g_ret_record;
+
+// MXSymbolInferShape returns three (ndim[], data[][]) groups; each group's
+// backing storage lives here until the next call on this thread
+struct ShapeGroup {
+  std::vector<std::vector<mx_uint>> shapes;
+  std::vector<mx_uint> ndims;
+  std::vector<const mx_uint*> ptrs;
+  void load(PyObject* seq) {
+    Py_ssize_t n = PySequence_Size(seq);
+    shapes.assign(n, {});
+    ndims.assign(n, 0);
+    ptrs.assign(n, nullptr);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject* shp = PySequence_GetItem(seq, i);
+      Py_ssize_t d = (shp && shp != Py_None) ? PySequence_Size(shp) : 0;
+      for (Py_ssize_t j = 0; j < d; ++j) {
+        PyObject* it = PySequence_GetItem(shp, j);
+        shapes[i].push_back(static_cast<mx_uint>(PyLong_AsUnsignedLong(it)));
+        Py_XDECREF(it);
+      }
+      Py_XDECREF(shp);
+      ndims[i] = static_cast<mx_uint>(shapes[i].size());
+      ptrs[i] = shapes[i].data();
+    }
+  }
+};
+thread_local ShapeGroup g_in_shapes, g_out_shapes, g_aux_shapes;
 
 PyObject* impl() {
   static thread_local PyObject* mod = nullptr;
@@ -617,6 +648,375 @@ int MXExecutorFree(ExecutorHandle handle) {
   if (!handle) return 0;
   Gil gil;
   Py_DECREF(static_cast<PyObject*>(handle));
+  return 0;
+}
+
+// --- NDArray views / misc --------------------------------------------------
+namespace {
+// one-arg helper call returning a fresh handle
+int handle_out_call(const char* fn, PyObject* args, void** out) {
+  PyObject* r = args ? call(fn, args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  *out = r;
+  return 0;
+}
+}  // namespace
+
+int MXNDArrayReshape(NDArrayHandle handle, int ndim, const int* dims,
+                     NDArrayHandle* out) {
+  if (!handle) return fail("null handle");
+  Gil gil;
+  PyObject* shp = PyTuple_New(ndim);
+  for (int i = 0; i < ndim; ++i) {
+    PyTuple_SET_ITEM(shp, i, PyLong_FromLong(dims[i]));
+  }
+  return handle_out_call("ndarray_reshape",
+                         Py_BuildValue("(ON)", handle, shp), out);
+}
+
+int MXNDArraySlice(NDArrayHandle handle, mx_uint slice_begin,
+                   mx_uint slice_end, NDArrayHandle* out) {
+  if (!handle) return fail("null handle");
+  Gil gil;
+  return handle_out_call(
+      "ndarray_slice",
+      Py_BuildValue("(OII)", handle, slice_begin, slice_end), out);
+}
+
+int MXNDArrayAt(NDArrayHandle handle, mx_uint idx, NDArrayHandle* out) {
+  if (!handle) return fail("null handle");
+  Gil gil;
+  return handle_out_call("ndarray_at", Py_BuildValue("(OI)", handle, idx),
+                         out);
+}
+
+int MXNDArrayGetContext(NDArrayHandle handle, int* out_dev_type,
+                        int* out_dev_id) {
+  if (!handle) return fail("null handle");
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", handle);
+  PyObject* r = args ? call("ndarray_context", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  *out_dev_type = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(r, 0)));
+  *out_dev_id = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(r, 1)));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXRandomSeed(int seed) {
+  ensure_python();
+  Gil gil;
+  PyObject* args = Py_BuildValue("(i)", seed);
+  PyObject* r = args ? call("random_seed", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  Py_DECREF(r);
+  return 0;
+}
+
+// --- symbol shape inference ------------------------------------------------
+int MXSymbolInferShape(SymbolHandle sym, mx_uint num_args,
+                       const char** keys, const mx_uint* arg_ind_ptr,
+                       const mx_uint* arg_shape_data,
+                       mx_uint* in_shape_size,
+                       const mx_uint** in_shape_ndim,
+                       const mx_uint*** in_shape_data,
+                       mx_uint* out_shape_size,
+                       const mx_uint** out_shape_ndim,
+                       const mx_uint*** out_shape_data,
+                       mx_uint* aux_shape_size,
+                       const mx_uint** aux_shape_ndim,
+                       const mx_uint*** aux_shape_data,
+                       int* complete) {
+  if (!sym) return fail("null handle");
+  Gil gil;
+  PyObject* names = list_from_strs(num_args, keys);
+  PyObject* shapes = PyList_New(num_args);
+  for (mx_uint i = 0; i < num_args; ++i) {
+    mx_uint b = arg_ind_ptr[i], e = arg_ind_ptr[i + 1];
+    PyObject* shp = PyTuple_New(e - b);
+    for (mx_uint j = b; j < e; ++j) {
+      PyTuple_SET_ITEM(shp, j - b,
+                       PyLong_FromUnsignedLong(arg_shape_data[j]));
+    }
+    PyList_SET_ITEM(shapes, i, shp);
+  }
+  PyObject* args = Py_BuildValue("(OOO)", sym, names, shapes);
+  Py_DECREF(names);
+  Py_DECREF(shapes);
+  PyObject* r = args ? call("symbol_infer_shape", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  g_in_shapes.load(PyTuple_GetItem(r, 0));
+  g_out_shapes.load(PyTuple_GetItem(r, 1));
+  g_aux_shapes.load(PyTuple_GetItem(r, 2));
+  *complete = PyObject_IsTrue(PyTuple_GetItem(r, 3));
+  Py_DECREF(r);
+  *in_shape_size = static_cast<mx_uint>(g_in_shapes.ndims.size());
+  *in_shape_ndim = g_in_shapes.ndims.data();
+  *in_shape_data = g_in_shapes.ptrs.data();
+  *out_shape_size = static_cast<mx_uint>(g_out_shapes.ndims.size());
+  *out_shape_ndim = g_out_shapes.ndims.data();
+  *out_shape_data = g_out_shapes.ptrs.data();
+  *aux_shape_size = static_cast<mx_uint>(g_aux_shapes.ndims.size());
+  *aux_shape_ndim = g_aux_shapes.ndims.data();
+  *aux_shape_data = g_aux_shapes.ptrs.data();
+  return 0;
+}
+
+// --- cached op -------------------------------------------------------------
+int MXCreateCachedOp(SymbolHandle sym, CachedOpHandle* out) {
+  if (!sym) return fail("null handle");
+  Gil gil;
+  return handle_out_call("cached_op_create", Py_BuildValue("(O)", sym),
+                         out);
+}
+
+int MXInvokeCachedOp(CachedOpHandle handle, int num_inputs,
+                     NDArrayHandle* inputs, int* num_outputs,
+                     NDArrayHandle** outputs) {
+  if (!handle) return fail("null handle");
+  Gil gil;
+  PyObject* ins = list_from_handles(num_inputs, inputs);
+  PyObject* args = Py_BuildValue("(OO)", handle, ins);
+  Py_DECREF(ins);
+  PyObject* r = args ? call("cached_op_invoke", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  mx_uint n = 0;
+  handlelist_out(r, &n, outputs);
+  *num_outputs = static_cast<int>(n);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXFreeCachedOp(CachedOpHandle handle) {
+  if (!handle) return 0;
+  Gil gil;
+  Py_DECREF(static_cast<PyObject*>(handle));
+  return 0;
+}
+
+// --- data iterators --------------------------------------------------------
+int MXListDataIters(mx_uint* out_size, const char*** out_array) {
+  ensure_python();
+  Gil gil;
+  PyObject* r = call("list_data_iters", nullptr);
+  if (!r) return fail_from_python();
+  strlist_out(r, out_size, out_array);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXDataIterCreateIter(const char* iter_name, mx_uint num_param,
+                         const char** keys, const char** vals,
+                         DataIterHandle* out) {
+  ensure_python();
+  Gil gil;
+  PyObject* k = list_from_strs(num_param, keys);
+  PyObject* v = list_from_strs(num_param, vals);
+  PyObject* args = Py_BuildValue("(sOO)", iter_name, k, v);
+  Py_DECREF(k);
+  Py_DECREF(v);
+  return handle_out_call("data_iter_create", args, out);
+}
+
+int MXDataIterBeforeFirst(DataIterHandle handle) {
+  if (!handle) return fail("null handle");
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", handle);
+  PyObject* r = args ? call("data_iter_reset", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXDataIterNext(DataIterHandle handle, int* out) {
+  if (!handle) return fail("null handle");
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", handle);
+  PyObject* r = args ? call("data_iter_next", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  *out = PyObject_IsTrue(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+namespace {
+int iter_field(const char* fn, DataIterHandle handle, NDArrayHandle* out) {
+  if (!handle) return fail("null handle");
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", handle);
+  PyObject* r = args ? call(fn, args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  if (r == Py_None) {
+    Py_DECREF(r);
+    *out = nullptr;
+    return 0;
+  }
+  *out = r;
+  return 0;
+}
+}  // namespace
+
+int MXDataIterGetData(DataIterHandle handle, NDArrayHandle* out) {
+  return iter_field("data_iter_data", handle, out);
+}
+
+int MXDataIterGetLabel(DataIterHandle handle, NDArrayHandle* out) {
+  return iter_field("data_iter_label", handle, out);
+}
+
+int MXDataIterGetPadNum(DataIterHandle handle, int* pad) {
+  if (!handle) return fail("null handle");
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", handle);
+  PyObject* r = args ? call("data_iter_pad", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  *pad = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXDataIterFree(DataIterHandle handle) {
+  if (!handle) return 0;
+  Gil gil;
+  Py_DECREF(static_cast<PyObject*>(handle));
+  return 0;
+}
+
+// --- RecordIO --------------------------------------------------------------
+int MXRecordIOWriterCreate(const char* uri, RecordIOHandle* out) {
+  ensure_python();
+  Gil gil;
+  return handle_out_call("recordio_writer_create",
+                         Py_BuildValue("(s)", uri), out);
+}
+
+int MXRecordIOWriterWriteRecord(RecordIOHandle handle, const char* buf,
+                                size_t size) {
+  if (!handle) return fail("null handle");
+  Gil gil;
+  PyObject* data = PyBytes_FromStringAndSize(
+      buf, static_cast<Py_ssize_t>(size));
+  PyObject* args = Py_BuildValue("(ON)", handle, data);
+  PyObject* r = args ? call("recordio_write", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXRecordIOWriterFree(RecordIOHandle handle) {
+  if (!handle) return 0;
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", handle);
+  PyObject* r = args ? call("recordio_close", args) : nullptr;
+  Py_XDECREF(args);
+  Py_DECREF(static_cast<PyObject*>(handle));
+  if (!r) {
+    // close can fail for real (ENOSPC on final flush) — report it and
+    // clear the error indicator so the next call on this thread is clean
+    return fail_from_python();
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXRecordIOReaderCreate(const char* uri, RecordIOHandle* out) {
+  ensure_python();
+  Gil gil;
+  return handle_out_call("recordio_reader_create",
+                         Py_BuildValue("(s)", uri), out);
+}
+
+int MXRecordIOReaderReadRecord(RecordIOHandle handle, const char** buf,
+                               size_t* size) {
+  if (!handle) return fail("null handle");
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", handle);
+  PyObject* r = args ? call("recordio_read", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  if (r == Py_None) {
+    Py_DECREF(r);
+    *buf = nullptr;
+    *size = 0;
+    return 0;
+  }
+  char* src = nullptr;
+  Py_ssize_t n = 0;
+  if (PyBytes_AsStringAndSize(r, &src, &n) != 0) {
+    Py_DECREF(r);
+    return fail_from_python();
+  }
+  g_ret_record.assign(src, static_cast<size_t>(n));
+  Py_DECREF(r);
+  *buf = g_ret_record.data();
+  *size = g_ret_record.size();
+  return 0;
+}
+
+int MXRecordIOReaderFree(RecordIOHandle handle) {
+  return MXRecordIOWriterFree(handle);
+}
+
+// --- profiler --------------------------------------------------------------
+int MXSetProcessProfilerConfig(int num_params, const char** keys,
+                               const char** vals) {
+  ensure_python();
+  Gil gil;
+  PyObject* k = list_from_strs(num_params, keys);
+  PyObject* v = list_from_strs(num_params, vals);
+  PyObject* args = Py_BuildValue("(OO)", k, v);
+  Py_DECREF(k);
+  Py_DECREF(v);
+  PyObject* r = args ? call("profiler_config", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXSetProcessProfilerState(int state) {
+  ensure_python();
+  Gil gil;
+  PyObject* args = Py_BuildValue("(i)", state);
+  PyObject* r = args ? call("profiler_state", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXDumpProcessProfile(int finished) {
+  ensure_python();
+  Gil gil;
+  PyObject* args = Py_BuildValue("(i)", finished);
+  PyObject* r = args ? call("profiler_dump", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXAggregateProfileStatsPrint(const char** out_str, int reset) {
+  ensure_python();
+  Gil gil;
+  PyObject* args = Py_BuildValue("(i)", reset);
+  PyObject* r = args ? call("profiler_stats", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  const char* c = PyUnicode_AsUTF8(r);
+  g_ret_json = c ? c : "";
+  Py_DECREF(r);
+  *out_str = g_ret_json.c_str();
   return 0;
 }
 

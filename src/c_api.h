@@ -23,6 +23,9 @@ typedef void* NDArrayHandle;
 typedef void* SymbolHandle;
 typedef void* ExecutorHandle;
 typedef void* KVStoreHandle;
+typedef void* CachedOpHandle;
+typedef void* DataIterHandle;
+typedef void* RecordIOHandle;
 typedef uint32_t mx_uint;
 
 /* ---- misc --------------------------------------------------------------- */
@@ -106,6 +109,73 @@ int MXExecutorOutputs(ExecutorHandle handle, mx_uint* out_size,
 int MXExecutorArgGrad(ExecutorHandle handle, const char* arg_name,
                       NDArrayHandle* out);
 int MXExecutorFree(ExecutorHandle handle);
+
+/* ---- NDArray views / misc ----------------------------------------------- */
+int MXNDArrayReshape(NDArrayHandle handle, int ndim, const int* dims,
+                     NDArrayHandle* out);
+int MXNDArraySlice(NDArrayHandle handle, mx_uint slice_begin,
+                   mx_uint slice_end, NDArrayHandle* out);
+int MXNDArrayAt(NDArrayHandle handle, mx_uint idx, NDArrayHandle* out);
+int MXNDArrayGetContext(NDArrayHandle handle, int* out_dev_type,
+                        int* out_dev_id);
+int MXRandomSeed(int seed);
+
+/* ---- symbol shape inference --------------------------------------------- */
+/* Reference MXSymbolInferShape (c_api.h:1482): known arg shapes arrive in
+ * CSR layout (arg_ind_ptr has num_args+1 offsets into arg_shape_data);
+ * results come back as three (size, ndim[], data[][]) groups valid until
+ * the next call on this thread. */
+int MXSymbolInferShape(SymbolHandle sym, mx_uint num_args,
+                       const char** keys, const mx_uint* arg_ind_ptr,
+                       const mx_uint* arg_shape_data,
+                       mx_uint* in_shape_size,
+                       const mx_uint** in_shape_ndim,
+                       const mx_uint*** in_shape_data,
+                       mx_uint* out_shape_size,
+                       const mx_uint** out_shape_ndim,
+                       const mx_uint*** out_shape_data,
+                       mx_uint* aux_shape_size,
+                       const mx_uint** aux_shape_ndim,
+                       const mx_uint*** aux_shape_data,
+                       int* complete);
+
+/* ---- cached op (hybridize from C; reference MXCreateCachedOpEx) --------- */
+int MXCreateCachedOp(SymbolHandle sym, CachedOpHandle* out);
+int MXInvokeCachedOp(CachedOpHandle handle, int num_inputs,
+                     NDArrayHandle* inputs, int* num_outputs,
+                     NDArrayHandle** outputs);
+int MXFreeCachedOp(CachedOpHandle handle);
+
+/* ---- data iterators (reference MXDataIter*, c_api.h:2195+) -------------- */
+int MXListDataIters(mx_uint* out_size, const char*** out_array);
+int MXDataIterCreateIter(const char* iter_name, mx_uint num_param,
+                         const char** keys, const char** vals,
+                         DataIterHandle* out);
+int MXDataIterBeforeFirst(DataIterHandle handle);
+int MXDataIterNext(DataIterHandle handle, int* out);
+int MXDataIterGetData(DataIterHandle handle, NDArrayHandle* out);
+int MXDataIterGetLabel(DataIterHandle handle, NDArrayHandle* out);
+int MXDataIterGetPadNum(DataIterHandle handle, int* pad);
+int MXDataIterFree(DataIterHandle handle);
+
+/* ---- RecordIO (reference MXRecordIO*, c_api.h:2283+) -------------------- */
+int MXRecordIOWriterCreate(const char* uri, RecordIOHandle* out);
+int MXRecordIOWriterWriteRecord(RecordIOHandle handle, const char* buf,
+                                size_t size);
+int MXRecordIOWriterFree(RecordIOHandle handle);
+int MXRecordIOReaderCreate(const char* uri, RecordIOHandle* out);
+/* *buf NULL + *size 0 at end of stream; buffer valid until next read */
+int MXRecordIOReaderReadRecord(RecordIOHandle handle, const char** buf,
+                               size_t* size);
+int MXRecordIOReaderFree(RecordIOHandle handle);
+
+/* ---- profiler (reference MXSetProcessProfilerConfig/State) -------------- */
+int MXSetProcessProfilerConfig(int num_params, const char** keys,
+                               const char** vals);
+int MXSetProcessProfilerState(int state);  /* 0 stop, 1 run */
+int MXDumpProcessProfile(int finished);
+/* aggregate stats table; reset!=0 clears accumulated records */
+int MXAggregateProfileStatsPrint(const char** out_str, int reset);
 
 /* ---- kvstore ------------------------------------------------------------ */
 int MXKVStoreCreate(const char* type, KVStoreHandle* out);

@@ -88,6 +88,45 @@ def _lib():
     lib.MXKVStoreFree.argtypes = [vp]
     lib.MXListAllOpNames.argtypes = [u32p, ctypes.POINTER(cpp)]
     lib.MXGetVersion.argtypes = [intp]
+    # round-4 additions: views, infer-shape, cached op, data iter,
+    # recordio, profiler
+    lib.MXNDArrayReshape.argtypes = [vp, ctypes.c_int, intp, vpp]
+    lib.MXNDArraySlice.argtypes = [vp, u32, u32, vpp]
+    lib.MXNDArrayAt.argtypes = [vp, u32, vpp]
+    lib.MXNDArrayGetContext.argtypes = [vp, intp, intp]
+    lib.MXRandomSeed.argtypes = [ctypes.c_int]
+    u32pp = ctypes.POINTER(u32p)
+    lib.MXSymbolInferShape.argtypes = [vp, u32, cpp, u32p, u32p,
+                                       u32p, u32pp, ctypes.POINTER(u32pp),
+                                       u32p, u32pp, ctypes.POINTER(u32pp),
+                                       u32p, u32pp, ctypes.POINTER(u32pp),
+                                       intp]
+    lib.MXCreateCachedOp.argtypes = [vp, vpp]
+    lib.MXInvokeCachedOp.argtypes = [vp, ctypes.c_int, vpp, intp,
+                                     ctypes.POINTER(vpp)]
+    lib.MXFreeCachedOp.argtypes = [vp]
+    lib.MXListDataIters.argtypes = [u32p, ctypes.POINTER(cpp)]
+    lib.MXDataIterCreateIter.argtypes = [cp, u32, cpp, cpp, vpp]
+    lib.MXDataIterBeforeFirst.argtypes = [vp]
+    lib.MXDataIterNext.argtypes = [vp, intp]
+    lib.MXDataIterGetData.argtypes = [vp, vpp]
+    lib.MXDataIterGetLabel.argtypes = [vp, vpp]
+    lib.MXDataIterGetPadNum.argtypes = [vp, intp]
+    lib.MXDataIterFree.argtypes = [vp]
+    lib.MXRecordIOWriterCreate.argtypes = [cp, vpp]
+    lib.MXRecordIOWriterWriteRecord.argtypes = [vp, ctypes.c_char_p,
+                                                ctypes.c_size_t]
+    lib.MXRecordIOWriterFree.argtypes = [vp]
+    lib.MXRecordIOReaderCreate.argtypes = [cp, vpp]
+    lib.MXRecordIOReaderReadRecord.argtypes = [vp, ctypes.POINTER(cp),
+                                               ctypes.POINTER(
+                                                   ctypes.c_size_t)]
+    lib.MXRecordIOReaderFree.argtypes = [vp]
+    lib.MXSetProcessProfilerConfig.argtypes = [ctypes.c_int, cpp, cpp]
+    lib.MXSetProcessProfilerState.argtypes = [ctypes.c_int]
+    lib.MXDumpProcessProfile.argtypes = [ctypes.c_int]
+    lib.MXAggregateProfileStatsPrint.argtypes = [ctypes.POINTER(cp),
+                                                 ctypes.c_int]
     return lib
 
 
@@ -470,3 +509,180 @@ def test_standalone_c_training(tmp_path):
                       timeout=300, env=env)
     assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
     assert "C-TRAIN-OK" in r.stdout
+
+
+@needs_lib
+class TestCtypesRound4:
+    """Round-4 C API surface: views, infer-shape, cached op, data iter,
+    RecordIO, profiler (parity: reference c_api.h MXNDArraySlice:699,
+    MXSymbolInferShape:1482, MXCreateCachedOpEx:1376, MXDataIter*:2195+,
+    MXRecordIO*:2283+, MXSetProcessProfilerConfig)."""
+
+    def test_views_and_context(self):
+        lib = _lib()
+        x = np.arange(24, dtype=np.float32).reshape(6, 4)
+        h = _mk_ndarray(lib, x)
+        out = vp()
+        dims = (ctypes.c_int * 2)(3, 8)
+        assert lib.MXNDArrayReshape(h, 2, dims, ctypes.byref(out)) == 0
+        np.testing.assert_allclose(_to_numpy(lib, out), x.reshape(3, 8))
+        sl = vp()
+        assert lib.MXNDArraySlice(h, 1, 3, ctypes.byref(sl)) == 0
+        np.testing.assert_allclose(_to_numpy(lib, sl), x[1:3])
+        at = vp()
+        assert lib.MXNDArrayAt(h, 2, ctypes.byref(at)) == 0
+        np.testing.assert_allclose(_to_numpy(lib, at), x[2])
+        dt, di = ctypes.c_int(), ctypes.c_int()
+        assert lib.MXNDArrayGetContext(h, ctypes.byref(dt),
+                                       ctypes.byref(di)) == 0
+        assert dt.value in (1, 6)  # cpu or tpu
+        assert lib.MXRandomSeed(7) == 0
+        for hh in (h, out, sl, at):
+            lib.MXNDArrayFree(hh)
+
+    def test_infer_shape(self):
+        lib = _lib()
+        x = vp()
+        assert lib.MXSymbolCreateVariable(b"x", ctypes.byref(x)) == 0
+        fc = vp()
+        k = (ctypes.c_char_p * 1)(b"num_hidden")
+        v = (ctypes.c_char_p * 1)(b"8")
+        ins = (vp * 1)(x)
+        assert lib.MXSymbolCreateOp(b"FullyConnected", 1, k, v, 1, ins,
+                                    b"fc", ctypes.byref(fc)) == 0, _err(lib)
+        ind = (u32 * 2)(0, 2)
+        sdata = (u32 * 2)(5, 3)
+        keys = (ctypes.c_char_p * 1)(b"x")
+        u32p_t = ctypes.POINTER(u32)
+        iss, oss, ass_ = u32(), u32(), u32()
+        isn, osn, asn = u32p_t(), u32p_t(), u32p_t()
+        isd = ctypes.POINTER(u32p_t)()
+        osd = ctypes.POINTER(u32p_t)()
+        asd = ctypes.POINTER(u32p_t)()
+        comp = ctypes.c_int()
+        rc = lib.MXSymbolInferShape(
+            fc, 1, keys, ind, sdata,
+            ctypes.byref(iss), ctypes.byref(isn), ctypes.byref(isd),
+            ctypes.byref(oss), ctypes.byref(osn), ctypes.byref(osd),
+            ctypes.byref(ass_), ctypes.byref(asn), ctypes.byref(asd),
+            ctypes.byref(comp))
+        assert rc == 0, _err(lib)
+        outs = [tuple(osd[i][j] for j in range(osn[i]))
+                for i in range(oss.value)]
+        assert outs == [(5, 8)], outs
+        args_shapes = [tuple(isd[i][j] for j in range(isn[i]))
+                       for i in range(iss.value)]
+        assert (5, 3) in args_shapes and (8, 3) in args_shapes
+        assert comp.value == 1
+
+    def test_cached_op(self):
+        lib = _lib()
+        x = vp()
+        assert lib.MXSymbolCreateVariable(b"x", ctypes.byref(x)) == 0
+        act = vp()
+        k = (ctypes.c_char_p * 1)(b"act_type")
+        v = (ctypes.c_char_p * 1)(b"relu")
+        ins = (vp * 1)(x)
+        assert lib.MXSymbolCreateOp(b"Activation", 1, k, v, 1, ins, b"a",
+                                    ctypes.byref(act)) == 0, _err(lib)
+        co = vp()
+        assert lib.MXCreateCachedOp(act, ctypes.byref(co)) == 0, _err(lib)
+        data = np.array([[-1.0, 2.0], [3.0, -4.0]], np.float32)
+        h = _mk_ndarray(lib, data)
+        inh = (vp * 1)(h)
+        nout = ctypes.c_int(0)
+        outs = ctypes.POINTER(vp)()
+        for _ in range(2):  # second call hits the executor cache
+            assert lib.MXInvokeCachedOp(co, 1, inh, ctypes.byref(nout),
+                                        ctypes.byref(outs)) == 0, _err(lib)
+            np.testing.assert_allclose(_to_numpy(lib, outs[0]),
+                                       np.maximum(data, 0))
+        assert lib.MXFreeCachedOp(co) == 0
+
+    def test_data_iter(self, tmp_path):
+        lib = _lib()
+        n = u32()
+        arr = cpp_t = ctypes.POINTER(ctypes.c_char_p)()
+        assert lib.MXListDataIters(ctypes.byref(n), ctypes.byref(arr)) == 0
+        names = [arr[i].decode() for i in range(n.value)]
+        assert "CSVIter" in names and "LibSVMIter" in names
+        csv = tmp_path / "d.csv"
+        np.savetxt(csv, np.arange(24, dtype=np.float32).reshape(6, 4),
+                   delimiter=",")
+        it = vp()
+        keys = (ctypes.c_char_p * 3)(b"data_csv", b"data_shape",
+                                     b"batch_size")
+        vals = (ctypes.c_char_p * 3)(str(csv).encode(), b"(4,)", b"2")
+        assert lib.MXDataIterCreateIter(b"CSVIter", 3, keys, vals,
+                                        ctypes.byref(it)) == 0, _err(lib)
+        for _pass in range(2):  # second pass after BeforeFirst
+            seen = []
+            has = ctypes.c_int()
+            while True:
+                assert lib.MXDataIterNext(it, ctypes.byref(has)) == 0
+                if not has.value:
+                    break
+                d = vp()
+                assert lib.MXDataIterGetData(it, ctypes.byref(d)) == 0
+                seen.append(_to_numpy(lib, d))
+                pad = ctypes.c_int()
+                assert lib.MXDataIterGetPadNum(it,
+                                               ctypes.byref(pad)) == 0
+                lib.MXNDArrayFree(d)
+            got = np.concatenate(seen)
+            np.testing.assert_allclose(
+                got, np.arange(24, dtype=np.float32).reshape(6, 4))
+            assert lib.MXDataIterBeforeFirst(it) == 0
+        assert lib.MXDataIterFree(it) == 0
+
+    def test_recordio_roundtrip(self, tmp_path):
+        lib = _lib()
+        rec = str(tmp_path / "t.rec").encode()
+        w = vp()
+        assert lib.MXRecordIOWriterCreate(rec, ctypes.byref(w)) == 0
+        payloads = [b"hello", b"tpu world", b"x" * 1000]
+        for p in payloads:
+            assert lib.MXRecordIOWriterWriteRecord(w, p, len(p)) == 0
+        assert lib.MXRecordIOWriterFree(w) == 0
+        r = vp()
+        assert lib.MXRecordIOReaderCreate(rec, ctypes.byref(r)) == 0
+        buf = ctypes.c_char_p()
+        sz = ctypes.c_size_t()
+        got = []
+        while True:
+            assert lib.MXRecordIOReaderReadRecord(
+                r, ctypes.byref(buf), ctypes.byref(sz)) == 0
+            if not buf.value and sz.value == 0:
+                break
+            got.append(ctypes.string_at(buf, sz.value))
+        assert got == payloads
+        assert lib.MXRecordIOReaderFree(r) == 0
+        # python reader agrees (format compatibility)
+        from mxnet_tpu.recordio import MXRecordIO
+        rd = MXRecordIO(rec.decode(), "r")
+        assert [rd.read() for _ in range(3)] == payloads
+        rd.close()
+
+    def test_profiler(self, tmp_path):
+        lib = _lib()
+        keys = (ctypes.c_char_p * 2)(b"aggregate_stats", b"filename")
+        fname = str(tmp_path / "p.json").encode()
+        vals = (ctypes.c_char_p * 2)(b"1", fname)
+        assert lib.MXSetProcessProfilerConfig(2, keys, vals) == 0, \
+            _err(lib)
+        assert lib.MXSetProcessProfilerState(1) == 0
+        # run one op so something is recorded
+        h = _mk_ndarray(lib, np.ones((4, 4), np.float32))
+        outs = ctypes.POINTER(vp)()
+        nout = ctypes.c_int(0)
+        assert lib.MXImperativeInvokeEx(b"relu", 1, (vp * 1)(h),
+                                        ctypes.byref(nout),
+                                        ctypes.byref(outs), 0, None,
+                                        None) == 0, _err(lib)
+        assert lib.MXSetProcessProfilerState(0) == 0
+        stats = ctypes.c_char_p()
+        assert lib.MXAggregateProfileStatsPrint(ctypes.byref(stats),
+                                                1) == 0
+        assert stats.value is not None
+        assert lib.MXDumpProcessProfile(1) == 0
+        assert os.path.exists(fname)

@@ -1,0 +1,53 @@
+"""Example-script smoke tests (parity: the reference CI runs example/
+scripts in its nightly pipelines — tests/nightly/straight_dope, ci/).
+
+Each example is a standalone subprocess run with a reduced budget and a
+built-in success criterion (accuracy / loss-drop / GAN-health assert),
+so "the examples work" is a tested property, not a README claim.
+
+These runs cost minutes of single-core time, so by default only the
+fastest is exercised; set MXNET_TEST_EXAMPLES=1 (ci/run.sh does) to run
+the full set.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_FULL = os.environ.get("MXNET_TEST_EXAMPLES", "") == "1"
+
+needs_full = pytest.mark.skipif(
+    not _FULL, reason="set MXNET_TEST_EXAMPLES=1 for the full example set")
+
+
+def _run(script, *args, timeout=900):
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # no TPU dialing from examples
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, "-u", os.path.join(_REPO, "examples", script),
+         *args],
+        capture_output=True, text=True, timeout=timeout, env=env)
+    assert r.returncode == 0, f"{script} failed:\n{r.stdout}\n{r.stderr}"
+    return r.stdout
+
+
+def test_sparse_linear_classification():
+    out = _run("sparse_linear_classification.py", "--epochs", "6")
+    assert "final accuracy" in out
+
+
+@needs_full
+def test_model_parallel_lstm():
+    out = _run("model_parallel_lstm.py", "--epochs", "5")
+    assert "model-parallel LSTM trained OK" in out
+
+
+@needs_full
+def test_dcgan():
+    out = _run("dcgan.py", "--iters", "100")
+    assert "DCGAN trained OK" in out

@@ -228,3 +228,76 @@ def test_dist_async_localhost(tmp_path):
     for r in results:
         np.testing.assert_allclose(r, 1.0 - 0.4, rtol=1e-5)
     np.testing.assert_array_equal(results[0], results[1])
+
+
+_PROFILED_WORKER = """
+import os, sys
+rank = int(sys.argv[1]); port = int(sys.argv[2])
+os.environ["DMLC_RANK"] = str(rank)
+os.environ["DMLC_NUM_WORKER"] = "2"
+os.environ["DMLC_PS_ROOT_URI"] = "127.0.0.1"
+os.environ["DMLC_PS_ROOT_PORT"] = str(port)
+import mxnet_tpu as mx
+from mxnet_tpu import kvstore as kvs
+kv = kvs.create("dist_sync")
+if rank == 0:
+    # only rank 0 drives the server profiler (reference contract:
+    # commands come from one worker)
+    mx.profiler.set_kvstore_handle(kv)
+    mx.profiler.set_config(filename=sys.argv[3], aggregate_stats=True)
+    mx.profiler.start()
+kv.init("w", mx.nd.ones((4,)))
+kv.push("w", mx.nd.ones((4,)))
+kv.barrier()
+out = mx.nd.zeros((4,))
+kv.pull("w", out=out)
+if rank == 0:
+    mx.profiler.stop()
+    mx.profiler.dump()
+"""
+
+
+def test_server_side_profiling(tmp_path):
+    """Worker profiler commands reach the PS (parity: reference
+    KVStoreServerProfilerCommand, include/mxnet/kvstore.h:49 +
+    tests/nightly/test_server_profiling.py): set_kvstore_handle routes
+    set_config/start/stop/dump to the server, which writes its own
+    *_server.json trace."""
+    import subprocess
+    import sys
+
+    from mxnet_tpu import profiler
+    from mxnet_tpu.kvstore_server import KVServer
+    port = 19671
+    server = KVServer(port=port, num_workers=2)
+    t = threading.Thread(target=server.run, daemon=True)
+    t.start()
+    time.sleep(0.2)
+    fname = str(tmp_path / "prof.json")
+    script = str(tmp_path / "worker.py")
+    with open(script, "w") as f:
+        f.write(_PROFILED_WORKER)
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    saved = dict(profiler._config)
+    try:
+        procs = [subprocess.Popen(
+            [sys.executable, script, str(r), str(port), fname],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+            for r in range(2)]
+        for p in procs:
+            out, err = p.communicate(timeout=300)
+            assert p.returncode == 0, err.decode()
+        # worker wrote its own trace ...
+        assert os.path.exists(fname)
+        # ... and the server (this process, via the command channel)
+        # wrote the _server variant
+        server_trace = str(tmp_path / "prof_server.json")
+        assert os.path.exists(server_trace), os.listdir(tmp_path)
+    finally:
+        server._stop.set()
+        profiler._config.update(saved)
+        profiler._state["kvstore"] = None

@@ -2,6 +2,8 @@
 (reference: src/profiler/profiler.h:260 engine-integrated profiling;
 threaded_engine.cc:422-451 exception rethrow at WaitToRead/WaitForAll,
 tests/python/unittest/test_exc_handling.py)."""
+import os
+
 import numpy as np
 import pytest
 
@@ -115,3 +117,96 @@ def test_healthy_path_unaffected():
     y = (x * 2 + 1)
     np.testing.assert_allclose(y.asnumpy(), 3.0)
     nd.waitall()
+
+
+def test_profiler_api_events_and_json_dumps():
+    """profile_api records sync-point events (reference c_api_profile.cc);
+    dumps(format='json') returns the aggregate dict."""
+    import mxnet_tpu as mx
+    mx.profiler.set_config(profile_api=True, aggregate_stats=True)
+    mx.profiler.start()
+    try:
+        x = mx.nd.ones((4, 4))
+        (x * 2).asnumpy()
+        mx.nd.waitall()
+    finally:
+        mx.profiler.stop()
+    agg = mx.profiler.dumps(format="json", reset=True)
+    names = set(agg)
+    assert "MXNDArraySyncCopyToCPU" in names, names
+    assert "MXNDArrayWaitAll" in names, names
+    for v in agg.values():
+        assert v["count"] >= 1 and v["total_ms"] >= 0
+    mx.profiler.set_config(profile_api=False)
+
+
+def test_profiler_counter_and_marker_events(tmp_path):
+    """Counters emit chrome-trace 'C' samples; aggregate table ignores
+    them (they have no duration)."""
+    import json
+    import mxnet_tpu as mx
+    fname = str(tmp_path / "trace.json")
+    mx.profiler.set_config(filename=fname)
+    mx.profiler.start()
+    try:
+        dom = mx.profiler.Domain("test")
+        ctr = dom.new_counter("queue_depth", 0)
+        ctr.set_value(5)
+        ctr += 3
+        dom.new_marker("epoch_end").mark()
+    finally:
+        mx.profiler.stop()
+    mx.profiler.dump()
+    events = json.load(open(fname))["traceEvents"]
+    cs = [e for e in events if e.get("ph") == "C"
+          and e["name"] == "test:queue_depth"]
+    assert [e["args"]["value"] for e in cs] == [5, 8]
+    table = mx.profiler.dumps(reset=True)
+    assert "queue_depth" not in table  # counters aren't duration rows
+
+
+def test_profiler_continuous_dump(tmp_path):
+    import json
+    import time as _t
+    import mxnet_tpu as mx
+    fname = str(tmp_path / "cont.json")
+    mx.profiler.set_config(filename=fname, continuous_dump=True,
+                           dump_period=0.05)
+    mx.profiler.start()
+    try:
+        x = mx.nd.ones((2, 2))
+        (x + 1).asnumpy()
+        deadline = _t.time() + 5
+        while not os.path.exists(fname) and _t.time() < deadline:
+            _t.sleep(0.02)
+    finally:
+        mx.profiler.stop()
+        mx.profiler.set_config(continuous_dump=False)
+    assert os.path.exists(fname), "periodic dump never fired"
+    json.load(open(fname))  # valid JSON
+    mx.profiler.dumps(reset=True)
+
+
+def test_profiler_autostart_env(tmp_path):
+    """MXNET_PROFILER_AUTOSTART starts profiling at import
+    (reference env_var.md:193-197)."""
+    import subprocess
+    import sys
+    code = (
+        "import mxnet_tpu as mx\n"
+        "assert mx.profiler.is_running()\n"
+        "x = mx.nd.ones((2,2)); (x+1).asnumpy()\n"
+        "mx.profiler.stop()\n"
+        "assert 'broadcast' in mx.profiler.dumps() or "
+        "'_plus_scalar' in mx.profiler.dumps()\n"
+        "print('AUTOSTART-OK')\n")
+    env = dict(os.environ)
+    env["MXNET_PROFILER_AUTOSTART"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=300, env=env)
+    assert r.returncode == 0, r.stderr
+    assert "AUTOSTART-OK" in r.stdout
