@@ -293,10 +293,14 @@ class _CCachedOp:
         ex = self._cache.get(key)
         args = dict(zip(self.arg_names, inputs))
         if ex is None:
-            ex = self.sym.bind(inputs[0].context, args, grad_req="null")
+            # bind against executor-owned slot copies, never the caller's
+            # arrays: the executor's arg_dict aliases whatever it was
+            # bound with, and later copy_params_from writes would
+            # otherwise mutate the first invocation's inputs in place
+            slots = {k: v.copy() for k, v in args.items()}
+            ex = self.sym.bind(inputs[0].context, slots, grad_req="null")
             self._cache[key] = ex
-        else:
-            ex.copy_params_from(args)
+        ex.copy_params_from(args)
         ex.forward(is_train=False)
         return list(ex.outputs)
 

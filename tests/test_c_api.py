@@ -597,6 +597,16 @@ class TestCtypesRound4:
                                         ctypes.byref(outs)) == 0, _err(lib)
             np.testing.assert_allclose(_to_numpy(lib, outs[0]),
                                        np.maximum(data, 0))
+        # cache-hit with a DIFFERENT input handle must not mutate the
+        # first input (the executor binds slot copies, not caller arrays)
+        data2 = -data
+        h2 = _mk_ndarray(lib, data2)
+        inh2 = (vp * 1)(h2)
+        assert lib.MXInvokeCachedOp(co, 1, inh2, ctypes.byref(nout),
+                                    ctypes.byref(outs)) == 0, _err(lib)
+        np.testing.assert_allclose(_to_numpy(lib, outs[0]),
+                                   np.maximum(data2, 0))
+        np.testing.assert_allclose(_to_numpy(lib, h), data)  # unharmed
         assert lib.MXFreeCachedOp(co) == 0
 
     def test_data_iter(self, tmp_path):

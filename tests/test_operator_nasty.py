@@ -584,3 +584,158 @@ def test_embedding_grad_accumulates_rows():
     want[2] = 2.0
     want[5] = 1.0
     np.testing.assert_allclose(w.grad.asnumpy(), want)
+
+
+# --- sequence ops (reference test_operator.py test_sequence_mask/last/
+#     reverse — variable lengths, time-major layout) ------------------------
+def test_sequence_mask_value_and_axis():
+    T, N, D = 5, 3, 2
+    x = _a(T, N, D)
+    slen = np.array([1, 3, 5], np.float32)
+    out = nd.SequenceMask(nd.array(x), nd.array(slen),
+                          use_sequence_length=True, value=-7.0).asnumpy()
+    expect = x.copy()
+    for n in range(N):
+        expect[int(slen[n]):, n, :] = -7.0
+    np.testing.assert_allclose(out, expect)
+    # axis=1: (N, T, D) layout
+    xt = np.transpose(x, (1, 0, 2))
+    out1 = nd.SequenceMask(nd.array(xt), nd.array(slen),
+                           use_sequence_length=True, value=-7.0,
+                           axis=1).asnumpy()
+    np.testing.assert_allclose(out1, np.transpose(expect, (1, 0, 2)))
+    # without use_sequence_length: identity
+    np.testing.assert_allclose(
+        nd.SequenceMask(nd.array(x)).asnumpy(), x)
+
+
+def test_sequence_last_and_grad():
+    T, N, D = 6, 4, 3
+    x = _a(T, N, D)
+    slen = np.array([2, 6, 1, 4], np.float32)
+    data = nd.array(x)
+    data.attach_grad()
+    with mx.autograd.record():
+        last = nd.SequenceLast(data, nd.array(slen),
+                               use_sequence_length=True)
+        loss = last.sum()
+    loss.backward()
+    expect = np.stack([x[int(slen[n]) - 1, n] for n in range(N)])
+    np.testing.assert_allclose(last.asnumpy(), expect, rtol=1e-6)
+    # gradient flows only into the selected timestep of each sequence
+    g = data.grad.asnumpy()
+    gexpect = np.zeros_like(x)
+    for n in range(N):
+        gexpect[int(slen[n]) - 1, n, :] = 1.0
+    np.testing.assert_allclose(g, gexpect)
+
+
+def test_sequence_reverse_lengths():
+    T, N, D = 5, 2, 2
+    x = _a(T, N, D)
+    slen = np.array([3, 5], np.float32)
+    out = nd.SequenceReverse(nd.array(x), nd.array(slen),
+                             use_sequence_length=True).asnumpy()
+    expect = x.copy()
+    for n in range(N):
+        L = int(slen[n])
+        expect[:L, n] = x[:L, n][::-1]
+    np.testing.assert_allclose(out, expect)
+    # full reverse without lengths
+    np.testing.assert_allclose(
+        nd.SequenceReverse(nd.array(x)).asnumpy(), x[::-1])
+
+
+# --- LeakyReLU family (reference test_operator.py test_leaky_relu /
+#     test_prelu / test_selu) ------------------------------------------------
+def test_leaky_relu_family_values_and_grads():
+    x = _a(4, 5)
+    x[0, 0] = 0.0  # kink point
+    v = nd.array(x)
+    # leaky
+    out = nd.LeakyReLU(v, act_type="leaky", slope=0.1).asnumpy()
+    np.testing.assert_allclose(out, np.where(x > 0, x, 0.1 * x), rtol=1e-6)
+    # elu
+    out = nd.LeakyReLU(v, act_type="elu", slope=0.5).asnumpy()
+    np.testing.assert_allclose(out, np.where(x > 0, x, 0.5 * np.expm1(x)),
+                               rtol=1e-5)
+    # selu pins the published constants
+    alpha, scale = 1.6732632423543772, 1.0507009873554805
+    out = nd.LeakyReLU(v, act_type="selu").asnumpy()
+    np.testing.assert_allclose(
+        out, scale * np.where(x > 0, x, alpha * np.expm1(x)), rtol=1e-5)
+
+
+def test_prelu_per_channel_gamma_grad():
+    x = _a(2, 3, 4)
+    gamma = np.array([0.1, 0.2, 0.3], np.float32)
+    data, g = nd.array(x), nd.array(gamma)
+    data.attach_grad()
+    g.attach_grad()
+    with mx.autograd.record():
+        y = nd.LeakyReLU(data, g, act_type="prelu")
+        loss = y.sum()
+    loss.backward()
+    gb = gamma.reshape(1, 3, 1)
+    np.testing.assert_allclose(y.asnumpy(), np.where(x > 0, x, gb * x),
+                               rtol=1e-6)
+    np.testing.assert_allclose(data.grad.asnumpy(),
+                               np.where(x > 0, 1.0, gb * np.ones_like(x)),
+                               rtol=1e-6)
+    # d(loss)/d(gamma_c) = sum of negative x over channel c
+    gexp = np.where(x < 0, x, 0).sum(axis=(0, 2))
+    np.testing.assert_allclose(g.grad.asnumpy(), gexp, rtol=1e-5)
+
+
+# --- L2Normalization modes (reference test_operator.py
+#     test_l2_normalization) ------------------------------------------------
+@pytest.mark.parametrize("mode", ["instance", "channel", "spatial"])
+def test_l2_normalization_modes(mode):
+    x = _a(2, 3, 4, 5)
+    out = nd.L2Normalization(nd.array(x), mode=mode).asnumpy()
+    axes = {"instance": (1, 2, 3), "channel": (1,),
+            "spatial": (2, 3)}[mode]
+    norm = np.sqrt((x ** 2).sum(axis=axes, keepdims=True) + 1e-10)
+    np.testing.assert_allclose(out, x / norm, rtol=1e-5)
+    # unit norm property along the reduced axes
+    nrm = (out ** 2).sum(axis=axes)
+    np.testing.assert_allclose(nrm, np.ones_like(nrm), rtol=1e-4)
+
+
+# --- InstanceNorm (reference test_operator.py test_instance_normalization)
+def test_instance_norm_matches_manual():
+    x = _a(2, 3, 4, 4)
+    gamma = _a(3, lo=0.5, hi=1.5)
+    beta = _a(3)
+    eps = 1e-3
+    out = nd.InstanceNorm(nd.array(x), nd.array(gamma), nd.array(beta),
+                          eps=eps).asnumpy()
+    mean = x.mean(axis=(2, 3), keepdims=True)
+    var = x.var(axis=(2, 3), keepdims=True)
+    expect = ((x - mean) / np.sqrt(var + eps)) * gamma.reshape(1, 3, 1, 1) \
+        + beta.reshape(1, 3, 1, 1)
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+    # per-(sample, channel) standardization: mean~0, var~1 pre-affine
+    raw = nd.InstanceNorm(nd.array(x), nd.ones((3,)), nd.zeros((3,)),
+                          eps=eps).asnumpy()
+    np.testing.assert_allclose(raw.mean(axis=(2, 3)),
+                               np.zeros((2, 3)), atol=1e-6)
+
+
+# --- Dropout train/eval modes (reference test_operator.py test_dropout)
+def test_dropout_modes():
+    x = np.ones((200, 200), np.float32)
+    v = nd.array(x)
+    # eval mode (no autograd train scope): identity
+    out = nd.Dropout(v, p=0.5).asnumpy()
+    np.testing.assert_allclose(out, x)
+    # train mode: ~half zeroed, survivors scaled by 1/(1-p)
+    with mx.autograd.record(train_mode=True):
+        out = nd.Dropout(v, p=0.5).asnumpy()
+    zeros = (out == 0).mean()
+    assert 0.4 < zeros < 0.6, zeros
+    survivors = out[out != 0]
+    np.testing.assert_allclose(survivors, 2.0, rtol=1e-5)
+    # mode='always' drops outside training too
+    out = nd.Dropout(v, p=0.5, mode="always").asnumpy()
+    assert 0.4 < (out == 0).mean() < 0.6

@@ -1,0 +1,100 @@
+"""Thread-local frontend state (parity: tests/python/unittest/
+test_thread_local.py + tests/nightly/test_tlocal_racecondition.py —
+AttrScope, NameManager prefixes, default Context, and autograd recording
+state must be per-thread, or concurrent model builders corrupt each
+other)."""
+import threading
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu import symbol as sym
+
+
+def _run_in_thread(fn):
+    out, err = [], []
+
+    def wrap():
+        try:
+            out.append(fn())
+        except BaseException as e:  # surface assertion failures
+            err.append(e)
+
+    t = threading.Thread(target=wrap)
+    t.start()
+    t.join(60)
+    if err:
+        raise err[0]
+    return out[0]
+
+
+def test_attr_scope_is_thread_local():
+    with mx.AttrScope(ctx_group="main_group"):
+        def other():
+            # the spawned thread must NOT inherit main's open scope
+            v = sym.var("tv")
+            assert v._outputs[0][0].attrs.get("ctx_group") is None
+            with mx.AttrScope(ctx_group="other_group"):
+                w = sym.var("tw")
+            return w._outputs[0][0].attrs.get("ctx_group")
+
+        got = _run_in_thread(other)
+        assert got == "other_group"
+        # main thread's scope is still active and unchanged
+        u = sym.var("u_main")
+        assert u._outputs[0][0].attrs.get("ctx_group") == "main_group"
+
+
+def test_autograd_recording_is_thread_local():
+    with autograd.record():
+        assert autograd.is_recording()
+
+        def other():
+            return autograd.is_recording()
+
+        assert _run_in_thread(other) is False
+    assert not autograd.is_recording()
+
+
+def test_default_context_is_thread_local():
+    prev = mx.current_context()
+    with mx.Context("cpu", 1):
+        assert mx.current_context().device_id == 1
+
+        def other():
+            return mx.current_context().device_id
+
+        # spawned thread sees the process default, not main's override
+        assert _run_in_thread(other) == prev.device_id
+    assert mx.current_context() == prev
+
+
+def test_concurrent_graph_builders_do_not_cross_talk():
+    """test_tlocal_racecondition analog: N threads each build + run a
+    small recorded graph; names/scopes/grads must stay per-thread."""
+    results = {}
+    errs = []
+
+    def build(i):
+        try:
+            with mx.AttrScope(ctx_group=f"g{i}"):
+                v = sym.var(f"v{i}")
+                assert v._outputs[0][0].attrs["ctx_group"] == f"g{i}"
+            x = nd.array(np.full((4,), float(i + 1), np.float32))
+            x.attach_grad()
+            with autograd.record():
+                y = (x * x).sum()
+            y.backward()
+            results[i] = x.grad.asnumpy().copy()
+        except BaseException as e:
+            errs.append((i, e))
+
+    threads = [threading.Thread(target=build, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+    assert not errs, errs
+    for i in range(4):
+        np.testing.assert_allclose(results[i], 2.0 * (i + 1))
