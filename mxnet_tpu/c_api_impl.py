@@ -300,7 +300,8 @@ class _CCachedOp:
             slots = {k: v.copy() for k, v in args.items()}
             ex = self.sym.bind(inputs[0].context, slots, grad_req="null")
             self._cache[key] = ex
-        ex.copy_params_from(args)
+        else:
+            ex.copy_params_from(args)  # miss path already copied via slots
         ex.forward(is_train=False)
         return list(ex.outputs)
 

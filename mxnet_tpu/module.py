@@ -534,8 +534,17 @@ class Module(BaseModule):
 
     @property
     def output_shapes(self):
-        return [(n, o.shape) for n, o in zip(self.output_names,
-                                             self._exec.outputs)]
+        if self._exec is not None and self._exec.outputs:
+            return [(n, o.shape) for n, o in zip(self.output_names,
+                                                 self._exec.outputs)]
+        # before the first forward the executor has no output arrays yet
+        # (reference modules report inferred shapes straight from bind) —
+        # infer from the bound data/label shapes instead
+        known = {d.name: d.shape for d in (self._data_shapes or [])}
+        for l in (self._label_shapes or []):
+            known[l.name] = l.shape
+        _, outs, _ = self.symbol.infer_shape_partial(**known)
+        return list(zip(self.output_names, outs or []))
 
     def install_monitor(self, mon):
         assert self.binded
@@ -753,3 +762,130 @@ def _as_data_desc(x):
         return x
     name, shape = x[0], x[1]
     return mx_io.DataDesc(name, tuple(shape))
+
+
+class PythonModule(BaseModule):
+    """A module whose computation is written directly in Python
+    (parity: module/python_module.py PythonModule) — no symbol, no
+    parameters by default. Subclasses implement forward/backward and
+    ``_compute_output_shapes``; everything parameter/optimizer-shaped is
+    a no-op so the module slots into SequentialModule pipelines and
+    the fit() loop unchanged."""
+
+    def __init__(self, data_names, label_names, output_names,
+                 logger=logging):
+        super().__init__(logger=logger)
+        self._data_names = tuple(data_names)
+        self._label_names = tuple(label_names or ())
+        self._output_names = tuple(output_names)
+        self._data_shapes = None
+        self._label_shapes = None
+        self._output_shapes = None
+
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def output_names(self):
+        return self._output_names
+
+    @property
+    def data_shapes(self):
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        return self._output_shapes
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False,
+             shared_module=None, grad_req="write"):
+        if self.binded and not force_rebind:
+            return
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self._data_shapes = [_as_data_desc(x) for x in data_shapes]
+        self._label_shapes = ([_as_data_desc(x) for x in label_shapes]
+                              if label_shapes else None)
+        self._output_shapes = self._compute_output_shapes()
+        self.binded = True
+
+    def _compute_output_shapes(self):
+        """[(name, shape)] of this module's outputs — subclass hook."""
+        raise NotImplementedError()
+
+    # -- parameters: none by default ---------------------------------------
+    def get_params(self):
+        return {}, {}
+
+    def init_params(self, initializer=None, arg_params=None,
+                    aux_params=None, allow_missing=False, force_init=False,
+                    allow_extra=False):
+        self.params_initialized = True
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        self.optimizer_initialized = True
+
+    def update(self):
+        pass
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        if self._label_shapes is not None:
+            eval_metric.update(labels, self.get_outputs())
+
+
+class PythonLossModule(PythonModule):
+    """A Python-defined loss head (parity: module/python_module.py
+    PythonLossModule): forward caches the incoming scores, backward
+    produces the input gradient from ``grad_func(scores, labels)`` —
+    the escape hatch for losses that are awkward as symbols, typically
+    as the last stage of a SequentialModule."""
+
+    def __init__(self, name="pyloss", data_names=("data",),
+                 label_names=("softmax_label",), logger=logging,
+                 grad_func=None):
+        super().__init__([name + "_" + d for d in data_names],
+                         label_names,
+                         [name + "_output"], logger=logger)
+        self._name = name
+        self._scores = None
+        self._labels = None
+        self._scores_grad = None
+        if grad_func is not None and not callable(grad_func):
+            raise TypeError("grad_func must be callable")
+        self._grad_func = grad_func
+
+    def _compute_output_shapes(self):
+        # loss passes scores through: one output, shaped like the input
+        return [(self._name + "_output", self._data_shapes[0].shape)]
+
+    def forward(self, data_batch, is_train=None):
+        self._scores = data_batch.data[0]
+        if data_batch.label:
+            self._labels = data_batch.label[0]
+
+    def get_outputs(self, merge_multi_context=True):
+        return [self._scores]
+
+    def backward(self, out_grads=None):
+        assert out_grads is None, \
+            "PythonLossModule is a loss head; it accepts no head grads"
+        if self._grad_func is None:
+            raise NotImplementedError(
+                "PythonLossModule requires grad_func (the reference's "
+                "fallback was an RTC CUDA kernel; provide the gradient "
+                "of your loss w.r.t. the scores)")
+        grad = self._grad_func(self._scores, self._labels)
+        if not isinstance(grad, nd.NDArray):
+            grad = nd.array(grad)
+        self._scores_grad = grad
+
+    def get_input_grads(self, merge_multi_context=True):
+        return [self._scores_grad]

@@ -126,7 +126,7 @@ class PallasKernel:
             input_output_aliases=aliases,
             interpret=interpret,
         )
-        self._compile_cache[(grid, out_meta, scalars, interpret)] = fn
+        self._compile_cache[ck] = fn
         return fn
 
     def launch(self, args, ctx, grid_dims, block_dims=None, shared_mem=0):
@@ -138,15 +138,26 @@ class PallasKernel:
         from .ndarray import NDArray
         import jax
 
+        if len(args) != len(self._args):
+            raise MXNetError(
+                f"kernel {self.name!r} declares {len(self._args)} args "
+                f"({', '.join(a.name for a in self._args)}); launch got "
+                f"{len(args)}")
         tensors, scalars = [], []
-        ai = iter(args)
-        for a in self._args:
-            v = next(ai)
+        for a, v in zip(self._args, args):
             if a.is_ptr:
                 if not isinstance(v, NDArray):
                     raise MXNetError(
                         f"kernel arg {a.name!r} is a pointer; expected "
                         f"NDArray, got {type(v).__name__}")
+                want = ("bfloat16" if a.dtype == "bfloat16"
+                        else np.dtype(a.dtype).name)
+                got = np.dtype(v.dtype).name
+                if got != want:
+                    raise MXNetError(
+                        f"kernel arg {a.name!r} declared {want} but the "
+                        f"NDArray is {got} (the reference launch rejects "
+                        "dtype mismatches too)")
                 tensors.append(v)
             else:
                 scalars.append((a.name, np.dtype(a.dtype).type(v)
@@ -198,13 +209,28 @@ class PallasModule:
             k for k, v in ns.items() if callable(v)
             and getattr(v, "__module__", None) is None)
 
+    def _kernel_source(self, name):
+        """Source segment of one kernel function (for the per-kernel
+        grid_aware check — a sibling kernel's program_id use must not
+        vouch for this one)."""
+        import ast
+        try:
+            tree = ast.parse(self._source)
+            for node in ast.walk(tree):
+                if isinstance(node, ast.FunctionDef) and node.name == name:
+                    return ast.get_source_segment(self._source, node) or ""
+        except SyntaxError:
+            pass
+        return self._source  # unparseable: fall back to whole-module scan
+
     def get_kernel(self, name, signature):
         fn = self._ns.get(name)
         if fn is None or not callable(fn):
             raise MXNetError(f"no kernel {name!r} in module "
                              f"(defined: {sorted(self.exports)})")
-        return PallasKernel(fn, name, _parse_signature(signature),
-                            grid_aware="program_id" in self._source)
+        return PallasKernel(
+            fn, name, _parse_signature(signature),
+            grid_aware="program_id" in self._kernel_source(name))
 
 
 # source-compat alias: scripts using mx.rtc.CudaModule keep working, the

@@ -96,3 +96,83 @@ class TestLRScheduler:
         assert c(0) == pytest.approx(0.0, abs=1e-6)
         assert c(10) == pytest.approx(1.0, rel=0.2)
         assert c(100) == pytest.approx(0.0, abs=1e-3)
+
+
+class TestPythonModule:
+    """PythonModule / PythonLossModule (parity: module/python_module.py +
+    the reference's SequentialModule+PythonLossModule pattern)."""
+
+    def test_python_loss_module_trains_in_sequential(self):
+        import mxnet_tpu as mx
+        from mxnet_tpu import io as mxio
+        from mxnet_tpu import symbol as sym
+        from mxnet_tpu.module import (Module, PythonLossModule,
+                                      SequentialModule)
+
+        rng = np.random.RandomState(3)
+        n, d, k = 400, 10, 3
+        w_true = rng.randn(k, d).astype(np.float32)
+        x = rng.randn(n, d).astype(np.float32)
+        y = (x @ w_true.T).argmax(axis=1).astype(np.float32)
+
+        data = sym.var("data")
+        fc = sym.Symbol._create("FullyConnected", [data],
+                                {"num_hidden": k}, name="fc")
+        net = Module(fc, data_names=("data",), label_names=None)
+
+        def softmax_ce_grad(scores, labels):
+            # d(CE)/d(scores) per sample (un-normalized, like the
+            # reference loss ops: Module's rescale_grad=1/batch applies
+            # the mean)
+            s = scores.asnumpy()
+            e = np.exp(s - s.max(axis=1, keepdims=True))
+            p = e / e.sum(axis=1, keepdims=True)
+            lab = labels.asnumpy().astype(int)
+            p[np.arange(len(lab)), lab] -= 1.0
+            return p
+
+        loss = PythonLossModule(grad_func=softmax_ce_grad,
+                                data_names=("data",),
+                                label_names=("softmax_label",))
+        seq = SequentialModule()
+        seq.add(net).add(loss, take_labels=True)
+
+        it = mxio.NDArrayIter(mx.nd.array(x), mx.nd.array(y),
+                              batch_size=50, shuffle=True,
+                              label_name="softmax_label")
+        seq.bind(data_shapes=it.provide_data,
+                 label_shapes=it.provide_label)
+        seq.init_params(initializer=mx.initializer.Xavier())
+        seq.init_optimizer(optimizer="sgd",
+                           optimizer_params=(("learning_rate", 0.5),))
+        for _epoch in range(8):
+            it.reset()
+            for batch in it:
+                seq.forward(batch, is_train=True)
+                seq.backward()
+                seq.update()
+        # accuracy of the trained stack
+        it.reset()
+        correct = total = 0
+        for batch in it:
+            seq.forward(batch, is_train=False)
+            pred = seq.get_outputs()[0].asnumpy().argmax(axis=1)
+            lab = batch.label[0].asnumpy()
+            correct += int((pred == lab).sum())
+            total += len(lab)
+        acc = correct / total
+        assert acc > 0.9, acc
+
+    def test_python_loss_module_requires_grad_func(self):
+        import pytest as _pytest
+        from mxnet_tpu.module import PythonLossModule
+        from mxnet_tpu import io as mxio, nd as _nd
+        m = PythonLossModule()
+        m.bind(data_shapes=[mxio.DataDesc("pyloss_data", (4, 3))],
+               label_shapes=[mxio.DataDesc("softmax_label", (4,))])
+        assert m.output_shapes[0][1] == (4, 3)
+        m.forward(mxio.DataBatch(data=[_nd.ones((4, 3))],
+                                 label=[_nd.zeros((4,))]))
+        np.testing.assert_allclose(m.get_outputs()[0].asnumpy(), 1.0)
+        with _pytest.raises(NotImplementedError):
+            m.backward()

@@ -69,6 +69,37 @@ def test_grid_guard_rejects_non_grid_aware_kernels():
         k.launch([x, y, 1.0], mx.cpu(0), (4, 1, 1))
 
 
+def test_grid_guard_is_per_kernel_in_mixed_modules():
+    # a sibling kernel's program_id use must not vouch for axpy
+    src = AXPY_SRC + """
+
+def rowscale(x_ref, y_ref, alpha):
+    i = pl.program_id(0)
+    y_ref[i, :] = x_ref[i, :] * alpha
+"""
+    mod = mx.rtc.PallasModule(src)
+    bad = mod.get_kernel("axpy", "const float *x, float *y, float alpha")
+    x, y = nd.ones((8,)), nd.zeros((8,))
+    with pytest.raises(MXNetError, match="program_id"):
+        bad.launch([x, y, 1.0], mx.cpu(0), (4, 1, 1))
+    ok = mod.get_kernel("rowscale",
+                        "const float *x, float *y, float alpha")
+    x2, y2 = nd.ones((8, 4)), nd.zeros((8, 4))
+    ok.launch([x2, y2, 2.0], mx.cpu(0), (8, 1, 1))
+    np.testing.assert_allclose(y2.asnumpy(), 2.0)
+
+
+def test_launch_validates_arg_count_and_dtype():
+    mod = mx.rtc.PallasModule(AXPY_SRC)
+    k = mod.get_kernel("axpy", "const float *x, float *y, float alpha")
+    x, y = nd.ones((4,)), nd.zeros((4,))
+    with pytest.raises(MXNetError, match="declares 3 args"):
+        k.launch([x, y], mx.cpu(0), (1,))
+    xi = nd.array(np.ones(4, np.int32))
+    with pytest.raises(MXNetError, match="declared float32"):
+        k.launch([xi, y, 1.0], mx.cpu(0), (1,))
+
+
 def test_signature_and_name_errors():
     mod = mx.rtc.PallasModule(AXPY_SRC)
     with pytest.raises(MXNetError):
