@@ -1,0 +1,264 @@
+"""Caffe converter (parity: reference tools/caffe_converter/
+test_converter.py, which converts zoo models and checks outputs; here a
+LeNet-style prototxt + synthetic .caffemodel — encoded with the same
+wire helpers the parser reads — round-trips through convert_model and
+must match a hand-built symbol with identical weights)."""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "tools", "caffe_converter"))
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.contrib.onnx import _proto
+
+import prototxt as ptx
+from convert_symbol import convert_symbol
+from convert_model import convert_model, parse_caffemodel
+
+LENET_PROTOTXT = """
+name: "TinyLeNet"
+input: "data"
+input_dim: 2
+input_dim: 1
+input_dim: 12
+input_dim: 12
+layer {
+  name: "conv1"
+  type: "Convolution"
+  bottom: "data"
+  top: "conv1"
+  convolution_param { num_output: 4 kernel_size: 3 stride: 1 }
+}
+layer { name: "relu1" type: "ReLU" bottom: "conv1" top: "relu1" }
+layer {
+  name: "pool1"
+  type: "Pooling"
+  bottom: "relu1"
+  top: "pool1"
+  pooling_param { pool: MAX kernel_size: 2 stride: 2 }
+}
+layer {
+  name: "ip1"
+  type: "InnerProduct"
+  bottom: "pool1"
+  top: "ip1"
+  inner_product_param { num_output: 5 }
+}
+layer { name: "prob" type: "Softmax" bottom: "ip1" top: "prob" }
+"""
+
+
+def _blob(arr):
+    """Encode a BlobProto (shape + packed float data)."""
+    arr = np.asarray(arr, np.float32)
+    dims = b"".join(_proto._varint(int(d)) for d in arr.shape)
+    shape_msg = _proto.emit_bytes(1, dims)
+    return (_proto.emit_bytes(7, shape_msg)
+            + _proto.emit_bytes(5, arr.tobytes()))
+
+
+def _layer(name, ltype, blobs):
+    msg = _proto.emit_str(1, name) + _proto.emit_str(2, ltype)
+    for b in blobs:
+        msg += _proto.emit_bytes(7, _blob(b))
+    return _proto.emit_bytes(100, msg)  # NetParameter.layer
+
+
+def _make_caffemodel(weights):
+    out = b""
+    for name, ltype, blobs in weights:
+        out += _layer(name, ltype, blobs)
+    return out
+
+
+def test_prototxt_parser_basics():
+    net = ptx.parse(LENET_PROTOTXT)
+    assert net["name"] == "TinyLeNet"
+    assert [int(d) for d in net["input_dim"]] == [2, 1, 12, 12]
+    layers = ptx.as_list(net["layer"])
+    assert [l["type"] for l in layers] == \
+        ["Convolution", "ReLU", "Pooling", "InnerProduct", "Softmax"]
+    assert layers[0]["convolution_param"]["num_output"] == 4
+    assert layers[2]["pooling_param"]["pool"] == "MAX"
+
+
+def test_convert_symbol_structure():
+    s, input_name, input_dim = convert_symbol(LENET_PROTOTXT)
+    assert input_name == "data" and input_dim == [2, 1, 12, 12]
+    args = s.list_arguments()
+    for want in ("conv1_weight", "conv1_bias", "ip1_weight", "ip1_bias"):
+        assert want in args, args
+    _, outs, _ = s.infer_shape_partial(data=(2, 1, 12, 12))
+    assert outs[0] == (2, 5), outs
+
+
+def test_convert_model_roundtrip_matches_handbuilt():
+    rng = np.random.RandomState(0)
+    w_conv = rng.randn(4, 1, 3, 3).astype(np.float32) * 0.3
+    b_conv = rng.randn(4).astype(np.float32) * 0.1
+    w_ip = rng.randn(5, 100).astype(np.float32) * 0.1  # 4*5*5 = 100
+    b_ip = rng.randn(5).astype(np.float32) * 0.1
+    model = _make_caffemodel([
+        ("conv1", "Convolution", [w_conv, b_conv]),
+        ("ip1", "InnerProduct", [w_ip, b_ip]),
+    ])
+
+    # wire parse sanity
+    parsed = parse_caffemodel(model)
+    assert [(n, t, len(b)) for n, t, b in parsed] == \
+        [("conv1", "Convolution", 2), ("ip1", "InnerProduct", 2)]
+    np.testing.assert_allclose(parsed[0][2][0], w_conv)
+
+    s, arg_p, aux_p, input_name, input_dim = convert_model(
+        LENET_PROTOTXT, model)
+    assert not aux_p
+    x = rng.randn(*input_dim).astype(np.float32)
+    args = {input_name: nd.array(x)}
+    args.update(arg_p)
+    ex = s.bind(mx.cpu(), args, grad_req="null")
+    got = ex.forward()[0].asnumpy()
+
+    # hand-built identical network
+    data = sym.var("data")
+    h = sym.Symbol._create("Convolution", [data],
+                           {"num_filter": 4, "kernel": (3, 3)}, name="c")
+    h = sym.Symbol._create("Activation", [h], {"act_type": "relu"})
+    h = sym.Symbol._create("Pooling", [h],
+                           {"pool_type": "max", "kernel": (2, 2),
+                            "stride": (2, 2),
+                            "pooling_convention": "full"})
+    h = sym.Symbol._create("FullyConnected", [h],
+                           {"num_hidden": 5, "flatten": True}, name="f")
+    h = sym.Symbol._create("softmax", [h], {})
+    ref_args = {"data": nd.array(x),
+                "c_weight": nd.array(w_conv), "c_bias": nd.array(b_conv),
+                "f_weight": nd.array(w_ip), "f_bias": nd.array(b_ip)}
+    ref = h.bind(mx.cpu(), ref_args, grad_req="null").forward()[0].asnumpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+    assert got.shape == (2, 5)
+    np.testing.assert_allclose(got.sum(axis=1), 1.0, rtol=1e-5)
+
+
+def test_batchnorm_scale_fusion():
+    proto = """
+input: "data"
+input_dim: 2 input_dim: 3 input_dim: 4 input_dim: 4
+layer {
+  name: "bn" type: "BatchNorm" bottom: "data" top: "bn"
+  batch_norm_param { eps: 0.001 use_global_stats: true }
+}
+layer { name: "sc" type: "Scale" bottom: "bn" top: "sc"
+        scale_param { bias_term: true } }
+layer { name: "r" type: "ReLU" bottom: "sc" top: "r" }
+"""
+    rng = np.random.RandomState(1)
+    mean = rng.rand(3).astype(np.float32)
+    var = (rng.rand(3).astype(np.float32) + 0.5)
+    gamma = rng.rand(3).astype(np.float32) + 0.5
+    beta = rng.randn(3).astype(np.float32)
+    factor = 2.0
+    model = _make_caffemodel([
+        ("bn", "BatchNorm", [mean * factor, var * factor,
+                             np.array([factor], np.float32)]),
+        ("sc", "Scale", [gamma, beta]),
+    ])
+    s, arg_p, aux_p, input_name, input_dim = convert_model(proto, model)
+    np.testing.assert_allclose(aux_p["bn_moving_mean"].asnumpy(), mean,
+                               rtol=1e-6)
+    np.testing.assert_allclose(arg_p["bn_gamma"].asnumpy(), gamma)
+    x = rng.randn(*input_dim).astype(np.float32)
+    args = {input_name: nd.array(x)}
+    args.update(arg_p)
+    ex = s.bind(mx.cpu(), args, aux_states=aux_p, grad_req="null")
+    got = ex.forward(is_train=False)[0].asnumpy()
+    expect = np.maximum(
+        (x - mean.reshape(1, 3, 1, 1))
+        / np.sqrt(var.reshape(1, 3, 1, 1) + 1e-3)
+        * gamma.reshape(1, 3, 1, 1) + beta.reshape(1, 3, 1, 1), 0.0)
+    np.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_unsupported_layer_raises():
+    proto = """
+input: "data"
+input_dim: 1 input_dim: 1 input_dim: 4 input_dim: 4
+layer { name: "x" type: "FancyNewLayer" bottom: "data" top: "x" }
+"""
+    with pytest.raises(ValueError, match="FancyNewLayer"):
+        convert_symbol(proto)
+
+
+def test_trailing_accuracy_layer_and_softmax_axis():
+    proto = """
+input: "data"
+input_dim: 2 input_dim: 3 input_dim: 4 input_dim: 4
+layer { name: "sm" type: "Softmax" bottom: "data" top: "sm" }
+layer { name: "acc" type: "Accuracy" bottom: "sm" top: "acc" }
+"""
+    s, iname, idim = convert_symbol(proto)  # trailing Accuracy skipped
+    x = np.random.RandomState(0).randn(*idim).astype(np.float32)
+    ex = s.bind(mx.cpu(), {iname: nd.array(x)}, grad_req="null")
+    out = ex.forward()[0].asnumpy()
+    # caffe softmax normalizes over CHANNELS (axis=1), not trailing axis
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-5)
+
+
+def test_anisotropic_kernel_and_eltwise_coeff():
+    proto = """
+input: "data"
+input_dim: 1 input_dim: 1 input_dim: 8 input_dim: 10
+layer {
+  name: "c" type: "Convolution" bottom: "data" top: "c"
+  convolution_param { num_output: 2 kernel_size: 3 kernel_size: 5 }
+}
+"""
+    s, _n, _d = convert_symbol(proto)
+    _, outs, _ = s.infer_shape_partial(data=(1, 1, 8, 10))
+    assert outs[0] == (1, 2, 6, 6), outs  # (8-3+1, 10-5+1)
+
+    sub = """
+input: "data"
+input_dim: 1 input_dim: 2 input_dim: 3 input_dim: 3
+layer { name: "d2" type: "Dropout" bottom: "data" top: "d2"
+        dropout_param { dropout_ratio: 0.0 } }
+layer {
+  name: "e" type: "Eltwise" bottom: "data" bottom: "d2" top: "e"
+  eltwise_param { operation: SUM coeff: 1 coeff: -1 }
+}
+"""
+    s2, n2, d2 = convert_symbol(sub)
+    x = np.random.RandomState(1).randn(*d2).astype(np.float32)
+    got = s2.bind(mx.cpu(), {n2: nd.array(x)},
+                  grad_req="null").forward()[0].asnumpy()
+    np.testing.assert_allclose(got, 0.0, atol=1e-6)  # x - x
+
+
+def test_stochastic_pooling_rejected():
+    proto = """
+input: "data"
+input_dim: 1 input_dim: 1 input_dim: 4 input_dim: 4
+layer { name: "p" type: "Pooling" bottom: "data" top: "p"
+        pooling_param { pool: STOCHASTIC kernel_size: 2 } }
+"""
+    with pytest.raises(ValueError, match="STOCHASTIC"):
+        convert_symbol(proto)
+
+
+def test_unpacked_blobshape_dims():
+    # protobuf allows packed fields to arrive unpacked (one varint per
+    # field occurrence); the blob parser must accumulate, not overwrite
+    arr = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    dims_unpacked = b"".join(_proto.emit_int(1, d) for d in arr.shape)
+    blob = (_proto.emit_bytes(7, dims_unpacked)
+            + _proto.emit_bytes(5, arr.tobytes()))
+    msg = (_proto.emit_str(1, "w") + _proto.emit_str(2, "Convolution")
+           + _proto.emit_bytes(7, blob))
+    layers = parse_caffemodel(_proto.emit_bytes(100, msg))
+    assert layers[0][2][0].shape == (2, 3, 4)
+    np.testing.assert_allclose(layers[0][2][0], arr)

@@ -1,0 +1,155 @@
+"""Convert Caffe .caffemodel weights to mxnet_tpu arg/aux params.
+
+Parity: reference tools/caffe_converter/convert_model.py. The binary is
+protobuf wire format; instead of a compiled caffe_pb2 this reuses the
+framework's self-contained wire codec (mxnet_tpu/contrib/onnx/_proto.py
+parse_fields) with the handful of Caffe field numbers hard-wired from
+caffe.proto: NetParameter.layer = 100, LayerParameter
+{name=1, type=2, blobs=7}, BlobProto {shape=7, data=5 packed-float,
+num/channels/height/width = 1..4}, BlobShape.dim = 1.
+
+Weight layout translation (as in the reference converter):
+  Convolution blobs -> <name>_weight (num_filter, C, kh, kw), _bias
+  InnerProduct blobs -> <name>_weight (out, in), _bias
+  BatchNorm blobs [mean, var, scale_factor] -> moving stats / scale
+  Scale blobs [gamma, beta] -> folded onto the preceding BatchNorm
+"""
+from __future__ import annotations
+
+import os
+import struct
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def _wire():
+    from mxnet_tpu.contrib.onnx import _proto
+    return _proto
+
+
+def _parse_blob(buf):
+    """BlobProto -> np.float32 array with its declared shape."""
+    p = _wire()
+    dims, legacy = None, {}
+    data = b""
+    for field, wire, value in p.parse_fields(buf):
+        if field == 7 and wire == 2:  # shape
+            for f2, _w2, v2 in p.parse_fields(value):
+                if f2 == 1:
+                    # packed (bytes) or unpacked (one varint per field) —
+                    # protobuf parsers must accept both; accumulate
+                    new = p._unpack_ints(v2) if isinstance(v2, bytes) \
+                        else [v2]
+                    dims = (dims or []) + new
+        elif field == 5 and wire == 2:  # packed float data
+            data += value
+        elif field == 5 and wire == 5:  # unpacked float element
+            data += value
+        elif field in (1, 2, 3, 4) and wire == 0:  # legacy NCHW dims
+            legacy[field] = value
+    arr = np.frombuffer(data, dtype="<f4").astype(np.float32)
+    if dims:
+        arr = arr.reshape([int(d) for d in dims])
+    elif legacy:
+        shape = [legacy.get(i, 1) for i in (1, 2, 3, 4)]
+        arr = arr.reshape(shape)
+    return arr
+
+
+def parse_caffemodel(path_or_bytes):
+    """caffemodel -> [(name, type, [blob arrays])]."""
+    p = _wire()
+    buf = path_or_bytes
+    if isinstance(buf, (str, os.PathLike)):
+        with open(buf, "rb") as f:
+            buf = f.read()
+    layers = []
+    for field, wire, value in p.parse_fields(buf):
+        if field == 100 and wire == 2:  # NetParameter.layer
+            name = ltype = ""
+            blobs = []
+            for f2, w2, v2 in p.parse_fields(value):
+                if f2 == 1 and w2 == 2:
+                    name = v2.decode()
+                elif f2 == 2 and w2 == 2:
+                    ltype = v2.decode()
+                elif f2 == 7 and w2 == 2:
+                    blobs.append(_parse_blob(v2))
+            layers.append((name, ltype, blobs))
+    return layers
+
+
+def convert_model(prototxt_text, caffemodel):
+    """(prototxt text, caffemodel path/bytes) ->
+    (Symbol, arg_params, aux_params, input_name, input_dim)."""
+    from convert_symbol import convert_symbol
+    from mxnet_tpu import nd
+
+    symbol, input_name, input_dim = convert_symbol(prototxt_text)
+    arg_names = set(symbol.list_arguments())
+    aux_names = set(symbol.list_auxiliary_states())
+    arg_params, aux_params = {}, {}
+    pending_bn = None  # (layer name) awaiting a Scale companion
+
+    for name, ltype, blobs in parse_caffemodel(caffemodel):
+        if not blobs:
+            continue
+        if ltype in ("Convolution", "Deconvolution", "InnerProduct"):
+            w = blobs[0]
+            arg_params[f"{name}_weight"] = nd.array(w)
+            if len(blobs) > 1:
+                arg_params[f"{name}_bias"] = nd.array(blobs[1].reshape(-1))
+        elif ltype == "BatchNorm":
+            mean, var = blobs[0].reshape(-1), blobs[1].reshape(-1)
+            if len(blobs) > 2:
+                # caffe stores running stats scaled by a factor blob
+                factor = float(blobs[2].reshape(-1)[0])
+                if factor != 0:
+                    mean = mean / factor
+                    var = var / factor
+            aux_params[f"{name}_moving_mean"] = nd.array(mean)
+            aux_params[f"{name}_moving_var"] = nd.array(var)
+            pending_bn = name
+            # without a Scale companion the converter uses fix_gamma;
+            # provide neutral gamma/beta so binding is complete
+            arg_params.setdefault(f"{name}_gamma",
+                                  nd.array(np.ones_like(mean)))
+            arg_params.setdefault(f"{name}_beta",
+                                  nd.array(np.zeros_like(mean)))
+        elif ltype == "Scale" and pending_bn is not None:
+            arg_params[f"{pending_bn}_gamma"] = nd.array(
+                blobs[0].reshape(-1))
+            if len(blobs) > 1:
+                arg_params[f"{pending_bn}_beta"] = nd.array(
+                    blobs[1].reshape(-1))
+            pending_bn = None
+
+    arg_params = {k: v for k, v in arg_params.items() if k in arg_names}
+    aux_params = {k: v for k, v in aux_params.items() if k in aux_names}
+    return symbol, arg_params, aux_params, input_name, input_dim
+
+
+def main():
+    import argparse
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("prototxt")
+    ap.add_argument("caffemodel")
+    ap.add_argument("output_prefix")
+    args = ap.parse_args()
+    with open(args.prototxt) as f:
+        sym_, arg_p, aux_p, _n, _d = convert_model(f.read(),
+                                                   args.caffemodel)
+    from mxnet_tpu import nd
+    with open(args.output_prefix + "-symbol.json", "w") as f:
+        f.write(sym_.tojson())
+    save = {f"arg:{k}": v for k, v in arg_p.items()}
+    save.update({f"aux:{k}": v for k, v in aux_p.items()})
+    nd.save(args.output_prefix + "-0000.params", save)
+    print(f"saved {args.output_prefix}-symbol.json / -0000.params")
+
+
+if __name__ == "__main__":
+    main()

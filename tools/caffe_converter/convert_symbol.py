@@ -1,0 +1,253 @@
+"""Convert a Caffe prototxt network definition to an mxnet_tpu Symbol.
+
+Parity: reference tools/caffe_converter/convert_symbol.py (which walks
+caffe_pb2 LayerParameters and emits mx.symbol calls; layer coverage and
+attribute translation — ceil pooling => pooling_convention='full',
+BatchNorm+Scale fusion, grouped convolution — follow it). This version
+parses the prototxt text directly (prototxt.py) and builds Symbols
+through the registry, no Caffe install required.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import prototxt  # noqa: E402
+
+
+def _pair(param, base, default=0):
+    """Caffe kernel/stride/pad: scalar `k`, repeated per-axis `k k`,
+    or explicit k_h/k_w."""
+    v = param.get(base)
+    if v is not None:
+        vals = [int(x) for x in prototxt.as_list(v)]
+        if len(vals) == 1:
+            return (vals[0], vals[0])
+        if len(vals) == 2:
+            return (vals[0], vals[1])
+        raise ValueError(
+            f"{base}: expected at most 2 repeated values, got {vals}")
+    h = param.get(base + "_h")
+    w = param.get(base + "_w")
+    if h is not None or w is not None:
+        return (int(h or default), int(w or default))
+    return (default, default)
+
+
+def _conv_attrs(param):
+    attrs = {"num_filter": int(param["num_output"])}
+    attrs["kernel"] = _pair(param, "kernel_size")
+    attrs["stride"] = _pair(param, "stride", 1)
+    attrs["pad"] = _pair(param, "pad", 0)
+    group = int(param.get("group", 1))
+    if group != 1:
+        attrs["num_group"] = group
+    if param.get("bias_term") is False:
+        attrs["no_bias"] = True
+    dil = param.get("dilation")
+    if dil is not None:
+        ds = [int(x) for x in prototxt.as_list(dil)]
+        attrs["dilate"] = (ds[0], ds[-1]) if len(ds) <= 2 else None
+        if attrs["dilate"] is None:
+            raise ValueError(f"dilation: at most 2 values, got {ds}")
+    return attrs
+
+
+def convert_symbol(proto_text):
+    """prototxt text -> (Symbol, input_name, input_dim).
+
+    Supported layer types mirror the reference converter: Input/data,
+    Convolution, Deconvolution, Pooling (MAX/AVE, global, ceil), LRN,
+    InnerProduct, ReLU, Sigmoid, TanH, Dropout, Softmax,
+    SoftmaxWithLoss, Concat, Eltwise (SUM/PROD/MAX), Flatten,
+    BatchNorm (+ fused following Scale layer).
+    """
+    from mxnet_tpu import symbol as sym
+
+    net = prototxt.parse(proto_text)
+    layers = prototxt.as_list(net.get("layer")) or \
+        prototxt.as_list(net.get("layers"))
+    if not layers:
+        raise ValueError("no layer/layers entries in prototxt")
+
+    # -- input ---------------------------------------------------------------
+    input_name, input_dim = "data", None
+    if net.get("input"):
+        input_name = prototxt.as_list(net["input"])[0]
+        if net.get("input_dim"):
+            input_dim = [int(d) for d in prototxt.as_list(net["input_dim"])]
+        elif net.get("input_shape"):
+            shp = prototxt.as_list(net["input_shape"])[0]
+            input_dim = [int(d) for d in prototxt.as_list(shp["dim"])]
+    elif layers and layers[0].get("type") == "Input":
+        l0 = layers.pop(0)
+        input_name = prototxt.as_list(l0["top"])[0]
+        shp = l0["input_param"]["shape"]
+        input_dim = [int(d) for d in
+                     prototxt.as_list(prototxt.as_list(shp)[0]["dim"])]
+
+    blobs = {input_name: sym.var(input_name)}
+    last_top = input_name
+
+    def bottom(layer):
+        return [blobs[b] for b in prototxt.as_list(layer["bottom"])]
+
+    skip_next_scale_of = None
+    for i, layer in enumerate(layers):
+        ltype = layer["type"]
+        name = layer.get("name", f"layer{i}")
+        tops = prototxt.as_list(layer["top"]) if layer.get("top") else [name]
+        if ltype in ("Data", "ImageData", "HDF5Data", "Accuracy", "Silence"):
+            continue
+        if ltype == "Scale" and skip_next_scale_of is not None and \
+                prototxt.as_list(layer["bottom"])[0] == skip_next_scale_of:
+            # folded into the preceding BatchNorm (reference fuses too)
+            blobs[tops[0]] = blobs[skip_next_scale_of]
+            last_top = tops[0]
+            skip_next_scale_of = None
+            continue
+
+        ins = bottom(layer)
+        if ltype == "Convolution":
+            out = sym.Symbol._create(
+                "Convolution", ins, _conv_attrs(layer["convolution_param"]),
+                name=name)
+        elif ltype == "Deconvolution":
+            out = sym.Symbol._create(
+                "Deconvolution", ins,
+                _conv_attrs(layer["convolution_param"]), name=name)
+        elif ltype == "Pooling":
+            p = layer["pooling_param"]
+            pool_raw = p.get("pool", "MAX")
+            pool = {0: "max", 1: "avg",
+                    "MAX": "max", "AVE": "avg"}.get(pool_raw)
+            if pool is None:
+                # STOCHASTIC (=2) and anything newer have no analog here
+                raise ValueError(
+                    f"unsupported caffe pooling method {pool_raw!r} "
+                    f"(layer {name!r}); only MAX/AVE convert")
+            attrs = {"pool_type": pool}
+            if p.get("global_pooling"):
+                attrs["global_pool"] = True
+                attrs["kernel"] = (1, 1)
+            else:
+                attrs["kernel"] = _pair(p, "kernel_size")
+                attrs["stride"] = _pair(p, "stride", 1)
+                attrs["pad"] = _pair(p, "pad", 0)
+                # caffe pools with ceil — the reference converter maps
+                # this to pooling_convention='full'
+                attrs["pooling_convention"] = "full"
+            out = sym.Symbol._create("Pooling", ins, attrs, name=name)
+        elif ltype == "InnerProduct":
+            p = layer["inner_product_param"]
+            attrs = {"num_hidden": int(p["num_output"]), "flatten": True}
+            if p.get("bias_term") is False:
+                attrs["no_bias"] = True
+            out = sym.Symbol._create("FullyConnected", ins, attrs,
+                                     name=name)
+        elif ltype in ("ReLU", "Sigmoid", "TanH"):
+            act = {"ReLU": "relu", "Sigmoid": "sigmoid",
+                   "TanH": "tanh"}[ltype]
+            out = sym.Symbol._create("Activation", ins,
+                                     {"act_type": act}, name=name)
+        elif ltype == "LRN":
+            p = layer.get("lrn_param", {})
+            out = sym.Symbol._create(
+                "LRN", ins,
+                {"nsize": int(p.get("local_size", 5)),
+                 "alpha": float(p.get("alpha", 1e-4)),
+                 "beta": float(p.get("beta", 0.75)),
+                 "knorm": float(p.get("k", 1.0))}, name=name)
+        elif ltype == "Dropout":
+            p = layer.get("dropout_param", {})
+            out = sym.Symbol._create(
+                "Dropout", ins,
+                {"p": float(p.get("dropout_ratio", 0.5))}, name=name)
+        elif ltype == "Softmax":
+            p = layer.get("softmax_param", {})
+            # caffe softmax normalizes over channels (axis=1) by default,
+            # not the trailing axis
+            out = sym.Symbol._create("softmax", ins,
+                                     {"axis": int(p.get("axis", 1))},
+                                     name=name)
+        elif ltype == "SoftmaxWithLoss":
+            label = sym.var("softmax_label")
+            out = sym.Symbol._create("SoftmaxOutput", [ins[0], label], {},
+                                     name=name)
+        elif ltype == "Concat":
+            p = layer.get("concat_param", {})
+            out = sym.Symbol._create(
+                "Concat", ins,
+                {"dim": int(p.get("axis", 1)),
+                 "num_args": len(ins)}, name=name)
+        elif ltype == "Eltwise":
+            p = layer.get("eltwise_param", {})
+            op = p.get("operation", "SUM")
+            opname = {0: "elemwise_mul", 1: "elemwise_add",
+                      2: "broadcast_maximum", "PROD": "elemwise_mul",
+                      "SUM": "elemwise_add",
+                      "MAX": "broadcast_maximum"}[op]
+            coeffs = [float(c) for c in prototxt.as_list(p.get("coeff"))]
+            if coeffs and opname != "elemwise_add":
+                raise ValueError("eltwise coeff is only valid with SUM")
+            terms = list(ins)
+            if coeffs:
+                if len(coeffs) != len(terms):
+                    raise ValueError(
+                        f"eltwise: {len(coeffs)} coeffs for "
+                        f"{len(terms)} inputs")
+                terms = [t if c == 1.0 else
+                         sym.Symbol._create("_mul_scalar", [t],
+                                            {"scalar": c})
+                         for t, c in zip(terms, coeffs)]
+            out = terms[0]
+            for extra in terms[1:]:
+                out = sym.Symbol._create(opname, [out, extra], {})
+        elif ltype == "Flatten":
+            out = sym.Symbol._create("Flatten", ins, {}, name=name)
+        elif ltype == "BatchNorm":
+            p = layer.get("batch_norm_param", {})
+            attrs = {"eps": float(p.get("eps", 1e-5)),
+                     "use_global_stats":
+                         bool(p.get("use_global_stats", True))}
+            # a following Scale layer supplies gamma/beta; without one,
+            # gamma is fixed (caffe BatchNorm has no affine part)
+            nxt = layers[i + 1] if i + 1 < len(layers) else None
+            if nxt is not None and nxt.get("type") == "Scale" and \
+                    prototxt.as_list(nxt["bottom"])[0] == tops[0]:
+                skip_next_scale_of = tops[0]
+                # the Scale layer's gamma/beta are real parameters —
+                # override BatchNorm's fix_gamma=True default
+                attrs["fix_gamma"] = False
+            else:
+                attrs["fix_gamma"] = True
+            out = sym.Symbol._create("BatchNorm", ins, attrs, name=name)
+        else:
+            raise ValueError(
+                f"unsupported caffe layer type {ltype!r} (layer {name!r})"
+                " — extend convert_symbol.py, the mapping table is small")
+        blobs[tops[0]] = out
+        last_top = tops[0]
+
+    # the network output is the last COMPUTED top — trailing
+    # Accuracy/Silence/data layers are skipped and never produce one
+    return blobs[last_top], input_name, input_dim
+
+
+def main():
+    import argparse
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("prototxt")
+    ap.add_argument("output_json")
+    args = ap.parse_args()
+    with open(args.prototxt) as f:
+        s, _name, _dim = convert_symbol(f.read())
+    with open(args.output_json, "w") as f:
+        f.write(s.tojson())
+    print(f"saved symbol to {args.output_json}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,106 @@
+"""Minimal Caffe prototxt (protobuf text-format) parser.
+
+Role parity: reference tools/caffe_converter/caffe_parser.py, which
+needs a compiled caffe_pb2; here the text format is parsed directly —
+prototxt is a simple nested ``key: value`` / ``key { ... }`` grammar —
+so the converter has zero Caffe dependency.
+
+Returns plain dicts: repeated keys become lists, ``key { ... }`` blocks
+become nested dicts, enum identifiers stay strings, numbers and
+true/false are converted.
+"""
+from __future__ import annotations
+
+import re
+
+_TOKEN = re.compile(
+    r"""(?:
+      (?P<brace>[{}])
+    | (?P<colon>:)
+    | (?P<string>"(?:[^"\\]|\\.)*")
+    | (?P<number>[-+]?(?:\d+\.\d*|\.\d+|\d+)(?:[eE][-+]?\d+)?)
+    | (?P<ident>[A-Za-z_][A-Za-z0-9_.\-]*)
+    )""",
+    re.VERBOSE,
+)
+
+
+def _tokenize(text):
+    pos, n = 0, len(text)
+    while pos < n:
+        while pos < n and text[pos].isspace():
+            pos += 1
+        if pos >= n:
+            break
+        if text[pos] == "#":  # comment to end of line
+            nl = text.find("\n", pos)
+            pos = n if nl == -1 else nl + 1
+            continue
+        m = _TOKEN.match(text, pos)
+        if m is None:
+            snippet = text[pos:pos + 20]
+            raise ValueError(f"prototxt parse error at {snippet!r}")
+        pos = m.end()
+        yield m.lastgroup, m.group()
+
+
+def _coerce(kind, raw):
+    if kind == "string":
+        return raw[1:-1].encode().decode("unicode_escape")
+    if kind == "number":
+        f = float(raw)
+        return int(f) if f == int(f) and "." not in raw and "e" not in raw.lower() else f
+    if raw in ("true", "false"):
+        return raw == "true"
+    return raw  # enum identifier (MAX, AVE, SUM, ...)
+
+
+def _store(d, key, value):
+    if key in d:
+        cur = d[key]
+        if isinstance(cur, list):
+            cur.append(value)
+        else:
+            d[key] = [cur, value]
+    else:
+        d[key] = value
+
+
+def parse(text):
+    """Parse prototxt text into a nested dict."""
+    tokens = list(_tokenize(text))
+    pos = 0
+
+    def block():
+        nonlocal pos
+        out = {}
+        while pos < len(tokens):
+            kind, tok = tokens[pos]
+            if kind == "brace" and tok == "}":
+                pos += 1
+                return out
+            if kind != "ident":
+                raise ValueError(f"expected field name, got {tok!r}")
+            key = tok
+            pos += 1
+            kind, tok = tokens[pos]
+            if kind == "colon":
+                pos += 1
+                vkind, vtok = tokens[pos]
+                pos += 1
+                _store(out, key, _coerce(vkind, vtok))
+            elif kind == "brace" and tok == "{":
+                pos += 1
+                _store(out, key, block())
+            else:
+                raise ValueError(f"expected ':' or '{{' after {key!r}")
+        return out
+
+    return block()
+
+
+def as_list(v):
+    """A possibly-repeated field as a list ([] for absent)."""
+    if v is None:
+        return []
+    return v if isinstance(v, list) else [v]
