@@ -478,6 +478,14 @@ class NDArray:
         if isinstance(key, NDArray):
             return invoke("take", [self, key], {"axis": 0, "mode": "clip"})
         if isinstance(key, (int, np.integer)):
+            n = self._data.shape[0] if self._data.ndim else 0
+            if not -n <= key < n:
+                # jax clamps out-of-range indices; without this check,
+                # iterating an NDArray never terminates (the iteration
+                # protocol probes __getitem__ until IndexError)
+                raise IndexError(
+                    f"index {key} is out of bounds for axis 0 with "
+                    f"size {n}")
             return NDArray(self._data[key], self._ctx, _base=self, _view_index=key)
         if key == slice(None):
             return self

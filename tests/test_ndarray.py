@@ -172,3 +172,20 @@ def test_topk_sort():
     v = a.topk(k=2, ret_typ="value")
     assert np.allclose(v.asnumpy(), -np.sort(-x, axis=1)[:, :2])
     assert np.allclose(a.sort().asnumpy(), np.sort(x, axis=1))
+
+
+def test_integer_index_bounds_and_iteration():
+    """Out-of-range integer indexing must raise IndexError (jax would
+    silently clamp), which is also what makes `for row in arr` and
+    list(arr) terminate instead of looping forever."""
+    import numpy as _np
+    import pytest as _pytest
+    a = nd.array(_np.arange(6, dtype=_np.float32).reshape(3, 2))
+    with _pytest.raises(IndexError):
+        a[3]
+    with _pytest.raises(IndexError):
+        a[-4]
+    _np.testing.assert_allclose(a[-1].asnumpy(), [4.0, 5.0])
+    rows = [r.asnumpy() for r in a]
+    assert len(rows) == 3
+    _np.testing.assert_allclose(_np.stack(rows), a.asnumpy())
