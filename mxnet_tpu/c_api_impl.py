@@ -13,9 +13,16 @@ from __future__ import annotations
 
 import numpy as np
 
-# MXNet dtype codes (reference include/mxnet/base.h TypeFlag)
+# MXNet dtype codes (reference include/mxnet/base.h TypeFlag / mshadow)
 _DTYPE_BY_CODE = {0: np.float32, 1: np.float64, 2: np.float16,
-                  3: np.uint8, 4: np.int32, 5: np.int8, 6: np.int64}
+                  3: np.uint8, 4: np.int32, 5: np.int8, 6: np.int64,
+                  7: np.bool_, 8: np.int16, 9: np.uint16,
+                  10: np.uint32, 11: np.uint64}
+try:
+    import ml_dtypes as _ml_dtypes
+    _DTYPE_BY_CODE[12] = _ml_dtypes.bfloat16  # mshadow kBfloat16
+except ImportError:
+    pass
 _CODE_BY_DTYPE = {np.dtype(v).name: k for k, v in _DTYPE_BY_CODE.items()}
 _CODE_BY_DTYPE["bfloat16"] = 12  # mshadow kBfloat16
 
@@ -264,6 +271,86 @@ def symbol_infer_shape(s, names, shapes):
                 and all(v for v in list(args) + list(outs)
                         + list(aux or [])))
     return clean(args), clean(outs), clean(aux), bool(complete)
+
+
+# --- symbol type inference / attrs / views -----------------------------------
+def symbol_infer_type(s, names, type_codes):
+    """MXSymbolInferType parity: mshadow dtype codes in/out, -1 unknown."""
+    known = {}
+    for n, c in zip(names, type_codes):
+        if c < 0:
+            continue
+        dt = _DTYPE_BY_CODE.get(c)
+        if dt is None:
+            from .base import MXNetError
+            raise MXNetError(
+                f"unknown mshadow dtype code {c} for argument {n!r} "
+                f"(known: {sorted(_DTYPE_BY_CODE)})")
+        known[n] = dt
+    args, outs, aux = s.infer_type(**known)
+
+    def codes(group):
+        return [_CODE_BY_DTYPE.get(np.dtype(t).name, -1) if t is not None
+                else -1 for t in (group or [])]
+
+    complete = (args is not None
+                and all(t is not None
+                        for t in list(args) + list(outs) + list(aux or [])))
+    return codes(args), codes(outs), codes(aux), bool(complete)
+
+
+def symbol_get_attr(s, key):
+    return s.attr(key)
+
+
+def symbol_set_attr(s, key, value):
+    # attrs live on the head node (reference MXSymbolSetAttr contract);
+    # a multi-output group has no single head — Symbol.attr would read
+    # None right back, so reject rather than silently drop
+    if len(s._outputs) != 1:
+        from .base import MXNetError
+        raise MXNetError(
+            "MXSymbolSetAttr: cannot set an attribute on a grouped "
+            f"symbol with {len(s._outputs)} outputs")
+    s._outputs[0][0].attrs[key] = value
+    return True
+
+
+def symbol_get_internals(s):
+    return s.get_internals()
+
+
+def symbol_get_output(s, index):
+    return s[int(index)]
+
+
+# --- executor reshape --------------------------------------------------------
+def executor_reshape(ex, partial_shaping, allow_up_sizing, names, shapes):
+    kwargs = {n: tuple(int(d) for d in shp)
+              for n, shp in zip(names, shapes)}
+    return ex.reshape(partial_shaping=bool(partial_shaping),
+                      allow_up_sizing=bool(allow_up_sizing), **kwargs)
+
+
+# --- raw-bytes serialization -------------------------------------------------
+def ndarray_save_raw(arr):
+    """Single-array serialization in the framework's .params entry
+    format (reference MXNDArraySaveRawBytes / NDArray::Save)."""
+    from .ndarray.utils import _save_one
+    buf = []
+    _save_one(buf, arr)
+    return b"".join(buf)
+
+
+def ndarray_load_raw(data):
+    import io as _io
+    from .ndarray.utils import _load_one
+    return _load_one(_io.BytesIO(data))
+
+
+def accelerator_count():
+    from .context import num_tpus, num_gpus
+    return num_tpus() or num_gpus()
 
 
 # --- cached op ---------------------------------------------------------------

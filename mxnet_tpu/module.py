@@ -463,8 +463,12 @@ class Module(BaseModule):
         for name, arr in feed.items():
             tgt = self._exec.arg_dict[name]
             if tuple(arr.shape) != tuple(tgt.shape):
-                # shape change (last partial batch / bucketing): reshape
+                # shape change (last partial batch / bucketing): reshape.
+                # The module owns its data arrays, so growing back to the
+                # full batch after a partial one is expected — opt into
+                # both relaxations explicitly
                 self._exec = self._exec.reshape(
+                    partial_shaping=True, allow_up_sizing=True,
                     **{n: a.shape for n, a in feed.items()})
             break
         for name, arr in feed.items():

@@ -310,7 +310,12 @@ class Executor:
 
     def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
         """Return a new executor with new input shapes (parity:
-        executor.py reshape; cheap here — recompile happens lazily)."""
+        executor.py reshape; cheap here — recompile happens lazily).
+
+        partial_shaping: permit args NOT named in kwargs to change shape
+        (else that's an error, the reference contract). allow_up_sizing:
+        permit new shapes with more elements than the old array (the
+        reference refuses to grow buffers silently without it)."""
         from .. import ndarray as nd
         arg_shapes, _, aux_shapes = self._symbol.infer_shape(**kwargs)
         new_args = {}
@@ -319,6 +324,22 @@ class Executor:
             if old is not None and tuple(old.shape) == tuple(shape):
                 new_args[name] = old
             else:
+                if old is not None:
+                    if not partial_shaping and name not in kwargs:
+                        raise MXNetError(
+                            f"reshape changes the shape of {name!r} "
+                            f"({tuple(old.shape)} -> {tuple(shape)}) which "
+                            "was not listed; pass partial_shaping=True "
+                            "to allow it (reference MXExecutorReshapeEx "
+                            "contract)")
+                    if (not allow_up_sizing
+                            and int(np.prod(shape))
+                            > int(np.prod(old.shape))):
+                        raise MXNetError(
+                            f"reshape grows {name!r} from "
+                            f"{tuple(old.shape)} to {tuple(shape)}; pass "
+                            "allow_up_sizing=True to permit buffer "
+                            "growth")
                 new_args[name] = nd.zeros(shape, ctx=self._ctx,
                                           dtype=old.dtype if old is not None
                                           else np.float32)

@@ -766,6 +766,206 @@ int MXSymbolInferShape(SymbolHandle sym, mx_uint num_args,
   return 0;
 }
 
+// --- symbol type inference / attrs / views ---------------------------------
+namespace {
+thread_local std::vector<int> g_in_types, g_out_types, g_aux_types;
+thread_local std::string g_ret_attr;
+thread_local std::string g_ret_raw;
+
+void intlist_from_py(PyObject* seq, std::vector<int>* out) {
+  Py_ssize_t n = PySequence_Size(seq);
+  out->assign(n, -1);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* it = PySequence_GetItem(seq, i);
+    (*out)[i] = static_cast<int>(PyLong_AsLong(it));
+    Py_XDECREF(it);
+  }
+}
+}  // namespace
+
+int MXSymbolInferType(SymbolHandle sym, mx_uint num_args,
+                      const char** keys, const int* arg_type_data,
+                      mx_uint* in_type_size, const int** in_type_data,
+                      mx_uint* out_type_size, const int** out_type_data,
+                      mx_uint* aux_type_size, const int** aux_type_data,
+                      int* complete) {
+  if (!sym) return fail("null handle");
+  Gil gil;
+  PyObject* names = list_from_strs(num_args, keys);
+  PyObject* types = PyList_New(num_args);
+  for (mx_uint i = 0; i < num_args; ++i) {
+    PyList_SET_ITEM(types, i, PyLong_FromLong(arg_type_data[i]));
+  }
+  PyObject* args = Py_BuildValue("(OOO)", sym, names, types);
+  Py_DECREF(names);
+  Py_DECREF(types);
+  PyObject* r = args ? call("symbol_infer_type", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  intlist_from_py(PyTuple_GetItem(r, 0), &g_in_types);
+  intlist_from_py(PyTuple_GetItem(r, 1), &g_out_types);
+  intlist_from_py(PyTuple_GetItem(r, 2), &g_aux_types);
+  *complete = PyObject_IsTrue(PyTuple_GetItem(r, 3));
+  Py_DECREF(r);
+  *in_type_size = static_cast<mx_uint>(g_in_types.size());
+  *in_type_data = g_in_types.data();
+  *out_type_size = static_cast<mx_uint>(g_out_types.size());
+  *out_type_data = g_out_types.data();
+  *aux_type_size = static_cast<mx_uint>(g_aux_types.size());
+  *aux_type_data = g_aux_types.data();
+  return 0;
+}
+
+int MXSymbolGetAttr(SymbolHandle sym, const char* key, const char** out,
+                    int* success) {
+  if (!sym) return fail("null handle");
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Os)", sym, key);
+  PyObject* r = args ? call("symbol_get_attr", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  if (r == Py_None) {
+    *out = nullptr;
+    *success = 0;
+  } else {
+    const char* c = PyUnicode_AsUTF8(r);
+    g_ret_attr = c ? c : "";
+    *out = g_ret_attr.c_str();
+    *success = 1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXSymbolSetAttr(SymbolHandle sym, const char* key, const char* value) {
+  if (!sym) return fail("null handle");
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Oss)", sym, key, value);
+  PyObject* r = args ? call("symbol_set_attr", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXSymbolGetInternals(SymbolHandle sym, SymbolHandle* out) {
+  if (!sym) return fail("null handle");
+  Gil gil;
+  return handle_out_call("symbol_get_internals",
+                         Py_BuildValue("(O)", sym), out);
+}
+
+int MXSymbolGetOutput(SymbolHandle sym, mx_uint index, SymbolHandle* out) {
+  if (!sym) return fail("null handle");
+  Gil gil;
+  return handle_out_call("symbol_get_output",
+                         Py_BuildValue("(OI)", sym, index), out);
+}
+
+// --- executor reshape ------------------------------------------------------
+int MXExecutorReshape(ExecutorHandle handle, int partial_shaping,
+                      int allow_up_sizing, mx_uint num_args,
+                      const char** keys, const mx_uint* arg_ind_ptr,
+                      const mx_uint* arg_shape_data, ExecutorHandle* out) {
+  if (!handle) return fail("null handle");
+  Gil gil;
+  PyObject* names = list_from_strs(num_args, keys);
+  PyObject* shapes = PyList_New(num_args);
+  for (mx_uint i = 0; i < num_args; ++i) {
+    mx_uint b = arg_ind_ptr[i], e = arg_ind_ptr[i + 1];
+    PyObject* shp = PyTuple_New(e - b);
+    for (mx_uint j = b; j < e; ++j) {
+      PyTuple_SET_ITEM(shp, j - b,
+                       PyLong_FromUnsignedLong(arg_shape_data[j]));
+    }
+    PyList_SET_ITEM(shapes, i, shp);
+  }
+  PyObject* args = Py_BuildValue("(OiiOO)", handle, partial_shaping,
+                                 allow_up_sizing, names, shapes);
+  Py_DECREF(names);
+  Py_DECREF(shapes);
+  return handle_out_call("executor_reshape", args, out);
+}
+
+// --- kvstore string keys ---------------------------------------------------
+namespace {
+int kv_op_ex(const char* fn, KVStoreHandle handle, mx_uint num,
+             const char** keys, NDArrayHandle* vals, int priority) {
+  if (!handle) return fail("null handle");
+  Gil gil;
+  PyObject* k = list_from_strs(num, keys);
+  PyObject* v = list_from_handles(num, vals);
+  PyObject* args = std::string(fn) == "kvstore_init"
+                       ? Py_BuildValue("(OOO)", handle, k, v)
+                       : Py_BuildValue("(OOOi)", handle, k, v, priority);
+  Py_DECREF(k);
+  Py_DECREF(v);
+  PyObject* r = args ? call(fn, args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  Py_DECREF(r);
+  return 0;
+}
+}  // namespace
+
+int MXKVStoreInitEx(KVStoreHandle handle, mx_uint num, const char** keys,
+                    NDArrayHandle* vals) {
+  return kv_op_ex("kvstore_init", handle, num, keys, vals, 0);
+}
+
+int MXKVStorePushEx(KVStoreHandle handle, mx_uint num, const char** keys,
+                    NDArrayHandle* vals, int priority) {
+  return kv_op_ex("kvstore_push", handle, num, keys, vals, priority);
+}
+
+int MXKVStorePullEx(KVStoreHandle handle, mx_uint num, const char** keys,
+                    NDArrayHandle* vals, int priority) {
+  return kv_op_ex("kvstore_pull", handle, num, keys, vals, priority);
+}
+
+// --- raw-bytes serialization -----------------------------------------------
+int MXNDArraySaveRawBytes(NDArrayHandle handle, size_t* out_size,
+                          const char** out_buf) {
+  if (!handle) return fail("null handle");
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", handle);
+  PyObject* r = args ? call("ndarray_save_raw", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  char* src = nullptr;
+  Py_ssize_t n = 0;
+  if (PyBytes_AsStringAndSize(r, &src, &n) != 0) {
+    Py_DECREF(r);
+    return fail_from_python();
+  }
+  g_ret_raw.assign(src, static_cast<size_t>(n));
+  Py_DECREF(r);
+  *out_buf = g_ret_raw.data();
+  *out_size = g_ret_raw.size();
+  return 0;
+}
+
+int MXNDArrayLoadFromRawBytes(const void* buf, size_t size,
+                              NDArrayHandle* out) {
+  ensure_python();
+  Gil gil;
+  PyObject* data = PyBytes_FromStringAndSize(
+      static_cast<const char*>(buf), static_cast<Py_ssize_t>(size));
+  return handle_out_call("ndarray_load_raw", Py_BuildValue("(N)", data),
+                         out);
+}
+
+// --- device discovery ------------------------------------------------------
+int MXGetGPUCount(int* out) {
+  ensure_python();
+  Gil gil;
+  PyObject* r = call("accelerator_count", nullptr);
+  if (!r) return fail_from_python();
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
 // --- cached op -------------------------------------------------------------
 int MXCreateCachedOp(SymbolHandle sym, CachedOpHandle* out) {
   if (!sym) return fail("null handle");

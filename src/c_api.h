@@ -139,6 +139,47 @@ int MXSymbolInferShape(SymbolHandle sym, mx_uint num_args,
                        const mx_uint*** aux_shape_data,
                        int* complete);
 
+/* ---- symbol type inference / attrs / views ------------------------------ */
+/* Reference MXSymbolInferType (c_api.h:1553): known arg dtypes arrive as
+ * mshadow codes (-1 = unknown) keyed by name. */
+int MXSymbolInferType(SymbolHandle sym, mx_uint num_args,
+                      const char** keys, const int* arg_type_data,
+                      mx_uint* in_type_size, const int** in_type_data,
+                      mx_uint* out_type_size, const int** out_type_data,
+                      mx_uint* aux_type_size, const int** aux_type_data,
+                      int* complete);
+int MXSymbolGetAttr(SymbolHandle sym, const char* key, const char** out,
+                    int* success);
+int MXSymbolSetAttr(SymbolHandle sym, const char* key, const char* value);
+int MXSymbolGetInternals(SymbolHandle sym, SymbolHandle* out);
+int MXSymbolGetOutput(SymbolHandle sym, mx_uint index, SymbolHandle* out);
+
+/* ---- executor reshape (reference MXExecutorReshapeEx) ------------------- */
+/* CSR layout like MXSymbolInferShape; returns a NEW executor sharing
+ * parameters with the old one (bucketing contract). */
+int MXExecutorReshape(ExecutorHandle handle, int partial_shaping,
+                      int allow_up_sizing, mx_uint num_args,
+                      const char** keys, const mx_uint* arg_ind_ptr,
+                      const mx_uint* arg_shape_data, ExecutorHandle* out);
+
+/* ---- kvstore string keys (reference MXKVStoreInitEx/PushEx/PullEx) ------ */
+int MXKVStoreInitEx(KVStoreHandle handle, mx_uint num, const char** keys,
+                    NDArrayHandle* vals);
+int MXKVStorePushEx(KVStoreHandle handle, mx_uint num, const char** keys,
+                    NDArrayHandle* vals, int priority);
+int MXKVStorePullEx(KVStoreHandle handle, mx_uint num, const char** keys,
+                    NDArrayHandle* vals, int priority);
+
+/* ---- raw-bytes serialization (reference MXNDArraySaveRawBytes) ---------- */
+/* buffer valid until the next call on this thread */
+int MXNDArraySaveRawBytes(NDArrayHandle handle, size_t* out_size,
+                          const char** out_buf);
+int MXNDArrayLoadFromRawBytes(const void* buf, size_t size,
+                              NDArrayHandle* out);
+
+/* ---- device discovery --------------------------------------------------- */
+int MXGetGPUCount(int* out);   /* accelerator (TPU) count here */
+
 /* ---- cached op (hybridize from C; reference MXCreateCachedOpEx) --------- */
 int MXCreateCachedOp(SymbolHandle sym, CachedOpHandle* out);
 int MXInvokeCachedOp(CachedOpHandle handle, int num_inputs,

@@ -696,3 +696,174 @@ class TestCtypesRound4:
         assert stats.value is not None
         assert lib.MXDumpProcessProfile(1) == 0
         assert os.path.exists(fname)
+
+
+@needs_lib
+class TestCtypesRound4b:
+    """Second C-API widening batch: infer-type, symbol attrs/views,
+    executor reshape, string-key kvstore, raw-bytes serde, device count
+    (reference c_api.h MXSymbolInferType:1553, MXSymbolGetAttr,
+    MXExecutorReshapeEx, MXKVStoreInitEx:1714+, MXNDArraySaveRawBytes)."""
+
+    def _fc(self, lib):
+        x = vp()
+        assert lib.MXSymbolCreateVariable(b"x", ctypes.byref(x)) == 0
+        fc = vp()
+        k = (ctypes.c_char_p * 1)(b"num_hidden")
+        v = (ctypes.c_char_p * 1)(b"8")
+        assert lib.MXSymbolCreateOp(b"FullyConnected", 1, k, v, 1,
+                                    (vp * 1)(x), b"fc",
+                                    ctypes.byref(fc)) == 0, _err(lib)
+        return fc
+
+    def test_infer_type(self):
+        lib = _lib()
+        intp = ctypes.POINTER(ctypes.c_int)
+        lib.MXSymbolInferType.argtypes = [
+            vp, u32, ctypes.POINTER(ctypes.c_char_p), intp,
+            ctypes.POINTER(u32), ctypes.POINTER(intp),
+            ctypes.POINTER(u32), ctypes.POINTER(intp),
+            ctypes.POINTER(u32), ctypes.POINTER(intp),
+            intp]
+        fc = self._fc(lib)
+        keys = (ctypes.c_char_p * 1)(b"x")
+        codes = (ctypes.c_int * 1)(0)  # float32
+        iss, oss, ass_ = u32(), u32(), u32()
+        isd, osd, asd = intp(), intp(), intp()
+        comp = ctypes.c_int()
+        assert lib.MXSymbolInferType(
+            fc, 1, keys, codes,
+            ctypes.byref(iss), ctypes.byref(isd),
+            ctypes.byref(oss), ctypes.byref(osd),
+            ctypes.byref(ass_), ctypes.byref(asd),
+            ctypes.byref(comp)) == 0, _err(lib)
+        assert comp.value == 1
+        assert [isd[i] for i in range(iss.value)].count(0) == iss.value
+        assert osd[0] == 0  # float32 output
+
+    def test_symbol_attrs_and_views(self):
+        lib = _lib()
+        lib.MXSymbolGetAttr.argtypes = [vp, ctypes.c_char_p,
+                                        ctypes.POINTER(ctypes.c_char_p),
+                                        ctypes.POINTER(ctypes.c_int)]
+        lib.MXSymbolSetAttr.argtypes = [vp, ctypes.c_char_p,
+                                        ctypes.c_char_p]
+        lib.MXSymbolGetInternals.argtypes = [vp, vpp_t()]
+        lib.MXSymbolGetOutput.argtypes = [vp, u32, vpp_t()]
+        fc = self._fc(lib)
+        out = ctypes.c_char_p()
+        ok = ctypes.c_int()
+        assert lib.MXSymbolGetAttr(fc, b"ctx_group", ctypes.byref(out),
+                                   ctypes.byref(ok)) == 0
+        assert ok.value == 0
+        assert lib.MXSymbolSetAttr(fc, b"ctx_group", b"dev1") == 0
+        assert lib.MXSymbolGetAttr(fc, b"ctx_group", ctypes.byref(out),
+                                   ctypes.byref(ok)) == 0
+        assert ok.value == 1 and out.value == b"dev1"
+        internals = vp()
+        assert lib.MXSymbolGetInternals(fc, ctypes.byref(internals)) == 0
+        n = u32()
+        arr = ctypes.POINTER(ctypes.c_char_p)()
+        assert lib.MXSymbolListOutputs(internals, ctypes.byref(n),
+                                       ctypes.byref(arr)) == 0
+        names = [arr[i].decode() for i in range(n.value)]
+        assert any("fc" in s for s in names), names
+        first = vp()
+        assert lib.MXSymbolGetOutput(internals, 0,
+                                     ctypes.byref(first)) == 0, _err(lib)
+
+    def test_executor_reshape(self):
+        lib = _lib()
+        u32p_t = ctypes.POINTER(u32)
+        lib.MXExecutorReshape.argtypes = [
+            vp, ctypes.c_int, ctypes.c_int, u32,
+            ctypes.POINTER(ctypes.c_char_p), u32p_t, u32p_t, vpp_t()]
+        fc = self._fc(lib)
+        # bind at batch 4
+        x = _mk_ndarray(lib, np.ones((4, 3), np.float32))
+        w = _mk_ndarray(lib, np.ones((8, 3), np.float32) * 0.5)
+        b = _mk_ndarray(lib, np.zeros((8,), np.float32))
+        names = (ctypes.c_char_p * 3)(b"x", b"fc_weight", b"fc_bias")
+        arrs = (vp * 3)(x, w, b)
+        reqs = (ctypes.c_char_p * 3)(b"null", b"null", b"null")
+        ex = vp()
+        assert lib.MXExecutorBind(fc, 1, 0, 3, names, arrs, reqs, 0,
+                                  None, None, ctypes.byref(ex)) == 0, \
+            _err(lib)
+        # reshape x to batch 6
+        ind = (u32 * 2)(0, 2)
+        sdata = (u32 * 2)(6, 3)
+        keys = (ctypes.c_char_p * 1)(b"x")
+        ex2 = vp()
+        assert lib.MXExecutorReshape(ex, 0, 1, 1, keys, ind, sdata,
+                                     ctypes.byref(ex2)) == 0, _err(lib)
+        assert lib.MXExecutorForward(ex2, 0) == 0, _err(lib)
+        nout = u32()
+        outs = ctypes.POINTER(vp)()
+        assert lib.MXExecutorOutputs(ex2, ctypes.byref(nout),
+                                     ctypes.byref(outs)) == 0
+        got = _to_numpy(lib, outs[0])
+        # resized args get FRESH (zero) data arrays; only params are
+        # shared (the reference reshape/bucketing contract) — so the
+        # output is bias-only zeros at the new batch size
+        assert got.shape == (6, 8), got.shape
+        np.testing.assert_allclose(got, 0.0)
+        # the original executor still works at its own batch size
+        assert lib.MXExecutorForward(ex, 0) == 0, _err(lib)
+        assert lib.MXExecutorOutputs(ex, ctypes.byref(nout),
+                                     ctypes.byref(outs)) == 0
+        np.testing.assert_allclose(_to_numpy(lib, outs[0]), 1.5)
+
+    def test_kvstore_string_keys(self):
+        lib = _lib()
+        cpp_t2 = ctypes.POINTER(ctypes.c_char_p)
+        lib.MXKVStoreInitEx.argtypes = [vp, u32, cpp_t2, vpp_t()]
+        lib.MXKVStorePushEx.argtypes = [vp, u32, cpp_t2, vpp_t(),
+                                        ctypes.c_int]
+        lib.MXKVStorePullEx.argtypes = [vp, u32, cpp_t2, vpp_t(),
+                                        ctypes.c_int]
+        kv = vp()
+        assert lib.MXKVStoreCreate(b"local", ctypes.byref(kv)) == 0
+        keys = (ctypes.c_char_p * 1)(b"weight")
+        val = _mk_ndarray(lib, np.full((4,), 2.0, np.float32))
+        assert lib.MXKVStoreInitEx(kv, 1, keys, (vp * 1)(val)) == 0, \
+            _err(lib)
+        grad = _mk_ndarray(lib, np.ones((4,), np.float32))
+        assert lib.MXKVStorePushEx(kv, 1, keys, (vp * 1)(grad), 0) == 0
+        out = _mk_ndarray(lib, np.zeros((4,), np.float32))
+        assert lib.MXKVStorePullEx(kv, 1, keys, (vp * 1)(out), 0) == 0
+        # local kvstore without an updater: push REPLACES the stored
+        # value (reference KVStoreLocal contract)
+        np.testing.assert_allclose(_to_numpy(lib, out), 1.0)
+        lib.MXKVStoreFree(kv)
+
+    def test_raw_bytes_roundtrip(self):
+        lib = _lib()
+        lib.MXNDArraySaveRawBytes.argtypes = [
+            vp, ctypes.POINTER(ctypes.c_size_t),
+            ctypes.POINTER(ctypes.c_char_p)]
+        lib.MXNDArrayLoadFromRawBytes.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, vpp_t()]
+        x = np.random.RandomState(3).randn(3, 5).astype(np.float32)
+        h = _mk_ndarray(lib, x)
+        size = ctypes.c_size_t()
+        buf = ctypes.c_char_p()
+        assert lib.MXNDArraySaveRawBytes(h, ctypes.byref(size),
+                                         ctypes.byref(buf)) == 0, _err(lib)
+        raw = ctypes.string_at(buf, size.value)
+        h2 = vp()
+        assert lib.MXNDArrayLoadFromRawBytes(raw, len(raw),
+                                             ctypes.byref(h2)) == 0, \
+            _err(lib)
+        np.testing.assert_allclose(_to_numpy(lib, h2), x)
+
+    def test_gpu_count(self):
+        lib = _lib()
+        lib.MXGetGPUCount.argtypes = [ctypes.POINTER(ctypes.c_int)]
+        n = ctypes.c_int(-1)
+        assert lib.MXGetGPUCount(ctypes.byref(n)) == 0
+        assert n.value >= 0
+
+
+def vpp_t():
+    return ctypes.POINTER(vp)

@@ -10,6 +10,7 @@ import pytest
 import mxnet_tpu as mx
 from mxnet_tpu import autograd, gluon
 from mxnet_tpu.gluon import nn
+from mxnet_tpu.base import MXNetError
 import mxnet_tpu.symbol as sym
 
 
@@ -166,8 +167,14 @@ def test_executor_reshape():
     out = sym.FullyConnected(sym.var("data"), sym.var("w"), sym.var("b"),
                              num_hidden=4)
     ex = out.simple_bind(mx.cpu(), data=(2, 6))
-    ex2 = ex.reshape(data=(5, 6))
+    # growing a buffer needs the explicit flag (reference contract)
+    with pytest.raises(MXNetError):
+        ex.reshape(data=(5, 6))
+    ex2 = ex.reshape(data=(5, 6), allow_up_sizing=True)
     assert ex2.forward()[0].shape == (5, 4)
+    # shrinking is always allowed
+    ex3 = ex.reshape(data=(1, 6))
+    assert ex3.forward()[0].shape == (1, 4)
 
 
 def test_auto_created_param_vars():
