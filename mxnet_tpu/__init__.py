@@ -29,7 +29,8 @@ def __getattr__(name):
                 "symbol", "sym", "io", "image", "kvstore", "profiler", "module", "mod",
                 "callback", "monitor", "parallel", "test_utils", "visualization",
                 "executor", "runtime", "model", "recordio", "contrib", "amp", "config",
-                "operator", "subgraph", "attribute", "torch_bridge", "th", "rtc"):
+                "operator", "subgraph", "attribute", "torch_bridge", "th", "rtc",
+                "util", "log"):
         target = {"sym": "symbol", "mod": "module",
                   "th": "torch_bridge"}.get(name, name)
         mod = importlib.import_module(f".{target}", __name__)

@@ -349,8 +349,8 @@ def ndarray_load_raw(data):
 
 
 def accelerator_count():
-    from .context import num_tpus, num_gpus
-    return num_tpus() or num_gpus()
+    from .util import get_gpu_count
+    return get_gpu_count()
 
 
 # --- cached op ---------------------------------------------------------------

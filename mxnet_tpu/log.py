@@ -1,0 +1,75 @@
+"""Logging utilities (parity: python/mxnet/log.py — a level-colored
+console formatter and getLogger helpers). Re-designed minimally: same
+public names, ANSI colors only on TTYs, no global side effects."""
+from __future__ import annotations
+
+import logging
+import sys
+
+DEBUG = logging.DEBUG
+INFO = logging.INFO
+WARNING = logging.WARNING
+ERROR = logging.ERROR
+NOTSET = logging.NOTSET
+
+_COLORS = {logging.DEBUG: "\x1b[32m",     # green
+           logging.INFO: "\x1b[36m",      # cyan
+           logging.WARNING: "\x1b[33m",   # yellow
+           logging.ERROR: "\x1b[31m"}     # red
+_RESET = "\x1b[0m"
+_LABELS = {logging.DEBUG: "D", logging.INFO: "I",
+           logging.WARNING: "W", logging.ERROR: "E"}
+
+
+class _Formatter(logging.Formatter):
+    """Level-tagged formatter; colored when the stream is a terminal."""
+
+    def __init__(self, colored=None):
+        if colored is None:
+            colored = getattr(sys.stderr, "isatty", lambda: False)()
+        self._colored = colored
+        super().__init__(datefmt="%m%d %H:%M:%S")
+
+    def format(self, record):
+        label = _LABELS.get(record.levelno, "U")
+        if self._colored and record.levelno in _COLORS:
+            label = f"{_COLORS[record.levelno]}{label}{_RESET}"
+        self._style._fmt = (f"{label}%(asctime)s %(process)d "
+                            f"%(pathname)s:%(lineno)d] %(message)s")
+        return super().format(record)
+
+
+def getLogger(name=None, filename=None, filemode=None, level=None):
+    """Deprecated alias of get_logger (parity: log.py:80)."""
+    import warnings
+    warnings.warn("getLogger is deprecated, use get_logger instead",
+                  DeprecationWarning)
+    return get_logger(name, filename, filemode, level)
+
+
+def get_logger(name=None, filename=None, filemode=None, level=None):
+    """Return a logger configured with the level-colored formatter
+    (parity: log.py get_logger). Repeated calls reuse the handler and
+    keep the existing level unless a new one is passed explicitly. The
+    root logger (name=None) is returned untouched — the framework never
+    hijacks the host application's logging config (same guard as the
+    reference)."""
+    logger = logging.getLogger(name)
+    if name is None:
+        if level is not None:
+            logger.setLevel(level)
+        return logger
+    if getattr(logger, "_mxnet_tpu_configured", False):
+        if level is not None:
+            logger.setLevel(level)
+        return logger
+    if filename:
+        handler = logging.FileHandler(filename, filemode or "a")
+        handler.setFormatter(_Formatter(colored=False))
+    else:
+        handler = logging.StreamHandler()
+        handler.setFormatter(_Formatter())
+    logger.addHandler(handler)
+    logger.setLevel(WARNING if level is None else level)
+    logger._mxnet_tpu_configured = True
+    return logger

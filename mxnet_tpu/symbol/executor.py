@@ -348,8 +348,14 @@ class Executor:
             new_grads = {}
             for name, arr in self.grad_dict.items():
                 shape = new_args[name].shape
-                new_grads[name] = nd.zeros(shape, ctx=self._ctx,
-                                           dtype=arr.dtype)
+                if tuple(arr.shape) == tuple(shape):
+                    # unchanged shape: SHARE the grad array so grad_req
+                    # 'add' accumulation survives a reshape (reference
+                    # reshape shares untouched buffers)
+                    new_grads[name] = arr
+                else:
+                    new_grads[name] = nd.zeros(shape, ctx=self._ctx,
+                                               dtype=arr.dtype)
         new_aux = {}
         for name, shape in zip(self._aux_names, aux_shapes):
             old = self.aux_dict.get(name)

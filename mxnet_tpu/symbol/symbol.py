@@ -396,8 +396,28 @@ class Symbol:
             raise MXNetError("incomplete shape information for arguments")
         return arg_shapes, out_shapes, aux_shapes
 
+    # ops whose inputs legitimately differ in dtype from the output, so
+    # unknown inputs must NOT be back-filled from the output dtype
+    # (index/condition inputs; Cast decides its own output)
+    _TYPE_HETERO_OPS = frozenset((
+        "Cast", "cast", "amp_cast", "amp_multicast", "Embedding",
+        "embedding", "take", "batch_take", "gather_nd", "scatter_nd",
+        "one_hot", "pick", "where", "SequenceMask", "SequenceLast",
+        "SequenceReverse", "arange_like", "_contrib_boolean_mask",
+        "argmax", "argmin", "topk", "argsort",
+    ))
+    # for hetero ops: which input's dtype the output follows
+    _TYPE_DRIVING_INPUT = {"Embedding": 1, "embedding": 1, "where": 1}
+
     def infer_type(self, *args, **kwargs):
-        """Returns (arg_types, out_types, aux_types)."""
+        """Infer dtypes of arguments/outputs/aux from the known ones
+        (parity: symbol.py infer_type / the reference InferType pass,
+        infer_graph_attr_pass.cc:94 — forward + backward fixpoint).
+
+        Returns (arg_types, out_types, aux_types) as numpy dtypes; an
+        entry is None when genuinely unresolvable. With no information
+        at all, everything defaults to float32 (the reference's
+        variable default)."""
         arg_names = self.list_arguments()
         known = {}
         if args:
@@ -406,9 +426,86 @@ class Symbol:
                     known[name] = np_dtype(t)
         known.update({k: np_dtype(v) for k, v in kwargs.items()
                       if v is not None})
-        arg_types = [known.get(a, np.dtype(np.float32)) for a in arg_names]
-        out_types = [np.dtype(np.float32)] * len(self._outputs)
-        aux_types = [np.dtype(np.float32)] * len(self.list_auxiliary_states())
+
+        topo = self._topo()
+        dt = {}  # (node, out_idx) -> np.dtype | None
+        for n in topo:
+            if n.is_variable():
+                d = known.get(n.name)
+                if d is None and n.attrs.get("__dtype__"):
+                    d = np_dtype(n.attrs["__dtype__"])
+                if d is None and not known:
+                    # reference default: with zero hints anywhere,
+                    # variables resolve to float32 up front so the
+                    # whole graph infers complete
+                    d = np.dtype(np.float32)
+                dt[(n, 0)] = d
+
+        # which output slots of each node are actually consumed
+        needed = {}
+        for n in topo:
+            for (src, i) in n.inputs:
+                needed.setdefault(id(src), set()).add(i)
+        for (n, i) in self._outputs:
+            needed.setdefault(id(n), set()).add(i)
+
+        for _ in range(len(topo)):  # fixpoint: fwd + bwd sweeps
+            changed = False
+            for n in topo:
+                if n.is_variable():
+                    continue
+                out_keys = [(n, i) for i in needed.get(id(n), {0}) | {0}]
+                a = n.attrs
+                if n.op in ("Cast", "cast", "amp_cast", "argmax",
+                            "argmin", "argsort"):
+                    out_d = np_dtype(a.get("dtype", "float32"))
+                    for k in out_keys:
+                        if dt.get(k) != out_d:
+                            dt[k] = out_d
+                            changed = True
+                    continue
+                if n.op in self._TYPE_HETERO_OPS:
+                    # output follows one driving input (data/weight/
+                    # branch); index & condition inputs are independent,
+                    # no backfill. one_hot has no driving input at all —
+                    # its dtype attr decides.
+                    if n.op == "one_hot":
+                        out_d = np_dtype(a.get("dtype", "float32"))
+                    else:
+                        drive = self._TYPE_DRIVING_INPUT.get(n.op, 0)
+                        out_d = (dt.get(n.inputs[drive])
+                                 if drive < len(n.inputs) else None)
+                    if out_d is not None:
+                        for k in out_keys:
+                            if dt.get(k) is None:
+                                dt[k] = out_d
+                                changed = True
+                    continue
+                # homogeneous op: inputs and outputs form one dtype
+                # equivalence class (the reference FInferType idiom) —
+                # any known member types every unknown one
+                cls = list(n.inputs) + out_keys
+                kn = [dt.get(k) for k in cls if dt.get(k) is not None]
+                if not kn:
+                    continue
+                d = np.dtype(np.result_type(*kn))
+                for k in cls:
+                    if dt.get(k) is None:
+                        dt[k] = d
+                        changed = True
+            if not changed:
+                break
+
+        def var_dtype(name):
+            for n in topo:
+                if n.is_variable() and n.name == name:
+                    return dt.get((n, 0))
+            return None
+
+        aux_names = self.list_auxiliary_states()
+        arg_types = [var_dtype(a) for a in arg_names]
+        aux_types = [var_dtype(a) for a in aux_names]
+        out_types = [dt.get(o) for o in self._outputs]
         return arg_types, out_types, aux_types
 
     # -- serde (MXNet JSON format) ------------------------------------------

@@ -1,0 +1,87 @@
+"""General utilities (parity: python/mxnet/util.py — makedirs, device
+discovery helpers, and the numpy-shape-semantics scope).
+
+The np_shape machinery deserves a note: the reference uses it to gate
+zero-size/zero-dim tensor support in its C++ shape inference (legacy
+MXNet treated 0 as "unknown"). This framework sits on jax, where
+`()`-shaped and 0-size arrays are first-class — so numpy semantics are
+always available; the scope still exists (thread-local flag, context
+manager, decorator) so reference code that toggles it runs unchanged,
+and `is_np_shape()` faithfully reports what the caller set.
+"""
+from __future__ import annotations
+
+import functools
+import os
+import threading
+
+_state = threading.local()
+
+
+def makedirs(d):
+    """mkdir -p (parity: util.py makedirs)."""
+    os.makedirs(os.path.expanduser(d), exist_ok=True)
+
+
+def get_gpu_count():
+    """Accelerator count (parity: util.py get_gpu_count)."""
+    from .context import num_tpus, num_gpus
+    return num_tpus() or num_gpus()
+
+
+def get_gpu_memory(gpu_dev_id=0):
+    """(free, total) bytes for a device (parity: util.py
+    get_gpu_memory)."""
+    from .context import device_memory_info, tpu
+    info = device_memory_info(tpu(gpu_dev_id))
+    total = int(info.get("bytes_limit", 0))
+    used = int(info.get("bytes_in_use", 0))
+    return total - used, total
+
+
+def set_np_shape(active):
+    """Toggle numpy shape semantics (parity: util.py set_np_shape).
+    Returns the previous state."""
+    prev = is_np_shape()
+    _state.np_shape = bool(active)
+    return prev
+
+
+def is_np_shape():
+    return getattr(_state, "np_shape", False)
+
+
+class _NumpyShapeScope:
+    def __init__(self, is_np_shape_):
+        self._active = is_np_shape_
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = set_np_shape(self._active)
+        return self
+
+    def __exit__(self, *exc):
+        set_np_shape(self._prev)
+
+
+def np_shape(active=True):
+    """Context manager enabling numpy shape semantics (parity:
+    util.py np_shape)."""
+    return _NumpyShapeScope(active)
+
+
+def use_np_shape(func):
+    """Decorator running ``func`` under np_shape(True) (parity:
+    util.py use_np_shape; works on functions and classes' methods)."""
+    if isinstance(func, type):
+        for name, attr in list(vars(func).items()):
+            if callable(attr) and not name.startswith("__"):
+                setattr(func, name, use_np_shape(attr))
+        return func
+
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        with np_shape(True):
+            return func(*args, **kwargs)
+
+    return wrapper

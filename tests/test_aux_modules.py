@@ -176,3 +176,54 @@ class TestPythonModule:
         np.testing.assert_allclose(m.get_outputs()[0].asnumpy(), 1.0)
         with _pytest.raises(NotImplementedError):
             m.backward()
+
+
+class TestUtilAndLog:
+    """mx.util + mx.log (parity: python/mxnet/util.py, log.py)."""
+
+    def test_np_shape_scope(self):
+        import threading
+        import mxnet_tpu as mx
+        assert mx.util.is_np_shape() is False
+        with mx.util.np_shape(True):
+            assert mx.util.is_np_shape() is True
+            # thread-local: another thread sees the default
+            seen = []
+            t = threading.Thread(
+                target=lambda: seen.append(mx.util.is_np_shape()))
+            t.start(); t.join()
+            assert seen == [False]
+        assert mx.util.is_np_shape() is False
+
+        @mx.util.use_np_shape
+        def f():
+            return mx.util.is_np_shape()
+
+        assert f() is True and mx.util.is_np_shape() is False
+        # zero-size arrays work regardless (jax-native; the scope is
+        # compatibility surface, not a gate)
+        assert mx.nd.zeros((0, 4)).shape == (0, 4)
+
+    def test_makedirs_and_gpu_count(self, tmp_path):
+        import mxnet_tpu as mx
+        d = tmp_path / "a" / "b"
+        mx.util.makedirs(str(d))
+        mx.util.makedirs(str(d))  # idempotent
+        assert d.is_dir()
+        assert mx.util.get_gpu_count() >= 0
+
+    def test_get_logger(self, tmp_path):
+        import logging
+        import mxnet_tpu as mx
+        f = str(tmp_path / "x.log")
+        lg = mx.log.get_logger("mxtpu_test", filename=f,
+                               level=mx.log.INFO)
+        lg.info("hello %d", 42)
+        lg2 = mx.log.get_logger("mxtpu_test")  # reuses handler
+        assert lg2 is lg and len(lg.handlers) == 1
+        for h in lg.handlers:
+            h.flush()
+        text = open(f).read()
+        assert "hello 42" in text and "I" in text
+        with pytest.warns(DeprecationWarning):
+            mx.log.getLogger("mxtpu_test2", level=logging.ERROR)

@@ -51,3 +51,9 @@ def test_model_parallel_lstm():
 def test_dcgan():
     out = _run("dcgan.py", "--iters", "100")
     assert "DCGAN trained OK" in out
+
+
+@needs_full
+def test_autoencoder():
+    out = _run("autoencoder.py", "--epochs", "15")
+    assert "autoencoder trained OK" in out

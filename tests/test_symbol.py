@@ -219,3 +219,32 @@ def test_inference_only_bind_auto_label():
     mod.forward(DataBatch(data=[mx.nd.array(np.zeros((2, 3), np.float32))],
                           label=None), is_train=False)
     assert mod.get_outputs()[0].shape == (2, 10)
+
+
+def test_infer_type_propagation():
+    """Bidirectional dtype inference (reference InferType pass,
+    infer_graph_attr_pass.cc): a known data dtype propagates forward to
+    outputs AND backward into parameter variables."""
+    d = sym.var("data")
+    fc = sym.FullyConnected(d, num_hidden=4, name="fc")
+    # no hints at all: everything defaults to float32, complete
+    args_t, out_t, _ = fc.infer_type()
+    assert all(t == np.float32 for t in args_t)
+    assert out_t == [np.dtype(np.float32)]
+    # float64 data: weight/bias/output follow
+    args_t, out_t, _ = fc.infer_type(data=np.float64)
+    by_name = dict(zip(fc.list_arguments(), args_t))
+    assert by_name["fc_weight"] == np.float64
+    assert by_name["fc_bias"] == np.float64
+    assert out_t == [np.dtype(np.float64)]
+    # Cast decides its own dtype regardless of input
+    c = sym.Cast(d, dtype="float16")
+    _, out_t, _ = c.infer_type(data=np.float32)
+    assert out_t == [np.dtype(np.float16)]
+    # Embedding: int32 indices do not pollute the embedding dtype
+    e = sym.Embedding(d, input_dim=10, output_dim=4, name="emb")
+    args_t, out_t, _ = e.infer_type(emb_weight=np.float32,
+                                    data=np.int32)
+    by_name = dict(zip(e.list_arguments(), args_t))
+    assert by_name["data"] == np.int32
+    assert out_t == [np.dtype(np.float32)]
