@@ -18,3 +18,4 @@ from .ring import ring_attention_local, ring_self_attention
 from .multihost import init_multihost, is_coordinator
 from .pipeline import (gpipe_fn, pipeline_apply, stack_stage_params,
                        pipeline_efficiency)
+from .moe import init_moe_params, moe_ffn, moe_ffn_ep

@@ -1,0 +1,119 @@
+"""Mixture-of-Experts + expert parallelism (parallel/moe.py).
+
+The ep strategy completes the dp/fsdp/tp/sp/pp/ep set (SURVEY §2.4:
+greenfield — the reference has none). Equivalence oracle: with capacity
+admitting every token, MoE output per token is gate * expert_ffn(x), so
+the dense single-device version, a hand looped-per-expert evaluation,
+and the sharded all_to_all version must all agree.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu.parallel import DeviceMesh
+from mxnet_tpu.parallel.moe import init_moe_params, moe_ffn, moe_ffn_ep
+
+N, D, H, E = 32, 8, 16, 4
+
+
+@pytest.fixture(scope="module")
+def setup():
+    params = init_moe_params(jax.random.PRNGKey(0), D, H, E)
+    x = jax.random.normal(jax.random.PRNGKey(1), (N, D))
+    return params, x
+
+
+def _reference_loop(params, x):
+    """Slow per-token oracle: y_n = gate_n * FFN_{expert(n)}(x_n)."""
+    logits = np.asarray(x) @ np.asarray(params["wg"])
+    e_x = np.exp(logits - logits.max(axis=1, keepdims=True))
+    gates = e_x / e_x.sum(axis=1, keepdims=True)
+    expert = gates.argmax(axis=1)
+    y = np.zeros_like(np.asarray(x))
+    for n in range(x.shape[0]):
+        e = int(expert[n])
+        h = np.maximum(
+            np.asarray(x)[n] @ np.asarray(params["w1"])[e]
+            + np.asarray(params["b1"])[e], 0.0)
+        y[n] = (h @ np.asarray(params["w2"])[e]
+                + np.asarray(params["b2"])[e]) * gates[n, e]
+    return y
+
+
+def test_dense_moe_matches_per_token_oracle(setup):
+    params, x = setup
+    y, aux = moe_ffn(params, x, capacity_factor=float(E))  # no drops
+    np.testing.assert_allclose(np.asarray(y), _reference_loop(params, x),
+                               rtol=1e-5, atol=1e-6)
+    assert float(aux) > 0.0  # load-balance loss is positive
+
+
+def test_capacity_drops_tokens(setup):
+    params, x = setup
+    # capacity 1 slot per expert: most tokens dropped -> zero rows
+    y, _ = moe_ffn(params, x, capacity_factor=E / N)
+    zero_rows = (np.abs(np.asarray(y)).sum(axis=1) < 1e-9).sum()
+    assert zero_rows >= N - 2 * E, zero_rows
+    # generous capacity: no zero rows (every token routed)
+    y2, _ = moe_ffn(params, x, capacity_factor=float(E))
+    assert (np.abs(np.asarray(y2)).sum(axis=1) < 1e-9).sum() == 0
+
+
+@pytest.mark.parametrize("ep", [2, 4])
+def test_expert_parallel_matches_dense(setup, ep):
+    params, x = setup
+    mesh = DeviceMesh({"ep": ep})
+    y_ep, aux_ep = jax.jit(
+        lambda p, xx: moe_ffn_ep(p, xx, mesh, capacity_factor=float(E))
+    )(params, x)
+    # per-token equivalence (capacity admits everything on every shard)
+    np.testing.assert_allclose(np.asarray(y_ep),
+                               _reference_loop(params, x),
+                               rtol=1e-5, atol=1e-6)
+    assert np.isfinite(float(aux_ep))
+
+
+def test_expert_parallel_gradients_flow(setup):
+    params, x = setup
+    mesh = DeviceMesh({"ep": 4})
+
+    # compare the MAIN loss path only: the aux load-balance term is
+    # deliberately per-device in EP (frac*mean_gate is nonlinear in the
+    # token set, so per-shard aux != global aux — the standard choice)
+    def loss_ep(p):
+        y, _aux = moe_ffn_ep(p, x, mesh, capacity_factor=float(E))
+        return (y ** 2).mean()
+
+    def loss_dense(p):
+        y, _aux = moe_ffn(p, x, capacity_factor=float(E))
+        return (y ** 2).mean()
+
+    g_ep = jax.jit(jax.grad(loss_ep))(params)
+    g_dense = jax.grad(loss_dense)(params)
+    for k in params:
+        np.testing.assert_allclose(
+            np.asarray(g_ep[k]), np.asarray(g_dense[k]),
+            rtol=2e-4, atol=1e-6, err_msg=f"grad mismatch for {k}")
+    # experts actually receive gradient
+    assert float(jnp.abs(g_ep["w1"]).sum()) > 0
+
+
+def test_moe_trains(setup):
+    """A few SGD steps on the dense MoE reduce a regression loss."""
+    params, x = setup
+    target = jax.random.normal(jax.random.PRNGKey(2), (N, D))
+
+    def loss_fn(p):
+        y, aux = moe_ffn(p, x, capacity_factor=float(E))
+        return ((y - target) ** 2).mean() + 0.01 * aux
+
+    vg = jax.jit(jax.value_and_grad(loss_fn))
+    p = {k: v for k, v in params.items()}
+    first = None
+    for _ in range(80):
+        l, g = vg(p)
+        first = first if first is not None else float(l)
+        p = jax.tree_util.tree_map(lambda a, b: a - 0.3 * b, p, g)
+    assert float(l) < first * 0.8, (first, float(l))
