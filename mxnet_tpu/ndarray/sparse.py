@@ -473,3 +473,71 @@ def elemwise_add(a, b):
     da = a.tostype("default") if isinstance(a, BaseSparseNDArray) else a
     db = b.tostype("default") if isinstance(b, BaseSparseNDArray) else b
     return da + db
+
+
+def elemwise_sub(a, b):
+    """a - b with row_sparse structure preserved (parity: reference
+    elemwise_sub(rsp, rsp) -> rsp)."""
+    if isinstance(a, RowSparseNDArray) and isinstance(b, RowSparseNDArray):
+        idx = jnp.union1d(a._indices, b._indices)
+        da = jnp.zeros((idx.shape[0],) + a._data.shape[1:], a._data.dtype)
+        pa = jnp.searchsorted(idx, a._indices)
+        pb = jnp.searchsorted(idx, b._indices)
+        da = da.at[pa].add(a._data).at[pb].add(-b._data)
+        return RowSparseNDArray(da, idx, a.shape, a._ctx)
+    da = a.tostype("default") if isinstance(a, BaseSparseNDArray) else a
+    db = b.tostype("default") if isinstance(b, BaseSparseNDArray) else b
+    return da - db
+
+
+def elemwise_mul(a, b):
+    """a * b keeping the SPARSE side's structure (parity: reference
+    elemwise_mul(rsp, dense) -> rsp, (csr, dense) -> csr,
+    (rsp, rsp) -> rsp over the row intersection)."""
+    if isinstance(a, RowSparseNDArray) and isinstance(b, RowSparseNDArray):
+        # intersection structure: rows of a scaled by b's matching rows
+        # (zero where b has no row), then vice versa is symmetric
+        bd = b.todense()._data
+        vals = a._data * bd[a._indices.astype(jnp.int32)]
+        return RowSparseNDArray(vals, a._indices, a.shape, a._ctx)
+    if isinstance(a, RowSparseNDArray) and isinstance(b, NDArray):
+        vals = a._data * b._data[a._indices.astype(jnp.int32)]
+        return RowSparseNDArray(vals, a._indices, a.shape, a._ctx)
+    if isinstance(b, RowSparseNDArray) and isinstance(a, NDArray):
+        return elemwise_mul(b, a)
+    if isinstance(a, CSRNDArray) and isinstance(b, NDArray) \
+            and not isinstance(b, BaseSparseNDArray):
+        rows = a._row_ids()
+        vals = a._data * b._data[rows, a._indices.astype(jnp.int32)]
+        return CSRNDArray(vals, a._indices, a._indptr, a.shape, a._ctx)
+    if isinstance(b, CSRNDArray) and not isinstance(a, BaseSparseNDArray):
+        return elemwise_mul(b, a)
+    da = a.tostype("default") if isinstance(a, BaseSparseNDArray) else a
+    db = b.tostype("default") if isinstance(b, BaseSparseNDArray) else b
+    return da * db
+
+
+def multiply_scalar(arr, scalar):
+    """arr * scalar preserving sparse structure (parity: the reference's
+    _mul_scalar FComputeEx on rsp/csr)."""
+    if isinstance(arr, RowSparseNDArray):
+        return RowSparseNDArray(arr._data * scalar, arr._indices,
+                                arr.shape, arr._ctx)
+    if isinstance(arr, CSRNDArray):
+        return CSRNDArray(arr._data * scalar, arr._indices, arr._indptr,
+                          arr.shape, arr._ctx)
+    return arr * scalar
+
+
+def divide_scalar(arr, scalar):
+    return multiply_scalar(arr, 1.0 / scalar)
+
+
+def norm(arr, ord=2):
+    """Frobenius norm over stored values only — zeros contribute nothing,
+    so this equals the dense norm (parity: reference norm on rsp/csr
+    FComputeEx)."""
+    if ord != 2:
+        raise MXNetError("sparse norm supports ord=2 only")
+    # _data holds exactly the stored values for every storage type
+    return NDArray(jnp.sqrt(jnp.sum(jnp.square(arr._data))), arr._ctx)

@@ -240,3 +240,54 @@ def test_criteo_shaped_linear_model_converges():
     acc = (pred == y_np).mean()
     assert losses[-1] < losses[0] * 0.4, (losses[0], losses[-1])
     assert acc > 0.9, acc
+
+
+def test_sparse_elemwise_mul_and_sub():
+    """elemwise_mul keeps the sparse structure; elemwise_sub unions rows
+    (reference sparse FComputeEx semantics)."""
+    dense_a = np.zeros((6, 3), np.float32)
+    dense_a[[1, 4]] = np.random.RandomState(0).randn(2, 3)
+    dense_b = np.random.RandomState(1).randn(6, 3).astype(np.float32)
+    a = sp.array(dense_a, stype="row_sparse")
+    b = nd.array(dense_b)
+    out = sp.elemwise_mul(a, b)
+    assert out.stype == "row_sparse"
+    assert out.indices.asnumpy().tolist() == [1, 4]
+    np.testing.assert_allclose(out.asnumpy(), dense_a * dense_b, rtol=1e-6)
+    # rsp * rsp: structure of the left operand, zero where right is empty
+    dense_c = np.zeros((6, 3), np.float32)
+    dense_c[[4, 5]] = 2.0
+    c = sp.array(dense_c, stype="row_sparse")
+    out2 = sp.elemwise_mul(a, c)
+    np.testing.assert_allclose(out2.asnumpy(), dense_a * dense_c, rtol=1e-6)
+    # subtraction with union structure
+    out3 = sp.elemwise_sub(a, c)
+    assert out3.stype == "row_sparse"
+    np.testing.assert_allclose(out3.asnumpy(), dense_a - dense_c, rtol=1e-6)
+    assert sorted(out3.indices.asnumpy().tolist()) == [1, 4, 5]
+
+
+def test_sparse_csr_elemwise_mul_and_scalar():
+    dense = np.zeros((4, 5), np.float32)
+    dense[0, 1] = 2.0
+    dense[2, 3] = -1.5
+    dense[3, 0] = 4.0
+    csr = sp.array(dense, stype="csr")
+    other = np.random.RandomState(2).randn(4, 5).astype(np.float32)
+    out = sp.elemwise_mul(csr, nd.array(other))
+    assert out.stype == "csr"
+    np.testing.assert_allclose(out.asnumpy(), dense * other, rtol=1e-6)
+    # scalar ops keep structure and nnz
+    half = sp.divide_scalar(sp.multiply_scalar(csr, 3.0), 2.0)
+    assert half.stype == "csr"
+    np.testing.assert_allclose(half.asnumpy(), dense * 1.5, rtol=1e-6)
+    assert half.indices.asnumpy().shape == csr.indices.asnumpy().shape
+
+
+def test_sparse_norm_matches_dense():
+    dense = np.zeros((8, 4), np.float32)
+    dense[[2, 5]] = np.random.RandomState(3).randn(2, 4)
+    for stype in ("row_sparse", "csr"):
+        arr = sp.array(dense, stype=stype)
+        got = float(sp.norm(arr).asscalar())
+        np.testing.assert_allclose(got, np.linalg.norm(dense), rtol=1e-6)
