@@ -134,3 +134,58 @@ class SyncBatchNorm(BatchNorm):
         return F.contrib.SyncBatchNorm(x, gamma, beta, running_mean,
                                        running_var, name="fwd",
                                        **self._kwargs)
+
+
+class MoEDense(HybridBlock):
+    """Mixture-of-Experts FFN layer (greenfield TPU capability — the
+    reference has no MoE; numerics and the expert-parallel deployment
+    live in mxnet_tpu/parallel/moe.py; this block is the gluon face
+    over the ``_contrib_MoEFFN`` op).
+
+    forward(x) -> (y, aux_loss): y has x's shape; add a small multiple
+    of aux_loss (Switch-style load balancing) to the training loss.
+    For multi-chip expert parallelism use parallel.moe.moe_ffn_ep with
+    this block's collected parameters.
+    """
+
+    def __init__(self, num_experts, hidden_units, in_units=0,
+                 capacity_factor=2.0, dtype="float32",
+                 weight_initializer=None, **kwargs):
+        super().__init__(**kwargs)
+        self._E = int(num_experts)
+        self._H = int(hidden_units)
+        self._cf = float(capacity_factor)
+        with self.name_scope():
+            self.gate_weight = self.params.get(
+                "gate_weight", shape=(in_units, self._E), dtype=dtype,
+                init=weight_initializer, allow_deferred_init=True)
+            self.w1 = self.params.get(
+                "expert_w1", shape=(self._E, in_units, self._H),
+                dtype=dtype, init=weight_initializer,
+                allow_deferred_init=True)
+            self.b1 = self.params.get("expert_b1",
+                                      shape=(self._E, self._H),
+                                      dtype=dtype, init="zeros")
+            self.w2 = self.params.get(
+                "expert_w2", shape=(self._E, self._H, in_units),
+                dtype=dtype, init=weight_initializer,
+                allow_deferred_init=True)
+            self.b2 = self.params.get("expert_b2",
+                                      shape=(self._E, in_units),
+                                      dtype=dtype, init="zeros",
+                                      allow_deferred_init=True)
+
+    def _shape_hint(self, x):
+        d = int(x.shape[-1])
+        self.gate_weight.shape = (d, self._E)
+        self.w1.shape = (self._E, d, self._H)
+        self.w2.shape = (self._E, self._H, d)
+        self.b2.shape = (self._E, d)
+
+    def hybrid_forward(self, F, x, gate_weight, w1, b1, w2, b2):
+        return F._contrib_MoEFFN(x, gate_weight, w1, b1, w2, b2,
+                                 capacity_factor=self._cf)
+
+    def __repr__(self):
+        return (f"MoEDense(experts={self._E}, hidden={self._H}, "
+                f"capacity_factor={self._cf})")

@@ -463,28 +463,29 @@ def adam_lazy_update(weight, grad_rs, mean, var, lr, wd, beta1=0.9,
 
 def elemwise_add(a, b):
     if isinstance(a, RowSparseNDArray) and isinstance(b, RowSparseNDArray):
-        idx = jnp.union1d(a._indices, b._indices)
-        da = jnp.zeros((idx.shape[0],) + a._data.shape[1:], a._data.dtype)
-        pa = jnp.searchsorted(idx, a._indices)
-        pb = jnp.searchsorted(idx, b._indices)
-        da = da.at[pa].add(a._data).at[pb].add(b._data)
-        return RowSparseNDArray(da, idx, a.shape, a._ctx)
+        return _rsp_union_merge(a, b, 1.0)
     # mixed sparse/dense: densify the sparse side (full-shape result)
     da = a.tostype("default") if isinstance(a, BaseSparseNDArray) else a
     db = b.tostype("default") if isinstance(b, BaseSparseNDArray) else b
     return da + db
 
 
+def _rsp_union_merge(a, b, sign):
+    """Union-row merge of two RowSparse arrays: a + sign*b (the shared
+    primitive behind elemwise_add/elemwise_sub)."""
+    idx = jnp.union1d(a._indices, b._indices)
+    da = jnp.zeros((idx.shape[0],) + a._data.shape[1:], a._data.dtype)
+    pa = jnp.searchsorted(idx, a._indices)
+    pb = jnp.searchsorted(idx, b._indices)
+    da = da.at[pa].add(a._data).at[pb].add(sign * b._data)
+    return RowSparseNDArray(da, idx, a.shape, a._ctx)
+
+
 def elemwise_sub(a, b):
     """a - b with row_sparse structure preserved (parity: reference
     elemwise_sub(rsp, rsp) -> rsp)."""
     if isinstance(a, RowSparseNDArray) and isinstance(b, RowSparseNDArray):
-        idx = jnp.union1d(a._indices, b._indices)
-        da = jnp.zeros((idx.shape[0],) + a._data.shape[1:], a._data.dtype)
-        pa = jnp.searchsorted(idx, a._indices)
-        pb = jnp.searchsorted(idx, b._indices)
-        da = da.at[pa].add(a._data).at[pb].add(-b._data)
-        return RowSparseNDArray(da, idx, a.shape, a._ctx)
+        return _rsp_union_merge(a, b, -1.0)
     da = a.tostype("default") if isinstance(a, BaseSparseNDArray) else a
     db = b.tostype("default") if isinstance(b, BaseSparseNDArray) else b
     return da - db
@@ -495,15 +496,24 @@ def elemwise_mul(a, b):
     elemwise_mul(rsp, dense) -> rsp, (csr, dense) -> csr,
     (rsp, rsp) -> rsp over the row intersection)."""
     if isinstance(a, RowSparseNDArray) and isinstance(b, RowSparseNDArray):
-        # intersection structure: rows of a scaled by b's matching rows
-        # (zero where b has no row), then vice versa is symmetric
-        bd = b.todense()._data
-        vals = a._data * bd[a._indices.astype(jnp.int32)]
-        return RowSparseNDArray(vals, a._indices, a.shape, a._ctx)
-    if isinstance(a, RowSparseNDArray) and isinstance(b, NDArray):
+        # O(nnz) row intersection: for each of a's rows, gather b's
+        # matching row (zero when absent) — never densify
+        pos = jnp.searchsorted(b._indices, a._indices)
+        pos_c = jnp.clip(pos, 0, max(b._indices.shape[0] - 1, 0))
+        present = (pos < b._indices.shape[0]) & \
+            (b._indices[pos_c] == a._indices)
+        b_rows = jnp.where(present[(...,) + (None,) * (b._data.ndim - 1)],
+                           b._data[pos_c], 0)
+        return RowSparseNDArray(a._data * b_rows, a._indices, a.shape,
+                                a._ctx)
+    if isinstance(a, RowSparseNDArray) \
+            and not isinstance(b, BaseSparseNDArray) \
+            and isinstance(b, NDArray):
         vals = a._data * b._data[a._indices.astype(jnp.int32)]
         return RowSparseNDArray(vals, a._indices, a.shape, a._ctx)
-    if isinstance(b, RowSparseNDArray) and isinstance(a, NDArray):
+    if isinstance(b, RowSparseNDArray) \
+            and not isinstance(a, BaseSparseNDArray) \
+            and isinstance(a, NDArray):
         return elemwise_mul(b, a)
     if isinstance(a, CSRNDArray) and isinstance(b, NDArray) \
             and not isinstance(b, BaseSparseNDArray):
@@ -512,6 +522,7 @@ def elemwise_mul(a, b):
         return CSRNDArray(vals, a._indices, a._indptr, a.shape, a._ctx)
     if isinstance(b, CSRNDArray) and not isinstance(a, BaseSparseNDArray):
         return elemwise_mul(b, a)
+    # anything else (incl. mixed rsp/csr): densify both
     da = a.tostype("default") if isinstance(a, BaseSparseNDArray) else a
     db = b.tostype("default") if isinstance(b, BaseSparseNDArray) else b
     return da * db

@@ -1245,3 +1245,19 @@ def _identity_attach_kl_sparse_reg(attrs, data, moving_avg):
     flat = data.reshape(data.shape[0], -1)
     new_avg = momentum * moving_avg + (1 - momentum) * flat.mean(axis=0)
     return data, new_avg
+
+
+@register("_contrib_MoEFFN", num_outputs=2,
+          alias=("_contrib_moe_ffn",))
+def _contrib_moe_ffn(attrs, x, gate_weight, w1, b1, w2, b2):
+    """Mixture-of-Experts FFN (greenfield — no reference analog; see
+    parallel/moe.py for the sharded version). Inputs: tokens (n, d) or
+    (batch, seq, d); outputs (same-shape y, scalar load-balance aux).
+    Attr capacity_factor bounds per-expert slots (static shapes)."""
+    from ..parallel.moe import moe_ffn
+    cf = float(attrs.get("capacity_factor", 2.0))
+    shape = x.shape
+    tokens = x.reshape(-1, shape[-1])
+    params = {"wg": gate_weight, "w1": w1, "b1": b1, "w2": w2, "b2": b2}
+    y, aux = moe_ffn(params, tokens, capacity_factor=cf)
+    return y.reshape(shape), aux

@@ -117,3 +117,56 @@ def test_moe_trains(setup):
         first = first if first is not None else float(l)
         p = jax.tree_util.tree_map(lambda a, b: a - 0.3 * b, p, g)
     assert float(l) < first * 0.8, (first, float(l))
+
+
+def test_gluon_moe_dense_block():
+    """The gluon-facing MoEDense block (op _contrib_MoEFFN) trains with
+    autograd + Trainer and matches the functional dense MoE."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+    from mxnet_tpu.gluon.contrib.nn import MoEDense
+
+    layer = MoEDense(num_experts=4, hidden_units=16, capacity_factor=4.0)
+    layer.initialize(mx.initializer.Xavier())
+    x = nd.array(np.random.RandomState(0).randn(16, 8).astype(np.float32))
+    y, aux = layer(x)
+    assert y.shape == (16, 8)
+    assert np.isfinite(float(aux.asscalar()))
+    # equivalence with the functional path on the same params
+    p = {"wg": layer.gate_weight.data()._data,
+         "w1": layer.w1.data()._data, "b1": layer.b1.data()._data,
+         "w2": layer.w2.data()._data, "b2": layer.b2.data()._data}
+    y_ref, _ = moe_ffn(p, x._data, capacity_factor=4.0)
+    np.testing.assert_allclose(y.asnumpy(), np.asarray(y_ref),
+                               rtol=1e-5, atol=1e-6)
+    # a few training steps reduce a regression loss through the router
+    target = nd.array(np.random.RandomState(1).randn(16, 8)
+                      .astype(np.float32))
+    trainer = gluon.Trainer(layer.collect_params(), "adam",
+                            {"learning_rate": 0.01})
+    losses = []
+    for _ in range(25):
+        with autograd.record():
+            out, aux = layer(x)
+            loss = ((out - target) ** 2).mean() + 0.01 * aux
+        loss.backward()
+        trainer.step(1)
+        losses.append(float(loss.asscalar()))
+    assert losses[-1] < losses[0] * 0.9, (losses[0], losses[-1])
+    # 3-D (batch, seq, d) input keeps its shape
+    x3 = nd.array(np.random.RandomState(2).randn(2, 8, 8)
+                  .astype(np.float32))
+    y3, _ = layer(x3)
+    assert y3.shape == (2, 8, 8)
+
+
+def test_gluon_moe_dense_with_in_units_initializes_fully():
+    """With in_units given, every parameter (incl. w2/b2) materializes
+    at initialize() — no deferred-init asymmetry (regression)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.contrib.nn import MoEDense
+    layer = MoEDense(num_experts=2, hidden_units=4, in_units=6)
+    layer.initialize(mx.initializer.Xavier())
+    assert layer.w2.data().shape == (2, 4, 6)
+    assert layer.b2.data().shape == (2, 6)
+    assert layer.gate_weight.data().shape == (6, 2)

@@ -665,6 +665,8 @@ EXEMPT = {
     "cast_storage": "test_op_gap_r4.py",
     "sparse_retain": "test_op_gap_r4.py",
     "_square_sum": "test_op_gap_r4.py",
+    # greenfield MoE FFN: per-token oracle + expert-parallel equivalence
+    "_contrib_MoEFFN": "test_moe.py",
     # round-4 named-op gap closers (each has a dedicated oracle test there)
     "_contrib_SparseEmbedding": "test_op_gap_r4.py",
     "_contrib_edge_id": "test_op_gap_r4.py",

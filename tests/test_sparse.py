@@ -291,3 +291,35 @@ def test_sparse_norm_matches_dense():
         arr = sp.array(dense, stype=stype)
         got = float(sp.norm(arr).asscalar())
         np.testing.assert_allclose(got, np.linalg.norm(dense), rtol=1e-6)
+
+
+def test_sparse_mixed_stype_mul_densifies():
+    """(rsp, csr) has no structure-preserving kernel — it must densify
+    correctly, never index the CSR value array by row (regression)."""
+    dense_a = np.zeros((6, 3), np.float32)
+    dense_a[[1, 4]] = 1.5
+    dense_b = np.zeros((6, 3), np.float32)
+    dense_b[4, 2] = 2.0
+    dense_b[1, 0] = -3.0
+    rsp = sp.array(dense_a, stype="row_sparse")
+    csr = sp.array(dense_b, stype="csr")
+    for x, y in ((rsp, csr), (csr, rsp)):
+        out = sp.elemwise_mul(x, y)
+        np.testing.assert_allclose(np.asarray(out.asnumpy()),
+                                   dense_a * dense_b, rtol=1e-6)
+
+
+def test_rsp_rsp_mul_intersection_without_densify():
+    """rsp*rsp uses an O(nnz) index intersection; rows absent on either
+    side come out zero."""
+    a_dense = np.zeros((8, 2), np.float32)
+    a_dense[[0, 3, 6]] = np.random.RandomState(0).randn(3, 2)
+    b_dense = np.zeros((8, 2), np.float32)
+    b_dense[[3, 5, 6]] = np.random.RandomState(1).randn(3, 2)
+    a = sp.array(a_dense, stype="row_sparse")
+    b = sp.array(b_dense, stype="row_sparse")
+    out = sp.elemwise_mul(a, b)
+    assert out.stype == "row_sparse"
+    assert out.indices.asnumpy().tolist() == [0, 3, 6]  # a's structure
+    np.testing.assert_allclose(out.asnumpy(), a_dense * b_dense,
+                               rtol=1e-6)
