@@ -8,6 +8,7 @@ Demonstrates the greenfield capabilities relative to the reference
   tp    Megatron-style tensor parallelism (shard_map, psum at row cuts)
   sp    ring attention            (sequence sharded, K/V ppermute ring)
   pp    GPipe pipeline            (layer stages, microbatch scan)
+  ep    expert parallelism        (MoE FFN, all_to_all token dispatch)
 
 Runs on a virtual CPU mesh out of the box:
 
@@ -16,6 +17,9 @@ Runs on a virtual CPU mesh out of the box:
 
   JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
       python examples/transformer_parallel.py --dp 2 --pp 4 --layers 4
+
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python examples/transformer_parallel.py --dp 1 --ep 4 --experts 8
 
 On a TPU pod the same flags lay the axes onto ICI.
 """
@@ -35,6 +39,8 @@ def main():
     ap.add_argument("--tp", type=int, default=1)
     ap.add_argument("--sp", type=int, default=1)
     ap.add_argument("--pp", type=int, default=1)
+    ap.add_argument("--ep", type=int, default=1)
+    ap.add_argument("--experts", type=int, default=8)
     ap.add_argument("--embed", type=int, default=64)
     ap.add_argument("--heads", type=int, default=4)
     ap.add_argument("--seq", type=int, default=64)
@@ -52,7 +58,7 @@ def main():
                                     pipeline_apply, stack_stage_params,
                                     ring_self_attention)
 
-    need = args.dp * args.tp * args.sp * args.pp
+    need = args.dp * args.tp * args.sp * args.pp * args.ep
     have = len(jax.devices())
     if need > have:
         sys.exit(f"mesh needs {need} devices, found {have} "
@@ -61,7 +67,27 @@ def main():
     key = jax.random.PRNGKey(0)
     x = jax.random.normal(key, (args.batch, args.seq, args.embed))
 
-    if args.pp > 1:
+    if args.ep > 1:
+        from mxnet_tpu.parallel.moe import (init_moe_params, moe_ffn,
+                                            moe_ffn_ep)
+        mesh = DeviceMesh({"ep": args.ep})
+        print(f"mesh: ep={args.ep} ({args.experts} experts, "
+              "all_to_all token dispatch)")
+        mp = init_moe_params(key, args.embed, args.embed * 4,
+                             args.experts)
+        tokens = x.reshape(-1, args.embed)
+        cf = float(args.experts)  # generous capacity: exact equivalence
+        y_ref, _ = moe_ffn(mp, tokens, capacity_factor=cf)
+        fn = jax.jit(lambda p, t: moe_ffn_ep(p, t, mesh,
+                                             capacity_factor=cf))
+        t0 = time.perf_counter()
+        y, aux = fn(mp, tokens)
+        y.block_until_ready()
+        dt = time.perf_counter() - t0
+        err = float(jnp.abs(y - y_ref).max())
+        print(f"expert-parallel MoE FFN: {dt * 1e3:.1f} ms, "
+              f"max err vs dense {err:.2e}, aux {float(aux):.3f}")
+    elif args.pp > 1:
         mesh = DeviceMesh({"dp": args.dp, "pp": args.pp})
         print(f"mesh: dp={args.dp} pp={args.pp} (GPipe, "
               f"{args.layers} layers over {args.pp} stages)")
