@@ -68,6 +68,10 @@ def main():
     x = jax.random.normal(key, (args.batch, args.seq, args.embed))
 
     if args.ep > 1:
+        if args.dp * args.tp * args.sp * args.pp != 1:
+            sys.exit("--ep is a standalone demo mode here; run it with "
+                     "dp=tp=sp=pp=1 (the ep axis subsumes data "
+                     "parallelism: tokens are sharded over it)")
         from mxnet_tpu.parallel.moe import (init_moe_params, moe_ffn,
                                             moe_ffn_ep)
         mesh = DeviceMesh({"ep": args.ep})

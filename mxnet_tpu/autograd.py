@@ -69,10 +69,10 @@ class SparseCot:
 
 class TapeNode:
     __slots__ = ("op_name", "inputs", "out_refs", "vjp_fn", "n_outputs",
-                 "attrs", "out_avals")
+                 "attrs", "out_avals", "replay_fn")
 
     def __init__(self, op_name, inputs, out_refs, vjp_fn, n_outputs,
-                 attrs=None, out_avals=None):
+                 attrs=None, out_avals=None, replay_fn=None):
         self.op_name = op_name
         self.inputs = inputs          # list of input NDArrays
         self.out_refs = out_refs      # weakrefs to output NDArrays
@@ -82,6 +82,12 @@ class TapeNode:
         # (shape, dtype) per output — lets backward build zero cotangents
         # for outputs the user dropped (their weakrefs are dead by then)
         self.out_avals = out_avals
+        # pure jax fn(*input_arrays) -> tuple(output_arrays): lets a
+        # create_graph walk differentiate THROUGH this node even when
+        # op_name isn't in the registry (the _grad_* nodes a previous
+        # create_graph pass recorded) — this is what makes third- and
+        # higher-order gradients possible
+        self.replay_fn = replay_fn
 
 
 class Tape:
@@ -221,12 +227,15 @@ class Function:
         return outputs
 
 
-def record_custom(op_name, inputs, outputs, vjp_fn, attrs=None):
+def record_custom(op_name, inputs, outputs, vjp_fn, attrs=None,
+                  replay_fn=None):
     """Push a hand-built node onto the tape.
 
     For ops that bypass the dense registry (sparse kernels, custom python
     ops): ``vjp_fn(cotangents_tuple) -> input cotangents`` where a cotangent
     may be a jax array or a SparseCot.  No-op outside a record scope.
+    ``replay_fn`` (pure jax, tuple-returning) makes the node
+    higher-order-differentiable under create_graph.
     """
     if not is_recording():
         return
@@ -234,7 +243,8 @@ def record_custom(op_name, inputs, outputs, vjp_fn, attrs=None):
     node = TapeNode(op_name, list(inputs),
                     [weakref.ref(o) for o in outputs],
                     vjp_fn, len(outputs), attrs,
-                    out_avals=[(o.shape, o.dtype) for o in outputs])
+                    out_avals=[(o.shape, o.dtype) for o in outputs],
+                    replay_fn=replay_fn)
     for o in outputs:
         o._autograd_node = node
     tape = get_tape()
@@ -413,15 +423,24 @@ def _backward_create_graph(heads, head_grads, return_for):
 
         op = _registry.get(node.op_name) if _registry.exists(node.op_name) \
             else None
-        if op is not None and not op.is_random and op.fgradient is None:
+        # a differentiable forward to replay: either the registry op's
+        # raw compute, or the replay_fn a previous create_graph pass
+        # attached to its _grad_* node (that recursion is what makes
+        # third- and higher-order derivatives work)
+        if node.replay_fn is not None:
+            fwd, tuple_out = node.replay_fn, True
+        elif op is not None and not op.is_random and op.fgradient is None:
+            fwd = op.raw(dict(node.attrs or {}))
+            tuple_out = node.n_outputs > 1
+        else:
+            fwd = None
+        if fwd is not None:
             # differentiable replay: gfun(primals, cts) -> input cotangents
-            attrs = dict(node.attrs or {})
             n_in = len(node.inputs)
-            multi = node.n_outputs > 1
 
-            def gfun(*arrays, _op=op, _attrs=attrs, _n=n_in, _m=multi):
+            def gfun(*arrays, _f=fwd, _n=n_in, _m=tuple_out):
                 prims, cts = arrays[:_n], arrays[_n:]
-                _, vf = jax.vjp(_op.raw(_attrs), *prims)
+                _, vf = jax.vjp(_f, *prims)
                 return vf(tuple(cts) if _m else cts[0])
 
             in_nds = list(node.inputs) + ct_nds
@@ -443,7 +462,8 @@ def _backward_create_graph(heads, head_grads, return_for):
             def grad_vjp(cts, _v=vjp_fn):
                 return _v(cts if isinstance(cts, tuple) else (cts,))
 
-            record_custom(f"_grad_{node.op_name}", in_nds, live, grad_vjp)
+            record_custom(f"_grad_{node.op_name}", in_nds, live, grad_vjp,
+                          replay_fn=gfun_live)
             in_cots = [None] * n_in
             for slot, o_nd in zip(live_idx, live):
                 in_cots[slot] = o_nd
