@@ -1,0 +1,166 @@
+"""Numeric loss + metric checks (parity: tests/python/unittest/
+test_loss.py + test_metric.py — values pinned against hand formulas,
+not just shapes)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+
+rng = np.random.RandomState(23)
+
+
+# --- losses -----------------------------------------------------------------
+def test_bce_numeric_and_weighting():
+    pred = rng.randn(4, 3).astype(np.float32)
+    label = (rng.rand(4, 3) > 0.5).astype(np.float32)
+    l = gluon.loss.SigmoidBinaryCrossEntropyLoss()(
+        nd.array(pred), nd.array(label)).asnumpy()
+    p = 1 / (1 + np.exp(-pred))
+    ref = -(label * np.log(p) + (1 - label) * np.log(1 - p)).mean(-1)
+    np.testing.assert_allclose(l, ref, rtol=1e-4, atol=1e-6)
+    # from_sigmoid path agrees
+    l2 = gluon.loss.SigmoidBinaryCrossEntropyLoss(from_sigmoid=True)(
+        nd.array(p), nd.array(label)).asnumpy()
+    np.testing.assert_allclose(l2, ref, rtol=1e-4, atol=1e-5)
+    # scalar weight scales the loss
+    lw = gluon.loss.SigmoidBinaryCrossEntropyLoss(weight=0.5)(
+        nd.array(pred), nd.array(label)).asnumpy()
+    np.testing.assert_allclose(lw, 0.5 * ref, rtol=1e-4, atol=1e-6)
+    # per-sample weight masks samples
+    sw = np.array([1, 0, 1, 0], np.float32).reshape(4, 1)
+    lsw = gluon.loss.SigmoidBinaryCrossEntropyLoss()(
+        nd.array(pred), nd.array(label), nd.array(sw)).asnumpy()
+    np.testing.assert_allclose(lsw[[1, 3]], 0.0, atol=1e-7)
+    np.testing.assert_allclose(lsw[[0, 2]], ref[[0, 2]], rtol=1e-4,
+                               atol=1e-6)
+
+
+def test_huber_both_regimes():
+    rho = 1.0
+    pred = np.array([[0.2], [3.0]], np.float32)
+    label = np.array([[0.0], [0.0]], np.float32)
+    l = gluon.loss.HuberLoss(rho=rho)(nd.array(pred),
+                                      nd.array(label)).asnumpy()
+    # |e|<=rho: 0.5 e^2 / rho ; else |e| - rho/2
+    np.testing.assert_allclose(l, [0.5 * 0.2 ** 2 / rho, 3.0 - rho / 2],
+                               rtol=1e-5)
+
+
+def test_hinge_and_squared_hinge():
+    pred = np.array([[0.9], [-0.3]], np.float32)
+    label = np.array([[1.0], [1.0]], np.float32)
+    l = gluon.loss.HingeLoss()(nd.array(pred), nd.array(label)).asnumpy()
+    np.testing.assert_allclose(l, [max(0, 1 - 0.9), max(0, 1 + 0.3)],
+                               rtol=1e-5)
+    l2 = gluon.loss.SquaredHingeLoss()(nd.array(pred),
+                                       nd.array(label)).asnumpy()
+    np.testing.assert_allclose(
+        l2, [max(0, 1 - 0.9) ** 2, max(0, 1 + 0.3) ** 2], rtol=1e-5)
+
+
+def test_kl_div_numeric():
+    logits = rng.randn(3, 5).astype(np.float32)
+    target = np.exp(rng.randn(3, 5)).astype(np.float32)
+    target /= target.sum(-1, keepdims=True)
+    # from_logits=True: pred are log-probs already
+    logp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+    l = gluon.loss.KLDivLoss(from_logits=True)(
+        nd.array(logp), nd.array(target)).asnumpy()
+    ref = (target * (np.log(target) - logp)).mean(-1)
+    np.testing.assert_allclose(l, ref, rtol=1e-4, atol=1e-6)
+
+
+def test_cosine_and_triplet():
+    a = rng.randn(4, 6).astype(np.float32)
+    b = rng.randn(4, 6).astype(np.float32)
+    lab = np.array([1, -1, 1, -1], np.float32)
+    l = gluon.loss.CosineEmbeddingLoss()(
+        nd.array(a), nd.array(b), nd.array(lab)).asnumpy()
+    cos = (a * b).sum(-1) / (np.linalg.norm(a, axis=-1)
+                             * np.linalg.norm(b, axis=-1))
+    ref = np.where(lab == 1, 1 - cos, np.maximum(0, cos))
+    np.testing.assert_allclose(l, ref, rtol=1e-4, atol=1e-5)
+
+    pos = a + 0.1
+    neg = rng.randn(4, 6).astype(np.float32)
+    lt = gluon.loss.TripletLoss(margin=1.0)(
+        nd.array(a), nd.array(pos), nd.array(neg)).asnumpy()
+    ref_t = np.maximum(
+        ((a - pos) ** 2).sum(-1) - ((a - neg) ** 2).sum(-1) + 1.0, 0)
+    np.testing.assert_allclose(lt, ref_t, rtol=1e-4, atol=1e-5)
+
+
+# --- metrics ----------------------------------------------------------------
+def test_accuracy_and_topk():
+    pred = nd.array(np.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]],
+                             np.float32))
+    label = nd.array(np.array([1, 1, 1], np.float32))
+    m = mx.metric.Accuracy()
+    m.update([label], [pred])
+    assert m.get()[1] == pytest.approx(2.0 / 3.0)
+    topk = mx.metric.TopKAccuracy(top_k=2)
+    topk.update([label], [pred])
+    assert topk.get()[1] == pytest.approx(1.0)  # 2 classes: always in top2
+
+
+def test_f1_and_mcc_known_confusion():
+    # predictions -> confusion: TP=1 FP=1 TN=1 FN=1
+    pred = nd.array(np.array([[0.2, 0.8], [0.4, 0.6],
+                              [0.9, 0.1], [0.7, 0.3]], np.float32))
+    label = nd.array(np.array([1, 0, 0, 1], np.float32))
+    f1 = mx.metric.F1()
+    f1.update([label], [pred])
+    # precision = 1/2, recall = 1/2 -> F1 = 1/2
+    assert f1.get()[1] == pytest.approx(0.5)
+    mcc = mx.metric.MCC()
+    mcc.update([label], [pred])
+    # balanced random confusion -> MCC 0
+    assert mcc.get()[1] == pytest.approx(0.0, abs=1e-6)
+
+
+def test_regression_metrics_numeric():
+    lab = np.array([1.0, 2.0, 3.0], np.float32)
+    prd = np.array([1.5, 2.0, 2.0], np.float32)
+    pairs = {"mae": np.abs(lab - prd).mean(),
+             "mse": ((lab - prd) ** 2).mean(),
+             "rmse": np.sqrt(((lab - prd) ** 2).mean())}
+    for name, want in pairs.items():
+        m = mx.metric.create(name)
+        m.update([nd.array(lab)], [nd.array(prd)])
+        assert m.get()[1] == pytest.approx(float(want), rel=1e-5), name
+
+
+def test_perplexity_matches_cross_entropy():
+    probs = np.array([[0.5, 0.5], [0.9, 0.1]], np.float32)
+    label = np.array([0, 0], np.float32)
+    m = mx.metric.Perplexity(ignore_label=None)
+    m.update([nd.array(label)], [nd.array(probs)])
+    want = np.exp(-(np.log(0.5) + np.log(0.9)) / 2)
+    assert m.get()[1] == pytest.approx(float(want), rel=1e-5)
+
+
+def test_pearson_correlation():
+    x = rng.randn(32).astype(np.float32)
+    noise = rng.randn(32).astype(np.float32) * 0.1
+    y = 2 * x + noise
+    m = mx.metric.PearsonCorrelation()
+    m.update([nd.array(y)], [nd.array(x)])
+    want = np.corrcoef(x, y)[0, 1]
+    assert m.get()[1] == pytest.approx(float(want), rel=1e-3)
+
+
+def test_composite_and_custom_metric():
+    comp = mx.metric.CompositeEvalMetric()
+    comp.add(mx.metric.Accuracy())
+    comp.add(mx.metric.create("mae"))
+    pred = nd.array(np.array([[0.3, 0.7]], np.float32))
+    label = nd.array(np.array([1], np.float32))
+    comp.update([label], [pred])
+    names, vals = comp.get()
+    assert len(names) == 2 and len(vals) == 2
+
+    cm = mx.metric.CustomMetric(
+        lambda l, p: float(np.abs(l - p).max()), name="maxerr")
+    cm.update([nd.array([1.0, 2.0])], [nd.array([1.5, 2.0])])
+    assert cm.get()[1] == pytest.approx(0.5)
