@@ -1,0 +1,78 @@
+"""Central-difference gradient sweep (parity: the reference's
+check_numeric_gradient discipline in test_operator.py — autograd
+backward vs numeric differentiation for a spread of op families, not
+just the elementwise zoo)."""
+import numpy as np
+import pytest
+
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import check_numeric_gradient
+
+rng = np.random.RandomState(29)
+
+
+def _a(*shape, lo=-1.5, hi=1.5):
+    return rng.uniform(lo, hi, shape).astype(np.float32)
+
+
+CASES = [
+    ("fully_connected",
+     lambda x, w, b: nd.FullyConnected(x, w, b, num_hidden=4),
+     [_a(3, 5), _a(4, 5), _a(4)]),
+    ("conv2d",
+     lambda x, w, b: nd.Convolution(x, w, b, kernel=(3, 3), num_filter=2,
+                                    pad=(1, 1)),
+     [_a(2, 3, 5, 5), _a(2, 3, 3, 3), _a(2)]),
+    ("batch_dot",
+     lambda a, b: nd.batch_dot(a, b),
+     [_a(2, 3, 4), _a(2, 4, 2)]),
+    ("max_pool",
+     lambda x: nd.Pooling(x, kernel=(2, 2), stride=(2, 2),
+                          pool_type="max"),
+     [_a(1, 2, 4, 4)]),
+    ("avg_pool_pad",
+     lambda x: nd.Pooling(x, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                          pool_type="avg"),
+     [_a(1, 2, 5, 5)]),
+    ("softmax_axis0",
+     lambda x: nd.softmax(x, axis=0),
+     [_a(4, 3)]),
+    ("layernorm",
+     lambda x, g, b: nd.LayerNorm(x, g, b, axis=-1),
+     [_a(3, 6), _a(6, lo=0.5, hi=1.5), _a(6)]),
+    ("broadcast_mul",
+     lambda a, b: nd.broadcast_mul(a, b),
+     [_a(3, 4), _a(1, 4)]),
+    ("transpose_dot",
+     lambda a, b: nd.dot(a, b, transpose_a=True),
+     [_a(3, 4), _a(3, 2)]),
+    ("sum_axis_keepdims",
+     lambda x: nd.sum(x, axis=1, keepdims=True) * 2.0,
+     [_a(3, 5)]),
+    ("concat_slice",
+     lambda a, b: nd.slice_axis(nd.concat(a, b, dim=1), axis=1, begin=1,
+                                end=5),
+     [_a(2, 3), _a(2, 3)]),
+    ("tile_mean",
+     lambda x: nd.tile(x, reps=(2, 1)),
+     [_a(2, 3)]),
+    ("leaky_gelu",
+     lambda x: nd.LeakyReLU(x, act_type="gelu"),
+     [_a(4, 4)]),
+    ("l2_normalization",
+     lambda x: nd.L2Normalization(x, mode="channel"),
+     [_a(2, 5)]),
+    ("take_rows",
+     lambda w: nd.take(w, nd.array(np.array([0, 2, 2], np.float32))),
+     [_a(4, 3)]),
+    ("where_cond",
+     lambda a, b: nd.where(nd.array(np.array([1, 0, 1], np.float32)),
+                           a, b),
+     [_a(3, 2), _a(3, 2)]),
+]
+
+
+@pytest.mark.parametrize("name,fn,inputs", CASES,
+                         ids=[c[0] for c in CASES])
+def test_numeric_gradient(name, fn, inputs):
+    check_numeric_gradient(fn, inputs, rtol=2e-2, atol=2e-3, eps=1e-3)
