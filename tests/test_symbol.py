@@ -248,3 +248,47 @@ def test_infer_type_propagation():
     by_name = dict(zip(e.list_arguments(), args_t))
     assert by_name["data"] == np.int32
     assert out_t == [np.dtype(np.float32)]
+
+
+def test_infer_shape_partial_and_errors():
+    """Partial inference + error contracts (parity: reference
+    tests/python/unittest/test_infer_shape.py)."""
+    d = sym.var("data")
+    w = sym.var("w")
+    fc1 = sym.FullyConnected(d, w, sym.var("b"), num_hidden=4, name="f1")
+    out = sym.Activation(fc1, act_type="relu")
+    # nothing known: partial returns None everywhere, no raise
+    args, outs, _ = out.infer_shape_partial()
+    assert all(a is None for a in args)
+    assert outs == [None] or all(o is None for o in outs)
+    # full inference from data alone back-fills the params
+    args, outs, _ = out.infer_shape(data=(5, 7))
+    assert args == [(5, 7), (4, 7), (4,)]
+    assert outs == [(5, 4)]
+    # strict inference with missing info raises
+    with pytest.raises(MXNetError):
+        sym.FullyConnected(sym.var("x"), sym.var("w2"), sym.var("b2"),
+                           num_hidden=3).infer_shape()
+    # inconsistent known shapes raise
+    with pytest.raises(MXNetError):
+        out.infer_shape(data=(5, 7), w=(4, 9))
+
+
+def test_infer_shape_var_shape_attr():
+    """A variable's __shape__ attr seeds inference (reference
+    sym.var(shape=...) behavior)."""
+    d = sym.var("data", __shape__=(3, 6))
+    out = sym.FullyConnected(d, num_hidden=2, name="fc")
+    args, outs, _ = out.infer_shape()
+    assert outs == [(3, 2)]
+    by_name = dict(zip(out.list_arguments(), args))
+    assert by_name["fc_weight"] == (2, 6)
+
+
+def test_infer_shape_zero_size_batch():
+    """0-size batch flows through inference (jax-native zero-size
+    arrays; reference np_shape semantics)."""
+    d = sym.var("data")
+    out = sym.FullyConnected(d, num_hidden=4, name="fc")
+    _, outs, _ = out.infer_shape(data=(0, 5))
+    assert outs == [(0, 4)]
