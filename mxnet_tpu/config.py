@@ -122,6 +122,9 @@ _register("DMLC_PS_BIND_ADDR", str, "127.0.0.1",
 _register("MXNET_PROFILER_XPLANE_DIR", str, "",
           "directory for jax.profiler xplane traces (TensorBoard/"
           "perfetto); empty disables the device trace")
+_register("MXNET_FUSED_SOFTMAX_CE", str, "auto",
+          "fused Pallas softmax-cross-entropy kernel: 1 forces on, 0 "
+          "forces plain XLA, auto probes the tile config once on TPU")
 _register("MXNET_PROFILER_AUTOSTART", bool, False,
           "start the profiler at import (parity: reference "
           "env_var.md MXNET_PROFILER_AUTOSTART)")

@@ -497,9 +497,11 @@ def _logistic_regression_output(attrs, data, label):
 
 @register("softmax_cross_entropy")
 def _softmax_cross_entropy(attrs, data, label):
-    logp = jax.nn.log_softmax(data, axis=-1)
-    oh = jax.nn.one_hot(label.astype(jnp.int32), data.shape[-1])
-    return -jnp.sum(oh * logp)
+    """Total softmax CE over the batch (reference loss_binary_op.cc:30).
+    Routes through the fused Pallas row kernel (pallas_softmax_ce.py,
+    gated by MXNET_FUSED_SOFTMAX_CE) — one HBM pass over the logits."""
+    from .pallas_softmax_ce import fused_softmax_ce
+    return jnp.sum(fused_softmax_ce(data, label))
 
 
 @register("CTCLoss", alias=("ctc_loss",))

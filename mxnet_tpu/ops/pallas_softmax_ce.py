@@ -1,0 +1,159 @@
+"""Fused softmax + cross-entropy — Pallas TPU kernel #3.
+
+Reference capability anchor: softmax_output-inl.h computes softmax and
+the CE loss/gradient as separate passes over HBM. The fused row kernel
+keeps each logit row resident in VMEM and emits BOTH the per-row loss
+and the softmax probabilities in one pass (one HBM read of the logits),
+with the max-subtraction done in f32 regardless of input dtype
+(bf16-safe) — the classifier-head bandwidth floor.
+
+Forward runs as a Pallas kernel (interpret mode off-TPU so the suite
+exercises the same code path); backward is the analytic
+``(softmax - onehot) * ct`` in plain XLA from the saved probs (no 1/N —
+the registered op SUMS per-row losses, reference loss_binary_op.cc).
+Out-of-range labels (the -1 ignore/padding convention) contribute zero
+loss and zero gradient, matching the one_hot semantics of the plain
+path. Gated like the LayerNorm kernel: MXNET_FUSED_SOFTMAX_CE=1/true/on
+forces on, 0/false/off forces plain XLA, auto (default) probes once on
+TPU and falls back on Mosaic rejection.
+"""
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _smce_kernel(x_ref, lab_ref, loss_ref, prob_ref):
+    x = x_ref[:].astype(jnp.float32)              # (B, D)
+    m = jnp.max(x, axis=-1, keepdims=True)
+    e = jnp.exp(x - m)
+    s = jnp.sum(e, axis=-1, keepdims=True)
+    logp = x - m - jnp.log(s)
+    prob = e / s
+    lab = lab_ref[:].astype(jnp.int32)            # (B,)
+    # invalid labels (e.g. -1 padding) contribute zero, like one_hot
+    valid = (lab >= 0) & (lab < x.shape[-1])
+    picked = jnp.take_along_axis(
+        logp, jnp.clip(lab, 0, x.shape[-1] - 1)[:, None], axis=-1)[:, 0]
+    loss_ref[:] = jnp.where(valid, -picked, 0.0)
+    prob_ref[:] = prob.astype(prob_ref.dtype)
+
+
+def _use_interpret():
+    return jax.default_backend() != "tpu"
+
+
+def _pick_block_rows(n):
+    for b in (256, 128, 64, 32, 16, 8, 4, 2, 1):
+        if n % b == 0:
+            return b
+    return 1
+
+
+@functools.partial(jax.jit, static_argnames=("block_rows", "interpret"))
+def _smce_fwd(x2, labels, *, block_rows, interpret):
+    n, d = x2.shape
+    grid = (n // block_rows,)
+    loss, prob = pl.pallas_call(
+        _smce_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
+            pl.BlockSpec((block_rows,), lambda i: (i,)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_rows,), lambda i: (i,)),
+            pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n,), jnp.float32),
+            jax.ShapeDtypeStruct((n, d), x2.dtype),
+        ],
+        interpret=interpret,
+    )(x2, labels)
+    return loss, prob
+
+
+@jax.custom_vjp
+def _softmax_ce(logits, labels):
+    loss, _prob = _smce_core(logits, labels)
+    return loss
+
+
+def _smce_core(logits, labels):
+    return _smce_fwd(logits, labels,
+                     block_rows=_pick_block_rows(logits.shape[0]),
+                     interpret=_use_interpret())
+
+
+def _smce_vjp_fwd(logits, labels):
+    loss, prob = _smce_core(logits, labels)
+    return loss, (prob, labels)
+
+
+def _smce_vjp_bwd(res, ct):
+    prob, labels = res
+    lab = labels.astype(jnp.int32)
+    onehot = jax.nn.one_hot(lab, prob.shape[-1], dtype=jnp.float32)
+    valid = ((lab >= 0) & (lab < prob.shape[-1])).astype(jnp.float32)
+    # invalid (padding) rows get ZERO gradient, matching their zero loss
+    d_logits = (prob.astype(jnp.float32) - onehot) \
+        * (ct * valid)[:, None]
+    return d_logits.astype(prob.dtype), None
+
+
+_softmax_ce.defvjp(_smce_vjp_fwd, _smce_vjp_bwd)
+
+
+_GATE_CACHE = {}
+
+
+def fused_softmax_ce_available(n, d, dtype):
+    """Gate identical in spirit to MXNET_FUSED_LAYERNORM: env override,
+    else probe this exact tile config once on TPU (Mosaic can reject a
+    layout) and remember the answer."""
+    flag = os.environ.get("MXNET_FUSED_SOFTMAX_CE", "auto").lower()
+    if flag in ("1", "true", "on"):
+        return True
+    if flag in ("0", "false", "off"):
+        return False
+    if _use_interpret():
+        return True  # interpret mode always works
+    key = (_pick_block_rows(n), d, str(dtype))
+    hit = _GATE_CACHE.get(key)
+    if hit is None:
+        try:
+            import numpy as _np
+            probe = _smce_fwd(jnp.zeros((key[0], d), dtype),
+                              jnp.zeros((key[0],), jnp.int32),
+                              block_rows=key[0], interpret=False)
+            # materialize: execution-time Mosaic failures must be
+            # caught HERE, not at the first real call
+            _np.asarray(probe[0])
+            hit = True
+        except Exception:
+            hit = False
+        _GATE_CACHE[key] = hit
+    return hit
+
+
+def fused_softmax_ce(logits, labels):
+    """Per-row softmax cross-entropy loss, differentiable.
+
+    logits: (n, d); labels: (n,) integer class ids. Returns (n,) f32
+    losses. Falls back to plain XLA when the kernel is gated off."""
+    labels = labels.astype(jnp.int32)
+    n, d = logits.shape
+    if n == 0:
+        return jnp.zeros((0,), jnp.float32)
+    if fused_softmax_ce_available(n, d, logits.dtype):
+        return _softmax_ce(logits, labels)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    valid = (labels >= 0) & (labels < d)
+    picked = jnp.take_along_axis(
+        logp, jnp.clip(labels, 0, d - 1)[:, None], axis=-1)[:, 0]
+    return jnp.where(valid, -picked, 0.0)
