@@ -262,3 +262,41 @@ def test_unpacked_blobshape_dims():
     layers = parse_caffemodel(_proto.emit_bytes(100, msg))
     assert layers[0][2][0].shape == (2, 3, 4)
     np.testing.assert_allclose(layers[0][2][0], arr)
+
+
+def test_converted_model_loads_in_gluon_symbolblock(tmp_path):
+    """End-to-end deployment path: convert_model output saved as the
+    standard checkpoint pair loads through gluon.SymbolBlock.imports
+    and reproduces the converted executor's outputs."""
+    from mxnet_tpu import gluon
+
+    rng = np.random.RandomState(7)
+    w_conv = rng.randn(4, 1, 3, 3).astype(np.float32) * 0.3
+    b_conv = rng.randn(4).astype(np.float32) * 0.1
+    w_ip = rng.randn(5, 100).astype(np.float32) * 0.1
+    b_ip = rng.randn(5).astype(np.float32) * 0.1
+    model = _make_caffemodel([
+        ("conv1", "Convolution", [w_conv, b_conv]),
+        ("ip1", "InnerProduct", [w_ip, b_ip]),
+    ])
+    s, arg_p, aux_p, input_name, input_dim = convert_model(
+        LENET_PROTOTXT, model)
+
+    # save the standard pair (what convert_model.py main() writes)
+    prefix = str(tmp_path / "caffenet")
+    with open(prefix + "-symbol.json", "w") as f:
+        f.write(s.tojson())
+    save = {f"arg:{k}": v for k, v in arg_p.items()}
+    save.update({f"aux:{k}": v for k, v in aux_p.items()})
+    nd.save(prefix + "-0000.params", save)
+
+    net = gluon.SymbolBlock.imports(prefix + "-symbol.json",
+                                    [input_name],
+                                    prefix + "-0000.params")
+    x = rng.randn(*input_dim).astype(np.float32)
+    got = net(nd.array(x)).asnumpy()
+
+    args = {input_name: nd.array(x)}
+    args.update(arg_p)
+    ref = s.bind(mx.cpu(), args, grad_req="null").forward()[0].asnumpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
