@@ -285,3 +285,42 @@ class TestAggregatedOptimizer:
         pb = self._train(monkeypatch, 0)
         for a, b in zip(pa, pb):
             np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+class TestScalarRandomFamilyMoments:
+    """Scalar-parameter _random_* ops: empirical moments + seed
+    determinism (parity: reference test_random.py, which checks each
+    sampler's mean/std against the distribution)."""
+
+    def _draw(self, fn, **kw):
+        return fn(shape=(4000,), **kw).asnumpy()
+
+    def test_moments(self):
+        import mxnet_tpu as mx
+        mx.random.seed(1234)
+        u = self._draw(nd.random.uniform, low=2.0, high=6.0)
+        np.testing.assert_allclose(u.mean(), 4.0, atol=0.15)
+        assert u.min() >= 2.0 and u.max() <= 6.0
+        n = self._draw(nd.random.normal, loc=1.0, scale=3.0)
+        np.testing.assert_allclose(n.mean(), 1.0, atol=0.2)
+        np.testing.assert_allclose(n.std(), 3.0, rtol=0.06)
+        g = self._draw(nd.random.gamma, alpha=4.0, beta=0.5)
+        np.testing.assert_allclose(g.mean(), 2.0, rtol=0.08)
+        e = self._draw(nd.random.exponential, scale=0.5)
+        np.testing.assert_allclose(e.mean(), 0.5, rtol=0.08)
+        p = self._draw(nd.random.poisson, lam=6.0)
+        np.testing.assert_allclose(p.mean(), 6.0, rtol=0.05)
+        np.testing.assert_allclose(p.var(), 6.0, rtol=0.15)
+
+    def test_seed_determinism_and_divergence(self):
+        import mxnet_tpu as mx
+        mx.random.seed(77)
+        a = nd.random.normal(shape=(64,)).asnumpy()
+        b = nd.random.normal(shape=(64,)).asnumpy()
+        assert not np.allclose(a, b)  # stream advances
+        mx.random.seed(77)
+        a2 = nd.random.normal(shape=(64,)).asnumpy()
+        np.testing.assert_array_equal(a, a2)  # same seed, same stream
+        mx.random.seed(78)
+        a3 = nd.random.normal(shape=(64,)).asnumpy()
+        assert not np.allclose(a, a3)
