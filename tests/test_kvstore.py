@@ -301,3 +301,20 @@ def test_server_side_profiling(tmp_path):
         server._stop.set()
         profiler._config.update(saved)
         profiler._state["kvstore"] = None
+
+
+def test_refuse_nonloopback_bind_without_token(monkeypatch):
+    """Security contract: pickle-over-TCP must never listen beyond loopback
+    unauthenticated (unauthenticated pickle = remote code execution)."""
+    from mxnet_tpu.kvstore_server import KVServer
+    monkeypatch.delenv("MXNET_KVSTORE_AUTH_TOKEN", raising=False)
+    monkeypatch.delenv("MXNET_KVSTORE_ALLOW_INSECURE", raising=False)
+    with pytest.raises(RuntimeError, match="non-loopback"):
+        KVServer(port=0, num_workers=1, bind_addr="0.0.0.0")
+    # loopback without a token stays allowed (the default deployment)
+    KVServer(port=0, num_workers=1, bind_addr="127.0.0.1")
+    # a token unlocks non-loopback
+    KVServer(port=0, num_workers=1, bind_addr="0.0.0.0", auth_token="s3cret")
+    # the documented escape hatch for trusted private networks
+    monkeypatch.setenv("MXNET_KVSTORE_ALLOW_INSECURE", "1")
+    KVServer(port=0, num_workers=1, bind_addr="0.0.0.0")
