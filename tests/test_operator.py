@@ -295,3 +295,47 @@ def test_random_ops_determinism():
     r = nd.random.randint(0, 10, shape=(100,))
     assert r.dtype == np.int32
     assert r.asnumpy().min() >= 0 and r.asnumpy().max() < 10
+
+
+def test_conv_strided_1x1_subsample_rewrite():
+    """Strided 1x1 convs lower to subsample+stride-1 conv (round-5 perf
+    rewrite); forward and BOTH grads must match the direct strided conv."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from mxnet_tpu.ops import registry
+    conv = registry.get("Convolution").fcompute
+    rng = np.random.RandomState(7)
+    for stride, groups in [((2, 2), 1), ((2, 2), 4), ((3, 2), 1)]:
+        x = rng.randn(2, 8, 15, 14).astype(np.float32)
+        w = rng.randn(16, 8 // groups, 1, 1).astype(np.float32)
+        attrs = {"kernel": (1, 1), "stride": stride, "no_bias": True,
+                 "num_group": groups}
+        dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                        ("NCHW", "OIHW", "NCHW"))
+        ref = lax.conv_general_dilated(
+            jnp.asarray(x), jnp.asarray(w), stride, [(0, 0), (0, 0)],
+            dimension_numbers=dn, feature_group_count=groups)
+        got = conv(attrs, jnp.asarray(x), jnp.asarray(w))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-6)
+
+        def loss_mx(x, w):
+            return (conv(attrs, x, w) ** 2).sum()
+
+        def loss_ref(x, w):
+            return (lax.conv_general_dilated(
+                x, w, stride, [(0, 0), (0, 0)], dimension_numbers=dn,
+                feature_group_count=groups) ** 2).sum()
+
+        for a, b in zip(jax.grad(loss_mx, (0, 1))(jnp.asarray(x), jnp.asarray(w)),
+                        jax.grad(loss_ref, (0, 1))(jnp.asarray(x), jnp.asarray(w))):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-6)
+    # channel-last layout keeps its spatial axes straight
+    x = rng.randn(2, 31, 6).astype(np.float32)
+    w = rng.randn(1, 6, 12).astype(np.float32)  # WIO for channel-last
+    y = conv({"kernel": (1,), "stride": (4,), "no_bias": True,
+              "layout": "NWC"}, jnp.asarray(x), jnp.asarray(w))
+    ref = np.einsum("nwc,co->nwo", x[:, ::4, :], w[0])
+    np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-4, atol=1e-6)
