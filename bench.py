@@ -178,10 +178,104 @@ def calibrate_peak(dev, reps=None):
                   "sweep": sweep}
 
 
+_MODEL_CACHE = {}
+
+
+def build_train_step(batch, dtype="bfloat16", use_remat=False,
+                     loss_mode="fused"):
+    """Build the benchmarked ResNet-50 train step (fwd+bwd+SGD-momentum).
+
+    Shared by main() and tools/hlo_flops.py so the FLOP forensics always
+    analyze the exact program being timed.  Returns
+    ``(step_fn, (tparams, aparams), n_params)`` with the param tuples as
+    host arrays; callers place them on their own device and create the
+    momentum buffers (``jnp.zeros_like``) themselves.
+
+    The functionalized model is batch-polymorphic, so it is built ONCE
+    per dtype and cached — multi-batch-size runs (bs32/128/256) pay the
+    host-side functionalize + init exactly once.
+
+    loss_mode: "fused" routes softmax-CE through the Pallas kernel
+    (mxnet_tpu.ops.pallas_softmax_ce, XLA fallback built in);
+    "onehot" keeps the r2-r4 one-hot formulation for A/B.
+    """
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.parallel.spmd import (functionalize, merge_params,
+                                         host_cpu_scope, remat_wrap)
+    from mxnet_tpu.ops import registry as _registry
+    from mxnet_tpu.ops.pallas_softmax_ce import fused_softmax_ce
+    from mxnet_tpu import autograd as _ag
+    from mxnet_tpu import amp
+
+    if dtype == "bfloat16":
+        # framework AMP: MXU ops compute in bf16, fp32 master weights
+        # and norm statistics — the recipe lives in mxnet_tpu.amp
+        amp.init(target_dtype="bfloat16")
+
+    if dtype in _MODEL_CACHE:
+        apply_fn, param_arrays, train_idx, aux_list = _MODEL_CACHE[dtype]
+    else:
+        with host_cpu_scope(), jax.disable_jit():
+            net = vision.resnet50_v1()
+            net.initialize(mx.initializer.Xavier())
+            x_ex = mx.nd.zeros((batch, 3, 224, 224))
+            fb = functionalize(net, x_ex)
+            apply_fn, param_arrays, _names = fb
+            x_sds = jax.ShapeDtypeStruct((batch, 3, 224, 224),
+                                         np.dtype(np.float32))
+            train_idx, aux_list = fb.split_train_aux((x_sds,))
+        _MODEL_CACHE[dtype] = (apply_fn, param_arrays, train_idx, aux_list)
+
+    sgd_attrs = {"lr": 0.01, "wd": 1e-4, "momentum": 0.9,
+                 "rescale_grad": 1.0}
+    sgd_mom = _registry.get("sgd_mom_update").fcompute
+
+    def step(key, tparams, aparams, moms, x, y):
+        def fwd(tps, x_):
+            ps = merge_params(train_idx, aux_list, tps, aparams)
+            with _ag.train_mode():
+                outs, mutated = apply_fn(key, ps, (x_,))
+            return outs[0], mutated
+
+        if use_remat:
+            fwd = remat_wrap(fwd)
+
+        def loss_fn(tps):
+            logits, mutated = fwd(tps, x)
+            logits = logits.astype(jnp.float32)
+            if loss_mode == "fused":
+                loss = fused_softmax_ce(logits, y.astype(jnp.int32)).mean()
+            else:
+                logp = jax.nn.log_softmax(logits, axis=-1)
+                oh = jax.nn.one_hot(y.astype(jnp.int32), 1000)
+                loss = -(oh * logp).sum(axis=-1).mean()
+            return loss, mutated
+
+        (loss, mutated), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(tparams)
+        new_p, new_m = [], []
+        for w, g, m in zip(tparams, grads, moms):
+            nw, nm = sgd_mom(sgd_attrs, w, g.astype(w.dtype), m)
+            new_p.append(nw)
+            new_m.append(nm)
+        new_aux = tuple(mu.astype(a.dtype) for mu, a in zip(mutated, aparams))
+        return tuple(new_p), new_aux, tuple(new_m), loss
+
+    tparams = tuple(param_arrays[i] for i in train_idx)
+    aparams = tuple(param_arrays[i] for i in aux_list)
+    n_params = sum(int(np.prod(a.shape)) for a in param_arrays)
+    return step, (tparams, aparams), n_params
+
+
 def main():
     budget = float(os.environ.get("BENCH_TIME_BUDGET", 1200))
     batch = int(os.environ.get("BENCH_BATCH", 32))
     batch2 = int(os.environ.get("BENCH_BATCH2", 128))
+    batch3 = int(os.environ.get("BENCH_BATCH3", 256))
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
     k_steps = max(2, int(os.environ.get("BENCH_K", 8)))
 
@@ -233,13 +327,7 @@ def main():
         except Exception:
             pass
 
-        import mxnet_tpu as mx
-        from mxnet_tpu.gluon.model_zoo import vision
-        from mxnet_tpu.parallel.spmd import functionalize, merge_params
-        from mxnet_tpu.ops import registry as _registry
         from mxnet_tpu import random as _random
-        from mxnet_tpu import autograd as _ag
-        from mxnet_tpu import amp
 
         # bounded retry inside the init window: a relay FLAP surfaces as a
         # fast exception from device enumeration — re-dial with backoff
@@ -267,74 +355,13 @@ def main():
         result["n_devices"] = len(devs)
         result["device_kind"] = str(kind)
 
-        if dtype == "bfloat16":
-            # framework AMP: MXU ops compute in bf16, fp32 master weights
-            # and norm statistics — the recipe lives in mxnet_tpu.amp, not
-            # hand-rolled here
-            amp.init(target_dtype="bfloat16")
-
-        log("building ResNet-50 on host CPU (no device compiles)")
-        from mxnet_tpu.parallel.spmd import host_cpu_scope
-        with host_cpu_scope(), jax.disable_jit():
-            net = vision.resnet50_v1()
-            net.initialize(mx.initializer.Xavier())
-            x_ex = mx.nd.zeros((batch, 3, 224, 224))
-            fb = functionalize(net, x_ex)
-            apply_fn, param_arrays, names = fb
-            x_sds = jax.ShapeDtypeStruct((batch, 3, 224, 224),
-                                         np.dtype(np.float32))
-            train_idx, aux_list = fb.split_train_aux((x_sds,))
-        n_params = sum(int(np.prod(a.shape)) for a in param_arrays)
-        log(f"functionalized: {len(param_arrays)} params "
-            f"({n_params / 1e6:.1f}M), {len(aux_list)} aux")
-
         compute_dtype = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
-
-        sgd_attrs = {"lr": 0.01, "wd": 1e-4, "momentum": 0.9,
-                     "rescale_grad": 1.0}
-        sgd_mom = _registry.get("sgd_mom_update").fcompute
 
         # remat parity hook (MXNET_BACKWARD_DO_MIRROR). Default OFF: honest
         # timing shows no activation-spill cliff at these sizes and remat
         # costs ~20% real step time at bs128 (measured r4).
-        from mxnet_tpu.parallel.spmd import remat_wrap
         remat_from = int(os.environ.get("BENCH_REMAT_FROM_BS", 0))
-
-        def make_step(use_remat):
-            def step(key, tparams, aparams, moms, x, y):
-                def fwd(tps, x_):
-                    ps = merge_params(train_idx, aux_list, tps, aparams)
-                    with _ag.train_mode():
-                        outs, mutated = apply_fn(key, ps, (x_,))
-                    return outs[0], mutated
-
-                if use_remat:
-                    fwd = remat_wrap(fwd)
-
-                def loss_fn(tps):
-                    logits, mutated = fwd(tps, x)
-                    logits = logits.astype(jnp.float32)
-                    logp = jax.nn.log_softmax(logits, axis=-1)
-                    oh = jax.nn.one_hot(y.astype(jnp.int32), 1000)
-                    return -(oh * logp).sum(axis=-1).mean(), mutated
-
-                (loss, mutated), grads = jax.value_and_grad(
-                    loss_fn, has_aux=True)(tparams)
-                new_p, new_m = [], []
-                for w, g, m in zip(tparams, grads, moms):
-                    nw, nm = sgd_mom(sgd_attrs, w, g.astype(w.dtype), m)
-                    new_p.append(nw)
-                    new_m.append(nm)
-                new_aux = tuple(mu.astype(a.dtype)
-                                for mu, a in zip(mutated, aparams))
-                return tuple(new_p), new_aux, tuple(new_m), loss
-
-            return step
-
-        base_tparams = tuple(jax.device_put(param_arrays[i], dev)
-                             for i in train_idx)
-        base_aparams = tuple(jax.device_put(param_arrays[i], dev)
-                             for i in aux_list)
+        loss_mode = os.environ.get("BENCH_LOSS", "fused")
 
         def measure(bs):
             """Compile + time the train step at batch size bs.
@@ -344,9 +371,14 @@ def main():
             = (T(2K) - T(K)) / K with transfer sync (see module docstring
             for why nothing weaker is trustworthy on this relay).
             """
-            step_fn = make_step(bs >= remat_from > 0)
-            tparams = tuple(jnp.array(p) for p in base_tparams)
-            aparams = tuple(jnp.array(p) for p in base_aparams)
+            log(f"[bs{bs}] building ResNet-50 on host CPU "
+                "(no device compiles)")
+            step_fn, (tparams_h, aparams_h), n_params = build_train_step(
+                bs, dtype, use_remat=(bs >= remat_from > 0),
+                loss_mode=loss_mode)
+            log(f"[bs{bs}] functionalized ({n_params / 1e6:.1f}M params)")
+            tparams = tuple(jax.device_put(p, dev) for p in tparams_h)
+            aparams = tuple(jax.device_put(p, dev) for p in aparams_h)
             moms = tuple(jnp.zeros_like(p) for p in tparams)
             x = jax.device_put(
                 np.random.randn(bs, 3, 224, 224).astype(np.float32), dev
@@ -490,6 +522,7 @@ def main():
             "timed_steps": m1["timed_steps"],
             "batch": batch,
             "dtype": dtype,
+            "loss": loss_mode,
             "final_loss": m1["final_loss"],
             "flops_per_step_analytic": m1["flops_analytic"],
             "flops_per_step_cost_analysis": m1["flops_cost_analysis"],
@@ -501,23 +534,26 @@ def main():
         })
         attach_mfu(m1, result)
 
-        # --- second MFU point (bs128 per round-3 verdict) ----------------
-        remaining = budget - (time.perf_counter() - T_START)
-        if batch2 and batch2 != batch and remaining > 240:
+        # --- extra MFU points (bs128 per r3 verdict, bs256 per r4) -------
+        for extra_bs in (batch2, batch3):
+            if not extra_bs or extra_bs == batch:
+                continue
+            remaining = budget - (time.perf_counter() - T_START)
+            if remaining <= 240:
+                log(f"skipping bs{extra_bs}: only {remaining:.0f}s left")
+                continue
             try:
-                m2 = measure(batch2)
-                log(f"[bs{batch2}] {m2['img_s']:.1f} img/s, "
+                m2 = measure(extra_bs)
+                log(f"[bs{extra_bs}] {m2['img_s']:.1f} img/s, "
                     f"step {m2['step_ms']:.2f}ms")
                 sub = {"img_s": round(m2["img_s"], 2),
                        "compile_seconds": m2["compile_seconds"],
                        "final_loss": m2["final_loss"]}
                 attach_mfu(m2, sub)
-                result[f"bs{batch2}"] = sub
+                result[f"bs{extra_bs}"] = sub
             except Exception as e:
-                log(f"bs{batch2} phase failed: {type(e).__name__}: {e}")
-                result[f"bs{batch2}"] = {"error": str(e)}
-        elif batch2 and batch2 != batch:
-            log(f"skipping bs{batch2}: only {remaining:.0f}s left")
+                log(f"bs{extra_bs} phase failed: {type(e).__name__}: {e}")
+                result[f"bs{extra_bs}"] = {"error": str(e)}
     except Exception as e:  # always emit the JSON line
         import traceback
         traceback.print_exc(file=sys.stderr)

@@ -1,0 +1,175 @@
+"""Composed parallelism: one train step over a dp x tp x pp (or
+dp x pp x ep) mesh.
+
+Phases 2-4 of the driver dryrun exercise tensor/sequence, pipeline, and
+expert parallelism in isolation; this module is the composition the
+round-4 verdict asked for (SURVEY §7 step 8): a transformer train step
+whose PIPELINE STAGES contain TENSOR-PARALLEL blocks, all in ONE
+shard_map program —
+
+  * batch sharded over 'dp' (the pipeline runs per data shard);
+  * per-stage weights stacked on a leading axis sharded over 'pp'
+    (gpipe_fn param_specs);
+  * within each stage, Megatron column/row sharding over 'tp' with its
+    psums riding ICI *inside* the pipeline body (tp._block_math);
+  * gradients from jax.grad through the whole schedule (scan + ppermute
+    + psum all reverse correctly), then a plain SGD update.
+
+The ep variant swaps the TP block for a pre-LN MoE residual block whose
+two all_to_all collectives run over 'ep' inside the pipeline body
+(moe.moe_ffn_local).
+
+Every builder returns (step, oracle_step) where oracle_step is the
+single-device sequential-stage reference with identical math
+(tp_axis=None / dense MoE): the dryrun pins one against the other.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..base import MXNetError
+from .mesh import DeviceMesh
+from .pipeline import gpipe_fn, pipeline_apply, stack_stage_params
+from .tp import _PARAM_SPECS, _block_math, _layernorm, init_transformer_params
+from .moe import init_moe_params, moe_ffn, moe_ffn_local
+
+__all__ = ["init_pp_tp_params", "pp_tp_train_step",
+           "init_pp_moe_params", "pp_moe_train_step"]
+
+
+def _sgd(params, grads, lr):
+    return jax.tree_util.tree_map(lambda w, g: w - lr * g, params, grads)
+
+
+# --- dp x tp x pp: pipelined tensor-parallel transformer ------------------
+def init_pp_tp_params(key, num_stages, embed, ffn, num_heads,
+                      dtype=jnp.float32):
+    """Stacked per-stage transformer-block params (leading 'pp' axis)."""
+    keys = jax.random.split(key, num_stages)
+    return stack_stage_params(
+        [init_transformer_params(k, embed, ffn, num_heads, dtype)
+         for k in keys])
+
+
+def pp_tp_train_step(mesh, num_heads, num_microbatches, lr=0.05,
+                     causal=True):
+    """Build (step, oracle_step) for the dp x tp x pp composed mesh.
+
+    step(stacked_params, x, target) -> (new_params, loss): MSE loss on
+    the pipeline output, gradients through the full GPipe schedule with
+    TP psums inside every stage, SGD update.  oracle_step is the
+    sequential single-device reference (same math, tp_axis=None).
+    """
+    if not isinstance(mesh, DeviceMesh):
+        raise MXNetError("mesh must be a parallel.DeviceMesh")
+    for ax in ("tp", "pp"):
+        if ax not in mesh.axes:
+            raise MXNetError(f"mesh has no '{ax}' axis")
+
+    # stage weights: stacked on 'pp', then each leaf's own TP spec
+    specs = {name: P("pp", *spec) for name, spec in _PARAM_SPECS.items()}
+
+    def stage_fn(p, x):
+        return _block_math(x, p, num_heads=num_heads, causal=causal,
+                           tp_axis="tp")
+
+    fwd = gpipe_fn(stage_fn, mesh, num_microbatches, param_specs=specs)
+
+    def loss_fn(stacked, x, target):
+        return ((fwd(stacked, x) - target) ** 2).mean()
+
+    def step(stacked, x, target):
+        loss, grads = jax.value_and_grad(loss_fn)(stacked, x, target)
+        return _sgd(stacked, grads, lr), loss
+
+    def stage_ref(p, x):
+        return _block_math(x, p, num_heads=num_heads, causal=causal,
+                           tp_axis=None)
+
+    def oracle_loss(stacked, x, target):
+        return ((pipeline_apply(stage_ref, stacked, x) - target) ** 2).mean()
+
+    def oracle_step(stacked, x, target):
+        loss, grads = jax.value_and_grad(oracle_loss)(stacked, x, target)
+        return _sgd(stacked, grads, lr), loss
+
+    return step, oracle_step
+
+
+# --- dp x pp x ep: pipelined expert-parallel MoE --------------------------
+def init_pp_moe_params(key, num_stages, d_model, d_hidden, num_experts,
+                       dtype=jnp.float32):
+    """Stacked per-stage {ln_g, ln_b, moe...} params (leading 'pp' axis)."""
+    keys = jax.random.split(key, num_stages)
+    stages = []
+    for k in keys:
+        p = dict(init_moe_params(k, d_model, d_hidden, num_experts, dtype))
+        p["ln_g"] = jnp.ones((d_model,), dtype)
+        p["ln_b"] = jnp.zeros((d_model,), dtype)
+        stages.append(p)
+    return stack_stage_params(stages)
+
+
+def pp_moe_train_step(mesh, num_experts, num_microbatches, tokens_per_call,
+                      lr=0.05):
+    """Build (step, oracle_step) for the dp x pp x ep composed mesh.
+
+    Each pipeline stage is a pre-LN MoE residual block; its all_to_all
+    dispatch/return run over 'ep' inside the pipeline body.  Capacity is
+    sized to admit every token (capacity == local token count) so the
+    sharded program is exactly equal to the dense oracle — the same
+    no-drop contract phase 4 tests for ep in isolation.  The aux
+    (load-balancing) loss is not part of the pinned training loss: the
+    dense oracle routes over the full batch while stages route per
+    microbatch, so their aux terms differ by construction.
+    """
+    if not isinstance(mesh, DeviceMesh):
+        raise MXNetError("mesh must be a parallel.DeviceMesh")
+    for ax in ("ep", "pp"):
+        if ax not in mesh.axes:
+            raise MXNetError(f"mesh has no '{ax}' axis")
+    ep = mesh.size("ep")
+    if num_experts % ep:
+        raise MXNetError(
+            f"num_experts {num_experts} must be a multiple of ep={ep}")
+    capacity = int(tokens_per_call)  # no-drop: every token admitted
+
+    specs = {"wg": P("pp"), "w1": P("pp", "ep"), "b1": P("pp", "ep"),
+             "w2": P("pp", "ep"), "b2": P("pp", "ep"),
+             "ln_g": P("pp"), "ln_b": P("pp")}
+
+    def stage_fn(p, x):
+        mb, s, e = x.shape
+        h = _layernorm(x, p["ln_g"], p["ln_b"])
+        y, _aux = moe_ffn_local(
+            p, h.reshape(mb * s, e), axis="ep", ep=ep,
+            capacity=capacity, num_experts=num_experts)
+        return x + y.reshape(mb, s, e)
+
+    fwd = gpipe_fn(stage_fn, mesh, num_microbatches, param_specs=specs)
+
+    def loss_fn(stacked, x, target):
+        return ((fwd(stacked, x) - target) ** 2).mean()
+
+    def step(stacked, x, target):
+        loss, grads = jax.value_and_grad(loss_fn)(stacked, x, target)
+        return _sgd(stacked, grads, lr), loss
+
+    def stage_ref(p, x):
+        mb, s, e = x.shape
+        h = _layernorm(x, p["ln_g"], p["ln_b"])
+        # capacity_factor=num_experts => dense capacity == token count
+        y, _aux = moe_ffn(p, h.reshape(mb * s, e),
+                          capacity_factor=float(num_experts))
+        return x + y.reshape(mb, s, e)
+
+    def oracle_loss(stacked, x, target):
+        return ((pipeline_apply(stage_ref, stacked, x) - target) ** 2).mean()
+
+    def oracle_step(stacked, x, target):
+        loss, grads = jax.value_and_grad(oracle_loss)(stacked, x, target)
+        return _sgd(stacked, grads, lr), loss
+
+    return step, oracle_step

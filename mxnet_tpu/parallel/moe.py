@@ -96,6 +96,37 @@ def moe_ffn(params, x, capacity_factor=2.0):
     return y, aux
 
 
+def moe_ffn_local(params, xl, *, axis, ep, capacity, num_experts):
+    """Per-device MoE FFN body, for use INSIDE an enclosing shard_map.
+
+    ``params`` are this device's slices (wg replicated, experts' leading
+    dim already E/ep local); ``xl`` is this device's (n_loc, d) tokens.
+    Issues the two ``lax.all_to_all`` collectives over ``axis`` — callers
+    composing MoE with other axes (pipeline stages, dp) just call this
+    from their own shard_map body.  Returns (y_local, pmean'd aux loss).
+    """
+    dispatch, combine, aux = _route(xl, params["wg"], capacity)  # (n,E,C)
+    xe = jnp.einsum("nec,nd->ecd", dispatch, xl)         # (E, C, d)
+    # regroup expert dim by owning device, swap with the device axis:
+    # (ep, E_loc, C, d) -> all_to_all -> (ep, E_loc, C, d) where the
+    # leading dim is now the SOURCE device of the token slots
+    e_loc = xe.shape[0] // ep
+    xe = xe.reshape(ep, e_loc, capacity, xe.shape[-1])
+    xe = lax.all_to_all(xe, axis, split_axis=0, concat_axis=0,
+                        tiled=False)
+    # (ep, E_loc, C, d): local experts, slots from every source dev
+    h = jax.nn.relu(jnp.einsum("secd,edh->sech", xe, params["w1"])
+                    + params["b1"][None, :, None, :])
+    ye = jnp.einsum("sech,ehd->secd", h, params["w2"]) \
+        + params["b2"][None, :, None, :]
+    ye = lax.all_to_all(ye, axis, split_axis=0, concat_axis=0,
+                        tiled=False)
+    ye = ye.reshape(num_experts, capacity, ye.shape[-1])
+    y = jnp.einsum("nec,ecd->nd", combine, ye)
+    # aux loss averages over devices (each routed its own tokens)
+    return y, lax.pmean(aux, axis)
+
+
 def moe_ffn_ep(params, x, mesh, axis="ep", capacity_factor=2.0):
     """Expert-parallel MoE FFN over ``axis``.
 
@@ -116,26 +147,10 @@ def moe_ffn_ep(params, x, mesh, axis="ep", capacity_factor=2.0):
     capacity = max(1, math.ceil(capacity_factor * n_loc / num_experts))
 
     def local(wg, w1, b1, w2, b2, xl):
-        # xl: (n_loc, d); expert params already sharded: (E_loc, ...)
-        dispatch, combine, aux = _route(xl, wg, capacity)    # (n_loc,E,C)
-        xe = jnp.einsum("nec,nd->ecd", dispatch, xl)         # (E, C, d)
-        # regroup expert dim by owning device, swap with the device axis:
-        # (ep, E_loc, C, d) -> all_to_all -> (ep, E_loc, C, d) where the
-        # leading dim is now the SOURCE device of the token slots
-        e_loc = xe.shape[0] // ep
-        xe = xe.reshape(ep, e_loc, capacity, xe.shape[-1])
-        xe = lax.all_to_all(xe, axis, split_axis=0, concat_axis=0,
-                            tiled=False)
-        # (ep, E_loc, C, d): local experts, slots from every source dev
-        h = jax.nn.relu(jnp.einsum("secd,edh->sech", xe, w1)
-                        + b1[None, :, None, :])
-        ye = jnp.einsum("sech,ehd->secd", h, w2) + b2[None, :, None, :]
-        ye = lax.all_to_all(ye, axis, split_axis=0, concat_axis=0,
-                            tiled=False)
-        ye = ye.reshape(num_experts, capacity, ye.shape[-1])
-        y = jnp.einsum("nec,ecd->nd", combine, ye)
-        # aux loss averages over devices (each routed its own tokens)
-        return y, lax.pmean(aux, axis)
+        return moe_ffn_local({"wg": wg, "w1": w1, "b1": b1,
+                              "w2": w2, "b2": b2},
+                             xl, axis=axis, ep=ep, capacity=capacity,
+                             num_experts=num_experts)
 
     pspec_tokens = P(axis)
     pspec_experts = P(axis)

@@ -67,7 +67,8 @@ def pipeline_apply(stage_fn, stacked_params, x):
     return x
 
 
-def gpipe_fn(stage_fn, mesh, num_microbatches, axis="pp", batch_axis="dp"):
+def gpipe_fn(stage_fn, mesh, num_microbatches, axis="pp", batch_axis="dp",
+             param_specs=None):
     """Build the pipelined forward: fn(stacked_params, x) -> y.
 
     stacked_params leaves carry the stage axis first (stack_stage_params),
@@ -75,6 +76,14 @@ def gpipe_fn(stage_fn, mesh, num_microbatches, axis="pp", batch_axis="dp"):
     `num_microbatches` equal microbatches internally (B % M == 0). When the
     mesh also has a `batch_axis` of size > 1, x is additionally sharded
     over it and the pipeline runs per data-parallel shard.
+
+    ``param_specs`` (optional) is a pytree matching stacked_params whose
+    leaves are PartitionSpecs INCLUDING the leading stage axis — e.g.
+    ``P('pp', None, 'tp')`` for a stage weight that is also tensor-
+    parallel.  ``stage_fn`` may then use the extra mesh axes (psum over
+    'tp', all_to_all over 'ep', ...) inside the pipeline body: that is
+    how pp composes with tp/ep in one program.  Default: ``P(axis)`` on
+    every leaf (stage-sharded, otherwise replicated).
 
     Returns a function suitable for jax.jit / jax.grad; the backward
     schedule is derived by autodiff.
@@ -92,7 +101,7 @@ def gpipe_fn(stage_fn, mesh, num_microbatches, axis="pp", batch_axis="dp"):
     x_spec = P(batch_axis) if has_dp else P()
     # every mesh axis must appear in specs or be explicitly replicated;
     # shard_map replicates unmentioned axes by default
-    param_spec = P(axis)
+    param_spec = P(axis) if param_specs is None else param_specs
 
     def shifted(out):
         """One tick's activation hop: stage s sends its output to s+1. The

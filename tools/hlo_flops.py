@@ -51,55 +51,20 @@ def force_cpu_backend():
     jax.config.update("jax_platforms", "cpu")
 
 
-def build_train_step(batch, dtype="bfloat16", layout="NCHW"):
-    """The bench.py train step, importable: returns (jitted_lowerable, args)."""
-    import numpy as np
-    import jax
+def build_train_step(batch, dtype="bfloat16", loss_mode="fused"):
+    """The EXACT bench.py train step (imported, not copied): returns
+    (step_fn, example_args) ready to lower.  loss_mode defaults to
+    "fused" — bench.py's default — so the analysis is of the program
+    being timed; pass "onehot" to reproduce the r2-r4 loss for A/B."""
     import jax.numpy as jnp
-    import mxnet_tpu as mx
-    from mxnet_tpu.gluon.model_zoo import vision
-    from mxnet_tpu.parallel.spmd import functionalize, merge_params, host_cpu_scope
-    from mxnet_tpu.ops import registry as _registry
+    import bench
     from mxnet_tpu import random as _random
-    from mxnet_tpu import autograd as _ag
-    from mxnet_tpu import amp
 
-    if dtype == "bfloat16":
-        amp.init(target_dtype="bfloat16")
-    with host_cpu_scope(), jax.disable_jit():
-        net = vision.resnet50_v1()
-        net.initialize(mx.initializer.Xavier())
-        x_ex = mx.nd.zeros((batch, 3, 224, 224))
-        fb = functionalize(net, x_ex)
-        apply_fn, param_arrays, names = fb
-        x_sds = jax.ShapeDtypeStruct((batch, 3, 224, 224), np.dtype(np.float32))
-        train_idx, aux_list = fb.split_train_aux((x_sds,))
-
+    step, (tparams_h, aparams_h), _n = bench.build_train_step(
+        batch, dtype, use_remat=False, loss_mode=loss_mode)
     compute_dtype = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
-    sgd_attrs = {"lr": 0.01, "wd": 1e-4, "momentum": 0.9, "rescale_grad": 1.0}
-    sgd_mom = _registry.get("sgd_mom_update").fcompute
-
-    def step(key, tparams, aparams, moms, x, y):
-        def loss_fn(tps):
-            ps = merge_params(train_idx, aux_list, tps, aparams)
-            with _ag.train_mode():
-                outs, mutated = apply_fn(key, ps, (x,))
-            logits = outs[0].astype(jnp.float32)
-            logp = jax.nn.log_softmax(logits, axis=-1)
-            oh = jax.nn.one_hot(y.astype(jnp.int32), 1000)
-            return -(oh * logp).sum(axis=-1).mean(), mutated
-
-        (loss, mutated), grads = jax.value_and_grad(loss_fn, has_aux=True)(tparams)
-        new_p, new_m = [], []
-        for w, g, m in zip(tparams, grads, moms):
-            nw, nm = sgd_mom(sgd_attrs, w, g.astype(w.dtype), m)
-            new_p.append(nw)
-            new_m.append(nm)
-        new_aux = tuple(mu.astype(a.dtype) for mu, a in zip(mutated, aparams))
-        return tuple(new_p), new_aux, tuple(new_m), loss
-
-    tparams = tuple(jnp.asarray(param_arrays[i]) for i in train_idx)
-    aparams = tuple(jnp.asarray(param_arrays[i]) for i in aux_list)
+    tparams = tuple(jnp.asarray(p) for p in tparams_h)
+    aparams = tuple(jnp.asarray(p) for p in aparams_h)
     moms = tuple(jnp.zeros_like(p) for p in tparams)
     x = jnp.zeros((batch, 3, 224, 224), compute_dtype)
     y = jnp.zeros((batch,), jnp.float32)
@@ -219,6 +184,8 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=32)
     ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--loss", default="fused", choices=["fused", "onehot"],
+                    help="loss path; 'fused' matches bench.py's default")
     ap.add_argument("--json", default=None)
     ap.add_argument("--top", type=int, default=25)
     ap.add_argument("--dump-hlo", default=None, help="write optimized HLO here")
@@ -233,7 +200,8 @@ def main():
     else:
         force_cpu_backend()
         import jax
-        step, step_args = build_train_step(args.batch, args.dtype)
+        step, step_args = build_train_step(args.batch, args.dtype,
+                                           loss_mode=args.loss)
         print("lowering + compiling ...", file=sys.stderr, flush=True)
         compiled = jax.jit(step).lower(*step_args).compile()
         hlo = compiled.as_text()
@@ -256,14 +224,24 @@ def main():
     fwd_analytic = 7.72e9 * args.batch
 
     b = args.batch
+    # ResNet-50 feature-map sizes: an activation-shaped conv output has
+    # batch leading AND at least one spatial dim from this set; wgrad
+    # outputs are weight-shaped ([Cin,kh,kw,Cout] etc.) and have neither
+    # when b collides with a channel count (64/128/256/512...).
+    spatial = {7, 14, 28, 56, 112}
+
+    def is_act_conv(c):
+        return c["out"][0] == b and any(d in spatial for d in c["out"][1:])
+
     dil = [c for c in convs if c["lhs_dilated"]]
-    fwd_c = [c for c in convs if not c["lhs_dilated"] and c["out"][0] == b]
-    wg_c = [c for c in convs if not c["lhs_dilated"] and c["out"][0] != b]
+    fwd_c = [c for c in convs if not c["lhs_dilated"] and is_act_conv(c)]
+    wg_c = [c for c in convs if not c["lhs_dilated"] and not is_act_conv(c)]
     # activation dots have batch * spatial-extent leading rows, where the
     # spatial extent is one of ResNet-50's feature-map sizes (1 for the
     # FC fwd [b,1000] / dgrad [b,2048]).  FC wgrad [2048,1000] has
     # weight-shaped rows (2048/b is not a feature-map size) -> weight-out.
-    spatial_sizes = {1, 7 * 7, 14 * 14, 28 * 28, 56 * 56, 112 * 112}
+    spatial_sizes = {1, 7 * 7, 14 * 14, 28 * 28, 56 * 56, 112 * 112,
+                     224 * 224}
 
     def is_act_dot(d):
         rows = d["out"][0]
