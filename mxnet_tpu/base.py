@@ -19,6 +19,44 @@ class MXNetError(RuntimeError):
     """Error raised by the runtime (parity: MXNetError in python/mxnet/base.py)."""
 
 
+# TPU integer-width contract -------------------------------------------------
+# The backend narrows int64 to int32 (TPU integer units are 32-bit; the
+# reference builds with int64 tensor indexing, tests/nightly/
+# test_large_array.py).  That narrowing is a documented deviation, but it
+# must be LOUD: any size, dim, or index beyond int32 raises MXNetError at
+# the API boundary instead of letting JAX truncate with a warning.
+INT32_MAX = 2 ** 31 - 1
+
+
+def check_int32_range(value, what):
+    """Raise MXNetError when ``value`` cannot be represented as int32."""
+    if value > INT32_MAX:
+        raise MXNetError(
+            f"{what} {value} exceeds the int32 limit {INT32_MAX}: the "
+            "TPU backend uses 32-bit integer indexing (large-tensor int64 "
+            "support is a documented deviation, docs/env_var.md); "
+            "refusing to truncate silently")
+    return value
+
+
+def check_shape_int32(shape, allow_wildcards=False, what="array"):
+    """Validate every dim AND the total element count against int32.
+
+    The single guard behind the creation APIs (zeros/ones/full/array),
+    NDArray.reshape, and the host-parameterized generators (arange /
+    linspace).  ``allow_wildcards`` skips non-positive dims (reshape's
+    0/-1/-2.. placeholders).  Returns the validated element count.
+    """
+    size = 1
+    for d in shape:
+        d = int(d)
+        if d <= 0 and allow_wildcards:
+            continue
+        size *= check_int32_range(d, "dimension")
+    check_int32_range(size, f"{what} size")
+    return size
+
+
 # dtype handling -------------------------------------------------------------
 # The reference maps int codes <-> numpy dtypes (mshadow type codes). We keep
 # the same code assignment for checkpoint compatibility (NDArray binary format

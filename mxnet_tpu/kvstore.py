@@ -417,6 +417,27 @@ class KVStoreDist(KVStore):
                     k, tuple(self._store[k].shape), int(rec[0]))
                 self._chunked[k] = layout if len(layout) > 1 else None
 
+    def attach(self, key, value):
+        """Adopt already-initialized server state for ``key`` WITHOUT the
+        init barrier — the elastic-resume path.
+
+        ``init`` ends in a full-group barrier, which can never complete
+        for a replacement worker joining after its peers initialized (or
+        exited): the round-5 failure-recovery contract (kvstore.h:353
+        dead-node surfacing) needs rejoining workers to come up solo.
+        ``value`` supplies only the shape/dtype for the local layout
+        record; the live weights stay whatever the server holds.
+        """
+        if self._client is None:
+            return super().init(key, value)
+        keys, values = _key_value(key, value)
+        for k, v in zip(keys, values):
+            self._store[k] = v.copy()
+            rec = self._client.pull_many([f"__layout__{k}"])[0]
+            layout = self._layout_from_rows_per(
+                k, tuple(v.shape), int(rec[0]))
+            self._chunked[k] = layout if len(layout) > 1 else None
+
     def push(self, key, value, priority=0):
         if self._client is None:
             return super().push(key, value, priority)

@@ -18,7 +18,7 @@ import jax.numpy as jnp
 
 from .. import autograd, engine
 from .. import random as _random
-from ..base import MXNetError, np_dtype
+from ..base import MXNetError, check_int32_range, check_shape_int32, np_dtype
 from ..context import Context, cpu, current_context
 from ..ops import registry as _registry
 
@@ -252,6 +252,7 @@ class NDArray:
         if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
             shape = tuple(shape[0])
         shape = kwargs.get("shape", shape)
+        check_shape_int32(shape, allow_wildcards=True, what="reshaped")
         return invoke("reshape", [self], {"shape": tuple(shape)})
 
     def reshape_like(self, other):
@@ -692,6 +693,7 @@ def array(source, ctx=None, dtype=None):
         return NDArray(jax.device_put(src, ctx.jax_device), ctx)
     is_np = isinstance(source, np.ndarray)
     a = np.asarray(source)
+    check_int32_range(a.size, "array size")
     if dtype is None:
         # parity: lists default to float32; numpy arrays keep their dtype
         # (float64 narrowed — TPUs have no f64 by default)
@@ -704,6 +706,7 @@ def _creation(opname, shape, ctx, dtype, **extra):
     ctx = ctx or current_context()
     if isinstance(shape, (int, np.integer)):
         shape = (shape,)
+    check_shape_int32(shape)
     attrs = {"shape": tuple(shape), "dtype": np_dtype(dtype).name, **extra}
     op = _registry.get(opname)
     fn, _ = op.bind(**attrs)

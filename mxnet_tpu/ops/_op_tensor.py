@@ -557,11 +557,18 @@ def _eye(attrs):
 
 @register("_arange")
 def _arange(attrs):
-    from ..base import np_dtype
-    out = jnp.arange(attrs.get("start", 0), attrs.get("stop", None),
-                     attrs.get("step", 1.0),
-                     dtype=np_dtype(attrs.get("dtype", "float32")))
+    from ..base import check_int32_range, np_dtype
+    import math as _math
+    start = float(attrs.get("start", 0))
+    stop = attrs.get("stop", None)
+    step = float(attrs.get("step", 1.0))
     repeat = int(attrs.get("repeat", 1))
+    if step:  # host-parameterized size: guard it (stop=None => [0, start))
+        hi, lo = (float(stop), start) if stop is not None else (start, 0.0)
+        count = max(0, _math.ceil((hi - lo) / step))
+        check_int32_range(count * max(repeat, 1), "arange length")
+    out = jnp.arange(start, stop, step,
+                     dtype=np_dtype(attrs.get("dtype", "float32")))
     if repeat > 1:
         out = jnp.repeat(out, repeat)
     return out
@@ -569,16 +576,33 @@ def _arange(attrs):
 
 @register("_linspace")
 def _linspace(attrs):
-    from ..base import np_dtype
-    return jnp.linspace(attrs["start"], attrs["stop"], int(attrs["num"]),
+    from ..base import check_int32_range, np_dtype
+    num = check_int32_range(int(attrs["num"]), "linspace length")
+    return jnp.linspace(attrs["start"], attrs["stop"], num,
                         endpoint=bool(attrs.get("endpoint", True)),
                         dtype=np_dtype(attrs.get("dtype", "float32")))
 
 
 register("zeros_like")(lambda attrs, x: jnp.zeros_like(x))
 register("ones_like")(lambda attrs, x: jnp.ones_like(x))
-register("shape_array")(lambda attrs, x: jnp.asarray(x.shape, dtype=jnp.int64))
-register("size_array")(lambda attrs, x: jnp.asarray([x.size], dtype=jnp.int64))
+
+
+@register("shape_array")
+def _shape_array(attrs, x):
+    # the reference emits int64 (src/operator/tensor/elemwise_unary_op.h
+    # ShapeComputeCPU); this backend narrows to int32 — LOUDLY: any dim
+    # beyond int32 raises instead of letting JAX truncate with a warning
+    from ..base import check_int32_range
+    for d in x.shape:
+        check_int32_range(int(d), "dimension")
+    return jnp.asarray(x.shape, dtype=jnp.int32)
+
+
+@register("size_array")
+def _size_array(attrs, x):
+    from ..base import check_int32_range
+    check_int32_range(int(x.size), "array size")
+    return jnp.asarray([x.size], dtype=jnp.int32)
 
 
 @register("diag")

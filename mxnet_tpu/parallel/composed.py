@@ -67,6 +67,9 @@ def pp_tp_train_step(mesh, num_heads, num_microbatches, lr=0.05,
     for ax in ("tp", "pp"):
         if ax not in mesh.axes:
             raise MXNetError(f"mesh has no '{ax}' axis")
+    if num_heads % mesh.size("tp"):
+        raise MXNetError(f"num_heads {num_heads} not divisible by "
+                         f"tp={mesh.size('tp')}")
 
     # stage weights: stacked on 'pp', then each leaf's own TP spec
     specs = {name: P("pp", *spec) for name, spec in _PARAM_SPECS.items()}
@@ -112,12 +115,12 @@ def init_pp_moe_params(key, num_stages, d_model, d_hidden, num_experts,
     return stack_stage_params(stages)
 
 
-def pp_moe_train_step(mesh, num_experts, num_microbatches, tokens_per_call,
-                      lr=0.05):
+def pp_moe_train_step(mesh, num_experts, num_microbatches, lr=0.05):
     """Build (step, oracle_step) for the dp x pp x ep composed mesh.
 
     Each pipeline stage is a pre-LN MoE residual block; its all_to_all
     dispatch/return run over 'ep' inside the pipeline body.  Capacity is
+    derived from the (static) microbatch shape inside the stage and
     sized to admit every token (capacity == local token count) so the
     sharded program is exactly equal to the dense oracle — the same
     no-drop contract phase 4 tests for ep in isolation.  The aux
@@ -134,7 +137,6 @@ def pp_moe_train_step(mesh, num_experts, num_microbatches, tokens_per_call,
     if num_experts % ep:
         raise MXNetError(
             f"num_experts {num_experts} must be a multiple of ep={ep}")
-    capacity = int(tokens_per_call)  # no-drop: every token admitted
 
     specs = {"wg": P("pp"), "w1": P("pp", "ep"), "b1": P("pp", "ep"),
              "w2": P("pp", "ep"), "b2": P("pp", "ep"),
@@ -145,7 +147,7 @@ def pp_moe_train_step(mesh, num_experts, num_microbatches, tokens_per_call,
         h = _layernorm(x, p["ln_g"], p["ln_b"])
         y, _aux = moe_ffn_local(
             p, h.reshape(mb * s, e), axis="ep", ep=ep,
-            capacity=capacity, num_experts=num_experts)
+            capacity=mb * s, num_experts=num_experts)
         return x + y.reshape(mb, s, e)
 
     fwd = gpipe_fn(stage_fn, mesh, num_microbatches, param_specs=specs)

@@ -47,8 +47,7 @@ def test_pp_moe_composed_train_step_matches_oracle():
     stacked = init_pp_moe_params(jax.random.PRNGKey(2), 2, e, 12, E)
     x = jnp.asarray(rng.randn(B, seq, e).astype(np.float32))
     t = jnp.asarray(rng.randn(B, seq, e).astype(np.float32))
-    tokens_per_call = (B // (2 * M)) * seq
-    step, oracle = pp_moe_train_step(mesh, E, M, tokens_per_call)
+    step, oracle = pp_moe_train_step(mesh, E, M)
     new_p, loss = jax.jit(step)(stacked, x, t)
     ref_p, ref_loss = jax.jit(oracle)(stacked, x, t)
     assert abs(float(loss) - float(ref_loss)) < 1e-6 * max(
@@ -61,4 +60,4 @@ def test_pp_tp_requires_axes():
     with pytest.raises(MXNetError, match="'tp'"):
         pp_tp_train_step(make_mesh(dp=4, pp=2), 2, 2)
     with pytest.raises(MXNetError, match="'ep'"):
-        pp_moe_train_step(make_mesh(dp=4, pp=2), 4, 2, 8)
+        pp_moe_train_step(make_mesh(dp=4, pp=2), 4, 2)

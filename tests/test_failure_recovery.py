@@ -1,0 +1,228 @@
+"""Failure detection + recovery and async staleness (round-5, VERDICT
+item 9; parity targets: include/mxnet/kvstore.h:353 dead-node surfacing
+and tests/nightly/dist_async_kvstore.py).
+
+Two end-to-end multi-process scenarios over the real TCP PS transport:
+
+* a worker is SIGKILLed mid-train; the server's heartbeat tracker must
+  report it dead; a replacement worker resumes from the rank-0
+  checkpoint and training converges anyway;
+* two dist_async workers run at deliberately different rates (one
+  sleeps per step, one free-runs) so pushes interleave with real
+  staleness — convergence must survive it.
+"""
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx  # noqa: F401  (pins the CPU backend via conftest)
+
+TARGET = [0.5, -1.25, 2.0, 0.125]
+
+
+def _worker_env(port, rank, num_workers):
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # children must not dial the TPU
+    env["JAX_PLATFORMS"] = "cpu"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(DMLC_RANK=str(rank), DMLC_NUM_WORKER=str(num_workers),
+               DMLC_PS_ROOT_URI="127.0.0.1", DMLC_PS_ROOT_PORT=str(port),
+               MXNET_KVSTORE_HEARTBEAT_INTERVAL="0.2")
+    return env
+
+
+_TRAIN_WORKER = """
+import json, os, sys, time
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import kvstore as kvs
+from mxnet_tpu import nd
+
+rank = int(os.environ["DMLC_RANK"])
+steps = int(sys.argv[1])
+ckpt = sys.argv[2]
+out = sys.argv[3]
+resume_from = int(sys.argv[4])  # 0 = fresh start
+target = np.array(%(target)s, np.float32)
+
+kv = kvs.create("dist_async")
+start = 0
+if resume_from:
+    # elastic resume: attach() adopts server state without the init
+    # barrier (peers may have moved on or exited); step counter + params
+    # come from the rank-0 checkpoint
+    kv.attach("w", nd.zeros((4,)))
+    saved = nd.load(ckpt)
+    meta = json.load(open(ckpt + ".meta"))
+    start = int(meta["step"])
+    assert np.isfinite(saved["w"].asnumpy()).all()
+else:
+    kv.init("w", nd.zeros((4,)))
+    # the server keeps the optimizer across worker restarts, and
+    # set_optimizer barriers the full group — fresh workers only
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.05))
+
+w = nd.zeros((4,))
+for step in range(start, steps):
+    kv.pull("w", out=w)
+    grad = 2.0 * (w.asnumpy() - target)
+    kv.push("w", nd.array(grad))
+    if rank == 0:
+        nd.save(ckpt, {"w": w})
+        with open(ckpt + ".meta", "w") as f:
+            json.dump({"step": step + 1}, f)
+    time.sleep(0.04)
+kv.pull("w", out=w)
+np.save(out, w.asnumpy())
+"""
+
+
+def test_worker_sigkill_detected_and_training_resumes(tmp_path):
+    from mxnet_tpu.kvstore_server import KVClient, KVServer
+    port = 19671
+    num_workers = 2
+    steps = 40
+    server = KVServer(port=port, num_workers=num_workers)
+    threading.Thread(target=server.run, daemon=True).start()
+    time.sleep(0.2)
+
+    script = str(tmp_path / "train_worker.py")
+    with open(script, "w") as f:
+        f.write(_TRAIN_WORKER % {"target": TARGET})
+    ckpt = str(tmp_path / "ckpt.params")
+    outs = [str(tmp_path / f"w{r}.npy") for r in range(num_workers)]
+
+    def spawn(rank, resume):
+        return subprocess.Popen(
+            [sys.executable, script, str(steps), ckpt, outs[rank],
+             str(int(resume))],
+            env=_worker_env(port, rank, num_workers))
+
+    monitor = None
+    procs = [spawn(0, False), spawn(1, False)]
+    try:
+        monitor = KVClient("127.0.0.1", port, rank=0, num_workers=2,
+                           heartbeat_interval=0)
+        # let training get going, then SIGKILL rank 1 mid-train
+        deadline = time.time() + 20
+        while not os.path.exists(ckpt + ".meta"):
+            assert time.time() < deadline, "training never started"
+            time.sleep(0.1)
+        time.sleep(0.5)
+        procs[1].kill()          # SIGKILL: no cleanup, heartbeats stop
+        procs[1].wait(timeout=10)
+
+        # failure DETECTION: the stale heartbeat surfaces as a dead node
+        deadline = time.time() + 15
+        while monitor.num_dead_node(timeout=1.0) < 1:
+            assert time.time() < deadline, \
+                "dead worker never detected via heartbeats"
+            time.sleep(0.2)
+
+        # RECOVERY: a replacement rank-1 worker resumes from checkpoint
+        # (per-rank heartbeat revival itself is pinned by
+        # test_heartbeat_dead_node_detection; after graceful completion
+        # every rank's heartbeat goes stale again by design, so the
+        # aggregate count cannot distinguish 'replacement alive' once
+        # rank 0 finishes)
+        import json
+        kill_step = json.load(open(ckpt + ".meta"))["step"]
+        procs[1] = spawn(1, True)
+        for p in procs:
+            assert p.wait(timeout=120) == 0
+        # the run really CONTINUED from the checkpoint: rank 0 kept
+        # checkpointing past the step at which rank 1 was killed
+        assert json.load(open(ckpt + ".meta"))["step"] >= kill_step
+        assert json.load(open(ckpt + ".meta"))["step"] == steps
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        if monitor is not None:
+            try:
+                monitor.close()
+            except Exception:
+                pass
+        server._stop.set()
+
+    # convergence despite the mid-train kill: both survivors agree and
+    # landed at the quadratic loss minimum
+    final = [np.load(o) for o in outs]
+    np.testing.assert_allclose(final[0], TARGET, atol=0.05)
+    np.testing.assert_allclose(final[1], TARGET, atol=0.05)
+
+
+_STALENESS_WORKER = """
+import os, sys, time
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import kvstore as kvs
+from mxnet_tpu import nd
+
+rank = int(os.environ["DMLC_RANK"])
+steps = int(sys.argv[1])
+sleep_s = float(sys.argv[2])
+out = sys.argv[3]
+target = np.array(%(target)s, np.float32)
+
+kv = kvs.create("dist_async")
+kv.init("w", nd.zeros((4,)))
+kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.04))
+w = nd.zeros((4,))
+t0 = time.time()
+for step in range(steps):
+    kv.pull("w", out=w)
+    grad = 2.0 * (w.asnumpy() - target)
+    kv.push("w", nd.array(grad))
+    if sleep_s:
+        time.sleep(sleep_s)
+elapsed = time.time() - t0
+kv.barrier()
+kv.pull("w", out=w)
+np.save(out, w.asnumpy())
+with open(out + ".rate", "w") as f:
+    f.write(str(steps / max(elapsed, 1e-9)))
+"""
+
+
+def test_dist_async_staleness_different_rates(tmp_path):
+    """Workers at deliberately different speeds (one sleeps 60ms/step, one
+    free-runs 3x the steps) interleave stale pushes; dist_async must still
+    converge (parity: tests/nightly/dist_async_kvstore.py intent)."""
+    from mxnet_tpu.kvstore_server import KVServer
+    port = 19683
+    server = KVServer(port=port, num_workers=2)
+    threading.Thread(target=server.run, daemon=True).start()
+    time.sleep(0.2)
+
+    script = str(tmp_path / "stale_worker.py")
+    with open(script, "w") as f:
+        f.write(_STALENESS_WORKER % {"target": TARGET})
+    outs = [str(tmp_path / f"s{r}.npy") for r in range(2)]
+    plans = [(20, 0.06), (60, 0.0)]  # (steps, sleep): slow vs fast
+    procs = [subprocess.Popen(
+        [sys.executable, script, str(steps), str(sl), outs[r]],
+        env=_worker_env(port, r, 2))
+        for r, (steps, sl) in enumerate(plans)]
+    try:
+        for p in procs:
+            assert p.wait(timeout=120) == 0
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        server._stop.set()
+
+    rates = [float(open(o + ".rate").read()) for o in outs]
+    assert rates[1] > rates[0] * 1.5, \
+        f"rates did not actually diverge: {rates}"
+    final = [np.load(o) for o in outs]
+    # after the barrier both workers see the same converged state
+    np.testing.assert_array_equal(final[0], final[1])
+    np.testing.assert_allclose(final[0], TARGET, atol=0.05)

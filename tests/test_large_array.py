@@ -1,0 +1,48 @@
+"""int32-boundary gate (bounded analog of the reference's
+tests/nightly/test_large_array.py).
+
+The TPU backend narrows integer indexing to 32 bits — a documented
+deviation — but the narrowing must be LOUD: any size/dim/index beyond
+2^31-1 raises MXNetError at the API boundary (round-5 fix; previously
+JAX truncated silently with a warning). These tests exercise the guard
+WITHOUT allocating large arrays: every failing call must raise before
+any buffer is created."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.base import INT32_MAX, MXNetError, check_int32_range
+
+
+def test_check_int32_range_boundary():
+    assert check_int32_range(INT32_MAX, "x") == INT32_MAX
+    with pytest.raises(MXNetError, match="int32 limit"):
+        check_int32_range(INT32_MAX + 1, "x")
+
+
+def test_creation_beyond_int32_raises_before_alloc():
+    for shape in [(2 ** 31,), (2 ** 16, 2 ** 16), (1, 2 ** 40)]:
+        with pytest.raises(MXNetError, match="int32 limit"):
+            nd.zeros(shape)
+        with pytest.raises(MXNetError, match="int32 limit"):
+            nd.ones(shape)
+        with pytest.raises(MXNetError, match="int32 limit"):
+            nd.full(shape, 3.0)
+
+
+def test_reshape_beyond_int32_raises():
+    x = nd.zeros((4,))
+    with pytest.raises(MXNetError, match="int32 limit"):
+        x.reshape((2 ** 31 + 8,))
+    # wildcard dims stay usable
+    assert x.reshape((-1, 2)).shape == (2, 2)
+
+
+def test_boundary_sizes_still_work():
+    # sizes comfortably inside the limit are untouched
+    x = nd.zeros((1024, 1024))
+    assert x.shape == (1024, 1024)
+    s = nd.shape_array(x) if hasattr(nd, "shape_array") else None
+    if s is not None:
+        np.testing.assert_array_equal(s.asnumpy(), [1024, 1024])

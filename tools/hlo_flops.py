@@ -224,14 +224,21 @@ def main():
     fwd_analytic = 7.72e9 * args.batch
 
     b = args.batch
-    # ResNet-50 feature-map sizes: an activation-shaped conv output has
-    # batch leading AND at least one spatial dim from this set; wgrad
-    # outputs are weight-shaped ([Cin,kh,kw,Cout] etc.) and have neither
-    # when b collides with a channel count (64/128/256/512...).
-    spatial = {7, 14, 28, 56, 112}
+    # ResNet-50 activation conv outputs are [b, H, W, C] (or NCHW): batch
+    # leading, a feature-map spatial size present, AND a channel count
+    # present.  Wgrad outputs are weight-shaped — [Cin, kh, kw, Cout] etc.
+    # — which can collide with b on the leading dim (b=64/128/256/512) and
+    # with the spatial set via 7x7 kernels ([64,3,7,7] at b=64), but never
+    # carry a {spatial, channel} pair like an activation does (the only
+    # 3-channel tensor is the input itself, which is not a conv output).
+    spatial = {7, 14, 28, 56, 112, 224}
+    channels = {3, 64, 128, 256, 512, 1024, 2048}
 
     def is_act_conv(c):
-        return c["out"][0] == b and any(d in spatial for d in c["out"][1:])
+        dims = c["out"]
+        return (dims[0] == b
+                and any(d in spatial for d in dims[1:])
+                and any(d in channels for d in dims[1:]))
 
     dil = [c for c in convs if c["lhs_dilated"]]
     fwd_c = [c for c in convs if not c["lhs_dilated"] and is_act_conv(c)]
