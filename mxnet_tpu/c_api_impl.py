@@ -529,3 +529,471 @@ def version():
     from . import __version__
     return int("".join(f"{int(x):02d}" for x in
                        __version__.split(".")[:3]))
+
+
+# --- sparse NDArray (round-5; parity: c_api.h MXNDArrayCreateSparseEx:577,
+# SyncCopyFromNDArray:693, GetStorageType:756, GetAuxType:885,
+# GetAuxNDArray:894, GetDataNDArray:903, SyncCheckFormat:702) -------------
+# storage-type ids: python/mxnet/ndarray/sparse.py _STORAGE_TYPE_STR_TO_ID
+_STYPE_BY_ID = {0: "default", 1: "row_sparse", 2: "csr"}
+_ID_BY_STYPE = {v: k for k, v in _STYPE_BY_ID.items()}
+
+
+def ndarray_create_sparse(stype_id, shape, dev_type, dev_id, dtype_code):
+    from .ndarray import sparse as sp
+    stype = _STYPE_BY_ID.get(int(stype_id))
+    if stype not in ("row_sparse", "csr"):
+        raise ValueError(f"unsupported storage type id {stype_id}")
+    dtype = _DTYPE_BY_CODE.get(dtype_code, np.float32)
+    return sp.zeros(stype, tuple(int(d) for d in shape),
+                    ctx=_ctx(dev_type, dev_id), dtype=dtype)
+
+
+def ndarray_storage_type(arr):
+    return _ID_BY_STYPE.get(getattr(arr, "stype", "default"), 0)
+
+
+def _aux_fields(arr):
+    """Aux slots in the reference's order (row_sparse: [idx]; csr:
+    [indptr, idx] — include/mxnet/ndarray.h rowsparse::kIdx/csr::kIndPtr)."""
+    from .ndarray import sparse as sp
+    if isinstance(arr, sp.RowSparseNDArray):
+        return ["_indices"]
+    if isinstance(arr, sp.CSRNDArray):
+        return ["_indptr", "_indices"]
+    raise ValueError("not a sparse NDArray")
+
+
+def ndarray_sync_copy_from_ndarray(dst, src, i):
+    """i == -1 copies the data blob, i >= 0 the ith aux blob; sparse
+    arrays here are rebuilt field-wise (the staging path C bindings use
+    to construct a sparse array slot by slot)."""
+    import jax.numpy as jnp
+    from .ndarray import sparse as sp
+    val = jnp.asarray(src._data)
+    if int(i) < 0:
+        # dense targets copy exactly; sparse .data blobs may change their
+        # nnz leading dim but must keep the per-row shape (row_sparse) /
+        # stay rank-1 (csr) — the reference errors on mismatch too
+        if isinstance(dst, sp.RowSparseNDArray):
+            if tuple(val.shape[1:]) != tuple(dst._full_shape[1:]):
+                raise ValueError(
+                    f"row_sparse data row shape {val.shape[1:]} != "
+                    f"{dst._full_shape[1:]}")
+        elif isinstance(dst, sp.CSRNDArray):
+            if val.ndim != 1:
+                raise ValueError("csr data blob must be rank-1")
+        elif tuple(val.shape) != tuple(dst.shape):
+            raise ValueError(
+                f"shape mismatch: dst {tuple(dst.shape)} vs src "
+                f"{tuple(val.shape)}")
+        dst._data = val
+    else:
+        setattr(dst, _aux_fields(dst)[int(i)], val.astype(jnp.int32))
+    return True
+
+
+def ndarray_get_aux_type(arr, i):
+    import numpy as _np
+    field = getattr(arr, _aux_fields(arr)[int(i)])
+    # the reference stores aux indices as int64; we narrow to int32 by
+    # the documented TPU deviation but report the real dtype
+    return _CODE_BY_DTYPE[_np.dtype(_np.asarray(field).dtype).name]
+
+
+def ndarray_get_aux_ndarray(arr, i):
+    from .ndarray.ndarray import NDArray
+    import jax.numpy as jnp
+    return NDArray(jnp.asarray(getattr(arr, _aux_fields(arr)[int(i)])),
+                   arr._ctx)
+
+
+def ndarray_get_data_ndarray(arr):
+    from .ndarray.ndarray import NDArray
+    import jax.numpy as jnp
+    return NDArray(jnp.asarray(arr._data), arr._ctx)
+
+
+def ndarray_check_format(arr, full_check):
+    """Raise on malformed sparse arrays (parity: MXNDArraySyncCheckFormat;
+    the reference checks idx sorted/unique/in-range, indptr monotone)."""
+    from .ndarray import sparse as sp
+    from .base import MXNetError
+    if isinstance(arr, sp.RowSparseNDArray):
+        idx = np.asarray(arr._indices)
+        if idx.ndim != 1 or np.asarray(arr._data).shape[0] != idx.shape[0]:
+            raise MXNetError("row_sparse: data rows != len(indices)")
+        if full_check and idx.size:
+            if (np.diff(idx) <= 0).any():
+                raise MXNetError("row_sparse: indices not sorted unique")
+            if idx[0] < 0 or idx[-1] >= arr.shape[0]:
+                raise MXNetError("row_sparse: index out of range")
+    elif isinstance(arr, sp.CSRNDArray):
+        indptr = np.asarray(arr._indptr)
+        idx = np.asarray(arr._indices)
+        if indptr.shape[0] != arr.shape[0] + 1:
+            raise MXNetError("csr: len(indptr) != rows+1")
+        if full_check:
+            if (np.diff(indptr) < 0).any() or indptr[0] != 0 or \
+                    int(indptr[-1]) != idx.shape[0]:
+                raise MXNetError("csr: indptr not monotone / nnz mismatch")
+            if idx.size and (idx.min() < 0 or idx.max() >= arr.shape[1]):
+                raise MXNetError("csr: column index out of range")
+    return True
+
+
+# --- kvstore updater from C (parity: MXKVStoreSetUpdater c_api.h:2503) ----
+def kvstore_set_updater(kv, fn_addr, ctx_addr, str_keys):
+    """Install a C callback as the kvstore updater.
+
+    The C function pointer is called through ctypes; recv/local cross as
+    NDArrayHandles (PyObject*, exactly what the rest of the C API hands
+    out), so the callback updates weights by calling back into C API
+    functions (e.g. MXImperativeInvokeEx writing into `local`).
+    """
+    import ctypes
+    if str_keys:
+        CB = ctypes.CFUNCTYPE(None, ctypes.c_char_p, ctypes.c_void_p,
+                              ctypes.c_void_p, ctypes.c_void_p)
+    else:
+        CB = ctypes.CFUNCTYPE(None, ctypes.c_int, ctypes.c_void_p,
+                              ctypes.c_void_p, ctypes.c_void_p)
+    cb = CB(fn_addr)
+
+    def updater(key, recv, stored):
+        # id(obj) is the PyObject* address in CPython — the same value
+        # the C shim uses as NDArrayHandle.  The refs stay alive for the
+        # duration of the call via the closure arguments.
+        k = str(key).encode() if str_keys else int(key)
+        cb(k, ctypes.c_void_p(id(recv)), ctypes.c_void_p(id(stored)),
+           ctypes.c_void_p(ctx_addr))
+
+    kv._set_updater(updater)
+    kv._c_updater_keepalive = (cb, updater)  # outlive the C call
+    return True
+
+
+# --- executor monitor callback (parity: MXExecutorSetMonitorCallback
+# c_api.h:2170) ------------------------------------------------------------
+def executor_set_monitor_callback(ex, fn_addr, ctx_addr, monitor_all):
+    import ctypes
+    CB = ctypes.CFUNCTYPE(None, ctypes.c_char_p, ctypes.c_void_p,
+                          ctypes.c_void_p)
+    cb = CB(fn_addr)
+
+    def monitor(name, arr):
+        cb(str(name).encode(), ctypes.c_void_p(id(arr)),
+           ctypes.c_void_p(ctx_addr))
+
+    ex.set_monitor_callback(monitor, monitor_all=bool(monitor_all))
+    ex._c_monitor_keepalive = (cb, monitor)
+    return True
+
+
+# --- custom op registration from C (parity: MXCustomOpRegister
+# c_api.h:2745 + src/operator/custom/custom.cc callback protocol) ---------
+def custom_op_register(op_type, creator_addr):
+    """Register a C plugin op under ``op_type``.
+
+    The C side supplies a CustomOpPropCreator; its MXCallbackList entries
+    (CustomOpPropCallbacks enum order) are wrapped into a CustomOpProp
+    subclass, so a C-registered op flows through the SAME host machinery
+    as Python custom ops (operator.py): imperative, traced
+    (pure_callback) and gradient paths included.  Callback results use
+    the reference convention: nonzero return = success.
+    """
+    import ctypes
+    from . import operator as opmod
+
+    GEN = ctypes.CFUNCTYPE(ctypes.c_int)
+
+    class MXCallbackList(ctypes.Structure):
+        _fields_ = [("num_callbacks", ctypes.c_int),
+                    ("callbacks", ctypes.POINTER(GEN)),
+                    ("contexts", ctypes.POINTER(ctypes.c_void_p))]
+
+    CREATOR = ctypes.CFUNCTYPE(
+        ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_char_p),
+        ctypes.POINTER(MXCallbackList))
+    LIST = ctypes.CFUNCTYPE(ctypes.c_int,
+                            ctypes.POINTER(ctypes.POINTER(ctypes.c_char_p)),
+                            ctypes.c_void_p)
+    INFERSHAPE = ctypes.CFUNCTYPE(
+        ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_int)), ctypes.c_void_p)
+    CREATEOP = ctypes.CFUNCTYPE(
+        ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint)),
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(MXCallbackList), ctypes.c_void_p)
+    FB = ctypes.CFUNCTYPE(
+        ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+        ctypes.c_int, ctypes.c_void_p)
+
+    # CustomOpPropCallbacks / CustomOpCallbacks enum indices (c_api.h:158+)
+    PROP_LIST_ARGS, PROP_LIST_OUTS = 1, 2
+    PROP_INFER_SHAPE, PROP_CREATE_OP = 4, 6
+    OP_FORWARD, OP_BACKWARD = 1, 2
+    REQ_CODE = {"null": 0, "write": 1, "inplace": 2, "add": 3}
+
+    creator = CREATOR(creator_addr)
+
+    def _entry(cblist, idx, ftype):
+        if idx >= cblist.num_callbacks or not cblist.callbacks[idx]:
+            return None, None
+        fn = ctypes.cast(cblist.callbacks[idx], ftype)
+        return fn, cblist.contexts[idx]
+
+    def _call_list(cblist, idx):
+        fn, ctx = _entry(cblist, idx, LIST)
+        arr = ctypes.POINTER(ctypes.c_char_p)()
+        if not fn or not fn(ctypes.byref(arr), ctx):
+            raise RuntimeError(f"{op_type}: list callback failed")
+        names, i = [], 0
+        while arr[i]:
+            names.append(arr[i].decode())
+            i += 1
+        return names
+
+    class CProp(opmod.CustomOpProp):
+        def __init__(self, **kwargs):
+            super().__init__()
+            n = len(kwargs)
+            keys = (ctypes.c_char_p * max(n, 1))(
+                *[k.encode() for k in kwargs])
+            vals = (ctypes.c_char_p * max(n, 1))(
+                *[str(v).encode() for v in kwargs])
+            self._cb = MXCallbackList()
+            if not creator(op_type.encode(), n, keys, vals,
+                           ctypes.byref(self._cb)):
+                raise RuntimeError(f"creator for {op_type!r} failed")
+            self._keep = (keys, vals)
+
+        def list_arguments(self):
+            return _call_list(self._cb, PROP_LIST_ARGS)
+
+        def list_outputs(self):
+            return _call_list(self._cb, PROP_LIST_OUTS)
+
+        def infer_shape(self, in_shape):
+            n_in = len(self.list_arguments())
+            n_out = len(self.list_outputs())
+            total = n_in + n_out
+            ndims = (ctypes.c_int * total)(
+                *([len(s) for s in in_shape] + [0] * n_out))
+            bufs = [(ctypes.c_int * max(len(s), 1))(*s) for s in in_shape]
+            bufs += [None] * n_out
+            shapes = (ctypes.POINTER(ctypes.c_int) * total)(
+                *[ctypes.cast(b, ctypes.POINTER(ctypes.c_int))
+                  if b is not None else None for b in bufs])
+            fn, ctx = _entry(self._cb, PROP_INFER_SHAPE, INFERSHAPE)
+            if not fn or not fn(total, ndims, shapes, ctx):
+                raise RuntimeError(f"{op_type}: infer_shape failed")
+            outs = [[shapes[n_in + i][j] for j in range(ndims[n_in + i])]
+                    for i in range(n_out)]
+            ins = [[shapes[i][j] for j in range(ndims[i])]
+                   for i in range(n_in)]
+            return ins, outs, []
+
+        def create_operator(self, ctx, shapes, dtypes):
+            n = len(shapes)
+            ndims = (ctypes.c_int * max(n, 1))(*[len(s) for s in shapes])
+            bufs = [(ctypes.c_uint * max(len(s), 1))(*s) for s in shapes]
+            shp = (ctypes.POINTER(ctypes.c_uint) * max(n, 1))(
+                *[ctypes.cast(b, ctypes.POINTER(ctypes.c_uint))
+                  for b in bufs])
+            dts = (ctypes.c_int * max(n, 1))(
+                *[_CODE_BY_DTYPE.get(np.dtype(d).name, 0) for d in dtypes])
+            opcb = MXCallbackList()
+            fn, cctx = _entry(self._cb, PROP_CREATE_OP, CREATEOP)
+            if not fn or not fn(str(ctx).encode(), n, shp, ndims, dts,
+                                ctypes.byref(opcb), cctx):
+                raise RuntimeError(f"{op_type}: create_operator failed")
+
+            class COp(opmod.CustomOp):
+                def _fb(self, idx, nds, tags, reqs, is_train):
+                    fn2, sctx = _entry(opcb, idx, FB)
+                    if not fn2:
+                        raise RuntimeError(f"{op_type}: missing callback")
+                    size = len(nds)
+                    ptrs = (ctypes.c_void_p * size)(*[id(a) for a in nds])
+                    tg = (ctypes.c_int * size)(*tags)
+                    rq = (ctypes.c_int * size)(*reqs)
+                    if not fn2(size, ptrs, tg, rq, int(is_train), sctx):
+                        raise RuntimeError(f"{op_type}: callback failed")
+
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    nds = list(in_data) + list(out_data) + list(aux)
+                    tags = ([0] * len(in_data) + [1] * len(out_data)
+                            + [4] * len(aux))
+                    reqs = [REQ_CODE.get(r, 1) for r in req]
+                    self._fb(OP_FORWARD, nds, tags,
+                             [1] * len(in_data) + reqs + [1] * len(aux),
+                             is_train)
+
+                def backward(self, req, out_grad, in_data, out_data,
+                             in_grad, aux):
+                    nds = (list(out_grad) + list(in_data) + list(out_data)
+                           + list(in_grad) + list(aux))
+                    tags = ([3] * len(out_grad) + [0] * len(in_data)
+                            + [1] * len(out_data) + [2] * len(in_grad)
+                            + [4] * len(aux))
+                    reqs = [REQ_CODE.get(r, 1) for r in req]
+                    pre = len(out_grad) + len(in_data) + len(out_data)
+                    self._fb(OP_BACKWARD, nds, tags,
+                             [1] * pre + reqs + [1] * len(aux), True)
+
+            op = COp()
+            op._keepalive = opcb
+            return op
+
+    CProp.__name__ = f"CProp_{op_type}"
+    opmod._REGISTRY[op_type] = CProp
+    # keep the creator callable alive for the process lifetime
+    _c_custom_ops[op_type] = creator
+    return True
+
+
+_c_custom_ops = {}
+
+
+# --- op discovery for binding generators (parity: c_api.h
+# MXSymbolListAtomicSymbolCreators:963 / GetAtomicSymbolName:974 /
+# GetAtomicSymbolInfo:1002 — what OpWrapperGenerator-style tools use) ------
+def atomic_symbol_creators():
+    from .ops import registry
+    return sorted(registry.list_ops())
+
+
+def atomic_symbol_info(name):
+    """(name, description, arg_names, arg_types, arg_descs,
+    key_var_num_args, return_type)."""
+    from .ops import registry
+    op = registry.get(name)
+    doc = (getattr(op, "fcompute", None) and op.fcompute.__doc__) or ""
+    names = getattr(op, "input_names", None)
+    args = list(names) if names and not callable(names) else []
+    if not args and not getattr(op, "eager_only", False):
+        args = ["data"]
+    return (name, doc, args, ["NDArray-or-Symbol"] * len(args),
+            [""] * len(args), "", "")
+
+
+def symbol_copy(s):
+    import copy as _copy
+    return _copy.deepcopy(s)
+
+
+def symbol_name(s):
+    return s.name or ""
+
+
+def symbol_num_outputs(s):
+    return len(s.list_outputs())
+
+
+def symbol_compose(s, name, keys, input_syms):
+    """In-place composition (parity: MXSymbolCompose c_api.h:1168)."""
+    kwargs = dict(zip(keys, input_syms)) if keys else {}
+    args = [] if keys else list(input_syms)
+    s._compose(*args, name=name or None, **kwargs)
+    return True
+
+
+def symbol_infer_shape_partial(s, names, shapes):
+    kwargs = {n: tuple(sh) for n, sh in zip(names, shapes) if sh}
+    arg_s, out_s, aux_s = s.infer_shape_partial(**kwargs)
+    return (arg_s or [], out_s or [], aux_s or [])
+
+
+def symbol_infer_type_partial(s, names, type_codes):
+    kwargs = {}
+    for n, c in zip(names, type_codes):
+        if c >= 0:
+            if c not in _DTYPE_BY_CODE:  # same contract as the full path
+                raise ValueError(f"unknown dtype code {c}")
+            kwargs[n] = _DTYPE_BY_CODE[c]
+    arg_t, out_t, aux_t = s.infer_type_partial(**kwargs)
+    code = lambda ts: [
+        _CODE_BY_DTYPE.get(np.dtype(t).name, -1) if t else -1
+        for t in (ts or [])]
+    return code(arg_t), code(out_t), code(aux_t)
+
+
+# --- autograd / ndarray extras --------------------------------------------
+def autograd_is_recording():
+    from . import autograd
+    return autograd.is_recording()
+
+
+def autograd_is_training():
+    from . import autograd
+    return autograd.is_training()
+
+
+def ndarray_detach(arr):
+    return arr.detach()
+
+
+def ndarray_load_from_buffer(data):
+    """Parity: MXNDArrayLoadFromBuffer c_api.h:660 — deserialize the
+    nd.save format from an in-memory buffer."""
+    import os
+    import tempfile
+    from . import nd
+    fd, path = tempfile.mkstemp(suffix=".params")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+        loaded = nd.load(path)
+    finally:
+        os.unlink(path)
+    if isinstance(loaded, dict):
+        names = list(loaded.keys())
+        return [loaded[n] for n in names], names
+    return list(loaded), []
+
+
+# --- kvstore extras --------------------------------------------------------
+def kvstore_barrier(kv):
+    kv.barrier()
+    return True
+
+
+def kvstore_pushpull(kv, keys, values, outs, priority):
+    kv.pushpull(list(keys), list(values),
+                out=list(outs) if outs else None, priority=priority)
+    return True
+
+
+def kvstore_send_command(kv, head, body):
+    kv._send_command_to_servers(head, body)
+    return True
+
+
+def kvstore_type(kv):
+    return kv.type
+
+
+def kvstore_num_dead_node(kv, node_id, timeout):
+    return int(kv.get_num_dead_node(node_id, timeout=timeout))
+
+
+# --- misc extras -----------------------------------------------------------
+def device_memory_info(dev_type, dev_id):
+    from . import context
+    ctx = _ctx(dev_type, dev_id)
+    try:
+        free, total = context.device_memory_info(ctx)
+        return int(free), int(total)
+    except Exception:
+        return 0, 0
+
+
+def data_iter_info(name):
+    """(name, description, arg names/types/descs) for a registered iter."""
+    reg = _iter_registry()
+    cls = reg[name]
+    return (name, (cls.__doc__ or "").strip(), [], [], [])

@@ -175,6 +175,11 @@ class KVStore:
     def _send_command_to_servers(self, head, body):
         pass  # single-process: nothing to send
 
+    def get_num_dead_node(self, node_id=0, timeout=60):
+        """Non-dist stores have no remote peers to lose (parity:
+        KVStore::get_num_dead_node, include/mxnet/kvstore.h:353)."""
+        return 0
+
     def save_optimizer_states(self, fname, dump_optimizer=False):
         assert self._updater is not None, "updater is not set"
         with open(fname, "wb") as fout:

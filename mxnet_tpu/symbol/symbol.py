@@ -144,8 +144,26 @@ class Symbol:
         return Symbol(list(self._outputs))
 
     def __deepcopy__(self, memo):
-        # graph nodes are immutable-by-convention; shallow copy is enough
-        return Symbol(list(self._outputs))
+        # a REAL graph clone: _compose mutates nodes in place, so copies
+        # meant for independent composition (Symbol.__call__, the C ABI's
+        # MXSymbolCopy) must not share nodes with the original.  The node
+        # cache rides `memo`, so deepcopying a structure holding several
+        # symbols with shared subgraphs preserves that sharing among the
+        # clones.
+        if id(self) in memo:
+            return memo[id(self)]
+
+        def clone(node):
+            got = memo.get(id(node))
+            if got is None:
+                got = _SymNode(node.op, node.name, dict(node.attrs),
+                               [(clone(c), oi) for c, oi in node.inputs])
+                memo[id(node)] = got
+            return got
+
+        out = Symbol([(clone(n), oi) for n, oi in self._outputs])
+        memo[id(self)] = out
+        return out
 
     # -- graph walks -------------------------------------------------------
     def _topo(self):
@@ -311,6 +329,61 @@ class Symbol:
 
     def infer_shape_partial(self, *args, **kwargs):
         return self._infer_shape_impl(True, *args, **kwargs)
+
+    def infer_type_partial(self, *args, **kwargs):
+        """Partial dtype inference (parity: symbol.py infer_type_partial).
+        infer_type already reports None for the genuinely unresolvable
+        instead of raising, which is exactly the partial contract."""
+        return self.infer_type(*args, **kwargs)
+
+    # -- composition -------------------------------------------------------
+    def _compose(self, *args, name=None, **kwargs):
+        """In-place composition (parity: symbol.py __call__ -> MXSymbolCompose,
+        c_api.h:1168): bind this symbol's free-variable inputs to other
+        symbols, positionally (list_arguments order) or by variable name."""
+        if args and kwargs:
+            raise MXNetError(
+                "compose accepts positional OR keyword symbols, not both")
+        if args:
+            kwargs = dict(zip(self.list_arguments(), args))
+        bad = [k for k, v in kwargs.items() if not isinstance(v, Symbol)]
+        if bad:
+            raise MXNetError(f"compose values must be Symbols: {bad}")
+
+        def one(s):
+            if len(s._outputs) != 1:
+                raise MXNetError(
+                    "cannot compose with a multi-output symbol as one "
+                    "input; select an output first")
+            return s._outputs[0]
+
+        repl = {n: one(s) for n, s in kwargs.items()}
+        unknown = set(repl) - set(self.list_arguments())
+        if unknown:
+            raise MXNetError(
+                f"compose: {sorted(unknown)} are not free variables of "
+                f"this symbol (arguments: {self.list_arguments()})")
+        for node in self._topo():
+            for i, (child, oi) in enumerate(node.inputs):
+                if child.is_variable() and child.name in repl:
+                    node.inputs[i] = repl[child.name]
+        self._outputs = [
+            repl[n.name] if n.is_variable() and n.name in repl else (n, oi)
+            for (n, oi) in self._outputs]
+        if name is not None and self._outputs:
+            # never rename a node grafted in from an ARGUMENT symbol (it
+            # stays shared with the caller's graph); only nodes that were
+            # already ours take the composed name
+            head = self._outputs[0][0]
+            if id(head) not in {id(n) for (n, _) in repl.values()}:
+                head.name = name
+
+    def __call__(self, *args, **kwargs):
+        """Compose into a NEW symbol, leaving this one untouched."""
+        import copy as _copy
+        out = _copy.deepcopy(self)
+        out._compose(*args, **kwargs)
+        return out
 
     def _infer_shape_impl(self, partial, *args, **kwargs):
         import jax

@@ -26,6 +26,7 @@
 
 #include <cstdint>
 #include <cstring>
+#include <set>
 #include <string>
 #include <vector>
 
@@ -1298,6 +1299,495 @@ int MXKVStoreFree(KVStoreHandle handle) {
   if (!handle) return 0;
   Gil gil;
   Py_DECREF(static_cast<PyObject*>(handle));
+  return 0;
+}
+
+
+
+/* ---- op discovery / symbol extras (round-5 width; reference c_api.h:963,
+   974, 1002, 1126, 1145, 1168, 1511, 1562) ------------------------------- */
+
+// creator handles must stay valid for the PROCESS lifetime (binding
+// generators cache them across unrelated C API calls), so names are
+// interned in a node-based container whose element addresses never move.
+static std::set<std::string>& creator_intern() {
+  static std::set<std::string>* s = new std::set<std::string>();
+  return *s;
+}
+
+int MXSymbolListAtomicSymbolCreators(mx_uint* out_size, void*** out_array) {
+  ensure_python();
+  Gil gil;
+  PyObject* args = Py_BuildValue("()");
+  PyObject* r = args ? call("atomic_symbol_creators", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  Py_ssize_t n = PySequence_Size(r);
+  static thread_local std::vector<void*> creators;
+  creators.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* it = PySequence_GetItem(r, i);
+    auto ins = creator_intern().insert(PyUnicode_AsUTF8(it));
+    Py_XDECREF(it);
+    creators.push_back(const_cast<char*>(ins.first->c_str()));
+  }
+  Py_DECREF(r);
+  *out_size = static_cast<mx_uint>(n);
+  *out_array = creators.data();
+  return 0;
+}
+
+int MXSymbolGetAtomicSymbolName(void* creator, const char** name) {
+  /* creators ARE their interned names in this ABI */
+  *name = static_cast<const char*>(creator);
+  return 0;
+}
+
+int MXSymbolGetAtomicSymbolInfo(void* creator, const char** name,
+                                const char** description, mx_uint* num_args,
+                                const char*** arg_names,
+                                const char*** arg_type_infos,
+                                const char*** arg_descriptions,
+                                const char** key_var_num_args,
+                                const char** return_type) {
+  ensure_python();
+  Gil gil;
+  PyObject* args = Py_BuildValue("(s)", static_cast<const char*>(creator));
+  PyObject* r = args ? call("atomic_symbol_info", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  // two-phase: materialize EVERY string first, take pointers after —
+  // c_str() captured mid-growth dangles once the vector reallocates
+  static thread_local std::vector<std::string> strs;
+  static thread_local std::vector<const char*> names_v, types_v, descs_v;
+  strs.clear(); names_v.clear(); types_v.clear(); descs_v.clear();
+  auto S = [](PyObject* o) -> std::string {
+    return (o && PyUnicode_Check(o)) ? PyUnicode_AsUTF8(o) : "";
+  };
+  strs.push_back(S(PyTuple_GetItem(r, 0)));  // [0] name
+  strs.push_back(S(PyTuple_GetItem(r, 1)));  // [1] description
+  strs.push_back(S(PyTuple_GetItem(r, 5)));  // [2] key_var_num_args
+  strs.push_back(S(PyTuple_GetItem(r, 6)));  // [3] return_type
+  PyObject *an = PyTuple_GetItem(r, 2), *at = PyTuple_GetItem(r, 3),
+           *ad = PyTuple_GetItem(r, 4);
+  Py_ssize_t n = PySequence_Size(an);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    for (PyObject* seq : {an, at, ad}) {
+      PyObject* it = PySequence_GetItem(seq, i);
+      strs.push_back(S(it));
+      Py_XDECREF(it);
+    }
+  }
+  Py_DECREF(r);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    names_v.push_back(strs[4 + 3 * i].c_str());
+    types_v.push_back(strs[4 + 3 * i + 1].c_str());
+    descs_v.push_back(strs[4 + 3 * i + 2].c_str());
+  }
+  if (name) *name = strs[0].c_str();
+  if (description) *description = strs[1].c_str();
+  if (num_args) *num_args = static_cast<mx_uint>(n);
+  if (arg_names) *arg_names = names_v.data();
+  if (arg_type_infos) *arg_type_infos = types_v.data();
+  if (arg_descriptions) *arg_descriptions = descs_v.data();
+  if (key_var_num_args) *key_var_num_args = strs[2].c_str();
+  if (return_type) *return_type = strs[3].c_str();
+  return 0;
+}
+
+int MXSymbolCopy(SymbolHandle symbol, SymbolHandle* out) {
+  if (!symbol) return fail("null handle");
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", symbol);
+  PyObject* r = args ? call("symbol_copy", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  *out = r;
+  return 0;
+}
+
+int MXSymbolGetName(SymbolHandle symbol, const char** out, int* success) {
+  if (!symbol) return fail("null handle");
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", symbol);
+  PyObject* r = args ? call("symbol_name", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  g_ret_json = PyUnicode_AsUTF8(r);
+  Py_DECREF(r);
+  *out = g_ret_json.c_str();
+  if (success) *success = g_ret_json.empty() ? 0 : 1;
+  return 0;
+}
+
+int MXSymbolGetNumOutputs(SymbolHandle symbol, mx_uint* output_count) {
+  if (!symbol) return fail("null handle");
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", symbol);
+  PyObject* r = args ? call("symbol_num_outputs", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  *output_count = static_cast<mx_uint>(PyLong_AsUnsignedLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXSymbolCompose(SymbolHandle sym, const char* name, mx_uint num_args,
+                    const char** keys, SymbolHandle* args_handles) {
+  if (!sym) return fail("null handle");
+  Gil gil;
+  PyObject* ks = list_from_strs(keys ? num_args : 0, keys);
+  PyObject* ins = list_from_handles(num_args, args_handles);
+  PyObject* args = Py_BuildValue("(OsOO)", sym, name ? name : "", ks, ins);
+  Py_DECREF(ks);
+  Py_DECREF(ins);
+  PyObject* r = args ? call("symbol_compose", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  Py_DECREF(r);
+  return 0;
+}
+
+/* ---- autograd / ndarray extras ------------------------------------------ */
+
+int MXAutogradIsRecording(bool* curr) {
+  ensure_python();
+  Gil gil;
+  PyObject* args = Py_BuildValue("()");
+  PyObject* r = args ? call("autograd_is_recording", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  *curr = PyObject_IsTrue(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXAutogradIsTraining(bool* curr) {
+  ensure_python();
+  Gil gil;
+  PyObject* args = Py_BuildValue("()");
+  PyObject* r = args ? call("autograd_is_training", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  *curr = PyObject_IsTrue(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayDetach(NDArrayHandle handle, NDArrayHandle* out) {
+  if (!handle) return fail("null handle");
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", handle);
+  PyObject* r = args ? call("ndarray_detach", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  *out = r;
+  return 0;
+}
+
+int MXNDArrayLoadFromBuffer(const void* ndarray_buffer, size_t size,
+                            mx_uint* out_size, NDArrayHandle** out_arr,
+                            mx_uint* out_name_size,
+                            const char*** out_names) {
+  ensure_python();
+  Gil gil;
+  PyObject* buf = PyBytes_FromStringAndSize(
+      static_cast<const char*>(ndarray_buffer),
+      static_cast<Py_ssize_t>(size));
+  PyObject* args = Py_BuildValue("(O)", buf);
+  Py_XDECREF(buf);
+  PyObject* r = args ? call("ndarray_load_from_buffer", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  PyObject* arrs = PyTuple_GetItem(r, 0);
+  PyObject* names = PyTuple_GetItem(r, 1);
+  handlelist_out(arrs, out_size, out_arr);
+  strlist_out(names, out_name_size, out_names);
+  Py_DECREF(r);
+  return 0;
+}
+
+/* ---- kvstore extras ----------------------------------------------------- */
+
+int MXKVStoreBarrier(KVStoreHandle handle) {
+  if (!handle) return fail("null handle");
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", handle);
+  PyObject* r = args ? call("kvstore_barrier", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreGetType(KVStoreHandle handle, const char** type) {
+  if (!handle) return fail("null handle");
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", handle);
+  PyObject* r = args ? call("kvstore_type", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  g_ret_json = PyUnicode_AsUTF8(r);
+  Py_DECREF(r);
+  *type = g_ret_json.c_str();
+  return 0;
+}
+
+int MXKVStoreSendCommmandToServers(KVStoreHandle handle, int cmd_id,
+                                   const char* cmd_body) {
+  if (!handle) return fail("null handle");
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Ois)", handle, cmd_id,
+                                 cmd_body ? cmd_body : "");
+  PyObject* r = args ? call("kvstore_send_command", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreGetNumDeadNode(KVStoreHandle handle, const int node_id,
+                            int* number, const int timeout_sec) {
+  if (!handle) return fail("null handle");
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Oii)", handle, node_id, timeout_sec);
+  PyObject* r = args ? call("kvstore_num_dead_node", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  *number = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStorePushPull(KVStoreHandle handle, mx_uint num, const int* keys,
+                      NDArrayHandle* in_vals, NDArrayHandle* out_vals,
+                      int priority) {
+  if (!handle) return fail("null handle");
+  Gil gil;
+  PyObject* ks = PyList_New(num);
+  for (mx_uint i = 0; i < num; ++i) {
+    PyList_SET_ITEM(ks, i, PyLong_FromLong(keys[i]));
+  }
+  PyObject* ins = list_from_handles(num, in_vals);
+  PyObject* outs = list_from_handles(num, out_vals);
+  PyObject* args = Py_BuildValue("(OOOOi)", handle, ks, ins, outs,
+                                 priority);
+  Py_DECREF(ks);
+  Py_DECREF(ins);
+  Py_DECREF(outs);
+  PyObject* r = args ? call("kvstore_pushpull", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  Py_DECREF(r);
+  return 0;
+}
+
+/* ---- misc extras -------------------------------------------------------- */
+
+int MXGetGPUMemoryInformation64(int dev, uint64_t* free_mem,
+                                uint64_t* total_mem) {
+  ensure_python();
+  Gil gil;
+  PyObject* args = Py_BuildValue("(ii)", 2, dev);
+  PyObject* r = args ? call("device_memory_info", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  *free_mem = PyLong_AsUnsignedLongLong(PyTuple_GetItem(r, 0));
+  *total_mem = PyLong_AsUnsignedLongLong(PyTuple_GetItem(r, 1));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNotifyShutdown(void) {
+  return 0;  /* engine shutdown is XLA/atexit-owned in this runtime */
+}
+
+/* ---- sparse NDArray (round-5; reference c_api.h:577+) ------------------- */
+
+int MXNDArrayCreateSparseEx(int storage_type, const mx_uint* shape,
+                            mx_uint ndim, int dev_type, int dev_id,
+                            int delay_alloc, int dtype, mx_uint num_aux,
+                            int* aux_type, mx_uint* aux_ndims,
+                            const mx_uint* aux_shape, NDArrayHandle* out) {
+  (void)delay_alloc; (void)num_aux; (void)aux_type; (void)aux_ndims;
+  (void)aux_shape;  /* aux blobs arrive later via SyncCopyFromNDArray */
+  ensure_python();
+  Gil gil;
+  PyObject* shp = PyTuple_New(ndim);
+  for (mx_uint i = 0; i < ndim; ++i) {
+    PyTuple_SET_ITEM(shp, i, PyLong_FromUnsignedLong(shape[i]));
+  }
+  PyObject* args = Py_BuildValue("(iOiii)", storage_type, shp, dev_type,
+                                 dev_id, dtype);
+  Py_DECREF(shp);
+  PyObject* r = args ? call("ndarray_create_sparse", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  *out = r;
+  return 0;
+}
+
+int MXNDArrayGetStorageType(NDArrayHandle handle, int* out_storage_type) {
+  if (!handle) return fail("null handle");
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", handle);
+  PyObject* r = args ? call("ndarray_storage_type", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  *out_storage_type = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArraySyncCopyFromNDArray(NDArrayHandle handle_dst,
+                                 const NDArrayHandle handle_src,
+                                 const int i) {
+  if (!handle_dst || !handle_src) return fail("null handle");
+  Gil gil;
+  PyObject* args = Py_BuildValue("(OOi)", handle_dst, handle_src, i);
+  PyObject* r = args ? call("ndarray_sync_copy_from_ndarray", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArraySyncCheckFormat(NDArrayHandle handle, const bool full_check) {
+  if (!handle) return fail("null handle");
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Oi)", handle, full_check ? 1 : 0);
+  PyObject* r = args ? call("ndarray_check_format", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayGetAuxType(NDArrayHandle handle, mx_uint i, int* out_type) {
+  if (!handle) return fail("null handle");
+  Gil gil;
+  PyObject* args = Py_BuildValue("(OI)", handle, i);
+  PyObject* r = args ? call("ndarray_get_aux_type", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  *out_type = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayGetAuxNDArray(NDArrayHandle handle, mx_uint i,
+                           NDArrayHandle* out) {
+  if (!handle) return fail("null handle");
+  Gil gil;
+  PyObject* args = Py_BuildValue("(OI)", handle, i);
+  PyObject* r = args ? call("ndarray_get_aux_ndarray", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  *out = r;
+  return 0;
+}
+
+int MXNDArrayGetDataNDArray(NDArrayHandle handle, NDArrayHandle* out) {
+  if (!handle) return fail("null handle");
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", handle);
+  PyObject* r = args ? call("ndarray_get_data_ndarray", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  *out = r;
+  return 0;
+}
+
+/* ---- kvstore updaters (reference c_api.h:2503+) ------------------------- */
+
+typedef void (MXKVStoreUpdater)(int key, NDArrayHandle recv,
+                                NDArrayHandle local, void* handle);
+typedef void (MXKVStoreStrUpdater)(const char* key, NDArrayHandle recv,
+                                   NDArrayHandle local, void* handle);
+
+int MXKVStoreSetUpdater(KVStoreHandle handle, MXKVStoreUpdater updater,
+                        void* updater_handle) {
+  if (!handle) return fail("null handle");
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(OKKi)", handle,
+      reinterpret_cast<unsigned long long>(updater),
+      reinterpret_cast<unsigned long long>(updater_handle), 0);
+  PyObject* r = args ? call("kvstore_set_updater", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreSetStrUpdater(KVStoreHandle handle, MXKVStoreStrUpdater updater,
+                           void* updater_handle) {
+  if (!handle) return fail("null handle");
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(OKKi)", handle,
+      reinterpret_cast<unsigned long long>(updater),
+      reinterpret_cast<unsigned long long>(updater_handle), 1);
+  PyObject* r = args ? call("kvstore_set_updater", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreSetUpdaterEx(KVStoreHandle handle, MXKVStoreUpdater updater,
+                          MXKVStoreStrUpdater str_updater,
+                          void* updater_handle) {
+  /* int-keyed stores use `updater`, string-keyed use `str_updater`; this
+     framework's kvstore normalizes keys, so install whichever is given
+     (int wins when both are). */
+  if (updater) return MXKVStoreSetUpdater(handle, updater, updater_handle);
+  return MXKVStoreSetStrUpdater(handle, str_updater, updater_handle);
+}
+
+/* ---- executor monitor callback (reference c_api.h:2170) ----------------- */
+
+typedef void (*ExecutorMonitorCallback)(const char*, NDArrayHandle, void*);
+
+int MXExecutorSetMonitorCallbackEX(ExecutorHandle handle,
+                                   ExecutorMonitorCallback callback,
+                                   void* callback_handle, bool monitor_all) {
+  if (!handle) return fail("null handle");
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(OKKi)", handle,
+      reinterpret_cast<unsigned long long>(callback),
+      reinterpret_cast<unsigned long long>(callback_handle),
+      monitor_all ? 1 : 0);
+  PyObject* r = args ? call("executor_set_monitor_callback", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXExecutorSetMonitorCallback(ExecutorHandle handle,
+                                 ExecutorMonitorCallback callback,
+                                 void* callback_handle) {
+  return MXExecutorSetMonitorCallbackEX(handle, callback, callback_handle,
+                                        false);
+}
+
+/* ---- custom op registration (reference c_api.h:2745) -------------------- */
+
+typedef int (*CustomOpPropCreator)(const char*, const int, const char**,
+                                   const char**, struct MXCallbackList*);
+
+int MXCustomOpRegister(const char* op_type, CustomOpPropCreator creator) {
+  if (!op_type || !creator) return fail("null op_type/creator");
+  ensure_python();
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(sK)", op_type, reinterpret_cast<unsigned long long>(creator));
+  PyObject* r = args ? call("custom_op_register", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  Py_DECREF(r);
   return 0;
 }
 

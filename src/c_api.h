@@ -12,6 +12,7 @@
 #ifndef MXNET_TPU_C_API_H_
 #define MXNET_TPU_C_API_H_
 
+#include <stdbool.h>
 #include <stddef.h>
 #include <stdint.h>
 
@@ -229,6 +230,95 @@ int MXKVStorePull(KVStoreHandle handle, mx_uint num, const int* keys,
 int MXKVStoreGetRank(KVStoreHandle handle, int* rank);
 int MXKVStoreGetGroupSize(KVStoreHandle handle, int* size);
 int MXKVStoreFree(KVStoreHandle handle);
+
+
+
+/* ---- op discovery / symbol extras (round-5 width) ----------------------- */
+int MXSymbolListAtomicSymbolCreators(mx_uint* out_size, void*** out_array);
+int MXSymbolGetAtomicSymbolName(void* creator, const char** name);
+int MXSymbolGetAtomicSymbolInfo(void* creator, const char** name,
+                                const char** description, mx_uint* num_args,
+                                const char*** arg_names,
+                                const char*** arg_type_infos,
+                                const char*** arg_descriptions,
+                                const char** key_var_num_args,
+                                const char** return_type);
+int MXSymbolCopy(SymbolHandle symbol, SymbolHandle* out);
+int MXSymbolGetName(SymbolHandle symbol, const char** out, int* success);
+int MXSymbolGetNumOutputs(SymbolHandle symbol, mx_uint* output_count);
+int MXSymbolCompose(SymbolHandle sym, const char* name, mx_uint num_args,
+                    const char** keys, SymbolHandle* args_handles);
+
+/* ---- autograd / ndarray extras ------------------------------------------ */
+int MXAutogradIsRecording(bool* curr);
+int MXAutogradIsTraining(bool* curr);
+int MXNDArrayDetach(NDArrayHandle handle, NDArrayHandle* out);
+int MXNDArrayLoadFromBuffer(const void* ndarray_buffer, size_t size,
+                            mx_uint* out_size, NDArrayHandle** out_arr,
+                            mx_uint* out_name_size, const char*** out_names);
+
+/* ---- kvstore extras ----------------------------------------------------- */
+int MXKVStoreBarrier(KVStoreHandle handle);
+int MXKVStoreGetType(KVStoreHandle handle, const char** type);
+int MXKVStoreSendCommmandToServers(KVStoreHandle handle, int cmd_id,
+                                   const char* cmd_body);
+int MXKVStoreGetNumDeadNode(KVStoreHandle handle, const int node_id,
+                            int* number, const int timeout_sec);
+int MXKVStorePushPull(KVStoreHandle handle, mx_uint num, const int* keys,
+                      NDArrayHandle* in_vals, NDArrayHandle* out_vals,
+                      int priority);
+
+/* ---- misc extras -------------------------------------------------------- */
+int MXGetGPUMemoryInformation64(int dev, uint64_t* free_mem,
+                                uint64_t* total_mem);
+int MXNotifyShutdown(void);
+
+/* ---- sparse NDArray (round-5; reference c_api.h:577+) ------------------- */
+int MXNDArrayCreateSparseEx(int storage_type, const mx_uint* shape,
+                            mx_uint ndim, int dev_type, int dev_id,
+                            int delay_alloc, int dtype, mx_uint num_aux,
+                            int* aux_type, mx_uint* aux_ndims,
+                            const mx_uint* aux_shape, NDArrayHandle* out);
+int MXNDArrayGetStorageType(NDArrayHandle handle, int* out_storage_type);
+/* i == -1 copies the data blob, i >= 0 the ith aux blob */
+int MXNDArraySyncCopyFromNDArray(NDArrayHandle handle_dst,
+                                 const NDArrayHandle handle_src, const int i);
+int MXNDArraySyncCheckFormat(NDArrayHandle handle, const bool full_check);
+int MXNDArrayGetAuxType(NDArrayHandle handle, mx_uint i, int* out_type);
+int MXNDArrayGetAuxNDArray(NDArrayHandle handle, mx_uint i,
+                           NDArrayHandle* out);
+int MXNDArrayGetDataNDArray(NDArrayHandle handle, NDArrayHandle* out);
+
+/* ---- kvstore updaters / monitor / custom op (round-5) ------------------- */
+typedef void (MXKVStoreUpdater)(int key, NDArrayHandle recv,
+                                NDArrayHandle local, void* handle);
+typedef void (MXKVStoreStrUpdater)(const char* key, NDArrayHandle recv,
+                                   NDArrayHandle local, void* handle);
+int MXKVStoreSetUpdater(KVStoreHandle handle, MXKVStoreUpdater updater,
+                        void* updater_handle);
+int MXKVStoreSetStrUpdater(KVStoreHandle handle, MXKVStoreStrUpdater updater,
+                           void* updater_handle);
+int MXKVStoreSetUpdaterEx(KVStoreHandle handle, MXKVStoreUpdater updater,
+                          MXKVStoreStrUpdater str_updater,
+                          void* updater_handle);
+
+typedef void (*ExecutorMonitorCallback)(const char*, NDArrayHandle, void*);
+int MXExecutorSetMonitorCallback(ExecutorHandle handle,
+                                 ExecutorMonitorCallback callback,
+                                 void* callback_handle);
+int MXExecutorSetMonitorCallbackEX(ExecutorHandle handle,
+                                   ExecutorMonitorCallback callback,
+                                   void* callback_handle, bool monitor_all);
+
+struct MXCallbackList {
+  int num_callbacks;
+  int (**callbacks)(void);
+  void** contexts;
+};
+typedef int (*CustomOpPropCreator)(const char* op_type, const int num_kwargs,
+                                   const char** keys, const char** values,
+                                   struct MXCallbackList* ret);
+int MXCustomOpRegister(const char* op_type, CustomOpPropCreator creator);
 
 #ifdef __cplusplus
 }  /* extern "C" */

@@ -357,6 +357,16 @@ extern int MXExecutorOutputs(H, mx_uint*, H**);
 extern int MXExecutorArgGrad(H, const char*, H*);
 extern int MXImperativeInvokeEx(const char*, int, H*, int*, H**, int,
                                 const char**, const char**);
+extern int MXNDArrayCreateSparseEx(int, const mx_uint*, mx_uint, int, int,
+                                   int, int, mx_uint, int*, mx_uint*,
+                                   const mx_uint*, H*);
+extern int MXNDArrayGetStorageType(H, int*);
+extern int MXNDArraySyncCopyFromNDArray(H, const H, const int);
+extern int MXNDArraySyncCheckFormat(H, const int);
+extern int MXKVStoreCreate(const char*, H*);
+extern int MXKVStoreInit(H, mx_uint, const int*, H*);
+extern int MXKVStorePush(H, mx_uint, const int*, H*, int);
+extern int MXKVStorePull(H, mx_uint, const int*, H*, int);
 
 #define CHECK(x) if ((x) != 0) { \
   fprintf(stderr, "FAIL %s: %s\n", #x, MXGetLastError()); return 1; }
@@ -476,6 +486,44 @@ int main(void) {
   for (int r = 0; r < 4; ++r) loss1 -= logf(probs[r * 10 + (int)lbuf[r]]);
   printf("loss %.6f -> %.6f\n", loss0, loss1);
   if (!(loss1 < loss0)) { fprintf(stderr, "no improvement\n"); return 1; }
+
+  // ---- sparse path (round-5): build a row_sparse gradient in C, push
+  // it through the kvstore, pull the dense result back -----------------
+  mx_uint sh_sp[2] = {4, 3};
+  H hsp = NULL;
+  CHECK(MXNDArrayCreateSparseEx(1, sh_sp, 2, 1, 0, 0, 0, 1, NULL, NULL,
+                                NULL, &hsp));
+  int stype = -9;
+  CHECK(MXNDArrayGetStorageType(hsp, &stype));
+  if (stype != 1) { fprintf(stderr, "stype %d\n", stype); return 1; }
+  float spdata[6] = {1, 2, 3, 4, 5, 6};
+  float spidx[2] = {1, 3};
+  mx_uint sh_d[2] = {2, 3};
+  mx_uint sh_i[1] = {2};
+  H hd = nd(sh_d, 2, spdata, 6);
+  H hi = nd(sh_i, 1, spidx, 2);
+  CHECK(MXNDArraySyncCopyFromNDArray(hsp, hd, -1));
+  CHECK(MXNDArraySyncCopyFromNDArray(hsp, hi, 0));
+  CHECK(MXNDArraySyncCheckFormat(hsp, 1));
+  H kv = NULL;
+  CHECK(MXKVStoreCreate("local", &kv));
+  int kvkeys[1] = {3};
+  float zero12[12] = {0};
+  H hw = nd(sh_sp, 2, zero12, 12);
+  CHECK(MXKVStoreInit(kv, 1, kvkeys, &hw));
+  CHECK(MXKVStorePush(kv, 1, kvkeys, &hsp, 0));
+  H hout = nd(sh_sp, 2, zero12, 12);
+  CHECK(MXKVStorePull(kv, 1, kvkeys, &hout, 0));
+  float dense[12];
+  CHECK(MXNDArraySyncCopyToCPU(hout, dense, sizeof(dense)));
+  float want[12] = {0, 0, 0, 1, 2, 3, 0, 0, 0, 4, 5, 6};
+  for (int i = 0; i < 12; ++i) {
+    if (fabsf(dense[i] - want[i]) > 1e-6f) {
+      fprintf(stderr, "sparse mismatch @%d: %f\n", i, dense[i]);
+      return 1;
+    }
+  }
+  printf("C-SPARSE-OK\n");
   printf("C-TRAIN-OK\n");
   return 0;
 }
@@ -509,6 +557,7 @@ def test_standalone_c_training(tmp_path):
                       timeout=300, env=env)
     assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
     assert "C-TRAIN-OK" in r.stdout
+    assert "C-SPARSE-OK" in r.stdout
 
 
 @needs_lib
@@ -867,3 +916,450 @@ class TestCtypesRound4b:
 
 def vpp_t():
     return ctypes.POINTER(vp)
+
+
+@needs_lib
+class TestRound5Groups:
+    """Sparse NDArray, C updaters, executor monitor, MXCustomOpRegister
+    (VERDICT r4 item 5; reference c_api.h:577+, 2170, 2503, 2745)."""
+
+    def _lib5(self):
+        lib = _lib()
+        u32p = ctypes.POINTER(u32)
+        vpp = ctypes.POINTER(vp)
+        intp = ctypes.POINTER(ctypes.c_int)
+        lib.MXNDArrayCreateSparseEx.argtypes = [
+            ctypes.c_int, u32p, u32, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, u32, intp, u32p, u32p, vpp]
+        lib.MXNDArrayGetStorageType.argtypes = [vp, intp]
+        lib.MXNDArraySyncCopyFromNDArray.argtypes = [vp, vp, ctypes.c_int]
+        lib.MXNDArraySyncCheckFormat.argtypes = [vp, ctypes.c_bool]
+        lib.MXNDArrayGetAuxType.argtypes = [vp, u32, intp]
+        lib.MXNDArrayGetAuxNDArray.argtypes = [vp, u32, vpp]
+        lib.MXNDArrayGetDataNDArray.argtypes = [vp, vpp]
+        lib.MXKVStoreSetUpdater.argtypes = [vp, vp, vp]
+        lib.MXExecutorSetMonitorCallbackEX.argtypes = [vp, vp, vp,
+                                                       ctypes.c_bool]
+        lib.MXCustomOpRegister.argtypes = [vp, vp]
+        return lib
+
+    def test_sparse_row_sparse_create_fill_read(self):
+        lib = self._lib5()
+        shape = (u32 * 2)(4, 3)
+        h = vp()
+        rc = lib.MXNDArrayCreateSparseEx(1, shape, 2, 1, 0, 0, 0, 1,
+                                         None, None, None,
+                                         ctypes.byref(h))
+        assert rc == 0, _err(lib)
+        st = ctypes.c_int()
+        assert lib.MXNDArrayGetStorageType(h, ctypes.byref(st)) == 0
+        assert st.value == 1  # row_sparse
+        data = np.array([[1, 2, 3], [4, 5, 6]], np.float32)
+        idx = np.array([1, 3], np.float32)  # cast to int32 by the aux copy
+        hd, hi = _mk_ndarray(lib, data), _mk_ndarray(lib, idx)
+        assert lib.MXNDArraySyncCopyFromNDArray(h, hd, -1) == 0, _err(lib)
+        assert lib.MXNDArraySyncCopyFromNDArray(h, hi, 0) == 0, _err(lib)
+        assert lib.MXNDArraySyncCheckFormat(h, True) == 0, _err(lib)
+        dense = np.zeros((4, 3), np.float32)
+        dense[[1, 3]] = data
+        np.testing.assert_allclose(_to_numpy(lib, h), dense)
+        # aux/data accessors give dense copies
+        at = ctypes.c_int()
+        assert lib.MXNDArrayGetAuxType(h, 0, ctypes.byref(at)) == 0
+        assert at.value == 4  # int32 (documented narrowing from int64)
+        ha, hda = vp(), vp()
+        assert lib.MXNDArrayGetAuxNDArray(h, 0, ctypes.byref(ha)) == 0
+        assert lib.MXNDArrayGetDataNDArray(h, ctypes.byref(hda)) == 0
+        np.testing.assert_allclose(_to_numpy(lib, hda), data)
+        # malformed indices (unsorted) must fail the full check
+        hbad = _mk_ndarray(lib, np.array([3, 1], np.float32))
+        assert lib.MXNDArraySyncCopyFromNDArray(h, hbad, 0) == 0
+        assert lib.MXNDArraySyncCheckFormat(h, True) != 0
+        for x in (h, hd, hi, ha, hda, hbad):
+            lib.MXNDArrayFree(x)
+
+    def test_sparse_csr_create_fill_read(self):
+        lib = self._lib5()
+        shape = (u32 * 2)(3, 4)
+        h = vp()
+        assert lib.MXNDArrayCreateSparseEx(2, shape, 2, 1, 0, 0, 0, 2,
+                                           None, None, None,
+                                           ctypes.byref(h)) == 0, _err(lib)
+        st = ctypes.c_int()
+        lib.MXNDArrayGetStorageType(h, ctypes.byref(st))
+        assert st.value == 2  # csr
+        data = np.array([1.0, 2.0, 3.0], np.float32)
+        indptr = np.array([0, 2, 3, 3], np.float32)
+        indices = np.array([0, 2, 1], np.float32)
+        hd = _mk_ndarray(lib, data)
+        hp = _mk_ndarray(lib, indptr)
+        hi = _mk_ndarray(lib, indices)
+        assert lib.MXNDArraySyncCopyFromNDArray(h, hd, -1) == 0, _err(lib)
+        assert lib.MXNDArraySyncCopyFromNDArray(h, hp, 0) == 0, _err(lib)
+        assert lib.MXNDArraySyncCopyFromNDArray(h, hi, 1) == 0, _err(lib)
+        assert lib.MXNDArraySyncCheckFormat(h, True) == 0, _err(lib)
+        dense = np.array([[1, 0, 2, 0], [0, 3, 0, 0], [0, 0, 0, 0]],
+                         np.float32)
+        np.testing.assert_allclose(_to_numpy(lib, h), dense)
+        for x in (h, hd, hp, hi):
+            lib.MXNDArrayFree(x)
+
+    def test_kvstore_c_updater(self):
+        lib = self._lib5()
+        kv = vp()
+        assert lib.MXKVStoreCreate(b"local", ctypes.byref(kv)) == 0
+        w0 = _mk_ndarray(lib, np.full((4,), 10.0, np.float32))
+        keys = (ctypes.c_int * 1)(7)
+        assert lib.MXKVStoreInit(kv, 1, keys, (vp * 1)(w0)) == 0, _err(lib)
+
+        UPD = ctypes.CFUNCTYPE(None, ctypes.c_int, vp, vp, vp)
+        seen = []
+
+        @UPD
+        def updater(key, recv, local, _ctx):
+            # SGD-style: local -= 0.5 * recv, through the C API itself
+            seen.append(key)
+            num_out = ctypes.c_int(0)
+            outs = ctypes.POINTER(vp)()
+            k = (ctypes.c_char_p * 1)(b"scalar")
+            v = (ctypes.c_char_p * 1)(b"0.5")
+            rc = lib.MXImperativeInvokeEx(b"_mul_scalar", 1, (vp * 1)(recv),
+                                          ctypes.byref(num_out),
+                                          ctypes.byref(outs), 1, k, v)
+            assert rc == 0, _err(lib)
+            scaled = outs[0]
+            out_arr = (vp * 1)(local)
+            outp = ctypes.cast(out_arr, ctypes.POINTER(vp))
+            n2 = ctypes.c_int(1)
+            rc = lib.MXImperativeInvokeEx(
+                b"elemwise_sub", 2, (vp * 2)(local, scaled),
+                ctypes.byref(n2), ctypes.byref(outp), 0, None, None)
+            assert rc == 0, _err(lib)
+            lib.MXNDArrayFree(scaled)
+
+        assert lib.MXKVStoreSetUpdater(
+            kv, ctypes.cast(updater, vp), None) == 0, _err(lib)
+        g = _mk_ndarray(lib, np.full((4,), 2.0, np.float32))
+        assert lib.MXKVStorePush(kv, 1, keys, (vp * 1)(g), 0) == 0, _err(lib)
+        out = _mk_ndarray(lib, np.zeros((4,), np.float32))
+        assert lib.MXKVStorePull(kv, 1, keys, (vp * 1)(out), 0) == 0
+        np.testing.assert_allclose(_to_numpy(lib, out), 9.0)  # 10 - 0.5*2
+        assert seen == [7]
+        for x in (w0, g, out):
+            lib.MXNDArrayFree(x)
+        lib.MXKVStoreFree(kv)
+
+    def test_executor_monitor_callback(self):
+        lib = self._lib5()
+        var = vp()
+        assert lib.MXSymbolCreateVariable(b"x", ctypes.byref(var)) == 0
+        sq = vp()
+        assert lib.MXSymbolCreateOp(b"square", 0, None, None, 1,
+                                    (vp * 1)(var), b"sq",
+                                    ctypes.byref(sq)) == 0, _err(lib)
+        x = _mk_ndarray(lib, np.full((2, 2), 3.0, np.float32))
+        ex = vp()
+        names = (ctypes.c_char_p * 1)(b"x")
+        reqs = (ctypes.c_char_p * 1)(b"null")
+        assert lib.MXExecutorBind(sq, 1, 0, 1, names, (vp * 1)(x),
+                                  reqs, 0, None, None,
+                                  ctypes.byref(ex)) == 0, _err(lib)
+        MON = ctypes.CFUNCTYPE(None, ctypes.c_char_p, vp, vp)
+        seen = []
+
+        @MON
+        def monitor(name, arr_handle, _ctx):
+            seen.append((name.decode(), float(_to_numpy(lib,
+                                                        arr_handle)[0, 0])))
+
+        assert lib.MXExecutorSetMonitorCallbackEX(
+            ex, ctypes.cast(monitor, vp), None, False) == 0, _err(lib)
+        assert lib.MXExecutorForward(ex, 0) == 0, _err(lib)
+        assert seen and any(v == 9.0 for _n, v in seen), seen
+        lib.MXExecutorFree(ex)
+        lib.MXNDArrayFree(x)
+
+    def test_custom_op_register_full_protocol(self):
+        lib = self._lib5()
+        keep = []  # every callback/array the C side must keep alive
+
+        GEN = ctypes.CFUNCTYPE(ctypes.c_int)
+        LIST = ctypes.CFUNCTYPE(
+            ctypes.c_int, ctypes.POINTER(ctypes.POINTER(ctypes.c_char_p)),
+            vp)
+        INFER = ctypes.CFUNCTYPE(
+            ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_int)), vp)
+        CREATEOP = ctypes.CFUNCTYPE(
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint)),
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+            vp, vp)
+        FB = ctypes.CFUNCTYPE(
+            ctypes.c_int, ctypes.c_int, ctypes.POINTER(vp),
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+            ctypes.c_int, vp)
+        CREATOR = ctypes.CFUNCTYPE(
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_char_p), vp)
+
+        class CBList(ctypes.Structure):
+            _fields_ = [("num_callbacks", ctypes.c_int),
+                        ("callbacks", ctypes.POINTER(GEN)),
+                        ("contexts", ctypes.POINTER(vp))]
+
+        def _scale(handle_in, handle_out, factor):
+            """out = factor * in via the C API (what a real plugin does)."""
+            k = (ctypes.c_char_p * 1)(b"scalar")
+            v = (ctypes.c_char_p * 1)(str(factor).encode())
+            out_arr = (vp * 1)(handle_out)
+            outp = ctypes.cast(out_arr, ctypes.POINTER(vp))
+            n = ctypes.c_int(1)
+            rc = lib.MXImperativeInvokeEx(b"_mul_scalar", 1,
+                                          (vp * 1)(handle_in),
+                                          ctypes.byref(n),
+                                          ctypes.byref(outp), 1, k, v)
+            assert rc == 0, _err(lib)
+
+        @LIST
+        def list_args(out, _ctx):
+            arr = (ctypes.c_char_p * 2)(b"data", None)
+            keep.append(arr)
+            out[0] = arr
+            return 1
+
+        @LIST
+        def list_outs(out, _ctx):
+            arr = (ctypes.c_char_p * 2)(b"output", None)
+            keep.append(arr)
+            out[0] = arr
+            return 1
+
+        @INFER
+        def infer_shape(num_tensor, ndims, shapes, _ctx):
+            # one input, one output: output shape = input shape
+            ndims[1] = ndims[0]
+            keep.append(shapes[0])
+            shapes[1] = shapes[0]
+            return 1
+
+        @FB
+        def forward(size, ptrs, tags, _reqs, _is_train, _state):
+            ins = [ptrs[i] for i in range(size) if tags[i] == 0]
+            outs = [ptrs[i] for i in range(size) if tags[i] == 1]
+            _scale(ins[0], outs[0], 2.0)
+            return 1
+
+        @FB
+        def backward(size, ptrs, tags, _reqs, _is_train, _state):
+            ograds = [ptrs[i] for i in range(size) if tags[i] == 3]
+            igrads = [ptrs[i] for i in range(size) if tags[i] == 2]
+            _scale(ograds[0], igrads[0], 2.0)
+            return 1
+
+        @CREATEOP
+        def create_op(_ctx_str, _n, _shapes, _ndims, _dtypes, ret, _state):
+            cbs = (GEN * 3)(GEN(), ctypes.cast(forward, GEN),
+                            ctypes.cast(backward, GEN))
+            ctxs = (vp * 3)()
+            keep.extend([cbs, ctxs])
+            lst = ctypes.cast(ret, ctypes.POINTER(CBList))
+            lst[0].num_callbacks = 3
+            lst[0].callbacks = cbs
+            lst[0].contexts = ctxs
+            return 1
+
+        @CREATOR
+        def creator(_op_type, _nk, _keys, _vals, ret):
+            # CustomOpPropCallbacks order: del, list_args, list_outs,
+            # list_aux, infer_shape, bwd_dep, create_operator
+            cbs = (GEN * 7)(GEN(), ctypes.cast(list_args, GEN),
+                            ctypes.cast(list_outs, GEN), GEN(),
+                            ctypes.cast(infer_shape, GEN), GEN(),
+                            ctypes.cast(create_op, GEN))
+            ctxs = (vp * 7)()
+            keep.extend([cbs, ctxs])
+            lst = ctypes.cast(ret, ctypes.POINTER(CBList))
+            lst[0].num_callbacks = 7
+            lst[0].callbacks = cbs
+            lst[0].contexts = ctxs
+            return 1
+
+        keep.extend([list_args, list_outs, infer_shape, forward, backward,
+                     create_op, creator])
+        assert lib.MXCustomOpRegister(
+            b"c_scale2", ctypes.cast(creator, vp)) == 0, _err(lib)
+
+        # the C-registered op is a first-class custom op: imperative,
+        # gradient, and the same registry as Python custom ops
+        import mxnet_tpu as mx
+        from mxnet_tpu import nd
+        x = nd.array(np.array([1.0, -2.0, 3.5], np.float32))
+        x.attach_grad()
+        with mx.autograd.record():
+            y = nd.Custom(x, op_type="c_scale2")
+        np.testing.assert_allclose(y.asnumpy(), [2.0, -4.0, 7.0])
+        y.backward()
+        np.testing.assert_allclose(x.grad.asnumpy(), [2.0, 2.0, 2.0])
+
+
+@needs_lib
+class TestRound5Width:
+    """Op discovery, symbol compose/copy, autograd state, kvstore extras,
+    load-from-buffer (round-5 width batch; reference c_api.h:963+, 1168,
+    660, 2538+)."""
+
+    def test_op_discovery(self):
+        lib = _lib()
+        lib.MXSymbolListAtomicSymbolCreators.argtypes = [
+            ctypes.POINTER(u32), ctypes.POINTER(ctypes.POINTER(vp))]
+        n = u32()
+        creators = ctypes.POINTER(vp)()
+        assert lib.MXSymbolListAtomicSymbolCreators(
+            ctypes.byref(n), ctypes.byref(creators)) == 0, _err(lib)
+        assert n.value > 400
+        # find Convolution and read its info
+        lib.MXSymbolGetAtomicSymbolName.argtypes = [
+            vp, ctypes.POINTER(ctypes.c_char_p)]
+        found = None
+        for i in range(n.value):
+            nm = ctypes.c_char_p()
+            assert lib.MXSymbolGetAtomicSymbolName(
+                creators[i], ctypes.byref(nm)) == 0
+            if nm.value == b"Convolution":
+                found = creators[i]
+        assert found is not None
+        name = ctypes.c_char_p()
+        desc = ctypes.c_char_p()
+        nargs = u32()
+        anames = ctypes.POINTER(ctypes.c_char_p)()
+        atypes = ctypes.POINTER(ctypes.c_char_p)()
+        adescs = ctypes.POINTER(ctypes.c_char_p)()
+        kv = ctypes.c_char_p()
+        rt = ctypes.c_char_p()
+        lib.MXSymbolGetAtomicSymbolInfo.argtypes = [
+            vp, ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(u32),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_char_p)),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_char_p)),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_char_p)),
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_char_p)]
+        assert lib.MXSymbolGetAtomicSymbolInfo(
+            found, ctypes.byref(name), ctypes.byref(desc),
+            ctypes.byref(nargs), ctypes.byref(anames), ctypes.byref(atypes),
+            ctypes.byref(adescs), ctypes.byref(kv),
+            ctypes.byref(rt)) == 0, _err(lib)
+        assert name.value == b"Convolution"
+
+    def test_symbol_compose_copy_name(self):
+        lib = _lib()
+        x = vp()
+        assert lib.MXSymbolCreateVariable(b"x", ctypes.byref(x)) == 0
+        sq = vp()
+        assert lib.MXSymbolCreateOp(b"square", 0, None, None, 1,
+                                    (vp * 1)(x), b"sq", ctypes.byref(sq)) == 0
+        # copy, then compose the copy's free var with a fresh variable
+        cp = vp()
+        assert lib.MXSymbolCopy(sq, ctypes.byref(cp)) == 0, _err(lib)
+        y = vp()
+        assert lib.MXSymbolCreateVariable(b"y", ctypes.byref(y)) == 0
+        keys = (ctypes.c_char_p * 1)(b"x")
+        assert lib.MXSymbolCompose(cp, b"sq2", 1, keys,
+                                   (vp * 1)(y)) == 0, _err(lib)
+        nargs = u32()
+        names = ctypes.POINTER(ctypes.c_char_p)()
+        assert lib.MXSymbolListArguments(cp, ctypes.byref(nargs),
+                                         ctypes.byref(names)) == 0
+        assert nargs.value == 1 and names[0] == b"y"
+        # the original is untouched
+        assert lib.MXSymbolListArguments(sq, ctypes.byref(nargs),
+                                         ctypes.byref(names)) == 0
+        assert names[0] == b"x"
+        nout = u32()
+        assert lib.MXSymbolGetNumOutputs(sq, ctypes.byref(nout)) == 0
+        assert nout.value == 1
+        nm = ctypes.c_char_p()
+        ok = ctypes.c_int()
+        assert lib.MXSymbolGetName(sq, ctypes.byref(nm),
+                                   ctypes.byref(ok)) == 0
+        assert nm.value == b"sq"
+
+    def test_autograd_state_and_detach(self):
+        lib = _lib()
+        cur = ctypes.c_bool(True)
+        assert lib.MXAutogradIsRecording(ctypes.byref(cur)) == 0
+        assert cur.value is False
+        prev = ctypes.c_int()
+        assert lib.MXAutogradSetIsRecording(1, ctypes.byref(prev)) == 0
+        assert lib.MXAutogradIsRecording(ctypes.byref(cur)) == 0
+        assert cur.value is True
+        assert lib.MXAutogradSetIsRecording(0, ctypes.byref(prev)) == 0
+        h = _mk_ndarray(lib, np.ones((2,), np.float32))
+        d = vp()
+        assert lib.MXNDArrayDetach(h, ctypes.byref(d)) == 0, _err(lib)
+        np.testing.assert_allclose(_to_numpy_1d(lib, d, 2), 1.0)
+        lib.MXNDArrayFree(h)
+        lib.MXNDArrayFree(d)
+
+    def test_load_from_buffer_and_kvstore_extras(self, tmp_path):
+        lib = _lib()
+        x = np.arange(6, dtype=np.float32).reshape(2, 3)
+        h = _mk_ndarray(lib, x)
+        fname = str(tmp_path / "buf.params").encode()
+        keys = (ctypes.c_char_p * 1)(b"w")
+        assert lib.MXNDArraySave(fname, 1, (vp * 1)(h), keys) == 0
+        blob = open(fname.decode(), "rb").read()
+        lib.MXNDArrayLoadFromBuffer.argtypes = [
+            vp, ctypes.c_size_t, ctypes.POINTER(u32),
+            ctypes.POINTER(ctypes.POINTER(vp)), ctypes.POINTER(u32),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_char_p))]
+        n = u32()
+        arrs = ctypes.POINTER(vp)()
+        nn = u32()
+        names = ctypes.POINTER(ctypes.c_char_p)()
+        assert lib.MXNDArrayLoadFromBuffer(
+            blob, len(blob), ctypes.byref(n), ctypes.byref(arrs),
+            ctypes.byref(nn), ctypes.byref(names)) == 0, _err(lib)
+        assert n.value == 1 and names[0] == b"w"
+        np.testing.assert_allclose(_to_numpy(lib, arrs[0]), x)
+
+        kv = vp()
+        assert lib.MXKVStoreCreate(b"local", ctypes.byref(kv)) == 0
+        t = ctypes.c_char_p()
+        assert lib.MXKVStoreGetType(kv, ctypes.byref(t)) == 0
+        assert t.value == b"local"
+        ikeys = (ctypes.c_int * 1)(1)
+        w = _mk_ndarray(lib, np.zeros((3,), np.float32))
+        assert lib.MXKVStoreInit(kv, 1, ikeys, (vp * 1)(w)) == 0
+        g = _mk_ndarray(lib, np.full((3,), 2.0, np.float32))
+        out = _mk_ndarray(lib, np.zeros((3,), np.float32))
+        assert lib.MXKVStorePushPull(kv, 1, ikeys, (vp * 1)(g),
+                                     (vp * 1)(out), 0) == 0, _err(lib)
+        np.testing.assert_allclose(_to_numpy_1d(lib, out, 3), 2.0)
+        assert lib.MXKVStoreBarrier(kv) == 0
+        dead = ctypes.c_int(-1)
+        assert lib.MXKVStoreGetNumDeadNode(kv, 0, ctypes.byref(dead),
+                                           5) == 0
+        assert dead.value == 0
+        lib.MXKVStoreFree(kv)
+
+    def test_memory_info_and_shutdown(self):
+        lib = _lib()
+        free = ctypes.c_uint64()
+        total = ctypes.c_uint64()
+        lib.MXGetGPUMemoryInformation64.argtypes = [
+            ctypes.c_int, ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64)]
+        assert lib.MXGetGPUMemoryInformation64(
+            0, ctypes.byref(free), ctypes.byref(total)) == 0
+        assert lib.MXNotifyShutdown() == 0
+
+
+def _to_numpy_1d(lib, h, n):
+    out = np.zeros((n,), np.float32)
+    rc = lib.MXNDArraySyncCopyToCPU(h, out.ctypes.data_as(vp),
+                                    ctypes.c_size_t(out.nbytes))
+    assert rc == 0, _err(lib)
+    return out
