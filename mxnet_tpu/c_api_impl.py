@@ -571,6 +571,10 @@ def ndarray_sync_copy_from_ndarray(dst, src, i):
     import jax.numpy as jnp
     from .ndarray import sparse as sp
     val = jnp.asarray(src._data)
+    if int(i) < 0 and val.dtype != np.dtype(dst.dtype):
+        raise ValueError(
+            f"dtype mismatch: dst {np.dtype(dst.dtype).name} vs src "
+            f"{val.dtype.name} (the reference errors here too)")
     if int(i) < 0:
         # dense targets copy exactly; sparse .data blobs may change their
         # nnz leading dim but must keep the per-row shape (row_sparse) /
@@ -633,6 +637,8 @@ def ndarray_check_format(arr, full_check):
         idx = np.asarray(arr._indices)
         if indptr.shape[0] != arr.shape[0] + 1:
             raise MXNetError("csr: len(indptr) != rows+1")
+        if np.asarray(arr._data).shape[0] != idx.shape[0]:
+            raise MXNetError("csr: len(data) != len(indices)")
         if full_check:
             if (np.diff(indptr) < 0).any() or indptr[0] != 0 or \
                     int(indptr[-1]) != idx.shape[0]:
@@ -875,6 +881,21 @@ def atomic_symbol_info(name):
     doc = (getattr(op, "fcompute", None) and op.fcompute.__doc__) or ""
     names = getattr(op, "input_names", None)
     args = list(names) if names and not callable(names) else []
+    if not args and getattr(op, "fcompute", None) is not None:
+        # fall back to the compute function's own positional parameters
+        # (skip the attrs dict) so multi-input ops report a real arity —
+        # a single hardcoded "data" misleads binding generators
+        import inspect
+        try:
+            params = list(inspect.signature(op.fcompute).parameters
+                          .values())[1:]
+            args = [p.name for p in params
+                    if p.kind in (p.POSITIONAL_OR_KEYWORD, p.POSITIONAL_ONLY)]
+            var = [p for p in params if p.kind == p.VAR_POSITIONAL]
+            if var:
+                args.append(f"*{var[0].name}")
+        except (TypeError, ValueError):
+            args = ["data"]
     if not args and not getattr(op, "eager_only", False):
         args = ["data"]
     return (name, doc, args, ["NDArray-or-Symbol"] * len(args),

@@ -133,6 +133,21 @@ def _deconvolution(attrs, x, weight, *maybe_bias):
     x = x.astype(weight.dtype)
     # transposed conv = lhs-dilated conv with flipped, IO-swapped kernel
     k_eff = [(k - 1) * d + 1 for k, d in zip(kernel, dilate)]
+    tshape = attrs.get("target_shape")
+    if tshape:
+        # target_shape overrides pad/adj (reference deconvolution-inl.h:
+        # InferPad — pad/adj attrs are IGNORED when a target is given)
+        tshape = (tshape,) if isinstance(tshape, int) else tuple(tshape)
+        in_sp = x.shape[2:] if not layout.endswith("C") else x.shape[1:-1]
+        # reference InferPad (deconvolution-inl.h:138): total excess =
+        # s*(i-1) + k_eff - target; odd totals put the EXTRA row in pad
+        # (pad = (total+1)/2) and compensate with adj = total % 2
+        totals = [stride[j] * (in_sp[j] - 1) + k_eff[j] - int(tshape[j])
+                  for j in range(nd)]
+        if any(t < 0 for t in totals):
+            raise MXNetError(f"too big target shape {tshape}")
+        pad = tuple((t + 1) // 2 for t in totals)
+        adj = tuple(t % 2 for t in totals)
     padding = [(ke - 1 - p, ke - 1 - p + a) for ke, p, a in zip(k_eff, pad, adj)]
     w = jnp.flip(weight, axis=tuple(range(2, 2 + nd)))
     w = jnp.swapaxes(w, 0, 1)
@@ -247,7 +262,7 @@ def _batch_norm(attrs, x, gamma, beta, moving_mean, moving_var):
     """
     eps = float(attrs.get("eps", 1e-3))
     momentum = float(attrs.get("momentum", 0.9))
-    axis = int(attrs.get("axis", 1))
+    axis = int(attrs.get("axis", 1)) % x.ndim  # axis=-1 == channels-last
     training = bool(attrs.get("_training", False)) and not bool(
         attrs.get("use_global_stats", False))
     fix_gamma = bool(attrs.get("fix_gamma", True))

@@ -81,6 +81,7 @@ class Executor:
         self._aux_names = aux_names
         self.outputs = []
         self._monitor_callback = None
+        self._monitor_all = False
         self._fn_cache = {}
         self._vjp_holder = None
         self._last_is_train = False
@@ -197,9 +198,7 @@ class Executor:
             arr._set_data(new)
         self.outputs = [NDArray(o, self._ctx) for o in outs]
         if self._monitor_callback is not None:
-            names = self._symbol.list_outputs()
-            for n, o in zip(names, self.outputs):
-                self._monitor_callback(n, o)
+            self._run_monitor()
         return self.outputs
 
     def _forward_grouped(self, is_train):
@@ -223,9 +222,7 @@ class Executor:
                 arr._set_data(new_aux[name])
         self.outputs = [NDArray(o, self._ctx) for o in outs]
         if self._monitor_callback is not None:
-            names = self._symbol.list_outputs()
-            for n, o in zip(names, self.outputs):
-                self._monitor_callback(n, o)
+            self._run_monitor()
         return self.outputs
 
     def _backward_grouped(self, out_grads):
@@ -367,10 +364,30 @@ class Executor:
                         self.grad_req, new_aux,
                         group2ctx=self._group2ctx)
 
+    def _run_monitor(self):
+        if self._monitor_all:
+            # inputs first (monitor_all contract: inputs AND outputs)
+            for n, a in zip(self._arg_names, self.arg_arrays):
+                if a is not None:
+                    self._monitor_callback(n, a)
+            for n, a in zip(self._aux_names, self.aux_arrays):
+                if a is not None:
+                    self._monitor_callback(n, a)
+        for n, o in zip(self._symbol.list_outputs(), self.outputs):
+            self._monitor_callback(n, o)
+
     def set_monitor_callback(self, callback, monitor_all=False):
-        """Install per-output callback (parity: graph_executor.cc:1403
-        monitor_callback_)."""
+        """Install the monitor callback (parity: graph_executor.cc:1403
+        monitor_callback_).
+
+        monitor_all=False reports the graph outputs after each forward;
+        monitor_all=True additionally reports the bound inputs (arg and
+        aux arrays).  Per-internal-node values are not observable here —
+        the whole graph is ONE fused XLA program (use
+        Symbol.get_internals() to bind an executor that exposes them,
+        the documented TPU-era equivalent)."""
         self._monitor_callback = callback
+        self._monitor_all = bool(monitor_all)
 
     def debug_str(self):
         lines = ["Symbol Outputs:"]

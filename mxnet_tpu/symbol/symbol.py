@@ -40,6 +40,16 @@ def _auto_name(hint):
     return NameManager._current_value().get(None, hint)
 
 
+def _single_output(s):
+    """The (node, idx) of a single-output symbol; composition inputs must
+    be scalar-valued in the graph sense."""
+    if len(s._outputs) != 1:
+        raise MXNetError(
+            "cannot compose with a multi-output symbol as one input; "
+            "select an output first")
+    return s._outputs[0]
+
+
 class Symbol:
     """Symbol is symbolic graph handle (parity: symbol/symbol.py:55)."""
 
@@ -57,13 +67,7 @@ class Symbol:
         from ..name import NameManager
         name = NameManager._current_value().get(name, op_name.lower().strip("_"))
 
-        def one_output(s):
-            if len(s._outputs) != 1:
-                raise MXNetError(
-                    "cannot compose with a multi-output symbol as one input; "
-                    "select an output first")
-            return s._outputs[0]
-
+        one_output = _single_output
         entries = [one_output(s) for s in input_syms]
         expected = op.resolve_input_names(attrs)
         named_inputs = dict(named_inputs or {})
@@ -345,19 +349,16 @@ class Symbol:
             raise MXNetError(
                 "compose accepts positional OR keyword symbols, not both")
         if args:
-            kwargs = dict(zip(self.list_arguments(), args))
+            free = self.list_arguments()
+            if len(args) > len(free):
+                raise MXNetError(
+                    f"too many positional arguments: {len(args)} given, "
+                    f"{len(free)} free variables ({free})")
+            kwargs = dict(zip(free, args))
         bad = [k for k, v in kwargs.items() if not isinstance(v, Symbol)]
         if bad:
             raise MXNetError(f"compose values must be Symbols: {bad}")
-
-        def one(s):
-            if len(s._outputs) != 1:
-                raise MXNetError(
-                    "cannot compose with a multi-output symbol as one "
-                    "input; select an output first")
-            return s._outputs[0]
-
-        repl = {n: one(s) for n, s in kwargs.items()}
+        repl = {n: _single_output(s) for n, s in kwargs.items()}
         unknown = set(repl) - set(self.list_arguments())
         if unknown:
             raise MXNetError(

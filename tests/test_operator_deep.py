@@ -1,0 +1,650 @@
+"""Hand-chosen hard operator cases (round-5, VERDICT item 6).
+
+The reference's unit suite earns its depth in a handful of places: the
+Convolution/Deconvolution sections of tests/python/unittest/
+test_operator.py (parameter grids over stride x dilation x pad x groups,
+adjoint and impulse-response identities, target_shape inference), the
+pooling convention matrix, fused-RNN-vs-hand-rolled oracles, and the
+kAddTo/kNullOp grad_req contracts.  This file ports those STRATEGIES —
+every case is pinned against a from-scratch numpy oracle (direct loops,
+no jax), not against the op itself.
+
+bf16 variants run the same oracles at bf16-appropriate tolerances.
+"""
+import itertools
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+rng = np.random.RandomState(7)
+
+
+# --- numpy oracles (direct loops; trusted by construction) -----------------
+def np_conv(x, w, b, stride, pad, dilate, groups):
+    """Direct N-d convolution, NC+spatial layout, OIHW weights."""
+    ndim = x.ndim - 2
+    N, C = x.shape[:2]
+    O = w.shape[0]
+    k = w.shape[2:]
+    xp = np.pad(x, [(0, 0), (0, 0)] + [(p, p) for p in pad])
+    k_eff = [(k[j] - 1) * dilate[j] + 1 for j in range(ndim)]
+    out_sp = [(xp.shape[2 + j] - k_eff[j]) // stride[j] + 1
+              for j in range(ndim)]
+    out = np.zeros((N, O) + tuple(out_sp), np.float64)
+    cpg, opg = C // groups, O // groups
+    for n in range(N):
+        for o in range(O):
+            g = o // opg
+            for pos in itertools.product(*[range(s) for s in out_sp]):
+                acc = 0.0
+                for ci in range(cpg):
+                    for kpos in itertools.product(*[range(kk) for kk in k]):
+                        xi = [pos[j] * stride[j] + kpos[j] * dilate[j]
+                              for j in range(ndim)]
+                        acc += (xp[(n, g * cpg + ci) + tuple(xi)]
+                                * w[(o, ci) + kpos])
+                out[(n, o) + pos] = acc
+            if b is not None:
+                out[n, o] += b[o]
+    return out
+
+
+def np_pool(x, kernel, stride, pad, mode, count_include_pad=True,
+            convention="valid"):
+    """Direct N-d pooling (max/avg), NC+spatial."""
+    ndim = x.ndim - 2
+    fill = -np.inf if mode == "max" else 0.0
+    xp = np.pad(x, [(0, 0), (0, 0)] + [(p, p) for p in pad],
+                constant_values=fill)
+    size = lambda i, j: (
+        int(np.ceil((i + 2 * pad[j] - kernel[j]) / stride[j])) + 1
+        if convention == "full"
+        else (i + 2 * pad[j] - kernel[j]) // stride[j] + 1)
+    out_sp = [size(x.shape[2 + j], j) for j in range(ndim)]
+    out = np.zeros(x.shape[:2] + tuple(out_sp), np.float64)
+    for n in range(x.shape[0]):
+        for c in range(x.shape[1]):
+            for pos in itertools.product(*[range(s) for s in out_sp]):
+                vals = []
+                n_valid = 0
+                for kpos in itertools.product(*[range(kk) for kk in kernel]):
+                    xi = [pos[j] * stride[j] + kpos[j] for j in range(ndim)]
+                    if any(xi[j] >= xp.shape[2 + j] for j in range(ndim)):
+                        continue  # 'full' windows may overhang the edge
+                    vals.append(xp[(n, c) + tuple(xi)])
+                    in_core = all(pad[j] <= xi[j] < pad[j] + x.shape[2 + j]
+                                  for j in range(ndim))
+                    n_valid += int(in_core)
+                if mode == "max":
+                    out[(n, c) + pos] = max(vals)
+                else:
+                    # include_pad divides by the FULL kernel volume —
+                    # 'full'-convention windows overhanging the padded
+                    # edge count the missing cells as zeros (reference
+                    # pool.h GetPadSize semantics)
+                    denom = (int(np.prod(kernel)) if count_include_pad
+                             else max(n_valid, 1))
+                    out[(n, c) + pos] = sum(vals) / denom
+    return out
+
+
+# --- Convolution grid ------------------------------------------------------
+CONV_GRID = [
+    # (xshape, nfilter, kernel, stride, pad, dilate, groups)
+    ((2, 3, 7, 7), 4, (3, 3), (1, 1), (0, 0), (1, 1), 1),
+    ((2, 3, 7, 7), 4, (3, 3), (2, 2), (1, 1), (1, 1), 1),
+    ((2, 4, 8, 8), 6, (3, 3), (1, 1), (1, 1), (2, 2), 2),
+    ((1, 2, 9, 9), 2, (2, 2), (3, 3), (2, 2), (1, 1), 1),
+    ((2, 6, 6, 6), 6, (3, 3), (2, 1), (0, 1), (1, 2), 3),
+    ((2, 4, 5, 5), 4, (1, 1), (2, 2), (0, 0), (1, 1), 4),  # depthwise-ish
+    ((2, 3, 9), 5, (3,), (2,), (1,), (2,), 1),              # 1D
+    ((1, 2, 4, 5, 6), 3, (2, 3, 2), (2, 1, 2), (1, 0, 1), (1, 1, 1), 1),  # 3D
+]
+
+
+@pytest.mark.parametrize("case", CONV_GRID,
+                         ids=[f"conv{i}" for i in range(len(CONV_GRID))])
+def test_convolution_grid_vs_numpy(case):
+    xshape, nf, kernel, stride, pad, dilate, groups = case
+    x = rng.randn(*xshape).astype(np.float32)
+    w = rng.randn(nf, xshape[1] // groups, *kernel).astype(np.float32)
+    b = rng.randn(nf).astype(np.float32)
+    got = nd.Convolution(nd.array(x), nd.array(w), nd.array(b),
+                         kernel=kernel, num_filter=nf, stride=stride,
+                         pad=pad, dilate=dilate,
+                         num_group=groups).asnumpy()
+    want = np_conv(x, w, b, stride, pad, dilate, groups)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("case", CONV_GRID[:5],
+                         ids=[f"grad{i}" for i in range(5)])
+def test_convolution_grid_gradients(case):
+    """Both grads against the numpy oracle through finite differences of
+    the oracle itself (NOT the op) — catches fwd+bwd disagreeing
+    together."""
+    xshape, nf, kernel, stride, pad, dilate, groups = case
+    x = nd.array(rng.randn(*xshape).astype(np.float32) * 0.5)
+    w = nd.array(rng.randn(nf, xshape[1] // groups,
+                           *kernel).astype(np.float32) * 0.5)
+    x.attach_grad()
+    w.attach_grad()
+    cot = rng.randn(*np_conv(x.asnumpy(), w.asnumpy(), None, stride, pad,
+                             dilate, groups).shape).astype(np.float32)
+    with mx.autograd.record():
+        y = nd.Convolution(x, w, kernel=kernel, num_filter=nf,
+                           stride=stride, pad=pad, dilate=dilate,
+                           num_group=groups, no_bias=True)
+        loss = (y * nd.array(cot)).sum()
+    loss.backward()
+    eps = 1e-2
+
+    def fd(arr, grad, tag):
+        flat = arr.asnumpy().ravel()
+        idxs = rng.choice(flat.size, size=min(8, flat.size), replace=False)
+        for i in idxs:
+            for sgn, store in ((1, "p"), (-1, "m")):
+                pert = flat.copy()
+                pert[i] += sgn * eps
+                out = np_conv(
+                    pert.reshape(arr.shape) if tag == "x" else x.asnumpy(),
+                    pert.reshape(arr.shape) if tag == "w" else w.asnumpy(),
+                    None, stride, pad, dilate, groups)
+                if sgn == 1:
+                    up = (out * cot).sum()
+                else:
+                    lo = (out * cot).sum()
+            num = (up - lo) / (2 * eps)
+            np.testing.assert_allclose(grad.asnumpy().ravel()[i], num,
+                                       rtol=2e-2, atol=2e-2)
+
+    fd(x, x.grad, "x")
+    fd(w, w.grad, "w")
+
+
+def test_convolution_bf16_matches_f32_oracle():
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    w = rng.randn(4, 3, 3, 3).astype(np.float32)
+    got = nd.Convolution(nd.array(x).astype("bfloat16"),
+                         nd.array(w).astype("bfloat16"),
+                         kernel=(3, 3), num_filter=4, stride=(2, 2),
+                         pad=(1, 1), no_bias=True)
+    want = np_conv(x, w, None, (2, 2), (1, 1), (1, 1), 1)
+    np.testing.assert_allclose(np.asarray(got.astype("float32").asnumpy()),
+                               want, rtol=0.05, atol=0.1)
+
+
+def test_convolution_dilated_impulse_response():
+    """A unit impulse convolved with a dilated kernel reproduces the
+    kernel at dilated offsets (reference
+    test_convolution_dilated_impulse_response)."""
+    for dil in ((1, 1), (2, 2), (3, 3)):
+        x = np.zeros((1, 1, 14, 14), np.float32)
+        x[0, 0, 7, 7] = 1.0
+        w = rng.randn(1, 1, 3, 3).astype(np.float32)
+        y = nd.Convolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                           num_filter=1, pad=(3, 3), dilate=dil,
+                           no_bias=True).asnumpy()
+        want = np_conv(x, w, None, (1, 1), (3, 3), dil, 1)
+        np.testing.assert_allclose(y, want, rtol=1e-4, atol=1e-5)
+
+
+def test_convolution_independent_gradients():
+    """grad_req combinations: only the requested grads are produced
+    (reference test_convolution_independent_gradients)."""
+    from mxnet_tpu import sym
+    x = rng.randn(1, 3, 6, 6).astype(np.float32)
+    w = rng.randn(2, 3, 3, 3).astype(np.float32)
+    s = sym.Convolution(sym.var("x"), sym.var("w"), kernel=(3, 3),
+                        num_filter=2, no_bias=True, name="c")
+    for reqs in ({"x": "write", "w": "null"}, {"x": "null", "w": "write"},
+                 {"x": "write", "w": "write"}):
+        args = {"x": nd.array(x), "w": nd.array(w)}
+        grads = {k: nd.zeros(args[k].shape) for k, r in reqs.items()
+                 if r != "null"}
+        ex = s.bind(mx.cpu(), args, args_grad=grads, grad_req=reqs)
+        ex.forward(is_train=True)
+        ex.backward(nd.ones(ex.outputs[0].shape))
+        for k, r in reqs.items():
+            if r == "write":
+                assert float(np.abs(grads[k].asnumpy()).sum()) > 0, (reqs, k)
+            else:
+                assert k not in grads
+
+
+# --- Deconvolution ---------------------------------------------------------
+DECONV_GRID = [
+    # (xshape, nfilter, kernel, stride, pad, adj, dilate)
+    ((1, 1, 5, 5), 1, (3, 3), (1, 1), (1, 1), (0, 0), (1, 1)),
+    ((2, 3, 6, 6), 3, (3, 3), (2, 2), (1, 1), (1, 1), (1, 1)),
+    ((2, 2, 4, 4), 4, (2, 2), (3, 3), (0, 0), (2, 2), (1, 1)),
+    ((2, 3, 5, 5), 2, (3, 3), (2, 2), (2, 2), (0, 0), (2, 2)),
+    ((2, 3, 7), 2, (3,), (2,), (1,), (1,), (1,)),  # 1D
+]
+
+
+@pytest.mark.parametrize("case", DECONV_GRID,
+                         ids=[f"deconv{i}" for i in range(len(DECONV_GRID))])
+def test_deconvolution_adjoint_identity(case):
+    """<conv(x, w), y> == <x, deconv(y, w)> — Deconvolution IS the conv
+    transpose, checked exactly (the reference pins the same relation via
+    check_deconvolution_forward_backward)."""
+    xshape, nf, kernel, stride, pad, adj, dilate = case
+    ndim = len(kernel)
+    cin = xshape[1]
+    w = rng.randn(cin, nf, *kernel).astype(np.float32)
+    y = rng.randn(*xshape).astype(np.float32)  # deconv input
+    dec = nd.Deconvolution(nd.array(y), nd.array(w), kernel=kernel,
+                           num_filter=nf, stride=stride, pad=pad, adj=adj,
+                           dilate=dilate, no_bias=True).asnumpy()
+    # conv with the SAME geometry maps dec's shape back to y's shape;
+    # deconv weights (cin, nf, k) are EXACTLY that conv's OIHW weights
+    x = rng.randn(*dec.shape).astype(np.float32)
+    conv = np_conv(x, w, None, stride, pad, dilate, 1)
+    # conv output spatial may exceed y (adj trims the correspondence)
+    sl = (slice(None), slice(None)) + tuple(
+        slice(0, y.shape[2 + j]) for j in range(ndim))
+    lhs = float((conv[sl] * y).sum())
+    rhs = float((x * dec).sum())
+    np.testing.assert_allclose(lhs, rhs, rtol=1e-3)
+
+
+def test_deconvolution_target_shape():
+    """target_shape overrides pad/adj (reference test_deconvolution:
+    pad=(99,99)/adj=(101,101) are IGNORED)."""
+    y = nd.array(rng.randn(2, 3, 4, 4).astype(np.float32))
+    w = nd.array(rng.randn(3, 4, 3, 3).astype(np.float32))
+    out = nd.Deconvolution(y, w, kernel=(3, 3), num_filter=4,
+                           stride=(2, 2), pad=(99, 99), adj=(101, 101),
+                           target_shape=(8, 8))
+    assert out.shape == (2, 4, 8, 8), out.shape
+    out1 = nd.Deconvolution(nd.array(rng.randn(2, 3, 4).astype(np.float32)),
+                            nd.array(rng.randn(3, 4, 3).astype(np.float32)),
+                            kernel=(3,), num_filter=4, stride=(2,),
+                            pad=(99,), adj=(101,), target_shape=(8,))
+    assert out1.shape == (2, 4, 8), out1.shape
+
+
+def test_deconvolution_forward_with_bias():
+    y = rng.randn(1, 2, 3, 3).astype(np.float32)
+    w = rng.randn(2, 3, 2, 2).astype(np.float32)
+    b = rng.randn(3).astype(np.float32)
+    with_b = nd.Deconvolution(nd.array(y), nd.array(w), nd.array(b),
+                              kernel=(2, 2), num_filter=3).asnumpy()
+    no_b = nd.Deconvolution(nd.array(y), nd.array(w), kernel=(2, 2),
+                            num_filter=3, no_bias=True).asnumpy()
+    np.testing.assert_allclose(with_b, no_b + b.reshape(1, 3, 1, 1),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_deconvolution_gradient_finite_diff():
+    from mxnet_tpu.test_utils import check_numeric_gradient
+    y = rng.randn(1, 2, 4, 4).astype(np.float32)
+    w = rng.randn(2, 2, 3, 3).astype(np.float32)
+
+    def f(yy, ww):
+        return nd.Deconvolution(yy, ww, kernel=(3, 3), num_filter=2,
+                                stride=(2, 2), pad=(1, 1), no_bias=True)
+
+    check_numeric_gradient(f, [nd.array(y), nd.array(w)], rtol=5e-2,
+                           atol=5e-2, eps=1e-2)
+
+
+# --- Pooling grid ----------------------------------------------------------
+POOL_GRID = list(itertools.product(
+    ["max", "avg"], ["valid", "full"], [True, False],
+    [((2, 2), (2, 2), (0, 0)), ((3, 3), (2, 2), (1, 1)),
+     ((2, 3), (1, 2), (1, 0))]))
+
+
+@pytest.mark.parametrize(
+    "mode,conv,incl,geom", POOL_GRID,
+    ids=[f"{m}-{c}-{'incl' if i else 'excl'}-{g[0]}" for m, c, i, g in
+         POOL_GRID])
+def test_pooling_grid_vs_numpy(mode, conv, incl, geom):
+    kernel, stride, pad = geom
+    if mode == "max" and not incl:
+        pytest.skip("count_include_pad is an avg-pool knob")
+    x = rng.randn(2, 3, 7, 8).astype(np.float32)
+    got = nd.Pooling(nd.array(x), kernel=kernel, stride=stride, pad=pad,
+                     pool_type=mode, pooling_convention=conv,
+                     count_include_pad=incl).asnumpy()
+    want = np_pool(x, kernel, stride, pad, mode, incl, conv)
+    assert got.shape == want.shape, (got.shape, want.shape)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_pooling_1d_3d_vs_numpy():
+    x1 = rng.randn(2, 2, 9).astype(np.float32)
+    got = nd.Pooling(nd.array(x1), kernel=(3,), stride=(2,), pad=(1,),
+                     pool_type="avg").asnumpy()
+    np.testing.assert_allclose(got, np_pool(x1, (3,), (2,), (1,), "avg"),
+                               rtol=1e-5, atol=1e-6)
+    x3 = rng.randn(1, 2, 4, 5, 4).astype(np.float32)
+    got = nd.Pooling(nd.array(x3), kernel=(2, 2, 2), stride=(2, 1, 2),
+                     pad=(0, 1, 0), pool_type="max").asnumpy()
+    np.testing.assert_allclose(
+        got, np_pool(x3, (2, 2, 2), (2, 1, 2), (0, 1, 0), "max"),
+        rtol=1e-5, atol=1e-6)
+
+
+def test_max_pool_gradient_routes_to_argmax():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    xd = nd.array(x)
+    xd.attach_grad()
+    with mx.autograd.record():
+        y = nd.Pooling(xd, kernel=(2, 2), stride=(2, 2), pool_type="max")
+        loss = y.sum()
+    loss.backward()
+    want = np.zeros_like(x)
+    want[0, 0, 1::2, 1::2] = 1.0  # max of each 2x2 block is bottom-right
+    np.testing.assert_allclose(xd.grad.asnumpy(), want)
+
+
+# --- fused RNN vs hand-rolled numpy oracles --------------------------------
+def _np_rnn_cell(mode, xt, h, c, W_ih, W_hh, b_ih, b_hh):
+    g = xt @ W_ih.T + b_ih + h @ W_hh.T + b_hh
+    if mode == "rnn_tanh":
+        return np.tanh(g), None
+    if mode == "rnn_relu":
+        return np.maximum(g, 0), None
+    H = h.shape[-1]
+    if mode == "lstm":
+        i = 1 / (1 + np.exp(-g[:, :H]))
+        f = 1 / (1 + np.exp(-g[:, H:2 * H]))
+        gg = np.tanh(g[:, 2 * H:3 * H])
+        o = 1 / (1 + np.exp(-g[:, 3 * H:]))
+        c2 = f * c + i * gg
+        return o * np.tanh(c2), c2
+    if mode == "gru":
+        # cuDNN gating: reset applies to the RECURRENT candidate term
+        xg = xt @ W_ih.T + b_ih
+        hg = h @ W_hh.T + b_hh
+        r = 1 / (1 + np.exp(-(xg[:, :H] + hg[:, :H])))
+        z = 1 / (1 + np.exp(-(xg[:, H:2 * H] + hg[:, H:2 * H])))
+        n = np.tanh(xg[:, 2 * H:] + r * hg[:, 2 * H:])
+        return (1 - z) * n + z * h, None
+    raise AssertionError(mode)
+
+
+def _np_rnn(mode, x, h0, c0, weights, biases, bidir):
+    """weights/biases per direction-layer as (W_ih, W_hh)/(b_ih, b_hh)."""
+    dirs = 2 if bidir else 1
+    T, N, _ = x.shape
+    outs_h, outs_c = [], []
+    layer_in = x
+    n_layers = len(weights) // dirs
+    for layer in range(n_layers):
+        per_dir = []
+        for d in range(dirs):
+            li = layer * dirs + d
+            W_ih, W_hh = weights[li]
+            b_ih, b_hh = biases[li]
+            h = h0[li].copy()
+            c = c0[li].copy() if c0 is not None else None
+            seq = layer_in[::-1] if d == 1 else layer_in
+            ys = []
+            for t in range(T):
+                h, c = _np_rnn_cell(mode, seq[t], h, c, W_ih, W_hh, b_ih,
+                                    b_hh)
+                ys.append(h)
+            ys = np.stack(ys)
+            if d == 1:
+                ys = ys[::-1]
+            per_dir.append(ys)
+            outs_h.append(h)
+            if c is not None:
+                outs_c.append(c)
+        layer_in = np.concatenate(per_dir, axis=-1)
+    return layer_in, np.stack(outs_h), (np.stack(outs_c) if outs_c else None)
+
+
+def _pack_rnn_params(mode, weights, biases):
+    """Flatten per-layer (W_ih, W_hh, b_ih, b_hh) into the fused layout
+    (all W_ih+W_hh first, then all biases — the cuDNN packing the op
+    documents in rnn_unpack_params)."""
+    flat = []
+    for W_ih, W_hh in weights:
+        flat.extend([W_ih.ravel(), W_hh.ravel()])
+    for b_ih, b_hh in biases:
+        flat.extend([b_ih.ravel(), b_hh.ravel()])
+    return np.concatenate(flat).astype(np.float32)
+
+
+_GATES = {"rnn_tanh": 1, "rnn_relu": 1, "lstm": 4, "gru": 3}
+
+
+@pytest.mark.parametrize("mode", ["rnn_tanh", "rnn_relu", "lstm", "gru"])
+@pytest.mark.parametrize("bidir", [False, True],
+                         ids=["unidir", "bidir"])
+def test_fused_rnn_vs_numpy_oracle(mode, bidir):
+    T, N, I, H, L = 5, 3, 4, 6, 2
+    dirs = 2 if bidir else 1
+    G = _GATES[mode]
+    weights, biases = [], []
+    for layer in range(L):
+        for d in range(dirs):
+            in_sz = I if layer == 0 else H * dirs
+            weights.append((rng.randn(G * H, in_sz).astype(np.float32) * .3,
+                            rng.randn(G * H, H).astype(np.float32) * .3))
+            biases.append((rng.randn(G * H).astype(np.float32) * .1,
+                           rng.randn(G * H).astype(np.float32) * .1))
+    x = rng.randn(T, N, I).astype(np.float32)
+    h0 = rng.randn(L * dirs, N, H).astype(np.float32)
+    c0 = rng.randn(L * dirs, N, H).astype(np.float32) \
+        if mode == "lstm" else None
+    params = _pack_rnn_params(mode, weights, biases)
+
+    args = [nd.array(x), nd.array(params), nd.array(h0)]
+    if mode == "lstm":
+        args.append(nd.array(c0))
+    outs = nd.RNN(*args, state_size=H, num_layers=L, mode=mode,
+                  bidirectional=bidir, state_outputs=True)
+    y = outs[0].asnumpy() if isinstance(outs, (list, tuple)) else outs.asnumpy()
+    want_y, want_h, want_c = _np_rnn(mode, x, h0, c0, weights, biases,
+                                     bidir)
+    np.testing.assert_allclose(y, want_y, rtol=2e-4, atol=2e-4)
+    if isinstance(outs, (list, tuple)) and len(outs) > 1:
+        np.testing.assert_allclose(outs[1].asnumpy(), want_h, rtol=2e-4,
+                                   atol=2e-4)
+        if mode == "lstm" and len(outs) > 2:
+            np.testing.assert_allclose(outs[2].asnumpy(), want_c,
+                                       rtol=2e-4, atol=2e-4)
+
+
+def test_variable_length_sequence_ops_vs_numpy():
+    """SequenceMask / SequenceLast / SequenceReverse with ragged lengths
+    — the variable-length contract the fused RNN pipeline relies on."""
+    T, N, D = 6, 4, 3
+    x = rng.randn(T, N, D).astype(np.float32)
+    lens = np.array([1, 6, 3, 4], np.float32)
+    masked = nd.SequenceMask(nd.array(x), nd.array(lens),
+                             use_sequence_length=True,
+                             value=-7.0).asnumpy()
+    want = x.copy()
+    for n, l in enumerate(lens.astype(int)):
+        want[l:, n] = -7.0
+    np.testing.assert_allclose(masked, want)
+
+    last = nd.SequenceLast(nd.array(x), nd.array(lens),
+                           use_sequence_length=True).asnumpy()
+    want_last = np.stack([x[int(l) - 1, n] for n, l in enumerate(lens)])
+    np.testing.assert_allclose(last, want_last)
+
+    rev = nd.SequenceReverse(nd.array(x), nd.array(lens),
+                             use_sequence_length=True).asnumpy()
+    want_rev = x.copy()
+    for n, l in enumerate(lens.astype(int)):
+        want_rev[:l, n] = x[:l, n][::-1]
+    np.testing.assert_allclose(rev, want_rev)
+
+
+# --- grad_req contracts ----------------------------------------------------
+def test_grad_req_add_accumulates_across_backwards():
+    """kAddTo parity: grad_req='add' accumulates into the caller's buffer
+    across executor backward calls; 'write' overwrites."""
+    from mxnet_tpu import sym
+    s = sym.square(sym.var("x"))
+    x = nd.array(np.array([1.0, 2.0, 3.0], np.float32))
+    for req, want_factor in (("write", 1), ("add", 2)):
+        gbuf = nd.zeros((3,))
+        ex = s.bind(mx.cpu(), {"x": x}, args_grad={"x": gbuf},
+                    grad_req={"x": req})
+        for _ in range(2):
+            ex.forward(is_train=True)
+            ex.backward(nd.ones((3,)))
+        want = 2 * x.asnumpy() * want_factor
+        np.testing.assert_allclose(gbuf.asnumpy(), want, rtol=1e-5)
+
+
+def test_grad_req_add_conv_weights():
+    """kAddTo through a real layered op (conv weight grads accumulate)."""
+    from mxnet_tpu import sym
+    s = sym.Convolution(sym.var("x"), sym.var("w"), kernel=(3, 3),
+                        num_filter=2, no_bias=True)
+    x = nd.array(rng.randn(1, 2, 5, 5).astype(np.float32))
+    w = nd.array(rng.randn(2, 2, 3, 3).astype(np.float32))
+    gw = nd.zeros(w.shape)
+    ex = s.bind(mx.cpu(), {"x": x, "w": w}, args_grad={"w": gw},
+                grad_req={"x": "null", "w": "add"})
+    ex.forward(is_train=True)
+    ex.backward(nd.ones(ex.outputs[0].shape))
+    once = gw.asnumpy().copy()
+    ex.forward(is_train=True)
+    ex.backward(nd.ones(ex.outputs[0].shape))
+    np.testing.assert_allclose(gw.asnumpy(), 2 * once, rtol=1e-4,
+                               atol=1e-5)
+
+
+# --- normalization family vs numpy oracles ---------------------------------
+def np_batchnorm(x, gamma, beta, mean, var, eps, axis, fix_gamma):
+    g = np.ones_like(gamma) if fix_gamma else gamma
+    bshape = tuple(x.shape[i] if i == axis else 1 for i in range(x.ndim))
+    inv = 1.0 / np.sqrt(var.astype(np.float64) + eps)
+    a = g * inv
+    b = beta - mean * a
+    return x * a.reshape(bshape) + b.reshape(bshape)
+
+
+@pytest.mark.parametrize("axis", [1, -1, 2])
+@pytest.mark.parametrize("fix_gamma", [True, False],
+                         ids=["fixg", "freeg"])
+def test_batchnorm_training_grid(axis, fix_gamma):
+    """Training-mode BN over axis x fix_gamma: output AND the returned
+    moving-stat updates against the closed-form oracle."""
+    x = rng.randn(4, 3, 5, 6).astype(np.float32) * 2 + 1
+    C = x.shape[axis]
+    gamma = rng.rand(C).astype(np.float32) + 0.5
+    beta = rng.randn(C).astype(np.float32)
+    mm = rng.randn(C).astype(np.float32)
+    mv = rng.rand(C).astype(np.float32) + 0.5
+    momentum, eps = 0.9, 1e-3
+    from mxnet_tpu.ops import registry
+    bn = registry.get("BatchNorm").fcompute
+    out, new_mm, new_mv = bn(
+        {"eps": eps, "momentum": momentum, "axis": axis,
+         "_training": True, "fix_gamma": fix_gamma},
+        *(np.asarray(a) for a in (x, gamma, beta, mm, mv)))
+    red = tuple(i for i in range(x.ndim) if i != (axis % x.ndim))
+    bmean = x.mean(axis=red)
+    bvar = x.var(axis=red)
+    want = np_batchnorm(x, gamma, beta, bmean, bvar, eps, axis % x.ndim,
+                        fix_gamma)
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-4,
+                               atol=1e-4)
+    np.testing.assert_allclose(np.asarray(new_mm),
+                               mm * momentum + bmean * (1 - momentum),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(new_mv),
+                               mv * momentum + bvar * (1 - momentum),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_batchnorm_use_global_stats_ignores_batch():
+    """use_global_stats=True must normalize by the MOVING stats even in
+    training mode and leave them unchanged."""
+    from mxnet_tpu.ops import registry
+    bn = registry.get("BatchNorm").fcompute
+    x = rng.randn(2, 3, 4, 4).astype(np.float32) * 10
+    gamma = np.ones(3, np.float32)
+    beta = np.zeros(3, np.float32)
+    mm = np.array([0.5, -0.5, 2.0], np.float32)
+    mv = np.array([1.0, 4.0, 0.25], np.float32)
+    out, new_mm, new_mv = bn(
+        {"eps": 1e-3, "_training": True, "use_global_stats": True,
+         "fix_gamma": False}, x, gamma, beta, mm, mv)
+    want = np_batchnorm(x, gamma, beta, mm, mv, 1e-3, 1, False)
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-4,
+                               atol=1e-4)
+    np.testing.assert_allclose(np.asarray(new_mm), mm)
+    np.testing.assert_allclose(np.asarray(new_mv), mv)
+
+
+def test_layernorm_instance_l2norm_vs_numpy():
+    x = rng.randn(3, 4, 5).astype(np.float32) * 3 + 2
+    g = rng.rand(5).astype(np.float32) + 0.5
+    b = rng.randn(5).astype(np.float32)
+    got = nd.LayerNorm(nd.array(x), nd.array(g), nd.array(b),
+                       axis=-1, eps=1e-5).asnumpy()
+    mu = x.mean(-1, keepdims=True)
+    sd = np.sqrt(x.var(-1, keepdims=True) + 1e-5)
+    np.testing.assert_allclose(got, (x - mu) / sd * g + b, rtol=1e-4,
+                               atol=1e-4)
+
+    xi = rng.randn(2, 3, 4, 4).astype(np.float32)
+    gi = rng.rand(3).astype(np.float32)
+    bi = rng.randn(3).astype(np.float32)
+    got = nd.InstanceNorm(nd.array(xi), nd.array(gi), nd.array(bi),
+                          eps=1e-5).asnumpy()
+    mu = xi.mean((2, 3), keepdims=True)
+    sd = np.sqrt(xi.var((2, 3), keepdims=True) + 1e-5)
+    want = (xi - mu) / sd * gi.reshape(1, 3, 1, 1) + bi.reshape(1, 3, 1, 1)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    xl = rng.randn(2, 6).astype(np.float32)
+    got = nd.L2Normalization(nd.array(xl), mode="instance").asnumpy()
+    want = xl / np.sqrt((xl ** 2).sum(-1, keepdims=True) + 1e-10)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_batchnorm_gradient_vs_finite_diff():
+    """BN training-mode grads (through batch statistics!) against finite
+    differences of the numpy oracle."""
+    x0 = rng.randn(3, 2, 4).astype(np.float32)
+    gamma0 = rng.rand(2).astype(np.float32) + 0.5
+    beta0 = rng.randn(2).astype(np.float32)
+    cot = rng.randn(3, 2, 4).astype(np.float32)
+
+    def oracle(xf, gf, bf):
+        mean = xf.mean(axis=(0, 2))
+        var = xf.var(axis=(0, 2))
+        return np_batchnorm(xf, gf, bf, mean, var, 1e-3, 1, False)
+
+    x = nd.array(x0)
+    gamma = nd.array(gamma0)
+    beta = nd.array(beta0)
+    for v in (x, gamma, beta):
+        v.attach_grad()
+    with mx.autograd.record():
+        y = nd.BatchNorm(x, gamma, beta, nd.zeros(2), nd.ones(2),
+                         fix_gamma=False, eps=1e-3)
+        loss = (y * nd.array(cot)).sum()
+    loss.backward()
+
+    eps = 1e-3
+    for arr, grad, slot in ((x0, x.grad, 0), (gamma0, gamma.grad, 1),
+                            (beta0, beta.grad, 2)):
+        flat = arr.ravel()
+        for i in rng.choice(flat.size, size=min(6, flat.size),
+                            replace=False):
+            args = [x0.copy(), gamma0.copy(), beta0.copy()]
+            args[slot].ravel()[i] += eps
+            up = (oracle(*args) * cot).sum()
+            args[slot].ravel()[i] -= 2 * eps
+            lo = (oracle(*args) * cot).sum()
+            num = (up - lo) / (2 * eps)
+            np.testing.assert_allclose(grad.asnumpy().ravel()[i], num,
+                                       rtol=3e-2, atol=3e-2)
