@@ -879,27 +879,37 @@ def atomic_symbol_info(name):
     from .ops import registry
     op = registry.get(name)
     doc = (getattr(op, "fcompute", None) and op.fcompute.__doc__) or ""
-    names = getattr(op, "input_names", None)
-    args = list(names) if names and not callable(names) else []
+    key_var = ""
+    # declared input ROLES first (resolve_input_names handles the ops
+    # whose declaration is attr-dependent, e.g. Convolution's optional
+    # bias) — these are the names the symbol layer accepts as keywords
+    try:
+        names = op.resolve_input_names({})
+    except Exception:
+        names = getattr(op, "input_names", None)
+        names = None if callable(names) else names
+    args = list(names) if names else []
     if not args and getattr(op, "fcompute", None) is not None:
         # fall back to the compute function's own positional parameters
-        # (skip the attrs dict) so multi-input ops report a real arity —
-        # a single hardcoded "data" misleads binding generators
+        # (skip the attrs dict) so multi-input ops report a real arity;
+        # variadic ops signal through key_var_num_args (the reference
+        # ABI's channel for add_n/concat-style arity)
         import inspect
         try:
             params = list(inspect.signature(op.fcompute).parameters
                           .values())[1:]
             args = [p.name for p in params
                     if p.kind in (p.POSITIONAL_OR_KEYWORD, p.POSITIONAL_ONLY)]
-            var = [p for p in params if p.kind == p.VAR_POSITIONAL]
-            if var:
-                args.append(f"*{var[0].name}")
+            if any(p.kind == p.VAR_POSITIONAL for p in params):
+                key_var = "num_args"
+                if not args:
+                    args = ["data"]
         except (TypeError, ValueError):
             args = ["data"]
     if not args and not getattr(op, "eager_only", False):
         args = ["data"]
     return (name, doc, args, ["NDArray-or-Symbol"] * len(args),
-            [""] * len(args), "", "")
+            [""] * len(args), key_var, "")
 
 
 def symbol_copy(s):

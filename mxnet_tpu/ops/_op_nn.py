@@ -16,6 +16,7 @@ import numpy as np
 from jax import lax
 
 from .registry import register
+from ..base import MXNetError
 
 
 def _attr_bool(v):
@@ -138,6 +139,10 @@ def _deconvolution(attrs, x, weight, *maybe_bias):
         # target_shape overrides pad/adj (reference deconvolution-inl.h:
         # InferPad — pad/adj attrs are IGNORED when a target is given)
         tshape = (tshape,) if isinstance(tshape, int) else tuple(tshape)
+        if len(tshape) != nd:
+            raise MXNetError(
+                f"target_shape {tshape} must have {nd} dims to match "
+                f"kernel {kernel}")
         in_sp = x.shape[2:] if not layout.endswith("C") else x.shape[1:-1]
         # reference InferPad (deconvolution-inl.h:138): total excess =
         # s*(i-1) + k_eff - target; odd totals put the EXTRA row in pad
@@ -163,7 +168,9 @@ def _deconvolution(attrs, x, weight, *maybe_bias):
         lhs_dilation=stride, rhs_dilation=dilate, dimension_numbers=dn,
         feature_group_count=groups)
     if maybe_bias and not bool(attrs.get("no_bias", False)):
-        out = out + maybe_bias[0].reshape((1, -1) + (1,) * nd)
+        b = maybe_bias[0]
+        out = out + (b if layout.endswith("C")
+                     else b.reshape((1, -1) + (1,) * nd))
     return out
 
 

@@ -1,0 +1,55 @@
+"""Shared plumbing for the TPU-relay measurement tools.
+
+The axon relay fails in two distinct ways and every tool must survive
+both: a HANG at backend init (the relay accepts the dial and never
+answers — only a watchdog thread + os._exit escapes it) and a FLAP
+mid-run (individual device ops stall).  Tools also must end with
+os._exit after flushing: a wedged relay client thread otherwise keeps
+the interpreter alive after main() returns, eating one process per
+relay-up window in automation.
+"""
+import json
+import os
+import sys
+import threading
+import time
+
+T0 = time.perf_counter()
+
+
+def log(tag, msg):
+    print(f"[{tag} +{time.perf_counter() - T0:6.1f}s] {msg}",
+          file=sys.stderr, flush=True)
+
+
+def arm_watchdog(seconds, payload):
+    """Print ``payload`` as JSON and hard-exit unless disarm() is called
+    within ``seconds``.  Returns the disarm callable; seconds <= 0 arms
+    nothing."""
+    done = threading.Event()
+    if seconds > 0:
+        def run():
+            if not done.wait(seconds):
+                print(json.dumps(payload), flush=True)
+                os._exit(3)
+        threading.Thread(target=run, daemon=True).start()
+    return done.set
+
+
+def finish(rc):
+    """Flush and hard-exit: relay client threads must not keep a finished
+    tool alive."""
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(rc)
+
+
+def cpu_only_backend():
+    """Pin the CPU backend WITHOUT initializing the axon plugin (its init
+    dials the relay and hangs when the tunnel is down)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    import jax._src.xla_bridge as _xb
+    _xb._backend_factories.pop("axon", None)
+    jax.config.update("jax_platforms", "cpu")
+    return jax

@@ -1,0 +1,223 @@
+#!/usr/bin/env python
+"""Validate the three Pallas kernels ON THE REAL CHIP (VERDICT r4 item 2).
+
+Per kernel (LayerNorm, flash attention, softmax-CE): compile with
+interpret=False on the TPU, assert numerics against the XLA fallback, and
+time both with the transfer-sync differencing methodology bench.py
+established (block_until_ready is NOT a barrier on the axon relay; only a
+device->host transfer is, and the fixed relay roundtrip is cancelled by
+the (T(2R)-T(R))/R quotient).
+
+Writes docs/tpu_kernel_table.json and prints a markdown table.  Exits
+fast with a structured error when the relay is down — run it at every
+relay-up window.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from _relay_util import T0, arm_watchdog, cpu_only_backend, finish
+from _relay_util import log as _log
+
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "docs", "tpu_kernel_table.json")
+
+
+def log(m):
+    _log("kcheck", m)
+
+
+def _timed_pair(fn, args, reps):
+    """Per-call time via rep differencing with transfer sync.
+
+    ``fn(carry, *rest)`` must return an array shaped like ``carry`` so the
+    fori_loop iterations form a non-hoistable sequential chain.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def chain(r, salt, *a):
+        a0 = a[0] + (salt * 1e-30).astype(a[0].dtype)
+
+        def body(_, carry):
+            return fn(carry, *a[1:]).astype(carry.dtype)
+
+        out = lax.fori_loop(0, r, body, a0)
+        return out.reshape(-1)[0].astype(jnp.float32)
+
+    jitted = jax.jit(chain, static_argnums=())
+    float(jitted(2, jnp.float32(1), *args))  # compile + warm
+    calls = [1]
+
+    def t(r):
+        best = None
+        for _ in range(3):
+            calls[0] += 1
+            t0 = time.perf_counter()
+            float(jitted(r, jnp.float32(calls[0]), *args))
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        return best
+
+    t1, t2 = t(reps), t(2 * reps)
+    return max((t2 - t1) / reps, 1e-9)
+
+
+def main():
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                          os.path.join(os.path.dirname(OUT), "..",
+                                       ".jax_cache"))
+    result = {"kernels": {}, "device": None}
+
+    import numpy as np
+    interp = os.environ.get("KCHECK_INTERPRET", "0") == "1"
+    if interp:
+        jax = cpu_only_backend()  # dry run: never dial the relay
+        import jax.numpy as jnp
+        dev = jax.devices("cpu")[0]
+    else:
+        import jax
+        import jax.numpy as jnp
+        timeout = float(os.environ.get("KCHECK_INIT_TIMEOUT", 300))
+        disarm = arm_watchdog(timeout, {"error": "TPU relay unreachable"})
+        devs = jax.devices()
+        disarm()
+        dev = devs[0]
+        if dev.platform == "cpu":
+            print(json.dumps({"error": "no TPU device (cpu backend); "
+                              "set KCHECK_INTERPRET=1 for a dry run"}))
+            finish(1)
+        arm_watchdog(float(os.environ.get("KCHECK_BUDGET", 1800)),
+                     {"error": "kernel check wedged", "partial": OUT})
+    result["device"] = str(getattr(dev, "device_kind", dev))
+    log(f"device: {result['device']}")
+    rng = np.random.RandomState(0)
+    reps = int(os.environ.get("KCHECK_REPS", 20))
+    # interpret-mode dry runs shrink the shapes: the pallas interpreter is
+    # orders of magnitude slower than the compiled kernel
+    small = interp
+
+    # ---- LayerNorm -------------------------------------------------------
+    from mxnet_tpu.ops import pallas_norm as pn
+    n, d = (256, 128) if small else (4096, 1024)
+    x = jax.device_put(rng.randn(n, d).astype(np.float32), dev)
+    g = jax.device_put(rng.rand(d).astype(np.float32) + 0.5, dev)
+    b = jax.device_put(rng.randn(d).astype(np.float32), dev)
+
+    def ln_pallas(x2, g2, b2):
+        return pn._ln_fwd(x2, g2, b2, eps=1e-5,
+                          block_rows=pn._pick_block_rows(x2.shape[0]),
+                          interpret=interp)[0]
+
+    def ln_xla(x2, g2, b2):
+        mu = x2.mean(-1, keepdims=True)
+        var = ((x2 - mu) ** 2).mean(-1, keepdims=True)
+        return (x2 - mu) * jax.lax.rsqrt(var + 1e-5) * g2 + b2
+
+    try:
+        got = np.asarray(jax.jit(ln_pallas)(x, g, b))
+        want = np.asarray(jax.jit(ln_xla)(x, g, b))
+        err = float(np.abs(got - want).max())
+        tp = _timed_pair(lambda c, g2, b2: ln_pallas(c, g2, b2), (x, g, b),
+                         reps)
+        tx = _timed_pair(lambda c, g2, b2: ln_xla(c, g2, b2), (x, g, b),
+                         reps)
+        result["kernels"]["layer_norm"] = {
+            "shape": [n, d], "max_abs_err": err, "pallas_us": tp * 1e6,
+            "xla_us": tx * 1e6, "speedup": tx / tp,
+            "numerics_ok": bool(err < 1e-4)}
+        log(f"layer_norm err={err:.2e} pallas={tp*1e6:.1f}us "
+            f"xla={tx*1e6:.1f}us")
+    except Exception as e:
+        result["kernels"]["layer_norm"] = {"error": f"{type(e).__name__}: {e}"}
+
+    # ---- flash attention -------------------------------------------------
+    from mxnet_tpu.ops import pallas_attention as pa
+    B, H, S, D = (1, 2, 256, 32) if small else (4, 8, 1024, 64)
+    q = jax.device_put(rng.randn(B, H, S, D).astype(np.float32) * .3, dev)
+    k = jax.device_put(rng.randn(B, H, S, D).astype(np.float32) * .3, dev)
+    v = jax.device_put(rng.randn(B, H, S, D).astype(np.float32) * .3, dev)
+
+    def fa_pallas(qq, kk, vv):
+        return pa._flash_fwd(qq, kk, vv, causal=True, sm_scale=D ** -0.5,
+                             block_q=128, block_k=128, interpret=interp)[0]
+
+    def fa_xla(qq, kk, vv):
+        return pa._reference_attention(qq, kk, vv, True, D ** -0.5)
+
+    try:
+        got = np.asarray(jax.jit(fa_pallas)(q, k, v))
+        want = np.asarray(jax.jit(fa_xla)(q, k, v))
+        err = float(np.abs(got - want).max())
+        tp = _timed_pair(lambda c, kk, vv: fa_pallas(c, kk, vv), (q, k, v),
+                         reps)
+        tx = _timed_pair(lambda c, kk, vv: fa_xla(c, kk, vv), (q, k, v),
+                         reps)
+        result["kernels"]["flash_attention"] = {
+            "shape": [B, H, S, D], "max_abs_err": err,
+            "pallas_us": tp * 1e6, "xla_us": tx * 1e6, "speedup": tx / tp,
+            "numerics_ok": bool(err < 5e-3)}
+        log(f"flash_attention err={err:.2e} pallas={tp*1e6:.1f}us "
+            f"xla={tx*1e6:.1f}us")
+    except Exception as e:
+        result["kernels"]["flash_attention"] = {
+            "error": f"{type(e).__name__}: {e}"}
+
+    # ---- softmax cross-entropy -------------------------------------------
+    from mxnet_tpu.ops import pallas_softmax_ce as ps
+    n, c = (256, 128) if small else (4096, 1000)
+    logits = jax.device_put(rng.randn(n, c).astype(np.float32), dev)
+    labels = jax.device_put(rng.randint(0, c, n).astype(np.int32), dev)
+
+    def ce_pallas(lg, lb):
+        return ps._smce_fwd(lg, lb, block_rows=ps._pick_block_rows(n),
+                            interpret=interp)[0]
+
+    def ce_xla(lg, lb):
+        logp = jax.nn.log_softmax(lg, axis=-1)
+        return -jnp.take_along_axis(logp, lb[:, None], axis=-1)[:, 0]
+
+    try:
+        got = np.asarray(jax.jit(ce_pallas)(logits, labels))
+        want = np.asarray(jax.jit(ce_xla)(logits, labels))
+        err = float(np.abs(got - want).max())
+        # CE returns (n,) — fold it back to the (n, c) carry shape to keep
+        # the timing chain sequential
+        tp = _timed_pair(
+            lambda c2, lb: c2 + ce_pallas(c2, lb)[:, None] * 1e-30,
+            (logits, labels), reps)
+        tx = _timed_pair(
+            lambda c2, lb: c2 + ce_xla(c2, lb)[:, None] * 1e-30,
+            (logits, labels), reps)
+        result["kernels"]["softmax_ce"] = {
+            "shape": [n, c], "max_abs_err": err, "pallas_us": tp * 1e6,
+            "xla_us": tx * 1e6, "speedup": tx / tp,
+            "numerics_ok": bool(err < 1e-4)}
+        log(f"softmax_ce err={err:.2e} pallas={tp*1e6:.1f}us "
+            f"xla={tx*1e6:.1f}us")
+    except Exception as e:
+        result["kernels"]["softmax_ce"] = {"error": f"{type(e).__name__}: {e}"}
+
+    with open(OUT, "w") as f:
+        json.dump(result, f, indent=1)
+    print("| kernel | shape | max err | pallas | xla | speedup |")
+    print("|---|---|---|---|---|---|")
+    for nm, r in result["kernels"].items():
+        if "error" in r:
+            print(f"| {nm} | - | ERROR: {r['error']} | - | - | - |")
+        else:
+            print(f"| {nm} | {r['shape']} | {r['max_abs_err']:.2e} | "
+                  f"{r['pallas_us']:.1f}us | {r['xla_us']:.1f}us | "
+                  f"{r['speedup']:.2f}x |")
+    print(json.dumps({"metric": "tpu_kernel_check", "ok": all(
+        r.get("numerics_ok") for r in result["kernels"].values())}))
+    finish(0)
+
+
+if __name__ == "__main__":
+    main()
