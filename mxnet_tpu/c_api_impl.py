@@ -901,7 +901,16 @@ def atomic_symbol_info(name):
             args = [p.name for p in params
                     if p.kind in (p.POSITIONAL_OR_KEYWORD, p.POSITIONAL_ONLY)]
             if any(p.kind == p.VAR_POSITIONAL for p in params):
-                key_var = "num_args"
+                # the arity attr differs per family (num_weights for
+                # multi_sgd_*, num_arrays for multi_all_finite, ...):
+                # read it off the compute source rather than guessing
+                import re
+                try:
+                    m = re.search(r"attrs(?:\.get\(|\[)[\"'](num_\w+)",
+                                  inspect.getsource(op.fcompute))
+                    key_var = m.group(1) if m else "num_args"
+                except (OSError, TypeError):
+                    key_var = "num_args"
                 if not args:
                     args = ["data"]
         except (TypeError, ValueError):

@@ -277,3 +277,212 @@ def __getattr__(name):
         return fn
     raise AttributeError(
         f"module 'mxnet_tpu.ndarray.contrib' has no attribute {name!r}")
+
+
+# ---------------------------------------------------------------------------
+# DGL graph-sampling family (reference: src/operator/contrib/dgl_graph.cc).
+# These are data-pipeline ops — dynamic shapes, host-side graph walks — so
+# they run on host numpy over CSRNDArray containers (the same stance the
+# reference takes: FComputeEx CPU-only kernels, no GPU version exists).
+# ---------------------------------------------------------------------------
+def _csr_parts(csr):
+    indptr = np.asarray(csr.indptr.asnumpy(), np.int64)
+    indices = np.asarray(csr.indices.asnumpy(), np.int64)
+    data = np.asarray(csr.data.asnumpy())
+    return indptr, indices, data
+
+
+def _make_csr(data, indices, indptr, shape, ctx):
+    from . import sparse as _sp
+    return _sp.CSRNDArray(jnp.asarray(data), jnp.asarray(indices),
+                          jnp.asarray(indptr), shape, ctx)
+
+
+def _neighbor_sample(parts, seeds, num_hops, num_neighbor,
+                     max_num_vertices, prob=None):
+    """One seed array -> (vertices[max+1], sub_csr, layers[max]).
+
+    BFS from the seeds; each hop samples up to ``num_neighbor`` of a
+    frontier vertex's neighbors (uniformly, or weighted by ``prob``)
+    without replacement.  Sub-graph rows/cols are COMPACTED ids: row i of
+    the sub CSR is vertices[i]; data values keep the original edge ids
+    (reference dgl_graph.cc:744 contract).  ``parts`` is the host-side
+    (indptr, indices, data) triple — hoisted by the callers so the graph
+    transfers from device ONCE per call, not once per seed array.
+    """
+    indptr, indices, data = parts
+    seeds = np.asarray(seeds.asnumpy(), np.int64).ravel()
+    seeds = seeds[seeds >= 0]
+    layer_of = {int(v): 0 for v in seeds}
+    edges = []  # (src, dst, edge_id)
+    frontier = list(layer_of)
+    for hop in range(1, num_hops + 1):
+        nxt = []
+        for v in frontier:
+            lo, hi = int(indptr[v]), int(indptr[v + 1])
+            deg = hi - lo
+            if deg == 0:
+                continue
+            k = min(num_neighbor, deg)
+            if prob is None:
+                pick = np.random.choice(deg, size=k, replace=False)
+            else:
+                p = np.asarray(prob[indices[lo:hi]], np.float64)
+                s = p.sum()
+                if s <= 0:
+                    continue
+                pick = np.random.choice(deg, size=min(k, int((p > 0).sum())),
+                                        replace=False, p=p / s)
+            for j in pick:
+                u = int(indices[lo + j])
+                edges.append((v, u, data[lo + j]))
+                if u not in layer_of and \
+                        len(layer_of) < max_num_vertices:
+                    layer_of[u] = hop
+                    nxt.append(u)
+        frontier = nxt
+    verts = np.array(sorted(layer_of), np.int64)
+    n = len(verts)
+    if n > max_num_vertices:
+        raise MXNetError(
+            f"sampled {n} vertices > max_num_vertices {max_num_vertices}")
+    vout = np.full(max_num_vertices + 1, -1, np.int64)
+    vout[:n] = verts
+    vout[-1] = n
+    lout = np.full(max_num_vertices, -1, np.int64)
+    lout[:n] = [layer_of[int(v)] for v in verts]
+    # compacted-id sub CSR
+    new_id = {int(v): i for i, v in enumerate(verts)}
+    rows = [[] for _ in range(max_num_vertices)]
+    for s, d, eid in edges:
+        if int(s) in new_id and int(d) in new_id:
+            rows[new_id[int(s)]].append((new_id[int(d)], eid))
+    sub_indptr = np.zeros(max_num_vertices + 1, np.int64)
+    sub_indices, sub_data = [], []
+    for i, row in enumerate(rows):
+        row.sort()
+        sub_indices.extend(c for c, _ in row)
+        sub_data.extend(e for _, e in row)
+        sub_indptr[i + 1] = len(sub_indices)
+    return (vout, (np.asarray(sub_data, data.dtype),
+                   np.asarray(sub_indices, np.int64), sub_indptr,
+                   (max_num_vertices, max_num_vertices)), lout)
+
+
+def dgl_csr_neighbor_uniform_sample(csr_matrix, *seed_arrays, num_args=None,
+                                    num_hops=1, num_neighbor=2,
+                                    max_num_vertices=100):
+    """Uniform neighbor sampling for DGL (reference
+    contrib/dgl_graph.cc:744 _contrib_dgl_csr_neighbor_uniform_sample).
+    Returns 3*N outputs: N vertex arrays (len max+1, last = count), N
+    sub-graph CSRNDArrays (compacted ids, original edge-id data), N layer
+    arrays (len max)."""
+    from . import array as nd_array
+    ctx = csr_matrix._ctx
+    parts = _csr_parts(csr_matrix)
+    outs_v, outs_g, outs_l = [], [], []
+    for seeds in seed_arrays:
+        v, (d, i, p, shp), l = _neighbor_sample(
+            parts, seeds, int(num_hops), int(num_neighbor),
+            int(max_num_vertices))
+        outs_v.append(nd_array(v, ctx=ctx, dtype=np.int64))
+        outs_g.append(_make_csr(d, i, p, shp, ctx))
+        outs_l.append(nd_array(l, ctx=ctx, dtype=np.int64))
+    return outs_v + outs_g + outs_l
+
+
+def dgl_csr_neighbor_non_uniform_sample(csr_matrix, probability,
+                                        *seed_arrays, num_args=None,
+                                        num_hops=1, num_neighbor=2,
+                                        max_num_vertices=100):
+    """Probability-weighted variant (reference dgl_graph.cc
+    _contrib_dgl_csr_neighbor_non_uniform_sample): ``probability`` is a
+    per-VERTEX weight array; neighbors with zero weight are never
+    drawn."""
+    from . import array as nd_array
+    ctx = csr_matrix._ctx
+    parts = _csr_parts(csr_matrix)
+    prob = np.asarray(probability.asnumpy(), np.float64).ravel()
+    outs_v, outs_g, outs_l = [], [], []
+    for seeds in seed_arrays:
+        v, (d, i, p, shp), l = _neighbor_sample(
+            parts, seeds, int(num_hops), int(num_neighbor),
+            int(max_num_vertices), prob=prob)
+        outs_v.append(nd_array(v, ctx=ctx, dtype=np.int64))
+        outs_g.append(_make_csr(d, i, p, shp, ctx))
+        outs_l.append(nd_array(l, ctx=ctx, dtype=np.int64))
+    return outs_v + outs_g + outs_l
+
+
+def dgl_subgraph(graph, *vid_arrays, return_mapping=False, num_args=None):
+    """Induced subgraphs (reference dgl_graph.cc:1115 _contrib_dgl_subgraph):
+    per vertex-id array, the subgraph among exactly those vertices with
+    edges renumbered 1..M; with return_mapping=True also a CSR whose data
+    are the ORIGINAL edge ids."""
+    indptr, indices, data = _csr_parts(graph)
+    ctx = graph._ctx
+    subs, maps = [], []
+    for vids in vid_arrays:
+        vs = np.asarray(vids.asnumpy(), np.int64).ravel()
+        vs = vs[vs >= 0]
+        new_id = {int(v): i for i, v in enumerate(vs)}
+        n = len(vs)
+        sp_indptr = np.zeros(n + 1, np.int64)
+        sp_indices, sp_new, sp_orig = [], [], []
+        next_eid = 1
+        for i, v in enumerate(vs):
+            lo, hi = int(indptr[v]), int(indptr[v + 1])
+            row = []
+            for j in range(lo, hi):
+                u = int(indices[j])
+                if u in new_id:
+                    row.append((new_id[u], data[j]))
+            row.sort()
+            for c, orig in row:
+                sp_indices.append(c)
+                sp_new.append(next_eid)
+                sp_orig.append(orig)
+                next_eid += 1
+            sp_indptr[i + 1] = len(sp_indices)
+        idx = np.asarray(sp_indices, np.int64)
+        subs.append(_make_csr(np.asarray(sp_new, np.int64), idx,
+                              sp_indptr, (n, n), ctx))
+        if return_mapping:
+            maps.append(_make_csr(np.asarray(sp_orig, data.dtype), idx,
+                                  sp_indptr.copy(), (n, n), ctx))
+    return subs + maps if return_mapping else subs
+
+
+def dgl_adjacency(graph):
+    """Edge-id CSR -> float32 adjacency CSR with unit weights (reference
+    dgl_graph.cc:1376 _contrib_dgl_adjacency)."""
+    indptr, indices, data = _csr_parts(graph)
+    return _make_csr(np.ones_like(data, np.float32), indices, indptr,
+                     graph.shape, graph._ctx)
+
+
+def dgl_graph_compact(*graphs, graph_sizes=None, return_mapping=False,
+                      num_args=None):
+    """Trim padded subgraph CSRs to their live vertex count (reference
+    dgl_graph.cc:1551 _contrib_dgl_graph_compact).  ``graph_sizes`` gives
+    each graph's actual vertex count."""
+    if graph_sizes is None:
+        raise MXNetError("dgl_graph_compact requires graph_sizes=")
+    sizes = [int(s) for s in np.asarray(
+        graph_sizes.asnumpy() if hasattr(graph_sizes, "asnumpy")
+        else graph_sizes).ravel()]
+    if len(sizes) != len(graphs):
+        raise MXNetError(
+            f"dgl_graph_compact: {len(graphs)} graphs but "
+            f"{len(sizes)} graph_sizes")
+    outs = []
+    for g, n in zip(graphs, sizes):
+        indptr, indices, data = _csr_parts(g)
+        keep = indptr[n]
+        outs.append(_make_csr(data[:keep], indices[:keep],
+                              indptr[:n + 1].copy(), (n, n), g._ctx))
+    if return_mapping:
+        raise MXNetError(
+            "dgl_graph_compact return_mapping is not supported "
+            "(documented deviation: compaction here is a pure trim)")
+    return outs if len(outs) > 1 else outs[0]

