@@ -868,6 +868,17 @@ _c_custom_ops = {}
 # --- op discovery for binding generators (parity: c_api.h
 # MXSymbolListAtomicSymbolCreators:963 / GetAtomicSymbolName:974 /
 # GetAtomicSymbolInfo:1002 — what OpWrapperGenerator-style tools use) ------
+# ops whose input arity is an attr (reference key_var_num_args contract)
+_KEY_VAR_BY_OP = {
+    "add_n": "num_args", "Concat": "num_args", "concat": "num_args",
+    "rnn_param_concat": "num_args", "stack": "num_args",
+    "multi_all_finite": "num_arrays",
+    "multi_sgd_update": "num_weights",
+    "multi_sgd_mom_update": "num_weights",
+    "multi_mp_sgd_update": "num_weights",
+    "multi_mp_sgd_mom_update": "num_weights",
+    "multi_lars": "num_tensors",
+}
 def atomic_symbol_creators():
     from .ops import registry
     return sorted(registry.list_ops())
@@ -879,7 +890,10 @@ def atomic_symbol_info(name):
     from .ops import registry
     op = registry.get(name)
     doc = (getattr(op, "fcompute", None) and op.fcompute.__doc__) or ""
-    key_var = ""
+    # variadic arity attr by family (the reference's key_var_num_args
+    # channel); an explicit table — heuristics over fcompute source
+    # misfire on ordinary num_* params like Convolution's num_group
+    key_var = _KEY_VAR_BY_OP.get(name, "")
     # declared input ROLES first (resolve_input_names handles the ops
     # whose declaration is attr-dependent, e.g. Convolution's optional
     # bias) — these are the names the symbol layer accepts as keywords
@@ -900,19 +914,12 @@ def atomic_symbol_info(name):
                           .values())[1:]
             args = [p.name for p in params
                     if p.kind in (p.POSITIONAL_OR_KEYWORD, p.POSITIONAL_ONLY)]
-            if any(p.kind == p.VAR_POSITIONAL for p in params):
-                # the arity attr differs per family (num_weights for
-                # multi_sgd_*, num_arrays for multi_all_finite, ...):
-                # read it off the compute source rather than guessing
-                import re
-                try:
-                    m = re.search(r"attrs(?:\.get\(|\[)[\"'](num_\w+)",
-                                  inspect.getsource(op.fcompute))
-                    key_var = m.group(1) if m else "num_args"
-                except (OSError, TypeError):
-                    key_var = "num_args"
-                if not args:
-                    args = ["data"]
+            # a *args tail usually means an OPTIONAL trailing input
+            # (Convolution's bias, RNN's lstm cell state) — attr-driven
+            # arity is only claimed for table entries above
+            if not args and any(p.kind == p.VAR_POSITIONAL
+                                for p in params):
+                args = ["data"]
         except (TypeError, ValueError):
             args = ["data"]
     if not args and not getattr(op, "eager_only", False):

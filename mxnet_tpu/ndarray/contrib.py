@@ -468,6 +468,10 @@ def dgl_graph_compact(*graphs, graph_sizes=None, return_mapping=False,
     each graph's actual vertex count."""
     if graph_sizes is None:
         raise MXNetError("dgl_graph_compact requires graph_sizes=")
+    if return_mapping:
+        raise MXNetError(
+            "dgl_graph_compact return_mapping is not supported "
+            "(documented deviation: compaction here is a pure trim)")
     sizes = [int(s) for s in np.asarray(
         graph_sizes.asnumpy() if hasattr(graph_sizes, "asnumpy")
         else graph_sizes).ravel()]
@@ -481,8 +485,4 @@ def dgl_graph_compact(*graphs, graph_sizes=None, return_mapping=False,
         keep = indptr[n]
         outs.append(_make_csr(data[:keep], indices[:keep],
                               indptr[:n + 1].copy(), (n, n), g._ctx))
-    if return_mapping:
-        raise MXNetError(
-            "dgl_graph_compact return_mapping is not supported "
-            "(documented deviation: compaction here is a pure trim)")
     return outs if len(outs) > 1 else outs[0]

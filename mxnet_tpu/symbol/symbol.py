@@ -826,20 +826,70 @@ def Group(symbols):
     return Symbol(entries)
 
 
+# Optimizer/placement hints that old JSONs store as PLAIN attrs; modern
+# graphs (and this framework) expect them in `__key__` form on the
+# variable they apply to (reference src/nnvm/legacy_json_util.cc
+# kHiddenKeys + UpgradeJSON_FixParsing).
+_HIDDEN_KEYS = ("ctx_group", "lr_mult", "wd_mult", "force_mirroring",
+                "mirror_stage")
+
+
+def _upgrade_legacy_attrs(entry, node, input_names):
+    """One node's legacy-JSON upgrade (reference legacy_json_util.cc):
+
+    * pre-0.9 graphs keep op params under ``param`` — fold them in;
+    * bare hidden keys (``lr_mult`` on a node) become ``__lr_mult__``;
+    * suffixed hidden keys (``weight_lr_mult`` on an OP node) move onto
+      the matching input VARIABLE as ``__lr_mult__``.
+    """
+    attrs = dict(entry.get("attrs", entry.get("attr", {}) or {}))
+    attrs.update(entry.get("param", {}) or {})
+    out = {}
+    deferred = []  # (input_name, hidden_key, value)
+    for k, v in attrs.items():
+        hidden = next((h for h in _HIDDEN_KEYS
+                       if k == h or k.endswith("_" + h)), None)
+        if hidden is None:
+            out[k] = v
+        elif k == hidden:
+            out[f"__{hidden}__"] = v
+        else:
+            deferred.append((k[:-(len(hidden) + 1)], hidden, v))
+    node.attrs.update({k: _parse_attr_value(v) for k, v in out.items()})
+    for arg_name, hidden, v in deferred:
+        for (src, _oi), role in zip(node.inputs, input_names or []):
+            if src.is_variable() and role == arg_name:
+                src.attrs[f"__{hidden}__"] = _parse_attr_value(v)
+                break
+        else:  # no matching input: keep it where it was (reference does)
+            node.attrs[f"{arg_name}_{hidden}"] = _parse_attr_value(v)
+
+
 def load_json(json_str):
     """Load symbol from MXNet graph JSON (parity: sym.load_json; also reads
-    reference-produced files — format from nnvm JSON pass)."""
+    reference-produced files — format from nnvm JSON pass, including
+    pre-1.0 graphs via the legacy upgrade path)."""
     data = json.loads(json_str)
     raw_nodes = data["nodes"]
     nodes = []
     for entry in raw_nodes:
         op = entry["op"]
-        attrs = dict(entry.get("attrs", entry.get("attr", {}) or {}))
-        parsed_attrs = {k: _parse_attr_value(v) for k, v in attrs.items()}
-        node = _SymNode(None if op == "null" else op, entry["name"],
-                        parsed_attrs)
+        node = _SymNode(None if op == "null" else op, entry["name"], {})
         node.inputs = [(nodes[src], out_i)
                        for src, out_i, *_ in entry.get("inputs", [])]
+        input_names = None
+        if node.op is not None:
+            # resolve roles from the SAME folded attr view the upgrade
+            # uses — pre-0.9 graphs keep op params under 'param', and
+            # role resolution (e.g. no_bias) must see them
+            folded = dict(entry.get("attrs", entry.get("attr", {}) or {}))
+            folded.update(entry.get("param", {}) or {})
+            try:
+                input_names = _registry.get(node.op).resolve_input_names(
+                    {k: _parse_attr_value(v) for k, v in folded.items()})
+            except Exception:
+                input_names = None
+        _upgrade_legacy_attrs(entry, node, input_names)
         nodes.append(node)
     heads = [(nodes[i], out_i) for i, out_i, *_ in data["heads"]]
     return Symbol(heads)

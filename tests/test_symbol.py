@@ -292,3 +292,41 @@ def test_infer_shape_zero_size_batch():
     out = sym.FullyConnected(d, num_hidden=4, name="fc")
     _, outs, _ = out.infer_shape(data=(0, 5))
     assert outs == [(0, 4)]
+
+
+def test_load_json_legacy_upgrade():
+    """Pre-1.0 JSON quirks (reference src/nnvm/legacy_json_util.cc):
+    op params under 'param', bare and suffixed hidden keys."""
+    import json as _json
+    legacy = {
+        "nodes": [
+            {"op": "null", "name": "x", "inputs": []},
+            {"op": "null", "name": "fc_weight", "inputs": [],
+             "attr": {"lr_mult": "2.0"}},
+            {"op": "FullyConnected", "name": "fc",
+             "param": {"num_hidden": "4", "no_bias": "True"},
+             "attr": {"weight_lr_mult": "0.5", "ctx_group": "dev1"},
+             "inputs": [[0, 0], [1, 0]]},
+        ],
+        "arg_nodes": [0, 1],
+        "heads": [[2, 0]],
+    }
+    s = sym.load_json(_json.dumps(legacy))
+    node = s._outputs[0][0]
+    # 'param' folded into attrs and parsed
+    assert node.attrs["num_hidden"] == 4
+    assert node.attrs["no_bias"] is True
+    # bare hidden key renamed on the node itself
+    assert node.attrs["__ctx_group__"] == "dev1"
+    # suffixed hidden key moved to the matching input variable
+    wvar = node.inputs[1][0]
+    assert wvar.is_variable()
+    # bare lr_mult on the variable upgraded, suffixed one overrides it
+    assert wvar.attrs["__lr_mult__"] == 0.5
+    # graph still binds and runs
+    import numpy as np
+    from mxnet_tpu import nd
+    ex = s.bind(mx.cpu(), {
+        "x": nd.array(np.ones((2, 3), np.float32)),
+        "fc_weight": nd.array(np.ones((4, 3), np.float32))})
+    np.testing.assert_allclose(ex.forward()[0].asnumpy(), 3.0)

@@ -15,7 +15,6 @@ relay-up window.
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -31,11 +30,6 @@ OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
 def log(m):
     _log("kcheck", m)
 
-
-
-def _timed_pair(fn, args, reps):
-    """seconds, or None with the anomaly recorded by the caller."""
-    return differenced_time(fn, args, reps)
 
 
 
@@ -60,13 +54,11 @@ def main():
                           os.path.join(os.path.dirname(OUT), "..",
                                        ".jax_cache"))
     result = {"kernels": {}, "device": None}
-    interp_early = os.environ.get("KCHECK_INTERPRET", "0") == "1"
-    out_path = OUT if not interp_early else OUT.replace(
-        ".json", ".dryrun.json")
-    result["dry_run"] = interp_early
+    interp = os.environ.get("KCHECK_INTERPRET", "0") == "1"
+    out_path = OUT if not interp else OUT.replace(".json", ".dryrun.json")
+    result["dry_run"] = interp
 
     import numpy as np
-    interp = os.environ.get("KCHECK_INTERPRET", "0") == "1"
     if interp:
         jax = cpu_only_backend()  # dry run: never dial the relay
         import jax.numpy as jnp
@@ -116,9 +108,9 @@ def main():
         err = float(np.abs(got - want).max())
         result["kernels"]["layer_norm"] = _record(
             [n, d], err, 1e-4,
-            lambda: _timed_pair(lambda c, g2, b2: ln_pallas(c, g2, b2),
+            lambda: differenced_time(lambda c, g2, b2: ln_pallas(c, g2, b2),
                                 (x, g, b), reps),
-            lambda: _timed_pair(lambda c, g2, b2: ln_xla(c, g2, b2),
+            lambda: differenced_time(lambda c, g2, b2: ln_xla(c, g2, b2),
                                 (x, g, b), reps))
         log(f"layer_norm {result['kernels']['layer_norm']}")
     except Exception as e:
@@ -147,9 +139,9 @@ def main():
         err = float(np.abs(got - want).max())
         result["kernels"]["flash_attention"] = _record(
             [B, H, S, D], err, 5e-3,
-            lambda: _timed_pair(lambda c, kk, vv: fa_pallas(c, kk, vv),
+            lambda: differenced_time(lambda c, kk, vv: fa_pallas(c, kk, vv),
                                 (q, k, v), reps),
-            lambda: _timed_pair(lambda c, kk, vv: fa_xla(c, kk, vv),
+            lambda: differenced_time(lambda c, kk, vv: fa_xla(c, kk, vv),
                                 (q, k, v), reps))
         log(f"flash_attention {result['kernels']['flash_attention']}")
     except Exception as e:
@@ -181,10 +173,10 @@ def main():
         # the timing chain sequential
         result["kernels"]["softmax_ce"] = _record(
             [n, c], err, 1e-4,
-            lambda: _timed_pair(
+            lambda: differenced_time(
                 lambda c2, lb: c2 + ce_pallas(c2, lb)[:, None] * 1e-30,
                 (logits, labels), reps),
-            lambda: _timed_pair(
+            lambda: differenced_time(
                 lambda c2, lb: c2 + ce_xla(c2, lb)[:, None] * 1e-30,
                 (logits, labels), reps))
         log(f"softmax_ce {result['kernels']['softmax_ce']}")
