@@ -1044,3 +1044,126 @@ def data_iter_info(name):
     reg = _iter_registry()
     cls = reg[name]
     return (name, (cls.__doc__ or "").strip(), [], [], [])
+
+
+# --- PS env / roles / server loop (parity: c_api.h MXInitPSEnv:2290,
+# MXKVStoreIsWorkerNode:2559 family, MXKVStoreRunServer:2612) --------------
+def init_ps_env(keys, vals):
+    import os
+    for k, v in zip(keys, vals):
+        os.environ[str(k)] = str(v)
+    return True
+
+
+def kvstore_role():
+    import os
+    return os.environ.get("DMLC_ROLE", "worker")
+
+
+def kvstore_run_server(kv, fn_addr, ctx_addr):
+    """Run the process as a PS server (blocks until a 'stop' command).
+
+    The C controller receives every application-defined command sent via
+    MXKVStoreSendCommmandToServers as (cmd_id, cmd_body).
+    """
+    import ctypes
+    import os
+    from .kvstore_server import KVServer
+    CB = ctypes.CFUNCTYPE(None, ctypes.c_int, ctypes.c_char_p,
+                          ctypes.c_void_p)
+    cb = CB(fn_addr) if fn_addr else None
+    server = KVServer(
+        port=int(os.environ.get("DMLC_PS_ROOT_PORT", 9091)),
+        num_workers=int(os.environ.get("DMLC_NUM_WORKER", 1)))
+    if cb is not None:
+        def controller(head, body):
+            try:
+                cmd_id = int(head)
+            except (TypeError, ValueError):
+                cmd_id = 0
+            payload = body if isinstance(body, bytes) else \
+                str(body).encode()
+            cb(cmd_id, payload, ctypes.c_void_p(ctx_addr))
+        server.controller = controller
+    server.run()  # blocks; 'stop' command ends it
+    return True
+
+
+# --- SimpleBind (parity: c_api.h MXExecutorSimpleBindEx:2046) -------------
+def executor_simple_bind(s, dev_type, dev_id, req_names, req_types,
+                         shape_names, shapes, dtype_names, dtype_codes):
+    """Allocate arguments from inferred shapes and bind — the bind path
+    every reference binding actually uses (hand-building arg arrays is
+    the exception, not the rule).
+
+    Returns (executor, in_args, arg_grads_with_None, aux_states) in
+    declared argument order.  Unlisted args default to grad_req 'write'
+    when no req list is given (the reference python default) or to the
+    single provided req type.
+    """
+    import numpy as np
+    from . import nd
+    ctx = _ctx(dev_type, dev_id)
+    shape_kwargs = {n: tuple(int(d) for d in shp)
+                    for n, shp in zip(shape_names, shapes)}
+    arg_shapes, _out_shapes, aux_shapes = s.infer_shape(**shape_kwargs)
+    arg_names = s.list_arguments()
+    aux_names = s.list_auxiliary_states()
+    dtype_map = {n: _DTYPE_BY_CODE.get(c, np.float32)
+                 for n, c in zip(dtype_names, dtype_codes)}
+
+    if req_names:
+        req = {n: t for n, t in zip(req_names, req_types)}
+        default_req = "null"
+    elif len(req_types) == 1:  # single global req type
+        req = {}
+        default_req = req_types[0]
+    else:
+        req = {}
+        default_req = "write"
+
+    args, grads, reqs = {}, {}, {}
+    for n, shp in zip(arg_names, arg_shapes):
+        if shp is None:
+            raise ValueError(
+                f"simple_bind: shape of argument {n!r} is not fully "
+                "inferred; provide it explicitly")
+        args[n] = nd.zeros(tuple(shp), ctx=ctx,
+                           dtype=dtype_map.get(n, np.float32))
+        r = req.get(n, default_req)
+        reqs[n] = r
+        if r != "null":
+            grads[n] = nd.zeros(tuple(shp), ctx=ctx,
+                                dtype=dtype_map.get(n, np.float32))
+    aux = {n: nd.zeros(tuple(shp), ctx=ctx)
+           for n, shp in zip(aux_names, aux_shapes)}
+    ex = s.bind(ctx, args, args_grad=grads or None, grad_req=reqs,
+                aux_states=aux or None)
+    in_args = [args[n] for n in arg_names]
+    arg_grads = [grads.get(n) for n in arg_names]
+    aux_states = [aux[n] for n in aux_names]
+    return ex, in_args, arg_grads, aux_states
+
+
+# --- symbol attr listing (parity: MXSymbolListAttr/ListAttrShallow) -------
+def symbol_list_attr(s, shallow):
+    """Flat [key, value, ...] pairs; deep form prefixes node names the way
+    the reference's recursive ListAttr does."""
+    out = []
+    if shallow:
+        for node, _ in s._outputs:
+            for k, v in node.attrs.items():
+                out.extend([str(k), str(v)])
+            break
+    else:
+        for node in s._topo():
+            for k, v in node.attrs.items():
+                key = f"{node.name}${k}" if node.name else str(k)
+                out.extend([key, str(v)])
+    return out
+
+
+def data_iter_list_info(name):
+    reg = _iter_registry()
+    cls = reg[name]
+    return (name, (cls.__doc__ or "").strip())

@@ -110,6 +110,7 @@ class KVServer:
                 "Set a token, or MXNET_KVSTORE_ALLOW_INSECURE=1 on a "
                 "trusted private network.")
         self.num_workers = num_workers
+        self.controller = None  # MXKVStoreRunServer hook
         self.store = {}           # key -> np.ndarray
         self.updater = None
         self.optimizer = None
@@ -321,6 +322,19 @@ class KVServer:
                     self.updater = np_updater
                 elif head == "stop":
                     self._stop.set()
+                elif self.controller is not None and \
+                        not head.startswith("profiler_"):
+                    # user controller (parity: MXKVStoreRunServer's
+                    # MXKVStoreServerController receives every
+                    # application-defined command)
+                    err = None
+                    try:
+                        self.controller(head, body)
+                    except Exception as e:
+                        err = str(e)
+                    _send_msg(conn, {"ok": err is None, "error": err},
+                              self.auth_token)
+                    continue
                 elif head.startswith("profiler_"):
                     # server-side profiling (parity: reference
                     # KVStoreServerProfilerCommand, include/mxnet/

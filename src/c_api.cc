@@ -1602,6 +1602,191 @@ int MXNotifyShutdown(void) {
   return 0;  /* engine shutdown is XLA/atexit-owned in this runtime */
 }
 
+
+/* ---- PS env / roles / server loop (reference c_api.h:2290, 2559+) ------- */
+
+int MXInitPSEnv(mx_uint num_vars, const char** keys, const char** vals) {
+  ensure_python();
+  Gil gil;
+  PyObject* ks = list_from_strs(num_vars, keys);
+  PyObject* vs = list_from_strs(num_vars, vals);
+  PyObject* args = Py_BuildValue("(OO)", ks, vs);
+  Py_DECREF(ks);
+  Py_DECREF(vs);
+  PyObject* r = args ? call("init_ps_env", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  Py_DECREF(r);
+  return 0;
+}
+
+static int role_is(const char* want, int* ret) {
+  ensure_python();
+  Gil gil;
+  PyObject* args = Py_BuildValue("()");
+  PyObject* r = args ? call("kvstore_role", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  *ret = strcmp(PyUnicode_AsUTF8(r), want) == 0 ? 1 : 0;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreIsWorkerNode(int* ret) { return role_is("worker", ret); }
+int MXKVStoreIsServerNode(int* ret) { return role_is("server", ret); }
+int MXKVStoreIsSchedulerNode(int* ret) { return role_is("scheduler", ret); }
+
+typedef void (MXKVStoreServerController)(int head, const char* body,
+                                         void* controller_handle);
+
+int MXKVStoreRunServer(KVStoreHandle handle,
+                       MXKVStoreServerController controller,
+                       void* controller_handle) {
+  if (!handle) return fail("null handle");
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(OKK)", handle,
+      reinterpret_cast<unsigned long long>(controller),
+      reinterpret_cast<unsigned long long>(controller_handle));
+  PyObject* r = args ? call("kvstore_run_server", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  Py_DECREF(r);
+  return 0;
+}
+
+/* ---- SimpleBind (reference c_api.h:2046 MXExecutorSimpleBindEx; the
+   g2c/stype/shared-buffer channels of the full signature are accepted
+   and ignored — shape/dtype/grad_req drive allocation) ------------------- */
+
+int MXExecutorSimpleBindEx(
+    SymbolHandle symbol_handle, int dev_type, int dev_id,
+    const mx_uint num_g2c_keys, const char** g2c_keys,
+    const int* g2c_dev_types, const int* g2c_dev_ids,
+    const mx_uint provided_grad_req_list_len,
+    const char** provided_grad_req_names,
+    const char** provided_grad_req_types,
+    const mx_uint num_provided_arg_shapes,
+    const char** provided_arg_shape_names,
+    const int* provided_arg_shape_data,
+    const mx_uint* provided_arg_shape_idx,
+    const mx_uint num_provided_arg_dtypes,
+    const char** provided_arg_dtype_names, const int* provided_arg_dtypes,
+    const mx_uint num_provided_arg_stypes,
+    const char** provided_arg_stype_names, const int* provided_arg_stypes,
+    const mx_uint num_shared_arg_names, const char** shared_arg_name_list,
+    int* shared_buffer_len, const char** shared_buffer_name_list,
+    NDArrayHandle* shared_buffer_handle_list,
+    const char*** updated_shared_buffer_name_list,
+    NDArrayHandle** updated_shared_buffer_handle_list,
+    mx_uint* num_in_args, NDArrayHandle** in_args, NDArrayHandle** arg_grads,
+    mx_uint* num_aux_states, NDArrayHandle** aux_states,
+    ExecutorHandle shared_exec_handle, ExecutorHandle* out) {
+  (void)num_g2c_keys; (void)g2c_keys; (void)g2c_dev_types; (void)g2c_dev_ids;
+  (void)num_provided_arg_stypes; (void)provided_arg_stype_names;
+  (void)provided_arg_stypes; (void)num_shared_arg_names;
+  (void)shared_arg_name_list; (void)shared_buffer_len;
+  (void)shared_buffer_name_list; (void)shared_buffer_handle_list;
+  (void)updated_shared_buffer_name_list;
+  (void)updated_shared_buffer_handle_list; (void)shared_exec_handle;
+  if (!symbol_handle) return fail("null symbol");
+  Gil gil;
+  PyObject* req_ns = list_from_strs(provided_grad_req_list_len,
+                                    provided_grad_req_names);
+  /* reference convention: list_len == 0 with a non-NULL types pointer
+     means ONE global grad_req string for every argument */
+  mx_uint n_req_types = provided_grad_req_list_len;
+  if (n_req_types == 0 && provided_grad_req_types != nullptr) {
+    n_req_types = 1;
+  }
+  PyObject* req_ts = list_from_strs(n_req_types, provided_grad_req_types);
+  PyObject* shp_ns = list_from_strs(num_provided_arg_shapes,
+                                    provided_arg_shape_names);
+  PyObject* shp_vs = PyList_New(num_provided_arg_shapes);
+  for (mx_uint i = 0; i < num_provided_arg_shapes; ++i) {
+    mx_uint lo = provided_arg_shape_idx[i];
+    mx_uint hi = provided_arg_shape_idx[i + 1];
+    PyObject* t = PyTuple_New(hi - lo);
+    for (mx_uint j = lo; j < hi; ++j) {
+      PyTuple_SET_ITEM(t, j - lo,
+                       PyLong_FromLong(provided_arg_shape_data[j]));
+    }
+    PyList_SET_ITEM(shp_vs, i, t);
+  }
+  PyObject* dt_ns = list_from_strs(num_provided_arg_dtypes,
+                                   provided_arg_dtype_names);
+  PyObject* dt_vs = PyList_New(num_provided_arg_dtypes);
+  for (mx_uint i = 0; i < num_provided_arg_dtypes; ++i) {
+    PyList_SET_ITEM(dt_vs, i, PyLong_FromLong(provided_arg_dtypes[i]));
+  }
+  PyObject* args = Py_BuildValue("(OiiOOOOOO)", symbol_handle, dev_type,
+                                 dev_id, req_ns, req_ts, shp_ns, shp_vs,
+                                 dt_ns, dt_vs);
+  Py_DECREF(req_ns); Py_DECREF(req_ts); Py_DECREF(shp_ns);
+  Py_DECREF(shp_vs); Py_DECREF(dt_ns); Py_DECREF(dt_vs);
+  PyObject* r = args ? call("executor_simple_bind", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  /* r = (executor, in_args, arg_grads_with_None, aux_states).
+     THREE separate out-arrays from one call: each needs its own backing
+     store (handlelist_out's shared g_ret_handles would clobber the
+     earlier out-param on every later call). */
+  PyObject* ex = PyTuple_GetItem(r, 0);
+  Py_INCREF(ex);
+  static thread_local std::vector<NDArrayHandle> in_v, grads_v, aux_v;
+  auto fill = [](PyObject* seq, std::vector<NDArrayHandle>* dst,
+                 bool allow_none) {
+    dst->clear();
+    Py_ssize_t n = PySequence_Size(seq);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject* it = PySequence_GetItem(seq, i);
+      if (allow_none && it == Py_None) {
+        dst->push_back(nullptr);
+        Py_XDECREF(it);
+      } else {
+        dst->push_back(it);  /* owned ref kept for the caller */
+      }
+    }
+    return static_cast<mx_uint>(n);
+  };
+  *num_in_args = fill(PyTuple_GetItem(r, 1), &in_v, false);
+  *in_args = in_v.data();
+  fill(PyTuple_GetItem(r, 2), &grads_v, true);
+  *arg_grads = grads_v.data();
+  *num_aux_states = fill(PyTuple_GetItem(r, 3), &aux_v, false);
+  *aux_states = aux_v.data();
+  Py_DECREF(r);
+  *out = ex;
+  return 0;
+}
+
+/* ---- symbol attr listing (reference c_api.h MXSymbolListAttr) ----------- */
+
+static int list_attr_impl(SymbolHandle symbol, int shallow, mx_uint* out_size,
+                          const char*** out) {
+  if (!symbol) return fail("null handle");
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Oi)", symbol, shallow);
+  PyObject* r = args ? call("symbol_list_attr", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  mx_uint n = 0;
+  strlist_out(r, &n, out);
+  *out_size = n / 2;  /* reference counts PAIRS */
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXSymbolListAttr(SymbolHandle symbol, mx_uint* out_size,
+                     const char*** out) {
+  return list_attr_impl(symbol, 0, out_size, out);
+}
+
+int MXSymbolListAttrShallow(SymbolHandle symbol, mx_uint* out_size,
+                            const char*** out) {
+  return list_attr_impl(symbol, 1, out_size, out);
+}
+
 /* ---- sparse NDArray (round-5; reference c_api.h:577+) ------------------- */
 
 int MXNDArrayCreateSparseEx(int storage_type, const mx_uint* shape,

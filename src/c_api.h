@@ -233,6 +233,45 @@ int MXKVStoreFree(KVStoreHandle handle);
 
 
 
+
+/* ---- PS env / roles / server loop / SimpleBind / attr listing ----------- */
+int MXInitPSEnv(mx_uint num_vars, const char** keys, const char** vals);
+int MXKVStoreIsWorkerNode(int* ret);
+int MXKVStoreIsServerNode(int* ret);
+int MXKVStoreIsSchedulerNode(int* ret);
+typedef void (MXKVStoreServerController)(int head, const char* body,
+                                         void* controller_handle);
+int MXKVStoreRunServer(KVStoreHandle handle,
+                       MXKVStoreServerController controller,
+                       void* controller_handle);
+int MXExecutorSimpleBindEx(
+    SymbolHandle symbol_handle, int dev_type, int dev_id,
+    const mx_uint num_g2c_keys, const char** g2c_keys,
+    const int* g2c_dev_types, const int* g2c_dev_ids,
+    const mx_uint provided_grad_req_list_len,
+    const char** provided_grad_req_names,
+    const char** provided_grad_req_types,
+    const mx_uint num_provided_arg_shapes,
+    const char** provided_arg_shape_names,
+    const int* provided_arg_shape_data,
+    const mx_uint* provided_arg_shape_idx,
+    const mx_uint num_provided_arg_dtypes,
+    const char** provided_arg_dtype_names, const int* provided_arg_dtypes,
+    const mx_uint num_provided_arg_stypes,
+    const char** provided_arg_stype_names, const int* provided_arg_stypes,
+    const mx_uint num_shared_arg_names, const char** shared_arg_name_list,
+    int* shared_buffer_len, const char** shared_buffer_name_list,
+    NDArrayHandle* shared_buffer_handle_list,
+    const char*** updated_shared_buffer_name_list,
+    NDArrayHandle** updated_shared_buffer_handle_list,
+    mx_uint* num_in_args, NDArrayHandle** in_args, NDArrayHandle** arg_grads,
+    mx_uint* num_aux_states, NDArrayHandle** aux_states,
+    ExecutorHandle shared_exec_handle, ExecutorHandle* out);
+int MXSymbolListAttr(SymbolHandle symbol, mx_uint* out_size,
+                     const char*** out);
+int MXSymbolListAttrShallow(SymbolHandle symbol, mx_uint* out_size,
+                            const char*** out);
+
 /* ---- op discovery / symbol extras (round-5 width) ----------------------- */
 int MXSymbolListAtomicSymbolCreators(mx_uint* out_size, void*** out_array);
 int MXSymbolGetAtomicSymbolName(void* creator, const char** name);

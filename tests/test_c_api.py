@@ -1363,3 +1363,172 @@ def _to_numpy_1d(lib, h, n):
                                     ctypes.c_size_t(out.nbytes))
     assert rc == 0, _err(lib)
     return out
+
+
+@needs_lib
+class TestRound5Batch3:
+    """SimpleBind, PS env/roles/server loop, symbol attr listing
+    (reference c_api.h:2046, 2290, 2559+, MXSymbolListAttr)."""
+
+    def test_simple_bind_trains(self):
+        lib = _lib()
+        lib.MXExecutorSimpleBindEx.restype = ctypes.c_int
+        x = vp()
+        assert lib.MXSymbolCreateVariable(b"x", ctypes.byref(x)) == 0
+        fc = vp()
+        k = (ctypes.c_char_p * 1)(b"num_hidden")
+        v = (ctypes.c_char_p * 1)(b"3")
+        assert lib.MXSymbolCreateOp(b"FullyConnected", 1, k, v, 1,
+                                    (vp * 1)(x), b"fc",
+                                    ctypes.byref(fc)) == 0, _err(lib)
+        # provide only the data shape; weights/bias are inferred+allocated
+        shp_names = (ctypes.c_char_p * 1)(b"x")
+        shp_data = (ctypes.c_int * 2)(2, 5)
+        shp_idx = (u32 * 2)(0, 2)
+        n_in = u32()
+        in_args = ctypes.POINTER(vp)()
+        arg_grads = ctypes.POINTER(vp)()
+        n_aux = u32()
+        aux = ctypes.POINTER(vp)()
+        ex = vp()
+        rc = lib.MXExecutorSimpleBindEx(
+            fc, 1, 0,                      # cpu
+            0, None, None, None,           # g2c
+            0, None, None,                 # grad reqs (default write)
+            1, shp_names, shp_data, shp_idx,
+            0, None, None,                 # dtypes
+            0, None, None,                 # stypes
+            0, None, None, None, None, None, None,  # shared
+            ctypes.byref(n_in), ctypes.byref(in_args),
+            ctypes.byref(arg_grads), ctypes.byref(n_aux),
+            ctypes.byref(aux), None, ctypes.byref(ex))
+        assert rc == 0, _err(lib)
+        assert n_in.value == 3  # x, fc_weight, fc_bias
+        # fill data + weight through the returned handles and run a step
+        xbuf = np.random.RandomState(0).randn(2, 5).astype(np.float32)
+        wbuf = np.random.RandomState(1).randn(3, 5).astype(np.float32)
+        assert lib.MXNDArraySyncCopyFromCPU(
+            in_args[0], xbuf.ctypes.data_as(vp), xbuf.nbytes) == 0
+        assert lib.MXNDArraySyncCopyFromCPU(
+            in_args[1], wbuf.ctypes.data_as(vp), wbuf.nbytes) == 0
+        assert lib.MXExecutorForward(ex, 1) == 0, _err(lib)
+        nout = u32()
+        outs = ctypes.POINTER(vp)()
+        assert lib.MXExecutorOutputs(ex, ctypes.byref(nout),
+                                     ctypes.byref(outs)) == 0
+        got = _to_numpy(lib, outs[0])
+        np.testing.assert_allclose(got, xbuf @ wbuf.T, rtol=1e-4,
+                                   atol=1e-4)
+        assert lib.MXExecutorBackward(ex, 0, None) == 0, _err(lib)
+        # grads were allocated by simple_bind (grad_req defaulted write)
+        g = _to_numpy(lib, arg_grads[1])
+        assert np.abs(g).sum() > 0
+
+    def test_ps_env_roles_and_run_server(self):
+        import threading
+        lib = _lib()
+        keys = (ctypes.c_char_p * 2)(b"DMLC_ROLE", b"DMLC_PS_ROOT_PORT")
+        vals = (ctypes.c_char_p * 2)(b"server", b"19873")
+        assert lib.MXInitPSEnv(2, keys, vals) == 0, _err(lib)
+        ret = ctypes.c_int(-1)
+        assert lib.MXKVStoreIsServerNode(ctypes.byref(ret)) == 0
+        assert ret.value == 1
+        assert lib.MXKVStoreIsWorkerNode(ctypes.byref(ret)) == 0
+        assert ret.value == 0
+
+        kv = vp()
+        assert lib.MXKVStoreCreate(b"local", ctypes.byref(kv)) == 0
+        CTRL = ctypes.CFUNCTYPE(None, ctypes.c_int, ctypes.c_char_p, vp)
+        seen = []
+
+        @CTRL
+        def controller(head, body, _h):
+            seen.append((head, body))
+
+        lib.MXKVStoreRunServer.argtypes = [vp, vp, vp]
+        done = []
+
+        def run():
+            rc = lib.MXKVStoreRunServer(kv, ctypes.cast(controller, vp),
+                                        None)
+            done.append(rc)
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        import time as _time
+        from mxnet_tpu.kvstore_server import KVClient
+        deadline = _time.time() + 10
+        client = None
+        while client is None and _time.time() < deadline:
+            try:
+                client = KVClient("127.0.0.1", 19873, rank=0,
+                                  num_workers=1, heartbeat_interval=0)
+            except OSError:
+                _time.sleep(0.1)
+        assert client is not None, "server did not come up"
+        client.send_command("42", b"hello-from-worker")
+        client.stop_server()
+        t.join(timeout=10)
+        assert done == [0]
+        assert (42, b"hello-from-worker") in seen
+
+    def test_symbol_list_attr(self):
+        lib = _lib()
+        x = vp()
+        assert lib.MXSymbolCreateVariable(b"x", ctypes.byref(x)) == 0
+        assert lib.MXSymbolSetAttr(x, b"lr_mult", b"2.5") == 0, _err(lib)
+        n = u32()
+        pairs = ctypes.POINTER(ctypes.c_char_p)()
+        lib.MXSymbolListAttrShallow.argtypes = [
+            vp, ctypes.POINTER(u32),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_char_p))]
+        assert lib.MXSymbolListAttrShallow(
+            x, ctypes.byref(n), ctypes.byref(pairs)) == 0, _err(lib)
+        got = {pairs[2 * i].decode(): pairs[2 * i + 1].decode()
+               for i in range(n.value)}
+        assert any("lr_mult" in k for k in got), got
+
+    def test_simple_bind_with_aux_and_global_req(self):
+        """BatchNorm has aux states — the three out-arrays must not share
+        a buffer; and the reference's global-req convention (list_len=0 +
+        one type) must reach the python side."""
+        lib = _lib()
+        x = vp()
+        assert lib.MXSymbolCreateVariable(b"x", ctypes.byref(x)) == 0
+        bn = vp()
+        assert lib.MXSymbolCreateOp(b"BatchNorm", 0, None, None, 1,
+                                    (vp * 1)(x), b"bn",
+                                    ctypes.byref(bn)) == 0, _err(lib)
+        shp_names = (ctypes.c_char_p * 1)(b"x")
+        shp_data = (ctypes.c_int * 4)(2, 3, 4, 4)
+        shp_idx = (u32 * 2)(0, 4)
+        req_types = (ctypes.c_char_p * 1)(b"null")  # global: inference
+        n_in = u32()
+        in_args = ctypes.POINTER(vp)()
+        arg_grads = ctypes.POINTER(vp)()
+        n_aux = u32()
+        aux = ctypes.POINTER(vp)()
+        ex = vp()
+        rc = lib.MXExecutorSimpleBindEx(
+            bn, 1, 0, 0, None, None, None,
+            0, None, req_types,            # global grad_req
+            1, shp_names, shp_data, shp_idx,
+            0, None, None, 0, None, None,
+            0, None, None, None, None, None, None,
+            ctypes.byref(n_in), ctypes.byref(in_args),
+            ctypes.byref(arg_grads), ctypes.byref(n_aux),
+            ctypes.byref(aux), None, ctypes.byref(ex))
+        assert rc == 0, _err(lib)
+        assert n_in.value == 3 and n_aux.value == 2  # x,gamma,beta + mm,mv
+        # in_args must still be valid AFTER aux_states was produced
+        # (regression: shared thread-local buffer clobbered it)
+        shp_n = u32()
+        pdata = ctypes.POINTER(u32)()
+        assert lib.MXNDArrayGetShape(in_args[0], ctypes.byref(shp_n),
+                                     ctypes.byref(pdata)) == 0
+        assert [pdata[i] for i in range(shp_n.value)] == [2, 3, 4, 4]
+        assert lib.MXNDArrayGetShape(aux[0], ctypes.byref(shp_n),
+                                     ctypes.byref(pdata)) == 0
+        assert [pdata[i] for i in range(shp_n.value)] == [3]
+        # global 'null': no grads allocated
+        assert all(not arg_grads[i] for i in range(n_in.value))
