@@ -1135,8 +1135,14 @@ def executor_simple_bind(s, dev_type, dev_id, req_names, req_types,
         if r != "null":
             grads[n] = nd.zeros(tuple(shp), ctx=ctx,
                                 dtype=dtype_map.get(n, np.float32))
-    aux = {n: nd.zeros(tuple(shp), ctx=ctx)
-           for n, shp in zip(aux_names, aux_shapes)}
+    aux = {}
+    for n, shp in zip(aux_names, aux_shapes):
+        if shp is None:
+            raise ValueError(
+                f"simple_bind: shape of auxiliary state {n!r} is not "
+                "fully inferred; provide more input shapes")
+        aux[n] = nd.zeros(tuple(shp), ctx=ctx,
+                          dtype=dtype_map.get(n, np.float32))
     ex = s.bind(ctx, args, args_grad=grads or None, grad_req=reqs,
                 aux_states=aux or None)
     in_args = [args[n] for n in arg_names]
@@ -1149,15 +1155,20 @@ def executor_simple_bind(s, dev_type, dev_id, req_names, req_types,
 def symbol_list_attr(s, shallow):
     """Flat [key, value, ...] pairs; deep form prefixes node names the way
     the reference's recursive ListAttr does."""
+    def visible(items):
+        # internal bookkeeping attrs (__is_aux__ etc. — NOT the public
+        # __lr_mult__-style hidden keys, which ARE part of the ABI)
+        return [(k, v) for k, v in items if k != "__is_aux__"]
+
     out = []
     if shallow:
         for node, _ in s._outputs:
-            for k, v in node.attrs.items():
+            for k, v in visible(node.attrs.items()):
                 out.extend([str(k), str(v)])
             break
     else:
         for node in s._topo():
-            for k, v in node.attrs.items():
+            for k, v in visible(node.attrs.items()):
                 key = f"{node.name}${k}" if node.name else str(k)
                 out.extend([key, str(v)])
     return out
@@ -1167,3 +1178,58 @@ def data_iter_list_info(name):
     reg = _iter_registry()
     cls = reg[name]
     return (name, (cls.__doc__ or "").strip())
+
+
+# --- misc batch 4 (profiler aliases, numpy-shape toggle, engine knobs,
+# feature flags — reference c_api.h:235, 2618+, profiler aliases) ----------
+_NUMPY_SHAPE = [0]
+
+
+def lib_features():
+    """[(name, enabled), ...] (parity: MXLibInfoFeatures over
+    runtime.Features)."""
+    from . import runtime
+    feats = runtime.Features()
+    return [(str(k), bool(feats.is_enabled(k))) for k in sorted(feats)]
+
+
+def set_numpy_shape(flag):
+    # tri-state like the reference (0 off / 1 thread-local / 2 global-on):
+    # round-trips must preserve 2
+    prev = _NUMPY_SHAPE[0]
+    _NUMPY_SHAPE[0] = int(flag)
+    return prev
+
+
+def is_numpy_shape():
+    return _NUMPY_SHAPE[0]
+
+
+def engine_set_bulk_size(size):
+    """Accepted for API parity; XLA owns op bulking (fusion) here, so the
+    knob records the request and reports the previous value."""
+    prev = _BULK_SIZE[0]
+    _BULK_SIZE[0] = int(size)
+    return prev
+
+
+_BULK_SIZE = [15]
+
+
+def random_seed_context(seed, dev_type, dev_id):
+    """Per-device seeding (parity: MXRandomSeedContext); this runtime's
+    counter-key PRNG is device-independent, so it folds the device into
+    the seed stream the same way for every context."""
+    from . import random as _random
+    _random.seed(int(seed) ^ (int(dev_type) << 16) ^ int(dev_id))
+    return True
+
+
+def storage_empty_cache(dev_type, dev_id):
+    """PJRT owns pooling; a cache-drop request maps to host GC only.
+    (jax.clear_caches() would drop compiled executables and force
+    re-compilation — far more destructive than the reference's cheap
+    memory-pool drain.)"""
+    import gc
+    gc.collect()
+    return True

@@ -1603,6 +1603,129 @@ int MXNotifyShutdown(void) {
 }
 
 
+
+/* ---- misc batch 4: profiler aliases, feature flags, numpy-shape toggle,
+   engine knobs (reference c_api.h:235+, 2618+, profiler legacy names) ---- */
+
+int MXSetProfilerConfig(int num_params, const char** keys,
+                        const char** vals) {
+  return MXSetProcessProfilerConfig(num_params, keys, vals);
+}
+
+int MXSetProfilerState(int state) { return MXSetProcessProfilerState(state); }
+
+int MXDumpProfile(int finished) { return MXDumpProcessProfile(finished); }
+
+struct LibFeature {
+  const char* name;
+  bool enabled;
+};
+
+int MXLibInfoFeatures(const struct LibFeature** libFeature, size_t* size) {
+  ensure_python();
+  Gil gil;
+  PyObject* args = Py_BuildValue("()");
+  PyObject* r = args ? call("lib_features", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  static thread_local std::vector<std::string> names;
+  static thread_local std::vector<LibFeature> feats;
+  names.clear();
+  feats.clear();
+  Py_ssize_t n = PySequence_Size(r);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* it = PySequence_GetItem(r, i);
+    names.emplace_back(PyUnicode_AsUTF8(PyTuple_GetItem(it, 0)));
+    Py_XDECREF(it);
+  }
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* it = PySequence_GetItem(r, i);
+    feats.push_back({names[i].c_str(),
+                     PyObject_IsTrue(PyTuple_GetItem(it, 1)) == 1});
+    Py_XDECREF(it);
+  }
+  Py_DECREF(r);
+  *libFeature = feats.data();
+  *size = static_cast<size_t>(n);
+  return 0;
+}
+
+int MXSetIsNumpyShape(int is_np_shape, int* prev) {
+  ensure_python();
+  Gil gil;
+  PyObject* args = Py_BuildValue("(i)", is_np_shape);
+  PyObject* r = args ? call("set_numpy_shape", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  /* tri-state (0/1/2=GlobalOn): PyLong, not truthiness */
+  if (prev) *prev = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXIsNumpyShape(int* curr) {
+  ensure_python();
+  Gil gil;
+  PyObject* args = Py_BuildValue("()");
+  PyObject* r = args ? call("is_numpy_shape", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  *curr = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXEngineSetBulkSize(int bulk_size, int* prev_bulk_size) {
+  ensure_python();
+  Gil gil;
+  PyObject* args = Py_BuildValue("(i)", bulk_size);
+  PyObject* r = args ? call("engine_set_bulk_size", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  if (prev_bulk_size) {
+    *prev_bulk_size = static_cast<int>(PyLong_AsLong(r));
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXRandomSeedContext(int seed, int dev_type, int dev_id) {
+  ensure_python();
+  Gil gil;
+  PyObject* args = Py_BuildValue("(iii)", seed, dev_type, dev_id);
+  PyObject* r = args ? call("random_seed_context", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXStorageEmptyCache(int dev_type, int dev_id) {
+  ensure_python();
+  Gil gil;
+  PyObject* args = Py_BuildValue("(ii)", dev_type, dev_id);
+  PyObject* r = args ? call("storage_empty_cache", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXGetGPUMemoryInformation(int dev, int* free_mem, int* total_mem) {
+  uint64_t f = 0, t = 0;
+  int rc = MXGetGPUMemoryInformation64(dev, &f, &t);
+  if (rc) return rc;
+  *free_mem = static_cast<int>(f >> 20);   /* MiB, like the reference */
+  *total_mem = static_cast<int>(t >> 20);
+  return 0;
+}
+
+int MXKVStoreSetBarrierBeforeExit(KVStoreHandle handle,
+                                  const int barrier_before_exit) {
+  (void)handle; (void)barrier_before_exit;
+  return 0;  /* exit barriers are the launcher's job in this runtime */
+}
+
 /* ---- PS env / roles / server loop (reference c_api.h:2290, 2559+) ------- */
 
 int MXInitPSEnv(mx_uint num_vars, const char** keys, const char** vals) {

@@ -234,6 +234,23 @@ int MXKVStoreFree(KVStoreHandle handle);
 
 
 
+
+/* ---- misc batch 4 ------------------------------------------------------- */
+int MXSetProfilerConfig(int num_params, const char** keys,
+                        const char** vals);
+int MXSetProfilerState(int state);
+int MXDumpProfile(int finished);
+struct LibFeature { const char* name; bool enabled; };
+int MXLibInfoFeatures(const struct LibFeature** libFeature, size_t* size);
+int MXSetIsNumpyShape(int is_np_shape, int* prev);
+int MXIsNumpyShape(int* curr);
+int MXEngineSetBulkSize(int bulk_size, int* prev_bulk_size);
+int MXRandomSeedContext(int seed, int dev_type, int dev_id);
+int MXStorageEmptyCache(int dev_type, int dev_id);
+int MXGetGPUMemoryInformation(int dev, int* free_mem, int* total_mem);
+int MXKVStoreSetBarrierBeforeExit(KVStoreHandle handle,
+                                  const int barrier_before_exit);
+
 /* ---- PS env / roles / server loop / SimpleBind / attr listing ----------- */
 int MXInitPSEnv(mx_uint num_vars, const char** keys, const char** vals);
 int MXKVStoreIsWorkerNode(int* ret);

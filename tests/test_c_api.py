@@ -1424,12 +1424,17 @@ class TestRound5Batch3:
         g = _to_numpy(lib, arg_grads[1])
         assert np.abs(g).sum() > 0
 
-    def test_ps_env_roles_and_run_server(self):
+    def test_ps_env_roles_and_run_server(self, monkeypatch):
         import threading
         lib = _lib()
+        # MXInitPSEnv writes into os.environ; scope it to this test
+        monkeypatch.delenv("DMLC_ROLE", raising=False)
+        monkeypatch.delenv("DMLC_PS_ROOT_PORT", raising=False)
         keys = (ctypes.c_char_p * 2)(b"DMLC_ROLE", b"DMLC_PS_ROOT_PORT")
         vals = (ctypes.c_char_p * 2)(b"server", b"19873")
         assert lib.MXInitPSEnv(2, keys, vals) == 0, _err(lib)
+        monkeypatch.setenv("DMLC_ROLE", "server")  # registers cleanup
+        monkeypatch.setenv("DMLC_PS_ROOT_PORT", "19873")
         ret = ctypes.c_int(-1)
         assert lib.MXKVStoreIsServerNode(ctypes.byref(ret)) == 0
         assert ret.value == 1
@@ -1532,3 +1537,42 @@ class TestRound5Batch3:
         assert [pdata[i] for i in range(shp_n.value)] == [3]
         # global 'null': no grads allocated
         assert all(not arg_grads[i] for i in range(n_in.value))
+
+    def test_misc_batch4(self):
+        lib = _lib()
+        # profiler legacy aliases
+        assert lib.MXSetProfilerState(0) == 0
+        # feature flags
+        class LibFeature(ctypes.Structure):
+            _fields_ = [("name", ctypes.c_char_p),
+                        ("enabled", ctypes.c_bool)]
+        feats = ctypes.POINTER(LibFeature)()
+        size = ctypes.c_size_t()
+        lib.MXLibInfoFeatures.argtypes = [
+            ctypes.POINTER(ctypes.POINTER(LibFeature)),
+            ctypes.POINTER(ctypes.c_size_t)]
+        assert lib.MXLibInfoFeatures(ctypes.byref(feats),
+                                     ctypes.byref(size)) == 0, _err(lib)
+        assert size.value > 0
+        names = {feats[i].name.decode() for i in range(size.value)}
+        assert names  # non-empty feature set
+        # numpy-shape toggle round trip
+        prev = ctypes.c_int(-1)
+        assert lib.MXSetIsNumpyShape(1, ctypes.byref(prev)) == 0
+        cur = ctypes.c_int(-1)
+        assert lib.MXIsNumpyShape(ctypes.byref(cur)) == 0
+        assert cur.value == 1
+        assert lib.MXSetIsNumpyShape(0, ctypes.byref(prev)) == 0
+        assert prev.value == 1
+        # engine bulk size returns the previous value
+        prevb = ctypes.c_int(-1)
+        assert lib.MXEngineSetBulkSize(30, ctypes.byref(prevb)) == 0
+        assert lib.MXEngineSetBulkSize(15, ctypes.byref(prevb)) == 0
+        assert prevb.value == 30
+        # per-context seed + cache drop + MiB memory info
+        assert lib.MXRandomSeedContext(7, 1, 0) == 0
+        assert lib.MXStorageEmptyCache(1, 0) == 0
+        free = ctypes.c_int(); tot = ctypes.c_int()
+        assert lib.MXGetGPUMemoryInformation(0, ctypes.byref(free),
+                                             ctypes.byref(tot)) == 0
+        assert lib.MXKVStoreSetBarrierBeforeExit(None, 1) == 0
