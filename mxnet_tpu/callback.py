@@ -107,3 +107,15 @@ class ProgressBar:
         percents = math.ceil(100.0 * count / float(self.total))
         prog_bar = "=" * filled_len + "-" * (self.bar_len - filled_len)
         sys.stdout.write(f"[{prog_bar}] {percents}%\r")
+
+
+class LogValidationMetricsCallback:
+    """Log eval metrics at the end of an epoch (parity: callback.py
+    LogValidationMetricsCallback)."""
+
+    def __call__(self, param):
+        if not param.eval_metric:
+            return
+        for name, value in param.eval_metric.get_name_value():
+            logging.info("Epoch[%d] Validation-%s=%f", param.epoch, name,
+                         value)

@@ -2,5 +2,5 @@
 from .rnn_cell import (BidirectionalCell, DropoutCell, GRUCell,
                        HybridRecurrentCell, LSTMCell, ModifierCell,
                        RecurrentCell, ResidualCell, RNNCell,
-                       SequentialRNNCell, ZoneoutCell)
+                       HybridSequentialRNNCell, SequentialRNNCell, ZoneoutCell)
 from .rnn_layer import GRU, LSTM, RNN

@@ -429,6 +429,14 @@ class SequentialRNNCell(RecurrentCell):
         raise NotImplementedError()
 
 
+class HybridSequentialRNNCell(SequentialRNNCell):
+    """Sequentially stacked cells usable under hybridize (parity:
+    rnn_cell.py HybridSequentialRNNCell).  This runtime traces every
+    cell through jax anyway, so the hybrid variant IS the sequential
+    one — the class exists so reference model code constructing it
+    ports unchanged."""
+
+
 class DropoutCell(RecurrentCell):
     """Apply dropout on input (parity: rnn_cell.py DropoutCell)."""
 
