@@ -1,5 +1,6 @@
 """Training callbacks (parity: python/mxnet/callback.py): Speedometer,
-do_checkpoint, log_train_metric, ProgressBar."""
+do_checkpoint, log_train_metric, ProgressBar,
+LogValidationMetricsCallback."""
 from __future__ import annotations
 
 import logging
