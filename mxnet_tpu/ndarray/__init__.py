@@ -13,7 +13,8 @@ from . import image  # noqa: F401
 from .ndarray import (NDArray, add_n, arange, array, concat, dot, empty, eye,
                       full, invoke, linspace, maximum, minimum, moveaxis, ones,
                       ones_like, stack, transpose, waitall, zeros, zeros_like)
-from .utils import load, save
+from .utils import (from_dlpack, load, save,
+                    to_dlpack_for_read, to_dlpack_for_write)
 from ..ops import registry as _registry
 
 ElementWiseSum = add_n

@@ -247,6 +247,25 @@ class NDArray:
     def tolist(self):
         return self.asnumpy().tolist()
 
+    # -- DLPack protocol (parity: ndarray.py:2236 to_dlpack_for_read;
+    # the protocol form lets torch.from_dlpack(nd_array) work directly) --
+    def __dlpack__(self, **kwargs):
+        # pass the full DLPack-2023 surface (max_version/dl_device/copy/
+        # stream) through to the backing jax array
+        self.wait_to_read()  # sync-point contract: MXNetError on failure
+        return self._data.__dlpack__(**kwargs)
+
+    def __dlpack_device__(self):
+        return self._data.__dlpack_device__()
+
+    def to_dlpack_for_read(self):
+        from .utils import to_dlpack_for_read
+        return to_dlpack_for_read(self)
+
+    def to_dlpack_for_write(self):
+        from .utils import to_dlpack_for_write
+        return to_dlpack_for_write(self)
+
     # -- shape ops ---------------------------------------------------------
     def reshape(self, *shape, **kwargs):
         if len(shape) == 1 and isinstance(shape[0], (list, tuple)):

@@ -147,3 +147,62 @@ def load(fname):
     if keys:
         return dict(zip(keys, arrays))
     return arrays
+
+
+# --- DLPack interop (parity: ndarray.py:4058 to_dlpack_for_read /
+# to_dlpack_for_write / from_dlpack:4121).  Backed by the array API's
+# native __dlpack__ protocol, so exchange with torch/numpy/cupy is
+# zero-copy where the producer allows it. ---------------------------------
+def to_dlpack_for_read(data):
+    """A DLPack capsule view of ``data`` for READING (parity:
+    to_dlpack_for_read).  Materialization is a sync point, so async
+    device failures surface here as MXNetError (the same contract as
+    wait_to_read/asnumpy)."""
+    data.wait_to_read()  # MXNetError-wrapping sync (ndarray.py contract)
+    return data._data.__dlpack__()
+
+
+def to_dlpack_for_write(data):
+    """DLPack capsule for writing (parity: to_dlpack_for_write).
+
+    jax buffers are immutable, so a WRITABLE export cannot alias the
+    original: the capsule wraps a host copy, and the caller's writes are
+    NOT reflected back (documented deviation — functional arrays have no
+    in-place aliasing to give)."""
+    import numpy as np
+    host = np.array(data.asnumpy())  # fresh, writable
+    return host.__dlpack__()
+
+
+class _CapsuleProducer:
+    """Adapter: jax's from_dlpack wants a protocol OBJECT, while the
+    reference API traffics in bare capsules.  A bare capsule carries no
+    device tag, so it is presented as host memory (kDLCPU) — which is
+    what this API's own to_dlpack_for_read/-write produce off-device;
+    cross-device exchange should hand over the producer object itself."""
+
+    def __init__(self, capsule):
+        self._capsule = capsule
+
+    def __dlpack__(self, **_kwargs):
+        return self._capsule
+
+    def __dlpack_device__(self):
+        return (1, 0)  # kDLCPU
+
+
+def from_dlpack(dlpack):
+    """NDArray from a DLPack capsule or any __dlpack__-capable producer
+    (torch tensors, numpy arrays, ...) — parity: from_dlpack."""
+    import jax
+    from ..context import Context, cpu, gpu, tpu
+    from .ndarray import NDArray
+    if not hasattr(dlpack, "__dlpack__"):  # bare capsule (reference form)
+        dlpack = _CapsuleProducer(dlpack)
+    arr = jax.dlpack.from_dlpack(dlpack)
+    # label the context from where the buffer actually landed
+    dev = getattr(arr, "device", None)
+    platform = getattr(dev, "platform", "cpu")
+    ctor = {"cpu": cpu, "gpu": gpu, "cuda": gpu, "tpu": tpu,
+            "axon": tpu}.get(platform, cpu)
+    return NDArray(arr, ctor(getattr(dev, "id", 0)))

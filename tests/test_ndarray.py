@@ -189,3 +189,30 @@ def test_integer_index_bounds_and_iteration():
     rows = [r.asnumpy() for r in a]
     assert len(rows) == 3
     _np.testing.assert_allclose(_np.stack(rows), a.asnumpy())
+
+
+def test_dlpack_interop_with_torch():
+    """DLPack exchange (parity: reference ndarray.py to_dlpack_for_read /
+    from_dlpack): zero-copy-capable handoff to and from torch."""
+    import torch
+    x = nd.array(np.arange(12, dtype=np.float32).reshape(3, 4))
+    # NDArray -> torch via the protocol (torch consumes __dlpack__)
+    t = torch.from_dlpack(x)
+    np.testing.assert_array_equal(t.numpy(), x.asnumpy())
+    # torch -> NDArray
+    src = torch.arange(8, dtype=torch.float32).reshape(2, 4) * 0.5
+    back = nd.from_dlpack(src)
+    np.testing.assert_array_equal(back.asnumpy(), src.numpy())
+    # explicit capsule forms
+    cap = nd.to_dlpack_for_read(x)
+    t2 = torch.utils.dlpack.from_dlpack(cap)
+    np.testing.assert_array_equal(t2.numpy(), x.asnumpy())
+    # write capsule is a COPY (functional arrays: documented deviation)
+    capw = nd.to_dlpack_for_write(x)
+    t3 = torch.utils.dlpack.from_dlpack(capw)
+    t3[0, 0] = 999.0
+    assert float(x.asnumpy()[0, 0]) == 0.0
+    # the reference-parity CAPSULE round trip (bare capsule in, NDArray out)
+    rt = nd.from_dlpack(nd.to_dlpack_for_read(x))
+    np.testing.assert_array_equal(rt.asnumpy(), x.asnumpy())
+    assert rt.context.device_type in ("cpu", "tpu")
