@@ -1427,14 +1427,15 @@ class TestRound5Batch3:
     def test_ps_env_roles_and_run_server(self, monkeypatch):
         import threading
         lib = _lib()
-        # MXInitPSEnv writes into os.environ; scope it to this test
-        monkeypatch.delenv("DMLC_ROLE", raising=False)
-        monkeypatch.delenv("DMLC_PS_ROOT_PORT", raising=False)
+        # MXInitPSEnv writes into os.environ; register the UNDO state
+        # BEFORE it runs (setenv on an absent var records delete-on-undo
+        # — delenv(raising=False) on an absent var records NOTHING, the
+        # leak that broke test_parallel/test_tools when suite-ordered)
+        monkeypatch.setenv("DMLC_ROLE", "placeholder")
+        monkeypatch.setenv("DMLC_PS_ROOT_PORT", "placeholder")
         keys = (ctypes.c_char_p * 2)(b"DMLC_ROLE", b"DMLC_PS_ROOT_PORT")
         vals = (ctypes.c_char_p * 2)(b"server", b"19873")
         assert lib.MXInitPSEnv(2, keys, vals) == 0, _err(lib)
-        monkeypatch.setenv("DMLC_ROLE", "server")  # registers cleanup
-        monkeypatch.setenv("DMLC_PS_ROOT_PORT", "19873")
         ret = ctypes.c_int(-1)
         assert lib.MXKVStoreIsServerNode(ctypes.byref(ret)) == 0
         assert ret.value == 1
