@@ -1233,3 +1233,37 @@ def storage_empty_cache(dev_type, dev_id):
     import gc
     gc.collect()
     return True
+
+
+def symbol_infer_shape_partial4(s, names, shapes):
+    """Partial shape inference in the 4-tuple wire format the C shim
+    marshals (arg, out, aux, complete)."""
+    arg_s, out_s, aux_s = symbol_infer_shape_partial(s, names, shapes)
+    complete = all(x is not None for x in list(arg_s) + list(out_s)
+                   + list(aux_s))
+    return arg_s, out_s, aux_s, complete
+
+
+def symbol_save_file(s, fname):
+    s.save(fname)  # the one canonical serde path (symbol.py Symbol.save)
+    return True
+
+
+def symbol_load_file(fname):
+    from .symbol import load
+    return load(fname)
+
+
+def kvstore_set_gradient_compression(kv, keys, vals):
+    kv.set_gradient_compression(dict(zip(keys, vals)))
+    return True
+
+
+def data_iter_arg_names(name):
+    """Constructor parameter names of a registered iterator (the arg
+    metadata MXDataIterGetIterInfo reports)."""
+    import inspect
+    cls = _iter_registry()[name]
+    params = list(inspect.signature(cls.__init__).parameters.values())[1:]
+    return [p.name for p in params
+            if p.kind in (p.POSITIONAL_OR_KEYWORD, p.KEYWORD_ONLY)]

@@ -718,7 +718,8 @@ int MXRandomSeed(int seed) {
 }
 
 // --- symbol shape inference ------------------------------------------------
-int MXSymbolInferShape(SymbolHandle sym, mx_uint num_args,
+static int infer_shape_common(const char* helper, SymbolHandle sym,
+                       mx_uint num_args,
                        const char** keys, const mx_uint* arg_ind_ptr,
                        const mx_uint* arg_shape_data,
                        mx_uint* in_shape_size,
@@ -747,7 +748,7 @@ int MXSymbolInferShape(SymbolHandle sym, mx_uint num_args,
   PyObject* args = Py_BuildValue("(OOO)", sym, names, shapes);
   Py_DECREF(names);
   Py_DECREF(shapes);
-  PyObject* r = args ? call("symbol_infer_shape", args) : nullptr;
+  PyObject* r = args ? call(helper, args) : nullptr;
   Py_XDECREF(args);
   if (!r) return fail_from_python();
   g_in_shapes.load(PyTuple_GetItem(r, 0));
@@ -1603,6 +1604,165 @@ int MXNotifyShutdown(void) {
 }
 
 
+
+
+int MXSymbolInferShape(SymbolHandle sym, mx_uint num_args,
+                       const char** keys, const mx_uint* arg_ind_ptr,
+                       const mx_uint* arg_shape_data,
+                       mx_uint* in_shape_size,
+                       const mx_uint** in_shape_ndim,
+                       const mx_uint*** in_shape_data,
+                       mx_uint* out_shape_size,
+                       const mx_uint** out_shape_ndim,
+                       const mx_uint*** out_shape_data,
+                       mx_uint* aux_shape_size,
+                       const mx_uint** aux_shape_ndim,
+                       const mx_uint*** aux_shape_data,
+                       int* complete) {
+  return infer_shape_common("symbol_infer_shape", sym, num_args, keys,
+                            arg_ind_ptr, arg_shape_data, in_shape_size,
+                            in_shape_ndim, in_shape_data, out_shape_size,
+                            out_shape_ndim, out_shape_data, aux_shape_size,
+                            aux_shape_ndim, aux_shape_data, complete);
+}
+
+int MXSymbolInferShapePartial(SymbolHandle sym, mx_uint num_args,
+                              const char** keys,
+                              const mx_uint* arg_ind_ptr,
+                              const mx_uint* arg_shape_data,
+                              mx_uint* in_shape_size,
+                              const mx_uint** in_shape_ndim,
+                              const mx_uint*** in_shape_data,
+                              mx_uint* out_shape_size,
+                              const mx_uint** out_shape_ndim,
+                              const mx_uint*** out_shape_data,
+                              mx_uint* aux_shape_size,
+                              const mx_uint** aux_shape_ndim,
+                              const mx_uint*** aux_shape_data,
+                              int* complete) {
+  return infer_shape_common("symbol_infer_shape_partial4", sym, num_args,
+                            keys, arg_ind_ptr, arg_shape_data,
+                            in_shape_size, in_shape_ndim, in_shape_data,
+                            out_shape_size, out_shape_ndim, out_shape_data,
+                            aux_shape_size, aux_shape_ndim, aux_shape_data,
+                            complete);
+}
+
+/* ---- final width batch: file serde, 64-bit view aliases, invoke alias,
+   gradient compression, iterator info ------------------------------------ */
+
+int MXSymbolSaveToFile(SymbolHandle symbol, const char* fname) {
+  if (!symbol) return fail("null handle");
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Os)", symbol, fname);
+  PyObject* r = args ? call("symbol_save_file", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXSymbolCreateFromFile(const char* fname, SymbolHandle* out) {
+  ensure_python();
+  Gil gil;
+  PyObject* args = Py_BuildValue("(s)", fname);
+  PyObject* r = args ? call("symbol_load_file", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  *out = r;
+  return 0;
+}
+
+int MXImperativeInvoke(const char* op_name, int num_inputs,
+                       NDArrayHandle* inputs, int* num_outputs,
+                       NDArrayHandle** outputs, int num_params,
+                       const char** param_keys, const char** param_vals) {
+  return MXImperativeInvokeEx(op_name, num_inputs, inputs, num_outputs,
+                              outputs, num_params, param_keys, param_vals);
+}
+
+int MXNDArrayAt64(NDArrayHandle handle, int64_t idx, NDArrayHandle* out) {
+  /* the int32 narrowing contract is LOUD (mxnet_tpu/base.py): refuse
+     rather than truncate */
+  if (idx < 0 || idx > UINT32_MAX) return fail("index beyond uint32 range");
+  return MXNDArrayAt(handle, static_cast<mx_uint>(idx), out);
+}
+
+int MXNDArraySlice64(NDArrayHandle handle, int64_t begin, int64_t end,
+                     NDArrayHandle* out) {
+  if (begin < 0 || begin > UINT32_MAX || end < 0 || end > UINT32_MAX) {
+    return fail("slice bound beyond uint32 range");
+  }
+  return MXNDArraySlice(handle, static_cast<mx_uint>(begin),
+                        static_cast<mx_uint>(end), out);
+}
+
+int MXKVStoreSetGradientCompression(KVStoreHandle handle, mx_uint num_params,
+                                    const char** keys, const char** vals) {
+  if (!handle) return fail("null handle");
+  Gil gil;
+  PyObject* ks = list_from_strs(num_params, keys);
+  PyObject* vs = list_from_strs(num_params, vals);
+  PyObject* args = Py_BuildValue("(OOO)", handle, ks, vs);
+  Py_DECREF(ks);
+  Py_DECREF(vs);
+  PyObject* r = args ? call("kvstore_set_gradient_compression", args)
+                     : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXDataIterGetIterInfo(void* creator, const char** name,
+                          const char** description, mx_uint* num_args,
+                          const char*** arg_names,
+                          const char*** arg_type_infos,
+                          const char*** arg_descriptions) {
+  ensure_python();
+  Gil gil;
+  PyObject* args = Py_BuildValue("(s)", static_cast<const char*>(creator));
+  PyObject* r = args ? call("data_iter_list_info", args) : nullptr;
+  Py_XDECREF(args);
+  if (!r) return fail_from_python();
+  static thread_local std::string nm, doc;
+  nm = PyUnicode_AsUTF8(PyTuple_GetItem(r, 0));
+  doc = PyUnicode_AsUTF8(PyTuple_GetItem(r, 1));
+  Py_DECREF(r);
+  if (name) *name = nm.c_str();
+  if (description) *description = doc.c_str();
+  /* arg metadata from the iterator class's constructor signature */
+  static thread_local std::vector<std::string> astrs;
+  static thread_local std::vector<const char*> anames, atypes, adescs;
+  astrs.clear(); anames.clear(); atypes.clear(); adescs.clear();
+  {
+    Gil gil2;
+    PyObject* a2 = Py_BuildValue("(s)", nm.c_str());
+    PyObject* r2 = a2 ? call("data_iter_arg_names", a2) : nullptr;
+    Py_XDECREF(a2);
+    if (r2) {
+      Py_ssize_t na = PySequence_Size(r2);
+      for (Py_ssize_t i = 0; i < na; ++i) {
+        PyObject* it = PySequence_GetItem(r2, i);
+        astrs.emplace_back(PyUnicode_AsUTF8(it));
+        Py_XDECREF(it);
+      }
+      Py_DECREF(r2);
+      for (auto& s2 : astrs) {
+        anames.push_back(s2.c_str());
+        atypes.push_back("");
+        adescs.push_back("");
+      }
+    } else {
+      PyErr_Clear();
+    }
+  }
+  if (num_args) *num_args = static_cast<mx_uint>(anames.size());
+  if (arg_names) *arg_names = anames.data();
+  if (arg_type_infos) *arg_type_infos = atypes.data();
+  if (arg_descriptions) *arg_descriptions = adescs.data();
+  return 0;
+}
 
 /* ---- misc batch 4: profiler aliases, feature flags, numpy-shape toggle,
    engine knobs (reference c_api.h:235+, 2618+, profiler legacy names) ---- */

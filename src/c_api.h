@@ -235,6 +235,39 @@ int MXKVStoreFree(KVStoreHandle handle);
 
 
 
+
+/* ---- final width batch -------------------------------------------------- */
+int MXSymbolInferShapePartial(SymbolHandle sym, mx_uint num_args,
+                              const char** keys,
+                              const mx_uint* arg_ind_ptr,
+                              const mx_uint* arg_shape_data,
+                              mx_uint* in_shape_size,
+                              const mx_uint** in_shape_ndim,
+                              const mx_uint*** in_shape_data,
+                              mx_uint* out_shape_size,
+                              const mx_uint** out_shape_ndim,
+                              const mx_uint*** out_shape_data,
+                              mx_uint* aux_shape_size,
+                              const mx_uint** aux_shape_ndim,
+                              const mx_uint*** aux_shape_data,
+                              int* complete);
+int MXSymbolSaveToFile(SymbolHandle symbol, const char* fname);
+int MXSymbolCreateFromFile(const char* fname, SymbolHandle* out);
+int MXImperativeInvoke(const char* op_name, int num_inputs,
+                       NDArrayHandle* inputs, int* num_outputs,
+                       NDArrayHandle** outputs, int num_params,
+                       const char** param_keys, const char** param_vals);
+int MXNDArrayAt64(NDArrayHandle handle, int64_t idx, NDArrayHandle* out);
+int MXNDArraySlice64(NDArrayHandle handle, int64_t begin, int64_t end,
+                     NDArrayHandle* out);
+int MXKVStoreSetGradientCompression(KVStoreHandle handle, mx_uint num_params,
+                                    const char** keys, const char** vals);
+int MXDataIterGetIterInfo(void* creator, const char** name,
+                          const char** description, mx_uint* num_args,
+                          const char*** arg_names,
+                          const char*** arg_type_infos,
+                          const char*** arg_descriptions);
+
 /* ---- misc batch 4 ------------------------------------------------------- */
 int MXSetProfilerConfig(int num_params, const char** keys,
                         const char** vals);

@@ -1577,3 +1577,76 @@ class TestRound5Batch3:
         assert lib.MXGetGPUMemoryInformation(0, ctypes.byref(free),
                                              ctypes.byref(tot)) == 0
         assert lib.MXKVStoreSetBarrierBeforeExit(None, 1) == 0
+
+    def test_final_width_batch(self, tmp_path):
+        lib = _lib()
+        x = vp()
+        assert lib.MXSymbolCreateVariable(b"x", ctypes.byref(x)) == 0
+        sq = vp()
+        assert lib.MXSymbolCreateOp(b"square", 0, None, None, 1,
+                                    (vp * 1)(x), b"sq",
+                                    ctypes.byref(sq)) == 0
+        # file round trip
+        fname = str(tmp_path / "sym.json").encode()
+        assert lib.MXSymbolSaveToFile(sq, fname) == 0, _err(lib)
+        loaded = vp()
+        assert lib.MXSymbolCreateFromFile(fname,
+                                          ctypes.byref(loaded)) == 0
+        n = u32()
+        names = ctypes.POINTER(ctypes.c_char_p)()
+        assert lib.MXSymbolListArguments(loaded, ctypes.byref(n),
+                                         ctypes.byref(names)) == 0
+        assert n.value == 1 and names[0] == b"x"
+        # partial shape inference: no shapes provided -> complete=0
+        u32p = ctypes.POINTER(u32)
+        isz = u32(); indim = u32p(); idata = ctypes.POINTER(u32p)()
+        osz = u32(); ondim = u32p(); odata = ctypes.POINTER(u32p)()
+        asz = u32(); andim = u32p(); adata = ctypes.POINTER(u32p)()
+        comp = ctypes.c_int(-1)
+        lib.MXSymbolInferShapePartial.argtypes = [
+            vp, u32, ctypes.POINTER(ctypes.c_char_p), u32p, u32p,
+            ctypes.POINTER(u32), ctypes.POINTER(u32p),
+            ctypes.POINTER(ctypes.POINTER(u32p)),
+            ctypes.POINTER(u32), ctypes.POINTER(u32p),
+            ctypes.POINTER(ctypes.POINTER(u32p)),
+            ctypes.POINTER(u32), ctypes.POINTER(u32p),
+            ctypes.POINTER(ctypes.POINTER(u32p)),
+            ctypes.POINTER(ctypes.c_int)]
+        rc = lib.MXSymbolInferShapePartial(
+            sq, 0, None, (u32 * 1)(0), None,
+            ctypes.byref(isz), ctypes.byref(indim), ctypes.byref(idata),
+            ctypes.byref(osz), ctypes.byref(ondim), ctypes.byref(odata),
+            ctypes.byref(asz), ctypes.byref(andim), ctypes.byref(adata),
+            ctypes.byref(comp))
+        assert rc == 0, _err(lib)
+        assert comp.value == 0  # nothing known -> incomplete, no error
+        # invoke alias + 64-bit views
+        a = _mk_ndarray(lib, np.arange(6, dtype=np.float32).reshape(3, 2))
+        no = ctypes.c_int(0)
+        outs = ctypes.POINTER(vp)()
+        assert lib.MXImperativeInvoke(b"square", 1, (vp * 1)(a),
+                                      ctypes.byref(no), ctypes.byref(outs),
+                                      0, None, None) == 0
+        row = vp()
+        lib.MXNDArrayAt64.argtypes = [vp, ctypes.c_int64,
+                                      ctypes.POINTER(vp)]
+        assert lib.MXNDArrayAt64(a, 1, ctypes.byref(row)) == 0, _err(lib)
+        sl = vp()
+        lib.MXNDArraySlice64.argtypes = [vp, ctypes.c_int64,
+                                         ctypes.c_int64,
+                                         ctypes.POINTER(vp)]
+        assert lib.MXNDArraySlice64(a, 0, 2, ctypes.byref(sl)) == 0
+        # gradient compression config reaches the kvstore
+        kv = vp()
+        assert lib.MXKVStoreCreate(b"device", ctypes.byref(kv)) == 0
+        k = (ctypes.c_char_p * 1)(b"type")
+        v = (ctypes.c_char_p * 1)(b"2bit")
+        assert lib.MXKVStoreSetGradientCompression(kv, 1, k, v) == 0, \
+            _err(lib)
+        # iterator info by name
+        nm = ctypes.c_char_p(); desc = ctypes.c_char_p()
+        na = u32()
+        assert lib.MXDataIterGetIterInfo(
+            b"CSVIter", ctypes.byref(nm), ctypes.byref(desc),
+            ctypes.byref(na), None, None, None) == 0, _err(lib)
+        assert nm.value == b"CSVIter"
