@@ -309,7 +309,11 @@ def main():
             if not init_done.wait(init_timeout):
                 emit({**result,
                       "error": f"device init exceeded {init_timeout:.0f}s "
-                               "(TPU relay unreachable)"})
+                               "(TPU relay unreachable)",
+                      "note": "relay unreachable at bench time; the last "
+                              "self-measured numbers and the corrected-"
+                              "accounting MFU expectations are tabulated "
+                              "in docs/perf_notes.md"})
                 os._exit(3)
 
         if init_timeout > 0:  # 0 disables, matching the other BENCH_* knobs
