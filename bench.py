@@ -178,6 +178,98 @@ def calibrate_peak(dev, reps=None):
                   "sweep": sweep}
 
 
+def measure_serving():
+    """Inference serving throughput: ResNet-18 through the DynamicBatcher
+    under synthetic Poisson arrivals (open loop).
+
+    Three phases: (1) warm the full bucket so the XLA compile is outside
+    the window; (2) a short closed-loop probe to find the saturated
+    throughput; (3) a BENCH_SERVE_SECONDS open-loop run with exponential
+    inter-arrivals at BENCH_SERVE_RATE (0 = auto: 1.2x the probe, i.e.
+    deliberately slightly over capacity so queueing + shedding engage).
+    Headline value is completed img/s over the open-loop window; p50/p99
+    and batch occupancy come from serving metrics.
+    """
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import config as mxcfg
+    from mxnet_tpu import serving
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    max_batch = mxcfg.get("BENCH_SERVE_BATCH")
+    lat_ms = mxcfg.get("BENCH_SERVE_LATENCY_MS")
+    seconds = mxcfg.get("BENCH_SERVE_SECONDS")
+    rate = mxcfg.get("BENCH_SERVE_RATE")
+
+    net = vision.resnet18_v1()
+    net.initialize(mx.initializer.Xavier())
+    net(mx.nd.zeros((1, 3, 224, 224)))  # materialize deferred-init params
+    server = serving.ModelServer(
+        max_batch_size=max_batch, max_latency_ms=lat_ms,
+        max_queue_depth=max(256, 4 * max_batch), name="bench")
+    server.load("resnet18", block=net)
+    sample = np.random.randn(3, 224, 224).astype(np.float32)
+
+    def fire(n):
+        futs = []
+        for _ in range(n):
+            futs.append(server.predict_async("resnet18", {"data": sample}))
+        for f in futs:
+            f.result(600)
+
+    log(f"[serving] warmup: bucket {max_batch} compile + first batch")
+    fire(max_batch)
+    t0 = time.perf_counter()
+    fire(4 * max_batch)
+    probe_rps = 4 * max_batch / (time.perf_counter() - t0)
+    lam = rate or 1.2 * probe_rps
+    log(f"[serving] probe {probe_rps:.1f} img/s closed-loop; "
+        f"Poisson arrivals at {lam:.1f} req/s for {seconds:.0f}s")
+
+    rng = np.random.default_rng(0)
+    futures, shed = [], 0
+    t_begin = time.perf_counter()
+    t_next, t_end = t_begin, t_begin + seconds
+    while True:
+        now = time.perf_counter()
+        if now >= t_end:
+            break
+        t_next += rng.exponential(1.0 / lam)
+        if t_next > now:
+            time.sleep(t_next - now)
+        try:
+            futures.append(
+                server.predict_async("resnet18", {"data": sample}))
+        except serving.ServingOverloadError:
+            shed += 1
+    completed = 0
+    for f in futures:
+        try:
+            f.result(600)
+            completed += 1
+        except Exception:
+            pass
+    elapsed = time.perf_counter() - t_begin
+    snap = server.stats()
+    server.shutdown()
+    return {
+        "metric": "resnet18_serve_img_per_sec",
+        "value": round(completed / elapsed, 2),
+        "unit": "img/s",
+        "window_s": round(elapsed, 2),
+        "arrival_rate_rps": round(lam, 2),
+        "probe_closed_loop_rps": round(probe_rps, 2),
+        "offered": len(futures) + shed,
+        "completed": completed,
+        "shed": shed,
+        "p50_ms": snap["latency_ms"]["p50"],
+        "p99_ms": snap["latency_ms"]["p99"],
+        "batch_occupancy": snap.get("batch_occupancy"),
+        "max_batch_size": max_batch,
+        "max_latency_ms": lat_ms,
+    }
+
+
 _MODEL_CACHE = {}
 
 
@@ -558,6 +650,24 @@ def main():
             except Exception as e:
                 log(f"bs{extra_bs} phase failed: {type(e).__name__}: {e}")
                 result[f"bs{extra_bs}"] = {"error": str(e)}
+
+        # --- serving throughput (resnet18 via the DynamicBatcher) -------
+        from mxnet_tpu import config as _mxcfg
+        if _mxcfg.get("BENCH_SERVE"):
+            remaining = budget - (time.perf_counter() - T_START)
+            if remaining <= 180:
+                log(f"skipping serving phase: only {remaining:.0f}s left")
+            else:
+                try:
+                    srv = measure_serving()
+                    result["serving"] = srv
+                    log(f"[serving] {srv['value']} img/s "
+                        f"(p99 {srv['p99_ms']}ms, shed {srv['shed']})")
+                except Exception as e:
+                    log(f"serving phase failed: {type(e).__name__}: {e}")
+                    result["serving"] = {
+                        "metric": "resnet18_serve_img_per_sec",
+                        "error": f"{type(e).__name__}: {e}"}
     except Exception as e:  # always emit the JSON line
         import traceback
         traceback.print_exc(file=sys.stderr)

@@ -17,6 +17,10 @@ git diff --exit-code cpp-package/include/mxnet_tpu/op.hpp
 echo "== unit suite (virtual 8-device CPU mesh via tests/conftest.py) =="
 MXNET_TEST_EXAMPLES=1 python -m pytest tests/ -q
 
+echo "== serving smoke (dynamic batcher, 64 concurrent clients) =="
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  python -m mxnet_tpu.serving.smoke
+
 echo "== entry points =="
 JAX_PLATFORMS=cpu python -c \
   "import __graft_entry__ as g; fn, a = g.entry(); fn(*a)"

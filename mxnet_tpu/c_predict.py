@@ -4,9 +4,16 @@ Parity: the reference's standalone predict ABI (c_predict_api.cc) binds a
 symbol + params for inference only; here the Predictor wraps a bound
 Executor with grad_req='null'. Params arrive as the raw bytes of a
 .params file (nd.save format), inputs/outputs as raw float32 buffers.
+
+Executor acquisition goes through the serving layer's shared compiled-
+executor cache, keyed by content hash of (symbol JSON, param bytes) plus
+the input-shape signature: a C host that creates a fresh Predictor per
+request — the reference deployment pattern — reuses one bound executor
+(and its compiled XLA program) instead of rebinding every time.
 """
 from __future__ import annotations
 
+import hashlib
 import os
 import tempfile
 
@@ -41,25 +48,25 @@ def _load_params_bytes(raw):
 
 class Predictor:
     def __init__(self, symbol_json, param_bytes, input_shapes):
+        from .serving.executor_cache import (bind_inference_executor,
+                                             shape_signature, shared_cache)
         self._sym = load_json(symbol_json)
-        params = _load_params_bytes(param_bytes)
-        arg_names = self._sym.list_arguments()
-        aux_names = set(self._sym.list_auxiliary_states())
         self._input_shapes = {k: tuple(int(d) for d in v)
                               for k, v in input_shapes.items()}
-        args = {}
-        for name in arg_names:
-            if name in self._input_shapes:
-                args[name] = nd.zeros(self._input_shapes[name])
-            elif name in params:
-                args[name] = params[name]
-            else:
-                raise MXNetError(
-                    f"predictor: argument {name!r} has neither a bound "
-                    "input shape nor a loaded parameter")
-        aux = {name: params[name] for name in aux_names if name in params}
-        self._exec = self._sym.bind(cpu(), args, grad_req="null",
-                                    aux_states=aux)
+        # content-addressed identity: same model bytes + same shapes ->
+        # same bound executor, across Predictor instances
+        key = ("c_predict",
+               hashlib.sha1(symbol_json.encode()).hexdigest(),
+               hashlib.sha1(param_bytes).hexdigest(),
+               shape_signature(self._input_shapes))
+
+        def _bind():
+            params = _load_params_bytes(param_bytes)
+            return bind_inference_executor(self._sym, params,
+                                           self._input_shapes, cpu())
+
+        self._cached = shared_cache().get(key, _bind)
+        self._exec = self._cached.executor
         self._outputs = None
 
     def set_input(self, key, raw):

@@ -131,6 +131,32 @@ _register("MXNET_PROFILER_AUTOSTART", bool, False,
 _register("MXNET_PROFILER_MODE", str, "",
           "with AUTOSTART: 'all'/'1' also enables profile_all + "
           "profile_api (parity: reference MXNET_PROFILER_MODE)")
+# -- serving ----------------------------------------------------------------
+_register("MXNET_SERVING_MAX_BATCH", int, 32,
+          "DynamicBatcher flush size: a batch runs as soon as this many "
+          "requests coalesce (upper bound of the bucketed batch dim)")
+_register("MXNET_SERVING_MAX_LATENCY_MS", float, 5.0,
+          "DynamicBatcher deadline: a partial batch flushes once the "
+          "oldest queued request has waited this long (throughput vs "
+          "p99 knob; docs/serving.md)")
+_register("MXNET_SERVING_QUEUE_DEPTH", int, 256,
+          "bounded serving queue capacity (requests)")
+_register("MXNET_SERVING_SHED_WATERMARK", int, 0,
+          "queue depth at which submits fail fast with "
+          "ServingOverloadError; 0 = at queue capacity")
+_register("MXNET_SERVING_NUM_WORKERS", int, 1,
+          "batch-execution worker threads per model endpoint")
+_register("MXNET_SERVING_TIMEOUT_MS", float, 0.0,
+          "default per-request timeout (queued past this -> "
+          "RequestTimeoutError); 0 disables")
+_register("MXNET_SERVING_EXECUTOR_CACHE", int, 32,
+          "LRU capacity of the compiled-executor cache, in (model, "
+          "version, bucketed-shape) entries")
+_register("MXNET_MODULE_PAD_PARTIAL_PREDICT", bool, True,
+          "Module.forward(is_train=False): pad a partial final batch up "
+          "to the bound batch and slice outputs, instead of rebinding a "
+          "new executor shape (serving-style bucketing on the module "
+          "predict path)")
 # -- driver / bench ---------------------------------------------------------
 _register("MX_DRYRUN_TIMEOUT", float, 900.0,
           "subprocess timeout for __graft_entry__.dryrun_multichip")
@@ -146,3 +172,15 @@ _register("BENCH_REMAT_FROM_BS", int, 64,
           "(0 disables); see MXNET_BACKWARD_DO_MIRROR")
 _register("BENCH_CALIB_N", int, 4096,
           "bench.py peak-calibration matmul dimension")
+_register("BENCH_SERVE", bool, True,
+          "bench.py: also measure serving throughput (resnet18 via the "
+          "DynamicBatcher under Poisson arrivals)")
+_register("BENCH_SERVE_SECONDS", float, 8.0,
+          "bench.py serving phase: Poisson measurement window (s)")
+_register("BENCH_SERVE_RATE", float, 0.0,
+          "bench.py serving phase: Poisson arrival rate (req/s); 0 = "
+          "auto (1.2x the closed-loop probe throughput)")
+_register("BENCH_SERVE_BATCH", int, 32,
+          "bench.py serving phase: DynamicBatcher max_batch_size")
+_register("BENCH_SERVE_LATENCY_MS", float, 10.0,
+          "bench.py serving phase: DynamicBatcher max_latency_ms")

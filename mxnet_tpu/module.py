@@ -460,20 +460,57 @@ class Module(BaseModule):
         if self._label_shapes and data_batch.label:
             for desc, arr in zip(self._label_shapes, data_batch.label):
                 feed[desc.name] = arr
-        for name, arr in feed.items():
-            tgt = self._exec.arg_dict[name]
-            if tuple(arr.shape) != tuple(tgt.shape):
-                # shape change (last partial batch / bucketing): reshape.
-                # The module owns its data arrays, so growing back to the
-                # full batch after a partial one is expected — opt into
-                # both relaxations explicitly
-                self._exec = self._exec.reshape(
-                    partial_shaping=True, allow_up_sizing=True,
-                    **{n: a.shape for n, a in feed.items()})
-            break
+        self._forward_pad = 0
+        mismatch = any(
+            tuple(arr.shape) != tuple(self._exec.arg_dict[name].shape)
+            for name, arr in feed.items())
+        if mismatch:
+            pad = self._partial_batch_pad(feed) if not is_train else None
+            if pad is not None:
+                # serving-style bucketing on the predict path: a partial
+                # final batch is zero-padded up to the bound batch and the
+                # outputs sliced (get_outputs), reusing the compiled
+                # program instead of rebinding a new executor shape
+                # (MXNET_MODULE_PAD_PARTIAL_PREDICT; docs/serving.md)
+                n, bound = pad
+                self._forward_pad = bound - n
+                self._pad_bound = bound
+                for name, arr in feed.items():
+                    host = arr.asnumpy()
+                    host = np.concatenate(
+                        [host, np.zeros((bound - n,) + host.shape[1:],
+                                        host.dtype)], axis=0)
+                    self._exec.arg_dict[name][:] = host
+                self._exec.forward(is_train=False)
+                return
+            # shape change (bucketing / train-mode partial batch):
+            # reshape.  The module owns its data arrays, so growing back
+            # to the full batch after a partial one is expected — opt
+            # into both relaxations explicitly
+            self._exec = self._exec.reshape(
+                partial_shaping=True, allow_up_sizing=True,
+                **{n: a.shape for n, a in feed.items()})
         for name, arr in feed.items():
             self._exec.arg_dict[name][:] = arr
         self._exec.forward(is_train=is_train)
+
+    def _partial_batch_pad(self, feed):
+        """(n, bound) when ``feed`` is the bound shapes short a few batch
+        rows (pad-and-slice eligible), else None."""
+        from . import config as _config
+        if not _config.get("MXNET_MODULE_PAD_PARTIAL_PREDICT"):
+            return None
+        ns, bounds = set(), set()
+        for name, arr in feed.items():
+            tgt = self._exec.arg_dict[name]
+            if tuple(arr.shape[1:]) != tuple(tgt.shape[1:]):
+                return None
+            ns.add(int(arr.shape[0]))
+            bounds.add(int(tgt.shape[0]))
+        if len(ns) != 1 or len(bounds) != 1:
+            return None
+        n, bound = ns.pop(), bounds.pop()
+        return (n, bound) if 0 < n < bound else None
 
     def backward(self, out_grads=None):
         """Backward (parity: module.py backward)."""
@@ -507,7 +544,16 @@ class Module(BaseModule):
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized
-        return self._exec.outputs
+        outs = self._exec.outputs
+        pad = getattr(self, "_forward_pad", 0)
+        if pad:
+            # slice off the zero-padding rows added by the partial-batch
+            # predict path (only outputs carrying the padded batch dim)
+            bound = self._pad_bound
+            outs = [o.slice_axis(0, 0, bound - pad)
+                    if len(o.shape) >= 1 and o.shape[0] == bound else o
+                    for o in outs]
+        return outs
 
     def get_input_grads(self, merge_multi_context=True):
         assert self.binded and self.params_initialized and \
@@ -518,7 +564,7 @@ class Module(BaseModule):
         eval_metric.update_dict(
             {name: l for name, l in zip([d.name for d in self._label_shapes],
                                         labels)},
-            dict(zip(self.output_names, self._exec.outputs)))
+            dict(zip(self.output_names, self.get_outputs())))
 
     @property
     def output_names(self):

@@ -187,6 +187,22 @@ def record_op(name, dur_us, cat="operator"):
         _sample_device_memory()
 
 
+def record_counter(name, value, args_key="value"):
+    """Append one counter-lane sample ("C" event) to the trace (parity:
+    the reference profiler's counter lanes, src/profiler/profiler.h
+    ProfileCounter).  Module-level entry point so subsystems (serving
+    metrics, storage, …) can emit counters without holding a Domain/
+    Counter object; no-op while the profiler is stopped."""
+    if not _state["running"]:
+        return
+    with _records_lock:
+        _records.append({
+            "name": name, "cat": "counter", "ph": "C",
+            "ts": (time.perf_counter() - _t0) * 1e6,
+            "pid": os.getpid(), "args": {args_key: value},
+        })
+
+
 def record_api(name, dur_us=0.0):
     """Record a frontend/API event (waitall, asnumpy, bind, …) when
     profile_api is on (parity: the reference's MXAPIThreadLocal API-call
@@ -362,14 +378,7 @@ class Counter:
     def _emit(self):
         # counters render as a chrome-trace counter lane ("C" events),
         # like the reference's profiler counters
-        if not _state["running"]:
-            return
-        with _records_lock:
-            _records.append({
-                "name": f"{self.domain}:{self.name}", "cat": "counter",
-                "ph": "C", "ts": (time.perf_counter() - _t0) * 1e6,
-                "pid": os.getpid(), "args": {"value": self.value},
-            })
+        record_counter(f"{self.domain}:{self.name}", self.value)
 
     def set_value(self, value):
         self.value = value

@@ -1,0 +1,26 @@
+"""mxnet_tpu.serving — dynamic-batching inference serving.
+
+The L-layer above the executor that the ROADMAP's "serves heavy traffic"
+north star needs: a versioned ModelRepository (hot reload, multi-model),
+a compiled-executor cache with power-of-two shape bucketing (repeated
+shapes reuse one XLA program; padding handled transparently), and a
+DynamicBatcher draining a bounded queue under a max_batch_size /
+max_latency_ms deadline policy — with load shedding, per-request
+timeouts, graceful drain, and p50/p90/p99 serving metrics exported
+through the profiler counter lanes.  See docs/serving.md.
+"""
+from .batcher import (DynamicBatcher, RequestTimeoutError, ServeFuture,
+                      ServingClosedError, ServingOverloadError)
+from .executor_cache import (CachedExecutor, ExecutorCache,
+                             bind_inference_executor, bucket_batch, pad_to,
+                             shape_signature, shared_cache)
+from .metrics import ServingMetrics, stats
+from .repository import ModelRepository
+from .server import ModelServer
+
+__all__ = [
+    "CachedExecutor", "DynamicBatcher", "ExecutorCache", "ModelRepository",
+    "ModelServer", "RequestTimeoutError", "ServeFuture", "ServingClosedError",
+    "ServingMetrics", "ServingOverloadError", "bind_inference_executor",
+    "bucket_batch", "pad_to", "shape_signature", "shared_cache", "stats",
+]

@@ -1,0 +1,276 @@
+"""DynamicBatcher: deadline-bounded request coalescing.
+
+Requests (one sample each, no batch dim) enter a bounded queue; worker
+threads drain it into batches under the policy
+
+* flush when ``max_batch_size`` requests have coalesced, OR
+* flush when ``max_latency_ms`` has elapsed since the oldest queued
+  request started waiting (a lone request never waits longer than the
+  deadline — the throughput-vs-p99 knob, see docs/serving.md);
+* a burst larger than ``max_batch_size`` is split into micro-batches:
+  each worker pass takes at most ``max_batch_size`` requests and the
+  remainder stays queued for the next pass (or another worker).
+
+Robustness contract:
+
+* the queue is bounded — ``submit`` on a queue at the shed watermark
+  fails fast with ``ServingOverloadError`` (an ``MXNetError`` carrying
+  ``queue_depth``/``watermark``/``batcher`` fields) instead of letting
+  latency grow without bound;
+* per-request timeouts: a request whose deadline expires while queued
+  is failed with ``RequestTimeoutError`` without wasting a batch slot;
+* ``close(drain=True)`` stops intake, lets workers drain everything
+  in flight, then joins them; ``drain=False`` fails queued requests
+  immediately (structured error, never a hang).
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+import numpy as np
+
+from ..base import MXNetError
+from .metrics import ServingMetrics
+
+
+class ServingOverloadError(MXNetError):
+    """Load shed: queue depth reached the watermark (backpressure)."""
+
+    def __init__(self, batcher, queue_depth, watermark):
+        self.batcher = batcher
+        self.queue_depth = queue_depth
+        self.watermark = watermark
+        super().__init__(
+            f"serving[{batcher}]: queue depth {queue_depth} >= shed "
+            f"watermark {watermark}; request shed — retry with backoff "
+            "(load-shedding keeps p99 bounded instead of queueing "
+            "unboundedly)")
+
+
+class RequestTimeoutError(MXNetError):
+    """The request's deadline expired before (or while) it was served."""
+
+    def __init__(self, batcher, waited_ms, timeout_ms):
+        self.batcher = batcher
+        self.waited_ms = waited_ms
+        self.timeout_ms = timeout_ms
+        super().__init__(
+            f"serving[{batcher}]: request timed out after "
+            f"{waited_ms:.1f}ms (timeout {timeout_ms:.1f}ms)")
+
+
+class ServingClosedError(MXNetError):
+    """Submit after shutdown (or request abandoned by drain=False)."""
+
+    def __init__(self, batcher):
+        self.batcher = batcher
+        super().__init__(f"serving[{batcher}]: server is shut down")
+
+
+class ServeFuture:
+    """Minimal future for one request (threading.Event based)."""
+
+    __slots__ = ("_event", "_result", "_exc")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._result = None
+        self._exc = None
+
+    def _set_result(self, value):
+        self._result = value
+        self._event.set()
+
+    def _set_exception(self, exc):
+        self._exc = exc
+        self._event.set()
+
+    def done(self):
+        return self._event.is_set()
+
+    def result(self, timeout=None):
+        if not self._event.wait(timeout):
+            raise MXNetError(
+                f"serving: no response within {timeout}s (request still "
+                "queued or executing)")
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+
+class _Request:
+    __slots__ = ("inputs", "future", "t_enqueue", "deadline")
+
+    def __init__(self, inputs, deadline):
+        self.inputs = inputs
+        self.future = ServeFuture()
+        self.t_enqueue = time.perf_counter()
+        self.deadline = deadline
+
+
+class DynamicBatcher:
+    """Queue + worker threads draining it through ``runner``.
+
+    ``runner(feed, n_real)`` receives ``{input_name: np.ndarray}`` with
+    the requests stacked on a new leading axis (``n_real`` rows, NOT yet
+    padded — shape bucketing is the runner's concern, see
+    executor_cache) and returns a list of batch-leading output arrays;
+    row ``i`` of every output answers request ``i``.
+    """
+
+    def __init__(self, runner, max_batch_size=None, max_latency_ms=None,
+                 num_workers=None, max_queue_depth=None, shed_watermark=None,
+                 default_timeout_ms=None, name="batcher", metrics=None):
+        from .. import config as _config
+        cfg = _config.get
+        self.name = name
+        self._runner = runner
+        self.max_batch_size = int(max_batch_size
+                                  if max_batch_size is not None
+                                  else cfg("MXNET_SERVING_MAX_BATCH"))
+        self.max_latency_ms = float(max_latency_ms
+                                    if max_latency_ms is not None
+                                    else cfg("MXNET_SERVING_MAX_LATENCY_MS"))
+        self.max_queue_depth = int(max_queue_depth
+                                   if max_queue_depth is not None
+                                   else cfg("MXNET_SERVING_QUEUE_DEPTH"))
+        watermark = (shed_watermark if shed_watermark is not None
+                     else cfg("MXNET_SERVING_SHED_WATERMARK"))
+        # 0 = "at queue capacity"; the watermark may sit below capacity so
+        # sheds start before the queue is physically full
+        self.shed_watermark = int(watermark) or self.max_queue_depth
+        self.default_timeout_ms = float(
+            default_timeout_ms if default_timeout_ms is not None
+            else cfg("MXNET_SERVING_TIMEOUT_MS"))
+        n_workers = int(num_workers if num_workers is not None
+                        else cfg("MXNET_SERVING_NUM_WORKERS"))
+        if self.max_batch_size <= 0 or n_workers <= 0:
+            raise MXNetError("serving: max_batch_size and num_workers "
+                             "must be positive")
+        self.metrics = metrics or ServingMetrics(name)
+        self._queue = collections.deque()
+        self._cond = threading.Condition()
+        self._closed = False
+        self._workers = [
+            threading.Thread(target=self._worker_loop, daemon=True,
+                             name=f"mx-serving-{name}-{i}")
+            for i in range(n_workers)]
+        for t in self._workers:
+            t.start()
+
+    # -- intake -------------------------------------------------------------
+    def submit(self, inputs, timeout_ms=None):
+        """Enqueue one request; returns its ``ServeFuture``.
+
+        Raises ``ServingOverloadError`` (shed) / ``ServingClosedError``
+        synchronously — backpressure is an admission decision, not a
+        queued outcome.
+        """
+        timeout_ms = (self.default_timeout_ms if timeout_ms is None
+                      else float(timeout_ms))
+        deadline = (time.perf_counter() + timeout_ms / 1e3
+                    if timeout_ms > 0 else None)
+        req = _Request(inputs, deadline)
+        with self._cond:
+            if self._closed:
+                self.metrics.incr("rejected_total")
+                raise ServingClosedError(self.name)
+            depth = len(self._queue)
+            if depth >= self.shed_watermark:
+                self.metrics.incr("shed_total")
+                raise ServingOverloadError(self.name, depth,
+                                           self.shed_watermark)
+            self._queue.append(req)
+            self.metrics.gauge("queue_depth", len(self._queue))
+            self._cond.notify()
+        self.metrics.incr("requests_total")
+        return req.future
+
+    # -- worker -------------------------------------------------------------
+    def _take_batch(self):
+        """Block for the first request, then coalesce up to
+        ``max_batch_size`` under the ``max_latency_ms`` deadline.
+        Returns [] only at shutdown with an empty queue."""
+        with self._cond:
+            while not self._queue and not self._closed:
+                self._cond.wait(0.05)
+            if not self._queue:
+                return []
+            batch = [self._queue.popleft()]
+            # the deadline anchors at the OLDEST member's enqueue: a
+            # request never waits for stragglers longer than the policy
+            flush_at = batch[0].t_enqueue + self.max_latency_ms / 1e3
+            while len(batch) < self.max_batch_size:
+                if self._queue:
+                    batch.append(self._queue.popleft())
+                    continue
+                remaining = flush_at - time.perf_counter()
+                if remaining <= 0 or self._closed:
+                    break
+                self._cond.wait(remaining)
+            self.metrics.gauge("queue_depth", len(self._queue))
+            return batch
+
+    def _worker_loop(self):
+        while True:
+            batch = self._take_batch()
+            if not batch:
+                return  # closed and drained
+            now = time.perf_counter()
+            live = []
+            for req in batch:
+                if req.deadline is not None and now > req.deadline:
+                    waited = (now - req.t_enqueue) * 1e3
+                    timeout = (req.deadline - req.t_enqueue) * 1e3
+                    req.future._set_exception(RequestTimeoutError(
+                        self.name, waited, timeout))
+                    self.metrics.incr("timeouts_total")
+                else:
+                    live.append(req)
+            if not live:
+                continue
+            try:
+                names = list(live[0].inputs)
+                feed = {k: np.stack([np.asarray(r.inputs[k]) for r in live])
+                        for k in names}
+                outputs = self._runner(feed, len(live))
+            except Exception as e:  # noqa: BLE001 — fanned out per request
+                exc = e if isinstance(e, MXNetError) else MXNetError(
+                    f"serving[{self.name}]: batch execution failed: "
+                    f"{type(e).__name__}: {e}")
+                for req in live:
+                    req.future._set_exception(exc)
+                self.metrics.incr("errors_total", len(live))
+                continue
+            done = time.perf_counter()
+            for i, req in enumerate(live):
+                req.future._set_result([out[i] for out in outputs])
+                self.metrics.observe_latency((done - req.t_enqueue) * 1e3)
+            self.metrics.incr("responses_total", len(live))
+
+    # -- lifecycle ----------------------------------------------------------
+    def close(self, drain=True, timeout=30.0):
+        """Stop intake; drain (default) or fail what is queued; join
+        workers.  Idempotent."""
+        with self._cond:
+            already = self._closed
+            self._closed = True
+            if not drain:
+                while self._queue:
+                    req = self._queue.popleft()
+                    req.future._set_exception(ServingClosedError(self.name))
+                    self.metrics.incr("rejected_total")
+                self.metrics.gauge("queue_depth", 0)
+            self._cond.notify_all()
+        if already:
+            return
+        for t in self._workers:
+            t.join(timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

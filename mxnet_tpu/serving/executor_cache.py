@@ -1,0 +1,188 @@
+"""Compiled-executor cache with power-of-two shape bucketing.
+
+An inference ``Executor`` is expensive to create: binding traces the
+graph and the first ``forward`` compiles one XLA program per input
+signature.  The serving layer therefore never binds per request — it
+buckets the batch dimension up to the next power of two (so a Zipf of
+request sizes collapses onto log2(max_batch) programs), pads the inputs
+to the bucket, reuses one bound executor per (model, version, bucketed
+signature) through an LRU, and slices the padding back off the outputs.
+
+The cache is shared machinery: ``ModelServer`` keys it by repository
+(model, version), ``c_predict.Predictor`` keys it by content hash of the
+symbol JSON + param bytes, so a host that creates a fresh Predictor per
+request (the reference deployment shape) stops paying a rebind each
+time.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+
+import numpy as np
+
+from ..base import MXNetError
+from ..context import current_context
+from .metrics import ServingMetrics
+
+# process-wide cache metrics (hits/misses/evictions across every cache)
+_CACHE_METRICS = ServingMetrics("executor_cache")
+
+
+def bucket_batch(n, max_batch=None):
+    """Next power of two >= n, optionally capped at ``max_batch``.
+
+    The cap wins even when it is not itself a power of two — the batcher
+    never forms batches above ``max_batch``, so that one extra signature
+    is the largest program ever compiled.
+    """
+    n = int(n)
+    if n <= 0:
+        raise MXNetError(f"bucket_batch: batch must be positive, got {n}")
+    b = 1
+    while b < n:
+        b <<= 1
+    if max_batch is not None and b > int(max_batch):
+        if n > int(max_batch):
+            raise MXNetError(
+                f"bucket_batch: batch {n} exceeds max_batch {max_batch}")
+        b = int(max_batch)
+    return b
+
+
+def shape_signature(input_shapes):
+    """Canonical hashable signature for a dict of input shapes."""
+    return tuple(sorted((str(k), tuple(int(d) for d in v))
+                        for k, v in input_shapes.items()))
+
+
+def bind_inference_executor(symbol, params, input_shapes, ctx=None):
+    """Bind ``symbol`` for inference: inputs get fresh zero buffers at
+    ``input_shapes``, every other argument / aux state comes from
+    ``params`` (one flat name->NDArray dict).  grad_req='null' — the
+    shared contract of c_predict.Predictor and the serving runner."""
+    from .. import ndarray as nd
+    ctx = ctx or current_context()
+    aux_names = set(symbol.list_auxiliary_states())
+    args = {}
+    for name in symbol.list_arguments():
+        if name in input_shapes:
+            args[name] = nd.zeros(tuple(int(d) for d in input_shapes[name]))
+        elif name in params:
+            args[name] = params[name]
+        else:
+            raise MXNetError(
+                f"serving: argument {name!r} has neither a bound input "
+                "shape nor a loaded parameter")
+    aux = {name: params[name] for name in aux_names if name in params}
+    return symbol.bind(ctx, args, grad_req="null", aux_states=aux)
+
+
+class CachedExecutor:
+    """A bound executor plus the lock serializing its users (the bound
+    input buffers are shared mutable state)."""
+
+    __slots__ = ("executor", "lock", "key")
+
+    def __init__(self, executor, key):
+        self.executor = executor
+        self.lock = threading.Lock()
+        self.key = key
+
+    def run_padded(self, feed, n_real):
+        """Write ``feed`` (already padded to the bound batch) into the
+        input buffers, forward, and return outputs sliced to ``n_real``
+        host arrays."""
+        with self.lock:
+            ex = self.executor
+            for name, arr in feed.items():
+                ex.arg_dict[name][:] = arr
+            outs = ex.forward(is_train=False)
+            return [np.asarray(o.asnumpy())[:n_real] for o in outs]
+
+
+class ExecutorCache:
+    """LRU of ``CachedExecutor`` keyed by (model-identity, signature)."""
+
+    def __init__(self, capacity=None):
+        if capacity is None:
+            from .. import config as _config
+            capacity = _config.get("MXNET_SERVING_EXECUTOR_CACHE")
+        self.capacity = max(1, int(capacity))
+        self._lock = threading.Lock()
+        self._entries = collections.OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def get(self, key, builder):
+        """Return the cached executor for ``key``, building (and possibly
+        evicting LRU) on miss.  ``builder()`` -> bound Executor.
+
+        The build runs under the cache lock on purpose: concurrent
+        misses on one key must not compile the same program twice, and
+        an inference bind is cheap relative to the XLA compile its first
+        forward triggers anyway.
+        """
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                _CACHE_METRICS.incr("cache_hits_total")
+                return entry
+            self.misses += 1
+            _CACHE_METRICS.incr("cache_misses_total")
+            entry = CachedExecutor(builder(), key)
+            self._entries[key] = entry
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+                self.evictions += 1
+                _CACHE_METRICS.incr("cache_evictions_total")
+            return entry
+
+    def evict_model(self, model_prefix):
+        """Drop every entry whose key starts with ``model_prefix`` (used
+        when a repository version is unloaded)."""
+        with self._lock:
+            doomed = [k for k in self._entries
+                      if k[:len(model_prefix)] == model_prefix]
+            for k in doomed:
+                del self._entries[k]
+            return len(doomed)
+
+    def __len__(self):
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self):
+        with self._lock:
+            return {"size": len(self._entries), "capacity": self.capacity,
+                    "hits": self.hits, "misses": self.misses,
+                    "evictions": self.evictions}
+
+
+def pad_to(arr, n_rows):
+    """Zero-pad ``arr`` (host array, batch-leading) to ``n_rows``."""
+    arr = np.asarray(arr)
+    if arr.shape[0] == n_rows:
+        return arr
+    if arr.shape[0] > n_rows:
+        raise MXNetError(
+            f"pad_to: array batch {arr.shape[0]} exceeds target {n_rows}")
+    pad = np.zeros((n_rows - arr.shape[0],) + arr.shape[1:], arr.dtype)
+    return np.concatenate([arr, pad], axis=0)
+
+
+# the process-wide cache c_predict.Predictor binds through; sized by the
+# same config knob as per-server caches
+_SHARED = None
+_SHARED_LOCK = threading.Lock()
+
+
+def shared_cache():
+    global _SHARED
+    with _SHARED_LOCK:
+        if _SHARED is None:
+            _SHARED = ExecutorCache()
+        return _SHARED

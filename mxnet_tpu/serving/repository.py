@@ -1,0 +1,166 @@
+"""ModelRepository: versioned model storage behind the server.
+
+A repository maps ``name -> {version -> _ModelVersion}``.  Versions are
+monotonically increasing integers; ``get(name)`` returns the latest, so
+loading a new version is a hot reload — in-flight batches finish on the
+version they resolved, the next batch picks up the new one (the serving
+runner resolves the version per batch, never per process).
+
+Three load sources, all normalized to (Symbol, flat name->NDArray
+params, input names):
+
+* ``prefix``      — ``{prefix}-symbol.json`` + ``{prefix}-{epoch:04d}.params``
+                    checkpoint pairs as written by ``HybridBlock.export`` /
+                    ``Module.save_checkpoint``;
+* ``symbol`` + ``params`` — an in-memory Symbol (or its JSON) plus a
+                    param dict or raw ``.params`` bytes;
+* ``block``       — a gluon (Hybrid)Block, traced to a Symbol graph and
+                    its ``collect_params()`` snapshot.
+"""
+from __future__ import annotations
+
+import threading
+
+from ..base import MXNetError
+
+
+def _strip_prefixes(param_dict):
+    """arg:/aux: save-format prefixes -> flat names."""
+    return {k.split(":", 1)[-1]: v for k, v in param_dict.items()}
+
+
+class _ModelVersion:
+    __slots__ = ("symbol", "params", "input_names", "version")
+
+    def __init__(self, symbol, params, input_names, version):
+        self.symbol = symbol
+        self.params = params
+        self.input_names = input_names
+        self.version = version
+
+
+def _normalize(symbol=None, params=None, prefix=None, block=None, epoch=0):
+    from .. import ndarray as nd
+    from ..symbol import load_json
+    from ..symbol.symbol import Symbol
+
+    if sum(x is not None for x in (symbol, prefix, block)) != 1:
+        raise MXNetError(
+            "repository.load: pass exactly one of symbol=, prefix=, block=")
+
+    if prefix is not None:
+        with open(f"{prefix}-symbol.json") as f:
+            symbol = load_json(f.read())
+        params = _strip_prefixes(nd.load(f"{prefix}-{epoch:04d}.params"))
+    elif block is not None:
+        # trace the block to a Symbol graph (same path as export, minus
+        # the filesystem round trip)
+        if not getattr(block, "_cached_graph", None):
+            block._build_sym_graph()
+        _, symbol = block._cached_graph
+        params = {name: p._reduce()
+                  for name, p in block.collect_params().items()}
+    else:
+        if isinstance(symbol, str):
+            symbol = load_json(symbol)
+        if not isinstance(symbol, Symbol):
+            raise MXNetError(
+                f"repository.load: symbol must be a Symbol or its JSON, "
+                f"got {type(symbol).__name__}")
+        if isinstance(params, (bytes, bytearray)):
+            from ..c_predict import _load_params_bytes
+            params = _load_params_bytes(bytes(params))
+        elif isinstance(params, dict):
+            params = _strip_prefixes(params)
+        else:
+            raise MXNetError(
+                "repository.load: params must be a dict or .params bytes "
+                "when loading from a symbol")
+
+    bound = set(params)
+    input_names = [n for n in symbol.list_arguments() if n not in bound]
+    if not input_names:
+        raise MXNetError(
+            "repository.load: every argument is covered by params — the "
+            "model has no free inputs to serve")
+    return symbol, params, input_names
+
+
+class ModelRepository:
+    """Thread-safe versioned model store (multi-model endpoints)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._models = {}   # name -> {version -> _ModelVersion}
+        self._latest = {}   # name -> int
+
+    def load(self, name, symbol=None, params=None, prefix=None, block=None,
+             epoch=0, version=None):
+        """Register a model version; returns the version number.  Loading
+        an existing name again with no explicit version is a hot reload
+        (latest+1)."""
+        symbol, params, input_names = _normalize(
+            symbol=symbol, params=params, prefix=prefix, block=block,
+            epoch=epoch)
+        with self._lock:
+            versions = self._models.setdefault(name, {})
+            if version is None:
+                version = self._latest.get(name, 0) + 1
+            version = int(version)
+            if version in versions:
+                raise MXNetError(
+                    f"repository: model {name!r} version {version} already "
+                    "loaded (unload it first, or omit version= for "
+                    "hot reload)")
+            versions[version] = _ModelVersion(symbol, params, input_names,
+                                              version)
+            self._latest[name] = max(self._latest.get(name, 0), version)
+            return version
+
+    def get(self, name, version=None):
+        """The requested (or latest) ``_ModelVersion``."""
+        with self._lock:
+            versions = self._models.get(name)
+            if not versions:
+                raise MXNetError(f"repository: unknown model {name!r}; "
+                                 f"loaded: {sorted(self._models)}")
+            if version is None:
+                version = self._latest[name]
+            mv = versions.get(int(version))
+            if mv is None:
+                raise MXNetError(
+                    f"repository: model {name!r} has no version {version}; "
+                    f"available: {sorted(versions)}")
+            return mv
+
+    def unload(self, name, version=None):
+        """Drop one version (or the whole model when version is None)."""
+        with self._lock:
+            versions = self._models.get(name)
+            if not versions:
+                raise MXNetError(f"repository: unknown model {name!r}")
+            if version is None:
+                del self._models[name]
+                del self._latest[name]
+                return
+            version = int(version)
+            if version not in versions:
+                raise MXNetError(
+                    f"repository: model {name!r} has no version {version}")
+            del versions[version]
+            if not versions:
+                del self._models[name]
+                del self._latest[name]
+            elif self._latest[name] == version:
+                self._latest[name] = max(versions)
+
+    def models(self):
+        """{name: sorted list of loaded versions}."""
+        with self._lock:
+            return {n: sorted(v) for n, v in self._models.items()}
+
+    def latest_version(self, name):
+        with self._lock:
+            if name not in self._latest:
+                raise MXNetError(f"repository: unknown model {name!r}")
+            return self._latest[name]

@@ -1,0 +1,140 @@
+"""ModelServer: repository + executor cache + per-model dynamic batchers.
+
+The in-process serving front end:
+
+    server = mx.serving.ModelServer()
+    server.load("mlp", block=net)              # or prefix= / symbol=+params=
+    out = server.predict("mlp", {"data": x})   # x: one sample, no batch dim
+    fut = server.predict_async("mlp", {"data": x})
+    server.stats()                             # metrics snapshot
+    server.shutdown()                          # graceful drain
+
+Execution path per batch (one per worker pass, see batcher.py): resolve
+the LATEST model version from the repository (this is what makes
+``load`` a hot reload), bucket the batch to the next power of two, fetch
+the bound executor from the LRU cache — (model, version, signature) key,
+compile only on first use — pad, forward, unpad, fan results back out to
+the request futures.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from ..base import MXNetError
+from ..context import current_context
+from .batcher import DynamicBatcher
+from .executor_cache import (ExecutorCache, bind_inference_executor,
+                             bucket_batch, pad_to, shape_signature)
+from .metrics import ServingMetrics
+from .repository import ModelRepository
+
+
+class ModelServer:
+    """Multi-model in-process inference server."""
+
+    def __init__(self, repository=None, ctx=None, max_batch_size=None,
+                 max_latency_ms=None, num_workers=None, max_queue_depth=None,
+                 shed_watermark=None, default_timeout_ms=None,
+                 cache_capacity=None, name="server"):
+        self.name = name
+        self.repository = repository or ModelRepository()
+        self._ctx = ctx or current_context()
+        self._cache = ExecutorCache(cache_capacity)
+        self.metrics = ServingMetrics(name)
+        self._batcher_kw = dict(
+            max_batch_size=max_batch_size, max_latency_ms=max_latency_ms,
+            num_workers=num_workers, max_queue_depth=max_queue_depth,
+            shed_watermark=shed_watermark,
+            default_timeout_ms=default_timeout_ms)
+        self._batchers = {}
+        self._lock = threading.Lock()
+        self._shutdown = False
+
+    # -- model management ---------------------------------------------------
+    def load(self, name, **kwargs):
+        """Load (or hot-reload) a model; see ModelRepository.load."""
+        return self.repository.load(name, **kwargs)
+
+    def unload(self, name, version=None):
+        self.repository.unload(name, version=version)
+        self._cache.evict_model((name,) if version is None
+                                else (name, int(version)))
+
+    # -- the per-batch execution path ---------------------------------------
+    def _runner_for(self, model):
+        def run(feed, n_real):
+            # latest-version resolution happens HERE, per batch: traffic
+            # in flight during a hot reload finishes on the old version,
+            # the next batch serves the new one
+            mv = self.repository.get(model)
+            missing = [n for n in mv.input_names if n not in feed]
+            if missing:
+                raise MXNetError(
+                    f"serving[{model}]: request is missing inputs "
+                    f"{missing} (expects {mv.input_names})")
+            bucket = bucket_batch(
+                n_real, self._batchers[model].max_batch_size)
+            padded = {k: pad_to(np.asarray(v, np.float32), bucket)
+                      for k, v in feed.items()}
+            sig = shape_signature({k: v.shape for k, v in padded.items()})
+            entry = self._cache.get(
+                (model, mv.version, sig),
+                lambda: bind_inference_executor(
+                    mv.symbol, mv.params,
+                    {k: v.shape for k, v in padded.items()}, self._ctx))
+            outs = entry.run_padded(padded, n_real)
+            self.metrics.observe_batch(n_real, bucket)
+            return outs
+        return run
+
+    def _get_batcher(self, model):
+        with self._lock:
+            if self._shutdown:
+                from .batcher import ServingClosedError
+                raise ServingClosedError(self.name)
+            b = self._batchers.get(model)
+            if b is None:
+                # metrics are shared server-wide; per-model split lives in
+                # the (model, …) executor-cache keys and batcher names
+                b = DynamicBatcher(
+                    self._runner_for(model), name=f"{self.name}/{model}",
+                    metrics=self.metrics, **self._batcher_kw)
+                self._batchers[model] = b
+            return b
+
+    # -- request API --------------------------------------------------------
+    def predict_async(self, model, inputs, timeout_ms=None):
+        """Submit one request (single sample, batch dim added by the
+        batcher); returns a ServeFuture of the output list."""
+        self.repository.get(model)  # unknown-model errors surface here
+        return self._get_batcher(model).submit(dict(inputs),
+                                               timeout_ms=timeout_ms)
+
+    def predict(self, model, inputs, timeout_ms=None, wait_s=60.0):
+        """Blocking convenience over predict_async."""
+        return self.predict_async(model, inputs,
+                                  timeout_ms=timeout_ms).result(wait_s)
+
+    # -- observability / lifecycle ------------------------------------------
+    def stats(self):
+        snap = self.metrics.snapshot()
+        snap["executor_cache"] = self._cache.stats()
+        snap["models"] = self.repository.models()
+        return snap
+
+    def shutdown(self, drain=True, timeout=30.0):
+        """Stop intake on every batcher; drain in-flight work (default)
+        or fail it fast; idempotent."""
+        with self._lock:
+            self._shutdown = True
+            batchers = list(self._batchers.values())
+        for b in batchers:
+            b.close(drain=drain, timeout=timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()

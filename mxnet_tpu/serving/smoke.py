@@ -1,0 +1,101 @@
+"""Serving smoke: 64 concurrent clients against a live ModelServer.
+
+CI entry point (``python -m mxnet_tpu.serving.smoke``): spin up a
+ModelServer on the virtual 8-device CPU mesh, fire 64 concurrent
+requests through a deliberately small queue so SOME of them shed, and
+assert the robustness contract: every request is either answered with a
+numerically correct output or fails fast with a structured MXNetError —
+nothing hangs, nothing crashes the server.  Prints one JSON summary
+line; exit code 0 iff the contract held.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+
+import numpy as np
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+N_CLIENTS = 64
+IN_DIM = 16
+
+
+def main():
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu import serving
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(32, activation="relu"), gluon.nn.Dense(4))
+    net.initialize()
+    xs = np.random.RandomState(0).randn(N_CLIENTS, IN_DIM).astype(np.float32)
+    ref = net(mx.nd.array(xs)).asnumpy()
+
+    server = serving.ModelServer(max_batch_size=8, max_latency_ms=4.0,
+                                 max_queue_depth=16, name="smoke")
+    server.load("mlp", block=net)
+    # prime the hot bucket so concurrent clients race a warm server, not
+    # one giant first-call XLA compile
+    server.predict("mlp", {"data": xs[0]})
+
+    results = [None] * N_CLIENTS  # ("ok", out) | ("shed", e) | ("bad", why)
+    barrier = threading.Barrier(N_CLIENTS)
+
+    def client(i):
+        barrier.wait()
+        try:
+            out = server.predict("mlp", {"data": xs[i]}, wait_s=60.0)
+            results[i] = ("ok", out[0])
+        except MXNetError as e:
+            results[i] = ("shed", e)
+        except Exception as e:  # noqa: BLE001 — contract violation
+            results[i] = ("bad", f"{type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(N_CLIENTS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+
+    ok = shed = 0
+    failures = []
+    for i, r in enumerate(results):
+        if r is None:
+            failures.append(f"client {i}: hung (no result)")
+        elif r[0] == "ok":
+            if not np.allclose(r[1], ref[i], atol=1e-5):
+                failures.append(f"client {i}: wrong answer")
+            else:
+                ok += 1
+        elif r[0] == "shed":
+            shed += 1
+        else:
+            failures.append(f"client {i}: unstructured failure: {r[1]}")
+
+    server.shutdown()
+    snap = server.stats()
+    if ok == 0:
+        failures.append("no request was answered at all")
+    summary = {
+        "smoke": "serving", "clients": N_CLIENTS, "answered": ok,
+        "shed": shed, "failures": failures,
+        "throughput_rps": snap.get("throughput_rps"),
+        "p99_ms": snap.get("latency_ms", {}).get("p99"),
+        "batch_occupancy": snap.get("batch_occupancy"),
+        "executor_cache": snap.get("executor_cache"),
+    }
+    print(json.dumps(summary), flush=True)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
