@@ -67,18 +67,33 @@ class Predictor:
 
         self._cached = shared_cache().get(key, _bind)
         self._exec = self._cached.executor
+        # the cached executor (and its input/output buffers) is shared
+        # with every other live Predictor of the same model+shapes, so
+        # inputs are staged per-Predictor here and only written under
+        # the executor lock in forward(); zeros mirror the freshly-bound
+        # buffer contents for inputs the caller never sets
+        self._staged = {k: np.zeros(v, np.float32)
+                        for k, v in self._input_shapes.items()}
         self._outputs = None
 
     def set_input(self, key, raw):
         if key not in self._input_shapes:
             raise MXNetError(f"predictor: unknown input {key!r}")
         shape = self._input_shapes[key]
-        arr = np.frombuffer(raw, np.float32).reshape(shape)
-        self._exec.arg_dict[key][:] = arr
+        self._staged[key] = np.frombuffer(raw, np.float32).reshape(shape) \
+            .copy()  # snapshot: the caller may recycle its buffer
         return True
 
     def forward(self):
-        self._outputs = self._exec.forward(is_train=False)
+        # write-inputs -> forward -> copy-outputs is one atomic critical
+        # section: interleaved Predictors sharing this executor must not
+        # clobber each other's inputs or read each other's outputs
+        with self._cached.lock:
+            ex = self._exec
+            for key, arr in self._staged.items():
+                ex.arg_dict[key][:] = arr
+            outs = ex.forward(is_train=False)
+            self._outputs = [np.asarray(o.asnumpy()) for o in outs]
         return True
 
     def output_shape(self, index):
@@ -92,5 +107,5 @@ class Predictor:
     def output_bytes(self, index):
         if self._outputs is None:
             raise MXNetError("forward() has not run")
-        out = self._outputs[int(index)].asnumpy().astype(np.float32)
+        out = self._outputs[int(index)].astype(np.float32)
         return np.ascontiguousarray(out).tobytes()

@@ -475,6 +475,8 @@ class Module(BaseModule):
                 n, bound = pad
                 self._forward_pad = bound - n
                 self._pad_bound = bound
+                self._pad_batch_outputs = self._infer_batch_outputs(
+                    feed, n, bound)
                 for name, arr in feed.items():
                     host = arr.asnumpy()
                     host = np.concatenate(
@@ -511,6 +513,46 @@ class Module(BaseModule):
             return None
         n, bound = ns.pop(), bounds.pop()
         return (n, bound) if 0 < n < bound else None
+
+    def _infer_batch_outputs(self, feed, n, bound):
+        """Which output indices actually carry the padded batch dim —
+        exact, by inferring output shapes at batch ``n`` vs ``bound``
+        (every non-feed argument keeps its bound shape): only outputs
+        whose leading dim tracks the batch get pad-sliced.  Returns
+        None when inference cannot decide (get_outputs then falls back
+        to the leading-dim heuristic)."""
+        cache = getattr(self, "_batch_out_cache", None)
+        if cache is None:
+            cache = self._batch_out_cache = {}
+        key = (n, bound)
+        if key not in cache:
+            try:
+                fixed = {name: tuple(a.shape)
+                         for name, a in self._exec.arg_dict.items()
+                         if name not in feed}
+                fixed.update({name: tuple(a.shape) for name, a
+                              in getattr(self._exec, "aux_dict",
+                                         {}).items()})
+
+                def outs_at(b):
+                    shapes = dict(fixed)
+                    shapes.update({name: (b,) + tuple(arr.shape[1:])
+                                   for name, arr in feed.items()})
+                    _, outs, _ = self.symbol.infer_shape_partial(**shapes)
+                    return outs
+
+                outs_n, outs_b = outs_at(n), outs_at(bound)
+                if (len(outs_n) == len(outs_b)
+                        and all(s is not None for s in outs_n)
+                        and all(s is not None for s in outs_b)):
+                    cache[key] = frozenset(
+                        i for i, (sn, sb) in enumerate(zip(outs_n, outs_b))
+                        if sn and sb and sn[0] == n and sb[0] == bound)
+                else:
+                    cache[key] = None
+            except Exception:  # noqa: BLE001 — fall back to heuristic
+                cache[key] = None
+        return cache[key]
 
     def backward(self, out_grads=None):
         """Backward (parity: module.py backward)."""
@@ -550,9 +592,18 @@ class Module(BaseModule):
             # slice off the zero-padding rows added by the partial-batch
             # predict path (only outputs carrying the padded batch dim)
             bound = self._pad_bound
-            outs = [o.slice_axis(0, 0, bound - pad)
-                    if len(o.shape) >= 1 and o.shape[0] == bound else o
-                    for o in outs]
+            batch_outs = getattr(self, "_pad_batch_outputs", None)
+            if batch_outs is not None:
+                # exact membership from shape inference at both batch
+                # sizes (_infer_batch_outputs)
+                outs = [o.slice_axis(0, 0, bound - pad)
+                        if i in batch_outs else o
+                        for i, o in enumerate(outs)]
+            else:
+                # inference couldn't decide: leading-dim heuristic
+                outs = [o.slice_axis(0, 0, bound - pad)
+                        if len(o.shape) >= 1 and o.shape[0] == bound else o
+                        for o in outs]
         return outs
 
     def get_input_grads(self, merge_multi_context=True):

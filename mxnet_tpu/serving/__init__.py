@@ -12,8 +12,9 @@ through the profiler counter lanes.  See docs/serving.md.
 from .batcher import (DynamicBatcher, RequestTimeoutError, ServeFuture,
                       ServingClosedError, ServingOverloadError)
 from .executor_cache import (CachedExecutor, ExecutorCache,
-                             bind_inference_executor, bucket_batch, pad_to,
-                             shape_signature, shared_cache)
+                             bind_inference_executor, bucket_batch,
+                             feed_signature, pad_to, shape_signature,
+                             shared_cache)
 from .metrics import ServingMetrics, stats
 from .repository import ModelRepository
 from .server import ModelServer
@@ -22,5 +23,6 @@ __all__ = [
     "CachedExecutor", "DynamicBatcher", "ExecutorCache", "ModelRepository",
     "ModelServer", "RequestTimeoutError", "ServeFuture", "ServingClosedError",
     "ServingMetrics", "ServingOverloadError", "bind_inference_executor",
-    "bucket_batch", "pad_to", "shape_signature", "shared_cache", "stats",
+    "bucket_batch", "feed_signature", "pad_to", "shape_signature",
+    "shared_cache", "stats",
 ]

@@ -17,6 +17,12 @@ Robustness contract:
   fails fast with ``ServingOverloadError`` (an ``MXNetError`` carrying
   ``queue_depth``/``watermark``/``batcher`` fields) instead of letting
   latency grow without bound;
+* malformed requests fail ALONE: ``submit`` normalizes inputs to host
+  arrays, runs the optional ``validator`` (rejecting synchronously with
+  a structured error), and workers group requests by input signature
+  (names + per-sample shapes + dtypes) so a request that could not
+  stack with its neighbours executes in its own cohort instead of
+  poisoning the whole micro-batch;
 * per-request timeouts: a request whose deadline expires while queued
   is failed with ``RequestTimeoutError`` without wasting a batch slot;
 * ``close(drain=True)`` stops intake, lets workers drain everything
@@ -101,10 +107,11 @@ class ServeFuture:
 
 
 class _Request:
-    __slots__ = ("inputs", "future", "t_enqueue", "deadline")
+    __slots__ = ("inputs", "sig", "future", "t_enqueue", "deadline")
 
-    def __init__(self, inputs, deadline):
+    def __init__(self, inputs, sig, deadline):
         self.inputs = inputs
+        self.sig = sig
         self.future = ServeFuture()
         self.t_enqueue = time.perf_counter()
         self.deadline = deadline
@@ -122,11 +129,16 @@ class DynamicBatcher:
 
     def __init__(self, runner, max_batch_size=None, max_latency_ms=None,
                  num_workers=None, max_queue_depth=None, shed_watermark=None,
-                 default_timeout_ms=None, name="batcher", metrics=None):
+                 default_timeout_ms=None, name="batcher", metrics=None,
+                 validator=None):
         from .. import config as _config
         cfg = _config.get
         self.name = name
         self._runner = runner
+        # validator(inputs) runs at submit time with the normalized host
+        # arrays; raising rejects THAT request synchronously before it
+        # can join (and poison) a batch
+        self._validator = validator
         self.max_batch_size = int(max_batch_size
                                   if max_batch_size is not None
                                   else cfg("MXNET_SERVING_MAX_BATCH"))
@@ -166,13 +178,29 @@ class DynamicBatcher:
 
         Raises ``ServingOverloadError`` (shed) / ``ServingClosedError``
         synchronously — backpressure is an admission decision, not a
-        queued outcome.
+        queued outcome.  A malformed request (per the ``validator``, or
+        inputs that cannot become host arrays) is likewise rejected
+        here, individually, with a structured ``MXNetError``.
         """
+        try:
+            inputs = {k: np.asarray(v) for k, v in inputs.items()}
+            if self._validator is not None:
+                self._validator(inputs)
+        except MXNetError:
+            self.metrics.incr("invalid_total")
+            raise
+        except Exception as e:  # noqa: BLE001 — normalized to structured
+            self.metrics.incr("invalid_total")
+            raise MXNetError(
+                f"serving[{self.name}]: invalid request: "
+                f"{type(e).__name__}: {e}") from e
+        sig = tuple(sorted((k, v.shape, v.dtype.str)
+                           for k, v in inputs.items()))
         timeout_ms = (self.default_timeout_ms if timeout_ms is None
                       else float(timeout_ms))
         deadline = (time.perf_counter() + timeout_ms / 1e3
                     if timeout_ms > 0 else None)
-        req = _Request(inputs, deadline)
+        req = _Request(inputs, sig, deadline)
         with self._cond:
             if self._closed:
                 self.metrics.incr("rejected_total")
@@ -231,24 +259,32 @@ class DynamicBatcher:
                     live.append(req)
             if not live:
                 continue
-            try:
-                names = list(live[0].inputs)
-                feed = {k: np.stack([np.asarray(r.inputs[k]) for r in live])
-                        for k in names}
-                outputs = self._runner(feed, len(live))
-            except Exception as e:  # noqa: BLE001 — fanned out per request
-                exc = e if isinstance(e, MXNetError) else MXNetError(
-                    f"serving[{self.name}]: batch execution failed: "
-                    f"{type(e).__name__}: {e}")
-                for req in live:
-                    req.future._set_exception(exc)
-                self.metrics.incr("errors_total", len(live))
-                continue
-            done = time.perf_counter()
-            for i, req in enumerate(live):
-                req.future._set_result([out[i] for out in outputs])
-                self.metrics.observe_latency((done - req.t_enqueue) * 1e3)
-            self.metrics.incr("responses_total", len(live))
+            # cohorts: requests only share a runner call with requests
+            # of the SAME input signature, so a mismatched/malformed
+            # request fails alone instead of poisoning its neighbours
+            cohorts = collections.OrderedDict()
+            for req in live:
+                cohorts.setdefault(req.sig, []).append(req)
+            for cohort in cohorts.values():
+                try:
+                    names = list(cohort[0].inputs)
+                    feed = {k: np.stack([r.inputs[k] for r in cohort])
+                            for k in names}
+                    outputs = self._runner(feed, len(cohort))
+                except Exception as e:  # noqa: BLE001 — fanned out per req
+                    exc = e if isinstance(e, MXNetError) else MXNetError(
+                        f"serving[{self.name}]: batch execution failed: "
+                        f"{type(e).__name__}: {e}")
+                    for req in cohort:
+                        req.future._set_exception(exc)
+                    self.metrics.incr("errors_total", len(cohort))
+                    continue
+                done = time.perf_counter()
+                for i, req in enumerate(cohort):
+                    req.future._set_result([out[i] for out in outputs])
+                    self.metrics.observe_latency(
+                        (done - req.t_enqueue) * 1e3)
+                self.metrics.incr("responses_total", len(cohort))
 
     # -- lifecycle ----------------------------------------------------------
     def close(self, drain=True, timeout=30.0):

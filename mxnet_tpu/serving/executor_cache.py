@@ -56,18 +56,30 @@ def shape_signature(input_shapes):
                         for k, v in input_shapes.items()))
 
 
-def bind_inference_executor(symbol, params, input_shapes, ctx=None):
+def feed_signature(feed):
+    """Canonical hashable signature for a dict of host arrays — shapes
+    AND dtypes, so an int32 feed never reuses a float32-bound program."""
+    return tuple(sorted((str(k), tuple(int(d) for d in v.shape),
+                         str(v.dtype))
+                        for k, v in feed.items()))
+
+
+def bind_inference_executor(symbol, params, input_shapes, ctx=None,
+                            input_dtypes=None):
     """Bind ``symbol`` for inference: inputs get fresh zero buffers at
-    ``input_shapes``, every other argument / aux state comes from
-    ``params`` (one flat name->NDArray dict).  grad_req='null' — the
-    shared contract of c_predict.Predictor and the serving runner."""
+    ``input_shapes`` (dtype per ``input_dtypes``, default float32),
+    every other argument / aux state comes from ``params`` (one flat
+    name->NDArray dict).  grad_req='null' — the shared contract of
+    c_predict.Predictor and the serving runner."""
     from .. import ndarray as nd
     ctx = ctx or current_context()
     aux_names = set(symbol.list_auxiliary_states())
+    input_dtypes = input_dtypes or {}
     args = {}
     for name in symbol.list_arguments():
         if name in input_shapes:
-            args[name] = nd.zeros(tuple(int(d) for d in input_shapes[name]))
+            args[name] = nd.zeros(tuple(int(d) for d in input_shapes[name]),
+                                  dtype=input_dtypes.get(name))
         elif name in params:
             args[name] = params[name]
         else:

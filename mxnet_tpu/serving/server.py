@@ -26,7 +26,7 @@ from ..base import MXNetError
 from ..context import current_context
 from .batcher import DynamicBatcher
 from .executor_cache import (ExecutorCache, bind_inference_executor,
-                             bucket_batch, pad_to, shape_signature)
+                             bucket_batch, feed_signature, pad_to)
 from .metrics import ServingMetrics
 from .repository import ModelRepository
 
@@ -76,18 +76,61 @@ class ModelServer:
                     f"{missing} (expects {mv.input_names})")
             bucket = bucket_batch(
                 n_real, self._batchers[model].max_batch_size)
-            padded = {k: pad_to(np.asarray(v, np.float32), bucket)
+            # request dtypes are preserved end to end (int token ids /
+            # indices / masks must NOT be silently cast to float32);
+            # the executor binds its input buffers with the same dtypes
+            padded = {k: pad_to(np.asarray(v), bucket)
                       for k, v in feed.items()}
-            sig = shape_signature({k: v.shape for k, v in padded.items()})
+            sig = feed_signature(padded)
             entry = self._cache.get(
                 (model, mv.version, sig),
                 lambda: bind_inference_executor(
                     mv.symbol, mv.params,
-                    {k: v.shape for k, v in padded.items()}, self._ctx))
+                    {k: v.shape for k, v in padded.items()}, self._ctx,
+                    input_dtypes={k: v.dtype for k, v in padded.items()}))
             outs = entry.run_padded(padded, n_real)
             self.metrics.observe_batch(n_real, bucket)
             return outs
         return run
+
+    def _validator_for(self, model):
+        """Submit-time request validation: key-set check against the
+        model's input names, then per-sample shape/dtype validation by
+        graph inference (param shapes/dtypes are known exactly), cached
+        per (version, signature).  Raising here rejects ONE request
+        synchronously — it never reaches (or poisons) a batch."""
+        valid_sigs = {}
+
+        def validate(inputs):
+            mv = self.repository.get(model)
+            missing = [n for n in mv.input_names if n not in inputs]
+            extra = [k for k in inputs if k not in mv.input_names]
+            if missing or extra:
+                raise MXNetError(
+                    f"serving[{model}]: request inputs {sorted(inputs)} "
+                    f"do not match model inputs {mv.input_names}"
+                    + (f" — missing {missing}" if missing else "")
+                    + (f" — unexpected {extra}" if extra else ""))
+            sig = tuple(sorted((k, v.shape, v.dtype.str)
+                               for k, v in inputs.items()))
+            key = (mv.version, sig)
+            if key in valid_sigs:
+                return
+            shapes = {k: tuple(p.shape) for k, p in mv.params.items()}
+            shapes.update({k: (1,) + tuple(v.shape)
+                           for k, v in inputs.items()})
+            dtypes = {k: p.dtype for k, p in mv.params.items()}
+            dtypes.update({k: v.dtype for k, v in inputs.items()})
+            try:
+                mv.symbol.infer_shape(**shapes)
+                mv.symbol.infer_type(**dtypes)
+            except Exception as e:  # noqa: BLE001 — structured per-request
+                raise MXNetError(
+                    f"serving[{model}]: request rejected — sample "
+                    f"shapes/dtypes are incompatible with the model: "
+                    f"{e}") from e
+            valid_sigs[key] = True
+        return validate
 
     def _get_batcher(self, model):
         with self._lock:
@@ -100,7 +143,9 @@ class ModelServer:
                 # the (model, …) executor-cache keys and batcher names
                 b = DynamicBatcher(
                     self._runner_for(model), name=f"{self.name}/{model}",
-                    metrics=self.metrics, **self._batcher_kw)
+                    metrics=self.metrics,
+                    validator=self._validator_for(model),
+                    **self._batcher_kw)
                 self._batchers[model] = b
             return b
 

@@ -312,6 +312,133 @@ def test_predictor_routes_through_executor_cache(tmp_path):
         np.testing.assert_allclose(o, ref, rtol=1e-5, atol=1e-6)
 
 
+def test_concurrent_predictors_do_not_clobber_shared_executor(tmp_path):
+    """Two live Predictors share one CachedExecutor; interleaved and
+    concurrent set_input/forward/output_bytes must stay isolated."""
+    from mxnet_tpu.c_predict import Predictor
+    net = _mlp(hidden=13, out=6)
+    xs = np.random.randn(8, 1, 4).astype(np.float32)
+    ref = [net(mx.nd.array(x)).asnumpy() for x in xs]
+    prefix = str(tmp_path / "mlp")
+    net.export(prefix)
+    sym_json = open(prefix + "-symbol.json").read()
+    params = open(prefix + "-0000.params", "rb").read()
+
+    p1 = Predictor(sym_json, params, {"data": (1, 4)})
+    p2 = Predictor(sym_json, params, {"data": (1, 4)})
+    assert p1._cached is p2._cached  # genuinely shared
+
+    # single-threaded interleaving: p1.set_input, p2.set_input,
+    # p1.forward, p2.forward — the exact clobber pattern from REVIEW
+    p1.set_input("data", xs[0].tobytes())
+    p2.set_input("data", xs[1].tobytes())
+    p1.forward()
+    p2.forward()
+    o1 = np.frombuffer(p1.output_bytes(0), np.float32).reshape(1, 6)
+    o2 = np.frombuffer(p2.output_bytes(0), np.float32).reshape(1, 6)
+    np.testing.assert_allclose(o1, ref[0], rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(o2, ref[1], rtol=1e-5, atol=1e-6)
+
+    # p2 forwarding again must not invalidate p1's already-read outputs
+    p2.set_input("data", xs[2].tobytes())
+    p2.forward()
+    o1_again = np.frombuffer(p1.output_bytes(0), np.float32).reshape(1, 6)
+    np.testing.assert_allclose(o1_again, ref[0], rtol=1e-5, atol=1e-6)
+
+    # concurrent threads hammering their own Predictor
+    bad = []
+
+    def worker(p, idx):
+        for _ in range(25):
+            p.set_input("data", xs[idx].tobytes())
+            p.forward()
+            out = np.frombuffer(p.output_bytes(0),
+                                np.float32).reshape(1, 6)
+            if not np.allclose(out, ref[idx], rtol=1e-5, atol=1e-6):
+                bad.append(idx)
+                return
+
+    threads = [threading.Thread(target=worker, args=(p, i))
+               for i, p in enumerate((p1, p2,
+                                      Predictor(sym_json, params,
+                                                {"data": (1, 4)})))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    assert not bad, f"cross-Predictor clobber on indices {bad}"
+
+
+# -- request validation / batch isolation ------------------------------------
+def test_malformed_request_rejected_individually():
+    """A bad request fails at submit() with a structured error and never
+    poisons the micro-batch its well-formed neighbours ride in."""
+    net = _mlp()
+    xs = np.random.randn(6, 4).astype(np.float32)
+    oracle = net(mx.nd.array(xs)).asnumpy()
+    with ModelServer(max_batch_size=8, max_latency_ms=20.0,
+                     name="t-malformed") as server:
+        server.load("m", block=net)
+        futs = [server.predict_async("m", {"data": xs[i]})
+                for i in range(3)]
+        # wrong per-sample shape: rejected synchronously, alone
+        with pytest.raises(MXNetError, match="incompatible"):
+            server.predict_async("m", {"data": np.zeros(7, np.float32)})
+        # missing input key: rejected synchronously, alone
+        with pytest.raises(MXNetError, match="do not match"):
+            server.predict_async("m", {"wrong": xs[0]})
+        # unexpected extra key: rejected synchronously, alone
+        with pytest.raises(MXNetError, match="unexpected"):
+            server.predict_async("m", {"data": xs[0], "extra": xs[0]})
+        futs += [server.predict_async("m", {"data": xs[i]})
+                 for i in range(3, 6)]
+        outs = [f.result(60) for f in futs]
+        assert server.metrics.get("invalid_total") == 3
+    for i, out in enumerate(outs):  # the innocents all answered correctly
+        np.testing.assert_allclose(out[0], oracle[i], atol=1e-6)
+
+
+def test_batcher_signature_cohorts_isolate_mismatched_shapes():
+    """Raw DynamicBatcher (no validator): requests with different input
+    signatures execute in separate cohorts instead of one np.stack that
+    throws for everyone."""
+    ran = []
+
+    def runner(feed, n):
+        ran.append((feed["x"].shape, n))
+        return [feed["x"] * 2.0]
+
+    b = DynamicBatcher(runner, max_batch_size=8, max_latency_ms=30.0,
+                       num_workers=1, name="t-cohort")
+    f_a = [b.submit({"x": np.full((3,), float(i), np.float32)})
+           for i in range(2)]
+    f_b = b.submit({"x": np.zeros((5,), np.float32)})  # mismatched shape
+    for i, f in enumerate(f_a):
+        np.testing.assert_allclose(f.result(10)[0], 2.0 * i)
+    np.testing.assert_allclose(f_b.result(10)[0], np.zeros(5))
+    b.close()
+    assert {shape[1:] for shape, _ in ran} == {(3,), (5,)}
+
+
+def test_integer_inputs_preserve_dtype():
+    """Int inputs (token ids / indices) must not be cast to float32 —
+    16777217 is the first integer float32 cannot represent."""
+    data = mx.sym.var("data")
+    out = data + 1
+    with ModelServer(max_batch_size=4, max_latency_ms=2.0,
+                     name="t-dtype") as server:
+        server.load("ids", symbol=out, params={})
+        big = np.array([16777217, 3], dtype=np.int32)
+        res = server.predict("ids", {"data": big})[0]
+        assert res.dtype == np.int32, f"int32 in, {res.dtype} out"
+        np.testing.assert_array_equal(res, big + 1)
+        # float traffic on the same model binds its own program
+        fres = server.predict(
+            "ids", {"data": np.array([0.5, 1.5], np.float32)})[0]
+        assert fres.dtype == np.float32
+        np.testing.assert_allclose(fres, [1.5, 2.5])
+
+
 # -- repository --------------------------------------------------------------
 def test_repository_versioning_and_errors(tmp_path):
     net = _mlp()
@@ -486,3 +613,28 @@ def test_module_partial_batch_pads_instead_of_rebinding():
     assert mod._exec is bound_exec
     np.testing.assert_allclose(mod.get_outputs()[0].asnumpy(), full_out,
                                rtol=1e-5, atol=1e-6)
+
+
+def test_partial_batch_slices_only_batch_carrying_outputs():
+    """An output whose leading dim COINCIDENTALLY equals the bound batch
+    (here a (6,6) gram matrix under a batch of 6) must not be pad-sliced
+    after a padded partial-batch forward."""
+    data = mx.sym.var("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=5, name="fc")
+    gram = mx.sym.dot(mx.sym.transpose(data), data)  # (in, in) = (6, 6)
+    out = mx.symbol.Group([fc, gram])
+    mod = mx.mod.Module(out, data_names=("data",), label_names=None)
+    mod.bind(data_shapes=[("data", (6, 6))], for_training=False)
+    mod.init_params()
+    from collections import namedtuple
+    Batch = namedtuple("Batch", ["data", "label", "pad"])
+    x = np.random.randn(6, 6).astype(np.float32)
+    # partial batch of 2 -> padded to the bound 6; zero pad rows do not
+    # change X^T X, so the unsliced gram output must come back (6, 6)
+    mod.forward(Batch([mx.nd.array(x[:2])], None, 0), is_train=False)
+    fc_out, gram_out = mod.get_outputs()
+    assert mod._forward_pad == 4  # the pad path actually ran
+    assert fc_out.shape == (2, 5)          # batch output: sliced
+    assert gram_out.shape == (6, 6)        # non-batch output: untouched
+    np.testing.assert_allclose(gram_out.asnumpy(), x[:2].T @ x[:2],
+                               rtol=1e-4, atol=1e-5)
