@@ -178,6 +178,61 @@ def calibrate_peak(dev, reps=None):
                   "sweep": sweep}
 
 
+def measure_checkpoint():
+    """Time-to-safe metrics: how long a checkpoint save blocks the train
+    loop (async manager: device->host snapshot only) vs the equivalent
+    synchronous save, and restore latency — on BENCH_CKPT_MB of state.
+
+    Emits ckpt_save_blocking_ms (async headline), ckpt_save_sync_ms
+    (the serialize+sha256+fsync+commit cost the writer thread hides),
+    blocking_fraction, and ckpt_restore_s (checksum-verified load).
+    Best-of-3 each, so one fs hiccup doesn't skew the trajectory.
+    """
+    import shutil
+    import tempfile
+
+    import numpy as np
+    from mxnet_tpu import config as mxcfg
+    from mxnet_tpu.checkpoint import CheckpointManager
+
+    mb = max(1, mxcfg.get("BENCH_CKPT_MB"))
+    n = mb * 1024 * 1024 // 4 // 8
+    arrays = {f"w{i}": np.random.randn(n).astype(np.float32)
+              for i in range(8)}
+    nbytes = sum(a.nbytes for a in arrays.values())
+    root = tempfile.mkdtemp(prefix="bench-ckpt-")
+    try:
+        sync_ms, blocking_ms, restore_s = [], [], []
+        with CheckpointManager(os.path.join(root, "sync"), keep_last=1,
+                               async_save=False) as mgr:
+            for i in range(3):
+                t0 = time.perf_counter()
+                mgr.save(i + 1, arrays=arrays, block=True)
+                sync_ms.append((time.perf_counter() - t0) * 1e3)
+        with CheckpointManager(os.path.join(root, "async"), keep_last=1,
+                               async_save=True) as mgr:
+            for i in range(3):
+                t0 = time.perf_counter()
+                mgr.save(i + 1, arrays=arrays)  # returns after the snapshot
+                blocking_ms.append((time.perf_counter() - t0) * 1e3)
+                mgr.wait()
+            for _ in range(3):
+                t0 = time.perf_counter()
+                mgr.restore()  # checksum-verified
+                restore_s.append(time.perf_counter() - t0)
+        blk, syn = min(blocking_ms), min(sync_ms)
+        return {
+            "metric": "ckpt_save_blocking_ms",
+            "value": round(blk, 2),
+            "ckpt_save_sync_ms": round(syn, 2),
+            "blocking_fraction": round(blk / syn, 4) if syn else None,
+            "ckpt_restore_s": round(min(restore_s), 4),
+            "state_bytes": nbytes,
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def measure_serving():
     """Inference serving throughput: ResNet-18 through the DynamicBatcher
     under synthetic Poisson arrivals (open loop).
@@ -667,6 +722,25 @@ def main():
                     log(f"serving phase failed: {type(e).__name__}: {e}")
                     result["serving"] = {
                         "metric": "resnet18_serve_img_per_sec",
+                        "error": f"{type(e).__name__}: {e}"}
+
+        # --- checkpoint time-to-safe (save-blocking / restore) ----------
+        if _mxcfg.get("BENCH_CKPT"):
+            remaining = budget - (time.perf_counter() - T_START)
+            if remaining <= 60:
+                log(f"skipping checkpoint phase: only {remaining:.0f}s left")
+            else:
+                try:
+                    ck = measure_checkpoint()
+                    result["checkpoint"] = ck
+                    log(f"[checkpoint] save blocks {ck['value']}ms async vs "
+                        f"{ck['ckpt_save_sync_ms']}ms sync "
+                        f"({ck['blocking_fraction']:.0%}), restore "
+                        f"{ck['ckpt_restore_s']}s")
+                except Exception as e:
+                    log(f"checkpoint phase failed: {type(e).__name__}: {e}")
+                    result["checkpoint"] = {
+                        "metric": "ckpt_save_blocking_ms",
                         "error": f"{type(e).__name__}: {e}"}
     except Exception as e:  # always emit the JSON line
         import traceback

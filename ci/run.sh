@@ -21,6 +21,9 @@ echo "== serving smoke (dynamic batcher, 64 concurrent clients) =="
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
   python -m mxnet_tpu.serving.smoke
 
+echo "== checkpoint smoke (save -> kill writer mid-save -> restore) =="
+JAX_PLATFORMS=cpu python -m mxnet_tpu.checkpoint.smoke
+
 echo "== entry points =="
 JAX_PLATFORMS=cpu python -c \
   "import __graft_entry__ as g; fn, a = g.entry(); fn(*a)"

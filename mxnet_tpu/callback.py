@@ -9,25 +9,51 @@ import sys
 import time
 
 
-def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
+def _checkpoint_manager(prefix, manager):
+    """The CheckpointManager behind a legacy ``prefix`` callback: commits
+    land in ``{prefix}-ckpt/step-NNNNNN/`` (atomic, checksummed,
+    retention-managed) and the legacy mirror keeps emitting
+    ``{prefix}-symbol.json`` / ``{prefix}-NNNN.params`` so existing
+    consumers of the reference format keep working."""
+    if manager is not None:
+        return manager
+    from .checkpoint import CheckpointManager
+    return CheckpointManager(f"{prefix}-ckpt", legacy_prefix=prefix)
+
+
+def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False,
+                      manager=None):
     """Callback to checkpoint Module every period epochs
-    (parity: callback.py module_checkpoint)."""
+    (parity: callback.py module_checkpoint).  Routed through the
+    checkpoint subsystem: atomic commit + manifest + retention, with the
+    legacy ``prefix-NNNN.params`` files mirrored for compatibility."""
     period = int(max(1, period))
+    mgr = _checkpoint_manager(prefix, manager)
 
     def _callback(iter_no, sym=None, arg=None, aux=None):
         if (iter_no + 1) % period == 0:
-            mod.save_checkpoint(prefix, iter_no + 1, save_optimizer_states)
+            mgr.save_module(mod, iter_no + 1,
+                            save_optimizer_states=save_optimizer_states,
+                            epoch=iter_no + 1, block=True)
+    _callback.manager = mgr
     return _callback
 
 
-def do_checkpoint(prefix, period=1):
-    """Callback to checkpoint the model (parity: callback.py do_checkpoint)."""
-    from .model import save_checkpoint
+def do_checkpoint(prefix, period=1, manager=None):
+    """Callback to checkpoint the model (parity: callback.py do_checkpoint).
+    Routed through CheckpointManager (atomic commit, checksums,
+    retention) while the legacy mirror keeps ``prefix-NNNN.params``
+    readable by ``model.load_checkpoint``."""
     period = int(max(1, period))
+    mgr = _checkpoint_manager(prefix, manager)
 
     def _callback(iter_no, sym, arg, aux):
         if (iter_no + 1) % period == 0:
-            save_checkpoint(prefix, iter_no + 1, sym, arg, aux)
+            arrays = {f"arg:{n}": v for n, v in (arg or {}).items()}
+            arrays.update({f"aux:{n}": v for n, v in (aux or {}).items()})
+            mgr.save(iter_no + 1, arrays=arrays, symbol=sym,
+                     epoch=iter_no + 1, block=True)
+    _callback.manager = mgr
     return _callback
 
 

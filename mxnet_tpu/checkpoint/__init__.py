@@ -1,0 +1,20 @@
+"""mxnet_tpu.checkpoint — async, atomic, sharded checkpointing.
+
+The Orbax/TensorStore-shaped answer to the north star's failure-survival
+requirement: saves snapshot device state on the train thread (cheap
+device->host copy) and serialize/fsync on a background writer; commits
+are write-into-``step-NNNNNN.tmp/`` + manifest-with-checksums + atomic
+rename, so a torn checkpoint is never discoverable; sharded writes put
+only host-owned shards on disk and restore re-assembles + re-shards onto
+any other dp×tp×pp layout (elastic restore).  See docs/checkpoint.md.
+"""
+from .core import (Checkpoint, CheckpointCorruptError, CheckpointError,
+                   CheckpointNotFoundError, committed_steps, latest_step,
+                   load_step, restore, step_dir, step_dirname)
+from .manager import CheckpointManager
+
+__all__ = [
+    "Checkpoint", "CheckpointCorruptError", "CheckpointError",
+    "CheckpointManager", "CheckpointNotFoundError", "committed_steps",
+    "latest_step", "load_step", "restore", "step_dir", "step_dirname",
+]

@@ -1,0 +1,305 @@
+"""Checkpoint layout, manifest schema and the read/verify path.
+
+Directory layout (docs/checkpoint.md):
+
+    ckpt_dir/
+      step-000010/              # committed: the rename made it visible
+        MANIFEST.json           # schema, per-file sha256, shard layout
+        data-00000-of-00001.bin # raw tensor shards + opaque blobs
+        symbol.json             # optional: the graph that produced them
+      step-000012.tmp/          # in progress — never discoverable
+
+The commit protocol is write-into-tmp -> fsync files -> fsync tmp dir ->
+rename(tmp, final) -> fsync parent.  ``committed_steps``/``latest_step``
+only ever see directories whose rename completed AND that contain a
+manifest, so a writer killed at any instant leaves either the previous
+step or the new one — never a torn checkpoint.
+
+Tensors are stored as raw bytes (dtype recorded by name, so bfloat16 and
+friends survive) with an explicit shard table: each shard carries the
+half-open index ``[[start, stop], ...]`` it covers in the global array.
+A checkpoint saved from one dp×tp×pp layout is therefore re-assembled
+into full host arrays on load and can be re-sharded onto any other
+layout (elastic restore).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import re
+
+import numpy as np
+
+from ..base import MXNetError
+
+SCHEMA_VERSION = 1
+MANIFEST = "MANIFEST.json"
+TMP_SUFFIX = ".tmp"
+_STEP_RE = re.compile(r"^step-(\d{6,})$")
+
+
+class CheckpointError(MXNetError):
+    """Base class for checkpoint failures."""
+
+
+class CheckpointNotFoundError(CheckpointError):
+    """No committed checkpoint matches the request."""
+
+
+class CheckpointCorruptError(CheckpointError):
+    """A committed checkpoint failed checksum/structure verification."""
+
+
+def step_dirname(step):
+    return f"step-{int(step):06d}"
+
+
+def step_dir(directory, step):
+    return os.path.join(directory, step_dirname(step))
+
+
+def committed_steps(directory):
+    """Sorted committed step numbers under ``directory``.
+
+    A step counts as committed only when its final (non-``.tmp``)
+    directory exists AND contains a manifest — the last file written
+    before the atomic rename, so partial states are invisible here.
+    """
+    try:
+        names = os.listdir(directory)
+    except (FileNotFoundError, NotADirectoryError):
+        return []
+    steps = []
+    for name in names:
+        m = _STEP_RE.match(name)
+        if m and os.path.isfile(os.path.join(directory, name, MANIFEST)):
+            steps.append(int(m.group(1)))
+    return sorted(steps)
+
+
+def latest_step(directory):
+    """Newest committed step, or None when there is none."""
+    steps = committed_steps(directory)
+    return steps[-1] if steps else None
+
+
+def _np_dtype(name):
+    """np.dtype from its saved name; bfloat16 etc. resolve via ml_dtypes."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        try:
+            return np.dtype(getattr(ml_dtypes, name))
+        except AttributeError:
+            raise CheckpointCorruptError(
+                f"checkpoint tensor has unknown dtype {name!r}") from None
+
+
+def _fsync_path(path):
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _sha256(data):
+    return hashlib.sha256(data).hexdigest()
+
+
+class Checkpoint:
+    """One restored checkpoint: host arrays + blobs + metadata.
+
+    ``arrays`` maps tensor name -> np.ndarray (fully assembled global
+    arrays, whatever mesh layout saved them).  ``blobs`` maps name ->
+    bytes (e.g. ``"optimizer_states"``).  ``symbol_json`` is the graph
+    JSON when the saver provided one.
+    """
+
+    def __init__(self, step, metadata, mesh, arrays, blobs, symbol_json):
+        self.step = int(step)
+        self.metadata = metadata or {}
+        self.mesh = mesh
+        self.arrays = arrays
+        self.blobs = blobs
+        self.symbol_json = symbol_json
+
+    @property
+    def epoch(self):
+        return self.metadata.get("epoch")
+
+    def as_ndarrays(self):
+        """All tensors as NDArrays (keys unchanged)."""
+        from ..ndarray import array
+        return {k: array(v) for k, v in self.arrays.items()}
+
+    def _prefixed(self, prefix):
+        from ..ndarray import array
+        return {k.split(":", 1)[1]: array(v) for k, v in self.arrays.items()
+                if k.startswith(prefix)}
+
+    @property
+    def arg_params(self):
+        """``arg:``-prefixed tensors as {name: NDArray} (module convention)."""
+        return self._prefixed("arg:")
+
+    @property
+    def aux_params(self):
+        """``aux:``-prefixed tensors as {name: NDArray}."""
+        return self._prefixed("aux:")
+
+    def __repr__(self):
+        return (f"Checkpoint(step={self.step}, tensors={len(self.arrays)}, "
+                f"blobs={sorted(self.blobs)})")
+
+
+def _assemble_tensor(name, entry, file_bytes):
+    """Re-assemble one global array from its recorded shards."""
+    dtype = _np_dtype(entry["dtype"])
+    shape = tuple(int(s) for s in entry["shape"])
+    out = np.empty(shape, dtype=dtype)
+    covered = 0
+    for sh in entry["shards"]:
+        data = file_bytes.get(sh["file"])
+        if data is None:
+            raise CheckpointCorruptError(
+                f"tensor {name!r} references missing file {sh['file']!r}")
+        index = tuple((int(b), int(e)) for b, e in sh["index"])
+        shard_shape = tuple(e - b for b, e in index)
+        n = int(np.prod(shard_shape)) if shard_shape else 1
+        nbytes = n * dtype.itemsize
+        if sh["offset"] + nbytes > len(data):
+            raise CheckpointCorruptError(
+                f"tensor {name!r} shard overruns file {sh['file']!r}")
+        flat = np.frombuffer(data, dtype=dtype, count=n,
+                             offset=int(sh["offset"]))
+        if shape == ():
+            out = flat.reshape(())
+            covered = 1
+            continue
+        out[tuple(slice(b, e) for b, e in index)] = flat.reshape(shard_shape)
+        covered += n
+    total = int(np.prod(shape)) if shape else 1
+    if covered < total:
+        raise CheckpointCorruptError(
+            f"tensor {name!r}: shards cover {covered} of {total} elements "
+            "(checkpoint saved by a partial host set?)")
+    return out
+
+
+def load_step(directory, step, verify=True):
+    """Load one committed step into a :class:`Checkpoint`.
+
+    Raises CheckpointNotFoundError when the step is not committed and
+    CheckpointCorruptError on checksum/structure mismatch.
+    """
+    path = step_dir(directory, step)
+    mpath = os.path.join(path, MANIFEST)
+    if not os.path.isfile(mpath):
+        raise CheckpointNotFoundError(
+            f"no committed checkpoint for step {step} in {directory!r}")
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        raise CheckpointCorruptError(
+            f"unreadable manifest for step {step}: {e}") from e
+    if manifest.get("schema_version") != SCHEMA_VERSION:
+        raise CheckpointCorruptError(
+            f"manifest schema {manifest.get('schema_version')!r} not "
+            f"supported (expected {SCHEMA_VERSION})")
+
+    file_bytes = {}
+    for fname, finfo in manifest.get("files", {}).items():
+        fpath = os.path.join(path, fname)
+        try:
+            with open(fpath, "rb") as f:
+                data = f.read()
+        except OSError as e:
+            raise CheckpointCorruptError(
+                f"step {step}: cannot read {fname!r}: {e}") from e
+        if len(data) != int(finfo["bytes"]):
+            raise CheckpointCorruptError(
+                f"step {step}: {fname!r} is {len(data)} bytes, manifest "
+                f"says {finfo['bytes']}")
+        if verify and _sha256(data) != finfo["sha256"]:
+            raise CheckpointCorruptError(
+                f"step {step}: checksum mismatch for {fname!r}")
+        file_bytes[fname] = data
+
+    arrays = {}
+    for name, entry in manifest.get("tensors", {}).items():
+        arrays[name] = _assemble_tensor(name, entry, file_bytes)
+    blobs = {}
+    for name, entry in manifest.get("blobs", {}).items():
+        data = file_bytes.get(entry["file"])
+        if data is None:
+            raise CheckpointCorruptError(
+                f"blob {name!r} references missing file {entry['file']!r}")
+        off, n = int(entry["offset"]), int(entry["nbytes"])
+        if off + n > len(data):
+            raise CheckpointCorruptError(f"blob {name!r} overruns its file")
+        blobs[name] = bytes(data[off:off + n])
+    symbol_json = None
+    sym_file = manifest.get("symbol")
+    if sym_file and sym_file in file_bytes:
+        symbol_json = file_bytes[sym_file].decode("utf-8")
+    return Checkpoint(manifest["step"], manifest.get("metadata"),
+                      manifest.get("mesh"), arrays, blobs, symbol_json)
+
+
+def restore(directory, step=None, verify=True, fallback=True,
+            logger=logging):
+    """Restore a checkpoint from ``directory``.
+
+    With ``step=None`` the newest committed step is loaded; if it fails
+    verification and ``fallback`` is true, earlier committed steps are
+    tried (newest first) with a warning — the ISSUE-2 contract that a
+    corrupt latest step degrades to the previous good one instead of
+    killing the resume.  An explicitly requested step never falls back.
+    """
+    import time as _time
+    t0 = _time.perf_counter()
+    steps = committed_steps(directory)
+    if not steps:
+        raise CheckpointNotFoundError(
+            f"no committed checkpoints in {directory!r}")
+    if step is not None:
+        ckpt = load_step(directory, int(step), verify=verify)
+        _record_restore(t0)
+        return ckpt
+    last_err = None
+    for s in reversed(steps):
+        try:
+            ckpt = load_step(directory, s, verify=verify)
+            if last_err is not None:
+                logger.warning(
+                    "checkpoint: fell back to step %d after corruption: %s",
+                    s, last_err)
+            _record_restore(t0)
+            return ckpt
+        except CheckpointCorruptError as e:
+            if not fallback:
+                raise
+            last_err = e
+            logger.warning("checkpoint: step %d failed verification (%s); "
+                           "trying previous committed step", s, e)
+    raise CheckpointCorruptError(
+        f"every committed checkpoint in {directory!r} failed "
+        f"verification; last error: {last_err}")
+
+
+def _record_restore(t0):
+    import time as _time
+    try:
+        from .. import profiler
+        profiler.record_counter("checkpoint:restore_s",
+                                round(_time.perf_counter() - t0, 4))
+    except Exception:
+        pass

@@ -1,0 +1,598 @@
+"""CheckpointManager: async, atomic, sharded checkpointing.
+
+Save lifecycle (ISSUE 2 tentpole):
+
+1. **snapshot** (caller thread, the only part the train loop pays for):
+   every tensor is copied device->host.  jax Arrays are snapshotted
+   shard-by-shard — each process copies only the shards it can address,
+   deduplicating replicas — so under a ``parallel`` mesh a host writes
+   only what it owns.
+2. **serialize + commit** (background writer thread): shards stream into
+   ``step-NNNNNN.tmp/data-*.bin`` with running sha256, the manifest is
+   written last, everything is fsynced, and the tmp directory is
+   atomically renamed to ``step-NNNNNN/``.  ``latest()`` therefore only
+   ever sees committed steps.
+3. **retention**: after each commit, old steps are garbage-collected
+   under the ``keep_last`` / ``keep_every`` policy.
+
+Restore re-assembles full host arrays from the shard table and hands
+them back as numpy/NDArray — the caller re-shards onto whatever mesh
+layout it is running now (elastic restore; see TrainStep.These
+restore_checkpoint and docs/checkpoint.md).
+
+One manager instance owns a directory (single writer per directory);
+stale ``.tmp``/``.gc``/``.old`` residue from a killed writer is swept on
+construction.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import pickle
+import queue
+import shutil
+import threading
+import time
+
+import numpy as np
+
+from .core import (MANIFEST, SCHEMA_VERSION, TMP_SUFFIX, Checkpoint,
+                   CheckpointCorruptError, CheckpointError,
+                   CheckpointNotFoundError, _fsync_path, _sha256,
+                   committed_steps, latest_step, restore, step_dir,
+                   step_dirname)
+
+_STALE_SUFFIXES = (TMP_SUFFIX, ".gc", ".old")
+
+
+def _cfg(name):
+    from ..config import get
+    return get(name)
+
+
+class _SaveFuture:
+    """Completion handle for an async save."""
+
+    def __init__(self, step):
+        self.step = int(step)
+        self._done = threading.Event()
+        self._exc = None
+
+    def _set(self, exc):
+        self._exc = exc
+        self._done.set()
+
+    def done(self):
+        return self._done.is_set()
+
+    def result(self, timeout=None):
+        """Block until the save committed; raises the writer's error."""
+        if not self._done.wait(timeout):
+            raise CheckpointError(
+                f"save of step {self.step} not committed within {timeout}s")
+        if self._exc is not None:
+            raise self._exc
+        return self.step
+
+
+class _SaveJob:
+    __slots__ = ("step", "tensors", "blobs", "symbol_json", "metadata",
+                 "mesh", "future", "snapshot_ms", "nbytes")
+
+    def __init__(self, step, tensors, blobs, symbol_json, metadata, mesh,
+                 future, snapshot_ms, nbytes):
+        self.step = step
+        self.tensors = tensors      # [(name, dtype_str, shape, shards)]
+        self.blobs = blobs          # {name: bytes}
+        self.symbol_json = symbol_json
+        self.metadata = metadata
+        self.mesh = mesh
+        self.future = future
+        self.snapshot_ms = snapshot_ms
+        self.nbytes = nbytes
+
+
+def _norm_index(index, shape):
+    """jax shard index (tuple of slices) -> [[start, stop], ...]."""
+    out = []
+    for d, sl in enumerate(index):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = shape[d] if sl.stop is None else int(sl.stop)
+        out.append([start, stop])
+    for d in range(len(index), len(shape)):
+        out.append([0, shape[d]])
+    return out
+
+
+def _snapshot_one(name, value):
+    """-> (name, dtype_str, shape, [(index, host np.ndarray)]).
+
+    The device->host copy happens HERE, on the caller thread — that is
+    the entirety of what a save blocks the train loop for.  jax Arrays
+    contribute only their addressable shards (replicas deduplicated by
+    index), so multi-host meshes naturally partition the write.
+    """
+    from ..ndarray import NDArray
+    if isinstance(value, NDArray):
+        value = value._data
+    try:
+        import jax
+        is_jax = isinstance(value, jax.Array)
+    except Exception:
+        is_jax = False
+    if is_jax:
+        shape = tuple(int(s) for s in value.shape)
+        dtype = np.dtype(value.dtype)
+        shards = []
+        seen = set()
+        for sh in value.addressable_shards:
+            index = _norm_index(sh.index, shape)
+            key = tuple(map(tuple, index))
+            if key in seen:
+                continue  # replica of a shard already snapshotted
+            seen.add(key)
+            shards.append((index, np.asarray(sh.data)))
+        if not shards:
+            raise CheckpointError(
+                f"tensor {name!r} has no addressable shards on this host")
+        return (name, dtype.name, shape, shards)
+    arr = np.array(value)  # owns its memory: caller may mutate theirs
+    shape = tuple(arr.shape)
+    return (name, arr.dtype.name, shape,
+            [([[0, s] for s in shape], arr)])
+
+
+class CheckpointManager:
+    """Owns the save/restore lifecycle for one checkpoint directory.
+
+    Parameters default from the ``MXNET_CKPT_*`` config tier
+    (``mx.config.describe()``):
+
+    * ``async_save``  — serialize/fsync on a background writer so
+      ``save()`` blocks only for the device->host snapshot.
+    * ``keep_last``   — committed steps retained (0 = keep everything).
+    * ``keep_every``  — additionally keep every Nth step forever.
+    * ``legacy_prefix`` — also mirror each commit to
+      ``{prefix}-symbol.json`` / ``{prefix}-{step:04d}.params`` (the
+      reference checkpoint format) so legacy tooling keeps working.
+    """
+
+    def __init__(self, directory, keep_last=None, keep_every=None,
+                 async_save=None, legacy_prefix=None, host_id=None,
+                 num_hosts=None, logger=None):
+        self.directory = str(directory)
+        self.keep_last = (_cfg("MXNET_CKPT_KEEP_LAST") if keep_last is None
+                          else int(keep_last))
+        self.keep_every = (_cfg("MXNET_CKPT_KEEP_EVERY") if keep_every is None
+                           else int(keep_every))
+        self.async_save = (_cfg("MXNET_CKPT_ASYNC") if async_save is None
+                           else bool(async_save))
+        self.legacy_prefix = legacy_prefix
+        if host_id is None or num_hosts is None:
+            host_id, num_hosts = self._detect_hosts(host_id, num_hosts)
+        self.host_id = int(host_id)
+        self.num_hosts = int(num_hosts)
+        self.logger = logger or logging.getLogger("mxnet_tpu.checkpoint")
+        os.makedirs(self.directory, exist_ok=True)
+        if self.host_id == 0:
+            self._sweep_stale()
+        self._stats = {"saves": 0, "failures": 0, "gc_removed": 0,
+                       "last_save_blocking_ms": None,
+                       "last_save_total_ms": None,
+                       "last_save_bytes": None}
+        self._pending = []
+        self._lock = threading.Lock()
+        self._queue = queue.Queue(maxsize=1)
+        self._writer = None
+        self._closed = False
+
+    @staticmethod
+    def _detect_hosts(host_id, num_hosts):
+        try:
+            import jax
+            return (jax.process_index() if host_id is None else host_id,
+                    jax.process_count() if num_hosts is None else num_hosts)
+        except Exception:
+            return (host_id or 0, num_hosts or 1)
+
+    def _sweep_stale(self):
+        """Remove residue a killed writer left behind (single-writer dirs)."""
+        for name in os.listdir(self.directory):
+            if name.endswith(_STALE_SUFFIXES):
+                shutil.rmtree(os.path.join(self.directory, name),
+                              ignore_errors=True)
+
+    # -- save ---------------------------------------------------------------
+    def save(self, step, arrays=None, blobs=None, symbol=None, epoch=None,
+             rng=None, extra=None, mesh=None, block=None):
+        """Checkpoint ``arrays`` (+ ``blobs``/``symbol``/metadata) as ``step``.
+
+        ``arrays``: {name: NDArray | np.ndarray | jax.Array} — jax arrays
+        are saved shard-wise per their current sharding.  ``blobs``:
+        {name: bytes} for opaque state (optimizer pickles, RNG).  Returns
+        a future; ``block=True`` (or sync mode) waits for the commit.
+        The caller thread only pays for the device->host snapshot; with a
+        save already in flight, the next ``save()`` backpressures until
+        the writer frees up.
+        """
+        if self._closed:
+            raise CheckpointError("CheckpointManager is closed")
+        step = int(step)
+        if step < 0:
+            raise CheckpointError(f"step must be >= 0, got {step}")
+        t0 = time.perf_counter()
+        tensors = [_snapshot_one(name, value)
+                   for name, value in (arrays or {}).items()]
+        job_blobs = {str(k): bytes(v) for k, v in (blobs or {}).items()}
+        if rng is not None:
+            job_blobs.setdefault("rng", pickle.dumps(rng))
+        symbol_json = None
+        if symbol is not None:
+            symbol_json = symbol if isinstance(symbol, str) else \
+                symbol.tojson()
+        metadata = {"wall_time": time.time()}
+        if epoch is not None:
+            metadata["epoch"] = int(epoch)
+        if extra is not None:
+            metadata["extra"] = extra
+        nbytes = sum(arr.nbytes for _n, _d, _s, shards in tensors
+                     for _i, arr in shards)
+        nbytes += sum(len(b) for b in job_blobs.values())
+        fut = _SaveFuture(step)
+        mesh_meta = dict(getattr(mesh, "axes", mesh)) if mesh else None
+        job = _SaveJob(step, tensors, job_blobs, symbol_json, metadata,
+                       mesh_meta, fut, 0.0, nbytes)
+        with self._lock:
+            self._pending.append(fut)
+        if self.async_save:
+            self._ensure_writer()
+            self._queue.put(job)  # backpressure: one save in flight
+            blocking_ms = (time.perf_counter() - t0) * 1e3
+        else:
+            blocking_ms = None  # set below: sync save blocks for everything
+            try:
+                self._write_step(job)
+                fut._set(None)
+            except BaseException as e:
+                fut._set(e if isinstance(e, Exception) else
+                         CheckpointError(str(e)))
+            blocking_ms = (time.perf_counter() - t0) * 1e3
+        job.snapshot_ms = blocking_ms
+        self._stats["last_save_blocking_ms"] = blocking_ms
+        self._record_counter("checkpoint:save_blocking_ms",
+                             round(blocking_ms, 3))
+        if block or not self.async_save:
+            fut.result()
+        return fut
+
+    def _ensure_writer(self):
+        if self._writer is None or not self._writer.is_alive():
+            self._writer = threading.Thread(
+                target=self._writer_loop, name="ckpt-writer", daemon=True)
+            self._writer.start()
+
+    def _writer_loop(self):
+        while True:
+            job = self._queue.get()
+            if job is None:
+                return
+            try:
+                self._write_step(job)
+                job.future._set(None)
+            except BaseException as e:  # surface via future, keep writing
+                self._stats["failures"] += 1
+                self.logger.exception(
+                    "checkpoint: save of step %d failed", job.step)
+                job.future._set(e if isinstance(e, Exception) else
+                                CheckpointError(str(e)))
+
+    # -- the write/commit protocol ------------------------------------------
+    def _write_step(self, job):
+        t0 = time.perf_counter()
+        delay_s = _cfg("MXNET_CKPT_WRITE_DELAY_MS") / 1e3
+        final = step_dir(self.directory, job.step)
+        tmp = final + TMP_SUFFIX
+        if self.host_id == 0:
+            if os.path.isdir(tmp):
+                shutil.rmtree(tmp)  # stale attempt for this very step
+            os.makedirs(tmp, exist_ok=True)
+        else:
+            deadline = time.time() + _cfg("MXNET_CKPT_COMMIT_TIMEOUT_S")
+            while not os.path.isdir(tmp):  # host 0 creates the tmp dir
+                if time.time() > deadline:
+                    raise CheckpointError(
+                        f"host {self.host_id}: step dir never appeared")
+                time.sleep(0.05)
+
+        data_name = f"data-{self.host_id:05d}-of-{self.num_hosts:05d}.bin"
+        tensor_entries, blob_entries = {}, {}
+        sha = None
+        offset = 0
+        import hashlib
+        sha = hashlib.sha256()
+        with open(os.path.join(tmp, data_name), "wb") as f:
+            for name, dtype_str, shape, shards in job.tensors:
+                entry = tensor_entries.setdefault(
+                    name, {"dtype": dtype_str, "shape": list(shape),
+                           "shards": []})
+                for index, arr in shards:
+                    raw = np.ascontiguousarray(arr).tobytes()
+                    entry["shards"].append(
+                        {"file": data_name, "offset": offset,
+                         "nbytes": len(raw), "index": index})
+                    f.write(raw)
+                    sha.update(raw)
+                    offset += len(raw)
+                if delay_s:
+                    f.flush()
+                    time.sleep(delay_s)  # test/debug: widen the tmp window
+            for name, raw in job.blobs.items():
+                blob_entries[name] = {"file": data_name, "offset": offset,
+                                      "nbytes": len(raw)}
+                f.write(raw)
+                sha.update(raw)
+                offset += len(raw)
+            f.flush()
+            os.fsync(f.fileno())
+        files = {data_name: {"sha256": sha.hexdigest(), "bytes": offset}}
+
+        if self.num_hosts > 1:
+            self._write_shard_manifest(tmp, files, tensor_entries,
+                                       blob_entries)
+            if self.host_id != 0:
+                return  # host 0 merges and commits
+            files, tensor_entries, blob_entries = self._merge_shards(tmp)
+
+        symbol_file = None
+        if job.symbol_json is not None:
+            symbol_file = "symbol.json"
+            raw = job.symbol_json.encode("utf-8")
+            with open(os.path.join(tmp, symbol_file), "wb") as f:
+                f.write(raw)
+                f.flush()
+                os.fsync(f.fileno())
+            files[symbol_file] = {"sha256": _sha256(raw), "bytes": len(raw)}
+
+        manifest = {
+            "schema_version": SCHEMA_VERSION,
+            "step": job.step,
+            "metadata": job.metadata,
+            "mesh": job.mesh,
+            "num_hosts": self.num_hosts,
+            "files": files,
+            "tensors": tensor_entries,
+            "blobs": blob_entries,
+        }
+        if symbol_file:
+            manifest["symbol"] = symbol_file
+        if delay_s:
+            time.sleep(delay_s)
+        with open(os.path.join(tmp, MANIFEST), "w") as f:
+            json.dump(manifest, f, indent=1)
+            f.flush()
+            os.fsync(f.fileno())
+        _fsync_path(tmp)
+
+        # the commit point: after this rename (atomic on POSIX) the step
+        # is discoverable; before it, latest() cannot see it
+        if os.path.isdir(final):
+            old = final + ".old"
+            shutil.rmtree(old, ignore_errors=True)
+            os.rename(final, old)
+            os.rename(tmp, final)
+            shutil.rmtree(old, ignore_errors=True)
+        else:
+            os.rename(tmp, final)
+        _fsync_path(self.directory)
+
+        if self.legacy_prefix is not None:
+            self._mirror_legacy(job)
+        self._gc()
+
+        total_ms = (time.perf_counter() - t0) * 1e3
+        self._stats["saves"] += 1
+        self._stats["last_save_total_ms"] = total_ms
+        self._stats["last_save_bytes"] = job.nbytes
+        self._record_counter("checkpoint:save_total_ms", round(total_ms, 3))
+        self._record_counter("checkpoint:save_bytes", job.nbytes)
+        self.logger.info("checkpoint: committed step %d (%.1f MB, %.0f ms)",
+                         job.step, job.nbytes / 1e6, total_ms)
+
+    def _write_shard_manifest(self, tmp, files, tensors, blobs):
+        name = f"shard-{self.host_id:05d}.json"
+        with open(os.path.join(tmp, name), "w") as f:
+            json.dump({"files": files, "tensors": tensors, "blobs": blobs},
+                      f)
+            f.flush()
+            os.fsync(f.fileno())
+
+    def _merge_shards(self, tmp):
+        """Host 0: wait for every host's shard manifest and merge them."""
+        deadline = time.time() + _cfg("MXNET_CKPT_COMMIT_TIMEOUT_S")
+        paths = [os.path.join(tmp, f"shard-{h:05d}.json")
+                 for h in range(self.num_hosts)]
+        while not all(os.path.isfile(p) for p in paths):
+            if time.time() > deadline:
+                missing = [p for p in paths if not os.path.isfile(p)]
+                raise CheckpointError(
+                    f"commit timed out waiting for host shards: {missing}")
+            time.sleep(0.05)
+        files, tensors, blobs = {}, {}, {}
+        for p in paths:
+            with open(p) as f:
+                part = json.load(f)
+            files.update(part["files"])
+            for name, entry in part["tensors"].items():
+                tgt = tensors.setdefault(
+                    name, {"dtype": entry["dtype"], "shape": entry["shape"],
+                           "shards": []})
+                tgt["shards"].extend(entry["shards"])
+            blobs.update(part["blobs"])
+        return files, tensors, blobs
+
+    def _mirror_legacy(self, job):
+        """Also emit ``{prefix}-symbol.json`` + ``{prefix}-{step:04d}.params``
+        (+ ``.states``) so reference-format consumers keep working."""
+        if self.num_hosts > 1:
+            return  # mirror is a single-host convenience
+        from ..ndarray import array
+        from ..ndarray import utils as nd_utils
+        prefix = self.legacy_prefix
+        if job.symbol_json is not None:
+            tmp = f"{prefix}-symbol.json.tmp-{os.getpid()}"
+            with open(tmp, "w") as f:
+                f.write(job.symbol_json)
+            os.replace(tmp, f"{prefix}-symbol.json")
+        save_dict = {}
+        for name, _dtype, shape, shards in job.tensors:
+            full = np.empty(shape,
+                            dtype=shards[0][1].dtype) if shape else None
+            if full is None:
+                full = shards[0][1].reshape(())
+            else:
+                for index, arr in shards:
+                    full[tuple(slice(b, e) for b, e in index)] = arr
+            save_dict[name] = array(full)
+        nd_utils.save(f"{prefix}-{job.step:04d}.params", save_dict)
+        states = job.blobs.get("optimizer_states")
+        if states is not None:
+            tmp = f"{prefix}-{job.step:04d}.states.tmp-{os.getpid()}"
+            with open(tmp, "wb") as f:
+                f.write(states)
+            os.replace(tmp, f"{prefix}-{job.step:04d}.states")
+
+    def _gc(self):
+        """Delete committed steps outside the retention policy."""
+        if self.keep_last <= 0:
+            return
+        steps = committed_steps(self.directory)
+        keep = set(steps[-self.keep_last:])
+        if self.keep_every > 0:
+            keep.update(s for s in steps if s % self.keep_every == 0)
+        removed = 0
+        for s in steps:
+            if s in keep:
+                continue
+            path = step_dir(self.directory, s)
+            trash = path + ".gc"
+            try:
+                os.rename(path, trash)  # instantly invisible to latest()
+                shutil.rmtree(trash, ignore_errors=True)
+                removed += 1
+            except OSError:
+                pass
+        if removed:
+            self._stats["gc_removed"] += removed
+            self._record_counter("checkpoint:gc_removed", removed)
+
+    @staticmethod
+    def _record_counter(name, value):
+        try:
+            from .. import profiler
+            profiler.record_counter(name, value)
+        except Exception:
+            pass
+
+    # -- module / symbolic glue ---------------------------------------------
+    def save_module(self, module, step, save_optimizer_states=True,
+                    epoch=None, extra=None, block=None):
+        """Checkpoint a Module: params + aux + optimizer state + graph."""
+        module._sync_params_from_exec()
+        arrays = {f"arg:{n}": v for n, v in
+                  (module._arg_params or {}).items()}
+        arrays.update({f"aux:{n}": v for n, v in
+                       (module._aux_params or {}).items()})
+        blobs = {}
+        if save_optimizer_states and module.optimizer_initialized:
+            states = module.get_optimizer_states()
+            if states is not None:
+                blobs["optimizer_states"] = states
+        return self.save(step, arrays=arrays, blobs=blobs,
+                         symbol=module.symbol, epoch=epoch, extra=extra,
+                         block=block)
+
+    def restore_module(self, step=None, load_optimizer_states=True,
+                       **module_kwargs):
+        """(Module, Checkpoint) rebuilt from a committed step.
+
+        The module arrives with params installed (bind + init_optimizer
+        as usual); optimizer state is applied on ``init_optimizer``.
+        """
+        ckpt = self.restore(step)
+        if ckpt.symbol_json is None:
+            raise CheckpointError(
+                f"step {ckpt.step} holds no symbol; restore_module needs "
+                "a checkpoint written by save_module")
+        from ..module import Module
+        from ..symbol import load_json
+        mod = Module(symbol=load_json(ckpt.symbol_json), **module_kwargs)
+        mod._arg_params = ckpt.arg_params
+        mod._aux_params = ckpt.aux_params
+        mod.params_initialized = True
+        states = ckpt.blobs.get("optimizer_states")
+        if load_optimizer_states and states is not None:
+            mod._preload_opt_states_bytes = states
+        return mod, ckpt
+
+    # -- read side ----------------------------------------------------------
+    def restore(self, step=None, verify=None, fallback=True):
+        """Load a committed checkpoint (latest when ``step`` is None),
+        verifying checksums and falling back to the previous committed
+        step on corruption (auto-latest only)."""
+        t0 = time.perf_counter()
+        if verify is None:
+            verify = _cfg("MXNET_CKPT_VERIFY_ON_LOAD")
+        ckpt = restore(self.directory, step=step, verify=verify,
+                       fallback=fallback, logger=self.logger)
+        self._stats["last_restore_s"] = time.perf_counter() - t0
+        return ckpt
+
+    def latest(self):
+        """Newest committed step number (None when empty)."""
+        return latest_step(self.directory)
+
+    def steps(self):
+        """All committed step numbers, ascending."""
+        return committed_steps(self.directory)
+
+    # -- lifecycle ----------------------------------------------------------
+    def wait(self, timeout=None):
+        """Block until every pending async save committed; re-raises the
+        first writer failure."""
+        with self._lock:
+            pending = list(self._pending)
+            self._pending = [f for f in self._pending if not f.done()]
+        exc = None
+        for fut in pending:
+            try:
+                fut.result(timeout)
+            except Exception as e:
+                if exc is None:
+                    exc = e
+        with self._lock:
+            self._pending = [f for f in self._pending if not f.done()]
+        if exc is not None:
+            raise exc
+
+    def stats(self):
+        """Save/restore latency + volume counters (bench + tests)."""
+        return dict(self._stats)
+
+    def close(self):
+        """Flush pending saves and stop the writer thread."""
+        if self._closed:
+            return
+        try:
+            self.wait()
+        finally:
+            self._closed = True
+            if self._writer is not None and self._writer.is_alive():
+                self._queue.put(None)
+                self._writer.join(timeout=30)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,85 @@
+"""Checkpoint smoke for CI: save -> SIGKILL the writer mid-save ->
+restore -> verify (ci/run.sh).
+
+A child process commits step 1, then starts saving step 2 with
+MXNET_CKPT_WRITE_DELAY_MS widening the ``step-000002.tmp`` window; the
+parent SIGKILLs it the moment the tmp directory appears.  The atomic-
+commit contract under test: ``latest()`` still points at step 1, its
+checksums verify, and a fresh manager over the same directory sweeps the
+residue and commits step 2 cleanly.
+
+Run: JAX_PLATFORMS=cpu python -m mxnet_tpu.checkpoint.smoke
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+_VICTIM = """
+import os, sys
+import numpy as np
+from mxnet_tpu.checkpoint import CheckpointManager
+
+d = sys.argv[1]
+mgr = CheckpointManager(d, keep_last=0)
+arrs = {"w%d" % i: np.full((256, 256), float(i), np.float32)
+        for i in range(8)}
+mgr.save(1, arrays=arrs, extra={"phase": "committed"}, block=True)
+print("STEP1-COMMITTED", flush=True)
+os.environ["MXNET_CKPT_WRITE_DELAY_MS"] = "400"
+mgr.save(2, arrays=arrs, block=True)   # parent kills us mid-write
+print("STEP2-COMMITTED", flush=True)   # must never print
+"""
+
+
+def main():
+    from . import (CheckpointCorruptError, CheckpointManager,
+                   committed_steps, restore, step_dir)
+    tmpdir = tempfile.mkdtemp(prefix="ckpt-smoke-")
+    script = os.path.join(tmpdir, "victim.py")
+    with open(script, "w") as f:
+        f.write(_VICTIM)
+    ckdir = os.path.join(tmpdir, "ckpt")
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=repo + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    proc = subprocess.Popen([sys.executable, script, ckdir], env=env,
+                            stdout=subprocess.PIPE, text=True)
+    try:
+        tmp_step2 = step_dir(ckdir, 2) + ".tmp"
+        deadline = time.time() + 120
+        while not os.path.isdir(tmp_step2):
+            assert proc.poll() is None, "victim exited before step-2 save"
+            assert time.time() < deadline, "step-2 tmp dir never appeared"
+            time.sleep(0.005)
+        proc.kill()  # SIGKILL mid-write: no cleanup, no atexit
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+    # the torn step-2 attempt must be invisible; step 1 must verify
+    assert committed_steps(ckdir) == [1], committed_steps(ckdir)
+    ckpt = restore(ckdir)  # checksum-verified
+    assert ckpt.step == 1 and ckpt.metadata["extra"]["phase"] == "committed"
+    np.testing.assert_array_equal(ckpt.arrays["w3"],
+                                  np.full((256, 256), 3.0, np.float32))
+
+    # a fresh manager sweeps the residue and step 2 commits cleanly
+    with CheckpointManager(ckdir, keep_last=0) as mgr:
+        assert not os.path.isdir(tmp_step2)
+        mgr.save(2, arrays={"w": np.ones((4,), np.float32)}, block=True)
+        assert mgr.steps() == [1, 2]
+        mgr.restore(2)
+    print("checkpoint smoke OK: torn save invisible, committed step "
+          "verified, recovery clean")
+
+
+if __name__ == "__main__":
+    main()

@@ -157,6 +157,31 @@ _register("MXNET_MODULE_PAD_PARTIAL_PREDICT", bool, True,
           "to the bound batch and slice outputs, instead of rebinding a "
           "new executor shape (serving-style bucketing on the module "
           "predict path)")
+# -- checkpoint --------------------------------------------------------------
+_register("MXNET_CKPT_ASYNC", bool, True,
+          "CheckpointManager: serialize/fsync on a background writer so "
+          "save() blocks the train loop only for the device->host "
+          "snapshot; 0 makes every save synchronous")
+_register("MXNET_CKPT_KEEP_LAST", int, 5,
+          "retention: committed checkpoint steps kept (older steps are "
+          "garbage-collected after each commit; 0 keeps everything)")
+_register("MXNET_CKPT_KEEP_EVERY", int, 0,
+          "retention: additionally keep every Nth step forever "
+          "(step %% N == 0); 0 disables")
+_register("MXNET_CKPT_VERIFY_ON_LOAD", bool, True,
+          "verify per-file sha256 checksums on restore; a mismatch "
+          "raises CheckpointCorruptError (auto-latest restores fall "
+          "back to the previous committed step)")
+_register("MXNET_CKPT_WRITE_DELAY_MS", float, 0.0,
+          "test/debug: sleep this long between tensor writes and before "
+          "the manifest, widening the step-NNNNNN.tmp window for "
+          "crash-during-save tests (ci checkpoint smoke)")
+_register("MXNET_CKPT_WATCH_INTERVAL_S", float, 1.0,
+          "serving ModelRepository.watch poll period for newly "
+          "committed checkpoint steps")
+_register("MXNET_CKPT_COMMIT_TIMEOUT_S", float, 60.0,
+          "multi-host commit: how long host 0 waits for every host's "
+          "shard manifest before failing the save")
 # -- driver / bench ---------------------------------------------------------
 _register("MX_DRYRUN_TIMEOUT", float, 900.0,
           "subprocess timeout for __graft_entry__.dryrun_multichip")
@@ -184,3 +209,8 @@ _register("BENCH_SERVE_BATCH", int, 32,
           "bench.py serving phase: DynamicBatcher max_batch_size")
 _register("BENCH_SERVE_LATENCY_MS", float, 10.0,
           "bench.py serving phase: DynamicBatcher max_latency_ms")
+_register("BENCH_CKPT", bool, True,
+          "bench.py: also measure checkpoint save-blocking time and "
+          "restore latency (ckpt_save_blocking_ms / ckpt_restore_s)")
+_register("BENCH_CKPT_MB", int, 64,
+          "bench.py checkpoint phase: synthetic state size in MB")

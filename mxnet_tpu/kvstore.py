@@ -180,15 +180,31 @@ class KVStore:
         KVStore::get_num_dead_node, include/mxnet/kvstore.h:353)."""
         return 0
 
-    def save_optimizer_states(self, fname, dump_optimizer=False):
+    def get_optimizer_states(self, dump_optimizer=False):
+        """Optimizer state as bytes — the file-free primitive the
+        checkpoint subsystem stores in its manifest-tracked blobs (dist
+        stores fetch from the server, where the updater actually ran)."""
         assert self._updater is not None, "updater is not set"
-        with open(fname, "wb") as fout:
-            fout.write(self._updater.get_states(dump_optimizer))
+        return self._updater.get_states(dump_optimizer)
+
+    def set_optimizer_states(self, states):
+        """Install optimizer state bytes (inverse of
+        get_optimizer_states)."""
+        assert self._updater is not None, "updater is not set"
+        self._updater.set_states(states)
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        data = self.get_optimizer_states(dump_optimizer)
+        # atomic temp + os.replace: same no-torn-writes contract as
+        # nd.save / the checkpoint subsystem
+        tmp = f"{fname}.tmp-{os.getpid()}"
+        with open(tmp, "wb") as fout:
+            fout.write(data)
+        os.replace(tmp, fname)
 
     def load_optimizer_states(self, fname):
-        assert self._updater is not None, "updater is not set"
         with open(fname, "rb") as f:
-            self._updater.set_states(f.read())
+            self.set_optimizer_states(f.read())
 
     # -- compression / barrier --------------------------------------------
     def set_gradient_compression(self, compression_params):
@@ -543,6 +559,23 @@ class KVStoreDist(KVStore):
             self._client.send_command("set_optimizer",
                                       pickle.dumps(optimizer))
         self._client.barrier()
+
+    def get_optimizer_states(self, dump_optimizer=False):
+        """Dist resume: fetch the SERVER-side optimizer state (that is
+        where update_on_kvstore ran the updater), so a rank-0 checkpoint
+        can capture momentum/Adam state that never existed worker-side."""
+        if self._client is None:
+            return super().get_optimizer_states(dump_optimizer)
+        resp = self._client.command("get_optimizer_states",
+                                    pickle.dumps(bool(dump_optimizer)))
+        return resp["value"]
+
+    def set_optimizer_states(self, states):
+        """Dist resume: install checkpointed optimizer state into the
+        live server (requires set_optimizer to have run there)."""
+        if self._client is None:
+            return super().set_optimizer_states(states)
+        self._client.command("set_optimizer_states", states)
 
     def _send_command_to_servers(self, head, body):
         """Generic server command (parity: KVStore::SendCommandToServers,

@@ -320,6 +320,34 @@ class KVServer:
                         _u(key, g, w)
                         stored_np[...] = w.asnumpy()
                     self.updater = np_updater
+                    self._updater_obj = updater
+                elif head == "get_optimizer_states":
+                    # dist checkpoint/resume: the updater state lives
+                    # HERE (update_on_kvstore), so rank 0 fetches it over
+                    # the wire for the checkpoint blob
+                    u = getattr(self, "_updater_obj", None)
+                    if u is None:
+                        _send_msg(conn, {"ok": False,
+                                         "error": "no optimizer installed"},
+                                  self.auth_token)
+                    else:
+                        dump = bool(pickle.loads(body)) if body else False
+                        with self._lock:
+                            states = u.get_states(dump)
+                        _send_msg(conn, {"ok": True, "value": states},
+                                  self.auth_token)
+                    continue
+                elif head == "set_optimizer_states":
+                    u = getattr(self, "_updater_obj", None)
+                    if u is None:
+                        _send_msg(conn, {"ok": False,
+                                         "error": "no optimizer installed"},
+                                  self.auth_token)
+                    else:
+                        with self._lock:
+                            u.set_states(body)
+                        _send_msg(conn, {"ok": True}, self.auth_token)
+                    continue
                 elif head == "stop":
                     self._stop.set()
                 elif self.controller is not None and \
@@ -528,6 +556,11 @@ class KVClient:
 
     def send_command(self, head, body):
         self._rpc({"op": "command", "head": head, "body": body})
+
+    def command(self, head, body):
+        """A server command whose REPLY matters (e.g.
+        get_optimizer_states returns {"value": bytes})."""
+        return self._rpc({"op": "command", "head": head, "body": body})
 
     def stop_server(self):
         self._rpc({"op": "command", "head": "stop", "body": b""})

@@ -260,22 +260,54 @@ class Module(BaseModule):
             mod._preload_opt_states = f"{prefix}-{epoch:04d}.states"
         return mod
 
-    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False,
+                        manager=None):
         """Save symbol + params (+ optimizer states)
-        (parity: module.py save_checkpoint)."""
+        (parity: module.py save_checkpoint).
+
+        With ``manager`` (a checkpoint.CheckpointManager), the save
+        routes through the async/atomic subsystem instead — params +
+        optimizer state + step land in a committed ``step-NNNN/`` dir,
+        and the manager's ``legacy_prefix`` mirror (when configured)
+        keeps the ``prefix-NNNN.params`` files readable."""
+        if manager is not None:
+            return manager.save_module(
+                self, epoch, save_optimizer_states=save_optimizer_states,
+                epoch=epoch)
         self._sync_params_from_exec()
         save_checkpoint(prefix, epoch, self.symbol, self._arg_params,
                         self._aux_params)
         if save_optimizer_states:
-            fname = f"{prefix}-{epoch:04d}.states"
-            if getattr(self, "_update_on_kvstore", False) and \
-                    self._kvstore is not None:
-                # the real optimizer state lives IN the store (server
-                # side for dist) — the local updater never ran
-                self._kvstore.save_optimizer_states(fname)
-            elif self._updater is not None:
-                with open(fname, "wb") as f:
-                    f.write(self._updater.get_states())
+            states = self.get_optimizer_states()
+            if states is not None:
+                fname = f"{prefix}-{epoch:04d}.states"
+                tmp = f"{fname}.tmp-{os.getpid()}"
+                with open(tmp, "wb") as f:
+                    f.write(states)
+                os.replace(tmp, fname)
+
+    def get_optimizer_states(self, dump_optimizer=False):
+        """Optimizer state as bytes (None when nothing to save).  Under
+        update_on_kvstore the real state lives IN the store (server-side
+        for dist) — the local updater never ran — so it is fetched from
+        there (parity: module.py save_optimizer_states)."""
+        if getattr(self, "_update_on_kvstore", False) and \
+                self._kvstore is not None:
+            return self._kvstore.get_optimizer_states(dump_optimizer)
+        if self._updater is not None:
+            return self._updater.get_states(dump_optimizer)
+        return None
+
+    def set_optimizer_states(self, states):
+        """Install optimizer state bytes (inverse of
+        ``get_optimizer_states``); requires init_optimizer first."""
+        assert self.optimizer_initialized, \
+            "call init_optimizer before restoring optimizer states"
+        if getattr(self, "_update_on_kvstore", False) and \
+                self._kvstore is not None:
+            self._kvstore.set_optimizer_states(states)
+        else:
+            self._updater.set_states(states)
 
     # -- bind / params -----------------------------------------------------
     def bind(self, data_shapes, label_shapes=None, for_training=True,
@@ -447,6 +479,12 @@ class Module(BaseModule):
                 with open(self._preload_opt_states, "rb") as f:
                     self._updater.set_states(f.read())
             del self._preload_opt_states
+        if hasattr(self, "_preload_opt_states_bytes"):
+            # checkpoint.CheckpointManager.restore_module stashes the
+            # optimizer blob here; it can only be applied once the
+            # updater/kvstore exists
+            self.set_optimizer_states(self._preload_opt_states_bytes)
+            del self._preload_opt_states_bytes
 
     # -- compute -----------------------------------------------------------
     def forward(self, data_batch, is_train=None):

@@ -1,0 +1,28 @@
+"""jax shard_map across jax versions.
+
+jax >= 0.5 exports ``jax.shard_map`` (replication checking controlled by
+``check_vma=``); jax < 0.5 keeps it in ``jax.experimental.shard_map``
+where the same knob is spelled ``check_rep=``.  Import ``shard_map``
+from here and always pass ``check_vma=`` — the shim translates for old
+runtimes.
+"""
+from __future__ import annotations
+
+import functools
+import inspect
+
+try:
+    from jax import shard_map as _impl
+except ImportError:
+    from jax.experimental.shard_map import shard_map as _impl
+
+if "check_vma" in inspect.signature(_impl).parameters:
+    shard_map = _impl
+else:
+    @functools.wraps(_impl)
+    def shard_map(*args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _impl(*args, **kwargs)
+
+__all__ = ["shard_map"]

@@ -28,7 +28,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+from ._shard_map import shard_map
 
 from ..base import MXNetError
 from .mesh import DeviceMesh

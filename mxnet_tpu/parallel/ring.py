@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+from ._shard_map import shard_map
 
 from ..base import MXNetError
 from ..ops.pallas_attention import _flash_fwd, _use_interpret, _NEG_INF

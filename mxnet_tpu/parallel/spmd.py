@@ -397,3 +397,72 @@ class TrainStep:
             p = self.block.collect_params()[name]
             d = p.data()
             d._set_data(jnp.asarray(arr, dtype=d.dtype))
+
+    # -- checkpointing (mxnet_tpu.checkpoint integration) -------------------
+    def state_dict(self):
+        """{name: jax.Array} of the full training state, still sharded on
+        the mesh: ``param:<name>`` for every parameter (trainable + aux)
+        and ``opt:<name>:<j>`` per optimizer-state slot.  The checkpoint
+        manager snapshots each array shard-wise, so every host saves only
+        the shards it owns."""
+        d = {}
+        for name, arr in zip(self.param_names, self.params):
+            d[f"param:{name}"] = arr
+        for i, st in zip(self._train_idx, self.opt_state):
+            name = self.param_names[i]
+            for j, s in enumerate(st):
+                d[f"opt:{name}:{j}"] = s
+        return d
+
+    def save_checkpoint(self, manager, step, block=None, extra=None):
+        """Checkpoint params + optimizer state + step through a
+        checkpoint.CheckpointManager (async by default: the train loop
+        blocks only for the device->host shard snapshot)."""
+        return manager.save(step, arrays=self.state_dict(),
+                            mesh=self.mesh, extra=extra, block=block)
+
+    def load_state_dict(self, arrays):
+        """Install a restored state dict ({name: host np.ndarray}) onto
+        THIS TrainStep's mesh — the elastic half of restore: the arrays
+        were assembled from whatever dp×tp×pp layout saved them, and are
+        re-sharded here onto the current layout bit-identically."""
+        def _take(key, like, sharding):
+            arr = arrays.get(key)
+            if arr is None:
+                raise MXNetError(f"checkpoint is missing tensor {key!r}")
+            if tuple(arr.shape) != tuple(like.shape):
+                raise MXNetError(
+                    f"checkpoint tensor {key!r} has shape {arr.shape}, "
+                    f"expected {tuple(like.shape)}")
+            return jax.device_put(arr.astype(like.dtype), sharding)
+
+        new_train, new_aux, new_state = [], [], []
+        for k, i in enumerate(self._train_idx):
+            name = self.param_names[i]
+            w = _take(f"param:{name}", self._train_params[k],
+                      self._param_sh[i])
+            new_train.append(w)
+            st = []
+            for j, s in enumerate(self.opt_state[k]):
+                st.append(_take(f"opt:{name}:{j}", s, self._param_sh[i]))
+            new_state.append(tuple(st))
+        for k, i in enumerate(self._aux_idx):
+            name = self.param_names[i]
+            new_aux.append(_take(f"param:{name}", self._aux_params[k],
+                                 self._param_sh[i]))
+        self._train_params = tuple(new_train)
+        self._aux_params = tuple(new_aux)
+        self.opt_state = tuple(new_state)
+
+    def restore_checkpoint(self, source, step=None):
+        """Restore from a CheckpointManager or a checkpoint directory
+        saved by ANY mesh layout; returns the Checkpoint (step,
+        metadata).  Params + optimizer state land re-sharded onto this
+        TrainStep's mesh."""
+        if hasattr(source, "restore"):
+            ckpt = source.restore(step)
+        else:
+            from ..checkpoint import restore as _restore
+            ckpt = _restore(str(source), step=step)
+        self.load_state_dict(ckpt.arrays)
+        return ckpt

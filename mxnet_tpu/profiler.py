@@ -34,6 +34,7 @@ _state = {"running": False, "jax_trace_dir": None, "dump_timer": None,
           "kvstore": None, "last_mem_sample": 0.0}
 _records = []
 _records_lock = threading.Lock()
+_last_counters = {}
 _t0 = None
 
 KWARGS = _config  # parity alias
@@ -191,8 +192,13 @@ def record_counter(name, value, args_key="value"):
     """Append one counter-lane sample ("C" event) to the trace (parity:
     the reference profiler's counter lanes, src/profiler/profiler.h
     ProfileCounter).  Module-level entry point so subsystems (serving
-    metrics, storage, …) can emit counters without holding a Domain/
-    Counter object; no-op while the profiler is stopped."""
+    metrics, checkpoint, storage, …) can emit counters without holding a
+    Domain/Counter object.  The last value per counter is always kept
+    (``last_counters()``) so bench/monitoring can read e.g.
+    ``checkpoint:save_blocking_ms`` without a running trace; trace
+    events are only appended while the profiler runs."""
+    with _records_lock:
+        _last_counters[name] = value
     if not _state["running"]:
         return
     with _records_lock:
@@ -201,6 +207,15 @@ def record_counter(name, value, args_key="value"):
             "ts": (time.perf_counter() - _t0) * 1e6,
             "pid": os.getpid(), "args": {args_key: value},
         })
+
+
+def last_counters():
+    """Snapshot of the most recent value of every counter ever recorded
+    (e.g. ``checkpoint:save_blocking_ms``, ``serving:*``) — maintained
+    even while the profiler is stopped, so save-latency/bytes lanes are
+    observable without arming a trace."""
+    with _records_lock:
+        return dict(_last_counters)
 
 
 def record_api(name, dur_us=0.0):

@@ -93,6 +93,7 @@ class ModelRepository:
         self._lock = threading.Lock()
         self._models = {}   # name -> {version -> _ModelVersion}
         self._latest = {}   # name -> int
+        self._watchers = {}  # name -> (thread, stop Event)
 
     def load(self, name, symbol=None, params=None, prefix=None, block=None,
              epoch=0, version=None):
@@ -164,3 +165,83 @@ class ModelRepository:
             if name not in self._latest:
                 raise MXNetError(f"repository: unknown model {name!r}")
             return self._latest[name]
+
+    # -- checkpoint-directory hot reload ------------------------------------
+    def poll_checkpoint(self, name, ckpt_dir):
+        """One poll of a checkpoint directory: when a step newer than the
+        currently served version has COMMITTED, load it as a new version
+        (version number == step) and return the step; else None.
+
+        Only committed steps are ever considered — ``latest_step``
+        cannot see a ``step-NNNNNN.tmp/`` in progress, and checksums are
+        verified before the version goes live, so a torn or corrupt
+        checkpoint is never served (ISSUE 2 satellite).
+        """
+        from ..checkpoint import latest_step, restore
+        from ..symbol import load_json
+        step = latest_step(ckpt_dir)
+        with self._lock:
+            current = self._latest.get(name, 0)
+        if step is None or step <= current:
+            return None
+        ckpt = restore(ckpt_dir, step=step)  # verifies checksums
+        if ckpt.symbol_json is None:
+            raise MXNetError(
+                f"repository.watch: checkpoint step {ckpt.step} in "
+                f"{ckpt_dir!r} holds no symbol — save it via "
+                "CheckpointManager.save_module (or pass symbol=) so the "
+                "server knows the graph")
+        params = {}
+        params.update(ckpt.arg_params)
+        params.update(ckpt.aux_params)
+        if not params:  # unprefixed tensor names: serve them as-is
+            params = ckpt.as_ndarrays()
+        self.load(name, symbol=load_json(ckpt.symbol_json), params=params,
+                  version=ckpt.step)
+        return ckpt.step
+
+    def watch(self, name, ckpt_dir, interval=None):
+        """Hot-reload ``name`` from a CheckpointManager directory: a
+        background poller picks up each newly committed step and loads
+        it as a new version (in-flight batches finish on the version
+        they resolved; the next batch serves the new step).  Returns the
+        stop Event; ``unwatch(name)`` also stops it."""
+        if interval is None:
+            from ..config import get as _cfg
+            interval = _cfg("MXNET_CKPT_WATCH_INTERVAL_S")
+        self.unwatch(name)
+        stop = threading.Event()
+
+        def _poll_loop():
+            import logging
+            while not stop.is_set():
+                try:
+                    self.poll_checkpoint(name, ckpt_dir)
+                except Exception:  # keep serving the current version
+                    logging.getLogger("mxnet_tpu.serving").exception(
+                        "watch(%r): poll of %r failed", name, ckpt_dir)
+                stop.wait(interval)
+
+        t = threading.Thread(target=_poll_loop, daemon=True,
+                             name=f"ckpt-watch-{name}")
+        with self._lock:
+            self._watchers[name] = (t, stop)
+        t.start()
+        return stop
+
+    def unwatch(self, name):
+        """Stop the checkpoint watcher for ``name`` (no-op when absent)."""
+        with self._lock:
+            entry = self._watchers.pop(name, None)
+        if entry is not None:
+            t, stop = entry
+            stop.set()
+            if t.is_alive():
+                t.join(timeout=5)
+
+    def stop_watches(self):
+        """Stop every active checkpoint watcher."""
+        with self._lock:
+            names = list(self._watchers)
+        for n in names:
+            self.unwatch(n)

@@ -611,8 +611,20 @@ class Symbol:
         }, indent=2)
 
     def save(self, fname):
-        with open(fname, "w") as f:
-            f.write(self.tojson())
+        # atomic (temp + os.replace): a crash mid-save must not tear an
+        # existing symbol file (same contract as nd.save / checkpoint)
+        import os
+        tmp = f"{fname}.tmp-{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                f.write(self.tojson())
+            os.replace(tmp, fname)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
 
     # -- evaluation --------------------------------------------------------
     def bind(self, ctx, args, args_grad=None, grad_req="write", aux_states=None,

@@ -20,6 +20,7 @@ def _max_leaf_err(a, b):
         jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)))
 
 
+@pytest.mark.slow  # heavy grad/jit compile; excluded from the tier-1 budget
 def test_pp_tp_composed_train_step_matches_oracle():
     mesh = make_mesh(dp=2, tp=2, pp=2)
     e, f, heads, M, seq = 8, 16, 2, 2, 4

@@ -43,10 +43,11 @@ import numpy as np
 import mxnet_tpu as mx
 from mxnet_tpu import kvstore as kvs
 from mxnet_tpu import nd
+from mxnet_tpu.checkpoint import CheckpointManager, restore
 
 rank = int(os.environ["DMLC_RANK"])
 steps = int(sys.argv[1])
-ckpt = sys.argv[2]
+ckdir = sys.argv[2]  # CheckpointManager directory (rank-0 owned)
 out = sys.argv[3]
 resume_from = int(sys.argv[4])  # 0 = fresh start
 target = np.array(%(target)s, np.float32)
@@ -56,30 +57,37 @@ start = 0
 if resume_from:
     # elastic resume: attach() adopts server state without the init
     # barrier (peers may have moved on or exited); step counter + params
-    # come from the rank-0 checkpoint
+    # come from the rank-0 checkpoint.  The replacement reads via the
+    # module-level restore() — only rank 0's manager owns the directory.
     kv.attach("w", nd.zeros((4,)))
-    saved = nd.load(ckpt)
-    meta = json.load(open(ckpt + ".meta"))
-    start = int(meta["step"])
-    assert np.isfinite(saved["w"].asnumpy()).all()
+    ck = restore(ckdir)  # checksum-verified, committed steps only
+    start = ck.step
+    assert np.isfinite(ck.arrays["w"]).all()
+    blob = ck.blobs.get("optimizer_states")
+    if blob is not None:
+        # dist resume of the SERVER-side optimizer state captured by
+        # rank 0's checkpoint (kvstore get/set_optimizer_states)
+        kv.set_optimizer_states(blob)
 else:
     kv.init("w", nd.zeros((4,)))
     # the server keeps the optimizer across worker restarts, and
     # set_optimizer barriers the full group — fresh workers only
     kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.05))
 
+mgr = CheckpointManager(ckdir, keep_last=3) if rank == 0 else None
 w = nd.zeros((4,))
 for step in range(start, steps):
     kv.pull("w", out=w)
     grad = 2.0 * (w.asnumpy() - target)
     kv.push("w", nd.array(grad))
     if rank == 0:
-        nd.save(ckpt, {"w": w})
-        with open(ckpt + ".meta", "w") as f:
-            json.dump({"step": step + 1}, f)
+        blobs = {"optimizer_states": kv.get_optimizer_states()}
+        mgr.save(step + 1, arrays={"w": w}, blobs=blobs, block=True)
     time.sleep(0.04)
 kv.pull("w", out=w)
 np.save(out, w.asnumpy())
+if mgr is not None:
+    mgr.close()
 """
 
 
@@ -95,15 +103,16 @@ def test_worker_sigkill_detected_and_training_resumes(tmp_path):
     script = str(tmp_path / "train_worker.py")
     with open(script, "w") as f:
         f.write(_TRAIN_WORKER % {"target": TARGET})
-    ckpt = str(tmp_path / "ckpt.params")
+    ckdir = str(tmp_path / "ckpt")
     outs = [str(tmp_path / f"w{r}.npy") for r in range(num_workers)]
 
     def spawn(rank, resume):
         return subprocess.Popen(
-            [sys.executable, script, str(steps), ckpt, outs[rank],
+            [sys.executable, script, str(steps), ckdir, outs[rank],
              str(int(resume))],
             env=_worker_env(port, rank, num_workers))
 
+    from mxnet_tpu.checkpoint import latest_step
     monitor = None
     procs = [spawn(0, False), spawn(1, False)]
     try:
@@ -111,7 +120,7 @@ def test_worker_sigkill_detected_and_training_resumes(tmp_path):
                            heartbeat_interval=0)
         # let training get going, then SIGKILL rank 1 mid-train
         deadline = time.time() + 20
-        while not os.path.exists(ckpt + ".meta"):
+        while latest_step(ckdir) is None:
             assert time.time() < deadline, "training never started"
             time.sleep(0.1)
         time.sleep(0.5)
@@ -125,21 +134,21 @@ def test_worker_sigkill_detected_and_training_resumes(tmp_path):
                 "dead worker never detected via heartbeats"
             time.sleep(0.2)
 
-        # RECOVERY: a replacement rank-1 worker resumes from checkpoint
-        # (per-rank heartbeat revival itself is pinned by
+        # RECOVERY: a replacement rank-1 worker resumes from the manager
+        # checkpoint — params + step + the SERVER-side optimizer-state
+        # blob (per-rank heartbeat revival itself is pinned by
         # test_heartbeat_dead_node_detection; after graceful completion
         # every rank's heartbeat goes stale again by design, so the
         # aggregate count cannot distinguish 'replacement alive' once
         # rank 0 finishes)
-        import json
-        kill_step = json.load(open(ckpt + ".meta"))["step"]
+        kill_step = latest_step(ckdir)
         procs[1] = spawn(1, True)
         for p in procs:
             assert p.wait(timeout=120) == 0
         # the run really CONTINUED from the checkpoint: rank 0 kept
-        # checkpointing past the step at which rank 1 was killed
-        assert json.load(open(ckpt + ".meta"))["step"] >= kill_step
-        assert json.load(open(ckpt + ".meta"))["step"] == steps
+        # committing steps past the one at which rank 1 was killed
+        assert latest_step(ckdir) >= kill_step
+        assert latest_step(ckdir) == steps
     finally:
         for p in procs:
             if p.poll() is None:
@@ -226,3 +235,47 @@ def test_dist_async_staleness_different_rates(tmp_path):
     # after the barrier both workers see the same converged state
     np.testing.assert_array_equal(final[0], final[1])
     np.testing.assert_allclose(final[0], TARGET, atol=0.05)
+
+
+def test_dist_optimizer_states_roundtrip_via_server():
+    """The kvstore get/set_optimizer_states wire pair (dist resume): a
+    momentum optimizer's SERVER-side state is fetchable as bytes for the
+    checkpoint blob, and installable into a live server again."""
+    import pickle
+    from mxnet_tpu.kvstore_server import KVClient, KVServer
+    port = 19697
+    server = KVServer(port=port, num_workers=1)
+    threading.Thread(target=server.run, daemon=True).start()
+    time.sleep(0.2)
+    cl = None
+    try:
+        cl = KVClient("127.0.0.1", port, rank=0, num_workers=1,
+                      heartbeat_interval=0)
+        # before set_optimizer there is nothing to fetch
+        with pytest.raises(RuntimeError):
+            cl.command("get_optimizer_states", pickle.dumps(False))
+        import mxnet_tpu as mx
+        cl.send_command("set_optimizer", pickle.dumps(
+            mx.optimizer.SGD(learning_rate=0.1, momentum=0.9)))
+        cl.init("w", np.zeros(4, np.float32))
+        cl.push("w", np.ones(4, np.float32))  # creates momentum state
+        states = cl.command("get_optimizer_states",
+                            pickle.dumps(False))["value"]
+        d = pickle.loads(states)
+        assert "w" in d
+        mom = d["w"][0] if isinstance(d["w"], (tuple, list)) else d["w"]
+        assert np.abs(mom.asnumpy()).sum() > 0  # momentum actually moved
+        # install back into the live server (the dist resume path)
+        cl.command("set_optimizer_states", states)
+        again = pickle.loads(cl.command("get_optimizer_states",
+                                        pickle.dumps(False))["value"])
+        m2 = again["w"][0] if isinstance(again["w"], (tuple, list)) \
+            else again["w"]
+        np.testing.assert_array_equal(mom.asnumpy(), m2.asnumpy())
+    finally:
+        if cl is not None:
+            try:
+                cl.close()
+            except Exception:
+                pass
+        server._stop.set()

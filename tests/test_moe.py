@@ -119,6 +119,7 @@ def test_moe_trains(setup):
     assert float(l) < first * 0.8, (first, float(l))
 
 
+@pytest.mark.slow  # heavy grad/jit compile; excluded from the tier-1 budget
 def test_gluon_moe_dense_block():
     """The gluon-facing MoEDense block (op _contrib_MoEFFN) trains with
     autograd + Trainer and matches the functional dense MoE."""

@@ -104,6 +104,7 @@ def test_quantize_net_small_cnn():
     assert rel < 0.05
 
 
+@pytest.mark.slow  # multi-minute convergence/calibration run; outside the tier-1 budget
 @pytest.mark.parametrize("calib_mode,min_agree", [("naive", 0.99),
                                                   ("entropy", 0.85)])
 def test_quantize_resnet18_within_1pct(calib_mode, min_agree):

@@ -638,3 +638,81 @@ def test_partial_batch_slices_only_batch_carrying_outputs():
     assert gram_out.shape == (6, 6)        # non-batch output: untouched
     np.testing.assert_allclose(gram_out.asnumpy(), x[:2].T @ x[:2],
                                rtol=1e-4, atol=1e-5)
+
+
+# -- checkpoint-directory hot reload (ISSUE 2 satellite) --------------------
+def test_repository_watch_serves_only_committed_checkpoints(tmp_path):
+    """ModelRepository.poll_checkpoint picks up newly COMMITTED steps as
+    new versions; an in-progress ``step-NNNNNN.tmp/`` is never served."""
+    import os
+    from mxnet_tpu.checkpoint import CheckpointManager, step_dir
+    from mxnet_tpu.module import Module
+
+    net = _mlp()
+    ckdir = str(tmp_path / "ck")
+    repo = ModelRepository()
+    with CheckpointManager(ckdir, keep_last=0) as mgr:
+        params = {f"arg:{k}": p._reduce()
+                  for k, p in net.collect_params().items()}
+        if not getattr(net, "_cached_graph", None):
+            net._build_sym_graph()
+        sym = net._cached_graph[1]
+        mgr.save(1, arrays=params, symbol=sym, block=True)
+
+        # first poll loads step 1 as version 1
+        assert repo.poll_checkpoint("mlp", ckdir) == 1
+        assert repo.latest_version("mlp") == 1
+        # nothing new: no-op
+        assert repo.poll_checkpoint("mlp", ckdir) is None
+
+        # an in-progress step-2 tmp dir must NEVER be served
+        tmp2 = step_dir(ckdir, 2) + ".tmp"
+        os.makedirs(tmp2)
+        with open(os.path.join(tmp2, "data-00000-of-00001.bin"), "wb") as f:
+            f.write(b"torn")
+        assert repo.poll_checkpoint("mlp", ckdir) is None
+        assert repo.latest_version("mlp") == 1
+
+        # commit step 2 for real -> hot reload as version 2
+        mgr.save(2, arrays=params, symbol=sym, block=True)
+        assert repo.poll_checkpoint("mlp", ckdir) == 2
+        assert repo.latest_version("mlp") == 2
+        # the loaded version actually serves: bind + forward
+        mv = repo.get("mlp")
+        assert mv.version == 2 and mv.input_names == ["data"]
+
+
+def test_repository_watch_thread_hot_reloads(tmp_path):
+    """The background watcher picks up a commit within its poll period."""
+    import time as _time
+    from mxnet_tpu.checkpoint import CheckpointManager
+
+    net = _mlp()
+    if not getattr(net, "_cached_graph", None):
+        net._build_sym_graph()
+    sym = net._cached_graph[1]
+    params = {f"arg:{k}": p._reduce()
+              for k, p in net.collect_params().items()}
+    ckdir = str(tmp_path / "ck")
+    repo = ModelRepository()
+    with CheckpointManager(ckdir, keep_last=0) as mgr:
+        mgr.save(1, arrays=params, symbol=sym, block=True)
+        repo.watch("mlp", ckdir, interval=0.05)
+        try:
+            deadline = _time.time() + 10
+            while _time.time() < deadline:
+                try:
+                    if repo.latest_version("mlp") == 1:
+                        break
+                except MXNetError:
+                    pass
+                _time.sleep(0.02)
+            assert repo.latest_version("mlp") == 1
+            mgr.save(7, arrays=params, symbol=sym, block=True)
+            deadline = _time.time() + 10
+            while repo.latest_version("mlp") != 7:
+                assert _time.time() < deadline, \
+                    "watcher never picked up the committed step"
+                _time.sleep(0.02)
+        finally:
+            repo.unwatch("mlp")

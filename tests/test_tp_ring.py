@@ -80,6 +80,7 @@ def test_tp_transformer_block_matches_single_device():
     assert sp["wq"].sharding.shard_shape(sp["wq"].shape) == (e, e // 8)
 
 
+@pytest.mark.slow  # heavy grad/jit compile; excluded from the tier-1 budget
 def test_tp_on_mixed_mesh():
     key = jax.random.PRNGKey(2)
     e, f, h = 32, 64, 4
@@ -92,6 +93,7 @@ def test_tp_on_mixed_mesh():
     assert float(jnp.abs(out - ref).max()) < 1e-4
 
 
+@pytest.mark.slow  # heavy grad/jit compile; excluded from the tier-1 budget
 def test_tp_block_grads_match():
     key = jax.random.PRNGKey(4)
     e, f, h = 32, 64, 8
@@ -125,6 +127,7 @@ def test_ring_attention_matches_reference(causal):
     assert float(jnp.abs(out - ref).max()) < 3e-5
 
 
+@pytest.mark.slow  # heavy grad/jit compile; excluded from the tier-1 budget
 def test_ring_attention_grads():
     rng = np.random.RandomState(1)
     b, h, s, d = 1, 2, 32, 16
@@ -145,6 +148,7 @@ def test_ring_attention_grads():
         assert float(jnp.abs(got - want).max()) < 5e-4
 
 
+@pytest.mark.slow  # heavy grad/jit compile; excluded from the tier-1 budget
 def test_ring_attention_sp_partial_mesh():
     # sp combined with a dp axis: sequence sharded over 4, batch over 2
     rng = np.random.RandomState(2)

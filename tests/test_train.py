@@ -98,6 +98,7 @@ def _train_gluon(net, train, val, epochs, lr=0.05, dtype="float32",
     return metric.get()[1]
 
 
+@pytest.mark.slow  # multi-minute convergence/calibration run; outside the tier-1 budget
 def test_conv_convergence():
     """LeNet on the translated-patch set to >= 0.98 (ref train/test_conv.py).
 
