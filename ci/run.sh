@@ -14,6 +14,13 @@ echo "== generated code in sync =="
 python cpp-package/OpWrapperGenerator.py
 git diff --exit-code cpp-package/include/mxnet_tpu/op.hpp
 
+echo "== graftlint (project-native static analysis, baseline-gated) =="
+# lock-discipline / torn-write / host-sync / tracer-leak /
+# swallowed-error / env-knob-drift; fails only on NEW violations
+# (ci/graftlint_baseline.json holds triaged pre-existing debt).
+# docs/lint.md has the rule catalog and suppression syntax.
+python tools/graftlint.py --fail-on-new
+
 echo "== unit suite (virtual 8-device CPU mesh via tests/conftest.py) =="
 MXNET_TEST_EXAMPLES=1 python -m pytest tests/ -q
 

@@ -1,0 +1,23 @@
+"""graftlint — project-native static analysis for the mxnet_tpu codebase.
+
+A single-walk AST analysis framework plus the rules encoding this
+repository's own invariants (lock discipline, torn writes, host syncs in
+hot paths, tracer leaks, swallowed errors, env-knob drift).  See
+docs/lint.md for the rule catalog and ``tools/graftlint.py`` for the CLI.
+
+This package is deliberately stdlib-only: the CLI loads it without
+importing ``mxnet_tpu`` itself (no jax, no numpy), so linting stays
+cheap enough to run before the test phase in CI.
+"""
+from .core import (Context, Finding, Rule, all_rules, analyze_paths,
+                   analyze_source, diff_baseline, fingerprint_counts,
+                   load_baseline, make_rules, register_rule, render_json,
+                   render_text, write_baseline)
+from . import rules as _rules  # noqa: F401  — registers the rule classes
+
+__all__ = [
+    "Context", "Finding", "Rule", "all_rules", "analyze_paths",
+    "analyze_source", "diff_baseline", "fingerprint_counts",
+    "load_baseline", "make_rules", "register_rule", "render_json",
+    "render_text", "write_baseline",
+]

@@ -1,0 +1,386 @@
+"""graftlint core: rule registry, single-walk AST driver, suppressions,
+baseline mechanics, and report rendering.
+
+Design:
+
+* **Single walk.**  Each file is parsed once and traversed once; every
+  registered rule receives ``visit``/``depart`` callbacks on every node,
+  sharing one :class:`Context` (class/function stacks, lock depth, loop
+  depth).  Rules keep their own accumulators and usually report from
+  ``depart`` of a class/function once enough context has been seen.
+* **Suppressions.**  ``# graftlint: disable=<rule>[,<rule>...] -- reason``
+  on the flagged line (or the line directly above) silences those rules
+  for that line.  ``disable=all`` silences everything.  The reason text
+  after ``--`` is required by convention (reviewed, not enforced).
+* **Baseline.**  A committed JSON file maps finding *fingerprints*
+  (stable across line-number drift: rule + path + symbol) to occurrence
+  counts.  ``--fail-on-new`` fails only on findings whose fingerprint
+  count exceeds the baseline, so the debt ratchet only tightens.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+
+SEVERITIES = ("error", "warning", "info")
+
+_SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\-]+)")
+
+# substrings identifying an attribute/name as a synchronization object;
+# `with <lockish>:` bumps Context.lock_depth
+_LOCKISH_TOKENS = ("lock", "cond", "mutex")
+
+
+class Finding:
+    """One rule violation at one source location."""
+
+    __slots__ = ("rule", "severity", "path", "line", "col", "message",
+                 "symbol")
+
+    def __init__(self, rule, severity, path, line, col, message, symbol):
+        self.rule = rule
+        self.severity = severity
+        self.path = path
+        self.line = line
+        self.col = col
+        self.message = message
+        # symbol is the rule-chosen stable identity (attribute, env-var
+        # name, scope) — the part of the fingerprint that survives line
+        # drift, so baselines do not churn on unrelated edits
+        self.symbol = symbol
+
+    @property
+    def fingerprint(self):
+        return f"{self.rule}|{self.path}|{self.symbol}"
+
+    def as_dict(self):
+        return {"rule": self.rule, "severity": self.severity,
+                "path": self.path, "line": self.line, "col": self.col,
+                "message": self.message, "symbol": self.symbol,
+                "fingerprint": self.fingerprint}
+
+    def __repr__(self):
+        return (f"Finding({self.rule}, {self.path}:{self.line}, "
+                f"{self.symbol!r})")
+
+
+class Context:
+    """Shared traversal state handed to every rule callback."""
+
+    def __init__(self, path):
+        self.path = path.replace(os.sep, "/")
+        self.findings = []
+        self.class_stack = []   # ast.ClassDef nodes, outermost first
+        self.func_stack = []    # ast.FunctionDef/AsyncFunctionDef/Lambda
+        self.lock_depth = 0     # inside `with self._lock:` style blocks
+        self.loop_depth = 0     # inside for/while bodies, comprehensions
+
+    # -- rule-facing helpers -------------------------------------------------
+    @property
+    def current_class(self):
+        return self.class_stack[-1] if self.class_stack else None
+
+    @property
+    def current_func(self):
+        return self.func_stack[-1] if self.func_stack else None
+
+    def func_name(self):
+        f = self.current_func
+        if f is None:
+            return "<module>"
+        return getattr(f, "name", "<lambda>")
+
+    def in_lock(self):
+        return self.lock_depth > 0
+
+    def in_loop(self):
+        return self.loop_depth > 0
+
+    def report(self, rule, node, message, symbol=None):
+        scope = ".".join([c.name for c in self.class_stack]
+                         + [self.func_name()]
+                         if self.func_stack or self.class_stack else [])
+        sym = symbol if symbol is not None else scope or "<module>"
+        self.findings.append(Finding(
+            rule.id, rule.severity, self.path,
+            getattr(node, "lineno", 0), getattr(node, "col_offset", 0),
+            message, sym))
+
+
+class Rule:
+    """Base class: subclass, set ``id``/``severity``/``doc``, implement
+    the callbacks you need, and decorate with ``@register_rule``."""
+
+    id = ""
+    severity = "warning"
+    doc = ""
+
+    def begin_file(self, ctx):
+        """Reset per-file state."""
+
+    def visit(self, node, ctx):
+        """Called for every node, before its children."""
+
+    def depart(self, node, ctx):
+        """Called for every node, after its children."""
+
+    def end_file(self, ctx):
+        """Flush file-level findings."""
+
+
+_RULES = {}
+
+
+def register_rule(cls):
+    if not cls.id:
+        raise ValueError(f"rule {cls.__name__} has no id")
+    if cls.severity not in SEVERITIES:
+        raise ValueError(f"rule {cls.id}: bad severity {cls.severity!r}")
+    _RULES[cls.id] = cls
+    return cls
+
+
+def all_rules():
+    """{rule_id: rule class} for every registered rule."""
+    return dict(_RULES)
+
+
+def make_rules(select=None, disable=()):
+    """Fresh rule instances (rules are stateful within a run)."""
+    ids = list(_RULES)
+    if select:
+        unknown = set(select) - set(ids)
+        if unknown:
+            raise ValueError(f"unknown rules: {sorted(unknown)}")
+        ids = [i for i in ids if i in set(select)]
+    ids = [i for i in ids if i not in set(disable)]
+    return [_RULES[i]() for i in ids]
+
+
+# -- lock detection shared by core and rules ---------------------------------
+def is_lockish_name(name):
+    low = name.lower()
+    return (any(t in low for t in _LOCKISH_TOKENS)
+            or low.endswith("_cv") or low == "cv")
+
+
+def _is_lockish_expr(expr):
+    if isinstance(expr, ast.Attribute):
+        return is_lockish_name(expr.attr)
+    if isinstance(expr, ast.Name):
+        return is_lockish_name(expr.id)
+    return False
+
+
+# -- the single walk ---------------------------------------------------------
+_LOOP_NODES = (ast.While,)
+_COMP_NODES = (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _walk(node, ctx, rules):
+    is_class = isinstance(node, ast.ClassDef)
+    is_func = isinstance(node, _FUNC_NODES)
+    is_loop = isinstance(node, _LOOP_NODES)
+    is_for = isinstance(node, (ast.For, ast.AsyncFor))
+    is_comp = isinstance(node, _COMP_NODES)
+    lockish = (isinstance(node, (ast.With, ast.AsyncWith)) and
+               any(_is_lockish_expr(it.context_expr) for it in node.items))
+
+    if is_class:
+        ctx.class_stack.append(node)
+    if is_func:
+        ctx.func_stack.append(node)
+    if lockish:
+        ctx.lock_depth += 1
+
+    for r in rules:
+        r.visit(node, ctx)
+
+    if is_for:
+        # target/iter evaluate once, outside the loop body
+        _walk(node.target, ctx, rules)
+        _walk(node.iter, ctx, rules)
+        ctx.loop_depth += 1
+        for child in node.body + node.orelse:
+            _walk(child, ctx, rules)
+        ctx.loop_depth -= 1
+    elif is_comp:
+        # the first generator's source iterable evaluates once; the
+        # element expression and remaining clauses run per item
+        gen0 = node.generators[0]
+        _walk(gen0.iter, ctx, rules)
+        ctx.loop_depth += 1
+        _walk(gen0.target, ctx, rules)
+        for cond in gen0.ifs:
+            _walk(cond, ctx, rules)
+        for gen in node.generators[1:]:
+            _walk(gen.target, ctx, rules)
+            _walk(gen.iter, ctx, rules)
+            for cond in gen.ifs:
+                _walk(cond, ctx, rules)
+        if isinstance(node, ast.DictComp):
+            _walk(node.key, ctx, rules)
+            _walk(node.value, ctx, rules)
+        else:
+            _walk(node.elt, ctx, rules)
+        ctx.loop_depth -= 1
+    elif is_loop:
+        ctx.loop_depth += 1
+        for child in ast.iter_child_nodes(node):
+            _walk(child, ctx, rules)
+        ctx.loop_depth -= 1
+    else:
+        for child in ast.iter_child_nodes(node):
+            _walk(child, ctx, rules)
+
+    for r in rules:
+        r.depart(node, ctx)
+
+    if lockish:
+        ctx.lock_depth -= 1
+    if is_func:
+        ctx.func_stack.pop()
+    if is_class:
+        ctx.class_stack.pop()
+
+
+# -- suppressions ------------------------------------------------------------
+def _suppressions(source):
+    out = {}
+    for i, line in enumerate(source.splitlines(), 1):
+        m = _SUPPRESS_RE.search(line)
+        if m:
+            out[i] = {r.strip() for r in m.group(1).split(",") if r.strip()}
+    return out
+
+
+def _is_suppressed(finding, supp):
+    for ln in (finding.line, finding.line - 1):
+        rules = supp.get(ln)
+        if rules and ("all" in rules or finding.rule in rules):
+            return True
+    return False
+
+
+# -- entry points ------------------------------------------------------------
+def analyze_source(source, path="<string>", rules=None):
+    """Lint one source string; returns the (unsuppressed) findings."""
+    if rules is None:
+        rules = make_rules()
+    tree = ast.parse(source, filename=path)
+    ctx = Context(path)
+    for r in rules:
+        r.begin_file(ctx)
+    _walk(tree, ctx, rules)
+    for r in rules:
+        r.end_file(ctx)
+    supp = _suppressions(source)
+    return [f for f in ctx.findings if not _is_suppressed(f, supp)]
+
+
+def iter_py_files(paths):
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+        elif os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if not d.startswith(".")
+                                     and d != "__pycache__")
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        yield os.path.join(dirpath, fn)
+
+
+def analyze_paths(paths, rules=None, root=None):
+    """Lint every ``.py`` under ``paths``; paths in findings are made
+    relative to ``root`` (for stable fingerprints)."""
+    if rules is None:
+        rules = make_rules()
+    findings = []
+    errors = []
+    for path in iter_py_files(paths):
+        rel = os.path.relpath(path, root) if root else path
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            findings.extend(analyze_source(source, path=rel, rules=rules))
+        except (SyntaxError, UnicodeDecodeError) as e:
+            errors.append((rel, f"{type(e).__name__}: {e}"))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings, errors
+
+
+# -- baseline ----------------------------------------------------------------
+def fingerprint_counts(findings):
+    counts = {}
+    for f in findings:
+        counts[f.fingerprint] = counts.get(f.fingerprint, 0) + 1
+    return counts
+
+
+def load_baseline(path):
+    """{fingerprint: count} from a baseline file ({} when absent)."""
+    if not os.path.isfile(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    return {str(k): int(v) for k, v in doc.get("findings", {}).items()}
+
+
+def write_baseline(path, findings):
+    """Commit the current findings as the new baseline (atomic write)."""
+    doc = {
+        "comment": "graftlint baseline — regenerate with "
+                   "`python tools/graftlint.py --write-baseline`; "
+                   "--fail-on-new fails only findings not counted here, "
+                   "so this file should only ever shrink",
+        "findings": dict(sorted(fingerprint_counts(findings).items())),
+    }
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+def diff_baseline(findings, baseline):
+    """Split findings into (new, old) against a baseline count map.
+
+    The first ``baseline[fp]`` occurrences of each fingerprint are old
+    debt; anything beyond that is new and should fail the gate.
+    """
+    seen = {}
+    new, old = [], []
+    for f in findings:
+        idx = seen.get(f.fingerprint, 0)
+        seen[f.fingerprint] = idx + 1
+        (old if idx < baseline.get(f.fingerprint, 0) else new).append(f)
+    return new, old
+
+
+# -- rendering ---------------------------------------------------------------
+def render_text(findings, errors=(), title="graftlint"):
+    lines = []
+    for f in findings:
+        lines.append(f"{f.path}:{f.line}:{f.col}: [{f.severity}] "
+                     f"{f.rule}: {f.message}")
+    for path, msg in errors:
+        lines.append(f"{path}: [error] parse-error: {msg}")
+    by_rule = {}
+    for f in findings:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    summary = ", ".join(f"{r}={n}" for r, n in sorted(by_rule.items()))
+    lines.append(f"{title}: {len(findings)} finding(s)"
+                 + (f" ({summary})" if summary else ""))
+    return "\n".join(lines)
+
+
+def render_json(findings, errors=()):
+    return json.dumps({
+        "findings": [f.as_dict() for f in findings],
+        "parse_errors": [{"path": p, "message": m} for p, m in errors],
+    }, indent=1)

@@ -1,0 +1,105 @@
+"""env-knob-drift — every env knob must live in the typed registry.
+
+``mxnet_tpu/config.py`` is the single discoverable surface for the
+framework's environment variables (``mx.config.describe()`` renders the
+env_var.md table).  A raw ``os.environ.get("MXNET_...")`` whose name was
+never ``_register``-ed is invisible to users, undocumented, untyped, and
+untested — exactly how ``MXNET_COORDINATOR_URI`` and
+``MXNET_MP_START_METHOD`` drifted out of the docs.
+
+The rule statically parses the ``_register(...)`` calls out of
+``config.py`` (no import — the linter stays jax-free) and flags any
+literal read of a ``MXNET_*`` / ``BENCH_*`` / ``DMLC_*`` / ``MX_*``
+name not in that registry, via ``os.environ.get``, ``os.getenv``, or an
+``os.environ[...]`` subscript load.  Writes (``os.environ[k] = v``,
+tests priming knobs) and dynamic names are not reads and stay silent.
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+from ..core import Rule, register_rule
+
+_PREFIXES = ("MXNET_", "BENCH_", "DMLC_", "MX_")
+
+_CONFIG_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "config.py")
+
+
+def load_registered_names(config_path=None):
+    """Names passed to ``_register(...)`` in config.py (static parse)."""
+    path = config_path or _CONFIG_PATH
+    names = set()
+    try:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+    except (OSError, SyntaxError):
+        return names
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "_register"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            names.add(node.args[0].value)
+    return names
+
+
+def _env_read_name(node):
+    """Literal env-var name read by ``node``, or None."""
+    if isinstance(node, ast.Call):
+        func = node.func
+        # <anything>.environ.get("X") / <anything>.getenv("X")
+        is_environ_get = (isinstance(func, ast.Attribute)
+                          and func.attr == "get"
+                          and isinstance(func.value, ast.Attribute)
+                          and func.value.attr == "environ")
+        is_getenv = (isinstance(func, ast.Attribute)
+                     and func.attr == "getenv")
+        if (is_environ_get or is_getenv) and node.args \
+                and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            return node.args[0].value
+    elif isinstance(node, ast.Subscript) \
+            and isinstance(node.ctx, ast.Load) \
+            and isinstance(node.value, ast.Attribute) \
+            and node.value.attr == "environ" \
+            and isinstance(node.slice, ast.Constant) \
+            and isinstance(node.slice.value, str):
+        return node.slice.value
+    return None
+
+
+@register_rule
+class EnvDriftRule(Rule):
+    id = "env-knob-drift"
+    severity = "warning"
+    doc = ("MXNET_*/BENCH_*/DMLC_* env var read at a use site but never "
+           "registered in config.py")
+
+    def __init__(self, registered=None):
+        # tests inject a registry; production parses config.py once
+        self._registered = registered
+
+    @property
+    def registered(self):
+        if self._registered is None:
+            self._registered = load_registered_names()
+        return self._registered
+
+    def visit(self, node, ctx):
+        name = _env_read_name(node)
+        if name is None or not name.startswith(_PREFIXES):
+            return
+        if name in self.registered:
+            return
+        ctx.report(
+            self, node,
+            f"env var {name!r} is read here but not registered in "
+            "mxnet_tpu/config.py — register it (type, default, doc) so "
+            "config.describe() stays the complete knob surface, or "
+            "delete the dead read",
+            symbol=name)

@@ -1,0 +1,60 @@
+"""host-sync-in-hot-path — device→host round trips inside loops.
+
+On TPU the killer of serving/training throughput is an unnoticed
+blocking transfer: ``.asnumpy()`` / ``.asscalar()`` / ``.item()`` /
+``.block_until_ready()`` inside a per-request or per-batch loop
+serializes the device against the host once per iteration (the reason
+PR-1's batcher stages host arrays once per *batch*, and PR-2 snapshots
+device→host once per *save*).
+
+The rule fires only inside the repo's hot paths (serving, module/model
+execution, the SPMD train step) — a sync in offline tooling is fine —
+and only when the call is lexically inside a ``for``/``while`` body or
+a comprehension.  ``for``-loop iterables and a sync *outside* the loop
+(hoisted, batched) are near-misses and stay silent.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule, register_rule
+
+# modules whose loops are latency/throughput-critical
+HOT_PATH_PREFIXES = (
+    "mxnet_tpu/serving/",
+    "mxnet_tpu/module.py",
+    "mxnet_tpu/model.py",
+    "mxnet_tpu/executor.py",
+    "mxnet_tpu/gluon/trainer.py",
+    "mxnet_tpu/parallel/spmd.py",
+)
+
+_SYNC_METHODS = {"asnumpy", "asscalar", "item", "block_until_ready"}
+
+
+@register_rule
+class HostSyncRule(Rule):
+    id = "host-sync-in-hot-path"
+    severity = "warning"
+    doc = ("device->host sync (.asnumpy()/.item()/...) inside a loop in "
+           "serving/train-step code")
+
+    def begin_file(self, ctx):
+        self._hot = any(p in ctx.path for p in HOT_PATH_PREFIXES)
+
+    def visit(self, node, ctx):
+        if not self._hot or not ctx.in_loop():
+            return
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _SYNC_METHODS):
+            return
+        # dict.items() etc. — `.item` is the array method, `.items` is not
+        recv = ast.unparse(node.func.value)
+        ctx.report(
+            self, node,
+            f"{recv}.{node.func.attr}() inside a loop blocks on a "
+            "device->host transfer every iteration in a hot path — "
+            "hoist it out of the loop or batch the transfer "
+            "(one sync per batch, not per element)",
+            symbol=f"{ctx.func_name()}:{node.func.attr}")

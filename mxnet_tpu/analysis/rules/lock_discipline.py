@@ -1,0 +1,207 @@
+"""lock-discipline — the static race detector.
+
+Generalizes the PR-1 shared-executor race and the PR-2 background-writer
+stats races: in a class that guards state with a lock, every access to
+that state must hold the lock.
+
+Two prongs, both tuned on ``serving/executor_cache``, ``batcher``,
+``repository``, ``metrics`` and ``checkpoint/manager``:
+
+* **(a) guarded-attr escape** — an attribute written under
+  ``with self._lock:`` (or any lock/condition) in one method and then
+  read or written bare in another method is a race: the lock only works
+  when every access site takes it.
+* **(b) threaded-class bare writes** — in a class that both owns a lock
+  and spawns a ``threading.Thread``/``Timer`` (so its methods provably
+  run concurrently), an attribute mutated without the lock from two or
+  more different methods is shared mutable state with no discipline at
+  all (the ``CheckpointManager._stats`` shape).
+
+Heuristics / known limits: any ``with``-statement over an attribute or
+name containing ``lock``/``cond``/``mutex`` counts as "the lock" (locks
+are not distinguished from each other); closures defined inside a
+``with`` block look lock-held even though they may run later.  Accesses
+in ``__init__``/``__new__``/``__del__`` are exempt (no concurrency
+before construction completes / during teardown).
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule, is_lockish_name, register_rule
+
+_INIT_METHODS = ("__init__", "__new__", "__del__")
+
+# calls that mutate their receiver in place
+_MUTATORS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "update", "setdefault", "pop", "popleft", "popitem", "clear",
+    "remove", "discard", "sort", "put", "put_nowait", "move_to_end",
+}
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
+                   "BoundedSemaphore"}
+_THREAD_FACTORIES = {"Thread", "Timer"}
+# internally-synchronized primitives: mutating them without an extra
+# lock is fine (prong (b) exemption)
+_THREADSAFE_FACTORIES = {"Queue", "SimpleQueue", "LifoQueue",
+                         "PriorityQueue", "Event", "Barrier"}
+
+
+class _ClassRecord:
+    __slots__ = ("node", "accesses", "lock_attrs", "has_lock",
+                 "spawns_thread", "threadsafe_attrs")
+
+    def __init__(self, node):
+        self.node = node
+        # (attr, method, locked:bool, write:bool, node)
+        self.accesses = []
+        self.lock_attrs = set()
+        self.has_lock = False
+        self.spawns_thread = False
+        self.threadsafe_attrs = set()
+
+
+def _self_attr(expr):
+    """-> attribute name when ``expr`` is ``self.<attr>`` (else None)."""
+    if (isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"):
+        return expr.attr
+    return None
+
+
+def _unwrap_to_self_attr(target):
+    """``self.x[...]...`` / ``self.x.y`` assignment target -> ``x``."""
+    while isinstance(target, (ast.Subscript, ast.Attribute)):
+        name = _self_attr(target)
+        if name is not None:
+            return name
+        target = target.value
+    return None
+
+
+@register_rule
+class LockDisciplineRule(Rule):
+    id = "lock-discipline"
+    severity = "error"
+    doc = ("attribute guarded by a lock in one method must not be "
+           "accessed bare in another")
+
+    def begin_file(self, ctx):
+        self._stack = []
+
+    # -- collection ----------------------------------------------------------
+    def visit(self, node, ctx):
+        if isinstance(node, ast.ClassDef):
+            self._stack.append(_ClassRecord(node))
+            return
+        if not self._stack or not ctx.func_stack:
+            return
+        rec = self._stack[-1]
+
+        if isinstance(node, ast.Attribute):
+            attr = _self_attr(node)
+            if attr is None:
+                return
+            write = isinstance(node.ctx, (ast.Store, ast.Del))
+            self._record(rec, attr, ctx, write, node)
+        elif isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            value = getattr(node, "value", None)
+            for t in targets:
+                direct = _self_attr(t)
+                if direct is not None:
+                    # `self._mu = threading.Lock()` marks a lock attr
+                    # even when the name doesn't look lockish;
+                    # `self._q = queue.Queue()` marks a thread-safe attr
+                    if isinstance(value, ast.Call):
+                        vf = value.func
+                        vfname = (vf.attr if isinstance(vf, ast.Attribute)
+                                  else getattr(vf, "id", ""))
+                        if vfname in _LOCK_FACTORIES:
+                            rec.lock_attrs.add(direct)
+                            rec.has_lock = True
+                        elif vfname in _THREADSAFE_FACTORIES:
+                            rec.threadsafe_attrs.add(direct)
+                    continue  # the Attribute Store ctx records the write
+                # `self.x[k] = v` / `self.x.y = v`
+                attr = _unwrap_to_self_attr(t)
+                if attr is not None:
+                    self._record(rec, attr, ctx, True, node)
+        elif isinstance(node, ast.Call):
+            func = node.func
+            if isinstance(func, ast.Attribute):
+                if func.attr in _MUTATORS:
+                    attr = _self_attr(func.value)
+                    if attr is not None:
+                        self._record(rec, attr, ctx, True, node)
+                if func.attr in _THREAD_FACTORIES | {"start_new_thread"}:
+                    rec.spawns_thread = True
+            elif isinstance(func, ast.Name) and \
+                    func.id in _THREAD_FACTORIES:
+                rec.spawns_thread = True
+            fname = (func.attr if isinstance(func, ast.Attribute)
+                     else getattr(func, "id", ""))
+            if fname in _LOCK_FACTORIES:
+                rec.has_lock = True
+
+    def _record(self, rec, attr, ctx, write, node):
+        if is_lockish_name(attr):
+            rec.lock_attrs.add(attr)
+            rec.has_lock = True
+            return
+        rec.accesses.append((attr, ctx.func_name(), ctx.in_lock(),
+                             write, node))
+
+    # -- reporting -----------------------------------------------------------
+    def depart(self, node, ctx):
+        if not isinstance(node, ast.ClassDef) or not self._stack:
+            return
+        rec = self._stack.pop()
+        if rec.node is not node:
+            return
+        cname = node.name
+
+        protected = {a for (a, m, locked, w, _n) in rec.accesses
+                     if locked and w and m not in _INIT_METHODS}
+        reported = set()
+        for attr, method, locked, write, anode in rec.accesses:
+            if (attr in protected and not locked
+                    and method not in _INIT_METHODS
+                    and attr not in rec.lock_attrs):
+                key = (attr, anode.lineno)
+                if key in reported:
+                    continue
+                reported.add(key)
+                ctx.findings.append(self._finding(
+                    ctx, anode, cname, attr,
+                    f"{cname}.{attr} is {'written' if write else 'read'} "
+                    f"in {method}() without the lock, but written under "
+                    "the lock elsewhere in the class — every access must "
+                    "hold it (static race)"))
+
+        if rec.has_lock and rec.spawns_thread:
+            bare_write_methods = {}
+            for attr, method, locked, write, anode in rec.accesses:
+                if (write and not locked and attr not in protected
+                        and attr not in rec.lock_attrs
+                        and attr not in rec.threadsafe_attrs
+                        and method not in _INIT_METHODS):
+                    bare_write_methods.setdefault(attr, {})[method] = anode
+            for attr, methods in sorted(bare_write_methods.items()):
+                if len(methods) < 2:
+                    continue
+                anode = min(methods.values(), key=lambda n: n.lineno)
+                ctx.findings.append(self._finding(
+                    ctx, anode, cname, attr,
+                    f"{cname}.{attr} is mutated without the lock from "
+                    f"multiple methods ({', '.join(sorted(methods))}) of "
+                    "a thread-spawning class — shared mutable state with "
+                    "no lock discipline"))
+
+    def _finding(self, ctx, node, cname, attr, message):
+        from ..core import Finding
+        return Finding(self.id, self.severity, ctx.path, node.lineno,
+                       node.col_offset, message, f"{cname}.{attr}")

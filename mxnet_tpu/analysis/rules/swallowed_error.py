@@ -1,0 +1,86 @@
+"""swallowed-error — broad handlers that drop errors on the floor.
+
+``except Exception:`` (or a bare ``except:``) silently swallows
+``MXNetError`` — including the structured serving/checkpoint errors PR 1
+and PR 2 introduced precisely so callers could react to them — and
+corrupted-state bugs surface far from their cause.
+
+A broad handler is fine when it *does something* with the error.  The
+rule flags ``except Exception`` / ``except BaseException`` / bare
+``except`` whose body neither
+
+* re-raises (``raise`` anywhere in the handler), nor
+* logs (a call to ``.exception()/.error()/.warning()/.debug()/...``,
+  ``warnings.warn``, ``print``, ``traceback.print_exc``), nor
+* uses the bound exception (``except Exception as e:`` where ``e`` is
+  actually read — e.g. packed into a structured reply).
+
+The fix is usually to narrow the type (``except ImportError:`` for an
+optional dependency probe), log-and-continue for best-effort paths, or
+log + re-raise where state could be corrupted.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule, register_rule
+
+_BROAD = {"Exception", "BaseException"}
+_LOG_CALLS = {"exception", "error", "warning", "warn", "info", "debug",
+              "critical", "log", "print_exc", "format_exc"}
+
+
+def _is_broad(handler):
+    t = handler.type
+    if t is None:
+        return True
+    if isinstance(t, ast.Name) and t.id in _BROAD:
+        return True
+    if isinstance(t, ast.Attribute) and t.attr in _BROAD:
+        return True
+    if isinstance(t, ast.Tuple):
+        return any(_is_broad(ast.ExceptHandler(type=elt, name=None,
+                                               body=[]))
+                   for elt in t.elts)
+    return False
+
+
+def _handles_error(handler):
+    name = handler.name
+    for node in ast.walk(ast.Module(body=handler.body,
+                                    type_ignores=[])):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            func = node.func
+            if isinstance(func, ast.Attribute) and \
+                    func.attr in _LOG_CALLS:
+                return True
+            if isinstance(func, ast.Name) and func.id == "print":
+                return True
+        if name and isinstance(node, ast.Name) and node.id == name \
+                and isinstance(node.ctx, ast.Load):
+            return True
+    return False
+
+
+@register_rule
+class SwallowedErrorRule(Rule):
+    id = "swallowed-error"
+    severity = "warning"
+    doc = ("except Exception / bare except that drops the error without "
+           "re-raise, logging, or use")
+
+    def visit(self, node, ctx):
+        if not isinstance(node, ast.ExceptHandler):
+            return
+        if not _is_broad(node) or _handles_error(node):
+            return
+        shown = ("bare except" if node.type is None
+                 else f"except {ast.unparse(node.type)}")
+        ctx.report(
+            self, node,
+            f"{shown} in {ctx.func_name()}() swallows every error "
+            "(including MXNetError) without re-raise, logging, or use — "
+            "narrow the exception type, or log before continuing",
+            symbol=f"{ctx.func_name()}:{shown}")

@@ -1,0 +1,109 @@
+"""torn-write — durable artifacts must commit atomically.
+
+Generalizes the PR-2 checkpoint work (and its satellite fixes to
+``nd.save``/``Symbol.save``/``kvstore.save_optimizer_states``): a file a
+reader may open later must never be observable half-written.  The
+repository pattern is
+
+    tmp = f"{fname}.tmp-{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(...)
+    os.replace(tmp, fname)
+
+The rule flags ``open(path, 'w'/'wb'/'x'/...)`` when the enclosing
+function performs no ``os.replace``/``os.rename``/``shutil.move`` —
+i.e. the bytes land on the final path directly.  Near-misses that are
+NOT flagged:
+
+* the open targets a temp path (the unparsed path expression contains
+  ``tmp``/``temp`` — covers writes into a ``step-NNNNNN.tmp/`` staging
+  directory committed by a later directory rename);
+* the function renames/replaces something (the commit is present);
+* append modes (``'a'``/``'ab'``): an append-only event/record stream
+  (e.g. the TensorBoard writer) tears at worst its tail record, which
+  readers of those formats tolerate by design;
+* ``os.fdopen`` (the fd came from ``mkstemp``-style machinery).
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule, register_rule
+
+_RENAMERS = {"replace", "rename", "renames", "move"}
+
+
+class _FuncRecord:
+    __slots__ = ("node", "opens", "has_rename")
+
+    def __init__(self, node):
+        self.node = node
+        self.opens = []         # (node, path_text)
+        self.has_rename = False
+
+
+def _mode_of(call):
+    """The literal mode string of an ``open`` call (None if dynamic)."""
+    if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant) \
+            and isinstance(call.args[1].value, str):
+        return call.args[1].value
+    for kw in call.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant) \
+                and isinstance(kw.value.value, str):
+            return kw.value.value
+    return None
+
+
+@register_rule
+class TornWriteRule(Rule):
+    id = "torn-write"
+    severity = "error"
+    doc = ("durable file opened for writing without the "
+           "temp + os.replace commit pattern")
+
+    def begin_file(self, ctx):
+        # module scope behaves like an (outermost) function record
+        self._stack = [_FuncRecord(None)]
+
+    def visit(self, node, ctx):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._stack.append(_FuncRecord(node))
+            return
+        if not isinstance(node, ast.Call):
+            return
+        rec = self._stack[-1]
+        func = node.func
+        if isinstance(func, ast.Name) and func.id == "open":
+            mode = _mode_of(node)
+            if mode is None or not ("w" in mode or "x" in mode):
+                return
+            if not node.args:
+                return
+            path_text = ast.unparse(node.args[0]).lower()
+            if "tmp" in path_text or "temp" in path_text:
+                return
+            rec.opens.append((node, ast.unparse(node.args[0])))
+        elif isinstance(func, ast.Attribute) and func.attr in _RENAMERS:
+            rec.has_rename = True
+
+    def depart(self, node, ctx):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._flush(ctx, self._stack.pop())
+
+    def end_file(self, ctx):
+        self._flush(ctx, self._stack.pop())
+
+    def _flush(self, ctx, rec):
+        if rec.has_rename:
+            return
+        from ..core import Finding
+        fname = rec.node.name if rec.node is not None else "<module>"
+        for call, path_text in rec.opens:
+            ctx.findings.append(Finding(
+                self.id, self.severity, ctx.path, call.lineno,
+                call.col_offset,
+                f"open({path_text}, 'w') in {fname}() writes a durable "
+                "file in place — a crash mid-write leaves a torn "
+                "artifact; write to a '.tmp-<pid>' path and commit with "
+                "os.replace (see docs/lint.md)",
+                f"{fname}:{path_text}"))

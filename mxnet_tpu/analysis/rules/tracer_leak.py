@@ -1,0 +1,199 @@
+"""tracer-leak — traced values escaping or being concretized inside
+``jit``/``shard_map``-decorated functions.
+
+Inside a function compiled by ``jax.jit`` (or ``shard_map``/``pmap``)
+the arguments are tracers.  Three classic bugs:
+
+* **storing a tracer** on ``self`` or a global: the reference outlives
+  the trace and either raises ``UnexpectedTracerError`` later or
+  silently pins stale compile-time state;
+* **Python branching** (``if``/``while``/``assert``) on a traced value:
+  forces concretization — a ``ConcretizationTypeError`` at best, a
+  silently trace-time-frozen branch at worst;
+* **host concretization** — ``float()``/``int()``/``bool()``/
+  ``.item()``/``.tolist()`` on a traced argument.
+
+Near-misses that stay silent: branching on parameters named in
+``static_argnames``/``static_argnums`` (they are Python values, not
+tracers), and branching on *static metadata* of a traced value —
+``x.shape`` / ``x.ndim`` / ``x.dtype`` / ``x.size`` / ``len(x)`` /
+``isinstance(x, ...)`` are trace-time constants.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule, register_rule
+
+_JIT_NAMES = {"jit", "shard_map", "pmap"}
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "sharding",
+                 "weak_type"}
+_STATIC_CALLS = {"len", "isinstance", "getattr", "hasattr", "type",
+                 "callable", "id"}
+_CONCRETIZERS = {"float", "int", "bool"}
+_CONCRETIZER_METHODS = {"item", "tolist"}
+
+
+def _tail_name(expr):
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return ""
+
+
+def _jit_decoration(dec):
+    """-> (static_names, static_nums) when ``dec`` marks a jit-like
+    transform, else None.  Handles ``@jit``, ``@jax.jit``,
+    ``@jax.jit(...)`` and ``@functools.partial(jax.jit, ...)``."""
+    if _tail_name(dec) in _JIT_NAMES:
+        return set(), set()
+    if not isinstance(dec, ast.Call):
+        return None
+    statics_from = None
+    if _tail_name(dec.func) in _JIT_NAMES:
+        statics_from = dec
+    elif _tail_name(dec.func) == "partial" and dec.args \
+            and _tail_name(dec.args[0]) in _JIT_NAMES:
+        statics_from = dec
+    if statics_from is None:
+        return None
+    names, nums = set(), set()
+    for kw in statics_from.keywords:
+        val = kw.value
+        if kw.arg == "static_argnames":
+            for elt in (val.elts if isinstance(val, (ast.Tuple, ast.List))
+                        else [val]):
+                if isinstance(elt, ast.Constant) and \
+                        isinstance(elt.value, str):
+                    names.add(elt.value)
+        elif kw.arg == "static_argnums":
+            for elt in (val.elts if isinstance(val, (ast.Tuple, ast.List))
+                        else [val]):
+                if isinstance(elt, ast.Constant) and \
+                        isinstance(elt.value, int):
+                    nums.add(elt.value)
+    return names, nums
+
+
+def _traced_params(func, static_names, static_nums):
+    args = func.args
+    positional = [a.arg for a in args.posonlyargs + args.args]
+    traced = {name for i, name in enumerate(positional)
+              if i not in static_nums and name not in static_names}
+    traced.update(a.arg for a in args.kwonlyargs
+                  if a.arg not in static_names)
+    traced.discard("self")
+    return traced
+
+
+def _offending_names(test, traced):
+    """Names of traced params used as *values* (not via static metadata)
+    in a branch test expression."""
+    bad = []
+
+    def rec(node, safe):
+        if isinstance(node, ast.Attribute):
+            rec(node.value, node.attr in _STATIC_ATTRS or safe)
+            return
+        if isinstance(node, ast.Call):
+            fname = _tail_name(node.func)
+            safe_call = fname in _STATIC_CALLS
+            if isinstance(node.func, ast.Attribute):
+                # x.sum() etc. produces a traced value — the receiver
+                # itself is being used as a value
+                rec(node.func.value, safe_call)
+            for a in list(node.args) + [kw.value for kw in node.keywords]:
+                rec(a, safe_call)
+            return
+        if isinstance(node, ast.Name):
+            if not safe and node.id in traced:
+                bad.append(node.id)
+            return
+        for child in ast.iter_child_nodes(node):
+            rec(child, safe)
+
+    rec(test, False)
+    return bad
+
+
+@register_rule
+class TracerLeakRule(Rule):
+    id = "tracer-leak"
+    severity = "error"
+    doc = ("storing to self/globals or Python-branching on traced "
+           "values inside jit/shard_map functions")
+
+    def begin_file(self, ctx):
+        # stack of (func_node, traced_param_names, global_names) for
+        # jit-decorated functions currently being traversed
+        self._jit_stack = []
+
+    def visit(self, node, ctx):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                info = _jit_decoration(dec)
+                if info is not None:
+                    names, nums = info
+                    self._jit_stack.append(
+                        (node, _traced_params(node, names, nums), set()))
+                    break
+            return
+        if not self._jit_stack:
+            return
+        fnode, traced, globals_ = self._jit_stack[-1]
+
+        if isinstance(node, ast.Global):
+            globals_.update(node.names)
+        elif isinstance(node, ast.Attribute) \
+                and isinstance(node.ctx, ast.Store) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "self":
+            ctx.report(
+                self, node,
+                f"assignment to self.{node.attr} inside jit-compiled "
+                f"{fnode.name}() stores a tracer on a long-lived object "
+                "— it escapes the trace (UnexpectedTracerError / stale "
+                "compile-time state)",
+                symbol=f"{fnode.name}:self.{node.attr}")
+        elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store) \
+                and node.id in globals_:
+            ctx.report(
+                self, node,
+                f"assignment to global {node.id!r} inside jit-compiled "
+                f"{fnode.name}() leaks a tracer out of the trace",
+                symbol=f"{fnode.name}:global.{node.id}")
+        elif isinstance(node, (ast.If, ast.While, ast.Assert)):
+            test = node.test
+            for name in _offending_names(test, traced):
+                ctx.report(
+                    self, node,
+                    f"Python branch on traced argument {name!r} inside "
+                    f"jit-compiled {fnode.name}() forces concretization "
+                    "— use lax.cond/jnp.where, or mark the argument "
+                    "static (static_argnames)",
+                    symbol=f"{fnode.name}:branch.{name}")
+        elif isinstance(node, ast.Call):
+            func = node.func
+            if isinstance(func, ast.Name) and func.id in _CONCRETIZERS \
+                    and len(node.args) == 1 \
+                    and isinstance(node.args[0], ast.Name) \
+                    and node.args[0].id in traced:
+                ctx.report(
+                    self, node,
+                    f"{func.id}({node.args[0].id}) inside jit-compiled "
+                    f"{fnode.name}() concretizes a traced value",
+                    symbol=f"{fnode.name}:{func.id}.{node.args[0].id}")
+            elif isinstance(func, ast.Attribute) \
+                    and func.attr in _CONCRETIZER_METHODS \
+                    and isinstance(func.value, ast.Name) \
+                    and func.value.id in traced:
+                ctx.report(
+                    self, node,
+                    f"{func.value.id}.{func.attr}() inside jit-compiled "
+                    f"{fnode.name}() concretizes a traced value",
+                    symbol=f"{fnode.name}:{func.attr}.{func.value.id}")
+
+    def depart(self, node, ctx):
+        if self._jit_stack and self._jit_stack[-1][0] is node:
+            self._jit_stack.pop()

@@ -93,7 +93,10 @@ class Predictor:
             for key, arr in self._staged.items():
                 ex.arg_dict[key][:] = arr
             outs = ex.forward(is_train=False)
-            self._outputs = [np.asarray(o.asnumpy()) for o in outs]
+            outputs = [np.asarray(o.asnumpy()) for o in outs]
+        # per-instance state: assigned outside the executor lock (the
+        # lock guards the SHARED bound buffers, nothing of this instance)
+        self._outputs = outputs
         return True
 
     def output_shape(self, index):

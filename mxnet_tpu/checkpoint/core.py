@@ -301,5 +301,5 @@ def _record_restore(t0):
         from .. import profiler
         profiler.record_counter("checkpoint:restore_s",
                                 round(_time.perf_counter() - t0, 4))
-    except Exception:
+    except Exception:  # graftlint: disable=swallowed-error -- best-effort metrics must never fail a restore
         pass

@@ -119,7 +119,7 @@ def _snapshot_one(name, value):
     try:
         import jax
         is_jax = isinstance(value, jax.Array)
-    except Exception:
+    except ImportError:
         is_jax = False
     if is_jax:
         shape = tuple(int(s) for s in value.shape)
@@ -193,7 +193,8 @@ class CheckpointManager:
             import jax
             return (jax.process_index() if host_id is None else host_id,
                     jax.process_count() if num_hosts is None else num_hosts)
-        except Exception:
+        except (ImportError, RuntimeError):
+            # jax absent or its runtime not initialized: single host
             return (host_id or 0, num_hosts or 1)
 
     def _sweep_stale(self):
@@ -259,7 +260,9 @@ class CheckpointManager:
                          CheckpointError(str(e)))
             blocking_ms = (time.perf_counter() - t0) * 1e3
         job.snapshot_ms = blocking_ms
-        self._stats["last_save_blocking_ms"] = blocking_ms
+        # _stats is shared with the writer thread — every access locks
+        with self._lock:
+            self._stats["last_save_blocking_ms"] = blocking_ms
         self._record_counter("checkpoint:save_blocking_ms",
                              round(blocking_ms, 3))
         if block or not self.async_save:
@@ -267,10 +270,14 @@ class CheckpointManager:
         return fut
 
     def _ensure_writer(self):
-        if self._writer is None or not self._writer.is_alive():
-            self._writer = threading.Thread(
-                target=self._writer_loop, name="ckpt-writer", daemon=True)
-            self._writer.start()
+        # under the lock: concurrent save() callers must not both spawn
+        # a writer (two writers would race the same step directories)
+        with self._lock:
+            if self._writer is None or not self._writer.is_alive():
+                self._writer = threading.Thread(
+                    target=self._writer_loop, name="ckpt-writer",
+                    daemon=True)
+                self._writer.start()
 
     def _writer_loop(self):
         while True:
@@ -281,7 +288,8 @@ class CheckpointManager:
                 self._write_step(job)
                 job.future._set(None)
             except BaseException as e:  # surface via future, keep writing
-                self._stats["failures"] += 1
+                with self._lock:
+                    self._stats["failures"] += 1
                 self.logger.exception(
                     "checkpoint: save of step %d failed", job.step)
                 job.future._set(e if isinstance(e, Exception) else
@@ -391,9 +399,10 @@ class CheckpointManager:
         self._gc()
 
         total_ms = (time.perf_counter() - t0) * 1e3
-        self._stats["saves"] += 1
-        self._stats["last_save_total_ms"] = total_ms
-        self._stats["last_save_bytes"] = job.nbytes
+        with self._lock:
+            self._stats["saves"] += 1
+            self._stats["last_save_total_ms"] = total_ms
+            self._stats["last_save_bytes"] = job.nbytes
         self._record_counter("checkpoint:save_total_ms", round(total_ms, 3))
         self._record_counter("checkpoint:save_bytes", job.nbytes)
         self.logger.info("checkpoint: committed step %d (%.1f MB, %.0f ms)",
@@ -483,7 +492,8 @@ class CheckpointManager:
             except OSError:
                 pass
         if removed:
-            self._stats["gc_removed"] += removed
+            with self._lock:
+                self._stats["gc_removed"] += removed
             self._record_counter("checkpoint:gc_removed", removed)
 
     @staticmethod
@@ -491,7 +501,7 @@ class CheckpointManager:
         try:
             from .. import profiler
             profiler.record_counter(name, value)
-        except Exception:
+        except Exception:  # graftlint: disable=swallowed-error -- best-effort metrics must never fail a save
             pass
 
     # -- module / symbolic glue ---------------------------------------------
@@ -545,7 +555,8 @@ class CheckpointManager:
             verify = _cfg("MXNET_CKPT_VERIFY_ON_LOAD")
         ckpt = restore(self.directory, step=step, verify=verify,
                        fallback=fallback, logger=self.logger)
-        self._stats["last_restore_s"] = time.perf_counter() - t0
+        with self._lock:
+            self._stats["last_restore_s"] = time.perf_counter() - t0
         return ckpt
 
     def latest(self):
@@ -577,7 +588,8 @@ class CheckpointManager:
 
     def stats(self):
         """Save/restore latency + volume counters (bench + tests)."""
-        return dict(self._stats)
+        with self._lock:
+            return dict(self._stats)
 
     def close(self):
         """Flush pending saves and stop the writer thread."""
@@ -587,9 +599,11 @@ class CheckpointManager:
             self.wait()
         finally:
             self._closed = True
-            if self._writer is not None and self._writer.is_alive():
+            with self._lock:
+                writer = self._writer
+            if writer is not None and writer.is_alive():
                 self._queue.put(None)
-                self._writer.join(timeout=30)
+                writer.join(timeout=30)
 
     def __enter__(self):
         return self

@@ -42,6 +42,7 @@ def main():
                    committed_steps, restore, step_dir)
     tmpdir = tempfile.mkdtemp(prefix="ckpt-smoke-")
     script = os.path.join(tmpdir, "victim.py")
+    # graftlint: disable=torn-write -- ephemeral script in a fresh tmpdir, consumed once below
     with open(script, "w") as f:
         f.write(_VICTIM)
     ckdir = os.path.join(tmpdir, "ckpt")

@@ -118,6 +118,28 @@ _register("DMLC_PS_ROOT_PORT", int, 9091, "kvstore server port")
 _register("DMLC_PS_BIND_ADDR", str, "127.0.0.1",
           "kvstore server bind address (loopback by default — frames "
           "are pickle)")
+# -- multihost runtime -------------------------------------------------------
+_register("MXNET_COORDINATOR_URI", str, "",
+          "jax.distributed coordinator host for parallel.multihost; "
+          "takes precedence over DMLC_PS_ROOT_URI (which is never "
+          "borrowed when DMLC_ROLE marks a PS deployment — the PS "
+          "socket is not a jax.distributed endpoint)")
+_register("MXNET_COORDINATOR_PORT", int, 8476,
+          "port for MXNET_COORDINATOR_URI")
+# -- data pipeline -----------------------------------------------------------
+_register("MXNET_MP_START_METHOD", str, "forkserver",
+          "multiprocessing start method for DataLoader worker pools; "
+          "'fork' restores zero-pickle datasets but deadlocks once "
+          "jax's XLA thread pools are live (gluon/data/dataloader.py)")
+# -- fused kernels -----------------------------------------------------------
+_register("MXNET_FUSED_LAYERNORM", str, "auto",
+          "fused Pallas LayerNorm: 1 forces on, 0 forces plain XLA, "
+          "auto probes the exact tile config once and falls back on "
+          "Mosaic rejection")
+# -- test harness ------------------------------------------------------------
+_register("MXNET_TEST_EXAMPLES", bool, False,
+          "run the full examples/ suite in tests/test_examples.py "
+          "(ci/run.sh sets it; tier-1 runs only the fastest example)")
 # -- profiler ---------------------------------------------------------------
 _register("MXNET_PROFILER_XPLANE_DIR", str, "",
           "directory for jax.profiler xplane traces (TensorBoard/"
@@ -189,14 +211,35 @@ _register("BENCH_TIME_BUDGET", float, 1200.0, "bench.py wall budget (s)")
 _register("BENCH_BATCH", int, 32, "bench.py primary batch size")
 _register("BENCH_BATCH2", int, 128,
           "bench.py second MFU point (0 disables)")
+_register("BENCH_BATCH3", int, 256,
+          "bench.py third MFU point (0 disables)")
 _register("BENCH_ITERS", int, 20, "bench.py timed iterations")
 _register("BENCH_WARMUP", int, 2, "bench.py warmup iterations")
+_register("BENCH_K", int, 8,
+          "bench.py steps chained per timed dispatch")
 _register("BENCH_DTYPE", str, "bfloat16", "bench.py compute dtype")
+_register("BENCH_LOSS", str, "fused",
+          "bench.py loss path: 'fused' (Pallas softmax-ce) or 'plain'")
+_register("BENCH_INIT_TIMEOUT", float, 300.0,
+          "bench.py timeout for model init + first compile (s)")
 _register("BENCH_REMAT_FROM_BS", int, 64,
           "bench.py: rematerialize the train step at batch >= this "
           "(0 disables); see MXNET_BACKWARD_DO_MIRROR")
-_register("BENCH_CALIB_N", int, 4096,
-          "bench.py peak-calibration matmul dimension")
+_register("BENCH_CALIB_N", str, "4096,8192",
+          "bench.py peak-calibration matmul dimensions "
+          "(comma-separated sweep)")
+_register("BENCH_CALIB_REPS", int, 40,
+          "bench.py peak-calibration chain length per size "
+          "(one fori_loop dispatch)")
+_register("BENCH_REC_IMAGES", int, 512,
+          "tools/bench_pipeline.py synthetic .rec image count")
+_register("BENCH_WORKERS", int, 4,
+          "tools/bench_pipeline.py DataLoader worker count")
+_register("BENCH_B", int, 4,
+          "tools/bench_attention.py batch size")
+_register("BENCH_SEQS", str, "512,1024,2048",
+          "tools/bench_attention.py sequence lengths "
+          "(comma-separated sweep)")
 _register("BENCH_SERVE", bool, True,
           "bench.py: also measure serving throughput (resnet18 via the "
           "DynamicBatcher under Poisson arrivals)")

@@ -474,8 +474,13 @@ def export_model(sym, params, input_shapes, input_dtype=np.float32,
     graph inputs bound to `input_shapes` positionally.
     """
     model = graph_to_onnx(sym, params, input_shapes, input_dtype)
-    with open(onnx_file_path, "wb") as f:
+    # atomic temp + os.replace: a crash mid-export must not leave a
+    # torn .onnx on the final path (same contract as nd.save)
+    import os
+    tmp = f"{onnx_file_path}.tmp-{os.getpid()}"
+    with open(tmp, "wb") as f:
         f.write(model.encode())
+    os.replace(tmp, onnx_file_path)
     return onnx_file_path
 
 

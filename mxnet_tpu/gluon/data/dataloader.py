@@ -79,8 +79,8 @@ def _to_shm(obj):
         try:
             from multiprocessing import resource_tracker
             resource_tracker.unregister(shm._name, "shared_memory")
-        except Exception:
-            pass
+        except (ImportError, AttributeError, KeyError):
+            pass  # tracker absent or never registered the block
         shm.close()
         return desc
     return obj
@@ -273,11 +273,14 @@ class DataLoader:
             # consumer stopped early (or a worker raised): attach+unlink
             # any in-flight shm blocks so /dev/shm does not leak
             if self._use_shm:
+                import logging
                 for result in pending:
                     try:
                         _from_shm(result.get(timeout=30))
-                    except Exception:
-                        pass
+                    except Exception as e:  # noqa: BLE001 — cleanup pass
+                        logging.getLogger("mxnet_tpu.gluon.data").debug(
+                            "dataloader drain: in-flight batch dropped "
+                            "(%s: %s)", type(e).__name__, e)
 
     def __len__(self):
         return len(self._batch_sampler)

@@ -267,12 +267,19 @@ class Trainer:
                     upd(i, grad, arr)
 
     def save_states(self, fname):
-        """Save optimizer/updater states (parity: trainer.py save_states)."""
+        """Save optimizer/updater states (parity: trainer.py save_states).
+
+        Atomic temp + os.replace: the states file is a durable restart
+        artifact and must never be observable half-written.
+        """
         assert self._optimizer is not None
         if not self._kv_initialized:
             self._init_kvstore()
-        with open(fname, "wb") as fout:
+        import os
+        tmp = f"{fname}.tmp-{os.getpid()}"
+        with open(tmp, "wb") as fout:
             fout.write(self._updaters[0].get_states(dump_optimizer=False))
+        os.replace(tmp, fname)
 
     def load_states(self, fname):
         """Load optimizer/updater states (parity: trainer.py load_states)."""

@@ -151,11 +151,17 @@ class KVServer:
 
     def _apply_update(self, key, grad):
         """sync aggregate-then-update / async per-push update
-        (parity: DataHandleEx kvstore_dist_server.h:325)."""
+        (parity: DataHandleEx kvstore_dist_server.h:325).
+
+        Callers hold ``self._lock`` — this helper is only reached from
+        the push paths inside ``with self._lock:`` blocks in _handle.
+        """
         if self.updater is None:
             # no optimizer installed: store accumulates the pushed value
+            # graftlint: disable=lock-discipline -- caller holds self._lock
             self.store[key] = grad.copy()
             return
+        # graftlint: disable=lock-discipline -- caller holds self._lock
         stored = self.store[key]
         self.updater(key, grad, stored)
 
@@ -426,11 +432,15 @@ class KVClient:
                 time.sleep(0.1)
 
     def _heartbeat_loop(self):
+        import logging
         while not self._hb_stop.wait(self._hb_interval):
             try:
                 self.heartbeat()
-            except Exception:
-                return  # connection gone; the owner will notice
+            except Exception as e:
+                # connection gone; the owner will notice on its own RPCs
+                logging.getLogger("mxnet_tpu.kvstore").debug(
+                    "worker %d heartbeat loop exiting: %s", self.rank, e)
+                return
 
     def heartbeat(self):
         with self._hb_lock:
@@ -502,6 +512,9 @@ class KVClient:
         self._rpc({"op": "push", "key": key, "value": np.asarray(value),
                    "sync": sync})
         if sync:
+            # _push_counts is owner-thread state: the spawned heartbeat
+            # thread only ever touches _hb_* attributes
+            # graftlint: disable=lock-discipline -- single-owner-thread state
             self._push_counts[key] = self._push_counts.get(key, 0) + 1
 
     def push_compressed(self, key, encoded, sync=True):

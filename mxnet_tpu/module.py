@@ -516,6 +516,9 @@ class Module(BaseModule):
                 self._pad_batch_outputs = self._infer_batch_outputs(
                     feed, n, bound)
                 for name, arr in feed.items():
+                    # one transfer per INPUT TENSOR: zero-padding the
+                    # partial final batch requires the host copy anyway
+                    # graftlint: disable=host-sync-in-hot-path -- per-input pad copy, once per partial batch
                     host = arr.asnumpy()
                     host = np.concatenate(
                         [host, np.zeros((bound - n,) + host.shape[1:],
@@ -588,7 +591,10 @@ class Module(BaseModule):
                         if sn and sb and sn[0] == n and sb[0] == bound)
                 else:
                     cache[key] = None
-            except Exception:  # noqa: BLE001 — fall back to heuristic
+            except Exception as e:  # noqa: BLE001 — fall back to heuristic
+                self.logger.debug(
+                    "pad-slice output inference failed (%s: %s); falling "
+                    "back to slicing every output", type(e).__name__, e)
                 cache[key] = None
         return cache[key]
 

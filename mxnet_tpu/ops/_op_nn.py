@@ -332,7 +332,11 @@ def _fused_ln_ok(n_rows, d, x_dtype, g_dtype, b_dtype):
                                      jnp.zeros((d,), b_dtype))
             _np.asarray(probe)
             _LN_PROBED[key] = True
-        except Exception:
+        except Exception as e:  # noqa: BLE001 — Mosaic rejection gates off
+            import logging
+            logging.getLogger("mxnet_tpu.ops").debug(
+                "fused layernorm gated off for tile %s (%s: %s); "
+                "falling back to plain XLA", key, type(e).__name__, e)
             _LN_PROBED[key] = False
     return _LN_PROBED[key]
 

@@ -32,7 +32,7 @@ from jax.experimental import pallas as pl
 try:  # pltpu imports fail on CPU-only builds of jaxlib
     from jax.experimental.pallas import tpu as pltpu
     _HAS_PLTPU = True
-except Exception:  # pragma: no cover
+except Exception:  # pragma: no cover  # graftlint: disable=swallowed-error -- optional-backend probe; any import failure means "no TPU pallas"
     pltpu = None
     _HAS_PLTPU = False
 

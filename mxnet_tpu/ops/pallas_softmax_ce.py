@@ -135,7 +135,11 @@ def fused_softmax_ce_available(n, d, dtype):
             # caught HERE, not at the first real call
             _np.asarray(probe[0])
             hit = True
-        except Exception:
+        except Exception as e:  # noqa: BLE001 — Mosaic rejection gates off
+            import logging
+            logging.getLogger("mxnet_tpu.ops").debug(
+                "fused softmax-ce gated off for tile %s (%s: %s); "
+                "falling back to plain XLA", key, type(e).__name__, e)
             hit = False
         _GATE_CACHE[key] = hit
     return hit

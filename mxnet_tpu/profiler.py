@@ -10,6 +10,7 @@ chrome-trace JSON + aggregate table the reference emits.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import threading
 import time
@@ -69,8 +70,9 @@ def _forward_to_server(head, payload):
     try:
         import pickle
         kv._send_command_to_servers(head, pickle.dumps(payload))
-    except Exception:
-        pass  # server-side profiling is best-effort
+    except Exception as e:  # noqa: BLE001 — best-effort forwarding
+        logging.getLogger("mxnet_tpu.profiler").debug(
+            "server-side profiler command %r dropped: %s", head, e)
 
 
 def profiler_set_config(mode="symbolic", filename="profile.json"):
@@ -111,8 +113,9 @@ def _schedule_dump():
         if _state["running"]:
             try:
                 dump(finished=False)
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001 — keep the timer alive
+                logging.getLogger("mxnet_tpu.profiler").warning(
+                    "continuous profiler dump failed: %s", e)
             _schedule_dump()
 
     t = threading.Timer(float(_config["dump_period"]), tick)
@@ -275,8 +278,13 @@ def dump(finished=True, profile_process="worker"):
     with _records_lock:
         events = list(_records)
     doc = {"traceEvents": events, "displayTimeUnit": "ms"}
-    with open(_config["filename"], "w") as f:
+    # atomic temp + os.replace: the continuous-dump timer rewrites this
+    # file periodically — chrome://tracing must never load a torn JSON
+    fname = _config["filename"]
+    tmp = f"{fname}.tmp-{os.getpid()}"
+    with open(tmp, "w") as f:
         json.dump(doc, f)
+    os.replace(tmp, fname)
     _forward_to_server("profiler_dump", bool(finished))
 
 

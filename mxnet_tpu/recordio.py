@@ -45,6 +45,10 @@ class MXRecordIO:
 
     def open(self):
         if self.flag == "w":
+            # streaming record writer: records append incrementally over
+            # the object's lifetime; the frame CRCs let readers detect a
+            # truncated tail (atomic-rename does not fit an open stream)
+            # graftlint: disable=torn-write -- incremental record stream, tail-tolerant format
             self.fid = open(self.uri, "wb")
             self.writable = True
         elif self.flag == "r":
@@ -171,9 +175,13 @@ class MXIndexedRecordIO(MXRecordIO):
 
     def close(self):
         if self.writable and self.fid is not None and not self.fid.closed:
-            with open(self.idx_path, "w") as fout:
+            # atomic: readers key random access off the .idx — a torn
+            # one silently truncates the dataset
+            tmp = f"{self.idx_path}.tmp-{os.getpid()}"
+            with open(tmp, "w") as fout:
                 for k in self.keys:
                     fout.write(f"{k}\t{self.idx[k]}\n")
+            os.replace(tmp, self.idx_path)
         super().close()
 
     def __getstate__(self):
@@ -226,9 +234,11 @@ def rec2idx(rec_path, idx_path=None, key_type=int):
                 break
             positions.append(pos)
         reader.close()
-    with open(idx_path, "w") as fout:
+    tmp = f"{idx_path}.tmp-{os.getpid()}"
+    with open(tmp, "w") as fout:
         for i, pos in enumerate(positions):
             fout.write(f"{key_type(i)}\t{pos}\n")
+    os.replace(tmp, idx_path)
     return len(positions)
 
 

@@ -110,6 +110,9 @@ class CachedExecutor:
             for name, arr in feed.items():
                 ex.arg_dict[name][:] = arr
             outs = ex.forward(is_train=False)
+            # one device->host transfer per OUTPUT TENSOR (not per
+            # request) — the batching already amortized the sync
+            # graftlint: disable=host-sync-in-hot-path -- per-output boundary transfer, already batch-amortized
             return [np.asarray(o.asnumpy())[:n_real] for o in outs]
 
 

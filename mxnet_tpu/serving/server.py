@@ -74,8 +74,12 @@ class ModelServer:
                 raise MXNetError(
                     f"serving[{model}]: request is missing inputs "
                     f"{missing} (expects {mv.input_names})")
-            bucket = bucket_batch(
-                n_real, self._batchers[model].max_batch_size)
+            # _batchers is guarded by _lock (a concurrent _get_batcher
+            # may be resizing the dict); max_batch_size itself is
+            # immutable after construction
+            with self._lock:
+                max_batch = self._batchers[model].max_batch_size
+            bucket = bucket_batch(n_real, max_batch)
             # request dtypes are preserved end to end (int token ids /
             # indices / masks must NOT be silently cast to float32);
             # the executor binds its input buffers with the same dtypes

@@ -1,0 +1,495 @@
+"""graftlint test suite (ISSUE 3).
+
+Per rule: one fixture that MUST be flagged and one near-miss that must
+NOT be (false-positive guard), plus suppression mechanics, baseline
+mechanics, CLI behavior, and the repo-gate regression (the committed
+baseline keeps `--fail-on-new` green).
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from mxnet_tpu.analysis import (analyze_source, diff_baseline,
+                                fingerprint_counts, make_rules)
+from mxnet_tpu.analysis.rules.env_drift import EnvDriftRule
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GRAFTLINT = os.path.join(REPO, "tools", "graftlint.py")
+
+
+def lint(src, path="mxnet_tpu/fake.py", rules=None):
+    return analyze_source(textwrap.dedent(src), path=path, rules=rules)
+
+
+def rules_hit(findings):
+    return {f.rule for f in findings}
+
+
+# -- lock-discipline ---------------------------------------------------------
+LOCKED_CLASS = """
+    import threading
+
+    class Cache:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []
+
+        def add(self, x):
+            with self._lock:
+                self._items.append(x)
+
+        def peek(self):
+            return self._items[-1]
+"""
+
+
+def test_lock_discipline_flags_bare_read():
+    findings = lint(LOCKED_CLASS)
+    assert "lock-discipline" in rules_hit(findings)
+    f = [x for x in findings if x.rule == "lock-discipline"][0]
+    assert f.symbol == "Cache._items"
+    assert "peek" in f.message
+
+
+def test_lock_discipline_near_miss_all_under_lock():
+    src = LOCKED_CLASS.replace(
+        "            return self._items[-1]",
+        "            with self._lock:\n"
+        "                return self._items[-1]")
+    assert "lock-discipline" not in rules_hit(lint(src))
+
+
+def test_lock_discipline_init_exempt():
+    # writes in __init__ happen before any concurrency exists
+    src = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+                self._n = 1
+
+            def bump(self):
+                with self._lock:
+                    self._n += 1
+    """
+    assert "lock-discipline" not in rules_hit(lint(src))
+
+
+def test_lock_discipline_threaded_class_bare_writes():
+    # the CheckpointManager._stats shape: never locked anywhere, but
+    # mutated from several methods of a thread-spawning class
+    src = """
+        import threading
+
+        class Writer:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._stats = {}
+                self._t = threading.Thread(target=self._run)
+
+            def _run(self):
+                self._stats["ticks"] = 1
+
+            def bump(self):
+                self._stats["bumps"] = 2
+    """
+    findings = lint(src)
+    assert any(f.rule == "lock-discipline" and f.symbol == "Writer._stats"
+               for f in findings)
+
+
+def test_lock_discipline_threadsafe_queue_exempt():
+    # queue.Queue is internally synchronized — no extra lock needed
+    src = """
+        import queue
+        import threading
+
+        class Writer:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._queue = queue.Queue()
+                self._t = threading.Thread(target=self._run)
+
+            def _run(self):
+                self._queue.put(1)
+
+            def submit(self):
+                self._queue.put(2)
+    """
+    assert "lock-discipline" not in rules_hit(lint(src))
+
+
+# -- torn-write --------------------------------------------------------------
+def test_torn_write_flags_in_place_write():
+    src = """
+        import json
+
+        def save(path, doc):
+            with open(path, "w") as f:
+                json.dump(doc, f)
+    """
+    findings = lint(src)
+    assert "torn-write" in rules_hit(findings)
+
+
+def test_torn_write_near_miss_temp_replace():
+    src = """
+        import json
+        import os
+
+        def save(path, doc):
+            tmp = f"{path}.tmp-{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(doc, f)
+            os.replace(tmp, path)
+    """
+    assert "torn-write" not in rules_hit(lint(src))
+
+
+def test_torn_write_near_miss_append_and_read():
+    src = """
+        def tail(path, line):
+            with open(path, "a") as f:
+                f.write(line)
+            with open(path) as f:
+                return f.read()
+    """
+    assert "torn-write" not in rules_hit(lint(src))
+
+
+# -- host-sync-in-hot-path ---------------------------------------------------
+HOT_LOOP = """
+    def run(outs):
+        return [o.asnumpy() for o in outs]
+"""
+
+
+def test_host_sync_flags_loop_in_hot_module():
+    findings = lint(HOT_LOOP, path="mxnet_tpu/serving/runner.py")
+    assert "host-sync-in-hot-path" in rules_hit(findings)
+
+
+def test_host_sync_near_miss_cold_module():
+    assert "host-sync-in-hot-path" not in rules_hit(
+        lint(HOT_LOOP, path="mxnet_tpu/visualization.py"))
+
+
+def test_host_sync_near_miss_hoisted_sync():
+    # the sync happens ONCE, before the loop (and a for-loop's iterable
+    # also evaluates once — neither may be flagged)
+    src = """
+        def run(arr):
+            host = arr.asnumpy()
+            out = [x + 1 for x in host]
+            for row in arr.asnumpy():
+                out.append(row)
+            return out
+    """
+    assert "host-sync-in-hot-path" not in rules_hit(
+        lint(src, path="mxnet_tpu/serving/runner.py"))
+
+
+# -- tracer-leak -------------------------------------------------------------
+def test_tracer_leak_flags_branch_on_traced():
+    src = """
+        import jax
+
+        @jax.jit
+        def step(x):
+            if x > 0:
+                return x
+            return -x
+    """
+    findings = lint(src)
+    assert "tracer-leak" in rules_hit(findings)
+
+
+def test_tracer_leak_flags_store_on_self():
+    src = """
+        import jax
+
+        class M:
+            @jax.jit
+            def f(self, x):
+                self.cache = x
+                return x
+    """
+    findings = lint(src)
+    assert any(f.rule == "tracer-leak" and "self.cache" in f.message
+               for f in findings)
+
+
+def test_tracer_leak_flags_concretization():
+    src = """
+        import jax
+
+        @jax.jit
+        def f(x):
+            return float(x)
+    """
+    assert "tracer-leak" in rules_hit(lint(src))
+
+
+def test_tracer_leak_near_miss_static_argnames():
+    # branching on a static arg, or on static metadata of a traced arg,
+    # is trace-time Python — not a leak
+    src = """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("block_rows",))
+        def f(x, *, block_rows):
+            if block_rows > 8:
+                x = x * 2
+            if x.ndim == 2:
+                x = x[None]
+            if len(x) == 1:
+                x = x + 1
+            return x
+    """
+    assert "tracer-leak" not in rules_hit(lint(src))
+
+
+def test_tracer_leak_near_miss_undecorated():
+    src = """
+        def f(x):
+            if x > 0:
+                return float(x)
+            return 0.0
+    """
+    assert "tracer-leak" not in rules_hit(lint(src))
+
+
+# -- swallowed-error ---------------------------------------------------------
+def test_swallowed_error_flags_silent_broad_except():
+    src = """
+        def f():
+            try:
+                risky()
+            except Exception:
+                pass
+    """
+    assert "swallowed-error" in rules_hit(lint(src))
+
+
+def test_swallowed_error_near_misses():
+    # logged, re-raised, used, or narrow — all fine
+    src = """
+        import logging
+
+        def a():
+            try:
+                risky()
+            except Exception as e:
+                logging.getLogger("x").warning("boom: %s", e)
+
+        def b():
+            try:
+                risky()
+            except Exception:
+                raise RuntimeError("wrapped")
+
+        def c():
+            try:
+                risky()
+            except Exception as e:
+                return {"ok": False, "error": str(e)}
+
+        def d():
+            try:
+                risky()
+            except ValueError:
+                pass
+    """
+    assert "swallowed-error" not in rules_hit(lint(src))
+
+
+# -- env-knob-drift ----------------------------------------------------------
+def test_env_drift_flags_unregistered_read():
+    rules = [EnvDriftRule(registered={"MXNET_GOOD"})]
+    src = """
+        import os
+
+        def f():
+            a = os.environ.get("MXNET_GOOD", "1")
+            b = os.environ.get("MXNET_BAD")
+            c = os.getenv("BENCH_NOPE", "0")
+            return a, b, c
+    """
+    findings = lint(src, rules=rules)
+    assert {f.symbol for f in findings} == {"MXNET_BAD", "BENCH_NOPE"}
+
+
+def test_env_drift_near_miss_writes_and_foreign_vars():
+    rules = [EnvDriftRule(registered=set())]
+    src = """
+        import os
+
+        def f():
+            os.environ["MXNET_PRIMED"] = "1"   # write, not a read
+            home = os.environ.get("HOME")      # not a framework prefix
+            name = "MXNET_DYNAMIC"
+            return os.environ.get(name)        # dynamic: not checkable
+    """
+    assert lint(src, rules=rules) == []
+
+
+def test_env_drift_repo_registry_is_parsed():
+    # the production rule parses config.py; a registered knob must pass
+    rule = EnvDriftRule()
+    assert "MXNET_SERVING_MAX_BATCH" in rule.registered
+    src = """
+        import os
+        x = os.environ.get("MXNET_SERVING_MAX_BATCH")
+    """
+    assert lint(src, rules=[rule]) == []
+
+
+# -- suppressions ------------------------------------------------------------
+TORN = """
+    def save(path, doc):
+        {comment_above}
+        with open(path, "w") as f:  {trailing}
+            f.write(doc)
+"""
+
+
+def _torn(comment_above="", trailing=""):
+    return TORN.format(comment_above=comment_above or "pass",
+                       trailing=trailing)
+
+
+def test_suppression_on_line():
+    src = _torn(trailing="# graftlint: disable=torn-write -- test")
+    assert "torn-write" not in rules_hit(lint(src))
+
+
+def test_suppression_line_above():
+    src = _torn(comment_above="# graftlint: disable=torn-write -- test")
+    assert "torn-write" not in rules_hit(lint(src))
+
+
+def test_suppression_all():
+    src = _torn(trailing="# graftlint: disable=all -- test")
+    assert lint(src) == []
+
+
+def test_suppression_wrong_rule_still_flags():
+    src = _torn(trailing="# graftlint: disable=swallowed-error -- test")
+    assert "torn-write" in rules_hit(lint(src))
+
+
+# -- baseline mechanics ------------------------------------------------------
+def test_baseline_absorbs_known_findings():
+    findings = lint(LOCKED_CLASS)
+    assert findings
+    baseline = fingerprint_counts(findings)
+    new, old = diff_baseline(findings, baseline)
+    assert new == [] and len(old) == len(findings)
+
+
+def test_baseline_catches_new_findings():
+    findings = lint(LOCKED_CLASS)
+    baseline = fingerprint_counts(findings)
+    grown = textwrap.dedent(LOCKED_CLASS) + textwrap.dedent("""
+        class Other:
+            def __init__(self):
+                import threading
+                self._lock = threading.Lock()
+                self._d = {}
+
+            def put(self, k):
+                with self._lock:
+                    self._d[k] = 1
+
+            def get(self, k):
+                return self._d[k]
+    """)
+    new, old = diff_baseline(
+        analyze_source(grown, path="mxnet_tpu/fake.py"), baseline)
+    assert len(old) == len(findings)
+    assert new and all(f.symbol == "Other._d" for f in new)
+
+
+def test_fingerprints_stable_across_line_drift():
+    shifted = "\n\n\n# a comment\n" + textwrap.dedent(LOCKED_CLASS)
+    a = fingerprint_counts(lint(LOCKED_CLASS))
+    b = fingerprint_counts(analyze_source(shifted, path="mxnet_tpu/fake.py"))
+    assert a == b
+
+
+def test_make_rules_select_disable():
+    assert {r.id for r in make_rules()} >= {
+        "lock-discipline", "torn-write", "host-sync-in-hot-path",
+        "tracer-leak", "swallowed-error", "env-knob-drift"}
+    only = make_rules(select=["torn-write"])
+    assert [r.id for r in only] == ["torn-write"]
+    without = make_rules(disable=["torn-write"])
+    assert "torn-write" not in {r.id for r in without}
+    with pytest.raises(ValueError):
+        make_rules(select=["no-such-rule"])
+
+
+# -- CLI ---------------------------------------------------------------------
+def _cli(*args):
+    return subprocess.run([sys.executable, GRAFTLINT, *args],
+                          capture_output=True, text=True, timeout=120)
+
+
+def test_cli_baseline_workflow(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        def save(path, doc):
+            with open(path, "w") as f:
+                f.write(doc)
+    """))
+    base = tmp_path / "baseline.json"
+
+    r = _cli(str(bad), "--baseline", str(base), "--fail-on-new")
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "torn-write" in r.stdout
+
+    r = _cli(str(bad), "--baseline", str(base), "--write-baseline")
+    assert r.returncode == 0, r.stdout + r.stderr
+    doc = json.loads(base.read_text())
+    assert any("torn-write" in k for k in doc["findings"])
+
+    r = _cli(str(bad), "--baseline", str(base), "--fail-on-new")
+    assert r.returncode == 0, r.stdout + r.stderr
+
+    # a second, NEW violation must fail even with the baseline
+    bad.write_text(bad.read_text() + textwrap.dedent("""
+        def save2(path, doc):
+            with open(path, "w") as f:
+                f.write(doc)
+    """))
+    r = _cli(str(bad), "--baseline", str(base), "--fail-on-new")
+    assert r.returncode == 1
+    assert "save2" in r.stdout
+
+
+def test_cli_json_and_list_rules(tmp_path):
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    r = _cli(str(clean), "--json")
+    assert r.returncode == 0
+    assert json.loads(r.stdout) == {"findings": [], "parse_errors": []}
+
+    r = _cli("--list-rules")
+    assert r.returncode == 0
+    for rid in ("lock-discipline", "torn-write", "host-sync-in-hot-path",
+                "tracer-leak", "swallowed-error", "env-knob-drift"):
+        assert rid in r.stdout
+
+
+def test_repo_gate_is_green():
+    """The committed baseline keeps the CI gate passing — and the lint
+    is self-clean on its own code (mxnet_tpu/analysis, tools)."""
+    r = _cli("--fail-on-new")
+    assert r.returncode == 0, r.stdout + r.stderr

@@ -125,8 +125,10 @@ def main():
               f"{per*1e3:8.3f} ms  {bw_alg/1e9:8.2f} GB/s", flush=True)
 
     if args.output:
-        with open(args.output, "w") as f:
+        tmp = f"{args.output}.tmp-{os.getpid()}"
+        with open(tmp, "w") as f:
             json.dump(results, f, indent=1)
+        os.replace(tmp, args.output)
         print(f"wrote {args.output}")
     else:
         print(json.dumps(results))

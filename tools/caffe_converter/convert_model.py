@@ -143,8 +143,11 @@ def main():
         sym_, arg_p, aux_p, _n, _d = convert_model(f.read(),
                                                    args.caffemodel)
     from mxnet_tpu import nd
-    with open(args.output_prefix + "-symbol.json", "w") as f:
+    sym_path = args.output_prefix + "-symbol.json"
+    tmp = f"{sym_path}.tmp-{os.getpid()}"
+    with open(tmp, "w") as f:
         f.write(sym_.tojson())
+    os.replace(tmp, sym_path)
     save = {f"arg:{k}": v for k, v in arg_p.items()}
     save.update({f"aux:{k}": v for k, v in aux_p.items()})
     nd.save(args.output_prefix + "-0000.params", save)

@@ -244,8 +244,10 @@ def main():
     args = ap.parse_args()
     with open(args.prototxt) as f:
         s, _name, _dim = convert_symbol(f.read())
-    with open(args.output_json, "w") as f:
+    tmp = f"{args.output_json}.tmp-{os.getpid()}"
+    with open(tmp, "w") as f:
         f.write(s.tojson())
+    os.replace(tmp, args.output_json)
     print(f"saved symbol to {args.output_json}")
 
 

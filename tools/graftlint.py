@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""graftlint — project-native static analysis for the mxnet_tpu repo.
+
+Rules encode invariants this codebase has already paid to learn (see
+docs/lint.md): lock-discipline races, torn writes of durable artifacts,
+device->host syncs in hot loops, tracer leaks in jit code, swallowed
+errors, and env-knob drift against config.py.
+
+Usage:
+  python tools/graftlint.py                      # lint default paths
+  python tools/graftlint.py --fail-on-new        # CI gate (baseline diff)
+  python tools/graftlint.py --write-baseline     # accept current findings
+  python tools/graftlint.py --json path/to.py    # machine-readable
+  python tools/graftlint.py --list-rules
+
+Exit codes: 0 clean (or only baselined findings with --fail-on-new),
+1 gate failure, 2 usage/internal error.
+
+The analysis package is loaded straight from its directory so that
+linting never imports mxnet_tpu itself (no jax/numpy import cost).
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DEFAULT_PATHS = ("mxnet_tpu", "tools", "bench.py", "__graft_entry__.py")
+DEFAULT_BASELINE = os.path.join("ci", "graftlint_baseline.json")
+
+
+def _load_analysis():
+    pkg_dir = os.path.join(REPO, "mxnet_tpu", "analysis")
+    spec = importlib.util.spec_from_file_location(
+        "graftlint_analysis", os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["graftlint_analysis"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="graftlint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="*",
+                    help=f"files/dirs to lint (default: {DEFAULT_PATHS})")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline JSON path (repo-relative)")
+    ap.add_argument("--fail-on-new", action="store_true",
+                    help="exit 1 when findings exceed the baseline")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="commit current findings as the baseline")
+    ap.add_argument("--select", default="",
+                    help="comma-separated rule ids to run exclusively")
+    ap.add_argument("--disable", default="",
+                    help="comma-separated rule ids to skip")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    an = _load_analysis()
+
+    if args.list_rules:
+        for rid, cls in sorted(an.all_rules().items()):
+            print(f"{rid:<22} [{cls.severity}] {cls.doc}")
+        return 0
+
+    try:
+        rules = an.make_rules(
+            select=[r for r in args.select.split(",") if r] or None,
+            disable=[r for r in args.disable.split(",") if r])
+    except ValueError as e:
+        print(f"graftlint: {e}", file=sys.stderr)
+        return 2
+
+    paths = args.paths or [os.path.join(REPO, p) for p in DEFAULT_PATHS]
+    findings, errors = an.analyze_paths(paths, rules=rules, root=REPO)
+
+    baseline_path = (args.baseline if os.path.isabs(args.baseline)
+                     else os.path.join(REPO, args.baseline))
+
+    if args.write_baseline:
+        an.write_baseline(baseline_path, findings)
+        print(f"graftlint: baseline written to "
+              f"{os.path.relpath(baseline_path, REPO)} "
+              f"({len(findings)} finding(s))")
+        return 0
+
+    if args.fail_on_new:
+        baseline = an.load_baseline(baseline_path)
+        new, old = an.diff_baseline(findings, baseline)
+        stale = sum(baseline.values()) - len(old)
+        if args.json:
+            print(an.render_json(new, errors))
+        else:
+            print(an.render_text(
+                new, errors,
+                title=f"graftlint --fail-on-new ({len(old)} baselined, "
+                      f"{stale} baseline entr{'y' if stale == 1 else 'ies'} "
+                      "now stale)"))
+            if stale > 0:
+                print("graftlint: note: the baseline over-counts — "
+                      "shrink it with --write-baseline")
+        if new or errors:
+            return 1
+        return 0
+
+    if args.json:
+        print(an.render_json(findings, errors))
+    else:
+        print(an.render_text(findings, errors))
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -206,8 +206,10 @@ def main():
         compiled = jax.jit(step).lower(*step_args).compile()
         hlo = compiled.as_text()
         if args.dump_hlo:
-            with open(args.dump_hlo, "w") as f:
+            tmp = f"{args.dump_hlo}.tmp-{os.getpid()}"
+            with open(tmp, "w") as f:
                 f.write(hlo)
+            os.replace(tmp, args.dump_hlo)
         try:
             ca = compiled.cost_analysis()
             if isinstance(ca, (list, tuple)):
@@ -280,12 +282,14 @@ def main():
         print(f"  {op['flops']/1e9:8.2f} GF  {kind}{tag}  out={op.get('out')} "
               f"k={op.get('kernel', op.get('k'))} {op['dtype']}")
     if args.json:
-        with open(args.json, "w") as f:
+        tmp = f"{args.json}.tmp-{os.getpid()}"
+        with open(tmp, "w") as f:
             json.dump({"batch": args.batch, "analytic": analytic,
                        "cost_analysis": ca_flops, "conv_total": total_conv,
                        "dot_total": total_dot,
                        "lhs_dilated_total": sum(c["flops"] for c in dil),
                        "convs": convs, "dots": dots}, f, indent=1)
+        os.replace(tmp, args.json)
     print("\nop histogram:", dict(notes.most_common(20)))
 
 

@@ -48,13 +48,15 @@ def list_images(root, recursive, exts):
 
 
 def write_list(path_out, image_list):
-    with open(path_out, "w") as fout:
+    tmp = f"{path_out}.tmp-{os.getpid()}"
+    with open(tmp, "w") as fout:
         for i, item in enumerate(image_list):
             line = "%d\t" % item[0]
             for j in item[2:]:
                 line += "%f\t" % j
             line += "%s\n" % item[1]
             fout.write(line)
+    os.replace(tmp, path_out)
 
 
 def read_list(path_in):

@@ -142,8 +142,12 @@ def main():
             rec = {"status": "error", "error": f"{type(e).__name__}: {e}"}
             n_fail += 1
         report["ops"][name] = rec
-        with open(args.out, "w") as f:
+        # rewritten after every op: replace atomically so a killed sweep
+        # still leaves a loadable report
+        tmp = f"{args.out}.tmp-{os.getpid()}"
+        with open(tmp, "w") as f:
             json.dump(report, f, indent=1)
+        os.replace(tmp, args.out)
         if i % 25 == 0:
             log(f"{i}/{len(names)} swept ({n_ok} ok, {n_fail} errors)")
 
@@ -152,8 +156,10 @@ def main():
     summary = {"metric": "tpu_op_sweep", "swept": len(report["ops"]),
                "total": len(names), "mismatch_or_error": len(bad)}
     report["summary"] = summary
-    with open(args.out, "w") as f:
+    tmp = f"{args.out}.tmp-{os.getpid()}"
+    with open(tmp, "w") as f:
         json.dump(report, f, indent=1)
+    os.replace(tmp, args.out)
     for k, v in sorted(bad.items()):
         log(f"BAD {k}: {v}")
     print(json.dumps(summary))
