@@ -24,6 +24,11 @@ python tools/graftlint.py --fail-on-new
 echo "== unit suite (virtual 8-device CPU mesh via tests/conftest.py) =="
 MXNET_TEST_EXAMPLES=1 python -m pytest tests/ -q
 
+echo "== fused train step smoke (<=3 dispatches/step, loop parity) =="
+# the fused path must issue at most 3 XLA dispatches per train step and
+# stay bit-identical to the per-param update loop (docs/perf_notes.md)
+JAX_PLATFORMS=cpu python -m mxnet_tpu.fused_step
+
 echo "== serving smoke (dynamic batcher, 64 concurrent clients) =="
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
   python -m mxnet_tpu.serving.smoke

@@ -97,6 +97,13 @@ class Speedometer:
                         (time.time() - self.tic)
                 except ZeroDivisionError:
                     speed = float("inf")
+                # metric syncs may be batched (MXNET_METRIC_SYNC_INTERVAL):
+                # drain the module's pending updates so the logged values
+                # cover every batch up to `count`
+                mod = (param.locals or {}).get("self")
+                flush = getattr(mod, "flush_metric_updates", None)
+                if flush is not None:
+                    flush()
                 if param.eval_metric is not None:
                     name_value = param.eval_metric.get_name_value()
                     if self.auto_reset:

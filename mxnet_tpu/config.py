@@ -131,6 +131,24 @@ _register("MXNET_MP_START_METHOD", str, "forkserver",
           "multiprocessing start method for DataLoader worker pools; "
           "'fork' restores zero-pickle datasets but deadlocks once "
           "jax's XLA thread pools are live (gluon/data/dataloader.py)")
+# -- fused train step --------------------------------------------------------
+_register("MXNET_FUSED_STEP", bool, True,
+          "Module train steps: trace forward+backward+optimizer update "
+          "into ONE donated jax.jit computation (1 dispatch/step) when "
+          "the optimizer exposes fused_update; 0 restores the per-param "
+          "dispatch loop (docs/perf_notes.md dispatch overhead)")
+_register("MXNET_METRIC_SYNC_INTERVAL", int, 1,
+          "Module.update_metric: flush buffered (label, output) pairs "
+          "into the metric every N batches instead of forcing a "
+          "device->host sync per batch; 1 = sync every batch (exact "
+          "legacy behaviour). N>1 requires the data iterator to hand "
+          "out fresh label arrays per batch (NDArrayIter does; staged "
+          "fit batches always do)")
+_register("MXNET_FIT_STAGE_NEXT", bool, True,
+          "fit loop: stage the NEXT DataBatch host->device "
+          "(jax.device_put) while the current step is still in flight, "
+          "overlapping input feed with compute; 0 feeds batches "
+          "synchronously at forward time")
 # -- fused kernels -----------------------------------------------------------
 _register("MXNET_FUSED_LAYERNORM", str, "auto",
           "fused Pallas LayerNorm: 1 forces on, 0 forces plain XLA, "
@@ -252,6 +270,20 @@ _register("BENCH_SERVE_BATCH", int, 32,
           "bench.py serving phase: DynamicBatcher max_batch_size")
 _register("BENCH_SERVE_LATENCY_MS", float, 10.0,
           "bench.py serving phase: DynamicBatcher max_latency_ms")
+_register("BENCH_DISPATCH", bool, True,
+          "bench.py: measure fused-train-step dispatch phases on the CPU "
+          "backend (resnet50_step_dispatches / train_step_ms_bs32); "
+          "needs no TPU relay")
+_register("BENCH_DISPATCH_STEPS", int, 20,
+          "bench.py dispatch phase: timed Module steps for "
+          "train_step_ms_bs32")
+_register("BENCH_DISPATCH_IMAGE", int, 32,
+          "bench.py dispatch phase: ResNet-50 image edge for the "
+          "dispatch count (count is shape-independent; small keeps CPU "
+          "convs cheap)")
+_register("BENCH_DISPATCH_BATCH", int, 4,
+          "bench.py dispatch phase: ResNet-50 batch for the dispatch "
+          "count")
 _register("BENCH_CKPT", bool, True,
           "bench.py: also measure checkpoint save-blocking time and "
           "restore latency (ckpt_save_blocking_ms / ckpt_restore_s)")

@@ -66,6 +66,71 @@ class DataBatch:
                f"label shapes: {label_shapes}"
 
 
+def stage_batch(batch, ctx):
+    """Copy a DataBatch's data/label host->device ahead of need.
+
+    ``jax.device_put`` is asynchronous under PJRT, so staging batch N+1
+    while batch N's (fused) train step is still in flight overlaps the
+    input feed with device compute — the double-buffer half of the
+    one-dispatch train step (fused_step.py).  Arrays already on ``ctx``'s
+    device pass through untouched; the returned DataBatch keeps
+    pad/index/bucket_key/provide_* so it is a drop-in replacement."""
+    import logging
+
+    import jax
+
+    try:
+        dev = ctx.jax_device if ctx is not None else None
+    except Exception as e:  # noqa: BLE001 — stage-ahead is best-effort
+        logging.getLogger(__name__).debug(
+            "batch staging skipped: ctx %s has no jax device (%s: %s)",
+            ctx, type(e).__name__, e)
+        dev = None
+    if dev is None:
+        return batch
+
+    def put(arrs):
+        if not arrs:
+            return arrs
+        out = []
+        for a in arrs:
+            if isinstance(a, NDArray):
+                buf = a._data
+                out.append(a if dev in buf.devices()
+                           else NDArray(jax.device_put(buf, dev), ctx))
+            else:
+                out.append(NDArray(
+                    jax.device_put(np.asarray(a), dev), ctx))
+        return out
+
+    return DataBatch(data=put(batch.data),
+                     label=put(batch.label) if batch.label else batch.label,
+                     pad=batch.pad, index=batch.index,
+                     bucket_key=batch.bucket_key,
+                     provide_data=batch.provide_data,
+                     provide_label=batch.provide_label)
+
+
+def make_batch_stager(ctx):
+    """A ``batch -> staged batch`` callable for the fit loop's input
+    double-buffer, or None when staging is off (MXNET_FIT_STAGE_NEXT=0)
+    or the context has no jax device to stage onto."""
+    import logging
+
+    from . import config as _config
+    if ctx is None or not _config.get("MXNET_FIT_STAGE_NEXT"):
+        return None
+    try:
+        if ctx.jax_device is None:
+            return None
+    except Exception as e:  # noqa: BLE001 — staging is an optimization
+        logging.getLogger(__name__).debug(
+            "fit input double-buffer off: ctx %s has no jax device "
+            "(%s: %s)", ctx, type(e).__name__, e)
+        return None
+    return lambda batch: stage_batch(batch, ctx)
+
+
 class DataIter:
     """Base data iterator (parity: io.py DataIter)."""
 

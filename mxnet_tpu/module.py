@@ -69,6 +69,7 @@ class BaseModule:
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
 
+        stager = mx_io.make_batch_stager(getattr(self, "_context", None))
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
@@ -76,17 +77,30 @@ class BaseModule:
             data_iter = iter(train_data)
             end_of_batch = False
             next_data_batch = next(data_iter)
+            if stager is not None:
+                next_data_batch = stager(next_data_batch)
             while not end_of_batch:
                 data_batch = next_data_batch
                 if monitor is not None:
                     monitor.tic()
                 self.forward_backward(data_batch)
+                if stager is not None:
+                    # double-buffer input feed: batch N+1's host->device
+                    # copy overlaps the step still in flight on batch N
+                    # (the staged copy also makes buffer-reusing iterators
+                    # safe to prefetch from before update_metric reads
+                    # batch N's labels)
+                    try:
+                        next_data_batch = stager(next(data_iter))
+                    except StopIteration:
+                        end_of_batch = True
                 self.update()
                 self.update_metric(eval_metric, data_batch.label)
-                try:
-                    next_data_batch = next(data_iter)
-                except StopIteration:
-                    end_of_batch = True
+                if stager is None:
+                    try:
+                        next_data_batch = next(data_iter)
+                    except StopIteration:
+                        end_of_batch = True
                 if monitor is not None:
                     monitor.toc_print()
                 if batch_end_callback is not None:
@@ -96,6 +110,7 @@ class BaseModule:
                     for callback in _as_list(batch_end_callback):
                         callback(batch_end_params)
                 nbatch += 1
+            self.flush_metric_updates()
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
             toc = time.time()
@@ -138,6 +153,7 @@ class BaseModule:
                 for callback in _as_list(batch_end_callback):
                     callback(batch_end_params)
             actual_num_batch += 1
+        self.flush_metric_updates()
         if score_end_callback:
             params = BatchEndParam(epoch=epoch, nbatch=actual_num_batch,
                                    eval_metric=eval_metric, locals=locals())
@@ -204,6 +220,10 @@ class BaseModule:
     def update_metric(self, eval_metric, labels, pre_sliced=False):
         raise NotImplementedError()
 
+    def flush_metric_updates(self):
+        """Drain metric updates buffered under MXNET_METRIC_SYNC_INTERVAL
+        (no-op for modules that sync every batch)."""
+
     def install_monitor(self, mon):
         raise NotImplementedError()
 
@@ -247,6 +267,11 @@ class Module(BaseModule):
         self._data_shapes = None
         self._label_shapes = None
         self._monitor = None
+        self._fused = None
+        self._fused_step_done = False
+        self._fused_disabled = False
+        self._zero_buf_cache = {}
+        self._pending_metric = []
 
     @staticmethod
     def load(prefix, epoch, load_optimizer_states=False, **kwargs):
@@ -339,6 +364,7 @@ class Module(BaseModule):
         self._exec = self.symbol.simple_bind(self._context,
                                              grad_req=grad_req_dict,
                                              **shape_kwargs)
+        self._fused = None  # new executor: the fused step must re-trace
         if self._arg_params is not None:
             self._exec.copy_params_from(self._arg_params, self._aux_params,
                                         allow_extra_params=True)
@@ -461,6 +487,8 @@ class Module(BaseModule):
                 "batch-summed; consider rescale_grad=1/batch_size)")
         self._optimizer = optimizer
         self._updater = opt_mod.get_updater(optimizer)
+        self._fused = None  # optimizer changed: invalidate the fused trace
+        self._fused_disabled = False
         arg_params = {n: self._exec.arg_dict[n] for n in self._param_names}
         kv, update_on_kvstore = _create_kvstore(kvstore, 1, arg_params)
         self._kvstore = kv
@@ -492,6 +520,9 @@ class Module(BaseModule):
         assert self.binded and self.params_initialized
         if is_train is None:
             is_train = self.for_training
+        # a manual forward supersedes any fused step still pending its
+        # update() no-op: the next update() must run the loop
+        self._fused_step_done = False
         feed = {}
         for desc, arr in zip(self._data_shapes, data_batch.data):
             feed[desc.name] = arr
@@ -603,11 +634,68 @@ class Module(BaseModule):
         assert self.binded and self.params_initialized
         self._exec.backward(out_grads=out_grads)
 
+    def forward_backward(self, data_batch):
+        """Forward + backward; when the setup is eligible this runs the
+        FUSED step instead — forward + VJP + optimizer update as one
+        donated XLA dispatch (fused_step.py) — and the following
+        ``update()`` becomes a no-op."""
+        if self._maybe_fused_step(data_batch):
+            return
+        self.forward(data_batch, is_train=True)
+        self.backward()
+
+    def _fused_eligible(self):
+        from . import config as _config
+        if not _config.get("MXNET_FUSED_STEP") or self._fused_disabled:
+            return False
+        if not (self.binded and self.params_initialized
+                and self.optimizer_initialized and self.for_training):
+            return False
+        if getattr(self, "_kvstore", None) is not None:
+            return False  # grads must sync/update through the store
+        if self.inputs_need_grad or self._monitor is not None:
+            return False
+        ex = self._exec
+        if ex is None or ex._grouped is not None or \
+                ex._monitor_callback is not None:
+            return False
+        if not callable(getattr(self._optimizer, "fused_update", None)):
+            return False  # custom optimizer: per-param loop, silently
+        if any(ex.grad_req.get(n, "null") not in ("write", "null")
+               for n in ex._arg_names):
+            return False  # "add" accumulation needs live grad buffers
+        return True
+
+    def _maybe_fused_step(self, data_batch):
+        if not self._fused_eligible():
+            return False
+        fs = self._fused
+        if fs is None or fs.stale(self):
+            from .fused_step import FusedTrainStep
+            fs = self._fused = FusedTrainStep(self)
+        try:
+            ran = fs.step(data_batch)
+        except Exception as e:  # trace-time failure: fall back for good
+            self.logger.warning(
+                "fused train step disabled (%s: %s); falling back to the "
+                "per-param update loop", type(e).__name__, e)
+            self._fused_disabled = True
+            self._fused = None
+            return False
+        if ran:
+            self._fused_step_done = True
+        return ran
+
     def update(self):
         """Apply optimizer to gradients (parity: module.py update →
-        model.py _update_params_on_kvstore / local updater)."""
+        model.py _update_params_on_kvstore / local updater).  After a
+        fused forward_backward the weights are already updated and this
+        is a no-op."""
         assert self.binded and self.params_initialized and \
             self.optimizer_initialized
+        if self._fused_step_done:
+            self._fused_step_done = False
+            return
         kv = getattr(self, "_kvstore", None)
         if kv is not None and self._update_on_kvstore:
             # optimizer runs IN the store (server-side for dist)
@@ -615,18 +703,39 @@ class Module(BaseModule):
                 [[self._exec.arg_dict[n]] for n in self._param_names],
                 [[self._exec.grad_dict.get(n)] for n in self._param_names],
                 kv, self._param_names)
-            for name in self._param_names:
-                g = self._exec.grad_dict.get(name)
-                if g is not None:
-                    g[:] = 0.0
+            self._zero_grads()
             return
         for i, name in enumerate(self._param_names):
             grad = self._exec.grad_dict.get(name)
-            if grad is None:
-                continue
+            if grad is None or \
+                    self._exec.grad_req.get(name, "null") == "null":
+                continue  # fixed/ungradded params take no optimizer step
             weight = self._exec.arg_dict[name]
             self._updater(i, grad, weight)
-            grad[:] = 0.0  # write-mode semantics for the next backward
+        self._zero_grads()
+
+    def _zero_grads(self):
+        """Write-mode semantics for the next backward, WITHOUT the old
+        one-dispatch-per-param ``grad[:] = 0.0`` loop: every grad NDArray
+        swaps to a cached immutable zero buffer (jax arrays are
+        copy-on-write, sharing is safe), so steady-state zeroing costs no
+        device dispatch at all.  Params with no grad buffer or grad_req
+        "null" are skipped."""
+        import jax as _jax
+        import jax.numpy as _jnp
+        cache = self._zero_buf_cache
+        for name in self._param_names:
+            g = self._exec.grad_dict.get(name)
+            if g is None or \
+                    self._exec.grad_req.get(name, "null") == "null":
+                continue
+            dev = next(iter(g._data.devices()))
+            key = (tuple(g.shape), str(g._data.dtype), dev)
+            z = cache.get(key)
+            if z is None:
+                z = cache[key] = _jax.device_put(
+                    _jnp.zeros(g.shape, g._data.dtype), dev)
+            g._set_data(z)
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized
@@ -656,10 +765,34 @@ class Module(BaseModule):
         return [self._exec.grad_dict[n] for n in self._data_names]
 
     def update_metric(self, eval_metric, labels, pre_sliced=False):
-        eval_metric.update_dict(
-            {name: l for name, l in zip([d.name for d in self._label_shapes],
-                                        labels)},
-            dict(zip(self.output_names, self.get_outputs())))
+        """Feed (labels, outputs) to the metric.  The metric math runs on
+        host numpy, so every call forces a device->host sync; with
+        MXNET_METRIC_SYNC_INTERVAL=N the pairs are buffered (device
+        arrays, no copy) and flushed every N batches — the device races
+        ahead and the N transfers amortize into one stall.  Buffering
+        requires label arrays that are not reused by the iterator
+        (NDArrayIter and staged fit batches qualify; see docs)."""
+        from . import config as _config
+        label_map = {name: l for name, l in
+                     zip([d.name for d in self._label_shapes], labels)}
+        pred_map = dict(zip(self.output_names, self.get_outputs()))
+        if _config.get("MXNET_METRIC_SYNC_INTERVAL") <= 1:
+            eval_metric.update_dict(label_map, pred_map)
+            return
+        self._pending_metric.append((eval_metric, label_map, pred_map))
+        if len(self._pending_metric) >= \
+                _config.get("MXNET_METRIC_SYNC_INTERVAL"):
+            self.flush_metric_updates()
+
+    def flush_metric_updates(self):
+        """Drain metric updates buffered under MXNET_METRIC_SYNC_INTERVAL;
+        the deferred device->host transfers all happen here."""
+        pending = self._pending_metric
+        if not pending:
+            return
+        self._pending_metric = []
+        for metric, label_map, pred_map in pending:
+            metric.update_dict(label_map, pred_map)
 
     @property
     def output_names(self):

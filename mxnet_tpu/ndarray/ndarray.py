@@ -523,6 +523,9 @@ class NDArray:
                 v = jnp.broadcast_to(v, self._data.shape).astype(self.dtype)
             self._set_data(v.astype(self.dtype))
         else:
+            if not isinstance(self._data, jax.core.Tracer):
+                from .. import profiler as _prof
+                _prof.record_dispatch("op")
             self._set_data(self._data.at[key].set(v))
 
     def __repr__(self):
@@ -626,6 +629,12 @@ def invoke(op, inputs, attrs, out=None):
 
     single = not isinstance(outs, (tuple, list))
     outs = (outs,) if single else tuple(outs)
+
+    if not isinstance(outs[0], jax.core.Tracer):
+        # dispatches-per-step lane (docs/perf_notes.md): one eager op =
+        # one XLA computation launch; traced calls are someone else's
+        from .. import profiler as _prof
+        _prof.record_dispatch("op")
 
     if _prof_t0 is not None:
         import time as _time

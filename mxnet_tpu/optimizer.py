@@ -83,6 +83,32 @@ class Optimizer:
     def update(self, index, weight, grad, state):
         raise NotImplementedError()
 
+    # -- fused pytree form (one-dispatch train step) -----------------------
+    # Optimizers that can run their update as pure jax math over the whole
+    # parameter pytree override ``fused_update``; the fused train step
+    # (mxnet_tpu/fused_step.py) traces it together with forward+backward
+    # into ONE donated XLA computation.  Optimizers that keep the class
+    # attribute ``None`` (anything host-side/stateful: LARS norms, LAMB
+    # trust ratios, sparse-lazy paths, user subclasses) silently fall back
+    # to the per-param dispatch loop in Module.update.
+    fused_update = None
+
+    def fused_hyperparams(self, indices):
+        """Host-side per-step dynamic scalars for ``fused_update``:
+        ``(lr_t, wd_t)`` python-float lists, evaluated ONCE per step
+        AFTER ``_update_count`` so lr schedules/bias corrections see the
+        same step count as the per-param loop.  They are passed into the
+        jitted step as weak-typed scalar ARGUMENTS (never baked into the
+        trace), so a changing lr schedule does not recompile."""
+        return ([float(self._get_lr(i)) for i in indices],
+                [float(self._get_wd(i)) for i in indices])
+
+    def fused_static_signature(self):
+        """Hyperparameters baked into the fused trace as constants; the
+        fused step retraces when this tuple changes (mutating e.g.
+        ``rescale_grad`` mid-training stays correct, just slower)."""
+        return (self.rescale_grad, self.clip_gradient, self.multi_precision)
+
     def update_multi_precision(self, index, weight, grad, state):
         if self.multi_precision and weight.dtype == np.float16:
             original_state, weight_master_copy = state
@@ -241,6 +267,53 @@ class SGD(Optimizer):
         else:
             _invoke("mp_sgd_update", [weight, grad, w32], attrs, weight)
 
+    def fused_update(self, params, grads, states, lr_t, wd_t):
+        """Whole-pytree functional SGD step for the fused train step.
+
+        Mirrors ``sgd_update``/``sgd_mom_update``/``mp_sgd_*``
+        (ops/_op_optimizer.py) bit for bit — same op order, same python-
+        float constants for rescale/clip/momentum — with lr/wd arriving
+        as traced weak-typed scalars (no recompile across schedules).
+        The multi-precision branch is chosen per param from the state
+        STRUCTURE, exactly like ``update_multi_precision``."""
+        import jax.numpy as jnp
+        rescale = self.rescale_grad
+        clip = self.clip_gradient
+        momentum = self.momentum
+        new_params, new_states = [], []
+        for w, g, s, lr, wd in zip(params, grads, states, lr_t, wd_t):
+            use_mp = self.multi_precision and isinstance(s, tuple) and \
+                len(s) == 2 and hasattr(s[1], "shape") and \
+                tuple(s[1].shape) == tuple(w.shape)
+            if use_mp:
+                mom, w32 = s
+                g32 = g.astype(jnp.float32) * rescale
+                if clip is not None:
+                    g32 = jnp.clip(g32, -clip, clip)
+                if mom is not None:
+                    nm = momentum * mom - lr * (g32 + wd * w32)
+                    nw32 = w32 + nm
+                    new_states.append((nm, nw32))
+                else:
+                    nw32 = w32 - lr * (g32 + wd * w32)
+                    new_states.append((None, nw32))
+                new_params.append(nw32.astype(w.dtype))
+                continue
+            gi = g * rescale
+            if clip is not None:
+                gi = jnp.clip(gi, -clip, clip)
+            if s is not None:
+                nm = momentum * s - lr * (gi + wd * w)
+                new_params.append(w + nm)
+                new_states.append(nm)
+            else:
+                new_params.append(w - lr * (gi + wd * w))
+                new_states.append(None)
+        return new_params, new_states
+
+    def fused_static_signature(self):
+        return super().fused_static_signature() + (self.momentum,)
+
     def _aggregated_update(self, indices, weights, grads, states):
         """One multi_sgd_* dispatch for N weights (optimizer_op.cc:320;
         list-typed update_multi_precision mirrors the reference SGD)."""
@@ -365,6 +438,47 @@ class Adam(Optimizer):
         attrs.update(beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon)
         mean, var = state
         _invoke("adam_update", [weight, grad, mean, var], attrs, weight)
+
+    def fused_update(self, params, grads, states, lr_t, wd_t):
+        """Whole-pytree functional Adam step (mirrors ``adam_update`` in
+        ops/_op_optimizer.py bit for bit).  The bias-corrected lr is
+        folded into ``lr_t`` host-side by ``fused_hyperparams`` — same
+        f64 arithmetic as ``update`` — so the step count never bakes
+        into the trace."""
+        import jax.numpy as jnp
+        if self.multi_precision:
+            raise MXNetError(
+                "Adam.fused_update does not implement the multi-precision "
+                "master-weight wrapper; the per-param loop handles it")
+        rescale = self.rescale_grad
+        clip = self.clip_gradient
+        b1, b2, eps = self.beta1, self.beta2, self.epsilon
+        new_params, new_states = [], []
+        for w, g, s, lr, wd in zip(params, grads, states, lr_t, wd_t):
+            mean, var = s
+            gi = g * rescale
+            if clip is not None:
+                gi = jnp.clip(gi, -clip, clip)
+            gi = gi + wd * w
+            m = b1 * mean + (1 - b1) * gi
+            v = b2 * var + (1 - b2) * jnp.square(gi)
+            new_params.append(w - lr * m / (jnp.sqrt(v) + eps))
+            new_states.append((m, v))
+        return new_params, new_states
+
+    def fused_hyperparams(self, indices):
+        lrs, wds = [], []
+        for i in indices:
+            t = self._index_update_count[i]
+            lr = self._get_lr(i)
+            lr *= math.sqrt(1.0 - self.beta2 ** t) / (1.0 - self.beta1 ** t)
+            lrs.append(float(lr))
+            wds.append(float(self._get_wd(i)))
+        return lrs, wds
+
+    def fused_static_signature(self):
+        return super().fused_static_signature() + \
+            (self.beta1, self.beta2, self.epsilon)
 
 
 @register
@@ -655,6 +769,10 @@ class FTML(Optimizer):
 class LBSGD(SGD):
     """Large-batch SGD with LARS-style layer-wise adaptive rates
     (parity: optimizer.py LBSGD, simplified to the LARS core)."""
+
+    # LARS computes trust ratios from host-side norms (asscalar below) —
+    # that cannot trace into the fused one-dispatch step; stay on the loop
+    fused_update = None
 
     def __init__(self, momentum=0.0, eta=0.001, **kwargs):
         kwargs.pop("multi_precision", None)

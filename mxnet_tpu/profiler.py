@@ -150,6 +150,7 @@ def _reset_after_fork():
     _state["jax_trace_dir"] = None
     with _records_lock:
         _records.clear()
+        _dispatch_counts.clear()
 
 
 def device_sync_enabled():
@@ -210,6 +211,33 @@ def record_counter(name, value, args_key="value"):
             "ts": (time.perf_counter() - _t0) * 1e6,
             "pid": os.getpid(), "args": {args_key: value},
         })
+
+
+_dispatch_counts = {}
+
+
+def record_dispatch(kind="op"):
+    """Count one framework-issued XLA computation launch (an eager op
+    ``invoke``, a compiled executor forward/backward, a fused train
+    step).  Unlike trace events these are counted even while the
+    profiler is stopped, so bench/CI can measure dispatches-per-step
+    (docs/perf_notes.md "dispatch overhead") without arming a trace.
+    Host<->device transfers are deliberately NOT counted — they overlap
+    compute under PJRT; this lane measures computation launches."""
+    with _records_lock:
+        _dispatch_counts[kind] = _dispatch_counts.get(kind, 0) + 1
+        _dispatch_counts["total"] = _dispatch_counts.get("total", 0) + 1
+
+
+def dispatch_counts():
+    """Snapshot of launch counts by kind plus a running ``total``."""
+    with _records_lock:
+        return dict(_dispatch_counts)
+
+
+def reset_dispatch_counts():
+    with _records_lock:
+        _dispatch_counts.clear()
 
 
 def last_counters():

@@ -193,6 +193,8 @@ class Executor:
         else:
             outs, new_aux = jitted(key_arr, arg_arrays, aux_arrays)
             self._vjp_holder = None
+        from .. import profiler as _prof
+        _prof.record_dispatch("graph")
         self._last_is_train = bool(is_train)
         for arr, new in zip(self.aux_arrays, new_aux):
             arr._set_data(new)
@@ -272,6 +274,8 @@ class Executor:
                 out_grads = [out_grads]
             cts = tuple(g._data for g in out_grads)
         grads = _BWD_EXEC(vjp_fn, (cts, tuple(zero_aux)))
+        from .. import profiler as _prof
+        _prof.record_dispatch("graph")
         for i, g in zip(grad_args, grads):
             name = self._arg_names[i]
             req = self.grad_req.get(name, "null")

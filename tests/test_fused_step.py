@@ -1,0 +1,348 @@
+"""Fused train step: one donated XLA computation per step.
+
+Covers the contracts from the dispatch-overhead PR (docs/perf_notes.md):
+
+* numerical parity — the fused forward+VJP+update program is BIT-identical
+  to the per-param dispatch loop over >= 10 steps for SGD, SGD-momentum
+  and Adam (fp32), and for multi-precision SGD at the optimizer level
+  (fp16 weights + fp32 master copies);
+* donation safety — old weight buffers are actually donated (deleted)
+  after a step, while externally-held arrays are defensively copied and
+  survive;
+* fallback — custom optimizers without ``fused_update``, kvstore setups,
+  and the MXNET_FUSED_STEP=0 opt-out silently use the per-param loop;
+* no recompiles across lr-schedule changes (trace counter stays at 1);
+* checkpoint save/restore round-trips through a fused-step Module;
+* MXNET_METRIC_SYNC_INTERVAL batching + Speedometer flush;
+* the batched grad zeroing (no per-param dispatch, grads read as zeros).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import io as mxio
+from mxnet_tpu import profiler as prof
+
+
+def _mlp():
+    d = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(d, num_hidden=32, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, num_hidden=10, name="fc2")
+    return mx.sym.SoftmaxOutput(h, name="softmax")
+
+
+def _data(bs=16, feat=20, seed=3):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(bs, feat).astype(np.float32)
+    y = rng.randint(0, 10, bs).astype(np.float32)
+    return mxio.DataBatch(data=[mx.nd.array(x)], label=[mx.nd.array(y)])
+
+
+def _init_params(seed=5):
+    rng = np.random.RandomState(seed)
+    return {"fc1_weight": mx.nd.array(rng.randn(32, 20) * 0.1),
+            "fc1_bias": mx.nd.zeros((32,)),
+            "fc2_weight": mx.nd.array(rng.randn(10, 32) * 0.1),
+            "fc2_bias": mx.nd.zeros((10,))}
+
+
+def _make_module(optimizer="sgd", opt_params=None, fixed=None):
+    mod = mx.mod.Module(_mlp(), context=mx.cpu(),
+                        fixed_param_names=fixed)
+    mod.bind(data_shapes=[("data", (16, 20))],
+             label_shapes=[("softmax_label", (16,))])
+    mod.init_params(arg_params={k: v.copy()
+                                for k, v in _init_params().items()})
+    mod.init_optimizer(kvstore=None, optimizer=optimizer,
+                       optimizer_params=opt_params or
+                       {"learning_rate": 0.05})
+    return mod
+
+
+def _run_steps(mod, batch, steps):
+    mx.random.seed(0)
+    outs = []
+    for _ in range(steps):
+        mod.forward_backward(batch)
+        mod.update()
+        outs.append(mod.get_outputs()[0].asnumpy())
+    params, _ = mod.get_params()
+    return {k: v.asnumpy() for k, v in params.items()}, outs
+
+
+@pytest.mark.parametrize("optimizer,opt_params", [
+    ("sgd", {"learning_rate": 0.05}),
+    ("sgd", {"learning_rate": 0.05, "momentum": 0.9, "wd": 1e-4}),
+    ("adam", {"learning_rate": 0.01, "wd": 1e-4}),
+])
+def test_fused_parity_bitwise(monkeypatch, optimizer, opt_params):
+    """Fused step == per-param loop bit for bit over 10 steps, including
+    outputs every step and the optimizer state at the end."""
+    batch = _data()
+    monkeypatch.setenv("MXNET_FUSED_STEP", "1")
+    mf = _make_module(optimizer, dict(opt_params))
+    pf, of = _run_steps(mf, batch, 10)
+    assert prof.dispatch_counts().get("fused_step"), \
+        "fused path did not engage"
+    monkeypatch.setenv("MXNET_FUSED_STEP", "0")
+    ml = _make_module(optimizer, dict(opt_params))
+    pl, ol = _run_steps(ml, batch, 10)
+    for k in pf:
+        assert np.array_equal(pf[k], pl[k]), f"param {k} diverged"
+    for a, b in zip(of, ol):
+        assert np.array_equal(a, b), "outputs diverged"
+    # optimizer state (momenta / adam moments) must match too
+    import pickle
+    sf = pickle.loads(mf.get_optimizer_states())
+    sl = pickle.loads(ml.get_optimizer_states())
+    for i in sf:
+        leaves_f = [x for x in (sf[i] if isinstance(sf[i], tuple)
+                                else (sf[i],)) if x is not None]
+        leaves_l = [x for x in (sl[i] if isinstance(sl[i], tuple)
+                                else (sl[i],)) if x is not None]
+        for a, b in zip(leaves_f, leaves_l):
+            assert np.array_equal(a.asnumpy(), b.asnumpy()), \
+                f"optimizer state {i} diverged"
+
+
+def test_fused_parity_multi_precision():
+    """fp16 weights + multi_precision: fused_update mirrors the
+    mp_sgd_mom_update per-param loop bit for bit (optimizer level — the
+    Module binds fp32, so mp is exercised directly)."""
+    import jax
+    from mxnet_tpu import optimizer as opt_mod
+
+    rng = np.random.RandomState(0)
+    shapes = [(8, 4), (8,), (3, 8)]
+    weights_l = [mx.nd.array(rng.randn(*s) * 0.5).astype(np.float16)
+                 for s in shapes]
+    weights_f = [w.copy() for w in weights_l]
+    grads = [[mx.nd.array(rng.randn(*s)).astype(np.float16)
+              for s in shapes] for _ in range(6)]
+
+    def mk():
+        return opt_mod.SGD(learning_rate=0.1, momentum=0.9, wd=1e-3,
+                           multi_precision=True, rescale_grad=0.5)
+
+    opt_l, opt_f = mk(), mk()
+    upd = opt_mod.get_updater(opt_l)
+    states_f = [opt_f.create_state_multi_precision(i, w)
+                for i, w in enumerate(weights_f)]
+
+    def leaves(tree):
+        return jax.tree_util.tree_map(
+            lambda x: x._data if isinstance(x, mx.nd.NDArray) else x, tree)
+
+    fused = jax.jit(lambda p, g, s, lrs, wds:
+                    opt_f.fused_update(p, g, s, lrs, wds))
+    bufs = [w._data for w in weights_f]
+    sbufs = leaves(states_f)
+    for gs in grads:
+        for i, (w, g) in enumerate(zip(weights_l, gs)):
+            upd(i, g, w)
+        idx = list(range(len(shapes)))
+        for i in idx:
+            opt_f._update_count(i)
+        lrs, wds = opt_f.fused_hyperparams(idx)
+        bufs, sbufs = fused(bufs, [g._data for g in gs], sbufs,
+                            tuple(lrs), tuple(wds))
+    for a, b in zip(weights_l, bufs):
+        assert np.array_equal(a.asnumpy(), np.asarray(b)), \
+            "mp weights diverged"
+
+
+def test_donation_and_external_buffer_safety(monkeypatch):
+    monkeypatch.setenv("MXNET_FUSED_STEP", "1")
+    batch = _data()
+    mod = _make_module("sgd", {"learning_rate": 0.05, "momentum": 0.9})
+    mod.forward_backward(batch)
+    mod.update()  # first step unshares init-time aliases
+    old = mod._exec.arg_dict["fc1_weight"]._data
+    mod.forward_backward(batch)
+    mod.update()
+    # in-place buffer reuse: the pre-step weight buffer was donated
+    assert old.is_deleted(), "weight buffer was not donated"
+    assert mod._exec.arg_dict["fc1_weight"]._data is not old
+    # externally-held params must NEVER be invalidated: set_params shares
+    # buffers, the fused step copies them before donating
+    ext = {k: v.copy() for k, v in _init_params().items()}
+    mod.set_params(ext, {})
+    mod.forward_backward(batch)
+    mod.update()
+    for k, v in ext.items():
+        assert np.isfinite(v.asnumpy()).all(), f"external {k} invalidated"
+
+
+def test_fallback_paths(monkeypatch):
+    batch = _data()
+    # custom optimizer without fused_update: silent per-param loop
+    monkeypatch.setenv("MXNET_FUSED_STEP", "1")
+    mod = _make_module("adagrad", {"learning_rate": 0.05})
+    prof.reset_dispatch_counts()
+    mod.forward_backward(batch)
+    mod.update()
+    counts = prof.dispatch_counts()
+    assert "fused_step" not in counts
+    assert counts.get("graph", 0) == 2  # fwd + bwd dispatched separately
+    assert mod._fused is None
+    # explicit opt-out
+    monkeypatch.setenv("MXNET_FUSED_STEP", "0")
+    mod2 = _make_module("sgd", {"learning_rate": 0.05})
+    prof.reset_dispatch_counts()
+    mod2.forward_backward(batch)
+    mod2.update()
+    assert "fused_step" not in prof.dispatch_counts()
+    # fixed params stay frozen on the fused path
+    monkeypatch.setenv("MXNET_FUSED_STEP", "1")
+    mod3 = _make_module("sgd", {"learning_rate": 0.5},
+                        fixed=["fc1_weight"])
+    before = mod3._exec.arg_dict["fc1_weight"].asnumpy()
+    mod3.forward_backward(batch)
+    mod3.update()
+    assert np.array_equal(before, mod3._exec.arg_dict["fc1_weight"]
+                          .asnumpy())
+
+
+def test_lr_schedule_no_recompile(monkeypatch):
+    """lr/wd are step arguments, not trace constants: a changing lr
+    schedule must not retrace, and the fused path stays <= 3
+    dispatches/step."""
+    monkeypatch.setenv("MXNET_FUSED_STEP", "1")
+    batch = _data()
+    sched = mx.lr_scheduler.FactorScheduler(step=1, factor=0.8)
+    mod = _make_module("sgd", {"learning_rate": 0.1, "momentum": 0.9,
+                               "lr_scheduler": sched})
+    mod.forward_backward(batch)
+    mod.update()
+    prof.reset_dispatch_counts()
+    for _ in range(6):
+        mod.forward_backward(batch)
+        mod.update()
+    assert mod._fused is not None
+    assert mod._fused._trace_count == 1, \
+        "lr schedule caused a retrace"
+    counts = prof.dispatch_counts()
+    assert counts.get("fused_step") == 6
+    assert counts.get("total", 0) / 6 <= 3
+    # the schedule really advanced (lr decayed => smaller later steps)
+    assert mod._optimizer.learning_rate < 0.1
+
+
+def test_checkpoint_roundtrip_fused(monkeypatch, tmp_path):
+    """save/restore through a fused-step Module is unchanged: a restored
+    module continues bit-identically to the original."""
+    monkeypatch.setenv("MXNET_FUSED_STEP", "1")
+    batch = _data()
+    opt = {"learning_rate": 0.05, "momentum": 0.9}
+    mod = _make_module("sgd", dict(opt))
+    _run_steps(mod, batch, 3)
+    prefix = str(tmp_path / "fused")
+    mod.save_checkpoint(prefix, 0, save_optimizer_states=True)
+    m2 = mx.mod.Module.load(prefix, 0, load_optimizer_states=True,
+                            context=mx.cpu())
+    m2.bind(data_shapes=[("data", (16, 20))],
+            label_shapes=[("softmax_label", (16,))])
+    m2.init_optimizer(kvstore=None, optimizer="sgd",
+                      optimizer_params=dict(opt))
+    pa, _ = _run_steps(mod, batch, 2)
+    pb, _ = _run_steps(m2, batch, 2)
+    for k in pa:
+        assert np.array_equal(pa[k], pb[k]), f"{k} diverged after restore"
+
+
+def test_metric_sync_interval(monkeypatch):
+    monkeypatch.setenv("MXNET_FUSED_STEP", "1")
+    monkeypatch.setenv("MXNET_METRIC_SYNC_INTERVAL", "3")
+    batch = _data()
+    mod = _make_module("sgd", {"learning_rate": 0.01})
+    metric = mx.metric.Accuracy()
+    for i in range(4):
+        mod.forward_backward(batch)
+        mod.update()
+        mod.update_metric(metric, batch.label)
+        if i < 2:
+            # buffered: no update reached the metric yet
+            assert metric.num_inst == 0
+        elif i == 2:
+            # third call flushed all three batches at once
+            assert metric.num_inst == 3 * 16
+    assert metric.num_inst == 3 * 16  # 4th buffered again
+    mod.flush_metric_updates()
+    assert metric.num_inst == 4 * 16
+    # Speedometer drains the buffer before reading the metric
+    mod.forward_backward(batch)
+    mod.update()
+    mod.update_metric(metric, batch.label)
+    from mxnet_tpu.model import BatchEndParam
+    from mxnet_tpu.callback import Speedometer
+    speedo = Speedometer(batch_size=16, frequent=1, auto_reset=False)
+    param = BatchEndParam(epoch=0, nbatch=1, eval_metric=metric,
+                          locals={"self": mod})
+    speedo(param)  # first call arms the timer
+    speedo(BatchEndParam(epoch=0, nbatch=2, eval_metric=metric,
+                         locals={"self": mod}))
+    assert metric.num_inst == 5 * 16, "Speedometer did not flush"
+
+
+def test_metric_interval_matches_per_batch_sync(monkeypatch):
+    """Interval-N metrics aggregate to exactly the per-batch values."""
+    batches = [_data(seed=s) for s in range(5)]
+
+    def score(interval):
+        monkeypatch.setenv("MXNET_METRIC_SYNC_INTERVAL", str(interval))
+        mod = _make_module("sgd", {"learning_rate": 0.05})
+        metric = mx.metric.Accuracy()
+        for b in batches:
+            mod.forward_backward(b)
+            mod.update()
+            mod.update_metric(metric, b.label)
+        mod.flush_metric_updates()
+        return metric.get()[1]
+
+    assert score(1) == score(2) == score(5)
+
+
+def test_batched_grad_zeroing(monkeypatch):
+    """After update() grads read as zeros with NO per-param zeroing
+    dispatch: a loop-path step costs fwd+bwd (2 graph launches) plus one
+    optimizer op per trainable param, nothing else."""
+    monkeypatch.setenv("MXNET_FUSED_STEP", "0")
+    batch = _data()
+    mod = _make_module("sgd", {"learning_rate": 0.05, "momentum": 0.9})
+    mod.forward_backward(batch)
+    mod.update()
+    prof.reset_dispatch_counts()
+    mod.forward_backward(batch)
+    mod.update()
+    counts = prof.dispatch_counts()
+    n_params = len(mod._param_names)
+    assert counts.get("graph") == 2
+    assert counts.get("op", 0) == n_params, counts
+    for name in mod._param_names:
+        g = mod._exec.grad_dict.get(name)
+        assert g is not None and not g.asnumpy().any(), \
+            f"grad {name} not zeroed"
+
+
+def test_stage_batch_and_partial_batch_fit(monkeypatch):
+    """The fit loop's input double-buffer stages batches onto the device
+    unchanged, and a partial final batch (shape mismatch) falls back to
+    the loop path without breaking the epoch."""
+    staged = mxio.stage_batch(_data(), mx.cpu())
+    assert np.array_equal(staged.data[0].asnumpy(),
+                          _data().data[0].asnumpy())
+    monkeypatch.setenv("MXNET_FUSED_STEP", "1")
+    rng = np.random.RandomState(0)
+    x = rng.randn(22, 20).astype(np.float32)  # 22 = 16 + partial 6
+    y = rng.randint(0, 10, 22).astype(np.float32)
+    it = mxio.NDArrayIter(mx.nd.array(x), mx.nd.array(y), batch_size=16,
+                          label_name="softmax_label")
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(it, num_epoch=2, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05},
+            initializer=mx.initializer.Xavier())
+    params, _ = mod.get_params()
+    assert all(np.isfinite(v.asnumpy()).all() for v in params.values())
