@@ -36,6 +36,12 @@ JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 echo "== checkpoint smoke (save -> kill writer mid-save -> restore) =="
 JAX_PLATFORMS=cpu python -m mxnet_tpu.checkpoint.smoke
 
+echo "== telemetry smoke (fit + serving burst, exporter scraped, watchdog silent) =="
+# 5-step fit + serving burst with the Prometheus endpoint on: required
+# metric families must scrape, step lanes must cover >=90% of step wall,
+# and the hang watchdog must not fire (docs/observability.md)
+JAX_PLATFORMS=cpu python -m mxnet_tpu.telemetry.smoke
+
 echo "== entry points =="
 JAX_PLATFORMS=cpu python -c \
   "import __graft_entry__ as g; fn, a = g.entry(); fn(*a)"

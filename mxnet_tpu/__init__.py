@@ -27,8 +27,8 @@ def __getattr__(name):
     import importlib
     if name in ("gluon", "optimizer", "metric", "initializer", "lr_scheduler",
                 "symbol", "sym", "io", "image", "kvstore", "profiler", "module", "mod",
-                "callback", "checkpoint", "monitor", "parallel", "serving", "test_utils",
-                "visualization",
+                "callback", "checkpoint", "monitor", "parallel", "serving", "telemetry",
+                "test_utils", "visualization",
                 "executor", "runtime", "model", "recordio", "contrib", "amp", "config",
                 "operator", "subgraph", "attribute", "torch_bridge", "th", "rtc",
                 "util", "log"):

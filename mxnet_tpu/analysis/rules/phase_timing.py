@@ -1,0 +1,101 @@
+"""raw-phase-timing — hot-path phase timing should be a telemetry span.
+
+ISSUE 5 built ``mxnet_tpu.telemetry``: a ``span("fit/step/h2d")`` lands
+in the chrome trace, the jax xplane trace, AND the metrics registry at
+once.  Hand-rolled ``t0 = time.perf_counter()`` / ``... - t0`` deltas in
+hot paths are invisible to all three — the number gets printed once and
+lost, which is exactly the siloed-visibility problem the telemetry layer
+exists to end.
+
+The rule fires only on the *paired* pattern inside one function in a
+hot-path module: a name assigned from a clock call
+(``time.time()`` / ``time.perf_counter()`` / ``time.monotonic()``)
+later SUBTRACTED — either ``clock() - t0`` or ``toc - tic`` with both
+names clock-assigned.  Near-misses stay silent: deadline arithmetic
+(``t0 + budget``, ``deadline - clock()``), clock reads never diffed,
+and any of this outside the hot-path list.  Existing sites that ARE the
+telemetry layer's own collection points carry suppressions.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule, register_rule
+
+# modules where an untracked timing phase is a lost observability signal
+HOT_PATH_PREFIXES = (
+    "mxnet_tpu/serving/",
+    "mxnet_tpu/checkpoint/",
+    "mxnet_tpu/module.py",
+    "mxnet_tpu/model.py",
+    "mxnet_tpu/executor.py",
+    "mxnet_tpu/fused_step.py",
+    "mxnet_tpu/io.py",
+)
+
+_CLOCKS = {"time", "perf_counter", "monotonic"}
+
+
+def _is_clock_call(node):
+    if not isinstance(node, ast.Call):
+        return False
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        # time.perf_counter() / _time.time() / xx.monotonic()
+        return func.attr in _CLOCKS
+    if isinstance(func, ast.Name):
+        # from time import perf_counter
+        return func.id in _CLOCKS and func.id != "time"
+    return False
+
+
+@register_rule
+class PhaseTimingRule(Rule):
+    id = "raw-phase-timing"
+    severity = "warning"
+    doc = ("hand-rolled clock-delta phase timing in a hot path — use "
+           "telemetry.span so the phase lands in the trace + registry")
+
+    def begin_file(self, ctx):
+        self._hot = any(p in ctx.path for p in HOT_PATH_PREFIXES)
+        self._clock_names = []  # one set per enclosing function
+
+    def visit(self, node, ctx):
+        if not self._hot:
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            self._clock_names.append(set())
+            return
+        if not self._clock_names:
+            return
+        names = self._clock_names[-1]
+        if isinstance(node, ast.Assign) and _is_clock_call(node.value):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    names.add(tgt.id)
+            return
+        if not (isinstance(node, ast.BinOp)
+                and isinstance(node.op, ast.Sub)):
+            return
+        right_is_stamp = (isinstance(node.right, ast.Name)
+                          and node.right.id in names)
+        left_is_clockish = (_is_clock_call(node.left)
+                            or (isinstance(node.left, ast.Name)
+                                and node.left.id in names))
+        if right_is_stamp and left_is_clockish:
+            stamp = node.right.id
+            ctx.report(
+                self, node,
+                f"phase timed by hand ({ast.unparse(node.left)} - {stamp}) "
+                "in a hot path — wrap the region in telemetry.span(...) "
+                "(or a step-timer lane) so the duration reaches the "
+                "chrome trace, the xplane trace and the metrics registry "
+                "instead of evaporating",
+                symbol=f"{ctx.func_name()}:{stamp}")
+
+    def depart(self, node, ctx):
+        if self._hot and isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            if self._clock_names:
+                self._clock_names.pop()

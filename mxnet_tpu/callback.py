@@ -128,6 +128,63 @@ class Speedometer:
             self.tic = time.time()
 
 
+class StepTimeline:
+    """Speedometer-style logger for the telemetry step-time breakdown.
+
+    Every ``frequent`` batches, logs where the window's step time went,
+    lane by lane (``data_wait`` / ``h2d_stage`` / ``step_dispatch`` /
+    ``device_block`` / ``metric_flush`` / ``ckpt_block`` / ``other``)::
+
+        Epoch[0] Batch [50-100] step 2.71ms: step_dispatch 1.92ms (71%) |
+        device_block 0.41ms (15%) | ...
+
+    Requires telemetry to be enabled (``MXNET_TELEMETRY=1`` or
+    ``mx.telemetry.enable()``); otherwise it logs nothing.  Pair with
+    ``Speedometer`` — this explains the samples/sec number it prints.
+    """
+
+    def __init__(self, frequent=50, logger=None):
+        self.frequent = int(frequent)
+        self.logger = logger or logging.getLogger(__name__)
+        self._last = None
+
+    def _window(self, current):
+        if self._last is None:
+            return current
+        prev = self._last
+        lanes = {lane: current["lanes"].get(lane, 0.0)
+                 - prev["lanes"].get(lane, 0.0)
+                 for lane in current["lanes"]}
+        return {"steps": current["steps"] - prev["steps"],
+                "wall_s": current["wall_s"] - prev["wall_s"],
+                "lanes": lanes,
+                "other_s": current["other_s"] - prev["other_s"]}
+
+    def __call__(self, param):
+        if param.nbatch == 0 or param.nbatch % self.frequent != 0:
+            return
+        from . import telemetry
+        current = telemetry.step_breakdown()
+        win = self._window(current)
+        self._last = current
+        steps = win["steps"]
+        if steps <= 0:
+            return  # telemetry disabled (or no timed steps this window)
+        wall_ms = win["wall_s"] / steps * 1e3
+        parts = []
+        shown = list(win["lanes"].items()) + [("other", win["other_s"])]
+        for lane, total in shown:
+            ms = total / steps * 1e3
+            if ms <= 0:
+                continue
+            pct = 100.0 * total / win["wall_s"] if win["wall_s"] else 0.0
+            parts.append(f"{lane} {ms:.2f}ms ({pct:.0f}%)")
+        self.logger.info(
+            "Epoch[%d] Batch [%d-%d]\tstep %.2fms: %s", param.epoch,
+            param.nbatch - self.frequent, param.nbatch, wall_ms,
+            " | ".join(parts) or "no lanes recorded")
+
+
 class ProgressBar:
     """ASCII progress bar (parity: callback.py ProgressBar)."""
 

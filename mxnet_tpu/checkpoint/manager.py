@@ -177,15 +177,32 @@ class CheckpointManager:
         os.makedirs(self.directory, exist_ok=True)
         if self.host_id == 0:
             self._sweep_stale()
-        self._stats = {"saves": 0, "failures": 0, "gc_removed": 0,
-                       "last_save_blocking_ms": None,
-                       "last_save_total_ms": None,
-                       "last_save_bytes": None}
+        self._stats_data = {"saves": 0, "failures": 0, "gc_removed": 0,
+                            "last_save_blocking_ms": None,
+                            "last_save_total_ms": None,
+                            "last_save_bytes": None,
+                            "last_commit_step": None}
+        self._last_commit_t = None  # monotonic time of the last commit
         self._pending = []
         self._lock = threading.Lock()
         self._queue = queue.Queue(maxsize=1)
         self._writer = None
         self._closed = False
+        from .. import telemetry as _telemetry
+        _telemetry.register_checkpoint_manager(self)  # weakly held
+
+    @property
+    def _stats(self):
+        """Deprecated: read :meth:`stats` instead.  Kept (as a locked
+        COPY — external mutation never lands) so pre-ISSUE-5 callers
+        keep working one release."""
+        import warnings
+        warnings.warn(
+            "direct CheckpointManager._stats access is deprecated; use "
+            "the public stats() (locked copy + writer-queue/commit-age "
+            "gauges)", DeprecationWarning, stacklevel=2)
+        with self._lock:
+            return dict(self._stats_data)
 
     @staticmethod
     def _detect_hosts(host_id, num_hosts):
@@ -249,6 +266,7 @@ class CheckpointManager:
         if self.async_save:
             self._ensure_writer()
             self._queue.put(job)  # backpressure: one save in flight
+            # graftlint: disable=raw-phase-timing -- this IS the save_blocking_ms collection point; it feeds telemetry's ckpt_block lane below
             blocking_ms = (time.perf_counter() - t0) * 1e3
         else:
             blocking_ms = None  # set below: sync save blocks for everything
@@ -258,13 +276,18 @@ class CheckpointManager:
             except BaseException as e:
                 fut._set(e if isinstance(e, Exception) else
                          CheckpointError(str(e)))
+            # graftlint: disable=raw-phase-timing -- same collection point, sync path
             blocking_ms = (time.perf_counter() - t0) * 1e3
         job.snapshot_ms = blocking_ms
-        # _stats is shared with the writer thread — every access locks
+        # _stats_data is shared with the writer thread — every access locks
         with self._lock:
-            self._stats["last_save_blocking_ms"] = blocking_ms
+            self._stats_data["last_save_blocking_ms"] = blocking_ms
         self._record_counter("checkpoint:save_blocking_ms",
                              round(blocking_ms, 3))
+        # charge the train thread's blocking share to the fit loop's
+        # ckpt_block lane (no-op when no step timer is live on this thread)
+        from .. import telemetry as _telemetry
+        _telemetry.current_step_timer().add("ckpt_block", blocking_ms / 1e3)
         if block or not self.async_save:
             fut.result()
         return fut
@@ -289,7 +312,7 @@ class CheckpointManager:
                 job.future._set(None)
             except BaseException as e:  # surface via future, keep writing
                 with self._lock:
-                    self._stats["failures"] += 1
+                    self._stats_data["failures"] += 1
                 self.logger.exception(
                     "checkpoint: save of step %d failed", job.step)
                 job.future._set(e if isinstance(e, Exception) else
@@ -398,11 +421,14 @@ class CheckpointManager:
             self._mirror_legacy(job)
         self._gc()
 
+        # graftlint: disable=raw-phase-timing -- writer-thread commit latency feeds stats()/save_total_ms, which telemetry's checkpoint collector exports
         total_ms = (time.perf_counter() - t0) * 1e3
         with self._lock:
-            self._stats["saves"] += 1
-            self._stats["last_save_total_ms"] = total_ms
-            self._stats["last_save_bytes"] = job.nbytes
+            self._stats_data["saves"] += 1
+            self._stats_data["last_save_total_ms"] = total_ms
+            self._stats_data["last_save_bytes"] = job.nbytes
+            self._stats_data["last_commit_step"] = job.step
+            self._last_commit_t = time.monotonic()
         self._record_counter("checkpoint:save_total_ms", round(total_ms, 3))
         self._record_counter("checkpoint:save_bytes", job.nbytes)
         self.logger.info("checkpoint: committed step %d (%.1f MB, %.0f ms)",
@@ -493,7 +519,7 @@ class CheckpointManager:
                 pass
         if removed:
             with self._lock:
-                self._stats["gc_removed"] += removed
+                self._stats_data["gc_removed"] += removed
             self._record_counter("checkpoint:gc_removed", removed)
 
     @staticmethod
@@ -556,7 +582,8 @@ class CheckpointManager:
         ckpt = restore(self.directory, step=step, verify=verify,
                        fallback=fallback, logger=self.logger)
         with self._lock:
-            self._stats["last_restore_s"] = time.perf_counter() - t0
+            # graftlint: disable=raw-phase-timing -- restore latency feeds stats()/last_restore_s, exported by telemetry's checkpoint collector
+            self._stats_data["last_restore_s"] = time.perf_counter() - t0
         return ckpt
 
     def latest(self):
@@ -587,9 +614,22 @@ class CheckpointManager:
             raise exc
 
     def stats(self):
-        """Save/restore latency + volume counters (bench + tests)."""
+        """Public observability surface: save/restore latency + volume
+        counters (a locked COPY), plus live gauges — writer-queue depth,
+        pending async saves, and the age of the last commit.  Feeds
+        ``telemetry.snapshot()["checkpoint"]`` and the Prometheus
+        ``mxnet_checkpoint_*`` families.  (Direct ``_stats`` access is
+        deprecated.)"""
         with self._lock:
-            return dict(self._stats)
+            out = dict(self._stats_data)
+            last_commit_t = self._last_commit_t
+            out["pending_saves"] = sum(1 for f in self._pending
+                                       if not f.done())
+        out["writer_queue_depth"] = self._queue.qsize()
+        out["last_commit_age_s"] = (
+            None if last_commit_t is None
+            else round(time.monotonic() - last_commit_t, 3))
+        return out
 
     def close(self):
         """Flush pending saves and stop the writer thread."""

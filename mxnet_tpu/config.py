@@ -171,6 +171,25 @@ _register("MXNET_PROFILER_AUTOSTART", bool, False,
 _register("MXNET_PROFILER_MODE", str, "",
           "with AUTOSTART: 'all'/'1' also enables profile_all + "
           "profile_api (parity: reference MXNET_PROFILER_MODE)")
+# -- telemetry ---------------------------------------------------------------
+_register("MXNET_TELEMETRY", bool, False,
+          "enable the telemetry span tracer + per-train-step lane "
+          "breakdown (telemetry.span / callback.StepTimeline); the "
+          "metrics registry, collectors and exporter work regardless — "
+          "this knob only arms the timed instrumentation "
+          "(docs/observability.md)")
+_register("MXNET_TELEMETRY_PORT", int, 0,
+          "serve telemetry.prometheus_dump() on "
+          "http://127.0.0.1:<port>/metrics (plus /snapshot.json and "
+          "/healthz) from a daemon thread; 0 disables the endpoint")
+_register("MXNET_WATCHDOG_S", float, 0.0,
+          "hang watchdog: when an armed section (fit loop, serving "
+          "batcher) makes no progress for this many seconds, dump "
+          "all-thread stacks + the telemetry snapshot to stderr and a "
+          "mxnet-watchdog-<pid>-<n>.txt file; 0 disables "
+          "(docs/observability.md runbook)")
+_register("MXNET_WATCHDOG_DIR", str, "",
+          "directory for hang-watchdog dump files (empty = cwd)")
 # -- serving ----------------------------------------------------------------
 _register("MXNET_SERVING_MAX_BATCH", int, 32,
           "DynamicBatcher flush size: a batch runs as soon as this many "
@@ -284,6 +303,10 @@ _register("BENCH_DISPATCH_IMAGE", int, 32,
 _register("BENCH_DISPATCH_BATCH", int, 4,
           "bench.py dispatch phase: ResNet-50 batch for the dispatch "
           "count")
+_register("BENCH_TELEMETRY", bool, True,
+          "bench.py: also measure the disabled-path cost of "
+          "telemetry.span (telemetry_disabled_span_ns; the <1us budget "
+          "that lets hot loops stay annotated unconditionally)")
 _register("BENCH_CKPT", bool, True,
           "bench.py: also measure checkpoint save-blocking time and "
           "restore latency (ckpt_save_blocking_ms / ckpt_restore_s)")

@@ -48,6 +48,7 @@ import jax.numpy as jnp
 
 from . import profiler as _prof
 from . import random as _random
+from . import telemetry as _telemetry
 from .base import MXNetError
 from .ndarray import NDArray
 
@@ -215,9 +216,10 @@ class FusedTrainStep:
         lrs, wds = opt.fused_hyperparams(self._opt_indices)
 
         key = _random.next_key()
-        outs, new_aux, new_params, new_states = self._jit(
-            key, train_vals, other_vals, aux_vals, states,
-            tuple(lrs), tuple(wds))
+        with _telemetry.span("fit/step/fused_dispatch"):
+            outs, new_aux, new_params, new_states = self._jit(
+                key, train_vals, other_vals, aux_vals, states,
+                tuple(lrs), tuple(wds))
         _prof.record_dispatch("fused_step")
 
         # write-back: swap the NEW buffers into the existing NDArray

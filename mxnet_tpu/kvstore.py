@@ -34,6 +34,29 @@ def _ctx_key(ctx):
     return (ctx.device_type, ctx.device_id)
 
 
+def _account_wire(op, grouped_values):
+    """Telemetry: logical payload bytes entering/leaving the store
+    (``mxnet_kvstore_bytes_total{op=push|pull}``).  Shape x itemsize host
+    arithmetic only — never a device sync; sparse arrays count their
+    logical (dense) shape."""
+    import numpy as _np
+
+    from . import telemetry as _telemetry
+    total = n = 0
+    for vlist in grouped_values:
+        if not isinstance(vlist, (list, tuple)):
+            vlist = [vlist]
+        for v in vlist:
+            shape = getattr(v, "shape", None)
+            dtype = getattr(v, "dtype", None)
+            if shape is None or dtype is None:
+                continue
+            total += int(_np.prod(shape, dtype=_np.int64)) * \
+                _np.dtype(dtype).itemsize
+            n += 1
+    _telemetry.record_kvstore(op, total, n)
+
+
 class KVStore:
     """Single-process key-value store (parity: include/mxnet/kvstore.h:59 +
     kvstore_local.h)."""
@@ -75,6 +98,7 @@ class KVStore:
         optimizer updates touch only the pushed rows)."""
         from .ndarray import sparse as _sp
         keys, values = _key_grouped(key, value)
+        _account_wire("push", values)
         for k, vlist in zip(keys, values):
             if k not in self._store:
                 raise MXNetError(f"key {k} was not init()ed")
@@ -110,6 +134,7 @@ class KVStore:
         from .ndarray.sparse import BaseSparseNDArray
         assert out is not None
         keys, outs = _key_grouped(key, out)
+        _account_wire("pull", outs)
         for k, olist in zip(keys, outs):
             stored = self._store[k]
             for o in olist:
@@ -289,9 +314,11 @@ class KVStoreICI(KVStore):
             if any(isinstance(v, _sp.BaseSparseNDArray) for v in vlist) or \
                     len(vlist) == 1:
                 # sparse or single-device: the local reduction is optimal
+                # (super().push accounts these bytes itself)
                 self._replicated.pop(k, None)
                 super().push(k, vlist, priority)
                 continue
+            _account_wire("push", [vlist])
             replicated, plain = self._allreduce(vlist)
             stored = self._store[k]
             if replicated is None:
@@ -319,6 +346,7 @@ class KVStoreICI(KVStore):
         from .ndarray.sparse import BaseSparseNDArray
         assert out is not None
         keys, outs = _key_grouped(key, out)
+        _account_wire("pull", outs)
         for k, olist in zip(keys, outs):
             replicated = self._replicated.get(k)
             stored = self._store[k]
@@ -464,6 +492,7 @@ class KVStoreDist(KVStore):
             return super().push(key, value, priority)
         from .ndarray import sparse as _sp
         keys, values = _key_grouped(key, value)
+        _account_wire("push", values)
         sync = self._type in ("dist_sync", "dist_device_sync")
         for k, vlist in zip(keys, values):
             if any(isinstance(v, _sp.BaseSparseNDArray) for v in vlist):
@@ -542,6 +571,7 @@ class KVStoreDist(KVStore):
             return super().pull(key, out, priority, ignore_sparse)
         import numpy as np
         keys, outs = _key_grouped(key, out)
+        _account_wire("pull", outs)
         for k, olist in zip(keys, outs):
             layout = self._chunked.get(k)
             if layout is None:

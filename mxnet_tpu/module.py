@@ -19,6 +19,7 @@ from . import io as mx_io
 from . import metric as metric_mod
 from . import ndarray as nd
 from . import optimizer as opt_mod
+from . import telemetry as _telemetry
 from .base import MXNetError
 from .context import cpu
 from .initializer import Uniform
@@ -70,65 +71,108 @@ class BaseModule:
             eval_metric = metric_mod.create(eval_metric)
 
         stager = mx_io.make_batch_stager(getattr(self, "_context", None))
-        for epoch in range(begin_epoch, num_epoch):
-            tic = time.time()
-            eval_metric.reset()
-            nbatch = 0
-            data_iter = iter(train_data)
-            end_of_batch = False
-            next_data_batch = next(data_iter)
-            if stager is not None:
-                next_data_batch = stager(next_data_batch)
-            while not end_of_batch:
-                data_batch = next_data_batch
-                if monitor is not None:
-                    monitor.tic()
-                self.forward_backward(data_batch)
+        # step-time breakdown (telemetry lanes) + hang watchdog: both are
+        # shared no-ops unless MXNET_TELEMETRY / MXNET_WATCHDOG_S arm them
+        timeline = _telemetry.step_timer()
+        wdog = _telemetry.watchdog
+        try:
+            self._fit_epochs(train_data, eval_data, eval_metric,
+                             validation_metric, monitor, stager, timeline,
+                             wdog, epoch_end_callback, batch_end_callback,
+                             eval_end_callback, eval_batch_end_callback,
+                             begin_epoch, num_epoch)
+        finally:
+            timeline.close()
+
+    def _fit_epochs(self, train_data, eval_data, eval_metric,
+                    validation_metric, monitor, stager, timeline, wdog,
+                    epoch_end_callback, batch_end_callback,
+                    eval_end_callback, eval_batch_end_callback,
+                    begin_epoch, num_epoch):
+        """The epoch/batch loop of ``fit`` (instrumented: every loop
+        iteration attributes its wall time to telemetry step lanes and
+        beats the hang watchdog)."""
+        with wdog.arm("train/fit"):
+            for epoch in range(begin_epoch, num_epoch):
+                tic = time.time()
+                eval_metric.reset()
+                nbatch = 0
+                data_iter = iter(train_data)
+                end_of_batch = False
+                with timeline.lane("data_wait"):
+                    next_data_batch = next(data_iter)
                 if stager is not None:
-                    # double-buffer input feed: batch N+1's host->device
-                    # copy overlaps the step still in flight on batch N
-                    # (the staged copy also makes buffer-reusing iterators
-                    # safe to prefetch from before update_metric reads
-                    # batch N's labels)
-                    try:
-                        next_data_batch = stager(next(data_iter))
-                    except StopIteration:
-                        end_of_batch = True
-                self.update()
-                self.update_metric(eval_metric, data_batch.label)
-                if stager is None:
-                    try:
-                        next_data_batch = next(data_iter)
-                    except StopIteration:
-                        end_of_batch = True
-                if monitor is not None:
-                    monitor.toc_print()
-                if batch_end_callback is not None:
-                    batch_end_params = BatchEndParam(
-                        epoch=epoch, nbatch=nbatch, eval_metric=eval_metric,
-                        locals=locals())
-                    for callback in _as_list(batch_end_callback):
-                        callback(batch_end_params)
-                nbatch += 1
-            self.flush_metric_updates()
-            for name, val in eval_metric.get_name_value():
-                self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
-            toc = time.time()
-            self.logger.info("Epoch[%d] Time cost=%.3f", epoch, (toc - tic))
-            arg_params, aux_params = self.get_params()
-            self.set_params(arg_params, aux_params)
-            if epoch_end_callback is not None:
-                for callback in _as_list(epoch_end_callback):
-                    callback(epoch, self.symbol, arg_params, aux_params)
-            if eval_data is not None:
-                res = self.score(eval_data, validation_metric,
-                                 score_end_callback=eval_end_callback,
-                                 batch_end_callback=eval_batch_end_callback,
-                                 epoch=epoch)
-                for name, val in res:
-                    self.logger.info("Epoch[%d] Validation-%s=%f", epoch,
-                                     name, val)
-            train_data.reset()
+                    with timeline.lane("h2d_stage"):
+                        next_data_batch = stager(next_data_batch)
+                timeline.begin_step()
+                while not end_of_batch:
+                    data_batch = next_data_batch
+                    if monitor is not None:
+                        monitor.tic()
+                    with timeline.lane("step_dispatch"):
+                        self.forward_backward(data_batch)
+                    if stager is not None:
+                        # double-buffer input feed: batch N+1's
+                        # host->device copy overlaps the step still in
+                        # flight on batch N (the staged copy also makes
+                        # buffer-reusing iterators safe to prefetch from
+                        # before update_metric reads batch N's labels)
+                        fetched = None
+                        with timeline.lane("data_wait"):
+                            try:
+                                fetched = next(data_iter)
+                            except StopIteration:
+                                end_of_batch = True
+                        if fetched is not None:
+                            with timeline.lane("h2d_stage"):
+                                next_data_batch = stager(fetched)
+                    with timeline.lane("step_dispatch"):
+                        self.update()
+                    # device_block/metric_flush lanes are attributed
+                    # inside update_metric (it knows where the sync is)
+                    self.update_metric(eval_metric, data_batch.label)
+                    if stager is None:
+                        with timeline.lane("data_wait"):
+                            try:
+                                next_data_batch = next(data_iter)
+                            except StopIteration:
+                                end_of_batch = True
+                    if monitor is not None:
+                        monitor.toc_print()
+                    if batch_end_callback is not None:
+                        batch_end_params = BatchEndParam(
+                            epoch=epoch, nbatch=nbatch,
+                            eval_metric=eval_metric, locals=locals())
+                        for callback in _as_list(batch_end_callback):
+                            callback(batch_end_params)
+                    nbatch += 1
+                    timeline.end_step()
+                    wdog.beat("train/fit")
+                self.flush_metric_updates()
+                for name, val in eval_metric.get_name_value():
+                    self.logger.info("Epoch[%d] Train-%s=%f", epoch, name,
+                                     val)
+                toc = time.time()
+                # legacy per-epoch log line (reference parity); per-step
+                # phases go through telemetry lanes
+                cost = toc - tic  # graftlint: disable=raw-phase-timing -- epoch wall is a user log line, not a phase metric
+                self.logger.info("Epoch[%d] Time cost=%.3f", epoch, cost)
+                arg_params, aux_params = self.get_params()
+                self.set_params(arg_params, aux_params)
+                if epoch_end_callback is not None:
+                    for callback in _as_list(epoch_end_callback):
+                        callback(epoch, self.symbol, arg_params, aux_params)
+                if eval_data is not None:
+                    res = self.score(
+                        eval_data, validation_metric,
+                        score_end_callback=eval_end_callback,
+                        batch_end_callback=eval_batch_end_callback,
+                        epoch=epoch)
+                    for name, val in res:
+                        self.logger.info("Epoch[%d] Validation-%s=%f",
+                                         epoch, name, val)
+                train_data.reset()
+                wdog.beat("train/fit")
 
     def score(self, eval_data, eval_metric, num_batch=None,
               batch_end_callback=None, score_end_callback=None, reset=True,
@@ -232,6 +276,18 @@ def _as_list(obj):
     if isinstance(obj, (list, tuple)):
         return obj
     return [obj]
+
+
+def _block_on_maps(*maps):
+    """Block until every device array in the maps is ready.  Telemetry's
+    ``device_block`` lane wraps this wait explicitly, so the metric math
+    that follows reads as pure host time (deferred device errors surface
+    here instead of inside the metric — same user-visible sync point)."""
+    import jax
+    bufs = [v._data for m in maps for v in m.values()
+            if isinstance(v, NDArray)]
+    if bufs:
+        jax.block_until_ready(bufs)
 
 
 class Module(BaseModule):
@@ -777,7 +833,15 @@ class Module(BaseModule):
                      zip([d.name for d in self._label_shapes], labels)}
         pred_map = dict(zip(self.output_names, self.get_outputs()))
         if _config.get("MXNET_METRIC_SYNC_INTERVAL") <= 1:
-            eval_metric.update_dict(label_map, pred_map)
+            st = _telemetry.current_step_timer()
+            if st.active:
+                # split the fit-loop lanes where the sync actually is:
+                # device_block = waiting for the step's outputs to land,
+                # metric_flush = the host-side metric math afterwards
+                with st.lane("device_block"):
+                    _block_on_maps(label_map, pred_map)
+            with st.lane("metric_flush"):
+                eval_metric.update_dict(label_map, pred_map)
             return
         self._pending_metric.append((eval_metric, label_map, pred_map))
         if len(self._pending_metric) >= \
@@ -791,8 +855,14 @@ class Module(BaseModule):
         if not pending:
             return
         self._pending_metric = []
-        for metric, label_map, pred_map in pending:
-            metric.update_dict(label_map, pred_map)
+        st = _telemetry.current_step_timer()
+        if st.active:
+            with st.lane("device_block"):
+                for _metric, label_map, pred_map in pending:
+                    _block_on_maps(label_map, pred_map)
+        with st.lane("metric_flush"):
+            for metric, label_map, pred_map in pending:
+                metric.update_dict(label_map, pred_map)
 
     @property
     def output_names(self):

@@ -93,6 +93,7 @@ def start(profile_process="worker"):
     global _t0
     _t0 = time.perf_counter()
     _state["running"] = True
+    _state["dump_deadline"] = None  # re-anchor the continuous-dump grid
     xdir = os.environ.get("MXNET_PROFILER_XPLANE_DIR")
     if xdir:
         import jax
@@ -103,25 +104,51 @@ def start(profile_process="worker"):
     _forward_to_server("profiler_set_state", "run")
 
 
+def _next_dump_deadline(deadline, period, now):
+    """The next monotonic dump deadline: ``deadline + period`` normally;
+    when a dump overran one or more whole periods, realign to the
+    original grid without firing a catch-up burst."""
+    nxt = deadline + period
+    if nxt <= now:
+        nxt = now + period - ((now - deadline) % period)
+    return nxt
+
+
 def _schedule_dump():
-    """Background periodic dump (reference continuous_dump/dump_period)."""
+    """Background periodic dump (reference continuous_dump/dump_period).
+
+    Each timer re-arms from a MONOTONIC deadline carried in
+    ``_state["dump_deadline"]`` — the old ``Timer(period)``-after-dump
+    scheme added every dump's own write time to the cadence, so a 50 ms
+    dump on a 1 s period drifted ~3 min/hour."""
     t = _state.get("dump_timer")
     if t is not None:
         t.cancel()
+    now = time.monotonic()
+    if _state.get("dump_deadline") is None:
+        _state["dump_deadline"] = now + float(_config["dump_period"])
 
     def tick():
-        if _state["running"]:
-            try:
-                dump(finished=False)
-            except Exception as e:  # noqa: BLE001 — keep the timer alive
-                logging.getLogger("mxnet_tpu.profiler").warning(
-                    "continuous profiler dump failed: %s", e)
-            _schedule_dump()
+        if not _state["running"]:
+            return
+        try:
+            dump(finished=False)
+        except Exception as e:  # noqa: BLE001 — keep the timer alive
+            logging.getLogger("mxnet_tpu.profiler").warning(
+                "continuous profiler dump failed: %s", e)
+        _state["dump_deadline"] = _next_dump_deadline(
+            _state["dump_deadline"], float(_config["dump_period"]),
+            time.monotonic())
+        _arm()
 
-    t = threading.Timer(float(_config["dump_period"]), tick)
-    t.daemon = True
-    t.start()
-    _state["dump_timer"] = t
+    def _arm():
+        delay = max(0.0, _state["dump_deadline"] - time.monotonic())
+        timer = threading.Timer(delay, tick)
+        timer.daemon = True
+        timer.start()
+        _state["dump_timer"] = timer
+
+    _arm()
 
 
 def stop(profile_process="worker"):
@@ -131,6 +158,7 @@ def stop(profile_process="worker"):
     if t is not None:
         t.cancel()
         _state["dump_timer"] = None
+    _state["dump_deadline"] = None
     if _state["jax_trace_dir"]:
         import jax
         jax.profiler.stop_trace()
@@ -140,6 +168,12 @@ def stop(profile_process="worker"):
 
 def is_running():
     return _state["running"]
+
+
+def jax_trace_dir():
+    """Directory of the live jax xplane trace (None when no device trace
+    is running) — telemetry spans mirror themselves into it."""
+    return _state["jax_trace_dir"]
 
 
 def _reset_after_fork():
@@ -292,6 +326,7 @@ def pause(profile_process="worker"):
     if t is not None:
         t.cancel()
         _state["dump_timer"] = None
+    _state["dump_deadline"] = None
 
 
 def resume(profile_process="worker"):
@@ -316,14 +351,21 @@ def dump(finished=True, profile_process="worker"):
     _forward_to_server("profiler_dump", bool(finished))
 
 
-def dumps(reset=False, format="table", sort_by="total", ascending=False):
+def dumps(reset=False, format="table", sort_by="total", ascending=False,
+          aggregate=False):
     """Return aggregate stats as an ASCII table, or a dict when
     format="json" (parity: profiler.py dumps → aggregate_stats.cc table
-    and json dump modes)."""
+    and json dump modes).  ``aggregate=True`` additionally folds the
+    dispatch-count lanes (``record_dispatch``) into the output — without
+    it only per-op duration rows make the table, so launches-per-step
+    was invisible in the very output meant to summarize the trace
+    (json: under the ``"dispatch_counts"`` key; table: a trailing
+    "Dispatch Counts" section)."""
     with _records_lock:
         events = list(_records)
         if reset:
             _records.clear()
+    counts = dispatch_counts() if aggregate else {}
     agg = {}
     for e in events:
         if e.get("ph") != "X":
@@ -334,9 +376,12 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False):
         st[2] = min(st[2], e["dur"])
         st[3] = max(st[3], e["dur"])
     if format == "json":
-        return {name: {"count": c, "total_ms": t / 1e3, "min_ms": mn / 1e3,
-                       "max_ms": mx / 1e3, "avg_ms": t / c / 1e3}
-                for name, (c, t, mn, mx) in agg.items()}
+        out = {name: {"count": c, "total_ms": t / 1e3, "min_ms": mn / 1e3,
+                      "max_ms": mx / 1e3, "avg_ms": t / c / 1e3}
+               for name, (c, t, mn, mx) in agg.items()}
+        if counts:
+            out["dispatch_counts"] = counts
+        return out
     lines = ["Profile Statistics:",
              f"{'Name':<40}{'Total Count':>12}{'Time (ms)':>14}"
              f"{'Min (ms)':>12}{'Max (ms)':>12}{'Avg (ms)':>12}"]
@@ -346,6 +391,12 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False):
     for name, (cnt, tot, mn, mx) in items:
         lines.append(f"{name:<40}{cnt:>12}{tot/1e3:>14.4f}"
                      f"{mn/1e3:>12.4f}{mx/1e3:>12.4f}{tot/cnt/1e3:>12.4f}")
+    if counts:
+        lines.append("")
+        lines.append("Dispatch Counts:")
+        lines.append(f"{'Kind':<40}{'Count':>12}")
+        for kind in sorted(counts):
+            lines.append(f"{kind:<40}{counts[kind]:>12}")
     return "\n".join(lines)
 
 

@@ -38,6 +38,7 @@ import time
 import numpy as np
 
 from ..base import MXNetError
+from ..telemetry import watchdog as _watchdog
 from .metrics import ServingMetrics
 
 
@@ -246,45 +247,53 @@ class DynamicBatcher:
             batch = self._take_batch()
             if not batch:
                 return  # closed and drained
-            now = time.perf_counter()
-            live = []
-            for req in batch:
-                if req.deadline is not None and now > req.deadline:
-                    waited = (now - req.t_enqueue) * 1e3
-                    timeout = (req.deadline - req.t_enqueue) * 1e3
-                    req.future._set_exception(RequestTimeoutError(
-                        self.name, waited, timeout))
-                    self.metrics.incr("timeouts_total")
-                else:
-                    live.append(req)
-            if not live:
+            with _watchdog.arm(f"serving/{self.name}"):
+                self._run_batch(batch)
+
+    def _run_batch(self, batch):
+        """Execute one taken batch (hang-watchdog armed by the caller:
+        a runner wedged in compile/execute for MXNET_WATCHDOG_S seconds
+        gets an all-thread stack dump instead of a silent stall)."""
+        now = time.perf_counter()
+        live = []
+        for req in batch:
+            if req.deadline is not None and now > req.deadline:
+                waited = (now - req.t_enqueue) * 1e3
+                timeout = (req.deadline - req.t_enqueue) * 1e3
+                req.future._set_exception(RequestTimeoutError(
+                    self.name, waited, timeout))
+                self.metrics.incr("timeouts_total")
+            else:
+                live.append(req)
+        if not live:
+            return
+        # cohorts: requests only share a runner call with requests
+        # of the SAME input signature, so a mismatched/malformed
+        # request fails alone instead of poisoning its neighbours
+        cohorts = collections.OrderedDict()
+        for req in live:
+            cohorts.setdefault(req.sig, []).append(req)
+        for cohort in cohorts.values():
+            try:
+                names = list(cohort[0].inputs)
+                feed = {k: np.stack([r.inputs[k] for r in cohort])
+                        for k in names}
+                outputs = self._runner(feed, len(cohort))
+            except Exception as e:  # noqa: BLE001 — fanned out per req
+                exc = e if isinstance(e, MXNetError) else MXNetError(
+                    f"serving[{self.name}]: batch execution failed: "
+                    f"{type(e).__name__}: {e}")
+                for req in cohort:
+                    req.future._set_exception(exc)
+                self.metrics.incr("errors_total", len(cohort))
                 continue
-            # cohorts: requests only share a runner call with requests
-            # of the SAME input signature, so a mismatched/malformed
-            # request fails alone instead of poisoning its neighbours
-            cohorts = collections.OrderedDict()
-            for req in live:
-                cohorts.setdefault(req.sig, []).append(req)
-            for cohort in cohorts.values():
-                try:
-                    names = list(cohort[0].inputs)
-                    feed = {k: np.stack([r.inputs[k] for r in cohort])
-                            for k in names}
-                    outputs = self._runner(feed, len(cohort))
-                except Exception as e:  # noqa: BLE001 — fanned out per req
-                    exc = e if isinstance(e, MXNetError) else MXNetError(
-                        f"serving[{self.name}]: batch execution failed: "
-                        f"{type(e).__name__}: {e}")
-                    for req in cohort:
-                        req.future._set_exception(exc)
-                    self.metrics.incr("errors_total", len(cohort))
-                    continue
-                done = time.perf_counter()
-                for i, req in enumerate(cohort):
-                    req.future._set_result([out[i] for out in outputs])
-                    self.metrics.observe_latency(
-                        (done - req.t_enqueue) * 1e3)
-                self.metrics.incr("responses_total", len(cohort))
+            done = time.perf_counter()
+            for i, req in enumerate(cohort):
+                req.future._set_result([out[i] for out in outputs])
+                self.metrics.observe_latency(
+                    (done - req.t_enqueue) * 1e3)
+            _watchdog.beat(f"serving/{self.name}")
+            self.metrics.incr("responses_total", len(cohort))
 
     # -- lifecycle ----------------------------------------------------------
     def close(self, drain=True, timeout=30.0):

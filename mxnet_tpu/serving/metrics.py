@@ -84,12 +84,17 @@ class ServingMetrics:
 
     # -- snapshot -----------------------------------------------------------
     def snapshot(self):
+        # copy the percentile reservoir UNDER the lock, sort OUTSIDE it:
+        # a concurrent submit()/observe_latency() can never mutate the
+        # sequence mid-sort, and the batcher's hot path never waits on an
+        # O(n log n) sort held inside its metrics lock
         with self._lock:
             counters = dict(self._counters)
             gauges = dict(self._gauges)
-            lat = sorted(self._latencies_ms)
+            lat = list(self._latencies_ms)
             items, slots = self._batch_items, self._batch_slots
             elapsed = max(1e-9, time.perf_counter() - self._t_start)
+        lat.sort()
         responses = counters.get("responses_total", 0)
         snap = {
             "name": self.name,
@@ -110,7 +115,11 @@ class ServingMetrics:
 
 def stats():
     """Snapshot of every live metrics instance, keyed by name — the
-    module-level ``mx.serving.stats()`` entry point."""
+    module-level ``mx.serving.stats()`` entry point.  This same payload
+    feeds ``telemetry.snapshot()["serving"]`` and the Prometheus
+    ``mxnet_serving_*`` families: once this module is imported, the
+    telemetry registry's ``serving`` collector pulls from here, so the
+    dict shape below IS the cross-subsystem contract."""
     with _REGISTRY_LOCK:
         instances = list(_REGISTRY.values())
     return {m.name: m.snapshot() for m in instances}

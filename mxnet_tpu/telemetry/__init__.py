@@ -1,0 +1,221 @@
+"""mxnet_tpu.telemetry — unified observability layer (ISSUE 5 tentpole).
+
+One import gives four subsystems one set of eyes:
+
+* **spans** (:func:`span`) — nestable, thread-safe timed regions that
+  merge into the profiler's chrome-trace stream, jax xplane traces, and
+  the ``mxnet_span_seconds`` histogram; ~zero-cost while disabled.
+* **registry** (:data:`REGISTRY`) — process-wide counters / gauges /
+  histograms plus pull-collectors that absorb ``serving.stats()``,
+  ``CheckpointManager.stats()``, profiler dispatch lanes, kvstore wire
+  bytes and io staging waits behind ONE :func:`snapshot` and a
+  Prometheus :func:`prometheus_dump` / HTTP endpoint
+  (``MXNET_TELEMETRY_PORT``).
+* **step breakdown** (:mod:`steps`) — ``Module.fit`` attributes each
+  train step's wall time to lanes (``data_wait`` / ``h2d_stage`` /
+  ``step_dispatch`` / ``device_block`` / ``metric_flush`` /
+  ``ckpt_block``), surfaced by ``callback.StepTimeline``.
+* **watchdog** (:mod:`watchdog`) — ``MXNET_WATCHDOG_S``: all-thread
+  stack + snapshot dumps when the train loop or a serving batcher stops
+  making progress.
+
+Enable spans + step lanes with ``MXNET_TELEMETRY=1`` or
+:func:`enable`; the registry and collectors are always live (they cost
+nothing until read).  See docs/observability.md for the metric catalog,
+span naming convention, and the watchdog runbook.
+"""
+from __future__ import annotations
+
+import sys
+import weakref
+
+from . import registry as _registry_mod
+from . import spans as _spans
+from . import steps as _steps
+from . import watchdog
+from .exporter import exporter_port, start_exporter, stop_exporter
+from .registry import MetricsRegistry, exponential_buckets
+from .spans import current_span, disable, enable, enabled, span, span_stack
+from .steps import (LANES, current_step_timer, reset_step_stats,
+                    step_breakdown, step_timer)
+
+heartbeat = watchdog.beat
+
+#: the process-wide registry every subsystem reports into
+REGISTRY = MetricsRegistry()
+
+counter = REGISTRY.counter
+gauge = REGISTRY.gauge
+histogram = REGISTRY.histogram
+register_collector = REGISTRY.register_collector
+
+# -- built-in instruments ----------------------------------------------------
+_spans._span_hist = REGISTRY.histogram(
+    "mxnet_span_seconds", "telemetry.span durations by span name")
+_steps._lane_hist = REGISTRY.histogram(
+    "mxnet_train_step_lane_seconds",
+    "per-train-step time attributed to each breakdown lane")
+_steps._step_hist = REGISTRY.histogram(
+    "mxnet_train_step_seconds", "train step wall time (fit loop)")
+
+_KV_BYTES = REGISTRY.counter(
+    "mxnet_kvstore_bytes_total",
+    "payload bytes moved through kvstore push/pull, by op")
+_KV_OPS = REGISTRY.counter(
+    "mxnet_kvstore_ops_total", "kvstore push/pull calls, by op")
+_IO_STAGE = REGISTRY.histogram(
+    "mxnet_io_stage_seconds",
+    "host time spent staging a DataBatch host->device (io.stage_batch)")
+_IO_STAGE_BYTES = REGISTRY.counter(
+    "mxnet_io_stage_bytes_total", "bytes staged host->device by io")
+
+
+def record_kvstore(op, nbytes, n_ops=1):
+    """Account one kvstore push/pull: wire/device payload byte volume."""
+    labels = {"op": op}
+    _KV_BYTES.inc(int(nbytes), labels=labels)
+    _KV_OPS.inc(int(n_ops), labels=labels)
+
+
+def record_io_stage(seconds, nbytes=0):
+    """Account one io.stage_batch call (the input-feed staging wait)."""
+    _IO_STAGE.observe(seconds)
+    if nbytes:
+        _IO_STAGE_BYTES.inc(int(nbytes))
+
+
+# -- checkpoint manager registration (weak: managers come and go) ------------
+_ckpt_managers = weakref.WeakSet()
+
+
+def register_checkpoint_manager(manager):
+    """Called by CheckpointManager.__init__ so its stats() joins the
+    ``checkpoint`` collector (weakly held; close() needs no unhook)."""
+    _ckpt_managers.add(manager)
+
+
+# -- collectors --------------------------------------------------------------
+def _serving_snapshot():
+    # pull, never import: a process that never served has no serving keys
+    mod = sys.modules.get("mxnet_tpu.serving.metrics")
+    return mod.stats() if mod is not None else {}
+
+
+def _serving_samples():
+    out = []
+    for name, snap in sorted(_serving_snapshot().items()):
+        labels = {"server": name}
+        lat = snap.get("latency_ms") or {}
+        for q in ("p50", "p90", "p99"):
+            if lat.get(q) is not None:
+                out.append(("mxnet_serving_latency_ms", "gauge",
+                            "serving request latency percentile",
+                            {**labels, "quantile": q}, lat[q]))
+        for key, value in sorted(snap.items()):
+            if not isinstance(value, (int, float)) or \
+                    isinstance(value, bool):
+                continue
+            mtype = "counter" if key.endswith("_total") else "gauge"
+            out.append((f"mxnet_serving_{key}", mtype,
+                        f"serving.stats() {key}", labels, value))
+    return out
+
+
+def _checkpoint_snapshot():
+    return {m.directory: m.stats() for m in list(_ckpt_managers)}
+
+
+def _checkpoint_samples():
+    renames = {"saves": "saves_total", "failures": "failures_total",
+               "gc_removed": "gc_removed_total"}
+    out = []
+    for directory, stats in sorted(_checkpoint_snapshot().items()):
+        labels = {"directory": directory}
+        for key, value in sorted(stats.items()):
+            if not isinstance(value, (int, float)) or \
+                    isinstance(value, bool):
+                continue
+            name = renames.get(key, key)
+            mtype = "counter" if name.endswith("_total") else "gauge"
+            out.append((f"mxnet_checkpoint_{name}", mtype,
+                        f"CheckpointManager.stats() {key}", labels, value))
+    return out
+
+
+def _profiler_snapshot():
+    from .. import profiler
+    return {"dispatch": profiler.dispatch_counts(),
+            "counters": profiler.last_counters()}
+
+
+def _profiler_samples():
+    from .. import profiler
+    out = []
+    for kind, n in sorted(profiler.dispatch_counts().items()):
+        out.append(("mxnet_dispatch_total", "counter",
+                    "framework-issued XLA computation launches, by kind",
+                    {"kind": kind}, n))
+    for name, value in sorted(profiler.last_counters().items()):
+        if isinstance(value, (int, float)) and not isinstance(value, bool):
+            out.append(("mxnet_profiler_counter", "gauge",
+                        "last value of each profiler counter lane",
+                        {"counter": name}, value))
+    return out
+
+
+def _step_samples():
+    bd = _steps.step_breakdown()
+    out = [("mxnet_train_steps_total", "counter",
+            "fit-loop train steps timed by the step breakdown", {},
+            bd["steps"]),
+           ("mxnet_train_step_wall_seconds_total", "counter",
+            "total fit-loop step wall time", {}, bd["wall_s"]),
+           ("mxnet_train_step_lane_seconds_total", "counter",
+            "total step time attributed to each lane",
+            {"lane": "other"}, bd["other_s"])]
+    for lane, total in sorted(bd["lanes"].items()):
+        out.append(("mxnet_train_step_lane_seconds_total", "counter",
+                    "total step time attributed to each lane",
+                    {"lane": lane}, total))
+    return out
+
+
+def _watchdog_samples():
+    return [("mxnet_watchdog_fires_total", "counter",
+             "hang-watchdog stall dumps written", {}, watchdog.fires())]
+
+
+REGISTRY.register_collector("serving", _serving_snapshot, _serving_samples)
+REGISTRY.register_collector("checkpoint", _checkpoint_snapshot,
+                            _checkpoint_samples)
+REGISTRY.register_collector("profiler", _profiler_snapshot,
+                            _profiler_samples)
+REGISTRY.register_collector("step", _steps.step_breakdown, _step_samples)
+REGISTRY.register_collector(
+    "watchdog",
+    lambda: {"fires": watchdog.fires(), "last_dump": watchdog.last_dump()},
+    _watchdog_samples)
+
+
+def snapshot():
+    """Everything, one call: local metric families + serving +
+    checkpoint + profiler dispatch lanes + step breakdown + watchdog."""
+    return REGISTRY.snapshot()
+
+
+def prometheus_dump():
+    """Prometheus text exposition of :func:`snapshot`'s numeric surface."""
+    return REGISTRY.prometheus_dump()
+
+
+# -- env autostart -----------------------------------------------------------
+def _autostart():
+    from .. import config as _config
+    if _config.get("MXNET_TELEMETRY"):
+        enable()
+    port = int(_config.get("MXNET_TELEMETRY_PORT"))
+    if port > 0:
+        start_exporter(port)
+
+
+_autostart()

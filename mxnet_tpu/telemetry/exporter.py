@@ -1,0 +1,91 @@
+"""Prometheus scrape endpoint on the stdlib HTTP server.
+
+``start_exporter(port)`` binds ``127.0.0.1:<port>`` (port 0 picks an
+ephemeral one — used by tests/smoke) on a daemon thread and serves:
+
+* ``GET /metrics``       — ``telemetry.prometheus_dump()`` (text 0.0.4)
+* ``GET /snapshot.json`` — the full ``telemetry.snapshot()`` as JSON
+* ``GET /healthz``       — ``ok`` (liveness)
+
+Auto-start: importing :mod:`mxnet_tpu.telemetry` with
+``MXNET_TELEMETRY_PORT`` set starts the endpoint; loopback-only by
+design (front it with your own proxy if it must leave the host).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+log = logging.getLogger("mxnet_tpu.telemetry")
+
+_lock = threading.Lock()
+_server = None
+_thread = None
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "mxnet-tpu-telemetry"
+
+    def do_GET(self):  # noqa: N802 — http.server API
+        from . import prometheus_dump, snapshot
+        path = self.path.split("?", 1)[0]
+        if path in ("/metrics", "/metrics/"):
+            body = prometheus_dump().encode("utf-8")
+            ctype = "text/plain; version=0.0.4; charset=utf-8"
+        elif path in ("/snapshot.json", "/snapshot"):
+            body = json.dumps(snapshot(), default=str,
+                              sort_keys=True).encode("utf-8")
+            ctype = "application/json"
+        elif path == "/healthz":
+            body, ctype = b"ok\n", "text/plain"
+        else:
+            self.send_error(404, "try /metrics, /snapshot.json, /healthz")
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args):
+        log.debug("exporter: " + fmt, *args)
+
+
+def start_exporter(port=None):
+    """Start (or return the already-running) endpoint; -> bound port."""
+    global _server, _thread
+    if port is None:
+        from .. import config as _config
+        port = int(_config.get("MXNET_TELEMETRY_PORT"))
+    with _lock:
+        if _server is not None:
+            return _server.server_address[1]
+        server = ThreadingHTTPServer(("127.0.0.1", int(port)), _Handler)
+        server.daemon_threads = True
+        thread = threading.Thread(target=server.serve_forever,
+                                  name="mx-telemetry-exporter", daemon=True)
+        thread.start()
+        _server, _thread = server, thread
+        bound = server.server_address[1]
+    log.info("telemetry exporter serving http://127.0.0.1:%d/metrics", bound)
+    return bound
+
+
+def exporter_port():
+    """The running exporter's port (None when not running)."""
+    with _lock:
+        return _server.server_address[1] if _server is not None else None
+
+
+def stop_exporter():
+    global _server, _thread
+    with _lock:
+        server, _server = _server, None
+        thread, _thread = _thread, None
+    if server is not None:
+        server.shutdown()
+        server.server_close()
+    if thread is not None:
+        thread.join(timeout=5)

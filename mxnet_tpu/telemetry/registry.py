@@ -1,0 +1,345 @@
+"""Process-wide metrics registry: counters, gauges, histograms.
+
+One :class:`MetricsRegistry` instance (``telemetry.REGISTRY``) is the
+single sink every subsystem reports into, replacing the four private
+stores that grew organically (``serving/metrics.py`` reservoirs,
+``CheckpointManager._stats``, profiler dispatch lanes, kvstore wire
+counters).  Two feeding modes:
+
+* **push** — hot paths create a metric once and update it
+  (``REGISTRY.counter(name).inc()``); updates are a dict write under a
+  lock, cheap enough for per-batch call sites.
+* **pull** — subsystems that already keep their own thread-safe stats
+  register a *collector* (a zero-arg callable returning a plain dict);
+  ``snapshot()`` and ``prometheus_dump()`` invoke collectors at read
+  time, so the subsystem pays nothing until someone actually looks.
+
+``prometheus_dump()`` renders the standard text exposition format
+(``# HELP`` / ``# TYPE`` + samples; histograms as cumulative
+``_bucket{le=...}`` + ``_sum``/``_count``) so a stock Prometheus scrape
+of the :mod:`exporter` endpoint works unmodified.
+"""
+from __future__ import annotations
+
+import logging
+import math
+import threading
+
+log = logging.getLogger("mxnet_tpu.telemetry")
+
+_VALID_TYPES = ("counter", "gauge", "histogram")
+
+
+def exponential_buckets(start=1e-4, factor=2.0, count=16):
+    """Upper bounds ``start * factor**i`` — the default histogram grid
+    (100 us .. ~3.3 s at the defaults, the span/step-lane range)."""
+    if start <= 0 or factor <= 1 or count < 1:
+        raise ValueError("exponential_buckets: start>0, factor>1, count>=1")
+    return tuple(start * factor ** i for i in range(count))
+
+
+def _label_key(labels):
+    if not labels:
+        return ()
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _escape_label_value(value):
+    return (value.replace("\\", "\\\\").replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
+def _render_labels(key, extra=()):
+    items = list(key) + list(extra)
+    if not items:
+        return ""
+    body = ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in items)
+    return "{" + body + "}"
+
+
+def _fmt(value):
+    """Prometheus sample value: integral floats render as integers."""
+    if isinstance(value, bool):
+        return "1" if value else "0"
+    if isinstance(value, int):
+        return str(value)
+    f = float(value)
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
+    if math.isnan(f):
+        return "NaN"
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+class _Metric:
+    """Base: a named family holding one value-cell per label set."""
+
+    kind = None
+
+    def __init__(self, name, doc=""):
+        self.name = name
+        self.doc = doc
+        self._lock = threading.Lock()
+        self._cells = {}  # _label_key -> cell (kind-specific)
+
+    def _cell(self, labels, factory):
+        key = _label_key(labels)
+        cell = self._cells.get(key)
+        if cell is None:
+            cell = self._cells.setdefault(key, factory())
+        return key, cell
+
+
+class Counter(_Metric):
+    """Monotonic counter (per label set)."""
+
+    kind = "counter"
+
+    def inc(self, n=1, labels=None):
+        if n < 0:
+            raise ValueError(f"counter {self.name}: negative increment {n}")
+        with self._lock:
+            key = _label_key(labels)
+            self._cells[key] = self._cells.get(key, 0) + n
+
+    def value(self, labels=None):
+        with self._lock:
+            return self._cells.get(_label_key(labels), 0)
+
+    def _samples(self):
+        with self._lock:
+            return [(self.name, key, v) for key, v in self._cells.items()]
+
+    def _snapshot(self):
+        with self._lock:
+            return [{"labels": dict(k), "value": v}
+                    for k, v in sorted(self._cells.items())]
+
+
+class Gauge(_Metric):
+    """Point-in-time value; ``set_fn`` installs a lazy read-time probe."""
+
+    kind = "gauge"
+
+    def __init__(self, name, doc=""):
+        super().__init__(name, doc)
+        self._fns = {}  # _label_key -> zero-arg callable
+
+    def set(self, value, labels=None):
+        with self._lock:
+            self._cells[_label_key(labels)] = float(value)
+
+    def inc(self, n=1, labels=None):
+        with self._lock:
+            key = _label_key(labels)
+            self._cells[key] = self._cells.get(key, 0.0) + n
+
+    def dec(self, n=1, labels=None):
+        self.inc(-n, labels)
+
+    def set_fn(self, fn, labels=None):
+        with self._lock:
+            self._fns[_label_key(labels)] = fn
+
+    def value(self, labels=None):
+        key = _label_key(labels)
+        with self._lock:
+            fn = self._fns.get(key)
+            if fn is None:
+                return self._cells.get(key, 0.0)
+        try:
+            return float(fn())
+        except Exception as e:  # noqa: BLE001 — a dead probe reads as 0
+            log.debug("gauge %s probe failed: %s", self.name, e)
+            return 0.0
+
+    def _keys(self):
+        with self._lock:
+            return sorted(set(self._cells) | set(self._fns))
+
+    def _samples(self):
+        return [(self.name, key, self.value(dict(key)))
+                for key in self._keys()]
+
+    def _snapshot(self):
+        return [{"labels": dict(k), "value": self.value(dict(k))}
+                for k in self._keys()]
+
+
+class _HistCell:
+    __slots__ = ("counts", "sum", "count")
+
+    def __init__(self, n_buckets):
+        self.counts = [0] * n_buckets  # per-bucket (non-cumulative)
+        self.sum = 0.0
+        self.count = 0
+
+
+class Histogram(_Metric):
+    """Distribution over exponential (by default) bucket upper bounds."""
+
+    kind = "histogram"
+
+    def __init__(self, name, doc="", buckets=None):
+        super().__init__(name, doc)
+        bounds = tuple(sorted(buckets)) if buckets else exponential_buckets()
+        if any(b <= a for a, b in zip(bounds, bounds[1:])):
+            raise ValueError(f"histogram {self.name}: duplicate buckets")
+        self.buckets = bounds
+
+    def observe(self, value, labels=None):
+        v = float(value)
+        with self._lock:
+            _key, cell = self._cell(
+                labels, lambda: _HistCell(len(self.buckets)))
+            for i, bound in enumerate(self.buckets):
+                if v <= bound:
+                    cell.counts[i] += 1
+                    break
+            cell.sum += v
+            cell.count += 1
+
+    def stats(self, labels=None):
+        with self._lock:
+            cell = self._cells.get(_label_key(labels))
+            if cell is None:
+                return {"count": 0, "sum": 0.0}
+            return {"count": cell.count, "sum": cell.sum}
+
+    def _samples(self):
+        out = []
+        with self._lock:
+            for key, cell in self._cells.items():
+                cum = 0
+                for bound, n in zip(self.buckets, cell.counts):
+                    cum += n
+                    out.append((f"{self.name}_bucket", key, cum,
+                                (("le", _fmt(bound)),)))
+                out.append((f"{self.name}_bucket", key, cell.count,
+                            (("le", "+Inf"),)))
+                out.append((f"{self.name}_sum", key, cell.sum))
+                out.append((f"{self.name}_count", key, cell.count))
+        return out
+
+    def _snapshot(self):
+        with self._lock:
+            out = []
+            for key, cell in sorted(self._cells.items()):
+                out.append({"labels": dict(key), "count": cell.count,
+                            "sum": cell.sum,
+                            "buckets": {_fmt(b): n for b, n in
+                                        zip(self.buckets, cell.counts)}})
+            return out
+
+
+_KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+
+
+class MetricsRegistry:
+    """Named metric families + named pull-collectors behind one snapshot."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics = {}     # name -> _Metric
+        self._collectors = {}  # name -> (snapshot_fn, samples_fn|None)
+
+    # -- metric creation (get-or-create; kind collisions are an error) ------
+    def _get_or_create(self, kind, name, doc, **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = _KINDS[kind](name, doc, **kw)
+            elif m.kind != kind:
+                raise ValueError(
+                    f"metric {name!r} already registered as {m.kind}, "
+                    f"requested {kind}")
+            return m
+
+    def counter(self, name, doc=""):
+        return self._get_or_create("counter", name, doc)
+
+    def gauge(self, name, doc=""):
+        return self._get_or_create("gauge", name, doc)
+
+    def histogram(self, name, doc="", buckets=None):
+        return self._get_or_create("histogram", name, doc, buckets=buckets)
+
+    def get(self, name):
+        with self._lock:
+            return self._metrics.get(name)
+
+    # -- collectors ---------------------------------------------------------
+    def register_collector(self, name, snapshot_fn, samples_fn=None):
+        """Register a pull source.  ``snapshot_fn()`` -> plain dict merged
+        into ``snapshot()`` under ``name``; ``samples_fn()`` (optional) ->
+        list of ``(family, type, help, labels_dict, value)`` tuples merged
+        into the Prometheus dump.  Re-registering a name replaces it."""
+        with self._lock:
+            self._collectors[name] = (snapshot_fn, samples_fn)
+
+    def unregister_collector(self, name):
+        with self._lock:
+            self._collectors.pop(name, None)
+
+    def _collect(self):
+        with self._lock:
+            collectors = dict(self._collectors)
+        out = {}
+        for name, (snap_fn, _s) in collectors.items():
+            try:
+                out[name] = snap_fn()
+            except Exception as e:  # noqa: BLE001 — one dead source must not poison the snapshot
+                log.warning("telemetry collector %r failed: %s", name, e)
+                out[name] = {"error": f"{type(e).__name__}: {e}"}
+        return out
+
+    # -- read side ----------------------------------------------------------
+    def snapshot(self):
+        """One dict with every local metric family plus every collector's
+        raw snapshot (``serving``, ``checkpoint``, ``profiler``, …)."""
+        with self._lock:
+            metrics = dict(self._metrics)
+        out = {"metrics": {
+            name: {"type": m.kind, "doc": m.doc, "values": m._snapshot()}
+            for name, m in sorted(metrics.items())}}
+        out.update(self._collect())
+        return out
+
+    def prometheus_dump(self):
+        """Prometheus text exposition (format version 0.0.4)."""
+        with self._lock:
+            metrics = [self._metrics[n] for n in sorted(self._metrics)]
+            collectors = dict(self._collectors)
+        lines = []
+        for m in metrics:
+            lines.append(f"# HELP {m.name} {m.doc or m.name}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            for sample in m._samples():
+                name, key, value = sample[0], sample[1], sample[2]
+                extra = sample[3] if len(sample) > 3 else ()
+                lines.append(
+                    f"{name}{_render_labels(key, extra)} {_fmt(value)}")
+        # collector samples, grouped so HELP/TYPE renders once per family
+        families = {}
+        for cname, (_snap, samples_fn) in sorted(collectors.items()):
+            if samples_fn is None:
+                continue
+            try:
+                samples = samples_fn()
+            except Exception as e:  # noqa: BLE001 — one dead source must not poison the scrape
+                log.warning("telemetry samples for %r failed: %s", cname, e)
+                continue
+            for family, mtype, help_, labels, value in samples:
+                if mtype not in _VALID_TYPES:
+                    mtype = "gauge"
+                fam = families.setdefault(family, (mtype, help_, []))
+                fam[2].append((_label_key(labels), value))
+        for family in sorted(families):
+            mtype, help_, samples = families[family]
+            lines.append(f"# HELP {family} {help_ or family}")
+            lines.append(f"# TYPE {family} {mtype}")
+            for key, value in samples:
+                lines.append(f"{family}{_render_labels(key)} {_fmt(value)}")
+        return "\n".join(lines) + "\n"

@@ -1,0 +1,144 @@
+"""CI telemetry smoke (run via ``python -m mxnet_tpu.telemetry.smoke``).
+
+Exercises the whole observability surface the way an operator would:
+
+1. telemetry + exporter on (ephemeral port), watchdog armed with a
+   generous timeout (it must stay SILENT through a healthy run);
+2. a 5-step ``Module.fit`` (step-lane breakdown), a serving burst
+   through the DynamicBatcher, and one checkpoint commit;
+3. ``telemetry.snapshot()`` must carry all four subsystems from ONE
+   call; the scraped ``/metrics`` endpoint must be valid Prometheus
+   exposition text containing the required metric families;
+4. the step-breakdown lanes must account for >= 90% of measured step
+   wall time, and the watchdog must not have fired.
+"""
+from __future__ import annotations
+
+import os
+import re
+import sys
+import tempfile
+import urllib.request
+
+os.environ.setdefault("MXNET_TELEMETRY", "1")
+os.environ.setdefault("MXNET_WATCHDOG_S", "120")
+
+REQUIRED_FAMILIES = (
+    "mxnet_train_step_lane_seconds_total",
+    "mxnet_train_steps_total",
+    "mxnet_serving_requests_total",
+    "mxnet_serving_responses_total",
+    "mxnet_dispatch_total",
+    "mxnet_checkpoint_saves_total",
+    "mxnet_span_seconds",
+    "mxnet_watchdog_fires_total",
+)
+
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? [^ ]+$")
+
+
+def _fail(msg):
+    print(f"FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def main():
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry
+
+    telemetry.enable()
+    port = telemetry.start_exporter(0)
+    print(f"exporter on http://127.0.0.1:{port}/metrics")
+
+    def build(train=True):
+        d = mx.sym.Variable("data")
+        h = mx.sym.FullyConnected(d, num_hidden=64, name="fc1")
+        h = mx.sym.Activation(h, act_type="relu")
+        h = mx.sym.FullyConnected(h, num_hidden=10, name="fc2")
+        # serving wants the label-free graph (logits); fit wants the loss
+        return mx.sym.SoftmaxOutput(h, name="softmax") if train else h
+
+    # -- 5-step fit (one epoch over 5 batches) ------------------------------
+    rng = np.random.RandomState(0)
+    x = rng.randn(160, 50).astype(np.float32)
+    y = rng.randint(0, 10, 160).astype(np.float32)
+    it = mx.io.NDArrayIter(x, y, batch_size=32)
+    mod = mx.mod.Module(build(), context=mx.cpu())
+    mod.fit(it, num_epoch=1, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1},
+            batch_end_callback=mx.callback.StepTimeline(frequent=3))
+
+    # -- serving burst -------------------------------------------------------
+    with mx.serving.ModelServer(max_latency_ms=2.0) as server:
+        server.load("mlp", symbol=build(train=False),
+                    params={"fc1_weight": mx.nd.array(
+                                rng.randn(64, 50).astype(np.float32) * 0.1),
+                            "fc1_bias": mx.nd.zeros((64,)),
+                            "fc2_weight": mx.nd.array(
+                                rng.randn(10, 64).astype(np.float32) * 0.1),
+                            "fc2_bias": mx.nd.zeros((10,))})
+        futs = [server.predict_async(
+                    "mlp", {"data": rng.randn(50).astype(np.float32)})
+                for _ in range(48)]
+        for f in futs:
+            f.result(30.0)
+
+    # -- one checkpoint commit ----------------------------------------------
+    from mxnet_tpu.checkpoint import CheckpointManager
+    with tempfile.TemporaryDirectory() as tmp:
+        with CheckpointManager(tmp) as mgr:
+            mgr.save(1, arrays={"w": mx.nd.ones((8, 8))}, block=True)
+            if mgr.stats()["saves"] != 1:
+                _fail("checkpoint save not visible in stats()")
+
+        # -- one snapshot, four subsystems ----------------------------------
+        snap = telemetry.snapshot()
+        if not snap["serving"]:
+            _fail("snapshot() has no serving metrics")
+        responses = max((s.get("responses_total", 0)
+                         for s in snap["serving"].values()), default=0)
+        if responses < 48:
+            _fail(f"serving responses missing from snapshot: "
+                  f"{snap['serving']}")
+        if not snap["checkpoint"]:
+            _fail("snapshot() has no checkpoint metrics")
+        if snap["profiler"]["dispatch"].get("total", 0) < 5:
+            _fail("snapshot() has no fused-step dispatch counts")
+        step = snap["step"]
+        if step["steps"] < 5:
+            _fail(f"snapshot() step breakdown saw {step['steps']} steps")
+        lane_cover = sum(step["lanes"].values()) / max(1e-9, step["wall_s"])
+        print(f"step lanes cover {lane_cover:.1%} of wall "
+              f"({step['steps']} steps)")
+        if lane_cover < 0.9:
+            _fail(f"step lanes cover only {lane_cover:.1%} of wall time")
+
+        # -- scrape ----------------------------------------------------------
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as resp:
+            text = resp.read().decode("utf-8")
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        if not _SAMPLE_RE.match(line):
+            _fail(f"invalid exposition line: {line!r}")
+    for family in REQUIRED_FAMILIES:
+        if f"# TYPE {family} " not in text:
+            _fail(f"metric family {family} missing from /metrics scrape")
+
+    # -- watchdog stayed silent ----------------------------------------------
+    if telemetry.watchdog.fires() != 0:
+        _fail(f"watchdog fired {telemetry.watchdog.fires()} time(s) "
+              f"during a healthy run ({telemetry.watchdog.last_dump()})")
+
+    telemetry.stop_exporter()
+    print("telemetry smoke OK: snapshot unified 4 subsystems, "
+          f"{len(REQUIRED_FAMILIES)} families scraped, lanes {lane_cover:.0%}"
+          " of step wall, watchdog silent")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,124 @@
+"""Span tracer: ``with telemetry.span("fit/step/h2d"): ...``.
+
+Thread-safe, nestable, and ~zero-cost when telemetry is disabled: the
+disabled path is one module-global check and a shared no-op context
+manager (no allocation, well under a microsecond — asserted by
+``tests/test_telemetry.py``).
+
+An enabled span, on exit, fans its duration out to every sink at once:
+
+* the profiler's chrome-trace stream (``profiler.record_op`` with
+  ``cat="span"``) — spans land in the same ``profiler.dump()`` JSON and
+  ``profiler.dumps()`` aggregate table as op dispatches, on the thread's
+  own lane, so nesting renders natively in chrome://tracing;
+* a ``jax.profiler.TraceAnnotation`` when a jax xplane trace is active
+  (``MXNET_PROFILER_XPLANE_DIR``), so spans also show up in
+  TensorBoard/perfetto next to the XLA device timeline;
+* the ``mxnet_span_seconds`` histogram in the global registry
+  (label ``span=<name>``), which is what ``snapshot()`` /
+  ``prometheus_dump()`` expose.
+
+Naming convention (docs/observability.md): slash-separated paths,
+``<subsystem>/<operation>[/<phase>]`` — e.g. ``fit/step/h2d_stage``,
+``serving/batch/run``, ``ckpt/save/snapshot``.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from .. import profiler as _profiler
+
+_enabled = False
+_tls = threading.local()
+
+# filled in by telemetry/__init__ (one histogram family for all spans)
+_span_hist = None
+
+
+def enable():
+    """Turn the span tracer + step-time breakdown on for this process."""
+    global _enabled
+    _enabled = True
+
+
+def disable():
+    global _enabled
+    _enabled = False
+
+
+def enabled():
+    return _enabled
+
+
+def _stack():
+    s = getattr(_tls, "spans", None)
+    if s is None:
+        s = _tls.spans = []
+    return s
+
+
+def current_span():
+    """Name of the innermost open span on this thread (None outside)."""
+    s = getattr(_tls, "spans", None)
+    return s[-1] if s else None
+
+
+def span_stack():
+    """Open span names on this thread, outermost first."""
+    return tuple(getattr(_tls, "spans", ()) or ())
+
+
+class _NullSpan:
+    """Shared no-op for the disabled path — nothing allocated per call."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("name", "_t0", "_jax")
+
+    def __init__(self, name):
+        self.name = name
+        self._t0 = None
+        self._jax = None
+
+    def __enter__(self):
+        _stack().append(self.name)
+        if _profiler.jax_trace_dir():
+            try:
+                import jax
+                self._jax = jax.profiler.TraceAnnotation(self.name)
+                self._jax.__enter__()
+            except Exception:  # graftlint: disable=swallowed-error -- xplane annotation is garnish; the span itself must never fail
+                self._jax = None
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dur_s = time.perf_counter() - self._t0
+        if self._jax is not None:
+            self._jax.__exit__(*exc)
+        s = _stack()
+        if s and s[-1] == self.name:
+            s.pop()
+        if _span_hist is not None:
+            _span_hist.observe(dur_s, labels={"span": self.name})
+        _profiler.record_op(self.name, dur_s * 1e6, cat="span")
+        return False
+
+
+def span(name):
+    """Context manager timing one named region (no-op while disabled)."""
+    if not _enabled:
+        return _NULL_SPAN
+    return _Span(name)

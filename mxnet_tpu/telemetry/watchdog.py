@@ -1,0 +1,206 @@
+"""Hang watchdog: dump every thread's stack when progress stops.
+
+A wedged fused step, a serving runner stuck in a compile, a checkpoint
+writer deadlocked on a lock — all present identically to an operator: a
+silent process.  The watchdog turns that silence into a diagnosis:
+
+* sections where progress is *expected* wrap themselves in
+  ``watchdog.arm(name)`` (the fit loop arms ``train/fit`` for the whole
+  run and ``beat``\\ s every batch; a batcher worker arms
+  ``serving/<name>`` around each batch it executes);
+* a daemon heartbeat checker wakes a few times per armed timeout; an
+  armed section whose last beat is older than ``MXNET_WATCHDOG_S``
+  seconds *fires*: all-thread stacks (``sys._current_frames``) plus the
+  live ``telemetry.snapshot()`` go to stderr AND a dump file
+  (``mxnet-watchdog-<pid>-<n>.txt`` in ``MXNET_WATCHDOG_DIR`` or cwd);
+* one dump per stall episode — it re-arms only after progress resumes.
+
+``MXNET_WATCHDOG_S=0`` (the default) disables everything: ``arm`` hands
+back a shared no-op context and no thread is ever spawned.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import threading
+import time
+import traceback
+
+log = logging.getLogger("mxnet_tpu.telemetry.watchdog")
+
+_lock = threading.Lock()
+_entries = {}   # name -> {"armed", "count", "last", "timeout", "fired_count"}
+_state = {"thread": None, "stop": None, "fires": 0, "last_dump": None}
+
+
+def _timeout_s():
+    from .. import config as _config
+    return float(_config.get("MXNET_WATCHDOG_S"))
+
+
+class _NullCtx:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_CTX = _NullCtx()
+
+
+class _Armed:
+    __slots__ = ("name",)
+
+    def __init__(self, name):
+        self.name = name
+
+    def __enter__(self):
+        with _lock:
+            e = _entries.setdefault(self.name, {
+                "armed": 0, "count": 0, "last": time.monotonic(),
+                "timeout": 0.0, "fired_count": None})
+            e["armed"] += 1
+            e["timeout"] = _timeout_s()
+            e["count"] += 1
+            e["last"] = time.monotonic()
+        _ensure_thread()
+        return self
+
+    def __exit__(self, *exc):
+        with _lock:
+            e = _entries.get(self.name)
+            if e is not None:
+                e["armed"] = max(0, e["armed"] - 1)
+                e["last"] = time.monotonic()
+        return False
+
+
+def active():
+    """True when the watchdog knob is set (arm() is not a no-op)."""
+    return _timeout_s() > 0
+
+
+def arm(name):
+    """Context manager marking a region where progress is expected;
+    pair with :func:`beat` for long-running loops."""
+    if not active():
+        return _NULL_CTX
+    return _Armed(name)
+
+
+def beat(name):
+    """Record progress for an armed section (cheap; no-op when the
+    section was never armed)."""
+    with _lock:
+        e = _entries.get(name)
+        if e is not None:
+            e["count"] += 1
+            e["last"] = time.monotonic()
+
+
+def fires():
+    """How many times the watchdog has fired in this process."""
+    with _lock:
+        return _state["fires"]
+
+
+def last_dump():
+    """Path of the most recent dump file (None before any fire)."""
+    with _lock:
+        return _state["last_dump"]
+
+
+def _ensure_thread():
+    with _lock:
+        if _state["thread"] is not None and _state["thread"].is_alive():
+            return
+        _state["stop"] = threading.Event()
+        t = threading.Thread(target=_loop, name="mx-telemetry-watchdog",
+                             daemon=True)
+        _state["thread"] = t
+        t.start()
+
+
+def _stop_for_tests():
+    with _lock:
+        stop, _state["thread"] = _state["stop"], None
+        _entries.clear()
+    if stop is not None:
+        stop.set()
+
+
+def _loop():
+    while True:
+        with _lock:
+            stop = _state["stop"]
+            timeouts = [e["timeout"] for e in _entries.values()
+                        if e["armed"] > 0 and e["timeout"] > 0]
+        interval = max(0.02, min(timeouts) / 4) if timeouts else 0.5
+        if stop is None or stop.wait(interval):
+            return
+        _check()
+
+
+def _check():
+    now = time.monotonic()
+    stale = []
+    with _lock:
+        for name, e in _entries.items():
+            if e["armed"] <= 0 or e["timeout"] <= 0:
+                continue
+            if e["fired_count"] == e["count"]:
+                continue  # already dumped this stall episode
+            age = now - e["last"]
+            if age > e["timeout"]:
+                e["fired_count"] = e["count"]
+                _state["fires"] += 1
+                stale.append((name, age))
+    for name, age in stale:
+        _fire(name, age)
+
+
+def _render_dump(name, age):
+    lines = [f"== mxnet_tpu watchdog: no progress on {name!r} for "
+             f"{age:.1f}s (pid {os.getpid()}) =="]
+    names = {t.ident: t.name for t in threading.enumerate()}
+    for ident, frame in sorted(sys._current_frames().items()):
+        lines.append(f"-- thread {names.get(ident, '?')} (ident {ident}) --")
+        lines.extend(ln.rstrip("\n")
+                     for ln in traceback.format_stack(frame))
+    lines.append("-- telemetry snapshot --")
+    try:
+        from . import snapshot
+        lines.append(json.dumps(snapshot(), indent=1, default=str,
+                                sort_keys=True))
+    except Exception as e:  # noqa: BLE001 — the stack dump must land even if a collector wedged too
+        lines.append(f"(snapshot unavailable: {type(e).__name__}: {e})")
+    return "\n".join(lines) + "\n"
+
+
+def _fire(name, age):
+    text = _render_dump(name, age)
+    sys.stderr.write(text)
+    sys.stderr.flush()
+    from .. import config as _config
+    directory = _config.get("MXNET_WATCHDOG_DIR") or os.getcwd()
+    with _lock:
+        n = _state["fires"]
+    path = os.path.join(directory,
+                        f"mxnet-watchdog-{os.getpid()}-{n}.txt")
+    try:
+        tmp = f"{path}.tmp-{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(text)
+        os.replace(tmp, path)
+        with _lock:
+            _state["last_dump"] = path
+        log.error("watchdog: %r stalled %.1fs — dump written to %s",
+                  name, age, path)
+    except OSError as e:
+        log.error("watchdog: %r stalled %.1fs — dump file failed (%s); "
+                  "stacks were written to stderr", name, age, e)

@@ -310,6 +310,91 @@ def test_swallowed_error_near_misses():
     assert "swallowed-error" not in rules_hit(lint(src))
 
 
+# -- raw-phase-timing --------------------------------------------------------
+PHASE_TIMED = """
+    import time
+
+    def serve_batch(runner, feed):
+        t0 = time.perf_counter()
+        out = runner(feed)
+        dur_ms = (time.perf_counter() - t0) * 1e3
+        return out, dur_ms
+"""
+
+
+def test_phase_timing_flags_clock_delta_in_hot_path():
+    findings = lint(PHASE_TIMED, path="mxnet_tpu/serving/batcher.py")
+    hits = [f for f in findings if f.rule == "raw-phase-timing"]
+    assert hits and hits[0].symbol == "serve_batch:t0"
+    assert "telemetry.span" in hits[0].message
+
+
+def test_phase_timing_flags_toc_minus_tic():
+    src = """
+        import time
+
+        def fit_epoch(step):
+            tic = time.time()
+            step()
+            toc = time.time()
+            return toc - tic
+    """
+    findings = lint(src, path="mxnet_tpu/module.py")
+    assert any(f.rule == "raw-phase-timing" for f in findings)
+
+
+def test_phase_timing_near_miss_outside_hot_path():
+    # same code in offline tooling is fine
+    assert "raw-phase-timing" not in rules_hit(
+        lint(PHASE_TIMED, path="tools/bench_pipeline.py"))
+
+
+def test_phase_timing_near_miss_deadline_math():
+    # deadline arithmetic is not phase timing: additions, and
+    # subtractions where the clock stamp is on the LEFT of a budget
+    src = """
+        import time
+
+        def wait_until(cond, budget_s):
+            deadline = time.perf_counter() + budget_s
+            while not cond():
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    return False
+            return True
+    """
+    assert "raw-phase-timing" not in rules_hit(
+        lint(src, path="mxnet_tpu/serving/batcher.py"))
+
+
+def test_phase_timing_near_miss_unrelated_name():
+    # subtracting a non-clock name from a clock read stays silent
+    src = """
+        import time
+
+        def age_of(t_enqueue):
+            return time.perf_counter() - t_enqueue
+    """
+    assert "raw-phase-timing" not in rules_hit(
+        lint(src, path="mxnet_tpu/serving/batcher.py"))
+
+
+def test_phase_timing_scope_is_per_function():
+    # a stamp from one function doesn't taint another
+    src = """
+        import time
+
+        def a():
+            t0 = time.perf_counter()
+            return t0
+
+        def b(t0):
+            return time.perf_counter() - t0
+    """
+    assert "raw-phase-timing" not in rules_hit(
+        lint(src, path="mxnet_tpu/module.py"))
+
+
 # -- env-knob-drift ----------------------------------------------------------
 def test_env_drift_flags_unregistered_read():
     rules = [EnvDriftRule(registered={"MXNET_GOOD"})]

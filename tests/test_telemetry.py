@@ -1,0 +1,474 @@
+"""mxnet_tpu.telemetry test suite (ISSUE 5).
+
+Covers: span nesting + thread-safety + disabled-path overhead, the
+Prometheus text-format golden, registry merge of the serving /
+checkpoint / profiler sources behind one snapshot(), the hang watchdog
+firing on a deliberately-wedged thread (dump names the stuck frame),
+the fit-loop step-breakdown lanes summing to ~step wall time, the
+exporter endpoint, and the satellite fixes (serving snapshot under
+concurrency, profiler continuous-dump deadline math + dispatch lanes in
+dumps(aggregate=True), CheckpointManager public stats gauges +
+deprecated _stats).
+"""
+import os
+import re
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import profiler, telemetry
+from mxnet_tpu.telemetry import watchdog
+from mxnet_tpu.telemetry.registry import MetricsRegistry
+
+
+@pytest.fixture
+def enabled():
+    telemetry.enable()
+    yield
+    telemetry.disable()
+
+
+def _mlp(train=True):
+    d = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(d, num_hidden=32, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, num_hidden=10, name="fc2")
+    return mx.sym.SoftmaxOutput(h, name="softmax") if train else h
+
+
+# -- spans -------------------------------------------------------------------
+def test_span_nesting_and_stack(enabled):
+    assert telemetry.current_span() is None
+    with telemetry.span("t/outer"):
+        assert telemetry.current_span() == "t/outer"
+        with telemetry.span("t/outer/inner"):
+            assert telemetry.span_stack() == ("t/outer", "t/outer/inner")
+        assert telemetry.current_span() == "t/outer"
+    assert telemetry.current_span() is None
+
+
+def test_span_exception_unwinds_stack(enabled):
+    with pytest.raises(ValueError):
+        with telemetry.span("t/raises"):
+            raise ValueError("boom")
+    assert telemetry.current_span() is None
+    # the failed span still recorded its duration
+    hist = telemetry.REGISTRY.get("mxnet_span_seconds")
+    assert hist.stats(labels={"span": "t/raises"})["count"] == 1
+
+
+def test_span_merges_into_profiler_dump(enabled):
+    profiler.start()
+    try:
+        with telemetry.span("t/profiled"):
+            time.sleep(0.001)
+    finally:
+        profiler.stop()
+    agg = profiler.dumps(format="json", reset=True)
+    assert "t/profiled" in agg
+    assert agg["t/profiled"]["count"] == 1
+    assert agg["t/profiled"]["total_ms"] >= 1.0
+
+
+def test_span_thread_safety(enabled):
+    name = "t/threaded-unique"
+    n_threads, per_thread = 8, 200
+
+    def work():
+        for _ in range(per_thread):
+            with telemetry.span(name):
+                pass
+
+    threads = [threading.Thread(target=work) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    hist = telemetry.REGISTRY.get("mxnet_span_seconds")
+    assert hist.stats(labels={"span": name})["count"] == \
+        n_threads * per_thread
+
+
+def test_disabled_span_overhead_under_1us():
+    telemetry.disable()
+    n = 20000
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            with telemetry.span("t/disabled"):
+                pass
+        best = min(best, (time.perf_counter() - t0) / n)
+    assert best < 1e-6, f"disabled span costs {best * 1e9:.0f} ns"
+    # and records nothing
+    hist = telemetry.REGISTRY.get("mxnet_span_seconds")
+    assert hist.stats(labels={"span": "t/disabled"})["count"] == 0
+
+
+# -- registry / prometheus ---------------------------------------------------
+def test_prometheus_text_format_golden():
+    reg = MetricsRegistry()
+    c = reg.counter("test_requests_total", "requests served")
+    c.inc(3)
+    c.inc(2, labels={"model": "a"})
+    g = reg.gauge("test_depth", "queue depth")
+    g.set(7)
+    h = reg.histogram("test_lat_seconds", "latency",
+                      buckets=(0.001, 0.01, 0.1))
+    h.observe(0.005)
+    h.observe(0.5)
+    text = reg.prometheus_dump()
+    lines = text.splitlines()
+    for expected in [
+        "# TYPE test_requests_total counter",
+        "test_requests_total 3",
+        'test_requests_total{model="a"} 2',
+        "# TYPE test_depth gauge",
+        "test_depth 7",
+        "# TYPE test_lat_seconds histogram",
+        'test_lat_seconds_bucket{le="0.001"} 0',
+        'test_lat_seconds_bucket{le="0.01"} 1',
+        'test_lat_seconds_bucket{le="0.1"} 1',
+        'test_lat_seconds_bucket{le="+Inf"} 2',
+        "test_lat_seconds_sum 0.505",
+        "test_lat_seconds_count 2",
+    ]:
+        assert expected in lines, f"missing {expected!r} in:\n{text}"
+    # every sample line parses as exposition text; TYPE precedes samples
+    sample_re = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? [^ ]+$")
+    seen_types = set()
+    for line in lines:
+        if line.startswith("# TYPE"):
+            seen_types.add(line.split()[2])
+        elif line and not line.startswith("#"):
+            assert sample_re.match(line), f"bad sample line {line!r}"
+            family = re.split(r"[{ ]", line)[0]
+            base = re.sub(r"_(bucket|sum|count)$", "", family)
+            assert family in seen_types or base in seen_types
+
+
+def test_registry_kind_collision_rejected():
+    reg = MetricsRegistry()
+    reg.counter("test_x_total")
+    with pytest.raises(ValueError):
+        reg.gauge("test_x_total")
+
+
+def test_label_escaping():
+    reg = MetricsRegistry()
+    reg.counter("test_esc_total").inc(1, labels={"p": 'a"b\\c\nd'})
+    text = reg.prometheus_dump()
+    assert r'test_esc_total{p="a\"b\\c\nd"} 1' in text
+
+
+def test_snapshot_merges_serving_checkpoint_profiler(tmp_path):
+    from mxnet_tpu.checkpoint import CheckpointManager
+    from mxnet_tpu.serving.metrics import ServingMetrics
+
+    m = ServingMetrics("t_merge_server")
+    m.incr("requests_total", 5)
+    m.observe_latency(3.0)
+    with CheckpointManager(str(tmp_path / "ck"), async_save=False) as mgr:
+        mgr.save(1, arrays={"w": mx.nd.ones((4, 4))}, block=True)
+        profiler.record_dispatch("t_merge_kind")
+        snap = telemetry.snapshot()
+    assert snap["serving"]["t_merge_server"]["requests_total"] == 5
+    ck = snap["checkpoint"][str(tmp_path / "ck")]
+    assert ck["saves"] == 1 and ck["writer_queue_depth"] == 0
+    assert snap["profiler"]["dispatch"]["t_merge_kind"] >= 1
+    assert "steps" in snap["step"] and "fires" in snap["watchdog"]
+    # ...and the same three sources surface in the Prometheus dump
+    text = telemetry.prometheus_dump()
+    assert 'mxnet_serving_requests_total{server="t_merge_server"} 5' in text
+    assert "mxnet_checkpoint_saves_total" in text
+    assert 'mxnet_dispatch_total{kind="t_merge_kind"}' in text
+
+
+def test_kvstore_and_io_counters_feed_registry():
+    kv = mx.kvstore.create("local")
+    a = mx.nd.ones((16, 4))
+    kv.init("w", a)
+    before = telemetry.REGISTRY.get("mxnet_kvstore_bytes_total") \
+        .value(labels={"op": "push"})
+    kv.push("w", a)
+    out = mx.nd.zeros((16, 4))
+    kv.pull("w", out=out)
+    reg = telemetry.REGISTRY
+    assert reg.get("mxnet_kvstore_bytes_total") \
+        .value(labels={"op": "push"}) - before == 16 * 4 * 4
+    assert reg.get("mxnet_kvstore_bytes_total") \
+        .value(labels={"op": "pull"}) >= 16 * 4 * 4
+    # io staging waits land in the histogram
+    from mxnet_tpu import io as mx_io
+    batch = mx_io.DataBatch(data=[mx.nd.ones((2, 2))], label=None)
+    n0 = reg.get("mxnet_io_stage_seconds").stats()["count"]
+    mx_io.stage_batch(batch, mx.cpu())
+    assert reg.get("mxnet_io_stage_seconds").stats()["count"] == n0 + 1
+
+
+# -- step breakdown ----------------------------------------------------------
+def test_fit_step_breakdown_lanes_cover_wall(enabled):
+    telemetry.reset_step_stats()
+    rng = np.random.RandomState(0)
+    x = rng.randn(160, 50).astype(np.float32)
+    y = rng.randint(0, 10, 160).astype(np.float32)
+    it = mx.io.NDArrayIter(x, y, batch_size=32)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(it, num_epoch=2, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1})
+    bd = telemetry.step_breakdown()
+    assert bd["steps"] == 10
+    for lane in ("data_wait", "h2d_stage", "step_dispatch", "device_block",
+                 "metric_flush", "ckpt_block"):
+        assert lane in bd["lanes"]
+    covered = sum(bd["lanes"].values())
+    assert covered >= 0.9 * bd["wall_s"], \
+        f"lanes cover {covered / bd['wall_s']:.1%} of step wall"
+    assert covered <= 1.5 * bd["wall_s"]  # sanity: no double counting
+    assert bd["last"]["wall_s"] > 0
+    # dispatch must dominate this CPU-bound fit, and the sync lanes exist
+    assert bd["lanes"]["step_dispatch"] > 0
+    assert bd["lanes"]["metric_flush"] > 0
+
+
+def test_fit_without_telemetry_records_nothing():
+    telemetry.disable()
+    telemetry.reset_step_stats()
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 50).astype(np.float32)
+    y = rng.randint(0, 10, 64).astype(np.float32)
+    it = mx.io.NDArrayIter(x, y, batch_size=32)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(it, num_epoch=1, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1})
+    assert telemetry.step_breakdown()["steps"] == 0
+
+
+def test_step_timeline_callback_logs(enabled, caplog):
+    import logging
+
+    telemetry.reset_step_stats()
+    rng = np.random.RandomState(0)
+    x = rng.randn(128, 50).astype(np.float32)
+    y = rng.randint(0, 10, 128).astype(np.float32)
+    it = mx.io.NDArrayIter(x, y, batch_size=32)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    with caplog.at_level(logging.INFO, logger="mxnet_tpu.callback"):
+        mod.fit(it, num_epoch=1, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1},
+                batch_end_callback=mx.callback.StepTimeline(frequent=2))
+    lines = [r.message for r in caplog.records if "step " in r.message]
+    assert lines, "StepTimeline logged nothing"
+    assert "step_dispatch" in lines[0]
+
+
+def test_ckpt_block_lane_attributed(enabled, tmp_path):
+    from mxnet_tpu.checkpoint import CheckpointManager
+    telemetry.reset_step_stats()
+    timer = telemetry.step_timer()
+    try:
+        timer.begin_step()
+        with CheckpointManager(str(tmp_path), async_save=False) as mgr:
+            mgr.save(1, arrays={"w": mx.nd.ones((64, 64))}, block=True)
+        timer.end_step()
+    finally:
+        timer.close()
+    bd = telemetry.step_breakdown()
+    assert bd["lanes"]["ckpt_block"] > 0
+
+
+# -- watchdog ----------------------------------------------------------------
+def test_watchdog_fires_on_wedged_thread(monkeypatch, tmp_path):
+    monkeypatch.setenv("MXNET_WATCHDOG_S", "0.2")
+    monkeypatch.setenv("MXNET_WATCHDOG_DIR", str(tmp_path))
+    release = threading.Event()
+    fires0 = watchdog.fires()
+
+    def _deliberately_wedged_fn():
+        with watchdog.arm("test/wedge"):
+            release.wait(10)
+
+    t = threading.Thread(target=_deliberately_wedged_fn, daemon=True)
+    t.start()
+    try:
+        deadline = time.time() + 5
+        while watchdog.fires() == fires0 and time.time() < deadline:
+            time.sleep(0.05)
+        assert watchdog.fires() > fires0, "watchdog never fired"
+        dump = watchdog.last_dump()
+        assert dump and os.path.dirname(dump) == str(tmp_path)
+        text = open(dump).read()
+        # the dump names the stuck section AND the stuck frame
+        assert "test/wedge" in text
+        assert "_deliberately_wedged_fn" in text
+        assert "telemetry snapshot" in text
+        # one dump per stall episode: no refire without progress
+        fired = watchdog.fires()
+        time.sleep(0.5)
+        assert watchdog.fires() == fired
+    finally:
+        release.set()
+        t.join(5)
+
+
+def test_watchdog_silent_when_beating(monkeypatch):
+    monkeypatch.setenv("MXNET_WATCHDOG_S", "0.3")
+    fires0 = watchdog.fires()
+    with watchdog.arm("test/healthy"):
+        for _ in range(6):
+            time.sleep(0.1)
+            watchdog.beat("test/healthy")
+    assert watchdog.fires() == fires0
+
+
+def test_watchdog_disabled_is_noop(monkeypatch):
+    monkeypatch.delenv("MXNET_WATCHDOG_S", raising=False)
+    assert not watchdog.active()
+    ctx = watchdog.arm("test/never")
+    assert type(ctx).__name__ == "_NullCtx"
+
+
+# -- exporter ----------------------------------------------------------------
+def test_exporter_serves_metrics_and_snapshot():
+    port = telemetry.start_exporter(0)
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+            assert r.status == 200
+            assert "version=0.0.4" in r.headers["Content-Type"]
+            text = r.read().decode()
+        assert "# TYPE mxnet_span_seconds histogram" in text
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/snapshot.json", timeout=10) as r:
+            import json
+            snap = json.loads(r.read().decode())
+        assert "metrics" in snap and "profiler" in snap
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10) as r:
+            assert r.read() == b"ok\n"
+    finally:
+        telemetry.stop_exporter()
+
+
+# -- satellite: serving metrics ----------------------------------------------
+def test_serving_snapshot_under_concurrent_mutation():
+    from mxnet_tpu.serving.metrics import ServingMetrics
+    m = ServingMetrics("t_race")
+    stop = threading.Event()
+    errors = []
+
+    def mutate():
+        i = 0
+        while not stop.is_set():
+            m.observe_latency(i % 7)
+            m.incr("responses_total")
+            i += 1
+
+    def read():
+        try:
+            for _ in range(200):
+                snap = m.snapshot()
+                lat = snap["latency_ms"]
+                if lat["samples"]:
+                    assert lat["p50"] is not None
+        except Exception as e:  # pragma: no cover - the failure signal
+            errors.append(e)
+
+    writers = [threading.Thread(target=mutate) for _ in range(4)]
+    reader = threading.Thread(target=read)
+    for t in writers:
+        t.start()
+    reader.start()
+    reader.join(30)
+    stop.set()
+    for t in writers:
+        t.join(5)
+    assert not errors
+
+
+def test_serving_stats_shape_unchanged_and_in_registry():
+    from mxnet_tpu.serving import metrics as smetrics
+    m = smetrics.ServingMetrics("t_shape")
+    m.incr("requests_total", 2)
+    m.observe_latency(1.0)
+    snap = smetrics.stats()["t_shape"]
+    # the pre-ISSUE-5 dict contract callers rely on
+    for key in ("name", "uptime_s", "throughput_rps", "latency_ms",
+                "batch_occupancy", "requests_total"):
+        assert key in snap
+    assert telemetry.snapshot()["serving"]["t_shape"]["requests_total"] == 2
+
+
+# -- satellite: profiler -----------------------------------------------------
+def test_continuous_dump_deadline_math():
+    from mxnet_tpu.profiler import _next_dump_deadline
+    # normal re-arm: anchored at deadline + period, not "now"
+    assert _next_dump_deadline(10.0, 1.0, 10.3) == 11.0
+    # a slow dump must not compress the next interval to zero...
+    nxt = _next_dump_deadline(10.0, 1.0, 12.5)
+    assert nxt == pytest.approx(13.0)  # ...and realigns to the 10+N grid
+    assert nxt > 12.5
+
+
+def test_continuous_dump_no_drift(tmp_path):
+    fname = str(tmp_path / "cont.json")
+    profiler.set_config(filename=fname, continuous_dump=True,
+                        dump_period=0.05)
+    profiler.start()
+    try:
+        deadline = time.time() + 5
+        while not os.path.exists(fname) and time.time() < deadline:
+            time.sleep(0.01)
+        # the re-arm deadline stays on the monotonic grid even after dumps
+        d1 = profiler._state["dump_deadline"]
+        time.sleep(0.12)
+        d2 = profiler._state["dump_deadline"]
+        assert d2 > d1
+        assert abs(((d2 - d1) / 0.05) - round((d2 - d1) / 0.05)) < 0.2
+    finally:
+        profiler.stop()
+        profiler.set_config(continuous_dump=False)
+        profiler.dumps(reset=True)
+    assert os.path.exists(fname)
+
+
+def test_dumps_aggregate_includes_dispatch_lanes():
+    profiler.reset_dispatch_counts()
+    profiler.record_dispatch("t_lane")
+    profiler.record_dispatch("t_lane")
+    agg = profiler.dumps(format="json", aggregate=True)
+    assert agg["dispatch_counts"]["t_lane"] == 2
+    assert agg["dispatch_counts"]["total"] == 2
+    table = profiler.dumps(aggregate=True)
+    assert "Dispatch Counts:" in table and "t_lane" in table
+    # default output keeps the pre-ISSUE-5 shape (no dispatch key)
+    assert "dispatch_counts" not in profiler.dumps(format="json")
+    profiler.reset_dispatch_counts()
+
+
+# -- satellite: checkpoint stats ---------------------------------------------
+def test_checkpoint_stats_public_gauges(tmp_path):
+    from mxnet_tpu.checkpoint import CheckpointManager
+    with CheckpointManager(str(tmp_path), async_save=False) as mgr:
+        stats = mgr.stats()
+        assert stats["writer_queue_depth"] == 0
+        assert stats["pending_saves"] == 0
+        assert stats["last_commit_age_s"] is None
+        mgr.save(3, arrays={"w": mx.nd.ones((4,))}, block=True)
+        stats = mgr.stats()
+        assert stats["saves"] == 1
+        assert stats["last_commit_step"] == 3
+        assert stats["last_commit_age_s"] is not None
+        assert stats["last_commit_age_s"] < 60
+
+
+def test_checkpoint_direct_stats_deprecated(tmp_path):
+    from mxnet_tpu.checkpoint import CheckpointManager
+    with CheckpointManager(str(tmp_path), async_save=False) as mgr:
+        with pytest.warns(DeprecationWarning):
+            legacy = mgr._stats
+        assert legacy["saves"] == 0  # a locked copy, old keys intact
