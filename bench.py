@@ -498,6 +498,82 @@ def measure_train_dispatch():
     }
 
 
+def measure_scan_dispatch(fused_step_ms=None):
+    """CPU-measurable perf signal for the K-step scanned train window
+    (ISSUE 6): the same dispatch-bound deep MLP as train_step_ms_bs32,
+    but driven through Module.fit so MXNET_SCAN_STEPS batches run as ONE
+    donated lax.scan dispatch.
+
+    * ``scan_dispatches_per_step`` — framework dispatches per train step
+      at K=BENCH_SCAN_K (gate: <= (1+eps)/K; eps=0.25).
+    * ``train_step_ms_scan_k<K>`` — amortized wall per step (bar: >=25%
+      below the PR-4 fused per-step figure measured in the same run).
+    """
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import config as mxcfg, io as mxio, profiler as prof
+
+    K = max(2, mxcfg.get("BENCH_SCAN_K"))
+    steps = max(K, (mxcfg.get("BENCH_DISPATCH_STEPS") // K) * K)
+
+    def deep_mlp(layers=24, width=64):
+        h = mx.sym.Variable("data")
+        for i in range(layers):
+            h = mx.sym.FullyConnected(h, num_hidden=width, name=f"fc{i}")
+            h = mx.sym.Activation(h, act_type="relu")
+        h = mx.sym.FullyConnected(h, num_hidden=10, name="fc_out")
+        return mx.sym.SoftmaxOutput(h, name="softmax")
+
+    log(f"[scan] deep-MLP fit @ bs32, K={K}, {steps} steps/epoch")
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.randn(steps * 32, 64).astype(np.float32))
+    y = mx.nd.array(rng.randint(0, 10, steps * 32).astype(np.float32))
+
+    def fit_epoch_ms(scan_k):
+        os.environ["MXNET_FUSED_STEP"] = "1"
+        os.environ["MXNET_SCAN_STEPS"] = str(scan_k)
+        it = mxio.NDArrayIter(x, y, batch_size=32,
+                              label_name="softmax_label")
+        mod = mx.mod.Module(deep_mlp(), context=mx.cpu())
+        opt = {"learning_rate": 0.01, "momentum": 0.9}
+        mod.fit(it, num_epoch=1, optimizer="sgd", optimizer_params=opt,
+                initializer=mx.initializer.Xavier())  # warm: compiles
+        it.reset()
+        prof.reset_dispatch_counts()
+        t0 = time.perf_counter()
+        mod.fit(it, num_epoch=1, optimizer="sgd", optimizer_params=opt)
+        ms = (time.perf_counter() - t0) / steps * 1e3
+        return ms, prof.dispatch_counts().get("total", 0) / steps
+
+    scan_ms, scan_disp = fit_epoch_ms(K)
+    seq_ms, seq_disp = fit_epoch_ms(1)
+    budget = (1 + 0.25) / K
+    fused_ref = fused_step_ms if fused_step_ms else seq_ms
+    return {
+        "scan_dispatch": {
+            "metric": "scan_dispatches_per_step",
+            "value": round(scan_disp, 4),
+            "budget": round(budget, 4),
+            "gate_pass": bool(scan_disp <= budget),
+            "k": K,
+            "sequential_dispatches_per_step": round(seq_disp, 2),
+            "note": "Module.fit dispatches/step with MXNET_SCAN_STEPS "
+                    "windows (one donated lax.scan per K steps)",
+        },
+        "train_step_scan": {
+            "metric": f"train_step_ms_scan_k{K}",
+            "value": round(scan_ms, 3),
+            "sequential_fused_ms": round(seq_ms, 3),
+            "fused_per_step_ref_ms": round(fused_ref, 3),
+            "improvement_vs_fused": round(1.0 - scan_ms / fused_ref, 3)
+            if fused_ref else None,
+            "bar": "amortized >= 25% below the per-step fused figure",
+            "model": "mlp24x64 (dispatch-bound)",
+            "steps": steps,
+        },
+    }
+
+
 _MODEL_CACHE = {}
 
 
@@ -635,6 +711,29 @@ def main():
                     os.environ.pop("MXNET_FUSED_STEP", None)
                 else:
                     os.environ["MXNET_FUSED_STEP"] = _prev_fused
+
+        if _cfg0.get("BENCH_SCAN"):
+            _prev = {k: os.environ.get(k)
+                     for k in ("MXNET_FUSED_STEP", "MXNET_SCAN_STEPS")}
+            try:
+                fused_ref = (result.get("train_step") or {}).get("value")
+                result.update(measure_scan_dispatch(fused_ref))
+                sd, st = result["scan_dispatch"], result["train_step_scan"]
+                log(f"[scan] {sd['value']}/step dispatches at K={sd['k']} "
+                    f"(budget {sd['budget']}); step {st['value']}ms vs "
+                    f"fused {st['fused_per_step_ref_ms']}ms "
+                    f"({st['improvement_vs_fused']:.0%} faster)")
+            except Exception as e:
+                log(f"scan phase failed: {type(e).__name__}: {e}")
+                result["scan_dispatch"] = {
+                    "metric": "scan_dispatches_per_step",
+                    "error": f"{type(e).__name__}: {e}"}
+            finally:
+                for k, v in _prev.items():
+                    if v is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = v
 
         if _cfg0.get("BENCH_TELEMETRY"):
             try:

@@ -24,9 +24,11 @@ python tools/graftlint.py --fail-on-new
 echo "== unit suite (virtual 8-device CPU mesh via tests/conftest.py) =="
 MXNET_TEST_EXAMPLES=1 python -m pytest tests/ -q
 
-echo "== fused train step smoke (<=3 dispatches/step, loop parity) =="
+echo "== fused + scanned train step smoke (dispatch budget, parity) =="
 # the fused path must issue at most 3 XLA dispatches per train step and
-# stay bit-identical to the per-param update loop (docs/perf_notes.md)
+# stay bit-identical to the per-param update loop; the K=8 scanned
+# window must issue <= (1+eps)/K dispatches per step and stay
+# bit-identical to the sequential fused loop (docs/perf_notes.md)
 JAX_PLATFORMS=cpu python -m mxnet_tpu.fused_step
 
 echo "== serving smoke (dynamic batcher, 64 concurrent clients) =="

@@ -144,6 +144,18 @@ _register("MXNET_METRIC_SYNC_INTERVAL", int, 1,
           "legacy behaviour). N>1 requires the data iterator to hand "
           "out fresh label arrays per batch (NDArrayIter does; staged "
           "fit batches always do)")
+_register("MXNET_SCAN_STEPS", int, 1,
+          "Module.fit: run this many fused train steps as ONE donated "
+          "jax.lax.scan dispatch (a K-step window); host control "
+          "(metrics, callbacks, watchdog beats) happens at window "
+          "boundaries only. 1 = one dispatch per step (PR-4 behaviour); "
+          "requires the fused-step eligibility (docs/perf_notes.md)")
+_register("MXNET_SCAN_ACCUM", int, 1,
+          "in-scan gradient accumulation: each scanned train step "
+          "consumes this many micro-batches and applies ONE optimizer "
+          "update over their summed gradients (effective batch = "
+          "M x bound batch; Module-computed rescale_grad accounts for "
+          "it). 1 disables; >1 requires MXNET_SCAN_STEPS mode")
 _register("MXNET_FIT_STAGE_NEXT", bool, True,
           "fit loop: stage the NEXT DataBatch host->device "
           "(jax.device_put) while the current step is still in flight, "
@@ -303,6 +315,12 @@ _register("BENCH_DISPATCH_IMAGE", int, 32,
 _register("BENCH_DISPATCH_BATCH", int, 4,
           "bench.py dispatch phase: ResNet-50 batch for the dispatch "
           "count")
+_register("BENCH_SCAN", bool, True,
+          "bench.py: also measure the K-step scanned train window on the "
+          "CPU backend (train_step_ms_scan_k<K> / "
+          "scan_dispatches_per_step); needs no TPU relay")
+_register("BENCH_SCAN_K", int, 8,
+          "bench.py scan phase: MXNET_SCAN_STEPS window size")
 _register("BENCH_TELEMETRY", bool, True,
           "bench.py: also measure the disabled-path cost of "
           "telemetry.span (telemetry_disabled_span_ns; the <1us budget "

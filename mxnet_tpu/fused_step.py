@@ -45,6 +45,7 @@ import logging
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from . import profiler as _prof
 from . import random as _random
@@ -258,6 +259,226 @@ class FusedTrainStep:
                 or module._optimizer is not self._opt_ref)
 
 
+class ScanTrainStep(FusedTrainStep):
+    """K fused train steps as ONE donated XLA dispatch (``jax.lax.scan``).
+
+    The fused step body (forward + VJP + optimizer update) becomes the
+    scan body; weights / optimizer state / aux stats are the carry, the
+    staged super-batch (one stacked array per input, leading dims
+    ``(K, M)``) and the host-evaluated per-step lr/wd vectors are the
+    scanned inputs, and the per-step forward outputs come back stacked so
+    metric updates at the window boundary see exactly what K sequential
+    steps would have produced.  With ``accum`` M > 1 each scan step
+    consumes M micro-batches sequentially (aux threads through, like M
+    forwards would) and applies ONE update over their summed gradients —
+    in-scan gradient accumulation for effective batches beyond HBM.
+
+    Host control (metric flush, callbacks, checkpoint triggers, watchdog
+    beats) happens only at window boundaries — the fit loop owns that
+    contract (module._fit_epoch_scan)."""
+
+    def __init__(self, module, scan_steps, accum=1):
+        super().__init__(module)
+        self.scan_steps = max(1, int(scan_steps))
+        self.accum = max(1, int(accum))
+        self._scan_jit = None
+        self._scan_sig = None
+        self._feed_order = None
+        self._rest_names = []
+        self._scan_trace_count = 0  # tests assert == 1 across an epoch
+        self.windows = 0
+
+    @property
+    def window_batches(self):
+        return self.scan_steps * self.accum
+
+    # -- trace -------------------------------------------------------------
+    def _build_scan_jit(self):
+        module = self._module
+        fn = module._exec._build_fn(True)
+        opt = module._optimizer
+        n_args = len(self._arg_names)
+        n_train = len(self._train_names)
+        train_slots = tuple(self._train_slots)
+        feed_slots = tuple(self._arg_names.index(n)
+                           for n in self._feed_order)
+        feed_set = set(self._feed_order)
+        self._rest_names = [n for n in self._other_names
+                            if n not in feed_set]
+        rest_slots = tuple(self._arg_names.index(n)
+                           for n in self._rest_names)
+        accum = self.accum
+        outer = self
+
+        def window(keys, feeds, lrs, wds, train_vals, rest_vals,
+                   aux_vals, states):
+            outer._scan_trace_count += 1  # host side: runs at trace only
+
+            def micro(key, feed_vals, train_vals, aux_vals):
+                # one forward+VJP, identical math to the single fused step
+                def fwd(*tv):
+                    full = [None] * n_args
+                    for slot, v in zip(train_slots, tv):
+                        full[slot] = v
+                    for slot, v in zip(feed_slots, feed_vals):
+                        full[slot] = v
+                    for slot, v in zip(rest_slots, rest_vals):
+                        full[slot] = v
+                    return fn(key, tuple(full), aux_vals)
+
+                (outs, new_aux), vjp_fn = jax.vjp(fwd, *train_vals)
+                cts = tuple(jnp.ones_like(o) for o in outs)
+                zero_aux = tuple(jnp.zeros_like(a) for a in new_aux)
+                grads = vjp_fn((cts, zero_aux))
+                grads = [g.astype(w.dtype)
+                         for g, w in zip(grads, train_vals)]
+                return outs, new_aux, grads
+
+            def body(carry, xs):
+                tv, av, st = carry
+                key_s, feed_s, lr_s, wd_s = xs
+                grads_sum = None
+                outs_micro = []
+                for m in range(accum):
+                    outs, av, grads = micro(
+                        key_s[m], tuple(f[m] for f in feed_s), tv, av)
+                    outs_micro.append(outs)
+                    grads_sum = grads if grads_sum is None else \
+                        [a + b for a, b in zip(grads_sum, grads)]
+                new_params, new_states = opt.fused_update(
+                    list(tv), grads_sum, list(st),
+                    [lr_s[i] for i in range(n_train)],
+                    [wd_s[i] for i in range(n_train)])
+                ys = tuple(jnp.stack([o[i] for o in outs_micro])
+                           for i in range(len(outs_micro[0])))
+                return (tuple(new_params), av, new_states), ys
+
+            carry, ys = jax.lax.scan(
+                body, (train_vals, aux_vals, states),
+                (keys, feeds, lrs, wds))
+            tv, av, st = carry
+            return tv, av, st, ys
+
+        # donate the carry inputs (weights / aux / optimizer state): the
+        # scan's final carry aliases them in place, exactly like the
+        # single-step donation — one buffer set for the whole window
+        self._scan_jit = jax.jit(window, donate_argnums=(4, 6, 7))
+
+    # -- per-window host path ----------------------------------------------
+    def run_window(self, sbatch):
+        """Dispatch one K-step (x M micro-batch) window.  ``sbatch`` is an
+        ``io.SuperBatch`` whose data/label arrays are stacked device
+        buffers with leading dim K*M.  Returns the list of per-position
+        output buffers flattened to leading dim K*M (for boundary metric
+        updates), or False when the stacked shapes don't match the bound
+        executor (caller falls back to per-batch steps)."""
+        module = self._module
+        exec_ = module._exec
+        K, M = self.scan_steps, self.accum
+        W = K * M
+        feed = {}
+        for desc, arr in zip(module._data_shapes, sbatch.data):
+            feed[desc.name] = arr
+        if module._label_shapes and sbatch.label:
+            for desc, arr in zip(module._label_shapes, sbatch.label):
+                feed[desc.name] = arr
+        for name, arr in feed.items():
+            bound = exec_.arg_dict.get(name)
+            if bound is None or \
+                    tuple(arr.shape) != (W,) + tuple(bound.shape):
+                return False
+
+        opt = module._optimizer
+        sig = (opt.fused_static_signature(), K, M,
+               tuple(sorted((n, tuple(a.shape), str(a.dtype))
+                            for n, a in feed.items())))
+        if self._scan_jit is None or sig != self._scan_sig:
+            self._feed_order = sorted(feed)
+            self._build_scan_jit()
+            self._scan_sig = sig
+
+        # stage the stacked feeds: (K, M, *batch_shape), bound dtype
+        feed_bufs = []
+        for name in self._feed_order:
+            buf = feed[name]
+            bound = exec_.arg_dict[name]
+            if buf.dtype != bound._data.dtype:
+                buf = buf.astype(bound._data.dtype)
+            feed_bufs.append(buf.reshape((K, M) + tuple(bound.shape)))
+
+        updater = module._updater
+        for i, name in self._train:
+            updater._ensure_state(i, exec_.arg_dict[name])
+        states_nd = [updater.states[i] for i in self._opt_indices]
+
+        train_vals = tuple(
+            self._owned_or_copy(("p", n), exec_.arg_dict[n]._data)
+            for n in self._train_names)
+        aux_vals = tuple(
+            self._owned_or_copy(("a", n), exec_.aux_dict[n]._data)
+            for n in self._aux_names)
+        leaf_counter = [0]
+
+        def stage_state(leaf):
+            tok = ("s", leaf_counter[0])
+            leaf_counter[0] += 1
+            return self._owned_or_copy(tok, _as_buf(leaf))
+
+        states = jax.tree_util.tree_map(stage_state, states_nd)
+        rest_vals = tuple(exec_.arg_dict[n]._data
+                          for n in self._rest_names)
+
+        # host-side hyperparameters for the WHOLE window: K rows of
+        # lr/wd, update counts bumped per step exactly like K sequential
+        # fused steps — schedules advance inside the scan, no retrace
+        lrs, wds = opt.fused_window_hyperparams(self._opt_indices, K)
+        lrs = np.asarray(lrs, np.float32)
+        wds = np.asarray(wds, np.float32)
+        # one key per micro forward, same counter stream as W sequential
+        # steps (bitwise-identical randomness)
+        keys = np.stack([np.asarray(_random.next_key())
+                         for _ in range(W)])
+        keys = keys.reshape((K, M) + keys.shape[1:])
+
+        with _telemetry.span("fit/step/scan_dispatch"):
+            tv, av, st, ys = self._scan_jit(
+                keys, tuple(feed_bufs), lrs, wds,
+                train_vals, rest_vals, aux_vals, states)
+        _prof.record_dispatch("scan_window")
+
+        owned = {}
+        for name, buf in zip(self._train_names, tv):
+            exec_.arg_dict[name]._set_data(buf)
+            owned[("p", name)] = buf
+        for name, buf in zip(self._aux_names, av):
+            exec_.aux_dict[name]._set_data(buf)
+            owned[("a", name)] = buf
+        leaf_counter[0] = 0
+
+        def writeback_state(old, new):
+            tok = ("s", leaf_counter[0])
+            leaf_counter[0] += 1
+            owned[tok] = new
+            old._set_data(new)
+
+        jax.tree_util.tree_map(writeback_state, states_nd, st)
+        self._owned = owned
+
+        module._zero_grads()
+        # (K, M, *out) -> (K*M, *out): position j is micro-batch j's
+        # forward outputs, computed with that step's pre-update weights —
+        # the boundary metric sees what W sequential steps produced
+        outs_flat = [y.reshape((W,) + tuple(y.shape[2:])) for y in ys]
+        exec_.outputs = [NDArray(y[W - 1], module._context)
+                         for y in outs_flat]
+        exec_._vjp_holder = None
+        exec_._last_is_train = True
+        self.steps += K
+        self.windows += 1
+        _prof.record_counter("train:fused_step_total", self.steps)
+        return outs_flat
+
+
 def _smoke():
     """CI gate: the fused path must issue <= 3 framework dispatches per
     step and match the per-param loop bitwise (run via
@@ -324,5 +545,78 @@ def _smoke():
     print("fused step smoke OK: <=3 dispatches/step, bitwise loop parity")
 
 
+def _scan_smoke():
+    """CI gate for the scanned window: at K=8 a fit epoch must issue
+    <= (1+eps)/K dispatches per train step and stay bitwise identical to
+    the sequential fused loop (run via ``python -m mxnet_tpu.fused_step``
+    after the single-step smoke; see ci/run.sh)."""
+    import os
+    import sys
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import io as mxio
+
+    K, NB, BS = 8, 16, 32  # two full windows per epoch
+
+    def build():
+        d = mx.sym.Variable("data")
+        h = mx.sym.FullyConnected(d, num_hidden=64, name="fc1")
+        h = mx.sym.Activation(h, act_type="relu")
+        h = mx.sym.FullyConnected(h, num_hidden=10, name="fc2")
+        return mx.sym.SoftmaxOutput(h, name="softmax")
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(NB * BS, 50).astype(np.float32)
+    y = rng.randint(0, 10, NB * BS).astype(np.float32)
+    init = {"fc1_weight": mx.nd.array(rng.randn(64, 50) * 0.1),
+            "fc1_bias": mx.nd.zeros((64,)),
+            "fc2_weight": mx.nd.array(rng.randn(10, 64) * 0.1),
+            "fc2_bias": mx.nd.zeros((10,))}
+
+    def run(scan_k):
+        os.environ["MXNET_FUSED_STEP"] = "1"
+        os.environ["MXNET_SCAN_STEPS"] = str(scan_k)
+        mx.random.seed(0)
+        it = mxio.NDArrayIter(mx.nd.array(x), mx.nd.array(y),
+                              batch_size=BS, label_name="softmax_label")
+        mod = mx.mod.Module(build(), context=mx.cpu())
+        mod.fit(it, num_epoch=1, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+                arg_params={k: v.copy() for k, v in init.items()})
+        mx.profiler.reset_dispatch_counts()
+        it.reset()
+        mod.fit(it, num_epoch=1, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+        counts = mx.profiler.dispatch_counts()
+        params, _ = mod.get_params()
+        return counts, {k: v.asnumpy() for k, v in params.items()}
+
+    counts_s, params_s = run(K)
+    counts_q, params_q = run(1)
+    os.environ["MXNET_SCAN_STEPS"] = "1"
+    per_step = counts_s.get("total", 0) / NB
+    budget = (1 + 0.25) / K
+    print(f"scan K={K}: {per_step:.3f} dispatches/step {counts_s}; "
+          f"sequential: {counts_q.get('total', 0) / NB:.2f} {counts_q}; "
+          f"budget {budget:.3f}")
+    if counts_s.get("scan_window", 0) != NB // K:
+        print("FAIL: scanned window did not engage", file=sys.stderr)
+        sys.exit(1)
+    if per_step > budget:
+        print(f"FAIL: scan path exceeds {budget:.3f} dispatches/step",
+              file=sys.stderr)
+        sys.exit(1)
+    for k in params_s:
+        if not np.array_equal(params_s[k], params_q[k]):
+            print(f"FAIL: scan/sequential parity broke on {k}",
+                  file=sys.stderr)
+            sys.exit(1)
+    print(f"scan smoke OK: <= {budget:.3f} dispatches/step at K={K}, "
+          "bitwise parity with the sequential fused loop")
+
+
 if __name__ == "__main__":
     _smoke()
+    _scan_smoke()

@@ -147,6 +147,72 @@ def make_batch_stager(ctx):
     return lambda batch: stage_batch(batch, ctx)
 
 
+class SuperBatch:
+    """A window of K*M DataBatches staged as ONE stacked device array per
+    data/label position (leading dim = number of batches).  Consumed by
+    the scanned train step (fused_step.ScanTrainStep); the stacked
+    label/output arrays also feed the boundary metric flush — stable
+    device data, so buffer-reusing iterators can't clobber a deferred
+    metric read."""
+
+    __slots__ = ("data", "label", "count")
+
+    def __init__(self, data, label, count):
+        self.data = data
+        self.label = label
+        self.count = count
+
+
+def stage_super_batch(batches, ctx):
+    """Stack a window of DataBatches host-side and ``jax.device_put``
+    each data/label position ONCE as a ``(len(batches), *shape)`` array.
+
+    This is the window-granular sibling of :func:`stage_batch`: while a
+    K-step scan is in flight the fit loop stages the NEXT super-batch
+    with a single H2D transfer per input tensor position (PyGraph's
+    whole-iteration-capture argument applied to the input feed)."""
+    import time as _time
+
+    import jax
+
+    from . import telemetry as _telemetry
+
+    import logging
+
+    try:
+        dev = ctx.jax_device if ctx is not None else None
+    except Exception as e:  # noqa: BLE001 — default placement still works
+        logging.getLogger(__name__).debug(
+            "super-batch staging: ctx %s has no jax device (%s: %s); "
+            "using default placement", ctx, type(e).__name__, e)
+        dev = None
+    t0 = _time.perf_counter()
+    staged_bytes = [0]
+
+    def host(a):
+        return a.asnumpy() if isinstance(a, NDArray) else np.asarray(a)
+
+    def stack(position_lists):
+        out = []
+        for arrs in position_lists:
+            stacked = np.stack([host(a) for a in arrs])
+            staged_bytes[0] += stacked.nbytes
+            out.append(jax.device_put(stacked, dev) if dev is not None
+                       else jax.device_put(stacked))
+        return out
+
+    n_data = len(batches[0].data)
+    data = stack([[b.data[i] for b in batches] for i in range(n_data)])
+    label = []
+    if batches[0].label:
+        n_label = len(batches[0].label)
+        label = stack([[b.label[i] for b in batches]
+                       for i in range(n_label)])
+    # graftlint: disable=raw-phase-timing -- this IS telemetry's collection point for the io staging wait
+    _telemetry.record_io_stage(_time.perf_counter() - t0, staged_bytes[0])
+    return SuperBatch(data, label, len(batches))
+
+
 class DataIter:
     """Base data iterator (parity: io.py DataIter)."""
 

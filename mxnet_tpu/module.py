@@ -91,63 +91,23 @@ class BaseModule:
                     begin_epoch, num_epoch):
         """The epoch/batch loop of ``fit`` (instrumented: every loop
         iteration attributes its wall time to telemetry step lanes and
-        beats the hang watchdog)."""
+        beats the hang watchdog).  With ``MXNET_SCAN_STEPS``/``_ACCUM``
+        the epoch body runs K-step scanned windows instead of per-batch
+        steps (one donated XLA dispatch per window; host control only at
+        window boundaries) when the module supports it."""
         with wdog.arm("train/fit"):
             for epoch in range(begin_epoch, num_epoch):
                 tic = time.time()
                 eval_metric.reset()
-                nbatch = 0
-                data_iter = iter(train_data)
-                end_of_batch = False
-                with timeline.lane("data_wait"):
-                    next_data_batch = next(data_iter)
-                if stager is not None:
-                    with timeline.lane("h2d_stage"):
-                        next_data_batch = stager(next_data_batch)
-                timeline.begin_step()
-                while not end_of_batch:
-                    data_batch = next_data_batch
-                    if monitor is not None:
-                        monitor.tic()
-                    with timeline.lane("step_dispatch"):
-                        self.forward_backward(data_batch)
-                    if stager is not None:
-                        # double-buffer input feed: batch N+1's
-                        # host->device copy overlaps the step still in
-                        # flight on batch N (the staged copy also makes
-                        # buffer-reusing iterators safe to prefetch from
-                        # before update_metric reads batch N's labels)
-                        fetched = None
-                        with timeline.lane("data_wait"):
-                            try:
-                                fetched = next(data_iter)
-                            except StopIteration:
-                                end_of_batch = True
-                        if fetched is not None:
-                            with timeline.lane("h2d_stage"):
-                                next_data_batch = stager(fetched)
-                    with timeline.lane("step_dispatch"):
-                        self.update()
-                    # device_block/metric_flush lanes are attributed
-                    # inside update_metric (it knows where the sync is)
-                    self.update_metric(eval_metric, data_batch.label)
-                    if stager is None:
-                        with timeline.lane("data_wait"):
-                            try:
-                                next_data_batch = next(data_iter)
-                            except StopIteration:
-                                end_of_batch = True
-                    if monitor is not None:
-                        monitor.toc_print()
-                    if batch_end_callback is not None:
-                        batch_end_params = BatchEndParam(
-                            epoch=epoch, nbatch=nbatch,
-                            eval_metric=eval_metric, locals=locals())
-                        for callback in _as_list(batch_end_callback):
-                            callback(batch_end_params)
-                    nbatch += 1
-                    timeline.end_step()
-                    wdog.beat("train/fit")
+                plan = self._scan_plan()
+                if plan is not None:
+                    nbatch = self._fit_epoch_scan(
+                        epoch, train_data, eval_metric, plan, stager,
+                        timeline, wdog, batch_end_callback)
+                else:
+                    nbatch = self._fit_epoch_loop(
+                        epoch, train_data, eval_metric, monitor, stager,
+                        timeline, wdog, batch_end_callback)
                 self.flush_metric_updates()
                 for name, val in eval_metric.get_name_value():
                     self.logger.info("Epoch[%d] Train-%s=%f", epoch, name,
@@ -173,6 +133,187 @@ class BaseModule:
                                          epoch, name, val)
                 train_data.reset()
                 wdog.beat("train/fit")
+
+    def _fit_epoch_loop(self, epoch, train_data, eval_metric, monitor,
+                        stager, timeline, wdog, batch_end_callback):
+        """One epoch, one host visit per batch (the pre-scan fit body)."""
+        nbatch = 0
+        data_iter = iter(train_data)
+        end_of_batch = False
+        with timeline.lane("data_wait"):
+            next_data_batch = next(data_iter)
+        if stager is not None:
+            with timeline.lane("h2d_stage"):
+                next_data_batch = stager(next_data_batch)
+        timeline.begin_step()
+        while not end_of_batch:
+            data_batch = next_data_batch
+            if monitor is not None:
+                monitor.tic()
+            with timeline.lane("step_dispatch"):
+                self.forward_backward(data_batch)
+            if stager is not None:
+                # double-buffer input feed: batch N+1's
+                # host->device copy overlaps the step still in
+                # flight on batch N (the staged copy also makes
+                # buffer-reusing iterators safe to prefetch from
+                # before update_metric reads batch N's labels)
+                fetched = None
+                with timeline.lane("data_wait"):
+                    try:
+                        fetched = next(data_iter)
+                    except StopIteration:
+                        end_of_batch = True
+                if fetched is not None:
+                    with timeline.lane("h2d_stage"):
+                        next_data_batch = stager(fetched)
+            with timeline.lane("step_dispatch"):
+                self.update()
+            # device_block/metric_flush lanes are attributed
+            # inside update_metric (it knows where the sync is)
+            self.update_metric(eval_metric, data_batch.label)
+            if stager is None:
+                with timeline.lane("data_wait"):
+                    try:
+                        next_data_batch = next(data_iter)
+                    except StopIteration:
+                        end_of_batch = True
+            if monitor is not None:
+                monitor.toc_print()
+            if batch_end_callback is not None:
+                batch_end_params = BatchEndParam(
+                    epoch=epoch, nbatch=nbatch,
+                    eval_metric=eval_metric, locals=locals())
+                for callback in _as_list(batch_end_callback):
+                    callback(batch_end_params)
+            nbatch += 1
+            timeline.end_step()
+            wdog.beat("train/fit")
+        return nbatch
+
+    def _scan_plan(self):
+        """(K, M) when this epoch should run K-step scanned windows with
+        M-way in-scan gradient accumulation, else None.  Only Module
+        overrides the eligibility; every other module type keeps the
+        per-batch loop."""
+        return None
+
+    def _fit_epoch_scan(self, epoch, train_data, eval_metric, plan,
+                        stager, timeline, wdog, batch_end_callback):
+        """One epoch in K-step windows: each full window of K*M
+        same-shape batches is staged as one super-batch and dispatched
+        as ONE scanned XLA computation; metrics, callbacks, watchdog
+        beats and timeline accounting happen at window boundaries.
+        Batches that don't fill a window (epoch tail, shape-mismatched
+        batches) and windows after a scan-trace failure run through the
+        per-batch path unchanged."""
+        K, M = plan
+        W = K * M
+        # a healthy window legitimately goes W batch-times between
+        # beats: scale the watchdog deadline so K=32 runs stay silent
+        # while real wedges still fire
+        wdog.set_scale("train/fit", W)
+        _telemetry.record_scan_window(K)
+        try:
+            return self._fit_epoch_scan_inner(
+                epoch, train_data, eval_metric, plan, stager, timeline,
+                wdog, batch_end_callback)
+        finally:
+            wdog.set_scale("train/fit", 1)
+
+    def _fit_epoch_scan_inner(self, epoch, train_data, eval_metric, plan,
+                              stager, timeline, wdog, batch_end_callback):
+        K, M = plan
+        W = K * M
+        ctx = getattr(self, "_context", None)
+        data_iter = iter(train_data)
+        state = {"exhausted": False}
+        nbatch = 0
+
+        def collect():
+            # the next W same-shape batches; shorter on epoch end or when
+            # a shape-mismatched batch (tail partial, bucketing) shows up
+            # — those route through the per-batch path in arrival order
+            batches, tail = [], []
+            while len(batches) < W:
+                with timeline.lane("data_wait"):
+                    try:
+                        b = next(data_iter)
+                    except StopIteration:
+                        state["exhausted"] = True
+                        break
+                if not self._scan_batch_ok(b):
+                    tail.append(b)
+                    break
+                batches.append(b)
+            return batches, tail
+
+        def per_batch(batch):
+            nonlocal nbatch
+            if stager is not None:
+                with timeline.lane("h2d_stage"):
+                    batch = stager(batch)
+            with timeline.lane("step_dispatch"):
+                self.forward_backward(batch)
+                self.update()
+            self.update_metric(eval_metric, batch.label)
+            if batch_end_callback is not None:
+                batch_end_params = BatchEndParam(
+                    epoch=epoch, nbatch=nbatch, eval_metric=eval_metric,
+                    locals=locals())
+                for callback in _as_list(batch_end_callback):
+                    callback(batch_end_params)
+            nbatch += 1
+            timeline.end_step()
+            wdog.beat("train/fit")
+
+        pending = collect()
+        timeline.begin_step()
+        while True:
+            batches, tail = pending
+            outs = False
+            if len(batches) == W and not self._scan_disabled:
+                with timeline.lane("h2d_stage"):
+                    sbatch = mx_io.stage_super_batch(batches, ctx)
+                try:
+                    with timeline.lane("step_dispatch"):
+                        outs = self._run_scan_window(sbatch, plan)
+                except Exception as e:  # trace failure: fall back for good
+                    self.logger.warning(
+                        "scanned train window disabled (%s: %s); falling "
+                        "back to per-batch steps%s",
+                        type(e).__name__, e,
+                        " — MXNET_SCAN_ACCUM gradient accumulation is "
+                        "LOST on the fallback path" if M > 1 else "")
+                    self._scan_disabled = True
+                    self._scan = None
+            if outs is not False:
+                # prefetch: collect the next window while this scan is
+                # still in flight on device (dispatch was async)
+                pending = collect()
+                # window boundary: the only host-control point — metric
+                # updates (stacked, one sync), batch callbacks,
+                # timeline, watchdog beat
+                self._window_update_metrics(eval_metric, sbatch, outs)
+                if batch_end_callback is not None:
+                    for j in range(W):
+                        batch_end_params = BatchEndParam(
+                            epoch=epoch, nbatch=nbatch + j,
+                            eval_metric=eval_metric, locals=locals())
+                        for callback in _as_list(batch_end_callback):
+                            callback(batch_end_params)
+                nbatch += W
+                timeline.end_step(steps=W)
+                wdog.beat("train/fit")
+                continue
+            for b in batches:
+                per_batch(b)
+            for b in tail:
+                per_batch(b)
+            if state["exhausted"]:
+                break
+            pending = collect()
+        return nbatch
 
     def score(self, eval_data, eval_metric, num_batch=None,
               batch_end_callback=None, score_end_callback=None, reset=True,
@@ -326,6 +467,8 @@ class Module(BaseModule):
         self._fused = None
         self._fused_step_done = False
         self._fused_disabled = False
+        self._scan = None
+        self._scan_disabled = False
         self._zero_buf_cache = {}
         self._pending_metric = []
 
@@ -421,6 +564,7 @@ class Module(BaseModule):
                                              grad_req=grad_req_dict,
                                              **shape_kwargs)
         self._fused = None  # new executor: the fused step must re-trace
+        self._scan = None
         if self._arg_params is not None:
             self._exec.copy_params_from(self._arg_params, self._aux_params,
                                         allow_extra_params=True)
@@ -531,6 +675,11 @@ class Module(BaseModule):
                     nw = kvstore.num_workers if not isinstance(kvstore, str) \
                         else int(os.environ.get("DMLC_NUM_WORKER", 1))
                     batch *= nw
+                # in-scan gradient accumulation sums M micro-batch
+                # gradients per update: the divisor is the EFFECTIVE
+                # batch, same precedent as the dist global batch above
+                from . import config as _config
+                batch *= max(1, int(_config.get("MXNET_SCAN_ACCUM")))
                 if batch:
                     opt_kw["rescale_grad"] = 1.0 / batch
             optimizer = opt_mod.create(
@@ -545,6 +694,8 @@ class Module(BaseModule):
         self._updater = opt_mod.get_updater(optimizer)
         self._fused = None  # optimizer changed: invalidate the fused trace
         self._fused_disabled = False
+        self._scan = None
+        self._scan_disabled = False
         arg_params = {n: self._exec.arg_dict[n] for n in self._param_names}
         kv, update_on_kvstore = _create_kvstore(kvstore, 1, arg_params)
         self._kvstore = kv
@@ -742,6 +893,57 @@ class Module(BaseModule):
             self._fused_step_done = True
         return ran
 
+    # -- scanned K-step windows (fused_step.ScanTrainStep) -----------------
+    def _scan_plan(self):
+        from . import config as _config
+        if self._scan_disabled:
+            return None
+        K = max(1, int(_config.get("MXNET_SCAN_STEPS")))
+        M = max(1, int(_config.get("MXNET_SCAN_ACCUM")))
+        if K * M <= 1:
+            return None
+        if not self._fused_eligible():
+            if M > 1:
+                self.logger.warning(
+                    "MXNET_SCAN_ACCUM=%d requested but the setup is not "
+                    "fused-step eligible; per-batch updates run WITHOUT "
+                    "gradient accumulation", M)
+                self._scan_disabled = True
+            return None
+        return (K, M)
+
+    def _scan_batch_ok(self, batch):
+        """Window-eligible: every data/label array matches its bound
+        shape exactly (partial tails and bucket switches go per-batch)."""
+        exec_ = self._exec
+        for desc, arr in zip(self._data_shapes, batch.data):
+            bound = exec_.arg_dict.get(desc.name)
+            if bound is None or \
+                    tuple(arr.shape) != tuple(bound.shape):
+                return False
+        if self._label_shapes and batch.label:
+            for desc, arr in zip(self._label_shapes, batch.label):
+                bound = exec_.arg_dict.get(desc.name)
+                if bound is None or \
+                        tuple(arr.shape) != tuple(bound.shape):
+                    return False
+        return True
+
+    def _run_scan_window(self, sbatch, plan):
+        """Dispatch one staged super-batch through the scanned step;
+        returns the flattened per-batch output buffers or False."""
+        K, M = plan
+        fs = self._scan
+        if fs is None or fs.stale(self) or fs.scan_steps != K \
+                or fs.accum != M:
+            from .fused_step import ScanTrainStep
+            fs = self._scan = ScanTrainStep(self, K, M)
+        outs = fs.run_window(sbatch)
+        if outs is not False:
+            self._forward_pad = 0
+            self._fused_step_done = False
+        return outs
+
     def update(self):
         """Apply optimizer to gradients (parity: module.py update →
         model.py _update_params_on_kvstore / local updater).  After a
@@ -843,14 +1045,43 @@ class Module(BaseModule):
             with st.lane("metric_flush"):
                 eval_metric.update_dict(label_map, pred_map)
             return
-        self._pending_metric.append((eval_metric, label_map, pred_map))
-        if len(self._pending_metric) >= \
+        self._pending_metric.append((eval_metric, label_map, pred_map, 1))
+        if self._pending_metric_steps() >= \
                 _config.get("MXNET_METRIC_SYNC_INTERVAL"):
             self.flush_metric_updates()
 
+    def _pending_metric_steps(self):
+        """Train steps represented in the metric buffer (a scanned window
+        contributes K*M at once, so the flush interval rounds up to
+        window boundaries)."""
+        return sum(entry[3] for entry in self._pending_metric)
+
+    def _window_update_metrics(self, eval_metric, sbatch, outs_flat):
+        """Queue one whole window's metric inputs as STACKED arrays —
+        zero per-step device ops here; the flush does ONE sync + one
+        host transfer per tensor position and feeds the metric zero-copy
+        numpy views per step.  Flushes immediately when metric syncing
+        is per-batch (MXNET_METRIC_SYNC_INTERVAL <= 1), else once the
+        buffered step count reaches the interval (rounded up to this
+        window's boundary)."""
+        from . import config as _config
+        label_map = {}
+        if self._label_shapes and sbatch.label:
+            label_map = {d.name: NDArray(l, self._context)
+                         for d, l in zip(self._label_shapes,
+                                         sbatch.label)}
+        pred_map = {name: NDArray(o, self._context)
+                    for name, o in zip(self.output_names, outs_flat)}
+        self._pending_metric.append(
+            (eval_metric, label_map, pred_map, sbatch.count))
+        interval = _config.get("MXNET_METRIC_SYNC_INTERVAL")
+        if interval <= 1 or self._pending_metric_steps() >= interval:
+            self.flush_metric_updates()
+
     def flush_metric_updates(self):
-        """Drain metric updates buffered under MXNET_METRIC_SYNC_INTERVAL;
-        the deferred device->host transfers all happen here."""
+        """Drain metric updates buffered under MXNET_METRIC_SYNC_INTERVAL
+        (and whole scanned windows); the deferred device->host transfers
+        all happen here, exactly once per buffered entry."""
         pending = self._pending_metric
         if not pending:
             return
@@ -858,11 +1089,22 @@ class Module(BaseModule):
         st = _telemetry.current_step_timer()
         if st.active:
             with st.lane("device_block"):
-                for _metric, label_map, pred_map in pending:
+                for _metric, label_map, pred_map, _n in pending:
                     _block_on_maps(label_map, pred_map)
         with st.lane("metric_flush"):
-            for metric, label_map, pred_map in pending:
-                metric.update_dict(label_map, pred_map)
+            for metric, label_map, pred_map, n in pending:
+                if n == 1:
+                    metric.update_dict(label_map, pred_map)
+                    continue
+                # stacked window entry (leading dim n): one host copy
+                # per tensor, then zero-copy numpy views per step —
+                # metrics consume numpy through _as_np unchanged
+                lm = {k: v.asnumpy() for k, v in label_map.items()}  # graftlint: disable=host-sync-in-hot-path -- ONE batched transfer per stacked window tensor, this is the flush point
+                pm = {k: v.asnumpy() for k, v in pred_map.items()}  # graftlint: disable=host-sync-in-hot-path -- ONE batched transfer per stacked window tensor, this is the flush point
+                for j in range(n):
+                    metric.update_dict(
+                        {k: v[j] for k, v in lm.items()},
+                        {k: v[j] for k, v in pm.items()})
 
     @property
     def output_names(self):

@@ -103,6 +103,23 @@ class Optimizer:
         return ([float(self._get_lr(i)) for i in indices],
                 [float(self._get_wd(i)) for i in indices])
 
+    def fused_window_hyperparams(self, indices, steps):
+        """Host-side lr/wd for a K-step scanned window (fused_step.py
+        ScanTrainStep): bumps the update counts step by step — exactly
+        like ``steps`` sequential ``fused_hyperparams`` calls — and
+        returns ``(lrs, wds)`` as ``steps x len(indices)`` float lists.
+        Schedules (and Adam's bias correction, via the subclass
+        ``fused_hyperparams``) therefore advance INSIDE the window
+        without ever baking a step count into the scan trace."""
+        lrs, wds = [], []
+        for _ in range(int(steps)):
+            for i in indices:
+                self._update_count(i)
+            lr_t, wd_t = self.fused_hyperparams(indices)
+            lrs.append(lr_t)
+            wds.append(wd_t)
+        return lrs, wds
+
     def fused_static_signature(self):
         """Hyperparameters baked into the fused trace as constants; the
         fused step retraces when this tuple changes (mutating e.g.

@@ -68,6 +68,11 @@ _IO_STAGE = REGISTRY.histogram(
     "host time spent staging a DataBatch host->device (io.stage_batch)")
 _IO_STAGE_BYTES = REGISTRY.counter(
     "mxnet_io_stage_bytes_total", "bytes staged host->device by io")
+_SCAN_WINDOW = REGISTRY.gauge(
+    "mxnet_scan_window_steps",
+    "train steps per scanned fit-window dispatch (MXNET_SCAN_STEPS; "
+    "1 = one dispatch per step)")
+_SCAN_WINDOW.set(1)
 
 
 def record_kvstore(op, nbytes, n_ops=1):
@@ -82,6 +87,11 @@ def record_io_stage(seconds, nbytes=0):
     _IO_STAGE.observe(seconds)
     if nbytes:
         _IO_STAGE_BYTES.inc(int(nbytes))
+
+
+def record_scan_window(steps):
+    """Record the active scanned-window size (Module._fit_epoch_scan)."""
+    _SCAN_WINDOW.set(int(steps))
 
 
 # -- checkpoint manager registration (weak: managers come and go) ------------

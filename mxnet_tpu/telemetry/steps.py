@@ -71,7 +71,7 @@ class _NullStepTimer:
     def begin_step(self):
         pass
 
-    def end_step(self):
+    def end_step(self, steps=1):
         pass
 
     def close(self):
@@ -121,8 +121,15 @@ class StepTimer:
         step rather than being dropped."""
         self._step_start = time.perf_counter()
 
-    def end_step(self):
+    def end_step(self, steps=1):
+        """Close out a timed unit covering ``steps`` train steps (1 for
+        the per-batch loop; K*M for a scanned window).  Totals accumulate
+        un-amortized — the lanes-vs-wall audit stays exact — while
+        ``last`` and the histograms record PER-STEP amortized values so
+        StepTimeline output and the step-seconds distribution keep
+        meaning \"one train step\" at any window size."""
         now = time.perf_counter()
+        n = max(1, int(steps))
         if self._step_start is None:
             self._step_start = now
             return
@@ -131,18 +138,21 @@ class StepTimer:
         cur, self._cur = self._cur, {}
         lane_sum = 0.0
         with _agg_lock:
-            _agg["steps"] += 1
+            _agg["steps"] += n
             _agg["wall_s"] += wall
             for lane, dur in cur.items():
                 _agg["lanes"][lane] = _agg["lanes"].get(lane, 0.0) + dur
                 lane_sum += dur
             _agg["other_s"] += max(0.0, wall - lane_sum)
-            _agg["last"] = {"wall_s": wall, "lanes": cur}
+            _agg["last"] = {"wall_s": wall / n,
+                            "lanes": {lane: dur / n
+                                      for lane, dur in cur.items()},
+                            "window_steps": n}
         if _lane_hist is not None:
             for lane, dur in cur.items():
-                _lane_hist.observe(dur, labels={"lane": lane})
+                _lane_hist.observe(dur / n, labels={"lane": lane})
         if _step_hist is not None:
-            _step_hist.observe(wall)
+            _step_hist.observe(wall / n)
 
     def close(self):
         _tls.timer = self._prev
@@ -168,7 +178,9 @@ def step_breakdown():
         return {"steps": _agg["steps"], "wall_s": _agg["wall_s"],
                 "lanes": dict(_agg["lanes"]), "other_s": _agg["other_s"],
                 "last": {"wall_s": _agg["last"].get("wall_s"),
-                         "lanes": dict(_agg["last"].get("lanes", {}))}}
+                         "lanes": dict(_agg["last"].get("lanes", {})),
+                         "window_steps": _agg["last"].get(
+                             "window_steps", 1)}}
 
 
 def reset_step_stats():

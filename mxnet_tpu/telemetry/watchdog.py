@@ -63,7 +63,7 @@ class _Armed:
         with _lock:
             e = _entries.setdefault(self.name, {
                 "armed": 0, "count": 0, "last": time.monotonic(),
-                "timeout": 0.0, "fired_count": None})
+                "timeout": 0.0, "scale": 1.0, "fired_count": None})
             e["armed"] += 1
             e["timeout"] = _timeout_s()
             e["count"] += 1
@@ -101,6 +101,18 @@ def beat(name):
         if e is not None:
             e["count"] += 1
             e["last"] = time.monotonic()
+
+
+def set_scale(name, factor):
+    """Scale an armed section's stall deadline.  A K-step scanned fit
+    window beats once per WINDOW, not per batch, so a healthy K=32 run
+    legitimately goes ~32 batch-times between beats — the fit loop sets
+    the scale to the window size (and back to 1) so MXNET_WATCHDOG_S
+    keeps meaning \"per expected progress unit\" without retuning."""
+    with _lock:
+        e = _entries.get(name)
+        if e is not None:
+            e["scale"] = max(1.0, float(factor))
 
 
 def fires():
@@ -156,7 +168,7 @@ def _check():
             if e["fired_count"] == e["count"]:
                 continue  # already dumped this stall episode
             age = now - e["last"]
-            if age > e["timeout"]:
+            if age > e["timeout"] * e.get("scale", 1.0):
                 e["fired_count"] = e["count"]
                 _state["fires"] += 1
                 stale.append((name, age))
