@@ -433,6 +433,109 @@ def measure_telemetry_overhead():
                           "budget_ns": 1000}}
 
 
+_COLD_START_CHILD = r'''
+import json, os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import compile as mxc
+from mxnet_tpu import serving
+
+LAYERS, WIDTH, IN_DIM = 24, 128, 64
+
+def build():
+    h = mx.sym.Variable("data")
+    for i in range(LAYERS):
+        h = mx.sym.FullyConnected(h, num_hidden=WIDTH, name=f"fc{i}")
+        h = mx.sym.Activation(h, act_type="relu")
+    return mx.sym.FullyConnected(h, num_hidden=10, name="out")
+
+rng = np.random.RandomState(0)
+params, prev = {}, IN_DIM
+for i in range(LAYERS):
+    params[f"fc{i}_weight"] = mx.nd.array(
+        rng.randn(WIDTH, prev).astype(np.float32) * 0.05)
+    params[f"fc{i}_bias"] = mx.nd.zeros((WIDTH,))
+    prev = WIDTH
+params["out_weight"] = mx.nd.array(
+    rng.randn(10, prev).astype(np.float32) * 0.05)
+params["out_bias"] = mx.nd.zeros((10,))
+
+server = serving.ModelServer(max_batch_size=8, name="coldstart")
+server.load("mlp", symbol=build(), params=params)
+x = rng.randn(IN_DIM).astype(np.float32)
+t0 = time.perf_counter()
+server.predict("mlp", {"data": x}, wait_s=600.0)
+first_ms = (time.perf_counter() - t0) * 1e3
+counts = mxc.LEDGER.counts()
+print(json.dumps({"first_request_ms": round(first_ms, 2),
+                  "compiles": mxc.LEDGER.compiles(),
+                  "jax": counts["jax"]}))
+server.shutdown()
+'''
+
+
+def measure_cold_start():
+    """Relay-proof CPU phase ``cold_start_first_request_ms`` (ISSUE 7):
+    time-to-first-response of a freshly started serving process, with a
+    cold persistent-cache dir vs a warm restart reusing it.
+
+    Two identical subprocesses publish a 24-layer MLP and time the first
+    ``predict``: the first populates ``MXNET_COMPILE_CACHE_DIR``, the
+    second deserializes executables instead of compiling.  Gate: warm
+    restart must be >= 2x faster to first response (the bar below), and
+    the warm child's ledger must report 0 backend compiles.
+    """
+    import shutil
+    import subprocess
+    import tempfile
+
+    cache_dir = tempfile.mkdtemp(prefix="bench-coldstart-")
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               MXNET_COMPILE_CACHE="1",
+               MXNET_COMPILE_CACHE_DIR=cache_dir,
+               MXNET_COMPILE_CACHE_MIN_COMPILE_S="0")
+    env.pop("XLA_FLAGS", None)  # single-device child, fastest startup
+
+    def run_child(tag):
+        t0 = time.perf_counter()
+        proc = subprocess.run([sys.executable, "-c", _COLD_START_CHILD],
+                              env=env, capture_output=True, text=True,
+                              timeout=600)
+        wall = time.perf_counter() - t0
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"cold-start child ({tag}) failed: "
+                f"{proc.stderr.strip()[-800:]}")
+        payload = json.loads(proc.stdout.strip().splitlines()[-1])
+        log(f"[cold_start] {tag}: first request "
+            f"{payload['first_request_ms']:.0f} ms, "
+            f"{payload['compiles']} compiles "
+            f"(child wall {wall:.1f}s)")
+        return payload
+
+    try:
+        cold = run_child("cold cache")
+        warm = run_child("warm restart")
+        speedup = cold["first_request_ms"] / max(1e-9,
+                                                 warm["first_request_ms"])
+        return {"cold_start": {
+            "metric": "cold_start_first_request_ms",
+            "value": warm["first_request_ms"],
+            "unit": "ms",
+            "cold_first_request_ms": cold["first_request_ms"],
+            "speedup_warm_vs_cold": round(speedup, 2),
+            "bar_speedup": 2.0,
+            "passed": speedup >= 2.0,
+            "warm_backend_compiles": warm["compiles"],
+            "cold_backend_compiles": cold["compiles"],
+            "model": "mlp24x128 via ModelServer",
+        }}
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+
+
 def measure_train_dispatch():
     """CPU-measurable perf signal for the fused train step (no TPU relay
     needed, unlike resnet50_train_img_per_sec which has been
@@ -734,6 +837,21 @@ def main():
                         os.environ.pop(k, None)
                     else:
                         os.environ[k] = v
+
+        if _cfg0.get("BENCH_COLD_START"):
+            try:
+                result.update(measure_cold_start())
+                cs = result["cold_start"]
+                log(f"[cold_start] warm {cs['value']}ms vs cold "
+                    f"{cs['cold_first_request_ms']}ms "
+                    f"({cs['speedup_warm_vs_cold']}x, bar "
+                    f"{cs['bar_speedup']}x, "
+                    f"{'PASS' if cs['passed'] else 'FAIL'})")
+            except Exception as e:
+                log(f"cold_start phase failed: {type(e).__name__}: {e}")
+                result["cold_start"] = {
+                    "metric": "cold_start_first_request_ms",
+                    "error": f"{type(e).__name__}: {e}"}
 
         if _cfg0.get("BENCH_TELEMETRY"):
             try:

@@ -44,6 +44,12 @@ echo "== telemetry smoke (fit + serving burst, exporter scraped, watchdog silent
 # and the hang watchdog must not fire (docs/observability.md)
 JAX_PLATFORMS=cpu python -m mxnet_tpu.telemetry.smoke
 
+echo "== compile smoke (persistent cache, ladder warmup, retrace ratchet) =="
+# publish -> AOT-warm the bucket ladder -> mixed-size burst: the workload
+# must trace exactly ladder-size times and compile NOTHING post-warmup;
+# the BucketPlanner must beat pow2 on a skewed histogram (docs/compile.md)
+JAX_PLATFORMS=cpu python -m mxnet_tpu.compile.smoke
+
 echo "== entry points =="
 JAX_PLATFORMS=cpu python -c \
   "import __graft_entry__ as g; fn, a = g.entry(); fn(*a)"

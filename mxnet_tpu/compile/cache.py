@@ -1,0 +1,181 @@
+"""Persistent XLA compilation artifacts (mxnet_tpu.compile, part 1).
+
+Every process used to pay the full cold-trace + backend-compile cost for
+each (model, version, bucket) serving executor and each fused/scanned
+train step.  jax ships a content-addressed persistent compilation cache
+(keyed by the serialized MLIR module + compile options + backend); this
+module owns its lifecycle for the whole framework:
+
+* **location** — ``MXNET_COMPILE_CACHE_DIR`` (default:
+  ``$XDG_CACHE_HOME/mxnet_tpu/compile``, falling back to
+  ``~/.cache/mxnet_tpu/compile``);
+* **versioned invalidation** — artifacts live under a subdirectory named
+  by a digest of (jax, jaxlib, mxnet_tpu, ``MXNET_COMPILE_CACHE_SALT``),
+  so upgrading any layer of the stack switches to a fresh namespace and
+  stale executables are never even candidates (jax's own content key is
+  the second line of defense); ``prune_stale()`` garbage-collects the
+  namespaces no live version can use;
+* **activation** — :func:`ensure_persistent_cache` is called lazily from
+  the compile-heavy paths (serving executor-cache misses, ladder warmup,
+  ``FusedTrainStep``/``ScanTrainStep`` trace builds), is idempotent, and
+  is a no-op when ``MXNET_COMPILE_CACHE=0``.
+
+Entries below ``MXNET_COMPILE_CACHE_MIN_COMPILE_S`` of backend compile
+time are not persisted (jax's own default policy): tiny programs are
+cheaper to recompile than to hash + stat.  Tests, the CI compile smoke
+and the cold-start bench set it to 0 so toy models persist too.
+"""
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import shutil
+import threading
+
+log = logging.getLogger("mxnet_tpu.compile")
+
+_MARKER = "MXNET_CACHE_KEY"
+
+_lock = threading.Lock()
+_resolved = False      # ensure_persistent_cache ran (even if disabled)
+_active = None         # the versioned dir jax writes to, when enabled
+
+
+def version_key():
+    """Digest naming the artifact namespace: any jax / jaxlib /
+    mxnet_tpu upgrade (or an explicit ``MXNET_COMPILE_CACHE_SALT``)
+    changes it, which IS the invalidation policy — executables compiled
+    by a different stack are never looked up, only orphaned."""
+    import jax
+    import jaxlib
+
+    from .. import config as _config
+    from ..base import __version__ as mx_version
+    raw = "|".join((f"jax={jax.__version__}",
+                    f"jaxlib={jaxlib.__version__}",
+                    f"mxnet_tpu={mx_version}",
+                    f"salt={_config.get('MXNET_COMPILE_CACHE_SALT')}"))
+    return hashlib.sha256(raw.encode()).hexdigest()[:16]
+
+
+def cache_root():
+    """The un-versioned root directory (knob or XDG default)."""
+    from .. import config as _config
+    root = _config.get("MXNET_COMPILE_CACHE_DIR")
+    if not root:
+        xdg = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+            os.path.expanduser("~"), ".cache")
+        root = os.path.join(xdg, "mxnet_tpu", "compile")
+    return root
+
+
+def cache_dir():
+    """The versioned directory artifacts for THIS stack live in."""
+    return os.path.join(cache_root(), version_key())
+
+
+def active_dir():
+    """The directory jax is currently persisting to (None when the cache
+    is disabled or :func:`ensure_persistent_cache` has not run yet)."""
+    with _lock:
+        return _active
+
+
+def ensure_persistent_cache():
+    """Point jax's persistent compilation cache at :func:`cache_dir`.
+
+    Idempotent and thread-safe; called from every compile-heavy path so
+    a process that serves or trains always resolves the cache before its
+    first expensive compile.  Returns the active directory, or None when
+    ``MXNET_COMPILE_CACHE=0``.
+    """
+    global _resolved, _active
+    with _lock:
+        if _resolved:
+            return _active
+        from .. import config as _config
+        if not _config.get("MXNET_COMPILE_CACHE"):
+            _resolved = True
+            return None
+        import jax
+        target = cache_dir()
+        try:
+            os.makedirs(target, exist_ok=True)
+            marker = os.path.join(target, _MARKER)
+            if not os.path.exists(marker):
+                tmp = marker + f".tmp.{os.getpid()}"
+                with open(tmp, "w") as f:
+                    f.write(version_key() + "\n")
+                os.replace(tmp, marker)
+            jax.config.update("jax_enable_compilation_cache", True)
+            jax.config.update("jax_compilation_cache_dir", target)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs",
+                float(_config.get("MXNET_COMPILE_CACHE_MIN_COMPILE_S")))
+            # no size floor: the compile-time floor above is the policy
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                              -1)
+            # jax memoizes is_cache_used() at the FIRST compile of the
+            # process — which already happened (framework import jits a
+            # few helpers) with no directory configured.  Reset so the
+            # next compile re-initializes against our directory.
+            from jax._src import compilation_cache as _cc
+            _cc.reset_cache()
+        except Exception:
+            # an unusable cache dir degrades to cold compiles, never to a
+            # broken process
+            log.exception("persistent compilation cache disabled: could "
+                          "not activate %r", target)
+            _resolved = True
+            _active = None
+            return None
+        _resolved = True
+        _active = target
+        log.info("persistent compilation cache at %s", target)
+        return target
+
+
+def stale_namespaces():
+    """Version-key subdirectories under :func:`cache_root` that no
+    longer match the running stack (candidates for :func:`prune_stale`)."""
+    root, current = cache_root(), version_key()
+    if not os.path.isdir(root):
+        return []
+    return sorted(d for d in os.listdir(root)
+                  if d != current and os.path.isdir(os.path.join(root, d))
+                  and os.path.exists(os.path.join(root, d, _MARKER)))
+
+
+def prune_stale():
+    """Delete stale artifact namespaces; returns the names removed.
+    Never runs implicitly — an operator (or the runbook) calls it."""
+    removed = []
+    root = cache_root()
+    for name in stale_namespaces():
+        shutil.rmtree(os.path.join(root, name), ignore_errors=True)
+        removed.append(name)
+    return removed
+
+
+def _reset_for_tests():
+    """Forget the resolved state so a test can re-activate against a
+    fresh directory; restores jax's cache defaults."""
+    global _resolved, _active
+    with _lock:
+        was = _active
+        _resolved = False
+        _active = None
+    if was is not None:
+        import jax
+        jax.config.update("jax_compilation_cache_dir", None)
+        jax.config.update("jax_enable_compilation_cache", True)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        try:
+            from jax._src import compilation_cache as _cc
+            _cc.reset_cache()
+        except Exception as e:  # noqa: BLE001 — test-only helper
+            log.debug("reset_cache unavailable: %s", e)
+    return was

@@ -1,0 +1,228 @@
+"""TraceLedger: every trace / compile event, counted and attributed.
+
+Retraces are the silent tax of a jit-based framework: an innocuous host
+change (a new shape bucket, a mutated optimizer attribute) shows up only
+as a mysteriously slow step.  The ledger makes them first-class:
+
+* **framework traces** — every jit build the framework itself performs
+  (``Executor._get_jitted``, ``FusedTrainStep``/``ScanTrainStep`` trace
+  builds, serving executor-cache misses) calls :func:`record_trace` with
+  a (callsite, reason) pair, feeding the ``mxnet_compile_traces_total``
+  telemetry lane;
+* **jax-level compiles** — jax's monitoring stream is tapped for
+  persistent-cache hits/misses and backend compile durations, feeding
+  ``mxnet_compile_cache_hits_total`` / ``mxnet_compile_cache_misses_total``
+  and the ``mxnet_compile_backend_seconds`` histogram;
+* **attribution** — :meth:`TraceLedger.attribute` scopes compile seconds
+  to a label (a serving model, the fused step) on the calling thread, so
+  per-model compile cost is exact, not inferred.
+
+``LEDGER.assert_trace_budget`` is the retrace ratchet: the CI compile
+smoke pins a workload's trace count to its warmed ladder size, the same
+fail-on-new loop graftlint established for static findings.
+"""
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+
+from .. import telemetry as _telemetry
+
+log = logging.getLogger("mxnet_tpu.compile")
+
+_TRACES = _telemetry.counter(
+    "mxnet_compile_traces_total",
+    "framework jit builds (trace events), by callsite and reason")
+_HITS = _telemetry.counter(
+    "mxnet_compile_cache_hits_total",
+    "persistent compilation-cache hits (backend compile skipped)")
+_MISSES = _telemetry.counter(
+    "mxnet_compile_cache_misses_total",
+    "persistent compilation-cache misses (backend compile ran)")
+_BACKEND_S = _telemetry.histogram(
+    "mxnet_compile_backend_seconds",
+    "XLA backend compile (or persistent-cache retrieval) durations")
+
+
+class TraceLedger:
+    """Process-wide trace/compile event log (``compile.LEDGER``)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._traces = collections.Counter()   # (callsite, reason) -> n
+        self._jax = collections.Counter()      # jax-level event -> n
+        self._backend_s = 0.0
+        self._by_label = {}                    # label -> [seconds, events]
+        self._tls = threading.local()
+
+    # -- framework traces ----------------------------------------------------
+    def record_trace(self, callsite, reason=""):
+        """One framework-performed jit build at ``callsite`` (why:
+        ``reason`` — 'build', 'warmup', 'request', 'signature-change')."""
+        with self._lock:
+            self._traces[(str(callsite), str(reason))] += 1
+        _TRACES.inc(labels={"callsite": str(callsite),
+                            "reason": str(reason)})
+
+    # -- jax monitoring feed -------------------------------------------------
+    def _jax_event(self, name):
+        with self._lock:
+            self._jax[name] += 1
+
+    def _backend_compile(self, seconds):
+        label = getattr(self._tls, "stack", None)
+        label = label[-1] if label else None
+        with self._lock:
+            self._jax["backend_compiles"] += 1
+            self._backend_s += seconds
+            if label is not None:
+                cell = self._by_label.setdefault(label, [0.0, 0])
+                cell[0] += seconds
+                cell[1] += 1
+
+    # -- attribution ---------------------------------------------------------
+    class _Attr:
+        __slots__ = ("_ledger", "_label")
+
+        def __init__(self, ledger, label):
+            self._ledger = ledger
+            self._label = label
+
+        def __enter__(self):
+            tls = self._ledger._tls
+            if not hasattr(tls, "stack"):
+                tls.stack = []
+            tls.stack.append(self._label)
+            return self
+
+        def __exit__(self, *exc):
+            self._ledger._tls.stack.pop()
+
+    def attribute(self, label):
+        """Context manager: backend compiles on this thread inside the
+        block are charged to ``label`` (e.g. the serving model name)."""
+        return self._Attr(self, str(label))
+
+    def attributed(self):
+        """{label: {"compile_s": float, "compiles": int}}."""
+        with self._lock:
+            return {k: {"compile_s": round(v[0], 6), "compiles": v[1]}
+                    for k, v in sorted(self._by_label.items())}
+
+    # -- read side -----------------------------------------------------------
+    def trace_count(self, callsite=None, reason=None):
+        with self._lock:
+            return sum(n for (c, r), n in self._traces.items()
+                       if (callsite is None or c == callsite)
+                       and (reason is None or r == reason))
+
+    def compiles(self):
+        """Backend compiles that actually ran XLA.  With the persistent
+        cache active that is the MISS count (hits deserialize instead of
+        compiling); without it, every backend compile event is real."""
+        import jax
+        with self._lock:
+            persistent = (jax.config.jax_enable_compilation_cache
+                          and bool(jax.config.jax_compilation_cache_dir))
+            if persistent:
+                return self._jax.get("persistent_misses", 0)
+            return self._jax.get("backend_compiles", 0)
+
+    def counts(self):
+        with self._lock:
+            by_callsite = collections.Counter()
+            for (c, _r), n in self._traces.items():
+                by_callsite[c] += n
+            return {
+                "traces": sum(self._traces.values()),
+                "by_callsite": dict(by_callsite),
+                "by_reason": {f"{c}:{r}": n
+                              for (c, r), n in sorted(self._traces.items())},
+                "jax": dict(self._jax),
+                "backend_compile_s": round(self._backend_s, 6),
+            }
+
+    def snapshot(self):
+        out = self.counts()
+        out["compiles"] = self.compiles()
+        out["attributed"] = self.attributed()
+        return out
+
+    def reset(self):
+        """Zero the ledger (tests / smoke phase boundaries).  Telemetry
+        counters stay monotonic — only the ledger's own view resets."""
+        with self._lock:
+            self._traces.clear()
+            self._jax.clear()
+            self._backend_s = 0.0
+            self._by_label.clear()
+
+    # -- the ratchet ---------------------------------------------------------
+    def assert_trace_budget(self, budget, callsite=None):
+        """Raise AssertionError when more traces than ``budget`` were
+        recorded (optionally at one callsite) — the CI retrace gate."""
+        seen = self.trace_count(callsite=callsite)
+        if seen > budget:
+            with self._lock:
+                detail = {f"{c}:{r}": n
+                          for (c, r), n in sorted(self._traces.items())
+                          if callsite is None or c == callsite}
+            raise AssertionError(
+                f"retrace budget exceeded: {seen} traces > budget "
+                f"{budget}" + (f" at callsite {callsite!r}" if callsite
+                               else "") + f" — {detail}")
+        return seen
+
+
+#: the process-wide ledger every compile path reports into
+LEDGER = TraceLedger()
+
+
+def record_trace(callsite, reason=""):
+    LEDGER.record_trace(callsite, reason)
+
+
+# -- jax monitoring tap ------------------------------------------------------
+_EVENT_MAP = {
+    "/jax/compilation_cache/cache_hits": "persistent_hits",
+    "/jax/compilation_cache/cache_misses": "persistent_misses",
+    "/jax/compilation_cache/compile_requests_use_cache": "cache_requests",
+}
+
+
+def _on_event(event, **_kw):
+    name = _EVENT_MAP.get(event)
+    if name is None:
+        return
+    LEDGER._jax_event(name)
+    if name == "persistent_hits":
+        _HITS.inc()
+    elif name == "persistent_misses":
+        _MISSES.inc()
+
+
+def _on_duration(event, duration, **_kw):
+    if event == "/jax/core/compile/backend_compile_duration":
+        LEDGER._backend_compile(float(duration))
+        _BACKEND_S.observe(float(duration))
+    elif event == "/jax/core/compile/jaxpr_trace_duration":
+        LEDGER._jax_event("jax_traces")
+
+
+def _install_monitoring():
+    """Tap jax's monitoring stream (private API: degrade to framework
+    counting only — with a visible warning — if a jax upgrade moves it)."""
+    try:
+        from jax._src import monitoring
+        monitoring.register_event_listener(_on_event)
+        monitoring.register_event_duration_secs_listener(_on_duration)
+        return True
+    except Exception as e:  # noqa: BLE001 — optional tap, never fatal
+        log.warning("jax monitoring tap unavailable (%s: %s): compile "
+                    "cache hit/miss lanes will read 0; framework trace "
+                    "counts are unaffected", type(e).__name__, e)
+        return False
+
+
+_MONITORING = _install_monitoring()

@@ -1,0 +1,238 @@
+"""BucketPlanner: ladder boundaries from the measured size histogram.
+
+The serving executor cache buckets batch sizes so a Zipf of request
+sizes collapses onto few compiled programs; the seed ladder was "next
+power of two" — a guess.  TVM's lesson (PAPERS.md) scaled down: pick the
+compiled-program set from MEASURED workload shapes.  Given the formed-
+batch-size histogram (:mod:`stats`), a max ladder size (the compile
+budget) and the batcher's ``max_batch``, the planner solves for the
+boundary set minimizing expected padding waste
+
+    sum_over_batches (boundary(batch) - batch)
+
+exactly, by dynamic programming over the distinct observed sizes (any
+optimal boundary sits ON an observed size, so the search space is the
+size set itself, O(n^2 * ladder) for n distinct sizes — n <= max_batch).
+``max_batch`` is always the top boundary: the batcher never forms more,
+and every size must have a bucket.
+
+Plans persist per model-version next to the compilation artifacts
+(``<cache_root>/ladders/<model>.json``) so a restarted process plans
+from history, not from zero.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+
+from ..base import MXNetError
+
+log = logging.getLogger("mxnet_tpu.compile")
+
+_lock = threading.Lock()
+_LADDERS = {}  # model -> tuple of ascending boundaries
+
+
+def pow2_ladder(max_batch):
+    """The seed policy: powers of two up to (and always including) the
+    ``max_batch`` cap — the comparison baseline and the fallback before
+    any traffic has been measured."""
+    max_batch = int(max_batch)
+    if max_batch < 1:
+        raise MXNetError(f"pow2_ladder: max_batch must be >= 1, "
+                         f"got {max_batch}")
+    out, b = [], 1
+    while b < max_batch:
+        out.append(b)
+        b <<= 1
+    out.append(max_batch)
+    return tuple(out)
+
+
+def padding_waste(hist, ladder):
+    """Total padded slots the ladder wastes on ``hist``
+    ({batch_size: count})."""
+    ladder = sorted(int(b) for b in ladder)
+    total = 0
+    for size, count in hist.items():
+        size = int(size)
+        for b in ladder:
+            if b >= size:
+                total += (b - size) * int(count)
+                break
+        else:
+            raise MXNetError(
+                f"padding_waste: size {size} exceeds ladder top "
+                f"{ladder[-1]}")
+    return total
+
+
+def plan_ladder(hist, max_ladder, max_batch):
+    """Optimal <=``max_ladder``-boundary ladder for ``hist`` (must end
+    at ``max_batch``).  Returns an ascending tuple of boundaries."""
+    max_batch = int(max_batch)
+    max_ladder = max(1, int(max_ladder))
+    counts = {}
+    for size, n in hist.items():
+        size = int(size)
+        if size < 1:
+            raise MXNetError(f"plan_ladder: batch size {size} invalid")
+        # the batcher never forms above max_batch; a stale histogram
+        # entry beyond the cap plans as the cap
+        counts[min(size, max_batch)] = counts.get(
+            min(size, max_batch), 0) + int(n)
+    counts.setdefault(max_batch, 0)  # the forced top boundary
+    xs = sorted(counts)
+    cs = [counts[x] for x in xs]
+    n = len(xs)
+
+    # prefix sums: S0 = sum of counts, S1 = sum of size*count
+    s0 = [0] * (n + 1)
+    s1 = [0] * (n + 1)
+    for i, (x, c) in enumerate(zip(xs, cs)):
+        s0[i + 1] = s0[i] + c
+        s1[i + 1] = s1[i] + x * c
+
+    def seg(i, j):
+        """Waste when sizes xs[i..j] are all served by boundary xs[j]."""
+        return xs[j] * (s0[j + 1] - s0[i]) - (s1[j + 1] - s1[i])
+
+    INF = float("inf")
+    m_cap = min(max_ladder, n)
+    # dp[m][j]: min waste covering xs[0..j] with m boundaries, the
+    # largest of which is xs[j]
+    dp = [[INF] * n for _ in range(m_cap + 1)]
+    parent = [[-1] * n for _ in range(m_cap + 1)]
+    for j in range(n):
+        dp[1][j] = seg(0, j)
+    for m in range(2, m_cap + 1):
+        for j in range(m - 1, n):
+            best, arg = INF, -1
+            for i in range(m - 2, j):
+                cand = dp[m - 1][i] + seg(i + 1, j)
+                if cand < best:
+                    best, arg = cand, i
+            dp[m][j] = best
+            parent[m][j] = arg
+    best_m, best_w = 1, dp[1][n - 1]
+    for m in range(2, m_cap + 1):
+        if dp[m][n - 1] < best_w:
+            best_m, best_w = m, dp[m][n - 1]
+    ladder, j, m = [], n - 1, best_m
+    while j >= 0 and m >= 1:
+        ladder.append(xs[j])
+        j = parent[m][j]
+        m -= 1
+    ladder.reverse()
+    return tuple(ladder)
+
+
+# -- the per-model plan registry the executor cache buckets from -------------
+def set_ladder(model, ladder):
+    ladder = tuple(sorted(int(b) for b in ladder))
+    if not ladder:
+        raise MXNetError("set_ladder: empty ladder")
+    with _lock:
+        _LADDERS[str(model)] = ladder
+    return ladder
+
+
+def ladder_for(model):
+    """The planned ladder for ``model`` (None -> caller falls back to
+    the power-of-two policy)."""
+    with _lock:
+        return _LADDERS.get(str(model))
+
+
+def clear_ladders():
+    with _lock:
+        _LADDERS.clear()
+
+
+def ladders():
+    with _lock:
+        return dict(_LADDERS)
+
+
+# -- persistence (per model-version, next to the compile artifacts) ----------
+def _ladder_path(model):
+    from .cache import cache_root
+    return os.path.join(cache_root(), "ladders", f"{model}.json")
+
+
+def save_ladder(model, version, ladder, meta=None):
+    path = _ladder_path(model)
+    payload = {"model": str(model), "version": int(version),
+               "ladder": [int(b) for b in ladder]}
+    payload.update(meta or {})
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + f".tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+    return path
+
+
+def load_ladder(model):
+    """(ladder tuple, payload dict) from the persisted plan, or None."""
+    path = _ladder_path(model)
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+        ladder = tuple(sorted(int(b) for b in payload["ladder"]))
+        if not ladder:
+            raise ValueError("empty ladder")
+        return ladder, payload
+    except FileNotFoundError:
+        return None
+    except Exception as e:  # noqa: BLE001 — a corrupt plan plans fresh
+        log.warning("ignoring corrupt ladder plan %r: %s: %s",
+                    path, type(e).__name__, e)
+        return None
+
+
+def plan_for(model, max_batch, version=0, max_ladder=None,
+             min_samples=None, persist=True):
+    """Plan ``model``'s ladder from the measured histogram and register
+    it.  Falls back (in order) to the persisted plan, then the power-of-
+    two ladder, when fewer than ``min_samples`` batches were observed.
+    Returns the active ladder."""
+    from .. import config as _config
+    from .stats import STATS
+    if max_ladder is None:
+        max_ladder = _config.get("MXNET_COMPILE_LADDER_MAX")
+    if min_samples is None:
+        min_samples = _config.get("MXNET_COMPILE_PLAN_MIN_SAMPLES")
+    hist = STATS.batch_histogram(model)
+    samples = sum(hist.values())
+    if samples >= max(1, int(min_samples)):
+        ladder = plan_ladder(hist, max_ladder, max_batch)
+        waste = padding_waste(hist, ladder)
+        p2 = pow2_ladder(max_batch)
+        log.info("planned ladder for %s v%s from %d batches: %s "
+                 "(waste %d vs pow2 %d)", model, version, samples,
+                 ladder, waste, padding_waste(hist, p2))
+        if persist:
+            try:
+                save_ladder(model, version, ladder,
+                            {"samples": samples, "waste": waste,
+                             "pow2_waste": padding_waste(hist, p2)})
+            except OSError as e:
+                log.warning("could not persist ladder plan for %s: %s",
+                            model, e)
+        return set_ladder(model, ladder)
+    loaded = load_ladder(model)
+    if loaded is not None:
+        ladder, payload = loaded
+        if max(ladder) <= int(max_batch):
+            log.info("loaded persisted ladder for %s (planned at v%s "
+                     "from %s batches): %s", model,
+                     payload.get("version"), payload.get("samples"),
+                     ladder)
+            return set_ladder(model, ladder)
+        log.warning("persisted ladder for %s tops at %d > max_batch %d; "
+                    "replanning from pow2", model, max(ladder),
+                    int(max_batch))
+    return set_ladder(model, pow2_ladder(max_batch))

@@ -1,0 +1,107 @@
+"""ShapeStats: the measured workload the BucketPlanner plans from.
+
+The serving runner records, per model, (1) the size of every formed
+batch — the quantity bucketing pads, so its histogram IS the padding-
+waste objective — and (2) the per-sample input signature (name, shape,
+dtype) of the traffic, which is what warmup needs to rebuild a bucket's
+feed for a model version that has not served yet.  Everything is
+process-wide and thread-safe; the telemetry ``compile`` collector
+exposes it read-only.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+
+from .. import telemetry as _telemetry
+
+# formed-batch sizes, observable without reading the raw histogram dict
+_BATCH_HIST = _telemetry.histogram(
+    "mxnet_serving_batch_size",
+    "formed serving batch sizes before bucket padding, by model",
+    buckets=(1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 48, 64, 96, 128, 192, 256))
+
+# distinct per-sample signatures tracked per model before new ones are
+# dropped (a runaway shape space must not grow host memory unboundedly)
+_MAX_SIGNATURES = 64
+
+
+def sample_signature(feed):
+    """Canonical per-sample signature of a batched feed: strip the batch
+    dim, keep (name, sample_shape, dtype), sorted."""
+    return tuple(sorted((str(k), tuple(int(d) for d in v.shape[1:]),
+                         str(v.dtype)) for k, v in feed.items()))
+
+
+def bucket_feed_signature(sig, bucket):
+    """The executor-cache feed signature a ``bucket``-padded batch of
+    ``sig``-shaped samples produces (must mirror
+    ``serving.executor_cache.feed_signature``)."""
+    return tuple(sorted((name, (int(bucket),) + tuple(shape), dtype)
+                        for name, shape, dtype in sig))
+
+
+class ShapeStats:
+    """Per-model request-size histogram + sample-signature census."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._sizes = {}       # model -> Counter{batch_size: n}
+        self._sigs = {}        # model -> Counter{sample_sig: n}
+        self._dropped = collections.Counter()
+
+    def record_batch(self, model, n_real, feed=None):
+        """Account one formed batch of ``n_real`` samples (and, when the
+        ``feed`` dict is given, its per-sample signature)."""
+        n = int(n_real)
+        sig = sample_signature(feed) if feed is not None else None
+        with self._lock:
+            self._sizes.setdefault(model, collections.Counter())[n] += 1
+            if sig is not None:
+                sigs = self._sigs.setdefault(model, collections.Counter())
+                if sig in sigs or len(sigs) < _MAX_SIGNATURES:
+                    sigs[sig] += 1
+                else:
+                    self._dropped[model] += 1
+        _BATCH_HIST.observe(n, labels={"model": str(model)})
+
+    def batch_histogram(self, model):
+        """{batch_size: count} for ``model`` (a copy)."""
+        with self._lock:
+            return dict(self._sizes.get(model) or {})
+
+    def samples(self, model):
+        with self._lock:
+            return sum((self._sizes.get(model) or {}).values())
+
+    def top_signature(self, model):
+        """The most common per-sample signature observed for ``model``
+        (None before any traffic) — warmup's shape source when the
+        caller does not pass one explicitly."""
+        with self._lock:
+            sigs = self._sigs.get(model)
+            if not sigs:
+                return None
+            return sigs.most_common(1)[0][0]
+
+    def snapshot(self):
+        with self._lock:
+            return {
+                model: {
+                    "samples": sum(sizes.values()),
+                    "sizes": {str(k): v
+                              for k, v in sorted(sizes.items())},
+                    "signatures": len(self._sigs.get(model) or ()),
+                    "signatures_dropped": self._dropped.get(model, 0),
+                }
+                for model, sizes in sorted(self._sizes.items())}
+
+    def reset(self):
+        with self._lock:
+            self._sizes.clear()
+            self._sigs.clear()
+            self._dropped.clear()
+
+
+#: process-wide stats instance the serving runner feeds
+STATS = ShapeStats()

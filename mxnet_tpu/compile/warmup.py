@@ -1,0 +1,176 @@
+"""AOT bucket-ladder warmup: compile at publish time, not first-request.
+
+A model version's bucket ladder is known before any request arrives; the
+only reason first requests used to pay trace + compile is that nothing
+compiled earlier.  :func:`warm_version` closes that gap: for every
+bucket it binds the executor into the serving cache, AOT-compiles the
+inference program via ``jax.jit(...).lower(...).compile()`` against the
+bound abstract shapes (which also persists the executable through
+:mod:`cache`), then runs one real forward on the zero-initialized input
+buffers so the dispatch path itself is hot — a post-warmup request is a
+pure executor-cache hit: no trace, no compile, no first-call setup.
+
+``ModelRepository`` calls this through its warm hooks: synchronously
+BEFORE flipping the served-version pointer on checkpoint hot-reload
+(a version swap under load never serves a cold request), and on a
+background thread after an explicit hot-reload ``load``.
+
+The warmed-signature registry doubles as the retrace alarm: once a
+(model, version) has a warmed ladder, any executor-cache miss outside it
+is logged as an unexpected retrace naming the offending signature.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+import jax
+
+from .. import random as _random
+from .ledger import LEDGER
+
+log = logging.getLogger("mxnet_tpu.compile")
+
+_warm_lock = threading.Lock()
+_WARMED = {}  # (model, version) -> set of feed signatures
+
+
+def mark_warmed(model, version, feed_sig):
+    with _warm_lock:
+        _WARMED.setdefault((str(model), int(version)), set()).add(feed_sig)
+
+
+def warmed_signatures(model, version):
+    """The warmed feed-signature set for (model, version), or None when
+    that version never went through warmup."""
+    try:
+        key = (str(model), int(version))
+    except (TypeError, ValueError):
+        return None
+    with _warm_lock:
+        sigs = _WARMED.get(key)
+        return frozenset(sigs) if sigs is not None else None
+
+
+def clear_warmed():
+    with _warm_lock:
+        _WARMED.clear()
+
+
+def note_retrace(key, reason):
+    """Called by the executor cache on every miss: count it, and WARN
+    when it lands outside a warmed ladder (the docs/compile.md runbook
+    starts from this line)."""
+    LEDGER.record_trace("serving.executor_cache", reason)
+    if reason == "warmup" or not (isinstance(key, tuple) and len(key) >= 3):
+        return
+    model, version, sig = key[0], key[1], key[2]
+    warmed = warmed_signatures(model, version)
+    if warmed is not None and sig not in warmed:
+        log.warning(
+            "serving[%s] v%s: unexpected retrace — signature %s is not "
+            "in the warmed ladder (%d warmed); a compile is running on "
+            "the request path", model, version, sig,
+            len(warmed))
+
+
+def aot_compile(executor):
+    """``jax.jit(...).lower(...).compile()`` the inference program of a
+    bound executor against its abstract shapes — no data runs, but the
+    executable lands in the persistent compilation cache (and XLA's
+    in-memory caches) so the first real dispatch only deserializes."""
+    jitted, _fwd_vjp, _grad_args = executor._get_jitted(False)
+    key = _random.current_key()
+    if any(a is None for a in executor.arg_arrays):
+        raise ValueError("aot_compile: executor has unbound arguments")
+    kaval = jax.ShapeDtypeStruct(key.shape, key.dtype)
+    arg_avals = tuple(jax.ShapeDtypeStruct(a._data.shape, a._data.dtype)
+                      for a in executor.arg_arrays)
+    aux_avals = tuple(jax.ShapeDtypeStruct(a._data.shape, a._data.dtype)
+                      for a in executor.aux_arrays)
+    return jitted.lower(kaval, arg_avals, aux_avals).compile()
+
+
+def warm_version(cache, model, mv, ctx, max_batch, sample_signature=None,
+                 ladder=None, plan=True):
+    """Compile ``mv``'s full bucket ladder into ``cache`` before it
+    serves traffic.  Returns the list of warmed bucket sizes (empty when
+    no sample signature is known yet — a first publish with no traffic
+    history and no explicit ``sample_signature``)."""
+    from .cache import ensure_persistent_cache
+    from .stats import STATS, bucket_feed_signature
+    from . import planner
+
+    sig = sample_signature or STATS.top_signature(model)
+    if sig is None:
+        log.info("warmup skipped for %s v%s: no observed or provided "
+                 "sample signature yet", model, mv.version)
+        return []
+    names = {name for name, _shape, _dtype in sig}
+    if names != set(mv.input_names):
+        log.warning(
+            "warmup skipped for %s v%s: signature inputs %s do not "
+            "match the model's free inputs %s (architecture changed?)",
+            model, mv.version, sorted(names), sorted(mv.input_names))
+        return []
+    try:
+        # the shape census is keyed by model NAME — prove the signature
+        # fits THIS version's graph before binding a whole ladder to it
+        mv.symbol.infer_shape(
+            **{name: (1,) + tuple(shape) for name, shape, _d in sig})
+    except Exception as e:  # noqa: BLE001 — structured skip, not fatal
+        log.warning(
+            "warmup skipped for %s v%s: observed signature %s is not "
+            "compatible with this version's graph (%s: %s)",
+            model, mv.version, sig, type(e).__name__, e)
+        return []
+    if ladder is None:
+        if plan:
+            ladder = planner.plan_for(model, max_batch,
+                                      version=mv.version)
+        else:
+            ladder = (planner.ladder_for(model)
+                      or planner.pow2_ladder(max_batch))
+    buckets = sorted({int(b) for b in ladder})
+    # register the whole intended set FIRST: a request racing the warmup
+    # for a bucket we are about to compile is expected, not an alarm
+    for b in buckets:
+        mark_warmed(model, mv.version, bucket_feed_signature(sig, b))
+
+    ensure_persistent_cache()
+    from ..serving.executor_cache import bind_inference_executor
+    t0 = time.perf_counter()
+    warmed = []
+    for b in buckets:
+        shapes = {name: (b,) + tuple(shape)
+                  for name, shape, _dtype in sig}
+        dtypes = {name: dtype for name, _shape, dtype in sig}
+        fsig = bucket_feed_signature(sig, b)
+
+        def build():
+            return bind_inference_executor(mv.symbol, mv.params, shapes,
+                                           ctx, input_dtypes=dtypes)
+
+        with LEDGER.attribute(str(model)):
+            entry = cache.get((model, mv.version, fsig), build,
+                              model=model, reason="warmup")
+            with entry.lock:
+                if not entry._hot:
+                    aot_compile(entry.executor)
+                    # then walk the REAL request path once on zeros: the
+                    # input-buffer writes jit a per-shape setitem helper
+                    # and the forward's backend compile is a persistent-
+                    # cache hit — afterwards a request compiles nothing
+                    import numpy as np
+                    ex = entry.executor
+                    for name in shapes:
+                        bound = ex.arg_dict[name]
+                        bound[:] = np.zeros(tuple(bound.shape),
+                                            np.dtype(bound.dtype))
+                    ex.forward(is_train=False)
+                    entry._hot = True
+        warmed.append(b)
+    log.info("warmed %s v%s ladder %s in %.2fs", model, mv.version,
+             warmed, time.perf_counter() - t0)
+    return warmed

@@ -202,6 +202,38 @@ _register("MXNET_WATCHDOG_S", float, 0.0,
           "(docs/observability.md runbook)")
 _register("MXNET_WATCHDOG_DIR", str, "",
           "directory for hang-watchdog dump files (empty = cwd)")
+# -- compilation lifecycle ---------------------------------------------------
+_register("MXNET_COMPILE_CACHE", bool, True,
+          "persistent XLA compilation artifacts: serving executor-cache "
+          "misses, ladder warmup and fused/scanned train-step builds "
+          "activate jax's persistent compilation cache so a restarted "
+          "process deserializes executables instead of recompiling "
+          "(docs/compile.md); 0 keeps every compile in-process only")
+_register("MXNET_COMPILE_CACHE_DIR", str, "",
+          "root directory for persistent compilation artifacts; "
+          "artifacts live under a per-(jax, jaxlib, mxnet_tpu) version "
+          "subdirectory so stack upgrades invalidate cleanly; empty = "
+          "$XDG_CACHE_HOME/mxnet_tpu/compile")
+_register("MXNET_COMPILE_CACHE_MIN_COMPILE_S", float, 1.0,
+          "only persist programs whose backend compile took at least "
+          "this long (tiny programs recompile cheaper than they "
+          "hash+stat); tests/smoke/bench set 0 so toy models persist")
+_register("MXNET_COMPILE_CACHE_SALT", str, "",
+          "extra salt mixed into the artifact version key (forces a "
+          "fresh cache namespace without touching the directory; tests "
+          "use it to prove versioned invalidation)")
+_register("MXNET_COMPILE_WARMUP", bool, True,
+          "AOT-compile a model version's full bucket ladder at publish "
+          "time via the repository warm hooks — synchronously BEFORE "
+          "the served-version pointer flips on checkpoint hot-reload, "
+          "on a background thread after a hot-reload load(); 0 keeps "
+          "first-request-pays-compile")
+_register("MXNET_COMPILE_LADDER_MAX", int, 8,
+          "BucketPlanner budget: max compiled bucket boundaries per "
+          "model ladder (each boundary is one compiled program)")
+_register("MXNET_COMPILE_PLAN_MIN_SAMPLES", int, 256,
+          "formed batches that must be observed before the planner "
+          "replaces the power-of-two ladder with a measured one")
 # -- serving ----------------------------------------------------------------
 _register("MXNET_SERVING_MAX_BATCH", int, 32,
           "DynamicBatcher flush size: a batch runs as soon as this many "
@@ -325,6 +357,10 @@ _register("BENCH_TELEMETRY", bool, True,
           "bench.py: also measure the disabled-path cost of "
           "telemetry.span (telemetry_disabled_span_ns; the <1us budget "
           "that lets hot loops stay annotated unconditionally)")
+_register("BENCH_COLD_START", bool, True,
+          "bench.py: also measure cold_start_first_request_ms — warm "
+          "restart (persistent compile cache) vs cold cache dir, in "
+          "fresh subprocesses on the CPU backend; needs no TPU relay")
 _register("BENCH_CKPT", bool, True,
           "bench.py: also measure checkpoint save-blocking time and "
           "restore latency (ckpt_save_blocking_ms / ckpt_restore_s)")

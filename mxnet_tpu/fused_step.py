@@ -97,10 +97,20 @@ class FusedTrainStep:
         self._static_sig = None
         self._jit = None
         self._trace_count = 0  # bumped at trace time; tests assert == 1
+        self._just_built = False  # next dispatch carries the compile
         self.steps = 0
 
     # -- trace -------------------------------------------------------------
     def _build_jit(self):
+        # compilation lifecycle (ISSUE 7): artifacts persist across
+        # processes, and every rebuild is a ledger event — a retrace
+        # storm shows up in mxnet_compile_traces_total, not in step time
+        from . import compile as _compile
+        _compile.ensure_persistent_cache()
+        _compile.record_trace(
+            "fused_step",
+            "build" if self._jit is None else "signature-change")
+        self._just_built = True
         module = self._module
         fn = module._exec._build_fn(True)
         opt = module._optimizer
@@ -218,9 +228,19 @@ class FusedTrainStep:
 
         key = _random.next_key()
         with _telemetry.span("fit/step/fused_dispatch"):
-            outs, new_aux, new_params, new_states = self._jit(
-                key, train_vals, other_vals, aux_vals, states,
-                tuple(lrs), tuple(wds))
+            if self._just_built:
+                # first dispatch after a (re)trace: charge its backend
+                # compile to the fused step in the TraceLedger
+                from . import compile as _compile
+                with _compile.LEDGER.attribute("fused_step"):
+                    outs, new_aux, new_params, new_states = self._jit(
+                        key, train_vals, other_vals, aux_vals, states,
+                        tuple(lrs), tuple(wds))
+                self._just_built = False
+            else:
+                outs, new_aux, new_params, new_states = self._jit(
+                    key, train_vals, other_vals, aux_vals, states,
+                    tuple(lrs), tuple(wds))
         _prof.record_dispatch("fused_step")
 
         # write-back: swap the NEW buffers into the existing NDArray
@@ -294,6 +314,12 @@ class ScanTrainStep(FusedTrainStep):
 
     # -- trace -------------------------------------------------------------
     def _build_scan_jit(self):
+        from . import compile as _compile
+        _compile.ensure_persistent_cache()
+        _compile.record_trace(
+            "scan_step",
+            "build" if self._scan_jit is None else "signature-change")
+        self._just_built = True
         module = self._module
         fn = module._exec._build_fn(True)
         opt = module._optimizer
@@ -441,9 +467,17 @@ class ScanTrainStep(FusedTrainStep):
         keys = keys.reshape((K, M) + keys.shape[1:])
 
         with _telemetry.span("fit/step/scan_dispatch"):
-            tv, av, st, ys = self._scan_jit(
-                keys, tuple(feed_bufs), lrs, wds,
-                train_vals, rest_vals, aux_vals, states)
+            if self._just_built:
+                from . import compile as _compile
+                with _compile.LEDGER.attribute("scan_step"):
+                    tv, av, st, ys = self._scan_jit(
+                        keys, tuple(feed_bufs), lrs, wds,
+                        train_vals, rest_vals, aux_vals, states)
+                self._just_built = False
+            else:
+                tv, av, st, ys = self._scan_jit(
+                    keys, tuple(feed_bufs), lrs, wds,
+                    train_vals, rest_vals, aux_vals, states)
         _prof.record_dispatch("scan_window")
 
         owned = {}

@@ -2,8 +2,10 @@
 
 The L-layer above the executor that the ROADMAP's "serves heavy traffic"
 north star needs: a versioned ModelRepository (hot reload, multi-model),
-a compiled-executor cache with power-of-two shape bucketing (repeated
-shapes reuse one XLA program; padding handled transparently), and a
+a compiled-executor cache with shape bucketing (measured ladders from
+mxnet_tpu.compile's BucketPlanner, power-of-two before any traffic;
+repeated shapes reuse one XLA program, padding handled transparently,
+publish-time AOT warmup — see docs/compile.md), and a
 DynamicBatcher draining a bounded queue under a max_batch_size /
 max_latency_ms deadline policy — with load shedding, per-request
 timeouts, graceful drain, and p50/p90/p99 serving metrics exported

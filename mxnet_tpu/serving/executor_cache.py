@@ -1,12 +1,21 @@
-"""Compiled-executor cache with power-of-two shape bucketing.
+"""Compiled-executor cache with measured (or power-of-two) bucketing.
 
 An inference ``Executor`` is expensive to create: binding traces the
 graph and the first ``forward`` compiles one XLA program per input
 signature.  The serving layer therefore never binds per request — it
-buckets the batch dimension up to the next power of two (so a Zipf of
-request sizes collapses onto log2(max_batch) programs), pads the inputs
-to the bucket, reuses one bound executor per (model, version, bucketed
-signature) through an LRU, and slices the padding back off the outputs.
+buckets the batch dimension (by the model's planned ladder when
+``mxnet_tpu.compile.BucketPlanner`` has measured one, else up to the
+next power of two, so a Zipf of request sizes collapses onto few
+programs), pads the inputs to the bucket, reuses one bound executor per
+(model, version, bucketed signature) through an LRU, and slices the
+padding back off the outputs.
+
+Compilation lifecycle hooks (``mxnet_tpu.compile``, ISSUE 7): every
+miss activates the persistent compilation cache and is counted by the
+TraceLedger with its reason; a miss outside a warmed ladder logs an
+unexpected-retrace WARN; per-model hit/miss/evict counters and
+attributed compile seconds export through the telemetry registry as
+``mxnet_executor_cache_*``.
 
 The cache is shared machinery: ``ModelServer`` keys it by repository
 (model, version), ``c_predict.Predictor`` keys it by content hash of the
@@ -18,6 +27,7 @@ from __future__ import annotations
 
 import collections
 import threading
+import weakref
 
 import numpy as np
 
@@ -28,9 +38,15 @@ from .metrics import ServingMetrics
 # process-wide cache metrics (hits/misses/evictions across every cache)
 _CACHE_METRICS = ServingMetrics("executor_cache")
 
+# every live cache, for the telemetry executor_cache pull-collector
+_ALL_CACHES = weakref.WeakSet()
+_ALL_CACHES_LOCK = threading.Lock()
 
-def bucket_batch(n, max_batch=None):
-    """Next power of two >= n, optionally capped at ``max_batch``.
+
+def bucket_batch(n, max_batch=None, ladder=None):
+    """The bucket ``n`` runs at: the smallest planned-``ladder``
+    boundary >= n when a measured ladder is given, else the next power
+    of two, optionally capped at ``max_batch``.
 
     The cap wins even when it is not itself a power of two — the batcher
     never forms batches above ``max_batch``, so that one extra signature
@@ -39,13 +55,19 @@ def bucket_batch(n, max_batch=None):
     n = int(n)
     if n <= 0:
         raise MXNetError(f"bucket_batch: batch must be positive, got {n}")
+    if max_batch is not None and n > int(max_batch):
+        raise MXNetError(
+            f"bucket_batch: batch {n} exceeds max_batch {max_batch}")
+    if ladder:
+        for b in ladder:  # planned ladders are ascending
+            if b >= n:
+                return int(b)
+        # a stale plan that tops below n (max_batch raised since the
+        # plan) falls through to the power-of-two policy
     b = 1
     while b < n:
         b <<= 1
     if max_batch is not None and b > int(max_batch):
-        if n > int(max_batch):
-            raise MXNetError(
-                f"bucket_batch: batch {n} exceeds max_batch {max_batch}")
         b = int(max_batch)
     return b
 
@@ -92,14 +114,19 @@ def bind_inference_executor(symbol, params, input_shapes, ctx=None,
 
 class CachedExecutor:
     """A bound executor plus the lock serializing its users (the bound
-    input buffers are shared mutable state)."""
+    input buffers are shared mutable state).  ``_hot`` flips after the
+    first forward (or ladder warmup): the compile that first forward
+    triggers is attributed to the model in the TraceLedger."""
 
-    __slots__ = ("executor", "lock", "key")
+    __slots__ = ("executor", "lock", "key", "model", "_hot")
 
-    def __init__(self, executor, key):
+    def __init__(self, executor, key, model=None):
         self.executor = executor
         self.lock = threading.Lock()
         self.key = key
+        self.model = model if model is not None else (
+            key[0] if isinstance(key, tuple) and key else "?")
+        self._hot = False
 
     def run_padded(self, feed, n_real):
         """Write ``feed`` (already padded to the bound batch) into the
@@ -109,7 +136,15 @@ class CachedExecutor:
             ex = self.executor
             for name, arr in feed.items():
                 ex.arg_dict[name][:] = arr
-            outs = ex.forward(is_train=False)
+            if self._hot:
+                outs = ex.forward(is_train=False)
+            else:
+                # cold entry: this forward carries the trace + backend
+                # compile — charge it to the model
+                from .. import compile as _compile
+                with _compile.LEDGER.attribute(str(self.model)):
+                    outs = ex.forward(is_train=False)
+                self._hot = True
             # one device->host transfer per OUTPUT TENSOR (not per
             # request) — the batching already amortized the sync
             # graftlint: disable=host-sync-in-hot-path -- per-output boundary transfer, already batch-amortized
@@ -119,40 +154,62 @@ class CachedExecutor:
 class ExecutorCache:
     """LRU of ``CachedExecutor`` keyed by (model-identity, signature)."""
 
-    def __init__(self, capacity=None):
+    def __init__(self, capacity=None, name="cache"):
         if capacity is None:
             from .. import config as _config
             capacity = _config.get("MXNET_SERVING_EXECUTOR_CACHE")
         self.capacity = max(1, int(capacity))
+        self.name = name
         self._lock = threading.Lock()
         self._entries = collections.OrderedDict()
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        self._per_model = {}  # model -> {"hits"/"misses"/"evictions"}
+        with _ALL_CACHES_LOCK:
+            _ALL_CACHES.add(self)
 
-    def get(self, key, builder):
+    def _model_cell(self, model):
+        cell = self._per_model.get(model)
+        if cell is None:
+            cell = self._per_model[model] = {
+                "hits": 0, "misses": 0, "evictions": 0}
+        return cell
+
+    def get(self, key, builder, model=None, reason="request"):
         """Return the cached executor for ``key``, building (and possibly
         evicting LRU) on miss.  ``builder()`` -> bound Executor.
 
         The build runs under the cache lock on purpose: concurrent
         misses on one key must not compile the same program twice, and
         an inference bind is cheap relative to the XLA compile its first
-        forward triggers anyway.
+        forward triggers anyway.  A miss activates the persistent
+        compilation cache, records a (callsite, reason) trace in the
+        TraceLedger, and — when it lands outside a warmed ladder — logs
+        an unexpected-retrace WARN naming the signature.
         """
+        if model is None and isinstance(key, tuple) and key:
+            model = key[0]
         with self._lock:
             entry = self._entries.get(key)
             if entry is not None:
                 self._entries.move_to_end(key)
                 self.hits += 1
+                self._model_cell(str(model))["hits"] += 1
                 _CACHE_METRICS.incr("cache_hits_total")
                 return entry
             self.misses += 1
+            self._model_cell(str(model))["misses"] += 1
             _CACHE_METRICS.incr("cache_misses_total")
-            entry = CachedExecutor(builder(), key)
+            from .. import compile as _compile
+            _compile.ensure_persistent_cache()
+            _compile.note_retrace(key, reason)
+            entry = CachedExecutor(builder(), key, model=model)
             self._entries[key] = entry
             while len(self._entries) > self.capacity:
-                self._entries.popitem(last=False)
+                _k, evicted = self._entries.popitem(last=False)
                 self.evictions += 1
+                self._model_cell(str(evicted.model))["evictions"] += 1
                 _CACHE_METRICS.incr("cache_evictions_total")
             return entry
 
@@ -174,7 +231,9 @@ class ExecutorCache:
         with self._lock:
             return {"size": len(self._entries), "capacity": self.capacity,
                     "hits": self.hits, "misses": self.misses,
-                    "evictions": self.evictions}
+                    "evictions": self.evictions,
+                    "per_model": {m: dict(c)
+                                  for m, c in self._per_model.items()}}
 
 
 def pad_to(arr, n_rows):
@@ -199,5 +258,60 @@ def shared_cache():
     global _SHARED
     with _SHARED_LOCK:
         if _SHARED is None:
-            _SHARED = ExecutorCache()
+            _SHARED = ExecutorCache(name="shared")
         return _SHARED
+
+
+# -- telemetry: per-model hit/miss/evict + attributed compile seconds --------
+def stats_by_model():
+    """Per-model counters aggregated across every live cache, plus the
+    TraceLedger's attributed compile seconds (exact backend-compile time
+    charged to each model by warmup / first-forward attribution)."""
+    with _ALL_CACHES_LOCK:
+        caches = list(_ALL_CACHES)
+    merged = {}
+    for cache in caches:
+        for model, cell in cache.stats()["per_model"].items():
+            out = merged.setdefault(
+                model, {"hits": 0, "misses": 0, "evictions": 0,
+                        "compile_s": 0.0, "compiles": 0})
+            for k in ("hits", "misses", "evictions"):
+                out[k] += cell[k]
+    from .. import compile as _compile
+    for model, attr in _compile.LEDGER.attributed().items():
+        out = merged.setdefault(
+            model, {"hits": 0, "misses": 0, "evictions": 0,
+                    "compile_s": 0.0, "compiles": 0})
+        out["compile_s"] += attr["compile_s"]
+        out["compiles"] += attr["compiles"]
+    return merged
+
+
+def _executor_cache_samples():
+    families = {
+        "hits": ("mxnet_executor_cache_hits_total", "counter",
+                 "serving executor-cache hits, by model"),
+        "misses": ("mxnet_executor_cache_misses_total", "counter",
+                   "serving executor-cache misses (bind + compile), "
+                   "by model"),
+        "evictions": ("mxnet_executor_cache_evictions_total", "counter",
+                      "serving executor-cache LRU evictions, by model"),
+        "compile_s": ("mxnet_executor_cache_compile_seconds_total",
+                      "counter",
+                      "backend compile seconds attributed to each "
+                      "model's executors"),
+    }
+    out = []
+    for model, cell in sorted(stats_by_model().items()):
+        for field, (fam, mtype, help_) in families.items():
+            out.append((fam, mtype, help_, {"model": model}, cell[field]))
+    return out
+
+
+def _register_collector():
+    from .. import telemetry as _telemetry
+    _telemetry.register_collector("executor_cache", stats_by_model,
+                                  _executor_cache_samples)
+
+
+_register_collector()

@@ -94,29 +94,75 @@ class ModelRepository:
         self._models = {}   # name -> {version -> _ModelVersion}
         self._latest = {}   # name -> int
         self._watchers = {}  # name -> (thread, stop Event)
+        self._warm_hooks = []  # fn(name, _ModelVersion), pre-flip
+
+    # -- publish-time warmup hooks ------------------------------------------
+    def add_warm_hook(self, fn):
+        """Register ``fn(name, model_version)`` to run before a new
+        version serves traffic: synchronously BEFORE the served-version
+        pointer flips on checkpoint hot-reload (``watch``/
+        ``poll_checkpoint``), and on a background thread after a
+        hot-reload ``load``.  A hook failure is logged, never fatal —
+        warming is an optimization, the flip must happen regardless."""
+        with self._lock:
+            self._warm_hooks.append(fn)
+        return fn
+
+    def _run_warm_hooks(self, name, mv):
+        import logging
+        from .. import config as _config
+        if not _config.get("MXNET_COMPILE_WARMUP"):
+            return
+        with self._lock:
+            hooks = list(self._warm_hooks)
+        for fn in hooks:
+            try:
+                fn(name, mv)
+            except Exception:  # warm failure must never block the flip
+                logging.getLogger("mxnet_tpu.serving").exception(
+                    "warm hook %r failed for %s v%s", fn, name,
+                    mv.version)
+
+    def _register(self, name, mv):
+        """Make ``mv`` visible (the pointer flip).  Allocates latest+1
+        when ``mv.version`` is None; raises on an explicit-version
+        collision.  Returns (version, was_hot_reload)."""
+        with self._lock:
+            versions = self._models.setdefault(name, {})
+            was_loaded = bool(versions)
+            if mv.version is None:
+                mv.version = self._latest.get(name, 0) + 1
+            if mv.version in versions:
+                raise MXNetError(
+                    f"repository: model {name!r} version {mv.version} "
+                    "already loaded (unload it first, or omit version= "
+                    "for hot reload)")
+            versions[mv.version] = mv
+            self._latest[name] = max(self._latest.get(name, 0),
+                                     mv.version)
+            return mv.version, was_loaded
 
     def load(self, name, symbol=None, params=None, prefix=None, block=None,
              epoch=0, version=None):
         """Register a model version; returns the version number.  Loading
         an existing name again with no explicit version is a hot reload
-        (latest+1)."""
+        (latest+1) — which also kicks the warm hooks on a background
+        thread, so the new version's bucket ladder compiles while the
+        old version keeps serving."""
         symbol, params, input_names = _normalize(
             symbol=symbol, params=params, prefix=prefix, block=block,
             epoch=epoch)
+        mv = _ModelVersion(symbol, params, input_names,
+                           None if version is None else int(version))
+        version, was_reload = self._register(name, mv)
         with self._lock:
-            versions = self._models.setdefault(name, {})
-            if version is None:
-                version = self._latest.get(name, 0) + 1
-            version = int(version)
-            if version in versions:
-                raise MXNetError(
-                    f"repository: model {name!r} version {version} already "
-                    "loaded (unload it first, or omit version= for "
-                    "hot reload)")
-            versions[version] = _ModelVersion(symbol, params, input_names,
-                                              version)
-            self._latest[name] = max(self._latest.get(name, 0), version)
-            return version
+            hooks_live = bool(self._warm_hooks)
+        if was_reload and hooks_live:
+            t = threading.Thread(target=self._run_warm_hooks,
+                                 args=(name, mv), daemon=True,
+                                 name=f"warmup-{name}-v{version}")
+            t.start()
+        return version
 
     def get(self, name, version=None):
         """The requested (or latest) ``_ModelVersion``."""
@@ -176,6 +222,10 @@ class ModelRepository:
         cannot see a ``step-NNNNNN.tmp/`` in progress, and checksums are
         verified before the version goes live, so a torn or corrupt
         checkpoint is never served (ISSUE 2 satellite).
+
+        The warm hooks run BEFORE the new version registers: a version
+        swap under load compiles its whole bucket ladder first, so the
+        flip never serves a cold-compile request (ISSUE 7 satellite).
         """
         from ..checkpoint import latest_step, restore
         from ..symbol import load_json
@@ -196,8 +246,13 @@ class ModelRepository:
         params.update(ckpt.aux_params)
         if not params:  # unprefixed tensor names: serve them as-is
             params = ckpt.as_ndarrays()
-        self.load(name, symbol=load_json(ckpt.symbol_json), params=params,
-                  version=ckpt.step)
+        symbol, params, input_names = _normalize(
+            symbol=load_json(ckpt.symbol_json), params=params)
+        mv = _ModelVersion(symbol, params, input_names, ckpt.step)
+        # warm-before-flip, synchronously on this (watcher) thread: the
+        # old version keeps serving while the ladder compiles
+        self._run_warm_hooks(name, mv)
+        self._register(name, mv)
         return ckpt.step
 
     def watch(self, name, ckpt_dir, interval=None):

@@ -22,6 +22,7 @@ import threading
 
 import numpy as np
 
+from .. import compile as _compile
 from ..base import MXNetError
 from ..context import current_context
 from .batcher import DynamicBatcher
@@ -51,6 +52,10 @@ class ModelServer:
         self._batchers = {}
         self._lock = threading.Lock()
         self._shutdown = False
+        # publish-time ladder warmup: the repository calls back BEFORE a
+        # hot-reloaded checkpoint version starts serving (and on a
+        # background thread after an explicit hot-reload load)
+        self.repository.add_warm_hook(self._warm_hook)
 
     # -- model management ---------------------------------------------------
     def load(self, name, **kwargs):
@@ -79,19 +84,24 @@ class ModelServer:
             # immutable after construction
             with self._lock:
                 max_batch = self._batchers[model].max_batch_size
-            bucket = bucket_batch(n_real, max_batch)
+            # the measured workload the BucketPlanner plans from: formed
+            # batch size + per-sample signature (warmup's shape source)
+            feed_np = {k: np.asarray(v) for k, v in feed.items()}
+            _compile.STATS.record_batch(model, n_real, feed_np)
+            bucket = bucket_batch(n_real, max_batch,
+                                  ladder=_compile.ladder_for(model))
             # request dtypes are preserved end to end (int token ids /
             # indices / masks must NOT be silently cast to float32);
             # the executor binds its input buffers with the same dtypes
-            padded = {k: pad_to(np.asarray(v), bucket)
-                      for k, v in feed.items()}
+            padded = {k: pad_to(v, bucket) for k, v in feed_np.items()}
             sig = feed_signature(padded)
             entry = self._cache.get(
                 (model, mv.version, sig),
                 lambda: bind_inference_executor(
                     mv.symbol, mv.params,
                     {k: v.shape for k, v in padded.items()}, self._ctx,
-                    input_dtypes={k: v.dtype for k, v in padded.items()}))
+                    input_dtypes={k: v.dtype for k, v in padded.items()}),
+                model=model)
             outs = entry.run_padded(padded, n_real)
             self.metrics.observe_batch(n_real, bucket)
             return outs
@@ -135,6 +145,44 @@ class ModelServer:
                     f"{e}") from e
             valid_sigs[key] = True
         return validate
+
+    # -- publish-time ladder warmup ------------------------------------------
+    def _warm_max_batch(self, model):
+        with self._lock:
+            b = self._batchers.get(model)
+        if b is not None:
+            return b.max_batch_size
+        mb = self._batcher_kw.get("max_batch_size")
+        if mb is None:
+            from .. import config as _config
+            mb = _config.get("MXNET_SERVING_MAX_BATCH")
+        return int(mb)
+
+    def _warm_hook(self, model, mv):
+        """Repository warm hook: compile the new version's full bucket
+        ladder (planned from the measured histogram when enough traffic
+        was observed) before it serves."""
+        _compile.warm_version(self._cache, model, mv, self._ctx,
+                              self._warm_max_batch(model))
+
+    def warm(self, model, version=None, sample_signature=None,
+             ladder=None):
+        """Explicitly warm ``model``'s bucket ladder: plan (or take)
+        the ladder, bind + AOT-compile every bucket into the executor
+        cache, and mark the signatures warmed so later retraces alarm.
+
+        ``sample_signature``: iterable of (input_name, sample_shape,
+        dtype_str) — defaults to the most common signature observed in
+        traffic.  Returns the list of warmed bucket sizes."""
+        mv = self.repository.get(model, version=version)
+        if sample_signature is not None:
+            sample_signature = tuple(sorted(
+                (str(n), tuple(int(d) for d in s), str(d_))
+                for n, s, d_ in sample_signature))
+        return _compile.warm_version(
+            self._cache, model, mv, self._ctx,
+            self._warm_max_batch(model),
+            sample_signature=sample_signature, ladder=ladder)
 
     def _get_batcher(self, model):
         with self._lock:

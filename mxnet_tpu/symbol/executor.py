@@ -145,6 +145,11 @@ class Executor:
                      for a in self.aux_arrays))
         entry = self._fn_cache.get(key)
         if entry is None:
+            # every framework jit build is a TraceLedger event (ISSUE 7
+            # retrace ratchet) — cold path only, one dict write
+            from .. import compile as _compile
+            _compile.record_trace("executor",
+                                  "train" if is_train else "infer")
             fn = self._build_fn(is_train)
             jitted = jax.jit(fn)
             grad_args = [i for i, n in enumerate(self._arg_names)

@@ -170,7 +170,6 @@ def _check():
             age = now - e["last"]
             if age > e["timeout"] * e.get("scale", 1.0):
                 e["fired_count"] = e["count"]
-                _state["fires"] += 1
                 stale.append((name, age))
     for name, age in stale:
         _fire(name, age)
@@ -201,7 +200,7 @@ def _fire(name, age):
     from .. import config as _config
     directory = _config.get("MXNET_WATCHDOG_DIR") or os.getcwd()
     with _lock:
-        n = _state["fires"]
+        n = _state["fires"] + 1
     path = os.path.join(directory,
                         f"mxnet-watchdog-{os.getpid()}-{n}.txt")
     try:
@@ -209,10 +208,16 @@ def _fire(name, age):
         with open(tmp, "w", encoding="utf-8") as f:
             f.write(text)
         os.replace(tmp, path)
+        # fires and last_dump flip together, AFTER the dump landed: a
+        # poller that sees the new fire count can read the dump path —
+        # rendering the (large) snapshot must not widen that window
         with _lock:
+            _state["fires"] += 1
             _state["last_dump"] = path
         log.error("watchdog: %r stalled %.1fs — dump written to %s",
                   name, age, path)
     except OSError as e:
+        with _lock:
+            _state["fires"] += 1
         log.error("watchdog: %r stalled %.1fs — dump file failed (%s); "
                   "stacks were written to stderr", name, age, e)

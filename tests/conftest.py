@@ -10,8 +10,15 @@ the axon client adds a network roundtrip per backend init (and hangs the
 suite outright if the TPU tunnel is down).
 """
 import os
+import tempfile
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# hermetic persistent-compilation-cache location: a test that triggers
+# mxnet_tpu.compile.ensure_persistent_cache must never write artifacts
+# into the developer's $XDG_CACHE_HOME
+os.environ.setdefault(
+    "MXNET_COMPILE_CACHE_DIR",
+    os.path.join(tempfile.gettempdir(), "mxnet-tpu-test-compile-cache"))
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

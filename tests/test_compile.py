@@ -1,0 +1,415 @@
+"""mxnet_tpu.compile — persistent artifacts, ladder planning, retrace
+ratchet (ISSUE 7).
+
+Covers: versioned cache invalidation (two-subprocess warm restart with 0
+backend compiles, salt-mismatch recompiles), BucketPlanner optimality on
+skewed histograms (non-power-of-two boundaries, DP == brute force),
+ladder persistence, ladder-aware bucket_batch, TraceLedger counting +
+budget assertion, AOT ladder warmup through the ModelServer (zero
+post-warmup traces), unexpected-retrace WARN, per-model executor-cache
+telemetry, and repository warm hooks (background on hot-reload load).
+"""
+import itertools
+import json
+import logging
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import compile as mxc
+from mxnet_tpu import serving
+
+
+@pytest.fixture(autouse=True)
+def _clean_compile_state():
+    yield
+    mxc.clear_ladders()
+    mxc.clear_warmed()
+    mxc.STATS.reset()
+    mxc.LEDGER.reset()
+
+
+def _mlp_symbol(in_dim=50):
+    d = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(d, num_hidden=64, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    return mx.sym.FullyConnected(h, num_hidden=10, name="fc2")
+
+
+def _mlp_params(in_dim=50, seed=0):
+    rng = np.random.RandomState(seed)
+    return {"fc1_weight": mx.nd.array(rng.randn(64, in_dim)
+                                      .astype(np.float32) * 0.1),
+            "fc1_bias": mx.nd.zeros((64,)),
+            "fc2_weight": mx.nd.array(rng.randn(10, 64)
+                                      .astype(np.float32) * 0.1),
+            "fc2_bias": mx.nd.zeros((10,))}
+
+
+# -- versioned cache namespace ----------------------------------------------
+def test_version_key_changes_with_salt(monkeypatch):
+    base = mxc.version_key()
+    assert mxc.cache_dir() == os.path.join(mxc.cache_root(), base)
+    monkeypatch.setenv("MXNET_COMPILE_CACHE_SALT", "other-stack")
+    salted = mxc.version_key()
+    assert salted != base
+    assert mxc.cache_dir().endswith(salted)
+
+
+# -- ladder-aware bucketing ---------------------------------------------------
+def test_bucket_batch_ladder_selection():
+    from mxnet_tpu.serving.executor_cache import bucket_batch
+    ladder = (1, 3, 7, 32)
+    assert bucket_batch(1, 32, ladder) == 1
+    assert bucket_batch(2, 32, ladder) == 3
+    assert bucket_batch(3, 32, ladder) == 3
+    assert bucket_batch(8, 32, ladder) == 32
+    # no ladder: the power-of-two policy, cap included even if not pow2
+    assert bucket_batch(5, None) == 8
+    assert bucket_batch(9, 12) == 12
+    # a stale ladder topping below n falls back to pow2-with-cap
+    assert bucket_batch(10, 16, ladder=(1, 2, 4)) == 16
+    with pytest.raises(mx.MXNetError):
+        bucket_batch(33, 32, ladder)
+    with pytest.raises(mx.MXNetError):
+        bucket_batch(0, 32)
+
+
+# -- BucketPlanner ------------------------------------------------------------
+def test_planner_beats_pow2_on_skewed_histogram():
+    """Acceptance gate: non-power-of-two boundaries and strictly lower
+    padding waste than the power-of-two ladder on a skewed histogram."""
+    hist = {1: 900, 3: 500, 7: 80, 20: 20, 32: 5}
+    planned = mxc.plan_ladder(hist, max_ladder=4, max_batch=32)
+    assert planned[-1] == 32
+    assert len(planned) <= 4
+    assert any(b & (b - 1) for b in planned), \
+        f"planner returned pure powers of two {planned} on skewed data"
+    assert (mxc.padding_waste(hist, planned)
+            < mxc.padding_waste(hist, mxc.pow2_ladder(32)))
+
+
+def test_planner_matches_brute_force():
+    rng = np.random.RandomState(7)
+    sizes = sorted(rng.choice(range(1, 17), size=6, replace=False))
+    hist = {int(s): int(rng.randint(1, 200)) for s in sizes}
+    max_batch, max_ladder = 16, 3
+    planned = mxc.plan_ladder(hist, max_ladder, max_batch)
+    w_planned = mxc.padding_waste(hist, planned)
+    candidates = sorted(set(list(hist) + [max_batch]))
+    best = None
+    for r in range(1, max_ladder + 1):
+        for combo in itertools.combinations(candidates, r):
+            if combo[-1] != max_batch:
+                continue
+            w = mxc.padding_waste(hist, combo)
+            if best is None or w < best:
+                best = w
+    assert w_planned == best
+
+
+def test_planner_clamps_oversized_and_degenerate():
+    # one distinct size: one boundary at max_batch
+    assert mxc.plan_ladder({4: 100}, 8, 4) == (4,)
+    # sizes beyond max_batch plan as the cap (stale histogram entries)
+    ladder = mxc.plan_ladder({2: 10, 64: 5}, 4, 8)
+    assert ladder[-1] == 8
+    assert mxc.padding_waste({2: 10}, ladder) <= 6 * 10
+
+
+def test_ladder_registry_and_persistence(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_COMPILE_CACHE_DIR", str(tmp_path))
+    assert mxc.ladder_for("m") is None
+    mxc.set_ladder("m", [8, 1, 4])
+    assert mxc.ladder_for("m") == (1, 4, 8)
+    path = mxc.save_ladder("m", 3, (1, 4, 8), {"samples": 42})
+    assert os.path.dirname(path) == str(tmp_path / "ladders")
+    ladder, payload = mxc.load_ladder("m")
+    assert ladder == (1, 4, 8)
+    assert payload["version"] == 3 and payload["samples"] == 42
+    # corrupt plan is ignored, not fatal
+    with open(path, "w") as f:
+        f.write("{not json")
+    assert mxc.load_ladder("m") is None
+
+
+def test_plan_for_needs_samples_then_plans(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_COMPILE_CACHE_DIR", str(tmp_path))
+    monkeypatch.setenv("MXNET_COMPILE_PLAN_MIN_SAMPLES", "10")
+    # below the sample floor: power-of-two fallback
+    for _ in range(3):
+        mxc.STATS.record_batch("m", 3)
+    assert mxc.plan_for("m", max_batch=16) == mxc.pow2_ladder(16)
+    # enough skewed traffic: a measured plan, persisted
+    for _ in range(200):
+        mxc.STATS.record_batch("m", 3)
+    for _ in range(20):
+        mxc.STATS.record_batch("m", 5)
+    ladder = mxc.plan_for("m", max_batch=16, version=2)
+    assert 3 in ladder and ladder[-1] == 16
+    persisted, payload = mxc.load_ladder("m")
+    assert persisted == ladder and payload["version"] == 2
+    # a fresh process with no traffic loads the persisted plan
+    mxc.clear_ladders()
+    mxc.STATS.reset()
+    assert mxc.plan_for("m", max_batch=16) == ladder
+
+
+# -- TraceLedger --------------------------------------------------------------
+def test_ledger_counts_and_budget():
+    mxc.LEDGER.reset()
+    mxc.record_trace("unit", "build")
+    mxc.record_trace("unit", "signature-change")
+    mxc.record_trace("elsewhere", "build")
+    assert mxc.LEDGER.trace_count() == 3
+    assert mxc.LEDGER.trace_count(callsite="unit") == 2
+    assert mxc.LEDGER.trace_count(callsite="unit",
+                                  reason="build") == 1
+    assert mxc.LEDGER.assert_trace_budget(2, callsite="unit") == 2
+    with pytest.raises(AssertionError, match="retrace budget exceeded"):
+        mxc.LEDGER.assert_trace_budget(1, callsite="unit")
+    snap = mxc.LEDGER.snapshot()
+    assert snap["by_callsite"] == {"unit": 2, "elsewhere": 1}
+
+
+def test_executor_build_records_trace():
+    from mxnet_tpu.serving.executor_cache import bind_inference_executor
+    mxc.LEDGER.reset()
+    ex = bind_inference_executor(_mlp_symbol(), _mlp_params(),
+                                 {"data": (2, 50)})
+    ex.forward(is_train=False)
+    assert mxc.LEDGER.trace_count(callsite="executor", reason="infer") == 1
+    ex.forward(is_train=False)  # warm path: no new trace
+    assert mxc.LEDGER.trace_count(callsite="executor", reason="infer") == 1
+
+
+def test_fused_step_build_records_trace():
+    mxc.LEDGER.reset()
+    from mxnet_tpu import io as mxio
+    d = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(d, num_hidden=8, name="fc")
+    sym = mx.sym.SoftmaxOutput(h, name="softmax")
+    x = np.random.randn(4, 6).astype(np.float32)
+    y = np.random.randint(0, 8, 4).astype(np.float32)
+    batch = mxio.DataBatch(data=[mx.nd.array(x)],
+                           label=[mx.nd.array(y)])
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.bind(data_shapes=[("data", x.shape)],
+             label_shapes=[("softmax_label", y.shape)])
+    mod.init_params()
+    mod.init_optimizer(kvstore=None, optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    for _ in range(3):
+        mod.forward_backward(batch)
+        mod.update()
+    assert mxc.LEDGER.trace_count(callsite="fused_step",
+                                  reason="build") == 1
+
+
+# -- AOT ladder warmup through the server -------------------------------------
+def test_server_warm_then_burst_zero_retraces():
+    mxc.LEDGER.reset()
+    with serving.ModelServer(max_batch_size=8, max_latency_ms=2.0,
+                             name="warmtest") as server:
+        server.load("wmlp", symbol=_mlp_symbol(), params=_mlp_params())
+        warmed = server.warm(
+            "wmlp", sample_signature=[("data", (50,), "float32")])
+        assert warmed == [1, 2, 4, 8]
+        assert mxc.warmed_signatures("wmlp", 1) is not None
+        assert len(mxc.warmed_signatures("wmlp", 1)) == 4
+        traces0 = mxc.LEDGER.trace_count(
+            callsite="serving.executor_cache")
+        assert traces0 == len(warmed)
+        misses0 = server._cache.stats()["misses"]
+
+        rng = np.random.RandomState(1)
+        futs = [server.predict_async(
+                    "wmlp", {"data": rng.randn(50).astype(np.float32)})
+                for _ in range(30)]
+        for f in futs:
+            f.result(30.0)
+
+        stats = server._cache.stats()
+        assert stats["misses"] == misses0, \
+            "a post-warmup request missed the executor cache"
+        assert mxc.LEDGER.trace_count(
+            callsite="serving.executor_cache") == traces0
+        # per-model split is exported
+        assert stats["per_model"]["wmlp"]["misses"] == len(warmed)
+        assert stats["per_model"]["wmlp"]["hits"] > 0
+        # the measured workload was recorded for the planner
+        assert mxc.STATS.samples("wmlp") > 0
+        assert mxc.STATS.top_signature("wmlp") == (
+            ("data", (50,), "float32"),)
+
+
+def test_warm_skips_unknown_signature_gracefully():
+    with serving.ModelServer(max_batch_size=4, name="nosig") as server:
+        server.load("fresh", symbol=_mlp_symbol(), params=_mlp_params())
+        # no traffic, no explicit signature: warmup is a logged no-op
+        assert server.warm("fresh") == []
+        # mismatched input names: skipped, not fatal
+        assert server.warm("fresh", sample_signature=[
+            ("wrong_input", (50,), "float32")]) == []
+
+
+def test_unexpected_retrace_warns(caplog):
+    sig = (("data", (50,), "float32"),)
+    mxc.mark_warmed("alarmed", 1, mxc.bucket_feed_signature(sig, 1))
+    other = mxc.bucket_feed_signature(sig, 16)
+    with caplog.at_level(logging.WARNING, logger="mxnet_tpu.compile"):
+        # a miss inside the warmed set: silent
+        mxc.note_retrace(("alarmed", 1,
+                          mxc.bucket_feed_signature(sig, 1)), "request")
+        assert not [r for r in caplog.records
+                    if "unexpected retrace" in r.message]
+        # outside it: one WARN naming the signature
+        mxc.note_retrace(("alarmed", 1, other), "request")
+    warns = [r for r in caplog.records
+             if "unexpected retrace" in r.getMessage()]
+    assert len(warns) == 1
+    assert "alarmed" in warns[0].getMessage()
+    assert "16" in warns[0].getMessage()
+    # an unwarmed model never alarms
+    caplog.clear()
+    with caplog.at_level(logging.WARNING, logger="mxnet_tpu.compile"):
+        mxc.note_retrace(("quiet", 1, other), "request")
+        mxc.note_retrace(("c_predict", "hash", "hash2", other), "request")
+    assert not [r for r in caplog.records
+                if "unexpected retrace" in r.getMessage()]
+
+
+def test_per_model_cache_stats_in_telemetry():
+    from mxnet_tpu import telemetry
+    with serving.ModelServer(max_batch_size=4, name="telem") as server:
+        server.load("tmodel", symbol=_mlp_symbol(), params=_mlp_params())
+        server.predict("tmodel",
+                       {"data": np.zeros(50, np.float32)}, wait_s=30.0)
+        snap = telemetry.snapshot()
+        assert "tmodel" in snap["executor_cache"]
+        cell = snap["executor_cache"]["tmodel"]
+        assert cell["misses"] >= 1
+        text = telemetry.prometheus_dump()
+        assert 'mxnet_executor_cache_misses_total{model="tmodel"}' in text
+        # the compile collector rides the same snapshot
+        assert snap["compile"]["ledger"]["traces"] >= 1
+        assert "tmodel" in snap["compile"]["shape_stats"]
+
+
+# -- repository warm hooks ----------------------------------------------------
+def test_load_hot_reload_triggers_background_warm():
+    from mxnet_tpu.serving.repository import ModelRepository
+    repo = ModelRepository()
+    seen = []
+    fired = threading.Event()
+
+    def hook(name, mv):
+        seen.append((name, mv.version))
+        fired.set()
+
+    repo.add_warm_hook(hook)
+    repo.load("bg", symbol=_mlp_symbol(), params=_mlp_params())
+    # first publish: nothing to warm from, no hook
+    time.sleep(0.05)
+    assert seen == []
+    repo.load("bg", symbol=_mlp_symbol(), params=_mlp_params())
+    assert fired.wait(5.0), "hot-reload load never ran the warm hooks"
+    assert seen == [("bg", 2)]
+
+
+def test_warm_hook_failure_never_blocks_load():
+    from mxnet_tpu.serving.repository import ModelRepository
+    repo = ModelRepository()
+    repo.add_warm_hook(
+        lambda name, mv: (_ for _ in ()).throw(RuntimeError("boom")))
+    repo.load("hardy", symbol=_mlp_symbol(), params=_mlp_params())
+    v2 = repo.load("hardy", symbol=_mlp_symbol(), params=_mlp_params())
+    assert v2 == 2
+    assert repo.latest_version("hardy") == 2
+
+
+# -- persistence across processes --------------------------------------------
+_CHILD = textwrap.dedent('''
+    import json, os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import compile as mxc
+    from mxnet_tpu import serving
+
+    def build():
+        d = mx.sym.Variable("data")
+        h = mx.sym.FullyConnected(d, num_hidden=64, name="fc1")
+        h = mx.sym.Activation(h, act_type="relu")
+        return mx.sym.FullyConnected(h, num_hidden=10, name="fc2")
+
+    rng = np.random.RandomState(0)
+    params = {"fc1_weight": mx.nd.array(rng.randn(64, 50)
+                                        .astype(np.float32) * 0.1),
+              "fc1_bias": mx.nd.zeros((64,)),
+              "fc2_weight": mx.nd.array(rng.randn(10, 64)
+                                        .astype(np.float32) * 0.1),
+              "fc2_bias": mx.nd.zeros((10,))}
+    server = serving.ModelServer(max_batch_size=4, name="persist")
+    server.load("mlp", symbol=build(), params=params)
+    warmed = server.warm("mlp",
+                         sample_signature=[("data", (50,), "float32")])
+    server.predict("mlp", {"data": rng.randn(50).astype(np.float32)},
+                   wait_s=60.0)
+    print(json.dumps({
+        "warmed": warmed,
+        "compiles": mxc.LEDGER.compiles(),
+        "jax": mxc.LEDGER.counts()["jax"],
+        "cache_dir": mxc.active_dir(),
+    }))
+    server.shutdown()
+''')
+
+
+def _run_child(cache_dir, salt=""):
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               MXNET_COMPILE_CACHE="1",
+               MXNET_COMPILE_CACHE_DIR=str(cache_dir),
+               MXNET_COMPILE_CACHE_MIN_COMPILE_S="0",
+               MXNET_COMPILE_CACHE_SALT=salt)
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run([sys.executable, "-c", _CHILD], env=env,
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, f"child failed:\n{proc.stderr[-2000:]}"
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def test_persistent_cache_across_processes_and_invalidation(tmp_path):
+    """Acceptance gate: a warm restart performs 0 backend compiles for a
+    previously-compiled ladder; a mismatched version key (salt) does NOT
+    reuse the artifacts."""
+    cache_dir = tmp_path / "artifacts"
+    cold = _run_child(cache_dir)
+    assert cold["warmed"] == [1, 2, 4]
+    assert cold["compiles"] > 0, \
+        "cold run should miss the persistent cache"
+    assert cold["cache_dir"].startswith(str(cache_dir))
+
+    warm = _run_child(cache_dir)
+    assert warm["warmed"] == [1, 2, 4]
+    assert warm["compiles"] == 0, (
+        "warm restart recompiled despite the persistent cache: "
+        f"{warm['jax']}")
+    assert warm["jax"].get("persistent_hits", 0) > 0
+
+    # same directory, different stack version key: nothing reused
+    salted = _run_child(cache_dir, salt="simulated-upgrade")
+    assert salted["compiles"] > 0, (
+        "a mismatched version key reused stale artifacts: "
+        f"{salted['jax']}")
+    assert salted["cache_dir"] != cold["cache_dir"]
+    # both namespaces coexist under the root
+    assert len(os.listdir(cache_dir)) == 2

@@ -716,3 +716,76 @@ def test_repository_watch_thread_hot_reloads(tmp_path):
                 _time.sleep(0.02)
         finally:
             repo.unwatch("mlp")
+
+
+def test_watch_warms_ladder_before_flip(tmp_path):
+    """ISSUE 7 satellite: a checkpoint hot-reload warms the new
+    version's full bucket ladder BEFORE the served-version pointer
+    flips, so a version swap under load never serves a cold-compile
+    request (zero executor-cache misses post-flip)."""
+    from mxnet_tpu import compile as mxc
+    from mxnet_tpu.checkpoint import CheckpointManager
+
+    net = _mlp(in_dim=6)
+    if not getattr(net, "_cached_graph", None):
+        net._build_sym_graph()
+    sym = net._cached_graph[1]
+    params = {f"arg:{k}": p._reduce()
+              for k, p in net.collect_params().items()}
+    ckdir = str(tmp_path / "ck")
+    server = ModelServer(max_batch_size=4, max_latency_ms=2.0,
+                         name="flip")
+    repo = server.repository
+    at_hook = []  # (latest-at-hook-time, warmed sigs registered?)
+
+    def probe_hook(name, mv):
+        # registered AFTER the server's warm hook, so by the time this
+        # runs the ladder must already be warmed — and the pointer must
+        # not have flipped yet
+        try:
+            latest = repo.latest_version(name)
+        except MXNetError:
+            latest = 0
+        at_hook.append((mv.version, latest,
+                        mxc.warmed_signatures(name, mv.version)))
+
+    repo.add_warm_hook(probe_hook)
+    try:
+        with CheckpointManager(ckdir, keep_last=0) as mgr:
+            mgr.save(1, arrays=params, symbol=sym, block=True)
+            assert repo.poll_checkpoint("flipm", ckdir) == 1
+            # v1 had no traffic history: warmup skipped, recorded as such
+            assert at_hook[0][0] == 1 and at_hook[0][2] is None
+
+            # serve traffic on v1 so the shape census knows the model
+            x = np.random.randn(6).astype(np.float32)
+            for _ in range(4):
+                server.predict("flipm", {"data": x}, wait_s=30.0)
+            misses_v1 = server._cache.stats()["misses"]
+
+            mgr.save(2, arrays=params, symbol=sym, block=True)
+            assert repo.poll_checkpoint("flipm", ckdir) == 2
+            # the probe ran after warmup, before the flip
+            assert at_hook[1][0] == 2
+            assert at_hook[1][1] == 1, \
+                "version pointer flipped before the warm hooks ran"
+            assert at_hook[1][2], "v2 ladder was not warmed pre-flip"
+            misses_warm = server._cache.stats()["misses"]
+            assert misses_warm > misses_v1  # the warmup itself compiled
+
+            # post-flip traffic is all executor-cache hits on v2
+            traces0 = mxc.LEDGER.trace_count(
+                callsite="serving.executor_cache")
+            for _ in range(6):
+                out = server.predict("flipm", {"data": x}, wait_s=30.0)
+            assert out[0].shape == (3,)
+            assert repo.get("flipm").version == 2
+            assert server._cache.stats()["misses"] == misses_warm, \
+                "a post-flip request paid a compile"
+            assert mxc.LEDGER.trace_count(
+                callsite="serving.executor_cache") == traces0
+    finally:
+        server.shutdown()
+        mxc.clear_ladders()
+        mxc.clear_warmed()
+        mxc.STATS.reset()
