@@ -16,7 +16,8 @@ git diff --exit-code cpp-package/include/mxnet_tpu/op.hpp
 
 echo "== graftlint (project-native static analysis, baseline-gated) =="
 # lock-discipline / torn-write / host-sync / tracer-leak /
-# swallowed-error / env-knob-drift; fails only on NEW violations
+# swallowed-error / env-knob-drift / raw-phase-timing / naked-retry;
+# fails only on NEW violations
 # (ci/graftlint_baseline.json holds triaged pre-existing debt).
 # docs/lint.md has the rule catalog and suppression syntax.
 python tools/graftlint.py --fail-on-new
@@ -49,6 +50,15 @@ echo "== compile smoke (persistent cache, ladder warmup, retrace ratchet) =="
 # must trace exactly ladder-size times and compile NOTHING post-warmup;
 # the BucketPlanner must beat pow2 on a skewed histogram (docs/compile.md)
 JAX_PLATFORMS=cpu python -m mxnet_tpu.compile.smoke
+
+echo "== chaos smoke (failpoints, composed fault scenarios, self-healing) =="
+# the four composed scenarios: kvstore worker kill/revive commits past
+# the kill, corrupt-checkpoint-under-reload serves the old version with
+# zero non-shed failures, a wedged batcher stays p99-bounded under a
+# named watchdog stall, and a mid-scan-window SIGKILL resumes
+# bit-identically; disabled-failpoint overhead must stay < 1us
+# (docs/chaos.md)
+JAX_PLATFORMS=cpu python -m mxnet_tpu.chaos.smoke
 
 echo "== entry points =="
 JAX_PLATFORMS=cpu python -c \

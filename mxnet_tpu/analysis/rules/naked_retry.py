@@ -1,0 +1,123 @@
+"""naked-retry — retry/poll loops need a bound and backoff+jitter.
+
+ISSUE 8's kvstore work is the canon: the client's RPC retry reconnects
+with ``base * 2^attempt * (1 + jitter)`` sleeps under a
+``MXNET_KVSTORE_RETRIES`` bound.  The anti-pattern this rule hunts is
+the loop that predates that design::
+
+    while True:
+        try:
+            return op()
+        except Exception:
+            time.sleep(1.0)        # forever, in lockstep with its peers
+
+A naked retry has two failure modes this repo has paid for: it turns a
+dead dependency into a silent hang (no attempt bound), and a fleet of
+them hammers the recovering dependency in synchronized waves (constant
+sleep, no jitter/backoff).
+
+The rule fires on a ``while`` loop that (a) sleeps a **constant**
+``time.sleep(c)`` in its body and (b) shows **no bound**: the loop test
+contains no comparison (``while True:``, ``while not done:``) and the
+body never compares a clock read (``time.time()`` / ``monotonic()`` /
+``perf_counter()``) against anything — the deadline-escape idiom.
+
+Near-misses stay silent:
+
+* ``for attempt in range(n):`` — attempt-bounded by construction;
+* ``while time.time() < deadline:`` or a ``if time.monotonic() >
+  deadline: raise`` inside the body — deadline-bounded;
+* ``while attempts < 5:`` — any comparison in the test counts as a
+  bound;
+* ``time.sleep(delay)`` where ``delay`` is computed — a non-constant
+  sleep is how backoff/jitter looks in source.
+
+Deliberate unbounded poll loops (a daemon poller whose lifetime IS the
+process) carry ``# graftlint: disable=naked-retry -- reason``.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule, register_rule
+
+_CLOCKS = {"time", "monotonic", "perf_counter"}
+
+
+def _is_clock_call(node):
+    if not isinstance(node, ast.Call):
+        return False
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        return func.attr in _CLOCKS
+    if isinstance(func, ast.Name):
+        return func.id in _CLOCKS and func.id != "time"
+    return False
+
+
+def _is_sleep_call(node):
+    if not isinstance(node, ast.Call):
+        return False
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        return func.attr == "sleep"
+    return isinstance(func, ast.Name) and func.id == "sleep"
+
+
+def _const_sleep_arg(call):
+    """The constant seconds of a sleep call, or None when the sleep is
+    computed (backoff/jitter-shaped) or argless."""
+    if not call.args:
+        return None
+    arg = call.args[0]
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, (int, float)):
+        return arg.value
+    # -x / +x literals
+    if isinstance(arg, ast.UnaryOp) and \
+            isinstance(arg.operand, ast.Constant):
+        return arg.operand.value
+    return None
+
+
+def _contains(node, pred):
+    return any(pred(n) for n in ast.walk(node))
+
+
+@register_rule
+class NakedRetryRule(Rule):
+    id = "naked-retry"
+    severity = "warning"
+    doc = ("unbounded retry/poll loop sleeping a constant — add an "
+           "attempt bound or deadline, and backoff+jitter "
+           "(docs/chaos.md; the kvstore client retry is the template)")
+
+    def visit(self, node, ctx):
+        if not isinstance(node, ast.While):
+            return
+        # any comparison in the loop test is read as a bound
+        # (attempt counter, deadline, queue-depth watermark...)
+        if _contains(node.test, lambda n: isinstance(n, ast.Compare)):
+            return
+        sleeps = [n for n in ast.walk(node)
+                  if _is_sleep_call(n) and _const_sleep_arg(n) is not None]
+        if not sleeps:
+            return
+        # deadline escape anywhere in the body: a Compare whose either
+        # side reads a clock
+        def _deadline_compare(n):
+            if not isinstance(n, ast.Compare):
+                return False
+            sides = [n.left] + list(n.comparators)
+            return any(_contains(s, _is_clock_call) for s in sides)
+        if any(_contains(stmt, _deadline_compare) for stmt in node.body):
+            return
+        call = sleeps[0]
+        ctx.report(
+            self, call,
+            f"retry/poll loop sleeps a constant {_const_sleep_arg(call)}s "
+            "with no attempt bound or deadline — a dead dependency "
+            "becomes a silent hang and the fixed period retries in "
+            "lockstep; bound the attempts and sleep "
+            "base * 2^attempt * (1 + jitter) (see the kvstore client "
+            "retry, docs/chaos.md)",
+            symbol=f"{ctx.func_name()}:naked-retry")

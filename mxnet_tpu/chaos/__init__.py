@@ -1,0 +1,44 @@
+"""mxnet_tpu.chaos — failpoint injection and composed fault scenarios.
+
+The robustness harness (ISSUE 8): :mod:`failpoints` plants named,
+deterministic injection sites across the checkpoint writer, serving
+stack, compile cache, kvstore transport and io staging;
+:mod:`harness` composes them into the four end-to-end outage scenarios
+CI replays (``python -m mxnet_tpu.chaos.smoke``); every weakness a
+scenario exposes becomes a permanent fix + a graftlint rule or
+telemetry alarm — the same ratchet loop graftlint (ISSUE 3) runs for
+static invariants, applied to dynamic ones.
+
+Usage::
+
+    import mxnet_tpu.chaos as chaos
+    chaos.arm("serving/batcher/worker", "raise", count=1)
+    ...                       # the next worker pass dies and restarts
+    chaos.reset()
+
+or from the environment (child processes, CI)::
+
+    MXNET_CHAOS="checkpoint/writer/pre_rename=kill" python train.py
+
+See docs/chaos.md for the failpoint catalog, the spec grammar, the
+scenario runbook, and how a found failure becomes a lint rule/alarm.
+"""
+from __future__ import annotations
+
+from .failpoints import (ACTIONS, SITES, ChaosInjectedError,
+                         ChaosSpecError, active, arm, arms, configure,
+                         configure_from_env, disarm, failpoint,
+                         failpoint_bytes, fatal_site, hit_counts, release,
+                         reset, sites)
+
+__all__ = [
+    "ACTIONS", "SITES", "ChaosInjectedError", "ChaosSpecError", "active",
+    "arm", "arms", "configure", "configure_from_env", "disarm",
+    "failpoint", "failpoint_bytes", "fatal_site", "hit_counts", "release",
+    "reset", "sites",
+]
+
+# arm from MXNET_CHAOS at import: sites call failpoint() through this
+# package, so the first instrumented subsystem to load activates any
+# environment-specified fault schedule (zero effect when unset)
+configure_from_env()

@@ -1,0 +1,431 @@
+"""Failpoints: named, deterministic fault-injection sites (ISSUE 8).
+
+Every failure mode this stack handles — SIGKILL-mid-save, corrupt
+manifests, wedged batcher workers, dead kvstore peers — used to be
+reproduced ad hoc (sleep-widened races, parent-timed kills).  A
+failpoint turns the injection point into a NAME:
+
+    from ..chaos.failpoints import failpoint
+    ...
+    failpoint("checkpoint/writer/pre_rename")
+
+Disabled (the default, and always when ``MXNET_CHAOS`` is unset) a call
+is one module-global check — the same near-zero bar as a disabled
+telemetry span (< 1 us, test-asserted), so the hooks stay in the hot
+paths unconditionally and production behavior is bit-identical.
+
+Armed — programmatically (:func:`arm`) or via ``MXNET_CHAOS`` spec
+strings (:func:`configure`) — a site fires one of five actions:
+
+* ``raise``      — raise a typed error (:class:`ChaosInjectedError` by
+                   default, or any builtin exception by name);
+* ``delay``      — sleep the calling thread for N seconds;
+* ``wedge``      — block until :func:`release` (or the wedge timeout,
+                   after which it raises — no scenario may end in a
+                   hang, see docs/chaos.md);
+* ``corrupt``    — for byte-producing sites (:func:`failpoint_bytes`):
+                   deterministically flip bytes, or truncate;
+* ``kill``       — SIGKILL the current process (``kill(mark)`` only
+                   records the fatal site, for in-process tests of the
+                   machinery around a kill).
+
+Determinism: triggers are **hit-count based** (``hits=N`` fires from the
+Nth call on, ``count=M`` fires at most M times) and any probabilistic
+trigger (``prob=p``) draws from a per-site ``random.Random`` seeded by
+``MXNET_CHAOS_SEED`` — the same spec string replays the same faults at
+the same call counts, every run.
+
+Spec grammar (``;``-separated arms)::
+
+    site=action[(value)][:key=val[:key=val...]]
+
+    MXNET_CHAOS="checkpoint/writer/pre_rename=kill"
+    MXNET_CHAOS="serving/batcher/worker=raise(RuntimeError):hits=3:count=1"
+    MXNET_CHAOS="kvstore/client/rpc=delay(0.2):prob=0.5"
+    MXNET_CHAOS="checkpoint/writer/manifest=corrupt(flip):hits=2"
+
+Every injection lands in the ``mxnet_chaos_injections_total{site,action}``
+telemetry lane, so a chaos run's fault schedule is auditable from the
+same ``/metrics`` scrape as its effects.
+"""
+from __future__ import annotations
+
+import builtins
+import logging
+import os
+import random
+import signal
+import threading
+import time
+import zlib
+
+from ..base import MXNetError
+
+log = logging.getLogger("mxnet_tpu.chaos")
+
+ACTIONS = ("raise", "delay", "wedge", "corrupt", "kill")
+
+# module-global fast gate: the ONLY thing a disabled failpoint() touches
+_any_armed = False
+
+_lock = threading.Lock()
+_arms = {}          # site -> _Arm
+_hits = {}          # site -> total failpoint() calls while armed
+_fatal_site = None  # site whose kill action fired (mark or pre-SIGKILL)
+
+# the static site catalog (docs/chaos.md renders this); calling a site
+# not listed here still works — it self-registers with an empty doc, so
+# ad-hoc scenario sites never error
+SITES = {
+    "checkpoint/writer/pre_tmp_write":
+        "background writer, before any byte of step-NNNNNN.tmp is written",
+    "checkpoint/writer/post_tmp_write":
+        "background writer, after the data file is written+fsynced but "
+        "before the manifest",
+    "checkpoint/writer/manifest":
+        "bytes hook on the serialized MANIFEST.json (corrupt-bytes "
+        "exercises the checksum/verify path)",
+    "checkpoint/writer/pre_rename":
+        "background writer, immediately before the atomic commit rename",
+    "checkpoint/gc/remove":
+        "retention GC, before each old step directory is removed",
+    "serving/batcher/submit":
+        "DynamicBatcher.submit, after validation, before enqueue",
+    "serving/batcher/worker":
+        "batcher worker loop, inside the watchdog arm, before the batch "
+        "runs (raise kills the worker; wedge stalls it)",
+    "serving/repository/poll":
+        "ModelRepository.poll_checkpoint, before the committed-step scan",
+    "serving/repository/warm_hook":
+        "repository warm hooks, before each hook runs",
+    "compile/cache/artifact":
+        "inside guarded_compile: a raise here simulates a corrupt/"
+        "truncated persistent-compile-cache artifact failing "
+        "deserialization",
+    "compile/ladder/load":
+        "planner.load_ladder, before the persisted ladder file is read",
+    "kvstore/client/rpc":
+        "KVClient, before each RPC frame is sent (raise exercises the "
+        "bounded-retry path; kill drops the worker mid-epoch)",
+    "kvstore/server/heartbeat":
+        "KVServer, on receipt of each worker heartbeat (raise drops the "
+        "connection, so the worker reads as dead)",
+    "io/stage":
+        "io.stage_batch / stage_super_batch, before the host->device put",
+    "train/scan_window":
+        "Module scanned fit, at each window boundary before the scan "
+        "dispatch (kill here is the SIGKILL-mid-window scenario)",
+}
+
+
+class ChaosInjectedError(MXNetError):
+    """The typed error an armed ``raise`` failpoint injects.
+
+    Carries ``site`` so handlers (and assertions) can tell an injected
+    fault from an organic one; ``retryable`` is True — the injection
+    models a transient fault.
+    """
+
+    retryable = True
+
+    def __init__(self, site, detail=""):
+        self.site = site
+        super().__init__(
+            f"chaos: injected fault at failpoint {site!r}"
+            + (f" ({detail})" if detail else ""))
+
+
+class ChaosSpecError(MXNetError):
+    """A MXNET_CHAOS spec string failed to parse."""
+
+
+class _Arm:
+    __slots__ = ("site", "action", "value", "hits", "count", "prob",
+                 "timeout", "fired", "rng", "event")
+
+    def __init__(self, site, action, value=None, hits=1, count=None,
+                 prob=1.0, timeout=None, seed=None):
+        if action not in ACTIONS:
+            raise ChaosSpecError(
+                f"chaos: unknown action {action!r} for site {site!r}; "
+                f"expected one of {ACTIONS}")
+        self.site = site
+        self.action = action
+        self.value = value
+        self.hits = max(1, int(hits))
+        self.count = None if count is None else max(1, int(count))
+        self.prob = float(prob)
+        self.timeout = timeout
+        self.fired = 0
+        if seed is None:
+            seed = _seed()
+        # per-site deterministic stream: the same spec replays the same
+        # probabilistic schedule and the same corruption bytes (crc32,
+        # not hash() — PYTHONHASHSEED must not change the schedule)
+        self.rng = random.Random((seed << 32)
+                                 ^ zlib.crc32(site.encode("utf-8")))
+        self.event = threading.Event()  # wedge release
+
+
+def _seed():
+    from .. import config as _config
+    return int(_config.get("MXNET_CHAOS_SEED"))
+
+
+def _wedge_timeout():
+    from .. import config as _config
+    return float(_config.get("MXNET_CHAOS_WEDGE_TIMEOUT_S"))
+
+
+def _injection_counter():
+    from .. import telemetry as _telemetry
+    return _telemetry.REGISTRY.counter(
+        "mxnet_chaos_injections_total",
+        "chaos failpoint injections fired, by site and action")
+
+
+# -- arming ------------------------------------------------------------------
+def arm(site, action, value=None, hits=1, count=None, prob=1.0,
+        timeout=None):
+    """Arm one failpoint.  ``hits``: fire from the Nth call on (1-based);
+    ``count``: auto-disarm after firing this many times (None = every
+    eligible hit); ``prob``: per-eligible-hit firing probability, drawn
+    from the seeded per-site stream; ``timeout``: wedge-only override of
+    ``MXNET_CHAOS_WEDGE_TIMEOUT_S``."""
+    global _any_armed
+    a = _Arm(str(site), action, value=value, hits=hits, count=count,
+             prob=prob, timeout=timeout)
+    with _lock:
+        SITES.setdefault(a.site, "")
+        _arms[a.site] = a
+        _hits.setdefault(a.site, 0)
+        _any_armed = True
+    log.info("chaos: armed %s=%s%s hits=%d count=%s prob=%g", a.site,
+             a.action, f"({a.value})" if a.value is not None else "",
+             a.hits, a.count, a.prob)
+    return a
+
+
+def disarm(site):
+    """Disarm one site (releasing any thread wedged on it)."""
+    global _any_armed
+    with _lock:
+        a = _arms.pop(str(site), None)
+        if not _arms:
+            _any_armed = False
+    if a is not None:
+        a.event.set()
+    return a is not None
+
+
+def release(site):
+    """Release threads wedged at ``site`` (the arm stays armed; with a
+    ``count`` it has already been consumed by the firing)."""
+    with _lock:
+        a = _arms.get(str(site))
+    if a is not None:
+        a.event.set()
+
+
+def reset():
+    """Disarm everything, release every wedge, forget hit counts and the
+    fatal marker — the between-scenarios (and between-tests) broom."""
+    global _any_armed, _fatal_site
+    with _lock:
+        arms = list(_arms.values())
+        _arms.clear()
+        _hits.clear()
+        _fatal_site = None
+        _any_armed = False
+    for a in arms:
+        a.event.set()
+
+
+def configure(spec):
+    """Parse and arm a ``MXNET_CHAOS``-style spec string; returns the
+    list of armed sites.  An empty/None spec arms nothing."""
+    armed = []
+    for part in (spec or "").split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ChaosSpecError(
+                f"chaos: bad arm {part!r} (expected site=action[...])")
+        site, rhs = part.split("=", 1)
+        fields = rhs.split(":")
+        head, opts = fields[0].strip(), fields[1:]
+        value = None
+        if "(" in head:
+            if not head.endswith(")"):
+                raise ChaosSpecError(f"chaos: unbalanced parens in {part!r}")
+            head, value = head.split("(", 1)
+            value = value[:-1]
+        kw = {}
+        for opt in opts:
+            if "=" not in opt:
+                raise ChaosSpecError(
+                    f"chaos: bad option {opt!r} in {part!r} "
+                    "(expected key=val)")
+            k, v = opt.split("=", 1)
+            k = k.strip()
+            if k in ("hits", "count"):
+                kw[k] = int(v)
+            elif k in ("prob", "timeout"):
+                kw[k] = float(v)
+            else:
+                raise ChaosSpecError(
+                    f"chaos: unknown option {k!r} in {part!r} (expected "
+                    "hits/count/prob/timeout)")
+        arm(site.strip(), head.strip(), value=value, **kw)
+        armed.append(site.strip())
+    return armed
+
+
+def configure_from_env():
+    """Arm from ``MXNET_CHAOS`` (no-op when unset) — called at chaos
+    package import, so a child process armed via its environment needs
+    no code change."""
+    from .. import config as _config
+    spec = _config.get("MXNET_CHAOS")
+    if spec:
+        return configure(spec)
+    return []
+
+
+# -- introspection -----------------------------------------------------------
+def active():
+    """True when at least one site is armed."""
+    return _any_armed
+
+
+def arms():
+    """{site: {action, hits, count, fired, ...}} for every armed site."""
+    with _lock:
+        return {s: {"action": a.action, "value": a.value, "hits": a.hits,
+                    "count": a.count, "prob": a.prob, "fired": a.fired}
+                for s, a in _arms.items()}
+
+
+def hit_counts():
+    """{site: total failpoint() calls observed while armed}."""
+    with _lock:
+        return dict(_hits)
+
+
+def fatal_site():
+    """The site whose ``kill`` action fired (None otherwise).  Set just
+    before the SIGKILL lands (and is all a ``kill(mark)`` arm does), so
+    liveness surfaces — ``/healthz`` — can report the process as doomed."""
+    with _lock:
+        return _fatal_site
+
+
+def sites():
+    """The failpoint catalog: {site: doc} (docs/chaos.md table source)."""
+    with _lock:
+        return dict(SITES)
+
+
+# -- the hooks ---------------------------------------------------------------
+def failpoint(site):
+    """The injection hook — a no-op global check unless chaos is armed."""
+    if not _any_armed:
+        return
+    _fire(site, None)
+
+
+def failpoint_bytes(site, data):
+    """Byte-producing sites route their payload through this hook so a
+    ``corrupt`` arm can mangle it; identity when chaos is off."""
+    if not _any_armed:
+        return data
+    return _fire(site, data)
+
+
+def _eligible(site):
+    """Trigger bookkeeping under the lock; returns the arm iff it should
+    fire for this call."""
+    global _any_armed
+    with _lock:
+        a = _arms.get(site)
+        if a is None:
+            return None
+        _hits[site] = n = _hits.get(site, 0) + 1
+        if n < a.hits:
+            return None
+        if a.count is not None and a.fired >= a.count:
+            return None
+        if a.prob < 1.0 and a.rng.random() >= a.prob:
+            return None
+        a.fired += 1
+        if a.count is not None and a.fired >= a.count and \
+                a.action != "wedge":
+            # consumed: drop the arm so the fast gate can re-close
+            del _arms[site]
+            if not _arms:
+                _any_armed = False
+        return a
+
+
+def _fire(site, data):
+    global _fatal_site
+    a = _eligible(site)
+    if a is None:
+        return data
+    try:
+        _injection_counter().inc(labels={"site": site, "action": a.action})
+    except Exception:  # graftlint: disable=swallowed-error -- injection accounting must never mask the injection itself
+        pass
+    log.warning("chaos: firing %s at %s (hit %d)", a.action, site,
+                _hits.get(site, 0))
+    if a.action == "raise":
+        raise _make_error(site, a.value)
+    if a.action == "delay":
+        time.sleep(float(a.value or 0.05))
+        return data
+    if a.action == "wedge":
+        timeout = a.timeout if a.timeout is not None else _wedge_timeout()
+        if not a.event.wait(timeout):
+            raise ChaosInjectedError(
+                site, f"wedge exceeded {timeout}s without release() — "
+                "raising instead of hanging forever")
+        return data
+    if a.action == "corrupt":
+        if data is None:
+            raise ChaosInjectedError(
+                site, "corrupt action armed on a non-bytes failpoint; "
+                "use failpoint_bytes sites (see docs/chaos.md catalog)")
+        return _corrupt(a, data)
+    if a.action == "kill":
+        with _lock:
+            _fatal_site = site
+        if a.value == "mark":
+            return data
+        log.error("chaos: SIGKILL self at %s", site)
+        os.kill(os.getpid(), signal.SIGKILL)
+    return data
+
+
+def _make_error(site, name):
+    if not name:
+        return ChaosInjectedError(site)
+    cls = getattr(builtins, str(name), None)
+    if isinstance(cls, type) and issubclass(cls, BaseException):
+        return cls(f"chaos: injected {name} at failpoint {site!r}")
+    return ChaosInjectedError(site, f"unknown error class {name!r}")
+
+
+def _corrupt(a, data):
+    data = bytes(data)
+    if a.value == "truncate":
+        return data[:len(data) // 2]
+    if not data:
+        return data
+    # deterministic bit damage: ~1% of bytes (at least one) XOR 0xFF,
+    # positions drawn from the arm's seeded stream
+    out = bytearray(data)
+    n = max(1, len(out) // 100)
+    for _ in range(n):
+        out[a.rng.randrange(len(out))] ^= 0xFF
+    return bytes(out)

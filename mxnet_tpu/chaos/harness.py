@@ -1,0 +1,634 @@
+"""Composed chaos scenarios — the outages unit tests cannot see.
+
+Each scenario function is self-contained, deterministic (failpoints are
+hit-count triggered, subprocess fault schedules ride in ``MXNET_CHAOS``
+env specs), and returns a plain result dict; ``tests/test_chaos.py``
+asserts on the dicts and ``python -m mxnet_tpu.chaos.smoke`` replays
+them in CI.  The four scenarios compose faults that PRs 1-7 only ever
+tested alone:
+
+1. **worker kill/revive** — a dist kvstore worker SIGKILLs itself
+   mid-epoch (chaos ``kill`` at the Nth client RPC); a replacement
+   attaches, restores the rank-0 checkpoint, heals two injected
+   transient RPC faults through the bounded retry, and training commits
+   steps past the kill.
+2. **corrupt checkpoint under serving load** — a corrupt step commits
+   into a watched checkpoint directory while clients hammer the server;
+   the poller quarantines it (alarm counter), the old version keeps
+   serving with zero non-shed failures, and the next good step hot-
+   reloads normally.
+3. **wedged batcher worker** — one of two workers wedges; the watchdog
+   fires naming the wedged section, ``/healthz`` flips to 503 (and back
+   after release), the in-flight sweep resolves the wedged batch as
+   typed timeouts, and the surviving worker keeps p99 bounded.
+4. **SIGKILL mid-scan-window** — a K-step scanned fit dies between
+   window boundaries; restore continues from the last boundary
+   checkpoint bit-identically to an uninterrupted run.
+
+Every scenario ends in recovery or a typed error — the assertions
+include "no hang" (bounded waits everywhere) and "no silent loss"
+(every request/save is accounted for).  docs/chaos.md is the runbook.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+from . import failpoints as chaos
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _child_env(**extra):
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # children must not dial the TPU
+    env.pop("MXNET_CHAOS", None)           # each child gets its own spec
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update({k: str(v) for k, v in extra.items()})
+    return env
+
+
+def _percentile(sorted_vals, q):
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+# ---------------------------------------------------------------------------
+# scenario 1: kvstore worker kill/revive mid-epoch
+# ---------------------------------------------------------------------------
+_KV_WORKER = """
+import os, sys, time
+import numpy as np
+import mxnet_tpu as mx
+import mxnet_tpu.chaos  # arms MXNET_CHAOS from this child's environment
+from mxnet_tpu import kvstore as kvs
+from mxnet_tpu import nd
+from mxnet_tpu.checkpoint import CheckpointManager, restore
+
+rank = int(os.environ["DMLC_RANK"])
+steps = int(sys.argv[1])
+ckdir = sys.argv[2]
+out = sys.argv[3]
+resume = int(sys.argv[4])
+target = np.array([0.5, -1.25, 2.0, 0.125], np.float32)
+
+kv = kvs.create("dist_async")
+start = 0
+if resume:
+    kv.attach("w", nd.zeros((4,)))
+    ck = restore(ckdir)
+    start = ck.step
+    blob = ck.blobs.get("optimizer_states")
+    if blob is not None:
+        kv.set_optimizer_states(blob)
+else:
+    kv.init("w", nd.zeros((4,)))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.05))
+
+mgr = CheckpointManager(ckdir, keep_last=3) if rank == 0 else None
+w = nd.zeros((4,))
+for step in range(start, steps):
+    kv.pull("w", out=w)
+    grad = 2.0 * (w.asnumpy() - target)
+    kv.push("w", nd.array(grad))
+    if rank == 0:
+        blobs = {"optimizer_states": kv.get_optimizer_states()}
+        mgr.save(step + 1, arrays={"w": w}, blobs=blobs, block=True)
+    time.sleep(0.02)
+kv.pull("w", out=w)
+np.save(out, w.asnumpy())
+if mgr is not None:
+    mgr.close()
+"""
+
+
+def scenario_worker_kill_revive(workdir, port=19733, steps=30,
+                                timeout=180.0):
+    """Kill a kvstore worker mid-epoch via a chaos ``kill`` arm at its
+    Nth client RPC; revive it with an elastic attach + checkpoint
+    restore (its retry path additionally heals two injected transient
+    RPC faults); assert training commits steps PAST the kill."""
+    import numpy as np
+
+    from ..checkpoint import latest_step
+    from ..kvstore_server import KVServer
+
+    workdir = str(workdir)
+    os.makedirs(workdir, exist_ok=True)
+    script = os.path.join(workdir, "kv_worker.py")
+    with open(script, "w") as f:  # graftlint: disable=torn-write -- ephemeral scenario script, single consumer
+        f.write(_KV_WORKER)
+    ckdir = os.path.join(workdir, "ckpt")
+    outs = [os.path.join(workdir, f"w{r}.npy") for r in range(2)]
+
+    server = KVServer(port=port, num_workers=2)
+    threading.Thread(target=server.run, daemon=True).start()
+    time.sleep(0.2)
+
+    def spawn(rank, resume, chaos_spec=""):
+        env = _child_env(
+            DMLC_RANK=rank, DMLC_NUM_WORKER=2,
+            DMLC_PS_ROOT_URI="127.0.0.1", DMLC_PS_ROOT_PORT=port,
+            MXNET_KVSTORE_HEARTBEAT_INTERVAL="0.2",
+            MXNET_KVSTORE_RETRY_BACKOFF_S="0.02")
+        if chaos_spec:
+            env["MXNET_CHAOS"] = chaos_spec
+        return subprocess.Popen(
+            [sys.executable, script, str(steps), ckdir, outs[rank],
+             str(int(resume))], env=env)
+
+    result = {"ok": False}
+    deadline = time.time() + timeout
+    # rank 1 SIGKILLs itself deterministically at its 25th client RPC
+    # (mid-epoch: each train step is at least 2 RPCs)
+    procs = [spawn(0, False),
+             spawn(1, False, chaos_spec="kvstore/client/rpc=kill:hits=25")]
+    try:
+        procs[1].wait(timeout=max(10.0, timeout / 2))
+        result["victim_exit"] = procs[1].returncode
+        kill_step = None
+        while kill_step is None and time.time() < deadline:
+            kill_step = latest_step(ckdir)
+            time.sleep(0.1)
+        result["kill_step"] = kill_step
+        # revive: elastic attach + restore, WITH two transient RPC
+        # faults injected — the bounded retry must absorb them
+        procs[1] = spawn(
+            1, True,
+            chaos_spec="kvstore/client/rpc=raise(ConnectionError)"
+                       ":hits=10:count=2")
+        for p in procs:
+            p.wait(timeout=max(1.0, deadline - time.time()))
+        result["exit_codes"] = [p.returncode for p in procs]
+        final_step = latest_step(ckdir)
+        result["final_step"] = final_step
+        finals = [np.load(o) for o in outs if os.path.exists(o)]
+        target = np.array([0.5, -1.25, 2.0, 0.125], np.float32)
+        result["n_finished"] = len(finals)
+        result["converged"] = bool(
+            len(finals) == 2
+            and all(np.allclose(f, target, atol=0.05) for f in finals))
+        result["ok"] = bool(
+            result["victim_exit"] == -9          # the kill arm fired
+            and result["exit_codes"] == [0, 0]   # both survivors finished
+            and final_step == steps              # committed past the kill
+            and kill_step is not None and final_step > kill_step
+            and result["converged"])
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        server._stop.set()
+    return result
+
+
+# ---------------------------------------------------------------------------
+# scenario 2: corrupt checkpoint during a serving hot-reload under load
+# ---------------------------------------------------------------------------
+def _tiny_model(seed=0, scale=0.05, in_dim=16, width=32, classes=10):
+    import numpy as np
+
+    import mxnet_tpu as mx
+    h = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(h, num_hidden=width, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    sym = mx.sym.FullyConnected(h, num_hidden=classes, name="out")
+    rng = np.random.RandomState(seed)
+    params = {
+        "fc1_weight": mx.nd.array(
+            rng.randn(width, in_dim).astype(np.float32) * scale),
+        "fc1_bias": mx.nd.zeros((width,)),
+        "out_weight": mx.nd.array(
+            rng.randn(classes, width).astype(np.float32) * scale),
+        "out_bias": mx.nd.zeros((classes,)),
+    }
+    return sym, params
+
+
+def scenario_corrupt_reload_under_load(workdir, seconds=2.5,
+                                       n_clients=4):
+    """Commit a CORRUPT checkpoint step into a watched directory while
+    clients hammer the server: the poller must quarantine it (alarm
+    counter), keep serving the old version with zero non-shed request
+    failures, and pick up the next GOOD step normally."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from .. import serving, telemetry
+    from ..checkpoint import CheckpointManager
+    from ..checkpoint.core import MANIFEST, step_dir
+    from ..serving.batcher import ServingOverloadError
+    from ..telemetry import watchdog as wd
+
+    workdir = str(workdir)
+    ckdir = os.path.join(workdir, "ckpt")
+    # the watchdog runs ARMED through this scenario and must stay
+    # silent: a corrupt reload degrades, it never stalls the stack
+    os.environ["MXNET_WATCHDOG_S"] = "5.0"
+    fires0 = wd.fires()
+    sym, params = _tiny_model()
+    mgr = CheckpointManager(ckdir, async_save=False, keep_last=0)
+    mgr.save(1, arrays=params, symbol=sym, block=True)
+
+    alarm = telemetry.REGISTRY.counter("mxnet_serving_corrupt_ckpt_total")
+    alarm0 = alarm.value(labels={"model": "m"})
+
+    server = serving.ModelServer(max_batch_size=8, name="chaos-reload")
+    result = {"ok": False, "non_shed_failures": [], "shed": 0,
+              "served": 0}
+    lock = threading.Lock()
+    stop = threading.Event()
+    try:
+        server.repository.watch("m", ckdir, interval=0.05)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            try:
+                server.repository.get("m")
+                break
+            except mx.base.MXNetError:
+                time.sleep(0.05)
+        x = np.ones((16,), np.float32)
+
+        def client():
+            while not stop.is_set():
+                try:
+                    server.predict("m", {"data": x}, wait_s=30.0)
+                    with lock:
+                        result["served"] += 1
+                except ServingOverloadError:
+                    with lock:
+                        result["shed"] += 1
+                except Exception as e:  # noqa: BLE001 — gate-fatal bucket
+                    with lock:
+                        result["non_shed_failures"].append(
+                            f"{type(e).__name__}: {e}")
+                # graftlint: disable=naked-retry -- paced load generator; lifetime is bounded by the stop event the scenario always sets
+                time.sleep(0.002)
+
+        clients = [threading.Thread(target=client)
+                   for _ in range(n_clients)]
+        for t in clients:
+            t.start()
+        time.sleep(seconds / 3)
+
+        # craft a COMMITTED-but-corrupt step 2: clone step 1, flip bytes
+        # in the data file, fix the manifest step, commit atomically (the
+        # watcher can never see a half-built dir)
+        src = step_dir(ckdir, 1)
+        build = step_dir(ckdir, 2) + ".build"
+        shutil.copytree(src, build)
+        with open(os.path.join(build, MANIFEST)) as f:
+            manifest = json.load(f)
+        manifest["step"] = 2
+        data_name = next(iter(manifest["files"]))
+        with open(os.path.join(build, data_name), "r+b") as f:
+            f.seek(10)
+            f.write(b"\xff\xff\xff\xff")  # checksum now lies
+        with open(os.path.join(build, MANIFEST), "w") as f:
+            json.dump(manifest, f)
+        os.rename(build, step_dir(ckdir, 2))
+        result["corrupt_committed_at"] = 2
+
+        time.sleep(seconds / 3)  # several polls hit the corrupt step
+        with lock:
+            result["version_during_corruption"] = \
+                server.repository.latest_version("m")
+
+        # the next GOOD step must still hot-reload (fresh param values
+        # so the swap is observable)
+        _sym, params3 = _tiny_model(seed=7, scale=0.07)
+        mgr.save(3, arrays=params3, symbol=sym, block=True)
+        deadline = time.time() + 15
+        while server.repository.latest_version("m") < 3 and \
+                time.time() < deadline:
+            time.sleep(0.05)
+        time.sleep(seconds / 3)
+        stop.set()
+        for t in clients:
+            t.join(timeout=30)
+        result["final_version"] = server.repository.latest_version("m")
+        result["quarantined"] = server.repository.corrupt_steps(
+            "m", ckdir)
+        result["alarm_count"] = alarm.value(labels={"model": "m"}) - alarm0
+        result["watchdog_silent"] = wd.fires() == fires0
+        result["ok"] = bool(
+            not result["non_shed_failures"]
+            and result["served"] > 0
+            and result["version_during_corruption"] == 1
+            and result["final_version"] == 3
+            and result["quarantined"] == [2]
+            and result["alarm_count"] >= 1
+            and result["watchdog_silent"])
+    finally:
+        stop.set()
+        server.repository.stop_watches()
+        server.shutdown()
+        mgr.close()
+        os.environ.pop("MXNET_WATCHDOG_S", None)
+    return result
+
+
+# ---------------------------------------------------------------------------
+# scenario 3: wedged batcher worker — watchdog + shedding + liveness
+# ---------------------------------------------------------------------------
+def scenario_wedged_batcher(seconds=2.0, watchdog_s=0.4, n_clients=6):
+    """Wedge one of two batcher workers; assert the watchdog fires
+    naming the wedged section, /healthz flips 503 -> 200 around the
+    stall, the wedged batch resolves as typed timeouts (nothing lost),
+    and the surviving worker + shedding keep p99 bounded."""
+    import numpy as np
+
+    from .. import telemetry
+    from ..serving.batcher import (DynamicBatcher, RequestTimeoutError,
+                                   ServingOverloadError)
+    from ..telemetry import watchdog as wd
+    from ..telemetry.exporter import start_exporter, stop_exporter
+
+    os.environ["MXNET_WATCHDOG_S"] = str(watchdog_s)
+    dump_dir = tempfile.mkdtemp(prefix="mx-chaos-wd-")
+    os.environ["MXNET_WATCHDOG_DIR"] = dump_dir
+    fires0 = wd.fires()
+    chaos.reset()
+    chaos.arm("serving/batcher/worker", "wedge", hits=1, count=1)
+
+    def runner(feed, n_real):
+        time.sleep(0.002)
+        return [feed["x"] * 2.0]
+
+    def healthz(port):
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=5) as r:
+                return r.status, r.read().decode()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read().decode()
+
+    result = {"ok": False, "non_typed_failures": [], "shed": 0,
+              "timeouts": 0, "served": 0}
+    lat_ms = []
+    lock = threading.Lock()
+    stop_t = time.perf_counter() + seconds
+    port = start_exporter(0)
+    b = DynamicBatcher(runner, max_batch_size=8, max_latency_ms=2.0,
+                       num_workers=2, max_queue_depth=64,
+                       shed_watermark=16, name="chaos-wedge")
+    try:
+        def client():
+            x = np.ones((8,), np.float32)
+            while time.perf_counter() < stop_t:
+                t0 = time.perf_counter()
+                try:
+                    b.submit({"x": x}, timeout_ms=400.0).result(10.0)
+                    with lock:
+                        lat_ms.append((time.perf_counter() - t0) * 1e3)
+                        result["served"] += 1
+                except ServingOverloadError:
+                    with lock:
+                        result["shed"] += 1
+                    time.sleep(0.001)
+                except RequestTimeoutError:
+                    with lock:
+                        result["timeouts"] += 1
+                except Exception as e:  # noqa: BLE001 — gate-fatal bucket
+                    with lock:
+                        result["non_typed_failures"].append(
+                            f"{type(e).__name__}: {e}")
+
+        clients = [threading.Thread(target=client)
+                   for _ in range(n_clients)]
+        for t in clients:
+            t.start()
+        # the watchdog must fire for the wedged section mid-load
+        deadline = time.time() + max(10.0, 6 * watchdog_s)
+        while wd.fires() <= fires0 and time.time() < deadline:
+            time.sleep(0.05)
+        result["watchdog_fired"] = wd.fires() > fires0
+        result["stalled_sections"] = wd.stalled_sections()
+        code, body = healthz(port)
+        result["healthz_during_stall"] = (code, body.strip())
+        dump = wd.last_dump()
+        dump_text = ""
+        if dump and os.path.exists(dump):
+            with open(dump) as f:
+                dump_text = f.read()
+        result["dump_names_wedge"] = bool(
+            "serving/chaos-wedge" in dump_text
+            and "failpoints" in dump_text)
+        for t in clients:
+            t.join(timeout=30)
+        # release the wedge: the worker resumes, progress beats end the
+        # stall episode, liveness returns to 200
+        chaos.release("serving/batcher/worker")
+        x = np.ones((8,), np.float32)
+        b.submit({"x": x}).result(10.0)
+        deadline = time.time() + 10
+        while wd.stalled_sections() and time.time() < deadline:
+            b.submit({"x": x}).result(10.0)
+            time.sleep(0.05)
+        code2, body2 = healthz(port)
+        result["healthz_after_release"] = (code2, body2.strip())
+        lat_ms.sort()
+        result["p99_ms"] = _percentile(lat_ms, 99)
+        result["ok"] = bool(
+            result["watchdog_fired"]
+            and result["dump_names_wedge"]
+            and code == 503 and "serving/chaos-wedge" in body
+            and code2 == 200
+            and not result["non_typed_failures"]
+            and result["served"] > 0
+            and result["p99_ms"] is not None
+            and result["p99_ms"] < 1000.0)
+    finally:
+        chaos.reset()
+        b.close(timeout=5.0)
+        stop_exporter()
+        os.environ.pop("MXNET_WATCHDOG_S", None)
+        os.environ.pop("MXNET_WATCHDOG_DIR", None)
+        shutil.rmtree(dump_dir, ignore_errors=True)
+    return result
+
+
+# ---------------------------------------------------------------------------
+# scenario 4: SIGKILL mid-scan-window, bit-identical resume
+# ---------------------------------------------------------------------------
+_SCAN_VICTIM = """
+import os, sys
+import numpy as np
+import mxnet_tpu as mx
+import mxnet_tpu.chaos  # arms the kill at window 3 from MXNET_CHAOS
+from mxnet_tpu import io as mxio
+from mxnet_tpu.checkpoint import CheckpointManager
+
+ckdir = sys.argv[1]
+K = int(os.environ["MXNET_SCAN_STEPS"])
+mgr = CheckpointManager(ckdir, async_save=False, keep_last=0)
+saved = set()
+
+def boundary_save(param):
+    mod = param.locals["self"]
+    step = mod._optimizer.num_update
+    if step % K == 0 and step not in saved:
+        saved.add(step)
+        mgr.save_module(mod, step, block=True)
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import chaos_scan_common as common
+common.fit(boundary_save)
+print("FINISHED", flush=True)  # must never print: the kill fires first
+"""
+
+_SCAN_COMMON = """
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import io as mxio
+
+N, FEAT, BATCH = 256, 20, 16
+
+def mlp():
+    d = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(d, num_hidden=32, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, num_hidden=10, name="fc2")
+    return mx.sym.SoftmaxOutput(h, name="softmax")
+
+def init_params(seed=5):
+    rng = np.random.RandomState(seed)
+    return {"fc1_weight": mx.nd.array(rng.randn(32, FEAT) * 0.1),
+            "fc1_bias": mx.nd.zeros((32,)),
+            "fc2_weight": mx.nd.array(rng.randn(10, 32) * 0.1),
+            "fc2_bias": mx.nd.zeros((10,))}
+
+def dataset():
+    rng = np.random.RandomState(3)
+    x = rng.randn(N, FEAT).astype(np.float32)
+    y = rng.randint(0, 10, N).astype(np.float32)
+    return x, y
+
+OPT = {"learning_rate": 0.05, "momentum": 0.9}
+
+def fit(batch_end_callback=None, start_batch=0, module=None):
+    mx.random.seed(0)
+    x, y = dataset()
+    x, y = x[start_batch * BATCH:], y[start_batch * BATCH:]
+    it = mxio.NDArrayIter(mx.nd.array(x), mx.nd.array(y),
+                          batch_size=BATCH, label_name="softmax_label")
+    mod = module or mx.mod.Module(mlp(), context=mx.cpu())
+    kwargs = {} if module is not None else {
+        "arg_params": {k: v.copy() for k, v in init_params().items()}}
+    mod.fit(it, num_epoch=1, optimizer="sgd",
+            optimizer_params=dict(OPT), eval_metric="acc",
+            batch_end_callback=batch_end_callback, **kwargs)
+    params, _ = mod.get_params()
+    return mod, {k: v.asnumpy() for k, v in params.items()}
+"""
+
+
+def scenario_sigkill_mid_scan(workdir, scan_k=4, timeout=180.0):
+    """A K-step scanned fit SIGKILLs itself (chaos ``kill``) before its
+    third window dispatches; the parent restores the last boundary
+    checkpoint and continues the fit — the final weights must be
+    BIT-IDENTICAL to an uninterrupted run."""
+    import numpy as np
+
+    from ..checkpoint import CheckpointManager, latest_step
+
+    workdir = str(workdir)
+    os.makedirs(workdir, exist_ok=True)
+    with open(os.path.join(workdir, "chaos_scan_common.py"), "w") as f:  # graftlint: disable=torn-write -- ephemeral scenario script, single consumer
+        f.write(_SCAN_COMMON)
+    victim = os.path.join(workdir, "scan_victim.py")
+    with open(victim, "w") as f:  # graftlint: disable=torn-write -- ephemeral scenario script, single consumer
+        f.write(_SCAN_VICTIM)
+    ckdir = os.path.join(workdir, "ckpt")
+
+    result = {"ok": False}
+    # windows 1 and 2 run (boundaries K and 2K committed); the kill arm
+    # fires as window 3 is about to stage — "mid-window" by construction
+    proc = subprocess.Popen(
+        [sys.executable, victim, ckdir],
+        env=_child_env(MXNET_SCAN_STEPS=scan_k, MXNET_FUSED_STEP=1,
+                       MXNET_CHAOS="train/scan_window=kill:hits=3"),
+        stdout=subprocess.PIPE, text=True)
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    result["victim_exit"] = proc.returncode
+    result["victim_finished"] = "FINISHED" in (out or "")
+    resume_step = latest_step(ckdir)
+    result["resume_step"] = resume_step
+    if resume_step != 2 * scan_k or result["victim_finished"]:
+        return result
+
+    # run the scenario's fit shapes in-process: the uninterrupted
+    # reference, then the boundary-restore continuation
+    sys.path.insert(0, workdir)
+    try:
+        import importlib
+
+        import chaos_scan_common as common
+        importlib.reload(common)
+        os.environ["MXNET_SCAN_STEPS"] = str(scan_k)
+        os.environ["MXNET_FUSED_STEP"] = "1"
+        try:
+            _ref_mod, ref_params = common.fit()
+
+            mgr = CheckpointManager(ckdir, async_save=False, keep_last=0)
+            mod, _ckpt = mgr.restore_module(resume_step)
+            mgr.close()
+            _mod, resumed = common.fit(start_batch=resume_step,
+                                       module=mod)
+        finally:
+            os.environ.pop("MXNET_SCAN_STEPS", None)
+            os.environ.pop("MXNET_FUSED_STEP", None)
+    finally:
+        sys.path.remove(workdir)
+    diverged = [k for k in ref_params
+                if not np.array_equal(ref_params[k], resumed[k])]
+    result["diverged_params"] = diverged
+    result["ok"] = bool(result["victim_exit"] == -9 and not diverged)
+    return result
+
+
+def run_all(workdir=None, verbose=True):
+    """Run the four composed scenarios sequentially; returns
+    {name: result dict}.  The smoke asserts every ``ok``."""
+    base = workdir or tempfile.mkdtemp(prefix="mx-chaos-")
+    results = {}
+    scenarios = [
+        ("worker_kill_revive",
+         lambda: scenario_worker_kill_revive(os.path.join(base, "s1"))),
+        ("corrupt_reload_under_load",
+         lambda: scenario_corrupt_reload_under_load(
+             os.path.join(base, "s2"))),
+        ("wedged_batcher", scenario_wedged_batcher),
+        ("sigkill_mid_scan",
+         lambda: scenario_sigkill_mid_scan(os.path.join(base, "s4"))),
+    ]
+    for name, fn in scenarios:
+        t0 = time.perf_counter()
+        chaos.reset()
+        try:
+            results[name] = fn()
+        finally:
+            chaos.reset()
+        results[name]["elapsed_s"] = round(time.perf_counter() - t0, 1)
+        if verbose:
+            print(f"[chaos] {name}: "
+                  f"{'OK' if results[name].get('ok') else 'FAIL'} "
+                  f"({results[name]['elapsed_s']}s)", flush=True)
+    return results

@@ -37,6 +37,8 @@ import time
 
 import numpy as np
 
+from ..chaos.failpoints import failpoint as _failpoint
+from ..chaos.failpoints import failpoint_bytes as _failpoint_bytes
 from .core import (MANIFEST, SCHEMA_VERSION, TMP_SUFFIX, Checkpoint,
                    CheckpointCorruptError, CheckpointError,
                    CheckpointNotFoundError, _fsync_path, _sha256,
@@ -178,6 +180,7 @@ class CheckpointManager:
         if self.host_id == 0:
             self._sweep_stale()
         self._stats_data = {"saves": 0, "failures": 0, "gc_removed": 0,
+                            "gc_errors": 0,
                             "last_save_blocking_ms": None,
                             "last_save_total_ms": None,
                             "last_save_bytes": None,
@@ -321,6 +324,7 @@ class CheckpointManager:
     # -- the write/commit protocol ------------------------------------------
     def _write_step(self, job):
         t0 = time.perf_counter()
+        _failpoint("checkpoint/writer/pre_tmp_write")
         delay_s = _cfg("MXNET_CKPT_WRITE_DELAY_MS") / 1e3
         final = step_dir(self.directory, job.step)
         tmp = final + TMP_SUFFIX
@@ -367,6 +371,7 @@ class CheckpointManager:
             f.flush()
             os.fsync(f.fileno())
         files = {data_name: {"sha256": sha.hexdigest(), "bytes": offset}}
+        _failpoint("checkpoint/writer/post_tmp_write")
 
         if self.num_hosts > 1:
             self._write_shard_manifest(tmp, files, tensor_entries,
@@ -399,12 +404,18 @@ class CheckpointManager:
             manifest["symbol"] = symbol_file
         if delay_s:
             time.sleep(delay_s)
-        with open(os.path.join(tmp, MANIFEST), "w") as f:
-            json.dump(manifest, f, indent=1)
+        # the chaos bytes hook lets a scenario corrupt the manifest as
+        # written (the verify path must catch it at restore/poll time)
+        raw_manifest = _failpoint_bytes(
+            "checkpoint/writer/manifest",
+            json.dumps(manifest, indent=1).encode("utf-8"))
+        with open(os.path.join(tmp, MANIFEST), "wb") as f:
+            f.write(raw_manifest)
             f.flush()
             os.fsync(f.fileno())
         _fsync_path(tmp)
 
+        _failpoint("checkpoint/writer/pre_rename")
         # the commit point: after this rename (atomic on POSIX) the step
         # is discoverable; before it, latest() cannot see it
         if os.path.isdir(final):
@@ -498,29 +509,72 @@ class CheckpointManager:
             os.replace(tmp, f"{prefix}-{job.step:04d}.states")
 
     def _gc(self):
-        """Delete committed steps outside the retention policy."""
+        """Delete committed steps outside the retention policy.
+
+        Best-effort by contract (ISSUE 8 satellite): a rename/rmtree
+        failure must never fail the commit that triggered this GC — it
+        is logged, counted in ``gc_errors`` and the
+        ``mxnet_ckpt_gc_errors_total`` telemetry lane, and retried on
+        the next commit (including leftover ``.gc`` trash directories
+        whose contents could not be unlinked last time).
+        """
         if self.keep_last <= 0:
             return
-        steps = committed_steps(self.directory)
-        keep = set(steps[-self.keep_last:])
-        if self.keep_every > 0:
-            keep.update(s for s in steps if s % self.keep_every == 0)
-        removed = 0
-        for s in steps:
-            if s in keep:
-                continue
-            path = step_dir(self.directory, s)
-            trash = path + ".gc"
-            try:
-                os.rename(path, trash)  # instantly invisible to latest()
-                shutil.rmtree(trash, ignore_errors=True)
+        removed = errors = 0
+        try:
+            steps = committed_steps(self.directory)
+            keep = set(steps[-self.keep_last:])
+            if self.keep_every > 0:
+                keep.update(s for s in steps if s % self.keep_every == 0)
+            # leftover trash from earlier failed removals retries first
+            trash_dirs = [os.path.join(self.directory, n)
+                          for n in os.listdir(self.directory)
+                          if n.endswith(".gc")]
+            for s in steps:
+                if s in keep:
+                    continue
+                path = step_dir(self.directory, s)
+                trash = path + ".gc"
+                try:
+                    _failpoint("checkpoint/gc/remove")
+                    os.rename(path, trash)  # instantly invisible to latest()
+                except OSError as e:
+                    errors += 1
+                    self.logger.warning(
+                        "checkpoint: GC of step %d failed (%s); the step "
+                        "stays; retrying on the next commit", s, e)
+                    continue
                 removed += 1
-            except OSError:
-                pass
+                trash_dirs.append(trash)
+            for trash in trash_dirs:
+                shutil.rmtree(trash, ignore_errors=True)
+                if os.path.isdir(trash):
+                    errors += 1
+                    self.logger.warning(
+                        "checkpoint: GC could not fully remove %s; "
+                        "retrying on the next commit", trash)
+        except Exception as e:  # noqa: BLE001 — GC must never fail a commit
+            errors += 1
+            self.logger.warning("checkpoint: retention GC pass failed "
+                                "(%s: %s); retrying on the next commit",
+                                type(e).__name__, e)
         if removed:
             with self._lock:
                 self._stats_data["gc_removed"] += removed
             self._record_counter("checkpoint:gc_removed", removed)
+        if errors:
+            with self._lock:
+                self._stats_data["gc_errors"] += errors
+            try:
+                from .. import telemetry as _telemetry
+                _telemetry.REGISTRY.counter(
+                    "mxnet_ckpt_gc_errors_total",
+                    "checkpoint retention-GC removal failures (best-"
+                    "effort: logged and retried on the next commit, "
+                    "never failing the commit itself)").inc(
+                        errors, labels={"directory": self.directory})
+            except Exception:  # graftlint: disable=swallowed-error -- best-effort metrics must never fail a save
+                pass
 
     @staticmethod
     def _record_counter(name, value):

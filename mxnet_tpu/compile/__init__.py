@@ -27,8 +27,8 @@ from __future__ import annotations
 
 from .. import telemetry as _telemetry
 from .cache import (active_dir, cache_dir, cache_root,
-                    ensure_persistent_cache, prune_stale, stale_namespaces,
-                    version_key)
+                    ensure_persistent_cache, guarded_compile, prune_stale,
+                    quarantine_active, stale_namespaces, version_key)
 from .ledger import LEDGER, TraceLedger, record_trace
 from .planner import (clear_ladders, ladder_for, ladders, load_ladder,
                       padding_waste, plan_for, plan_ladder, pow2_ladder,
@@ -41,9 +41,11 @@ __all__ = [
     "LEDGER", "STATS", "ShapeStats", "TraceLedger", "active_dir",
     "aot_compile", "bucket_feed_signature", "cache_dir", "cache_root",
     "clear_ladders", "clear_warmed", "ensure_persistent_cache",
-    "ladder_for", "ladders", "load_ladder", "mark_warmed", "note_retrace",
+    "guarded_compile", "ladder_for", "ladders", "load_ladder",
+    "mark_warmed", "note_retrace",
     "padding_waste", "plan_for", "plan_ladder", "pow2_ladder",
-    "prune_stale", "record_trace", "sample_signature", "save_ladder",
+    "prune_stale", "quarantine_active", "record_trace",
+    "sample_signature", "save_ladder",
     "set_ladder", "snapshot", "stale_namespaces", "stats",
     "version_key", "warm_version", "warmed_signatures",
 ]

@@ -158,6 +158,77 @@ def prune_stale():
     return removed
 
 
+def quarantine_active(reason=""):
+    """Move the ACTIVE artifact namespace into ``<root>/quarantine/`` and
+    detach jax from it (fresh compiles from here on; a process restart
+    re-activates against a clean directory).
+
+    This is the self-healing response to a corrupt/truncated persisted
+    executable (ISSUE 8): jax's cache granularity hides WHICH entry
+    failed to deserialize, so the whole namespace is quarantined — the
+    artifacts survive for offline diagnosis, and nothing in the bad
+    namespace is ever looked up again.  Returns the quarantine path, or
+    None when no cache was active.
+    """
+    global _active, _resolved
+    with _lock:
+        active = _active
+        if active is None:
+            return None
+        _active = None
+        _resolved = True  # stay detached for the rest of the process
+    dest_root = os.path.join(cache_root(), "quarantine")
+    os.makedirs(dest_root, exist_ok=True)
+    dest = os.path.join(
+        dest_root, f"{os.path.basename(active)}.{os.getpid()}")
+    try:
+        os.rename(active, dest)
+    except OSError as e:
+        log.warning("compile cache: could not quarantine %r (%s); "
+                    "detaching anyway", active, e)
+        dest = None
+    try:
+        import jax
+        jax.config.update("jax_compilation_cache_dir", None)
+        from jax._src import compilation_cache as _cc
+        _cc.reset_cache()
+    except Exception as e:  # noqa: BLE001 — detach is best-effort; fresh compiles still work
+        log.warning("compile cache: detach from jax failed: %s", e)
+    try:
+        from .. import telemetry as _telemetry
+        _telemetry.REGISTRY.counter(
+            "mxnet_compile_cache_quarantined_total",
+            "persistent compile-cache namespaces quarantined after an "
+            "artifact failed to load").inc()
+    except Exception:  # graftlint: disable=swallowed-error -- accounting must not mask the quarantine
+        pass
+    log.error("compile cache: quarantined artifact namespace %r -> %r%s; "
+              "falling back to fresh compiles", active, dest,
+              f" ({reason})" if reason else "")
+    return dest
+
+
+def guarded_compile(fn, what="compile"):
+    """Run ``fn()`` (a trace/compile/first-forward); if it raises while
+    the persistent compilation cache is active, quarantine the namespace
+    (corrupt/truncated artifacts are the prime suspect) and retry ONCE
+    against fresh compiles.  With no cache active the error propagates
+    unchanged — there is nothing to heal.
+    """
+    from ..chaos.failpoints import failpoint
+    try:
+        failpoint("compile/cache/artifact")
+        return fn()
+    except Exception as e:
+        if active_dir() is None:
+            raise
+        log.warning("compile cache: %s failed with the persistent cache "
+                    "active (%s: %s) — quarantining and recompiling "
+                    "fresh", what, type(e).__name__, e)
+        quarantine_active(f"{what}: {type(e).__name__}: {e}")
+        return fn()
+
+
 def _reset_for_tests():
     """Forget the resolved state so a test can re-activate against a
     fresh directory; restores jax's cache defaults."""

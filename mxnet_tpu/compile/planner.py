@@ -175,10 +175,22 @@ def save_ladder(model, version, ladder, meta=None):
     return path
 
 
+_warned_corrupt_ladders = set()  # paths already WARNed about (once each)
+
+
 def load_ladder(model):
-    """(ladder tuple, payload dict) from the persisted plan, or None."""
+    """(ladder tuple, payload dict) from the persisted plan, or None.
+
+    A corrupt/truncated plan file is quarantined (renamed to
+    ``<path>.corrupt``) with ONE warning naming the path, and the caller
+    falls back stats -> pow2 exactly as if no plan existed — a torn
+    write from a killed process must never propagate a
+    ``JSONDecodeError`` into ``bucket_batch`` (ISSUE 8 satellite).
+    """
+    from ..chaos.failpoints import failpoint as _failpoint
     path = _ladder_path(model)
     try:
+        _failpoint("compile/ladder/load")
         with open(path) as f:
             payload = json.load(f)
         ladder = tuple(sorted(int(b) for b in payload["ladder"]))
@@ -188,8 +200,17 @@ def load_ladder(model):
     except FileNotFoundError:
         return None
     except Exception as e:  # noqa: BLE001 — a corrupt plan plans fresh
-        log.warning("ignoring corrupt ladder plan %r: %s: %s",
-                    path, type(e).__name__, e)
+        with _lock:
+            warned = path in _warned_corrupt_ladders
+            _warned_corrupt_ladders.add(path)
+        if not warned:
+            log.warning("corrupt persisted ladder plan %r (%s: %s); "
+                        "quarantined — planning falls back to "
+                        "stats -> pow2", path, type(e).__name__, e)
+        try:
+            os.replace(path, path + ".corrupt")
+        except OSError:
+            pass  # already moved/removed by a concurrent loader
         return None
 
 

@@ -157,7 +157,11 @@ def warm_version(cache, model, mv, ctx, max_batch, sample_signature=None,
                               model=model, reason="warmup")
             with entry.lock:
                 if not entry._hot:
-                    aot_compile(entry.executor)
+                    from .cache import guarded_compile
+                    guarded_compile(
+                        lambda e=entry: aot_compile(e.executor),
+                        what=f"AOT warmup of {model} v{mv.version} "
+                             f"bucket {b}")
                     # then walk the REAL request path once on zeros: the
                     # input-buffer writes jit a per-shape setitem helper
                     # and the forward's backend compile is a persistent-

@@ -100,6 +100,14 @@ _register("MXNET_KVSTORE_MAX_FRAME", int, 1 << 30,
 _register("MXNET_KVSTORE_HEARTBEAT_INTERVAL", float, 5.0,
           "worker heartbeat period in seconds (0 disables); feeds "
           "get_num_dead_node")
+_register("MXNET_KVSTORE_RETRIES", int, 3,
+          "bounded retry budget for kvstore client RPCs on transport "
+          "failures (reconnect + resend with exponential backoff and "
+          "jitter); 0 fails on the first error.  Sync pushes retried "
+          "after a lost REPLY are at-least-once — see docs/chaos.md")
+_register("MXNET_KVSTORE_RETRY_BACKOFF_S", float, 0.05,
+          "base backoff for kvstore client RPC retries; attempt i "
+          "sleeps base * 2^i * (1 + jitter)")
 _register("MXNET_OPTIMIZER_AGGREGATION_SIZE", int, 4,
           "weights per aggregated multi_sgd_* dispatch in the SGD "
           "optimizer (0 disables; parity: reference sgd.py)")
@@ -183,6 +191,21 @@ _register("MXNET_PROFILER_AUTOSTART", bool, False,
 _register("MXNET_PROFILER_MODE", str, "",
           "with AUTOSTART: 'all'/'1' also enables profile_all + "
           "profile_api (parity: reference MXNET_PROFILER_MODE)")
+# -- chaos / fault injection -------------------------------------------------
+_register("MXNET_CHAOS", str, "",
+          "failpoint arm spec: ';'-separated "
+          "site=action[(value)][:hits=N][:count=M][:prob=P] arms "
+          "(actions: raise/delay/wedge/corrupt/kill; docs/chaos.md "
+          "grammar + site catalog); empty disables every failpoint "
+          "with zero behavior change")
+_register("MXNET_CHAOS_SEED", int, 0,
+          "seed for the per-site chaos random streams (prob triggers, "
+          "corrupt-byte positions) — same spec + same seed replays the "
+          "same fault schedule")
+_register("MXNET_CHAOS_WEDGE_TIMEOUT_S", float, 60.0,
+          "a wedge failpoint left unreleased raises ChaosInjectedError "
+          "after this long instead of hanging forever (the no-scenario-"
+          "ends-in-a-hang contract)")
 # -- telemetry ---------------------------------------------------------------
 _register("MXNET_TELEMETRY", bool, False,
           "enable the telemetry span tracer + per-train-step lane "
@@ -252,6 +275,11 @@ _register("MXNET_SERVING_NUM_WORKERS", int, 1,
 _register("MXNET_SERVING_TIMEOUT_MS", float, 0.0,
           "default per-request timeout (queued past this -> "
           "RequestTimeoutError); 0 disables")
+_register("MXNET_SERVING_WORKER_RESTARTS", int, 8,
+          "DynamicBatcher: how many times a crashed batch worker thread "
+          "is restarted in place (its in-flight batch fails with a "
+          "retryable ServingWorkerError) before the batcher gives up "
+          "and fails fast instead of hanging; 0 = never restart")
 _register("MXNET_SERVING_EXECUTOR_CACHE", int, 32,
           "LRU capacity of the compiled-executor cache, in (model, "
           "version, bucketed-shape) entries")
@@ -361,6 +389,10 @@ _register("BENCH_COLD_START", bool, True,
           "bench.py: also measure cold_start_first_request_ms — warm "
           "restart (persistent compile cache) vs cold cache dir, in "
           "fresh subprocesses on the CPU backend; needs no TPU relay")
+_register("BENCH_CHAOS", bool, True,
+          "bench.py: also measure degraded_p99_ms — serving p99 with "
+          "one wedged batcher worker vs healthy (gate: <= 3x healthy "
+          "p99 while shedding); pure-host phase, needs no TPU relay")
 _register("BENCH_CKPT", bool, True,
           "bench.py: also measure checkpoint save-blocking time and "
           "restore latency (ckpt_save_blocking_ms / ckpt_restore_s)")

@@ -91,6 +91,8 @@ def stage_batch(batch, ctx):
     import time as _time
 
     from . import telemetry as _telemetry
+    from .chaos.failpoints import failpoint as _failpoint
+    _failpoint("io/stage")
     staged_bytes = [0]
 
     def put(arrs):
@@ -186,6 +188,8 @@ def stage_super_batch(batches, ctx):
             "super-batch staging: ctx %s has no jax device (%s: %s); "
             "using default placement", ctx, type(e).__name__, e)
         dev = None
+    from .chaos.failpoints import failpoint as _failpoint
+    _failpoint("io/stage")
     t0 = _time.perf_counter()
     staged_bytes = [0]
 

@@ -17,14 +17,18 @@ from __future__ import annotations
 
 import hmac
 import hashlib
+import logging
 import os
 import pickle
+import random
 import socket
 import struct
 import threading
 import time
 
 import numpy as np
+
+from .chaos.failpoints import failpoint as _failpoint
 
 # pickle frames execute code on load: every frame carries an HMAC-SHA256 of
 # the body keyed by MXNET_KVSTORE_AUTH_TOKEN, VERIFIED BEFORE deserializing.
@@ -276,6 +280,12 @@ class KVServer:
                     _send_msg(conn, {"ok": True, "value": val},
                               self.auth_token)
             elif op == "heartbeat":
+                try:
+                    _failpoint("kvstore/server/heartbeat")
+                except Exception as e:  # noqa: BLE001 — injected fault
+                    logging.getLogger("mxnet_tpu.kvstore").warning(
+                        "chaos: dropping heartbeat connection (%s)", e)
+                    break
                 with self._lock:
                     self._heartbeats[int(msg["rank"])] = time.monotonic()
                 _send_msg(conn, {"ok": True}, self.auth_token)
@@ -401,6 +411,10 @@ class KVClient:
         self._timeout = timeout
         self.sock = self._connect(timeout)
         self._lock = threading.Lock()
+        self._closed = False
+        # retry jitter stream: seeded by rank so a worker fleet's retry
+        # storms decorrelate deterministically
+        self._retry_rng = random.Random(1 + int(rank))
         # heartbeat loop announcing liveness (ps-lite van heartbeats) on
         # its OWN connection — a barrier or versioned pull can block the
         # main RPC socket for up to 100s and must not stall liveness.
@@ -461,6 +475,7 @@ class KVClient:
                               "timeout": timeout})["value"])
 
     def close(self):
+        self._closed = True  # retry loops must not resurrect the socket
         self._hb_stop.set()
         # close sockets so the server-side handler threads unblock. The
         # heartbeat socket stays SET (not None) so a racing heartbeat()
@@ -474,34 +489,80 @@ class KVClient:
                     pass
         # shutdown OUTSIDE self._lock: an in-flight RPC (e.g. a barrier
         # blocked in recv for up to 120s) holds the lock — shutdown aborts
-        # that recv immediately instead of waiting it out
+        # that recv immediately instead of waiting it out.  _closed (set
+        # above) keeps the retry loop from reconnecting the aborted RPC.
         try:
+            # graftlint: disable=lock-discipline -- deliberate bare read: aborting the in-flight recv is the point, and _closed fences the retry path
             self.sock.shutdown(socket.SHUT_RDWR)
         except OSError:
             pass
         try:
+            # graftlint: disable=lock-discipline -- same deliberate bare read as the shutdown above
             self.sock.close()
         except OSError:
             pass
 
     def _rpc(self, msg):
-        with self._lock:
-            _send_msg(self.sock, msg)
-            resp = _recv_msg(self.sock)
-        if resp is None or not resp.get("ok"):
-            raise RuntimeError(f"kvstore server rpc failed: {resp}")
-        return resp
+        return self._rpc_many([msg])[0]
 
-    def _rpc_many(self, msgs):
-        """Pipelined round-trips: send every request, then drain the
-        replies — one lock hold, one in-flight window (used by big-array
-        chunk push/pull so chunking doesn't serialize latency)."""
+    def _attempt(self, msgs):
+        """One locked send-all + drain-all pass.  Transport failures
+        (socket errors, timeouts, a peer close mid-reply) surface as
+        OSError/ConnectionError for the retry loop in :meth:`_rpc_many`;
+        protocol failures (bad MAC, oversized frame) stay RuntimeError
+        and are never retried."""
         with self._lock:
             for m in msgs:
+                _failpoint("kvstore/client/rpc")
                 _send_msg(self.sock, m)
             resps = [_recv_msg(self.sock) for _ in msgs]
+        if any(r is None for r in resps):
+            raise ConnectionError("kvstore server closed the connection")
+        return resps
+
+    def _rpc_many(self, msgs):
+        """Pipelined round-trips with bounded retry (one lock hold, one
+        in-flight window — big-array chunking doesn't serialize latency).
+
+        Transport failures reconnect and resend with exponential backoff
+        + seeded jitter, at most ``MXNET_KVSTORE_RETRIES`` extra
+        attempts, then raise — bounded, never a silent hang (ISSUE 8).
+        Caveat: a reply lost AFTER the server processed a sync push is
+        retried as at-least-once; the deterministic chaos scenarios
+        inject before the send, where the retry is exact.
+        """
+        from .config import get as _cfg
+        retries = max(0, int(_cfg("MXNET_KVSTORE_RETRIES")))
+        base = float(_cfg("MXNET_KVSTORE_RETRY_BACKOFF_S"))
+        attempt = 0
+        while True:
+            try:
+                resps = self._attempt(msgs)
+                break
+            except (OSError, ConnectionError) as e:
+                if self._closed:
+                    raise RuntimeError(
+                        "kvstore client is closed") from e
+                if attempt >= retries:
+                    raise RuntimeError(
+                        f"kvstore server rpc failed after {attempt + 1} "
+                        f"attempt(s): {type(e).__name__}: {e}") from e
+                delay = base * (2 ** attempt) * \
+                    (1.0 + self._retry_rng.random())
+                logging.getLogger("mxnet_tpu.kvstore").warning(
+                    "worker %d: rpc transport failure (%s: %s); retry "
+                    "%d/%d in %.0f ms", self.rank, type(e).__name__, e,
+                    attempt + 1, retries, delay * 1e3)
+                time.sleep(delay)
+                with self._lock:
+                    try:
+                        self.sock.close()
+                    except OSError:
+                        pass
+                    self.sock = self._connect(self._timeout)
+                attempt += 1
         for resp in resps:
-            if resp is None or not resp.get("ok"):
+            if not resp.get("ok"):
                 raise RuntimeError(f"kvstore server rpc failed: {resp}")
         return resps
 

@@ -273,6 +273,11 @@ class BaseModule:
             batches, tail = pending
             outs = False
             if len(batches) == W and not self._scan_disabled:
+                # the SIGKILL-mid-scan-window scenario arms a kill here:
+                # deterministically between the last boundary's host
+                # control and the next window's dispatch
+                from .chaos.failpoints import failpoint as _chaos_fp
+                _chaos_fp("train/scan_window")
                 with timeline.lane("h2d_stage"):
                     sbatch = mx_io.stage_super_batch(batches, ctx)
                 try:

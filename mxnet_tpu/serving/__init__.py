@@ -12,7 +12,8 @@ timeouts, graceful drain, and p50/p90/p99 serving metrics exported
 through the profiler counter lanes.  See docs/serving.md.
 """
 from .batcher import (DynamicBatcher, RequestTimeoutError, ServeFuture,
-                      ServingClosedError, ServingOverloadError)
+                      ServingClosedError, ServingOverloadError,
+                      ServingWorkerError)
 from .executor_cache import (CachedExecutor, ExecutorCache,
                              bind_inference_executor, bucket_batch,
                              feed_signature, pad_to, shape_signature,
@@ -24,7 +25,8 @@ from .server import ModelServer
 __all__ = [
     "CachedExecutor", "DynamicBatcher", "ExecutorCache", "ModelRepository",
     "ModelServer", "RequestTimeoutError", "ServeFuture", "ServingClosedError",
-    "ServingMetrics", "ServingOverloadError", "bind_inference_executor",
+    "ServingMetrics", "ServingOverloadError", "ServingWorkerError",
+    "bind_inference_executor",
     "bucket_batch", "feed_signature", "pad_to", "shape_signature",
     "shared_cache", "stats",
 ]

@@ -38,6 +38,7 @@ import time
 import numpy as np
 
 from ..base import MXNetError
+from ..chaos.failpoints import failpoint as _failpoint
 from ..telemetry import watchdog as _watchdog
 from .metrics import ServingMetrics
 
@@ -76,23 +77,63 @@ class ServingClosedError(MXNetError):
         super().__init__(f"serving[{batcher}]: server is shut down")
 
 
-class ServeFuture:
-    """Minimal future for one request (threading.Event based)."""
+class ServingWorkerError(MXNetError):
+    """A batch worker thread died executing this request's batch.
 
-    __slots__ = ("_event", "_result", "_exc")
+    ``retryable`` is True: the request itself was well-formed — the
+    worker crashed around it (and was restarted, budget permitting), so
+    resubmitting is the right client response.  When the restart budget
+    is exhausted the batcher fails fast with this error too
+    (``exhausted=True``) rather than letting requests queue into a hang.
+    """
+
+    retryable = True
+
+    def __init__(self, batcher, cause=None, exhausted=False):
+        self.batcher = batcher
+        self.cause = cause
+        self.exhausted = exhausted
+        if exhausted:
+            msg = (f"serving[{batcher}]: worker restart budget exhausted "
+                   "(MXNET_SERVING_WORKER_RESTARTS); batcher failed fast "
+                   "— requests are rejected, never silently queued")
+        else:
+            msg = (f"serving[{batcher}]: worker thread died executing "
+                   f"this batch ({type(cause).__name__}: {cause}); the "
+                   "worker was restarted — retry the request")
+        super().__init__(msg)
+
+
+class ServeFuture:
+    """Minimal future for one request (threading.Event based).
+
+    Resolution is first-write-wins: a request failed from OUTSIDE its
+    worker (the in-flight sweep failing requests stuck on a wedged
+    thread) must not be re-resolved when that thread eventually comes
+    back and reports its stale outcome.
+    """
+
+    __slots__ = ("_event", "_result", "_exc", "_resolve_lock")
 
     def __init__(self):
         self._event = threading.Event()
         self._result = None
         self._exc = None
+        self._resolve_lock = threading.Lock()
 
     def _set_result(self, value):
-        self._result = value
-        self._event.set()
+        with self._resolve_lock:
+            if self._event.is_set():
+                return
+            self._result = value
+            self._event.set()
 
     def _set_exception(self, exc):
-        self._exc = exc
-        self._event.set()
+        with self._resolve_lock:
+            if self._event.is_set():
+                return
+            self._exc = exc
+            self._event.set()
 
     def done(self):
         return self._event.is_set()
@@ -102,9 +143,11 @@ class ServeFuture:
             raise MXNetError(
                 f"serving: no response within {timeout}s (request still "
                 "queued or executing)")
-        if self._exc is not None:
-            raise self._exc
-        return self._result
+        with self._resolve_lock:
+            exc, value = self._exc, self._result
+        if exc is not None:
+            raise exc
+        return value
 
 
 class _Request:
@@ -166,6 +209,16 @@ class DynamicBatcher:
         self._queue = collections.deque()
         self._cond = threading.Condition()
         self._closed = False
+        # worker self-healing: a crashed worker restarts in place until
+        # the budget runs dry, then the batcher fails fast (never hangs)
+        self._restart_budget = int(cfg("MXNET_SERVING_WORKER_RESTARTS"))
+        self._restarts = 0
+        self._failed = False
+        # batches claimed by a worker but not yet finished, by worker
+        # thread ident — the sweep fails their expired-deadline requests
+        # with RequestTimeoutError when the claiming thread is wedged
+        # (a wedged worker must never silently hold requests forever)
+        self._inflight = {}
         self._workers = [
             threading.Thread(target=self._worker_loop, daemon=True,
                              name=f"mx-serving-{name}-{i}")
@@ -202,7 +255,11 @@ class DynamicBatcher:
         deadline = (time.perf_counter() + timeout_ms / 1e3
                     if timeout_ms > 0 else None)
         req = _Request(inputs, sig, deadline)
+        _failpoint("serving/batcher/submit")
         with self._cond:
+            if self._failed:
+                self.metrics.incr("rejected_total")
+                raise ServingWorkerError(self.name, exhausted=True)
             if self._closed:
                 self.metrics.incr("rejected_total")
                 raise ServingClosedError(self.name)
@@ -213,6 +270,7 @@ class DynamicBatcher:
                                            self.shed_watermark)
             self._queue.append(req)
             self.metrics.gauge("queue_depth", len(self._queue))
+            self._sweep_inflight_locked()
             self._cond.notify()
         self.metrics.incr("requests_total")
         return req.future
@@ -225,6 +283,9 @@ class DynamicBatcher:
         with self._cond:
             while not self._queue and not self._closed:
                 self._cond.wait(0.05)
+                # idle tick: an otherwise-quiet batcher still fails
+                # expired requests stuck on a wedged sibling worker
+                self._sweep_inflight_locked()
             if not self._queue:
                 return []
             batch = [self._queue.popleft()]
@@ -240,15 +301,99 @@ class DynamicBatcher:
                     break
                 self._cond.wait(remaining)
             self.metrics.gauge("queue_depth", len(self._queue))
+            self._sweep_inflight_locked()
             return batch
+
+    def _sweep_inflight_locked(self):
+        """Fail expired-deadline requests held by OTHER (wedged) worker
+        threads — called under ``self._cond`` from the live paths, so a
+        worker stuck in compile/execute never turns its claimed batch
+        into silently-lost requests.  First-write-wins futures make the
+        eventual resolution from the stuck thread a no-op."""
+        now = time.perf_counter()
+        me = threading.get_ident()
+        timeouts = 0
+        # graftlint: disable=lock-discipline -- callers hold self._cond (the _locked suffix is the contract, as in _take_batch/submit)
+        for ident, batch in self._inflight.items():
+            if ident == me:
+                continue
+            for req in batch:
+                if req.deadline is not None and now > req.deadline and \
+                        not req.future.done():
+                    waited = (now - req.t_enqueue) * 1e3
+                    timeout = (req.deadline - req.t_enqueue) * 1e3
+                    req.future._set_exception(RequestTimeoutError(
+                        self.name, waited, timeout))
+                    timeouts += 1
+        if timeouts:
+            self.metrics.incr("timeouts_total", timeouts)
 
     def _worker_loop(self):
         while True:
-            batch = self._take_batch()
-            if not batch:
-                return  # closed and drained
-            with _watchdog.arm(f"serving/{self.name}"):
-                self._run_batch(batch)
+            batch = []
+            try:
+                batch = self._take_batch()
+                if not batch:
+                    return  # closed and drained
+                with self._cond:
+                    self._inflight[threading.get_ident()] = batch
+                try:
+                    with _watchdog.arm(f"serving/{self.name}"):
+                        # the chaos hook sits INSIDE the watchdog arm: a
+                        # wedge here is exactly a runner stuck in compile
+                        # — the watchdog must see (and name) it
+                        _failpoint("serving/batcher/worker")
+                        self._run_batch(batch)
+                finally:
+                    with self._cond:
+                        self._inflight.pop(threading.get_ident(), None)
+            except BaseException as e:  # noqa: BLE001 — worker self-healing
+                if not self._survive_crash(batch, e):
+                    return
+
+    def _survive_crash(self, batch, exc):
+        """A worker thread crashed OUTSIDE the per-cohort error fences
+        (runner errors are already fanned out per request by
+        ``_run_batch``).  Fail the in-flight batch with a retryable
+        typed error, restart in place while the budget lasts; when it
+        runs dry, fail everything queued and refuse new submits —
+        a dying worker must never become a silent hang."""
+        import logging
+        log = logging.getLogger("mxnet_tpu.serving")
+        err = ServingWorkerError(self.name, cause=exc)
+        for req in batch:
+            if not req.future.done():
+                req.future._set_exception(err)
+        if batch:
+            self.metrics.incr("errors_total", len(batch))
+        with self._cond:
+            self._restarts += 1
+            restarts = self._restarts
+            self.metrics.incr("worker_restarts_total")
+            exhausted = restarts > self._restart_budget
+            if exhausted:
+                self._failed = True
+                doomed = list(self._queue)
+                self._queue.clear()
+                self.metrics.gauge("queue_depth", 0)
+                self._cond.notify_all()
+        if not exhausted:
+            log.warning(
+                "serving[%s]: worker died (%s: %s); restarting in place "
+                "(%d/%d restarts used)", self.name, type(exc).__name__,
+                exc, restarts, self._restart_budget)
+            return True
+        log.error(
+            "serving[%s]: worker restart budget (%d) exhausted — failing "
+            "%d queued request(s) and rejecting new submits", self.name,
+            self._restart_budget, len(doomed))
+        fail = ServingWorkerError(self.name, exhausted=True)
+        for req in doomed:
+            if not req.future.done():
+                req.future._set_exception(fail)
+        if doomed:
+            self.metrics.incr("errors_total", len(doomed))
+        return False
 
     def _run_batch(self, batch):
         """Execute one taken batch (hang-watchdog armed by the caller:

@@ -140,10 +140,15 @@ class CachedExecutor:
                 outs = ex.forward(is_train=False)
             else:
                 # cold entry: this forward carries the trace + backend
-                # compile — charge it to the model
+                # compile — charge it to the model.  guarded_compile is
+                # the corrupt-artifact fence: a persisted executable
+                # that fails to load quarantines the cache namespace and
+                # recompiles fresh instead of failing the request
                 from .. import compile as _compile
                 with _compile.LEDGER.attribute(str(self.model)):
-                    outs = ex.forward(is_train=False)
+                    outs = _compile.guarded_compile(
+                        lambda: ex.forward(is_train=False),
+                        what=f"first forward of {self.key!r}")
                 self._hot = True
             # one device->host transfer per OUTPUT TENSOR (not per
             # request) — the batching already amortized the sync

@@ -22,6 +22,7 @@ from __future__ import annotations
 import threading
 
 from ..base import MXNetError
+from ..chaos.failpoints import failpoint as _failpoint
 
 
 def _strip_prefixes(param_dict):
@@ -95,6 +96,10 @@ class ModelRepository:
         self._latest = {}   # name -> int
         self._watchers = {}  # name -> (thread, stop Event)
         self._warm_hooks = []  # fn(name, _ModelVersion), pre-flip
+        # steps that failed checksum verification during poll_checkpoint,
+        # quarantined so the watcher never re-reads a known-corrupt step
+        # every poll interval: {(name, ckpt_dir): {step, ...}}
+        self._corrupt_steps = {}
 
     # -- publish-time warmup hooks ------------------------------------------
     def add_warm_hook(self, fn):
@@ -117,6 +122,7 @@ class ModelRepository:
             hooks = list(self._warm_hooks)
         for fn in hooks:
             try:
+                _failpoint("serving/repository/warm_hook")
                 fn(name, mv)
             except Exception:  # warm failure must never block the flip
                 logging.getLogger("mxnet_tpu.serving").exception(
@@ -223,24 +229,65 @@ class ModelRepository:
         verified before the version goes live, so a torn or corrupt
         checkpoint is never served (ISSUE 2 satellite).
 
+        A step that FAILS verification is quarantined (never re-read on
+        later polls), the ``mxnet_serving_corrupt_ckpt_total`` alarm
+        counter fires, and the poll degrades to the next-newest good
+        committed step — the currently served version keeps serving
+        either way, and the watch thread never wedges on a corrupt step
+        (ISSUE 8 self-healing).
+
         The warm hooks run BEFORE the new version registers: a version
         swap under load compiles its whole bucket ladder first, so the
         flip never serves a cold-compile request (ISSUE 7 satellite).
         """
-        from ..checkpoint import latest_step, restore
-        from ..symbol import load_json
-        step = latest_step(ckpt_dir)
+        from ..checkpoint import committed_steps, restore
+        from ..checkpoint.core import CheckpointCorruptError
+        _failpoint("serving/repository/poll")
         with self._lock:
             current = self._latest.get(name, 0)
-        if step is None or step <= current:
-            return None
-        ckpt = restore(ckpt_dir, step=step)  # verifies checksums
+            bad = set(self._corrupt_steps.get((name, ckpt_dir), ()))
+        candidates = [s for s in committed_steps(ckpt_dir)
+                      if s > current and s not in bad]
+        for step in sorted(candidates, reverse=True):
+            try:
+                ckpt = restore(ckpt_dir, step=step)  # verifies checksums
+            except CheckpointCorruptError as e:
+                self._quarantine_step(name, ckpt_dir, step, e)
+                continue  # degrade to the next-newest good step
+            return self._load_checkpoint_version(name, ckpt)
+        return None
+
+    def _quarantine_step(self, name, ckpt_dir, step, exc):
+        """Remember a corrupt step so no later poll re-reads it, and
+        raise the alarm counter — this is an operator page, not a retry
+        loop (docs/observability.md alarm catalog)."""
+        import logging
+        with self._lock:
+            self._corrupt_steps.setdefault((name, ckpt_dir),
+                                           set()).add(step)
+        from .. import telemetry as _telemetry
+        _telemetry.REGISTRY.counter(
+            "mxnet_serving_corrupt_ckpt_total",
+            "checkpoint steps that failed verification during serving "
+            "hot-reload polls (quarantined; the old version kept "
+            "serving)").inc(labels={"model": str(name)})
+        logging.getLogger("mxnet_tpu.serving").error(
+            "watch(%r): checkpoint step %d in %r failed verification "
+            "(%s) — step quarantined, serving continues on the current "
+            "version", name, step, ckpt_dir, exc)
+
+    def corrupt_steps(self, name, ckpt_dir):
+        """Steps quarantined by poll_checkpoint for (name, ckpt_dir)."""
+        with self._lock:
+            return sorted(self._corrupt_steps.get((name, ckpt_dir), ()))
+
+    def _load_checkpoint_version(self, name, ckpt):
+        from ..symbol import load_json
         if ckpt.symbol_json is None:
             raise MXNetError(
-                f"repository.watch: checkpoint step {ckpt.step} in "
-                f"{ckpt_dir!r} holds no symbol — save it via "
-                "CheckpointManager.save_module (or pass symbol=) so the "
-                "server knows the graph")
+                f"repository.watch: checkpoint step {ckpt.step} holds "
+                "no symbol — save it via CheckpointManager.save_module "
+                "(or pass symbol=) so the server knows the graph")
         params = {}
         params.update(ckpt.arg_params)
         params.update(ckpt.aux_params)

@@ -5,7 +5,12 @@ ephemeral one — used by tests/smoke) on a daemon thread and serves:
 
 * ``GET /metrics``       — ``telemetry.prometheus_dump()`` (text 0.0.4)
 * ``GET /snapshot.json`` — the full ``telemetry.snapshot()`` as JSON
-* ``GET /healthz``       — ``ok`` (liveness)
+* ``GET /healthz``       — liveness an orchestrator can act on: 200
+  ``ok`` normally; **503** naming the stalled section while a watchdog
+  stall episode is active (an armed section fired and has not
+  progressed since), or after a chaos ``kill`` arm fired (the process
+  is doomed/marked) — so a wedged-but-running worker gets restarted
+  instead of serving dead air (ISSUE 8 satellite).
 
 Auto-start: importing :mod:`mxnet_tpu.telemetry` with
 ``MXNET_TELEMETRY_PORT`` set starts the endpoint; loopback-only by
@@ -39,11 +44,16 @@ class _Handler(BaseHTTPRequestHandler):
                               sort_keys=True).encode("utf-8")
             ctype = "application/json"
         elif path == "/healthz":
-            body, ctype = b"ok\n", "text/plain"
+            body, ctype, status = _health()
+            self._reply(status, body, ctype)
+            return
         else:
             self.send_error(404, "try /metrics, /snapshot.json, /healthz")
             return
-        self.send_response(200)
+        self._reply(200, body, ctype)
+
+    def _reply(self, status, body, ctype):
+        self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
@@ -51,6 +61,28 @@ class _Handler(BaseHTTPRequestHandler):
 
     def log_message(self, fmt, *args):
         log.debug("exporter: " + fmt, *args)
+
+
+def _health():
+    """(body, content-type, status) for /healthz.  503 while a watchdog
+    stall episode is active (body names the stalled section, so an
+    orchestrator's restart log is a diagnosis) or after a chaos
+    ``kill`` arm fired; 200 otherwise."""
+    from . import watchdog
+    stalled = watchdog.stalled_sections()
+    fatal = None
+    try:
+        from ..chaos.failpoints import fatal_site
+        fatal = fatal_site()
+    except Exception as e:  # noqa: BLE001 — liveness must not depend on chaos importing
+        log.debug("healthz: chaos state unavailable: %s", e)
+    if fatal is not None:
+        return (f"fatal: chaos kill fired at {fatal}\n".encode("utf-8"),
+                "text/plain", 503)
+    if stalled:
+        return (("stalled: " + ", ".join(stalled) + "\n").encode("utf-8"),
+                "text/plain", 503)
+    return b"ok\n", "text/plain", 200
 
 
 def start_exporter(port=None):

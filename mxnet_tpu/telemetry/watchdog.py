@@ -121,6 +121,18 @@ def fires():
         return _state["fires"]
 
 
+def stalled_sections():
+    """Armed sections currently in a stall episode: the watchdog fired
+    for them and no progress (beat / re-arm / exit) has happened since.
+    The episode ends the moment the section beats or exits — this is
+    what ``/healthz`` keys its 503 on (docs/observability.md)."""
+    with _lock:
+        return sorted(
+            name for name, e in _entries.items()
+            if e["armed"] > 0 and e["fired_count"] is not None
+            and e["fired_count"] == e["count"])
+
+
 def last_dump():
     """Path of the most recent dump file (None before any fire)."""
     with _lock:

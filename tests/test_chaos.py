@@ -1,0 +1,514 @@
+"""Chaos harness (ISSUE 8): failpoints, self-healing, composed scenarios.
+
+Three layers:
+
+* **failpoint mechanics** — spec grammar, the five actions, hit-count +
+  seeded determinism, the <1 us disabled bar, zero behavior change when
+  unarmed, the injections telemetry lane;
+* **self-healing** — batcher worker restart budget + in-flight sweep,
+  poll_checkpoint corrupt-step quarantine + alarm, kvstore bounded
+  retry with backoff, compile-cache quarantine fallback, checkpoint GC
+  best-effort, persisted-ladder corrupt-file fallback, /healthz stall
+  transitions;
+* **composed scenarios** — the four end-to-end outages from
+  chaos/harness.py, each asserted to end in recovery or a typed error
+  (never a hang, never a silently lost request/save).
+"""
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx  # noqa: F401  (pins the CPU backend via conftest)
+import mxnet_tpu.chaos as chaos
+from mxnet_tpu import telemetry
+from mxnet_tpu.chaos import harness
+from mxnet_tpu.chaos.failpoints import failpoint, failpoint_bytes
+
+
+@pytest.fixture(autouse=True)
+def _chaos_clean():
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+def _injections(site, action):
+    return telemetry.REGISTRY.counter(
+        "mxnet_chaos_injections_total").value(
+            labels={"site": site, "action": action})
+
+
+# -- failpoint mechanics -----------------------------------------------------
+def test_disabled_failpoint_noop_and_under_1us():
+    """Unarmed, a failpoint changes nothing and costs < 1 us — the same
+    bar as a disabled telemetry span, so the hooks stay in hot paths."""
+    assert failpoint("tests/nothing") is None
+    assert failpoint_bytes("tests/nothing", b"payload") == b"payload"
+    n = 100000
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            failpoint("tests/nothing")
+        best = min(best, (time.perf_counter() - t0) / n)
+    assert best < 1e-6, f"disabled failpoint costs {best * 1e9:.0f} ns"
+
+
+def test_spec_grammar():
+    armed = chaos.configure(
+        "a/b=raise(RuntimeError):hits=3:count=1;"
+        "c/d=delay(0.01);e/f=corrupt(truncate):prob=0.5")
+    assert armed == ["a/b", "c/d", "e/f"]
+    arms = chaos.arms()
+    assert arms["a/b"] == {"action": "raise", "value": "RuntimeError",
+                           "hits": 3, "count": 1, "prob": 1.0, "fired": 0}
+    assert arms["c/d"]["action"] == "delay"
+    assert arms["e/f"]["prob"] == 0.5
+    assert chaos.configure("") == []
+    for bad in ("nosuchsyntax", "a/b=explode", "a/b=raise:bogus=1",
+                "a/b=raise(x"):
+        with pytest.raises(chaos.ChaosSpecError):
+            chaos.configure(bad)
+
+
+def test_raise_action_typed_and_builtin():
+    chaos.arm("t/typed", "raise")
+    with pytest.raises(chaos.ChaosInjectedError) as ei:
+        failpoint("t/typed")
+    assert ei.value.site == "t/typed" and ei.value.retryable
+    chaos.arm("t/builtin", "raise", value="OSError")
+    with pytest.raises(OSError):
+        failpoint("t/builtin")
+
+
+def test_hit_count_trigger_is_deterministic():
+    chaos.arm("t/hits", "raise", hits=3, count=2)
+    failpoint("t/hits")
+    failpoint("t/hits")
+    for _ in range(2):  # hits 3 and 4 fire (count=2), then disarm
+        with pytest.raises(chaos.ChaosInjectedError):
+            failpoint("t/hits")
+    failpoint("t/hits")  # consumed: armed state fully drained
+    assert not chaos.active()
+    assert _injections("t/hits", "raise") >= 2
+
+
+def test_prob_trigger_replays_with_seed(monkeypatch):
+    def schedule():
+        chaos.reset()
+        chaos.arm("t/prob", "raise", prob=0.5)
+        fired = []
+        for i in range(64):
+            try:
+                failpoint("t/prob")
+                fired.append(False)
+            except chaos.ChaosInjectedError:
+                fired.append(True)
+        return fired
+
+    a, b = schedule(), schedule()
+    assert a == b, "seeded prob trigger must replay identically"
+    assert any(a) and not all(a)
+
+
+def test_corrupt_bytes_deterministic_and_truncate():
+    payload = bytes(range(256)) * 8
+    chaos.arm("t/bytes", "corrupt")
+    one = failpoint_bytes("t/bytes", payload)
+    chaos.reset()
+    chaos.arm("t/bytes", "corrupt")
+    two = failpoint_bytes("t/bytes", payload)
+    assert one == two != payload and len(one) == len(payload)
+    chaos.reset()
+    chaos.arm("t/trunc", "corrupt", value="truncate")
+    assert failpoint_bytes("t/trunc", payload) == payload[:len(payload) // 2]
+    # corrupt armed on a non-bytes site is a typed error, not silence
+    chaos.arm("t/nobytes", "corrupt")
+    with pytest.raises(chaos.ChaosInjectedError):
+        failpoint("t/nobytes")
+
+
+def test_wedge_release_and_timeout():
+    chaos.arm("t/wedge", "wedge", count=1)
+    entered = threading.Event()
+    done = threading.Event()
+
+    def wedged():
+        entered.set()
+        failpoint("t/wedge")
+        done.set()
+
+    t = threading.Thread(target=wedged, daemon=True)
+    t.start()
+    assert entered.wait(5) and not done.wait(0.3), "wedge did not hold"
+    chaos.release("t/wedge")
+    assert done.wait(5), "release did not unwedge"
+    # an unreleased wedge RAISES after its timeout — never a hang
+    chaos.reset()
+    chaos.arm("t/wedge2", "wedge", timeout=0.2)
+    t0 = time.perf_counter()
+    with pytest.raises(chaos.ChaosInjectedError):
+        failpoint("t/wedge2")
+    assert time.perf_counter() - t0 < 5
+
+
+def test_kill_mark_records_fatal_site():
+    assert chaos.fatal_site() is None
+    chaos.arm("t/kill", "kill", value="mark")
+    failpoint("t/kill")
+    assert chaos.fatal_site() == "t/kill"
+    chaos.reset()
+    assert chaos.fatal_site() is None
+
+
+# -- serving self-healing ----------------------------------------------------
+def _echo_runner(feed, n_real):
+    return [feed["x"] * 2.0]
+
+
+def test_worker_death_restarts_with_retryable_error():
+    from mxnet_tpu.serving.batcher import DynamicBatcher, ServingWorkerError
+    chaos.arm("serving/batcher/worker", "raise", count=1)
+    b = DynamicBatcher(_echo_runner, max_batch_size=4, max_latency_ms=1,
+                       num_workers=1, name="t-restart")
+    try:
+        with pytest.raises(ServingWorkerError) as ei:
+            b.submit({"x": np.ones(3, np.float32)}).result(10)
+        assert ei.value.retryable and not ei.value.exhausted
+        # the worker restarted in place: the retry succeeds
+        out = b.submit({"x": np.ones(3, np.float32)}).result(10)
+        np.testing.assert_array_equal(out[0], 2 * np.ones(3, np.float32))
+        assert b.metrics.get("worker_restarts_total") == 1
+    finally:
+        b.close()
+
+
+def test_worker_restart_budget_fails_fast(monkeypatch):
+    from mxnet_tpu.serving.batcher import DynamicBatcher, ServingWorkerError
+    monkeypatch.setenv("MXNET_SERVING_WORKER_RESTARTS", "2")
+    chaos.arm("serving/batcher/worker", "raise")  # every pass dies
+    b = DynamicBatcher(_echo_runner, max_batch_size=4, max_latency_ms=1,
+                       num_workers=1, name="t-budget")
+    try:
+        seen_exhausted = False
+        for _ in range(6):
+            try:
+                b.submit({"x": np.ones(3, np.float32)}).result(10)
+            except ServingWorkerError as e:
+                seen_exhausted = seen_exhausted or e.exhausted
+            time.sleep(0.02)
+        deadline = time.time() + 5
+        while not seen_exhausted and time.time() < deadline:
+            try:
+                b.submit({"x": np.ones(3, np.float32)}).result(10)
+            except ServingWorkerError as e:
+                seen_exhausted = e.exhausted
+        assert seen_exhausted, "budget exhaustion never surfaced typed"
+        with pytest.raises(ServingWorkerError) as ei:
+            b.submit({"x": np.ones(3, np.float32)})
+        assert ei.value.exhausted
+    finally:
+        chaos.reset()
+        b.close(timeout=2)
+
+
+def test_wedged_worker_requests_resolve_typed():
+    """Requests claimed by a wedged worker resolve as RequestTimeoutError
+    via the in-flight sweep — never silently lost, and the stale
+    resolution from the resumed thread is a no-op (first-write-wins)."""
+    from mxnet_tpu.serving.batcher import (DynamicBatcher,
+                                           RequestTimeoutError)
+    chaos.arm("serving/batcher/worker", "wedge", hits=1, count=1)
+    b = DynamicBatcher(_echo_runner, max_batch_size=4, max_latency_ms=1,
+                       num_workers=2, name="t-wedge")
+    try:
+        doomed = b.submit({"x": np.ones(3, np.float32)}, timeout_ms=200)
+        time.sleep(0.1)  # let a worker claim + wedge on it
+        # the healthy worker keeps serving AND sweeps the wedged batch
+        for _ in range(10):
+            b.submit({"x": np.ones(3, np.float32)}).result(10)
+        with pytest.raises(RequestTimeoutError):
+            doomed.result(10)
+        chaos.release("serving/batcher/worker")
+        time.sleep(0.2)  # resumed worker re-resolves: must be a no-op
+        with pytest.raises(RequestTimeoutError):
+            doomed.result(0.1)
+    finally:
+        chaos.release("serving/batcher/worker")
+        b.close(timeout=5)
+
+
+def test_poll_checkpoint_quarantines_corrupt_step(tmp_path):
+    """A corrupt newer step: poll keeps the served version, raises the
+    alarm counter, quarantines the step (no re-read next poll), and
+    still picks up the next GOOD step."""
+    from mxnet_tpu.checkpoint import CheckpointManager
+    from mxnet_tpu.checkpoint.core import step_dir
+    from mxnet_tpu.serving import ModelRepository
+    sym, params = harness._tiny_model()
+    repo = ModelRepository()
+    ckdir = str(tmp_path)
+    with CheckpointManager(ckdir, async_save=False, keep_last=0) as mgr:
+        mgr.save(1, arrays=params, symbol=sym, block=True)
+        assert repo.poll_checkpoint("m", ckdir) == 1
+        mgr.save(2, arrays=params, symbol=sym, block=True)
+        data = [n for n in os.listdir(step_dir(ckdir, 2))
+                if n.startswith("data-")][0]
+        with open(os.path.join(step_dir(ckdir, 2), data), "r+b") as f:
+            f.seek(4)
+            f.write(b"\x00\xff\x00\xff")
+        alarm = telemetry.REGISTRY.counter(
+            "mxnet_serving_corrupt_ckpt_total")
+        before = alarm.value(labels={"model": "m"})
+        assert repo.poll_checkpoint("m", ckdir) is None
+        assert repo.latest_version("m") == 1  # old version kept serving
+        assert repo.corrupt_steps("m", ckdir) == [2]
+        assert alarm.value(labels={"model": "m"}) == before + 1
+        # quarantined: the next poll does not re-read (and re-alarm) it
+        assert repo.poll_checkpoint("m", ckdir) is None
+        assert alarm.value(labels={"model": "m"}) == before + 1
+        mgr.save(3, arrays=params, symbol=sym, block=True)
+        assert repo.poll_checkpoint("m", ckdir) == 3
+
+
+# -- kvstore self-healing ----------------------------------------------------
+def test_kvstore_client_bounded_retry(monkeypatch):
+    from mxnet_tpu.kvstore_server import KVClient, KVServer
+    monkeypatch.setenv("MXNET_KVSTORE_RETRY_BACKOFF_S", "0.01")
+    port = 19851
+    server = KVServer(port=port, num_workers=1)
+    threading.Thread(target=server.run, daemon=True).start()
+    time.sleep(0.2)
+    cl = None
+    try:
+        cl = KVClient("127.0.0.1", port, rank=0, num_workers=1,
+                      heartbeat_interval=0)
+        cl.init("w", np.zeros(4, np.float32))
+        # two transient transport faults heal inside the default budget
+        chaos.arm("kvstore/client/rpc", "raise",
+                  value="ConnectionError", count=2)
+        cl.push("w", np.ones(4, np.float32), sync=False)
+        np.testing.assert_array_equal(cl.pull("w"),
+                                      np.ones(4, np.float32))
+        # more faults than the budget: typed failure, quickly, no hang
+        chaos.reset()
+        monkeypatch.setenv("MXNET_KVSTORE_RETRIES", "1")
+        chaos.arm("kvstore/client/rpc", "raise", value="ConnectionError")
+        t0 = time.perf_counter()
+        with pytest.raises(RuntimeError, match="after 2 attempt"):
+            cl.pull("w")
+        assert time.perf_counter() - t0 < 5
+        chaos.reset()
+        # healed again once the fault clears
+        np.testing.assert_array_equal(cl.pull("w"),
+                                      np.ones(4, np.float32))
+    finally:
+        chaos.reset()
+        if cl is not None:
+            cl.close()
+        server._stop.set()
+
+
+def test_kvstore_server_heartbeat_failpoint_marks_dead(monkeypatch):
+    """Dropping heartbeats server-side (failpoint) surfaces the worker
+    as dead through the existing detection path."""
+    from mxnet_tpu.kvstore_server import KVClient, KVServer
+    port = 19853
+    server = KVServer(port=port, num_workers=1)
+    threading.Thread(target=server.run, daemon=True).start()
+    time.sleep(0.2)
+    cl = hb = None
+    try:
+        hb = KVClient("127.0.0.1", port, rank=0, num_workers=1,
+                      heartbeat_interval=0.05)
+        cl = KVClient("127.0.0.1", port, rank=0, num_workers=1,
+                      heartbeat_interval=0)
+        time.sleep(0.2)
+        assert cl.num_dead_node(timeout=1.0) == 0
+        chaos.arm("kvstore/server/heartbeat", "raise")
+        deadline = time.time() + 15
+        while cl.num_dead_node(timeout=0.3) < 1:
+            assert time.time() < deadline, "dead worker never detected"
+            time.sleep(0.1)
+    finally:
+        chaos.reset()
+        for c in (hb, cl):
+            if c is not None:
+                try:
+                    c.close()
+                except Exception:
+                    pass
+        server._stop.set()
+
+
+# -- compile-cache / ladder self-healing -------------------------------------
+def test_guarded_compile_quarantines_and_recompiles(tmp_path, monkeypatch):
+    from mxnet_tpu import compile as mxc
+    from mxnet_tpu.compile import cache as cache_mod
+    monkeypatch.setenv("MXNET_COMPILE_CACHE_DIR", str(tmp_path))
+    monkeypatch.setenv("MXNET_COMPILE_CACHE_MIN_COMPILE_S", "0")
+    cache_mod._reset_for_tests()
+    try:
+        active = mxc.ensure_persistent_cache()
+        assert active and os.path.isdir(active)
+        counter = telemetry.REGISTRY.counter(
+            "mxnet_compile_cache_quarantined_total")
+        before = counter.value()
+        calls = []
+        chaos.arm("compile/cache/artifact", "raise", count=1)
+        out = mxc.guarded_compile(lambda: calls.append(1) or 42,
+                                  what="test compile")
+        assert out == 42 and calls == [1]  # injected BEFORE fn: one run
+        assert counter.value() == before + 1
+        assert mxc.active_dir() is None, "cache must detach"
+        qdir = os.path.join(str(tmp_path), "quarantine")
+        assert os.path.isdir(qdir) and os.listdir(qdir)
+        assert not os.path.isdir(active)
+        # with no cache active the error propagates unchanged
+        chaos.arm("compile/cache/artifact", "raise", count=1)
+        with pytest.raises(chaos.ChaosInjectedError):
+            mxc.guarded_compile(lambda: 1)
+    finally:
+        chaos.reset()
+        cache_mod._reset_for_tests()
+
+
+def test_corrupt_ladder_file_falls_back_with_one_warn(tmp_path,
+                                                      monkeypatch,
+                                                      caplog):
+    """ISSUE 8 satellite: a truncated ladders/<model>.json falls back
+    stats -> pow2 with ONE warning naming the path — never a
+    JSONDecodeError out of the planning path — and is quarantined."""
+    import logging
+    from mxnet_tpu import compile as mxc
+    from mxnet_tpu.compile import planner
+    monkeypatch.setenv("MXNET_COMPILE_CACHE_DIR", str(tmp_path))
+    model = "t-corrupt-ladder"
+    path = planner.save_ladder(model, 1, (3, 9, 16))
+    good = planner.load_ladder(model)
+    assert good is not None and good[0] == (3, 9, 16)
+    with open(path, "w") as f:
+        f.write('{"model": "t-corrupt-ladder", "ladder": [3, 9')  # torn
+    mxc.clear_ladders()
+    with caplog.at_level(logging.WARNING, logger="mxnet_tpu.compile"):
+        assert planner.load_ladder(model) is None
+        ladder = planner.plan_for(model, max_batch=16)
+    assert ladder == planner.pow2_ladder(16)  # stats empty -> pow2
+    warns = [r for r in caplog.records if path in r.getMessage()]
+    assert len(warns) == 1, "exactly one WARN naming the path"
+    assert os.path.exists(path + ".corrupt") and not os.path.exists(path)
+    # quarantined + warned-once: later loads are silent no-ops
+    caplog.clear()
+    with caplog.at_level(logging.WARNING, logger="mxnet_tpu.compile"):
+        assert planner.load_ladder(model) is None
+    assert not [r for r in caplog.records if path in r.getMessage()]
+
+
+# -- checkpoint GC best-effort -----------------------------------------------
+def test_ckpt_gc_failure_never_fails_commit(tmp_path):
+    """ISSUE 8 satellite: a GC removal failure (injected OSError — the
+    read-only-step-dir shape, which root test runs cannot reproduce via
+    chmod) is logged + counted, the commit succeeds, and the next
+    commit retries the removal."""
+    from mxnet_tpu.checkpoint import CheckpointManager
+    counter = telemetry.REGISTRY.counter("mxnet_ckpt_gc_errors_total")
+    before = counter.value(labels={"directory": str(tmp_path)})
+    with CheckpointManager(str(tmp_path), async_save=False,
+                           keep_last=1) as mgr:
+        arr = {"w": np.ones((8,), np.float32)}
+        mgr.save(1, arrays=arr, block=True)
+        chaos.arm("checkpoint/gc/remove", "raise", value="OSError",
+                  count=1)
+        mgr.save(2, arrays=arr, block=True)  # commit must succeed
+        assert mgr.steps() == [1, 2]  # step 1's removal failed, retained
+        assert mgr.stats()["gc_errors"] == 1
+        assert counter.value(
+            labels={"directory": str(tmp_path)}) == before + 1
+        mgr.save(3, arrays=arr, block=True)  # retry on the next commit
+        assert mgr.steps() == [3]
+        assert not [n for n in os.listdir(str(tmp_path))
+                    if n.endswith(".gc")]
+
+
+# -- /healthz liveness --------------------------------------------------------
+def test_healthz_stall_and_fatal_transitions(monkeypatch, tmp_path):
+    from mxnet_tpu.telemetry import watchdog as wd
+    from mxnet_tpu.telemetry.exporter import start_exporter, stop_exporter
+    monkeypatch.setenv("MXNET_WATCHDOG_S", "0.2")
+    monkeypatch.setenv("MXNET_WATCHDOG_DIR", str(tmp_path))
+
+    def get(port):
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=5) as r:
+                return r.status, r.read().decode()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read().decode()
+
+    port = start_exporter(0)
+    try:
+        code, body = get(port)
+        assert code == 200 and body == "ok\n"
+        with wd.arm("tests/healthz"):
+            deadline = time.time() + 10
+            while "tests/healthz" not in wd.stalled_sections():
+                assert time.time() < deadline, "watchdog never fired"
+                time.sleep(0.05)
+            code, body = get(port)
+            assert code == 503 and "tests/healthz" in body
+            wd.beat("tests/healthz")  # progress ends the stall episode
+            code, body = get(port)
+            assert code == 200 and body == "ok\n"
+        # a fired chaos kill arm (mark mode in-process) reads as fatal
+        chaos.arm("t/healthz-kill", "kill", value="mark")
+        failpoint("t/healthz-kill")
+        code, body = get(port)
+        assert code == 503 and "t/healthz-kill" in body
+        chaos.reset()
+        code, body = get(port)
+        assert code == 200
+    finally:
+        chaos.reset()
+        stop_exporter()
+
+
+# -- the four composed scenarios ---------------------------------------------
+def test_scenario_worker_kill_revive(tmp_path):
+    r = harness.scenario_worker_kill_revive(str(tmp_path / "s1"),
+                                            port=19861)
+    assert r["ok"], json.dumps(r, default=str)
+    assert r["victim_exit"] == -9
+    assert r["final_step"] > r["kill_step"]
+    assert r["converged"]
+
+
+def test_scenario_corrupt_reload_under_load(tmp_path):
+    r = harness.scenario_corrupt_reload_under_load(str(tmp_path / "s2"))
+    assert r["ok"], json.dumps(r, default=str)
+    assert r["non_shed_failures"] == []
+    assert r["version_during_corruption"] == 1
+    assert r["final_version"] == 3
+    assert r["alarm_count"] >= 1
+
+
+def test_scenario_wedged_batcher():
+    r = harness.scenario_wedged_batcher()
+    assert r["ok"], json.dumps(r, default=str)
+    assert r["watchdog_fired"] and r["dump_names_wedge"]
+    assert r["healthz_during_stall"][0] == 503
+    assert r["healthz_after_release"][0] == 200
+    assert r["non_typed_failures"] == []
+    assert r["p99_ms"] < 1000.0
+
+
+def test_scenario_sigkill_mid_scan(tmp_path):
+    r = harness.scenario_sigkill_mid_scan(str(tmp_path / "s4"))
+    assert r["ok"], json.dumps(r, default=str)
+    assert r["victim_exit"] == -9 and not r["victim_finished"]
+    assert r["diverged_params"] == []

@@ -395,6 +395,86 @@ def test_phase_timing_scope_is_per_function():
         lint(src, path="mxnet_tpu/module.py"))
 
 
+# -- naked-retry -------------------------------------------------------------
+NAKED_RETRY = """
+    import time
+
+    def fetch(op):
+        while True:
+            try:
+                return op()
+            except ConnectionError:
+                time.sleep(1.0)
+"""
+
+
+def test_naked_retry_flags_unbounded_constant_sleep():
+    findings = lint(NAKED_RETRY)
+    hits = [f for f in findings if f.rule == "naked-retry"]
+    assert len(hits) == 1
+    assert "backoff" in hits[0].message or "2^attempt" in hits[0].message
+    assert hits[0].symbol == "fetch:naked-retry"
+
+
+def test_naked_retry_near_miss_deadline_poll():
+    # the repo's deliberate poll idiom: constant sleep, but a clock
+    # compared against a deadline bounds the loop (raise/break escape)
+    src = """
+        import time
+
+        def wait_for(path, deadline):
+            import os
+            while not os.path.isdir(path):
+                if time.time() > deadline:
+                    raise TimeoutError(path)
+                time.sleep(0.05)
+    """
+    assert "naked-retry" not in rules_hit(lint(src))
+
+
+def test_naked_retry_near_miss_bounded_and_backoff():
+    # attempt-bounded for loop: silent
+    src_for = NAKED_RETRY.replace("while True:",
+                                  "for attempt in range(5):")
+    assert "naked-retry" not in rules_hit(lint(src_for))
+    # bounded while test (any comparison counts as a bound): silent
+    src_while = """
+        import time
+
+        def fetch(op):
+            n = 0
+            while n < 5:
+                try:
+                    return op()
+                except ConnectionError:
+                    n += 1
+                    time.sleep(1.0)
+    """
+    assert "naked-retry" not in rules_hit(lint(src_while))
+    # computed sleep (backoff/jitter shape): silent
+    src_backoff = """
+        import time, random
+
+        def fetch(op):
+            delay = 0.05
+            while True:
+                try:
+                    return op()
+                except ConnectionError:
+                    time.sleep(delay * (1 + random.random()))
+                    delay *= 2
+    """
+    assert "naked-retry" not in rules_hit(lint(src_backoff))
+
+
+def test_naked_retry_suppression():
+    src = NAKED_RETRY.replace(
+        "time.sleep(1.0)",
+        "time.sleep(1.0)  # graftlint: disable=naked-retry -- "
+        "daemon poller, lifetime is the process")
+    assert "naked-retry" not in rules_hit(lint(src))
+
+
 # -- env-knob-drift ----------------------------------------------------------
 def test_env_drift_flags_unregistered_read():
     rules = [EnvDriftRule(registered={"MXNET_GOOD"})]
@@ -569,7 +649,8 @@ def test_cli_json_and_list_rules(tmp_path):
     r = _cli("--list-rules")
     assert r.returncode == 0
     for rid in ("lock-discipline", "torn-write", "host-sync-in-hot-path",
-                "tracer-leak", "swallowed-error", "env-knob-drift"):
+                "tracer-leak", "swallowed-error", "env-knob-drift",
+                "naked-retry"):
         assert rid in r.stdout
 
 

@@ -268,7 +268,7 @@ def test_server_side_profiling(tmp_path):
 
     from mxnet_tpu import profiler
     from mxnet_tpu.kvstore_server import KVServer
-    port = 19671
+    port = 19677  # unique repo-wide: 19671 is test_failure_recovery's
     server = KVServer(port=port, num_workers=2)
     t = threading.Thread(target=server.run, daemon=True)
     t.start()
