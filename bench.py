@@ -638,6 +638,68 @@ def measure_cold_start():
         shutil.rmtree(cache_dir, ignore_errors=True)
 
 
+def measure_multichip():
+    """Relay-proof CPU phase for the mesh fused distributed step
+    (ISSUE 9): a subprocess forced to 8 fake CPU devices runs
+    ``python -m mxnet_tpu.parallel.fused --bench-json`` — a dp=2,tp=2
+    Module.fit with a dist_device_sync kvstore routed through the
+    donated shard_map window.
+
+    * ``multichip_dispatches_per_step`` — gate <= (1+eps)/K at
+      K=BENCH_MULTICHIP_K: one donated dispatch per K-step window.
+    * ``multichip_comm_blocking_pct`` — gate <= 30: the differential
+      between the bucketed-collective window and the same window with
+      collectives compiled out isolates communication's share of step
+      wall.
+    """
+    import subprocess
+
+    from mxnet_tpu import config as mxcfg
+
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               BENCH_MULTICHIP_K=str(mxcfg.get("BENCH_MULTICHIP_K")))
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # never touch the TPU relay
+    proc = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.parallel.fused",
+         "--bench-json"],
+        env=env, capture_output=True, text=True, timeout=600,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+    if proc.returncode != 0:
+        raise RuntimeError(f"multichip child failed: "
+                           f"{proc.stderr.strip()[-800:]}")
+    payload = json.loads(proc.stdout.strip().splitlines()[-1])
+    disp = payload["multichip_dispatches_per_step"]
+    blocking = payload["multichip_comm_blocking_pct"]
+    return {
+        "multichip_dispatch": {
+            "metric": "multichip_dispatches_per_step",
+            "value": disp,
+            "budget": payload["budget"],
+            "gate_pass": bool(disp <= payload["budget"]),
+            "k": payload["k"], "mesh": payload["mesh"],
+            "note": "Module.fit dispatches/step with a dist_device_sync "
+                    "kvstore on a dp=2,tp=2 fake-device mesh (one "
+                    "donated shard_map window per K steps; the "
+                    "per-param push/pull loop is off the hot path)",
+        },
+        "multichip_comm": {
+            "metric": "multichip_comm_blocking_pct",
+            "value": blocking,
+            "budget_pct": payload["blocking_budget_pct"],
+            "gate_pass": bool(blocking <= payload["blocking_budget_pct"]),
+            "step_ms": payload["step_ms"],
+            "step_ms_comm_off": payload["step_ms_comm_off"],
+            "comm_standalone_ms_per_step":
+                payload["comm_standalone_ms_per_step"],
+            "note": "share of mesh step wall attributable to the "
+                    "bucketed gradient collectives (differential vs "
+                    "MXNET_COLLECTIVE_MODE=off)",
+        },
+    }
+
+
 def measure_train_dispatch():
     """CPU-measurable perf signal for the fused train step (no TPU relay
     needed, unlike resnet50_train_img_per_sec which has been
@@ -939,6 +1001,24 @@ def main():
                         os.environ.pop(k, None)
                     else:
                         os.environ[k] = v
+
+        if _cfg0.get("BENCH_MULTICHIP"):
+            try:
+                result.update(measure_multichip())
+                md, mc = result["multichip_dispatch"], \
+                    result["multichip_comm"]
+                log(f"[multichip] {md['value']}/step dispatches at "
+                    f"K={md['k']} on {md['mesh']} (budget "
+                    f"{md['budget']}, "
+                    f"{'PASS' if md['gate_pass'] else 'FAIL'}); comm "
+                    f"blocking {mc['value']}% (budget "
+                    f"{mc['budget_pct']}%, "
+                    f"{'PASS' if mc['gate_pass'] else 'FAIL'})")
+            except Exception as e:
+                log(f"multichip phase failed: {type(e).__name__}: {e}")
+                result["multichip_dispatch"] = {
+                    "metric": "multichip_dispatches_per_step",
+                    "error": f"{type(e).__name__}: {e}"}
 
         if _cfg0.get("BENCH_COLD_START"):
             try:

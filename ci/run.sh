@@ -32,6 +32,14 @@ echo "== fused + scanned train step smoke (dispatch budget, parity) =="
 # bit-identical to the sequential fused loop (docs/perf_notes.md)
 JAX_PLATFORMS=cpu python -m mxnet_tpu.fused_step
 
+echo "== mesh fused step smoke (dp x tp fit: dispatch budget, kvstore-loop parity) =="
+# a dist_device_sync Module.fit on a dp=2,tp=2 fake-device mesh must run
+# each K=8 window as ONE donated shard_map dispatch (<= (1+eps)/K per
+# step) and stay bitwise identical — weights AND optimizer state — to
+# the sequential per-param kvstore push/pull loop (docs/parallel.md)
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  python -m mxnet_tpu.parallel.fused
+
 echo "== serving smoke (dynamic batcher, 64 concurrent clients) =="
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
   python -m mxnet_tpu.serving.smoke
@@ -52,11 +60,12 @@ echo "== compile smoke (persistent cache, ladder warmup, retrace ratchet) =="
 JAX_PLATFORMS=cpu python -m mxnet_tpu.compile.smoke
 
 echo "== chaos smoke (failpoints, composed fault scenarios, self-healing) =="
-# the four composed scenarios: kvstore worker kill/revive commits past
+# the five composed scenarios: kvstore worker kill/revive commits past
 # the kill, corrupt-checkpoint-under-reload serves the old version with
 # zero non-shed failures, a wedged batcher stays p99-bounded under a
-# named watchdog stall, and a mid-scan-window SIGKILL resumes
-# bit-identically; disabled-failpoint overhead must stay < 1us
+# named watchdog stall, a mid-scan-window SIGKILL resumes
+# bit-identically, and the stalled/killed mesh fused step self-heals +
+# resumes bit-identically onto a resized mesh; disabled-failpoint overhead must stay < 1us
 # (docs/chaos.md)
 JAX_PLATFORMS=cpu python -m mxnet_tpu.chaos.smoke
 

@@ -1,7 +1,9 @@
 """graftlint rule modules — importing this package registers every rule
 with the core registry (see ``core.register_rule``)."""
 from . import (env_drift, host_sync, lock_discipline, naked_retry,
-               phase_timing, swallowed_error, torn_write, tracer_leak)
+               per_param_collective, phase_timing, swallowed_error,
+               torn_write, tracer_leak)
 
 __all__ = ["env_drift", "host_sync", "lock_discipline", "naked_retry",
-           "phase_timing", "swallowed_error", "torn_write", "tracer_leak"]
+           "per_param_collective", "phase_timing", "swallowed_error",
+           "torn_write", "tracer_leak"]

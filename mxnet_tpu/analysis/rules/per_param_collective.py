@@ -1,0 +1,96 @@
+"""per-param-collective — per-parameter collective/transfer loops on
+distributed hot paths.
+
+ISSUE 9's mesh fused step is the canon: gradient synchronization runs
+as ONE ``psum``/``reduce_scatter`` per ``MXNET_COLLECTIVE_BUCKET_MB``-
+sized flat bucket *inside* the donated train-step program.  The
+anti-pattern this rule hunts is the loop that design retired::
+
+    for name in param_names:
+        kvstore.push(name, grads[name])      # one host round-trip
+        kvstore.pull(name, weights[name])    # ... per PARAMETER
+
+163 tiny transfers per ResNet-50 step serialize the host against the
+store/device once per parameter; bucketed/batched forms amortize them
+into a handful of large ones that XLA (or the wire) can pipeline.
+
+The rule fires when a ``push``/``pull``/``pushpull``/``psum``/
+``device_put``/``all_gather``/``ppermute`` call sits lexically inside a
+``for``/``while`` body (or comprehension) in the distributed hot paths
+(``parallel/``, ``kvstore*.py``, ``module.py``, ``model.py``).
+
+Near-misses stay silent:
+
+* batched forms — ``push_many`` / ``pull_many`` / ``init_many`` /
+  ``bucketed_all_reduce`` move many tensors per call by construction;
+* init-time loops — an enclosing function whose name mentions init /
+  broadcast / attach / restore / load / state runs once per
+  bind/resume, not once per step;
+* calls outside any loop — a single collective per step is the goal.
+
+Residual per-param paths kept deliberately (the loop the mesh step
+falls back to for ineligible setups) carry
+``# graftlint: disable=per-param-collective -- reason``.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule, register_rule
+
+# distributed hot paths: per-step collective loops here tax every step
+HOT_PATH_PREFIXES = (
+    "mxnet_tpu/parallel/",
+    "mxnet_tpu/kvstore",
+    "mxnet_tpu/module.py",
+    "mxnet_tpu/model.py",
+)
+
+# one tensor per call: the shapes the per-param loop is made of
+_COLLECTIVE_ATTRS = {"push", "pull", "pushpull", "psum", "psum_scatter",
+                     "device_put", "all_gather", "reduce_scatter",
+                     "ppermute"}
+# many tensors per call: the batched/bucketed near-miss forms
+_BATCHED_ATTRS = {"push_many", "pull_many", "init_many",
+                  "bucketed_all_reduce", "fsdp_bucket_update"}
+
+# an enclosing function with one of these tokens is setup, not hot path
+_INIT_TOKENS = ("init", "broadcast", "attach", "restore", "load",
+                "state", "checkpoint", "calibrate")
+
+
+@register_rule
+class PerParamCollectiveRule(Rule):
+    id = "per-param-collective"
+    severity = "warning"
+    doc = ("per-parameter push/pull/psum/device_put loop on a "
+           "distributed hot path — bucket or batch the transfers "
+           "(docs/parallel.md; the mesh fused step's flat buckets are "
+           "the template)")
+
+    def begin_file(self, ctx):
+        self._hot = any(p in ctx.path for p in HOT_PATH_PREFIXES)
+
+    def visit(self, node, ctx):
+        if not self._hot or not ctx.in_loop():
+            return
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)):
+            return
+        attr = node.func.attr
+        if attr in _BATCHED_ATTRS or attr not in _COLLECTIVE_ATTRS:
+            return
+        fname = ctx.func_name().lower()
+        if any(tok in fname for tok in _INIT_TOKENS):
+            return  # init/resume-time loop: once per bind, not per step
+        recv = ast.unparse(node.func.value)
+        ctx.report(
+            self, node,
+            f"{recv}.{attr}() inside a loop issues one collective/"
+            "transfer per iteration on a distributed hot path — "
+            "163 per-param round-trips is the tax the mesh fused step "
+            "retired; flatten the tensors into "
+            "MXNET_COLLECTIVE_BUCKET_MB-sized buckets (parallel/"
+            "fused.bucketed_all_reduce) or use the *_many batched "
+            "forms",
+            symbol=f"{ctx.func_name()}:{attr}")

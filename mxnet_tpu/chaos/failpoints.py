@@ -115,6 +115,11 @@ SITES = {
     "train/scan_window":
         "Module scanned fit, at each window boundary before the scan "
         "dispatch (kill here is the SIGKILL-mid-window scenario)",
+    "parallel/collective":
+        "mesh fused train step, at the host-side window boundary before "
+        "the donated shard_map dispatch (delay/wedge stalls the mesh "
+        "step under the watchdog's eye; kill + boundary-checkpoint "
+        "restore onto a RESIZED mesh is the elastic-resume scenario)",
 }
 
 

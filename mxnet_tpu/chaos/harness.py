@@ -24,6 +24,12 @@ tested alone:
 4. **SIGKILL mid-scan-window** — a K-step scanned fit dies between
    window boundaries; restore continues from the last boundary
    checkpoint bit-identically to an uninterrupted run.
+5. **mesh collective stall + kill-resize** — the mesh fused step's
+   ``parallel/collective`` boundary wedges (watchdog names the stalled
+   mesh step, the fit self-heals through the wedge timeout), then a
+   dp=4 mesh fit SIGKILLs mid-run and a boundary-checkpoint restore
+   onto a RESIZED dp=2 mesh continues bit-identically to a planned
+   resize (elastic restore as the resize mechanism).
 
 Every scenario ends in recovery or a typed error — the assertions
 include "no hang" (bounded waits everywhere) and "no silent loss"
@@ -604,6 +610,259 @@ def scenario_sigkill_mid_scan(workdir, scan_k=4, timeout=180.0):
     return result
 
 
+# ---------------------------------------------------------------------------
+# scenario 5: mesh collective stall + kill, restore onto a RESIZED mesh
+
+_MESH_COMMON = """
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import io as mxio
+from mxnet_tpu.parallel.mesh import make_mesh
+
+N, FEAT, BATCH = 128, 20, 16
+
+def mlp():
+    d = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(d, num_hidden=32, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, num_hidden=10, name="fc2")
+    return mx.sym.SoftmaxOutput(h, name="softmax")
+
+def init_params(seed=5):
+    rng = np.random.RandomState(seed)
+    return {"fc1_weight": mx.nd.array(rng.randn(32, FEAT) * 0.1),
+            "fc1_bias": mx.nd.zeros((32,)),
+            "fc2_weight": mx.nd.array(rng.randn(10, 32) * 0.1),
+            "fc2_bias": mx.nd.zeros((10,))}
+
+def dataset():
+    rng = np.random.RandomState(3)
+    x = rng.randn(N, FEAT).astype(np.float32)
+    y = rng.randint(0, 10, N).astype(np.float32)
+    return x, y
+
+OPT = {"learning_rate": 0.05, "momentum": 0.9}
+
+def fit(dp, batch_end_callback=None, start_batch=0, end_batch=None,
+        module=None):
+    mx.random.seed(0)
+    x, y = dataset()
+    stop = None if end_batch is None else end_batch * BATCH
+    x, y = x[start_batch * BATCH:stop], y[start_batch * BATCH:stop]
+    it = mxio.NDArrayIter(mx.nd.array(x), mx.nd.array(y),
+                          batch_size=BATCH, label_name="softmax_label")
+    mod = module or mx.mod.Module(mlp(), context=mx.cpu())
+    kwargs = {} if module is not None else {
+        "arg_params": {k: v.copy() for k, v in init_params().items()}}
+    with make_mesh(dp=dp):
+        mod.fit(it, num_epoch=1, optimizer="sgd",
+                optimizer_params=dict(OPT), eval_metric="acc",
+                kvstore="dist_device_sync",
+                batch_end_callback=batch_end_callback, **kwargs)
+    assert mod._mesh is not None, "mesh fused path did not engage"
+    params, _ = mod.get_params()
+    return mod, {k: v.asnumpy() for k, v in params.items()}
+"""
+
+_MESH_WEDGE = """
+import json, os, sys
+import mxnet_tpu as mx
+import mxnet_tpu.chaos  # arms the wedge from MXNET_CHAOS
+from mxnet_tpu.telemetry import watchdog
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import chaos_mesh_common as common
+common.fit(2)  # wedge releases via timeout -> scan path self-heals
+dump = watchdog.last_dump()
+txt = ""
+if dump and os.path.exists(dump):
+    with open(dump) as f:
+        txt = f.read()
+print("RESULT " + json.dumps({
+    "fires": watchdog.fires(),
+    "names_fit_section": "train/fit" in txt,
+    "names_collective_frame": "parallel/collective" in txt
+                              or "failpoints" in txt,
+}), flush=True)
+"""
+
+_MESH_VICTIM = """
+import os, sys
+import mxnet_tpu as mx
+import mxnet_tpu.chaos  # arms the kill at window 3 from MXNET_CHAOS
+from mxnet_tpu.checkpoint import CheckpointManager
+
+ckdir = sys.argv[1]
+K = int(os.environ["MXNET_SCAN_STEPS"])
+mgr = CheckpointManager(ckdir, async_save=False, keep_last=0)
+saved = set()
+
+def boundary_save(param):
+    mod = param.locals["self"]
+    step = mod._optimizer.num_update
+    if step % K == 0 and step not in saved:
+        saved.add(step)
+        mgr.save_module(mod, step, block=True)
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import chaos_mesh_common as common
+common.fit(4, boundary_save)
+print("FINISHED", flush=True)  # must never print: the kill fires first
+"""
+
+_MESH_REF = """
+import os, sys
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu.checkpoint import CheckpointManager
+
+ckdir, out = sys.argv[1], sys.argv[2]
+K = int(os.environ["MXNET_SCAN_STEPS"])
+S = 2 * K  # the boundary the victim dies after
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import chaos_mesh_common as common
+mgr = CheckpointManager(ckdir, async_save=False, keep_last=0)
+saved = set()
+
+def boundary_save(param):
+    mod = param.locals["self"]
+    step = mod._optimizer.num_update
+    if step % K == 0 and step not in saved:
+        saved.add(step)
+        mgr.save_module(mod, step, block=True)
+
+# the no-fault reference: dp=4 to the boundary, then a planned
+# restore-resize onto dp=2 for the rest — the exact trajectory the
+# faulted run must reproduce
+common.fit(4, boundary_save, end_batch=S)
+mod, _ckpt = mgr.restore_module(S)
+mgr.close()
+_m, params = common.fit(2, start_batch=S, module=mod)
+np.savez(out, **params)
+"""
+
+_MESH_RESUME = """
+import os, sys
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu.checkpoint import CheckpointManager
+
+ckdir, out = sys.argv[1], sys.argv[2]
+K = int(os.environ["MXNET_SCAN_STEPS"])
+S = 2 * K
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import chaos_mesh_common as common
+mgr = CheckpointManager(ckdir, async_save=False, keep_last=0)
+mod, _ckpt = mgr.restore_module(S)
+mgr.close()
+_m, params = common.fit(2, start_batch=S, module=mod)
+np.savez(out, **params)
+"""
+
+
+def scenario_mesh_collective_stall(workdir, scan_k=2, timeout=240.0):
+    """The mesh fused step under composed faults, two phases:
+
+    1. **stall**: the ``parallel/collective`` failpoint wedges the
+       window boundary of a dp=2 mesh fit; the hang watchdog must fire
+       naming the stalled mesh step (``train/fit`` section + the wedged
+       failpoint frame in the dump), the wedge timeout must turn the
+       stall into a typed error, and the fit must SELF-HEAL by falling
+       back to per-batch steps and completing.
+    2. **kill + resize**: a dp=4 mesh fit SIGKILLs itself (chaos
+       ``kill``) before its third window; a fresh process restores the
+       last boundary checkpoint onto a RESIZED dp=2 mesh and continues —
+       bit-identical to a no-fault run that performed the same planned
+       dp=4 → dp=2 restore-resize at that boundary (PR 2's elastic
+       restore as the resize mechanism).
+    """
+    import numpy as np
+
+    from ..checkpoint import latest_step
+
+    workdir = str(workdir)
+    os.makedirs(workdir, exist_ok=True)
+    for fname, src in (("chaos_mesh_common.py", _MESH_COMMON),
+                       ("mesh_wedge.py", _MESH_WEDGE),
+                       ("mesh_victim.py", _MESH_VICTIM),
+                       ("mesh_ref.py", _MESH_REF),
+                       ("mesh_resume.py", _MESH_RESUME)):
+        with open(os.path.join(workdir, fname), "w") as f:  # graftlint: disable=torn-write -- ephemeral scenario scripts, single consumer
+            f.write(src)
+    mesh_env = dict(
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+        MXNET_SCAN_STEPS=scan_k, MXNET_MESH_FUSED_STEP=1)
+    result = {"ok": False}
+
+    # phase 1: wedge the window boundary; watchdog names it, the fit
+    # self-heals through the wedge-timeout error
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(workdir, "mesh_wedge.py")],
+        env=_child_env(MXNET_CHAOS="parallel/collective=wedge:hits=2",
+                       MXNET_CHAOS_WEDGE_TIMEOUT_S=1.5,
+                       MXNET_WATCHDOG_S=0.3, MXNET_WATCHDOG_DIR=workdir,
+                       **mesh_env),
+        stdout=subprocess.PIPE, text=True)
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    result["wedge_exit"] = proc.returncode
+    payload = {}
+    for line in (out or "").splitlines():
+        if line.startswith("RESULT "):
+            payload = json.loads(line[len("RESULT "):])
+    result["wedge"] = payload
+    wedge_ok = (proc.returncode == 0 and payload.get("fires", 0) >= 1
+                and payload.get("names_fit_section")
+                and payload.get("names_collective_frame"))
+    result["wedge_ok"] = bool(wedge_ok)
+
+    # phase 2: kill before window 3, restore onto a resized mesh
+    ckdir = os.path.join(workdir, "ckpt")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(workdir, "mesh_victim.py"), ckdir],
+        env=_child_env(MXNET_CHAOS="parallel/collective=kill:hits=3",
+                       **mesh_env),
+        stdout=subprocess.PIPE, text=True)
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    result["victim_exit"] = proc.returncode
+    result["victim_finished"] = "FINISHED" in (out or "")
+    resume_step = latest_step(ckdir)
+    result["resume_step"] = resume_step
+    if resume_step != 2 * scan_k or result["victim_finished"]:
+        return result
+
+    def run_child(script, *args):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(workdir, script)] + list(args),
+            env=_child_env(**mesh_env), capture_output=True, text=True,
+            timeout=timeout)
+        if proc.returncode != 0:
+            raise RuntimeError(f"{script} failed: "
+                               f"{proc.stderr.strip()[-500:]}")
+
+    ref_out = os.path.join(workdir, "ref.npz")
+    res_out = os.path.join(workdir, "resumed.npz")
+    run_child("mesh_ref.py", ckdir + "-ref", ref_out)
+    run_child("mesh_resume.py", ckdir, res_out)
+    ref = dict(np.load(ref_out))
+    resumed = dict(np.load(res_out))
+    diverged = [k for k in ref
+                if not np.array_equal(ref[k], resumed[k])]
+    result["diverged_params"] = diverged
+    result["ok"] = bool(wedge_ok and result["victim_exit"] == -9
+                        and not diverged)
+    return result
+
+
 def run_all(workdir=None, verbose=True):
     """Run the four composed scenarios sequentially; returns
     {name: result dict}.  The smoke asserts every ``ok``."""
@@ -618,6 +877,8 @@ def run_all(workdir=None, verbose=True):
         ("wedged_batcher", scenario_wedged_batcher),
         ("sigkill_mid_scan",
          lambda: scenario_sigkill_mid_scan(os.path.join(base, "s4"))),
+        ("mesh_collective_stall",
+         lambda: scenario_mesh_collective_stall(os.path.join(base, "s5"))),
     ]
     for name, fn in scenarios:
         t0 = time.perf_counter()

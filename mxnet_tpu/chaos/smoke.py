@@ -1,4 +1,4 @@
-"""Chaos smoke for CI: replay the four composed fault scenarios.
+"""Chaos smoke for CI: replay the five composed fault scenarios.
 
 Asserted per scenario (the ISSUE 8 acceptance contract):
 
@@ -15,6 +15,11 @@ Asserted per scenario (the ISSUE 8 acceptance contract):
    bounded.
 4. SIGKILL mid-scan-window — restore from the last boundary checkpoint
    continued BIT-identically to an uninterrupted run.
+5. mesh collective stall + kill-resize (ISSUE 9) — the wedged
+   ``parallel/collective`` boundary fired the watchdog naming the
+   stalled mesh step and the fit self-healed; the SIGKILLed dp=4 mesh
+   fit restored onto a RESIZED dp=2 mesh and continued BIT-identically
+   to a planned resize.
 
 Plus the standing invariants: no scenario hangs (every wait here is
 bounded) and the disabled-failpoint overhead stays under the 1 us bar.
@@ -63,7 +68,9 @@ def main():
     print("chaos smoke OK: worker kill/revive committed past the kill, "
           "corrupt reload served the old version with zero non-shed "
           "failures, wedged batcher stayed bounded under a named "
-          "watchdog stall, mid-window SIGKILL resumed bit-identically")
+          "watchdog stall, mid-window SIGKILL resumed bit-identically, "
+          "and the stalled mesh step self-healed + resumed "
+          "bit-identically onto a resized mesh")
 
 
 if __name__ == "__main__":

@@ -164,6 +164,24 @@ _register("MXNET_SCAN_ACCUM", int, 1,
           "update over their summed gradients (effective batch = "
           "M x bound batch; Module-computed rescale_grad accounts for "
           "it). 1 disables; >1 requires MXNET_SCAN_STEPS mode")
+_register("MXNET_MESH_FUSED_STEP", bool, True,
+          "Module.fit with an in-process kvstore: trace forward + VJP + "
+          "bucketed gradient collectives + optimizer update into ONE "
+          "donated shard_map computation per K-step window over the "
+          "DeviceMesh (parallel/fused.py), retiring the per-param "
+          "push/pull loop from the hot path; 0 keeps the sequential "
+          "kvstore loop (docs/parallel.md eligibility matrix)")
+_register("MXNET_COLLECTIVE_BUCKET_MB", float, 4.0,
+          "mesh fused step: gradients are flattened into buckets of at "
+          "most this many MB and reduced with ONE psum/reduce-scatter "
+          "per bucket, so XLA can overlap communication with remaining "
+          "backward compute instead of issuing one tiny collective per "
+          "parameter (docs/parallel.md bucket sizing)")
+_register("MXNET_COLLECTIVE_MODE", str, "bucketed",
+          "mesh fused step collective formulation: 'bucketed' (default) "
+          "or 'off' (skip gradient collectives entirely — WRONG results, "
+          "bench/debug only: the differential against 'bucketed' is how "
+          "multichip_comm_blocking_pct isolates communication time)")
 _register("MXNET_FIT_STAGE_NEXT", bool, True,
           "fit loop: stage the NEXT DataBatch host->device "
           "(jax.device_put) while the current step is still in flight, "
@@ -393,6 +411,14 @@ _register("BENCH_CHAOS", bool, True,
           "bench.py: also measure degraded_p99_ms — serving p99 with "
           "one wedged batcher worker vs healthy (gate: <= 3x healthy "
           "p99 while shedding); pure-host phase, needs no TPU relay")
+_register("BENCH_MULTICHIP", bool, True,
+          "bench.py: also measure the mesh fused distributed step in a "
+          "subprocess forced to an 8-fake-device CPU mesh "
+          "(multichip_dispatches_per_step / multichip_comm_blocking_pct; "
+          "relay-proof like the other CPU phases)")
+_register("BENCH_MULTICHIP_K", int, 8,
+          "bench.py multichip phase: MXNET_SCAN_STEPS window size on the "
+          "dp=2,tp=2 mesh (the <=(1+eps)/K dispatch gate)")
 _register("BENCH_CKPT", bool, True,
           "bench.py: also measure checkpoint save-blocking time and "
           "restore latency (ckpt_save_blocking_ms / ckpt_restore_s)")

@@ -149,13 +149,73 @@ class FusedTrainStep:
         self._jit = jax.jit(step, donate_argnums=(1, 3, 4))
 
     # -- per-step host path ------------------------------------------------
-    def _owned_or_copy(self, token, buf):
+    def _owned_or_copy(self, token, buf, sharding=None):
         if self._owned.get(token) is buf:
             return buf
         # not produced by our own last step: copy so donation cannot
         # invalidate an alias the caller still holds (set_params shares
-        # buffers with the user's arg_params dict)
-        return buf.copy()
+        # buffers with the user's arg_params dict).  A mesh-fused
+        # subclass passes its parameter ``sharding`` so externally-set
+        # buffers (single-device restores, user arg_params) land
+        # replicated/sharded on the mesh before their first donation.
+        buf = buf.copy()
+        if sharding is not None and buf.sharding != sharding:
+            buf = jax.device_put(buf, sharding)
+        return buf
+
+    def _stage_carry(self, sharding=None):
+        """Stage the donated carry: ``(train_vals, aux_vals, states,
+        states_nd)`` with every buffer either produced by our own last
+        dispatch (donate freely) or ledger-copied (and re-placed onto
+        ``sharding`` when given).  Optimizer state is created lazily
+        through the SAME ``Updater`` the loop path uses, so checkpoint
+        get/set_optimizer_states and a later fallback to the loop see
+        one state store."""
+        module = self._module
+        exec_ = module._exec
+        updater = module._updater
+        for i, name in self._train:
+            updater._ensure_state(i, exec_.arg_dict[name])
+        states_nd = [updater.states[i] for i in self._opt_indices]
+        train_vals = tuple(
+            self._owned_or_copy(("p", n), exec_.arg_dict[n]._data, sharding)
+            for n in self._train_names)
+        aux_vals = tuple(
+            self._owned_or_copy(("a", n), exec_.aux_dict[n]._data, sharding)
+            for n in self._aux_names)
+        leaf_counter = [0]
+
+        def stage_state(leaf):
+            tok = ("s", leaf_counter[0])
+            leaf_counter[0] += 1
+            return self._owned_or_copy(tok, _as_buf(leaf), sharding)
+
+        states = jax.tree_util.tree_map(stage_state, states_nd)
+        return train_vals, aux_vals, states, states_nd
+
+    def _writeback_carry(self, tv, av, st, states_nd):
+        """Swap the NEW buffers into the existing NDArray views so
+        arg_dict/aux_dict/updater.states stay the canonical handles
+        (zero extra dispatches — these are reference swaps), and record
+        them in the ownership ledger for the next donation."""
+        exec_ = self._module._exec
+        owned = {}
+        for name, buf in zip(self._train_names, tv):
+            exec_.arg_dict[name]._set_data(buf)
+            owned[("p", name)] = buf
+        for name, buf in zip(self._aux_names, av):
+            exec_.aux_dict[name]._set_data(buf)
+            owned[("a", name)] = buf
+        leaf_counter = [0]
+
+        def writeback_state(old, new):
+            tok = ("s", leaf_counter[0])
+            leaf_counter[0] += 1
+            owned[tok] = new
+            old._set_data(new)
+
+        jax.tree_util.tree_map(writeback_state, states_nd, st)
+        self._owned = owned
 
     def step(self, data_batch):
         """Run one fused step.  Returns False (caller falls back to the
@@ -193,28 +253,7 @@ class FusedTrainStep:
                 buf = buf.astype(bound._data.dtype)
             feed_bufs[name] = buf
 
-        # optimizer state: create lazily through the SAME Updater the
-        # loop path uses, so checkpoint get/set_optimizer_states and a
-        # later fallback to the loop see one state store
-        updater = module._updater
-        for i, name in self._train:
-            updater._ensure_state(i, exec_.arg_dict[name])
-        states_nd = [updater.states[i] for i in self._opt_indices]
-
-        train_vals = tuple(
-            self._owned_or_copy(("p", n), exec_.arg_dict[n]._data)
-            for n in self._train_names)
-        aux_vals = tuple(
-            self._owned_or_copy(("a", n), exec_.aux_dict[n]._data)
-            for n in self._aux_names)
-        leaf_counter = [0]
-
-        def stage_state(leaf):
-            tok = ("s", leaf_counter[0])
-            leaf_counter[0] += 1
-            return self._owned_or_copy(tok, _as_buf(leaf))
-
-        states = jax.tree_util.tree_map(stage_state, states_nd)
+        train_vals, aux_vals, states, states_nd = self._stage_carry()
         other_vals = tuple(
             feed_bufs[n] if n in feed_bufs else exec_.arg_dict[n]._data
             for n in self._other_names)
@@ -243,28 +282,9 @@ class FusedTrainStep:
                     tuple(lrs), tuple(wds))
         _prof.record_dispatch("fused_step")
 
-        # write-back: swap the NEW buffers into the existing NDArray
-        # views so arg_dict/aux_dict/updater.states stay the canonical
-        # handles (zero extra dispatches — these are reference swaps)
-        owned = {}
-        for name, buf in zip(self._train_names, new_params):
-            exec_.arg_dict[name]._set_data(buf)
-            owned[("p", name)] = buf
-        for name, buf in zip(self._aux_names, new_aux):
-            exec_.aux_dict[name]._set_data(buf)
-            owned[("a", name)] = buf
-        leaf_counter[0] = 0
-
-        def writeback_state(old, new):
-            tok = ("s", leaf_counter[0])
-            leaf_counter[0] += 1
-            owned[tok] = new
-            old._set_data(new)
-
-        jax.tree_util.tree_map(writeback_state, states_nd, new_states)
+        self._writeback_carry(new_params, new_aux, new_states, states_nd)
         for name, buf in feed_bufs.items():
             exec_.arg_dict[name]._set_data(buf)
-        self._owned = owned
 
         module._zero_grads()
         exec_.outputs = [NDArray(o, module._context) for o in outs]
@@ -432,25 +452,7 @@ class ScanTrainStep(FusedTrainStep):
                 buf = buf.astype(bound._data.dtype)
             feed_bufs.append(buf.reshape((K, M) + tuple(bound.shape)))
 
-        updater = module._updater
-        for i, name in self._train:
-            updater._ensure_state(i, exec_.arg_dict[name])
-        states_nd = [updater.states[i] for i in self._opt_indices]
-
-        train_vals = tuple(
-            self._owned_or_copy(("p", n), exec_.arg_dict[n]._data)
-            for n in self._train_names)
-        aux_vals = tuple(
-            self._owned_or_copy(("a", n), exec_.aux_dict[n]._data)
-            for n in self._aux_names)
-        leaf_counter = [0]
-
-        def stage_state(leaf):
-            tok = ("s", leaf_counter[0])
-            leaf_counter[0] += 1
-            return self._owned_or_copy(tok, _as_buf(leaf))
-
-        states = jax.tree_util.tree_map(stage_state, states_nd)
+        train_vals, aux_vals, states, states_nd = self._stage_carry()
         rest_vals = tuple(exec_.arg_dict[n]._data
                           for n in self._rest_names)
 
@@ -480,23 +482,7 @@ class ScanTrainStep(FusedTrainStep):
                     train_vals, rest_vals, aux_vals, states)
         _prof.record_dispatch("scan_window")
 
-        owned = {}
-        for name, buf in zip(self._train_names, tv):
-            exec_.arg_dict[name]._set_data(buf)
-            owned[("p", name)] = buf
-        for name, buf in zip(self._aux_names, av):
-            exec_.aux_dict[name]._set_data(buf)
-            owned[("a", name)] = buf
-        leaf_counter[0] = 0
-
-        def writeback_state(old, new):
-            tok = ("s", leaf_counter[0])
-            leaf_counter[0] += 1
-            owned[tok] = new
-            old._set_data(new)
-
-        jax.tree_util.tree_map(writeback_state, states_nd, st)
-        self._owned = owned
+        self._writeback_carry(tv, av, st, states_nd)
 
         module._zero_grads()
         # (K, M, *out) -> (K*M, *out): position j is micro-batch j's

@@ -55,6 +55,10 @@ def _account_wire(op, grouped_values):
                 _np.dtype(dtype).itemsize
             n += 1
     _telemetry.record_kvstore(op, total, n)
+    # the store path IS gradient communication: mirror it into the
+    # collective families so mxnet_collective_bytes_total{kind} covers
+    # both the mesh-fused step and this residual per-param path
+    _telemetry.record_collective(f"kvstore_{op}", total, 0.0, n)
 
 
 class KVStore:
@@ -82,6 +86,17 @@ class KVStore:
     @property
     def num_workers(self):
         return 1
+
+    @property
+    def mesh_fusible(self):
+        """True when ``Module.fit`` may absorb this store's per-step
+        gradient synchronization into the mesh-fused train step
+        (parallel/fused.py): the store then serves only init/broadcast
+        and optimizer-state fetch, and gradient reduction runs as
+        bucketed XLA collectives inside the donated window.  False when
+        the store carries semantics the traced collectives would drop
+        (gradient compression's quantize/residual cycle)."""
+        return getattr(self, "_compression", None) is None
 
     # -- data --------------------------------------------------------------
     def init(self, key, value):
@@ -288,7 +303,7 @@ class KVStoreICI(KVStore):
             # duplication would otherwise feed jit incompatible devices
             total = vlist[0]._data
             for v in vlist[1:]:
-                total = total + jax.device_put(v._data, devs[0])
+                total = total + jax.device_put(v._data, devs[0])  # graftlint: disable=per-param-collective -- duplicate-device fallback (tests faking multi-device): a handful of copies once, not a per-step loop
             return None, total
         shape = tuple(vlist[0].shape)
         ckey = (devs, shape, str(vlist[0].dtype))
@@ -316,7 +331,7 @@ class KVStoreICI(KVStore):
                 # sparse or single-device: the local reduction is optimal
                 # (super().push accounts these bytes itself)
                 self._replicated.pop(k, None)
-                super().push(k, vlist, priority)
+                super().push(k, vlist, priority)  # graftlint: disable=per-param-collective -- per-KEY delegation of the multi-key API; each key reduces once in-store
                 continue
             _account_wire("push", [vlist])
             replicated, plain = self._allreduce(vlist)
@@ -366,7 +381,7 @@ class KVStoreICI(KVStore):
                     o._set_data(shard_data)
                 else:
                     import jax
-                    o._set_data(jax.device_put(stored._data, odev))
+                    o._set_data(jax.device_put(stored._data, odev))  # graftlint: disable=per-param-collective -- boundary transfer per out array after the in-store allreduce; the mesh fused step removes pulls from eligible hot paths
 
 
 class KVStoreDist(KVStore):
@@ -395,6 +410,14 @@ class KVStoreDist(KVStore):
     @property
     def num_workers(self):
         return self._num_workers
+
+    @property
+    def mesh_fusible(self):
+        """Only the single-process degradation may fuse: with a live
+        multi-worker client the server-side sum over DCN is the sync
+        mechanism and must keep running per push."""
+        return self._client is None and \
+            getattr(self, "_compression", None) is None
 
     @staticmethod
     def _layout_from_rows_per(k, shape, rows_per):
@@ -532,7 +555,7 @@ class KVStoreDist(KVStore):
             else:
                 layout = self._chunked.get(k)
                 if layout is None:
-                    self._client.push(k, merged.asnumpy(), sync=sync)
+                    self._client.push(k, merged.asnumpy(), sync=sync)  # graftlint: disable=per-param-collective -- one wire frame per key is the multi-worker protocol; big keys batch via push_many, and mesh-fusible setups bypass this loop entirely
                 else:  # pipelined chunk pushes: one in-flight window
                     arr = merged.asnumpy()
                     self._client.push_many(
@@ -575,7 +598,7 @@ class KVStoreDist(KVStore):
         for k, olist in zip(keys, outs):
             layout = self._chunked.get(k)
             if layout is None:
-                arr = self._client.pull(k)
+                arr = self._client.pull(k)  # graftlint: disable=per-param-collective -- one wire frame per key is the multi-worker protocol; chunked keys batch via pull_many
             else:  # big array: pipelined chunk pulls, reassembled
                 parts = self._client.pull_many([ck for ck, _b, _e in layout])
                 arr = np.concatenate(parts, axis=0)

@@ -95,10 +95,10 @@ def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore,
         if grad_list[0] is None:
             continue
         name = param_names[index]
-        kvstore.push(name, grad_list, priority=-index)
+        kvstore.push(name, grad_list, priority=-index)  # graftlint: disable=per-param-collective -- the RESIDUAL per-param dist path: mesh-ineligible setups and real multi-worker clients; eligible fits route through parallel/fused.MeshFusedTrainStep (docs/parallel.md)
         live.append((index, name, arg_list))
     for index, name, arg_list in live:
-        kvstore.pull(name, arg_list, priority=-index)
+        kvstore.pull(name, arg_list, priority=-index)  # graftlint: disable=per-param-collective -- residual per-param dist path (see push above)
 
 
 def _update_params(param_arrays, grad_arrays, updater, num_device,
@@ -112,8 +112,8 @@ def _update_params(param_arrays, grad_arrays, updater, num_device,
         index = i
         if kvstore:
             name = param_names[index]
-            kvstore.push(name, grad_list, priority=-index)
-            kvstore.pull(name, grad_list, priority=-index)
+            kvstore.push(name, grad_list, priority=-index)  # graftlint: disable=per-param-collective -- legacy FeedForward local-aggregation path, kept for API parity
+            kvstore.pull(name, grad_list, priority=-index)  # graftlint: disable=per-param-collective -- legacy FeedForward local-aggregation path, kept for API parity
         for k, p in enumerate(zip(arg_list, grad_list)):
             w, g = p
             updates[k].append((index * num_device + k, g, w))

@@ -207,7 +207,7 @@ class BaseModule:
         Batches that don't fill a window (epoch tail, shape-mismatched
         batches) and windows after a scan-trace failure run through the
         per-batch path unchanged."""
-        K, M = plan
+        K, M = plan[0], plan[1]
         W = K * M
         # a healthy window legitimately goes W batch-times between
         # beats: scale the watchdog deadline so K=32 runs stay silent
@@ -223,7 +223,7 @@ class BaseModule:
 
     def _fit_epoch_scan_inner(self, epoch, train_data, eval_metric, plan,
                               stager, timeline, wdog, batch_end_callback):
-        K, M = plan
+        K, M = plan[0], plan[1]
         W = K * M
         ctx = getattr(self, "_context", None)
         data_iter = iter(train_data)
@@ -292,6 +292,9 @@ class BaseModule:
                         "LOST on the fallback path" if M > 1 else "")
                     self._scan_disabled = True
                     self._scan = None
+                    # NOTE: self._mesh stays set — it records that the
+                    # mesh path engaged this fit (scenario evidence);
+                    # _scan_disabled prevents re-entry
             if outs is not False:
                 # prefetch: collect the next window while this scan is
                 # still in flight on device (dispatch was async)
@@ -474,6 +477,10 @@ class Module(BaseModule):
         self._fused_disabled = False
         self._scan = None
         self._scan_disabled = False
+        self._mesh = None          # DeviceMesh when the mesh path engaged
+        self._mesh_disabled = False
+        self._auto_mesh = None     # cached all-device dp mesh (False = n/a)
+        self._batch_outs_ok = {}   # mesh eligibility: outputs carry batch
         self._zero_buf_cache = {}
         self._pending_metric = []
 
@@ -701,6 +708,8 @@ class Module(BaseModule):
         self._fused_disabled = False
         self._scan = None
         self._scan_disabled = False
+        self._mesh = None
+        self._mesh_disabled = False
         arg_params = {n: self._exec.arg_dict[n] for n in self._param_names}
         kv, update_on_kvstore = _create_kvstore(kvstore, 1, arg_params)
         self._kvstore = kv
@@ -727,9 +736,50 @@ class Module(BaseModule):
             del self._preload_opt_states_bytes
 
     # -- compute -----------------------------------------------------------
+    def _demesh_arrays(self):
+        """Re-place parameter/optimizer-state buffers held as
+        mesh-replicated ``jax.Array``s back onto the module's single
+        context device.  After mesh-fused windows ran (parallel/
+        fused.py), ``arg_dict``/``Updater.states`` hold multi-device
+        arrays; the plain executor path (per-batch fallback steps,
+        score/predict, direct forward) jits against the context device
+        and would fail with incompatible-devices — this collapse runs
+        once at the first such use, then the flag re-arms on the next
+        mesh window."""
+        if not getattr(self, "_mesh_arrays_live", False):
+            return
+        self._mesh_arrays_live = False
+        import jax as _jax
+        dev = self._context.jax_device
+
+        def _fix(nd_arr):
+            buf = getattr(nd_arr, "_data", None)
+            if buf is not None and len(buf.devices()) > 1:
+                nd_arr._set_data(_jax.device_put(buf, dev))
+
+        for n in self._param_names:
+            _fix(self._exec.arg_dict[n])
+        for n in self._aux_names:
+            _fix(self._exec.aux_dict[n])
+        if self._updater is not None:
+            def _walk(s):
+                if isinstance(s, (tuple, list)):
+                    for t in s:
+                        _walk(t)
+                elif isinstance(s, NDArray):
+                    _fix(s)
+            for s in self._updater.states.values():
+                _walk(s)
+        # the fused-step ownership ledgers point at the old buffers now
+        if self._scan is not None:
+            self._scan._owned = {}
+        if self._fused is not None:
+            self._fused._owned = {}
+
     def forward(self, data_batch, is_train=None):
         """Forward (parity: module.py forward; batch feeds the executor)."""
         assert self.binded and self.params_initialized
+        self._demesh_arrays()
         if is_train is None:
             is_train = self.for_training
         # a manual forward supersedes any fused step still pending its
@@ -898,6 +948,98 @@ class Module(BaseModule):
             self._fused_step_done = True
         return ran
 
+    # -- mesh-fused distributed windows (parallel/fused.py) ----------------
+    def _fit_mesh(self):
+        """The DeviceMesh the mesh-fused fit path would run on: the
+        ambient ``with mesh:`` mesh when one is active, else a cached
+        all-device dp mesh (every mesh axis is data-parallel for a
+        symbolic Module graph; docs/parallel.md)."""
+        from .parallel import current_mesh
+        m = current_mesh()
+        if m is not None:
+            return m
+        if self._auto_mesh is None:
+            import jax
+            from .parallel.mesh import DeviceMesh
+            devs = jax.devices()
+            self._auto_mesh = DeviceMesh({"dp": len(devs)}, devs) \
+                if len(devs) > 1 else False
+        return self._auto_mesh or None
+
+    def _mesh_batch_outputs_ok(self, n_shards, batch):
+        """Every graph output must carry the batch on its leading dim
+        (the window's out_specs shard/unshard dim0): infer output shapes
+        at the bound batch AND at the per-shard batch and require dim0
+        to track both.  Cached per (n_shards, batch)."""
+        key = (n_shards, batch)
+        if key not in self._batch_outs_ok:
+            try:
+                known = {d.name: d.shape for d in self._data_shapes}
+                for l in (self._label_shapes or []):
+                    known[l.name] = l.shape
+                _, outs_b, _ = self.symbol.infer_shape_partial(**known)
+                local = {k: (v[0] // n_shards,) + tuple(v[1:])
+                         for k, v in known.items()}
+                _, outs_s, _ = self.symbol.infer_shape_partial(**local)
+                ok = bool(outs_b and outs_s
+                          and all(o and o[0] == batch for o in outs_b)
+                          and all(o and o[0] == batch // n_shards
+                                  for o in outs_s))
+            except Exception as e:  # noqa: BLE001 — ineligible, not fatal
+                self.logger.debug(
+                    "mesh batch-output inference failed (%s: %s); "
+                    "keeping the per-param kvstore loop",
+                    type(e).__name__, e)
+                ok = False
+            self._batch_outs_ok[key] = ok
+        return self._batch_outs_ok[key]
+
+    def _mesh_fused_eligible(self):
+        """True when fit can trace forward + VJP + bucketed gradient
+        collectives + optimizer update into one donated shard_map window
+        per K steps (parallel/fused.MeshFusedTrainStep) instead of the
+        per-param kvstore push/pull loop.  See docs/parallel.md for the
+        full eligibility matrix."""
+        from . import config as _config
+        if not _config.get("MXNET_MESH_FUSED_STEP") or self._mesh_disabled:
+            return False
+        kv = getattr(self, "_kvstore", None)
+        if kv is None or not getattr(kv, "mesh_fusible", False):
+            return False  # no store, or a store the mesh cannot absorb
+        if not (self.binded and self.params_initialized
+                and self.optimizer_initialized and self.for_training):
+            return False
+        if self.inputs_need_grad or self._monitor is not None:
+            return False
+        if self._aux_names:
+            # per-replica aux mutation (BN running stats) would need
+            # sync-BN; the loop path keeps reference semantics
+            return False
+        ex = self._exec
+        if ex is None or ex._grouped is not None or \
+                ex._monitor_callback is not None:
+            return False
+        opt = self._optimizer
+        if not callable(getattr(opt, "fused_update", None)) or \
+                getattr(opt, "multi_precision", False):
+            return False
+        if any(ex.grad_req.get(n, "null") not in ("write", "null")
+               for n in ex._arg_names):
+            return False
+        mesh = self._fit_mesh()
+        if mesh is None or mesh.size() < 2:
+            return False
+        n = mesh.size()
+        shapes = list(self._data_shapes) + list(self._label_shapes or [])
+        if not shapes or not shapes[0].shape:
+            return False
+        batch = shapes[0].shape[0]
+        if not batch or batch % n:
+            return False  # batch must shard evenly over the mesh
+        if any((not d.shape) or d.shape[0] != batch for d in shapes):
+            return False
+        return self._mesh_batch_outputs_ok(n, batch)
+
     # -- scanned K-step windows (fused_step.ScanTrainStep) -----------------
     def _scan_plan(self):
         from . import config as _config
@@ -905,6 +1047,28 @@ class Module(BaseModule):
             return None
         K = max(1, int(_config.get("MXNET_SCAN_STEPS")))
         M = max(1, int(_config.get("MXNET_SCAN_ACCUM")))
+        if self._mesh_fused_eligible():
+            # mesh path: even K=1 windows win (one donated dispatch
+            # replaces 2 host round-trips per parameter).  The in-store
+            # updater retires from the hot path NOW — optimizer state
+            # lives in the module's Updater, which the mesh step
+            # maintains, so state fetch and any later loop fallback
+            # read one consistent store.
+            if self._update_on_kvstore:
+                # a checkpoint restore may have preloaded optimizer
+                # state into the STORE's updater (set_optimizer_states
+                # ran while update_on_kvstore was still true) — hand
+                # those states to the module updater, or a resumed fit
+                # would silently restart momentum/Adam moments at zero
+                kv_updater = getattr(self._kvstore, "_updater", None)
+                if kv_updater is not None:
+                    for idx, st in kv_updater.states.items():
+                        if isinstance(idx, int) and \
+                                idx not in self._updater.states:
+                            self._updater.states[idx] = st
+                            self._updater.states_synced[idx] = True
+                self._update_on_kvstore = False
+            return (K, M, self._fit_mesh())
         if K * M <= 1:
             return None
         if not self._fused_eligible():
@@ -915,7 +1079,7 @@ class Module(BaseModule):
                     "gradient accumulation", M)
                 self._scan_disabled = True
             return None
-        return (K, M)
+        return (K, M, None)
 
     def _scan_batch_ok(self, batch):
         """Window-eligible: every data/label array matches its bound
@@ -935,18 +1099,33 @@ class Module(BaseModule):
         return True
 
     def _run_scan_window(self, sbatch, plan):
-        """Dispatch one staged super-batch through the scanned step;
-        returns the flattened per-batch output buffers or False."""
-        K, M = plan
+        """Dispatch one staged super-batch through the scanned step
+        (mesh-fused when the plan carries a DeviceMesh); returns the
+        flattened per-batch output buffers or False."""
+        K, M, mesh = plan
         fs = self._scan
         if fs is None or fs.stale(self) or fs.scan_steps != K \
-                or fs.accum != M:
-            from .fused_step import ScanTrainStep
-            fs = self._scan = ScanTrainStep(self, K, M)
+                or fs.accum != M or getattr(fs, "mesh", None) is not mesh:
+            if mesh is not None:
+                from .parallel.fused import MeshFusedTrainStep
+                fs = self._scan = MeshFusedTrainStep(self, mesh, K, M)
+                self._mesh = mesh
+                self.logger.info(
+                    "mesh fused train step engaged: %s, K=%d M=%d — the "
+                    "per-param kvstore push/pull loop is off the hot "
+                    "path (kvstore remains for init/broadcast + "
+                    "optimizer-state fetch)", mesh, K, M)
+            else:
+                from .fused_step import ScanTrainStep
+                fs = self._scan = ScanTrainStep(self, K, M)
         outs = fs.run_window(sbatch)
         if outs is not False:
             self._forward_pad = 0
             self._fused_step_done = False
+            if mesh is not None:
+                # arg_dict/updater.states now hold mesh-replicated
+                # arrays; any plain-executor use collapses them first
+                self._mesh_arrays_live = True
         return outs
 
     def update(self):
@@ -961,11 +1140,22 @@ class Module(BaseModule):
             return
         kv = getattr(self, "_kvstore", None)
         if kv is not None and self._update_on_kvstore:
-            # optimizer runs IN the store (server-side for dist)
+            # optimizer runs IN the store (server-side for dist).  This
+            # is the residual per-param sync path (mesh-ineligible
+            # setups and real multi-worker clients): its wall time IS
+            # gradient-communication time, so reattribute it from the
+            # enclosing step_dispatch lane to comm_collective — the
+            # breakdown then shows blocking-% on collectives directly.
+            st = _telemetry.current_step_timer()
+            t0 = time.perf_counter()
             _update_params_on_kvstore(
                 [[self._exec.arg_dict[n]] for n in self._param_names],
                 [[self._exec.grad_dict.get(n)] for n in self._param_names],
                 kv, self._param_names)
+            if st.active:
+                dt = time.perf_counter() - t0  # graftlint: disable=raw-phase-timing -- lane REattribution: the span is already timed inside the step_dispatch lane; this moves its share to comm_collective
+                st.add("comm_collective", dt)
+                st.add("step_dispatch", -dt)
             self._zero_grads()
             return
         for i, name in enumerate(self._param_names):
@@ -996,7 +1186,7 @@ class Module(BaseModule):
             key = (tuple(g.shape), str(g._data.dtype), dev)
             z = cache.get(key)
             if z is None:
-                z = cache[key] = _jax.device_put(
+                z = cache[key] = _jax.device_put(  # graftlint: disable=per-param-collective -- cold zero-buffer cache fill, once per (shape, dtype, device); steady state is a dict hit
                     _jnp.zeros(g.shape, g._data.dtype), dev)
             g._set_data(z)
 
@@ -1070,12 +1260,16 @@ class Module(BaseModule):
         buffered step count reaches the interval (rounded up to this
         window's boundary)."""
         from . import config as _config
+        # a 1-step window (mesh path at K=M=1) strips its leading window
+        # dim: the flush's single-step branch expects per-batch arrays
+        unstack = sbatch.count == 1
         label_map = {}
         if self._label_shapes and sbatch.label:
-            label_map = {d.name: NDArray(l, self._context)
+            label_map = {d.name: NDArray(l[0] if unstack else l,
+                                         self._context)
                          for d, l in zip(self._label_shapes,
                                          sbatch.label)}
-        pred_map = {name: NDArray(o, self._context)
+        pred_map = {name: NDArray(o[0] if unstack else o, self._context)
                     for name, o in zip(self.output_names, outs_flat)}
         self._pending_metric.append(
             (eval_metric, label_map, pred_map, sbatch.count))

@@ -93,6 +93,14 @@ class Optimizer:
     # to the per-param dispatch loop in Module.update.
     fused_update = None
 
+    # True when ``fused_update`` is purely elementwise over (weight,
+    # grad, state) AND accepts lr/wd as broadcastable ARRAYS, not just
+    # scalars.  The mesh-fused fsdp layout (parallel/fused.py) relies on
+    # both: it runs the update on flat 1-D bucket *shards* that span
+    # parameter boundaries, feeding per-element lr/wd vectors — only
+    # legal when no cross-element math (LARS/LAMB norms) exists.
+    fused_elementwise = False
+
     def fused_hyperparams(self, indices):
         """Host-side per-step dynamic scalars for ``fused_update``:
         ``(lr_t, wd_t)`` python-float lists, evaluated ONCE per step
@@ -284,13 +292,17 @@ class SGD(Optimizer):
         else:
             _invoke("mp_sgd_update", [weight, grad, w32], attrs, weight)
 
+    fused_elementwise = True
+
     def fused_update(self, params, grads, states, lr_t, wd_t):
         """Whole-pytree functional SGD step for the fused train step.
 
         Mirrors ``sgd_update``/``sgd_mom_update``/``mp_sgd_*``
         (ops/_op_optimizer.py) bit for bit — same op order, same python-
         float constants for rescale/clip/momentum — with lr/wd arriving
-        as traced weak-typed scalars (no recompile across schedules).
+        as traced weak-typed scalars (no recompile across schedules; the
+        mesh-fused fsdp layout passes per-element lr/wd VECTORS instead,
+        which the same elementwise expressions broadcast through).
         The multi-precision branch is chosen per param from the state
         STRUCTURE, exactly like ``update_multi_precision``."""
         import jax.numpy as jnp
@@ -455,6 +467,8 @@ class Adam(Optimizer):
         attrs.update(beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon)
         mean, var = state
         _invoke("adam_update", [weight, grad, mean, var], attrs, weight)
+
+    fused_elementwise = True
 
     def fused_update(self, params, grads, states, lr_t, wd_t):
         """Whole-pytree functional Adam step (mirrors ``adam_update`` in

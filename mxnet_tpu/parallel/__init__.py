@@ -19,3 +19,7 @@ from .multihost import init_multihost, is_coordinator
 from .pipeline import (gpipe_fn, pipeline_apply, stack_stage_params,
                        pipeline_efficiency)
 from .moe import init_moe_params, moe_ffn, moe_ffn_ep
+# NOTE: .fused (MeshFusedTrainStep + bucketed collective helpers) is
+# deliberately NOT imported here — `python -m mxnet_tpu.parallel.fused`
+# is the CI mesh smoke, and an eager package import would make runpy
+# execute a second copy of the module. Import mxnet_tpu.parallel.fused.

@@ -251,13 +251,22 @@ class TrainStep:
 
     def __init__(self, block, loss_fn, optimizer, optimizer_params, mesh,
                  example_batch, batch_axis="dp", param_axis=None,
-                 dtype=None, remat=None):
+                 dtype=None, remat=None, bucket_mb=None):
         """remat: rematerialize the forward during backward, trading
         FLOPs for activation memory (parity: MXNET_BACKWARD_DO_MIRROR,
         src/nnvm/gradient.cc mirror fn). None reads the env var; True
         wraps the forward in jax.checkpoint with a policy keeping matmul
         AND conv outputs (elementwise recomputed) — the standard recipe
-        for large-batch training that would otherwise spill HBM."""
+        for large-batch training that would otherwise spill HBM.
+
+        bucket_mb: when set, the step compiles as an EXPLICIT shard_map
+        program whose gradient reduction is one psum per bucket_mb-sized
+        flat bucket (parallel/fused.bucketed_all_reduce) instead of the
+        pjit-inserted per-tensor psums — the collective count drops from
+        one-per-param to ceil(total_MB/bucket_MB) and XLA can overlap
+        each bucket with remaining backward compute.  Requires
+        replicated params (param_axis=None) and a block without
+        in-place-mutated aux (BatchNorm keeps the pjit path)."""
         from .. import autograd as _ag
 
         if remat is None:
@@ -334,45 +343,96 @@ class TrainStep:
 
         use_remat = self.remat
 
-        def step(key, train_params, aux_params, opt_state, x, y):
-            def fwd(tps, x_):
-                ps = merge_params(train_idx, aux_idx, tps, aux_params)
-                with _ag.train_mode():
-                    outs, mutated = apply_fn(key, ps, (x_,))
-                return outs[0], mutated
+        def make_step(grad_sync):
+            def step(key, train_params, aux_params, opt_state, x, y):
+                def fwd(tps, x_):
+                    ps = merge_params(train_idx, aux_idx, tps, aux_params)
+                    with _ag.train_mode():
+                        outs, mutated = apply_fn(key, ps, (x_,))
+                    return outs[0], mutated
 
-            if use_remat:
-                fwd = remat_wrap(fwd)
+                if use_remat:
+                    fwd = remat_wrap(fwd)
 
-            def compute_loss(tps):
-                pred, mutated = fwd(tps, x)
-                return loss_raw(pred, y), mutated
+                def compute_loss(tps):
+                    pred, mutated = fwd(tps, x)
+                    return loss_raw(pred, y), mutated
 
-            (loss, mutated), grads = jax.value_and_grad(
-                compute_loss, has_aux=True)(train_params)
-            new_params = []
-            new_state = []
-            for w, g, st in zip(train_params, grads, opt_state):
-                nw, ns = opt_update(opt_attrs, w, g, st)
-                new_params.append(nw)
-                new_state.append(ns)
-            # mutated comes back in ascending-param-index order == aux order;
-            # write the new running stats into the aux slot (round-1 dropped
-            # them: inference-mode BN saw frozen stats forever)
-            new_aux = tuple(m.astype(a.dtype) for m, a in
-                            zip(mutated, aux_params)) if mutated else aux_params
-            return tuple(new_params), new_aux, tuple(new_state), loss
+                (loss, mutated), grads = jax.value_and_grad(
+                    compute_loss, has_aux=True)(train_params)
+                if grad_sync is not None:
+                    grads, loss = grad_sync(list(grads), loss)
+                new_params = []
+                new_state = []
+                for w, g, st in zip(train_params, grads, opt_state):
+                    nw, ns = opt_update(opt_attrs, w, g, st)
+                    new_params.append(nw)
+                    new_state.append(ns)
+                # mutated comes back in ascending-param-index order == aux
+                # order; write the new running stats into the aux slot
+                # (round-1 dropped them: inference-mode BN saw frozen
+                # stats forever)
+                new_aux = tuple(m.astype(a.dtype) for m, a in
+                                zip(mutated, aux_params)) if mutated \
+                    else aux_params
+                return tuple(new_params), new_aux, tuple(new_state), loss
+            return step
 
         state_sh = tuple(tuple(sh for _ in st)
                          for st, sh in zip(self.opt_state, train_sh))
-        # one pjit'd program: params/opt state pinned to their shardings and
-        # DONATED (no 2x HBM), batch arrives dp-sharded; XLA inserts the dp
-        # psum for grads and fsdp all-gathers
-        self._step = jax.jit(
-            step,
-            in_shardings=(None, train_sh, aux_sh, state_sh,
-                          batch_sh, batch_sh),
-            donate_argnums=(1, 2, 3))
+        self.bucket_mb = bucket_mb
+        if bucket_mb is None:
+            # one pjit'd program: params/opt state pinned to their
+            # shardings and DONATED (no 2x HBM), batch arrives dp-sharded;
+            # XLA inserts the dp psum for grads and fsdp all-gathers
+            self._step = jax.jit(
+                make_step(None),
+                in_shardings=(None, train_sh, aux_sh, state_sh,
+                              batch_sh, batch_sh),
+                donate_argnums=(1, 2, 3))
+        else:
+            # explicit-collective formulation: the same step body runs as
+            # the per-shard program of a shard_map, and gradient sync is
+            # ONE psum per flat bucket.  The per-shard grads are of the
+            # LOCAL mean loss, so the bucketed global sum divides by the
+            # shard count to match the pjit global-mean gradients.
+            if param_axis is not None:
+                raise MXNetError(
+                    "bucket_mb requires replicated parameters "
+                    "(param_axis=None); fsdp-style sharding keeps the "
+                    "pjit formulation")
+            if self._aux_idx:
+                raise MXNetError(
+                    "bucket_mb: blocks with in-place-mutated aux "
+                    "(BatchNorm running stats) keep the pjit path — "
+                    "per-shard aux would need sync-BN semantics")
+            from ._shard_map import shard_map
+            from .fused import bucketed_all_reduce, plan_buckets
+            t_shapes = [tuple(param_arrays[i].shape)
+                        for i in self._train_idx]
+            t_dtypes = [str(param_arrays[i].dtype)
+                        for i in self._train_idx]
+            self._bucket_plan = plan_buckets(t_shapes, t_dtypes, bucket_mb)
+            n_dp = mesh.size(batch_axis)
+            plan = self._bucket_plan
+
+            def grad_sync(grads, loss):
+                grads = bucketed_all_reduce(grads, batch_axis, plan)
+                return [g / n_dp for g in grads], \
+                    jax.lax.psum(loss, batch_axis) / n_dp
+
+            state_spec = tuple(tuple(P() for _ in st)
+                               for st in self.opt_state)
+            smapped = shard_map(
+                make_step(grad_sync), mesh=mesh.jax_mesh,
+                in_specs=(P(), tuple(P() for _ in self._train_idx),
+                          tuple(P() for _ in self._aux_idx), state_spec,
+                          P(batch_axis), P(batch_axis)),
+                out_specs=(tuple(P() for _ in self._train_idx),
+                           tuple(P() for _ in self._aux_idx),
+                           state_spec, P()),
+                check_vma=False)
+            self._step = jax.jit(smapped, donate_argnums=(1, 2, 3))
 
     @property
     def params(self):

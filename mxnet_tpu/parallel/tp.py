@@ -140,7 +140,7 @@ def shard_transformer_params(mesh, params):
     out = {}
     for name, arr in params.items():
         spec = _PARAM_SPECS[name]
-        out[name] = jax.device_put(arr, mesh.sharding(*spec))
+        out[name] = jax.device_put(arr, mesh.sharding(*spec))  # graftlint: disable=per-param-collective -- one placement per weight at model setup, not a per-step loop
     return out
 
 

@@ -73,6 +73,22 @@ _SCAN_WINDOW = REGISTRY.gauge(
     "train steps per scanned fit-window dispatch (MXNET_SCAN_STEPS; "
     "1 = one dispatch per step)")
 _SCAN_WINDOW.set(1)
+_COLLECTIVE_BYTES = REGISTRY.counter(
+    "mxnet_collective_bytes_total",
+    "logical payload bytes moved by gradient-synchronization "
+    "collectives, by kind (psum/reduce_scatter/all_gather for the mesh "
+    "fused step; kvstore_push/kvstore_pull for the residual per-param "
+    "store path)")
+_COLLECTIVE_SECONDS = REGISTRY.counter(
+    "mxnet_collective_seconds",
+    "seconds attributed to gradient-synchronization collectives, by "
+    "kind (wall time for the kvstore path; calibrated standalone cost "
+    "for collectives fused inside the mesh step program)")
+_COLLECTIVE_OPS = REGISTRY.counter(
+    "mxnet_collective_ops_total",
+    "gradient-synchronization collective operations issued, by kind "
+    "(one per bucket per step for the mesh fused step — NOT one per "
+    "parameter; that is the point)")
 
 
 def record_kvstore(op, nbytes, n_ops=1):
@@ -80,6 +96,19 @@ def record_kvstore(op, nbytes, n_ops=1):
     labels = {"op": op}
     _KV_BYTES.inc(int(nbytes), labels=labels)
     _KV_OPS.inc(int(n_ops), labels=labels)
+
+
+def record_collective(kind, nbytes, seconds=0.0, n=1):
+    """Account gradient-synchronization collectives: ``kind`` is the
+    collective flavor (``psum``/``reduce_scatter``/``all_gather`` inside
+    the mesh fused step, ``kvstore_push``/``kvstore_pull`` on the
+    residual store path).  Byte counts are host shape arithmetic — never
+    a device sync."""
+    labels = {"kind": kind}
+    _COLLECTIVE_BYTES.inc(int(nbytes), labels=labels)
+    if seconds:
+        _COLLECTIVE_SECONDS.inc(float(seconds), labels=labels)
+    _COLLECTIVE_OPS.inc(int(n), labels=labels)
 
 
 def record_io_stage(seconds, nbytes=0):

@@ -7,6 +7,11 @@ iteration attributes its wall time to named *lanes*:
 * ``h2d_stage``     — host->device staging of the next batch (io.stage_batch)
 * ``step_dispatch`` — host time dispatching forward/backward/update
                       (the fused jit call included)
+* ``comm_collective`` — gradient-synchronization time: the wall time of
+                      the residual per-param kvstore push/pull loop, or
+                      the calibrated standalone cost of the mesh fused
+                      step's bucketed collectives (reattributed out of
+                      ``step_dispatch`` so the lane sum stays exact)
 * ``device_block``  — waiting for device results before metric math
                       (the sync the metric flush forces)
 * ``metric_flush``  — host-side metric math after arrays landed
@@ -29,8 +34,8 @@ import time
 
 from . import spans as _spans
 
-LANES = ("data_wait", "h2d_stage", "step_dispatch", "device_block",
-         "metric_flush", "ckpt_block")
+LANES = ("data_wait", "h2d_stage", "step_dispatch", "comm_collective",
+         "device_block", "metric_flush", "ckpt_block")
 
 _tls = threading.local()
 _agg_lock = threading.Lock()

@@ -512,3 +512,17 @@ def test_scenario_sigkill_mid_scan(tmp_path):
     assert r["ok"], json.dumps(r, default=str)
     assert r["victim_exit"] == -9 and not r["victim_finished"]
     assert r["diverged_params"] == []
+
+
+@pytest.mark.slow
+def test_scenario_mesh_collective_stall(tmp_path):
+    """ISSUE 9: the mesh fused step's collective boundary wedges (the
+    watchdog names the stalled mesh step, the fit self-heals), then a
+    mid-run SIGKILL restores onto a RESIZED dp=4 -> dp=2 mesh and
+    continues bit-identically to a planned resize."""
+    r = harness.scenario_mesh_collective_stall(str(tmp_path / "s5"))
+    assert r["ok"], json.dumps(r, default=str)
+    assert r["wedge"]["fires"] >= 1
+    assert r["wedge"]["names_fit_section"]
+    assert r["victim_exit"] == -9 and not r["victim_finished"]
+    assert r["diverged_params"] == []

@@ -475,6 +475,75 @@ def test_naked_retry_suppression():
     assert "naked-retry" not in rules_hit(lint(src))
 
 
+# -- per-param-collective ----------------------------------------------------
+PER_PARAM_LOOP = """
+    def update_params(kv, names, grads, weights):
+        for i, name in enumerate(names):
+            kv.push(name, grads[name], priority=-i)
+        for i, name in enumerate(names):
+            kv.pull(name, weights[name], priority=-i)
+"""
+
+
+def test_per_param_collective_flags_push_pull_loop():
+    findings = lint(PER_PARAM_LOOP, path="mxnet_tpu/model.py")
+    hits = [f for f in findings if f.rule == "per-param-collective"]
+    assert len(hits) == 2
+    assert {h.symbol for h in hits} == {"update_params:push",
+                                        "update_params:pull"}
+    assert "bucket" in hits[0].message.lower()
+
+
+def test_per_param_collective_only_in_hot_paths():
+    # the same loop in offline tooling stays silent
+    assert "per-param-collective" not in rules_hit(
+        lint(PER_PARAM_LOOP, path="tools/launch.py"))
+
+
+def test_per_param_collective_near_miss_batched_forms():
+    src = """
+    def sync(client, layout, arr):
+        for chunk in layout:
+            client.push_many([(ck, arr[b:e]) for ck, b, e in layout])
+    """
+    assert "per-param-collective" not in rules_hit(
+        lint(src, path="mxnet_tpu/kvstore.py"))
+
+
+def test_per_param_collective_near_miss_init_time_loop():
+    src = """
+    def init_params(kv, names, params):
+        for name in names:
+            kv.push(name, params[name])
+
+    def broadcast_weights(mesh, params):
+        import jax
+        return [jax.device_put(p, mesh.replicated()) for p in params]
+    """
+    assert "per-param-collective" not in rules_hit(
+        lint(src, path="mxnet_tpu/parallel/fused.py"))
+
+
+def test_per_param_collective_near_miss_outside_loop():
+    src = """
+    def sync_once(kv, name, grad):
+        kv.push(name, grad)
+        kv.pull(name, grad)
+    """
+    assert "per-param-collective" not in rules_hit(
+        lint(src, path="mxnet_tpu/model.py"))
+
+
+def test_per_param_collective_suppression():
+    src = PER_PARAM_LOOP.replace(
+        "kv.push(name, grads[name], priority=-i)",
+        "kv.push(name, grads[name], priority=-i)  "
+        "# graftlint: disable=per-param-collective -- residual path")
+    hits = [f for f in lint(src, path="mxnet_tpu/model.py")
+            if f.rule == "per-param-collective"]
+    assert {h.symbol for h in hits} == {"update_params:pull"}
+
+
 # -- env-knob-drift ----------------------------------------------------------
 def test_env_drift_flags_unregistered_read():
     rules = [EnvDriftRule(registered={"MXNET_GOOD"})]
