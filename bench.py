@@ -535,6 +535,200 @@ def measure_degraded_p99():
     }}
 
 
+def measure_serve_pool():
+    """Relay-proof host phases ``serve_sustained_img_per_sec`` and
+    ``serve_spike_p99_ms`` (ISSUE 10): replica-pool serving vs the
+    single batcher, and tail latency under a 10x Poisson load spike.
+
+    Runner is pure-host (per-item sleep — models per-sample device
+    compute, releases the GIL so replicas genuinely overlap): no
+    device, no relay.  Gates:
+
+    * sustained: a BENCH_SERVE_SPIKE_REPLICAS-replica pool sustains
+      >= 2x the closed-loop throughput of the single batcher;
+    * spike: with SLO admission armed (slo self-tuned to 2.5x the
+      measured steady p99), the p99 of ADMITTED requests inside a
+      BENCH_SERVE_SPIKE_X (10x) arrival spike stays <= 3x the
+      steady-state p99, every refusal is a typed ServingOverloadError,
+      and zero admitted requests time out or drop.
+    """
+    import sys as _sys
+    import threading as _th
+    import time as _t
+
+    import numpy as _np
+
+    from mxnet_tpu import config as mxcfg
+    from mxnet_tpu.serving.batcher import (RequestTimeoutError,
+                                           ServingOverloadError)
+    from mxnet_tpu.serving.metrics import ServingMetrics
+    from mxnet_tpu.serving.router import ReplicaPool
+
+    # a 10x-overload submit loop degenerates into a GIL-hogging tight
+    # loop at the default 5 ms switch interval, starving the dispatch
+    # threads it is supposed to measure — a load-GENERATOR artifact.
+    # Real clients live on other hosts; shrink the GIL slice so the
+    # in-process generator approximates them.
+    prev_switch = _sys.getswitchinterval()
+    _sys.setswitchinterval(0.0005)
+
+    n_replicas = max(2, mxcfg.get("BENCH_SERVE_SPIKE_REPLICAS"))
+    steady_s = float(mxcfg.get("BENCH_SERVE_SPIKE_SECONDS"))
+    spike_x = float(mxcfg.get("BENCH_SERVE_SPIKE_X"))
+
+    def factory(rid):
+        def run(feed, n_real):
+            # a ~2 ms/sample model: service time dominates framework
+            # overhead (the regime replica scaling is for), and the
+            # per-sample cost is what makes the >= 2x pool gate measure
+            # added CAPACITY rather than batching-overhead amortization
+            _t.sleep(0.002 * n_real + 0.001)
+            return [feed["x"] * 2.0]
+        return run
+
+    kw = dict(max_batch_size=8, max_latency_ms=2.0, num_workers=1,
+              max_queue_depth=256, shed_watermark=128)
+
+    def closed_loop(pool, seconds, n_clients=16):
+        done = [0]
+        lock = _th.Lock()
+        stop = _t.perf_counter() + seconds
+
+        def client():
+            x = _np.ones((16,), _np.float32)
+            while _t.perf_counter() < stop:
+                try:
+                    pool.submit({"x": x}).result(10.0)
+                    with lock:
+                        done[0] += 1
+                except ServingOverloadError:
+                    _t.sleep(0.001)
+
+        threads = [_th.Thread(target=client) for _ in range(n_clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return done[0] / seconds
+
+    # -- sustained: single batcher vs replica pool (closed loop) ---------
+    single = ReplicaPool(factory, num_replicas=1, name="bench-single",
+                         model="bench-single",
+                         metrics=ServingMetrics("bench-single"), **kw)
+    try:
+        closed_loop(single, 0.4)  # warm the code paths
+        single_rps = closed_loop(single, steady_s)
+    finally:
+        single.close()
+    pool_metrics = ServingMetrics("bench-pool")
+    pool = ReplicaPool(factory, num_replicas=n_replicas,
+                       name="bench-pool", model="bench-pool",
+                       metrics=pool_metrics, **kw)
+    sustained_rps = closed_loop(pool, steady_s, n_clients=8 * n_replicas)
+    sustained_bar = 2.0
+    sustained = {
+        "metric": "serve_sustained_img_per_sec",
+        "value": round(sustained_rps, 1), "unit": "img/s",
+        "single_batcher_img_per_sec": round(single_rps, 1),
+        "ratio_vs_single": round(sustained_rps / max(single_rps, 1e-9), 2),
+        "replicas": n_replicas,
+        "bar_ratio": sustained_bar,
+        "passed": bool(sustained_rps >= sustained_bar * single_rps),
+    }
+
+    # -- spike: Poisson steady window, then a 10x window -----------------
+    def open_loop(seconds, lam):
+        """Poisson arrivals at ``lam``; returns (submitted futures,
+        sheds, other-typed-refusals)."""
+        rng = _np.random.default_rng(0)
+        x = _np.ones((16,), _np.float32)
+        futures, sheds, refused = [], 0, []
+        t_next = _t.perf_counter()
+        t_end = t_next + seconds
+        while True:
+            now = _t.perf_counter()
+            if now >= t_end:
+                return futures, sheds, refused
+            t_next += rng.exponential(1.0 / lam)
+            # open-loop discipline: arrivals the generator could not
+            # keep up with are dropped from the schedule, not burst as
+            # a GIL-bound backlog (the rate cap is the generator's)
+            t_next = max(t_next, now - 0.002)
+            if t_next > now:
+                _t.sleep(t_next - now)
+            try:
+                futures.append(pool.submit({"x": x}, timeout_ms=1000.0))
+            except ServingOverloadError:
+                sheds += 1
+            except Exception as e:  # noqa: BLE001 — gate-fatal bucket
+                refused.append(f"{type(e).__name__}: {e}")
+
+    def settle(futures):
+        """Resolve every submitted future; returns (ok, timeouts,
+        failures) — an unresolved future is a DROP and gate-fatal."""
+        ok, timeouts, failures = 0, 0, []
+        for f in futures:
+            try:
+                f.result(10.0)
+                ok += 1
+            except RequestTimeoutError:
+                timeouts += 1
+            except Exception as e:  # noqa: BLE001 — gate-fatal bucket
+                failures.append(f"{type(e).__name__}: {e}")
+        return ok, timeouts, failures
+
+    def p99(vals):
+        vals.sort()
+        return vals[min(len(vals) - 1,
+                        int(0.99 * (len(vals) - 1)))] if vals else None
+
+    try:
+        steady_lam = 0.5 * sustained_rps
+        pool_metrics.drain_latencies()
+        futs, steady_sheds, steady_refused = open_loop(steady_s,
+                                                       steady_lam)
+        s_ok, s_to, s_fail = settle(futs)
+        steady_p99 = p99(pool_metrics.drain_latencies())
+        # arm SLO admission, self-tuned from the measured steady p99:
+        # the controller sheds on PREDICTED p99 so the spike's tail is
+        # bounded by refusals, not by queueing (2.0x leaves the last
+        # admitted request's own service time inside the 3x gate)
+        slo_ms = max(10.0, 2.0 * (steady_p99 or 10.0))
+        pool.admission.slo_p99_ms = slo_ms
+        futs, spike_sheds, spike_refused = open_loop(
+            max(1.0, steady_s / 2), spike_x * steady_lam)
+        k_ok, k_to, k_fail = settle(futs)
+        spike_p99 = p99(pool_metrics.drain_latencies())
+    finally:
+        pool.close()
+        _sys.setswitchinterval(prev_switch)
+
+    bar = 3.0
+    ratio = (spike_p99 / steady_p99
+             if steady_p99 and spike_p99 else None)
+    spike = {
+        "metric": "serve_spike_p99_ms",
+        "value": spike_p99, "unit": "ms",
+        "steady_p99_ms": steady_p99,
+        "ratio_vs_steady": round(ratio, 3) if ratio else None,
+        "bar_ratio": bar,
+        "spike_x": spike_x,
+        "steady_rate_rps": round(steady_lam, 1),
+        "slo_p99_ms": round(slo_ms, 1),
+        "served_steady": s_ok, "served_spike": k_ok,
+        "shed_steady": steady_sheds, "shed_spike": spike_sheds,
+        "timeouts": s_to + k_to,
+        "non_shed_failures": (steady_refused + spike_refused
+                              + s_fail + k_fail),
+        "passed": bool(ratio is not None and ratio <= bar
+                       and spike_sheds > 0
+                       and s_to + k_to == 0
+                       and not (steady_refused + spike_refused
+                                + s_fail + k_fail)),
+    }
+    return {"serve_sustained": sustained, "serve_spike": spike}
+
+
 _COLD_START_CHILD = r'''
 import json, os, sys, time
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -1045,6 +1239,24 @@ def main():
                 log(f"telemetry phase failed: {type(e).__name__}: {e}")
                 result["telemetry"] = {
                     "metric": "telemetry_disabled_span_ns",
+                    "error": f"{type(e).__name__}: {e}"}
+
+        if _cfg0.get("BENCH_SERVE_SPIKE"):
+            try:
+                result.update(measure_serve_pool())
+                ss, sp = result["serve_sustained"], result["serve_spike"]
+                log(f"[serve_pool] sustained {ss['value']} img/s vs "
+                    f"single {ss['single_batcher_img_per_sec']} "
+                    f"({ss['ratio_vs_single']}x, bar {ss['bar_ratio']}x, "
+                    f"{'PASS' if ss['passed'] else 'FAIL'}); spike p99 "
+                    f"{sp['value']}ms vs steady {sp['steady_p99_ms']}ms "
+                    f"({sp['ratio_vs_steady']}x, bar {sp['bar_ratio']}x, "
+                    f"shed {sp['shed_spike']}, "
+                    f"{'PASS' if sp['passed'] else 'FAIL'})")
+            except Exception as e:
+                log(f"serve_pool phase failed: {type(e).__name__}: {e}")
+                result["serve_spike"] = {
+                    "metric": "serve_spike_p99_ms",
                     "error": f"{type(e).__name__}: {e}"}
 
         if _cfg0.get("BENCH_CHAOS"):

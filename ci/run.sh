@@ -40,7 +40,13 @@ echo "== mesh fused step smoke (dp x tp fit: dispatch budget, kvstore-loop parit
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
   python -m mxnet_tpu.parallel.fused
 
-echo "== serving smoke (dynamic batcher, 64 concurrent clients) =="
+echo "== serving smoke (replica pools: 64-client burst + autoscaling hot-swap) =="
+# phase 1: 64 concurrent clients against a 2-replica pool with a small
+# queue — every request answered correctly or shed with a structured
+# error; phase 2: ModelRepository.watch hot-swaps a newly committed
+# checkpoint step under sustained load — ZERO dropped non-shed requests
+# and ZERO executor-cache misses after the flip (warm-before-flip x
+# replica pools, docs/serving.md)
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
   python -m mxnet_tpu.serving.smoke
 
@@ -60,13 +66,14 @@ echo "== compile smoke (persistent cache, ladder warmup, retrace ratchet) =="
 JAX_PLATFORMS=cpu python -m mxnet_tpu.compile.smoke
 
 echo "== chaos smoke (failpoints, composed fault scenarios, self-healing) =="
-# the five composed scenarios: kvstore worker kill/revive commits past
+# the six composed scenarios: kvstore worker kill/revive commits past
 # the kill, corrupt-checkpoint-under-reload serves the old version with
 # zero non-shed failures, a wedged batcher stays p99-bounded under a
-# named watchdog stall, a mid-scan-window SIGKILL resumes
-# bit-identically, and the stalled/killed mesh fused step self-heals +
-# resumes bit-identically onto a resized mesh; disabled-failpoint overhead must stay < 1us
-# (docs/chaos.md)
+# named watchdog stall, a serving replica killed mid-burst drains with
+# zero non-shed drops while siblings absorb the load, a mid-scan-window
+# SIGKILL resumes bit-identically, and the stalled/killed mesh fused
+# step self-heals + resumes bit-identically onto a resized mesh;
+# disabled-failpoint overhead must stay < 1us (docs/chaos.md)
 JAX_PLATFORMS=cpu python -m mxnet_tpu.chaos.smoke
 
 echo "== entry points =="

@@ -94,6 +94,10 @@ SITES = {
     "serving/batcher/worker":
         "batcher worker loop, inside the watchdog arm, before the batch "
         "runs (raise kills the worker; wedge stalls it)",
+    "serving/router/dispatch":
+        "ReplicaPool.submit, before the request is handed to the chosen "
+        "replica (raise exercises the spill path: the router re-routes "
+        "to the next-least-loaded sibling)",
     "serving/repository/poll":
         "ModelRepository.poll_checkpoint, before the committed-step scan",
     "serving/repository/warm_hook":

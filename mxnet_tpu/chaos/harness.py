@@ -30,6 +30,11 @@ tested alone:
    dp=4 mesh fit SIGKILLs mid-run and a boundary-checkpoint restore
    onto a RESIZED dp=2 mesh continues bit-identically to a planned
    resize (elastic restore as the resize mechanism).
+6. **replica kill mid-burst** (ISSUE 10) — injected
+   ``serving/router/dispatch`` faults spill to sibling replicas, then
+   one replica of the pool is removed under load: it drains everything
+   it admitted, the survivors absorb the traffic, and zero non-shed
+   requests are dropped or hung.
 
 Every scenario ends in recovery or a typed error — the assertions
 include "no hang" (bounded waits everywhere) and "no silent loss"
@@ -468,6 +473,113 @@ def scenario_wedged_batcher(seconds=2.0, watchdog_s=0.4, n_clients=6):
 
 
 # ---------------------------------------------------------------------------
+# scenario: replica killed mid-burst — the router drains it, siblings
+# absorb, zero non-shed requests dropped (ISSUE 10)
+# ---------------------------------------------------------------------------
+def scenario_replica_kill_mid_burst(seconds=2.5, n_replicas=3,
+                                    n_clients=8):
+    """Chaos over the ReplicaPool router: injected dispatch faults must
+    SPILL to siblings (``serving/router/dispatch`` raises, the rescued
+    requests still answer), then one replica is killed mid-burst
+    (``remove_replica`` = drain + drop, the kill path an autoscaler or
+    an operator takes) — its admitted requests all complete, the
+    surviving replicas absorb the load, p99 stays bounded, and not one
+    non-shed request is dropped or left hanging."""
+    import numpy as np
+
+    from .. import telemetry
+    from ..serving.batcher import (RequestTimeoutError,
+                                   ServingOverloadError)
+    from ..serving.metrics import ServingMetrics
+    from ..serving.router import ReplicaPool
+
+    def factory(rid):
+        def run(feed, n_real):
+            time.sleep(0.002)
+            return [feed["x"] * 2.0]
+        return run
+
+    chaos.reset()
+    # 12 injected dispatch faults, probabilistic so siblings rescue
+    # (an arm firing on EVERY attempt would fail all K hops of one
+    # request — that is the all-replicas-refused path, not spill)
+    chaos.arm("serving/router/dispatch", "raise", prob=0.5, count=12)
+    spill_counter = telemetry.REGISTRY.counter(
+        "mxnet_serving_router_spill_total")
+    spills0 = spill_counter.value(labels={"model": "chaos-pool"})
+
+    pool = ReplicaPool(factory, num_replicas=n_replicas,
+                       name="chaos-pool", model="chaos-pool",
+                       metrics=ServingMetrics("chaos-pool"),
+                       max_batch_size=8, max_latency_ms=2.0,
+                       num_workers=1, max_queue_depth=64,
+                       shed_watermark=32)
+    result = {"ok": False, "non_typed_failures": [], "shed": 0,
+              "served": 0, "injected_refusals": 0}
+    lat_ms = []
+    lock = threading.Lock()
+    stop_t = time.perf_counter() + seconds
+    try:
+        def client():
+            x = np.ones((8,), np.float32)
+            while time.perf_counter() < stop_t:
+                t0 = time.perf_counter()
+                try:
+                    pool.submit({"x": x}, timeout_ms=2000.0).result(10.0)
+                    with lock:
+                        lat_ms.append((time.perf_counter() - t0) * 1e3)
+                        result["served"] += 1
+                except ServingOverloadError:
+                    with lock:
+                        result["shed"] += 1
+                    time.sleep(0.001)
+                except chaos.ChaosInjectedError:
+                    # every replica's dispatch took the injected fault:
+                    # typed + retryable — the client retries, nothing
+                    # is silently lost
+                    with lock:
+                        result["injected_refusals"] += 1
+                except Exception as e:  # noqa: BLE001 — gate-fatal bucket
+                    with lock:
+                        result["non_typed_failures"].append(
+                            f"{type(e).__name__}: {e}")
+
+        clients = [threading.Thread(target=client)
+                   for _ in range(n_clients)]
+        for t in clients:
+            t.start()
+        # mid-burst: kill replica 0 (drain-on-removal — the router
+        # finishes everything it admitted, then drops it from routing)
+        time.sleep(seconds / 2)
+        victim_rid = pool.replica_ids()[0]
+        victim = pool.remove_replica(victim_rid, drain=True)
+        result["victim_drained"] = victim.occupancy() == 0
+        result["survivors"] = pool.replica_ids()
+        for t in clients:
+            t.join(timeout=30)
+        # every admitted request resolved: one more round trip proves
+        # the survivors still serve
+        x = np.ones((8,), np.float32)
+        pool.submit({"x": x}).result(10.0)
+        lat_ms.sort()
+        result["p99_ms"] = _percentile(lat_ms, 99)
+        result["spills"] = (spill_counter.value(
+            labels={"model": "chaos-pool"}) - spills0)
+        result["ok"] = bool(
+            result["victim_drained"]
+            and len(result["survivors"]) == n_replicas - 1
+            and result["served"] > 0
+            and result["spills"] >= 1
+            and not result["non_typed_failures"]
+            and result["p99_ms"] is not None
+            and result["p99_ms"] < 1000.0)
+    finally:
+        chaos.reset()
+        pool.close(timeout=5.0)
+    return result
+
+
+# ---------------------------------------------------------------------------
 # scenario 4: SIGKILL mid-scan-window, bit-identical resume
 # ---------------------------------------------------------------------------
 _SCAN_VICTIM = """
@@ -875,6 +987,7 @@ def run_all(workdir=None, verbose=True):
          lambda: scenario_corrupt_reload_under_load(
              os.path.join(base, "s2"))),
         ("wedged_batcher", scenario_wedged_batcher),
+        ("replica_kill_mid_burst", scenario_replica_kill_mid_burst),
         ("sigkill_mid_scan",
          lambda: scenario_sigkill_mid_scan(os.path.join(base, "s4"))),
         ("mesh_collective_stall",

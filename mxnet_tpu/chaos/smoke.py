@@ -1,4 +1,4 @@
-"""Chaos smoke for CI: replay the five composed fault scenarios.
+"""Chaos smoke for CI: replay the six composed fault scenarios.
 
 Asserted per scenario (the ISSUE 8 acceptance contract):
 
@@ -20,6 +20,10 @@ Asserted per scenario (the ISSUE 8 acceptance contract):
    stalled mesh step and the fit self-healed; the SIGKILLed dp=4 mesh
    fit restored onto a RESIZED dp=2 mesh and continued BIT-identically
    to a planned resize.
+6. replica kill mid-burst (ISSUE 10) — injected router dispatch faults
+   spilled to sibling replicas, the replica removed under load drained
+   everything it admitted, the survivors kept serving, and zero
+   non-shed requests were dropped or hung.
 
 Plus the standing invariants: no scenario hangs (every wait here is
 bounded) and the disabled-failpoint overhead stays under the 1 us bar.
@@ -68,7 +72,9 @@ def main():
     print("chaos smoke OK: worker kill/revive committed past the kill, "
           "corrupt reload served the old version with zero non-shed "
           "failures, wedged batcher stayed bounded under a named "
-          "watchdog stall, mid-window SIGKILL resumed bit-identically, "
+          "watchdog stall, the replica killed mid-burst drained with "
+          "zero non-shed drops while siblings absorbed the load, "
+          "mid-window SIGKILL resumed bit-identically, "
           "and the stalled mesh step self-healed + resumed "
           "bit-identically onto a resized mesh")
 

@@ -289,7 +289,23 @@ _register("MXNET_SERVING_SHED_WATERMARK", int, 0,
           "queue depth at which submits fail fast with "
           "ServingOverloadError; 0 = at queue capacity")
 _register("MXNET_SERVING_NUM_WORKERS", int, 1,
-          "batch-execution worker threads per model endpoint")
+          "batch-execution worker threads per batcher replica (each "
+          "worker is a stage/dispatch thread pair: micro-batch N+1 "
+          "coalesces and stacks while N executes)")
+_register("MXNET_SERVING_REPLICAS", int, 1,
+          "DynamicBatcher replicas per model endpoint, behind the "
+          "load-aware ReplicaPool router (occupancy x drain-time EWMA "
+          "routing, graceful spill, drain-on-removal); 1 = single "
+          "batcher (docs/serving.md replica pools)")
+_register("MXNET_SERVING_SLO_P99_MS", float, 0.0,
+          "SLO admission control: shed (ServingOverloadError) once the "
+          "router's PREDICTED p99 — pool occupancy / service-rate EWMA "
+          "— exceeds this many ms, so the shed point self-tunes to the "
+          "model's measured speed; 0 disables (watermark shedding "
+          "still applies per replica)")
+_register("MXNET_SERVING_SLO_EWMA_ALPHA", float, 0.2,
+          "smoothing factor for the admission controller's service-"
+          "rate EWMA (higher = faster adaptation, noisier predictions)")
 _register("MXNET_SERVING_TIMEOUT_MS", float, 0.0,
           "default per-request timeout (queued past this -> "
           "RequestTimeoutError); 0 disables")
@@ -379,6 +395,22 @@ _register("BENCH_SERVE_BATCH", int, 32,
           "bench.py serving phase: DynamicBatcher max_batch_size")
 _register("BENCH_SERVE_LATENCY_MS", float, 10.0,
           "bench.py serving phase: DynamicBatcher max_latency_ms")
+_register("BENCH_SERVE_SPIKE", bool, True,
+          "bench.py: also measure the replica-pool phases "
+          "serve_sustained_img_per_sec (pool >= 2x single-batcher "
+          "throughput) and serve_spike_p99_ms (p99 under a 10x Poisson "
+          "spike <= 3x steady, excess shed typed); pure-host runner, "
+          "needs no TPU relay")
+_register("BENCH_SERVE_SPIKE_SECONDS", float, 2.0,
+          "bench.py spike phase: steady-state window length (s); the "
+          "spike window runs half as long at BENCH_SERVE_SPIKE_X the "
+          "arrival rate")
+_register("BENCH_SERVE_SPIKE_X", float, 10.0,
+          "bench.py spike phase: spike arrival-rate multiplier over "
+          "the steady-state Poisson rate")
+_register("BENCH_SERVE_SPIKE_REPLICAS", int, 4,
+          "bench.py spike phase: ReplicaPool size (the >= 2x-vs-single "
+          "throughput gate scales with this)")
 _register("BENCH_DISPATCH", bool, True,
           "bench.py: measure fused-train-step dispatch phases on the CPU "
           "backend (resnet50_step_dispatches / train_step_ms_bs32); "

@@ -1,15 +1,20 @@
-"""mxnet_tpu.serving — dynamic-batching inference serving.
+"""mxnet_tpu.serving — continuously-batched replica-pool serving.
 
 The L-layer above the executor that the ROADMAP's "serves heavy traffic"
 north star needs: a versioned ModelRepository (hot reload, multi-model),
 a compiled-executor cache with shape bucketing (measured ladders from
 mxnet_tpu.compile's BucketPlanner, power-of-two before any traffic;
 repeated shapes reuse one XLA program, padding handled transparently,
-publish-time AOT warmup — see docs/compile.md), and a
-DynamicBatcher draining a bounded queue under a max_batch_size /
-max_latency_ms deadline policy — with load shedding, per-request
-timeouts, graceful drain, and p50/p90/p99 serving metrics exported
-through the profiler counter lanes.  See docs/serving.md.
+publish-time AOT warmup — see docs/compile.md), a DynamicBatcher that
+batches CONTINUOUSLY (cohort-aware admission into the forming
+micro-batch, stage/dispatch pipelining so batch N+1 coalesces while N
+executes) under a max_batch_size / max_latency_ms deadline policy, and
+a ReplicaPool router scaling each model endpoint across K batcher
+replicas — load-aware routing on occupancy x drain-time EWMA, graceful
+spill to siblings, predicted-p99 SLO admission control, and
+drain-on-removal — with load shedding, per-request timeouts, graceful
+drain, and p50/p90/p99 serving metrics exported through the profiler
+counter lanes and the telemetry registry.  See docs/serving.md.
 """
 from .batcher import (DynamicBatcher, RequestTimeoutError, ServeFuture,
                       ServingClosedError, ServingOverloadError,
@@ -20,11 +25,14 @@ from .executor_cache import (CachedExecutor, ExecutorCache,
                              shared_cache)
 from .metrics import ServingMetrics, stats
 from .repository import ModelRepository
+from .router import AdmissionController, ReplicaPool
 from .server import ModelServer
 
 __all__ = [
-    "CachedExecutor", "DynamicBatcher", "ExecutorCache", "ModelRepository",
-    "ModelServer", "RequestTimeoutError", "ServeFuture", "ServingClosedError",
+    "AdmissionController", "CachedExecutor", "DynamicBatcher",
+    "ExecutorCache", "ModelRepository",
+    "ModelServer", "ReplicaPool", "RequestTimeoutError", "ServeFuture",
+    "ServingClosedError",
     "ServingMetrics", "ServingOverloadError", "ServingWorkerError",
     "bind_inference_executor",
     "bucket_batch", "feed_signature", "pad_to", "shape_signature",

@@ -1,15 +1,33 @@
-"""DynamicBatcher: deadline-bounded request coalescing.
+"""DynamicBatcher: continuously-batched, deadline-bounded coalescing.
 
 Requests (one sample each, no batch dim) enter a bounded queue; worker
-threads drain it into batches under the policy
+pipelines drain it into micro-batches under the policy
 
 * flush when ``max_batch_size`` requests have coalesced, OR
 * flush when ``max_latency_ms`` has elapsed since the oldest queued
   request started waiting (a lone request never waits longer than the
   deadline — the throughput-vs-p99 knob, see docs/serving.md);
 * a burst larger than ``max_batch_size`` is split into micro-batches:
-  each worker pass takes at most ``max_batch_size`` requests and the
+  each stage pass takes at most ``max_batch_size`` requests and the
   remainder stays queued for the next pass (or another worker).
+
+Continuous batching (ISSUE 10 tentpole):
+
+* **cohort-aware admission** — a forming micro-batch anchors on the
+  OLDEST queued request and admits only requests with the same input
+  signature; a mismatched arrival stays queued for the *next*
+  micro-batch (a sibling worker dispatches it concurrently) instead of
+  being drained into the cohort and serialized behind it.  Arrivals
+  with the anchor's signature keep joining the forming batch until it
+  is full or the anchor's deadline flushes it — admission never stops
+  while a batch forms.
+* **stage/dispatch pipeline** — each worker is a thread pair: the
+  *stage* thread coalesces micro-batch N+1 and stacks its host arrays
+  while the *dispatch* thread still executes micro-batch N (the
+  ``io.stage_batch`` double-buffer trick from PR 4, applied to
+  serving).  Staged batches hand off through one shared bounded buffer,
+  so a wedged dispatch thread never strands work a stage thread
+  claimed — any healthy dispatch picks it up.
 
 Robustness contract:
 
@@ -32,6 +50,7 @@ Robustness contract:
 from __future__ import annotations
 
 import collections
+import queue
 import threading
 import time
 
@@ -44,17 +63,30 @@ from .metrics import ServingMetrics
 
 
 class ServingOverloadError(MXNetError):
-    """Load shed: queue depth reached the watermark (backpressure)."""
+    """Load shed: queue depth reached the watermark (backpressure), or
+    the router's SLO admission controller predicted a p99 breach
+    (``predicted_p99_ms``/``slo_ms`` are set in that case)."""
 
-    def __init__(self, batcher, queue_depth, watermark):
+    def __init__(self, batcher, queue_depth, watermark,
+                 predicted_p99_ms=None, slo_ms=None):
         self.batcher = batcher
         self.queue_depth = queue_depth
         self.watermark = watermark
-        super().__init__(
-            f"serving[{batcher}]: queue depth {queue_depth} >= shed "
-            f"watermark {watermark}; request shed — retry with backoff "
-            "(load-shedding keeps p99 bounded instead of queueing "
-            "unboundedly)")
+        self.predicted_p99_ms = predicted_p99_ms
+        self.slo_ms = slo_ms
+        if predicted_p99_ms is not None:
+            msg = (f"serving[{batcher}]: predicted p99 "
+                   f"{predicted_p99_ms:.1f}ms exceeds the "
+                   f"{slo_ms:.1f}ms SLO at occupancy {queue_depth}; "
+                   "request shed — retry with backoff (admission "
+                   "control sheds on PREDICTED latency so the p99 of "
+                   "admitted requests stays inside the SLO)")
+        else:
+            msg = (f"serving[{batcher}]: queue depth {queue_depth} >= "
+                   f"shed watermark {watermark}; request shed — retry "
+                   "with backoff (load-shedding keeps p99 bounded "
+                   "instead of queueing unboundedly)")
+        super().__init__(msg)
 
 
 class RequestTimeoutError(MXNetError):
@@ -214,15 +246,31 @@ class DynamicBatcher:
         self._restart_budget = int(cfg("MXNET_SERVING_WORKER_RESTARTS"))
         self._restarts = 0
         self._failed = False
-        # batches claimed by a worker but not yet finished, by worker
-        # thread ident — the sweep fails their expired-deadline requests
-        # with RequestTimeoutError when the claiming thread is wedged
-        # (a wedged worker must never silently hold requests forever)
+        # batches claimed but not yet finished — int keys are dispatch
+        # thread idents (executing), ("staged", seq) keys are batches
+        # coalesced by a stage thread but not yet picked up.  The sweep
+        # fails their expired-deadline requests with RequestTimeoutError
+        # when the claiming thread is wedged (a wedged worker must never
+        # silently hold requests forever)
         self._inflight = {}
-        self._workers = [
-            threading.Thread(target=self._worker_loop, daemon=True,
-                             name=f"mx-serving-{name}-{i}")
-            for i in range(n_workers)]
+        # requests claimed by the stage pipeline (staged or stage-held)
+        # but not yet executing: still counted against the shed
+        # watermark, so continuous batching does not widen admission
+        self._staged_n = 0
+        self._staged_seq = 0
+        # stage -> dispatch handoff: SHARED bounded buffer (not
+        # per-worker slots) so a wedged dispatch thread never strands a
+        # staged batch — any healthy dispatch drains it
+        self._staged_q = queue.Queue(maxsize=n_workers)
+        self.num_workers = n_workers
+        self._workers = []
+        for i in range(n_workers):
+            self._workers.append(threading.Thread(
+                target=self._stage_loop, daemon=True,
+                name=f"mx-serving-{name}-{i}-stage"))
+            self._workers.append(threading.Thread(
+                target=self._dispatch_loop, daemon=True,
+                name=f"mx-serving-{name}-{i}"))
         for t in self._workers:
             t.start()
 
@@ -263,46 +311,89 @@ class DynamicBatcher:
             if self._closed:
                 self.metrics.incr("rejected_total")
                 raise ServingClosedError(self.name)
-            depth = len(self._queue)
+            # staged-but-not-executing requests still count against the
+            # watermark: the pipeline must not quietly deepen admission
+            depth = len(self._queue) + self._staged_n
             if depth >= self.shed_watermark:
                 self.metrics.incr("shed_total")
                 raise ServingOverloadError(self.name, depth,
                                            self.shed_watermark)
             self._queue.append(req)
-            self.metrics.gauge("queue_depth", len(self._queue))
+            self.metrics.gauge("queue_depth",
+                               len(self._queue) + self._staged_n)
             self._sweep_inflight_locked()
             self._cond.notify()
         self.metrics.incr("requests_total")
         return req.future
 
-    # -- worker -------------------------------------------------------------
+    # -- stage (coalesce + stack) -------------------------------------------
     def _take_batch(self):
-        """Block for the first request, then coalesce up to
-        ``max_batch_size`` under the ``max_latency_ms`` deadline.
-        Returns [] only at shutdown with an empty queue."""
+        """Block for the oldest request, then coalesce a same-signature
+        cohort up to ``max_batch_size`` under the ``max_latency_ms``
+        deadline (anchored at the OLDEST member's enqueue: a request
+        never waits for stragglers longer than the policy).
+
+        Continuous admission: requests that arrive while the batch forms
+        JOIN it when they carry the anchor's signature; a mismatched
+        arrival stays queued for the next micro-batch — a sibling worker
+        dispatches it concurrently instead of it riding (and being
+        serialized behind) this cohort.  Returns ``(token, batch)`` with
+        the batch claimed as staged, or ``(None, [])`` at shutdown /
+        fail-fast with nothing left to take."""
         with self._cond:
-            while not self._queue and not self._closed:
+            while not self._queue and not self._closed and not self._failed:
                 self._cond.wait(0.05)
                 # idle tick: an otherwise-quiet batcher still fails
                 # expired requests stuck on a wedged sibling worker
                 self._sweep_inflight_locked()
-            if not self._queue:
-                return []
+            if self._failed or not self._queue:
+                return None, []
             batch = [self._queue.popleft()]
-            # the deadline anchors at the OLDEST member's enqueue: a
-            # request never waits for stragglers longer than the policy
+            sig = batch[0].sig
             flush_at = batch[0].t_enqueue + self.max_latency_ms / 1e3
             while len(batch) < self.max_batch_size:
-                if self._queue:
-                    batch.append(self._queue.popleft())
+                if self._take_matching_locked(batch, sig):
                     continue
                 remaining = flush_at - time.perf_counter()
-                if remaining <= 0 or self._closed:
+                if remaining <= 0 or self._closed or self._failed:
                     break
                 self._cond.wait(remaining)
-            self.metrics.gauge("queue_depth", len(self._queue))
+            token = self._claim_staged_locked(batch)
+            self.metrics.gauge("queue_depth",
+                               len(self._queue) + self._staged_n)
             self._sweep_inflight_locked()
-            return batch
+            return token, batch
+
+    def _take_matching_locked(self, batch, sig):
+        """Move the oldest queued request with ``sig`` into ``batch``;
+        False when none is queued.  Mismatched requests keep their queue
+        position (and their own deadline anchor) for the next pass."""
+        # graftlint: disable=lock-discipline -- callers hold self._cond (the _locked suffix is the contract, as in _sweep_inflight_locked)
+        for idx, req in enumerate(self._queue):
+            if req.sig == sig:
+                # graftlint: disable=lock-discipline -- callers hold self._cond (the _locked suffix is the contract)
+                del self._queue[idx]
+                batch.append(req)
+                return True
+        return False
+
+    def _claim_staged_locked(self, batch):
+        """Register a freshly-coalesced batch as staged: it has left the
+        queue but not yet reached a dispatch thread, so it must stay
+        visible to both the shed watermark and the in-flight sweep."""
+        # graftlint: disable=lock-discipline -- callers hold self._cond (the _locked suffix is the contract, as in _sweep_inflight_locked)
+        self._staged_seq += 1
+        token = ("staged", self._staged_seq)
+        # graftlint: disable=lock-discipline -- callers hold self._cond (the _locked suffix is the contract)
+        self._inflight[token] = batch
+        # graftlint: disable=lock-discipline -- callers hold self._cond (the _locked suffix is the contract)
+        self._staged_n += len(batch)
+        return token
+
+    def _unclaim_staged(self, token, batch):
+        with self._cond:
+            if self._inflight.pop(token, None) is not None:
+                self._staged_n -= len(batch)
 
     def _sweep_inflight_locked(self):
         """Fail expired-deadline requests held by OTHER (wedged) worker
@@ -328,22 +419,93 @@ class DynamicBatcher:
         if timeouts:
             self.metrics.incr("timeouts_total", timeouts)
 
-    def _worker_loop(self):
+    def _stage_feed(self, batch):
+        """Stack one same-signature cohort into the runner feed — the
+        host-side work the pipeline overlaps with the dispatch thread's
+        in-flight runner call."""
+        names = list(batch[0].inputs)
+        return {k: np.stack([r.inputs[k] for r in batch]) for k in names}
+
+    def _stage_loop(self):
+        """Coalesce + stack micro-batch N+1 while a dispatch thread
+        executes micro-batch N; hand off through the shared staged
+        buffer.  Exits by enqueueing one shutdown sentinel (None) so
+        exactly one dispatch thread retires with it."""
         while True:
             batch = []
             try:
-                batch = self._take_batch()
+                token, batch = self._take_batch()
                 if not batch:
-                    return  # closed and drained
+                    self._put_staged(None)
+                    return  # closed and drained (or failed fast)
+                try:
+                    feed = self._stage_feed(batch)
+                except Exception as e:  # noqa: BLE001 — fails this batch alone
+                    self._unclaim_staged(token, batch)
+                    exc = MXNetError(
+                        f"serving[{self.name}]: batch staging failed: "
+                        f"{type(e).__name__}: {e}")
+                    for req in batch:
+                        if not req.future.done():
+                            req.future._set_exception(exc)
+                    self.metrics.incr("errors_total", len(batch))
+                    continue
+                if not self._put_staged((token, batch, feed)):
+                    # batcher failed fast while we held a staged batch
+                    self._unclaim_staged(token, batch)
+                    err = ServingWorkerError(self.name, exhausted=True)
+                    for req in batch:
+                        if not req.future.done():
+                            req.future._set_exception(err)
+                    self.metrics.incr("errors_total", len(batch))
+            except BaseException as e:  # noqa: BLE001 — worker self-healing
+                if not self._survive_crash(batch, e):
+                    return
+
+    def _put_staged(self, item):
+        """Bounded put into the staged buffer; gives up (False) only
+        when the batcher has failed fast — never blocks forever behind
+        dead dispatch threads."""
+        while True:
+            # graftlint: disable=lock-discipline -- _failed is a monotonic False->True latch; a stale read here only delays the fail-fast exit by one 50ms tick
+            if self._failed:
+                return False
+            try:
+                self._staged_q.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+
+    def _get_staged(self):
+        while True:
+            try:
+                return self._staged_q.get(timeout=0.1)
+            except queue.Empty:
+                # graftlint: disable=lock-discipline -- _failed is a monotonic False->True latch; a stale read here only delays the fail-fast exit by one 100ms tick
+                if self._failed:
+                    return None
+
+    def _dispatch_loop(self):
+        while True:
+            batch = []
+            try:
+                item = self._get_staged()
+                if item is None:
+                    return  # stage sentinel (drained) or failed fast
+                token, batch, feed = item
                 with self._cond:
+                    # claim moves staged -> executing atomically: the
+                    # batch stays sweepable throughout
                     self._inflight[threading.get_ident()] = batch
+                    if self._inflight.pop(token, None) is not None:
+                        self._staged_n -= len(batch)
                 try:
                     with _watchdog.arm(f"serving/{self.name}"):
                         # the chaos hook sits INSIDE the watchdog arm: a
                         # wedge here is exactly a runner stuck in compile
                         # — the watchdog must see (and name) it
                         _failpoint("serving/batcher/worker")
-                        self._run_batch(batch)
+                        self._run_batch(batch, feed)
                 finally:
                     with self._cond:
                         self._inflight.pop(threading.get_ident(), None)
@@ -388,6 +550,9 @@ class DynamicBatcher:
             "%d queued request(s) and rejecting new submits", self.name,
             self._restart_budget, len(doomed))
         fail = ServingWorkerError(self.name, exhausted=True)
+        # staged batches would otherwise sit unexecuted behind dead
+        # dispatch threads: drain the handoff buffer and fail them too
+        doomed += self._drain_staged()
         for req in doomed:
             if not req.future.done():
                 req.future._set_exception(fail)
@@ -395,55 +560,92 @@ class DynamicBatcher:
             self.metrics.incr("errors_total", len(doomed))
         return False
 
-    def _run_batch(self, batch):
-        """Execute one taken batch (hang-watchdog armed by the caller:
-        a runner wedged in compile/execute for MXNET_WATCHDOG_S seconds
-        gets an all-thread stack dump instead of a silent stall)."""
+    def _drain_staged(self):
+        """Empty the stage->dispatch buffer (fail-fast path); returns
+        the requests of every staged batch it removed."""
+        out = []
+        while True:
+            try:
+                item = self._staged_q.get_nowait()
+            except queue.Empty:
+                return out
+            if item is None:
+                continue
+            token, batch, _feed = item
+            self._unclaim_staged(token, batch)
+            out.extend(batch)
+
+    def _run_batch(self, batch, feed):
+        """Execute one staged same-signature cohort (hang-watchdog armed
+        by the caller: a runner wedged in compile/execute for
+        MXNET_WATCHDOG_S seconds gets an all-thread stack dump instead
+        of a silent stall).  ``feed`` was stacked by the stage thread;
+        it is re-stacked here only when a member expired (or was swept)
+        between staging and dispatch, so a dead request never occupies a
+        batch row."""
         now = time.perf_counter()
-        live = []
+        live, dropped = [], False
         for req in batch:
-            if req.deadline is not None and now > req.deadline:
+            if req.future.done():
+                # already resolved from outside (in-flight sweep on a
+                # wedged thread, fail-fast) — must not be re-counted
+                dropped = True
+            elif req.deadline is not None and now > req.deadline:
                 waited = (now - req.t_enqueue) * 1e3
                 timeout = (req.deadline - req.t_enqueue) * 1e3
                 req.future._set_exception(RequestTimeoutError(
                     self.name, waited, timeout))
                 self.metrics.incr("timeouts_total")
+                dropped = True
             else:
                 live.append(req)
         if not live:
             return
-        # cohorts: requests only share a runner call with requests
-        # of the SAME input signature, so a mismatched/malformed
-        # request fails alone instead of poisoning its neighbours
-        cohorts = collections.OrderedDict()
-        for req in live:
-            cohorts.setdefault(req.sig, []).append(req)
-        for cohort in cohorts.values():
-            try:
-                names = list(cohort[0].inputs)
-                feed = {k: np.stack([r.inputs[k] for r in cohort])
-                        for k in names}
-                outputs = self._runner(feed, len(cohort))
-            except Exception as e:  # noqa: BLE001 — fanned out per req
-                exc = e if isinstance(e, MXNetError) else MXNetError(
-                    f"serving[{self.name}]: batch execution failed: "
-                    f"{type(e).__name__}: {e}")
-                for req in cohort:
-                    req.future._set_exception(exc)
-                self.metrics.incr("errors_total", len(cohort))
-                continue
-            done = time.perf_counter()
-            for i, req in enumerate(cohort):
-                req.future._set_result([out[i] for out in outputs])
-                self.metrics.observe_latency(
-                    (done - req.t_enqueue) * 1e3)
-            _watchdog.beat(f"serving/{self.name}")
-            self.metrics.incr("responses_total", len(cohort))
+        try:
+            if dropped:
+                feed = self._stage_feed(live)
+            outputs = self._runner(feed, len(live))
+        except Exception as e:  # noqa: BLE001 — fanned out per req
+            exc = e if isinstance(e, MXNetError) else MXNetError(
+                f"serving[{self.name}]: batch execution failed: "
+                f"{type(e).__name__}: {e}")
+            for req in live:
+                req.future._set_exception(exc)
+            self.metrics.incr("errors_total", len(live))
+            return
+        done = time.perf_counter()
+        for i, req in enumerate(live):
+            req.future._set_result([out[i] for out in outputs])
+            self.metrics.observe_latency((done - req.t_enqueue) * 1e3)
+        _watchdog.beat(f"serving/{self.name}")
+        self.metrics.incr("responses_total", len(live))
+
+    # -- load introspection (the router's routing signal) --------------------
+    def occupancy(self):
+        """Requests this batcher owns right now: queued + staged +
+        executing.  The ReplicaPool routes on this (occupancy x the
+        pool's drain-time EWMA = predicted wait behind this replica)."""
+        with self._cond:
+            n = len(self._queue) + self._staged_n
+            # graftlint: disable=lock-discipline -- self._cond is held (same contract as the other _locked readers)
+            for key, batch in self._inflight.items():
+                if isinstance(key, int):  # claimed by a dispatch thread
+                    n += len(batch)
+            return n
+
+    @property
+    def failed(self):
+        """True once the worker restart budget is exhausted — the
+        batcher rejects all traffic and a router must route around it."""
+        # graftlint: disable=lock-discipline -- monotonic False->True latch; lock-free read keeps the router's per-submit health probe off this batcher's hot lock
+        return self._failed
 
     # -- lifecycle ----------------------------------------------------------
     def close(self, drain=True, timeout=30.0):
         """Stop intake; drain (default) or fail what is queued; join
-        workers.  Idempotent."""
+        workers.  Idempotent.  Staged and executing batches always run
+        to completion on drain — a closing replica never drops a request
+        it admitted."""
         with self._cond:
             already = self._closed
             self._closed = True
@@ -452,7 +654,7 @@ class DynamicBatcher:
                     req = self._queue.popleft()
                     req.future._set_exception(ServingClosedError(self.name))
                     self.metrics.incr("rejected_total")
-                self.metrics.gauge("queue_depth", 0)
+                self.metrics.gauge("queue_depth", self._staged_n)
             self._cond.notify_all()
         if already:
             return

@@ -228,6 +228,21 @@ class ExecutorCache:
                 del self._entries[k]
             return len(doomed)
 
+    def evict_stale_versions(self, model, keep_versions):
+        """Hot-swap retirement: drop ``model``'s entries for every
+        version NOT in ``keep_versions`` (typically {new, previous} —
+        the previous stays warm for in-flight batches and a fast
+        rollback).  In-flight users hold their own references, so
+        eviction never tears an executing batch."""
+        keep = set(keep_versions)
+        with self._lock:
+            doomed = [k for k in self._entries
+                      if isinstance(k, tuple) and len(k) >= 2
+                      and k[0] == model and k[1] not in keep]
+            for k in doomed:
+                del self._entries[k]
+            return len(doomed)
+
     def __len__(self):
         with self._lock:
             return len(self._entries)

@@ -71,6 +71,15 @@ class ServingMetrics:
         with self._lock:
             self._latencies_ms.append(float(ms))
 
+    def drain_latencies(self):
+        """Return AND clear the latency reservoir — windowed percentile
+        measurement (the bench spike phase compares the p99 of disjoint
+        steady/spike windows on one live pool)."""
+        with self._lock:
+            out = list(self._latencies_ms)
+            self._latencies_ms.clear()
+        return out
+
     def observe_batch(self, n_real, n_slots):
         """One executed batch: ``n_real`` live requests in ``n_slots``
         padded slots (batch-occupancy accounting)."""

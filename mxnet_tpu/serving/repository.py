@@ -96,6 +96,7 @@ class ModelRepository:
         self._latest = {}   # name -> int
         self._watchers = {}  # name -> (thread, stop Event)
         self._warm_hooks = []  # fn(name, _ModelVersion), pre-flip
+        self._flip_hooks = []  # fn(name, _ModelVersion, prev_latest)
         # steps that failed checksum verification during poll_checkpoint,
         # quarantined so the watcher never re-reads a known-corrupt step
         # every poll interval: {(name, ckpt_dir): {step, ...}}
@@ -129,24 +130,47 @@ class ModelRepository:
                     "warm hook %r failed for %s v%s", fn, name,
                     mv.version)
 
+    def add_flip_hook(self, fn):
+        """Register ``fn(name, model_version, prev_latest)`` to run
+        right AFTER a hot-reload moves the served-version pointer (the
+        drain+rebuild hook: the server uses it to retire stale-version
+        executors from the LRU and reset the pool's SLO admission EWMA
+        so it re-learns the new version's service rate).  Failures are
+        logged, never fatal — the flip already happened."""
+        with self._lock:
+            self._flip_hooks.append(fn)
+        return fn
+
+    def _run_flip_hooks(self, name, mv, prev_latest):
+        import logging
+        with self._lock:
+            hooks = list(self._flip_hooks)
+        for fn in hooks:
+            try:
+                fn(name, mv, prev_latest)
+            except Exception:  # the flip is already live; never unwind it
+                logging.getLogger("mxnet_tpu.serving").exception(
+                    "flip hook %r failed for %s v%s", fn, name,
+                    mv.version)
+
     def _register(self, name, mv):
         """Make ``mv`` visible (the pointer flip).  Allocates latest+1
         when ``mv.version`` is None; raises on an explicit-version
-        collision.  Returns (version, was_hot_reload)."""
+        collision.  Returns (version, was_hot_reload, prev_latest)."""
         with self._lock:
             versions = self._models.setdefault(name, {})
             was_loaded = bool(versions)
+            prev_latest = self._latest.get(name, 0)
             if mv.version is None:
-                mv.version = self._latest.get(name, 0) + 1
+                mv.version = prev_latest + 1
             if mv.version in versions:
                 raise MXNetError(
                     f"repository: model {name!r} version {mv.version} "
                     "already loaded (unload it first, or omit version= "
                     "for hot reload)")
             versions[mv.version] = mv
-            self._latest[name] = max(self._latest.get(name, 0),
-                                     mv.version)
-            return mv.version, was_loaded
+            self._latest[name] = max(prev_latest, mv.version)
+            return mv.version, was_loaded, prev_latest
 
     def load(self, name, symbol=None, params=None, prefix=None, block=None,
              epoch=0, version=None):
@@ -160,7 +184,9 @@ class ModelRepository:
             epoch=epoch)
         mv = _ModelVersion(symbol, params, input_names,
                            None if version is None else int(version))
-        version, was_reload = self._register(name, mv)
+        version, was_reload, prev_latest = self._register(name, mv)
+        if was_reload:
+            self._run_flip_hooks(name, mv, prev_latest)
         with self._lock:
             hooks_live = bool(self._warm_hooks)
         if was_reload and hooks_live:
@@ -299,7 +325,11 @@ class ModelRepository:
         # warm-before-flip, synchronously on this (watcher) thread: the
         # old version keeps serving while the ladder compiles
         self._run_warm_hooks(name, mv)
-        self._register(name, mv)
+        _version, was_reload, prev_latest = self._register(name, mv)
+        if was_reload:
+            # post-flip drain+rebuild: stale-version executors retire,
+            # the pool's admission state re-learns the new version
+            self._run_flip_hooks(name, mv, prev_latest)
         return ckpt.step
 
     def watch(self, name, ckpt_dir, interval=None):

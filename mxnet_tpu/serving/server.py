@@ -1,4 +1,4 @@
-"""ModelServer: repository + executor cache + per-model dynamic batchers.
+"""ModelServer: repository + executor cache + per-model replica pools.
 
 The in-process serving front end:
 
@@ -6,15 +6,23 @@ The in-process serving front end:
     server.load("mlp", block=net)              # or prefix= / symbol=+params=
     out = server.predict("mlp", {"data": x})   # x: one sample, no batch dim
     fut = server.predict_async("mlp", {"data": x})
+    server.resize("mlp", 4)                    # scale the replica pool
     server.stats()                             # metrics snapshot
     server.shutdown()                          # graceful drain
 
-Execution path per batch (one per worker pass, see batcher.py): resolve
-the LATEST model version from the repository (this is what makes
-``load`` a hot reload), bucket the batch to the next power of two, fetch
-the bound executor from the LRU cache — (model, version, signature) key,
-compile only on first use — pad, forward, unpad, fan results back out to
-the request futures.
+Each model endpoint is a :class:`ReplicaPool` (``MXNET_SERVING_REPLICAS``
+batcher replicas behind load-aware routing, graceful spill and SLO
+admission control — see router.py); a pool of 1 behaves exactly like the
+PR-1 single batcher.  Execution path per micro-batch (one per dispatch
+pass, see batcher.py): resolve the LATEST model version from the
+repository (this is what makes ``load`` a hot reload), bucket the batch
+to the planned ladder (or next power of two), fetch the bound executor
+from the LRU cache — (model, version, signature) key, compile only on
+first use — pad, forward, unpad, fan results back out to the request
+futures.  On a checkpoint hot-swap the repository's flip hook retires
+stale-version executors from the cache and resets the pool's admission
+EWMA, so the pool re-learns the new version's service rate instead of
+shedding (or admitting) on the old one's.
 """
 from __future__ import annotations
 
@@ -25,11 +33,11 @@ import numpy as np
 from .. import compile as _compile
 from ..base import MXNetError
 from ..context import current_context
-from .batcher import DynamicBatcher
 from .executor_cache import (ExecutorCache, bind_inference_executor,
                              bucket_batch, feed_signature, pad_to)
 from .metrics import ServingMetrics
 from .repository import ModelRepository
+from .router import ReplicaPool
 
 
 class ModelServer:
@@ -38,24 +46,32 @@ class ModelServer:
     def __init__(self, repository=None, ctx=None, max_batch_size=None,
                  max_latency_ms=None, num_workers=None, max_queue_depth=None,
                  shed_watermark=None, default_timeout_ms=None,
-                 cache_capacity=None, name="server"):
+                 cache_capacity=None, num_replicas=None, slo_p99_ms=None,
+                 name="server"):
+        from .. import config as _config
         self.name = name
         self.repository = repository or ModelRepository()
         self._ctx = ctx or current_context()
         self._cache = ExecutorCache(cache_capacity)
         self.metrics = ServingMetrics(name)
+        self._max_batch = int(max_batch_size if max_batch_size is not None
+                              else _config.get("MXNET_SERVING_MAX_BATCH"))
+        self._num_replicas = num_replicas
+        self._slo_p99_ms = slo_p99_ms
         self._batcher_kw = dict(
-            max_batch_size=max_batch_size, max_latency_ms=max_latency_ms,
+            max_batch_size=self._max_batch, max_latency_ms=max_latency_ms,
             num_workers=num_workers, max_queue_depth=max_queue_depth,
             shed_watermark=shed_watermark,
             default_timeout_ms=default_timeout_ms)
-        self._batchers = {}
+        self._pools = {}
         self._lock = threading.Lock()
         self._shutdown = False
         # publish-time ladder warmup: the repository calls back BEFORE a
         # hot-reloaded checkpoint version starts serving (and on a
         # background thread after an explicit hot-reload load)
         self.repository.add_warm_hook(self._warm_hook)
+        # post-flip: retire stale-version executors, reset admission
+        self.repository.add_flip_hook(self._flip_hook)
 
     # -- model management ---------------------------------------------------
     def load(self, name, **kwargs):
@@ -63,7 +79,15 @@ class ModelServer:
         return self.repository.load(name, **kwargs)
 
     def unload(self, name, version=None):
+        """Drop one version (or the whole model).  Unloading the whole
+        model drains its replica pool first — admitted requests finish,
+        late submits get ``ServingClosedError``."""
         self.repository.unload(name, version=version)
+        if version is None:
+            with self._lock:
+                pool = self._pools.pop(name, None)
+            if pool is not None:
+                pool.close(drain=True)
         self._cache.evict_model((name,) if version is None
                                 else (name, int(version)))
 
@@ -79,11 +103,7 @@ class ModelServer:
                 raise MXNetError(
                     f"serving[{model}]: request is missing inputs "
                     f"{missing} (expects {mv.input_names})")
-            # _batchers is guarded by _lock (a concurrent _get_batcher
-            # may be resizing the dict); max_batch_size itself is
-            # immutable after construction
-            with self._lock:
-                max_batch = self._batchers[model].max_batch_size
+            max_batch = self._max_batch
             # the measured workload the BucketPlanner plans from: formed
             # batch size + per-sample signature (warmup's shape source)
             feed_np = {k: np.asarray(v) for k, v in feed.items()}
@@ -148,15 +168,7 @@ class ModelServer:
 
     # -- publish-time ladder warmup ------------------------------------------
     def _warm_max_batch(self, model):
-        with self._lock:
-            b = self._batchers.get(model)
-        if b is not None:
-            return b.max_batch_size
-        mb = self._batcher_kw.get("max_batch_size")
-        if mb is None:
-            from .. import config as _config
-            mb = _config.get("MXNET_SERVING_MAX_BATCH")
-        return int(mb)
+        return self._max_batch
 
     def _warm_hook(self, model, mv):
         """Repository warm hook: compile the new version's full bucket
@@ -164,6 +176,19 @@ class ModelServer:
         was observed) before it serves."""
         _compile.warm_version(self._cache, model, mv, self._ctx,
                               self._warm_max_batch(model))
+
+    def _flip_hook(self, model, mv, prev_latest):
+        """Repository flip hook (runs AFTER the served-version pointer
+        moved to ``mv``): retire executors for versions older than the
+        previous one from the LRU — in-flight batches keep their bound
+        references, so nothing they use is torn down — and reset the
+        pool's admission EWMA so SLO shedding re-learns the NEW
+        version's service rate instead of trusting the old one's."""
+        self._cache.evict_stale_versions(model, {mv.version, prev_latest})
+        with self._lock:
+            pool = self._pools.get(model)
+        if pool is not None:
+            pool.admission.reset()
 
     def warm(self, model, version=None, sample_signature=None,
              ladder=None):
@@ -184,30 +209,40 @@ class ModelServer:
             self._warm_max_batch(model),
             sample_signature=sample_signature, ladder=ladder)
 
-    def _get_batcher(self, model):
+    def _get_pool(self, model):
         with self._lock:
             if self._shutdown:
                 from .batcher import ServingClosedError
                 raise ServingClosedError(self.name)
-            b = self._batchers.get(model)
-            if b is None:
+            pool = self._pools.get(model)
+            if pool is None:
                 # metrics are shared server-wide; per-model split lives in
-                # the (model, …) executor-cache keys and batcher names
-                b = DynamicBatcher(
-                    self._runner_for(model), name=f"{self.name}/{model}",
+                # the (model, …) executor-cache keys, pool names and the
+                # {model}-labelled router telemetry families
+                runner = self._runner_for(model)
+                pool = ReplicaPool(
+                    lambda rid: runner,
+                    num_replicas=self._num_replicas,
+                    name=f"{self.name}/{model}", model=model,
                     metrics=self.metrics,
                     validator=self._validator_for(model),
+                    slo_p99_ms=self._slo_p99_ms,
                     **self._batcher_kw)
-                self._batchers[model] = b
-            return b
+                self._pools[model] = pool
+            return pool
+
+    def resize(self, model, num_replicas, drain=True):
+        """Scale ``model``'s replica pool up or down (shrinking drains
+        the removed replicas — zero admitted requests dropped)."""
+        self._get_pool(model).resize(num_replicas, drain=drain)
 
     # -- request API --------------------------------------------------------
     def predict_async(self, model, inputs, timeout_ms=None):
         """Submit one request (single sample, batch dim added by the
         batcher); returns a ServeFuture of the output list."""
         self.repository.get(model)  # unknown-model errors surface here
-        return self._get_batcher(model).submit(dict(inputs),
-                                               timeout_ms=timeout_ms)
+        return self._get_pool(model).submit(dict(inputs),
+                                            timeout_ms=timeout_ms)
 
     def predict(self, model, inputs, timeout_ms=None, wait_s=60.0):
         """Blocking convenience over predict_async."""
@@ -219,16 +254,20 @@ class ModelServer:
         snap = self.metrics.snapshot()
         snap["executor_cache"] = self._cache.stats()
         snap["models"] = self.repository.models()
+        with self._lock:
+            pools = dict(self._pools)
+        snap["pools"] = {model: pool.stats()
+                         for model, pool in pools.items()}
         return snap
 
     def shutdown(self, drain=True, timeout=30.0):
-        """Stop intake on every batcher; drain in-flight work (default)
+        """Stop intake on every pool; drain in-flight work (default)
         or fail it fast; idempotent."""
         with self._lock:
             self._shutdown = True
-            batchers = list(self._batchers.values())
-        for b in batchers:
-            b.close(drain=drain, timeout=timeout)
+            pools = list(self._pools.values())
+        for pool in pools:
+            pool.close(drain=drain, timeout=timeout)
 
     def __enter__(self):
         return self

@@ -1,19 +1,31 @@
-"""Serving smoke: 64 concurrent clients against a live ModelServer.
+"""Serving smoke: concurrent burst + autoscaling hot-swap under load.
 
-CI entry point (``python -m mxnet_tpu.serving.smoke``): spin up a
-ModelServer on the virtual 8-device CPU mesh, fire 64 concurrent
-requests through a deliberately small queue so SOME of them shed, and
-assert the robustness contract: every request is either answered with a
-numerically correct output or fails fast with a structured MXNetError —
-nothing hangs, nothing crashes the server.  Prints one JSON summary
-line; exit code 0 iff the contract held.
+CI entry point (``python -m mxnet_tpu.serving.smoke``), two phases:
+
+1. **burst contract** — spin up a ModelServer (2-replica pools) on the
+   virtual 8-device CPU mesh, fire 64 concurrent requests through a
+   deliberately small queue so SOME of them shed, and assert the
+   robustness contract: every request is either answered with a
+   numerically correct output or fails fast with a structured
+   MXNetError — nothing hangs, nothing crashes the server.
+2. **autoscaling hot-swap** (ISSUE 10) — ``ModelRepository.watch`` a
+   checkpoint directory while sustained client load runs against the
+   replica pool; commit a new step mid-traffic and assert the swap is
+   invisible: ZERO dropped non-shed requests, the new version serves,
+   and ZERO executor-cache misses after the flip (the warm hooks
+   compiled the new version's full bucket ladder BEFORE the pointer
+   moved — composing ISSUE 7's warm-before-flip with the pool).
+
+Prints one JSON summary line; exit code 0 iff both contracts held.
 """
 from __future__ import annotations
 
 import json
 import os
 import sys
+import tempfile
 import threading
+import time
 
 import numpy as np
 
@@ -22,9 +34,104 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+# hermetic compile-cache namespace: the smoke's warm/flip accounting
+# must not depend on what earlier local runs persisted
+os.environ.setdefault("MXNET_COMPILE_CACHE_DIR",
+                      tempfile.mkdtemp(prefix="mx-serve-smoke-cache-"))
 
 N_CLIENTS = 64
 IN_DIM = 16
+
+
+def autoscaling_hot_swap():
+    """Phase 2: ModelRepository.watch hot-swaps a committed step under
+    sustained replica-pool load — zero dropped non-shed requests, zero
+    post-flip cold compiles.  Returns (summary dict, failure list)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, serving
+    from mxnet_tpu.checkpoint import CheckpointManager
+    from mxnet_tpu.serving import (RequestTimeoutError, ServingClosedError,
+                                   ServingOverloadError)
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(24, activation="relu"), gluon.nn.Dense(4))
+    net.initialize()
+    net(mx.nd.zeros((1, IN_DIM)))
+    if not getattr(net, "_cached_graph", None):
+        net._build_sym_graph()
+    sym = net._cached_graph[1]
+    params = {f"arg:{k}": p._reduce()
+              for k, p in net.collect_params().items()}
+    x = np.random.RandomState(1).randn(IN_DIM).astype(np.float32)
+
+    ckdir = tempfile.mkdtemp(prefix="mx-serve-smoke-ck-")
+    failures = []
+    served = [0]
+    sheds = [0]
+    stop = threading.Event()
+    server = serving.ModelServer(max_batch_size=8, max_latency_ms=3.0,
+                                 max_queue_depth=64, num_replicas=2,
+                                 name="smoke-swap")
+    repo = server.repository
+    with CheckpointManager(ckdir, keep_last=0) as mgr:
+        mgr.save(1, arrays=params, symbol=sym, block=True)
+        assert repo.poll_checkpoint("swapm", ckdir) == 1
+
+        def client():
+            while not stop.is_set():
+                try:
+                    server.predict("swapm", {"data": x}, wait_s=30.0)
+                    served[0] += 1
+                except (ServingOverloadError, RequestTimeoutError,
+                        ServingClosedError):
+                    sheds[0] += 1
+                except Exception as e:  # noqa: BLE001 — contract violation
+                    failures.append(f"{type(e).__name__}: {e}")
+                    return
+
+        clients = [threading.Thread(target=client) for _ in range(4)]
+        for t in clients:
+            t.start()
+        try:
+            time.sleep(0.5)   # v1 traffic feeds the shape census
+            repo.watch("swapm", ckdir, interval=0.05)
+            mgr.save(2, arrays=params, symbol=sym, block=True)
+            deadline = time.time() + 30
+            while repo.latest_version("swapm") != 2:
+                if time.time() > deadline:
+                    failures.append("watcher never flipped to step 2")
+                    break
+                time.sleep(0.02)
+            # the flip is live: warmup compiled the v2 ladder pre-flip,
+            # so continued load must be a pure executor-cache hit
+            misses_at_flip = server._cache.stats()["misses"]
+            served_at_flip = served[0]
+            time.sleep(0.5)
+        finally:
+            repo.unwatch("swapm")
+            stop.set()
+            for t in clients:
+                t.join(timeout=30)
+        post_flip_misses = (server._cache.stats()["misses"]
+                            - misses_at_flip)
+        served_post_flip = served[0] - served_at_flip
+        server.shutdown()
+    if post_flip_misses:
+        failures.append(
+            f"{post_flip_misses} executor-cache miss(es) AFTER the "
+            "version flip — a request paid a cold compile")
+    if served_post_flip <= 0:
+        failures.append("no traffic completed after the hot swap")
+    if served[0] <= 0:
+        failures.append("no traffic completed at all during the swap")
+    summary = {
+        "served": served[0], "shed": sheds[0],
+        "served_post_flip": served_post_flip,
+        "post_flip_misses": post_flip_misses,
+        "final_version": repo.latest_version("swapm"),
+        "pool": server.stats()["pools"].get("swapm"),
+    }
+    return summary, failures
 
 
 def main():
@@ -41,7 +148,8 @@ def main():
     ref = net(mx.nd.array(xs)).asnumpy()
 
     server = serving.ModelServer(max_batch_size=8, max_latency_ms=4.0,
-                                 max_queue_depth=16, name="smoke")
+                                 max_queue_depth=16, num_replicas=2,
+                                 name="smoke")
     server.load("mlp", block=net)
     # prime the hot bucket so concurrent clients race a warm server, not
     # one giant first-call XLA compile
@@ -91,6 +199,16 @@ def main():
     snap = server.stats()
     if ok == 0:
         failures.append("no request was answered at all")
+
+    # phase 2: autoscaling hot-swap under sustained load
+    try:
+        swap_summary, swap_failures = autoscaling_hot_swap()
+    except Exception as e:  # noqa: BLE001 — smoke must report, not crash
+        swap_summary = {"error": f"{type(e).__name__}: {e}"}
+        swap_failures = [f"autoscaling phase crashed: "
+                         f"{type(e).__name__}: {e}"]
+    failures += swap_failures
+
     summary = {
         "smoke": "serving", "clients": N_CLIENTS, "answered": ok,
         "shed": shed, "failures": failures,
@@ -98,6 +216,8 @@ def main():
         "p99_ms": snap.get("latency_ms", {}).get("p99"),
         "batch_occupancy": snap.get("batch_occupancy"),
         "executor_cache": snap.get("executor_cache"),
+        "pools": snap.get("pools"),
+        "autoscaling": swap_summary,
     }
     print(json.dumps(summary), flush=True)
     return 1 if failures else 0

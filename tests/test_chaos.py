@@ -478,7 +478,39 @@ def test_healthz_stall_and_fatal_transitions(monkeypatch, tmp_path):
         stop_exporter()
 
 
-# -- the four composed scenarios ---------------------------------------------
+# -- router dispatch failpoint (ISSUE 10) -------------------------------------
+def test_router_dispatch_failpoint_spills_to_sibling():
+    """An injected fault at serving/router/dispatch makes the chosen
+    replica's dispatch fail; the router spills the request to a sibling
+    and it still answers (counted in the spill telemetry family)."""
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.serving.metrics import ServingMetrics
+    from mxnet_tpu.serving.router import ReplicaPool
+
+    def factory(rid):
+        def run(feed, n):
+            return [feed["x"] * 2.0]
+        return run
+
+    spill = telemetry.REGISTRY.counter("mxnet_serving_router_spill_total")
+    before = spill.value(labels={"model": "t-spill"})
+    pool = ReplicaPool(factory, num_replicas=2, name="t-spill",
+                       model="t-spill", metrics=ServingMetrics("t-spill"),
+                       max_batch_size=4, max_latency_ms=1.0)
+    try:
+        # exactly ONE dispatch attempt fails: the first hop of the next
+        # submit; the sibling must rescue it
+        chaos.arm("serving/router/dispatch", "raise", hits=1, count=1)
+        out = pool.submit({"x": np.float32(3.0)}).result(10)
+        assert out[0] == pytest.approx(6.0)
+        assert spill.value(labels={"model": "t-spill"}) == before + 1
+        assert pool.metrics.get("spill_total") == 1
+    finally:
+        chaos.reset()
+        pool.close()
+
+
+# -- the composed scenarios ---------------------------------------------------
 def test_scenario_worker_kill_revive(tmp_path):
     r = harness.scenario_worker_kill_revive(str(tmp_path / "s1"),
                                             port=19861)
@@ -504,6 +536,20 @@ def test_scenario_wedged_batcher():
     assert r["healthz_during_stall"][0] == 503
     assert r["healthz_after_release"][0] == 200
     assert r["non_typed_failures"] == []
+    assert r["p99_ms"] < 1000.0
+
+
+def test_scenario_replica_kill_mid_burst():
+    """ISSUE 10: injected router dispatch faults spill to siblings; the
+    replica removed mid-burst drains everything it admitted; survivors
+    absorb the load; zero non-shed requests dropped or hung."""
+    r = harness.scenario_replica_kill_mid_burst(seconds=1.5)
+    assert r["ok"], json.dumps(r, default=str)
+    assert r["victim_drained"]
+    assert len(r["survivors"]) == 2
+    assert r["spills"] >= 1
+    assert r["non_typed_failures"] == []
+    assert r["served"] > 0
     assert r["p99_ms"] < 1000.0
 
 
