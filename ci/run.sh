@@ -40,6 +40,16 @@ echo "== mesh fused step smoke (dp x tp fit: dispatch budget, kvstore-loop parit
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
   python -m mxnet_tpu.parallel.fused
 
+echo "== elastic multi-host smoke (2 processes x 4 fake devices: kill-and-recover) =="
+# a 2-subprocess jax.distributed mesh (gloo CPU collectives) drives the
+# fused window across hosts; rank 1 is SIGKILLed at window 3 -> the
+# survivor takes a typed PeerLostError at the deadline-bounded
+# rendezvous, commits the boundary checkpoint, and the launcher
+# respawns the dp/2 survivor world — the continued fit must be BITWISE
+# identical to a planned resize, within the per-process dispatch
+# budget (docs/parallel.md preemption runbook)
+JAX_PLATFORMS=cpu python -m mxnet_tpu.parallel.elastic
+
 echo "== serving smoke (replica pools: 64-client burst + autoscaling hot-swap) =="
 # phase 1: 64 concurrent clients against a 2-replica pool with a small
 # queue — every request answered correctly or shed with a structured

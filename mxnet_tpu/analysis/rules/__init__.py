@@ -2,8 +2,8 @@
 with the core registry (see ``core.register_rule``)."""
 from . import (env_drift, host_sync, lock_discipline, naked_retry,
                per_param_collective, phase_timing, swallowed_error,
-               torn_write, tracer_leak)
+               torn_write, tracer_leak, unbounded_wait)
 
 __all__ = ["env_drift", "host_sync", "lock_discipline", "naked_retry",
            "per_param_collective", "phase_timing", "swallowed_error",
-           "torn_write", "tracer_leak"]
+           "torn_write", "tracer_leak", "unbounded_wait"]

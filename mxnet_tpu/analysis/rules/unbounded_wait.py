@@ -1,0 +1,96 @@
+"""unbounded-wait — joins/waits without a timeout in coordination paths.
+
+ISSUE 11's elastic runtime is the canon: on a multi-host pod the
+dominant failure mode is a peer vanishing mid-step, and every
+coordination wait must prove a deadline — a ``thread.join()`` /
+``Event.wait()`` / ``Condition.wait_for(pred)`` / ``future.result()``
+with no timeout turns a dead peer (or a wedged worker) into a silent
+hang that no watchdog dump can unwind.  The kvstore server's dead-peer
+propagation and the multi-host window rendezvous exist precisely so
+these waits CAN be bounded; this rule keeps new code honest.
+
+The rule fires on an attribute call named ``join`` / ``wait`` /
+``wait_for`` / ``result`` that passes **no timeout** — neither a
+positional argument beyond the predicate slot nor a ``timeout=``
+keyword — inside the repo's coordination modules (``parallel/``,
+``kvstore*``, ``serving/``, ``chaos/``, ``checkpoint/``,
+``telemetry/watchdog``).
+
+Near-misses stay silent:
+
+* any ``timeout`` keyword, including a **computed** one
+  (``wait(timeout=deadline - now)`` — the deadline-derived idiom);
+* a positional timeout (``join(5)``, ``wait(remaining)``;
+  ``wait_for(pred, t)`` counts its second positional as the timeout);
+* ``str.join(parts)`` / ``os.path.join(a, b)`` — ``join`` WITH
+  arguments is string/path joining, not thread joining;
+* code outside the coordination modules (offline tooling may block).
+
+Deliberate unbounded waits (a writer drain whose bound is the caller's
+contract, a daemon's lifetime wait) carry
+``# graftlint: disable=unbounded-wait -- reason``.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule, register_rule
+
+# modules where a blocked wait can strand a peer, a survivor, or a
+# shutdown path (the elastic/serving/checkpoint coordination planes)
+COORDINATION_PREFIXES = (
+    "mxnet_tpu/parallel/",
+    "mxnet_tpu/kvstore",
+    "mxnet_tpu/serving/",
+    "mxnet_tpu/chaos/",
+    "mxnet_tpu/checkpoint/",
+    "mxnet_tpu/telemetry/watchdog",
+)
+
+_WAIT_METHODS = {"join", "wait", "wait_for", "result"}
+
+
+def _has_timeout(call):
+    """True when the call carries any plausible bound."""
+    if any(kw.arg == "timeout" for kw in call.keywords):
+        return True
+    name = call.func.attr
+    if name == "wait_for":
+        # wait_for(predicate, timeout): second positional is the bound
+        return len(call.args) >= 2
+    # join(t) / wait(t) / result(t): first positional is the bound
+    return len(call.args) >= 1
+
+
+@register_rule
+class UnboundedWaitRule(Rule):
+    id = "unbounded-wait"
+    severity = "warning"
+    doc = ("join()/wait()/wait_for()/result() without a timeout in a "
+           "coordination path — a dead peer or wedged thread becomes a "
+           "silent hang; derive a deadline (docs/lint.md; the "
+           "multi-host rendezvous is the template)")
+
+    def begin_file(self, ctx):
+        self._hot = any(p in ctx.path for p in COORDINATION_PREFIXES)
+
+    def visit(self, node, ctx):
+        if not self._hot:
+            return
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _WAIT_METHODS):
+            return
+        if _has_timeout(node):
+            return
+        if node.func.attr == "join" and node.args:
+            return  # str/path join — joining WITH args isn't a thread
+        recv = ast.unparse(node.func.value)
+        ctx.report(
+            self, node,
+            f"{recv}.{node.func.attr}() has no timeout in a "
+            "coordination path — a lost peer or wedged worker turns "
+            "this into a silent hang; pass a deadline-derived timeout "
+            "and fail typed (PeerLostError / watchdog) instead "
+            "(docs/lint.md)",
+            symbol=f"{ctx.func_name()}:{node.func.attr}")

@@ -19,6 +19,37 @@ class MXNetError(RuntimeError):
     """Error raised by the runtime (parity: MXNetError in python/mxnet/base.py)."""
 
 
+class PeerLostError(MXNetError):
+    """A multi-host peer stopped heartbeating (preemption, eviction,
+    crash) while this process was — or would have been — waiting on it.
+
+    Raised by the kvstore server's dead-peer propagation (an in-flight
+    sync pull or barrier that can only complete with the dead rank's
+    participation fails typed instead of timing out generically) and by
+    the multi-host runtime's window rendezvous/peer probes.  Carries the
+    lost ``ranks`` so the elastic recovery path knows the survivor set.
+    Not retryable: the peer is gone; recovery is a boundary checkpoint +
+    elastic restore onto the survivor mesh (docs/parallel.md).
+    """
+
+    retryable = False
+
+    def __init__(self, ranks, detail=""):
+        self.ranks = tuple(int(r) for r in (
+            ranks if isinstance(ranks, (list, tuple, set)) else [ranks]))
+        super().__init__(
+            f"peer(s) {sorted(self.ranks)} lost (no heartbeat within the "
+            "peer timeout)" + (f": {detail}" if detail else ""))
+
+
+class PreemptionError(MXNetError):
+    """This host received a preemption notice (SIGTERM) and must leave
+    the mesh at the next window boundary.  The elastic session turns it
+    into a boundary checkpoint + clean handoff (docs/parallel.md)."""
+
+    retryable = False
+
+
 # TPU integer-width contract -------------------------------------------------
 # The backend narrows int64 to int32 (TPU integer units are 32-bit; the
 # reference builds with int64 tensor indexing, tests/nightly/

@@ -124,6 +124,15 @@ SITES = {
         "the donated shard_map dispatch (delay/wedge stalls the mesh "
         "step under the watchdog's eye; kill + boundary-checkpoint "
         "restore onto a RESIZED mesh is the elastic-resume scenario)",
+    "multihost/heartbeat":
+        "multi-host runtime heartbeat loop, before each beat to the "
+        "control server (raise skips beats so this rank ages toward "
+        "'lost' — survivors must take typed PeerLostError paths)",
+    "multihost/peer_loss":
+        "multi-host fused step, at the window-boundary probe before "
+        "the rendezvous (kill here is the host-vanishes-mid-training "
+        "preemption scenario: survivors checkpoint the boundary and "
+        "the elastic launcher respawns the survivor mesh)",
 }
 
 

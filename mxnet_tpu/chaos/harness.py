@@ -975,8 +975,69 @@ def scenario_mesh_collective_stall(workdir, scan_k=2, timeout=240.0):
     return result
 
 
+# ---------------------------------------------------------------------------
+# scenario 7: multi-host peer loss mid-window — survivors checkpoint,
+# the elastic launcher respawns the dp/2 survivor mesh, the continued
+# fit is bitwise identical to a planned resize (ISSUE 11)
+# ---------------------------------------------------------------------------
+def scenario_peer_loss_mid_window(workdir, scan_k=2, timeout=240.0):
+    """Kill host 1 of a 2-process × 4-fake-device jax.distributed mesh
+    at its window-3 boundary (chaos ``kill`` at ``multihost/peer_loss``)
+    and assert the whole elastic contract:
+
+    * the survivor takes a **typed** exit (PeerLostError → the
+      ELASTIC_RESTART code) from the deadline-bounded rendezvous — no
+      straggler kill, no hang, no untyped crash;
+    * the boundary checkpoint commits and the launcher respawns the
+      dp/2 survivor world, which finishes training;
+    * the final weights are BITWISE identical to a planned resize (the
+      same host *leaving* via the preemption path at the same
+      boundary);
+    * recovery wall time was measured (the launcher's clock ran).
+    """
+    import numpy as np
+
+    from ..parallel import elastic as E
+
+    workdir = str(workdir)
+    os.makedirs(workdir, exist_ok=True)
+    K, NB, BS = scan_k, 4 * scan_k, 32
+    result = {"ok": False}
+
+    sa, pa, _la = E._launch(
+        os.path.join(workdir, "faulted"), 2, NB, BS, K,
+        rank_env={1: {"MXNET_CHAOS": "multihost/peer_loss=kill:hits=3"}})
+    sb, pb, _lb = E._launch(
+        os.path.join(workdir, "planned"), 2, NB, BS, K,
+        leave_at=2 * K)
+    result["faulted"] = {k: v for k, v in sa.items()}
+    result["planned_ok"] = bool(sb.get("ok"))
+    gen0 = sa["history"][0]["exits"]
+    result["gen0_exits"] = gen0
+    result["typed_only"] = sorted(gen0) == [-9, E.ELASTIC_RESTART]
+    result["survivor_world"] = sa["history"][-1]["world"]
+    result["recovery_s"] = (sa.get("recovery_s") or [None])[0]
+    try:
+        p_fault = E._final_params(pa)
+        p_plan = E._final_params(pb)
+        diverged = [k for k in p_plan
+                    if not np.array_equal(p_fault[k], p_plan[k])]
+    except Exception as e:  # noqa: BLE001 — gate-fatal bucket
+        result["error"] = f"{type(e).__name__}: {e}"
+        return result
+    result["diverged_params"] = diverged
+    result["ok"] = bool(
+        sa.get("ok") and sb.get("ok")
+        and result["typed_only"]
+        and sa.get("restarts") == 1
+        and result["survivor_world"] == 1
+        and result["recovery_s"] is not None
+        and not diverged)
+    return result
+
+
 def run_all(workdir=None, verbose=True):
-    """Run the four composed scenarios sequentially; returns
+    """Run the composed scenarios sequentially; returns
     {name: result dict}.  The smoke asserts every ``ok``."""
     base = workdir or tempfile.mkdtemp(prefix="mx-chaos-")
     results = {}
@@ -992,6 +1053,8 @@ def run_all(workdir=None, verbose=True):
          lambda: scenario_sigkill_mid_scan(os.path.join(base, "s4"))),
         ("mesh_collective_stall",
          lambda: scenario_mesh_collective_stall(os.path.join(base, "s5"))),
+        ("peer_loss_mid_window",
+         lambda: scenario_peer_loss_mid_window(os.path.join(base, "s7"))),
     ]
     for name, fn in scenarios:
         t0 = time.perf_counter()

@@ -24,6 +24,12 @@ Asserted per scenario (the ISSUE 8 acceptance contract):
    spilled to sibling replicas, the replica removed under load drained
    everything it admitted, the survivors kept serving, and zero
    non-shed requests were dropped or hung.
+7. multi-host peer loss mid-window (ISSUE 11) — host 1 of a 2-process
+   jax.distributed mesh SIGKILLed at window 3: the survivor took a
+   TYPED exit from the deadline-bounded rendezvous (zero hangs, zero
+   untyped failures), the boundary checkpoint committed, the elastic
+   launcher respawned the dp/2 survivor world, and the continued fit
+   was BITWISE identical to a planned resize.
 
 Plus the standing invariants: no scenario hangs (every wait here is
 bounded) and the disabled-failpoint overhead stays under the 1 us bar.
@@ -75,8 +81,10 @@ def main():
           "watchdog stall, the replica killed mid-burst drained with "
           "zero non-shed drops while siblings absorbed the load, "
           "mid-window SIGKILL resumed bit-identically, "
-          "and the stalled mesh step self-healed + resumed "
-          "bit-identically onto a resized mesh")
+          "the stalled mesh step self-healed + resumed "
+          "bit-identically onto a resized mesh, and the multi-host "
+          "peer loss recovered typed onto the dp/2 survivor world "
+          "bit-identically to a planned resize")
 
 
 if __name__ == "__main__":

@@ -292,6 +292,7 @@ class CheckpointManager:
         from .. import telemetry as _telemetry
         _telemetry.current_step_timer().add("ckpt_block", blocking_ms / 1e3)
         if block or not self.async_save:
+            # graftlint: disable=unbounded-wait -- block=True is the caller's explicit completion contract; the writer resolves EVERY future (success or error) per job, and a wall-clock bound here would fail legitimately huge saves
             fut.result()
         return fut
 
@@ -690,6 +691,7 @@ class CheckpointManager:
         if self._closed:
             return
         try:
+            # graftlint: disable=unbounded-wait -- close() flushes every pending save by contract (dropping them would lose committed-step guarantees); each queued job resolves its future even on failure, and the writer join below is bounded
             self.wait()
         finally:
             self._closed = True

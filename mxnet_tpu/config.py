@@ -108,6 +108,12 @@ _register("MXNET_KVSTORE_RETRIES", int, 3,
 _register("MXNET_KVSTORE_RETRY_BACKOFF_S", float, 0.05,
           "base backoff for kvstore client RPC retries; attempt i "
           "sleeps base * 2^i * (1 + jitter)")
+_register("MXNET_KVSTORE_PEER_TIMEOUT_S", float, 30.0,
+          "kvstore server dead-peer threshold: a rank that has "
+          "heartbeated at least once and then goes silent this long is "
+          "marked lost, and every in-flight sync pull/barrier that "
+          "needs it fails with typed PeerLostError instead of timing "
+          "out against a corpse (docs/parallel.md)")
 _register("MXNET_OPTIMIZER_AGGREGATION_SIZE", int, 4,
           "weights per aggregated multi_sgd_* dispatch in the SGD "
           "optimizer (0 disables; parity: reference sgd.py)")
@@ -182,6 +188,47 @@ _register("MXNET_COLLECTIVE_MODE", str, "bucketed",
           "or 'off' (skip gradient collectives entirely — WRONG results, "
           "bench/debug only: the differential against 'bucketed' is how "
           "multichip_comm_blocking_pct isolates communication time)")
+_register("MXNET_COLLECTIVE_COMPRESSION", str, "none",
+          "mesh fused step per-bucket gradient codec: 'none' (exact "
+          "dense psum), 'fp16' (halved wire bytes, ~1e-3 relative "
+          "tolerance), or '2bit' (error-feedback quantization to "
+          "+-threshold/0, packed 4 codes/byte and exchanged with one "
+          "all_gather per bucket — 32/R x fewer wire bytes per rank on "
+          "an R-way mesh; residuals ride the donated scan carry and "
+          "reset at elastic restore).  Changes training numerics: "
+          "opt-in, replicated layout only (docs/parallel.md)")
+_register("MXNET_COLLECTIVE_COMPRESSION_THRESHOLD", float, 0.5,
+          "2bit collective codec emission threshold (parity: reference "
+          "gradient_compression kTwoBit default)")
+_register("MXNET_MULTIHOST_COORD", str, "",
+          "host:port of the jax.distributed coordinator for a "
+          "multi-process mesh (empty = single process unless a TPU pod "
+          "autodetects); every process of one job must agree")
+_register("MXNET_MULTIHOST_NUM_PROCS", int, 1,
+          "process count of the multi-host job (1 = single process)")
+_register("MXNET_MULTIHOST_PROC_ID", int, 0,
+          "this process's rank in the multi-host job")
+_register("MXNET_MULTIHOST_CONTROL_URI", str, "",
+          "host of the multi-host control-plane kvstore server "
+          "(heartbeats, peer states, window rendezvous); empty "
+          "disables the liveness layer")
+_register("MXNET_MULTIHOST_CONTROL_PORT", int, 0,
+          "port of the multi-host control-plane server")
+_register("MXNET_MULTIHOST_HEARTBEAT_S", float, 1.0,
+          "multi-host runtime heartbeat period to the control server "
+          "(0 disables; peers read as lost after "
+          "MXNET_MULTIHOST_PEER_TIMEOUT_S of silence)")
+_register("MXNET_MULTIHOST_PEER_TIMEOUT_S", float, 10.0,
+          "multi-host dead-peer threshold: a rank silent this long is "
+          "lost — survivors get typed PeerLostError at the next window "
+          "rendezvous/in-flight wait instead of hanging")
+_register("MXNET_MULTIHOST_BARRIER_TIMEOUT_S", float, 60.0,
+          "deadline for the per-window multi-host rendezvous and for "
+          "the survivors' exit barrier; every coordination wait in the "
+          "elastic runtime is bounded by a deadline derived from this")
+_register("MXNET_MULTIHOST_MAX_RESTARTS", int, 3,
+          "elastic launcher: maximum world restarts (preemption "
+          "recoveries/resizes) before the job fails typed")
 _register("MXNET_FIT_STAGE_NEXT", bool, True,
           "fit loop: stage the NEXT DataBatch host->device "
           "(jax.device_put) while the current step is still in flight, "
@@ -451,6 +498,16 @@ _register("BENCH_MULTICHIP", bool, True,
 _register("BENCH_MULTICHIP_K", int, 8,
           "bench.py multichip phase: MXNET_SCAN_STEPS window size on the "
           "dp=2,tp=2 mesh (the <=(1+eps)/K dispatch gate)")
+_register("BENCH_MULTIHOST", bool, True,
+          "bench.py: also measure the elastic multi-host runtime — "
+          "2 worker processes x 4 fake CPU devices each under the "
+          "elastic launcher (multihost_dispatches_per_step, "
+          "multihost_recovery_s, collective-compression byte ratio); "
+          "relay-proof like the other CPU phases")
+_register("BENCH_MULTIHOST_K", int, 8,
+          "bench.py multihost phase: MXNET_SCAN_STEPS window size for "
+          "the 2-process mesh (the <=(1+eps)/K per-process dispatch "
+          "gate)")
 _register("BENCH_CKPT", bool, True,
           "bench.py: also measure checkpoint save-blocking time and "
           "restore latency (ckpt_save_blocking_ms / ckpt_restore_s)")

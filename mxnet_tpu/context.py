@@ -47,7 +47,12 @@ class Context:
         """The concrete jax.Device this context denotes."""
         kind = self._canonical_kind()
         if kind == "cpu":
-            devs = jax.devices("cpu") if _has_platform("cpu") else jax.devices()
+            # ADDRESSABLE devices only: in a multi-process job
+            # jax.devices() spans every host, and a context must never
+            # denote a device this process cannot touch (device_put to
+            # a non-addressable device is an error)
+            devs = jax.local_devices(backend="cpu") \
+                if _has_platform("cpu") else jax.local_devices()
         else:
             devs = _accel_devices()
             if not devs:
@@ -101,15 +106,17 @@ def _has_platform(name):
 
 
 def _accel_devices():
-    """Non-cpu jax devices (tpu under axon, else whatever the backend has)."""
+    """Non-cpu ADDRESSABLE jax devices (tpu under axon, else whatever
+    the backend has) — local, for the same multi-process reason as the
+    cpu branch of Context.jax_device."""
     for plat in ("tpu", "axon"):
         try:
-            devs = jax.devices(plat)
+            devs = jax.local_devices(backend=plat)
             if devs:
                 return devs
         except RuntimeError:
             pass
-    devs = jax.devices()
+    devs = jax.local_devices()
     return [d for d in devs if d.platform != "cpu"] or devs
 
 

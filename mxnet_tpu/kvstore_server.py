@@ -28,6 +28,7 @@ import time
 
 import numpy as np
 
+from .base import PeerLostError
 from .chaos.failpoints import failpoint as _failpoint
 
 # pickle frames execute code on load: every frame carries an HMAC-SHA256 of
@@ -94,8 +95,11 @@ class KVServer:
     """The server process main loop (parity: KVStoreDistServer)."""
 
     def __init__(self, port=9091, num_workers=1, bind_addr=None,
-                 auth_token=None):
+                 auth_token=None, peer_timeout_s=None):
         self.port = port
+        # explicit dead-peer threshold override (the elastic launcher's
+        # control plane runs tighter than the training-store default)
+        self.peer_timeout_s = peer_timeout_s
         # localhost-only by default: frames are pickle (code execution if a
         # hostile peer can reach the port).  Cross-host deployments must set
         # DMLC_PS_BIND_ADDR explicitly AND share MXNET_KVSTORE_AUTH_TOKEN.
@@ -121,7 +125,19 @@ class KVServer:
         # failure detection (parity: ps-lite heartbeats surfaced as
         # KVStore::get_num_dead_node, include/mxnet/kvstore.h:353)
         self._heartbeats = {}     # rank -> last heartbeat monotonic time
+        self._progress = {}       # rank -> last reported step
+        # dead-peer propagation (ISSUE 11): ranks that heartbeated then
+        # went silent past MXNET_KVSTORE_PEER_TIMEOUT_S.  The Event is
+        # the lock-free predicate blocked pull/barrier waiters poll; the
+        # dict (under _lock) carries which ranks for the typed reply.
+        self._dead = {}           # rank -> monotonic time marked lost
+        self._dead_event = threading.Event()
         self._start_time = time.monotonic()
+        # port=0 binds an OS-assigned port (port-collision-safe tests /
+        # supervisor-owned control planes); bound_port is readable after
+        # the started event sets
+        self.bound_port = None if port == 0 else port
+        self.started = threading.Event()
         self._agg = {}            # key -> (sum, count) for sync mode
         self._version = {}        # key -> completed sync rounds
         self._barrier_count = 0
@@ -137,8 +153,14 @@ class KVServer:
         srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         srv.bind((self.bind_addr, self.port))
-        srv.listen(self.num_workers * 2)
+        self.bound_port = self.port = srv.getsockname()[1]
+        with self._lock:  # num_workers is rewritten by reset_world
+            backlog = max(4, self.num_workers * 2)
+        srv.listen(backlog)
+        self.started.set()
         threads = []
+        monitor = threading.Thread(target=self._peer_monitor, daemon=True)
+        monitor.start()
         try:
             while not self._stop.is_set():
                 srv.settimeout(1.0)
@@ -152,6 +174,88 @@ class KVServer:
                 threads.append(t)
         finally:
             srv.close()
+
+    # -- dead-peer propagation (ISSUE 11) -----------------------------------
+    def _peer_timeout(self):
+        if self.peer_timeout_s is not None:
+            return float(self.peer_timeout_s)
+        from .config import get as _cfg
+        return float(_cfg("MXNET_KVSTORE_PEER_TIMEOUT_S"))
+
+    def _peer_monitor(self):
+        """Mark ranks lost when their heartbeats age out, and WAKE every
+        blocked waiter (versioned pulls, barriers) so in-flight RPCs
+        that need a dead rank fail with typed PeerLostError instead of
+        waiting out their generic timeouts against a corpse.  Only ranks
+        that announced themselves at least once are eligible — silence
+        from a rank that never heartbeated means heartbeating is off."""
+        while not self._stop.wait(0.1):
+            timeout = self._peer_timeout()
+            now = time.monotonic()
+            newly_dead = False
+            with self._lock:
+                for rank, last in self._heartbeats.items():
+                    if rank in self._dead:
+                        continue
+                    if now - last > timeout:
+                        self._dead[rank] = now
+                        newly_dead = True
+                dead = sorted(self._dead)
+            if newly_dead:
+                # the Event is self-synchronized; set it before waking
+                # the condition waiters so their predicates observe it
+                self._dead_event.set()
+                logging.getLogger("mxnet_tpu.kvstore").warning(
+                    "kvstore server: peer(s) %s lost (no heartbeat for "
+                    "> %.1fs); failing their in-flight waiters typed",
+                    dead, timeout)
+                with self._store_cv:
+                    self._store_cv.notify_all()
+                with self._barrier_cv:
+                    self._barrier_cv.notify_all()
+
+    def dead_ranks(self):
+        with self._lock:
+            return sorted(self._dead)
+
+    def _peer_states(self):
+        timeout = self._peer_timeout()
+        now = time.monotonic()
+        with self._lock:
+            out = {}
+            for rank in range(self.num_workers):
+                last = self._heartbeats.get(rank)
+                if rank in self._dead:
+                    state = "lost"
+                elif last is None:
+                    state = "unknown"
+                else:
+                    state = "alive" if now - last <= timeout else "lost"
+                out[rank] = {"state": state,
+                             "age_s": None if last is None else now - last,
+                             "step": self._progress.get(rank, 0)}
+            return out
+
+    def reset_world(self, num_workers):
+        """Re-arm the liveness layer for a new elastic world generation
+        (the launcher calls this between respawns): new worker count,
+        forgotten heartbeats/progress/dead marks, fresh barrier."""
+        with self._lock:
+            self.num_workers = int(num_workers)
+            self._heartbeats.clear()
+            self._progress.clear()
+            self._dead.clear()
+            self._start_time = time.monotonic()
+        self._dead_event.clear()
+        with self._barrier_cv:
+            self._barrier_count = 0
+            self._barrier_cv.notify_all()
+
+    def _peer_lost_reply(self):
+        return {"ok": False, "error_type": "PeerLostError",
+                "dead_ranks": self.dead_ranks(),
+                "error": f"peer(s) {self.dead_ranks()} lost — the "
+                         "requested wait can never complete"}
 
     def _apply_update(self, key, grad):
         """sync aggregate-then-update / async per-push update
@@ -262,12 +366,20 @@ class KVServer:
                 with self._store_cv:
                     # must be shorter than the client's 120s socket timeout
                     # so the error reply reaches the client instead of a
-                    # socket.timeout that desynchronizes the connection
+                    # socket.timeout that desynchronizes the connection.
+                    # A dead peer wakes the wait: a sync round missing a
+                    # lost rank's push can never complete, so the waiter
+                    # fails typed instead of burning the full timeout.
                     done = self._store_cv.wait_for(
-                        lambda: self._version.get(key, 0) >= min_version,
+                        lambda: self._version.get(key, 0) >= min_version
+                        or self._dead_event.is_set(),
                         timeout=100)
+                    satisfied = self._version.get(key, 0) >= min_version
                     val = self.store.get(key)
-                if not done:
+                if done and not satisfied:
+                    _send_msg(conn, self._peer_lost_reply(),
+                              self.auth_token)
+                elif not done:
                     _send_msg(conn, {"ok": False,
                                      "error": f"pull timeout waiting for "
                                               f"round {min_version} of key "
@@ -288,7 +400,16 @@ class KVServer:
                     break
                 with self._lock:
                     self._heartbeats[int(msg["rank"])] = time.monotonic()
+                    if "step" in msg:
+                        self._progress[int(msg["rank"])] = int(msg["step"])
                 _send_msg(conn, {"ok": True}, self.auth_token)
+            elif op == "progress":
+                with self._lock:
+                    self._progress[int(msg["rank"])] = int(msg["step"])
+                _send_msg(conn, {"ok": True}, self.auth_token)
+            elif op == "peer_states":
+                _send_msg(conn, {"ok": True, "value": self._peer_states()},
+                          self.auth_token)
             elif op == "num_dead_node":
                 timeout = float(msg.get("timeout", 60))
                 now = time.monotonic()
@@ -311,6 +432,14 @@ class KVServer:
                 _send_msg(conn, {"ok": True, "value": dead},
                           self.auth_token)
             elif op == "barrier":
+                if self._dead_event.is_set():
+                    # a barrier over a world with a lost rank can never
+                    # fill: fail typed immediately, never hang a survivor
+                    _send_msg(conn, self._peer_lost_reply(),
+                              self.auth_token)
+                    continue
+                deadline = float(msg.get("deadline", 120))
+                lost = False
                 with self._barrier_cv:
                     self._barrier_count += 1
                     gen = self._barrier_count // self.num_workers
@@ -318,10 +447,19 @@ class KVServer:
                         self._barrier_cv.notify_all()
                     else:
                         target = (self._barrier_count // self.num_workers) + 1
-                        self._barrier_cv.wait_for(
+                        filled = self._barrier_cv.wait_for(
                             lambda: self._barrier_count >=
-                            target * self.num_workers, timeout=120)
-                _send_msg(conn, {"ok": True}, self.auth_token)
+                            target * self.num_workers
+                            or self._dead_event.is_set(),
+                            timeout=deadline)
+                        lost = (self._barrier_count <
+                                target * self.num_workers
+                                and self._dead_event.is_set() and filled)
+                if lost:
+                    _send_msg(conn, self._peer_lost_reply(),
+                              self.auth_token)
+                else:
+                    _send_msg(conn, {"ok": True}, self.auth_token)
             elif op == "command":
                 head, body = msg["head"], msg["body"]
                 if head == "set_optimizer":
@@ -456,7 +594,10 @@ class KVClient:
                     "worker %d heartbeat loop exiting: %s", self.rank, e)
                 return
 
-    def heartbeat(self):
+    def heartbeat(self, step=None):
+        msg = {"op": "heartbeat", "rank": self.rank}
+        if step is not None:
+            msg["step"] = int(step)
         with self._hb_lock:
             if self._hb_stop.is_set():
                 # closed client must not transparently reconnect (it would
@@ -464,8 +605,7 @@ class KVClient:
                 raise RuntimeError("heartbeat after close()")
             if self._hb_sock is None:
                 self._hb_sock = self._connect(self._timeout)
-            _send_msg(self._hb_sock, {"op": "heartbeat",
-                                      "rank": self.rank})
+            _send_msg(self._hb_sock, msg)
             resp = _recv_msg(self._hb_sock)
         if resp is None or not resp.get("ok"):
             raise RuntimeError("heartbeat rpc failed")
@@ -473,6 +613,24 @@ class KVClient:
     def num_dead_node(self, timeout=60):
         return int(self._rpc({"op": "num_dead_node",
                               "timeout": timeout})["value"])
+
+    def peer_states(self):
+        """{rank: {"state": alive|lost|unknown, "age_s", "step"}} from
+        the server's liveness layer (one bounded RPC round trip)."""
+        raw = self._rpc({"op": "peer_states"})["value"]
+        return {int(r): v for r, v in raw.items()}
+
+    def report_progress(self, step):
+        """Publish this rank's training progress (window-boundary step
+        counter) so supervisors can measure recovery wall time."""
+        self._rpc({"op": "progress", "rank": self.rank,
+                   "step": int(step)})
+
+    def barrier_deadline(self, deadline_s):
+        """A barrier whose server-side wait is bounded by an explicit
+        deadline; fails typed (PeerLostError) when a participating rank
+        is lost instead of waiting the deadline out."""
+        self._rpc({"op": "barrier", "deadline": float(deadline_s)})
 
     def close(self):
         self._closed = True  # retry loops must not resurrect the socket
@@ -563,6 +721,13 @@ class KVClient:
                 attempt += 1
         for resp in resps:
             if not resp.get("ok"):
+                if resp.get("error_type") == "PeerLostError":
+                    # protocol-level typed failure: a rank this RPC was
+                    # waiting on is dead.  Never retried (retrying
+                    # cannot resurrect the peer) — the elastic recovery
+                    # path owns what happens next.
+                    raise PeerLostError(resp.get("dead_ranks", ()),
+                                        resp.get("error", ""))
                 raise RuntimeError(f"kvstore server rpc failed: {resp}")
         return resps
 

@@ -20,7 +20,7 @@ from . import metric as metric_mod
 from . import ndarray as nd
 from . import optimizer as opt_mod
 from . import telemetry as _telemetry
-from .base import MXNetError
+from .base import MXNetError, PeerLostError, PreemptionError
 from .context import cpu
 from .initializer import Uniform
 from .model import (BatchEndParam, load_checkpoint, save_checkpoint,
@@ -81,6 +81,15 @@ class BaseModule:
                              wdog, epoch_end_callback, batch_end_callback,
                              eval_end_callback, eval_batch_end_callback,
                              begin_epoch, num_epoch)
+        except (PeerLostError, PreemptionError) as e:
+            # the elastic self-heal hook: a lost peer / preemption
+            # notice surfaced at a window boundary — hand the module to
+            # the elastic session (boundary checkpoint on the survivor,
+            # telemetry) before the typed error propagates to the
+            # worker main for the survivor-mesh restore
+            from .parallel import elastic as _elastic
+            _elastic.on_fit_fault(self, e)
+            raise
         finally:
             timeline.close()
 
@@ -283,6 +292,12 @@ class BaseModule:
                 try:
                     with timeline.lane("step_dispatch"):
                         outs = self._run_scan_window(sbatch, plan)
+                except (PeerLostError, PreemptionError):
+                    # elastic events are NOT trace failures: a lost peer
+                    # or a preemption notice must reach the elastic
+                    # session (boundary checkpoint + survivor-mesh
+                    # restore), never degrade into per-batch steps
+                    raise
                 except Exception as e:  # trace failure: fall back for good
                     self.logger.warning(
                         "scanned train window disabled (%s: %s); falling "
@@ -479,6 +494,7 @@ class Module(BaseModule):
         self._scan_disabled = False
         self._mesh = None          # DeviceMesh when the mesh path engaged
         self._mesh_disabled = False
+        self._mesh_local_rows = None  # multi-process: this host's batch rows
         self._auto_mesh = None     # cached all-device dp mesh (False = n/a)
         self._batch_outs_ok = {}   # mesh eligibility: outputs carry batch
         self._zero_buf_cache = {}
@@ -1107,8 +1123,17 @@ class Module(BaseModule):
         if fs is None or fs.stale(self) or fs.scan_steps != K \
                 or fs.accum != M or getattr(fs, "mesh", None) is not mesh:
             if mesh is not None:
+                from .parallel import multihost as _mh
                 from .parallel.fused import MeshFusedTrainStep
-                fs = self._scan = MeshFusedTrainStep(self, mesh, K, M)
+                if _mh.runtime() is not None and mesh.is_multiprocess:
+                    # the coordinated multi-host flavor: per-window
+                    # rendezvous, peer-watching bounded result waits,
+                    # progress reporting (parallel/elastic.py)
+                    from .parallel.elastic import MultiHostFusedTrainStep
+                    fs = self._scan = MultiHostFusedTrainStep(
+                        self, mesh, K, M)
+                else:
+                    fs = self._scan = MeshFusedTrainStep(self, mesh, K, M)
                 self._mesh = mesh
                 self.logger.info(
                     "mesh fused train step engaged: %s, K=%d M=%d — the "
@@ -1265,10 +1290,16 @@ class Module(BaseModule):
         unstack = sbatch.count == 1
         label_map = {}
         if self._label_shapes and sbatch.label:
+            rows = getattr(self, "_mesh_local_rows", None)
+            labels = sbatch.label
+            if rows is not None:
+                # multi-process mesh: outputs carry only this host's
+                # addressable batch rows — pair them with the same
+                # label rows (metrics are per-host over the local shard)
+                labels = [l[:, rows[0]:rows[1]] for l in labels]
             label_map = {d.name: NDArray(l[0] if unstack else l,
                                          self._context)
-                         for d, l in zip(self._label_shapes,
-                                         sbatch.label)}
+                         for d, l in zip(self._label_shapes, labels)}
         pred_map = {name: NDArray(o[0] if unstack else o, self._context)
                     for name, o in zip(self.output_names, outs_flat)}
         self._pending_metric.append(

@@ -15,7 +15,8 @@ from .tp import (column_parallel_dense, row_parallel_dense,
                  init_transformer_params, shard_transformer_params,
                  transformer_block_ref, transformer_block_tp)
 from .ring import ring_attention_local, ring_self_attention
-from .multihost import init_multihost, is_coordinator
+from .multihost import (init_multihost, init_runtime, is_coordinator,
+                        runtime)
 from .pipeline import (gpipe_fn, pipeline_apply, stack_stage_params,
                        pipeline_efficiency)
 from .moe import init_moe_params, moe_ffn, moe_ffn_ep

@@ -74,6 +74,8 @@ from .. import random as _random
 from .. import telemetry as _telemetry
 from ..base import MXNetError
 from ..fused_step import ScanTrainStep
+from ..gradient_compression import (COLLECTIVE_CODECS, codec_wire_bytes,
+                                    decode_2bit_sum, quantize_2bit_flat)
 from ..ndarray import NDArray
 from ._shard_map import shard_map
 from .mesh import DeviceMesh
@@ -138,6 +140,49 @@ def bucketed_all_reduce(grads, axis_names, plan):
                 grads[i].shape)
             off += n
     return out
+
+
+def compressed_bucket_all_reduce(grads, axis_names, plan, codec,
+                                 threshold, residuals):
+    """Per-bucket gradient exchange with an opt-in codec (ISSUE 11):
+
+    * ``fp16`` — ONE half-width ``psum`` per bucket (wire bytes halved;
+      the sum reassociates in fp16, ~1e-3 relative tolerance);
+    * ``2bit`` — kTwoBit error-feedback quantization *inside the trace*:
+      each rank quantizes its flat bucket against its own residual
+      (``residuals[b]`` is this rank's (1, n) slice of the rank-sharded
+      residual carry), ONE ``all_gather`` per bucket moves the packed
+      uint8 codes (4 codes/byte — 2 bits/element on the wire), and
+      every rank decodes + sums the gathered codes, exactly like the
+      reference parameter server's DataHandleCompressed.
+
+    Buckets whose dtype is not float32 fall back to the dense ``psum``.
+    Returns ``(grads_out, new_residuals)``; residuals pass through
+    untouched for codecs that keep no state.
+    """
+    out = [None] * len(grads)
+    new_res = list(residuals)
+    for b, bucket in enumerate(plan):
+        flat = jnp.concatenate([grads[i].ravel() for i in bucket]) \
+            if len(bucket) > 1 else grads[bucket[0]].ravel()
+        if codec == "2bit" and flat.dtype == jnp.float32:
+            packed, res = quantize_2bit_flat(
+                flat, residuals[b][0], threshold)
+            gathered = jax.lax.all_gather(packed, axis_names)  # graftlint: disable=per-param-collective -- one all-gather of packed CODES per bucket: the compressed batched form
+            flat = decode_2bit_sum(gathered, threshold, flat.shape[0])
+            new_res[b] = res.reshape((1,) + res.shape)
+        elif codec == "fp16" and flat.dtype == jnp.float32:
+            flat = jax.lax.psum(flat.astype(jnp.float16), axis_names)  # graftlint: disable=per-param-collective -- one half-width psum per BUCKET
+            flat = flat.astype(jnp.float32)
+        else:
+            flat = jax.lax.psum(flat, axis_names)  # graftlint: disable=per-param-collective -- dense fallback for non-f32 buckets, still one psum per BUCKET
+        off = 0
+        for i in bucket:
+            n = grads[i].size
+            out[i] = jax.lax.dynamic_slice(flat, (off,), (n,)).reshape(
+                grads[i].shape)
+            off += n
+    return out, tuple(new_res)
 
 
 def _flat_bucket(arrs, pad):
@@ -255,7 +300,8 @@ class MeshFusedTrainStep(ScanTrainStep):
     """
 
     def __init__(self, module, mesh, scan_steps=1, accum=1,
-                 layout="replicated", bucket_mb=None, comm_mode=None):
+                 layout="replicated", bucket_mb=None, comm_mode=None,
+                 compression=None):
         from .. import config as _config
         if not isinstance(mesh, DeviceMesh):
             raise MXNetError("mesh must be a parallel.DeviceMesh")
@@ -263,6 +309,19 @@ class MeshFusedTrainStep(ScanTrainStep):
             raise MXNetError(f"unknown mesh layout {layout!r}; "
                              f"options: {LAYOUTS}")
         super().__init__(module, scan_steps, accum)
+        self.codec = compression if compression is not None else \
+            _config.get("MXNET_COLLECTIVE_COMPRESSION")
+        if self.codec not in COLLECTIVE_CODECS:
+            raise MXNetError(
+                f"unknown collective compression {self.codec!r}; "
+                f"options: {COLLECTIVE_CODECS}")
+        if self.codec != "none" and layout == "fsdp":
+            raise MXNetError(
+                "collective compression composes with the replicated "
+                "layout only (the fsdp flat-shard update needs exact "
+                "per-shard reduce-scatter semantics)")
+        self.codec_threshold = float(
+            _config.get("MXNET_COLLECTIVE_COMPRESSION_THRESHOLD"))
         if self._aux_names:
             # per-replica aux mutation (BN running stats) would need
             # sync-BN semantics the per-param loop does not have —
@@ -281,6 +340,9 @@ class MeshFusedTrainStep(ScanTrainStep):
         self._plan = None
         self._grad_bytes = 0
         self._comm_est_s = None  # calibrated standalone collective cost
+        self._bucket_elems = ()   # per-bucket flat element counts
+        self._residual_bufs = None  # 2bit error-feedback carry (rank-sharded)
+        self._rest_cache = {}     # multiprocess replicated rest-arg cache
 
     # Module routes mesh training through whole windows only; the
     # single-batch fused entry point stays on the per-param loop
@@ -306,6 +368,21 @@ class MeshFusedTrainStep(ScanTrainStep):
         self._grad_bytes = sum(
             int(np.prod(s, dtype=np.int64)) * np.dtype(d).itemsize
             for s, d in zip(shapes, dtypes))
+        elems = [int(np.prod(s, dtype=np.int64)) if s else 1
+                 for s in shapes]
+        self._bucket_elems = tuple(sum(elems[i] for i in bucket)
+                                   for bucket in self._plan)
+        if self.codec == "2bit":
+            # error-feedback residual: one (n_shards, bucket_elems) f32
+            # array per bucket, rank-sharded on dim 0 — each mesh rank
+            # carries ITS OWN residual through the donated scan carry
+            # (fresh zeros on rebuild/restore; docs/parallel.md)
+            self._residual_bufs = [
+                self.mesh.put_batch(
+                    np.zeros((self._n_shards, n), np.float32), 0)
+                for n in self._bucket_elems]
+        else:
+            self._residual_bufs = []
 
     # -- trace ---------------------------------------------------------------
     def _build_scan_jit(self):
@@ -335,9 +412,12 @@ class MeshFusedTrainStep(ScanTrainStep):
         layout = self.layout
         comm_on = self.comm_mode != "off"
         n_shards = self._n_shards
+        codec = self.codec
+        threshold = self.codec_threshold
         outer = self
 
-        def window(keys, feeds, lrs, wds, train_vals, rest_vals, states):
+        def window(keys, feeds, lrs, wds, train_vals, rest_vals, states,
+                   residuals):
             # per-shard program: feeds arrive batch-sharded, params and
             # optimizer state replicated; ONE collective per bucket per
             # scanned step synchronizes gradients across the mesh
@@ -362,7 +442,7 @@ class MeshFusedTrainStep(ScanTrainStep):
                 return outs, grads
 
             def body(carry, xs):
-                tv, st = carry
+                tv, st, res = carry
                 key_s, feed_s, lr_s, wd_s = xs
                 grads_sum = None
                 outs_micro = []
@@ -379,39 +459,81 @@ class MeshFusedTrainStep(ScanTrainStep):
                         opt, list(tv), grads_sum, list(st),
                         lr_row, wd_row, axes, plan, n_shards)
                 else:
-                    if comm_on:
+                    if comm_on and codec != "none":
+                        grads_sum, res = compressed_bucket_all_reduce(
+                            grads_sum, axes, plan, codec, threshold, res)
+                    elif comm_on:
                         grads_sum = bucketed_all_reduce(
                             grads_sum, axes, plan)
                     new_params, new_states = opt.fused_update(
                         list(tv), grads_sum, list(st), lr_row, wd_row)
                 ys = tuple(jnp.stack([o[i] for o in outs_micro])
                            for i in range(len(outs_micro[0])))
-                return (tuple(new_params), new_states), ys
+                return (tuple(new_params), new_states, res), ys
 
             carry, ys = jax.lax.scan(
-                body, (train_vals, states), (keys, feeds, lrs, wds))
-            tv, st = carry
-            return tv, st, ys
+                body, (train_vals, states, residuals),
+                (keys, feeds, lrs, wds))
+            tv, st, res = carry
+            return tv, st, res, ys
 
         batch_spec = P(None, None, axes)  # (K, M, B, ...), B sharded
         state_specs = jax.tree_util.tree_map(lambda _: P(),
                                              self._states_template)
+        res_spec = P(axes)  # (n_shards, n): each rank its own residual
         in_specs = (batch_spec,                            # keys
                     tuple(batch_spec for _ in self._feed_order),
                     P(), P(),                              # lrs, wds
                     tuple(P() for _ in self._train_names),
                     tuple(P() for _ in self._rest_names),
-                    state_specs)
+                    state_specs,
+                    tuple(res_spec for _ in self._residual_bufs))
         out_specs = (tuple(P() for _ in self._train_names),
                      state_specs,
+                     tuple(res_spec for _ in self._residual_bufs),
                      tuple(batch_spec for _ in range(self._n_outs)))
         smapped = shard_map(window, mesh=self.mesh.jax_mesh,
                             in_specs=in_specs, out_specs=out_specs,
                             check_vma=False)
-        # donate the carry (weights + optimizer state): the window's
-        # final carry aliases them in place, one buffer set per window
-        self._scan_jit = jax.jit(smapped, donate_argnums=(4, 6))
+        # donate the carry (weights + optimizer state + codec
+        # residuals): the window's final carry aliases them in place,
+        # one buffer set per window
+        self._scan_jit = jax.jit(smapped, donate_argnums=(4, 6, 7))
         self._comm_est_s = None
+
+    # -- multi-process placement helpers ------------------------------------
+    def _owned_or_copy(self, token, buf, sharding=None):
+        """Ledger copy with multi-process-safe re-placement: a buffer
+        not produced by our own last window (checkpoint restore, user
+        set_params) is fully replicated host-side, so every process can
+        rebuild the global replicated array from its own copy —
+        ``jax.device_put`` cannot reach non-addressable devices."""
+        if self._owned.get(token) is buf:
+            return buf
+        if sharding is not None and self.mesh.is_multiprocess:
+            return self.mesh.put_replicated(np.asarray(buf))
+        return super()._owned_or_copy(token, buf, sharding)
+
+    def _place_rest(self, name, buf):
+        """Non-trained, non-feed args ride replicated; on a multi-process
+        mesh they are placed once and cached by source buffer."""
+        if not self.mesh.is_multiprocess:
+            return buf
+        src, placed = self._rest_cache.get(name, (None, None))
+        if src is not buf:
+            placed = self.mesh.put_replicated(np.asarray(buf))
+            self._rest_cache[name] = (buf, placed)
+        return placed
+
+    def _local_rows_of(self, y, W):
+        """Re-assemble this process's addressable rows of a batch-
+        sharded (K, M, B, ...) output into a host (W, B_local, ...)
+        array (shards sorted by their batch offset)."""
+        shards = sorted(y.addressable_shards,
+                        key=lambda s: s.index[2].start or 0)
+        local = np.concatenate([np.asarray(s.data) for s in shards],
+                               axis=2)
+        return local.reshape((W,) + tuple(local.shape[2:]))
 
     def _calibrate_comm(self):
         """Standalone cost of ONE scanned step's gradient collectives
@@ -437,7 +559,7 @@ class MeshFusedTrainStep(ScanTrainStep):
             in_specs=(tuple(P() for _ in shapes),),
             out_specs=tuple(P() for _ in shapes), check_vma=False)
         jitted = jax.jit(smapped)
-        zeros = tuple(jax.device_put(jnp.zeros(s, d), self._repl)
+        zeros = tuple(self.mesh.put_replicated(np.zeros(s, np.dtype(str(d))))
                       for s, d in zip(shapes, dtypes))
         jax.block_until_ready(jitted(zeros))  # compile outside the clock
         best = None
@@ -450,8 +572,17 @@ class MeshFusedTrainStep(ScanTrainStep):
         self._comm_est_s = float(best)
         return self._comm_est_s
 
+    def _post_dispatch(self, tv, st, res, ys):
+        """Hook between the window dispatch and the first host read of
+        its results; the multi-host subclass bounds the wait here."""
+
     def comm_seconds_per_step(self):
-        """Calibrated standalone collective seconds per train step."""
+        """Calibrated standalone collective seconds per train step.
+        Skipped (0.0) on a multi-process mesh: the calibration dispatch
+        is an uncoordinated collective with an unbounded block — a peer
+        dying mid-calibration would hang it (docs/parallel.md)."""
+        if self.mesh.is_multiprocess:
+            return 0.0
         if self._comm_est_s is None:
             self._calibrate_comm()
         return self._comm_est_s or 0.0
@@ -482,7 +613,8 @@ class MeshFusedTrainStep(ScanTrainStep):
         opt = module._optimizer
         sig = (opt.fused_static_signature(), K, M, self._axes,
                tuple(self.mesh.axes.items()), self.layout,
-               self.bucket_mb, self.comm_mode,
+               self.bucket_mb, self.comm_mode, self.codec,
+               self.codec_threshold,
                tuple(sorted((n, tuple(a.shape), str(a.dtype))
                             for n, a in feed.items())))
         # stage the carry FIRST: the states template (structure + count)
@@ -498,7 +630,8 @@ class MeshFusedTrainStep(ScanTrainStep):
             self._scan_sig = sig
 
         # stacked feeds: (K, M, *bound), batch dim sharded over the mesh
-        batch_sh = self.mesh.sharding(None, None, self._axes)
+        # (a multi-process mesh routes through put_batch, where each
+        # process contributes only its local row block)
         feed_bufs = []
         for name in self._feed_order:
             buf = feed[name]
@@ -506,9 +639,9 @@ class MeshFusedTrainStep(ScanTrainStep):
             if buf.dtype != bound._data.dtype:
                 buf = buf.astype(bound._data.dtype)
             buf = buf.reshape((K, M) + tuple(bound.shape))
-            feed_bufs.append(jax.device_put(buf, batch_sh))  # graftlint: disable=per-param-collective -- one resharding put per INPUT POSITION per window (2 for data+label), not per parameter
+            feed_bufs.append(self.mesh.put_batch(np.asarray(buf), 2))  # graftlint: disable=per-param-collective -- one resharding put per INPUT POSITION per window (2 for data+label), not per parameter
 
-        rest_vals = tuple(exec_.arg_dict[n]._data
+        rest_vals = tuple(self._place_rest(n, exec_.arg_dict[n]._data)
                           for n in self._rest_names)
         lrs, wds = opt.fused_window_hyperparams(self._opt_indices, K)
         lrs = np.asarray(lrs, np.float32)
@@ -519,35 +652,51 @@ class MeshFusedTrainStep(ScanTrainStep):
         keys = np.stack([np.asarray(_random.next_key())
                          for _ in range(W * self._n_shards)])
         keys = keys.reshape((K, M, self._n_shards) + keys.shape[1:])
-        keys = jax.device_put(keys, batch_sh)
+        keys = self.mesh.put_batch(keys, 2)
 
         # the host-side window boundary: the chaos 'parallel/collective'
         # site arms delay/wedge/kill here, deterministically between the
         # last boundary's host control and this window's dispatch
         _failpoint("parallel/collective")
 
+        residuals = tuple(self._residual_bufs)
         with _telemetry.span("fit/step/mesh_dispatch"):
             if self._just_built:
                 from .. import compile as _compile
                 with _compile.LEDGER.attribute("mesh_step"):
-                    tv, st, ys = self._scan_jit(
+                    tv, st, res, ys = self._scan_jit(
                         keys, tuple(feed_bufs), lrs, wds,
-                        train_vals, rest_vals, states)
+                        train_vals, rest_vals, states, residuals)
                 self._just_built = False
             else:
-                tv, st, ys = self._scan_jit(
+                tv, st, res, ys = self._scan_jit(
                     keys, tuple(feed_bufs), lrs, wds,
-                    train_vals, rest_vals, states)
+                    train_vals, rest_vals, states, residuals)
         _prof.record_dispatch("mesh_window")
+        # coordination hook (parallel/elastic.py): a multi-host step
+        # bounds the wait on the in-flight window HERE, before any host
+        # read below could block unboundedly on a doomed collective
+        self._post_dispatch(tv, st, res, ys)
 
         self._writeback_carry(tv, (), st, states_nd)
+        self._residual_bufs = list(res)
         module._zero_grads()
         self._account_collectives(K)
 
         # (K, M, *out) -> (K*M, *out): position j is micro-batch j's
         # full-batch forward outputs, replicated back off the mesh for
-        # the boundary metric flush
-        outs_flat = [y.reshape((W,) + tuple(y.shape[2:])) for y in ys]
+        # the boundary metric flush.  On a multi-process mesh each
+        # process re-assembles only its ADDRESSABLE batch rows (metrics
+        # are per-host over the local shard; module slices labels to
+        # the same rows via _mesh_local_rows).
+        if self.mesh.is_multiprocess:
+            outs_flat = [self._local_rows_of(y, W) for y in ys]
+            module._mesh_local_rows = self.mesh.local_rows(
+                exec_.arg_dict[self._feed_order[0]].shape[0])
+        else:
+            outs_flat = [y.reshape((W,) + tuple(y.shape[2:]))
+                         for y in ys]
+            module._mesh_local_rows = None
         exec_.outputs = [NDArray(y[W - 1], module._context)
                          for y in outs_flat]
         exec_._vjp_holder = None
@@ -565,14 +714,31 @@ class MeshFusedTrainStep(ScanTrainStep):
         have no separately observable host wall time)."""
         if self.comm_mode == "off":
             return
-        kind = "reduce_scatter" if self.layout == "fsdp" else "psum"
         est = self.comm_seconds_per_step()
-        _telemetry.record_collective(kind, self._grad_bytes * K,
+        if self.codec != "none":
+            # compressed exchange: account the bytes that actually ride
+            # the wire per rank under the ring schedule (2 bits/element
+            # packed for 2bit, half-width for fp16) — the shrink the
+            # MXNET_COLLECTIVE_COMPRESSION gate measures
+            kind = ("all_gather_q2bit" if self.codec == "2bit"
+                    else "psum_fp16")
+            wire = codec_wire_bytes(self._grad_bytes, self._n_shards,
+                                    self.codec)
+            _telemetry.record_collective(kind, wire * K, est * K,
+                                         len(self._plan) * K)
+            return
+        # dense collectives account the same per-rank ring-schedule wire
+        # bytes as the compressed kinds (codec_wire_bytes), so the
+        # compression ratio reads directly off mxnet_collective_bytes
+        kind = "reduce_scatter" if self.layout == "fsdp" else "psum"
+        r = self._n_shards
+        half = int(self._grad_bytes * (r - 1) / max(1, r))
+        dense = half if self.layout == "fsdp" else 2 * half
+        _telemetry.record_collective(kind, dense * K,
                                      est * K, len(self._plan) * K)
         if self.layout == "fsdp":
             _telemetry.record_collective(
-                "all_gather", self._grad_bytes * K, 0.0,
-                len(self._plan) * K)
+                "all_gather", half * K, 0.0, len(self._plan) * K)
         st = _telemetry.current_step_timer()
         if st.active and est:
             share = est * K

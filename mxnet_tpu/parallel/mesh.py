@@ -62,6 +62,59 @@ class DeviceMesh:
     def replicated(self):
         return NamedSharding(self.jax_mesh, PartitionSpec())
 
+    # -- multi-process placement (ISSUE 11) ---------------------------------
+    @property
+    def process_indices(self):
+        """Sorted process indices spanned by this mesh's devices."""
+        return sorted({d.process_index for d in
+                       self.jax_mesh.devices.flat})
+
+    @property
+    def is_multiprocess(self):
+        """True when the mesh spans more than one jax process — plain
+        ``jax.device_put`` cannot place onto non-addressable devices, so
+        feeds/params route through :meth:`put_batch`/:meth:`put_replicated`
+        (``jax.make_array_from_process_local_data``) instead."""
+        return len(self.process_indices) > 1
+
+    def local_rows(self, n):
+        """This process's contiguous ``[lo, hi)`` row range of a length-
+        ``n`` dim sharded over ALL mesh axes.  Mesh devices are process-
+        major (jax.devices() order), so every process owns one
+        contiguous, equal block."""
+        procs = self.process_indices
+        idx = procs.index(jax.process_index())
+        per = n // len(procs)
+        return idx * per, (idx + 1) * per
+
+    def put_batch(self, host_array, dim, *spec):
+        """Place a host array with ``dim`` sharded over all mesh axes
+        (remaining dims per ``spec``, replicated by default).  On a
+        multi-process mesh each process contributes only its local row
+        block of ``dim``."""
+        if not spec:
+            spec = [None] * host_array.ndim
+            spec[dim] = self.axis_names
+        sh = self.sharding(*spec)
+        if not self.is_multiprocess:
+            return jax.device_put(host_array, sh)
+        lo, hi = self.local_rows(host_array.shape[dim])
+        sl = [slice(None)] * host_array.ndim
+        sl[dim] = slice(lo, hi)
+        local = np.ascontiguousarray(np.asarray(host_array)[tuple(sl)])
+        return jax.make_array_from_process_local_data(
+            sh, local, global_shape=tuple(host_array.shape))
+
+    def put_replicated(self, host_array):
+        """Place a host array fully replicated over the mesh (every
+        process passes the same full array on a multi-process mesh)."""
+        sh = self.replicated()
+        if not self.is_multiprocess:
+            return jax.device_put(host_array, sh)
+        host_array = np.asarray(host_array)
+        return jax.make_array_from_process_local_data(
+            sh, host_array, global_shape=tuple(host_array.shape))
+
     def __enter__(self):
         _CURRENT_MESH.append(self)
         self._ctx = self.jax_mesh

@@ -159,7 +159,7 @@ def main():
     barrier = threading.Barrier(N_CLIENTS)
 
     def client(i):
-        barrier.wait()
+        barrier.wait(timeout=60)  # a stuck sibling breaks the barrier typed
         try:
             out = server.predict("mlp", {"data": xs[i]}, wait_s=60.0)
             results[i] = ("ok", out[0])

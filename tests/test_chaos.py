@@ -572,3 +572,19 @@ def test_scenario_mesh_collective_stall(tmp_path):
     assert r["wedge"]["names_fit_section"]
     assert r["victim_exit"] == -9 and not r["victim_finished"]
     assert r["diverged_params"] == []
+
+
+@pytest.mark.slow
+def test_scenario_peer_loss_mid_window(tmp_path):
+    """ISSUE 11: host 1 of a 2-process jax.distributed mesh is
+    SIGKILLed at window 3 — the survivor takes a TYPED exit from the
+    deadline-bounded rendezvous (zero hangs, zero untyped failures),
+    the boundary checkpoint commits, the elastic launcher respawns the
+    dp/2 survivor world, and the continued fit is BITWISE identical to
+    a planned resize at the same boundary."""
+    r = harness.scenario_peer_loss_mid_window(str(tmp_path / "s7"))
+    assert r["ok"], json.dumps(r, default=str)
+    assert r["typed_only"], r["gen0_exits"]
+    assert r["survivor_world"] == 1
+    assert r["recovery_s"] is not None and r["recovery_s"] < 60
+    assert r["diverged_params"] == []

@@ -159,3 +159,54 @@ def test_dist_sync_4workers_compressed(tmp_path):
     np.testing.assert_allclose(per_worker[4:], 6 * 0.1, atol=0.25 + 0.1)
     # and something was actually emitted (the wire path works)
     assert (per_worker[:4] > 0).all()
+
+
+# -- traced collective codecs (ISSUE 11) -------------------------------------
+def test_jnp_quantize_matches_numpy_reference():
+    """The in-trace kTwoBit codec (quantize_2bit_flat/decode_2bit_sum)
+    must emit exactly the NumPy reference's codes and keep the same
+    error-feedback residual."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.gradient_compression import (decode_2bit_sum,
+                                                quantize_2bit_flat)
+
+    rng = np.random.RandomState(7)
+    grad = rng.randn(37).astype(np.float32)  # non-multiple-of-4 length
+    res = rng.randn(37).astype(np.float32) * 0.1
+
+    ref = GradientCompression(threshold=0.5)
+    ref._residuals["k"] = res.copy()
+    ref_packed = ref.quantize("k", grad)
+    ref_deq = ref.dequantize(ref_packed, grad.shape)
+
+    packed, new_res = jax.jit(
+        lambda f, r: quantize_2bit_flat(f, r, 0.5))(grad, res)
+    np.testing.assert_array_equal(np.asarray(packed), ref_packed)
+    np.testing.assert_allclose(np.asarray(new_res),
+                               ref._residuals["k"], atol=1e-6)
+    # decode-sum over a fake 2-rank gather == sum of dequantized values
+    gathered = jnp.stack([jnp.asarray(packed), jnp.asarray(packed)])
+    summed = jax.jit(
+        lambda g: decode_2bit_sum(g, 0.5, grad.shape[0]))(gathered)
+    np.testing.assert_allclose(np.asarray(summed), 2 * ref_deq,
+                               atol=1e-6)
+
+
+def test_codec_wire_bytes_ring_math():
+    from mxnet_tpu.gradient_compression import codec_wire_bytes
+
+    B = 1 << 20
+    # dense ring all-reduce: 2 * B * (R-1)/R
+    assert codec_wire_bytes(B, 8, "none") == int(2 * B * 7 / 8)
+    # fp16 halves it
+    assert codec_wire_bytes(B, 8, "fp16") == int(B * 7 / 8)
+    # 2bit: (R-1) * B/16 -> dense/2bit == 32/R
+    assert codec_wire_bytes(B, 8, "2bit") == int(7 * B / 16)
+    ratio = codec_wire_bytes(B, 8, "none") / codec_wire_bytes(B, 8,
+                                                              "2bit")
+    assert abs(ratio - 32 / 8) < 1e-9
+    # R=2 (the cross-host pair): 16x
+    r2 = codec_wire_bytes(B, 2, "none") / codec_wire_bytes(B, 2, "2bit")
+    assert abs(r2 - 16.0) < 1e-9

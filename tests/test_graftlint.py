@@ -728,3 +728,83 @@ def test_repo_gate_is_green():
     is self-clean on its own code (mxnet_tpu/analysis, tools)."""
     r = _cli("--fail-on-new")
     assert r.returncode == 0, r.stdout + r.stderr
+
+
+# -- unbounded-wait ----------------------------------------------------------
+UNBOUNDED_WAIT = """
+    import threading
+
+    class Runtime:
+        def __init__(self):
+            self._done = threading.Event()
+            self._t = threading.Thread(target=self._run)
+
+        def shutdown(self):
+            self._done.wait()
+            self._t.join()
+"""
+
+
+def test_unbounded_wait_flags_join_and_wait_in_coordination_path():
+    findings = lint(UNBOUNDED_WAIT, path="mxnet_tpu/parallel/fake.py")
+    hits = [f for f in findings if f.rule == "unbounded-wait"]
+    assert len(hits) == 2
+    assert {h.symbol for h in hits} == {"shutdown:wait",
+                                        "shutdown:join"}
+    assert "deadline" in hits[0].message
+
+
+def test_unbounded_wait_flags_wait_for_and_result():
+    src = """
+        def drain(cv, fut):
+            cv.wait_for(lambda: True)
+            fut.result()
+    """
+    findings = lint(src, path="mxnet_tpu/kvstore_server.py")
+    assert len([f for f in findings
+                if f.rule == "unbounded-wait"]) == 2
+
+
+def test_unbounded_wait_near_miss_computed_timeout():
+    # a deadline-derived timeout (keyword OR positional) is the fix the
+    # rule steers toward — silent, even when computed
+    src = """
+        import time
+
+        def shutdown(ev, t, cv, deadline):
+            ev.wait(timeout=deadline - time.monotonic())
+            t.join(5)
+            cv.wait_for(lambda: True, deadline - time.monotonic())
+    """
+    findings = lint(src, path="mxnet_tpu/parallel/fake.py")
+    assert "unbounded-wait" not in rules_hit(findings)
+
+
+def test_unbounded_wait_near_miss_string_join_and_cold_path():
+    # str/path join takes arguments — not a thread join
+    src = """
+        import os
+
+        def render(parts):
+            return ",".join(parts) + os.path.join("a", "b")
+    """
+    assert "unbounded-wait" not in rules_hit(
+        lint(src, path="mxnet_tpu/parallel/fake.py"))
+    # the same unbounded wait OUTSIDE the coordination modules is
+    # offline tooling's business — silent
+    assert "unbounded-wait" not in rules_hit(
+        lint(UNBOUNDED_WAIT, path="tools/im2rec.py"))
+
+
+def test_unbounded_wait_suppression():
+    # a line between the suppressed wait and the join: a suppression
+    # covers its own line and the one after it
+    src = UNBOUNDED_WAIT.replace(
+        "self._done.wait()\n            self._t.join()",
+        "self._done.wait()  # graftlint: disable=unbounded-wait -- "
+        "caller-contract drain\n            x = 1\n"
+        "            self._t.join()")
+    findings = lint(src, path="mxnet_tpu/parallel/fake.py")
+    hits = [f for f in findings if f.rule == "unbounded-wait"]
+    assert len(hits) == 1  # only the join remains
+    assert hits[0].symbol == "shutdown:join"
