@@ -433,6 +433,42 @@ def measure_telemetry_overhead():
                           "budget_ns": 1000}}
 
 
+def measure_trace_overhead():
+    """Disabled-path cost of the ISSUE-12 observability hooks: one
+    trace start+stage (the per-request/per-window tracing) plus one
+    flight-recorder record (the decision-event ring).  Both are wired
+    into hot paths unconditionally, so — like a disabled span or chaos
+    failpoint — the off path must stay well under 1 us per event."""
+    import time as _t
+
+    from mxnet_tpu.telemetry import flight, trace
+
+    was_trace = trace.enabled()
+    was_flight = flight.enabled()
+    trace.disable()
+    flight.disable()
+    try:
+        n = 50000
+        best = float("inf")
+        for _ in range(3):
+            t0 = _t.perf_counter()
+            for _ in range(n):
+                tr = trace.start("bench")
+                with tr.stage("noop"):
+                    pass
+                flight.record("bench", "noop", value=1)
+            # three hook events per iteration: start+stage, record
+            best = min(best, (_t.perf_counter() - t0) / (3 * n))
+    finally:
+        if was_trace:
+            trace.enable()
+        if was_flight:
+            flight.enable()
+    return {"trace": {"metric": "trace_disabled_overhead_ns",
+                      "value": round(best * 1e9, 1), "unit": "ns",
+                      "budget_ns": 1000}}
+
+
 def measure_degraded_p99():
     """Relay-proof host phase ``degraded_p99_ms`` (ISSUE 8): serving p99
     with one of two batcher workers WEDGED (chaos failpoint) versus
@@ -1331,6 +1367,18 @@ def main():
                 log(f"telemetry phase failed: {type(e).__name__}: {e}")
                 result["telemetry"] = {
                     "metric": "telemetry_disabled_span_ns",
+                    "error": f"{type(e).__name__}: {e}"}
+
+        if _cfg0.get("BENCH_TRACE"):
+            try:
+                result.update(measure_trace_overhead())
+                log(f"[trace] disabled trace/flight hook "
+                    f"{result['trace']['value']} ns "
+                    f"(budget {result['trace']['budget_ns']})")
+            except Exception as e:
+                log(f"trace phase failed: {type(e).__name__}: {e}")
+                result["trace"] = {
+                    "metric": "trace_disabled_overhead_ns",
                     "error": f"{type(e).__name__}: {e}"}
 
         if _cfg0.get("BENCH_SERVE_SPIKE"):

@@ -16,7 +16,8 @@ git diff --exit-code cpp-package/include/mxnet_tpu/op.hpp
 
 echo "== graftlint (project-native static analysis, baseline-gated) =="
 # lock-discipline / torn-write / host-sync / tracer-leak /
-# swallowed-error / env-knob-drift / raw-phase-timing / naked-retry;
+# swallowed-error / env-knob-drift / raw-phase-timing / naked-retry /
+# unbounded-wait / per-param-collective / metric-cardinality;
 # fails only on NEW violations
 # (ci/graftlint_baseline.json holds triaged pre-existing debt).
 # docs/lint.md has the rule catalog and suppression syntax.
@@ -47,7 +48,12 @@ echo "== elastic multi-host smoke (2 processes x 4 fake devices: kill-and-recove
 # rendezvous, commits the boundary checkpoint, and the launcher
 # respawns the dp/2 survivor world — the continued fit must be BITWISE
 # identical to a planned resize, within the per-process dispatch
-# budget (docs/parallel.md preemption runbook)
+# budget (docs/parallel.md preemption runbook).  The smoke also scrapes
+# the leader's /fleet.json (the killed rank must be tagged lost with
+# its last registry snapshot, per-rank families present for EVERY
+# generation) and validates the fault generation's postmortem bundle:
+# all ranks' flight rings + the final fleet snapshot, with the injected
+# site as the first anomalous event (docs/observability.md runbook)
 JAX_PLATFORMS=cpu python -m mxnet_tpu.parallel.elastic
 
 echo "== serving smoke (replica pools: 64-client burst + autoscaling hot-swap) =="

@@ -1,0 +1,166 @@
+"""metric-cardinality — unbounded label values on hot-path metrics.
+
+A Prometheus-style registry keys one value cell per label SET: a label
+whose values come from an unbounded source (request ids, trace ids,
+raw paths, URLs, exception messages) grows the registry without bound —
+every scrape ships the whole history, the exporter's memory climbs
+forever, and the one series an operator cares about drowns in millions
+of dead ones.  ISSUE 12's tracing layer makes the temptation concrete:
+``trace_id`` belongs in the exemplar store and the flight ring, NEVER
+in a metric label.
+
+The rule fires on registry metric updates — ``.inc()`` / ``.dec()`` /
+``.set()`` / ``.observe()`` calls carrying a ``labels={...}`` dict —
+inside the hot-path modules (serving/, parallel/, kvstore*, chaos/,
+telemetry/, checkpoint/, module.py, fused_step.py, io.py) where a label
+VALUE is an unbounded source:
+
+* an identifier (name or attribute, possibly wrapped in ``str()`` /
+  ``repr()`` / ``format()``) whose name carries an unbounded token:
+  ``trace_id`` / ``request_id`` / ``uuid`` / ``path`` / ``filename`` /
+  ``url`` / ``addr`` / ``msg`` / ``message`` / ``detail`` /
+  ``traceback``;
+* a live **exception variable** (``except ... as e:`` in scope) or its
+  stringification — exception TEXT is unbounded; the bounded form is
+  ``type(e).__name__``;
+* an f-string interpolating either of the above.
+
+Near-misses stay silent: string constants, enum-like names
+(``state``/``kind``/``lane``/``site``/``action``/``op``), model and
+replica names (``self.model``, ``str(rid)``), ``type(e).__name__``
+(class names are a bounded set), and computed values whose identifiers
+carry no unbounded token.  Deliberate exceptions carry
+``# graftlint: disable=metric-cardinality -- reason``.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule, register_rule
+
+HOT_PREFIXES = (
+    "mxnet_tpu/serving/",
+    "mxnet_tpu/parallel/",
+    "mxnet_tpu/kvstore",
+    "mxnet_tpu/chaos/",
+    "mxnet_tpu/telemetry/",
+    "mxnet_tpu/checkpoint/",
+    "mxnet_tpu/module.py",
+    "mxnet_tpu/fused_step.py",
+    "mxnet_tpu/io.py",
+)
+
+_UPDATE_METHODS = {"inc", "dec", "set", "observe"}
+
+# identifier substrings marking an unbounded value source
+_UNBOUNDED_TOKENS = ("request_id", "trace_id", "uuid", "filename",
+                     "fname", "url", "addr", "message", "msg",
+                     "detail", "traceback", "path")
+
+# wrappers that stringify without bounding the value space
+_STR_WRAPPERS = {"str", "repr", "format"}
+
+
+def _ident(expr):
+    """The rightmost identifier of a Name/Attribute chain (or None)."""
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+def _has_token(name):
+    if not name:
+        return False
+    low = name.lower()
+    return any(tok in low for tok in _UNBOUNDED_TOKENS)
+
+
+@register_rule
+class MetricCardinalityRule(Rule):
+    id = "metric-cardinality"
+    severity = "warning"
+    doc = ("metric label value drawn from an unbounded source (request/"
+           "trace ids, raw paths, exception text) in a hot path — one "
+           "cell per label set means the registry, the scrape and the "
+           "exporter grow without bound; put per-unit identity in the "
+           "trace exemplar store or the flight ring instead "
+           "(docs/lint.md)")
+
+    def begin_file(self, ctx):
+        self._hot = any(p in ctx.path for p in HOT_PREFIXES)
+        self._except_names = []   # stack of live `except ... as e` names
+
+    # -- exception-variable scope tracking -----------------------------------
+    def visit(self, node, ctx):
+        if isinstance(node, ast.ExceptHandler):
+            self._except_names.append(node.name)
+        if not self._hot:
+            return
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _UPDATE_METHODS):
+            return
+        labels = next((kw.value for kw in node.keywords
+                       if kw.arg == "labels"), None)
+        if not isinstance(labels, ast.Dict):
+            return
+        for key, value in zip(labels.keys, labels.values):
+            why = self._unbounded(value)
+            if why is None:
+                continue
+            label = (key.value if isinstance(key, ast.Constant)
+                     else _ident(key) or "?")
+            ctx.report(
+                self, value,
+                f"label {label!r} takes its value from {why} — an "
+                "unbounded label source grows one registry cell per "
+                "distinct value; label with a bounded enum (state/"
+                "kind/model) and put per-unit identity in the trace "
+                "exemplars or the flight ring (docs/lint.md)",
+                symbol=f"{ctx.func_name()}:{label}")
+
+    def depart(self, node, ctx):
+        if isinstance(node, ast.ExceptHandler):
+            self._except_names.pop()
+
+    # -- value classification -------------------------------------------------
+    def _is_exc_var(self, expr):
+        return (isinstance(expr, ast.Name)
+                and expr.id in set(filter(None, self._except_names)))
+
+    def _unbounded(self, expr):
+        """A human-readable reason when ``expr`` is an unbounded label
+        source; None for the bounded near-misses."""
+        # unwrap str()/repr()/format(x, ...) one level
+        if isinstance(expr, ast.Call) and isinstance(expr.func, ast.Name) \
+                and expr.func.id in _STR_WRAPPERS and expr.args:
+            inner = expr.args[0]
+            if self._is_exc_var(inner):
+                return f"{expr.func.id}() of a live exception variable"
+            if _has_token(_ident(inner)):
+                return (f"{expr.func.id}({_ident(inner)}) — an "
+                        "unbounded identifier")
+            return None
+        # f-strings: flag when any interpolated part is unbounded
+        if isinstance(expr, ast.JoinedStr):
+            for part in expr.values:
+                if isinstance(part, ast.FormattedValue):
+                    inner = part.value
+                    if self._is_exc_var(inner):
+                        return "an f-string interpolating a live " \
+                               "exception variable"
+                    if _has_token(_ident(inner)):
+                        return (f"an f-string interpolating "
+                                f"{_ident(inner)!r} — an unbounded "
+                                "identifier")
+            return None
+        if self._is_exc_var(expr):
+            return "a live exception variable (unbounded message text; " \
+               "use type(e).__name__)"
+        # type(e).__name__ and other bounded attributes pass through the
+        # token check: __name__/state/kind/... carry no unbounded token
+        if _has_token(_ident(expr)):
+            return f"identifier {_ident(expr)!r} — an unbounded source"
+        return None

@@ -395,6 +395,12 @@ def _fire(site, data):
         _injection_counter().inc(labels={"site": site, "action": a.action})
     except Exception:  # graftlint: disable=swallowed-error -- injection accounting must never mask the injection itself
         pass
+    try:
+        from ..telemetry import flight as _flight
+        _flight.record("chaos", "inject", severity="error", site=site,
+                       action=a.action, hit=_hits.get(site, 0))
+    except Exception:  # graftlint: disable=swallowed-error -- flight accounting must never mask the injection itself
+        pass
     log.warning("chaos: firing %s at %s (hit %d)", a.action, site,
                 _hits.get(site, 0))
     if a.action == "raise":
@@ -421,6 +427,14 @@ def _fire(site, data):
         if a.value == "mark":
             return data
         log.error("chaos: SIGKILL self at %s", site)
+        # flush the flight ring BEFORE the SIGKILL lands: even a
+        # vanished host leaves its event history for the postmortem
+        # bundle (the injection above is the ring's last entry)
+        try:
+            from ..telemetry import flight as _flight
+            _flight.auto_dump(f"chaos-kill:{site}")
+        except Exception:  # graftlint: disable=swallowed-error -- the kill must land even if the dump path is broken
+            pass
         os.kill(os.getpid(), signal.SIGKILL)
     return data
 

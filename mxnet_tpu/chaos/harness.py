@@ -993,8 +993,14 @@ def scenario_peer_loss_mid_window(workdir, scan_k=2, timeout=240.0):
     * the final weights are BITWISE identical to a planned resize (the
       same host *leaving* via the preemption path at the same
       boundary);
-    * recovery wall time was measured (the launcher's clock ran).
+    * recovery wall time was measured (the launcher's clock ran);
+    * the fault generation left ONE postmortem bundle whose merged
+      event rings name the injected site (``multihost/peer_loss``) as
+      the FIRST anomalous event, and whose fleet snapshot tags the
+      killed rank ``lost`` (ISSUE 12).
     """
+    import json as _json
+
     import numpy as np
 
     from ..parallel import elastic as E
@@ -1004,9 +1010,23 @@ def scenario_peer_loss_mid_window(workdir, scan_k=2, timeout=240.0):
     K, NB, BS = scan_k, 4 * scan_k, 32
     result = {"ok": False}
 
-    sa, pa, _la = E._launch(
+    sa, pa, la = E._launch(
         os.path.join(workdir, "faulted"), 2, NB, BS, K,
         rank_env={1: {"MXNET_CHAOS": "multihost/peer_loss=kill:hits=3"}})
+    result["postmortems"] = list(la.postmortems)
+    result["postmortem_rings"] = 0
+    result["first_anomaly_site"] = None
+    result["fleet_lost_tagged"] = False
+    if la.postmortems:
+        with open(la.postmortems[0], encoding="utf-8") as f:
+            bundle = _json.load(f)
+        result["postmortem_rings"] = len(bundle.get("rings", {}))
+        anomaly = bundle.get("first_anomaly") or {}
+        result["first_anomaly_site"] = \
+            (anomaly.get("fields") or {}).get("site")
+        result["fleet_lost_tagged"] = (
+            bundle.get("fleet", {}).get("ranks", {})
+            .get("1", {}).get("state") == "lost")
     sb, pb, _lb = E._launch(
         os.path.join(workdir, "planned"), 2, NB, BS, K,
         leave_at=2 * K)
@@ -1032,7 +1052,10 @@ def scenario_peer_loss_mid_window(workdir, scan_k=2, timeout=240.0):
         and sa.get("restarts") == 1
         and result["survivor_world"] == 1
         and result["recovery_s"] is not None
-        and not diverged)
+        and not diverged
+        and result["postmortem_rings"] >= 2
+        and result["first_anomaly_site"] == "multihost/peer_loss"
+        and result["fleet_lost_tagged"])
     return result
 
 

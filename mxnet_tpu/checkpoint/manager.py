@@ -317,6 +317,10 @@ class CheckpointManager:
             except BaseException as e:  # surface via future, keep writing
                 with self._lock:
                     self._stats_data["failures"] += 1
+                from ..telemetry import flight as _flight
+                _flight.record("checkpoint", "save_failed",
+                               severity="error", step=job.step,
+                               cause=type(e).__name__)
                 self.logger.exception(
                     "checkpoint: save of step %d failed", job.step)
                 job.future._set(e if isinstance(e, Exception) else
@@ -443,6 +447,10 @@ class CheckpointManager:
             self._last_commit_t = time.monotonic()
         self._record_counter("checkpoint:save_total_ms", round(total_ms, 3))
         self._record_counter("checkpoint:save_bytes", job.nbytes)
+        from ..telemetry import flight as _flight
+        _flight.record("checkpoint", "commit", step=job.step,
+                       nbytes=job.nbytes, ms=round(total_ms, 1),
+                       directory=self.directory)
         self.logger.info("checkpoint: committed step %d (%.1f MB, %.0f ms)",
                          job.step, job.nbytes / 1e6, total_ms)
 

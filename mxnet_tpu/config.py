@@ -290,6 +290,45 @@ _register("MXNET_WATCHDOG_S", float, 0.0,
           "(docs/observability.md runbook)")
 _register("MXNET_WATCHDOG_DIR", str, "",
           "directory for hang-watchdog dump files (empty = cwd)")
+_register("MXNET_WATCHDOG_KEEP", int, 8,
+          "retention for watchdog stall dumps AND flight-recorder dumps "
+          "in their target directory: newest N kept, oldest pruned at "
+          "each new dump; 0 keeps everything")
+_register("MXNET_TRACE", bool, False,
+          "end-to-end tracing: thread a trace context (trace_id + stage "
+          "spans) through every serving request (submit -> queue_wait -> "
+          "stage -> dispatch -> resolve, surviving spill hops) and every "
+          "scanned training window (collect -> stage -> rendezvous -> "
+          "dispatch -> boundary_flush); stage durations fan out to the "
+          "span sinks and finished traces feed the sampled exemplar "
+          "store (docs/observability.md trace taxonomy); the disabled "
+          "path is one global check, < 1 us")
+_register("MXNET_TRACE_SAMPLE", str, "head=8,tail=64",
+          "trace exemplar sampling policy per trace kind: keep the "
+          "first `head` finished traces (startup behaviour) plus the "
+          "`tail` slowest by end-to-end latency (the p99 outliers you "
+          "actually decompose); exemplars surface in "
+          "telemetry.snapshot()['trace'] and /snapshot.json")
+_register("MXNET_FLIGHT", bool, True,
+          "crash flight recorder: a lock-cheap bounded ring of "
+          "structured events (sheds, spills, chaos injections, restarts, "
+          "rendezvous outcomes, checkpoint commits) recorded at every "
+          "subsystem's decision points and dumped atomically on "
+          "watchdog fire / typed-fatal error / SIGTERM / chaos kill; "
+          "0 reduces every record to one global check (< 1 us)")
+_register("MXNET_FLIGHT_RING", int, 1024,
+          "flight recorder ring capacity in events (oldest evicted)")
+_register("MXNET_FLIGHT_DIR", str, "",
+          "directory for flight-recorder dump files "
+          "(empty = MXNET_WATCHDOG_DIR, then cwd); the elastic launcher "
+          "points each worker generation at its postmortem harvest dir")
+_register("MXNET_FLEET_INTERVAL_S", float, 0.0,
+          "cross-rank telemetry aggregation: every rank pushes its "
+          "registry snapshot to the control-plane kvstore server this "
+          "often so the leader can merge a fleet snapshot "
+          "(/fleet.json, rank-labelled Prometheus families; dead ranks "
+          "keep their last snapshot tagged state=lost); 0 disables the "
+          "reporter (the elastic launcher arms it for its workers)")
 # -- compilation lifecycle ---------------------------------------------------
 _register("MXNET_COMPILE_CACHE", bool, True,
           "persistent XLA compilation artifacts: serving executor-cache "
@@ -482,6 +521,12 @@ _register("BENCH_TELEMETRY", bool, True,
           "bench.py: also measure the disabled-path cost of "
           "telemetry.span (telemetry_disabled_span_ns; the <1us budget "
           "that lets hot loops stay annotated unconditionally)")
+_register("BENCH_TRACE", bool, True,
+          "bench.py: also measure the disabled-path cost of one "
+          "end-to-end trace hook + one flight-recorder record "
+          "(trace_disabled_overhead_ns; the <1us budget that lets the "
+          "request/window tracing and the event ring stay wired into "
+          "hot paths unconditionally)")
 _register("BENCH_COLD_START", bool, True,
           "bench.py: also measure cold_start_first_request_ms — warm "
           "restart (persistent compile cache) vs cold cache dir, in "

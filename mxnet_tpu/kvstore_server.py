@@ -133,6 +133,11 @@ class KVServer:
         self._dead = {}           # rank -> monotonic time marked lost
         self._dead_event = threading.Event()
         self._start_time = time.monotonic()
+        # cross-rank telemetry aggregation (ISSUE 12): latest registry
+        # payload per (generation, rank); a lost rank's last snapshot is
+        # retained so the fleet merge can tag it instead of dropping it
+        self._generation = 0
+        self._telemetry = {}      # generation -> {rank: {payload, mono}}
         # port=0 binds an OS-assigned port (port-collision-safe tests /
         # supervisor-owned control planes); bound_port is readable after
         # the started event sets
@@ -209,6 +214,9 @@ class KVServer:
                     "kvstore server: peer(s) %s lost (no heartbeat for "
                     "> %.1fs); failing their in-flight waiters typed",
                     dead, timeout)
+                from .telemetry import flight as _flight
+                _flight.record("kvstore", "peer_lost", severity="error",
+                               ranks=dead, timeout_s=timeout)
                 with self._store_cv:
                     self._store_cv.notify_all()
                 with self._barrier_cv:
@@ -236,16 +244,24 @@ class KVServer:
                              "step": self._progress.get(rank, 0)}
             return out
 
-    def reset_world(self, num_workers):
+    def reset_world(self, num_workers, generation=None):
         """Re-arm the liveness layer for a new elastic world generation
         (the launcher calls this between respawns): new worker count,
-        forgotten heartbeats/progress/dead marks, fresh barrier."""
+        forgotten heartbeats/progress/dead marks, fresh barrier.
+        Telemetry payloads are generation-keyed and KEPT — the fleet
+        history must show every generation's ranks, lost ones tagged."""
         with self._lock:
             self.num_workers = int(num_workers)
             self._heartbeats.clear()
             self._progress.clear()
             self._dead.clear()
             self._start_time = time.monotonic()
+            self._generation = (self._generation + 1 if generation is None
+                                else int(generation))
+            # bound the history (a runaway restart loop must not grow
+            # the server without bound; 16 generations tell any story)
+            for gen in sorted(self._telemetry)[:-16]:
+                del self._telemetry[gen]
         self._dead_event.clear()
         with self._barrier_cv:
             self._barrier_count = 0
@@ -409,6 +425,18 @@ class KVServer:
                 _send_msg(conn, {"ok": True}, self.auth_token)
             elif op == "peer_states":
                 _send_msg(conn, {"ok": True, "value": self._peer_states()},
+                          self.auth_token)
+            elif op == "telemetry_push":
+                with self._lock:
+                    self._telemetry.setdefault(
+                        self._generation, {})[int(msg["rank"])] = {
+                        "payload": msg.get("payload") or {},
+                        "mono": time.monotonic()}
+                _send_msg(conn, {"ok": True}, self.auth_token)
+            elif op == "fleet":
+                from .telemetry import fleet as _fleet
+                _send_msg(conn, {"ok": True,
+                                 "value": _fleet.merge_server(self)},
                           self.auth_token)
             elif op == "num_dead_node":
                 timeout = float(msg.get("timeout", 60))
@@ -625,6 +653,16 @@ class KVClient:
         counter) so supervisors can measure recovery wall time."""
         self._rpc({"op": "progress", "rank": self.rank,
                    "step": int(step)})
+
+    def push_telemetry(self, payload):
+        """Push this rank's registry snapshot for the leader's fleet
+        merge (telemetry.fleet; payload must be pickle/JSON-native)."""
+        self._rpc({"op": "telemetry_push", "rank": self.rank,
+                   "payload": payload})
+
+    def fleet_state(self):
+        """The server's merged fleet snapshot (one bounded RPC)."""
+        return self._rpc({"op": "fleet"})["value"]
 
     def barrier_deadline(self, deadline_s):
         """A barrier whose server-side wait is bounded by an explicit

@@ -243,6 +243,7 @@ class BaseModule:
             # the next W same-shape batches; shorter on epoch end or when
             # a shape-mismatched batch (tail partial, bucketing) shows up
             # — those route through the per-batch path in arrival order
+            t_c0 = time.perf_counter()
             batches, tail = [], []
             while len(batches) < W:
                 with timeline.lane("data_wait"):
@@ -255,6 +256,10 @@ class BaseModule:
                     tail.append(b)
                     break
                 batches.append(b)
+            # the interval the NEXT window's trace claims as its
+            # "collect" stage (prefetched collects belong to the window
+            # they feed, not the one in flight while they ran)
+            state["collect"] = (t_c0, time.perf_counter())
             return batches, tail
 
         def per_batch(batch):
@@ -281,22 +286,34 @@ class BaseModule:
         while True:
             batches, tail = pending
             outs = False
+            wtrace = _telemetry.trace.NULL_TRACE
             if len(batches) == W and not self._scan_disabled:
                 # the SIGKILL-mid-scan-window scenario arms a kill here:
                 # deterministically between the last boundary's host
                 # control and the next window's dispatch
                 from .chaos.failpoints import failpoint as _chaos_fp
                 _chaos_fp("train/scan_window")
-                with timeline.lane("h2d_stage"):
+                # window trace (ISSUE 12): collect -> stage ->
+                # [rendezvous, recorded by the multi-host step via the
+                # ambient trace] -> dispatch -> boundary_flush
+                wtrace = _telemetry.trace.start("train", "fit/window")
+                wtrace.add_stage(
+                    "collect", *state.get("collect",
+                                          (wtrace.t0, wtrace.t0)))
+                with timeline.lane("h2d_stage"), wtrace.stage("stage"):
                     sbatch = mx_io.stage_super_batch(batches, ctx)
+                _telemetry.trace.set_current(wtrace)
                 try:
-                    with timeline.lane("step_dispatch"):
+                    with timeline.lane("step_dispatch"), \
+                            wtrace.stage("dispatch"):
                         outs = self._run_scan_window(sbatch, plan)
-                except (PeerLostError, PreemptionError):
+                except (PeerLostError, PreemptionError) as e:
                     # elastic events are NOT trace failures: a lost peer
                     # or a preemption notice must reach the elastic
                     # session (boundary checkpoint + survivor-mesh
                     # restore), never degrade into per-batch steps
+                    wtrace.event("elastic_fault", cause=type(e).__name__)
+                    wtrace.finish(status="elastic_fault")
                     raise
                 except Exception as e:  # trace failure: fall back for good
                     self.logger.warning(
@@ -310,6 +327,8 @@ class BaseModule:
                     # NOTE: self._mesh stays set — it records that the
                     # mesh path engaged this fit (scenario evidence);
                     # _scan_disabled prevents re-entry
+                finally:
+                    _telemetry.trace.set_current(None)
             if outs is not False:
                 # prefetch: collect the next window while this scan is
                 # still in flight on device (dispatch was async)
@@ -317,18 +336,21 @@ class BaseModule:
                 # window boundary: the only host-control point — metric
                 # updates (stacked, one sync), batch callbacks,
                 # timeline, watchdog beat
-                self._window_update_metrics(eval_metric, sbatch, outs)
-                if batch_end_callback is not None:
-                    for j in range(W):
-                        batch_end_params = BatchEndParam(
-                            epoch=epoch, nbatch=nbatch + j,
-                            eval_metric=eval_metric, locals=locals())
-                        for callback in _as_list(batch_end_callback):
-                            callback(batch_end_params)
+                with wtrace.stage("boundary_flush"):
+                    self._window_update_metrics(eval_metric, sbatch, outs)
+                    if batch_end_callback is not None:
+                        for j in range(W):
+                            batch_end_params = BatchEndParam(
+                                epoch=epoch, nbatch=nbatch + j,
+                                eval_metric=eval_metric, locals=locals())
+                            for callback in _as_list(batch_end_callback):
+                                callback(batch_end_params)
+                wtrace.finish()
                 nbatch += W
                 timeline.end_step(steps=W)
                 wdog.beat("train/fit")
                 continue
+            wtrace.finish(status="fallback")
             for b in batches:
                 per_batch(b)
             for b in tail:

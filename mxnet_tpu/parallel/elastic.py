@@ -77,14 +77,18 @@ class MultiHostFusedTrainStep(MeshFusedTrainStep):
 
     def run_window(self, sbatch):
         from ..chaos.failpoints import failpoint as _failpoint
+        from ..telemetry import trace as _trace
         rt = _mh.runtime()
         # the preemption/peer-loss injection point: kill here is the
         # host-vanishes-at-a-boundary scenario, raise is a typed probe
         # fault, wedge exercises the watchdog over a stalled boundary
         _failpoint("multihost/peer_loss")
         if rt is not None:
-            rt.check()
-            rt.window_rendezvous()
+            # the window trace's rendezvous stage (the fit loop set the
+            # ambient trace; NULL_TRACE when tracing is off)
+            with _trace.current().stage("rendezvous"):
+                rt.check()
+                rt.window_rendezvous()
         outs = super().run_window(sbatch)
         if outs is not False and rt is not None:
             # global training progress (num_update resumes across an
@@ -125,9 +129,13 @@ class ElasticSession:
 
     # called by Module.fit's elastic except-clause via on_fit_fault
     def handle_fault(self, module, exc):
+        from ..telemetry import flight as _flight
         self.fault = exc
         step = int(module._optimizer.num_update)
         rt = _mh.runtime()
+        _flight.record("elastic", "fault", severity="error",
+                       cause=type(exc).__name__, step=step,
+                       rank=getattr(rt, "rank", None))
         if rt is not None and isinstance(exc, PeerLostError):
             # leader election among ALIVE ranks: exactly one survivor
             # writes the boundary step (they all hold the replicated
@@ -166,6 +174,8 @@ class ElasticSession:
             self.manager.save_module(module, step, block=True)
             log.warning("elastic: boundary checkpoint committed at "
                         "step %d", step)
+            from ..telemetry import flight as _flight
+            _flight.record("elastic", "boundary_checkpoint", step=step)
             return step
         except Exception as e:  # noqa: BLE001 — a racing peer's commit is success
             latest = self.manager.latest()
@@ -218,7 +228,8 @@ class ElasticLauncher:
                  max_restarts=None, respawn="survivors",
                  peer_timeout_s=2.0, env_extra=None, rank_env=None,
                  gen_timeout_s=300.0, exit_deadline_s=None,
-                 sigterm_rank=None, sigterm_at_step=0):
+                 sigterm_rank=None, sigterm_at_step=0,
+                 postmortem_dir=None):
         from .. import config as _config
         from ..kvstore_server import KVServer
         if respawn not in ("survivors", "full"):
@@ -248,6 +259,20 @@ class ElasticLauncher:
             raise MXNetError("elastic control server failed to start")
         self.history = []       # per-generation {world, exits, ...}
         self.recovery_s = []    # fault-detected -> progress-advanced
+        # observability plane (ISSUE 12): the launcher IS the fleet
+        # leader — its control server holds every rank's pushed registry
+        # snapshot, so /fleet.json on this process serves the merged
+        # cross-rank view (lost ranks tagged, per-generation history)
+        from ..telemetry import fleet as _fleet
+        _fleet.set_provider(lambda: _fleet.merge_server(self.server))
+        # postmortem harvest: each generation's workers dump their
+        # flight rings (chaos-kill/typed-fatal/SIGTERM) + watchdog
+        # files into gen<N>/; after a fault the launcher folds them +
+        # the final fleet snapshot into ONE bundle file
+        self.postmortem_dir = postmortem_dir
+        self.postmortems = []   # bundle paths, in generation order
+        if postmortem_dir:
+            os.makedirs(postmortem_dir, exist_ok=True)
         # optional preemption injection: SIGTERM `sigterm_rank` of
         # generation 0 once training progress reaches sigterm_at_step
         self.sigterm_rank = sigterm_rank
@@ -262,6 +287,20 @@ class ElasticLauncher:
         repo = os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))))
         env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        # observability (ISSUE 12), assigned BEFORE env_extra/rank_env so
+        # callers can still override: fleet pushes must outpace the peer
+        # timeout or every rank reads as stale, and each generation's
+        # flight/watchdog dumps land in its postmortem harvest dir
+        # (ambient values — e.g. the test conftest's hermetic dump dir —
+        # must NOT divert them away from the harvest)
+        env["MXNET_FLEET_INTERVAL_S"] = str(
+            max(0.1, self.peer_timeout_s / 5.0))
+        if self.postmortem_dir:
+            gen_dir = os.path.join(self.postmortem_dir,
+                                   f"gen{generation}")
+            os.makedirs(gen_dir, exist_ok=True)
+            env["MXNET_FLIGHT_DIR"] = gen_dir
+            env["MXNET_WATCHDOG_DIR"] = gen_dir
         env.update(self.env_extra)
         env.update(self.rank_env.get((generation, rank),
                                      self.rank_env.get(rank, {})
@@ -282,8 +321,11 @@ class ElasticLauncher:
         return env
 
     def _spawn_generation(self, generation, world):
+        from ..telemetry import flight as _flight
         coord_port = _free_port()
-        self.server.reset_world(world)
+        self.server.reset_world(world, generation=generation)
+        _flight.record("elastic", "generation_start",
+                       generation=generation, world=world)
         procs = []
         for rank in range(world):
             argv = self.worker_argv(generation, world, rank)
@@ -355,6 +397,70 @@ class ElasticLauncher:
         return [p.poll() if p.poll() is not None else -9
                 for p in procs], time.monotonic()
 
+    def _harvest_postmortem(self, generation, world, codes):
+        """Fold a faulted generation's story into ONE bundle file:
+        every rank's dumped flight ring, every watchdog stall dump, the
+        launcher's own ring, and the final fleet snapshot (dead ranks
+        tagged ``lost`` with their last registry state).  Best-effort:
+        a failed harvest must never block the respawn."""
+        if not self.postmortem_dir:
+            return None
+        from ..telemetry import fleet as _fleet
+        from ..telemetry import flight as _flight
+        gen_dir = os.path.join(self.postmortem_dir, f"gen{generation}")
+        rings, watchdogs = {}, {}
+        try:
+            names = sorted(os.listdir(gen_dir)) \
+                if os.path.isdir(gen_dir) else []
+        except OSError:
+            names = []
+        for name in names:
+            path = os.path.join(gen_dir, name)
+            try:
+                if name.startswith("mxnet-flight-") and \
+                        name.endswith(".json"):
+                    with open(path, encoding="utf-8") as f:
+                        rings[name] = json.load(f)
+                elif name.startswith("mxnet-watchdog-") and \
+                        name.endswith(".txt"):
+                    with open(path, encoding="utf-8") as f:
+                        watchdogs[name] = f.read()[-20000:]
+            except (OSError, ValueError) as e:
+                log.warning("postmortem: unreadable %s (%s)", path, e)
+        try:
+            fleet_snap = _fleet.merge_server(self.server)
+        except Exception as e:  # noqa: BLE001 — a half-dead control plane must not block the bundle
+            fleet_snap = {"error": f"{type(e).__name__}: {e}"}
+        anomaly = _flight.first_anomaly(rings.values())
+        bundle = {
+            "generation": generation,
+            "world": world,
+            "exits": codes,
+            "time": time.time(),
+            "first_anomaly": anomaly,
+            "rings": rings,
+            "launcher_ring": _flight.events(),
+            "watchdog_dumps": watchdogs,
+            "fleet": fleet_snap,
+        }
+        path = os.path.join(self.postmortem_dir,
+                            f"postmortem-gen{generation}.json")
+        try:
+            tmp = f"{path}.tmp-{os.getpid()}"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(bundle, f, indent=1, sort_keys=True,
+                          default=str)
+            os.replace(tmp, path)
+        except OSError as e:
+            log.error("postmortem: bundle write failed: %s", e)
+            return None
+        self.postmortems.append(path)
+        log.warning("elastic: postmortem bundle for generation %d -> %s"
+                    " (%d ring(s), first anomaly: %s)", generation,
+                    path, len(rings),
+                    (anomaly or {}).get("event", "none"))
+        return path
+
     def _next_world(self, codes):
         survivors = sum(1 for c in codes if c == ELASTIC_RESTART)
         if self.respawn == "full":
@@ -415,7 +521,13 @@ class ElasticLauncher:
                 # completed planned shrink)
                 return {"ok": True, "restarts": restarts,
                         "history": self.history,
-                        "recovery_s": self.recovery_s}
+                        "recovery_s": self.recovery_s,
+                        "postmortems": self.postmortems}
+            from ..telemetry import flight as _flight
+            _flight.record("elastic", "generation_fault", severity="warn",
+                           generation=generation, world=world,
+                           exits=codes)
+            self._harvest_postmortem(generation, world, codes)
             restarts += 1
             if restarts > self.max_restarts:
                 raise MXNetError(
@@ -541,6 +653,9 @@ def _worker_main(argv):
     except (PeerLostError, PreemptionError) as e:
         code = exit_code_for(e)
         payload = {"finished": False, "fault": type(e).__name__}
+        # typed-fatal: land this rank's event ring for the launcher's
+        # postmortem bundle before taking the elastic exit
+        _telemetry.flight.auto_dump(f"typed-fatal:{type(e).__name__}")
     counts = _prof.dispatch_counts()
     snap = _telemetry.REGISTRY.snapshot()["metrics"]
     coll = snap.get("mxnet_collective_bytes_total", {}).get("values", [])
@@ -596,7 +711,8 @@ def _launch(workdir, world, n_batches, batch, K, rank_env=None,
         rank_env=rank_env or {}, env_extra=env,
         peer_timeout_s=peer_timeout_s, respawn=respawn,
         sigterm_rank=sigterm_rank, sigterm_at_step=sigterm_at_step,
-        gen_timeout_s=120.0)
+        gen_timeout_s=120.0,
+        postmortem_dir=os.path.join(workdir, "postmortem"))
     try:
         summary = launcher.run()
     finally:
@@ -620,6 +736,47 @@ def _final_params(payloads):
                      f"{ {r: p.get('finished') for r, p in payloads.items()} }")
 
 
+def _scrape_fleet_and_postmortem(launcher):
+    """The ISSUE-12 observability assertions for a faulted elastic run:
+    HTTP-scrape /fleet.json off the leader's exporter and validate the
+    lost-rank tagging, the per-generation family history, and the
+    postmortem bundle's contents.  Returns (fleet snapshot, bundle)."""
+    import urllib.request
+
+    from .. import telemetry as _telemetry_mod
+
+    port = _telemetry_mod.start_exporter(0)
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/fleet.json", timeout=10) as r:
+            fleet_view = json.loads(r.read().decode("utf-8"))
+    finally:
+        _telemetry_mod.stop_exporter()
+    ranks = fleet_view["ranks"]
+    assert "0" in ranks and "1" in ranks, sorted(ranks)
+    assert ranks["1"]["state"] == "lost", \
+        f"killed rank not tagged lost: {ranks['1']['state']}"
+    assert ranks["1"]["families"], \
+        "lost rank's last registry snapshot was dropped"
+    assert fleet_view["generations"], "no generation history"
+    for gen, gen_ranks in fleet_view["generations"].items():
+        assert gen_ranks, f"generation {gen} has no ranks"
+        for rank, v in gen_ranks.items():
+            assert v["families"], \
+                f"generation {gen} rank {rank} has no families"
+    assert launcher.postmortems, "fault generation left no postmortem"
+    with open(launcher.postmortems[0], encoding="utf-8") as f:
+        bundle = json.load(f)
+    assert len(bundle["rings"]) >= 2, \
+        f"expected every rank's flight ring: {sorted(bundle['rings'])}"
+    assert bundle["fleet"]["ranks"]["1"]["state"] == "lost", bundle["fleet"]
+    anomaly = bundle.get("first_anomaly") or {}
+    site = str((anomaly.get("fields") or {}).get("site", ""))
+    assert "multihost/peer_loss" in site, \
+        f"first anomalous event does not name the injected site: {anomaly}"
+    return fleet_view, bundle
+
+
 def _smoke():
     """CI gate (ISSUE 11): a 2-process × 4-fake-device elastic fit whose
     rank-1 host is SIGKILLed at window 3 must (a) recover — survivors
@@ -639,6 +796,15 @@ def _smoke():
             os.path.join(base, "faulted"), 2, NB, BS, K,
             rank_env={1: {"MXNET_CHAOS":
                           "multihost/peer_loss=kill:hits=3"}})
+        # observability plane (ISSUE 12): scrape the leader's
+        # /fleet.json while THIS launcher is still the provider — the
+        # killed rank must be tagged lost with its last registry
+        # snapshot (never silently dropped), every generation must
+        # carry per-rank families, and the fault generation must have
+        # left ONE postmortem bundle holding all ranks' flight rings +
+        # the final fleet snapshot, with the injected site as the
+        # first anomalous event
+        fleet_view, bundle = _scrape_fleet_and_postmortem(la)
         # run B: the planned resize — rank 1 leaves at the same boundary
         sb, pb, _lb = _launch(
             os.path.join(base, "planned"), 2, NB, BS, K,
@@ -669,7 +835,12 @@ def _smoke():
               f"survivor checkpointed, world respawned at dp/2, "
               f"recovery {rec and round(rec, 1)}s, final weights "
               f"BITWISE identical to the planned resize; "
-              f"{total}/{steps} dispatches/step <= {budget:.3f} "
+              f"{total}/{steps} dispatches/step <= {budget:.3f}; "
+              f"/fleet.json tagged the lost rank across "
+              f"{len(fleet_view['generations'])} generation(s), "
+              f"postmortem bundle has {len(bundle['rings'])} ring(s) "
+              f"with first anomaly at "
+              f"{(bundle['first_anomaly'] or {}).get('fields', {}).get('site')} "
               f"(total {wall:.0f}s)")
     finally:
         shutil.rmtree(base, ignore_errors=True)

@@ -215,6 +215,18 @@ class MultiHostRuntime:
             name="multihost-heartbeat")
         self._client.heartbeat(step=0)
         self._hb_thread.start()
+        # cross-rank telemetry aggregation (ISSUE 12): push this rank's
+        # registry snapshot to the control plane so the leader's fleet
+        # merge always has a (possibly last) snapshot to tag.  Own
+        # connection: a barrier blocking the main RPC socket for 100s
+        # must not stall telemetry.
+        self._fleet = None
+        fleet_interval = float(_config.get("MXNET_FLEET_INTERVAL_S"))
+        if fleet_interval > 0:
+            from ..telemetry.fleet import FleetReporter
+            self._fleet = FleetReporter(
+                control_host, int(control_port), self.rank, self.world,
+                fleet_interval)
 
     # -- liveness -----------------------------------------------------------
     def _heartbeat_loop(self):
@@ -269,6 +281,10 @@ class MultiHostRuntime:
             log.warning("multihost rank %d: SIGTERM — leaving at the "
                         "next window boundary", self.rank)
             self._preempted.set()
+            from ..telemetry import flight as _flight
+            _flight.record("multihost", "sigterm", severity="warn",
+                           rank=self.rank)
+            _flight.auto_dump("sigterm")
 
         signal.signal(signal.SIGTERM, _on_term)
 
@@ -276,13 +292,19 @@ class MultiHostRuntime:
     def check(self):
         """The window-boundary probe: typed errors for elastic events,
         silence otherwise."""
+        from ..telemetry import flight as _flight
         if self._preempted.is_set():
+            _flight.record("multihost", "preempted", severity="error",
+                           rank=self.rank)
             raise PreemptionError(
                 f"rank {self.rank}: preemption notice received — "
                 "leaving the mesh at this window boundary")
         if self.world > 1:
             lost = self.lost_peers()
             if lost:
+                _flight.record("multihost", "peer_lost",
+                               severity="error", rank=self.rank,
+                               lost=lost)
                 raise PeerLostError(lost)
 
     def window_rendezvous(self):
@@ -331,12 +353,20 @@ class MultiHostRuntime:
                 last_check = time.monotonic()
                 lost = self.lost_peers()
                 if lost:
+                    from ..telemetry import flight as _flight
+                    _flight.record("multihost", "peer_lost_in_flight",
+                                   severity="error", rank=self.rank,
+                                   lost=lost)
                     raise PeerLostError(
                         lost, "peer died while a mesh window was in "
                         "flight; abandoning the doomed collective")
 
     def shutdown(self):
         self._stop.set()
+        if self._fleet is not None:
+            # final push: the fleet snapshot keeps this rank's last
+            # registry state even after a clean exit
+            self._fleet.stop(final_push=True)
         try:
             self._client.close()
         except Exception:  # graftlint: disable=swallowed-error -- best-effort teardown on a possibly-dead transport

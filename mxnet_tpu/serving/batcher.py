@@ -58,6 +58,8 @@ import numpy as np
 
 from ..base import MXNetError
 from ..chaos.failpoints import failpoint as _failpoint
+from ..telemetry import flight as _flight
+from ..telemetry import trace as _trace
 from ..telemetry import watchdog as _watchdog
 from .metrics import ServingMetrics
 
@@ -183,14 +185,19 @@ class ServeFuture:
 
 
 class _Request:
-    __slots__ = ("inputs", "sig", "future", "t_enqueue", "deadline")
+    __slots__ = ("inputs", "sig", "future", "t_enqueue", "deadline",
+                 "trace")
 
-    def __init__(self, inputs, sig, deadline):
+    def __init__(self, inputs, sig, deadline, trace=None):
         self.inputs = inputs
         self.sig = sig
         self.future = ServeFuture()
         self.t_enqueue = time.perf_counter()
         self.deadline = deadline
+        # the end-to-end trace context riding this request (ISSUE 12);
+        # the shared NULL_TRACE makes every stage record a no-op when
+        # tracing is off, so the pipeline records unconditionally
+        self.trace = trace if trace is not None else _trace.NULL_TRACE
 
 
 class DynamicBatcher:
@@ -275,7 +282,7 @@ class DynamicBatcher:
             t.start()
 
     # -- intake -------------------------------------------------------------
-    def submit(self, inputs, timeout_ms=None):
+    def submit(self, inputs, timeout_ms=None, trace=None):
         """Enqueue one request; returns its ``ServeFuture``.
 
         Raises ``ServingOverloadError`` (shed) / ``ServingClosedError``
@@ -302,7 +309,7 @@ class DynamicBatcher:
                       else float(timeout_ms))
         deadline = (time.perf_counter() + timeout_ms / 1e3
                     if timeout_ms > 0 else None)
-        req = _Request(inputs, sig, deadline)
+        req = _Request(inputs, sig, deadline, trace)
         _failpoint("serving/batcher/submit")
         with self._cond:
             if self._failed:
@@ -316,6 +323,10 @@ class DynamicBatcher:
             depth = len(self._queue) + self._staged_n
             if depth >= self.shed_watermark:
                 self.metrics.incr("shed_total")
+                req.trace.event("shed", replica=self.name, depth=depth)
+                _flight.record("serving", "shed", severity="warn",
+                               batcher=self.name, depth=depth,
+                               watermark=self.shed_watermark)
                 raise ServingOverloadError(self.name, depth,
                                            self.shed_watermark)
             self._queue.append(req)
@@ -415,9 +426,14 @@ class DynamicBatcher:
                     timeout = (req.deadline - req.t_enqueue) * 1e3
                     req.future._set_exception(RequestTimeoutError(
                         self.name, waited, timeout))
+                    req.trace.event("timeout_swept", replica=self.name,
+                                    waited_ms=round(waited, 3))
+                    req.trace.finish(status="timeout")
                     timeouts += 1
         if timeouts:
             self.metrics.incr("timeouts_total", timeouts)
+            _flight.record("serving", "wedged_sweep", severity="warn",
+                           batcher=self.name, timeouts=timeouts)
 
     def _stage_feed(self, batch):
         """Stack one same-signature cohort into the runner feed — the
@@ -438,6 +454,13 @@ class DynamicBatcher:
                 if not batch:
                     self._put_staged(None)
                     return  # closed and drained (or failed fast)
+                # trace: the queue wait ends the moment this stage
+                # thread claimed the cohort (recorded by the claimer —
+                # the waiting thread could not have closed the span)
+                t_claim = time.perf_counter()
+                for req in batch:
+                    req.trace.add_stage("queue_wait", req.t_enqueue,
+                                        t_claim)
                 try:
                     feed = self._stage_feed(batch)
                 except Exception as e:  # noqa: BLE001 — fails this batch alone
@@ -450,7 +473,10 @@ class DynamicBatcher:
                             req.future._set_exception(exc)
                     self.metrics.incr("errors_total", len(batch))
                     continue
-                if not self._put_staged((token, batch, feed)):
+                t_staged = time.perf_counter()
+                for req in batch:
+                    req.trace.add_stage("stage", t_claim, t_staged)
+                if not self._put_staged((token, batch, feed, t_staged)):
                     # batcher failed fast while we held a staged batch
                     self._unclaim_staged(token, batch)
                     err = ServingWorkerError(self.name, exhausted=True)
@@ -492,13 +518,16 @@ class DynamicBatcher:
                 item = self._get_staged()
                 if item is None:
                     return  # stage sentinel (drained) or failed fast
-                token, batch, feed = item
+                token, batch, feed, t_staged = item
                 with self._cond:
                     # claim moves staged -> executing atomically: the
                     # batch stays sweepable throughout
                     self._inflight[threading.get_ident()] = batch
                     if self._inflight.pop(token, None) is not None:
                         self._staged_n -= len(batch)
+                t_picked = time.perf_counter()
+                for req in batch:
+                    req.trace.add_stage("staged_wait", t_staged, t_picked)
                 try:
                     with _watchdog.arm(f"serving/{self.name}"):
                         # the chaos hook sits INSIDE the watchdog arm: a
@@ -544,7 +573,14 @@ class DynamicBatcher:
                 "serving[%s]: worker died (%s: %s); restarting in place "
                 "(%d/%d restarts used)", self.name, type(exc).__name__,
                 exc, restarts, self._restart_budget)
+            _flight.record("serving", "worker_restart", severity="warn",
+                           batcher=self.name, cause=type(exc).__name__,
+                           restarts=restarts,
+                           budget=self._restart_budget)
             return True
+        _flight.record("serving", "worker_fail_fast", severity="error",
+                       batcher=self.name, cause=type(exc).__name__,
+                       restarts=restarts, doomed=len(doomed))
         log.error(
             "serving[%s]: worker restart budget (%d) exhausted — failing "
             "%d queued request(s) and rejecting new submits", self.name,
@@ -571,7 +607,7 @@ class DynamicBatcher:
                 return out
             if item is None:
                 continue
-            token, batch, _feed = item
+            token, batch, _feed, _t = item
             self._unclaim_staged(token, batch)
             out.extend(batch)
 
@@ -595,6 +631,9 @@ class DynamicBatcher:
                 timeout = (req.deadline - req.t_enqueue) * 1e3
                 req.future._set_exception(RequestTimeoutError(
                     self.name, waited, timeout))
+                req.trace.event("timeout", replica=self.name,
+                                waited_ms=round(waited, 3))
+                req.trace.finish(status="timeout")
                 self.metrics.incr("timeouts_total")
                 dropped = True
             else:
@@ -604,6 +643,7 @@ class DynamicBatcher:
         try:
             if dropped:
                 feed = self._stage_feed(live)
+            t_run = time.perf_counter()
             outputs = self._runner(feed, len(live))
         except Exception as e:  # noqa: BLE001 — fanned out per req
             exc = e if isinstance(e, MXNetError) else MXNetError(
@@ -611,11 +651,19 @@ class DynamicBatcher:
                 f"{type(e).__name__}: {e}")
             for req in live:
                 req.future._set_exception(exc)
+                req.trace.event("error", error=type(e).__name__)
+                req.trace.finish(status="error")
             self.metrics.incr("errors_total", len(live))
             return
         done = time.perf_counter()
         for i, req in enumerate(live):
             req.future._set_result([out[i] for out in outputs])
+            if req.trace is not _trace.NULL_TRACE:
+                # resolve ends at THIS request's future resolution;
+                # the whole cohort shares one dispatch interval
+                req.trace.add_stage("dispatch", t_run, done)
+                req.trace.add_stage("resolve", done, time.perf_counter())
+                req.trace.finish()
             self.metrics.observe_latency((done - req.t_enqueue) * 1e3)
         _watchdog.beat(f"serving/{self.name}")
         self.metrics.incr("responses_total", len(live))

@@ -297,6 +297,9 @@ class ModelRepository:
             "checkpoint steps that failed verification during serving "
             "hot-reload polls (quarantined; the old version kept "
             "serving)").inc(labels={"model": str(name)})
+        _telemetry.flight.record("serving", "ckpt_quarantined",
+                                 severity="error", model=str(name),
+                                 step=step)
         logging.getLogger("mxnet_tpu.serving").error(
             "watch(%r): checkpoint step %d in %r failed verification "
             "(%s) — step quarantined, serving continues on the current "

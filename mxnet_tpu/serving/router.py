@@ -44,6 +44,8 @@ import time
 from ..base import MXNetError
 from ..chaos.failpoints import ChaosInjectedError
 from ..chaos.failpoints import failpoint as _failpoint
+from ..telemetry import flight as _flight
+from ..telemetry import trace as _trace
 from .batcher import (DynamicBatcher, ServingClosedError,
                       ServingOverloadError, ServingWorkerError)
 from .metrics import ServingMetrics
@@ -244,6 +246,8 @@ class ReplicaPool:
             rid = self._next_rid
             self._next_rid += 1
             self._replicas[rid] = self._make_replica(rid)
+        _flight.record("serving", "replica_added", model=self.model,
+                       replica=rid)
         return rid
 
     def remove_replica(self, rid, drain=True, timeout=30.0):
@@ -260,6 +264,8 @@ class ReplicaPool:
         b.close(drain=drain, timeout=timeout)
         _occupancy_gauge().set(0, labels={"model": self.model,
                                           "replica": str(rid)})
+        _flight.record("serving", "replica_removed", model=self.model,
+                       replica=rid, drained=bool(drain))
         return b
 
     def resize(self, num_replicas, drain=True):
@@ -308,11 +314,17 @@ class ReplicaPool:
         ranked.sort(key=lambda t: (t[0], t[1]))
         return ranked
 
-    def submit(self, inputs, timeout_ms=None):
+    def submit(self, inputs, timeout_ms=None, trace=None):
         """Route one request: SLO admission, then least-predicted-drain
         replica, spilling to siblings on shed/drain/failure.  Raises
         ``ServingOverloadError`` (typed, synchronous) when admission
-        predicts an SLO breach or every replica sheds."""
+        predicts an SLO breach or every replica sheds.
+
+        ``trace`` (an end-to-end trace context) survives spill hops:
+        the SAME context rides the resubmission to each sibling, so a
+        request that sheds, spills and resolves elsewhere is still ONE
+        trace with its hops recorded as events."""
+        tr = trace if trace is not None else _trace.NULL_TRACE
         ranked = self._ranked_replicas()
         if not ranked:
             self.metrics.incr("rejected_total")
@@ -320,11 +332,20 @@ class ReplicaPool:
         total_occ = sum(occ for occ, _rid, _b in ranked)
         self.admission.observe(self.responses(), total_occ)
         try:
-            self.admission.check(total_occ)
-        except ServingOverloadError:
+            predicted = self.admission.check(total_occ)
+        except ServingOverloadError as e:
             self.metrics.incr("shed_total")
             self.metrics.incr("slo_shed_total")
+            tr.event("admission", verdict="shed",
+                     predicted_p99_ms=e.predicted_p99_ms,
+                     slo_ms=e.slo_ms)
+            tr.finish(status="shed")
+            _flight.record("serving", "slo_shed", severity="warn",
+                           model=self.model, occupancy=total_occ,
+                           predicted_p99_ms=e.predicted_p99_ms)
             raise
+        tr.event("admission", verdict="admit", occupancy=total_occ,
+                 predicted_p99_ms=predicted)
         last_exc = None
         for hop, (_occ, rid, b) in enumerate(ranked):
             if b.failed:
@@ -332,7 +353,8 @@ class ReplicaPool:
                 continue
             try:
                 _failpoint("serving/router/dispatch")
-                fut = b.submit(inputs, timeout_ms=timeout_ms)
+                tr.event("route", replica=rid, hop=hop)
+                fut = b.submit(inputs, timeout_ms=timeout_ms, trace=tr)
             except (ServingOverloadError, ServingClosedError,
                     ServingWorkerError, ChaosInjectedError) as e:
                 # shed / draining / failed-fast / injected dispatch
@@ -340,12 +362,19 @@ class ReplicaPool:
                 # other error (validator rejection, malformed inputs)
                 # is about THIS request and propagates — a bad request
                 # fails alone, it is never spilled K times
+                tr.event("spill", replica=rid, hop=hop,
+                         cause=type(e).__name__)
                 last_exc = e
                 continue
             if hop > 0:
                 self.metrics.incr("spill_total", hop)
                 _spill_counter().inc(hop, labels={"model": self.model})
+                _flight.record("serving", "spill", severity="warn",
+                               model=self.model, hops=hop, replica=rid)
             return fut
+        tr.event("refused", hops=len(ranked),
+                 cause=type(last_exc).__name__)
+        tr.finish(status="refused")
         raise last_exc  # every replica refused (all typed errors)
 
     # -- observability / lifecycle -------------------------------------------

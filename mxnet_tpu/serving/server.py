@@ -33,6 +33,8 @@ import numpy as np
 from .. import compile as _compile
 from ..base import MXNetError
 from ..context import current_context
+from ..telemetry import flight as _flight
+from ..telemetry import trace as _trace
 from .executor_cache import (ExecutorCache, bind_inference_executor,
                              bucket_batch, feed_signature, pad_to)
 from .metrics import ServingMetrics
@@ -189,6 +191,8 @@ class ModelServer:
             pool = self._pools.get(model)
         if pool is not None:
             pool.admission.reset()
+        _flight.record("serving", "version_flip", model=model,
+                       version=mv.version, prev=prev_latest)
 
     def warm(self, model, version=None, sample_signature=None,
              ladder=None):
@@ -239,10 +243,25 @@ class ModelServer:
     # -- request API --------------------------------------------------------
     def predict_async(self, model, inputs, timeout_ms=None):
         """Submit one request (single sample, batch dim added by the
-        batcher); returns a ServeFuture of the output list."""
-        self.repository.get(model)  # unknown-model errors surface here
-        return self._get_pool(model).submit(dict(inputs),
-                                            timeout_ms=timeout_ms)
+        batcher); returns a ServeFuture of the output list.
+
+        With ``MXNET_TRACE`` on, a trace context is minted HERE and
+        rides the request end to end — submit stage, admission verdict,
+        route choice, spill hops, queue/stage/dispatch/resolve spans —
+        one trace per request regardless of how many replicas it
+        visited (docs/observability.md trace taxonomy)."""
+        tr = _trace.start("serving", model)
+        try:
+            with tr.stage("submit"):
+                self.repository.get(model)  # unknown-model errors here
+                return self._get_pool(model).submit(
+                    dict(inputs), timeout_ms=timeout_ms, trace=tr)
+        except BaseException as e:
+            # refused synchronously (shed / closed / invalid): the
+            # trace still finishes, typed — sheds are traceable too
+            tr.event("rejected", error=type(e).__name__)
+            tr.finish(status="rejected")
+            raise
 
     def predict(self, model, inputs, timeout_ms=None, wait_s=60.0):
         """Blocking convenience over predict_async."""

@@ -32,6 +32,9 @@ import weakref
 from . import registry as _registry_mod
 from . import spans as _spans
 from . import steps as _steps
+from . import fleet
+from . import flight
+from . import trace
 from . import watchdog
 from .exporter import exporter_port, start_exporter, stop_exporter
 from .registry import MetricsRegistry, exponential_buckets
@@ -57,6 +60,13 @@ _steps._lane_hist = REGISTRY.histogram(
     "per-train-step time attributed to each breakdown lane")
 _steps._step_hist = REGISTRY.histogram(
     "mxnet_train_step_seconds", "train step wall time (fit loop)")
+trace._stage_hist = REGISTRY.histogram(
+    "mxnet_trace_stage_seconds",
+    "per-trace stage durations (end-to-end request/window tracing), "
+    "by trace kind and stage name")
+trace._e2e_hist = REGISTRY.histogram(
+    "mxnet_trace_e2e_seconds",
+    "end-to-end latency of finished traces, by trace kind")
 
 _KV_BYTES = REGISTRY.counter(
     "mxnet_kvstore_bytes_total",
@@ -234,6 +244,14 @@ REGISTRY.register_collector(
     "watchdog",
     lambda: {"fires": watchdog.fires(), "last_dump": watchdog.last_dump()},
     _watchdog_samples)
+REGISTRY.register_collector("trace", trace.exemplars)
+REGISTRY.register_collector("fleet", fleet._collector_snapshot,
+                            fleet._collector_samples)
+REGISTRY.register_collector(
+    "flight",
+    lambda: {"enabled": flight.enabled(),
+             "ring_events": len(flight.events()),
+             "dumps": flight.dump_count()})
 
 
 def snapshot():
@@ -252,6 +270,9 @@ def _autostart():
     from .. import config as _config
     if _config.get("MXNET_TELEMETRY"):
         enable()
+    if _config.get("MXNET_TRACE"):
+        trace.enable()
+    flight.configure()
     port = int(_config.get("MXNET_TELEMETRY_PORT"))
     if port > 0:
         start_exporter(port)

@@ -5,6 +5,10 @@ ephemeral one — used by tests/smoke) on a daemon thread and serves:
 
 * ``GET /metrics``       — ``telemetry.prometheus_dump()`` (text 0.0.4)
 * ``GET /snapshot.json`` — the full ``telemetry.snapshot()`` as JSON
+* ``GET /fleet.json``    — the cross-rank fleet snapshot (the leader's
+  merged per-rank registry view with liveness tags; a single-rank local
+  view on processes without a fleet provider — see telemetry/fleet.py,
+  ISSUE 12)
 * ``GET /healthz``       — liveness an orchestrator can act on: 200
   ``ok`` normally; **503** naming the stalled section while a watchdog
   stall episode is active (an armed section fired and has not
@@ -43,12 +47,18 @@ class _Handler(BaseHTTPRequestHandler):
             body = json.dumps(snapshot(), default=str,
                               sort_keys=True).encode("utf-8")
             ctype = "application/json"
+        elif path in ("/fleet.json", "/fleet"):
+            from . import fleet
+            body = json.dumps(fleet.fleet_json(), default=str,
+                              sort_keys=True).encode("utf-8")
+            ctype = "application/json"
         elif path == "/healthz":
             body, ctype, status = _health()
             self._reply(status, body, ctype)
             return
         else:
-            self.send_error(404, "try /metrics, /snapshot.json, /healthz")
+            self.send_error(404, "try /metrics, /snapshot.json, "
+                                 "/fleet.json, /healthz")
             return
         self._reply(200, body, ctype)
 

@@ -1,0 +1,282 @@
+"""Cross-rank telemetry aggregation: one fleet snapshot for N processes
+(ISSUE 12 tentpole).
+
+PR 11 made training multi-process, but each rank still kept its own
+PR-5 registry — an operator (or the ROADMAP item-4 autoscaler) had to
+scrape N exporters and join them by hand, and a dead rank's metrics
+simply vanished.  This module closes that gap over the transport that
+already exists:
+
+* **rank side** — :class:`FleetReporter` (armed by the multi-host
+  runtime when ``MXNET_FLEET_INTERVAL_S`` > 0) pushes the registry's
+  flattened sample families (:meth:`MetricsRegistry.sample_families`)
+  to the control-plane kvstore server on its OWN connection (a barrier
+  blocking the main RPC socket must not stall telemetry), every
+  interval and once more at shutdown/fault;
+* **server side** — the :class:`~mxnet_tpu.kvstore_server.KVServer`
+  stores the latest payload per ``(generation, rank)``;
+* **leader side** — :func:`merge_server` joins payloads with the
+  server's liveness layer into ONE fleet snapshot: per-rank families
+  with ``state`` / ``age_s`` / staleness marks.  A dead rank keeps its
+  last snapshot tagged ``state="lost"`` — never silently dropped — and
+  every generation's history is retained, so "what was rank 1 doing
+  when it died" reads off ``/fleet.json``.
+
+Serving surfaces: the exporter's ``GET /fleet.json`` renders
+:func:`fleet_json` (the registered provider on the leader, a local
+single-rank view elsewhere), and the ``fleet`` telemetry collector
+re-emits every rank's counter/gauge samples into the Prometheus dump
+with a ``rank`` label plus ``mxnet_fleet_peers{state}`` /
+``mxnet_fleet_snapshot_age_seconds{rank}`` summary families — the data
+plane the ROADMAP item-4 autoscaler consumes.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+log = logging.getLogger("mxnet_tpu.telemetry.fleet")
+
+_provider_lock = threading.Lock()
+_provider = None   # zero-arg callable -> fleet snapshot dict (the leader)
+
+
+def _registry():
+    from . import REGISTRY
+    return REGISTRY
+
+
+def local_payload():
+    """This rank's pushable snapshot: flattened sample families plus a
+    wall-clock stamp (all leaves JSON-native)."""
+    return {"time": time.time(),
+            "families": _registry().sample_families()}
+
+
+# -- rank side ----------------------------------------------------------------
+class FleetReporter:
+    """Daemon thread pushing this rank's registry snapshot to the
+    control-plane server every ``interval_s``; ``push_now()`` forces a
+    final push on the fault/shutdown paths."""
+
+    def __init__(self, host, port, rank, world, interval_s, timeout=10.0):
+        self.rank = int(rank)
+        self.interval_s = float(interval_s)
+        self._stop = threading.Event()
+        self._client = None
+        self._host, self._port = host, int(port)
+        self._world = int(world)
+        self._timeout = float(timeout)
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="mx-fleet-reporter")
+        self._thread.start()
+
+    def _ensure_client(self):
+        if self._client is None:
+            from ..kvstore_server import KVClient
+            self._client = KVClient(
+                self._host, self._port, rank=self.rank,
+                num_workers=self._world, timeout=self._timeout,
+                heartbeat_interval=0)
+        return self._client
+
+    def _loop(self):
+        # first push immediately: a rank killed early must still appear
+        # in the fleet snapshot (lost, not vanished)
+        while True:
+            try:
+                self.push_now()
+            except Exception as e:  # noqa: BLE001 — telemetry push failures age the snapshot; they must not kill the reporter
+                log.debug("fleet reporter push failed: %s", e)
+                if self._stop.is_set():
+                    return
+            if self._stop.wait(self.interval_s):
+                return
+
+    def push_now(self):
+        """One synchronous push (used by the loop and the fault path)."""
+        client = self._ensure_client()
+        client.push_telemetry(local_payload())
+
+    def stop(self, final_push=True):
+        self._stop.set()
+        if final_push:
+            try:
+                self.push_now()
+            except Exception as e:  # noqa: BLE001 — best-effort final sample on a possibly-dead transport
+                log.debug("fleet reporter final push failed: %s", e)
+        if self._client is not None:
+            try:
+                self._client.close()
+            except Exception:  # graftlint: disable=swallowed-error -- best-effort teardown on a possibly-dead transport
+                pass
+
+
+# -- leader side --------------------------------------------------------------
+def merge_server(server):
+    """Join a control-plane :class:`KVServer`'s stored telemetry
+    payloads with its liveness layer into the fleet snapshot.
+
+    State per rank (current generation):
+
+    * ``alive`` — heartbeating within the peer timeout, snapshot fresh;
+    * ``stale`` — alive but its last telemetry push is older than the
+      peer timeout (the reporter wedged or was never armed);
+    * ``lost``  — marked dead by the server (or silent past the
+      timeout); its LAST pushed snapshot is retained and tagged;
+    * ``unknown`` — never heartbeated this generation.
+
+    Ranks from previous generations (a shrunk world) stay in the
+    ``generations`` history tagged ``lost`` — a fleet consumer can see
+    every generation's per-rank families, never a silent drop.
+    """
+    now_mono = time.monotonic()
+    peer_timeout = server._peer_timeout()
+    states = server._peer_states()
+    with server._lock:
+        generation = getattr(server, "_generation", 0)
+        num_workers = server.num_workers
+        stored = {gen: dict(ranks)
+                  for gen, ranks in server._telemetry.items()}
+    cur = stored.get(generation, {})
+    ranks = {}
+    for rank in range(num_workers):
+        info = states.get(rank, {"state": "unknown", "age_s": None,
+                                 "step": 0})
+        entry = cur.get(rank)
+        snap_age = (None if entry is None
+                    else max(0.0, now_mono - entry["mono"]))
+        state = info["state"]
+        if state == "alive" and (snap_age is None
+                                 or snap_age > peer_timeout):
+            state = "stale"
+        ranks[str(rank)] = {
+            "state": state,
+            "age_s": info.get("age_s"),
+            "step": info.get("step", 0),
+            "snapshot_age_s": snap_age,
+            "generation": generation,
+            "families": entry["payload"].get("families", {})
+            if entry else {},
+        }
+    generations = {}
+    for gen in sorted(stored):
+        gen_ranks = {}
+        for rank, entry in sorted(stored[gen].items()):
+            if gen == generation:
+                state = ranks[str(rank)]["state"]
+            else:
+                state = "lost"  # a rank of a dead generation
+                # lost ranks keep their last snapshot in the CURRENT
+                # view too when the world shrank past them
+                if str(rank) not in ranks:
+                    ranks[str(rank)] = {
+                        "state": "lost", "age_s": None, "step": None,
+                        "snapshot_age_s": max(
+                            0.0, now_mono - entry["mono"]),
+                        "generation": gen,
+                        "families": entry["payload"].get("families", {}),
+                    }
+            gen_ranks[str(rank)] = {
+                "state": state,
+                "time": entry["payload"].get("time"),
+                "families": entry["payload"].get("families", {}),
+            }
+        generations[str(gen)] = gen_ranks
+    return {"time": time.time(), "generation": generation,
+            "world": num_workers, "ranks": ranks,
+            "generations": generations}
+
+
+def set_provider(fn):
+    """Install the fleet-snapshot provider (the elastic launcher wires
+    ``lambda: merge_server(server)``); None uninstalls."""
+    global _provider
+    with _provider_lock:
+        _provider = fn
+
+
+def provider():
+    with _provider_lock:
+        return _provider
+
+
+def fleet_json():
+    """The ``/fleet.json`` payload: the provider's merged snapshot on
+    the leader, a single-rank local view everywhere else (so the
+    endpoint is meaningful on any process)."""
+    fn = provider()
+    if fn is not None:
+        return fn()
+    import os
+    rank = os.environ.get("MXNET_MULTIHOST_PROC_ID", "0")
+    return {"time": time.time(), "generation": None, "world": 1,
+            "ranks": {str(rank): {"state": "alive", "age_s": 0.0,
+                                  "snapshot_age_s": 0.0,
+                                  "generation": None,
+                                  "families":
+                                      local_payload()["families"]}},
+            "generations": {}}
+
+
+# -- telemetry collector hooks ------------------------------------------------
+def _collector_snapshot():
+    """The ``fleet`` key of ``telemetry.snapshot()``: summary only (the
+    full per-rank families live at /fleet.json; the snapshot stays
+    readable)."""
+    fn = provider()
+    if fn is None:
+        return {}
+    snap = fn()
+    return {"generation": snap.get("generation"),
+            "world": snap.get("world"),
+            "ranks": {r: {"state": v.get("state"),
+                          "age_s": v.get("age_s"),
+                          "snapshot_age_s": v.get("snapshot_age_s"),
+                          "families": len(v.get("families", {}))}
+                      for r, v in snap.get("ranks", {}).items()}}
+
+
+def _collector_samples():
+    """Prometheus surface: every rank's counter/gauge samples re-emitted
+    with a ``rank`` label, plus fleet summary families.  Histogram
+    sample families (``_bucket``/``_sum``/``_count``) re-emit as
+    counters — le labels survive the merge."""
+    fn = provider()
+    if fn is None:
+        return []
+    snap = fn()
+    out = []
+    state_counts = {}
+    for rank, v in sorted(snap.get("ranks", {}).items()):
+        state = v.get("state", "unknown")
+        state_counts[state] = state_counts.get(state, 0) + 1
+        out.append(("mxnet_fleet_rank_state", "gauge",
+                    "per-rank liveness in the fleet snapshot (1 = the "
+                    "labelled state holds)",
+                    {"rank": rank, "state": state}, 1))
+        if v.get("snapshot_age_s") is not None:
+            out.append(("mxnet_fleet_snapshot_age_seconds", "gauge",
+                        "age of each rank's last pushed registry "
+                        "snapshot", {"rank": rank},
+                        v["snapshot_age_s"]))
+        for family, fam in sorted(v.get("families", {}).items()):
+            mtype = fam.get("type", "gauge")
+            if mtype == "histogram":
+                mtype = "counter"  # flattened _bucket/_sum/_count rows
+            for sample in fam.get("values", []):
+                value = sample.get("value")
+                if not isinstance(value, (int, float)) or \
+                        isinstance(value, bool):
+                    continue
+                labels = dict(sample.get("labels", {}))
+                labels["rank"] = rank
+                out.append((family, mtype,
+                            f"fleet-merged {family} (rank-labelled)",
+                            labels, value))
+    for state in ("alive", "stale", "lost", "unknown"):
+        out.append(("mxnet_fleet_peers", "gauge",
+                    "fleet ranks by merged liveness state",
+                    {"state": state}, state_counts.get(state, 0)))
+    return out

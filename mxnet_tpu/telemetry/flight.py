@@ -1,0 +1,242 @@
+"""Crash flight recorder: a bounded ring of structured decision events,
+dumped atomically when the process dies messily (ISSUE 12 tentpole).
+
+A dead generation used to leave scattered stderr and watchdog files;
+"why did generation 0 die" was archaeology.  The flight recorder turns
+every subsystem's *decision points* into ring entries — state
+transitions, sheds, spills, chaos injections, worker restarts,
+rendezvous outcomes, peer-loss marks, checkpoint commits — each a
+``{seq, t, mono, thread, category, event, severity, fields}`` record
+appended under one cheap lock into a bounded deque
+(``MXNET_FLIGHT_RING`` events, oldest evicted).
+
+The ring is dumped atomically (tmp + ``os.replace``) as
+``mxnet-flight-<pid>-<n>.json`` into ``MXNET_FLIGHT_DIR`` (or
+``MXNET_WATCHDOG_DIR``, or cwd) on:
+
+* a **watchdog fire** (the stall dump and the event history land
+  together);
+* a **typed-fatal elastic fault** (PeerLostError / PreemptionError —
+  the worker dumps before taking its restart/leave exit);
+* **SIGTERM** (the multi-host preemption notice);
+* a **chaos ``kill``** arm — the ring is flushed *before* the SIGKILL
+  lands, so even a vanished host leaves its story behind.
+
+The :class:`~mxnet_tpu.parallel.elastic.ElasticLauncher` points each
+worker generation's ``MXNET_FLIGHT_DIR`` at a harvest directory and,
+after a fault, folds all ranks' rings + watchdog dumps + the final
+fleet snapshot into ONE postmortem bundle (docs/observability.md
+runbook).
+
+``MXNET_FLIGHT=0`` reduces :func:`record` to a single module-global
+check (< 1 µs, the chaos-failpoint bar), so the hooks stay wired into
+hot paths unconditionally.  Dump files obey the shared
+``MXNET_WATCHDOG_KEEP`` retention (newest N kept).
+"""
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import os
+import threading
+import time
+
+log = logging.getLogger("mxnet_tpu.telemetry.flight")
+
+# module-global fast gate: the ONLY thing a disabled record() touches
+_armed = True
+
+_lock = threading.Lock()
+_ring = collections.deque(maxlen=1024)
+_seq = 0
+_dumps = 0
+
+SEVERITIES = ("info", "warn", "error")
+
+
+def configure(enabled=None, ring=None):
+    """(Re)configure from the env knobs — called at telemetry import;
+    tests flip :func:`enable` / :func:`disable` directly."""
+    global _armed, _ring
+    from .. import config as _config
+    if enabled is None:
+        enabled = bool(_config.get("MXNET_FLIGHT"))
+    if ring is None:
+        ring = int(_config.get("MXNET_FLIGHT_RING"))
+    with _lock:
+        if ring != _ring.maxlen:
+            _ring = collections.deque(_ring, maxlen=max(16, ring))
+    _armed = bool(enabled)
+
+
+def enable():
+    global _armed
+    _armed = True
+
+
+def disable():
+    global _armed
+    _armed = False
+
+
+def enabled():
+    return _armed
+
+
+def _native(v):
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    if isinstance(v, (list, tuple)):
+        return [_native(x) for x in v]
+    item = getattr(v, "item", None)
+    if callable(item) and getattr(v, "ndim", 1) == 0:
+        try:
+            return item()
+        except Exception:  # graftlint: disable=swallowed-error -- best-effort coercion; the str fallback below always works
+            pass
+    return str(v)
+
+
+def record(category, event, severity="info", **fields):
+    """Append one decision event to the ring (no-op when disabled).
+
+    ``severity``: ``info`` for normal transitions, ``warn``/``error``
+    for anomalies — the postmortem reader's "first anomalous event" is
+    the first non-info entry across all ranks' merged rings."""
+    if not _armed:
+        return
+    global _seq
+    entry = {
+        "t": time.time(),
+        "mono": time.monotonic(),
+        "thread": threading.current_thread().name,
+        "category": str(category),
+        "event": str(event),
+        "severity": severity if severity in SEVERITIES else "info",
+        "fields": {k: _native(v) for k, v in fields.items()},
+    }
+    with _lock:
+        _seq += 1
+        entry["seq"] = _seq
+        _ring.append(entry)
+
+
+def events():
+    """The ring's current contents, oldest first."""
+    with _lock:
+        return list(_ring)
+
+
+def clear():
+    global _seq
+    with _lock:
+        _ring.clear()
+        _seq = 0
+
+
+def dump_count():
+    with _lock:
+        return _dumps
+
+
+# -- dumping ------------------------------------------------------------------
+def _keep():
+    from .. import config as _config
+    return int(_config.get("MXNET_WATCHDOG_KEEP"))
+
+
+def dump_dir():
+    from .. import config as _config
+    return (_config.get("MXNET_FLIGHT_DIR")
+            or _config.get("MXNET_WATCHDOG_DIR") or os.getcwd())
+
+
+def prune(directory, prefix, keep=None):
+    """Shared dump retention (MXNET_WATCHDOG_KEEP): keep the newest
+    ``keep`` files matching ``prefix*`` in ``directory``, remove the
+    rest.  Best-effort — retention must never fail the dump."""
+    keep = _keep() if keep is None else int(keep)
+    if keep <= 0:
+        return []
+    try:
+        names = [n for n in os.listdir(directory) if n.startswith(prefix)
+                 and not n.endswith(".tmp")]
+    except OSError:
+        return []
+    paths = []
+    for n in names:
+        p = os.path.join(directory, n)
+        try:
+            paths.append((os.path.getmtime(p), p))
+        except OSError:
+            continue
+    paths.sort(reverse=True)
+    removed = []
+    for _mt, p in paths[keep:]:
+        try:
+            os.remove(p)
+            removed.append(p)
+        except OSError as e:
+            log.debug("flight: retention could not remove %s: %s", p, e)
+    return removed
+
+
+def dump(path=None, reason=""):
+    """Write the ring atomically as JSON; returns the path.  The
+    payload carries enough identity (pid, rank, generation env) for the
+    launcher's postmortem merge."""
+    global _dumps
+    with _lock:
+        _dumps += 1
+        n = _dumps
+        ring = list(_ring)
+    if path is None:
+        directory = dump_dir()
+        path = os.path.join(directory,
+                            f"mxnet-flight-{os.getpid()}-{n}.json")
+    else:
+        directory = os.path.dirname(os.path.abspath(path))
+    payload = {
+        "pid": os.getpid(),
+        "rank": os.environ.get("MXNET_MULTIHOST_PROC_ID"),
+        "world": os.environ.get("MXNET_MULTIHOST_NUM_PROCS"),
+        "reason": str(reason),
+        "time": time.time(),
+        "events": ring,
+    }
+    tmp = f"{path}.tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    prune(directory, "mxnet-flight-")
+    return path
+
+
+def auto_dump(reason):
+    """Best-effort dump for the fatal paths (watchdog fire, typed-fatal
+    error, SIGTERM, chaos kill) — logging, never raising: the dump must
+    not mask the event that triggered it."""
+    if not _armed:
+        return None
+    try:
+        path = dump(reason=reason)
+        log.error("flight recorder dumped (%s) -> %s", reason, path)
+        return path
+    except Exception as e:  # noqa: BLE001 — the triggering fault outranks the dump
+        log.error("flight recorder dump failed (%s): %s", reason, e)
+        return None
+
+
+def first_anomaly(rings):
+    """Across one or more dumped rings (each a payload dict or raw
+    event list), the earliest non-info event by wall time — the
+    postmortem reader's "start here" pointer."""
+    merged = []
+    for ring in rings:
+        evs = ring.get("events", []) if isinstance(ring, dict) else ring
+        merged.extend(e for e in evs if e.get("severity") != "info")
+    merged.sort(key=lambda e: e.get("t", 0.0))
+    return merged[0] if merged else None

@@ -44,6 +44,35 @@ def _label_key(labels):
     return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
 
 
+def to_native(obj):
+    """Coerce numpy scalars/arrays (and other foreign leaves) to plain
+    Python types, recursively.  Applied at the REGISTRY boundary — every
+    collector snapshot and sample value passes through here — so
+    ``json.dumps(telemetry.snapshot())`` round-trips without a custom
+    encoder and the exporter's ``/snapshot.json`` never emits the
+    ``repr`` of a numpy scalar (ISSUE 12 satellite)."""
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if isinstance(obj, dict):
+        return {str(k) if not isinstance(k, str) else k: to_native(v)
+                for k, v in obj.items()}
+    if isinstance(obj, (list, tuple, set, frozenset)):
+        return [to_native(v) for v in obj]
+    item = getattr(obj, "item", None)
+    if callable(item) and getattr(obj, "ndim", 0) == 0:
+        try:
+            return to_native(item())
+        except Exception:  # graftlint: disable=swallowed-error -- best-effort coercion; the str fallback below always serializes
+            pass
+    tolist = getattr(obj, "tolist", None)
+    if callable(tolist):
+        try:
+            return to_native(tolist())
+        except Exception:  # graftlint: disable=swallowed-error -- best-effort coercion; the str fallback below always serializes
+            pass
+    return str(obj)
+
+
 def _escape_label_value(value):
     return (value.replace("\\", "\\\\").replace("\n", "\\n")
             .replace('"', '\\"'))
@@ -98,6 +127,8 @@ class Counter(_Metric):
     kind = "counter"
 
     def inc(self, n=1, labels=None):
+        if not isinstance(n, (int, float)):
+            n = to_native(n)  # numpy scalars stay out of the cells
         if n < 0:
             raise ValueError(f"counter {self.name}: negative increment {n}")
         with self._lock:
@@ -134,7 +165,7 @@ class Gauge(_Metric):
     def inc(self, n=1, labels=None):
         with self._lock:
             key = _label_key(labels)
-            self._cells[key] = self._cells.get(key, 0.0) + n
+            self._cells[key] = self._cells.get(key, 0.0) + float(n)
 
     def dec(self, n=1, labels=None):
         self.inc(-n, labels)
@@ -289,7 +320,9 @@ class MetricsRegistry:
         out = {}
         for name, (snap_fn, _s) in collectors.items():
             try:
-                out[name] = snap_fn()
+                # to_native at the boundary: a collector dict carrying
+                # numpy scalars must not leak them into /snapshot.json
+                out[name] = to_native(snap_fn())
             except Exception as e:  # noqa: BLE001 — one dead source must not poison the snapshot
                 log.warning("telemetry collector %r failed: %s", name, e)
                 out[name] = {"error": f"{type(e).__name__}: {e}"}
@@ -305,6 +338,40 @@ class MetricsRegistry:
             name: {"type": m.kind, "doc": m.doc, "values": m._snapshot()}
             for name, m in sorted(metrics.items())}}
         out.update(self._collect())
+        return out
+
+    def sample_families(self):
+        """Flattened numeric surface for cross-rank shipping: every
+        local family AND every collector sample, as
+        ``{family: {"type": t, "values": [{"labels": {...}, "value":
+        v}]}}`` with native-typed (JSON-safe) leaves.  Histograms
+        flatten into their ``_bucket`` / ``_sum`` / ``_count`` sample
+        families, so a fleet merge re-labels samples mechanically."""
+        with self._lock:
+            metrics = [self._metrics[n] for n in sorted(self._metrics)]
+            collectors = dict(self._collectors)
+        out = {}
+
+        def _add(family, mtype, key, value, extra=()):
+            fam = out.setdefault(family, {"type": mtype, "values": []})
+            fam["values"].append({"labels": dict(list(key) + list(extra)),
+                                  "value": to_native(value)})
+
+        for m in metrics:
+            for sample in m._samples():
+                name, key, value = sample[0], sample[1], sample[2]
+                extra = sample[3] if len(sample) > 3 else ()
+                _add(name, m.kind, key, value, extra)
+        for cname, (_snap, samples_fn) in sorted(collectors.items()):
+            if samples_fn is None:
+                continue
+            try:
+                samples = samples_fn()
+            except Exception as e:  # noqa: BLE001 — one dead source must not poison the fleet push
+                log.warning("telemetry samples for %r failed: %s", cname, e)
+                continue
+            for family, mtype, _help, labels, value in samples:
+                _add(family, mtype, _label_key(labels), value)
         return out
 
     def prometheus_dump(self):

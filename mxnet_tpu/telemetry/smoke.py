@@ -21,6 +21,7 @@ import tempfile
 import urllib.request
 
 os.environ.setdefault("MXNET_TELEMETRY", "1")
+os.environ.setdefault("MXNET_TRACE", "1")
 os.environ.setdefault("MXNET_WATCHDOG_S", "120")
 
 REQUIRED_FAMILIES = (
@@ -32,6 +33,8 @@ REQUIRED_FAMILIES = (
     "mxnet_checkpoint_saves_total",
     "mxnet_span_seconds",
     "mxnet_watchdog_fires_total",
+    "mxnet_trace_stage_seconds",
+    "mxnet_trace_e2e_seconds",
 )
 
 _SAMPLE_RE = re.compile(
@@ -50,6 +53,10 @@ def main():
     from mxnet_tpu import telemetry
 
     telemetry.enable()
+    # `python -m` imports the telemetry package before this module's
+    # env defaults land, so arm the ISSUE-12 planes explicitly too
+    telemetry.trace.enable()
+    telemetry.flight.enable()
     port = telemetry.start_exporter(0)
     print(f"exporter on http://127.0.0.1:{port}/metrics")
 
@@ -116,6 +123,19 @@ def main():
         if lane_cover < 0.9:
             _fail(f"step lanes cover only {lane_cover:.1%} of wall time")
 
+        # -- trace exemplars (ISSUE 12): every served request traced,
+        # stage spans covering >=95% of the measured e2e latency --------
+        traces = snap.get("trace", {}).get("serving")
+        if not traces or traces["count"] < 48:
+            _fail(f"serving traces missing from snapshot: {traces}")
+        worst = (traces["slowest"] or [traces["last"]])[0]
+        if worst["coverage"] < 0.95:
+            _fail(f"slowest request's stage spans cover only "
+                  f"{worst['coverage']:.1%} of its e2e latency: {worst}")
+        if traces["count"] and snap.get("flight", {}).get(
+                "enabled") is not True:
+            _fail("flight recorder not live during the smoke")
+
         # -- scrape ----------------------------------------------------------
         with urllib.request.urlopen(
                 f"http://127.0.0.1:{port}/metrics", timeout=10) as resp:
@@ -137,7 +157,9 @@ def main():
     telemetry.stop_exporter()
     print("telemetry smoke OK: snapshot unified 4 subsystems, "
           f"{len(REQUIRED_FAMILIES)} families scraped, lanes {lane_cover:.0%}"
-          " of step wall, watchdog silent")
+          f" of step wall, {traces['count']} request traces at "
+          f">=95% stage coverage (slowest {worst['coverage']:.0%}), "
+          "watchdog silent")
 
 
 if __name__ == "__main__":

@@ -209,7 +209,10 @@ def _fire(name, age):
     text = _render_dump(name, age)
     sys.stderr.write(text)
     sys.stderr.flush()
+    from . import flight as _flight
     from .. import config as _config
+    _flight.record("watchdog", "fire", severity="error", section=name,
+                   age_s=round(age, 3))
     directory = _config.get("MXNET_WATCHDOG_DIR") or os.getcwd()
     with _lock:
         n = _state["fires"] + 1
@@ -226,6 +229,9 @@ def _fire(name, age):
         with _lock:
             _state["fires"] += 1
             _state["last_dump"] = path
+        # dump retention (MXNET_WATCHDOG_KEEP): stall episodes must not
+        # grow the dump directory without bound
+        _flight.prune(directory, "mxnet-watchdog-")
         log.error("watchdog: %r stalled %.1fs — dump written to %s",
                   name, age, path)
     except OSError as e:
@@ -233,3 +239,6 @@ def _fire(name, age):
             _state["fires"] += 1
         log.error("watchdog: %r stalled %.1fs — dump file failed (%s); "
                   "stacks were written to stderr", name, age, e)
+    # the stall IS a fatal-adjacent event: land the flight ring next to
+    # the stack dump so the postmortem has the decision history too
+    _flight.auto_dump(f"watchdog:{name}")

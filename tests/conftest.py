@@ -19,6 +19,13 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault(
     "MXNET_COMPILE_CACHE_DIR",
     os.path.join(tempfile.gettempdir(), "mxnet-tpu-test-compile-cache"))
+# hermetic flight-recorder dump location: watchdog fires / chaos kills
+# inside tests must not litter the developer's cwd with
+# mxnet-flight-*.json rings (tests that assert on dumps pin their own
+# MXNET_FLIGHT_DIR via monkeypatch)
+_flight_dir = os.path.join(tempfile.gettempdir(), "mxnet-tpu-test-flight")
+os.makedirs(_flight_dir, exist_ok=True)
+os.environ.setdefault("MXNET_FLIGHT_DIR", _flight_dir)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

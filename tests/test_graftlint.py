@@ -808,3 +808,85 @@ def test_unbounded_wait_suppression():
     hits = [f for f in findings if f.rule == "unbounded-wait"]
     assert len(hits) == 1  # only the join remains
     assert hits[0].symbol == "shutdown:join"
+
+
+# -- metric-cardinality -------------------------------------------------------
+CARDINALITY_FLAG = """
+    from mxnet_tpu import telemetry
+
+    class Runner:
+        def handle(self, request_id, path):
+            try:
+                self.work()
+            except Exception as e:
+                telemetry.REGISTRY.counter("mx_errors_total").inc(
+                    labels={"error": str(e)})
+            telemetry.REGISTRY.gauge("mx_active").set(
+                1, labels={"req": f"r-{request_id}"})
+            telemetry.REGISTRY.histogram("mx_load_seconds").observe(
+                0.1, labels={"file": path})
+"""
+
+
+def test_metric_cardinality_flags_unbounded_label_sources():
+    findings = lint(CARDINALITY_FLAG, path="mxnet_tpu/serving/fake.py")
+    hits = [f for f in findings if f.rule == "metric-cardinality"]
+    assert len(hits) == 3, findings
+    labels = {f.symbol.split(":")[1] for f in hits}
+    assert labels == {"error", "req", "file"}
+    assert "exception" in hits[0].message or "unbounded" in hits[0].message
+
+
+def test_metric_cardinality_flags_bare_exception_var():
+    src = """
+        from mxnet_tpu import telemetry
+
+        def poll():
+            try:
+                refresh()
+            except OSError as err:
+                telemetry.REGISTRY.counter("mx_polls_total").inc(
+                    labels={"why": err})
+    """
+    findings = lint(src, path="mxnet_tpu/checkpoint/fake.py")
+    hits = [f for f in findings if f.rule == "metric-cardinality"]
+    assert len(hits) == 1
+    assert "exception" in hits[0].message
+
+
+def test_metric_cardinality_near_miss_enums_and_names():
+    src = """
+        from mxnet_tpu import telemetry
+
+        class Pool:
+            def route(self, rid, state, kind):
+                try:
+                    self.pick(rid)
+                except Exception as e:
+                    # class names are a bounded set — the right form
+                    telemetry.REGISTRY.counter("mx_faults_total").inc(
+                        labels={"cause": type(e).__name__})
+                telemetry.REGISTRY.gauge("mx_occ").set(1, labels={
+                    "model": self.model, "replica": str(rid),
+                    "state": state, "kind": kind, "site": "a/b"})
+    """
+    findings = lint(src, path="mxnet_tpu/serving/fake.py")
+    assert "metric-cardinality" not in rules_hit(findings)
+
+
+def test_metric_cardinality_silent_outside_hot_paths():
+    # offline tooling may label however it likes — the rule polices the
+    # registry's hot paths only
+    findings = lint(CARDINALITY_FLAG, path="tools/report.py")
+    assert "metric-cardinality" not in rules_hit(findings)
+
+
+def test_metric_cardinality_suppression():
+    src = CARDINALITY_FLAG.replace(
+        'labels={"error": str(e)})',
+        'labels={"error": str(e)})  # graftlint: '
+        'disable=metric-cardinality -- bounded: validator errors only')
+    findings = lint(src, path="mxnet_tpu/serving/fake.py")
+    hits = [f for f in findings if f.rule == "metric-cardinality"]
+    assert len(hits) == 2  # only the suppressed exception-label is gone
+    assert {f.symbol.split(":")[1] for f in hits} == {"req", "file"}
