@@ -469,6 +469,58 @@ def measure_trace_overhead():
                       "budget_ns": 1000}}
 
 
+def measure_alert_overhead():
+    """ISSUE-13 observatory overheads, three numbers:
+
+    * ``alert_tick_overhead_us`` — one evaluation pass of the DEFAULT
+      rule pack on an armed engine (< 1 ms: the engine may tick at 1 Hz
+      on a serving box without showing up in p99);
+    * ``resource_sample_overhead_us`` — one host resource sample
+      (RSS + fds + threads; < 1 ms for the same reason — checkpoint-dir
+      disk walks excluded here, they are sampled on the slow thread);
+    * ``alerts_disabled_tick_ns`` — the module-level tick with the
+      engine DISARMED (< 1 µs, the span/trace/failpoint bar: callers
+      may pulse it opportunistically from hot paths)."""
+    import time as _t
+
+    from mxnet_tpu.telemetry import alerts, resources
+
+    # disabled path first: module state must be pristine
+    assert not alerts.enabled()
+    n = 50000
+    best_off = float("inf")
+    for _ in range(3):
+        t0 = _t.perf_counter()
+        for _ in range(n):
+            alerts.tick()
+        best_off = min(best_off, (_t.perf_counter() - t0) / n)
+
+    eng = alerts.AlertEngine()  # the default pack, real sampler
+    eng.tick()  # warm: metric families + probes resolve once
+    best_tick = float("inf")
+    for _ in range(5):
+        t0 = _t.perf_counter()
+        eng.tick()
+        best_tick = min(best_tick, _t.perf_counter() - t0)
+
+    best_sample = float("inf")
+    for _ in range(5):
+        t0 = _t.perf_counter()
+        resources.sample_now(disk=False)
+        best_sample = min(best_sample, _t.perf_counter() - t0)
+
+    return {
+        "alerts": {"metric": "alert_tick_overhead_us",
+                   "value": round(best_tick * 1e6, 2), "unit": "us",
+                   "budget_us": 1000,
+                   "disabled_tick_ns": round(best_off * 1e9, 1),
+                   "disabled_budget_ns": 1000},
+        "resource_sample": {"metric": "resource_sample_overhead_us",
+                            "value": round(best_sample * 1e6, 2),
+                            "unit": "us", "budget_us": 1000},
+    }
+
+
 def measure_degraded_p99():
     """Relay-proof host phase ``degraded_p99_ms`` (ISSUE 8): serving p99
     with one of two batcher workers WEDGED (chaos failpoint) versus
@@ -1379,6 +1431,21 @@ def main():
                 log(f"trace phase failed: {type(e).__name__}: {e}")
                 result["trace"] = {
                     "metric": "trace_disabled_overhead_ns",
+                    "error": f"{type(e).__name__}: {e}"}
+
+        if _cfg0.get("BENCH_ALERTS"):
+            try:
+                result.update(measure_alert_overhead())
+                al, rs = result["alerts"], result["resource_sample"]
+                log(f"[alerts] tick {al['value']} us "
+                    f"(budget {al['budget_us']}), disabled "
+                    f"{al['disabled_tick_ns']} ns (budget "
+                    f"{al['disabled_budget_ns']}); host sample "
+                    f"{rs['value']} us (budget {rs['budget_us']})")
+            except Exception as e:
+                log(f"alerts phase failed: {type(e).__name__}: {e}")
+                result["alerts"] = {
+                    "metric": "alert_tick_overhead_us",
                     "error": f"{type(e).__name__}: {e}"}
 
         if _cfg0.get("BENCH_SERVE_SPIKE"):

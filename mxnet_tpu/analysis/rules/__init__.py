@@ -1,10 +1,11 @@
 """graftlint rule modules — importing this package registers every rule
 with the core registry (see ``core.register_rule``)."""
-from . import (env_drift, host_sync, lock_discipline, metric_cardinality,
-               naked_retry, per_param_collective, phase_timing,
-               swallowed_error, torn_write, tracer_leak, unbounded_wait)
+from . import (env_drift, host_sync, leaked_thread, lock_discipline,
+               metric_cardinality, naked_retry, per_param_collective,
+               phase_timing, swallowed_error, torn_write, tracer_leak,
+               unbounded_wait)
 
-__all__ = ["env_drift", "host_sync", "lock_discipline",
+__all__ = ["env_drift", "host_sync", "leaked_thread", "lock_discipline",
            "metric_cardinality", "naked_retry", "per_param_collective",
            "phase_timing", "swallowed_error", "torn_write", "tracer_leak",
            "unbounded_wait"]

@@ -1,0 +1,166 @@
+"""leaked-thread — non-daemon threads with no bounded lifecycle in
+long-running modules.
+
+ISSUE 13's resource observatory is the canon: the host sampler counts
+live threads precisely because a leaked one is invisible until shutdown
+hangs or the count ratchets.  A ``threading.Thread(...)`` started in a
+long-running module (``telemetry/``, ``serving/``, ``parallel/``,
+``chaos/``, ``checkpoint/``) must either be ``daemon=True`` (the
+process may die without it) or have a ``join(timeout=...)`` reachable
+from the owner's lifecycle (a ``close()``/``stop()`` method, or the
+same scope for a scoped worker pool) — otherwise a forgotten thread
+pins the interpreter at exit and every restart becomes a SIGKILL.
+
+The rule fires on a ``threading.Thread(...)`` / ``Thread(...)`` call
+in a scoped module that passes no ``daemon=`` keyword AND whose storage
+target (``self._thread = Thread(...)``, ``workers.append(Thread(...))``,
+``ts = [Thread(...) for ...]``) is never ``.join``-ed **with a
+timeout** anywhere in the file.
+
+Near-misses stay silent:
+
+* ``daemon=True`` (or any explicit ``daemon=`` keyword — an explicit
+  decision, reviewed where made);
+* worker pools with an explicit lifecycle — the created thread (or the
+  list holding it, matched through ``for t in threads: t.join(5)``
+  loop aliasing) is joined with a timeout somewhere in the file;
+* fire-and-forget threads outside the scoped long-running modules
+  (offline tooling, tests).
+
+Deliberate unjoined non-daemon threads carry
+``# graftlint: disable=leaked-thread -- reason``.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule, register_rule
+
+# modules whose processes are long-running: a leaked thread here pins a
+# server / trainer / launcher at exit
+LONG_RUNNING_PREFIXES = (
+    "mxnet_tpu/telemetry/",
+    "mxnet_tpu/serving/",
+    "mxnet_tpu/parallel/",
+    "mxnet_tpu/chaos/",
+    "mxnet_tpu/checkpoint/",
+)
+
+
+def _is_thread_ctor(call):
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr == "Thread" and \
+            isinstance(f.value, ast.Name) and f.value.id == "threading":
+        return True
+    return isinstance(f, ast.Name) and f.id == "Thread"
+
+
+def _has_daemon_kw(call):
+    return any(kw.arg == "daemon" for kw in call.keywords)
+
+
+def _target_base(node):
+    """Stable base name of an assignment target / receiver expression:
+    ``self._thread`` -> ``_thread``, ``workers`` -> ``workers``,
+    ``self._pools[k]`` -> ``_pools``."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Subscript):
+        return _target_base(node.value)
+    return None
+
+
+def _join_has_timeout(call):
+    return (any(kw.arg == "timeout" for kw in call.keywords)
+            or len(call.args) >= 1)
+
+
+@register_rule
+class LeakedThreadRule(Rule):
+    id = "leaked-thread"
+    severity = "warning"
+    doc = ("threading.Thread(...) in a long-running module without "
+           "daemon=True or a join(timeout=...) reachable in the file — "
+           "a leaked thread pins the interpreter at exit and hides in "
+           "the thread count the resource sampler now watches "
+           "(docs/lint.md)")
+
+    def begin_file(self, ctx):
+        self._hot = any(p in ctx.path for p in LONG_RUNNING_PREFIXES)
+        self._candidates = []    # (node, target_name, scope)
+        self._assigned = {}      # id(thread_call) -> target base name
+        self._joined = set()     # base names joined WITH a timeout
+        self._aliases = []       # (loop_var, iterated_base_name)
+
+    def _thread_calls_in(self, node):
+        return [n for n in ast.walk(node)
+                if isinstance(n, ast.Call) and _is_thread_ctor(n)]
+
+    def visit(self, node, ctx):
+        if not self._hot:
+            return
+        if isinstance(node, ast.Assign):
+            calls = self._thread_calls_in(node.value)
+            base = _target_base(node.targets[0])
+            if calls:
+                for c in calls:
+                    self._assigned[id(c)] = base
+            elif base and isinstance(node.value, ast.Name):
+                # `self._clients = clients`: a join on either name
+                # bounds the other
+                self._aliases.append((base, node.value.id))
+                self._aliases.append((node.value.id, base))
+            return
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            if isinstance(node.target, ast.Name):
+                base = _target_base(node.iter)
+                if base:
+                    self._aliases.append((node.target.id, base))
+            return
+        if not isinstance(node, ast.Call):
+            return
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "append":
+            # workers.append(Thread(...)): the pool list is the target
+            calls = self._thread_calls_in(node)
+            base = _target_base(f.value)
+            if calls and base:
+                for c in calls:
+                    self._assigned.setdefault(id(c), base)
+        if isinstance(f, ast.Attribute) and f.attr == "join" and \
+                _join_has_timeout(node):
+            base = _target_base(f.value)
+            if base:
+                self._joined.add(base)
+        if _is_thread_ctor(node) and not _has_daemon_kw(node):
+            self._candidates.append(
+                (node, self._assigned.get(id(node)), ctx.func_name()))
+
+    def end_file(self, ctx):
+        if not self._hot or not self._candidates:
+            return
+        joined = set(self._joined)
+        # `for t in threads: t.join(5)` bounds the whole pool; chase
+        # name/attr aliases to a fixpoint (loop var -> list -> attr)
+        changed = True
+        while changed:
+            changed = False
+            for var, src in self._aliases:
+                if var in joined and src not in joined:
+                    joined.add(src)
+                    changed = True
+        for node, target, scope in self._candidates:
+            if target is not None and target in joined:
+                continue
+            what = (f"thread stored in {target!r}" if target
+                    else "fire-and-forget thread")
+            ctx.report(
+                self, node,
+                f"{what} started without daemon=True and never "
+                "join(timeout=...)-ed in this file — in a long-running "
+                "module a leaked non-daemon thread pins the interpreter "
+                "at exit; mark it daemon or join it with a timeout from "
+                "close()/stop() (docs/lint.md)",
+                symbol=f"{scope}:{target or '<unnamed>'}")

@@ -3,11 +3,15 @@
 The robustness harness (ISSUE 8): :mod:`failpoints` plants named,
 deterministic injection sites across the checkpoint writer, serving
 stack, compile cache, kvstore transport and io staging;
-:mod:`harness` composes them into the four end-to-end outage scenarios
-CI replays (``python -m mxnet_tpu.chaos.smoke``); every weakness a
-scenario exposes becomes a permanent fix + a graftlint rule or
-telemetry alarm — the same ratchet loop graftlint (ISSUE 3) runs for
-static invariants, applied to dynamic ones.
+:mod:`harness` composes them into the end-to-end outage scenarios
+CI replays (``python -m mxnet_tpu.chaos.smoke``); :mod:`soak`
+(ISSUE 13) applies the same ratchet to wall-clock time — a
+bounded-minutes train + checkpoint + hot-reload + traffic loop under
+a seeded benign fault mix, gated by the in-process alert engine
+(``python -m mxnet_tpu.chaos.soak``).  Every weakness a scenario
+exposes becomes a permanent fix + a graftlint rule or an alert rule —
+the same ratchet loop graftlint (ISSUE 3) runs for static invariants,
+applied to dynamic ones.
 
 Usage::
 

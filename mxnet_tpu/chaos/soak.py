@@ -1,0 +1,337 @@
+"""Soak harness: bounded-minutes end-to-end run gated by the alert
+engine (ISSUE 13 tentpole, half three; ROADMAP item 5b).
+
+Every other CI phase exercises the stack for *seconds* — leaks, drifts
+and slow ratchets are invisible at that horizon.  The soak is the
+ratchet loop applied to wall-clock time: for ``--seconds`` (default
+``MXNET_SOAK_SECONDS`` = 90) it runs
+
+* **train windows** — repeated fit epochs on one persistent Module;
+* **checkpoint commits** — the module's params committed each round
+  (retention GC live);
+* **serving hot-reload** — a 2-replica ``ModelServer`` watching the
+  checkpoint directory, flipping to each newly committed step under
+  load;
+* **Poisson traffic** — client threads at ``MXNET_SOAK_QPS``;
+* **a seeded benign chaos mix** (``MXNET_SOAK_CHAOS``) — transient
+  router-dispatch faults the spill path must heal, io-stage and
+  checkpoint-GC delays — deliberately *below* every default alert
+  threshold, because the gate is that the stack absorbs them quietly;
+
+with the resource sampler, the alert engine (default rule pack), and
+the exporter all armed.  It passes only if the judgment layer stayed
+quiet:
+
+* **zero firing alerts at exit** and zero page-severity fires ever
+  (no leak-slope page, no watchdog, no shed burn);
+* **RSS leak slope** below ``MXNET_SOAK_RSS_SLOPE_MAX`` (the
+  least-squares estimator over the whole measured window);
+* the watchdog never fired, no non-shed request failures;
+* a final ``/alerts.json`` + ``/fleet.json`` + ``/healthz`` scrape
+  parses (200).
+
+Run: ``JAX_PLATFORMS=cpu python -m mxnet_tpu.chaos.soak --seconds 90``
+(the ci/run.sh soak smoke phase).  docs/chaos.md has the runbook.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random as _pyrandom
+import shutil
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+from . import failpoints as chaos
+
+
+def _build_model(seed=0, in_dim=16, width=32, classes=10, scale=0.05):
+    """(train_symbol, serve_symbol, init_params): the fit loop trains
+    the SoftmaxOutput graph; the label-free logits graph is what each
+    checkpoint commits for the serving hot-reload."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    d = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(d, num_hidden=width, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    logits = mx.sym.FullyConnected(h, num_hidden=classes, name="fc2")
+    sym = mx.sym.SoftmaxOutput(logits, name="softmax")
+    rng = np.random.RandomState(seed)
+    params = {
+        "fc1_weight": mx.nd.array(
+            rng.randn(width, in_dim).astype(np.float32) * scale),
+        "fc1_bias": mx.nd.zeros((width,)),
+        "fc2_weight": mx.nd.array(
+            rng.randn(classes, width).astype(np.float32) * scale),
+        "fc2_bias": mx.nd.zeros((classes,)),
+    }
+    return sym, logits, params
+
+
+def _rearm_chaos(rng):
+    """One round of the benign fault mix — transient, count-bounded,
+    and sized BELOW the default alert thresholds (spill_storm wants a
+    sustained > 1/s rate; this injects at most 2 spills per ~4 s
+    round).  The soak's claim is that the stack heals these without a
+    judgment."""
+    arms = chaos.arms()
+    if "serving/router/dispatch" not in arms:
+        chaos.arm("serving/router/dispatch", "raise",
+                  prob=0.05 + 0.05 * rng.random(), count=2)
+    if "io/stage" not in arms:
+        chaos.arm("io/stage", "delay", value=0.002, prob=0.2, count=4)
+    if "checkpoint/gc/remove" not in arms:
+        chaos.arm("checkpoint/gc/remove", "delay", value=0.002,
+                  prob=0.5, count=2)
+
+
+def _scrape(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+        return r.status, r.read().decode("utf-8")
+
+
+def run(seconds=None, qps=None, chaos_on=None, rss_slope_max=None,
+        n_clients=4, verbose=True, alert_interval_s=0.5,
+        sample_interval_s=0.5):
+    """Run the soak; returns a result dict with ``ok``."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from .. import config as _config
+    from .. import io as mxio
+    from .. import serving, telemetry
+    from ..checkpoint import CheckpointManager
+    from ..serving.batcher import (RequestTimeoutError,
+                                   ServingOverloadError)
+    from ..telemetry import alerts, resources
+    from ..telemetry import watchdog as wd
+
+    seconds = float(_config.get("MXNET_SOAK_SECONDS")
+                    if seconds is None else seconds)
+    qps = float(_config.get("MXNET_SOAK_QPS") if qps is None else qps)
+    chaos_on = bool(_config.get("MXNET_SOAK_CHAOS")
+                    if chaos_on is None else chaos_on)
+    rss_slope_max = float(_config.get("MXNET_SOAK_RSS_SLOPE_MAX")
+                          if rss_slope_max is None else rss_slope_max)
+    rng = _pyrandom.Random(int(_config.get("MXNET_CHAOS_SEED")) or 13)
+
+    workdir = tempfile.mkdtemp(prefix="mx-soak-")
+    ckdir = os.path.join(workdir, "ckpt")
+    # the watchdog runs ARMED through the soak and must stay silent
+    watchdog_was = os.environ.get("MXNET_WATCHDOG_S")
+    os.environ.setdefault("MXNET_WATCHDOG_S", "30")
+    fires0 = wd.fires()
+    chaos.reset()
+
+    result = {"ok": False, "seconds": seconds, "qps": qps,
+              "chaos": chaos_on, "served": 0, "shed": 0, "timeouts": 0,
+              "chaos_refusals": 0, "non_shed_failures": [],
+              "train_steps": 0, "commits": 0, "reloads": 0}
+    stop = threading.Event()
+    lock = threading.Lock()
+
+    sym, serve_sym, params = _build_model()
+    rng_np = np.random.RandomState(7)
+    x = rng_np.randn(128, 16).astype(np.float32)
+    y = rng_np.randint(0, 10, 128).astype(np.float32)
+
+    mgr = CheckpointManager(ckdir, async_save=False, keep_last=3)
+    server = serving.ModelServer(max_batch_size=8, max_latency_ms=2.0,
+                                 num_replicas=2, name="soak")
+    port = telemetry.start_exporter(0)
+    resources.SAMPLER.start(sample_interval_s)
+
+    def client():
+        xq = rng_np.randn(16).astype(np.float32)
+        per_client = max(0.5, qps / max(1, n_clients))
+        while not stop.is_set():
+            # Poisson arrivals: exponential inter-arrival per client
+            stop.wait(rng.expovariate(per_client))
+            if stop.is_set():
+                return
+            try:
+                server.predict("m", {"data": xq}, wait_s=30.0)
+                with lock:
+                    result["served"] += 1
+            except ServingOverloadError:
+                with lock:
+                    result["shed"] += 1
+            except RequestTimeoutError:
+                with lock:
+                    result["timeouts"] += 1
+            except chaos.ChaosInjectedError:
+                # every replica took the injected transient — typed and
+                # retryable; the next arrival retries organically
+                with lock:
+                    result["chaos_refusals"] += 1
+            except Exception as e:  # noqa: BLE001 — gate-fatal bucket
+                with lock:
+                    result["non_shed_failures"].append(
+                        f"{type(e).__name__}: {e}")
+
+    clients = []
+    step = 0
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    try:
+        # -- warmup (outside the measured window): first fit epoch,
+        # first commit, watch engaged, first served request — compile
+        # transients must not pollute the leak-slope estimator
+        it = mxio.NDArrayIter(mx.nd.array(x), mx.nd.array(y),
+                              batch_size=16, label_name="softmax_label")
+        mod.fit(it, num_epoch=1, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.05},
+                arg_params={k: v.copy() for k, v in params.items()})
+        step += 1
+        p, _ = mod.get_params()
+        mgr.save(step, arrays=p, symbol=serve_sym, block=True)
+        server.repository.watch("m", ckdir, interval=0.2)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            try:
+                server.repository.get("m")
+                break
+            except mx.base.MXNetError:
+                time.sleep(0.05)
+        server.predict("m", {"data": x[0]}, wait_s=30.0)
+
+        # -- measured window: reset the sampler history, arm the engine
+        resources.SAMPLER.reset()
+        alerts.start(alert_interval_s)
+        page_fires0 = {
+            r["name"]: r["fired_total"]
+            for r in alerts.alerts_json()["rules"]
+            if r["severity"] == "page"}
+        clients = [threading.Thread(target=client, daemon=True)
+                   for _ in range(n_clients)]
+        for t in clients:
+            t.start()
+
+        t_end = time.monotonic() + seconds
+        last_log = 0.0
+        last_rearm = 0.0
+        last_commit = 0.0
+        while time.monotonic() < t_end:
+            if chaos_on and time.monotonic() - last_rearm >= 4.0:
+                last_rearm = time.monotonic()
+                _rearm_chaos(rng)
+            it.reset()
+            mod.fit(it, num_epoch=1, optimizer="sgd",
+                    optimizer_params={"learning_rate": 0.05})
+            result["train_steps"] += len(x) // 16
+            if time.monotonic() - last_commit >= 2.5:
+                # a commit triggers a serving hot-reload + ladder warmup
+                # — a periodic publish, not a per-window spin
+                last_commit = time.monotonic()
+                step += 1
+                p, _ = mod.get_params()
+                mgr.save(step, arrays=p, symbol=serve_sym, block=True)
+                result["commits"] += 1
+            if verbose and time.monotonic() - last_log > 10:
+                last_log = time.monotonic()
+                with lock:
+                    served = result["served"]
+                print(f"[soak] t-{t_end - time.monotonic():.0f}s: "
+                      f"{result['commits']} commits, {served} served, "
+                      f"firing={alerts.firing()}", flush=True)
+            # pace the loop: commits are periodic events, not a spin
+            stop.wait(0.5)
+        stop.set()
+        for t in clients:
+            t.join(timeout=30)
+        chaos.reset()
+
+        # -- judgment ---------------------------------------------------
+        alerts.tick()  # one final evaluation with traffic stopped
+        ajson = alerts.alerts_json()
+        result["firing"] = ajson["firing"]
+        result["page_fires"] = {
+            r["name"]: r["fired_total"] - page_fires0.get(r["name"], 0)
+            for r in ajson["rules"] if r["severity"] == "page"
+            and r["fired_total"] > page_fires0.get(r["name"], 0)}
+        result["warn_fires"] = {
+            r["name"]: r["fired_total"] for r in ajson["rules"]
+            if r["severity"] == "warn" and r["fired_total"] > 0}
+        result["rss_slope_bytes_per_s"] = round(resources.leak_slope(), 1)
+        result["rss_slope_max"] = rss_slope_max
+        result["reloads"] = server.repository.latest_version("m") - 1
+        result["watchdog_fires"] = wd.fires() - fires0
+
+        code_a, body_a = _scrape(port, "/alerts.json")
+        code_f, body_f = _scrape(port, "/fleet.json")
+        code_h, _body_h = _scrape(port, "/healthz")
+        alerts_doc = json.loads(body_a)
+        fleet_doc = json.loads(body_f)
+        result["alerts_scrape_ok"] = bool(
+            code_a == 200 and alerts_doc.get("rules"))
+        result["fleet_scrape_ok"] = bool(
+            code_f == 200 and fleet_doc.get("ranks"))
+        result["healthz"] = code_h
+
+        result["ok"] = bool(
+            not result["firing"]
+            and not result["page_fires"]
+            and abs(result["rss_slope_bytes_per_s"]) <= rss_slope_max
+            and result["watchdog_fires"] == 0
+            and not result["non_shed_failures"]
+            and result["served"] > 0
+            and result["commits"] >= 2
+            and result["reloads"] >= 1
+            and result["alerts_scrape_ok"]
+            and result["fleet_scrape_ok"]
+            and result["healthz"] == 200)
+    finally:
+        stop.set()
+        chaos.reset()
+        alerts.stop()
+        resources.stop()
+        try:
+            server.repository.stop_watches()
+            server.shutdown()
+        except Exception as e:  # noqa: BLE001 — teardown must not mask the verdict
+            result.setdefault("teardown_errors", []).append(str(e))
+        mgr.close()
+        telemetry.stop_exporter()
+        if watchdog_was is None:
+            os.environ.pop("MXNET_WATCHDOG_S", None)
+        else:
+            os.environ["MXNET_WATCHDOG_S"] = watchdog_was
+        shutil.rmtree(workdir, ignore_errors=True)
+    return result
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="bounded-minutes soak gated by the alert engine")
+    ap.add_argument("--seconds", type=float, default=None)
+    ap.add_argument("--qps", type=float, default=None)
+    ap.add_argument("--no-chaos", action="store_true")
+    ap.add_argument("--json", action="store_true",
+                    help="print the result dict as JSON")
+    args = ap.parse_args(argv)
+    result = run(seconds=args.seconds, qps=args.qps,
+                 chaos_on=False if args.no_chaos else None)
+    if args.json:
+        print(json.dumps(result, sort_keys=True, default=str))
+    else:
+        printable = {k: v for k, v in result.items() if k != "ok"}
+        print(f"soak {'OK' if result['ok'] else 'FAIL'}: {printable}",
+              flush=True)
+    if not result["ok"]:
+        print("FAIL: soak gate did not hold", file=sys.stderr)
+        sys.exit(1)
+    print(f"soak OK: {result['seconds']:.0f}s quiet — "
+          f"{result['served']} served, {result['commits']} commits, "
+          f"{result['reloads']} hot-reloads, "
+          f"rss slope {result['rss_slope_bytes_per_s']} B/s "
+          f"(max {result['rss_slope_max']:.0f}), zero firing alerts, "
+          "watchdog silent, scrapes parsed")
+
+
+if __name__ == "__main__":
+    main()

@@ -158,10 +158,16 @@ def warm_version(cache, model, mv, ctx, max_batch, sample_signature=None,
             with entry.lock:
                 if not entry._hot:
                     from .cache import guarded_compile
-                    guarded_compile(
+                    compiled = guarded_compile(
                         lambda e=entry: aot_compile(e.executor),
                         what=f"AOT warmup of {model} v{mv.version} "
                              f"bucket {b}")
+                    # resource observatory (ISSUE 13): record the
+                    # compiled program's HBM estimate where jax exposes
+                    # memory_analysis() — the largest warmed bucket is
+                    # the model's serving footprint ceiling
+                    from ..telemetry import resources as _resources
+                    _resources.note_compiled(str(model), compiled)
                     # then walk the REAL request path once on zeros: the
                     # input-buffer writes jit a per-shape setitem helper
                     # and the forward's backend compile is a persistent-

@@ -271,6 +271,23 @@ _register("MXNET_CHAOS_WEDGE_TIMEOUT_S", float, 60.0,
           "a wedge failpoint left unreleased raises ChaosInjectedError "
           "after this long instead of hanging forever (the no-scenario-"
           "ends-in-a-hang contract)")
+# -- soak harness ------------------------------------------------------------
+_register("MXNET_SOAK_SECONDS", float, 90.0,
+          "chaos.soak harness: wall-clock length of the train + "
+          "checkpoint + serving-hot-reload + Poisson-traffic loop "
+          "(python -m mxnet_tpu.chaos.soak; --seconds overrides)")
+_register("MXNET_SOAK_QPS", float, 40.0,
+          "chaos.soak harness: Poisson arrival rate of the serving "
+          "traffic generator (req/s)")
+_register("MXNET_SOAK_CHAOS", bool, True,
+          "chaos.soak harness: arm the seeded benign fault mix "
+          "(transient router-dispatch raises the spill path heals, "
+          "io-stage and checkpoint-gc delays) while the loop runs; "
+          "0 soaks the stack fault-free")
+_register("MXNET_SOAK_RSS_SLOPE_MAX", float, 4e6,
+          "chaos.soak harness: maximum acceptable RSS leak slope "
+          "(bytes/s, least-squares over the sampler window) at soak "
+          "exit — above it the soak fails")
 # -- telemetry ---------------------------------------------------------------
 _register("MXNET_TELEMETRY", bool, False,
           "enable the telemetry span tracer + per-train-step lane "
@@ -322,6 +339,30 @@ _register("MXNET_FLIGHT_DIR", str, "",
           "directory for flight-recorder dump files "
           "(empty = MXNET_WATCHDOG_DIR, then cwd); the elastic launcher "
           "points each worker generation at its postmortem harvest dir")
+_register("MXNET_ALERTS", float, 0.0,
+          "in-process SLO alert engine: evaluate the rule pack "
+          "(telemetry/alerts.py; default pack codifies the doc alarm "
+          "table — watchdog stall, corrupt ckpt, spill storm, shed "
+          "burn-rate, retrace ratchet, RSS slope, snapshot staleness) "
+          "every this many seconds on a daemon thread; firing "
+          "page-severity rules flip /healthz to 503 and every "
+          "transition lands in the flight ring + /alerts.json; "
+          "0 disables (the disabled tick is one global check, < 1 us)")
+_register("MXNET_ALERT_RULES", str, "",
+          "extra alert rules appended to the default pack: "
+          "';'-separated name=family<op>value[:for=S][:cooldown=S]"
+          "[:severity=warn|page][:reduce=sum|max|min]"
+          "[:kind=threshold|rate|absence][:window=S] arms "
+          "(docs/observability.md rule grammar); a name collision "
+          "replaces the default rule")
+_register("MXNET_RESOURCE_SAMPLE_S", float, 0.0,
+          "host resource sampler: sample RSS / open fds / thread count "
+          "/ checkpoint-dir disk usage into a sliding window every this "
+          "many seconds (feeds the mxnet_resource_* families and the "
+          "least-squares RSS leak-slope estimator the rss_slope alert "
+          "rule and the soak harness gate on); 0 disables the thread "
+          "(the resources collector still takes one on-demand sample "
+          "per scrape)")
 _register("MXNET_FLEET_INTERVAL_S", float, 0.0,
           "cross-rank telemetry aggregation: every rank pushes its "
           "registry snapshot to the control-plane kvstore server this "
@@ -527,6 +568,12 @@ _register("BENCH_TRACE", bool, True,
           "(trace_disabled_overhead_ns; the <1us budget that lets the "
           "request/window tracing and the event ring stay wired into "
           "hot paths unconditionally)")
+_register("BENCH_ALERTS", bool, True,
+          "bench.py: also measure the alert/resource observatory "
+          "overheads — one evaluation pass over the default rule pack "
+          "(alert_tick_overhead_us) and one host resource sample "
+          "(resource_sample_overhead_us), both gated < 1 ms, plus the "
+          "engine-disabled tick gated < 1 us like span/trace/failpoint")
 _register("BENCH_COLD_START", bool, True,
           "bench.py: also measure cold_start_first_request_ms — warm "
           "restart (persistent compile cache) vs cold cache dir, in "

@@ -254,6 +254,13 @@ class FusedTrainStep:
             feed_bufs[name] = buf
 
         train_vals, aux_vals, states, states_nd = self._stage_carry()
+        if self._just_built:
+            # resource observatory (ISSUE 13): a (re)build re-states the
+            # donated carry's device footprint — host shape math only,
+            # never on the steady-state per-step path
+            _telemetry.resources.account_train_step(
+                "fused_step", params=train_vals, opt_state=states,
+                aux=aux_vals)
         other_vals = tuple(
             feed_bufs[n] if n in feed_bufs else exec_.arg_dict[n]._data
             for n in self._other_names)
@@ -453,6 +460,10 @@ class ScanTrainStep(FusedTrainStep):
             feed_bufs.append(buf.reshape((K, M) + tuple(bound.shape)))
 
         train_vals, aux_vals, states, states_nd = self._stage_carry()
+        if self._just_built:
+            _telemetry.resources.account_train_step(
+                "scan_step", params=train_vals, opt_state=states,
+                aux=aux_vals)
         rest_vals = tuple(exec_.arg_dict[n]._data
                           for n in self._rest_names)
 

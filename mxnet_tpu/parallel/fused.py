@@ -628,6 +628,15 @@ class MeshFusedTrainStep(ScanTrainStep):
             self._n_outs = len(module.output_names)
             self._build_scan_jit()
             self._scan_sig = sig
+            # resource observatory (ISSUE 13): re-state the mesh carry's
+            # device footprint at each (re)build — params/opt-state plus
+            # the mesh-specific gradient buckets and codec residuals
+            from ..telemetry import resources as _resources
+            _resources.account_train_step(
+                "mesh_step", params=train_vals, opt_state=states,
+                extra={"grad_buckets": self._grad_bytes,
+                       "codec_residuals": _resources.pytree_nbytes(
+                           list(self._residual_bufs))})
 
         # stacked feeds: (K, M, *bound), batch dim sharded over the mesh
         # (a multi-process mesh routes through put_batch, where each

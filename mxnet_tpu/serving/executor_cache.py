@@ -43,6 +43,22 @@ _ALL_CACHES = weakref.WeakSet()
 _ALL_CACHES_LOCK = threading.Lock()
 
 
+def _executor_nbytes(executor):
+    """Estimated device bytes a bound executor pins (args + aux), from
+    shape metadata only — never a device sync."""
+    from ..telemetry import resources as _resources
+    total = 0
+    for d in (getattr(executor, "arg_dict", None) or {},
+              getattr(executor, "aux_dict", None) or {}):
+        total += _resources.pytree_nbytes(dict(d))
+    return total
+
+
+def _ledger():
+    from ..telemetry import resources as _resources
+    return _resources.LEDGER
+
+
 def bucket_batch(n, max_batch=None, ladder=None):
     """The bucket ``n`` runs at: the smallest planned-``ladder``
     boundary >= n when a measured ladder is given, else the next power
@@ -118,7 +134,7 @@ class CachedExecutor:
     first forward (or ladder warmup): the compile that first forward
     triggers is attributed to the model in the TraceLedger."""
 
-    __slots__ = ("executor", "lock", "key", "model", "_hot")
+    __slots__ = ("executor", "lock", "key", "model", "_hot", "nbytes")
 
     def __init__(self, executor, key, model=None):
         self.executor = executor
@@ -127,6 +143,10 @@ class CachedExecutor:
         self.model = model if model is not None else (
             key[0] if isinstance(key, tuple) and key else "?")
         self._hot = False
+        # device footprint this entry pins (bound params + input/aux
+        # buffers) — host shape arithmetic, charged to the ISSUE-13
+        # device ledger at insert and released at evict
+        self.nbytes = _executor_nbytes(executor)
 
     def run_padded(self, feed, n_real):
         """Write ``feed`` (already padded to the bound batch) into the
@@ -211,11 +231,14 @@ class ExecutorCache:
             _compile.note_retrace(key, reason)
             entry = CachedExecutor(builder(), key, model=model)
             self._entries[key] = entry
+            _ledger().add(str(model), "executor_cache", entry.nbytes)
             while len(self._entries) > self.capacity:
                 _k, evicted = self._entries.popitem(last=False)
                 self.evictions += 1
                 self._model_cell(str(evicted.model))["evictions"] += 1
                 _CACHE_METRICS.incr("cache_evictions_total")
+                _ledger().release(str(evicted.model), "executor_cache",
+                                  evicted.nbytes)
             return entry
 
     def evict_model(self, model_prefix):
@@ -225,7 +248,9 @@ class ExecutorCache:
             doomed = [k for k in self._entries
                       if k[:len(model_prefix)] == model_prefix]
             for k in doomed:
-                del self._entries[k]
+                gone = self._entries.pop(k)
+                _ledger().release(str(gone.model), "executor_cache",
+                                  gone.nbytes)
             return len(doomed)
 
     def evict_stale_versions(self, model, keep_versions):
@@ -240,7 +265,9 @@ class ExecutorCache:
                       if isinstance(k, tuple) and len(k) >= 2
                       and k[0] == model and k[1] not in keep]
             for k in doomed:
-                del self._entries[k]
+                gone = self._entries.pop(k)
+                _ledger().release(str(gone.model), "executor_cache",
+                                  gone.nbytes)
             return len(doomed)
 
     def __len__(self):

@@ -32,8 +32,10 @@ import weakref
 from . import registry as _registry_mod
 from . import spans as _spans
 from . import steps as _steps
+from . import alerts
 from . import fleet
 from . import flight
+from . import resources
 from . import trace
 from . import watchdog
 from .exporter import exporter_port, start_exporter, stop_exporter
@@ -252,6 +254,22 @@ REGISTRY.register_collector(
     lambda: {"enabled": flight.enabled(),
              "ring_events": len(flight.events()),
              "dumps": flight.dump_count()})
+REGISTRY.register_collector("resources", resources._collector_snapshot,
+                            resources._collector_samples)
+
+
+def _alerts_collector():
+    # summary only (rule pack + full history live at /alerts.json);
+    # built lazily so an unarmed process pays one dict
+    if not alerts.enabled():
+        return {"enabled": False}
+    snap = alerts.alerts_json()
+    return {"enabled": True, "ticks": snap["ticks"],
+            "firing": snap["firing"], "pages": snap["pages"],
+            "states": {r["name"]: r["state"] for r in snap["rules"]}}
+
+
+REGISTRY.register_collector("alerts", _alerts_collector)
 
 
 def snapshot():
@@ -273,6 +291,10 @@ def _autostart():
     if _config.get("MXNET_TRACE"):
         trace.enable()
     flight.configure()
+    if float(_config.get("MXNET_RESOURCE_SAMPLE_S")) > 0:
+        resources.start()
+    if float(_config.get("MXNET_ALERTS")) > 0:
+        alerts.start()
     port = int(_config.get("MXNET_TELEMETRY_PORT"))
     if port > 0:
         start_exporter(port)

@@ -9,12 +9,17 @@ ephemeral one — used by tests/smoke) on a daemon thread and serves:
   merged per-rank registry view with liveness tags; a single-rank local
   view on processes without a fleet provider — see telemetry/fleet.py,
   ISSUE 12)
+* ``GET /alerts.json``   — the in-process alert engine's full state:
+  rule pack, lifecycle states, recent transitions, firing/pages lists
+  (telemetry/alerts.py, ISSUE 13)
 * ``GET /healthz``       — liveness an orchestrator can act on: 200
   ``ok`` normally; **503** naming the stalled section while a watchdog
   stall episode is active (an armed section fired and has not
-  progressed since), or after a chaos ``kill`` arm fired (the process
-  is doomed/marked) — so a wedged-but-running worker gets restarted
-  instead of serving dead air (ISSUE 8 satellite).
+  progressed since), after a chaos ``kill`` arm fired (the process
+  is doomed/marked), or while a **page**-severity alert rule is firing
+  (body names the firing rule; warn-severity alerts deliberately stay
+  out of the readiness verdict) — so a wedged-but-running worker gets
+  restarted instead of serving dead air (ISSUE 8 + 13 satellites).
 
 Auto-start: importing :mod:`mxnet_tpu.telemetry` with
 ``MXNET_TELEMETRY_PORT`` set starts the endpoint; loopback-only by
@@ -52,13 +57,18 @@ class _Handler(BaseHTTPRequestHandler):
             body = json.dumps(fleet.fleet_json(), default=str,
                               sort_keys=True).encode("utf-8")
             ctype = "application/json"
+        elif path in ("/alerts.json", "/alerts"):
+            from . import alerts
+            body = json.dumps(alerts.alerts_json(), default=str,
+                              sort_keys=True).encode("utf-8")
+            ctype = "application/json"
         elif path == "/healthz":
             body, ctype, status = _health()
             self._reply(status, body, ctype)
             return
         else:
             self.send_error(404, "try /metrics, /snapshot.json, "
-                                 "/fleet.json, /healthz")
+                                 "/fleet.json, /alerts.json, /healthz")
             return
         self._reply(200, body, ctype)
 
@@ -76,9 +86,11 @@ class _Handler(BaseHTTPRequestHandler):
 def _health():
     """(body, content-type, status) for /healthz.  503 while a watchdog
     stall episode is active (body names the stalled section, so an
-    orchestrator's restart log is a diagnosis) or after a chaos
-    ``kill`` arm fired; 200 otherwise."""
-    from . import watchdog
+    orchestrator's restart log is a diagnosis), after a chaos ``kill``
+    arm fired, or while a page-severity alert rule is firing (body
+    names the rule — warn severity never flips readiness); 200
+    otherwise."""
+    from . import alerts, watchdog
     stalled = watchdog.stalled_sections()
     fatal = None
     try:
@@ -91,6 +103,10 @@ def _health():
                 "text/plain", 503)
     if stalled:
         return (("stalled: " + ", ".join(stalled) + "\n").encode("utf-8"),
+                "text/plain", 503)
+    pages = alerts.firing_pages()
+    if pages:
+        return (("alert: " + ", ".join(pages) + "\n").encode("utf-8"),
                 "text/plain", 503)
     return b"ok\n", "text/plain", 200
 

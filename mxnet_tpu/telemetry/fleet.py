@@ -186,7 +186,42 @@ def merge_server(server):
         generations[str(gen)] = gen_ranks
     return {"time": time.time(), "generation": generation,
             "world": num_workers, "ranks": ranks,
-            "generations": generations}
+            "generations": generations,
+            "alerts": alert_rollup(ranks)}
+
+
+def alert_rollup(ranks):
+    """Fleet-wide alert rollup from merged per-rank families: every
+    rank's ``mxnet_alert_state`` one-hot gauges read back into
+    {rule: state}, with non-``alive`` ranks' alerts tagged ``stale`` —
+    a lost rank's last-known firing alert stays visible (never silently
+    dropped), but a consumer can tell judgment from memory (ISSUE 13).
+    """
+    by_rank = {}
+    firing = []
+    for rank, v in sorted((ranks or {}).items()):
+        fam = (v.get("families") or {}).get("mxnet_alert_state")
+        if not fam:
+            continue
+        rank_state = v.get("state", "unknown")
+        stale = rank_state != "alive"
+        rules = {}
+        for sample in fam.get("values", []):
+            if sample.get("value") != 1:
+                continue
+            labels = sample.get("labels", {})
+            rule, state = labels.get("rule"), labels.get("state")
+            if not rule or not state:
+                continue
+            rules[rule] = state
+            if state == "firing":
+                firing.append({"rank": rank, "rule": rule,
+                               "stale": stale,
+                               "rank_state": rank_state})
+        if rules:
+            by_rank[rank] = {"rank_state": rank_state, "stale": stale,
+                             "rules": rules}
+    return {"by_rank": by_rank, "firing": firing}
 
 
 def set_provider(fn):
@@ -211,13 +246,13 @@ def fleet_json():
         return fn()
     import os
     rank = os.environ.get("MXNET_MULTIHOST_PROC_ID", "0")
+    ranks = {str(rank): {"state": "alive", "age_s": 0.0,
+                         "snapshot_age_s": 0.0,
+                         "generation": None,
+                         "families": local_payload()["families"]}}
     return {"time": time.time(), "generation": None, "world": 1,
-            "ranks": {str(rank): {"state": "alive", "age_s": 0.0,
-                                  "snapshot_age_s": 0.0,
-                                  "generation": None,
-                                  "families":
-                                      local_payload()["families"]}},
-            "generations": {}}
+            "ranks": ranks, "generations": {},
+            "alerts": alert_rollup(ranks)}
 
 
 # -- telemetry collector hooks ------------------------------------------------
@@ -235,7 +270,9 @@ def _collector_snapshot():
                           "age_s": v.get("age_s"),
                           "snapshot_age_s": v.get("snapshot_age_s"),
                           "families": len(v.get("families", {}))}
-                      for r, v in snap.get("ranks", {}).items()}}
+                      for r, v in snap.get("ranks", {}).items()},
+            "alerts": snap.get("alerts",
+                               alert_rollup(snap.get("ranks", {})))}
 
 
 def _collector_samples():

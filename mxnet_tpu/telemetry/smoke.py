@@ -35,6 +35,9 @@ REQUIRED_FAMILIES = (
     "mxnet_watchdog_fires_total",
     "mxnet_trace_stage_seconds",
     "mxnet_trace_e2e_seconds",
+    "mxnet_resource_rss_bytes",
+    "mxnet_resource_device_total_bytes",
+    "mxnet_resource_device_bytes",
 )
 
 _SAMPLE_RE = re.compile(
@@ -122,6 +125,17 @@ def main():
               f"({step['steps']} steps)")
         if lane_cover < 0.9:
             _fail(f"step lanes cover only {lane_cover:.1%} of wall time")
+
+        # -- resource observatory (ISSUE 13): the fused fit registered
+        # its carry footprint and the serving burst its executors -----
+        res = snap.get("resources", {})
+        owners = res.get("device", {}).get("owners", {})
+        if owners.get("fused_step", {}).get("params", 0) <= 0:
+            _fail(f"fused step registered no param footprint: {owners}")
+        if not any("executor_cache" in kinds for kinds in owners.values()):
+            _fail(f"executor cache registered no footprint: {owners}")
+        if res.get("host", {}).get("rss_bytes", 0) <= 0:
+            _fail(f"host sampler produced no RSS sample: {res.get('host')}")
 
         # -- trace exemplars (ISSUE 12): every served request traced,
         # stage spans covering >=95% of the measured e2e latency --------

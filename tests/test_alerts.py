@@ -1,0 +1,612 @@
+"""Alert engine + resource observatory tests (ISSUE 13).
+
+Covers the satellite test contract: the alert state machine
+(pending -> firing -> resolved with for-duration hysteresis and
+per-rule cooldown) on synthetic registry series, rate and burn-rate
+windows, absence rules, the leak-slope estimator on synthetic RSS
+series, the device-buffer ledger (train-step build registration +
+executor-cache insert/evict accounting), transitions landing in the
+flight ring / a postmortem-shaped dump, the leader's fleet rollup
+tagging a lost rank's stale alerts, and the /healthz + /alerts.json
+exporter surfaces — plus the acceptance gate: the DEFAULT rule pack
+evaluated live against a chaos-injected fault mix drives three
+distinct rules through the full lifecycle.
+"""
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import telemetry
+from mxnet_tpu.telemetry import alerts, fleet, flight, resources
+from mxnet_tpu.telemetry.alerts import AlertEngine, AlertRule
+
+
+class Series:
+    """A scriptable sample source: tests poke ``vals`` between ticks."""
+
+    def __init__(self, **families):
+        self.vals = dict(families)
+
+    def __call__(self, families):
+        out = {}
+        for fam in families:
+            if fam in self.vals:
+                v = self.vals[fam]
+                out[fam] = v if isinstance(v, list) else [({}, float(v))]
+        return out
+
+
+@pytest.fixture(autouse=True)
+def _fresh_alerts():
+    alerts._reset_for_tests()
+    yield
+    alerts._reset_for_tests()
+
+
+# -- state machine ------------------------------------------------------------
+def test_threshold_lifecycle_pending_firing_resolved_hysteresis():
+    src = Series(x=0)
+    rule = AlertRule("t", "x", op=">", value=5, for_s=3.0,
+                     cooldown_s=10.0, severity="page")
+    eng = AlertEngine(rules=[rule], sampler=src)
+    eng.tick(now=0.0)
+    assert eng.state("t")["state"] == "inactive"
+    src.vals["x"] = 9
+    eng.tick(now=1.0)
+    assert eng.state("t")["state"] == "pending"  # hysteresis holds
+    eng.tick(now=2.0)
+    assert eng.state("t")["state"] == "pending"
+    eng.tick(now=4.5)  # held >= for_s
+    assert eng.state("t")["state"] == "firing"
+    assert eng.firing() == ["t"] and eng.firing("page") == ["t"]
+    src.vals["x"] = 1
+    eng.tick(now=5.0)
+    assert eng.state("t")["state"] == "resolved"
+    assert eng.firing() == []
+    trans = [(t["from"], t["to"]) for t in eng.transitions("t")]
+    assert trans == [("inactive", "pending"), ("pending", "firing"),
+                     ("firing", "resolved")]
+
+
+def test_pending_cancelled_when_condition_clears_before_for_s():
+    src = Series(x=9)
+    eng = AlertEngine(rules=[AlertRule("t", "x", op=">", value=5,
+                                       for_s=5.0)], sampler=src)
+    eng.tick(now=0.0)
+    assert eng.state("t")["state"] == "pending"
+    src.vals["x"] = 0
+    eng.tick(now=1.0)
+    assert eng.state("t")["state"] == "inactive"
+    assert eng.state("t")["fired_total"] == 0
+
+
+def test_cooldown_suppresses_refire_then_allows():
+    src = Series(x=9)
+    rule = AlertRule("t", "x", op=">", value=5, for_s=0.0, cooldown_s=20.0)
+    eng = AlertEngine(rules=[rule], sampler=src)
+    eng.tick(now=0.0)
+    assert eng.state("t")["state"] == "firing"
+    src.vals["x"] = 0
+    eng.tick(now=1.0)
+    assert eng.state("t")["state"] == "resolved"
+    # condition returns INSIDE the cooldown: suppressed
+    src.vals["x"] = 9
+    eng.tick(now=5.0)
+    assert eng.state("t")["state"] == "resolved"
+    assert eng.state("t")["fired_total"] == 1
+    # past the cooldown: re-fires
+    eng.tick(now=25.0)
+    assert eng.state("t")["state"] == "firing"
+    assert eng.state("t")["fired_total"] == 2
+
+
+def test_resolved_decays_to_inactive_after_cooldown():
+    src = Series(x=9)
+    eng = AlertEngine(rules=[AlertRule("t", "x", op=">", value=5,
+                                       for_s=0.0, cooldown_s=5.0)],
+                      sampler=src)
+    eng.tick(now=0.0)
+    src.vals["x"] = 0
+    eng.tick(now=1.0)
+    assert eng.state("t")["state"] == "resolved"
+    eng.tick(now=7.0)
+    assert eng.state("t")["state"] == "inactive"
+
+
+def test_rate_rule_on_synthetic_counter_series():
+    src = Series(c=0)
+    rule = AlertRule("r", "c", kind="rate", op=">", value=2.0,
+                     window_s=10.0, for_s=0.0, cooldown_s=0.0)
+    eng = AlertEngine(rules=[rule], sampler=src)
+    eng.tick(now=0.0)          # one point: no rate yet
+    assert eng.state("r")["state"] == "inactive"
+    src.vals["c"] = 10
+    eng.tick(now=2.0)          # 10/2s = 5/s > 2
+    assert eng.state("r")["state"] == "firing"
+    assert eng.state("r")["value"] == pytest.approx(5.0)
+    # counter stops moving; the window slides past the burst
+    eng.tick(now=20.0)
+    assert eng.state("r")["state"] == "resolved"
+
+
+def test_absence_rule_fires_when_family_disappears():
+    src = Series(hb=1)
+    rule = AlertRule("a", "hb", kind="absence", for_s=3.0, cooldown_s=0.0)
+    eng = AlertEngine(rules=[rule], sampler=src)
+    eng.tick(now=0.0)
+    assert eng.state("a")["state"] == "inactive"
+    del src.vals["hb"]
+    eng.tick(now=1.0)
+    assert eng.state("a")["state"] == "pending"
+    eng.tick(now=4.5)
+    assert eng.state("a")["state"] == "firing"
+    src.vals["hb"] = 1
+    eng.tick(now=5.0)
+    assert eng.state("a")["state"] == "resolved"
+
+
+def test_burn_rate_needs_both_windows():
+    # SLO objective: 5% sheds; factor 2 => burn fires only when the
+    # bad/total ratio exceeds 10% in BOTH the 10s fast and 60s slow
+    # windows.  A fast-only burst must NOT fire.
+    src = Series(bad=0, total=0)
+    rule = AlertRule("b", "bad", kind="burn_rate", total_family="total",
+                     objective=0.05, factor=2.0, fast_s=10.0, slow_s=60.0,
+                     for_s=0.0, cooldown_s=0.0)
+    eng = AlertEngine(rules=[rule], sampler=src)
+    # one minute of healthy traffic: 100 req / 1 bad per 5s tick
+    for i in range(13):
+        src.vals["total"] = 100 * (i + 1)
+        src.vals["bad"] = 1 * (i + 1)
+        eng.tick(now=5.0 * i)
+    assert eng.state("b")["state"] == "inactive"
+    # a SHORT shed burst: 30% bad over the fast window only — the slow
+    # window still dilutes it below 2x budget
+    src.vals["total"] += 100
+    src.vals["bad"] += 30
+    eng.tick(now=70.0)
+    assert eng.state("b")["state"] == "inactive"
+    # sustained burn: every subsequent window sheds 30% — both windows
+    # exceed 2x the budget and the rule fires
+    for i in range(12):
+        src.vals["total"] += 100
+        src.vals["bad"] += 30
+        eng.tick(now=75.0 + 5.0 * i)
+    assert eng.state("b")["state"] == "firing"
+    assert eng.state("b")["value"] >= 2.0  # burn multiple, not a count
+
+
+def test_labels_filter_and_reduce():
+    src = Series(x=[({"model": "a"}, 3.0), ({"model": "b"}, 9.0)])
+    eng = AlertEngine(
+        rules=[AlertRule("a_only", "x", op=">", value=5, for_s=0.0,
+                         labels={"model": "a"}),
+               AlertRule("summed", "x", op=">", value=10, for_s=0.0)],
+        sampler=src)
+    eng.tick(now=0.0)
+    assert eng.state("a_only")["state"] == "inactive"  # 3 < 5
+    assert eng.state("summed")["state"] == "firing"    # 3+9 > 10
+
+
+def test_rule_spec_parsing_and_validation():
+    rules = alerts.parse_rules(
+        "hot=my_family>5:for=2:cooldown=9:severity=page;"
+        "cold=other<1:kind=rate:window=30:reduce=max")
+    assert len(rules) == 2
+    assert rules[0].name == "hot" and rules[0].severity == "page"
+    assert rules[0].for_s == 2.0 and rules[0].cooldown_s == 9.0
+    assert rules[1].op == "<" and rules[1].kind == "rate"
+    assert rules[1].window_s == 30.0 and rules[1].reduce == "max"
+    from mxnet_tpu.base import MXNetError
+    with pytest.raises(MXNetError):
+        alerts.parse_rules("bad=no_bound_here")
+    with pytest.raises(MXNetError):
+        alerts.parse_rules("bad=f>1:wat=2")
+    with pytest.raises(MXNetError):
+        AlertRule("x", "f", kind="burn_rate")  # no total_family
+    with pytest.raises(MXNetError):
+        AlertRule("x", "f", severity="critical")
+
+
+def test_disabled_module_tick_is_noop():
+    assert not alerts.enabled()
+    assert alerts.tick() == 0
+    assert alerts.firing() == []
+    assert alerts.firing_pages() == []
+
+
+# -- leak-slope estimator ------------------------------------------------------
+def test_leak_slope_positive_and_negative_synthetic_series():
+    up = [(float(t), 1e8 + 4e6 * t) for t in range(20)]
+    flat = [(float(t), 1e8 + ((-1) ** t) * 1e4) for t in range(20)]
+    down = [(float(t), 1e8 - 2e6 * t) for t in range(20)]
+    assert resources.slope_bytes_per_s(up) == pytest.approx(4e6)
+    assert abs(resources.slope_bytes_per_s(flat)) < 1e4
+    assert resources.slope_bytes_per_s(down) == pytest.approx(-2e6)
+    # degenerate inputs never fabricate a leak
+    assert resources.slope_bytes_per_s([]) == 0.0
+    assert resources.slope_bytes_per_s([(0, 1), (0, 2)]) == 0.0
+    assert resources.slope_bytes_per_s([(0, 1), (0, 2), (0, 3)]) == 0.0
+
+
+def test_sampler_window_slope_via_synthetic_samples():
+    s = resources.HostSampler()
+    for t in range(10):
+        s.sample_now(rss=int(1e8 + 3e6 * t), t=float(t), disk=False)
+    assert s.leak_slope() == pytest.approx(3e6)
+    s.reset()
+    assert s.leak_slope() == 0.0
+
+
+def test_rss_slope_rule_on_synthetic_rss_series():
+    s = resources.HostSampler()
+    src = Series()
+    src.vals["mxnet_resource_rss_slope_bytes_per_s"] = 0.0
+
+    def probe_sampler(families):
+        return {"mxnet_resource_rss_slope_bytes_per_s":
+                [({}, s.leak_slope())]}
+
+    rule = [r for r in alerts.default_rules() if r.name == "rss_slope"][0]
+    eng = AlertEngine(rules=[rule], sampler=probe_sampler)
+    for t in range(5):
+        s.sample_now(rss=int(1e8 + 1e5 * t), t=float(t), disk=False)
+    eng.tick(now=0.0)
+    assert eng.state("rss_slope")["state"] == "inactive"  # 100 KB/s
+    s.reset()
+    for t in range(5):  # 16 MB/s — a leak
+        s.sample_now(rss=int(1e8 + 1.6e7 * t), t=float(t), disk=False)
+    eng.tick(now=1.0)
+    assert eng.state("rss_slope")["state"] == "pending"
+    eng.tick(now=1.0 + rule.for_s + 0.1)
+    assert eng.state("rss_slope")["state"] == "firing"
+
+
+# -- device-buffer ledger ------------------------------------------------------
+def test_pytree_nbytes_shape_math():
+    tree = {"a": np.zeros((4, 8), np.float32),
+            "b": [np.zeros((3,), np.float64),
+                  (np.zeros((2, 2), np.int8), None)],
+            "c": "not-an-array"}
+    assert resources.pytree_nbytes(tree) == 4 * 8 * 4 + 3 * 8 + 4
+    assert resources.nbytes(np.zeros((5,), np.float16)) == 10
+
+
+def test_device_ledger_set_add_release_floor():
+    led = resources.DeviceLedger()
+    led.set("fused_step", "params", 1000)
+    led.add("m", "executor_cache", 600)
+    led.add("m", "executor_cache", 400)
+    assert led.total() == 2000
+    led.release("m", "executor_cache", 700)
+    assert led.snapshot()["owners"]["m"]["executor_cache"] == 300
+    led.release("m", "executor_cache", 9999)  # floor at zero
+    assert led.snapshot()["owners"]["m"]["executor_cache"] == 0
+    led.note_hbm_estimate("m", {"arguments": 10, "temp": 5})
+    snap = led.snapshot()
+    assert snap["hbm_estimates"]["m"] == {"arguments": 10, "temp": 5}
+    fams = {s[0] for s in led.samples()}
+    assert {"mxnet_resource_device_bytes",
+            "mxnet_resource_device_total_bytes",
+            "mxnet_resource_hbm_estimate_bytes"} <= fams
+
+
+def test_fused_step_build_registers_carry_footprint():
+    import mxnet_tpu as mx
+    from mxnet_tpu import io as mxio
+    os.environ["MXNET_FUSED_STEP"] = "1"
+    try:
+        d = mx.sym.Variable("data")
+        h = mx.sym.FullyConnected(d, num_hidden=8, name="fc1")
+        sym = mx.sym.SoftmaxOutput(h, name="softmax")
+        x = np.random.randn(8, 6).astype(np.float32)
+        y = np.random.randint(0, 8, 8).astype(np.float32)
+        it = mxio.NDArrayIter(mx.nd.array(x), mx.nd.array(y), batch_size=8,
+                              label_name="softmax_label")
+        mod = mx.mod.Module(sym, context=mx.cpu())
+        mod.fit(it, num_epoch=1, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+        snap = resources.LEDGER.snapshot()["owners"].get("fused_step", {})
+        # fc1 weight (8x6 f32) + bias (8): params bytes exact
+        assert snap.get("params") == 8 * 6 * 4 + 8 * 4
+        # momentum state mirrors the params
+        assert snap.get("opt_state") == 8 * 6 * 4 + 8 * 4
+    finally:
+        os.environ.pop("MXNET_FUSED_STEP", None)
+
+
+def test_executor_cache_ledger_insert_and_evict():
+    from mxnet_tpu.serving.executor_cache import ExecutorCache
+
+    class FakeExec:
+        def __init__(self, n):
+            self.arg_dict = {"w": np.zeros((n,), np.float32)}
+            self.aux_dict = {}
+
+    led = resources.LEDGER
+    led.clear("fakemodel")
+    cache = ExecutorCache(capacity=2, name="ledger-test")
+    cache.get(("fakemodel", 1, "sig-a"), lambda: FakeExec(100))
+    cache.get(("fakemodel", 1, "sig-b"), lambda: FakeExec(50))
+    owners = led.snapshot()["owners"]
+    assert owners["fakemodel"]["executor_cache"] == 600  # (100+50)*4
+    # LRU eviction decrements by the evicted entry's recorded bytes
+    cache.get(("fakemodel", 2, "sig-c"), lambda: FakeExec(25))
+    assert led.snapshot()["owners"]["fakemodel"]["executor_cache"] == \
+        (50 + 25) * 4
+    # stale-version retirement releases everything not kept
+    cache.evict_stale_versions("fakemodel", keep_versions={2})
+    assert led.snapshot()["owners"]["fakemodel"]["executor_cache"] == 25 * 4
+    cache.evict_model(("fakemodel",))
+    assert led.snapshot()["owners"]["fakemodel"]["executor_cache"] == 0
+
+
+def test_resources_collector_in_snapshot_and_prometheus():
+    resources.sample_now(disk=False)
+    snap = telemetry.snapshot()["resources"]
+    assert snap["host"]["rss_bytes"] > 0
+    assert snap["host"]["threads"] >= 1
+    assert "rss_slope_bytes_per_s" in snap
+    json.dumps(telemetry.snapshot(), sort_keys=True)  # JSON-native
+    dump = telemetry.prometheus_dump()
+    for fam in ("mxnet_resource_rss_bytes", "mxnet_resource_open_fds",
+                "mxnet_resource_threads",
+                "mxnet_resource_rss_slope_bytes_per_s",
+                "mxnet_resource_device_total_bytes"):
+        assert f"# TYPE {fam} " in dump, fam
+
+
+# -- flight ring + postmortem bundle -------------------------------------------
+def test_transitions_land_in_flight_ring_and_postmortem(tmp_path):
+    flight.enable()
+    flight.clear()
+    src = Series(x=9)
+    rule = AlertRule("boom", "x", op=">", value=5, for_s=0.0,
+                     cooldown_s=0.0, severity="page")
+    eng = AlertEngine(rules=[rule], sampler=src)
+    eng.tick(now=0.0)
+    src.vals["x"] = 0
+    eng.tick(now=1.0)
+    evs = [e for e in flight.events() if e["category"] == "alert"]
+    assert [e["fields"]["to"] for e in evs] == \
+        ["pending", "firing", "resolved"]
+    firing_ev = [e for e in evs if e["fields"]["to"] == "firing"][0]
+    assert firing_ev["severity"] == "error"  # page rule
+    assert firing_ev["fields"]["rule"] == "boom"
+    # a postmortem-shaped bundle: dumped ring + first_anomaly points at
+    # the firing transition (the "start here" pointer)
+    path = flight.dump(path=str(tmp_path / "ring.json"), reason="test")
+    with open(path, encoding="utf-8") as f:
+        payload = json.load(f)
+    anomaly = flight.first_anomaly([payload])
+    assert anomaly is not None
+    assert anomaly["category"] == "alert"
+    assert anomaly["fields"]["to"] == "firing"
+
+
+# -- fleet rollup --------------------------------------------------------------
+def _alert_state_family(states):
+    values = []
+    for rule, state in states.items():
+        for s in alerts.STATES:
+            values.append({"labels": {"rule": rule, "state": s},
+                           "value": 1 if s == state else 0})
+    return {"type": "gauge", "values": values}
+
+
+def test_fleet_rollup_tags_lost_rank_stale_alerts():
+    ranks = {
+        "0": {"state": "alive",
+              "families": {"mxnet_alert_state":
+                           _alert_state_family({"rss_slope": "inactive",
+                                                "watchdog_stall":
+                                                    "firing"})}},
+        "1": {"state": "lost",
+              "families": {"mxnet_alert_state":
+                           _alert_state_family({"shed_burn_rate":
+                                                "firing"})}},
+        "2": {"state": "alive", "families": {}},  # no engine: absent
+    }
+    rollup = fleet.alert_rollup(ranks)
+    assert rollup["by_rank"]["0"]["stale"] is False
+    assert rollup["by_rank"]["0"]["rules"]["watchdog_stall"] == "firing"
+    assert rollup["by_rank"]["1"]["stale"] is True
+    assert rollup["by_rank"]["1"]["rank_state"] == "lost"
+    assert "2" not in rollup["by_rank"]
+    firing = {(f["rank"], f["rule"], f["stale"])
+              for f in rollup["firing"]}
+    assert firing == {("0", "watchdog_stall", False),
+                      ("1", "shed_burn_rate", True)}
+
+
+def test_alert_state_rides_sample_families_for_fleet_push():
+    src = Series(x=9)
+    eng = AlertEngine(rules=[AlertRule("ride", "x", op=">", value=5,
+                                       for_s=0.0)], sampler=src)
+    eng.tick(now=0.0)
+    fams = telemetry.REGISTRY.sample_families()
+    assert "mxnet_alert_state" in fams
+    one_hot = {(tuple(sorted(v["labels"].items()))): v["value"]
+               for v in fams["mxnet_alert_state"]["values"]}
+    assert one_hot[(("rule", "ride"), ("state", "firing"))] == 1
+    # the single-rank /fleet.json fallback carries the rollup too
+    doc = fleet.fleet_json()
+    assert doc["alerts"]["by_rank"]
+    rank = next(iter(doc["alerts"]["by_rank"]))
+    assert doc["alerts"]["by_rank"][rank]["rules"]["ride"] == "firing"
+
+
+# -- exporter surfaces ---------------------------------------------------------
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def test_healthz_folds_firing_page_alerts_not_warn(monkeypatch):
+    from mxnet_tpu.telemetry.exporter import start_exporter, stop_exporter
+    src = Series(p=0, w=9)
+    eng = AlertEngine(
+        rules=[AlertRule("page_rule", "p", op=">", value=5, for_s=0.0,
+                         cooldown_s=0.0, severity="page"),
+               AlertRule("warn_rule", "w", op=">", value=5, for_s=0.0,
+                         cooldown_s=0.0, severity="warn")],
+        sampler=src)
+    alerts.set_engine(eng)
+    monkeypatch.setattr(alerts, "_armed", True)
+    port = start_exporter(0)
+    try:
+        eng.tick(now=0.0)
+        assert eng.firing() == ["warn_rule"]
+        # warn-severity firing stays OUT of the readiness verdict
+        code, body = _get(port, "/healthz")
+        assert code == 200 and body.strip() == "ok"
+        # a page-severity fire flips readiness, body names the rule
+        src.vals["p"] = 9
+        eng.tick(now=1.0)
+        code, body = _get(port, "/healthz")
+        assert code == 503
+        assert "alert: page_rule" in body
+        # resolution restores readiness
+        src.vals["p"] = 0
+        eng.tick(now=2.0)
+        code, body = _get(port, "/healthz")
+        assert code == 200 and body.strip() == "ok"
+    finally:
+        stop_exporter()
+
+
+def test_alerts_json_endpoint_serves_engine_state(monkeypatch):
+    from mxnet_tpu.telemetry.exporter import start_exporter, stop_exporter
+    src = Series(x=9)
+    eng = AlertEngine(rules=[AlertRule("ep", "x", op=">", value=5,
+                                       for_s=0.0, severity="page")],
+                      sampler=src)
+    alerts.set_engine(eng)
+    monkeypatch.setattr(alerts, "_armed", True)
+    port = start_exporter(0)
+    try:
+        eng.tick(now=0.0)
+        code, body = _get(port, "/alerts.json")
+        assert code == 200
+        doc = json.loads(body)
+        assert doc["enabled"] is True
+        assert doc["firing"] == ["ep"] and doc["pages"] == ["ep"]
+        (rule,) = doc["rules"]
+        assert rule["state"] == "firing" and rule["fired_total"] == 1
+        assert [t["to"] for t in rule["recent"]] == ["pending", "firing"]
+    finally:
+        stop_exporter()
+
+
+# -- acceptance: the DEFAULT pack under a chaos-injected fault mix -------------
+@pytest.mark.slow
+def test_default_pack_lifecycle_under_chaos_fault_mix(monkeypatch,
+                                                      tmp_path):
+    """Acceptance gate (ISSUE 13): a chaos fault mix (wedge -> watchdog
+    stall, corrupt checkpoint, spill storm) drives >= 3 distinct DEFAULT
+    rules through pending -> firing -> resolved, with the transitions
+    visible in /alerts.json, the flight ring, and the fleet rollup."""
+    import mxnet_tpu.chaos.failpoints as chaos
+    from mxnet_tpu.serving.batcher import DynamicBatcher
+    from mxnet_tpu.telemetry import watchdog as wd
+    from mxnet_tpu.telemetry.exporter import start_exporter, stop_exporter
+
+    flight.enable()
+    flight.clear()
+    eng = AlertEngine()  # the DEFAULT rule pack, real registry sampler
+    alerts.set_engine(eng)
+    monkeypatch.setattr(alerts, "_armed", True)
+    monkeypatch.setenv("MXNET_WATCHDOG_S", "0.4")
+    monkeypatch.setenv("MXNET_WATCHDOG_DIR", str(tmp_path))
+    port = start_exporter(0)
+    chaos.reset()
+    b = None
+    now = [0.0]
+
+    def tick(dt=1.0):
+        now[0] += dt
+        eng.tick(now=now[0])
+
+    try:
+        # --- fault 1: wedge -> watchdog stall (page) --------------------
+        chaos.arm("serving/batcher/worker", "wedge", hits=1, count=1)
+        b = DynamicBatcher(lambda feed, n: [feed["x"] * 2.0],
+                           max_batch_size=4, max_latency_ms=1.0,
+                           num_workers=1, name="alerts-wedge")
+        fut = b.submit({"x": np.ones((4,), np.float32)})
+        deadline = time.time() + 15
+        while not wd.stalled_sections() and time.time() < deadline:
+            time.sleep(0.05)
+        assert wd.stalled_sections(), "watchdog never entered a stall"
+        tick()
+        assert eng.state("watchdog_stall")["state"] == "firing"
+        # --- fault 2: corrupt checkpoint detected (page) ----------------
+        corrupt = telemetry.REGISTRY.counter(
+            "mxnet_serving_corrupt_ckpt_total")
+        tick()  # anchor the rate window before the fault
+        corrupt.inc(labels={"model": "m"})
+        tick()
+        assert eng.state("corrupt_checkpoint")["state"] == "firing"
+        # --- fault 3: spill storm (warn) --------------------------------
+        spill = telemetry.REGISTRY.counter(
+            "mxnet_serving_router_spill_total")
+        for _ in range(6):
+            spill.inc(5, labels={"model": "m"})
+            tick()  # 5 spills/s sustained > 1/s, held past for_s
+        assert eng.state("spill_storm")["state"] == "firing"
+
+        # firing states visible in /alerts.json and the fleet rollup
+        code, body = _get(port, "/alerts.json")
+        doc = json.loads(body)
+        assert code == 200
+        assert {"watchdog_stall", "corrupt_checkpoint",
+                "spill_storm"} <= set(doc["firing"])
+        assert {"watchdog_stall", "corrupt_checkpoint"} <= \
+            set(doc["pages"])
+        rollup = fleet.fleet_json()["alerts"]
+        rank_rules = next(iter(rollup["by_rank"].values()))["rules"]
+        assert rank_rules["watchdog_stall"] == "firing"
+        assert rank_rules["spill_storm"] == "firing"
+        code, _body = _get(port, "/healthz")
+        assert code == 503  # page-severity alerts hold readiness down
+
+        # --- recovery: all three resolve --------------------------------
+        chaos.release("serving/batcher/worker")
+        fut.result(15.0)
+        deadline = time.time() + 15
+        while wd.stalled_sections() and time.time() < deadline:
+            b.submit({"x": np.ones((4,), np.float32)}).result(10.0)
+            time.sleep(0.05)
+        assert not wd.stalled_sections()
+        tick(dt=120.0)  # slide the rate windows past both bursts
+        for name in ("watchdog_stall", "corrupt_checkpoint",
+                     "spill_storm"):
+            assert eng.state(name)["state"] == "resolved", name
+            trans = [(t["from"], t["to"])
+                     for t in eng.transitions(name)]
+            assert ("inactive", "pending") in trans
+            assert ("pending", "firing") in trans
+            assert ("firing", "resolved") in trans
+        # transitions in the flight ring, per rule
+        ring_rules = {e["fields"]["rule"]: e
+                      for e in flight.events()
+                      if e["category"] == "alert"
+                      and e["fields"]["to"] == "firing"}
+        assert {"watchdog_stall", "corrupt_checkpoint",
+                "spill_storm"} <= set(ring_rules)
+        code, _body = _get(port, "/healthz")
+        assert code == 200
+    finally:
+        chaos.reset()
+        if b is not None:
+            b.close(timeout=5.0)
+        stop_exporter()

@@ -588,3 +588,23 @@ def test_scenario_peer_loss_mid_window(tmp_path):
     assert r["survivor_world"] == 1
     assert r["recovery_s"] is not None and r["recovery_s"] < 60
     assert r["diverged_params"] == []
+
+
+@pytest.mark.slow
+def test_soak_short_window_quiet(tmp_path):
+    """ISSUE 13 (ROADMAP 5b): a short soak — train windows, checkpoint
+    commits, serving hot-reload, Poisson traffic, the seeded benign
+    chaos mix — must end with ZERO firing alerts, zero page-severity
+    fires, a bounded RSS leak slope, a silent watchdog, and parsing
+    /alerts.json + /fleet.json scrapes (the ci phase runs 90 s; this
+    pins the harness mechanics at a CI-affordable length)."""
+    from mxnet_tpu.chaos import soak
+
+    r = soak.run(seconds=10.0, verbose=False)
+    assert r["ok"], json.dumps(r, default=str)
+    assert r["firing"] == [] and r["page_fires"] == {}
+    assert r["served"] > 0 and r["non_shed_failures"] == []
+    assert r["commits"] >= 2 and r["reloads"] >= 1
+    assert abs(r["rss_slope_bytes_per_s"]) <= r["rss_slope_max"]
+    assert r["watchdog_fires"] == 0
+    assert r["alerts_scrape_ok"] and r["fleet_scrape_ok"]

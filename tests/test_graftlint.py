@@ -890,3 +890,110 @@ def test_metric_cardinality_suppression():
     hits = [f for f in findings if f.rule == "metric-cardinality"]
     assert len(hits) == 2  # only the suppressed exception-label is gone
     assert {f.symbol.split(":")[1] for f in hits} == {"req", "file"}
+
+
+# -- leaked-thread ------------------------------------------------------------
+LEAKED_THREAD = """
+    import threading
+
+    class Poller:
+        def start(self):
+            self._thread = threading.Thread(target=self._loop)
+            self._thread.start()
+
+        def _loop(self):
+            pass
+"""
+
+
+def test_leaked_thread_flags_unjoined_non_daemon():
+    findings = lint(LEAKED_THREAD, path="mxnet_tpu/telemetry/fake.py")
+    hits = [f for f in findings if f.rule == "leaked-thread"]
+    assert len(hits) == 1, findings
+    assert hits[0].symbol == "start:_thread"
+    assert "daemon" in hits[0].message
+
+
+def test_leaked_thread_flags_fire_and_forget():
+    src = """
+        import threading
+
+        def kick(server):
+            threading.Thread(target=server.run).start()
+    """
+    findings = lint(src, path="mxnet_tpu/chaos/fake.py")
+    hits = [f for f in findings if f.rule == "leaked-thread"]
+    assert len(hits) == 1
+    assert hits[0].symbol == "kick:<unnamed>"
+
+
+def test_leaked_thread_near_miss_daemon():
+    # daemon=True (or ANY explicit daemon decision) is the reviewed form
+    src = LEAKED_THREAD.replace("target=self._loop)",
+                                "target=self._loop, daemon=True)")
+    assert "leaked-thread" not in rules_hit(
+        lint(src, path="mxnet_tpu/telemetry/fake.py"))
+
+
+def test_leaked_thread_near_miss_joined_lifecycle():
+    # a join WITH a timeout reachable from close() bounds the lifecycle
+    src = LEAKED_THREAD + """
+    def _close(self):
+        self._thread.join(timeout=5)
+"""
+    assert "leaked-thread" not in rules_hit(
+        lint(src, path="mxnet_tpu/serving/fake.py"))
+
+
+def test_leaked_thread_near_miss_worker_pool_loop_join():
+    # a pool appended/collected into a list and joined via the loop
+    # variable is an explicit lifecycle, not a leak
+    src = """
+        import threading
+
+        class Pool:
+            def start(self, n):
+                self._workers = []
+                for i in range(n):
+                    self._workers.append(
+                        threading.Thread(target=self._run))
+                clients = [threading.Thread(target=self._run)
+                           for _ in range(n)]
+                self._clients = clients
+
+            def close(self):
+                for t in self._workers:
+                    t.join(timeout=5)
+                for t in self._clients:
+                    t.join(5)
+    """
+    assert "leaked-thread" not in rules_hit(
+        lint(src, path="mxnet_tpu/serving/fake.py"))
+
+
+def test_leaked_thread_silent_outside_long_running_modules():
+    # test helpers / offline tooling may leak to their heart's content
+    assert "leaked-thread" not in rules_hit(
+        lint(LEAKED_THREAD, path="tools/report.py"))
+    assert "leaked-thread" not in rules_hit(
+        lint(LEAKED_THREAD, path="tests/test_fake.py"))
+
+
+def test_leaked_thread_join_without_timeout_still_flags():
+    # an UNBOUNDED join does not excuse the leak (and is itself the
+    # unbounded-wait rule's business)
+    src = LEAKED_THREAD + """
+    def _close(self):
+        self._thread.join()
+"""
+    findings = lint(src, path="mxnet_tpu/checkpoint/fake.py")
+    assert "leaked-thread" in rules_hit(findings)
+
+
+def test_leaked_thread_suppression():
+    src = LEAKED_THREAD.replace(
+        "self._thread = threading.Thread(target=self._loop)",
+        "self._thread = threading.Thread(target=self._loop)  "
+        "# graftlint: disable=leaked-thread -- joined by the caller")
+    assert "leaked-thread" not in rules_hit(
+        lint(src, path="mxnet_tpu/telemetry/fake.py"))
