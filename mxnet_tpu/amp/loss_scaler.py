@@ -4,10 +4,20 @@ With bfloat16 (TPU default) scaling is rarely needed — bf16 shares fp32's
 exponent range — but the capability is kept for fp16 workflows and API
 parity: multiply the loss up, check gradients for inf/nan, halve the scale
 on overflow, double it after a streak of clean steps.
+
+The overflow check shares the numerics observatory's fused sentinel
+(ISSUE 14 satellite): :meth:`has_overflow` delegates to
+``telemetry.numerics.host_all_finite`` — ONE jitted multi-all-finite
+reduction + one host sync, the same idiom the in-window non-finite flag
+uses — instead of building its own per-array ``isfinite().all()`` list
+every step.  When a numerics-armed train step already computed the
+per-step flags inside its donated window, attach the scaler
+(``telemetry.numerics.attach_loss_scaler``) and the boundary check feeds
+its backoff/growth directly — no separate device sync at all.  The
+backoff/growth sequence is unchanged either way (parity-tested in
+tests/test_amp.py).
 """
 from __future__ import annotations
-
-import numpy as np
 
 
 class LossScaler:
@@ -19,15 +29,11 @@ class LossScaler:
         self._unskipped = 0
 
     def has_overflow(self, grads):
-        """True if any gradient array contains inf/nan.  All per-array
-        checks reduce into ONE scalar before the single host sync
-        (reference: fused multi_all_finite op)."""
-        import jax.numpy as jnp
-        checks = [jnp.isfinite(g._data if hasattr(g, "_data") else g).all()
-                  for g in grads if g is not None]
-        if not checks:
-            return False
-        return not bool(jnp.stack(checks).all())
+        """True if any gradient array contains inf/nan — one fused
+        device reduction + one host sync via the shared numerics
+        sentinel (reference: fused multi_all_finite op)."""
+        from ..telemetry import numerics as _numerics
+        return not _numerics.host_all_finite(grads)
 
     def update_scale(self, overflow):
         if overflow:
@@ -39,3 +45,11 @@ class LossScaler:
             self.loss_scale = min(self.loss_scale * self._scale_factor,
                                   2. ** 24)
             self._unskipped = 0
+
+    def update_from_window(self, overflow_flags):
+        """Feed one window's per-step overflow verdicts (the in-window
+        non-finite flags a numerics-armed train step already computed)
+        — the same backoff/growth sequence as ``scale_window`` many
+        ``update_scale`` calls, with zero extra device syncs."""
+        for flag in overflow_flags:
+            self.update_scale(bool(flag))

@@ -50,6 +50,38 @@ class PreemptionError(MXNetError):
     retryable = False
 
 
+class NonFiniteError(MXNetError):
+    """The numerics observatory detected non-finite values (NaN/Inf).
+
+    Raised at a train-window boundary under ``MXNET_NUMERICS=halt``
+    (the poisoned update was already applied — restore from
+    ``dump_path``'s ``last_good_checkpoint_step`` and replay), and by
+    the serving output-health guard when a model produces non-finite
+    logits (that request fails typed; it is never served).  Not
+    retryable: resubmitting the same computation reproduces the same
+    poison (docs/observability.md numerics runbook).
+    """
+
+    retryable = False
+
+    def __init__(self, where, step=None, stat=None, value=None,
+                 dump_path=None, detail=""):
+        self.where = where
+        self.step = step
+        self.stat = stat
+        self.value = value
+        self.dump_path = dump_path
+        msg = f"non-finite values detected in {where}"
+        if stat is not None:
+            msg += f" ({stat}={value!r}"
+            msg += f" at step {step})" if step is not None else ")"
+        if detail:
+            msg += f": {detail}"
+        if dump_path:
+            msg += f" — forensics: {dump_path}"
+        super().__init__(msg)
+
+
 # TPU integer-width contract -------------------------------------------------
 # The backend narrows int64 to int32 (TPU integer units are 32-bit; the
 # reference builds with int64 tensor indexing, tests/nightly/

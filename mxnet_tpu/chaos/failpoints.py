@@ -119,6 +119,12 @@ SITES = {
     "train/scan_window":
         "Module scanned fit, at each window boundary before the scan "
         "dispatch (kill here is the SIGKILL-mid-window scenario)",
+    "train/poison_grad":
+        "numerics observatory injection: a raise arm poisons THIS "
+        "window's gradients with NaN inside the donated trace (raise "
+        "with value 'inf' injects Inf) — armed only while "
+        "MXNET_NUMERICS watches, proving non-finite detection, the "
+        "nonfinite_window alert, and the forensic dump end to end",
     "parallel/collective":
         "mesh fused train step, at the host-side window boundary before "
         "the donated shard_map dispatch (delay/wedge stalls the mesh "

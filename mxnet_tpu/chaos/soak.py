@@ -26,6 +26,11 @@ quiet:
   (no leak-slope page, no watchdog, no shed burn);
 * **RSS leak slope** below ``MXNET_SOAK_RSS_SLOPE_MAX`` (the
   least-squares estimator over the whole measured window);
+* **numerics quiet** (ISSUE 14): the observatory runs armed
+  (``MXNET_NUMERICS=warn``) through every train window — the soak
+  passes only with zero non-finite windows and bounded grad-norm drift
+  (max over the run within 50x the median: a slow exploding-gradient
+  ratchet fails the soak before it ever reaches NaN);
 * the watchdog never fired, no non-shed request failures;
 * a final ``/alerts.json`` + ``/fleet.json`` + ``/healthz`` scrape
   parses (200).
@@ -109,7 +114,7 @@ def run(seconds=None, qps=None, chaos_on=None, rss_slope_max=None,
     from ..checkpoint import CheckpointManager
     from ..serving.batcher import (RequestTimeoutError,
                                    ServingOverloadError)
-    from ..telemetry import alerts, resources
+    from ..telemetry import alerts, numerics, resources
     from ..telemetry import watchdog as wd
 
     seconds = float(_config.get("MXNET_SOAK_SECONDS")
@@ -127,6 +132,12 @@ def run(seconds=None, qps=None, chaos_on=None, rss_slope_max=None,
     watchdog_was = os.environ.get("MXNET_WATCHDOG_S")
     os.environ.setdefault("MXNET_WATCHDOG_S", "30")
     fires0 = wd.fires()
+    # the numerics observatory runs ARMED through every train window
+    # (warn mode: detection without intervention) — the gate below
+    # requires zero non-finite windows and bounded grad-norm drift
+    numerics_was = os.environ.get("MXNET_NUMERICS")
+    os.environ.setdefault("MXNET_NUMERICS", "warn")
+    numerics.configure()
     chaos.reset()
 
     result = {"ok": False, "seconds": seconds, "qps": qps,
@@ -262,6 +273,24 @@ def run(seconds=None, qps=None, chaos_on=None, rss_slope_max=None,
         result["reloads"] = server.repository.latest_version("m") - 1
         result["watchdog_fires"] = wd.fires() - fires0
 
+        # numerics gate (ISSUE 14): every window stayed finite and the
+        # grad norm never drifted beyond 50x its run median
+        nsum = numerics.summary()
+        result["numerics_steps"] = nsum.get("steps", 0)
+        result["numerics_nonfinite_windows"] = nsum.get(
+            "nonfinite_windows", 0)
+        gn_max = nsum.get("grad_norm_max")
+        gn_med = nsum.get("grad_norm_median")
+        drift_ok = True
+        if gn_max is not None and gn_med is not None:
+            result["grad_norm_max"] = gn_max
+            result["grad_norm_median"] = gn_med
+            drift_ok = gn_max <= 50.0 * max(gn_med, 1e-9)
+        result["numerics_ok"] = bool(
+            result["numerics_steps"] > 0
+            and result["numerics_nonfinite_windows"] == 0
+            and drift_ok)
+
         code_a, body_a = _scrape(port, "/alerts.json")
         code_f, body_f = _scrape(port, "/fleet.json")
         code_h, _body_h = _scrape(port, "/healthz")
@@ -278,6 +307,7 @@ def run(seconds=None, qps=None, chaos_on=None, rss_slope_max=None,
             and not result["page_fires"]
             and abs(result["rss_slope_bytes_per_s"]) <= rss_slope_max
             and result["watchdog_fires"] == 0
+            and result["numerics_ok"]
             and not result["non_shed_failures"]
             and result["served"] > 0
             and result["commits"] >= 2
@@ -301,6 +331,11 @@ def run(seconds=None, qps=None, chaos_on=None, rss_slope_max=None,
             os.environ.pop("MXNET_WATCHDOG_S", None)
         else:
             os.environ["MXNET_WATCHDOG_S"] = watchdog_was
+        if numerics_was is None:
+            os.environ.pop("MXNET_NUMERICS", None)
+        else:
+            os.environ["MXNET_NUMERICS"] = numerics_was
+        numerics.configure()
         shutil.rmtree(workdir, ignore_errors=True)
     return result
 
@@ -330,7 +365,8 @@ def main(argv=None):
           f"{result['reloads']} hot-reloads, "
           f"rss slope {result['rss_slope_bytes_per_s']} B/s "
           f"(max {result['rss_slope_max']:.0f}), zero firing alerts, "
-          "watchdog silent, scrapes parsed")
+          f"numerics quiet ({result['numerics_steps']} steps, 0 "
+          "non-finite windows), watchdog silent, scrapes parsed")
 
 
 if __name__ == "__main__":

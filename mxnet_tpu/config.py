@@ -363,6 +363,39 @@ _register("MXNET_RESOURCE_SAMPLE_S", float, 0.0,
           "rule and the soak harness gate on); 0 disables the thread "
           "(the resources collector still takes one on-demand sample "
           "per scrape)")
+_register("MXNET_NUMERICS", str, "off",
+          "numerics observatory mode for train windows: 'off' (default; "
+          "the boundary check is one global read, < 1 us), 'warn' (log + "
+          "flight event + forensic dump on a non-finite or rule-breaching "
+          "window, training continues), 'skip' (additionally gate each "
+          "poisoned step's update on device — the dynamic loss-scaler "
+          "idiom, no extra sync — and continue bit-identically to a "
+          "manual skip), 'halt' (raise typed NonFiniteError at the "
+          "boundary).  Stats (grad/param norms, update ratio, loss "
+          "proxy, per-bucket non-finite counts) are computed INSIDE the "
+          "donated jit/shard_map window: dispatches/step unchanged, "
+          "weights bitwise-identical to off (docs/observability.md)")
+_register("MXNET_NUMERICS_GRAD_NORM_MAX", float, 0.0,
+          "numerics host-side rule: a window whose global gradient L2 "
+          "norm exceeds this is treated like a non-finite window "
+          "(warn/skip-record/halt per MXNET_NUMERICS); 0 disables the "
+          "rule (the grad_norm_explosion alert rate-rule still watches "
+          "the exported gauge)")
+_register("MXNET_NUMERICS_HISTORY", int, 512,
+          "numerics observatory: per-step stat entries kept in the "
+          "in-process history ring (forensic dumps embed it; "
+          "numerics.monitor_summary() reads it)")
+_register("MXNET_NUMERICS_DUMP_DIR", str, "",
+          "directory for mxnet-numerics-<pid>-<n>.json forensic dumps "
+          "(empty = MXNET_FLIGHT_DIR, then MXNET_WATCHDOG_DIR, then "
+          "cwd); retention shared with MXNET_WATCHDOG_KEEP")
+_register("MXNET_NUMERICS_SERVING", bool, True,
+          "serving output-health guard: screen each executed batch's "
+          "float outputs and fail requests whose rows contain NaN/Inf "
+          "with typed NonFiniteError (bumping "
+          "mxnet_numerics_serving_nonfinite_total) instead of serving "
+          "them; healthy cohort members still resolve.  0 disables the "
+          "screen")
 _register("MXNET_FLEET_INTERVAL_S", float, 0.0,
           "cross-rank telemetry aggregation: every rank pushes its "
           "registry snapshot to the control-plane kvstore server this "
@@ -574,6 +607,11 @@ _register("BENCH_ALERTS", bool, True,
           "(alert_tick_overhead_us) and one host resource sample "
           "(resource_sample_overhead_us), both gated < 1 ms, plus the "
           "engine-disabled tick gated < 1 us like span/trace/failpoint")
+_register("BENCH_NUMERICS", bool, True,
+          "bench.py: also measure the numerics observatory — armed "
+          "K=8 scanned-window overhead vs off (< 5% step wall, "
+          "dispatches/step unchanged) and the disabled boundary-check "
+          "path (< 1 us, the span/trace/failpoint bar)")
 _register("BENCH_COLD_START", bool, True,
           "bench.py: also measure cold_start_first_request_ms — warm "
           "restart (persistent compile cache) vs cold cache dir, in "

@@ -52,6 +52,7 @@ from . import random as _random
 from . import telemetry as _telemetry
 from .base import MXNetError
 from .ndarray import NDArray
+from .telemetry import numerics as _numerics
 
 log = logging.getLogger(__name__)
 
@@ -98,7 +99,39 @@ class FusedTrainStep:
         self._jit = None
         self._trace_count = 0  # bumped at trace time; tests assert == 1
         self._just_built = False  # next dispatch carries the compile
+        # numerics observatory (ISSUE 14): mode + stat bucket plan are
+        # baked into the trace signature — arming retraces, never drifts
+        self._num_mode = "off"
+        self._num_poison = False
+        self._num_groups = []
+        self._num_labels = []
         self.steps = 0
+
+    def _numerics_plan(self):
+        """Freeze the observatory mode + stat buckets for the next
+        trace (dtype-contiguous parameter groups, same rule as the
+        collective planner, so a poisoned bucket names a model region).
+        The poison-injection multiply is baked in only while the chaos
+        ``train/poison_grad`` site is armed."""
+        exec_ = self._module._exec
+        self._num_mode = _numerics.trace_mode()
+        self._num_poison = False
+        if self._num_mode == "off":
+            self._num_groups, self._num_labels = [], []
+            return
+        self._num_poison = _numerics.poison_armed()
+        shapes = [tuple(exec_.arg_dict[n].shape)
+                  for n in self._train_names]
+        dtypes = [str(exec_.arg_dict[n]._data.dtype)
+                  for n in self._train_names]
+        self._num_groups, self._num_labels = _numerics.stat_groups(
+            shapes, dtypes, names=self._train_names)
+
+    def _numerics_sig(self):
+        """The observatory's contribution to the trace signature."""
+        return (_numerics.trace_mode(),
+                _numerics.trace_mode() != "off" and
+                _numerics.poison_armed())
 
     # -- trace -------------------------------------------------------------
     def _build_jit(self):
@@ -117,9 +150,14 @@ class FusedTrainStep:
         n_args = len(self._arg_names)
         train_slots = tuple(self._train_slots)
         other_slots = tuple(self._other_slots)
+        self._numerics_plan()
+        num_mode = self._num_mode
+        num_groups = self._num_groups
+        num_poison = self._num_poison
         outer = self
 
-        def step(key, train_vals, other_vals, aux_vals, states, lrs, wds):
+        def step(key, train_vals, other_vals, aux_vals, states, lrs, wds,
+                 poison):
             outer._trace_count += 1  # host side effect: runs at trace only
 
             def fwd(*tv):
@@ -138,10 +176,28 @@ class FusedTrainStep:
             zero_aux = tuple(jnp.zeros_like(a) for a in new_aux)
             grads = vjp_fn((cts, zero_aux))
             grads = [g.astype(w.dtype) for g, w in zip(grads, train_vals)]
+            if num_poison:
+                # chaos train/poison_grad rides this scalar (1.0 = IEEE
+                # identity, bitwise no-op; NaN/Inf poisons the window);
+                # baked in only while the site is armed, so production
+                # armed windows pay zero extra gradient traffic
+                grads = [g * poison.astype(g.dtype) for g in grads]
             new_params, new_states = opt.fused_update(
                 list(train_vals), grads, list(states),
                 list(lrs), list(wds))
-            return outs, new_aux, tuple(new_params), new_states
+            if num_mode != "off":
+                # numerics observatory (ISSUE 14): health stats ride the
+                # same donated dispatch; skip mode gates the poisoned
+                # update on device (the loss-scaler idiom, no extra sync)
+                new_params, (new_aux, new_states), stats = \
+                    _numerics.trace_step(
+                        num_mode, grads, list(outs), train_vals,
+                        new_params, [(new_aux, aux_vals),
+                                     (new_states, states)], num_groups)
+                stats = _numerics.window_param_stats(
+                    stats, new_params, train_vals)
+                return outs, new_aux, tuple(new_params), new_states, stats
+            return outs, new_aux, tuple(new_params), new_states, ()
 
         # donate weights (1), aux stats (3) and optimizer state (4):
         # XLA aliases them onto the matching outputs — in-place reuse,
@@ -235,7 +291,7 @@ class FusedTrainStep:
                 return False
 
         opt = module._optimizer
-        sig = opt.fused_static_signature()
+        sig = (opt.fused_static_signature(), self._numerics_sig())
         if self._jit is None or sig != self._static_sig:
             self._build_jit()
             self._static_sig = sig
@@ -273,20 +329,22 @@ class FusedTrainStep:
         lrs, wds = opt.fused_hyperparams(self._opt_indices)
 
         key = _random.next_key()
+        poison = _numerics.poison_value() if self._num_poison \
+            else np.float32(1.0)
         with _telemetry.span("fit/step/fused_dispatch"):
             if self._just_built:
                 # first dispatch after a (re)trace: charge its backend
                 # compile to the fused step in the TraceLedger
                 from . import compile as _compile
                 with _compile.LEDGER.attribute("fused_step"):
-                    outs, new_aux, new_params, new_states = self._jit(
-                        key, train_vals, other_vals, aux_vals, states,
-                        tuple(lrs), tuple(wds))
+                    outs, new_aux, new_params, new_states, stats = \
+                        self._jit(key, train_vals, other_vals, aux_vals,
+                                  states, tuple(lrs), tuple(wds), poison)
                 self._just_built = False
             else:
-                outs, new_aux, new_params, new_states = self._jit(
+                outs, new_aux, new_params, new_states, stats = self._jit(
                     key, train_vals, other_vals, aux_vals, states,
-                    tuple(lrs), tuple(wds))
+                    tuple(lrs), tuple(wds), poison)
         _prof.record_dispatch("fused_step")
 
         self._writeback_carry(new_params, new_aux, new_states, states_nd)
@@ -299,6 +357,12 @@ class FusedTrainStep:
         exec_._last_is_train = True
         self.steps += 1
         _prof.record_counter("train:fused_step_total", self.steps)
+        if self._num_mode != "off":
+            # boundary check: one tiny host read; halt mode raises typed
+            # NonFiniteError here, AFTER the views are consistent
+            _numerics.observe_window(
+                stats, kind="fused_step", first_step=self.steps,
+                window=self.steps, group_labels=self._num_labels)
         return True
 
     def stale(self, module):
@@ -361,10 +425,14 @@ class ScanTrainStep(FusedTrainStep):
         rest_slots = tuple(self._arg_names.index(n)
                            for n in self._rest_names)
         accum = self.accum
+        self._numerics_plan()
+        num_mode = self._num_mode
+        num_groups = self._num_groups
+        num_poison = self._num_poison
         outer = self
 
         def window(keys, feeds, lrs, wds, train_vals, rest_vals,
-                   aux_vals, states):
+                   aux_vals, states, poison):
             outer._scan_trace_count += 1  # host side: runs at trace only
 
             def micro(key, feed_vals, train_vals, aux_vals):
@@ -389,6 +457,7 @@ class ScanTrainStep(FusedTrainStep):
 
             def body(carry, xs):
                 tv, av, st = carry
+                av0 = av
                 key_s, feed_s, lr_s, wd_s = xs
                 grads_sum = None
                 outs_micro = []
@@ -398,19 +467,42 @@ class ScanTrainStep(FusedTrainStep):
                     outs_micro.append(outs)
                     grads_sum = grads if grads_sum is None else \
                         [a + b for a, b in zip(grads_sum, grads)]
+                if num_poison:
+                    grads_sum = [g * poison.astype(g.dtype)
+                                 for g in grads_sum]
+                if num_mode != "off":
+                    # fusion fence: grads now have two consumers (the
+                    # optimizer update AND the stat reductions); without
+                    # it XLA CPU duplicates batch-sized backward chains
+                    # into each consumer's fusion — measured at >10% of
+                    # step wall.  The barrier materializes grads once.
+                    grads_sum = list(jax.lax.optimization_barrier(
+                        tuple(grads_sum)))
                 new_params, new_states = opt.fused_update(
                     list(tv), grads_sum, list(st),
                     [lr_s[i] for i in range(n_train)],
                     [wd_s[i] for i in range(n_train)])
                 ys = tuple(jnp.stack([o[i] for o in outs_micro])
                            for i in range(len(outs_micro[0])))
+                if num_mode != "off":
+                    # in-scan health stats: one extra scanned output, no
+                    # extra dispatch; skip mode gates THIS step's update
+                    new_params, (av, new_states), stats = \
+                        _numerics.trace_step(
+                            num_mode, grads_sum, [ys[0]], tv, new_params,
+                            [(av, av0), (new_states, st)], num_groups)
+                    ys = ys + (stats,)
                 return (tuple(new_params), av, new_states), ys
 
             carry, ys = jax.lax.scan(
                 body, (train_vals, aux_vals, states),
                 (keys, feeds, lrs, wds))
             tv, av, st = carry
-            return tv, av, st, ys
+            if num_mode != "off":
+                stats = _numerics.window_param_stats(
+                    ys[-1], tv, train_vals)
+                return tv, av, st, ys[:-1], stats
+            return tv, av, st, ys, ()
 
         # donate the carry inputs (weights / aux / optimizer state): the
         # scan's final carry aliases them in place, exactly like the
@@ -443,6 +535,7 @@ class ScanTrainStep(FusedTrainStep):
 
         opt = module._optimizer
         sig = (opt.fused_static_signature(), K, M,
+               self._numerics_sig(),
                tuple(sorted((n, tuple(a.shape), str(a.dtype))
                             for n, a in feed.items())))
         if self._scan_jit is None or sig != self._scan_sig:
@@ -479,18 +572,20 @@ class ScanTrainStep(FusedTrainStep):
                          for _ in range(W)])
         keys = keys.reshape((K, M) + keys.shape[1:])
 
+        poison = _numerics.poison_value() if self._num_poison \
+            else np.float32(1.0)
         with _telemetry.span("fit/step/scan_dispatch"):
             if self._just_built:
                 from . import compile as _compile
                 with _compile.LEDGER.attribute("scan_step"):
-                    tv, av, st, ys = self._scan_jit(
+                    tv, av, st, ys, stats = self._scan_jit(
                         keys, tuple(feed_bufs), lrs, wds,
-                        train_vals, rest_vals, aux_vals, states)
+                        train_vals, rest_vals, aux_vals, states, poison)
                 self._just_built = False
             else:
-                tv, av, st, ys = self._scan_jit(
+                tv, av, st, ys, stats = self._scan_jit(
                     keys, tuple(feed_bufs), lrs, wds,
-                    train_vals, rest_vals, aux_vals, states)
+                    train_vals, rest_vals, aux_vals, states, poison)
         _prof.record_dispatch("scan_window")
 
         self._writeback_carry(tv, av, st, states_nd)
@@ -507,6 +602,13 @@ class ScanTrainStep(FusedTrainStep):
         self.steps += K
         self.windows += 1
         _prof.record_counter("train:fused_step_total", self.steps)
+        if self._num_mode != "off":
+            # window-boundary check: the host's only read of the stats
+            # (one tiny transfer); halt raises typed NonFiniteError here
+            _numerics.observe_window(
+                stats, kind="scan_window",
+                first_step=self.steps - K + 1, window=self.windows,
+                group_labels=self._num_labels)
         return outs_flat
 
 

@@ -20,7 +20,8 @@ from . import metric as metric_mod
 from . import ndarray as nd
 from . import optimizer as opt_mod
 from . import telemetry as _telemetry
-from .base import MXNetError, PeerLostError, PreemptionError
+from .base import (MXNetError, NonFiniteError, PeerLostError,
+                   PreemptionError)
 from .context import cpu
 from .initializer import Uniform
 from .model import (BatchEndParam, load_checkpoint, save_checkpoint,
@@ -314,6 +315,14 @@ class BaseModule:
                     # restore), never degrade into per-batch steps
                     wtrace.event("elastic_fault", cause=type(e).__name__)
                     wtrace.finish(status="elastic_fault")
+                    raise
+                except NonFiniteError:
+                    # numerics halt (MXNET_NUMERICS=halt) is a verdict,
+                    # not a trace failure: propagate typed to the caller
+                    # — never degrade into per-batch steps that would
+                    # keep training on the poisoned carry
+                    wtrace.event("nonfinite_halt")
+                    wtrace.finish(status="nonfinite")
                     raise
                 except Exception as e:  # trace failure: fall back for good
                     self.logger.warning(
@@ -975,6 +984,11 @@ class Module(BaseModule):
             fs = self._fused = FusedTrainStep(self)
         try:
             ran = fs.step(data_batch)
+        except NonFiniteError:
+            # the numerics halt verdict (MXNET_NUMERICS=halt) must reach
+            # the caller typed — falling back to the per-param loop
+            # would keep training through the poison it just caught
+            raise
         except Exception as e:  # trace-time failure: fall back for good
             self.logger.warning(
                 "fused train step disabled (%s: %s); falling back to the "

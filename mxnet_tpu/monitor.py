@@ -1,5 +1,19 @@
 """Monitor: per-op output statistics during training
-(parity: python/mxnet/monitor.py; executor hook graph_executor.cc:1403)."""
+(parity: python/mxnet/monitor.py; executor hook graph_executor.cc:1403).
+
+**Fusion opt-out (documented contract, ISSUE 14 satellite):** installing
+a Monitor hooks every op's output on the host, which is fundamentally
+incompatible with the fused / scanned / mesh-fused train steps (one
+donated XLA program per step/window has no per-op host boundary to hook)
+— a module with a monitor installed silently keeps the per-op dispatch
+loop (``module._fused_eligible`` / ``_mesh_fused_eligible``; tested in
+tests/test_numerics.py).  For training-health statistics that DO
+compose with fusion, use the numerics observatory instead: arm
+``MXNET_NUMERICS=warn`` and read :func:`numerics_summary` — grad/param
+norms, update ratios and the loss proxy are computed *inside* the
+donated window (zero extra dispatches) and exported through the
+telemetry registry (docs/observability.md numerics section).
+"""
 from __future__ import annotations
 
 import logging
@@ -8,8 +22,21 @@ import re
 from .ndarray import NDArray
 
 
+def numerics_summary(last_n=64):
+    """``Monitor.toc()``-shaped rows ``[(step, stat_name, value_str)]``
+    sourced from the numerics observatory's in-trace stats history —
+    the fused-compatible ``Monitor(stat_func=...)`` alternative (needs
+    ``MXNET_NUMERICS`` armed; see module docstring)."""
+    from .telemetry import numerics
+    return numerics.monitor_summary(last_n)
+
+
 class Monitor:
-    """Install a callback on executors to collect output statistics."""
+    """Install a callback on executors to collect output statistics.
+
+    NOTE: installing a monitor opts the module out of the fused /
+    scanned / mesh train-step fast paths (see module docstring);
+    :func:`numerics_summary` is the fused-compatible alternative."""
 
     def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
         if stat_func is None:

@@ -73,6 +73,7 @@ from .. import profiler as _prof
 from .. import random as _random
 from .. import telemetry as _telemetry
 from ..base import MXNetError
+from ..telemetry import numerics as _numerics
 from ..fused_step import ScanTrainStep
 from ..gradient_compression import (COLLECTIVE_CODECS, codec_wire_bytes,
                                     decode_2bit_sum, quantize_2bit_flat)
@@ -414,10 +415,29 @@ class MeshFusedTrainStep(ScanTrainStep):
         n_shards = self._n_shards
         codec = self.codec
         threshold = self.codec_threshold
+        # numerics observatory (ISSUE 14): stats need the globally
+        # REDUCED gradient, so the mesh sentinel arms only where the
+        # reduced pytree exists in-trace — the replicated layout with
+        # collectives on (fsdp shards the sum; comm off is a bench lie)
+        self._num_mode = _numerics.trace_mode()
+        if self._num_mode != "off" and not (comm_on and
+                                            layout == "replicated"):
+            log.warning(
+                "numerics observatory disabled for this mesh window: "
+                "MXNET_NUMERICS=%s needs comm_mode='bucketed' and the "
+                "replicated layout (got %s/%s)", self._num_mode,
+                self.comm_mode, layout)
+            self._num_mode = "off"
+        num_mode = self._num_mode
+        num_groups = self._plan if num_mode != "off" else []
+        self._num_poison = num_mode != "off" and _numerics.poison_armed()
+        num_poison = self._num_poison
+        self._num_labels = _numerics.group_names(
+            num_groups, self._train_names)
         outer = self
 
         def window(keys, feeds, lrs, wds, train_vals, rest_vals, states,
-                   residuals):
+                   residuals, poison):
             # per-shard program: feeds arrive batch-sharded, params and
             # optimizer state replicated; ONE collective per bucket per
             # scanned step synchronizes gradients across the mesh
@@ -443,6 +463,7 @@ class MeshFusedTrainStep(ScanTrainStep):
 
             def body(carry, xs):
                 tv, st, res = carry
+                res0 = res
                 key_s, feed_s, lr_s, wd_s = xs
                 grads_sum = None
                 outs_micro = []
@@ -465,17 +486,38 @@ class MeshFusedTrainStep(ScanTrainStep):
                     elif comm_on:
                         grads_sum = bucketed_all_reduce(
                             grads_sum, axes, plan)
+                    if num_poison:
+                        # poison AFTER the reduction: the reduced pytree
+                        # is what the sentinel judges, codec or not
+                        grads_sum = [g * poison.astype(g.dtype)
+                                     for g in grads_sum]
                     new_params, new_states = opt.fused_update(
-                        list(tv), grads_sum, list(st), lr_row, wd_row)
+                        list(tv), grads_sum, list(st),
+                        lr_row, wd_row)
                 ys = tuple(jnp.stack([o[i] for o in outs_micro])
                            for i in range(len(outs_micro[0])))
+                if num_mode != "off":
+                    # stats from replicated values only (reduced grads,
+                    # replicated params/states, pmean'd loss) — every
+                    # rank computes identical numbers, so the stats
+                    # output legally rides an out_spec of P()
+                    new_params, (new_states, res), stats = \
+                        _numerics.trace_step(
+                            num_mode, grads_sum, [ys[0]], tv, new_params,
+                            [(new_states, st), (res, res0)], num_groups,
+                            axes=axes)
+                    ys = ys + (stats,)
                 return (tuple(new_params), new_states, res), ys
 
             carry, ys = jax.lax.scan(
                 body, (train_vals, states, residuals),
                 (keys, feeds, lrs, wds))
             tv, st, res = carry
-            return tv, st, res, ys
+            if num_mode != "off":
+                stats = _numerics.window_param_stats(
+                    ys[-1], tv, train_vals)
+                return tv, st, res, ys[:-1], stats
+            return tv, st, res, ys, ()
 
         batch_spec = P(None, None, axes)  # (K, M, B, ...), B sharded
         state_specs = jax.tree_util.tree_map(lambda _: P(),
@@ -487,11 +529,14 @@ class MeshFusedTrainStep(ScanTrainStep):
                     tuple(P() for _ in self._train_names),
                     tuple(P() for _ in self._rest_names),
                     state_specs,
-                    tuple(res_spec for _ in self._residual_bufs))
+                    tuple(res_spec for _ in self._residual_bufs),
+                    P())                                   # poison scalar
         out_specs = (tuple(P() for _ in self._train_names),
                      state_specs,
                      tuple(res_spec for _ in self._residual_bufs),
-                     tuple(batch_spec for _ in range(self._n_outs)))
+                     tuple(batch_spec for _ in range(self._n_outs)),
+                     # stats are computed from replicated values only
+                     P() if num_mode != "off" else ())
         smapped = shard_map(window, mesh=self.mesh.jax_mesh,
                             in_specs=in_specs, out_specs=out_specs,
                             check_vma=False)
@@ -614,7 +659,7 @@ class MeshFusedTrainStep(ScanTrainStep):
         sig = (opt.fused_static_signature(), K, M, self._axes,
                tuple(self.mesh.axes.items()), self.layout,
                self.bucket_mb, self.comm_mode, self.codec,
-               self.codec_threshold,
+               self.codec_threshold, self._numerics_sig(),
                tuple(sorted((n, tuple(a.shape), str(a.dtype))
                             for n, a in feed.items())))
         # stage the carry FIRST: the states template (structure + count)
@@ -669,18 +714,21 @@ class MeshFusedTrainStep(ScanTrainStep):
         _failpoint("parallel/collective")
 
         residuals = tuple(self._residual_bufs)
+        poison = _numerics.poison_value() if self._num_poison \
+            else np.float32(1.0)
         with _telemetry.span("fit/step/mesh_dispatch"):
             if self._just_built:
                 from .. import compile as _compile
                 with _compile.LEDGER.attribute("mesh_step"):
-                    tv, st, res, ys = self._scan_jit(
+                    tv, st, res, ys, stats = self._scan_jit(
                         keys, tuple(feed_bufs), lrs, wds,
-                        train_vals, rest_vals, states, residuals)
+                        train_vals, rest_vals, states, residuals,
+                        poison)
                 self._just_built = False
             else:
-                tv, st, res, ys = self._scan_jit(
+                tv, st, res, ys, stats = self._scan_jit(
                     keys, tuple(feed_bufs), lrs, wds,
-                    train_vals, rest_vals, states, residuals)
+                    train_vals, rest_vals, states, residuals, poison)
         _prof.record_dispatch("mesh_window")
         # coordination hook (parallel/elastic.py): a multi-host step
         # bounds the wait on the in-flight window HERE, before any host
@@ -713,6 +761,14 @@ class MeshFusedTrainStep(ScanTrainStep):
         self.steps += K
         self.windows += 1
         _prof.record_counter("train:fused_step_total", self.steps)
+        if self._num_mode != "off":
+            # boundary sentinel: every rank observes (per-rank families
+            # ride the fleet push); stats are replicated, so all ranks
+            # reach the same verdict — a halt halts the whole mesh
+            _numerics.observe_window(
+                stats, kind="mesh_window",
+                first_step=self.steps - K + 1, window=self.windows,
+                group_labels=self._num_labels)
         return outs_flat
 
     def _account_collectives(self, K):

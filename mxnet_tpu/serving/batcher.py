@@ -56,9 +56,10 @@ import time
 
 import numpy as np
 
-from ..base import MXNetError
+from ..base import MXNetError, NonFiniteError
 from ..chaos.failpoints import failpoint as _failpoint
 from ..telemetry import flight as _flight
+from ..telemetry import numerics as _numerics
 from ..telemetry import trace as _trace
 from ..telemetry import watchdog as _watchdog
 from .metrics import ServingMetrics
@@ -656,7 +657,24 @@ class DynamicBatcher:
             self.metrics.incr("errors_total", len(live))
             return
         done = time.perf_counter()
+        # output-health guard (ISSUE 14): rows whose float outputs carry
+        # NaN/Inf fail typed and are never served; healthy cohort
+        # members still resolve — one vectorized isfinite pass per float
+        # output, an empty tuple when MXNET_NUMERICS_SERVING=0
+        bad_rows = _numerics.guard_rows(outputs, len(live))
+        if bad_rows:
+            _numerics.record_serving_nonfinite(self.name, len(bad_rows))
+            self.metrics.incr("nonfinite_total", len(bad_rows))
         for i, req in enumerate(live):
+            if i in bad_rows:
+                req.future._set_exception(NonFiniteError(
+                    where=f"serving[{self.name}] output",
+                    stat="nonfinite_output", value=True,
+                    detail="the model produced non-finite values for "
+                           "this request; it was not served"))
+                req.trace.event("nonfinite_output", replica=self.name)
+                req.trace.finish(status="nonfinite")
+                continue
             req.future._set_result([out[i] for out in outputs])
             if req.trace is not _trace.NULL_TRACE:
                 # resolve ends at THIS request's future resolution;
@@ -666,7 +684,9 @@ class DynamicBatcher:
                 req.trace.finish()
             self.metrics.observe_latency((done - req.t_enqueue) * 1e3)
         _watchdog.beat(f"serving/{self.name}")
-        self.metrics.incr("responses_total", len(live))
+        if len(live) > len(bad_rows):
+            self.metrics.incr("responses_total",
+                              len(live) - len(bad_rows))
 
     # -- load introspection (the router's routing signal) --------------------
     def occupancy(self):

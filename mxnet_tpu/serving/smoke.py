@@ -15,8 +15,12 @@ CI entry point (``python -m mxnet_tpu.serving.smoke``), two phases:
    and ZERO executor-cache misses after the flip (the warm hooks
    compiled the new version's full bucket ladder BEFORE the pointer
    moved — composing ISSUE 7's warm-before-flip with the pool).
+3. **output-health guard** (ISSUE 14) — a model producing NaN logits
+   fails those requests with typed ``NonFiniteError`` (never served),
+   bumps ``mxnet_numerics_serving_nonfinite_total``, and the pool's
+   survivors keep answering healthy requests.
 
-Prints one JSON summary line; exit code 0 iff both contracts held.
+Prints one JSON summary line; exit code 0 iff all contracts held.
 """
 from __future__ import annotations
 
@@ -41,6 +45,55 @@ os.environ.setdefault("MXNET_COMPILE_CACHE_DIR",
 
 N_CLIENTS = 64
 IN_DIM = 16
+
+
+def output_health_guard():
+    """Phase 3: non-finite logits fail typed, never serve, pool
+    survives.  Returns (summary dict, failure list)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import serving
+    from mxnet_tpu.base import NonFiniteError
+    from mxnet_tpu.telemetry import numerics
+
+    failures = []
+    # log(x): positive inputs are healthy, negative inputs produce NaN
+    sym = mx.sym.log(mx.sym.Variable("data"))
+    server = serving.ModelServer(max_batch_size=4, max_latency_ms=2.0,
+                                 num_replicas=2, name="nf-smoke")
+    server.load("m", symbol=sym, params={})
+    nf0 = numerics.summary()  # noqa: F841 — arm check only
+    healthy = server.predict("m", {"data": np.ones(IN_DIM, np.float32)})
+    if not np.allclose(np.asarray(healthy[0]), 0.0):
+        failures.append("guard smoke: healthy request served wrong")
+    typed = 0
+    try:
+        server.predict("m", {"data": -np.ones(IN_DIM, np.float32)})
+        failures.append("guard smoke: NaN output was SERVED")
+    except NonFiniteError:
+        typed = 1
+    except Exception as e:  # noqa: BLE001 — wrong error type = failure
+        failures.append(f"guard smoke: wrong error type "
+                        f"{type(e).__name__}: {e}")
+    # survivors keep serving after the guard fired
+    try:
+        again = server.predict(
+            "m", {"data": 2 * np.ones(IN_DIM, np.float32)})
+        if not np.allclose(np.asarray(again[0]), np.log(2.0)):
+            failures.append("guard smoke: post-guard answer wrong")
+    except Exception as e:  # noqa: BLE001 — survivors must serve
+        failures.append(f"guard smoke: pool stopped serving after the "
+                        f"guard fired: {type(e).__name__}: {e}")
+    counter = 0
+    from mxnet_tpu.telemetry import REGISTRY
+    fam = REGISTRY.get("mxnet_numerics_serving_nonfinite_total")
+    if fam is not None:
+        counter = sum(s[2] for s in fam._samples())
+    if counter < 1:
+        failures.append("guard smoke: serving_nonfinite counter did "
+                        "not bump")
+    server.shutdown()
+    return {"typed_failures": typed,
+            "serving_nonfinite_total": counter}, failures
 
 
 def autoscaling_hot_swap():
@@ -209,9 +262,19 @@ def main():
                          f"{type(e).__name__}: {e}"]
     failures += swap_failures
 
+    # phase 3: output-health guard (numerics observatory, ISSUE 14)
+    try:
+        guard_summary, guard_failures = output_health_guard()
+    except Exception as e:  # noqa: BLE001 — smoke must report, not crash
+        guard_summary = {"error": f"{type(e).__name__}: {e}"}
+        guard_failures = [f"output-health phase crashed: "
+                          f"{type(e).__name__}: {e}"]
+    failures += guard_failures
+
     summary = {
         "smoke": "serving", "clients": N_CLIENTS, "answered": ok,
         "shed": shed, "failures": failures,
+        "output_health": guard_summary,
         "throughput_rps": snap.get("throughput_rps"),
         "p99_ms": snap.get("latency_ms", {}).get("p99"),
         "batch_occupancy": snap.get("batch_occupancy"),

@@ -35,6 +35,7 @@ from . import steps as _steps
 from . import alerts
 from . import fleet
 from . import flight
+from . import numerics
 from . import resources
 from . import trace
 from . import watchdog
@@ -256,6 +257,7 @@ REGISTRY.register_collector(
              "dumps": flight.dump_count()})
 REGISTRY.register_collector("resources", resources._collector_snapshot,
                             resources._collector_samples)
+REGISTRY.register_collector("numerics", numerics._collector_snapshot)
 
 
 def _alerts_collector():
@@ -291,6 +293,7 @@ def _autostart():
     if _config.get("MXNET_TRACE"):
         trace.enable()
     flight.configure()
+    numerics.configure()
     if float(_config.get("MXNET_RESOURCE_SAMPLE_S")) > 0:
         resources.start()
     if float(_config.get("MXNET_ALERTS")) > 0:

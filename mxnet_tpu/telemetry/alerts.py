@@ -364,6 +364,31 @@ def default_rules():
             cooldown_s=60.0, severity="warn", reduce="max",
             doc="a fleet rank's last telemetry push is stale: its "
                 "reporter wedged or the rank is dying quietly"),
+        AlertRule(
+            "nonfinite_window", "mxnet_numerics_nonfinite_windows_total",
+            kind="rate", op=">", value=0.0, window_s=60.0, for_s=0.0,
+            cooldown_s=60.0, severity="page",
+            doc="a train window contained non-finite gradients/params/"
+                "loss within the last minute: the model is diverging or "
+                "the data is poisoned — the forensic "
+                "mxnet-numerics-*.json dump names the window "
+                "(docs/observability.md numerics runbook)"),
+        AlertRule(
+            "grad_norm_explosion", "mxnet_numerics_grad_norm",
+            kind="rate", op=">", value=1.0, window_s=30.0, for_s=5.0,
+            cooldown_s=120.0, severity="warn",
+            doc="the global gradient norm is climbing sustainedly "
+                "(> 1/s over the lookback): an exploding-gradient "
+                "trajectory headed for non-finite; tune the bound to "
+                "the model's scale via MXNET_ALERT_RULES"),
+        AlertRule(
+            "loss_spike", "mxnet_numerics_loss",
+            kind="rate", op=">", value=0.5, window_s=30.0, for_s=5.0,
+            cooldown_s=120.0, severity="warn",
+            doc="the loss proxy is rising sustainedly instead of "
+                "converging — divergence judged before it reaches "
+                "non-finite; tune the bound per model via "
+                "MXNET_ALERT_RULES"),
     ]
 
 

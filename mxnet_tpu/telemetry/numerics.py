@@ -1,0 +1,675 @@
+"""Numerics observatory: in-trace training-health telemetry, non-finite
+sentinels, and anomaly-triggered forensic dumps (ISSUE 14 tentpole).
+
+PRs 12–13 made the *system* observable; nothing watched the *model*.  A
+NaN produced mid-window silently corrupts the donated carry and
+surfaces — if ever — as a garbage checkpoint hours later.  This module
+closes that gap with the PyGraph lesson applied to health stats: the
+instrumentation lives *inside* the already-captured window, so
+observability costs zero extra dispatches.
+
+* **In-trace stats** (:func:`trace_step`) — the fused / scanned /
+  mesh-fused train steps fold a small per-step stat vector into their
+  donated ``jit``/``shard_map`` program: global gradient L2 norm,
+  parameter L2 norm, update ratio (‖Δw‖/‖w‖), a loss proxy (mean of the
+  graph's primary output — the loss for MakeLoss/regression heads, the
+  mean probability for SoftmaxOutput heads), and per-bucket non-finite
+  element counts over the gradients (buckets = the same dtype-contiguous
+  size-bounded parameter groups the collective planner uses, so a bad
+  bucket names a *region* of the model).  The stats ride the window's
+  existing outputs; the host reads them only at the window boundary —
+  dispatches/step are unchanged and the update math is untouched, so
+  weights stay bitwise identical to a numerics-off run.
+* **Sentinel modes** (``MXNET_NUMERICS=off|warn|skip|halt``) — at the
+  boundary a non-finite (or rule-breaching) window WARNs, SKIPs, or
+  HALTs.  ``skip`` replays the MXNet dynamic loss-scaler idiom *inside
+  the trace*: each step's update is gated on its own all-finite flag
+  (``where(finite, new, old)``), so a poisoned step's update (params,
+  optimizer state, aux, codec residuals) is dropped on device with no
+  extra host sync, and training continues bit-identically to a manual
+  skip.  ``halt`` raises a typed :class:`~mxnet_tpu.base.NonFiniteError`
+  at the boundary.  An attached :class:`~mxnet_tpu.amp.LossScaler`
+  consumes the same per-step flags (:func:`attach_loss_scaler`), so
+  dynamic-scale backoff/growth needs no separate overflow sync.
+* **Forensics** — a detected anomaly records a flight-ring event and
+  dumps ``mxnet-numerics-<pid>-<n>.json``: the stats history, window /
+  step numbers, per-bucket non-finite counts with parameter names, the
+  RNG key path (counter), batch indices, and the last-good checkpoint
+  step — "loss went NaN" starts from evidence, not archaeology.
+* **Serving guard** — :func:`guard_rows` screens batch outputs so a
+  model emitting non-finite logits fails *those requests* typed
+  (``NonFiniteError``) instead of serving garbage
+  (``MXNET_NUMERICS_SERVING``; ``mxnet_numerics_serving_nonfinite_total``).
+
+Export: ``mxnet_numerics_*`` registry families (plain metrics — they
+ride the PR-12 fleet push for per-rank visibility) plus a ``numerics``
+collector in ``telemetry.snapshot()``.  The default alert pack gains
+``nonfinite_window`` (page), ``grad_norm_explosion`` and ``loss_spike``
+rate rules (telemetry/alerts.py).  The disabled path is one
+module-global check (< 1 µs, the span/trace/failpoint bar).
+"""
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import os
+import threading
+import time
+
+import numpy as np
+
+from ..base import MXNetError, NonFiniteError
+
+log = logging.getLogger("mxnet_tpu.telemetry.numerics")
+
+MODES = ("off", "warn", "skip", "halt")
+
+#: names of the core stat slots; per-bucket non-finite counts follow
+STAT_NAMES = ("grad_norm", "param_norm", "update_ratio", "loss",
+              "nonfinite")
+N_CORE = len(STAT_NAMES)
+
+# module-global fast gates: the ONLY thing a disabled caller touches
+_mode = "off"
+_serving_guard = True
+
+_lock = threading.Lock()
+_history = collections.deque(maxlen=512)
+_windows = 0
+_dumps = 0
+_scalers = []          # attached LossScaler-likes (weak contract: small)
+_counts = {"steps": 0, "nonfinite_steps": 0, "nonfinite_windows": 0,
+           "rule_breach_windows": 0, "skipped_updates": 0}
+
+
+# -- configuration ------------------------------------------------------------
+def configure(mode=None, serving=None, history=None):
+    """(Re)configure from the env knobs — called at telemetry import;
+    tests flip modes directly."""
+    global _mode, _serving_guard, _history
+    from .. import config as _config
+    if mode is None:
+        mode = str(_config.get("MXNET_NUMERICS") or "off").strip().lower()
+    if mode not in MODES:
+        raise MXNetError(f"MXNET_NUMERICS={mode!r}: expected one of "
+                         f"{MODES}")
+    if serving is None:
+        serving = bool(_config.get("MXNET_NUMERICS_SERVING"))
+    if history is None:
+        history = int(_config.get("MXNET_NUMERICS_HISTORY"))
+    with _lock:
+        if history != _history.maxlen:
+            _history = collections.deque(_history, maxlen=max(16, history))
+    _serving_guard = bool(serving)
+    _mode = mode
+    if mode != "off":
+        _metrics()  # create the families eagerly: alert-rule rate
+        # baselines need the counters present from the first armed tick
+    return mode
+
+
+def mode():
+    return _mode
+
+
+def armed():
+    """True when the observatory watches train windows (mode != off) —
+    the hot-path gate; one global read."""
+    return _mode != "off"
+
+
+def trace_mode():
+    """The mode a train-step trace should bake in (part of its build
+    signature: arming/disarming retraces, never silently drifts)."""
+    return _mode
+
+
+def serving_guard():
+    """True when serving batch outputs are screened for non-finite rows
+    — one global read (< 1 µs disabled bar)."""
+    return _serving_guard
+
+
+# -- registry families --------------------------------------------------------
+def _metrics():
+    from . import REGISTRY
+    return {
+        "grad_norm": REGISTRY.gauge(
+            "mxnet_numerics_grad_norm",
+            "global L2 norm of the last observed step's gradients "
+            "(in-trace, read at window boundaries)"),
+        "param_norm": REGISTRY.gauge(
+            "mxnet_numerics_param_norm",
+            "global L2 norm of the parameters after the last observed "
+            "step's update"),
+        "update_ratio": REGISTRY.gauge(
+            "mxnet_numerics_update_ratio",
+            "|param delta| / |params| of the last observed step (0 for "
+            "a skipped update)"),
+        "loss": REGISTRY.gauge(
+            "mxnet_numerics_loss",
+            "loss proxy of the last observed step: mean of the graph's "
+            "primary output (the loss for MakeLoss/regression heads)"),
+        "steps": REGISTRY.counter(
+            "mxnet_numerics_steps_total",
+            "train steps observed by the numerics observatory"),
+        "nf_steps": REGISTRY.counter(
+            "mxnet_numerics_nonfinite_steps_total",
+            "observed train steps whose gradients/params/loss contained "
+            "non-finite values"),
+        "nf_windows": REGISTRY.counter(
+            "mxnet_numerics_nonfinite_windows_total",
+            "train windows containing at least one non-finite step (the "
+            "nonfinite_window alert rule's family)"),
+        "breaches": REGISTRY.counter(
+            "mxnet_numerics_rule_breaches_total",
+            "windows breaching a host-side numerics rule, by rule"),
+        "skipped": REGISTRY.counter(
+            "mxnet_numerics_skipped_updates_total",
+            "poisoned per-step updates dropped on device by skip mode"),
+        "nf_bucket": REGISTRY.counter(
+            "mxnet_numerics_nonfinite_elements_total",
+            "non-finite gradient elements observed, by parameter bucket"),
+        "dumps": REGISTRY.counter(
+            "mxnet_numerics_dumps_total",
+            "forensic numerics dumps written"),
+        "serving_nf": REGISTRY.counter(
+            "mxnet_numerics_serving_nonfinite_total",
+            "serving requests failed by the output-health guard "
+            "(non-finite logits never served), by batcher"),
+    }
+
+
+# -- in-trace helpers (pure jax; callable only inside a trace) ---------------
+def stat_groups(shapes, dtypes, names=None, bucket_mb=None):
+    """Group parameters (training order) into dtype-contiguous,
+    size-bounded stat buckets — the same grouping rule the collective
+    planner uses (parallel/fused.plan_buckets), re-stated here so the
+    telemetry layer never imports the parallel package.  Returns
+    ``(groups, group_names)``: index lists plus a display name per
+    group (joined member names, truncated)."""
+    if bucket_mb is None:
+        from .. import config as _config
+        bucket_mb = float(_config.get("MXNET_COLLECTIVE_BUCKET_MB"))
+    limit = max(1, int(float(bucket_mb) * (1 << 20)))
+    groups, cur, cur_bytes, cur_dtype = [], [], 0, None
+    for i, (shape, dtype) in enumerate(zip(shapes, dtypes)):
+        nb = int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+        if cur and (str(dtype) != cur_dtype or cur_bytes + nb > limit):
+            groups.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += nb
+        cur_dtype = str(dtype)
+    if cur:
+        groups.append(cur)
+    return groups, group_names(groups, names)
+
+
+def group_names(groups, names=None):
+    """Display name per group: joined member names, bounded length."""
+    out = []
+    for g in groups:
+        if names is None:
+            out.append(f"params[{g[0]}..{g[-1]}]")
+            continue
+        label = "+".join(names[i] for i in g)
+        out.append(label if len(label) <= 80 else
+                   f"{names[g[0]]}+..+{names[g[-1]]}")
+    return out
+
+
+def trace_step(mode_, grads, outs, old_params, new_params, gate_pairs,
+               groups, axes=None):
+    """One train step's in-trace numerics.  Returns ``(new_params,
+    gated_trees, stats_vec)``:
+
+    * ``stats_vec`` — float32 ``(N_CORE + len(groups),)``: grad norm,
+      param norm (of the APPLIED params), update ratio, loss proxy,
+      total non-finite count, then per-group non-finite gradient
+      counts;
+    * in ``skip`` mode every update is gated on the step's own
+      all-finite flag: ``new_params`` and each ``(new, old)`` pair in
+      ``gate_pairs`` (optimizer state, aux, codec residuals) select the
+      OLD tree when the step is poisoned — the dynamic loss-scaler
+      idiom, on device, no extra sync;
+    * under ``shard_map`` pass ``axes`` so the loss proxy is the global
+      batch mean (``pmean``); grads/params must already be
+      replicated/reduced so every rank computes identical stats.
+
+    All math is read-only over the step's existing values: a warn/halt
+    trace leaves the update bit-for-bit what a numerics-off trace
+    produces.
+    """
+    import jax
+    import jax.numpy as jnp
+    f32 = jnp.float32
+
+    # one fused reduce per ARRAY, then batched scalar math over stacked
+    # vectors: per-scalar add chains (and whole-tree concatenations,
+    # which copy every buffer and break the donated carry's in-place
+    # aliasing) both blow the <5% overhead gate on the CPU backend —
+    # this shape keeps the thunk count ~4 per parameter
+    g_sq = jnp.stack([jnp.sum(jnp.square(g.astype(f32)))
+                      for g in grads])
+    # a NaN/Inf element makes its array's sum of squares non-finite
+    # (squares are non-negative: no cancellation can hide it), so the
+    # per-array sentinel is FREE off the norm reductions — no second
+    # elementwise pass over the gradients.  The unit is poisoned
+    # ARRAYS: nf_groups[b] counts the parameters in bucket b whose
+    # gradient went non-finite (an overflowing-but-finite sumsq reads
+    # as poisoned too — conservative, never a miss).
+    g_nf = (~jnp.isfinite(g_sq)).astype(f32)
+    nf_groups = [jnp.sum(g_nf[grp[0]:grp[-1] + 1]) for grp in groups]
+    if outs:
+        loss = jnp.mean(outs[0].astype(f32))
+        if axes is not None:
+            loss = jax.lax.pmean(loss, axes)
+        nf_loss = (~jnp.isfinite(loss)).astype(f32)
+    else:
+        loss = jnp.zeros((), f32)
+        nf_loss = jnp.zeros((), f32)
+    total_nf = jnp.sum(g_nf) + nf_loss
+    finite = total_nf == 0
+
+    if mode_ == "skip":
+        new_params = tuple(
+            jnp.where(finite, n, o)
+            for n, o in zip(new_params, old_params))
+        gated = [jax.tree_util.tree_map(
+                     lambda n, o: jnp.where(finite, n, o), tn, to)
+                 for tn, to in gate_pairs]
+    else:
+        gated = [tn for tn, _to in gate_pairs]
+
+    grad_norm = jnp.sqrt(jnp.sum(g_sq))
+    # per-step rows carry the gradient-side stats + the loss proxy;
+    # the param-side stats (param_norm, update_ratio, final non-finite
+    # param sentinel) are filled per WINDOW by window_param_stats — a
+    # per-step pass over the params (let alone a new-old diff, which
+    # keeps the pre-update tree live and costs a carry copy per step)
+    # measured at 5-20% of step wall on CPU; window cadence amortizes
+    # it by 1/K, and non-finite params always surface within the same
+    # window anyway (a poisoned update makes the NEXT forward's loss
+    # and gradients non-finite — propagation is the sentinel)
+    stats = jnp.stack([grad_norm, jnp.zeros((), f32),
+                       jnp.zeros((), f32), loss,
+                       total_nf] + nf_groups)
+    return new_params, gated, stats
+
+
+def window_param_stats(stats, new_params, old_params):
+    """Fill the window's LAST stat row with the param-side stats,
+    computed once per dispatched window (outside the scan, inside the
+    same jit — the one place reading the pre-window params costs a
+    single carry copy instead of one per step): param L2 norm after the
+    window, the window's cumulative update ratio ‖Δw‖/‖w_before‖ (0
+    when every update was skipped), and the final-params non-finite
+    sentinel folded into the row's non-finite count."""
+    import jax.numpy as jnp
+    f32 = jnp.float32
+    n_sq = sum(jnp.sum(jnp.square(n.astype(f32))) for n in new_params)
+    u_sq = sum(jnp.sum(jnp.square(n.astype(f32) - o.astype(f32)))
+               for n, o in zip(new_params, old_params))
+    o_sq = sum(jnp.sum(jnp.square(o.astype(f32)))
+               for o in old_params)
+    param_norm = jnp.sqrt(n_sq)
+    ratio = jnp.sqrt(u_sq) / (jnp.sqrt(o_sq) + 1e-12)
+    nf_params = (~jnp.isfinite(n_sq)).astype(f32)
+    if stats.ndim == 1:
+        return jnp.stack([stats[0], param_norm, ratio, stats[3],
+                          stats[4] + nf_params, *stats[N_CORE:]])
+    last = jnp.stack([stats[-1, 0], param_norm, ratio, stats[-1, 3],
+                      stats[-1, 4] + nf_params, *stats[-1, N_CORE:]])
+    return stats.at[-1].set(last)
+
+
+def poison_armed():
+    """True when the chaos ``train/poison_grad`` site is armed — baked
+    into the train-step trace signature, so the in-trace poison
+    multiply exists only in chaos runs: a production armed window pays
+    zero extra gradient traffic for the injection hook."""
+    from ..chaos.failpoints import arms
+    return "train/poison_grad" in arms()
+
+
+def poison_value():
+    """Host-side chaos hook for the ``train/poison_grad`` site: returns
+    the scalar every in-trace gradient is multiplied by — 1.0 normally
+    (IEEE-exact identity, bitwise no-op), NaN/Inf when the failpoint
+    fires for this window.  Arm ``train/poison_grad=raise`` for NaN or
+    ``train/poison_grad=raise(inf)`` for Inf (docs/chaos.md)."""
+    from ..chaos.failpoints import ChaosInjectedError, failpoint
+    try:
+        failpoint("train/poison_grad")
+    except ChaosInjectedError as e:
+        val = float("inf") if "'inf'" in str(e) else float("nan")
+        log.warning("numerics: chaos poisoned this window's gradients "
+                    "with %s", val)
+        return np.float32(val)
+    return np.float32(1.0)
+
+
+# -- the fused overflow check (amp satellite) ---------------------------------
+_finite_jit = None
+
+
+def host_all_finite(arrays):
+    """ONE fused device reduction + one host sync answering "is every
+    array all-finite?" — the multi_all_finite idiom the dynamic loss
+    scaler's overflow check shares with the in-window sentinel (the
+    per-array ``isfinite().all()`` list the old check built is fused
+    into a single jitted program, retraced only per shape set)."""
+    import jax
+    import jax.numpy as jnp
+    global _finite_jit
+    bufs = [getattr(a, "_data", a) for a in arrays if a is not None]
+    if not bufs:
+        return True
+    if _finite_jit is None:
+        def all_finite(xs):
+            flags = [jnp.all(jnp.isfinite(x.astype(jnp.float32)))
+                     for x in xs]
+            return jnp.stack(flags).all()
+        _finite_jit = jax.jit(all_finite)
+    return bool(_finite_jit(tuple(bufs)))
+
+
+def attach_loss_scaler(scaler):
+    """Feed an amp ``LossScaler`` the per-step finite flags the
+    boundary check observes: poisoned steps back the scale off, clean
+    steps feed its growth window — no separate overflow sync."""
+    with _lock:
+        if scaler not in _scalers:
+            _scalers.append(scaler)
+
+
+def detach_loss_scaler(scaler):
+    with _lock:
+        if scaler in _scalers:
+            _scalers.remove(scaler)
+
+
+# -- host boundary check ------------------------------------------------------
+def observe_window(stats, kind, first_step, window, group_labels=(),
+                   nbatch=None):
+    """Judge one dispatched window's stats at the host boundary.
+
+    ``stats``: the window's in-trace stat rows — shape ``(n,)`` for a
+    single fused step or ``(K, n)`` for a scanned window (the
+    ``np.asarray`` here is the boundary's one tiny host read).  Updates
+    the registry families + history ring; on a non-finite or
+    rule-breaching window records a flight event, writes the forensic
+    dump, feeds attached loss scalers, and — in ``halt`` mode — raises
+    :class:`NonFiniteError`.  Returns the verdict dict (None when
+    disarmed)."""
+    if _mode == "off" or stats is None:
+        return None
+    if isinstance(stats, (tuple, list)) and not stats:
+        return None
+    from .. import config as _config
+    arr = np.asarray(stats, np.float64)
+    if arr.ndim == 1:
+        arr = arr[None]
+    K = arr.shape[0]
+    gn_max = float(_config.get("MXNET_NUMERICS_GRAD_NORM_MAX"))
+    m = _metrics()
+
+    nf_col = arr[:, 4]
+    core_bad = ~np.isfinite(arr[:, :N_CORE]).all(axis=1)
+    nonfinite_steps = (nf_col > 0) | core_bad
+    breach_steps = np.zeros(K, bool)
+    if gn_max > 0:
+        with np.errstate(invalid="ignore"):
+            breach_steps = arr[:, 0] > gn_max
+    n_nf = int(nonfinite_steps.sum())
+    verdict = ("nonfinite" if n_nf else
+               "rule_breach" if breach_steps.any() else "clean")
+
+    last = arr[-1]
+    m["grad_norm"].set(float(last[0]))
+    m["param_norm"].set(float(last[1]))
+    m["update_ratio"].set(float(last[2]))
+    m["loss"].set(float(last[3]))
+    m["steps"].inc(K)
+    if n_nf:
+        m["nf_steps"].inc(n_nf)
+        m["nf_windows"].inc()
+        if _mode == "skip":
+            m["skipped"].inc(n_nf)
+    if verdict == "rule_breach":
+        m["breaches"].inc(labels={"rule": "grad_norm_max"})
+    for g, label in enumerate(group_labels):
+        col = N_CORE + g
+        if col < arr.shape[1]:
+            with np.errstate(invalid="ignore"):
+                n = float(np.nan_to_num(arr[:, col],
+                                        nan=0.0, posinf=0.0).sum())
+            if n:
+                m["nf_bucket"].inc(int(n), labels={"bucket": label})
+
+    global _windows
+    entries = []
+    for j in range(K):
+        entries.append({
+            "step": int(first_step) + j, "kind": str(kind),
+            "window": int(window),
+            "grad_norm": float(arr[j, 0]), "param_norm": float(arr[j, 1]),
+            "update_ratio": float(arr[j, 2]), "loss": float(arr[j, 3]),
+            "nonfinite": float(arr[j, 4]),
+        })
+    with _lock:
+        _windows += 1
+        _history.extend(entries)
+        _counts["steps"] += K
+        _counts["nonfinite_steps"] += n_nf
+        if n_nf:
+            _counts["nonfinite_windows"] += 1
+            if _mode == "skip":
+                _counts["skipped_updates"] += n_nf
+        if verdict == "rule_breach":
+            _counts["rule_breach_windows"] += 1
+        scalers = list(_scalers)
+    for scaler in scalers:
+        for j in range(K):
+            scaler.update_scale(bool(nonfinite_steps[j]))
+
+    result = {"verdict": verdict, "kind": str(kind),
+              "window": int(window), "first_step": int(first_step),
+              "steps": K, "nonfinite_steps": n_nf,
+              "skipped": n_nf if (_mode == "skip" and n_nf) else 0}
+    if verdict == "clean":
+        return result
+
+    bad = int(np.argmax(nonfinite_steps if n_nf else breach_steps))
+    result.update({"bad_step": int(first_step) + bad,
+                   "grad_norm": float(arr[bad, 0]),
+                   "loss": float(arr[bad, 3])})
+    from . import flight
+    flight.record(
+        "numerics",
+        "nonfinite_window" if n_nf else "grad_norm_breach",
+        severity="error", kind=kind, window=int(window),
+        step=result["bad_step"], mode=_mode,
+        grad_norm=float(arr[bad, 0]), loss=float(arr[bad, 3]),
+        nonfinite=float(arr[bad, 4]),
+        action=("skip" if _mode == "skip" else
+                "halt" if _mode == "halt" else "warn"))
+    dump_path = _dump_forensics(result, arr, entries, group_labels,
+                                nbatch)
+    result["dump"] = dump_path
+    log.warning(
+        "numerics: %s window %d (%s, step %d): grad_norm=%g loss=%g "
+        "nonfinite=%g — %s%s", verdict, window, kind,
+        result["bad_step"], arr[bad, 0], arr[bad, 3], arr[bad, 4],
+        {"warn": "continuing (MXNET_NUMERICS=warn)",
+         "skip": "poisoned update(s) dropped on device",
+         "halt": "halting"}[_mode],
+        f"; forensics: {dump_path}" if dump_path else "")
+    if _mode == "halt":
+        raise NonFiniteError(
+            where=f"{kind} window {window}", step=result["bad_step"],
+            stat="nonfinite" if n_nf else "grad_norm",
+            value=float(arr[bad, 4] if n_nf else arr[bad, 0]),
+            dump_path=dump_path,
+            detail=f"grad_norm={arr[bad, 0]:g} loss={arr[bad, 3]:g}")
+    return result
+
+
+def _last_good_checkpoint_step():
+    try:
+        from . import _checkpoint_snapshot
+        steps = [s.get("last_commit_step")
+                 for s in _checkpoint_snapshot().values()
+                 if isinstance(s.get("last_commit_step"), (int, float))]
+        return int(max(steps)) if steps else None
+    except Exception as e:  # noqa: BLE001 — forensics enrichment only
+        log.debug("numerics: checkpoint step lookup failed: %s", e)
+        return None
+
+
+def _dump_dir():
+    from .. import config as _config
+    from . import flight
+    return _config.get("MXNET_NUMERICS_DUMP_DIR") or flight.dump_dir()
+
+
+def _dump_forensics(result, arr, window_entries, group_labels, nbatch):
+    """Write ``mxnet-numerics-<pid>-<n>.json`` atomically; best-effort
+    (the verdict — and a halt's raise — outrank the dump)."""
+    global _dumps
+    from . import flight
+    from .. import random as _random
+    with _lock:
+        _dumps += 1
+        n = _dumps
+        history = list(_history)
+    directory = _dump_dir()
+    path = os.path.join(directory, f"mxnet-numerics-{os.getpid()}-{n}.json")
+    nf_by_group = {}
+    for g, label in enumerate(group_labels):
+        col = N_CORE + g
+        if col < arr.shape[1]:
+            with np.errstate(invalid="ignore"):
+                count = float(np.nan_to_num(arr[:, col], nan=0.0,
+                                            posinf=0.0).sum())
+            if count:
+                nf_by_group[label] = count
+    payload = {
+        "pid": os.getpid(),
+        "time": time.time(),
+        "mode": _mode,
+        "verdict": result["verdict"],
+        "kind": result["kind"],
+        "window": result["window"],
+        "first_step": result["first_step"],
+        "bad_step": result.get("bad_step"),
+        "steps": result["steps"],
+        "batch_index": nbatch,
+        "rank": os.environ.get("MXNET_MULTIHOST_PROC_ID"),
+        "rng_key_path": getattr(_random._state, "counter", None),
+        "last_good_checkpoint_step": _last_good_checkpoint_step(),
+        "nonfinite_by_bucket": nf_by_group,
+        "window_stats": window_entries,
+        "history": history,
+    }
+    try:
+        tmp = f"{path}.tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(payload, f, indent=1, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        _metrics()["dumps"].inc()
+        flight.prune(directory, "mxnet-numerics-")
+        return path
+    except OSError as e:
+        log.error("numerics: forensic dump failed: %s", e)
+        return None
+
+
+# -- serving output guard -----------------------------------------------------
+def guard_rows(outputs, n_rows):
+    """Row indices (set) of a serving batch whose float outputs contain
+    non-finite values — the output-health guard's screen.  ``outputs``
+    is the runner's list of batch-leading host arrays.  One vectorized
+    ``isfinite`` pass per float output; empty set when the guard is
+    off."""
+    if not _serving_guard:
+        return ()
+    bad = None
+    for out in outputs:
+        a = np.asarray(out)
+        if a.dtype.kind != "f" or a.shape[:1] != (n_rows,):
+            continue
+        ok = np.isfinite(a.reshape(n_rows, -1)).all(axis=1)
+        bad = ~ok if bad is None else (bad | ~ok)
+    if bad is None or not bad.any():
+        return ()
+    return set(np.nonzero(bad)[0].tolist())
+
+
+def record_serving_nonfinite(batcher, n=1):
+    """Account guard-failed requests + flight-ring the event."""
+    _metrics()["serving_nf"].inc(int(n), labels={"batcher": str(batcher)})
+    from . import flight
+    flight.record("numerics", "serving_nonfinite", severity="error",
+                  batcher=batcher, requests=int(n))
+
+
+# -- read side ----------------------------------------------------------------
+def history(last_n=None):
+    """Recent per-step stat entries (oldest first)."""
+    with _lock:
+        entries = list(_history)
+    return entries if last_n is None else entries[-int(last_n):]
+
+
+def summary():
+    """Aggregate counters + the grad-norm spread over the history ring
+    (the soak harness's drift gate reads this)."""
+    with _lock:
+        counts = dict(_counts)
+        windows = _windows
+        gns = [e["grad_norm"] for e in _history
+               if np.isfinite(e["grad_norm"])]
+    out = {"mode": _mode, "windows": windows, **counts}
+    if gns:
+        out["grad_norm_last"] = gns[-1]
+        out["grad_norm_max"] = float(max(gns))
+        out["grad_norm_median"] = float(np.median(gns))
+    return out
+
+
+def monitor_summary(last_n=64):
+    """``Monitor.toc()``-shaped rows ``[(step, stat_name, value_str)]``
+    from the stats history — the fused-compatible alternative to
+    ``Monitor(stat_func=...)`` (which opts the module out of the
+    fused/scanned/mesh fast paths; see monitor.py)."""
+    rows = []
+    for entry in history(last_n):
+        for stat in ("grad_norm", "param_norm", "update_ratio", "loss"):
+            rows.append((entry["step"], stat, str(entry[stat])))
+    return rows
+
+
+def _collector_snapshot():
+    snap = {"mode": _mode, "serving_guard": _serving_guard,
+            "dumps": _dumps}
+    snap.update(summary())
+    return snap
+
+
+def _reset_for_tests():
+    """Disarm, clear history/counters, detach scalers."""
+    global _mode, _windows, _dumps, _finite_jit
+    with _lock:
+        _history.clear()
+        _scalers.clear()
+        for k in _counts:
+            _counts[k] = 0
+        _windows = 0
+        _dumps = 0
+    _mode = "off"
+    _finite_jit = None

@@ -99,6 +99,57 @@ def test_loss_scaler_skips_overflow_and_halves_scale():
     assert scaler.loss_scale == s0 / 2
 
 
+def test_loss_scaler_backoff_growth_sequence_unchanged():
+    """ISSUE-14 satellite parity: has_overflow now routes through the
+    numerics observatory's fused multi-all-finite sentinel — the
+    dynamic-scale backoff/growth SEQUENCE must be unchanged vs the
+    definition (halve on overflow, double after scale_window clean
+    steps), and the verdicts must match a per-array numpy check."""
+    from mxnet_tpu.amp import LossScaler
+
+    rng = np.random.RandomState(0)
+    clean = [nd.array(rng.randn(4, 3).astype(np.float32))
+             for _ in range(3)]
+    poisoned = [g.copy() for g in clean]
+    poisoned[1] = nd.array(
+        np.where(np.arange(12).reshape(4, 3) == 7, np.inf,
+                 rng.randn(4, 3)).astype(np.float32))
+    nan_poisoned = [g.copy() for g in clean]
+    nan_poisoned[0] = nd.array(np.full((4, 3), np.nan, np.float32))
+
+    scaler = LossScaler(init_scale=2. ** 8, scale_factor=2.,
+                        scale_window=3)
+    # verdicts match the per-array reference check
+    assert scaler.has_overflow(poisoned) is True
+    assert scaler.has_overflow(nan_poisoned) is True
+    assert scaler.has_overflow(clean) is False
+    assert scaler.has_overflow([None, clean[0]]) is False
+
+    # sequence parity: drive the scaler through a scripted overflow
+    # pattern twice — once via has_overflow + update_scale, once via
+    # update_from_window (the in-window flag feed) — same scale at
+    # every point
+    pattern = [False, True, False, False, False, True, False, False,
+               False, False]
+    a = LossScaler(init_scale=2. ** 8, scale_factor=2., scale_window=3)
+    scales_a = []
+    for ov in pattern:
+        grads = poisoned if ov else clean
+        a.update_scale(a.has_overflow(grads))
+        scales_a.append(a.loss_scale)
+    b = LossScaler(init_scale=2. ** 8, scale_factor=2., scale_window=3)
+    b.update_from_window(pattern)
+    assert scales_a[-1] == b.loss_scale
+    # the canonical sequence: halve at each overflow, double after 3
+    # consecutive clean steps
+    c = LossScaler(init_scale=2. ** 8, scale_factor=2., scale_window=3)
+    scales_c = []
+    for ov in pattern:
+        c.update_scale(ov)
+        scales_c.append(c.loss_scale)
+    assert scales_a == scales_c
+
+
 def test_convert_hybrid_block():
     net = nn.HybridSequential()
     with net.name_scope():
