@@ -1118,6 +1118,48 @@ def measure_train_dispatch():
     }
 
 
+def measure_graftlint():
+    """ISSUE-15 lint-cost phase: ``graftlint_full_tree_s`` — one
+    whole-tree run of the two-phase engine (lexical rules + summary
+    collection + call-graph resolution + flow rules) in a fresh
+    subprocess, gated under the same 15 s wall budget ci/run.sh
+    enforces.  Lint runs before every test phase, so its cost is a hot
+    path like any other: the per-rule breakdown rides along from
+    ``--timings`` so a regression names its rule."""
+    import json as _json
+    import subprocess as _sp
+    import sys as _sys
+    import time as _t
+
+    budget_s = 15.0
+    best = float("inf")
+    timings = {}
+    for _ in range(2):
+        t0 = _t.perf_counter()
+        r = _sp.run(
+            [_sys.executable,
+             os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tools", "graftlint.py"),
+             "--fail-on-new", "--timings", "--json"],
+            capture_output=True, text=True, timeout=120)
+        wall = _t.perf_counter() - t0
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"graftlint --fail-on-new failed during bench: "
+                f"{r.stdout[-500:]}")
+        best = min(best, wall)
+        timings = _json.loads(r.stdout).get("timings", {})
+    slowest = sorted(((v, k) for k, v in timings.items()
+                      if not k.startswith("(")), reverse=True)[:3]
+    return {"graftlint": {
+        "metric": "graftlint_full_tree_s",
+        "value": round(best, 2), "unit": "s",
+        "budget_s": budget_s,
+        "gate_pass": bool(best < budget_s),
+        "slowest_rules": {k: round(v, 3) for v, k in slowest},
+    }}
+
+
 def measure_numerics_overhead():
     """ISSUE-14 numerics-observatory overheads, two gates:
 
@@ -1576,6 +1618,20 @@ def main():
                 log(f"numerics phase failed: {type(e).__name__}: {e}")
                 result["numerics"] = {
                     "metric": "numerics_overhead_pct",
+                    "error": f"{type(e).__name__}: {e}"}
+
+        if _cfg0.get("BENCH_LINT"):
+            try:
+                result.update(measure_graftlint())
+                gl = result["graftlint"]
+                log(f"[graftlint] full tree {gl['value']}s (budget "
+                    f"{gl['budget_s']}s, "
+                    f"{'PASS' if gl['gate_pass'] else 'FAIL'}); "
+                    f"slowest rules {gl['slowest_rules']}")
+            except Exception as e:
+                log(f"graftlint phase failed: {type(e).__name__}: {e}")
+                result["graftlint"] = {
+                    "metric": "graftlint_full_tree_s",
                     "error": f"{type(e).__name__}: {e}"}
 
         if _cfg0.get("BENCH_SERVE_SPIKE"):

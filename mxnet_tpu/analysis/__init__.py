@@ -1,23 +1,33 @@
 """graftlint — project-native static analysis for the mxnet_tpu codebase.
 
-A single-walk AST analysis framework plus the rules encoding this
-repository's own invariants (lock discipline, torn writes, host syncs in
-hot paths, tracer leaks, swallowed errors, env-knob drift).  See
-docs/lint.md for the rule catalog and ``tools/graftlint.py`` for the CLI.
+A two-phase whole-program engine: phase 1 is a single-walk AST pass per
+file that runs the lexical rules AND builds per-function summaries
+(calls, locks, collectives, rank-dependent branches, host effects,
+traced-body registrations); phase 2 resolves a project-wide call graph
+over the summaries and runs the flow rules (collective-divergence,
+lock-order-cycle, trace-host-escape) over it.  See docs/lint.md for
+the rule catalog and ``tools/graftlint.py`` for the CLI.
 
 This package is deliberately stdlib-only: the CLI loads it without
 importing ``mxnet_tpu`` itself (no jax, no numpy), so linting stays
 cheap enough to run before the test phase in CI.
 """
-from .core import (Context, Finding, Rule, all_rules, analyze_paths,
-                   analyze_source, diff_baseline, fingerprint_counts,
-                   load_baseline, make_rules, register_rule, render_json,
-                   render_text, write_baseline)
+from .core import (Context, Finding, GraphRule, ProjectResult, Rule,
+                   all_graph_rules, all_rules, analyze_paths,
+                   analyze_project, analyze_source, analyze_sources,
+                   diff_baseline, fingerprint_counts, load_baseline,
+                   make_graph_rules, make_rules, register_graph_rule,
+                   register_rule, render_json, render_text,
+                   render_timings, write_baseline)
+from .summary import Program, SummaryCollector
 from . import rules as _rules  # noqa: F401  — registers the rule classes
 
 __all__ = [
-    "Context", "Finding", "Rule", "all_rules", "analyze_paths",
-    "analyze_source", "diff_baseline", "fingerprint_counts",
-    "load_baseline", "make_rules", "register_rule", "render_json",
-    "render_text", "write_baseline",
+    "Context", "Finding", "GraphRule", "Program", "ProjectResult",
+    "Rule", "SummaryCollector", "all_graph_rules", "all_rules",
+    "analyze_paths", "analyze_project", "analyze_source",
+    "analyze_sources", "diff_baseline", "fingerprint_counts",
+    "load_baseline", "make_graph_rules", "make_rules",
+    "register_graph_rule", "register_rule", "render_json",
+    "render_text", "render_timings", "write_baseline",
 ]

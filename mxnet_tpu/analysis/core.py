@@ -16,6 +16,12 @@ Design:
   (stable across line-number drift: rule + path + symbol) to occurrence
   counts.  ``--fail-on-new`` fails only on findings whose fingerprint
   count exceeds the baseline, so the debt ratchet only tightens.
+* **Two phases.**  Lexical rules report during the walk; *graph rules*
+  (:class:`GraphRule`) run afterwards over the whole-program
+  :class:`~summary.Program` built by the summary collector that rides
+  the same walk (one parse, one traversal per file either way).  Graph
+  findings land at a (path, line) like any other and the same
+  suppression / baseline mechanics apply.
 """
 from __future__ import annotations
 
@@ -23,6 +29,7 @@ import ast
 import json
 import os
 import re
+import time
 
 SEVERITIES = ("error", "warning", "info")
 
@@ -131,6 +138,7 @@ class Rule:
 
 
 _RULES = {}
+_GRAPH_RULES = {}
 
 
 def register_rule(cls):
@@ -157,6 +165,49 @@ def make_rules(select=None, disable=()):
         ids = [i for i in ids if i in set(select)]
     ids = [i for i in ids if i not in set(disable)]
     return [_RULES[i]() for i in ids]
+
+
+class GraphRule:
+    """Phase-2 rule: runs once over the whole-program summary graph.
+
+    Subclass, set ``id``/``severity``/``doc``, implement
+    ``run(program)`` returning a list of :class:`Finding`, and decorate
+    with ``@register_graph_rule``.  ``program`` is a
+    :class:`summary.Program` with resolved call edges and the
+    collective/lock closures already computed."""
+
+    id = ""
+    severity = "warning"
+    doc = ""
+
+    def run(self, program):
+        return []
+
+    def finding(self, path, line, col, message, symbol):
+        return Finding(self.id, self.severity, path, line, col,
+                       message, symbol)
+
+
+def register_graph_rule(cls):
+    if not cls.id:
+        raise ValueError(f"graph rule {cls.__name__} has no id")
+    if cls.severity not in SEVERITIES:
+        raise ValueError(f"rule {cls.id}: bad severity {cls.severity!r}")
+    _GRAPH_RULES[cls.id] = cls
+    return cls
+
+
+def all_graph_rules():
+    """{rule_id: rule class} for every registered graph rule."""
+    return dict(_GRAPH_RULES)
+
+
+def make_graph_rules(select=None, disable=()):
+    ids = list(_GRAPH_RULES)
+    if select:
+        ids = [i for i in ids if i in set(select)]
+    ids = [i for i in ids if i not in set(disable)]
+    return [_GRAPH_RULES[i]() for i in ids]
 
 
 # -- lock detection shared by core and rules ---------------------------------
@@ -265,9 +316,60 @@ def _is_suppressed(finding, supp):
     return False
 
 
+# -- timings -----------------------------------------------------------------
+class _TimedRule:
+    """Per-rule wall-time proxy: forwards every callback, accumulating
+    ``perf_counter`` deltas.  Only constructed under ``--timings`` —
+    the clock reads roughly double per-node dispatch cost."""
+
+    __slots__ = ("_rule", "id", "severity", "elapsed")
+
+    def __init__(self, rule):
+        self._rule = rule
+        self.id = rule.id
+        self.severity = rule.severity
+        self.elapsed = 0.0
+
+    def begin_file(self, ctx):
+        t0 = time.perf_counter()
+        self._rule.begin_file(ctx)
+        self.elapsed += time.perf_counter() - t0
+
+    def visit(self, node, ctx):
+        t0 = time.perf_counter()
+        self._rule.visit(node, ctx)
+        self.elapsed += time.perf_counter() - t0
+
+    def depart(self, node, ctx):
+        t0 = time.perf_counter()
+        self._rule.depart(node, ctx)
+        self.elapsed += time.perf_counter() - t0
+
+    def end_file(self, ctx):
+        t0 = time.perf_counter()
+        self._rule.end_file(ctx)
+        self.elapsed += time.perf_counter() - t0
+
+
+class ProjectResult:
+    """What ``analyze_project`` hands back: the merged findings, parse
+    errors, the whole-program summary graph, and (under ``--timings``)
+    the per-rule wall-time table."""
+
+    __slots__ = ("findings", "errors", "program", "timings")
+
+    def __init__(self, findings, errors, program, timings):
+        self.findings = findings
+        self.errors = errors
+        self.program = program
+        self.timings = timings
+
+
 # -- entry points ------------------------------------------------------------
 def analyze_source(source, path="<string>", rules=None):
-    """Lint one source string; returns the (unsuppressed) findings."""
+    """Lint one source string with the LEXICAL rules; returns the
+    (unsuppressed) findings.  Whole-program rules need
+    ``analyze_project``/``analyze_sources``."""
     if rules is None:
         rules = make_rules()
     tree = ast.parse(source, filename=path)
@@ -295,23 +397,115 @@ def iter_py_files(paths):
                         yield os.path.join(dirpath, fn)
 
 
-def analyze_paths(paths, rules=None, root=None):
-    """Lint every ``.py`` under ``paths``; paths in findings are made
-    relative to ``root`` (for stable fingerprints)."""
-    if rules is None:
-        rules = make_rules()
-    findings = []
-    errors = []
+def _iter_sources(paths, root):
     for path in iter_py_files(paths):
         rel = os.path.relpath(path, root) if root else path
         try:
             with open(path, encoding="utf-8") as f:
-                source = f.read()
-            findings.extend(analyze_source(source, path=rel, rules=rules))
-        except (SyntaxError, UnicodeDecodeError) as e:
-            errors.append((rel, f"{type(e).__name__}: {e}"))
+                yield rel, f.read()
+        except UnicodeDecodeError as e:
+            yield rel, e
+
+
+def analyze_project(paths, rules=None, graph_rules=None, root=None,
+                    timings=False):
+    """The two-phase engine over every ``.py`` under ``paths``.
+
+    Phase 1: one parse + one walk per file runs the lexical rules AND
+    the summary collector.  Phase 2: the call graph is resolved over
+    the collected summaries and each graph rule runs once over it.
+    Suppression comments apply to both phases (a graph finding landing
+    on a suppressed line is silenced like any other).  Paths in
+    findings are made relative to ``root`` (stable fingerprints).
+    """
+    if rules is None:
+        rules = make_rules()
+    if graph_rules is None:
+        graph_rules = make_graph_rules()
+    return _analyze_file_set(_iter_sources(paths, root), rules,
+                             graph_rules, timings)
+
+
+def analyze_sources(sources, rules=None, graph_rules=None):
+    """Two-phase analysis over in-memory ``{path: source}`` mappings —
+    the fixture-test entry point for whole-program rules."""
+    if rules is None:
+        rules = make_rules()
+    if graph_rules is None:
+        graph_rules = make_graph_rules()
+    items = sorted(sources.items())
+    return _analyze_file_set(iter(items), rules, graph_rules,
+                             False).findings
+
+
+def _analyze_file_set(items, rules, graph_rules, timings):
+    from .summary import Program, SummaryCollector
+
+    program = Program()
+    collector = SummaryCollector(program)
+    walk_rules = list(rules) + [collector]
+    timed = None
+    parse_s = 0.0
+    if timings:
+        walk_rules = [_TimedRule(r) for r in walk_rules]
+        timed = walk_rules
+    findings, errors = [], []
+    supp_by_path = {}
+    t_total0 = time.perf_counter()
+    for rel, source in items:
+        if isinstance(source, UnicodeDecodeError):
+            errors.append((rel, f"UnicodeDecodeError: {source}"))
+            continue
+        try:
+            t0 = time.perf_counter()
+            tree = ast.parse(source, filename=rel)
+            parse_s += time.perf_counter() - t0
+        except SyntaxError as e:
+            errors.append((rel, f"SyntaxError: {e}"))
+            continue
+        ctx = Context(rel)
+        for r in walk_rules:
+            r.begin_file(ctx)
+        _walk(tree, ctx, walk_rules)
+        for r in walk_rules:
+            r.end_file(ctx)
+        supp = _suppressions(source)
+        supp_by_path[ctx.path] = supp
+        findings.extend(f for f in ctx.findings
+                        if not _is_suppressed(f, supp))
+
+    t0 = time.perf_counter()
+    program.finish()
+    resolve_s = time.perf_counter() - t0
+
+    graph_times = {}
+    for gr in graph_rules:
+        t0 = time.perf_counter()
+        for f in gr.run(program):
+            if not _is_suppressed(f, supp_by_path.get(f.path, {})):
+                findings.append(f)
+        graph_times[gr.id] = time.perf_counter() - t0
+
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
-    return findings, errors
+
+    timing_table = None
+    if timings:
+        timing_table = {"(parse)": parse_s,
+                        "(call-graph)": resolve_s}
+        for tr in timed:
+            name = tr.id if tr.id != SummaryCollector.id else \
+                "(summaries)"
+            timing_table[name] = tr.elapsed
+        timing_table.update(graph_times)
+        timing_table["(total)"] = time.perf_counter() - t_total0
+    return ProjectResult(findings, errors, program, timing_table)
+
+
+def analyze_paths(paths, rules=None, root=None):
+    """Back-compat wrapper: lexical + graph findings as
+    ``(findings, errors)``."""
+    res = analyze_project(paths, rules=rules, root=root)
+    return res.findings, res.errors
 
 
 # -- baseline ----------------------------------------------------------------
@@ -379,8 +573,30 @@ def render_text(findings, errors=(), title="graftlint"):
     return "\n".join(lines)
 
 
-def render_json(findings, errors=()):
-    return json.dumps({
+JSON_SCHEMA_VERSION = 2
+
+
+def render_json(findings, errors=(), call_graph=None, timings=None):
+    doc = {
+        "schema_version": JSON_SCHEMA_VERSION,
         "findings": [f.as_dict() for f in findings],
         "parse_errors": [{"path": p, "message": m} for p, m in errors],
-    }, indent=1)
+    }
+    if call_graph is not None:
+        doc["call_graph"] = dict(call_graph)
+    if timings is not None:
+        doc["timings"] = {k: round(v, 4) for k, v in timings.items()}
+    return json.dumps(doc, indent=1)
+
+
+def render_timings(timings):
+    """Per-rule wall-time table (``--timings``), slowest first."""
+    rows = sorted(((v, k) for k, v in timings.items() if k != "(total)"),
+                  reverse=True)
+    total = timings.get("(total)", 0.0)
+    lines = ["graftlint timings (where lint time goes):"]
+    for v, k in rows:
+        pct = 100.0 * v / total if total else 0.0
+        lines.append(f"  {k:<28} {v * 1e3:9.1f} ms  {pct:5.1f}%")
+    lines.append(f"  {'(total)':<28} {total * 1e3:9.1f} ms")
+    return "\n".join(lines)

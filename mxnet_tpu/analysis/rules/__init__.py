@@ -1,11 +1,14 @@
 """graftlint rule modules — importing this package registers every rule
-with the core registry (see ``core.register_rule``)."""
-from . import (env_drift, host_sync, leaked_thread, lock_discipline,
+with the core registry (see ``core.register_rule`` /
+``core.register_graph_rule``)."""
+from . import (collective_divergence, env_drift, host_sync,
+               leaked_thread, lock_discipline, lock_order_cycle,
                metric_cardinality, naked_retry, per_param_collective,
-               phase_timing, swallowed_error, torn_write, tracer_leak,
-               unbounded_wait)
+               phase_timing, swallowed_error, torn_write,
+               trace_host_escape, tracer_leak, unbounded_wait)
 
-__all__ = ["env_drift", "host_sync", "leaked_thread", "lock_discipline",
+__all__ = ["collective_divergence", "env_drift", "host_sync",
+           "leaked_thread", "lock_discipline", "lock_order_cycle",
            "metric_cardinality", "naked_retry", "per_param_collective",
-           "phase_timing", "swallowed_error", "torn_write", "tracer_leak",
-           "unbounded_wait"]
+           "phase_timing", "swallowed_error", "torn_write",
+           "trace_host_escape", "tracer_leak", "unbounded_wait"]

@@ -1,0 +1,82 @@
+"""collective-divergence — the SPMD-deadlock shape, whole-program.
+
+Origin: ISSUE 11's elastic multi-host runtime.  Every rank of a
+multi-process mesh runs the SAME program; a collective
+(``psum``/``all_gather``/``barrier``/``window_rendezvous``/…) is a
+synchronization point EVERY rank must reach in the same order.  A
+branch whose condition differs per rank (``jax.process_index()``,
+``self.rank``, ``mesh.local_*``) that leads — directly or through any
+call chain — to a collective means some ranks arrive and some never
+do: the arrivers block until the peer timeout (at best) or forever
+(at worst).  The classic leader-only checkpoint bug::
+
+    if jax.process_index() == 0:
+        self._commit()          # ...which calls kv.barrier()
+
+deadlocks the whole world even though no line of it LOOKS blocking.
+
+Fires when a collective call is reachable under a rank-divergent
+branch: either lexically inside the branch body, or via a call at a
+guarded site whose callee *transitively* issues a collective
+(resolved over the project call graph — the finding names the chain).
+A rank-guarded early return (``if rank != 0: return``) marks the rest
+of the function divergent fallthrough and is reported the same way.
+
+Near-misses that stay silent: leader-only work AFTER an unconditional
+barrier (the barrier is not under the guard), rank-guarded
+logging/metrics-only branches (no collective reachable — unresolvable
+calls are assumed benign, open-world), and uniform conditions
+(``world_size``, step counters) that every rank computes identically.
+"""
+from __future__ import annotations
+
+from ..core import GraphRule, register_graph_rule
+
+
+def _chain_text(chain):
+    return " -> ".join(f"{name}()" for name in chain)
+
+
+@register_graph_rule
+class CollectiveDivergenceRule(GraphRule):
+    id = "collective-divergence"
+    severity = "error"
+    doc = ("collective (psum/all_gather/barrier/rendezvous) reachable "
+           "under a rank-divergent branch — the SPMD deadlock shape")
+
+    def run(self, program):
+        findings = []
+        for fs in program.functions.values():
+            for coll in fs.collectives:
+                if coll.guard is None:
+                    continue
+                findings.append(self._report(fs, coll.lineno, coll.col,
+                                             coll.guard, coll.kind,
+                                             fs.path, coll.lineno,
+                                             (fs.name,)))
+            for call in fs.calls:
+                if call.guard is None or call.callee is None:
+                    continue
+                hit = program.collective_closure.get(call.callee)
+                if hit is None:
+                    continue
+                kind, cpath, cline, chain = hit
+                findings.append(self._report(
+                    fs, call.lineno, call.col, call.guard, kind,
+                    cpath, cline, (fs.name,) + chain))
+        return findings
+
+    def _report(self, fs, line, col, guard, kind, cpath, cline, chain):
+        where = "the rest of the function after the rank-guarded " \
+                "return" if guard.via_return else "a rank-divergent " \
+                "branch"
+        via = "" if len(chain) == 1 else \
+            f" via {_chain_text(chain)}"
+        return self.finding(
+            fs.path, line, col,
+            f"collective {kind}() ({cpath}:{cline}) is reachable "
+            f"under {where} (condition `{guard.cond}` at line "
+            f"{guard.lineno}){via} — ranks that skip the branch never "
+            "arrive and the mesh deadlocks; hoist the collective out "
+            "of the guard or make every rank take it",
+            symbol=f"{fs.qual}:{kind}.{chain[-1]}")

@@ -1,0 +1,231 @@
+"""lock-order-cycle — whole-program lock-acquisition ordering.
+
+Origin: the five threaded subsystems
+(serving/telemetry/checkpoint/kvstore/chaos) each own locks, and their
+call graphs cross — the router routes under its pool lock into
+batchers that own worker locks; the alert engine ticks under its
+engine lock into the metrics registry; checkpoint hooks run into
+serving.  Per-file lexical rules cannot see that thread A acquires
+``X`` then ``Y`` while thread B acquires ``Y`` then ``X``: each file
+looks locally disciplined, and the AB/BA deadlock only exists in the
+composition.
+
+Two prongs:
+
+* **(a) acquisition cycles** — a global acquired-while-holding graph:
+  an edge ``X -> Y`` whenever ``Y`` is acquired (directly, or by any
+  transitively-called function) while ``X`` is held.  ANY cycle is an
+  error: some interleaving of two threads deadlocks.  Lock identity is
+  per-class (``module.Class._lock``) — every instance of a class must
+  follow the same order, and instances of the SAME class are not
+  distinguished (self-edges are skipped: re-entry is the
+  lock-discipline rule's business, and hand-over-hand within one class
+  cannot be checked statically).
+* **(b) callback-under-lock** — invoking a user-supplied hook
+  (``for fn in self._flip_hooks: fn(...)`` / ``hook()`` /
+  ``probe()`` — an UNRESOLVABLE callable with a hook-ish name) while
+  holding a lock, in the threaded subsystems.  The callee can run
+  arbitrary user code: re-enter the owning object (instant deadlock on
+  a non-reentrant lock) or acquire another subsystem's lock (a cycle
+  edge no static analysis can see).  The repo idiom is copy-then-call:
+  snapshot the hook list under the lock, invoke outside it.
+
+Near-misses that stay silent: nested acquisition in one consistent
+order everywhere (a DAG), re-entry of the same lock, hook invocation
+after the copy-then-call idiom (no lock held at the call), resolvable
+calls (those are walked, not guessed at), and locks acquired at
+exactly ONE site in the whole program (a pure serialization latch —
+the ``_tick_lock`` idiom: nothing else can be waiting on it while
+holding another lock, so user code under it forms no ordering edge).
+"""
+from __future__ import annotations
+
+from ..core import GraphRule, register_graph_rule
+from ..summary import HOOKISH_EXACT, HOOKISH_RECEIVERS, HOOKISH_TOKENS
+
+# modules whose classes provably run methods on multiple threads —
+# prong (b) polices only these (offline tooling may call whatever it
+# likes under whatever it likes)
+THREADED_PREFIXES = (
+    "mxnet_tpu/serving/", "mxnet_tpu/telemetry/", "mxnet_tpu/checkpoint/",
+    "mxnet_tpu/chaos/", "mxnet_tpu/parallel/", "mxnet_tpu/kvstore",
+)
+
+
+def _hookish(call):
+    name = call.parts[-1]
+    if name in HOOKISH_EXACT:
+        return True
+    low = name.lower()
+    if any(t in low for t in HOOKISH_TOKENS):
+        return True
+    # a method on a plugin-shaped receiver: `rule.evaluate(...)`,
+    # `builder.build(...)` — the receiver name marks user-owned code
+    return len(call.parts) > 1 and call.parts[0] in HOOKISH_RECEIVERS
+
+
+@register_graph_rule
+class LockOrderCycleRule(GraphRule):
+    id = "lock-order-cycle"
+    severity = "error"
+    doc = ("cycle in the global acquired-while-holding lock graph, or "
+           "a user hook invoked while holding a lock")
+
+    def run(self, program):
+        findings = []
+        edges = {}  # (held, acquired) -> provenance dict
+        # acquisition sites per lock across the program: a lock taken
+        # at exactly ONE site is a pure serialization latch (the
+        # `_tick_lock` idiom) — no other code path can be waiting on
+        # it while holding something else, so a hook under it is not
+        # an ordering edge (prong (b) near-miss)
+        acq_sites = {}
+        for fs in program.functions.values():
+            for la in fs.lock_acquires:
+                acq_sites[la.lock] = acq_sites.get(la.lock, 0) + 1
+        for fs in program.functions.values():
+            # direct nested acquisitions
+            for la in fs.lock_acquires:
+                for held in la.held:
+                    self._edge(edges, held, la.lock, fs, la.lineno,
+                               f"{fs.qual}() acquires {la.lock} while "
+                               f"holding {held}")
+            for call in fs.calls:
+                if not call.held:
+                    continue
+                # interprocedural: callee (transitively) acquires
+                if call.callee is not None:
+                    for lock, (lpath, lline, chain) in \
+                            program.lock_closure.get(call.callee,
+                                                     {}).items():
+                        for held in call.held:
+                            self._edge(
+                                edges, held, lock, fs, call.lineno,
+                                f"{fs.qual}() holds {held} and calls "
+                                + " -> ".join(f"{c}()" for c in chain)
+                                + f" which acquires {lock} "
+                                f"({lpath}:{lline})")
+                # prong (b): unresolvable hook-ish call under a lock
+                elif _hookish(call) and \
+                        fs.path.startswith(THREADED_PREFIXES) and \
+                        any(acq_sites.get(h, 0) >= 2 for h in call.held):
+                    findings.append(self.finding(
+                        fs.path, call.lineno, call.col,
+                        f"{call.display}(...) is invoked while holding "
+                        f"{', '.join(call.held)} in {fs.qual}() — a "
+                        "user-supplied hook under a lock can re-enter "
+                        "the owner or take another subsystem's lock "
+                        "(deadlock/ordering edge the analyzer cannot "
+                        "see); snapshot the hook list under the lock "
+                        "and call OUTSIDE it",
+                        symbol=f"{fs.qual}:hook.{call.parts[-1]}"))
+        findings.extend(self._cycles(edges))
+        return findings
+
+    def _edge(self, edges, held, acquired, fs, lineno, desc):
+        if held == acquired:
+            return  # re-entry: lock-discipline's business
+        edges.setdefault((held, acquired),
+                         {"path": fs.path, "line": lineno,
+                          "desc": desc})
+
+    def _cycles(self, edges):
+        """One finding per strongly-connected component of size >= 2
+        (deterministic: reported at the lexicographically-first lock's
+        outgoing edge, cycle path enumerated in the message)."""
+        adj = {}
+        for (a, b) in edges:
+            adj.setdefault(a, set()).add(b)
+            adj.setdefault(b, set())
+        sccs = _tarjan(adj)
+        findings = []
+        for comp in sccs:
+            if len(comp) < 2:
+                continue
+            locks = sorted(comp)
+            cycle = self._cycle_path(locks[0], set(comp), adj)
+            legs = []
+            for i in range(len(cycle) - 1):
+                prov = edges[(cycle[i], cycle[i + 1])]
+                legs.append(f"{cycle[i]} -> {cycle[i + 1]} "
+                            f"({prov['path']}:{prov['line']}: "
+                            f"{prov['desc']})")
+            first = edges[(cycle[0], cycle[1])]
+            findings.append(self.finding(
+                first["path"], first["line"], 0,
+                "lock-order cycle: " + "; ".join(legs) +
+                " — two threads taking these in opposite order "
+                "deadlock; pick ONE global order (document it) or "
+                "narrow one side to copy-then-call",
+                symbol="cycle:" + "->".join(locks)))
+        return findings
+
+    @staticmethod
+    def _cycle_path(start, comp, adj):
+        """Shortest concrete cycle through ``start`` within one SCC
+        (BFS over the component's edges; deterministic)."""
+        import collections
+        prev = {}
+        queue = collections.deque([start])
+        while queue:
+            cur = queue.popleft()
+            for nxt in sorted(adj.get(cur, ())):
+                if nxt == start and cur != start:
+                    back = []
+                    node = cur
+                    while node != start:
+                        back.append(node)
+                        node = prev[node]
+                    return [start] + list(reversed(back)) + [start]
+                if nxt in comp and nxt not in prev and nxt != start:
+                    prev[nxt] = cur
+                    queue.append(nxt)
+        return [start, start]
+
+
+def _tarjan(adj):
+    """Iterative Tarjan SCC (stdlib-free, recursion-safe)."""
+    index = {}
+    low = {}
+    on_stack = set()
+    stack = []
+    sccs = []
+    counter = [0]
+    for root in sorted(adj):
+        if root in index:
+            continue
+        work = [(root, iter(sorted(adj.get(root, ()))))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in index:
+                    index[nxt] = low[nxt] = counter[0]
+                    counter[0] += 1
+                    stack.append(nxt)
+                    on_stack.add(nxt)
+                    work.append((nxt, iter(sorted(adj.get(nxt, ())))))
+                    advanced = True
+                    break
+                if nxt in on_stack:
+                    low[node] = min(low[node], index[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                sccs.append(comp)
+    return sccs

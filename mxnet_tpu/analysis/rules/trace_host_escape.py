@@ -1,0 +1,97 @@
+"""trace-host-escape — host work reachable from traced program bodies.
+
+Origin: ISSUE 14's in-trace numerics.  The whole design of the fused/
+scanned/mesh train steps is that a ``jit``/``shard_map``/``lax.scan``
+body is a CLOSED device program — one dispatch per window, host control
+only at the boundary.  A host-effecting call reached *through any call
+chain* from the traced body breaks that silently, in one of two ways:
+
+* it runs at TRACE time only (``time.time()``, Python RNG, metric
+  ``.inc()``) — the value freezes into the compiled program, the
+  side effect fires once per compile instead of once per step, and
+  nobody notices until the number is wrong;
+* it forces a device->host sync or materialization (``.item()``,
+  ``np.asarray``, ``block_until_ready``) — a ConcretizationTypeError
+  at best, a silent per-step host round-trip at worst (PyGraph makes
+  the same argument for CUDA-graph capture: no host work inside the
+  captured region, enforced by analysis, not convention).
+
+The lexical ``tracer-leak`` rule sees only the decorated function's
+own body.  This rule closes it over the project call graph: roots are
+every traced-body registration site (``jax.jit(step)``,
+``shard_map(window, ...)``, ``jax.lax.scan(body, ...)``, jit-style
+decorators) and every host effect reachable from a root is reported at
+the effect's site with the chain that reaches it.
+
+Near-misses that stay silent: host effects in functions NOT reachable
+from any traced root (boundary code — the whole point of the window
+design), unresolvable calls (open-world: dynamic dispatch is assumed
+benign rather than guessed at), and ``float()/int()`` of
+non-parameter values (trace-time Python on static config).
+"""
+from __future__ import annotations
+
+from ..core import GraphRule, register_graph_rule
+
+_MAX_DEPTH = 12
+
+_EFFECT_VERB = {
+    "host_sync": "forces a device->host sync inside the traced program",
+    "numpy": "materializes a host array inside the traced program "
+             "(runs at trace time on tracers it will fail on; on "
+             "concrete values it hides a host round-trip)",
+    "clock": "reads the host clock at TRACE time — the value freezes "
+             "into the compiled program",
+    "metric": "updates a host-side metric at TRACE time — it fires "
+              "once per compile, not once per step",
+    "rng": "draws from the PYTHON rng at trace time — the draw "
+           "freezes into the compiled program (use jax PRNG keys)",
+    "concretize": "concretizes a (likely traced) argument",
+}
+
+
+@register_graph_rule
+class TraceHostEscapeRule(GraphRule):
+    id = "trace-host-escape"
+    severity = "error"
+    doc = ("host-effecting call (.item()/np.asarray/time.time/metric "
+           ".inc/python rng) reachable through the call graph from a "
+           "jit/shard_map/scan traced body")
+
+    def run(self, program):
+        findings = []
+        reported = set()  # (path, line, col) — one finding per site
+        for root in sorted(program.traced_roots, key=lambda f: f.id):
+            stack = [(root, (root.name,))]
+            visited = {root.id}
+            while stack:
+                fs, chain = stack.pop()
+                for eff in fs.host_effects:
+                    key = (fs.path, eff.lineno, eff.col)
+                    if key in reported:
+                        continue
+                    reported.add(key)
+                    findings.append(self._report(root, fs, chain, eff))
+                if len(chain) >= _MAX_DEPTH:
+                    continue
+                for call in fs.calls:
+                    callee = call.callee
+                    if callee is None or callee in visited:
+                        continue
+                    visited.add(callee)
+                    target = program.functions.get(callee)
+                    if target is not None:
+                        stack.append((target, chain + (target.name,)))
+        return findings
+
+    def _report(self, root, fs, chain, eff):
+        via = "" if len(chain) == 1 else \
+            " via " + " -> ".join(f"{c}()" for c in chain)
+        return self.finding(
+            fs.path, eff.lineno, eff.col,
+            f"{eff.detail} in {fs.qual}() is reachable from the "
+            f"traced body {root.name}() ({root.path}:{root.lineno})"
+            f"{via} — {_EFFECT_VERB.get(eff.kind, 'host effect')}; "
+            "move it to the window boundary or fold it into the "
+            "traced outputs",
+            symbol=f"{root.name}->{fs.name}:{eff.kind}{eff.detail}")

@@ -1,0 +1,883 @@
+"""graftlint phase 1 — per-function summaries and the project call graph.
+
+The lexical rules see one function in one file at a time; the bug
+classes the stack actually ships (collective deadlocks from
+rank-divergent control flow, lock-ordering cycles across threaded
+subsystems, host-effecting calls reached *transitively* from donated
+jit/shard_map bodies) are whole-program properties.  This module builds
+the substrate the flow rules (phase 2) run over:
+
+* :class:`SummaryCollector` is a pseudo-rule that rides the SAME single
+  AST walk the lexical rules use (one parse, one traversal per file)
+  and records, per function: calls made (with the locks held and any
+  rank-guard active at the call site), locks acquired while holding
+  other locks, collectives issued, host-effect calls, and
+  ``jit``/``shard_map``/``lax.scan`` body registrations.
+* :class:`Program` indexes every module's summaries, resolves call
+  sites to summaries (``self.method``, module-level functions, imported
+  names, ``self._attr.method`` via ``__init__`` attribute-type
+  inference), and computes the transitive closures the flow rules need
+  (reaches-a-collective, acquires-locks, host-effects) by worklist
+  propagation.
+
+Resolution policy is **open-world**: a call that cannot be resolved
+inside the analyzed tree (dynamic dispatch, stdlib, foreign objects) is
+assumed benign — it contributes to the ``unresolved_calls`` stat, never
+to a finding.  That keeps the flow rules' false-positive rate at the
+lexical rules' level: every edge in a reported chain is a real
+reference the engine can name.
+
+Stdlib-``ast`` only, like the rest of the package.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Rule, is_lockish_name
+
+# -- token sets --------------------------------------------------------------
+# names whose presence in a branch condition marks it rank-divergent:
+# different processes of the same SPMD program evaluate it differently
+RANK_TOKENS = {
+    "process_index", "process_id", "proc_id", "rank", "local_rank",
+    "node_rank", "host_id", "is_leader", "is_coordinator", "leader_rank",
+}
+
+# collective operations: every rank of the mesh/world must issue them
+# in the same order or the program deadlocks
+COLLECTIVE_TOKENS = {
+    "psum", "psum_scatter", "all_gather", "all_reduce", "reduce_scatter",
+    "ppermute", "pmean", "pmax", "pmin", "all_to_all", "barrier",
+    "rendezvous", "window_rendezvous",
+}
+
+# transforms whose body argument becomes a traced program
+_TRACE_TRANSFORMS = {"jit", "shard_map", "pmap"}
+
+# host-effect classification (for trace-host-escape):
+_HOST_SYNC_METHODS = {"item", "tolist", "asnumpy", "asscalar",
+                      "block_until_ready"}
+_NUMPY_BASES = {"np", "numpy", "onp"}
+_NUMPY_MATERIALIZERS = {"asarray", "array", "frombuffer", "copy"}
+_CLOCK_ATTRS = {"time", "perf_counter", "monotonic", "process_time",
+                "sleep"}
+_METRIC_METHODS = {"inc", "dec", "observe"}
+_METRIC_RECV_TOKENS = ("counter", "gauge", "histogram", "registry",
+                       "metric")
+_RNG_ATTRS = {"random", "randint", "uniform", "gauss", "normal",
+              "choice", "shuffle", "randrange", "sample", "randn"}
+
+# callable names that read as user-supplied callbacks when invoked
+# through an unresolvable reference (the callback-under-lock prong):
+# the CALLEE name itself (`fn(...)`, `builder(...)`), or a method on a
+# plugin-shaped RECEIVER (`rule.evaluate(...)`, `hook.fire(...)`)
+HOOKISH_EXACT = {"fn", "cb", "func", "callback", "hook", "probe",
+                 "builder"}
+HOOKISH_TOKENS = ("hook", "callback", "listener", "handler", "probe")
+HOOKISH_RECEIVERS = {"rule", "hook", "probe", "callback", "listener",
+                     "handler", "builder", "fn", "cb"}
+
+# builtins: calls to these are resolved-to-nothing, not "unresolved"
+_BUILTINS = {
+    "len", "isinstance", "getattr", "setattr", "hasattr", "type", "id",
+    "str", "repr", "int", "float", "bool", "list", "dict", "set",
+    "tuple", "frozenset", "sorted", "reversed", "enumerate", "zip",
+    "map", "filter", "range", "min", "max", "sum", "abs", "round",
+    "print", "open", "iter", "next", "super", "callable", "vars",
+    "format", "divmod", "any", "all", "hash", "ord", "chr", "bytes",
+    "bytearray", "memoryview", "object", "property", "staticmethod",
+    "classmethod", "issubclass", "delattr", "globals", "locals",
+    "exec", "eval", "compile", "slice", "pow", "hex", "oct", "bin",
+    "input", "complex", "NotImplementedError", "ValueError",
+    "TypeError", "KeyError", "RuntimeError", "OSError", "IOError",
+    "Exception", "BaseException", "StopIteration", "KeyboardInterrupt",
+    "AttributeError", "IndexError", "NotImplemented", "ArithmeticError",
+    "ZeroDivisionError", "OverflowError", "FileNotFoundError",
+    "PermissionError", "TimeoutError", "ConnectionError",
+    "InterruptedError", "BrokenPipeError", "UnicodeDecodeError",
+    "ImportError", "ModuleNotFoundError", "MemoryError",
+    "RecursionError", "SystemExit", "GeneratorExit", "AssertionError",
+    "LookupError", "NameError", "UnboundLocalError", "EOFError",
+}
+
+
+def module_name_for(path):
+    """Dotted module name for a repo-relative path
+    (``mxnet_tpu/serving/router.py`` -> ``mxnet_tpu.serving.router``;
+    ``pkg/__init__.py`` -> ``pkg``)."""
+    p = path.replace("\\", "/")
+    if p.endswith(".py"):
+        p = p[:-3]
+    parts = [seg for seg in p.split("/") if seg and seg != ".."]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts) or "<root>"
+
+
+def _tail(expr):
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return ""
+
+
+def _expr_text(expr, limit=48):
+    try:
+        text = ast.unparse(expr)
+    except (ValueError, RecursionError):  # display only; never fail the walk
+        text = "<expr>"
+    return text if len(text) <= limit else text[:limit - 3] + "..."
+
+
+class GuardInfo:
+    """A rank-divergent branch active at an event site."""
+
+    __slots__ = ("cond", "lineno", "via_return")
+
+    def __init__(self, cond, lineno, via_return=False):
+        self.cond = cond          # short source text of the condition
+        self.lineno = lineno
+        # True when the guard is the REST of a function after a
+        # rank-guarded early return/raise (divergent fallthrough)
+        self.via_return = via_return
+
+
+class CallSite:
+    """One call expression, with its resolution descriptor and the
+    synchronization/divergence context it executes under."""
+
+    __slots__ = ("kind", "parts", "lineno", "col", "held", "guard",
+                 "callee", "display")
+
+    def __init__(self, kind, parts, lineno, col, held, guard, display):
+        self.kind = kind        # name | self | selfattr | attr | dyn
+        self.parts = parts
+        self.lineno = lineno
+        self.col = col
+        self.held = held        # tuple of lock ids held at the call
+        self.guard = guard      # GuardInfo or None
+        self.display = display  # source text of the callee expression
+        self.callee = None      # function id, filled by Program.finish
+
+
+class LockAcquire:
+    __slots__ = ("lock", "held", "lineno", "col")
+
+    def __init__(self, lock, held, lineno, col):
+        self.lock = lock
+        self.held = held        # tuple of lock ids held when acquiring
+        self.lineno = lineno
+        self.col = col
+
+
+class HostEffect:
+    __slots__ = ("kind", "detail", "lineno", "col")
+
+    def __init__(self, kind, detail, lineno, col):
+        self.kind = kind        # host_sync|numpy|clock|metric|rng|concretize
+        self.detail = detail    # e.g. "time.time" or ".item"
+        self.lineno = lineno
+        self.col = col
+
+
+class Collective:
+    __slots__ = ("kind", "lineno", "col", "guard", "held")
+
+    def __init__(self, kind, lineno, col, guard, held):
+        self.kind = kind
+        self.lineno = lineno
+        self.col = col
+        self.guard = guard
+        self.held = held
+
+
+class TracedReg:
+    """A jit/shard_map/scan body registration site."""
+
+    __slots__ = ("transform", "kind", "parts", "lineno")
+
+    def __init__(self, transform, kind, parts, lineno):
+        self.transform = transform
+        self.kind = kind
+        self.parts = parts
+        self.lineno = lineno
+
+
+class FunctionSummary:
+    __slots__ = ("id", "module", "path", "qual", "name", "lineno",
+                 "class_name", "parent", "children", "calls",
+                 "collectives", "host_effects", "lock_acquires",
+                 "traced_regs", "is_traced_root", "rest_guard")
+
+    def __init__(self, fid, module, path, qual, name, lineno,
+                 class_name=None, parent=None):
+        self.id = fid
+        self.module = module
+        self.path = path
+        self.qual = qual          # dotted within the module
+        self.name = name
+        self.lineno = lineno
+        self.class_name = class_name
+        self.parent = parent      # enclosing function id, or None
+        self.children = {}        # nested def name -> function id
+        self.calls = []
+        self.collectives = []
+        self.host_effects = []
+        self.lock_acquires = []
+        self.traced_regs = []
+        self.is_traced_root = False   # @jit-style decorated
+        self.rest_guard = None        # GuardInfo after guarded return
+
+    def __repr__(self):
+        return f"FunctionSummary({self.id})"
+
+
+class ClassInfo:
+    __slots__ = ("name", "module", "bases", "methods", "attr_types",
+                 "lock_attrs")
+
+    def __init__(self, name, module, bases):
+        self.name = name
+        self.module = module
+        self.bases = bases        # base-class expression tails
+        self.methods = {}         # method name -> function id
+        self.attr_types = {}      # self.<attr> -> type descriptor
+        self.lock_attrs = set()   # attrs assigned from Lock factories
+
+
+class ModuleInfo:
+    __slots__ = ("name", "path", "package", "imports", "classes",
+                 "toplevel", "module_summary")
+
+    def __init__(self, name, path, is_pkg):
+        self.name = name
+        self.path = path
+        self.package = name if is_pkg else name.rpartition(".")[0]
+        self.imports = {}         # local name -> ("import", dotted) |
+        #                           ("from", base_module, name)
+        self.classes = {}         # class name -> ClassInfo
+        self.toplevel = {}        # module-level function name -> id
+        self.module_summary = None
+
+
+# -- the collector (rides the shared walk) -----------------------------------
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
+                   "BoundedSemaphore"}
+
+
+class _Frame:
+    """Per-function walk state: where this function's lock/guard
+    context starts (events inside a nested def must not inherit the
+    enclosing function's ``with``/``if`` context — the body runs
+    later), and the rank-tainted local names."""
+
+    __slots__ = ("summary", "lock_base", "guard_base", "taint")
+
+    def __init__(self, summary, lock_base, guard_base):
+        self.summary = summary
+        self.lock_base = lock_base
+        self.guard_base = guard_base
+        self.taint = set()
+
+
+class SummaryCollector(Rule):
+    """Not a lint rule — a collector sharing the single walk.  It is
+    appended to the rule list by ``analyze_project`` and never reports
+    findings of its own."""
+
+    id = "_summary-collector"
+    severity = "info"
+    doc = "internal: builds per-function summaries for the flow rules"
+
+    def __init__(self, program):
+        self.program = program
+
+    # -- file lifecycle ------------------------------------------------------
+    def begin_file(self, ctx):
+        is_pkg = ctx.path.endswith("__init__.py")
+        self.mod = ModuleInfo(module_name_for(ctx.path), ctx.path, is_pkg)
+        self.program.add_module(self.mod)
+        mod_summary = FunctionSummary(
+            f"{self.mod.name}::<module>", self.mod.name, ctx.path,
+            "<module>", "<module>", 0)
+        self.mod.module_summary = mod_summary
+        self.program.add_function(mod_summary)
+        self.frames = [_Frame(mod_summary, 0, 0)]
+        self.name_stack = []
+        self.class_infos = []     # ClassInfo stack
+        self.lock_stack = []      # (with-node, [lock ids])
+        self.guard_stack = []     # (if-node, GuardInfo)
+
+    def end_file(self, ctx):
+        self.frames = self.frames[:1]
+        self.lock_stack = []
+        self.guard_stack = []
+
+    # -- context helpers -----------------------------------------------------
+    @property
+    def _frame(self):
+        return self.frames[-1]
+
+    def _held(self):
+        frame = self._frame
+        out = []
+        for _node, ids in self.lock_stack[frame.lock_base:]:
+            out.extend(ids)
+        return tuple(out)
+
+    def _guard(self):
+        frame = self._frame
+        for _node, g in reversed(self.guard_stack[frame.guard_base:]):
+            if g is not None:
+                return g
+        return frame.summary.rest_guard
+
+    def _lock_id(self, expr, ctx):
+        """Stable identity for a lock expression, or None.
+
+        ``self._lock`` -> ``<module>.<Class>._lock`` (ordering
+        discipline is per-class: every instance of the class must
+        acquire in the same order); a module-level name ->
+        ``<module>.<name>``.  Other receivers (``obj.attr``) cannot be
+        aliased statically and stay inert (no edges)."""
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and expr.value.id == "self":
+            cls = ctx.current_class
+            owner = cls.name if cls is not None else "<self>"
+            return f"{self.mod.name}.{owner}.{expr.attr}"
+        if isinstance(expr, ast.Name):
+            return f"{self.mod.name}.{expr.id}"
+        return None
+
+    def _is_lock_expr(self, expr, ctx):
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and expr.value.id == "self":
+            if is_lockish_name(expr.attr):
+                return True
+            cls = self.class_infos[-1] if self.class_infos else None
+            return cls is not None and expr.attr in cls.lock_attrs
+        if isinstance(expr, ast.Name):
+            return is_lockish_name(expr.id)
+        return False
+
+    # -- rank-condition detection --------------------------------------------
+    def _rank_tokens_in(self, test):
+        """Token(s) that make ``test`` rank-divergent, or []."""
+        taint = self._frame.taint
+        found = []
+        for node in ast.walk(test):
+            if isinstance(node, ast.Name):
+                if node.id in RANK_TOKENS or node.id in taint:
+                    found.append(node.id)
+            elif isinstance(node, ast.Attribute):
+                if node.attr in RANK_TOKENS or \
+                        node.attr.startswith("local_"):
+                    found.append(node.attr)
+        return found
+
+    # -- call classification -------------------------------------------------
+    @staticmethod
+    def _descriptor(func):
+        """(kind, parts) resolution descriptor for a callee expression."""
+        if isinstance(func, ast.Name):
+            return "name", (func.id,)
+        if isinstance(func, ast.Attribute):
+            base = func.value
+            if isinstance(base, ast.Name):
+                if base.id == "self":
+                    return "self", (func.attr,)
+                return "attr", (base.id, func.attr)
+            if isinstance(base, ast.Attribute) and \
+                    isinstance(base.value, ast.Name) and \
+                    base.value.id == "self":
+                # self._attr.method() — resolvable via attr-type
+                # inference from __init__ assignments
+                return "selfattr", (base.attr, func.attr)
+            return "dyn", (func.attr,)
+        return "dyn", ("<call>",)
+
+    def _record_call(self, node, ctx):
+        func = node.func
+        kind, parts = self._descriptor(func)
+        summary = self._frame.summary
+        site = CallSite(kind, parts, node.lineno, node.col_offset,
+                        self._held(), self._guard(), _expr_text(func))
+        summary.calls.append(site)
+
+        tail = _tail(func)
+        # collectives (every rank must reach them)
+        if tail in COLLECTIVE_TOKENS:
+            summary.collectives.append(Collective(
+                tail, node.lineno, node.col_offset, site.guard,
+                site.held))
+
+        # host effects (trace-host-escape raw material)
+        self._record_host_effect(node, func, tail, summary)
+
+        # traced-body registrations: jit(f)/shard_map(f,...)/lax.scan(f)
+        if tail in _TRACE_TRANSFORMS and node.args:
+            summary.traced_regs.append(TracedReg(
+                tail, *self._descriptor_expr(node.args[0]), node.lineno))
+        elif tail == "scan" and isinstance(func, ast.Attribute) and \
+                _tail(func.value) == "lax" and node.args:
+            summary.traced_regs.append(TracedReg(
+                "scan", *self._descriptor_expr(node.args[0]), node.lineno))
+
+    @staticmethod
+    def _descriptor_expr(expr):
+        """Descriptor for a function VALUE (registration argument)."""
+        if isinstance(expr, (ast.Name, ast.Attribute)):
+            return SummaryCollector._descriptor(
+                expr if not isinstance(expr, ast.Call) else expr.func)
+        return "dyn", ("<expr>",)
+
+    def _record_host_effect(self, node, func, tail, summary):
+        effect = None
+        if isinstance(func, ast.Attribute):
+            base = func.value
+            base_tail = _tail(base)
+            if tail in _HOST_SYNC_METHODS:
+                effect = ("host_sync", f".{tail}")
+            elif base_tail in _NUMPY_BASES and \
+                    tail in _NUMPY_MATERIALIZERS:
+                effect = ("numpy", f"{base_tail}.{tail}")
+            elif base_tail == "time" and tail in _CLOCK_ATTRS:
+                effect = ("clock", f"time.{tail}")
+            elif tail in _METRIC_METHODS:
+                effect = ("metric", f".{tail}")
+            elif tail == "set":
+                recv = _expr_text(base).lower()
+                if any(t in recv for t in _METRIC_RECV_TOKENS):
+                    effect = ("metric", ".set")
+            elif tail in _RNG_ATTRS and (
+                    (isinstance(base, ast.Name) and base.id == "random")
+                    or (isinstance(base, ast.Attribute)
+                        and base.attr == "random"
+                        and _tail(base.value) in _NUMPY_BASES)):
+                # stdlib `random.x()` / `np.random.x()` only —
+                # `jax.random.*` is a traced PRNG op, not a host draw
+                effect = ("rng", f"{_expr_text(base)}.{tail}")
+        elif isinstance(func, ast.Name):
+            if func.id in ("float", "int", "bool") and \
+                    len(node.args) == 1 and \
+                    isinstance(node.args[0], ast.Name):
+                # only parameter-derived names: float(cfg) of a python
+                # scalar is fine, float(x) of a likely-array argument
+                # concretizes (the tracer-leak rule owns the decorated
+                # depth-0 form; this records it for call chains)
+                effect = ("concretize", f"{func.id}()")
+        if effect is not None:
+            summary.host_effects.append(HostEffect(
+                effect[0], effect[1], node.lineno, node.col_offset))
+
+    # -- the walk ------------------------------------------------------------
+    def visit(self, node, ctx):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                local = alias.asname or alias.name.split(".")[0]
+                target = alias.name if alias.asname else \
+                    alias.name.split(".")[0]
+                self.mod.imports[local] = ("import", target)
+        elif isinstance(node, ast.ImportFrom):
+            base = self._from_base(node)
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                self.mod.imports[alias.asname or alias.name] = \
+                    ("from", base, alias.name)
+        elif isinstance(node, ast.ClassDef):
+            info = ClassInfo(node.name, self.mod.name,
+                             [_tail(b) for b in node.bases])
+            self.mod.classes.setdefault(node.name, info)
+            self.class_infos.append(info)
+            self.name_stack.append(node.name)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._enter_function(node, ctx)
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            ids = []
+            held = list(self._held())
+            for item in node.items:
+                if not self._is_lock_expr(item.context_expr, ctx):
+                    continue
+                lid = self._lock_id(item.context_expr, ctx)
+                if lid is None:
+                    continue
+                self._frame.summary.lock_acquires.append(LockAcquire(
+                    lid, tuple(held), node.lineno, node.col_offset))
+                ids.append(lid)
+                held.append(lid)
+            self.lock_stack.append((node, ids))
+        elif isinstance(node, ast.If):
+            tokens = self._rank_tokens_in(node.test)
+            if tokens:
+                self.guard_stack.append((node, GuardInfo(
+                    _expr_text(node.test), node.lineno)))
+            else:
+                self.guard_stack.append((node, None))
+        elif isinstance(node, ast.Assign):
+            self._record_assign(node, ctx)
+        elif isinstance(node, ast.Call):
+            self._record_call(node, ctx)
+
+    def depart(self, node, ctx):
+        if isinstance(node, ast.ClassDef):
+            if self.class_infos and self.name_stack and \
+                    self.name_stack[-1] == node.name:
+                self.class_infos.pop()
+                self.name_stack.pop()
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if len(self.frames) > 1 and \
+                    self._frame.summary.name == node.name:
+                self.frames.pop()
+                self.name_stack.pop()
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            if self.lock_stack and self.lock_stack[-1][0] is node:
+                self.lock_stack.pop()
+        elif isinstance(node, ast.If):
+            if self.guard_stack and self.guard_stack[-1][0] is node:
+                _n, guard = self.guard_stack.pop()
+                if guard is not None and not node.orelse and \
+                        node.body and isinstance(
+                            node.body[-1], (ast.Return, ast.Raise)):
+                    # `if rank != 0: return` — the REST of the function
+                    # is rank-divergent fallthrough
+                    frame = self._frame
+                    if frame.summary.rest_guard is None:
+                        frame.summary.rest_guard = GuardInfo(
+                            guard.cond, guard.lineno, via_return=True)
+
+    # -- helpers -------------------------------------------------------------
+    def _from_base(self, node):
+        if node.level == 0:
+            return node.module or ""
+        parts = self.mod.package.split(".") if self.mod.package else []
+        if node.level > 1:
+            parts = parts[:len(parts) - (node.level - 1)]
+        if node.module:
+            parts = parts + node.module.split(".")
+        return ".".join(parts)
+
+    def _enter_function(self, node, ctx):
+        self.name_stack.append(node.name)
+        qual = ".".join(self.name_stack)
+        fid = f"{self.mod.name}::{qual}"
+        cls = self.class_infos[-1] if self.class_infos else None
+        parent = self._frame.summary if len(self.frames) > 1 or \
+            self._frame.summary.qual != "<module>" else None
+        summary = FunctionSummary(
+            fid, self.mod.name, self.mod.path, qual, node.name,
+            node.lineno,
+            class_name=cls.name if cls is not None else None,
+            parent=parent.id if parent is not None else None)
+        for dec in node.decorator_list:
+            dtail = _tail(dec)
+            if dtail in _TRACE_TRANSFORMS:
+                summary.is_traced_root = True
+            elif isinstance(dec, ast.Call):
+                ftail = _tail(dec.func)
+                if ftail in _TRACE_TRANSFORMS:
+                    summary.is_traced_root = True
+                elif ftail == "partial" and dec.args and \
+                        _tail(dec.args[0]) in _TRACE_TRANSFORMS:
+                    summary.is_traced_root = True
+        self.program.add_function(summary)
+        # register with the enclosing scope for name resolution
+        if parent is not None:
+            parent.children[node.name] = fid
+        if cls is not None and len(self.name_stack) >= 2 and \
+                self.name_stack[-2] == cls.name:
+            cls.methods.setdefault(node.name, fid)
+        elif parent is None:
+            self.mod.toplevel.setdefault(node.name, fid)
+        self.frames.append(_Frame(summary, len(self.lock_stack),
+                                  len(self.guard_stack)))
+
+    def _record_assign(self, node, ctx):
+        value = node.value
+        # rank taint: names assigned from a rank-bearing expression
+        if isinstance(value, (ast.Call, ast.Attribute, ast.Name,
+                              ast.BinOp, ast.Compare)):
+            rankish = False
+            for sub in ast.walk(value):
+                if isinstance(sub, ast.Name) and (
+                        sub.id in RANK_TOKENS
+                        or sub.id in self._frame.taint):
+                    rankish = True
+                    break
+                if isinstance(sub, ast.Attribute) and \
+                        sub.attr in RANK_TOKENS:
+                    rankish = True
+                    break
+            if rankish:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self._frame.taint.add(t.id)
+        # self.<attr> = <Type>(...): attribute-type inference, plus
+        # lock-factory marking for non-lockish names (self._mu = Lock())
+        cls = self.class_infos[-1] if self.class_infos else None
+        if cls is None or not isinstance(value, ast.Call):
+            return
+        vtail = _tail(value.func)
+        for t in node.targets:
+            if isinstance(t, ast.Attribute) and \
+                    isinstance(t.value, ast.Name) and t.value.id == "self":
+                if vtail in _LOCK_FACTORIES:
+                    cls.lock_attrs.add(t.attr)
+                elif vtail and vtail[0].isupper():
+                    cls.attr_types.setdefault(
+                        t.attr, self._descriptor(value.func))
+
+
+# -- the program (phase-2 substrate) ----------------------------------------
+class Program:
+    """Every module's summaries plus the resolved call graph and the
+    transitive closures the flow rules consume."""
+
+    def __init__(self):
+        self.modules = {}         # module name -> ModuleInfo
+        self.functions = {}       # function id -> FunctionSummary
+        self.edges = 0
+        self.unresolved_calls = 0
+        self.callers = {}         # callee id -> [(caller id, CallSite)]
+        self.collective_closure = {}   # fid -> (kind, path, line, chain)
+        self.lock_closure = {}    # fid -> {lock: (path, line, chain)}
+        self.traced_roots = []    # FunctionSummary list
+
+    def add_module(self, mod):
+        self.modules[mod.name] = mod
+
+    def add_function(self, fs):
+        self.functions[fs.id] = fs
+
+    def stats(self):
+        return {"functions": len(self.functions), "edges": self.edges,
+                "unresolved_calls": self.unresolved_calls}
+
+    # -- resolution ----------------------------------------------------------
+    def finish(self):
+        """Resolve every call site and compute the closures.  Called
+        once, after every file has been walked."""
+        for fs in self.functions.values():
+            mod = self.modules.get(fs.module)
+            if mod is None:
+                continue
+            for call in fs.calls:
+                callee = self._resolve(mod, fs, call)
+                if callee is _BENIGN:
+                    continue
+                if callee is None:
+                    self.unresolved_calls += 1
+                else:
+                    call.callee = callee
+                    self.edges += 1
+                    self.callers.setdefault(callee, []).append(
+                        (fs.id, call))
+        self._compute_collective_closure()
+        self._compute_lock_closure()
+        self._collect_traced_roots()
+        return self
+
+    def _resolve(self, mod, fs, call):
+        kind, parts = call.kind, call.parts
+        if kind == "name":
+            name = parts[0]
+            if name in _BUILTINS:
+                return _BENIGN
+            # lexical scope chain: nested defs of enclosing functions
+            cur = fs
+            while cur is not None:
+                if name in cur.children:
+                    return cur.children[name]
+                cur = self.functions.get(cur.parent) \
+                    if cur.parent else None
+            if name in mod.toplevel:
+                return mod.toplevel[name]
+            if name in mod.classes:
+                return mod.classes[name].methods.get("__init__", _BENIGN)
+            return self._resolve_import(mod, name, None)
+        if kind == "self":
+            return self._resolve_method(mod, fs.class_name, parts[0])
+        if kind == "selfattr":
+            attr, meth = parts
+            cls = mod.classes.get(fs.class_name or "")
+            if cls is None or attr not in cls.attr_types:
+                return None
+            tkind, tparts = cls.attr_types[attr]
+            tname = tparts[-1]
+            owner_mod = mod
+            if tname not in mod.classes:
+                target = self._resolve_import_module(mod, tkind, tparts)
+                if target is None:
+                    return None
+                owner_mod, tname = target
+            return self._resolve_method_in(owner_mod, tname, meth)
+        if kind == "attr":
+            base, attr = parts
+            if base in mod.classes:
+                return mod.classes[base].methods.get(attr)
+            return self._resolve_import(mod, base, attr)
+        return None
+
+    def _resolve_method(self, mod, class_name, meth, depth=0):
+        return self._resolve_method_in(mod, class_name or "", meth, depth)
+
+    def _resolve_method_in(self, mod, class_name, meth, depth=0):
+        if depth > 4:
+            return None
+        cls = mod.classes.get(class_name)
+        if cls is None:
+            return None
+        if meth in cls.methods:
+            return cls.methods[meth]
+        for base in cls.bases:
+            if base in mod.classes:
+                found = self._resolve_method_in(mod, base, meth,
+                                                depth + 1)
+            else:
+                target = self._resolve_import_module(
+                    mod, "name", (base,))
+                found = None if target is None else \
+                    self._resolve_method_in(target[0], target[1],
+                                            meth, depth + 1)
+            if found is not None:
+                return found
+        return None
+
+    def _resolve_import(self, mod, base, attr):
+        """Resolve ``base(...)`` (attr=None) or ``base.attr(...)``
+        through the module's import table."""
+        imp = mod.imports.get(base)
+        if imp is None:
+            return None
+        if imp[0] == "import":
+            target = self.modules.get(imp[1])
+            if target is None or attr is None:
+                return None
+            return self._module_attr(target, attr)
+        _kind, from_mod, sym = imp
+        submodule = self.modules.get(f"{from_mod}.{sym}")
+        if submodule is not None:
+            # `from pkg import mod` — base names a module
+            return None if attr is None else \
+                self._module_attr(submodule, attr)
+        target = self.modules.get(from_mod)
+        if target is None:
+            return None
+        if attr is None:
+            return self._module_attr(target, sym)
+        # `from m import Cls` then `Cls.method(...)`
+        if sym in target.classes:
+            return target.classes[sym].methods.get(attr)
+        return None
+
+    def _module_attr(self, mod, attr):
+        if attr in mod.toplevel:
+            return mod.toplevel[attr]
+        if attr in mod.classes:
+            return mod.classes[attr].methods.get("__init__", _BENIGN)
+        return None
+
+    def _resolve_import_module(self, mod, kind, parts):
+        """-> (ModuleInfo, class name) for a type descriptor, or
+        None."""
+        name = parts[-1]
+        if kind == "attr":
+            imp = mod.imports.get(parts[0])
+            if imp is not None:
+                target = None
+                if imp[0] == "import":
+                    target = self.modules.get(imp[1])
+                else:
+                    target = self.modules.get(f"{imp[1]}.{imp[2]}") or \
+                        self.modules.get(imp[1])
+                if target is not None and name in target.classes:
+                    return target, name
+            return None
+        imp = mod.imports.get(name)
+        if imp is not None and imp[0] == "from":
+            target = self.modules.get(imp[1])
+            if target is not None and imp[2] in target.classes:
+                return target, imp[2]
+        return None
+
+    # -- closures ------------------------------------------------------------
+    def _compute_collective_closure(self):
+        """fid -> (kind, path, line, chain of function names) for the
+        nearest collective reachable from the function (itself
+        included); BFS over reverse edges keeps chains shortest."""
+        closure = {}
+        worklist = []
+        for fs in self.functions.values():
+            if fs.collectives:
+                c = fs.collectives[0]
+                closure[fs.id] = (c.kind, fs.path, c.lineno, (fs.name,))
+                worklist.append(fs.id)
+        while worklist:
+            fid = worklist.pop(0)
+            kind, path, line, chain = closure[fid]
+            if len(chain) > 12:
+                continue
+            for caller_id, _site in self.callers.get(fid, ()):
+                if caller_id in closure:
+                    continue
+                caller = self.functions[caller_id]
+                closure[caller_id] = (kind, path, line,
+                                      (caller.name,) + chain)
+                worklist.append(caller_id)
+        self.collective_closure = closure
+
+    def _compute_lock_closure(self):
+        """fid -> {lock id: (path, line, chain)} — every lock a
+        function may acquire, directly or via calls."""
+        closure = {}
+        worklist = []
+        for fs in self.functions.values():
+            if fs.lock_acquires:
+                acc = {}
+                for la in fs.lock_acquires:
+                    acc.setdefault(la.lock,
+                                   (fs.path, la.lineno, (fs.name,)))
+                closure[fs.id] = acc
+                worklist.append(fs.id)
+        while worklist:
+            fid = worklist.pop(0)
+            for caller_id, _site in self.callers.get(fid, ()):
+                caller = self.functions[caller_id]
+                acc = closure.setdefault(caller_id, {})
+                changed = False
+                for lock, (path, line, chain) in closure[fid].items():
+                    if lock not in acc and len(chain) <= 12:
+                        acc[lock] = (path, line, (caller.name,) + chain)
+                        changed = True
+                if changed:
+                    worklist.append(caller_id)
+        self.lock_closure = closure
+
+    def _collect_traced_roots(self):
+        roots = {}
+        for fs in self.functions.values():
+            if fs.is_traced_root:
+                roots.setdefault(fs.id, fs)
+            mod = self.modules.get(fs.module)
+            for reg in fs.traced_regs:
+                target = None
+                if mod is not None:
+                    probe = CallSite(reg.kind, reg.parts, reg.lineno,
+                                     0, (), None, "")
+                    target = self._resolve(mod, fs, probe)
+                if target is not None and target is not _BENIGN:
+                    tf = self.functions.get(target)
+                    if tf is not None:
+                        roots.setdefault(tf.id, tf)
+        self.traced_roots = list(roots.values())
+
+
+class _Benign:
+    """Sentinel: resolved to something known-harmless (builtin, class
+    with no __init__) — not an edge, not an unresolved call."""
+
+    __repr__ = lambda self: "<benign>"  # noqa: E731
+
+
+_BENIGN = _Benign()

@@ -607,6 +607,12 @@ _register("BENCH_ALERTS", bool, True,
           "(alert_tick_overhead_us) and one host resource sample "
           "(resource_sample_overhead_us), both gated < 1 ms, plus the "
           "engine-disabled tick gated < 1 us like span/trace/failpoint")
+_register("BENCH_LINT", bool, True,
+          "bench.py: also measure graftlint_full_tree_s — one "
+          "whole-tree run of the two-phase lint engine (lexical walk + "
+          "summary collection + call-graph flow rules) in a fresh "
+          "subprocess, gated under the ci/run.sh 15 s wall budget with "
+          "the slowest rules named from --timings")
 _register("BENCH_NUMERICS", bool, True,
           "bench.py: also measure the numerics observatory — armed "
           "K=8 scanned-window overhead vs off (< 5% step wall, "

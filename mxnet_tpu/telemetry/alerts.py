@@ -453,6 +453,11 @@ class AlertEngine:
             raise MXNetError(f"duplicate alert rule names: {names}")
         self._sampler = sampler if sampler is not None else registry_sampler
         self._lock = threading.Lock()
+        # serializes tick() bodies (the per-rule history deques are
+        # single-writer) WITHOUT holding self._lock across user rule
+        # code — self._lock guards engine state only and is never held
+        # while rule.evaluate/describe or another subsystem runs
+        self._tick_lock = threading.Lock()
         self._states = {r.name: self._fresh_state() for r in self.rules}
         self._history = {r.name: collections.deque(maxlen=_HISTORY_POINTS)
                          for r in self.rules}
@@ -496,6 +501,12 @@ class AlertEngine:
                     "count of currently-firing alert rules, by severity"))
 
     def _transition(self, rule, st, to, now, value):
+        """Mutate ``st`` (caller holds ``self._lock``) and return the
+        emission record — metric/flight/log side effects run OUTSIDE
+        the lock (``_emit_transition``): the flight ring, the registry,
+        and the logging subsystem each own locks of their own, and
+        holding the engine lock into them is an ordering edge the
+        lock-order-cycle rule rightly flags."""
         frm = st["state"]
         st["state"] = to
         st["since"] = now
@@ -509,6 +520,9 @@ class AlertEngine:
             st["fired_total"] += 1
         elif to == "resolved":
             st["resolved_at"] = now
+        return (rule, frm, to, value)
+
+    def _emit_transition(self, rule, frm, to, value):
         counter, state_gauge, _firing_gauge = self._metrics()
         counter.inc(labels={"rule": rule.name, "to": to})
         for s in STATES:
@@ -530,7 +544,15 @@ class AlertEngine:
     # -- evaluation ----------------------------------------------------------
     def tick(self, now=None):
         """One evaluation pass over every rule; returns the number of
-        state transitions it caused."""
+        state transitions it caused.
+
+        Lock protocol: ``rule.history_point``/``rule.evaluate`` are
+        USER code (``add_rule`` accepts arbitrary objects) and run
+        under ``_tick_lock`` only — a rule that introspects the engine
+        (``state()``/``firing()``) must not deadlock on the engine
+        lock.  ``self._lock`` is held only to snapshot the rule list
+        and to apply state transitions; metric/flight/log emission
+        happens after it is released."""
         if now is None:
             now = time.monotonic()
         with self._lock:
@@ -544,52 +566,68 @@ class AlertEngine:
             log.warning("alert sampler failed: %s", e)
             return 0
         moved = 0
-        with self._lock:
-            self.ticks += 1
-            for rule in self.rules:
-                history = self._history[rule.name]
+        events = []
+        with self._tick_lock:
+            with self._lock:
+                self.ticks += 1
+                histories = {r.name: self._history[r.name]
+                             for r in rules if r.name in self._history}
+            evals = []
+            for rule in rules:
+                history = histories.get(rule.name)
+                if history is None:
+                    continue
                 point = rule.history_point(samples)
                 if point is not None:
                     history.append((now, point))
                 value, cond = rule.evaluate(samples, history, now)
-                st = self._states[rule.name]
-                st["value"] = value
-                state = st["state"]
-                if state == "inactive":
-                    if cond:
-                        self._transition(rule, st, "pending", now, value)
-                        moved += 1
-                        if rule.for_s <= 0:
-                            self._transition(rule, st, "firing", now, value)
-                            moved += 1
-                elif state == "pending":
-                    if not cond:
-                        self._transition(rule, st, "inactive", now, value)
-                        moved += 1
-                    elif now - st["pending_since"] >= rule.for_s:
-                        self._transition(rule, st, "firing", now, value)
-                        moved += 1
-                elif state == "firing":
-                    if not cond:
-                        self._transition(rule, st, "resolved", now, value)
-                        moved += 1
-                elif state == "resolved":
-                    cooled = (now - (st["resolved_at"] or now)
-                              >= rule.cooldown_s)
-                    if cond and cooled:
-                        self._transition(rule, st, "pending", now, value)
-                        moved += 1
-                        if rule.for_s <= 0:
-                            self._transition(rule, st, "firing", now, value)
-                            moved += 1
-                    elif not cond and cooled:
-                        self._transition(rule, st, "inactive", now, value)
-                        moved += 1
+                evals.append((rule, value, cond))
+            with self._lock:
+                for rule, value, cond in evals:
+                    st = self._states.get(rule.name)
+                    if st is None:
+                        continue
+                    st["value"] = value
+                    state = st["state"]
+                    if state == "inactive":
+                        if cond:
+                            events.append(self._transition(
+                                rule, st, "pending", now, value))
+                            if rule.for_s <= 0:
+                                events.append(self._transition(
+                                    rule, st, "firing", now, value))
+                    elif state == "pending":
+                        if not cond:
+                            events.append(self._transition(
+                                rule, st, "inactive", now, value))
+                        elif now - st["pending_since"] >= rule.for_s:
+                            events.append(self._transition(
+                                rule, st, "firing", now, value))
+                    elif state == "firing":
+                        if not cond:
+                            events.append(self._transition(
+                                rule, st, "resolved", now, value))
+                    elif state == "resolved":
+                        cooled = (now - (st["resolved_at"] or now)
+                                  >= rule.cooldown_s)
+                        if cond and cooled:
+                            events.append(self._transition(
+                                rule, st, "pending", now, value))
+                            if rule.for_s <= 0:
+                                events.append(self._transition(
+                                    rule, st, "firing", now, value))
+                        elif not cond and cooled:
+                            events.append(self._transition(
+                                rule, st, "inactive", now, value))
+                counts = {s: 0 for s in SEVERITIES}
+                for rule in rules:
+                    st = self._states.get(rule.name)
+                    if st is not None and st["state"] == "firing":
+                        counts[rule.severity] += 1
+            moved = len(events)
+            for rule, frm, to, value in events:
+                self._emit_transition(rule, frm, to, value)
             _c, _g, firing_gauge = self._metrics()
-            counts = {s: 0 for s in SEVERITIES}
-            for rule in self.rules:
-                if self._states[rule.name]["state"] == "firing":
-                    counts[rule.severity] += 1
             for sev, n in counts.items():
                 firing_gauge.set(n, labels={"severity": sev})
         return moved
@@ -614,26 +652,31 @@ class AlertEngine:
             return list(self._states[name]["recent"])
 
     def alerts_json(self):
-        """The ``GET /alerts.json`` payload."""
+        """The ``GET /alerts.json`` payload.  ``rule.describe()`` is
+        user code and runs outside the engine lock (state is snapshot
+        first)."""
         with self._lock:
-            rules = []
-            for rule in self.rules:
+            rule_list = list(self.rules)
+            snap = {}
+            for rule in rule_list:
                 st = self._states[rule.name]
-                d = rule.describe()
-                d.update({"state": st["state"], "value": st["value"],
-                          "since": st["since"],
-                          "transitions": st["transitions"],
-                          "fired_total": st["fired_total"],
-                          "recent": list(st["recent"])})
-                rules.append(d)
-            firing = sorted(
-                r.name for r in self.rules
-                if self._states[r.name]["state"] == "firing")
-            pages = sorted(
-                r.name for r in self.rules
-                if self._states[r.name]["state"] == "firing"
-                and r.severity == "page")
+                snap[rule.name] = {
+                    "state": st["state"], "value": st["value"],
+                    "since": st["since"],
+                    "transitions": st["transitions"],
+                    "fired_total": st["fired_total"],
+                    "recent": list(st["recent"])}
             ticks = self.ticks
+        rules = []
+        for rule in rule_list:
+            d = rule.describe()
+            d.update(snap[rule.name])
+            rules.append(d)
+        firing = sorted(r.name for r in rule_list
+                        if snap[r.name]["state"] == "firing")
+        pages = sorted(r.name for r in rule_list
+                       if snap[r.name]["state"] == "firing"
+                       and r.severity == "page")
         return {"time": time.time(), "enabled": _armed,
                 "ticks": ticks, "rules": rules,
                 "firing": firing, "pages": pages}

@@ -13,8 +13,10 @@ import textwrap
 
 import pytest
 
-from mxnet_tpu.analysis import (analyze_source, diff_baseline,
-                                fingerprint_counts, make_rules)
+from mxnet_tpu.analysis import (analyze_project, analyze_source,
+                                analyze_sources, diff_baseline,
+                                fingerprint_counts, make_graph_rules,
+                                make_rules)
 from mxnet_tpu.analysis.rules.env_drift import EnvDriftRule
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -713,13 +715,18 @@ def test_cli_json_and_list_rules(tmp_path):
     clean.write_text("x = 1\n")
     r = _cli(str(clean), "--json")
     assert r.returncode == 0
-    assert json.loads(r.stdout) == {"findings": [], "parse_errors": []}
+    doc = json.loads(r.stdout)
+    assert doc["schema_version"] == 2
+    assert doc["findings"] == [] and doc["parse_errors"] == []
+    assert set(doc["call_graph"]) == {"functions", "edges",
+                                      "unresolved_calls"}
 
     r = _cli("--list-rules")
     assert r.returncode == 0
     for rid in ("lock-discipline", "torn-write", "host-sync-in-hot-path",
                 "tracer-leak", "swallowed-error", "env-knob-drift",
-                "naked-retry"):
+                "naked-retry", "collective-divergence",
+                "lock-order-cycle", "trace-host-escape"):
         assert rid in r.stdout
 
 
@@ -997,3 +1004,610 @@ def test_leaked_thread_suppression():
         "# graftlint: disable=leaked-thread -- joined by the caller")
     assert "leaked-thread" not in rules_hit(
         lint(src, path="mxnet_tpu/telemetry/fake.py"))
+
+
+# -- v2 engine: collective-divergence -----------------------------------------
+def graph_lint(sources):
+    """Run ONLY the whole-program (graph) rules over in-memory files."""
+    return analyze_sources(sources, rules=[])
+
+
+RANK_GUARDED_DIRECT = """
+import jax
+
+def run(kv):
+    if jax.process_index() == 0:
+        kv.barrier()
+"""
+
+
+def test_collective_divergence_flags_direct_guarded_collective():
+    findings = graph_lint({"pkg/a.py": RANK_GUARDED_DIRECT})
+    hits = [f for f in findings if f.rule == "collective-divergence"]
+    assert len(hits) == 1
+    assert "barrier" in hits[0].message
+    assert "process_index" in hits[0].message
+
+
+def test_collective_divergence_flags_two_hop_chain():
+    # the leader-only checkpoint bug: the guarded call looks harmless,
+    # the barrier is two resolution hops away
+    src = """
+import jax
+
+def run(kv):
+    if jax.process_index() == 0:
+        commit(kv)
+
+def commit(kv):
+    _sync(kv)
+
+def _sync(kv):
+    kv.barrier()
+"""
+    findings = graph_lint({"pkg/a.py": src})
+    hits = [f for f in findings if f.rule == "collective-divergence"]
+    assert len(hits) == 1
+    assert "run() -> commit() -> _sync()" in hits[0].message
+
+
+def test_collective_divergence_flags_guarded_early_return():
+    # `if rank != 0: return` makes the REST of the function divergent
+    src = """
+def run(kv, rank):
+    if rank != 0:
+        return
+    kv.barrier()
+"""
+    findings = graph_lint({"pkg/a.py": src})
+    hits = [f for f in findings if f.rule == "collective-divergence"]
+    assert len(hits) == 1
+    assert "rank-guarded" in hits[0].message
+
+
+def test_collective_divergence_flags_tainted_local():
+    # the condition *derives* from process_index via a local variable
+    src = """
+import jax
+
+def run(arr, mesh):
+    r = jax.process_index()
+    if r == 0:
+        jax.lax.psum(arr, "dp")
+"""
+    findings = graph_lint({"pkg/a.py": src})
+    assert any(f.rule == "collective-divergence" for f in findings)
+
+
+def test_collective_divergence_near_miss_leader_after_barrier():
+    # every rank reaches the barrier; only the leader does host-side
+    # work afterwards — the reviewed idiom, silent
+    src = """
+import jax
+
+def run(kv, manager):
+    kv.barrier()
+    if jax.process_index() == 0:
+        commit(manager)
+
+def commit(manager):
+    manager.write()
+"""
+    findings = graph_lint({"pkg/a.py": src})
+    assert not any(f.rule == "collective-divergence" for f in findings)
+
+
+def test_collective_divergence_near_miss_logging_only():
+    # rank-guarded logging reaches no collective (and unresolvable
+    # calls are open-world benign)
+    src = """
+import logging
+
+def run(rank):
+    if rank == 0:
+        logging.getLogger("x").info("leader up")
+"""
+    findings = graph_lint({"pkg/a.py": src})
+    assert not any(f.rule == "collective-divergence" for f in findings)
+
+
+def test_collective_divergence_near_miss_uniform_condition():
+    # world size is identical on every rank — not divergent
+    src = """
+def run(kv, world_size):
+    if world_size > 1:
+        kv.barrier()
+"""
+    findings = graph_lint({"pkg/a.py": src})
+    assert not any(f.rule == "collective-divergence" for f in findings)
+
+
+# -- v2 engine: lock-order-cycle ----------------------------------------------
+AB_CYCLE = {
+    "pkg/__init__.py": "",
+    "pkg/a.py": """
+import threading
+from . import b
+
+class Router:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pool = b.Pool()
+
+    def route(self):
+        with self._lock:
+            self._pool.pick()
+""",
+    "pkg/b.py": """
+import threading
+from . import a
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._router = a.Router()
+
+    def pick(self):
+        with self._lock:
+            return 1
+
+    def rebalance(self):
+        with self._lock:
+            self._router.route()
+""",
+}
+
+
+def test_lock_order_cycle_flags_ab_ba_across_files():
+    findings = graph_lint(AB_CYCLE)
+    hits = [f for f in findings if f.rule == "lock-order-cycle"]
+    assert len(hits) == 1
+    assert "Router._lock" in hits[0].message
+    assert "Pool._lock" in hits[0].message
+    assert hits[0].symbol.startswith("cycle:")
+
+
+def test_lock_order_cycle_flags_three_class_cycle():
+    src = """
+import threading
+
+class A:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._b = B()
+
+    def fa(self):
+        with self._lock:
+            self._b.fb()
+
+class B:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._c = C()
+
+    def fb(self):
+        with self._lock:
+            self._c.fc()
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._a = A()
+
+    def fc(self):
+        with self._lock:
+            self._a.fa()
+"""
+    findings = graph_lint({"pkg/m.py": src})
+    hits = [f for f in findings if f.rule == "lock-order-cycle"]
+    assert len(hits) == 1
+    for cls in ("A._lock", "B._lock", "C._lock"):
+        assert cls in hits[0].symbol
+
+
+def test_lock_order_cycle_near_miss_consistent_order():
+    # A -> B from two places is a DAG, not a cycle
+    src = AB_CYCLE["pkg/b.py"].replace(
+        "            self._router.route()", "            return 2")
+    findings = graph_lint(dict(AB_CYCLE, **{"pkg/b.py": src}))
+    assert not any(f.rule == "lock-order-cycle" for f in findings)
+
+
+def test_lock_order_cycle_near_miss_reentry_is_not_a_cycle():
+    src = """
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.RLock()
+
+    def outer(self):
+        with self._lock:
+            self.inner()
+
+    def inner(self):
+        with self._lock:
+            return 1
+"""
+    findings = graph_lint({"pkg/m.py": src})
+    assert not any(f.rule == "lock-order-cycle" for f in findings)
+
+
+HOOK_UNDER_LOCK = """
+import threading
+
+class Repo:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._flip_hooks = []
+
+    def add(self, fn):
+        with self._lock:
+            self._flip_hooks.append(fn)
+
+    def run_hooks(self, name):
+        with self._lock:
+            for fn in self._flip_hooks:
+                fn(name)
+"""
+
+
+def test_lock_order_cycle_flags_hook_under_lock():
+    findings = graph_lint({"mxnet_tpu/serving/fake.py": HOOK_UNDER_LOCK})
+    hits = [f for f in findings if f.rule == "lock-order-cycle"]
+    assert len(hits) == 1
+    assert hits[0].symbol == "Repo.run_hooks:hook.fn"
+    assert "OUTSIDE" in hits[0].message
+
+
+def test_lock_order_cycle_flags_plugin_receiver_under_lock():
+    # the AlertEngine.tick shape: user rule objects evaluated under
+    # the engine lock
+    src = """
+import threading
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.rules = []
+
+    def add(self, r):
+        with self._lock:
+            self.rules.append(r)
+
+    def tick(self):
+        with self._lock:
+            for rule in self.rules:
+                rule.evaluate()
+"""
+    findings = graph_lint({"mxnet_tpu/telemetry/fake.py": src})
+    hits = [f for f in findings if f.rule == "lock-order-cycle"]
+    assert len(hits) == 1
+    assert "rule.evaluate" in hits[0].message
+
+
+def test_lock_order_cycle_near_miss_copy_then_call():
+    # the reviewed idiom: snapshot under the lock, invoke outside
+    src = HOOK_UNDER_LOCK.replace(
+        """    def run_hooks(self, name):
+        with self._lock:
+            for fn in self._flip_hooks:
+                fn(name)""",
+        """    def run_hooks(self, name):
+        with self._lock:
+            hooks = list(self._flip_hooks)
+        for fn in hooks:
+            fn(name)""")
+    findings = graph_lint({"mxnet_tpu/serving/fake.py": src})
+    assert not any(f.rule == "lock-order-cycle" for f in findings)
+
+
+def test_lock_order_cycle_near_miss_single_site_serialization_lock():
+    # a lock acquired at exactly ONE site is a serialization latch
+    # (the alerts `_tick_lock` idiom) — user code under it cannot form
+    # an ordering edge with anything else
+    src = """
+import threading
+
+class Engine:
+    def __init__(self):
+        self._tick_lock = threading.Lock()
+
+    def tick(self, rules):
+        with self._tick_lock:
+            for rule in rules:
+                rule.evaluate()
+"""
+    findings = graph_lint({"mxnet_tpu/telemetry/fake.py": src})
+    assert not any(f.rule == "lock-order-cycle" for f in findings)
+
+
+def test_lock_order_cycle_near_miss_outside_threaded_modules():
+    findings = graph_lint({"tools/fake.py": HOOK_UNDER_LOCK})
+    assert not any(f.rule == "lock-order-cycle" for f in findings)
+
+
+# -- v2 engine: trace-host-escape ---------------------------------------------
+def test_trace_host_escape_flags_direct_clock_in_traced_body():
+    src = """
+import jax
+import time
+
+def build():
+    def step(x):
+        t0 = time.time()
+        return x + t0
+    return jax.jit(step, donate_argnums=(0,))
+"""
+    findings = graph_lint({"pkg/a.py": src})
+    hits = [f for f in findings if f.rule == "trace-host-escape"]
+    assert len(hits) == 1
+    assert "time.time" in hits[0].message
+    assert "step()" in hits[0].message
+
+
+def test_trace_host_escape_flags_two_hop_chain():
+    # the registration names `step`; the host effect is two calls deep
+    src = """
+import jax
+import numpy as np
+
+def build():
+    def step(x):
+        return helper(x)
+    return jax.jit(step)
+
+def helper(x):
+    return deep(x)
+
+def deep(x):
+    return np.asarray(x)
+"""
+    findings = graph_lint({"pkg/a.py": src})
+    hits = [f for f in findings if f.rule == "trace-host-escape"]
+    assert len(hits) == 1
+    assert "step() -> helper() -> deep()" in hits[0].message
+    assert "np.asarray" in hits[0].message
+
+
+def test_trace_host_escape_flags_scan_body_rng_and_metric():
+    src = """
+import jax
+import random
+
+def window(carry, xs, registry):
+    def body(c, x):
+        jitter = random.random()
+        registry.counter("steps").inc()
+        return c + jitter, x
+    return jax.lax.scan(body, carry, xs)
+"""
+    findings = graph_lint({"pkg/a.py": src})
+    hits = [f for f in findings if f.rule == "trace-host-escape"]
+    assert {h.symbol.split(":")[1] for h in hits} == \
+        {"rngrandom.random", "metric.inc"}
+
+
+def test_trace_host_escape_flags_decorated_root():
+    src = """
+import jax
+
+@jax.jit
+def step(x):
+    return helper(x)
+
+def helper(x):
+    return x.item()
+"""
+    findings = graph_lint({"pkg/a.py": src})
+    hits = [f for f in findings if f.rule == "trace-host-escape"]
+    assert len(hits) == 1
+    assert ".item" in hits[0].message
+
+
+def test_trace_host_escape_near_miss_unreachable_host_code():
+    # host effects in BOUNDARY code (not reachable from any traced
+    # body) are the design, not a finding
+    src = """
+import jax
+import time
+
+def build():
+    def step(x):
+        return x * 2
+    return jax.jit(step)
+
+def boundary_flush(stats):
+    return time.time(), stats
+"""
+    findings = graph_lint({"pkg/a.py": src})
+    assert not any(f.rule == "trace-host-escape" for f in findings)
+
+
+def test_trace_host_escape_near_miss_jax_prng():
+    # jax.random.* is a traced PRNG op, not a host draw
+    src = """
+import jax
+
+def build():
+    def step(key, x):
+        return x + jax.random.normal(key, x.shape)
+    return jax.jit(step)
+"""
+    findings = graph_lint({"pkg/a.py": src})
+    assert not any(f.rule == "trace-host-escape" for f in findings)
+
+
+def test_trace_host_escape_near_miss_open_world_dynamic_call():
+    # an unresolvable dynamic call is assumed benign — never guessed at
+    src = """
+import jax
+
+def build(opaque):
+    def step(x):
+        return opaque.transform(x)
+    return jax.jit(step)
+"""
+    findings = graph_lint({"pkg/a.py": src})
+    assert not any(f.rule == "trace-host-escape" for f in findings)
+
+
+def test_trace_host_escape_suppression():
+    src = """
+import jax
+import time
+
+def build():
+    def step(x):
+        t0 = time.time()  # graftlint: disable=trace-host-escape -- test
+        return x + t0
+    return jax.jit(step)
+"""
+    findings = graph_lint({"pkg/a.py": src})
+    assert not any(f.rule == "trace-host-escape" for f in findings)
+
+
+# -- v2 engine: call-graph resolution -----------------------------------------
+def test_call_graph_resolution_and_stats(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "util.py").write_text(textwrap.dedent("""
+        def shared():
+            return 1
+    """))
+    (pkg / "mod.py").write_text(textwrap.dedent("""
+        from .util import shared
+        from . import util
+
+        def top():
+            return shared() + util.shared()
+
+        class C:
+            def run(self):
+                return self.helper() + top()
+
+            def helper(self):
+                return dynamic_thing.whatever()
+    """))
+    res = analyze_project([str(tmp_path)], rules=[], graph_rules=[],
+                          root=str(tmp_path))
+    prog = res.program
+    stats = prog.stats()
+    assert stats["functions"] >= 5  # incl. per-module <module> summaries
+    assert stats["edges"] >= 4
+    assert stats["unresolved_calls"] >= 1  # dynamic_thing.whatever
+
+    run = prog.functions["pkg.mod::C.run"]
+    callees = {c.display: c.callee for c in run.calls}
+    assert callees["self.helper"] == "pkg.mod::C.helper"
+    assert callees["top"] == "pkg.mod::top"
+    top = prog.functions["pkg.mod::top"]
+    assert {c.callee for c in top.calls} == {"pkg.util::shared"}
+    helper = prog.functions["pkg.mod::C.helper"]
+    assert all(c.callee is None for c in helper.calls)  # open world
+
+
+def test_call_graph_nested_def_and_self_attr_type(tmp_path):
+    (tmp_path / "m.py").write_text(textwrap.dedent("""
+        class Dep:
+            def work(self):
+                return 1
+
+        class Owner:
+            def __init__(self):
+                self._dep = Dep()
+
+            def go(self):
+                def inner():
+                    return self._dep.work()
+                return inner()
+    """))
+    res = analyze_project([str(tmp_path)], rules=[], graph_rules=[],
+                          root=str(tmp_path))
+    prog = res.program
+    go = prog.functions["m::Owner.go"]
+    assert {c.callee for c in go.calls} == {"m::Owner.go.inner"}
+    inner = prog.functions["m::Owner.go.inner"]
+    assert {c.callee for c in inner.calls} == {"m::Dep.work"}
+
+
+# -- v2 engine: whole-program acceptance (CLI, not fixtures) ------------------
+def test_cli_whole_program_rank_guarded_collective(tmp_path):
+    mod = tmp_path / "sync.py"
+    mod.write_text(textwrap.dedent("""
+        import jax
+
+        def leader_commit(kv):
+            kv.barrier()
+
+        def run(kv):
+            if jax.process_index() == 0:
+                leader_commit(kv)
+    """))
+    r = _cli(str(tmp_path))
+    assert "collective-divergence" in r.stdout
+    assert "barrier" in r.stdout
+
+
+def test_cli_whole_program_ab_ba_lock_cycle(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    for name, src in AB_CYCLE.items():
+        (tmp_path / name).write_text(textwrap.dedent(src))
+    r = _cli(str(tmp_path))
+    assert "lock-order-cycle" in r.stdout
+    assert "Router._lock" in r.stdout and "Pool._lock" in r.stdout
+
+
+def test_cli_timings_table(tmp_path):
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    r = _cli(str(clean), "--timings")
+    assert r.returncode == 0
+    assert "graftlint timings" in r.stdout
+    for row in ("(parse)", "(summaries)", "(call-graph)", "(total)",
+                "lock-discipline", "collective-divergence"):
+        assert row in r.stdout
+    # and the JSON form carries the same table
+    r = _cli(str(clean), "--timings", "--json")
+    doc = json.loads(r.stdout)
+    assert "(total)" in doc["timings"]
+
+
+def test_cli_changed_only_filters_unchanged_files(tmp_path):
+    # a violation in a file OUTSIDE the repo's changed set is filtered
+    # (the whole tree is still analyzed; only reporting is restricted)
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        def save(path, doc):
+            with open(path, "w") as f:
+                f.write(doc)
+    """))
+    r_full = _cli(str(bad), "--json")
+    assert any(f["rule"] == "torn-write"
+               for f in json.loads(r_full.stdout)["findings"])
+    r = _cli(str(bad), "--changed-only", "--diff-base", "HEAD", "--json")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert json.loads(r.stdout)["findings"] == []
+
+
+def test_make_graph_rules_select_disable():
+    assert {r.id for r in make_graph_rules()} == {
+        "collective-divergence", "lock-order-cycle",
+        "trace-host-escape"}
+    only = make_graph_rules(select=["lock-order-cycle"])
+    assert [r.id for r in only] == ["lock-order-cycle"]
+    without = make_graph_rules(disable=["lock-order-cycle"])
+    assert "lock-order-cycle" not in {r.id for r in without}
+
+
+def test_graph_findings_fingerprint_stable_across_line_drift():
+    shifted = {"pkg/a.py": "\n\n# pad\n" + RANK_GUARDED_DIRECT}
+    a = fingerprint_counts([f for f in graph_lint(
+        {"pkg/a.py": RANK_GUARDED_DIRECT})
+        if f.rule == "collective-divergence"])
+    b = fingerprint_counts([f for f in graph_lint(shifted)
+                            if f.rule == "collective-divergence"])
+    assert a == b

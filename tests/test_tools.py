@@ -1,5 +1,6 @@
 """tools/: im2rec packer + local dist launcher + packaging metadata
 (reference: tools/im2rec.py, tools/launch.py:128 local mode)."""
+import json
 import os
 import subprocess
 import sys
@@ -175,3 +176,31 @@ def test_hlo_flops_parser_canonical_lines():
         "window={size=1x1 lhs_dilate=2x2}, dim_labels=bf01_oi01->bf01")
     convs, _, _ = analyze_hlo(dilated)
     assert len(convs) == 1 and convs[0]["lhs_dilated"]
+
+
+def test_graftlint_json_schema_round_trips(tmp_path):
+    """--json is a machine interface (schema v2): findings + parse
+    errors + call_graph stats must survive a loads->dumps->loads round
+    trip, and the stats must reflect the analyzed tree."""
+    mod = tmp_path / "m.py"
+    mod.write_text(
+        "def top():\n"
+        "    return helper()\n\n"
+        "def helper():\n"
+        "    return unknown_dynamic.call()\n\n"
+        "def save(path, doc):\n"
+        "    with open(path, 'w') as f:\n"
+        "        f.write(doc)\n")
+    r = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "graftlint.py"),
+         str(tmp_path), "--json"],
+        capture_output=True, text=True, timeout=120)
+    doc = json.loads(r.stdout)
+    assert doc["schema_version"] == 2
+    cg = doc["call_graph"]
+    assert set(cg) == {"functions", "edges", "unresolved_calls"}
+    assert cg["functions"] >= 3 and cg["edges"] >= 1
+    assert cg["unresolved_calls"] >= 1
+    assert any(f["rule"] == "torn-write" for f in doc["findings"])
+    # byte-level round trip: the schema holds nothing json can't carry
+    assert json.loads(json.dumps(doc)) == doc

@@ -4,12 +4,19 @@
 Rules encode invariants this codebase has already paid to learn (see
 docs/lint.md): lock-discipline races, torn writes of durable artifacts,
 device->host syncs in hot loops, tracer leaks in jit code, swallowed
-errors, and env-knob drift against config.py.
+errors, env-knob drift against config.py — plus the whole-program flow
+rules the v2 call-graph engine runs: collective-divergence (the SPMD
+deadlock shape), lock-order-cycle (AB/BA across the threaded
+subsystems), and trace-host-escape (host work reachable from donated
+jit/shard_map/scan bodies).
 
 Usage:
   python tools/graftlint.py                      # lint default paths
   python tools/graftlint.py --fail-on-new        # CI gate (baseline diff)
   python tools/graftlint.py --write-baseline     # accept current findings
+  python tools/graftlint.py --changed-only       # findings in files
+                                                 # touched vs merge-base
+  python tools/graftlint.py --timings            # per-rule wall-time table
   python tools/graftlint.py --json path/to.py    # machine-readable
   python tools/graftlint.py --list-rules
 
@@ -18,12 +25,15 @@ Exit codes: 0 clean (or only baselined findings with --fail-on-new),
 
 The analysis package is loaded straight from its directory so that
 linting never imports mxnet_tpu itself (no jax/numpy import cost).
+Note the whole tree is ALWAYS analyzed (the call graph needs every
+summary); --changed-only only restricts which findings are reported.
 """
 from __future__ import annotations
 
 import argparse
 import importlib.util
 import os
+import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -43,6 +53,29 @@ def _load_analysis():
     return mod
 
 
+def _changed_files(base_ref="main"):
+    """Repo-relative ``.py`` paths touched (committed or working tree)
+    since ``git merge-base HEAD <base_ref>`` — or None when git cannot
+    answer (not a repo, unknown ref): the caller falls back to
+    full-tree reporting with a warning."""
+    try:
+        base = subprocess.run(
+            ["git", "merge-base", "HEAD", base_ref], cwd=REPO,
+            capture_output=True, text=True, timeout=30)
+        if base.returncode != 0:
+            return None
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", base.stdout.strip()],
+            cwd=REPO, capture_output=True, text=True, timeout=30)
+        if diff.returncode != 0:
+            return None
+    except (OSError, subprocess.SubprocessError):
+        return None
+    return {ln.strip().replace(os.sep, "/")
+            for ln in diff.stdout.splitlines()
+            if ln.strip().endswith(".py")}
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="graftlint", description=__doc__,
@@ -50,13 +83,24 @@ def main(argv=None):
     ap.add_argument("paths", nargs="*",
                     help=f"files/dirs to lint (default: {DEFAULT_PATHS})")
     ap.add_argument("--json", action="store_true",
-                    help="machine-readable output")
+                    help="machine-readable output (schema v2: findings "
+                         "+ call_graph stats + optional timings)")
     ap.add_argument("--baseline", default=DEFAULT_BASELINE,
                     help="baseline JSON path (repo-relative)")
     ap.add_argument("--fail-on-new", action="store_true",
                     help="exit 1 when findings exceed the baseline")
     ap.add_argument("--write-baseline", action="store_true",
                     help="commit current findings as the baseline")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="report findings only in files touched vs "
+                         "`git merge-base HEAD main` (the whole tree "
+                         "is still analyzed for the call graph)")
+    ap.add_argument("--diff-base", default="main",
+                    help="ref --changed-only diffs against "
+                         "(default: main)")
+    ap.add_argument("--timings", action="store_true",
+                    help="print a per-rule wall-time table (where "
+                         "lint time goes)")
     ap.add_argument("--select", default="",
                     help="comma-separated rule ids to run exclusively")
     ap.add_argument("--disable", default="",
@@ -67,20 +111,46 @@ def main(argv=None):
     an = _load_analysis()
 
     if args.list_rules:
-        for rid, cls in sorted(an.all_rules().items()):
-            print(f"{rid:<22} [{cls.severity}] {cls.doc}")
+        catalog = dict(an.all_rules())
+        catalog.update(an.all_graph_rules())
+        for rid, cls in sorted(catalog.items()):
+            print(f"{rid:<24} [{cls.severity}] {cls.doc}")
         return 0
 
-    try:
-        rules = an.make_rules(
-            select=[r for r in args.select.split(",") if r] or None,
-            disable=[r for r in args.disable.split(",") if r])
-    except ValueError as e:
-        print(f"graftlint: {e}", file=sys.stderr)
+    select = [r for r in args.select.split(",") if r]
+    disable = [r for r in args.disable.split(",") if r]
+    known = set(an.all_rules()) | set(an.all_graph_rules())
+    unknown = (set(select) | set(disable)) - known
+    if unknown:
+        print(f"graftlint: unknown rules: {sorted(unknown)}",
+              file=sys.stderr)
         return 2
+    lex_ids = set(an.all_rules())
+    lex_disable = [r for r in disable if r in lex_ids]
+    if select:
+        lex_select = [r for r in select if r in lex_ids]
+        rules = an.make_rules(select=lex_select,
+                              disable=lex_disable) if lex_select else []
+    else:
+        rules = an.make_rules(disable=lex_disable)
+    graph_rules = an.make_graph_rules(
+        select=select or None, disable=disable)
 
     paths = args.paths or [os.path.join(REPO, p) for p in DEFAULT_PATHS]
-    findings, errors = an.analyze_paths(paths, rules=rules, root=REPO)
+    res = an.analyze_project(paths, rules=rules,
+                             graph_rules=graph_rules, root=REPO,
+                             timings=args.timings)
+    findings, errors = res.findings, res.errors
+
+    if args.changed_only:
+        changed = _changed_files(args.diff_base)
+        if changed is None:
+            print("graftlint: --changed-only: git diff against "
+                  f"{args.diff_base!r} unavailable; reporting the "
+                  "full tree", file=sys.stderr)
+        else:
+            findings = [f for f in findings if f.path in changed]
+            errors = [(p, m) for p, m in errors if p in changed]
 
     baseline_path = (args.baseline if os.path.isabs(args.baseline)
                      else os.path.join(REPO, args.baseline))
@@ -90,14 +160,21 @@ def main(argv=None):
         print(f"graftlint: baseline written to "
               f"{os.path.relpath(baseline_path, REPO)} "
               f"({len(findings)} finding(s))")
+        if args.timings and res.timings:
+            print(an.render_timings(res.timings))
         return 0
 
+    stats = res.program.stats()
     if args.fail_on_new:
         baseline = an.load_baseline(baseline_path)
         new, old = an.diff_baseline(findings, baseline)
-        stale = sum(baseline.values()) - len(old)
+        # under --changed-only the unfiltered debt is out of view, so
+        # the baseline legitimately "over-counts" — no stale note
+        stale = 0 if args.changed_only else \
+            sum(baseline.values()) - len(old)
         if args.json:
-            print(an.render_json(new, errors))
+            print(an.render_json(new, errors, call_graph=stats,
+                                 timings=res.timings))
         else:
             print(an.render_text(
                 new, errors,
@@ -107,14 +184,19 @@ def main(argv=None):
             if stale > 0:
                 print("graftlint: note: the baseline over-counts — "
                       "shrink it with --write-baseline")
+            if args.timings and res.timings:
+                print(an.render_timings(res.timings))
         if new or errors:
             return 1
         return 0
 
     if args.json:
-        print(an.render_json(findings, errors))
+        print(an.render_json(findings, errors, call_graph=stats,
+                             timings=res.timings))
     else:
         print(an.render_text(findings, errors))
+        if args.timings and res.timings:
+            print(an.render_timings(res.timings))
     return 1 if errors else 0
 
 
