@@ -817,6 +817,151 @@ def measure_serve_pool():
     return {"serve_sustained": sustained, "serve_spike": spike}
 
 
+def measure_generation():
+    """Relay-proof host phases ``generate_tokens_per_sec`` and
+    ``generate_p99_intertoken_ms`` (ISSUE 16): stateful autoregressive
+    sessions over the paged-KV GenerationEngine under Poisson arrivals.
+
+    Runner is pure-host (``tiny_lm(jit=False)`` with a fixed
+    per-decode-tick sleep — models a fixed per-step device cost that
+    the whole slot cohort SHARES, which is exactly what continuous
+    decode batching amortizes): no device, no relay.  Gates:
+
+    * batching: the multi-slot engine sustains >= 1.5x the token
+      throughput of a closed-loop single-session run (same model, same
+      per-tick cost) — continuous decode batching must buy capacity;
+    * prefix reuse: with half the arrivals sharing a common prompt
+      head, the content-hash prefix cache ends the run with a hit rate
+      >= 0.25 (hits / lookups);
+    * health: zero non-shed session failures, and every intertoken
+      gap sampled on the engine's emit path lands in the reservoir
+      (p99 reported as ``generate_p99_intertoken_ms``).
+    """
+    import threading as _th
+    import time as _t
+
+    import numpy as _np
+
+    from mxnet_tpu import config as mxcfg
+    from mxnet_tpu.serving.batcher import (RequestTimeoutError,
+                                           ServingOverloadError)
+    from mxnet_tpu.serving.generation import GenerationEngine, tiny_lm
+
+    seconds = float(mxcfg.get("BENCH_GENERATE_SECONDS"))
+    rate = float(mxcfg.get("BENCH_GENERATE_RATE"))
+    max_new = max(2, mxcfg.get("BENCH_GENERATE_TOKENS"))
+    tick_s = 0.0005   # modeled fixed device cost per decode dispatch
+    slots = 8
+
+    def build_engine(name, prefix_entries):
+        return GenerationEngine(
+            tiny_lm(vocab=64, d_model=16, max_len=256, seed=0, jit=False,
+                    per_token_cost_s=tick_s),
+            name=name, slots=slots, page_tokens=16, kv_budget_mb=16,
+            prefix_cache_entries=prefix_entries, max_len=256,
+            session_timeout_s=60.0)
+
+    rng = _np.random.default_rng(0)
+    shared = rng.integers(1, 63, size=32).astype(_np.int32)
+
+    def prompt_for(i):
+        tail = rng.integers(1, 63, size=int(rng.integers(2, 10)))
+        tail = tail.astype(_np.int32)
+        return _np.concatenate([shared, tail]) if i % 2 else tail
+
+    # -- closed-loop single session: the unbatched baseline --------------
+    single = build_engine("bench-gen-single", prefix_entries=0)
+    single.warm()
+    try:
+        t_end = _t.perf_counter() + max(0.5, seconds / 2)
+        single_tokens, i = 0, 0
+        t0 = _t.perf_counter()
+        while _t.perf_counter() < t_end:
+            single_tokens += len(single.generate(
+                prompt_for(i), max_new_tokens=max_new))
+            i += 1
+        single_tps = single_tokens / (_t.perf_counter() - t0)
+    finally:
+        single.close()
+
+    # -- open loop: Poisson session arrivals against the full engine -----
+    eng = build_engine("bench-gen", prefix_entries=32)
+    eng.warm()
+    # default rate: ~60% of the slot pool's modeled token capacity
+    lam = rate or 0.6 * slots * single_tps / max_new
+    sessions, sheds, refused = [], 0, []
+    try:
+        t_next = _t.perf_counter()
+        t_end = t_next + seconds
+        i = 0
+        while True:
+            now = _t.perf_counter()
+            if now >= t_end:
+                break
+            t_next += rng.exponential(1.0 / lam)
+            t_next = max(t_next, now - 0.002)  # open-loop discipline
+            if t_next > now:
+                _t.sleep(t_next - now)
+            try:
+                sessions.append(eng.start_session(
+                    prompt_for(i), max_new_tokens=max_new))
+            except ServingOverloadError:
+                sheds += 1
+            except Exception as e:  # noqa: BLE001 — gate-fatal bucket
+                refused.append(f"{type(e).__name__}: {e}")
+            i += 1
+        t0_drain = _t.perf_counter()
+        ok, failures = 0, list(refused)
+        for s in sessions:
+            try:
+                toks = s.result(timeout=30.0)
+                ok += 1
+                if len(toks) != max_new:
+                    failures.append(f"short session: {len(toks)} tokens")
+            except RequestTimeoutError:
+                failures.append("session timed out (drop)")
+            except Exception as e:  # noqa: BLE001 — gate-fatal bucket
+                failures.append(f"{type(e).__name__}: {e}")
+        wall = t0_drain - (t_end - seconds)
+        stats = eng.stats()
+        gaps = sorted(eng.metrics.drain_observations("intertoken_ms"))
+        p99_inter = (gaps[min(len(gaps) - 1, int(0.99 * (len(gaps) - 1)))]
+                     if gaps else None)
+        tps = stats["tokens_emitted"] / max(wall, 1e-9)
+    finally:
+        eng.close()
+
+    px = stats["prefix_cache"]
+    lookups = px["hits"] + px["misses"]
+    hit_rate = px["hits"] / lookups if lookups else 0.0
+    ratio = tps / max(single_tps, 1e-9)
+    throughput = {
+        "metric": "generate_tokens_per_sec",
+        "value": round(tps, 1), "unit": "tok/s",
+        "single_session_tok_per_sec": round(single_tps, 1),
+        "ratio_vs_single": round(ratio, 2),
+        "bar_ratio": 1.5,
+        "slots": slots, "arrival_rate_sessions_per_s": round(lam, 1),
+        "sessions_ok": ok, "sessions_shed": sheds,
+        "max_active": stats["max_active"],
+        "prefix_hit_rate": round(hit_rate, 3),
+        "prefix_hit_bar": 0.25,
+        "non_shed_failures": failures,
+        "passed": bool(ratio >= 1.5 and hit_rate >= 0.25
+                       and ok > 0 and not failures),
+    }
+    intertoken = {
+        "metric": "generate_p99_intertoken_ms",
+        "value": round(p99_inter, 3) if p99_inter is not None else None,
+        "unit": "ms",
+        "samples": len(gaps),
+        "modeled_tick_ms": tick_s * 1e3,
+        "passed": bool(p99_inter is not None),
+    }
+    return {"generate_throughput": throughput,
+            "generate_intertoken": intertoken}
+
+
 _COLD_START_CHILD = r'''
 import json, os, sys, time
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -1650,6 +1795,24 @@ def main():
                 log(f"serve_pool phase failed: {type(e).__name__}: {e}")
                 result["serve_spike"] = {
                     "metric": "serve_spike_p99_ms",
+                    "error": f"{type(e).__name__}: {e}"}
+
+        if _cfg0.get("BENCH_GENERATE"):
+            try:
+                result.update(measure_generation())
+                gt = result["generate_throughput"]
+                gi = result["generate_intertoken"]
+                log(f"[generate] {gt['value']} tok/s vs single "
+                    f"{gt['single_session_tok_per_sec']} "
+                    f"({gt['ratio_vs_single']}x, bar {gt['bar_ratio']}x), "
+                    f"prefix hit rate {gt['prefix_hit_rate']} "
+                    f"(bar {gt['prefix_hit_bar']}), p99 intertoken "
+                    f"{gi['value']}ms, "
+                    f"{'PASS' if gt['passed'] else 'FAIL'}")
+            except Exception as e:
+                log(f"generate phase failed: {type(e).__name__}: {e}")
+                result["generate_throughput"] = {
+                    "metric": "generate_tokens_per_sec",
                     "error": f"{type(e).__name__}: {e}"}
 
         if _cfg0.get("BENCH_CHAOS"):

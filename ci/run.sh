@@ -67,13 +67,17 @@ echo "== elastic multi-host smoke (2 processes x 4 fake devices: kill-and-recove
 # site as the first anomalous event (docs/observability.md runbook)
 JAX_PLATFORMS=cpu python -m mxnet_tpu.parallel.elastic
 
-echo "== serving smoke (replica pools: 64-client burst + autoscaling hot-swap) =="
+echo "== serving smoke (replica pools: burst + hot-swap + generation sessions) =="
 # phase 1: 64 concurrent clients against a 2-replica pool with a small
 # queue — every request answered correctly or shed with a structured
 # error; phase 2: ModelRepository.watch hot-swaps a newly committed
 # checkpoint step under sustained load — ZERO dropped non-shed requests
 # and ZERO executor-cache misses after the flip (warm-before-flip x
-# replica pools, docs/serving.md)
+# replica pools); phase 3: NaN logits fail typed, survivors serve;
+# phase 4: stateful generation — warm decode + prefill ladders, N
+# concurrent sessions over an 8-slot paged KV pool, hot-reload the LM
+# MID-STREAM: zero non-shed drops, ZERO post-flip decode compiles, and
+# KV slot/ledger page accounting exactly zero after (docs/serving.md)
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
   python -m mxnet_tpu.serving.smoke
 
@@ -93,14 +97,17 @@ echo "== compile smoke (persistent cache, ladder warmup, retrace ratchet) =="
 JAX_PLATFORMS=cpu python -m mxnet_tpu.compile.smoke
 
 echo "== chaos smoke (failpoints, composed fault scenarios, self-healing) =="
-# the six composed scenarios: kvstore worker kill/revive commits past
+# the composed scenarios: kvstore worker kill/revive commits past
 # the kill, corrupt-checkpoint-under-reload serves the old version with
 # zero non-shed failures, a wedged batcher stays p99-bounded under a
 # named watchdog stall, a serving replica killed mid-burst drains with
-# zero non-shed drops while siblings absorb the load, a mid-scan-window
-# SIGKILL resumes bit-identically, and the stalled/killed mesh fused
-# step self-heals + resumes bit-identically onto a resized mesh;
-# disabled-failpoint overhead must stay < 1us (docs/chaos.md)
+# zero non-shed drops while siblings absorb the load, a generation
+# engine killed mid-stream fails its sessions typed-retryable so they
+# resume on the sibling with ZERO leaked KV slots/pages, a
+# mid-scan-window SIGKILL resumes bit-identically, and the
+# stalled/killed mesh fused step self-heals + resumes bit-identically
+# onto a resized mesh; disabled-failpoint overhead must stay < 1us
+# (docs/chaos.md)
 JAX_PLATFORMS=cpu python -m mxnet_tpu.chaos.smoke
 
 echo "== soak smoke (90s train+ckpt+reload+traffic under chaos, alert-engine gated) =="

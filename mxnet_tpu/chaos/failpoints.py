@@ -98,6 +98,11 @@ SITES = {
         "ReplicaPool.submit, before the request is handed to the chosen "
         "replica (raise exercises the spill path: the router re-routes "
         "to the next-least-loaded sibling)",
+    "serving/generation/decode":
+        "generation engine loop, before the fixed-shape decode dispatch "
+        "(raise kills the loop: active sessions fail typed-retryable "
+        "and resume on a sibling engine, slots and ledger pages "
+        "provably release — the replica_kill_mid_generation scenario)",
     "serving/repository/poll":
         "ModelRepository.poll_checkpoint, before the committed-step scan",
     "serving/repository/warm_hook":

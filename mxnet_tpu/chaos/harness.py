@@ -35,6 +35,13 @@ tested alone:
    one replica of the pool is removed under load: it drains everything
    it admitted, the survivors absorb the traffic, and zero non-shed
    requests are dropped or hung.
+7. **replica kill mid-generation** (ISSUE 16) — an injected
+   ``serving/generation/decode`` fault kills one of two generation
+   engines past its restart budget mid-stream: every victim session
+   fails typed-retryable (never hangs) and resumes on the sibling from
+   ``prompt + tokens-so-far``, survivor sessions stream untouched, and
+   both engines' KV slots and ledger pages are provably released
+   (zero-leak asserted).
 
 Every scenario ends in recovery or a typed error — the assertions
 include "no hang" (bounded waits everywhere) and "no silent loss"
@@ -580,6 +587,145 @@ def scenario_replica_kill_mid_burst(seconds=2.5, n_replicas=3,
 
 
 # ---------------------------------------------------------------------------
+# scenario: replica death mid-generation (ISSUE 16)
+# ---------------------------------------------------------------------------
+def scenario_replica_kill_mid_generation(n_sessions=6, max_new=10):
+    """Chaos over the stateful serving plane: two generation engines
+    (the "replicas") stream concurrent sessions; an injected
+    ``serving/generation/decode`` fault kills one engine's loop past
+    its restart budget mid-generation.  Contract: every session on the
+    victim fails TYPED-retryable (``ServingWorkerError``) — never
+    hangs — and the client resumes it on the sibling engine with
+    ``prompt + tokens-so-far`` as the new prompt (the sibling's prefix
+    cache makes the resume cheap); sessions on the survivor stream to
+    completion untouched.  Afterwards both engines' slot pools and the
+    resource ledger's ``kv_pages``/``prefix_cache`` rows are PROVABLY
+    zero — a dead replica leaks nothing."""
+    import numpy as np
+
+    from ..serving import generation
+    from ..serving.batcher import (RequestTimeoutError, ServingClosedError,
+                                   ServingOverloadError,
+                                   ServingWorkerError)
+    from ..telemetry.resources import LEDGER
+
+    chaos.reset()
+    engine_kw = dict(slots=4, page_tokens=8, kv_budget_mb=8,
+                     prefix_cache_entries=8, max_len=96,
+                     loop_restarts=0, session_timeout_s=30.0)
+    # identical seeds: the sibling holds the same weights, so a greedy
+    # resume continues the victim's stream deterministically
+    eng_a = generation.GenerationEngine(
+        generation.tiny_lm(vocab=24, d_model=8, max_len=96, seed=11),
+        name="chaos-gen-a", **engine_kw)
+    eng_b = generation.GenerationEngine(
+        generation.tiny_lm(vocab=24, d_model=8, max_len=96, seed=11),
+        name="chaos-gen-b", **engine_kw)
+    eng_a.warm()
+    eng_b.warm()
+    # one engine dies: the site is shared, hits-triggered, count=1 —
+    # whichever loop reaches the Nth decode dispatch first is the victim
+    chaos.arm("serving/generation/decode", "raise", hits=4, count=1)
+
+    result = {"ok": False, "completed": 0, "resumed": 0, "shed": 0,
+              "hung": 0, "non_typed_failures": []}
+    lock = threading.Lock()
+    rng = np.random.RandomState(7)
+    prompts = [rng.randint(0, 24, size=rng.randint(4, 12)).astype(np.int32)
+               for _ in range(n_sessions)]
+    engines = [eng_a, eng_b]
+
+    def client(i):
+        eng = engines[i % 2]
+        sibling = engines[(i + 1) % 2]
+        try:
+            sess = eng.start_session(prompts[i], max_new_tokens=max_new,
+                                     greedy=True)
+        except (ServingOverloadError, ServingClosedError):
+            with lock:
+                result["shed"] += 1
+            return
+        try:
+            sess.result(30.0)
+            with lock:
+                result["completed"] += 1
+            return
+        except ServingWorkerError:
+            pass  # the replica died under this session: resume below
+        except (ServingOverloadError, ServingClosedError):
+            with lock:
+                result["shed"] += 1
+            return
+        except RequestTimeoutError:
+            with lock:
+                result["hung"] += 1
+            return
+        except Exception as e:  # noqa: BLE001 — gate-fatal bucket
+            with lock:
+                result["non_typed_failures"].append(
+                    f"{type(e).__name__}: {e}")
+            return
+        # typed-retryable death: resume on the sibling from where the
+        # stream stopped
+        done = list(sess.tokens)
+        resume_prompt = np.concatenate(
+            [prompts[i], np.asarray(done, np.int32)])
+        try:
+            rest = sibling.generate(resume_prompt,
+                                    max_new_tokens=max_new - len(done)
+                                    or 1, greedy=True)
+            with lock:
+                result["resumed"] += 1
+                result["completed"] += bool(done + rest)
+        except (ServingOverloadError, ServingClosedError,
+                ServingWorkerError):
+            with lock:
+                result["shed"] += 1
+        except Exception as e:  # noqa: BLE001 — gate-fatal bucket
+            with lock:
+                result["non_typed_failures"].append(
+                    f"resume: {type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(n_sessions)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        result["hung"] += sum(t.is_alive() for t in threads)
+        result["victim"] = ("chaos-gen-a" if eng_a.stats()["failed"]
+                            else "chaos-gen-b" if eng_b.stats()["failed"]
+                            else None)
+    finally:
+        chaos.reset()
+        eng_a.close()
+        eng_b.close()
+    # zero-leak assertion: slots, pages and ledger rows all returned
+    owners = LEDGER.snapshot()["owners"]
+    leaks = {}
+    for eng in engines:
+        pool_stats = eng.pool.stats()
+        row = owners.get(f"generation/{eng.name}", {})
+        leaks[eng.name] = {
+            "slots_in_use": pool_stats["slots_in_use"],
+            "kv_bytes": pool_stats["kv_bytes"],
+            "ledger_kv": row.get("kv_pages", 0),
+            "ledger_prefix": row.get("prefix_cache", 0)}
+    result["leaks"] = leaks
+    result["zero_leak"] = all(
+        not any(v.values()) for v in leaks.values())
+    result["ok"] = bool(
+        result["victim"] is not None
+        and result["completed"] + result["shed"] == n_sessions
+        and result["resumed"] >= 1
+        and result["hung"] == 0
+        and result["zero_leak"]
+        and not result["non_typed_failures"])
+    return result
+
+
+# ---------------------------------------------------------------------------
 # scenario 4: SIGKILL mid-scan-window, bit-identical resume
 # ---------------------------------------------------------------------------
 _SCAN_VICTIM = """
@@ -1072,6 +1218,8 @@ def run_all(workdir=None, verbose=True):
              os.path.join(base, "s2"))),
         ("wedged_batcher", scenario_wedged_batcher),
         ("replica_kill_mid_burst", scenario_replica_kill_mid_burst),
+        ("replica_kill_mid_generation",
+         scenario_replica_kill_mid_generation),
         ("sigkill_mid_scan",
          lambda: scenario_sigkill_mid_scan(os.path.join(base, "s4"))),
         ("mesh_collective_stall",

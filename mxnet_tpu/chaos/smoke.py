@@ -1,4 +1,4 @@
-"""Chaos smoke for CI: replay the six composed fault scenarios.
+"""Chaos smoke for CI: replay the composed fault scenarios.
 
 Asserted per scenario (the ISSUE 8 acceptance contract):
 
@@ -24,7 +24,13 @@ Asserted per scenario (the ISSUE 8 acceptance contract):
    spilled to sibling replicas, the replica removed under load drained
    everything it admitted, the survivors kept serving, and zero
    non-shed requests were dropped or hung.
-7. multi-host peer loss mid-window (ISSUE 11) — host 1 of a 2-process
+7. replica kill mid-generation (ISSUE 16) — an injected
+   ``serving/generation/decode`` fault killed one of two generation
+   engines past its restart budget mid-stream: victim sessions failed
+   typed-retryable and resumed on the sibling, survivors streamed on,
+   and the KV slot pools + resource-ledger pages ended provably zero
+   (no leaked slots, no leaked pages, no hangs).
+8. multi-host peer loss mid-window (ISSUE 11) — host 1 of a 2-process
    jax.distributed mesh SIGKILLed at window 3: the survivor took a
    TYPED exit from the deadline-bounded rendezvous (zero hangs, zero
    untyped failures), the boundary checkpoint committed, the elastic

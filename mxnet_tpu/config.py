@@ -477,6 +477,29 @@ _register("MXNET_SERVING_WORKER_RESTARTS", int, 8,
 _register("MXNET_SERVING_EXECUTOR_CACHE", int, 32,
           "LRU capacity of the compiled-executor cache, in (model, "
           "version, bucketed-shape) entries")
+_register("MXNET_GENERATION_SLOTS", int, 8,
+          "KV-cache slots per generation engine = concurrent sessions "
+          "one fixed-shape decode micro-batch serves; a full pool "
+          "sheds new sessions typed (docs/serving.md generation)")
+_register("MXNET_GENERATION_MAX_LEN", int, 512,
+          "generation KV arena length cap (prompt + generated tokens "
+          "per session; the decode step's fixed sequence dimension)")
+_register("MXNET_GENERATION_PAGE_TOKENS", int, 64,
+          "KV-cache page granularity in tokens: session reservations "
+          "charge the resource ledger in whole pages, and the prefix "
+          "cache stores/hits page-aligned prompt prefixes")
+_register("MXNET_GENERATION_KV_BUDGET_MB", int, 64,
+          "HBM budget for one engine's committed KV pages; admission "
+          "sheds typed (ServingOverloadError) rather than commit past "
+          "it — the generation analogue of the queue watermark")
+_register("MXNET_GENERATION_PREFIX_CACHE", int, 32,
+          "prefix-cache capacity in entries (page-aligned prompt-"
+          "prefix activations, LRU, content-hash keyed per model "
+          "version); 0 disables prefix reuse")
+_register("MXNET_GENERATION_LOOP_RESTARTS", int, 2,
+          "how many times a crashed generation loop restarts (active "
+          "sessions fail typed-retryable and can resume on a sibling) "
+          "before the engine fails fast; 0 = never restart")
 _register("MXNET_MODULE_PAD_PARTIAL_PREDICT", bool, True,
           "Module.forward(is_train=False): pad a partial final batch up "
           "to the bound batch and slice outputs, instead of rebinding a "
@@ -571,6 +594,20 @@ _register("BENCH_SERVE_SPIKE_X", float, 10.0,
 _register("BENCH_SERVE_SPIKE_REPLICAS", int, 4,
           "bench.py spike phase: ReplicaPool size (the >= 2x-vs-single "
           "throughput gate scales with this)")
+_register("BENCH_GENERATE", bool, True,
+          "bench.py: also measure the generation phases "
+          "generate_tokens_per_sec / generate_p99_intertoken_ms "
+          "(Poisson session arrivals through a pure-host per-token-"
+          "cost engine, relay-proof) plus the shared-prefix "
+          "prefix-cache hit-rate gate")
+_register("BENCH_GENERATE_SECONDS", float, 2.0,
+          "bench.py generation phase: Poisson session-arrival window "
+          "(s)")
+_register("BENCH_GENERATE_RATE", float, 0.0,
+          "bench.py generation phase: Poisson session arrival rate "
+          "(sessions/s); 0 = auto-sized from the per-token host cost")
+_register("BENCH_GENERATE_TOKENS", int, 32,
+          "bench.py generation phase: max_new_tokens per session")
 _register("BENCH_DISPATCH", bool, True,
           "bench.py: measure fused-train-step dispatch phases on the CPU "
           "backend (resnet50_step_dispatches / train_step_ms_bs32); "

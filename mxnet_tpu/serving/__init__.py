@@ -15,26 +15,38 @@ spill to siblings, predicted-p99 SLO admission control, and
 drain-on-removal — with load shedding, per-request timeouts, graceful
 drain, and p50/p90/p99 serving metrics exported through the profiler
 counter lanes and the telemetry registry.  See docs/serving.md.
+
+ISSUE 16 adds the STATEFUL half: ``generation``/``kv_cache`` hold
+autoregressive sessions whose paged KV caches live on device across
+micro-batches — slot-pool admission charged to the resource ledger,
+anchor/join prefill cohorts interleaved with one fixed-shape jit decode
+step per micro-batch, and a content-hash prefix cache for shared prompt
+heads (``ModelServer.load_generator`` / ``generate``).
 """
-from .batcher import (DynamicBatcher, RequestTimeoutError, ServeFuture,
-                      ServingClosedError, ServingOverloadError,
-                      ServingWorkerError)
+from .batcher import (CohortQueue, DynamicBatcher, RequestTimeoutError,
+                      ServeFuture, ServingClosedError,
+                      ServingOverloadError, ServingWorkerError)
 from .executor_cache import (CachedExecutor, ExecutorCache,
                              bind_inference_executor, bucket_batch,
                              feed_signature, pad_to, shape_signature,
                              shared_cache)
+from .generation import (GenerationEngine, GenerationModel,
+                         GenerationSession, tiny_lm)
+from .kv_cache import KVPoolExhaustedError, KVSlotPool, PrefixCache
 from .metrics import ServingMetrics, stats
 from .repository import ModelRepository
 from .router import AdmissionController, ReplicaPool
 from .server import ModelServer
 
 __all__ = [
-    "AdmissionController", "CachedExecutor", "DynamicBatcher",
-    "ExecutorCache", "ModelRepository",
-    "ModelServer", "ReplicaPool", "RequestTimeoutError", "ServeFuture",
-    "ServingClosedError",
+    "AdmissionController", "CachedExecutor", "CohortQueue",
+    "DynamicBatcher", "ExecutorCache",
+    "GenerationEngine", "GenerationModel", "GenerationSession",
+    "KVPoolExhaustedError", "KVSlotPool", "ModelRepository",
+    "ModelServer", "PrefixCache", "ReplicaPool", "RequestTimeoutError",
+    "ServeFuture", "ServingClosedError",
     "ServingMetrics", "ServingOverloadError", "ServingWorkerError",
     "bind_inference_executor",
     "bucket_batch", "feed_signature", "pad_to", "shape_signature",
-    "shared_cache", "stats",
+    "shared_cache", "stats", "tiny_lm",
 ]

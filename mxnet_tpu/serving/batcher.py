@@ -734,3 +734,63 @@ class DynamicBatcher:
 
     def __exit__(self, *exc):
         self.close()
+
+
+class CohortQueue:
+    """The batcher's anchor/join admission, extracted as a reusable
+    cohort former (ISSUE 16): anchor on the OLDEST pending item, claim
+    every currently-queued item with the anchor's signature (bounded by
+    ``max_cohort``), and leave mismatched items in place — they keep
+    their queue position (and become the next anchor) instead of being
+    serialized behind a cohort they cannot join.
+
+    The generation engine's prefill queue is the first client: pending
+    sessions coalesce into same-prompt-bucket prefill cohorts between
+    decode ticks, exactly the way ``_take_batch`` forms same-signature
+    micro-batches — but decoupled from the batcher's deadline/shed
+    policy, because generation admission control lives in the KV slot
+    pool instead of a queue watermark."""
+
+    def __init__(self, sig_fn, max_cohort):
+        self._sig_fn = sig_fn
+        self.max_cohort = max(1, int(max_cohort))
+        self._items = collections.deque()
+        self._cond = threading.Condition()
+
+    def put(self, item):
+        with self._cond:
+            self._items.append(item)
+            self._cond.notify_all()
+
+    def __len__(self):
+        with self._cond:
+            return len(self._items)
+
+    def take(self, timeout=None):
+        """Claim one cohort: block up to ``timeout`` for an anchor
+        (``timeout=0`` polls), then join every queued same-signature
+        item.  Returns a possibly-empty list."""
+        with self._cond:
+            if not self._items and timeout:
+                self._cond.wait(timeout)
+            if not self._items:
+                return []
+            cohort = [self._items.popleft()]
+            sig = self._sig_fn(cohort[0])
+            idx = 0
+            while len(cohort) < self.max_cohort and idx < len(self._items):
+                if self._sig_fn(self._items[idx]) == sig:
+                    # graftlint: disable=lock-discipline -- self._cond is held for the whole scan
+                    item = self._items[idx]
+                    del self._items[idx]
+                    cohort.append(item)
+                else:
+                    idx += 1
+            return cohort
+
+    def drain(self):
+        """Remove and return everything queued (crash/close fan-out)."""
+        with self._cond:
+            items = list(self._items)
+            self._items.clear()
+            return items

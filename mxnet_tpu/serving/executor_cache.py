@@ -191,8 +191,22 @@ class ExecutorCache:
         self.misses = 0
         self.evictions = 0
         self._per_model = {}  # model -> {"hits"/"misses"/"evictions"}
+        self._retire_hooks = []  # fn(model, keep_versions), flip-time
         with _ALL_CACHES_LOCK:
             _ALL_CACHES.add(self)
+
+    def add_retire_hook(self, fn):
+        """Register ``fn(model, keep_versions)`` to run whenever
+        ``evict_stale_versions`` retires a flipped version — executors
+        are not the only per-version state a hot swap must tear down:
+        the generation engine hangs its decode/prefill ladders and
+        prefix-cache activations here, so a stale version's compiled
+        step or cached activations can never serve after the flip
+        (ISSUE 16 small fix).  Hook failures are logged, never fatal —
+        the executor eviction already happened."""
+        with self._lock:
+            self._retire_hooks.append(fn)
+        return fn
 
     def _model_cell(self, model):
         cell = self._per_model.get(model)
@@ -259,7 +273,10 @@ class ExecutorCache:
         version NOT in ``keep_versions`` (typically {new, previous} —
         the previous stays warm for in-flight batches and a fast
         rollback).  In-flight users hold their own references, so
-        eviction never tears an executing batch."""
+        eviction never tears an executing batch.  Registered retire
+        hooks fire afterwards with the same ``(model, keep_versions)``
+        so sibling per-version state (generation decode ladders,
+        prefix-cache activations) retires in the same flip."""
         keep = set(keep_versions)
         with self._lock:
             doomed = [k for k in self._entries
@@ -269,7 +286,16 @@ class ExecutorCache:
                 gone = self._entries.pop(k)
                 _ledger().release(str(gone.model), "executor_cache",
                                   gone.nbytes)
-            return len(doomed)
+            hooks = list(self._retire_hooks)
+        for fn in hooks:
+            try:
+                fn(model, keep)
+            except Exception:  # the flip already happened; never unwind
+                import logging
+                logging.getLogger("mxnet_tpu.serving").exception(
+                    "executor-cache retire hook %r failed for %s",
+                    fn, model)
+        return len(doomed)
 
     def __len__(self):
         with self._lock:

@@ -1,0 +1,294 @@
+"""Paged KV-cache accounting: slot pool, page ladder, prefix reuse.
+
+Generation state is the one serving resource that OUTLIVES a
+micro-batch: a session's key/value cache must stay resident on device
+between decode steps, so HBM is committed for the session's lifetime —
+admission control has to happen at session start, not per batch.  This
+module is the accounting half of the generation subsystem (ISSUE 16):
+
+* :class:`KVSlotPool` — a fixed pool of decode slots (one slot = one
+  row of the fixed-shape decode micro-batch).  ``acquire`` charges the
+  session's **bucket-laddered page reservation** — ``ceil((prompt +
+  max_new) / page_tokens)`` pages, each ``page_tokens *
+  bytes_per_token`` — to the PR 13 resource ledger under
+  ``(owner, "kv_pages")``, so committed KV bytes are visible in
+  ``LEDGER``/``/fleet.json`` next to executor-cache and train-state
+  footprints.  A full pool or a blown budget sheds **typed**
+  (:class:`KVPoolExhaustedError`, a :class:`ServingOverloadError`) —
+  the same fail-fast contract as the batcher's queue watermark.
+  ``release`` is idempotent and returns every page: the zero-leak
+  invariant the ``replica_kill_mid_generation`` chaos scenario asserts.
+* :class:`PrefixCache` — the ``ExecutorCache`` idiom applied to
+  activations: an LRU keyed ``(model, version, sha1(prefix tokens))``
+  holding host copies of page-aligned prompt-prefix KV.  A hit writes
+  the cached pages into the session's slot and skips recomputing the
+  shared prefix; entries charge ``(owner, "prefix_cache")`` in the
+  ledger and ``evict_stale_versions`` retires a flipped version's
+  activations so they can never serve again (ISSUE 16 small fix).
+
+The ledger is an estimator, not an allocator (resources.py): pages
+bound what generation may COMMIT, the arena itself is allocated once at
+engine construction with a fixed ``[slots, max_len]`` shape.
+"""
+from __future__ import annotations
+
+import collections
+import hashlib
+import threading
+
+import numpy as np
+
+from ..base import MXNetError
+from .batcher import ServingOverloadError
+
+
+def _ledger():
+    from ..telemetry.resources import LEDGER
+    return LEDGER
+
+
+class KVPoolExhaustedError(ServingOverloadError):
+    """Session admission shed: every decode slot is busy, or the page
+    reservation would blow the KV HBM budget.  Typed and retryable —
+    back off and resubmit once a sibling session finishes."""
+
+    def __init__(self, pool, kind, in_use, capacity):
+        self.batcher = pool
+        self.queue_depth = in_use
+        self.watermark = capacity
+        self.predicted_p99_ms = None
+        self.slo_ms = None
+        self.kind = kind
+        MXNetError.__init__(
+            self,
+            f"generation[{pool}]: KV {kind} exhausted ({in_use}/{capacity}"
+            f" {kind} committed); session shed — retry with backoff, or "
+            "lower max_new_tokens so the page reservation fits "
+            "(MXNET_GENERATION_SLOTS / MXNET_GENERATION_KV_BUDGET_MB)")
+
+
+def pages_for(tokens, page_tokens):
+    """Bucket-laddered page count: tokens rounded up to whole pages
+    (minimum one page — an admitted session always holds a slot row)."""
+    return max(1, -(-int(tokens) // max(1, int(page_tokens))))
+
+
+class KVSlot:
+    """One decode-slot lease: the arena row index plus the session's
+    charged page reservation."""
+
+    __slots__ = ("index", "session_id", "pages", "nbytes", "released")
+
+    def __init__(self, index, session_id, pages, nbytes):
+        self.index = index
+        self.session_id = session_id
+        self.pages = pages
+        self.nbytes = nbytes
+        self.released = False
+
+
+class KVSlotPool:
+    """Admission-controlled pool of decode slots with ledger-charged
+    page reservations."""
+
+    def __init__(self, owner, slots, page_tokens, bytes_per_token,
+                 budget_bytes):
+        self.owner = str(owner)
+        self.slots = int(slots)
+        self.page_tokens = int(page_tokens)
+        self.bytes_per_token = int(bytes_per_token)
+        self.budget_bytes = int(budget_bytes)
+        self._lock = threading.Lock()
+        self._free = list(range(self.slots - 1, -1, -1))
+        self._leases = {}          # index -> KVSlot
+        self.acquires = 0
+        self.releases = 0
+        self.sheds = 0
+
+    def page_bytes(self):
+        return self.page_tokens * self.bytes_per_token
+
+    def acquire(self, session_id, reserve_tokens):
+        """Lease a slot charging ``reserve_tokens`` worth of pages;
+        sheds typed when no slot is free or the budget cannot fit the
+        reservation."""
+        pages = pages_for(reserve_tokens, self.page_tokens)
+        nbytes = pages * self.page_bytes()
+        with self._lock:
+            if not self._free:
+                self.sheds += 1
+                raise KVPoolExhaustedError(self.owner, "slots",
+                                           len(self._leases), self.slots)
+            committed = sum(s.nbytes for s in self._leases.values())
+            if committed + nbytes > self.budget_bytes:
+                self.sheds += 1
+                raise KVPoolExhaustedError(
+                    self.owner, "page budget bytes",
+                    committed + nbytes, self.budget_bytes)
+            slot = KVSlot(self._free.pop(), session_id, pages, nbytes)
+            self._leases[slot.index] = slot
+            self.acquires += 1
+        _ledger().add(self.owner, "kv_pages", nbytes)
+        return slot
+
+    def grow(self, slot, total_tokens):
+        """Extend ``slot``'s reservation to cover ``total_tokens``
+        (no-op when already covered); sheds typed on a blown budget —
+        the caller fails the SESSION, never a sibling."""
+        pages = pages_for(total_tokens, self.page_tokens)
+        if pages <= slot.pages:
+            return 0
+        extra = (pages - slot.pages) * self.page_bytes()
+        with self._lock:
+            committed = sum(s.nbytes for s in self._leases.values())
+            if committed + extra > self.budget_bytes:
+                self.sheds += 1
+                raise KVPoolExhaustedError(
+                    self.owner, "page budget bytes",
+                    committed + extra, self.budget_bytes)
+            slot.pages = pages
+            slot.nbytes += extra
+        _ledger().add(self.owner, "kv_pages", extra)
+        return extra
+
+    def release(self, slot):
+        """Return the slot and every charged page (idempotent)."""
+        with self._lock:
+            if slot.released or self._leases.get(slot.index) is not slot:
+                return False
+            slot.released = True
+            del self._leases[slot.index]
+            self._free.append(slot.index)
+            self.releases += 1
+        _ledger().release(self.owner, "kv_pages", slot.nbytes)
+        return True
+
+    def stats(self):
+        with self._lock:
+            leases = list(self._leases.values())
+            return {
+                "slots": self.slots,
+                "slots_in_use": len(leases),
+                "pages_in_use": sum(s.pages for s in leases),
+                "kv_bytes": sum(s.nbytes for s in leases),
+                "budget_bytes": self.budget_bytes,
+                "page_tokens": self.page_tokens,
+                "bytes_per_token": self.bytes_per_token,
+                "acquires": self.acquires,
+                "releases": self.releases,
+                "sheds": self.sheds,
+            }
+
+
+def prefix_key(model, version, tokens, length):
+    """Content-hash cache key for a token prefix: the activation
+    analogue of the executor cache's ``(model, version, signature)``."""
+    digest = hashlib.sha1(
+        np.ascontiguousarray(np.asarray(tokens[:length],
+                                        np.int64))).hexdigest()
+    return (str(model), int(version), int(length), digest)
+
+
+class PrefixCache:
+    """LRU of page-aligned prompt-prefix KV activations (host copies)."""
+
+    def __init__(self, owner, capacity, page_tokens):
+        self.owner = str(owner)
+        self.capacity = int(capacity)
+        self.page_tokens = int(page_tokens)
+        self._lock = threading.Lock()
+        self._entries = collections.OrderedDict()  # key -> (kv, nbytes)
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def enabled(self):
+        return self.capacity > 0
+
+    def _hit_lengths(self, n_tokens):
+        """Candidate page-aligned prefix lengths, longest first.  The
+        final prompt token is always recomputed (its decode step is what
+        produces the first sampled-token logits), so a full-prompt hit
+        caps at ``n_tokens - 1`` rounded down to a page boundary."""
+        longest = ((int(n_tokens) - 1) // self.page_tokens) \
+            * self.page_tokens
+        return range(longest, 0, -self.page_tokens)
+
+    def lookup(self, model, version, tokens):
+        """Longest cached page-aligned prefix of ``tokens`` for this
+        (model, version) — ``(length, kv_dict)`` or ``(0, None)``."""
+        if not self.enabled():
+            return 0, None
+        for length in self._hit_lengths(len(tokens)):
+            key = prefix_key(model, version, tokens, length)
+            with self._lock:
+                entry = self._entries.get(key)
+                if entry is not None:
+                    self._entries.move_to_end(key)
+                    self.hits += 1
+                    return length, entry[0]
+        with self._lock:
+            self.misses += 1
+        return 0, None
+
+    def store(self, model, version, tokens, kv):
+        """Insert host KV for the longest page-aligned prefix of
+        ``tokens`` (``kv`` leaves are ``[prompt_len, ...]`` host
+        arrays, truncated here).  Skips sub-page prompts."""
+        if not self.enabled():
+            return 0
+        lengths = list(self._hit_lengths(len(tokens)))
+        if not lengths:
+            return 0
+        length = lengths[0]
+        key = prefix_key(model, version, tokens, length)
+        clipped = {name: np.ascontiguousarray(
+            np.asarray(arr)[:length]) for name, arr in kv.items()}
+        nbytes = sum(a.nbytes for a in clipped.values())
+        with self._lock:
+            if key in self._entries:
+                self._entries.move_to_end(key)
+                return length
+            self._entries[key] = (clipped, nbytes)
+            doomed = []
+            while len(self._entries) > self.capacity:
+                _k, gone = self._entries.popitem(last=False)
+                self.evictions += 1
+                doomed.append(gone[1])
+        _ledger().add(self.owner, "prefix_cache", nbytes)
+        for freed in doomed:
+            _ledger().release(self.owner, "prefix_cache", freed)
+        return length
+
+    def evict_stale_versions(self, model, keep_versions):
+        """Version-flip retirement: a stale version's activations must
+        never seed a new session's KV (ISSUE 16 small fix)."""
+        keep = {int(v) for v in keep_versions}
+        with self._lock:
+            doomed = [k for k in self._entries
+                      if k[0] == str(model) and k[1] not in keep]
+            freed = 0
+            for k in doomed:
+                freed += self._entries.pop(k)[1]
+                self.evictions += 1
+        if freed:
+            _ledger().release(self.owner, "prefix_cache", freed)
+        return len(doomed)
+
+    def clear(self):
+        with self._lock:
+            freed = sum(n for _kv, n in self._entries.values())
+            self._entries.clear()
+        if freed:
+            _ledger().release(self.owner, "prefix_cache", freed)
+
+    def __len__(self):
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self):
+        with self._lock:
+            return {"size": len(self._entries), "capacity": self.capacity,
+                    "hits": self.hits, "misses": self.misses,
+                    "evictions": self.evictions,
+                    "bytes": sum(n for _kv, n in self._entries.values())}

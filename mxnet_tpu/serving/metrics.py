@@ -44,6 +44,7 @@ class ServingMetrics:
         self._counters = collections.Counter()
         self._gauges = {}
         self._latencies_ms = collections.deque(maxlen=_LATENCY_WINDOW)
+        self._reservoirs = {}   # name -> bounded deque (observe())
         self._batch_items = 0
         self._batch_slots = 0
         self._t_start = time.perf_counter()
@@ -70,6 +71,27 @@ class ServingMetrics:
     def observe_latency(self, ms):
         with self._lock:
             self._latencies_ms.append(float(ms))
+
+    def observe(self, key, value):
+        """Named bounded reservoir alongside the request-latency one —
+        e.g. the generation engine's per-token ``intertoken_ms`` gaps;
+        ``snapshot()`` renders p50/p90/p99 per key (ISSUE 16)."""
+        with self._lock:
+            res = self._reservoirs.get(key)
+            if res is None:
+                res = self._reservoirs[key] = collections.deque(
+                    maxlen=_LATENCY_WINDOW)
+            res.append(float(value))
+
+    def drain_observations(self, key):
+        """Return AND clear one named reservoir (windowed percentile
+        measurement, like :meth:`drain_latencies`)."""
+        with self._lock:
+            res = self._reservoirs.get(key)
+            out = list(res) if res else []
+            if res:
+                res.clear()
+        return out
 
     def drain_latencies(self):
         """Return AND clear the latency reservoir — windowed percentile
@@ -101,6 +123,8 @@ class ServingMetrics:
             counters = dict(self._counters)
             gauges = dict(self._gauges)
             lat = list(self._latencies_ms)
+            reservoirs = {k: list(v)
+                          for k, v in self._reservoirs.items() if v}
             items, slots = self._batch_items, self._batch_slots
             elapsed = max(1e-9, time.perf_counter() - self._t_start)
         lat.sort()
@@ -117,6 +141,12 @@ class ServingMetrics:
             },
             "batch_occupancy": round(items / slots, 4) if slots else None,
         }
+        for key, vals in sorted(reservoirs.items()):
+            vals.sort()
+            snap[key] = {"p50": _percentile(vals, 50),
+                         "p90": _percentile(vals, 90),
+                         "p99": _percentile(vals, 99),
+                         "samples": len(vals)}
         snap.update(counters)
         snap.update(gauges)
         return snap

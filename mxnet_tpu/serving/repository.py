@@ -196,6 +196,27 @@ class ModelRepository:
             t.start()
         return version
 
+    def register_opaque(self, name, payload, version=None):
+        """Version-allocate an **opaque** (non-Symbol) model payload
+        through the same pointer-flip + flip-hook machinery as
+        :meth:`load` — generation models (ISSUE 16) ride the
+        repository's hot-reload semantics without a Symbol graph.  The
+        payload lands in ``mv.params`` with ``mv.symbol is None`` (the
+        opaque marker) and empty ``input_names``.
+
+        Warm hooks are NOT run here: an opaque model's warmup is the
+        caller's synchronous job (the generation engine AOT-warms the
+        new version's decode/prefill ladders BEFORE calling this, so
+        the flip observes the PR 7 warm-before-flip contract); flip
+        hooks DO run on hot reload, which is what retires stale-version
+        executors, decode ladders and prefix activations."""
+        mv = _ModelVersion(None, payload, (),
+                           None if version is None else int(version))
+        version, was_reload, prev_latest = self._register(name, mv)
+        if was_reload:
+            self._run_flip_hooks(name, mv, prev_latest)
+        return version
+
     def get(self, name, version=None):
         """The requested (or latest) ``_ModelVersion``."""
         with self._lock:

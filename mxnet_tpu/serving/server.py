@@ -66,6 +66,7 @@ class ModelServer:
             shed_watermark=shed_watermark,
             default_timeout_ms=default_timeout_ms)
         self._pools = {}
+        self._generators = {}   # name -> GenerationEngine (ISSUE 16)
         self._lock = threading.Lock()
         self._shutdown = False
         # publish-time ladder warmup: the repository calls back BEFORE a
@@ -92,6 +93,60 @@ class ModelServer:
                 pool.close(drain=True)
         self._cache.evict_model((name,) if version is None
                                 else (name, int(version)))
+
+    # -- generation endpoints (ISSUE 16) ------------------------------------
+    def load_generator(self, name, model, warm=False, **engine_kw):
+        """Create (or hot-reload) a stateful generation endpoint.
+
+        First call builds a :class:`~.generation.GenerationEngine`
+        around ``model`` (a :class:`~.generation.GenerationModel`) and
+        registers the payload as an opaque repository version, so the
+        endpoint shows up in :meth:`ModelServer.stats`/``models()`` and
+        rides the same flip-hook plumbing as Symbol models.  A later
+        call with the same ``name`` is a hot reload: the engine builds
+        and AOT-warms the NEW version's decode/prefill ladders before
+        its served-version pointer flips (warm-before-flip — zero
+        post-flip compiles), then the repository flip hook retires the
+        stale version's executors, decode ladders and prefix-cache
+        activations through the executor cache's retire hooks."""
+        from .generation import GenerationEngine
+        with self._lock:
+            if self._shutdown:
+                from .batcher import ServingClosedError
+                raise ServingClosedError(self.name)
+            eng = self._generators.get(name)
+        if eng is None:
+            eng = GenerationEngine(model, name=f"{self.name}/{name}",
+                                   metrics=self.metrics, **engine_kw)
+            with self._lock:
+                self._generators[name] = eng
+            # a flipped generation version must retire its ladders and
+            # prefix activations exactly where stale executors retire
+            self._cache.add_retire_hook(
+                lambda m, keep, _eng=eng, _n=name:
+                    _eng.retire_stale(keep) if m == _n else None)
+            if warm:
+                eng.warm()
+            version = self.repository.register_opaque(name, model)
+        else:
+            version = eng.load(model, warm=True)  # warm-before-flip
+            self.repository.register_opaque(name, model, version=version)
+        return version
+
+    def generator(self, name):
+        """The live GenerationEngine behind ``name`` (KeyError when
+        ``name`` is not a generation endpoint)."""
+        with self._lock:
+            return self._generators[name]
+
+    def generate_async(self, model, prompt, **kw):
+        """Start one streaming generation session (see
+        GenerationEngine.start_session for admission semantics)."""
+        return self.generator(model).start_session(prompt, **kw)
+
+    def generate(self, model, prompt, timeout=None, **kw):
+        """Blocking convenience: the full generated token list."""
+        return self.generate_async(model, prompt, **kw).result(timeout)
 
     # -- the per-batch execution path ---------------------------------------
     def _runner_for(self, model):
@@ -176,6 +231,11 @@ class ModelServer:
         """Repository warm hook: compile the new version's full bucket
         ladder (planned from the measured histogram when enough traffic
         was observed) before it serves."""
+        if mv.symbol is None:
+            # opaque (generation) payload: the engine AOT-warms its
+            # decode/prefill ladders synchronously in load_generator,
+            # before the version registers — nothing to do here
+            return
         _compile.warm_version(self._cache, model, mv, self._ctx,
                               self._warm_max_batch(model))
 
@@ -202,7 +262,15 @@ class ModelServer:
 
         ``sample_signature``: iterable of (input_name, sample_shape,
         dtype_str) — defaults to the most common signature observed in
-        traffic.  Returns the list of warmed bucket sizes."""
+        traffic.  Returns the list of warmed bucket sizes.
+
+        A generation endpoint warms its OWN ladder family — the decode
+        step plus every prefill prompt bucket — through the same entry
+        point."""
+        with self._lock:
+            eng = self._generators.get(model)
+        if eng is not None:
+            return eng.warm(version=version)
         mv = self.repository.get(model, version=version)
         if sample_signature is not None:
             sample_signature = tuple(sorted(
@@ -275,8 +343,11 @@ class ModelServer:
         snap["models"] = self.repository.models()
         with self._lock:
             pools = dict(self._pools)
+            generators = dict(self._generators)
         snap["pools"] = {model: pool.stats()
                          for model, pool in pools.items()}
+        snap["generators"] = {name: eng.stats()
+                              for name, eng in generators.items()}
         return snap
 
     def shutdown(self, drain=True, timeout=30.0):
@@ -285,6 +356,9 @@ class ModelServer:
         with self._lock:
             self._shutdown = True
             pools = list(self._pools.values())
+            generators = list(self._generators.values())
+        for eng in generators:
+            eng.close(timeout=timeout)
         for pool in pools:
             pool.close(drain=drain, timeout=timeout)
 

@@ -19,6 +19,13 @@ CI entry point (``python -m mxnet_tpu.serving.smoke``), two phases:
    fails those requests with typed ``NonFiniteError`` (never served),
    bumps ``mxnet_numerics_serving_nonfinite_total``, and the pool's
    survivors keep answering healthy requests.
+4. **generation hot reload** (ISSUE 16) — ``server.load_generator`` a
+   tiny LM, AOT-warm the decode step + prefill ladder, stream N
+   concurrent sessions (more than the slot pool holds, so some shed
+   typed), hot-reload a new model version MID-STREAM, and assert:
+   zero non-shed drops, ZERO decode-step compiles after the flip
+   returns (warm-before-flip), and the KV slot pool + resource-ledger
+   page accounting back at exactly zero afterwards.
 
 Prints one JSON summary line; exit code 0 iff all contracts held.
 """
@@ -187,6 +194,94 @@ def autoscaling_hot_swap():
     return summary, failures
 
 
+def generation_hot_reload():
+    """Phase 4: stateful generation sessions across a mid-stream hot
+    reload — zero non-shed drops, zero post-flip decode compiles, KV
+    ledger provably zero after.  Returns (summary dict, failure list)."""
+    from mxnet_tpu import serving
+    from mxnet_tpu.serving import (RequestTimeoutError, ServingClosedError,
+                                   ServingOverloadError)
+    from mxnet_tpu.serving.generation import tiny_lm
+    from mxnet_tpu.telemetry.resources import LEDGER
+
+    failures = []
+    server = serving.ModelServer(num_replicas=1, name="gen-smoke")
+    server.load_generator("lm", tiny_lm(vocab=32, d_model=8, max_len=128,
+                                        seed=5),
+                          warm=True, slots=8, page_tokens=16,
+                          kv_budget_mb=8, prefix_cache_entries=8,
+                          max_len=128)
+    eng = server.generator("lm")
+    rng = np.random.RandomState(0)
+    shared = rng.randint(1, 31, size=24).astype(np.int32)  # prefix-reuse head
+    completed = [0]
+    shed = [0]
+    stop = threading.Event()
+
+    def client(i):
+        r = np.random.RandomState(100 + i)
+        sheds_in_a_row = 0
+        while not stop.is_set():
+            tail = r.randint(1, 31, size=r.randint(2, 8)).astype(np.int32)
+            prompt = np.concatenate([shared, tail]) if i % 2 else tail
+            try:
+                toks = server.generate("lm", prompt, timeout=30.0,
+                                       max_new_tokens=8)
+                if len(toks) != 8:
+                    failures.append(f"gen client {i}: {len(toks)} tokens")
+                completed[0] += 1
+                sheds_in_a_row = 0
+            except (ServingOverloadError, RequestTimeoutError,
+                    ServingClosedError):
+                shed[0] += 1   # typed admission shed: the contract allows it
+                sheds_in_a_row += 1
+                if sheds_in_a_row > 400:   # persistently full: give up
+                    return
+                time.sleep(0.005 * 2 ** min(sheds_in_a_row, 4)
+                           * (1.0 + 0.25 * r.rand()))
+            except Exception as e:  # noqa: BLE001 — contract violation
+                failures.append(f"gen client {i}: {type(e).__name__}: {e}")
+                return
+
+    clients = [threading.Thread(target=client, args=(i,)) for i in range(12)]
+    for t in clients:
+        t.start()
+    try:
+        time.sleep(0.6)   # v1 streams
+        flip_version = server.load_generator(
+            "lm", tiny_lm(vocab=32, d_model=8, max_len=128, seed=6))
+        compiles_at_flip = eng.stats()["decode_compiles"]
+        time.sleep(0.6)   # v2 streams, in-flight v1 sessions finish on it
+    finally:
+        stop.set()
+        for t in clients:
+            t.join(timeout=30)
+    post_flip_compiles = eng.stats()["decode_compiles"] - compiles_at_flip
+    stats = eng.stats()
+    server.shutdown()
+    if post_flip_compiles:
+        failures.append(f"{post_flip_compiles} decode-step compile(s) "
+                        "AFTER the generation hot reload — a session "
+                        "paid a cold compile mid-stream")
+    if completed[0] <= 0:
+        failures.append("no generation session completed at all")
+    if stats["version"] != flip_version:
+        failures.append(f"engine never flipped to v{flip_version}")
+    kv = stats["kv"]
+    ledger_kv = LEDGER.snapshot()["owners"].get(
+        f"generation/{eng.name}", {}).get("kv_pages", 0)
+    if kv["slots_in_use"] or kv["kv_bytes"] or ledger_kv:
+        failures.append(f"generation leaked KV state after shutdown: "
+                        f"{kv['slots_in_use']} slots, {kv['kv_bytes']} "
+                        f"bytes, ledger={ledger_kv} pages")
+    return {"completed": completed[0], "shed": shed[0],
+            "flipped_to": stats["version"],
+            "post_flip_decode_compiles": post_flip_compiles,
+            "max_active": stats["max_active"],
+            "prefix_cache": stats["prefix_cache"],
+            "kv": kv}, failures
+
+
 def main():
     import mxnet_tpu as mx
     from mxnet_tpu import gluon
@@ -271,6 +366,15 @@ def main():
                           f"{type(e).__name__}: {e}"]
     failures += guard_failures
 
+    # phase 4: stateful generation across a mid-stream hot reload
+    try:
+        gen_summary, gen_failures = generation_hot_reload()
+    except Exception as e:  # noqa: BLE001 — smoke must report, not crash
+        gen_summary = {"error": f"{type(e).__name__}: {e}"}
+        gen_failures = [f"generation phase crashed: "
+                        f"{type(e).__name__}: {e}"]
+    failures += gen_failures
+
     summary = {
         "smoke": "serving", "clients": N_CLIENTS, "answered": ok,
         "shed": shed, "failures": failures,
@@ -281,6 +385,7 @@ def main():
         "executor_cache": snap.get("executor_cache"),
         "pools": snap.get("pools"),
         "autoscaling": swap_summary,
+        "generation": gen_summary,
     }
     print(json.dumps(summary), flush=True)
     return 1 if failures else 0

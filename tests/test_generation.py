@@ -1,0 +1,434 @@
+"""ISSUE 16 — stateful generation: sessions, paged KV, prefix reuse.
+
+The acceptance pins:
+
+* a session's tokens are BITWISE identical batched vs unbatched
+  (greedy and seeded sampling), with >= 2 sessions genuinely sharing
+  a decode micro-batch;
+* ZERO decode-step compiles after warm (trace-time counter, not a
+  timing observation), and decode dispatches are SHARED across active
+  sessions (< 1 dispatch per token once batched);
+* KV slot-pool admission charges the resource ledger and provably
+  releases on every exit path (done / typed failure / close);
+* the prefix cache hits page-aligned shared heads, changes nothing
+  bitwise, and a version flip invalidates its stale activations;
+* per-session "generation" traces tile the session wall with named
+  stages;
+* a non-finite decode row fails THAT session typed while cohort
+  siblings keep streaming;
+* the chaos scenario: an engine killed mid-stream fails sessions
+  typed-retryable, siblings resume them, nothing leaks.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx  # noqa: F401  (pins the CPU backend via conftest)
+from mxnet_tpu.base import MXNetError, NonFiniteError
+from mxnet_tpu.serving import (CohortQueue, GenerationEngine,
+                               KVPoolExhaustedError, KVSlotPool,
+                               PrefixCache, ServingOverloadError,
+                               ServingWorkerError, tiny_lm)
+from mxnet_tpu.serving.kv_cache import pages_for
+from mxnet_tpu.telemetry import trace as mxtrace
+from mxnet_tpu.telemetry.resources import LEDGER
+
+VOCAB, DM, MAXLEN = 24, 8, 64
+
+
+def _engine(name, seed=2, prefix=0, slots=4, jit=True, **kw):
+    model = tiny_lm(vocab=VOCAB, d_model=DM, max_len=MAXLEN, seed=seed,
+                    jit=jit, **{k: kw.pop(k) for k in ("eos_id",
+                                                       "per_token_cost_s")
+                                if k in kw})
+    return GenerationEngine(model, name=name, slots=slots, page_tokens=8,
+                            kv_budget_mb=8, prefix_cache_entries=prefix,
+                            max_len=MAXLEN, **kw)
+
+
+def _prompts():
+    return [np.arange(1, 1 + n, dtype=np.int32) % (VOCAB - 1) + 1
+            for n in (5, 9, 13, 3)]
+
+
+def _run_unbatched(name, greedy, seed=2):
+    eng = _engine(name, seed=seed)
+    eng.warm()
+    try:
+        return [eng.generate(p, max_new_tokens=8, greedy=greedy,
+                             seed=7 + i)
+                for i, p in enumerate(_prompts())]
+    finally:
+        eng.close()
+
+
+def _run_batched(name, greedy, seed=2):
+    eng = _engine(name, seed=seed)
+    eng.warm()
+    try:
+        sessions = [eng.start_session(p, max_new_tokens=8, greedy=greedy,
+                                      seed=7 + i)
+                    for i, p in enumerate(_prompts())]
+        out = [s.result(timeout=60) for s in sessions]
+        return out, eng.stats()
+    finally:
+        eng.close()
+
+
+# -- bitwise identity ---------------------------------------------------------
+def test_batched_greedy_bitwise_identical_to_unbatched():
+    want = _run_unbatched("gen-u-g", greedy=True)
+    got, stats = _run_batched("gen-b-g", greedy=True)
+    assert got == want
+    # the identity must have been exercised BATCHED: sessions genuinely
+    # shared decode micro-batches, on shared dispatches
+    assert stats["max_active"] >= 2
+    assert stats["decode_steps"] < 4 * 8
+
+
+def test_batched_seeded_sampling_bitwise_identical_to_unbatched():
+    """Seeded host-side sampling is sensitive to every logits ulp, so
+    this pins bitwise row-independence of the packed decode step (the
+    padding-row scatter-drop included), not just argmax stability."""
+    want = _run_unbatched("gen-u-s", greedy=False)
+    got, stats = _run_batched("gen-b-s", greedy=False)
+    assert got == want
+    assert stats["max_active"] >= 2
+
+
+# -- compile discipline -------------------------------------------------------
+def test_zero_decode_compiles_after_warm():
+    eng = _engine("gen-compiles")
+    warmed = eng.warm()
+    try:
+        assert warmed  # the prefill prompt ladder compiled
+        s0 = eng.stats()
+        assert s0["decode_compiles"] == 1   # exactly the warm trace
+        sessions = [eng.start_session(p, max_new_tokens=8)
+                    for p in _prompts()]
+        for s in sessions:
+            s.result(timeout=60)
+        s1 = eng.stats()
+        assert s1["decode_compiles"] == s0["decode_compiles"]
+        assert s1["prefill_compiles"] == s0["prefill_compiles"]
+        assert s1["tokens_emitted"] == 4 * 8
+    finally:
+        eng.close()
+
+
+# -- slot pool + ledger -------------------------------------------------------
+def test_slot_pool_ledger_roundtrip_and_idempotent_release():
+    pool = KVSlotPool("generation/t-pool", slots=2, page_tokens=8,
+                      bytes_per_token=64, budget_bytes=1 << 20)
+    a = pool.acquire("s1", 16)
+    assert a.pages == pages_for(16, 8) == 2
+    owners = LEDGER.snapshot()["owners"]
+    assert owners["generation/t-pool"]["kv_pages"] == a.nbytes
+    b = pool.acquire("s2", 6)
+    with pytest.raises(KVPoolExhaustedError):
+        pool.acquire("s3", 6)
+    pool.release(a)
+    pool.release(a)   # idempotent: double release must not go negative
+    pool.release(b)
+    st = pool.stats()
+    assert st["slots_in_use"] == 0 and st["kv_bytes"] == 0
+    assert st["acquires"] == 2 and st["releases"] == 2 and st["sheds"] == 1
+    assert LEDGER.snapshot()["owners"]["generation/t-pool"]["kv_pages"] == 0
+
+
+def test_kv_budget_blow_sheds_typed():
+    pool = KVSlotPool("generation/t-budget", slots=8, page_tokens=8,
+                      bytes_per_token=64, budget_bytes=2 * 8 * 64)
+    pool.acquire("s1", 16)                             # exactly the budget
+    with pytest.raises(KVPoolExhaustedError) as e:
+        pool.acquire("s2", 2)
+    assert isinstance(e.value, ServingOverloadError)
+    assert isinstance(e.value, MXNetError)
+
+
+def test_engine_pool_full_sheds_typed_and_admission_validates():
+    eng = _engine("gen-full", slots=1, jit=False, per_token_cost_s=0.01)
+    try:
+        hog = eng.start_session(np.arange(1, 5, dtype=np.int32),
+                                max_new_tokens=16)
+        with pytest.raises(ServingOverloadError):
+            eng.start_session(np.array([1, 2], np.int32), max_new_tokens=4)
+        with pytest.raises(MXNetError):
+            eng.start_session(np.array([], np.int32))      # empty prompt
+        with pytest.raises(MXNetError):
+            eng.start_session(np.array([1], np.int32),
+                              max_new_tokens=MAXLEN + 1)   # arena overflow
+        assert len(hog.result(timeout=60)) == 16
+    finally:
+        eng.close()
+    st = eng.stats()
+    assert st["kv"]["slots_in_use"] == 0 and st["kv"]["kv_bytes"] == 0
+
+
+def test_sessions_release_ledger_to_zero():
+    eng = _engine("gen-ledger")
+    eng.warm()
+    try:
+        sessions = [eng.start_session(p, max_new_tokens=6)
+                    for p in _prompts()]
+        for s in sessions:
+            s.result(timeout=60)
+    finally:
+        eng.close()
+    owner = f"generation/{eng.name}"
+    assert LEDGER.snapshot()["owners"][owner]["kv_pages"] == 0
+    st = eng.stats()["kv"]
+    assert st["acquires"] == 4 and st["releases"] == 4
+
+
+# -- prefix cache -------------------------------------------------------------
+def test_prefix_cache_page_alignment_hit_and_miss():
+    cache = PrefixCache("generation/t-px", capacity=4, page_tokens=8)
+    prompt = np.arange(1, 20, dtype=np.int32)         # len 19
+    kv = {"k": np.ones((19, 4), np.float32)}
+    stored = cache.store("m", 1, prompt, kv)
+    assert stored == 16                                # page-aligned clip
+    hit_len, got = cache.lookup("m", 1, prompt)
+    assert hit_len == 16 and got["k"].shape[0] == 16
+    # a hit may never cover the WHOLE prompt: the last token must
+    # recompute so the session has first-sample logits
+    short = np.arange(1, 9, dtype=np.int32)            # len 8
+    cache.store("m", 1, short, {"k": np.ones((8, 4), np.float32)})
+    hl, _ = cache.lookup("m", 1, short)
+    assert hl == 0                                     # 8 == len, capped out
+    assert cache.lookup("m", 1, np.arange(50, 60, dtype=np.int32))[0] == 0
+    st = cache.stats()
+    assert st["hits"] == 1 and st["misses"] >= 1
+
+
+def test_prefix_cache_version_flip_invalidates():
+    cache = PrefixCache("generation/t-flip", capacity=4, page_tokens=8)
+    prompt = np.arange(1, 20, dtype=np.int32)
+    cache.store("m", 1, prompt, {"k": np.ones((19, 4), np.float32)})
+    cache.store("m", 2, prompt, {"k": np.ones((19, 4), np.float32)})
+    cache.store("other", 1, prompt, {"k": np.ones((19, 4), np.float32)})
+    cache.evict_stale_versions("m", keep_versions={2})
+    assert cache.lookup("m", 1, prompt)[0] == 0        # v1 gone
+    assert cache.lookup("m", 2, prompt)[0] == 16       # v2 kept
+    assert cache.lookup("other", 1, prompt)[0] == 16   # other model kept
+    assert LEDGER.snapshot()["owners"]["generation/t-flip"][
+        "prefix_cache"] > 0
+    cache.clear()
+    assert LEDGER.snapshot()["owners"]["generation/t-flip"][
+        "prefix_cache"] == 0
+
+
+def test_prefix_hit_is_bitwise_invisible():
+    shared = np.arange(1, 20, dtype=np.int32) % (VOCAB - 1) + 1
+    p1 = np.concatenate([shared, np.array([3, 4], np.int32)])
+    p2 = np.concatenate([shared, np.array([5, 6, 7], np.int32)])
+
+    eng = _engine("gen-px", seed=3, prefix=8)
+    eng.warm()
+    try:
+        a1 = eng.generate(p1, max_new_tokens=6)
+        a2 = eng.generate(p2, max_new_tokens=6)        # hits p1's head
+        st = eng.stats()["prefix_cache"]
+        assert st["hits"] >= 1
+    finally:
+        eng.close()
+
+    ref = _engine("gen-px-ref", seed=3, prefix=0)
+    ref.warm()
+    try:
+        assert a1 == ref.generate(p1, max_new_tokens=6)
+        assert a2 == ref.generate(p2, max_new_tokens=6)
+    finally:
+        ref.close()
+
+
+# -- cohort queue -------------------------------------------------------------
+def test_cohort_queue_anchors_oldest_and_joins_same_signature():
+    q = CohortQueue(lambda x: x[0], max_cohort=3)
+    for item in [(8, "a"), (16, "b"), (8, "c"), (8, "d"), (8, "e")]:
+        q.put(item)
+    cohort = q.take(timeout=0.0)
+    # anchor (8,"a") joins the later 8s, skipping the 16 — up to max
+    assert cohort == [(8, "a"), (8, "c"), (8, "d")]
+    assert q.take(timeout=0.0) == [(16, "b")]
+    assert q.take(timeout=0.0) == [(8, "e")]
+    assert q.take(timeout=0.0) == []
+    q.put((4, "f"))
+    assert q.drain() == [(4, "f")] and len(q) == 0
+
+
+# -- observability ------------------------------------------------------------
+def test_generation_trace_stages_tile_the_session():
+    mxtrace.enable()
+    mxtrace.reset_exemplars()
+    eng = _engine("gen-trace")
+    eng.warm()
+    try:
+        eng.generate(np.arange(1, 8, dtype=np.int32), max_new_tokens=6)
+        doc = mxtrace.exemplars()["generation"]["last"]
+    finally:
+        eng.close()
+        mxtrace.disable()
+        mxtrace.reset_exemplars()
+    stages = {s["stage"] for s in doc["stages"]}
+    assert {"admit", "prefill_wait", "prefill", "decode_wait",
+            "decode_step", "sample", "deliver"} <= stages
+    assert doc["coverage"] >= 0.8, doc
+
+
+def test_generation_metric_families_export():
+    from mxnet_tpu.telemetry import REGISTRY
+    eng = _engine("gen-metrics")
+    eng.warm()
+    try:
+        eng.generate(np.arange(1, 6, dtype=np.int32), max_new_tokens=4)
+        snap = REGISTRY.snapshot()
+        assert "gen-metrics" in snap["generation"]
+        dump = REGISTRY.prometheus_dump()
+        for fam in ("mxnet_generation_sessions_total",
+                    "mxnet_generation_tokens_total",
+                    "mxnet_generation_decode_steps_total",
+                    "mxnet_generation_decode_compiles",
+                    "mxnet_generation_kv_pages"):
+            assert fam in dump, fam
+    finally:
+        eng.close()
+
+
+def test_intertoken_reservoir_observed():
+    eng = _engine("gen-inter")
+    eng.warm()
+    try:
+        eng.generate(np.arange(1, 6, dtype=np.int32), max_new_tokens=6)
+        gaps = eng.metrics.drain_observations("intertoken_ms")
+        assert len(gaps) >= 5
+        assert all(g >= 0.0 for g in gaps)
+    finally:
+        eng.close()
+
+
+# -- output health ------------------------------------------------------------
+def test_nonfinite_decode_row_fails_typed_siblings_stream_on():
+    base = tiny_lm(vocab=VOCAB, d_model=DM, max_len=MAXLEN, seed=4,
+                   jit=False)
+    TRIG = VOCAB - 1
+    inner = base.decode_fn
+
+    def decode_nan(params, arena, tokens, pos):
+        logits, arena = inner(params, arena, tokens, pos)
+        logits = np.array(logits)
+        logits[np.asarray(tokens) == TRIG] = np.nan
+        return logits, arena
+
+    base.decode_fn = decode_nan
+    eng = GenerationEngine(base, name="gen-nan", slots=4, page_tokens=8,
+                           kv_budget_mb=8, prefix_cache_entries=0,
+                           max_len=MAXLEN)
+    try:
+        # victim's first decode feeds its last prompt token == TRIG
+        victim = eng.start_session(np.array([1, 2, TRIG], np.int32),
+                                   max_new_tokens=8)
+        sibling = eng.start_session(np.array([1, 2, 3], np.int32),
+                                    max_new_tokens=8)
+        with pytest.raises(NonFiniteError):
+            victim.result(timeout=60)
+        assert len(sibling.result(timeout=60)) == 8
+        st = eng.stats()
+        assert st["sessions_failed"] == 1
+    finally:
+        eng.close()
+    assert eng.stats()["kv"]["slots_in_use"] == 0   # victim's slot freed
+
+
+# -- hot reload / retire ------------------------------------------------------
+def test_executor_cache_retire_hook_fires_on_stale_eviction():
+    from mxnet_tpu.serving.executor_cache import ExecutorCache
+    cache = ExecutorCache(capacity=4)
+    seen = []
+    cache.add_retire_hook(lambda model, keep: seen.append((model,
+                                                           set(keep))))
+    cache.evict_stale_versions("m", keep_versions={2})
+    assert seen == [("m", {2})]
+
+
+def test_engine_hot_reload_zero_post_flip_decode_compiles():
+    eng = _engine("gen-flip", seed=2)
+    eng.warm()
+    try:
+        v1_out = eng.generate(np.arange(1, 8, dtype=np.int32),
+                              max_new_tokens=4)
+        v2 = eng.load(tiny_lm(vocab=VOCAB, d_model=DM, max_len=MAXLEN,
+                              seed=9))
+        compiles_at_flip = eng.stats()["decode_compiles"]
+        v2_out = eng.generate(np.arange(1, 8, dtype=np.int32),
+                              max_new_tokens=4)
+        st = eng.stats()
+        assert st["version"] == v2
+        assert st["decode_compiles"] == compiles_at_flip  # warmed pre-flip
+        assert v2_out != v1_out     # genuinely the new params
+        # retire keeps {prev, new}: one flip of headroom, nothing older
+        assert v2 in st["versions_resident"]
+        assert len(st["versions_resident"]) <= 2
+    finally:
+        eng.close()
+
+
+def test_server_load_generator_end_to_end():
+    from mxnet_tpu import serving
+    server = serving.ModelServer(num_replicas=1, name="gen-srv")
+    try:
+        v1 = server.load_generator(
+            "lm", tiny_lm(vocab=VOCAB, d_model=DM, max_len=MAXLEN, seed=2),
+            warm=True, slots=2, page_tokens=8, kv_budget_mb=8,
+            prefix_cache_entries=4, max_len=MAXLEN)
+        toks = server.generate("lm", np.arange(1, 6, dtype=np.int32),
+                               timeout=60, max_new_tokens=4)
+        assert len(toks) == 4
+        assert "lm" in server.repository.models()
+        v2 = server.load_generator(
+            "lm", tiny_lm(vocab=VOCAB, d_model=DM, max_len=MAXLEN, seed=9))
+        assert v2 > v1
+        assert server.generator("lm").stats()["version"] == v2
+        snap = server.stats()
+        assert snap["generators"]["lm"]["sessions_started"] == 1
+    finally:
+        server.shutdown()
+
+
+# -- failure fan-out ----------------------------------------------------------
+def test_loop_crash_fails_active_sessions_typed_retryable():
+    import mxnet_tpu.chaos as chaos
+    chaos.reset()
+    eng = _engine("gen-crash", jit=False, per_token_cost_s=0.005,
+                  loop_restarts=0)
+    try:
+        chaos.arm("serving/generation/decode", "raise", hits=2, count=1)
+        sess = eng.start_session(np.arange(1, 5, dtype=np.int32),
+                                 max_new_tokens=16)
+        with pytest.raises(ServingWorkerError) as e:
+            sess.result(timeout=60)
+        assert e.value.retryable
+        with pytest.raises(MXNetError):
+            eng.start_session(np.array([1], np.int32))  # failed fast
+    finally:
+        chaos.reset()
+        eng.close()
+    st = eng.stats()
+    assert st["kv"]["slots_in_use"] == 0                # nothing leaked
+
+
+@pytest.mark.slow
+def test_chaos_scenario_replica_kill_mid_generation():
+    from mxnet_tpu import chaos
+    from mxnet_tpu.chaos import harness
+    chaos.reset()
+    try:
+        r = harness.scenario_replica_kill_mid_generation(n_sessions=4,
+                                                         max_new=6)
+    finally:
+        chaos.reset()
+    assert r["ok"], r
+    assert r["hung"] == 0 and not r["non_typed_failures"]
+    assert r["zero_leak"], r["leaks"]
