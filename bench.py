@@ -1305,6 +1305,102 @@ def measure_graftlint():
     }}
 
 
+def measure_kernels():
+    """ISSUE-17 kernels-layer phases (BENCH_KERNELS), relay-proof:
+
+    * ``kernel_tuner_overhead_s`` — a cold measured tune of every
+      registered kernel on a bench shape (grid capped by
+      MXNET_KERNELS_TUNE_BUDGET) into a throwaway namespace, gated
+      under a fixed wall budget.  Every search must commit a ``tuned``
+      winner, and re-resolving every kernel afterwards must be pure
+      ladder work: ZERO new tune traces on the PR 7 ledger;
+    * ``kernel_device`` — tuned-vs-reference device latency ships
+      relay-ARMED: on a CPU backend it reports ``relay-dormant``
+      (interpreted Pallas measures the interpreter, not the kernel)
+      and the ratio gate arms itself the first run a TPU backend is
+      live.
+    """
+    import tempfile as _tf
+    import time as _t
+
+    import numpy as _np
+
+    import jax as _jax
+    from mxnet_tpu import kernels as _k
+    from mxnet_tpu.compile.ledger import LEDGER
+    from mxnet_tpu.kernels import autotune as _at
+
+    budget_s = 60.0
+    shapes = {"layernorm": (256, 128), "softmax_ce": (256, 64),
+              "attention": (2, 2, 64, 16)}
+    prev = {k: os.environ.get(k)
+            for k in ("MXNET_COMPILE_CACHE_DIR", "MXNET_KERNELS")}
+    os.environ["MXNET_COMPILE_CACHE_DIR"] = _tf.mkdtemp(
+        prefix="bench-kernels-")
+    os.environ["MXNET_KERNELS"] = "tuned"
+    try:
+        _k.reset_for_tests()
+        before = LEDGER.trace_count("kernels/tune")
+        t0 = _t.perf_counter()
+        winners = {}
+        for name, shape in shapes.items():
+            cfg, src = _k.tune(name, shape, _np.float32, repeats=1)
+            winners[name] = {"config": cfg, "source": src}
+        tune_s = _t.perf_counter() - t0
+        tunes = LEDGER.trace_count("kernels/tune") - before
+        for name, shape in shapes.items():
+            _k.get(name, shape, _np.float32)
+        retunes = LEDGER.trace_count("kernels/tune") - before - tunes
+        all_tuned = all(w["source"] == "tuned" for w in winners.values())
+
+        backend = _jax.default_backend()
+        if backend == "tpu":
+            spec = _k.get_spec("layernorm")
+            rng = _np.random.RandomState(7)
+            args, kwargs = spec.example_inputs(shapes["layernorm"],
+                                               _np.float32, rng)
+            cfg = winners["layernorm"]["config"]
+            tuned_ms = _at._measure(spec.make(dict(cfg)), args, kwargs, 20)
+            ref_ms = _at._measure(spec.reference, args, kwargs, 20)
+            device = {
+                "metric": "kernel_layernorm_speedup_vs_reference",
+                "value": round(ref_ms / max(tuned_ms, 1e-9), 3),
+                "unit": "x", "status": "relay-live", "backend": backend,
+                "tuned_ms": round(tuned_ms, 4),
+                "reference_ms": round(ref_ms, 4),
+                "gate_pass": bool(tuned_ms <= ref_ms * 1.1),
+            }
+        else:
+            device = {
+                "metric": "kernel_layernorm_speedup_vs_reference",
+                "value": 0.0, "unit": "x", "status": "relay-dormant",
+                "backend": backend,
+                "note": "armed; measures tuned-vs-reference dispatch "
+                        "latency once a TPU backend is live",
+                "gate_pass": True,
+            }
+        return {
+            "kernel_tuner": {
+                "metric": "kernel_tuner_overhead_s",
+                "value": round(tune_s, 2), "unit": "s",
+                "budget_s": budget_s,
+                "tunes": tunes, "retunes_on_reresolve": retunes,
+                "winners": winners,
+                "gate_pass": bool(tune_s < budget_s and tunes ==
+                                  len(shapes) and retunes == 0 and
+                                  all_tuned),
+            },
+            "kernel_device": device,
+        }
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        _k.reset_for_tests()
+
+
 def measure_numerics_overhead():
     """ISSUE-14 numerics-observatory overheads, two gates:
 
@@ -1777,6 +1873,22 @@ def main():
                 log(f"graftlint phase failed: {type(e).__name__}: {e}")
                 result["graftlint"] = {
                     "metric": "graftlint_full_tree_s",
+                    "error": f"{type(e).__name__}: {e}"}
+
+        if _cfg0.get("BENCH_KERNELS"):
+            try:
+                result.update(measure_kernels())
+                kt, kd = result["kernel_tuner"], result["kernel_device"]
+                log(f"[kernels] tuner {kt['value']}s for {kt['tunes']} "
+                    f"searches (budget {kt['budget_s']}s, "
+                    f"{kt['retunes_on_reresolve']} re-tunes on "
+                    f"re-resolve, "
+                    f"{'PASS' if kt['gate_pass'] else 'FAIL'}); device "
+                    f"latency {kd['status']}")
+            except Exception as e:
+                log(f"kernels phase failed: {type(e).__name__}: {e}")
+                result["kernel_tuner"] = {
+                    "metric": "kernel_tuner_overhead_s",
                     "error": f"{type(e).__name__}: {e}"}
 
         if _cfg0.get("BENCH_SERVE_SPIKE"):

@@ -96,6 +96,15 @@ echo "== compile smoke (persistent cache, ladder warmup, retrace ratchet) =="
 # the BucketPlanner must beat pow2 on a skewed histogram (docs/compile.md)
 JAX_PLATFORMS=cpu python -m mxnet_tpu.compile.smoke
 
+echo "== kernels smoke (gates, measured tune, persisted winners, salt flip) =="
+# every registered Pallas kernel must pass its interpreter-mode fwd+bwd
+# correctness gate vs its pure-XLA reference on a tiny grid; a measured
+# tune commits winners into the versioned namespace next to the compile
+# cache ladders; a SECOND process reloads them with zero re-tunes; a
+# salt flip falls back to heuristic defaults without touching the live
+# namespace; tune trace budgets hold on the ledger (docs/kernels.md)
+JAX_PLATFORMS=cpu python -m mxnet_tpu.kernels.smoke
+
 echo "== chaos smoke (failpoints, composed fault scenarios, self-healing) =="
 # the composed scenarios: kvstore worker kill/revive commits past
 # the kill, corrupt-checkpoint-under-reload serves the old version with

@@ -27,7 +27,7 @@ def __getattr__(name):
     import importlib
     if name in ("gluon", "optimizer", "metric", "initializer", "lr_scheduler",
                 "symbol", "sym", "io", "image", "kvstore", "profiler", "module", "mod",
-                "callback", "checkpoint", "monitor", "parallel", "serving", "telemetry",
+                "callback", "checkpoint", "kernels", "monitor", "parallel", "serving", "telemetry",
                 "test_utils", "visualization",
                 "executor", "runtime", "model", "recordio", "contrib", "amp", "config",
                 "operator", "subgraph", "attribute", "torch_bridge", "th", "rtc",

@@ -113,6 +113,12 @@ SITES = {
         "deserialization",
     "compile/ladder/load":
         "planner.load_ladder, before the persisted ladder file is read",
+    "kernels/tune":
+        "kernel autotuner: call hook fires before each candidate config "
+        "is gated+measured (raise aborts the search — partial results "
+        "discarded, lookup falls down the ladder); bytes hook fires on "
+        "the serialized winners json (corrupt exercises the "
+        "quarantine-on-load path)",
     "kvstore/client/rpc":
         "KVClient, before each RPC frame is sent (raise exercises the "
         "bounded-retry path; kill drops the worker mid-epoch)",

@@ -235,6 +235,20 @@ _register("MXNET_FIT_STAGE_NEXT", bool, True,
           "overlapping input feed with compute; 0 feeds batches "
           "synchronously at forward time")
 # -- fused kernels -----------------------------------------------------------
+_register("MXNET_KERNELS", str, "off",
+          "kernels subsystem mode: off (legacy per-op gates only), "
+          "reference (pure-XLA references, bitwise = off for op paths), "
+          "tuned (gated Pallas kernels at the best known config; "
+          "reference fallback on gate failure)")
+_register("MXNET_KERNELS_OVERRIDES", str, "",
+          "per-kernel mode overrides, e.g. "
+          "'layernorm=tuned,attention=off'; unlisted kernels follow "
+          "MXNET_KERNELS")
+_register("MXNET_KERNELS_TUNE_REPEATS", int, 3,
+          "autotuner: timed repeats per candidate config (best-of)")
+_register("MXNET_KERNELS_TUNE_BUDGET", int, 8,
+          "autotuner: max configs measured per (kernel, shape); 0 = "
+          "unlimited")
 _register("MXNET_FUSED_LAYERNORM", str, "auto",
           "fused Pallas LayerNorm: 1 forces on, 0 forces plain XLA, "
           "auto probes the exact tile config once and falls back on "
@@ -608,6 +622,10 @@ _register("BENCH_GENERATE_RATE", float, 0.0,
           "(sessions/s); 0 = auto-sized from the per-token host cost")
 _register("BENCH_GENERATE_TOKENS", int, 32,
           "bench.py generation phase: max_new_tokens per session")
+_register("BENCH_KERNELS", bool, True,
+          "bench.py: measure the kernel_tuner phases (tuner overhead "
+          "seconds + reference-vs-kernel CPU trace counts, relay-proof); "
+          "device kernel-latency phases ship relay-armed")
 _register("BENCH_DISPATCH", bool, True,
           "bench.py: measure fused-train-step dispatch phases on the CPU "
           "backend (resnet50_step_dispatches / train_step_ms_bs32); "

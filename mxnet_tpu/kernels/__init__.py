@@ -1,0 +1,218 @@
+"""mxnet_tpu.kernels — the single owner of hand-fused Pallas kernels.
+
+``kernels.get(name, shape, dtype)`` is the ONE lookup the rest of the
+tree uses.  It resolves the mode switch, walks the autotuner's lookup
+ladder (stats -> persisted -> heuristic default), enforces the
+correctness gate, and returns a callable :class:`BoundKernel` — or
+``None`` when the subsystem is off and the caller should keep its
+legacy path.
+
+Mode switch (``MXNET_KERNELS``, default ``off``):
+
+* ``off``       — subsystem disabled; ``get`` returns None.
+* ``reference`` — serve the pure-XLA reference implementations (bitwise
+                  identical to off for the op paths, by construction).
+* ``tuned``     — serve the gated Pallas kernel at the best known
+                  config; fall back to the reference (and count it) if
+                  the config fails its gate.
+
+``MXNET_KERNELS_OVERRIDES`` refines per kernel, e.g.
+``layernorm=tuned,attention=off``.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+
+from ..base import MXNetError
+from . import autotune  # noqa: F401  (re-export: kernels.autotune)
+from .registry import (KernelSpec, config_key, gate, gate_report,  # noqa: F401
+                       get_spec, list_kernels, register_kernel)
+from . import library  # noqa: F401  (registers the built-in specs)
+
+log = logging.getLogger("mxnet_tpu.kernels")
+
+MODES = ("off", "reference", "tuned")
+
+_lock = threading.Lock()
+_BOUND = {}          # (name, shape, dtype, mode-env) -> BoundKernel | None
+_SELECTED = {}       # (name, shape, dtype) -> selection record (collector)
+_FALLBACK_WARNED = set()
+_OVERRIDE_CACHE = {}
+
+
+def _parse_overrides(raw):
+    cached = _OVERRIDE_CACHE.get(raw)
+    if cached is not None:
+        return cached
+    out = {}
+    for part in raw.replace(";", ",").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise MXNetError(
+                f"MXNET_KERNELS_OVERRIDES entry {part!r} is not "
+                "'<kernel>=<mode>'")
+        name, _, m = part.partition("=")
+        name, m = name.strip(), m.strip().lower()
+        if m not in MODES:
+            raise MXNetError(
+                f"MXNET_KERNELS_OVERRIDES: unknown mode {m!r} for "
+                f"kernel {name!r}; expected one of {MODES}")
+        out[name] = m
+    _OVERRIDE_CACHE[raw] = out
+    return out
+
+
+def _mode_env():
+    from .. import config as _config
+    base = str(_config.get("MXNET_KERNELS")).strip().lower() or "off"
+    if base not in MODES:
+        raise MXNetError(
+            f"MXNET_KERNELS={base!r}: expected one of {MODES}")
+    return base, str(_config.get("MXNET_KERNELS_OVERRIDES")).strip()
+
+
+def mode(name=None):
+    """The effective mode — global, or for one kernel with overrides."""
+    base, overrides = _mode_env()
+    if name is None or not overrides:
+        return base
+    return _parse_overrides(overrides).get(name, base)
+
+
+class BoundKernel:
+    """A resolved kernel: implementation + the config/source that chose
+    it.  Calling it is a plain passthrough — no lookups, no metrics, no
+    host effects — so it is safe inside jit/scan/shard_map bodies."""
+
+    __slots__ = ("name", "fn", "config", "source")
+
+    def __init__(self, name, fn, config, source):
+        self.name = name
+        self.fn = fn
+        self.config = config
+        self.source = source
+
+    def __call__(self, *args, **kwargs):
+        return self.fn(*args, **kwargs)
+
+    def __repr__(self):
+        return (f"BoundKernel({self.name!r}, source={self.source!r}, "
+                f"config={self.config!r})")
+
+
+def _fallback(name, reason):
+    autotune._fallback_counter_inc(name, reason)
+    with _lock:
+        warned = (name, reason) in _FALLBACK_WARNED
+        _FALLBACK_WARNED.add((name, reason))
+    if not warned:
+        log.warning("kernel %r serving the reference implementation "
+                    "(%s)", name, reason)
+
+
+def get(name, shape, dtype):
+    """Resolve ``name`` for a concrete (shape, dtype) under the current
+    mode.  Returns a :class:`BoundKernel` or ``None`` (off).  Resolution
+    is cached per exact key; resolve OUTSIDE traced bodies when you can
+    (the serving engine resolves at model build), though trace-time
+    resolution is also safe — it is trace-time Python, like any other
+    static configuration.
+    """
+    import jax.numpy as jnp
+
+    m = mode(name)
+    if m == "off":
+        return None
+    shape = tuple(int(s) for s in shape)
+    dt = jnp.dtype(dtype).name
+    envkey = _mode_env()
+    key = (name, shape, dt, m, envkey[1])
+    with _lock:
+        if key in _BOUND:
+            return _BOUND[key]
+    spec = get_spec(name)
+    if m == "reference":
+        bound = BoundKernel(name, spec.reference, None, "reference")
+    else:
+        try:
+            cfg, source = autotune.lookup(name, shape, dtype)
+            if gate(name, cfg, shape, dtype):
+                bound = BoundKernel(name, spec.make(dict(cfg)), cfg, source)
+            else:
+                _fallback(name, "gate-failed")
+                bound = BoundKernel(name, spec.reference, None,
+                                    "fallback-reference")
+        except Exception as e:  # noqa: BLE001 — a broken lookup serves the reference, never a crash
+            _fallback(name, f"lookup-error:{type(e).__name__}")
+            bound = BoundKernel(name, spec.reference, None,
+                                "fallback-reference")
+    with _lock:
+        _BOUND[key] = bound
+        _SELECTED[(name, shape, dt)] = {
+            "kernel": name, "mode": m, "source": bound.source,
+            "config": bound.config, "shape": shape, "dtype": dt}
+    return bound
+
+
+def tune(name, shape, dtype, **kwargs):
+    """Explicit measured tune (see autotune.tune); invalidates the bound
+    cache so the next ``get`` serves the fresh winner."""
+    result = autotune.tune(name, shape, dtype, **kwargs)
+    with _lock:
+        _BOUND.clear()
+    return result
+
+
+def reset_for_tests():
+    """Full subsystem reset: bound cache, selections, gate cache, tuner."""
+    from .registry import reset_gate_cache
+    with _lock:
+        _BOUND.clear()
+        _SELECTED.clear()
+        _FALLBACK_WARNED.clear()
+    reset_gate_cache()
+    autotune.reset_for_tests()
+
+
+# -- telemetry collector ------------------------------------------------------
+def _collector_snapshot():
+    base, overrides = _mode_env()
+    with _lock:
+        selected = {f"{k[0]}|{'x'.join(map(str, k[1]))}|{k[2]}": dict(v)
+                    for k, v in _SELECTED.items()}
+    return {"mode": base, "overrides": overrides,
+            "registered": list_kernels(),
+            "tunes_performed": autotune.tunes_performed(),
+            "selected": selected}
+
+
+def _collector_samples():
+    with _lock:
+        records = list(_SELECTED.values())
+    out = []
+    for rec in records:
+        out.append((
+            "mxnet_kernel_selected_config", "gauge",
+            "active kernel selection per (kernel, shape, dtype); value 1, "
+            "identity in {kernel, shape, dtype, source, config}",
+            {"kernel": rec["kernel"], "shape":
+             "x".join(map(str, rec["shape"])), "dtype": rec["dtype"],
+             "source": rec["source"],
+             "config": config_key(rec["config"])},
+            1.0))
+    return out
+
+
+def _register_collector():
+    try:
+        from ..telemetry import REGISTRY
+        REGISTRY.register_collector("kernels", _collector_snapshot,
+                                    _collector_samples)
+    except Exception as e:  # noqa: BLE001 — observability must not break the kernels import
+        log.debug("kernels collector not registered: %s", e)
+
+
+_register_collector()

@@ -1,0 +1,294 @@
+"""Per-shape measured autotuner with persisted winners.
+
+TVM's lesson (PAPERS.md) applied at Pallas granularity: the best
+tile/block config is a property of the concrete (shape, dtype), and a
+measured search beats any fixed heuristic.  The tuner walks the spec's
+config grid, gates each candidate (an incorrect config is never timed,
+let alone selected), measures wall time with synchronized dispatches,
+and commits the winner.
+
+Winners persist under the SAME namespace policy as the PR 7 compile
+cache: ``<cache_root()>/kernels/<version_key()>.json`` — any jax /
+jaxlib / mxnet_tpu upgrade or ``MXNET_COMPILE_CACHE_SALT`` change
+renames the namespace, so a stale stack never reloads foreign winners;
+it just falls through the ladder.  Lookup order (the ladder):
+
+  1. stats      — winners measured by THIS process,
+  2. persisted  — winners reloaded from the namespace file,
+  3. default    — the spec's heuristic config (always gated like any
+                  other config before dispatch).
+
+A corrupt/torn winners file is quarantined (renamed ``<path>.corrupt``)
+with ONE warning and the ladder falls through — same doctrine as
+planner.load_ladder.  The ``kernels/tune`` failpoint arms both the
+mid-tune raise (partial measurements are discarded; nothing half-tuned
+is ever committed) and byte corruption of the persisted file.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+
+log = logging.getLogger("mxnet_tpu.kernels")
+
+_lock = threading.Lock()
+_winners = {}          # record key -> {"config", "ms", "source"}
+_persisted = None      # lazily loaded file payload ({} when absent/corrupt)
+_tunes = 0             # measured-search runs committed by THIS process
+_warned_corrupt = set()
+
+
+def record_key(name, shape, dtype):
+    import jax.numpy as jnp
+    dims = "x".join(str(int(s)) for s in shape)
+    return f"{name}|{dims}|{jnp.dtype(dtype).name}"
+
+
+def winners_path():
+    from ..compile.cache import cache_root, version_key
+    return os.path.join(cache_root(), "kernels", version_key() + ".json")
+
+
+# -- persistence --------------------------------------------------------------
+def _load():
+    """The persisted winners map for the CURRENT namespace (cached)."""
+    global _persisted
+    with _lock:
+        if _persisted is not None:
+            return _persisted
+    from ..compile.cache import version_key
+    path = winners_path()
+    loaded = {}
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+        if payload.get("version") == version_key():
+            for key, rec in payload.get("winners", {}).items():
+                loaded[str(key)] = {"config": dict(rec["config"]),
+                                    "ms": float(rec.get("ms", 0.0))}
+        # a version-field mismatch (hand-copied file) is simply not ours:
+        # fall through the ladder without quarantining a healthy file
+    except FileNotFoundError:
+        pass
+    except Exception as e:  # noqa: BLE001 — a torn winners file must never crash a lookup
+        with _lock:
+            warned = path in _warned_corrupt
+            _warned_corrupt.add(path)
+        if not warned:
+            log.warning(
+                "corrupt persisted kernel tunings %r (%s: %s); "
+                "quarantined — lookups fall back to heuristic defaults",
+                path, type(e).__name__, e)
+        try:
+            os.replace(path, path + ".corrupt")
+        except OSError:
+            pass  # already moved/removed by a concurrent loader
+    with _lock:
+        if _persisted is None:
+            _persisted = loaded
+        return _persisted
+
+
+def _save():
+    """Write stats + persisted winners for this namespace atomically."""
+    from ..chaos.failpoints import failpoint_bytes
+    from ..compile.cache import version_key
+    path = winners_path()
+    merged = dict(_load())
+    with _lock:
+        for key, rec in _winners.items():
+            merged[key] = {"config": rec["config"], "ms": rec["ms"]}
+    payload = {"version": version_key(), "winners": merged}
+    data = json.dumps(payload, indent=1, sort_keys=True).encode()
+    data = failpoint_bytes("kernels/tune", data)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + f".tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, path)
+    return path
+
+
+def stale_namespaces():
+    """Winner files under ``<cache_root()>/kernels`` whose namespace no
+    longer matches the running stack (prune candidates)."""
+    from ..compile.cache import cache_root, version_key
+    kdir = os.path.join(cache_root(), "kernels")
+    if not os.path.isdir(kdir):
+        return []
+    current = version_key() + ".json"
+    return sorted(f for f in os.listdir(kdir)
+                  if f.endswith(".json") and f != current)
+
+
+def prune_stale():
+    """Delete stale winner namespaces; returns the file names removed.
+    Same contract as compile.cache.prune_stale: explicit, never implicit."""
+    from ..compile.cache import cache_root
+    kdir = os.path.join(cache_root(), "kernels")
+    removed = []
+    for name in stale_namespaces():
+        try:
+            os.remove(os.path.join(kdir, name))
+            removed.append(name)
+        except OSError:
+            pass  # lost a race with another pruner; the goal state holds
+    return removed
+
+
+# -- the ladder ---------------------------------------------------------------
+def lookup(name, shape, dtype):
+    """(config, source) through stats -> persisted -> heuristic default."""
+    from .registry import get_spec
+    key = record_key(name, shape, dtype)
+    with _lock:
+        rec = _winners.get(key)
+    if rec is not None:
+        return dict(rec["config"]), rec["source"]
+    rec = _load().get(key)
+    if rec is not None:
+        with _lock:
+            _winners[key] = {"config": dict(rec["config"]),
+                             "ms": rec["ms"], "source": "persisted"}
+        return dict(rec["config"]), "persisted"
+    return dict(get_spec(name).default_config(shape, dtype)), "default"
+
+
+def tunes_performed():
+    with _lock:
+        return _tunes
+
+
+# -- measurement --------------------------------------------------------------
+def _measure(fn, args, kwargs, repeats):
+    """Best-of-``repeats`` wall ms for one synchronized dispatch.
+
+    Runs on an isolated thread so a tune reached mid-trace still times
+    concrete eager dispatches (see registry.run_host_isolated).
+    """
+    from .registry import run_host_isolated
+
+    def _timed():
+        import jax
+        out = fn(*args, **kwargs)      # compile outside the timed region
+        jax.block_until_ready(out)
+        best = float("inf")
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(*args, **kwargs))
+            best = min(best, time.perf_counter() - t0)
+        return best * 1e3
+
+    return run_host_isolated(_timed)
+
+
+def _tune_histogram():
+    from ..telemetry import REGISTRY
+    return REGISTRY.histogram(
+        "mxnet_kernel_tune_seconds",
+        "wall seconds per measured autotune search, by {kernel}")
+
+
+def tune(name, shape, dtype, configs=None, repeats=None, persist=True):
+    """Measured search over the config grid for one (shape, dtype).
+
+    Gates every candidate first (an incorrect config is never eligible,
+    tuned or not), measures the survivors, commits the winner to the
+    stats rung and — with ``persist`` — the namespace file.  Returns
+    ``(config, source)``.
+
+    Never crashes the caller: any failure mid-search (including an
+    armed ``kernels/tune`` raise) discards the partial measurements and
+    falls back down the lookup ladder, with the fallback counted for
+    the ``kernel_fallback`` alert.
+    """
+    global _tunes
+    from .. import config as _config
+    from ..compile.ledger import record_trace
+    from .registry import config_key, gate, get_spec
+
+    spec = get_spec(name)
+    key = record_key(name, shape, dtype)
+    if repeats is None:
+        repeats = max(1, _config.get("MXNET_KERNELS_TUNE_REPEATS"))
+    if configs is None:
+        configs = list(spec.config_space(shape, dtype))
+        budget = _config.get("MXNET_KERNELS_TUNE_BUDGET")
+        if budget > 0 and len(configs) > budget:
+            log.info("kernel %r tune grid capped at %d of %d configs "
+                     "(MXNET_KERNELS_TUNE_BUDGET)", name, budget,
+                     len(configs))
+            configs = configs[:budget]
+
+    t0 = time.perf_counter()
+    try:
+        from ..chaos.failpoints import failpoint
+        rng_inputs = None
+        measured = []   # partial results live HERE until the search completes
+        for cfg in configs:
+            failpoint("kernels/tune")
+            if not gate(name, cfg, shape, dtype):
+                continue
+            if rng_inputs is None:
+                import numpy as _np
+                rng_inputs = spec.example_inputs(shape, dtype,
+                                                 _np.random.RandomState(1))
+            args, kwargs = rng_inputs
+            ms = _measure(spec.make(dict(cfg)), args, kwargs, repeats)
+            measured.append((ms, cfg))
+            log.debug("kernel %r %s: %.3f ms", name, config_key(cfg), ms)
+        if not measured:
+            raise RuntimeError("no config survived the correctness gate")
+    except Exception as e:  # noqa: BLE001 — a failed search degrades to the heuristic, never to a crash
+        log.warning("kernel %r autotune aborted on shape=%s dtype=%s "
+                    "(%s: %s); partial results discarded, falling back "
+                    "down the lookup ladder", name, tuple(shape), dtype,
+                    type(e).__name__, e)
+        _fallback_counter_inc(name, "tune-aborted")
+        return lookup(name, shape, dtype)
+
+    ms, winner = min(measured, key=lambda t: t[0])
+    with _lock:
+        _winners[key] = {"config": dict(winner), "ms": ms,
+                         "source": "tuned"}
+        _tunes += 1
+    record_trace("kernels/tune", reason=name)
+    try:
+        _tune_histogram().observe(time.perf_counter() - t0,
+                                  labels={"kernel": name})
+    except Exception:  # graftlint: disable=swallowed-error -- tuner accounting must never fail a tune that succeeded
+        pass
+    if persist:
+        try:
+            _save()
+        except Exception as e:  # noqa: BLE001 — an unwritable cache degrades to per-process tuning
+            log.warning("could not persist kernel tunings (%s: %s); "
+                        "winners remain process-local",
+                        type(e).__name__, e)
+    return dict(winner), "tuned"
+
+
+def _fallback_counter_inc(name, reason):
+    try:
+        from ..telemetry import REGISTRY
+        REGISTRY.counter(
+            "mxnet_kernel_fallback_total",
+            "kernel lookups served by the reference implementation "
+            "instead of a tuned/default Pallas config, by "
+            "{kernel, reason}").inc(labels={"kernel": name,
+                                            "reason": reason})
+    except Exception:  # graftlint: disable=swallowed-error -- fallback accounting must never mask the fallback itself
+        pass
+
+
+def reset_for_tests():
+    """Forget stats, the loaded file, and the tune count (test isolation)."""
+    global _persisted, _tunes
+    with _lock:
+        _winners.clear()
+        _persisted = None
+        _tunes = 0
+        _warned_corrupt.clear()

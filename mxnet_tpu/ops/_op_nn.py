@@ -345,18 +345,23 @@ def _fused_ln_ok(n_rows, d, x_dtype, g_dtype, b_dtype):
 def _layer_norm(attrs, x, gamma, beta):
     axis = int(attrs.get("axis", -1))
     eps = float(attrs.get("eps", 1e-5))
-    # trailing-axis LN takes the fused Pallas kernel (one HBM read+write
-    # per element; pallas_norm.py) — the hot transformer configuration
-    if (axis in (-1, x.ndim - 1) and gamma.ndim == 1
-            and _fused_ln_ok(int(np.prod(x.shape[:-1])),
-                             x.shape[-1], x.dtype, gamma.dtype, beta.dtype)):
-        from .pallas_norm import fused_layer_norm
-        return fused_layer_norm(x, gamma, beta, eps=eps)
-    mean = jnp.mean(x, axis=axis, keepdims=True)
-    var = jnp.var(x, axis=axis, keepdims=True)
-    out = (x - mean) * lax.rsqrt(var + eps)
-    bshape = tuple(x.shape[i] if i == (axis % x.ndim) else 1 for i in range(x.ndim))
-    return out * gamma.reshape(bshape) + beta.reshape(bshape)
+    from .pallas_norm import plain_layer_norm
+    if axis in (-1, x.ndim - 1) and gamma.ndim == 1:
+        # the kernels subsystem owns the choice when opted in
+        # (MXNET_KERNELS=reference|tuned); off returns None and the
+        # legacy per-op gate below keeps its seed-era behavior
+        from .. import kernels as _kernels
+        kb = _kernels.get("layernorm", x.shape, x.dtype)
+        if kb is not None:
+            return kb(x, gamma, beta, eps)
+        # trailing-axis LN takes the fused Pallas kernel (one HBM
+        # read+write per element; pallas_norm.py) — the hot
+        # transformer configuration
+        if _fused_ln_ok(int(np.prod(x.shape[:-1])), x.shape[-1],
+                        x.dtype, gamma.dtype, beta.dtype):
+            from .pallas_norm import fused_layer_norm
+            return fused_layer_norm(x, gamma, beta, eps=eps)
+    return plain_layer_norm(x, gamma, beta, eps=eps, axis=axis)
 
 
 @register("GroupNorm", input_names=("data", "gamma", "beta"))
@@ -539,9 +544,16 @@ def _logistic_regression_output(attrs, data, label):
 @register("softmax_cross_entropy")
 def _softmax_cross_entropy(attrs, data, label):
     """Total softmax CE over the batch (reference loss_binary_op.cc:30).
-    Routes through the fused Pallas row kernel (pallas_softmax_ce.py,
-    gated by MXNET_FUSED_SOFTMAX_CE) — one HBM pass over the logits."""
+    The kernels subsystem (MXNET_KERNELS=reference|tuned) owns the
+    implementation when opted in; otherwise the legacy fused Pallas row
+    kernel (pallas_softmax_ce.py, gated by MXNET_FUSED_SOFTMAX_CE) —
+    one HBM pass over the logits either way."""
     from .pallas_softmax_ce import fused_softmax_ce
+    if data.ndim == 2 and data.shape[0] > 0:
+        from .. import kernels as _kernels
+        kb = _kernels.get("softmax_ce", data.shape, data.dtype)
+        if kb is not None:
+            return jnp.sum(kb(data, label))
     return jnp.sum(fused_softmax_ce(data, label))
 
 

@@ -180,6 +180,31 @@ def _use_interpret():
     return jax.default_backend() != "tpu"
 
 
+def _static_sm_scale(sm_scale, head_dim):
+    """Resolve the softmax scale to a static python float.
+
+    The scale parameterizes the kernel (a jit static argument), so a
+    traced value here is a contract violation — rejecting it with a
+    TypeError replaces the suppressed ``float(sm_scale)`` host escape
+    of the original kernel (a concretization that graftlint's
+    trace-host-escape rule rightly flagged)."""
+    if sm_scale is None:
+        return head_dim ** -0.5
+    if not isinstance(sm_scale, (int, float)):
+        raise TypeError(
+            "flash_attention: sm_scale must be a static python scalar "
+            f"(got {type(sm_scale).__name__}); it is baked into the "
+            "kernel grid, not traced")
+    return sm_scale
+
+
+def reference_attention(q, k, v, causal=False, sm_scale=None):
+    """Public plain-XLA attention with flash_attention's signature —
+    the kernel registry's reference implementation."""
+    return _reference_attention(q, k, v, causal,
+                                _static_sm_scale(sm_scale, q.shape[-1]))
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q, k, v, causal=False, sm_scale=None, block_q=128,
                     block_k=128):
@@ -187,11 +212,14 @@ def flash_attention(q, k, v, causal=False, sm_scale=None, block_q=128,
 
     q, k, v: (batch, heads, seq, head_dim).  sm_scale defaults to
     1/sqrt(head_dim).
+
+    ``sm_scale`` is a STATIC kernel parameter (baked into the pallas
+    grid function), so it must be a python scalar, never a traced
+    array — the old ``float(sm_scale)`` host conversion would silently
+    concretize a tracer inside jit/shard_map bodies.
     """
-    if sm_scale is None:
-        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
-    # graftlint: disable=trace-host-escape -- sm_scale is a static python-float hyperparameter by contract (pallas grid param), trace-time Python
-    out, _, _ = _flash_fwd(q, k, v, causal=causal, sm_scale=float(sm_scale),
+    sm_scale = _static_sm_scale(sm_scale, q.shape[-1])
+    out, _, _ = _flash_fwd(q, k, v, causal=causal, sm_scale=sm_scale,
                            block_q=block_q, block_k=block_k,
                            interpret=_use_interpret())
     return out
@@ -204,11 +232,10 @@ def _flash_fwd_rule(q, k, v, causal, sm_scale, block_q, block_k):
 
 def _flash_bwd_rule(causal, sm_scale, block_q, block_k, res, g):
     q, k, v = res
-    if sm_scale is None:
-        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    sm_scale = _static_sm_scale(sm_scale, q.shape[-1])
 
     def ref(q_, k_, v_):
-        return _reference_attention(q_, k_, v_, causal, float(sm_scale))
+        return _reference_attention(q_, k_, v_, causal, sm_scale)
 
     _, vjp = jax.vjp(ref, q, k, v)
     return vjp(g)

@@ -65,9 +65,9 @@ def _ln_fwd(x2, gamma, beta, *, eps, block_rows, interpret):
     return out, mean, rstd
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def _layer_norm(x2, gamma, beta, eps):
-    out, _m, _r = _ln_core(x2, gamma, beta, eps)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _layer_norm(x2, gamma, beta, eps, block_rows):
+    out, _m, _r = _ln_core(x2, gamma, beta, eps, block_rows)
     return out
 
 
@@ -78,18 +78,26 @@ def _pick_block_rows(n):
     return 1
 
 
-def _ln_core(x2, gamma, beta, eps):
+def _resolve_block_rows(n, block_rows):
+    # a tuned block size only applies when it tiles THIS n exactly (a
+    # shard_map body sees the shard-local row count, not the tuned one)
+    if block_rows and n % block_rows == 0:
+        return block_rows
+    return _pick_block_rows(n)
+
+
+def _ln_core(x2, gamma, beta, eps, block_rows=None):
     return _ln_fwd(x2, gamma, beta, eps=eps,
-                   block_rows=_pick_block_rows(x2.shape[0]),
+                   block_rows=_resolve_block_rows(x2.shape[0], block_rows),
                    interpret=_use_interpret())
 
 
-def _ln_vjp_fwd(x2, gamma, beta, eps):
-    out, mean, rstd = _ln_core(x2, gamma, beta, eps)
+def _ln_vjp_fwd(x2, gamma, beta, eps, block_rows):
+    out, mean, rstd = _ln_core(x2, gamma, beta, eps, block_rows)
     return out, (x2, gamma, beta, mean, rstd)
 
 
-def _ln_vjp_bwd(eps, res, ct):
+def _ln_vjp_bwd(eps, block_rows, res, ct):
     x2, gamma, beta, mean, rstd = res
     xf = x2.astype(jnp.float32)
     ctf = ct.astype(jnp.float32)
@@ -109,17 +117,34 @@ def _ln_vjp_bwd(eps, res, ct):
 _layer_norm.defvjp(_ln_vjp_fwd, _ln_vjp_bwd)
 
 
-def fused_layer_norm(x, gamma, beta, eps=1e-5, axis=-1):
+def fused_layer_norm(x, gamma, beta, eps=1e-5, axis=-1, block_rows=None):
     """Fused LayerNorm over the trailing axis (differentiable).
 
     x: any shape; normalization along ``axis`` (must be the last axis or
-    movable there). gamma/beta: (d,).
+    movable there). gamma/beta: (d,).  ``block_rows`` is the tunable row
+    tile (kernels autotuner config); None picks the built-in heuristic.
     """
     if axis not in (-1, x.ndim - 1):
         x = jnp.moveaxis(x, axis, -1)
     shape = x.shape
-    out = _layer_norm(x.reshape(-1, shape[-1]), gamma, beta, float(eps))
+    out = _layer_norm(x.reshape(-1, shape[-1]), gamma, beta, float(eps),
+                      block_rows)
     out = out.reshape(shape)
     if axis not in (-1, len(shape) - 1):
         out = jnp.moveaxis(out, -1, axis)
     return out
+
+
+def plain_layer_norm(x, gamma, beta, eps=1e-5, axis=-1):
+    """The pure-XLA LayerNorm the op path uses when the kernel is off —
+    and, verbatim, the kernel registry's reference implementation.  One
+    definition on purpose: ``MXNET_KERNELS=reference`` must be bitwise
+    identical to kernels-off, which only holds if both modes lower the
+    exact same jaxpr."""
+    from jax import lax
+    mean = jnp.mean(x, axis=axis, keepdims=True)
+    var = jnp.var(x, axis=axis, keepdims=True)
+    out = (x - mean) * lax.rsqrt(var + eps)
+    bshape = tuple(x.shape[i] if i == (axis % x.ndim) else 1
+                   for i in range(x.ndim))
+    return out * gamma.reshape(bshape) + beta.reshape(bshape)

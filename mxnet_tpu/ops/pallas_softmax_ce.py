@@ -78,24 +78,33 @@ def _smce_fwd(x2, labels, *, block_rows, interpret):
     return loss, prob
 
 
-@jax.custom_vjp
-def _softmax_ce(logits, labels):
-    loss, _prob = _smce_core(logits, labels)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _softmax_ce(logits, labels, block_rows):
+    loss, _prob = _smce_core(logits, labels, block_rows)
     return loss
 
 
-def _smce_core(logits, labels):
+def _resolve_block_rows(n, block_rows):
+    # a tuned block size only applies when it tiles THIS n exactly (a
+    # shard_map body sees the shard-local row count, not the tuned one)
+    if block_rows and n % block_rows == 0:
+        return block_rows
+    return _pick_block_rows(n)
+
+
+def _smce_core(logits, labels, block_rows=None):
     return _smce_fwd(logits, labels,
-                     block_rows=_pick_block_rows(logits.shape[0]),
+                     block_rows=_resolve_block_rows(logits.shape[0],
+                                                    block_rows),
                      interpret=_use_interpret())
 
 
-def _smce_vjp_fwd(logits, labels):
-    loss, prob = _smce_core(logits, labels)
+def _smce_vjp_fwd(logits, labels, block_rows):
+    loss, prob = _smce_core(logits, labels, block_rows)
     return loss, (prob, labels)
 
 
-def _smce_vjp_bwd(res, ct):
+def _smce_vjp_bwd(block_rows, res, ct):
     prob, labels = res
     lab = labels.astype(jnp.int32)
     onehot = jax.nn.one_hot(lab, prob.shape[-1], dtype=jnp.float32)
@@ -145,6 +154,26 @@ def fused_softmax_ce_available(n, d, dtype):
     return hit
 
 
+def softmax_ce_kernel(logits, labels, block_rows=None):
+    """The Pallas row kernel with an explicit (tunable) row tile — the
+    kernels-registry entry point.  No availability gate: the caller
+    (kernels.get / fused_softmax_ce) owns that decision."""
+    return _softmax_ce(logits, labels.astype(jnp.int32), block_rows)
+
+
+def plain_softmax_ce(logits, labels):
+    """Pure-XLA per-row softmax CE — the gated-off fallback and, verbatim,
+    the kernel registry's reference implementation (one definition so
+    ``MXNET_KERNELS=reference`` lowers the same jaxpr as kernels-off)."""
+    labels = labels.astype(jnp.int32)
+    d = logits.shape[-1]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    valid = (labels >= 0) & (labels < d)
+    picked = jnp.take_along_axis(
+        logp, jnp.clip(labels, 0, d - 1)[:, None], axis=-1)[:, 0]
+    return jnp.where(valid, -picked, 0.0)
+
+
 def fused_softmax_ce(logits, labels):
     """Per-row softmax cross-entropy loss, differentiable.
 
@@ -155,9 +184,5 @@ def fused_softmax_ce(logits, labels):
     if n == 0:
         return jnp.zeros((0,), jnp.float32)
     if fused_softmax_ce_available(n, d, logits.dtype):
-        return _softmax_ce(logits, labels)
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    valid = (labels >= 0) & (labels < d)
-    picked = jnp.take_along_axis(
-        logp, jnp.clip(labels, 0, d - 1)[:, None], axis=-1)[:, 0]
-    return jnp.where(valid, -picked, 0.0)
+        return _softmax_ce(logits, labels, None)
+    return plain_softmax_ce(logits, labels)

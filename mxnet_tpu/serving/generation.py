@@ -147,22 +147,37 @@ def tiny_lm(vocab=32, d_model=16, max_len=256, seed=0, jit=True,
     if jit:
         import jax.numpy as jnp
 
+        # resolve the optional fused prefill attention ONCE, at model
+        # build (host side): the traced prefill body below must contain
+        # zero lookups/metrics.  None (MXNET_KERNELS=off) keeps the
+        # einsum path.  Right-padded cohorts make the causal mask
+        # subsume the key-padding mask at every consumed query row, so
+        # the flash kernel is drop-in for the rows the engine reads.
+        from .. import kernels as _kernels
+        attn_kernel = _kernels.get("attention", (1, 1, max_len, d_model),
+                                   np.float32)
+        attn_scale = float(scale)   # static kernel param, host-resolved
+
         def prefill_fn(p, tokens, mask):
             L = tokens.shape[1]
             x = p["emb"][tokens] + p["pos"][:L][None, :, :]
             q = x @ p["wq"]
             k = x @ p["wk"]
             v = x @ p["wv"]
-            att = jnp.einsum("bid,bjd->bij", q, k) * scale
-            allowed = (jnp.arange(L)[None, :, None]
-                       >= jnp.arange(L)[None, None, :]) \
-                & (mask[:, None, :] > 0)
-            att = jnp.where(allowed, att, -jnp.inf)
-            att = att - att.max(axis=-1, keepdims=True)
-            w = jnp.exp(att)
-            w = jnp.where(allowed, w, 0.0)
-            w = w / w.sum(axis=-1, keepdims=True)
-            y = jnp.einsum("bij,bjd->bid", w, v)
+            if attn_kernel is not None:
+                y = attn_kernel(q[:, None], k[:, None], v[:, None],
+                                causal=True, sm_scale=attn_scale)[:, 0]
+            else:
+                att = jnp.einsum("bid,bjd->bij", q, k) * scale
+                allowed = (jnp.arange(L)[None, :, None]
+                           >= jnp.arange(L)[None, None, :]) \
+                    & (mask[:, None, :] > 0)
+                att = jnp.where(allowed, att, -jnp.inf)
+                att = att - att.max(axis=-1, keepdims=True)
+                w = jnp.exp(att)
+                w = jnp.where(allowed, w, 0.0)
+                w = w / w.sum(axis=-1, keepdims=True)
+                y = jnp.einsum("bij,bjd->bid", w, v)
             h = x + y @ p["wo"]
             return {"k": k, "v": v}, h @ p["w_out"]
 

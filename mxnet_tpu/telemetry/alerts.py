@@ -389,6 +389,15 @@ def default_rules():
                 "converging — divergence judged before it reaches "
                 "non-finite; tune the bound per model via "
                 "MXNET_ALERT_RULES"),
+        AlertRule(
+            "kernel_fallback", "mxnet_kernel_fallback_total",
+            kind="rate", op=">", value=0.0, window_s=60.0, for_s=0.0,
+            cooldown_s=120.0, severity="warn",
+            doc="a kernels-subsystem lookup served the reference "
+                "implementation instead of a Pallas config within the "
+                "last window — a correctness-gate failure or aborted "
+                "autotune (docs/kernels.md runbook); numerics stay "
+                "correct, the tuned speed is gone"),
     ]
 
 

@@ -608,3 +608,74 @@ def test_soak_short_window_quiet(tmp_path):
     assert abs(r["rss_slope_bytes_per_s"]) <= r["rss_slope_max"]
     assert r["watchdog_fires"] == 0
     assert r["alerts_scrape_ok"] and r["fleet_scrape_ok"]
+
+
+# -- kernels/tune failpoint (ISSUE 17) ---------------------------------------
+def test_kernels_tune_corrupt_winners_quarantined(tmp_path, monkeypatch,
+                                                  caplog):
+    """ISSUE 17 satellite: corrupt bytes injected into the persisted
+    winners file (the ``kernels/tune`` bytes hook in autotune._save) are
+    quarantined on the next load — ONE warning, ``.corrupt`` rename,
+    heuristic-default fallback — never a crash."""
+    import logging
+
+    from mxnet_tpu import kernels
+    from mxnet_tpu.kernels import autotune
+
+    monkeypatch.setenv("MXNET_COMPILE_CACHE_DIR", str(tmp_path))
+    kernels.reset_for_tests()
+    configs = [{"block_rows": 64}, {"block_rows": 16}]
+    # the call hook fires once per candidate config; land the single
+    # injection on the bytes hook in _save instead (hits is 1-based)
+    chaos.arm("kernels/tune", "corrupt", value="truncate",
+              hits=len(configs) + 1, count=1)
+    cfg, source = kernels.tune("layernorm", (64, 32), np.float32,
+                               configs=configs, repeats=1)
+    assert source == "tuned"  # the tune itself succeeded; the FILE is torn
+    assert _injections("kernels/tune", "corrupt") >= 1
+    path = autotune.winners_path()
+    assert os.path.exists(path)
+
+    kernels.reset_for_tests()  # a fresh process would hit the torn file
+    with caplog.at_level(logging.WARNING, logger="mxnet_tpu.kernels"):
+        cfg2, source2 = autotune.lookup("layernorm", (64, 32), np.float32)
+        autotune.lookup("layernorm", (64, 32), np.float32)
+    assert source2 == "default"
+    assert os.path.exists(path + ".corrupt") and not os.path.exists(path)
+    warns = [r for r in caplog.records
+             if "corrupt persisted kernel tunings" in r.getMessage()]
+    assert len(warns) == 1, "exactly one WARN for the torn winners file"
+
+
+def test_kernels_tune_raise_discards_partials(tmp_path, monkeypatch,
+                                              caplog):
+    """ISSUE 17 satellite: a raise mid-tune discards the partial
+    measurements (nothing half-tuned is committed or persisted), the
+    caller gets the ladder fallback instead of an exception, and the
+    correctness gate still guards the config served afterwards."""
+    import logging
+
+    from mxnet_tpu import kernels
+    from mxnet_tpu.kernels import autotune
+    from mxnet_tpu.kernels.registry import _GATE_CACHE
+
+    monkeypatch.setenv("MXNET_COMPILE_CACHE_DIR", str(tmp_path))
+    monkeypatch.setenv("MXNET_KERNELS", "tuned")
+    kernels.reset_for_tests()
+    chaos.arm("kernels/tune", "raise", count=1)
+    with caplog.at_level(logging.WARNING, logger="mxnet_tpu.kernels"):
+        cfg, source = kernels.tune("layernorm", (64, 32), np.float32,
+                                   configs=[{"block_rows": 64}],
+                                   repeats=1)
+    assert source == "default"           # ladder fallback, no crash
+    assert autotune.tunes_performed() == 0
+    assert not os.path.exists(autotune.winners_path())
+    assert _injections("kernels/tune", "raise") == 1
+    assert any("partial results discarded" in r.getMessage()
+               for r in caplog.records)
+
+    # the gate is still enforced on the fallback path: resolving the
+    # kernel afterwards gates the default config before serving it
+    kb = kernels.get("layernorm", (64, 32), np.float32)
+    assert kb is not None and kb.source == "default"
+    assert any(k[0] == "layernorm" and v for k, v in _GATE_CACHE.items())
