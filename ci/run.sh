@@ -18,8 +18,11 @@ echo "== graftlint (whole-program static analysis, baseline-gated) =="
 # phase 1 (lexical): lock-discipline / torn-write / host-sync /
 # tracer-leak / swallowed-error / env-knob-drift / raw-phase-timing /
 # naked-retry / unbounded-wait / per-param-collective /
-# metric-cardinality / leaked-thread; phase 2 (call-graph flow rules):
-# collective-divergence / lock-order-cycle / trace-host-escape.
+# metric-cardinality / leaked-thread; phase 1.5 lowers per-function
+# CFGs (exception edges, finally duplication) for the lifecycle
+# dataflow; phase 2 (call-graph flow rules): collective-divergence /
+# lock-order-cycle / trace-host-escape / resource-leak-on-raise /
+# double-release / release-under-wrong-lock.
 # Fails only on NEW violations (ci/graftlint_baseline.json holds
 # triaged pre-existing debt); --timings prints where lint time goes
 # and the whole run must fit the 15 s wall budget (the engine is a

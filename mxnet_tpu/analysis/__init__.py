@@ -1,12 +1,17 @@
 """graftlint — project-native static analysis for the mxnet_tpu codebase.
 
-A two-phase whole-program engine: phase 1 is a single-walk AST pass per
-file that runs the lexical rules AND builds per-function summaries
+A three-phase whole-program engine: phase 1 is a single-walk AST pass
+per file that runs the lexical rules AND builds per-function summaries
 (calls, locks, collectives, rank-dependent branches, host effects,
-traced-body registrations); phase 2 resolves a project-wide call graph
-over the summaries and runs the flow rules (collective-divergence,
-lock-order-cycle, trace-host-escape) over it.  See docs/lint.md for
-the rule catalog and ``tools/graftlint.py`` for the CLI.
+traced-body registrations); phase 1.5 lowers each function (lazily, on
+demand) to a statement-level CFG with explicit exception edges
+(``cfg.py``); phase 2 resolves a project-wide call graph over the
+summaries and runs the flow rules — collective-divergence,
+lock-order-cycle, trace-host-escape, and the path-sensitive lifecycle
+rules (resource-leak-on-raise, double-release,
+release-under-wrong-lock) that run a worklist dataflow over the CFG
+(``lifecycle.py``).  See docs/lint.md for the rule catalog and
+``tools/graftlint.py`` for the CLI.
 
 This package is deliberately stdlib-only: the CLI loads it without
 importing ``mxnet_tpu`` itself (no jax, no numpy), so linting stays
@@ -20,14 +25,19 @@ from .core import (Context, Finding, GraphRule, ProjectResult, Rule,
                    register_rule, render_json, render_text,
                    render_timings, write_baseline)
 from .summary import Program, SummaryCollector
+from .cfg import CFG, build_cfg
+from .lifecycle import LifecycleReport, lifecycle_report
+from .sarif import render_sarif
+from . import catalog
 from . import rules as _rules  # noqa: F401  — registers the rule classes
 
 __all__ = [
-    "Context", "Finding", "GraphRule", "Program", "ProjectResult",
-    "Rule", "SummaryCollector", "all_graph_rules", "all_rules",
-    "analyze_paths", "analyze_project", "analyze_source",
-    "analyze_sources", "diff_baseline", "fingerprint_counts",
+    "CFG", "Context", "Finding", "GraphRule", "LifecycleReport",
+    "Program", "ProjectResult", "Rule", "SummaryCollector",
+    "all_graph_rules", "all_rules", "analyze_paths", "analyze_project",
+    "analyze_source", "analyze_sources", "build_cfg", "catalog",
+    "diff_baseline", "fingerprint_counts", "lifecycle_report",
     "load_baseline", "make_graph_rules", "make_rules",
     "register_graph_rule", "register_rule", "render_json",
-    "render_text", "render_timings", "write_baseline",
+    "render_sarif", "render_text", "render_timings", "write_baseline",
 ]

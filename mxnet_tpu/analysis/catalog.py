@@ -1,0 +1,215 @@
+"""graftlint rule catalog — the single source of truth for the rich
+rule documentation shared by ``tools/graftlint.py --explain <rule>``
+and the ``docs/lint.md`` catalog section.
+
+Each entry carries the prose a triager needs at the moment a finding
+fires: what the rule proves, the origin bug that motivated it, one
+minimal example that flags, and one near-miss that deliberately stays
+silent.  ``render_entry`` produces the exact markdown block embedded
+in ``docs/lint.md`` (a drift-guard test in ``tests/test_graftlint.py``
+compares them byte-for-byte), and ``explain`` prints the same block on
+the CLI — docs and CLI cannot drift because they are the same string.
+
+Rules without an entry here fall back to their one-line registry
+``doc`` in ``--explain`` (the v2 rules keep their hand-written docs
+sections; new rules must add an entry)."""
+from __future__ import annotations
+
+from .core import all_graph_rules, all_rules
+
+
+class CatalogEntry:
+    __slots__ = ("rule", "title", "description", "origin", "example",
+                 "near_miss")
+
+    def __init__(self, rule, title, description, origin, example,
+                 near_miss):
+        self.rule = rule
+        self.title = title
+        self.description = description
+        self.origin = origin
+        self.example = example
+        self.near_miss = near_miss
+
+
+_ENTRIES = {}
+
+
+def _entry(**kw):
+    ent = CatalogEntry(**kw)
+    _ENTRIES[ent.rule] = ent
+    return ent
+
+
+_entry(
+    rule="resource-leak-on-raise",
+    title="acquired resource reaches the exceptional exit unreleased",
+    description=(
+        "The lifecycle dataflow (analysis/lifecycle.py) tracks every "
+        "protocol-table resource — KV-slot handles, trace spans, bare "
+        "`open()` files, `Thread` handles, keyed `LEDGER.add/release` "
+        "byte pairs, bare `lock.acquire()` outside `with`, chaos "
+        "failpoint arm/disarm — through the per-function CFG "
+        "(analysis/cfg.py), including the implicit exception edge out "
+        "of every call site.  The rule fires when SOME exception path "
+        "from after the acquire reaches the function's exceptional "
+        "exit with neither a release nor an ownership transfer "
+        "(return / yield / stored on an attribute / passed to a "
+        "callee) on that path.  Releases inside `finally` cover both "
+        "edges (the CFG inlines finally bodies per path); `with` "
+        "acquisitions are never tracked; the acquire statement's own "
+        "exception edge carries the pre-acquire state; unresolved "
+        "callees are open-world and silent."),
+    origin=(
+        "ISSUE 18 triage: `GenerationEngine.start_session` started "
+        "the session trace span, then ran `KVSlotPool.acquire` under "
+        "it — admission-control rejections (pool exhausted) left the "
+        "span unfinished, leaking a phantom in-flight session into "
+        "the tracer's active set on every shed request."),
+    example=(
+        "def serve(pool):\n"
+        "    slot = pool.acquire(\"s\", 4)\n"
+        "    risky()            # raises -> slot never released\n"
+        "    pool.release(slot)"),
+    near_miss=(
+        "def serve(pool):\n"
+        "    slot = pool.acquire(\"s\", 4)\n"
+        "    try:\n"
+        "        risky()\n"
+        "    finally:\n"
+        "        pool.release(slot)   # covers the exception edge"),
+)
+
+_entry(
+    rule="double-release",
+    title="every path into a release has already released",
+    description=(
+        "A must-analysis on the same lifecycle dataflow: the rule "
+        "fires at a release site only when the abstract state set "
+        "arriving there is non-empty and ALL-released — i.e. every "
+        "feasible path already released the resource, so the second "
+        "release is dead code or split ownership (two owners each "
+        "believing they hold the slot).  Guarded patterns stay "
+        "silent because a join that still carries an acquired or "
+        "unacquired branch is not all-released: `if f: f.close()` "
+        "after a conditional close, handler-release + finally-release "
+        "separated by the CFG's per-path finally duplication.  "
+        "Legitimately repeatable protocols (Thread.join, accumulative "
+        "keyed ledger pairs) are excluded."),
+    origin=(
+        "ISSUE 18 triage: `KVSlotPool.release` is idempotent by "
+        "design for chaos teardown, which silently absorbs what "
+        "should be an ownership crash — a path that releases the "
+        "same slot twice means two owners, and the pool's "
+        "idempotence hides it until page accounting drifts."),
+    example=(
+        "def teardown(pool, slot):\n"
+        "    pool.release(slot)\n"
+        "    pool.release(slot)   # every path already released"),
+    near_miss=(
+        "def teardown(pool, slot, dirty):\n"
+        "    if dirty:\n"
+        "        pool.release(slot)\n"
+        "    if dirty:            # join carries the unreleased branch\n"
+        "        return\n"
+        "    pool.release(slot)"),
+)
+
+_entry(
+    rule="release-under-wrong-lock",
+    title="paired acquire and release disagree on held locks",
+    description=(
+        "For every acquire/release pairing the lifecycle engine "
+        "proves inside one function, compare the held-lock sets the "
+        "PR 15 summaries recorded at the two call sites.  In a "
+        "threaded subsystem (same path gate as lock-order-cycle) a "
+        "mismatch means either the release takes locks the acquire "
+        "proved unnecessary (new deadlock surface against the "
+        "exporter/scrape path) or the acquire relied on a lock the "
+        "release doesn't honor (torn accounting).  Silent when both "
+        "sites are lock-free, when both run under the identical lock "
+        "(`with self._lock:` around both halves), and outside the "
+        "threaded prefixes."),
+    origin=(
+        "ISSUE 18 triage: `KVSlotPool` deliberately charges the "
+        "ledger AFTER dropping the pool lock (PR 16 — never call the "
+        "accounting layer under a pool lock, the exporter scrapes "
+        "it); a release path that slips `LEDGER.release` back under "
+        "the pool lock reintroduces the exact deadlock the design "
+        "dodged, visible only when a scrape lands mid-release."),
+    example=(
+        "# mxnet_tpu/serving/pool.py\n"
+        "def grab(self):\n"
+        "    h = self.pool.acquire(\"s\", 4)   # lock-free by design\n"
+        "    with self._lock:\n"
+        "        self.pool.release(h)        # now under _lock"),
+    near_miss=(
+        "# mxnet_tpu/serving/pool.py\n"
+        "def grab(self):\n"
+        "    with self._lock:\n"
+        "        h = self.pool.acquire(\"s\", 4)\n"
+        "        self.pool.release(h)        # same lock both sites"),
+)
+
+
+def entries():
+    """All catalog entries, by rule id."""
+    return dict(_ENTRIES)
+
+
+def get(rule_id):
+    return _ENTRIES.get(rule_id)
+
+
+def _registered(rule_id):
+    cls = all_rules().get(rule_id)
+    if cls is None:
+        cls = all_graph_rules().get(rule_id)
+    return cls
+
+
+def _severity_of(rule_id):
+    cls = _registered(rule_id)
+    return cls.severity if cls is not None else None
+
+
+def _doc_of(rule_id):
+    cls = _registered(rule_id)
+    return cls.doc if cls is not None else None
+
+
+def render_entry(rule_id):
+    """The markdown block for one rule — byte-identical to the block
+    embedded in docs/lint.md (drift-guard tested)."""
+    ent = _ENTRIES.get(rule_id)
+    sev = _severity_of(rule_id) or "warning"
+    if ent is None:
+        return None
+    return (
+        f"### `{ent.rule}` ({sev}) — {ent.title}\n"
+        f"\n"
+        f"**Origin:** {ent.origin}\n"
+        f"\n"
+        f"{ent.description}\n"
+        f"\n"
+        f"**Flags:**\n"
+        f"\n"
+        f"```python\n{ent.example}\n```\n"
+        f"\n"
+        f"**Stays silent (near-miss):**\n"
+        f"\n"
+        f"```python\n{ent.near_miss}\n```\n")
+
+
+def explain(rule_id):
+    """The --explain payload: the catalog block, or the registry
+    one-liner for rules without a rich entry; None for unknown ids."""
+    block = render_entry(rule_id)
+    if block is not None:
+        return block
+    doc = _doc_of(rule_id)
+    if doc is None:
+        return None
+    sev = _severity_of(rule_id)
+    return (f"### `{rule_id}` ({sev})\n\n{doc}\n\n"
+            "(no rich catalog entry — see docs/lint.md)\n")

@@ -1,0 +1,485 @@
+"""graftlint phase 1.5 — per-function control-flow graphs.
+
+The v2 summaries are path-*insensitive*: they record that a function
+calls ``release()`` but not that the release is skipped when the code
+between ``acquire()`` and ``release()`` raises.  This module builds the
+statement-level CFG the lifecycle dataflow (``analysis/lifecycle.py``)
+runs over:
+
+* one node per simple statement, plus one *header* node per compound
+  statement (the ``if``/``while`` test, the ``for`` iterable, the
+  ``with`` items) — bodies are lowered recursively;
+* three virtual nodes: ENTRY, EXIT (normal return) and RAISE (the
+  exceptional exit — "an exception escapes this function");
+* **implicit exception edges out of every call site**: any statement
+  whose header expressions contain a call (or ``raise``/``assert``/
+  ``await``/``yield``) gets an ``exception`` edge to the innermost
+  enclosing handler set, or to RAISE;
+* ``try/except/else/finally`` with real Python semantics: body
+  exceptions edge to every handler entry (and past them when no
+  handler is a catch-all), ``else`` runs outside the handler
+  protection, and exceptions raised *inside* a handler propagate
+  outward (never to a sibling handler);
+* ``finally`` bodies are **inlined by duplication** — one memoized
+  exception copy per ``try`` (all raisers share the same continuation:
+  propagate outward), one normal copy, and a fresh copy per
+  ``return``/``break``/``continue`` that crosses the ``finally`` — so
+  a release inside ``finally`` is seen on every path it actually runs
+  on, including the exceptional one;
+* ``break``/``continue`` route through every intervening ``finally``
+  to the loop exit / loop header; ``while``/``for`` ``else`` clauses
+  hang off the exhausted edge (a ``break`` bypasses them);
+* ``with`` is exception-transparent (the common case), except
+  ``contextlib.suppress(...)`` / ``pytest.raises(...)`` items, which
+  catch the body's exceptions and continue after the block.
+
+Node duplication is bounded: a function whose lowering exceeds
+``MAX_NODES`` gets a CFG marked ``capped`` and the lifecycle analysis
+skips it (missing-a-finding is acceptable; a wrong finding is not).
+
+Stdlib-``ast`` only, like the rest of the package.
+"""
+from __future__ import annotations
+
+import ast
+
+NORMAL = "normal"
+EXCEPTION = "exception"
+
+MAX_NODES = 4000
+
+_CATCHALL_NAMES = ("Exception", "BaseException")
+_SUPPRESSING_WITH_TAILS = ("suppress", "raises")
+
+
+def _tail(expr):
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return ""
+
+
+class Node:
+    """One CFG node.  ``stmt`` is the governing AST statement (or the
+    ``ast.excepthandler`` for handler entries; ``None`` for virtual
+    nodes); ``kind`` labels the role; ``succs`` is a list of
+    ``(node_index, edge_kind)`` with edge_kind ``normal``/``exception``."""
+
+    __slots__ = ("idx", "stmt", "kind", "succs")
+
+    def __init__(self, idx, stmt, kind):
+        self.idx = idx
+        self.stmt = stmt
+        self.kind = kind
+        self.succs = []
+
+    def add_succ(self, idx, kind=NORMAL):
+        edge = (idx, kind)
+        if edge not in self.succs:
+            self.succs.append(edge)
+
+    @property
+    def lineno(self):
+        return getattr(self.stmt, "lineno", 0)
+
+    def __repr__(self):
+        where = f"@{self.lineno}" if self.stmt is not None else ""
+        return f"Node({self.idx}, {self.kind}{where})"
+
+
+class CFG:
+    """The built graph: ``nodes[0]`` is ENTRY, ``nodes[cfg.exit]`` the
+    normal exit, ``nodes[cfg.raise_exit]`` the exceptional exit."""
+
+    __slots__ = ("nodes", "entry", "exit", "raise_exit", "capped")
+
+    def __init__(self):
+        self.nodes = []
+        self.entry = 0
+        self.exit = 0
+        self.raise_exit = 0
+        self.capped = False
+
+    # -- introspection helpers (tests, debugging) ----------------------------
+    def nodes_at(self, lineno):
+        return [n for n in self.nodes
+                if n.stmt is not None and n.lineno == lineno]
+
+    def edges(self, kind=None):
+        out = []
+        for n in self.nodes:
+            for idx, k in n.succs:
+                if kind is None or k == kind:
+                    out.append((n.idx, idx, k))
+        return out
+
+    def preds(self):
+        """{node idx: [(pred idx, edge kind)]}."""
+        pred = {n.idx: [] for n in self.nodes}
+        for n in self.nodes:
+            for idx, k in n.succs:
+                pred[idx].append((n.idx, k))
+        return pred
+
+
+# -- statement classification -------------------------------------------------
+def header_exprs(stmt):
+    """The expressions a statement's own CFG node evaluates (compound
+    statements contribute only their header — bodies are separate
+    nodes)."""
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [stmt.test]
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.iter, stmt.target]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return [item.context_expr for item in stmt.items]
+    if isinstance(stmt, ast.Return):
+        return [stmt.value] if stmt.value is not None else []
+    if isinstance(stmt, ast.Raise):
+        return [e for e in (stmt.exc, stmt.cause) if e is not None]
+    if isinstance(stmt, ast.Assert):
+        return [e for e in (stmt.test, stmt.msg) if e is not None]
+    if isinstance(stmt, ast.Expr):
+        return [stmt.value]
+    if isinstance(stmt, ast.Assign):
+        return [stmt.value] + list(stmt.targets)
+    if isinstance(stmt, ast.AugAssign):
+        return [stmt.value, stmt.target]
+    if isinstance(stmt, ast.AnnAssign):
+        return [stmt.value] if stmt.value is not None else []
+    if isinstance(stmt, ast.Delete):
+        return list(stmt.targets)
+    if isinstance(stmt, ast.Match):
+        return [stmt.subject]
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return list(stmt.decorator_list)
+    if isinstance(stmt, ast.ClassDef):
+        return list(stmt.decorator_list) + list(stmt.bases)
+    return []
+
+
+def _contains_call(exprs):
+    for expr in exprs:
+        stack = [expr]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.Call, ast.Await, ast.Yield,
+                                 ast.YieldFrom)):
+                return True
+            if isinstance(node, ast.Lambda):
+                continue            # body runs later, not here
+            stack.extend(ast.iter_child_nodes(node))
+    return False
+
+
+def can_raise(stmt):
+    """Whether this statement's own evaluation gets an implicit
+    exception edge.  Policy: explicit ``raise``/``assert`` always;
+    otherwise only statements whose header contains a call site —
+    attribute reads, arithmetic, subscripts stay edge-free (fewer
+    spurious paths keeps the lifecycle rules at zero false
+    positives)."""
+    if isinstance(stmt, (ast.Raise, ast.Assert)):
+        return True
+    return _contains_call(header_exprs(stmt))
+
+
+def _is_catchall(handler):
+    if handler.type is None:
+        return True
+    if isinstance(handler.type, ast.Tuple):
+        return any(_tail(e) in _CATCHALL_NAMES for e in handler.type.elts)
+    return _tail(handler.type) in _CATCHALL_NAMES
+
+
+def _is_suppressing_with(stmt):
+    for item in stmt.items:
+        expr = item.context_expr
+        if isinstance(expr, ast.Call) and \
+                _tail(expr.func) in _SUPPRESSING_WITH_TAILS:
+            return True
+    return False
+
+
+class _Capped(Exception):
+    pass
+
+
+# -- the builder --------------------------------------------------------------
+class _Builder:
+    """Lowers one function body.  ``frames`` is the exception-routing
+    stack, innermost last; entries are
+
+    * ``("handlers", [entry idx, ...], catchall)`` — an active
+      ``except`` clause set,
+    * ``("finally", finalbody stmts, memo dict)`` — a ``finally``
+      exceptions must run through (memo holds the shared exception
+      copy),
+    * ``("loop", info dict)`` — a loop for ``break``/``continue``
+      targeting (transparent to exception routing).
+    """
+
+    def __init__(self):
+        self.cfg = CFG()
+        self.frames = []
+
+    def build(self, func):
+        cfg = self.cfg
+        entry = self._new(None, "entry")
+        cfg.entry = entry.idx
+        exit_node = self._new(None, "exit")
+        cfg.exit = exit_node.idx
+        raise_node = self._new(None, "raise")
+        cfg.raise_exit = raise_node.idx
+        try:
+            exits = self._lower_block(func.body, [entry.idx])
+            for idx in exits:
+                self._edge(idx, cfg.exit)
+        except _Capped:
+            cfg.capped = True
+        return cfg
+
+    # -- plumbing ------------------------------------------------------------
+    def _new(self, stmt, kind):
+        if len(self.cfg.nodes) >= MAX_NODES:
+            raise _Capped
+        node = Node(len(self.cfg.nodes), stmt, kind)
+        self.cfg.nodes.append(node)
+        return node
+
+    def _edge(self, src, dst, kind=NORMAL):
+        self.cfg.nodes[src].add_succ(dst, kind)
+
+    def _exc_edges(self, src, frames=None):
+        """Route an exception raised at ``src`` per the frame stack:
+        into every live handler entry, through the memoized exception
+        copy of each intervening ``finally``, and finally to RAISE."""
+        if frames is None:
+            frames = self.frames
+        i = len(frames) - 1
+        while i >= 0:
+            tag = frames[i][0]
+            if tag == "handlers":
+                _tag, entries, catchall = frames[i]
+                for e in entries:
+                    self._edge(src, e, EXCEPTION)
+                if catchall:
+                    return
+            elif tag == "finally":
+                entry = self._finally_exc_copy(frames[i], frames[:i])
+                self._edge(src, entry, EXCEPTION)
+                return
+            i -= 1
+        self._edge(src, self.cfg.raise_exit, EXCEPTION)
+
+    def _finally_exc_copy(self, frame, outer_frames):
+        """The (memoized) exception copy of a ``finally`` body: runs
+        the body, then re-raises through the *outer* frames."""
+        memo = frame[2]
+        if "exc" not in memo:
+            anchor, exits = self._copy_finally(frame, outer_frames)
+            reraise = self._new(None, "reraise")
+            for idx in exits:
+                self._edge(idx, reraise.idx)
+            self._exc_edges(reraise.idx, outer_frames)
+            memo["exc"] = anchor
+        return memo["exc"]
+
+    def _copy_finally(self, frame, outer_frames):
+        """Lower one fresh copy of a finally body under the outer
+        frame stack; -> (anchor idx, normal-exit idxs)."""
+        saved = self.frames
+        self.frames = list(outer_frames)
+        try:
+            anchor = self._new(None, "finally")
+            exits = self._lower_block(frame[1], [anchor.idx])
+        finally:
+            self.frames = saved
+        return anchor.idx, exits
+
+    def _route_through_finallys(self, start_idxs, down_to=None):
+        """Chain ``start_idxs`` through a fresh copy of every finally
+        frame above ``down_to`` (a frame index; None = all the way
+        out), innermost first; -> the final exit idxs."""
+        ends = list(start_idxs)
+        i = len(self.frames) - 1
+        floor = -1 if down_to is None else down_to
+        while i > floor:
+            frame = self.frames[i]
+            if frame[0] == "finally":
+                anchor, exits = self._copy_finally(frame, self.frames[:i])
+                for idx in ends:
+                    self._edge(idx, anchor)
+                ends = exits
+            i -= 1
+        return ends
+
+    def _nearest_loop(self):
+        for i in range(len(self.frames) - 1, -1, -1):
+            if self.frames[i][0] == "loop":
+                return i, self.frames[i][1]
+        return None, None
+
+    # -- lowering ------------------------------------------------------------
+    def _lower_block(self, stmts, preds):
+        exits = list(preds)
+        for stmt in stmts:
+            exits = self._lower_stmt(stmt, exits)
+        return exits
+
+    def _simple(self, stmt, preds, kind="stmt"):
+        node = self._new(stmt, kind)
+        for p in preds:
+            self._edge(p, node.idx)
+        if can_raise(stmt):
+            self._exc_edges(node.idx)
+        return node
+
+    def _lower_stmt(self, stmt, preds):
+        if isinstance(stmt, ast.If):
+            test = self._simple(stmt, preds, "if")
+            body_exits = self._lower_block(stmt.body, [test.idx])
+            if stmt.orelse:
+                else_exits = self._lower_block(stmt.orelse, [test.idx])
+            else:
+                else_exits = [test.idx]
+            return body_exits + else_exits
+
+        if isinstance(stmt, ast.While):
+            test = self._simple(stmt, preds, "while")
+            always = isinstance(stmt.test, ast.Constant) and \
+                bool(stmt.test.value)
+            info = {"breaks": [], "header": test.idx}
+            self.frames.append(("loop", info))
+            body_exits = self._lower_block(stmt.body, [test.idx])
+            self.frames.pop()
+            for idx in body_exits:
+                self._edge(idx, test.idx)
+            exits = list(info["breaks"])
+            if not always:
+                if stmt.orelse:
+                    exits += self._lower_block(stmt.orelse, [test.idx])
+                else:
+                    exits.append(test.idx)
+            return exits
+
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            header = self._simple(stmt, preds, "for")
+            info = {"breaks": [], "header": header.idx}
+            self.frames.append(("loop", info))
+            body_exits = self._lower_block(stmt.body, [header.idx])
+            self.frames.pop()
+            for idx in body_exits:
+                self._edge(idx, header.idx)
+            exits = list(info["breaks"])
+            if stmt.orelse:
+                exits += self._lower_block(stmt.orelse, [header.idx])
+            else:
+                exits.append(header.idx)
+            return exits
+
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            header = self._simple(stmt, preds, "with")
+            if _is_suppressing_with(stmt):
+                join = self._new(None, "with-exit")
+                self.frames.append(("handlers", [join.idx], True))
+                body_exits = self._lower_block(stmt.body, [header.idx])
+                self.frames.pop()
+                for idx in body_exits:
+                    self._edge(idx, join.idx)
+                return [join.idx]
+            return self._lower_block(stmt.body, [header.idx])
+
+        if isinstance(stmt, ast.Try) or (hasattr(ast, "TryStar")
+                                         and isinstance(stmt, ast.TryStar)):
+            return self._lower_try(stmt, preds)
+
+        if isinstance(stmt, ast.Return):
+            node = self._simple(stmt, preds, "return")
+            ends = self._route_through_finallys([node.idx])
+            for idx in ends:
+                self._edge(idx, self.cfg.exit)
+            return []
+
+        if isinstance(stmt, ast.Raise):
+            node = self._new(stmt, "raise")
+            for p in preds:
+                self._edge(p, node.idx)
+            self._exc_edges(node.idx)
+            return []
+
+        if isinstance(stmt, ast.Break):
+            node = self._simple(stmt, preds, "break")
+            li, info = self._nearest_loop()
+            if info is None:          # malformed; treat as fallthrough
+                return [node.idx]
+            ends = self._route_through_finallys([node.idx], down_to=li)
+            info["breaks"].extend(ends)
+            return []
+
+        if isinstance(stmt, ast.Continue):
+            node = self._simple(stmt, preds, "continue")
+            li, info = self._nearest_loop()
+            if info is None:
+                return [node.idx]
+            ends = self._route_through_finallys([node.idx], down_to=li)
+            for idx in ends:
+                self._edge(idx, info["header"])
+            return []
+
+        if isinstance(stmt, ast.Match):
+            subject = self._simple(stmt, preds, "match")
+            exits = [subject.idx]     # no case may match
+            for case in stmt.cases:
+                exits += self._lower_block(case.body, [subject.idx])
+            return exits
+
+        # simple statements (incl. nested def/class: their bodies run
+        # later and belong to their own CFGs)
+        node = self._simple(stmt, preds)
+        return [node.idx]
+
+    def _lower_try(self, stmt, preds):
+        has_finally = bool(stmt.finalbody)
+        if has_finally:
+            fin_frame = ("finally", stmt.finalbody, {})
+            self.frames.append(fin_frame)
+
+        handler_entries = []
+        for handler in stmt.handlers:
+            handler_entries.append(self._new(handler, "except").idx)
+        catchall = any(_is_catchall(h) for h in stmt.handlers)
+
+        if stmt.handlers:
+            self.frames.append(("handlers", handler_entries, catchall))
+        body_exits = self._lower_block(stmt.body, preds)
+        if stmt.handlers:
+            self.frames.pop()
+
+        # else runs after normal body completion, OUTSIDE the handlers
+        if stmt.orelse:
+            else_exits = self._lower_block(stmt.orelse, body_exits)
+        else:
+            else_exits = body_exits
+
+        handler_exits = []
+        for handler, entry in zip(stmt.handlers, handler_entries):
+            handler_exits += self._lower_block(handler.body, [entry])
+
+        normal_in = else_exits + handler_exits
+        if has_finally:
+            self.frames.pop()
+            if not normal_in:         # every path returned/raised
+                return []
+            anchor, exits = self._copy_finally(fin_frame, self.frames)
+            for idx in normal_in:
+                self._edge(idx, anchor)
+            return exits
+        return normal_in
+
+
+def build_cfg(func):
+    """CFG for one ``ast.FunctionDef``/``AsyncFunctionDef``.  Returns
+    a :class:`CFG`; check ``.capped`` before trusting it."""
+    return _Builder().build(func)

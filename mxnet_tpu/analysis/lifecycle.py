@@ -1,0 +1,712 @@
+"""graftlint phase 2.5 — path-sensitive resource-lifecycle dataflow.
+
+The codebase runs on paired-resource protocols everywhere: ``LEDGER.add
+/release`` byte accounting, ``KVSlotPool`` acquire/release, trace-span
+``start``/``finish``, chaos failpoint ``arm``/``disarm``, manual
+``Lock.acquire``/``release`` outside ``with``, bare ``open``/``close``,
+``Thread.start``/``join``.  A release that is skipped when the code
+between acquire and release raises is an HBM/accounting leak the alert
+engine only sees *after* it happens in production.  This module proves
+the pairing at lint time: a worklist dataflow over the per-function CFG
+(``analysis/cfg.py``) tracks each resource through an abstract state
+lattice and reports where an acquired resource reaches the exceptional
+exit unreleased.
+
+**States** (per resource, per program point — a SET of tagged states,
+so a join keeps both sides):
+
+* ``U`` — not (yet) acquired on this path;
+* ``A(line)`` — acquired at ``line``, live;
+* ``R(line)`` — released at ``line``;
+* ``E(line)`` — escaped at ``line``: ownership transferred out.
+
+**Transfer rules** (the zero-false-positive discipline):
+
+* an *acquire* statement's own exception edge carries the PRE-state
+  (if ``pool.acquire()`` itself raises, nothing was acquired);
+* a *release* or *escape* statement's exception edge carries the
+  POST-state (if ``release()`` raises, we still credit the release —
+  claiming a leak there would be speculative);
+* *escape* is any of: the handle returned or yielded; stored into an
+  attribute/subscript; aliased to another name; or passed as an
+  argument to ANY call.  A callee whose summary provably releases its
+  parameter (directly or transitively over the resolved call graph)
+  classifies the escape as a *transfer*; an unresolved callee stays
+  open-world — **both are silent**, the classification is reported for
+  introspection only.  Reads stay benign: method calls *on* the handle
+  (``h.stage(...)``) and bare-name/truthiness tests (``if h:``,
+  ``assert h``) do not escape.
+
+**Protocols** come in two shapes:
+
+* *handle* protocols (``h = pool.acquire(...)`` … ``pool.release(h)``
+  / ``h.finish()``): the resource is a local name; escape analysis
+  applies.  Tracked only when the function also contains a matching
+  release — or, for protocols where a dangling resource is a real bug
+  even when handed off (kv slots, trace spans, files), an escape.
+* *keyed* protocols (``LEDGER.add(owner, kind, n)``): no handle to
+  track, so the pairing is textual — tracked only when ONE function
+  contains both the acquire and a release with the IDENTICAL key text
+  (for the ledger that includes the amount expression: charge-N /
+  release-N is a pairing, charge-new/release-evicted is accumulative
+  accounting and stays silent).
+
+``with``-item acquisitions are never tracked (the ``with`` releases).
+Functions whose CFG lowering exceeds the node cap are skipped.
+
+Three graph rules consume one memoized report per program:
+``resource-leak-on-raise``, ``double-release`` (must-analysis: flagged
+only when EVERY path into a release has already released), and
+``release-under-wrong-lock`` (held-set mismatch between the paired
+acquire/release sites, threaded subsystems only — the rule filters).
+"""
+from __future__ import annotations
+
+import ast
+from collections import deque
+
+from .cfg import EXCEPTION, build_cfg, header_exprs
+from .core import is_lockish_name
+
+# -- protocol table -----------------------------------------------------------
+#: handle protocols: methods ON the handle that release it
+HANDLE_RELEASE_METHODS = {
+    "kv-slot": ("release", "free"),
+    "trace-span": ("finish",),
+    "file": ("close",),
+    "thread": ("join",),
+}
+
+#: protocols where a second release on an already-released path is a
+#: definite bug (locks raise RuntimeError; slot/span/file double
+#: release is dead or confused code) — thread.join and the accumulative
+#: keyed protocols are legitimately repeatable
+DOUBLE_RELEASE_PROTOS = {"kv-slot", "trace-span", "file", "lock-manual"}
+
+#: handle protocols tracked even without a local release, when the
+#: handle escapes: an exception BEFORE the hand-off dangles a resource
+#: whose owner never existed (thread handles are excluded — a started
+#: thread without a local join is the leaked-thread rule's business)
+TRACK_ON_ESCAPE = {"kv-slot", "trace-span", "file"}
+
+#: keyed protocols eligible for the wrong-lock pairing check are
+#: everything except the locks themselves
+WRONG_LOCK_EXEMPT = {"lock-manual"}
+
+_CHAOS_PATHS = ("tests/", "mxnet_tpu/chaos/")
+
+_MAX_KEY = 60
+_FIXPOINT_ROUNDS = 4
+
+
+def _dotted(expr):
+    """Cheap dotted text for a receiver chain (``self._pool``,
+    ``_ledger()``); None when not name-shaped."""
+    parts = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        parts.append(expr.id)
+        return ".".join(reversed(parts))
+    if isinstance(expr, ast.Call):
+        inner = _dotted(expr.func)
+        return None if inner is None else inner + "()"
+    return None
+
+
+def _key_text(expr):
+    try:
+        text = ast.unparse(expr)
+    except (ValueError, RecursionError):
+        text = "<expr>"
+    return text
+
+
+def _root_name(expr):
+    while isinstance(expr, (ast.Attribute, ast.Subscript)):
+        expr = expr.value
+    return expr.id if isinstance(expr, ast.Name) else None
+
+
+class Event:
+    """One protocol event at one call site."""
+
+    __slots__ = ("op", "proto", "res", "lineno", "col", "esc_kind",
+                 "call_pos")
+
+    def __init__(self, op, proto, res, lineno, col, esc_kind=None,
+                 call_pos=None):
+        self.op = op              # acquire | release | escape
+        self.proto = proto
+        self.res = res            # "h:<name>" or "k:<proto>:<key>"
+        self.lineno = lineno
+        self.col = col            # call col_offset (held-set lookup)
+        self.esc_kind = esc_kind  # return/store/alias/arg/bare/...
+        self.call_pos = call_pos  # escape-to-arg: ((line, col), index)
+
+    def __repr__(self):
+        return f"Event({self.op}, {self.res}@{self.lineno})"
+
+
+# -- statement iteration ------------------------------------------------------
+_NESTED = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+           ast.Lambda)
+
+
+def iter_own_statements(func):
+    """Every statement executed by ``func``'s own frame (nested
+    def/class bodies run later and belong to their own summaries)."""
+    stack = list(reversed(func.body))
+    while stack:
+        stmt = stack.pop()
+        yield stmt
+        if isinstance(stmt, _NESTED):
+            continue
+        for field in ("body", "orelse", "finalbody"):
+            stack.extend(reversed(getattr(stmt, field, []) or []))
+        for handler in getattr(stmt, "handlers", []) or []:
+            stack.extend(reversed(handler.body))
+        for case in getattr(stmt, "cases", []) or []:
+            stack.extend(reversed(case.body))
+
+
+def _calls_in(exprs):
+    for expr in exprs:
+        if expr is None:
+            continue
+        stack = [expr]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, ast.Lambda):
+                continue
+            if isinstance(node, ast.Call):
+                yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+
+# -- event extraction ---------------------------------------------------------
+class _Extractor:
+    """One pass over a function's statements, in source (= execution)
+    order, producing events keyed by statement identity (finally
+    copies in the CFG share statement objects, so a release inside
+    ``finally`` is seen on every path the copy runs on)."""
+
+    def __init__(self, path):
+        self.path = path
+        self.events = {}          # id(stmt) -> [Event]
+        self.handles = {}         # local name -> proto
+        self.thread_decls = set()
+        self.keyed_seen = {}      # res -> {"acquire": n, "release": n}
+        self.consumed = set()     # (id(call), handle) release args
+
+    def run(self, func):
+        for stmt in iter_own_statements(func):
+            evs = self._statement_events(stmt)
+            if evs:
+                self.events[id(stmt)] = evs
+        return self
+
+    def _statement_events(self, stmt):
+        evs = []
+        in_with = isinstance(stmt, (ast.With, ast.AsyncWith))
+        # handle declaration (simple local assignment from an acquire)
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                isinstance(stmt.targets[0], ast.Name) and \
+                isinstance(stmt.value, ast.Call):
+            name = stmt.targets[0].id
+            proto = self._acquire_proto(stmt.value)
+            if proto == "thread":
+                self.thread_decls.add(name)
+                self.handles[name] = "thread"
+            elif proto is not None:
+                self.handles[name] = proto
+                evs.append(Event("acquire", proto, f"h:{name}",
+                                 stmt.value.lineno,
+                                 stmt.value.col_offset))
+        # keyed events + handle releases, in every header expression
+        for call in _calls_in(header_exprs(stmt)):
+            evs.extend(self._classify_call(call, stmt,
+                                           in_with_items=in_with))
+        # escapes of known handles
+        if self.handles:
+            evs.extend(self._escape_events(stmt))
+        evs.sort(key=lambda e: (e.lineno, e.col))
+        return evs
+
+    @staticmethod
+    def _acquire_proto(call):
+        func = call.func
+        if isinstance(func, ast.Name):
+            if func.id == "open":
+                return "file"
+            if func.id == "Thread":
+                return "thread"
+            return None
+        if isinstance(func, ast.Attribute):
+            if func.attr == "Thread":
+                return "thread"
+            recv = _dotted(func.value)
+            low = recv.lower() if recv else ""
+            if func.attr in ("acquire", "lease") and "pool" in low:
+                return "kv-slot"
+            if func.attr == "start" and "trace" in low:
+                return "trace-span"
+            if func.attr == "begin_span":
+                return "trace-span"
+        return None
+
+    def _classify_call(self, call, stmt, in_with_items):
+        func = call.func
+        evs = []
+        if isinstance(func, ast.Attribute):
+            recv = _dotted(func.value)
+            low = recv.lower() if recv else ""
+            tail = func.attr
+            # ledger bytes: keyed on (owner, kind, amount) text
+            if "ledger" in low and tail in ("add", "release") and \
+                    len(call.args) >= 3 and not in_with_items:
+                key = "|".join(_key_text(a) for a in call.args[:3])
+                evs.append(self._keyed(
+                    "acquire" if tail == "add" else "release",
+                    "ledger-bytes", key, call))
+                return evs
+            # chaos failpoints: keyed on the site argument
+            if tail in ("arm", "disarm") and call.args and \
+                    ("chaos" in low or "failpoint" in low):
+                evs.extend(self._chaos(tail, call))
+                return evs
+            # manual lock acquire/release OUTSIDE with: only the bare
+            # blocking Expr-statement form (`ok = l.acquire(False)` is
+            # value-dependent — near-miss)
+            if tail in ("acquire", "release") and not call.args and \
+                    not call.keywords and recv is not None and \
+                    "pool" not in low and \
+                    is_lockish_name(recv.rsplit(".", 1)[-1]) and \
+                    isinstance(stmt, ast.Expr) and stmt.value is call:
+                evs.append(self._keyed(tail, "lock-manual", recv, call))
+                return evs
+            # pool.release(h): handle released by argument
+            if tail in ("release", "free") and "pool" in low and \
+                    len(call.args) == 1 and \
+                    isinstance(call.args[0], ast.Name) and \
+                    call.args[0].id in self.handles:
+                name = call.args[0].id
+                self.consumed.add((id(call), name))
+                evs.append(Event("release", self.handles[name],
+                                 f"h:{name}", call.lineno,
+                                 call.col_offset))
+                return evs
+            # h.finish()/h.close()/h.release()/h.join(): method release
+            if isinstance(func.value, ast.Name) and \
+                    func.value.id in self.handles:
+                name = func.value.id
+                proto = self.handles[name]
+                if tail in HANDLE_RELEASE_METHODS.get(proto, ()):
+                    evs.append(Event("release", proto, f"h:{name}",
+                                     call.lineno, call.col_offset))
+                    return evs
+                if proto == "thread" and tail == "start":
+                    evs.append(Event("acquire", proto, f"h:{name}",
+                                     call.lineno, call.col_offset))
+                    return evs
+        elif isinstance(func, ast.Name):
+            if func.id in ("arm", "disarm") and call.args:
+                evs.extend(self._chaos(func.id, call))
+        return evs
+
+    def _chaos(self, tail, call):
+        if not (self.path.startswith(_CHAOS_PATHS)
+                or "/tests/" in self.path):
+            return []
+        if tail == "arm" and any(k.arg in ("count", "hits")
+                                 for k in call.keywords):
+            return []             # auto-expiring arm — self-limiting
+        key = _key_text(call.args[0])
+        op = "acquire" if tail == "arm" else "release"
+        return [self._keyed(op, "chaos-failpoint", key, call)]
+
+    def _keyed(self, op, proto, key, call):
+        res = f"k:{proto}:{key[:_MAX_KEY]}"
+        seen = self.keyed_seen.setdefault(res, {"acquire": 0,
+                                                "release": 0})
+        seen[op] += 1
+        return Event(op, proto, res, call.lineno, call.col_offset)
+
+    # -- escapes -------------------------------------------------------------
+    def _escape_events(self, stmt):
+        evs = []
+        kind = "store"
+        bare_ok = False
+        if isinstance(stmt, ast.Return):
+            kind = "return"
+        elif isinstance(stmt, (ast.If, ast.While, ast.Assert)):
+            bare_ok = True        # truthiness tests read, not move
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            kind = "with"
+        hits = {}
+        for expr in header_exprs(stmt):
+            if expr is not None:
+                self._scan(expr, hits, kind, bare_ok)
+        for name, (esc_kind, lineno, col, call_pos) in hits.items():
+            evs.append(Event("escape", self.handles[name], f"h:{name}",
+                             lineno, col, esc_kind=esc_kind,
+                             call_pos=call_pos))
+        return evs
+
+    def _scan(self, expr, hits, kind, bare_ok, call_pos=None):
+        if isinstance(expr, ast.Name):
+            if expr.id in self.handles and \
+                    isinstance(expr.ctx, ast.Load) and not bare_ok:
+                hits.setdefault(expr.id, (
+                    "arg" if call_pos else kind, expr.lineno,
+                    expr.col_offset, call_pos))
+            return
+        if isinstance(expr, ast.Lambda):
+            for sub in ast.walk(expr.body):
+                if isinstance(sub, ast.Name) and \
+                        sub.id in self.handles:
+                    hits.setdefault(sub.id, ("closure", sub.lineno,
+                                             sub.col_offset, None))
+            return
+        if isinstance(expr, ast.Call):
+            # receiver chains rooted at a handle are reads, not moves
+            func = expr.func
+            if isinstance(func, ast.Attribute):
+                root = _root_name(func)
+                if root is None or root not in self.handles:
+                    self._scan(func.value, hits, kind, False)
+            for j, arg in enumerate(expr.args):
+                if isinstance(arg, ast.Name) and \
+                        (id(expr), arg.id) in self.consumed:
+                    continue      # this occurrence IS the release
+                self._scan(arg, hits, kind, False,
+                           call_pos=((expr.lineno, expr.col_offset), j))
+            for kw in expr.keywords:
+                self._scan(kw.value, hits, kind, False,
+                           call_pos=((expr.lineno, expr.col_offset),
+                                     None))
+            return
+        if isinstance(expr, ast.Attribute):
+            root = _root_name(expr)
+            if root in self.handles and not bare_ok and \
+                    isinstance(expr.ctx, ast.Load):
+                # a field of the handle flowing into a value — treat
+                # as escape (conservative: silence over speculation)
+                hits.setdefault(root, ("field", expr.lineno,
+                                       expr.col_offset, call_pos))
+            return
+        for child in ast.iter_child_nodes(expr):
+            self._scan(child, hits, kind, bare_ok, call_pos=call_pos)
+
+
+# -- the dataflow -------------------------------------------------------------
+def _apply(events, state, res, exc):
+    for ev in events:
+        if ev.res != res:
+            continue
+        if ev.op == "acquire":
+            if not exc:           # acquire's exception edge = PRE-state
+                state = frozenset({("A", ev.lineno)})
+        elif ev.op == "release":
+            state = frozenset({("R", ev.lineno)})
+        elif ev.op == "escape":
+            state = frozenset({("E", ev.lineno)})
+    return state
+
+
+def _run_dataflow(cfg, events_by_node, res):
+    n = len(cfg.nodes)
+    IN = [None] * n
+    IN[cfg.entry] = frozenset({("U", 0)})
+    work = deque([cfg.entry])
+    while work:
+        i = work.popleft()
+        evs = events_by_node.get(i, ())
+        out_n = _apply(evs, IN[i], res, exc=False)
+        out_e = _apply(evs, IN[i], res, exc=True)
+        for j, kind in cfg.nodes[i].succs:
+            contrib = out_e if kind == EXCEPTION else out_n
+            if IN[j] is None:
+                IN[j] = contrib
+                work.append(j)
+            elif not contrib <= IN[j]:
+                IN[j] = IN[j] | contrib
+                work.append(j)
+    return IN
+
+
+def _blame_line(cfg, IN, events_by_node, res, acq_line):
+    """The source line whose exception edge first carries the leak out
+    (best-effort provenance for the finding message)."""
+    releasing = set()
+    for idx, evs in events_by_node.items():
+        if any(e.res == res and e.op in ("release", "escape")
+               for e in evs):
+            releasing.add(idx)
+    keep = {cfg.raise_exit}
+    preds = cfg.preds()
+    stack = [cfg.raise_exit]
+    while stack:
+        i = stack.pop()
+        for p, _kind in preds[i]:
+            if p in keep or p in releasing:
+                continue
+            keep.add(p)
+            stack.append(p)
+    best = None
+    for node in cfg.nodes:
+        if IN[node.idx] is None:
+            continue
+        exc_succs = [j for j, k in node.succs if k == EXCEPTION]
+        if not exc_succs or not any(j in keep for j in exc_succs):
+            continue
+        out_e = _apply(events_by_node.get(node.idx, ()), IN[node.idx],
+                       res, exc=True)
+        if ("A", acq_line) in out_e and node.lineno:
+            if best is None or node.lineno < best:
+                best = node.lineno
+    return best if best is not None else acq_line
+
+
+# -- per-function analysis ----------------------------------------------------
+class _Entry:
+    """One report entry (leak / double-release / pairing)."""
+
+    __slots__ = ("fs", "proto", "label", "lineno", "col", "detail")
+
+    def __init__(self, fs, proto, label, lineno, col, detail):
+        self.fs = fs
+        self.proto = proto
+        self.label = label        # handle name or keyed label
+        self.lineno = lineno
+        self.col = col
+        self.detail = detail      # per-kind payload dict
+
+
+class LifecycleReport:
+    __slots__ = ("leaks", "double_releases", "pairs", "escapes",
+                 "skipped_capped", "analyzed_functions")
+
+    def __init__(self):
+        self.leaks = []
+        self.double_releases = []
+        self.pairs = []           # acquire/release held-set pairings
+        self.escapes = []         # (fs, res, esc classification)
+        self.skipped_capped = []
+        self.analyzed_functions = 0
+
+
+def _tracked_resources(ex):
+    """{res: proto} for resources the dataflow should run on."""
+    tracked = {}
+    by_res = {}
+    for evs in ex.events.values():
+        for ev in evs:
+            by_res.setdefault(ev.res, []).append(ev)
+    for res, evs in by_res.items():
+        proto = evs[0].proto
+        has_acq = any(e.op == "acquire" for e in evs)
+        has_rel = any(e.op == "release" for e in evs)
+        has_esc = any(e.op == "escape" for e in evs)
+        if not has_acq:
+            continue
+        if res.startswith("k:"):
+            if has_rel:           # keyed: both halves, identical key
+                tracked[res] = proto
+        elif has_rel or (proto in TRACK_ON_ESCAPE and has_esc):
+            tracked[res] = proto
+    return tracked
+
+
+def _res_label(res):
+    if res.startswith("h:"):
+        return res[2:]
+    return res[2:]                # "proto:key"
+
+
+def _analyze_function(program, fs, report, releasing):
+    func = fs.ast_node
+    ex = _Extractor(fs.path).run(func)
+    if not ex.events:
+        return
+    tracked = _tracked_resources(ex)
+    _classify_escapes(program, fs, ex, report, releasing)
+    if not tracked:
+        return
+    cfg = build_cfg(func)
+    if cfg.capped:
+        report.skipped_capped.append(fs.id)
+        return
+    report.analyzed_functions += 1
+    events_by_node = {}
+    for node in cfg.nodes:
+        if node.stmt is not None and id(node.stmt) in ex.events:
+            events_by_node[node.idx] = ex.events[id(node.stmt)]
+    held_at = {(c.lineno, c.col): c.held for c in fs.calls}
+
+    for res, proto in sorted(tracked.items()):
+        IN = _run_dataflow(cfg, events_by_node, res)
+        label = _res_label(res)
+        all_evs = [e for evs in ex.events.values() for e in evs
+                   if e.res == res]
+        # leak-on-raise: acquired state reaches the exceptional exit
+        raise_in = IN[cfg.raise_exit]
+        if raise_in:
+            for tag, line in sorted(raise_in):
+                if tag != "A":
+                    continue
+                blame = _blame_line(cfg, IN, events_by_node, res, line)
+                report.leaks.append(_Entry(
+                    fs, proto, label, line, 0,
+                    {"blame_line": blame}))
+        # double release: must-analysis on every release node
+        seen_dr = set()
+        for node in cfg.nodes:
+            evs = events_by_node.get(node.idx, ())
+            rel = [e for e in evs if e.res == res and e.op == "release"]
+            if not rel or IN[node.idx] is None or not IN[node.idx]:
+                continue
+            if proto not in DOUBLE_RELEASE_PROTOS:
+                continue
+            if all(tag == "R" for tag, _ln in IN[node.idx]):
+                ev = rel[0]
+                if (res, ev.lineno) in seen_dr:
+                    continue
+                seen_dr.add((res, ev.lineno))
+                prior = min(ln for _t, ln in IN[node.idx])
+                report.double_releases.append(_Entry(
+                    fs, proto, label, ev.lineno, ev.col,
+                    {"prior_line": prior}))
+        # acquire/release held-set pairing (wrong-lock raw material)
+        acqs = [e for e in all_evs if e.op == "acquire"]
+        rels = [e for e in all_evs if e.op == "release"]
+        if acqs and rels and proto not in WRONG_LOCK_EXEMPT:
+            a = acqs[0]
+            a_held = held_at.get((a.lineno, a.col))
+            for r in rels:
+                r_held = held_at.get((r.lineno, r.col))
+                if a_held is None or r_held is None:
+                    continue
+                report.pairs.append(_Entry(
+                    fs, proto, label, r.lineno, r.col,
+                    {"acq_line": a.lineno, "acq_held": a_held,
+                     "rel_held": r_held}))
+
+
+def _classify_escapes(program, fs, ex, report, releasing):
+    """Label each escape (transfer / releasing-callee / open-world) —
+    introspection only, never findings."""
+    site = {(c.lineno, c.col): c for c in fs.calls}
+    for evs in ex.events.values():
+        for ev in evs:
+            if ev.op != "escape":
+                continue
+            label = ev.esc_kind or "escape"
+            if ev.esc_kind == "arg" and ev.call_pos is not None:
+                (line, col), j = ev.call_pos
+                call = site.get((line, col))
+                callee = call.callee if call is not None else None
+                if callee is None:
+                    label = "arg:open-world"
+                else:
+                    label = "arg:callee"
+                    if j is not None and _releases_param_at(
+                            program, releasing, callee, call.kind, j):
+                        label = "arg:transfer-release"
+            report.escapes.append((fs.id, ev.res, label, ev.lineno))
+
+
+# -- releasing-callee summaries ----------------------------------------------
+def _function_params(fs):
+    node = getattr(fs, "ast_node", None)
+    if node is None:
+        return None
+    args = node.args
+    return [a.arg for a in list(getattr(args, "posonlyargs", []))
+            + list(args.args)]
+
+
+def _releasing_params(program):
+    """fid -> set of parameter names the function provably releases
+    (directly, or by forwarding to a releasing callee — depth-limited
+    fixpoint over the resolved call graph)."""
+    released = {}
+    forwards = []
+    for fs in program.functions.values():
+        params = _function_params(fs)
+        if not params:
+            continue
+        pset = set(params)
+        for stmt in iter_own_statements(fs.ast_node):
+            for call in _calls_in(header_exprs(stmt)):
+                func = call.func
+                if isinstance(func, ast.Attribute):
+                    recv = _dotted(func.value)
+                    low = recv.lower() if recv else ""
+                    if func.attr in ("release", "free") and \
+                            "pool" in low and len(call.args) == 1:
+                        root = _root_name(call.args[0])
+                        if root in pset:
+                            released.setdefault(fs.id, set()).add(root)
+                    elif func.attr in ("close", "finish", "release",
+                                       "join") and \
+                            isinstance(func.value, ast.Name) and \
+                            func.value.id in pset:
+                        released.setdefault(fs.id, set()).add(
+                            func.value.id)
+                for j, arg in enumerate(call.args):
+                    if isinstance(arg, ast.Name) and arg.id in pset:
+                        forwards.append((fs.id, arg.id,
+                                         (call.lineno,
+                                          call.col_offset), j))
+    site = {}
+    for fs in program.functions.values():
+        for c in fs.calls:
+            if c.callee:
+                site[(fs.id, c.lineno, c.col)] = (c.callee, c.kind)
+    for _round in range(_FIXPOINT_ROUNDS):
+        changed = False
+        for fid, param, key, j in forwards:
+            ent = site.get((fid,) + key)
+            if ent is None:
+                continue
+            callee_id, kind = ent
+            if _releases_param_at(program, released, callee_id, kind,
+                                  j):
+                cur = released.setdefault(fid, set())
+                if param not in cur:
+                    cur.add(param)
+                    changed = True
+        if not changed:
+            break
+    return released
+
+
+def _releases_param_at(program, released, callee_id, call_kind, j):
+    rel = released.get(callee_id)
+    if not rel:
+        return False
+    callee = program.functions.get(callee_id)
+    params = _function_params(callee) if callee is not None else None
+    if not params:
+        return False
+    idx = j + (1 if params[0] == "self" and call_kind != "name" else 0)
+    return idx < len(params) and params[idx] in rel
+
+
+# -- the memoized program-level report ---------------------------------------
+def lifecycle_report(program):
+    """Compute (once per Program) the lifecycle findings raw material
+    shared by the three graph rules."""
+    cached = program.__dict__.get("_lifecycle_report")
+    if cached is not None:
+        return cached
+    report = LifecycleReport()
+    releasing = _releasing_params(program)
+    for fs in sorted(program.functions.values(), key=lambda f: f.id):
+        if getattr(fs, "ast_node", None) is None:
+            continue
+        _analyze_function(program, fs, report, releasing)
+    program.__dict__["_lifecycle_report"] = report
+    return report

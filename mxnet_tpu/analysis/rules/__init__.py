@@ -1,14 +1,17 @@
 """graftlint rule modules — importing this package registers every rule
 with the core registry (see ``core.register_rule`` /
 ``core.register_graph_rule``)."""
-from . import (collective_divergence, env_drift, host_sync,
-               leaked_thread, lock_discipline, lock_order_cycle,
-               metric_cardinality, naked_retry, per_param_collective,
-               phase_timing, swallowed_error, torn_write,
+from . import (collective_divergence, double_release, env_drift,
+               host_sync, leaked_thread, lock_discipline,
+               lock_order_cycle, metric_cardinality, naked_retry,
+               per_param_collective, phase_timing, release_wrong_lock,
+               resource_leak_on_raise, swallowed_error, torn_write,
                trace_host_escape, tracer_leak, unbounded_wait)
 
-__all__ = ["collective_divergence", "env_drift", "host_sync",
-           "leaked_thread", "lock_discipline", "lock_order_cycle",
-           "metric_cardinality", "naked_retry", "per_param_collective",
-           "phase_timing", "swallowed_error", "torn_write",
-           "trace_host_escape", "tracer_leak", "unbounded_wait"]
+__all__ = ["collective_divergence", "double_release", "env_drift",
+           "host_sync", "leaked_thread", "lock_discipline",
+           "lock_order_cycle", "metric_cardinality", "naked_retry",
+           "per_param_collective", "phase_timing",
+           "release_wrong_lock", "resource_leak_on_raise",
+           "swallowed_error", "torn_write", "trace_host_escape",
+           "tracer_leak", "unbounded_wait"]

@@ -1,0 +1,56 @@
+"""double-release — a release site every path into which has already
+released the same resource.
+
+Origin: ISSUE 18's triage.  ``KVSlotPool.release`` is idempotent BY
+DESIGN (chaos teardown calls it defensively), which hides the real
+bug class: a second ``release()`` on every path means either dead code
+(one of the two is never needed) or — worse — confused ownership where
+two owners each believe they hold the slot, and the idempotence
+silently absorbs what should have been a crash.  For manual
+``lock.release()`` the second call raises ``RuntimeError`` at runtime;
+for files a double ``close()`` is dead code that masks a missing
+release of something else.
+
+This is a MUST-analysis: the finding fires only when EVERY path
+reaching the release carries a released state (the dataflow state set
+at the release node is non-empty and all-R).  That is what keeps the
+common guarded patterns silent:
+
+* ``if f: f.close()`` after a conditional close — the join carries the
+  unreleased branch too, so the state set is not all-R;
+* release in an ``except`` handler plus release in ``finally`` when
+  the handler re-raises — the finally's exception copy sees R, but
+  the normal copy sees A (path-separated by the CFG's finally
+  duplication), and only per-copy all-R paths fire;
+* protocols that are legitimately repeatable — ``Thread.join`` and
+  the keyed accumulative protocols — are excluded from the check
+  entirely (``DOUBLE_RELEASE_PROTOS``).
+"""
+from __future__ import annotations
+
+from ..core import GraphRule, register_graph_rule
+from ..lifecycle import lifecycle_report
+
+
+@register_graph_rule
+class DoubleReleaseRule(GraphRule):
+    id = "double-release"
+    severity = "error"
+    doc = ("release site reached only by paths that already released "
+           "the same kv slot / trace span / file / manual lock "
+           "(must-analysis: every incoming path is post-release)")
+
+    def run(self, program):
+        findings = []
+        for entry in lifecycle_report(program).double_releases:
+            fs = entry.fs
+            findings.append(self.finding(
+                fs.path, entry.lineno, entry.col,
+                f"{entry.proto} resource '{entry.label}' is released "
+                f"again at line {entry.lineno} in {fs.qual}() — every "
+                f"path here already released it (first at line "
+                f"{entry.detail['prior_line']}); one of the two is "
+                "dead code or ownership is split between two owners",
+                symbol=f"{fs.qual}:{entry.proto}:{entry.label}:"
+                       f"L{entry.lineno}"))
+        return findings

@@ -1,0 +1,66 @@
+"""resource-leak-on-raise — an acquired resource reaches the
+exceptional exit of its function unreleased and untransferred.
+
+Origin: ISSUE 18's triage of ``GenerationEngine.start_session``
+(serving/generation.py).  The session trace span was started FIRST,
+then ``KVSlotPool.acquire`` ran under it — on an admission-control
+``RuntimeError`` (pool exhausted, queue full) the span was never
+finished: every shed session leaked an open span into the tracer's
+active set, and the ring buffer view showed phantom in-flight sessions
+forever.  The dynamic soak harness can only catch the KV-page variant
+of this AFTER it drains the pool in production-shaped traffic; the
+lifecycle dataflow proves it at lint time.
+
+The engine (``analysis/lifecycle.py``) runs a worklist dataflow over
+the per-function CFG (``analysis/cfg.py``) for every resource in the
+protocol table — KV-slot handles, trace spans, bare ``open()`` files,
+``Thread`` handles, keyed ``LEDGER.add``/``release`` byte pairs, bare
+``lock.acquire()`` outside ``with``, chaos failpoint arm/disarm.  A
+finding means: on SOME exception path from after the acquire to the
+function's exceptional exit there is neither a release nor an escape.
+
+Near-misses that stay silent (the zero-false-positive discipline):
+
+* release in a ``finally`` (the CFG inlines finally bodies on both the
+  normal and the exception edge — the release covers both);
+* acquisition via ``with`` (the context manager IS the release);
+* the handle escapes before the raising region: returned, yielded,
+  stored into an attribute, aliased, or passed to ANY callee —
+  resolved releasing callees are transfers, unresolved callees are
+  open-world, both silent;
+* the acquire statement itself raising (its exception edge carries the
+  pre-acquire state);
+* keyed protocols whose acquire/release key texts differ (accumulative
+  accounting like charge-new/release-evicted is not a pairing).
+"""
+from __future__ import annotations
+
+from ..core import GraphRule, register_graph_rule
+from ..lifecycle import lifecycle_report
+
+
+@register_graph_rule
+class ResourceLeakOnRaiseRule(GraphRule):
+    id = "resource-leak-on-raise"
+    severity = "error"
+    doc = ("acquired resource (kv slot / trace span / ledger bytes / "
+           "file / lock / failpoint / thread) reaches the function's "
+           "exceptional exit with no release or ownership transfer on "
+           "that path")
+
+    def run(self, program):
+        findings = []
+        for entry in lifecycle_report(program).leaks:
+            fs = entry.fs
+            blame = entry.detail.get("blame_line", entry.lineno)
+            via = "" if blame == entry.lineno else \
+                f" when line {blame} raises"
+            findings.append(self.finding(
+                fs.path, entry.lineno, entry.col,
+                f"{entry.proto} resource '{entry.label}' acquired at "
+                f"line {entry.lineno} in {fs.qual}() can reach the "
+                f"exceptional exit unreleased{via} — release it in a "
+                "finally/except, use with, or hand it off before the "
+                "raising region",
+                symbol=f"{fs.qual}:{entry.proto}:{entry.label}"))
+        return findings

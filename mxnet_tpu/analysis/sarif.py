@@ -1,0 +1,96 @@
+"""SARIF 2.1.0 output for graftlint findings.
+
+``tools/graftlint.py --sarif <path>`` writes one run in the static
+analysis results interchange format so CI can annotate PRs with any
+SARIF-aware viewer.  Design points:
+
+* every REGISTERED rule appears in ``tool.driver.rules`` (not just the
+  rules that fired) — viewers resolve ``ruleIndex`` against it, and a
+  clean run still documents what was checked.  Rich catalog entries
+  (``analysis/catalog.py``) supply ``fullDescription``; rules without
+  one fall back to their registry one-liner;
+* graftlint fingerprints (``rule|path|symbol`` — stable across line
+  drift) go into ``partialFingerprints`` under
+  ``graftlintFingerprint/v1`` so SARIF baselining matches the native
+  baseline mechanics;
+* severities map error→error, warning→warning, info→note;
+* artifact URIs are repo-relative (graftlint already normalizes to
+  forward slashes) with a ``uriBaseId`` of ``SRCROOT``.
+"""
+from __future__ import annotations
+
+from . import catalog
+from .core import all_graph_rules, all_rules
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/"
+                "sarif-spec/master/Schemata/sarif-schema-2.1.0.json")
+FINGERPRINT_KEY = "graftlintFingerprint/v1"
+
+_LEVEL = {"error": "error", "warning": "warning", "info": "note"}
+
+
+def _rule_descriptor(cls):
+    ent = catalog.get(cls.id)
+    desc = {
+        "id": cls.id,
+        "shortDescription": {"text": cls.doc},
+        "defaultConfiguration": {
+            "level": _LEVEL.get(cls.severity, "warning"),
+        },
+        "helpUri": "docs/lint.md",
+    }
+    if ent is not None:
+        desc["fullDescription"] = {"text": ent.description}
+        desc["help"] = {"markdown": catalog.render_entry(cls.id)}
+    return desc
+
+
+def render_sarif(findings, tool_version="3"):
+    """The SARIF 2.1.0 document (a plain dict — json.dump it)."""
+    rules = {}
+    rules.update(all_rules())
+    rules.update(all_graph_rules())
+    ordered = sorted(rules.values(), key=lambda c: c.id)
+    index = {cls.id: i for i, cls in enumerate(ordered)}
+
+    results = []
+    for f in findings:
+        result = {
+            "ruleId": f.rule,
+            "level": _LEVEL.get(f.severity, "warning"),
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": f.path,
+                        "uriBaseId": "SRCROOT",
+                    },
+                    "region": {
+                        "startLine": max(1, f.line),
+                        "startColumn": max(1, f.col + 1),
+                    },
+                },
+            }],
+            "partialFingerprints": {FINGERPRINT_KEY: f.fingerprint},
+        }
+        if f.rule in index:
+            result["ruleIndex"] = index[f.rule]
+        results.append(result)
+
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "graftlint",
+                    "version": tool_version,
+                    "informationUri": "docs/lint.md",
+                    "rules": [_rule_descriptor(c) for c in ordered],
+                },
+            },
+            "originalUriBaseIds": {"SRCROOT": {"uri": "file:///"}},
+            "results": results,
+        }],
+    }

@@ -208,7 +208,8 @@ class FunctionSummary:
     __slots__ = ("id", "module", "path", "qual", "name", "lineno",
                  "class_name", "parent", "children", "calls",
                  "collectives", "host_effects", "lock_acquires",
-                 "traced_regs", "is_traced_root", "rest_guard")
+                 "traced_regs", "is_traced_root", "rest_guard",
+                 "ast_node")
 
     def __init__(self, fid, module, path, qual, name, lineno,
                  class_name=None, parent=None):
@@ -228,6 +229,7 @@ class FunctionSummary:
         self.traced_regs = []
         self.is_traced_root = False   # @jit-style decorated
         self.rest_guard = None        # GuardInfo after guarded return
+        self.ast_node = None          # def node (lifecycle CFG input)
 
     def __repr__(self):
         return f"FunctionSummary({self.id})"
@@ -570,6 +572,7 @@ class SummaryCollector(Rule):
             node.lineno,
             class_name=cls.name if cls is not None else None,
             parent=parent.id if parent is not None else None)
+        summary.ast_node = node   # lifecycle builds its CFG lazily
         for dec in node.decorator_list:
             dtail = _tail(dec)
             if dtail in _TRACE_TRANSFORMS:

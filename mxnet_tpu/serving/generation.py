@@ -591,10 +591,20 @@ class GenerationEngine:
                 f"generation[{self.name}]: prompt token out of range "
                 f"[0, {model.vocab})")
         tr = _trace.start("generation", self.name)
-        with tr.stage("admit"):
-            slot = self.pool.acquire(
-                f"s{next(_session_seq)}",
-                prompt.size + int(max_new_tokens))
+        try:
+            with tr.stage("admit"):
+                slot = self.pool.acquire(
+                    f"s{next(_session_seq)}",
+                    prompt.size + int(max_new_tokens))
+        except BaseException as e:
+            # shed (pool exhausted / page budget): the trace still
+            # finishes, typed — rejected admissions are traceable too,
+            # and the span must not leak into the tracer's active set
+            try:
+                tr.event("rejected", error=type(e).__name__)
+            finally:
+                tr.finish(status="rejected")
+            raise
         sess = GenerationSession(self, prompt, max_new_tokens, greedy,
                                  seed, slot, version, tr)
         with self._lock:

@@ -326,9 +326,13 @@ class ModelServer:
                     dict(inputs), timeout_ms=timeout_ms, trace=tr)
         except BaseException as e:
             # refused synchronously (shed / closed / invalid): the
-            # trace still finishes, typed — sheds are traceable too
-            tr.event("rejected", error=type(e).__name__)
-            tr.finish(status="rejected")
+            # trace still finishes, typed — sheds are traceable too;
+            # finish under finally so even a failing event() cannot
+            # leak the span into the tracer's active set
+            try:
+                tr.event("rejected", error=type(e).__name__)
+            finally:
+                tr.finish(status="rejected")
             raise
 
     def predict(self, model, inputs, timeout_ms=None, wait_s=60.0):

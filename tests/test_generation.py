@@ -278,6 +278,38 @@ def test_generation_trace_stages_tile_the_session():
     assert doc["coverage"] >= 0.8, doc
 
 
+def test_shed_admission_finishes_trace_typed():
+    """Regression (graftlint resource-leak-on-raise): a pool-full shed
+    inside start_session used to leave the freshly-minted "generation"
+    span unfinished — every rejected admission leaked a phantom
+    in-flight session into the tracer's active set."""
+    mxtrace.enable()
+    mxtrace.reset_exemplars()
+    eng = _engine("gen-shed-trace", slots=1, jit=False,
+                  per_token_cost_s=0.01)
+    try:
+        hog = eng.start_session(np.arange(1, 5, dtype=np.int32),
+                                max_new_tokens=16)
+        with pytest.raises(ServingOverloadError):
+            eng.start_session(np.array([1, 2], np.int32),
+                              max_new_tokens=4)
+        # the rejected admission's trace FINISHED, typed (the hog's
+        # session is still decoding, so its trace cannot be here yet)
+        docs = mxtrace.exemplars().get("generation", {})
+        finished = list(docs.get("head", []))
+        if docs.get("last") is not None:
+            finished.append(docs["last"])
+        rejected = [d for d in finished if d["status"] == "rejected"]
+        assert rejected, f"shed admission left its span open: {docs}"
+        assert any(e["event"] == "rejected"
+                   for e in rejected[0]["events"])
+        assert len(hog.result(timeout=60)) == 16
+    finally:
+        eng.close()
+        mxtrace.disable()
+        mxtrace.reset_exemplars()
+
+
 def test_generation_metric_families_export():
     from mxnet_tpu.telemetry import REGISTRY
     eng = _engine("gen-metrics")

@@ -1596,7 +1596,8 @@ def test_cli_changed_only_filters_unchanged_files(tmp_path):
 def test_make_graph_rules_select_disable():
     assert {r.id for r in make_graph_rules()} == {
         "collective-divergence", "lock-order-cycle",
-        "trace-host-escape"}
+        "trace-host-escape", "resource-leak-on-raise",
+        "double-release", "release-under-wrong-lock"}
     only = make_graph_rules(select=["lock-order-cycle"])
     assert [r.id for r in only] == ["lock-order-cycle"]
     without = make_graph_rules(disable=["lock-order-cycle"])
@@ -1611,3 +1612,535 @@ def test_graph_findings_fingerprint_stable_across_line_drift():
     b = fingerprint_counts([f for f in graph_lint(shifted)
                             if f.rule == "collective-divergence"])
     assert a == b
+
+
+# -- v3 engine: per-function CFG (phase 1.5) ----------------------------------
+def _cfg_for(src):
+    import ast as _ast
+    from mxnet_tpu.analysis import build_cfg
+    mod = _ast.parse(textwrap.dedent(src))
+    return build_cfg(mod.body[0])
+
+
+def _lines_on_path_kind(cfg, kind):
+    """Source lines of edges of the given kind, as (src_line, dst_line)
+    pairs (virtual nodes show as 0)."""
+    return {(cfg.nodes[s].lineno or 0, cfg.nodes[d].lineno or 0)
+            for s, d, k in cfg.edges() if k == kind}
+
+
+def test_cfg_try_finally_duplicates_finally_per_path():
+    # the finally body must run on BOTH the normal and the exception
+    # edge — the CFG inlines a copy per path, so the release statement
+    # appears on >= 2 nodes
+    cfg = _cfg_for("""
+        def f(pool, slot):
+            try:
+                risky()
+            finally:
+                pool.release(slot)
+    """)
+    release_nodes = cfg.nodes_at(6)
+    assert len(release_nodes) >= 2, \
+        "finally body not duplicated per incoming path"
+    # the exception copy re-raises: some release node reaches the
+    # exceptional exit, some reaches the normal exit
+    def reaches(start, goal):
+        seen, stack = set(), [start]
+        while stack:
+            i = stack.pop()
+            if i == goal:
+                return True
+            for j, _k in cfg.nodes[i].succs:
+                if j not in seen:
+                    seen.add(j)
+                    stack.append(j)
+        return False
+    assert any(reaches(n.idx, cfg.exit) for n in release_nodes)
+    assert any(reaches(n.idx, cfg.raise_exit) for n in release_nodes)
+
+
+def test_cfg_raise_in_except_propagates_outward_not_to_sibling():
+    cfg = _cfg_for("""
+        def f():
+            try:
+                risky()
+            except ValueError:
+                raise RuntimeError("wrapped")
+            except KeyError:
+                cleanup()
+    """)
+    raise_nodes = cfg.nodes_at(6)
+    assert raise_nodes
+    sibling = {n.idx for n in cfg.nodes_at(7) + cfg.nodes_at(8)}
+    for node in raise_nodes:
+        succs = {j for j, _k in node.succs}
+        assert cfg.raise_exit in succs, \
+            "raise in except must reach the exceptional exit"
+        assert not (succs & sibling), \
+            "raise in except must NOT fall into a sibling handler"
+
+
+def test_cfg_while_else_runs_on_exhaustion_and_break_bypasses_it():
+    cfg = _cfg_for("""
+        def f(xs):
+            while xs.pop():
+                if found():
+                    break
+            else:
+                missed()
+            done()
+    """)
+    else_nodes = {n.idx for n in cfg.nodes_at(7)}
+    done_nodes = {n.idx for n in cfg.nodes_at(8)}
+    assert else_nodes and done_nodes
+    # the while test's false edge feeds the else
+    test_succs = {j for n in cfg.nodes_at(3) for j, k in n.succs
+                  if k == "normal"}
+    assert test_succs & else_nodes, "exhausted edge must run else"
+    # break jumps straight past the else
+    break_succs = {j for n in cfg.nodes_at(5) for j, k in n.succs}
+    assert break_succs & done_nodes, "break must bypass the else"
+    assert not (break_succs & else_nodes)
+
+
+def test_cfg_call_sites_get_exception_edges_and_caps_are_flagged():
+    cfg = _cfg_for("""
+        def f():
+            x = 1
+            y = g(x)
+            return y
+    """)
+    # plain assignment: no exception edge; call: exception edge
+    assert all(k != "exception"
+               for n in cfg.nodes_at(3) for _j, k in n.succs)
+    assert any(k == "exception"
+               for n in cfg.nodes_at(4) for _j, k in n.succs)
+    assert not cfg.capped
+
+
+# -- v3 engine: resource-leak-on-raise ----------------------------------------
+def _leaks(sources):
+    return [f for f in graph_lint(sources)
+            if f.rule == "resource-leak-on-raise"]
+
+
+def test_leak_on_raise_flags_call_between_acquire_and_release():
+    hits = _leaks({"mxnet_tpu/serving/a.py": textwrap.dedent("""
+        def serve(pool):
+            slot = pool.acquire("s", 4)
+            risky()
+            pool.release(slot)
+    """)})
+    assert len(hits) == 1
+    assert hits[0].severity == "error"
+    assert "slot" in hits[0].message and "when line 4" in hits[0].message
+
+
+def test_leak_on_raise_flags_wrapping_raise_in_except():
+    # the except swallows the original but raises a new error AFTER the
+    # acquire — the release below the try is skipped on that path
+    hits = _leaks({"mxnet_tpu/serving/a.py": textwrap.dedent("""
+        def serve(pool):
+            slot = pool.acquire("s", 4)
+            try:
+                risky()
+            except ValueError:
+                raise RuntimeError("wrapped")
+            pool.release(slot)
+    """)})
+    assert len(hits) == 1
+
+
+def test_leak_on_raise_flags_keyed_ledger_pairing():
+    hits = _leaks({"mxnet_tpu/serving/a.py": textwrap.dedent("""
+        class Cache:
+            def charge(self, nbytes):
+                LEDGER.add(self.owner, "pages", nbytes)
+                rebuild()
+                LEDGER.release(self.owner, "pages", nbytes)
+    """)})
+    assert len(hits) == 1
+    assert "ledger-bytes" in hits[0].message
+
+
+def test_leak_on_raise_flags_manual_lock_and_trace_span():
+    hits = _leaks({"mxnet_tpu/serving/a.py": textwrap.dedent("""
+        class C:
+            def bump(self):
+                self._lock.acquire()
+                self.n = recompute()
+                self._lock.release()
+
+            def trace_it(self, tracer):
+                tr = tracer.trace.start("serving", "x")
+                work()
+                tr.finish()
+    """)})
+    assert {("lock-manual" in h.message, "trace-span" in h.message)
+            for h in hits} == {(True, False), (False, True)}
+
+
+def test_leak_on_raise_near_miss_finally_release():
+    assert _leaks({"mxnet_tpu/serving/a.py": textwrap.dedent("""
+        def serve(pool):
+            slot = pool.acquire("s", 4)
+            try:
+                risky()
+            finally:
+                pool.release(slot)
+    """)}) == []
+
+
+def test_leak_on_raise_near_miss_with_statement_and_loop_reacquire():
+    assert _leaks({"mxnet_tpu/serving/a.py": textwrap.dedent("""
+        def read(path):
+            with open(path) as f:
+                return f.read()
+
+        def pump(pool):
+            for i in range(3):
+                slot = pool.acquire("s", 4)
+                try:
+                    work(slot)
+                finally:
+                    pool.release(slot)
+    """)}) == []
+
+
+def test_leak_on_raise_near_miss_transfer_via_return_and_self():
+    assert _leaks({"mxnet_tpu/serving/a.py": textwrap.dedent("""
+        class Engine:
+            def lease(self, pool):
+                slot = pool.acquire("s", 4)
+                return Session(slot)
+
+            def adopt(self, pool):
+                slot = pool.acquire("s", 4)
+                self.slot = slot
+                late_work()
+    """)}) == []
+
+
+def test_leak_on_raise_near_miss_releasing_callee_and_open_world():
+    # _free provably releases its parameter (summary fixpoint) -> the
+    # hand-off is a transfer; sink.consume is unresolved -> open-world;
+    # neither may fire
+    assert _leaks({"mxnet_tpu/serving/a.py": textwrap.dedent("""
+        def _free(pool, s):
+            pool.release(s)
+
+        def serve(pool):
+            slot = pool.acquire("s", 4)
+            _free(pool, slot)
+            audit()
+
+        def hand_off(pool, sink):
+            slot = pool.acquire("s", 4)
+            sink.consume(slot)
+            audit()
+    """)}) == []
+
+
+def test_leak_on_raise_near_miss_conditional_release_join():
+    # both arms release before the join -> nothing acquired survives
+    assert _leaks({"mxnet_tpu/serving/a.py": textwrap.dedent("""
+        def serve(pool, fast):
+            slot = pool.acquire("s", 4)
+            if fast:
+                pool.release(slot)
+            else:
+                pool.release(slot)
+            audit()
+    """)}) == []
+
+
+def test_leak_on_raise_near_miss_accumulative_ledger_keys():
+    # charge-new / release-evicted use DIFFERENT amount expressions:
+    # that is accounting, not a pairing — must stay silent
+    assert _leaks({"mxnet_tpu/serving/a.py": textwrap.dedent("""
+        class Cache:
+            def get(self, nbytes):
+                LEDGER.add(self.owner, "entries", nbytes)
+                for evicted in self._evict():
+                    LEDGER.release(self.owner, "entries",
+                                   evicted.nbytes)
+    """)}) == []
+
+
+def test_leak_on_raise_suppression():
+    src = textwrap.dedent("""
+        def serve(pool):
+            slot = pool.acquire("s", 4)  # graftlint: disable=resource-leak-on-raise -- teardown drains the pool
+            risky()
+            pool.release(slot)
+    """)
+    assert _leaks({"mxnet_tpu/serving/a.py": src}) == []
+
+
+# -- v3 engine: double-release ------------------------------------------------
+def _doubles(sources):
+    return [f for f in graph_lint(sources)
+            if f.rule == "double-release"]
+
+
+def test_double_release_flags_sequential_release():
+    hits = _doubles({"mxnet_tpu/serving/a.py": textwrap.dedent("""
+        def teardown(pool):
+            slot = pool.acquire("s", 4)
+            pool.release(slot)
+            pool.release(slot)
+    """)})
+    assert len(hits) == 1
+    assert "line 4" in hits[0].message  # the prior release
+
+
+def test_double_release_flags_release_after_both_branches_released():
+    hits = _doubles({"mxnet_tpu/serving/a.py": textwrap.dedent("""
+        def teardown(pool, fast):
+            slot = pool.acquire("s", 4)
+            if fast:
+                pool.release(slot)
+            else:
+                pool.release(slot)
+            pool.release(slot)
+    """)})
+    assert len(hits) == 1
+    assert hits[0].line == 8
+
+
+def test_double_release_flags_file_double_close():
+    hits = _doubles({"mxnet_tpu/serving/a.py": textwrap.dedent("""
+        def dump(path, doc):
+            f = open(path)
+            f.close()
+            f.close()
+    """)})
+    assert len(hits) == 1
+
+
+def test_double_release_flags_span_double_finish():
+    hits = _doubles({"mxnet_tpu/serving/a.py": textwrap.dedent("""
+        def trace_it(tracer):
+            tr = tracer.trace.start("serving", "x")
+            tr.finish()
+            tr.finish(status="late")
+    """)})
+    assert len(hits) == 1
+
+
+def test_double_release_near_miss_conditional_then_final_release():
+    # the join still carries the un-released branch: must analysis
+    assert _doubles({"mxnet_tpu/serving/a.py": textwrap.dedent("""
+        def teardown(pool, dirty):
+            slot = pool.acquire("s", 4)
+            if dirty:
+                pool.release(slot)
+                return
+            pool.release(slot)
+    """)}) == []
+
+
+def test_double_release_near_miss_handler_release_with_reraise():
+    # except-path release + fall-through release are path-separated
+    assert _doubles({"mxnet_tpu/serving/a.py": textwrap.dedent("""
+        def serve(pool):
+            slot = pool.acquire("s", 4)
+            try:
+                risky()
+            except Exception:
+                pool.release(slot)
+                raise
+            pool.release(slot)
+    """)}) == []
+
+
+def test_double_release_near_miss_thread_join_repeatable():
+    assert _doubles({"mxnet_tpu/serving/a.py": textwrap.dedent("""
+        def fanout(work):
+            t = Thread(target=work)
+            t.start()
+            t.join(5.0)
+            t.join(5.0)
+    """)}) == []
+
+
+def test_double_release_near_miss_loop_reacquire():
+    # the back edge re-acquires before every release
+    assert _doubles({"mxnet_tpu/serving/a.py": textwrap.dedent("""
+        def pump(pool):
+            for i in range(3):
+                slot = pool.acquire("s", 4)
+                pool.release(slot)
+    """)}) == []
+
+
+# -- v3 engine: release-under-wrong-lock --------------------------------------
+def _wrong_locks(sources):
+    return [f for f in graph_lint(sources)
+            if f.rule == "release-under-wrong-lock"]
+
+
+def test_wrong_lock_flags_release_under_lock_acquired_bare():
+    hits = _wrong_locks({"mxnet_tpu/serving/a.py": textwrap.dedent("""
+        class P:
+            def grab(self):
+                h = self.pool.acquire("s", 4)
+                try:
+                    work()
+                finally:
+                    with self._lock:
+                        self.pool.release(h)
+    """)})
+    assert len(hits) == 1
+    assert hits[0].severity == "warning"
+    assert "_lock" in hits[0].message
+
+
+def test_wrong_lock_flags_acquire_under_lock_released_bare():
+    hits = _wrong_locks({"mxnet_tpu/serving/a.py": textwrap.dedent("""
+        class P:
+            def grab(self):
+                with self._lock:
+                    h = self.pool.acquire("s", 4)
+                try:
+                    work()
+                finally:
+                    self.pool.release(h)
+    """)})
+    assert len(hits) == 1
+
+
+def test_wrong_lock_flags_different_locks():
+    hits = _wrong_locks({"mxnet_tpu/serving/a.py": textwrap.dedent("""
+        class P:
+            def grab(self):
+                with self._admit_lock:
+                    h = self.pool.acquire("s", 4)
+                try:
+                    work()
+                finally:
+                    with self._evict_lock:
+                        self.pool.release(h)
+    """)})
+    assert len(hits) == 1
+    assert "_admit_lock" in hits[0].message
+    assert "_evict_lock" in hits[0].message
+
+
+def test_wrong_lock_flags_keyed_ledger_pairing():
+    hits = _wrong_locks({"mxnet_tpu/serving/a.py": textwrap.dedent("""
+        class Cache:
+            def charge(self, nbytes):
+                with self._lock:
+                    LEDGER.add(self.owner, "pages", nbytes)
+                try:
+                    rebuild()
+                finally:
+                    LEDGER.release(self.owner, "pages", nbytes)
+    """)})
+    assert len(hits) == 1
+    assert "ledger-bytes" in hits[0].message
+
+
+def test_wrong_lock_near_miss_same_lock_both_sites():
+    assert _wrong_locks({"mxnet_tpu/serving/a.py": textwrap.dedent("""
+        class P:
+            def grab(self):
+                with self._lock:
+                    h = self.pool.acquire("s", 4)
+                    self.pool.release(h)
+    """)}) == []
+
+
+def test_wrong_lock_near_miss_both_sites_lock_free():
+    assert _wrong_locks({"mxnet_tpu/serving/a.py": textwrap.dedent("""
+        class P:
+            def grab(self):
+                h = self.pool.acquire("s", 4)
+                try:
+                    work()
+                finally:
+                    self.pool.release(h)
+    """)}) == []
+
+
+def test_wrong_lock_near_miss_outside_threaded_subsystems():
+    assert _wrong_locks({"tools/batch.py": textwrap.dedent("""
+        class P:
+            def grab(self):
+                h = self.pool.acquire("s", 4)
+                try:
+                    work()
+                finally:
+                    with self._lock:
+                        self.pool.release(h)
+    """)}) == []
+
+
+def test_wrong_lock_near_miss_manual_lock_protocol_exempt():
+    # the manual-lock protocol's acquire/release ARE the lock — held
+    # sets trivially differ; the protocol is exempt from this rule
+    assert _wrong_locks({"mxnet_tpu/serving/a.py": textwrap.dedent("""
+        class C:
+            def bump(self):
+                self._mu.acquire()
+                self.n += 1
+                self._mu.release()
+    """)}) == []
+
+
+# -- v3 engine: catalog <-> docs drift guard ----------------------------------
+def test_catalog_entries_embedded_verbatim_in_docs():
+    from mxnet_tpu.analysis import catalog
+    with open(os.path.join(REPO, "docs", "lint.md")) as fh:
+        docs = fh.read()
+    for rid in ("resource-leak-on-raise", "double-release",
+                "release-under-wrong-lock"):
+        block = catalog.render_entry(rid)
+        assert block is not None
+        assert block in docs, \
+            f"docs/lint.md drifted from the catalog entry for {rid}"
+    # and --explain serves the same text through the real CLI
+    r = _cli("--explain", "resource-leak-on-raise")
+    assert r.returncode == 0
+    assert r.stdout == catalog.render_entry("resource-leak-on-raise")
+
+
+def test_explain_unknown_rule_exits_2():
+    r = _cli("--explain", "no-such-rule")
+    assert r.returncode == 2
+    assert "unknown rule" in r.stderr
+
+
+# -- v3 engine: whole-program acceptance (CLI on a tmp tree) ------------------
+def test_cli_acceptance_leak_caught_near_misses_silent(tmp_path):
+    (tmp_path / "leaky.py").write_text(textwrap.dedent("""
+        def serve(pool):
+            slot = pool.acquire("s", 4)
+            risky()
+            pool.release(slot)
+    """))
+    (tmp_path / "clean.py").write_text(textwrap.dedent("""
+        def _free(pool, s):
+            pool.release(s)
+
+        def covered(pool):
+            slot = pool.acquire("s", 4)
+            try:
+                risky()
+            finally:
+                pool.release(slot)
+
+        def transferred(pool):
+            slot = pool.acquire("s", 4)
+            _free(pool, slot)
+            audit()
+    """))
+    r = _cli(str(tmp_path), "--json")
+    doc = json.loads(r.stdout)
+    hits = [f for f in doc["findings"]
+            if f["rule"] == "resource-leak-on-raise"]
+    assert len(hits) == 1
+    assert hits[0]["path"].endswith("leaky.py")
+    assert hits[0]["line"] == 3

@@ -1161,3 +1161,35 @@ def test_watch_warms_ladder_before_flip(tmp_path):
         mxc.clear_ladders()
         mxc.clear_warmed()
         mxc.STATS.reset()
+
+
+# -- trace lifecycle hardening ------------------------------------------------
+def test_rejected_predict_finishes_trace_even_when_event_raises():
+    """Regression (graftlint resource-leak-on-raise): predict_async's
+    rejection handler recorded the shed event BEFORE finishing the
+    span — an event() that raised (exporter lock poisoned, snapshot
+    bug) leaked the span into the tracer's active set.  finish() now
+    runs under finally."""
+    from mxnet_tpu.telemetry import trace as mxtrace
+
+    mxtrace.enable()
+    mxtrace.reset_exemplars()
+    orig_event = mxtrace.Trace.event
+
+    def exploding_event(self, name, **fields):
+        raise RuntimeError("exporter wedged")
+
+    mxtrace.Trace.event = exploding_event
+    try:
+        with ModelServer(name="t-trace-reject") as server:
+            with pytest.raises(RuntimeError, match="exporter wedged"):
+                server.predict_async("no-such-model",
+                                     {"data": np.zeros(4, np.float32)})
+        docs = mxtrace.exemplars().get("serving", {})
+        last = docs.get("last")
+        assert last is not None and last["status"] == "rejected", \
+            f"span leaked despite the failing event(): {docs}"
+    finally:
+        mxtrace.Trace.event = orig_event
+        mxtrace.disable()
+        mxtrace.reset_exemplars()

@@ -204,3 +204,49 @@ def test_graftlint_json_schema_round_trips(tmp_path):
     assert any(f["rule"] == "torn-write" for f in doc["findings"])
     # byte-level round trip: the schema holds nothing json can't carry
     assert json.loads(json.dumps(doc)) == doc
+
+
+def test_graftlint_sarif_round_trips(tmp_path):
+    """--sarif emits SARIF 2.1.0: every registered rule in
+    tool.driver.rules, results carrying graftlint fingerprints as
+    partialFingerprints, severities mapped to SARIF levels — and the
+    document survives a loads->dumps->loads round trip."""
+    mod = tmp_path / "m.py"
+    mod.write_text(
+        "def serve(pool):\n"
+        "    slot = pool.acquire('s', 4)\n"
+        "    risky()\n"
+        "    pool.release(slot)\n\n"
+        "def save(path, doc):\n"
+        "    with open(path, 'w') as f:\n"
+        "        f.write(doc)\n")
+    out = tmp_path / "lint.sarif"
+    r = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "graftlint.py"),
+         str(tmp_path), "--sarif", str(out)],
+        capture_output=True, text=True, timeout=120)
+    doc = json.loads(out.read_text())
+    assert doc["version"] == "2.1.0"
+    assert "sarif-schema-2.1.0" in doc["$schema"]
+    run = doc["runs"][0]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "graftlint"
+    rule_ids = [rd["id"] for rd in driver["rules"]]
+    assert rule_ids == sorted(rule_ids)          # stable ruleIndex order
+    assert "torn-write" in rule_ids
+    assert "resource-leak-on-raise" in rule_ids  # ALL rules, fired or not
+    by_rule = {res["ruleId"]: res for res in run["results"]}
+    assert {"torn-write", "resource-leak-on-raise"} <= set(by_rule)
+    for res in run["results"]:
+        # ruleIndex must resolve to the matching descriptor
+        assert driver["rules"][res["ruleIndex"]]["id"] == res["ruleId"]
+        fp = res["partialFingerprints"]["graftlintFingerprint/v1"]
+        assert fp.startswith(res["ruleId"] + "|")
+        region = res["locations"][0]["physicalLocation"]["region"]
+        assert region["startLine"] >= 1 and region["startColumn"] >= 1
+    assert by_rule["torn-write"]["level"] == "error"
+    leak = by_rule["resource-leak-on-raise"]
+    assert leak["locations"][0]["physicalLocation"][
+        "artifactLocation"]["uri"].endswith("m.py")
+    # byte-level round trip
+    assert json.loads(json.dumps(doc)) == doc

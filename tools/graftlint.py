@@ -18,6 +18,8 @@ Usage:
                                                  # touched vs merge-base
   python tools/graftlint.py --timings            # per-rule wall-time table
   python tools/graftlint.py --json path/to.py    # machine-readable
+  python tools/graftlint.py --sarif out.sarif    # SARIF 2.1.0 for CI
+  python tools/graftlint.py --explain <rule>     # rule catalog entry
   python tools/graftlint.py --list-rules
 
 Exit codes: 0 clean (or only baselined findings with --fail-on-new),
@@ -101,6 +103,15 @@ def main(argv=None):
     ap.add_argument("--timings", action="store_true",
                     help="print a per-rule wall-time table (where "
                          "lint time goes)")
+    ap.add_argument("--sarif", default="", metavar="PATH",
+                    help="also write findings as SARIF 2.1.0 to PATH "
+                         "(rule metadata from the catalog, graftlint "
+                         "fingerprints as partialFingerprints)")
+    ap.add_argument("--explain", default="", metavar="RULE",
+                    help="print RULE's catalog entry (description, "
+                         "origin bug, flag + near-miss examples) and "
+                         "exit — the same source of truth docs/lint.md "
+                         "embeds")
     ap.add_argument("--select", default="",
                     help="comma-separated rule ids to run exclusively")
     ap.add_argument("--disable", default="",
@@ -109,6 +120,16 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     an = _load_analysis()
+
+    if args.explain:
+        block = an.catalog.explain(args.explain)
+        if block is None:
+            known = sorted(set(an.all_rules()) | set(an.all_graph_rules()))
+            print(f"graftlint: unknown rule {args.explain!r} "
+                  f"(known: {', '.join(known)})", file=sys.stderr)
+            return 2
+        print(block, end="")
+        return 0
 
     if args.list_rules:
         catalog = dict(an.all_rules())
@@ -151,6 +172,19 @@ def main(argv=None):
         else:
             findings = [f for f in findings if f.path in changed]
             errors = [(p, m) for p, m in errors if p in changed]
+
+    if args.sarif:
+        import json as _json
+        sarif_path = (args.sarif if os.path.isabs(args.sarif)
+                      else os.path.join(os.getcwd(), args.sarif))
+        tmp = f"{sarif_path}.tmp-{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            _json.dump(an.render_sarif(findings), fh, indent=2,
+                       sort_keys=True)
+            fh.write("\n")
+        os.replace(tmp, sarif_path)
+        print(f"graftlint: SARIF written to {args.sarif} "
+              f"({len(findings)} result(s))", file=sys.stderr)
 
     baseline_path = (args.baseline if os.path.isabs(args.baseline)
                      else os.path.join(REPO, args.baseline))
