@@ -1514,6 +1514,113 @@ def measure_numerics_overhead():
         }}
 
 
+def measure_data_pipeline():
+    """ISSUE-19 streaming-data-plane gate (``BENCH_DATA``): a K=8
+    scanned fit fed by the multi-worker window feed must hide the data
+    plane behind compute —
+
+    * ``data_wait_pct`` — total train-thread blocked-on-data time
+      (the ``mxnet_data_wait_seconds`` histogram, recorded at the one
+      place the train thread can block: ``WindowFeed.get``) as a
+      percentage of epoch wall, on the compute-representative MLP
+      (width 256 @ bs 512, same model as the numerics phase).  Gate
+      < 5%: window N+1 stages on the feed thread while window N
+      executes, so the train thread should almost never wait;
+    * ``serial_ratio`` — pipelined epoch wall over the serial baseline
+      (``workers=0``: same seeded shard order, read + staged inline on
+      the train thread).  Reported, not gated (CPU-backend compute
+      dominates both sides; the ratio is the relay proof, the 5% wait
+      gate is the contract);
+    * dispatches/step REQUIRED identical on vs off — the pipeline
+      feeds the same donated window dispatch, it never adds one."""
+    import time as _t
+
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import io_pipeline as mxpipe, profiler as prof
+    from mxnet_tpu import telemetry as _tel
+
+    K, steps, bs = 8, 16, 512
+
+    def mlp(layers=16, width=256):
+        h = mx.sym.Variable("data")
+        for i in range(layers):
+            h = mx.sym.FullyConnected(h, num_hidden=width, name=f"fc{i}")
+            h = mx.sym.Activation(h, act_type="relu")
+        h = mx.sym.FullyConnected(h, num_hidden=10, name="fc_out")
+        return mx.sym.SoftmaxOutput(h, name="softmax")
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(steps * bs, 64).astype(np.float32)
+    y = rng.randint(0, 10, steps * bs).astype(np.float32)
+
+    os.environ["MXNET_FUSED_STEP"] = "1"
+    os.environ["MXNET_SCAN_STEPS"] = str(K)
+    opt = {"learning_rate": 0.01, "momentum": 0.9}
+
+    def make_runner(workers):
+        if workers:
+            os.environ["MXNET_DATA_WORKERS"] = str(workers)
+        else:
+            os.environ.pop("MXNET_DATA_WORKERS", None)
+        it = mxpipe.DataPipeline(
+            mxpipe.NDArraySource(x, y, batch_size=bs,
+                                 batches_per_shard=1),
+            workers=workers, seed=0)
+        mod = mx.mod.Module(mlp(), context=mx.cpu())
+        mod.fit(it, num_epoch=1, optimizer="sgd", optimizer_params=opt,
+                initializer=mx.initializer.Xavier())  # warm: compiles
+        return mod, it
+
+    def epoch(mod, it):
+        it.reset()
+        prof.reset_dispatch_counts()
+        wait0 = _tel._DATA_WAIT.stats()["sum"]
+        t0 = _t.perf_counter()
+        mod.fit(it, num_epoch=1, optimizer="sgd", optimizer_params=opt)
+        wall = _t.perf_counter() - t0
+        return (wall / steps * 1e3,
+                prof.dispatch_counts().get("total", 0) / steps,
+                _tel._DATA_WAIT.stats()["sum"] - wait0, wall)
+
+    try:
+        # serial baseline (workers=0: inline read + stage)
+        mod0, it0 = make_runner(0)
+        epoch(mod0, it0)  # settle
+        off = sorted((epoch(mod0, it0) for _ in range(3)),
+                     key=lambda t: t[0])[1]  # median of 3
+        it0.close()
+        # pipelined (2 readers + the window feed double-buffer)
+        mod1, it1 = make_runner(2)
+        epoch(mod1, it1)  # settle
+        runs = sorted((epoch(mod1, it1) for _ in range(3)),
+                      key=lambda t: t[0])
+        on = runs[1]  # median of 3
+        it1.close()
+    finally:
+        os.environ.pop("MXNET_DATA_WORKERS", None)
+        os.environ.pop("MXNET_SCAN_STEPS", None)
+    off_ms, off_disp, _w, _off_wall = off
+    on_ms, on_disp, wait_s, on_wall = on
+    wait_pct = (wait_s / on_wall * 100.0) if on_wall else 0.0
+    return {
+        "data_pipeline": {
+            "metric": "data_wait_pct",
+            "value": round(wait_pct, 2),
+            "unit": "%",
+            "budget_pct": 5.0,
+            "gate_pass": bool(wait_pct < 5.0 and on_disp == off_disp),
+            "k": K,
+            "workers": 2,
+            "step_ms_pipelined": round(on_ms, 3),
+            "step_ms_serial": round(off_ms, 3),
+            "serial_ratio": round(on_ms / off_ms, 3) if off_ms else 1.0,
+            "data_wait_s_per_epoch": round(wait_s, 4),
+            "dispatches_per_step_pipelined": round(on_disp, 4),
+            "dispatches_per_step_serial": round(off_disp, 4),
+        }}
+
+
 def measure_scan_dispatch(fused_step_ms=None):
     """CPU-measurable perf signal for the K-step scanned train window
     (ISSUE 6): the same dispatch-bound deep MLP as train_step_ms_bs32,
@@ -1859,6 +1966,25 @@ def main():
                 log(f"numerics phase failed: {type(e).__name__}: {e}")
                 result["numerics"] = {
                     "metric": "numerics_overhead_pct",
+                    "error": f"{type(e).__name__}: {e}"}
+
+        if _cfg0.get("BENCH_DATA"):
+            try:
+                result.update(measure_data_pipeline())
+                dp = result["data_pipeline"]
+                log(f"[data] K={dp['k']} x{dp['workers']} workers: "
+                    f"data_wait {dp['value']}% of wall (budget "
+                    f"{dp['budget_pct']}%), step "
+                    f"{dp['step_ms_pipelined']}ms vs serial "
+                    f"{dp['step_ms_serial']}ms "
+                    f"({dp['serial_ratio']}x), dispatches "
+                    f"{dp['dispatches_per_step_pipelined']} vs "
+                    f"{dp['dispatches_per_step_serial']} serial, "
+                    f"{'PASS' if dp['gate_pass'] else 'FAIL'}")
+            except Exception as e:
+                log(f"data phase failed: {type(e).__name__}: {e}")
+                result["data_pipeline"] = {
+                    "metric": "data_wait_pct",
                     "error": f"{type(e).__name__}: {e}"}
 
         if _cfg0.get("BENCH_LINT"):

@@ -47,6 +47,13 @@ echo "== fused + scanned train step smoke (dispatch budget, parity) =="
 # bit-identical to the sequential fused loop (docs/perf_notes.md)
 JAX_PLATFORMS=cpu python -m mxnet_tpu.fused_step
 
+echo "== streaming data plane smoke (shard-order determinism, dead-reader exactly-once, backpressure) =="
+# the multi-worker prefetch pipeline must deliver the seeded per-epoch
+# shard order bitwise-identically for 0/1/2/4 workers, survive a reader
+# death mid-epoch with every batch delivered exactly once, and hold the
+# buffered-batch bound under a stalled consumer (docs/data.md)
+JAX_PLATFORMS=cpu python -m mxnet_tpu.io_pipeline
+
 echo "== mesh fused step smoke (dp x tp fit: dispatch budget, kvstore-loop parity) =="
 # a dist_device_sync Module.fit on a dp=2,tp=2 fake-device mesh must run
 # each K=8 window as ONE donated shard_map dispatch (<= (1+eps)/K per

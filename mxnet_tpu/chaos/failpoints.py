@@ -127,6 +127,11 @@ SITES = {
         "connection, so the worker reads as dead)",
     "io/stage":
         "io.stage_batch / stage_super_batch, before the host->device put",
+    "io/reader/read":
+        "io_pipeline reader worker, per batch read (delay = slow "
+        "reader; raise = the reader dies and its shards rebalance onto "
+        "the survivors — exactly-once, typed DataReaderError only when "
+        "ALL readers are gone)",
     "train/scan_window":
         "Module scanned fit, at each window boundary before the scan "
         "dispatch (kill here is the SIGKILL-mid-window scenario)",

@@ -42,6 +42,13 @@ tested alone:
    ``prompt + tokens-so-far``, survivor sessions stream untouched, and
    both engines' KV slots and ledger pages are provably released
    (zero-leak asserted).
+8. **reader death mid-epoch** (ISSUE 19) — one reader worker of the
+   streaming data plane dies at the Nth ``io/reader/read``: the
+   pipeline rebalances its shards onto the survivors, the epoch
+   completes with every sample delivered exactly once in the seeded
+   shard order, zero stalls; a slow reader (delay arm) is absorbed the
+   same way; killing ALL readers raises a typed ``DataReaderError`` —
+   never a hang.
 
 Every scenario ends in recovery or a typed error — the assertions
 include "no hang" (bounded waits everywhere) and "no silent loss"
@@ -1205,6 +1212,132 @@ def scenario_peer_loss_mid_window(workdir, scan_k=2, timeout=240.0):
     return result
 
 
+# ---------------------------------------------------------------------------
+# scenario: reader death mid-epoch — the streaming data plane rebalances,
+# the epoch completes exactly-once, all-dead is a typed error (ISSUE 19)
+# ---------------------------------------------------------------------------
+def scenario_reader_death_mid_epoch(workers=4, shards=16,
+                                    batches_per_shard=4, kill_at=13):
+    """Chaos over the streaming data plane (``io_pipeline``):
+
+    1. one of ``workers`` reader workers dies at its ``kill_at``-th
+       ``io/reader/read`` — the pipeline requeues the victim's shards
+       onto the survivors, the epoch completes with every sample row
+       delivered exactly once IN THE SAME seeded order as the serial
+       baseline, the rebalance counter ticks, and no single ``next()``
+       stalls;
+    2. a slow reader (delay arm) is absorbed the same way — order
+       unchanged, nothing dropped;
+    3. every reader dying raises a typed :class:`DataReaderError` on
+       the train thread — never a hang (asserted via a joined helper
+       thread, not hope).
+    """
+    import numpy as np
+
+    from .. import io_pipeline as pipe
+    from .. import telemetry
+
+    batch_size = 8
+    n_rows = shards * batches_per_shard * batch_size
+    data = np.arange(n_rows * 3, dtype=np.float32).reshape(n_rows, 3)
+    label = np.arange(n_rows, dtype=np.float32)
+
+    def make_pipe(n_workers):
+        src = pipe.NDArraySource(data, label, batch_size=batch_size,
+                                 batches_per_shard=batches_per_shard)
+        return pipe.DataPipeline(src, workers=n_workers, seed=7)
+
+    def drain(p, stall_box=None):
+        """One full epoch; returns the concatenated row-index sequence."""
+        idx = []
+        while True:
+            t0 = time.perf_counter()
+            try:
+                batch = p.next()
+            except StopIteration:
+                break
+            if stall_box is not None:
+                stall_box[0] = max(stall_box[0],
+                                   time.perf_counter() - t0)
+            idx.append(np.asarray(batch.index))
+        return np.concatenate(idx) if idx else np.empty((0,), np.int64)
+
+    result = {"ok": False, "non_typed_failures": [], "rebalances": 0,
+              "max_next_stall_s": 0.0}
+    reb0 = telemetry._DATA_REBALANCE.value()
+    chaos.reset()
+    p_base = p_kill = p_slow = p_dead = None
+    try:
+        # serial baseline: the seeded shard order, workers=0
+        p_base = make_pipe(0)
+        baseline = drain(p_base)
+        result["batches"] = len(baseline) // batch_size
+        if sorted(baseline.tolist()) != list(range(n_rows)):
+            result["non_typed_failures"].append(
+                "baseline is not a permutation of the dataset")
+
+        # pass 1: kill one reader mid-epoch
+        chaos.arm("io/reader/read", "raise", hits=kill_at, count=1)
+        p_kill = make_pipe(workers)
+        stall = [0.0]
+        try:
+            seq = drain(p_kill, stall)
+        except pipe.DataReaderError as e:
+            result["non_typed_failures"].append(
+                f"one dead reader must rebalance, not raise: {e}")
+            seq = np.empty((0,), np.int64)
+        result["max_next_stall_s"] = round(stall[0], 3)
+        result["exactly_once"] = bool(np.array_equal(seq, baseline))
+        result["rebalances"] = telemetry._DATA_REBALANCE.value() - reb0
+        chaos.reset()
+
+        # pass 2: a slow reader is absorbed, order unchanged
+        chaos.arm("io/reader/read", "delay", value=0.01, hits=3, count=6)
+        p_slow = make_pipe(workers)
+        slow_seq = drain(p_slow)
+        result["slow_reader_order_ok"] = bool(
+            np.array_equal(slow_seq, baseline))
+        chaos.reset()
+
+        # pass 3: ALL readers dead -> typed DataReaderError, no hang
+        chaos.arm("io/reader/read", "raise", hits=1)
+        p_dead = make_pipe(workers)
+        box = {"raised": None}
+
+        def all_dead():
+            try:
+                drain(p_dead)
+                box["raised"] = "completed-without-error"
+            except pipe.DataReaderError:
+                box["raised"] = "typed"
+            except Exception as e:  # noqa: BLE001 — gate-fatal bucket
+                box["raised"] = f"{type(e).__name__}: {e}"
+
+        t = threading.Thread(target=all_dead, name="chaos-all-dead")
+        t.start()
+        t.join(timeout=30)
+        result["all_dead_hung"] = t.is_alive()
+        result["all_dead_outcome"] = box["raised"]
+        if box["raised"] not in (None, "typed"):
+            result["non_typed_failures"].append(
+                f"all-dead pass: {box['raised']}")
+
+        result["ok"] = bool(
+            result["exactly_once"]
+            and result["rebalances"] >= 1
+            and result["max_next_stall_s"] < 10.0
+            and result["slow_reader_order_ok"]
+            and not result["all_dead_hung"]
+            and result["all_dead_outcome"] == "typed"
+            and not result["non_typed_failures"])
+    finally:
+        chaos.reset()
+        for p in (p_base, p_kill, p_slow, p_dead):
+            if p is not None:
+                p.close()
+    return result
+
+
 def run_all(workdir=None, verbose=True):
     """Run the composed scenarios sequentially; returns
     {name: result dict}.  The smoke asserts every ``ok``."""
@@ -1220,6 +1353,7 @@ def run_all(workdir=None, verbose=True):
         ("replica_kill_mid_burst", scenario_replica_kill_mid_burst),
         ("replica_kill_mid_generation",
          scenario_replica_kill_mid_generation),
+        ("reader_death_mid_epoch", scenario_reader_death_mid_epoch),
         ("sigkill_mid_scan",
          lambda: scenario_sigkill_mid_scan(os.path.join(base, "s4"))),
         ("mesh_collective_stall",

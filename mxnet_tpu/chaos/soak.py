@@ -90,6 +90,11 @@ def _rearm_chaos(rng):
                   prob=0.05 + 0.05 * rng.random(), count=2)
     if "io/stage" not in arms:
         chaos.arm("io/stage", "delay", value=0.002, prob=0.2, count=4)
+    if "io/reader/read" not in arms:
+        # slow reader: the data-plane workers absorb it below the
+        # data_starved rate threshold (0.3 s/s over 30 s)
+        chaos.arm("io/reader/read", "delay", value=0.002, prob=0.2,
+                  count=4)
     if "checkpoint/gc/remove" not in arms:
         chaos.arm("checkpoint/gc/remove", "delay", value=0.002,
                   prob=0.5, count=2)
@@ -188,13 +193,20 @@ def run(seconds=None, qps=None, chaos_on=None, rss_slope_max=None,
 
     clients = []
     step = 0
+    it = None
     mod = mx.mod.Module(sym, context=mx.cpu())
     try:
         # -- warmup (outside the measured window): first fit epoch,
         # first commit, watch engaged, first served request — compile
         # transients must not pollute the leak-slope estimator
-        it = mxio.NDArrayIter(mx.nd.array(x), mx.nd.array(y),
-                              batch_size=16, label_name="softmax_label")
+        # the training feed is the streaming data plane itself (2 reader
+        # workers) so the soak's io/reader/read delays land on real
+        # reader threads, not an armed-but-idle site
+        from .. import io_pipeline as mxpipe
+        it = mxpipe.DataPipeline(
+            mxpipe.NDArraySource(x, y, batch_size=16,
+                                 batches_per_shard=2),
+            workers=2, seed=0)
         mod.fit(it, num_epoch=1, optimizer="sgd",
                 optimizer_params={"learning_rate": 0.05},
                 arg_params={k: v.copy() for k, v in params.items()})
@@ -318,6 +330,8 @@ def run(seconds=None, qps=None, chaos_on=None, rss_slope_max=None,
     finally:
         stop.set()
         chaos.reset()
+        if it is not None:
+            it.close()
         alerts.stop()
         resources.stop()
         try:

@@ -234,6 +234,22 @@ _register("MXNET_FIT_STAGE_NEXT", bool, True,
           "(jax.device_put) while the current step is still in flight, "
           "overlapping input feed with compute; 0 feeds batches "
           "synchronously at forward time")
+# -- streaming data plane (io_pipeline.py) -----------------------------------
+_register("MXNET_DATA_WORKERS", int, 0,
+          "streaming data plane: reader worker threads per "
+          "DataPipeline (decode/augment off the train thread) and the "
+          "switch for the fit loop's off-thread super-batch assembler; "
+          "0 = serial in-thread reads (bitwise-identical batch "
+          "sequence, no overlap)")
+_register("MXNET_DATA_QUEUE_DEPTH", int, 4,
+          "streaming data plane: bounded per-shard output queue depth "
+          "(batches); with the in-flight shard window this caps host "
+          "RSS — total buffered batches <= depth x max in-flight "
+          "shards")
+_register("MXNET_DATA_SHARD_SEED", int, 0,
+          "streaming data plane: seed for the per-epoch shard order "
+          "permutation; the SAME order is produced for any worker "
+          "count (the load-bearing determinism contract, docs/data.md)")
 # -- fused kernels -----------------------------------------------------------
 _register("MXNET_KERNELS", str, "off",
           "kernels subsystem mode: off (legacy per-op gates only), "
@@ -646,6 +662,13 @@ _register("BENCH_SCAN", bool, True,
           "scan_dispatches_per_step); needs no TPU relay")
 _register("BENCH_SCAN_K", int, 8,
           "bench.py scan phase: MXNET_SCAN_STEPS window size")
+_register("BENCH_DATA", bool, True,
+          "bench.py: also measure the streaming data plane — a K=8 "
+          "scan-window fit on a compute-representative model with the "
+          "multi-worker pipeline on (data_wait_pct, gated < 5% of "
+          "step wall) vs the serial in-thread loop "
+          "(data_wait_serial_ratio); pure-host phase, needs no TPU "
+          "relay")
 _register("BENCH_TELEMETRY", bool, True,
           "bench.py: also measure the disabled-path cost of "
           "telemetry.span (telemetry_disabled_span_ns; the <1us budget "

@@ -512,15 +512,21 @@ class ScanTrainStep(FusedTrainStep):
     # -- per-window host path ----------------------------------------------
     def run_window(self, sbatch):
         """Dispatch one K-step (x M micro-batch) window.  ``sbatch`` is an
-        ``io.SuperBatch`` whose data/label arrays are stacked device
-        buffers with leading dim K*M.  Returns the list of per-position
-        output buffers flattened to leading dim K*M (for boundary metric
-        updates), or False when the stacked shapes don't match the bound
-        executor (caller falls back to per-batch steps)."""
+        ``io.SuperBatch`` whose data/label arrays are stacked buffers
+        with leading dim K*M — device arrays, or host numpy stacks when
+        the streaming window feed pre-staged them off-thread
+        (``stage_super_batch(host=True)``); jit placement makes the two
+        bitwise-equivalent.  Returns the list of per-position output
+        buffers flattened to leading dim K*M (for boundary metric
+        updates), or False when the window is short or the stacked
+        shapes don't match the bound executor (caller falls back to
+        per-batch steps)."""
         module = self._module
         exec_ = module._exec
         K, M = self.scan_steps, self.accum
         W = K * M
+        if sbatch.count != W:
+            return False
         feed = {}
         for desc, arr in zip(module._data_shapes, sbatch.data):
             feed[desc.name] = arr

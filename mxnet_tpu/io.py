@@ -165,14 +165,20 @@ class SuperBatch:
         self.count = count
 
 
-def stage_super_batch(batches, ctx):
+def stage_super_batch(batches, ctx, host=False):
     """Stack a window of DataBatches host-side and ``jax.device_put``
     each data/label position ONCE as a ``(len(batches), *shape)`` array.
 
     This is the window-granular sibling of :func:`stage_batch`: while a
     K-step scan is in flight the fit loop stages the NEXT super-batch
     with a single H2D transfer per input tensor position (PyGraph's
-    whole-iteration-capture argument applied to the input feed)."""
+    whole-iteration-capture argument applied to the input feed).
+
+    ``host=True`` stops after the stack: the SuperBatch holds numpy
+    arrays.  The mesh fused window wants this — its ``run_window``
+    re-places the stacked feeds itself (``DeviceMesh.put_batch`` shards
+    the batch axis across the mesh), so a device placement here would
+    just be copied straight back out."""
     import time as _time
 
     import jax
@@ -193,16 +199,20 @@ def stage_super_batch(batches, ctx):
     t0 = _time.perf_counter()
     staged_bytes = [0]
 
-    def host(a):
+    def as_host(a):
         return a.asnumpy() if isinstance(a, NDArray) else np.asarray(a)
 
     def stack(position_lists):
         out = []
         for arrs in position_lists:
-            stacked = np.stack([host(a) for a in arrs])
+            stacked = np.stack([as_host(a) for a in arrs])
             staged_bytes[0] += stacked.nbytes
-            out.append(jax.device_put(stacked, dev) if dev is not None
-                       else jax.device_put(stacked))
+            if host:
+                out.append(stacked)
+            elif dev is not None:
+                out.append(jax.device_put(stacked, dev))
+            else:
+                out.append(jax.device_put(stacked))
         return out
 
     n_data = len(batches[0].data)
@@ -315,23 +325,42 @@ class PrefetchingIter(DataIter):
         self.rename_data = rename_data
         self.rename_label = rename_label
         self.batch_size = self.provide_data[0][1][0]
-        self._queues = [_queue.Queue(maxsize=2) for _ in range(self.n_iter)]
-        self._started = True
         self.current_batch = [None for _ in range(self.n_iter)]
+        self._spawn()
 
-        def prefetch_func(self_, i):
-            while self_._started:
+    def _spawn(self):
+        """Fresh queues + prefetch threads for one generation.  The
+        stop event and queue list are captured AT SPAWN TIME: a
+        straggler thread from a previous generation can never observe
+        the new generation's state and keep producing into its queues
+        (the pre-fix reset bug — the 1 s join timeout was load-bearing)."""
+        queues = [_queue.Queue(maxsize=2) for _ in range(self.n_iter)]
+        stop = threading.Event()
+
+        def prefetch_func(it, q):
+            while not stop.is_set():
                 try:
-                    batch = self_.iters[i].next()
+                    batch = it.next()
                 except StopIteration:
                     batch = None
-                self_._queues[i].put(batch)
+                # bounded put: a stopped generation must exit even if
+                # nobody ever drains its queue again
+                while not stop.is_set():
+                    try:
+                        q.put(batch, timeout=0.1)
+                        break
+                    except _queue.Full:
+                        continue
                 if batch is None:
                     break
 
+        self._queues = queues
+        self._stop = stop
+        self._started = True
         self.prefetch_threads = []
         for i in range(self.n_iter):
-            t = threading.Thread(target=prefetch_func, args=(self, i),
+            t = threading.Thread(target=prefetch_func,
+                                 args=(self.iters[i], queues[i]),
                                  daemon=True)
             t.start()
             self.prefetch_threads.append(t)
@@ -356,6 +385,7 @@ class PrefetchingIter(DataIter):
 
     def __del__(self):
         self._started = False
+        self._stop.set()
         for q in self._queues:
             try:
                 q.get_nowait()
@@ -363,37 +393,24 @@ class PrefetchingIter(DataIter):
                 pass
 
     def reset(self):
-        # drain then restart threads
+        # signal FIRST, then drain while joining: a thread blocked on a
+        # full queue sees the stop event on its bounded put, so the old
+        # generation is provably gone before the upstream iters rewind
+        # and the next generation spawns
         self._started = False
-        for q in self._queues:
-            while True:
-                try:
-                    q.get_nowait()
-                except _queue.Empty:
-                    break
+        self._stop.set()
         for t in self.prefetch_threads:
-            t.join(timeout=1.0)
+            while t.is_alive():
+                for q in self._queues:
+                    try:
+                        while True:
+                            q.get_nowait()
+                    except _queue.Empty:
+                        pass
+                t.join(timeout=0.2)
         for i in self.iters:
             i.reset()
-        self._started = True
-        self._queues = [_queue.Queue(maxsize=2) for _ in range(self.n_iter)]
-
-        def prefetch_func(self_, i):
-            while self_._started:
-                try:
-                    batch = self_.iters[i].next()
-                except StopIteration:
-                    batch = None
-                self_._queues[i].put(batch)
-                if batch is None:
-                    break
-
-        self.prefetch_threads = []
-        for i in range(self.n_iter):
-            t = threading.Thread(target=prefetch_func, args=(self, i),
-                                 daemon=True)
-            t.start()
-            self.prefetch_threads.append(t)
+        self._spawn()
 
     def iter_next(self):
         batches = [q.get() for q in self._queues]

@@ -236,14 +236,43 @@ class BaseModule:
         K, M = plan[0], plan[1]
         W = K * M
         ctx = getattr(self, "_context", None)
+        # a mesh window re-places its stacked feeds itself
+        # (DeviceMesh.put_batch shards the batch axis), so stage the
+        # super-batch host-side there — one placement, not two
+        stage_host = len(plan) > 2 and plan[2] is not None
         data_iter = iter(train_data)
         state = {"exhausted": False}
         nbatch = 0
+        from . import io_pipeline as mx_pipe
+        feed = None
+        if mx_pipe.feed_enabled():
+            # streaming data plane (ISSUE 19): collect AND stage the
+            # next window off the train thread, double-buffered — the
+            # stage/dispatch thread-pair idiom applied to input.  The
+            # train thread only blocks in feed.get(), charged to the
+            # data_wait lane; a wedged feed stops the train/fit beats,
+            # so the watchdog still pages.
+            feed = mx_pipe.WindowFeed(data_iter, W, ctx,
+                                      self._scan_batch_ok,
+                                      host=stage_host)
 
         def collect():
-            # the next W same-shape batches; shorter on epoch end or when
-            # a shape-mismatched batch (tail partial, bucketing) shows up
-            # — those route through the per-batch path in arrival order
+            # the next W same-shape batches (+ their pre-staged
+            # super-batch when the window feed is on); shorter on epoch
+            # end or when a shape-mismatched batch (tail partial,
+            # bucketing) shows up — those route through the per-batch
+            # path in arrival order
+            if feed is not None:
+                with timeline.lane("data_wait"):
+                    kind, payload, sbatch, span = feed.get()
+                if kind == "end":
+                    state["exhausted"] = True
+                    state["collect"] = None
+                    return [], [], None
+                state["collect"] = span
+                if kind == "window":
+                    return payload, [], sbatch
+                return payload, [], None
             t_c0 = time.perf_counter()
             batches, tail = [], []
             while len(batches) < W:
@@ -261,7 +290,7 @@ class BaseModule:
             # "collect" stage (prefetched collects belong to the window
             # they feed, not the one in flight while they ran)
             state["collect"] = (t_c0, time.perf_counter())
-            return batches, tail
+            return batches, tail, None
 
         def per_batch(batch):
             nonlocal nbatch
@@ -284,89 +313,109 @@ class BaseModule:
 
         pending = collect()
         timeline.begin_step()
-        while True:
-            batches, tail = pending
-            outs = False
-            wtrace = _telemetry.trace.NULL_TRACE
-            if len(batches) == W and not self._scan_disabled:
-                # the SIGKILL-mid-scan-window scenario arms a kill here:
-                # deterministically between the last boundary's host
-                # control and the next window's dispatch
-                from .chaos.failpoints import failpoint as _chaos_fp
-                _chaos_fp("train/scan_window")
-                # window trace (ISSUE 12): collect -> stage ->
-                # [rendezvous, recorded by the multi-host step via the
-                # ambient trace] -> dispatch -> boundary_flush
-                wtrace = _telemetry.trace.start("train", "fit/window")
-                wtrace.add_stage(
-                    "collect", *state.get("collect",
-                                          (wtrace.t0, wtrace.t0)))
-                with timeline.lane("h2d_stage"), wtrace.stage("stage"):
-                    sbatch = mx_io.stage_super_batch(batches, ctx)
-                _telemetry.trace.set_current(wtrace)
-                try:
-                    with timeline.lane("step_dispatch"), \
-                            wtrace.stage("dispatch"):
-                        outs = self._run_scan_window(sbatch, plan)
-                except (PeerLostError, PreemptionError) as e:
-                    # elastic events are NOT trace failures: a lost peer
-                    # or a preemption notice must reach the elastic
-                    # session (boundary checkpoint + survivor-mesh
-                    # restore), never degrade into per-batch steps
-                    wtrace.event("elastic_fault", cause=type(e).__name__)
-                    wtrace.finish(status="elastic_fault")
-                    raise
-                except NonFiniteError:
-                    # numerics halt (MXNET_NUMERICS=halt) is a verdict,
-                    # not a trace failure: propagate typed to the caller
-                    # — never degrade into per-batch steps that would
-                    # keep training on the poisoned carry
-                    wtrace.event("nonfinite_halt")
-                    wtrace.finish(status="nonfinite")
-                    raise
-                except Exception as e:  # trace failure: fall back for good
-                    self.logger.warning(
-                        "scanned train window disabled (%s: %s); falling "
-                        "back to per-batch steps%s",
-                        type(e).__name__, e,
-                        " — MXNET_SCAN_ACCUM gradient accumulation is "
-                        "LOST on the fallback path" if M > 1 else "")
-                    self._scan_disabled = True
-                    self._scan = None
-                    # NOTE: self._mesh stays set — it records that the
-                    # mesh path engaged this fit (scenario evidence);
-                    # _scan_disabled prevents re-entry
-                finally:
-                    _telemetry.trace.set_current(None)
-            if outs is not False:
-                # prefetch: collect the next window while this scan is
-                # still in flight on device (dispatch was async)
+        try:
+            while True:
+                batches, tail, staged = pending
+                is_window = (staged is not None) if feed is not None \
+                    else (len(batches) == W)
+                outs = False
+                wtrace = _telemetry.trace.NULL_TRACE
+                if is_window and not self._scan_disabled:
+                    # the SIGKILL-mid-scan-window scenario arms a kill
+                    # here: deterministically between the last boundary's
+                    # host control and the next window's dispatch
+                    from .chaos.failpoints import failpoint as _chaos_fp
+                    _chaos_fp("train/scan_window")
+                    # window trace (ISSUE 12): collect -> stage ->
+                    # [rendezvous, recorded by the multi-host step via the
+                    # ambient trace] -> dispatch -> boundary_flush
+                    wtrace = _telemetry.trace.start("train", "fit/window")
+                    wtrace.add_stage(
+                        "collect", *(state.get("collect")
+                                     or (wtrace.t0, wtrace.t0)))
+                    if staged is not None:
+                        # the window feed already collected AND staged
+                        # this super-batch off-thread — zero train-thread
+                        # staging time (that is the point)
+                        sbatch = staged
+                    else:
+                        with timeline.lane("h2d_stage"), \
+                                wtrace.stage("stage"):
+                            sbatch = mx_io.stage_super_batch(
+                                batches, ctx, host=stage_host)
+                    _telemetry.trace.set_current(wtrace)
+                    try:
+                        with timeline.lane("step_dispatch"), \
+                                wtrace.stage("dispatch"):
+                            outs = self._run_scan_window(sbatch, plan)
+                    except (PeerLostError, PreemptionError) as e:
+                        # elastic events are NOT trace failures: a lost
+                        # peer or a preemption notice must reach the
+                        # elastic session (boundary checkpoint +
+                        # survivor-mesh restore), never degrade into
+                        # per-batch steps
+                        wtrace.event("elastic_fault",
+                                     cause=type(e).__name__)
+                        wtrace.finish(status="elastic_fault")
+                        raise
+                    except NonFiniteError:
+                        # numerics halt (MXNET_NUMERICS=halt) is a
+                        # verdict, not a trace failure: propagate typed to
+                        # the caller — never degrade into per-batch steps
+                        # that would keep training on the poisoned carry
+                        wtrace.event("nonfinite_halt")
+                        wtrace.finish(status="nonfinite")
+                        raise
+                    except Exception as e:  # trace failure: fall back
+                        self.logger.warning(
+                            "scanned train window disabled (%s: %s); "
+                            "falling back to per-batch steps%s",
+                            type(e).__name__, e,
+                            " — MXNET_SCAN_ACCUM gradient accumulation "
+                            "is LOST on the fallback path" if M > 1
+                            else "")
+                        self._scan_disabled = True
+                        self._scan = None
+                        # NOTE: self._mesh stays set — it records that
+                        # the mesh path engaged this fit (scenario
+                        # evidence); _scan_disabled prevents re-entry
+                    finally:
+                        _telemetry.trace.set_current(None)
+                if outs is not False:
+                    # prefetch: collect the next window while this scan
+                    # is still in flight on device (dispatch was async)
+                    pending = collect()
+                    # window boundary: the only host-control point —
+                    # metric updates (stacked, one sync), batch
+                    # callbacks, timeline, watchdog beat
+                    with wtrace.stage("boundary_flush"):
+                        self._window_update_metrics(eval_metric, sbatch,
+                                                    outs)
+                        if batch_end_callback is not None:
+                            for j in range(W):
+                                batch_end_params = BatchEndParam(
+                                    epoch=epoch, nbatch=nbatch + j,
+                                    eval_metric=eval_metric,
+                                    locals=locals())
+                                for callback in \
+                                        _as_list(batch_end_callback):
+                                    callback(batch_end_params)
+                    wtrace.finish()
+                    nbatch += W
+                    timeline.end_step(steps=W)
+                    wdog.beat("train/fit")
+                    continue
+                wtrace.finish(status="fallback")
+                for b in batches:
+                    per_batch(b)
+                for b in tail:
+                    per_batch(b)
+                if state["exhausted"]:
+                    break
                 pending = collect()
-                # window boundary: the only host-control point — metric
-                # updates (stacked, one sync), batch callbacks,
-                # timeline, watchdog beat
-                with wtrace.stage("boundary_flush"):
-                    self._window_update_metrics(eval_metric, sbatch, outs)
-                    if batch_end_callback is not None:
-                        for j in range(W):
-                            batch_end_params = BatchEndParam(
-                                epoch=epoch, nbatch=nbatch + j,
-                                eval_metric=eval_metric, locals=locals())
-                            for callback in _as_list(batch_end_callback):
-                                callback(batch_end_params)
-                wtrace.finish()
-                nbatch += W
-                timeline.end_step(steps=W)
-                wdog.beat("train/fit")
-                continue
-            wtrace.finish(status="fallback")
-            for b in batches:
-                per_batch(b)
-            for b in tail:
-                per_batch(b)
-            if state["exhausted"]:
-                break
-            pending = collect()
+        finally:
+            if feed is not None:
+                feed.close()
         return nbatch
 
     def score(self, eval_data, eval_metric, num_batch=None,

@@ -637,12 +637,18 @@ class MeshFusedTrainStep(ScanTrainStep):
         """Dispatch one K-step (x M micro-batch) window across the mesh.
         Same contract as ScanTrainStep.run_window: returns the flattened
         per-position output buffers (leading dim K*M) for the boundary
-        metric flush, or False when the stacked shapes don't match."""
+        metric flush, or False when the window is short or the stacked
+        shapes don't match.  ``sbatch`` arrays are host numpy stacks
+        (the fit loop stages mesh windows with ``host=True`` — one
+        batch-sharded ``put_batch`` placement below instead of a full
+        device_put here and a re-place there)."""
         from ..chaos.failpoints import failpoint as _failpoint
         module = self._module
         exec_ = module._exec
         K, M = self.scan_steps, self.accum
         W = K * M
+        if sbatch.count != W:
+            return False
         feed = {}
         for desc, arr in zip(module._data_shapes, sbatch.data):
             feed[desc.name] = arr

@@ -81,6 +81,25 @@ _IO_STAGE = REGISTRY.histogram(
     "host time spent staging a DataBatch host->device (io.stage_batch)")
 _IO_STAGE_BYTES = REGISTRY.counter(
     "mxnet_io_stage_bytes_total", "bytes staged host->device by io")
+_DATA_WAIT = REGISTRY.histogram(
+    "mxnet_data_wait_seconds",
+    "train-thread time blocked waiting on the streaming data plane "
+    "(io_pipeline assembler/window feed); the data_wait step lane's "
+    "registry twin — rising _sum rate means training is data-bound "
+    "(docs/data.md runbook)")
+_DATA_QUEUE_DEPTH = REGISTRY.gauge(
+    "mxnet_data_queue_depth",
+    "batches currently buffered in the streaming data plane "
+    "(io_pipeline shard queues + window feed), by pipeline role")
+_DATA_BATCHES = REGISTRY.counter(
+    "mxnet_data_batches_total",
+    "batches produced by streaming-data-plane reader workers "
+    "(reader throughput; rate vs the fit loop's step rate says "
+    "whether the readers keep up)")
+_DATA_REBALANCE = REGISTRY.counter(
+    "mxnet_data_rebalance_total",
+    "shard rebalances after a reader worker died mid-epoch "
+    "(remaining shards were requeued onto the survivors)")
 _SCAN_WINDOW = REGISTRY.gauge(
     "mxnet_scan_window_steps",
     "train steps per scanned fit-window dispatch (MXNET_SCAN_STEPS; "
@@ -134,6 +153,28 @@ def record_io_stage(seconds, nbytes=0):
 def record_scan_window(steps):
     """Record the active scanned-window size (Module._fit_epoch_scan)."""
     _SCAN_WINDOW.set(int(steps))
+
+
+def record_data_wait(seconds):
+    """Account one blocking wait on the streaming data plane (the
+    consumer side: assembler ``next()`` or window-feed ``get()``)."""
+    _DATA_WAIT.observe(seconds)
+
+
+def record_data_batches(n=1):
+    """Account batches produced by reader workers (throughput)."""
+    _DATA_BATCHES.inc(int(n))
+
+
+def record_data_queue_depth(depth, role="shards"):
+    """Publish the current buffered-batch count for one pipeline role
+    (``shards`` = reader output queues, ``feed`` = staged windows)."""
+    _DATA_QUEUE_DEPTH.set(float(depth), labels={"role": role})
+
+
+def record_data_rebalance(n=1):
+    """Account one dead-reader shard rebalance."""
+    _DATA_REBALANCE.inc(int(n))
 
 
 # -- checkpoint manager registration (weak: managers come and go) ------------

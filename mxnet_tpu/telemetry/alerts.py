@@ -275,6 +275,18 @@ def _register_default_probes():
         return out
     register_probe("mxnet_fleet_snapshot_age_seconds", snapshot_age_probe)
 
+    def data_queue_depth_probe():
+        # pull, never import: a process with no streaming data plane
+        # has no rows.  Live pipelines answer only while they make
+        # progress — a wedged assembler lets the family go ABSENT, so
+        # an absence rule on mxnet_data_queue_depth fires while the
+        # train/fit watchdog walks up to its page (docs/data.md)
+        mod = sys.modules.get("mxnet_tpu.io_pipeline")
+        if mod is None:
+            return []
+        return mod.queue_depth_samples()
+    register_probe("mxnet_data_queue_depth", data_queue_depth_probe)
+
 
 def _read_family(family):
     probe = _PROBES.get(family)
@@ -389,6 +401,16 @@ def default_rules():
                 "converging — divergence judged before it reaches "
                 "non-finite; tune the bound per model via "
                 "MXNET_ALERT_RULES"),
+        AlertRule(
+            "data_starved", "mxnet_data_wait_seconds_sum",
+            kind="rate", op=">", value=0.3, window_s=30.0, for_s=10.0,
+            cooldown_s=120.0, severity="warn",
+            doc="the train thread is spending a sustained > 30% of "
+                "wall time blocked on the input pipeline (data_wait "
+                "seconds accruing at > 0.3 s/s over the lookback): "
+                "training is data-bound — raise MXNET_DATA_WORKERS / "
+                "queue depth or shrink the decode (docs/data.md "
+                "'training is data-bound' runbook)"),
         AlertRule(
             "kernel_fallback", "mxnet_kernel_fallback_total",
             kind="rate", op=">", value=0.0, window_s=60.0, for_s=0.0,

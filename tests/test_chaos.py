@@ -560,6 +560,19 @@ def test_scenario_sigkill_mid_scan(tmp_path):
     assert r["diverged_params"] == []
 
 
+def test_scenario_reader_death_mid_epoch():
+    """ISSUE 19: one streaming-data-plane reader dies mid-epoch — the
+    survivors absorb its shards (exactly once, same seeded order, zero
+    stalls); ALL readers dying raises typed DataReaderError, no hang."""
+    r = harness.scenario_reader_death_mid_epoch()
+    assert r["ok"], json.dumps(r, default=str)
+    assert r["exactly_once"]
+    assert r["rebalances"] >= 1
+    assert r["slow_reader_order_ok"]
+    assert r["all_dead_outcome"] == "typed" and not r["all_dead_hung"]
+    assert r["non_typed_failures"] == []
+
+
 @pytest.mark.slow
 def test_scenario_mesh_collective_stall(tmp_path):
     """ISSUE 9: the mesh fused step's collective boundary wedges (the
