@@ -1198,6 +1198,88 @@ def measure_multihost():
     }
 
 
+def measure_fleet():
+    """Relay-proof CPU phase for the fleet observability plane
+    (ISSUE 20): one subprocess runs ``python -m
+    mxnet_tpu.telemetry.fleet_sim --ranks 1000 --json`` — 1000
+    in-process synthetic reporters (delta pushes, scripted anomalies)
+    against one real leader on a virtual clock, with an internal
+    rank=100 reference run for the sublinearity ratio and the rank<=8
+    byte-compat pin.
+
+    * ``fleet_merge_p99_ms``   — gate < 1: per-push leader merge p99.
+    * ``fleet_rollup_cpu_ms``  — gate < 50: summary rollup at scrape.
+    * ``fleet_scrape_kib``     — gate < 256: summary /fleet.json bytes.
+    * ``fleet_sublinearity``   — gate <= 3x: rank=1000 merge p99 over
+      the rank=100 reference.
+    """
+    import subprocess
+
+    from mxnet_tpu import config as mxcfg
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # never touch the TPU relay
+    proc = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.telemetry.fleet_sim",
+         "--ranks", str(mxcfg.get("MXNET_FLEET_SIM_RANKS")),
+         "--cycles", str(mxcfg.get("MXNET_FLEET_SIM_CYCLES")),
+         "--json"],
+        env=env, capture_output=True, text=True, timeout=600,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+    if proc.returncode != 0 and not proc.stdout.strip():
+        raise RuntimeError(f"fleet sim child failed: "
+                           f"{proc.stderr.strip()[-800:]}")
+    payload = json.loads(proc.stdout.strip().splitlines()[-1])
+    res, gates = payload["result"], payload["gates"]
+    sub = gates.get("sublinear_vs_ref", {})
+    return {
+        "fleet_merge": {
+            "metric": "fleet_merge_p99_ms",
+            "value": round(res["merge"]["p99_ms"], 4),
+            "budget_ms": gates["merge_p99_ms"]["limit"],
+            "gate_pass": bool(gates["merge_p99_ms"]["ok"]),
+            "pushes": res["merge"]["pushes"],
+            "delta_pushes": res["merge"]["delta"],
+            "resyncs": res["merge"]["resync"],
+            "note": "per-push leader merge latency p99 at rank="
+                    f"{res['ranks']} (delta upsert into the sharded "
+                    "FleetStore; virtual clock, pure host CPU)",
+        },
+        "fleet_rollup": {
+            "metric": "fleet_rollup_cpu_ms",
+            "value": round(res["rollup"]["max_ms"], 3),
+            "budget_ms": gates["rollup_ms"]["limit"],
+            "gate_pass": bool(gates["rollup_ms"]["ok"]),
+            "p50_ms": round(res["rollup"]["p50_ms"], 3),
+            "note": "summary rollup cost at scrape time, worst cycle "
+                    "(bounded-staleness cache + incremental family "
+                    "catalog; O(families + anomalous ranks))",
+        },
+        "fleet_scrape": {
+            "metric": "fleet_scrape_kib",
+            "value": round(res["scrape"]["summary_kib"], 2),
+            "budget_kib": gates["scrape_kib"]["limit"],
+            "gate_pass": bool(gates["scrape_kib"]["ok"]),
+            "note": "summary-mode /fleet.json bytes at rank="
+                    f"{res['ranks']} (per-rank detail stays behind "
+                    "?detail=rank)",
+        },
+        "fleet_sublinear": {
+            "metric": "fleet_sublinearity",
+            "value": round(sub.get("value", 0.0), 3),
+            "budget_x": sub.get("limit"),
+            "gate_pass": bool(sub.get("ok", False)),
+            "ref_ranks": sub.get("ref_ranks"),
+            "backcompat_identical": bool(
+                payload["backcompat"]["identical"]),
+            "alert_lag_intervals": res["alerts"]["lag_intervals"],
+            "note": "rank=1000 merge p99 over the rank=100 reference "
+                    "run (plus the rank<=8 byte-compat pin and the "
+                    "breach->leader alert propagation lag)",
+        },
+    }
+
+
 def measure_train_dispatch():
     """CPU-measurable perf signal for the fused train step (no TPU relay
     needed, unlike resnet50_train_img_per_sec which has been
@@ -1895,6 +1977,29 @@ def main():
                 log(f"multihost phase failed: {type(e).__name__}: {e}")
                 result["multihost_dispatch"] = {
                     "metric": "multihost_dispatches_per_step",
+                    "error": f"{type(e).__name__}: {e}"}
+
+        if _cfg0.get("BENCH_FLEET"):
+            try:
+                result.update(measure_fleet())
+                fm, fr, fs, fx = (result["fleet_merge"],
+                                  result["fleet_rollup"],
+                                  result["fleet_scrape"],
+                                  result["fleet_sublinear"])
+                log(f"[fleet] merge p99 {fm['value']}ms (budget "
+                    f"{fm['budget_ms']}ms, "
+                    f"{'PASS' if fm['gate_pass'] else 'FAIL'}); rollup "
+                    f"{fr['value']}ms (budget {fr['budget_ms']}ms, "
+                    f"{'PASS' if fr['gate_pass'] else 'FAIL'}); scrape "
+                    f"{fs['value']}KiB (budget {fs['budget_kib']}KiB, "
+                    f"{'PASS' if fs['gate_pass'] else 'FAIL'}); "
+                    f"sublinear {fx['value']}x vs rank="
+                    f"{fx['ref_ranks']} (bar {fx['budget_x']}x, "
+                    f"{'PASS' if fx['gate_pass'] else 'FAIL'})")
+            except Exception as e:
+                log(f"fleet phase failed: {type(e).__name__}: {e}")
+                result["fleet_merge"] = {
+                    "metric": "fleet_merge_p99_ms",
                     "error": f"{type(e).__name__}: {e}"}
 
         if _cfg0.get("BENCH_COLD_START"):

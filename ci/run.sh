@@ -100,6 +100,28 @@ echo "== telemetry smoke (fit + serving burst, exporter scraped, watchdog silent
 # and the hang watchdog must not fire (docs/observability.md)
 JAX_PLATFORMS=cpu python -m mxnet_tpu.telemetry.smoke
 
+echo "== fleet smoke (256-rank simulator, delta plane gates, backcompat pin) =="
+# the in-process fleet simulator at a CI-bounded scale: 256 synthetic
+# delta-push reporters against one real leader on a virtual clock —
+# merge p99 < 1ms, summary rollup < 50ms, summary scrape < 256KiB,
+# breach->leader alert lag < 2 push intervals, zero leader exceptions,
+# and the rank<=8 detail view byte-identical to the pre-delta merge
+# path (docs/observability.md "fleet at scale"); must finish well
+# inside 20s on plain host CPU
+JAX_PLATFORMS=cpu timeout -k 5 120 \
+  python -m mxnet_tpu.telemetry.fleet_sim --ranks 256 --cycles 25 \
+    --reference-ranks 0 --json > /tmp/fleet_smoke.json
+python - <<'PYEOF'
+import json
+rep = json.load(open("/tmp/fleet_smoke.json"))
+assert rep["ok"], {k: v for k, v in rep["gates"].items() if not v["ok"]}
+assert rep["wall_s"] < 20.0, f"fleet smoke too slow: {rep['wall_s']:.1f}s"
+print(f"fleet smoke: 256 ranks in {rep['wall_s']:.1f}s, "
+      f"merge p99 {rep['result']['merge']['p99_ms']:.3f}ms, "
+      f"rollup max {rep['result']['rollup']['max_ms']:.1f}ms, "
+      f"scrape {rep['result']['scrape']['summary_kib']:.1f}KiB")
+PYEOF
+
 echo "== compile smoke (persistent cache, ladder warmup, retrace ratchet) =="
 # publish -> AOT-warm the bucket ladder -> mixed-size burst: the workload
 # must trace exactly ladder-size times and compile NOTHING post-warmup;

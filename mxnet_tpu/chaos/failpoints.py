@@ -125,6 +125,12 @@ SITES = {
     "kvstore/server/heartbeat":
         "KVServer, on receipt of each worker heartbeat (raise drops the "
         "connection, so the worker reads as dead)",
+    "fleet/push":
+        "fleet telemetry push path (FleetReporter.push_now and the "
+        "fleet simulator's synthetic ranks), after delta encoding, "
+        "before the push reaches the leader (raise = the push is "
+        "dropped and the rank's snapshot ages; delay = the push "
+        "arrives late — the rollup_under_churn scenario)",
     "io/stage":
         "io.stage_batch / stage_super_batch, before the host->device put",
     "io/reader/read":

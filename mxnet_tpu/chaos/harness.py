@@ -1338,6 +1338,57 @@ def scenario_reader_death_mid_epoch(workers=4, shards=16,
     return result
 
 
+def scenario_rollup_under_churn(ranks=64, cycles=24):
+    """[fleet/push] The fleet telemetry plane under membership churn on
+    a lossy push path (ISSUE 20): ``ranks`` in-process synthetic
+    reporters drive ONE real leader (KVServer + FleetStore + summary
+    rollup, virtual clock) while 10% of pushes are chaos-dropped at the
+    ``fleet/push`` site, 8 ranks die mid-run and 8 join late.
+
+    Gates: the leader loop takes ZERO exceptions (a dropped delta must
+    resolve via resync, never a merge error); the rollup stays bounded
+    (a scrape never blocks the push path); every dead rank is tagged
+    lost/stale in the summary within the peer timeout; the dropped
+    pushes are actually counted (the arm fired, not a no-op run)."""
+    from ..telemetry import fleet_sim
+
+    result = {"ok": False, "ranks": ranks, "cycles": cycles}
+    # dying/joining ranks live at the top of the rank space so the
+    # simulator's scripted anomaly ranks (low) stay out of the churn
+    churn = {"die": list(range(ranks - 16, ranks - 8)),
+             "die_at": cycles // 2,
+             "join": list(range(ranks - 8, ranks)),
+             "join_at": cycles // 4}
+    chaos.arm("fleet/push", "raise", prob=0.1, count=None)
+    try:
+        r = fleet_sim.run_sim(ranks=ranks, cycles=cycles,
+                              interval_s=5.0, seed=7, delta=True,
+                              churn=churn, alloc_window=0)
+    finally:
+        chaos.reset()
+    peers = r["final_summary"]["peers"] or {}
+    anomalous = set(r["final_summary"]["anomalous"] or ())
+    dead_tagged = all(str(rank) in anomalous for rank in churn["die"])
+    result.update({
+        "leader_exceptions": r["leader_exceptions"],
+        "dropped_pushes": r["merge"]["dropped"],
+        "resyncs": r["merge"]["resync"],
+        "merge_p99_ms": round(r["merge"]["p99_ms"], 3),
+        "rollup_max_ms": round(r["rollup"]["max_ms"], 2),
+        "peers": peers,
+        "dead_ranks_tagged": dead_tagged,
+        "silent_rank_state": r["alerts"]["silent_rank_state"],
+    })
+    result["ok"] = bool(
+        not r["leader_exceptions"]
+        and r["merge"]["dropped"] > 0
+        and dead_tagged
+        and r["alerts"]["silent_rank_state"] in ("lost", "stale")
+        and peers.get("alive", 0) >= ranks - 16 - 1
+        and r["rollup"]["max_ms"] < 250.0)
+    return result
+
+
 def run_all(workdir=None, verbose=True):
     """Run the composed scenarios sequentially; returns
     {name: result dict}.  The smoke asserts every ``ok``."""
@@ -1360,6 +1411,7 @@ def run_all(workdir=None, verbose=True):
          lambda: scenario_mesh_collective_stall(os.path.join(base, "s5"))),
         ("peer_loss_mid_window",
          lambda: scenario_peer_loss_mid_window(os.path.join(base, "s7"))),
+        ("rollup_under_churn", scenario_rollup_under_churn),
     ]
     for name, fn in scenarios:
         t0 = time.perf_counter()

@@ -433,6 +433,30 @@ _register("MXNET_FLEET_INTERVAL_S", float, 0.0,
           "(/fleet.json, rank-labelled Prometheus families; dead ranks "
           "keep their last snapshot tagged state=lost); 0 disables the "
           "reporter (the elastic launcher arms it for its workers)")
+_register("MXNET_FLEET_DELTA", bool, True,
+          "delta-encode fleet telemetry pushes against the last "
+          "server-acked snapshot (unchanged families cost ~0 wire "
+          "bytes and ~0 leader merge work; a forgotten baseline "
+          "resyncs with one full push); 0 forces every push to carry "
+          "the full family snapshot")
+_register("MXNET_FLEET_HISTORY", int, 8,
+          "elastic world generations of per-rank telemetry the fleet "
+          "leader retains and serves in /fleet.json?detail=rank; older "
+          "generations are pruned (an absence-safe 'history' "
+          "truncation marker appears in the detail view once pruning "
+          "happened) so a long-lived leader's scrape size plateaus")
+_register("MXNET_FLEET_SIM_RANKS", int, 1000,
+          "default synthetic rank count for the in-process fleet "
+          "simulator (python -m mxnet_tpu.telemetry.fleet_sim); the "
+          "--ranks flag overrides")
+_register("MXNET_FLEET_SIM_CYCLES", int, 50,
+          "default push cycles per fleet-simulator run (virtualized "
+          "time: one cycle = one push interval); the --cycles flag "
+          "overrides")
+_register("MXNET_FLEET_SIM_SEED", int, 0,
+          "base seed for the fleet simulator's per-rank metric-family "
+          "generators and anomaly schedule (same seed, same fleet); "
+          "the --seed flag overrides")
 # -- compilation lifecycle ---------------------------------------------------
 _register("MXNET_COMPILE_CACHE", bool, True,
           "persistent XLA compilation artifacts: serving executor-cache "
@@ -642,6 +666,12 @@ _register("BENCH_KERNELS", bool, True,
           "bench.py: measure the kernel_tuner phases (tuner overhead "
           "seconds + reference-vs-kernel CPU trace counts, relay-proof); "
           "device kernel-latency phases ship relay-armed")
+_register("BENCH_FLEET", bool, True,
+          "bench.py: run the fleet-scale observability simulator "
+          "(telemetry.fleet_sim) at rank=100 and rank=1000 in "
+          "subprocesses and gate merge p99 / rollup CPU / summary "
+          "scrape size / alert lag / sublinearity (relay-proof, pure "
+          "host CPU)")
 _register("BENCH_DISPATCH", bool, True,
           "bench.py: measure fused-train-step dispatch phases on the CPU "
           "backend (resnet50_step_dispatches / train_step_ms_bs32); "

@@ -95,8 +95,12 @@ class KVServer:
     """The server process main loop (parity: KVStoreDistServer)."""
 
     def __init__(self, port=9091, num_workers=1, bind_addr=None,
-                 auth_token=None, peer_timeout_s=None):
+                 auth_token=None, peer_timeout_s=None, clock=None):
         self.port = port
+        # liveness/telemetry clock hook: the fleet simulator injects a
+        # virtual clock so 1000-rank aging scenarios run in-process in
+        # seconds; production always uses time.monotonic
+        self._clock = clock if clock is not None else time.monotonic
         # explicit dead-peer threshold override (the elastic launcher's
         # control plane runs tighter than the training-store default)
         self.peer_timeout_s = peer_timeout_s
@@ -132,12 +136,13 @@ class KVServer:
         # dict (under _lock) carries which ranks for the typed reply.
         self._dead = {}           # rank -> monotonic time marked lost
         self._dead_event = threading.Event()
-        self._start_time = time.monotonic()
-        # cross-rank telemetry aggregation (ISSUE 12): latest registry
-        # payload per (generation, rank); a lost rank's last snapshot is
-        # retained so the fleet merge can tag it instead of dropping it
+        self._start_time = self._clock()
+        # cross-rank telemetry aggregation (ISSUE 12 / sharded since
+        # ISSUE 20): per-(generation, rank) payloads live in a lazily
+        # created telemetry.fleet.FleetStore (incremental delta upserts,
+        # capped generation history, summary rollup aggregates)
         self._generation = 0
-        self._telemetry = {}      # generation -> {rank: {payload, mono}}
+        self._fleet_store = None  # telemetry.fleet.FleetStore, lazy
         # port=0 binds an OS-assigned port (port-collision-safe tests /
         # supervisor-owned control planes); bound_port is readable after
         # the started event sets
@@ -196,7 +201,7 @@ class KVServer:
         from a rank that never heartbeated means heartbeating is off."""
         while not self._stop.wait(0.1):
             timeout = self._peer_timeout()
-            now = time.monotonic()
+            now = self._clock()
             newly_dead = False
             with self._lock:
                 for rank, last in self._heartbeats.items():
@@ -228,7 +233,7 @@ class KVServer:
 
     def _peer_states(self):
         timeout = self._peer_timeout()
-        now = time.monotonic()
+        now = self._clock()
         with self._lock:
             out = {}
             for rank in range(self.num_workers):
@@ -248,24 +253,44 @@ class KVServer:
         """Re-arm the liveness layer for a new elastic world generation
         (the launcher calls this between respawns): new worker count,
         forgotten heartbeats/progress/dead marks, fresh barrier.
-        Telemetry payloads are generation-keyed and KEPT — the fleet
-        history must show every generation's ranks, lost ones tagged."""
+        Telemetry payloads are generation-keyed and KEPT (up to the
+        MXNET_FLEET_HISTORY cap — a runaway restart loop must not grow
+        the server without bound) — the fleet history must show every
+        retained generation's ranks, lost ones tagged."""
         with self._lock:
             self.num_workers = int(num_workers)
             self._heartbeats.clear()
             self._progress.clear()
             self._dead.clear()
-            self._start_time = time.monotonic()
+            self._start_time = self._clock()
             self._generation = (self._generation + 1 if generation is None
                                 else int(generation))
-            # bound the history (a runaway restart loop must not grow
-            # the server without bound; 16 generations tell any story)
-            for gen in sorted(self._telemetry)[:-16]:
-                del self._telemetry[gen]
+            generation = self._generation
+        self.fleet_store().set_generation(generation)
         self._dead_event.clear()
         with self._barrier_cv:
             self._barrier_count = 0
             self._barrier_cv.notify_all()
+
+    def fleet_store(self):
+        """The server's sharded telemetry store (ISSUE 20), created
+        lazily so a kvstore without fleet traffic never pays for it."""
+        from .telemetry.fleet import FleetStore
+        with self._lock:
+            if self._fleet_store is None:
+                self._fleet_store = FleetStore(
+                    clock=self._clock, generation=self._generation)
+            return self._fleet_store
+
+    def apply_telemetry_push(self, rank, payload):
+        """The ``telemetry_push`` op body: decode a full/delta payload
+        into the fleet store.  A real method (not inlined in _handle)
+        so the in-process fleet simulator drives the exact production
+        merge path without a socket per synthetic rank."""
+        with self._lock:
+            generation = self._generation
+        return self.fleet_store().apply_push(
+            generation, int(rank), payload or {})
 
     def _peer_lost_reply(self):
         return {"ok": False, "error_type": "PeerLostError",
@@ -415,7 +440,7 @@ class KVServer:
                         "chaos: dropping heartbeat connection (%s)", e)
                     break
                 with self._lock:
-                    self._heartbeats[int(msg["rank"])] = time.monotonic()
+                    self._heartbeats[int(msg["rank"])] = self._clock()
                     if "step" in msg:
                         self._progress[int(msg["rank"])] = int(msg["step"])
                 _send_msg(conn, {"ok": True}, self.auth_token)
@@ -427,12 +452,9 @@ class KVServer:
                 _send_msg(conn, {"ok": True, "value": self._peer_states()},
                           self.auth_token)
             elif op == "telemetry_push":
-                with self._lock:
-                    self._telemetry.setdefault(
-                        self._generation, {})[int(msg["rank"])] = {
-                        "payload": msg.get("payload") or {},
-                        "mono": time.monotonic()}
-                _send_msg(conn, {"ok": True}, self.auth_token)
+                resp = self.apply_telemetry_push(
+                    msg["rank"], msg.get("payload"))
+                _send_msg(conn, resp, self.auth_token)
             elif op == "fleet":
                 from .telemetry import fleet as _fleet
                 _send_msg(conn, {"ok": True,
@@ -440,7 +462,7 @@ class KVServer:
                           self.auth_token)
             elif op == "num_dead_node":
                 timeout = float(msg.get("timeout", 60))
-                now = time.monotonic()
+                now = self._clock()
                 from .config import get as _cfg
                 hb_enabled = _cfg("MXNET_KVSTORE_HEARTBEAT_INTERVAL") > 0
                 with self._lock:
@@ -655,10 +677,13 @@ class KVClient:
                    "step": int(step)})
 
     def push_telemetry(self, payload):
-        """Push this rank's registry snapshot for the leader's fleet
-        merge (telemetry.fleet; payload must be pickle/JSON-native)."""
-        self._rpc({"op": "telemetry_push", "rank": self.rank,
-                   "payload": payload})
+        """Push this rank's registry snapshot (full or delta-encoded)
+        for the leader's fleet merge (telemetry.fleet; payload must be
+        pickle/JSON-native).  Returns the server reply — ``acked`` (the
+        committed delta baseline) or ``resync`` (baseline forgotten:
+        the reporter answers with one full push)."""
+        return self._rpc({"op": "telemetry_push", "rank": self.rank,
+                          "payload": payload})
 
     def fleet_state(self):
         """The server's merged fleet snapshot (one bounded RPC)."""

@@ -264,7 +264,9 @@ class ElasticLauncher:
         # snapshot, so /fleet.json on this process serves the merged
         # cross-rank view (lost ranks tagged, per-generation history)
         from ..telemetry import fleet as _fleet
-        _fleet.set_provider(lambda: _fleet.merge_server(self.server))
+        _fleet.set_provider(
+            lambda detail=None: _fleet.merge_server(self.server,
+                                                    detail=detail))
         # postmortem harvest: each generation's workers dump their
         # flight rings (chaos-kill/typed-fatal/SIGTERM) + watchdog
         # files into gen<N>/; after a fault the launcher folds them +
@@ -428,7 +430,9 @@ class ElasticLauncher:
             except (OSError, ValueError) as e:
                 log.warning("postmortem: unreadable %s (%s)", path, e)
         try:
-            fleet_snap = _fleet.merge_server(self.server)
+            # postmortems always want the full per-rank view,
+            # whatever the world size's auto scrape mode is
+            fleet_snap = _fleet.merge_server(self.server, detail="rank")
         except Exception as e:  # noqa: BLE001 — a half-dead control plane must not block the bundle
             fleet_snap = {"error": f"{type(e).__name__}: {e}"}
         anomaly = _flight.first_anomaly(rings.values())

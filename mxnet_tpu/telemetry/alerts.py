@@ -267,8 +267,22 @@ def _register_default_probes():
         fn = fleet.provider()
         if fn is None:
             return []
+        snap = fn() or {}
         out = []
-        for rank, v in (fn() or {}).get("ranks", {}).items():
+        if snap.get("mode") == "summary":
+            # summary-mode leader (world > DETAIL_AUTO_RANKS): the
+            # scrape carries the fleet-wide max age + per-rank ages for
+            # anomalous ranks only — exactly what a reduce=max
+            # staleness rule needs, without the O(ranks) row fan-out
+            age = snap.get("snapshot_age_max_s")
+            if isinstance(age, (int, float)):
+                out.append(({}, float(age)))
+            for rank, v in (snap.get("anomalous") or {}).items():
+                age = v.get("snapshot_age_s")
+                if isinstance(age, (int, float)):
+                    out.append(({"rank": str(rank)}, float(age)))
+            return out
+        for rank, v in snap.get("ranks", {}).items():
             age = v.get("snapshot_age_s")
             if isinstance(age, (int, float)):
                 out.append(({"rank": str(rank)}, float(age)))
@@ -376,6 +390,16 @@ def default_rules():
             cooldown_s=60.0, severity="warn", reduce="max",
             doc="a fleet rank's last telemetry push is stale: its "
                 "reporter wedged or the rank is dying quietly"),
+        AlertRule(
+            "fleet_merge_slow", "mxnet_fleet_merge_seconds_sum",
+            kind="rate", op=">", value=0.05, window_s=30.0, for_s=10.0,
+            cooldown_s=120.0, severity="warn",
+            doc="the fleet leader is spending a sustained > 5% of wall "
+                "time merging telemetry pushes (merge seconds accruing "
+                "at > 0.05 s/s over the lookback): delta encoding is "
+                "off/ineffective or the store is degenerating to full "
+                "re-merges — docs/observability.md 'the leader is hot' "
+                "runbook"),
         AlertRule(
             "nonfinite_window", "mxnet_numerics_nonfinite_windows_total",
             kind="rate", op=">", value=0.0, window_s=60.0, for_s=0.0,

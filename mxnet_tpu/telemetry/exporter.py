@@ -44,7 +44,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):  # noqa: N802 — http.server API
         from . import prometheus_dump, snapshot
-        path = self.path.split("?", 1)[0]
+        path, _, query = self.path.partition("?")
         if path in ("/metrics", "/metrics/"):
             body = prometheus_dump().encode("utf-8")
             ctype = "text/plain; version=0.0.4; charset=utf-8"
@@ -54,7 +54,15 @@ class _Handler(BaseHTTPRequestHandler):
             ctype = "application/json"
         elif path in ("/fleet.json", "/fleet"):
             from . import fleet
-            body = json.dumps(fleet.fleet_json(), default=str,
+            # ?detail=rank -> the full per-rank/per-generation view;
+            # ?detail=summary -> the O(families + anomalous) rollup;
+            # unset -> auto by world size (docs/observability.md)
+            detail = None
+            for part in query.split("&"):
+                if part.startswith("detail="):
+                    detail = part.split("=", 1)[1] or None
+            body = json.dumps(fleet.fleet_json(detail=detail),
+                              default=str,
                               sort_keys=True).encode("utf-8")
             ctype = "application/json"
         elif path in ("/alerts.json", "/alerts"):

@@ -1,5 +1,5 @@
 """Cross-rank telemetry aggregation: one fleet snapshot for N processes
-(ISSUE 12 tentpole).
+(ISSUE 12 tentpole; sharded/sublinear plane: ISSUE 20).
 
 PR 11 made training multi-process, but each rank still kept its own
 PR-5 registry — an operator (or the ROADMAP item-4 autoscaler) had to
@@ -12,34 +12,61 @@ already exists:
   flattened sample families (:meth:`MetricsRegistry.sample_families`)
   to the control-plane kvstore server on its OWN connection (a barrier
   blocking the main RPC socket must not stall telemetry), every
-  interval and once more at shutdown/fault;
+  interval and once more at shutdown/fault.  With ``MXNET_FLEET_DELTA``
+  (default on) pushes are **delta-encoded** against the last snapshot
+  the server acked (:class:`~.registry.SampleDeltaEncoder`): an
+  unchanged family costs ~0 wire bytes and ~0 merge work;
 * **server side** — the :class:`~mxnet_tpu.kvstore_server.KVServer`
-  stores the latest payload per ``(generation, rank)``;
-* **leader side** — :func:`merge_server` joins payloads with the
-  server's liveness layer into ONE fleet snapshot: per-rank families
-  with ``state`` / ``age_s`` / staleness marks.  A dead rank keeps its
-  last snapshot tagged ``state="lost"`` — never silently dropped — and
-  every generation's history is retained, so "what was rank 1 doing
-  when it died" reads off ``/fleet.json``.
+  delegates to a :class:`FleetStore`: a sharded, incrementally-upserted
+  per-``(generation, rank)`` store.  A push touches only its changed
+  families (merge cost O(changed), not O(ranks × families)) while
+  fleet-wide family aggregates and per-rule alert state VECTORS are
+  maintained in the same pass, so the rollup needs no per-rank scan;
+* **leader side** — :func:`merge_server` joins the store with the
+  server's liveness layer.  Two scrape contracts: ``detail="rank"``
+  (the pre-ISSUE-20 full view, byte-compatible: per-rank families,
+  per-generation history — automatic at world ≤ 8) and ``"summary"``
+  (automatic above 8 ranks): O(families + anomalous ranks) — peer
+  counts, the aggregated family catalog, the vectorized alert rollup
+  and ONLY the non-alive ranks, served from a bounded-staleness cache.
+  A dead rank keeps its last snapshot tagged ``state="lost"`` — never
+  silently dropped — and retained generations (capped by
+  ``MXNET_FLEET_HISTORY``, with an absence-safe truncation marker) keep
+  their per-rank families, so "what was rank 1 doing when it died"
+  still reads off ``/fleet.json?detail=rank``.
 
 Serving surfaces: the exporter's ``GET /fleet.json`` renders
 :func:`fleet_json` (the registered provider on the leader, a local
 single-rank view elsewhere), and the ``fleet`` telemetry collector
-re-emits every rank's counter/gauge samples into the Prometheus dump
-with a ``rank`` label plus ``mxnet_fleet_peers{state}`` /
-``mxnet_fleet_snapshot_age_seconds{rank}`` summary families — the data
-plane the ROADMAP item-4 autoscaler consumes.
+re-emits rank samples into the Prometheus dump (full rank-labelled
+re-emit in detail mode; summary families only at scale).  The plane
+watches itself: ``mxnet_fleet_merge_seconds`` /
+``mxnet_fleet_rollup_seconds`` / ``mxnet_fleet_push_bytes{mode}`` feed
+the ``fleet_merge_slow`` alert rule, and
+``mxnet_tpu.telemetry.fleet_sim`` replays the whole plane at 1000
+ranks in-process (docs/observability.md "fleet at scale").
 """
 from __future__ import annotations
 
 import logging
+import pickle
 import threading
 import time
 
 log = logging.getLogger("mxnet_tpu.telemetry.fleet")
 
 _provider_lock = threading.Lock()
-_provider = None   # zero-arg callable -> fleet snapshot dict (the leader)
+_provider = None   # callable -> fleet snapshot dict (the leader)
+
+# world size at or below which /fleet.json defaults to the full
+# (pre-ISSUE-20, byte-compatible) per-rank view; above it the summary
+# contract keeps the scrape O(families + anomalous ranks)
+DETAIL_AUTO_RANKS = 8
+
+# bounded staleness of the cached summary rollup: repeated scrapes
+# within this window re-serve the same aggregation (the store version
+# also invalidates it, so an idle fleet never recomputes at all)
+ROLLUP_STALENESS_S = 0.5
 
 
 def _registry():
@@ -54,13 +81,44 @@ def local_payload():
             "families": _registry().sample_families()}
 
 
+# -- self-observability (ISSUE 20 satellite) ----------------------------------
+def _merge_hist():
+    return _registry().histogram(
+        "mxnet_fleet_merge_seconds",
+        "leader-side cost of applying ONE rank's telemetry push into "
+        "the fleet store (O(changed families) with delta pushes)",
+        buckets=(1e-5, 5e-5, 1e-4, 5e-4, 1e-3, 5e-3, 2e-2, 1e-1, 1.0))
+
+
+def _rollup_hist():
+    return _registry().histogram(
+        "mxnet_fleet_rollup_seconds",
+        "leader-side cost of building one /fleet.json view (summary "
+        "views are cached for ROLLUP_STALENESS_S)",
+        buckets=(1e-4, 5e-4, 1e-3, 5e-3, 2e-2, 5e-2, 2e-1, 1.0, 5.0))
+
+
+def _push_bytes_counter():
+    return _registry().counter(
+        "mxnet_fleet_push_bytes",
+        "rank-side serialized telemetry push bytes by encoding mode "
+        "(delta pushes of an idle registry should be near zero)")
+
+
+def _push_failpoint():
+    from ..chaos.failpoints import failpoint
+    failpoint("fleet/push")
+
+
 # -- rank side ----------------------------------------------------------------
 class FleetReporter:
     """Daemon thread pushing this rank's registry snapshot to the
     control-plane server every ``interval_s``; ``push_now()`` forces a
-    final push on the fault/shutdown paths."""
+    final push on the fault/shutdown paths.  ``delta=None`` follows
+    ``MXNET_FLEET_DELTA``."""
 
-    def __init__(self, host, port, rank, world, interval_s, timeout=10.0):
+    def __init__(self, host, port, rank, world, interval_s, timeout=10.0,
+                 delta=None):
         self.rank = int(rank)
         self.interval_s = float(interval_s)
         self._stop = threading.Event()
@@ -68,6 +126,14 @@ class FleetReporter:
         self._host, self._port = host, int(port)
         self._world = int(world)
         self._timeout = float(timeout)
+        if delta is None:
+            from ..config import get as _cfg
+            delta = bool(_cfg("MXNET_FLEET_DELTA"))
+        if delta:
+            from .registry import SampleDeltaEncoder
+            self._encoder = SampleDeltaEncoder()
+        else:
+            self._encoder = None
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="mx-fleet-reporter")
         self._thread.start()
@@ -97,7 +163,29 @@ class FleetReporter:
     def push_now(self):
         """One synchronous push (used by the loop and the fault path)."""
         client = self._ensure_client()
-        client.push_telemetry(local_payload())
+        payload = local_payload()
+        if self._encoder is not None:
+            payload = self._encoder.encode(payload)
+        _push_failpoint()
+        resp = client.push_telemetry(payload) or {}
+        if self._encoder is not None and resp.get("resync"):
+            # the server forgot this rank's baseline (restart, lost
+            # ack, generation bump): exactly ONE full push resyncs
+            self._encoder.reset()
+            payload = self._encoder.encode(local_payload())
+            resp = client.push_telemetry(payload) or {}
+        if self._encoder is not None and resp.get("acked") is not None:
+            self._encoder.ack(resp["acked"])
+        self._record_push(payload)
+
+    def _record_push(self, payload):
+        try:
+            mode = "delta" if "delta" in payload else "full"
+            _push_bytes_counter().inc(
+                len(pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)),
+                labels={"mode": mode})
+        except Exception as e:  # noqa: BLE001 — accounting must not fail the push path
+            log.debug("fleet push accounting failed: %s", e)
 
     def stop(self, final_push=True):
         self._stop.set()
@@ -113,10 +201,328 @@ class FleetReporter:
                 pass
 
 
+# -- server side: the sharded incremental store -------------------------------
+def _fam_stats(fam):
+    """(sample count, numeric value sum) of one sample family — the
+    per-family contribution to the fleet-wide aggregate catalog."""
+    n = 0
+    total = 0.0
+    for sample in fam.get("values", ()):
+        n += 1
+        v = sample.get("value")
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            total += v
+    return n, total
+
+
+def _alert_vector(fam):
+    """One rank's ``mxnet_alert_state`` family reduced to its per-rule
+    state vector ``{"rules": {rule: state}, "firing": [rule, ...]}``
+    (sample order preserved — the rollup renders it back verbatim)."""
+    rules = {}
+    firing = []
+    for sample in fam.get("values", ()):
+        if sample.get("value") != 1:
+            continue
+        labels = sample.get("labels", {})
+        rule, state = labels.get("rule"), labels.get("state")
+        if not rule or not state:
+            continue
+        rules[rule] = state
+        if state == "firing":
+            firing.append(rule)
+    if not rules:
+        return None
+    return {"rules": rules, "firing": firing}
+
+
+class FleetStore:
+    """Sharded, incrementally-upserted per-``(generation, rank)``
+    telemetry store — the leader-side half of the delta push protocol
+    (ISSUE 20 tentpole).
+
+    Replaces the KVServer's flat ``_telemetry`` dict + full re-merge:
+
+    * ranks are sharded across ``shards`` locks, so 1000 concurrently
+      pushing ranks never serialize on one mutex;
+    * :meth:`apply_push` decodes a full or delta payload and upserts
+      ONLY the changed families into the rank's retained family dict —
+      O(changed families) per push — while maintaining, in the same
+      pass, the fleet-wide family catalog aggregates and the per-rank
+      alert state vectors the summary rollup renders without any
+      per-rank dict scan;
+    * a delta whose ``base`` does not match the stored ``seq`` (server
+      restart, lost ack, generation bump) is refused with
+      ``{"resync": True}`` — the rank answers with one full push;
+    * retained generations are capped at ``MXNET_FLEET_HISTORY``
+      (:meth:`set_generation` prunes; ``dropped_generations`` feeds the
+      absence-safe truncation marker in the detail view).
+
+    ``clock`` (default ``time.monotonic``) stamps snapshot ages; the
+    fleet simulator injects a virtual clock so a 1000-rank, 50-cycle
+    run completes in seconds.
+    """
+
+    def __init__(self, clock=None, shards=16, history=None,
+                 generation=0):
+        self._clock = clock if clock is not None else time.monotonic
+        if history is None:
+            from ..config import get as _cfg
+            history = int(_cfg("MXNET_FLEET_HISTORY"))
+        self.history_cap = max(1, int(history))
+        self._nshards = max(1, int(shards))
+        self._shard_locks = [threading.Lock()
+                             for _ in range(self._nshards)]
+        self._meta = threading.Lock()   # generation-map structure
+        self._gens = {}                 # gen -> [shard dict, ...]
+        self._dropped_gens = 0
+        # current-generation aggregates (all under _agg_lock)
+        self._agg_lock = threading.Lock()
+        self._generation = int(generation)
+        self._families = {}   # family -> {type, ranks, samples, sum}
+        self._alerts = {}     # rank -> {"rules": {...}, "firing": [...]}
+        self._version = 0
+        self._counts = {"pushes": 0, "full": 0, "delta": 0, "resync": 0}
+        self._cache = None    # (version, built_mono, summary dict)
+
+    # -- structure ----------------------------------------------------------
+    def _gen_shards(self, gen):
+        with self._meta:
+            shards = self._gens.get(gen)
+            if shards is None:
+                shards = self._gens[gen] = [
+                    {} for _ in range(self._nshards)]
+            return shards
+
+    def set_generation(self, gen):
+        """Re-arm for a new elastic world generation: aggregates reset
+        (they describe the CURRENT generation only; ranks repopulate
+        them on their next push — a delta against a pre-bump baseline
+        resyncs), retained generations pruned to ``history_cap``."""
+        gen = int(gen)
+        self._gen_shards(gen)
+        with self._meta:
+            for old in sorted(self._gens)[:-self.history_cap]:
+                del self._gens[old]
+                self._dropped_gens += 1
+        with self._agg_lock:
+            self._generation = gen
+            self._families = {}
+            self._alerts = {}
+            self._version += 1
+            self._cache = None
+
+    def dropped_generations(self):
+        with self._meta:
+            return self._dropped_gens
+
+    def retained_generations(self):
+        with self._meta:
+            return sorted(self._gens)
+
+    # -- write path ---------------------------------------------------------
+    def apply_push(self, generation, rank, payload):
+        """Decode + upsert one rank's push; returns the wire reply
+        (``{"ok", "acked", "mode"}`` or ``{"ok", "resync"}``)."""
+        t0 = time.perf_counter()
+        rank = int(rank)
+        payload = payload or {}
+        shards = self._gen_shards(generation)
+        sh = rank % self._nshards
+        with self._shard_locks[sh]:
+            entry = shards[sh].get(rank)
+            if entry is None:
+                entry = shards[sh][rank] = {
+                    "families": {}, "stats": {}, "seq": None,
+                    "mono": None, "time": None}
+            delta = payload.get("delta")
+            if delta is not None:
+                if entry["seq"] is None or \
+                        entry["seq"] != delta.get("base"):
+                    with self._agg_lock:
+                        self._counts["resync"] += 1
+                    return {"ok": True, "resync": True}
+                mode = "delta"
+                changed = delta.get("changed") or {}
+                removed = delta.get("removed") or ()
+                entry["seq"] = delta.get("seq")
+            else:
+                mode = "full"
+                changed = payload.get("families") or {}
+                removed = [f for f in entry["families"]
+                           if f not in changed]
+                entry["seq"] = payload.get("seq")
+            fams, stats = entry["families"], entry["stats"]
+            agg_delta = []      # (family, type, dn, dsum, dranks)
+            alert_vec = ...     # sentinel: untouched
+            for f in removed:
+                old = stats.pop(f, None)
+                fams.pop(f, None)
+                if old is not None:
+                    agg_delta.append((f, None, -old[0], -old[1], -1))
+                if f == "mxnet_alert_state":
+                    alert_vec = None
+            for f, fam in changed.items():
+                old = stats.get(f)
+                n, s = _fam_stats(fam)
+                stats[f] = (n, s)
+                fams[f] = fam
+                agg_delta.append((
+                    f, fam.get("type"),
+                    n - (old[0] if old else 0),
+                    s - (old[1] if old else 0.0),
+                    0 if old else 1))
+                if f == "mxnet_alert_state":
+                    alert_vec = _alert_vector(fam)
+            entry["mono"] = self._clock()
+            entry["time"] = payload.get("time")
+            acked = entry["seq"]
+        with self._agg_lock:
+            if generation == self._generation:
+                catalog = self._families
+                for f, ftype, dn, dsum, dranks in agg_delta:
+                    agg = catalog.get(f)
+                    if agg is None:
+                        if dranks <= 0:
+                            continue
+                        agg = catalog[f] = {
+                            "type": ftype or "gauge", "ranks": 0,
+                            "samples": 0, "sum": 0.0}
+                    agg["ranks"] += dranks
+                    agg["samples"] += dn
+                    agg["sum"] += dsum
+                    if agg["ranks"] <= 0:
+                        del catalog[f]
+                if alert_vec is not ...:
+                    if alert_vec is None:
+                        self._alerts.pop(rank, None)
+                    else:
+                        self._alerts[rank] = alert_vec
+                self._version += 1
+            self._counts["pushes"] += 1
+            self._counts[mode] += 1
+        _merge_hist().observe(time.perf_counter() - t0)
+        return {"ok": True, "acked": acked, "mode": mode}
+
+    # -- read paths ---------------------------------------------------------
+    def legacy_view(self):
+        """The pre-ISSUE-20 ``server._telemetry`` shape
+        (``{gen: {rank: {"payload": {...}, "mono": t}}}``), built from
+        the store by reference — feeds :func:`_merge_view` so the
+        detail scrape stays byte-compatible with the old merge path."""
+        with self._meta:
+            gens = dict(self._gens)
+        out = {}
+        for gen, shards in gens.items():
+            ranks = {}
+            for shard, lock in zip(shards, self._shard_locks):
+                with lock:
+                    for rank, e in shard.items():
+                        ranks[rank] = {
+                            "payload": {"time": e["time"],
+                                        "families": e["families"]},
+                            "mono": e["mono"]}
+            if ranks:
+                out[gen] = ranks
+        return out
+
+    def snapshot_ages(self, generation, now_mono):
+        """{rank: seconds since last push} for one generation —
+        O(ranks) scalar reads, no family traffic."""
+        shards = self._gen_shards(generation)
+        ages = {}
+        for shard, lock in zip(shards, self._shard_locks):
+            with lock:
+                for rank, e in shard.items():
+                    if e["mono"] is not None:
+                        ages[rank] = max(0.0, now_mono - e["mono"])
+        return ages
+
+    def summary(self, states, generation, num_workers, peer_timeout,
+                now_mono, now_wall):
+        """The O(families + anomalous ranks) scrape contract: peer
+        counts + ONLY non-alive ranks + the incrementally-maintained
+        family catalog and vectorized alert rollup, cached for
+        ``ROLLUP_STALENESS_S``."""
+        with self._agg_lock:
+            cache = self._cache
+            if cache is not None and cache[0] == self._version and \
+                    now_mono - cache[1] < ROLLUP_STALENESS_S:
+                out = dict(cache[2])
+                out["time"] = now_wall
+                return out
+        ages = self.snapshot_ages(generation, now_mono)
+        peers = {"alive": 0, "stale": 0, "lost": 0, "unknown": 0}
+        anomalous = {}
+        rank_states = {}
+        age_max = None
+        for rank in range(int(num_workers)):
+            info = states.get(rank, {"state": "unknown", "age_s": None,
+                                     "step": 0})
+            snap_age = ages.get(rank)
+            state = info["state"]
+            if state == "alive" and (snap_age is None
+                                     or snap_age > peer_timeout):
+                state = "stale"
+            peers[state] = peers.get(state, 0) + 1
+            rank_states[str(rank)] = state
+            if snap_age is not None:
+                age_max = snap_age if age_max is None \
+                    else max(age_max, snap_age)
+            if state != "alive":
+                anomalous[str(rank)] = {
+                    "state": state, "age_s": info.get("age_s"),
+                    "step": info.get("step", 0),
+                    "snapshot_age_s": snap_age,
+                    "generation": generation}
+        with self._agg_lock:
+            families = {f: dict(v)
+                        for f, v in sorted(self._families.items())}
+            vectors = {r: {"rules": dict(v["rules"]),
+                           "firing": list(v["firing"])}
+                       for r, v in self._alerts.items()}
+            counts = dict(self._counts)
+            version = self._version
+        out = {"time": now_wall, "mode": "summary",
+               "generation": generation, "world": int(num_workers),
+               "peers": peers,
+               "snapshot_age_max_s": age_max,
+               "anomalous": anomalous,
+               "families": families,
+               "alerts": _rollup_from_vectors(vectors, rank_states),
+               "push_stats": counts,
+               "history": {"generations": len(
+                   self.retained_generations()),
+                   "dropped_generations": self.dropped_generations()}}
+        with self._agg_lock:
+            self._cache = (version, now_mono, out)
+        return out
+
+
+def _rollup_from_vectors(vectors, rank_states):
+    """The vectorized :func:`alert_rollup`: renders the per-rank state
+    vectors the store maintained at push time — O(alerting ranks), same
+    output shape (``{"by_rank", "firing"}``)."""
+    by_rank = {}
+    firing = []
+    for rank_str, vec in sorted((str(r), v) for r, v in vectors.items()):
+        rank_state = rank_states.get(rank_str, "unknown")
+        stale = rank_state != "alive"
+        by_rank[rank_str] = {"rank_state": rank_state, "stale": stale,
+                             "rules": dict(vec["rules"])}
+        for rule in vec["firing"]:
+            firing.append({"rank": rank_str, "rule": rule,
+                           "stale": stale, "rank_state": rank_state})
+    return {"by_rank": by_rank, "firing": firing}
+
+
 # -- leader side --------------------------------------------------------------
-def merge_server(server):
-    """Join a control-plane :class:`KVServer`'s stored telemetry
-    payloads with its liveness layer into the fleet snapshot.
+def _merge_view(states, generation, num_workers, stored, peer_timeout,
+                now_mono, now_wall):
+    """The pre-ISSUE-20 merge algorithm, verbatim, over an explicit
+    ``{gen: {rank: {"payload", "mono"}}}`` store — the detail
+    (``?detail=rank``) scrape contract, byte-compat pinned by the fleet
+    simulator at rank ≤ 8 against a shadow full-push store.
 
     State per rank (current generation):
 
@@ -129,16 +535,8 @@ def merge_server(server):
 
     Ranks from previous generations (a shrunk world) stay in the
     ``generations`` history tagged ``lost`` — a fleet consumer can see
-    every generation's per-rank families, never a silent drop.
+    every retained generation's per-rank families, never a silent drop.
     """
-    now_mono = time.monotonic()
-    peer_timeout = server._peer_timeout()
-    states = server._peer_states()
-    with server._lock:
-        generation = getattr(server, "_generation", 0)
-        num_workers = server.num_workers
-        stored = {gen: dict(ranks)
-                  for gen, ranks in server._telemetry.items()}
     cur = stored.get(generation, {})
     ranks = {}
     for rank in range(num_workers):
@@ -184,10 +582,52 @@ def merge_server(server):
                 "families": entry["payload"].get("families", {}),
             }
         generations[str(gen)] = gen_ranks
-    return {"time": time.time(), "generation": generation,
+    return {"time": now_wall, "generation": generation,
             "world": num_workers, "ranks": ranks,
             "generations": generations,
             "alerts": alert_rollup(ranks)}
+
+
+def merge_server(server, detail=None, _now=None):
+    """Join a control-plane :class:`KVServer`'s fleet store with its
+    liveness layer into the fleet snapshot.
+
+    ``detail``: ``None`` auto-selects (``"rank"`` at world ≤
+    ``DETAIL_AUTO_RANKS``, else ``"summary"``); ``"rank"`` forces the
+    full per-rank/per-generation view, anything else the summary.
+    ``_now`` pins the wall-clock stamp (simulator/back-compat tests).
+    """
+    store = server.fleet_store()
+    clock = getattr(server, "_clock", time.monotonic)
+    now_mono = clock()
+    peer_timeout = server._peer_timeout()
+    states = server._peer_states()
+    with server._lock:
+        generation = getattr(server, "_generation", 0)
+        num_workers = server.num_workers
+    now_wall = time.time() if _now is None else _now
+    if detail is None:
+        detail = "rank" if num_workers <= DETAIL_AUTO_RANKS \
+            else "summary"
+    t0 = time.perf_counter()
+    if detail in ("rank", "full", True):
+        out = _merge_view(states, generation, num_workers,
+                          store.legacy_view(), peer_timeout,
+                          now_mono, now_wall)
+        dropped = store.dropped_generations()
+        if dropped:
+            # absence-safe truncation marker: the key only appears once
+            # MXNET_FLEET_HISTORY actually pruned (pre-ISSUE-20 readers
+            # and the byte-compat pin never see it otherwise)
+            out["history"] = {
+                "retained_generations": len(
+                    store.retained_generations()),
+                "dropped_generations": dropped}
+    else:
+        out = store.summary(states, generation, num_workers,
+                            peer_timeout, now_mono, now_wall)
+    _rollup_hist().observe(time.perf_counter() - t0)
+    return out
 
 
 def alert_rollup(ranks):
@@ -196,7 +636,8 @@ def alert_rollup(ranks):
     {rule: state}, with non-``alive`` ranks' alerts tagged ``stale`` —
     a lost rank's last-known firing alert stays visible (never silently
     dropped), but a consumer can tell judgment from memory (ISSUE 13).
-    """
+    The summary scrape uses the vectorized equivalent
+    (:func:`_rollup_from_vectors`) instead of re-scanning families."""
     by_rank = {}
     firing = []
     for rank, v in sorted((ranks or {}).items()):
@@ -226,7 +667,9 @@ def alert_rollup(ranks):
 
 def set_provider(fn):
     """Install the fleet-snapshot provider (the elastic launcher wires
-    ``lambda: merge_server(server)``); None uninstalls."""
+    ``lambda detail=None: merge_server(server, detail=detail)``); None
+    uninstalls.  Providers without a ``detail`` parameter still work
+    (auto mode only)."""
     global _provider
     with _provider_lock:
         _provider = fn
@@ -237,13 +680,25 @@ def provider():
         return _provider
 
 
-def fleet_json():
+def _call_provider(fn, detail):
+    if detail is not None:
+        try:
+            return fn(detail=detail)
+        except TypeError:
+            # a provider predating the detail contract: serve auto mode
+            pass
+    return fn()
+
+
+def fleet_json(detail=None):
     """The ``/fleet.json`` payload: the provider's merged snapshot on
     the leader, a single-rank local view everywhere else (so the
-    endpoint is meaningful on any process)."""
+    endpoint is meaningful on any process).  ``detail`` mirrors the
+    ``?detail=`` query parameter (``rank`` | ``summary`` | None=auto).
+    """
     fn = provider()
     if fn is not None:
-        return fn()
+        return _call_provider(fn, detail)
     import os
     rank = os.environ.get("MXNET_MULTIHOST_PROC_ID", "0")
     ranks = {str(rank): {"state": "alive", "age_s": 0.0,
@@ -264,6 +719,15 @@ def _collector_snapshot():
     if fn is None:
         return {}
     snap = fn()
+    if snap.get("mode") == "summary":
+        return {"generation": snap.get("generation"),
+                "world": snap.get("world"),
+                "mode": "summary",
+                "peers": snap.get("peers", {}),
+                "anomalous": snap.get("anomalous", {}),
+                "families": len(snap.get("families", {})),
+                "push_stats": snap.get("push_stats", {}),
+                "alerts": snap.get("alerts", {})}
     return {"generation": snap.get("generation"),
             "world": snap.get("world"),
             "ranks": {r: {"state": v.get("state"),
@@ -276,15 +740,41 @@ def _collector_snapshot():
 
 
 def _collector_samples():
-    """Prometheus surface: every rank's counter/gauge samples re-emitted
-    with a ``rank`` label, plus fleet summary families.  Histogram
-    sample families (``_bucket``/``_sum``/``_count``) re-emit as
-    counters — le labels survive the merge."""
+    """Prometheus surface.  Detail worlds (≤ DETAIL_AUTO_RANKS):
+    every rank's counter/gauge samples re-emitted with a ``rank``
+    label (histogram sample families re-emit as counters — le labels
+    survive the merge).  Summary worlds: fleet summary families only —
+    re-emitting 1000 ranks × families into one text scrape is exactly
+    the O(ranks × families) surface ISSUE 20 removes."""
     fn = provider()
     if fn is None:
         return []
     snap = fn()
     out = []
+    if snap.get("mode") == "summary":
+        peers = snap.get("peers", {})
+        for state in ("alive", "stale", "lost", "unknown"):
+            out.append(("mxnet_fleet_peers", "gauge",
+                        "fleet ranks by merged liveness state",
+                        {"state": state}, peers.get(state, 0)))
+        age_max = snap.get("snapshot_age_max_s")
+        if isinstance(age_max, (int, float)):
+            out.append(("mxnet_fleet_snapshot_age_max_seconds", "gauge",
+                        "oldest rank snapshot age in the fleet",
+                        {}, age_max))
+        for rank, v in sorted((snap.get("anomalous") or {}).items()):
+            out.append(("mxnet_fleet_rank_state", "gauge",
+                        "per-rank liveness in the fleet snapshot (1 = "
+                        "the labelled state holds; summary mode emits "
+                        "only non-alive ranks)",
+                        {"rank": rank,
+                         "state": v.get("state", "unknown")}, 1))
+            if v.get("snapshot_age_s") is not None:
+                out.append(("mxnet_fleet_snapshot_age_seconds", "gauge",
+                            "age of each rank's last pushed registry "
+                            "snapshot", {"rank": rank},
+                            v["snapshot_age_s"]))
+        return out
     state_counts = {}
     for rank, v in sorted(snap.get("ranks", {}).items()):
         state = v.get("state", "unknown")

@@ -410,3 +410,69 @@ class MetricsRegistry:
             for key, value in samples:
                 lines.append(f"{family}{_render_labels(key)} {_fmt(value)}")
         return "\n".join(lines) + "\n"
+
+
+# -- delta sampling (ISSUE 20) ------------------------------------------------
+class SampleDeltaEncoder:
+    """Delta-encode successive :meth:`MetricsRegistry.sample_families`
+    snapshots against the last snapshot the receiver ACKNOWLEDGED, so an
+    unchanged family costs ~0 wire bytes and ~0 merge work on the fleet
+    leader (ISSUE 20 tentpole).
+
+    Protocol (one encoder per pushing rank, one decoder per rank on the
+    leader's :class:`~mxnet_tpu.telemetry.fleet.FleetStore`):
+
+    * ``encode(payload)`` assigns a monotonically increasing ``seq`` and
+      returns either a **full** payload (``{"seq", "time", "families"}``
+      — always on the first push or after :meth:`reset`) or a **delta**
+      payload ``{"time", "delta": {"base", "seq", "changed", "removed"}}``
+      where ``base`` names the acked snapshot the delta applies to;
+    * the receiver replies ``{"acked": seq}`` when it applied the push,
+      or ``{"resync": True}`` when its baseline for this rank does not
+      match ``base`` (server restart, lost ack, generation bump) — the
+      caller then calls :meth:`reset` and sends exactly ONE full push;
+    * ``ack(seq)`` commits the pending snapshot as the new baseline.
+      A push whose ack is lost leaves the baseline untouched, so the
+      next delta still applies cleanly against what the server last
+      confirmed — or triggers the resync path, never silent skew.
+    """
+
+    def __init__(self):
+        self._seq = 0
+        self._acked_seq = None
+        self._acked = None       # family dict the receiver confirmed
+        self._pending = {}       # seq -> families awaiting ack
+
+    def encode(self, payload):
+        families = payload.get("families") or {}
+        self._seq += 1
+        seq = self._seq
+        # supersede older unacked snapshots: pushes are synchronous, a
+        # lost one is replaced by the next (the baseline never advances
+        # past an ack, so correctness does not depend on them)
+        self._pending = {seq: families}
+        if self._acked is None:
+            out = dict(payload)
+            out["seq"] = seq
+            return out
+        base = self._acked
+        changed = {f: fam for f, fam in families.items()
+                   if base.get(f) != fam}
+        removed = [f for f in base if f not in families]
+        out = {k: v for k, v in payload.items() if k != "families"}
+        out["delta"] = {"base": self._acked_seq, "seq": seq,
+                        "changed": changed, "removed": removed}
+        return out
+
+    def ack(self, seq):
+        families = self._pending.pop(seq, None)
+        if families is not None:
+            self._acked = families
+            self._acked_seq = seq
+
+    def reset(self):
+        """Forget the baseline: the next :meth:`encode` emits a full
+        snapshot (the resync path when the receiver forgot this rank)."""
+        self._acked = None
+        self._acked_seq = None
+        self._pending = {}

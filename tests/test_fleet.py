@@ -1,0 +1,318 @@
+"""ISSUE 20: the fleet-scale telemetry plane — delta push protocol
+round-trips, resync-after-ack-loss, the sharded FleetStore's history
+cap, summary-vs-detail scrape contract, the rank<=8 byte-compat pin,
+the ``fleet/push`` chaos site, the plane's self-observability metric
+families, and the in-process 1000-rank simulator (run small here; CI
+runs it at 256, bench at 1000)."""
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from mxnet_tpu.telemetry import fleet, fleet_sim
+from mxnet_tpu.telemetry.registry import MetricsRegistry, \
+    SampleDeltaEncoder
+
+
+def _payload(reg, t):
+    return {"time": t, "families": reg.sample_families()}
+
+
+def _mixed_registry():
+    """One registry with every family type the wire carries: counter
+    (labelled), gauge, histogram (flattens to _bucket/_sum/_count
+    sample families), and a collector-backed family."""
+    reg = MetricsRegistry()
+    reg.counter("t_requests_total", "reqs").inc(3, labels={"lane": "a"})
+    reg.gauge("t_depth", "depth").set(7.0)
+    reg.histogram("t_lat_seconds", "lat",
+                  buckets=(0.01, 0.1, 1.0)).observe(0.05)
+    box = {"v": 1.0}
+    reg.register_collector(
+        "t_coll", lambda: {"t_coll": {"v": box["v"]}},
+        lambda: [("t_coll_value", "gauge", "collector-backed",
+                  {"src": "box"}, box["v"])])
+    return reg, box
+
+
+# -- delta protocol -----------------------------------------------------------
+def test_delta_round_trip_every_family_type():
+    """full -> ack -> mutate -> delta: the store's retained families
+    must equal a fresh local sample for counters, gauges, flattened
+    histograms AND collector-backed families."""
+    reg, box = _mixed_registry()
+    enc = SampleDeltaEncoder()
+    store = fleet.FleetStore(clock=lambda: 10.0)
+
+    p1 = enc.encode(_payload(reg, 1.0))
+    assert "delta" not in p1 and "seq" in p1
+    r1 = store.apply_push(0, 0, p1)
+    assert r1["mode"] == "full" and not r1.get("resync")
+    enc.ack(r1["acked"])
+
+    reg.counter("t_requests_total", "reqs").inc(2, labels={"lane": "b"})
+    reg.gauge("t_depth", "depth").set(9.5)
+    reg.histogram("t_lat_seconds", "lat").observe(0.5)
+    box["v"] = 2.5
+    p2 = enc.encode(_payload(reg, 2.0))
+    assert "delta" in p2
+    r2 = store.apply_push(0, 0, p2)
+    assert r2["mode"] == "delta"
+    enc.ack(r2["acked"])
+
+    stored = store.legacy_view()[0][0]["payload"]["families"]
+    assert stored == reg.sample_families()
+
+
+def test_delta_unchanged_registry_ships_nothing():
+    """No local movement between pushes -> an empty delta (the wire
+    win the 1000-rank plane is built on)."""
+    reg, _ = _mixed_registry()
+    enc = SampleDeltaEncoder()
+    store = fleet.FleetStore(clock=lambda: 10.0)
+    r1 = store.apply_push(0, 0, enc.encode(_payload(reg, 1.0)))
+    enc.ack(r1["acked"])
+    p2 = enc.encode(_payload(reg, 2.0))
+    assert p2["delta"]["changed"] == {} and \
+        list(p2["delta"]["removed"]) == []
+
+
+def test_delta_removed_family_propagates():
+    """A family that vanishes locally (collector unregistered) must
+    vanish from the leader's retained view via ``removed``."""
+    reg, _ = _mixed_registry()
+    enc = SampleDeltaEncoder()
+    store = fleet.FleetStore(clock=lambda: 10.0)
+    r1 = store.apply_push(0, 0, enc.encode(_payload(reg, 1.0)))
+    enc.ack(r1["acked"])
+    reg.unregister_collector("t_coll")
+    p2 = enc.encode(_payload(reg, 2.0))
+    assert "t_coll_value" in p2["delta"]["removed"]
+    r2 = store.apply_push(0, 0, p2)
+    enc.ack(r2["acked"])
+    stored = store.legacy_view()[0][0]["payload"]["families"]
+    assert "t_coll_value" not in stored
+    assert stored == reg.sample_families()
+
+
+def test_dropped_push_needs_no_resync():
+    """An unacked (dropped) delta leaves the baseline at the last ack;
+    the NEXT delta still applies cleanly — drops cost staleness, never
+    a resync round-trip."""
+    reg, _ = _mixed_registry()
+    enc = SampleDeltaEncoder()
+    store = fleet.FleetStore(clock=lambda: 10.0)
+    r1 = store.apply_push(0, 0, enc.encode(_payload(reg, 1.0)))
+    enc.ack(r1["acked"])
+    reg.gauge("t_depth", "depth").set(1.0)
+    enc.encode(_payload(reg, 2.0))        # encoded, never delivered
+    reg.gauge("t_depth", "depth").set(2.0)
+    p3 = enc.encode(_payload(reg, 3.0))
+    r3 = store.apply_push(0, 0, p3)
+    assert r3["mode"] == "delta" and not r3.get("resync")
+    enc.ack(r3["acked"])
+    stored = store.legacy_view()[0][0]["payload"]["families"]
+    assert stored == reg.sample_families()
+
+
+def test_resync_after_baseline_loss_is_exactly_one_full_push():
+    """A leader that forgot the rank's baseline (restart / generation
+    bump / lost ack) answers ``resync``; the rank resets its encoder
+    and sends exactly ONE full push, then returns to deltas."""
+    reg, _ = _mixed_registry()
+    enc = SampleDeltaEncoder()
+    store = fleet.FleetStore(clock=lambda: 10.0)
+    r1 = store.apply_push(0, 0, enc.encode(_payload(reg, 1.0)))
+    enc.ack(r1["acked"])
+
+    fresh = fleet.FleetStore(clock=lambda: 20.0)   # the restarted leader
+    reg.gauge("t_depth", "depth").set(42.0)
+    r2 = fresh.apply_push(0, 0, enc.encode(_payload(reg, 2.0)))
+    assert r2.get("resync") and "acked" not in r2
+
+    enc.reset()
+    p3 = enc.encode(_payload(reg, 3.0))
+    assert "delta" not in p3               # the one full resync push
+    r3 = fresh.apply_push(0, 0, p3)
+    assert r3["mode"] == "full"
+    enc.ack(r3["acked"])
+    p4 = enc.encode(_payload(reg, 4.0))
+    assert "delta" in p4                   # straight back to deltas
+    assert fresh.legacy_view()[0][0]["payload"]["families"] == \
+        reg.sample_families()
+
+
+def test_backcompat_rank8_byte_identical():
+    """The delta-fed store rendered at ``detail=rank`` must be
+    byte-identical to the pre-delta merge path fed the same pushes in
+    full — across a mid-run generation bump (which also forces the
+    resync path) and a silent rank."""
+    r = fleet_sim.run_backcompat(ranks=6, cycles=6)
+    assert r["identical"], r
+    assert r["resyncs"] >= 1       # the generation bump exercised resync
+
+
+# -- history cap + scrape contract --------------------------------------------
+def test_history_cap_plateaus_detail_scrape(monkeypatch):
+    """MXNET_FLEET_HISTORY caps retained generations: a restart loop
+    must NOT grow the detail scrape without bound, and the truncation
+    marker appears ONLY once generations were actually dropped."""
+    from mxnet_tpu.kvstore_server import KVServer
+
+    monkeypatch.setenv("MXNET_FLEET_HISTORY", "3")
+    clock = fleet_sim.SimClock()
+    server = KVServer(port=0, num_workers=2, peer_timeout_s=60.0,
+                      clock=clock)
+    reg, _ = _mixed_registry()
+    sizes = []
+    saw_marker = []
+    for gen in range(9):
+        server.reset_world(2, generation=gen)
+        clock.advance(1.0)
+        for rank in range(2):
+            server.apply_telemetry_push(rank, _payload(reg, clock()))
+        view = fleet.merge_server(server, detail="rank", _now=clock())
+        sizes.append(len(json.dumps(view, default=str, sort_keys=True)))
+        saw_marker.append("history" in view)
+    assert not saw_marker[0]              # absence-safe: no drops yet
+    assert saw_marker[-1]
+    assert view["history"]["dropped_generations"] == 6
+    assert len(view["generations"]) <= 3
+    assert sizes[-1] == sizes[-2] == sizes[-3]   # the plateau
+
+
+def test_summary_vs_detail_contract():
+    """Worlds above DETAIL_AUTO_RANKS auto-scrape the summary (peer
+    counts + catalog + anomalous only); ``detail=rank`` always returns
+    the full per-rank view; small worlds stay detail by default."""
+    from mxnet_tpu.kvstore_server import KVServer
+
+    clock = fleet_sim.SimClock()
+    server = KVServer(port=0, num_workers=32, peer_timeout_s=60.0,
+                      clock=clock)
+    reg, _ = _mixed_registry()
+    for rank in range(32):
+        with server._lock:
+            server._heartbeats[rank] = clock()
+        server.apply_telemetry_push(rank, _payload(reg, clock()))
+    auto = fleet.merge_server(server, _now=clock())
+    assert auto["mode"] == "summary"
+    assert "ranks" not in auto
+    assert auto["peers"]["alive"] == 32
+    assert "t_depth" in auto["families"]
+    assert auto["families"]["t_depth"]["ranks"] == 32
+    det = fleet.merge_server(server, detail="rank", _now=clock())
+    assert "mode" not in det and len(det["ranks"]) == 32
+
+    small = KVServer(port=0, num_workers=2, peer_timeout_s=60.0,
+                     clock=clock)
+    small.apply_telemetry_push(0, _payload(reg, clock()))
+    assert "ranks" in fleet.merge_server(small, _now=clock())
+
+
+def test_exporter_fleet_detail_query():
+    """``GET /fleet.json?detail=rank`` must reach the provider's
+    ``detail`` parameter; a bare scrape passes None (auto)."""
+    from mxnet_tpu.telemetry import exporter
+
+    seen = []
+
+    def provider(detail=None):
+        seen.append(detail)
+        return {"mode": "summary", "detail_echo": detail}
+
+    old = fleet.provider()
+    fleet.set_provider(provider)
+    try:
+        port = exporter.start_exporter(0)
+        base = f"http://127.0.0.1:{port}/fleet.json"
+        doc = json.load(urllib.request.urlopen(base, timeout=10))
+        assert doc["detail_echo"] is None
+        doc = json.load(urllib.request.urlopen(base + "?detail=rank",
+                                               timeout=10))
+        assert doc["detail_echo"] == "rank"
+        assert seen == [None, "rank"]
+    finally:
+        fleet.set_provider(old)
+        exporter.stop_exporter()
+
+
+# -- chaos site + self-observability ------------------------------------------
+def test_fleet_push_chaos_site_drops_push():
+    """The ``fleet/push`` site is cataloged and armed=raise fires on
+    the reporter's push path (after delta encode, before the leader)."""
+    from mxnet_tpu.chaos import failpoints as chaos
+
+    assert "fleet/push" in chaos.sites()
+    chaos.arm("fleet/push", "raise", hits=1, count=1)
+    try:
+        with pytest.raises(chaos.ChaosInjectedError):
+            fleet._push_failpoint()
+    finally:
+        chaos.reset()
+
+
+def test_fleet_merge_slow_rule_in_default_pack():
+    from mxnet_tpu.telemetry.alerts import default_rules
+
+    rules = {r.name: r for r in default_rules()}
+    assert "fleet_merge_slow" in rules
+    assert rules["fleet_merge_slow"].severity == "warn"
+    assert rules["fleet_merge_slow"].family == \
+        "mxnet_fleet_merge_seconds_sum"
+
+
+def test_reporter_socket_roundtrip_emits_self_observability():
+    """A real FleetReporter over a real socket: first push full, second
+    delta; the plane's own metric families (merge latency histogram,
+    push-bytes counter by mode, rollup histogram) appear in the global
+    registry, and the leader's push accounting shows the delta."""
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.kvstore_server import KVServer
+
+    server = KVServer(port=0, num_workers=2, peer_timeout_s=60.0)
+    t = threading.Thread(target=server.run, daemon=True)
+    t.start()
+    assert server.started.wait(timeout=10)
+    rep = None
+    try:
+        rep = fleet.FleetReporter("127.0.0.1", server.bound_port,
+                                  rank=0, world=2, interval_s=3600,
+                                  delta=True)
+        rep.push_now()
+        rep.push_now()
+        snap = fleet.merge_server(server, detail="summary")
+        assert snap["push_stats"]["delta"] >= 1
+        assert snap["push_stats"]["full"] >= 1
+        fams = telemetry.REGISTRY.sample_families()
+        assert "mxnet_fleet_merge_seconds_count" in fams
+        assert "mxnet_fleet_rollup_seconds_count" in fams
+        modes = {s["labels"].get("mode")
+                 for s in fams["mxnet_fleet_push_bytes"]["values"]}
+        assert {"full", "delta"} <= modes
+    finally:
+        if rep is not None:
+            rep.stop(final_push=False)
+        server._stop.set()
+        t.join(timeout=10)
+
+
+# -- the simulator is itself under test ---------------------------------------
+def test_small_sim_passes_all_gates():
+    r = fleet_sim.run_sim(ranks=16, cycles=10, interval_s=5.0, seed=1,
+                          alloc_window=0)
+    gates = fleet_sim.evaluate(r)
+    assert all(g["ok"] for g in gates.values()), \
+        {k: v for k, v in gates.items() if not v["ok"]}
+    assert r["merge"]["delta"] > r["merge"]["full"]
+    assert r["alerts"]["silent_rank_state"] == "lost"
+
+
+def test_rollup_under_churn_reduced():
+    from mxnet_tpu.chaos.harness import scenario_rollup_under_churn
+
+    r = scenario_rollup_under_churn(ranks=24, cycles=12)
+    assert r["ok"], r
+    assert r["dropped_pushes"] > 0 and not r["leader_exceptions"]
