@@ -55,9 +55,14 @@ def _mac(body, token):
 
 
 def _send_msg(sock, obj, token=None):
+    """Send one framed message; returns the wire frame size (length
+    header + MAC + pickled body) so callers can do byte accounting
+    without serializing the object a second time."""
     token = _token() if token is None else token
     body = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    sock.sendall(struct.pack("<I", len(body)) + _mac(body, token) + body)
+    frame = struct.pack("<I", len(body)) + _mac(body, token) + body
+    sock.sendall(frame)
+    return len(frame)
 
 
 def _recv_msg(sock, token=None):
@@ -600,6 +605,7 @@ class KVClient:
         self.sock = self._connect(timeout)
         self._lock = threading.Lock()
         self._closed = False
+        self._last_sent_bytes = 0  # wire size of the last sent batch
         # retry jitter stream: seeded by rank so a worker fleet's retry
         # storms decorrelate deterministically
         self._retry_rng = random.Random(1 + int(rank))
@@ -685,6 +691,14 @@ class KVClient:
         return self._rpc({"op": "telemetry_push", "rank": self.rank,
                           "payload": payload})
 
+    def last_sent_bytes(self):
+        """Wire bytes (length header + MAC + pickled body) of the most
+        recent successfully-sent RPC batch on this client — the fleet
+        reporter's push accounting reads this instead of re-pickling
+        its payload."""
+        with self._lock:
+            return self._last_sent_bytes
+
     def fleet_state(self):
         """The server's merged fleet snapshot (one bounded RPC)."""
         return self._rpc({"op": "fleet"})["value"]
@@ -733,9 +747,11 @@ class KVClient:
         protocol failures (bad MAC, oversized frame) stay RuntimeError
         and are never retried."""
         with self._lock:
+            sent = 0
             for m in msgs:
                 _failpoint("kvstore/client/rpc")
-                _send_msg(self.sock, m)
+                sent += _send_msg(self.sock, m)
+            self._last_sent_bytes = sent
             resps = [_recv_msg(self.sock) for _ in msgs]
         if any(r is None for r in resps):
             raise ConnectionError("kvstore server closed the connection")

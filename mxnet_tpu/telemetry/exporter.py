@@ -53,14 +53,23 @@ class _Handler(BaseHTTPRequestHandler):
                               sort_keys=True).encode("utf-8")
             ctype = "application/json"
         elif path in ("/fleet.json", "/fleet"):
+            from urllib.parse import parse_qs
             from . import fleet
-            # ?detail=rank -> the full per-rank/per-generation view;
-            # ?detail=summary -> the O(families + anomalous) rollup;
-            # unset -> auto by world size (docs/observability.md)
-            detail = None
-            for part in query.split("&"):
-                if part.startswith("detail="):
-                    detail = part.split("=", 1)[1] or None
+            # ?detail=rank|full -> the full per-rank/per-generation
+            # view; ?detail=summary -> the O(families + anomalous)
+            # rollup; unset -> auto by world size
+            # (docs/observability.md).  Anything else is a 400 — a typo
+            # must not silently downgrade a small world to summary.
+            raw = parse_qs(query, keep_blank_values=True).get(
+                "detail", [""])[-1].strip().lower()
+            if raw in ("rank", "full", "summary"):
+                detail = raw
+            elif raw == "":
+                detail = None
+            else:
+                self.send_error(
+                    400, "detail must be rank, full, or summary")
+                return
             body = json.dumps(fleet.fleet_json(detail=detail),
                               default=str,
                               sort_keys=True).encode("utf-8")

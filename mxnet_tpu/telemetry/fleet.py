@@ -134,6 +134,10 @@ class FleetReporter:
             self._encoder = SampleDeltaEncoder()
         else:
             self._encoder = None
+        # the loop thread and the stop()/fault path share ONE client
+        # socket and ONE delta encoder: pushes must serialize or
+        # interleaved RPC frames / out-of-order seqs garble a push
+        self._push_lock = threading.Lock()
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="mx-fleet-reporter")
         self._thread.start()
@@ -161,29 +165,40 @@ class FleetReporter:
                 return
 
     def push_now(self):
-        """One synchronous push (used by the loop and the fault path)."""
-        client = self._ensure_client()
-        payload = local_payload()
-        if self._encoder is not None:
-            payload = self._encoder.encode(payload)
-        _push_failpoint()
-        resp = client.push_telemetry(payload) or {}
-        if self._encoder is not None and resp.get("resync"):
-            # the server forgot this rank's baseline (restart, lost
-            # ack, generation bump): exactly ONE full push resyncs
-            self._encoder.reset()
-            payload = self._encoder.encode(local_payload())
+        """One synchronous push (used by the loop and the fault path;
+        the lock serializes the two callers)."""
+        with self._push_lock:
+            client = self._ensure_client()
+            payload = local_payload()
+            if self._encoder is not None:
+                payload = self._encoder.encode(payload)
+            _push_failpoint()
             resp = client.push_telemetry(payload) or {}
-        if self._encoder is not None and resp.get("acked") is not None:
-            self._encoder.ack(resp["acked"])
-        self._record_push(payload)
+            if self._encoder is not None and resp.get("resync"):
+                # the server forgot this rank's baseline (restart, lost
+                # ack, generation bump): exactly ONE full push resyncs
+                self._encoder.reset()
+                payload = self._encoder.encode(local_payload())
+                resp = client.push_telemetry(payload) or {}
+            if self._encoder is not None and \
+                    resp.get("acked") is not None:
+                self._encoder.ack(resp["acked"])
+            self._record_push(payload, client)
 
-    def _record_push(self, payload):
+    def _record_push(self, payload, client=None):
         try:
             mode = "delta" if "delta" in payload else "full"
-            _push_bytes_counter().inc(
-                len(pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)),
-                labels={"mode": mode})
+            nbytes = None
+            if client is not None:
+                last = getattr(client, "last_sent_bytes", None)
+                if last is not None:
+                    # the RPC already serialized the push — read the
+                    # wire frame size instead of re-pickling the payload
+                    nbytes = last()
+            if nbytes is None:
+                nbytes = len(pickle.dumps(
+                    payload, protocol=pickle.HIGHEST_PROTOCOL))
+            _push_bytes_counter().inc(nbytes, labels={"mode": mode})
         except Exception as e:  # noqa: BLE001 — accounting must not fail the push path
             log.debug("fleet push accounting failed: %s", e)
 
@@ -253,7 +268,10 @@ class FleetStore:
       per-rank dict scan;
     * a delta whose ``base`` does not match the stored ``seq`` (server
       restart, lost ack, generation bump) is refused with
-      ``{"resync": True}`` — the rank answers with one full push;
+      ``{"resync": True}`` — the rank answers with one full push; so
+      is any push for a non-current generation (it raced
+      ``reset_world``): applying it would resurrect a pruned
+      generation into retained history;
     * retained generations are capped at ``MXNET_FLEET_HISTORY``
       (:meth:`set_generation` prunes; ``dropped_generations`` feeds the
       absence-safe truncation marker in the detail view).
@@ -274,7 +292,11 @@ class FleetStore:
         self._shard_locks = [threading.Lock()
                              for _ in range(self._nshards)]
         self._meta = threading.Lock()   # generation-map structure
-        self._gens = {}                 # gen -> [shard dict, ...]
+        # gen -> [shard dict, ...]; the initial generation's shards
+        # exist from birth so apply_push's not-in-_gens refusal never
+        # bounces a non-elastic world's first push into a resync loop
+        self._gens = {int(generation): [
+            {} for _ in range(self._nshards)]}
         self._dropped_gens = 0
         # current-generation aggregates (all under _agg_lock)
         self._agg_lock = threading.Lock()
@@ -327,21 +349,37 @@ class FleetStore:
         t0 = time.perf_counter()
         rank = int(rank)
         payload = payload or {}
-        shards = self._gen_shards(generation)
+        with self._agg_lock:
+            current = self._generation
+        with self._meta:
+            shards = self._gens.get(generation)
+        if generation != current or shards is None:
+            # a push that raced reset_world (read the old generation
+            # before the bump) or targets a pruned one: refuse rather
+            # than resurrect a near-empty generation into retained
+            # history — the rank answers with one full push at the
+            # generation it reads next
+            with self._agg_lock:
+                self._counts["resync"] += 1
+            return {"ok": True, "resync": True}
         sh = rank % self._nshards
         with self._shard_locks[sh]:
             entry = shards[sh].get(rank)
-            if entry is None:
-                entry = shards[sh][rank] = {
-                    "families": {}, "stats": {}, "seq": None,
-                    "mono": None, "time": None}
             delta = payload.get("delta")
             if delta is not None:
-                if entry["seq"] is None or \
+                # decide the refusal BEFORE creating the entry: a
+                # refused delta must not leave an empty placeholder
+                # (mono=None) that a concurrent detail merge trips on
+                if entry is None or entry["seq"] is None or \
                         entry["seq"] != delta.get("base"):
                     with self._agg_lock:
                         self._counts["resync"] += 1
                     return {"ok": True, "resync": True}
+            if entry is None:
+                entry = shards[sh][rank] = {
+                    "families": {}, "stats": {}, "seq": None,
+                    "mono": None, "time": None}
+            if delta is not None:
                 mode = "delta"
                 changed = delta.get("changed") or {}
                 removed = delta.get("removed") or ()
@@ -407,9 +445,14 @@ class FleetStore:
     # -- read paths ---------------------------------------------------------
     def legacy_view(self):
         """The pre-ISSUE-20 ``server._telemetry`` shape
-        (``{gen: {rank: {"payload": {...}, "mono": t}}}``), built from
-        the store by reference — feeds :func:`_merge_view` so the
-        detail scrape stays byte-compatible with the old merge path."""
+        (``{gen: {rank: {"payload": {...}, "mono": t}}}``) — feeds
+        :func:`_merge_view` so the detail scrape stays byte-compatible
+        with the old merge path.  Each rank's families dict is
+        shallow-copied UNDER its shard lock: apply_push mutates the
+        stored dict in place, and a reader iterating the live dict
+        (json.dumps / the fleet-RPC pickle) would race it.  Inner
+        family dicts are replaced wholesale on upsert, never mutated,
+        so the shallow copy is a consistent snapshot."""
         with self._meta:
             gens = dict(self._gens)
         out = {}
@@ -420,7 +463,7 @@ class FleetStore:
                     for rank, e in shard.items():
                         ranks[rank] = {
                             "payload": {"time": e["time"],
-                                        "families": e["families"]},
+                                        "families": dict(e["families"])},
                             "mono": e["mono"]}
             if ranks:
                 out[gen] = ranks

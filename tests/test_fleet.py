@@ -7,6 +7,7 @@ runs it at 256, bench at 1000)."""
 import json
 import threading
 import time
+import urllib.error
 import urllib.request
 
 import pytest
@@ -131,6 +132,10 @@ def test_resync_after_baseline_loss_is_exactly_one_full_push():
     reg.gauge("t_depth", "depth").set(42.0)
     r2 = fresh.apply_push(0, 0, enc.encode(_payload(reg, 2.0)))
     assert r2.get("resync") and "acked" not in r2
+    # the refusal must not leave an empty placeholder entry behind: a
+    # detail merge between the resync reply and the full push would
+    # trip on its mono=None snapshot age
+    assert fresh.legacy_view() == {}
 
     enc.reset()
     p3 = enc.encode(_payload(reg, 3.0))
@@ -142,6 +147,54 @@ def test_resync_after_baseline_loss_is_exactly_one_full_push():
     assert "delta" in p4                   # straight back to deltas
     assert fresh.legacy_view()[0][0]["payload"]["families"] == \
         reg.sample_families()
+
+
+def test_legacy_view_snapshot_isolated_from_later_pushes():
+    """``legacy_view`` shallow-copies each rank's families under the
+    shard lock: a reader serializing the view (json.dumps, the fleet
+    RPC pickle) while pushes keep landing must never see the stored
+    dict mutate under it."""
+    reg, _ = _mixed_registry()
+    enc = SampleDeltaEncoder()
+    store = fleet.FleetStore(clock=lambda: 10.0)
+    r1 = store.apply_push(0, 0, enc.encode(_payload(reg, 1.0)))
+    enc.ack(r1["acked"])
+    view = store.legacy_view()
+    before = dict(view[0][0]["payload"]["families"])
+
+    reg.counter("t_late_total", "landed after the view").inc(1)
+    reg.gauge("t_depth", "depth").set(99.0)
+    r2 = store.apply_push(0, 0, enc.encode(_payload(reg, 2.0)))
+    assert r2["mode"] == "delta"
+    assert view[0][0]["payload"]["families"] == before
+    assert "t_late_total" not in view[0][0]["payload"]["families"]
+    assert "t_late_total" in \
+        store.legacy_view()[0][0]["payload"]["families"]
+
+
+def test_stale_generation_push_refused_not_resurrected():
+    """A push carrying a non-current generation (it raced
+    ``reset_world``, or its generation was already pruned) is refused
+    with ``resync`` instead of upserting into — or worse, recreating —
+    a historical generation."""
+    reg, _ = _mixed_registry()
+    store = fleet.FleetStore(clock=lambda: 10.0, history=2)
+    store.apply_push(0, 0, _payload(reg, 1.0))
+    store.set_generation(1)
+
+    r = store.apply_push(0, 1, _payload(reg, 2.0))   # raced the bump
+    assert r.get("resync") and "acked" not in r
+    assert sorted(store.legacy_view()[0]) == [0]     # history untouched
+    assert store.retained_generations() == [0, 1]
+
+    store.set_generation(2)
+    store.set_generation(3)                          # gens 0, 1 pruned
+    r = store.apply_push(0, 2, _payload(reg, 3.0))   # pruned gen
+    assert r.get("resync")
+    assert store.retained_generations() == [2, 3]    # never resurrected
+
+    r = store.apply_push(3, 0, _payload(reg, 4.0))   # current: applies
+    assert r["mode"] == "full" and not r.get("resync")
 
 
 def test_backcompat_rank8_byte_identical():
@@ -233,7 +286,16 @@ def test_exporter_fleet_detail_query():
         doc = json.load(urllib.request.urlopen(base + "?detail=rank",
                                                timeout=10))
         assert doc["detail_echo"] == "rank"
-        assert seen == [None, "rank"]
+        # %-encoded and case-variant values decode before the check
+        doc = json.load(urllib.request.urlopen(
+            base + "?detail=%52ank", timeout=10))
+        assert doc["detail_echo"] == "rank"
+        assert seen == [None, "rank", "rank"]
+        # a typo is a 400, never a silent downgrade to summary
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(base + "?detail=rnak", timeout=10)
+        assert err.value.code == 400
+        assert len(seen) == 3                 # never hit the provider
     finally:
         fleet.set_provider(old)
         exporter.stop_exporter()
